@@ -1,0 +1,109 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's
+capability surface, rebuilt on JAX/XLA/Pallas (ref: Yelrose/Paddle at
+/root/reference; see SURVEY.md for the layer map this mirrors).
+
+Programming model (ref README.md dual model):
+  - dygraph (eager): ops dispatch to XLA-cached executables, tape autograd
+    (`Tensor.backward()`).
+  - compiled: `paddle_tpu.jit.to_static` / hapi `Model` trace whole train steps
+    through jax.jit — the static-graph analog where XLA owns fusion/scheduling.
+"""
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    Tensor, Parameter, to_tensor,
+    float16, bfloat16, float32, float64, int8, int16, int32, int64, uint8,
+    bool_, complex64, complex128,
+    CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
+    set_device, get_device, seed, set_flags, get_flags, no_grad,
+    set_default_dtype, get_default_dtype, is_grad_enabled,
+)
+
+from . import framework
+from . import ops
+from .ops.creation import (  # noqa: F401
+    zeros, ones, full, empty, zeros_like, ones_like, full_like, empty_like,
+    arange, linspace, logspace, eye, diag, diagflat, tril, triu, meshgrid,
+    assign, clone, rand, randn, normal, uniform, randint, randperm, bernoulli,
+    multinomial, standard_normal,
+)
+from .ops.math import (  # noqa: F401
+    add, subtract, multiply, divide, floor_divide, remainder, mod, pow,
+    maximum, minimum, fmax, fmin, abs, neg, exp, expm1, log, log2, log10,
+    log1p, sqrt, rsqrt, square, reciprocal, sin, cos, tan, asin, acos, atan,
+    sinh, cosh, tanh, asinh, acosh, atanh, erf, floor, ceil, round, trunc,
+    sign, clip, isnan, isinf, isfinite, nan_to_num, sum, mean, prod, max, min,
+    amax, amin, logsumexp, std, var, median, argmax, argmin, cumsum, cumprod,
+    count_nonzero, matmul, mm, dot, bmm, inner, outer, addmm, kron, trace,
+    diagonal, topk, sort, argsort, unique, kthvalue, scale, increment,
+    multiplex, atan2, sigmoid, lgamma, digamma, erfinv,
+)
+from .ops.manipulation import (  # noqa: F401
+    cast, reshape, reshape_, flatten, transpose, moveaxis, swapaxes, t, concat,
+    stack, unstack, split, chunk, unbind, squeeze, unsqueeze, expand,
+    broadcast_to, expand_as, tile, repeat_interleave, flip, roll, rot90,
+    slice, strided_slice, gather, gather_nd, scatter, scatter_nd,
+    scatter_nd_add, index_select, index_sample, where, nonzero, masked_select,
+    masked_fill, take_along_axis, put_along_axis, shard_index, one_hot,
+    tensordot, as_complex, as_real, crop,
+)
+from .ops.logic import (  # noqa: F401
+    equal, not_equal, greater_than, greater_equal, less_than, less_equal,
+    logical_and, logical_or, logical_xor, logical_not, bitwise_and, bitwise_or,
+    bitwise_xor, bitwise_not, all, any, isclose, allclose, equal_all,
+    is_empty, is_tensor,
+)
+from .ops import linalg  # noqa: F401
+
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import metric  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from . import distributed  # noqa: E402
+from . import static  # noqa: E402
+from . import vision  # noqa: E402
+from .framework.serialization import save, load  # noqa: E402
+from .hapi.model import Model, summary  # noqa: E402
+from .framework.state import get_flags, set_flags  # noqa: E402,F811
+
+# dygraph-mode queries (reference framework.py:182 in_dygraph_mode)
+def in_dynamic_mode():
+    from .framework import state as _s
+    return not _s.is_functional_mode()
+
+
+in_dygraph_mode = in_dynamic_mode
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    from .static import _enable_static_mode
+    _enable_static_mode()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad analog (ref imperative/partial_grad_engine.cc): returns grads
+    of `outputs` wrt `inputs` without touching `.grad` slots."""
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = [(t.grad, t.stop_gradient) for t in ins]
+    for t in ins:
+        t.grad = None
+    rg = retain_graph if retain_graph is not None else create_graph
+    for o in outs:
+        o.backward(retain_graph=True if rg else False)
+    grads = [t.grad for t in ins]
+    for t, (g, sg) in zip(ins, saved):
+        t.grad = g
+    for g, t in zip(grads, ins):
+        if g is None and not allow_unused:
+            raise RuntimeError(f"grad: input {t.name} unused in graph "
+                               "(pass allow_unused=True to get None)")
+    return grads

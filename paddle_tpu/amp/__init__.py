@@ -1,0 +1,1 @@
+"""placeholder — filled in during round 1 build."""

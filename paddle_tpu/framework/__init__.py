@@ -1,0 +1,10 @@
+from .dtype import (float16, bfloat16, float32, float64, int8, int16, int32,
+                    int64, uint8, bool_, complex64, complex128, convert_dtype,
+                    dtype_name, is_floating_point, is_integer)
+from .state import (Place, CPUPlace, TPUPlace, CUDAPlace, XPUPlace, set_device,
+                    get_device, get_place, seed, default_generator, next_rng_key,
+                    set_flags, get_flags, get_flag, no_grad, no_grad_ctx,
+                    enable_grad_ctx, functional_mode_ctx, is_grad_enabled,
+                    is_functional_mode, set_default_dtype, get_default_dtype)
+from .tensor import Tensor, Parameter, to_tensor
+from . import tape

@@ -1,0 +1,78 @@
+"""Dtype taxonomy for paddle_tpu.
+
+TPU-first: bfloat16 is a first-class dtype (ref: paddle/fluid/framework/data_type.h
+enumerates fp16/fp32/fp64/int*/bool; we add bf16 as the primary mixed-precision type
+since the MXU natively consumes bf16).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects are numpy dtypes (what jax uses under the hood).
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+uint8 = jnp.dtype(jnp.uint8)
+bool_ = jnp.dtype(jnp.bool_)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+
+_STR2DTYPE = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8,
+    "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGER = {int8, int16, int32, int64, uint8}
+
+
+def convert_dtype(dtype):
+    """Normalise a dtype spec (str / np.dtype / jnp type) to a numpy dtype.
+
+    TPU-first: with x64 disabled (the default — 32-bit indices keep gathers and
+    iotas on the fast path), int64/float64 requests map to their 32-bit
+    counterparts instead of warning on every op.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            d = _STR2DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+    else:
+        d = jnp.dtype(dtype)
+    import jax
+    if not jax.config.jax_enable_x64:
+        d = {float64: float32, int64: int32, complex128: complex64}.get(d, d)
+    return d
+
+
+def dtype_name(dtype):
+    d = jnp.dtype(dtype)
+    if d == bfloat16:
+        return "bfloat16"
+    return d.name
+
+
+def is_floating_point(dtype):
+    return jnp.dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype):
+    return jnp.dtype(dtype) in _INTEGER
+
+
+def default_dtype():
+    from . import state
+    return state.get_default_dtype()

@@ -1,0 +1,4 @@
+def save(obj, path, **k):
+    raise NotImplementedError
+def load(path, **k):
+    raise NotImplementedError

@@ -1,0 +1,269 @@
+"""Process-global framework state: device/place, RNG, flags, grad & functional modes.
+
+TPU-native redesign of the reference's process-wide services:
+  - Place taxonomy + DeviceContextPool (ref paddle/fluid/platform/place.h,
+    device_context.h:691) -> a current-Place holder; JAX/PJRT owns streams.
+  - gflags FLAGS_* (ref platform/flags.cc) -> a plain dict with set_flags/get_flags.
+  - Generator RNG (ref framework/generator.h:93) -> a split-on-demand JAX PRNG key chain.
+Grad mode (no_grad) and functional mode (tracing under jax.jit/jax.grad, where the
+tape must NOT record) are contextvars so they compose with threads.
+"""
+import contextlib
+import contextvars
+import threading
+
+import jax
+import numpy as np
+
+from .dtype import float32, convert_dtype
+
+# --------------------------------------------------------------------------- places
+
+
+class Place:
+    """Device placement descriptor. TPU-native: maps onto a jax.Device."""
+
+    def __init__(self, kind: str, device_id: int = 0):
+        self.kind = kind
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self.kind == other.kind
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.kind, self.device_id))
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _platform_of(d) == self.kind]
+        if not devs:  # fall back to host
+            devs = jax.devices("cpu")
+        return devs[min(self.device_id, len(devs) - 1)]
+
+    def is_cpu_place(self):
+        return self.kind == "cpu"
+
+    def is_tpu_place(self):
+        return self.kind != "cpu"
+
+    # reference-API aliases
+    is_gpu_place = is_tpu_place
+
+
+def _platform_of(d):
+    p = d.platform
+    # axon tunnels expose the real TPU under an experimental platform name
+    return "tpu" if p in ("tpu", "axon") else p
+
+
+def CPUPlace():
+    return Place("cpu", 0)
+
+
+def TPUPlace(device_id=0):
+    return Place("tpu", device_id)
+
+
+# Reference compat: CUDAPlace scripts run on the accelerator place.
+CUDAPlace = TPUPlace
+XPUPlace = TPUPlace
+
+
+class _GlobalState(threading.local):
+    pass
+
+
+_state = _GlobalState()
+
+
+def _detect_default_place():
+    for d in jax.devices():
+        if _platform_of(d) != "cpu":
+            return Place(_platform_of(d), 0)
+    return Place("cpu", 0)
+
+
+_current_place = None
+_default_dtype = float32
+
+
+def set_device(device):
+    """paddle.set_device analog: 'cpu', 'tpu', 'tpu:0', 'gpu:0' (alias of tpu)."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return _current_place
+    device = str(device)
+    if ":" in device:
+        kind, idx = device.split(":")
+        idx = int(idx)
+    else:
+        kind, idx = device, 0
+    if kind in ("gpu", "cuda", "xpu", "npu", "tpu"):
+        kind = "tpu"
+    _current_place = Place(kind, idx)
+    return _current_place
+
+
+def get_device():
+    p = get_place()
+    return f"{p.kind}:{p.device_id}"
+
+
+def get_place():
+    global _current_place
+    if _current_place is None:
+        _current_place = _detect_default_place()
+    return _current_place
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+# --------------------------------------------------------------------------- RNG
+
+
+class Generator:
+    """Split-on-demand PRNG chain (ref framework/generator.h:93 kept functional:
+    every draw advances the chain by splitting, so eager ops stay reproducible)."""
+
+    def __init__(self, seed=0):
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed):
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+        return self
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+
+_default_generator = Generator(0)
+
+
+def seed(s):
+    """paddle.seed analog."""
+    _default_generator.manual_seed(int(s))
+    np.random.seed(int(s) % (2 ** 32))
+    return _default_generator
+
+
+def default_generator():
+    return _default_generator
+
+
+def next_rng_key():
+    return _default_generator.next_key()
+
+
+# --------------------------------------------------------------------------- flags
+
+_FLAGS = {
+    "FLAGS_check_nan_inf": False,           # ref platform/flags.cc:44
+    "FLAGS_sort_sum_gradient": False,       # ref platform/flags.cc:527
+    "FLAGS_cudnn_deterministic": True,      # XLA is deterministic by default
+    "FLAGS_matmul_precision": "default",    # TPU knob: default|high|highest
+    "FLAGS_eager_op_cache": True,
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_use_donated_buffers": True,
+}
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        _FLAGS[k] = v
+
+
+def get_flags(keys=None):
+    if keys is None:
+        return dict(_FLAGS)
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _FLAGS.get(k) for k in keys}
+
+
+def get_flag(key, default=None):
+    return _FLAGS.get(key, default)
+
+
+# --------------------------------------------------------------------------- modes
+
+_grad_enabled = contextvars.ContextVar("grad_enabled", default=True)
+_functional_mode = contextvars.ContextVar("functional_mode", default=False)
+
+
+def is_grad_enabled():
+    return _grad_enabled.get()
+
+
+def is_functional_mode():
+    return _functional_mode.get()
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    tok = _grad_enabled.set(False)
+    try:
+        yield
+    finally:
+        _grad_enabled.reset(tok)
+
+
+@contextlib.contextmanager
+def enable_grad_ctx():
+    tok = _grad_enabled.set(True)
+    try:
+        yield
+    finally:
+        _grad_enabled.reset(tok)
+
+
+@contextlib.contextmanager
+def functional_mode_ctx():
+    """Active while tracing a pure function under jax.jit/grad: the eager tape is
+    bypassed and autodiff is delegated to JAX (the performance path)."""
+    tok = _functional_mode.set(True)
+    try:
+        yield
+    finally:
+        _functional_mode.reset(tok)
+
+
+class no_grad:
+    """Usable as decorator and context manager, like paddle.no_grad."""
+
+    def __enter__(self):
+        self._tok = _grad_enabled.set(False)
+        return self
+
+    def __exit__(self, *exc):
+        _grad_enabled.reset(self._tok)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad_ctx():
+                return fn(*a, **k)
+
+        return wrapper

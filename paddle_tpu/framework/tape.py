@@ -1,0 +1,131 @@
+"""Eager autograd engine (dygraph `.backward()`).
+
+TPU-native redesign of the reference imperative engine
+(ref paddle/fluid/imperative/basic_engine.cc:39,265 BasicEngine::Init/Execute and
+gradient_accumulator.cc): instead of GradOpNode objects created from a C++ grad-op
+registry, every eager op records a GradNode whose `vjp` closure comes from jax.vjp of
+the op's pure-JAX implementation — the VJP itself is XLA-compiled, so the backward
+hot loop is one cached executable launch per op, mirroring the reference's
+one-C++-crossing-per-op design (ref pybind/op_function_generator.cc:488).
+
+Graph lifetime is reference-counted through the output tensors (a node lives as long
+as some tensor produced by it), matching dygraph semantics where dropping activations
+frees the graph. `backward()` runs a pending-count topological sweep like
+BasicEngine::Execute's ready queue.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import state
+
+
+class GradNode:
+    """One recorded op. Outputs hold (node, slot) so multi-output ops share a node."""
+
+    __slots__ = ("vjp", "inputs", "n_outputs", "out_shapes", "out_dtypes", "name",
+                 "__weakref__")
+
+    def __init__(self, vjp, inputs, n_outputs, out_shapes, out_dtypes, name=""):
+        self.vjp = vjp                  # callable: tuple(cotangents) -> tuple(in grads)
+        self.inputs = inputs            # list[Tensor | None]; None = non-diff input
+        self.n_outputs = n_outputs
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+        self.name = name
+
+
+def _is_float0(g):
+    return g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0)
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False):
+    """Reverse sweep from `tensor`. Accumulates into leaf `.grad` (paddle semantics:
+    grads accumulate across backward calls until clear_grad)."""
+    from .tensor import Tensor
+
+    root_node = tensor._node
+    if grad_tensor is None:
+        if tensor._data.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar tensor requires an explicit grad_tensor")
+        seed_grad = jnp.ones_like(tensor._data)
+    else:
+        seed_grad = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    if root_node is None:
+        if not tensor.stop_gradient:
+            _accumulate_leaf(tensor, seed_grad)
+        return
+
+    # ---- pass 1: count consumer edges per node (DFS over the creator graph)
+    pending = {}          # id(node) -> number of consumer edges not yet satisfied
+    nodes = {}            # id(node) -> node (keep alive during sweep)
+    stack = [root_node]
+    nodes[id(root_node)] = root_node
+    pending[id(root_node)] = 0
+    while stack:
+        node = stack.pop()
+        for inp in node.inputs:
+            if inp is None or inp.stop_gradient:
+                continue
+            child = inp._node
+            if child is None:
+                continue
+            cid = id(child)
+            if cid not in pending:
+                pending[cid] = 0
+                nodes[cid] = child
+                stack.append(child)
+            pending[cid] += 1
+
+    # ---- pass 2: ready-queue sweep (ref basic_engine.cc:265)
+    # cotangent buckets per node output slot
+    cots = {id(root_node): [None] * root_node.n_outputs}
+    cots[id(root_node)][tensor._slot] = seed_grad
+    ready = [root_node]
+    visited_nodes = []
+    while ready:
+        node = ready.pop()
+        visited_nodes.append(node)
+        nid = id(node)
+        slot_cots = cots.pop(nid)
+        full_cots = tuple(
+            c if c is not None else jnp.zeros(s, d)
+            for c, s, d in zip(slot_cots, node.out_shapes, node.out_dtypes))
+        in_grads = node.vjp(full_cots if node.n_outputs > 1 else full_cots[0])
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        for inp, g in zip(node.inputs, in_grads):
+            if inp is None or inp.stop_gradient or _is_float0(g):
+                continue
+            child = inp._node
+            if child is None:
+                _accumulate_leaf(inp, g)
+                continue
+            cid = id(child)
+            if cid not in pending:      # reached via a path pruned in pass 1
+                continue
+            bucket = cots.setdefault(cid, [None] * child.n_outputs)
+            slot = inp._slot
+            bucket[slot] = g if bucket[slot] is None else bucket[slot] + g
+            pending[cid] -= 1
+            if pending[cid] == 0:
+                ready.append(child)
+
+    if not retain_graph:
+        for node in visited_nodes:
+            node.vjp = None
+            node.inputs = ()
+        # detach root so a second backward errors out cleanly
+        tensor._node = None
+
+
+def _accumulate_leaf(t, g):
+    from .tensor import Tensor
+    if g.dtype != t._data.dtype:
+        g = g.astype(t._data.dtype)
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad._data + g, stop_gradient=True)

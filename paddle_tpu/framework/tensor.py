@@ -1,0 +1,266 @@
+"""paddle_tpu.Tensor — eager tensor wrapping a jax.Array.
+
+TPU-native analog of the reference's imperative VarBase (ref
+paddle/fluid/imperative/layer.h:65) + LoDTensor storage (ref
+paddle/fluid/framework/tensor.h:89): device memory is owned by PJRT (no custom
+allocator needed — ref memory/allocation/allocator_facade.h becomes the PJRT
+arena), autograd linkage is (`_node`, `_slot`) into the tape (tape.py).
+
+Ragged LoDTensor has no XLA-friendly equivalent; sequence ops take dense
+padded tensors + length masks instead (see ops/sequence.py).
+
+Arithmetic dunders are attached by paddle_tpu.ops at import time to avoid a
+circular import (the reference does the same via generated `core.ops` methods,
+pybind/op_function_generator.cc:488).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import state
+from .dtype import convert_dtype, dtype_name
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_slot", "name",
+                 "persistable", "trainable", "_hooks", "__weakref__", "__dict__")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            dt = convert_dtype(dtype)
+            arr = np.asarray(data)
+            if dt is None and arr.dtype == np.float64:
+                dt = state.get_default_dtype()
+            data = jnp.asarray(arr, dtype=dt)
+        elif dtype is not None and data.dtype != convert_dtype(dtype):
+            data = data.astype(convert_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._slot = 0
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._hooks = None
+
+    # ------------------------------------------------------------- properties
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        return state.get_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def T(self):
+        from ..ops import manipulation
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    def dim(self):
+        return self._data.ndim
+
+    def rank(self):
+        return self._data.ndim
+
+    def numel(self):
+        return self.size
+
+    # ------------------------------------------------------------- conversion
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        from ..ops import manipulation
+        return manipulation.cast(self, dtype)
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        # device moves are PJRT-managed; only dtype conversion is meaningful here
+        for a in args:
+            if isinstance(a, (str, np.dtype)) and str(a) not in ("cpu", "tpu", "gpu"):
+                try:
+                    return self.astype(a)
+                except ValueError:
+                    pass
+        if "dtype" in kwargs and kwargs["dtype"] is not None:
+            return self.astype(kwargs["dtype"])
+        return self
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # ------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from . import tape
+        tape.backward(self, grad_tensor=grad_tensor, retain_graph=retain_graph)
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from ..ops import math as _m
+        return _m.assign(self)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+        class _Remover:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Remover(self._hooks, hook)
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # ------------------------------------------------------------- in-place-ish
+    def set_value(self, value):
+        """In-place value replacement (optimizer updates, state loading)."""
+        if isinstance(value, Tensor):
+            value = value._data
+        value = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}")
+        self._data = value
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def fill_(self, v):
+        self._data = jnp.full_like(self._data, v)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def scale_(self, v):
+        self._data = self._data * v
+        return self
+
+    def add_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data + jnp.asarray(o, dtype=self._data.dtype)
+        return self
+
+    # ------------------------------------------------------------- indexing
+    def __getitem__(self, idx):
+        from ..ops import manipulation
+        return manipulation.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        if isinstance(idx, Tensor):
+            idx = idx._data
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = self._data.at[idx].set(value)
+
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ------------------------------------------------------------- misc
+    def __repr__(self):
+        grad_txt = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={dtype_name(self.dtype)}"
+                f"{grad_txt},\n       {np.asarray(self._data)!r})")
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, *a, **k):
+        return self._data.__dlpack__(*a, **k)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor analog."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+class Parameter(Tensor):
+    """Trainable leaf (ref python/paddle/fluid/framework.py:5416 ParamBase)."""
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()

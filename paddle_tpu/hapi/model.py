@@ -1,0 +1,4 @@
+class Model:  # placeholder
+    pass
+def summary(*a, **k):
+    raise NotImplementedError

@@ -1,0 +1,200 @@
+"""Tensor creation ops (ref python/paddle/tensor/creation.py + random.py API surface).
+
+All creation happens through jnp on the current Place's device; random ops draw from
+the functional Generator chain (framework/state.py) so eager runs are reproducible.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import state
+from ..framework.dtype import convert_dtype
+from ..framework.tensor import Tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = default or state.get_default_dtype()
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x._data, dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=convert_dtype(dtype)))
+
+
+empty_like = zeros_like
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer))
+                                for v in (start, end, step)) else None)
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    out = jnp.diag(a, k=offset)
+    if padding_value != 0 and a.ndim == 1:
+        n = a.shape[0] + abs(offset)
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        out = jnp.where(mask, out, padding_value)
+    return Tensor(out)
+
+
+def diagflat(x, offset=0, name=None):
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.diagflat(a, k=offset))
+
+
+def tril(x, diagonal=0, name=None):
+    from .dispatch import apply
+    return apply(lambda a: jnp.tril(a, diagonal), (x,), name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    from .dispatch import apply
+    return apply(lambda a: jnp.triu(a, diagonal), (x,), name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(o) for o in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+def assign(x, output=None):
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is not None:
+        output.set_value(a)
+        return output
+    from .dispatch import apply
+    if isinstance(x, Tensor):
+        return apply(lambda v: v + 0, (x,), name="assign")
+    return Tensor(a)
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+# ----------------------------------------------------------------- random ops
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(state.next_rng_key(), _shape(shape),
+                                     dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(state.next_rng_key(), _shape(shape),
+                                    dtype=_dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(state.next_rng_key(), shp) * s + m)
+    return Tensor(jax.random.normal(state.next_rng_key(), _shape(shape))
+                  * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else state.next_rng_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype) or convert_dtype("int64")
+    return Tensor(jax.random.randint(state.next_rng_key(), _shape(shape),
+                                     low, high, dtype=d))
+
+
+def randperm(n, dtype=None, name=None):
+    d = convert_dtype(dtype) or convert_dtype("int64")
+    return Tensor(jax.random.permutation(state.next_rng_key(), n).astype(d))
+
+
+def bernoulli(x, name=None):
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(state.next_rng_key(), a).astype(a.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(a, 1e-30))
+    if a.ndim == 1:
+        out = jax.random.categorical(state.next_rng_key(), logits,
+                                     shape=(num_samples,))
+    else:
+        out = jax.random.categorical(state.next_rng_key(), logits[:, None, :],
+                                     axis=-1, shape=(a.shape[0], num_samples))
+    return Tensor(out.astype(convert_dtype("int64")))
+
+
+def shuffle(x, name=None):
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.permutation(state.next_rng_key(), a, axis=0))

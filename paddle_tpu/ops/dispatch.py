@@ -1,0 +1,121 @@
+"""Eager op dispatch.
+
+TPU-native analog of the reference dygraph fast path
+(ref paddle/fluid/imperative/tracer.cc:132 Tracer::TraceOp +
+prepared_operator.cc kernel choice): an op is a pure-JAX function; dispatching it
+eagerly means calling it on jax.Arrays (XLA compiles + caches per shape/dtype —
+that cache replaces the reference's OpKernelType registry lookup). If any input
+requires grad, the forward runs under jax.vjp and a GradNode is recorded
+(ref tracer.cc:205 CreateGradOpNode).
+
+Under functional mode (jax.jit / jax.grad tracing of a whole train step), the tape
+is bypassed entirely and autodiff belongs to JAX — the performance path that turns
+a dygraph model into one fused XLA program (the dy2static analog; ref
+dygraph_to_static/program_translator.py:233).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import state
+from ..framework.tensor import Tensor
+from ..framework.tape import GradNode
+
+# op-name -> python impl; consumed by the static-graph lowering (static/program.py)
+OP_REGISTRY = {}
+
+
+def as_array(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return x
+
+
+def _requires_grad(t):
+    return isinstance(t, Tensor) and not t.stop_gradient
+
+
+def _wrap_outputs(outs, multi, requires_grad):
+    if multi:
+        res = tuple(Tensor(o, stop_gradient=not requires_grad) for o in outs)
+        return res
+    return Tensor(outs, stop_gradient=not requires_grad)
+
+
+def apply(fn, tensors, attrs=None, name=None, differentiable=True):
+    """Run op `fn(*arrays, **attrs)` on tensor inputs; record GradNode if needed."""
+    attrs = attrs or {}
+    arrays = tuple(as_array(t) for t in tensors)
+    if attrs:
+        f = functools.partial(fn, **attrs)
+    else:
+        f = fn
+
+    if state.is_functional_mode() or not state.is_grad_enabled():
+        outs = f(*arrays)
+        multi = isinstance(outs, (tuple, list))
+        # in functional mode JAX owns autodiff; stop_gradient only tracks lineage
+        rg = (state.is_functional_mode() and differentiable
+              and any(_requires_grad(t) for t in tensors))
+        return _wrap_outputs(tuple(outs) if multi else outs, multi, rg)
+
+    needs_grad = differentiable and any(_requires_grad(t) for t in tensors)
+    if not needs_grad:
+        outs = f(*arrays)
+        multi = isinstance(outs, (tuple, list))
+        return _wrap_outputs(tuple(outs) if multi else outs, multi, False)
+
+    outs, vjp_fn = jax.vjp(f, *arrays)
+    multi = isinstance(outs, (tuple, list))
+    outs_t = tuple(outs) if multi else (outs,)
+
+    # non-diff inputs recorded as None so backward skips them
+    node_inputs = [t if isinstance(t, Tensor) else None for t in tensors]
+    node = GradNode(
+        vjp=vjp_fn,
+        inputs=node_inputs,
+        n_outputs=len(outs_t),
+        out_shapes=tuple(o.shape for o in outs_t),
+        out_dtypes=tuple(o.dtype for o in outs_t),
+        name=name or getattr(fn, "__name__", "op"),
+    )
+    wrapped = _wrap_outputs(outs_t if multi else outs_t[0], multi, True)
+    ws = wrapped if multi else (wrapped,)
+    for i, w in enumerate(ws):
+        w._node = node
+        w._slot = i
+    return wrapped
+
+
+def def_op(name=None, differentiable=True, n_tensor_args=None):
+    """Register + wrap a pure-JAX impl as an eager op.
+
+    The wrapped function accepts Tensors/arrays for its first `n_tensor_args`
+    positional args (default: all positional) and keyword attrs after that.
+    """
+
+    def deco(fn):
+        opname = name or fn.__name__
+        OP_REGISTRY[opname] = fn
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if n_tensor_args is None:
+                tensors = args
+                attrs = kwargs
+            else:
+                tensors = args[:n_tensor_args]
+                attrs = dict(kwargs)
+                # extra positionals beyond tensor args are attrs by position — not
+                # supported; keep the call sites keyword-only for attrs
+                if len(args) > n_tensor_args:
+                    raise TypeError(
+                        f"{opname}: pass attrs as keywords (got extra positionals)")
+            return apply(fn, tensors, attrs, name=opname,
+                         differentiable=differentiable)
+
+        wrapper.raw = fn
+        return wrapper
+
+    return deco

@@ -1,0 +1,137 @@
+"""Linear algebra ops (ref operators/norm_op, cholesky_op, svd via Eigen;
+python/paddle/tensor/linalg.py surface). Backed by jnp.linalg (XLA native)."""
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype
+from ..framework.tensor import Tensor
+from .dispatch import apply
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+
+    def f(a):
+        if p == "fro" and (axis is None or isinstance(axis, tuple)):
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        pw = float(p)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), pw), axis=axis,
+                                 keepdims=keepdim), 1.0 / pw)
+    return apply(f, (x,), name="norm")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply(f, (x,), name="cholesky")
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, (x,), name="inverse")
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond), (x,), name="pinv")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, (x,), name="det")
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply(f, (x,), name="slogdet")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), (x,),
+                 name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.matrix_rank(a, tol=tol).astype(convert_dtype("int64")),
+                 (x,), differentiable=False, name="matrix_rank")
+
+
+def svd(x, full_matrices=False, name=None):
+    def f(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2)
+    return apply(f, (x,), name="svd")
+
+
+def qr(x, mode="reduced", name=None):
+    def f(a):
+        q, r = jnp.linalg.qr(a, mode=mode)
+        return q, r
+    return apply(f, (x,), name="qr")
+
+
+def eigh(x, UPLO="L", name=None):
+    def f(a):
+        w, v = jnp.linalg.eigh(a, UPLO=UPLO)
+        return w, v
+    return apply(f, (x,), name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), (x,), name="eigvalsh")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, (x, y), name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return apply(lambda a, b: jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular), (x, y), name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return apply(lambda b, l: jax.scipy.linalg.cho_solve((l, not upper), b),
+                 (x, y), name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol
+    return apply(f, (x, y), name="lstsq")
+
+
+def cross(x, y, axis=None, name=None):
+    ax = axis if axis is not None else -1
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), (x, y), name="cross")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h.astype(convert_dtype("int64"))
+    return apply(f, (input,), differentiable=False, name="histogram")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    from .dispatch import as_array
+    a = as_array(x)
+    w = as_array(weights) if weights is not None else None
+    n = max(int(a.max()) + 1 if a.size else 0, minlength)
+    out = jnp.zeros((n,), jnp.float32 if w is not None else convert_dtype("int64"))
+    out = out.at[a].add(w if w is not None else 1)
+    return Tensor(out)

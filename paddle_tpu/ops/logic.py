@@ -1,0 +1,75 @@
+"""Comparison / logical ops (ref operators/controlflow/compare_op.cc, logical_op.cc;
+python/paddle/tensor/logic.py surface). All non-differentiable."""
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .dispatch import apply, as_array
+
+
+def _cmp(fn, name):
+    def op(x, y, name=None):
+        return apply(fn, (x, y), differentiable=False, name=name)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(lambda a, b: a == b, "equal")
+not_equal = _cmp(lambda a, b: a != b, "not_equal")
+greater_than = _cmp(lambda a, b: a > b, "greater_than")
+greater_equal = _cmp(lambda a, b: a >= b, "greater_equal")
+less_than = _cmp(lambda a, b: a < b, "less_than")
+less_equal = _cmp(lambda a, b: a <= b, "less_equal")
+
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, (x,), differentiable=False, name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, (x,), differentiable=False, name="bitwise_not")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply(lambda a: jnp.all(a, axis=axis, keepdims=keepdim), (x,),
+                 differentiable=False, name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply(lambda a: jnp.any(a, axis=axis, keepdims=keepdim), (x,),
+                 differentiable=False, name="any")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan),
+                 (x, y), differentiable=False, name="isclose")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                 (x, y), differentiable=False, name="allclose")
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), (x, y),
+                 differentiable=False, name="equal_all")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(as_array(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,376 @@
+"""Shape/index manipulation ops (ref operators/reshape_op.cc, transpose_op.cc,
+concat/split/slice/gather/scatter, python/paddle/tensor/manipulation.py surface).
+
+Static-shape discipline: ops that would produce data-dependent shapes
+(masked_select, nonzero) fall back to host numpy — on TPU the supported pattern is
+`where` + masking, which these docstrings point to.
+"""
+import builtins
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.dtype import convert_dtype
+from ..framework.tensor import Tensor
+from .dispatch import apply, as_array
+
+
+def _axes(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def cast(x, dtype):
+    d = convert_dtype(dtype)
+    return apply(lambda a: a.astype(d), (x,), name="cast")
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+    return apply(lambda a: jnp.reshape(a, shape), (x,), name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data = out._data
+    x._node, x._slot = out._node, out._slot
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply(f, (x,), name="flatten")
+
+
+def transpose(x, perm, name=None):
+    perm = tuple(int(p) for p in perm)
+    return apply(lambda a: jnp.transpose(a, perm), (x,), name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), (x,),
+                 name="moveaxis")
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis1, axis2), (x,), name="swapaxes")
+
+
+def t(x, name=None):
+    return apply(lambda a: a.T, (x,), name="t")
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda *arrs: jnp.concatenate(arrs, axis=axis), tuple(tensors),
+                 name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), tuple(tensors),
+                 name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+
+    def f(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return list(apply(f, (x,), name="unstack"))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def f(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        secs = [int(s) for s in num_or_sections]
+        total = a.shape[axis]
+        known = builtins.sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else total - known for s in secs]
+        idxs = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(a, idxs, axis=axis))
+
+    return list(apply(f, (x,), name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis=axis)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes)
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply(f, (x,), name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    def f(a):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = a
+        for ax in builtins.sorted(int(v) for v in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply(f, (x,), name="unsqueeze")
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s) for s in shape]
+
+    def f(a):
+        tgt = list(shape)
+        pad = len(tgt) - a.ndim
+        src = (1,) * pad + a.shape
+        tgt = [src[i] if tgt[i] == -1 else tgt[i] for i in range(len(tgt))]
+        return jnp.broadcast_to(a.reshape(src), tuple(tgt))
+    return apply(f, (x,), name="expand")
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    reps = tuple(int(r) for r in repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), (x,), name="tile")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats.tolist() if isinstance(repeats, Tensor) else repeats
+    return apply(lambda a: jnp.repeat(a, r, axis=axis), (x,),
+                 name="repeat_interleave")
+
+
+def flip(x, axis, name=None):
+    return apply(lambda a: jnp.flip(a, axis=_axes(axis)), (x,), name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), (x,), name="roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,), name="rot90")
+
+
+# ----------------------------------------------------------------- index ops
+
+def getitem(x, idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        if isinstance(i, tuple):
+            return tuple(conv(j) for j in i)
+        return i
+    j_idx = conv(idx)
+    return apply(lambda a: a[j_idx], (x,), name="getitem")
+
+
+def slice(x, axes, starts, ends, name=None):
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            s = int(s.item()) if isinstance(s, Tensor) else int(s)
+            e = int(e.item()) if isinstance(e, Tensor) else int(e)
+            idx[int(ax)] = builtins.slice(s, e)
+        return a[tuple(idx)]
+    return apply(f, (x,), name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(ax)] = builtins.slice(int(s), int(e), int(st))
+        return a[tuple(idx)]
+    return apply(f, (x,), name="strided_slice")
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis)
+    return apply(f, (x, index), name="gather")
+
+
+def take_along_axis(x, indices, axis, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+                 (x, indices), name="take_along_axis")
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "assign":
+            return _put_along(a, i, v, axis, "set")
+        if reduce == "add":
+            return _put_along(a, i, v, axis, "add")
+        if reduce in ("mul", "multiply"):
+            return _put_along(a, i, v, axis, "mul")
+        raise ValueError(reduce)
+    return apply(f, (x, indices, values), name="put_along_axis")
+
+
+def _put_along(a, idx, v, axis, mode):
+    # build full index grids
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+    grids[axis] = idx
+    ref = a.at[tuple(grids)]
+    return getattr(ref, mode)(v)
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return a[comps]
+    return apply(f, (x, index), name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        # paddle scatter(overwrite=False) zeroes target rows then adds
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return apply(f, (x, index, updates), name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return a.at[comps].add(upd)
+    return apply(f, (x, index, updates), name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idx, upd = as_array(index), as_array(updates)
+    zeros = jnp.zeros(tuple(shape), upd.dtype)
+    comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+    return Tensor(zeros.at[comps].add(upd))
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda a, i: jnp.take(a, i, axis=axis), (x, index),
+                 name="index_select")
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=1),
+                 (x, index), name="index_sample")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), (condition, x, y),
+                 name="where")
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic shape -> host fallback (use `where(cond, a, b)` on-device instead)
+    a = np.asarray(as_array(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v[:, None])) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def masked_select(x, mask, name=None):
+    # dynamic shape -> host fallback
+    a = np.asarray(as_array(x))
+    m = np.asarray(as_array(mask)).astype(bool)
+    return Tensor(jnp.asarray(a[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.item() if isinstance(value, Tensor) else value
+    return apply(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                 (x, mask), name="masked_fill")
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    def f(a):
+        n = builtins.min(a.shape)
+        eye = jnp.eye(a.shape[0], a.shape[1], k=offset, dtype=bool) \
+            if a.ndim == 2 else None
+        return jnp.where(eye, jnp.asarray(value, a.dtype), a)
+    return apply(f, (x,), name="fill_diagonal")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """TP helper (ref operators/shard_index_op.cc, used by _parallel_embedding,
+    python/paddle/distributed/collective.py:566): map global ids to shard-local,
+    ignore_value for out-of-shard."""
+    def f(idx):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        in_shard = (idx >= lo) & (idx < hi)
+        return jnp.where(in_shard, idx - lo, ignore_value)
+    return apply(f, (input,), differentiable=False, name="shard_index")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda i: jax.nn.one_hot(i, num_classes, dtype=jnp.float32),
+                 (x,), differentiable=False, name="one_hot")
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), (x, y),
+                 name="tensordot")
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: lax.complex(a[..., 0], a[..., 1]), (x,),
+                 name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), (x,),
+                 name="as_real")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def f(a):
+        offs = offsets or [0] * a.ndim
+        shp = [s if s != -1 else a.shape[i] - offs[i]
+               for i, s in enumerate(shape)]
+        return lax.dynamic_slice(a, [int(o) for o in offs], [int(s) for s in shp])
+    return apply(f, (x,), name="crop")

@@ -1,0 +1,377 @@
+"""Math ops: elementwise, reductions, matmul (ref paddle/fluid/operators/elementwise/,
+reduce_ops/, matmul_v2_op; python/paddle/tensor/math.py API surface).
+
+Every op is a pure-JAX impl behind the eager dispatcher — XLA fuses chains of these
+into single kernels under jit, which replaces the reference's fusion passes
+(ref paddle/fluid/framework/ir/fusion_group/).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import state
+from ..framework.dtype import convert_dtype
+from ..framework.tensor import Tensor
+from .dispatch import apply, def_op, as_array
+
+
+def _binop(fn, name):
+    def op(x, y, name=None):
+        return apply(fn, (x, y), name=name)
+    op.__name__ = name
+    op.raw = fn
+    return op
+
+
+add = _binop(lambda x, y: x + y, "add")
+subtract = _binop(lambda x, y: x - y, "subtract")
+multiply = _binop(lambda x, y: x * y, "multiply")
+divide = _binop(lambda x, y: x / y, "divide")
+floor_divide = _binop(lambda x, y: jnp.floor_divide(x, y), "floor_divide")
+remainder = _binop(lambda x, y: jnp.remainder(x, y), "remainder")
+mod = remainder
+floor_mod = remainder
+maximum = _binop(jnp.maximum, "maximum")
+minimum = _binop(jnp.minimum, "minimum")
+fmax = _binop(jnp.fmax, "fmax")
+fmin = _binop(jnp.fmin, "fmin")
+atan2 = _binop(jnp.arctan2, "atan2")
+hypot = _binop(jnp.hypot, "hypot")
+
+
+def pow(x, y, name=None):
+    return apply(lambda a, b: jnp.power(a, b), (x, y), name="pow")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(a, s, b):
+        return a * s + b if bias_after_scale else (a + b) * s
+    out = apply(f, (x, scale, bias), name="scale")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def _unary(fn, name):
+    def op(x, name=None):
+        return apply(fn, (x,), name=name)
+    op.__name__ = name
+    op.raw = fn
+    return op
+
+
+abs = _unary(jnp.abs, "abs")
+neg = _unary(jnp.negative, "neg")
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(lax.rsqrt, "rsqrt")
+square = _unary(jnp.square, "square")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda a: a - jnp.trunc(a), "frac")
+sign = _unary(jnp.sign, "sign")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), (x,), name="clip")
+
+
+def isnan(x, name=None):
+    return apply(jnp.isnan, (x,), differentiable=False, name="isnan")
+
+
+def isinf(x, name=None):
+    return apply(jnp.isinf, (x,), differentiable=False, name="isinf")
+
+
+def isfinite(x, name=None):
+    return apply(jnp.isfinite, (x,), differentiable=False, name="isfinite")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                 (x,), name="nan_to_num")
+
+
+# ----------------------------------------------------------------- reductions
+
+def _reduce(fn, name, int_result=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(int(a) for a in axis)
+        elif axis is not None and not isinstance(axis, int):
+            axis = int(axis)
+
+        def f(a):
+            out = fn(a, axis=axis, keepdims=keepdim)
+            if dtype is not None:
+                out = out.astype(convert_dtype(dtype))
+            return out
+        return apply(f, (x,), differentiable=not int_result, name=name)
+    op.__name__ = name
+    return op
+
+
+sum = _reduce(jnp.sum, "sum")
+mean = _reduce(jnp.mean, "mean")
+prod = _reduce(jnp.prod, "prod")
+max = _reduce(jnp.max, "max")
+min = _reduce(jnp.min, "min")
+amax = _reduce(jnp.max, "amax")
+amin = _reduce(jnp.min, "amin")
+nansum = _reduce(jnp.nansum, "nansum")
+nanmean = _reduce(jnp.nanmean, "nanmean")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdim),
+                 (x,), name="logsumexp")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    dd = 1 if unbiased else 0
+    return apply(lambda a: jnp.std(a, axis=axis, ddof=dd, keepdims=keepdim),
+                 (x,), name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    dd = 1 if unbiased else 0
+    return apply(lambda a: jnp.var(a, axis=axis, ddof=dd, keepdims=keepdim),
+                 (x,), name="var")
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.median(a, axis=axis, keepdims=keepdim),
+                 (x,), name="median")
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmax(a, axis=axis, keepdims=keepdim)
+        return out.astype(convert_dtype(dtype))
+    return apply(f, (x,), differentiable=False, name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmin(a, axis=axis, keepdims=keepdim)
+        return out.astype(convert_dtype(dtype))
+    return apply(f, (x,), differentiable=False, name="argmin")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=convert_dtype(dtype))
+        return jnp.cumsum(a, axis=axis, dtype=convert_dtype(dtype))
+    return apply(f, (x,), name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(lambda a: jnp.cumprod(a, axis=dim, dtype=convert_dtype(dtype)),
+                 (x,), name="cumprod")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return apply(lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim)
+                 .astype(convert_dtype("int64")), (x,), differentiable=False,
+                 name="count_nonzero")
+
+
+# ----------------------------------------------------------------- linalg-ish
+
+def _matmul_precision():
+    p = state.get_flag("FLAGS_matmul_precision", "default")
+    return {"default": None, "high": "float32", "highest": "highest"}.get(p, None)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """MXU-path matmul. bf16 inputs hit the systolic array natively; the precision
+    flag maps to lax precision for f32 tests (ref math/blas.h MatMul)."""
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=_matmul_precision())
+
+    return apply(f, (x, y), name="matmul")
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply(f, (x, y), name="dot")
+
+
+def bmm(x, y, name=None):
+    return apply(lambda a, b: jnp.matmul(a, b, precision=_matmul_precision()),
+                 (x, y), name="bmm")
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, (x, y), name="inner")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), (x, y), name="outer")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 (input, x, y), name="addmm")
+
+
+def multiplex(inputs, index, name=None):
+    arrays = [as_array(t) for t in inputs]
+    idx = as_array(index).reshape(-1)
+    stacked = jnp.stack(arrays, axis=0)
+    out = stacked[idx, jnp.arange(idx.shape[0])]
+    return Tensor(out)
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, (x, y), name="kron")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                 (x,), name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                 (x,), name="diagonal")
+
+
+# ----------------------------------------------------------------- sort / topk
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(a):
+        ax = axis if axis is not None else -1
+        a_m = jnp.moveaxis(a, ax, -1)
+        vals, idxs = (lax.top_k(a_m, k) if largest
+                      else lax.top_k(-a_m, k))
+        if not largest:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, ax)
+        idxs = jnp.moveaxis(idxs, -1, ax)
+        return vals, idxs.astype(convert_dtype("int64"))
+
+    # indices are non-diff; run whole thing diff'able for values path
+    vals, idxs = apply(f, (x,), name="topk")
+    idxs.stop_gradient = True
+    return vals, idxs
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply(f, (x,), name="sort")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        out = jnp.argsort(a, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out.astype(convert_dtype("int64"))
+    return apply(f, (x,), differentiable=False, name="argsort")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output shape -> host fallback (XLA needs static shapes; the reference
+    # unique op is also CPU-bound for the same reason)
+    a = np.asarray(as_array(x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        idx = jnp.argsort(a, axis=axis)
+        vals = jnp.take(s, k - 1, axis=axis)
+        ind = jnp.take(idx, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            ind = jnp.expand_dims(ind, axis)
+        return vals, ind.astype(convert_dtype("int64"))
+    vals, idxs = apply(f, (x,), name="kthvalue")
+    idxs.stop_gradient = True
+    return vals, idxs
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(as_array(x))
+    from scipy import stats  # pragma: no cover - optional
+    raise NotImplementedError("mode: not yet implemented")
+
+
+def assign(x, output=None):
+    from .creation import assign as _assign
+    return _assign(x, output)
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    a = as_array(input)
+    l = as_array(label).reshape(-1)
+    topk_idx = jnp.argsort(a, axis=-1)[:, ::-1][:, :k]
+    hit = jnp.any(topk_idx == l[:, None], axis=-1)
+    return Tensor(jnp.mean(hit.astype(jnp.float32)))

@@ -1,0 +1,3 @@
+"""placeholder — filled in during round 1 build."""
+def _enable_static_mode():
+    raise NotImplementedError

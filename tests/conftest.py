@@ -1,0 +1,24 @@
+"""Test config: force an 8-device virtual CPU mesh so SPMD/collective tests run
+without TPU hardware (SURVEY.md §4 implication (b): the reference simulates
+clusters with multiprocess-localhost; the XLA analog is
+--xla_force_host_platform_device_count)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax
+
+# The axon sitecustomize pre-registers the TPU platform with JAX_PLATFORMS=axon
+# baked into config at import time; this update must come before any backend use.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as pt
+    pt.seed(1234)
+    yield

@@ -1,0 +1,145 @@
+"""Eager autograd engine tests — numeric-vs-analytic checks in the spirit of the
+reference OpTest.check_grad (ref python/paddle/fluid/tests/unittests/op_test.py:1335)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences, like op_test.py get_numeric_gradient."""
+    x = x.astype(np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op, x_np, analytic_fn=None, atol=1e-3):
+    t = pt.to_tensor(x_np.astype("float32"), stop_gradient=False)
+    out = op(t)
+    out.sum().backward()
+    num = numeric_grad(lambda a: op(pt.to_tensor(a.astype("float32"))).sum().item(),
+                       x_np)
+    np.testing.assert_allclose(t.grad.numpy(), num, atol=atol, rtol=1e-2)
+
+
+class TestBackwardBasics:
+    def test_linear_chain(self):
+        x = pt.to_tensor(np.random.randn(4, 3).astype("f4"), stop_gradient=False)
+        w = pt.to_tensor(np.random.randn(3, 5).astype("f4"), stop_gradient=False)
+        b = pt.zeros([5]); b.stop_gradient = False
+        y = pt.matmul(x, w) + b
+        loss = (y * y).mean()
+        loss.backward()
+        yn = x.numpy() @ w.numpy() + b.numpy()
+        gy = 2 * yn / yn.size
+        np.testing.assert_allclose(x.grad.numpy(), gy @ w.numpy().T,
+                                   atol=2e-2, rtol=2e-2)
+        np.testing.assert_allclose(w.grad.numpy(), x.numpy().T @ gy,
+                                   atol=2e-2, rtol=2e-2)
+        np.testing.assert_allclose(b.grad.numpy(), gy.sum(0), atol=1e-4)
+
+    def test_grad_accumulation(self):
+        x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_diamond(self):
+        a = pt.to_tensor([2.0], stop_gradient=False)
+        (a * a + a * 3.0).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [7.0])
+
+    def test_stop_gradient_blocks(self):
+        a = pt.to_tensor([2.0], stop_gradient=False)
+        b = pt.to_tensor([3.0], stop_gradient=True)
+        (a * b).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), [3.0])
+        assert b.grad is None
+
+    def test_detach(self):
+        a = pt.to_tensor([2.0], stop_gradient=False)
+        d = (a * 2).detach()
+        assert d.stop_gradient
+        (a * d).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [4.0])
+
+    def test_no_grad_context(self):
+        a = pt.to_tensor([2.0], stop_gradient=False)
+        with pt.no_grad():
+            y = a * 5
+        assert y.stop_gradient and y._node is None
+
+    def test_backward_twice_without_retain_raises_or_noop(self):
+        a = pt.to_tensor([2.0], stop_gradient=False)
+        y = a * a
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(a.grad.numpy(), [8.0])
+
+    def test_multi_output_op(self):
+        t = pt.to_tensor([[1.0, 5.0, 3.0]], stop_gradient=False)
+        vals, idxs = pt.topk(t, k=2)
+        vals.sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(), [[0.0, 1.0, 1.0]])
+        assert idxs.stop_gradient
+
+    def test_paddle_grad_api(self):
+        a = pt.to_tensor([3.0], stop_gradient=False)
+        g, = pt.grad(a * a, a)
+        np.testing.assert_allclose(g.numpy(), [6.0])
+        assert a.grad is None  # paddle.grad must not pollute .grad
+
+    def test_non_scalar_backward_needs_grad_tensor(self):
+        a = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            (a * 2).backward()
+        (a * 2).backward(pt.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(a.grad.numpy(), [2.0, 2.0])
+
+
+class TestNumericGrad:
+    def test_tanh(self):
+        check_grad(lambda t: pt.tanh(t), np.random.randn(3, 4))
+
+    def test_sigmoid(self):
+        check_grad(lambda t: pt.sigmoid(t), np.random.randn(3, 4))
+
+    def test_exp(self):
+        check_grad(lambda t: pt.exp(t), np.random.randn(3, 4) * 0.5)
+
+    def test_sqrt(self):
+        check_grad(lambda t: pt.sqrt(t), np.random.rand(3, 4) + 0.5)
+
+    def test_reduce_mean_axis(self):
+        check_grad(lambda t: pt.mean(t, axis=1).sum(), np.random.randn(3, 4))
+
+    def test_softmax_like_composite(self):
+        def f(t):
+            e = pt.exp(t - pt.max(t, axis=-1, keepdim=True))
+            return (e / pt.sum(e, axis=-1, keepdim=True)).max(axis=-1)
+        check_grad(lambda t: f(t).sum(), np.random.randn(2, 5))
+
+    def test_getitem_grad(self):
+        t = pt.to_tensor(np.arange(12, dtype="f4").reshape(3, 4),
+                         stop_gradient=False)
+        t[1:, ::2].sum().backward()
+        expect = np.zeros((3, 4), "f4"); expect[1:, ::2] = 1
+        np.testing.assert_allclose(t.grad.numpy(), expect)
+
+    def test_concat_split_grad(self):
+        a = pt.to_tensor(np.ones((2, 2), "f4"), stop_gradient=False)
+        b = pt.to_tensor(np.ones((2, 2), "f4") * 2, stop_gradient=False)
+        c = pt.concat([a, b], axis=0)
+        p1, p2 = pt.split(c, 2, axis=0)
+        (p1 * 3 + p2 * 5).sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), np.full((2, 2), 3.0))
+        np.testing.assert_allclose(b.grad.numpy(), np.full((2, 2), 5.0))

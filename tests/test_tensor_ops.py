@@ -1,0 +1,155 @@
+"""Tensor surface + op numerics vs numpy (OpTest.check_output analog,
+ref unittests/op_test.py:1033)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestTensorBasics:
+    def test_to_tensor_dtypes(self):
+        assert pt.to_tensor([1, 2]).dtype == pt.int64 or \
+               pt.to_tensor([1, 2]).dtype == pt.int32
+        assert pt.to_tensor([1.0]).dtype == pt.float32
+        assert pt.to_tensor(np.float64(1.0)).dtype == pt.float32
+        assert pt.to_tensor([1], dtype="float16").dtype == pt.float16
+        assert pt.to_tensor([1], dtype="bfloat16").dtype == pt.bfloat16
+
+    def test_shape_props(self):
+        t = pt.zeros([2, 3, 4])
+        assert t.shape == [2, 3, 4] and t.ndim == 3 and t.size == 24
+        assert len(t) == 2
+
+    def test_item_numpy(self):
+        t = pt.full([1], 3.5)
+        assert t.item() == 3.5
+        assert np.asarray(pt.ones([2])).tolist() == [1.0, 1.0]
+
+    def test_astype(self):
+        t = pt.ones([2]).astype("int32")
+        assert t.dtype == pt.int32
+
+    def test_set_value(self):
+        t = pt.zeros([2, 2])
+        t.set_value(np.ones((2, 2), "f4"))
+        np.testing.assert_allclose(t.numpy(), 1.0)
+        with pytest.raises(ValueError):
+            t.set_value(np.ones((3, 3), "f4"))
+
+    def test_setitem(self):
+        t = pt.zeros([3])
+        t[1] = 5.0
+        np.testing.assert_allclose(t.numpy(), [0, 5, 0])
+
+    def test_operators(self):
+        a = pt.to_tensor([4.0, 9.0])
+        np.testing.assert_allclose((a + 1).numpy(), [5, 10])
+        np.testing.assert_allclose((1 - a).numpy(), [-3, -8])
+        np.testing.assert_allclose((a * a).numpy(), [16, 81])
+        np.testing.assert_allclose((a / 2).numpy(), [2, 4.5])
+        np.testing.assert_allclose((a ** 0.5).numpy(), [2, 3])
+        np.testing.assert_allclose((-a).numpy(), [-4, -9])
+        np.testing.assert_allclose((a > 5).numpy(), [False, True])
+        assert (a == a).all().item()
+
+    def test_matmul_operator(self):
+        a = pt.ones([2, 3]); b = pt.ones([3, 4])
+        assert (a @ b).shape == [2, 4]
+
+
+class TestOps:
+    def test_creation(self):
+        np.testing.assert_allclose(pt.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(pt.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        assert pt.eye(3).numpy().trace() == 3
+        np.testing.assert_allclose(pt.full([2], 7).numpy(), [7, 7])
+        assert pt.rand([3, 3]).shape == [3, 3]
+        assert pt.randn([3, 3]).dtype == pt.float32
+        assert pt.randint(0, 10, [4]).numpy().max() < 10
+        assert sorted(pt.randperm(5).tolist()) == [0, 1, 2, 3, 4]
+
+    def test_reductions(self):
+        x = np.random.randn(3, 4).astype("f4")
+        t = pt.to_tensor(x)
+        np.testing.assert_allclose(pt.sum(t).item(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(pt.mean(t, axis=0).numpy(), x.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(pt.max(t, axis=1).numpy(), x.max(1))
+        np.testing.assert_allclose(pt.std(t).item(), x.std(ddof=1), rtol=1e-4)
+        assert pt.argmax(t).item() == x.argmax()
+        np.testing.assert_allclose(pt.logsumexp(t).item(),
+                                   np.log(np.exp(x).sum()), rtol=1e-5)
+
+    def test_manipulation(self):
+        x = np.arange(24).reshape(2, 3, 4).astype("f4")
+        t = pt.to_tensor(x)
+        assert pt.reshape(t, [4, 6]).shape == [4, 6]
+        assert pt.reshape(t, [-1]).shape == [24]
+        assert pt.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+        assert pt.flatten(t, 1).shape == [2, 12]
+        assert pt.squeeze(pt.ones([1, 3, 1]), axis=0).shape == [3, 1]
+        assert pt.unsqueeze(t, [0, 2]).shape == [1, 2, 1, 3, 4]
+        assert pt.concat([t, t], axis=1).shape == [2, 6, 4]
+        assert pt.stack([t, t]).shape == [2, 2, 3, 4]
+        parts = pt.split(t, [1, 2], axis=1)
+        assert parts[0].shape == [2, 1, 4] and parts[1].shape == [2, 2, 4]
+        assert pt.tile(pt.ones([2]), [3]).shape == [6]
+        assert pt.expand(pt.ones([1, 3]), [5, 3]).shape == [5, 3]
+        np.testing.assert_allclose(pt.flip(pt.arange(3), 0).numpy(), [2, 1, 0])
+
+    def test_gather_scatter(self):
+        t = pt.to_tensor(np.arange(10, dtype="f4"))
+        np.testing.assert_allclose(pt.gather(t, pt.to_tensor([1, 3])).numpy(),
+                                   [1, 3])
+        s = pt.scatter(pt.zeros([5]), pt.to_tensor([1, 3]),
+                       pt.to_tensor([7.0, 8.0]))
+        np.testing.assert_allclose(s.numpy(), [0, 7, 0, 8, 0])
+        g = pt.gather_nd(pt.to_tensor(np.arange(6).reshape(2, 3)),
+                         pt.to_tensor([[0, 1], [1, 2]]))
+        np.testing.assert_allclose(g.numpy(), [1, 5])
+
+    def test_where_masking(self):
+        c = pt.to_tensor([True, False, True])
+        np.testing.assert_allclose(
+            pt.where(c, pt.ones([3]), pt.zeros([3])).numpy(), [1, 0, 1])
+        np.testing.assert_allclose(
+            pt.masked_fill(pt.zeros([3]), c, 9.0).numpy(), [9, 0, 9])
+
+    def test_one_hot_shard_index(self):
+        oh = pt.one_hot(pt.to_tensor([0, 2]), 3)
+        np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
+        si = pt.shard_index(pt.to_tensor([0, 5, 9]), index_num=10, nshards=2,
+                            shard_id=1)
+        np.testing.assert_allclose(si.numpy(), [-1, 0, 4])
+
+    def test_linalg(self):
+        a = np.random.randn(4, 4).astype("f4")
+        a = a @ a.T + 4 * np.eye(4, dtype="f4")
+        t = pt.to_tensor(a)
+        np.testing.assert_allclose(pt.linalg.inv(t).numpy(), np.linalg.inv(a),
+                                   atol=1e-3)
+        np.testing.assert_allclose(pt.linalg.norm(t).item(),
+                                   np.linalg.norm(a), rtol=1e-4)
+        l = pt.linalg.cholesky(t)
+        np.testing.assert_allclose((l @ l.T).numpy(), a, atol=1e-3)
+
+    def test_sort_topk(self):
+        x = np.array([[3.0, 1.0, 2.0]], "f4")
+        v, i = pt.topk(pt.to_tensor(x), k=2)
+        np.testing.assert_allclose(v.numpy(), [[3, 2]])
+        np.testing.assert_allclose(i.numpy(), [[0, 2]])
+        np.testing.assert_allclose(pt.sort(pt.to_tensor(x), axis=-1).numpy(),
+                                   [[1, 2, 3]])
+
+    def test_cumsum_clip(self):
+        np.testing.assert_allclose(pt.cumsum(pt.arange(4, dtype="float32")).numpy(),
+                                   [0, 1, 3, 6])
+        np.testing.assert_allclose(
+            pt.clip(pt.to_tensor([-1.0, 0.5, 2.0]), 0.0, 1.0).numpy(),
+            [0, 0.5, 1])
+
+    def test_bf16_matmul(self):
+        a = pt.ones([8, 8], dtype="bfloat16")
+        out = a @ a
+        assert out.dtype == pt.bfloat16
+        np.testing.assert_allclose(out.astype("float32").numpy(), 8.0)
