@@ -1,1 +1,155 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle_tpu.amp (ref python/paddle/amp: auto_cast + GradScaler;
+fluid/contrib/mixed_precision for the static lists; kernels
+operators/amp/check_finite_and_unscale_op.cc, update_loss_scaling_op.cc).
+
+TPU-first: the low-precision dtype is bfloat16 (MXU native). bf16 shares
+float32's exponent range, so dynamic loss scaling is unnecessary — GradScaler
+keeps the reference API/state machine but defaults `use_loss_scaling=False`
+when dtype is bf16 (enable=True + fp16 restores the full behavior).
+"""
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework import state
+from ..framework.tensor import Tensor
+from ..ops.dispatch import AMP_WHITE_LIST, AMP_BLACK_LIST
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """ref paddle/amp/auto_cast.py."""
+    if not enable:
+        yield
+        return
+    low = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+    saved_w = saved_b = None
+    if custom_white_list:
+        saved_w = set(AMP_WHITE_LIST)
+        AMP_WHITE_LIST.update(custom_white_list)
+    if custom_black_list:
+        saved_b = set(AMP_BLACK_LIST)
+        AMP_BLACK_LIST.update(custom_black_list)
+    try:
+        with state.amp_guard_ctx({"level": level, "dtype": low}):
+            yield
+    finally:
+        if saved_w is not None:
+            AMP_WHITE_LIST.clear()
+            AMP_WHITE_LIST.update(saved_w)
+        if saved_b is not None:
+            AMP_BLACK_LIST.clear()
+            AMP_BLACK_LIST.update(saved_b)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """ref paddle/amp O2 decorate: cast model params to the low dtype.
+    Optimizer moments stay fp32 (master weights) — see optimizer._init_state."""
+    low = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+    models_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for m in models_list:
+            m.to(dtype=low)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """ref paddle/amp/grad_scaler.py:20 + fluid AmpScaler loss_scaler.py:27.
+    Implements the check_finite_and_unscale + update_loss_scaling state
+    machine (ref operators/amp/*) as pure jnp."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling and enable
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        found_inf = False
+        inv = 1.0 / self._scale
+        for p in optimizer._parameters:
+            if p.grad is None:
+                continue
+            g = p.grad._data
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            if not finite:
+                found_inf = True
+            p.grad._data = g * inv
+        self._found_inf = found_inf
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd["good_steps"]
+        self._bad_steps = sd["bad_steps"]
+
+
+AmpScaler = GradScaler

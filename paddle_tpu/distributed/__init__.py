@@ -1,1 +1,16 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle_tpu.distributed (ref python/paddle/distributed).
+
+TPU-native mapping (SURVEY.md §5): the reference's ring_id->NCCL-comm registry
+becomes a named-axis Mesh registry; c_* collective ops become lax collectives
+resolved inside shard_map/pjit; rendezvous is jax.distributed's coordination
+service instead of a TCP ncclUniqueId bootstrap.
+"""
+from .env import (init_parallel_env, get_rank, get_world_size, ParallelEnv,
+                  is_initialized)
+from .mesh import (MeshContext, get_mesh, set_mesh, mesh_axes, default_mesh)
+from .collective import (all_reduce, all_gather, broadcast, reduce, scatter,
+                         barrier, send, recv, split, ReduceOp, new_group,
+                         wait, reduce_scatter, alltoall)
+from .parallel import DataParallel
+from . import fleet
+from .spawn import spawn

@@ -1,0 +1,216 @@
+"""Collective API (ref python/paddle/distributed/collective.py:101-457 and the
+c_* kernels in paddle/fluid/operators/collective/).
+
+Semantics mapping (SURVEY.md §5 "Distributed communication backend"):
+  c_allreduce_{sum,max,min,prod} -> lax.psum/pmax/pmin (inside SPMD traces)
+  c_allgather                    -> lax.all_gather
+  c_reducescatter                -> lax.psum_scatter
+  c_broadcast                    -> broadcast from src via lax.all_gather pick
+  send_v2/recv_v2 (p2p)          -> lax.ppermute (pipeline edges)
+  c_sync_calc/comm_stream        -> no-ops (XLA async collectives are
+                                    scheduler-ordered; wait() kept for API)
+
+Two execution regimes:
+  * traced (inside shard_map/pjit over a Mesh axis): lax collectives — the
+    performance path, compiled onto ICI.
+  * eager single-controller: arrays are process-local and replicated, so
+    reductions over the "world" are identity; multi-process eager sync uses
+    jax process-level primitives only where needed (barrier).
+These match the reference's dual dygraph/static collective paths.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import as_array
+from . import mesh as mesh_mod
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _in_trace(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis(group):
+    return mesh_mod.get_group(group).axis_name
+
+
+def _apply_inplace(x, arr):
+    if isinstance(x, Tensor):
+        x._data = arr
+        return x
+    return Tensor(arr)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=True):
+    a = as_array(tensor)
+    if _in_trace(a):
+        ax = _axis(group)
+        if op == ReduceOp.SUM:
+            out = lax.psum(a, ax)
+        elif op == ReduceOp.MAX:
+            out = lax.pmax(a, ax)
+        elif op == ReduceOp.MIN:
+            out = lax.pmin(a, ax)
+        elif op == ReduceOp.AVG:
+            out = lax.pmean(a, ax)
+        else:
+            out = jnp.exp(lax.psum(jnp.log(a), ax))
+        return _apply_inplace(tensor, out)
+    # eager single-controller: the full world is visible locally -> identity
+    return _apply_inplace(tensor, a)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    a = as_array(tensor)
+    if _in_trace(a):
+        ax = _axis(group)
+        gathered = lax.all_gather(a, ax)  # [axis_size, ...]
+        n = gathered.shape[0]
+        outs = [Tensor(gathered[i]) for i in range(n)]
+    else:
+        outs = [Tensor(a)]
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend(outs)
+    return outs
+
+
+def reduce_scatter(tensor, tensor_or_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    src = tensor_or_list
+    if isinstance(src, (list, tuple)):
+        a = jnp.concatenate([as_array(t) for t in src], axis=0)
+    else:
+        a = as_array(src)
+    if _in_trace(a):
+        ax = _axis(group)
+        out = lax.psum_scatter(a, ax, tiled=True)
+    else:
+        out = a
+    return _apply_inplace(tensor, out)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    a = as_array(tensor)
+    if _in_trace(a):
+        ax = _axis(group)
+        gathered = lax.all_gather(a, ax)
+        return _apply_inplace(tensor, gathered[src])
+    return _apply_inplace(tensor, a)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # SPMD: reduce == all_reduce (every shard holds the result; the dst
+    # distinction only matters for MPMD runtimes)
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    a = as_array(tensor)
+    if _in_trace(a) and tensor_list is not None:
+        ax = _axis(group)
+        stacked = jnp.stack([as_array(t) for t in tensor_list])
+        idx = lax.axis_index(ax)
+        return _apply_inplace(tensor, stacked[idx])
+    if tensor_list:
+        return _apply_inplace(tensor, as_array(tensor_list[src]))
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    arrays = [as_array(t) for t in in_tensor_list]
+    if _in_trace(arrays[0]):
+        ax = _axis(group)
+        stacked = jnp.stack(arrays)  # [n_peers, ...]
+        out = lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0,
+                             tiled=False)
+        outs = [Tensor(out[i]) for i in range(out.shape[0])]
+    else:
+        outs = [Tensor(a) for a in arrays]
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        out_tensor_list.extend(outs)
+    return outs
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """p2p over a ring edge -> ppermute in traced mode (ref send_v2_op.cc)."""
+    a = as_array(tensor)
+    if _in_trace(a):
+        ax = _axis(group)
+        n = mesh_mod.get_group(group).nranks
+        perm = [(i, dst if n == 1 else (i + (dst or 1)) % n) for i in range(n)]
+        return Tensor(lax.ppermute(a, ax, perm))
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def barrier(group=None):
+    try:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    except (RuntimeError, ValueError):
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """c_sync_*_stream analog: XLA orders async collectives itself; blocking
+    on the value is the only observable semantics."""
+    a = as_array(tensor)
+    if not _in_trace(a):
+        a.block_until_ready()
+    return tensor
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Bind a new group to the innermost mesh axis by default."""
+    axes = mesh_mod.mesh_axes() or (mesh_mod.DP_AXIS,)
+    return mesh_mod.register_group(axes[-1], ranks)
+
+
+def get_group(gid=0):
+    return mesh_mod.get_group(gid)
+
+
+# --------------------------------------------------------- TP split helpers
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """ref collective.py:492 paddle.distributed.split — Megatron-style parallel
+    linear/embedding. The TPU-native implementation lives in
+    distributed/parallel_layers.py (sharding annotations instead of manual
+    allreduce); this functional form keeps reference-API compat."""
+    from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation!r}")

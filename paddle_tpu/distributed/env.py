@@ -1,0 +1,94 @@
+"""Distributed environment (ref python/paddle/distributed/parallel.py:57
+init_parallel_env + ParallelEnv).
+
+On TPU pods, process-level topology comes from jax.distributed (coordination
+service over DCN); within a host, all local chips belong to this process, so
+"rank" means process index and collective work is expressed over the Mesh
+rather than per-chip ranks (SPMD, not MPMD).
+"""
+import os
+
+import jax
+
+
+_initialized = False
+
+
+class ParallelEnv:
+    """ref fluid/dygraph/parallel.py ParallelEnv — env-var cluster spec."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                        os.environ.get("RANK", 0)))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                              os.environ.get("WORLD_SIZE", 1)))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self._device_id = int(os.environ.get("FLAGS_selected_tpus",
+                                             os.environ.get(
+                                                 "FLAGS_selected_gpus", "0")
+                                             ).split(",")[0])
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    local_rank = rank
+    nranks = world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+def init_parallel_env():
+    """Multi-host bootstrap. Under a single process (the common TPU case —
+    all local chips visible), this is a no-op beyond mesh setup."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    env = ParallelEnv()
+    if env.world_size > 1 and env.trainer_endpoints:
+        coordinator = env.trainer_endpoints[0]
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=env.world_size,
+                process_id=env.rank)
+        except (RuntimeError, ValueError):
+            pass  # already initialized or single-process testing
+    from .mesh import default_mesh
+    default_mesh()  # materialise the data-parallel mesh over all devices
+    _initialized = True
+    return env
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank():
+    try:
+        return jax.process_index()
+    except (RuntimeError, ValueError):
+        return ParallelEnv().rank
+
+
+def get_world_size():
+    try:
+        return jax.process_count()
+    except (RuntimeError, ValueError):
+        return ParallelEnv().world_size

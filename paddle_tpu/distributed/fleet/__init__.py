@@ -1,0 +1,10 @@
+"""Fleet facade (ref python/paddle/distributed/fleet/base/fleet_base.py:63).
+Full strategy-compiler stack lands with the hybrid-parallel milestone; the
+facade keeps the reference call contract: init / distributed_optimizer /
+distributed_model / minimize."""
+from .base import (init, is_first_worker, worker_index, worker_num,
+                   is_worker, worker_endpoints, server_num, server_index,
+                   server_endpoints, is_server, barrier_worker,
+                   distributed_optimizer, distributed_model,
+                   DistributedStrategy, UserDefinedRoleMaker,
+                   PaddleCloudRoleMaker, UtilBase, fleet)

@@ -1,0 +1,285 @@
+"""Fleet base: role makers, DistributedStrategy, facade
+(ref fleet/base/fleet_base.py:63,130,598,643; base/role_maker.py:528;
+base/distributed_strategy.py + framework/distributed_strategy.proto:122).
+
+DistributedStrategy keeps the reference's strategy-bag surface (amp, recompute,
+sharding, pipeline, tensor_parallel...); the strategy compiler maps enabled
+features onto mesh axes + jax transforms instead of program rewrites
+(see meta_optimizers.py).
+"""
+import os
+
+from ..env import ParallelEnv, get_rank, get_world_size
+from .. import mesh as mesh_mod
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_endpoints = []
+        self._server_endpoints = []
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return max(len(self._worker_endpoints), 1)
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """ref role_maker.py:875 — explicit cluster spec for tests."""
+
+    def __init__(self, is_collective=False, current_id=0, role=Role.WORKER,
+                 worker_num=0, worker_endpoints=None, server_endpoints=None,
+                 **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._current_id = current_id
+        self._role = role
+        self._worker_endpoints = worker_endpoints or \
+            [f"127.0.0.1:{36000 + i}" for i in range(worker_num)]
+        self._server_endpoints = server_endpoints or []
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """ref role_maker.py:861 — parse PADDLE_* env."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        env = ParallelEnv()
+        self._current_id = env.rank
+        self._worker_endpoints = env.trainer_endpoints or ["127.0.0.1:36000"]
+        training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        if training_role == "PSERVER":
+            self._role = Role.SERVER
+            eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = eps.split(",") if eps else []
+            self._current_id = int(os.environ.get("PADDLE_PSERVER_ID", 0))
+        else:
+            self._role = Role.WORKER
+            eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = eps.split(",") if eps else []
+
+
+class DistributedStrategy:
+    """ref distributed_strategy.proto:122 — feature-flag bag + config dicts."""
+
+    def __init__(self):
+        # collective features
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_bf16": False}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.sharding = False
+        self.sharding_configs = {"fuse_broadcast_MB": 32,
+                                 "sharding_degree": 1}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1, "sp_degree": 1}
+        self.lamb = False
+        self.lamb_configs = {}
+        self.lars = False
+        self.lars_configs = {}
+        self.dgc = False
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        self.adaptive_localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        # async PS
+        self.a_sync = False
+        self.a_sync_configs = {"k_steps": 0, "launch_barrier": True}
+        # misc mirrors
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.last_comm_group_size_MB = 1
+        self.auto = False
+        self.elastic = False
+        self.build_strategy = None
+        self.execution_strategy = None
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items()
+              if isinstance(v, bool) and v]
+        return f"DistributedStrategy(enabled={on})"
+
+
+class UtilBase:
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        return input
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier as _barrier
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        return [input]
+
+    def get_file_shard(self, files):
+        idx = worker_index()
+        n = worker_num()
+        return [f for i, f in enumerate(files) if i % n == idx]
+
+
+class _FleetState:
+    def __init__(self):
+        self.role_maker = None
+        self.strategy = None
+        self.initialized = False
+        self.util = UtilBase()
+
+
+_fleet = _FleetState()
+
+
+def init(role_maker=None, is_collective=False, strategy=None):
+    """ref fleet_base.py:130."""
+    _fleet.role_maker = role_maker or PaddleCloudRoleMaker(
+        is_collective=is_collective)
+    _fleet.strategy = strategy or DistributedStrategy()
+    _fleet.initialized = True
+    # build the mesh implied by hybrid_configs
+    hc = _fleet.strategy.hybrid_configs
+    import jax
+    ndev = len(jax.devices())
+    axes = {}
+    for key, name in (("dp_degree", mesh_mod.DP_AXIS),
+                      ("pp_degree", mesh_mod.PP_AXIS),
+                      ("sharding_degree", "sharding"),
+                      ("mp_degree", mesh_mod.MP_AXIS),
+                      ("sp_degree", mesh_mod.SP_AXIS)):
+        d = int(hc.get(key, 1) or 1)
+        if d > 1:
+            axes[name] = d
+    if axes:
+        total = 1
+        for v in axes.values():
+            total *= v
+        if total <= ndev:
+            mesh_mod.make_mesh(axes)
+    else:
+        mesh_mod.default_mesh()
+    return _fleet
+
+
+def is_first_worker():
+    return _fleet.role_maker is None or _fleet.role_maker.is_first_worker()
+
+
+def worker_index():
+    return _fleet.role_maker.worker_index() if _fleet.role_maker else get_rank()
+
+
+def worker_num():
+    return _fleet.role_maker.worker_num() if _fleet.role_maker \
+        else get_world_size()
+
+
+def is_worker():
+    return _fleet.role_maker is None or _fleet.role_maker.is_worker()
+
+
+def worker_endpoints(to_string=False):
+    eps = _fleet.role_maker.get_trainer_endpoints() if _fleet.role_maker else []
+    return ",".join(eps) if to_string else eps
+
+
+def server_num():
+    return _fleet.role_maker.server_num() if _fleet.role_maker else 0
+
+
+def server_index():
+    return _fleet.role_maker.server_index() if _fleet.role_maker else 0
+
+
+def server_endpoints(to_string=False):
+    eps = _fleet.role_maker.get_pserver_endpoints() if _fleet.role_maker else []
+    return ",".join(eps) if to_string else eps
+
+
+def is_server():
+    return _fleet.role_maker is not None and _fleet.role_maker.is_server()
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()
+
+
+def distributed_model(model):
+    """ref fleet_base.py:643 — wrap for data parallelism."""
+    from ..parallel import DataParallel
+    if isinstance(model, DataParallel):
+        return model
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """ref fleet_base.py:598 — returns a meta-optimizer chain honoring the
+    strategy (meta_optimizers.py)."""
+    from .meta_optimizers import build_distributed_optimizer
+    strat = strategy or _fleet.strategy or DistributedStrategy()
+    _fleet.strategy = strat
+    return build_distributed_optimizer(optimizer, strat)
+
+
+class _FleetModule:
+    """Attribute-style facade: fleet.init(...), fleet.worker_num()..."""
+    init = staticmethod(init)
+    is_first_worker = staticmethod(is_first_worker)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    is_worker = staticmethod(is_worker)
+    worker_endpoints = staticmethod(worker_endpoints)
+    server_num = staticmethod(server_num)
+    server_index = staticmethod(server_index)
+    server_endpoints = staticmethod(server_endpoints)
+    is_server = staticmethod(is_server)
+    barrier_worker = staticmethod(barrier_worker)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    distributed_model = staticmethod(distributed_model)
+
+    @property
+    def util(self):
+        return _fleet.util
+
+
+fleet = _FleetModule()

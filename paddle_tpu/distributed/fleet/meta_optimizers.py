@@ -1,0 +1,208 @@
+"""Meta-optimizers (ref fleet/meta_optimizers/*: AMP, Recompute, GradientMerge,
+Lamb, Lars, LocalSGD, Sharding, Pipeline, GraphExecution chained by
+StrategyCompiler base/strategy_compiler.py:89).
+
+TPU-native: instead of rewriting ProgramDesc, each meta-optimizer wraps the
+inner Optimizer and/or flags transforms applied at TrainStep compile time
+(bf16 autocast, jax.remat segments, gradient accumulation, GSPMD weight-update
+sharding). The chain is composed here, mirroring maximum_path_len_algo's
+compatibility ordering.
+"""
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer, Lamb, Lars
+
+
+class MetaOptimizerBase(Optimizer):
+    def __init__(self, inner_opt):
+        self.inner_opt = inner_opt
+        # delegate core surface
+        self._lr = inner_opt._lr
+        self._parameters = inner_opt._parameters
+        self._grad_clip = inner_opt._grad_clip
+        self._weight_decay = inner_opt._weight_decay
+        self._accumulators = inner_opt._accumulators
+        self._global_step = inner_opt._global_step
+        # transform flags consumed by TrainStep/hapi
+        self.transforms = dict(getattr(inner_opt, "transforms", {}))
+
+    # default passthroughs
+    def get_lr(self):
+        return self.inner_opt.get_lr()
+
+    def step(self):
+        self.inner_opt.step()
+
+    def clear_grad(self):
+        self.inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self.inner_opt.minimize(loss)
+
+    def state_dict(self):
+        return self.inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner_opt.set_state_dict(sd)
+
+    def init_opt_state(self, params):
+        return self.inner_opt.init_opt_state(params)
+
+    def apply_gradients_fn(self):
+        return self.inner_opt.apply_gradients_fn()
+
+    @property
+    def _state_names(self):
+        return self.inner_opt._state_names
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    """ref meta_optimizers/amp_optimizer.py: wraps with bf16 autocast +
+    GradScaler semantics (scaling defaults off for bf16 — see amp/)."""
+
+    def __init__(self, inner_opt, configs=None):
+        super().__init__(inner_opt)
+        cfg = configs or {}
+        self.transforms["amp"] = {
+            "level": "O2" if cfg.get("use_pure_bf16") or cfg.get("use_pure_fp16")
+            else "O1",
+            "dtype": "bfloat16",
+            "init_loss_scaling": cfg.get("init_loss_scaling", 1.0),
+        }
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    """ref meta_optimizers/recompute_optimizer.py + fluid RecomputeOptimizer
+    (optimizer.py:4549): jax.checkpoint on marked segments."""
+
+    def __init__(self, inner_opt, configs=None):
+        super().__init__(inner_opt)
+        self.transforms["recompute"] = dict(configs or {"checkpoints": []})
+
+    def backward(self, loss, **kwargs):
+        loss.backward()
+
+    def apply_optimize(self, loss, startup_program=None, params_grads=None):
+        self.inner_opt.step()
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    """ref meta_optimizers/gradient_merge_optimizer.py — k-step grad
+    accumulation before the update. Eagerly: accumulate into .grad and step
+    every k; compiled: the TrainStep wraps updates in lax.cond."""
+
+    def __init__(self, inner_opt, k_steps=1, avg=True):
+        super().__init__(inner_opt)
+        self.k_steps = int(k_steps)
+        self.avg = avg
+        self._acc_step = 0
+        self.transforms["gradient_merge"] = {"k_steps": self.k_steps,
+                                             "avg": avg}
+
+    def step(self):
+        self._acc_step += 1
+        if self._acc_step % self.k_steps != 0:
+            return  # keep accumulating in .grad
+        if self.avg and self.k_steps > 1:
+            for p in self._parameters:
+                if p.grad is not None:
+                    p.grad._data = p.grad._data / self.k_steps
+        self.inner_opt.step()
+
+    def clear_grad(self):
+        if self._acc_step % self.k_steps == 0:
+            self.inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+
+class LambOptimizer(MetaOptimizerBase):
+    def __init__(self, inner_opt, configs=None):
+        lamb = Lamb(learning_rate=inner_opt._lr,
+                    parameters=inner_opt._parameters,
+                    grad_clip=inner_opt._grad_clip,
+                    **({k: v for k, v in (configs or {}).items()
+                        if k in ("lamb_weight_decay", "beta1", "beta2",
+                                 "epsilon")}))
+        super().__init__(lamb)
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    def __init__(self, inner_opt, configs=None):
+        cfg = {k: v for k, v in (configs or {}).items()
+               if k in ("lars_coeff", "lars_weight_decay", "epsilon")}
+        momentum = getattr(inner_opt, "_momentum", 0.9)
+        lars = Lars(learning_rate=inner_opt._lr, momentum=momentum,
+                    parameters=inner_opt._parameters,
+                    grad_clip=inner_opt._grad_clip, **cfg)
+        super().__init__(lars)
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    """ref meta_optimizers/localsgd_optimizer.py — run k local steps then
+    average params across dp. Under GSPMD, param averaging is a psum at sync
+    points; the compiled step takes a sync flag."""
+
+    def __init__(self, inner_opt, k_steps=1):
+        super().__init__(inner_opt)
+        self.k_steps = k_steps
+        self.transforms["localsgd"] = {"k_steps": k_steps}
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    """ref meta_optimizers/sharding_optimizer.py:33 (ZeRO): on TPU this is
+    GSPMD weight-update/optimizer-state sharding (PAPERS.md: Automatic
+    Cross-Replica Sharding of Weight Update, arXiv:2004.13336) — opt states get
+    sharded PartitionSpecs over 'dp' instead of manual broadcast/reduce."""
+
+    def __init__(self, inner_opt, configs=None):
+        super().__init__(inner_opt)
+        self.transforms["sharding"] = dict(configs or {})
+
+
+class PipelineOptimizer(MetaOptimizerBase):
+    """ref meta_optimizers/pipeline_optimizer.py + fluid PipelineOptimizer
+    (optimizer.py:3718): micro-batch 1F1B over 'pp' mesh axis; consumed by
+    distributed/pipeline.py."""
+
+    def __init__(self, inner_opt, configs=None):
+        super().__init__(inner_opt)
+        self.transforms["pipeline"] = dict(
+            configs or {"accumulate_steps": 1, "micro_batch_size": 1})
+
+
+class GraphExecutionOptimizer(MetaOptimizerBase):
+    """ref graph_execution_optimizer.py — the whole-graph compiled execution;
+    on TPU every TrainStep is already whole-graph XLA, so this is the identity
+    terminal of the chain."""
+
+
+def build_distributed_optimizer(optimizer, strategy):
+    """StrategyCompiler analog (ref base/strategy_compiler.py:89): order
+    matters — match the reference's valid chain AMP ∘ Recompute ∘ (Lamb|Lars)
+    ∘ (Sharding|Pipeline|LocalSGD|GradientMerge) ∘ GraphExecution."""
+    opt = optimizer
+    if strategy.lamb:
+        opt = LambOptimizer(opt, strategy.lamb_configs)
+    elif strategy.lars:
+        opt = LarsOptimizer(opt, strategy.lars_configs)
+    if strategy.recompute:
+        opt = RecomputeOptimizer(opt, strategy.recompute_configs)
+    if strategy.amp:
+        opt = AMPOptimizer(opt, strategy.amp_configs)
+    if strategy.sharding:
+        opt = ShardingOptimizer(opt, strategy.sharding_configs)
+    if strategy.pipeline:
+        opt = PipelineOptimizer(opt, strategy.pipeline_configs)
+    if strategy.localsgd:
+        opt = LocalSGDOptimizer(opt, strategy.localsgd_configs.get("k_steps", 1))
+    if strategy.gradient_merge:
+        opt = GradientMergeOptimizer(
+            opt, strategy.gradient_merge_configs.get("k_steps", 1),
+            strategy.gradient_merge_configs.get("avg", True))
+    if not isinstance(opt, MetaOptimizerBase):
+        opt = GraphExecutionOptimizer(opt)
+    return opt

@@ -1,0 +1,120 @@
+"""Mesh/axis registry — the TPU-native `NCCLCommContext` (ref
+paddle/fluid/platform/collective_helper.h:65: ring_id -> comm registry).
+
+The reference keys communicators by integer ring_id; XLA keys collectives by
+*named mesh axes*. This registry maps both worlds: groups/ring_ids resolve to
+(mesh, axis-name) pairs so c_allreduce(ring_id=k) lowers to lax.psum over the
+right axis. Axis naming convention across the framework:
+  'dp' data parallel | 'mp' tensor/model parallel | 'pp' pipeline stages |
+  'sp' sequence/context parallel | 'ep' expert parallel
+"""
+import numpy as np
+import jax
+from jax.sharding import Mesh, PartitionSpec, NamedSharding
+
+_current_mesh = None
+_groups = {}          # group id -> _Group
+_next_group_id = 1
+
+DP_AXIS = "dp"
+MP_AXIS = "mp"
+PP_AXIS = "pp"
+SP_AXIS = "sp"
+EP_AXIS = "ep"
+
+
+class _Group:
+    def __init__(self, gid, axis_name, ranks=None):
+        self.id = gid
+        self.axis_name = axis_name
+        self.ranks = ranks
+
+    @property
+    def nranks(self):
+        if self.ranks:
+            return len(self.ranks)
+        m = get_mesh()
+        return int(m.shape[self.axis_name]) if m is not None else 1
+
+
+def default_mesh():
+    """1-D data-parallel mesh over all devices (the DP allreduce ring analog)."""
+    global _current_mesh
+    if _current_mesh is None:
+        devs = np.asarray(jax.devices())
+        _current_mesh = Mesh(devs, (DP_AXIS,))
+        _groups[0] = _Group(0, DP_AXIS)
+    return _current_mesh
+
+
+def make_mesh(shape_dict):
+    """Build + install an N-D mesh, e.g. {'dp': 2, 'mp': 4}."""
+    global _current_mesh
+    names = tuple(shape_dict.keys())
+    sizes = tuple(int(v) for v in shape_dict.values())
+    n = int(np.prod(sizes))
+    devs = np.asarray(jax.devices()[:n]).reshape(sizes)
+    _current_mesh = Mesh(devs, names)
+    _groups.clear()
+    _groups[0] = _Group(0, names[0])
+    return _current_mesh
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh():
+    return _current_mesh
+
+
+def mesh_axes():
+    m = get_mesh()
+    return tuple(m.axis_names) if m is not None else ()
+
+
+def register_group(axis_name, ranks=None):
+    """ring_id/new_group analog: returns a group handle bound to a mesh axis."""
+    global _next_group_id
+    gid = _next_group_id
+    _next_group_id += 1
+    g = _Group(gid, axis_name, ranks)
+    _groups[gid] = g
+    return g
+
+
+def get_group(group=None):
+    if group is None or group == 0:
+        default_mesh()
+        return _groups[0]
+    if isinstance(group, _Group):
+        return group
+    return _groups[int(group)]
+
+
+class MeshContext:
+    """Context manager installing a mesh (for `with MeshContext({'dp':8}):`)."""
+
+    def __init__(self, shape_dict_or_mesh):
+        if isinstance(shape_dict_or_mesh, Mesh):
+            self.mesh = shape_dict_or_mesh
+        else:
+            names = tuple(shape_dict_or_mesh.keys())
+            sizes = tuple(int(v) for v in shape_dict_or_mesh.values())
+            n = int(np.prod(sizes))
+            devs = np.asarray(jax.devices()[:n]).reshape(sizes)
+            self.mesh = Mesh(devs, names)
+        self._saved = None
+
+    def __enter__(self):
+        global _current_mesh
+        self._saved = _current_mesh
+        _current_mesh = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        global _current_mesh
+        _current_mesh = self._saved
+        return False

@@ -1,0 +1,50 @@
+"""DataParallel (ref python/paddle/fluid/dygraph/parallel.py:322 + the bucketed
+Reducer imperative/reducer.cc).
+
+TPU-native rationale: the reference overlaps backward with bucketed NCCL
+allreduce because grads materialise op-by-op on separate processes. Under
+GSPMD there is one program: the train step is compiled over a Mesh with the
+batch sharded on the 'dp' axis, and XLA inserts (and schedules/overlaps) the
+gradient AllReduces itself — the Reducer's bucketing/overlap machinery is the
+compiler's latency-hiding scheduler now. DataParallel therefore:
+  * marks the model as data-parallel (TrainStep/hapi shard inputs on 'dp'),
+  * keeps scale_loss/apply_collective_grads API compat as no-ops,
+  * still works in eager mode (single-device semantics).
+"""
+import jax
+
+from ..nn.layer import Layer
+from . import mesh as mesh_mod
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.comm_buffer_size = comm_buffer_size
+        self.find_unused_parameters = find_unused_parameters
+        mesh_mod.default_mesh()
+        self._data_parallel = True
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        """ref parallel.py:506 — grads are psum-averaged by the compiled step;
+        no pre-scaling needed."""
+        return loss
+
+    def apply_collective_grads(self):
+        """ref parallel.py:515 — XLA inserts gradient AllReduce; no-op."""
+        pass
+
+    # delegate module surface to the wrapped layer
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)
+
+    set_dict = set_state_dict

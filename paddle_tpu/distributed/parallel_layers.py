@@ -1,0 +1,185 @@
+"""Tensor-parallel layers (ref python/paddle/distributed/collective.py:492-620
+_parallel_linear/_parallel_embedding — Megatron-style TP).
+
+TPU-native: weights carry PartitionSpec sharding hints on the 'mp' axis; under
+pjit, GSPMD propagates them and inserts the minimal collectives (AllReduce on
+row-parallel outputs, AllGather when gather_output=True). When traced inside
+shard_map (explicit-collective mode, used by the pipeline engine), the layers
+issue lax collectives directly — both regimes are supported by checking for a
+bound axis.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..ops.dispatch import apply
+from . import mesh as mesh_mod
+
+
+def _mp_size():
+    m = mesh_mod.get_mesh()
+    if m is not None and mesh_mod.MP_AXIS in m.axis_names:
+        return int(m.shape[mesh_mod.MP_AXIS])
+    return 1
+
+
+def _axis_bound(name):
+    """True while tracing inside shard_map with this axis in scope."""
+    try:
+        lax.axis_index(name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+class ColumnParallelLinear(Layer):
+    """Y = X @ [W_1 | W_2 | ... | W_p]: weight column-sharded on 'mp'
+    (ref collective.py _parallel_linear axis=1)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.sharding = P(None, mesh_mod.MP_AXIS)
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        if self.bias is not None:
+            self.bias.sharding = P(mesh_mod.MP_AXIS)
+
+    def forward(self, x):
+        if _axis_bound(mesh_mod.MP_AXIS):
+            # explicit mode: local shard matmul; output is mp-sharded on cols
+            out = F.linear(x, self.weight, self.bias)
+            if self.gather_output:
+                arr = lax.all_gather(out._data, mesh_mod.MP_AXIS, axis=-1,
+                                     tiled=True)
+                out = Tensor(arr, stop_gradient=out.stop_gradient)
+                out._node, out._slot = None, 0
+            return out
+        # GSPMD mode: full logical shapes; sharding constraint steers SPMD
+        out = F.linear(x, self.weight, self.bias)
+        return _with_sharding(out, P(None, mesh_mod.MP_AXIS)
+                              if not self.gather_output else None)
+
+
+class RowParallelLinear(Layer):
+    """Y = sum_p X_p @ W_p: weight row-sharded, output AllReduced
+    (ref collective.py _parallel_linear axis=0)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.sharding = P(mesh_mod.MP_AXIS, None)
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        if _axis_bound(mesh_mod.MP_AXIS):
+            out = F.linear(x, self.weight, None)
+            arr = lax.psum(out._data, mesh_mod.MP_AXIS)
+            out = Tensor(arr, stop_gradient=out.stop_gradient)
+            if self.bias is not None:
+                out = out + self.bias
+            return out
+        out = F.linear(x, self.weight, None)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding row(vocab)-sharded on 'mp' with shard_index + masked lookup +
+    psum (ref collective.py:566 _parallel_embedding)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0))
+        self.weight.sharding = P(mesh_mod.MP_AXIS, None)
+
+    def forward(self, x):
+        if _axis_bound(mesh_mod.MP_AXIS):
+            mp = _mp_size()
+            from ..ops.manipulation import shard_index
+            rank = lax.axis_index(mesh_mod.MP_AXIS)
+            shard_size = (self.num_embeddings + mp - 1) // mp
+
+            def f(idx, w):
+                local = idx - rank * shard_size
+                valid = (local >= 0) & (local < w.shape[0])
+                safe = jnp.where(valid, local, 0)
+                out = jnp.take(w, safe, axis=0)
+                out = jnp.where(valid[..., None], out, 0.0)
+                return lax.psum(out, mesh_mod.MP_AXIS)
+            return apply(f, (x, self.weight), name="vocab_parallel_embedding")
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax CE over mp-sharded logits."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, logits, label):
+        def f(z, y):
+            if _axis_bound(mesh_mod.MP_AXIS):
+                mp_max = lax.pmax(jnp.max(z, axis=-1, keepdims=True),
+                                  mesh_mod.MP_AXIS)
+                e = jnp.exp(z - mp_max)
+                denom = lax.psum(jnp.sum(e, axis=-1, keepdims=True),
+                                 mesh_mod.MP_AXIS)
+                rank = lax.axis_index(mesh_mod.MP_AXIS)
+                vshard = z.shape[-1]
+                local = y - rank * vshard
+                valid = (local >= 0) & (local < vshard)
+                safe = jnp.where(valid, local, 0)
+                picked = jnp.take_along_axis(z - mp_max, safe[..., None],
+                                             axis=-1)[..., 0]
+                picked = jnp.where(valid, picked, 0.0)
+                picked = lax.psum(picked, mesh_mod.MP_AXIS)
+                return jnp.mean(jnp.log(denom[..., 0]) - picked)
+            return jnp.mean(-jnp.take_along_axis(
+                jax.nn.log_softmax(z, -1), y[..., None], axis=-1))
+        return apply(f, (logits, label), name="parallel_cross_entropy")
+
+
+def _with_sharding(t, spec):
+    """Attach a GSPMD sharding constraint inside pjit traces."""
+    if spec is None:
+        return t
+    a = t._data
+    if isinstance(a, jax.core.Tracer):
+        mesh = mesh_mod.get_mesh()
+        if mesh is not None:
+            try:
+                a = jax.lax.with_sharding_constraint(
+                    a, jax.sharding.NamedSharding(mesh, spec))
+                out = Tensor(a, stop_gradient=t.stop_gradient)
+                return out
+            except (ValueError, RuntimeError):
+                return t
+    return t

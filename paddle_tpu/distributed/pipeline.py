@@ -1,0 +1,17 @@
+"""Pipeline parallelism engine (ref fluid/optimizer.py:3718 PipelineOptimizer +
+framework/section_worker.cc 1F1B micro loop + device_guard placement).
+
+TPU-native design: pipeline stages live on a 'pp' mesh axis. Activations cross
+stage boundaries with lax.ppermute over ICI neighbors inside shard_map. The
+micro-batch schedule is GPipe-style expressed as a lax.scan over microbatches
+(compiler sees the whole schedule and overlaps permutes with compute), with
+gradient accumulation across microbatches. Full engine lands with the hybrid
+milestone; _CURRENT_STAGE backs static.device_guard placement markers.
+"""
+import contextvars
+
+_CURRENT_STAGE = contextvars.ContextVar("pp_stage", default=None)
+
+
+def current_stage():
+    return _CURRENT_STAGE.get()

@@ -1,0 +1,64 @@
+"""paddle.distributed.spawn analog (ref python/paddle/distributed/spawn.py:276).
+
+On TPU, one process drives all local chips (SPMD single-controller), so spawn
+degenerates to running `func` once in-process for nprocs<=1; multi-host spawn
+forks python processes with PADDLE_* env set, mirroring the reference's
+launcher contract (used by localhost multi-process tests).
+"""
+import multiprocessing as mp
+import os
+import sys
+import traceback
+
+
+class _SpawnContext:
+    def __init__(self, procs, error_queues):
+        self.processes = procs
+        self.error_queues = error_queues
+
+    def join(self, timeout=None):
+        for i, p in enumerate(self.processes):
+            p.join(timeout)
+            if p.exitcode not in (0, None):
+                eq = self.error_queues[i]
+                msg = eq.get() if not eq.empty() else f"exitcode {p.exitcode}"
+                raise RuntimeError(f"spawned rank {i} failed:\n{msg}")
+        return True
+
+
+def _worker(func, rank, nprocs, args, error_queue, env):
+    try:
+        os.environ.update(env)
+        func(*args)
+    except Exception:
+        error_queue.put(traceback.format_exc())
+        sys.exit(1)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    if nprocs <= 1:
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    procs, eqs = [], []
+    base_port = int(options.get("started_port", 36701))
+    endpoints = ",".join(f"127.0.0.1:{base_port + i}" for i in range(nprocs))
+    for rank in range(nprocs):
+        eq = ctx.SimpleQueue()
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{base_port + rank}",
+        }
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, args, eq, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+        eqs.append(eq)
+    context = _SpawnContext(procs, eqs)
+    if join:
+        context.join()
+        return None
+    return context

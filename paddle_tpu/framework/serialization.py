@@ -1,4 +1,70 @@
-def save(obj, path, **k):
-    raise NotImplementedError
-def load(path, **k):
-    raise NotImplementedError
+"""paddle.save/load analog (ref python/paddle/framework/io.py:202,292 —
+pickled nested containers of tensors; tensors serialised as numpy).
+
+Large checkpoints for distributed/sharded state go through orbax in
+incubate/checkpoint; this is the single-host object-file path.
+"""
+import os
+import pickle
+
+import numpy as np
+
+from .tensor import Tensor, Parameter
+
+
+class _TensorPayload:
+    """Pickle-stable wrapper recording dtype/shape + raw bytes."""
+
+    def __init__(self, arr: np.ndarray):
+        # bfloat16 has no numpy dtype string; store via uint16 view
+        self.is_bf16 = arr.dtype.name == "bfloat16"
+        if self.is_bf16:
+            self.dtype = "bfloat16"
+            self.data = arr.view(np.uint16)
+        else:
+            self.dtype = arr.dtype.str
+            self.data = arr
+        self.shape = arr.shape
+
+    def restore(self):
+        if self.is_bf16:
+            import ml_dtypes
+            return self.data.view(ml_dtypes.bfloat16)
+        return self.data
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        arr = obj.restore()
+        return arr if return_numpy else Tensor(arr)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -171,6 +171,9 @@ def default_generator():
 
 
 def next_rng_key():
+    traced = _functional_rng.get()
+    if traced is not None:
+        return traced.next_key()
     return _default_generator.next_key()
 
 
@@ -208,6 +211,29 @@ def get_flag(key, default=None):
 
 _grad_enabled = contextvars.ContextVar("grad_enabled", default=True)
 _functional_mode = contextvars.ContextVar("functional_mode", default=False)
+_functional_rng = contextvars.ContextVar("functional_rng", default=None)
+
+
+class _TracedRng:
+    """Split-on-demand chain over a traced PRNG key — lets dropout etc. draw
+    fresh randomness inside jit'd train steps (the key is a step input, so each
+    compiled step gets a new mask instead of a baked-in constant)."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+@contextlib.contextmanager
+def functional_rng_ctx(key):
+    tok = _functional_rng.set(_TracedRng(key))
+    try:
+        yield
+    finally:
+        _functional_rng.reset(tok)
 
 
 def is_grad_enabled():
@@ -245,6 +271,22 @@ def functional_mode_ctx():
         yield
     finally:
         _functional_mode.reset(tok)
+
+
+_amp_state = contextvars.ContextVar("amp_state", default=None)
+
+
+def get_amp_state():
+    return _amp_state.get()
+
+
+@contextlib.contextmanager
+def amp_guard_ctx(cfg):
+    tok = _amp_state.set(cfg)
+    try:
+        yield
+    finally:
+        _amp_state.reset(tok)
 
 
 class no_grad:

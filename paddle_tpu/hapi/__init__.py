@@ -1,1 +1,2 @@
-"""placeholder — filled in during round 1 build."""
+from .model import Model, summary
+from . import callbacks

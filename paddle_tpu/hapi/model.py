@@ -1,4 +1,278 @@
-class Model:  # placeholder
-    pass
-def summary(*a, **k):
-    raise NotImplementedError
+"""hapi Model — Keras-like fit/evaluate/predict
+(ref python/paddle/hapi/model.py:810 fit, :1299 predict; adapters :224,609).
+
+The reference has separate static/dygraph adapters; here the single adapter is
+jit.TrainStep: fit() compiles forward+loss+backward+update into one donated XLA
+executable and streams DataLoader batches into it.
+"""
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..framework import state
+from ..jit import TrainStep, _wrap, _unwrap
+from ..metric import Metric
+from . import callbacks as cbks
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        self._amp_configs = amp_configs
+
+    def _loss_fn(self, *args):
+        # split model outputs from labels by loss arity: loss(out..., label...)
+        return self._loss(*args)
+
+    # ------------------------------------------------------------- training
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        cb_list = cbks.CallbackList(callbacks or [])
+        cb_list.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cb_list.on_begin("train", {"epochs": epochs, "steps": steps,
+                                   "verbose": verbose,
+                                   "metrics": self._metric_names()})
+        history = {"loss": []}
+        it_count = 0
+        logs = {}
+        for epoch in range(epochs):
+            cb_list.on_epoch_begin(epoch)
+            self.network.train()
+            for step, batch in enumerate(train_loader):
+                cb_list.on_batch_begin("train", step, logs)
+                loss, metrics = self.train_batch_parts(batch)
+                logs = {"loss": loss, **metrics,
+                        "batch_size": batch_size}
+                history["loss"].append(loss)
+                cb_list.on_batch_end("train", step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            for m in self._metrics:
+                logs[self._name_of(m)] = m.accumulate()
+                m.reset()
+            cb_list.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            if self.stop_training or (num_iters is not None
+                                      and it_count >= num_iters):
+                break
+        cb_list.on_end("train", logs)
+        if self._train_step is not None:
+            self._train_step.sync()
+        return history
+
+    def train_batch_parts(self, batch):
+        from ..optimizer.lr import LRScheduler
+        inputs, labels = self._split_batch(batch)
+        if self._train_step is None:
+            self._train_step = TrainStep(self.network, self._loss_fn,
+                                         self._optimizer,
+                                         return_outputs=bool(self._metrics))
+        result = self._train_step(inputs, labels)
+        if self._metrics:
+            loss_t, outs = result
+            outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
+            metric_logs = {}
+            for m in self._metrics:
+                res = m.compute(*outs_t, *labels)
+                val = m.update(*res) if isinstance(res, tuple) \
+                    else m.update(res)
+                metric_logs[self._name_of(m)] = val
+        else:
+            loss_t = result
+            metric_logs = {}
+        loss = float(loss_t.numpy())
+        if isinstance(self._optimizer._lr, LRScheduler):
+            self._optimizer._lr.step()
+        return loss, metric_logs
+
+    def train_batch(self, inputs, labels=None):
+        """Single train step (ref hapi/model.py train_batch)."""
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        if self._train_step is None:
+            self._train_step = TrainStep(self.network, self._loss_fn,
+                                         self._optimizer)
+        loss = self._train_step(tuple(inputs), tuple(labels))
+        return [float(loss.numpy())]
+
+    # ------------------------------------------------------------- eval/pred
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size)
+        else:
+            loader = eval_data
+        if self._train_step is not None:
+            self._train_step.sync()
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        with state.no_grad_ctx():
+            for batch in loader:
+                inputs, labels = self._split_batch(batch)
+                outs = self.network(*[Tensor(b) if not isinstance(b, Tensor)
+                                      else b for b in inputs])
+                outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
+                if self._loss is not None:
+                    losses.append(float(
+                        self._loss_fn(*outs_t, *labels).numpy()))
+                for m in self._metrics:
+                    res = m.compute(*outs_t, *labels)
+                    m.update(*res) if isinstance(res, tuple) else m.update(res)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[self._name_of(m)] = m.accumulate()
+        self.network.train()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size)
+        else:
+            loader = test_data
+        if self._train_step is not None:
+            self._train_step.sync()
+        self.network.eval()
+        outputs = []
+        with state.no_grad_ctx():
+            for batch in loader:
+                inputs, _ = self._split_batch(batch, allow_no_label=True)
+                outs = self.network(*inputs)
+                outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
+                outputs.append([o.numpy() for o in outs_t])
+        self.network.train()
+        n_out = len(outputs[0])
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g) for g in grouped]
+        return grouped
+
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.network.eval()
+        with state.no_grad_ctx():
+            outs = self.network(*[Tensor(i) if not isinstance(i, Tensor)
+                                  else i for i in inputs])
+        self.network.train()
+        outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [o.numpy() for o in outs_t]
+
+    def eval_batch(self, inputs, labels=None):
+        logs = {}
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        self.network.eval()
+        with state.no_grad_ctx():
+            outs = self.network(*[Tensor(i) if not isinstance(i, Tensor)
+                                  else i for i in inputs])
+            outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
+            loss = self._loss_fn(*outs_t, *[Tensor(l) if not isinstance(l, Tensor)
+                                            else l for l in labels])
+        self.network.train()
+        return [float(loss.numpy())]
+
+    # ------------------------------------------------------------- save/load
+    def save(self, path, training=True):
+        from ..framework.serialization import save as _save
+        if self._train_step is not None:
+            self._train_step.sync()
+        _save(dict(self.network.state_dict()), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.serialization import load as _load
+        sd = _load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+        self._train_step = None  # recompile against restored state
+
+    def parameters(self):
+        return self.network.parameters()
+
+    # ------------------------------------------------------------- helpers
+    def _split_batch(self, batch, allow_no_label=False):
+        if isinstance(batch, dict):
+            batch = list(batch.values())
+        batch = list(batch)
+        n_labels = len(self._labels) if self._labels else 1
+        if allow_no_label and len(batch) == 1:
+            return batch, []
+        inputs = batch[:-n_labels] if len(batch) > n_labels else batch[:1]
+        labels = batch[len(inputs):]
+        return inputs, labels
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            names.append(self._name_of(m))
+        return names
+
+    @staticmethod
+    def _name_of(m):
+        n = m.name()
+        return n if isinstance(n, str) else n[0]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """paddle.summary analog."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if p.trainable:
+            trainable += n
+        rows.append((name, p.shape, n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = ["-" * (width + 30)]
+    for name, shp, n in rows:
+        lines.append(f"{name:<{width}}{str(shp):<20}{n:>10,}")
+    lines.append("-" * (width + 30))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

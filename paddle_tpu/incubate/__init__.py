@@ -1,1 +1,1 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle_tpu.incubate (ref python/paddle/fluid/incubate): auto-checkpoint etc."""

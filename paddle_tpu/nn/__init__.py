@@ -1,1 +1,28 @@
-"""placeholder"""
+"""paddle_tpu.nn — layers, functional ops, initializers
+(ref python/paddle/nn/__init__.py surface)."""
+from . import functional
+from . import initializer
+from .layer import (Layer, LayerList, Sequential, ParameterList,
+                    HookRemoveHelper)
+from .param_attr import ParamAttr
+from .layers_common import (Linear, Embedding, Dropout, Dropout2D, Dropout3D,
+                            AlphaDropout, Flatten, Identity, Pad1D, Pad2D,
+                            Pad3D, Upsample, UpsamplingBilinear2D,
+                            UpsamplingNearest2D, PixelShuffle, Bilinear,
+                            CosineSimilarity)
+from .conv import (Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose)
+from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                   SyncBatchNorm, LayerNorm, GroupNorm, InstanceNorm1D,
+                   InstanceNorm2D, InstanceNorm3D, LocalResponseNorm,
+                   SpectralNorm)
+from .pooling import (MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D,
+                      AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D)
+from .activation import (ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish,
+                         Hardswish, Hardsigmoid, Softsign, Tanhshrink, GELU,
+                         LeakyReLU, ELU, CELU, SELU, PReLU, Hardtanh,
+                         Hardshrink, Softshrink, Softplus, Softmax, LogSoftmax,
+                         Maxout)
+from .loss import (CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss,
+                   BCELoss, BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss,
+                   HingeEmbeddingLoss)
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm

@@ -1,0 +1,86 @@
+"""Gradient clipping (ref python/paddle/fluid/clip.py: ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm). Operates on (param, grad) lists both
+eagerly (Tensor grads) and functionally (jnp pytrees, for jit'd steps)."""
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def apply_arrays(self, grads):
+        """Functional form: list/tree of jnp arrays -> clipped arrays."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+    def apply_arrays(self, grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max)
+                for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return g * scale
+
+    def __call__(self, params_grads):
+        return [(p, g if g is None else Tensor(self._clip_one(g._data)))
+                for p, g in params_grads]
+
+    def apply_arrays(self, grads):
+        return [None if g is None else self._clip_one(g) for g in grads]
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """ref fluid/clip.py GradientClipByGlobalNorm — the Fleet default clip."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _scale(self, arrays):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in arrays if g is not None)
+        global_norm = jnp.sqrt(sq)
+        return self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+
+    def __call__(self, params_grads):
+        arrays = [g._data for _, g in params_grads if g is not None]
+        if not arrays:
+            return params_grads
+        scale = self._scale(arrays)
+        return [(p, g if g is None else Tensor(g._data * scale.astype(g.dtype)))
+                for p, g in params_grads]
+
+    def apply_arrays(self, grads):
+        live = [g for g in grads if g is not None]
+        if not live:
+            return grads
+        scale = self._scale(live)
+        return [None if g is None else g * scale.astype(g.dtype) for g in grads]
+
+
+# fluid aliases
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

@@ -1,0 +1,1040 @@
+"""nn.functional — activations, conv/pool, norm, losses, embedding, dropout
+(ref python/paddle/nn/functional/* and the kernels in paddle/fluid/operators/:
+activation_op.cc, conv_cudnn_op.cu, pool_op, batch_norm_op, layer_norm_op,
+softmax_with_cross_entropy_op, dropout_op, lookup_table_v2_op).
+
+Convs ride lax.conv_general_dilated (MXU path); XLA picks TPU-optimal layouts so
+both NCHW (paddle default) and NHWC are accepted.
+"""
+import math
+import numbers
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import state
+from ..framework.dtype import convert_dtype
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply, as_array
+
+# ----------------------------------------------------------------- activations
+
+
+def _unary(fn, name):
+    def op(x, name=None):
+        return apply(fn, (x,), name=name)
+    op.__name__ = name
+    return op
+
+
+relu = _unary(jax.nn.relu, "relu")
+relu6 = _unary(jax.nn.relu6, "relu6")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+tanh = _unary(jnp.tanh, "tanh")
+silu = _unary(jax.nn.silu, "silu")
+swish = silu
+mish = _unary(lambda a: a * jnp.tanh(jax.nn.softplus(a)), "mish")
+hardswish = _unary(jax.nn.hard_swish, "hardswish")
+hardsigmoid = _unary(lambda a: jnp.clip(a / 6.0 + 0.5, 0.0, 1.0), "hardsigmoid")
+tanhshrink = _unary(lambda a: a - jnp.tanh(a), "tanhshrink")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), (x,),
+                 name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), (x,),
+                 name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha), (x,), name="elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha), (x,), name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                 (x,), name="selu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply(f, (x, weight), name="prelu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), (x,), name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), (x,),
+                 name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold, 0.0)),
+                 (x,), name="softshrink")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda a: jnp.where(a * beta > threshold, a,
+                                     jax.nn.softplus(a * beta) / beta),
+                 (x,), name="softplus")
+
+
+def softsign(x, name=None):
+    return apply(lambda a: a / (1 + jnp.abs(a)), (x,), name="softsign")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        c = a.shape[axis]
+        new_shape = list(a.shape)
+        new_shape[axis] = c // groups
+        new_shape.insert(axis + 1, groups)
+        return jnp.max(a.reshape(new_shape), axis=axis + 1)
+    return apply(f, (x,), name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply(f, (x,), name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply(f, (x,), name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(state.next_rng_key(), tuple(as_array(x).shape)) + 1e-20))
+
+    def f(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis) \
+                if hasattr(jnp, "put_along_axis") else \
+                jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis)
+            y = onehot + y - lax.stop_gradient(y)
+        return y
+    return apply(f, (x,), name="gumbel_softmax")
+
+
+# ----------------------------------------------------------------- linear / emb
+
+def linear(x, weight, bias=None, name=None):
+    """paddle weight layout: [in_features, out_features] (ref nn/functional/common.py:1419)."""
+    if bias is None:
+        return apply(lambda a, w: jnp.matmul(a, w), (x, weight), name="linear")
+    return apply(lambda a, w, b: jnp.matmul(a, w) + b, (x, weight, bias),
+                 name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Device-side gather (TPU: embedding lookups stay on-chip; host-resident
+    sparse tables are the PS path, see distributed/ps)."""
+    def f(idx, w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply(f, (x, weight), name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda i: jax.nn.one_hot(i, num_classes, dtype=jnp.float32),
+                 (x,), differentiable=False, name="one_hot")
+
+
+# ----------------------------------------------------------------- dropout
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    a = as_array(x)
+    shape = tuple(a.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(a.shape))
+    keep = jax.random.bernoulli(state.next_rng_key(), 1.0 - p, shape)
+
+    def f(v):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0)
+        return jnp.where(keep, v, 0.0)
+    return apply(f, (x,), name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a_ = as_array(x)
+    keep = jax.random.bernoulli(state.next_rng_key(), 1.0 - p, tuple(a_.shape))
+    q = 1.0 - p
+    coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+    coef_b = -coef_a * alpha_p * p
+
+    def f(v):
+        return coef_a * jnp.where(keep, v, alpha_p) + coef_b
+    return apply(f, (x,), name="alpha_dropout")
+
+
+# ----------------------------------------------------------------- conv / pool
+
+def _norm_tuple(v, n):
+    if isinstance(v, numbers.Number):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _conv_padding(padding, n, strides, dilations, ksize):
+    """paddle padding spec -> lax padding list. Supports int, list, 'SAME','VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, numbers.Number):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n:
+        if isinstance(padding[0], (list, tuple)):
+            return [tuple(p) for p in padding]
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:  # [before0, after0, before1, after1...]
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """weight layout: [out_c, in_c/groups, kh, kw] (paddle/ref conv_op.cc)."""
+    n = 2
+    strides = _norm_tuple(stride, n)
+    dilations = _norm_tuple(dilation, n)
+    dn_str = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" \
+        else ("NHWC", "OIHW", "NHWC")
+
+    def f(a, w, *maybe_b):
+        pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
+        dn = lax.conv_dimension_numbers(a.shape, w.shape, dn_str)
+        out = lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups)
+        if maybe_b:
+            b = maybe_b[0]
+            if data_format == "NCHW":
+                out = out + b.reshape(1, -1, 1, 1)
+            else:
+                out = out + b.reshape(1, 1, 1, -1)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(f, args, name="conv2d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    n = 1
+    strides = _norm_tuple(stride, n)
+    dilations = _norm_tuple(dilation, n)
+    dn_str = ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "OIH", "NHC")
+
+    def f(a, w, *maybe_b):
+        pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
+        dn = lax.conv_dimension_numbers(a.shape, w.shape, dn_str)
+        out = lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups)
+        if maybe_b:
+            shape = (1, -1, 1) if data_format == "NCL" else (1, 1, -1)
+            out = out + maybe_b[0].reshape(shape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(f, args, name="conv1d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    n = 3
+    strides = _norm_tuple(stride, n)
+    dilations = _norm_tuple(dilation, n)
+    dn_str = ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" \
+        else ("NDHWC", "OIDHW", "NDHWC")
+
+    def f(a, w, *maybe_b):
+        pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
+        dn = lax.conv_dimension_numbers(a.shape, w.shape, dn_str)
+        out = lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups)
+        if maybe_b:
+            shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" else (1, 1, 1, 1, -1)
+            out = out + maybe_b[0].reshape(shape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(f, args, name="conv3d")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, output_size=None,
+                     data_format="NCHW", name=None):
+    """weight layout: [in_c, out_c/groups, kh, kw] (ref conv_transpose_op.cc)."""
+    n = 2
+    strides = _norm_tuple(stride, n)
+    dilations = _norm_tuple(dilation, n)
+    out_pad = _norm_tuple(output_padding, n)
+
+    def f(a, w, *maybe_b):
+        if data_format == "NHWC":
+            a_nchw = jnp.transpose(a, (0, 3, 1, 2))
+        else:
+            a_nchw = a
+        pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
+        if isinstance(pad, str):
+            pad_list = [(0, 0)] * n if pad == "VALID" else None
+            if pad_list is None:
+                raise ValueError("SAME padding unsupported for conv_transpose")
+            pad = pad_list
+        kh = [((w.shape[2 + i] - 1) * dilations[i] + 1) for i in range(n)]
+        trans_pad = [
+            (kh[i] - 1 - pad[i][0], kh[i] - 1 - pad[i][1] + out_pad[i])
+            for i in range(n)]
+        # grouped transpose conv: weight [in_c, out_c/g, kh, kw]
+        w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups == 1:
+            w_t = jnp.transpose(w_flip, (1, 0, 2, 3))  # -> [out_c, in_c, kh, kw]
+            dn = lax.conv_dimension_numbers(a_nchw.shape, w_t.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+            out = lax.conv_general_dilated(
+                a_nchw, w_t, window_strides=(1, 1), padding=trans_pad,
+                lhs_dilation=strides, rhs_dilation=dilations,
+                dimension_numbers=dn)
+        else:
+            ic = a_nchw.shape[1]
+            icg = ic // groups
+            outs = []
+            for g in range(groups):
+                wg = w_flip[g * icg:(g + 1) * icg]
+                wg_t = jnp.transpose(wg, (1, 0, 2, 3))
+                dn = lax.conv_dimension_numbers(
+                    (a_nchw.shape[0], icg) + a_nchw.shape[2:], wg_t.shape,
+                    ("NCHW", "OIHW", "NCHW"))
+                outs.append(lax.conv_general_dilated(
+                    a_nchw[:, g * icg:(g + 1) * icg], wg_t, window_strides=(1, 1),
+                    padding=trans_pad, lhs_dilation=strides,
+                    rhs_dilation=dilations, dimension_numbers=dn))
+            out = jnp.concatenate(outs, axis=1)
+        if maybe_b:
+            out = out + maybe_b[0].reshape(1, -1, 1, 1)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(f, args, name="conv2d_transpose")
+
+
+def _pool(x, ksize, strides, padding, data_format, reducer, init, name,
+          ceil_mode=False, count_include_pad=True, average=False):
+    n = 2
+    ksize = _norm_tuple(ksize, n)
+    strides = _norm_tuple(strides or ksize, n)
+
+    def f(a):
+        if data_format == "NCHW":
+            dims = (1, 1) + ksize
+            strd = (1, 1) + strides
+        else:
+            dims = (1,) + ksize + (1,)
+            strd = (1,) + strides + (1,)
+        pad = _conv_padding(padding, n, strides, (1, 1), ksize)
+        if isinstance(pad, str):
+            pad_cfg = pad
+        else:
+            if data_format == "NCHW":
+                pad_cfg = [(0, 0), (0, 0)] + list(pad)
+            else:
+                pad_cfg = [(0, 0)] + list(pad) + [(0, 0)]
+        out = lax.reduce_window(a, init(a.dtype), reducer, dims, strd, pad_cfg)
+        if average:
+            if count_include_pad or (isinstance(pad, str) and pad == "VALID"):
+                denom = np.prod(ksize)
+                out = out / denom
+            else:
+                onesw = lax.reduce_window(jnp.ones_like(a), 0.0, lax.add, dims,
+                                          strd, pad_cfg)
+                out = out / onesw
+        return out
+
+    return apply(f, (x,), name=name)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, data_format, lax.max,
+                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                 else jnp.iinfo(dt).min,
+                 "max_pool2d", ceil_mode=ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               count_include_pad=True, divisor_override=None,
+               data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, data_format, lax.add,
+                 lambda dt: jnp.zeros([], dt).item() if False else 0.0,
+                 "avg_pool2d", ceil_mode=ceil_mode,
+                 count_include_pad=count_include_pad, average=True)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _norm_tuple(output_size, 2)
+
+    def f(a):
+        if data_format == "NCHW":
+            h_axis, w_axis = 2, 3
+        else:
+            h_axis, w_axis = 1, 2
+        ih, iw = a.shape[h_axis], a.shape[w_axis]
+        oh, ow = out_hw
+        if ih % oh == 0 and iw % ow == 0:
+            # reshape-mean fast path
+            if data_format == "NCHW":
+                r = a.reshape(a.shape[0], a.shape[1], oh, ih // oh, ow, iw // ow)
+                return r.mean(axis=(3, 5))
+            r = a.reshape(a.shape[0], oh, ih // oh, ow, iw // ow, a.shape[-1])
+            return r.mean(axis=(2, 4))
+        # general: per-output-bin mean via cumsum trick is overkill; use resize
+        raise NotImplementedError(
+            "adaptive pooling with non-divisible sizes not supported")
+
+    return apply(f, (x,), name="adaptive_avg_pool2d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_hw = _norm_tuple(output_size, 2)
+
+    def f(a):
+        ih, iw = a.shape[2], a.shape[3]
+        oh, ow = out_hw
+        if ih % oh == 0 and iw % ow == 0:
+            r = a.reshape(a.shape[0], a.shape[1], oh, ih // oh, ow, iw // ow)
+            return r.max(axis=(3, 5))
+        raise NotImplementedError
+    return apply(f, (x,), name="adaptive_max_pool2d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, name=None):
+    t = x.unsqueeze(-1) if isinstance(x, Tensor) else Tensor(x)
+    out = max_pool2d(t, (int(kernel_size) if isinstance(kernel_size, int)
+                         else kernel_size[0], 1),
+                     (int(stride) if isinstance(stride, (int, type(None)))
+                      and stride else (stride[0] if stride else None), 1)
+                     if stride else None,
+                     padding=(padding if isinstance(padding, int) else padding[0],
+                              0))
+    return out.squeeze(-1)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               count_include_pad=True, name=None):
+    t = x.unsqueeze(-1)
+    out = avg_pool2d(t, (kernel_size if isinstance(kernel_size, int)
+                         else kernel_size[0], 1),
+                     (stride if isinstance(stride, int) else None, 1)
+                     if stride else None,
+                     padding=(padding if isinstance(padding, int) else padding[0],
+                              0), count_include_pad=count_include_pad)
+    return out.squeeze(-1)
+
+
+# ----------------------------------------------------------------- norm
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """ref operators/batch_norm_op.cc. Updates running stats in-place on the
+    Tensor objects (buffer mutation is captured by functional_call)."""
+    ch_axis = 1 if data_format in ("NCHW", "NCL", "NCDHW") else -1
+
+    a = as_array(x)
+    reduce_axes = tuple(i for i in range(a.ndim) if i != (ch_axis % a.ndim))
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        batch_mean = jnp.mean(a, axis=reduce_axes)
+        batch_var = jnp.var(a, axis=reduce_axes)
+        # update running stats (paddle: momentum * running + (1-m) * batch)
+        running_mean._data = (momentum * running_mean._data
+                              + (1 - momentum) * batch_mean)
+        running_var._data = (momentum * running_var._data
+                             + (1 - momentum) * batch_var)
+        mean_t = Tensor(batch_mean)
+        var_t = Tensor(batch_var)
+        # keep grad flow through batch stats: recompute inside f
+        def f(v, w_, b_):
+            m = jnp.mean(v, axis=reduce_axes, keepdims=True)
+            var = jnp.var(v, axis=reduce_axes, keepdims=True)
+            inv = lax.rsqrt(var + epsilon)
+            shape = [1] * v.ndim
+            shape[ch_axis] = v.shape[ch_axis]
+            out = (v - m) * inv
+            if w_ is not None:
+                out = out * w_.reshape(shape)
+            if b_ is not None:
+                out = out + b_.reshape(shape)
+            return out
+    else:
+        rm, rv = running_mean._data, running_var._data
+
+        def f(v, w_, b_):
+            shape = [1] * v.ndim
+            shape[ch_axis] = v.shape[ch_axis]
+            inv = lax.rsqrt(rv.reshape(shape) + epsilon)
+            out = (v - rm.reshape(shape)) * inv
+            if w_ is not None:
+                out = out * w_.reshape(shape)
+            if b_ is not None:
+                out = out + b_.reshape(shape)
+            return out
+
+    if weight is not None and bias is not None:
+        return apply(lambda v, w_, b_: f(v, w_, b_), (x, weight, bias),
+                     name="batch_norm")
+    return apply(lambda v: f(v, None, None), (x,), name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, numbers.Number):
+        normalized_shape = (normalized_shape,)
+    nd = len(tuple(normalized_shape))
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - nd, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * lax.rsqrt(v + epsilon)
+        if wb:
+            out = out * wb[0]
+            if len(wb) > 1:
+                out = out + wb[1]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(f, tuple(args), name="layer_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * lax.rsqrt(v + eps)
+        if wb:
+            shape = (1, -1) + (1,) * (a.ndim - 2)
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(f, tuple(args), name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        g = num_groups
+        r = a.reshape((n, g, c // g) + a.shape[2:])
+        axes = tuple(range(2, r.ndim))
+        m = jnp.mean(r, axis=axes, keepdims=True)
+        v = jnp.var(r, axis=axes, keepdims=True)
+        out = ((r - m) * lax.rsqrt(v + epsilon)).reshape(a.shape)
+        if wb:
+            shape = (1, c) + (1,) * (a.ndim - 2)
+            out = out * wb[0].reshape(shape)
+            if len(wb) > 1:
+                out = out + wb[1].reshape(shape)
+        return out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    return apply(f, tuple(args), name="group_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                                keepdims=True), 1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply(f, (x,), name="normalize")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        sq = jnp.square(a)
+        half = size // 2
+        pad_cfg = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (a.ndim - 2)
+        padded = jnp.pad(sq, pad_cfg)
+        window = sum(padded[:, i:i + a.shape[1]] for i in range(size))
+        return a / jnp.power(k + alpha * window, beta)
+    return apply(f, (x,), name="local_response_norm")
+
+
+# ----------------------------------------------------------------- losses
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    """ref operators/softmax_with_cross_entropy_op.cc — fused log_softmax + NLL."""
+    def f(logits, lab, *maybe_w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            per = -jnp.sum(lab * logp, axis=axis)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logp.ndim:  # [N,1] style labels
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            per = -jnp.take_along_axis(logp, safe[..., None], axis=axis)
+            per = jnp.squeeze(per, axis=axis)
+            if maybe_w:
+                w = jnp.take(maybe_w[0], safe)
+                per = per * w
+            per = jnp.where(valid, per, 0.0)
+            if reduction == "mean":
+                if maybe_w:
+                    w = jnp.take(maybe_w[0], safe)
+                    denom = jnp.sum(jnp.where(valid, w, 0.0))
+                else:
+                    denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+                return jnp.sum(per) / denom
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply(f, args, name="cross_entropy")
+
+
+softmax_with_cross_entropy = cross_entropy
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def f(logp, lab, *maybe_w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        per = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        if maybe_w:
+            per = per * jnp.take(maybe_w[0], safe)
+        per = jnp.where(valid, per, 0.0)
+        if reduction == "mean":
+            denom = (jnp.sum(jnp.take(maybe_w[0], safe) * valid) if maybe_w
+                     else jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0))
+            return jnp.sum(per) / denom
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply(f, args, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    def f(a, b):
+        d = jnp.square(a - b)
+        if reduction == "mean":
+            return jnp.mean(d)
+        if reduction == "sum":
+            return jnp.sum(d)
+        return d
+    return apply(f, (input, label), name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        if reduction == "mean":
+            return jnp.mean(d)
+        if reduction == "sum":
+            return jnp.sum(d)
+        return d
+    return apply(f, (input, label), name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        l = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        if reduction == "mean":
+            return jnp.mean(l)
+        if reduction == "sum":
+            return jnp.sum(l)
+        return l
+    return apply(f, (input, label), name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *maybe_w):
+        per = -(y * jnp.log(jnp.maximum(p, 1e-12))
+                + (1 - y) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+        if maybe_w:
+            per = per * maybe_w[0]
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply(f, args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, *rest):
+        i = 0
+        w = rest[i] if weight is not None else None
+        if weight is not None:
+            i += 1
+        pw = rest[i] if pos_weight is not None else None
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            per = per * log_w
+        if w is not None:
+            per = per * w
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply(f, tuple(args), name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, y):
+        per = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "batchmean":
+            return jnp.sum(per) / logp.shape[0]
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+    return apply(f, (input, label), name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        per = jnp.maximum(-y * (a - b) + margin, 0.0)
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+    return apply(f, (input, other, label), name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        per = jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0))
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+    return apply(f, (input, label), name="hinge_embedding_loss")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.maximum(jnp.linalg.norm(a, axis=axis)
+                          * jnp.linalg.norm(b, axis=axis), eps)
+        return num / den
+    return apply(f, (x1, x2), name="cosine_similarity")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), (input, label),
+                 name="square_error_cost")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *maybe_n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        per = a_t * jnp.power(1 - p_t, gamma) * ce
+        if maybe_n:
+            per = per / maybe_n[0]
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+    args = (logit, label) if normalizer is None else (logit, label, normalizer)
+    return apply(f, args, name="sigmoid_focal_loss")
+
+
+# ----------------------------------------------------------------- padding etc.
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def f(a):
+        p = [int(v) for v in pad]
+        if len(p) == 2 * a.ndim:
+            cfg = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # paddle: pad applies to last len(p)//2 spatial dims
+            # for NCHW 4-d input with 4 pads: [left,right,top,bottom] on W,H
+            n_spatial = len(p) // 2
+            cfg = [(0, 0)] * a.ndim
+            if data_format.startswith("NC"):
+                dims = list(range(a.ndim - n_spatial, a.ndim))
+            else:
+                dims = list(range(1, 1 + n_spatial))
+            # paddle order: innermost (last) dim first
+            for i, d in enumerate(reversed(dims)):
+                cfg[d] = (p[2 * i], p[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+    return apply(f, (x,), name="pad")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    p = _norm_tuple(paddings, 2)
+    d = _norm_tuple(dilations, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        patches = lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s,
+            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+            dimension_numbers=lax.conv_dimension_numbers(
+                a.shape, (c, c, k[0], k[1]), ("NCHW", "OIHW", "NCHW")))
+        # -> [N, C*kh*kw, L]
+        return patches.reshape(n, c * k[0] * k[1], -1)
+    return apply(f, (x,), name="unfold")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            spatial = (h, w)
+        else:
+            n, h, w, c = a.shape
+            spatial = (h, w)
+        if size is not None:
+            out_hw = tuple(int(v) for v in
+                           (size.tolist() if isinstance(size, Tensor) else size))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else (scale_factor, scale_factor)
+            out_hw = (int(spatial[0] * sf[0]), int(spatial[1] * sf[1]))
+        method = {"nearest": "nearest", "bilinear": "linear",
+                  "bicubic": "cubic", "area": "linear"}[mode]
+        if data_format == "NCHW":
+            shape = (n, c) + out_hw
+        else:
+            shape = (n,) + out_hw + (c,)
+        return jax.image.resize(a, shape, method=method)
+    return apply(f, (x,), name="interpolate")
+
+
+upsample = interpolate
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        oc = c // (r * r)
+        out = a.reshape(n, oc, r, r, h, w)
+        out = out.transpose(0, 1, 4, 2, 5, 3)
+        return out.reshape(n, oc, h * r, w * r)
+    return apply(f, (x,), name="pixel_shuffle")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        r = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([r[:, 1:, :fold], jnp.zeros_like(r[:, -1:, :fold])],
+                               axis=1)
+        right = jnp.concatenate([jnp.zeros_like(r[:, :1, fold:2 * fold]),
+                                 r[:, :-1, fold:2 * fold]], axis=1)
+        rest = r[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    return apply(f, (x,), name="temporal_shift")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = (g[..., 0] + 1) * (w - 1) / 2 if align_corners \
+            else ((g[..., 0] + 1) * w - 1) / 2
+        gy = (g[..., 1] + 1) * (h - 1) / 2 if align_corners \
+            else ((g[..., 1] + 1) * h - 1) / 2
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+
+        def sample(yy, xx):
+            yy_c = jnp.clip(yy, 0, h - 1)
+            xx_c = jnp.clip(xx, 0, w - 1)
+            v = a[jnp.arange(n)[:, None, None], :, yy_c, xx_c]  # [N,Hg,Wg,C]
+            if padding_mode == "zeros":
+                inb = ((yy >= 0) & (yy < h) & (xx >= 0) & (xx < w))[..., None]
+                v = jnp.where(inb, v, 0.0)
+            return v
+
+        wa = ((x1 - gx) * (y1 - gy))[..., None]
+        wb = ((x1 - gx) * (gy - y0))[..., None]
+        wc = ((gx - x0) * (y1 - gy))[..., None]
+        wd = ((gx - x0) * (gy - y0))[..., None]
+        out = (sample(y0, x0) * wa + sample(y1, x0) * wb
+               + sample(y0, x1) * wc + sample(y1, x1) * wd)
+        return out.transpose(0, 3, 1, 2)
+    return apply(f, (x, grid), name="grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def f(th):
+        n, _, h, w = [int(v) for v in (out_shape.tolist()
+                                       if isinstance(out_shape, Tensor)
+                                       else out_shape)]
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H,W,3]
+        return jnp.einsum("nij,hwj->nhwi", th, base)
+    return apply(f, (theta,), name="affine_grid")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(y, *maybe_p):
+        k = y.shape[-1]
+        if maybe_p:
+            return (1 - epsilon) * y + epsilon * maybe_p[0]
+        return (1 - epsilon) * y + epsilon / k
+    args = (label,) if prior_dist is None else (label, prior_dist)
+    return apply(f, args, name="label_smooth")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, y):
+        sim = jnp.matmul(a, p.T)
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        ce = jnp.mean(-jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1))
+                        + jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25
+        return ce + reg
+    return apply(f, (anchor, positive, labels), name="npair_loss")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        out = jnp.zeros(a.shape + (a.shape[-1],), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        return out.at[..., idx, idx].set(a)
+    return apply(f, (x,), name="diag_embed")
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    ml = int(maxlen) if maxlen is not None else int(np.asarray(
+        as_array(lengths)).max())
+
+    def f(l):
+        return (jnp.arange(ml)[None, :] < l[:, None]).astype(convert_dtype(dtype))
+    return apply(f, (lengths,), differentiable=False, name="sequence_mask")

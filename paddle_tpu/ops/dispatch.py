@@ -25,6 +25,36 @@ from ..framework.tape import GradNode
 # op-name -> python impl; consumed by the static-graph lowering (static/program.py)
 OP_REGISTRY = {}
 
+# AMP op lists (ref python/paddle/fluid/contrib/mixed_precision/fp16_lists.py):
+# white = compute-bound MXU ops run in low precision; black = numerically
+# sensitive ops kept f32. Everything else follows its inputs.
+AMP_WHITE_LIST = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "addmm", "flash_attention",
+}
+AMP_BLACK_LIST = {
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "exp", "log",
+    "log2", "log10", "log1p", "mean", "sum", "logsumexp", "layer_norm",
+    "batch_norm", "group_norm", "instance_norm", "norm", "cumsum", "prod",
+    "sigmoid_focal_loss", "bce_with_logits", "binary_cross_entropy", "erf",
+    "erfinv", "pow", "square", "std", "var", "kl_div",
+}
+
+
+def _amp_cast(arrays, name, amp):
+    import jax.numpy as jnp
+    low = amp["dtype"]
+    if name in AMP_WHITE_LIST:
+        return tuple(a.astype(low)
+                     if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                     for a in arrays)
+    if name in AMP_BLACK_LIST:
+        return tuple(a.astype(jnp.float32)
+                     if hasattr(a, "dtype") and a.dtype == low else a
+                     for a in arrays)
+    # gray ops: follow inputs (no cast)
+    return arrays
+
 
 def as_array(x):
     if isinstance(x, Tensor):
@@ -47,6 +77,9 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
     """Run op `fn(*arrays, **attrs)` on tensor inputs; record GradNode if needed."""
     attrs = attrs or {}
     arrays = tuple(as_array(t) for t in tensors)
+    amp = state.get_amp_state()
+    if amp is not None:
+        arrays = _amp_cast(arrays, name, amp)
     if attrs:
         f = functools.partial(fn, **attrs)
     else:

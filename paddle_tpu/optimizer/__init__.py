@@ -1,1 +1,4 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle_tpu.optimizer (ref python/paddle/optimizer/__init__.py)."""
+from . import lr
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
+                        Adagrad, Adadelta, RMSProp, Lamb, Lars)

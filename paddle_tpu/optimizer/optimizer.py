@@ -1,0 +1,441 @@
+"""Optimizer base + update rules (ref python/paddle/optimizer/optimizer.py and the
+device kernels in paddle/fluid/operators/optimizers/: sgd_op, momentum_op,
+adam_op, adamw, lamb_op, lars_momentum_op, rmsprop, adagrad, adadelta).
+
+Design: each optimizer defines a pure `_update(p, g, lr, *state) -> (new_p,
+*new_state)` rule. Eagerly, `step()` runs it through one fused XLA executable per
+(shape,dtype) bucket; functionally, `apply_gradients` maps it over a pytree inside
+a jit'd train step (the ParallelExecutor-analog hot path) with buffer donation so
+weights update in place on HBM.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, Parameter
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _state_names = ()          # per-param state slot names, e.g. ("moment",)
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        self._parameters = list(parameters) if parameters is not None else []
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, (float, int)) and weight_decay:
+            from ..regularizer import L2Decay
+            self._weight_decay = L2Decay(float(weight_decay))
+        else:
+            self._weight_decay = weight_decay
+        self._accumulators = {}    # id(param) -> dict(state_name -> jnp array)
+        self._global_step = 0
+        self.helper = None
+
+    # ------------------------------------------------------------------ lr
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # ------------------------------------------------------------------ state
+    def _ensure_state(self, p):
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self._init_state(p._data)
+        return self._accumulators[key]
+
+    def _init_state(self, arr):
+        return {name: jnp.zeros_like(arr) for name in self._state_names}
+
+    def _hyper(self):
+        """Scalar hyperparams passed to _update (beyond lr)."""
+        return ()
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ step
+    def step(self):
+        self._global_step += 1
+        params_grads = [(p, p.grad) for p in self._parameters
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        hyper = self._hyper()
+        update = _jitted_update(type(self))
+        for p, g in params_grads:
+            g_arr = g._data.astype(p._data.dtype)
+            if self._weight_decay is not None and \
+                    getattr(p, "regularizer", None) is None:
+                g_arr = self._weight_decay._append(p._data, g_arr)
+            elif getattr(p, "regularizer", None) is not None:
+                g_arr = p.regularizer._append(p._data, g_arr)
+            state = self._ensure_state(p)
+            plr = lr * getattr(p, "learning_rate", 1.0)
+            new_p, new_state = update(
+                p._data, g_arr, jnp.asarray(plr, jnp.float32), hyper,
+                tuple(state[n] for n in self._state_names),
+                jnp.asarray(self._global_step, jnp.int32))
+            p._data = new_p
+            for n, s in zip(self._state_names, new_state):
+                state[n] = s
+
+    minimize_called = False
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """ref optimizer.py minimize: backward + apply. Dygraph path."""
+        loss.backward()
+        self.step()
+        return [], []
+
+    def clear_grad(self):
+        for p in self._parameters:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # ------------------------------------------------------- functional path
+    def init_opt_state(self, params):
+        """params: dict name -> jnp array. Returns opt state pytree."""
+        return {
+            name: {sn: jnp.zeros_like(arr) for sn in self._state_names}
+            for name, arr in params.items()
+        }
+
+    def apply_gradients_fn(self):
+        """Returns a pure fn(params, grads, opt_state, lr, step) ->
+        (new_params, new_opt_state) usable under jit/pjit."""
+        hyper = self._hyper()
+        update = type(self)._update
+        clip = self._grad_clip
+        wd = self._weight_decay
+        state_names = self._state_names
+
+        def apply_fn(params, grads, opt_state, lr, step):
+            names = list(params.keys())
+            gs = [grads[n] for n in names]
+            if clip is not None:
+                gs = clip.apply_arrays(gs)
+            new_params, new_state = {}, {}
+            for n, g in zip(names, gs):
+                p = params[n]
+                if g is None:
+                    new_params[n] = p
+                    new_state[n] = opt_state[n]
+                    continue
+                g = g.astype(p.dtype)
+                if wd is not None:
+                    g = wd._append(p, g)
+                st = tuple(opt_state[n][sn] for sn in state_names)
+                np_, nst = update(p, g, lr, hyper, st, step)
+                new_params[n] = np_
+                new_state[n] = dict(zip(state_names, nst))
+            return new_params, new_state
+
+        return apply_fn
+
+    # ------------------------------------------------------------- save/load
+    def state_dict(self):
+        sd = {}
+        for i, p in enumerate(self._parameters):
+            key = p.name or f"param_{i}"
+            st = self._accumulators.get(id(p))
+            if st:
+                for n, arr in st.items():
+                    sd[f"{key}.{n}"] = Tensor(arr)
+        sd["global_step"] = self._global_step
+        if isinstance(self._lr, LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, sd):
+        self._global_step = int(sd.get("global_step", 0))
+        if "LR_Scheduler" in sd and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(sd["LR_Scheduler"])
+        for i, p in enumerate(self._parameters):
+            key = p.name or f"param_{i}"
+            st = self._ensure_state(p)
+            for n in self._state_names:
+                k = f"{key}.{n}"
+                if k in sd:
+                    v = sd[k]
+                    st[n] = jnp.asarray(v.numpy() if isinstance(v, Tensor)
+                                        else v)
+
+    set_dict = set_state_dict
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_update(cls):
+    """One compiled+donated executable per optimizer class; XLA caches per
+    shape/dtype (the OpKernel cache analog)."""
+    return jax.jit(cls._update, donate_argnums=(0,), static_argnums=())
+
+
+# --------------------------------------------------------------------- rules
+
+
+class SGD(Optimizer):
+    _state_names = ()
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        return p - lr.astype(p.dtype) * g, ()
+
+
+class Momentum(Optimizer):
+    _state_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = float(momentum)
+        self._use_nesterov = bool(use_nesterov)
+
+    def _hyper(self):
+        return (self._momentum, 1.0 if self._use_nesterov else 0.0)
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        mu, nesterov = hyper
+        (v,) = state
+        v_new = mu * v + g
+        delta = jnp.where(nesterov > 0.5, g + mu * v_new, v_new)
+        return p - lr.astype(p.dtype) * delta, (v_new,)
+
+
+class Adam(Optimizer):
+    _state_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _hyper(self):
+        return (self._beta1, self._beta2, self._epsilon)
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        b1, b2, eps = hyper
+        m, v = state
+        g32 = g.astype(jnp.float32)
+        m = b1 * m + (1 - b1) * g32
+        v = b2 * v + (1 - b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+        return p - upd.astype(p.dtype), (m, v)
+
+    def _init_state(self, arr):
+        # fp32 master moments even for bf16 params (multi-precision default)
+        return {n: jnp.zeros(arr.shape, jnp.float32)
+                for n in self._state_names}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref optimizers/adamw — decay applied to param
+    directly, not through grads)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        self._coeff = float(weight_decay) if isinstance(weight_decay,
+                                                        (int, float)) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _hyper(self):
+        return (self._beta1, self._beta2, self._epsilon, self._coeff)
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        b1, b2, eps, coeff = hyper
+        m, v = state
+        g32 = g.astype(jnp.float32)
+        m = b1 * m + (1 - b1) * g32
+        v = b2 * v + (1 - b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        upd = lr * (mhat / (jnp.sqrt(vhat) + eps) + coeff * p.astype(jnp.float32))
+        return p - upd.astype(p.dtype), (m, v)
+
+
+class Adamax(Optimizer):
+    _state_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _hyper(self):
+        return (self._beta1, self._beta2, self._epsilon)
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        b1, b2, eps = hyper
+        m, u = state
+        m = b1 * m + (1 - b1) * g
+        u = jnp.maximum(b2 * u, jnp.abs(g))
+        t = step.astype(jnp.float32)
+        lr_t = lr / (1 - b1 ** t)
+        return (p - (lr_t * m / (u + eps)).astype(p.dtype)), (m, u)
+
+
+class Adagrad(Optimizer):
+    _state_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_value = initial_accumulator_value
+
+    def _hyper(self):
+        return (self._epsilon,)
+
+    def _init_state(self, arr):
+        return {"moment": jnp.full(arr.shape, self._init_value, jnp.float32)}
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        (eps,) = hyper
+        (mom,) = state
+        mom = mom + jnp.square(g.astype(jnp.float32))
+        upd = lr * g.astype(jnp.float32) / (jnp.sqrt(mom) + eps)
+        return p - upd.astype(p.dtype), (mom,)
+
+
+class Adadelta(Optimizer):
+    _state_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _hyper(self):
+        return (self._epsilon, self._rho)
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        eps, rho = hyper
+        sq_g, sq_u = state
+        g32 = g.astype(jnp.float32)
+        sq_g = rho * sq_g + (1 - rho) * jnp.square(g32)
+        upd = jnp.sqrt(sq_u + eps) / jnp.sqrt(sq_g + eps) * g32
+        sq_u = rho * sq_u + (1 - rho) * jnp.square(upd)
+        return p - (lr * upd).astype(p.dtype), (sq_g, sq_u)
+
+
+class RMSProp(Optimizer):
+    _state_names = ("mean_square", "mean_grad", "momentum_acc")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _hyper(self):
+        return (self._rho, self._epsilon, self._momentum,
+                1.0 if self._centered else 0.0)
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        rho, eps, mom, centered = hyper
+        ms, mg, macc = state
+        g32 = g.astype(jnp.float32)
+        ms = rho * ms + (1 - rho) * jnp.square(g32)
+        mg = jnp.where(centered > 0.5, rho * mg + (1 - rho) * g32, mg)
+        denom = jnp.where(centered > 0.5, ms - jnp.square(mg), ms)
+        macc = mom * macc + lr * g32 / jnp.sqrt(denom + eps)
+        return p - macc.astype(p.dtype), (ms, mg, macc)
+
+
+class Lamb(Optimizer):
+    """ref optimizers/lamb_op.cc — layerwise-adaptive Adam for large batch."""
+    _state_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _hyper(self):
+        return (self._beta1, self._beta2, self._epsilon, self._lamb_wd)
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        b1, b2, eps, wd = hyper
+        m, v = state
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = b1 * m + (1 - b1) * g32
+        v = b2 * v + (1 - b2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - (lr * trust * r).astype(p.dtype), (m, v)
+
+
+class Lars(Momentum):
+    """LARS momentum (ref optimizers/lars_momentum_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None):
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+
+    def _hyper(self):
+        return (self._momentum, self._lars_coeff, self._lars_wd)
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        mu, coeff, wd = hyper
+        (v,) = state
+        p32, g32 = p.astype(jnp.float32), g.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            coeff * w_norm / (g_norm + wd * w_norm + 1e-12), 1.0)
+        v_new = mu * v + lr * local_lr * (g32 + wd * p32)
+        return p - v_new.astype(p.dtype), (v_new,)

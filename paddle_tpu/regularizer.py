@@ -1,0 +1,34 @@
+"""Weight-decay regularizers (ref python/paddle/fluid/regularizer.py L1Decay /
+L2Decay appended to gradients at optimize time)."""
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def _append(self, p, g):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def _append(self, p, g):
+        return g + self._coeff * p
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def _append(self, p, g):
+        return g + self._coeff * jnp.sign(p)
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"
+
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

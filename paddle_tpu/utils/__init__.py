@@ -1,1 +1,7 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle_tpu.utils (ref python/paddle/utils)."""
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

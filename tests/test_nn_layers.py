@@ -1,0 +1,155 @@
+"""Layer tests (ref test strategy: unittests/test_layers.py style checks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+class TestLayerBase:
+    def test_parameters_registration(self):
+        l = nn.Linear(4, 3)
+        assert len(l.parameters()) == 2
+        names = dict(l.named_parameters())
+        assert "weight" in names and "bias" in names
+        assert names["weight"].shape == [4, 3]
+
+    def test_sublayer_iteration(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        assert len(m.parameters()) == 4
+        assert len(m.sublayers()) == 3
+
+    def test_state_dict_roundtrip(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        sd = m.state_dict()
+        assert len(sd) == 4
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        m2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+        for (k1, v1), (k2, v2) in zip(m.state_dict().items(),
+                                      m2.state_dict().items()):
+            np.testing.assert_allclose(v1.numpy(), v2.numpy())
+
+    def test_train_eval_mode(self):
+        m = nn.Dropout(0.5)
+        x = pt.ones([100])
+        m.eval()
+        np.testing.assert_allclose(m(x).numpy(), 1.0)
+        m.train()
+        out = m(x).numpy()
+        assert (out == 0).any() and (out > 1.0).any()
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        l(pt.ones([1, 2]))
+        assert calls == [1]
+        h.remove()
+        l(pt.ones([1, 2]))
+        assert calls == [1]
+
+    def test_layerlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(ll.parameters()) == 8
+
+
+class TestLayers:
+    def test_linear(self):
+        l = nn.Linear(3, 5)
+        out = l(pt.ones([2, 3]))
+        assert out.shape == [2, 5]
+        expect = np.ones((2, 3)) @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expect, atol=1e-5)
+
+    def test_conv2d_shapes(self):
+        c = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        assert c(pt.ones([2, 3, 16, 16])).shape == [2, 8, 8, 8]
+        cg = nn.Conv2D(8, 8, 3, groups=4, padding=1)
+        assert cg(pt.ones([1, 8, 5, 5])).shape == [1, 8, 5, 5]
+
+    def test_conv2d_numeric(self):
+        import jax.numpy as jnp
+        c = nn.Conv2D(1, 1, 2, bias_attr=False)
+        c.weight.set_value(np.ones((1, 1, 2, 2), "f4"))
+        x = pt.to_tensor(np.arange(9, dtype="f4").reshape(1, 1, 3, 3))
+        out = c(x).numpy()[0, 0]
+        expect = np.array([[0+1+3+4, 1+2+4+5], [3+4+6+7, 4+5+7+8]], "f4")
+        np.testing.assert_allclose(out, expect)
+
+    def test_conv_transpose(self):
+        ct = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1)
+        out = ct(pt.ones([2, 4, 8, 8]))
+        assert out.shape == [2, 6, 15, 15]
+
+    def test_pools(self):
+        x = pt.to_tensor(np.arange(16, dtype="f4").reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2, 2)(x)
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = nn.AvgPool2D(2, 2)(x)
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5],
+                                                      [10.5, 12.5]])
+        aap = nn.AdaptiveAvgPool2D(1)(x)
+        np.testing.assert_allclose(aap.numpy()[0, 0], [[7.5]])
+
+    def test_batchnorm_stats(self):
+        bn = nn.BatchNorm2D(3, momentum=0.5)
+        x = pt.to_tensor(np.random.randn(8, 3, 4, 4).astype("f4") * 2 + 1)
+        bn.train()
+        out = bn(x)
+        # normalized output: ~0 mean, ~1 std per channel
+        o = out.numpy()
+        assert abs(o.mean()) < 1e-4 and abs(o.std() - 1) < 1e-2
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [8, 3, 4, 4]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = pt.randn([4, 8])
+        o = ln(x).numpy()
+        np.testing.assert_allclose(o.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(o.std(-1), 1, atol=1e-1)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(pt.to_tensor([[1, 0, 3]]))
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], 0.0)
+
+    def test_activations(self):
+        x = pt.to_tensor([-1.0, 0.0, 2.0])
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+        np.testing.assert_allclose(nn.LeakyReLU(0.1)(x).numpy(),
+                                   [-0.1, 0, 2], atol=1e-6)
+        assert nn.GELU()(x).numpy()[2] == pytest.approx(1.9545, abs=1e-3)
+        s = nn.Softmax()(pt.ones([2, 4])).numpy()
+        np.testing.assert_allclose(s, 0.25, atol=1e-6)
+
+    def test_losses(self):
+        logits = pt.to_tensor([[10.0, 0.0], [0.0, 10.0]])
+        labels = pt.to_tensor([0, 1])
+        ce = nn.CrossEntropyLoss()(logits, labels)
+        assert ce.item() < 1e-3
+        mse = nn.MSELoss()(pt.ones([3]), pt.zeros([3]))
+        assert mse.item() == pytest.approx(1.0)
+        bce = nn.BCEWithLogitsLoss()(pt.zeros([4]), pt.ones([4]))
+        assert bce.item() == pytest.approx(np.log(2), abs=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = pt.to_tensor([[1.0, 2.0], [3.0, 1.0]])
+        labels = pt.to_tensor([1, -100])
+        loss = nn.functional.cross_entropy(logits, labels, ignore_index=-100)
+        expect = -np.log(np.exp(2) / (np.exp(1) + np.exp(2)))
+        assert loss.item() == pytest.approx(expect, abs=1e-5)
+
+    def test_grad_clip_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        p = pt.framework.Parameter(np.zeros(4, "f4"))
+        g = pt.to_tensor(np.full(4, 10.0, "f4"))
+        (pn, gn), = clip([(p, g)])
+        assert np.linalg.norm(gn.numpy()) == pytest.approx(1.0, abs=1e-4)

@@ -1,0 +1,257 @@
+"""End-to-end training tests — the MNIST LeNet smoke (BASELINE config 0) in
+both dygraph and compiled modes, optimizer correctness, save/load."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.jit import TrainStep
+
+
+def make_regression(n=128, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, 1).astype("f4")
+    x = rng.randn(n, d).astype("f4")
+    y = x @ w + 0.01 * rng.randn(n, 1).astype("f4")
+    return x, y
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("opt_cls,kwargs", [
+        (pt.optimizer.SGD, {}),
+        (pt.optimizer.Momentum, {"momentum": 0.9}),
+        (pt.optimizer.Adam, {}),
+        (pt.optimizer.AdamW, {"weight_decay": 0.01}),
+        (pt.optimizer.RMSProp, {}),
+        (pt.optimizer.Adagrad, {}),
+        (pt.optimizer.Lamb, {}),
+    ])
+    def test_optimizer_reduces_loss(self, opt_cls, kwargs):
+        x, y = make_regression()
+        model = nn.Linear(8, 1)
+        lr = 0.1 if opt_cls in (pt.optimizer.SGD, pt.optimizer.Momentum) \
+            else 0.05
+        opt = opt_cls(learning_rate=lr, parameters=model.parameters(),
+                      **kwargs)
+        xt, yt = pt.to_tensor(x), pt.to_tensor(y)
+        first = None
+        for i in range(60):
+            loss = nn.functional.mse_loss(model(xt), yt)
+            if first is None:
+                first = loss.item()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert loss.item() < first * 0.5, f"{opt_cls.__name__} not learning"
+
+    def test_adam_matches_reference_formula(self):
+        p = pt.framework.Parameter(np.array([1.0], "f4"))
+        opt = pt.optimizer.Adam(learning_rate=0.1, parameters=[p])
+        p.grad = pt.to_tensor([0.5])
+        opt.step()
+        # manual adam step 1
+        m = 0.1 * 0.5
+        v = 0.001 * 0.25
+        mh, vh = m / 0.1, v / 0.001
+        expect = 1.0 - 0.1 * mh / (np.sqrt(vh) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), [expect], rtol=1e-5)
+
+    def test_lr_scheduler_integration(self):
+        sched = pt.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                          gamma=0.1)
+        opt = pt.optimizer.SGD(learning_rate=sched, parameters=[])
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step(); sched.step()
+        assert opt.get_lr() == pytest.approx(0.01)
+
+    def test_weight_decay_regularizer(self):
+        p = pt.framework.Parameter(np.array([1.0], "f4"))
+        opt = pt.optimizer.SGD(learning_rate=0.1, parameters=[p],
+                               weight_decay=0.5)
+        p.grad = pt.to_tensor([0.0])
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-6)
+
+
+class TestTrainStep:
+    def test_compiled_matches_eager(self):
+        """Compiled whole-step must track the eager path numerically."""
+        x, y = make_regression(64, 4)
+        pt.seed(7)
+        m1 = nn.Linear(4, 1)
+        m2 = nn.Linear(4, 1)
+        m2.set_state_dict({k: v.numpy() for k, v in m1.state_dict().items()})
+        o1 = pt.optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+        o2 = pt.optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+        step = TrainStep(m2, nn.functional.mse_loss, o2)
+        xt, yt = pt.to_tensor(x), pt.to_tensor(y)
+        for i in range(5):
+            loss_e = nn.functional.mse_loss(m1(xt), yt)
+            loss_e.backward()
+            o1.step(); o1.clear_grad()
+            loss_c = step(xt, yt)
+            np.testing.assert_allclose(loss_e.item(), float(loss_c.numpy()),
+                                       rtol=1e-4)
+        step.sync()
+        np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lenet_mnist_convergence(self):
+        from paddle_tpu.vision.models import LeNet
+        from paddle_tpu.vision.datasets import MNIST
+        pt.seed(42)
+        model = LeNet()
+        opt = pt.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+        step = TrainStep(model, nn.CrossEntropyLoss(), opt)
+        loader = DataLoader(MNIST(mode="train"), batch_size=64, shuffle=True)
+        losses = []
+        for i, (x, y) in enumerate(loader):
+            losses.append(float(step(x, y).numpy()))
+            if i >= 30:
+                break
+        step.sync()
+        assert losses[-1] < losses[0] * 0.5
+        # accuracy check
+        model.eval()
+        x, y = next(iter(DataLoader(MNIST(mode="train"), batch_size=256)))
+        acc = (model(x).numpy().argmax(-1) == y.numpy()).mean()
+        assert acc > 0.6, f"acc {acc}"
+
+    def test_bn_buffers_update_under_jit(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+        opt = pt.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+
+        def loss_fn(out, y):
+            return nn.functional.mse_loss(out, y)
+
+        step = TrainStep(model, loss_fn, opt)
+        x = pt.to_tensor(np.random.randn(16, 4).astype("f4") * 3)
+        y = pt.to_tensor(np.random.randn(16, 8).astype("f4"))
+        step(x, y)
+        step.sync()
+        bn = model[1]
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+
+
+class TestSaveLoad:
+    def test_save_load_roundtrip(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        opt = pt.optimizer.Adam(parameters=m.parameters())
+        x = pt.randn([4, 4])
+        (m(x).sum()).backward()
+        opt.step()
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "model.pdparams")
+            pt.save(dict(m.state_dict()), path)
+            pt.save(opt.state_dict(), os.path.join(d, "opt.pdopt"))
+            m2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+            m2.set_state_dict(pt.load(path))
+            np.testing.assert_allclose(m[0].weight.numpy(),
+                                       m2[0].weight.numpy())
+            opt2 = pt.optimizer.Adam(parameters=m2.parameters())
+            opt2.set_state_dict(pt.load(os.path.join(d, "opt.pdopt")))
+            assert opt2._global_step == 1
+
+    def test_bf16_save_load(self):
+        t = pt.ones([3], dtype="bfloat16")
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "t.pd")
+            pt.save({"x": t}, p)
+            loaded = pt.load(p)["x"]
+            assert loaded.dtype == pt.bfloat16
+            np.testing.assert_allclose(
+                loaded.astype("float32").numpy(), 1.0)
+
+
+class TestDataLoader:
+    def test_batching(self):
+        ds = TensorDataset([np.arange(10, dtype="f4")[:, None],
+                            np.arange(10, dtype="i8")])
+        loader = DataLoader(ds, batch_size=3)
+        batches = list(loader)
+        assert len(batches) == 4
+        assert batches[0][0].shape == [3, 1]
+        assert batches[-1][0].shape == [1, 1]
+        loader2 = DataLoader(ds, batch_size=3, drop_last=True)
+        assert len(list(loader2)) == 3
+
+    def test_shuffle_workers(self):
+        ds = TensorDataset([np.arange(100, dtype="f4")])
+        loader = DataLoader(ds, batch_size=10, shuffle=True, num_workers=2)
+        vals = np.concatenate([b[0].numpy() for b in loader])
+        assert sorted(vals.tolist()) == list(range(100))
+        assert not np.array_equal(vals, np.arange(100))
+
+    def test_iterable_dataset(self):
+        from paddle_tpu.io import IterableDataset
+
+        class Gen(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32(i)
+
+        loader = DataLoader(Gen(), batch_size=2)
+        batches = list(loader)
+        assert len(batches) == 4
+
+
+class TestAmp:
+    def test_autocast_matmul_bf16(self):
+        with pt.amp.auto_cast():
+            out = pt.matmul(pt.ones([4, 4]), pt.ones([4, 4]))
+        assert out.dtype == pt.bfloat16
+        # black list op stays f32
+        with pt.amp.auto_cast():
+            s = pt.nn.functional.softmax(pt.ones([2, 2], dtype="bfloat16"))
+        assert s.dtype == pt.float32
+
+    def test_grad_scaler_state_machine(self):
+        scaler = pt.amp.GradScaler(init_loss_scaling=4.0,
+                                   incr_every_n_steps=1,
+                                   decr_every_n_nan_or_inf=1)
+        p = pt.framework.Parameter(np.zeros(2, "f4"))
+        opt = pt.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        loss = pt.to_tensor([1.0], stop_gradient=False)
+        # finite grads: step happens, scale doubles
+        p.grad = pt.to_tensor([4.0, 4.0])
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), [-1.0, -1.0])
+        assert scaler.get_init_loss_scaling() == 8.0
+        # inf grads: step skipped, scale halves
+        p.grad = pt.to_tensor([np.inf, 1.0])
+        before = p.numpy().copy()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), before)
+        assert scaler.get_init_loss_scaling() == 4.0
+
+
+class TestHapi:
+    def test_model_fit_evaluate(self):
+        from paddle_tpu.vision.datasets import _SyntheticImageDataset
+        ds = _SyntheticImageDataset(256, (1, 8, 8), 4)
+        net = nn.Sequential(nn.Flatten(), nn.Linear(64, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        model = pt.Model(net)
+        model.prepare(
+            optimizer=pt.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=pt.metric.Accuracy())
+        hist = model.fit(ds, epochs=2, batch_size=32, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+        logs = model.evaluate(ds, batch_size=64, verbose=0)
+        assert logs["acc"] > 0.5
+        preds = model.predict(ds, batch_size=64, stack_outputs=True)
+        assert preds[0].shape == (256, 4)
+
+    def test_summary(self):
+        info = pt.summary(nn.Linear(4, 2))
+        assert info["total_params"] == 10
