@@ -1,0 +1,81 @@
+"""Benchmark: GPT-style decoder-LM training throughput on the local chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+vs_baseline is measured against a fixed roofline-style reference number
+(see BASELINE.md — the reference repo publishes no numbers; we report
+model-FLOPs utilisation-normalised throughput so rounds are comparable).
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+    from paddle_tpu.nlp.gpt import gpt_pretrain_loss
+    from paddle_tpu.jit import TrainStep
+
+    pt.seed(0)
+    on_tpu = jax.default_backend() != "cpu"
+    # sized to fit one v5e chip comfortably in bf16
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024, dropout=0.0,
+                        attn_dropout=0.0)
+        batch, seq, iters = 8, 1024, 20
+    else:  # CI smoke
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128, dropout=0.0,
+                        attn_dropout=0.0)
+        batch, seq, iters = 2, 128, 3
+
+    model = GPTForPretraining(cfg)
+    if on_tpu:
+        model.to(dtype=jnp.bfloat16)  # bf16 params: MXU-native
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters())
+    step = TrainStep(model, gpt_pretrain_loss, opt)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32")
+
+    # warmup/compile
+    loss = step(ids, ids)
+    float(loss.numpy())
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    final = float(loss.numpy())
+    dt = (time.perf_counter() - t0) / iters
+    assert np.isfinite(final), "non-finite loss in bench"
+
+    tokens_per_sec = batch * seq / dt
+
+    # model FLOPs per token (fwd+bwd ~ 6 * params for transformer)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_tok = 6 * n_params
+    tflops = tokens_per_sec * flops_per_tok / 1e12
+
+    # baseline anchor: BASELINE.json publishes no reference numbers; anchor
+    # against v5e-chip peak (197 bf16 TFLOP/s) => value is MFU-normalised.
+    peak = 197.0 if on_tpu else 1.0
+    mfu = tflops / peak
+
+    print(json.dumps({
+        "metric": "gpt2s-1024ctx train tokens/sec/chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu, 4),
+        "detail": {"step_ms": round(dt * 1e3, 2), "loss": round(final, 3),
+                   "model_tflops": round(tflops, 2), "params": n_params,
+                   "backend": jax.default_backend()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

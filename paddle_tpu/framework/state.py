@@ -132,23 +132,34 @@ def get_default_dtype():
 # --------------------------------------------------------------------------- RNG
 
 
+def host_device():
+    """The host CPU jax device — cheap bookkeeping (PRNG splits, init) runs
+    here; on tunneled TPUs every eager dispatch is a network round-trip."""
+    return jax.devices("cpu")[0]
+
+
 class Generator:
     """Split-on-demand PRNG chain (ref framework/generator.h:93 kept functional:
-    every draw advances the chain by splitting, so eager ops stay reproducible)."""
+    every draw advances the chain by splitting, so eager ops stay reproducible).
+    Key management happens on host CPU — a split is 8 bytes of work and must
+    not pay a device round-trip."""
 
     def __init__(self, seed=0):
         self._seed = seed
-        self._key = jax.random.PRNGKey(seed)
         self._lock = threading.Lock()
+        with jax.default_device(host_device()):
+            self._key = jax.random.PRNGKey(seed)
 
     def manual_seed(self, seed):
         self._seed = seed
-        self._key = jax.random.PRNGKey(seed)
+        with jax.default_device(host_device()):
+            self._key = jax.random.PRNGKey(seed)
         return self
 
     def next_key(self):
         with self._lock:
-            self._key, sub = jax.random.split(self._key)
+            with jax.default_device(host_device()):
+                self._key, sub = jax.random.split(self._key)
             return sub
 
     @property

@@ -1,0 +1,59 @@
+"""Recompute / activation checkpointing (ref fluid/optimizer.py:4549
+RecomputeOptimizer + meta_optimizers/recompute_optimizer.py).
+
+TPU-native: jax.checkpoint (remat) on the segment — XLA re-executes the
+forward inside the backward, trading FLOPs for HBM exactly like the reference's
+recompute pass but without program rewriting. Closed-over parameters are
+treated as saved residuals (weights kept, activations recomputed).
+Eager mode runs the segment normally (the tape stores residuals; eager
+recompute is a memory no-op under PJRT).
+"""
+import jax
+
+from ..framework import state
+from ..framework.tensor import Tensor
+
+
+def recompute(function, *args, preserve_rng_state=True, **kwargs):
+    if not state.is_functional_mode():
+        return function(*args, **kwargs)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    arrays = [t._data for t in tensor_args]
+
+    def pure(*arrs):
+        it = iter(arrs)
+        rebuilt = [Tensor(next(it)) if isinstance(a, Tensor) else a
+                   for a in args]
+        out = function(*rebuilt, **kwargs)
+        if isinstance(out, Tensor):
+            return out._data
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out
+
+    out = jax.checkpoint(pure)(*arrays)
+    if isinstance(out, tuple):
+        return tuple(Tensor(o, stop_gradient=False) for o in out)
+    return Tensor(out, stop_gradient=False)
+
+
+def recompute_sequential(functions, x, segments=1):
+    """Checkpoint a Sequential in `segments` chunks (ref recompute segment
+    semantics)."""
+    import numpy as np
+    layers = list(functions)
+    n = len(layers)
+    seg_size = max(1, n // max(segments, 1))
+    i = 0
+    while i < n:
+        chunk = layers[i:i + seg_size]
+
+        def seg_fn(inp, chunk=chunk):
+            for l in chunk:
+                inp = l(inp)
+            return inp
+
+        x = recompute(seg_fn, x)
+        i += seg_size
+    return x

@@ -1,0 +1,142 @@
+"""BERT encoder (BASELINE config 2: BERT-base pretraining, Fleet collective DP).
+
+Built on the nn.Transformer encoder stack (post-norm like the original BERT)
+with MLM + NSP pretraining heads; flash attention handles the padding mask
+via the additive-mask XLA path.
+"""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..distributed import mesh as mesh_mod
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_seq_len=512,
+                 type_vocab_size=2, dropout=0.1, attn_dropout=0.1,
+                 initializer_range=0.02, use_recompute=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_seq_len = max_seq_len
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.attn_dropout = attn_dropout
+        self.initializer_range = initializer_range
+        self.use_recompute = use_recompute
+
+
+def bert_base(**kw):
+    return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                      intermediate_size=3072, **kw)
+
+
+def bert_large(**kw):
+    return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                      intermediate_size=4096, **kw)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = nn.ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                            weight_attr=init)
+        self.position_embeddings = nn.Embedding(cfg.max_seq_len,
+                                                cfg.hidden_size,
+                                                weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size,
+                                                  weight_attr=init)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.word_embeddings.weight.sharding = P(mesh_mod.MP_AXIS, None)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        import paddle_tpu as pt
+        s = input_ids.shape[-1]
+        if position_ids is None:
+            position_ids = pt.arange(s, dtype="int32").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = pt.zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.transformer.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+            dropout=cfg.dropout, activation="gelu",
+            attn_dropout=cfg.attn_dropout, act_dropout=0.0,
+            weight_attr=nn.ParamAttr(
+                initializer=I.Normal(0.0, cfg.initializer_range)))
+        self.encoder = nn.transformer.TransformerEncoder(enc_layer,
+                                                         cfg.num_layers)
+        self.pooler_dense = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            # [B,S] 1/0 -> additive [B,1,1,S]
+            from ..ops.manipulation import cast, unsqueeze
+            m = cast(attention_mask, "float32")
+            mask = (1.0 - m.unsqueeze(1).unsqueeze(2)) * -1e9
+        seq_out = self.encoder(x, src_mask=mask)
+        pooled = F.tanh(self.pooler_dense(seq_out[:, 0]))
+        return seq_out, pooled
+
+
+class BertPretrainingHeads(nn.Layer):
+    def __init__(self, cfg: BertConfig, embedding_weights):
+        super().__init__()
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.decoder_weight = embedding_weights          # tied
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
+        self.seq_relationship = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output):
+        from ..ops.math import matmul
+        h = self.layer_norm(F.gelu(self.transform(sequence_output)))
+        mlm_logits = matmul(h, self.decoder_weight,
+                            transpose_y=True) + self.decoder_bias
+        nsp_logits = self.seq_relationship(pooled_output)
+        return mlm_logits, nsp_logits
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.cls = BertPretrainingHeads(
+            cfg, self.bert.embeddings.word_embeddings.weight)
+        self.cfg = cfg
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.cls(seq_out, pooled)
+
+
+def bert_pretrain_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels):
+    """MLM loss over non -100 positions + NSP loss."""
+    b, s, v = mlm_logits.shape
+    mlm = F.cross_entropy(mlm_logits.reshape([b * s, v]),
+                          mlm_labels.reshape([b * s]), ignore_index=-100)
+    nsp = F.cross_entropy(nsp_logits, nsp_labels)
+    return mlm + nsp

@@ -1,0 +1,188 @@
+"""GPT decoder-only LM (BASELINE config 3: GPT-2 medium with pipeline + tensor
+parallel + recompute).
+
+TPU-first design decisions:
+  - fused QKV projection (one MXU matmul instead of three)
+  - causal flash attention (Pallas kernel, ops/pallas)
+  - pre-norm blocks, gelu MLP
+  - every Linear weight carries a PartitionSpec hint so pjit shards
+    Megatron-style over the 'mp' axis with zero code changes
+    (attention QKV column-parallel, attn-out row-parallel; MLP in
+    column-parallel, MLP out row-parallel; embeddings vocab-parallel)
+  - layers are homogeneous -> pipeline engine can split evenly over 'pp'
+"""
+import math
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..distributed import mesh as mesh_mod
+from ..nn.transformer import scaled_dot_product_attention
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, ffn_hidden_size=None, max_seq_len=1024,
+                 dropout=0.1, attn_dropout=0.1, initializer_range=0.02,
+                 use_recompute=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.attn_dropout = attn_dropout
+        self.initializer_range = initializer_range
+        self.use_recompute = use_recompute
+
+
+def gpt2_small(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt2_medium(**kw):
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.qkv_proj = nn.Linear(h, 3 * h, weight_attr=nn.ParamAttr(
+            initializer=init))
+        self.out_proj = nn.Linear(h, h, weight_attr=nn.ParamAttr(
+            initializer=I.Normal(0.0, cfg.initializer_range
+                                 / math.sqrt(2 * cfg.num_layers))))
+        self.attn_dropout_p = cfg.attn_dropout
+        self.resid_dropout = nn.Dropout(cfg.dropout)
+        # Megatron shardings: QKV column-parallel, out row-parallel
+        self.qkv_proj.weight.sharding = P(None, mesh_mod.MP_AXIS)
+        self.qkv_proj.bias.sharding = P(mesh_mod.MP_AXIS)
+        self.out_proj.weight.sharding = P(mesh_mod.MP_AXIS, None)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)                       # [B,S,3H]
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        qkv = qkv.transpose([2, 0, 3, 1, 4])          # [3,B,Hd,S,D]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        out = scaled_dot_product_attention(
+            q, k, v, causal=True, dropout_p=self.attn_dropout_p,
+            training=self.training)
+        out = out.transpose([0, 2, 1, 3]).reshape([b, s, h])
+        return self.resid_dropout(self.out_proj(out))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.fc_in = nn.Linear(cfg.hidden_size, cfg.ffn_hidden_size,
+                               weight_attr=nn.ParamAttr(initializer=init))
+        self.fc_out = nn.Linear(cfg.ffn_hidden_size, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(
+                                    initializer=I.Normal(
+                                        0.0, cfg.initializer_range
+                                        / math.sqrt(2 * cfg.num_layers))))
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.fc_in.weight.sharding = P(None, mesh_mod.MP_AXIS)
+        self.fc_in.bias.sharding = P(mesh_mod.MP_AXIS)
+        self.fc_out.weight.sharding = P(mesh_mod.MP_AXIS, None)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x),
+                                               approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = nn.Embedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=init))
+        self.position_embeddings = nn.Embedding(
+            cfg.max_seq_len, cfg.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=init))
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.word_embeddings.weight.sharding = P(mesh_mod.MP_AXIS, None)
+
+    def forward(self, input_ids, position_ids=None):
+        import paddle_tpu as pt
+        if position_ids is None:
+            s = input_ids.shape[-1]
+            position_ids = pt.arange(s, dtype="int32").unsqueeze(0)
+        return self.dropout(self.word_embeddings(input_ids)
+                            + self.position_embeddings(position_ids))
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        use_remat = self.cfg.use_recompute
+        if use_remat:
+            from ..incubate.recompute import recompute
+            for blk in self.blocks:
+                x = recompute(blk, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForPretraining(nn.Layer):
+    """LM head tied to word embeddings (ref weight-tying convention)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+        self.cfg = cfg
+
+    def forward(self, input_ids, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        w = self.gpt.embeddings.word_embeddings.weight
+        from ..ops.math import matmul
+        logits = matmul(hidden, w, transpose_y=True)
+        return logits
+
+    def loss(self, logits, labels):
+        return gpt_pretrain_loss(logits, labels)
+
+
+def gpt_pretrain_loss(logits, labels):
+    shift_logits = logits[:, :-1, :]
+    shift_labels = labels[:, 1:]
+    b, s, v = shift_logits.shape
+    return F.cross_entropy(shift_logits.reshape([b * s, v]),
+                           shift_labels.reshape([b * s]))

@@ -26,3 +26,7 @@ from .loss import (CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss,
                    BCELoss, BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss,
                    HingeEmbeddingLoss)
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
+from . import transformer
+from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
+                          TransformerEncoder, TransformerDecoderLayer,
+                          TransformerDecoder, Transformer)

@@ -657,7 +657,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                 lab_i = jnp.squeeze(lab_i, axis=axis)
             valid = lab_i != ignore_index
             safe = jnp.where(valid, lab_i, 0)
-            per = -jnp.take_along_axis(logp, safe[..., None], axis=axis)
+            per = -jnp.take_along_axis(logp, jnp.expand_dims(safe, axis),
+                                       axis=axis)
             per = jnp.squeeze(per, axis=axis)
             if maybe_w:
                 w = jnp.take(maybe_w[0], safe)

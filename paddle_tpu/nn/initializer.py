@@ -27,7 +27,20 @@ def _fan_in_out(shape):
 
 
 class Initializer:
+    """Initialisation runs on host CPU: weight init is latency-bound
+    bookkeeping, not MXU work, and on tunneled TPUs each eager op is a network
+    round-trip. The arrays migrate to the accelerator on first real use
+    (jit input placement / device_put in the train-step compilers).
+
+    Subclasses implement `_generate(shape, dtype)`; `__call__` is the template
+    method that pins the computation to the host device."""
+
     def __call__(self, shape, dtype="float32"):
+        from ..framework.state import host_device
+        with jax.default_device(host_device()):
+            return self._generate(shape, dtype)
+
+    def _generate(self, shape, dtype):
         raise NotImplementedError
 
 
@@ -35,7 +48,7 @@ class Constant(Initializer):
     def __init__(self, value=0.0):
         self.value = value
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         return jnp.full(tuple(shape), self.value, convert_dtype(dtype))
 
 
@@ -43,7 +56,7 @@ class Uniform(Initializer):
     def __init__(self, low=-1.0, high=1.0):
         self.low, self.high = low, high
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         return jax.random.uniform(state.next_rng_key(), tuple(shape),
                                   convert_dtype(dtype), self.low, self.high)
 
@@ -52,7 +65,7 @@ class Normal(Initializer):
     def __init__(self, mean=0.0, std=1.0):
         self.mean, self.std = mean, std
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         return (jax.random.normal(state.next_rng_key(), tuple(shape),
                                   convert_dtype(dtype)) * self.std + self.mean)
 
@@ -61,7 +74,7 @@ class TruncatedNormal(Initializer):
     def __init__(self, mean=0.0, std=1.0):
         self.mean, self.std = mean, std
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         return (jax.random.truncated_normal(state.next_rng_key(), -2.0, 2.0,
                                             tuple(shape), convert_dtype(dtype))
                 * self.std + self.mean)
@@ -71,7 +84,7 @@ class XavierUniform(Initializer):
     def __init__(self, fan_in=None, fan_out=None, gain=1.0):
         self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         fi, fo = _fan_in_out(shape)
         fi = self._fan_in if self._fan_in is not None else fi
         fo = self._fan_out if self._fan_out is not None else fo
@@ -84,7 +97,7 @@ class XavierNormal(Initializer):
     def __init__(self, fan_in=None, fan_out=None, gain=1.0):
         self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         fi, fo = _fan_in_out(shape)
         fi = self._fan_in if self._fan_in is not None else fi
         fo = self._fan_out if self._fan_out is not None else fo
@@ -97,7 +110,7 @@ class KaimingUniform(Initializer):
     def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
         self._fan_in = fan_in
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         fi, _ = _fan_in_out(shape)
         fi = self._fan_in if self._fan_in is not None else fi
         limit = math.sqrt(6.0 / fi)
@@ -109,7 +122,7 @@ class KaimingNormal(Initializer):
     def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
         self._fan_in = fan_in
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         fi, _ = _fan_in_out(shape)
         fi = self._fan_in if self._fan_in is not None else fi
         std = math.sqrt(2.0 / fi)
@@ -124,7 +137,7 @@ class Assign(Initializer):
     def __init__(self, value):
         self.value = value
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         arr = np.asarray(self.value)
         return jnp.asarray(arr, convert_dtype(dtype)).reshape(tuple(shape))
 
@@ -133,7 +146,7 @@ class Orthogonal(Initializer):
     def __init__(self, gain=1.0):
         self.gain = gain
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         return jax.nn.initializers.orthogonal(scale=self.gain)(
             state.next_rng_key(), tuple(shape), convert_dtype(dtype))
 
@@ -142,7 +155,7 @@ class Dirac(Initializer):
     def __init__(self, groups=1):
         self.groups = groups
 
-    def __call__(self, shape, dtype="float32"):
+    def _generate(self, shape, dtype):
         out = np.zeros(tuple(shape), dtype=np.float32)
         oc, ic = shape[0], shape[1]
         centers = [s // 2 for s in shape[2:]]

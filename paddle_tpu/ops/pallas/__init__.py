@@ -1,0 +1,10 @@
+"""Pallas TPU kernels (the reference's hand-written fused CUDA kernels,
+ref paddle/fluid/operators/fused/{multihead_matmul_op.cu, fmha_ref.h} —
+rebuilt as Pallas kernels per /opt/skills/guides/pallas_guide.md).
+
+Currently: flash attention (forward Pallas kernel + XLA recompute backward via
+custom_vjp). Falls back to a fused XLA implementation when the shape/feature
+combination isn't kernel-friendly (attn-weight dropout, additive masks,
+tiny sequences) — both paths share semantics, so callers never branch.
+"""
+from .flash_attention import flash_attention, flash_attention_xla
