@@ -12,5 +12,6 @@ from .collective import (all_reduce, all_gather, broadcast, reduce, scatter,
                          barrier, send, recv, split, ReduceOp, new_group,
                          wait, reduce_scatter, alltoall)
 from .parallel import DataParallel
+from .ring_attention import ring_attention, ring_flash_attention
 from . import fleet
 from .spawn import spawn

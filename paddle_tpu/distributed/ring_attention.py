@@ -1,0 +1,136 @@
+"""Ring attention — sequence/context parallelism over the 'sp' mesh axis.
+
+NEW capability relative to the reference (SURVEY.md §5: Yelrose/Paddle has no
+sequence parallelism; its only long-sequence coping mechanisms are recompute +
+pipeline). TPU-native design (PAPERS.md Ring Attention, arXiv:2310.01889):
+
+  - Q, K, V are sharded along the sequence dim over the 'sp' axis.
+  - Each device keeps its Q shard resident and streams K/V shards around the
+    ICI ring with `lax.ppermute` inside `shard_map`; partial softmax outputs
+    are merged with (out, logsumexp) online-softmax statistics, so no device
+    ever materialises more than an (S/n x S/n) score block.
+  - The K/V rotation is expressed as a `lax.scan`, so XLA's latency-hiding
+    scheduler overlaps each ppermute with the next block's compute.
+  - Backward is plain autodiff through the scan with `jax.checkpoint` around
+    the per-block kernel: score blocks are recomputed, keeping the backward
+    memory at the same (S/n)^2 footprint.
+
+Communication rides the 'sp' ring only; composes freely with 'dp' (batch),
+'mp' (heads/hidden via GSPMD outside the shard_map), and 'pp'.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+
+def _block_attn(q, k, v, scale, causal, q_off, k_off):
+    """One attention block. q:[B,H,Sq,D], k/v:[B,H,Sk,D] ->
+    (normalised block output [B,H,Sq,D], logsumexp [B,H,Sq]).
+
+    q_off/k_off are the global sequence offsets of the shards (k_off is
+    traced — it depends on the ring step)."""
+    sq, sk = q.shape[-2], k.shape[-2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = q_off + jnp.arange(sq)[:, None]
+        ki = k_off + jnp.arange(sk)[None, :]
+        s = jnp.where(ki <= qi, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)   # fully-masked rows
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    o = o / jnp.maximum(l, 1e-30)
+    lse = jnp.where(l[..., 0] > 0,
+                    m_safe[..., 0] + jnp.log(jnp.maximum(l[..., 0], 1e-30)),
+                    -jnp.inf)
+    return o, lse
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Combine two partial softmax results by their logsumexp statistics."""
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.where(jnp.isfinite(lse1), jnp.exp(lse1 - lse), 0.0)
+    w2 = jnp.where(jnp.isfinite(lse2), jnp.exp(lse2 - lse), 0.0)
+    return o1 * w1[..., None] + o2 * w2[..., None], lse
+
+
+def ring_attention_shard(q, k, v, *, axis_name, causal, scale):
+    """Per-shard body (call inside shard_map). q/k/v: local [B,H,S/n,D]."""
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    s_loc = q.shape[-2]
+    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    q_off = me * s_loc
+    qf = q.astype(jnp.float32) if q.dtype != jnp.float32 else q
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    block = jax.checkpoint(
+        functools.partial(_block_attn, scale=sc, causal=causal, q_off=q_off))
+
+    def body(carry, t):
+        k_cur, v_cur, o, lse = carry
+        src = jnp.mod(me - t, n)                 # owner of the block we hold
+        o_b, lse_b = block(qf, k_cur, v_cur, k_off=src * s_loc)
+        o, lse = _merge(o, lse, o_b, lse_b)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, o, lse), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    (k, v, o, lse), _ = lax.scan(body, (k, v, o0, lse0), jnp.arange(n))
+    return o.astype(q.dtype)
+
+
+def ring_attention(q, k, v, causal=False, scale=None,
+                   axis_name=mesh_mod.SP_AXIS, mesh=None):
+    """Array-level ring attention over globally-shaped [B,H,S,D] arrays.
+
+    Shards the sequence dim over `axis_name` of the current mesh (and the
+    batch dim over 'dp' when present). Falls back to single-device flash
+    attention when the mesh has no (or a trivial) 'sp' axis."""
+    mesh = mesh or mesh_mod.get_mesh()
+    if (mesh is None or axis_name not in mesh.axis_names
+            or int(mesh.shape[axis_name]) == 1):
+        from ..ops.pallas.flash_attention import _flash_array
+        return _flash_array(q, k, v, causal=causal, scale=scale)
+    if q.shape[-2] % int(mesh.shape[axis_name]) != 0:
+        raise ValueError(
+            f"sequence length {q.shape[-2]} not divisible by sp="
+            f"{mesh.shape[axis_name]}")
+    batch_axis = mesh_mod.DP_AXIS if (
+        mesh_mod.DP_AXIS in mesh.axis_names
+        and q.shape[0] % int(mesh.shape[mesh_mod.DP_AXIS]) == 0) else None
+    # heads ride 'mp' (Megatron head-sharded QKV stays sharded through the
+    # ring — nothing in the shard body mixes heads)
+    head_axis = mesh_mod.MP_AXIS if (
+        mesh_mod.MP_AXIS in mesh.axis_names
+        and q.shape[1] % int(mesh.shape[mesh_mod.MP_AXIS]) == 0) else None
+    spec = P(batch_axis, head_axis, axis_name, None)
+    f = jax.shard_map(
+        functools.partial(ring_attention_shard, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return f(q, k, v)
+
+
+def ring_flash_attention(q, k, v, causal=False, scale=None,
+                         axis_name=mesh_mod.SP_AXIS, mesh=None):
+    """Tensor-level op (tape/functional integrated via the dispatcher)."""
+    from ..ops.dispatch import apply
+
+    def fn(q_, k_, v_):
+        return ring_attention(q_, k_, v_, causal=causal, scale=scale,
+                              axis_name=axis_name, mesh=mesh)
+
+    return apply(fn, (q, k, v), name="ring_flash_attention")

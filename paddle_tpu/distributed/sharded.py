@@ -82,7 +82,7 @@ class ShardedTrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, dp_axis=None,
-                 zero_stage=0, donate=True, remat=False):
+                 zero_stage=0, donate=True, remat=False, shard_seq=True):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -91,6 +91,7 @@ class ShardedTrainStep:
             mesh_mod.DP_AXIS if mesh_mod.DP_AXIS in self.mesh.axis_names
             else self.mesh.axis_names[0])
         self.zero_stage = zero_stage
+        self.shard_seq = shard_seq
 
         params, buffers = model.functional_state()
         named_params = dict(model.named_parameters())
@@ -169,12 +170,22 @@ class ShardedTrainStep:
 
     # ------------------------------------------------------------------ step
     def _shard_batch(self, arrs):
+        # dim 1 = sequence is a sequence-model convention; pass
+        # shard_seq=False for models where dim 1 isn't a sequence axis
+        sp = mesh_mod.SP_AXIS if (
+            self.shard_seq
+            and mesh_mod.SP_AXIS in self.mesh.axis_names) else None
         out = []
         for a in arrs:
             a = a._data if isinstance(a, Tensor) else jnp.asarray(a)
-            spec = P(self.dp_axis) if (
-                a.ndim >= 1 and a.shape[0] % self.mesh.shape[self.dp_axis]
-                == 0) else P()
+            parts = [None] * a.ndim
+            if a.ndim >= 1 and a.shape[0] % self.mesh.shape[self.dp_axis] == 0:
+                parts[0] = self.dp_axis
+            # sequence dim rides 'sp' (ring attention shards activations too)
+            if (sp and a.ndim >= 2
+                    and a.shape[1] % self.mesh.shape[sp] == 0):
+                parts[1] = sp
+            spec = P(*parts) if any(parts) else P()
             out.append(jax.device_put(a, NamedSharding(self.mesh, spec)))
         return tuple(out)
 

@@ -29,7 +29,7 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_hidden_size=None, max_seq_len=1024,
                  dropout=0.1, attn_dropout=0.1, initializer_range=0.02,
-                 use_recompute=False):
+                 use_recompute=False, sequence_parallel=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -40,6 +40,9 @@ class GPTConfig:
         self.attn_dropout = attn_dropout
         self.initializer_range = initializer_range
         self.use_recompute = use_recompute
+        # long-context: ring attention over the 'sp' mesh axis
+        # (distributed/ring_attention.py; new capability vs the reference)
+        self.sequence_parallel = sequence_parallel
 
 
 def gpt2_small(**kw):
@@ -63,6 +66,13 @@ class GPTAttention(nn.Layer):
             initializer=I.Normal(0.0, cfg.initializer_range
                                  / math.sqrt(2 * cfg.num_layers))))
         self.attn_dropout_p = cfg.attn_dropout
+        self.sequence_parallel = cfg.sequence_parallel
+        if cfg.sequence_parallel and cfg.attn_dropout:
+            import warnings
+            warnings.warn(
+                "sequence_parallel ring attention does not apply "
+                "attention-prob dropout; attn_dropout is ignored "
+                "(residual dropout still applies)")
         self.resid_dropout = nn.Dropout(cfg.dropout)
         # Megatron shardings: QKV column-parallel, out row-parallel
         self.qkv_proj.weight.sharding = P(None, mesh_mod.MP_AXIS)
@@ -75,9 +85,15 @@ class GPTAttention(nn.Layer):
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         qkv = qkv.transpose([2, 0, 3, 1, 4])          # [3,B,Hd,S,D]
         q, k, v = qkv[0], qkv[1], qkv[2]
-        out = scaled_dot_product_attention(
-            q, k, v, causal=True, dropout_p=self.attn_dropout_p,
-            training=self.training)
+        if self.sequence_parallel:
+            # ring attention over 'sp'; attention-prob dropout is skipped on
+            # this path (scores are never materialised globally)
+            from ..distributed.ring_attention import ring_flash_attention
+            out = ring_flash_attention(q, k, v, causal=True)
+        else:
+            out = scaled_dot_product_attention(
+                q, k, v, causal=True, dropout_p=self.attn_dropout_p,
+                training=self.training)
         out = out.transpose([0, 2, 1, 3]).reshape([b, s, h])
         return self.resid_dropout(self.out_proj(out))
 
