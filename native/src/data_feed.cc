@@ -1,0 +1,331 @@
+// paddle_tpu native data feed — C++ ingest pipeline.
+//
+// TPU-native equivalent of the reference's C++ data layer
+// (ref paddle/fluid/framework/data_feed.h:120 DataFeed /
+//  data_feed.h:664 MultiSlotDataFeed, data_set.h:157 DatasetImpl):
+// multi-slot text parsing, in-memory dataset with seeded shuffle, and a
+// bounded channel feeding batches assembled on a background thread.
+// Exposed through a C ABI consumed via ctypes (no pybind11 in the image).
+//
+// Design differences from the reference (this is not a port):
+//   - One contiguous arena per record (floats / int64s / per-slot counts)
+//     instead of per-slot MultiSlotType vectors — fewer allocations, cache
+//     friendly batch assembly.
+//   - Batches carry ragged slots as (values, lod-offsets) pairs, the dense
+//     formulation XLA needs (LoDTensor analog without the LoD class).
+//   - The epoch driver is a single assembler thread + bounded MPMC channel;
+//     consumers (Python) pop whole batches, so the GIL is never held while
+//     parsing or assembling.
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <memory>
+#include <mutex>
+#include <numeric>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace ptn {
+
+// ---------------------------------------------------------------- channel
+template <typename T>
+class Channel {  // bounded blocking MPMC queue (ref framework/channel.h idea)
+ public:
+  explicit Channel(size_t cap) : cap_(cap) {}
+
+  bool Put(T v) {
+    std::unique_lock<std::mutex> lk(mu_);
+    send_cv_.wait(lk, [&] { return closed_ || q_.size() < cap_; });
+    if (closed_) return false;
+    q_.push_back(std::move(v));
+    recv_cv_.notify_one();
+    return true;
+  }
+
+  bool Get(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    recv_cv_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    if (q_.empty()) return false;  // closed and drained
+    *out = std::move(q_.front());
+    q_.pop_front();
+    send_cv_.notify_one();
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    send_cv_.notify_all();
+    recv_cv_.notify_all();
+  }
+
+  void Reopen() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = false;
+    q_.clear();
+  }
+
+ private:
+  size_t cap_;
+  bool closed_ = false;
+  std::deque<T> q_;
+  std::mutex mu_;
+  std::condition_variable send_cv_, recv_cv_;
+};
+
+// ---------------------------------------------------------------- records
+struct Slot {
+  std::string name;
+  bool is_float;  // else uint64 feasign ids
+  int dense_dim;  // >0: fixed-length check at parse time; 0: ragged
+};
+
+struct Record {  // one sample: arena layout, values in slot order
+  std::vector<float> fvals;
+  std::vector<int64_t> ivals;
+  std::vector<uint32_t> counts;  // per slot, in schema order
+};
+
+struct SlotBatch {
+  std::vector<float> fvals;
+  std::vector<int64_t> ivals;
+  std::vector<int64_t> lod;  // batch_size + 1 offsets
+};
+
+struct Batch {
+  int size = 0;
+  std::vector<SlotBatch> slots;
+};
+
+// ---------------------------------------------------------------- dataset
+class Dataset {
+ public:
+  void AddSlot(const char* name, int is_float, int dense_dim) {
+    slots_.push_back({name, is_float != 0, dense_dim});
+  }
+
+  // Parse one multi-slot text file; returns #records or -1 on parse error.
+  long LoadFile(const char* path) {
+    std::ifstream in(path);
+    if (!in.is_open()) {
+      snprintf(err_, sizeof(err_), "cannot open %s", path);
+      return -1;
+    }
+    std::vector<Record> local;
+    std::string line;
+    long lineno = 0;
+    while (std::getline(in, line)) {
+      ++lineno;
+      if (line.empty()) continue;
+      Record rec;
+      rec.counts.reserve(slots_.size());
+      const char* p = line.c_str();
+      char* end = nullptr;
+      for (size_t s = 0; s < slots_.size(); ++s) {
+        long num = strtol(p, &end, 10);
+        if (end == p || num <= 0) {
+          snprintf(err_, sizeof(err_),
+                   "%s:%ld: slot %zu (%s) has invalid feasign count",
+                   path, lineno, s, slots_[s].name.c_str());
+          return -1;
+        }
+        if (slots_[s].dense_dim > 0 && num != slots_[s].dense_dim) {
+          snprintf(err_, sizeof(err_),
+                   "%s:%ld: dense slot %s expects %d values, got %ld",
+                   path, lineno, slots_[s].name.c_str(),
+                   slots_[s].dense_dim, num);
+          return -1;
+        }
+        p = end;
+        rec.counts.push_back(static_cast<uint32_t>(num));
+        if (slots_[s].is_float) {
+          for (long j = 0; j < num; ++j) {
+            rec.fvals.push_back(strtof(p, &end));
+            p = end;
+          }
+        } else {
+          for (long j = 0; j < num; ++j) {
+            rec.ivals.push_back(
+                static_cast<int64_t>(strtoull(p, &end, 10)));
+            p = end;
+          }
+        }
+      }
+      local.push_back(std::move(rec));
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& r : local) records_.push_back(std::move(r));
+    return static_cast<long>(local.size());
+  }
+
+  void Shuffle(uint64_t seed) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::mt19937_64 rng(seed);
+    std::shuffle(records_.begin(), records_.end(), rng);
+  }
+
+  long Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<long>(records_.size());
+  }
+
+  void Clear() {
+    Stop();
+    std::lock_guard<std::mutex> lk(mu_);
+    records_.clear();
+  }
+
+  // ---- epoch driving: background assembler -> channel -> Next()
+  void Start(int batch_size, int drop_last, int channel_cap) {
+    Stop();
+    chan_.reset(new Channel<std::unique_ptr<Batch>>(
+        channel_cap > 0 ? channel_cap : 8));
+    stop_.store(false);
+    worker_ = std::thread([this, batch_size, drop_last] {
+      AssembleLoop(batch_size, drop_last != 0);
+    });
+  }
+
+  // Pops the next batch; returns its size, 0 at epoch end.
+  int Next() {
+    if (!chan_) return 0;
+    std::unique_ptr<Batch> b;
+    if (!chan_->Get(&b)) return 0;
+    cur_ = std::move(b);
+    return cur_->size;
+  }
+
+  void Stop() {
+    stop_.store(true);
+    if (chan_) chan_->Close();
+    if (worker_.joinable()) worker_.join();
+    chan_.reset();
+    cur_.reset();
+  }
+
+  const Slot& slot(int i) const { return slots_[i]; }
+  int num_slots() const { return static_cast<int>(slots_.size()); }
+  Batch* current() { return cur_.get(); }
+  const char* error() const { return err_; }
+
+  ~Dataset() { Stop(); }
+
+ private:
+  void AssembleLoop(int batch_size, bool drop_last) {
+    size_t n;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      n = records_.size();
+    }
+    size_t i = 0;
+    while (i < n && !stop_.load()) {
+      size_t bs = std::min(static_cast<size_t>(batch_size), n - i);
+      if (bs < static_cast<size_t>(batch_size) && drop_last) break;
+      auto batch = std::unique_ptr<Batch>(new Batch);
+      batch->size = static_cast<int>(bs);
+      batch->slots.resize(slots_.size());
+      for (size_t s = 0; s < slots_.size(); ++s)
+        batch->slots[s].lod.push_back(0);
+      for (size_t r = i; r < i + bs; ++r) {
+        const Record& rec = records_[r];  // records_ frozen during epoch
+        size_t fo = 0, io = 0;
+        for (size_t s = 0; s < slots_.size(); ++s) {
+          uint32_t c = rec.counts[s];
+          SlotBatch& sb = batch->slots[s];
+          if (slots_[s].is_float) {
+            sb.fvals.insert(sb.fvals.end(), rec.fvals.begin() + fo,
+                            rec.fvals.begin() + fo + c);
+            fo += c;
+          } else {
+            sb.ivals.insert(sb.ivals.end(), rec.ivals.begin() + io,
+                            rec.ivals.begin() + io + c);
+            io += c;
+          }
+          sb.lod.push_back(sb.lod.back() + c);
+        }
+      }
+      i += bs;
+      if (!chan_->Put(std::move(batch))) return;  // closed
+    }
+    chan_->Close();
+  }
+
+  std::vector<Slot> slots_;
+  std::vector<Record> records_;
+  std::mutex mu_;
+  std::unique_ptr<Channel<std::unique_ptr<Batch>>> chan_;
+  std::thread worker_;
+  std::atomic<bool> stop_{false};
+  std::unique_ptr<Batch> cur_;
+  char err_[512] = {0};
+};
+
+}  // namespace ptn
+
+// ------------------------------------------------------------------ C ABI
+extern "C" {
+
+void* pt_feed_create() { return new ptn::Dataset(); }
+
+void pt_feed_destroy(void* h) { delete static_cast<ptn::Dataset*>(h); }
+
+void pt_feed_add_slot(void* h, const char* name, int is_float,
+                      int dense_dim) {
+  static_cast<ptn::Dataset*>(h)->AddSlot(name, is_float, dense_dim);
+}
+
+long pt_feed_load_file(void* h, const char* path) {
+  return static_cast<ptn::Dataset*>(h)->LoadFile(path);
+}
+
+const char* pt_feed_error(void* h) {
+  return static_cast<ptn::Dataset*>(h)->error();
+}
+
+void pt_feed_shuffle(void* h, unsigned long long seed) {
+  static_cast<ptn::Dataset*>(h)->Shuffle(seed);
+}
+
+long pt_feed_size(void* h) { return static_cast<ptn::Dataset*>(h)->Size(); }
+
+void pt_feed_clear(void* h) { static_cast<ptn::Dataset*>(h)->Clear(); }
+
+void pt_feed_start(void* h, int batch_size, int drop_last, int channel_cap) {
+  static_cast<ptn::Dataset*>(h)->Start(batch_size, drop_last, channel_cap);
+}
+
+int pt_feed_next(void* h) { return static_cast<ptn::Dataset*>(h)->Next(); }
+
+void pt_feed_stop(void* h) { static_cast<ptn::Dataset*>(h)->Stop(); }
+
+// Current-batch slot accessors. Pointers stay valid until the next
+// pt_feed_next / pt_feed_stop call.
+long pt_feed_slot_fvals(void* h, int slot, const float** out) {
+  ptn::Batch* b = static_cast<ptn::Dataset*>(h)->current();
+  if (!b) return -1;
+  *out = b->slots[slot].fvals.data();
+  return static_cast<long>(b->slots[slot].fvals.size());
+}
+
+long pt_feed_slot_ivals(void* h, int slot, const int64_t** out) {
+  ptn::Batch* b = static_cast<ptn::Dataset*>(h)->current();
+  if (!b) return -1;
+  *out = b->slots[slot].ivals.data();
+  return static_cast<long>(b->slots[slot].ivals.size());
+}
+
+long pt_feed_slot_lod(void* h, int slot, const int64_t** out) {
+  ptn::Batch* b = static_cast<ptn::Dataset*>(h)->current();
+  if (!b) return -1;
+  *out = b->slots[slot].lod.data();
+  return static_cast<long>(b->slots[slot].lod.size());
+}
+
+}  // extern "C"

@@ -382,3 +382,11 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+def __getattr__(name):
+    # native C++ feed classes load (and build) the shared lib on first use
+    if name in ("DatasetFactory", "InMemoryDataset", "QueueDataset"):
+        from . import dataset_native
+        return getattr(dataset_native, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,145 @@
+"""Dataset-driven ingest over the native C++ feed
+(ref python/paddle/fluid/dataset.py: DatasetFactory/InMemoryDataset/
+QueueDataset over framework/data_feed.h MultiSlotDataFeed + data_set.h
+DatasetImpl).
+
+The C++ side (native/src/data_feed.cc) parses multi-slot text, holds records
+in memory, shuffles with a seed, and assembles batches on a background thread
+behind a bounded channel. Python pops whole batches as numpy (ragged slots as
+(values, lod) pairs — the LoDTensor analog in dense XLA-friendly form).
+"""
+import ctypes
+
+import numpy as np
+
+from ..utils.native_build import load_native
+
+
+class _Slot:
+    def __init__(self, name, dtype="int64", dense_dim=0):
+        assert dtype in ("float32", "int64"), dtype
+        self.name = name
+        self.is_float = dtype == "float32"
+        self.dense_dim = int(dense_dim)
+
+
+class DatasetBase:
+    """Multi-slot dataset over the native feed."""
+
+    def __init__(self):
+        self._lib = load_native()
+        self._h = self._lib.pt_feed_create()
+        self._slots = []
+        self._batch_size = 1
+        self._drop_last = False
+        self._filelist = []
+        self._started = False
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pt_feed_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ config
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_use_var(self, slots):
+        """Declare slots, in on-disk order. Each entry: (name, dtype) or
+        (name, dtype, dense_dim) with dtype 'float32'|'int64'."""
+        assert not self._slots, "slots already set"
+        for s in slots:
+            slot = _Slot(*s) if isinstance(s, (tuple, list)) else _Slot(s)
+            self._slots.append(slot)
+            self._lib.pt_feed_add_slot(
+                self._h, slot.name.encode(), int(slot.is_float),
+                slot.dense_dim)
+
+    def set_filelist(self, files):
+        self._filelist = list(files)
+
+    # ------------------------------------------------------------ ingest
+    def load_into_memory(self):
+        for f in self._filelist:
+            n = self._lib.pt_feed_load_file(self._h, str(f).encode())
+            if n < 0:
+                raise ValueError(
+                    self._lib.pt_feed_error(self._h).decode() or
+                    f"failed to parse {f}")
+
+    def local_shuffle(self, seed=0):
+        self._lib.pt_feed_shuffle(self._h, int(seed))
+
+    def global_shuffle(self, fleet=None, seed=0):
+        # single-host: identical to local_shuffle; multi-host exchange is the
+        # PS runtime's job (ref data_set.h global shuffle via gloo)
+        self.local_shuffle(seed)
+
+    def get_memory_data_size(self):
+        return int(self._lib.pt_feed_size(self._h))
+
+    def release_memory(self):
+        self._lib.pt_feed_clear(self._h)
+
+    # ------------------------------------------------------------ batches
+    def _read_slot(self, i, bs):
+        slot = self._slots[i]
+        lp = ctypes.POINTER(ctypes.c_int64)()
+        n = self._lib.pt_feed_slot_lod(self._h, i, ctypes.byref(lp))
+        lod = np.ctypeslib.as_array(lp, shape=(n,)).copy()
+        if slot.is_float:
+            vp = ctypes.POINTER(ctypes.c_float)()
+            n = self._lib.pt_feed_slot_fvals(self._h, i, ctypes.byref(vp))
+            vals = (np.ctypeslib.as_array(vp, shape=(n,)).copy()
+                    if n else np.empty((0,), "f4"))
+        else:
+            vp = ctypes.POINTER(ctypes.c_int64)()
+            n = self._lib.pt_feed_slot_ivals(self._h, i, ctypes.byref(vp))
+            vals = (np.ctypeslib.as_array(vp, shape=(n,)).copy()
+                    if n else np.empty((0,), "i8"))
+        if slot.dense_dim > 0:
+            return vals.reshape(bs, slot.dense_dim)
+        return vals, lod
+
+    def __iter__(self):
+        """Yield dict name -> dense [bs, dim] array, or (values, lod) for
+        ragged slots."""
+        self._lib.pt_feed_start(self._h, self._batch_size,
+                                int(self._drop_last), 8)
+        try:
+            while True:
+                bs = self._lib.pt_feed_next(self._h)
+                if bs == 0:
+                    break
+                yield {s.name: self._read_slot(i, bs)
+                       for i, s in enumerate(self._slots)}
+        finally:
+            self._lib.pt_feed_stop(self._h)
+
+
+class InMemoryDataset(DatasetBase):
+    """ref fluid/dataset.py:329 InMemoryDataset."""
+
+
+class QueueDataset(DatasetBase):
+    """ref fluid/dataset.py QueueDataset — streaming; here load_into_memory
+    is implicit at iteration start if not done."""
+
+    def __iter__(self):
+        if self.get_memory_data_size() == 0:
+            self.load_into_memory()
+        return super().__iter__()
+
+
+class DatasetFactory:
+    """ref fluid/dataset.py:23."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class}")

@@ -1,0 +1,86 @@
+"""Lazy builder/loader for the native C++ runtime library
+(native/src/*.cc -> libptnative.so), the cpp_extension JIT-build analog
+(ref python/paddle/utils/cpp_extension/: compile-on-demand with caching).
+
+No pybind11 in the image — the library exposes a C ABI consumed via ctypes.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native"))
+
+
+def native_dir():
+    return _NATIVE_DIR
+
+
+def _needs_build(out, srcs):
+    if not os.path.exists(out):
+        return True
+    out_m = os.path.getmtime(out)
+    return any(os.path.getmtime(s) > out_m for s in srcs)
+
+
+def build_native(verbose=False):
+    """Compile native/src/*.cc into build/libptnative.so if stale."""
+    src_dir = os.path.join(_NATIVE_DIR, "src")
+    srcs = sorted(os.path.join(src_dir, f) for f in os.listdir(src_dir)
+                  if f.endswith(".cc"))
+    out = os.path.join(_NATIVE_DIR, "build", "libptnative.so")
+    if not _needs_build(out, srcs):
+        return out
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    cmd = [os.environ.get("CXX", "g++"), "-O2", "-fPIC", "-std=c++17",
+           "-Wall", "-pthread", "-shared", *srcs, "-o", out]
+    if verbose:
+        print("building native lib:", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"native build failed:\n{proc.stderr}\ncmd: {' '.join(cmd)}")
+    return out
+
+
+def load_native():
+    """Build (if needed) and dlopen the native library; cached."""
+    global _lib
+    with _lock:
+        if _lib is None:
+            path = build_native()
+            lib = ctypes.CDLL(path)
+            _configure(lib)
+            _lib = lib
+    return _lib
+
+
+def _configure(lib):
+    c = ctypes
+    lib.pt_feed_create.restype = c.c_void_p
+    lib.pt_feed_destroy.argtypes = [c.c_void_p]
+    lib.pt_feed_add_slot.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int]
+    lib.pt_feed_load_file.argtypes = [c.c_void_p, c.c_char_p]
+    lib.pt_feed_load_file.restype = c.c_long
+    lib.pt_feed_error.argtypes = [c.c_void_p]
+    lib.pt_feed_error.restype = c.c_char_p
+    lib.pt_feed_shuffle.argtypes = [c.c_void_p, c.c_ulonglong]
+    lib.pt_feed_size.argtypes = [c.c_void_p]
+    lib.pt_feed_size.restype = c.c_long
+    lib.pt_feed_clear.argtypes = [c.c_void_p]
+    lib.pt_feed_start.argtypes = [c.c_void_p, c.c_int, c.c_int, c.c_int]
+    lib.pt_feed_next.argtypes = [c.c_void_p]
+    lib.pt_feed_next.restype = c.c_int
+    lib.pt_feed_stop.argtypes = [c.c_void_p]
+    f32p = c.POINTER(c.c_float)
+    i64p = c.POINTER(c.c_int64)
+    lib.pt_feed_slot_fvals.argtypes = [c.c_void_p, c.c_int, c.POINTER(f32p)]
+    lib.pt_feed_slot_fvals.restype = c.c_long
+    lib.pt_feed_slot_ivals.argtypes = [c.c_void_p, c.c_int, c.POINTER(i64p)]
+    lib.pt_feed_slot_ivals.restype = c.c_long
+    lib.pt_feed_slot_lod.argtypes = [c.c_void_p, c.c_int, c.POINTER(i64p)]
+    lib.pt_feed_slot_lod.restype = c.c_long
