@@ -1,0 +1,551 @@
+// paddle_tpu native parameter server — C++ PS over TCP.
+//
+// TPU-native equivalent of the reference's "TheOnePS"
+// (ref paddle/fluid/distributed/service/brpc_ps_server.h PsServer,
+//  brpc_ps_client.h PsClient, table/common_dense_table.h,
+//  table/common_sparse_table.h, service/communicator.h async push):
+// dense tables with server-side SGD apply (async/Hogwild semantics),
+// sharded sparse embedding tables with deterministic per-id initialization,
+// worker barrier, table save/load. brpc is replaced by a dependency-free
+// length-prefixed TCP protocol (DCN in production rides the same sockets).
+//
+// Wire format (little-endian):
+//   request : [u8 op][u32 table][u64 count][u32 aux][payload]
+//   response: [u64 len][payload]   (len = payload bytes)
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace ptps {
+
+enum Op : uint8_t {
+  PULL_DENSE = 1,
+  PUSH_DENSE_GRAD = 2,   // server applies -lr * grad (async SGD)
+  PUSH_DENSE_DELTA = 3,  // server adds delta (geo-SGD)
+  PULL_SPARSE = 4,
+  PUSH_SPARSE_GRAD = 5,
+  BARRIER = 6,
+  SAVE = 7,
+  LOAD = 8,
+  STOP = 9,
+  SET_DENSE = 10,        // overwrite dense values (init/broadcast)
+};
+
+// ---------------------------------------------------------------- tables
+struct DenseTable {
+  std::vector<float> values;
+  float lr = 0.1f;
+  std::mutex mu;
+};
+
+// splitmix64 — deterministic per-id embedding init seed
+static inline uint64_t mix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+struct SparseShard {
+  std::unordered_map<int64_t, std::vector<float>> rows;
+  std::mutex mu;
+};
+
+struct SparseTable {
+  int dim = 8;
+  float lr = 0.1f;
+  float init_scale = 0.01f;  // rows init uniform(-scale, scale), id-seeded
+  static constexpr int kShards = 16;
+  SparseShard shards[kShards];
+
+  SparseShard& shard(int64_t id) {
+    return shards[mix64(static_cast<uint64_t>(id)) % kShards];
+  }
+
+  // row lookup with deterministic lazy init
+  std::vector<float>& Row(int64_t id) {
+    SparseShard& s = shard(id);
+    auto it = s.rows.find(id);
+    if (it != s.rows.end()) return it->second;
+    std::vector<float> row(dim);
+    uint64_t st = mix64(static_cast<uint64_t>(id) ^ 0x5bf03635ull);
+    for (int i = 0; i < dim; ++i) {
+      st = mix64(st);
+      // map to [-scale, scale)
+      row[i] = init_scale *
+               (2.0f * (st >> 11) * (1.0f / 9007199254740992.0f) - 1.0f);
+    }
+    return s.rows.emplace(id, std::move(row)).first->second;
+  }
+};
+
+// ---------------------------------------------------------------- server
+class PsServer {
+ public:
+  int Start(int port) {
+    lfd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd_ < 0) return -1;
+    int one = 1;
+    setsockopt(lfd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(lfd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return -1;
+    if (port == 0) {  // report kernel-chosen port
+      socklen_t len = sizeof(addr);
+      getsockname(lfd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    }
+    if (listen(lfd_, 64) < 0) return -1;
+    running_.store(true);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return ntohs(addr.sin_port);
+  }
+
+  void AddDenseTable(uint32_t id, int64_t size, float lr) {
+    auto t = std::make_unique<DenseTable>();
+    t->values.assign(size, 0.0f);
+    t->lr = lr;
+    std::lock_guard<std::mutex> lk(tables_mu_);
+    dense_[id] = std::move(t);
+  }
+
+  void AddSparseTable(uint32_t id, int dim, float lr, float init_scale) {
+    auto t = std::make_unique<SparseTable>();
+    t->dim = dim;
+    t->lr = lr;
+    t->init_scale = init_scale;
+    std::lock_guard<std::mutex> lk(tables_mu_);
+    sparse_[id] = std::move(t);
+  }
+
+  void Stop() {
+    if (!running_.exchange(false)) return;
+    shutdown(lfd_, SHUT_RDWR);
+    close(lfd_);
+    {  // release any waiters so conn threads can exit
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      barrier_gen_++;
+      barrier_cv_.notify_all();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    for (int fd : conn_fds_) shutdown(fd, SHUT_RDWR);  // wake blocked reads
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+    conn_threads_.clear();
+    conn_fds_.clear();
+  }
+
+  ~PsServer() { Stop(); }
+
+ private:
+  static bool ReadN(int fd, void* buf, size_t n) {
+    char* p = static_cast<char*>(buf);
+    while (n) {
+      ssize_t r = read(fd, p, n);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool WriteN(int fd, const void* buf, size_t n) {
+    const char* p = static_cast<const char*>(buf);
+    while (n) {
+      ssize_t r = write(fd, p, n);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool Reply(int fd, const void* payload, uint64_t n) {
+    if (!WriteN(fd, &n, 8)) return false;
+    return n == 0 || WriteN(fd, payload, n);
+  }
+
+  void AcceptLoop() {
+    while (running_.load()) {
+      int cfd = accept(lfd_, nullptr, nullptr);
+      if (cfd < 0) break;
+      int one = 1;
+      setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      conn_fds_.push_back(cfd);
+      conn_threads_.emplace_back([this, cfd] { Serve(cfd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (running_.load()) {
+      uint8_t op;
+      uint32_t table, aux;
+      uint64_t count;
+      if (!ReadN(fd, &op, 1) || !ReadN(fd, &table, 4) ||
+          !ReadN(fd, &count, 8) || !ReadN(fd, &aux, 4))
+        break;
+      if (!Dispatch(fd, op, table, count, aux)) break;
+      if (op == STOP) break;
+    }
+    close(fd);
+  }
+
+  bool Dispatch(int fd, uint8_t op, uint32_t table, uint64_t count,
+                uint32_t aux) {
+    switch (op) {
+      case PULL_DENSE: {
+        DenseTable* t = Dense(table);
+        if (!t) return false;
+        std::lock_guard<std::mutex> lk(t->mu);
+        return Reply(fd, t->values.data(), t->values.size() * 4);
+      }
+      case PUSH_DENSE_GRAD:
+      case PUSH_DENSE_DELTA:
+      case SET_DENSE: {
+        DenseTable* t = Dense(table);
+        std::vector<float> buf(count);
+        if (!ReadN(fd, buf.data(), count * 4) || !t ||
+            count != t->values.size())
+          return false;
+        {
+          std::lock_guard<std::mutex> lk(t->mu);
+          if (op == PUSH_DENSE_GRAD)
+            for (uint64_t i = 0; i < count; ++i)
+              t->values[i] -= t->lr * buf[i];
+          else if (op == PUSH_DENSE_DELTA)
+            for (uint64_t i = 0; i < count; ++i) t->values[i] += buf[i];
+          else
+            t->values = std::move(buf);
+        }
+        uint8_t ok = 1;
+        return Reply(fd, &ok, 1);
+      }
+      case PULL_SPARSE: {
+        SparseTable* t = Sparse(table);
+        std::vector<int64_t> ids(count);
+        if (!ReadN(fd, ids.data(), count * 8) || !t) return false;
+        std::vector<float> out(count * t->dim);
+        for (uint64_t i = 0; i < count; ++i) {
+          SparseShard& sh = t->shard(ids[i]);
+          std::lock_guard<std::mutex> lk(sh.mu);
+          const std::vector<float>& row = t->Row(ids[i]);
+          std::memcpy(&out[i * t->dim], row.data(), t->dim * 4);
+        }
+        return Reply(fd, out.data(), out.size() * 4);
+      }
+      case PUSH_SPARSE_GRAD: {
+        SparseTable* t = Sparse(table);
+        std::vector<int64_t> ids(count);
+        if (!ReadN(fd, ids.data(), count * 8) || !t) return false;
+        std::vector<float> grads(count * t->dim);
+        if (!ReadN(fd, grads.data(), grads.size() * 4)) return false;
+        for (uint64_t i = 0; i < count; ++i) {
+          SparseShard& sh = t->shard(ids[i]);
+          std::lock_guard<std::mutex> lk(sh.mu);
+          std::vector<float>& row = t->Row(ids[i]);
+          for (int d = 0; d < t->dim; ++d)
+            row[d] -= t->lr * grads[i * t->dim + d];
+        }
+        uint8_t ok = 1;
+        return Reply(fd, &ok, 1);
+      }
+      case BARRIER: {  // aux = world size
+        std::unique_lock<std::mutex> lk(barrier_mu_);
+        uint64_t gen = barrier_gen_;
+        if (++barrier_count_ >= aux) {
+          barrier_count_ = 0;
+          barrier_gen_++;
+          barrier_cv_.notify_all();
+        } else {
+          barrier_cv_.wait(lk, [&] {
+            return barrier_gen_ != gen || !running_.load();
+          });
+        }
+        uint8_t ok = 1;
+        return Reply(fd, &ok, 1);
+      }
+      case SAVE:
+      case LOAD: {
+        std::string path(count, '\0');
+        if (!ReadN(fd, path.data(), count)) return false;
+        uint8_t ok = (op == SAVE) ? SaveTable(table, path)
+                                  : LoadTable(table, path);
+        return Reply(fd, &ok, 1);
+      }
+      case STOP: {
+        uint8_t ok = 1;
+        Reply(fd, &ok, 1);
+        return true;
+      }
+      default:
+        return false;
+    }
+  }
+
+  bool SaveTable(uint32_t id, const std::string& path) {
+    std::ofstream out(path, std::ios::binary);
+    if (!out.is_open()) return false;
+    if (DenseTable* t = Dense(id)) {
+      std::lock_guard<std::mutex> lk(t->mu);
+      uint64_t n = t->values.size();
+      out.write(reinterpret_cast<const char*>(&n), 8);
+      out.write(reinterpret_cast<const char*>(t->values.data()), n * 4);
+      return true;
+    }
+    if (SparseTable* t = Sparse(id)) {
+      uint64_t total = 0;
+      for (auto& sh : t->shards) total += sh.rows.size();
+      uint64_t dim = static_cast<uint64_t>(t->dim);
+      out.write(reinterpret_cast<const char*>(&total), 8);
+      out.write(reinterpret_cast<const char*>(&dim), 8);
+      for (auto& sh : t->shards) {
+        std::lock_guard<std::mutex> lk(sh.mu);
+        for (auto& kv : sh.rows) {
+          out.write(reinterpret_cast<const char*>(&kv.first), 8);
+          out.write(reinterpret_cast<const char*>(kv.second.data()),
+                    t->dim * 4);
+        }
+      }
+      return true;
+    }
+    return false;
+  }
+
+  bool LoadTable(uint32_t id, const std::string& path) {
+    std::ifstream in(path, std::ios::binary);
+    if (!in.is_open()) return false;
+    if (DenseTable* t = Dense(id)) {
+      uint64_t n = 0;
+      in.read(reinterpret_cast<char*>(&n), 8);
+      std::lock_guard<std::mutex> lk(t->mu);
+      if (n != t->values.size()) return false;
+      in.read(reinterpret_cast<char*>(t->values.data()), n * 4);
+      return true;
+    }
+    if (SparseTable* t = Sparse(id)) {
+      uint64_t total = 0, dim = 0;
+      in.read(reinterpret_cast<char*>(&total), 8);
+      in.read(reinterpret_cast<char*>(&dim), 8);
+      if (dim != static_cast<uint64_t>(t->dim)) return false;
+      for (uint64_t i = 0; i < total; ++i) {
+        int64_t key;
+        std::vector<float> row(t->dim);
+        in.read(reinterpret_cast<char*>(&key), 8);
+        in.read(reinterpret_cast<char*>(row.data()), t->dim * 4);
+        SparseShard& sh = t->shard(key);
+        std::lock_guard<std::mutex> lk(sh.mu);
+        sh.rows[key] = std::move(row);
+      }
+      return true;
+    }
+    return false;
+  }
+
+  DenseTable* Dense(uint32_t id) {
+    std::lock_guard<std::mutex> lk(tables_mu_);
+    auto it = dense_.find(id);
+    return it == dense_.end() ? nullptr : it->second.get();
+  }
+
+  SparseTable* Sparse(uint32_t id) {
+    std::lock_guard<std::mutex> lk(tables_mu_);
+    auto it = sparse_.find(id);
+    return it == sparse_.end() ? nullptr : it->second.get();
+  }
+
+  int lfd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::vector<int> conn_fds_;
+  std::mutex tables_mu_;
+  std::unordered_map<uint32_t, std::unique_ptr<DenseTable>> dense_;
+  std::unordered_map<uint32_t, std::unique_ptr<SparseTable>> sparse_;
+  std::mutex barrier_mu_;
+  std::condition_variable barrier_cv_;
+  uint32_t barrier_count_ = 0;
+  uint64_t barrier_gen_ = 0;
+};
+
+// ---------------------------------------------------------------- client
+class PsClient {
+ public:
+  bool Connect(const char* host, int port) {
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host, &addr.sin_addr) <= 0) return false;
+    return connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                   sizeof(addr)) == 0;
+  }
+
+  ~PsClient() {
+    if (fd_ >= 0) close(fd_);
+  }
+
+  bool Request(uint8_t op, uint32_t table, uint64_t count, uint32_t aux,
+               const void* payload, size_t payload_n, std::vector<char>* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!WriteN(fd_, &op, 1) || !WriteN(fd_, &table, 4) ||
+        !WriteN(fd_, &count, 8) || !WriteN(fd_, &aux, 4))
+      return false;
+    if (payload_n && !WriteN(fd_, payload, payload_n)) return false;
+    uint64_t n = 0;
+    if (!ReadN(fd_, &n, 8)) return false;
+    out->resize(n);
+    return n == 0 || ReadN(fd_, out->data(), n);
+  }
+
+ private:
+  static bool ReadN(int fd, void* buf, size_t n) {
+    char* p = static_cast<char*>(buf);
+    while (n) {
+      ssize_t r = read(fd, p, n);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool WriteN(int fd, const void* buf, size_t n) {
+    const char* p = static_cast<const char*>(buf);
+    while (n) {
+      ssize_t r = write(fd, p, n);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace ptps
+
+// ------------------------------------------------------------------ C ABI
+extern "C" {
+
+void* pt_ps_server_create() { return new ptps::PsServer(); }
+
+void pt_ps_server_destroy(void* h) { delete static_cast<ptps::PsServer*>(h); }
+
+void pt_ps_add_dense_table(void* h, uint32_t id, int64_t size, float lr) {
+  static_cast<ptps::PsServer*>(h)->AddDenseTable(id, size, lr);
+}
+
+void pt_ps_add_sparse_table(void* h, uint32_t id, int dim, float lr,
+                            float init_scale) {
+  static_cast<ptps::PsServer*>(h)->AddSparseTable(id, dim, lr, init_scale);
+}
+
+// returns bound port (use port=0 for ephemeral), or -1
+int pt_ps_server_start(void* h, int port) {
+  return static_cast<ptps::PsServer*>(h)->Start(port);
+}
+
+void pt_ps_server_stop(void* h) { static_cast<ptps::PsServer*>(h)->Stop(); }
+
+void* pt_ps_client_create() { return new ptps::PsClient(); }
+
+void pt_ps_client_destroy(void* h) { delete static_cast<ptps::PsClient*>(h); }
+
+int pt_ps_client_connect(void* h, const char* host, int port) {
+  return static_cast<ptps::PsClient*>(h)->Connect(host, port) ? 0 : -1;
+}
+
+static thread_local std::vector<char> g_resp;
+
+int pt_ps_pull_dense(void* h, uint32_t table, float* out, int64_t n) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::PULL_DENSE, table, 0, 0,
+                                                nullptr, 0, &g_resp))
+    return -1;
+  if (g_resp.size() != static_cast<size_t>(n) * 4) return -1;
+  std::memcpy(out, g_resp.data(), g_resp.size());
+  return 0;
+}
+
+int pt_ps_push_dense(void* h, uint32_t table, const float* vals, int64_t n,
+                     int mode) {  // mode: 0=grad, 1=delta, 2=set
+  uint8_t op = mode == 0 ? ptps::PUSH_DENSE_GRAD
+                         : (mode == 1 ? ptps::PUSH_DENSE_DELTA
+                                      : ptps::SET_DENSE);
+  if (!static_cast<ptps::PsClient*>(h)->Request(op, table, n, 0, vals, n * 4,
+                                                &g_resp))
+    return -1;
+  return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+}
+
+int pt_ps_pull_sparse(void* h, uint32_t table, const int64_t* ids, int64_t n,
+                      float* out, int dim) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::PULL_SPARSE, table, n,
+                                                0, ids, n * 8, &g_resp))
+    return -1;
+  if (g_resp.size() != static_cast<size_t>(n) * dim * 4) return -1;
+  std::memcpy(out, g_resp.data(), g_resp.size());
+  return 0;
+}
+
+int pt_ps_push_sparse_grad(void* h, uint32_t table, const int64_t* ids,
+                           int64_t n, const float* grads, int dim) {
+  std::vector<char> payload(n * 8 + static_cast<size_t>(n) * dim * 4);
+  std::memcpy(payload.data(), ids, n * 8);
+  std::memcpy(payload.data() + n * 8, grads,
+              static_cast<size_t>(n) * dim * 4);
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::PUSH_SPARSE_GRAD, table,
+                                                n, 0, payload.data(),
+                                                payload.size(), &g_resp))
+    return -1;
+  return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+}
+
+int pt_ps_barrier(void* h, uint32_t world) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::BARRIER, 0, 0, world,
+                                                nullptr, 0, &g_resp))
+    return -1;
+  return g_resp.size() == 1 ? 0 : -1;
+}
+
+int pt_ps_save(void* h, uint32_t table, const char* path) {
+  size_t n = std::strlen(path);
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::SAVE, table, n, 0, path,
+                                                n, &g_resp))
+    return -1;
+  return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+}
+
+int pt_ps_load(void* h, uint32_t table, const char* path) {
+  size_t n = std::strlen(path);
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::LOAD, table, n, 0, path,
+                                                n, &g_resp))
+    return -1;
+  return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+}
+
+}  // extern "C"

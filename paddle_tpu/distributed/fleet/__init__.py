@@ -8,3 +8,11 @@ from .base import (init, is_first_worker, worker_index, worker_num,
                    distributed_optimizer, distributed_model,
                    DistributedStrategy, UserDefinedRoleMaker,
                    PaddleCloudRoleMaker, UtilBase, fleet)
+
+
+def __getattr__(name):
+    # native PS runtime loads (and builds) the C++ library on first use
+    if name in ("PsServer", "PsClient", "AsyncPSTrainer", "GeoPSTrainer"):
+        from . import ps
+        return getattr(ps, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,241 @@
+"""Parameter-server runtime over the native C++ PS
+(ref paddle/fluid/distributed/service/brpc_ps_server.h PsServer /
+ brpc_ps_client.h PsClient, table/common_dense_table.h,
+ table/common_sparse_table.h, fleet/runtime/the_one_ps.py TheOnePSRuntime,
+ service/communicator.h async push semantics).
+
+TPU-native split of responsibilities:
+  - Servers (host-only processes) own tables: dense param blocks with
+    server-side SGD apply (async/Hogwild) and sparse embedding tables with
+    deterministic lazy row init.
+  - Workers pull dense params + the batch's unique embedding rows, run the
+    compiled TPU step (jax.value_and_grad over params AND rows), and push
+    gradients back — the device never holds the full embedding table
+    (host-offload for beyond-HBM sparse models, the heter-PS analog).
+  - geo-SGD: workers train locally and push parameter deltas every k steps
+    (PUSH_DENSE_DELTA), the geo_async mode of the reference communicator.
+"""
+import ctypes
+
+import numpy as np
+import jax
+
+from ...utils.native_build import load_native
+
+_f32p = ctypes.POINTER(ctypes.c_float)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _fptr(a):
+    return a.ctypes.data_as(_f32p)
+
+
+def _iptr(a):
+    return a.ctypes.data_as(_i64p)
+
+
+class PsServer:
+    """In-process native PS server (one per server rank)."""
+
+    def __init__(self):
+        self._lib = load_native()
+        self._h = self._lib.pt_ps_server_create()
+        self.port = None
+
+    def add_dense_table(self, table_id, size, lr=0.1):
+        self._lib.pt_ps_add_dense_table(self._h, table_id, int(size),
+                                        float(lr))
+
+    def add_sparse_table(self, table_id, dim, lr=0.1, init_scale=0.01):
+        self._lib.pt_ps_add_sparse_table(self._h, table_id, int(dim),
+                                         float(lr), float(init_scale))
+
+    def start(self, port=0):
+        p = self._lib.pt_ps_server_start(self._h, int(port))
+        if p < 0:
+            raise RuntimeError(f"ps server failed to bind port {port}")
+        self.port = p
+        return p
+
+    def stop(self):
+        if self._h:
+            self._lib.pt_ps_server_stop(self._h)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pt_ps_server_stop(self._h)
+                self._lib.pt_ps_server_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class PsClient:
+    """Worker-side connection to one PS server."""
+
+    def __init__(self, host="127.0.0.1", port=None):
+        self._lib = load_native()
+        self._h = self._lib.pt_ps_client_create()
+        if self._lib.pt_ps_client_connect(self._h, host.encode(),
+                                          int(port)) != 0:
+            raise ConnectionError(f"cannot connect to ps {host}:{port}")
+
+    def pull_dense(self, table_id, size):
+        out = np.empty(size, np.float32)
+        self._check(self._lib.pt_ps_pull_dense(self._h, table_id, _fptr(out),
+                                               size), "pull_dense")
+        return out
+
+    def push_dense_grad(self, table_id, grad):
+        grad = np.ascontiguousarray(grad, np.float32)
+        self._check(self._lib.pt_ps_push_dense(self._h, table_id,
+                                               _fptr(grad), grad.size, 0),
+                    "push_dense_grad")
+
+    def push_dense_delta(self, table_id, delta):
+        delta = np.ascontiguousarray(delta, np.float32)
+        self._check(self._lib.pt_ps_push_dense(self._h, table_id,
+                                               _fptr(delta), delta.size, 1),
+                    "push_dense_delta")
+
+    def set_dense(self, table_id, values):
+        values = np.ascontiguousarray(values, np.float32)
+        self._check(self._lib.pt_ps_push_dense(self._h, table_id,
+                                               _fptr(values), values.size, 2),
+                    "set_dense")
+
+    def pull_sparse(self, table_id, ids, dim):
+        ids = np.ascontiguousarray(ids, np.int64)
+        out = np.empty((ids.size, dim), np.float32)
+        self._check(self._lib.pt_ps_pull_sparse(self._h, table_id, _iptr(ids),
+                                                ids.size, _fptr(out), dim),
+                    "pull_sparse")
+        return out
+
+    def push_sparse_grad(self, table_id, ids, grads):
+        ids = np.ascontiguousarray(ids, np.int64)
+        grads = np.ascontiguousarray(grads, np.float32)
+        assert grads.shape[0] == ids.size
+        self._check(self._lib.pt_ps_push_sparse_grad(
+            self._h, table_id, _iptr(ids), ids.size, _fptr(grads),
+            grads.shape[1]), "push_sparse_grad")
+
+    def barrier(self, world_size):
+        self._check(self._lib.pt_ps_barrier(self._h, int(world_size)),
+                    "barrier")
+
+    def save(self, table_id, path):
+        self._check(self._lib.pt_ps_save(self._h, table_id,
+                                         str(path).encode()), "save")
+
+    def load(self, table_id, path):
+        self._check(self._lib.pt_ps_load(self._h, table_id,
+                                         str(path).encode()), "load")
+
+    def _check(self, rc, what):
+        if rc != 0:
+            raise RuntimeError(f"ps client {what} failed (rc={rc})")
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pt_ps_client_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# worker-side trainers
+# --------------------------------------------------------------------------
+
+class _ParamCodec:
+    """flatten/unflatten a name->array dict into one dense-table vector."""
+
+    def __init__(self, template):
+        self.names = sorted(template)
+        self.shapes = {n: np.asarray(template[n]).shape for n in self.names}
+        self.sizes = {n: int(np.prod(self.shapes[n])) for n in self.names}
+        self.total = sum(self.sizes.values())
+
+    def flatten(self, params):
+        return np.concatenate(
+            [np.asarray(params[n], np.float32).ravel() for n in self.names])
+
+    def unflatten(self, flat):
+        out, off = {}, 0
+        for n in self.names:
+            k = self.sizes[n]
+            out[n] = np.asarray(flat[off:off + k],
+                                np.float32).reshape(self.shapes[n])
+            off += k
+        return out
+
+
+class AsyncPSTrainer:
+    """Async (a_sync/Hogwild) PS worker loop (ref
+    parameter_server_optimizer a_sync mode + HogwildWorker::TrainFiles).
+
+    loss_fn(params, urows, inv, *batch) -> scalar jnp loss, where
+    `urows[inv]` recovers per-position embedding rows. Gradients w.r.t.
+    duplicate ids are accumulated by autodiff through the gather.
+    """
+
+    def __init__(self, loss_fn, params_template, client, dense_table=0,
+                 sparse_table=1, emb_dim=8, init_dense=True):
+        self.client = client
+        self.dense_table = dense_table
+        self.sparse_table = sparse_table
+        self.emb_dim = emb_dim
+        self.codec = _ParamCodec(params_template)
+        if init_dense:
+            client.set_dense(dense_table, self.codec.flatten(params_template))
+        self._grad = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
+
+    def step(self, ids, *batch):
+        """One async step: pull, compute, push. Returns host loss."""
+        c = self.client
+        params = self.codec.unflatten(
+            c.pull_dense(self.dense_table, self.codec.total))
+        ids = np.asarray(ids).ravel()
+        uids, inv = np.unique(ids, return_inverse=True)
+        urows = c.pull_sparse(self.sparse_table, uids, self.emb_dim)
+        loss, (gp, grows) = self._grad(params, urows, inv.astype(np.int32),
+                                       *batch)
+        c.push_dense_grad(self.dense_table, self.codec.flatten(gp))
+        c.push_sparse_grad(self.sparse_table, uids, np.asarray(grows))
+        return float(loss)
+
+
+class GeoPSTrainer:
+    """geo-SGD worker (ref communicator geo mode / geo_sgd_transpiler):
+    trains a local copy, pushes the parameter DELTA every k steps and
+    re-pulls — communication-reducing async DP for PS mode."""
+
+    def __init__(self, loss_fn, params_template, client, dense_table=0,
+                 k_steps=4, lr=0.1, init_dense=True):
+        self.client = client
+        self.dense_table = dense_table
+        self.k_steps = k_steps
+        self.lr = lr
+        self.codec = _ParamCodec(params_template)
+        if init_dense:
+            client.set_dense(dense_table, self.codec.flatten(params_template))
+        self._base = client.pull_dense(dense_table, self.codec.total)
+        self._local = self._base.copy()
+        self._i = 0
+        self._grad = jax.jit(jax.value_and_grad(loss_fn))
+
+    def step(self, *batch):
+        params = self.codec.unflatten(self._local)
+        loss, gp = self._grad(params, *batch)
+        self._local -= self.lr * self.codec.flatten(gp)
+        self._i += 1
+        if self._i % self.k_steps == 0:
+            delta = self._local - self._base
+            self.client.push_dense_delta(self.dense_table, delta)
+            self._base = self.client.pull_dense(self.dense_table,
+                                                self.codec.total)
+            self._local = self._base.copy()
+        return float(loss)

@@ -84,3 +84,37 @@ def _configure(lib):
     lib.pt_feed_slot_ivals.restype = c.c_long
     lib.pt_feed_slot_lod.argtypes = [c.c_void_p, c.c_int, c.POINTER(i64p)]
     lib.pt_feed_slot_lod.restype = c.c_long
+
+    # ---- parameter server (native/src/ps_server.cc)
+    lib.pt_ps_server_create.restype = c.c_void_p
+    lib.pt_ps_server_destroy.argtypes = [c.c_void_p]
+    lib.pt_ps_add_dense_table.argtypes = [c.c_void_p, c.c_uint32, c.c_int64,
+                                          c.c_float]
+    lib.pt_ps_add_sparse_table.argtypes = [c.c_void_p, c.c_uint32, c.c_int,
+                                           c.c_float, c.c_float]
+    lib.pt_ps_server_start.argtypes = [c.c_void_p, c.c_int]
+    lib.pt_ps_server_start.restype = c.c_int
+    lib.pt_ps_server_stop.argtypes = [c.c_void_p]
+    lib.pt_ps_client_create.restype = c.c_void_p
+    lib.pt_ps_client_destroy.argtypes = [c.c_void_p]
+    lib.pt_ps_client_connect.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+    lib.pt_ps_client_connect.restype = c.c_int
+    f32p = c.POINTER(c.c_float)
+    i64p = c.POINTER(c.c_int64)
+    lib.pt_ps_pull_dense.argtypes = [c.c_void_p, c.c_uint32, f32p, c.c_int64]
+    lib.pt_ps_pull_dense.restype = c.c_int
+    lib.pt_ps_push_dense.argtypes = [c.c_void_p, c.c_uint32, f32p, c.c_int64,
+                                     c.c_int]
+    lib.pt_ps_push_dense.restype = c.c_int
+    lib.pt_ps_pull_sparse.argtypes = [c.c_void_p, c.c_uint32, i64p, c.c_int64,
+                                      f32p, c.c_int]
+    lib.pt_ps_pull_sparse.restype = c.c_int
+    lib.pt_ps_push_sparse_grad.argtypes = [c.c_void_p, c.c_uint32, i64p,
+                                           c.c_int64, f32p, c.c_int]
+    lib.pt_ps_push_sparse_grad.restype = c.c_int
+    lib.pt_ps_barrier.argtypes = [c.c_void_p, c.c_uint32]
+    lib.pt_ps_barrier.restype = c.c_int
+    lib.pt_ps_save.argtypes = [c.c_void_p, c.c_uint32, c.c_char_p]
+    lib.pt_ps_save.restype = c.c_int
+    lib.pt_ps_load.argtypes = [c.c_void_p, c.c_uint32, c.c_char_p]
+    lib.pt_ps_load.restype = c.c_int
