@@ -12,6 +12,7 @@
 // Wire format (little-endian):
 //   request : [u8 op][u32 table][u64 count][u32 aux][payload]
 //   response: [u64 len][payload]   (len = payload bytes)
+#include <algorithm>
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -145,11 +146,16 @@ class PsServer {
       barrier_cv_.notify_all();
     }
     if (accept_thread_.joinable()) accept_thread_.join();
-    std::lock_guard<std::mutex> lk(conn_mu_);
-    for (int fd : conn_fds_) shutdown(fd, SHUT_RDWR);  // wake blocked reads
-    for (auto& t : conn_threads_)
+    std::vector<std::thread> threads;
+    {
+      // don't hold conn_mu_ while joining: Serve() exit paths lock it
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      for (int fd : conn_fds_) shutdown(fd, SHUT_RDWR);  // wake blocked reads
+      threads.swap(conn_threads_);
+    }
+    for (auto& t : threads)
       if (t.joinable()) t.join();
-    conn_threads_.clear();
+    std::lock_guard<std::mutex> lk(conn_mu_);
     conn_fds_.clear();
   }
 
@@ -205,6 +211,12 @@ class PsServer {
         break;
       if (!Dispatch(fd, op, table, count, aux)) break;
       if (op == STOP) break;
+    }
+    {
+      // deregister before close so Stop() never shutdown()s a recycled fd
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+                      conn_fds_.end());
     }
     close(fd);
   }
@@ -312,20 +324,25 @@ class PsServer {
       return true;
     }
     if (SparseTable* t = Sparse(id)) {
+      // hold every shard lock for the whole snapshot so the header count
+      // cannot disagree with the records written (rows are lazily created
+      // by concurrent PULL_SPARSE)
+      std::vector<std::unique_lock<std::mutex>> locks;
+      locks.reserve(SparseTable::kShards);
+      for (auto& sh : t->shards) locks.emplace_back(sh.mu);
       uint64_t total = 0;
       for (auto& sh : t->shards) total += sh.rows.size();
       uint64_t dim = static_cast<uint64_t>(t->dim);
       out.write(reinterpret_cast<const char*>(&total), 8);
       out.write(reinterpret_cast<const char*>(&dim), 8);
       for (auto& sh : t->shards) {
-        std::lock_guard<std::mutex> lk(sh.mu);
         for (auto& kv : sh.rows) {
           out.write(reinterpret_cast<const char*>(&kv.first), 8);
           out.write(reinterpret_cast<const char*>(kv.second.data()),
                     t->dim * 4);
         }
       }
-      return true;
+      return out.good();
     }
     return false;
   }
@@ -335,25 +352,36 @@ class PsServer {
     if (!in.is_open()) return false;
     if (DenseTable* t = Dense(id)) {
       uint64_t n = 0;
-      in.read(reinterpret_cast<char*>(&n), 8);
+      if (!in.read(reinterpret_cast<char*>(&n), 8)) return false;
       std::lock_guard<std::mutex> lk(t->mu);
       if (n != t->values.size()) return false;
-      in.read(reinterpret_cast<char*>(t->values.data()), n * 4);
+      // stage into a scratch buffer: a truncated file must not leave the
+      // live table half-overwritten
+      std::vector<float> staged(n);
+      if (!in.read(reinterpret_cast<char*>(staged.data()), n * 4))
+        return false;
+      t->values = std::move(staged);
       return true;
     }
     if (SparseTable* t = Sparse(id)) {
       uint64_t total = 0, dim = 0;
-      in.read(reinterpret_cast<char*>(&total), 8);
-      in.read(reinterpret_cast<char*>(&dim), 8);
+      if (!in.read(reinterpret_cast<char*>(&total), 8)) return false;
+      if (!in.read(reinterpret_cast<char*>(&dim), 8)) return false;
       if (dim != static_cast<uint64_t>(t->dim)) return false;
+      std::vector<std::pair<int64_t, std::vector<float>>> staged;
+      staged.reserve(total);
       for (uint64_t i = 0; i < total; ++i) {
         int64_t key;
         std::vector<float> row(t->dim);
-        in.read(reinterpret_cast<char*>(&key), 8);
-        in.read(reinterpret_cast<char*>(row.data()), t->dim * 4);
-        SparseShard& sh = t->shard(key);
+        if (!in.read(reinterpret_cast<char*>(&key), 8)) return false;
+        if (!in.read(reinterpret_cast<char*>(row.data()), t->dim * 4))
+          return false;
+        staged.emplace_back(key, std::move(row));
+      }
+      for (auto& kv : staged) {
+        SparseShard& sh = t->shard(kv.first);
         std::lock_guard<std::mutex> lk(sh.mu);
-        sh.rows[key] = std::move(row);
+        sh.rows[kv.first] = std::move(kv.second);
       }
       return true;
     }

@@ -30,3 +30,6 @@ from . import transformer
 from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           TransformerEncoder, TransformerDecoderLayer,
                           TransformerDecoder, Transformer)
+from . import rnn
+from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+                  SimpleRNN, LSTM, GRU)

@@ -1,0 +1,519 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU cells and multi-layer RNNs.
+
+TPU-native redesign of the reference RNN stack (ref
+python/paddle/nn/layer/rnn.py:144-1400 and the cuDNN-backed rnn op,
+paddle/fluid/operators/rnn_op.cu): instead of a per-timestep op loop (or a
+vendor RNN kernel), a whole (layer, direction) pass is ONE registered op whose
+body is
+
+    1. input projection for ALL timesteps in a single  [T*B, I] x [I, G*H]
+       matmul — the FLOPs land on the MXU in one large tile-friendly GEMM;
+    2. `lax.scan` over time carrying only the small recurrent GEMM — XLA
+       unrolls nothing, compiles once, and the loop body stays fused.
+
+This makes forward+backward a single XLA program (jax.vjp of the scan), where
+the reference needs a C++ grad-op per timestep. Gate semantics match the
+reference exactly (LSTM chunks [i, f, g, o] rnn.py:518-537; GRU
+``h = (h_prev - c) * z + c`` rnn.py:665-686) so state dicts are numerically
+interchangeable.
+
+Variable-length sequences use the dense-plus-lengths design (no LoDTensor —
+SURVEY.md §7): `sequence_length` masks state updates inside the scan, so
+final states equal the last valid step and padded outputs are zeroed.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import def_op
+from . import initializer as I
+from .layer import Layer, LayerList
+
+
+# --------------------------------------------------------------------------- #
+# fused single-(layer,direction) sequence kernels                             #
+# --------------------------------------------------------------------------- #
+
+def _mask_carry(new, old, valid):
+    return jnp.where(valid[:, None], new, old)
+
+
+def _scan_rnn(step, x_proj, init, w_hh, b_hh, lengths, reverse):
+    """Run `step` over time-major projected inputs with optional length mask.
+
+    x_proj: [T, B, G*H] (input projection already added, biases included).
+    init:   tuple of [B, H] carries.
+    Returns (outputs [T, B, H], final carries).
+    """
+    T = x_proj.shape[0]
+    ts = jnp.arange(T)
+    if reverse:
+        x_proj = jnp.flip(x_proj, axis=0)
+        ts = jnp.flip(ts, axis=0)
+
+    def body(carry, inp):
+        xt, t = inp
+        new_carry, out = step(carry, xt, w_hh, b_hh)
+        if lengths is not None:
+            valid = t < lengths            # [B]
+            new_carry = tuple(_mask_carry(n, o, valid)
+                              for n, o in zip(new_carry, carry))
+            out = jnp.where(valid[:, None], out, jnp.zeros_like(out))
+        return new_carry, out
+
+    final, ys = lax.scan(body, init, (x_proj, ts))
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, final
+
+
+def _simple_step(act):
+    def step(carry, xt, w_hh, b_hh):
+        (h,) = carry
+        pre = xt + h @ w_hh.T + b_hh
+        h = jnp.tanh(pre) if act == "tanh" else jax.nn.relu(pre)
+        return (h,), h
+    return step
+
+
+def _lstm_step(carry, xt, w_hh, b_hh):
+    h, c = carry
+    gates = xt + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c = f * c + i * jnp.tanh(g)
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
+def _gru_step(carry, xt, w_hh, b_hh):
+    (h,) = carry
+    # reset gate applies AFTER the recurrent matmul (ref rnn.py:683)
+    hg = h @ w_hh.T + b_hh
+    x_r, x_z, x_c = jnp.split(xt, 3, axis=-1)
+    h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(x_r + h_r)
+    z = jax.nn.sigmoid(x_z + h_z)
+    c = jnp.tanh(x_c + r * h_c)
+    h = (h - c) * z + c
+    return (h,), h
+
+
+@def_op("simple_rnn_seq", n_tensor_args=7)
+def simple_rnn_seq(x, h0, w_ih, w_hh, b_ih, b_hh, lengths,
+                   activation="tanh", reverse=False):
+    """One SimpleRNN layer over a full [T, B, I] time-major sequence."""
+    xp = x @ w_ih.T + b_ih
+    ys, (h,) = _scan_rnn(_simple_step(activation), xp, (h0,), w_hh, b_hh,
+                         lengths, reverse)
+    return ys, h
+
+
+@def_op("lstm_seq", n_tensor_args=8)
+def lstm_seq(x, h0, c0, w_ih, w_hh, b_ih, b_hh, lengths, reverse=False):
+    """One LSTM layer over a full [T, B, I] time-major sequence."""
+    xp = x @ w_ih.T + b_ih
+    ys, (h, c) = _scan_rnn(_lstm_step, xp, (h0, c0), w_hh, b_hh,
+                           lengths, reverse)
+    return ys, h, c
+
+
+@def_op("gru_seq", n_tensor_args=7)
+def gru_seq(x, h0, w_ih, w_hh, b_ih, b_hh, lengths, reverse=False):
+    """One GRU layer over a full [T, B, I] time-major sequence."""
+    xp = x @ w_ih.T + b_ih
+    ys, (h,) = _scan_rnn(_gru_step, xp, (h0,), w_hh, b_hh, lengths, reverse)
+    return ys, h
+
+
+# --------------------------------------------------------------------------- #
+# cells                                                                       #
+# --------------------------------------------------------------------------- #
+
+class RNNCellBase(Layer):
+    """ref python/paddle/nn/layer/rnn.py:144."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        dtype = dtype or "float32"
+        if isinstance(shape[0], (list, tuple)):
+            return tuple(
+                Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                dtype=jnp.dtype(dtype)))
+                for s in shape)
+        return Tensor(jnp.full((batch,) + tuple(shape), init_value,
+                               dtype=jnp.dtype(dtype)))
+
+    def _make_weights(self, input_size, hidden_size, n_gates,
+                      weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                      bias_hh_attr):
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        g = n_gates * hidden_size
+        self.weight_ih = self.create_parameter(
+            (g, input_size), weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (g, hidden_size), weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            (g,), bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            (g,), bias_hh_attr, is_bias=True, default_initializer=u)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """Elman RNN cell (ref rnn.py:268)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError(
+                f"activation for SimpleRNNCell should be tanh or relu, "
+                f"but got {activation}")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self._make_weights(input_size, hidden_size, 1, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        from . import functional as F
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre = (F.linear(inputs, self.weight_ih.T, self.bias_ih)
+               + F.linear(states, self.weight_hh.T, self.bias_hh))
+        h = pre.tanh() if self.activation == "tanh" else F.relu(pre)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class LSTMCell(RNNCellBase):
+    """LSTM cell, gate chunks [i, f, g, o] (ref rnn.py:400,518-537)."""
+
+    def __init__(self, input_size, hidden_size,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._make_weights(input_size, hidden_size, 4, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h0, c0 = states
+        x = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+        ys, h, c = lstm_seq(x.unsqueeze(0), h0, c0, self.weight_ih,
+                            self.weight_hh, self.bias_ih, self.bias_hh, None)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class GRUCell(RNNCellBase):
+    """GRU cell, h = (h_prev - c) * z + c (ref rnn.py:553,665-686)."""
+
+    def __init__(self, input_size, hidden_size,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._make_weights(input_size, hidden_size, 3, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        x = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+        ys, h = gru_seq(x.unsqueeze(0), states, self.weight_ih,
+                        self.weight_hh, self.bias_ih, self.bias_hh, None)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+# --------------------------------------------------------------------------- #
+# wrappers                                                                    #
+# --------------------------------------------------------------------------- #
+
+_FUSED = {}  # cell class -> runner; filled below
+
+
+def _as_tuple(states):
+    return states if isinstance(states, (tuple, list)) else (states,)
+
+
+class RNN(Layer):
+    """Run a cell over a sequence (ref rnn.py:700).
+
+    Known cells (SimpleRNNCell/LSTMCell/GRUCell) take the fused-scan fast
+    path; custom cells fall back to a per-step python loop (eager autograd
+    still works; wrap the whole step in jit.to_static for speed).
+    """
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        runner = _FUSED.get(type(self.cell))
+        if runner is not None:
+            outs, final = runner(self.cell, x, initial_states,
+                                 sequence_length, self.is_reverse)
+        else:
+            outs, final = self._loop(x, initial_states, sequence_length)
+        if not self.time_major:
+            outs = outs.transpose([1, 0, 2])
+        return outs, final
+
+    def _loop(self, x, initial_states, sequence_length):
+        from ..ops import manipulation as M
+        T = x.shape[0]
+        states = initial_states
+        if states is None:
+            states = self.cell.get_initial_states(x, batch_dim_idx=1)
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = [None] * T
+        for t in order:
+            out, new_states = self.cell(x[t], states)
+            if sequence_length is not None:
+                valid = Tensor((t < sequence_length._data)[:, None])
+                zero = Tensor(jnp.zeros_like(out._data))
+                outs[t] = M.where(valid, out, zero)
+                # hold states past each sequence's end (matches fused path)
+                new_flat = _as_tuple(new_states)
+                old_flat = _as_tuple(states)
+                held = tuple(M.where(valid, n, o)
+                             for n, o in zip(new_flat, old_flat))
+                states = held if isinstance(new_states, (tuple, list)) \
+                    else held[0]
+            else:
+                outs[t] = out
+                states = new_states
+        return M.stack(outs, axis=0), states
+
+
+def _run_simple(cell, x, init, lengths, reverse):
+    h0 = _as_tuple(init)[0] if init is not None else \
+        cell.get_initial_states(x, batch_dim_idx=1)
+    ys, h = simple_rnn_seq(x, h0, cell.weight_ih, cell.weight_hh,
+                           cell.bias_ih, cell.bias_hh, lengths,
+                           activation=cell.activation, reverse=reverse)
+    return ys, h
+
+
+def _run_lstm(cell, x, init, lengths, reverse):
+    if init is None:
+        init = cell.get_initial_states(x, batch_dim_idx=1)
+    h0, c0 = init
+    ys, h, c = lstm_seq(x, h0, c0, cell.weight_ih, cell.weight_hh,
+                        cell.bias_ih, cell.bias_hh, lengths, reverse=reverse)
+    return ys, (h, c)
+
+
+def _run_gru(cell, x, init, lengths, reverse):
+    h0 = _as_tuple(init)[0] if init is not None else \
+        cell.get_initial_states(x, batch_dim_idx=1)
+    ys, h = gru_seq(x, h0, cell.weight_ih, cell.weight_hh,
+                    cell.bias_ih, cell.bias_hh, lengths, reverse=reverse)
+    return ys, h
+
+
+_FUSED[SimpleRNNCell] = _run_simple
+_FUSED[LSTMCell] = _run_lstm
+_FUSED[GRUCell] = _run_gru
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over the same sequence (ref rnn.py:775)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops import manipulation as M
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        return M.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) stack (ref rnn.py:854).
+
+    Parameter naming follows the reference flat form: weight_ih_l{k} /
+    weight_hh_l{k} / bias_ih_l{k} / bias_hh_l{k} with `_reverse` suffix for
+    the backward direction, so state dicts port over directly.
+    """
+
+    MODES = {"RNN_TANH": (1, "simple"), "RNN_RELU": (1, "simple"),
+             "LSTM": (4, "lstm"), "GRU": (3, "gru")}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(
+                f"direction should be forward or bidirect(ional), "
+                f"got {direction}")
+        n_gates, self._kind = self.MODES[mode]
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                isize = input_size if layer == 0 \
+                    else hidden_size * self.num_directions
+                sfx = f"l{layer}" + ("_reverse" if d == 1 else "")
+                g = n_gates * hidden_size
+                setattr(self, f"weight_ih_{sfx}", self.create_parameter(
+                    (g, isize), weight_ih_attr, default_initializer=u))
+                setattr(self, f"weight_hh_{sfx}", self.create_parameter(
+                    (g, hidden_size), weight_hh_attr, default_initializer=u))
+                setattr(self, f"bias_ih_{sfx}", self.create_parameter(
+                    (g,), bias_ih_attr, is_bias=True, default_initializer=u))
+                setattr(self, f"bias_hh_{sfx}", self.create_parameter(
+                    (g,), bias_hh_attr, is_bias=True, default_initializer=u))
+
+    def _weights(self, layer, d):
+        sfx = f"l{layer}" + ("_reverse" if d == 1 else "")
+        return (getattr(self, f"weight_ih_{sfx}"),
+                getattr(self, f"weight_hh_{sfx}"),
+                getattr(self, f"bias_ih_{sfx}"),
+                getattr(self, f"bias_hh_{sfx}"))
+
+    def _zeros(self, x):
+        batch = x.shape[1]
+        n = self.num_layers * self.num_directions
+        return jnp.zeros((n, batch, self.hidden_size), dtype=x._data.dtype)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from . import functional as F
+        from ..ops import manipulation as M
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        lengths = sequence_length
+        is_lstm = self._kind == "lstm"
+
+        if initial_states is None:
+            z = Tensor(self._zeros(x))
+            initial_states = (z, z.clone()) if is_lstm else z
+        if is_lstm:
+            h_all, c_all = initial_states
+        else:
+            h_all = initial_states
+
+        out = x
+        hs, cs = [], []
+        for layer in range(self.num_layers):
+            per_dir = []
+            for d in range(self.num_directions):
+                idx = layer * self.num_directions + d
+                w_ih, w_hh, b_ih, b_hh = self._weights(layer, d)
+                h0 = h_all[idx]
+                if self._kind == "simple":
+                    act = "tanh" if self.mode == "RNN_TANH" else "relu"
+                    ys, h = simple_rnn_seq(out, h0, w_ih, w_hh, b_ih, b_hh,
+                                           lengths, activation=act,
+                                           reverse=bool(d))
+                    hs.append(h)
+                elif self._kind == "gru":
+                    ys, h = gru_seq(out, h0, w_ih, w_hh, b_ih, b_hh,
+                                    lengths, reverse=bool(d))
+                    hs.append(h)
+                else:
+                    c0 = c_all[idx]
+                    ys, h, c = lstm_seq(out, h0, c0, w_ih, w_hh, b_ih, b_hh,
+                                        lengths, reverse=bool(d))
+                    hs.append(h)
+                    cs.append(c)
+                per_dir.append(ys)
+            out = per_dir[0] if len(per_dir) == 1 \
+                else M.concat(per_dir, axis=-1)
+            if self.dropout > 0.0 and layer < self.num_layers - 1:
+                out = F.dropout(out, p=self.dropout,
+                                training=self.training)
+        final_h = M.stack(hs, axis=0)
+        if not self.time_major:
+            out = out.transpose([1, 0, 2])
+        if is_lstm:
+            return out, (final_h, M.stack(cs, axis=0))
+        return out, final_h
+
+    def extra_repr(self):
+        return (f"{self.input_size}, {self.hidden_size}, "
+                f"num_layers={self.num_layers}, mode={self.mode}")
+
+
+class SimpleRNN(_RNNBase):
+    """ref rnn.py:1090."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    """ref rnn.py:1197."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    """ref rnn.py:1308."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)

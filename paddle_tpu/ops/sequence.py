@@ -1,0 +1,127 @@
+"""Sequence ops on dense padded tensors + explicit lengths.
+
+TPU-native replacement for the reference's LoDTensor-based sequence ops
+(ref paddle/fluid/operators/sequence_ops/ — sequence_pool_op.cc,
+sequence_pad_op.cc, sequence_expand_op.cc, sequence_reverse_op.h,
+sequence_softmax_op.cc). Ragged LoD offsets do not map to XLA's static-shape
+world, so every op here takes `[B, T, ...]` padded data plus a `[B]` lengths
+vector and compiles to masked dense compute — fully fusable, MXU/VPU
+friendly, and shardable along batch with GSPMD.
+
+The `lod` concept survives only at the python edge: `sequence_pad/unpad`
+convert between python lists of variable-length arrays and the dense form.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .dispatch import def_op
+
+
+def _mask(lengths, T, dtype=jnp.float32):
+    # [B, T] 1.0 where t < length
+    return (jnp.arange(T)[None, :] < lengths[:, None]).astype(dtype)
+
+
+@def_op("sequence_pool", n_tensor_args=2)
+def sequence_pool(x, lengths, pool_type="sum"):
+    """Pool over the time axis honouring lengths
+    (ref sequence_ops/sequence_pool_op.cc; pool types average/sum/sqrt/max/
+    first/last). x: [B, T, ...], lengths: [B] int. Returns [B, ...]."""
+    T = x.shape[1]
+    pt = pool_type.lower()
+    if pt == "first":
+        return x[:, 0]
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), T, dtype=jnp.int32)
+    m = _mask(lengths, T, x.dtype)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    if pt in ("sum", "average", "sqrt"):
+        s = jnp.sum(x * m, axis=1)
+        if pt == "average":
+            denom = jnp.maximum(lengths, 1).astype(x.dtype)
+            return s / denom.reshape(denom.shape + (1,) * (x.ndim - 2))
+        if pt == "sqrt":
+            denom = jnp.sqrt(jnp.maximum(lengths, 1).astype(x.dtype))
+            return s / denom.reshape(denom.shape + (1,) * (x.ndim - 2))
+        return s
+    if pt == "max":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    if pt == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@def_op("sequence_reverse", n_tensor_args=2)
+def sequence_reverse(x, lengths):
+    """Reverse each sequence's valid prefix, keep padding in place
+    (ref sequence_ops/sequence_reverse_op.h). x: [B, T, ...]."""
+    T = x.shape[1]
+    t = jnp.arange(T)[None, :]                       # [1, T]
+    lens = lengths[:, None]                          # [B, 1]
+    src = jnp.where(t < lens, lens - 1 - t, t)       # reversed index in prefix
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+@def_op("sequence_softmax", n_tensor_args=2)
+def sequence_softmax(x, lengths):
+    """Softmax over the valid prefix of the time axis
+    (ref sequence_ops/sequence_softmax_op.cc). x: [B, T]."""
+    m = _mask(lengths, x.shape[1], x.dtype)
+    neg = jnp.finfo(x.dtype).min
+    z = jnp.where(m > 0, x, neg)
+    z = z - jnp.max(z, axis=1, keepdims=True)
+    e = jnp.exp(z) * m
+    return e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-30)
+
+
+@def_op("sequence_expand", n_tensor_args=1)
+def sequence_expand(x, repeats=()):
+    """Repeat each row i `repeats[i]` times — the dense analog of LoD-driven
+    sequence_expand (ref sequence_ops/sequence_expand_op.cc). Because XLA
+    needs static shapes, `repeats` is an attr (concrete host-side int
+    vector), never a traced tensor; under jit use a padded formulation."""
+    reps = np.asarray(repeats)
+    idx = jnp.asarray(np.repeat(np.arange(reps.shape[0]), reps))
+    return jnp.take(x, idx, axis=0)
+
+
+def sequence_pad(sequences, pad_value=0.0, maxlen=None, dtype=None):
+    """python list of [Ti, ...] arrays -> (padded [B, T, ...], lengths [B])
+    (ref sequence_ops/sequence_pad_op.cc). Host-side edge op."""
+    arrs = [s.numpy() if isinstance(s, Tensor) else np.asarray(s)
+            for s in sequences]
+    lens = np.array([a.shape[0] for a in arrs], dtype=np.int32)
+    T = int(maxlen) if maxlen is not None else int(lens.max(initial=0))
+    lens = np.minimum(lens, T)  # truncation must be reflected in lengths
+    tail = arrs[0].shape[1:] if arrs else ()
+    out = np.full((len(arrs), T) + tail, pad_value,
+                  dtype=dtype or (arrs[0].dtype if arrs else np.float32))
+    for i, a in enumerate(arrs):
+        out[i, :a.shape[0]] = a[:T]
+    return Tensor(out), Tensor(lens)
+
+
+def sequence_unpad(x, lengths):
+    """Dense (x, lengths) -> python list of variable-length Tensors
+    (ref sequence_ops/sequence_unpad_op.cc). Host-side edge op."""
+    data = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    lens = lengths.numpy() if isinstance(lengths, Tensor) \
+        else np.asarray(lengths)
+    return [Tensor(data[i, :int(l)]) for i, l in enumerate(lens)]
+
+
+@def_op("sequence_first_step", n_tensor_args=1)
+def sequence_first_step(x):
+    return sequence_pool.raw(x, None, pool_type="first")
+
+
+@def_op("sequence_last_step", n_tensor_args=2)
+def sequence_last_step(x, lengths):
+    return sequence_pool.raw(x, lengths, pool_type="last")

@@ -1,0 +1,90 @@
+"""Sequence ops (dense + lengths): numerics vs numpy references.
+
+Mirrors ref unittests/sequence/test_sequence_pool.py etc., re-expressed for
+the padded-dense design (SURVEY.md §7 — LoDTensor → padded + mask).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops import sequence as S
+
+
+@pytest.fixture
+def data():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 5, 4).astype("float32")
+    lens = np.array([5, 3, 1], dtype="int32")
+    return x, lens
+
+
+def test_pool_sum_avg_sqrt(data):
+    x, lens = data
+    xt, lt = pt.to_tensor(x), pt.to_tensor(lens)
+    for pool, fn in [
+        ("sum", lambda a: a.sum(0)),
+        ("average", lambda a: a.mean(0)),
+        ("sqrt", lambda a: a.sum(0) / np.sqrt(a.shape[0])),
+    ]:
+        got = S.sequence_pool(xt, lt, pool_type=pool).numpy()
+        want = np.stack([fn(x[i, :l]) for i, l in enumerate(lens)])
+        np.testing.assert_allclose(got, want, atol=1e-5, err_msg=pool)
+
+
+def test_pool_max_first_last(data):
+    x, lens = data
+    xt, lt = pt.to_tensor(x), pt.to_tensor(lens)
+    got = S.sequence_pool(xt, lt, pool_type="max").numpy()
+    want = np.stack([x[i, :l].max(0) for i, l in enumerate(lens)])
+    np.testing.assert_allclose(got, want)
+    got = S.sequence_pool(xt, lt, pool_type="last").numpy()
+    want = np.stack([x[i, l - 1] for i, l in enumerate(lens)])
+    np.testing.assert_allclose(got, want)
+    got = S.sequence_first_step(xt).numpy()
+    np.testing.assert_allclose(got, x[:, 0])
+
+
+def test_reverse(data):
+    x, lens = data
+    got = S.sequence_reverse(pt.to_tensor(x), pt.to_tensor(lens)).numpy()
+    for i, l in enumerate(lens):
+        np.testing.assert_allclose(got[i, :l], x[i, :l][::-1])
+        np.testing.assert_allclose(got[i, l:], x[i, l:])  # padding untouched
+
+
+def test_softmax(data):
+    x, lens = data
+    x2 = x[:, :, 0]
+    got = S.sequence_softmax(pt.to_tensor(x2), pt.to_tensor(lens)).numpy()
+    for i, l in enumerate(lens):
+        e = np.exp(x2[i, :l] - x2[i, :l].max())
+        np.testing.assert_allclose(got[i, :l], e / e.sum(), atol=1e-6)
+        np.testing.assert_allclose(got[i, l:], 0, atol=1e-7)
+
+
+def test_pad_unpad_roundtrip():
+    seqs = [np.random.RandomState(i).randn(n, 2).astype("f4")
+            for i, n in enumerate([4, 2, 5])]
+    padded, lens = S.sequence_pad(seqs, pad_value=-1.0)
+    assert padded.shape == [3, 5, 2]
+    assert lens.numpy().tolist() == [4, 2, 5]
+    back = S.sequence_unpad(padded, lens)
+    for a, b in zip(seqs, back):
+        np.testing.assert_allclose(a, b.numpy())
+
+
+def test_expand():
+    x = np.arange(6, dtype="float32").reshape(3, 2)
+    got = S.sequence_expand(pt.to_tensor(x), repeats=[2, 0, 1]).numpy()
+    np.testing.assert_allclose(got, x[[0, 0, 2]])
+
+
+def test_pool_grad(data):
+    x, lens = data
+    xt = pt.to_tensor(x, stop_gradient=False)
+    out = S.sequence_pool(xt, pt.to_tensor(lens), pool_type="sum")
+    out.sum().backward()
+    g = xt.grad.numpy()
+    for i, l in enumerate(lens):
+        np.testing.assert_allclose(g[i, :l], 1.0)
+        np.testing.assert_allclose(g[i, l:], 0.0)
