@@ -321,7 +321,7 @@ class PsServer {
       uint64_t n = t->values.size();
       out.write(reinterpret_cast<const char*>(&n), 8);
       out.write(reinterpret_cast<const char*>(t->values.data()), n * 4);
-      return true;
+      return out.good();
     }
     if (SparseTable* t = Sparse(id)) {
       // hold every shard lock for the whole snapshot so the header count
@@ -368,6 +368,13 @@ class PsServer {
       if (!in.read(reinterpret_cast<char*>(&total), 8)) return false;
       if (!in.read(reinterpret_cast<char*>(&dim), 8)) return false;
       if (dim != static_cast<uint64_t>(t->dim)) return false;
+      // bound the header count by what the file can actually hold so a
+      // corrupt total can't trigger a huge allocation
+      in.seekg(0, std::ios::end);
+      uint64_t payload = static_cast<uint64_t>(in.tellg()) - 16;
+      in.seekg(16, std::ios::beg);
+      uint64_t rec = 8 + dim * 4;
+      if (rec == 0 || total > payload / rec) return false;
       std::vector<std::pair<int64_t, std::vector<float>>> staged;
       staged.reserve(total);
       for (uint64_t i = 0; i < total; ++i) {

@@ -8,3 +8,5 @@ from .state import (Place, CPUPlace, TPUPlace, CUDAPlace, XPUPlace, set_device,
                     is_functional_mode, set_default_dtype, get_default_dtype)
 from .tensor import Tensor, Parameter, to_tensor
 from . import tape
+from . import errors
+from .errors import enforce, enforce_eq, enforce_shape

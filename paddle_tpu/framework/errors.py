@@ -1,0 +1,81 @@
+"""Typed errors + enforce helpers.
+
+TPU-native analog of the reference error machinery
+(ref paddle/fluid/platform/enforce.h PADDLE_ENFORCE*, platform/errors.h,
+platform/error_codes.proto): the same typed taxonomy, expressed as python
+exception classes (no C++ stack demangling needed — python tracebacks carry
+the op call stack the reference reconstructs via framework/op_call_stack.cc).
+"""
+
+
+class PaddleTpuError(Exception):
+    code = "LEGACY"
+
+
+class InvalidArgumentError(PaddleTpuError, ValueError):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(PaddleTpuError, KeyError):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(PaddleTpuError, IndexError):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(PaddleTpuError):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(PaddleTpuError, MemoryError):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(PaddleTpuError, RuntimeError):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(PaddleTpuError, PermissionError):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(PaddleTpuError, TimeoutError):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(PaddleTpuError, NotImplementedError):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(PaddleTpuError, RuntimeError):
+    code = "UNAVAILABLE"
+
+
+class FatalError(PaddleTpuError, RuntimeError):
+    code = "FATAL"
+
+
+class ExternalError(PaddleTpuError, RuntimeError):
+    code = "EXTERNAL"
+
+
+def enforce(condition, message="", error_cls=PreconditionNotMetError):
+    """ref PADDLE_ENFORCE (enforce.h). Raise typed error when false."""
+    if not condition:
+        raise error_cls(message)
+
+
+def enforce_eq(a, b, message="", error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(f"expected {a!r} == {b!r}. {message}")
+
+
+def enforce_shape(tensor, expected, message=""):
+    got = tuple(tensor.shape)
+    want = tuple(expected)
+    ok = len(got) == len(want) and all(
+        w in (-1, None) or g == w for g, w in zip(got, want))
+    if not ok:
+        raise InvalidArgumentError(
+            f"shape mismatch: got {got}, expected {want}. {message}")

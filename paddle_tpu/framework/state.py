@@ -201,6 +201,33 @@ _FLAGS = {
 }
 
 
+def _bootstrap_env_flags():
+    """Parse FLAGS_* env vars at import (ref python/paddle/fluid/__init__.py
+    __bootstrap__ passing env gflags to core.init_gflags)."""
+    import os
+    for key, default in list(_FLAGS.items()):
+        raw = os.environ.get(key)
+        if raw is None:
+            continue
+        try:
+            if isinstance(default, bool):
+                _FLAGS[key] = raw.lower() in ("1", "true", "yes", "on")
+            elif isinstance(default, int):
+                _FLAGS[key] = int(raw)
+            elif isinstance(default, float):
+                _FLAGS[key] = float(raw)
+            else:
+                _FLAGS[key] = raw
+        except ValueError:
+            import warnings
+            warnings.warn(
+                f"ignoring malformed env var {key}={raw!r}; keeping "
+                f"default {default!r}")
+
+
+_bootstrap_env_flags()
+
+
 def set_flags(flags: dict):
     for k, v in flags.items():
         _FLAGS[k] = v

@@ -52,8 +52,18 @@ class StaticFunction:
         self._input_spec = input_spec
 
     def _build(self):
+        from . import dy2static
+        convert = ProgramTranslator.get_instance().enable_to_static
         if self._is_layer:
             layer = self._target
+            if convert and "forward" not in layer.__dict__:
+                # rewrite tensor-dependent `if`/`while` in forward so the
+                # trace lowers them to lax.cond/while (dy2static analog);
+                # patched on the instance so hooks/functional_call are kept
+                import types as _types
+                fwd = dy2static.convert_function(type(layer).forward)
+                if fwd is not type(layer).forward:
+                    layer.__dict__["forward"] = _types.MethodType(fwd, layer)
 
             def pure(params, buffers, key, args, kwargs):
                 with state.functional_rng_ctx(key):
@@ -63,7 +73,8 @@ class StaticFunction:
 
             self._compiled = jax.jit(pure)
         else:
-            fn = self._target
+            fn = dy2static.convert_function(self._target) if convert \
+                else self._target
 
             def pure(key, args, kwargs):
                 with state.functional_mode_ctx():
@@ -195,16 +206,19 @@ class TrainStep:
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save analog: persists state_dict + a structural note.
-    Full StableHLO export lives in static/export.py."""
-    from ..framework.serialization import save as _save
-    _save({"state_dict": dict(layer.state_dict()),
-           "class": type(layer).__name__}, path + ".pdparams")
+    """paddle.jit.save analog (ref dygraph/jit.py:507): StableHLO export —
+    see static/export.py for the on-disk format."""
+    from ..static import export as _export
+    if input_spec is None and isinstance(layer, StaticFunction):
+        input_spec = layer._input_spec
+        layer = layer._target
+    return _export.save(layer, path, input_spec=input_spec, **configs)
 
 
 def load(path, **configs):
-    raise NotImplementedError(
-        "jit.load of serialized programs lands with static/export")
+    """paddle.jit.load analog (ref dygraph/jit.py:787) -> TranslatedLayer."""
+    from ..static import export as _export
+    return _export.load(path, **configs)
 
 
 def not_to_static(fn):

@@ -1,0 +1,475 @@
+"""dy2static — AST transpiler: tensor-dependent python control flow → lax.
+
+TPU-native redesign of the reference dygraph_to_static stack
+(ref python/paddle/fluid/dygraph/dygraph_to_static/ast_transformer.py,
+ifelse_transformer.py, loop_transformer.py, logical_transformer.py,
+program_translator.py:233): the reference rewrites python AST into
+ProgramDesc control-flow ops (conditional_block/while); here the same AST
+surgery rewrites `if`/`while` statements into runtime helpers that pick
+between plain python execution (concrete predicate — eager) and
+`lax.cond`/`lax.while_loop` (traced predicate — inside jax.jit), so one
+model source serves both programming models (SURVEY.md §7 P3).
+
+Mechanics: each converted `if`/`while` becomes a cluster of nested
+functions — branch bodies with `nonlocal` write-back, a getter and a
+resetter for the captured variable tuple — mirroring the reference's
+true_fn/false_fn + modified-name analysis (ifelse_transformer.py
+NameVisitor), but without variable renaming because `nonlocal` gives
+read/write access to the enclosing frame.
+
+Deliberate limits (same spirit as the reference's unsupported lists):
+- `if`/`while` bodies containing return/break/continue/yield are left as
+  python (they still work eagerly; under tracing they raise jax's
+  concretization error with a clear message);
+- `for` loops stay python: concrete ranges unroll under jit (the common
+  case); tensor-bounded iteration should use paddle_tpu.static.fori_loop;
+- variables flowing through converted control flow must be tensors/scalars
+  when traced (strings/objects are closure-captured, branch-invariant).
+"""
+import ast
+import functools
+import inspect
+import textwrap
+import types
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+# --------------------------------------------------------------------------- #
+# runtime helpers (the `_jst` namespace emitted code calls into)              #
+# --------------------------------------------------------------------------- #
+
+class _Undef:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<UNDEF>"
+
+
+UNDEF = _Undef()
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _any_traced(vals):
+    return any(_is_traced(_unwrap(v)) for v in vals)
+
+
+def _split_dynamic(vals):
+    """Partition a variable tuple into (dynamic indices, static values).
+    Dynamic = things lax can carry (tensors/arrays/python numbers)."""
+    dyn_idx = []
+    for i, v in enumerate(vals):
+        u = _unwrap(v)
+        if isinstance(u, (jax.Array, jax.core.Tracer, int, float, bool,
+                          complex)) and not isinstance(v, _Undef):
+            dyn_idx.append(i)
+    return dyn_idx
+
+
+def convert_ifelse(pred, true_fn, false_fn, get, reset):
+    """Emitted for `if`: concrete pred runs one branch in place; traced pred
+    lowers to lax.cond. Branch outputs are discovered during tracing: each
+    branch closes over the enclosing frame (captured tracers become cond
+    constants) and reports, per captured variable, whether it produced a
+    dynamic value (carried through cond) or a static one (must agree across
+    branches — same constraint the reference's ifelse_transformer imposes)."""
+    p = _unwrap(pred)
+    if not _is_traced(p):
+        (true_fn if bool(p) else false_fn)()
+        return get() if get is not None else ()
+    if get is None:
+        # no captured vars: still lower (branches may have jax side effects
+        # like debug prints); outputs are empty
+        jax.lax.cond(p, lambda _: (true_fn(), ())[1],
+                     lambda _: (false_fn(), ())[1], None)
+        return ()
+    orig = get()
+    specs = {}  # branch name -> list of ('dyn',) | ('static', value)
+
+    def run(fn, tag):
+        def branch(_):
+            reset(orig)
+            fn()
+            out = get()
+            spec, leaves = [], []
+            for v in out:
+                u = _unwrap(v)
+                if isinstance(u, (jax.Array, jax.core.Tracer)) or \
+                        isinstance(u, (int, float, bool)) and \
+                        not isinstance(v, _Undef):
+                    spec.append("dyn")
+                    leaves.append(jnp.asarray(u))
+                else:
+                    spec.append(("static", v))
+            specs[tag] = spec
+            return tuple(leaves)
+        return branch
+
+    res = jax.lax.cond(p, run(true_fn, "true"), run(false_fn, "false"), None)
+    spec_t, spec_f = specs["true"], specs["false"]
+    for i, (st, sf) in enumerate(zip(spec_t, spec_f)):
+        if (st == "dyn") != (sf == "dyn"):
+            raise ValueError(
+                "dy2static: a variable is a tensor in one branch of a "
+                "traced `if` but not the other — assign it consistently "
+                "in both branches")
+    final, j = [], 0
+    for i, s in enumerate(spec_t):
+        if s == "dyn":
+            final.append(Tensor(res[j]) if isinstance(orig[i], Tensor)
+                         or isinstance(orig[i], _Undef) else res[j])
+            j += 1
+        else:
+            final.append(s[1])
+    reset(tuple(final))
+    return tuple(final)
+
+
+def convert_while(cond_fn, body_fn, get, reset):
+    """Emitted for `while`: concrete → python loop; traced condition or
+    loop vars → lax.while_loop over the dynamic subset of captured vars
+    (static vars are loop-invariant closure constants)."""
+    first = _unwrap(cond_fn())
+    orig = get() if get is not None else ()
+    if not _is_traced(first) and not _any_traced(orig):
+        while bool(_unwrap(cond_fn())):
+            body_fn()
+        return get() if get is not None else ()
+    dyn_idx = _split_dynamic(orig)
+
+    def put(carry):
+        full = list(orig)
+        for j, i in enumerate(dyn_idx):
+            full[i] = Tensor(carry[j]) if isinstance(orig[i], Tensor) \
+                else carry[j]
+        reset(tuple(full))
+
+    def c(carry):
+        put(carry)
+        return _unwrap(cond_fn())
+
+    def b(carry):
+        put(carry)
+        body_fn()
+        out = get()
+        for i, v in enumerate(out):
+            if i not in dyn_idx and _is_traced(_unwrap(v)) \
+                    and not _is_traced(_unwrap(orig[i])):
+                raise ValueError(
+                    "dy2static: a variable becomes a tensor inside a traced "
+                    "`while` body — initialize it as a tensor before the "
+                    "loop (XLA loop carries need a fixed structure)")
+        new = []
+        for j, i in enumerate(dyn_idx):
+            u = jnp.asarray(_unwrap(out[i]))
+            new.append(u.astype(carry[j].dtype)
+                       if u.dtype != carry[j].dtype else u)
+        return tuple(new)
+
+    carry0 = tuple(jnp.asarray(_unwrap(orig[i])) for i in dyn_idx)
+    res = jax.lax.while_loop(c, b, carry0)
+    final = list(orig)
+    for j, i in enumerate(dyn_idx):
+        final[i] = Tensor(res[j]) if isinstance(orig[i], Tensor) else res[j]
+    reset(tuple(final))
+    return tuple(final)
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    """ref logical_transformer.py convert_logical_and — preserves python
+    short-circuit when concrete."""
+    l = lhs_fn()
+    lu = _unwrap(l)
+    if not _is_traced(lu):
+        if not bool(lu):
+            return l
+        return rhs_fn()
+    return Tensor(jnp.logical_and(lu, _unwrap(rhs_fn())))
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    l = lhs_fn()
+    lu = _unwrap(l)
+    if not _is_traced(lu):
+        if bool(lu):
+            return l
+        return rhs_fn()
+    return Tensor(jnp.logical_or(lu, _unwrap(rhs_fn())))
+
+
+def convert_logical_not(x):
+    u = _unwrap(x)
+    if not _is_traced(u):
+        return not bool(u)
+    return Tensor(jnp.logical_not(u))
+
+
+# --------------------------------------------------------------------------- #
+# AST transformation                                                          #
+# --------------------------------------------------------------------------- #
+
+_BLOCKERS = (ast.Return, ast.Break, ast.Continue, ast.Yield, ast.YieldFrom)
+
+
+def _scan(nodes):
+    """True when return/break/continue/yield appears in `nodes` (stopping at
+    nested function boundaries) — such blocks stay python (see module doc)."""
+    for n in nodes:
+        if isinstance(n, _BLOCKERS):
+            return True
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        for field in getattr(n, "_fields", ()):
+            v = getattr(n, field, None)
+            if isinstance(v, list):
+                if _scan([x for x in v if isinstance(x, ast.AST)]):
+                    return True
+            elif isinstance(v, ast.AST):
+                if _scan([v]):
+                    return True
+    return False
+
+
+class _NameCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.stored = set()
+        self.loaded = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.stored.add(node.id)
+        else:
+            self.loaded.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.stored.add(node.name)  # don't descend
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _names(nodes):
+    c = _NameCollector()
+    for n in nodes:
+        c.visit(n)
+    return c.stored, c.loaded
+
+
+class _TestTransformer(ast.NodeTransformer):
+    """BoolOp/Not inside if/while tests → _jst.convert_logical_*."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id="_jst", ctx=ast.Load()),
+                    attr=fn, ctx=ast.Load()),
+                args=[ast.Lambda(args=_empty_args(), body=expr),
+                      ast.Lambda(args=_empty_args(), body=rhs)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id="_jst", ctx=ast.Load()),
+                    attr="convert_logical_not", ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+
+def _empty_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    def visit_FunctionDef(self, node):
+        return node  # don't transform nested defs
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def _emit_cluster(self, n, vars_, defs, call_expr):
+        """Common tail: getter/resetter defs + result assignment."""
+        stmts = list(defs)
+        vt = ", ".join(vars_)
+        if vars_:
+            get_src = f"def __pt_get_{n}():\n    return ({vt},)"
+            reset_src = (f"def __pt_reset_{n}(__pt_v):\n"
+                         f"    nonlocal {vt}\n    ({vt},) = __pt_v")
+            stmts += [ast.parse(get_src).body[0],
+                      ast.parse(reset_src).body[0]]
+            assign = ast.parse(f"({vt},) = {call_expr}").body[0]
+        else:
+            assign = ast.parse(call_expr).body[0]
+        stmts.append(assign)
+        return stmts
+
+    def _guards(self, vars_):
+        return [ast.parse(
+            f"try:\n    {v}\nexcept NameError:\n    {v} = _jst.UNDEF"
+        ).body[0] for v in vars_]
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _scan(node.body) or _scan(node.orelse):
+            return node  # return/break/continue inside: leave as python
+        # only names ASSIGNED in a branch need capture/write-back; read-only
+        # names stay plain closure reads (and plain python ints stay ints —
+        # carrying them through lax.cond would trace-ify them)
+        stored, _loaded = _names(node.body + node.orelse)
+        vars_ = sorted(stored)
+        n = self.counter
+        self.counter += 1
+        test = _TestTransformer().visit(node.test)
+        ast.fix_missing_locations(test)
+        test_src = ast.unparse(test)
+
+        def mk_branch(name, body):
+            body_src = "\n".join(ast.unparse(s) for s in body) or "pass"
+            nl = f"    nonlocal {', '.join(vars_)}\n" if vars_ else ""
+            src = f"def {name}():\n{nl}" + textwrap.indent(
+                body_src, "    ")
+            if not body:
+                src = f"def {name}():\n{nl}    pass"
+            return ast.parse(src).body[0]
+
+        defs = self._guards(vars_) + [
+            mk_branch(f"__pt_true_{n}", node.body),
+            mk_branch(f"__pt_false_{n}", node.orelse)]
+        get = f"__pt_get_{n}" if vars_ else "None"
+        reset = f"__pt_reset_{n}" if vars_ else "None"
+        call = (f"_jst.convert_ifelse(({test_src}), __pt_true_{n}, "
+                f"__pt_false_{n}, {get}, {reset})")
+        return self._emit_cluster(n, vars_, defs, call)
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _scan(node.body):
+            return node
+        stored, _loaded = _names(node.body)
+        vars_ = sorted(stored)
+        n = self.counter
+        self.counter += 1
+        test = _TestTransformer().visit(node.test)
+        ast.fix_missing_locations(test)
+        test_src = ast.unparse(test)
+        nl = f"    nonlocal {', '.join(vars_)}\n" if vars_ else ""
+        cond_src = f"def __pt_cond_{n}():\n    return ({test_src})"
+        body_src = "\n".join(ast.unparse(s) for s in node.body) or "pass"
+        body_def = f"def __pt_body_{n}():\n{nl}" + textwrap.indent(
+            body_src, "    ")
+        defs = self._guards(vars_) + [ast.parse(cond_src).body[0],
+                                      ast.parse(body_def).body[0]]
+        get = f"__pt_get_{n}" if vars_ else "None"
+        reset = f"__pt_reset_{n}" if vars_ else "None"
+        call = (f"_jst.convert_while(__pt_cond_{n}, __pt_body_{n}, "
+                f"{get}, {reset})")
+        return self._emit_cluster(n, vars_, defs, call)
+
+
+_CACHE = {}
+
+
+def convert_function(fn):
+    """Rewrite `fn`'s tensor-dependent control flow; returns a new function
+    closed over the same globals (ref program_translator.py:233
+    ProgramTranslator + convert_to_static cache)."""
+    # closure cells are baked into the converted copy's globals, so the cache
+    # key must distinguish different closures over the same code object
+    cells = tuple(fn.__closure__) if getattr(fn, "__closure__", None) else ()
+    key = (getattr(fn, "__code__", None), cells)
+    if key in _CACHE:
+        return _CACHE[key]
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    tree = ast.parse(src)
+    fn_node = tree.body[0]
+    if not isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fn_node.decorator_list = []
+    has_cf = any(isinstance(s, (ast.If, ast.While))
+                 for s in ast.walk(fn_node))
+    if not has_cf:
+        _CACHE[key] = fn
+        return fn
+    tr = _ControlFlowTransformer()
+    new_body = []
+    for s in fn_node.body:
+        out = tr.visit(s)
+        if out is None:
+            continue
+        new_body.extend(out if isinstance(out, list) else [out])
+    fn_node.body = new_body
+    ast.fix_missing_locations(tree)
+
+    glb = dict(fn.__globals__)
+    glb["_jst"] = _JST
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    try:
+        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        exec(code, glb)
+        new_fn = glb[fn_node.name]
+    except SyntaxError as e:  # pragma: no cover - surface, keep original
+        warnings.warn(f"dy2static: could not convert {fn.__qualname__}: {e}")
+        _CACHE[key] = fn
+        return fn
+    new_fn = functools.wraps(fn)(new_fn)
+    _CACHE[key] = new_fn
+    return new_fn
+
+
+class _JSTNamespace(types.SimpleNamespace):
+    pass
+
+
+_JST = _JSTNamespace(
+    convert_ifelse=convert_ifelse,
+    convert_while=convert_while,
+    convert_logical_and=convert_logical_and,
+    convert_logical_or=convert_logical_or,
+    convert_logical_not=convert_logical_not,
+    UNDEF=UNDEF,
+)

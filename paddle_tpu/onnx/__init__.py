@@ -1,0 +1,19 @@
+"""paddle_tpu.onnx — ONNX export shim (ref python/paddle/onnx/export.py).
+
+The reference delegates entirely to the external `paddle2onnx` package; here
+the equivalent external path is jax→ONNX conversion. When no converter is
+installed the function fails with guidance and points at `paddle_tpu.jit.save`
+(StableHLO), the portable TPU-native artifact that covers the same
+deploy-elsewhere need."""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import tf2onnx  # noqa: F401  (not shipped in this image)
+    except ImportError:
+        raise NotImplementedError(
+            "ONNX export needs an external jax/tf->onnx converter (the "
+            "reference similarly requires the external paddle2onnx "
+            "package). For a portable compiled artifact use "
+            "paddle_tpu.jit.save(layer, path, input_spec) — StableHLO, "
+            "loadable on any XLA backend.") from None

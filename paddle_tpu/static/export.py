@@ -1,0 +1,198 @@
+"""Portable program export/import: StableHLO instead of ProgramDesc.
+
+TPU-native redesign of the reference's saved-program formats
+(ref python/paddle/fluid/io.py:1199 save_inference_model,
+fluid/dygraph/jit.py:507 jit.save -> TranslatedLayer dygraph/io.py:988,
+framework/framework.proto ProgramDesc): the portable graph artifact is a
+serialized StableHLO module (jax.export), the exact IR XLA consumes — no
+interpreter needed at load time, and the artifact is device-portable
+(CPU/TPU) the way ProgramDesc is place-agnostic.
+
+Format on disk for prefix `path`:
+  path.pdmodel   — jax.export bytes (StableHLO + calling convention)
+  path.pdiparams — params/buffers via framework.serialization (pickle+numpy)
+  path.meta.json — input specs + output tree structure
+"""
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import export as jexport
+
+from ..framework import state
+from ..framework.serialization import save as _save_obj, load as _load_obj
+from ..framework.tensor import Tensor, Parameter
+from ..framework.dtype import convert_dtype
+from .program import InputSpec
+
+
+def _specs_from_inputs(input_spec):
+    """InputSpec dims of None/-1 become export symbolic dims, so the loaded
+    program accepts any size there (ProgramDesc's -1 dims equivalent)."""
+    specs = []
+    scope = None
+    counter = [0]
+
+    def dim_str(d):
+        if d is None or (isinstance(d, int) and d < 0):
+            counter[0] += 1
+            return f"_d{counter[0]}"
+        return str(int(d))
+
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            if any(d is None or (isinstance(d, int) and d < 0)
+                   for d in s.shape):
+                if scope is None:
+                    scope = jexport.SymbolicScope()
+                shape = jexport.symbolic_shape(
+                    ",".join(dim_str(d) for d in s.shape), scope=scope)
+                specs.append(jax.ShapeDtypeStruct(shape, s.dtype))
+            else:
+                specs.append(jax.ShapeDtypeStruct(
+                    tuple(int(d) for d in s.shape), s.dtype))
+        elif isinstance(s, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+        else:
+            a = np.asarray(s)
+            specs.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+    return specs
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save analog (ref dygraph/jit.py:507): trace the layer's
+    eval-mode forward with jax.jit, export to StableHLO, persist weights."""
+    was_training = getattr(layer, "training", False)
+    layer.eval()
+    params, buffers = layer.functional_state()
+    if input_spec is None:
+        input_spec = getattr(layer, "_input_spec", None)
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec=[InputSpec(shape, dtype), ...] "
+            "(or a Tensor example) to trace the forward")
+    in_specs = _specs_from_inputs(input_spec)
+
+    out_struct = {}
+
+    def fwd(params, buffers, *inputs):
+        out, _ = layer.functional_call(params, buffers, *inputs)
+        flat, _tree = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda t: isinstance(t, Tensor))
+        out_struct["n"] = len(flat)
+        return tuple(t._data if isinstance(t, Tensor) else t for t in flat)
+
+    p_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in params.items()}
+    b_specs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in buffers.items()}
+    exported = jexport.export(jax.jit(fwd))(p_specs, b_specs, *in_specs)
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    _save_obj({"params": {k: Tensor(v) for k, v in params.items()},
+               "buffers": {k: Tensor(v) for k, v in buffers.items()}},
+              path + ".pdiparams")
+    meta = {
+        "inputs": [{"shape": [d if isinstance(d, int) else str(d)
+                              for d in s.shape],
+                    "dtype": str(np.dtype(s.dtype))
+                    if s.dtype != jnp.bfloat16 else "bfloat16"}
+                   for s in in_specs],
+        "n_outputs": out_struct["n"],
+        "class": type(layer).__name__,
+    }
+    with open(path + ".meta.json", "w") as f:
+        json.dump(meta, f)
+    if was_training:
+        layer.train()  # don't leave a mid-training checkpoint in eval mode
+    return path
+
+
+class TranslatedLayer:
+    """Loaded program (ref fluid/dygraph/io.py:988 TranslatedLayer): wraps
+    the deserialized StableHLO executable; callable like a Layer in eval
+    mode. Weights are editable via state_dict/set_state_dict (they are
+    passed to the program at every call, not baked in)."""
+
+    def __init__(self, exported, params, buffers, meta):
+        self._exported = exported
+        self._params = {k: v._data if isinstance(v, Tensor) else v
+                        for k, v in params.items()}
+        self._buffers = {k: v._data if isinstance(v, Tensor) else v
+                         for k, v in buffers.items()}
+        self._meta = meta
+        self.training = False
+
+    def __call__(self, *inputs):
+        arrays = tuple(i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                       for i in inputs)
+        outs = self._exported.call(self._params, self._buffers, *arrays)
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if self._meta.get("n_outputs") == 1 else tuple(outs)
+
+    forward = __call__
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer is an exported inference program; rebuild the "
+            "python Layer to train (same as the reference TranslatedLayer)")
+
+    def state_dict(self):
+        d = {k: Tensor(v) for k, v in self._params.items()}
+        d.update({k: Tensor(v) for k, v in self._buffers.items()})
+        return d
+
+    def set_state_dict(self, sd):
+        for k, v in sd.items():
+            arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            if k in self._params:
+                self._params[k] = arr
+            elif k in self._buffers:
+                self._buffers[k] = arr
+        return self
+
+
+def load(path, **configs):
+    """paddle.jit.load analog (ref dygraph/jit.py:787)."""
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    blob = _load_obj(path + ".pdiparams")
+    with open(path + ".meta.json") as f:
+        meta = json.load(f)
+    return TranslatedLayer(exported, blob["params"], blob["buffers"], meta)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """ref python/paddle/static/io.py save_inference_model. In the TPU
+    design the artifact is the same StableHLO bundle as jit.save; feed_vars
+    carry the InputSpecs and fetch_vars must come from a layer-backed
+    forward (`fetch_vars` = the layer, matching the common
+    `save_inference_model(path, [x], model)` migration)."""
+    layer = kwargs.pop("layer", None)
+    target = layer if layer is not None else fetch_vars
+    if not hasattr(target, "functional_call"):
+        raise ValueError(
+            "save_inference_model on the TPU build exports a Layer's "
+            "forward; pass the Layer as fetch_vars (or layer=...)")
+    specs = [s if isinstance(s, (InputSpec, Tensor)) else InputSpec(
+        s.shape, s.dtype) for s in feed_vars]
+    return save(target, path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """ref python/paddle/static/io.py load_inference_model — returns
+    (program, feed_names, fetch_names) shaped like the reference; program
+    is the TranslatedLayer (callable)."""
+    tl = load(path_prefix)
+    feed_names = [f"feed_{i}" for i in range(len(tl._meta["inputs"]))]
+    fetch_names = [f"fetch_{i}" for i in range(tl._meta["n_outputs"])]
+    return tl, feed_names, fetch_names

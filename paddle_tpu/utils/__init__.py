@@ -1,4 +1,8 @@
 """paddle_tpu.utils (ref python/paddle/utils)."""
+from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401
+
+
 def try_import(name):
     import importlib
     try:

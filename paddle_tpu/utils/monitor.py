@@ -1,0 +1,54 @@
+"""Process-wide stat registry.
+
+TPU-native analog of the reference monitor (ref
+paddle/fluid/platform/monitor.h:77 StatRegistry, STAT_ADD :130): named int
+counters for memory/throughput bookkeeping, queryable from python the way
+the reference exposes them via pybind/global_value_getter_setter.cc.
+Device memory stats come from PJRT (`jax.local_devices()[0].memory_stats()`)
+instead of a custom allocator (ref memory/allocation)."""
+import threading
+
+_lock = threading.Lock()
+_stats = {}
+
+
+def stat_add(name, value=1):
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + value
+        return _stats[name]
+
+
+def stat_set(name, value):
+    with _lock:
+        _stats[name] = value
+
+
+def stat_get(name, default=0):
+    with _lock:
+        return _stats.get(name, default)
+
+
+def stat_reset(name=None):
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def all_stats():
+    with _lock:
+        return dict(_stats)
+
+
+def device_memory_stats(device=None):
+    """PJRT memory stats for a device — replaces the reference's allocator
+    STAT_ADD("gpu_mem", ...) counters (memory/stats.h)."""
+    import jax
+    dev = device or jax.local_devices()[0]
+    stats = dev.memory_stats() or {}
+    return {
+        "bytes_in_use": stats.get("bytes_in_use", 0),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+        "bytes_limit": stats.get("bytes_limit", 0),
+    }

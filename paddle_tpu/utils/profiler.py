@@ -1,0 +1,128 @@
+"""Profiler: host event recorder + XLA/TPU device trace bridge.
+
+TPU-native redesign of the reference profiler
+(ref paddle/fluid/platform/profiler.h:127,210 RecordEvent /
+EnableProfiler/DisableProfiler, device_tracer.cc CUPTI bridge,
+tools/timeline.py chrome-trace writer): host-side RAII events aggregate into
+the same kind of per-op summary table; the device side delegates to
+`jax.profiler` (XPlane), whose traces open in TensorBoard/Perfetto — the
+CUPTI-equivalent on TPU. `export_chrome_tracing` keeps the
+chrome://tracing workflow of tools/timeline.py.
+"""
+import contextlib
+import json
+import threading
+import time
+
+_lock = threading.Lock()
+_enabled = False
+_events = []          # (name, start_s, dur_s, thread_id)
+_active_trace_dir = None
+
+
+class RecordEvent:
+    """RAII host event (ref platform/profiler.h:127). Usable as context
+    manager or decorator; nesting is recorded flat like the reference."""
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled and self._t0 is not None:
+            with _lock:
+                _events.append((self.name, self._t0,
+                                time.perf_counter() - self._t0,
+                                threading.get_ident()))
+        return False
+
+    def __call__(self, fn):
+        def wrapped(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+        return wrapped
+
+
+def start_profiler(state="All", tracer_option="Default", trace_dir=None):
+    """ref EnableProfiler (profiler.h:210). When `trace_dir` is given, also
+    start a jax.profiler device trace (XPlane -> TensorBoard)."""
+    global _enabled, _active_trace_dir
+    with _lock:
+        _events.clear()
+    _enabled = True
+    if trace_dir is not None:
+        import jax
+        jax.profiler.start_trace(trace_dir)
+        _active_trace_dir = trace_dir
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    """ref DisableProfiler. Prints the aggregated per-event table; writes a
+    chrome trace json when profile_path is given (tools/timeline.py analog)."""
+    global _enabled, _active_trace_dir
+    _enabled = False
+    if _active_trace_dir is not None:
+        import jax
+        jax.profiler.stop_trace()
+        _active_trace_dir = None
+    stats = summary(sorted_key)
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    return stats
+
+
+def summary(sorted_key="total"):
+    """Aggregate events -> list of dicts (name, calls, total_ms, avg_ms,
+    min_ms, max_ms), printed like the reference profiler table."""
+    agg = {}
+    with _lock:
+        evs = list(_events)
+    for name, _t0, dur, _tid in evs:
+        a = agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+        a[0] += 1
+        a[1] += dur
+        a[2] = min(a[2], dur)
+        a[3] = max(a[3], dur)
+    rows = [{"name": n, "calls": c, "total_ms": t * 1e3,
+             "avg_ms": t * 1e3 / c, "min_ms": lo * 1e3, "max_ms": hi * 1e3}
+            for n, (c, t, lo, hi) in agg.items()]
+    key = {"total": "total_ms", "calls": "calls", "max": "max_ms",
+           "min": "min_ms", "ave": "avg_ms"}.get(sorted_key, "total_ms")
+    rows.sort(key=lambda r: r[key], reverse=True)
+    if rows:
+        w = max(len(r["name"]) for r in rows)
+        print(f"{'Event':<{w}}  Calls  Total(ms)  Avg(ms)  Min(ms)  Max(ms)")
+        for r in rows:
+            print(f"{r['name']:<{w}}  {r['calls']:>5}  {r['total_ms']:>9.3f}"
+                  f"  {r['avg_ms']:>7.3f}  {r['min_ms']:>7.3f}"
+                  f"  {r['max_ms']:>7.3f}")
+    return rows
+
+
+def export_chrome_tracing(path):
+    """Write host events as chrome://tracing json (tools/timeline.py)."""
+    with _lock:
+        evs = list(_events)
+    trace = {"traceEvents": [
+        {"name": name, "ph": "X", "ts": t0 * 1e6, "dur": dur * 1e6,
+         "pid": 0, "tid": tid % 10000, "cat": "host"}
+        for name, t0, dur, tid in evs]}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None,
+             trace_dir=None):
+    """with profiler(): ... — start/stop convenience
+    (ref python/paddle/fluid/profiler.py profiler ctx)."""
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,174 @@
+"""dy2static AST transpiler: python if/while on tensors -> lax under jit.
+
+Mirrors ref dygraph_to_static tests (test_ifelse.py, test_loop.py,
+test_logical.py) for the lax-lowering design.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import convert_function
+
+
+def test_if_converted_eager_and_traced():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = -x
+        return y
+
+    g = convert_function(f)
+    # eager concrete: python semantics
+    out = g(pt.to_tensor([1.0, 2.0]))
+    np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+    out = g(pt.to_tensor([-1.0, -2.0]))
+    np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+    # traced: lowers to lax.cond — both signs work through ONE jitted fn
+    jf = jax.jit(lambda a: g(pt.to_tensor(a))._data)
+    np.testing.assert_allclose(jf(jnp.asarray([1.0, 2.0])), [2.0, 4.0])
+    np.testing.assert_allclose(jf(jnp.asarray([-1.0, -2.0])), [1.0, 2.0])
+
+
+def test_while_converted_traced():
+    def f(n):
+        i = pt.to_tensor(jnp.asarray(0, jnp.int32))
+        s = pt.to_tensor(jnp.asarray(0.0))
+        while i < n:
+            s = s + 2.0
+            i = i + 1
+        return s
+
+    g = convert_function(f)
+    assert float(g(pt.to_tensor(3)).numpy()) == 6.0
+    jf = jax.jit(lambda n: g(pt.to_tensor(n))._data)
+    assert float(jf(jnp.asarray(5, jnp.int32))) == 10.0
+
+
+def test_elif_chain():
+    def f(x):
+        if x.sum() > 10:
+            y = x * 100
+        elif x.sum() > 0:
+            y = x * 10
+        else:
+            y = x
+        return y
+
+    g = convert_function(f)
+    jf = jax.jit(lambda a: g(pt.to_tensor(a))._data)
+    np.testing.assert_allclose(jf(jnp.asarray([20.0])), [2000.0])
+    np.testing.assert_allclose(jf(jnp.asarray([1.0])), [10.0])
+    np.testing.assert_allclose(jf(jnp.asarray([-1.0])), [-1.0])
+
+
+def test_bool_ops_in_test():
+    def f(x):
+        if (x.sum() > 0) and (x.max() < 10):
+            y = x + 1
+        else:
+            y = x - 1
+        return y
+
+    g = convert_function(f)
+    jf = jax.jit(lambda a: g(pt.to_tensor(a))._data)
+    np.testing.assert_allclose(jf(jnp.asarray([1.0])), [2.0])
+    np.testing.assert_allclose(jf(jnp.asarray([100.0])), [99.0])
+    np.testing.assert_allclose(jf(jnp.asarray([-1.0])), [-2.0])
+
+
+def test_return_inside_if_stays_python():
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        return -x
+
+    g = convert_function(f)
+    # eager still fine
+    np.testing.assert_allclose(g(pt.to_tensor([2.0])).numpy(), [4.0])
+    np.testing.assert_allclose(g(pt.to_tensor([-2.0])).numpy(), [2.0])
+    # traced: raises jax concretization error (documented limit)
+    with pytest.raises(Exception):
+        jax.jit(lambda a: g(pt.to_tensor(a))._data)(jnp.asarray([1.0]))
+
+
+def test_layer_forward_with_control_flow_to_static():
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.sum() > 0:
+                out = h * 2
+            else:
+                out = h * 0.5
+            return out
+
+    pt.seed(0)
+    net = Gate()
+    sf = to_static(net)
+    x = np.random.RandomState(0).randn(2, 4).astype("float32")
+    want = net(pt.to_tensor(x)).numpy()  # eager reference
+    got = sf(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+
+def test_while_with_mixed_scalars():
+    def f(x):
+        k = 0
+        while k < 3:
+            x = x * 2.0
+            k = k + 1
+        return x
+
+    g = convert_function(f)
+    assert float(g(pt.to_tensor(1.0)).numpy()) == 8.0
+    jf = jax.jit(lambda a: g(pt.to_tensor(a))._data)
+    assert float(jf(jnp.asarray(1.0))) == 8.0
+
+
+def test_undefined_var_in_one_branch_traced_errors():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            z = x  # y undefined here
+        return x
+
+    g = convert_function(f)
+    # eager fine (taken branch defines what it needs)
+    g(pt.to_tensor([1.0]))
+    with pytest.raises(Exception):
+        jax.jit(lambda a: g(pt.to_tensor(a))._data)(jnp.asarray([1.0]))
+
+
+def test_grad_through_converted_if():
+    def f(x):
+        if x.sum() > 0:
+            y = x * x
+        else:
+            y = x * 3.0
+        return y.sum()
+
+    g = convert_function(f)
+    grad = jax.grad(lambda a: g(pt.to_tensor(a))._data)(jnp.asarray([2.0]))
+    np.testing.assert_allclose(grad, [4.0])
+    grad = jax.grad(lambda a: g(pt.to_tensor(a))._data)(jnp.asarray([-2.0]))
+    np.testing.assert_allclose(grad, [3.0])
+
+
+def test_conversion_cache():
+    def f(x):
+        if x.sum() > 0:
+            y = x
+        else:
+            y = -x
+        return y
+
+    assert convert_function(f) is convert_function(f)

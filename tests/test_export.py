@@ -1,0 +1,99 @@
+"""jit.save/load StableHLO export roundtrip (ref unittests
+test_jit_save_load.py, test_inference_model_io.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 8)
+        self.fc2 = nn.Linear(8, 3)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    pt.seed(0)
+    net = Net()
+    path = str(tmp_path / "model")
+    pt.jit.save(net, path, input_spec=[InputSpec([2, 4], "float32")])
+
+    loaded = pt.jit.load(path)
+    x = np.random.RandomState(0).randn(2, 4).astype("float32")
+    want = net(pt.to_tensor(x)).numpy()
+    got = loaded(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_translated_layer_state_dict_edit(tmp_path):
+    pt.seed(0)
+    net = Net()
+    path = str(tmp_path / "model")
+    pt.jit.save(net, path, input_spec=[InputSpec([1, 4], "float32")])
+    loaded = pt.jit.load(path)
+    sd = loaded.state_dict()
+    assert any("fc1" in k for k in sd)
+    # zero all weights -> output must change to bias-only path
+    loaded.set_state_dict({k: pt.zeros(v.shape) for k, v in sd.items()})
+    out = loaded(pt.to_tensor(np.ones((1, 4), dtype="float32")))
+    np.testing.assert_allclose(out.numpy(), 0.0, atol=1e-7)
+    with pytest.raises(RuntimeError):
+        loaded.train()
+
+
+def test_save_inference_model(tmp_path):
+    pt.seed(0)
+    net = Net()
+    prefix = str(tmp_path / "infer")
+    pt.static.export.save_inference_model(
+        prefix, [InputSpec([8, 4], "float32")], net)
+    prog, feeds, fetches = pt.static.export.load_inference_model(prefix)
+    assert len(feeds) == 1 and len(fetches) == 1
+    x = np.random.RandomState(1).randn(8, 4).astype("float32")
+    np.testing.assert_allclose(prog(x).numpy(),
+                               net(pt.to_tensor(x)).numpy(), atol=1e-6)
+
+
+def test_dynamic_batch_dim(tmp_path):
+    """InputSpec None dims export symbolically: any batch size at load."""
+    pt.seed(0)
+    net = Net()
+    path = str(tmp_path / "dyn")
+    pt.jit.save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+    loaded = pt.jit.load(path)
+    for b in (1, 3, 8):
+        x = np.random.RandomState(b).randn(b, 4).astype("float32")
+        np.testing.assert_allclose(loaded(pt.to_tensor(x)).numpy(),
+                                   net(pt.to_tensor(x)).numpy(), atol=1e-6)
+
+
+def test_save_restores_training_mode(tmp_path):
+    pt.seed(0)
+    net = Net()
+    net.train()
+    pt.jit.save(net, str(tmp_path / "m"),
+                input_spec=[InputSpec([1, 4], "float32")])
+    assert net.training
+
+
+def test_onnx_export_guidance():
+    net = Net()
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        pt.onnx.export(net, "x", input_spec=[InputSpec([1, 4], "float32")])
+
+
+def test_exported_artifact_is_stablehlo(tmp_path):
+    pt.seed(0)
+    net = Net()
+    path = str(tmp_path / "m")
+    pt.jit.save(net, path, input_spec=[InputSpec([1, 4], "float32")])
+    from jax import export as jexport
+    with open(path + ".pdmodel", "rb") as f:
+        exp = jexport.deserialize(f.read())
+    assert "stablehlo" in exp.mlir_module() or "module" in exp.mlir_module()

@@ -1,0 +1,100 @@
+"""Platform services: flags (env bootstrap + set/get), nan/inf check,
+profiler host events, monitor stats, typed errors.
+
+Mirrors ref platform/enforce.h tests, flags.cc knobs, monitor.h STAT_ADD,
+profiler.h RecordEvent — re-expressed on the TPU substrate.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.framework import errors
+from paddle_tpu.utils import monitor, profiler
+
+
+def test_set_get_flags():
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    assert pt.get_flags(["FLAGS_check_nan_inf"])["FLAGS_check_nan_inf"]
+    pt.set_flags({"FLAGS_check_nan_inf": False})
+    flags = pt.get_flags()
+    assert "FLAGS_matmul_precision" in flags
+
+
+def test_env_flag_bootstrap():
+    code = ("import paddle_tpu as pt; "
+            "print(pt.get_flags(['FLAGS_check_nan_inf']))")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, "FLAGS_check_nan_inf": "1",
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, cwd="/root/repo")
+    assert "True" in out.stdout, out.stderr
+
+
+def test_check_nan_inf_raises():
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = pt.to_tensor([1.0, 0.0])
+        with pytest.raises(errors.PreconditionNotMetError, match="log"):
+            pt.log(x - 1.0)  # log(0) = -inf, log(-1) = nan
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+    # off: no raise
+    out = pt.log(pt.to_tensor([0.0]))
+    assert np.isinf(out.numpy()).all()
+
+
+def test_enforce():
+    errors.enforce(True, "fine")
+    with pytest.raises(errors.PreconditionNotMetError):
+        errors.enforce(False, "boom")
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce_eq(1, 2)
+    errors.enforce_shape(pt.zeros([2, 3]), (2, -1))
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce_shape(pt.zeros([2, 3]), (3, 3))
+    # typed taxonomy maps onto builtin exception hierarchy
+    assert issubclass(errors.NotFoundError, KeyError)
+    assert issubclass(errors.UnimplementedError, NotImplementedError)
+
+
+def test_profiler_events_and_chrome_trace(tmp_path):
+    profiler.start_profiler()
+    with profiler.RecordEvent("matmul_step"):
+        (pt.ones([8, 8]) @ pt.ones([8, 8])).numpy()
+    with profiler.RecordEvent("matmul_step"):
+        (pt.ones([8, 8]) @ pt.ones([8, 8])).numpy()
+    path = str(tmp_path / "trace.json")
+    rows = profiler.stop_profiler(profile_path=path)
+    ev = {r["name"]: r for r in rows}
+    assert ev["matmul_step"]["calls"] == 2
+    trace = json.load(open(path))
+    assert len(trace["traceEvents"]) == 2
+    assert trace["traceEvents"][0]["name"] == "matmul_step"
+
+
+def test_record_event_decorator():
+    profiler.start_profiler()
+
+    @profiler.RecordEvent("fn")
+    def fn():
+        return 1
+    fn()
+    rows = profiler.stop_profiler()
+    assert any(r["name"] == "fn" for r in rows)
+
+
+def test_monitor_stats():
+    monitor.stat_reset()
+    monitor.stat_add("reader_queue", 3)
+    monitor.stat_add("reader_queue", 2)
+    assert monitor.stat_get("reader_queue") == 5
+    monitor.stat_set("epoch", 7)
+    assert monitor.all_stats()["epoch"] == 7
+    stats = monitor.device_memory_stats()
+    assert "bytes_in_use" in stats
