@@ -1,0 +1,181 @@
+"""Multi-process launcher: `python -m paddle_tpu.distributed.launch train.py`.
+
+TPU-native redesign of the reference launcher
+(ref python/paddle/distributed/fleet/launch.py:208,260,334 launch_collective,
+launch_utils.py:57 Cluster/Pod/Trainer model, :435 TrainerProc watch loop):
+same cluster model and per-rank env contract, but the per-rank env also
+carries the JAX distributed-initialization variables so worker processes
+rendezvous through the jax coordination service (the ncclUniqueId-TCP
+bootstrap analog, ref platform/gen_comm_id_helper.cc:284 — here the
+coordinator is jax.distributed's builtin service on rank 0).
+
+Failure handling mirrors TrainerProc/watch_local_trainers: any dead worker
+tears the pod down (ref launch_utils.py watch_local_trainers + the PS-mode
+HeartBeatMonitor semantics, operators/distributed/heart_beat_monitor.h:51).
+
+On a real pod each host runs its own slice of ranks; on one host this gives
+the multi-process localhost harness the reference tests rely on
+(SURVEY.md §4).
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+class Trainer:
+    def __init__(self, rank, endpoint, devices):
+        self.rank = rank
+        self.endpoint = endpoint
+        self.devices = devices
+
+
+class Pod:
+    """One host's worth of trainers (ref launch_utils.py:57 Cluster/Pod)."""
+
+    def __init__(self, trainers, coordinator):
+        self.trainers = trainers
+        self.coordinator = coordinator
+
+
+def get_cluster(nproc, start_port=36777, ips="127.0.0.1"):
+    hosts = [h for h in ips.split(",") if h]
+    per_host = nproc // len(hosts)
+    trainers = []
+    for hi, host in enumerate(hosts):
+        for i in range(per_host):
+            rank = hi * per_host + i
+            trainers.append(Trainer(rank, f"{host}:{start_port + i}", [i]))
+    return Pod(trainers, f"{hosts[0]}:{start_port - 1}")
+
+
+def _rank_env(pod, trainer, nproc, training_script_args):
+    env = dict(os.environ)
+    env.update({
+        # reference contract (launch_utils.py:258 get_proc_env)
+        "PADDLE_TRAINER_ID": str(trainer.rank),
+        "PADDLE_CURRENT_ENDPOINT": trainer.endpoint,
+        "PADDLE_TRAINERS_NUM": str(nproc),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(
+            t.endpoint for t in pod.trainers),
+        # jax coordination service (the TPU-native bootstrap)
+        "COORDINATOR_ADDRESS": pod.coordinator,
+        "PROCESS_ID": str(trainer.rank),
+        "NUM_PROCESSES": str(nproc),
+    })
+    return env
+
+
+def launch_procs(pod, script, script_args, nproc, log_dir=None):
+    """Start one process per trainer; monitor; teardown-all on any failure
+    (ref launch_utils.py:435 TrainerProc + watch_local_trainers)."""
+    procs = []
+    logs = []
+    for t in pod.trainers:
+        env = _rank_env(pod, t, nproc, script_args)
+        cmd = [sys.executable, "-u", script] + list(script_args)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            f = open(os.path.join(log_dir, f"workerlog.{t.rank}"), "w")
+            logs.append(f)
+            p = subprocess.Popen(cmd, env=env, stdout=f, stderr=f)
+        else:
+            p = subprocess.Popen(cmd, env=env)
+        procs.append(p)
+    try:
+        alive = True
+        ret = 0
+        while alive:
+            alive = False
+            for i, p in enumerate(procs):
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    # a worker died: tear down the pod (heart-beat analog)
+                    sys.stderr.write(
+                        f"trainer rank {pod.trainers[i].rank} failed "
+                        f"(exit {rc}); aborting pod\n")
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    ret = rc
+                    alive = False
+                    break
+            if alive:
+                time.sleep(0.5)
+        for p in procs:
+            p.wait()
+        return ret if ret else max(
+            (p.returncode or 0 for p in procs), default=0)
+    finally:
+        for f in logs:
+            f.close()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        "paddle_tpu.distributed.launch",
+        description="launch a distributed job: one process per device/rank")
+    parser.add_argument("--nproc_per_node", type=int, default=None)
+    parser.add_argument("--ips", type=str, default="127.0.0.1",
+                        help="comma-split host ips (ref launch.py --ips)")
+    parser.add_argument("--start_port", type=int, default=36777)
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("--server_num", type=int, default=0,
+                        help="PS mode: number of parameter servers")
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    nproc = args.nproc_per_node
+    if nproc is None:
+        try:
+            import jax
+            nproc = max(1, jax.local_device_count())
+        except Exception:
+            nproc = 1
+
+    if args.server_num:
+        return _launch_ps(args, nproc)
+
+    pod = get_cluster(nproc, args.start_port, args.ips)
+    return launch_procs(pod, args.training_script,
+                        args.training_script_args, nproc, args.log_dir)
+
+
+def _launch_ps(args, nproc):
+    """PS mode: servers + workers with TRAINING_ROLE env
+    (ref launch.py launch_ps)."""
+    host = args.ips.split(",")[0]
+    server_eps = ",".join(f"{host}:{args.start_port + i}"
+                          for i in range(args.server_num))
+    procs = []
+    for role, count in (("PSERVER", args.server_num), ("TRAINER", nproc)):
+        for i in range(count):
+            env = dict(os.environ)
+            env.update({
+                "TRAINING_ROLE": role,
+                "PADDLE_PSERVERS_IP_PORT_LIST": server_eps,
+                "PADDLE_TRAINERS_NUM": str(nproc),
+                "PADDLE_TRAINER_ID": str(i),
+                "POD_IP": host,
+                "PADDLE_PORT": str(args.start_port + i),
+            })
+            cmd = [sys.executable, "-u", args.training_script] + \
+                list(args.training_script_args)
+            procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs[args.server_num:]:   # wait for trainers
+        rc = p.wait() or rc
+    for p in procs[:args.server_num]:   # then stop servers
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+            p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,189 @@
+"""paddle_tpu.distribution — probability distributions.
+
+TPU-native version of the reference distributions
+(ref python/paddle/fluid/layers/distributions.py:30,115,260,425,531 —
+Distribution/Uniform/Normal/Categorical/MultivariateNormalDiag, and the
+paddle 2.x paddle.distribution namespace): sampling draws from the
+framework RNG (threefry keys, reproducible under jit) instead of a
+per-call CUDA generator; densities are pure jnp so they fuse into
+surrounding programs.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import state
+from ..framework.tensor import Tensor
+
+
+def _arr(x, dtype=None):
+    if isinstance(x, Tensor):
+        a = x._data
+    else:
+        a = jnp.asarray(x, dtype=jnp.float32 if isinstance(
+            x, (int, float, list, tuple)) else None)
+    if dtype is not None and a.dtype != dtype:
+        a = a.astype(dtype)
+    return a
+
+
+class Distribution:
+    """ref distributions.py:30."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U[low, high) (ref distributions.py:115)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape=()):
+        key = state.next_rng_key()
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(key, shape, dtype=self.low.dtype)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = jnp.logical_and(v >= self.low, v < self.high)
+        lp = jnp.where(inside, -jnp.log(self.high - self.low),
+                       -jnp.inf)
+        return Tensor(lp)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale^2) (ref distributions.py:260)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=()):
+        key = state.next_rng_key()
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        z = jax.random.normal(key, shape, dtype=self.loc.dtype)
+        return Tensor(self.loc + z * self.scale)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale * self.scale
+        lp = (-((v - self.loc) ** 2) / (2 * var)
+              - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        return Tensor(lp)
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def kl_divergence(self, other):
+        """KL(self || other), other Normal (ref distributions.py kl_divergence)."""
+        var_a = self.scale ** 2
+        var_b = other.scale ** 2
+        kl = (jnp.log(other.scale / self.scale)
+              + (var_a + (self.loc - other.loc) ** 2) / (2 * var_b) - 0.5)
+        return Tensor(kl)
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of `logits` (ref distributions.py:425)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+        self._log_p = jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        key = state.next_rng_key()
+        return Tensor(jax.random.categorical(key, self.logits,
+                                             shape=tuple(shape)
+                                             + self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        if self._log_p.ndim == 1:
+            return Tensor(self._log_p[v])
+        return Tensor(jnp.take_along_axis(
+            self._log_p, v[..., None], axis=-1).squeeze(-1))
+
+    def probs(self, value=None):
+        p = jnp.exp(self._log_p)
+        if value is None:
+            return Tensor(p)
+        v = _arr(value).astype(jnp.int32)
+        if p.ndim == 1:
+            return Tensor(p[v])
+        return Tensor(jnp.take_along_axis(p, v[..., None],
+                                          axis=-1).squeeze(-1))
+
+    def entropy(self):
+        p = jnp.exp(self._log_p)
+        return Tensor(-jnp.sum(p * self._log_p, axis=-1))
+
+    def kl_divergence(self, other):
+        p = jnp.exp(self._log_p)
+        return Tensor(jnp.sum(p * (self._log_p - other._log_p), axis=-1))
+
+
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance MVN (ref distributions.py:531)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)  # diagonal std
+
+    @property
+    def _dim(self):
+        return self.loc.shape[-1]
+
+    def sample(self, shape=()):
+        key = state.next_rng_key()
+        z = jax.random.normal(key, tuple(shape) + self.loc.shape,
+                              dtype=self.loc.dtype)
+        return Tensor(self.loc + z * self.scale)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lp = (-0.5 * jnp.sum(((v - self.loc) / self.scale) ** 2, axis=-1)
+              - jnp.sum(jnp.log(self.scale), axis=-1)
+              - 0.5 * self._dim * math.log(2 * math.pi))
+        return Tensor(lp)
+
+    def entropy(self):
+        return Tensor(0.5 * self._dim * (1 + math.log(2 * math.pi))
+                      + jnp.sum(jnp.log(self.scale), axis=-1))
+
+    def kl_divergence(self, other):
+        var_a = self.scale ** 2
+        var_b = other.scale ** 2
+        kl = 0.5 * jnp.sum(
+            var_a / var_b + ((self.loc - other.loc) ** 2) / var_b
+            - 1.0 + jnp.log(var_b) - jnp.log(var_a), axis=-1)
+        return Tensor(kl)
+
+
+def kl_divergence(p, q):
+    """paddle.distribution.kl_divergence dispatch."""
+    return p.kl_divergence(q)

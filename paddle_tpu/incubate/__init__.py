@@ -1,1 +1,4 @@
 """paddle_tpu.incubate (ref python/paddle/fluid/incubate): auto-checkpoint etc."""
+from . import recompute  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import train_epoch_range, TrainEpochRange  # noqa: F401

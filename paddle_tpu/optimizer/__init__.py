@@ -2,3 +2,5 @@
 from . import lr
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
                         Adagrad, Adadelta, RMSProp, Lamb, Lars)
+from .wrappers import (ExponentialMovingAverage, ModelAverage,
+                       LookaheadOptimizer, GradientMergeOptimizer)

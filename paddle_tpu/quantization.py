@@ -1,0 +1,124 @@
+"""Quantization: QAT fake-quant + PTQ calibration (slim analog).
+
+TPU-native take on the reference slim quantization
+(ref python/paddle/fluid/contrib/slim/quantization/quantization_pass.py
+QuantizationTransformPass — inserts fake_quantize/dequantize ops into the
+program; imperative qat ImperativeQuantAware): instead of a graph pass, QAT
+wraps Linear/Conv layers so weights (and optionally activations) pass
+through a straight-through-estimator fake-quant — the rewrite the reference
+does on ProgramDesc happens here at the Layer level, and XLA fuses the
+quant/dequant pair into the matmul. int8 deploy on TPU means bf16/int8
+matmuls via XLA; the exported StableHLO carries the q/dq ops.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor
+from .ops.dispatch import def_op
+from . import nn
+
+
+@def_op("fake_quantize_dequantize", n_tensor_args=1)
+def fake_quantize_dequantize(x, bits=8, symmetric=True):
+    """Straight-through fake quant (ref fake_quantize_op.cc
+    FakeQuantizeDequantizeAbsMax): quantize to `bits` then dequantize;
+    gradient passes through unchanged."""
+    qmax = 2.0 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / qmax
+
+    def qdq(v):
+        return jnp.round(v / scale) * scale
+
+    # straight-through estimator: forward quantized, backward identity
+    return x + jax.lax.stop_gradient(qdq(x) - x)
+
+
+class FakeQuantWrapper(nn.Layer):
+    """Wraps one layer; fake-quants its weight (and input activations when
+    activation_quantize=True) before the wrapped forward."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 activation_quantize=True):
+        super().__init__()
+        self.wrapped = layer
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.activation_quantize = activation_quantize
+
+    def forward(self, x, *args, **kwargs):
+        if self.activation_quantize:
+            x = fake_quantize_dequantize(x, bits=self.activation_bits)
+        w = self.wrapped.weight
+        saved = w._data
+        w._data = fake_quantize_dequantize(
+            Tensor(saved), bits=self.weight_bits)._data
+        try:
+            out = self.wrapped(x, *args, **kwargs)
+        finally:
+            w._data = saved
+        return out
+
+
+_QUANTIZABLE = (nn.Linear, nn.Conv2D, nn.Conv1D, nn.Conv3D)
+
+
+class ImperativeQuantAware:
+    """ref slim ImperativeQuantAware: quantize(model) swaps quantizable
+    sublayers for fake-quant wrappers in place."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_layer_type=None):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.types = tuple(quantizable_layer_type or _QUANTIZABLE)
+
+    def quantize(self, model):
+        for holder in model.sublayers(include_self=True) \
+                if hasattr(model, "sublayers") else [model]:
+            subs = getattr(holder, "_sub_layers", {})
+            for name, sub in list(subs.items()):
+                if isinstance(sub, self.types):
+                    subs[name] = FakeQuantWrapper(
+                        sub, self.weight_bits, self.activation_bits)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .static import export
+        return export.save(model, path, input_spec=input_spec)
+
+
+class PostTrainingQuantization:
+    """PTQ calibration (ref slim post_training_quantization.py): run
+    representative batches, record per-layer abs-max activation scales."""
+
+    def __init__(self, model, algo="abs_max"):
+        self.model = model
+        self.algo = algo
+        self.scales = {}
+
+    def calibrate(self, data_iter, max_batches=16):
+        hooks = []
+        scales = self.scales
+
+        def mk_hook(name):
+            def hook(layer, inputs, outputs=None):
+                x = inputs[0]
+                m = float(jnp.max(jnp.abs(
+                    x._data if isinstance(x, Tensor) else x)))
+                scales[name] = max(scales.get(name, 0.0), m)
+            return hook
+
+        for name, sub in self.model.named_sublayers():
+            if isinstance(sub, _QUANTIZABLE):
+                hooks.append(sub.register_forward_pre_hook(mk_hook(name)))
+        try:
+            for i, batch in enumerate(data_iter):
+                if i >= max_batches:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                self.model(x if isinstance(x, Tensor) else Tensor(x))
+        finally:
+            for h in hooks:
+                h.remove()
+        return self.scales

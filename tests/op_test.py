@@ -1,0 +1,146 @@
+"""OpTest — declarative per-op correctness harness.
+
+Mirrors the reference OpTest (ref python/paddle/fluid/tests/unittests/
+op_test.py:238 — `self.op_type/self.inputs/self.outputs` fixtures,
+check_output :1033 against numpy reference, check_grad :1335 analytic vs
+numeric finite differences). Differences by design: ops are pure jnp
+functions in OP_REGISTRY, so "every registered place" collapses to the one
+XLA backend, and the dygraph-parity re-run becomes an eager-vs-jit parity
+check (the two programming models here).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.ops.dispatch import OP_REGISTRY
+
+
+class OpTest:
+    """Subclass and define:
+        op_type: registry name
+        inputs: dict name -> np array (positional order preserved)
+        attrs: dict of op attrs (optional)
+        outputs: dict name -> expected np array(s)
+    then call check_output() / check_grad([...], "Out")."""
+
+    op_type = None
+    inputs = {}
+    kw_inputs = ()     # input names passed by keyword (e.g. weight/bias)
+    attrs = {}
+    outputs = {}
+
+    def _fn(self):
+        """Resolve op: OP_REGISTRY raw impl, else public API (nn.functional
+        / ops.*) wrapped to array-in/array-out."""
+        raw = OP_REGISTRY.get(self.op_type)
+        if raw is not None:
+            return raw
+        from paddle_tpu import nn as _nn, ops as _ops
+        from paddle_tpu.framework.tensor import Tensor
+        for mod in (_nn.functional, _ops.math, _ops.manipulation,
+                    _ops.logic, _ops.creation, pt):
+            public = getattr(mod, self.op_type, None)
+            if public is not None:
+                break
+        assert public is not None, f"op {self.op_type} not found"
+        names = list(self.inputs)
+        kw = set(self.kw_inputs)
+
+        def fn(*arrays, **attrs):
+            pos, kws = [], {}
+            for n, a in zip(names, arrays):
+                t = Tensor(a)
+                if n in kw:
+                    kws[n] = t
+                else:
+                    pos.append(t)
+            out = public(*pos, **kws, **attrs)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._data if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._data if isinstance(out, Tensor) else out
+        return fn
+
+    def _run(self, arrays=None):
+        fn = self._fn()
+        arrays = arrays if arrays is not None else [
+            jnp.asarray(v) for v in self.inputs.values()]
+        out = fn(*arrays, **self.attrs)
+        return out if isinstance(out, (tuple, list)) else (out,)
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        got = self._run()
+        want = list(self.outputs.values())
+        assert len(got) == len(want), \
+            f"{self.op_type}: {len(got)} outputs vs {len(want)} expected"
+        for g, w, name in zip(got, want, self.outputs):
+            np.testing.assert_allclose(
+                np.asarray(g), w, atol=atol, rtol=rtol,
+                err_msg=f"{self.op_type} output {name}")
+        # eager-vs-compiled parity (dygraph/static parity analog)
+        jitted = jax.jit(lambda arrs: self._run(arrs))(
+            [jnp.asarray(v) for v in self.inputs.values()])
+        for g, w in zip(jitted, got):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=atol, rtol=rtol,
+                                       err_msg=f"{self.op_type} jit parity")
+
+    def check_grad(self, inputs_to_check, output_name="Out",
+                   max_relative_error=5e-3, delta=1e-3,
+                   user_defined_grads=None):
+        """Analytic (jax.vjp — what the tape records) vs central finite
+        differences of a scalar projection, the reference's
+        get_numeric_gradient scheme."""
+        names = list(self.inputs)
+        arrays = [jnp.asarray(np.asarray(v, dtype=np.float64)
+                              if np.asarray(v).dtype == np.float32 else v)
+                  for v in self.inputs.values()]
+        # float64 for FD accuracy where input was float
+        arrays = [a.astype(jnp.float32) if a.dtype == jnp.float64 else a
+                  for a in arrays]
+        fn = self._fn()
+        out_idx = list(self.outputs).index(output_name) \
+            if self.outputs else 0
+
+        rng = np.random.RandomState(7)
+        proj = None
+
+        def scalar(*arrs):
+            out = fn(*arrs, **self.attrs)
+            out = out[out_idx] if isinstance(out, (tuple, list)) else out
+            nonlocal proj
+            if proj is None:
+                proj = jnp.asarray(
+                    rng.randn(*out.shape).astype(np.float32))
+            return jnp.vdot(out.astype(jnp.float32), proj)
+
+        analytic = jax.grad(scalar, argnums=tuple(
+            names.index(n) for n in inputs_to_check))(*arrays)
+
+        for k, name in enumerate(inputs_to_check):
+            if user_defined_grads is not None:
+                np.testing.assert_allclose(
+                    np.asarray(analytic[k]), user_defined_grads[k],
+                    rtol=max_relative_error, err_msg=f"grad {name}")
+                continue
+            i = names.index(name)
+            base = np.asarray(arrays[i], dtype=np.float64)
+            num = np.zeros_like(base)
+            flat = base.reshape(-1)
+            nf = num.reshape(-1)
+            for j in range(flat.size):
+                for sgn in (+1, -1):
+                    pert = flat.copy()
+                    pert[j] += sgn * delta
+                    arrs = list(arrays)
+                    arrs[i] = jnp.asarray(
+                        pert.reshape(base.shape).astype(
+                            np.asarray(arrays[i]).dtype))
+                    nf[j] += sgn * float(scalar(*arrs)) / (2 * delta)
+            a = np.asarray(analytic[k], dtype=np.float64)
+            denom = np.maximum(np.abs(num), np.maximum(np.abs(a), 1e-3))
+            rel = np.abs(a - num) / denom
+            assert rel.max() <= max_relative_error, (
+                f"{self.op_type} grad wrt {name}: max rel err "
+                f"{rel.max():.2e} > {max_relative_error:.2e}")

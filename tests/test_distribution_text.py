@@ -1,0 +1,118 @@
+"""Distributions (vs scipy-free closed forms) + text dataset zoo +
+Viterbi decode (vs brute force). Mirrors ref test_distribution.py,
+text/datasets tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import distribution as D
+from paddle_tpu import text
+
+
+def test_normal():
+    pt.seed(0)
+    n = D.Normal(1.0, 2.0)
+    s = n.sample([20000])
+    assert abs(float(s.numpy().mean()) - 1.0) < 0.1
+    assert abs(float(s.numpy().std()) - 2.0) < 0.1
+    lp = n.log_prob(pt.to_tensor([1.0])).numpy()
+    want = -np.log(2.0) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(lp, want, atol=1e-6)
+    ent = float(n.entropy().numpy())
+    np.testing.assert_allclose(ent, 0.5 + 0.5 * np.log(2 * np.pi)
+                               + np.log(2.0), atol=1e-6)
+    # KL(N(1,2)||N(1,2)) == 0; KL to different dist > 0
+    np.testing.assert_allclose(n.kl_divergence(D.Normal(1.0, 2.0)).numpy(),
+                               0.0, atol=1e-7)
+    assert float(n.kl_divergence(D.Normal(0.0, 1.0)).numpy()) > 0
+
+
+def test_uniform():
+    pt.seed(0)
+    u = D.Uniform(-2.0, 3.0)
+    s = u.sample([10000]).numpy()
+    assert s.min() >= -2.0 and s.max() < 3.0
+    np.testing.assert_allclose(u.log_prob(pt.to_tensor([0.0])).numpy(),
+                               -np.log(5.0), atol=1e-6)
+    assert np.isneginf(u.log_prob(pt.to_tensor([4.0])).numpy())
+    np.testing.assert_allclose(u.entropy().numpy(), np.log(5.0), atol=1e-6)
+
+
+def test_categorical():
+    pt.seed(0)
+    logits = np.log(np.array([0.2, 0.3, 0.5], dtype="f4"))
+    c = D.Categorical(logits)
+    s = c.sample([30000]).numpy()
+    freq = np.bincount(s, minlength=3) / s.size
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+    np.testing.assert_allclose(c.probs().numpy(), [0.2, 0.3, 0.5], atol=1e-6)
+    np.testing.assert_allclose(c.log_prob(pt.to_tensor([2])).numpy(),
+                               np.log(0.5), atol=1e-6)
+    ent = float(c.entropy().numpy())
+    np.testing.assert_allclose(
+        ent, -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5)),
+        atol=1e-6)
+
+
+def test_mvn_diag():
+    pt.seed(0)
+    m = D.MultivariateNormalDiag([0.0, 1.0], [1.0, 2.0])
+    lp = float(m.log_prob(pt.to_tensor([0.0, 1.0])).numpy())
+    want = -np.log(2.0) - np.log(2 * np.pi)
+    np.testing.assert_allclose(lp, want, atol=1e-6)
+    kl = float(m.kl_divergence(
+        D.MultivariateNormalDiag([0.0, 1.0], [1.0, 2.0])).numpy())
+    np.testing.assert_allclose(kl, 0.0, atol=1e-6)
+
+
+def test_text_datasets_shapes():
+    d = text.Imdb(mode="train", num_samples=50)
+    x, y = d[0]
+    assert x.shape == (128,) and y in (0, 1)
+    d2 = text.Imikolov(num_samples=50)
+    item = d2[0]
+    assert len(item) == 5  # 4-gram context + target
+    d3 = text.UCIHousing(num_samples=20)
+    x, y = d3[3]
+    assert x.shape == (13,) and y.shape == (1,)
+    d4 = text.WMT16(num_samples=20)
+    src, trg_in, trg = d4[0]
+    assert src.shape == trg_in.shape == trg.shape
+    d5 = text.Movielens(num_samples=30)
+    u, m, r = d5[0]
+    assert 1 <= r <= 5
+    d6 = text.Conll05st(num_samples=10)
+    w, p, l = d6[0]
+    assert w.shape == l.shape
+
+
+def test_text_dataset_learnable():
+    """IMDB synthetic must carry class signal (mean-pooled bag of words
+    separates classes linearly)."""
+    d = text.Imdb(mode="train", num_samples=400, vocab_size=50, seq_len=64)
+    X = np.stack([np.bincount(d[i][0], minlength=50) for i in range(400)])
+    y = np.array([d[i][1] for i in range(400)])
+    mu0, mu1 = X[y == 0].mean(0), X[y == 1].mean(0)
+    w = mu1 - mu0
+    pred = (X @ w > (mu0 + mu1) @ w / 2).astype(int)
+    assert (pred == y).mean() > 0.9
+
+
+def test_viterbi_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 4
+    pot = rng.randn(B, T, N).astype("f4")
+    trans = rng.randn(N, N).astype("f4")
+    scores, paths = text.viterbi_decode(pt.to_tensor(pot),
+                                        pt.to_tensor(trans))
+    import itertools
+    for b in range(B):
+        best, best_path = -1e9, None
+        for seq in itertools.product(range(N), repeat=T):
+            s = pot[b, 0, seq[0]] + sum(
+                trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+                for t in range(1, T))
+            if s > best:
+                best, best_path = s, seq
+        np.testing.assert_allclose(scores.numpy()[b], best, rtol=1e-5)
+        assert tuple(paths.numpy()[b]) == best_path

@@ -1,0 +1,120 @@
+"""Launcher (cluster env contract, failure teardown) and auto-checkpoint
+(epoch-range resume). Mirrors ref test_launch_coverage.py and
+test_auto_checkpoint.py at the harness level: multiprocess on localhost.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.distributed import launch as L
+from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+
+def test_cluster_env_contract(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, json, sys
+        print(json.dumps({
+            "rank": os.environ["PADDLE_TRAINER_ID"],
+            "nranks": os.environ["PADDLE_TRAINERS_NUM"],
+            "ep": os.environ["PADDLE_CURRENT_ENDPOINT"],
+            "eps": os.environ["PADDLE_TRAINER_ENDPOINTS"],
+            "coord": os.environ["COORDINATOR_ADDRESS"],
+        }))
+    """))
+    log_dir = str(tmp_path / "logs")
+    rc = L.main(["--nproc_per_node", "2", "--log_dir", log_dir,
+                 str(script)])
+    assert rc == 0
+    seen = set()
+    for r in range(2):
+        out = open(os.path.join(log_dir, f"workerlog.{r}")).read()
+        info = json.loads(out.strip().splitlines()[-1])
+        assert info["nranks"] == "2"
+        assert info["ep"] in info["eps"].split(",")
+        seen.add(info["rank"])
+    assert seen == {"0", "1"}
+
+
+def test_failed_worker_tears_down_pod(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            sys.exit(3)          # this rank dies
+        time.sleep(60)           # healthy rank would run forever
+    """))
+    import time
+    t0 = time.time()
+    rc = L.main(["--nproc_per_node", "2", "--log_dir",
+                 str(tmp_path / "logs"), str(script)])
+    assert rc == 3
+    assert time.time() - t0 < 30  # pod torn down, not waiting 60s
+
+
+class TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(2, 2)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def test_epoch_range_snapshots_and_resumes(tmp_path):
+    pt.seed(0)
+    root = str(tmp_path / "ckpt")
+    os.environ["PADDLE_JOB_ID"] = "job_x"
+    try:
+        m = TinyNet()
+        opt = pt.optimizer.Adam(learning_rate=0.1,
+                                parameters=m.parameters())
+        ran = []
+        r = TrainEpochRange(4, root, model=m, optimizer=opt)
+        for epoch in r:
+            ran.append(epoch)
+            # one step so state actually changes per epoch
+            loss = m(pt.to_tensor(np.ones((1, 2), "float32"))).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if epoch == 1:
+                break  # simulate preemption after epoch 1's yield (no snap)
+        assert ran == [0, 1]
+        w_after_e1 = m.fc.weight.numpy().copy()
+
+        # relaunch: fresh model restores epoch-1... epoch 0 was snapshotted
+        # after completing, epoch 1 was interrupted before snapshot
+        m2 = TinyNet()
+        opt2 = pt.optimizer.Adam(learning_rate=0.1,
+                                 parameters=m2.parameters())
+        r2 = TrainEpochRange(4, root, model=m2, optimizer=opt2)
+        resumed = list(r2)
+        assert resumed == [1, 2, 3]  # epoch 0 skipped
+    finally:
+        del os.environ["PADDLE_JOB_ID"]
+
+
+def test_epoch_range_restores_weights(tmp_path):
+    pt.seed(0)
+    root = str(tmp_path / "c2")
+    m = TinyNet()
+    r = TrainEpochRange(2, root, model=m, name="j2")
+    it = iter(r)
+    next(it)
+    m.fc.weight.set_value(np.full((2, 2), 7.0, "float32"))
+    try:
+        next(it)
+    except StopIteration:
+        pass
+    # next(it) completed epoch 0 -> snapshot holds the 7.0 weights
+    m3 = TinyNet()
+    r3 = TrainEpochRange(2, root, model=m3, name="j2")
+    assert r3.restored_from == 0
+    np.testing.assert_allclose(m3.fc.weight.numpy(), 7.0)

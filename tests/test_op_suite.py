@@ -1,0 +1,138 @@
+"""Declarative per-op tests on the OpTest harness (ref unittests
+test_softmax_op.py / test_matmul_op.py / test_layer_norm_op.py style) +
+custom op extension tests (ref test_custom_op / PD_BUILD_OP)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from op_test import OpTest
+
+
+class TestSoftmaxOp(OpTest):
+    op_type = "softmax"
+
+    def setup(self):
+        x = np.random.RandomState(0).randn(3, 7).astype("f4")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["X"])
+
+
+class TestMatmulOp(OpTest):
+    op_type = "matmul"
+
+    def setup(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(4, 5).astype("f4")
+        b = rng.randn(5, 3).astype("f4")
+        self.inputs = {"X": a, "Y": b}
+        self.outputs = {"Out": a @ b}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestLayerNormOp(OpTest):
+    op_type = "layer_norm"
+    kw_inputs = ("weight", "bias")
+
+    def setup(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 6).astype("f4")
+        g = rng.rand(6).astype("f4") + 0.5
+        b = rng.randn(6).astype("f4")
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        want = (x - mu) / np.sqrt(var + 1e-5) * g + b
+        self.inputs = {"X": x, "weight": g, "bias": b}
+        self.attrs = {"normalized_shape": 6}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.setup()
+        self.check_output(atol=1e-4, rtol=1e-4)
+        self.check_grad(["X", "weight", "bias"], max_relative_error=1e-2)
+
+
+class TestSigmoidOp(OpTest):
+    op_type = "sigmoid"
+
+    def setup(self):
+        x = np.random.RandomState(3).randn(8).astype("f4")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 1 / (1 + np.exp(-x))}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        # f32 finite differences are noisy in the sigmoid tails
+        self.check_grad(["X"], max_relative_error=2e-2)
+
+
+class TestSequencePoolOp(OpTest):
+    op_type = "sequence_pool"
+
+    def setup(self):
+        x = np.random.RandomState(4).randn(2, 4, 3).astype("f4")
+        lens = np.array([4, 2], dtype="i4")
+        want = np.stack([x[0, :4].sum(0), x[1, :2].sum(0)])
+        self.inputs = {"X": x, "Lens": lens}
+        self.attrs = {"pool_type": "sum"}
+        self.outputs = {"Out": want}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+
+
+# --------------------------------------------------------------------------- #
+# custom op extension                                                         #
+# --------------------------------------------------------------------------- #
+
+def test_register_python_op_with_custom_vjp():
+    from paddle_tpu.utils.cpp_extension import register_op
+    import jax.numpy as jnp
+
+    def fwd(x):
+        return jnp.square(x) * 3
+
+    def bwd(res, g):
+        (x,) = res
+        return (g * 6 * x,)
+
+    op = register_op("my_triple_square", fwd, backward=bwd)
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    y = op(x)
+    np.testing.assert_allclose(y.numpy(), [12.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_cpp_extension_host_op(tmp_path):
+    """JIT-build a C++ kernel with g++, register via host callback, run
+    eagerly and under jit (PD_BUILD_OP + cpp_extension.load analog)."""
+    from paddle_tpu.utils import cpp_extension as cpp
+    import jax
+
+    src = tmp_path / "my_relu.cc"
+    src.write_text(
+        'extern "C" void my_relu(float* out, const float* in, long long n)'
+        '{ for (long long i = 0; i < n; ++i)'
+        '  out[i] = in[i] > 0.f ? in[i] : 0.f; }')
+    lib = cpp.load("my_relu_ext", str(src),
+                   build_directory=str(tmp_path))
+    op = cpp.host_op("my_cpp_relu", lib, "my_relu")
+
+    x = np.array([-1.0, 2.0, -3.0, 4.0], dtype="f4")
+    np.testing.assert_allclose(op(pt.to_tensor(x)).numpy(),
+                               [0, 2, 0, 4])
+    jitted = jax.jit(lambda a: op(pt.Tensor(a))._data)
+    np.testing.assert_allclose(np.asarray(jitted(x)), [0, 2, 0, 4])

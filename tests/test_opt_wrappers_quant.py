@@ -1,0 +1,163 @@
+"""Optimizer wrappers (EMA/ModelAverage/Lookahead/GradientMerge) and
+quantization (QAT fake-quant, PTQ calibration). Mirrors ref
+test_ema.py, test_lookahead.py, test_gradient_merge, slim tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def _net():
+    pt.seed(0)
+
+    class N(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+    return N()
+
+
+def test_ema_apply_restore():
+    m = _net()
+    ema = pt.optimizer.ExponentialMovingAverage(
+        decay=0.5, parameters=m.parameters())
+    w0 = m.fc.weight.numpy().copy()
+    m.fc.weight.set_value(w0 + 1.0)
+    ema.update()
+    m.fc.weight.set_value(w0 + 3.0)
+    ema.update()
+    live = m.fc.weight.numpy().copy()
+    # bias-corrected EMA after 2 updates of values (w0+1), (w0+3) with
+    # decay 0.5 starting from w0:
+    # ema = .5(.5 w0 + .5(w0+1)) + .5(w0+3) ; corr = 1-.25
+    want = (0.25 * w0 + 0.25 * (w0 + 1) + 0.5 * (w0 + 3)) / 0.75
+    with ema.apply():
+        np.testing.assert_allclose(m.fc.weight.numpy(), want, rtol=1e-5)
+    np.testing.assert_allclose(m.fc.weight.numpy(), live)
+
+
+def test_model_average_apply_restore():
+    m = _net()
+    ma = pt.optimizer.ModelAverage(
+        0.5, parameters=m.parameters(), min_average_window=2,
+        max_average_window=4)
+    vals = []
+    w0 = m.fc.weight.numpy().copy()
+    for i in range(3):
+        m.fc.weight.set_value(w0 + i)
+        ma.update()
+        vals.append(w0 + i)
+    live = m.fc.weight.numpy().copy()
+    with ma.apply():
+        avg = m.fc.weight.numpy()
+        # a sliding (geometric) window average: between min and max values
+        assert avg.mean() > vals[0].mean() and avg.mean() < vals[-1].mean()
+    np.testing.assert_allclose(m.fc.weight.numpy(), live)
+
+
+def test_lookahead_converges():
+    m = _net()
+    inner = pt.optimizer.SGD(learning_rate=0.5,
+                             parameters=m.parameters())
+    look = pt.optimizer.LookaheadOptimizer(inner, alpha=0.5, k=2)
+    x = pt.to_tensor(np.ones((4, 4), "float32"))
+    target = pt.to_tensor(np.zeros((4, 4), "float32"))
+    losses = []
+    for _ in range(20):
+        out = m(x)
+        loss = ((out - target) ** 2).mean()
+        loss.backward()
+        look.step()
+        look.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.05 * losses[0]
+
+
+def test_gradient_merge_equals_big_batch():
+    """k accumulation steps == one step on the averaged gradient."""
+    xs = [np.random.RandomState(i).randn(2, 4).astype("f4")
+          for i in range(2)]
+
+    # path A: gradient merge over 2 micro batches
+    ma = _net()
+    inner = pt.optimizer.SGD(learning_rate=0.1, parameters=ma.parameters())
+    gm = pt.optimizer.GradientMergeOptimizer(inner, k_steps=2, avg=True)
+    for x in xs:
+        loss = ma(pt.to_tensor(x)).sum()
+        loss.backward()
+        gm.step()
+
+    # path B: single step on the mean loss
+    mb = _net()
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=mb.parameters())
+    loss = (mb(pt.to_tensor(xs[0])).sum()
+            + mb(pt.to_tensor(xs[1])).sum()) / 2
+    loss.backward()
+    opt.step()
+
+    np.testing.assert_allclose(ma.fc.weight.numpy(), mb.fc.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fake_quant_ste_gradient():
+    from paddle_tpu.quantization import fake_quantize_dequantize
+    x = pt.to_tensor(np.linspace(-1, 1, 16).astype("f4"),
+                     stop_gradient=False)
+    y = fake_quantize_dequantize(x, bits=4)
+    # quantized forward: few distinct values
+    assert len(np.unique(np.round(y.numpy(), 5))) <= 17
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 1.0)  # STE passthrough
+
+
+def test_qat_wraps_and_trains():
+    from paddle_tpu.quantization import ImperativeQuantAware, FakeQuantWrapper
+
+    class N(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+
+    pt.seed(0)
+    m = ImperativeQuantAware().quantize(N())
+    assert isinstance(m._sub_layers["fc1"], FakeQuantWrapper)
+    opt = pt.optimizer.Adam(learning_rate=0.05,
+                            parameters=m.parameters())
+    x = np.random.RandomState(0).randn(16, 4).astype("f4")
+    y = (x[:, 0] > 0).astype("int64")
+    losses = []
+    for _ in range(30):
+        loss = nn.functional.cross_entropy(m(pt.to_tensor(x)),
+                                           pt.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_ptq_calibration():
+    from paddle_tpu.quantization import PostTrainingQuantization
+
+    class N(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    pt.seed(0)
+    m = N()
+    data = [pt.to_tensor(np.full((2, 4), float(i), "f4"))
+            for i in range(1, 4)]
+    scales = PostTrainingQuantization(m).calibrate(data)
+    assert scales and abs(list(scales.values())[0] - 3.0) < 1e-5

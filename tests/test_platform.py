@@ -26,13 +26,15 @@ def test_set_get_flags():
 
 
 def test_env_flag_bootstrap():
-    code = ("import paddle_tpu as pt; "
+    # force the CPU backend before jax initializes (JAX_PLATFORMS alone is
+    # overridden by the environment's sitecustomize)
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import paddle_tpu as pt; "
             "print(pt.get_flags(['FLAGS_check_nan_inf']))")
     out = subprocess.run(
         [sys.executable, "-c", code],
-        env={**os.environ, "FLAGS_check_nan_inf": "1",
-             "JAX_PLATFORMS": "cpu"},
-        capture_output=True, text=True, cwd="/root/repo")
+        env={**os.environ, "FLAGS_check_nan_inf": "1"},
+        capture_output=True, text=True, cwd="/root/repo", timeout=120)
     assert "True" in out.stdout, out.stderr
 
 
