@@ -3,16 +3,53 @@
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 vs_baseline is measured against a fixed roofline-style reference number
 (see BASELINE.md — the reference repo publishes no numbers; we report
-model-FLOPs utilisation-normalised throughput so rounds are comparable).
+model-FLOPs-utilisation-normalised throughput so rounds are comparable).
+
+Hardened entry: backend init is retried with backoff (tunneled TPU plugins
+can be transiently unavailable), import never touches a device (lazy RNG),
+and any terminal failure still prints a parseable JSON error line.
 """
 import json
+import sys
 import time
 
 import numpy as np
 
 
-def main():
+def _init_backend(max_tries=5, base_delay=5.0):
+    """Initialize a JAX backend, preferring the TPU, retrying transient
+    plugin failures with exponential backoff. Returns (jax, on_tpu)."""
     import jax
+    last_err = None
+    for attempt in range(max_tries):
+        try:
+            backend = jax.default_backend()
+            if backend != "cpu":
+                return jax, True
+            # jax caches the backend set even when the TPU plugin failed
+            # (cpu fills in first) — drop it so the next attempt actually
+            # re-tries the plugin instead of silently returning cpu
+            last_err = last_err or RuntimeError("only cpu backend came up")
+        except RuntimeError as e:  # backend setup error (plugin hiccup)
+            last_err = e
+        if attempt < max_tries - 1:
+            import jax.extend.backend as _eb
+            _eb.clear_backends()
+            time.sleep(base_delay * (2 ** attempt))
+    # TPU never came up: fall back to host CPU so we still produce a number
+    # (flagged via detail.backend so the driver/judge can tell).
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.default_backend()
+        return jax, False
+    except RuntimeError:
+        raise RuntimeError(f"no JAX backend available: {last_err}")
+
+
+def run():
+    jax, on_tpu = _init_backend()
     import jax.numpy as jnp
     import paddle_tpu as pt
     from paddle_tpu.nlp import GPTConfig, GPTForPretraining
@@ -20,7 +57,6 @@ def main():
     from paddle_tpu.jit import TrainStep
 
     pt.seed(0)
-    on_tpu = jax.default_backend() != "cpu"
     # sized to fit one v5e chip comfortably in bf16
     if on_tpu:
         cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
@@ -75,6 +111,18 @@ def main():
                    "model_tflops": round(tflops, 2), "params": n_params,
                    "backend": jax.default_backend()},
     }))
+
+
+def main():
+    try:
+        run()
+    except Exception as e:  # still emit a parseable line for the driver
+        print(json.dumps({
+            "metric": "gpt2s-1024ctx train tokens/sec/chip",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+            "detail": {"error": f"{type(e).__name__}: {e}"},
+        }))
+        sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -42,6 +42,11 @@ class Pod:
 
 def get_cluster(nproc, start_port=36777, ips="127.0.0.1"):
     hosts = [h for h in ips.split(",") if h]
+    if nproc % len(hosts) != 0:
+        raise ValueError(
+            f"--nproc_per_node total {nproc} must divide evenly over "
+            f"{len(hosts)} hosts ({ips}); {nproc % len(hosts)} ranks "
+            f"would be dropped")
     per_host = nproc // len(hosts)
     trainers = []
     for hi, host in enumerate(hosts):
@@ -49,6 +54,43 @@ def get_cluster(nproc, start_port=36777, ips="127.0.0.1"):
             rank = hi * per_host + i
             trainers.append(Trainer(rank, f"{host}:{start_port + i}", [i]))
     return Pod(trainers, f"{hosts[0]}:{start_port - 1}")
+
+
+def _local_addresses():
+    """Addresses that mean 'this host' — POD_IP (the reference's per-host
+    identity env, launch_utils.py get_cluster_from_args), hostname, and
+    loopback."""
+    import socket
+    addrs = {"127.0.0.1", "localhost", "0.0.0.0"}
+    pod_ip = os.environ.get("POD_IP")
+    if pod_ip:
+        addrs.add(pod_ip)
+    try:
+        hn = socket.gethostname()
+        addrs.add(hn)
+        addrs.add(socket.gethostbyname(hn))
+    except OSError:
+        pass
+    return addrs
+
+
+def local_trainers(pod):
+    """This host's slice of the pod — only these ranks are spawned here
+    (each host in --ips runs the launcher; ref launch_collective spawns
+    procs for the local pod only)."""
+    addrs = _local_addresses()
+    mine = [t for t in pod.trainers if t.endpoint.split(":")[0] in addrs]
+    if mine:
+        return mine
+    pod_hosts = {t.endpoint.split(":")[0] for t in pod.trainers}
+    if len(pod_hosts) == 1:
+        # single-host pod whose ip isn't a local alias (e.g. NAT): safe —
+        # only one host will ever run this launcher
+        return pod.trainers
+    raise RuntimeError(
+        f"cannot identify this host among pod hosts {sorted(pod_hosts)} "
+        f"(local addresses: {sorted(addrs)}); set POD_IP to this host's "
+        f"ip from --ips so each host spawns only its own ranks")
 
 
 def _rank_env(pod, trainer, nproc, training_script_args):
@@ -73,7 +115,8 @@ def launch_procs(pod, script, script_args, nproc, log_dir=None):
     (ref launch_utils.py:435 TrainerProc + watch_local_trainers)."""
     procs = []
     logs = []
-    for t in pod.trainers:
+    mine = local_trainers(pod)
+    for t in mine:
         env = _rank_env(pod, t, nproc, script_args)
         cmd = [sys.executable, "-u", script] + list(script_args)
         if log_dir:
@@ -96,7 +139,7 @@ def launch_procs(pod, script, script_args, nproc, log_dir=None):
                 elif rc != 0:
                     # a worker died: tear down the pod (heart-beat analog)
                     sys.stderr.write(
-                        f"trainer rank {pod.trainers[i].rank} failed "
+                        f"trainer rank {mine[i].rank} failed "
                         f"(exit {rc}); aborting pod\n")
                     for q in procs:
                         if q.poll() is None:

@@ -145,20 +145,25 @@ class Generator:
     not pay a device round-trip."""
 
     def __init__(self, seed=0):
+        # Lazy: no JAX backend is touched until the first draw. Importing the
+        # framework must never initialize a device (ref initializes devices
+        # explicitly from bootstrap, platform/init.h:36 — not at link time);
+        # a flaky TPU plugin must not make the package unimportable.
         self._seed = seed
         self._lock = threading.Lock()
-        with jax.default_device(host_device()):
-            self._key = jax.random.PRNGKey(seed)
+        self._key = None
 
     def manual_seed(self, seed):
-        self._seed = seed
-        with jax.default_device(host_device()):
-            self._key = jax.random.PRNGKey(seed)
+        with self._lock:
+            self._seed = seed
+            self._key = None
         return self
 
     def next_key(self):
         with self._lock:
             with jax.default_device(host_device()):
+                if self._key is None:
+                    self._key = jax.random.PRNGKey(self._seed)
                 self._key, sub = jax.random.split(self._key)
             return sub
 

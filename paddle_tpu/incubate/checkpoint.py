@@ -54,6 +54,11 @@ class TrainEpochRange:
         self.inter = max(1, save_checkpoint_inter)
         self._start = 0
         os.makedirs(self.root, exist_ok=True)
+        # sweep .saving_* temp dirs orphaned by a hard kill mid-save
+        for d in os.listdir(self.root):
+            if d.startswith(".saving_"):
+                shutil.rmtree(os.path.join(self.root, d),
+                              ignore_errors=True)
         self._restore()
 
     # ------------------------------------------------------------- persistence
@@ -102,7 +107,8 @@ class TrainEpochRange:
             json.dump({"last_completed_epoch": epoch,
                        "max_epoch_num": self.max_epoch_num}, f)
         os.replace(_meta_path(self.root) + ".tmp", _meta_path(self.root))
-        # keep only the latest snapshot (ref checkpoint_saver keeps max_num)
+        # keep only the latest snapshot (ref checkpoint_saver keeps max_num);
+        # orphaned .saving_* dirs are swept by the constructor on restart
         for d in os.listdir(self.root):
             if d.startswith("epoch_") and d != f"epoch_{epoch}":
                 shutil.rmtree(os.path.join(self.root, d),

@@ -121,9 +121,25 @@ def convert_ifelse(pred, true_fn, false_fn, get, reset):
             return tuple(leaves)
         return branch
 
-    res = jax.lax.cond(p, run(true_fn, "true"), run(false_fn, "false"), None)
+    try:
+        res = jax.lax.cond(p, run(true_fn, "true"), run(false_fn, "false"),
+                           None)
+    except (TypeError, ValueError) as e:
+        # Diagnose: if the branches disagree on which vars are tensors,
+        # lax.cond raises a generic pytree-structure error — both branch
+        # specs were already collected during its tracing, so we can
+        # replace it with an actionable message.
+        both = specs.get("true"), specs.get("false")
+        if all(s is not None for s in both) and any(
+                (st == "dyn") != (sf == "dyn")
+                for st, sf in zip(*both)):
+            raise ValueError(
+                "dy2static: a variable is a tensor in one branch of a "
+                "traced `if` but not the other — assign it consistently "
+                "in both branches") from e
+        raise
     spec_t, spec_f = specs["true"], specs["false"]
-    for i, (st, sf) in enumerate(zip(spec_t, spec_f)):
+    for st, sf in zip(spec_t, spec_f):
         if (st == "dyn") != (sf == "dyn"):
             raise ValueError(
                 "dy2static: a variable is a tensor in one branch of a "

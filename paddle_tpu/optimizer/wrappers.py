@@ -29,7 +29,10 @@ class ExponentialMovingAverage:
             raise ValueError("pass parameters=model.parameters()")
         self._decay = decay
         self._params = [p for p in parameters if p.trainable]
-        self._ema = {id(p): jnp.array(p._data) for p in self._params}
+        # EMA_0 = 0 (matching ref fluid/optimizer.py ExponentialMovingAverage)
+        # — the /(1 - decay^t) bias correction below is only valid for a
+        # zero-initialized accumulator.
+        self._ema = {id(p): jnp.zeros_like(p._data) for p in self._params}
         self._step = 0
         self._backup = None
 
@@ -40,16 +43,18 @@ class ExponentialMovingAverage:
             key = id(p)
             self._ema[key] = d * self._ema[key] + (1.0 - d) * p._data
 
-    def _unbiased(self, key):
+    def _unbiased(self, key, live):
+        if self._step == 0:
+            return live  # no update yet: zeros accumulator is meaningless
         corr = 1.0 - self._decay ** self._step
-        return self._ema[key] / corr if self._step > 0 else self._ema[key]
+        return self._ema[key] / corr
 
     def apply(self, need_restore=True):
         """Swap EMA weights into the params; returns a context manager so
         `with ema.apply(): evaluate()` restores automatically."""
         self._backup = {id(p): p._data for p in self._params}
         for p in self._params:
-            p._data = self._unbiased(id(p)).astype(p._data.dtype)
+            p._data = self._unbiased(id(p), p._data).astype(p._data.dtype)
         ema = self
 
         @contextlib.contextmanager

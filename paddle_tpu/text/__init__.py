@@ -216,18 +216,28 @@ def viterbi_decode(potentials, transitions, lengths=None,
     from ..framework.tensor import Tensor
     from ..ops.dispatch import apply
 
-    def _decode(pot, trans):
+    def _decode(pot, trans, lens):
         B, T, N = pot.shape
 
-        def fwd(carry, emit):
+        def fwd(carry, xs):
             score = carry                                # [B, N]
+            emit, t = xs
             cand = score[:, :, None] + trans[None]       # [B, N, N]
             best = jnp.max(cand, axis=1) + emit          # [B, N]
             idx = jnp.argmax(cand, axis=1)               # [B, N]
+            if lens is not None:
+                # freeze finished rows: score unchanged, identity
+                # backpointers so the backtrace passes straight through
+                active = (t < lens)[:, None]             # [B, 1]
+                best = jnp.where(active, best, score)
+                ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
+                idx = jnp.where(active, idx, ident)
             return best, idx
 
         init = pot[:, 0]
-        score, back = lax.scan(fwd, init, jnp.swapaxes(pot[:, 1:], 0, 1))
+        ts = jnp.arange(1, T)
+        score, back = lax.scan(
+            fwd, init, (jnp.swapaxes(pot[:, 1:], 0, 1), ts))
         last = jnp.argmax(score, axis=-1)                # [B]
 
         def bwd(carry, idx_t):
@@ -239,10 +249,17 @@ def viterbi_decode(potentials, transitions, lengths=None,
         first, tail = lax.scan(bwd, last, back, reverse=True)
         paths = jnp.concatenate([first[:, None],
                                  jnp.swapaxes(tail, 0, 1)], axis=1)
+        if lens is not None:
+            paths = jnp.where(jnp.arange(T)[None, :] < lens[:, None],
+                              paths, 0)
         return jnp.max(score, axis=-1), paths
 
-    return apply(_decode, (potentials, transitions), name="viterbi_decode",
-                 differentiable=False)
+    if lengths is None:
+        return apply(lambda p, t: _decode(p, t, None),
+                     (potentials, transitions), name="viterbi_decode",
+                     differentiable=False)
+    return apply(_decode, (potentials, transitions, lengths),
+                 name="viterbi_decode", differentiable=False)
 
 
 class ViterbiDecoder:

@@ -116,3 +116,21 @@ def test_viterbi_matches_bruteforce():
                 best, best_path = s, seq
         np.testing.assert_allclose(scores.numpy()[b], best, rtol=1e-5)
         assert tuple(paths.numpy()[b]) == best_path
+
+
+def test_viterbi_respects_lengths():
+    """Padded batch must decode identically to each truncated sequence."""
+    rng = np.random.RandomState(1)
+    B, T, N = 3, 6, 4
+    pot = rng.randn(B, T, N).astype("f4")
+    trans = rng.randn(N, N).astype("f4")
+    lens = np.array([6, 3, 1], dtype="i4")
+    scores, paths = text.viterbi_decode(
+        pt.to_tensor(pot), pt.to_tensor(trans), pt.to_tensor(lens))
+    for b, L in enumerate(lens):
+        s1, p1 = text.viterbi_decode(pt.to_tensor(pot[b:b + 1, :L]),
+                                     pt.to_tensor(trans))
+        np.testing.assert_allclose(scores.numpy()[b], s1.numpy()[0],
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(paths.numpy()[b, :L], p1.numpy()[0])
+        assert (paths.numpy()[b, L:] == 0).all()

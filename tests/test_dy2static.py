@@ -144,7 +144,8 @@ def test_undefined_var_in_one_branch_traced_errors():
     g = convert_function(f)
     # eager fine (taken branch defines what it needs)
     g(pt.to_tensor([1.0]))
-    with pytest.raises(Exception):
+    # the tailored message fires, not lax.cond's generic pytree error
+    with pytest.raises(ValueError, match="one branch of a traced"):
         jax.jit(lambda a: g(pt.to_tensor(a))._data)(jnp.asarray([1.0]))
 
 

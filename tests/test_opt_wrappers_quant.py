@@ -32,9 +32,9 @@ def test_ema_apply_restore():
     ema.update()
     live = m.fc.weight.numpy().copy()
     # bias-corrected EMA after 2 updates of values (w0+1), (w0+3) with
-    # decay 0.5 starting from w0:
-    # ema = .5(.5 w0 + .5(w0+1)) + .5(w0+3) ; corr = 1-.25
-    want = (0.25 * w0 + 0.25 * (w0 + 1) + 0.5 * (w0 + 3)) / 0.75
+    # decay 0.5 starting from EMA_0 = 0 (ref ExponentialMovingAverage):
+    # ema = .5(.5*0 + .5(w0+1)) + .5(w0+3) ; corr = 1 - .5^2
+    want = (0.25 * (w0 + 1) + 0.5 * (w0 + 3)) / 0.75
     with ema.apply():
         np.testing.assert_allclose(m.fc.weight.numpy(), want, rtol=1e-5)
     np.testing.assert_allclose(m.fc.weight.numpy(), live)
