@@ -261,6 +261,49 @@ def distributed_optimizer(optimizer, strategy=None):
     return build_distributed_optimizer(optimizer, strat)
 
 
+def build_train_step(model, loss_fn, optimizer, **kwargs):
+    """Strategy -> execution: pick + configure the compiled train step the
+    meta-optimizer chain implies. This is where the reference applies its
+    program rewrites (ref fleet_base.py:1070 minimize -> strategy_compiler
+    -> meta-optimizer .minimize_impl chain); here the transforms dict
+    recorded by meta_optimizers.py selects/teaches ONE jitted step:
+      pipeline   -> PipelineTrainStep over the 'pp' mesh axis
+      localsgd   -> LocalSGDTrainStep (per-replica params, periodic sync)
+      mesh>1 dev -> ShardedTrainStep (GSPMD; amp/recompute/sharding/
+                    gradient_merge consumed in-step via jit/transforms.py)
+      otherwise  -> single-chip TrainStep (same transforms)."""
+    from ...jit import TrainStep, transforms as tfm
+    from ..parallel import DataParallel
+    if isinstance(model, DataParallel):
+        model = model._layers
+    tf = tfm.resolve(optimizer)
+    mesh = mesh_mod.get_mesh()
+    ndev = len(mesh.devices.flat) if mesh is not None else 1
+    # pipeline/localsgd steps don't expose per-batch outputs (micro-batched
+    # / per-replica); TrainStep and ShardedTrainStep do
+    ro = bool(kwargs.pop("return_outputs", False))
+    if tf.get("pipeline") is not None and mesh is not None and \
+            mesh_mod.PP_AXIS in mesh.axis_names:
+        from ..pipeline import PipelineTrainStep
+        cfg = tf["pipeline"]
+        return PipelineTrainStep(
+            model, loss_fn, optimizer,
+            num_micro=max(1, int(cfg.get("accumulate_steps", 1) or 1)),
+            **kwargs)
+    if tf.get("localsgd") is not None and mesh is not None and ndev > 1:
+        from ..localsgd import LocalSGDTrainStep
+        cfg = tf["localsgd"]
+        return LocalSGDTrainStep(
+            model, loss_fn, optimizer,
+            k_steps=max(1, int(cfg.get("k_steps", 1) or 1)), **kwargs)
+    if mesh is not None and ndev > 1:
+        from ..sharded import ShardedTrainStep
+        return ShardedTrainStep(model, loss_fn, optimizer,
+                                return_outputs=ro, **kwargs)
+    return TrainStep(model, loss_fn, optimizer, return_outputs=ro,
+                     **kwargs)
+
+
 class _FleetModule:
     """Attribute-style facade: fleet.init(...), fleet.worker_num()..."""
     init = staticmethod(init)
@@ -276,6 +319,7 @@ class _FleetModule:
     barrier_worker = staticmethod(barrier_worker)
     distributed_optimizer = staticmethod(distributed_optimizer)
     distributed_model = staticmethod(distributed_model)
+    build_train_step = staticmethod(build_train_step)
 
     @property
     def util(self):

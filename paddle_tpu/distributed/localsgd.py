@@ -1,0 +1,163 @@
+"""LocalSGD — k local optimizer steps per replica, then parameter averaging.
+
+TPU-native redesign of the reference LocalSGD meta-optimizer
+(ref python/paddle/distributed/fleet/meta_optimizers/localsgd_optimizer.py:
+skip per-step grad allreduce, every k steps c_allreduce_sum params / nranks):
+under GSPMD you cannot "skip the allreduce" — the partitioner inserts it
+wherever replicated params meet dp-sharded batches. Instead each replica's
+divergent weights are made EXPLICIT: params/opt-state carry a leading
+replica axis of size dp, sharded P('dp') over the mesh. Per-device memory
+equals plain replication (each device holds exactly one replica), but the
+vmapped step lets every replica march independently — zero cross-replica
+communication on local steps. Every k-th step the params are averaged over
+the replica axis (ONE all-reduce over 'dp' riding ICI) and re-broadcast,
+all inside the same compiled step via lax.cond.
+
+Optimizer moments stay local (matching the reference, which averages only
+the parameters); buffers (BN stats) also stay local between syncs.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework import state
+from ..framework.tensor import Tensor
+from ..jit import _unwrap, _wrap
+from . import mesh as mesh_mod
+
+
+class LocalSGDTrainStep:
+    """Compiled LocalSGD step over the 'dp' axis of the current Mesh.
+
+    Usage:
+        make_mesh({'dp': 8})
+        step = LocalSGDTrainStep(model, loss_fn, opt, k_steps=4)
+        loss = step(batch_inputs, batch_labels)   # global batch arrays
+    """
+
+    def __init__(self, model, loss_fn, optimizer, k_steps=1, mesh=None,
+                 dp_axis=None, donate=True):
+        from ..jit import transforms as tfm
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.k_steps = max(1, int(k_steps))
+        self.mesh = mesh or mesh_mod.get_mesh() or mesh_mod.default_mesh()
+        self.dp_axis = dp_axis or (
+            mesh_mod.DP_AXIS if mesh_mod.DP_AXIS in self.mesh.axis_names
+            else self.mesh.axis_names[0])
+        self.dp = self.mesh.shape[self.dp_axis]
+        dp = self.dp
+
+        params, buffers = model.functional_state()
+        rep = NamedSharding(self.mesh, P(self.dp_axis))
+
+        def stack(a):
+            return jax.device_put(
+                jnp.broadcast_to(a[None], (dp,) + a.shape), rep)
+
+        self.params = {n: stack(a) for n, a in params.items()}
+        self.buffers = {n: stack(a) for n, a in buffers.items()}
+        self.opt_state = jax.tree.map(stack,
+                                      optimizer.init_opt_state(params))
+        self._step_i = optimizer._global_step
+        apply_fn = optimizer.apply_gradients_fn()
+        k = self.k_steps
+
+        # strategy transforms: amp/recompute apply per replica; k-step
+        # accumulation is inherent to LocalSGD (its local steps), so a
+        # gradient_merge flag is rejected rather than silently ignored
+        self.transforms = tfm.resolve(optimizer)
+        if tfm.merge_config(self.transforms)[0] > 1:
+            raise ValueError(
+                "strategy.gradient_merge cannot be combined with localsgd "
+                "(local steps already accumulate); raise localsgd k_steps "
+                "instead")
+
+        def _forward(p, b, key, x, y):
+            with state.functional_rng_ctx(key):
+                out, new_b = model.functional_call(p, b, *_wrap(x))
+                outs = out if isinstance(out, tuple) else (out,)
+                loss_t = loss_fn(*outs, *_wrap(y))
+            return _unwrap(loss_t), new_b
+
+        _forward = tfm.wrap_forward(_forward, self.transforms)
+
+        def _one_replica(p, b, o, key, lr, step_i, x, y):
+            (loss, new_b), grads = jax.value_and_grad(
+                lambda pp: _forward(pp, b, key, x, y), has_aux=True)(p)
+            new_p, new_o = apply_fn(p, grads, o, lr, step_i)
+            return loss, new_p, new_b, new_o
+
+        def _step(params, buffers, opt_state, keys, lr, step_i, inputs,
+                  labels):
+            loss, new_p, new_b, new_o = jax.vmap(
+                _one_replica,
+                in_axes=(0, 0, 0, 0, None, None, 0, 0))(
+                params, buffers, opt_state, keys, lr, step_i, inputs,
+                labels)
+
+            def sync(p):
+                # ONE collective: mean over the replica axis, re-broadcast
+                return jax.tree.map(
+                    lambda a: jnp.broadcast_to(
+                        jnp.mean(a, axis=0, keepdims=True), a.shape), p)
+
+            new_p = jax.lax.cond(step_i % k == 0, sync, lambda p: p, new_p)
+            return jnp.mean(loss), new_p, new_b, new_o
+
+        sh = {"params": {n: rep for n in self.params},
+              "buffers": {n: rep for n in self.buffers},
+              "opt": jax.tree.map(lambda _: rep, self.opt_state)}
+        self._compiled = jax.jit(
+            _step,
+            in_shardings=(sh["params"], sh["buffers"], sh["opt"], rep,
+                          None, None, None, None),
+            out_shardings=(NamedSharding(self.mesh, P()), sh["params"],
+                           sh["buffers"], sh["opt"]),
+            donate_argnums=(0, 1, 2) if donate else (),
+        )
+
+    # ------------------------------------------------------------------ step
+    def _split_batch(self, arrs):
+        """Global batch [B, ...] -> per-replica [dp, B/dp, ...], sharded."""
+        rep = NamedSharding(self.mesh, P(self.dp_axis))
+        out = []
+        for a in arrs:
+            a = a._data if isinstance(a, Tensor) else jnp.asarray(a)
+            if a.shape[0] % self.dp != 0:
+                raise ValueError(
+                    f"LocalSGD batch dim {a.shape[0]} must be divisible "
+                    f"by dp={self.dp}")
+            out.append(jax.device_put(
+                a.reshape((self.dp, a.shape[0] // self.dp) + a.shape[1:]),
+                rep))
+        return tuple(out)
+
+    def __call__(self, inputs, labels):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
+        labels = labels if isinstance(labels, (list, tuple)) else (labels,)
+        self._step_i += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        keys = jax.random.split(state.next_rng_key(), self.dp)
+        with self.mesh:
+            loss, self.params, self.buffers, self.opt_state = \
+                self._compiled(self.params, self.buffers, self.opt_state,
+                               keys, lr,
+                               jnp.asarray(self._step_i, jnp.int32),
+                               self._split_batch(inputs),
+                               self._split_batch(labels))
+        return Tensor(loss)
+
+    def sync(self):
+        """Average replicas and write back into the live Layer/Optimizer."""
+        named_p = dict(self.model.named_parameters())
+        for n, arr in self.params.items():
+            named_p[n]._data = jnp.asarray(
+                np.asarray(jax.device_get(arr)).mean(0))
+        named_b = dict(self.model.named_buffers())
+        for n, arr in self.buffers.items():
+            named_b[n]._data = jnp.asarray(
+                np.asarray(jax.device_get(arr)).mean(0))
+        self.optimizer._global_step = self._step_i

@@ -82,14 +82,22 @@ class ShardedTrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, dp_axis=None,
-                 zero_stage=0, donate=True, remat=False, shard_seq=True):
+                 zero_stage=0, donate=True, remat=False, shard_seq=True,
+                 return_outputs=False):
+        from ..jit import transforms as tfm
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.return_outputs = return_outputs
         self.mesh = mesh or mesh_mod.get_mesh() or mesh_mod.default_mesh()
         self.dp_axis = dp_axis or (
             mesh_mod.DP_AXIS if mesh_mod.DP_AXIS in self.mesh.axis_names
             else self.mesh.axis_names[0])
+        # strategy transforms from the fleet meta-optimizer chain override
+        # the constructor defaults (jit/transforms.py)
+        self.transforms = tfm.resolve(optimizer)
+        zero_stage = tfm.zero_stage_of(self.transforms, zero_stage)
+        remat = remat or self.transforms.get("recompute") is not None
         self.zero_stage = zero_stage
         self.shard_seq = shard_seq
 
@@ -139,20 +147,34 @@ class ShardedTrainStep:
                     p, buffers, *_wrap(inputs))
                 outs = out if isinstance(out, tuple) else (out,)
                 loss_t = loss_fn(*outs, *_wrap(labels))
-            return _unwrap(loss_t), new_buf
+            return _unwrap(loss_t), (new_buf, _unwrap(out))
 
+        # amp autocast (recompute is handled by the remat flag below so a
+        # strategy-enabled recompute isn't checkpointed twice)
+        amp_only = {k: v for k, v in self.transforms.items() if k == "amp"}
+        _forward = tfm.wrap_forward(_forward, amp_only)
         if remat:
             _forward = jax.checkpoint(_forward, static_argnums=())
 
-        def _step(params, buffers, opt_state, key, lr, step_i, inputs, labels):
+        # k-step gradient merge (strategy.gradient_merge): accumulator
+        # sharded like the grads (= params)
+        k_merge, merge_avg = tfm.merge_config(self.transforms)
+        self.grad_acc = tfm.init_grad_acc(params, k_merge)
+        if k_merge > 1:
+            self.grad_acc = {n: shard(a, self.param_specs[n])
+                             for n, a in self.grad_acc.items()}
+        update_fn = tfm.merged_update(apply_fn, k_merge, merge_avg)
+
+        def _step(params, buffers, opt_state, acc, key, lr, step_i,
+                  inputs, labels):
             def pure_loss(p):
                 return _forward(p, buffers, key, inputs, labels)
 
-            (loss, new_buf), grads = jax.value_and_grad(
+            (loss, (new_buf, outs)), grads = jax.value_and_grad(
                 pure_loss, has_aux=True)(params)
-            new_params, new_opt = apply_fn(params, grads, opt_state, lr,
-                                           step_i)
-            return loss, new_params, new_buf, new_opt
+            new_params, new_opt, new_acc = update_fn(
+                params, grads, opt_state, acc, lr, step_i)
+            return loss, new_params, new_buf, new_opt, new_acc, outs
 
         # output shardings mirror inputs so state stays put across steps
         ns = lambda spec: NamedSharding(mesh, spec)
@@ -160,12 +182,14 @@ class ShardedTrainStep:
         buffer_sh = {n: ns(P()) for n in self.buffers}
         opt_sh = {n: {sn: ns(s) for sn, s in slots.items()}
                   for n, slots in self.opt_specs.items()}
+        acc_sh = {n: param_sh[n] for n in self.grad_acc}
         self._compiled = jax.jit(
             _step,
-            in_shardings=(param_sh, buffer_sh, opt_sh, None, None, None,
-                          None, None),
-            out_shardings=(ns(P()), param_sh, buffer_sh, opt_sh),
-            donate_argnums=(0, 1, 2) if donate else (),
+            in_shardings=(param_sh, buffer_sh, opt_sh, acc_sh, None, None,
+                          None, None, None),
+            out_shardings=(ns(P()), param_sh, buffer_sh, opt_sh, acc_sh,
+                           None),
+            donate_argnums=(0, 1, 2, 3) if donate else (),
         )
 
     # ------------------------------------------------------------------ step
@@ -195,11 +219,14 @@ class ShardedTrainStep:
         self._step_i += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         with self.mesh:
-            loss, self.params, self.buffers, self.opt_state = self._compiled(
-                self.params, self.buffers, self.opt_state,
+            (loss, self.params, self.buffers, self.opt_state,
+             self.grad_acc, outs) = self._compiled(
+                self.params, self.buffers, self.opt_state, self.grad_acc,
                 state.next_rng_key(), lr,
                 jnp.asarray(self._step_i, jnp.int32),
                 self._shard_batch(inputs), self._shard_batch(labels))
+        if self.return_outputs:
+            return Tensor(loss), _wrap(outs)
         return Tensor(loss)
 
     def sync(self):

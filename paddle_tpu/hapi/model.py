@@ -9,7 +9,6 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 from ..framework import state
-from ..jit import TrainStep, _wrap, _unwrap
 from ..metric import Metric
 from . import callbacks as cbks
 
@@ -96,11 +95,20 @@ class Model:
         from ..optimizer.lr import LRScheduler
         inputs, labels = self._split_batch(batch)
         if self._train_step is None:
-            self._train_step = TrainStep(self.network, self._loss_fn,
-                                         self._optimizer,
-                                         return_outputs=bool(self._metrics))
+            from ..distributed.fleet.base import build_train_step
+            self._train_step = build_train_step(
+                self.network, self._loss_fn, self._optimizer,
+                return_outputs=bool(self._metrics))
         result = self._train_step(inputs, labels)
-        if self._metrics:
+        has_outs = getattr(self._train_step, "return_outputs", False)
+        if self._metrics and not has_outs:
+            import warnings
+            warnings.warn(
+                f"{type(self._train_step).__name__} does not expose batch "
+                f"outputs; train metrics will not be computed (loss only)",
+                stacklevel=2)
+            self._metrics = []
+        if self._metrics and has_outs:
             loss_t, outs = result
             outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
             metric_logs = {}
@@ -123,8 +131,9 @@ class Model:
         labels = labels if isinstance(labels, (list, tuple)) else (
             [labels] if labels is not None else [])
         if self._train_step is None:
-            self._train_step = TrainStep(self.network, self._loss_fn,
-                                         self._optimizer)
+            from ..distributed.fleet.base import build_train_step
+            self._train_step = build_train_step(
+                self.network, self._loss_fn, self._optimizer)
         loss = self._train_step(tuple(inputs), tuple(labels))
         return [float(loss.numpy())]
 

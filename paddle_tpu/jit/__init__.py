@@ -123,6 +123,7 @@ class TrainStep:
 
     def __init__(self, model, loss_fn, optimizer, donate=True,
                  return_outputs=False):
+        from . import transforms as tfm
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -137,21 +138,34 @@ class TrainStep:
         self._step_i = optimizer._global_step
         apply_fn = optimizer.apply_gradients_fn()
 
-        def _step(params, buffers, opt_state, key, lr, step_i, inputs, labels):
-            def pure_loss(p):
-                with state.functional_rng_ctx(key):
-                    out, new_buf = model.functional_call(
-                        p, buffers, *_wrap(inputs))
-                    outs = out if isinstance(out, tuple) else (out,)
-                    loss_t = loss_fn(*outs, *_wrap(labels))
-                return _unwrap(loss_t), (new_buf, _unwrap(out))
+        # strategy transforms recorded by the fleet meta-optimizer chain
+        # (amp autocast, recompute, k-step gradient merge) — see
+        # jit/transforms.py for the mapping
+        self.transforms = tfm.resolve(optimizer)
+        k_merge, merge_avg = tfm.merge_config(self.transforms)
+        self.grad_acc = tfm.init_grad_acc(self.params, k_merge)
+        update_fn = tfm.merged_update(apply_fn, k_merge, merge_avg)
 
+        def _forward(p, bufs, key, inputs, labels):
+            with state.functional_rng_ctx(key):
+                out, new_buf = model.functional_call(
+                    p, bufs, *_wrap(inputs))
+                outs = out if isinstance(out, tuple) else (out,)
+                loss_t = loss_fn(*outs, *_wrap(labels))
+            return _unwrap(loss_t), (new_buf, _unwrap(out))
+
+        _forward = tfm.wrap_forward(_forward, self.transforms)
+
+        def _step(params, buffers, opt_state, acc, key, lr, step_i,
+                  inputs, labels):
             (loss, (new_buf, outs)), grads = jax.value_and_grad(
-                pure_loss, has_aux=True)(params)
-            new_params, new_opt = apply_fn(params, grads, opt_state, lr, step_i)
-            return loss, new_params, new_buf, new_opt, outs
+                lambda p: _forward(p, buffers, key, inputs, labels),
+                has_aux=True)(params)
+            new_params, new_opt, new_acc = update_fn(
+                params, grads, opt_state, acc, lr, step_i)
+            return loss, new_params, new_buf, new_opt, new_acc, outs
 
-        donate_args = (0, 1, 2) if donate else ()
+        donate_args = (0, 1, 2, 3) if donate else ()
         self._compiled = jax.jit(_step, donate_argnums=donate_args)
 
     def __call__(self, inputs, labels):
@@ -159,8 +173,10 @@ class TrainStep:
         labels = labels if isinstance(labels, (list, tuple)) else (labels,)
         self._step_i += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, self.params, self.buffers, self.opt_state, outs = self._compiled(
-            self.params, self.buffers, self.opt_state, state.next_rng_key(),
+        (loss, self.params, self.buffers, self.opt_state, self.grad_acc,
+         outs) = self._compiled(
+            self.params, self.buffers, self.opt_state, self.grad_acc,
+            state.next_rng_key(),
             lr, jnp.asarray(self._step_i, jnp.int32),
             _unwrap(tuple(inputs)), _unwrap(tuple(labels)))
         if self.return_outputs:

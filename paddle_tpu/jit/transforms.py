@@ -1,0 +1,115 @@
+"""Strategy transforms -> compiled-step rewrites.
+
+The consumption point for fleet.DistributedStrategy: each meta-optimizer
+(distributed/fleet/meta_optimizers.py) records its feature in
+``optimizer.transforms``; the compiled train steps (jit.TrainStep,
+distributed.sharded.ShardedTrainStep) call into here so the flags actually
+change execution — the TPU-native analog of the reference meta-optimizers
+rewriting the ProgramDesc (ref fleet/base/fleet_base.py:1070 chained via
+base/strategy_compiler.py:89, e.g. sharding_optimizer.py:100,
+amp_optimizer.py, recompute_optimizer.py):
+
+  amp            -> bf16 autocast (O1: white/black-list casts inside the
+                    traced forward via the dispatch amp state; O2: params
+                    cast to bf16 for compute, fp32 masters kept for the
+                    update — ref mixed_precision master-weight semantics)
+  recompute      -> jax.checkpoint over the forward (rematerialize in bwd)
+  gradient_merge -> in-step k-step gradient accumulation under lax.cond
+  sharding       -> ZeRO stage for ShardedTrainStep (opt-state/dp sharding)
+  localsgd       -> LocalSGDTrainStep (distributed/localsgd.py)
+  pipeline       -> PipelineTrainStep (distributed/pipeline.py)
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework import state
+
+
+def resolve(optimizer):
+    """The transform dict accumulated by the meta-optimizer chain."""
+    return dict(getattr(optimizer, "transforms", None) or {})
+
+
+def wrap_forward(forward, transforms):
+    """Apply amp/recompute to a functional forward
+    ``forward(params, buffers, key, inputs, labels) -> (loss, aux)``.
+    Order: autocast innermost, checkpoint outermost (the rematerialized
+    segment must replay the same casts)."""
+    amp = transforms.get("amp")
+    if amp:
+        level = amp.get("level", "O1")
+        low = jnp.bfloat16 if str(amp.get("dtype", "bfloat16")).endswith(
+            ("bfloat16", "bf16")) else jnp.float16
+        inner = forward
+
+        def amp_forward(p, buffers, key, inputs, labels):
+            if level == "O2":
+                # compute in low precision, master weights stay fp32 —
+                # the cast is differentiable so grads return as fp32
+                p = jax.tree.map(
+                    lambda a: a.astype(low)
+                    if a.dtype == jnp.float32 else a, p)
+            with state.amp_guard_ctx({"level": level, "dtype": low}):
+                return inner(p, buffers, key, inputs, labels)
+
+        forward = amp_forward
+    if transforms.get("recompute") is not None:
+        forward = jax.checkpoint(forward)
+    return forward
+
+
+def merge_config(transforms):
+    """(k_steps, avg) for in-step gradient accumulation."""
+    gm = transforms.get("gradient_merge") or {}
+    return max(1, int(gm.get("k_steps", 1) or 1)), bool(gm.get("avg", True))
+
+
+def zero_stage_of(transforms, default=0):
+    """ZeRO stage implied by the sharding transform (ref
+    sharding_optimizer.py 'sharding_degree'/'stage' configs)."""
+    sh = transforms.get("sharding")
+    if sh is None:
+        return default
+    return int(sh.get("stage", 1) or 1)
+
+
+def merged_update(apply_fn, k_steps, avg):
+    """Wrap an optimizer apply_fn with k-step gradient accumulation:
+    returns ``update(params, grads, opt_state, acc, lr, step_i) ->
+    (new_params, new_opt, new_acc)``. With k_steps == 1 the accumulator
+    is a zero-leaf passthrough."""
+
+    if k_steps <= 1:
+        def update1(params, grads, opt_state, acc, lr, step_i):
+            new_params, new_opt = apply_fn(params, grads, opt_state, lr,
+                                           step_i)
+            return new_params, new_opt, acc
+        return update1
+
+    def update(params, grads, opt_state, acc, lr, step_i):
+        acc = jax.tree.map(lambda a, g: a + g, acc, grads)
+
+        def do_update(op):
+            p0, o0, a0 = op
+            g = jax.tree.map(lambda a: a / k_steps, a0) if avg else a0
+            # the optimizer's step count is the number of APPLIED updates
+            # (Adam bias correction must see t=1,2,... — matching the eager
+            # GradientMergeOptimizer, which steps the inner opt every k-th
+            # call), not the micro-step counter
+            np_, no_ = apply_fn(p0, g, o0, lr, step_i // k_steps)
+            return np_, no_, jax.tree.map(jnp.zeros_like, a0)
+
+        def keep(op):
+            return op
+
+        return jax.lax.cond(step_i % k_steps == 0, do_update, keep,
+                            (params, opt_state, acc))
+
+    return update
+
+
+def init_grad_acc(params, k_steps):
+    """Zero accumulator tree (empty when accumulation is off)."""
+    if k_steps <= 1:
+        return {}
+    return {n: jnp.zeros_like(a) for n, a in params.items()}

