@@ -94,6 +94,56 @@ def _stacked_spec(hint, mesh, shape, pp_axis):
 
 
 # --------------------------------------------------------------------------
+# model partitioning spec
+# --------------------------------------------------------------------------
+
+class PipelineParts:
+    """How a model maps onto pipeline stages — decouples the engines from any
+    particular model class (the reference marks placement with device_guard;
+    here the decomposition is explicit):
+
+      pre:    Layer: model inputs -> first-stage activations (embeddings)
+      blocks: homogeneous list of Layers (the pipelined trunk)
+      post:   Layer or None applied after the last stage (final norm)
+      head_call(post_params, pre_params, h, labels) -> loss array
+              (defaults to post -> loss_fn; GPT supplies the tied-embedding
+              projection here)
+    """
+
+    def __init__(self, pre, blocks, post=None, head_call=None):
+        self.pre = pre
+        self.blocks = list(blocks)
+        self.post = post
+        self.head_call = head_call
+
+
+def resolve_parts(model, loss_fn):
+    """PipelineParts for `model`: model.pipeline_parts(loss_fn) if it defines
+    one, else the GPTForPretraining shape (embeddings/blocks/ln_f + tied
+    head), else an actionable error."""
+    if hasattr(model, "pipeline_parts"):
+        return model.pipeline_parts(loss_fn)
+    gpt = getattr(model, "gpt", None)
+    if gpt is not None and hasattr(gpt, "blocks"):
+        ln_f = gpt.ln_f
+
+        def head_call(post_p, pre_p, h, labels):
+            out, _ = ln_f.functional_call(post_p, {}, Tensor(h))
+            w_emb = pre_p["word_embeddings.weight"]
+            logits = jnp.einsum("bsh,vh->bsv", out._data, w_emb,
+                                preferred_element_type=jnp.float32)
+            l = loss_fn(Tensor(logits), Tensor(labels))
+            return l._data if isinstance(l, Tensor) else l
+
+        return PipelineParts(gpt.embeddings, list(gpt.blocks), gpt.ln_f,
+                             head_call)
+    raise ValueError(
+        "cannot infer pipeline stages: give the model a "
+        "pipeline_parts(loss_fn) -> PipelineParts method, or pass "
+        "parts= explicitly (pre/blocks/post/head_call)")
+
+
+# --------------------------------------------------------------------------
 # core schedule
 # --------------------------------------------------------------------------
 
@@ -174,7 +224,7 @@ class PipelineTrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, num_micro=4,
-                 num_stages=None, remat=True, donate=True):
+                 num_stages=None, remat=True, donate=True, parts=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -186,19 +236,22 @@ class PipelineTrainStep:
         self.dp_axis = (mesh_mod.DP_AXIS
                         if mesh_mod.DP_AXIS in self.mesh.axis_names else None)
 
-        blocks = list(model.gpt.blocks)
+        self.parts = parts or resolve_parts(model, loss_fn)
+        blocks = self.parts.blocks
         L = len(blocks)
         S = self.num_stages
         assert L % S == 0, f"{L} layers not divisible by {S} stages"
         self.lps = L // S
 
-        # ---- split state: pre (embeddings), blocks (stacked), post (ln_f)
+        # ---- split state: pre (embeddings), blocks (stacked), post (norm)
         self.blocks_layer = blocks[0]
         stacked = {n: a.reshape((S, self.lps) + a.shape[1:])
                    for n, a in stack_block_params(blocks).items()}
         pre_p = {n: p._data
-                 for n, p in model.gpt.embeddings.named_parameters()}
-        post_p = {n: p._data for n, p in model.gpt.ln_f.named_parameters()}
+                 for n, p in self.parts.pre.named_parameters()}
+        post_p = ({n: p._data
+                   for n, p in self.parts.post.named_parameters()}
+                  if self.parts.post is not None else {})
 
         params = {}
         params.update({"pre." + n: a for n, a in pre_p.items()})
@@ -209,7 +262,7 @@ class PipelineTrainStep:
         hints = {n: getattr(p, "sharding", None)
                  for n, p in self.blocks_layer.named_parameters()}
         emb_hints = {n: getattr(p, "sharding", None)
-                     for n, p in model.gpt.embeddings.named_parameters()}
+                     for n, p in self.parts.pre.named_parameters()}
         self.param_specs = {}
         for n, a in params.items():
             if n.startswith("blocks."):
@@ -235,8 +288,7 @@ class PipelineTrainStep:
         self._step_i = optimizer._global_step
         apply_fn = optimizer.apply_gradients_fn()
 
-        embeddings = model.gpt.embeddings
-        ln_f = model.gpt.ln_f
+        embeddings = self.parts.pre
         mesh = self.mesh
 
         def block_call(layer_params, x, key):
@@ -250,12 +302,17 @@ class PipelineTrainStep:
                 out, _ = embeddings.functional_call(pre_p, {}, Tensor(ids))
             return out._data if isinstance(out, Tensor) else out
 
-        def post_call(post_p, w_emb, h, labels):
-            out, _ = ln_f.functional_call(post_p, {}, Tensor(h))
-            logits = jnp.einsum("bsh,vh->bsv", out._data, w_emb,
-                                preferred_element_type=jnp.float32)
-            l = loss_fn(Tensor(logits), Tensor(labels))
-            return l._data if isinstance(l, Tensor) else l
+        if self.parts.head_call is not None:
+            head_call = self.parts.head_call
+        else:
+            post_layer = self.parts.post
+
+            def head_call(post_p, pre_p, h, labels):
+                if post_layer is not None:
+                    out, _ = post_layer.functional_call(post_p, {}, Tensor(h))
+                    h = out._data if isinstance(out, Tensor) else out
+                l = loss_fn(Tensor(h), Tensor(labels))
+                return l._data if isinstance(l, Tensor) else l
 
         M = self.num_micro
 
@@ -269,9 +326,8 @@ class PipelineTrainStep:
                 ids_micro, jax.random.split(k_pre, M))
             hs = pipeline_apply(block_call, blocks_p, x, S, mesh=mesh,
                                 remat=remat, key=k_pipe)
-            w_emb = pre["word_embeddings.weight"]
             losses = jax.vmap(
-                lambda h, lab: post_call(post, w_emb, h, lab))(
+                lambda h, lab: head_call(post, pre, h, lab))(
                     hs, labels_micro)
             return jnp.mean(losses)
 
@@ -319,9 +375,10 @@ class PipelineTrainStep:
         S, lps = self.num_stages, self.lps
         named = {}
         named.update({"pre." + n: p for n, p in
-                      self.model.gpt.embeddings.named_parameters()})
-        named.update({"post." + n: p for n, p in
-                      self.model.gpt.ln_f.named_parameters()})
+                      self.parts.pre.named_parameters()})
+        if self.parts.post is not None:
+            named.update({"post." + n: p for n, p in
+                          self.parts.post.named_parameters()})
         stacked = {}
         for n, arr in self.params.items():
             if n.startswith("blocks."):
@@ -330,5 +387,5 @@ class PipelineTrainStep:
                                                         + a.shape[2:])
             else:
                 named[n]._data = jnp.copy(jax.device_get(arr))
-        unstack_block_params(list(self.model.gpt.blocks), stacked)
+        unstack_block_params(self.parts.blocks, stacked)
         self.optimizer._global_step = self._step_i

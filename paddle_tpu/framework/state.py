@@ -255,6 +255,23 @@ def get_flag(key, default=None):
 _grad_enabled = contextvars.ContextVar("grad_enabled", default=True)
 _functional_mode = contextvars.ContextVar("functional_mode", default=False)
 _functional_rng = contextvars.ContextVar("functional_rng", default=None)
+_static_recorder = contextvars.ContextVar("static_recorder", default=None)
+
+
+def get_static_recorder():
+    """Active ProgramDesc recorder (static/program.py) or None. When set,
+    ops/dispatch.apply records every op into the current Program's desc
+    (ref imperative/tracer.cc:132 TraceOp writing OpDesc in static mode)."""
+    return _static_recorder.get()
+
+
+@contextlib.contextmanager
+def static_recorder_ctx(rec):
+    tok = _static_recorder.set(rec)
+    try:
+        yield
+    finally:
+        _static_recorder.reset(tok)
 
 
 class _TracedRng:

@@ -17,14 +17,16 @@ from jax import lax
 from ..framework import state
 from ..framework.dtype import convert_dtype
 from ..framework.tensor import Tensor
-from ..ops.dispatch import apply, as_array
+from ..ops.dispatch import apply, as_array, register_op
 
 # ----------------------------------------------------------------- activations
 
 
 def _unary(fn, name):
-    def op(x, name=None):
-        return apply(fn, (x,), name=name)
+    register_op(name, fn)
+
+    def op(x, name=None, _opname=name):
+        return apply(fn, (x,), name=_opname)
     op.__name__ = name
     return op
 
@@ -41,14 +43,28 @@ hardsigmoid = _unary(lambda a: jnp.clip(a / 6.0 + 0.5, 0.0, 1.0), "hardsigmoid")
 tanhshrink = _unary(lambda a: a - jnp.tanh(a), "tanhshrink")
 
 
+def _gelu_raw(a, approximate=False):
+    return jax.nn.gelu(a, approximate=approximate)
+
+
+register_op("gelu", _gelu_raw)
+
+
 def gelu(x, approximate=False, name=None):
-    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), (x,),
+    return apply(_gelu_raw, (x,), {"approximate": bool(approximate)},
                  name="gelu")
 
 
+def _leaky_relu_raw(a, negative_slope=0.01):
+    return jax.nn.leaky_relu(a, negative_slope)
+
+
+register_op("leaky_relu", _leaky_relu_raw)
+
+
 def leaky_relu(x, negative_slope=0.01, name=None):
-    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), (x,),
-                 name="leaky_relu")
+    return apply(_leaky_relu_raw, (x,),
+                 {"negative_slope": float(negative_slope)}, name="leaky_relu")
 
 
 def elu(x, alpha=1.0, name=None):
@@ -110,20 +126,34 @@ def maxout(x, groups, axis=1, name=None):
     return apply(f, (x,), name="maxout")
 
 
+def _softmax_raw(a, axis=-1, to_dtype=None):
+    if to_dtype is not None:
+        a = a.astype(convert_dtype(to_dtype))
+    return jax.nn.softmax(a, axis=axis)
+
+
+register_op("softmax", _softmax_raw)
+
+
 def softmax(x, axis=-1, dtype=None, name=None):
-    def f(a):
-        if dtype is not None:
-            a = a.astype(convert_dtype(dtype))
-        return jax.nn.softmax(a, axis=axis)
-    return apply(f, (x,), name="softmax")
+    return apply(_softmax_raw, (x,),
+                 {"axis": int(axis), "to_dtype": None if dtype is None else
+                  str(np.dtype(convert_dtype(dtype)))}, name="softmax")
+
+
+def _log_softmax_raw(a, axis=-1, to_dtype=None):
+    if to_dtype is not None:
+        a = a.astype(convert_dtype(to_dtype))
+    return jax.nn.log_softmax(a, axis=axis)
+
+
+register_op("log_softmax", _log_softmax_raw)
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
-    def f(a):
-        if dtype is not None:
-            a = a.astype(convert_dtype(dtype))
-        return jax.nn.log_softmax(a, axis=axis)
-    return apply(f, (x,), name="log_softmax")
+    return apply(_log_softmax_raw, (x,),
+                 {"axis": int(axis), "to_dtype": None if dtype is None else
+                  str(np.dtype(convert_dtype(dtype)))}, name="log_softmax")
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
@@ -145,24 +175,38 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 # ----------------------------------------------------------------- linear / emb
 
+def _linear_raw(a, w, b=None):
+    out = jnp.matmul(a, w)
+    return out if b is None else out + b
+
+
+register_op("linear", _linear_raw)
+
+
 def linear(x, weight, bias=None, name=None):
     """paddle weight layout: [in_features, out_features] (ref nn/functional/common.py:1419)."""
     if bias is None:
-        return apply(lambda a, w: jnp.matmul(a, w), (x, weight), name="linear")
-    return apply(lambda a, w, b: jnp.matmul(a, w) + b, (x, weight, bias),
-                 name="linear")
+        return apply(_linear_raw, (x, weight), name="linear")
+    return apply(_linear_raw, (x, weight, bias), name="linear")
+
+
+def _embedding_raw(idx, w, padding_idx=None):
+    out = jnp.take(w, idx, axis=0)
+    if padding_idx is not None:
+        mask = (idx == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+register_op("embedding", _embedding_raw)
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Device-side gather (TPU: embedding lookups stay on-chip; host-resident
     sparse tables are the PS path, see distributed/ps)."""
-    def f(idx, w):
-        out = jnp.take(w, idx, axis=0)
-        if padding_idx is not None:
-            mask = (idx == padding_idx)[..., None]
-            out = jnp.where(mask, 0.0, out)
-        return out
-    return apply(f, (x, weight), name="embedding")
+    return apply(_embedding_raw, (x, weight),
+                 {"padding_idx": None if padding_idx is None
+                  else int(padding_idx)}, name="embedding")
 
 
 def one_hot(x, num_classes, name=None):
@@ -172,22 +216,45 @@ def one_hot(x, num_classes, name=None):
 
 # ----------------------------------------------------------------- dropout
 
-def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
-            name=None):
+def _dropout_raw(v, key, p=0.5, axis=None, mode="upscale_in_train",
+                 training=True):
+    """rng-explicit dropout (ref operators/dropout_op.cc: seed attr + mask
+    output; here the mask is derived from a key input so the static desc
+    replays with fresh randomness per run)."""
     if not training or p == 0.0:
-        return x if isinstance(x, Tensor) else Tensor(x)
-    a = as_array(x)
-    shape = tuple(a.shape)
+        return v
+    shape = tuple(v.shape)
     if axis is not None:
         axes = axis if isinstance(axis, (list, tuple)) else [axis]
-        shape = tuple(s if i in axes else 1 for i, s in enumerate(a.shape))
-    keep = jax.random.bernoulli(state.next_rng_key(), 1.0 - p, shape)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(v.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, v / (1.0 - p), 0.0)
+    return jnp.where(keep, v, 0.0)
 
-    def f(v):
-        if mode == "upscale_in_train":
-            return jnp.where(keep, v / (1.0 - p), 0.0)
-        return jnp.where(keep, v, 0.0)
-    return apply(f, (x,), name="dropout")
+
+register_op("dropout", _dropout_raw)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    # same gating as apply(): in functional (jit-trace) mode the recorder is
+    # inert and the eager fast path below is safe
+    rec = None if state.is_functional_mode() else state.get_static_recorder()
+    if (not training or p == 0.0) and rec is None:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = state.next_rng_key()
+    if isinstance(axis, (list, tuple)):
+        axis = [int(a) for a in axis]
+    elif axis is not None:
+        axis = int(axis)
+    # "__rng__": True asks the recorder to salt this op so the Executor
+    # re-derives the key input per run (dispatch strips dunder attrs before
+    # calling the impl)
+    return apply(_dropout_raw, (x, Tensor(key)),
+                 {"p": float(p), "axis": axis, "mode": mode,
+                  "training": bool(training), "__rng__": True},
+                 name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -494,58 +561,75 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 # ----------------------------------------------------------------- norm
 
+def _batch_norm_raw(v, rm, rv, *wb, ch_axis=1, momentum=0.9, epsilon=1e-5,
+                    training=False):
+    """Single batch_norm op: y + updated running stats as explicit outputs
+    (ref operators/batch_norm_op.cc MeanOut/VarianceOut in-place outputs).
+    Eval mode passes the stats through unchanged."""
+    ch = ch_axis % v.ndim
+    shape = [1] * v.ndim
+    shape[ch] = v.shape[ch]
+    if training:
+        reduce_axes = tuple(i for i in range(v.ndim) if i != ch)
+        m = jnp.mean(v, axis=reduce_axes)
+        var = jnp.var(v, axis=reduce_axes)
+        new_rm = momentum * rm + (1 - momentum) * m
+        new_rv = momentum * rv + (1 - momentum) * var
+        inv = lax.rsqrt(var.reshape(shape) + epsilon)
+        out = (v - m.reshape(shape)) * inv
+    else:
+        new_rm, new_rv = rm, rv
+        inv = lax.rsqrt(rv.reshape(shape) + epsilon)
+        out = (v - rm.reshape(shape)) * inv
+    if wb:
+        out = out * wb[0].reshape(shape)
+        if len(wb) > 1:
+            out = out + wb[1].reshape(shape)
+    return out, lax.stop_gradient(new_rm), lax.stop_gradient(new_rv)
+
+
+register_op("batch_norm", _batch_norm_raw)
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
                use_global_stats=None, name=None):
-    """ref operators/batch_norm_op.cc. Updates running stats in-place on the
-    Tensor objects (buffer mutation is captured by functional_call)."""
+    """ref operators/batch_norm_op.cc. Running stats are explicit op outputs;
+    the wrapper writes them back onto the buffer Tensors (captured by
+    functional_call and by the static recorder via alias_output)."""
     ch_axis = 1 if data_format in ("NCHW", "NCL", "NCDHW") else -1
-
-    a = as_array(x)
-    reduce_axes = tuple(i for i in range(a.ndim) if i != (ch_axis % a.ndim))
     use_batch_stats = training and not use_global_stats
-
-    if use_batch_stats:
-        batch_mean = jnp.mean(a, axis=reduce_axes)
-        batch_var = jnp.var(a, axis=reduce_axes)
-        # update running stats (paddle: momentum * running + (1-m) * batch)
-        running_mean._data = (momentum * running_mean._data
-                              + (1 - momentum) * batch_mean)
-        running_var._data = (momentum * running_var._data
-                             + (1 - momentum) * batch_var)
-        mean_t = Tensor(batch_mean)
-        var_t = Tensor(batch_var)
-        # keep grad flow through batch stats: recompute inside f
-        def f(v, w_, b_):
-            m = jnp.mean(v, axis=reduce_axes, keepdims=True)
-            var = jnp.var(v, axis=reduce_axes, keepdims=True)
-            inv = lax.rsqrt(var + epsilon)
-            shape = [1] * v.ndim
-            shape[ch_axis] = v.shape[ch_axis]
-            out = (v - m) * inv
-            if w_ is not None:
-                out = out * w_.reshape(shape)
-            if b_ is not None:
-                out = out + b_.reshape(shape)
-            return out
-    else:
-        rm, rv = running_mean._data, running_var._data
-
-        def f(v, w_, b_):
-            shape = [1] * v.ndim
-            shape[ch_axis] = v.shape[ch_axis]
-            inv = lax.rsqrt(rv.reshape(shape) + epsilon)
-            out = (v - rm.reshape(shape)) * inv
-            if w_ is not None:
-                out = out * w_.reshape(shape)
-            if b_ is not None:
-                out = out + b_.reshape(shape)
-            return out
-
+    args = [x, running_mean, running_var]
     if weight is not None and bias is not None:
-        return apply(lambda v, w_, b_: f(v, w_, b_), (x, weight, bias),
-                     name="batch_norm")
-    return apply(lambda v: f(v, None, None), (x,), name="batch_norm")
+        args += [weight, bias]
+    outs = apply(_batch_norm_raw, tuple(args),
+                 {"ch_axis": int(ch_axis), "momentum": float(momentum),
+                  "epsilon": float(epsilon),
+                  "training": bool(use_batch_stats)}, name="batch_norm")
+    y, new_rm, new_rv = outs
+    if use_batch_stats:
+        rec = state.get_static_recorder()
+        if rec is not None:
+            rec.alias_output(new_rm, running_mean)
+            rec.alias_output(new_rv, running_var)
+        running_mean._data = new_rm._data
+        running_var._data = new_rv._data
+    return y
+
+
+def _layer_norm_raw(a, *wb, nd=1, epsilon=1e-5):
+    axes = tuple(range(a.ndim - nd, a.ndim))
+    m = jnp.mean(a, axis=axes, keepdims=True)
+    v = jnp.var(a, axis=axes, keepdims=True)
+    out = (a - m) * lax.rsqrt(v + epsilon)
+    if wb:
+        out = out * wb[0]
+        if len(wb) > 1:
+            out = out + wb[1]
+    return out
+
+
+register_op("layer_norm", _layer_norm_raw)
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
@@ -553,24 +637,13 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
     if isinstance(normalized_shape, numbers.Number):
         normalized_shape = (normalized_shape,)
     nd = len(tuple(normalized_shape))
-
-    def f(a, *wb):
-        axes = tuple(range(a.ndim - nd, a.ndim))
-        m = jnp.mean(a, axis=axes, keepdims=True)
-        v = jnp.var(a, axis=axes, keepdims=True)
-        out = (a - m) * lax.rsqrt(v + epsilon)
-        if wb:
-            out = out * wb[0]
-            if len(wb) > 1:
-                out = out + wb[1]
-        return out
-
     args = [x]
     if weight is not None:
         args.append(weight)
         if bias is not None:
             args.append(bias)
-    return apply(f, tuple(args), name="layer_norm")
+    return apply(_layer_norm_raw, tuple(args),
+                 {"nd": nd, "epsilon": float(epsilon)}, name="layer_norm")
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
@@ -644,41 +717,50 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, name=None):
     """ref operators/softmax_with_cross_entropy_op.cc — fused log_softmax + NLL."""
-    def f(logits, lab, *maybe_w):
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits, axis=axis)
-        else:
-            logp = jnp.log(jnp.maximum(logits, 1e-30))
-        if soft_label:
-            per = -jnp.sum(lab * logp, axis=axis)
-        else:
-            lab_i = lab.astype(jnp.int32)
-            if lab_i.ndim == logp.ndim:  # [N,1] style labels
-                lab_i = jnp.squeeze(lab_i, axis=axis)
-            valid = lab_i != ignore_index
-            safe = jnp.where(valid, lab_i, 0)
-            per = -jnp.take_along_axis(logp, jnp.expand_dims(safe, axis),
-                                       axis=axis)
-            per = jnp.squeeze(per, axis=axis)
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply(_cross_entropy_raw, args,
+                 {"ignore_index": int(ignore_index), "reduction": reduction,
+                  "soft_label": bool(soft_label), "axis": int(axis),
+                  "use_softmax": bool(use_softmax)}, name="cross_entropy")
+
+
+def _cross_entropy_raw(logits, lab, *maybe_w, ignore_index=-100,
+                       reduction="mean", soft_label=False, axis=-1,
+                       use_softmax=True):
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    if soft_label:
+        per = -jnp.sum(lab * logp, axis=axis)
+    else:
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logp.ndim:  # [N,1] style labels
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        per = -jnp.take_along_axis(logp, jnp.expand_dims(safe, axis),
+                                   axis=axis)
+        per = jnp.squeeze(per, axis=axis)
+        if maybe_w:
+            w = jnp.take(maybe_w[0], safe)
+            per = per * w
+        per = jnp.where(valid, per, 0.0)
+        if reduction == "mean":
             if maybe_w:
                 w = jnp.take(maybe_w[0], safe)
-                per = per * w
-            per = jnp.where(valid, per, 0.0)
-            if reduction == "mean":
-                if maybe_w:
-                    w = jnp.take(maybe_w[0], safe)
-                    denom = jnp.sum(jnp.where(valid, w, 0.0))
-                else:
-                    denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
-                return jnp.sum(per) / denom
-        if reduction == "mean":
-            return jnp.mean(per)
-        if reduction == "sum":
-            return jnp.sum(per)
-        return per
+                denom = jnp.sum(jnp.where(valid, w, 0.0))
+            else:
+                denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+            return jnp.sum(per) / denom
+    if reduction == "mean":
+        return jnp.mean(per)
+    if reduction == "sum":
+        return jnp.sum(per)
+    return per
 
-    args = (input, label) if weight is None else (input, label, weight)
-    return apply(f, args, name="cross_entropy")
+
+register_op("cross_entropy", _cross_entropy_raw)
 
 
 softmax_with_cross_entropy = cross_entropy

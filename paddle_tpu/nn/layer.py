@@ -92,6 +92,10 @@ class Layer:
         self._buffers[str(name)] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(str(name))
+        else:
+            # static capture relies on this to thread the buffer through the
+            # desc as a persist var instead of freezing it as a constant
+            tensor.persistable = True
         return tensor
 
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,

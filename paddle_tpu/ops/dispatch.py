@@ -25,6 +25,14 @@ from ..framework.tape import GradNode
 # op-name -> python impl; consumed by the static-graph lowering (static/program.py)
 OP_REGISTRY = {}
 
+
+def register_op(name, fn):
+    """Make `fn` the canonical raw impl for `name`, so desc ops recorded from
+    apply(fn, ..., name=name) serialize (static/desc.py OpDesc.serializable:
+    the recorded fn must BE the registered one and attrs must be JSON-able)."""
+    OP_REGISTRY[name] = fn
+    return fn
+
 # AMP op lists (ref python/paddle/fluid/contrib/mixed_precision/fp16_lists.py):
 # white = compute-bound MXU ops run in low precision; black = numerically
 # sensitive ops kept f32. Everything else follows its inputs.
@@ -98,11 +106,16 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
     if amp is not None:
         arrays = _amp_cast(arrays, name, amp)
     if attrs:
-        f = functools.partial(fn, **attrs)
+        # dunder attrs (e.g. "__rng__") are recorder directives, not impl
+        # kwargs — static/desc.py resolve_impl strips them the same way
+        call_attrs = {k: v for k, v in attrs.items()
+                      if not k.startswith("__")}
+        f = functools.partial(fn, **call_attrs) if call_attrs else fn
     else:
         f = fn
 
     check = state.get_flag("FLAGS_check_nan_inf")
+    rec = None if state.is_functional_mode() else state.get_static_recorder()
 
     if state.is_functional_mode() or not state.is_grad_enabled():
         outs = f(*arrays)
@@ -112,7 +125,11 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
         # in functional mode JAX owns autodiff; stop_gradient only tracks lineage
         rg = (state.is_functional_mode() and differentiable
               and any(_requires_grad(t) for t in tensors))
-        return _wrap_outputs(tuple(outs) if multi else outs, multi, rg)
+        wrapped = _wrap_outputs(tuple(outs) if multi else outs, multi, rg)
+        if rec is not None:
+            rec.record_op(name, fn, f, tensors, attrs, wrapped, multi,
+                          differentiable)
+        return wrapped
 
     needs_grad = differentiable and any(_requires_grad(t) for t in tensors)
     if not needs_grad:
@@ -120,7 +137,11 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
         multi = isinstance(outs, (tuple, list))
         if check:
             _check_nan_inf(name, tuple(outs) if multi else (outs,))
-        return _wrap_outputs(tuple(outs) if multi else outs, multi, False)
+        wrapped = _wrap_outputs(tuple(outs) if multi else outs, multi, False)
+        if rec is not None:
+            rec.record_op(name, fn, f, tensors, attrs, wrapped, multi,
+                          differentiable)
+        return wrapped
 
     outs, vjp_fn = jax.vjp(f, *arrays)
     if check:
@@ -144,6 +165,9 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
     for i, w in enumerate(ws):
         w._node = node
         w._slot = i
+    if rec is not None:
+        rec.record_op(name, fn, f, tensors, attrs, wrapped, multi,
+                      differentiable)
     return wrapped
 
 

@@ -13,7 +13,7 @@ from jax import lax
 
 from ..framework.dtype import convert_dtype
 from ..framework.tensor import Tensor
-from .dispatch import apply, as_array
+from .dispatch import apply, as_array, register_op
 
 
 def _axes(axis):
@@ -22,38 +22,70 @@ def _axes(axis):
     return int(axis)
 
 
+def _cast_raw(a, to_dtype="float32"):
+    return a.astype(convert_dtype(to_dtype))
+
+
+register_op("cast", _cast_raw)
+
+
 def cast(x, dtype):
-    d = convert_dtype(dtype)
-    return apply(lambda a: a.astype(d), (x,), name="cast")
+    d = str(np.dtype(convert_dtype(dtype)))
+    return apply(_cast_raw, (x,), {"to_dtype": d}, name="cast")
 
 
 def reshape(x, shape, name=None):
     if isinstance(shape, Tensor):
         shape = shape.tolist()
     shape = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
-    return apply(lambda a: jnp.reshape(a, shape), (x,), name="reshape")
+    return apply(_reshape_raw, (x,), {"shape": shape}, name="reshape")
+
+
+def _reshape_raw(a, shape=()):
+    return jnp.reshape(a, tuple(shape))
+
+
+register_op("reshape", _reshape_raw)
 
 
 def reshape_(x, shape, name=None):
     out = reshape(x, shape)
     x._data = out._data
     x._node, x._slot = out._node, out._slot
+    # carry the static-desc binding: later consumers must record against the
+    # reshaped var, not the pre-mutation one
+    for attr in ("_desc_name", "_desc_rec", "_recorder"):
+        if attr in getattr(out, "__dict__", {}):
+            setattr(x, attr, getattr(out, attr))
     return x
 
 
+def _flatten_raw(a, start_axis=0, stop_axis=-1):
+    nd = a.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    return jnp.reshape(a, a.shape[:s] + (-1,) + a.shape[e + 1:])
+
+
+register_op("flatten", _flatten_raw)
+
+
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
-    def f(a):
-        nd = a.ndim
-        s = start_axis % nd if nd else 0
-        e = stop_axis % nd if nd else 0
-        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
-        return jnp.reshape(a, new_shape)
-    return apply(f, (x,), name="flatten")
+    return apply(_flatten_raw, (x,),
+                 {"start_axis": int(start_axis), "stop_axis": int(stop_axis)},
+                 name="flatten")
+
+
+def _transpose_raw(a, perm=()):
+    return jnp.transpose(a, tuple(perm))
+
+
+register_op("transpose", _transpose_raw)
 
 
 def transpose(x, perm, name=None):
     perm = tuple(int(p) for p in perm)
-    return apply(lambda a: jnp.transpose(a, perm), (x,), name="transpose")
+    return apply(_transpose_raw, (x,), {"perm": perm}, name="transpose")
 
 
 def moveaxis(x, source, destination, name=None):
@@ -61,8 +93,16 @@ def moveaxis(x, source, destination, name=None):
                  name="moveaxis")
 
 
+def _swapaxes_raw(a, axis1=0, axis2=1):
+    return jnp.swapaxes(a, axis1, axis2)
+
+
+register_op("swapaxes", _swapaxes_raw)
+
+
 def swapaxes(x, axis1, axis2, name=None):
-    return apply(lambda a: jnp.swapaxes(a, axis1, axis2), (x,), name="swapaxes")
+    return apply(_swapaxes_raw, (x,),
+                 {"axis1": int(axis1), "axis2": int(axis2)}, name="swapaxes")
 
 
 def t(x, name=None):
@@ -73,14 +113,27 @@ def concat(x, axis=0, name=None):
     tensors = list(x)
     if isinstance(axis, Tensor):
         axis = int(axis.item())
-    return apply(lambda *arrs: jnp.concatenate(arrs, axis=axis), tuple(tensors),
+    return apply(_concat_raw, tuple(tensors), {"axis": int(axis)},
                  name="concat")
+
+
+def _concat_raw(*arrs, axis=0):
+    return jnp.concatenate(arrs, axis=axis)
+
+
+register_op("concat", _concat_raw)
+
+
+def _stack_raw(*arrs, axis=0):
+    return jnp.stack(arrs, axis=axis)
+
+
+register_op("stack", _stack_raw)
 
 
 def stack(x, axis=0, name=None):
     tensors = list(x)
-    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), tuple(tensors),
-                 name="stack")
+    return apply(_stack_raw, tuple(tensors), {"axis": int(axis)}, name="stack")
 
 
 def unstack(x, axis=0, num=None, name=None):
@@ -96,17 +149,25 @@ def split(x, num_or_sections, axis=0, name=None):
     if isinstance(axis, Tensor):
         axis = int(axis.item())
 
-    def f(a):
-        if isinstance(num_or_sections, int):
-            return tuple(jnp.split(a, num_or_sections, axis=axis))
-        secs = [int(s) for s in num_or_sections]
-        total = a.shape[axis]
-        known = builtins.sum(s for s in secs if s >= 0)
-        secs = [s if s >= 0 else total - known for s in secs]
-        idxs = np.cumsum(secs)[:-1].tolist()
-        return tuple(jnp.split(a, idxs, axis=axis))
+    nos = num_or_sections
+    if not isinstance(nos, int):
+        nos = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in nos]
+    return list(apply(_split_raw, (x,), {"num_or_sections": nos,
+                                         "axis": int(axis)}, name="split"))
 
-    return list(apply(f, (x,), name="split"))
+
+def _split_raw(a, num_or_sections=1, axis=0):
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(a, num_or_sections, axis=axis))
+    secs = [int(s) for s in num_or_sections]
+    total = a.shape[axis]
+    known = builtins.sum(s for s in secs if s >= 0)
+    secs = [s if s >= 0 else total - known for s in secs]
+    idxs = np.cumsum(secs)[:-1].tolist()
+    return tuple(jnp.split(a, idxs, axis=axis))
+
+
+register_op("split", _split_raw)
 
 
 def chunk(x, chunks, axis=0, name=None):
@@ -117,39 +178,61 @@ def unbind(x, axis=0, name=None):
     return unstack(x, axis=axis)
 
 
+def _squeeze_raw(a, axis=None):
+    if axis is None:
+        return jnp.squeeze(a)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(ax % a.ndim for ax in axes)
+    axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+    return jnp.squeeze(a, axis=axes) if axes else a
+
+
+register_op("squeeze", _squeeze_raw)
+
+
 def squeeze(x, axis=None, name=None):
-    def f(a):
-        if axis is None:
-            return jnp.squeeze(a)
-        axes = axis if isinstance(axis, (list, tuple)) else [axis]
-        axes = tuple(ax % a.ndim for ax in axes)
-        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
-        return jnp.squeeze(a, axis=axes) if axes else a
-    return apply(f, (x,), name="squeeze")
+    if isinstance(axis, (list, tuple)):
+        axis = [int(a) for a in axis]
+    elif axis is not None:
+        axis = int(axis)
+    return apply(_squeeze_raw, (x,), {"axis": axis}, name="squeeze")
+
+
+def _unsqueeze_raw(a, axis=0):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    out = a
+    for ax in builtins.sorted(int(v) for v in axes):
+        out = jnp.expand_dims(out, ax)
+    return out
+
+
+register_op("unsqueeze", _unsqueeze_raw)
 
 
 def unsqueeze(x, axis, name=None):
-    def f(a):
-        axes = axis if isinstance(axis, (list, tuple)) else [axis]
-        out = a
-        for ax in builtins.sorted(int(v) for v in axes):
-            out = jnp.expand_dims(out, ax)
-        return out
-    return apply(f, (x,), name="unsqueeze")
+    if isinstance(axis, (list, tuple)):
+        axis = [int(a) for a in axis]
+    else:
+        axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply(_unsqueeze_raw, (x,), {"axis": axis}, name="unsqueeze")
 
 
 def expand(x, shape, name=None):
     if isinstance(shape, Tensor):
         shape = shape.tolist()
     shape = [int(s) for s in shape]
+    return apply(_expand_raw, (x,), {"shape": shape}, name="expand")
 
-    def f(a):
-        tgt = list(shape)
-        pad = len(tgt) - a.ndim
-        src = (1,) * pad + a.shape
-        tgt = [src[i] if tgt[i] == -1 else tgt[i] for i in range(len(tgt))]
-        return jnp.broadcast_to(a.reshape(src), tuple(tgt))
-    return apply(f, (x,), name="expand")
+
+def _expand_raw(a, shape=()):
+    tgt = list(shape)
+    pad = len(tgt) - a.ndim
+    src_shape = (1,) * pad + a.shape
+    tgt = [src_shape[i] if tgt[i] == -1 else tgt[i] for i in range(len(tgt))]
+    return jnp.broadcast_to(a.reshape(src_shape), tuple(tgt))
+
+
+register_op("expand", _expand_raw)
 
 
 broadcast_to = expand
@@ -163,7 +246,14 @@ def tile(x, repeat_times, name=None):
     if isinstance(repeat_times, Tensor):
         repeat_times = repeat_times.tolist()
     reps = tuple(int(r) for r in repeat_times)
-    return apply(lambda a: jnp.tile(a, reps), (x,), name="tile")
+    return apply(_tile_raw, (x,), {"reps": reps}, name="tile")
+
+
+def _tile_raw(a, reps=()):
+    return jnp.tile(a, tuple(reps))
+
+
+register_op("tile", _tile_raw)
 
 
 def repeat_interleave(x, repeats, axis=None, name=None):
@@ -199,15 +289,23 @@ def getitem(x, idx):
     return apply(lambda a: a[j_idx], (x,), name="getitem")
 
 
+def _slice_raw(a, axes=(), starts=(), ends=()):
+    idx = [builtins.slice(None)] * a.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[int(ax)] = builtins.slice(int(s), int(e))
+    return a[tuple(idx)]
+
+
+register_op("slice", _slice_raw)
+
+
 def slice(x, axes, starts, ends, name=None):
-    def f(a):
-        idx = [builtins.slice(None)] * a.ndim
-        for ax, s, e in zip(axes, starts, ends):
-            s = int(s.item()) if isinstance(s, Tensor) else int(s)
-            e = int(e.item()) if isinstance(e, Tensor) else int(e)
-            idx[int(ax)] = builtins.slice(s, e)
-        return a[tuple(idx)]
-    return apply(f, (x,), name="slice")
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s)
+              for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+    return apply(_slice_raw, (x,),
+                 {"axes": [int(a) for a in axes], "starts": starts,
+                  "ends": ends}, name="slice")
 
 
 def strided_slice(x, axes, starts, ends, strides, name=None):
@@ -223,14 +321,26 @@ def gather(x, index, axis=0, name=None):
     if isinstance(axis, Tensor):
         axis = int(axis.item())
 
-    def f(a, idx):
-        return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis)
-    return apply(f, (x, index), name="gather")
+    return apply(_gather_raw, (x, index), {"axis": int(axis)}, name="gather")
+
+
+def _gather_raw(a, idx, axis=0):
+    return jnp.take(a, idx.reshape(-1) if idx.ndim > 1 else idx, axis=axis)
+
+
+register_op("gather", _gather_raw)
+
+
+def _take_along_axis_raw(a, i, axis=0):
+    return jnp.take_along_axis(a, i, axis=axis)
+
+
+register_op("take_along_axis", _take_along_axis_raw)
 
 
 def take_along_axis(x, indices, axis, name=None):
-    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=axis),
-                 (x, indices), name="take_along_axis")
+    return apply(_take_along_axis_raw, (x, indices), {"axis": int(axis)},
+                 name="take_along_axis")
 
 
 def put_along_axis(x, indices, values, axis, reduce="assign", name=None):

@@ -13,12 +13,14 @@ from jax import lax
 from ..framework import state
 from ..framework.dtype import convert_dtype
 from ..framework.tensor import Tensor
-from .dispatch import apply, def_op, as_array
+from .dispatch import apply, def_op, as_array, register_op
 
 
 def _binop(fn, name):
-    def op(x, y, name=None):
-        return apply(fn, (x, y), name=name)
+    register_op(name, fn)           # serializable in the static desc
+
+    def op(x, y, name=None, _opname=name):
+        return apply(fn, (x, y), name=_opname)
     op.__name__ = name
     op.raw = fn
     return op
@@ -55,8 +57,10 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 
 
 def _unary(fn, name):
-    def op(x, name=None):
-        return apply(fn, (x,), name=name)
+    register_op(name, fn)
+
+    def op(x, name=None, _opname=name):
+        return apply(fn, (x,), name=_opname)
     op.__name__ = name
     op.raw = fn
     return op
@@ -100,10 +104,20 @@ frac = _unary(lambda a: a - jnp.trunc(a), "frac")
 sign = _unary(jnp.sign, "sign")
 
 
+def _clip_raw(a, lo=None, hi=None):
+    return jnp.clip(a, lo, hi)
+
+
+register_op("clip", _clip_raw)
+register_op("isnan", jnp.isnan)
+register_op("isinf", jnp.isinf)
+register_op("isfinite", jnp.isfinite)
+
+
 def clip(x, min=None, max=None, name=None):
     lo = min.item() if isinstance(min, Tensor) else min
     hi = max.item() if isinstance(max, Tensor) else max
-    return apply(lambda a: jnp.clip(a, lo, hi), (x,), name="clip")
+    return apply(_clip_raw, (x,), {"lo": lo, "hi": hi}, name="clip")
 
 
 def isnan(x, name=None):
@@ -126,18 +140,26 @@ def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
 # ----------------------------------------------------------------- reductions
 
 def _reduce(fn, name, int_result=False):
-    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+    def raw(a, axis=None, keepdim=False, out_dtype=None):
+        if isinstance(axis, list):
+            axis = tuple(axis)
+        out = fn(a, axis=axis, keepdims=keepdim)
+        if out_dtype is not None:
+            out = out.astype(convert_dtype(out_dtype))
+        return out
+    raw.__name__ = name
+    register_op(name, raw)
+
+    def op(x, axis=None, keepdim=False, name=None, dtype=None, _opname=name):
         if isinstance(axis, (list, tuple)):
             axis = tuple(int(a) for a in axis)
         elif axis is not None and not isinstance(axis, int):
             axis = int(axis)
-
-        def f(a):
-            out = fn(a, axis=axis, keepdims=keepdim)
-            if dtype is not None:
-                out = out.astype(convert_dtype(dtype))
-            return out
-        return apply(f, (x,), differentiable=not int_result, name=name)
+        return apply(raw, (x,),
+                     {"axis": axis, "keepdim": bool(keepdim),
+                      "out_dtype": None if dtype is None
+                      else str(np.dtype(convert_dtype(dtype)))},
+                     differentiable=not int_result, name=_opname)
     op.__name__ = name
     return op
 
@@ -224,18 +246,23 @@ def _matmul_precision():
     return {"default": None, "high": "float32", "highest": "highest"}.get(p, None)
 
 
+def _matmul_raw(a, b, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    return jnp.matmul(a, b, precision=_matmul_precision())
+
+
+register_op("matmul", _matmul_raw)
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     """MXU-path matmul. bf16 inputs hit the systolic array natively; the precision
     flag maps to lax precision for f32 tests (ref math/blas.h MatMul)."""
-
-    def f(a, b):
-        if transpose_x:
-            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
-        if transpose_y:
-            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        return jnp.matmul(a, b, precision=_matmul_precision())
-
-    return apply(f, (x, y), name="matmul")
+    return apply(_matmul_raw, (x, y),
+                 {"transpose_x": bool(transpose_x),
+                  "transpose_y": bool(transpose_y)}, name="matmul")
 
 
 mm = matmul

@@ -99,7 +99,16 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        """ref optimizer.py minimize: backward + apply. Dygraph path."""
+        """ref optimizer.py minimize. Static mode (under program_guard):
+        appends backward + update OpDescs to the program
+        (static/backward.py minimize_static); dygraph: backward + step."""
+        from ..framework import state as _state
+        rec = _state.get_static_recorder()
+        if rec is not None and rec.name_of(loss) is not None:
+            from ..static.backward import minimize_static
+            return minimize_static(self, loss, program=rec.program,
+                                   parameters=parameters,
+                                   no_grad_set=no_grad_set)
         loss.backward()
         self.step()
         return [], []

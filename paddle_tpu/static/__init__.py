@@ -9,7 +9,10 @@ Full builder lands in static/program.py (Program/Executor below import it)."""
 from .program import (Program, program_guard, default_main_program,
                       default_startup_program, data, Executor, InputSpec,
                       name_scope, global_scope, cpu_places, cuda_places,
-                      tpu_places, device_guard)
+                      tpu_places, device_guard, CompiledProgram,
+                      reset_default_programs)
+from .backward import append_backward, grad_var_name
+from . import desc
 from . import control_flow
 from .control_flow import (cond, while_loop, case, switch_case, TensorArray,
                            create_array, array_write, array_read,

@@ -1,0 +1,232 @@
+"""append_backward over the ProgramDesc (ref python/paddle/fluid/backward.py
+append_backward:1454 + framework/grad_op_desc_maker.h).
+
+One generic grad-op maker serves every forward op: the appended `grad` OpDesc
+references its forward op by index, and execution computes jax.vjp of the
+forward impl at the recorded inputs (static/desc.py _exec_grad). XLA CSEs the
+forward recompute against the forward pass in the same compiled block, so the
+cost matches purpose-built grad kernels. Accumulation where a var fans out
+into several ops appends an explicit `sum_grads` op, like the reference's
+_append_grad_suffix_ + sum_op insertion (backward.py:1132).
+"""
+import jax.numpy as jnp
+
+from ..framework.tensor import Parameter
+from . import desc as D
+
+
+def grad_var_name(name):
+    return name + "@GRAD"
+
+
+def _requires_grad_vars(desc):
+    """Forward-propagate requires-grad from trainable persistables
+    (ref backward.py _find_no_grad_vars, inverted)."""
+    req = {n for n, v in desc.vars.items()
+           if v.kind == D.PERSIST and not v.stop_gradient}
+    for op in desc.ops:
+        if not op.differentiable or op.type in D.BUILTIN_OPS:
+            continue
+        if any(n in req for n in op.inputs):
+            req.update(o for o in op.outputs if o)
+    return req
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    program=None):
+    """Append grad ops for d(loss)/d(params) to the loss's Program.
+
+    Returns [(param Tensor, grad var name)] like the reference's
+    [(param, grad var)] pairs. `loss` must be a scalar var recorded in the
+    program (built under its program_guard).
+    """
+    if program is None:
+        rec_hint = getattr(loss, "_recorder", None)
+        if rec_hint is not None:
+            program = rec_hint.program
+    if program is None:
+        from .program import default_main_program
+        program = default_main_program()
+    desc = program.desc
+    rec = program.recorder
+
+    loss_name = loss if isinstance(loss, str) else rec.name_of(loss)
+    if loss_name is None:
+        raise ValueError("append_backward: loss was not recorded in this "
+                         "program (build it under program_guard)")
+
+    req = _requires_grad_vars(desc)
+    if no_grad_set:
+        req -= set(no_grad_set)
+    if loss_name not in req:
+        raise ValueError(
+            f"loss '{loss_name}' does not depend on any trainable parameter")
+
+    # live grad var of each fwd var; fan-out appends sum_grads
+    grad_of = {}
+    g0 = grad_var_name(loss_name)
+    desc.add_var(D.VarDesc(g0, D.TMP))
+    desc.add_op(D.OpDesc("fill_ones_like", [loss_name], [g0]))
+    grad_of[loss_name] = g0
+
+    n_fwd = len(desc.ops) - 1    # index of fill_ones_like; fwd ops precede it
+    uniq = [0]
+
+    def fresh(name):
+        uniq[0] += 1
+        n = f"{grad_var_name(name)}@{uniq[0]}"
+        desc.add_var(D.VarDesc(n, D.TMP))
+        return n
+
+    def give_grad(name, new_grad):
+        cur = grad_of.get(name)
+        if cur is None:
+            grad_of[name] = new_grad
+            return
+        acc = fresh(name)
+        desc.add_op(D.OpDesc("sum_grads", [cur, new_grad], [acc]))
+        grad_of[name] = acc
+
+    for idx in range(n_fwd - 1, -1, -1):
+        op = desc.ops[idx]
+        if op.type in D.BUILTIN_OPS or not op.differentiable:
+            continue
+        has_out_grad = [bool(o and o in grad_of) for o in op.outputs]
+        if not any(has_out_grad):
+            continue
+        out_grads = [grad_of[o] for o, h in zip(op.outputs, has_out_grad) if h]
+        out_names = []
+        targets = []
+        for n in op.inputs:
+            v = desc.vars.get(n)
+            if n in req and v is not None and v.kind != D.CONST:
+                gname = fresh(n)
+                out_names.append(gname)
+                targets.append((n, gname))
+            else:
+                out_names.append("")
+        if not targets:
+            continue
+        desc.add_op(D.OpDesc(
+            "grad", list(op.inputs) + out_grads, out_names,
+            attrs={"fwd_index": idx, "n_inputs": len(op.inputs),
+                   "has_out_grad": has_out_grad}))
+        for n, gname in targets:
+            give_grad(n, gname)
+
+    # canonical @GRAD aliases for the params so fetches are predictable
+    params_grads = []
+    wanted = None
+    if parameter_list is not None:
+        wanted = {p if isinstance(p, str) else (rec.name_of(p) or p.name)
+                  for p in parameter_list}
+    for name, var in list(desc.vars.items()):
+        if var.kind != D.PERSIST or var.stop_gradient:
+            continue
+        if wanted is not None and name not in wanted:
+            continue
+        if name not in grad_of:
+            continue
+        canonical = grad_var_name(name)
+        if grad_of[name] != canonical:
+            desc.add_var(D.VarDesc(canonical, D.TMP))
+            desc.add_op(D.OpDesc("assign_var", [grad_of[name]], [canonical]))
+            grad_of[name] = canonical
+        params_grads.append((program._persist[name], canonical))
+
+    program._params_grads = params_grads
+    return params_grads
+
+
+def minimize_static(optimizer, loss, program=None, parameters=None,
+                    no_grad_set=None):
+    """Static half of Optimizer.minimize: append_backward + clip + one
+    optimizer_update op per parameter (ref optimizer.py:4452 minimize ->
+    apply_gradients -> _append_optimize_op)."""
+    from .program import default_main_program
+    program = program or default_main_program()
+    desc = program.desc
+
+    if no_grad_set is not None:
+        no_grad_set = {n if isinstance(n, str)
+                       else (program.recorder.name_of(n) or n.name)
+                       for n in no_grad_set}
+    params_grads = append_backward(loss, parameter_list=parameters,
+                                   no_grad_set=no_grad_set, program=program)
+    if not params_grads:
+        raise ValueError("minimize: no trainable parameters reached by loss")
+    grad_names = [g for _, g in params_grads]
+
+    clip = getattr(optimizer, "_grad_clip", None)
+    if clip is not None:
+        clip_norm = getattr(clip, "clip_norm", None)
+        if clip_norm is None:
+            raise NotImplementedError(
+                "static minimize supports ClipGradByGlobalNorm")
+        clipped = [g + "@CLIP" for g in grad_names]
+        for c in clipped:
+            desc.add_var(D.VarDesc(c, D.TMP))
+        desc.add_op(D.OpDesc("global_norm_clip", grad_names, clipped,
+                             attrs={"clip_norm": float(clip_norm)}))
+        grad_names = clipped
+
+    from ..framework.tensor import Tensor
+
+    # step counter (Adam bias correction): one persistable int
+    if D.STEP_VAR not in desc.vars:
+        desc.add_var(D.VarDesc(D.STEP_VAR, D.PERSIST, (), "int32"))
+        step_t = Tensor(jnp.zeros((), jnp.int32), name=D.STEP_VAR)
+        step_t.persistable = True
+        program._persist[D.STEP_VAR] = step_t
+    desc.add_op(D.OpDesc("increment", [D.STEP_VAR], [D.STEP_VAR],
+                         attrs={"step": 1}))
+
+    # learning rate as a persist var refreshed from the optimizer each
+    # Executor.run — LR schedulers keep working in static mode (ref
+    # optimizer.py _create_global_learning_rate's lr var)
+    opt_class = type(optimizer).__name__
+    lr_var = f"@LR@{opt_class}@{len(program._lr_updaters)}"
+    desc.add_var(D.VarDesc(lr_var, D.PERSIST, (), "float32"))
+    lr_t = Tensor(jnp.asarray(float(optimizer.get_lr()), jnp.float32),
+                  name=lr_var)
+    lr_t.persistable = True
+    program._persist[lr_var] = lr_t
+    program._lr_updaters[lr_var] = optimizer.get_lr
+
+    from ..regularizer import L1Decay, L2Decay
+
+    def _decay_attrs(p):
+        """(l2, l1) coefficients matching the dygraph step(): a per-param
+        regularizer overrides the optimizer-level decay (optimizer.py:83)."""
+        reg = getattr(p, "regularizer", None)
+        if reg is None:
+            reg = getattr(optimizer, "_weight_decay", None)
+        if reg is None:
+            return 0.0, 0.0
+        if isinstance(reg, L1Decay):
+            return 0.0, float(reg._coeff)
+        coeff = getattr(reg, "_coeff", None) or getattr(reg, "coeff", 0.0)
+        return float(coeff or 0.0), 0.0
+
+    hyper = [float(h) for h in optimizer._hyper()]
+    for (p, gname) in zip([p for p, _ in params_grads], grad_names):
+        pname = program.recorder.name_of(p) or p.name
+        l2, l1 = _decay_attrs(p)
+        state_names = []
+        for sn in optimizer._state_names:
+            svar = f"{pname}@{sn}"
+            if svar not in desc.vars:
+                desc.add_var(D.VarDesc(svar, D.PERSIST, p.shape, p.dtype))
+                st = Tensor(jnp.zeros(tuple(p.shape), p.dtype), name=svar)
+                st.persistable = True
+                program._persist[svar] = st
+            state_names.append(svar)
+        desc.add_op(D.OpDesc(
+            "optimizer_update",
+            [pname, gname, D.STEP_VAR, lr_var] + state_names,
+            [pname] + state_names,
+            attrs={"opt_class": opt_class, "hyper": hyper, "l2_decay": l2,
+                   "l1_decay": l1,
+                   "lr_scale": float(getattr(p, "learning_rate", 1.0))}))
+
+    return [op for op in desc.ops[-len(params_grads):]], params_grads

@@ -1,0 +1,354 @@
+"""ProgramDesc analog: a serializable op-list IR for the static-graph mode.
+
+Reference shape: paddle/fluid/framework/framework.proto:202 ProgramDesc
+(BlockDesc{VarDesc, OpDesc}) interpreted by executor.cc:414. TPU-native
+redesign: the desc is still a flat op list (one global block; control flow
+records the taken branch, like a trace), but *execution* is compilation — the
+Executor lowers the op list into one pure JAX function
+(feeds, persistables, rng) -> (fetches, new persistables) and jit-compiles it
+per feed signature (the ExecutorCache analog, ref framework/executor_cache.h).
+Autograd over the desc is `append_backward` (static/backward.py), which
+appends first-class grad OpDescs; each grad op is executed via jax.vjp of its
+forward op's impl — XLA CSEs the recomputed forward, so under jit this costs
+the same as a hand-written grad kernel chain.
+
+Serialization: JSON. An op is serializable when its impl is the registered
+raw fn for its type (ops/dispatch.py OP_REGISTRY) and its attrs are
+JSON-able; ops recorded from anonymous closures execute fine in-process but
+cannot cross a process boundary — Program.save names them so the fix (def_op
+the impl) is obvious. Builtin op types (grad/sum_grads/fill_ones_like/
+optimizer_update/increment/global_norm_clip/feed_minimize helpers) always
+serialize.
+"""
+import functools
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+FEED, PERSIST, TMP, CONST, RNG = "feed", "persist", "tmp", "const", "rng"
+
+# builtin op types executed by the interpreter itself (always serializable)
+BUILTIN_OPS = {"grad", "sum_grads", "fill_ones_like", "optimizer_update",
+               "increment", "global_norm_clip", "assign_var"}
+
+RNG_VAR = "@RNG@"
+STEP_VAR = "@STEP@"
+
+_CONST_MAX_ELEMS = 10_000_000
+
+
+class VarDesc:
+    __slots__ = ("name", "kind", "shape", "dtype", "stop_gradient", "value")
+
+    def __init__(self, name, kind, shape=None, dtype=None, stop_gradient=True,
+                 value=None):
+        self.name = name
+        self.kind = kind
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = str(dtype) if dtype is not None else None
+        self.stop_gradient = bool(stop_gradient)
+        self.value = value          # const only: np.ndarray snapshot
+
+    @property
+    def persistable(self):
+        return self.kind == PERSIST
+
+    def to_dict(self):
+        d = {"name": self.name, "kind": self.kind,
+             "shape": list(self.shape) if self.shape is not None else None,
+             "dtype": self.dtype, "stop_gradient": self.stop_gradient}
+        if self.kind == CONST:
+            v = np.asarray(self.value)
+            d["value"] = v.tolist()
+            d["dtype"] = str(v.dtype)
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        value = None
+        if d["kind"] == CONST:
+            value = np.asarray(d["value"], dtype=d["dtype"])
+        return cls(d["name"], d["kind"], d["shape"], d["dtype"],
+                   d["stop_gradient"], value)
+
+    def __repr__(self):
+        return f"VarDesc({self.name!r}, {self.kind}, {self.shape}, {self.dtype})"
+
+
+class OpDesc:
+    __slots__ = ("type", "inputs", "outputs", "attrs", "differentiable",
+                 "_fn", "_raw")
+
+    def __init__(self, type, inputs, outputs, attrs=None, differentiable=True,
+                 _fn=None, _raw=None):
+        self.type = type
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.attrs = dict(attrs or {})
+        self.differentiable = bool(differentiable)
+        self._fn = _fn       # bound callable arrays -> out(s); in-memory only
+        self._raw = _raw     # unbound impl for serializability check
+
+    def serializable(self):
+        if self.type in BUILTIN_OPS:
+            return _json_ok(self.attrs)
+        from ..ops.dispatch import OP_REGISTRY
+        reg = OP_REGISTRY.get(self.type)
+        return (reg is not None and (self._raw is None or self._raw is reg)
+                and _json_ok(self.attrs))
+
+    def to_dict(self):
+        return {"type": self.type, "inputs": self.inputs,
+                "outputs": self.outputs, "attrs": _json_attrs(self.attrs),
+                "differentiable": self.differentiable}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["type"], d["inputs"], d["outputs"], d["attrs"],
+                   d["differentiable"])
+
+    def __repr__(self):
+        return (f"OpDesc({self.type}: {self.inputs} -> {self.outputs}"
+                f"{' ' + repr(self.attrs) if self.attrs else ''})")
+
+
+def _json_ok(obj):
+    try:
+        json.dumps(_json_attrs(obj) if isinstance(obj, dict) else obj)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def _json_attrs(attrs):
+    """Attrs sanitizer: tuples -> lists, dtypes -> str, numpy scalars -> py."""
+    def conv(v):
+        if isinstance(v, (list, tuple)):
+            return [conv(x) for x in v]
+        if isinstance(v, dict):
+            return {k: conv(x) for k, x in v.items()}
+        if isinstance(v, np.generic):
+            return v.item()
+        if isinstance(v, (np.dtype, jnp.dtype)) or (
+                isinstance(v, type) and issubclass(v, np.generic)):
+            return str(np.dtype(v))
+        return v
+    return {k: conv(v) for k, v in attrs.items()}
+
+
+class ProgramDesc:
+    """One global block: ordered vars + ops (framework.proto BlockDesc)."""
+
+    def __init__(self):
+        self.vars = {}              # name -> VarDesc
+        self.ops = []               # [OpDesc]
+        self.version = 0
+
+    def add_var(self, var):
+        self.vars[var.name] = var
+        self.version += 1
+        return var
+
+    def add_op(self, op):
+        self.ops.append(op)
+        self.version += 1
+        return op
+
+    def var_names(self, kind):
+        return [n for n, v in self.vars.items() if v.kind == kind]
+
+    def unserializable_ops(self):
+        return [op for op in self.ops if not op.serializable()]
+
+    # ---------------------------------------------------------------- (de)ser
+    def to_json(self):
+        bad = self.unserializable_ops()
+        if bad:
+            kinds = sorted({op.type for op in bad})
+            raise ValueError(
+                f"Program contains {len(bad)} op(s) not registered for "
+                f"serialization: {kinds}. Register their impls with "
+                f"ops.dispatch.def_op (attrs must be JSON-able) to make the "
+                f"desc portable; in-process execution is unaffected.")
+        return json.dumps({
+            "version": 1,
+            "vars": [v.to_dict() for v in self.vars.values()],
+            "ops": [op.to_dict() for op in self.ops],
+        })
+
+    @classmethod
+    def from_json(cls, s):
+        d = json.loads(s)
+        desc = cls()
+        for vd in d["vars"]:
+            desc.add_var(VarDesc.from_dict(vd))
+        for od in d["ops"]:
+            desc.add_op(OpDesc.from_dict(od))
+        return desc
+
+    def clone(self):
+        """Structural deep copy (impl handles shared: _fn refs are kept)."""
+        new = ProgramDesc()
+        for v in self.vars.values():
+            new.add_var(VarDesc(v.name, v.kind, v.shape, v.dtype,
+                                v.stop_gradient, v.value))
+        for op in self.ops:
+            new.add_op(OpDesc(op.type, op.inputs, op.outputs, op.attrs,
+                              op.differentiable, op._fn, op._raw))
+        return new
+
+    def __repr__(self):
+        kinds = {}
+        for v in self.vars.values():
+            kinds[v.kind] = kinds.get(v.kind, 0) + 1
+        return f"ProgramDesc(ops={len(self.ops)}, vars={kinds})"
+
+
+# --------------------------------------------------------------- op resolve
+
+def resolve_impl(op):
+    """Bound callable arrays -> out(s) for a forward op."""
+    if op._fn is not None:
+        return op._fn
+    from ..ops.dispatch import OP_REGISTRY
+    raw = OP_REGISTRY.get(op.type)
+    if raw is None:
+        raise KeyError(
+            f"op '{op.type}' has no registered impl (OP_REGISTRY) and no "
+            f"in-memory closure — was this desc loaded in a fresh process "
+            f"before importing the module that defines the op?")
+    attrs = {k: v for k, v in op.attrs.items() if not k.startswith("__")}
+    if attrs:
+        return functools.partial(raw, **attrs)
+    return raw
+
+
+# -------------------------------------------------------------- interpreter
+
+def _exec_grad(desc, op, env):
+    """Generic grad op: jax.vjp of the forward op's impl at its recorded
+    inputs (ref framework/grad_op_desc_maker.h — here one maker serves every
+    op because JAX owns the VJPs; XLA CSEs the forward recompute)."""
+    a = op.attrs
+    fwd = desc.ops[a["fwd_index"]]
+    f = resolve_impl(fwd)
+    n_in = a["n_inputs"]
+    primals = [env[n] for n in op.inputs[:n_in]]
+    salt = fwd.attrs.get("__rng__")
+    if salt:
+        # same folded key as the forward replay: grad sees the same mask
+        primals[1] = jax.random.fold_in(env[RNG_VAR], salt)
+    grads_in = [env[n] for n in op.inputs[n_in:]]
+    outs, vjp = jax.vjp(lambda *xs: f(*xs), *primals)
+    multi = isinstance(outs, (tuple, list))
+    outs_t = tuple(outs) if multi else (outs,)
+    mask = a["has_out_grad"]
+    cots, gi = [], 0
+    for j, o in enumerate(outs_t):
+        if mask[j]:
+            cots.append(grads_in[gi].astype(o.dtype))
+            gi += 1
+        else:
+            cots.append(jnp.zeros_like(o))
+    in_grads = vjp(tuple(cots) if multi else cots[0])
+    for name, g in zip(op.outputs, in_grads):
+        if name:
+            env[name] = g
+
+
+def _exec_optimizer_update(op, env):
+    """Generic parameter update: the optimizer's pure _update rule as one op
+    (ref paddle/fluid/operators/optimizers/sgd_op.cc etc.)."""
+    from .. import optimizer as popt
+    a = op.attrs
+    cls = getattr(popt, a["opt_class"])
+    p = env[op.inputs[0]]
+    g = env[op.inputs[1]].astype(p.dtype)
+    step = env[op.inputs[2]]
+    lr = env[op.inputs[3]] * a.get("lr_scale", 1.0)
+    states = tuple(env[n] for n in op.inputs[4:])
+    l2 = a.get("l2_decay", 0.0)
+    if l2:
+        g = g + jnp.asarray(l2, p.dtype) * p
+    l1 = a.get("l1_decay", 0.0)
+    if l1:
+        g = g + jnp.asarray(l1, p.dtype) * jnp.sign(p)
+    new_p, new_states = cls._update(p, g, lr, tuple(a["hyper"]), states, step)
+    env[op.outputs[0]] = new_p
+    for n, s in zip(op.outputs[1:], new_states):
+        env[n] = s
+
+
+def _exec_builtin(desc, op, env):
+    t = op.type
+    if t == "grad":
+        _exec_grad(desc, op, env)
+    elif t == "sum_grads":
+        acc = env[op.inputs[0]]
+        for n in op.inputs[1:]:
+            acc = acc + env[n]
+        env[op.outputs[0]] = acc
+    elif t == "fill_ones_like":
+        env[op.outputs[0]] = jnp.ones_like(env[op.inputs[0]])
+    elif t == "optimizer_update":
+        _exec_optimizer_update(op, env)
+    elif t == "increment":
+        env[op.outputs[0]] = env[op.inputs[0]] + op.attrs.get("step", 1)
+    elif t == "global_norm_clip":
+        gs = [env[n] for n in op.inputs]
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in gs)
+        norm = jnp.sqrt(sq)
+        clip = jnp.asarray(op.attrs["clip_norm"], jnp.float32)
+        scale = clip / jnp.maximum(norm, clip)
+        for n, g in zip(op.outputs, gs):
+            env[n] = (g.astype(jnp.float32) * scale).astype(g.dtype)
+    elif t == "assign_var":
+        env[op.outputs[0]] = env[op.inputs[0]]
+    else:
+        raise KeyError(f"unknown builtin op {t}")
+
+
+def run_desc(desc, env):
+    """Interpret the op list over env (name -> array). Mutates env."""
+    for op in desc.ops:
+        if op.type in BUILTIN_OPS:
+            _exec_builtin(desc, op, env)
+            continue
+        f = resolve_impl(op)
+        args = [env[n] for n in op.inputs]
+        salt = op.attrs.get("__rng__")
+        if salt:
+            # rng-consuming op (dropout): its recorded key input (position 1
+            # by convention) is replaced with fold_in(run key, op salt) so
+            # every Executor.run draws fresh randomness
+            args[1] = jax.random.fold_in(env[RNG_VAR], salt)
+        out = f(*args)
+        if isinstance(out, (tuple, list)):
+            for name, o in zip(op.outputs, out):
+                if name:
+                    env[name] = o
+        else:
+            env[op.outputs[0]] = out
+
+
+def build_runner(desc, fetch_names, persist_names):
+    """Lower the desc to a pure function for jit:
+    (feeds: dict, persist: dict, rng_key) -> (fetch vals, new persist)."""
+    consts = {n: jnp.asarray(v.value)
+              for n, v in desc.vars.items() if v.kind == CONST}
+    persist_names = tuple(persist_names)
+    fetch_names = tuple(fetch_names)
+
+    def runner(feeds, persist, rng_key):
+        env = dict(consts)
+        env.update(persist)
+        env.update(feeds)
+        env[RNG_VAR] = rng_key
+        run_desc(desc, env)
+        return ([env[n] for n in fetch_names],
+                {n: env[n] for n in persist_names})
+
+    return runner

@@ -1,15 +1,21 @@
-"""Static graph: Program / Executor / feed-fetch
+"""Static graph: Program / Executor / feed-fetch over a real op-list IR
 (ref python/paddle/fluid/framework.py:4160 Program, executor.py:475 Executor,
-framework.proto ProgramDesc).
+framework.proto:202 ProgramDesc).
 
-Redesign rationale (SURVEY.md §7): the reference interprets an OpDesc list per
-step (executor.cc:414). Here a Program records python thunks symbolically the
-first time it runs and compiles the whole (feed -> fetch) dataflow with
-jax.jit — the "executor" is compile-and-run of the block, with an executable
-cache keyed by feed shapes/dtypes (the ExecutorCache analog,
-ref framework/executor_cache.h).
+Design (SURVEY.md §7 redesign): static mode is *define-by-run capture* — ops
+execute eagerly (so user code sees shapes/values) while every dispatch is
+also recorded as an OpDesc into the Program's desc (static/desc.py). The
+Executor then ignores the eager values and compiles the desc into ONE pure
+XLA function per feed signature (the ExecutorCache analog,
+ref framework/executor_cache.h), with persistables (params, opt state,
+RNG-independent buffers) threaded through and donated. `append_backward` /
+`Optimizer.minimize` append first-class grad + update OpDescs
+(static/backward.py), so a whole SGD training loop runs as compiled desc
+replays that mutate the scope — the reference's Program/Executor contract,
+without the per-op C++ interpreter.
 """
-import functools
+import contextlib
+import itertools
 
 import numpy as np
 import jax
@@ -18,6 +24,7 @@ import jax.numpy as jnp
 from ..framework import state
 from ..framework.tensor import Tensor, Parameter
 from ..framework.dtype import convert_dtype
+from . import desc as D
 
 
 class InputSpec:
@@ -33,54 +40,281 @@ class InputSpec:
 
 
 class _FeedVar(Tensor):
-    """Placeholder variable: carries spec; gets bound at run time."""
+    """Placeholder variable: carries spec; holds a zeros example eagerly so
+    recording sees concrete shapes (None dims -> 1)."""
 
     def __init__(self, name, shape, dtype):
-        shape_concrete = tuple(1 if (s is None or s < 0) else int(s)
-                               for s in shape)
+        shape_concrete = tuple(1 if (s is None or (isinstance(s, int) and s < 0))
+                               else int(s) for s in shape)
         super().__init__(jnp.zeros(shape_concrete, convert_dtype(dtype)))
         self.name = name
         self.spec_shape = tuple(shape)
         self.is_feed = True
 
 
+class StaticRecorder:
+    """Routes every ops/dispatch.apply call into the Program's desc.
+    Assigns var names, snapshots constants, registers persistables
+    (ref imperative/tracer.cc TraceOp's OpDesc-building static half).
+
+    Var names live as attributes ON the recorded Tensors (`_desc_name` +
+    `_desc_rec`), not in an id-keyed table — no strong refs are kept, so
+    capture-time activations are freed normally and id reuse cannot alias."""
+
+    def __init__(self, program):
+        self.program = program
+        self._n_tmp = 0
+        self._n_rng = 0
+
+    # ------------------------------------------------------------- var names
+    def _new_name(self, prefix="tmp"):
+        self._n_tmp += 1
+        return f"{prefix}_{self._n_tmp}"
+
+    def name_of(self, t):
+        """Existing var name of a recorded Tensor in this recorder, or None."""
+        d = getattr(t, "__dict__", None)
+        if d is not None and d.get("_desc_rec") is self.program._ns:
+            return d.get("_desc_name")
+        return None
+
+    def _bind(self, t, name):
+        t._desc_name = name
+        # the name-space token is shared by clones, so fetch targets recorded
+        # in the original resolve in a for_test clone too
+        t._desc_rec = self.program._ns
+        return name
+
+    def _register_input(self, t):
+        """Var name for an op input, creating feed/persist/const vars."""
+        desc = self.program.desc
+        if isinstance(t, Tensor):
+            known = self.name_of(t)
+            if known is not None:
+                return known
+            if getattr(t, "is_feed", False):
+                name = t.name
+                if name not in desc.vars:
+                    desc.add_var(D.VarDesc(
+                        name, D.FEED, t.spec_shape, t.dtype,
+                        stop_gradient=t.stop_gradient))
+                return self._bind(t, name)
+            if isinstance(t, Parameter) or t.persistable or not t.stop_gradient:
+                name = t.name or self._new_name("param")
+                if name in desc.vars and self.program._persist.get(name) is not t:
+                    name = self._new_name(name)
+                t.name = t.name or name
+                desc.add_var(D.VarDesc(name, D.PERSIST, t.shape,
+                                       t.dtype, stop_gradient=t.stop_gradient))
+                self.program._persist[name] = t
+                return self._bind(t, name)
+            # plain eager tensor from outside the program: freeze as const
+            return self._const(t._data, ref=t)
+        # non-Tensor input (python scalar / numpy / jax array)
+        return self._const(t)
+
+    def _const(self, value, ref=None):
+        arr = value if isinstance(value, (jax.Array, np.ndarray)) \
+            else np.asarray(value)
+        if hasattr(arr, "dtype") and arr.dtype == np.float64:
+            arr = np.asarray(arr, np.float32)
+        if arr.size > D._CONST_MAX_ELEMS:
+            raise ValueError(
+                f"static recording: refusing to snapshot a {arr.shape} "
+                f"constant; feed it or make it a persistable parameter")
+        name = self._new_name("const")
+        self.program.desc.add_var(
+            D.VarDesc(name, D.CONST, arr.shape, arr.dtype, value=np.asarray(arr)))
+        if ref is not None:
+            self._bind(ref, name)
+        return name
+
+    def _register_output(self, t, name=None):
+        name = name or self._new_name()
+        self.program.desc.add_var(
+            D.VarDesc(name, D.TMP, t.shape, t.dtype,
+                      stop_gradient=t.stop_gradient))
+        self._bind(t, name)
+        t._recorder = self          # lets append_backward find the program
+        return name
+
+    # -------------------------------------------------------------- recording
+    def record_op(self, name, raw_fn, bound_fn, tensors, attrs, wrapped,
+                  multi, differentiable):
+        in_names = [self._register_input(t) for t in tensors]
+        outs = wrapped if multi else (wrapped,)
+        out_names = [self._register_output(o) for o in outs]
+        if attrs.get("__rng__"):
+            # rng-consuming op: assign its per-program salt here so the
+            # Executor re-derives the key input each run (desc.py run_desc)
+            attrs = dict(attrs, __rng__=self.rng_input())
+        self.program.desc.add_op(D.OpDesc(
+            name, in_names, out_names, attrs,
+            differentiable=differentiable, _fn=bound_fn, _raw=raw_fn))
+
+    def alias_output(self, out_tensor, persist_tensor):
+        """Rebind the op output that produced `out_tensor` to write the
+        persistable var of `persist_tensor` (BN running-stats update). If the
+        target was captured as a const earlier, it is upgraded to persist —
+        a mutated var is state, not a constant."""
+        desc = self.program.desc
+        pname = self._register_input(persist_tensor)
+        var = desc.vars.get(pname)
+        if var is not None and var.kind == D.CONST:
+            newname = persist_tensor.name or self._new_name("buf")
+            if newname in desc.vars:
+                newname = self._new_name(newname)
+            persist_tensor.name = persist_tensor.name or newname
+            persist_tensor.persistable = True
+            desc.add_var(D.VarDesc(newname, D.PERSIST, var.shape, var.dtype))
+            for op in desc.ops:
+                op.inputs = [newname if n == pname else n for n in op.inputs]
+            del desc.vars[pname]
+            self.program._persist[newname] = persist_tensor
+            self._bind(persist_tensor, newname)
+            pname = newname
+        oname = self.name_of(out_tensor)
+        for op in reversed(desc.ops):
+            if oname in op.outputs:
+                op.outputs[op.outputs.index(oname)] = pname
+                self._bind(out_tensor, pname)
+                return
+        raise ValueError("alias_output: producing op not found")
+
+    def rng_input(self):
+        """Salt for an rng-consuming op (dropout): ops get fresh randomness
+        per Executor run via fold_in(run_key, salt)."""
+        self._n_rng += 1
+        return self._n_rng
+
+
 class Program:
-    """A recorded computation: list of (fn, inputs, outputs) thunks built by
-    layer calls under program_guard; compiled on first Executor.run."""
+    """A recorded computation over a serializable desc."""
+
+    _uid_counter = itertools.count()
 
     def __init__(self):
-        self.feeds = {}          # name -> _FeedVar
-        self.fetch_vars = []
-        self._builders = []      # callables replayed at trace time
+        self.desc = D.ProgramDesc()
+        self.feeds = {}            # name -> _FeedVar
+        self._persist = {}         # name -> live Tensor (scope view)
+        self._uid = next(Program._uid_counter)   # id() is reusable; this isn't
+        self._ns = object()        # name-space token shared with clones
+        self.recorder = StaticRecorder(self)
         self.random_seed = 0
-        self._trace_fn = None
+        self._for_test = False
+        self._params_grads = []    # set by minimize/append_backward
+        self._lr_updaters = {}     # lr var name -> callable() -> float
+        self._fetch_alias = {}     # for_test clones: pruned-out -> source var
 
+    # ------------------------------------------------------------- lifecycle
     def clone(self, for_test=False):
-        return self
+        """Real clone: copies the desc. for_test=True prunes backward +
+        optimizer ops, strips dropout and freezes batch-norm stats (ref
+        framework.py Program.clone:4891 — there it prunes with is_test attr;
+        here the op set is rewritten)."""
+        new = Program.__new__(Program)
+        new.desc = self.desc.clone()
+        new.feeds = dict(self.feeds)
+        new._persist = dict(self._persist)
+        new._uid = next(Program._uid_counter)
+        new._ns = self._ns                # fetch targets resolve in the clone
+        new._fetch_alias = {}
+        new.recorder = StaticRecorder(new)
+        new.recorder._n_tmp = self.recorder._n_tmp
+        new.recorder._n_rng = self.recorder._n_rng
+        new.random_seed = self.random_seed
+        new._params_grads = list(self._params_grads)
+        new._lr_updaters = dict(self._lr_updaters)
+        new._for_test = for_test
+        if for_test:
+            new._fetch_alias = _rewrite_for_test(new.desc)
+        return new
 
     def global_block(self):
         return self
 
-    # Block-surface compat
     @property
     def blocks(self):
         return [self]
 
-    def all_parameters(self):
-        seen, out = set(), []
-        for b in self._builders:
-            for p in getattr(b, "_params", []):
-                if id(p) not in seen:
-                    seen.add(id(p))
-                    out.append(p)
-        return out
+    @property
+    def ops(self):
+        return self.desc.ops
 
-    def record(self, builder):
-        self._builders.append(builder)
+    def all_parameters(self):
+        return [t for t in self._persist.values()
+                if isinstance(t, Parameter) or t.trainable]
+
+    # ---------------------------------------------------------------- ser/de
+    def serialize_to_string(self):
+        return self.desc.to_json()
+
+    def save(self, path):
+        """Desc JSON + persistable values (params/buffers/opt state) so a
+        fresh process can resume (ref io.py save_persistables +
+        framework.py Program.parse_from_string)."""
+        with open(path + ".json", "w") as f:
+            f.write(self.desc.to_json())
+        arrays = {n: np.asarray(t._data) for n, t in self._persist.items()}
+        np.savez(path + ".pdparams.npz", **arrays)
+
+    @classmethod
+    def load(cls, path):
+        with open(path + ".json") as f:
+            prog = cls.parse_from_string(f.read())
+        data = np.load(path + ".pdparams.npz")
+        for n in data.files:
+            if n in prog._persist:
+                prog._persist[n]._data = jnp.asarray(data[n])
+        return prog
+
+    @classmethod
+    def parse_from_string(cls, s):
+        prog = cls()
+        prog.desc = D.ProgramDesc.from_json(s)
+        for v in prog.desc.vars.values():
+            if v.kind == D.FEED:
+                fv = _FeedVar(v.name, v.shape, v.dtype or "float32")
+                prog.feeds[v.name] = fv
+                prog.recorder._bind(fv, v.name)
+            elif v.kind == D.PERSIST:
+                t = Parameter(jnp.zeros(v.shape or (), convert_dtype(v.dtype)),
+                              name=v.name) if not v.stop_gradient else \
+                    Tensor(jnp.zeros(v.shape or (), convert_dtype(v.dtype)),
+                           name=v.name)
+                t.persistable = True
+                prog._persist[v.name] = t
+                prog.recorder._bind(t, v.name)
+        return prog
 
     def __repr__(self):
-        return (f"Program(feeds={list(self.feeds)}, "
-                f"builders={len(self._builders)})")
+        return f"Program({self.desc!r})"
+
+
+def _rewrite_for_test(desc):
+    """Inference rewrite: prune backward/optimizer ops (a test program is
+    forward-only — matching the reference's clone-for-test pruning), drop
+    dropout ops (rewire out -> in), force eval-mode attrs. Grad ops hold
+    `fwd_index` references that op removal would invalidate, which pruning
+    them sidesteps entirely. Returns the out->in alias map so fetches of a
+    removed op's output resolve to its input."""
+    alias = {}
+    kept = []
+    for op in desc.ops:
+        if op.type in D.BUILTIN_OPS:       # grad/sum/optimizer/step machinery
+            continue
+        if op.type in ("dropout", "alpha_dropout"):
+            src = op.inputs[0]
+            alias[op.outputs[0]] = alias.get(src, src)
+            del desc.vars[op.outputs[0]]   # no producer anymore
+            continue
+        op.inputs = [alias.get(n, n) for n in op.inputs]
+        if op.type == "batch_norm" and "training" in op.attrs:
+            op.attrs = dict(op.attrs, training=False)
+            op._fn = None      # re-resolve from registry with new attrs
+        kept.append(op)
+    desc.ops[:] = kept
+    return alias
 
 
 _main_program = Program()
@@ -96,17 +330,29 @@ def default_startup_program():
     return _prog_stack[-1][1] if _prog_stack else _startup_program
 
 
+def reset_default_programs():
+    global _main_program, _startup_program
+    _main_program = Program()
+    _startup_program = Program()
+
+
 class program_guard:
+    """Entering activates desc recording for every eager op dispatch."""
+
     def __init__(self, main_program, startup_program=None):
         self.main = main_program
         self.startup = startup_program or Program()
+        self._tok = None
 
     def __enter__(self):
         _prog_stack.append((self.main, self.startup))
+        self._ctx = state.static_recorder_ctx(self.main.recorder)
+        self._ctx.__enter__()
         return self.main
 
     def __exit__(self, *exc):
         _prog_stack.pop()
+        self._ctx.__exit__(*exc)
         return False
 
 
@@ -115,19 +361,18 @@ def data(name, shape, dtype="float32", lod_level=0):
     prog = default_main_program()
     var = _FeedVar(name, shape, dtype)
     prog.feeds[name] = var
+    prog.desc.add_var(D.VarDesc(name, D.FEED, var.spec_shape, var.dtype))
+    prog.recorder._bind(var, name)
     return var
 
 
 def name_scope(prefix=None):
-    import contextlib
     return contextlib.nullcontext()
 
 
 def device_guard(device=None):
     """ref fluid/framework.py device_guard — pipeline stage placement hint.
     Consumed by distributed/pipeline.py; records the current stage id."""
-    import contextlib
-
     @contextlib.contextmanager
     def _ctx():
         from ..distributed import pipeline as pp
@@ -142,6 +387,9 @@ def device_guard(device=None):
 
 
 class _Scope:
+    """Name -> live Tensor view over every Program's persistables
+    (ref framework/scope.h — flat here: one global block)."""
+
     def __init__(self):
         self.vars = {}
 
@@ -149,7 +397,12 @@ class _Scope:
         return self.vars.setdefault(name, Tensor(jnp.zeros([])))
 
     def find_var(self, name):
-        return self.vars.get(name)
+        if name in self.vars:
+            return self.vars[name]
+        for prog in ([p for p, _ in _prog_stack] + [_main_program]):
+            if name in prog._persist:
+                return prog._persist[name]
+        return None
 
 
 _global_scope = _Scope()
@@ -173,55 +426,118 @@ tpu_places = cuda_places
 
 
 class Executor:
-    """ref fluid/executor.py:475. run(program, feed, fetch_list) with an
-    executable cache keyed on feed signature."""
+    """ref fluid/executor.py:475. Compiles the Program's desc per feed
+    signature and runs it; persistable updates flow back into the live
+    Parameter objects (the scope), so repeated run() calls train."""
 
     def __init__(self, place=None):
         self.place = place
-        self._cache = {}
+        self._cache = {}            # (prog uid, desc ver, sig) -> jitted
+        self._run_count = 0
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True):
-        program = program or default_main_program()
+        program_obj = program
+        if isinstance(program_obj, CompiledProgram):
+            program = program_obj.program
+        else:
+            program = program_obj or default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
-        if getattr(program, "_run_callable", None) is not None:
-            outs = program._run_callable(feed, fetch_list)
-        else:
-            outs = self._run_traced(program, feed, fetch_list)
-        if return_numpy:
-            return [np.asarray(o._data if isinstance(o, Tensor) else o)
-                    for o in outs]
-        return outs
 
-    def _run_traced(self, program, feed, fetch_list):
-        # bind feeds then replay builders eagerly (interpreter mode — the
-        # compiled path is jit.TrainStep / CompiledProgram)
+        fetch_names = [self._fetch_name(program, f) for f in fetch_list]
+        feed_arrays = {}
         for name, value in feed.items():
-            if name in program.feeds:
-                var = program.feeds[name]
-                arr = value.numpy() if isinstance(value, Tensor) \
-                    else np.asarray(value)
-                var._data = jnp.asarray(arr)
-        with state.no_grad_ctx():
-            for b in program._builders:
-                b()
-        return list(fetch_list)
+            if name not in program.feeds and name not in program.desc.vars:
+                raise KeyError(f"feed '{name}' is not a declared input "
+                               f"(have {list(program.feeds)})")
+            arr = value._data if isinstance(value, Tensor) \
+                else jnp.asarray(np.asarray(value))
+            feed_arrays[name] = arr
+
+        persist_names = tuple(sorted(program._persist))
+        sig = (tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                            for n, a in feed_arrays.items())),
+               tuple(fetch_names), persist_names)
+        key = (program._uid, program.desc.version)
+        mesh = getattr(program_obj, "_dp_mesh", None) \
+            if isinstance(program_obj, CompiledProgram) else None
+        cached = self._cache.get(key + (sig, mesh is not None))
+        if cached is None or not use_program_cache:
+            runner = D.build_runner(program.desc, fetch_names, persist_names)
+            if mesh is not None:
+                # CompiledProgram.with_data_parallel: feed batch dim sharded
+                # over the device mesh, persistables replicated (GSPMD
+                # inserts the grad allreduce — ref compiler.py:164
+                # ParallelExecutor's reduce-mode graph)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                feed_shard = {
+                    n: NamedSharding(mesh, P("dp", *([None] * (a.ndim - 1))))
+                    if a.ndim >= 1 and a.shape[0] % mesh.size == 0
+                    else NamedSharding(mesh, P())
+                    for n, a in feed_arrays.items()}
+                rep = NamedSharding(mesh, P())
+                persist_shard = {n: rep for n in persist_names}
+                cached = jax.jit(
+                    runner, donate_argnums=(1,),
+                    in_shardings=(feed_shard, persist_shard, rep))
+            else:
+                cached = jax.jit(runner, donate_argnums=(1,))
+            self._cache[key + (sig, mesh is not None)] = cached
+
+        # refresh scheduler-driven vars (lr) from their live sources
+        for vname, getter in getattr(program, "_lr_updaters", {}).items():
+            program._persist[vname]._data = jnp.asarray(float(getter()),
+                                                        jnp.float32)
+        persist = {n: program._persist[n]._data for n in persist_names}
+        self._run_count += 1
+        rng = jax.random.fold_in(jax.random.PRNGKey(program.random_seed),
+                                 self._run_count)
+
+        fetches, new_persist = cached(feed_arrays, persist, rng)
+
+        for n in persist_names:
+            program._persist[n]._data = new_persist[n]
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    @staticmethod
+    def _fetch_name(program, f):
+        alias = getattr(program, "_fetch_alias", None) or {}
+        if isinstance(f, str):
+            name = alias.get(f, f)
+            if name not in program.desc.vars:
+                raise KeyError(f"fetch var '{f}' not in program")
+            return name
+        name = program.recorder.name_of(f)
+        if name is None:
+            raise ValueError(
+                "fetch target was not recorded in this program — build it "
+                "under program_guard(program)")
+        return alias.get(name, name)
 
     def close(self):
         pass
 
 
 class CompiledProgram:
-    """ref fluid/compiler.py:88 — on TPU, compilation is the default; kept for
-    API compat. with_data_parallel marks dp sharding intent."""
+    """ref fluid/compiler.py:88. with_data_parallel shards the feed batch
+    over the local devices via GSPMD when >1 device is visible; on one chip
+    compilation is already the default so it is the identity."""
 
     def __init__(self, program_or_graph, build_strategy=None):
         self.program = program_or_graph
         self._is_data_parallel = False
+        self._dp_mesh = None
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
                            places=None):
         self._is_data_parallel = True
+        ndev = len(jax.devices())
+        if ndev > 1:
+            from jax.sharding import Mesh
+            self._dp_mesh = Mesh(np.array(jax.devices()), ("dp",))
         return self

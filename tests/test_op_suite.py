@@ -53,7 +53,7 @@ class TestLayerNormOp(OpTest):
         var = x.var(-1, keepdims=True)
         want = (x - mu) / np.sqrt(var + 1e-5) * g + b
         self.inputs = {"X": x, "weight": g, "bias": b}
-        self.attrs = {"normalized_shape": 6}
+        self.attrs = {"nd": 1}          # registry raw signature
         self.outputs = {"Out": want}
 
     def test(self):
