@@ -61,13 +61,20 @@ def init_parallel_env():
     if _initialized:
         return ParallelEnv()
     env = ParallelEnv()
-    if env.world_size > 1 and env.trainer_endpoints:
+    # the launcher's contract (launch.py _rank_env): a dedicated coordinator
+    # address + per-rank process id for the jax coordination service — the
+    # TCP bootstrap analog of gen_comm_id_helper.cc:284
+    coordinator = os.environ.get("COORDINATOR_ADDRESS")
+    num_procs = int(os.environ.get("NUM_PROCESSES", env.world_size))
+    proc_id = int(os.environ.get("PROCESS_ID", env.rank))
+    if coordinator is None and env.world_size > 1 and env.trainer_endpoints:
         coordinator = env.trainer_endpoints[0]
+    if coordinator is not None and num_procs > 1:
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator,
-                num_processes=env.world_size,
-                process_id=env.rank)
+                num_processes=num_procs,
+                process_id=proc_id)
         except (RuntimeError, ValueError):
             pass  # already initialized or single-process testing
     from .mesh import default_mesh

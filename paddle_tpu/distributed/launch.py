@@ -40,19 +40,30 @@ class Pod:
         self.coordinator = coordinator
 
 
-def get_cluster(nproc, start_port=36777, ips="127.0.0.1"):
+def get_cluster(nproc_per_node, start_port=36777, ips="127.0.0.1",
+                nnodes=None):
+    """nproc_per_node ranks on EACH host in --ips (total = per_node x
+    hosts — the reference's launch contract). `nnodes` must match the host
+    count when both are given; with a single host entry it replicates it
+    (localhost multi-node simulation: --nnodes 2 gives two 'nodes' on
+    127.0.0.1 with distinct port ranges, the way the reference simulates
+    clusters multiprocess-on-localhost)."""
     hosts = [h for h in ips.split(",") if h]
-    if nproc % len(hosts) != 0:
-        raise ValueError(
-            f"--nproc_per_node total {nproc} must divide evenly over "
-            f"{len(hosts)} hosts ({ips}); {nproc % len(hosts)} ranks "
-            f"would be dropped")
-    per_host = nproc // len(hosts)
+    if nnodes and nnodes != len(hosts):
+        if len(hosts) != 1:
+            raise ValueError(
+                f"--nnodes {nnodes} does not match --ips ({ips}, "
+                f"{len(hosts)} hosts): give one ip (replicated) or exactly "
+                f"nnodes ips")
+        hosts = hosts * nnodes
+    per_host = nproc_per_node
     trainers = []
     for hi, host in enumerate(hosts):
         for i in range(per_host):
             rank = hi * per_host + i
-            trainers.append(Trainer(rank, f"{host}:{start_port + i}", [i]))
+            # per-node port ranges so simulated nodes on one ip don't clash
+            trainers.append(Trainer(
+                rank, f"{host}:{start_port + hi * per_host + i}", [i]))
     return Pod(trainers, f"{hosts[0]}:{start_port - 1}")
 
 
@@ -163,6 +174,10 @@ def main(argv=None):
         "paddle_tpu.distributed.launch",
         description="launch a distributed job: one process per device/rank")
     parser.add_argument("--nproc_per_node", type=int, default=None)
+    parser.add_argument("--nnodes", type=int, default=1,
+                        help="number of nodes; with a single --ips entry the "
+                             "nodes are simulated on localhost (multi-host "
+                             "smoke testing, ref launch.py --nnodes)")
     parser.add_argument("--ips", type=str, default="127.0.0.1",
                         help="comma-split host ips (ref launch.py --ips)")
     parser.add_argument("--start_port", type=int, default=36777)
@@ -184,9 +199,10 @@ def main(argv=None):
     if args.server_num:
         return _launch_ps(args, nproc)
 
-    pod = get_cluster(nproc, args.start_port, args.ips)
+    pod = get_cluster(nproc, args.start_port, args.ips, nnodes=args.nnodes)
+    total = len(pod.trainers)
     return launch_procs(pod, args.training_script,
-                        args.training_script_args, nproc, args.log_dir)
+                        args.training_script_args, total, args.log_dir)
 
 
 def _launch_ps(args, nproc):

@@ -257,6 +257,9 @@ class DataLoader:
         self.num_workers = int(num_workers)
         self.prefetch_factor = max(int(prefetch_factor), 1)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -303,6 +306,13 @@ class DataLoader:
             else:
                 for batch in self._raw_batches():
                     yield self._wrap(batch)
+            return
+        if self.use_shared_memory:
+            # forked workers + shared-memory transport + watchdog
+            # (ref dataloader_iter.py:469 _DataLoaderIterMultiProcess)
+            from .multiprocess import MultiprocessLoaderIter
+            for batch in MultiprocessLoaderIter(self):
+                yield self._wrap(batch)
             return
         yield from self._worker_iter()
 
@@ -381,7 +391,9 @@ class DataLoader:
 
 
 def get_worker_info():
-    return None
+    """In a multiprocess DataLoader worker: shard info; else None."""
+    from .multiprocess import get_worker_info as _gwi
+    return _gwi()
 
 
 def __getattr__(name):

@@ -1,0 +1,272 @@
+"""Multiprocess DataLoader workers with shared-memory batch transport
+(ref python/paddle/fluid/dataloader/dataloader_iter.py:469
+_DataLoaderIterMultiProcess + paddle/fluid/memory/allocation/mmap_allocator.h).
+
+Design: forked workers fetch+collate index batches and write each numpy
+array of the batch into one POSIX shared-memory segment
+(multiprocessing.shared_memory — the mmap_allocator analog); only the
+segment name + array headers cross the result queue. The parent maps,
+copies out (into jnp on first device use), and unlinks. A watchdog in the
+parent's receive loop replaces the reference's SIGCHLD handler: worker
+death is detected by exitcode polling and tears the loader down with the
+worker's identity instead of hanging on the queue. Batches are re-ordered
+by sequence id so shuffle order matches the single-process loader.
+"""
+import atexit
+import itertools
+import os
+import queue as pyqueue
+import multiprocessing as mp
+from multiprocessing import shared_memory
+
+import numpy as np
+
+_FORK = mp.get_context("fork")
+
+# shm segments the parent has mapped but not yet unlinked (crash cleanup)
+_LIVE_SEGMENTS = set()
+
+
+@atexit.register
+def _cleanup_segments():
+    for name in list(_LIVE_SEGMENTS):
+        try:
+            shm = shared_memory.SharedMemory(name=name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+class _WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a worker: (id, num_workers, dataset); None in the parent
+    (ref dataloader/worker.py get_worker_info)."""
+    return _worker_info
+
+
+def _pack(seq, batch):
+    """Collated batch (list/tuple/dict of np arrays) -> shm segment + meta."""
+    if isinstance(batch, dict):
+        keys = list(batch.keys())
+        arrays = [np.ascontiguousarray(np.asarray(batch[k])) for k in keys]
+    else:
+        keys = None
+        arrays = [np.ascontiguousarray(np.asarray(a)) for a in
+                  (batch if isinstance(batch, (list, tuple)) else [batch])]
+    total = sum(a.nbytes for a in arrays) or 1
+    shm = shared_memory.SharedMemory(create=True, size=total)
+    metas = []
+    off = 0
+    for a in arrays:
+        shm.buf[off:off + a.nbytes] = a.tobytes()
+        metas.append((str(a.dtype), a.shape, off))
+        off += a.nbytes
+    name = shm.name
+    shm.close()
+    return {"seq": seq, "shm": name, "metas": metas, "keys": keys}
+
+
+def _unpack(msg):
+    shm = shared_memory.SharedMemory(name=msg["shm"])
+    _LIVE_SEGMENTS.add(msg["shm"])
+    out = []
+    for dtype, shape, off in msg["metas"]:
+        n = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        arr = np.frombuffer(shm.buf[off:off + n],
+                            dtype=dtype).reshape(shape).copy()
+        out.append(arr)
+    shm.close()
+    shm.unlink()
+    _LIVE_SEGMENTS.discard(msg["shm"])
+    if msg["keys"] is not None:
+        return dict(zip(msg["keys"], out))
+    return out
+
+
+def _worker_loop(worker_id, num_workers, dataset, collate_fn, index_queue,
+                 out_queue, iterable_mode, batch_size, drop_last,
+                 worker_init_fn):
+    global _worker_info
+    _worker_info = _WorkerInfo(worker_id, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    try:
+        if iterable_mode:
+            # each worker owns a strided shard of the stream
+            it = iter(dataset)
+            seq = worker_id
+            stream = itertools.islice(it, worker_id, None, num_workers)
+            while True:
+                batch = list(itertools.islice(stream, batch_size))
+                if not batch or (len(batch) < batch_size and drop_last):
+                    break
+                out_queue.put(_pack(seq, collate_fn(batch)))
+                seq += num_workers
+            out_queue.put({"done": worker_id})
+            return
+        while True:
+            item = index_queue.get()
+            if item is None:
+                out_queue.put({"done": worker_id})
+                return
+            seq, idxs = item
+            out_queue.put(_pack(seq, collate_fn([dataset[i] for i in idxs])))
+    except KeyboardInterrupt:
+        pass
+    except BaseException as e:  # surface the traceback in the parent
+        import traceback
+        out_queue.put({"error": f"{type(e).__name__}: {e}",
+                       "tb": traceback.format_exc(), "worker": worker_id})
+
+
+class MultiprocessLoaderIter:
+    """One epoch of forked-worker loading. Iterate to exhaustion or close().
+    """
+
+    def __init__(self, loader):
+        self.loader = loader
+        n = loader.num_workers
+        self.n = n
+        self._iterable = loader._iterable_mode
+        self._window = max(2, n * loader.prefetch_factor)
+        # bounded out queue = the backpressure that stops workers from
+        # materialising the whole epoch into /dev/shm
+        self._out = _FORK.Queue(maxsize=self._window)
+        self._index_queues = []
+        self._workers = []
+        self._timeout = float(loader.timeout) if loader.timeout else None
+        for w in range(n):
+            iq = _FORK.Queue() if not self._iterable else None
+            p = _FORK.Process(
+                target=_worker_loop,
+                args=(w, n, loader.dataset, loader.collate_fn, iq, self._out,
+                      self._iterable, loader.batch_size
+                      if self._iterable else 0,
+                      loader.drop_last if self._iterable else False,
+                      loader.worker_init_fn),
+                daemon=True)
+            p.start()
+            self._workers.append(p)
+            self._index_queues.append(iq)
+
+
+
+    def _check_workers(self):
+        for w, p in enumerate(self._workers):
+            if p.exitcode is not None and p.exitcode != 0:
+                self.close()
+                raise RuntimeError(
+                    f"DataLoader worker {w} (pid {p.pid}) died with exit "
+                    f"code {p.exitcode} — the SIGCHLD watchdog analog "
+                    f"(ref dataloader_iter.py _on_child_exit)")
+
+    def __iter__(self):
+        import time as _time
+        try:
+            done = set()
+            buffered = {}
+            next_seq = 0
+            expect = None
+            dispatched = 0
+            index_iter = None
+            closed_queues = False
+            if not self._iterable:
+                index_iter = enumerate(iter(self.loader.batch_sampler))
+                expect = len(self.loader.batch_sampler)
+                if expect == 0:
+                    return
+            received = 0
+            last_progress = _time.monotonic()
+            while True:
+                # incremental dispatch: keep at most `window` index batches
+                # outstanding (dispatched - received); the rest wait here
+                if index_iter is not None and not closed_queues:
+                    while dispatched - received < self._window:
+                        try:
+                            seq, idxs = next(index_iter)
+                        except StopIteration:
+                            for iq in self._index_queues:
+                                iq.put(None)
+                            closed_queues = True
+                            break
+                        self._index_queues[seq % self.n].put(
+                            (seq, list(idxs)))
+                        dispatched += 1
+                if len(done) == self.n and (
+                        expect is None or received >= expect):
+                    break
+                try:
+                    msg = self._out.get(timeout=1.0)
+                except pyqueue.Empty:
+                    self._check_workers()
+                    if self._timeout and                             _time.monotonic() - last_progress > self._timeout:
+                        self.close()
+                        raise RuntimeError(
+                            f"DataLoader timed out: no batch for "
+                            f"{self._timeout:.0f}s (workers alive but "
+                            f"stuck?)")
+                    continue
+                last_progress = _time.monotonic()
+                if "error" in msg:
+                    self.close()
+                    raise RuntimeError(
+                        f"DataLoader worker {msg['worker']} raised:\n"
+                        f"{msg['tb']}")
+                if "done" in msg:
+                    done.add(msg["done"])
+                    continue
+                received += 1
+                if self._iterable:
+                    # stream shards end at different times; arrival order
+                    # (like the reference's mp iterable loader)
+                    yield _unpack(msg)
+                    continue
+                buffered[msg["seq"]] = msg
+                while next_seq in buffered:
+                    yield _unpack(buffered.pop(next_seq))
+                    next_seq += 1
+        finally:
+            self.close()
+
+    def close(self):
+        # drain undelivered batches so their shm segments are unlinked (an
+        # early-exiting consumer must not leak /dev/shm)
+        try:
+            while True:
+                msg = self._out.get_nowait()
+                if "shm" in msg:
+                    try:
+                        seg = shared_memory.SharedMemory(name=msg["shm"])
+                        seg.close()
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+        except (pyqueue.Empty, OSError, ValueError):
+            pass
+        for iq in self._index_queues:
+            if iq is not None:
+                try:
+                    iq.cancel_join_thread()
+                    iq.close()
+                except (OSError, ValueError):
+                    pass
+        for p in self._workers:
+            if p.is_alive():
+                p.terminate()
+        for p in self._workers:
+            p.join(timeout=5)
+        try:
+            self._out.cancel_join_thread()
+            self._out.close()
+        except (OSError, ValueError):
+            pass

@@ -600,8 +600,12 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     ch_axis = 1 if data_format in ("NCHW", "NCL", "NCDHW") else -1
     use_batch_stats = training and not use_global_stats
     args = [x, running_mean, running_var]
-    if weight is not None and bias is not None:
-        args += [weight, bias]
+    if weight is None and bias is not None:
+        weight = Tensor(jnp.ones_like(as_array(bias)))   # shift-only affine
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
     outs = apply(_batch_norm_raw, tuple(args),
                  {"ch_axis": int(ch_axis), "momentum": float(momentum),
                   "epsilon": float(epsilon),

@@ -1,0 +1,93 @@
+"""Multi-host bootstrap: the launcher's --nnodes localhost simulation wires
+the jax coordination service (DCN analog; ref
+paddle/fluid/platform/gen_comm_id_helper.cc:284 TCP bootstrap +
+launch_utils.py get_cluster_from_args). Two processes, each with 2 virtual
+CPU devices, form one 4-device global mesh and allreduce across it."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch import get_cluster
+
+
+def test_get_cluster_nnodes_simulated():
+    pod = get_cluster(2, start_port=40100, ips="127.0.0.1", nnodes=2)
+    assert len(pod.trainers) == 4               # 2 per node x 2 nodes
+    ports = [t.endpoint.split(":")[1] for t in pod.trainers]
+    assert len(set(ports)) == 4                 # distinct ports per rank
+    assert pod.coordinator.endswith(":40099")
+
+
+def test_get_cluster_nnodes_mismatch():
+    with pytest.raises(ValueError, match="nnodes"):
+        get_cluster(4, ips="10.0.0.1,10.0.0.2", nnodes=3)
+    # consistent per-node semantics: nproc_per_node on EACH host
+    pod = get_cluster(3, ips="10.0.0.1,10.0.0.2,10.0.0.3", nnodes=3)
+    assert len(pod.trainers) == 9
+
+
+WORKER = textwrap.dedent("""
+    import os, json
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import distributed as dist
+
+    env = dist.init_parallel_env()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+
+    # data-parallel allreduce over the GLOBAL mesh (2 procs x 2 devices):
+    # psum of each device's (rank+1) ones -> sum over 4 devices = 6
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()).reshape(4), ("dp",))
+    import jax.numpy as jnp
+
+    @jax.jit
+    def allsum(x):
+        return jax.lax.psum(x, "dp")
+
+    local = jnp.ones((2, 4)) * (dist.get_rank() + 1)
+    arrs = [jax.device_put(local[i:i+1], d)
+            for i, d in enumerate(jax.local_devices())]
+    g = jax.make_array_from_single_device_arrays(
+        (4, 4), NamedSharding(mesh, P("dp")), arrs)
+    s = jax.shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                      in_specs=P("dp"), out_specs=P())(g)
+    # result is replicated: every process reads its local copy
+    total = float(np.asarray(s.addressable_shards[0].data).ravel()[0])
+    # rank0 contributes 1+1, rank1 contributes 2+2 -> 6
+    assert total == 6.0, total
+    print(json.dumps({"rank": dist.get_rank(),
+                      "world": dist.get_world_size(), "sum": total}))
+""")
+
+
+def test_launcher_nnodes_2_localhost(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    log_dir = str(tmp_path / "logs")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--nnodes", "2",
+         "--start_port", "40311", "--log_dir", log_dir, str(script)],
+        capture_output=True, text=True, cwd="/root/repo", env=env,
+        timeout=280)
+    logs = ""
+    for f in sorted(os.listdir(log_dir)):
+        logs += open(os.path.join(log_dir, f)).read()
+    assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-1000:],
+                               logs[-3000:])
+    payloads = [json.loads(l) for l in logs.splitlines()
+                if l.startswith("{")]
+    assert {p["rank"] for p in payloads} == {0, 1}
+    assert all(p["world"] == 2 and p["sum"] == 6.0 for p in payloads)
