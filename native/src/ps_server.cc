@@ -20,6 +20,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -30,6 +31,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace ptps {
@@ -45,7 +47,15 @@ enum Op : uint8_t {
   LOAD = 8,
   STOP = 9,
   SET_DENSE = 10,        // overwrite dense values (init/broadcast)
+  REGISTER = 11,         // aux = worker id; worker -> RUNNING
+  HEARTBEAT = 12,        // aux = worker id; refresh liveness
+  COMPLETE = 13,         // aux = worker id; worker -> COMPLETED (clean exit)
+  QUERY_ALIVE = 14,      // reply: u32 running, u32 completed, u32 dead
 };
+
+// worker lifecycle (ref operators/distributed/heart_beat_monitor.h:51
+// UNINITED/RUNNING/COMPLETED + the monitor marking silent workers dead)
+enum WorkerState : uint8_t { W_RUNNING = 1, W_COMPLETED = 2, W_DEAD = 3 };
 
 // ---------------------------------------------------------------- tables
 struct DenseTable {
@@ -116,7 +126,23 @@ class PsServer {
     if (listen(lfd_, 64) < 0) return -1;
     running_.store(true);
     accept_thread_ = std::thread([this] { AcceptLoop(); });
+    monitor_thread_ = std::thread([this] { MonitorLoop(); });
     return ntohs(addr.sin_port);
+  }
+
+  // heartbeat timeout (ms); a RUNNING worker silent for longer is DEAD
+  void SetHeartbeatTimeout(int ms) { hb_timeout_ms_.store(ms); }
+
+  // (running, completed, dead) counts
+  void WorkerCounts(uint32_t* run, uint32_t* comp, uint32_t* dead) {
+    std::lock_guard<std::mutex> lk(barrier_mu_);
+    uint32_t r = 0, c = 0, d = 0;
+    for (auto& kv : workers_) {
+      if (kv.second.state == W_RUNNING) ++r;
+      else if (kv.second.state == W_COMPLETED) ++c;
+      else ++d;
+    }
+    *run = r; *comp = c; *dead = d;
   }
 
   void AddDenseTable(uint32_t id, int64_t size, float lr) {
@@ -145,6 +171,7 @@ class PsServer {
       barrier_gen_++;
       barrier_cv_.notify_all();
     }
+    if (monitor_thread_.joinable()) monitor_thread_.join();
     if (accept_thread_.joinable()) accept_thread_.join();
     std::vector<std::thread> threads;
     {
@@ -280,20 +307,68 @@ class PsServer {
         uint8_t ok = 1;
         return Reply(fd, &ok, 1);
       }
-      case BARRIER: {  // aux = world size
+      case BARRIER: {  // aux = nominal world; table = worker_id+1 (0=anon)
         std::unique_lock<std::mutex> lk(barrier_mu_);
         uint64_t gen = barrier_gen_;
-        if (++barrier_count_ >= aux) {
-          barrier_count_ = 0;
-          barrier_gen_++;
-          barrier_cv_.notify_all();
+        barrier_world_ = aux;
+        if (table > 0) {
+          // per-worker arrival: a dead worker's stale arrival can't trip
+          // the barrier for live ones — required = every RUNNING worker
+          // present in the waiter set
+          barrier_waiters_.insert(table - 1);
         } else {
+          ++barrier_count_;
+        }
+        TripBarrierIfReadyLocked();
+        bool lost;
+        if (barrier_gen_ == gen) {
           barrier_cv_.wait(lk, [&] {
             return barrier_gen_ != gen || !running_.load();
           });
         }
+        lost = AnyDeadLocked();
+        // 1 = clean release; 2 = released but the cohort lost workers
+        // (the client surfaces degraded mode instead of hanging forever)
+        uint8_t ok = lost ? 2 : 1;
+        return Reply(fd, &ok, 1);
+      }
+      case REGISTER: {
+        std::lock_guard<std::mutex> lk(barrier_mu_);
+        workers_[aux] = {W_RUNNING, Now()};
         uint8_t ok = 1;
         return Reply(fd, &ok, 1);
+      }
+      case HEARTBEAT: {
+        std::lock_guard<std::mutex> lk(barrier_mu_);
+        auto it = workers_.find(aux);
+        uint8_t ok = 1;
+        if (it == workers_.end()) {
+          // unknown id (server restarted and lost its registry): a beat IS
+          // proof of life — re-register instead of killing the beat thread
+          workers_[aux] = {W_RUNNING, Now()};
+        } else if (it->second.state == W_COMPLETED) {
+          ok = 0;   // completed workers stop beating
+        } else {
+          // a beat from a worker previously declared dead revives it
+          // (network blip + client reconnect)
+          it->second.state = W_RUNNING;
+          it->second.last_beat = Now();
+        }
+        return Reply(fd, &ok, 1);
+      }
+      case COMPLETE: {
+        std::lock_guard<std::mutex> lk(barrier_mu_);
+        auto it = workers_.find(aux);
+        if (it != workers_.end()) it->second.state = W_COMPLETED;
+        barrier_waiters_.erase(aux);
+        TripBarrierIfReadyLocked();
+        uint8_t ok = 1;
+        return Reply(fd, &ok, 1);
+      }
+      case QUERY_ALIVE: {
+        uint32_t counts[3];
+        WorkerCounts(&counts[0], &counts[1], &counts[2]);
+        return Reply(fd, counts, sizeof(counts));
       }
       case SAVE:
       case LOAD: {
@@ -395,6 +470,68 @@ class PsServer {
     return false;
   }
 
+  static int64_t Now() {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  // ---- liveness (all *Locked helpers need barrier_mu_)
+  bool AnyDeadLocked() const {
+    for (auto& kv : workers_)
+      if (kv.second.state == W_DEAD) return true;
+    return false;
+  }
+
+  void TripBarrierIfReadyLocked() {
+    bool ready;
+    if (workers_.empty()) {
+      // legacy anonymous mode: count arrivals against the nominal world
+      ready = barrier_count_ > 0 && barrier_count_ >= barrier_world_;
+    } else {
+      // registered mode: every RUNNING worker must be in the waiter set
+      // (dead/completed workers are evicted from the cohort; their stale
+      // arrivals sit harmlessly in the set)
+      ready = false;
+      if (!barrier_waiters_.empty() || barrier_count_ > 0) {
+        ready = true;
+        for (auto& kv : workers_)
+          if (kv.second.state == W_RUNNING &&
+              barrier_waiters_.count(kv.first) == 0) {
+            ready = false;
+            break;
+          }
+      }
+    }
+    if (ready) {
+      barrier_count_ = 0;
+      barrier_waiters_.clear();
+      barrier_gen_++;
+      barrier_cv_.notify_all();
+    }
+  }
+
+  void MonitorLoop() {
+    // the SIGCHLD/heartbeat monitor analog: declare silent workers dead and
+    // re-evaluate any barrier they were holding up
+    while (running_.load()) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      int timeout = hb_timeout_ms_.load();
+      if (timeout <= 0) continue;
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      int64_t now = Now();
+      bool changed = false;
+      for (auto& kv : workers_) {
+        if (kv.second.state == W_RUNNING &&
+            now - kv.second.last_beat > timeout) {
+          kv.second.state = W_DEAD;
+          changed = true;
+        }
+      }
+      if (changed) TripBarrierIfReadyLocked();
+    }
+  }
+
   DenseTable* Dense(uint32_t id) {
     std::lock_guard<std::mutex> lk(tables_mu_);
     auto it = dense_.find(id);
@@ -420,31 +557,76 @@ class PsServer {
   std::condition_variable barrier_cv_;
   uint32_t barrier_count_ = 0;
   uint64_t barrier_gen_ = 0;
+  uint32_t barrier_world_ = 0;
+  std::unordered_set<uint32_t> barrier_waiters_;  // guarded by barrier_mu_
+  struct WorkerInfo { WorkerState state; int64_t last_beat; };
+  std::unordered_map<uint32_t, WorkerInfo> workers_;  // guarded by barrier_mu_
+  std::atomic<int> hb_timeout_ms_{10000};
+  std::thread monitor_thread_;
 };
 
 // ---------------------------------------------------------------- client
 class PsClient {
  public:
   bool Connect(const char* host, int port) {
-    fd_ = socket(AF_INET, SOCK_STREAM, 0);
-    if (fd_ < 0) return false;
-    int one = 1;
-    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons(static_cast<uint16_t>(port));
-    if (inet_pton(AF_INET, host, &addr.sin_addr) <= 0) return false;
-    return connect(fd_, reinterpret_cast<sockaddr*>(&addr),
-                   sizeof(addr)) == 0;
+    host_ = host;
+    port_ = port;
+    return Dial();
   }
 
   ~PsClient() {
     if (fd_ >= 0) close(fd_);
   }
 
+  static bool Idempotent(uint8_t op) {
+    switch (op) {
+      case PULL_DENSE:
+      case PULL_SPARSE:
+      case SET_DENSE:
+      case QUERY_ALIVE:
+      case REGISTER:
+      case HEARTBEAT:
+      case COMPLETE:
+      case SAVE:
+      case LOAD:
+        return true;
+      default:
+        // PUSH_* apply deltas and BARRIER counts arrivals: a retry after a
+        // lost reply would double-apply (at-least-once). Reconnect for the
+        // NEXT call, but surface this one's failure to the caller.
+        return false;
+    }
+  }
+
   bool Request(uint8_t op, uint32_t table, uint64_t count, uint32_t aux,
                const void* payload, size_t payload_n, std::vector<char>* out) {
     std::lock_guard<std::mutex> lk(mu_);
+    if (TryRequest(op, table, count, aux, payload, payload_n, out))
+      return true;
+    if (!Dial()) return false;
+    if (!Idempotent(op)) return false;
+    return TryRequest(op, table, count, aux, payload, payload_n, out);
+  }
+
+ private:
+  bool Dial() {
+    if (fd_ >= 0) close(fd_);
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (inet_pton(AF_INET, host_.c_str(), &addr.sin_addr) <= 0) return false;
+    return connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                   sizeof(addr)) == 0;
+  }
+
+  bool TryRequest(uint8_t op, uint32_t table, uint64_t count, uint32_t aux,
+                  const void* payload, size_t payload_n,
+                  std::vector<char>* out) {
+    if (fd_ < 0) return false;
     if (!WriteN(fd_, &op, 1) || !WriteN(fd_, &table, 4) ||
         !WriteN(fd_, &count, 8) || !WriteN(fd_, &aux, 4))
       return false;
@@ -455,7 +637,6 @@ class PsClient {
     return n == 0 || ReadN(fd_, out->data(), n);
   }
 
- private:
   static bool ReadN(int fd, void* buf, size_t n) {
     char* p = static_cast<char*>(buf);
     while (n) {
@@ -479,6 +660,8 @@ class PsClient {
   }
 
   int fd_ = -1;
+  std::string host_;
+  int port_ = 0;
   std::mutex mu_;
 };
 
@@ -564,7 +747,57 @@ int pt_ps_barrier(void* h, uint32_t world) {
   if (!static_cast<ptps::PsClient*>(h)->Request(ptps::BARRIER, 0, 0, world,
                                                 nullptr, 0, &g_resp))
     return -1;
-  return g_resp.size() == 1 ? 0 : -1;
+  if (g_resp.size() != 1) return -1;
+  return g_resp[0];  // 1 = clean, 2 = degraded (workers died)
+}
+
+// barrier with worker identity: table carries worker_id+1 so a dead
+// worker's stale arrival can't satisfy the barrier for live ones
+int pt_ps_barrier_as(void* h, uint32_t world, uint32_t worker_id) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::BARRIER, worker_id + 1,
+                                                0, world, nullptr, 0,
+                                                &g_resp))
+    return -1;
+  if (g_resp.size() != 1) return -1;
+  return g_resp[0];
+}
+
+void pt_ps_server_set_heartbeat_timeout(void* h, int ms) {
+  static_cast<ptps::PsServer*>(h)->SetHeartbeatTimeout(ms);
+}
+
+int pt_ps_worker_register(void* h, uint32_t worker_id) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::REGISTER, 0, 0,
+                                                worker_id, nullptr, 0,
+                                                &g_resp))
+    return -1;
+  return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+}
+
+int pt_ps_worker_heartbeat(void* h, uint32_t worker_id) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::HEARTBEAT, 0, 0,
+                                                worker_id, nullptr, 0,
+                                                &g_resp))
+    return -1;
+  return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+}
+
+int pt_ps_worker_complete(void* h, uint32_t worker_id) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::COMPLETE, 0, 0,
+                                                worker_id, nullptr, 0,
+                                                &g_resp))
+    return -1;
+  return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+}
+
+// out[3] = {running, completed, dead}
+int pt_ps_query_workers(void* h, uint32_t* out) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::QUERY_ALIVE, 0, 0, 0,
+                                                nullptr, 0, &g_resp))
+    return -1;
+  if (g_resp.size() != 12) return -1;
+  std::memcpy(out, g_resp.data(), 12);
+  return 0;
 }
 
 int pt_ps_save(void* h, uint32_t table, const char* path) {

@@ -1,0 +1,187 @@
+"""Deep Gradient Compression (ref python/paddle/distributed/fleet/
+meta_optimizers/dgc_optimizer.py DGCMomentumOptimizer +
+paddle/fluid/framework/details/sparse_all_reduce_op_handle.cc +
+paddle/fluid/operators/dgc_op.h).
+
+DGC semantics, TPU-native: each dp replica keeps momentum-corrected
+accumulators (U = m*U + g, V = V + U — the paper's momentum correction),
+communicates only the top-(1-sparsity) fraction of |V| per parameter each
+step, and zeroes the communicated entries locally (residual accumulation).
+Parameters stay replica-identical: the update applies the cross-replica MEAN
+of the sparse tensors with plain SGD (the paper's server-side apply).
+
+Communication note (the honest TPU story): the reference's bandwidth win
+comes from a custom sparse allreduce over commodity ethernet
+(sparse_all_reduce_op_handle.cc). XLA exposes dense collectives only, so
+here the sparse tensor is psum'd dense over ICI — DGC's *convergence*
+semantics (what the sparsity does to training) are exact, while its *wire*
+format is moot on ICI, whose bandwidth makes dense dp allreduce a non-issue
+at the scales the reference targets. If DCN-scale sparse collectives become
+available in XLA, only `_communicate` below changes.
+
+Selection: per-parameter top-k on |V| (k static per compile from the
+sparsity schedule), matching dgc_op.h's per-tensor threshold; ties admit a
+few extra elements, exactly like the reference's sampled threshold.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework import state
+from ..framework.tensor import Tensor
+from ..jit import _unwrap, _wrap
+from . import mesh as mesh_mod
+
+
+def _topk_mask(v, keep):
+    """Boolean mask of the `keep` largest-|v| entries (per tensor)."""
+    flat = jnp.abs(v).ravel()
+    if keep >= flat.size:
+        return jnp.ones_like(v, dtype=bool)
+    thr = jax.lax.top_k(flat, keep)[0][-1]
+    return jnp.abs(v) >= thr
+
+
+class DGCTrainStep:
+    """Compiled DGC training step over the 'dp' mesh axis.
+
+    optimizer must be Momentum-flavored (the reference's
+    DGCMomentumOptimizer subclasses Momentum): its lr and momentum drive the
+    update; its own accumulators are bypassed — DGC's U/V replace them.
+
+    sparsity: fraction of entries NOT communicated each step (e.g. 0.999
+    keeps the top 0.1%). rampup_begin_step delays compression (dense warmup,
+    like the reference's rampup_begin_step).
+    """
+
+    def __init__(self, model, loss_fn, optimizer, sparsity=0.999,
+                 rampup_begin_step=0, mesh=None, dp_axis=None, donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh or mesh_mod.get_mesh() or mesh_mod.default_mesh()
+        self.dp_axis = dp_axis or (
+            mesh_mod.DP_AXIS if mesh_mod.DP_AXIS in self.mesh.axis_names
+            else self.mesh.axis_names[0])
+        self.dp = int(self.mesh.shape[self.dp_axis])
+        dp = self.dp
+        momentum = float(getattr(optimizer, "_momentum", 0.9))
+        self.sparsity = float(sparsity)
+        self.rampup_begin_step = int(rampup_begin_step)
+
+        params, buffers = model.functional_state()
+        rep_axis = NamedSharding(self.mesh, P(self.dp_axis))
+        replicated = NamedSharding(self.mesh, P())
+
+        def stack(a):
+            return jax.device_put(
+                jnp.broadcast_to(jnp.zeros_like(a)[None], (dp,) + a.shape),
+                rep_axis)
+
+        self.params = {n: jax.device_put(a, replicated)
+                       for n, a in params.items()}
+        self.buffers = {n: jax.device_put(a, replicated)
+                        for n, a in buffers.items()}
+        self.U = {n: stack(a) for n, a in params.items()}   # momentum accum
+        self.V = {n: stack(a) for n, a in params.items()}   # residual accum
+        self._step_i = optimizer._global_step
+        keep_frac = max(1e-6, 1.0 - self.sparsity)
+        keep = {n: max(1, int(math.ceil(keep_frac * int(np.prod(a.shape)))))
+                for n, a in params.items()}
+        # sparsity 0 keeps everything: compression is the identity, so stay
+        # on the dense (plain momentum) branch forever
+        rampup = self.rampup_begin_step if keep_frac < 1.0 else 2 ** 30
+
+        def _forward(p, b, key, x, y):
+            with state.functional_rng_ctx(key):
+                out, _ = model.functional_call(p, b, *_wrap(x))
+                outs = out if isinstance(out, tuple) else (out,)
+                loss_t = loss_fn(*outs, *_wrap(y))
+            return _unwrap(loss_t)
+
+        def _one_replica_grads(p, b, key, x, y):
+            return jax.value_and_grad(
+                lambda pp: _forward(pp, b, key, x, y))(p)
+
+        def _step(params, buffers, U, V, keys, lr, step_i, inputs, labels):
+            # per-replica grads on the local micro-batch (params replicated)
+            loss, grads = jax.vmap(
+                _one_replica_grads,
+                in_axes=(None, None, 0, 0, 0))(params, buffers, keys,
+                                               inputs, labels)
+
+            new_params, new_U, new_V = {}, {}, {}
+            for n, p in params.items():
+                g = grads[n]                       # [dp, ...]
+                u = momentum * U[n] + g            # momentum correction
+                v = V[n] + u                       # residual accumulation
+
+                def compress(args):
+                    u_, v_ = args
+                    mask = jax.vmap(lambda vv: _topk_mask(vv, keep[n]))(v_)
+                    sparse = jnp.where(mask, v_, 0)
+                    return (jnp.where(mask, 0, u_),   # factor masking
+                            jnp.where(mask, 0, v_), sparse)
+
+                def dense(args):
+                    # warmup (and sparsity=0): plain momentum SGD — U is the
+                    # live momentum buffer, V stays empty, the whole
+                    # momentum-corrected gradient is communicated (matching
+                    # the reference, which runs the vanilla momentum op
+                    # before rampup_begin_step)
+                    u_, v_ = args
+                    return (u_, jnp.zeros_like(v_), u_)
+
+                u, v, sparse = jax.lax.cond(step_i > rampup, compress,
+                                            dense, (u, v))
+                comm = jnp.mean(sparse, axis=0)    # the (dense) allreduce
+                new_params[n] = p - lr.astype(p.dtype) * comm.astype(p.dtype)
+                new_U[n] = u
+                new_V[n] = v
+            return jnp.mean(loss), new_params, new_U, new_V
+
+        sh_p = {n: replicated for n in self.params}
+        sh_acc = {n: rep_axis for n in self.params}
+        self._compiled = jax.jit(
+            _step,
+            in_shardings=(sh_p, {n: replicated for n in self.buffers},
+                          sh_acc, sh_acc, rep_axis, None, None, None, None),
+            out_shardings=(replicated, sh_p, sh_acc, sh_acc),
+            donate_argnums=(0, 2, 3) if donate else (),
+        )
+
+    def _split_batch(self, arrs):
+        rep = NamedSharding(self.mesh, P(self.dp_axis))
+        out = []
+        for a in arrs:
+            a = a._data if isinstance(a, Tensor) else jnp.asarray(a)
+            if a.shape[0] % self.dp != 0:
+                raise ValueError(
+                    f"DGC batch dim {a.shape[0]} must be divisible by "
+                    f"dp={self.dp}")
+            out.append(jax.device_put(
+                a.reshape((self.dp, a.shape[0] // self.dp) + a.shape[1:]),
+                rep))
+        return tuple(out)
+
+    def __call__(self, inputs, labels):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
+        labels = labels if isinstance(labels, (list, tuple)) else (labels,)
+        self._step_i += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        keys = jax.random.split(state.next_rng_key(), self.dp)
+        with self.mesh:
+            loss, self.params, self.U, self.V = self._compiled(
+                self.params, self.buffers, self.U, self.V, keys, lr,
+                jnp.asarray(self._step_i, jnp.int32),
+                self._split_batch(inputs), self._split_batch(labels))
+        return Tensor(loss)
+
+    def sync(self):
+        named_p = dict(self.model.named_parameters())
+        for n, arr in self.params.items():
+            named_p[n]._data = jnp.copy(jax.device_get(arr))
+        self.optimizer._global_step = self._step_i

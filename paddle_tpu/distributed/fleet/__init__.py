@@ -7,7 +7,7 @@ from .base import (init, is_first_worker, worker_index, worker_num,
                    server_endpoints, is_server, barrier_worker,
                    distributed_optimizer, distributed_model,
                    DistributedStrategy, UserDefinedRoleMaker,
-                   PaddleCloudRoleMaker, UtilBase, fleet)
+                   PaddleCloudRoleMaker, UtilBase, fleet, build_train_step)
 
 
 def __getattr__(name):

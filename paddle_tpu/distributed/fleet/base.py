@@ -117,6 +117,7 @@ class DistributedStrategy:
         self.lars = False
         self.lars_configs = {}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.999]}
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 1}
         self.adaptive_localsgd = False
@@ -290,6 +291,12 @@ def build_train_step(model, loss_fn, optimizer, **kwargs):
             model, loss_fn, optimizer,
             num_micro=max(1, int(cfg.get("accumulate_steps", 1) or 1)),
             **kwargs)
+    if tf.get("dgc") is not None and mesh is not None and ndev > 1:
+        from ..dgc import DGCTrainStep
+        cfg = tf["dgc"]
+        return DGCTrainStep(
+            model, loss_fn, optimizer, sparsity=cfg.get("sparsity", 0.999),
+            rampup_begin_step=cfg.get("rampup_begin_step", 0), **kwargs)
     if tf.get("localsgd") is not None and mesh is not None and ndev > 1:
         from ..localsgd import LocalSGDTrainStep
         cfg = tf["localsgd"]

@@ -152,6 +152,22 @@ class LocalSGDOptimizer(MetaOptimizerBase):
         self.transforms["localsgd"] = {"k_steps": k_steps}
 
 
+class DGCOptimizer(MetaOptimizerBase):
+    """ref meta_optimizers/dgc_optimizer.py DGCMomentumOptimizer: top-k
+    sparsified grads with momentum correction + residual accumulation;
+    consumed by distributed/dgc.py DGCTrainStep."""
+
+    def __init__(self, inner_opt, configs=None):
+        super().__init__(inner_opt)
+        cfg = dict(configs or {})
+        sparsity = cfg.get("sparsity", [0.999])
+        if isinstance(sparsity, (list, tuple)):
+            sparsity = sparsity[-1]
+        self.transforms["dgc"] = {
+            "sparsity": float(sparsity),
+            "rampup_begin_step": int(cfg.get("rampup_begin_step", 0) or 0)}
+
+
 class ShardingOptimizer(MetaOptimizerBase):
     """ref meta_optimizers/sharding_optimizer.py:33 (ZeRO): on TPU this is
     GSPMD weight-update/optimizer-state sharding (PAPERS.md: Automatic
@@ -199,6 +215,8 @@ def build_distributed_optimizer(optimizer, strategy):
         opt = PipelineOptimizer(opt, strategy.pipeline_configs)
     if strategy.localsgd:
         opt = LocalSGDOptimizer(opt, strategy.localsgd_configs.get("k_steps", 1))
+    if strategy.dgc:
+        opt = DGCOptimizer(opt, getattr(strategy, "dgc_configs", None))
     if strategy.gradient_merge:
         opt = GradientMergeOptimizer(
             opt, strategy.gradient_merge_configs.get("k_steps", 1),

@@ -57,6 +57,12 @@ class PsServer:
         self.port = p
         return p
 
+    def set_heartbeat_timeout(self, seconds):
+        """RUNNING workers silent for longer are declared DEAD and evicted
+        from barriers (ref heart_beat_monitor.h)."""
+        self._lib.pt_ps_server_set_heartbeat_timeout(self._h,
+                                                     int(seconds * 1000))
+
     def stop(self):
         if self._h:
             self._lib.pt_ps_server_stop(self._h)
@@ -76,6 +82,7 @@ class PsClient:
 
     def __init__(self, host="127.0.0.1", port=None):
         self._lib = load_native()
+        self._host, self._port = host, int(port)
         self._h = self._lib.pt_ps_client_create()
         if self._lib.pt_ps_client_connect(self._h, host.encode(),
                                           int(port)) != 0:
@@ -121,9 +128,68 @@ class PsClient:
             self._h, table_id, _iptr(ids), ids.size, _fptr(grads),
             grads.shape[1]), "push_sparse_grad")
 
-    def barrier(self, world_size):
-        self._check(self._lib.pt_ps_barrier(self._h, int(world_size)),
-                    "barrier")
+    def barrier(self, world_size, worker_id=None):
+        """True = clean release; False = released degraded (the server's
+        heartbeat monitor evicted dead workers from the cohort instead of
+        letting the barrier hang — ref heart_beat_monitor.h:51). Pass
+        worker_id when workers register/heartbeat: arrivals are then tracked
+        per worker, so a dead worker's stale arrival can't fake quorum."""
+        if worker_id is None:
+            rc = self._lib.pt_ps_barrier(self._h, int(world_size))
+        else:
+            rc = self._lib.pt_ps_barrier_as(self._h, int(world_size),
+                                            int(worker_id))
+        if rc < 0:
+            raise RuntimeError(f"ps client barrier failed (rc={rc})")
+        return rc == 1
+
+    # ------------------------------------------------------ worker liveness
+    def register_worker(self, worker_id):
+        self._check(self._lib.pt_ps_worker_register(self._h, int(worker_id)),
+                    "register_worker")
+
+    def heartbeat(self, worker_id):
+        """One beat. Returns False if the server no longer accepts beats for
+        this worker (already COMPLETED)."""
+        return self._lib.pt_ps_worker_heartbeat(self._h, int(worker_id)) == 0
+
+    def complete_worker(self, worker_id):
+        self._check(self._lib.pt_ps_worker_complete(self._h, int(worker_id)),
+                    "complete_worker")
+
+    def query_workers(self):
+        """(running, completed, dead) counts from the server's monitor."""
+        out = np.zeros(3, np.uint32)
+        self._check(self._lib.pt_ps_query_workers(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))),
+            "query_workers")
+        return int(out[0]), int(out[1]), int(out[2])
+
+    def start_heartbeat(self, worker_id, interval_s=1.0):
+        """Background beat thread on its OWN connection — a blocking
+        barrier on this client must not starve the beats it exists to
+        protect (the reference's worker heartbeat thread is likewise a
+        separate brpc channel)."""
+        import threading
+        stop = threading.Event()
+        beat_client = PsClient(host=self._host, port=self._port)
+        self.register_worker(worker_id)
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    if not beat_client.heartbeat(worker_id):
+                        return
+                except RuntimeError:
+                    return
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+
+        def cancel():
+            stop.set()
+            t.join(timeout=5)
+        return cancel
 
     def save(self, table_id, path):
         self._check(self._lib.pt_ps_save(self._h, table_id,

@@ -174,7 +174,7 @@ def main(argv=None):
         "paddle_tpu.distributed.launch",
         description="launch a distributed job: one process per device/rank")
     parser.add_argument("--nproc_per_node", type=int, default=None)
-    parser.add_argument("--nnodes", type=int, default=1,
+    parser.add_argument("--nnodes", type=int, default=None,
                         help="number of nodes; with a single --ips entry the "
                              "nodes are simulated on localhost (multi-host "
                              "smoke testing, ref launch.py --nnodes)")

@@ -161,13 +161,16 @@ class MultiprocessLoaderIter:
 
 
 
-    def _check_workers(self):
+    def _check_workers(self, done=()):
         for w, p in enumerate(self._workers):
-            if p.exitcode is not None and p.exitcode != 0:
+            if p.exitcode is not None and w not in done:
+                # exit 0 without the 'done' sentinel (sys.exit in a
+                # transform, swallowed KeyboardInterrupt) is just as dead
                 self.close()
                 raise RuntimeError(
-                    f"DataLoader worker {w} (pid {p.pid}) died with exit "
-                    f"code {p.exitcode} — the SIGCHLD watchdog analog "
+                    f"DataLoader worker {w} (pid {p.pid}) exited "
+                    f"(code {p.exitcode}) before finishing its batches — "
+                    f"the SIGCHLD watchdog analog "
                     f"(ref dataloader_iter.py _on_child_exit)")
 
     def __iter__(self):

@@ -114,7 +114,20 @@ def _configure(lib):
     lib.pt_ps_push_sparse_grad.restype = c.c_int
     lib.pt_ps_barrier.argtypes = [c.c_void_p, c.c_uint32]
     lib.pt_ps_barrier.restype = c.c_int
+    lib.pt_ps_barrier_as.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32]
+    lib.pt_ps_barrier_as.restype = c.c_int
     lib.pt_ps_save.argtypes = [c.c_void_p, c.c_uint32, c.c_char_p]
     lib.pt_ps_save.restype = c.c_int
     lib.pt_ps_load.argtypes = [c.c_void_p, c.c_uint32, c.c_char_p]
     lib.pt_ps_load.restype = c.c_int
+    # worker liveness (heartbeat monitor)
+    lib.pt_ps_server_set_heartbeat_timeout.argtypes = [c.c_void_p, c.c_int]
+    lib.pt_ps_worker_register.argtypes = [c.c_void_p, c.c_uint32]
+    lib.pt_ps_worker_register.restype = c.c_int
+    lib.pt_ps_worker_heartbeat.argtypes = [c.c_void_p, c.c_uint32]
+    lib.pt_ps_worker_heartbeat.restype = c.c_int
+    lib.pt_ps_worker_complete.argtypes = [c.c_void_p, c.c_uint32]
+    lib.pt_ps_worker_complete.restype = c.c_int
+    lib.pt_ps_query_workers.argtypes = [c.c_void_p,
+                                        c.POINTER(c.c_uint32)]
+    lib.pt_ps_query_workers.restype = c.c_int

@@ -177,3 +177,106 @@ class TestPSTraining:
             np.testing.assert_allclose(w, w_true, atol=0.1)
         finally:
             srv.stop()
+
+
+# --------------------------------------------------------------- liveness
+
+def test_heartbeat_dead_worker_evicted_from_barrier():
+    """Kill 1 of 4 workers mid-barrier: the monitor declares it dead and the
+    barrier releases degraded instead of hanging
+    (ref operators/distributed/heart_beat_monitor.h:51)."""
+    import threading
+    import time as _t
+    from paddle_tpu.distributed.fleet.ps import PsServer, PsClient
+
+    server = PsServer()
+    server.add_dense_table(0, 4, lr=0.1)
+    port = server.start(0)
+    server.set_heartbeat_timeout(1.0)
+    try:
+        clients = [PsClient(port=port) for _ in range(4)]
+        cancels = []
+        for w, cl in enumerate(clients):
+            cancels.append(cl.start_heartbeat(w, interval_s=0.2))
+        _t.sleep(0.5)
+        run, comp, dead = clients[0].query_workers()
+        assert (run, comp, dead) == (4, 0, 0)
+
+        # worker 3 dies: stop its beats entirely
+        cancels[3]()
+
+        results = {}
+
+        def wait_barrier(w):
+            results[w] = clients[w].barrier(4, worker_id=w)
+
+        threads = [threading.Thread(target=wait_barrier, args=(w,))
+                   for w in range(3)]
+        t0 = _t.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        elapsed = _t.monotonic() - t0
+        assert all(not t.is_alive() for t in threads), "barrier hung"
+        assert elapsed < 10, elapsed
+        # released, flagged degraded (a cohort member is dead)
+        assert results == {0: False, 1: False, 2: False}
+        run, comp, dead = clients[0].query_workers()
+        assert dead == 1 and run == 3
+        for c in cancels[:3]:
+            c()
+    finally:
+        server.stop()
+
+
+def test_completed_workers_leave_cohort():
+    """COMPLETE shrinks the barrier requirement: remaining workers sync
+    without the finished one (ref worker states UNINITED/RUNNING/COMPLETED)."""
+    import threading
+    from paddle_tpu.distributed.fleet.ps import PsServer, PsClient
+
+    server = PsServer()
+    port = server.start(0)
+    try:
+        clients = [PsClient(port=port) for _ in range(3)]
+        for w, cl in enumerate(clients):
+            cl.register_worker(w)
+        clients[2].complete_worker(2)
+        results = {}
+
+        def wait_barrier(w):
+            results[w] = clients[w].barrier(3, worker_id=w)
+
+        threads = [threading.Thread(target=wait_barrier, args=(w,))
+                   for w in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        assert all(not t.is_alive() for t in threads), "barrier hung"
+        assert results == {0: True, 1: True}   # clean: nobody died
+    finally:
+        server.stop()
+
+
+def test_client_reconnects_after_server_restart():
+    from paddle_tpu.distributed.fleet.ps import PsServer, PsClient
+
+    server = PsServer()
+    server.add_dense_table(0, 8, lr=0.1)
+    port = server.start(0)
+    client = PsClient(port=port)
+    client.set_dense(0, np.arange(8, dtype=np.float32))
+    server.stop()
+
+    server2 = PsServer()
+    server2.add_dense_table(0, 8, lr=0.1)
+    server2.start(port)
+    try:
+        # transparent reconnect inside the client (one retry per request)
+        vals = client.pull_dense(0, 8)
+        assert vals.shape == (8,)          # fresh table: zeros
+        np.testing.assert_allclose(vals, 0.0)
+    finally:
+        server2.stop()
