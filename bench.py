@@ -69,25 +69,40 @@ def run():
                         attn_dropout=0.0)
         batch, seq, iters = 2, 128, 3
 
-    model = GPTForPretraining(cfg)
-    if on_tpu:
-        model.to(dtype=jnp.bfloat16)  # bf16 params: MXU-native
-    opt = pt.optimizer.AdamW(learning_rate=1e-4,
-                             parameters=model.parameters())
-    step = TrainStep(model, gpt_pretrain_loss, opt)
-
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32")
 
-    # warmup/compile
-    loss = step(ids, ids)
-    float(loss.numpy())
+    def build(donate):
+        model = GPTForPretraining(cfg)
+        if on_tpu:
+            model.to(dtype=jnp.bfloat16)  # bf16 params: MXU-native
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+        return TrainStep(model, gpt_pretrain_loss, opt, donate=donate), model
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, ids)
-    final = float(loss.numpy())
-    dt = (time.perf_counter() - t0) / iters
+    def measure(step, n):
+        loss = step(ids, ids)          # warmup/compile
+        float(loss.numpy())
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = step(ids, ids)
+        final = float(loss.numpy())
+        return (time.perf_counter() - t0) / n, final
+
+    # donation is the right default (params update in place on HBM), but
+    # the tunneled single-chip plugin has shown pathological donated-step
+    # behavior; self-tune: probe a few steps, rebuild without donation if
+    # it's clearly faster, keep the winner for the measured run.
+    step, model = build(donate=True)
+    dt_probe, _ = measure(step, 3)
+    chosen = "donate"
+    if on_tpu and dt_probe > 1.0:      # >1s/step for GPT2s is pathological
+        step2, model2 = build(donate=False)
+        dt2, _ = measure(step2, 3)
+        if dt2 < dt_probe * 0.8:
+            step, model, chosen = step2, model2, "no-donate"
+
+    dt, final = measure(step, iters)
     assert np.isfinite(final), "non-finite loss in bench"
 
     tokens_per_sec = batch * seq / dt
@@ -109,7 +124,7 @@ def run():
         "vs_baseline": round(mfu, 4),
         "detail": {"step_ms": round(dt * 1e3, 2), "loss": round(final, 3),
                    "model_tflops": round(tflops, 2), "params": n_params,
-                   "backend": jax.default_backend()},
+                   "backend": jax.default_backend(), "mode": chosen},
     }))
 
 

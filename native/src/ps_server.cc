@@ -484,24 +484,26 @@ class PsServer {
   }
 
   void TripBarrierIfReadyLocked() {
-    bool ready;
-    if (workers_.empty()) {
-      // legacy anonymous mode: count arrivals against the nominal world
-      ready = barrier_count_ > 0 && barrier_count_ >= barrier_world_;
-    } else {
-      // registered mode: every RUNNING worker must be in the waiter set
-      // (dead/completed workers are evicted from the cohort; their stale
-      // arrivals sit harmlessly in the set)
-      ready = false;
-      if (!barrier_waiters_.empty() || barrier_count_ > 0) {
-        ready = true;
+    // anonymous arrivals always count against the nominal world (legacy
+    // mode, and the escape hatch when registered workers barrier without
+    // identities)
+    bool ready = barrier_count_ > 0 && barrier_world_ > 0 &&
+                 barrier_count_ >= barrier_world_;
+    if (!ready && !workers_.empty() && !barrier_waiters_.empty()) {
+      // registered mode: (a) the expected cohort has fully registered
+      // (dead/completed members still count as registered — they are
+      // known, just evicted) and (b) every still-RUNNING worker is in the
+      // waiter set. (a) stops the first registrant from sailing through
+      // a world-N barrier alone before its peers even register.
+      ready = workers_.size() + barrier_count_ >=
+              static_cast<size_t>(barrier_world_);
+      if (ready)
         for (auto& kv : workers_)
           if (kv.second.state == W_RUNNING &&
               barrier_waiters_.count(kv.first) == 0) {
             ready = false;
             break;
           }
-      }
     }
     if (ready) {
       barrier_count_ = 0;
@@ -774,12 +776,15 @@ int pt_ps_worker_register(void* h, uint32_t worker_id) {
   return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
 }
 
+// 1 = beat accepted; 0 = worker is COMPLETED (stop beating);
+// -1 = transport failure (retry next interval)
 int pt_ps_worker_heartbeat(void* h, uint32_t worker_id) {
   if (!static_cast<ptps::PsClient*>(h)->Request(ptps::HEARTBEAT, 0, 0,
                                                 worker_id, nullptr, 0,
                                                 &g_resp))
     return -1;
-  return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+  if (g_resp.size() != 1) return -1;
+  return g_resp[0] == 1 ? 1 : 0;
 }
 
 int pt_ps_worker_complete(void* h, uint32_t worker_id) {

@@ -149,9 +149,10 @@ class PsClient:
                     "register_worker")
 
     def heartbeat(self, worker_id):
-        """One beat. Returns False if the server no longer accepts beats for
-        this worker (already COMPLETED)."""
-        return self._lib.pt_ps_worker_heartbeat(self._h, int(worker_id)) == 0
+        """One beat. 1 = accepted, 0 = worker COMPLETED (stop beating),
+        -1 = transport failure (transient: the next beat re-dials and the
+        server re-registers a beating worker after restart)."""
+        return int(self._lib.pt_ps_worker_heartbeat(self._h, int(worker_id)))
 
     def complete_worker(self, worker_id):
         self._check(self._lib.pt_ps_worker_complete(self._h, int(worker_id)),
@@ -178,10 +179,10 @@ class PsClient:
         def loop():
             while not stop.wait(interval_s):
                 try:
-                    if not beat_client.heartbeat(worker_id):
-                        return
+                    if beat_client.heartbeat(worker_id) == 0:
+                        return          # COMPLETED: beats are over
                 except RuntimeError:
-                    return
+                    pass                # transient transport error: retry
 
         t = threading.Thread(target=loop, daemon=True)
         t.start()

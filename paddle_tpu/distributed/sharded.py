@@ -112,6 +112,20 @@ class ShardedTrainStep:
         self.buffer_specs = {n: P() for n in buffers}
 
         # ---- optimizer state shardings (follow param; + dp for ZeRO>=1)
+        # ZeRO stages under GSPMD (ref fleet sharding_optimizer.py stages;
+        # PAPERS.md arXiv:2004.13336):
+        #   1: optimizer state dp-sharded — the update math runs on 1/dp of
+        #      each state tensor per device.
+        #   2: gradient sharding. Grads are ephemeral inside the single
+        #      compiled step and are consumed by the dp-sharded update, so
+        #      the partitioner materialises them reduce-SCATTERED into the
+        #      update — stage 2 is subsumed by stage 1 here (there is no
+        #      standalone grad buffer to shard).
+        #   3: parameters dp-sharded too. Gather-on-use is explicit in the
+        #      partitioned HLO: every use site all-gathers the shard just
+        #      before the matmul and the backward reduce-scatters dL/dW
+        #      straight back to the shard (test_zero3.py asserts both
+        #      collectives exist and per-device bytes are size/dp).
         opt_state = optimizer.init_opt_state(params)
         self.opt_specs = {}
         for n, slots in opt_state.items():

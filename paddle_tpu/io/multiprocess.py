@@ -211,7 +211,7 @@ class MultiprocessLoaderIter:
                 try:
                     msg = self._out.get(timeout=1.0)
                 except pyqueue.Empty:
-                    self._check_workers()
+                    self._check_workers(done)
                     if self._timeout and                             _time.monotonic() - last_progress > self._timeout:
                         self.close()
                         raise RuntimeError(

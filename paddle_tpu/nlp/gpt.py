@@ -14,6 +14,7 @@ TPU-first design decisions:
 import math
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -202,3 +203,81 @@ def gpt_pretrain_loss(logits, labels):
     b, s, v = shift_logits.shape
     return F.cross_entropy(shift_logits.reshape([b * s, v]),
                            shift_labels.reshape([b * s]))
+
+
+def gpt_generate(model, input_ids, max_new_tokens=32, do_sample=False,
+                 top_k=0, top_p=1.0, temperature=1.0, eos_token_id=None,
+                 seed=None):
+    """Autoregressive decode for GPTForPretraining
+    (ref paddlenlp generation_utils.generate: greedy + top-k/top-p sampling).
+
+    TPU-native: ONE jitted lax.fori_loop over a fixed [B, Lmax] buffer —
+    each step recomputes the (causal) forward over the buffer and reads the
+    logits at the frontier position. Positions past the frontier are
+    padding; causal masking keeps them out of every earlier position, so
+    recompute-full-prefix is exact. (A KV-cache kernel trades this O(T^2)
+    for O(T) at larger contexts; the buffer form compiles to one program
+    with zero dynamic shapes, which is the right default for short
+    decodes on TPU.)
+
+    Returns ids [B, prompt_len + max_new_tokens] (prompt included), padded
+    with eos after finish when eos_token_id is given.
+    """
+    import numpy as np
+    from ..framework import state as _state
+    from ..framework.tensor import Tensor as _T
+    from ..nn.decode import top_k_top_p_filtering
+
+    ids = input_ids._data if isinstance(input_ids, _T) else jnp.asarray(
+        np.asarray(input_ids))
+    ids = ids.astype(jnp.int32)
+    B, prompt_len = ids.shape
+    L = prompt_len + int(max_new_tokens)
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+
+    params, buffers = model.functional_state()
+
+    def logits_at(p, b, buf, t):
+        out, _ = model.functional_call(p, b, _T(buf))
+        lo = out._data if isinstance(out, _T) else out
+        # frontier logits: position t-1 predicts token t
+        return jax.lax.dynamic_index_in_dim(lo, t - 1, axis=1,
+                                            keepdims=False)
+
+    def make_step(p, b):
+        def step(t, carry):
+            buf, finished, key = carry
+            lo = logits_at(p, b, buf, t).astype(jnp.float32)
+            if temperature and temperature != 1.0:
+                lo = lo / temperature
+            if do_sample:
+                lo = top_k_top_p_filtering(_T(lo), top_k=top_k,
+                                           top_p=top_p)._data
+                key, sub = jax.random.split(key)
+                tok = jax.random.categorical(sub, lo,
+                                             axis=-1).astype(jnp.int32)
+            else:
+                tok = jnp.argmax(lo, axis=-1).astype(jnp.int32)
+            tok = jnp.where(finished, jnp.int32(max(eos, 0)), tok)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, tok[:, None], t, axis=1)
+            if eos_token_id is not None:
+                finished = finished | (tok == eos)
+            return buf, finished, key
+        return step
+
+    buf0 = jnp.zeros((B, L), jnp.int32)
+    buf0 = jax.lax.dynamic_update_slice_in_dim(buf0, ids, 0, axis=1)
+    key0 = (jax.random.PRNGKey(seed) if seed is not None
+            else _state.next_rng_key())
+
+    @jax.jit
+    def run(p, b, buf, key):
+        # params enter as jit ARGUMENTS (not baked constants), so repeated
+        # generate() calls after training reuse the compiled program
+        finished = jnp.zeros((B,), bool)
+        buf, _, _ = jax.lax.fori_loop(prompt_len, L, make_step(p, b),
+                                      (buf, finished, key))
+        return buf
+
+    return _T(run(params, buffers, buf0, key0))

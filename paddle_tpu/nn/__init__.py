@@ -33,3 +33,6 @@ from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
 from . import rnn
 from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
                   SimpleRNN, LSTM, GRU)
+from . import decode
+from .decode import (BeamSearchDecoder, dynamic_decode,
+                     top_k_top_p_filtering, sampling_id, greedy_search)

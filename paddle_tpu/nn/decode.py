@@ -1,0 +1,176 @@
+"""Decoding: beam search + dynamic_decode
+(ref python/paddle/fluid/layers/rnn.py:1034 BeamSearchDecoder,
+ :1496 dynamic_decode, paddle/fluid/operators/math/beam_search.h
+ BeamSearchFunctor).
+
+TPU-native redesign: the reference's beam_search op mutates LoD tensors per
+step inside a C++ while-op; here the whole decode is ONE lax.scan with
+dense [batch, beam] state — scores/finished/lengths plus a fixed
+[batch, beam, max_steps] token buffer written at step t (no LoD, no
+dynamic shapes; XLA unrolls nothing). Finished beams are absorbing: only
+<eos> continues them at zero added cost, everything else is masked to -inf
+(the reference's is_finished handling in beam_search_op).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import as_array
+
+_NEG_INF = -1e9
+
+
+def _gather_beams(x, idx, B, K):
+    """x: [B, K, ...] -> x[b, idx[b, k]] (re-rank beams)."""
+    return jax.vmap(lambda xb, ib: xb[ib])(x, idx)
+
+
+class BeamSearchDecoder:
+    """ref fluid/layers/rnn.py BeamSearchDecoder. Wraps an RNN cell (or any
+    callable (inputs, states) -> (cell_out, new_states)) for beam decode.
+
+    embedding_fn maps token ids -> cell inputs; output_fn maps cell output
+    -> vocab logits (defaults to identity, i.e. the cell emits logits)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # tile_beam_merge_with_batch (ref rnn.py:1112): [B, ...] -> [B*K, ...]
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        a = as_array(x)
+        a = jnp.repeat(a[:, None], beam_size, axis=1)
+        return Tensor(a.reshape((-1,) + a.shape[2:]))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Run beam-search decode (ref fluid/layers/rnn.py dynamic_decode).
+
+    inits: initial cell states (pytree of [B, ...] arrays/Tensors).
+    Returns (ids Tensor [B, max_step_num, K], lengths Tensor [B, K]) —
+    beams sorted best-first, padded with end_token after finish."""
+    K = decoder.beam_size
+    eos = decoder.end_token
+    cell = decoder.cell
+    embed = decoder.embedding_fn
+    out_fn = decoder.output_fn
+
+    states0 = jax.tree.map(as_array, inits)
+    B = jax.tree_util.tree_leaves(states0)[0].shape[0]
+
+    # beam-tile cell states: [B, ...] -> [B, K, ...]
+    states0 = jax.tree.map(
+        lambda a: jnp.repeat(a[:, None], K, axis=1), states0)
+
+    # beam 0 live, others dead (standard init so step0 expands one beam)
+    log_probs0 = jnp.full((B, K), _NEG_INF, jnp.float32).at[:, 0].set(0.0)
+    tokens0 = jnp.full((B, K), decoder.start_token, jnp.int32)
+    finished0 = jnp.zeros((B, K), bool)
+    lengths0 = jnp.zeros((B, K), jnp.int32)
+    buf0 = jnp.full((B, K, max_step_num), eos, jnp.int32)
+
+    def call_cell(tok, states):
+        """One cell step over flattened beams."""
+        flat_states = jax.tree.map(
+            lambda a: a.reshape((B * K,) + a.shape[2:]), states)
+        inp = tok.reshape(B * K)
+        if embed is not None:
+            inp = as_array(embed(Tensor(inp)))
+        out, new_states = cell(Tensor(inp), jax.tree.map(Tensor, flat_states))
+        logits = as_array(out_fn(out)) if out_fn is not None else as_array(out)
+        new_states = jax.tree.map(
+            lambda t: as_array(t).reshape((B, K) + as_array(t).shape[1:]),
+            new_states)
+        return logits.reshape(B, K, -1), new_states
+
+    def step(carry, t):
+        log_probs, tokens, finished, lengths, states, buf = carry
+        logits, new_states = call_cell(tokens, states)
+        V = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+        # finished beams: only <eos> continues, at no added cost
+        eos_only = jnp.full((V,), _NEG_INF).at[eos].set(0.0)
+        logp = jnp.where(finished[..., None], eos_only[None, None, :], logp)
+
+        scores = log_probs[..., None] + logp                  # [B, K, V]
+        flat = scores.reshape(B, K * V)
+        top_scores, top_idx = lax.top_k(flat, K)              # [B, K]
+        parent = top_idx // V
+        token = (top_idx % V).astype(jnp.int32)
+
+        new_finished = _gather_beams(finished, parent, B, K) | (token == eos)
+        prev_len = _gather_beams(lengths, parent, B, K)
+        was_fin = _gather_beams(finished, parent, B, K)
+        new_lengths = jnp.where(was_fin, prev_len, prev_len + 1)
+
+        states = jax.tree.map(
+            lambda a: _gather_beams(a, parent, B, K), new_states)
+        buf = _gather_beams(buf, parent, B, K)
+        buf = buf.at[:, :, t].set(jnp.where(was_fin, eos, token))
+
+        return (top_scores, token, new_finished, new_lengths, states,
+                buf), None
+
+    carry0 = (log_probs0, tokens0, finished0, lengths0, states0, buf0)
+    (log_probs, _, finished, lengths, _, buf), _ = lax.scan(
+        step, carry0, jnp.arange(max_step_num))
+
+    # best-first by per-beam score (length-normalised like the reference's
+    # final ranking on finished beams)
+    norm = log_probs / jnp.maximum(lengths, 1).astype(jnp.float32)
+    order = jnp.argsort(-norm, axis=1)
+    buf = _gather_beams(buf, order, B, K)
+    lengths = jnp.take_along_axis(lengths, order, axis=1)
+    return Tensor(jnp.transpose(buf, (0, 2, 1))), Tensor(lengths)
+
+
+# ----------------------------------------------------------------- sampling
+
+def top_k_top_p_filtering(logits, top_k=0, top_p=1.0):
+    """Mask logits outside top-k / nucleus top-p to -inf
+    (ref generation_utils TopKProcess/TopPProcess)."""
+    a = as_array(logits).astype(jnp.float32)
+    if top_k and top_k > 0:
+        kth = lax.top_k(a, min(int(top_k), a.shape[-1]))[0][..., -1:]
+        a = jnp.where(a < kth, _NEG_INF, a)
+    if top_p is not None and top_p < 1.0:
+        sort_idx = jnp.argsort(-a, axis=-1)
+        sorted_a = jnp.take_along_axis(a, sort_idx, axis=-1)
+        probs = jax.nn.softmax(sorted_a, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens with cumulative prob <= p (always keep the best),
+        # then scatter the sorted mask back via the inverse permutation
+        keep_sorted = cum - probs < top_p
+        keep_sorted = keep_sorted.at[..., 0].set(True)
+        inv = jnp.argsort(sort_idx, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        a = jnp.where(keep, a, _NEG_INF)
+    return Tensor(a)
+
+
+def sampling_id(probs, seed=None, key=None):
+    """Sample token ids from probability rows (ref operators/sampling_id_op.cc).
+    """
+    from ..framework import state
+    p = as_array(probs)
+    if key is None:
+        key = (jax.random.PRNGKey(seed) if seed is not None
+               else state.next_rng_key())
+    return Tensor(jax.random.categorical(
+        key, jnp.log(jnp.maximum(p, 1e-30)), axis=-1))
+
+
+def greedy_search(logits):
+    """argmax decode helper."""
+    return Tensor(jnp.argmax(as_array(logits), axis=-1))

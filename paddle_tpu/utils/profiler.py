@@ -126,3 +126,120 @@ def profiler(state="All", sorted_key="total", profile_path=None,
         yield
     finally:
         stop_profiler(sorted_key, profile_path)
+
+
+# --------------------------------------------------------------------------
+# paddle.profiler new-style API (ref python/paddle/profiler/profiler.py:
+# Profiler(targets, scheduler, on_trace_ready) + make_scheduler)
+# --------------------------------------------------------------------------
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"          # accepted alias: the device side is the TPU trace
+    TPU = "tpu"
+    CUSTOM_DEVICE = "custom_device"
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """ref profiler.make_scheduler: step-state machine. Returns
+    fn(step) -> 'closed'|'ready'|'record' (repeat=0 means cycle forever)."""
+    cycle = closed + ready + record
+
+    def schedule(step):
+        if step < skip_first:
+            return "closed"
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return "closed"
+        pos = s % cycle if cycle else 0
+        if pos < closed:
+            return "closed"
+        if pos < closed + ready:
+            return "ready"
+        return "record"
+
+    return schedule
+
+
+class Profiler:
+    """ref python/paddle/profiler/profiler.py Profiler: step-scheduled
+    host + device tracing.
+
+        p = profiler.Profiler(trace_dir="/tmp/trace",
+                              scheduler=make_scheduler(closed=1, ready=1,
+                                                       record=3))
+        p.start()
+        for batch in loader:
+            train_step(batch)
+            p.step()
+        p.stop()                 # host table + XPlane dump for TensorBoard
+    """
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 trace_dir=None, timer_only=False):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        self.scheduler = scheduler or (lambda step: "record")
+        self.on_trace_ready = on_trace_ready
+        self.trace_dir = trace_dir
+        self.timer_only = timer_only
+        self._step = 0
+        self._recording = False
+        self._device_active = False
+
+    def start(self):
+        self._apply_state(self.scheduler(self._step))
+        return self
+
+    def step(self):
+        self._step += 1
+        self._apply_state(self.scheduler(self._step))
+
+    def _apply_state(self, st):
+        global _enabled
+        want_record = st == "record"
+        if want_record and not self._recording:
+            _enabled = True
+            self._recording = True
+            if self.trace_dir and not self.timer_only and \
+                    ProfilerTarget.TPU in self.targets or \
+                    ProfilerTarget.GPU in self.targets:
+                if self.trace_dir and not self._device_active:
+                    import jax
+                    jax.profiler.start_trace(self.trace_dir)
+                    self._device_active = True
+        elif not want_record and self._recording:
+            self._flush()
+
+    def _flush(self):
+        global _enabled
+        _enabled = False
+        self._recording = False
+        if self._device_active:
+            import jax
+            jax.profiler.stop_trace()
+            self._device_active = False
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def stop(self):
+        if self._recording:
+            self._flush()
+
+    def summary(self, sorted_by="total"):
+        return summary(sorted_by)
+
+    def export(self, path, format="json"):
+        return export_chrome_tracing(path)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def export_protobuf(path):
+    """XPlane protobufs are written by jax.profiler into trace_dir; this
+    helper names the convention for API parity (ref profiler export)."""
+    return path

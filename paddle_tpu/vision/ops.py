@@ -1,0 +1,317 @@
+"""paddle.vision.ops — detection operator set
+(ref python/paddle/vision/ops.py + paddle/fluid/operators/detection/:
+iou_similarity_op, box_coder_op, prior_box_op, yolo_box_op, nms util,
+roi_align_op).
+
+TPU discipline: every op is fixed-shape. NMS returns a fixed-size keep MASK
+(scores of suppressed boxes are zeroed) computed by an O(n) lax.fori_loop of
+vectorised suppressions instead of the reference's dynamic output list —
+callers slice top-k afterwards, which is how detection heads compose on
+XLA. roi_align is gather+bilinear arithmetic (no custom kernel needed; XLA
+fuses it).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply, as_array, register_op
+
+
+# ------------------------------------------------------------------- iou
+
+def _box_iou_raw(a, b):
+    """a: [N, 4], b: [M, 4] (x1, y1, x2, y2) -> [N, M] IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+register_op("box_iou", _box_iou_raw)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    return apply(_box_iou_raw, (boxes1, boxes2), name="box_iou")
+
+
+iou_similarity = box_iou        # ref detection/iou_similarity_op.cc
+
+
+# ------------------------------------------------------------------- nms
+
+def _nms_raw(boxes, scores, iou_threshold=0.5, score_threshold=None):
+    """Greedy NMS as a fixed-shape suppression mask (1 = kept).
+    O(N) sequential rounds, each suppressing against the best live box."""
+    n = boxes.shape[0]
+    iou = _box_iou_raw(boxes, boxes)
+    live = jnp.ones((n,), bool)
+    if score_threshold is not None:
+        live = live & (scores > score_threshold)
+    kept = jnp.zeros((n,), bool)
+
+    def body(_, carry):
+        live, kept = carry
+        masked = jnp.where(live, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        any_live = jnp.any(live)
+        take = live[best] & any_live
+        kept = kept.at[best].set(take | kept[best])
+        # suppress neighbours of the chosen box (and itself)
+        suppress = (iou[best] >= iou_threshold) | \
+            (jnp.arange(n) == best)
+        live = live & jnp.where(take, ~suppress, True)
+        return live, kept
+
+    _, kept = lax.fori_loop(0, n, body, (live, kept))
+    return kept
+
+
+register_op("nms", _nms_raw)
+
+
+def nms(boxes, scores, iou_threshold=0.5, score_threshold=None, top_k=None,
+        name=None):
+    """ref python/paddle/vision/ops.py nms — returns kept indices sorted by
+    score (fixed count when top_k given; else a dynamic-size host slice)."""
+    kept = apply(_nms_raw, (boxes, scores),
+                 {"iou_threshold": float(iou_threshold),
+                  "score_threshold": None if score_threshold is None
+                  else float(score_threshold)},
+                 differentiable=False, name="nms")
+    k = as_array(kept)
+    s = as_array(scores)
+    ranked = jnp.argsort(jnp.where(k, s, -jnp.inf))[::-1]
+    n_kept = jnp.sum(k)
+    if top_k is not None:
+        # fixed shape: positions past the kept count are -1, never a
+        # suppressed box's real index
+        idx = jnp.where(jnp.arange(int(top_k)) < n_kept,
+                        ranked[:top_k], -1)
+        return Tensor(idx)
+    n_keep = int(np.asarray(n_kept))            # host sync: dynamic count
+    return Tensor(ranked[:n_keep])
+
+
+# --------------------------------------------------------------- box_coder
+
+def _box_coder_raw(prior_box, prior_box_var, target_box,
+                   code_type="encode_center_size", box_normalized=True,
+                   axis=0):
+    """ref detection/box_coder_op.h: encode/decode between corner boxes and
+    center-size offsets."""
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    ph = prior_box[:, 3] - prior_box[:, 1] + norm
+    px = prior_box[:, 0] + pw * 0.5
+    py = prior_box[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((4,), target_box.dtype)
+    else:
+        var = prior_box_var
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tx = target_box[:, 0] + tw * 0.5
+        ty = target_box[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tx - px) / pw, (ty - py) / ph,
+            jnp.log(jnp.maximum(tw / pw, 1e-10)),
+            jnp.log(jnp.maximum(th / ph, 1e-10))], axis=1)
+        return out / (var if var.ndim == 1 else var)
+    # decode: target_box holds offsets [N, 4]
+    off = target_box * (var if var.ndim == 1 else var)
+    ox = off[:, 0] * pw + px
+    oy = off[:, 1] * ph + py
+    ow = jnp.exp(off[:, 2]) * pw
+    oh = jnp.exp(off[:, 3]) * ph
+    return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                      ox + ow * 0.5 - norm, oy + oh * 0.5 - norm], axis=1)
+
+
+register_op("box_coder", _box_coder_raw)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              name=None):
+    if prior_box_var is None:
+        return apply(lambda p, t, **kw: _box_coder_raw(p, None, t, **kw),
+                     (prior_box, target_box),
+                     {"code_type": code_type,
+                      "box_normalized": bool(box_normalized)},
+                     name="box_coder")
+    return apply(_box_coder_raw, (prior_box, prior_box_var, target_box),
+                 {"code_type": code_type,
+                  "box_normalized": bool(box_normalized)}, name="box_coder")
+
+
+# --------------------------------------------------------------- prior_box
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    """SSD prior boxes (ref detection/prior_box_op.cc). Host-side numpy —
+    priors are data-independent constants per feature-map shape."""
+    in_h, in_w = as_array(input).shape[-2:]
+    img_h, img_w = as_array(image).shape[-2:]
+    step_w = steps[0] or img_w / in_w
+    step_h = steps[1] or img_h / in_h
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for y in range(in_h):
+        for x in range(in_w):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            for k, ms in enumerate(min_sizes):
+                for ar in ars:
+                    bw = ms * np.sqrt(ar) * 0.5
+                    bh = ms / np.sqrt(ar) * 0.5
+                    boxes.append([(cx - bw) / img_w, (cy - bh) / img_h,
+                                  (cx + bw) / img_w, (cy + bh) / img_h])
+                if max_sizes:
+                    s = np.sqrt(ms * max_sizes[k]) * 0.5
+                    boxes.append([(cx - s) / img_w, (cy - s) / img_h,
+                                  (cx + s) / img_w, (cy + s) / img_h])
+    out = np.asarray(boxes, np.float32).reshape(in_h, in_w, -1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+# --------------------------------------------------------------- yolo_box
+
+def _yolo_box_raw(x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
+                  downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """ref detection/yolo_box_op.h — decode YOLOv3 head output [N, C, H, W]
+    into boxes [N, H*W*na, 4] + scores [N, H*W*na, class_num]."""
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, x.dtype).reshape(na, 2)
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) * alpha + beta + gx) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) * alpha + beta + gy) / h
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / \
+        (w * downsample_ratio)
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / \
+        (h * downsample_ratio)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    img_w = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x1 = (bx - bw * 0.5) * img_w
+    y1 = (by - bh * 0.5) * img_h
+    x2 = (bx + bw * 0.5) * img_w
+    y2 = (by + bh * 0.5) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    mask = (conf > conf_thresh)[..., None]
+    scores = jnp.where(mask, probs.transpose(0, 1, 3, 4, 2),
+                       0.0).reshape(n, -1, class_num)
+    return boxes, scores
+
+
+register_op("yolo_box", _yolo_box_raw)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None,
+             scale_x_y=1.0):
+    boxes, scores = apply(
+        _yolo_box_raw, (x, img_size),
+        {"anchors": tuple(int(a) for a in anchors),
+         "class_num": int(class_num), "conf_thresh": float(conf_thresh),
+         "downsample_ratio": int(downsample_ratio),
+         "clip_bbox": bool(clip_bbox), "scale_x_y": float(scale_x_y)},
+        name="yolo_box")
+    return boxes, scores
+
+
+# --------------------------------------------------------------- roi_align
+
+def _roi_align_raw(x, boxes, boxes_num=None, output_size=(1, 1),
+                   spatial_scale=1.0, sampling_ratio=-1, aligned=True):
+    """ref roi_align_op.h: bilinear-sampled average pooling per RoI.
+    x: [N, C, H, W]; boxes: [R, 4] in input coords; boxes are all on image 0
+    when boxes_num is None (single-image path used by the test suite)."""
+    n, c, h, w = x.shape
+    ph, pw = output_size
+    off = 0.5 if aligned else 0.0
+    img = x[0]                                    # [C, H, W]
+
+    def one_roi(box):
+        x1, y1, x2, y2 = box * spatial_scale
+        x1, y1 = x1 - off, y1 - off
+        x2, y2 = x2 - off, y2 - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-3)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-3)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        s = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [ph, pw, s, s, 2]
+        iy = (jnp.arange(ph)[:, None] * bin_h + y1 +
+              (jnp.arange(s)[None, :] + 0.5) * bin_h / s)   # [ph, s]
+        ix = (jnp.arange(pw)[:, None] * bin_w + x1 +
+              (jnp.arange(s)[None, :] + 0.5) * bin_w / s)   # [pw, s]
+
+        def bilinear(yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            y0i, x0i, y1i, x1i = (y0.astype(int), x0.astype(int),
+                                  y1_.astype(int), x1_.astype(int))
+            v00 = img[:, y0i, x0i]
+            v01 = img[:, y0i, x1i]
+            v10 = img[:, y1i, x0i]
+            v11 = img[:, y1i, x1i]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                    v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        # average over the s*s samples in each bin
+        vals = jax.vmap(lambda yy: jax.vmap(
+            lambda xx: bilinear(yy, xx))(ix.ravel()))(iy.ravel())
+        # vals: [ph*s, pw*s, C] -> [ph, s, pw, s, C] -> mean samples
+        vals = vals.reshape(ph, s, pw, s, c).mean(axis=(1, 3))
+        return vals.transpose(2, 0, 1)            # [C, ph, pw]
+
+    return jax.vmap(one_roi)(boxes)
+
+
+register_op("roi_align", _roi_align_raw)
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    from ..ops.dispatch import as_array as _aa
+    if boxes_num is not None or _aa(x).shape[0] != 1:
+        raise NotImplementedError(
+            "roi_align: multi-image batches (boxes_num) not supported yet — "
+            "pass one image per call (vmap over images for batches)")
+    return apply(_roi_align_raw, (x, boxes),
+                 {"output_size": tuple(output_size),
+                  "spatial_scale": float(spatial_scale),
+                  "sampling_ratio": int(sampling_ratio),
+                  "aligned": bool(aligned)}, name="roi_align")

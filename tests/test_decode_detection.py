@@ -1,0 +1,186 @@
+"""Beam search / sampling decode + detection op tail
+(ref fluid/layers/rnn.py BeamSearchDecoder + dynamic_decode,
+operators/math/beam_search.h, vision/ops.py nms/box_coder/yolo_box/
+roi_align, detection/*_op.cc)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.nn import (BeamSearchDecoder, dynamic_decode,
+                           top_k_top_p_filtering, sampling_id)
+from paddle_tpu.vision import ops as V
+
+
+# ------------------------------------------------------------- beam search
+
+class _TableCell:
+    """Deterministic 'LM': next-token logits depend only on current token.
+    Transition matrix rigged so beam search has a known best path."""
+
+    def __init__(self, table):
+        self.table = jnp.asarray(table, jnp.float32)
+
+    def __call__(self, inputs, states):
+        tok = inputs._data.astype(jnp.int32)
+        logits = self.table[tok]
+        return pt.framework.tensor.Tensor(logits), states
+
+
+def test_beam_search_finds_best_path():
+    # vocab {0=eos, 1, 2, 3}; from <start>=1 greedy takes 2, but token 2's
+    # row makes eos relatively very expensive (a strong non-eos competitor
+    # soaks the softmax mass), while 3 -> eos is nearly free: beam search
+    # must prefer the 3 -> eos path.
+    V_ = 5               # 0=eos, 1=start, 2=greedy trap, 3=good, 4=dead end
+    tbl = np.full((V_, V_), -10.0, np.float32)
+    tbl[1, 2] = 2.0      # greedy first step
+    tbl[1, 3] = 1.5      # beam-optimal first step
+    tbl[2, 4] = 5.0      # from 2, eos is ~8 nats behind this competitor...
+    tbl[2, 0] = -3.0
+    # ...and token 4 is a uniform dead end (-log V per further step)
+    tbl[3, 0] = 3.0      # from 3, eos is the easy winner
+    cell = _TableCell(tbl)
+    dec = BeamSearchDecoder(cell, start_token=1, end_token=0, beam_size=3)
+    state0 = {"h": jnp.zeros((2, 1))}           # batch of 2, dummy state
+    ids, lengths = dynamic_decode(dec, inits=state0, max_step_num=4)
+    ids = np.asarray(ids.numpy())               # [B, T, K]
+    assert ids.shape == (2, 4, 3)
+    best = ids[0, :, 0].tolist()
+    assert best[0] == 3 and best[1] == 0, best  # 3 then eos
+    # while plain greedy would have started with 2
+    assert int(np.argmax(tbl[1])) == 2
+
+
+def test_beam_search_eos_absorbing():
+    V_ = 3
+    tbl = np.full((V_, V_), -10.0, np.float32)
+    tbl[1, 0] = 5.0      # immediately prefer eos
+    tbl[1, 2] = 1.0
+    tbl[2, 2] = 1.0
+    cell = _TableCell(tbl)
+    dec = BeamSearchDecoder(cell, start_token=1, end_token=0, beam_size=2)
+    ids, lengths = dynamic_decode(dec, inits={"h": jnp.zeros((1, 1))},
+                                  max_step_num=5)
+    ids = np.asarray(ids.numpy())
+    lengths = np.asarray(lengths.numpy())
+    assert ids[0, 0, 0] == 0                    # best beam ends at once
+    assert lengths[0, 0] == 1
+    assert (ids[0, 1:, 0] == 0).all()           # padded with eos after
+
+
+# --------------------------------------------------------------- sampling
+
+def test_top_k_top_p_filtering():
+    logits = pt.to_tensor(np.log(np.asarray(
+        [[0.5, 0.3, 0.15, 0.05]], np.float32)))
+    k2 = top_k_top_p_filtering(logits, top_k=2).numpy()
+    assert np.isfinite(k2[0, :2]).all()
+    assert (k2[0, 2:] < -1e8).all()
+    p = top_k_top_p_filtering(logits, top_p=0.7).numpy()
+    assert np.isfinite(p[0, :2]).all()          # 0.5 + 0.3 cover 0.7
+    assert (p[0, 2:] < -1e8).all()
+
+
+def test_sampling_id_distribution():
+    probs = pt.to_tensor(np.asarray([[0.0, 0.0, 1.0]] * 8, np.float32))
+    ids = sampling_id(probs, seed=0).numpy()
+    assert (ids == 2).all()
+
+
+# --------------------------------------------------------------- detection
+
+def test_box_iou():
+    a = pt.to_tensor(np.asarray([[0, 0, 2, 2]], np.float32))
+    b = pt.to_tensor(np.asarray([[1, 1, 3, 3], [0, 0, 2, 2],
+                                 [5, 5, 6, 6]], np.float32))
+    iou = V.box_iou(a, b).numpy()
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-6)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = pt.to_tensor(np.asarray([
+        [0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+        [0.5, 0.5, 10.5, 10.5]], np.float32))
+    scores = pt.to_tensor(np.asarray([0.9, 0.8, 0.7, 0.95], np.float32))
+    keep = V.nms(boxes, scores, iou_threshold=0.5).numpy()
+    # box 3 (0.95) kills 0 and 1; box 2 survives
+    assert sorted(keep.tolist()) == [2, 3]
+    keep2 = V.nms(boxes, scores, iou_threshold=0.5, top_k=1).numpy()
+    assert keep2.tolist() == [3]
+
+
+def test_box_coder_roundtrip():
+    priors = pt.to_tensor(np.asarray([[0, 0, 10, 10], [5, 5, 20, 30]],
+                                     np.float32))
+    gt = np.asarray([[1, 2, 9, 12], [4, 6, 22, 28]], np.float32)
+    enc = V.box_coder(priors, None, pt.to_tensor(gt),
+                      code_type="encode_center_size").numpy()
+    dec = V.box_coder(priors, None, pt.to_tensor(enc),
+                      code_type="decode_center_size").numpy()
+    np.testing.assert_allclose(dec, gt, rtol=1e-4, atol=1e-4)
+
+
+def test_prior_box_shapes():
+    feat = pt.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = pt.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    boxes, var = V.prior_box(feat, img, min_sizes=[16.0], max_sizes=[32.0],
+                             aspect_ratios=[1.0, 2.0], flip=True, clip=True)
+    assert boxes.shape == [4, 4, 4, 4]          # 1 + 1(max) + 2 extra ars
+    assert var.shape == boxes.shape
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_yolo_box_shapes_and_range():
+    n, na, cls, h, w = 1, 2, 3, 4, 4
+    x = pt.to_tensor(np.random.RandomState(0).randn(
+        n, na * (5 + cls), h, w).astype("f4"))
+    img_size = pt.to_tensor(np.asarray([[64, 64]], np.int32))
+    boxes, scores = V.yolo_box(x, img_size, anchors=[10, 13, 16, 30],
+                               class_num=cls, conf_thresh=0.0)
+    assert boxes.shape == [n, h * w * na, 4]
+    assert scores.shape == [n, h * w * na, cls]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 64).all()
+
+
+def test_roi_align_constant_map():
+    # constant feature map: every RoI pools to the constant
+    x = pt.to_tensor(np.full((1, 2, 8, 8), 3.0, np.float32))
+    rois = pt.to_tensor(np.asarray([[1, 1, 5, 5], [0, 0, 7, 7]], np.float32))
+    out = V.roi_align(x, rois, output_size=2, spatial_scale=1.0)
+    assert out.shape == [2, 2, 2, 2]
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+
+# ----------------------------------------------------------- gpt_generate
+
+def test_gpt_generate_greedy_and_sampled():
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining, gpt_generate
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)
+    model = GPTForPretraining(cfg)
+    prompt = pt.to_tensor(np.asarray([[5, 7, 9], [3, 2, 1]], np.int32))
+    out = gpt_generate(model, prompt, max_new_tokens=5)
+    assert out.shape == [2, 8]
+    assert (out.numpy()[:, :3] == prompt.numpy()).all()   # prompt kept
+    # greedy is deterministic
+    out2 = gpt_generate(model, prompt, max_new_tokens=5)
+    assert (out.numpy() == out2.numpy()).all()
+    # causal exactness: growing the prompt with greedy's own output keeps
+    # the continuation identical (recompute-full-prefix correctness)
+    out3 = gpt_generate(model, pt.to_tensor(out.numpy()[:, :4]),
+                        max_new_tokens=4)
+    assert (out3.numpy() == out.numpy()).all()
+    # sampling draws valid ids and differs across seeds (usually)
+    s1 = gpt_generate(model, prompt, max_new_tokens=5, do_sample=True,
+                      top_k=10, seed=0).numpy()
+    s2 = gpt_generate(model, prompt, max_new_tokens=5, do_sample=True,
+                      top_k=10, seed=1).numpy()
+    assert ((0 <= s1) & (s1 < 64)).all()
+    assert not (s1 == s2).all()

@@ -1,0 +1,63 @@
+"""Profiler: host events, chrome trace export, device XPlane bridge, and
+the step-scheduled new-style Profiler (ref platform/profiler.h RecordEvent,
+python/paddle/profiler/profiler.py)."""
+import glob
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.utils import profiler as prof
+
+
+def test_record_event_and_summary(capsys):
+    prof.start_profiler()
+    with prof.RecordEvent("fwd"):
+        pt.to_tensor(np.ones(4)).sum()
+    with prof.RecordEvent("fwd"):
+        pass
+    rows = prof.stop_profiler()
+    names = {r["name"]: r for r in rows}
+    assert names["fwd"]["calls"] == 2
+
+
+def test_chrome_trace_export(tmp_path):
+    prof.start_profiler()
+    with prof.RecordEvent("step"):
+        pass
+    path = str(tmp_path / "trace.json")
+    prof.stop_profiler(profile_path=path)
+    trace = json.load(open(path))
+    assert any(e["name"] == "step" for e in trace["traceEvents"])
+
+
+def test_device_trace_writes_xplane(tmp_path):
+    """trace_dir engages jax.profiler: the dump dir must contain XPlane
+    artifacts TensorBoard can open (the device_tracer.cc analog)."""
+    import jax
+    d = str(tmp_path / "tb")
+    prof.start_profiler(trace_dir=d)
+    x = pt.to_tensor(np.random.randn(64, 64).astype("f4"))
+    (x @ x).numpy()
+    prof.stop_profiler()
+    dumped = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in dumped), dumped
+
+
+def test_new_style_profiler_scheduler(tmp_path):
+    sched = prof.make_scheduler(closed=1, ready=0, record=2, repeat=1)
+    assert [sched(i) for i in range(4)] == \
+        ["closed", "record", "record", "closed"]
+    events = []
+    p = prof.Profiler(scheduler=sched,
+                      on_trace_ready=lambda pp: events.append(pp._step))
+    p.start()
+    for i in range(4):
+        with prof.RecordEvent("tick"):
+            pass
+        p.step()
+    p.stop()
+    assert events == [3]          # flushed when leaving 'record'
+    rows = p.summary()
+    assert any(r["name"] == "tick" for r in rows)
