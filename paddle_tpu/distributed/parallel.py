@@ -32,13 +32,40 @@ class DataParallel(Layer):
         return self._layers(*inputs, **kwargs)
 
     def scale_loss(self, loss):
-        """ref parallel.py:506 — grads are psum-averaged by the compiled step;
-        no pre-scaling needed."""
+        """ref parallel.py:506 — the eager multi-process path averages in
+        apply_collective_grads; the compiled GSPMD path psum-averages in the
+        partitioned program. Either way no pre-scaling here."""
         return loss
 
     def apply_collective_grads(self):
-        """ref parallel.py:515 — XLA inserts gradient AllReduce; no-op."""
-        pass
+        """ref parallel.py:515 + imperative/reducer.cc. Under one compiled
+        step, XLA inserts the gradient AllReduce (no-op here). In EAGER
+        multi-process mode (jax.distributed initialised by
+        init_parallel_env / the launcher), this is a real cross-process
+        gradient mean over the coordination service — the dygraph Reducer's
+        allreduce, batched into one fused collective per call."""
+        try:
+            nproc = jax.process_count()
+        except (RuntimeError, ValueError):
+            nproc = 1
+        if nproc <= 1:
+            return
+        from jax.experimental import multihost_utils
+        import jax.numpy as jnp
+        params = [p for _, p in self._layers.named_parameters()
+                  if p.grad is not None and not p.stop_gradient]
+        if not params:
+            return
+        # one fused collective for the whole bucket (reducer.cc's bucketed
+        # allreduce): gather each grad across processes, mean over them
+        import numpy as np
+        grads = [p.grad._data for p in params]
+        gathered = multihost_utils.process_allgather(tuple(grads))
+        for p, g in zip(params, gathered):
+            # back to a plain local array: the gather result is a global
+            # (process-spanning) Array that local eager ops can't consume
+            local = np.asarray(jax.device_get(g)).mean(axis=0)
+            p.grad._data = jnp.asarray(local)
 
     # delegate module surface to the wrapped layer
     def state_dict(self, *args, **kwargs):

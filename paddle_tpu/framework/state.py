@@ -38,9 +38,14 @@ class Place:
         return hash((self.kind, self.device_id))
 
     def jax_device(self):
-        devs = [d for d in jax.devices() if _platform_of(d) == self.kind]
+        # local_devices: in a multi-process job "device 0" must mean THIS
+        # process's first device — global jax.devices()[0] belongs to rank 0
+        # and is not addressable from other ranks
+        devs = [d for d in jax.local_devices()
+                if _platform_of(d) == self.kind]
         if not devs:  # fall back to host
-            devs = jax.devices("cpu")
+            devs = [d for d in jax.local_devices()
+                    if _platform_of(d) == "cpu"] or jax.devices("cpu")
         return devs[min(self.device_id, len(devs) - 1)]
 
     def is_cpu_place(self):
@@ -80,7 +85,7 @@ _state = _GlobalState()
 
 
 def _detect_default_place():
-    for d in jax.devices():
+    for d in jax.local_devices():
         if _platform_of(d) != "cpu":
             return Place(_platform_of(d), 0)
     return Place("cpu", 0)
@@ -133,9 +138,11 @@ def get_default_dtype():
 
 
 def host_device():
-    """The host CPU jax device — cheap bookkeeping (PRNG splits, init) runs
-    here; on tunneled TPUs every eager dispatch is a network round-trip."""
-    return jax.devices("cpu")[0]
+    """THIS process's host CPU jax device — cheap bookkeeping (PRNG splits,
+    init) runs here; on tunneled TPUs every eager dispatch is a network
+    round-trip. local_devices, not devices: in a multi-process job the
+    global cpu[0] belongs to rank 0 and is unaddressable elsewhere."""
+    return jax.local_devices(backend="cpu")[0]
 
 
 class Generator:
