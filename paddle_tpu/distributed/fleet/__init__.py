@@ -10,9 +10,15 @@ from .base import (init, is_first_worker, worker_index, worker_num,
                    PaddleCloudRoleMaker, UtilBase, fleet, build_train_step)
 
 
+from .trainers import MultiTrainer, DistMultiTrainer
+
+
 def __getattr__(name):
     # native PS runtime loads (and builds) the C++ library on first use
     if name in ("PsServer", "PsClient", "AsyncPSTrainer", "GeoPSTrainer"):
         from . import ps
         return getattr(ps, name)
+    if name == "TheOnePSRuntime":
+        from .runtime import TheOnePSRuntime
+        return TheOnePSRuntime
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

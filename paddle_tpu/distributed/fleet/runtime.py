@@ -1,0 +1,141 @@
+"""TheOnePSRuntime analog — DistributedStrategy -> parameter-server runtime
+(ref python/paddle/distributed/fleet/runtime/the_one_ps.py TheOnePSRuntime:
+strategy + role -> table configs -> server/worker bring-up).
+
+What the reference does with proto table configs and brpc services, this
+does directly against the native PS (native/src/ps_server.cc): the runtime
+reads the strategy (a_sync / a_sync_configs / geo k_steps), derives the
+table layout from the model's parameters (one dense table for the dense
+pack, one sparse table per Embedding-like param), starts the server role
+in-process, and hands workers a ready trainer (AsyncPSTrainer /
+GeoPSTrainer) wired with registration + heartbeats."""
+import numpy as np
+
+from . import ps as ps_mod
+
+
+class PSTableConfig:
+    def __init__(self, table_id, kind, shape=None, dim=None, lr=0.1,
+                 init_scale=0.01, name=""):
+        self.table_id = table_id
+        self.kind = kind            # "dense" | "sparse"
+        self.shape = shape
+        self.dim = dim
+        self.lr = lr
+        self.init_scale = init_scale
+        self.name = name
+
+    def __repr__(self):
+        return (f"PSTableConfig({self.table_id}, {self.kind}, "
+                f"name={self.name!r})")
+
+
+def plan_tables(params, sparse_names=(), lr=0.1, emb_dim=None,
+                init_scale=0.01):
+    """Derive the table layout (ref the_one_ps.py _get_tables): params whose
+    name matches `sparse_names` (or that look like embedding rows) become
+    sparse tables; everything else packs into dense table 0."""
+    dense, sparse = {}, []
+    tid = 1
+    configs = []
+    for n, a in params.items():
+        if n in sparse_names:
+            arr = np.asarray(a)
+            configs.append(PSTableConfig(tid, "sparse", dim=arr.shape[-1],
+                                         lr=lr, init_scale=init_scale,
+                                         name=n))
+            tid += 1
+        else:
+            dense[n] = a
+    total = int(sum(np.asarray(a).size for a in dense.values()))
+    configs.insert(0, PSTableConfig(0, "dense", shape=(total,), lr=lr,
+                                    name="dense_pack"))
+    return configs, dense
+
+
+class TheOnePSRuntime:
+    """strategy + role -> running PS job half (server or worker).
+
+    Usage (mirrors the reference's fleet.init + runtime._init_server/worker):
+
+        runtime = TheOnePSRuntime(strategy, role="server"|"worker",
+                                  endpoints=["127.0.0.1:0"])
+        server = runtime.init_server(params, sparse_names=[...])  # blocks? no
+        trainer = runtime.init_worker(loss_fn, params, worker_id=w, port=p)
+    """
+
+    def __init__(self, strategy=None, role="worker", lr=0.1,
+                 heartbeat_timeout_s=10.0):
+        self.strategy = strategy
+        self.role = role
+        self.lr = lr
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.geo_k = 0
+        self.mode = "sync"
+        if strategy is not None and getattr(strategy, "a_sync", False):
+            cfg = getattr(strategy, "a_sync_configs", {}) or {}
+            k = int(cfg.get("k_steps", 0) or 0)
+            if k > 0:
+                self.mode = "geo"
+                self.geo_k = k
+            else:
+                self.mode = "async"
+        self.server = None
+        self.tables = None
+
+    # ---------------------------------------------------------------- server
+    def init_server(self, params, sparse_names=(), port=0, emb_dim=None,
+                    init_scale=0.01):
+        """Start the native server with tables derived from `params`.
+        Returns (server, port)."""
+        configs, dense = plan_tables(params, sparse_names, lr=self.lr,
+                                     init_scale=init_scale)
+        self.tables = configs
+        srv = ps_mod.PsServer()
+        for c in configs:
+            if c.kind == "dense":
+                srv.add_dense_table(c.table_id, int(np.prod(c.shape)),
+                                    lr=c.lr)
+            else:
+                srv.add_sparse_table(c.table_id, c.dim, lr=c.lr,
+                                     init_scale=c.init_scale)
+        bound = srv.start(port)
+        srv.set_heartbeat_timeout(self.heartbeat_timeout_s)
+        self.server = srv
+        return srv, bound
+
+    # ---------------------------------------------------------------- worker
+    def init_worker(self, loss_fn, params_template, worker_id, host="127.0.0.1",
+                    port=None, emb_dim=8, init_dense=None):
+        """Connect a worker: registers for liveness, starts its beat thread,
+        and returns the trainer the strategy implies (async -> Hogwild,
+        geo -> k-step delta pushing). The returned trainer grows
+        `.stop_heartbeat()` and `.finish()` for clean teardown."""
+        client = ps_mod.PsClient(host=host, port=port)
+        cancel = client.start_heartbeat(worker_id,
+                                        interval_s=min(
+                                            1.0,
+                                            self.heartbeat_timeout_s / 4))
+        if init_dense is None:
+            init_dense = worker_id == 0
+        if self.mode == "geo":
+            trainer = ps_mod.GeoPSTrainer(loss_fn, params_template, client,
+                                          k_steps=self.geo_k, lr=self.lr,
+                                          init_dense=init_dense)
+        else:
+            trainer = ps_mod.AsyncPSTrainer(loss_fn, params_template, client,
+                                            emb_dim=emb_dim,
+                                            init_dense=init_dense)
+        trainer.worker_id = worker_id
+        trainer.stop_heartbeat = cancel
+
+        def finish():
+            cancel()
+            client.complete_worker(worker_id)
+        trainer.finish = finish
+        return trainer
+
+    def stop(self):
+        if self.server is not None:
+            self.server.stop()
+            self.server = None

@@ -1,0 +1,156 @@
+"""Trainer/worker family (ref paddle/fluid/framework/multi_trainer.cc
+MultiTrainer + hogwild_worker.cc, dist_multi_trainer.cc DistMultiTrainer,
+trainer_factory.py).
+
+TPU-native redesign: the reference runs N CPU threads each interpreting the
+program over its own data-feed channel (Hogwild on shared host params). On
+TPU the device executes one compiled step at a time, so thread-parallelism
+belongs to the HOST side of the pipeline: MultiTrainer runs N feed threads
+that pull+collate batches from the dataset (the DataFeed channel analog)
+into a bounded queue, while one consumer drives the compiled train step —
+host preprocessing overlaps device compute, which is what the reference's
+thread pool actually buys on its hardware. DistMultiTrainer composes the
+same pump with PS workers (each feed thread owns an Async/Geo PS trainer —
+that IS Hogwild, server-side)."""
+import queue
+import threading
+
+import numpy as np
+
+
+class MultiTrainer:
+    """N feed threads -> bounded batch queue -> one step consumer
+    (ref multi_trainer.cc run + trainer_desc thread_num).
+
+    train_fn(*batch_arrays) -> loss-like (host float or array).
+    dataset: iterable of batches (io.DatasetBase / DataLoader / generator
+    factory called per epoch).
+    """
+
+    def __init__(self, train_fn, num_threads=2, queue_depth=8):
+        self.train_fn = train_fn
+        self.num_threads = max(1, int(num_threads))
+        self.queue_depth = queue_depth
+
+    def train_from_dataset(self, dataset, epochs=1):
+        """Returns per-epoch mean losses. Feed threads shard the dataset
+        round-robin (channel semantics); the consumer drains in arrival
+        order (Hogwild: no ordering guarantee, like the reference).
+
+        dataset may be a list, a re-iterable, a one-shot iterator (drained
+        once, reused across epochs), or a zero-arg factory called per
+        epoch."""
+        losses = []
+        materialized = None
+        for _ in range(epochs):
+            if callable(dataset):
+                batches = list(dataset())
+            else:
+                if materialized is None:
+                    materialized = list(dataset)
+                batches = materialized
+            if not batches:
+                raise ValueError("MultiTrainer: dataset produced no batches")
+            losses.append(self._one_epoch(batches))
+        return losses
+
+    def _one_epoch(self, batches):
+        q = queue.Queue(maxsize=self.queue_depth)
+        n = self.num_threads
+        done = object()
+        cancel = threading.Event()
+        errors = []
+
+        def feeder(tid):
+            try:
+                for b in batches[tid::n]:
+                    while not cancel.is_set():
+                        try:
+                            q.put(tuple(np.asarray(a) for a in b),
+                                  timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if cancel.is_set():
+                        return
+            except BaseException as e:    # surfaced by the consumer
+                errors.append(e)
+            finally:
+                # the done marker must arrive unless the epoch was cancelled
+                # (a dropped marker deadlocks the consumer)
+                while not cancel.is_set():
+                    try:
+                        q.put(done, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+
+        threads = [threading.Thread(target=feeder, args=(t,), daemon=True)
+                   for t in range(n)]
+        for t in threads:
+            t.start()
+        total, count, finished = 0.0, 0, 0
+        try:
+            while finished < n:
+                item = q.get()
+                if item is done:
+                    finished += 1
+                    continue
+                out = self.train_fn(*item)
+                total += float(np.asarray(out).ravel()[0]) \
+                    if out is not None else 0.0
+                count += 1
+        finally:
+            # unblock any feeder parked on a full queue before propagating
+            cancel.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            for t in threads:
+                t.join(timeout=10)
+        if errors:
+            raise errors[0]
+        return total / max(count, 1)
+
+
+class DistMultiTrainer:
+    """PS-mode thread family (ref dist_multi_trainer.cc + downpour_worker):
+    each thread owns a PS trainer (Hogwild against the server's tables) and
+    a shard of the dataset."""
+
+    def __init__(self, make_worker, num_threads=2):
+        """make_worker(thread_id) -> object with .step(*batch)."""
+        self.make_worker = make_worker
+        self.num_threads = max(1, int(num_threads))
+
+    def train_from_dataset(self, dataset, epochs=1):
+        batches = list(dataset)
+        n = self.num_threads
+        results = [None] * n
+        errors = []
+
+        def run(tid):
+            try:
+                worker = self.make_worker(tid)
+                losses = []
+                for _ in range(epochs):
+                    for b in batches[tid::n]:
+                        losses.append(worker.step(*b))
+                if hasattr(worker, "finish"):
+                    worker.finish()
+                results[tid] = losses
+            except BaseException as e:   # re-raised in the caller
+                errors.append((tid, e))
+
+        threads = [threading.Thread(target=run, args=(t,)) for t in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            tid, e = errors[0]
+            raise RuntimeError(
+                f"DistMultiTrainer worker thread {tid} failed") from e
+        return results

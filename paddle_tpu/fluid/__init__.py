@@ -1,0 +1,57 @@
+"""paddle.fluid compatibility surface (ref python/paddle/fluid/__init__.py).
+
+The reference keeps a large legacy `fluid.*` namespace that 1.x model code
+imports; 2.x code should use the top-level API. This package maps that
+legacy surface onto the modern implementations — real behavior, legacy
+spelling. Coverage follows what 1.x model zoos actually use: layers.*
+builders, dygraph guard/to_variable, executor/program plumbing, and the
+data feeders."""
+import contextlib
+
+import numpy as np
+
+from ..framework import state as _state
+from ..framework.tensor import Tensor
+from ..static import (Program, program_guard, default_main_program,
+                      default_startup_program, Executor, global_scope,
+                      cpu_places, cuda_places, data as _data)
+from ..framework.state import CPUPlace, CUDAPlace, TPUPlace
+from .. import optimizer as _opt
+from . import layers
+from . import dygraph
+from . import io
+
+__all__ = ["layers", "dygraph", "io", "Program", "program_guard",
+           "default_main_program", "default_startup_program", "Executor",
+           "global_scope", "CPUPlace", "CUDAPlace", "TPUPlace",
+           "ParamAttr", "optimizer", "initializer", "regularizer",
+           "core"]
+
+from ..nn.param_attr import ParamAttr
+from ..nn import initializer
+from .. import regularizer
+optimizer = _opt
+
+
+class core:
+    """fluid.core shim: the C++ binding namespace. Places + scope only —
+    kernels/ops are the JAX registry."""
+    CPUPlace = CPUPlace
+    CUDAPlace = CUDAPlace
+
+    @staticmethod
+    def get_cuda_device_count():
+        import jax
+        try:
+            return len([d for d in jax.local_devices()
+                        if d.platform != "cpu"])
+        except RuntimeError:
+            return 0
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def release_memory(*a, **k):
+    pass

@@ -1,0 +1,45 @@
+"""fluid.dygraph legacy namespace (ref python/paddle/fluid/dygraph/):
+guard/to_variable plus the Layer aliases 1.x dygraph code imports."""
+import contextlib
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import (Linear, Conv2D, BatchNorm, Embedding, LayerList,
+                  Sequential)
+from ..framework import state as _state
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """ref dygraph/base.py guard — dygraph is this framework's default mode,
+    so the guard only scopes an optional place override."""
+    if place is not None:
+        from ..framework.state import set_device
+        prev = _state.get_place()
+        set_device("cpu" if place.is_cpu_place() else "tpu")
+        try:
+            yield
+        finally:
+            _state._current_place = prev
+        return
+    yield
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """ref dygraph/base.py to_variable."""
+    return Tensor(np.asarray(value), dtype=dtype, name=name)
+
+
+def enabled():
+    return True
+
+
+no_grad = _state.no_grad_ctx
+
+def __getattr__(name):
+    from .. import nn
+    if hasattr(nn, name):
+        return getattr(nn, name)
+    raise AttributeError(f"module 'fluid.dygraph' has no attribute {name!r}")

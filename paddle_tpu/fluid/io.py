@@ -1,0 +1,63 @@
+"""fluid.io legacy persistence + feeding (ref python/paddle/fluid/io.py):
+save/load_params over the Program's persistables, DataFeeder."""
+import os
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..static import default_main_program
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """ref io.py save_params: persistables -> one npz (filename) or one
+    file per var."""
+    prog = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {n: np.asarray(t._data) for n, t in prog._persist.items()}
+    if filename:
+        if not filename.endswith(".npz"):
+            filename += ".npz"      # np.savez appends it; keep both sides agreed
+        np.savez(os.path.join(dirname, filename), **arrays)
+    else:
+        for n, a in arrays.items():
+            np.save(os.path.join(dirname, n.replace("/", "_") + ".npy"), a)
+
+
+save_persistables = save_params
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    prog = main_program or default_main_program()
+    if filename:
+        if not filename.endswith(".npz"):
+            filename += ".npz"
+        data = np.load(os.path.join(dirname, filename))
+        items = {n: data[n] for n in data.files}
+    else:
+        items = {}
+        for n in prog._persist:
+            p = os.path.join(dirname, n.replace("/", "_") + ".npy")
+            if os.path.exists(p):
+                items[n] = np.load(p)
+    import jax.numpy as jnp
+    for n, a in items.items():
+        if n in prog._persist:
+            prog._persist[n]._data = jnp.asarray(a)
+
+
+load_persistables = load_params
+
+
+class DataFeeder:
+    """ref fluid/data_feeder.py DataFeeder: rows of python data -> the feed
+    dict the Executor consumes."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self.names = [f if isinstance(f, str) else f.name for f in feed_list]
+
+    def feed(self, iterable):
+        cols = list(zip(*iterable))
+        out = {}
+        for name, col in zip(self.names, cols):
+            out[name] = np.asarray(col)
+        return out

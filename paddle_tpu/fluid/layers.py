@@ -1,0 +1,283 @@
+"""fluid.layers legacy builder surface (ref python/paddle/fluid/layers/nn.py
+et al.) mapped onto the modern functional/op implementations.
+
+These are the builders 1.x model code calls under program_guard (or eagerly
+in dygraph guard). Weight-carrying builders (fc, conv2d, ...) create their
+parameters on first call through a module-level cache keyed by `name` —
+the legacy unique-name parameter model, where the *program* owns weights
+rather than a Layer object (ref framework.py unique_name + create_parameter).
+Call `reset_parameters()` between independent programs/tests."""
+import numpy as np
+
+from ..framework.tensor import Tensor, Parameter
+from ..framework import state as _state
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..ops import math as M
+from ..ops import manipulation as MA
+from ..ops import creation as C
+from ..ops import logic as L
+from ..static import control_flow as _cf
+
+_PARAMS = {}          # name -> Parameter (legacy program-owned weights)
+_counter = {}
+
+
+def reset_parameters():
+    _PARAMS.clear()
+    _counter.clear()
+
+
+def _uname(prefix):
+    n = _counter.get(prefix, 0)
+    _counter[prefix] = n + 1
+    return f"{prefix}_{n}"
+
+
+def _get_param(name, shape, initializer, attr=None):
+    if attr is not None and getattr(attr, "name", None):
+        name = attr.name
+    p = _PARAMS.get(name)
+    if p is None:
+        init = initializer
+        if attr is not None and getattr(attr, "initializer", None) is not None:
+            init = attr.initializer
+        p = Parameter(init(shape, "float32"), name=name)
+        if attr is not None and getattr(attr, "regularizer", None) is not None:
+            p.regularizer = attr.regularizer
+        _PARAMS[name] = p
+    return p
+
+
+# ------------------------------------------------------------ data/feeding
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
+    """ref fluid/layers/io.py data: legacy prepends the batch dim."""
+    from ..static import data as _sdata
+    if append_batch_size:
+        shape = [None] + list(shape)
+    return _sdata(name, shape, dtype)
+
+
+def assign(input, output=None):
+    a = input._data if isinstance(input, Tensor) else np.asarray(input)
+    t = Tensor(a)
+    if output is not None:
+        output._data = t._data
+        return output
+    return t
+
+
+def fill_constant(shape, dtype, value, name=None):
+    return C.full(shape, value, dtype=dtype)
+
+
+# ---------------------------------------------------------------- builders
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """ref layers/nn.py fc."""
+    x = input
+    shp = x.shape
+    in_dim = int(np.prod(shp[num_flatten_dims:]))
+    if len(shp) > num_flatten_dims + 1:
+        # -1 on the leading dims: the capture-time placeholder batch (1)
+        # must not be baked into the recorded reshape
+        x = MA.reshape(x, [-1, in_dim])
+    name = name or _uname("fc")
+    w = _get_param(name + ".w_0", (in_dim, size),
+                   I.XavierNormal(), param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _get_param(name + ".b_0", (size,), I.Constant(0.0), bias_attr)
+    out = F.linear(x, w, b)
+    return getattr(F, act)(out) if act else out
+
+
+def embedding(input, size, is_sparse=False, param_attr=None, dtype="float32",
+              padding_idx=None, name=None):
+    name = name or _uname("embedding")
+    w = _get_param(name + ".w_0", tuple(size), I.Normal(0.0, 0.02),
+                   param_attr)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    name = name or _uname("conv2d")
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = input.shape[1]
+    w = _get_param(name + ".w_0", (num_filters, cin // groups, *ks),
+                   I.XavierNormal(), param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _get_param(name + ".b_0", (num_filters,), I.Constant(0.0),
+                       bias_attr)
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    return getattr(F, act)(out) if act else out
+
+
+def pool2d(input, pool_size=2, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    if global_pooling:
+        return F.adaptive_avg_pool2d(input, 1) if pool_type == "avg" \
+            else F.adaptive_max_pool2d(input, 1)
+    if pool_type == "avg":
+        return F.avg_pool2d(input, pool_size, stride=pool_stride,
+                            padding=pool_padding)
+    return F.max_pool2d(input, pool_size, stride=pool_stride,
+                        padding=pool_padding)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, name=None):
+    name = name or _uname("batch_norm")
+    c = input.shape[1]
+    w = _get_param(name + ".w_0", (c,), I.Constant(1.0), param_attr)
+    b = _get_param(name + ".b_0", (c,), I.Constant(0.0), bias_attr)
+    rm = _PARAMS.get(name + ".mean")
+    if rm is None:
+        rm = Tensor(np.zeros(c, "f4"), name=name + ".mean")
+        rv = Tensor(np.ones(c, "f4"), name=name + ".var")
+        rm.persistable = rv.persistable = True
+        rm.stop_gradient = rv.stop_gradient = True
+        _PARAMS[name + ".mean"] = rm
+        _PARAMS[name + ".var"] = rv
+    rv = _PARAMS[name + ".var"]
+    out = F.batch_norm(input, rm, rv, w, b, training=not is_test,
+                       momentum=momentum, epsilon=epsilon)
+    return getattr(F, act)(out) if act else out
+
+
+def dropout(x, dropout_prob, is_test=False, name=None):
+    return F.dropout(x, p=dropout_prob, training=not is_test)
+
+
+def relu(x, name=None):
+    return F.relu(x)
+
+
+def softmax(input, axis=-1, name=None):
+    return F.softmax(input, axis=axis)
+
+
+def sigmoid(x, name=None):
+    return F.sigmoid(x)
+
+
+def tanh(x, name=None):
+    return F.tanh(x)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """legacy: input is post-softmax probs."""
+    return F.cross_entropy(input, label, soft_label=soft_label,
+                           ignore_index=ignore_index, use_softmax=False,
+                           reduction="none")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1):
+    return F.cross_entropy(logits, label, soft_label=soft_label, axis=axis,
+                           reduction="none")
+
+
+def mean(x, name=None):
+    return M.mean(x)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return M.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return M.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return M.max(input, axis=dim, keepdim=keep_dim)
+
+
+def concat(input, axis=0, name=None):
+    return MA.concat(input, axis=axis)
+
+
+def reshape(x, shape, name=None):
+    return MA.reshape(x, shape)
+
+
+def transpose(x, perm, name=None):
+    return MA.transpose(x, perm)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    out = M.add(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return M.subtract(x, y)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return M.multiply(x, y)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return M.divide(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    out = M.matmul(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if alpha != 1.0:
+        out = M.multiply(out, Tensor(np.float32(alpha)))
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    xs = x.shape
+    x2 = MA.reshape(x, [-1, int(np.prod(xs[x_num_col_dims:]))])
+    return M.matmul(x2, y)
+
+
+def accuracy(input, label, k=1):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def cast(x, dtype):
+    return MA.cast(x, dtype)
+
+
+def argmax(x, axis=0):
+    return M.argmax(x, axis=axis)
+
+
+def one_hot(input, depth):
+    return F.one_hot(input, depth)
+
+
+def topk(input, k=1, name=None):
+    from ..ops.math import topk as _topk
+    return _topk(input, k=k)
+
+
+# control flow (legacy names; ref layers/control_flow.py)
+cond = _cf.cond
+while_loop = _cf.while_loop
+case = _cf.case
+switch_case = _cf.switch_case
+array_write = _cf.array_write
+array_read = _cf.array_read
+create_array = _cf.create_array
+
+
+def increment(x, value=1.0, in_place=True):
+    return _cf.increment(x, value=value)
+
+
+def sequence_pool(input, pool_type="sum"):
+    from ..ops import sequence as S
+    lengths = Tensor(np.asarray([input.shape[1]] * input.shape[0], "i4"))
+    return S.sequence_pool(input, lengths, pool_type=pool_type)

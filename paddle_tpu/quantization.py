@@ -122,3 +122,59 @@ class PostTrainingQuantization:
             for h in hooks:
                 h.remove()
         return self.scales
+
+
+# ---------------------------------------------------------------------------
+# inference-side conversion (ref slim/quantization quant2_int8 convert pass:
+# the trained/calibrated model's weights become int8 + scales; activations
+# dequantize on the fly)
+# ---------------------------------------------------------------------------
+
+class QuantizedLinear(nn.Layer):
+    """Weight-only int8 Linear: stores the weight as int8 with per-output-
+    channel symmetric scales and dequantizes into the matmul dtype at use.
+    On TPU this halves weight memory/HBM traffic; the matmul itself runs in
+    the activation dtype (XLA fuses the dequant multiply into the matmul's
+    operand)."""
+
+    def __init__(self, linear, weight_bits=8):
+        super().__init__()
+        w = linear.weight._data                      # [in, out]
+        qmax = 2 ** (weight_bits - 1) - 1
+        scale = jnp.max(jnp.abs(w), axis=0) / qmax   # per out-channel
+        scale = jnp.where(scale == 0, 1.0, scale)
+        self.register_buffer("w_int8", Tensor(
+            jnp.clip(jnp.round(w / scale), -qmax, qmax).astype(jnp.int8)))
+        self.register_buffer("w_scale", Tensor(scale))
+        self.bias = getattr(linear, "bias", None)
+        self.weight_bits = weight_bits
+        self._dtype_ref = w.dtype
+
+    def forward(self, x):
+        a = x._data if isinstance(x, Tensor) else x
+        w = (self.w_int8._data.astype(a.dtype)
+             * self.w_scale._data.astype(a.dtype))
+        out = jnp.matmul(a, w)
+        if self.bias is not None:
+            out = out + self.bias._data.astype(a.dtype)
+        return Tensor(out)
+
+
+def convert_to_int8(model, weight_bits=8, quantizable=None):
+    """Replace every quantizable sublayer's weights with int8 + scales
+    (in place on the Layer tree). Returns the model and the count of
+    converted layers (ref save_quantized_model's convert step)."""
+    quantizable = quantizable or (nn.Linear,)
+    converted = 0
+
+    def visit(layer):
+        nonlocal converted
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, quantizable):
+                layer._sub_layers[name] = QuantizedLinear(sub, weight_bits)
+                converted += 1
+            else:
+                visit(sub)
+
+    visit(model)
+    return model, converted

@@ -1,0 +1,80 @@
+"""fluid.* legacy surface: 1.x-style static program and dygraph code runs
+unchanged (ref python/paddle/fluid/__init__.py, layers/nn.py, dygraph/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def setup_function(_):
+    fluid.layers.reset_parameters()
+
+
+def test_fluid_static_mnist_style_program():
+    """The canonical 1.x recipe: data -> fc -> loss -> SGD minimize ->
+    Executor train loop."""
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data(name="img", shape=[16], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        hidden = fluid.layers.fc(input=img, size=32, act="relu")
+        logits = fluid.layers.fc(input=hidden, size=4)
+        loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+        avg_loss = fluid.layers.mean(loss)
+        opt = fluid.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(avg_loss)
+
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 16).astype("f4")
+    y = (x[:, :4].argmax(-1)).astype("i8")[:, None]
+    first = None
+    for _ in range(30):
+        (lval,) = exe.run(prog, feed={"img": x, "label": y},
+                          fetch_list=[avg_loss])
+        if first is None:
+            first = float(lval)
+    assert float(lval) < first * 0.6, (first, float(lval))
+
+
+def test_fluid_layers_builders_eager():
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 3, 8, 8)
+                         .astype("f4"))
+    y = fluid.layers.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                            act="relu")
+    assert y.shape == [2, 4, 8, 8]
+    y = fluid.layers.batch_norm(y)
+    y = fluid.layers.pool2d(y, pool_size=2, pool_type="max", pool_stride=2)
+    assert y.shape == [2, 4, 4, 4]
+    y = fluid.layers.fc(y, size=10, act="softmax")
+    assert y.shape == [2, 10]
+    np.testing.assert_allclose(y.numpy().sum(-1), 1.0, rtol=1e-5)
+
+
+def test_fluid_dygraph_guard_to_variable():
+    with fluid.dygraph.guard():
+        v = fluid.dygraph.to_variable(np.ones((2, 2), "f4"))
+        lin = fluid.dygraph.Linear(2, 3)
+        out = lin(v)
+        assert out.shape == [2, 3]
+        e = fluid.layers.elementwise_add(v, v)
+        np.testing.assert_allclose(e.numpy(), 2.0)
+
+
+def test_fluid_io_save_load_params(tmp_path):
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        img = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(input=img, size=2, name="probe")
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.io.save_params(exe, str(tmp_path), main_program=prog,
+                         filename="params.npz")
+    w = fluid.layers._PARAMS["probe.w_0"]
+    old = np.asarray(w._data).copy()
+    import jax.numpy as jnp
+    w._data = jnp.zeros_like(w._data)
+    fluid.io.load_params(exe, str(tmp_path), main_program=prog,
+                         filename="params.npz")
+    np.testing.assert_allclose(np.asarray(w._data), old)
