@@ -121,18 +121,41 @@ def _rank_env(pod, trainer, nproc, training_script_args):
     return env
 
 
-def launch_procs(pod, script, script_args, nproc, log_dir=None):
-    """Start one process per trainer; monitor; teardown-all on any failure
-    (ref launch_utils.py:435 TrainerProc + watch_local_trainers)."""
+def launch_procs(pod, script, script_args, nproc, log_dir=None,
+                 max_restarts=0):
+    """Start one process per trainer; monitor; on failure either restart the
+    whole local pod (elastic mode, up to `max_restarts` times — ref
+    paddle.distributed.elastic / launch_utils watch + respawn) or tear it
+    down (ref launch_utils.py:435 TrainerProc + watch_local_trainers).
+
+    Pod-level restart, not per-rank: a collective job cannot admit a lone
+    rejoining rank mid-allreduce; the reference's elastic controller
+    restarts the trainer group the same way."""
+    mine = local_trainers(pod)
+    attempts = 0
+    while True:
+        rc = _run_pod_once(pod, mine, script, script_args, nproc, log_dir,
+                           attempt=attempts)
+        if rc == 0 or attempts >= max_restarts:
+            return rc
+        attempts += 1
+        sys.stderr.write(
+            f"pod failed (exit {rc}); elastic restart "
+            f"{attempts}/{max_restarts}\n")
+
+
+def _run_pod_once(pod, mine, script, script_args, nproc, log_dir, attempt=0):
     procs = []
     logs = []
-    mine = local_trainers(pod)
     for t in mine:
         env = _rank_env(pod, t, nproc, script_args)
+        env["PADDLE_RESTART_ATTEMPT"] = str(attempt)
         cmd = [sys.executable, "-u", script] + list(script_args)
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
-            f = open(os.path.join(log_dir, f"workerlog.{t.rank}"), "w")
+            suffix = f".r{attempt}" if attempt else ""
+            f = open(os.path.join(log_dir,
+                                  f"workerlog.{t.rank}{suffix}"), "w")
             logs.append(f)
             p = subprocess.Popen(cmd, env=env, stdout=f, stderr=f)
         else:
@@ -184,6 +207,9 @@ def main(argv=None):
     parser.add_argument("--log_dir", type=str, default=None)
     parser.add_argument("--server_num", type=int, default=0,
                         help="PS mode: number of parameter servers")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="elastic: restart the local pod up to N times "
+                             "on worker failure (ref distributed.elastic)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -202,7 +228,8 @@ def main(argv=None):
     pod = get_cluster(nproc, args.start_port, args.ips, nnodes=args.nnodes)
     total = len(pod.trainers)
     return launch_procs(pod, args.training_script,
-                        args.training_script_args, total, args.log_dir)
+                        args.training_script_args, total, args.log_dir,
+                        max_restarts=args.max_restarts)
 
 
 def _launch_ps(args, nproc):

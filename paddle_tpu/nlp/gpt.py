@@ -98,6 +98,38 @@ class GPTAttention(nn.Layer):
         out = out.transpose([0, 2, 1, 3]).reshape([b, s, h])
         return self.resid_dropout(self.out_proj(out))
 
+    # -------------------------------------------------- incremental decode
+    def init_cache(self, batch, max_len, dtype=jnp.float32):
+        """KV cache [B, heads, L, head_dim] x2 (ref paddlenlp gen cache /
+        fused multi-transformer CacheKV)."""
+        shape = (batch, self.num_heads, max_len, self.head_dim)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def decode(self, x_t, cache, pos):
+        """One-token step: write K/V at `pos`, attend q over cache[:pos].
+        x_t: [B, 1, H] Tensor; pos: traced int. Returns (out, new_cache)."""
+        b = x_t.shape[0]
+        qkv = self.qkv_proj(x_t)
+        a = qkv._data if isinstance(qkv, Tensor) else qkv
+        a = a.reshape(b, 1, 3, self.num_heads, self.head_dim)
+        a = jnp.transpose(a, (2, 0, 3, 1, 4))           # [3, B, nh, 1, D]
+        q, k_t, v_t = a[0], a[1], a[2]
+        ck, cv = cache
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k_t.astype(ck.dtype),
+                                                 pos, axis=2)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v_t.astype(cv.dtype),
+                                                 pos, axis=2)
+        L = ck.shape[2]
+        scores = jnp.einsum("bhqd,bhld->bhql", q.astype(jnp.float32),
+                            ck.astype(jnp.float32)) / math.sqrt(self.head_dim)
+        mask = jnp.arange(L)[None, None, None, :] <= pos
+        scores = jnp.where(mask, scores, -1e9)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+        out = jnp.einsum("bhql,bhld->bhqd", probs, cv)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, -1)
+        out = self.out_proj(Tensor(out.astype(x_t._data.dtype)))
+        return out, (ck, cv)
+
 
 class GPTMLP(nn.Layer):
     def __init__(self, cfg: GPTConfig):
@@ -132,6 +164,12 @@ class GPTBlock(nn.Layer):
         x = x + self.attn(self.ln_1(x))
         x = x + self.mlp(self.ln_2(x))
         return x
+
+    def decode(self, x, cache, pos):
+        a, cache = self.attn.decode(self.ln_1(x), cache, pos)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, cache
 
 
 class GPTEmbeddings(nn.Layer):
@@ -177,6 +215,22 @@ class GPTModel(nn.Layer):
                 x = blk(x)
         return self.ln_f(x)
 
+    def init_cache(self, batch, max_len, dtype=jnp.float32):
+        return [blk.attn.init_cache(batch, max_len, dtype)
+                for blk in self.blocks]
+
+    def decode_step(self, tok, caches, pos):
+        """tok: [B, 1] ids; pos: traced position. Returns (h, caches)."""
+        pos = pos._data if isinstance(pos, Tensor) else pos
+        pos_ids = jnp.full((tok.shape[0] if hasattr(tok, "shape") else 1, 1),
+                           0, jnp.int32) + pos
+        x = self.embeddings(tok, Tensor(pos_ids))
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, cache = blk.decode(x, cache, pos)
+            new_caches.append(cache)
+        return self.ln_f(x), new_caches
+
 
 class GPTForPretraining(nn.Layer):
     """LM head tied to word embeddings (ref weight-tying convention)."""
@@ -196,6 +250,12 @@ class GPTForPretraining(nn.Layer):
     def loss(self, logits, labels):
         return gpt_pretrain_loss(logits, labels)
 
+    def decode_step(self, tok, caches, pos):
+        h, caches = self.gpt.decode_step(tok, caches, pos)
+        w = self.gpt.embeddings.word_embeddings.weight
+        from ..ops.math import matmul
+        return matmul(h, w, transpose_y=True), caches
+
 
 def gpt_pretrain_loss(logits, labels):
     shift_logits = logits[:, :-1, :]
@@ -207,18 +267,19 @@ def gpt_pretrain_loss(logits, labels):
 
 def gpt_generate(model, input_ids, max_new_tokens=32, do_sample=False,
                  top_k=0, top_p=1.0, temperature=1.0, eos_token_id=None,
-                 seed=None):
+                 seed=None, use_cache=False):
     """Autoregressive decode for GPTForPretraining
     (ref paddlenlp generation_utils.generate: greedy + top-k/top-p sampling).
 
-    TPU-native: ONE jitted lax.fori_loop over a fixed [B, Lmax] buffer —
-    each step recomputes the (causal) forward over the buffer and reads the
-    logits at the frontier position. Positions past the frontier are
-    padding; causal masking keeps them out of every earlier position, so
-    recompute-full-prefix is exact. (A KV-cache kernel trades this O(T^2)
-    for O(T) at larger contexts; the buffer form compiles to one program
-    with zero dynamic shapes, which is the right default for short
-    decodes on TPU.)
+    TPU-native: ONE jitted lax.fori_loop over a fixed [B, Lmax] buffer.
+    use_cache=False recomputes the (causal) forward over the whole buffer
+    per step and reads the frontier logits — exact, zero dynamic shapes,
+    right for short decodes. use_cache=True runs the incremental KV-cache
+    path (GPTModel.decode_step): O(T) attention per token against
+    [B, heads, Lmax, head_dim] caches, the long-decode configuration;
+    the prompt is consumed through the same single-token loop (prefill
+    positions teacher-force from the buffer), so both paths are one
+    compiled program.
 
     Returns ids [B, prompt_len + max_new_tokens] (prompt included), padded
     with eos after finish when eos_token_id is given.
@@ -235,6 +296,8 @@ def gpt_generate(model, input_ids, max_new_tokens=32, do_sample=False,
     L = prompt_len + int(max_new_tokens)
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
+    was_training = model.training
+    model.eval()            # generation is inference: dropout must be off
     params, buffers = model.functional_state()
 
     def logits_at(p, b, buf, t):
@@ -271,13 +334,67 @@ def gpt_generate(model, input_ids, max_new_tokens=32, do_sample=False,
     key0 = (jax.random.PRNGKey(seed) if seed is not None
             else _state.next_rng_key())
 
+    if not use_cache:
+        @jax.jit
+        def run(p, b, buf, key):
+            # params enter as jit ARGUMENTS (not baked constants), so
+            # repeated generate() calls after training reuse the program
+            finished = jnp.zeros((B,), bool)
+            buf, _, _ = jax.lax.fori_loop(prompt_len, L, make_step(p, b),
+                                          (buf, finished, key))
+            return buf
+
+        try:
+            return _T(run(params, buffers, buf0, key0))
+        finally:
+            if was_training:
+                model.train()
+
+    # ---------------- KV-cache path
+    def make_cached_step(p, b):
+        def step(t, carry):
+            buf, caches, finished, key = carry
+            tok_t = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
+            logits, caches = _functional_decode_step(model, p, b, tok_t,
+                                                     caches, t)
+            lo = logits[:, 0, :].astype(jnp.float32)
+            if temperature and temperature != 1.0:
+                lo = lo / temperature
+            if do_sample:
+                lo = top_k_top_p_filtering(_T(lo), top_k=top_k,
+                                           top_p=top_p)._data
+                key, sub = jax.random.split(key)
+                tok = jax.random.categorical(sub, lo,
+                                             axis=-1).astype(jnp.int32)
+            else:
+                tok = jnp.argmax(lo, axis=-1).astype(jnp.int32)
+            # prefill positions teacher-force the known next token
+            nxt = jnp.where(t + 1 < prompt_len, buf[:, (t + 1) % L], tok)
+            nxt = jnp.where(finished, jnp.int32(max(eos, 0)), nxt)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, nxt[:, None], jnp.minimum(t + 1, L - 1), axis=1)
+            if eos_token_id is not None:
+                finished = finished | ((t + 1 >= prompt_len) & (nxt == eos))
+            return buf, caches, finished, key
+        return step
+
+    def _functional_decode_step(model, p, b, tok, caches, pos):
+        out, _ = model.functional_call(
+            p, b, _T(tok), caches, pos, method="decode_step")
+        logits, new_caches = out
+        return (logits._data if isinstance(logits, _T) else logits,
+                new_caches)
+
     @jax.jit
-    def run(p, b, buf, key):
-        # params enter as jit ARGUMENTS (not baked constants), so repeated
-        # generate() calls after training reuse the compiled program
+    def run_cached(p, b, buf, key):
+        caches = model.gpt.init_cache(B, L)
         finished = jnp.zeros((B,), bool)
-        buf, _, _ = jax.lax.fori_loop(prompt_len, L, make_step(p, b),
-                                      (buf, finished, key))
+        buf, _, _, _ = jax.lax.fori_loop(
+            0, L - 1, make_cached_step(p, b), (buf, caches, finished, key))
         return buf
 
-    return _T(run(params, buffers, buf0, key0))
+    try:
+        return _T(run_cached(params, buffers, buf0, key0))
+    finally:
+        if was_training:
+            model.train()

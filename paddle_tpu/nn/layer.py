@@ -10,6 +10,7 @@ import collections
 import contextlib
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..framework import state
@@ -308,15 +309,26 @@ class Layer:
             for n, arr in saved_b.items():
                 named_b[n]._data = arr
 
-    def functional_call(self, params, buffers, *inputs, **kwargs):
-        """Pure call: returns (outputs, new_buffers). Safe under jax tracing."""
+    def functional_call(self, params, buffers, *inputs, method=None,
+                        **kwargs):
+        """Pure call: returns (outputs, new_buffers). Safe under jax tracing.
+        `method` selects a non-forward entry point (e.g. GPT decode_step);
+        only array-like positionals are Tensor-wrapped — pytrees (KV caches)
+        and scalars pass through untouched."""
+        def wrap(i):
+            if isinstance(i, Tensor):
+                return i
+            if isinstance(i, (jax.Array, jax.core.Tracer, np.ndarray)):
+                return Tensor(i)
+            return i
+
         with state.functional_mode_ctx():
             with self._use_state(params, buffers) as (named_p, named_b):
-                wrapped = [Tensor(i) if not isinstance(i, Tensor) else i
-                           for i in inputs]
+                wrapped = [wrap(i) for i in inputs]
                 for n in params:
                     named_p[n].stop_gradient = False
-                out = self(*wrapped, **kwargs)
+                fn = getattr(self, method) if method else self
+                out = fn(*wrapped, **kwargs)
                 new_buffers = {n: named_b[n]._data for n in (buffers or {})}
         return out, new_buffers
 

@@ -175,3 +175,39 @@ def test_eager_data_parallel_two_processes(tmp_path):
         opt.clear_grad()
     np.testing.assert_allclose(w0, np.asarray(ref.weight.numpy()),
                                rtol=1e-4, atol=1e-5)
+
+
+ELASTIC_WORKER = textwrap.dedent("""
+    import os, sys, json
+    attempt = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    marker = os.environ["ELASTIC_MARKER"]
+    if attempt == 0 and rank == 0:
+        sys.exit(3)                       # simulated crash on first attempt
+    print(json.dumps({"rank": rank, "attempt": attempt}))
+""")
+
+
+def test_launcher_elastic_restart(tmp_path):
+    """--max_restarts: a crashed pod is respawned and the retry completes
+    (ref paddle.distributed.elastic pod restart)."""
+    script = tmp_path / "elastic_worker.py"
+    script.write_text(ELASTIC_WORKER)
+    log_dir = str(tmp_path / "logs")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_MARKER"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--start_port", "40711",
+         "--max_restarts", "2", "--log_dir", log_dir, str(script)],
+        capture_output=True, text=True, cwd="/root/repo", env=env,
+        timeout=120)
+    assert r.returncode == 0, (r.stdout[-300:], r.stderr[-800:])
+    assert "elastic restart 1/2" in r.stderr
+    logs = ""
+    for f in sorted(os.listdir(log_dir)):
+        logs += open(os.path.join(log_dir, f)).read()
+    payloads = [json.loads(l) for l in logs.splitlines()
+                if l.startswith("{")]
+    assert {(p["rank"], p["attempt"]) for p in payloads} >= {(0, 1), (1, 1)}
