@@ -88,10 +88,18 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        from ..framework.selected_rows import SelectedRows
         found_inf = False
         inv = 1.0 / self._scale
         for p in optimizer._parameters:
             if p.grad is None:
+                continue
+            if isinstance(p.grad, SelectedRows):
+                vals = p.grad.values
+                if not bool(jnp.all(jnp.isfinite(vals))):
+                    found_inf = True
+                p.grad = SelectedRows(p.grad.rows, vals * inv,
+                                      p.grad.height)
                 continue
             g = p.grad._data
             finite = bool(jnp.all(jnp.isfinite(g)))

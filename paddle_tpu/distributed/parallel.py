@@ -52,10 +52,16 @@ class DataParallel(Layer):
             return
         from jax.experimental import multihost_utils
         import jax.numpy as jnp
+        from ..framework.selected_rows import SelectedRows
+        from ..framework.tensor import Tensor as _T
         params = [p for _, p in self._layers.named_parameters()
                   if p.grad is not None and not p.stop_gradient]
         if not params:
             return
+        for p in params:
+            if isinstance(p.grad, SelectedRows):
+                # cross-process mean needs aligned dense buffers
+                p.grad = _T(p.grad.to_dense())
         # one fused collective for the whole bucket (reducer.cc's bucketed
         # allreduce): gather each grad across processes, mean over them
         import numpy as np

@@ -1,0 +1,74 @@
+"""SelectedRows — row-sparse gradients for embedding tables
+(ref paddle/fluid/framework/selected_rows.h + operators/sum_op sparse
+accumulation + optimizers' SelectedRows kernels, e.g. sgd_op.h
+SparseSGDFunctor).
+
+A SelectedRows holds (rows, values[len(rows), dim], height): the gradient
+of an embedding lookup touches only the looked-up rows, so eager backward
+can carry O(batch * dim) instead of O(vocab * dim). On TPU the compiled
+training path doesn't need this (XLA fuses the scatter-add into the
+update), but the EAGER path and the PS path (push_sparse_grad) do — this
+is the dygraph `.grad` format for Embedding(sparse=True), exactly like the
+reference's VarBase holding a SelectedRows."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(rows, jnp.int32).ravel()
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+        assert self.values.shape[0] == self.rows.shape[0], \
+            (self.values.shape, self.rows.shape)
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def merge(self):
+        """Deduplicate rows, summing their values (ref
+        operators/math/selected_rows_functor.cc MergeAdd)."""
+        rows = np.asarray(self.rows)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        if uniq.size == rows.size:
+            return self
+        summed = jax.ops.segment_sum(self.values,
+                                     jnp.asarray(inv, jnp.int32),
+                                     num_segments=int(uniq.size)) \
+            if hasattr(jax.ops, "segment_sum") else \
+            jnp.zeros((uniq.size,) + self.values.shape[1:],
+                      self.values.dtype).at[jnp.asarray(inv)].add(self.values)
+        return SelectedRows(uniq, summed, self.height)
+
+    def to_dense(self):
+        out = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype),
+                            self.height)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            assert other.height == self.height
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values,
+                                 other.values.astype(self.values.dtype)]),
+                self.height)
+        # dense + sparse -> dense
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"dim={tuple(self.values.shape[1:])})")

@@ -123,9 +123,22 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
 
 def _accumulate_leaf(t, g):
     from .tensor import Tensor
+    from .selected_rows import SelectedRows
     if g.dtype != t._data.dtype:
         g = g.astype(t._data.dtype)
+    if isinstance(g, SelectedRows):
+        # row-sparse accumulation (ref gradient_accumulator.cc SelectedRows
+        # branch): sparse+sparse concatenates, sparse+dense densifies
+        if t.grad is None:
+            t.grad = g
+        elif isinstance(t.grad, SelectedRows):
+            t.grad = t.grad + g
+        else:
+            t.grad = Tensor(t.grad._data + g.to_dense(), stop_gradient=True)
+        return
     if t.grad is None:
         t.grad = Tensor(g, stop_gradient=True)
+    elif isinstance(t.grad, SelectedRows):
+        t.grad = Tensor(t.grad.to_dense() + g, stop_gradient=True)
     else:
         t.grad = Tensor(t.grad._data + g, stop_gradient=True)

@@ -165,7 +165,12 @@ class Tensor:
 
     @property
     def gradient(self):
-        return None if self.grad is None else self.grad.numpy()
+        if self.grad is None:
+            return None
+        from .selected_rows import SelectedRows
+        if isinstance(self.grad, SelectedRows):
+            return np.asarray(self.grad.to_dense())
+        return self.grad.numpy()
 
     # ------------------------------------------------------------- in-place-ish
     def set_value(self, value):

@@ -203,10 +203,50 @@ register_op("embedding", _embedding_raw)
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Device-side gather (TPU: embedding lookups stay on-chip; host-resident
-    sparse tables are the PS path, see distributed/ps)."""
+    sparse tables are the PS path, see distributed/ps). sparse=True makes the
+    EAGER backward produce a SelectedRows gradient on `weight` — O(batch*dim)
+    instead of O(vocab*dim) (ref lookup_table_v2_op is_sparse grad; under
+    jit, XLA's fused scatter-add already gives this, so the flag only
+    changes the eager tape)."""
+    if padding_idx is not None and padding_idx < 0:
+        # paddle semantics: negative pad indexes from the end of the table
+        padding_idx = int(as_array(weight).shape[0]) + int(padding_idx)
+    if sparse and not state.is_functional_mode() and state.is_grad_enabled() \
+            and isinstance(weight, Tensor) and not weight.stop_gradient \
+            and weight._node is None:
+        # leaf tables only: a non-leaf weight's producer holds a jax vjp
+        # that cannot consume a SelectedRows cotangent
+        return _sparse_embedding_eager(x, weight, padding_idx)
     return apply(_embedding_raw, (x, weight),
                  {"padding_idx": None if padding_idx is None
                   else int(padding_idx)}, name="embedding")
+
+
+def _sparse_embedding_eager(x, weight, padding_idx):
+    """Eager gather whose GradNode emits SelectedRows for the table."""
+    from ..framework.tape import GradNode
+    from ..framework.selected_rows import SelectedRows
+    ids = as_array(x)
+    w = as_array(weight)
+    out = _embedding_raw(ids, w, padding_idx=padding_idx)
+    height = int(w.shape[0])      # don't capture w: it pins a stale table
+
+    def vjp(cot):
+        flat_ids = ids.ravel()
+        vals = cot.reshape((-1,) + cot.shape[ids.ndim:])
+        if padding_idx is not None:
+            vals = jnp.where((flat_ids == padding_idx)[..., None], 0.0, vals)
+        return (jnp.zeros_like(ids),          # ids: int input, skipped
+                SelectedRows(flat_ids, vals, height))
+
+    res = Tensor(out, stop_gradient=False)
+    node = GradNode(vjp=vjp,
+                    inputs=[x if isinstance(x, Tensor) else None, weight],
+                    n_outputs=1, out_shapes=(out.shape,),
+                    out_dtypes=(out.dtype,), name="sparse_embedding")
+    res._node = node
+    res._slot = 0
+    return res
 
 
 def one_hot(x, num_classes, name=None):

@@ -70,15 +70,28 @@ class Optimizer:
 
     # ------------------------------------------------------------------ step
     def step(self):
+        from ..framework.selected_rows import SelectedRows
         self._global_step += 1
         params_grads = [(p, p.grad) for p in self._parameters
                         if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
+            # clipping needs dense magnitudes: densify row-sparse grads
+            params_grads = [
+                (p, Tensor(g.to_dense()) if isinstance(g, SelectedRows)
+                 else g) for p, g in params_grads]
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
         hyper = self._hyper()
         update = _jitted_update(type(self))
         for p, g in params_grads:
+            if isinstance(g, SelectedRows):
+                if self._can_row_update():
+                    self._sparse_step(p, g, lr, hyper)
+                    continue
+                # stateful non-lazy optimizers need the dense semantics
+                # (moments decay on untouched rows too — ref adam_op
+                # non-lazy SelectedRows branch densifies likewise)
+                g = Tensor(g.to_dense())
             g_arr = g._data.astype(p._data.dtype)
             if self._weight_decay is not None and \
                     getattr(p, "regularizer", None) is None:
@@ -94,6 +107,35 @@ class Optimizer:
             p._data = new_p
             for n, s in zip(self._state_names, new_state):
                 state[n] = s
+
+    def _can_row_update(self):
+        """Row-wise sparse update is exact for stateless rules (SGD) and is
+        the documented lazy_mode semantics for stateful ones."""
+        return not self._state_names or getattr(self, "_lazy_mode", False)
+
+    def _sparse_step(self, p, g, lr, hyper):
+        """Update only the touched rows (ref sgd_op.h SparseSGDFunctor /
+        adam lazy_mode): gather rows of param+state, apply the dense rule
+        on the slice, scatter back."""
+        merged = g.merge()
+        rows = merged.rows
+        vals = merged.values.astype(p._data.dtype)
+        state_d = self._ensure_state(p)
+        plr = lr * getattr(p, "learning_rate", 1.0)
+        p_rows = p._data[rows]
+        # decay/regularizer on the touched rows (matching the dense path;
+        # lazy semantics regularize rows when they are updated)
+        if getattr(p, "regularizer", None) is not None:
+            vals = p.regularizer._append(p_rows, vals)
+        elif self._weight_decay is not None:
+            vals = self._weight_decay._append(p_rows, vals)
+        st_rows = tuple(state_d[n][rows] for n in self._state_names)
+        new_rows, new_st = type(self)._update(
+            p_rows, vals, jnp.asarray(plr, jnp.float32), hyper, st_rows,
+            jnp.asarray(self._global_step, jnp.int32))
+        p._data = p._data.at[rows].set(new_rows)
+        for n, s in zip(self._state_names, new_st):
+            state_d[n] = state_d[n].at[rows].set(s)
 
     minimize_called = False
 
@@ -239,6 +281,7 @@ class Adam(Optimizer):
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lazy_mode = bool(lazy_mode)
 
     def _hyper(self):
         return (self._beta1, self._beta2, self._epsilon)
@@ -271,7 +314,7 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip)
+                         None, grad_clip, lazy_mode=lazy_mode)
         self._coeff = float(weight_decay) if isinstance(weight_decay,
                                                         (int, float)) else 0.01
         self._apply_decay_param_fun = apply_decay_param_fun

@@ -1,0 +1,75 @@
+"""SelectedRows row-sparse gradients (ref framework/selected_rows.h,
+lookup_table_v2 is_sparse grad, sgd_op SparseSGDFunctor, adam lazy_mode)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.framework.selected_rows import SelectedRows
+import paddle_tpu.nn.functional as F
+
+
+def test_selected_rows_merge_and_dense():
+    sr = SelectedRows([2, 0, 2], np.asarray([[1., 1.], [2., 2.], [3., 3.]]),
+                      height=4)
+    m = sr.merge()
+    assert sorted(np.asarray(m.rows).tolist()) == [0, 2]
+    d = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(d[2], [4., 4.])
+    np.testing.assert_allclose(d[0], [2., 2.])
+    np.testing.assert_allclose(d[1], 0.0)
+
+
+def test_sparse_embedding_grad_is_selected_rows():
+    pt.seed(0)
+    w = pt.framework.tensor.Parameter(
+        np.random.RandomState(0).randn(10, 4).astype("f4"), name="emb")
+    ids = pt.to_tensor(np.asarray([[1, 3], [3, 5]], np.int64))
+    out = F.embedding(ids, w, sparse=True)
+    loss = pt.ops.math.sum(out * out)
+    loss.backward()
+    g = w.grad
+    assert isinstance(g, SelectedRows)
+    assert g.height == 10
+    assert sorted(np.asarray(g.rows).tolist()) == [1, 3, 3, 5]
+    # parity with the dense path
+    w2 = pt.framework.tensor.Parameter(np.asarray(w._data), name="emb2")
+    out2 = F.embedding(ids, w2, sparse=False)
+    pt.ops.math.sum(out2 * out2).backward()
+    np.testing.assert_allclose(np.asarray(g.to_dense()),
+                               np.asarray(w2.grad.numpy()), rtol=1e-6)
+
+
+def test_sgd_sparse_step_matches_dense():
+    def run(sparse):
+        pt.seed(0)
+        emb = pt.nn.Embedding(12, 4, sparse=sparse)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=emb.parameters())
+        ids = pt.to_tensor(np.asarray([[0, 3, 3, 7]], np.int64))
+        for _ in range(3):
+            out = emb(ids)
+            loss = pt.ops.math.sum(out * out)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(emb.weight._data)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+def test_adam_lazy_mode_touches_only_rows():
+    pt.seed(0)
+    emb = pt.nn.Embedding(8, 4, sparse=True)
+    w0 = np.asarray(emb.weight._data).copy()
+    opt = pt.optimizer.Adam(learning_rate=0.1, lazy_mode=True,
+                            parameters=emb.parameters())
+    ids = pt.to_tensor(np.asarray([[1, 2]], np.int64))
+    out = emb(ids)
+    pt.ops.math.sum(out * out).backward()
+    opt.step()
+    w1 = np.asarray(emb.weight._data)
+    changed = np.abs(w1 - w0).sum(axis=1) > 0
+    assert changed[1] and changed[2]
+    assert not changed[[0, 3, 4, 5, 6, 7]].any()   # untouched rows frozen
