@@ -18,6 +18,9 @@ def __getattr__(name):
     if name in ("PsServer", "PsClient", "AsyncPSTrainer", "GeoPSTrainer"):
         from . import ps
         return getattr(ps, name)
+    if name == "HeterPSTrainer":
+        from .heter import HeterPSTrainer
+        return HeterPSTrainer
     if name == "TheOnePSRuntime":
         from .runtime import TheOnePSRuntime
         return TheOnePSRuntime

@@ -1,0 +1,88 @@
+"""Heterogeneous PS training — the TPU answer to the reference's heter-PS /
+PSGPU path (ref paddle/fluid/framework/fleet/heter_ps/heter_comm.h,
+fleet/ps_gpu_wrapper.h: GPU workers with a device-side embedding cache in
+front of host parameter-server tables).
+
+Design (TPU-native, not a port):
+  - DENSE parameters + optimizer state are RESIDENT on the device and update
+    in place inside one donated compiled step (no per-step dense pull/push —
+    the reference keeps dense on the worker GPU the same way).
+  - SPARSE embedding rows live in the host PS sparse table (beyond-HBM
+    capacity). Per batch: host computes unique ids, pulls only those rows,
+    the compiled step takes grads w.r.t. the pulled block, and the sparse
+    grads are pushed back asynchronously.
+  - XLA needs static shapes: the unique-id block is padded to power-of-two
+    buckets so re-compilation happens O(log max_unique) times, not per batch.
+    Padding duplicates uids[0]; untouched duplicate rows receive zero grad
+    through the gather VJP, so pushing them is a no-op add.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _bucket(n, lo=64):
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
+class HeterPSTrainer:
+    """Device-resident dense tower + host-PS sparse embeddings.
+
+    loss_fn(dense_params, urows, inv, *batch) -> scalar loss, where
+    `urows[inv]` recovers per-position embedding rows ([B*S, emb_dim]).
+
+    dense update runs on-device with `optimizer` (paddle_tpu Optimizer);
+    sparse update is the PS table's optimizer (server-side SGD).
+    """
+
+    def __init__(self, loss_fn, dense_params, optimizer, client,
+                 sparse_table=1, emb_dim=8, donate=True):
+        self.client = client
+        self.sparse_table = sparse_table
+        self.emb_dim = emb_dim
+        self.optimizer = optimizer
+        self.params = {n: jnp.asarray(a, jnp.float32)
+                       for n, a in dense_params.items()}
+        self.opt_state = optimizer.init_opt_state(self.params)
+        self._step_i = 0
+        apply_fn = optimizer.apply_gradients_fn()
+
+        def _step(params, opt_state, urows, inv, lr, step_i, *batch):
+            loss, (gp, grows) = jax.value_and_grad(
+                lambda p, r: loss_fn(p, r, inv, *batch),
+                argnums=(0, 1))(params, urows)
+            new_params, new_opt = apply_fn(params, gp, opt_state, lr, step_i)
+            return loss, new_params, new_opt, grows
+
+        donate_args = (0, 1) if donate else ()
+        self._compiled = jax.jit(_step, donate_argnums=donate_args)
+
+    def step(self, ids, *batch):
+        """One heter step. `ids` is any int array of embedding ids for the
+        batch; `urows[inv]` has one row per flattened id position."""
+        c = self.client
+        ids = np.asarray(ids).ravel()
+        if ids.size == 0:
+            raise ValueError("HeterPSTrainer.step: empty ids batch")
+        uids, inv = np.unique(ids, return_inverse=True)
+        b = _bucket(len(uids))
+        pad = b - len(uids)
+        uids_p = np.concatenate([uids, np.full(pad, uids[0], uids.dtype)]) \
+            if pad else uids
+        urows = c.pull_sparse(self.sparse_table, uids_p, self.emb_dim)
+        urows = np.asarray(urows, np.float32).reshape(b, self.emb_dim)
+        self._step_i += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss, self.params, self.opt_state, grows = self._compiled(
+            self.params, self.opt_state, jnp.asarray(urows),
+            jnp.asarray(inv.astype(np.int32)), lr,
+            jnp.asarray(self._step_i, jnp.int32), *batch)
+        c.push_sparse_grad(self.sparse_table, uids_p, np.asarray(grows))
+        return float(loss)
+
+    def dense_state(self):
+        """Host copies of the device-resident dense params."""
+        return {n: np.asarray(a) for n, a in self.params.items()}

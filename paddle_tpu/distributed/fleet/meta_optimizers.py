@@ -107,8 +107,13 @@ class GradientMergeOptimizer(MetaOptimizerBase):
         if self._acc_step % self.k_steps != 0:
             return  # keep accumulating in .grad
         if self.avg and self.k_steps > 1:
+            from ...framework.selected_rows import SelectedRows
             for p in self._parameters:
-                if p.grad is not None:
+                if p.grad is None:
+                    continue
+                if isinstance(p.grad, SelectedRows):
+                    p.grad.values = p.grad.values / self.k_steps
+                else:
                     p.grad._data = p.grad._data / self.k_steps
         self.inner_opt.step()
 

@@ -38,12 +38,9 @@ class SelectedRows:
         uniq, inv = np.unique(rows, return_inverse=True)
         if uniq.size == rows.size:
             return self
-        summed = jax.ops.segment_sum(self.values,
-                                     jnp.asarray(inv, jnp.int32),
-                                     num_segments=int(uniq.size)) \
-            if hasattr(jax.ops, "segment_sum") else \
-            jnp.zeros((uniq.size,) + self.values.shape[1:],
-                      self.values.dtype).at[jnp.asarray(inv)].add(self.values)
+        summed = jnp.zeros((uniq.size,) + self.values.shape[1:],
+                           self.values.dtype).at[jnp.asarray(inv)] \
+            .add(self.values)
         return SelectedRows(uniq, summed, self.height)
 
     def to_dense(self):

@@ -203,9 +203,12 @@ class GradientMergeOptimizer:
         if self._acc is None:
             self._acc = {id(p): jnp.zeros_like(p._data)
                          for p in self._params}
+        from ..framework.selected_rows import SelectedRows
         for p in self._params:
             if p.grad is not None:
-                self._acc[id(p)] = self._acc[id(p)] + p.grad._data
+                g = p.grad.to_dense() if isinstance(p.grad, SelectedRows) \
+                    else p.grad._data
+                self._acc[id(p)] = self._acc[id(p)] + g
         self._steps += 1
         if self._steps % self.k_steps == 0:
             scale = 1.0 / self.k_steps if self.avg else 1.0

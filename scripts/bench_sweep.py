@@ -1,0 +1,59 @@
+"""One-off: sweep batch sizes for the bench GPT config on the real chip.
+
+Measures steady-state step time (after warmup absorbing compile + the
+one-time relayout step) for several batch sizes, with the persistent
+compilation cache enabled so re-runs are cheap.
+"""
+import time
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import paddle_tpu as pt
+from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+from paddle_tpu.nlp.gpt import gpt_pretrain_loss
+from paddle_tpu.jit import TrainStep
+
+t0 = time.time()
+
+
+def log(m):
+    print(f"[{time.time()-t0:7.1f}s] {m}", flush=True)
+
+
+cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                num_heads=12, max_seq_len=1024, dropout=0.0,
+                attn_dropout=0.0)
+seq = 1024
+
+for batch in [int(a) for a in sys.argv[1:]] or [8, 16, 32]:
+    pt.seed(0)
+    model = GPTForPretraining(cfg)
+    model.to(dtype=jnp.bfloat16)
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters())
+    step = TrainStep(model, gpt_pretrain_loss, opt, donate=True)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32")
+    for i in range(3):
+        t1 = time.time()
+        loss = step(ids, ids)
+        v = float(loss.numpy())
+        log(f"b={batch} warm {i}: {time.time()-t1:.3f}s loss={v:.4f}")
+    iters = 20
+    t1 = time.time()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    float(loss.numpy())
+    dt = (time.time() - t1) / iters
+    toks = batch * seq / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    tf = toks * 6 * n_params / 1e12
+    log(f"b={batch}: {dt*1e3:.1f} ms/step  {toks:,.0f} tok/s  "
+        f"{tf:.1f} TF/s  MFU={tf/197:.3f}")
+    del step, model, opt

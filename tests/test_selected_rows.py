@@ -73,3 +73,42 @@ def test_adam_lazy_mode_touches_only_rows():
     changed = np.abs(w1 - w0).sum(axis=1) > 0
     assert changed[1] and changed[2]
     assert not changed[[0, 3, 4, 5, 6, 7]].any()   # untouched rows frozen
+
+
+def test_gradient_merge_wrapper_handles_sparse_grads():
+    """Regression: GradientMergeOptimizer (wrappers) accumulates
+    SelectedRows grads from Embedding(sparse=True) by densifying."""
+    from paddle_tpu.optimizer.wrappers import GradientMergeOptimizer
+    pt.seed(0)
+    emb = pt.nn.Embedding(12, 4, sparse=True)
+    inner = pt.optimizer.SGD(learning_rate=0.1, parameters=emb.parameters())
+    opt = GradientMergeOptimizer(inner, k_steps=2, avg=True)
+    w0 = np.asarray(emb.weight._data).copy()
+    ids = pt.to_tensor(np.asarray([[0, 3]], np.int64))
+    for _ in range(2):
+        loss = pt.ops.math.sum(emb(ids) * emb(ids))
+        loss.backward()
+        opt.step()
+    w1 = np.asarray(emb.weight._data)
+    assert np.abs(w1[0] - w0[0]).max() > 1e-6   # touched rows moved
+    np.testing.assert_allclose(w1[5], w0[5])    # untouched rows intact
+
+
+def test_fleet_gradient_merge_avg_handles_sparse_grads():
+    """Regression: fleet GradientMergeOptimizer avg path scales
+    SelectedRows.values instead of reading ._data."""
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        GradientMergeOptimizer as FleetGM)
+    pt.seed(0)
+    emb = pt.nn.Embedding(12, 4, sparse=True)
+    inner = pt.optimizer.SGD(learning_rate=0.1, parameters=emb.parameters())
+    opt = FleetGM(inner, k_steps=2, avg=True)
+    w0 = np.asarray(emb.weight._data).copy()
+    ids = pt.to_tensor(np.asarray([[1, 4]], np.int64))
+    for _ in range(2):
+        loss = pt.ops.math.sum(emb(ids) * emb(ids))
+        loss.backward()
+        opt.step()
+    w1 = np.asarray(emb.weight._data)
+    assert np.abs(w1[1] - w0[1]).max() > 1e-6
+    np.testing.assert_allclose(w1[7], w0[7])
