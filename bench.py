@@ -5,9 +5,16 @@ vs_baseline is measured against a fixed roofline-style reference number
 (see BASELINE.md — the reference repo publishes no numbers; we report
 model-FLOPs-utilisation-normalised throughput so rounds are comparable).
 
-Hardened entry: backend init is retried with backoff (tunneled TPU plugins
-can be transiently unavailable), import never touches a device (lazy RNG),
-and any terminal failure still prints a parseable JSON error line.
+Hardened entry:
+  - import never touches a device (lazy RNG); backend init is retried with
+    backoff (tunneled TPU plugins can be transiently unavailable)
+  - persistent XLA compilation cache (.jax_cache) — warm re-runs skip the
+    ~minutes-long tunnel compile
+  - warmup absorbs BOTH slow first steps (initial compile + the one-time
+    donated-buffer relayout recompile) before the measured window; the
+    old self-tune rebuild misread the relayout step as pathological
+    donation and doubled compile time into a driver timeout
+  - any terminal failure still prints a parseable JSON error line
 """
 import json
 import sys
@@ -15,41 +22,66 @@ import time
 
 import numpy as np
 
+METRIC = "gpt2s-1024ctx train tokens/sec/chip"
+PEAK_TFLOPS = 197.0   # v5e chip peak, bf16
 
-def _init_backend(max_tries=5, base_delay=5.0):
-    """Initialize a JAX backend, preferring the TPU, retrying transient
-    plugin failures with exponential backoff. Returns (jax, on_tpu)."""
-    import jax
-    last_err = None
-    for attempt in range(max_tries):
-        try:
-            backend = jax.default_backend()
-            if backend != "cpu":
-                return jax, True
-            # jax caches the backend set even when the TPU plugin failed
-            # (cpu fills in first) — drop it so the next attempt actually
-            # re-tries the plugin instead of silently returning cpu
-            last_err = last_err or RuntimeError("only cpu backend came up")
-        except RuntimeError as e:  # backend setup error (plugin hiccup)
-            last_err = e
-        if attempt < max_tries - 1:
-            import jax.extend.backend as _eb
-            _eb.clear_backends()
-            time.sleep(base_delay * (2 ** attempt))
-    # TPU never came up: fall back to host CPU so we still produce a number
-    # (flagged via detail.backend so the driver/judge can tell).
-    import os
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    jax.config.update("jax_platforms", "cpu")
+
+def _tpu_probe_ok(timeout_s=120):
+    """Attempt TPU discovery in a DISPOSABLE child process. A wedged
+    tunnel makes backend init HANG (not raise) — observed when a remote
+    compile gets killed mid-flight — and a hang in the bench process
+    itself would eat the driver's whole time budget. A child can be
+    timed out and killed."""
+    import subprocess
     try:
-        jax.default_backend()
-        return jax, False
-    except RuntimeError:
-        raise RuntimeError(f"no JAX backend available: {last_err}")
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.default_backend() != 'cpu'"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _init_backend(max_tries=3, delay=20.0):
+    """Initialize a JAX backend, preferring the TPU but never hanging on
+    it: each attempt probes the tunnel in a killable child first.
+    Returns (jax, on_tpu)."""
+    import os
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache")
+    on_tpu = False
+    for attempt in range(max_tries):
+        if _tpu_probe_ok():
+            on_tpu = True
+            break
+        _note(f"tpu probe {attempt} failed (tunnel down/wedged)")
+        if attempt < max_tries - 1:
+            time.sleep(delay * (attempt + 1))
+    if not on_tpu:
+        # fall back to host CPU so we still produce a number (flagged via
+        # detail.backend so the driver/judge can tell)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    if not on_tpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax, jax.default_backend() != "cpu"
+
+
+def _note(msg, _t0=[None]):
+    """Progress to stderr (stdout is reserved for the one JSON line)."""
+    if _t0[0] is None:
+        _t0[0] = time.time()
+    print(f"[bench +{time.time()-_t0[0]:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
 
 
 def run():
+    _note("init backend")
     jax, on_tpu = _init_backend()
+    _note(f"backend={jax.default_backend()}")
     import jax.numpy as jnp
     import paddle_tpu as pt
     from paddle_tpu.nlp import GPTConfig, GPTForPretraining
@@ -62,7 +94,7 @@ def run():
         cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024, dropout=0.0,
                         attn_dropout=0.0)
-        batch, seq, iters = 8, 1024, 20
+        batch, seq, iters = 8, 1024, 30
     else:  # CI smoke
         cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                         num_heads=4, max_seq_len=128, dropout=0.0,
@@ -72,37 +104,26 @@ def run():
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32")
 
-    def build(donate):
-        model = GPTForPretraining(cfg)
-        if on_tpu:
-            model.to(dtype=jnp.bfloat16)  # bf16 params: MXU-native
-        opt = pt.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
-        return TrainStep(model, gpt_pretrain_loss, opt, donate=donate), model
+    model = GPTForPretraining(cfg)
+    if on_tpu:
+        model.to(dtype=jnp.bfloat16)  # bf16 params: MXU-native
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters())
+    step = TrainStep(model, gpt_pretrain_loss, opt, donate=True)
 
-    def measure(step, n):
-        loss = step(ids, ids)          # warmup/compile
+    # warmup: step 1 compiles; step 2 recompiles once for the donated
+    # on-device buffer layouts; step 3 confirms steady state
+    _note("model built; warmup (compile)")
+    for i in range(3):
+        loss = step(ids, ids)
         float(loss.numpy())
-        t0 = time.perf_counter()
-        for _ in range(n):
-            loss = step(ids, ids)
-        final = float(loss.numpy())
-        return (time.perf_counter() - t0) / n, final
+        _note(f"warm {i} done")
 
-    # donation is the right default (params update in place on HBM), but
-    # the tunneled single-chip plugin has shown pathological donated-step
-    # behavior; self-tune: probe a few steps, rebuild without donation if
-    # it's clearly faster, keep the winner for the measured run.
-    step, model = build(donate=True)
-    dt_probe, _ = measure(step, 3)
-    chosen = "donate"
-    if on_tpu and dt_probe > 1.0:      # >1s/step for GPT2s is pathological
-        step2, model2 = build(donate=False)
-        dt2, _ = measure(step2, 3)
-        if dt2 < dt_probe * 0.8:
-            step, model, chosen = step2, model2, "no-donate"
-
-    dt, final = measure(step, iters)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    final = float(loss.numpy())           # one device sync at the end
+    dt = (time.perf_counter() - t0) / iters
     assert np.isfinite(final), "non-finite loss in bench"
 
     tokens_per_sec = batch * seq / dt
@@ -114,17 +135,17 @@ def run():
 
     # baseline anchor: BASELINE.json publishes no reference numbers; anchor
     # against v5e-chip peak (197 bf16 TFLOP/s) => value is MFU-normalised.
-    peak = 197.0 if on_tpu else 1.0
+    peak = PEAK_TFLOPS if on_tpu else 1.0
     mfu = tflops / peak
 
     print(json.dumps({
-        "metric": "gpt2s-1024ctx train tokens/sec/chip",
+        "metric": METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu, 4),
         "detail": {"step_ms": round(dt * 1e3, 2), "loss": round(final, 3),
                    "model_tflops": round(tflops, 2), "params": n_params,
-                   "backend": jax.default_backend(), "mode": chosen},
+                   "backend": jax.default_backend(), "batch": batch},
     }))
 
 
@@ -133,7 +154,7 @@ def main():
         run()
     except Exception as e:  # still emit a parseable line for the driver
         print(json.dumps({
-            "metric": "gpt2s-1024ctx train tokens/sec/chip",
+            "metric": METRIC,
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
             "detail": {"error": f"{type(e).__name__}: {e}"},
         }))

@@ -1,0 +1,95 @@
+"""Ulysses-style sequence parallelism — all-to-all head redistribution.
+
+Second sequence-parallel strategy next to ring attention (PAPERS.md
+DeepSpeed-Ulysses, arXiv:2309.14509); NEW capability relative to the
+reference (SURVEY.md §5: Yelrose/Paddle has no sequence parallelism).
+
+Where ring attention streams K/V shards around the ICI ring (constant
+memory, n ppermute hops), Ulysses swaps WHICH dim is sharded: activations
+arrive sequence-sharded [B, H, S/n, D], one all-to-all re-shards them to
+head-sharded [B, H/n, S, D], each device runs ordinary (flash) attention
+on its full-sequence head slice, and a second all-to-all restores
+sequence sharding. Two collectives per call instead of n, at the price of
+holding S x (H/n) activations; the right trade when heads >= sp and the
+sequence shard still fits HBM. Composes with 'dp' (batch) like the ring.
+
+Both strategies expose the same call contract, so GPTAttention can pick
+per-config (sequence_parallel="ring" | "ulysses").
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+
+def ulysses_attention_shard(q, k, v, *, axis_name, causal, scale):
+    """Per-shard body (inside shard_map). q/k/v local: [B, H, S/n, D].
+    Requires H % n == 0 (head-parallel redistribution)."""
+    n = lax.axis_size(axis_name)
+    b, h, s_loc, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"num_heads {h} not divisible by sp={n}")
+
+    def seq_to_head(x):
+        # [B, H, S/n, D] -> [B, H/n, S, D]: split heads across devices,
+        # concatenate sequence. all_to_all splits axis 1, concats axis 2.
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def head_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh = seq_to_head(q)
+    kh = seq_to_head(k)
+    vh = seq_to_head(v)
+    from ..ops.pallas.flash_attention import _flash_array
+    oh = _flash_array(qh, kh, vh, causal=causal, scale=scale)
+    return head_to_seq(oh)
+
+
+def ulysses_attention(q, k, v, causal=False, scale=None,
+                      axis_name=mesh_mod.SP_AXIS, mesh=None):
+    """Array-level Ulysses attention over globally-shaped [B,H,S,D] arrays.
+    Falls back to single-device flash attention when the mesh has no (or a
+    trivial) 'sp' axis. Mirrors ring_attention's sharding contract."""
+    mesh = mesh or mesh_mod.get_mesh()
+    if (mesh is None or axis_name not in mesh.axis_names
+            or int(mesh.shape[axis_name]) == 1):
+        from ..ops.pallas.flash_attention import _flash_array
+        return _flash_array(q, k, v, causal=causal, scale=scale)
+    n = int(mesh.shape[axis_name])
+    if q.shape[-2] % n != 0:
+        raise ValueError(f"sequence length {q.shape[-2]} not divisible by "
+                         f"sp={n}")
+    if q.shape[1] % n != 0:
+        raise ValueError(f"num_heads {q.shape[1]} not divisible by sp={n} "
+                         "(use ring attention for head counts below the "
+                         "sp degree)")
+    batch_axis = mesh_mod.DP_AXIS if (
+        mesh_mod.DP_AXIS in mesh.axis_names
+        and q.shape[0] % int(mesh.shape[mesh_mod.DP_AXIS]) == 0) else None
+    spec = P(batch_axis, None, axis_name, None)
+    f = jax.shard_map(
+        functools.partial(ulysses_attention_shard, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return f(q, k, v)
+
+
+def ulysses_flash_attention(q, k, v, causal=False, scale=None,
+                            axis_name=mesh_mod.SP_AXIS, mesh=None):
+    """Tensor-level op (tape/functional integrated via the dispatcher)."""
+    from ..ops.dispatch import apply
+
+    def fn(q_, k_, v_):
+        return ulysses_attention(q_, k_, v_, causal=causal, scale=scale,
+                                 axis_name=axis_name, mesh=mesh)
+
+    return apply(fn, (q, k, v), name="ulysses_flash_attention")

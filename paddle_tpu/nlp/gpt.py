@@ -41,8 +41,10 @@ class GPTConfig:
         self.attn_dropout = attn_dropout
         self.initializer_range = initializer_range
         self.use_recompute = use_recompute
-        # long-context: ring attention over the 'sp' mesh axis
-        # (distributed/ring_attention.py; new capability vs the reference)
+        # long-context sequence parallelism over the 'sp' mesh axis — new
+        # capability vs the reference. False | True/"ring" (ring attention,
+        # distributed/ring_attention.py) | "ulysses" (all-to-all head
+        # redistribution, distributed/ulysses.py)
         self.sequence_parallel = sequence_parallel
 
 
@@ -87,10 +89,20 @@ class GPTAttention(nn.Layer):
         qkv = qkv.transpose([2, 0, 3, 1, 4])          # [3,B,Hd,S,D]
         q, k, v = qkv[0], qkv[1], qkv[2]
         if self.sequence_parallel:
-            # ring attention over 'sp'; attention-prob dropout is skipped on
-            # this path (scores are never materialised globally)
-            from ..distributed.ring_attention import ring_flash_attention
-            out = ring_flash_attention(q, k, v, causal=True)
+            # sequence parallelism over 'sp'; attention-prob dropout is
+            # skipped on this path (scores are never materialised globally).
+            # "ring" (default) streams K/V around the ICI ring; "ulysses"
+            # all-to-alls to head-sharded full-sequence attention.
+            if self.sequence_parallel == "ulysses":
+                from ..distributed.ulysses import ulysses_flash_attention
+                out = ulysses_flash_attention(q, k, v, causal=True)
+            elif self.sequence_parallel in (True, "ring"):
+                from ..distributed.ring_attention import ring_flash_attention
+                out = ring_flash_attention(q, k, v, causal=True)
+            else:
+                raise ValueError(
+                    f"unknown sequence_parallel={self.sequence_parallel!r}; "
+                    "expected False, True/'ring', or 'ulysses'")
         else:
             out = scaled_dot_product_attention(
                 q, k, v, causal=True, dropout_p=self.attn_dropout_p,
@@ -258,11 +270,18 @@ class GPTForPretraining(nn.Layer):
 
 
 def gpt_pretrain_loss(logits, labels):
-    shift_logits = logits[:, :-1, :]
-    shift_labels = labels[:, 1:]
-    b, s, v = shift_logits.shape
-    return F.cross_entropy(shift_logits.reshape([b * s, v]),
-                           shift_labels.reshape([b * s]))
+    """Next-token CE. Shift the LABELS (cheap int32 op) instead of slicing
+    the logits: logits[:, :-1] yields a 1023-row tensor that breaks the
+    TPU (8,128) tiling and costs a full relayout copy of the [B,S,V]
+    logits (~512MB at the bench config, visible as reshape+fusion ops in
+    the device trace); the last position is masked via ignore_index."""
+    b, s, v = logits.shape
+    from ..ops.manipulation import concat
+    from ..ops.creation import full
+    ign = full([b, 1], -1, dtype="int64")
+    shifted = concat([labels[:, 1:].astype("int64"), ign], axis=1)
+    return F.cross_entropy(logits.reshape([b * s, v]),
+                           shifted.reshape([b * s]), ignore_index=-1)
 
 
 def gpt_generate(model, input_ids, max_new_tokens=32, do_sample=False,

@@ -17,8 +17,28 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-_BQ = 128   # query block (sublane-friendly)
-_BK = 128   # key block
+import os
+
+# Block sizes: bigger tiles amortise per-program overhead and feed the MXU
+# larger operands (128x128 tiles left the kernels ~20x off roofline in the
+# device trace); bounded by VMEM (~16MB/core). Env-tunable for sweeps.
+_BQ = int(os.environ.get("PADDLE_TPU_FLASH_BQ", 512))   # query block
+_BK = int(os.environ.get("PADDLE_TPU_FLASH_BK", 512))   # key block
+
+
+def _blk(pref, n):
+    """Largest 128-multiple divisor of n not exceeding pref."""
+    b = (min(pref, n) // 128) * 128   # round env-supplied sizes to the grid
+    while b > 128 and n % b:
+        b -= 128
+    return max(b, 128)
+
+
+def _pad_dim(d):
+    """Kernel head-dim: 64 stays (block == array dim is Mosaic-legal and
+    avoids doubling HBM traffic); otherwise round up to the 128 lane
+    boundary."""
+    return d if d == 64 else max(128, ((d + 127) // 128) * 128)
 
 
 def _sdpa_reference(q, k, v, mask, causal, scale):
@@ -42,14 +62,17 @@ def _sdpa_reference(q, k, v, mask, causal, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, kv_len, q_len):
-    """One (batch*head, q-block) program: stream K/V blocks, online softmax."""
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                kv_len, q_len, bk):
+    """One (batch*head, q-block) program: stream K/V blocks, online softmax.
+    Also writes the per-row log-sum-exp (softmax stats) so the flash
+    backward kernel can recompute P tiles without re-reducing."""
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale        # [BQ, D]
     bq = q.shape[0]
     d = q.shape[1]
-    nblocks = kv_len // _BK
+    nblocks = kv_len // bk
     qblk = pl.program_id(1)
 
     m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
@@ -58,17 +81,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, kv_len, q_len):
 
     def body(j, carry):
         m, l, acc = carry
-        kblk = k_ref[0, pl.ds(j * _BK, _BK), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(j * _BK, _BK), :].astype(jnp.float32)
+        kblk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [BQ,BK]
         if causal:
             # absolute query position includes the (klen - qlen) decode offset
             # so semantics match _sdpa_reference for sq != sk
             q_idx = (kv_len - q_len) + qblk * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, _BK), 0)
-            k_idx = j * _BK + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (bq, _BK), 1)
+                jnp.int32, (bq, bk), 0)
+            k_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (bq, bk), 1)
             s = jnp.where(k_idx <= q_idx, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # guard fully-masked rows (m_new = -inf): shift by 0 there
@@ -84,11 +107,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, kv_len, q_len):
     if causal:
         # only blocks up to (and including) the diagonal contribute
         diag = kv_len - q_len + (qblk + 1) * bq
-        upper = jnp.minimum(nblocks, (diag + _BK - 1) // _BK)
+        upper = jnp.minimum(nblocks, (diag + bk - 1) // bk)
         m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
     else:
         m, l, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # lse = m + log l (finite-m guard matches the shift guard above).
+    # lse_ref holds the FULL [1, q_len] row (TPU block constraint: last two
+    # dims must be 8/128-divisible or whole); each q-block program writes
+    # its slice — grid iterations are sequential so this is race-free.
+    lse = jnp.where(jnp.isfinite(m), m, 0.0) + jnp.log(jnp.maximum(l, 1e-30))
+    lse_ref[0, 0, pl.ds(qblk * bq, bq)] = lse[:, 0]
 
 
 def _flash_fwd_pallas(q, k, v, causal, scale):
@@ -98,7 +127,10 @@ def _flash_fwd_pallas(q, k, v, causal, scale):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
-    d_pad = max(128, ((d + 127) // 128) * 128)
+    # head_dim 64 runs unpadded (block dim == array dim satisfies the
+    # Mosaic constraint); padding to 128 would double the HBM traffic of
+    # every q/k/v copy feeding the kernel
+    d_pad = _pad_dim(d)
     if d != d_pad:
         pad = [(0, 0)] * 3 + [(0, d_pad - d)]
         q = jnp.pad(q, pad)
@@ -109,47 +141,216 @@ def _flash_fwd_pallas(q, k, v, causal, scale):
     vr = v.reshape(b * h, sk, d_pad)
 
     interpret = jax.default_backend() == "cpu"
+    bq_, bk_ = _blk(_BQ, sq), _blk(_BK, sk)
     kernel = functools.partial(_fwd_kernel, scale=s, causal=causal,
-                               kv_len=sk, q_len=sq)
-    out = pl.pallas_call(
+                               kv_len=sk, q_len=sq, bk=bk_)
+    out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // _BQ),
+        grid=(b * h, sq // bq_),
         in_specs=[
-            pl.BlockSpec((1, _BQ, d_pad), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, sk, d_pad), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, sk, d_pad), lambda bh, i: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, _BQ, d_pad), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d_pad), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
     out = out.reshape(b, h, sq, d_pad)
-    return out[..., :d] if d != d_pad else out
+    return (out[..., :d] if d != d_pad else out), lse
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
+                    dk_ref, dv_ref, *, scale, causal, kv_len, q_len,
+                    bq, bk):
+    """One (batch*head, k-block) program: accumulate dK/dV over q blocks.
+    P tiles are recomputed from saved lse; dd is rowsum(dO * O)."""
+    from jax.experimental import pallas as pl
+
+    kblk = k_ref[0].astype(jnp.float32)             # [BK, D]
+    vblk = v_ref[0].astype(jnp.float32)
+    kb = pl.program_id(1)
+    nqb = q_len // bq
+    d = kblk.shape[1]
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * bq, bq)].reshape(bq, 1)
+        dd = dd_ref[0, 0, pl.ds(i * bq, bq)].reshape(bq, 1)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                        # [BQ, BK]
+        if causal:
+            q_idx = (kv_len - q_len) + i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_idx = kb * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                       (bq, bk), 1)
+            p = jnp.where(k_idx <= q_idx, p, 0.0)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dd) * scale                  # [BQ, BK]
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # first q block whose last row reaches this k block's first row
+        start = jnp.maximum(0, (kb * bk - (kv_len - q_len)) // bq)
+        dk, dv = jax.lax.fori_loop(start, nqb, body, (dk0, dv0))
+    else:
+        dk, dv = jax.lax.fori_loop(0, nqb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
+                   scale, causal, kv_len, q_len, bq, bk):
+    """One (batch*head, q-block) program: accumulate dQ over k blocks."""
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)                # [BQ, D]
+    do = do_ref[0].astype(jnp.float32)
+    qblk = pl.program_id(1)
+    lse = lse_ref[0, 0, pl.ds(qblk * bq, bq)].reshape(bq, 1)
+    dd = dd_ref[0, 0, pl.ds(qblk * bq, bq)].reshape(bq, 1)
+    nkb = kv_len // bk
+    d = q.shape[1]
+    dq0 = jnp.zeros((bq, d), jnp.float32)
+
+    def body(j, dq):
+        kblk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            q_idx = (kv_len - q_len) + qblk * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            k_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (bq, bk), 1)
+            p = jnp.where(k_idx <= q_idx, p, 0.0)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dd) * scale
+        return dq + jax.lax.dot_general(ds, kblk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    if causal:
+        diag = kv_len - q_len + (qblk + 1) * bq
+        upper = jnp.minimum(nkb, (diag + bk - 1) // bk)
+        dq = jax.lax.fori_loop(0, upper, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, nkb, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale):
+    """Flash backward: dQ/dK/dV without materialising S x S in HBM."""
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    d_pad = _pad_dim(d)
+    if d != d_pad:
+        pad = [(0, 0)] * 3 + [(0, d_pad - d)]
+        q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
+        out, g = jnp.pad(out, pad), jnp.pad(g, pad)
+    qr = q.reshape(b * h, sq, d_pad)
+    kr = k.reshape(b * h, sk, d_pad)
+    vr = v.reshape(b * h, sk, d_pad)
+    dor = g.reshape(b * h, sq, d_pad)
+    # dd = rowsum(dO * O): cheap elementwise reduce, XLA fuses it
+    dd = jnp.sum(dor.astype(jnp.float32)
+                 * out.reshape(b * h, sq, d_pad).astype(jnp.float32),
+                 axis=-1).reshape(b * h, 1, sq)
+
+    interpret = jax.default_backend() == "cpu"
+    bq_, bk_ = _blk(_BQ, sq), _blk(_BK, sk)
+    dkv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=s, causal=causal,
+                          kv_len=sk, q_len=sq, bq=bq_, bk=bk_),
+        grid=(b * h, sk // bk_),
+        in_specs=[
+            pl.BlockSpec((1, sq, d_pad), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, bk_, d_pad), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk_, d_pad), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, sq, d_pad), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bh, j: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk_, d_pad), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk_, d_pad), lambda bh, j: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d_pad), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d_pad), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse, dd)
+    dk, dv = dkv
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=s, causal=causal,
+                          kv_len=sk, q_len=sq, bq=bq_, bk=bk_),
+        grid=(b * h, sq // bq_),
+        in_specs=[
+            pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d_pad), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d_pad), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d_pad), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, dor, lse, dd)
+
+    dq = dq.reshape(b, h, sq, d_pad)
+    dk = dk.reshape(b, h, sk, d_pad)
+    dv = dv.reshape(b, h, sk, d_pad)
+    if d != d_pad:
+        dq, dk, dv = dq[..., :d], dk[..., :d], dv[..., :d]
+    return dq, dk, dv
 
 
 def _kernel_eligible(q, k, mask, dropout_p):
     if mask is not None or dropout_p:
         return False
     sq, sk = q.shape[2], k.shape[2]
-    return (sq % _BQ == 0 and sk % _BK == 0 and sq >= _BQ and sk >= _BK)
+    return (sq % 128 == 0 and sk % 128 == 0
+            and sq >= 128 and sk >= 128)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_core(q, k, v, causal, scale):
-    return _flash_fwd_pallas(q, k, v, causal, scale)
+    out, _ = _flash_fwd_pallas(q, k, v, causal, scale)
+    return out
 
 
 def _flash_core_fwd(q, k, v, causal, scale):
-    return _flash_fwd_pallas(q, k, v, causal, scale), (q, k, v)
+    out, lse = _flash_fwd_pallas(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_core_bwd(causal, scale, res, g):
-    q, k, v = res
-    # recompute-based VJP through the XLA reference (flash bwd kernel later)
-    _, vjp_fn = jax.vjp(
-        lambda q_, k_, v_: _sdpa_reference(q_, k_, v_, None, causal, scale),
-        q, k, v)
-    return vjp_fn(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)

@@ -1,0 +1,12 @@
+"""Recommendation model zoo — BASELINE config "Wide&Deep / DeepFM (PS,
+sparse)" model families (ref PaddleRec wide_deep/deepfm nets; the core repo
+exercises them through the PS trainers, tests/test_ps.py style).
+
+TPU-native: the embedding tables are ordinary dense Parameters for
+single-chip / GSPMD training; `wide_deep_sparse_loss` provides the
+PS-trainer variant (AsyncPSTrainer / HeterPSTrainer) where embedding rows
+come from a host-side sparse table.
+"""
+from .models import WideDeep, DeepFM, ctr_loss, wide_deep_sparse_loss
+
+__all__ = ["WideDeep", "DeepFM", "ctr_loss", "wide_deep_sparse_loss"]

@@ -1,0 +1,133 @@
+"""Wide&Deep and DeepFM (ref PaddleRec models/rank/{wide_deep,deepfm};
+the reference trains them through the PS stack — BASELINE config 5).
+
+Both models take
+  sparse_ids: int tensor [B, n_fields] of feature ids into one shared
+              vocabulary (field offsets pre-applied, the usual PS layout)
+  dense_x:    float tensor [B, n_dense] of continuous features
+and return logits [B] (binary CTR-style objective).
+
+`wide_deep_sparse_loss` builds the pure-functional variant used by the PS
+trainers (AsyncPSTrainer / HeterPSTrainer), where the embedding block comes
+from the host sparse table instead of a device Parameter (see
+distributed/fleet/heter.py).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.manipulation import concat
+
+
+class _MLP(nn.Layer):
+    def __init__(self, in_dim, hidden, act="relu"):
+        super().__init__()
+        layers = []
+        d = in_dim
+        for h in hidden:
+            layers.append(nn.Linear(d, h))
+            layers.append(nn.ReLU())
+            d = h
+        layers.append(nn.Linear(d, 1))
+        self.net = nn.Sequential(*layers)
+
+    def forward(self, x):
+        return self.net(x)
+
+
+class WideDeep(nn.Layer):
+    """wide (linear-over-ids) + deep (embedding MLP) joint logit."""
+
+    def __init__(self, vocab_size, emb_dim=8, n_fields=4, n_dense=4,
+                 hidden=(64, 32)):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.emb_dim = emb_dim
+        self.n_fields = n_fields
+        # wide part: per-id scalar weight == 1-dim embedding
+        self.wide_emb = nn.Embedding(vocab_size, 1)
+        self.deep_emb = nn.Embedding(vocab_size, emb_dim)
+        self.deep_mlp = _MLP(n_fields * emb_dim + n_dense, list(hidden))
+        self.bias = self.create_parameter(
+            [1], default_initializer=nn.initializer.Constant(0.0))
+
+    def forward(self, sparse_ids, dense_x):
+        b = sparse_ids.shape[0]
+        wide = self.wide_emb(sparse_ids).reshape([b, self.n_fields]) \
+                   .sum(axis=1)
+        emb = self.deep_emb(sparse_ids).reshape(
+            [b, self.n_fields * self.emb_dim])
+        deep_in = concat([emb, dense_x], axis=1)
+        deep = self.deep_mlp(deep_in).reshape([b])
+        return wide + deep + self.bias
+
+
+class DeepFM(nn.Layer):
+    """FM second-order interactions + deep MLP over shared embeddings
+    (ref deepfm_net: first_order + sum-square trick + DNN)."""
+
+    def __init__(self, vocab_size, emb_dim=8, n_fields=4, n_dense=0,
+                 hidden=(64, 32)):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.emb_dim = emb_dim
+        self.n_fields = n_fields
+        self.first_emb = nn.Embedding(vocab_size, 1)
+        self.second_emb = nn.Embedding(vocab_size, emb_dim)
+        self.mlp = _MLP(n_fields * emb_dim + n_dense, list(hidden))
+        self.bias = self.create_parameter(
+            [1], default_initializer=nn.initializer.Constant(0.0))
+
+    def forward(self, sparse_ids, dense_x=None):
+        b = sparse_ids.shape[0]
+        first = self.first_emb(sparse_ids).reshape([b, self.n_fields]) \
+                    .sum(axis=1)
+        e = self.second_emb(sparse_ids)          # [B, F, D]
+        # FM: 0.5 * sum_d((sum_f e)^2 - sum_f e^2)
+        s = e.sum(axis=1)
+        fm = 0.5 * (s * s - (e * e).sum(axis=1)).sum(axis=1)
+        flat = e.reshape([b, self.n_fields * self.emb_dim])
+        deep_in = flat if dense_x is None \
+            else concat([flat, dense_x], axis=1)
+        deep = self.mlp(deep_in).reshape([b])
+        return first + fm + deep + self.bias
+
+
+def ctr_loss(logits, labels):
+    """Binary logistic loss on raw logits (ref log_loss over sigmoid)."""
+    return F.binary_cross_entropy_with_logits(logits, labels)
+
+
+# ---------------------------------------------------------------- PS path
+
+def wide_deep_sparse_loss(n_fields, emb_dim, n_dense, hidden=(64, 32)):
+    """Build (params_template, loss_fn) for the PS trainers: the deep
+    embedding block comes from the host sparse table (wide weights fold
+    into the table's first column). loss_fn(params, urows, inv, dense_x,
+    labels) -> scalar; `urows[inv]` = per-(b,field) rows [B*F, 1+emb_dim]
+    where col 0 is the wide weight."""
+    rng = np.random.RandomState(0)
+    d_in = n_fields * emb_dim + n_dense
+    params = {"w1": rng.normal(0, 0.05, (d_in, hidden[0])).astype("f4"),
+              "b1": np.zeros(hidden[0], "f4"),
+              "w2": rng.normal(0, 0.05, (hidden[0], hidden[1])).astype("f4"),
+              "b2": np.zeros(hidden[1], "f4"),
+              "w3": rng.normal(0, 0.05, (hidden[1], 1)).astype("f4"),
+              "b3": np.zeros(1, "f4")}
+
+    def loss_fn(p, urows, inv, dense_x, labels):
+        rows = urows[inv]                      # [B*F, 1+emb_dim]
+        b = labels.shape[0]
+        wide = rows[:, 0].reshape(b, n_fields).sum(axis=1)
+        emb = rows[:, 1:].reshape(b, n_fields * emb_dim)
+        x = jnp.concatenate([emb, dense_x], axis=1) if n_dense \
+            else emb
+        h = jnp.maximum(x @ p["w1"] + p["b1"], 0.0)
+        h = jnp.maximum(h @ p["w2"] + p["b2"], 0.0)
+        logit = (h @ p["w3"] + p["b3"])[:, 0] + wide
+        z = jnp.clip(logit, -30, 30)
+        return jnp.mean(jnp.log1p(jnp.exp(-jnp.abs(z)))
+                        + jnp.maximum(z, 0.0) - z * labels)
+
+    return params, loss_fn

@@ -4,6 +4,7 @@ Measures steady-state step time (after warmup absorbing compile + the
 one-time relayout step) for several batch sizes, with the persistent
 compilation cache enabled so re-runs are cheap.
 """
+import os
 import time
 import sys
 
@@ -11,7 +12,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_REPO, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import paddle_tpu as pt
@@ -54,6 +57,7 @@ for batch in [int(a) for a in sys.argv[1:]] or [8, 16, 32]:
     toks = batch * seq / dt
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tf = toks * 6 * n_params / 1e12
+    from bench import PEAK_TFLOPS
     log(f"b={batch}: {dt*1e3:.1f} ms/step  {toks:,.0f} tok/s  "
-        f"{tf:.1f} TF/s  MFU={tf/197:.3f}")
+        f"{tf:.1f} TF/s  MFU={tf/PEAK_TFLOPS:.3f}")
     del step, model, opt

@@ -167,3 +167,34 @@ def test_flash_causal_decode_offset():
     out_k = _flash_array(q, k, v, causal=True)
     out_r = _sdpa_reference(q, k, v, None, True, None)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bwd_kernel_grads_noncausal_and_offset(causal):
+    """Flash BACKWARD kernel parity (dQ/dK/dV from saved-lse tile
+    recompute) incl. the sq != sk decode offset."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 2, 128, 64).astype("f4"))
+    k = jnp.asarray(rng.randn(1, 2, 256, 64).astype("f4"))
+    v = jnp.asarray(rng.randn(1, 2, 256, 64).astype("f4"))
+    gk = jax.grad(lambda *a: jnp.sum(_flash_array(*a, causal=causal) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(
+        _sdpa_reference(*a, None, causal, None) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
+
+
+def test_flash_bwd_kernel_bf16():
+    """bf16 inputs: grads come back bf16 and close to the f32 reference."""
+    rng = np.random.RandomState(4)
+    qf = rng.randn(1, 2, 128, 128).astype("f4")
+    q = jnp.asarray(qf, jnp.bfloat16)
+    gk = jax.grad(lambda a: jnp.sum(
+        _flash_array(a, a, a, causal=True).astype(jnp.float32) ** 2))(q)
+    gr = jax.grad(lambda a: jnp.sum(
+        _sdpa_reference(a, a, a, None, True, None) ** 2))(jnp.asarray(qf))
+    assert gk.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(gk, np.float32), np.asarray(gr),
+                               atol=0.15, rtol=0.1)
