@@ -24,4 +24,11 @@ def __getattr__(name):
     if name == "TheOnePSRuntime":
         from .runtime import TheOnePSRuntime
         return TheOnePSRuntime
+    if name == "util":
+        # ref fleet_base.py `util` property: host-collective helpers
+        from .base import _fleet
+        return _fleet.util
+    if name == "metrics":
+        import importlib
+        return importlib.import_module(__name__ + ".metrics")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -144,15 +144,40 @@ class DistributedStrategy:
 
 
 class UtilBase:
+    """ref fleet/utils/fleet_util.py + base/util_factory.py — host-side
+    collectives delegate to the gloo-analog kv-store collective when the
+    launcher set one up (distributed/gloo.py), single-process fallback
+    otherwise."""
+
+    def _host(self):
+        if not hasattr(self, "_host_coll"):
+            from ..gloo import collective_from_env
+            self._host_coll = collective_from_env()
+        return self._host_coll
+
     def all_reduce(self, input, mode="sum", comm_world="worker"):
-        return input
+        hc = self._host()
+        if hc is None:
+            return input
+        import numpy as np
+        out = hc.all_reduce(np.asarray(input), op=mode)
+        return out if hasattr(input, "shape") else type(input)(out)
 
     def barrier(self, comm_world="worker"):
+        hc = self._host()
+        if hc is not None:
+            hc.barrier()
+            return
         from ..collective import barrier as _barrier
         _barrier()
 
     def all_gather(self, input, comm_world="worker"):
-        return [input]
+        hc = self._host()
+        if hc is None:
+            return [input]
+        import json as _json
+        parts = hc.all_gather(_json.dumps(input).encode())
+        return [_json.loads(p) for p in parts]
 
     def get_file_shard(self, files):
         idx = worker_index()

@@ -1,0 +1,67 @@
+"""Distributed metric aggregation (ref fleet/metrics/metric.py — sum/max/
+min/auc/rmse aggregated across workers over gloo/fleet util).
+
+Each helper takes a local metric value (array or scalar) and returns the
+global aggregate using the fleet util host collective (single-process:
+identity). AUC aggregates the positive/negative histogram buckets, NOT
+the local AUCs — same math as the reference's global_auc."""
+import numpy as np
+
+
+def _util():
+    from .base import _fleet
+    return _fleet.util
+
+
+def sum(value, comm_world="worker"):  # noqa: A001 - paddle api name
+    return _util().all_reduce(np.asarray(value, np.float64), "sum",
+                              comm_world)
+
+
+def max(value, comm_world="worker"):  # noqa: A001
+    return _util().all_reduce(np.asarray(value, np.float64), "max",
+                              comm_world)
+
+
+def min(value, comm_world="worker"):  # noqa: A001
+    return _util().all_reduce(np.asarray(value, np.float64), "min",
+                              comm_world)
+
+
+def mean(value, count, comm_world="worker"):
+    """Global weighted mean from (local sum, local count)."""
+    tot = _util().all_reduce(np.asarray([value, count], np.float64),
+                             "sum", comm_world)
+    return float(tot[0]) / np.maximum(float(tot[1]), 1e-12)
+
+
+def acc(correct, total, comm_world="worker"):
+    return mean(correct, total, comm_world)
+
+
+def rmse(sq_err_sum, count, comm_world="worker"):
+    return float(np.sqrt(mean(sq_err_sum, count, comm_world)))
+
+
+def mae(abs_err_sum, count, comm_world="worker"):
+    return mean(abs_err_sum, count, comm_world)
+
+
+def auc(pos_bins, neg_bins, comm_world="worker"):
+    """Global AUC from per-worker score histograms: pos_bins[i]/neg_bins[i]
+    count positives/negatives whose score fell in bucket i (ascending
+    score). Aggregate the histograms, then trapezoid over the ROC."""
+    pos = np.asarray(_util().all_reduce(
+        np.asarray(pos_bins, np.float64), "sum", comm_world))
+    neg = np.asarray(_util().all_reduce(
+        np.asarray(neg_bins, np.float64), "sum", comm_world))
+    # descending score order for cumulative TP/FP
+    tp = np.cumsum(pos[::-1])
+    fp = np.cumsum(neg[::-1])
+    tot_p, tot_n = tp[-1], fp[-1]
+    if tot_p == 0 or tot_n == 0:
+        return 0.5
+    tpr = np.concatenate([[0.0], tp / tot_p])
+    fpr = np.concatenate([[0.0], fp / tot_n])
+    return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") \
+        else float(np.trapz(tpr, fpr))

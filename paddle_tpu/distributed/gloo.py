@@ -1,0 +1,292 @@
+"""Host-side (CPU) collectives — the GlooWrapper analog.
+
+The reference carries gloo for everything that must synchronise OUTSIDE
+the accelerator ring: role_maker rendezvous, fleet.util barriers,
+dataset global shuffle, distributed metric aggregation
+(ref framework/fleet/gloo_wrapper.h:113, platform/gloo_context.cc,
+fleet/base/role_maker.py:33 — gloo over HTTP/file/HDFS kv stores).
+
+TPU-native stance: device collectives are XLA's job (lax.psum over the
+mesh); the HOST control plane still needs its own rendezvous, so this
+module provides a dependency-free kv-store + collective set:
+
+  - KVStore        — tiny TCP key/value service (set/get-wait/add), the
+                     HTTP-store analog; values are opaque bytes
+  - FileKVStore    — shared-filesystem store (the file-store analog)
+  - HostCollective — rank/world barrier, all_gather, broadcast,
+                     all_reduce(np) built on either store
+
+Wire format (TCP): one JSON line per request/response, values base64 —
+control-plane sized payloads, no pickle on the wire.
+"""
+import base64
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+
+import numpy as np
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        store = self.server.store
+        for raw in self.rfile:
+            try:
+                req = json.loads(raw)
+                op = req["op"]
+                key = req.get("key", "")
+                if op == "set":
+                    with store.cond:
+                        store.data[key] = base64.b64decode(req["val"])
+                        store.cond.notify_all()
+                    resp = {"ok": True}
+                elif op == "get":
+                    deadline = time.time() + float(req.get("timeout", 60))
+                    with store.cond:
+                        while key not in store.data:
+                            left = deadline - time.time()
+                            if left <= 0:
+                                break
+                            store.cond.wait(left)
+                        val = store.data.get(key)
+                    if val is None:
+                        resp = {"ok": False, "err": f"timeout on {key!r}"}
+                    else:
+                        resp = {"ok": True,
+                                "val": base64.b64encode(val).decode()}
+                elif op == "delete":
+                    with store.cond:
+                        store.data.pop(key, None)
+                    resp = {"ok": True}
+                elif op == "add":
+                    with store.cond:
+                        cur = int(store.data.get(key, b"0"))
+                        cur += int(req.get("delta", 1))
+                        store.data[key] = str(cur).encode()
+                        store.cond.notify_all()
+                    resp = {"ok": True, "val": cur}
+                else:
+                    resp = {"ok": False, "err": f"bad op {op!r}"}
+            except Exception as e:  # keep the store alive on bad input
+                resp = {"ok": False, "err": f"{type(e).__name__}: {e}"}
+            self.wfile.write(json.dumps(resp).encode() + b"\n")
+            self.wfile.flush()
+
+
+class KVStore:
+    """TCP kv service. Start on rank 0 (or a dedicated host); every rank
+    connects with KVClient. ref role_maker's HTTP kv store."""
+
+    def __init__(self, port=0, host="0.0.0.0"):
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.data = {}
+        self.cond = threading.Condition()
+        self._srv = _Srv((host, port), _Handler)
+        self._srv.store = self
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class KVClient:
+    def __init__(self, host="127.0.0.1", port=None):
+        self._addr = (host, int(port))
+        self._sock = None
+
+    def _conn(self):
+        if self._sock is None:
+            self._sock = socket.create_connection(self._addr, timeout=120)
+            self._file = self._sock.makefile("rb")
+        return self._sock
+
+    def _rpc(self, req):
+        s = self._conn()
+        s.sendall(json.dumps(req).encode() + b"\n")
+        resp = json.loads(self._file.readline())
+        if not resp.get("ok"):
+            raise RuntimeError(f"kv store: {resp.get('err')}")
+        return resp
+
+    def set(self, key, val: bytes):
+        self._rpc({"op": "set", "key": key,
+                   "val": base64.b64encode(val).decode()})
+
+    def get(self, key, timeout=60) -> bytes:
+        r = self._rpc({"op": "get", "key": key, "timeout": timeout})
+        return base64.b64decode(r["val"])
+
+    def add(self, key, delta=1) -> int:
+        return int(self._rpc({"op": "add", "key": key,
+                              "delta": delta})["val"])
+
+    def delete(self, key):
+        self._rpc({"op": "delete", "key": key})
+
+    def close(self):
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+
+class FileKVStore:
+    """Shared-filesystem store (ref role_maker file-store rendezvous):
+    one file per key under `root`; works across hosts on NFS-like FS."""
+
+    def __init__(self, root):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key):
+        safe = base64.urlsafe_b64encode(key.encode()).decode()
+        return os.path.join(self.root, safe)
+
+    def set(self, key, val: bytes):
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(val)
+        os.replace(tmp, self._path(key))
+
+    def get(self, key, timeout=60) -> bytes:
+        deadline = time.time() + timeout
+        p = self._path(key)
+        while time.time() < deadline:
+            try:
+                with open(p, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                time.sleep(0.02)
+        raise RuntimeError(f"kv store: timeout on {key!r}")
+
+    def add(self, key, delta=1) -> int:
+        # cross-process atomicity via a lock file
+        lock = self._path(key) + ".lock"
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                break
+            except FileExistsError:
+                time.sleep(0.005)
+        try:
+            try:
+                cur = int(self.get(key, timeout=0.01))
+            except RuntimeError:
+                cur = 0
+            cur += delta
+            self.set(key, str(cur).encode())
+            return cur
+        finally:
+            os.close(fd)
+            os.unlink(lock)
+
+    def delete(self, key):
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def close(self):
+        pass
+
+
+class HostCollective:
+    """Rank/world collectives over a kv store (GlooWrapper analog).
+    Generation counters make the primitives reusable (each call uses a
+    fresh key namespace), and each completed generation deletes the
+    PREVIOUS generation's keys: completing gen g proves every rank
+    finished gen g-1 (a rank only posts to g after its g-1 call
+    returned), so the store stays O(world) keys per primitive instead of
+    growing for the life of the job."""
+
+    def __init__(self, rank, world, store, scope="default"):
+        self.rank = int(rank)
+        self.world = int(world)
+        self.store = store
+        self.scope = scope
+        self._gen = {}
+        self._prev_keys = {}   # kind -> keys of the previous generation
+
+    def _key(self, kind, *extra):
+        g = self._gen.get(kind, 0)
+        self._gen[kind] = g + 1
+        parts = [self.scope, kind, str(g)] + [str(e) for e in extra]
+        return "/".join(parts), g
+
+    def _cleanup(self, kind, keys):
+        """Called on completing a generation: rank 0 deletes the previous
+        generation's keys and remembers this one's for next time."""
+        if self.rank == 0:
+            for k in self._prev_keys.get(kind, ()):
+                self.store.delete(k)
+        self._prev_keys[kind] = keys
+
+    def barrier(self, timeout=120):
+        key, g = self._key("barrier")
+        n = self.store.add(key, 1)
+        done = f"{key}/done"
+        if n == self.world:
+            self.store.set(done, b"1")
+        self.store.get(done, timeout=timeout)
+        self._cleanup("barrier", [key, done])
+
+    def all_gather(self, data: bytes, timeout=120):
+        """Returns list of every rank's bytes, rank-ordered."""
+        base, g = self._key("allgather")
+        self.store.set(f"{base}/{self.rank}", data)
+        out = []
+        for r in range(self.world):
+            out.append(self.store.get(f"{base}/{r}", timeout=timeout))
+        self._cleanup("allgather",
+                      [f"{base}/{r}" for r in range(self.world)])
+        return out
+
+    def broadcast(self, data, src=0, timeout=120):
+        base, g = self._key("bcast")
+        if self.rank == src:
+            self.store.set(base, data)
+        else:
+            data = self.store.get(base, timeout=timeout)
+        self._cleanup("bcast", [base])
+        return data
+
+    def all_reduce(self, arr, op="sum", timeout=120):
+        """Small-array host allreduce (metrics, role bookkeeping)."""
+        a = np.asarray(arr)
+        parts = self.all_gather(a.tobytes(), timeout=timeout)
+        stack = np.stack([np.frombuffer(p, dtype=a.dtype).reshape(a.shape)
+                          for p in parts])
+        if op == "sum":
+            return stack.sum(axis=0)
+        if op == "max":
+            return stack.max(axis=0)
+        if op == "min":
+            return stack.min(axis=0)
+        raise ValueError(f"unknown op {op!r}")
+
+
+def collective_from_env():
+    """Build a HostCollective from the launcher env, or None when not in
+    a distributed run. Honors PADDLE_GLOO_HTTP_ENDPOINT (kv server) and
+    PADDLE_GLOO_FS_PATH (shared-fs store) like the reference role_maker."""
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if world <= 1:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    ep = os.environ.get("PADDLE_GLOO_HTTP_ENDPOINT")
+    if ep:
+        host, port = ep.rsplit(":", 1)
+        return HostCollective(rank, world, KVClient(host, port))
+    fs = os.environ.get("PADDLE_GLOO_FS_PATH")
+    if fs:
+        return HostCollective(rank, world, FileKVStore(fs))
+    return None

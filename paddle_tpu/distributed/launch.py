@@ -147,9 +147,25 @@ def launch_procs(pod, script, script_args, nproc, log_dir=None,
 def _run_pod_once(pod, mine, script, script_args, nproc, log_dir, attempt=0):
     procs = []
     logs = []
+    # host-collective rendezvous (gloo analog, ref role_maker gloo HTTP
+    # store): the rank-0 pod hosts a kv store on a DETERMINISTIC port
+    # (coordinator port + 1) so every pod — including remote hosts whose
+    # launcher can't receive env from ours — computes the same endpoint;
+    # the store binds all interfaces for them. An externally provided
+    # PADDLE_GLOO_HTTP_ENDPOINT (cluster scheduler) wins.
+    kv = None
+    kv_ep = os.environ.get("PADDLE_GLOO_HTTP_ENDPOINT")
+    if kv_ep is None and pod.coordinator:
+        host, cport = pod.coordinator.rsplit(":", 1)
+        kv_ep = f"{host}:{int(cport) + 1}"
+        if mine and mine[0].rank == 0:
+            from .gloo import KVStore
+            kv = KVStore(port=int(cport) + 1)
     for t in mine:
         env = _rank_env(pod, t, nproc, script_args)
         env["PADDLE_RESTART_ATTEMPT"] = str(attempt)
+        if kv_ep:
+            env["PADDLE_GLOO_HTTP_ENDPOINT"] = kv_ep
         cmd = [sys.executable, "-u", script] + list(script_args)
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
@@ -190,6 +206,8 @@ def _run_pod_once(pod, mine, script, script_args, nproc, log_dir, attempt=0):
     finally:
         for f in logs:
             f.close()
+        if kv is not None:
+            kv.stop()
 
 
 def main(argv=None):

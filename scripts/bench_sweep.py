@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+from bench import PEAK_TFLOPS
 jax.config.update("jax_compilation_cache_dir",
                   os.path.join(_REPO, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
@@ -57,7 +59,6 @@ for batch in [int(a) for a in sys.argv[1:]] or [8, 16, 32]:
     toks = batch * seq / dt
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tf = toks * 6 * n_params / 1e12
-    from bench import PEAK_TFLOPS
     log(f"b={batch}: {dt*1e3:.1f} ms/step  {toks:,.0f} tok/s  "
         f"{tf:.1f} TF/s  MFU={tf/PEAK_TFLOPS:.3f}")
     del step, model, opt

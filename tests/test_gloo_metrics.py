@@ -1,0 +1,167 @@
+"""Host-collective (gloo analog) + distributed metrics tests
+(ref gloo_wrapper / fleet/metrics/metric.py; N workers simulated as
+threads against one kv store, plus a real 2-process file-store run)."""
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.gloo import (KVStore, KVClient, FileKVStore,
+                                         HostCollective)
+
+
+def _run_world(world, fn, store_factory):
+    outs = [None] * world
+    errs = []
+
+    def work(r):
+        try:
+            hc = HostCollective(r, world, store_factory())
+            outs[r] = fn(hc, r)
+        except Exception as e:
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=work, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs, errs
+    return outs
+
+
+class TestTcpStore:
+    def test_barrier_allgather_allreduce(self):
+        srv = KVStore()
+        try:
+            def fn(hc, r):
+                hc.barrier()
+                gathered = hc.all_gather(f"rank{r}".encode())
+                red = hc.all_reduce(np.asarray([r + 1.0, 2.0 * r]))
+                bc = hc.broadcast(b"hello" if r == 0 else None, src=0)
+                hc.barrier()
+                return gathered, red, bc
+
+            outs = _run_world(4, fn,
+                              lambda: KVClient(port=srv.port))
+            for gathered, red, bc in outs:
+                assert gathered == [b"rank0", b"rank1", b"rank2", b"rank3"]
+                np.testing.assert_allclose(red, [10.0, 12.0])
+                assert bc == b"hello"
+        finally:
+            srv.stop()
+
+    def test_reusable_generations(self):
+        srv = KVStore()
+        try:
+            def fn(hc, r):
+                vals = []
+                for i in range(3):
+                    vals.append(hc.all_reduce(np.asarray([float(i + r)])))
+                return vals
+
+            outs = _run_world(2, fn, lambda: KVClient(port=srv.port))
+            for vals in outs:
+                np.testing.assert_allclose(np.concatenate(vals),
+                                           [1.0, 3.0, 5.0])
+        finally:
+            srv.stop()
+
+
+def test_file_store_two_processes(tmp_path):
+    """Real cross-process rendezvous over the shared-fs store."""
+    prog = r"""
+import sys
+import numpy as np
+from paddle_tpu.distributed.gloo import FileKVStore, HostCollective
+rank = int(sys.argv[1]); root = sys.argv[2]
+hc = HostCollective(rank, 2, FileKVStore(root))
+hc.barrier()
+out = hc.all_reduce(np.asarray([rank + 1.0]))
+assert out[0] == 3.0, out
+print("OK", rank)
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen([sys.executable, "-c", prog, str(r),
+                               str(tmp_path / "kv")],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+             for r in range(2)]
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, out.decode()
+        assert b"OK" in out
+
+
+class TestFleetMetrics:
+    def test_single_process_identity_and_auc(self):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.fleet import metrics as M
+        fleet.init()
+        assert float(M.sum(3.0)) == 3.0
+        assert M.mean(6.0, 3.0) == pytest.approx(2.0)
+        assert M.rmse(8.0, 2.0) == pytest.approx(2.0)
+        # AUC: perfect separation -> 1.0; uniform mixing -> 0.5
+        pos = np.zeros(10); pos[9] = 100     # all positives in top bucket
+        neg = np.zeros(10); neg[0] = 100
+        assert M.auc(pos, neg) == pytest.approx(1.0)
+        assert M.auc(np.ones(10), np.ones(10)) == pytest.approx(0.5)
+
+    def test_util_uses_env_collective(self, tmp_path, monkeypatch):
+        """UtilBase picks up the file-store collective from the env; with
+        world=1... simulate world=2 via two threads sharing one store."""
+        from paddle_tpu.distributed.gloo import FileKVStore, HostCollective
+        from paddle_tpu.distributed.fleet.base import UtilBase
+
+        root = str(tmp_path / "kv2")
+        outs = []
+
+        def worker(r):
+            u = UtilBase()
+            u._host_coll = HostCollective(r, 2, FileKVStore(root))
+            outs.append(sorted(u.all_gather({"rank": r})[i]["rank"]
+                               for i in range(2)))
+
+        ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert outs and all(o == [0, 1] for o in outs)
+
+
+def test_launcher_wires_gloo_endpoint(tmp_path):
+    """End-to-end: the launcher stands up the kv store, exports
+    PADDLE_GLOO_HTTP_ENDPOINT, and fleet.util host collectives work
+    across the launched ranks."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import paddle_tpu.distributed.fleet as fleet\n"
+        "assert os.environ.get('PADDLE_GLOO_HTTP_ENDPOINT'), 'no ep'\n"
+        "fleet.init()\n"
+        "from paddle_tpu.distributed.fleet.base import _fleet\n"
+        "r = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "got = _fleet.util.all_gather({'r': r})\n"
+        "assert sorted(g['r'] for g in got) == [0, 1], got\n"
+        "s = _fleet.util.all_reduce(np.asarray([r + 1.0]))\n"
+        "assert float(s[0]) == 3.0, s\n"
+        "print('WORKER OK', r)\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PADDLE_GLOO_HTTP_ENDPOINT", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    port = 40300 + os.getpid() % 1500      # avoid cross-run collisions
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--start_port", str(port), str(script)],
+        env=env, capture_output=True, timeout=180, cwd=repo)
+    assert r.returncode == 0, (r.stdout.decode(), r.stderr.decode())
