@@ -376,3 +376,4 @@ class no_grad:
                 return fn(*a, **k)
 
         return wrapper
+

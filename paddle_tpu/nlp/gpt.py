@@ -30,7 +30,8 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, ffn_hidden_size=None, max_seq_len=1024,
                  dropout=0.1, attn_dropout=0.1, initializer_range=0.02,
-                 use_recompute=False, sequence_parallel=False):
+                 use_recompute=False, sequence_parallel=False,
+                 moe_experts=0, moe_k=2, moe_capacity_factor=1.25):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -46,6 +47,11 @@ class GPTConfig:
         # distributed/ring_attention.py) | "ulysses" (all-to-all head
         # redistribution, distributed/ulysses.py)
         self.sequence_parallel = sequence_parallel
+        # MoE FFN: >0 replaces every block's MLP with an expert-parallel
+        # MoELayer over the 'ep' mesh axis (incubate/moe.py)
+        self.moe_experts = int(moe_experts)
+        self.moe_k = moe_k
+        self.moe_capacity_factor = moe_capacity_factor
 
 
 def gpt2_small(**kw):
@@ -170,12 +176,22 @@ class GPTBlock(nn.Layer):
         self.ln_1 = nn.LayerNorm(cfg.hidden_size)
         self.attn = GPTAttention(cfg)
         self.ln_2 = nn.LayerNorm(cfg.hidden_size)
-        self.mlp = GPTMLP(cfg)
+        if cfg.moe_experts:
+            from ..incubate.moe import MoELayer
+            self.mlp = MoELayer(cfg.hidden_size, cfg.ffn_hidden_size,
+                                cfg.moe_experts, k=cfg.moe_k,
+                                capacity_factor=cfg.moe_capacity_factor,
+                                initializer_range=cfg.initializer_range)
+        else:
+            self.mlp = GPTMLP(cfg)
 
     def forward(self, x):
         x = x + self.attn(self.ln_1(x))
-        x = x + self.mlp(self.ln_2(x))
-        return x
+        m = self.mlp(self.ln_2(x))
+        if isinstance(m, tuple):         # MoE FFN: (out, aux_loss)
+            x = x + m[0]
+            return x, m[1]
+        return x + m
 
     def decode(self, x, cache, pos):
         a, cache = self.attn.decode(self.ln_1(x), cache, pos)
@@ -216,16 +232,27 @@ class GPTModel(nn.Layer):
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
 
     def forward(self, input_ids, position_ids=None):
+        """Returns hidden states; with MoE blocks, (hidden, aux_total) —
+        the aux loss flows through the data path (remat-safe: it is an
+        output of each checkpointed block, so it is a valid outer-trace
+        value; no global side-channel)."""
         x = self.embeddings(input_ids, position_ids)
         use_remat = self.cfg.use_recompute
-        if use_remat:
-            from ..incubate.recompute import recompute
-            for blk in self.blocks:
-                x = recompute(blk, x)
-        else:
-            for blk in self.blocks:
-                x = blk(x)
-        return self.ln_f(x)
+        moe = bool(self.cfg.moe_experts)
+        aux_total = None
+        for blk in self.blocks:
+            if use_remat:
+                from ..incubate.recompute import recompute
+                out = recompute(blk, x)
+            else:
+                out = blk(x)
+            if moe:
+                x, aux = out
+                aux_total = aux if aux_total is None else aux_total + aux
+            else:
+                x = out
+        h = self.ln_f(x)
+        return (h, aux_total) if moe else h
 
     def init_cache(self, batch, max_len, dtype=jnp.float32):
         return [blk.attn.init_cache(batch, max_len, dtype)
@@ -253,10 +280,15 @@ class GPTForPretraining(nn.Layer):
         self.cfg = cfg
 
     def forward(self, input_ids, position_ids=None):
-        hidden = self.gpt(input_ids, position_ids)
+        out = self.gpt(input_ids, position_ids)
+        hidden, aux = out if isinstance(out, tuple) else (out, None)
         w = self.gpt.embeddings.word_embeddings.weight
         from ..ops.math import matmul
         logits = matmul(hidden, w, transpose_y=True)
+        if aux is not None:
+            # ride the exact Tensor handed to the loss fn — per-call, no
+            # global state, safe across interleaved models/forwards
+            logits._moe_aux_loss = aux
         return logits
 
     def loss(self, logits, labels):
@@ -280,8 +312,14 @@ def gpt_pretrain_loss(logits, labels):
     from ..ops.creation import full
     ign = full([b, 1], -1, dtype="int64")
     shifted = concat([labels[:, 1:].astype("int64"), ign], axis=1)
-    return F.cross_entropy(logits.reshape([b * s, v]),
+    loss = F.cross_entropy(logits.reshape([b * s, v]),
                            shifted.reshape([b * s]), ignore_index=-1)
+    # MoE load-balance aux rides the logits Tensor (GPTForPretraining
+    # attaches it); same-trace under TrainStep, concrete eagerly
+    aux = getattr(logits, "_moe_aux_loss", None)
+    if aux is not None:
+        loss = loss + aux
+    return loss
 
 
 def gpt_generate(model, input_ids, max_new_tokens=32, do_sample=False,
