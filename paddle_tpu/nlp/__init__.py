@@ -4,3 +4,5 @@ ships these as test models dist_transformer.py / the nn.Transformer stack)."""
 from .gpt import (GPTModel, GPTForPretraining, GPTConfig, gpt2_small,
                   gpt2_medium, gpt_generate)
 from .bert import BertModel, BertForPretraining, BertConfig, bert_base, bert_large
+from .llama import (LlamaModel, LlamaForCausalLM, LlamaConfig,
+                    llama_pretrain_loss)

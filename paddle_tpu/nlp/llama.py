@@ -1,0 +1,241 @@
+"""LLaMA-family decoder LM — RMSNorm + RoPE + SwiGLU + grouped-query
+attention. Third NLP model family next to GPT/BERT (the reference era
+predates LLaMA; this is the modern-LLM surface a switching user expects,
+built on the same TPU-native kernel/parallelism substrate).
+
+TPU-first choices:
+  - fused QKV projection sized for GQA (q heads + 2 * kv heads in one
+    MXU matmul); KV heads are repeated with a reshape-broadcast (free
+    under XLA) to feed the shared flash kernel
+  - RoPE applied in f32 with precomputed cos/sin tables (static shapes)
+  - causal Pallas flash attention (ops/pallas) for the [B,H,S,D] core
+  - Megatron TP hints: QKV column-parallel, out row-parallel, SwiGLU
+    gate/up column-parallel, down row-parallel (over 'mp')
+"""
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..distributed import mesh as mesh_mod
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=32000, hidden_size=768,
+                 intermediate_size=None, num_layers=12, num_heads=12,
+                 num_kv_heads=None, max_seq_len=2048, rope_theta=10000.0,
+                 rms_eps=1e-6, initializer_range=0.02,
+                 use_recompute=False, tie_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        # LLaMA sizing: 2/3 * 4h rounded; callers may pass exact values
+        self.intermediate_size = intermediate_size or int(8 * hidden_size / 3)
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads   # GQA when smaller
+        self.max_seq_len = max_seq_len
+        self.rope_theta = rope_theta
+        self.rms_eps = rms_eps
+        self.initializer_range = initializer_range
+        self.use_recompute = use_recompute
+        self.tie_embeddings = tie_embeddings
+        if num_heads % self.num_kv_heads:
+            raise ValueError(f"num_heads {num_heads} not divisible by "
+                             f"num_kv_heads {self.num_kv_heads}")
+
+
+class RMSNorm(nn.Layer):
+    """Root-mean-square norm (no mean subtraction, no bias): stats in f32."""
+
+    def __init__(self, dim, eps=1e-6):
+        super().__init__()
+        self.eps = eps
+        self.weight = self.create_parameter(
+            [dim], default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        from ..ops.dispatch import apply
+
+        def f(x_, w):
+            xf = x_.astype(jnp.float32)
+            var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+            y = xf * jax.lax.rsqrt(var + self.eps)
+            return (y * w.astype(jnp.float32)).astype(x_.dtype)
+
+        return apply(f, (x, self.weight), name="rms_norm")
+
+
+@functools.lru_cache(maxsize=8)
+def rope_tables(seq_len, head_dim, theta=10000.0):
+    """cos/sin tables [S, D/2] in f32. lru-cached so every attention
+    layer of a model shares ONE table (not per-layer copies baked into
+    the traced program)."""
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(seq_len)
+    freqs = np.outer(t, inv)                       # [S, D/2]
+    return (jnp.asarray(np.cos(freqs), jnp.float32),
+            jnp.asarray(np.sin(freqs), jnp.float32))
+
+
+def apply_rope(x, cos, sin, pos_offset=0):
+    """x: [B, H, S, D] array. Rotates pairs (x[2i], x[2i+1]) — f32 math,
+    cast back to x.dtype. A static pos_offset is range-checked (a traced
+    offset can't be; dynamic_slice would clamp silently)."""
+    b, h, s, d = x.shape
+    if isinstance(pos_offset, int) and pos_offset + s > cos.shape[0]:
+        raise ValueError(
+            f"RoPE positions [{pos_offset}, {pos_offset + s}) exceed the "
+            f"table length {cos.shape[0]} (raise max_seq_len)")
+    xf = x.astype(jnp.float32).reshape(b, h, s, d // 2, 2)
+    x1, x2 = xf[..., 0], xf[..., 1]
+    c = jax.lax.dynamic_slice_in_dim(cos, pos_offset, s, axis=0)
+    sn = jax.lax.dynamic_slice_in_dim(sin, pos_offset, s, axis=0)
+    c = c[None, None]                              # [1,1,S,D/2]
+    sn = sn[None, None]
+    y1 = x1 * c - x2 * sn
+    y2 = x1 * sn + x2 * c
+    return jnp.stack([y1, y2], axis=-1).reshape(b, h, s, d).astype(x.dtype)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.num_kv_heads = cfg.num_kv_heads
+        self.head_dim = h // cfg.num_heads
+        init = I.Normal(0.0, cfg.initializer_range)
+        qkv_out = (cfg.num_heads + 2 * cfg.num_kv_heads) * self.head_dim
+        self.qkv_proj = nn.Linear(h, qkv_out, bias_attr=False,
+                                  weight_attr=nn.ParamAttr(initializer=init))
+        self.o_proj = nn.Linear(cfg.num_heads * self.head_dim, h,
+                                bias_attr=False,
+                                weight_attr=nn.ParamAttr(
+                                    initializer=I.Normal(
+                                        0.0, cfg.initializer_range
+                                        / math.sqrt(2 * cfg.num_layers))))
+        self.qkv_proj.weight.sharding = P(None, mesh_mod.MP_AXIS)
+        self.o_proj.weight.sharding = P(mesh_mod.MP_AXIS, None)
+        self._cos, self._sin = rope_tables(cfg.max_seq_len, self.head_dim,
+                                           cfg.rope_theta)
+
+    def forward(self, x):
+        from ..ops.dispatch import apply
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+
+        def f(x_, wqkv):
+            b, s, _ = x_.shape
+            qkv = x_ @ wqkv                              # [B,S,(nh+2kv)*hd]
+            q, k, v = jnp.split(
+                qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+            q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+            v = v.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+            q = apply_rope(q, self._cos, self._sin)
+            k = apply_rope(k, self._cos, self._sin)
+            if nkv != nh:                                 # GQA: repeat KV
+                rep = nh // nkv
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            from ..ops.pallas.flash_attention import _flash_array
+            o = _flash_array(q, k, v, causal=True)
+            return o.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+
+        out = apply(f, (x, self.qkv_proj.weight), name="llama_attention")
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        h, m = cfg.hidden_size, cfg.intermediate_size
+        self.gate_proj = nn.Linear(h, m, bias_attr=False,
+                                   weight_attr=nn.ParamAttr(initializer=init))
+        self.up_proj = nn.Linear(h, m, bias_attr=False,
+                                 weight_attr=nn.ParamAttr(initializer=init))
+        self.down_proj = nn.Linear(m, h, bias_attr=False,
+                                   weight_attr=nn.ParamAttr(
+                                       initializer=I.Normal(
+                                           0.0, cfg.initializer_range
+                                           / math.sqrt(2 * cfg.num_layers))))
+        self.gate_proj.weight.sharding = P(None, mesh_mod.MP_AXIS)
+        self.up_proj.weight.sharding = P(None, mesh_mod.MP_AXIS)
+        self.down_proj.weight.sharding = P(mesh_mod.MP_AXIS, None)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaBlock(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.embed_tokens = nn.Embedding(
+            cfg.vocab_size, cfg.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=init))
+        self.embed_tokens.weight.sharding = P(mesh_mod.MP_AXIS, None)
+        self.layers = nn.LayerList([LlamaBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        if self.cfg.use_recompute:
+            from ..incubate.recompute import recompute
+            for blk in self.layers:
+                x = recompute(blk, x)
+        else:
+            for blk in self.layers:
+                x = blk(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Linear(
+                cfg.hidden_size, cfg.vocab_size, bias_attr=False,
+                weight_attr=nn.ParamAttr(
+                    initializer=I.Normal(0.0, cfg.initializer_range)))
+            self.lm_head.weight.sharding = P(None, mesh_mod.MP_AXIS)
+
+    def forward(self, input_ids):
+        hidden = self.model(input_ids)
+        if self.cfg.tie_embeddings:
+            w = self.model.embed_tokens.weight
+            from ..ops.math import matmul
+            return matmul(hidden, w, transpose_y=True)
+        return self.lm_head(hidden)
+
+
+def llama_pretrain_loss(logits, labels):
+    """Same label-shift CE as GPT (see gpt.gpt_pretrain_loss)."""
+    from .gpt import gpt_pretrain_loss
+    return gpt_pretrain_loss(logits, labels)
