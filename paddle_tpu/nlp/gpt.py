@@ -137,13 +137,9 @@ class GPTAttention(nn.Layer):
                                                  pos, axis=2)
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v_t.astype(cv.dtype),
                                                  pos, axis=2)
-        L = ck.shape[2]
-        scores = jnp.einsum("bhqd,bhld->bhql", q.astype(jnp.float32),
-                            ck.astype(jnp.float32)) / math.sqrt(self.head_dim)
-        mask = jnp.arange(L)[None, None, None, :] <= pos
-        scores = jnp.where(mask, scores, -1e9)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-        out = jnp.einsum("bhql,bhld->bhqd", probs, cv)
+        from ..nn.transformer import cached_decode_attention
+        out = cached_decode_attention(q, ck, cv, pos,
+                                      1.0 / math.sqrt(self.head_dim))
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, -1)
         out = self.out_proj(Tensor(out.astype(x_t._data.dtype)))
         return out, (ck, cv)
@@ -294,6 +290,9 @@ class GPTForPretraining(nn.Layer):
     def loss(self, logits, labels):
         return gpt_pretrain_loss(logits, labels)
 
+    def init_cache(self, batch, max_len, dtype=jnp.float32):
+        return self.gpt.init_cache(batch, max_len, dtype)
+
     def decode_step(self, tok, caches, pos):
         h, caches = self.gpt.decode_step(tok, caches, pos)
         w = self.gpt.embeddings.word_embeddings.weight
@@ -322,11 +321,13 @@ def gpt_pretrain_loss(logits, labels):
     return loss
 
 
-def gpt_generate(model, input_ids, max_new_tokens=32, do_sample=False,
+def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                  top_k=0, top_p=1.0, temperature=1.0, eos_token_id=None,
                  seed=None, use_cache=False):
-    """Autoregressive decode for GPTForPretraining
-    (ref paddlenlp generation_utils.generate: greedy + top-k/top-p sampling).
+    """Autoregressive decode for any causal LM exposing forward(ids) ->
+    logits and (for use_cache=True) init_cache/decode_step — GPT and
+    LLaMA both do (ref paddlenlp generation_utils.generate: greedy +
+    top-k/top-p sampling).
 
     TPU-native: ONE jitted lax.fori_loop over a fixed [B, Lmax] buffer.
     use_cache=False recomputes the (causal) forward over the whole buffer
@@ -444,7 +445,7 @@ def gpt_generate(model, input_ids, max_new_tokens=32, do_sample=False,
 
     @jax.jit
     def run_cached(p, b, buf, key):
-        caches = model.gpt.init_cache(B, L)
+        caches = model.init_cache(B, L)
         finished = jnp.zeros((B,), bool)
         buf, _, _, _ = jax.lax.fori_loop(
             0, L - 1, make_cached_step(p, b), (buf, caches, finished, key))
@@ -455,3 +456,6 @@ def gpt_generate(model, input_ids, max_new_tokens=32, do_sample=False,
     finally:
         if was_training:
             model.train()
+
+
+gpt_generate = generate      # back-compat name

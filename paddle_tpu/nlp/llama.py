@@ -150,6 +150,45 @@ class LlamaAttention(nn.Layer):
         out = apply(f, (x, self.qkv_proj.weight), name="llama_attention")
         return self.o_proj(out)
 
+    # -------------------------------------------------- incremental decode
+    def init_cache(self, batch, max_len, dtype=jnp.float32):
+        """KV cache [B, kv_heads, L, head_dim] x2 — GQA caches only the
+        kv heads (the memory win that motivates GQA at decode time).
+        max_len is validated against the RoPE table here because inside
+        the decode loop `pos` is traced and apply_rope's static range
+        check cannot fire (dynamic_slice would clamp silently)."""
+        if max_len > self._cos.shape[0]:
+            raise ValueError(
+                f"decode length {max_len} exceeds the RoPE table "
+                f"({self._cos.shape[0]}); raise max_seq_len")
+        shape = (batch, self.num_kv_heads, max_len, self.head_dim)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def decode(self, x_t, cache, pos):
+        """One-token step: RoPE at `pos` (traced), write K/V, attend over
+        cache[:pos]. x_t: [B, 1, H] Tensor."""
+        from ..framework.tensor import Tensor
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        b = x_t.shape[0]
+        qkv = self.qkv_proj(x_t)
+        a = qkv._data if isinstance(qkv, Tensor) else qkv
+        q, k_t, v_t = jnp.split(a, [nh * hd, (nh + nkv) * hd], axis=-1)
+        q = q.reshape(b, 1, nh, hd).transpose(0, 2, 1, 3)
+        k_t = k_t.reshape(b, 1, nkv, hd).transpose(0, 2, 1, 3)
+        v_t = v_t.reshape(b, 1, nkv, hd).transpose(0, 2, 1, 3)
+        q = apply_rope(q, self._cos, self._sin, pos_offset=pos)
+        k_t = apply_rope(k_t, self._cos, self._sin, pos_offset=pos)
+        ck, cv = cache
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, k_t.astype(ck.dtype),
+                                                 pos, axis=2)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, v_t.astype(cv.dtype),
+                                                 pos, axis=2)
+        from ..nn.transformer import cached_decode_attention
+        out = cached_decode_attention(q, ck, cv, pos, 1.0 / math.sqrt(hd))
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, nh * hd)
+        out = self.o_proj(Tensor(out.astype(x_t._data.dtype)))
+        return out, (ck, cv)
+
 
 class LlamaMLP(nn.Layer):
     """SwiGLU: down(silu(gate(x)) * up(x))."""
@@ -188,6 +227,13 @@ class LlamaBlock(nn.Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
+    def decode(self, x, cache, pos):
+        a, cache = self.self_attn.decode(self.input_layernorm(x), cache,
+                                         pos)
+        x = x + a
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, cache
+
 
 class LlamaModel(nn.Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -213,6 +259,21 @@ class LlamaModel(nn.Layer):
                 x = blk(x)
         return self.norm(x)
 
+    def init_cache(self, batch, max_len, dtype=jnp.float32):
+        return [blk.self_attn.init_cache(batch, max_len, dtype)
+                for blk in self.layers]
+
+    def decode_step(self, tok, caches, pos):
+        """tok: [B, 1] ids; pos: traced position. Returns (h, caches)."""
+        from ..framework.tensor import Tensor
+        pos = pos._data if isinstance(pos, Tensor) else pos
+        x = self.embed_tokens(tok)
+        new_caches = []
+        for blk, cache in zip(self.layers, caches):
+            x, cache = blk.decode(x, cache, pos)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
+
 
 class LlamaForCausalLM(nn.Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -233,6 +294,17 @@ class LlamaForCausalLM(nn.Layer):
             from ..ops.math import matmul
             return matmul(hidden, w, transpose_y=True)
         return self.lm_head(hidden)
+
+    def init_cache(self, batch, max_len, dtype=jnp.float32):
+        return self.model.init_cache(batch, max_len, dtype)
+
+    def decode_step(self, tok, caches, pos):
+        h, caches = self.model.decode_step(tok, caches, pos)
+        if self.cfg.tie_embeddings:
+            w = self.model.embed_tokens.weight
+            from ..ops.math import matmul
+            return matmul(h, w, transpose_y=True), caches
+        return self.lm_head(h), caches
 
 
 def llama_pretrain_loss(logits, labels):

@@ -350,3 +350,25 @@ def _clone_layer(layer):
     """Fresh layer with the same config but independent initialisation
     (the reference rebuilds per-layer from config, transformer.py ~_config)."""
     return type(layer)(**layer._config)
+
+
+def cached_decode_attention(q, ck, cv, pos, scale):
+    """Single-token cached attention core shared by the GPT and LLaMA
+    decoders. q: [B, H, 1, D]; ck/cv: [B, Hkv, L, D] with H % Hkv == 0 —
+    grouped (GQA) when H > Hkv, WITHOUT materialising the repeated cache:
+    q is reshaped to [B, Hkv, rep, D] and contracted against the
+    un-repeated KV buffers. Returns [B, H, 1, D] in cv.dtype."""
+    import jax
+    import jax.numpy as jnp
+
+    b, h, _, d = q.shape
+    hkv, L = ck.shape[1], ck.shape[2]
+    rep = h // hkv
+    qf = q.astype(jnp.float32).reshape(b, hkv, rep, d)
+    scores = jnp.einsum("bkrd,bkld->bkrl", qf,
+                        ck.astype(jnp.float32)) * scale
+    mask = jnp.arange(L)[None, None, None, :] <= pos
+    scores = jnp.where(mask, scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    out = jnp.einsum("bkrl,bkld->bkrd", probs, cv)
+    return out.reshape(b, h, 1, d)

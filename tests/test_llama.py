@@ -106,3 +106,31 @@ def test_llama_trains_sharded_dp_mp():
         losses.append(float(step(seq, seq).numpy()))
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_llama_generate_cache_matches_recompute():
+    """generate(use_cache=True) over GQA KV caches must reproduce the
+    full-recompute path exactly (greedy)."""
+    from paddle_tpu.nlp import generate
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=32)
+    m = LlamaForCausalLM(cfg)
+    prompt = pt.to_tensor(np.random.RandomState(0).randint(0, 64, (2, 4)),
+                          dtype="int32")
+    out_full = generate(m, prompt, max_new_tokens=6, use_cache=False)
+    out_cache = generate(m, prompt, max_new_tokens=6, use_cache=True)
+    np.testing.assert_array_equal(out_full.numpy(), out_cache.numpy())
+    assert out_full.shape == [2, 10]
+    np.testing.assert_array_equal(out_full.numpy()[:, :4], prompt.numpy())
+
+
+def test_llama_generate_rejects_overlong_decode():
+    from paddle_tpu.nlp import generate
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                      num_heads=4, num_kv_heads=2, max_seq_len=8)
+    m = LlamaForCausalLM(cfg)
+    prompt = pt.to_tensor(np.zeros((1, 6), np.int32))
+    with pytest.raises(ValueError, match="RoPE"):
+        generate(m, prompt, max_new_tokens=8, use_cache=True)
