@@ -204,6 +204,7 @@ def next_rng_key():
 
 _FLAGS = {
     "FLAGS_check_nan_inf": False,           # ref platform/flags.cc:44
+    "FLAGS_unused_var_check": False,        # ref framework/unused_var_check.cc
     "FLAGS_sort_sum_gradient": False,       # ref platform/flags.cc:527
     "FLAGS_cudnn_deterministic": True,      # XLA is deterministic by default
     "FLAGS_matmul_precision": "default",    # TPU knob: default|high|highest

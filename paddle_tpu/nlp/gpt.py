@@ -251,6 +251,11 @@ class GPTModel(nn.Layer):
         return (h, aux_total) if moe else h
 
     def init_cache(self, batch, max_len, dtype=jnp.float32):
+        if max_len > self.cfg.max_seq_len:
+            raise ValueError(
+                f"decode length {max_len} exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}: the position-embedding gather at "
+                "a traced pos would clamp silently")
         return [blk.attn.init_cache(batch, max_len, dtype)
                 for blk in self.blocks]
 

@@ -287,24 +287,22 @@ class LlamaForCausalLM(nn.Layer):
                     initializer=I.Normal(0.0, cfg.initializer_range)))
             self.lm_head.weight.sharding = P(None, mesh_mod.MP_AXIS)
 
-    def forward(self, input_ids):
-        hidden = self.model(input_ids)
+    def _logits(self, hidden):
         if self.cfg.tie_embeddings:
             w = self.model.embed_tokens.weight
             from ..ops.math import matmul
             return matmul(hidden, w, transpose_y=True)
         return self.lm_head(hidden)
 
+    def forward(self, input_ids):
+        return self._logits(self.model(input_ids))
+
     def init_cache(self, batch, max_len, dtype=jnp.float32):
         return self.model.init_cache(batch, max_len, dtype)
 
     def decode_step(self, tok, caches, pos):
         h, caches = self.model.decode_step(tok, caches, pos)
-        if self.cfg.tie_embeddings:
-            w = self.model.embed_tokens.weight
-            from ..ops.math import matmul
-            return matmul(h, w, transpose_y=True), caches
-        return self.lm_head(h), caches
+        return self._logits(h), caches
 
 
 def llama_pretrain_loss(logits, labels):

@@ -435,6 +435,59 @@ class Executor:
         self._cache = {}            # (prog uid, desc ver, sig) -> jitted
         self._run_count = 0
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100, epochs=1):
+        """Dataset-driven training (ref fluid/executor.py train_from_dataset;
+        SURVEY 3.5 call stack): pumps the C++ data feed through the
+        MultiTrainer thread pool into compiled Program runs. Dense slots
+        only (ragged slots carry (values, lod) and need a sequence-op
+        program — feed them via run())."""
+        from ..distributed.fleet.trainers import MultiTrainer
+        program_obj = program or default_main_program()
+        plain = program_obj.program \
+            if isinstance(program_obj, CompiledProgram) else program_obj
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        names = [n for n in plain.feeds]
+        labels = fetch_info or [str(f) for f in (fetch_list or [])]
+
+        def to_batch(d):
+            out = []
+            for n in names:
+                v = d[n]
+                if isinstance(v, tuple):
+                    raise ValueError(
+                        f"slot {n!r} is ragged; train_from_dataset handles "
+                        "dense slots only (use run() with sequence ops)")
+                out.append(v)
+            return tuple(out)
+
+        step_i = [0]
+
+        def train_fn(*arrays):
+            feed = dict(zip(names, arrays))
+            outs = self.run(program_obj, feed=feed, scope=scope,
+                            fetch_list=fetch_list or [])
+            step_i[0] += 1
+            if (debug or print_period) and outs \
+                    and step_i[0] % (print_period or 100) == 0:
+                shown = ", ".join(
+                    f"{lbl}={float(np.asarray(o).ravel()[0]):.6g}"
+                    for lbl, o in zip(labels, outs))
+                print(f"[train_from_dataset] step {step_i[0]}: {shown}")
+            return float(np.asarray(outs[0]).ravel()[0]) if outs else 0.0
+
+        trainer = MultiTrainer(train_fn, num_threads=thread or 2)
+        return trainer.train_from_dataset(
+            lambda: (to_batch(d) for d in dataset), epochs=epochs)
+
+    def infer_from_dataset(self, program=None, dataset=None, **kw):
+        """ref fluid/executor.py infer_from_dataset — same pump, no
+        backward ops expected in the program."""
+        return self.train_from_dataset(program=program, dataset=dataset,
+                                       **kw)
+
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True):
         program_obj = program
@@ -454,6 +507,18 @@ class Executor:
             arr = value._data if isinstance(value, Tensor) \
                 else jnp.asarray(np.asarray(value))
             feed_arrays[name] = arr
+
+        if state.get_flag("FLAGS_unused_var_check"):
+            # ref framework/unused_var_check.cc: flag fed-but-unread vars
+            import warnings
+            read = set()
+            for op in program.desc.ops:
+                read.update(op.inputs)
+            for name in feed_arrays:
+                if name not in read:
+                    warnings.warn(
+                        f"feed variable '{name}' is not consumed by any "
+                        "op in the program (FLAGS_unused_var_check)")
 
         persist_names = tuple(sorted(program._persist))
         sig = (tuple(sorted((n, tuple(a.shape), str(a.dtype))

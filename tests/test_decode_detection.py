@@ -204,3 +204,18 @@ def test_gpt_generate_kv_cache_matches_recompute():
     s = gpt_generate(model, prompt, max_new_tokens=6, use_cache=True,
                      do_sample=True, top_k=8, seed=0).numpy()
     assert ((0 <= s) & (s < 64)).all()
+
+
+def test_gpt_generate_rejects_overlong_decode():
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining, generate
+    import paddle_tpu as pt
+    import numpy as np
+    import pytest
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=2, max_seq_len=8, dropout=0.0,
+                    attn_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(m, pt.to_tensor(np.zeros((1, 6), np.int32)),
+                 max_new_tokens=8, use_cache=True)

@@ -1,0 +1,95 @@
+"""exe.train_from_dataset — the SURVEY 3.5 dataset-driven call stack:
+native C++ data feed -> MultiTrainer thread pump -> compiled Program runs
+(ref fluid/executor.py train_from_dataset + multi_trainer.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+from paddle_tpu.io.dataset_native import DatasetFactory
+
+
+def _write_dense(path, n, seed=0):
+    """2 dense slots per line: feat (dim 4), label (dim 1). Labels depend
+    on feat so the program can learn."""
+    rng = np.random.RandomState(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            feat = rng.randn(4)
+            label = int(feat[:2].sum() > 0)
+            vals = " ".join(f"{v:.5f}" for v in feat)
+            f.write(f"4 {vals} 1 {label}\n")
+
+
+def test_executor_train_from_dataset(tmp_path):
+    p = tmp_path / "part-0.txt"
+    _write_dense(str(p), 64)
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(16)
+    ds.set_use_var([("feat", "float32", 4), ("label", "int64", 1)])
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        feat = fluid.layers.data(name="feat", shape=[4], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        hidden = fluid.layers.fc(input=feat, size=16, act="relu")
+        logits = fluid.layers.fc(input=hidden, size=2)
+        loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+        avg_loss = fluid.layers.mean(loss)
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = exe.train_from_dataset(prog, ds, thread=2,
+                                    fetch_list=[avg_loss], epochs=6)
+    assert len(losses) == 6
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_train_from_dataset_rejects_ragged(tmp_path):
+    p = tmp_path / "part-1.txt"
+    rng = np.random.RandomState(0)
+    with open(p, "w") as f:
+        for i in range(8):
+            k = rng.randint(1, 4)
+            ids = " ".join(map(str, rng.randint(0, 10, k)))
+            f.write(f"{k} {ids} 1 {i % 2}\n")
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(4)
+    ds.set_use_var([("ids", "int64"), ("label", "int64", 1)])
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        ids = fluid.layers.data(name="ids", shape=[1], dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(ValueError, match="ragged"):
+        exe.train_from_dataset(prog, ds)
+
+
+def test_unused_var_check_warns():
+    import warnings
+    import paddle_tpu as pt
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        unused = fluid.layers.data(name="unused", shape=[1],
+                                   dtype="float32")
+        y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    pt.set_flags({"FLAGS_unused_var_check": True})
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            exe.run(prog, feed={"x": np.zeros((2, 4), "f4"),
+                                "unused": np.zeros((2, 1), "f4")},
+                    fetch_list=[y])
+        assert any("unused" in str(x.message) for x in w), \
+            [str(x.message) for x in w]
+    finally:
+        pt.set_flags({"FLAGS_unused_var_check": False})
