@@ -71,6 +71,8 @@ from . import distribution  # noqa: E402
 from . import quantization  # noqa: E402
 from . import utils  # noqa: E402
 from . import fluid  # noqa: E402
+from . import autograd  # noqa: E402
+from . import rec  # noqa: E402
 from .framework.serialization import save, load  # noqa: E402
 from .hapi.model import Model, summary  # noqa: E402
 from .framework.state import get_flags, set_flags  # noqa: E402,F811

@@ -1,0 +1,144 @@
+"""paddle.autograd — user-defined differentiable functions + grad API
+(ref python/paddle/autograd/py_layer.py PyLayer/PyLayerContext; the
+reference's C++ side is imperative/py_layer_fcns — here the tape engine
+consumes the Python backward directly as a GradNode vjp).
+
+Also re-exports `backward` and the double-grad `grad` from the tape.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..framework.tape import GradNode
+from ..framework import state
+
+__all__ = ["PyLayer", "PyLayerContext", "backward", "grad"]
+
+
+class PyLayerContext:
+    """Passed as ctx to forward/backward (ref py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom autograd op:
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, grad_out):
+                (x,) = ctx.saved_tensor()
+                return 3 * x * x * grad_out
+
+        y = Cube.apply(x)
+
+    backward returns one grad per DIFFERENTIABLE tensor input of forward
+    (None allowed for non-differentiable ones), like the reference.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with state.no_grad_ctx():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        for o in outs:
+            if not isinstance(o, Tensor):
+                raise TypeError(
+                    f"{cls.__name__}.forward must return Tensor(s), got "
+                    f"{type(o).__name__}")
+
+        for k, v in kwargs.items():
+            if isinstance(v, Tensor) and not v.stop_gradient:
+                raise TypeError(
+                    f"{cls.__name__}.apply: differentiable Tensor passed "
+                    f"as keyword {k!r}; tensors must be positional so "
+                    "backward grads align with them")
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = state.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if not needs_grad:
+            return out
+
+        def vjp(cots):
+            cots = cots if isinstance(cots, tuple) else (cots,)
+            with state.no_grad_ctx():
+                gs = cls.backward(ctx, *[Tensor(c) for c in cots])
+            gs = gs if isinstance(gs, (tuple, list)) else (gs,)
+            if len(gs) != len(tensor_inputs):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(gs)} grads "
+                    f"for {len(tensor_inputs)} tensor inputs")
+            return tuple(
+                None if g is None else
+                (g._data if isinstance(g, Tensor) else jnp.asarray(g))
+                for g in gs)
+
+        node = GradNode(
+            vjp=vjp,
+            inputs=[t if not t.stop_gradient else None
+                    for t in tensor_inputs],
+            n_outputs=len(outs),
+            out_shapes=tuple(o.shape for o in outs),
+            out_dtypes=tuple(o.dtype for o in outs),
+            name=cls.__name__,
+        )
+        fresh = []
+        for i, o in enumerate(outs):
+            w = Tensor(o._data, stop_gradient=False)
+            w._node = node
+            w._slot = i
+            fresh.append(w)
+        return tuple(fresh) if multi else fresh[0]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward: reverse sweeps from one or more tensors.
+    Shared subgraphs survive across the per-tensor sweeps (every sweep
+    but the last retains the graph regardless of `retain_graph`)."""
+    from ..framework import tape
+    ts = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if isinstance(grad_tensors, (list, tuple)):
+        if len(grad_tensors) != len(ts):
+            raise ValueError(
+                f"backward: {len(ts)} tensors but {len(grad_tensors)} "
+                "grad_tensors")
+        gs = list(grad_tensors)
+    else:
+        gs = [grad_tensors] * len(ts)
+    for i, (t, g) in enumerate(zip(ts, gs)):
+        keep = retain_graph or i < len(ts) - 1
+        tape.backward(t, g, retain_graph=keep)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — delegate to the framework-level implementation."""
+    import paddle_tpu as pt
+    return pt.grad(outputs, inputs, grad_outputs=grad_outputs,
+                   retain_graph=retain_graph, create_graph=create_graph,
+                   only_inputs=only_inputs, allow_unused=allow_unused,
+                   no_grad_vars=no_grad_vars)

@@ -1,0 +1,123 @@
+"""paddle.autograd.PyLayer — user-defined differentiable functions over
+the tape engine (ref python/paddle/autograd/py_layer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.autograd import PyLayer
+
+
+class Cube(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        ctx.save_for_backward(x)
+        return x * x * x
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        (x,) = ctx.saved_tensor()
+        return 3 * x * x * grad_out
+
+
+def test_pylayer_matches_autodiff():
+    x = pt.to_tensor(np.array([2.0, -1.0, 3.0], "f4"), stop_gradient=False)
+    y = Cube.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               3 * np.array([4.0, 1.0, 9.0]), rtol=1e-6)
+
+
+def test_pylayer_multi_output_and_nondiff_input():
+    class SplitScale(PyLayer):
+        @staticmethod
+        def forward(ctx, x, scale):
+            ctx.scale = scale
+            return x * scale, x + scale
+
+        @staticmethod
+        def backward(ctx, g1, g2):
+            return g1 * ctx.scale + g2
+
+    x = pt.to_tensor(np.array([1.0, 2.0], "f4"), stop_gradient=False)
+    a, b = SplitScale.apply(x, 4.0)
+    (a.sum() + b.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])   # scale + 1
+
+
+def test_pylayer_grad_count_mismatch_raises():
+    class Bad(PyLayer):
+        @staticmethod
+        def forward(ctx, x, y):
+            return x + y
+
+        @staticmethod
+        def backward(ctx, g):
+            return g            # forgot y's grad
+
+    x = pt.to_tensor(np.ones(2, "f4"), stop_gradient=False)
+    y = pt.to_tensor(np.ones(2, "f4"), stop_gradient=False)
+    out = Bad.apply(x, y)
+    with pytest.raises(ValueError, match="grads"):
+        out.sum().backward()
+
+
+def test_pylayer_in_layer_training():
+    """PyLayer inside a Layer: a straight-through sign quantizer trains."""
+    class SignSTE(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            import paddle_tpu.ops.math as M
+            return M.sign(x)
+
+        @staticmethod
+        def backward(ctx, g):
+            return g            # straight-through
+
+    pt.seed(0)
+    lin = pt.nn.Linear(4, 1)
+    opt = pt.optimizer.SGD(learning_rate=0.1,
+                           parameters=lin.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype("f4")
+    w_true = np.array([1.0, -2.0, 0.5, 3.0], "f4")
+    yv = (x @ w_true > 0).astype("f4") * 2 - 1
+    first = last = None
+    for _ in range(40):
+        out = SignSTE.apply(lin(pt.to_tensor(x)))
+        loss = ((out.reshape([-1]) - pt.to_tensor(yv)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(loss.numpy())
+        first = first if first is not None else v
+        last = v
+    assert last < first, (first, last)
+
+
+def test_pylayer_no_grad_passthrough():
+    x = pt.to_tensor(np.ones(3, "f4"))    # stop_gradient=True
+    y = Cube.apply(x)
+    assert y._node is None                 # no tape node recorded
+
+
+def test_autograd_backward_multi_tensor_shared_graph():
+    """Two roots sharing a subgraph: both sweeps must contribute."""
+    x = pt.to_tensor(np.array([1.0, 2.0], "f4"), stop_gradient=False)
+    y = x * 2.0
+    a = (y * 3.0).sum()
+    b = (y * 5.0).sum()
+    pt.autograd.backward([a, b])
+    np.testing.assert_allclose(x.grad.numpy(), [16.0, 16.0])  # 6 + 10
+
+
+def test_autograd_backward_mismatched_grad_tensors():
+    x = pt.to_tensor(np.ones(2, "f4"), stop_gradient=False)
+    a, b = (x * 2).sum(), (x * 3).sum()
+    with pytest.raises(ValueError, match="grad_tensors"):
+        pt.autograd.backward([a, b], grad_tensors=[None])
+
+
+def test_pylayer_kwarg_tensor_rejected():
+    x = pt.to_tensor(np.ones(2, "f4"), stop_gradient=False)
+    with pytest.raises(TypeError, match="keyword"):
+        Cube.apply(x=x)
