@@ -5,7 +5,8 @@ from . import initializer
 from .layer import (Layer, LayerList, Sequential, ParameterList,
                     HookRemoveHelper)
 from .param_attr import ParamAttr
-from .layers_common import (Linear, Embedding, Dropout, Dropout2D, Dropout3D,
+from .layers_common import (PairwiseDistance, Unfold,
+                            Linear, Embedding, Dropout, Dropout2D, Dropout3D,
                             AlphaDropout, Flatten, Identity, Pad1D, Pad2D,
                             Pad3D, Upsample, UpsamplingBilinear2D,
                             UpsamplingNearest2D, PixelShuffle, Bilinear,
@@ -22,7 +23,8 @@ from .activation import (ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish,
                          LeakyReLU, ELU, CELU, SELU, PReLU, Hardtanh,
                          Hardshrink, Softshrink, Softplus, Softmax, LogSoftmax,
                          Maxout)
-from .loss import (CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss,
+from .loss import (CTCLoss,
+                   CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss,
                    BCELoss, BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss,
                    HingeEmbeddingLoss)
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm

@@ -1165,3 +1165,98 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
     def f(l):
         return (jnp.arange(ml)[None, :] < l[:, None]).astype(convert_dtype(dtype))
     return apply(f, (lengths,), differentiable=False, name="sequence_mask")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """ref nn/functional/distance.py: p-norm of (x - y) over the last
+    axis. The reference's p_norm kernel uses `epsilon` only in the
+    GRADIENT denominator (p_norm_op.h PnormGradKernel), never the
+    forward — kept in the signature for API parity; autodiff handles the
+    norm-at-zero subgradient here."""
+    def f(x_, y_):
+        return jnp.linalg.norm(x_ - y_, ord=p, axis=-1, keepdims=keepdim)
+
+    return apply(f, (x, y), name="pairwise_distance")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (ref operators/warpctc_op.cc / paddle.nn.functional.ctc_loss).
+
+    log_probs: [T, B, C] RAW logits (log_softmax applied internally, like
+    the reference's warpctc). labels: [B, Lmax] padded int labels.
+    input_lengths/label_lengths: [B] ints.
+
+    TPU-native: the alpha recursion runs as one lax.scan over time in log
+    space with static shapes ([B, 2*Lmax+1] state); per-sample lengths are
+    handled by masking, so one compiled program serves the whole batch.
+    Gradients come from autodiff through the scan (the reference ships a
+    hand-written backward; XLA differentiates the recursion directly).
+    """
+    def f(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        Lmax = lab.shape[1]
+        S = 2 * Lmax + 1
+        logp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        neg_inf = jnp.float32(-1e30)
+
+        # extended label sequence l' = [blank, l1, blank, l2, ..., blank]
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        # transition-2 allowed where l'_s != blank and l'_s != l'_{s-2}
+        ext_m2 = jnp.concatenate(
+            [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+        can_skip = (ext != blank) & (ext != ext_m2)
+
+        def emit(t_logp):
+            # t_logp: [B, C] -> per-extended-position emission [B, S]
+            return jnp.take_along_axis(t_logp, ext, axis=1)
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        e0 = emit(logp[0])
+        alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+        if S > 1:      # Lmax=0 (all-blank targets) has only position 0
+            alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, e0[:, 1],
+                                                   neg_inf))
+
+        def step(alpha, t_logp_t):
+            t_logp, t = t_logp_t
+            if S > 1:
+                prev1 = jnp.concatenate(
+                    [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+                prev2 = jnp.concatenate(
+                    [jnp.full((B, 2), neg_inf),
+                     alpha[:, :max(S - 2, 0)]], axis=1)[:, :S]
+                prev2 = jnp.where(can_skip, prev2, neg_inf)
+                merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            else:      # Lmax=0: only the all-blank path exists
+                merged = alpha
+            new = merged + emit(t_logp)
+            # freeze finished samples (t >= input_length)
+            active = (t < in_len)[:, None]
+            return jnp.where(active, new, alpha), None
+
+        ts = jnp.arange(1, T)
+        alpha, _ = jax.lax.scan(step, alpha0, (logp[1:], ts))
+
+        # final: logsumexp of positions S-1 (last blank) and S-2 (last label)
+        s_last = 2 * lab_len.astype(jnp.int32)        # index of last blank
+        a_last = jnp.take_along_axis(alpha, s_last[:, None], axis=1)[:, 0]
+        s_lab = jnp.maximum(s_last - 1, 0)
+        a_lab = jnp.where(
+            lab_len > 0,
+            jnp.take_along_axis(alpha, s_lab[:, None], axis=1)[:, 0],
+            neg_inf)
+        nll = -jnp.logaddexp(a_last, a_lab)
+        if norm_by_times:
+            nll = nll / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # paddle mean: divide per-sample loss by label_length first
+            return jnp.mean(nll / jnp.maximum(
+                lab_len.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply(f, (log_probs, labels, input_lengths, label_lengths),
+                 name="ctc_loss")

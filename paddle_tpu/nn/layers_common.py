@@ -218,3 +218,30 @@ class CosineSimilarity(Layer):
 
     def forward(self, x1, x2):
         return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    """ref paddle.nn.PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, p=self.p, epsilon=self.epsilon,
+                                   keepdim=self.keepdim)
+
+
+class Unfold(Layer):
+    """ref paddle.nn.Unfold (im2col as a layer)."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.kernel_sizes, strides=self.strides,
+                        paddings=self.paddings, dilations=self.dilations)

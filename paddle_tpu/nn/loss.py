@@ -113,3 +113,19 @@ class HingeEmbeddingLoss(Layer):
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, margin=self.margin,
                                       reduction=self.reduction)
+
+
+class CTCLoss(Layer):
+    """ref paddle.nn.CTCLoss (warpctc): log_probs [T, B, C] raw logits,
+    labels [B, Lmax] padded."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)

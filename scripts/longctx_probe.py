@@ -1,0 +1,66 @@
+"""Long-context single-chip probe: GPT-2s at seq 4096/8192 with the Pallas
+flash kernels (fwd + bwd) and optional recompute. The S x S score matrix
+at 8192 would be 256MB/head-layer in HBM — flash streams it, so these
+configs fit one v5e where the XLA dense path OOMs.
+
+Usage: PYTHONPATH=/root/repo:/root/.axon_site python scripts/longctx_probe.py [seq ...]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from bench import PEAK_TFLOPS          # noqa: E402
+import paddle_tpu as pt                # noqa: E402
+from paddle_tpu.nlp import GPTConfig, GPTForPretraining  # noqa: E402
+from paddle_tpu.nlp.gpt import gpt_pretrain_loss         # noqa: E402
+from paddle_tpu.jit import TrainStep   # noqa: E402
+
+t0 = time.time()
+
+
+def log(m):
+    print(f"[{time.time()-t0:7.1f}s] {m}", flush=True)
+
+
+for seq in [int(a) for a in sys.argv[1:]] or [4096, 8192]:
+    batch = max(1, 8192 // seq)
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=seq, dropout=0.0,
+                    attn_dropout=0.0, use_recompute=(seq >= 8192))
+    model = GPTForPretraining(cfg)
+    model.to(dtype=jnp.bfloat16)
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters())
+    step = TrainStep(model, gpt_pretrain_loss, opt, donate=True)
+    ids = np.random.RandomState(0).randint(
+        0, 32768, (batch, seq)).astype("int32")
+    for i in range(3):
+        t1 = time.time()
+        loss = step(ids, ids)
+        v = float(loss.numpy())
+        log(f"seq={seq} b={batch} warm {i}: {time.time()-t1:.1f}s "
+            f"loss={v:.4f}")
+    iters = 10
+    t1 = time.time()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    float(loss.numpy())
+    dt = (time.time() - t1) / iters
+    toks = batch * seq / dt
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    tf = toks * 6 * n_params / 1e12
+    log(f"seq={seq}: {dt*1e3:.1f} ms/step  {toks:,.0f} tok/s  "
+        f"{tf:.1f} TF/s  MFU={tf/PEAK_TFLOPS:.3f} "
+        f"(attn-flops excluded from MFU)")
+    del step, model, opt

@@ -1,0 +1,124 @@
+"""CTC loss (ref operators/warpctc_op.cc): alpha-recursion lax.scan vs a
+brute-force alignment enumeration, torch.nn.CTCLoss cross-check, variable
+lengths, gradients, and a tiny training run."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+def _brute_force_nll(logits, label, blank=0):
+    """-log P(label) summing over ALL alignments of length T (exact)."""
+    T, C = logits.shape
+    lp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                         .sum(-1, keepdims=True)) - logits.max(-1,
+                                                              keepdims=True)
+
+    def collapse(path):
+        out = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                out.append(s)
+            prev = s
+        return tuple(out)
+
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(label):
+            lpp = sum(lp[t, path[t]] for t in range(T))
+            total = np.logaddexp(total, lpp)
+    return -total
+
+
+@pytest.mark.parametrize("label", [[1], [1, 2], [1, 1], [2, 1, 2]])
+def test_ctc_matches_brute_force(label):
+    rng = np.random.RandomState(0)
+    T, C = 5, 3
+    logits = rng.randn(T, 1, C).astype("f4")
+    nll = F.ctc_loss(pt.to_tensor(logits),
+                     pt.to_tensor(np.asarray([label], "i4")),
+                     pt.to_tensor(np.asarray([T], "i4")),
+                     pt.to_tensor(np.asarray([len(label)], "i4")),
+                     reduction="none")
+    ref = _brute_force_nll(logits[:, 0], label)
+    assert float(nll.numpy()[0]) == pytest.approx(ref, rel=1e-4)
+
+
+def test_ctc_matches_torch_batch():
+    import torch
+    rng = np.random.RandomState(1)
+    T, B, C, Lmax = 12, 4, 6, 5
+    logits = rng.randn(T, B, C).astype("f4")
+    in_len = np.asarray([12, 10, 8, 12], "i4")
+    lab_len = np.asarray([5, 3, 1, 4], "i4")
+    labels = np.zeros((B, Lmax), "i4")
+    for b in range(B):
+        labels[b, :lab_len[b]] = rng.randint(1, C, lab_len[b])
+
+    ours = F.ctc_loss(pt.to_tensor(logits), pt.to_tensor(labels),
+                      pt.to_tensor(in_len), pt.to_tensor(lab_len),
+                      reduction="none")
+    tl = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits), dim=-1),
+        torch.tensor(labels.astype("i8")),
+        torch.tensor(in_len.astype("i8")),
+        torch.tensor(lab_len.astype("i8")),
+        blank=0, reduction="none")
+    np.testing.assert_allclose(np.asarray(ours.numpy()),
+                               tl.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_trains():
+    """Gradients through the scan: a linear model learns to emit a fixed
+    label sequence."""
+    pt.seed(0)
+    T, B, C = 8, 2, 5
+    lin = pt.nn.Linear(4, C)
+    opt = pt.optimizer.Adam(learning_rate=0.1,
+                            parameters=lin.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, B, 4).astype("f4")
+    labels = np.asarray([[1, 2, 3], [2, 4, 2]], "i4")
+    crit = pt.nn.CTCLoss(blank=0)
+    in_len = pt.to_tensor(np.asarray([T, T], "i4"))
+    lab_len = pt.to_tensor(np.asarray([3, 3], "i4"))
+    first = last = None
+    for _ in range(40):
+        logits = lin(pt.to_tensor(x))
+        loss = crit(logits, pt.to_tensor(labels), in_len, lab_len)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(loss.numpy())
+        first = first if first is not None else v
+        last = v
+    assert last < first * 0.3, (first, last)
+
+
+def test_pairwise_distance_and_unfold_layers():
+    x = pt.to_tensor(np.asarray([[0.0, 0.0], [1.0, 1.0]], "f4"))
+    y = pt.to_tensor(np.asarray([[3.0, 4.0], [1.0, 1.0]], "f4"))
+    d = pt.nn.PairwiseDistance()(x, y)
+    np.testing.assert_allclose(d.numpy(), [5.0, 0.0], atol=1e-6)
+    img = pt.to_tensor(np.arange(16, dtype="f4").reshape(1, 1, 4, 4))
+    cols = pt.nn.Unfold(kernel_sizes=[2, 2], strides=2)(img)
+    assert cols.shape == [1, 4, 4]
+
+
+def test_ctc_all_blank_targets():
+    """Lmax=0 (every target empty) is legal: NLL = -sum logp[t, blank]."""
+    rng = np.random.RandomState(2)
+    T, B, C = 4, 2, 3
+    logits = rng.randn(T, B, C).astype("f4")
+    nll = F.ctc_loss(pt.to_tensor(logits),
+                     pt.to_tensor(np.zeros((B, 0), "i4")),
+                     pt.to_tensor(np.asarray([T, T], "i4")),
+                     pt.to_tensor(np.asarray([0, 0], "i4")),
+                     reduction="none")
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    ref = -lp[:, :, 0].sum(0)
+    np.testing.assert_allclose(np.asarray(nll.numpy()), ref, rtol=1e-4)
