@@ -192,3 +192,230 @@ def hflip(img):
 
 def vflip(img):
     return np.asarray(img)[::-1].copy()
+
+
+# ------------------------------------------------------------ functional tail
+# (ref python/paddle/vision/transforms/functional.py — host-side numpy)
+
+def adjust_brightness(img, factor):
+    """Blend with black: out = img * factor (clipped for uint8)."""
+    arr = np.asarray(img)
+    out = arr.astype(np.float32) * float(factor)
+    return (np.clip(out, 0, 255).astype(np.uint8)
+            if arr.dtype == np.uint8 else out)
+
+
+def adjust_contrast(img, factor):
+    """Blend with the GRAYSCALE mean of the image (0.299/0.587/0.114
+    weights, matching the reference/PIL), not the raw channel mean."""
+    arr = np.asarray(img)
+    f = arr.astype(np.float32)
+    if f.ndim == 3 and f.shape[-1] >= 3:
+        gray = (0.299 * f[..., 0] + 0.587 * f[..., 1] + 0.114 * f[..., 2])
+        mean = gray.mean()
+    else:
+        mean = f.mean()
+    out = mean + (f - mean) * float(factor)
+    return (np.clip(np.round(out), 0, 255).astype(np.uint8)
+            if arr.dtype == np.uint8 else out)
+
+
+def adjust_saturation(img, factor):
+    """Blend with the grayscale version (HWC, 3 channels)."""
+    arr = np.asarray(img)
+    f = arr.astype(np.float32)
+    gray = (0.299 * f[..., 0] + 0.587 * f[..., 1]
+            + 0.114 * f[..., 2])[..., None]
+    out = gray + (f - gray) * float(factor)
+    return (np.clip(out, 0, 255).astype(np.uint8)
+            if arr.dtype == np.uint8 else out)
+
+
+def adjust_hue(img, factor):
+    """Rotate hue by factor in [-0.5, 0.5] (HWC uint8/float RGB)."""
+    arr = np.asarray(img)
+    f = arr.astype(np.float32) / (255.0 if arr.dtype == np.uint8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = f[..., :3].max(-1)
+    minc = f[..., :3].min(-1)
+    v = maxc
+    c = maxc - minc
+    s = np.where(maxc > 0, c / np.maximum(maxc, 1e-12), 0.0)
+    rc = np.where(c > 0, (maxc - r) / np.maximum(c, 1e-12), 0.0)
+    gc = np.where(c > 0, (maxc - g) / np.maximum(c, 1e-12), 0.0)
+    bc = np.where(c > 0, (maxc - b) / np.maximum(c, 1e-12), 0.0)
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc)) / 6.0
+    h = (h + float(factor)) % 1.0
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * fr)
+    t = v * (1.0 - s * (1.0 - fr))
+    i = (i.astype(np.int32) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    if arr.dtype == np.uint8:
+        return np.clip(np.round(out * 255.0), 0, 255).astype(np.uint8)
+    return out
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img)
+    f = arr.astype(np.float32)
+    gray = 0.299 * f[..., 0] + 0.587 * f[..., 1] + 0.114 * f[..., 2]
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return (np.clip(np.round(out), 0, 255).astype(np.uint8)
+            if arr.dtype == np.uint8 else out)
+
+
+def rotate(img, angle, center=None, fill=0):
+    """Rotate counter-clockwise by `angle` degrees about the center
+    (nearest-neighbor, same output size — ref F.rotate defaults)."""
+    arr = np.asarray(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    yy, xx = np.mgrid[0:h, 0:w]
+    # inverse map: output pixel -> source pixel
+    xs = cos * (xx - cx) + sin * (yy - cy) + cx
+    ys = -sin * (xx - cx) + cos * (yy - cy) + cy
+    xi = np.round(xs).astype(np.int64)
+    yi = np.round(ys).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full_like(arr, fill)
+    out[valid] = arr[yi[valid], xi[valid]]
+    return out
+
+
+class ContrastTransform(BaseTransform):
+    """ref transforms.ContrastTransform: random contrast in
+    [1-value, 1+value]."""
+
+    def __init__(self, value=0.4):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value=0.4):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value=0.1):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """ref transforms.ColorJitter: composes the per-property random
+    transforms (Brightness/Contrast/Saturation/Hue) in random order —
+    one place owns each property's jitter convention."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self._ops = []
+        if brightness:
+            self._ops.append(BrightnessTransform(float(brightness)))
+        if contrast:
+            self._ops.append(ContrastTransform(float(contrast)))
+        if saturation:
+            self._ops.append(SaturationTransform(float(saturation)))
+        if hue:
+            self._ops.append(HueTransform(float(hue)))
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self._ops))
+        for i in order:
+            img = self._ops[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    """ref transforms.Pad: constant pad on HWC images."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding            # left, top, right, bottom
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        spec = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+        if self.padding_mode == "constant":
+            return np.pad(arr, spec, constant_values=self.fill)
+        return np.pad(arr, spec, mode=self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, fill=0):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """ref transforms.RandomErasing over CHW/HWC float or uint8."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() > self.prob:
+            return arr
+        # layout: HWC when the last dim looks like channels, else CHW
+        hwc = arr.ndim == 2 or arr.shape[-1] in (1, 3, 4)
+        h, w = arr.shape[:2] if hwc else arr.shape[1:3]
+        arr = arr.copy()
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                y = np.random.randint(0, h - eh)
+                x = np.random.randint(0, w - ew)
+                if hwc:
+                    arr[y:y + eh, x:x + ew] = self.value
+                else:
+                    arr[:, y:y + eh, x:x + ew] = self.value
+                return arr
+        return arr

@@ -153,3 +153,27 @@ class TestLayers:
         g = pt.to_tensor(np.full(4, 10.0, "f4"))
         (pn, gn), = clip([(p, g)])
         assert np.linalg.norm(gn.numpy()) == pytest.approx(1.0, abs=1e-4)
+
+    def test_random_erasing_chw_layout(self):
+        import paddle_tpu.vision.transforms as T
+        chw = np.random.RandomState(0).rand(3, 32, 32).astype("f4") + 1.0
+        out = T.RandomErasing(prob=1.0, value=0)(chw)
+        zero = (out == 0)
+        # erased region is spatial: spans ALL channels at the same y/x
+        assert zero.any()
+        assert (zero.all(axis=0) == zero.any(axis=0)).all()
+
+    def test_adjust_hue_identity_exact_after_round(self):
+        import paddle_tpu.vision.transforms as T
+        img = (np.random.RandomState(3).rand(8, 8, 3) * 255).astype("u1")
+        assert np.abs(T.adjust_hue(img, 0.0).astype(int)
+                      - img.astype(int)).max() <= 1
+
+    def test_adjust_contrast_uses_gray_mean(self):
+        import paddle_tpu.vision.transforms as T
+        red = np.zeros((4, 4, 3), "f4"); red[..., 0] = 255.0
+        out = T.adjust_contrast(red, 0.5)
+        gray_mean = 0.299 * 255.0
+        np.testing.assert_allclose(out[..., 0],
+                                   gray_mean + (255.0 - gray_mean) * 0.5)
+        np.testing.assert_allclose(out[..., 1], gray_mean * 0.5)
