@@ -1,0 +1,222 @@
+"""Static-graph mixed precision — the program-rewrite half of AMP
+(ref python/paddle/fluid/contrib/mixed_precision/fp16_utils.py:
+`rewrite_program` O1 insert-cast pass :468, `cast_model_to_fp16` O2 :306,
+decorator.py:36 OptimizerWithMixedPrecision).
+
+TPU-native: the low dtype defaults to bfloat16 (no loss scaling needed —
+bf16 has f32's exponent range, so the decorator's scaler defaults off,
+matching the framework-wide bf16-first stance). The pass edits the
+ProgramDesc op list directly: white-list ops get bf16-cast inputs (cast
+OpDescs are real desc ops, serializable and differentiable through
+append_backward), black-list ops get f32 casts on any low input.
+"""
+from ..ops.dispatch import AMP_WHITE_LIST, AMP_BLACK_LIST
+from . import desc as D
+
+
+class AutoMixedPrecisionLists:
+    """ref fp16_lists.py AutoMixedPrecisionLists: white/black sets with
+    custom additions."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(AMP_WHITE_LIST)
+        self.black_list = set(AMP_BLACK_LIST)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+        overlap = self.white_list & self.black_list
+        if overlap:
+            raise ValueError(f"ops in both white and black lists: {overlap}")
+
+
+def _is_float_var(desc, name, low_vars):
+    v = desc.vars.get(name)
+    if name in low_vars:
+        return False                     # already low precision
+    if v is None or v.dtype is None:
+        return True                      # tmp vars default to float compute
+    return "float32" in v.dtype or v.dtype in ("float", "f4")
+
+
+def _cast_op(desc, src, dst, dtype):
+    """Append a cast VarDesc+OpDesc producing `dst` = cast(src, dtype)."""
+    svar = desc.vars.get(src)
+    desc.add_var(D.VarDesc(dst, D.TMP,
+                           svar.shape if svar is not None else None,
+                           dtype, stop_gradient=False))
+    return D.OpDesc("cast", [src], [dst], {"to_dtype": dtype},
+                    differentiable=True)
+
+
+def _make_caster(desc, new_ops, tag):
+    """One shared insert-a-cast closure: returns cast_to(name, dtype) with
+    a (name, dtype) cache so each var is cast at most once per dtype."""
+    cache = {}
+    n = [0]
+
+    def cast_to(name, dtype):
+        key = (name, dtype)
+        if key not in cache:
+            n[0] += 1
+            suffix = "low" if dtype != "float32" else "f32"
+            alias = f"{name}@{tag}_{suffix}_{n[0]}"
+            new_ops.append(_cast_op(desc, name, alias, dtype))
+            cache[key] = alias
+        return cache[key]
+
+    return cast_to
+
+
+def _check_no_grad_ops(desc, what):
+    """Op insertion shifts positions, and grad ops address their forward
+    op BY INDEX (attrs['fwd_index']) — rewriting after minimize would
+    silently corrupt every gradient."""
+    if any(op.type == "grad" for op in desc.ops):
+        raise RuntimeError(
+            f"{what} must run BEFORE minimize/append_backward: the "
+            "program already contains grad ops whose fwd_index positions "
+            "an op insertion would invalidate")
+
+
+def rewrite_program(program, amp_lists=None, dest_dtype="bfloat16"):
+    """O1: white-list ops run in `dest_dtype` (their float inputs get cast
+    ops inserted), black-list ops get float32 casts on low inputs; other
+    ops consume whatever reaches them (mirrors rewrite_program's
+    gray-op propagation). Call BEFORE minimize so append_backward
+    differentiates through the casts. Returns the program."""
+    amp_lists = amp_lists or AutoMixedPrecisionLists()
+    desc = program.desc
+    _check_no_grad_ops(desc, "rewrite_program")
+    new_ops = []
+    low_vars = set()                     # var names known to be low dtype
+    cast_to = _make_caster(desc, new_ops, "cast")
+
+    for op in desc.ops:
+        if op.type == "cast":
+            # user-recorded casts change precision too
+            to = op.attrs.get("to_dtype", "float32")
+            if to == dest_dtype:
+                low_vars.update(op.outputs)
+            else:
+                low_vars.difference_update(op.outputs)
+            new_ops.append(op)
+            continue
+        if op.type in amp_lists.white_list:
+            ins = []
+            for name in op.inputs:
+                if name in low_vars:
+                    ins.append(name)
+                elif _is_float_var(desc, name, low_vars):
+                    ins.append(cast_to(name, dest_dtype))
+                else:
+                    ins.append(name)
+            op.inputs = ins
+            low_vars.update(op.outputs)  # low in -> low out
+        elif op.type in amp_lists.black_list:
+            op.inputs = [cast_to(name, "float32") if name in low_vars
+                         else name for name in op.inputs]
+        else:
+            # gray op: keeps the precision of its inputs; outputs are low
+            # only if EVERY float input is low
+            if op.inputs and any(name in low_vars for name in op.inputs) \
+                    and all(name in low_vars
+                            or not _is_float_var(desc, name, low_vars)
+                            for name in op.inputs):
+                low_vars.update(op.outputs)
+        new_ops.append(op)
+    desc.ops[:] = new_ops
+    desc.version += 1
+    return program
+
+
+def cast_model_to_fp16(program, dest_dtype="bfloat16", amp_lists=None):
+    """O2 (pure low precision): cast every float PERSIST parameter's
+    backing tensor + VarDesc to `dest_dtype` and low-cast float feeds at
+    their first use; black-list ops still compute in float32 via inserted
+    casts (ref cast_model_to_fp16:306). Returns the program."""
+    import jax.numpy as jnp
+    from ..framework.dtype import convert_dtype
+    amp_lists = amp_lists or AutoMixedPrecisionLists()
+    desc = program.desc
+    _check_no_grad_ops(desc, "cast_model_to_fp16")
+    jdt = convert_dtype(dest_dtype)
+    low_vars = set()
+    for name, var in desc.vars.items():
+        if var.kind == D.PERSIST and var.dtype and "float32" in var.dtype:
+            t = program._persist.get(name)
+            if t is not None and hasattr(t, "_data"):
+                t._data = t._data.astype(jdt)
+            var.dtype = str(jdt)
+            low_vars.add(name)
+        elif var.kind == D.FEED and var.dtype and "float32" in var.dtype:
+            # Executor.run casts fed arrays to the DECLARED var dtype
+            # (program.py feed loop), so relabeling makes the feed low
+            var.dtype = str(jdt)
+            low_vars.add(name)
+
+    # black ops still need f32 inputs
+    new_ops = []
+    cast_to = _make_caster(desc, new_ops, "o2")
+    for op in desc.ops:
+        if op.type in amp_lists.black_list:
+            op.inputs = [cast_to(name, "float32") if name in low_vars
+                         else name for name in op.inputs]
+        else:
+            if op.inputs and any(x in low_vars for x in op.inputs):
+                low_vars.update(op.outputs)
+        new_ops.append(op)
+    desc.ops[:] = new_ops
+    desc.version += 1
+    return program
+
+
+class OptimizerWithMixedPrecision:
+    """ref decorator.py:36 — wraps an optimizer so minimize() rewrites the
+    program first (O1) or expects a cast model (O2). Loss scaling is kept
+    in the API but defaults OFF for bf16."""
+
+    def __init__(self, optimizer, amp_lists=None, level="O1",
+                 dest_dtype="bfloat16", init_loss_scaling=1.0,
+                 use_dynamic_loss_scaling=False):
+        self._opt = optimizer
+        self._amp_lists = amp_lists or AutoMixedPrecisionLists()
+        self._level = level
+        self._dest = dest_dtype
+        self._loss_scaling = init_loss_scaling
+        self._dynamic = use_dynamic_loss_scaling
+        if (use_dynamic_loss_scaling or init_loss_scaling != 1.0) \
+            and dest_dtype == "float16":
+            raise NotImplementedError(
+                "static-mode loss scaling is not implemented; use the "
+                "bf16 default (f32 exponent range needs no scaling) or "
+                "the eager GradScaler (paddle_tpu.amp)")
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        rec = getattr(loss, "_recorder", None)
+        if rec is not None:
+            program = rec.program
+        else:
+            from .program import default_main_program
+            program = default_main_program()
+        if self._level == "O1":
+            rewrite_program(program, self._amp_lists, self._dest)
+        else:
+            cast_model_to_fp16(program, self._dest, self._amp_lists)
+        return self._opt.minimize(loss, startup_program=startup_program,
+                                  parameters=parameters,
+                                  no_grad_set=no_grad_set)
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             use_dynamic_loss_scaling=False, level="O1",
+             dest_dtype="bfloat16"):
+    """ref mixed_precision.decorate."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists=amp_lists, level=level, dest_dtype=dest_dtype,
+        init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling)

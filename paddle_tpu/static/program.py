@@ -506,6 +506,16 @@ class Executor:
                                f"(have {list(program.feeds)})")
             arr = value._data if isinstance(value, Tensor) \
                 else jnp.asarray(np.asarray(value))
+            # honor the DECLARED feed dtype (static AMP O2 relabels float
+            # feeds to bf16; feeding f32 would silently promote the whole
+            # graph back to f32)
+            var = program.desc.vars.get(name)
+            if var is not None and var.dtype is not None \
+                    and jnp.issubdtype(arr.dtype, jnp.floating):
+                from ..framework.dtype import convert_dtype
+                want = convert_dtype(var.dtype)
+                if jnp.issubdtype(want, jnp.floating) and arr.dtype != want:
+                    arr = arr.astype(want)
             feed_arrays[name] = arr
 
         if state.get_flag("FLAGS_unused_var_check"):

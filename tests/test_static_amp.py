@@ -1,0 +1,131 @@
+"""Static AMP program rewrite (ref fluid/contrib/mixed_precision:
+rewrite_program O1, cast_model_to_fp16 O2, decorator)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+from paddle_tpu.static import amp as static_amp
+
+
+def _build(prog, startup):
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        logits = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+    return x, label, loss
+
+
+def _batch(rng, n=32):
+    x = rng.randn(n, 16).astype("f4")
+    y = (x[:, :4].argmax(-1)).astype("i8")[:, None]
+    return x, y
+
+
+def test_o1_rewrite_inserts_casts_and_trains():
+    prog, startup = fluid.Program(), fluid.Program()
+    x, label, loss = _build(prog, startup)
+    n_ops = len(prog.desc.ops)
+    opt = static_amp.decorate(
+        fluid.optimizer.SGD(learning_rate=0.5), level="O1")
+    with fluid.program_guard(prog, startup):
+        opt.minimize(loss)
+    cast_ops = [op for op in prog.desc.ops if op.type == "cast"]
+    assert cast_ops, "no cast ops inserted by O1 rewrite"
+    low = [op for op in cast_ops
+           if op.attrs.get("to_dtype") == "bfloat16"]
+    assert low, "no bf16 casts present"
+    # the white-listed linear ops consume bf16-cast inputs
+    mm = [op for op in prog.desc.ops
+          if op.type in ("linear", "matmul", "mul")]
+    assert any(any("@cast_low" in n for n in op.inputs) for op in mm)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    first = None
+    for _ in range(30):
+        bx, by = _batch(rng)
+        (lv,) = exe.run(prog, feed={"x": bx, "label": by},
+                        fetch_list=[loss])
+        first = first if first is not None else float(lv)
+    assert float(lv) < first * 0.7, (first, float(lv))
+
+
+def test_o1_black_ops_get_f32_inputs():
+    prog, startup = fluid.Program(), fluid.Program()
+    x, label, loss = _build(prog, startup)
+    static_amp.rewrite_program(prog)
+    # black-list ops (softmax CE / mean) never read a low var directly
+    lists = static_amp.AutoMixedPrecisionLists()
+    low_outs = set()
+    for op in prog.desc.ops:
+        if op.type in lists.white_list or (
+                op.type == "cast"
+                and op.attrs.get("to_dtype") == "bfloat16"):
+            low_outs.update(op.outputs)
+        elif op.type in lists.black_list:
+            assert not (set(op.inputs) & low_outs), \
+                (op.type, op.inputs)
+
+
+def test_o2_casts_params_and_trains():
+    prog, startup = fluid.Program(), fluid.Program()
+    x, label, loss = _build(prog, startup)
+    opt = static_amp.decorate(
+        fluid.optimizer.SGD(learning_rate=0.25), level="O2")
+    with fluid.program_guard(prog, startup):
+        opt.minimize(loss)
+    import jax.numpy as jnp
+    low_params = [t for t in prog._persist.values()
+                  if hasattr(t, "_data") and t._data.dtype == jnp.bfloat16]
+    assert low_params, "O2 cast no parameters to bf16"
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    first = None
+    for _ in range(40):
+        bx, by = _batch(rng)
+        (lv,) = exe.run(prog, feed={"x": bx, "label": by},
+                        fetch_list=[loss])
+        first = first if first is not None else float(lv)
+    assert float(lv) < first * 0.8, (first, float(lv))
+
+
+def test_custom_lists_validate():
+    with pytest.raises(ValueError, match="both"):
+        static_amp.AutoMixedPrecisionLists(custom_white_list={"mean"},
+                                           custom_black_list={"mean"})
+
+
+def test_o2_activations_actually_low():
+    """Fetch a hidden activation after O2: it must be bfloat16 at runtime
+    (the feed relabel + Executor feed cast make the whole graph low)."""
+    import jax.numpy as jnp
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8)
+    static_amp.cast_model_to_fp16(prog)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (hv,) = exe.run(prog, feed={"x": np.zeros((2, 16), "f4")},
+                    fetch_list=[h], return_numpy=False)
+    assert hv.dtype == jnp.bfloat16, hv.dtype
+
+
+def test_rewrite_after_minimize_raises():
+    prog, startup = fluid.Program(), fluid.Program()
+    x, label, loss = _build(prog, startup)
+    with fluid.program_guard(prog, startup):
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    with pytest.raises(RuntimeError, match="BEFORE minimize"):
+        static_amp.rewrite_program(prog)
+
+
+def test_fp16_loss_scaling_not_implemented():
+    with pytest.raises(NotImplementedError, match="loss scaling"):
+        static_amp.decorate(fluid.optimizer.SGD(learning_rate=0.1),
+                            dest_dtype="float16",
+                            use_dynamic_loss_scaling=True)
