@@ -72,6 +72,14 @@ from . import quantization  # noqa: E402
 from . import utils  # noqa: E402
 from . import fluid  # noqa: E402
 from . import autograd  # noqa: E402
+from . import device  # noqa: E402
+from . import reader  # noqa: E402
+from . import compat  # noqa: E402
+from . import sysconfig  # noqa: E402
+from . import inference  # noqa: E402
+from . import dataset  # noqa: E402
+from . import tensor  # noqa: E402
+from .reader import batch  # noqa: E402
 from . import rec  # noqa: E402
 from .framework.serialization import save, load  # noqa: E402
 from .hapi.model import Model, summary  # noqa: E402

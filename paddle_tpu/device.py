@@ -1,0 +1,38 @@
+"""paddle.device namespace (ref python/paddle/device.py)."""
+from .framework.state import set_device, get_device
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_device_count():
+    import jax
+    return len(jax.devices())
+
+
+class cuda:       # paddle.device.cuda namespace shim
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False

@@ -1,0 +1,84 @@
+"""paddle.inference — the deployment surface (ref paddle/fluid/inference
+AnalysisPredictor + api/paddle_inference_api.h; the TRT/Lite/capi engines
+are out of scope per SURVEY §7 — XLA is the engine).
+
+TPU-native slice: a predictor over the StableHLO export format
+(static/export.py jit.save artifacts). Config/create_predictor keep the
+reference call contract:
+
+    config = Config(model_dir)          # a paddle.jit.save'd dir/prefix
+    predictor = create_predictor(config)
+    out = predictor.run([np_input, ...])
+"""
+import numpy as np
+
+
+class Config:
+    """ref paddle_infer.Config: carries the model path + knobs. GPU/TRT
+    switches are accepted and recorded (XLA owns device placement)."""
+
+    def __init__(self, model_dir=None, params_file=None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+        self._use_gpu = False
+        self._device_id = 0
+        self._enable_mkldnn = False
+        self._cpu_math_threads = 1
+        self._memory_optim = True
+        self._ir_optim = True
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_gpu = True
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def enable_mkldnn(self):
+        self._enable_mkldnn = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = n
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def model_path(self):
+        return self.model_dir
+
+
+class Predictor:
+    """ref AnalysisPredictor: named input/output handles + run(). The
+    compiled executable comes from the StableHLO artifact; repeated run()
+    calls reuse XLA's compile cache."""
+
+    def __init__(self, config):
+        from ..static.export import load
+        self._layer = load(config.model_path())
+        self._inputs = None
+
+    def get_input_names(self):
+        spec = getattr(self._layer, "_input_spec", None)
+        if spec:
+            return [getattr(s, "name", f"x{i}") or f"x{i}"
+                    for i, s in enumerate(spec)]
+        return ["x0"]
+
+    def get_output_names(self):
+        return ["out0"]
+
+    def run(self, inputs):
+        """inputs: list of numpy arrays in input order. Returns a list of
+        numpy outputs (ref predictor.run contract)."""
+        from ..framework.tensor import Tensor
+        outs = self._layer(*[np.asarray(a) for a in inputs])
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [np.asarray(o.numpy() if isinstance(o, Tensor) else o)
+                for o in outs]
+
+
+def create_predictor(config):
+    return Predictor(config)

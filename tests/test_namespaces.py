@@ -1,0 +1,115 @@
+"""Thin top-level namespaces (ref python/paddle layout): device, reader
+decorators, batch, dataset zoo readers, compat, sysconfig, tensor,
+inference predictor over StableHLO exports."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_device_namespace():
+    assert paddle.device.is_compiled_with_tpu()
+    assert not paddle.device.is_compiled_with_cuda()
+    assert paddle.device.get_device_count() >= 1
+    assert not paddle.device.cuda.is_available()
+
+
+def test_reader_decorators():
+    def r():
+        return iter(range(10))
+
+    batched = paddle.batch(r, 3)
+    chunks = list(batched())
+    assert [len(c) for c in chunks] == [3, 3, 3, 1]
+    assert list(paddle.reader.firstn(r, 4)()) == [0, 1, 2, 3]
+    assert sorted(paddle.reader.shuffle(r, 5)()) == list(range(10))
+    assert list(paddle.reader.chain(r, r)()) == list(range(10)) * 2
+    assert list(paddle.reader.map_readers(lambda a, b: a + b, r, r)()) \
+        == [2 * i for i in range(10)]
+    assert list(paddle.reader.buffered(r, 2)()) == list(range(10))
+    c = paddle.reader.cache(r)
+    assert list(c()) == list(c())
+
+
+def test_compose_misaligned_raises():
+    def a():
+        return iter([(1,), (2,)])
+
+    def b():
+        return iter([(1,)])
+
+    with pytest.raises(ValueError, match="compose"):
+        list(paddle.reader.compose(a, b)())
+
+
+def test_dataset_readers():
+    x, y = next(paddle.dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    img, label = next(paddle.dataset.mnist.train()())
+    assert np.asarray(img).size >= 28 * 28
+
+
+def test_tensor_namespace_and_compat():
+    t = paddle.tensor.ones([2, 2])
+    assert paddle.tensor.concat([t, t], axis=0).shape == [4, 2]
+    assert paddle.compat.to_text(b"abc") == "abc"
+    assert paddle.compat.to_bytes("abc") == b"abc"
+    assert isinstance(paddle.sysconfig.get_include(), str)
+
+
+def test_inference_predictor_roundtrip(tmp_path):
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 2))
+    x = np.random.RandomState(0).randn(3, 4).astype("f4")
+    ref = net(paddle.to_tensor(x)).numpy()
+    path = os.path.join(str(tmp_path), "model")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([None, 4],
+                                                        "float32")])
+    config = paddle.inference.Config(path)
+    config.enable_memory_optim()
+    predictor = paddle.inference.create_predictor(config)
+    (out,) = predictor.run([x])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    assert predictor.get_input_names()
+    assert predictor.get_output_names()
+
+
+def test_buffered_propagates_reader_errors():
+    def bad():
+        yield 1
+        raise RuntimeError("corrupt sample")
+
+    it = paddle.reader.buffered(bad, 2)()
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="corrupt"):
+        list(it)
+
+
+def test_cache_all_or_nothing():
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        yield 1
+        if calls[0] == 1:
+            raise RuntimeError("transient")
+        yield 2
+
+    c = paddle.reader.cache(flaky)
+    with pytest.raises(RuntimeError):
+        list(c())
+    assert list(c()) == [1, 2]     # retry re-reads, full data cached
+
+
+def test_compat_round_half_away_from_zero():
+    assert paddle.compat.round(2.5) == 3.0
+    assert paddle.compat.round(-2.5) == -3.0
+    assert paddle.compat.round(2.45, 1) == 2.5
+
+
+def test_tensor_namespace_no_leakage():
+    assert not hasattr(paddle.tensor, "jnp")
+    assert not hasattr(paddle.tensor, "apply")
