@@ -114,8 +114,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     for t in ins:
         t.grad = None
     rg = retain_graph if retain_graph is not None else create_graph
+    from .framework import tape as _tape
+    sinks = {id(t) for t in ins} if only_inputs else None
     for o in outs:
-        o.backward(retain_graph=True if rg else False)
+        _tape.backward(o, retain_graph=bool(rg),
+                       create_graph=bool(create_graph),
+                       only_accumulate=sinks)
     grads = [t.grad for t in ins]
     for t, (g, sg) in zip(ins, saved):
         t.grad = g

@@ -24,24 +24,71 @@ class GradNode:
     """One recorded op. Outputs hold (node, slot) so multi-output ops share a node."""
 
     __slots__ = ("vjp", "inputs", "n_outputs", "out_shapes", "out_dtypes", "name",
-                 "__weakref__")
+                 "fn", "primals", "__weakref__")
 
-    def __init__(self, vjp, inputs, n_outputs, out_shapes, out_dtypes, name=""):
+    def __init__(self, vjp, inputs, n_outputs, out_shapes, out_dtypes, name="",
+                 fn=None, primals=None):
         self.vjp = vjp                  # callable: tuple(cotangents) -> tuple(in grads)
         self.inputs = inputs            # list[Tensor | None]; None = non-diff input
         self.n_outputs = n_outputs
         self.out_shapes = out_shapes
         self.out_dtypes = out_dtypes
         self.name = name
+        # create_graph (double-grad) support: the bound forward impl + its
+        # primal arrays let the backward be REPLAYED through the dispatcher
+        # as a taped op, so second-order grads flow (partial_grad_engine
+        # analog). None for custom nodes that opt out.
+        self.fn = fn
+        self.primals = primals
 
 
 def _is_float0(g):
     return g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0)
 
 
-def backward(tensor, grad_tensor=None, retain_graph=False):
+_FREED = object()   # sentinel: graph released by a retain_graph=False sweep
+
+
+def _taped_vjp(node, cot_tensors):
+    """Replay one node's backward THROUGH the dispatcher so the computed
+    grads carry their own tape (create_graph mode). Returns Tensors."""
+    import jax as _jax
+    from .tensor import Tensor
+    from ..ops import dispatch as _dispatch
+    if node.primals is _FREED:
+        raise RuntimeError(
+            f"create_graph: op '{node.name}' graph was already freed by a "
+            "retain_graph=False backward; recompute the forward or pass "
+            "retain_graph=True")
+    if node.fn is None or node.primals is None:
+        raise RuntimeError(
+            f"create_graph: op '{node.name}' does not support double "
+            "backward (no replayable forward recorded)")
+    k = node.n_outputs
+
+    def bwd_fn(*args):
+        cots, prims = args[:k], args[k:]
+        _, vjp_fn = _jax.vjp(node.fn, *prims)
+        gs = vjp_fn(tuple(cots) if k > 1 else cots[0])
+        return tuple(gs) if len(gs) > 1 else gs[0]
+
+    prim_tensors = [
+        inp if inp is not None else Tensor(p, stop_gradient=True)
+        for inp, p in zip(node.inputs, node.primals)]
+    out = _dispatch.apply(bwd_fn, tuple(cot_tensors) + tuple(prim_tensors),
+                          name=f"{node.name}_grad")
+    return out if isinstance(out, tuple) else (out,)
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False,
+             create_graph=False, only_accumulate=None):
     """Reverse sweep from `tensor`. Accumulates into leaf `.grad` (paddle semantics:
-    grads accumulate across backward calls until clear_grad)."""
+    grads accumulate across backward calls until clear_grad). With
+    create_graph=True the sweep runs in Tensor space via the dispatcher,
+    so the produced grads are themselves differentiable.
+    `only_accumulate` (a set of tensor ids) restricts leaf accumulation to
+    those tensors — paddle.grad's only_inputs semantics: other leaves'
+    .grad slots are left untouched."""
     from .tensor import Tensor
 
     root_node = tensor._node
@@ -52,10 +99,17 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
         seed_grad = jnp.ones_like(tensor._data)
     else:
         seed_grad = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+    if create_graph:
+        retain_graph = True
+        if isinstance(grad_tensor, Tensor):
+            seed_grad = grad_tensor      # keep the caller's graph
+        else:
+            seed_grad = Tensor(seed_grad, stop_gradient=True)
 
     if root_node is None:
-        if not tensor.stop_gradient:
-            _accumulate_leaf(tensor, seed_grad)
+        if not tensor.stop_gradient and (
+                only_accumulate is None or id(tensor) in only_accumulate):
+            _accumulate_leaf(tensor, seed_grad, keep_graph=create_graph)
         return
 
     # ---- pass 1: count consumer edges per node (DFS over the creator graph)
@@ -90,18 +144,28 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
         visited_nodes.append(node)
         nid = id(node)
         slot_cots = cots.pop(nid)
-        full_cots = tuple(
-            c if c is not None else jnp.zeros(s, d)
-            for c, s, d in zip(slot_cots, node.out_shapes, node.out_dtypes))
-        in_grads = node.vjp(full_cots if node.n_outputs > 1 else full_cots[0])
+        if create_graph:
+            full_cots = tuple(
+                c if c is not None else Tensor(jnp.zeros(s, d),
+                                               stop_gradient=True)
+                for c, s, d in zip(slot_cots, node.out_shapes,
+                                   node.out_dtypes))
+            in_grads = _taped_vjp(node, full_cots)
+        else:
+            full_cots = tuple(
+                c if c is not None else jnp.zeros(s, d)
+                for c, s, d in zip(slot_cots, node.out_shapes, node.out_dtypes))
+            in_grads = node.vjp(full_cots if node.n_outputs > 1 else full_cots[0])
         if not isinstance(in_grads, tuple):
             in_grads = (in_grads,)
         for inp, g in zip(node.inputs, in_grads):
-            if inp is None or inp.stop_gradient or _is_float0(g):
+            garr = g._data if isinstance(g, Tensor) else g
+            if inp is None or inp.stop_gradient or _is_float0(garr):
                 continue
             child = inp._node
             if child is None:
-                _accumulate_leaf(inp, g)
+                if only_accumulate is None or id(inp) in only_accumulate:
+                    _accumulate_leaf(inp, g, keep_graph=create_graph)
                 continue
             cid = id(child)
             if cid not in pending:      # reached via a path pruned in pass 1
@@ -117,13 +181,21 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
         for node in visited_nodes:
             node.vjp = None
             node.inputs = ()
+            node.fn = None          # release the primal arrays too
+            node.primals = _FREED
         # detach root so a second backward errors out cleanly
         tensor._node = None
 
 
-def _accumulate_leaf(t, g):
+def _accumulate_leaf(t, g, keep_graph=False):
     from .tensor import Tensor
     from .selected_rows import SelectedRows
+    if keep_graph and isinstance(g, Tensor):
+        # create_graph mode: grads keep their tape (differentiable)
+        t.grad = g if t.grad is None else t.grad + g
+        return
+    if isinstance(g, Tensor):
+        g = g._data
     if g.dtype != t._data.dtype:
         g = g.astype(t._data.dtype)
     if isinstance(g, SelectedRows):

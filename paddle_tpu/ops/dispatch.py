@@ -159,6 +159,8 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
         out_shapes=tuple(o.shape for o in outs_t),
         out_dtypes=tuple(o.dtype for o in outs_t),
         name=name or getattr(fn, "__name__", "op"),
+        fn=f,                 # replayable impl for create_graph double-grad
+        primals=arrays,
     )
     wrapped = _wrap_outputs(outs_t if multi else outs_t[0], multi, True)
     ws = wrapped if multi else (wrapped,)

@@ -143,3 +143,87 @@ class TestNumericGrad:
         (p1 * 3 + p2 * 5).sum().backward()
         np.testing.assert_allclose(a.grad.numpy(), np.full((2, 2), 3.0))
         np.testing.assert_allclose(b.grad.numpy(), np.full((2, 2), 5.0))
+
+
+class TestCreateGraph:
+    """Double/higher-order grads: the create_graph sweep replays each
+    node's backward through the dispatcher (ref
+    imperative/partial_grad_engine.cc create_graph)."""
+
+    def test_second_and_third_order(self):
+        x = pt.to_tensor(np.array([2.0, 3.0], "f4"), stop_gradient=False)
+        y = (x * x * x).sum()
+        (g,) = pt.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [12.0, 27.0])
+        (g2,) = pt.grad(g.sum(), [x], create_graph=True)
+        np.testing.assert_allclose(g2.numpy(), [12.0, 18.0])   # 6x
+        (g3,) = pt.grad(g2.sum(), [x])
+        np.testing.assert_allclose(g3.numpy(), [6.0, 6.0])
+
+    def test_gradient_penalty_training(self):
+        """WGAN-GP-style: the penalty (|dD/dx| - 1)^2 trains through the
+        double-grad path."""
+        pt.seed(0)
+        lin = pt.nn.Linear(4, 1)
+        opt = pt.optimizer.SGD(learning_rate=0.2,
+                               parameters=lin.parameters())
+        x = pt.to_tensor(np.random.RandomState(0).randn(16, 4)
+                         .astype("f4"), stop_gradient=False)
+        first = last = None
+        for _ in range(25):
+            out = lin(x).sum()
+            (gx,) = pt.grad(out, [x], create_graph=True)
+            gnorm = ((gx * gx).sum(axis=1) ** 0.5)
+            penalty = ((gnorm - 1.0) ** 2).mean()
+            penalty.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(penalty.numpy())
+            first = first if first is not None else v
+            last = v
+        assert last < first * 0.1, (first, last)
+        # weight row norm pushed toward 1
+        wn = float(np.linalg.norm(lin.weight.numpy()))
+        assert abs(wn - 1.0) < 0.15, wn
+
+    def test_freed_graph_raises_informatively(self):
+        x = pt.to_tensor(np.array([1.0], "f4"), stop_gradient=False)
+        y = (x * x).sum()
+        (g,) = pt.grad(y, [x], create_graph=True)
+        pt.grad(g.sum(), [x])                 # frees both graphs
+        with pytest.raises(RuntimeError):
+            pt.grad(y, [x], create_graph=True)
+
+    def test_mixed_with_pylayer_raises_clearly(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Sq(PyLayer):
+            @staticmethod
+            def forward(ctx, a):
+                ctx.save_for_backward(a)
+                return a * a
+
+            @staticmethod
+            def backward(ctx, g):
+                (a,) = ctx.saved_tensor()
+                return 2 * a * g
+
+        x = pt.to_tensor(np.array([2.0], "f4"), stop_gradient=False)
+        y = Sq.apply(x).sum()
+        with pytest.raises(RuntimeError, match="double backward"):
+            pt.grad(y, [x], create_graph=True)
+
+    def test_free_releases_primals(self):
+        from paddle_tpu.framework.tape import _FREED
+        x = pt.to_tensor(np.array([1.0], "f4"), stop_gradient=False)
+        y = (x * x).sum()
+        node = y._node
+        y.backward()
+        assert node.primals is _FREED and node.fn is None
+
+    def test_grad_leaf_root_respects_only_inputs(self):
+        x = pt.to_tensor(np.array([3.0], "f4"), stop_gradient=False)
+        w = pt.to_tensor(np.array([1.0], "f4"), stop_gradient=False)
+        gs = pt.grad(x, [w], allow_unused=True)
+        assert gs == [None]
+        assert x.grad is None        # untouched: x is not an input sink
