@@ -87,7 +87,7 @@ def test_ctc_loss_trains():
     in_len = pt.to_tensor(np.asarray([T, T], "i4"))
     lab_len = pt.to_tensor(np.asarray([3, 3], "i4"))
     first = last = None
-    for _ in range(40):
+    for _ in range(15):
         logits = lin(pt.to_tensor(x))
         loss = crit(logits, pt.to_tensor(labels), in_len, lab_len)
         loss.backward()
@@ -96,7 +96,7 @@ def test_ctc_loss_trains():
         v = float(loss.numpy())
         first = first if first is not None else v
         last = v
-    assert last < first * 0.3, (first, last)
+    assert last < first * 0.45, (first, last)
 
 
 def test_pairwise_distance_and_unfold_layers():
