@@ -21,8 +21,9 @@ Deliberate limits (same spirit as the reference's unsupported lists):
 - `if`/`while` bodies containing return/break/continue/yield are left as
   python (they still work eagerly; under tracing they raise jax's
   concretization error with a clear message);
-- `for` loops stay python: concrete ranges unroll under jit (the common
-  case); tensor-bounded iteration should use paddle_tpu.static.fori_loop;
+- `for i in range(...)` lowers through the while machinery (tensor
+  bounds become lax.while_loop; concrete ranges still unroll); other
+  iterables (lists, enumerate, tensor iteration) stay python;
 - variables flowing through converted control flow must be tensors/scalars
   when traced (strings/objects are closure-captured, branch-invariant).
 """
@@ -205,6 +206,15 @@ def convert_while(cond_fn, body_fn, get, reset):
         final[i] = Tensor(res[j]) if isinstance(orig[i], Tensor) else res[j]
     reset(tuple(final))
     return tuple(final)
+
+
+def check_step(step):
+    """range() semantics: a CONCRETE zero step is an error (python raises
+    ValueError); a traced step can't be checked at trace time."""
+    u = _unwrap(step)
+    if not _is_traced(u) and int(u) == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    return step
 
 
 def convert_logical_and(lhs_fn, rhs_fn):
@@ -393,6 +403,52 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 f"__pt_false_{n}, {get}, {reset})")
         return self._emit_cluster(n, vars_, defs, call)
 
+    def visit_For(self, node):
+        """`for i in range(...)` lowers to the while machinery (ref
+        dygraph_to_static loop_transformer's for->while rewrite); other
+        iterables (lists, enumerate, tensors) stay python — range is the
+        only form whose bound can be a traced Tensor."""
+        self.generic_visit(node)
+        if (node.orelse or _scan(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range"
+                        and not node.iter.keywords
+                        and 1 <= len(node.iter.args) <= 3)):
+            return node
+        n = self.counter   # unique suffix for the loop-state temporaries
+        tgt = node.target.id
+        args = [ast.unparse(a) for a in node.iter.args]
+        if len(args) == 1:
+            start, stop, step = "0", args[0], "1"
+        elif len(args) == 2:
+            start, stop, step = args[0], args[1], "1"
+        else:
+            start, stop, step = args
+        # a hidden counter carries the loop; the TARGET is assigned inside
+        # the body, so after the loop it holds the LAST value (python
+        # binding), not one-past-the-end. Divergence kept: an empty range
+        # leaves the target at `start` rather than unbound (a traced loop
+        # needs a fixed carry structure).
+        setup = ast.parse(
+            f"__pt_i_{n} = {start}\n"
+            f"{tgt} = __pt_i_{n}\n"
+            f"__pt_stop_{n} = {stop}\n"
+            f"__pt_step_{n} = _jst.check_step({step})").body
+        # (stop - i) * step > 0 is direction-agnostic (positive or
+        # negative traced step)
+        while_src = (
+            f"while (__pt_stop_{n} - __pt_i_{n}) * __pt_step_{n} > 0:\n"
+            f"    pass")
+        while_node = ast.parse(while_src).body[0]
+        while_node.body = (
+            ast.parse(f"{tgt} = __pt_i_{n}").body
+            + list(node.body)
+            + ast.parse(f"__pt_i_{n} = __pt_i_{n} + __pt_step_{n}").body)
+        out = self.visit_While(while_node)
+        return setup + (out if isinstance(out, list) else [out])
+
     def visit_While(self, node):
         self.generic_visit(node)
         if node.orelse or _scan(node.body):
@@ -440,7 +496,13 @@ def convert_function(fn):
     if not isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
     fn_node.decorator_list = []
-    has_cf = any(isinstance(s, (ast.If, ast.While))
+    def _range_for(nd):
+        return (isinstance(nd, ast.For)
+                and isinstance(nd.iter, ast.Call)
+                and isinstance(nd.iter.func, ast.Name)
+                and nd.iter.func.id == "range")
+
+    has_cf = any(isinstance(s, (ast.If, ast.While)) or _range_for(s)
                  for s in ast.walk(fn_node))
     if not has_cf:
         _CACHE[key] = fn
@@ -484,6 +546,7 @@ class _JSTNamespace(types.SimpleNamespace):
 _JST = _JSTNamespace(
     convert_ifelse=convert_ifelse,
     convert_while=convert_while,
+    check_step=check_step,
     convert_logical_and=convert_logical_and,
     convert_logical_or=convert_logical_or,
     convert_logical_not=convert_logical_not,

@@ -173,3 +173,86 @@ def test_conversion_cache():
         return y
 
     assert convert_function(f) is convert_function(f)
+
+
+class TestForRange:
+    """for-in-range lowering to the while machinery (ref
+    loop_transformer's for->while rewrite)."""
+
+    def test_tensor_bound_for(self):
+        @pt.jit.to_static
+        def cum_pow(x, n):
+            acc = x * 0 + 1.0
+            for _ in range(n):
+                acc = acc * x
+            return acc
+
+        x = pt.to_tensor(np.array([2.0], "f4"))
+        np.testing.assert_allclose(
+            cum_pow(x, pt.to_tensor(5)).numpy(), [32.0])
+        # same compiled fn, different bound: value changes (lax.while)
+        np.testing.assert_allclose(
+            cum_pow(x, pt.to_tensor(3)).numpy(), [8.0])
+
+    def test_start_stop_step_and_negative(self):
+        @pt.jit.to_static
+        def tri(n):
+            total = n * 0
+            for i in range(n, 0, -1):
+                total = total + i
+            return total
+
+        np.testing.assert_allclose(tri(pt.to_tensor(5)).numpy(), 15)
+
+        @pt.jit.to_static
+        def evens(n):
+            s = n * 0
+            for i in range(0, n, 2):
+                s = s + i
+            return s
+
+        np.testing.assert_allclose(evens(pt.to_tensor(7)).numpy(),
+                                   0 + 2 + 4 + 6)
+
+    def test_concrete_range_still_python(self):
+        @pt.jit.to_static
+        def poly(x):
+            acc = x * 0
+            for i in range(3):          # concrete: unrolls
+                acc = acc + x ** i
+            return acc
+
+        x = pt.to_tensor(np.array([2.0], "f4"))
+        np.testing.assert_allclose(poly(x).numpy(), [1 + 2 + 4])
+
+    def test_non_range_for_left_alone(self):
+        @pt.jit.to_static
+        def over_list(x):
+            for m in [1.0, 2.0]:        # python iterable: stays python
+                x = x * m
+            return x
+
+        x = pt.to_tensor(np.array([3.0], "f4"))
+        np.testing.assert_allclose(over_list(x).numpy(), [6.0])
+
+    def test_post_loop_target_binding_matches_python(self):
+        @pt.jit.to_static
+        def last_i(x, n):
+            i = -1
+            for i in range(n):
+                x = x + i
+            return x, i
+
+        x = pt.to_tensor(np.array([0.0], "f4"))
+        out, i = last_i(x, pt.to_tensor(3))
+        assert int(np.asarray(i.numpy() if hasattr(i, "numpy") else i)) == 2
+
+    def test_zero_concrete_step_raises(self):
+        @pt.jit.to_static
+        def bad(x):
+            for i in range(0, 4, 0):
+                x = x + i
+            return x
+
+        with pytest.raises(ValueError, match="must not be zero"):
+            bad(pt.to_tensor(np.array([1.0], "f4")))
