@@ -1,0 +1,8 @@
+"""fleet.utils (ref python/paddle/distributed/fleet/utils): filesystem
+clients + recompute re-export."""
+from .fs import LocalFS, HDFSClient, FSFileExistsError, FSFileNotExistsError
+
+from ....incubate.recompute import recompute  # noqa: F401
+
+__all__ = ["LocalFS", "HDFSClient", "FSFileExistsError",
+           "FSFileNotExistsError", "recompute"]

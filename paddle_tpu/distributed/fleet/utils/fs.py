@@ -1,0 +1,103 @@
+"""Filesystem clients (ref python/paddle/distributed/fleet/utils/fs.py:
+LocalFS + HDFSClient over the hadoop CLI). The PS runtime and
+auto-checkpoint use these to move table snapshots/checkpoints."""
+import os
+import shutil
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class LocalFS:
+    """ref fs.py LocalFS — same call surface."""
+
+    def ls_dir(self, fs_path):
+        """Returns (dirs, files) directly under fs_path."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            if os.path.isdir(os.path.join(fs_path, name)):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        with open(fs_path, "a"):
+            pass
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        else:
+            os.remove(fs_path)
+
+    def mv(self, src_path, dst_path, overwrite=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if self.is_exist(dst_path):
+            if not overwrite:
+                raise FSFileExistsError(dst_path)
+            # os.replace overwrites FILES atomically — checkpoint rotation
+            # must never have a window with no checkpoint on disk; only a
+            # directory target needs pre-deletion (os.replace can't
+            # replace one)
+            if os.path.isdir(dst_path):
+                self.delete(dst_path)
+        os.replace(src_path, dst_path)
+
+    rename = mv
+
+    def upload(self, local_path, fs_path):
+        """LocalFS 'upload' is a copy (parity with the HDFS surface)."""
+        if not os.path.exists(local_path):
+            raise FSFileNotExistsError(local_path)
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """ref fs.py HDFSClient: the reference shells out to the hadoop CLI.
+    This build does not implement the CLI bridge — construction always
+    raises with guidance (an importable stub that constructed and then
+    crashed per-method would be worse). LocalFS exposes the same call
+    surface for local/shared-filesystem storage."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        raise RuntimeError(
+            "HDFSClient (hadoop CLI bridge) is not implemented in "
+            "paddle_tpu; use fleet.utils.LocalFS on a local or shared "
+            "(NFS) filesystem — the call surface is identical")

@@ -1,0 +1,45 @@
+"""fleet.utils filesystem clients (ref fleet/utils/fs.py)."""
+import pytest
+
+
+class TestFleetFS:
+    def test_localfs_surface(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import (
+            LocalFS, FSFileExistsError, FSFileNotExistsError)
+        fs = LocalFS()
+        root = str(tmp_path / "store")
+        fs.mkdirs(root + "/sub")
+        fs.touch(root + "/a.txt")
+        dirs, files = fs.ls_dir(root)
+        assert dirs == ["sub"] and files == ["a.txt"]
+        assert fs.is_file(root + "/a.txt") and fs.is_dir(root + "/sub")
+        fs.mv(root + "/a.txt", root + "/b.txt")
+        assert fs.is_exist(root + "/b.txt")
+        with pytest.raises(FSFileNotExistsError):
+            fs.mv(root + "/missing", root + "/x")
+        fs.touch(root + "/c.txt")
+        with pytest.raises(FSFileExistsError):
+            fs.mv(root + "/c.txt", root + "/b.txt")
+        fs.mv(root + "/c.txt", root + "/b.txt", overwrite=True)
+        fs.upload(root + "/b.txt", root + "/d.txt")
+        assert fs.list_dirs(root) == ["sub"]
+        fs.delete(root)
+        assert not fs.is_exist(root)
+
+    def test_hdfs_always_raises_with_guidance(self):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+        with pytest.raises(RuntimeError, match="LocalFS"):
+            HDFSClient()
+
+    def test_mv_overwrite_keeps_checkpoint_window_closed(self, tmp_path):
+        """File-over-file overwrite rides os.replace (atomic): dst exists
+        at every instant."""
+        from paddle_tpu.distributed.fleet.utils import LocalFS
+        fs = LocalFS()
+        a, b = str(tmp_path / "a"), str(tmp_path / "b")
+        for p, v in ((a, "new"), (b, "old")):
+            with open(p, "w") as f:
+                f.write(v)
+        fs.mv(a, b, overwrite=True)
+        with open(b) as f:
+            assert f.read() == "new"
