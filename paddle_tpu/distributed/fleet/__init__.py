@@ -28,7 +28,7 @@ def __getattr__(name):
         # ref fleet_base.py `util` property: host-collective helpers
         from .base import _fleet
         return _fleet.util
-    if name == "metrics":
+    if name in ("metrics", "utils"):
         import importlib
-        return importlib.import_module(__name__ + ".metrics")
+        return importlib.import_module(f"{__name__}.{name}")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -62,11 +62,10 @@ class LocalFS:
         if self.is_exist(dst_path):
             if not overwrite:
                 raise FSFileExistsError(dst_path)
-            # os.replace overwrites FILES atomically — checkpoint rotation
-            # must never have a window with no checkpoint on disk; only a
-            # directory target needs pre-deletion (os.replace can't
-            # replace one)
-            if os.path.isdir(dst_path):
+            # file-over-file rides os.replace (atomic: checkpoint rotation
+            # never has a window with no checkpoint on disk); any other
+            # type combination needs dst pre-deleted first
+            if os.path.isdir(dst_path) or os.path.isdir(src_path):
                 self.delete(dst_path)
         os.replace(src_path, dst_path)
 

@@ -1,0 +1,100 @@
+"""Op micro-benchmark harness (ref paddle/fluid/operators/benchmark/
+op_tester.cc): times a representative op set on the current backend and
+prints a table. Used to sanity-check kernel regressions chip-side.
+
+Usage: PYTHONPATH=/root/repo:/root/.axon_site python scripts/op_bench.py [op ...]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+if "--cpu" in sys.argv:        # sitecustomize bakes the axon platform;
+    sys.argv.remove("--cpu")   # only the config API overrides it
+    jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def _flash(q):
+    from paddle_tpu.ops.pallas.flash_attention import _flash_array
+    return _flash_array(q, q, q, causal=True)
+
+
+def _flash_grad(q):
+    from paddle_tpu.ops.pallas.flash_attention import _flash_array
+    return jax.grad(
+        lambda x: jnp.sum(_flash_array(x, x, x, causal=True)
+                          .astype(jnp.float32)))(q)
+
+
+CASES = {
+    # name: (fn, arg builder, flops estimate or None)
+    "matmul_4k_bf16": (
+        lambda a, b: a @ b,
+        lambda r: (jnp.asarray(r.randn(4096, 4096), jnp.bfloat16),
+                   jnp.asarray(r.randn(4096, 4096), jnp.bfloat16)),
+        2 * 4096 ** 3),
+    "matmul_1k_f32": (
+        lambda a, b: a @ b,
+        lambda r: (jnp.asarray(r.randn(1024, 1024), jnp.float32),) * 2,
+        2 * 1024 ** 3),
+    "layer_norm_8x1024x1024": (
+        lambda x: jax.nn.standardize(x, axis=-1),
+        lambda r: (jnp.asarray(r.randn(8, 1024, 1024), jnp.bfloat16),),
+        None),
+    "softmax_8x1024x32768": (
+        lambda x: jax.nn.softmax(x, axis=-1),
+        lambda r: (jnp.asarray(r.randn(8, 1024, 32768), jnp.bfloat16),),
+        None),
+    "flash_attn_fwd_b8h12s1024d64": (
+        _flash,
+        lambda r: (jnp.asarray(r.randn(8, 12, 1024, 64), jnp.bfloat16),),
+        4 * 8 * 12 * 1024 * 1024 * 64 // 2),
+    "flash_attn_fwdbwd_b8h12s1024d64": (
+        _flash_grad,
+        lambda r: (jnp.asarray(r.randn(8, 12, 1024, 64), jnp.bfloat16),),
+        int(4 * 8 * 12 * 1024 * 1024 * 64 // 2 * 3.5)),
+    "embedding_32k_to_8x1024": (
+        lambda w, i: w[i],
+        lambda r: (jnp.asarray(r.randn(32768, 768), jnp.bfloat16),
+                   jnp.asarray(r.randint(0, 32768, (8, 1024)), jnp.int32)),
+        None),
+    "conv2d_64x64x224": (
+        lambda x, k: jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME",
+            dimension_numbers=("NCHW", "OIHW", "NCHW")),
+        lambda r: (jnp.asarray(r.randn(8, 64, 224, 224), jnp.bfloat16),
+                   jnp.asarray(r.randn(64, 64, 3, 3), jnp.bfloat16)),
+        2 * 8 * 64 * 64 * 224 * 224 * 9),
+}
+
+
+def main():
+    names = sys.argv[1:] or list(CASES)
+    rng = np.random.RandomState(0)
+    print(f"backend: {jax.default_backend()}")
+    print(f"{'op':36s} {'ms':>9s} {'TFLOP/s':>9s}")
+    for name in names:
+        fn, build, flops = CASES[name]
+        args = build(rng)
+        jfn = jax.jit(fn)
+        jax.block_until_ready(jfn(*args))          # compile + warm
+        t0 = time.perf_counter()
+        n = 10
+        for _ in range(n):
+            out = jfn(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / n
+        tf = f"{flops / dt / 1e12:9.1f}" if flops else "        -"
+        print(f"{name:36s} {dt * 1e3:9.3f} {tf}")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,3 +43,20 @@ class TestFleetFS:
         fs.mv(a, b, overwrite=True)
         with open(b) as f:
             assert f.read() == "new"
+
+
+def test_fleet_utils_attribute_access():
+    import paddle_tpu.distributed.fleet as fleet
+    assert fleet.utils.LocalFS is not None
+
+
+def test_mv_dir_over_file_with_overwrite(tmp_path):
+    from paddle_tpu.distributed.fleet.utils import LocalFS
+    import os
+    fs = LocalFS()
+    d = str(tmp_path / "d")
+    os.makedirs(d)
+    f = str(tmp_path / "f")
+    open(f, "w").write("x")
+    fs.mv(d, f, overwrite=True)
+    assert os.path.isdir(f)
