@@ -38,3 +38,5 @@ from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
 from . import decode
 from .decode import (BeamSearchDecoder, dynamic_decode,
                      top_k_top_p_filtering, sampling_id, greedy_search)
+
+from . import utils  # noqa: E402

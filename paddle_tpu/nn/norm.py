@@ -3,6 +3,7 @@ GroupNorm, InstanceNorm, SyncBatchNorm (on TPU SyncBatchNorm = BatchNorm whose
 stats are psum'd across the data axis when running under shard_map)."""
 import numbers
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
@@ -225,7 +226,22 @@ class SpectralNorm(Layer):
 
     def forward(self, weight):
         from ..ops.dispatch import apply
+        from ..framework.tensor import Tensor as _T
         dim, iters, eps = self._dim, self._power_iters, self._eps
+        # eager calls ADVANCE the persisted power-iteration state (ref
+        # spectral_norm_op: u/v updated every call, so sigma converges
+        # across steps); under tracing the state is read-only
+        warr = weight._data if isinstance(weight, _T) else weight
+        if not isinstance(warr, jax.core.Tracer):
+            wm_ = jnp.moveaxis(warr, dim, 0).reshape(warr.shape[dim], -1)
+            u_, v_ = self.weight_u._data, self.weight_v._data
+            for _ in range(iters):
+                v_ = wm_.T @ u_
+                v_ = v_ / (jnp.linalg.norm(v_) + eps)
+                u_ = wm_ @ v_
+                u_ = u_ / (jnp.linalg.norm(u_) + eps)
+            self.weight_u._data = u_
+            self.weight_v._data = v_
         u0, v0 = self.weight_u._data, self.weight_v._data
 
         def f(w):

@@ -1,0 +1,127 @@
+"""paddle.nn.utils (ref python/paddle/nn/utils/weight_norm_hook.py,
+spectral_norm_hook.py, transform_parameters.py): layer reparametrization
+hooks + parameter/vector converters."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, Parameter
+from .layer import Layer
+
+
+def _norm_except(v, dim):
+    """||v|| computed over every axis except `dim` (None = whole tensor),
+    shaped to broadcast against v."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    dim = dim % v.ndim          # negative dims must still exclude an axis
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparametrize layer.<name> as g * v / ||v|| (ref weight_norm_hook):
+    the original parameter is replaced by `<name>_g` (magnitude) and
+    `<name>_v` (direction); a forward-pre-hook recomputes the composed
+    weight every call, so the optimizer trains g and v."""
+    if getattr(layer, f"__wn_{name}", None):
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = getattr(layer, name)
+    warr = w._data
+    g0 = _norm_except(warr, dim)
+    g = Parameter(g0, name=(w.name or name) + "_g")
+    v = Parameter(jnp.copy(warr), name=(w.name or name) + "_v")
+    # unregister the original parameter; Layer.__setattr__ registers the
+    # new pair into _parameters (single source of truth — no __dict__
+    # mirrors to go stale)
+    del layer._parameters[name]
+    setattr(layer, name + "_g", g)
+    setattr(layer, name + "_v", v)
+
+    def compose():
+        vv = getattr(layer, name + "_v")
+        gg = getattr(layer, name + "_g")
+        # keep everything in Tensor space so grads flow to g and v
+        from ..ops.dispatch import apply
+
+        def f(v_, g_):
+            return v_ * (g_ / _norm_except(v_, dim))
+
+        return apply(f, (vv, gg), name="weight_norm")
+
+    def pre_hook(lyr, inputs):
+        setattr(lyr, name, compose())
+        return inputs
+
+    handle = layer.register_forward_pre_hook(pre_hook)
+    object.__setattr__(layer, f"__wn_{name}", (handle, dim))
+    setattr(layer, name, compose())             # usable before a forward
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g * v/||v|| back into a single parameter (ref remove hook)."""
+    state = getattr(layer, f"__wn_{name}", None)
+    if not state:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    handle, dim = state
+    handle.remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    composed = v._data * (g._data / _norm_except(v._data, dim))
+    p = Parameter(composed, name=v.name[:-2] if v.name else name)
+    layer.__dict__.pop(name, None)   # drop the composed-Tensor shadow
+    setattr(layer, name, p)          # re-registers into _parameters
+    object.__setattr__(layer, f"__wn_{name}", None)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide layer.<name> by its spectral norm every forward (ref
+    spectral_norm_hook; persistent power-iteration state rides the
+    SpectralNorm module and advances on every eager call)."""
+    from .norm import SpectralNorm
+    if getattr(layer, f"__sn_{name}", None):
+        raise ValueError(f"spectral_norm already applied to {name!r}")
+    w = getattr(layer, name)
+    if dim is None:
+        # ref spectral_norm_hook: Linear and transpose convs matricize
+        # along dim 1 (their weight layout puts the output axis second)
+        cls = type(layer).__name__
+        dim = 1 if (("Linear" in cls or "Transpose" in cls)
+                    and len(w.shape) > 1) else 0
+    sn = SpectralNorm(tuple(w.shape), dim=dim,
+                      power_iters=n_power_iterations, eps=eps)
+    layer.add_sublayer(f"_spectral_norm_{name}", sn)
+    orig = layer._parameters[name]
+
+    def pre_hook(lyr, inputs):
+        object.__setattr__(lyr, name, sn(orig))
+        return inputs
+
+    handle = layer.register_forward_pre_hook(pre_hook)
+    object.__setattr__(layer, f"__sn_{name}", (handle, dim))
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """Concatenate parameters into one flat Tensor (ref
+    transform_parameters.py)."""
+    arrs = [p._data.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(arrs) if arrs
+                  else jnp.zeros((0,), jnp.float32))
+
+
+def vector_to_parameters(vec, parameters):
+    """Write a flat vector back into the parameter list (in-place)."""
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    total = sum(int(np.prod(p.shape)) for p in parameters)
+    if total != data.size:
+        raise ValueError(
+            f"vector has {data.size} elements but parameters need {total}")
+    for p in parameters:
+        k = int(np.prod(p.shape))
+        p._data = data[off:off + k].reshape(tuple(p.shape)) \
+            .astype(p._data.dtype)
+        off += k

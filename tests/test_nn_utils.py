@@ -1,0 +1,70 @@
+"""paddle.nn.utils: weight_norm/spectral_norm reparametrization hooks +
+parameter/vector converters (ref nn/utils/weight_norm_hook.py,
+spectral_norm_hook.py, transform_parameters.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nn.utils import (weight_norm, remove_weight_norm,
+                                 spectral_norm, parameters_to_vector,
+                                 vector_to_parameters)
+
+
+def test_weight_norm_roundtrip_and_training():
+    pt.seed(0)
+    lin = pt.nn.Linear(4, 3)
+    x = pt.to_tensor(np.random.RandomState(0).randn(8, 4).astype("f4"))
+    y0 = lin(x).numpy()
+    weight_norm(lin, dim=0)
+    names = sorted(n for n, _ in lin.named_parameters())
+    assert names == ["bias", "weight_g", "weight_v"]
+    np.testing.assert_allclose(lin(x).numpy(), y0, rtol=1e-5)
+
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    loss = (lin(x) ** 2).sum()
+    loss.backward()
+    assert lin.weight_g.grad is not None
+    assert lin.weight_v.grad is not None
+    opt.step()
+    opt.clear_grad()
+    y_trained = lin(x).numpy()
+    assert np.abs(y_trained - y0).max() > 1e-4
+
+    remove_weight_norm(lin)
+    names = sorted(n for n, _ in lin.named_parameters())
+    assert names == ["bias", "weight"]
+    np.testing.assert_allclose(lin(x).numpy(), y_trained, rtol=1e-5)
+
+
+def test_weight_norm_double_apply_rejected():
+    lin = pt.nn.Linear(2, 2)
+    weight_norm(lin)
+    with pytest.raises(ValueError, match="already"):
+        weight_norm(lin)
+
+
+def test_spectral_norm_caps_singular_value():
+    pt.seed(0)
+    lin = pt.nn.Linear(6, 6)
+    lin.weight._data = lin.weight._data * 10.0   # large spectral norm
+    spectral_norm(lin, n_power_iterations=8)
+    x = pt.to_tensor(np.eye(6, dtype="f4"))
+    lin(x)                                       # trigger hook
+    w_eff = np.asarray(lin.weight.numpy())
+    s = np.linalg.svd(w_eff, compute_uv=False)[0]
+    assert s == pytest.approx(1.0, rel=0.05)
+
+
+def test_parameter_vector_roundtrip():
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(3, 4), pt.nn.Linear(4, 2))
+    vec = parameters_to_vector(net.parameters())
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert vec.shape == [total]
+    orig = vec.numpy().copy()
+    vector_to_parameters(vec * 2.0, net.parameters())
+    np.testing.assert_allclose(
+        parameters_to_vector(net.parameters()).numpy(), orig * 2.0,
+        rtol=1e-6)
+    with pytest.raises(ValueError, match="elements"):
+        vector_to_parameters(vec.numpy()[:-1], net.parameters())
