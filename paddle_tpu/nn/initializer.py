@@ -179,3 +179,24 @@ def calculate_gain(nonlinearity, param=None):
              "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
              "selu": 0.75}
     return gains[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """ref initializer.py BilinearInitializer — transposed-conv upsampling
+    kernels: EVERY channel pair of the 4-D weight gets the separable
+    bilinear interpolation filter (the reference fills all channels, so
+    the canonical grouped layout [C, 1, kh, kw] upsamples every channel)."""
+
+    def _generate(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects a 4-D conv weight shape")
+        kh, kw = shape[2], shape[3]
+
+        def filt(k):
+            f = (k + 1) // 2
+            center = f - 1 if k % 2 == 1 else f - 0.5
+            return (1 - np.abs(np.arange(k) - center) / f)
+
+        kern = np.outer(filt(kh), filt(kw))
+        w = np.broadcast_to(kern, shape)
+        return jnp.asarray(w, convert_dtype(dtype))

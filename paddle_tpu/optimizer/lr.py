@@ -241,3 +241,85 @@ class OneCycleLR(LRScheduler):
         pct = (step - up) / max(self.total_steps - up, 1)
         return self.end_lr + (self.max_lr - self.end_lr) * (
             1 + math.cos(math.pi * pct)) / 2
+
+
+class MultiplicativeDecay(LRScheduler):
+    """ref lr.py MultiplicativeDecay: lr_{t} = lr_{t-1} * lam(t)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        lr = self.base_lr
+        for e in range(1, self.last_epoch + 1):
+            lr = lr * self.lr_lambda(e)
+        return lr
+
+
+class CyclicLR(LRScheduler):
+    """ref lr.py CyclicLR (triangular policies over a base/max band)."""
+
+    def __init__(self, base_learning_rate, max_learning_rate,
+                 step_size_up, step_size_down=None, mode="triangular",
+                 exp_gamma=1.0, scale_fn=None, scale_mode="cycle",
+                 last_epoch=-1, verbose=False):
+        self.max_lr = float(max_learning_rate)
+        self.up = int(step_size_up)
+        self.down = int(step_size_down
+                        if step_size_down is not None else step_size_up)
+        if self.up <= 0 or self.down <= 0:
+            raise ValueError("CyclicLR step sizes must be positive")
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        if scale_fn is not None:
+            self.scale_fn, self.scale_mode = scale_fn, scale_mode
+        elif mode == "triangular":
+            self.scale_fn, self.scale_mode = (lambda x: 1.0), "cycle"
+        elif mode == "triangular2":
+            self.scale_fn = lambda x: 1.0 / (2.0 ** (x - 1))
+            self.scale_mode = "cycle"
+        elif mode == "exp_range":
+            self.scale_fn = lambda x: exp_gamma ** x
+            self.scale_mode = "iterations"
+        else:
+            raise ValueError(f"unknown CyclicLR mode {mode!r}")
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        total = self.up + self.down
+        it = max(self.last_epoch, 0)
+        cycle = it // total + 1
+        pos = it % total
+        frac = pos / self.up if pos < self.up \
+            else 1.0 - (pos - self.up) / self.down
+        span = (self.max_lr - self.base_lr) * frac
+        x = cycle if self.scale_mode == "cycle" else it
+        return self.base_lr + span * self.scale_fn(x)
+
+
+class CosineAnnealingWarmRestarts(LRScheduler):
+    """ref lr.py CosineAnnealingWarmRestarts (SGDR): cosine anneal over
+    T_i, restart, T_{i+1} = T_i * T_mult."""
+
+    def __init__(self, learning_rate, T_0, T_mult=1, eta_min=0.0,
+                 last_epoch=-1, verbose=False):
+        if T_0 <= 0 or T_mult < 1:
+            raise ValueError("T_0 must be > 0 and T_mult >= 1")
+        self.T_0 = int(T_0)
+        self.T_mult = int(T_mult)
+        self.eta_min = float(eta_min)
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        e = max(self.last_epoch, 0)
+        t_i = self.T_0
+        if self.T_mult == 1:
+            e = e % self.T_0            # O(1); the loop would be O(e/T_0)
+        else:
+            while e >= t_i:
+                e -= t_i
+                t_i *= self.T_mult
+        return self.eta_min + (self.base_lr - self.eta_min) \
+            * (1 + math.cos(math.pi * e / t_i)) / 2

@@ -68,3 +68,66 @@ def test_parameter_vector_roundtrip():
         rtol=1e-6)
     with pytest.raises(ValueError, match="elements"):
         vector_to_parameters(vec.numpy()[:-1], net.parameters())
+
+
+class TestLRSchedulerTail:
+    def test_cyclic_triangular(self):
+        lr = pt.optimizer.lr.CyclicLR(0.1, 1.0, step_size_up=4,
+                                      step_size_down=4)
+        vals = []
+        for _ in range(9):
+            vals.append(lr())
+            lr.step()
+        assert vals[0] == pytest.approx(0.1)
+        assert vals[4] == pytest.approx(1.0)
+        assert vals[8] == pytest.approx(0.1)
+
+    def test_cyclic_triangular2_halves_amplitude(self):
+        lr = pt.optimizer.lr.CyclicLR(0.0, 1.0, step_size_up=2,
+                                      step_size_down=2,
+                                      mode="triangular2")
+        vals = []
+        for _ in range(7):
+            vals.append(lr())
+            lr.step()
+        assert vals[2] == pytest.approx(1.0)      # cycle 1 peak
+        assert vals[6] == pytest.approx(0.5)      # cycle 2 peak halved
+
+    def test_warm_restarts(self):
+        wr = pt.optimizer.lr.CosineAnnealingWarmRestarts(1.0, T_0=4,
+                                                         T_mult=2)
+        seq = []
+        for _ in range(13):
+            seq.append(wr())
+            wr.step()
+        assert seq[0] == pytest.approx(1.0)
+        assert seq[4] == pytest.approx(1.0)       # restart at T_0
+        assert seq[12] == pytest.approx(1.0)      # next period 8
+        assert seq[2] == pytest.approx(0.5)
+
+    def test_multiplicative(self):
+        md = pt.optimizer.lr.MultiplicativeDecay(1.0, lambda e: 0.5)
+        seq = []
+        for _ in range(4):
+            seq.append(md())
+            md.step()
+        assert seq == [pytest.approx(1.0), pytest.approx(0.5),
+                       pytest.approx(0.25), pytest.approx(0.125)]
+
+
+def test_bilinear_initializer_fills_all_channels():
+    init = pt.nn.initializer.Bilinear()
+    w = np.asarray(init([3, 1, 4, 4], "float32"))   # grouped layout
+    assert w.shape == (3, 1, 4, 4)
+    # every channel carries the same symmetric kernel (reference fills all)
+    for c in range(3):
+        np.testing.assert_allclose(w[c, 0], w[0, 0])
+    np.testing.assert_allclose(w[0, 0], w[0, 0].T, atol=1e-7)
+    assert w[0, 0, 1, 1] == w[0, 0].max()
+    with pytest.raises(ValueError, match="4-D"):
+        init([3, 3], "float32")
+
+
+def test_cyclic_rejects_nonpositive_steps():
+    with pytest.raises(ValueError, match="positive"):
+        pt.optimizer.lr.CyclicLR(0.1, 1.0, step_size_up=0)
