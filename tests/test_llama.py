@@ -134,3 +134,20 @@ def test_llama_generate_rejects_overlong_decode():
     prompt = pt.to_tensor(np.zeros((1, 6), np.int32))
     with pytest.raises(ValueError, match="RoPE"):
         generate(m, prompt, max_new_tokens=8, use_cache=True)
+
+
+def test_llama_jit_save_load_roundtrip(tmp_path):
+    """StableHLO export handles the full RoPE/GQA/RMSNorm stack."""
+    import os
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=16)
+    m = LlamaForCausalLM(cfg)
+    ids = np.random.RandomState(0).randint(0, 64, (2, 16)).astype("i4")
+    ref = m(pt.to_tensor(ids)).numpy()
+    path = os.path.join(str(tmp_path), "llama")
+    pt.jit.save(m, path,
+                input_spec=[pt.static.InputSpec([None, 16], "int32")])
+    out = pt.jit.load(path)(ids)
+    arr = np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+    np.testing.assert_allclose(arr, ref, atol=1e-5)
