@@ -43,7 +43,7 @@ def _tpu_probe_ok(timeout_s=120):
         return False
 
 
-def _init_backend(max_tries=3, delay=20.0):
+def _init_backend(max_tries=2, delay=20.0):
     """Initialize a JAX backend, preferring the TPU but never hanging on
     it: each attempt probes the tunnel in a killable child first.
     Returns (jax, on_tpu)."""
