@@ -1260,3 +1260,26 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return apply(f, (log_probs, labels, input_lengths, label_lengths),
                  name="ctc_loss")
+
+
+def gather_tree(ids, parents):
+    """Reconstruct full beam-search sequences from per-step token ids and
+    parent beam indices (ref operators/gather_tree_op.cc; both [T, B, K]).
+    TPU-native: one reverse lax.scan walking the parent chain — no
+    per-(batch, beam) host loops."""
+    def f(ids_, par_):
+        T, B, K = ids_.shape
+        par_ = par_.astype(jnp.int32)
+
+        def step(beams, xs):
+            ids_t, par_t = xs
+            out_t = jnp.take_along_axis(ids_t, beams, axis=-1)
+            prev = jnp.take_along_axis(par_t, beams, axis=-1)
+            return prev, out_t
+
+        init = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (B, K))
+        _, outs = jax.lax.scan(step, init, (ids_, par_), reverse=True)
+        return outs
+
+    return apply(f, (ids, parents), differentiable=False,
+                 name="gather_tree")

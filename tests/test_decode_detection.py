@@ -219,3 +219,25 @@ def test_gpt_generate_rejects_overlong_decode():
     with pytest.raises(ValueError, match="max_seq_len"):
         generate(m, pt.to_tensor(np.zeros((1, 6), np.int32)),
                  max_new_tokens=8, use_cache=True)
+
+
+def test_gather_tree_matches_reference_walk():
+    """Parent-chain reconstruction vs an explicit python walk
+    (ref gather_tree_op semantics)."""
+    import paddle_tpu.nn.functional as F
+    rng = np.random.RandomState(0)
+    T, B, K = 5, 2, 3
+    ids = rng.randint(0, 9, (T, B, K)).astype("i8")
+    parents = rng.randint(0, K, (T, B, K)).astype("i8")
+    out = np.asarray(F.gather_tree(pt.to_tensor(ids),
+                                   pt.to_tensor(parents)).numpy())
+    ref = np.zeros_like(ids)
+    for b in range(B):
+        for k in range(K):
+            beam = k
+            ref[T - 1, b, k] = ids[T - 1, b, beam]
+            parent = parents[T - 1, b, beam]
+            for t in range(T - 2, -1, -1):
+                ref[t, b, k] = ids[t, b, parent]
+                parent = parents[t, b, parent]
+    np.testing.assert_array_equal(out, ref)
