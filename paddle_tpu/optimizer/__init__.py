@@ -1,6 +1,7 @@
 """paddle_tpu.optimizer (ref python/paddle/optimizer/__init__.py)."""
 from . import lr
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
-                        Adagrad, Adadelta, RMSProp, Lamb, Lars)
+                        Adagrad, Adadelta, RMSProp, Lamb, Lars, Ftrl,
+                        Dpsgd)
 from .wrappers import (ExponentialMovingAverage, ModelAverage,
                        LookaheadOptimizer, GradientMergeOptimizer)

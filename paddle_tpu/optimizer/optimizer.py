@@ -491,3 +491,87 @@ class Lars(Momentum):
             coeff * w_norm / (g_norm + wd * w_norm + 1e-12), 1.0)
         v_new = mu * v + lr * local_lr * (g32 + wd * p32)
         return p - v_new.astype(p.dtype), (v_new,)
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (ref operators/optimizers/ftrl_op.h): per-coordinate
+    adaptive lr with L1/L2 regularization in the update itself — the
+    sparse-model optimizer the reference pairs with PS training."""
+
+    _state_names = ("squared", "linear")
+
+    def __init__(self, learning_rate=0.05, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+        self._lr_power = float(lr_power)
+
+    def _hyper(self):
+        return (self._l1, self._l2, self._lr_power)
+
+    def _init_state(self, arr):
+        return {"squared": jnp.zeros(arr.shape, jnp.float32),
+                "linear": jnp.zeros(arr.shape, jnp.float32)}
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        l1, l2, lr_power = hyper
+        sq, lin = state
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        new_sq = sq + gf * gf
+        sigma = (new_sq ** (-lr_power) - sq ** (-lr_power)) / lr
+        lin = lin + gf - sigma * pf
+        quad = new_sq ** (-lr_power) / lr + 2.0 * l2
+        pre = jnp.clip(lin, -l1, l1) - lin
+        new_p = jnp.where(jnp.abs(lin) > l1, pre / quad, 0.0)
+        return new_p.astype(p.dtype), (new_sq, lin)
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (ref operators/optimizers/dpsgd_op.cc):
+    per-update gradient clipping to `clip` + gaussian noise scaled by
+    batch_size/sigma.
+
+    RNG discipline: a FRESH key is drawn eagerly in _hyper() every step
+    (so paddle.seed governs the noise and the key enters the compiled
+    update as a traced argument, never a baked constant), and each
+    parameter carries a unique `noise_idx` in its state so same-shaped
+    parameters get decorrelated noise. Under a whole-step compiler
+    (TrainStep) the key is captured once at build time; noise still
+    varies per step/param via fold_in(step, idx)."""
+
+    _state_names = ("noise_idx",)
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._clip = float(clip)
+        self._batch_size = float(batch_size)
+        self._sigma = float(sigma)
+        self._noise_counter = 0
+
+    def _hyper(self):
+        from ..framework import state as _st
+        return (self._clip, self._batch_size, self._sigma,
+                _st.next_rng_key())
+
+    def _init_state(self, arr):
+        self._noise_counter += 1
+        return {"noise_idx": jnp.asarray(self._noise_counter, jnp.uint32)}
+
+    @staticmethod
+    def _update(p, g, lr, hyper, state, step):
+        clip, batch_size, sigma, key = hyper
+        (idx,) = state
+        gf = g.astype(jnp.float32)
+        norm = jnp.sqrt(jnp.sum(gf * gf))
+        gf = gf * jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+        key = jax.random.fold_in(jax.random.fold_in(key, step), idx)
+        noise = jax.random.normal(key, gf.shape) * (clip * sigma
+                                                    / batch_size)
+        return (p - lr * (gf + noise).astype(p.dtype)).astype(p.dtype), \
+            (idx,)

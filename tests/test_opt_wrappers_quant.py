@@ -187,3 +187,67 @@ def test_int8_weight_only_conversion():
     # state_dict carries the quantized form (deployable artifact)
     sd = model.state_dict()
     assert any("w_int8" in k for k in sd)
+
+
+class TestFtrlDpsgd:
+    def test_ftrl_known_first_step(self):
+        """One FTRL step from zero state vs hand-computed values
+        (ref ftrl_op.h math)."""
+        pt.seed(0)
+        p = pt.framework.tensor.Parameter(np.asarray([1.0, -2.0], "f4"),
+                                          name="w")
+        opt = pt.optimizer.Ftrl(learning_rate=0.5, l1=0.1, l2=0.05,
+                                parameters=[p])
+        g = np.asarray([0.2, -0.4], "f4")
+        from paddle_tpu.framework.tensor import Tensor
+        p.grad = Tensor(np.asarray(g))
+        opt.step()
+        lr, l1, l2, lp = 0.5, 0.1, 0.05, -0.5
+        sq = g * g
+        sigma = (sq ** (-lp) - 0.0) / lr
+        lin = g - sigma * np.asarray([1.0, -2.0])
+        quad = sq ** (-lp) / lr + 2 * l2
+        expect = np.where(np.abs(lin) > l1,
+                          (np.clip(lin, -l1, l1) - lin) / quad, 0.0)
+        np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5)
+
+    def test_ftrl_induces_sparsity(self):
+        """Tiny gradients + strong l1 keep weights at exactly zero."""
+        pt.seed(0)
+        p = pt.framework.tensor.Parameter(np.zeros(4, "f4"), name="w")
+        opt = pt.optimizer.Ftrl(learning_rate=0.1, l1=10.0,
+                                parameters=[p])
+        from paddle_tpu.framework.tensor import Tensor
+        for _ in range(5):
+            p.grad = Tensor(np.full(4, 0.01, "f4"))
+            opt.step()
+        np.testing.assert_array_equal(p.numpy(), np.zeros(4))
+
+    def test_dpsgd_clips_and_is_seeded(self):
+        from paddle_tpu.framework.tensor import Tensor
+
+        def run(seed):
+            pt.seed(seed)
+            p = pt.framework.tensor.Parameter(np.zeros(8, "f4"), name="w")
+            q = pt.framework.tensor.Parameter(np.zeros(8, "f4"), name="v")
+            opt = pt.optimizer.Dpsgd(learning_rate=0.1, clip=1.0,
+                                     batch_size=8.0, sigma=1.0,
+                                     parameters=[p, q])
+            g = np.full(8, 100.0, "f4")                # huge: clipped
+            p.grad, q.grad = Tensor(g), Tensor(g)
+            opt.step()
+            r1 = (p.numpy().copy(), q.numpy().copy())
+            p.grad, q.grad = Tensor(g), Tensor(g)
+            opt.step()
+            return r1, (p.numpy().copy(), q.numpy().copy())
+
+        (a1, aq1), (a2, _) = run(7)
+        (b1, _), _ = run(7)
+        (c1, _), _ = run(12345)
+        np.testing.assert_array_equal(a1, b1)   # same seed -> same noise
+        assert np.abs(a1 - c1).max() > 1e-6     # different seed differs
+        assert np.abs(a1 - aq1).max() > 1e-6    # same-shaped params differ
+        assert np.abs(a1 - (a2 - a1)).max() > 1e-6   # noise varies by step
+        # clipped grad norm is 1, lr 0.1, noise scale 1/8 — far from the
+        # unclipped magnitude 10.0 per coordinate
+        assert np.abs(a1).max() < 0.2, a1
