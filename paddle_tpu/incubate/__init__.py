@@ -2,3 +2,10 @@
 from . import recompute  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import train_epoch_range, TrainEpochRange  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "moe":
+        import importlib
+        return importlib.import_module(__name__ + ".moe")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

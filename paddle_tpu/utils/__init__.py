@@ -9,3 +9,45 @@ def try_import(name):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+def run_check():
+    """ref paddle.utils.run_check: sanity-check the install + device."""
+    import jax
+    import numpy as np
+    from .. import to_tensor
+    backend = jax.default_backend()
+    x = to_tensor(np.ones((2, 2), "f4"))
+    y = (x @ x).numpy()
+    if float(y[0, 0]) != 2.0:       # not assert: must survive python -O
+        raise RuntimeError(
+            f"paddle_tpu self-check FAILED on backend {backend}: "
+            f"ones(2,2) @ ones(2,2) gave {y!r}, expected 2.0s")
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! backend={backend}, "
+          f"{n} device(s) visible.")
+
+
+def deprecated(update_to="", since="", reason=""):
+    """ref paddle.utils.deprecated decorator."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{fn.__qualname__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if reason:
+                msg += f": {reason}"
+            if update_to:
+                msg += f"; use '{update_to}' instead"
+            with warnings.catch_warnings():
+                # DeprecationWarning is filtered outside __main__ by
+                # default; the reference forces visibility the same way
+                warnings.simplefilter("always", DeprecationWarning)
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco

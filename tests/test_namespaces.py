@@ -113,3 +113,22 @@ def test_compat_round_half_away_from_zero():
 def test_tensor_namespace_no_leakage():
     assert not hasattr(paddle.tensor, "jnp")
     assert not hasattr(paddle.tensor, "apply")
+
+
+def test_utils_run_check_and_deprecated(capsys):
+    import warnings
+    paddle.utils.run_check()
+    assert "installed successfully" in capsys.readouterr().out
+
+    @paddle.utils.deprecated(update_to="paddle.new_api", since="2.0")
+    def legacy():
+        return 7
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert legacy() == 7
+    assert any("paddle.new_api" in str(x.message) for x in w)
+
+
+def test_incubate_moe_reachable():
+    assert paddle.incubate.moe.MoELayer is not None
