@@ -20,11 +20,30 @@ from .control_flow import (cond, while_loop, case, switch_case, TensorArray,
 
 
 class nn:
-    """paddle.static.nn namespace (ref python/paddle/static/nn)."""
+    """paddle.static.nn namespace (ref python/paddle/static/nn — the
+    static builders alias the fluid.layers set, exactly like the
+    reference's static/nn/__init__.py re-exports)."""
     cond = staticmethod(cond)
     while_loop = staticmethod(while_loop)
     case = staticmethod(case)
     switch_case = staticmethod(switch_case)
+
+    def __init_subclass__(cls):
+        raise TypeError("paddle.static.nn is a namespace, not a base class")
+
+
+def _populate_static_nn():
+    from ..fluid import layers as _L
+    # no `data` here: paddle.static.data (full-shape semantics) is the
+    # 2.x entry point; fluid.layers.data's append_batch_size behavior
+    # would silently double the batch dim for 2.x-style callers
+    for _name in ("fc", "embedding", "conv2d", "batch_norm",
+                  "sequence_pool", "dropout", "one_hot", "topk"):
+        setattr(nn, _name, staticmethod(getattr(_L, _name)))
+    nn.data = staticmethod(data)
+
+
+_populate_static_nn()
 
 _static_mode = False
 

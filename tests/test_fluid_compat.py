@@ -78,3 +78,33 @@ def test_fluid_io_save_load_params(tmp_path):
     fluid.io.load_params(exe, str(tmp_path), main_program=prog,
                          filename="params.npz")
     np.testing.assert_allclose(np.asarray(w._data), old)
+
+
+def test_static_nn_namespace_builders():
+    """paddle.static.nn re-exports the layer builders (ref static/nn)."""
+    from paddle_tpu import static
+    for name in ("fc", "embedding", "conv2d", "batch_norm", "data",
+                 "cond", "while_loop"):
+        assert callable(getattr(static.nn, name)), name
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        # static.nn.data is paddle.static.data (FULL shape, 2.x style)
+        x = static.nn.data(name="x", shape=[None, 8], dtype="float32")
+        label = static.nn.data(name="label", shape=[None, 1],
+                               dtype="int64")
+        h = static.nn.fc(input=x, size=16, act="relu")
+        logits = static.nn.fc(input=h, size=3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    bx = rng.randn(32, 8).astype("f4")
+    by = bx[:, :3].argmax(-1).astype("i8")[:, None]
+    first = None
+    for _ in range(30):
+        (lv,) = exe.run(prog, feed={"x": bx, "label": by},
+                        fetch_list=[loss])
+        first = first if first is not None else float(lv)
+    assert float(lv) < first * 0.6
