@@ -43,7 +43,14 @@ class DataParallel(Layer):
         multi-process mode (jax.distributed initialised by
         init_parallel_env / the launcher), this is a real cross-process
         gradient mean over the coordination service — the dygraph Reducer's
-        allreduce, batched into one fused collective per call."""
+        allreduce, batched into one fused collective per call.
+
+        COMPAT SHIM ONLY, not a perf path: the eager mean stages through
+        host numpy (process_allgather -> np mean -> re-upload) per call,
+        a device->host->device round-trip the reference does as bucketed
+        in-place NCCL. The compiled GSPMD path (ShardedTrainStep /
+        strategy transforms) is the performance-bearing DP implementation;
+        keep eager DP out of any benchmark or perf claim."""
         try:
             nproc = jax.process_count()
         except (RuntimeError, ValueError):

@@ -22,11 +22,16 @@ send/recv ops; here the whole schedule is ONE jitted program:
     so its F tick only banks the input; loss and d(loss) emerge on its B
     tick — the classic 1F1B "loss immediately follows arrival" behavior.
 
-Composability: this engine owns the 'pp' axis exclusively (pure-pp mesh);
-the GPipe-as-scan engine (pipeline.py) remains the pp×dp×mp composition
-path. Peak-memory, not bubble, is what 1F1B buys: both schedules idle
-(S-1)-ish slots per wave, but 1F1B retires microbatch m's activations after
-its backward instead of after ALL forwards.
+Composability (ref fleet/meta_optimizers/pipeline_optimizer.py:232, which
+inserts per-ring allreduce to compose pipeline with DP): the schedule is
+MANUAL only over 'pp' (jax.shard_map axis_names={'pp'}); any other mesh
+axes (dp, mp) stay AUTO, so GSPMD shards the per-stage compute over them
+and inserts the dp gradient psums and Megatron mp collectives itself —
+the Megatron dp×mp×pp production shape with 1F1B memory behavior, without
+hand-written per-ring allreduces. Peak-memory, not bubble, is what 1F1B
+buys: both schedules idle (S-1)-ish slots per wave, but 1F1B retires
+microbatch m's activations after its backward instead of after ALL
+forwards.
 """
 import numpy as np
 
@@ -151,6 +156,25 @@ def pipeline_1f1b(stage_fn, last_loss_fn, blocks_p, post_p, x_micro,
         # blocks_local: [1, ...] local stage slice -> squeeze
         params = jax.tree.map(lambda a: a[0], blocks_local)
         me = lax.axis_index(axis)
+        # vma discipline (check_vma=True on hybrid meshes): every stage
+        # computes different values, so mark ALL inputs varying over 'pp'
+        # up front — cond branches then agree on types
+        def _v(a):
+            # idempotent: stacked inputs (P over pp) arrive already varying.
+            # lax.pcast is the current invariant->varying cast; pvary is its
+            # deprecated alias (kept as fallback for older jax).
+            vma = getattr(jax.typeof(a), "vma", frozenset())
+            if axis in vma:
+                return a
+            if hasattr(lax, "pcast"):
+                return lax.pcast(a, (axis,), to="varying")
+            return lax.pvary(a, (axis,))
+
+        vary = lambda t: jax.tree.map(_v, t)
+        params = vary(params)
+        post_local = vary(post_local)
+        xm = vary(xm)
+        labm = vary(labm)
 
         def fwd_of(x):
             return stage_fn(params, x)
@@ -159,7 +183,7 @@ def pipeline_1f1b(stage_fn, last_loss_fn, blocks_p, post_p, x_micro,
             def f(p, pp_, xx):
                 return last_loss_fn(p, pp_, xx, lab)
             loss, pull = jax.vjp(f, params, post_local, x)
-            dp, dpost, dx = pull(jnp.asarray(1.0 / M, loss.dtype))
+            dp, dpost, dx = pull(_v(jnp.asarray(1.0 / M, loss.dtype)))
             return loss, dp, dpost, dx
 
         def tick(carry, xs):
@@ -221,7 +245,8 @@ def pipeline_1f1b(stage_fn, last_loss_fn, blocks_p, post_p, x_micro,
                     _, pull = jax.vjp(f, params, x_sv)
                     dp, dx = pull(cot[mb_i % S].astype(x_sv.dtype))
                     zero_post = jax.tree.map(jnp.zeros_like, post_local)
-                    return jnp.asarray(0.0, jnp.float32), dp, zero_post, dx
+                    return (_v(jnp.asarray(0.0, jnp.float32)), dp, zero_post,
+                            dx)
 
                 loss_m, dp, dpost, dx = lax.cond(me == S - 1, last_branch,
                                                  mid_branch, None)
@@ -243,7 +268,7 @@ def pipeline_1f1b(stage_fn, last_loss_fn, blocks_p, post_p, x_micro,
                     dx_acc), None
 
         zeros_act = jnp.zeros(mb_shape, x_micro.dtype)
-        carry0 = (
+        carry0 = vary((
             zeros_act,                                   # fwd_send
             zeros_act,                                   # bwd_send (cot)
             jnp.zeros((S,) + mb_shape, x_micro.dtype),   # input bank ring
@@ -252,7 +277,7 @@ def pipeline_1f1b(stage_fn, last_loss_fn, blocks_p, post_p, x_micro,
             jax.tree.map(jnp.zeros_like, post_local),    # gpost
             jnp.zeros((), jnp.float32),                  # loss_acc
             jnp.zeros((M,) + mb_shape, x_micro.dtype),   # dx per micro
-        )
+        ))
         carry, _ = lax.scan(tick, carry0, tables)
         _, _, _, _, gacc, gpost, loss_acc, dx_acc = carry
         loss = lax.psum(loss_acc, axis) / M              # only last stage != 0
@@ -263,11 +288,19 @@ def pipeline_1f1b(stage_fn, last_loss_fn, blocks_p, post_p, x_micro,
 
     stacked = P(axis)
     rep = P()
+    # manual ONLY over the pp axis: other mesh axes (dp/mp) remain auto, so
+    # GSPMD shards the per-stage math over them (Megatron mp matmuls, dp
+    # batch) and inserts their collectives — the hybrid composition path
+    hybrid = len(mesh.axis_names) > 1
     f = jax.shard_map(
         body, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: stacked, blocks_p), rep, rep, rep),
         out_specs=(rep, jax.tree.map(lambda _: stacked, blocks_p), rep, rep),
-        check_vma=False)
+        axis_names=frozenset({axis}),
+        # partial-manual requires the vma machinery (jax's check_vma=False
+        # path assumes full-manual in _unmatch); pure-pp keeps the cheaper
+        # unchecked mode
+        check_vma=hybrid)
     return f(blocks_p, post_p, x_micro, labels_micro)
 
 
@@ -276,10 +309,13 @@ def pipeline_1f1b(stage_fn, last_loss_fn, blocks_p, post_p, x_micro,
 # --------------------------------------------------------------------------
 
 class OneF1BTrainStep:
-    """Compiled 1F1B training step over a pure-'pp' mesh (the memory-lean
-    alternative to pipeline.PipelineTrainStep's GPipe-as-scan; ref
-    section_worker.cc Run1F1B). Accepts any model decomposable via
-    pipeline.PipelineParts — not just GPT.
+    """Compiled 1F1B training step over a mesh with a 'pp' axis — pure-pp or
+    hybrid dp×mp×pp (the memory-lean alternative to
+    pipeline.PipelineTrainStep's GPipe-as-scan; ref section_worker.cc
+    Run1F1B + pipeline_optimizer.py:232 pipeline×DP composition). The
+    schedule is manual over 'pp' only; dp/mp axes are GSPMD-auto, with
+    Megatron mp specs taken from the parameters' sharding hints. Accepts
+    any model decomposable via pipeline.PipelineParts — not just GPT.
 
     Dropout inside pipelined blocks is not key-threaded here (the engine's
     stage replay is deterministic); train with dropout=0 in the trunk or use
@@ -288,7 +324,7 @@ class OneF1BTrainStep:
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, num_micro=8,
                  num_stages=None, donate=True, parts=None):
-        from .pipeline import (PipelineParts, resolve_parts,
+        from .pipeline import (PipelineParts, resolve_parts, _stacked_spec,
                                stack_block_params, unstack_block_params)
         from ..framework.tensor import Tensor as _T
         self.model = model
@@ -381,10 +417,23 @@ class OneF1BTrainStep:
             return loss, new_params, new_opt
 
         from jax.sharding import NamedSharding
-        stacked_sh = NamedSharding(self.mesh, P(mesh_mod.PP_AXIS))
         rep = NamedSharding(self.mesh, P())
-        param_sh = {n: (stacked_sh if n.startswith("blocks.") else rep)
-                    for n in self.params}
+        # Megatron mp hints from the parameters, composed with the pp stage
+        # dim for the stacked trunk (same spec helpers as the GPipe engine).
+        # pre/post (embedding + head) stay REPLICATED: a vocab-parallel
+        # embedding entering the partial-manual pp region trips an XLA SPMD
+        # partitioner CHECK (spmd_partitioner_util.cc:495); the trunk is
+        # where the Megatron specs matter.
+        hints = {n: getattr(p, "sharding", None)
+                 for n, p in self.blocks_layer.named_parameters()}
+        param_sh = {}
+        for n, a in self.params.items():
+            if n.startswith("blocks."):
+                spec = _stacked_spec(hints.get(n[len("blocks."):]),
+                                     self.mesh, a.shape, mesh_mod.PP_AXIS)
+                param_sh[n] = NamedSharding(self.mesh, spec)
+            else:
+                param_sh[n] = rep
         opt_sh = {n: {sn: param_sh[n] for sn in slots}
                   for n, slots in self.opt_state.items()}
         self.params = {n: jax.device_put(a, param_sh[n])
@@ -392,9 +441,15 @@ class OneF1BTrainStep:
         self.opt_state = {n: {sn: jax.device_put(a, param_sh[n])
                               for sn, a in slots.items()}
                           for n, slots in self.opt_state.items()}
+        # microbatched data [M, mb, ...]: shard the within-microbatch batch
+        # dim over dp when the mesh has one (GSPMD splits each stage's math)
+        dp = (mesh_mod.DP_AXIS
+              if mesh_mod.DP_AXIS in self.mesh.axis_names else None)
+        data_sh = NamedSharding(self.mesh, P(None, dp)) if dp else rep
         self._compiled = jax.jit(
             _step,
-            in_shardings=(param_sh, opt_sh, None, None, None, rep, rep),
+            in_shardings=(param_sh, opt_sh, None, None, None, data_sh,
+                          data_sh),
             out_shardings=(rep, param_sh, opt_sh),
             donate_argnums=(0, 1) if donate else ())
         self._unstack = unstack_block_params

@@ -17,10 +17,21 @@ true_fn/false_fn + modified-name analysis (ifelse_transformer.py
 NameVisitor), but without variable renaming because `nonlocal` gives
 read/write access to the enclosing frame.
 
+break/continue/return (ref break_continue_transformer.py,
+return_transformer.py): lowered to loop-carried booleans BEFORE control-flow
+conversion — `break` -> `__pt_brk_n = True` (loop test gains
+`not __pt_brk_n`), `continue` -> `__pt_cont_n = True` (trailing body
+statements guarded), `return v` -> `__pt_ret_flag/__pt_ret_val` sets with
+every enclosing loop test gaining `not __pt_ret_flag` and the function tail
+returning via _jst.finalize_return. The flags ride the normal lax carry, so
+all three work under jit tracing.
+
 Deliberate limits (same spirit as the reference's unsupported lists):
-- `if`/`while` bodies containing return/break/continue/yield are left as
-  python (they still work eagerly; under tracing they raise jax's
-  concretization error with a clear message);
+- `yield` blocks conversion (generators stay python);
+- a TRACED early return must produce values of one consistent
+  shape/dtype across all return sites (the reference's
+  RETURN_NO_VALUE magic has the same constraint); eager returns are
+  unrestricted;
 - `for i in range(...)` lowers through the while machinery (tensor
   bounds become lax.while_loop; concrete ranges still unroll); other
   iterables (lists, enumerate, tensor iteration) stay python;
@@ -161,13 +172,23 @@ def convert_ifelse(pred, true_fn, false_fn, get, reset):
 def convert_while(cond_fn, body_fn, get, reset):
     """Emitted for `while`: concrete → python loop; traced condition or
     loop vars → lax.while_loop over the dynamic subset of captured vars
-    (static vars are loop-invariant closure constants)."""
-    first = _unwrap(cond_fn())
-    orig = get() if get is not None else ()
-    if not _is_traced(first) and not _any_traced(orig):
-        while bool(_unwrap(cond_fn())):
-            body_fn()
-        return get() if get is not None else ()
+    (static vars are loop-invariant closure constants).
+
+    The python loop re-checks tracedness EVERY iteration and escapes to the
+    lax path mid-loop from the current state: a loop can start fully
+    concrete and only acquire a traced carry later (e.g. a return/break
+    flag set by a traced `if` — the break_continue/return transforms)."""
+    while True:
+        c = _unwrap(cond_fn())
+        cur = get() if get is not None else ()
+        if _is_traced(c) or _any_traced(cur):
+            return _lax_while(cond_fn, body_fn, get, reset, cur)
+        if not bool(c):
+            return cur
+        body_fn()
+
+
+def _lax_while(cond_fn, body_fn, get, reset, orig):
     dyn_idx = _split_dynamic(orig)
 
     def put(carry):
@@ -187,7 +208,12 @@ def convert_while(cond_fn, body_fn, get, reset):
         out = get()
         for i, v in enumerate(out):
             if i not in dyn_idx and _is_traced(_unwrap(v)) \
-                    and not _is_traced(_unwrap(orig[i])):
+                    and not _is_traced(_unwrap(orig[i])) \
+                    and not isinstance(orig[i], _Undef):
+                # a var that WAS undefined at loop entry is a loop-LOCAL
+                # (written fresh every iteration — nested-loop counters,
+                # break flags, if-cluster helpers); it needs no carry slot.
+                # Only a real pre-loop static turning traced is an error.
                 raise ValueError(
                     "dy2static: a variable becomes a tensor inside a traced "
                     "`while` body — initialize it as a tensor before the "
@@ -204,6 +230,8 @@ def convert_while(cond_fn, body_fn, get, reset):
     final = list(orig)
     for j, i in enumerate(dyn_idx):
         final[i] = Tensor(res[j]) if isinstance(orig[i], Tensor) else res[j]
+    # loop-locals (UNDEF at entry) stay UNDEF after the loop: their traced
+    # per-iteration values cannot escape the while_loop scope
     reset(tuple(final))
     return tuple(final)
 
@@ -246,30 +274,345 @@ def convert_logical_not(x):
     return Tensor(jnp.logical_not(u))
 
 
+def finalize_return(flag, val):
+    """Function tail after the return transform: a concrete never-set flag
+    means python fall-off-the-end semantics (None); a traced flag means at
+    least one traced return site executed — the carried val IS the result
+    (sites that didn't fire left the initial 0.0, matching the reference's
+    RETURN_NO_VALUE contract that all traced paths return)."""
+    u = _unwrap(flag)
+    if not _is_traced(u):
+        return val if bool(u) else None
+    return val
+
+
 # --------------------------------------------------------------------------- #
 # AST transformation                                                          #
 # --------------------------------------------------------------------------- #
 
+# statements that keep a block python when they SURVIVE the pre-passes
+# (the break/continue/return transformers remove the ones they can lower;
+# leftovers — yields, returns in unlowerable loops — must block conversion
+# or convert_ifelse would silently discard them)
 _BLOCKERS = (ast.Return, ast.Break, ast.Continue, ast.Yield, ast.YieldFrom)
 
 
-def _scan(nodes):
-    """True when return/break/continue/yield appears in `nodes` (stopping at
-    nested function boundaries) — such blocks stay python (see module doc)."""
+def _scan_for(kinds, nodes, stop_at_loops=False):
+    """True when a node of `kinds` appears in `nodes`, stopping at nested
+    function boundaries (and optionally at nested loops — break/continue
+    bind to the nearest loop)."""
     for n in nodes:
-        if isinstance(n, _BLOCKERS):
+        if isinstance(n, kinds):
             return True
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if stop_at_loops and isinstance(n, (ast.For, ast.While)):
             continue
         for field in getattr(n, "_fields", ()):
             v = getattr(n, field, None)
             if isinstance(v, list):
-                if _scan([x for x in v if isinstance(x, ast.AST)]):
+                if _scan_for(kinds, [x for x in v if isinstance(x, ast.AST)],
+                             stop_at_loops):
                     return True
             elif isinstance(v, ast.AST):
-                if _scan([v]):
+                if _scan_for(kinds, [v], stop_at_loops):
                     return True
     return False
+
+
+def _scan(nodes):
+    """True when a surviving blocker statement appears in `nodes` — such
+    blocks stay python (see _BLOCKERS)."""
+    return _scan_for(_BLOCKERS, nodes)
+
+
+def _sets_name(stmt, names):
+    """Does this statement subtree assign any of `names`? (Flag names are
+    generated uniques, so a plain Name-target search is exact.)"""
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id in names:
+                    return True
+    return False
+
+
+def _guard_tail(stmts, flags):
+    """ref break_continue_transformer.py BreakContinueTransformer: after any
+    statement that may set one of `flags`, wrap the remaining statements in
+    `if not (f1 or f2 ...):` — the lowered form of the skipped tail."""
+    names = set(flags)
+    out = []
+    for idx, s in enumerate(stmts):
+        out.append(s)
+        if _sets_name(s, names) and idx < len(stmts) - 1:
+            rest = _guard_tail(stmts[idx + 1:], flags)
+            test_src = " or ".join(flags)
+            guard = ast.parse(f"if not ({test_src}):\n    pass").body[0]
+            guard.body = rest
+            out.append(guard)
+            return out
+    return out
+
+
+def _apply_guards_in_lists(node, flags, *, into_loops):
+    """Run _guard_tail over every statement list under `node` (not crossing
+    nested function boundaries; optionally not crossing loop boundaries)."""
+    for field in getattr(node, "_fields", ()):
+        v = getattr(node, field, None)
+        if isinstance(v, list) and v and all(isinstance(x, ast.stmt)
+                                             for x in v):
+            for x in v:
+                if isinstance(x, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                if not into_loops and isinstance(x, (ast.For, ast.While)):
+                    continue
+                _apply_guards_in_lists(x, flags, into_loops=into_loops)
+            setattr(node, field, _guard_tail(v, flags))
+        elif isinstance(v, ast.AST):
+            if isinstance(v, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if not into_loops and isinstance(v, (ast.For, ast.While)):
+                continue
+            _apply_guards_in_lists(v, flags, into_loops=into_loops)
+
+
+def _not_flag_test(test, flag):
+    """`test` -> `(not flag) and (test)` as AST."""
+    return ast.BoolOp(op=ast.And(), values=[
+        ast.UnaryOp(op=ast.Not(),
+                    operand=ast.Name(id=flag, ctx=ast.Load())),
+        test])
+
+
+def _loop_convertible(node):
+    """Syntactic lowering eligibility (mirrors _ControlFlowTransformer's
+    For/While acceptance). NOT sufficient on its own — see
+    _loop_will_lower."""
+    if isinstance(node, ast.While):
+        return not node.orelse
+    return (not node.orelse
+            and isinstance(node.target, ast.Name)
+            and isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and not node.iter.keywords
+            and 1 <= len(node.iter.args) <= 3)
+
+
+def _direct_nested_loops(nodes):
+    """Outermost For/While nodes under `nodes`, not crossing function
+    boundaries and not descending into found loops."""
+    out = []
+    for n in nodes:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, (ast.For, ast.While)):
+            out.append(n)
+            continue
+        for field in getattr(n, "_fields", ()):
+            v = getattr(n, field, None)
+            kids = v if isinstance(v, list) else [v]
+            out += _direct_nested_loops(
+                [x for x in kids if isinstance(x, ast.AST)])
+    return out
+
+
+def _loop_will_lower(node):
+    """Will this loop ACTUALLY be lowered once the pre-passes run? Lowered
+    loops are the only legal flag consumers (their tests gain `not flag`
+    terms); a loop the control-flow transformer ends up leaving as python
+    (because a blocker survives inside it) must keep its literal
+    break/continue/return. A loop lowers iff it is syntactically
+    convertible, contains no yield, and every nested loop holding flow
+    statements will itself lower (those are the only blockers the
+    pre-passes cannot remove)."""
+    if not _loop_convertible(node):
+        return False
+    if _scan_for((ast.Yield, ast.YieldFrom), node.body):
+        return False
+    for nl in _direct_nested_loops(node.body):
+        if _scan_for((ast.Break, ast.Continue, ast.Return), [nl]) \
+                and not _loop_will_lower(nl):
+            return False
+    return True
+
+
+class _BreakContinueReplacer(ast.NodeTransformer):
+    """Replace break/continue bound to THE CURRENT loop with flag sets
+    (does not descend into nested loops or functions)."""
+
+    def __init__(self, brk, cont):
+        self.brk, self.cont = brk, cont
+        self.saw_brk = self.saw_cont = False
+
+    def visit_Break(self, node):
+        self.saw_brk = True
+        return ast.parse(f"{self.brk} = True").body[0]
+
+    def visit_Continue(self, node):
+        self.saw_cont = True
+        return ast.parse(f"{self.cont} = True").body[0]
+
+    def visit_For(self, node):
+        return node
+
+    def visit_While(self, node):
+        return node
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+
+class _BreakContinueTransformer(ast.NodeTransformer):
+    """ref dygraph_to_static/break_continue_transformer.py, lowered for the
+    lax world: break/continue become loop-carried booleans. Bottom-up, so
+    inner loops are clean before the enclosing loop is processed."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def _rewrite(self, node):
+        """Shared For/While body rewrite. Returns (prelude_stmts, node) —
+        prelude initialises the break flag BEFORE the loop."""
+        self.generic_visit(node)      # inner loops first
+        if not _loop_will_lower(node):
+            # stays a python loop: literal break/continue keep working;
+            # flag-lowering would break them (no test hook to exit)
+            return [], node
+        if not _scan_for((ast.Break, ast.Continue), node.body,
+                         stop_at_loops=True):
+            return [], node
+        n = self.counter
+        self.counter += 1
+        brk, cont = f"__pt_brk_{n}", f"__pt_cont_{n}"
+        rep = _BreakContinueReplacer(brk, cont)
+        node.body = [rep.visit(s) for s in node.body]
+        flags = [f for f, saw in ((brk, rep.saw_brk), (cont, rep.saw_cont))
+                 if saw]
+        _apply_guards_in_lists(node, flags, into_loops=False)
+        prelude = []
+        if rep.saw_cont:
+            node.body = ast.parse(f"{cont} = False").body + node.body
+            prelude += ast.parse(f"{cont} = False").body
+        if rep.saw_brk:
+            prelude += ast.parse(f"{brk} = False").body
+            if isinstance(node, ast.While):
+                node.test = _not_flag_test(node.test, brk)
+            else:   # For: the for->while lowering reads this marker
+                node._pt_extra_break_flags = (
+                    getattr(node, "_pt_extra_break_flags", []) + [brk])
+        return prelude, node
+
+    def visit_While(self, node):
+        prelude, node = self._rewrite(node)
+        return prelude + [node] if prelude else node
+
+    def visit_For(self, node):
+        prelude, node = self._rewrite(node)
+        return prelude + [node] if prelude else node
+
+
+class _ReturnTransformer(ast.NodeTransformer):
+    """ref dygraph_to_static/return_transformer.py: every `return v` becomes
+    `__pt_ret_flag = True; __pt_ret_val = v`; trailing statements are
+    guarded on the flag; every loop on the path gains `not __pt_ret_flag`
+    in its test; the function tail returns _jst.finalize_return(...)."""
+
+    FLAG, VAL = "__pt_ret_flag", "__pt_ret_val"
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_Return(self, node):
+        # val BEFORE flag: the guard pass wraps everything after a
+        # flag-setting statement, and the companion val assignment must
+        # stay unguarded
+        val_src = ast.unparse(node.value) if node.value is not None else "None"
+        return ast.parse(f"{self.VAL} = ({val_src})\n"
+                         f"{self.FLAG} = True").body
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _sets_name(node, {self.FLAG}):
+            node.test = _not_flag_test(node.test, self.FLAG)
+        return node
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if _sets_name(node, {self.FLAG}):
+            node._pt_extra_break_flags = (
+                getattr(node, "_pt_extra_break_flags", []) + [self.FLAG])
+        return node
+
+    @classmethod
+    def apply(cls, fn_node):
+        """Transform iff a return appears INSIDE a compound statement — any
+        container (if/while/for/try/with), not just direct top-level control
+        flow (a plain top-level `return` needs nothing). Returns True when
+        applied. Bails (returns False, leaving returns literal) when a
+        return sits inside a loop that will NOT be lowered: such loops stay
+        python and must keep their real `return`."""
+        nested = any(not isinstance(s, ast.Return)
+                     and _scan_for((ast.Return,), [s])
+                     for s in fn_node.body)
+        if not nested:
+            return False
+
+        def _unlowerable_return(nodes):
+            # walk without crossing nested-function boundaries
+            for n in nodes:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                if isinstance(n, (ast.For, ast.While)) \
+                        and not _loop_will_lower(n) \
+                        and _scan_for((ast.Return,), n.body):
+                    return True
+                for field in getattr(n, "_fields", ()):
+                    v = getattr(n, field, None)
+                    kids = (v if isinstance(v, list) else [v])
+                    kids = [x for x in kids if isinstance(x, ast.AST)]
+                    if kids and _unlowerable_return(kids):
+                        return True
+            return False
+
+        if _unlowerable_return(fn_node.body):
+            return False
+        tr = cls()
+        new_body = []
+        for s in fn_node.body:
+            out = tr.visit(s)
+            new_body.extend(out if isinstance(out, list) else [out])
+        # guard every trailing statement list on the flag, at every depth
+        holder = ast.Module(body=new_body, type_ignores=[])
+        _apply_guards_in_lists(holder, [cls.FLAG], into_loops=True)
+        fn_node.body = (
+            ast.parse(f"{cls.FLAG} = False\n{cls.VAL} = 0.0").body
+            + holder.body
+            + ast.parse(
+                f"return _jst.finalize_return({cls.FLAG}, {cls.VAL})").body)
+        return True
 
 
 class _NameCollector(ast.NodeVisitor):
@@ -437,9 +780,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             f"__pt_stop_{n} = {stop}\n"
             f"__pt_step_{n} = _jst.check_step({step})").body
         # (stop - i) * step > 0 is direction-agnostic (positive or
-        # negative traced step)
+        # negative traced step); break/return flags attached by the
+        # pre-passes join the test here
+        extra = "".join(
+            f" and not {f}"
+            for f in getattr(node, "_pt_extra_break_flags", ()))
         while_src = (
-            f"while (__pt_stop_{n} - __pt_i_{n}) * __pt_step_{n} > 0:\n"
+            f"while (__pt_stop_{n} - __pt_i_{n}) * __pt_step_{n} > 0"
+            f"{extra}:\n"
             f"    pass")
         while_node = ast.parse(while_src).body[0]
         while_node.body = (
@@ -507,6 +855,15 @@ def convert_function(fn):
     if not has_cf:
         _CACHE[key] = fn
         return fn
+    # pre-passes: return -> flag/val, break/continue -> loop-carried booleans
+    # (ref return_transformer.py / break_continue_transformer.py)
+    _ReturnTransformer.apply(fn_node)
+    bc = _BreakContinueTransformer()
+    bc_body = []
+    for s in fn_node.body:
+        out = bc.visit(s)
+        bc_body.extend(out if isinstance(out, list) else [out])
+    fn_node.body = bc_body
     tr = _ControlFlowTransformer()
     new_body = []
     for s in fn_node.body:
@@ -550,5 +907,6 @@ _JST = _JSTNamespace(
     convert_logical_and=convert_logical_and,
     convert_logical_or=convert_logical_or,
     convert_logical_not=convert_logical_not,
+    finalize_return=finalize_return,
     UNDEF=UNDEF,
 )

@@ -50,6 +50,17 @@ class LlamaConfig:
                              f"num_kv_heads {self.num_kv_heads}")
 
 
+def _rms_norm_raw(x_, w, eps=1e-6):
+    xf = x_.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * w.astype(jnp.float32)).astype(x_.dtype)
+
+
+from ..ops.dispatch import register_op as _register_op  # noqa: E402
+_register_op("rms_norm", _rms_norm_raw)
+
+
 class RMSNorm(nn.Layer):
     """Root-mean-square norm (no mean subtraction, no bias): stats in f32."""
 
@@ -61,14 +72,8 @@ class RMSNorm(nn.Layer):
 
     def forward(self, x):
         from ..ops.dispatch import apply
-
-        def f(x_, w):
-            xf = x_.astype(jnp.float32)
-            var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-            y = xf * jax.lax.rsqrt(var + self.eps)
-            return (y * w.astype(jnp.float32)).astype(x_.dtype)
-
-        return apply(f, (x, self.weight), name="rms_norm")
+        return apply(_rms_norm_raw, (x, self.weight),
+                     {"eps": float(self.eps)}, name="rms_norm")
 
 
 @functools.lru_cache(maxsize=8)

@@ -6,6 +6,7 @@ softmax_with_cross_entropy_op, dropout_op, lookup_table_v2_op).
 Convs ride lax.conv_general_dilated (MXU path); XLA picks TPU-optimal layouts so
 both NCHW (paddle default) and NHWC are accepted.
 """
+import functools
 import math
 import numbers
 
@@ -67,63 +68,115 @@ def leaky_relu(x, negative_slope=0.01, name=None):
                  {"negative_slope": float(negative_slope)}, name="leaky_relu")
 
 
+def _elu_raw(a, alpha=1.0):
+    return jax.nn.elu(a, alpha)
+
+
+def _celu_raw(a, alpha=1.0):
+    return jax.nn.celu(a, alpha)
+
+
+def _selu_raw(a, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(a > 0, a, alpha * jnp.expm1(a))
+
+
+def _prelu_raw(a, w, data_format="NCHW"):
+    if w.size == 1:
+        return jnp.where(a > 0, a, w.reshape(()) * a)
+    ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+    shape = [1] * a.ndim
+    shape[ch_axis] = w.size
+    return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+
+def _hardtanh_raw(a, lo=-1.0, hi=1.0):
+    return jnp.clip(a, lo, hi)
+
+
+def _hardshrink_raw(a, threshold=0.5):
+    return jnp.where(jnp.abs(a) > threshold, a, 0.0)
+
+
+def _softshrink_raw(a, threshold=0.5):
+    return jnp.where(a > threshold, a - threshold,
+                     jnp.where(a < -threshold, a + threshold, 0.0))
+
+
+def _softplus_raw(a, beta=1.0, threshold=20.0):
+    return jnp.where(a * beta > threshold, a,
+                     jax.nn.softplus(a * beta) / beta)
+
+
+def _softsign_raw(a):
+    return a / (1 + jnp.abs(a))
+
+
+def _maxout_raw(a, groups=1, axis=1):
+    c = a.shape[axis]
+    new_shape = list(a.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(a.reshape(new_shape), axis=axis + 1)
+
+
+register_op("elu", _elu_raw)
+register_op("celu", _celu_raw)
+register_op("selu", _selu_raw)
+register_op("prelu", _prelu_raw)
+register_op("hardtanh", _hardtanh_raw)
+register_op("hardshrink", _hardshrink_raw)
+register_op("softshrink", _softshrink_raw)
+register_op("softplus", _softplus_raw)
+register_op("softsign", _softsign_raw)
+register_op("maxout", _maxout_raw)
+
+
 def elu(x, alpha=1.0, name=None):
-    return apply(lambda a: jax.nn.elu(a, alpha), (x,), name="elu")
+    return apply(_elu_raw, (x,), {"alpha": float(alpha)}, name="elu")
 
 
 def celu(x, alpha=1.0, name=None):
-    return apply(lambda a: jax.nn.celu(a, alpha), (x,), name="celu")
+    return apply(_celu_raw, (x,), {"alpha": float(alpha)}, name="celu")
 
 
 def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
-    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
-                 (x,), name="selu")
+    return apply(_selu_raw, (x,),
+                 {"scale": float(scale), "alpha": float(alpha)}, name="selu")
 
 
 def prelu(x, weight, data_format="NCHW", name=None):
-    def f(a, w):
-        if w.size == 1:
-            return jnp.where(a > 0, a, w.reshape(()) * a)
-        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
-        shape = [1] * a.ndim
-        shape[ch_axis] = w.size
-        return jnp.where(a > 0, a, w.reshape(shape) * a)
-    return apply(f, (x, weight), name="prelu")
+    return apply(_prelu_raw, (x, weight), {"data_format": str(data_format)},
+                 name="prelu")
 
 
 def hardtanh(x, min=-1.0, max=1.0, name=None):
-    return apply(lambda a: jnp.clip(a, min, max), (x,), name="hardtanh")
+    return apply(_hardtanh_raw, (x,), {"lo": float(min), "hi": float(max)},
+                 name="hardtanh")
 
 
 def hardshrink(x, threshold=0.5, name=None):
-    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), (x,),
+    return apply(_hardshrink_raw, (x,), {"threshold": float(threshold)},
                  name="hardshrink")
 
 
 def softshrink(x, threshold=0.5, name=None):
-    return apply(lambda a: jnp.where(a > threshold, a - threshold,
-                                     jnp.where(a < -threshold, a + threshold, 0.0)),
-                 (x,), name="softshrink")
+    return apply(_softshrink_raw, (x,), {"threshold": float(threshold)},
+                 name="softshrink")
 
 
 def softplus(x, beta=1.0, threshold=20.0, name=None):
-    return apply(lambda a: jnp.where(a * beta > threshold, a,
-                                     jax.nn.softplus(a * beta) / beta),
-                 (x,), name="softplus")
+    return apply(_softplus_raw, (x,),
+                 {"beta": float(beta), "threshold": float(threshold)},
+                 name="softplus")
 
 
 def softsign(x, name=None):
-    return apply(lambda a: a / (1 + jnp.abs(a)), (x,), name="softsign")
+    return apply(_softsign_raw, (x,), name="softsign")
 
 
 def maxout(x, groups, axis=1, name=None):
-    def f(a):
-        c = a.shape[axis]
-        new_shape = list(a.shape)
-        new_shape[axis] = c // groups
-        new_shape.insert(axis + 1, groups)
-        return jnp.max(a.reshape(new_shape), axis=axis + 1)
-    return apply(f, (x,), name="maxout")
+    return apply(_maxout_raw, (x,),
+                 {"groups": int(groups), "axis": int(axis)}, name="maxout")
 
 
 def _softmax_raw(a, axis=-1, to_dtype=None):
@@ -156,21 +209,28 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
                   str(np.dtype(convert_dtype(dtype)))}, name="log_softmax")
 
 
-def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+def _gumbel_softmax_raw(a, key, temperature=1.0, hard=False, axis=-1):
     g = -jnp.log(-jnp.log(
-        jax.random.uniform(state.next_rng_key(), tuple(as_array(x).shape)) + 1e-20))
+        jax.random.uniform(key, tuple(a.shape)) + 1e-20))
+    y = jax.nn.softmax((a + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis) \
+            if hasattr(jnp, "put_along_axis") else \
+            jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis)
+        y = onehot + y - lax.stop_gradient(y)
+    return y
 
-    def f(a):
-        y = jax.nn.softmax((a + g) / temperature, axis=axis)
-        if hard:
-            idx = jnp.argmax(y, axis=axis, keepdims=True)
-            onehot = jnp.zeros_like(y)
-            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis) \
-                if hasattr(jnp, "put_along_axis") else \
-                jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis)
-            y = onehot + y - lax.stop_gradient(y)
-        return y
-    return apply(f, (x,), name="gumbel_softmax")
+
+register_op("gumbel_softmax", _gumbel_softmax_raw)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    # rng op: key is input #1 + "__rng__" salt, same replay contract as dropout
+    return apply(_gumbel_softmax_raw, (x, Tensor(state.next_rng_key())),
+                 {"temperature": float(temperature), "hard": bool(hard),
+                  "axis": int(axis), "__rng__": True}, name="gumbel_softmax")
 
 
 # ----------------------------------------------------------------- linear / emb
@@ -250,8 +310,9 @@ def _sparse_embedding_eager(x, weight, padding_idx):
 
 
 def one_hot(x, num_classes, name=None):
-    return apply(lambda i: jax.nn.one_hot(i, num_classes, dtype=jnp.float32),
-                 (x,), differentiable=False, name="one_hot")
+    from ..ops.manipulation import _one_hot_raw
+    return apply(_one_hot_raw, (x,), {"num_classes": int(num_classes)},
+                 differentiable=False, name="one_hot")
 
 
 # ----------------------------------------------------------------- dropout
@@ -307,21 +368,25 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p=p, axis=axis, training=training)
 
 
-def alpha_dropout(x, p=0.5, training=True, name=None):
-    if not training or p == 0.0:
-        return x
+def _alpha_dropout_raw(v, key, p=0.5):
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
-    a_ = as_array(x)
-    keep = jax.random.bernoulli(state.next_rng_key(), 1.0 - p, tuple(a_.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(v.shape))
     q = 1.0 - p
     coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
     coef_b = -coef_a * alpha_p * p
+    return coef_a * jnp.where(keep, v, alpha_p) + coef_b
 
-    def f(v):
-        return coef_a * jnp.where(keep, v, alpha_p) + coef_b
-    return apply(f, (x,), name="alpha_dropout")
+
+register_op("alpha_dropout", _alpha_dropout_raw)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    return apply(_alpha_dropout_raw, (x, Tensor(state.next_rng_key())),
+                 {"p": float(p), "__rng__": True}, name="alpha_dropout")
 
 
 # ----------------------------------------------------------------- conv / pool
@@ -351,227 +416,279 @@ def _conv_padding(padding, n, strides, dilations, ksize):
     raise ValueError(f"bad padding {padding}")
 
 
+def _convnd_raw(a, w, *maybe_b, n=2, stride=1, padding=0, dilation=1,
+                groups=1, channels_last=False):
+    """Shared N-d conv impl (ref conv_op.cc): weight [out_c, in_c/g, *k]."""
+    strides = _norm_tuple(stride, n)
+    dilations = _norm_tuple(dilation, n)
+    spatial = "DHW"[3 - n:]
+    if channels_last:
+        dn_str = ("N" + spatial + "C", "OI" + spatial, "N" + spatial + "C")
+    else:
+        dn_str = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
+    dn = lax.conv_dimension_numbers(a.shape, w.shape, dn_str)
+    out = lax.conv_general_dilated(
+        a, w, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups)
+    if maybe_b:
+        shape = ((1,) + (1,) * n + (-1,) if channels_last
+                 else (1, -1) + (1,) * n)
+        out = out + maybe_b[0].reshape(shape)
+    return out
+
+
+def _conv1d_raw(a, w, *maybe_b, stride=1, padding=0, dilation=1, groups=1,
+                channels_last=False):
+    return _convnd_raw(a, w, *maybe_b, n=1, stride=stride, padding=padding,
+                       dilation=dilation, groups=groups,
+                       channels_last=channels_last)
+
+
+def _conv2d_raw(a, w, *maybe_b, stride=1, padding=0, dilation=1, groups=1,
+                channels_last=False):
+    return _convnd_raw(a, w, *maybe_b, n=2, stride=stride, padding=padding,
+                       dilation=dilation, groups=groups,
+                       channels_last=channels_last)
+
+
+def _conv3d_raw(a, w, *maybe_b, stride=1, padding=0, dilation=1, groups=1,
+                channels_last=False):
+    return _convnd_raw(a, w, *maybe_b, n=3, stride=stride, padding=padding,
+                       dilation=dilation, groups=groups,
+                       channels_last=channels_last)
+
+
+register_op("conv1d", _conv1d_raw)
+register_op("conv2d", _conv2d_raw)
+register_op("conv3d", _conv3d_raw)
+
+
+def _pad_attr(padding):
+    if isinstance(padding, str):
+        return padding
+    if isinstance(padding, numbers.Number):
+        return int(padding)
+    return [list(int(i) for i in p) if isinstance(p, (list, tuple))
+            else int(p) for p in padding]
+
+
+def _stride_attr(v):
+    if isinstance(v, numbers.Number):
+        return int(v)
+    return [int(i) for i in v]
+
+
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW", name=None):
     """weight layout: [out_c, in_c/groups, kh, kw] (paddle/ref conv_op.cc)."""
-    n = 2
-    strides = _norm_tuple(stride, n)
-    dilations = _norm_tuple(dilation, n)
-    dn_str = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" \
-        else ("NHWC", "OIHW", "NHWC")
-
-    def f(a, w, *maybe_b):
-        pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
-        dn = lax.conv_dimension_numbers(a.shape, w.shape, dn_str)
-        out = lax.conv_general_dilated(
-            a, w, window_strides=strides, padding=pad,
-            rhs_dilation=dilations, dimension_numbers=dn,
-            feature_group_count=groups)
-        if maybe_b:
-            b = maybe_b[0]
-            if data_format == "NCHW":
-                out = out + b.reshape(1, -1, 1, 1)
-            else:
-                out = out + b.reshape(1, 1, 1, -1)
-        return out
-
     args = (x, weight) if bias is None else (x, weight, bias)
-    return apply(f, args, name="conv2d")
+    return apply(_conv2d_raw, args,
+                 {"stride": _stride_attr(stride), "padding": _pad_attr(padding),
+                  "dilation": _stride_attr(dilation), "groups": int(groups),
+                  "channels_last": data_format != "NCHW"}, name="conv2d")
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCL", name=None):
-    n = 1
-    strides = _norm_tuple(stride, n)
-    dilations = _norm_tuple(dilation, n)
-    dn_str = ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "OIH", "NHC")
-
-    def f(a, w, *maybe_b):
-        pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
-        dn = lax.conv_dimension_numbers(a.shape, w.shape, dn_str)
-        out = lax.conv_general_dilated(
-            a, w, window_strides=strides, padding=pad,
-            rhs_dilation=dilations, dimension_numbers=dn,
-            feature_group_count=groups)
-        if maybe_b:
-            shape = (1, -1, 1) if data_format == "NCL" else (1, 1, -1)
-            out = out + maybe_b[0].reshape(shape)
-        return out
-
     args = (x, weight) if bias is None else (x, weight, bias)
-    return apply(f, args, name="conv1d")
+    return apply(_conv1d_raw, args,
+                 {"stride": _stride_attr(stride), "padding": _pad_attr(padding),
+                  "dilation": _stride_attr(dilation), "groups": int(groups),
+                  "channels_last": data_format != "NCL"}, name="conv1d")
 
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW", name=None):
-    n = 3
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(_conv3d_raw, args,
+                 {"stride": _stride_attr(stride), "padding": _pad_attr(padding),
+                  "dilation": _stride_attr(dilation), "groups": int(groups),
+                  "channels_last": data_format != "NCDHW"}, name="conv3d")
+
+
+def _conv2d_transpose_raw(a, w, *maybe_b, stride=1, padding=0,
+                          output_padding=0, dilation=1, groups=1,
+                          channels_last=False):
+    """weight layout: [in_c, out_c/groups, kh, kw] (ref conv_transpose_op.cc)."""
+    n = 2
     strides = _norm_tuple(stride, n)
     dilations = _norm_tuple(dilation, n)
-    dn_str = ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" \
-        else ("NDHWC", "OIDHW", "NDHWC")
-
-    def f(a, w, *maybe_b):
-        pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
-        dn = lax.conv_dimension_numbers(a.shape, w.shape, dn_str)
+    out_pad = _norm_tuple(output_padding, n)
+    if channels_last:
+        a_nchw = jnp.transpose(a, (0, 3, 1, 2))
+    else:
+        a_nchw = a
+    pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
+    if isinstance(pad, str):
+        pad_list = [(0, 0)] * n if pad == "VALID" else None
+        if pad_list is None:
+            raise ValueError("SAME padding unsupported for conv_transpose")
+        pad = pad_list
+    kh = [((w.shape[2 + i] - 1) * dilations[i] + 1) for i in range(n)]
+    trans_pad = [
+        (kh[i] - 1 - pad[i][0], kh[i] - 1 - pad[i][1] + out_pad[i])
+        for i in range(n)]
+    # grouped transpose conv: weight [in_c, out_c/g, kh, kw]
+    w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+    if groups == 1:
+        w_t = jnp.transpose(w_flip, (1, 0, 2, 3))  # -> [out_c, in_c, kh, kw]
+        dn = lax.conv_dimension_numbers(a_nchw.shape, w_t.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
         out = lax.conv_general_dilated(
-            a, w, window_strides=strides, padding=pad,
-            rhs_dilation=dilations, dimension_numbers=dn,
-            feature_group_count=groups)
-        if maybe_b:
-            shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" else (1, 1, 1, 1, -1)
-            out = out + maybe_b[0].reshape(shape)
-        return out
+            a_nchw, w_t, window_strides=(1, 1), padding=trans_pad,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn)
+    else:
+        ic = a_nchw.shape[1]
+        icg = ic // groups
+        outs = []
+        for g in range(groups):
+            wg = w_flip[g * icg:(g + 1) * icg]
+            wg_t = jnp.transpose(wg, (1, 0, 2, 3))
+            dn = lax.conv_dimension_numbers(
+                (a_nchw.shape[0], icg) + a_nchw.shape[2:], wg_t.shape,
+                ("NCHW", "OIHW", "NCHW"))
+            outs.append(lax.conv_general_dilated(
+                a_nchw[:, g * icg:(g + 1) * icg], wg_t, window_strides=(1, 1),
+                padding=trans_pad, lhs_dilation=strides,
+                rhs_dilation=dilations, dimension_numbers=dn))
+        out = jnp.concatenate(outs, axis=1)
+    if maybe_b:
+        out = out + maybe_b[0].reshape(1, -1, 1, 1)
+    if channels_last:
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
 
-    args = (x, weight) if bias is None else (x, weight, bias)
-    return apply(f, args, name="conv3d")
+
+register_op("conv2d_transpose", _conv2d_transpose_raw)
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1, output_size=None,
                      data_format="NCHW", name=None):
     """weight layout: [in_c, out_c/groups, kh, kw] (ref conv_transpose_op.cc)."""
-    n = 2
-    strides = _norm_tuple(stride, n)
-    dilations = _norm_tuple(dilation, n)
-    out_pad = _norm_tuple(output_padding, n)
-
-    def f(a, w, *maybe_b):
-        if data_format == "NHWC":
-            a_nchw = jnp.transpose(a, (0, 3, 1, 2))
-        else:
-            a_nchw = a
-        pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
-        if isinstance(pad, str):
-            pad_list = [(0, 0)] * n if pad == "VALID" else None
-            if pad_list is None:
-                raise ValueError("SAME padding unsupported for conv_transpose")
-            pad = pad_list
-        kh = [((w.shape[2 + i] - 1) * dilations[i] + 1) for i in range(n)]
-        trans_pad = [
-            (kh[i] - 1 - pad[i][0], kh[i] - 1 - pad[i][1] + out_pad[i])
-            for i in range(n)]
-        # grouped transpose conv: weight [in_c, out_c/g, kh, kw]
-        w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
-        if groups == 1:
-            w_t = jnp.transpose(w_flip, (1, 0, 2, 3))  # -> [out_c, in_c, kh, kw]
-            dn = lax.conv_dimension_numbers(a_nchw.shape, w_t.shape,
-                                            ("NCHW", "OIHW", "NCHW"))
-            out = lax.conv_general_dilated(
-                a_nchw, w_t, window_strides=(1, 1), padding=trans_pad,
-                lhs_dilation=strides, rhs_dilation=dilations,
-                dimension_numbers=dn)
-        else:
-            ic = a_nchw.shape[1]
-            icg = ic // groups
-            outs = []
-            for g in range(groups):
-                wg = w_flip[g * icg:(g + 1) * icg]
-                wg_t = jnp.transpose(wg, (1, 0, 2, 3))
-                dn = lax.conv_dimension_numbers(
-                    (a_nchw.shape[0], icg) + a_nchw.shape[2:], wg_t.shape,
-                    ("NCHW", "OIHW", "NCHW"))
-                outs.append(lax.conv_general_dilated(
-                    a_nchw[:, g * icg:(g + 1) * icg], wg_t, window_strides=(1, 1),
-                    padding=trans_pad, lhs_dilation=strides,
-                    rhs_dilation=dilations, dimension_numbers=dn))
-            out = jnp.concatenate(outs, axis=1)
-        if maybe_b:
-            out = out + maybe_b[0].reshape(1, -1, 1, 1)
-        if data_format == "NHWC":
-            out = jnp.transpose(out, (0, 2, 3, 1))
-        return out
-
     args = (x, weight) if bias is None else (x, weight, bias)
-    return apply(f, args, name="conv2d_transpose")
+    return apply(_conv2d_transpose_raw, args,
+                 {"stride": _stride_attr(stride), "padding": _pad_attr(padding),
+                  "output_padding": _stride_attr(output_padding),
+                  "dilation": _stride_attr(dilation), "groups": int(groups),
+                  "channels_last": data_format != "NCHW"},
+                 name="conv2d_transpose")
 
 
-def _pool(x, ksize, strides, padding, data_format, reducer, init, name,
-          ceil_mode=False, count_include_pad=True, average=False):
+def _pool2d_raw(a, ksize=1, strides=None, padding=0, channels_last=False,
+                average=False, count_include_pad=True):
     n = 2
     ksize = _norm_tuple(ksize, n)
     strides = _norm_tuple(strides or ksize, n)
-
-    def f(a):
-        if data_format == "NCHW":
-            dims = (1, 1) + ksize
-            strd = (1, 1) + strides
+    if not channels_last:
+        dims = (1, 1) + ksize
+        strd = (1, 1) + strides
+    else:
+        dims = (1,) + ksize + (1,)
+        strd = (1,) + strides + (1,)
+    pad = _conv_padding(padding, n, strides, (1, 1), ksize)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        if not channels_last:
+            pad_cfg = [(0, 0), (0, 0)] + list(pad)
         else:
-            dims = (1,) + ksize + (1,)
-            strd = (1,) + strides + (1,)
-        pad = _conv_padding(padding, n, strides, (1, 1), ksize)
-        if isinstance(pad, str):
-            pad_cfg = pad
+            pad_cfg = [(0, 0)] + list(pad) + [(0, 0)]
+    if average:
+        reducer, init = lax.add, 0.0
+    else:
+        reducer = lax.max
+        init = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                else jnp.iinfo(a.dtype).min)
+    out = lax.reduce_window(a, init, reducer, dims, strd, pad_cfg)
+    if average:
+        if count_include_pad or (isinstance(pad, str) and pad == "VALID"):
+            out = out / np.prod(ksize)
         else:
-            if data_format == "NCHW":
-                pad_cfg = [(0, 0), (0, 0)] + list(pad)
-            else:
-                pad_cfg = [(0, 0)] + list(pad) + [(0, 0)]
-        out = lax.reduce_window(a, init(a.dtype), reducer, dims, strd, pad_cfg)
-        if average:
-            if count_include_pad or (isinstance(pad, str) and pad == "VALID"):
-                denom = np.prod(ksize)
-                out = out / denom
-            else:
-                onesw = lax.reduce_window(jnp.ones_like(a), 0.0, lax.add, dims,
-                                          strd, pad_cfg)
-                out = out / onesw
-        return out
+            onesw = lax.reduce_window(jnp.ones_like(a), 0.0, lax.add, dims,
+                                      strd, pad_cfg)
+            out = out / onesw
+    return out
 
-    return apply(f, (x,), name=name)
+
+register_op("max_pool2d", functools.partial(_pool2d_raw, average=False))
+register_op("avg_pool2d", functools.partial(_pool2d_raw, average=True))
+
+
+def _pool(x, ksize, strides, padding, data_format, name,
+          ceil_mode=False, count_include_pad=True, average=False):
+    from ..ops.dispatch import OP_REGISTRY
+    attrs = {"ksize": _stride_attr(ksize),
+             "strides": None if strides is None else _stride_attr(strides),
+             "padding": _pad_attr(padding),
+             "channels_last": data_format != "NCHW"}
+    if average:
+        attrs["count_include_pad"] = bool(count_include_pad)
+    return apply(OP_REGISTRY[name], (x,), attrs, name=name)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    return _pool(x, kernel_size, stride, padding, data_format, lax.max,
-                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating)
-                 else jnp.iinfo(dt).min,
+    return _pool(x, kernel_size, stride, padding, data_format,
                  "max_pool2d", ceil_mode=ceil_mode)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                count_include_pad=True, divisor_override=None,
                data_format="NCHW", name=None):
-    return _pool(x, kernel_size, stride, padding, data_format, lax.add,
-                 lambda dt: jnp.zeros([], dt).item() if False else 0.0,
+    return _pool(x, kernel_size, stride, padding, data_format,
                  "avg_pool2d", ceil_mode=ceil_mode,
                  count_include_pad=count_include_pad, average=True)
 
 
-def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+def _adaptive_avg_pool2d_raw(a, output_size=1, channels_last=False):
     out_hw = _norm_tuple(output_size, 2)
+    if not channels_last:
+        h_axis, w_axis = 2, 3
+    else:
+        h_axis, w_axis = 1, 2
+    ih, iw = a.shape[h_axis], a.shape[w_axis]
+    oh, ow = out_hw
+    if ih % oh == 0 and iw % ow == 0:
+        # reshape-mean fast path
+        if not channels_last:
+            r = a.reshape(a.shape[0], a.shape[1], oh, ih // oh, ow, iw // ow)
+            return r.mean(axis=(3, 5))
+        r = a.reshape(a.shape[0], oh, ih // oh, ow, iw // ow, a.shape[-1])
+        return r.mean(axis=(2, 4))
+    # general: per-output-bin mean via cumsum trick is overkill; use resize
+    raise NotImplementedError(
+        "adaptive pooling with non-divisible sizes not supported")
 
-    def f(a):
-        if data_format == "NCHW":
-            h_axis, w_axis = 2, 3
-        else:
-            h_axis, w_axis = 1, 2
-        ih, iw = a.shape[h_axis], a.shape[w_axis]
-        oh, ow = out_hw
-        if ih % oh == 0 and iw % ow == 0:
-            # reshape-mean fast path
-            if data_format == "NCHW":
-                r = a.reshape(a.shape[0], a.shape[1], oh, ih // oh, ow, iw // ow)
-                return r.mean(axis=(3, 5))
-            r = a.reshape(a.shape[0], oh, ih // oh, ow, iw // ow, a.shape[-1])
-            return r.mean(axis=(2, 4))
-        # general: per-output-bin mean via cumsum trick is overkill; use resize
-        raise NotImplementedError(
-            "adaptive pooling with non-divisible sizes not supported")
 
-    return apply(f, (x,), name="adaptive_avg_pool2d")
+def _adaptive_max_pool2d_raw(a, output_size=1):
+    out_hw = _norm_tuple(output_size, 2)
+    ih, iw = a.shape[2], a.shape[3]
+    oh, ow = out_hw
+    if ih % oh == 0 and iw % ow == 0:
+        r = a.reshape(a.shape[0], a.shape[1], oh, ih // oh, ow, iw // ow)
+        return r.max(axis=(3, 5))
+    raise NotImplementedError
+
+
+register_op("adaptive_avg_pool2d", _adaptive_avg_pool2d_raw)
+register_op("adaptive_max_pool2d", _adaptive_max_pool2d_raw)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return apply(_adaptive_avg_pool2d_raw, (x,),
+                 {"output_size": _stride_attr(output_size),
+                  "channels_last": data_format != "NCHW"},
+                 name="adaptive_avg_pool2d")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    out_hw = _norm_tuple(output_size, 2)
-
-    def f(a):
-        ih, iw = a.shape[2], a.shape[3]
-        oh, ow = out_hw
-        if ih % oh == 0 and iw % ow == 0:
-            r = a.reshape(a.shape[0], a.shape[1], oh, ih // oh, ow, iw // ow)
-            return r.max(axis=(3, 5))
-        raise NotImplementedError
-    return apply(f, (x,), name="adaptive_max_pool2d")
+    return apply(_adaptive_max_pool2d_raw, (x,),
+                 {"output_size": _stride_attr(output_size)},
+                 name="adaptive_max_pool2d")
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -690,70 +807,98 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
                  {"nd": nd, "epsilon": float(epsilon)}, name="layer_norm")
 
 
+def _instance_norm_raw(a, *wb, eps=1e-5):
+    axes = tuple(range(2, a.ndim))
+    m = jnp.mean(a, axis=axes, keepdims=True)
+    v = jnp.var(a, axis=axes, keepdims=True)
+    out = (a - m) * lax.rsqrt(v + eps)
+    if wb:
+        shape = (1, -1) + (1,) * (a.ndim - 2)
+        out = out * wb[0].reshape(shape)
+        if len(wb) > 1:
+            out = out + wb[1].reshape(shape)
+    return out
+
+
+register_op("instance_norm", _instance_norm_raw)
+
+
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
                   bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
                   data_format="NCHW", name=None):
-    def f(a, *wb):
-        axes = tuple(range(2, a.ndim))
-        m = jnp.mean(a, axis=axes, keepdims=True)
-        v = jnp.var(a, axis=axes, keepdims=True)
-        out = (a - m) * lax.rsqrt(v + eps)
-        if wb:
-            shape = (1, -1) + (1,) * (a.ndim - 2)
-            out = out * wb[0].reshape(shape)
-            if len(wb) > 1:
-                out = out + wb[1].reshape(shape)
-        return out
     args = [x]
     if weight is not None:
         args.append(weight)
         if bias is not None:
             args.append(bias)
-    return apply(f, tuple(args), name="instance_norm")
+    return apply(_instance_norm_raw, tuple(args), {"eps": float(eps)},
+                 name="instance_norm")
+
+
+def _group_norm_raw(a, *wb, num_groups=1, epsilon=1e-5):
+    n, c = a.shape[0], a.shape[1]
+    g = num_groups
+    r = a.reshape((n, g, c // g) + a.shape[2:])
+    axes = tuple(range(2, r.ndim))
+    m = jnp.mean(r, axis=axes, keepdims=True)
+    v = jnp.var(r, axis=axes, keepdims=True)
+    out = ((r - m) * lax.rsqrt(v + epsilon)).reshape(a.shape)
+    if wb:
+        shape = (1, c) + (1,) * (a.ndim - 2)
+        out = out * wb[0].reshape(shape)
+        if len(wb) > 1:
+            out = out + wb[1].reshape(shape)
+    return out
+
+
+register_op("group_norm", _group_norm_raw)
 
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
                data_format="NCHW", name=None):
-    def f(a, *wb):
-        n, c = a.shape[0], a.shape[1]
-        g = num_groups
-        r = a.reshape((n, g, c // g) + a.shape[2:])
-        axes = tuple(range(2, r.ndim))
-        m = jnp.mean(r, axis=axes, keepdims=True)
-        v = jnp.var(r, axis=axes, keepdims=True)
-        out = ((r - m) * lax.rsqrt(v + epsilon)).reshape(a.shape)
-        if wb:
-            shape = (1, c) + (1,) * (a.ndim - 2)
-            out = out * wb[0].reshape(shape)
-            if len(wb) > 1:
-                out = out + wb[1].reshape(shape)
-        return out
     args = [x]
     if weight is not None:
         args.append(weight)
         if bias is not None:
             args.append(bias)
-    return apply(f, tuple(args), name="group_norm")
+    return apply(_group_norm_raw, tuple(args),
+                 {"num_groups": int(num_groups), "epsilon": float(epsilon)},
+                 name="group_norm")
+
+
+def _normalize_raw(a, p=2, axis=1, epsilon=1e-12):
+    nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                            keepdims=True), 1.0 / p)
+    return a / jnp.maximum(nrm, epsilon)
+
+
+register_op("normalize", _normalize_raw)
 
 
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
-    def f(a):
-        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
-                                keepdims=True), 1.0 / p)
-        return a / jnp.maximum(nrm, epsilon)
-    return apply(f, (x,), name="normalize")
+    return apply(_normalize_raw, (x,),
+                 {"p": float(p), "axis": int(axis), "epsilon": float(epsilon)},
+                 name="normalize")
+
+
+def _local_response_norm_raw(a, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(a)
+    half = size // 2
+    pad_cfg = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (a.ndim - 2)
+    padded = jnp.pad(sq, pad_cfg)
+    window = sum(padded[:, i:i + a.shape[1]] for i in range(size))
+    return a / jnp.power(k + alpha * window, beta)
+
+
+register_op("local_response_norm", _local_response_norm_raw)
 
 
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
                         data_format="NCHW", name=None):
-    def f(a):
-        sq = jnp.square(a)
-        half = size // 2
-        pad_cfg = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (a.ndim - 2)
-        padded = jnp.pad(sq, pad_cfg)
-        window = sum(padded[:, i:i + a.shape[1]] for i in range(size))
-        return a / jnp.power(k + alpha * window, beta)
-    return apply(f, (x,), name="local_response_norm")
+    return apply(_local_response_norm_raw, (x,),
+                 {"size": int(size), "alpha": float(alpha),
+                  "beta": float(beta), "k": float(k)},
+                 name="local_response_norm")
 
 
 # ----------------------------------------------------------------- losses
@@ -810,361 +955,493 @@ register_op("cross_entropy", _cross_entropy_raw)
 softmax_with_cross_entropy = cross_entropy
 
 
+def _reduce_loss(per, reduction):
+    if reduction == "mean":
+        return jnp.mean(per)
+    if reduction == "sum":
+        return jnp.sum(per)
+    return per
+
+
+def _nll_loss_raw(logp, lab, *maybe_w, ignore_index=-100, reduction="mean"):
+    lab_i = lab.astype(jnp.int32)
+    valid = lab_i != ignore_index
+    safe = jnp.where(valid, lab_i, 0)
+    per = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    if maybe_w:
+        per = per * jnp.take(maybe_w[0], safe)
+    per = jnp.where(valid, per, 0.0)
+    if reduction == "mean":
+        denom = (jnp.sum(jnp.take(maybe_w[0], safe) * valid) if maybe_w
+                 else jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0))
+        return jnp.sum(per) / denom
+    return _reduce_loss(per, reduction)
+
+
+register_op("nll_loss", _nll_loss_raw)
+
+
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
              name=None):
-    def f(logp, lab, *maybe_w):
-        lab_i = lab.astype(jnp.int32)
-        valid = lab_i != ignore_index
-        safe = jnp.where(valid, lab_i, 0)
-        per = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        if maybe_w:
-            per = per * jnp.take(maybe_w[0], safe)
-        per = jnp.where(valid, per, 0.0)
-        if reduction == "mean":
-            denom = (jnp.sum(jnp.take(maybe_w[0], safe) * valid) if maybe_w
-                     else jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0))
-            return jnp.sum(per) / denom
-        if reduction == "sum":
-            return jnp.sum(per)
-        return per
     args = (input, label) if weight is None else (input, label, weight)
-    return apply(f, args, name="nll_loss")
+    return apply(_nll_loss_raw, args,
+                 {"ignore_index": int(ignore_index),
+                  "reduction": str(reduction)}, name="nll_loss")
+
+
+def _mse_loss_raw(a, b, reduction="mean"):
+    return _reduce_loss(jnp.square(a - b), reduction)
+
+
+def _l1_loss_raw(a, b, reduction="mean"):
+    return _reduce_loss(jnp.abs(a - b), reduction)
+
+
+def _smooth_l1_loss_raw(a, b, reduction="mean", delta=1.0):
+    d = jnp.abs(a - b)
+    l = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce_loss(l, reduction)
+
+
+register_op("mse_loss", _mse_loss_raw)
+register_op("l1_loss", _l1_loss_raw)
+register_op("smooth_l1_loss", _smooth_l1_loss_raw)
 
 
 def mse_loss(input, label, reduction="mean", name=None):
-    def f(a, b):
-        d = jnp.square(a - b)
-        if reduction == "mean":
-            return jnp.mean(d)
-        if reduction == "sum":
-            return jnp.sum(d)
-        return d
-    return apply(f, (input, label), name="mse_loss")
+    return apply(_mse_loss_raw, (input, label),
+                 {"reduction": str(reduction)}, name="mse_loss")
 
 
 def l1_loss(input, label, reduction="mean", name=None):
-    def f(a, b):
-        d = jnp.abs(a - b)
-        if reduction == "mean":
-            return jnp.mean(d)
-        if reduction == "sum":
-            return jnp.sum(d)
-        return d
-    return apply(f, (input, label), name="l1_loss")
+    return apply(_l1_loss_raw, (input, label),
+                 {"reduction": str(reduction)}, name="l1_loss")
 
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
-    def f(a, b):
-        d = jnp.abs(a - b)
-        l = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
-        if reduction == "mean":
-            return jnp.mean(l)
-        if reduction == "sum":
-            return jnp.sum(l)
-        return l
-    return apply(f, (input, label), name="smooth_l1_loss")
+    return apply(_smooth_l1_loss_raw, (input, label),
+                 {"reduction": str(reduction), "delta": float(delta)},
+                 name="smooth_l1_loss")
+
+
+def _binary_cross_entropy_raw(p, y, *maybe_w, reduction="mean"):
+    per = -(y * jnp.log(jnp.maximum(p, 1e-12))
+            + (1 - y) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+    if maybe_w:
+        per = per * maybe_w[0]
+    return _reduce_loss(per, reduction)
+
+
+register_op("binary_cross_entropy", _binary_cross_entropy_raw)
 
 
 def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
-    def f(p, y, *maybe_w):
-        per = -(y * jnp.log(jnp.maximum(p, 1e-12))
-                + (1 - y) * jnp.log(jnp.maximum(1 - p, 1e-12)))
-        if maybe_w:
-            per = per * maybe_w[0]
-        if reduction == "mean":
-            return jnp.mean(per)
-        if reduction == "sum":
-            return jnp.sum(per)
-        return per
     args = (input, label) if weight is None else (input, label, weight)
-    return apply(f, args, name="binary_cross_entropy")
+    return apply(_binary_cross_entropy_raw, args,
+                 {"reduction": str(reduction)}, name="binary_cross_entropy")
+
+
+def _bce_with_logits_raw(z, y, *rest, has_weight=False, has_pos_weight=False,
+                         reduction="mean"):
+    i = 0
+    w = rest[i] if has_weight else None
+    if has_weight:
+        i += 1
+    pw = rest[i] if has_pos_weight else None
+    # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+    per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    if pw is not None:
+        log_w = (pw - 1) * y + 1
+        per = per * log_w
+    if w is not None:
+        per = per * w
+    return _reduce_loss(per, reduction)
+
+
+register_op("bce_with_logits", _bce_with_logits_raw)
 
 
 def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
                                      pos_weight=None, name=None):
-    def f(z, y, *rest):
-        i = 0
-        w = rest[i] if weight is not None else None
-        if weight is not None:
-            i += 1
-        pw = rest[i] if pos_weight is not None else None
-        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
-        per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
-        if pw is not None:
-            log_w = (pw - 1) * y + 1
-            per = per * log_w
-        if w is not None:
-            per = per * w
-        if reduction == "mean":
-            return jnp.mean(per)
-        if reduction == "sum":
-            return jnp.sum(per)
-        return per
     args = [logit, label]
     if weight is not None:
         args.append(weight)
     if pos_weight is not None:
         args.append(pos_weight)
-    return apply(f, tuple(args), name="bce_with_logits")
+    return apply(_bce_with_logits_raw, tuple(args),
+                 {"has_weight": weight is not None,
+                  "has_pos_weight": pos_weight is not None,
+                  "reduction": str(reduction)}, name="bce_with_logits")
+
+
+def _kl_div_raw(logp, y, reduction="mean"):
+    per = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+    if reduction == "batchmean":
+        return jnp.sum(per) / logp.shape[0]
+    return _reduce_loss(per, reduction)
+
+
+register_op("kl_div", _kl_div_raw)
 
 
 def kl_div(input, label, reduction="mean", name=None):
-    def f(logp, y):
-        per = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
-        if reduction == "mean":
-            return jnp.mean(per)
-        if reduction == "batchmean":
-            return jnp.sum(per) / logp.shape[0]
-        if reduction == "sum":
-            return jnp.sum(per)
-        return per
-    return apply(f, (input, label), name="kl_div")
+    return apply(_kl_div_raw, (input, label),
+                 {"reduction": str(reduction)}, name="kl_div")
+
+
+def _margin_ranking_loss_raw(a, b, y, margin=0.0, reduction="mean"):
+    per = jnp.maximum(-y * (a - b) + margin, 0.0)
+    return _reduce_loss(per, reduction)
+
+
+register_op("margin_ranking_loss", _margin_ranking_loss_raw)
 
 
 def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
                         name=None):
-    def f(a, b, y):
-        per = jnp.maximum(-y * (a - b) + margin, 0.0)
-        if reduction == "mean":
-            return jnp.mean(per)
-        if reduction == "sum":
-            return jnp.sum(per)
-        return per
-    return apply(f, (input, other, label), name="margin_ranking_loss")
+    return apply(_margin_ranking_loss_raw, (input, other, label),
+                 {"margin": float(margin), "reduction": str(reduction)},
+                 name="margin_ranking_loss")
+
+
+def _hinge_embedding_loss_raw(a, y, margin=1.0, reduction="mean"):
+    per = jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0))
+    return _reduce_loss(per, reduction)
+
+
+register_op("hinge_embedding_loss", _hinge_embedding_loss_raw)
 
 
 def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
-    def f(a, y):
-        per = jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0))
-        if reduction == "mean":
-            return jnp.mean(per)
-        if reduction == "sum":
-            return jnp.sum(per)
-        return per
-    return apply(f, (input, label), name="hinge_embedding_loss")
+    return apply(_hinge_embedding_loss_raw, (input, label),
+                 {"margin": float(margin), "reduction": str(reduction)},
+                 name="hinge_embedding_loss")
+
+
+def _cosine_similarity_raw(a, b, axis=1, eps=1e-8):
+    num = jnp.sum(a * b, axis=axis)
+    den = jnp.maximum(jnp.linalg.norm(a, axis=axis)
+                      * jnp.linalg.norm(b, axis=axis), eps)
+    return num / den
+
+
+register_op("cosine_similarity", _cosine_similarity_raw)
 
 
 def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
-    def f(a, b):
-        num = jnp.sum(a * b, axis=axis)
-        den = jnp.maximum(jnp.linalg.norm(a, axis=axis)
-                          * jnp.linalg.norm(b, axis=axis), eps)
-        return num / den
-    return apply(f, (x1, x2), name="cosine_similarity")
+    return apply(_cosine_similarity_raw, (x1, x2),
+                 {"axis": int(axis), "eps": float(eps)},
+                 name="cosine_similarity")
+
+
+def _square_error_cost_raw(a, b):
+    return jnp.square(a - b)
+
+
+register_op("square_error_cost", _square_error_cost_raw)
 
 
 def square_error_cost(input, label):
-    return apply(lambda a, b: jnp.square(a - b), (input, label),
+    return apply(_square_error_cost_raw, (input, label),
                  name="square_error_cost")
+
+
+def _sigmoid_focal_loss_raw(z, y, *maybe_n, alpha=0.25, gamma=2.0,
+                            reduction="sum"):
+    p = jax.nn.sigmoid(z)
+    ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    p_t = p * y + (1 - p) * (1 - y)
+    a_t = alpha * y + (1 - alpha) * (1 - y)
+    per = a_t * jnp.power(1 - p_t, gamma) * ce
+    if maybe_n:
+        per = per / maybe_n[0]
+    return _reduce_loss(per, reduction)
+
+
+register_op("sigmoid_focal_loss", _sigmoid_focal_loss_raw)
 
 
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
                        reduction="sum", name=None):
-    def f(z, y, *maybe_n):
-        p = jax.nn.sigmoid(z)
-        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
-        p_t = p * y + (1 - p) * (1 - y)
-        a_t = alpha * y + (1 - alpha) * (1 - y)
-        per = a_t * jnp.power(1 - p_t, gamma) * ce
-        if maybe_n:
-            per = per / maybe_n[0]
-        if reduction == "mean":
-            return jnp.mean(per)
-        if reduction == "sum":
-            return jnp.sum(per)
-        return per
     args = (logit, label) if normalizer is None else (logit, label, normalizer)
-    return apply(f, args, name="sigmoid_focal_loss")
+    return apply(_sigmoid_focal_loss_raw, args,
+                 {"alpha": float(alpha), "gamma": float(gamma),
+                  "reduction": str(reduction)}, name="sigmoid_focal_loss")
 
 
 # ----------------------------------------------------------------- padding etc.
 
-def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
-    def f(a):
-        p = [int(v) for v in pad]
-        if len(p) == 2 * a.ndim:
-            cfg = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+def _pad_raw(a, pad=(), mode="constant", value=0.0, channels_first=True):
+    p = [int(v) for v in pad]
+    if len(p) == 2 * a.ndim:
+        cfg = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+    else:
+        # paddle: pad applies to last len(p)//2 spatial dims
+        # for NCHW 4-d input with 4 pads: [left,right,top,bottom] on W,H
+        n_spatial = len(p) // 2
+        cfg = [(0, 0)] * a.ndim
+        if channels_first:
+            dims = list(range(a.ndim - n_spatial, a.ndim))
         else:
-            # paddle: pad applies to last len(p)//2 spatial dims
-            # for NCHW 4-d input with 4 pads: [left,right,top,bottom] on W,H
-            n_spatial = len(p) // 2
-            cfg = [(0, 0)] * a.ndim
-            if data_format.startswith("NC"):
-                dims = list(range(a.ndim - n_spatial, a.ndim))
-            else:
-                dims = list(range(1, 1 + n_spatial))
-            # paddle order: innermost (last) dim first
-            for i, d in enumerate(reversed(dims)):
-                cfg[d] = (p[2 * i], p[2 * i + 1])
-        jmode = {"constant": "constant", "reflect": "reflect",
-                 "replicate": "edge", "circular": "wrap"}[mode]
-        if jmode == "constant":
-            return jnp.pad(a, cfg, mode="constant", constant_values=value)
-        return jnp.pad(a, cfg, mode=jmode)
-    return apply(f, (x,), name="pad")
+            dims = list(range(1, 1 + n_spatial))
+        # paddle order: innermost (last) dim first
+        for i, d in enumerate(reversed(dims)):
+            cfg[d] = (p[2 * i], p[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(a, cfg, mode="constant", constant_values=value)
+    return jnp.pad(a, cfg, mode=jmode)
+
+
+register_op("pad", _pad_raw)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    return apply(_pad_raw, (x,),
+                 {"pad": [int(v) for v in pad], "mode": str(mode),
+                  "value": float(value),
+                  "channels_first": data_format.startswith("NC")}, name="pad")
+
+
+def _unfold_raw(a, k=(1, 1), s=(1, 1), p=(0, 0), d=(1, 1)):
+    k, s, p, d = (tuple(v) for v in (k, s, p, d))
+    n, c, h, w = a.shape
+    patches = lax.conv_general_dilated_patches(
+        a, filter_shape=k, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        dimension_numbers=lax.conv_dimension_numbers(
+            a.shape, (c, c, k[0], k[1]), ("NCHW", "OIHW", "NCHW")))
+    # -> [N, C*kh*kw, L]
+    return patches.reshape(n, c * k[0] * k[1], -1)
+
+
+register_op("unfold", _unfold_raw)
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    k = _norm_tuple(kernel_sizes, 2)
-    s = _norm_tuple(strides, 2)
-    p = _norm_tuple(paddings, 2)
-    d = _norm_tuple(dilations, 2)
+    return apply(_unfold_raw, (x,),
+                 {"k": list(_norm_tuple(kernel_sizes, 2)),
+                  "s": list(_norm_tuple(strides, 2)),
+                  "p": list(_norm_tuple(paddings, 2)),
+                  "d": list(_norm_tuple(dilations, 2))}, name="unfold")
 
-    def f(a):
+
+def _interpolate_raw(a, size=None, scale_factor=None, mode="nearest",
+                     channels_last=False):
+    if not channels_last:
         n, c, h, w = a.shape
-        patches = lax.conv_general_dilated_patches(
-            a, filter_shape=k, window_strides=s,
-            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
-            dimension_numbers=lax.conv_dimension_numbers(
-                a.shape, (c, c, k[0], k[1]), ("NCHW", "OIHW", "NCHW")))
-        # -> [N, C*kh*kw, L]
-        return patches.reshape(n, c * k[0] * k[1], -1)
-    return apply(f, (x,), name="unfold")
+        spatial = (h, w)
+    else:
+        n, h, w, c = a.shape
+        spatial = (h, w)
+    if size is not None:
+        out_hw = tuple(int(v) for v in size)
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else (scale_factor, scale_factor)
+        out_hw = (int(spatial[0] * sf[0]), int(spatial[1] * sf[1]))
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic", "area": "linear"}[mode]
+    if not channels_last:
+        shape = (n, c) + out_hw
+    else:
+        shape = (n,) + out_hw + (c,)
+    return jax.image.resize(a, shape, method=method)
+
+
+register_op("interpolate", _interpolate_raw)
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
-    def f(a):
-        if data_format == "NCHW":
-            n, c, h, w = a.shape
-            spatial = (h, w)
-        else:
-            n, h, w, c = a.shape
-            spatial = (h, w)
-        if size is not None:
-            out_hw = tuple(int(v) for v in
-                           (size.tolist() if isinstance(size, Tensor) else size))
-        else:
-            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
-                else (scale_factor, scale_factor)
-            out_hw = (int(spatial[0] * sf[0]), int(spatial[1] * sf[1]))
-        method = {"nearest": "nearest", "bilinear": "linear",
-                  "bicubic": "cubic", "area": "linear"}[mode]
-        if data_format == "NCHW":
-            shape = (n, c) + out_hw
-        else:
-            shape = (n,) + out_hw + (c,)
-        return jax.image.resize(a, shape, method=method)
-    return apply(f, (x,), name="interpolate")
+    if size is not None:
+        size = [int(v) for v in
+                (size.tolist() if isinstance(size, Tensor) else size)] \
+            if not isinstance(size, numbers.Number) else [int(size)] * 2
+    if isinstance(scale_factor, (list, tuple)):
+        scale_factor = [float(v) for v in scale_factor]
+    elif scale_factor is not None:
+        scale_factor = float(scale_factor)
+    return apply(_interpolate_raw, (x,),
+                 {"size": size, "scale_factor": scale_factor,
+                  "mode": str(mode), "channels_last": data_format != "NCHW"},
+                 name="interpolate")
 
 
 upsample = interpolate
 
 
-def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
-    r = upscale_factor
+def _pixel_shuffle_raw(a, r=1):
+    n, c, h, w = a.shape
+    oc = c // (r * r)
+    out = a.reshape(n, oc, r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3)
+    return out.reshape(n, oc, h * r, w * r)
 
-    def f(a):
-        n, c, h, w = a.shape
-        oc = c // (r * r)
-        out = a.reshape(n, oc, r, r, h, w)
-        out = out.transpose(0, 1, 4, 2, 5, 3)
-        return out.reshape(n, oc, h * r, w * r)
-    return apply(f, (x,), name="pixel_shuffle")
+
+register_op("pixel_shuffle", _pixel_shuffle_raw)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return apply(_pixel_shuffle_raw, (x,), {"r": int(upscale_factor)},
+                 name="pixel_shuffle")
+
+
+def _temporal_shift_raw(a, seg_num=1, shift_ratio=0.25):
+    nt, c, h, w = a.shape
+    n = nt // seg_num
+    r = a.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([r[:, 1:, :fold], jnp.zeros_like(r[:, -1:, :fold])],
+                           axis=1)
+    right = jnp.concatenate([jnp.zeros_like(r[:, :1, fold:2 * fold]),
+                             r[:, :-1, fold:2 * fold]], axis=1)
+    rest = r[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+register_op("temporal_shift", _temporal_shift_raw)
 
 
 def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
-    def f(a):
-        nt, c, h, w = a.shape
-        n = nt // seg_num
-        r = a.reshape(n, seg_num, c, h, w)
-        fold = int(c * shift_ratio)
-        left = jnp.concatenate([r[:, 1:, :fold], jnp.zeros_like(r[:, -1:, :fold])],
-                               axis=1)
-        right = jnp.concatenate([jnp.zeros_like(r[:, :1, fold:2 * fold]),
-                                 r[:, :-1, fold:2 * fold]], axis=1)
-        rest = r[:, :, 2 * fold:]
-        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
-    return apply(f, (x,), name="temporal_shift")
+    return apply(_temporal_shift_raw, (x,),
+                 {"seg_num": int(seg_num), "shift_ratio": float(shift_ratio)},
+                 name="temporal_shift")
+
+
+def _grid_sample_raw(a, g, padding_mode="zeros", align_corners=True):
+    n, c, h, w = a.shape
+    gx = (g[..., 0] + 1) * (w - 1) / 2 if align_corners \
+        else ((g[..., 0] + 1) * w - 1) / 2
+    gy = (g[..., 1] + 1) * (h - 1) / 2 if align_corners \
+        else ((g[..., 1] + 1) * h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def sample(yy, xx):
+        yy_c = jnp.clip(yy, 0, h - 1)
+        xx_c = jnp.clip(xx, 0, w - 1)
+        v = a[jnp.arange(n)[:, None, None], :, yy_c, xx_c]  # [N,Hg,Wg,C]
+        if padding_mode == "zeros":
+            inb = ((yy >= 0) & (yy < h) & (xx >= 0) & (xx < w))[..., None]
+            v = jnp.where(inb, v, 0.0)
+        return v
+
+    wa = ((x1 - gx) * (y1 - gy))[..., None]
+    wb = ((x1 - gx) * (gy - y0))[..., None]
+    wc = ((gx - x0) * (y1 - gy))[..., None]
+    wd = ((gx - x0) * (gy - y0))[..., None]
+    out = (sample(y0, x0) * wa + sample(y1, x0) * wb
+           + sample(y0, x1) * wc + sample(y1, x1) * wd)
+    return out.transpose(0, 3, 1, 2)
+
+
+register_op("grid_sample", _grid_sample_raw)
 
 
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
-    def f(a, g):
-        n, c, h, w = a.shape
-        gx = (g[..., 0] + 1) * (w - 1) / 2 if align_corners \
-            else ((g[..., 0] + 1) * w - 1) / 2
-        gy = (g[..., 1] + 1) * (h - 1) / 2 if align_corners \
-            else ((g[..., 1] + 1) * h - 1) / 2
-        x0 = jnp.floor(gx).astype(jnp.int32)
-        y0 = jnp.floor(gy).astype(jnp.int32)
-        x1, y1 = x0 + 1, y0 + 1
+    return apply(_grid_sample_raw, (x, grid),
+                 {"padding_mode": str(padding_mode),
+                  "align_corners": bool(align_corners)}, name="grid_sample")
 
-        def sample(yy, xx):
-            yy_c = jnp.clip(yy, 0, h - 1)
-            xx_c = jnp.clip(xx, 0, w - 1)
-            v = a[jnp.arange(n)[:, None, None], :, yy_c, xx_c]  # [N,Hg,Wg,C]
-            if padding_mode == "zeros":
-                inb = ((yy >= 0) & (yy < h) & (xx >= 0) & (xx < w))[..., None]
-                v = jnp.where(inb, v, 0.0)
-            return v
 
-        wa = ((x1 - gx) * (y1 - gy))[..., None]
-        wb = ((x1 - gx) * (gy - y0))[..., None]
-        wc = ((gx - x0) * (y1 - gy))[..., None]
-        wd = ((gx - x0) * (gy - y0))[..., None]
-        out = (sample(y0, x0) * wa + sample(y1, x0) * wb
-               + sample(y0, x1) * wc + sample(y1, x1) * wd)
-        return out.transpose(0, 3, 1, 2)
-    return apply(f, (x, grid), name="grid_sample")
+def _affine_grid_raw(th, out_shape=(), align_corners=True):
+    n, _, h, w = [int(v) for v in out_shape]
+    if align_corners:
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1
+        xs = (jnp.arange(w) * 2 + 1) / w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H,W,3]
+    return jnp.einsum("nij,hwj->nhwi", th, base)
+
+
+register_op("affine_grid", _affine_grid_raw)
 
 
 def affine_grid(theta, out_shape, align_corners=True, name=None):
-    def f(th):
-        n, _, h, w = [int(v) for v in (out_shape.tolist()
-                                       if isinstance(out_shape, Tensor)
-                                       else out_shape)]
-        if align_corners:
-            ys = jnp.linspace(-1, 1, h)
-            xs = jnp.linspace(-1, 1, w)
-        else:
-            ys = (jnp.arange(h) * 2 + 1) / h - 1
-            xs = (jnp.arange(w) * 2 + 1) / w - 1
-        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
-        ones = jnp.ones_like(gx)
-        base = jnp.stack([gx, gy, ones], axis=-1)  # [H,W,3]
-        return jnp.einsum("nij,hwj->nhwi", th, base)
-    return apply(f, (theta,), name="affine_grid")
+    shape = [int(v) for v in (out_shape.tolist()
+                              if isinstance(out_shape, Tensor) else out_shape)]
+    return apply(_affine_grid_raw, (theta,),
+                 {"out_shape": shape, "align_corners": bool(align_corners)},
+                 name="affine_grid")
+
+
+def _label_smooth_raw(y, *maybe_p, epsilon=0.1):
+    k = y.shape[-1]
+    if maybe_p:
+        return (1 - epsilon) * y + epsilon * maybe_p[0]
+    return (1 - epsilon) * y + epsilon / k
+
+
+register_op("label_smooth", _label_smooth_raw)
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
-    def f(y, *maybe_p):
-        k = y.shape[-1]
-        if maybe_p:
-            return (1 - epsilon) * y + epsilon * maybe_p[0]
-        return (1 - epsilon) * y + epsilon / k
     args = (label,) if prior_dist is None else (label, prior_dist)
-    return apply(f, args, name="label_smooth")
+    return apply(_label_smooth_raw, args, {"epsilon": float(epsilon)},
+                 name="label_smooth")
+
+
+def _npair_loss_raw(a, p, y, l2_reg=0.002):
+    sim = jnp.matmul(a, p.T)
+    same = (y[:, None] == y[None, :]).astype(a.dtype)
+    same = same / jnp.sum(same, axis=1, keepdims=True)
+    ce = jnp.mean(-jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1))
+                    + jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25
+    return ce + reg
+
+
+register_op("npair_loss", _npair_loss_raw)
 
 
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
-    def f(a, p, y):
-        sim = jnp.matmul(a, p.T)
-        same = (y[:, None] == y[None, :]).astype(a.dtype)
-        same = same / jnp.sum(same, axis=1, keepdims=True)
-        ce = jnp.mean(-jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1))
-        reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(a), 1))
-                        + jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25
-        return ce + reg
-    return apply(f, (anchor, positive, labels), name="npair_loss")
+    return apply(_npair_loss_raw, (anchor, positive, labels),
+                 {"l2_reg": float(l2_reg)}, name="npair_loss")
+
+
+def _diag_embed_raw(a):
+    out = jnp.zeros(a.shape + (a.shape[-1],), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    return out.at[..., idx, idx].set(a)
+
+
+register_op("diag_embed", _diag_embed_raw)
 
 
 def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
-    def f(a):
-        out = jnp.zeros(a.shape + (a.shape[-1],), a.dtype)
-        idx = jnp.arange(a.shape[-1])
-        return out.at[..., idx, idx].set(a)
-    return apply(f, (x,), name="diag_embed")
+    return apply(_diag_embed_raw, (x,), name="diag_embed")
+
+
+def _sequence_mask_raw(l, maxlen=1, out_dtype="int64"):
+    return (jnp.arange(maxlen)[None, :] < l[:, None]).astype(
+        convert_dtype(out_dtype))
+
+
+register_op("sequence_mask", _sequence_mask_raw)
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
     ml = int(maxlen) if maxlen is not None else int(np.asarray(
         as_array(lengths)).max())
+    return apply(_sequence_mask_raw, (lengths,),
+                 {"maxlen": ml, "out_dtype": str(dtype)},
+                 differentiable=False, name="sequence_mask")
 
-    def f(l):
-        return (jnp.arange(ml)[None, :] < l[:, None]).astype(convert_dtype(dtype))
-    return apply(f, (lengths,), differentiable=False, name="sequence_mask")
+
+def _pairwise_distance_raw(x_, y_, p=2.0, keepdim=False):
+    return jnp.linalg.norm(x_ - y_, ord=p, axis=-1, keepdims=keepdim)
+
+
+register_op("pairwise_distance", _pairwise_distance_raw)
 
 
 def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
@@ -1173,10 +1450,79 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
     GRADIENT denominator (p_norm_op.h PnormGradKernel), never the
     forward — kept in the signature for API parity; autodiff handles the
     norm-at-zero subgradient here."""
-    def f(x_, y_):
-        return jnp.linalg.norm(x_ - y_, ord=p, axis=-1, keepdims=keepdim)
+    return apply(_pairwise_distance_raw, (x, y),
+                 {"p": float(p), "keepdim": bool(keepdim)},
+                 name="pairwise_distance")
 
-    return apply(f, (x, y), name="pairwise_distance")
+
+def _ctc_loss_raw(lp, lab, in_len, lab_len, blank=0, reduction="mean",
+                  norm_by_times=False):
+    T, B, C = lp.shape
+    Lmax = lab.shape[1]
+    S = 2 * Lmax + 1
+    logp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+    neg_inf = jnp.float32(-1e30)
+
+    # extended label sequence l' = [blank, l1, blank, l2, ..., blank]
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+    # transition-2 allowed where l'_s != blank and l'_s != l'_{s-2}
+    ext_m2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    def emit(t_logp):
+        # t_logp: [B, C] -> per-extended-position emission [B, S]
+        return jnp.take_along_axis(t_logp, ext, axis=1)
+
+    alpha0 = jnp.full((B, S), neg_inf)
+    e0 = emit(logp[0])
+    alpha0 = alpha0.at[:, 0].set(e0[:, 0])
+    if S > 1:      # Lmax=0 (all-blank targets) has only position 0
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, e0[:, 1],
+                                               neg_inf))
+
+    def step(alpha, t_logp_t):
+        t_logp, t = t_logp_t
+        if S > 1:
+            prev1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf),
+                 alpha[:, :max(S - 2, 0)]], axis=1)[:, :S]
+            prev2 = jnp.where(can_skip, prev2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        else:      # Lmax=0: only the all-blank path exists
+            merged = alpha
+        new = merged + emit(t_logp)
+        # freeze finished samples (t >= input_length)
+        active = (t < in_len)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    ts = jnp.arange(1, T)
+    alpha, _ = jax.lax.scan(step, alpha0, (logp[1:], ts))
+
+    # final: logsumexp of positions S-1 (last blank) and S-2 (last label)
+    s_last = 2 * lab_len.astype(jnp.int32)        # index of last blank
+    a_last = jnp.take_along_axis(alpha, s_last[:, None], axis=1)[:, 0]
+    s_lab = jnp.maximum(s_last - 1, 0)
+    a_lab = jnp.where(
+        lab_len > 0,
+        jnp.take_along_axis(alpha, s_lab[:, None], axis=1)[:, 0],
+        neg_inf)
+    nll = -jnp.logaddexp(a_last, a_lab)
+    if norm_by_times:
+        nll = nll / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+    if reduction == "mean":
+        # paddle mean: divide per-sample loss by label_length first
+        return jnp.mean(nll / jnp.maximum(
+            lab_len.astype(jnp.float32), 1.0))
+    if reduction == "sum":
+        return jnp.sum(nll)
+    return nll
+
+
+register_op("ctc_loss", _ctc_loss_raw)
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
@@ -1193,73 +1539,28 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     Gradients come from autodiff through the scan (the reference ships a
     hand-written backward; XLA differentiates the recursion directly).
     """
-    def f(lp, lab, in_len, lab_len):
-        T, B, C = lp.shape
-        Lmax = lab.shape[1]
-        S = 2 * Lmax + 1
-        logp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
-        neg_inf = jnp.float32(-1e30)
+    return apply(_ctc_loss_raw,
+                 (log_probs, labels, input_lengths, label_lengths),
+                 {"blank": int(blank), "reduction": str(reduction),
+                  "norm_by_times": bool(norm_by_times)}, name="ctc_loss")
 
-        # extended label sequence l' = [blank, l1, blank, l2, ..., blank]
-        ext = jnp.full((B, S), blank, jnp.int32)
-        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
-        # transition-2 allowed where l'_s != blank and l'_s != l'_{s-2}
-        ext_m2 = jnp.concatenate(
-            [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
-        can_skip = (ext != blank) & (ext != ext_m2)
 
-        def emit(t_logp):
-            # t_logp: [B, C] -> per-extended-position emission [B, S]
-            return jnp.take_along_axis(t_logp, ext, axis=1)
+def _gather_tree_raw(ids_, par_):
+    T, B, K = ids_.shape
+    par_ = par_.astype(jnp.int32)
 
-        alpha0 = jnp.full((B, S), neg_inf)
-        e0 = emit(logp[0])
-        alpha0 = alpha0.at[:, 0].set(e0[:, 0])
-        if S > 1:      # Lmax=0 (all-blank targets) has only position 0
-            alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, e0[:, 1],
-                                                   neg_inf))
+    def step(beams, xs):
+        ids_t, par_t = xs
+        out_t = jnp.take_along_axis(ids_t, beams, axis=-1)
+        prev = jnp.take_along_axis(par_t, beams, axis=-1)
+        return prev, out_t
 
-        def step(alpha, t_logp_t):
-            t_logp, t = t_logp_t
-            if S > 1:
-                prev1 = jnp.concatenate(
-                    [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
-                prev2 = jnp.concatenate(
-                    [jnp.full((B, 2), neg_inf),
-                     alpha[:, :max(S - 2, 0)]], axis=1)[:, :S]
-                prev2 = jnp.where(can_skip, prev2, neg_inf)
-                merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
-            else:      # Lmax=0: only the all-blank path exists
-                merged = alpha
-            new = merged + emit(t_logp)
-            # freeze finished samples (t >= input_length)
-            active = (t < in_len)[:, None]
-            return jnp.where(active, new, alpha), None
+    init = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (B, K))
+    _, outs = jax.lax.scan(step, init, (ids_, par_), reverse=True)
+    return outs
 
-        ts = jnp.arange(1, T)
-        alpha, _ = jax.lax.scan(step, alpha0, (logp[1:], ts))
 
-        # final: logsumexp of positions S-1 (last blank) and S-2 (last label)
-        s_last = 2 * lab_len.astype(jnp.int32)        # index of last blank
-        a_last = jnp.take_along_axis(alpha, s_last[:, None], axis=1)[:, 0]
-        s_lab = jnp.maximum(s_last - 1, 0)
-        a_lab = jnp.where(
-            lab_len > 0,
-            jnp.take_along_axis(alpha, s_lab[:, None], axis=1)[:, 0],
-            neg_inf)
-        nll = -jnp.logaddexp(a_last, a_lab)
-        if norm_by_times:
-            nll = nll / jnp.maximum(in_len.astype(jnp.float32), 1.0)
-        if reduction == "mean":
-            # paddle mean: divide per-sample loss by label_length first
-            return jnp.mean(nll / jnp.maximum(
-                lab_len.astype(jnp.float32), 1.0))
-        if reduction == "sum":
-            return jnp.sum(nll)
-        return nll
-
-    return apply(f, (log_probs, labels, input_lengths, label_lengths),
-                 name="ctc_loss")
+register_op("gather_tree", _gather_tree_raw)
 
 
 def gather_tree(ids, parents):
@@ -1267,19 +1568,5 @@ def gather_tree(ids, parents):
     parent beam indices (ref operators/gather_tree_op.cc; both [T, B, K]).
     TPU-native: one reverse lax.scan walking the parent chain — no
     per-(batch, beam) host loops."""
-    def f(ids_, par_):
-        T, B, K = ids_.shape
-        par_ = par_.astype(jnp.int32)
-
-        def step(beams, xs):
-            ids_t, par_t = xs
-            out_t = jnp.take_along_axis(ids_t, beams, axis=-1)
-            prev = jnp.take_along_axis(par_t, beams, axis=-1)
-            return prev, out_t
-
-        init = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32), (B, K))
-        _, outs = jax.lax.scan(step, init, (ids_, par_), reverse=True)
-        return outs
-
-    return apply(f, (ids, parents), differentiable=False,
+    return apply(_gather_tree_raw, (ids, parents), differentiable=False,
                  name="gather_tree")

@@ -4,9 +4,18 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
+from ..ops.dispatch import register_op
 from . import functional as F
 from . import initializer as I
 from .layer import Layer
+
+
+def _bilinear_raw(a, b, w, *maybe_bias):
+    out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+    return out + maybe_bias[0] if maybe_bias else out
+
+
+register_op("bilinear", _bilinear_raw)
 
 
 class Linear(Layer):
@@ -203,12 +212,10 @@ class Bilinear(Layer):
 
     def forward(self, x1, x2):
         from ..ops.dispatch import apply
-        args = (x1, x2, self.weight)
         if self.bias is not None:
-            return apply(lambda a, b, w, bi: jnp.einsum("bi,oij,bj->bo", a, w, b)
-                         + bi, (x1, x2, self.weight, self.bias), name="bilinear")
-        return apply(lambda a, b, w: jnp.einsum("bi,oij,bj->bo", a, w, b),
-                     args, name="bilinear")
+            return apply(_bilinear_raw, (x1, x2, self.weight, self.bias),
+                         name="bilinear")
+        return apply(_bilinear_raw, (x1, x2, self.weight), name="bilinear")
 
 
 class CosineSimilarity(Layer):

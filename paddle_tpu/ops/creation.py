@@ -10,6 +10,7 @@ import jax.numpy as jnp
 from ..framework import state
 from ..framework.dtype import convert_dtype
 from ..framework.tensor import Tensor
+from .dispatch import apply, register_op
 
 
 def _shape(shape):
@@ -103,14 +104,24 @@ def diagflat(x, offset=0, name=None):
     return Tensor(jnp.diagflat(a, k=offset))
 
 
+def _tril_raw(a, diagonal=0):
+    return jnp.tril(a, diagonal)
+
+
+def _triu_raw(a, diagonal=0):
+    return jnp.triu(a, diagonal)
+
+
+register_op("tril", _tril_raw)
+register_op("triu", _triu_raw)
+
+
 def tril(x, diagonal=0, name=None):
-    from .dispatch import apply
-    return apply(lambda a: jnp.tril(a, diagonal), (x,), name="tril")
+    return apply(_tril_raw, (x,), {"diagonal": int(diagonal)}, name="tril")
 
 
 def triu(x, diagonal=0, name=None):
-    from .dispatch import apply
-    return apply(lambda a: jnp.triu(a, diagonal), (x,), name="triu")
+    return apply(_triu_raw, (x,), {"diagonal": int(diagonal)}, name="triu")
 
 
 def meshgrid(*args, **kwargs):
@@ -118,14 +129,20 @@ def meshgrid(*args, **kwargs):
     return [Tensor(o) for o in jnp.meshgrid(*arrays, indexing="ij")]
 
 
+def _assign_raw(v):
+    return v + 0
+
+
+register_op("assign", _assign_raw)
+
+
 def assign(x, output=None):
     a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
     if output is not None:
         output.set_value(a)
         return output
-    from .dispatch import apply
     if isinstance(x, Tensor):
-        return apply(lambda v: v + 0, (x,), name="assign")
+        return apply(_assign_raw, (x,), name="assign")
     return Tensor(a)
 
 

@@ -33,6 +33,20 @@ def register_op(name, fn):
     OP_REGISTRY[name] = fn
     return fn
 
+
+def axis_attr(axis):
+    """Normalize an axis argument to its JSON-able desc-attr form (list or
+    int) — the shared half of the desc serialization contract; raw impls
+    convert back with axis_arg."""
+    if isinstance(axis, (list, tuple)):
+        return [int(a) for a in axis]
+    return None if axis is None else int(axis)
+
+
+def axis_arg(axis):
+    """Inverse of axis_attr inside raw impls: JSON list -> tuple for jnp."""
+    return tuple(axis) if isinstance(axis, list) else axis
+
 # AMP op lists (ref python/paddle/fluid/contrib/mixed_precision/fp16_lists.py):
 # white = compute-bound MXU ops run in low precision; black = numerically
 # sensitive ops kept f32. Everything else follows its inputs.

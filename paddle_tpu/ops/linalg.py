@@ -1,37 +1,57 @@
 """Linear algebra ops (ref operators/norm_op, cholesky_op, svd via Eigen;
-python/paddle/tensor/linalg.py surface). Backed by jnp.linalg (XLA native)."""
+python/paddle/tensor/linalg.py surface). Backed by jnp.linalg (XLA native).
+
+Every impl is a registered module-level raw fn with JSON-able attrs so the
+static desc serializes (ops/dispatch.py OP_REGISTRY contract)."""
 import jax
 import jax.numpy as jnp
 
 from ..framework.dtype import convert_dtype
 from ..framework.tensor import Tensor
-from .dispatch import apply
+from .dispatch import apply, register_op
+
+
+def _norm_raw(a, p="fro", axis=None, keepdim=False):
+    axis = tuple(axis) if isinstance(axis, list) else axis
+    if p == "fro" and (axis is None or isinstance(axis, tuple)):
+        return jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+    pw = float(p)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(a), pw), axis=axis,
+                             keepdims=keepdim), 1.0 / pw)
+
+
+register_op("norm", _norm_raw)
 
 
 def norm(x, p="fro", axis=None, keepdim=False, name=None):
     if isinstance(axis, (list, tuple)):
-        axis = tuple(axis)
+        axis = [int(a) for a in axis]
+    elif axis is not None:
+        axis = int(axis)
+    return apply(_norm_raw, (x,),
+                 {"p": p if isinstance(p, str) else float(p), "axis": axis,
+                  "keepdim": bool(keepdim)}, name="norm")
 
-    def f(a):
-        if p == "fro" and (axis is None or isinstance(axis, tuple)):
-            return jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdim))
-        if p == float("inf"):
-            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
-        if p == float("-inf"):
-            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
-        if p == 0:
-            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
-        pw = float(p)
-        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), pw), axis=axis,
-                                 keepdims=keepdim), 1.0 / pw)
-    return apply(f, (x,), name="norm")
+
+def _cholesky_raw(a, upper=False):
+    l = jnp.linalg.cholesky(a)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+register_op("cholesky", _cholesky_raw)
 
 
 def cholesky(x, upper=False, name=None):
-    def f(a):
-        l = jnp.linalg.cholesky(a)
-        return jnp.swapaxes(l, -1, -2) if upper else l
-    return apply(f, (x,), name="cholesky")
+    return apply(_cholesky_raw, (x,), {"upper": bool(upper)}, name="cholesky")
+
+
+register_op("inverse", jnp.linalg.inv)
 
 
 def inverse(x, name=None):
@@ -41,90 +61,184 @@ def inverse(x, name=None):
 inv = inverse
 
 
+def _pinv_raw(a, rcond=1e-15):
+    return jnp.linalg.pinv(a, rtol=rcond)
+
+
+register_op("pinv", _pinv_raw)
+
+
 def pinv(x, rcond=1e-15, name=None):
-    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond), (x,), name="pinv")
+    return apply(_pinv_raw, (x,), {"rcond": float(rcond)}, name="pinv")
+
+
+register_op("det", jnp.linalg.det)
 
 
 def det(x, name=None):
     return apply(jnp.linalg.det, (x,), name="det")
 
 
+def _slogdet_raw(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return jnp.stack([sign, logdet])
+
+
+register_op("slogdet", _slogdet_raw)
+
+
 def slogdet(x, name=None):
-    def f(a):
-        sign, logdet = jnp.linalg.slogdet(a)
-        return jnp.stack([sign, logdet])
-    return apply(f, (x,), name="slogdet")
+    return apply(_slogdet_raw, (x,), name="slogdet")
+
+
+def _matrix_power_raw(a, n=1):
+    return jnp.linalg.matrix_power(a, n)
+
+
+register_op("matrix_power", _matrix_power_raw)
 
 
 def matrix_power(x, n, name=None):
-    return apply(lambda a: jnp.linalg.matrix_power(a, n), (x,),
-                 name="matrix_power")
+    return apply(_matrix_power_raw, (x,), {"n": int(n)}, name="matrix_power")
+
+
+def _matrix_rank_raw(a, tol=None):
+    return jnp.linalg.matrix_rank(a, tol=tol).astype(convert_dtype("int64"))
+
+
+register_op("matrix_rank", _matrix_rank_raw)
 
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):
-    return apply(lambda a: jnp.linalg.matrix_rank(a, tol=tol).astype(convert_dtype("int64")),
-                 (x,), differentiable=False, name="matrix_rank")
+    return apply(_matrix_rank_raw, (x,),
+                 {"tol": None if tol is None else float(tol)},
+                 differentiable=False, name="matrix_rank")
+
+
+def _svd_raw(a, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2)
+
+
+register_op("svd", _svd_raw)
 
 
 def svd(x, full_matrices=False, name=None):
-    def f(a):
-        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2)
-    return apply(f, (x,), name="svd")
+    return apply(_svd_raw, (x,), {"full_matrices": bool(full_matrices)},
+                 name="svd")
+
+
+def _qr_raw(a, mode="reduced"):
+    q, r = jnp.linalg.qr(a, mode=mode)
+    return q, r
+
+
+register_op("qr", _qr_raw)
 
 
 def qr(x, mode="reduced", name=None):
-    def f(a):
-        q, r = jnp.linalg.qr(a, mode=mode)
-        return q, r
-    return apply(f, (x,), name="qr")
+    return apply(_qr_raw, (x,), {"mode": str(mode)}, name="qr")
+
+
+def _eigh_raw(a, UPLO="L"):
+    w, v = jnp.linalg.eigh(a, UPLO=UPLO)
+    return w, v
+
+
+register_op("eigh", _eigh_raw)
 
 
 def eigh(x, UPLO="L", name=None):
-    def f(a):
-        w, v = jnp.linalg.eigh(a, UPLO=UPLO)
-        return w, v
-    return apply(f, (x,), name="eigh")
+    return apply(_eigh_raw, (x,), {"UPLO": str(UPLO)}, name="eigh")
+
+
+def _eigvalsh_raw(a, UPLO="L"):
+    return jnp.linalg.eigvalsh(a, UPLO=UPLO)
+
+
+register_op("eigvalsh", _eigvalsh_raw)
 
 
 def eigvalsh(x, UPLO="L", name=None):
-    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), (x,), name="eigvalsh")
+    return apply(_eigvalsh_raw, (x,), {"UPLO": str(UPLO)}, name="eigvalsh")
+
+
+register_op("solve", jnp.linalg.solve)
 
 
 def solve(x, y, name=None):
     return apply(jnp.linalg.solve, (x, y), name="solve")
 
 
+def _triangular_solve_raw(a, b, upper=True, transpose=False,
+                          unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+register_op("triangular_solve", _triangular_solve_raw)
+
+
 def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
                      name=None):
-    return apply(lambda a, b: jax.scipy.linalg.solve_triangular(
-        a, b, lower=not upper, trans=1 if transpose else 0,
-        unit_diagonal=unitriangular), (x, y), name="triangular_solve")
+    return apply(_triangular_solve_raw, (x, y),
+                 {"upper": bool(upper), "transpose": bool(transpose),
+                  "unitriangular": bool(unitriangular)},
+                 name="triangular_solve")
+
+
+def _cholesky_solve_raw(b, l, upper=False):
+    return jax.scipy.linalg.cho_solve((l, not upper), b)
+
+
+register_op("cholesky_solve", _cholesky_solve_raw)
 
 
 def cholesky_solve(x, y, upper=False, name=None):
-    return apply(lambda b, l: jax.scipy.linalg.cho_solve((l, not upper), b),
-                 (x, y), name="cholesky_solve")
+    return apply(_cholesky_solve_raw, (x, y), {"upper": bool(upper)},
+                 name="cholesky_solve")
+
+
+def _lstsq_raw(a, b, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return sol
+
+
+register_op("lstsq", _lstsq_raw)
 
 
 def lstsq(x, y, rcond=None, name=None):
-    def f(a, b):
-        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
-        return sol
-    return apply(f, (x, y), name="lstsq")
+    return apply(_lstsq_raw, (x, y),
+                 {"rcond": None if rcond is None else float(rcond)},
+                 name="lstsq")
+
+
+def _cross_raw(a, b, axis=-1):
+    return jnp.cross(a, b, axis=axis)
+
+
+register_op("cross", _cross_raw)
 
 
 def cross(x, y, axis=None, name=None):
-    ax = axis if axis is not None else -1
-    return apply(lambda a, b: jnp.cross(a, b, axis=ax), (x, y), name="cross")
+    return apply(_cross_raw, (x, y),
+                 {"axis": -1 if axis is None else int(axis)}, name="cross")
+
+
+def _histogram_raw(a, bins=100, lo=0, hi=0):
+    lo_, hi_ = (lo, hi) if (lo != 0 or hi != 0) else (a.min(), a.max())
+    h, _ = jnp.histogram(a, bins=bins, range=(lo_, hi_))
+    return h.astype(convert_dtype("int64"))
+
+
+register_op("histogram", _histogram_raw)
 
 
 def histogram(input, bins=100, min=0, max=0, name=None):
-    def f(a):
-        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
-        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
-        return h.astype(convert_dtype("int64"))
-    return apply(f, (input,), differentiable=False, name="histogram")
+    return apply(_histogram_raw, (input,),
+                 {"bins": int(bins), "lo": float(min), "hi": float(max)},
+                 differentiable=False, name="histogram")
 
 
 def bincount(x, weights=None, minlength=0, name=None):

@@ -3,22 +3,49 @@ python/paddle/tensor/logic.py surface). All non-differentiable."""
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
-from .dispatch import apply, as_array
+from .dispatch import apply, as_array, register_op
 
 
 def _cmp(fn, name):
-    def op(x, y, name=None):
-        return apply(fn, (x, y), differentiable=False, name=name)
+    register_op(name, fn)
+
+    def op(x, y, name=None, _opname=name):
+        return apply(fn, (x, y), differentiable=False, name=_opname)
     op.__name__ = name
+    op.raw = fn
     return op
 
 
-equal = _cmp(lambda a, b: a == b, "equal")
-not_equal = _cmp(lambda a, b: a != b, "not_equal")
-greater_than = _cmp(lambda a, b: a > b, "greater_than")
-greater_equal = _cmp(lambda a, b: a >= b, "greater_equal")
-less_than = _cmp(lambda a, b: a < b, "less_than")
-less_equal = _cmp(lambda a, b: a <= b, "less_equal")
+def _equal_raw(a, b):
+    return a == b
+
+
+def _not_equal_raw(a, b):
+    return a != b
+
+
+def _greater_than_raw(a, b):
+    return a > b
+
+
+def _greater_equal_raw(a, b):
+    return a >= b
+
+
+def _less_than_raw(a, b):
+    return a < b
+
+
+def _less_equal_raw(a, b):
+    return a <= b
+
+
+equal = _cmp(_equal_raw, "equal")
+not_equal = _cmp(_not_equal_raw, "not_equal")
+greater_than = _cmp(_greater_than_raw, "greater_than")
+greater_equal = _cmp(_greater_equal_raw, "greater_equal")
+less_than = _cmp(_less_than_raw, "less_than")
+less_equal = _cmp(_less_equal_raw, "less_equal")
 
 logical_and = _cmp(jnp.logical_and, "logical_and")
 logical_or = _cmp(jnp.logical_or, "logical_or")
@@ -26,6 +53,9 @@ logical_xor = _cmp(jnp.logical_xor, "logical_xor")
 bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
 bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
 bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+register_op("logical_not", jnp.logical_not)
+register_op("bitwise_not", jnp.bitwise_not)
 
 
 def logical_not(x, name=None):
@@ -36,34 +66,64 @@ def bitwise_not(x, name=None):
     return apply(jnp.bitwise_not, (x,), differentiable=False, name="bitwise_not")
 
 
+def _all_raw(a, axis=None, keepdim=False):
+    ax = tuple(axis) if isinstance(axis, list) else axis
+    return jnp.all(a, axis=ax, keepdims=keepdim)
+
+
+def _any_raw(a, axis=None, keepdim=False):
+    ax = tuple(axis) if isinstance(axis, list) else axis
+    return jnp.any(a, axis=ax, keepdims=keepdim)
+
+
+register_op("all", _all_raw)
+register_op("any", _any_raw)
+
+
+from .dispatch import axis_attr as _axis_attr
+
+
 def all(x, axis=None, keepdim=False, name=None):
-    if isinstance(axis, (list, tuple)):
-        axis = tuple(axis)
-    return apply(lambda a: jnp.all(a, axis=axis, keepdims=keepdim), (x,),
+    return apply(_all_raw, (x,),
+                 {"axis": _axis_attr(axis), "keepdim": bool(keepdim)},
                  differentiable=False, name="all")
 
 
 def any(x, axis=None, keepdim=False, name=None):
-    if isinstance(axis, (list, tuple)):
-        axis = tuple(axis)
-    return apply(lambda a: jnp.any(a, axis=axis, keepdims=keepdim), (x,),
+    return apply(_any_raw, (x,),
+                 {"axis": _axis_attr(axis), "keepdim": bool(keepdim)},
                  differentiable=False, name="any")
 
 
+def _isclose_raw(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def _allclose_raw(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+register_op("isclose", _isclose_raw)
+register_op("allclose", _allclose_raw)
+register_op("equal_all", jnp.array_equal)
+
+
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
-                                          equal_nan=equal_nan),
-                 (x, y), differentiable=False, name="isclose")
+    return apply(_isclose_raw, (x, y),
+                 {"rtol": float(rtol), "atol": float(atol),
+                  "equal_nan": bool(equal_nan)},
+                 differentiable=False, name="isclose")
 
 
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
-                                           equal_nan=equal_nan),
-                 (x, y), differentiable=False, name="allclose")
+    return apply(_allclose_raw, (x, y),
+                 {"rtol": float(rtol), "atol": float(atol),
+                  "equal_nan": bool(equal_nan)},
+                 differentiable=False, name="allclose")
 
 
 def equal_all(x, y, name=None):
-    return apply(lambda a, b: jnp.array_equal(a, b), (x, y),
+    return apply(jnp.array_equal, (x, y),
                  differentiable=False, name="equal_all")
 
 

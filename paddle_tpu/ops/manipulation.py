@@ -88,8 +88,20 @@ def transpose(x, perm, name=None):
     return apply(_transpose_raw, (x,), {"perm": perm}, name="transpose")
 
 
+def _moveaxis_raw(a, source=0, destination=0):
+    src = tuple(source) if isinstance(source, list) else source
+    dst = tuple(destination) if isinstance(destination, list) else destination
+    return jnp.moveaxis(a, src, dst)
+
+
+register_op("moveaxis", _moveaxis_raw)
+
+
 def moveaxis(x, source, destination, name=None):
-    return apply(lambda a: jnp.moveaxis(a, source, destination), (x,),
+    conv = (lambda v: [int(i) for i in v] if isinstance(v, (list, tuple))
+            else int(v))
+    return apply(_moveaxis_raw, (x,),
+                 {"source": conv(source), "destination": conv(destination)},
                  name="moveaxis")
 
 
@@ -105,8 +117,15 @@ def swapaxes(x, axis1, axis2, name=None):
                  {"axis1": int(axis1), "axis2": int(axis2)}, name="swapaxes")
 
 
+def _t_raw(a):
+    return a.T
+
+
+register_op("t", _t_raw)
+
+
 def t(x, name=None):
-    return apply(lambda a: a.T, (x,), name="t")
+    return apply(_t_raw, (x,), name="t")
 
 
 def concat(x, axis=0, name=None):
@@ -136,13 +155,18 @@ def stack(x, axis=0, name=None):
     return apply(_stack_raw, tuple(tensors), {"axis": int(axis)}, name="stack")
 
 
+def _unstack_raw(a, axis=0, num=1):
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(a, num, axis=axis))
+
+
+register_op("unstack", _unstack_raw)
+
+
 def unstack(x, axis=0, num=None, name=None):
     n = num or x.shape[axis]
-
-    def f(a):
-        return tuple(jnp.squeeze(s, axis=axis)
-                     for s in jnp.split(a, n, axis=axis))
-    return list(apply(f, (x,), name="unstack"))
+    return list(apply(_unstack_raw, (x,), {"axis": int(axis), "num": int(n)},
+                      name="unstack"))
 
 
 def split(x, num_or_sections, axis=0, name=None):
@@ -256,22 +280,54 @@ def _tile_raw(a, reps=()):
 register_op("tile", _tile_raw)
 
 
+def _repeat_interleave_raw(a, repeats=1, axis=None):
+    return jnp.repeat(a, repeats, axis=axis)
+
+
+def _flip_raw(a, axis=0):
+    return jnp.flip(a, axis=_axes(axis))
+
+
+def _roll_raw(a, shifts=0, axis=None):
+    sh = tuple(shifts) if isinstance(shifts, list) else shifts
+    ax = tuple(axis) if isinstance(axis, list) else axis
+    return jnp.roll(a, sh, axis=ax)
+
+
+def _rot90_raw(a, k=1, axes=(0, 1)):
+    return jnp.rot90(a, k=k, axes=tuple(axes))
+
+
+register_op("repeat_interleave", _repeat_interleave_raw)
+register_op("flip", _flip_raw)
+register_op("roll", _roll_raw)
+register_op("rot90", _rot90_raw)
+
+
 def repeat_interleave(x, repeats, axis=None, name=None):
     r = repeats.tolist() if isinstance(repeats, Tensor) else repeats
-    return apply(lambda a: jnp.repeat(a, r, axis=axis), (x,),
+    r = [int(v) for v in r] if isinstance(r, (list, tuple)) else int(r)
+    return apply(_repeat_interleave_raw, (x,),
+                 {"repeats": r, "axis": None if axis is None else int(axis)},
                  name="repeat_interleave")
 
 
 def flip(x, axis, name=None):
-    return apply(lambda a: jnp.flip(a, axis=_axes(axis)), (x,), name="flip")
+    ax = [int(a) for a in axis] if isinstance(axis, (list, tuple)) \
+        else int(axis)
+    return apply(_flip_raw, (x,), {"axis": ax}, name="flip")
 
 
 def roll(x, shifts, axis=None, name=None):
-    return apply(lambda a: jnp.roll(a, shifts, axis=axis), (x,), name="roll")
+    conv = (lambda v: [int(i) for i in v] if isinstance(v, (list, tuple))
+            else (None if v is None else int(v)))
+    return apply(_roll_raw, (x,), {"shifts": conv(shifts), "axis": conv(axis)},
+                 name="roll")
 
 
 def rot90(x, k=1, axes=(0, 1), name=None):
-    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,), name="rot90")
+    return apply(_rot90_raw, (x,),
+                 {"k": int(k), "axes": [int(a) for a in axes]}, name="rot90")
 
 
 # ----------------------------------------------------------------- index ops
@@ -308,13 +364,23 @@ def slice(x, axes, starts, ends, name=None):
                   "ends": ends}, name="slice")
 
 
+def _strided_slice_raw(a, axes=(), starts=(), ends=(), strides=()):
+    idx = [builtins.slice(None)] * a.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[int(ax)] = builtins.slice(int(s), int(e), int(st))
+    return a[tuple(idx)]
+
+
+register_op("strided_slice", _strided_slice_raw)
+
+
 def strided_slice(x, axes, starts, ends, strides, name=None):
-    def f(a):
-        idx = [builtins.slice(None)] * a.ndim
-        for ax, s, e, st in zip(axes, starts, ends, strides):
-            idx[int(ax)] = builtins.slice(int(s), int(e), int(st))
-        return a[tuple(idx)]
-    return apply(f, (x,), name="strided_slice")
+    conv = lambda v: [int(i.item()) if isinstance(i, Tensor) else int(i)
+                      for i in v]
+    return apply(_strided_slice_raw, (x,),
+                 {"axes": conv(axes), "starts": conv(starts),
+                  "ends": conv(ends), "strides": conv(strides)},
+                 name="strided_slice")
 
 
 def gather(x, index, axis=0, name=None):
@@ -343,17 +409,22 @@ def take_along_axis(x, indices, axis, name=None):
                  name="take_along_axis")
 
 
-def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
-    def f(a, i, v):
-        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
-        if reduce == "assign":
-            return _put_along(a, i, v, axis, "set")
-        if reduce == "add":
-            return _put_along(a, i, v, axis, "add")
-        if reduce in ("mul", "multiply"):
-            return _put_along(a, i, v, axis, "mul")
+def _put_along_axis_raw(a, i, v, axis=0, reduce="assign"):
+    v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+    mode = {"assign": "set", "add": "add", "mul": "mul",
+            "multiply": "mul"}.get(reduce)
+    if mode is None:
         raise ValueError(reduce)
-    return apply(f, (x, indices, values), name="put_along_axis")
+    return _put_along(a, i, v, axis, mode)
+
+
+register_op("put_along_axis", _put_along_axis_raw)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+    return apply(_put_along_axis_raw, (x, indices, values),
+                 {"axis": int(axis), "reduce": str(reduce)},
+                 name="put_along_axis")
 
 
 def _put_along(a, idx, v, axis, mode):
@@ -364,29 +435,42 @@ def _put_along(a, idx, v, axis, mode):
     return getattr(ref, mode)(v)
 
 
+def _gather_nd_raw(a, idx):
+    comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+    return a[comps]
+
+
+def _scatter_raw(a, idx, upd, overwrite=True):
+    idx = idx.reshape(-1)
+    if overwrite:
+        return a.at[idx].set(upd)
+    # paddle scatter(overwrite=False) zeroes target rows then adds
+    zeroed = a.at[idx].set(jnp.zeros_like(upd))
+    return zeroed.at[idx].add(upd)
+
+
+def _scatter_nd_add_raw(a, idx, upd):
+    comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+    return a.at[comps].add(upd)
+
+
+register_op("gather_nd", _gather_nd_raw)
+register_op("scatter", _scatter_raw)
+register_op("scatter_nd_add", _scatter_nd_add_raw)
+
+
 def gather_nd(x, index, name=None):
-    def f(a, idx):
-        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
-        return a[comps]
-    return apply(f, (x, index), name="gather_nd")
+    return apply(_gather_nd_raw, (x, index), name="gather_nd")
 
 
 def scatter(x, index, updates, overwrite=True, name=None):
-    def f(a, idx, upd):
-        idx = idx.reshape(-1)
-        if overwrite:
-            return a.at[idx].set(upd)
-        # paddle scatter(overwrite=False) zeroes target rows then adds
-        zeroed = a.at[idx].set(jnp.zeros_like(upd))
-        return zeroed.at[idx].add(upd)
-    return apply(f, (x, index, updates), name="scatter")
+    return apply(_scatter_raw, (x, index, updates),
+                 {"overwrite": bool(overwrite)}, name="scatter")
 
 
 def scatter_nd_add(x, index, updates, name=None):
-    def f(a, idx, upd):
-        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
-        return a.at[comps].add(upd)
-    return apply(f, (x, index, updates), name="scatter_nd_add")
+    return apply(_scatter_nd_add_raw, (x, index, updates),
+                 name="scatter_nd_add")
 
 
 def scatter_nd(index, updates, shape, name=None):
@@ -396,21 +480,36 @@ def scatter_nd(index, updates, shape, name=None):
     return Tensor(zeros.at[comps].add(upd))
 
 
+def _index_select_raw(a, i, axis=0):
+    return jnp.take(a, i, axis=axis)
+
+
+def _index_sample_raw(a, i):
+    return jnp.take_along_axis(a, i, axis=1)
+
+
+def _where_raw(c, a, b):
+    return jnp.where(c, a, b)
+
+
+register_op("index_select", _index_select_raw)
+register_op("index_sample", _index_sample_raw)
+register_op("where", _where_raw)
+
+
 def index_select(x, index, axis=0, name=None):
-    return apply(lambda a, i: jnp.take(a, i, axis=axis), (x, index),
+    return apply(_index_select_raw, (x, index), {"axis": int(axis)},
                  name="index_select")
 
 
 def index_sample(x, index, name=None):
-    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=1),
-                 (x, index), name="index_sample")
+    return apply(_index_sample_raw, (x, index), name="index_sample")
 
 
 def where(condition, x=None, y=None, name=None):
     if x is None and y is None:
         return nonzero(condition, as_tuple=True)
-    return apply(lambda c, a, b: jnp.where(c, a, b), (condition, x, y),
-                 name="where")
+    return apply(_where_raw, (condition, x, y), name="where")
 
 
 def nonzero(x, as_tuple=False):
@@ -429,58 +528,117 @@ def masked_select(x, mask, name=None):
     return Tensor(jnp.asarray(a[m]))
 
 
+def _masked_fill_raw(a, m, value=0.0):
+    return jnp.where(m, jnp.asarray(value, a.dtype), a)
+
+
+register_op("masked_fill", _masked_fill_raw)
+
+
 def masked_fill(x, mask, value, name=None):
     v = value.item() if isinstance(value, Tensor) else value
-    return apply(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
-                 (x, mask), name="masked_fill")
+    return apply(_masked_fill_raw, (x, mask), {"value": float(v)},
+                 name="masked_fill")
+
+
+def _fill_diagonal_raw(a, value=0.0, offset=0):
+    eye = jnp.eye(a.shape[0], a.shape[1], k=offset, dtype=bool) \
+        if a.ndim == 2 else None
+    return jnp.where(eye, jnp.asarray(value, a.dtype), a)
+
+
+register_op("fill_diagonal", _fill_diagonal_raw)
 
 
 def fill_diagonal(x, value, offset=0, wrap=False, name=None):
-    def f(a):
-        n = builtins.min(a.shape)
-        eye = jnp.eye(a.shape[0], a.shape[1], k=offset, dtype=bool) \
-            if a.ndim == 2 else None
-        return jnp.where(eye, jnp.asarray(value, a.dtype), a)
-    return apply(f, (x,), name="fill_diagonal")
+    return apply(_fill_diagonal_raw, (x,),
+                 {"value": float(value), "offset": int(offset)},
+                 name="fill_diagonal")
+
+
+def _shard_index_raw(idx, index_num=1, nshards=1, shard_id=0, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (idx >= lo) & (idx < hi)
+    return jnp.where(in_shard, idx - lo, ignore_value)
+
+
+register_op("shard_index", _shard_index_raw)
 
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     """TP helper (ref operators/shard_index_op.cc, used by _parallel_embedding,
     python/paddle/distributed/collective.py:566): map global ids to shard-local,
     ignore_value for out-of-shard."""
-    def f(idx):
-        shard_size = (index_num + nshards - 1) // nshards
-        lo = shard_id * shard_size
-        hi = lo + shard_size
-        in_shard = (idx >= lo) & (idx < hi)
-        return jnp.where(in_shard, idx - lo, ignore_value)
-    return apply(f, (input,), differentiable=False, name="shard_index")
+    return apply(_shard_index_raw, (input,),
+                 {"index_num": int(index_num), "nshards": int(nshards),
+                  "shard_id": int(shard_id), "ignore_value": int(ignore_value)},
+                 differentiable=False, name="shard_index")
+
+
+def _one_hot_raw(i, num_classes=1):
+    return jax.nn.one_hot(i, num_classes, dtype=jnp.float32)
+
+
+register_op("one_hot", _one_hot_raw)
 
 
 def one_hot(x, num_classes, name=None):
-    return apply(lambda i: jax.nn.one_hot(i, num_classes, dtype=jnp.float32),
-                 (x,), differentiable=False, name="one_hot")
+    return apply(_one_hot_raw, (x,), {"num_classes": int(num_classes)},
+                 differentiable=False, name="one_hot")
+
+
+def _tensordot_raw(a, b, axes=2):
+    ax = [tuple(v) for v in axes] if isinstance(axes, list) \
+        and axes and isinstance(axes[0], (list, tuple)) else axes
+    return jnp.tensordot(a, b, axes=ax)
+
+
+register_op("tensordot", _tensordot_raw)
 
 
 def tensordot(x, y, axes=2, name=None):
-    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), (x, y),
-                 name="tensordot")
+    if isinstance(axes, (list, tuple)):
+        axes = [list(int(i) for i in v) if isinstance(v, (list, tuple))
+                else int(v) for v in axes]
+    else:
+        axes = int(axes)
+    return apply(_tensordot_raw, (x, y), {"axes": axes}, name="tensordot")
+
+
+def _as_complex_raw(a):
+    return lax.complex(a[..., 0], a[..., 1])
+
+
+def _as_real_raw(a):
+    return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
+
+
+register_op("as_complex", _as_complex_raw)
+register_op("as_real", _as_real_raw)
 
 
 def as_complex(x, name=None):
-    return apply(lambda a: lax.complex(a[..., 0], a[..., 1]), (x,),
-                 name="as_complex")
+    return apply(_as_complex_raw, (x,), name="as_complex")
 
 
 def as_real(x, name=None):
-    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), (x,),
-                 name="as_real")
+    return apply(_as_real_raw, (x,), name="as_real")
+
+
+def _crop_raw(a, shape=(), offsets=None):
+    offs = offsets or [0] * a.ndim
+    shp = [s if s != -1 else a.shape[i] - offs[i]
+           for i, s in enumerate(shape)]
+    return lax.dynamic_slice(a, [int(o) for o in offs], [int(s) for s in shp])
+
+
+register_op("crop", _crop_raw)
 
 
 def crop(x, shape=None, offsets=None, name=None):
-    def f(a):
-        offs = offsets or [0] * a.ndim
-        shp = [s if s != -1 else a.shape[i] - offs[i]
-               for i, s in enumerate(shape)]
-        return lax.dynamic_slice(a, [int(o) for o in offs], [int(s) for s in shp])
-    return apply(f, (x,), name="crop")
+    return apply(_crop_raw, (x,),
+                 {"shape": [int(s) for s in shape],
+                  "offsets": None if offsets is None
+                  else [int(o) for o in offsets]}, name="crop")

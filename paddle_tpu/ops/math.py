@@ -42,14 +42,27 @@ atan2 = _binop(jnp.arctan2, "atan2")
 hypot = _binop(jnp.hypot, "hypot")
 
 
+def _pow_raw(a, b):
+    return jnp.power(a, b)
+
+
+register_op("pow", _pow_raw)
+
+
 def pow(x, y, name=None):
-    return apply(lambda a, b: jnp.power(a, b), (x, y), name="pow")
+    return apply(_pow_raw, (x, y), name="pow")
+
+
+def _scale_raw(a, s, b, bias_after_scale=True):
+    return a * s + b if bias_after_scale else (a + b) * s
+
+
+register_op("scale", _scale_raw)
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
-    def f(a, s, b):
-        return a * s + b if bias_after_scale else (a + b) * s
-    out = apply(f, (x, scale, bias), name="scale")
+    out = apply(_scale_raw, (x, scale, bias),
+                {"bias_after_scale": bool(bias_after_scale)}, name="scale")
     if act:
         from ..nn import functional as F
         out = getattr(F, act)(out)
@@ -132,9 +145,19 @@ def isfinite(x, name=None):
     return apply(jnp.isfinite, (x,), differentiable=False, name="isfinite")
 
 
+def _nan_to_num_raw(a, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf)
+
+
+register_op("nan_to_num", _nan_to_num_raw)
+
+
 def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
-    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
-                 (x,), name="nan_to_num")
+    return apply(_nan_to_num_raw, (x,),
+                 {"nan": float(nan),
+                  "posinf": None if posinf is None else float(posinf),
+                  "neginf": None if neginf is None else float(neginf)},
+                 name="nan_to_num")
 
 
 # ----------------------------------------------------------------- reductions
@@ -175,68 +198,119 @@ nansum = _reduce(jnp.nansum, "nansum")
 nanmean = _reduce(jnp.nanmean, "nanmean")
 
 
+from .dispatch import axis_attr as _axis_attr, axis_arg as _axis_arg
+
+
+def _logsumexp_raw(a, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(a, axis=_axis_arg(axis), keepdims=keepdim)
+
+
+def _std_raw(a, axis=None, ddof=1, keepdim=False):
+    return jnp.std(a, axis=_axis_arg(axis), ddof=ddof, keepdims=keepdim)
+
+
+def _var_raw(a, axis=None, ddof=1, keepdim=False):
+    return jnp.var(a, axis=_axis_arg(axis), ddof=ddof, keepdims=keepdim)
+
+
+def _median_raw(a, axis=None, keepdim=False):
+    return jnp.median(a, axis=_axis_arg(axis), keepdims=keepdim)
+
+
+def _argmax_raw(a, axis=None, keepdim=False, out_dtype="int64"):
+    return jnp.argmax(a, axis=axis, keepdims=keepdim).astype(
+        convert_dtype(out_dtype))
+
+
+def _argmin_raw(a, axis=None, keepdim=False, out_dtype="int64"):
+    return jnp.argmin(a, axis=axis, keepdims=keepdim).astype(
+        convert_dtype(out_dtype))
+
+
+def _cumsum_raw(a, axis=None, out_dtype=None):
+    dt = convert_dtype(out_dtype) if out_dtype is not None else None
+    if axis is None:
+        return jnp.cumsum(a.reshape(-1), dtype=dt)
+    return jnp.cumsum(a, axis=axis, dtype=dt)
+
+
+def _cumprod_raw(a, axis=None, out_dtype=None):
+    dt = convert_dtype(out_dtype) if out_dtype is not None else None
+    return jnp.cumprod(a, axis=axis, dtype=dt)
+
+
+def _count_nonzero_raw(a, axis=None, keepdim=False):
+    return jnp.count_nonzero(a, axis=_axis_arg(axis), keepdims=keepdim).astype(
+        convert_dtype("int64"))
+
+
+register_op("logsumexp", _logsumexp_raw)
+register_op("std", _std_raw)
+register_op("var", _var_raw)
+register_op("median", _median_raw)
+register_op("argmax", _argmax_raw)
+register_op("argmin", _argmin_raw)
+register_op("cumsum", _cumsum_raw)
+register_op("cumprod", _cumprod_raw)
+register_op("count_nonzero", _count_nonzero_raw)
+
+
 def logsumexp(x, axis=None, keepdim=False, name=None):
-    if isinstance(axis, (list, tuple)):
-        axis = tuple(axis)
-    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdim),
-                 (x,), name="logsumexp")
+    return apply(_logsumexp_raw, (x,),
+                 {"axis": _axis_attr(axis), "keepdim": bool(keepdim)},
+                 name="logsumexp")
 
 
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
-    if isinstance(axis, (list, tuple)):
-        axis = tuple(axis)
-    dd = 1 if unbiased else 0
-    return apply(lambda a: jnp.std(a, axis=axis, ddof=dd, keepdims=keepdim),
-                 (x,), name="std")
+    return apply(_std_raw, (x,),
+                 {"axis": _axis_attr(axis), "ddof": 1 if unbiased else 0,
+                  "keepdim": bool(keepdim)}, name="std")
 
 
 def var(x, axis=None, unbiased=True, keepdim=False, name=None):
-    if isinstance(axis, (list, tuple)):
-        axis = tuple(axis)
-    dd = 1 if unbiased else 0
-    return apply(lambda a: jnp.var(a, axis=axis, ddof=dd, keepdims=keepdim),
-                 (x,), name="var")
+    return apply(_var_raw, (x,),
+                 {"axis": _axis_attr(axis), "ddof": 1 if unbiased else 0,
+                  "keepdim": bool(keepdim)}, name="var")
 
 
 def median(x, axis=None, keepdim=False, name=None):
-    return apply(lambda a: jnp.median(a, axis=axis, keepdims=keepdim),
-                 (x,), name="median")
+    return apply(_median_raw, (x,),
+                 {"axis": _axis_attr(axis), "keepdim": bool(keepdim)},
+                 name="median")
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
-    def f(a):
-        out = jnp.argmax(a, axis=axis, keepdims=keepdim)
-        return out.astype(convert_dtype(dtype))
-    return apply(f, (x,), differentiable=False, name="argmax")
+    return apply(_argmax_raw, (x,),
+                 {"axis": None if axis is None else int(axis),
+                  "keepdim": bool(keepdim), "out_dtype": str(dtype)},
+                 differentiable=False, name="argmax")
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
-    def f(a):
-        out = jnp.argmin(a, axis=axis, keepdims=keepdim)
-        return out.astype(convert_dtype(dtype))
-    return apply(f, (x,), differentiable=False, name="argmin")
+    return apply(_argmin_raw, (x,),
+                 {"axis": None if axis is None else int(axis),
+                  "keepdim": bool(keepdim), "out_dtype": str(dtype)},
+                 differentiable=False, name="argmin")
 
 
 def cumsum(x, axis=None, dtype=None, name=None):
-    def f(a):
-        if axis is None:
-            a = a.reshape(-1)
-            return jnp.cumsum(a, dtype=convert_dtype(dtype))
-        return jnp.cumsum(a, axis=axis, dtype=convert_dtype(dtype))
-    return apply(f, (x,), name="cumsum")
+    return apply(_cumsum_raw, (x,),
+                 {"axis": None if axis is None else int(axis),
+                  "out_dtype": None if dtype is None
+                  else str(np.dtype(convert_dtype(dtype)))}, name="cumsum")
 
 
 def cumprod(x, dim=None, dtype=None, name=None):
-    return apply(lambda a: jnp.cumprod(a, axis=dim, dtype=convert_dtype(dtype)),
-                 (x,), name="cumprod")
+    return apply(_cumprod_raw, (x,),
+                 {"axis": None if dim is None else int(dim),
+                  "out_dtype": None if dtype is None
+                  else str(np.dtype(convert_dtype(dtype)))}, name="cumprod")
 
 
 def count_nonzero(x, axis=None, keepdim=False, name=None):
-    if isinstance(axis, (list, tuple)):
-        axis = tuple(axis)
-    return apply(lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim)
-                 .astype(convert_dtype("int64")), (x,), differentiable=False,
-                 name="count_nonzero")
+    return apply(_count_nonzero_raw, (x,),
+                 {"axis": _axis_attr(axis), "keepdim": bool(keepdim)},
+                 differentiable=False, name="count_nonzero")
 
 
 # ----------------------------------------------------------------- linalg-ish
@@ -268,15 +342,35 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
 mm = matmul
 
 
+def _dot_raw(a, b):
+    return jnp.sum(a * b, axis=-1)
+
+
+def _bmm_raw(a, b):
+    return jnp.matmul(a, b, precision=_matmul_precision())
+
+
+def _outer_raw(a, b):
+    return jnp.outer(a, b)
+
+
+def _addmm_raw(i, a, b, beta=1.0, alpha=1.0):
+    return beta * i + alpha * jnp.matmul(a, b)
+
+
+register_op("dot", _dot_raw)
+register_op("bmm", _bmm_raw)
+register_op("inner", jnp.inner)
+register_op("outer", _outer_raw)
+register_op("addmm", _addmm_raw)
+
+
 def dot(x, y, name=None):
-    def f(a, b):
-        return jnp.sum(a * b, axis=-1)
-    return apply(f, (x, y), name="dot")
+    return apply(_dot_raw, (x, y), name="dot")
 
 
 def bmm(x, y, name=None):
-    return apply(lambda a, b: jnp.matmul(a, b, precision=_matmul_precision()),
-                 (x, y), name="bmm")
+    return apply(_bmm_raw, (x, y), name="bmm")
 
 
 def inner(x, y, name=None):
@@ -284,12 +378,12 @@ def inner(x, y, name=None):
 
 
 def outer(x, y, name=None):
-    return apply(lambda a, b: jnp.outer(a, b), (x, y), name="outer")
+    return apply(_outer_raw, (x, y), name="outer")
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
-                 (input, x, y), name="addmm")
+    return apply(_addmm_raw, (input, x, y),
+                 {"beta": float(beta), "alpha": float(alpha)}, name="addmm")
 
 
 def multiplex(inputs, index, name=None):
@@ -300,57 +394,87 @@ def multiplex(inputs, index, name=None):
     return Tensor(out)
 
 
+def _trace_raw(a, offset=0, axis1=0, axis2=1):
+    return jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def _diagonal_raw(a, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2)
+
+
+register_op("kron", jnp.kron)
+register_op("trace", _trace_raw)
+register_op("diagonal", _diagonal_raw)
+
+
 def kron(x, y, name=None):
     return apply(jnp.kron, (x, y), name="kron")
 
 
 def trace(x, offset=0, axis1=0, axis2=1, name=None):
-    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
-                 (x,), name="trace")
+    return apply(_trace_raw, (x,),
+                 {"offset": int(offset), "axis1": int(axis1),
+                  "axis2": int(axis2)}, name="trace")
 
 
 def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
-    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
-                 (x,), name="diagonal")
+    return apply(_diagonal_raw, (x,),
+                 {"offset": int(offset), "axis1": int(axis1),
+                  "axis2": int(axis2)}, name="diagonal")
 
 
 # ----------------------------------------------------------------- sort / topk
 
+def _topk_raw(a, k=1, axis=-1, largest=True):
+    ax = axis if axis is not None else -1
+    a_m = jnp.moveaxis(a, ax, -1)
+    vals, idxs = (lax.top_k(a_m, k) if largest else lax.top_k(-a_m, k))
+    if not largest:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax)
+    return vals, idxs.astype(convert_dtype("int64"))
+
+
+def _sort_raw(a, axis=-1, descending=False):
+    out = jnp.sort(a, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def _argsort_raw(a, axis=-1, descending=False):
+    out = jnp.argsort(a, axis=axis)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(convert_dtype("int64"))
+
+
+register_op("topk", _topk_raw)
+register_op("sort", _sort_raw)
+register_op("argsort", _argsort_raw)
+
+
 def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
     if isinstance(k, Tensor):
         k = int(k.item())
-
-    def f(a):
-        ax = axis if axis is not None else -1
-        a_m = jnp.moveaxis(a, ax, -1)
-        vals, idxs = (lax.top_k(a_m, k) if largest
-                      else lax.top_k(-a_m, k))
-        if not largest:
-            vals = -vals
-        vals = jnp.moveaxis(vals, -1, ax)
-        idxs = jnp.moveaxis(idxs, -1, ax)
-        return vals, idxs.astype(convert_dtype("int64"))
-
     # indices are non-diff; run whole thing diff'able for values path
-    vals, idxs = apply(f, (x,), name="topk")
+    vals, idxs = apply(_topk_raw, (x,),
+                       {"k": int(k),
+                        "axis": None if axis is None else int(axis),
+                        "largest": bool(largest)}, name="topk")
     idxs.stop_gradient = True
     return vals, idxs
 
 
 def sort(x, axis=-1, descending=False, name=None):
-    def f(a):
-        out = jnp.sort(a, axis=axis)
-        return jnp.flip(out, axis=axis) if descending else out
-    return apply(f, (x,), name="sort")
+    return apply(_sort_raw, (x,),
+                 {"axis": int(axis), "descending": bool(descending)},
+                 name="sort")
 
 
 def argsort(x, axis=-1, descending=False, name=None):
-    def f(a):
-        out = jnp.argsort(a, axis=axis)
-        if descending:
-            out = jnp.flip(out, axis=axis)
-        return out.astype(convert_dtype("int64"))
-    return apply(f, (x,), differentiable=False, name="argsort")
+    return apply(_argsort_raw, (x,),
+                 {"axis": int(axis), "descending": bool(descending)},
+                 differentiable=False, name="argsort")
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
@@ -365,17 +489,24 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
     return tuple(Tensor(r) for r in res)
 
 
+def _kthvalue_raw(a, k=1, axis=-1, keepdim=False):
+    s = jnp.sort(a, axis=axis)
+    idx = jnp.argsort(a, axis=axis)
+    vals = jnp.take(s, k - 1, axis=axis)
+    ind = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        ind = jnp.expand_dims(ind, axis)
+    return vals, ind.astype(convert_dtype("int64"))
+
+
+register_op("kthvalue", _kthvalue_raw)
+
+
 def kthvalue(x, k, axis=-1, keepdim=False, name=None):
-    def f(a):
-        s = jnp.sort(a, axis=axis)
-        idx = jnp.argsort(a, axis=axis)
-        vals = jnp.take(s, k - 1, axis=axis)
-        ind = jnp.take(idx, k - 1, axis=axis)
-        if keepdim:
-            vals = jnp.expand_dims(vals, axis)
-            ind = jnp.expand_dims(ind, axis)
-        return vals, ind.astype(convert_dtype("int64"))
-    vals, idxs = apply(f, (x,), name="kthvalue")
+    vals, idxs = apply(_kthvalue_raw, (x,),
+                       {"k": int(k), "axis": int(axis),
+                        "keepdim": bool(keepdim)}, name="kthvalue")
     idxs.stop_gradient = True
     return vals, idxs
 

@@ -2,9 +2,9 @@
 
 Forward: online-softmax tiled kernel (Pallas) — keeps the S x S score matrix
 out of HBM, streaming K/V blocks through VMEM with running (max, denom)
-rescaling. Backward: recompute-based XLA VJP (flash backward kernel is a
-later optimisation; recompute already avoids materialising S x S in HBM
-under XLA fusion).
+rescaling. Backward: Pallas flash kernels too (_bwd_dkv_kernel /
+_bwd_dq_kernel below) — two passes that recompute the block's scores in
+VMEM from the saved logsumexp, so dQ/dK/dV never materialise S x S in HBM.
 
 Layout [B, H, S, D]; D is padded to the 128-lane boundary inside the kernel
 wrapper when needed.
@@ -32,6 +32,18 @@ def _blk(pref, n):
     while b > 128 and n % b:
         b -= 128
     return max(b, 128)
+
+
+def _sds(shape, dtype, *arrs):
+    """ShapeDtypeStruct matching the varying-manual-axes (vma) of the
+    inputs: under a vma-checked shard_map (partial-manual hybrid meshes),
+    pallas_call outputs must declare how they vary across mesh axes."""
+    vma = frozenset()
+    for a in arrs:
+        vma |= getattr(jax.typeof(a), "vma", frozenset()) or frozenset()
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _pad_dim(d):
@@ -157,8 +169,8 @@ def _flash_fwd_pallas(q, k, v, causal, scale):
             pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d_pad), q.dtype),
-            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+            _sds((b * h, sq, d_pad), q.dtype, qr, kr, vr),
+            _sds((b * h, 1, sq), jnp.float32, qr, kr, vr),
         ],
         interpret=interpret,
     )(qr, kr, vr)
@@ -297,8 +309,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale):
             pl.BlockSpec((1, bk_, d_pad), lambda bh, j: (bh, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d_pad), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d_pad), v.dtype),
+            _sds((b * h, sk, d_pad), k.dtype, qr, kr, vr, dor),
+            _sds((b * h, sk, d_pad), v.dtype, qr, kr, vr, dor),
         ],
         interpret=interpret,
     )(qr, kr, vr, dor, lse, dd)
@@ -317,7 +329,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale):
             pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d_pad), q.dtype),
+        out_shape=_sds((b * h, sq, d_pad), q.dtype, qr, kr, vr, dor),
         interpret=interpret,
     )(qr, kr, vr, dor, lse, dd)
 
@@ -332,6 +344,17 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale):
 def _kernel_eligible(q, k, mask, dropout_p):
     if mask is not None or dropout_p:
         return False
+    if jax.default_backend() == "cpu":
+        # interpret-mode pallas cannot evaluate kernels whose inputs carry
+        # varying-manual-axes types (vma-checked hybrid shard_map): the
+        # HLO interpreter's block dynamic_slices mix invariant indices with
+        # varying operands. Real Mosaic lowering is unaffected; on CPU use
+        # the XLA softmax path for those call sites.
+        vma = frozenset()
+        for a in (q, k):
+            vma |= getattr(jax.typeof(a), "vma", frozenset()) or frozenset()
+        if vma:
+            return False
     sq, sk = q.shape[2], k.shape[2]
     return (sq % 128 == 0 and sk % 128 == 0
             and sq >= 128 and sk >= 128)

@@ -125,3 +125,133 @@ def sequence_first_step(x):
 @def_op("sequence_last_step", n_tensor_args=2)
 def sequence_last_step(x, lengths):
     return sequence_pool.raw(x, lengths, pool_type="last")
+
+
+@def_op("sequence_conv", n_tensor_args=3)
+def sequence_conv(x, lengths, filter, context_length=3, context_start=None):
+    """Context-window conv over the time axis (ref
+    sequence_ops/sequence_conv_op.cc): each step attends a window of
+    `context_length` steps starting at `context_start` (default centred),
+    zero-padded at sequence edges AND beyond each row's length. x: [B,T,D],
+    filter: [context_length*D, out]. Returns [B,T,out] (padding rows zero).
+
+    Dense formulation: shift-and-stack the window into [B,T,ctx*D] (an
+    unrolled im2col over time — ctx is tiny and static) then one MXU matmul."""
+    B, T, D = x.shape
+    start = (-((context_length - 1) // 2) if context_start is None
+             else context_start)
+    m = _mask(lengths, T, x.dtype)[..., None]                 # [B,T,1]
+    xm = x * m
+    cols = []
+    for k in range(context_length):
+        off = start + k
+        if off < 0:
+            shifted = jnp.pad(xm, ((0, 0), (-off, 0), (0, 0)))[:, :T]
+        elif off > 0:
+            shifted = jnp.pad(xm, ((0, 0), (0, off), (0, 0)))[:, off:]
+        else:
+            shifted = xm
+        cols.append(shifted)
+    window = jnp.concatenate(cols, axis=-1)                   # [B,T,ctx*D]
+    out = jnp.matmul(window, filter)                          # [B,T,out]
+    return out * m
+
+
+@def_op("sequence_slice", n_tensor_args=4)
+def sequence_slice(x, lengths, offset, length):
+    """Per-row slice [offset[i] : offset[i]+length[i]] (ref
+    sequence_ops/sequence_slice_op.cc). Static output T' = x.shape[1]; the
+    result is front-packed with new lengths = length (padding zeroed).
+    Returns (sliced [B,T,...], new_lengths [B])."""
+    T = x.shape[1]
+    t = jnp.arange(T)[None, :]                                # [1,T]
+    src = jnp.clip(offset[:, None] + t, 0, T - 1)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+    valid = (t < length[:, None])
+    out = out * valid.reshape(valid.shape + (1,) * (x.ndim - 2)).astype(x.dtype)
+    return out, length.astype(jnp.int32)
+
+
+@def_op("sequence_concat", n_tensor_args=4)
+def sequence_concat(x1, len1, x2, len2):
+    """Concatenate two batched sequences row-wise along time (ref
+    sequence_ops/sequence_concat_op.cc): row i = x1[i,:len1[i]] ++
+    x2[i,:len2[i]], front-packed into [B, T1+T2, ...] with zero padding.
+    Returns (concat, new_lengths). One scatter per input — no host loops."""
+    B, T1 = x1.shape[0], x1.shape[1]
+    T2 = x2.shape[1]
+    Tout = T1 + T2
+    tail = x1.shape[2:]
+    out = jnp.zeros((B, Tout) + tail, x1.dtype)
+    t1 = jnp.arange(T1)[None, :]
+    t2 = jnp.arange(T2)[None, :]
+    b1 = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T1))
+    b2 = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T2))
+    # invalid entries all collide on slot Tout-1 then get zeroed via lengths
+    pos1 = jnp.where(t1 < len1[:, None], t1, Tout - 1)
+    pos2 = jnp.where(t2 < len2[:, None], len1[:, None] + t2, Tout - 1)
+    m1 = (t1 < len1[:, None]).reshape((B, T1) + (1,) * len(tail))
+    m2 = (t2 < len2[:, None]).reshape((B, T2) + (1,) * len(tail))
+    out = out.at[b1, pos1].set(jnp.where(m1, x1, 0.0), mode="drop")
+    out = out.at[b2, pos2].add(jnp.where(m2, x2, 0.0), mode="drop")
+    new_len = (len1 + len2).astype(jnp.int32)
+    tt = jnp.arange(Tout)[None, :]
+    keep = (tt < new_len[:, None]).reshape((B, Tout) + (1,) * len(tail))
+    return jnp.where(keep, out, 0.0), new_len
+
+
+@def_op("sequence_erase", n_tensor_args=2, differentiable=False)
+def sequence_erase(x, lengths, tokens=()):
+    """Remove the given token ids from each row, front-packing survivors
+    (ref sequence_ops/sequence_erase_op.cc). x: [B,T] int ids. Returns
+    (erased [B,T] zero-padded, new_lengths [B]). Pure scatter: new position
+    of a surviving token is its prefix-count of survivors."""
+    B, T = x.shape
+    t = jnp.arange(T)[None, :]
+    valid = t < lengths[:, None]
+    keep = valid
+    for tok in tokens:
+        keep = keep & (x != tok)
+    new_pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1   # [B,T]
+    b = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    dest = jnp.where(keep, new_pos, T - 1)
+    out = jnp.zeros_like(x)
+    out = out.at[b, dest].max(jnp.where(keep, x, 0), mode="drop")
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    out = jnp.where(t < new_len[:, None], out, 0)
+    return out, new_len
+
+
+@def_op("sequence_enumerate", n_tensor_args=2, differentiable=False)
+def sequence_enumerate(x, lengths, win_size=2, pad_value=0):
+    """Sliding-window id enumeration (ref
+    sequence_ops/sequence_enumerate_op.cc): out[b,t,k] = x[b,t+k] while
+    t+k < length[b], else pad_value. x: [B,T] ids -> [B,T,win_size]."""
+    B, T = x.shape
+    t = jnp.arange(T)[:, None]                    # [T,1]
+    k = jnp.arange(win_size)[None, :]             # [1,win]
+    src = jnp.clip(t + k, 0, T - 1)               # [T,win]
+    gathered = x[:, src]                          # [B,T,win]
+    inb = (t + k)[None] < lengths[:, None, None]
+    return jnp.where(inb, gathered, pad_value)
+
+
+@def_op("sequence_topk_avg_pooling", n_tensor_args=2)
+def sequence_topk_avg_pooling(x, lengths, topks=(1,)):
+    """Average of the top-k values over each row's valid prefix, one output
+    channel per k (ref sequence_ops/sequence_topk_avg_pooling_op.cc,
+    simplified to the dense [B,T] case). Returns [B, len(topks)]."""
+    B, T = x.shape[0], x.shape[1]
+    m = _mask(lengths, T, x.dtype)
+    neg = jnp.finfo(x.dtype).min
+    masked = jnp.where(m > 0, x, neg)
+    srt = jnp.sort(masked, axis=1)[:, ::-1]       # desc
+    outs = []
+    for k in topks:
+        k = int(k)
+        kk = jnp.minimum(lengths, k).astype(x.dtype)   # rows shorter than k
+        s = jnp.sum(jnp.where(jnp.arange(T)[None, :] < kk[:, None],
+                              srt, 0.0), axis=1)
+        outs.append(s / jnp.maximum(kk, 1.0))
+    return jnp.stack(outs, axis=1)

@@ -207,59 +207,63 @@ class Conll05st(Dataset):
 # sequence-labeling zoo) — pure lax.scan dynamic program                      #
 # --------------------------------------------------------------------------- #
 
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.dispatch import apply, register_op
+
+
+def _viterbi_decode_raw(pot, trans, *maybe_lens):
+    lens = maybe_lens[0] if maybe_lens else None
+    B, T, N = pot.shape
+
+    def fwd(carry, xs):
+        score = carry                                # [B, N]
+        emit, t = xs
+        cand = score[:, :, None] + trans[None]       # [B, N, N]
+        best = jnp.max(cand, axis=1) + emit          # [B, N]
+        idx = jnp.argmax(cand, axis=1)               # [B, N]
+        if lens is not None:
+            # freeze finished rows: score unchanged, identity
+            # backpointers so the backtrace passes straight through
+            active = (t < lens)[:, None]             # [B, 1]
+            best = jnp.where(active, best, score)
+            ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
+            idx = jnp.where(active, idx, ident)
+        return best, idx
+
+    init = pot[:, 0]
+    ts = jnp.arange(1, T)
+    score, back = lax.scan(
+        fwd, init, (jnp.swapaxes(pot[:, 1:], 0, 1), ts))
+    last = jnp.argmax(score, axis=-1)                # [B]
+
+    def bwd(carry, idx_t):
+        cur = carry
+        prev = jnp.take_along_axis(idx_t, cur[:, None], 1)[:, 0]
+        return prev, cur
+
+    # reverse scan: ys[t] = state at time t+1, final carry = state at 0
+    first, tail = lax.scan(bwd, last, back, reverse=True)
+    paths = jnp.concatenate([first[:, None],
+                             jnp.swapaxes(tail, 0, 1)], axis=1)
+    if lens is not None:
+        paths = jnp.where(jnp.arange(T)[None, :] < lens[:, None],
+                          paths, 0)
+    return jnp.max(score, axis=-1), paths
+
+
+register_op("viterbi_decode", _viterbi_decode_raw)
+
+
 def viterbi_decode(potentials, transitions, lengths=None,
                    include_bos_eos_tag=False):
     """Batched Viterbi: potentials [B, T, N], transitions [N, N] ->
     (scores [B], paths [B, T]). lax.scan forward pass + backtrace."""
-    import jax.numpy as jnp
-    from jax import lax
-    from ..framework.tensor import Tensor
-    from ..ops.dispatch import apply
-
-    def _decode(pot, trans, lens):
-        B, T, N = pot.shape
-
-        def fwd(carry, xs):
-            score = carry                                # [B, N]
-            emit, t = xs
-            cand = score[:, :, None] + trans[None]       # [B, N, N]
-            best = jnp.max(cand, axis=1) + emit          # [B, N]
-            idx = jnp.argmax(cand, axis=1)               # [B, N]
-            if lens is not None:
-                # freeze finished rows: score unchanged, identity
-                # backpointers so the backtrace passes straight through
-                active = (t < lens)[:, None]             # [B, 1]
-                best = jnp.where(active, best, score)
-                ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
-                idx = jnp.where(active, idx, ident)
-            return best, idx
-
-        init = pot[:, 0]
-        ts = jnp.arange(1, T)
-        score, back = lax.scan(
-            fwd, init, (jnp.swapaxes(pot[:, 1:], 0, 1), ts))
-        last = jnp.argmax(score, axis=-1)                # [B]
-
-        def bwd(carry, idx_t):
-            cur = carry
-            prev = jnp.take_along_axis(idx_t, cur[:, None], 1)[:, 0]
-            return prev, cur
-
-        # reverse scan: ys[t] = state at time t+1, final carry = state at 0
-        first, tail = lax.scan(bwd, last, back, reverse=True)
-        paths = jnp.concatenate([first[:, None],
-                                 jnp.swapaxes(tail, 0, 1)], axis=1)
-        if lens is not None:
-            paths = jnp.where(jnp.arange(T)[None, :] < lens[:, None],
-                              paths, 0)
-        return jnp.max(score, axis=-1), paths
-
-    if lengths is None:
-        return apply(lambda p, t: _decode(p, t, None),
-                     (potentials, transitions), name="viterbi_decode",
-                     differentiable=False)
-    return apply(_decode, (potentials, transitions, lengths),
-                 name="viterbi_decode", differentiable=False)
+    args = ((potentials, transitions) if lengths is None
+            else (potentials, transitions, lengths))
+    return apply(_viterbi_decode_raw, args, name="viterbi_decode",
+                 differentiable=False)
 
 
 class ViterbiDecoder:

@@ -256,3 +256,184 @@ class TestForRange:
 
         with pytest.raises(ValueError, match="must not be zero"):
             bad(pt.to_tensor(np.array([1.0], "f4")))
+
+
+class TestBreakContinueReturn:
+    """ref dygraph_to_static/break_continue_transformer.py +
+    return_transformer.py: break/continue/return inside converted control
+    flow, lowered to loop-carried booleans — parity eager vs jit-traced."""
+
+    def _both(self, fn, *args):
+        """Convert fn, run on tensor args eagerly AND under jax.jit;
+        assert equal, return the value. Uses convert_function directly
+        (not to_static) so the jit wrap here is the ONLY trace layer."""
+        import jax
+        from paddle_tpu.jit.dy2static import convert_function
+
+        conv = convert_function(fn)
+        t_args = [pt.to_tensor(np.asarray(a, "f4")) for a in args]
+        eager = conv(*t_args)
+        eager = np.asarray(eager.numpy() if hasattr(eager, "numpy")
+                           else eager)
+
+        def raw(*xs):
+            out = conv(*[pt.Tensor(x) for x in xs])
+            return out._data if hasattr(out, "_data") else out
+
+        traced = np.asarray(jax.jit(raw)(
+            *[np.asarray(a, "f4") for a in args]))
+        np.testing.assert_allclose(eager, traced, rtol=1e-6)
+        return eager
+
+    def test_break_in_for(self):
+        def f(x, n):
+            s = x * 0.0
+            for i in range(10):
+                if i >= n:
+                    break
+                s = s + x * i
+            return s
+
+        assert self._both(f, 2.0, 3) == 2.0 * 3
+
+    def test_continue_in_for(self):
+        def f(x, n):
+            s = x * 0.0
+            for i in range(6):
+                if i == n:
+                    continue
+                s = s + x * i
+            return s
+
+        assert self._both(f, 2.0, 2) == 2.0 * (0 + 1 + 3 + 4 + 5)
+
+    def test_break_in_while(self):
+        def f(x, n):
+            s = x * 0.0
+            i = 0.0
+            while i < 100.0:
+                if i >= n:
+                    break
+                s = s + x
+                i = i + 1.0
+            return s
+
+        assert self._both(f, 2.0, 5.0) == 10.0
+
+    def test_early_return_in_loop(self):
+        def f(x, n):
+            for i in range(6):
+                if i == n:
+                    return x * i
+            return x * 0.0
+
+        assert self._both(f, 2.0, 4) == 8.0
+
+    def test_return_in_both_if_branches(self):
+        def f(x):
+            if (x > 0).all():
+                return x * 2.0
+            else:
+                return x * 3.0
+
+        assert self._both(f, 3.0) == 6.0
+        assert self._both(f, -3.0) == -9.0
+
+    def test_break_binds_to_inner_loop(self):
+        def f(x, n):
+            s = x * 0.0
+            for i in range(3):
+                for j in range(5):
+                    if j >= n:
+                        break
+                    s = s + x
+            return s
+
+        assert self._both(f, 2.0, 2.0) == 2.0 * 3 * 2
+
+    def test_fall_off_end_returns_none_eager(self):
+        def f(x):
+            for i in range(3):
+                if i > 5:
+                    return x
+
+        assert f(pt.to_tensor(np.array(1.0, "f4"))) is None
+
+    def test_to_static_end_to_end_break_return(self):
+        """Same patterns through the public pt.jit.to_static entry."""
+        @pt.jit.to_static
+        def f(x, n):
+            s = x * 0.0
+            for i in range(8):
+                if i >= n:
+                    break
+                s = s + x
+            return s
+
+        out = f(pt.to_tensor(np.array(2.0, "f4")), pt.to_tensor(3))
+        assert float(np.asarray(out.numpy())) == 6.0
+
+    def test_break_in_nonrange_for_stays_python(self):
+        """break in a python-iterable for must keep LITERAL break
+        semantics (the flag lowering has no exit hook for python loops)."""
+        from paddle_tpu.jit.dy2static import convert_function
+
+        def f(x):
+            s = x * 0.0
+            for v in [1.0, 2.0, 3.0]:
+                s = s + v
+                if (s > 2.5).all():
+                    break
+            return s
+
+        out = convert_function(f)(pt.to_tensor(np.array(0.0, "f4")))
+        assert float(np.asarray(out.numpy())) == 3.0  # 1+2, stops before +3
+
+    def test_return_under_try_stays_python(self):
+        """a return nested under try/with must not be converted into a
+        discarded branch-closure return (pre-pass bails, if stays python)."""
+        @pt.jit.to_static
+        def f(x):
+            try:
+                if (x > 0).all():
+                    return x * 2.0
+            finally:
+                pass
+            return x * 3.0
+
+        got = f(pt.to_tensor(np.array(5.0, "f4")))
+        assert float(np.asarray(got.numpy())) == 10.0
+        got = f(pt.to_tensor(np.array(-5.0, "f4")))
+        assert float(np.asarray(got.numpy())) == -15.0
+
+    def test_return_in_nonrange_for_stays_python(self):
+        @pt.jit.to_static
+        def f(x):
+            for v in [1.0, 2.0, 3.0]:
+                if v > 1.5:
+                    return x * v
+            return x
+
+        got = f(pt.to_tensor(np.array(4.0, "f4")))
+        assert float(np.asarray(got.numpy())) == 8.0
+
+    def test_break_unconsumed_when_outer_loop_stays_python(self):
+        """reviewer repro: a range-for that ultimately stays python (nested
+        non-range loop keeps a literal continue) must keep its literal
+        break too — flag-lowering it would disable the early exit."""
+        from paddle_tpu.jit.dy2static import convert_function
+
+        def f(x):
+            acc = x * 0.0
+            for i in range(5):
+                acc = acc + 1.0
+                if i == 2:
+                    break
+                for item in [1, 2]:
+                    if item == 1:
+                        continue
+                    acc = acc + 0.0
+            return acc
+
+        out = convert_function(f)(pt.to_tensor(np.array(0.0, "f4")))
+        assert float(np.asarray(out.numpy())) == 3.0
