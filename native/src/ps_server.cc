@@ -51,6 +51,7 @@ enum Op : uint8_t {
   HEARTBEAT = 12,        // aux = worker id; refresh liveness
   COMPLETE = 13,         // aux = worker id; worker -> COMPLETED (clean exit)
   QUERY_ALIVE = 14,      // reply: u32 running, u32 completed, u32 dead
+  SET_SPARSE = 15,       // overwrite sparse rows (heter cache write-back)
 };
 
 // worker lifecycle (ref operators/distributed/heart_beat_monitor.h:51
@@ -303,6 +304,23 @@ class PsServer {
           std::vector<float>& row = t->Row(ids[i]);
           for (int d = 0; d < t->dim; ++d)
             row[d] -= t->lr * grads[i * t->dim + d];
+        }
+        uint8_t ok = 1;
+        return Reply(fd, &ok, 1);
+      }
+      case SET_SPARSE: {
+        // absolute write-back (heter device-cache eviction / ckpt load):
+        // the worker's cached copy is authoritative while a row is cached
+        SparseTable* t = Sparse(table);
+        std::vector<int64_t> ids(count);
+        if (!ReadN(fd, ids.data(), count * 8) || !t) return false;
+        std::vector<float> vals(count * t->dim);
+        if (!ReadN(fd, vals.data(), vals.size() * 4)) return false;
+        for (uint64_t i = 0; i < count; ++i) {
+          SparseShard& sh = t->shard(ids[i]);
+          std::lock_guard<std::mutex> lk(sh.mu);
+          std::vector<float>& row = t->Row(ids[i]);
+          std::memcpy(row.data(), &vals[i * t->dim], t->dim * 4);
         }
         uint8_t ok = 1;
         return Reply(fd, &ok, 1);
@@ -585,6 +603,7 @@ class PsClient {
       case PULL_DENSE:
       case PULL_SPARSE:
       case SET_DENSE:
+      case SET_SPARSE:   // absolute overwrite: retry-safe
       case QUERY_ALIVE:
       case REGISTER:
       case HEARTBEAT:
@@ -740,6 +759,19 @@ int pt_ps_push_sparse_grad(void* h, uint32_t table, const int64_t* ids,
               static_cast<size_t>(n) * dim * 4);
   if (!static_cast<ptps::PsClient*>(h)->Request(ptps::PUSH_SPARSE_GRAD, table,
                                                 n, 0, payload.data(),
+                                                payload.size(), &g_resp))
+    return -1;
+  return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+}
+
+int pt_ps_set_sparse(void* h, uint32_t table, const int64_t* ids, int64_t n,
+                     const float* vals, int dim) {
+  std::vector<char> payload(n * 8 + static_cast<size_t>(n) * dim * 4);
+  std::memcpy(payload.data(), ids, n * 8);
+  std::memcpy(payload.data() + n * 8, vals,
+              static_cast<size_t>(n) * dim * 4);
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::SET_SPARSE, table, n, 0,
+                                                payload.data(),
                                                 payload.size(), &g_resp))
     return -1;
   return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;

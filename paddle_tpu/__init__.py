@@ -20,6 +20,7 @@ from .framework import (  # noqa: F401
 )
 
 from . import framework
+from .framework import errors  # noqa: F401  (paddle.errors taxonomy)
 from . import ops
 from .ops.creation import (  # noqa: F401
     zeros, ones, full, empty, zeros_like, ones_like, full_like, empty_like,

@@ -28,6 +28,113 @@ def _bucket(n, lo=64):
     return b
 
 
+class HotRowCache:
+    """Device-resident write-back cache of hot sparse rows (ref
+    fleet/heter_ps/hashtable.h + heter_comm.h — the PSGPU device cache,
+    redesigned for TPU: the id->slot hash/LRU CONTROL plane stays on the
+    host, only the row DATA plane [capacity, dim] lives in HBM, indexed
+    by static-shape gathers inside the compiled step).
+
+    While a row is cached the device copy is AUTHORITATIVE: its update
+    (SGD at the TRAINER's sparse_lr, the same rule the server applies on
+    PUSH_SPARSE_GRAD — the update itself lives in the trainer's compiled
+    step, not here; this class is the pure control+storage plane).
+    Eviction (LRU) writes absolute rows back via the native SET_SPARSE
+    command. Repeated-key batches therefore cost ZERO host round-trips."""
+
+    def __init__(self, client, table_id, dim, capacity):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        self.capacity = capacity
+        self.rows = jnp.zeros((capacity, dim), jnp.float32)
+        # vectorized control plane: sorted cached ids + aligned slots
+        # (np.searchsorted membership), LRU as a per-slot stamp array —
+        # steady-state cost is O(U log N) numpy, no per-id python loops
+        self._ids = np.empty(0, np.int64)        # sorted cached ids
+        self._slots = np.empty(0, np.int32)      # slot of self._ids[i]
+        self._stamp = np.zeros(capacity, np.int64)
+        self._tick = 0
+        self.free = list(range(capacity))
+        self.pull_rpcs = 0
+        self.push_rpcs = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _lookup(self, uids):
+        """(found mask, slot array — valid where found)."""
+        if not len(self._ids):
+            return (np.zeros(len(uids), bool),
+                    np.full(len(uids), -1, np.int32))
+        pos = np.searchsorted(self._ids, uids)
+        pos_c = np.minimum(pos, len(self._ids) - 1)
+        found = self._ids[pos_c] == uids
+        slots = np.where(found, self._slots[pos_c], -1).astype(np.int32)
+        return found, slots
+
+    def ensure(self, uids):
+        """Make every id in `uids` cached; returns their slot array.
+        Misses are pulled in ONE rpc; evictions written back in ONE rpc."""
+        uids = np.asarray(uids, np.int64)
+        self._tick += 1
+        found, slots = self._lookup(uids)
+        n_miss_pos = int((~found).sum())
+        self.hits += int(found.sum())
+        if n_miss_pos:
+            miss = np.unique(uids[~found])
+            self.misses += len(miss)
+            needed = len(miss) - len(self.free)
+            if needed > 0:
+                # evict the stalest slots not referenced by this batch
+                batch_slots = set(int(s) for s in slots[found])
+                order = np.argsort(self._stamp[self._slots])
+                victims_idx = [int(i) for i in order
+                               if int(self._slots[i]) not in batch_slots]
+                if len(victims_idx) < needed:
+                    raise RuntimeError(
+                        f"HotRowCache: working set {len(uids)} exceeds "
+                        f"capacity {self.capacity}")
+                victims_idx = np.asarray(victims_idx[:needed])
+                vids = self._ids[victims_idx]
+                vslots = self._slots[victims_idx]
+                self.client.set_sparse(
+                    self.table_id, vids,
+                    np.asarray(self.rows[jnp.asarray(vslots)]))
+                self.push_rpcs += 1
+                self.evictions += len(victims_idx)
+                self.free.extend(int(s) for s in vslots)
+                keep = np.ones(len(self._ids), bool)
+                keep[victims_idx] = False
+                self._ids = self._ids[keep]
+                self._slots = self._slots[keep]
+            pulled = self.client.pull_sparse(self.table_id, miss, self.dim)
+            self.pull_rpcs += 1
+            mslots = np.array([self.free.pop() for _ in miss], np.int32)
+            self.rows = self.rows.at[jnp.asarray(mslots)].set(
+                jnp.asarray(np.asarray(pulled, np.float32)))
+            order = np.argsort(np.concatenate([self._ids, miss]))
+            self._ids = np.concatenate([self._ids, miss])[order]
+            self._slots = np.concatenate([self._slots, mslots])[order]
+            _, slots = self._lookup(uids)
+        self._stamp[slots] = self._tick
+        return slots
+
+    def flush(self):
+        """Write ALL cached rows back (checkpoint/shutdown)."""
+        if not len(self._ids):
+            return
+        self.client.set_sparse(
+            self.table_id, self._ids,
+            np.asarray(self.rows[jnp.asarray(self._slots)]))
+        self.push_rpcs += 1
+
+    def stats(self):
+        return {"pull_rpcs": self.pull_rpcs, "push_rpcs": self.push_rpcs,
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions}
+
+
 class HeterPSTrainer:
     """Device-resident dense tower + host-PS sparse embeddings.
 
@@ -39,7 +146,8 @@ class HeterPSTrainer:
     """
 
     def __init__(self, loss_fn, dense_params, optimizer, client,
-                 sparse_table=1, emb_dim=8, donate=True):
+                 sparse_table=1, emb_dim=8, donate=True, cache_capacity=0,
+                 sparse_lr=0.1):
         self.client = client
         self.sparse_table = sparse_table
         self.emb_dim = emb_dim
@@ -49,6 +157,9 @@ class HeterPSTrainer:
         self.opt_state = optimizer.init_opt_state(self.params)
         self._step_i = 0
         apply_fn = optimizer.apply_gradients_fn()
+        self.cache = (HotRowCache(client, sparse_table, emb_dim,
+                                  cache_capacity)
+                      if cache_capacity else None)
 
         def _step(params, opt_state, urows, inv, lr, step_i, *batch):
             loss, (gp, grows) = jax.value_and_grad(
@@ -60,9 +171,27 @@ class HeterPSTrainer:
         donate_args = (0, 1) if donate else ()
         self._compiled = jax.jit(_step, donate_argnums=donate_args)
 
+        def _step_cached(params, opt_state, cache_rows, slots, inv, lr,
+                         step_i, *batch):
+            # gather from the HBM-resident cache; the sparse SGD update
+            # (same rule the server applies) runs on-device — no RPCs
+            def f(p, rows):
+                return loss_fn(p, rows[slots], inv, *batch)
+            loss, (gp, grows_full) = jax.value_and_grad(
+                f, argnums=(0, 1))(params, cache_rows)
+            new_params, new_opt = apply_fn(params, gp, opt_state, lr, step_i)
+            new_rows = cache_rows - jnp.asarray(sparse_lr, jnp.float32) \
+                * grows_full
+            return loss, new_params, new_opt, new_rows
+
+        donate_c = (0, 1, 2) if donate else ()
+        self._compiled_cached = jax.jit(_step_cached,
+                                        donate_argnums=donate_c)
+
     def step(self, ids, *batch):
         """One heter step. `ids` is any int array of embedding ids for the
-        batch; `urows[inv]` has one row per flattened id position."""
+        batch; `urows[inv]` has one row per flattened id position. With a
+        HotRowCache, repeated-key batches skip the host PS entirely."""
         c = self.client
         ids = np.asarray(ids).ravel()
         if ids.size == 0:
@@ -72,10 +201,22 @@ class HeterPSTrainer:
         pad = b - len(uids)
         uids_p = np.concatenate([uids, np.full(pad, uids[0], uids.dtype)]) \
             if pad else uids
-        urows = c.pull_sparse(self.sparse_table, uids_p, self.emb_dim)
-        urows = np.asarray(urows, np.float32).reshape(b, self.emb_dim)
         self._step_i += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+
+        if self.cache is not None:
+            # padded duplicate slots get zero grad through the gather VJP
+            # (inv never references the pad), so the scatter-add is exact
+            slots = self.cache.ensure(uids_p)
+            loss, self.params, self.opt_state, self.cache.rows = \
+                self._compiled_cached(
+                    self.params, self.opt_state, self.cache.rows,
+                    jnp.asarray(slots), jnp.asarray(inv.astype(np.int32)),
+                    lr, jnp.asarray(self._step_i, jnp.int32), *batch)
+            return float(loss)
+
+        urows = c.pull_sparse(self.sparse_table, uids_p, self.emb_dim)
+        urows = np.asarray(urows, np.float32).reshape(b, self.emb_dim)
         loss, self.params, self.opt_state, grows = self._compiled(
             self.params, self.opt_state, jnp.asarray(urows),
             jnp.asarray(inv.astype(np.int32)), lr,

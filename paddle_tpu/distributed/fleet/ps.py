@@ -128,6 +128,15 @@ class PsClient:
             self._h, table_id, _iptr(ids), ids.size, _fptr(grads),
             grads.shape[1]), "push_sparse_grad")
 
+    def set_sparse(self, table_id, ids, values):
+        """Absolute row overwrite (heter cache write-back, ckpt load)."""
+        ids = np.ascontiguousarray(ids, np.int64)
+        values = np.ascontiguousarray(values, np.float32)
+        assert values.shape[0] == ids.size
+        self._check(self._lib.pt_ps_set_sparse(
+            self._h, table_id, _iptr(ids), ids.size, _fptr(values),
+            values.shape[1]), "set_sparse")
+
     def barrier(self, world_size, worker_id=None):
         """True = clean release; False = released degraded (the server's
         heartbeat monitor evicted dead workers from the cohort instead of

@@ -66,6 +66,56 @@ def enforce(condition, message="", error_cls=PreconditionNotMetError):
         raise error_cls(message)
 
 
+def _short_spec(a):
+    dt = getattr(a, "dtype", None)
+    sh = getattr(a, "shape", None)
+    if dt is None or sh is None:
+        return type(a).__name__
+    return f"{dt}[{','.join(str(s) for s in sh)}]"
+
+
+def attach_op_context(exc, op_name, arrays=(), attrs=None, callstack=None):
+    """ref framework/op_call_stack.cc InsertCallStackInfo + enforce.h's
+    "Error Message Summary": append the failing operator's name, input
+    specs, attrs, and (for desc replay) the python call stack recorded at
+    op-creation time to the exception message IN PLACE — the type is
+    preserved so existing `except ValueError` handlers keep working."""
+    lines = [f"  [operator < {op_name} > error]"]
+    if arrays:
+        lines.append("  [inputs: "
+                     + ", ".join(_short_spec(a) for a in arrays) + "]")
+    if attrs:
+        shown = {k: v for k, v in attrs.items() if not k.startswith("__")}
+        if shown:
+            lines.append(f"  [attrs: {shown}]")
+    if callstack:
+        lines.append("  [python call stack (op creation)]:")
+        lines += [f"    {fr}" for fr in callstack]
+    ctx = "\n".join(lines)
+    msg = str(exc.args[0]) if exc.args else ""
+    try:
+        exc.args = (f"{msg}\n{ctx}",) + tuple(exc.args[1:])
+    except Exception:
+        pass        # exotic exception with immutable args: keep original
+    return exc
+
+
+def user_callstack(limit=5):
+    """Non-framework frames of the current python stack, innermost last
+    (the reference records these at op-definition time for static graphs
+    so runtime failures point at model code, not executor internals)."""
+    import traceback
+    import os
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = []
+    for fr in traceback.extract_stack()[:-1]:
+        if fr.filename.startswith(pkg):
+            continue
+        out.append(f"{fr.filename}:{fr.lineno} in {fr.name}: "
+                   f"{(fr.line or '').strip()}")
+    return out[-limit:]
+
+
 def enforce_eq(a, b, message="", error_cls=InvalidArgumentError):
     if a != b:
         raise error_cls(f"expected {a!r} == {b!r}. {message}")

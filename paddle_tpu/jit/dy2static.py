@@ -169,10 +169,12 @@ def convert_ifelse(pred, true_fn, false_fn, get, reset):
     return tuple(final)
 
 
-def convert_while(cond_fn, body_fn, get, reset):
+def convert_while(cond_fn, body_fn, get, reset, names=None):
     """Emitted for `while`: concrete → python loop; traced condition or
     loop vars → lax.while_loop over the dynamic subset of captured vars
-    (static vars are loop-invariant closure constants).
+    (static vars are loop-invariant closure constants). `names` is the
+    captured-variable name tuple (diagnostics + the generated-local
+    exemption below).
 
     The python loop re-checks tracedness EVERY iteration and escapes to the
     lax path mid-loop from the current state: a loop can start fully
@@ -182,13 +184,13 @@ def convert_while(cond_fn, body_fn, get, reset):
         c = _unwrap(cond_fn())
         cur = get() if get is not None else ()
         if _is_traced(c) or _any_traced(cur):
-            return _lax_while(cond_fn, body_fn, get, reset, cur)
+            return _lax_while(cond_fn, body_fn, get, reset, cur, names)
         if not bool(c):
             return cur
         body_fn()
 
 
-def _lax_while(cond_fn, body_fn, get, reset, orig):
+def _lax_while(cond_fn, body_fn, get, reset, orig, names=None):
     dyn_idx = _split_dynamic(orig)
 
     def put(carry):
@@ -212,10 +214,13 @@ def _lax_while(cond_fn, body_fn, get, reset, orig):
                     and not isinstance(orig[i], _Undef):
                 # a var that WAS undefined at loop entry is a loop-LOCAL
                 # (written fresh every iteration — nested-loop counters,
-                # break flags, if-cluster helpers); it needs no carry slot.
+                # break flags, cluster helpers); it needs no carry slot
+                # and is POISONED after the loop (see _LoopLocal below).
                 # Only a real pre-loop static turning traced is an error.
+                nm = names[i] if names and i < len(names) else None
+                what = f"variable {nm!r}" if nm else "a variable"
                 raise ValueError(
-                    "dy2static: a variable becomes a tensor inside a traced "
+                    f"dy2static: {what} becomes a tensor inside a traced "
                     "`while` body — initialize it as a tensor before the "
                     "loop (XLA loop carries need a fixed structure)")
         new = []
@@ -230,10 +235,44 @@ def _lax_while(cond_fn, body_fn, get, reset, orig):
     final = list(orig)
     for j, i in enumerate(dyn_idx):
         final[i] = Tensor(res[j]) if isinstance(orig[i], Tensor) else res[j]
-    # loop-locals (UNDEF at entry) stay UNDEF after the loop: their traced
-    # per-iteration values cannot escape the while_loop scope
+    # loop-locals (UNDEF at entry, no carry slot): their per-iteration
+    # values cannot escape the while_loop scope — poison them so a
+    # post-loop READ fails with the variable's name instead of silently
+    # propagating a sentinel
+    for i, v in enumerate(final):
+        if isinstance(v, _Undef):
+            final[i] = _LoopLocal(names[i] if names and i < len(names)
+                                  else None)
     reset(tuple(final))
     return tuple(final)
+
+
+class _LoopLocal:
+    """Post-loop value of a variable first assigned INSIDE a traced loop:
+    lax.while_loop scopes its carry, so the value cannot escape. Any use
+    raises with the variable's name; never using it (generated counters,
+    flags, inner-loop targets) is fine."""
+
+    def __init__(self, name):
+        object.__setattr__(self, "_pt_name", name or "<unknown>")
+
+    def _pt_die(self, *a, **k):
+        raise ValueError(
+            f"dy2static: variable {self._pt_name!r} was first assigned "
+            "inside a traced loop; its value does not escape the "
+            "lax.while_loop — initialize it before the loop to read it "
+            "afterwards")
+
+    def __getattr__(self, name):
+        self._pt_die()
+
+    def __repr__(self):
+        return f"<loop-local {self._pt_name!r}>"
+
+    __bool__ = __call__ = __iter__ = __len__ = __getitem__ = _pt_die
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _pt_die
+    __truediv__ = __rtruediv__ = __eq__ = __lt__ = __gt__ = _pt_die
+    __float__ = __int__ = __index__ = _pt_die
 
 
 def check_step(step):
@@ -817,8 +856,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                       ast.parse(body_def).body[0]]
         get = f"__pt_get_{n}" if vars_ else "None"
         reset = f"__pt_reset_{n}" if vars_ else "None"
+        names_lit = ("(" + ", ".join(repr(v) for v in vars_) + ",)"
+                     if vars_ else "None")
         call = (f"_jst.convert_while(__pt_cond_{n}, __pt_body_{n}, "
-                f"{get}, {reset})")
+                f"{get}, {reset}, names={names_lit})")
         return self._emit_cluster(n, vars_, defs, call)
 
 

@@ -214,11 +214,9 @@ def _gumbel_softmax_raw(a, key, temperature=1.0, hard=False, axis=-1):
         jax.random.uniform(key, tuple(a.shape)) + 1e-20))
     y = jax.nn.softmax((a + g) / temperature, axis=axis)
     if hard:
-        idx = jnp.argmax(y, axis=axis, keepdims=True)
-        onehot = jnp.zeros_like(y)
-        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis) \
-            if hasattr(jnp, "put_along_axis") else \
-            jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis)
+        # straight-through: one-hot forward, soft gradient
+        idx = jnp.argmax(y, axis=axis)
+        onehot = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
         y = onehot + y - lax.stop_gradient(y)
     return y
 

@@ -131,8 +131,22 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
     check = state.get_flag("FLAGS_check_nan_inf")
     rec = None if state.is_functional_mode() else state.get_static_recorder()
 
+    def call(g, *xs):
+        """Run the impl; on failure attach op name/inputs/attrs to the
+        exception IN PLACE (type preserved) — the eager analog of ref
+        framework/op_call_stack.cc (python tracebacks already carry the
+        call stack; this adds the operator-level summary)."""
+        try:
+            return g(*xs)
+        except Exception as e:
+            if not getattr(e, "_pt_op_ctx", False):
+                from ..framework.errors import attach_op_context
+                attach_op_context(e, name, xs, attrs)
+                e._pt_op_ctx = True
+            raise
+
     if state.is_functional_mode() or not state.is_grad_enabled():
-        outs = f(*arrays)
+        outs = call(f, *arrays)
         multi = isinstance(outs, (tuple, list))
         if check:
             _check_nan_inf(name, tuple(outs) if multi else (outs,))
@@ -147,7 +161,7 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
 
     needs_grad = differentiable and any(_requires_grad(t) for t in tensors)
     if not needs_grad:
-        outs = f(*arrays)
+        outs = call(f, *arrays)
         multi = isinstance(outs, (tuple, list))
         if check:
             _check_nan_inf(name, tuple(outs) if multi else (outs,))
@@ -157,7 +171,7 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
                           differentiable)
         return wrapped
 
-    outs, vjp_fn = jax.vjp(f, *arrays)
+    outs, vjp_fn = call(lambda *xs: jax.vjp(f, *xs), *arrays)
     if check:
         _check_nan_inf(name, tuple(outs) if isinstance(outs, (tuple, list))
                        else (outs,))

@@ -542,8 +542,10 @@ def masked_fill(x, mask, value, name=None):
 
 
 def _fill_diagonal_raw(a, value=0.0, offset=0):
-    eye = jnp.eye(a.shape[0], a.shape[1], k=offset, dtype=bool) \
-        if a.ndim == 2 else None
+    if a.ndim != 2:
+        raise ValueError(
+            f"fill_diagonal: only 2-D tensors supported, got ndim={a.ndim}")
+    eye = jnp.eye(a.shape[0], a.shape[1], k=offset, dtype=bool)
     return jnp.where(eye, jnp.asarray(value, a.dtype), a)
 
 
@@ -551,6 +553,10 @@ register_op("fill_diagonal", _fill_diagonal_raw)
 
 
 def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    if wrap:
+        raise NotImplementedError(
+            "fill_diagonal: wrap=True (tall-matrix diagonal wrapping) is "
+            "not supported")
     return apply(_fill_diagonal_raw, (x,),
                  {"value": float(value), "offset": int(offset)},
                  name="fill_diagonal")

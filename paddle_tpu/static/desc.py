@@ -325,7 +325,17 @@ def run_desc(desc, env):
             # by convention) is replaced with fold_in(run key, op salt) so
             # every Executor.run draws fresh randomness
             args[1] = jax.random.fold_in(env[RNG_VAR], salt)
-        out = f(*args)
+        try:
+            out = f(*args)
+        except Exception as e:
+            # ref op_call_stack.cc: replayed-desc failures report the op
+            # AND the model-code frames recorded at op-definition time
+            if not getattr(e, "_pt_op_ctx", False):
+                from ..framework.errors import attach_op_context
+                attach_op_context(e, op.type, args, op.attrs,
+                                  callstack=op.attrs.get("__callstack__"))
+                e._pt_op_ctx = True
+            raise
         if isinstance(out, (tuple, list)):
             for name, o in zip(op.outputs, out):
                 if name:

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework import state
+from ..framework import errors as errors_mod
 from ..framework.tensor import Tensor, Parameter
 from ..framework.dtype import convert_dtype
 from . import desc as D
@@ -148,6 +149,12 @@ class StaticRecorder:
             # rng-consuming op: assign its per-program salt here so the
             # Executor re-derives the key input each run (desc.py run_desc)
             attrs = dict(attrs, __rng__=self.rng_input())
+        # user-code frames at op-DEFINITION time (ref op_call_stack.cc:
+        # static-graph runtime failures must point at model code, not the
+        # executor); JSON-able, stripped from impl kwargs by resolve_impl
+        cs = errors_mod.user_callstack()
+        if cs:
+            attrs = dict(attrs, __callstack__=cs)
         self.program.desc.add_op(D.OpDesc(
             name, in_names, out_names, attrs,
             differentiable=differentiable, _fn=bound_fn, _raw=raw_fn))

@@ -109,6 +109,9 @@ def _configure(lib):
     lib.pt_ps_pull_sparse.argtypes = [c.c_void_p, c.c_uint32, i64p, c.c_int64,
                                       f32p, c.c_int]
     lib.pt_ps_pull_sparse.restype = c.c_int
+    lib.pt_ps_set_sparse.argtypes = [c.c_void_p, c.c_uint32, i64p,
+                                     c.c_int64, f32p, c.c_int]
+    lib.pt_ps_set_sparse.restype = c.c_int
     lib.pt_ps_push_sparse_grad.argtypes = [c.c_void_p, c.c_uint32, i64p,
                                            c.c_int64, f32p, c.c_int]
     lib.pt_ps_push_sparse_grad.restype = c.c_int

@@ -1,8 +1,16 @@
-"""One-off: sweep batch sizes for the bench GPT config on the real chip.
+"""Throughput sweep over the BASELINE model families on the real chip.
+
+    python scripts/bench_sweep.py gpt 8 16        # GPT-2 small, batches 8,16
+    python scripts/bench_sweep.py gpt2m 2 4       # GPT-2 medium
+    python scripts/bench_sweep.py resnet 64 128   # ResNet-50 bf16 (imgs/s)
+    python scripts/bench_sweep.py bert 16 32      # BERT-base MLM+NSP
+    python scripts/bench_sweep.py all             # default batch per family
 
 Measures steady-state step time (after warmup absorbing compile + the
-one-time relayout step) for several batch sizes, with the persistent
-compilation cache enabled so re-runs are cheap.
+one-time relayout step) with the persistent compilation cache enabled so
+re-runs are cheap. Prints ms/step, samples-or-tokens/s, model TFLOP/s and
+MFU against the v5e bf16 peak (BASELINE.md configs[1..3]; ref has no
+published numbers — these rows ARE the measurement record).
 """
 import os
 import time
@@ -20,8 +28,6 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import paddle_tpu as pt
-from paddle_tpu.nlp import GPTConfig, GPTForPretraining
-from paddle_tpu.nlp.gpt import gpt_pretrain_loss
 from paddle_tpu.jit import TrainStep
 
 t0 = time.time()
@@ -31,34 +37,132 @@ def log(m):
     print(f"[{time.time()-t0:7.1f}s] {m}", flush=True)
 
 
-cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
-                num_heads=12, max_seq_len=1024, dropout=0.0,
-                attn_dropout=0.0)
-seq = 1024
-
-for batch in [int(a) for a in sys.argv[1:]] or [8, 16, 32]:
-    pt.seed(0)
-    model = GPTForPretraining(cfg)
-    model.to(dtype=jnp.bfloat16)
-    opt = pt.optimizer.AdamW(learning_rate=1e-4,
-                             parameters=model.parameters())
-    step = TrainStep(model, gpt_pretrain_loss, opt, donate=True)
-    rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32")
-    for i in range(3):
+def _measure(step, inputs, labels, tag, per_step_samples, flops_per_step,
+             unit):
+    warm = int(os.environ.get("BENCH_WARM", 3))
+    for i in range(warm):
         t1 = time.time()
-        loss = step(ids, ids)
+        loss = step(inputs, labels)
         v = float(loss.numpy())
-        log(f"b={batch} warm {i}: {time.time()-t1:.3f}s loss={v:.4f}")
-    iters = 20
+        log(f"{tag} warm {i}: {time.time()-t1:.3f}s loss={v:.4f}")
+    iters = int(os.environ.get("BENCH_ITERS", 20))
     t1 = time.time()
     for _ in range(iters):
-        loss = step(ids, ids)
+        loss = step(inputs, labels)
     float(loss.numpy())
     dt = (time.time() - t1) / iters
-    toks = batch * seq / dt
-    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
-    tf = toks * 6 * n_params / 1e12
-    log(f"b={batch}: {dt*1e3:.1f} ms/step  {toks:,.0f} tok/s  "
+    rate = per_step_samples / dt
+    tf = flops_per_step / dt / 1e12
+    log(f"{tag}: {dt*1e3:.1f} ms/step  {rate:,.0f} {unit}  "
         f"{tf:.1f} TF/s  MFU={tf/PEAK_TFLOPS:.3f}")
-    del step, model, opt
+
+
+def sweep_gpt(batches, medium=False):
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+    from paddle_tpu.nlp.gpt import gpt_pretrain_loss
+    if medium:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
+                        num_heads=16, max_seq_len=1024, dropout=0.0,
+                        attn_dropout=0.0)
+        name = "gpt2-medium"
+    else:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024, dropout=0.0,
+                        attn_dropout=0.0)
+        name = "gpt2-small"
+    seq = 1024
+    for batch in batches:
+        pt.seed(0)
+        model = GPTForPretraining(cfg)
+        model.to(dtype=jnp.bfloat16)
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+        step = TrainStep(model, gpt_pretrain_loss, opt, donate=True)
+        ids = np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (batch, seq)).astype("int32")
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        flops = 6 * n_params * batch * seq      # dense transformer train
+        _measure(step, ids, ids, f"{name} b={batch}", batch * seq, flops,
+                 "tok/s")
+        del step, model, opt
+
+
+def sweep_resnet(batches):
+    """ResNet-50 bf16 train (BASELINE configs[1]: static graph + AMP).
+    FLOPs: 4.09 GFLOP forward per 224x224 image (standard resnet50 count);
+    train ~= 3x forward (bwd ~2x fwd for convs)."""
+    from paddle_tpu.vision.models import resnet50
+    import paddle_tpu.nn.functional as F
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits, labels)
+
+    FWD_GFLOPS = 4.09
+    for batch in batches:
+        pt.seed(0)
+        model = resnet50()
+        model.to(dtype=jnp.bfloat16)
+        opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+        step = TrainStep(model, loss_fn, opt, donate=True)
+        rng = np.random.RandomState(0)
+        imgs = jnp.asarray(rng.randn(batch, 3, 224, 224),
+                           jnp.bfloat16)
+        labels = jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32)
+        flops = 3 * FWD_GFLOPS * 1e9 * batch
+        _measure(step, imgs, labels, f"resnet50 b={batch}", batch, flops,
+                 "imgs/s")
+        del step, model, opt
+
+
+def sweep_bert(batches, seq=512):
+    """BERT-base MLM+NSP pretrain step (BASELINE configs[2])."""
+    from paddle_tpu.nlp.bert import (BertForPretraining, bert_base,
+                                     bert_pretrain_loss)
+    cfg = bert_base(max_seq_len=seq, dropout=0.0, attn_dropout=0.0)
+    for batch in batches:
+        pt.seed(0)
+        model = BertForPretraining(cfg)
+        model.to(dtype=jnp.bfloat16)
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+        step = TrainStep(model, bert_pretrain_loss, opt, donate=True)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32")
+        mlm = np.where(rng.rand(batch, seq) < 0.15,
+                       rng.randint(0, cfg.vocab_size, (batch, seq)),
+                       -100).astype("int64")
+        nsp = rng.randint(0, 2, (batch,)).astype("int64")
+        n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+        flops = 6 * n_params * batch * seq
+        _measure(step, (ids,), (mlm, nsp), f"bert-base s={seq} b={batch}",
+                 batch, flops, "samples/s")
+        del step, model, opt
+
+
+FAMILIES = {
+    "gpt": (sweep_gpt, [8, 16, 32]),
+    "gpt2m": (lambda bs: sweep_gpt(bs, medium=True), [2, 4, 8]),
+    "resnet": (sweep_resnet, [64, 128]),
+    "bert": (sweep_bert, [8, 16]),
+}
+
+
+def main():
+    args = sys.argv[1:]
+    if args and not args[0].isdigit():
+        fam, batch_args = args[0], args[1:]
+    else:
+        fam, batch_args = "gpt", args        # bare digits: gpt family
+    batches = [int(a) for a in batch_args if a.isdigit()]
+    if fam == "all":
+        for name, (fn, default) in FAMILIES.items():
+            log(f"==== {name} ====")
+            fn(default)
+        return
+    fn, default = FAMILIES[fam]
+    fn(batches or default)
+
+
+if __name__ == "__main__":
+    main()

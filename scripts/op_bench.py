@@ -76,7 +76,52 @@ CASES = {
 }
 
 
+def bench_hot_row_cache():
+    """Heter-PS hot-row cache micro-bench: steady-state step latency with
+    the device cache (zero RPCs) vs the pull/push path, same workload."""
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.fleet.ps import PsServer, PsClient
+    from paddle_tpu.distributed.fleet.heter import HeterPSTrainer
+
+    emb_dim, nfeat, batch, vocab = 64, 26, 512, 4096
+    s = PsServer()
+    s.add_sparse_table(1, dim=emb_dim, lr=0.1)
+    s.add_sparse_table(2, dim=emb_dim, lr=0.1)
+    port = s.start(0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, nfeat))
+    y = jnp.asarray(rng.randn(batch).astype("f4"))
+
+    def loss_fn(p, urows, inv, y):
+        x = urows[inv].reshape(y.shape[0], nfeat * emb_dim)
+        return jnp.mean(jnp.square(jnp.sum(x, -1) - y))
+
+    out = {}
+    for tag, table, cap in (("pull/push", 1, 0), ("hot-cache", 2, 8192)):
+        opt = pt.optimizer.AdamW(learning_rate=0.01, parameters=[])
+        tr = HeterPSTrainer(loss_fn, {"w": np.ones(2, "f4")}, opt,
+                            PsClient(port=port), sparse_table=table,
+                            emb_dim=emb_dim, cache_capacity=cap)
+        for _ in range(3):
+            tr.step(ids, y)                        # warm + fill cache
+        t0 = time.perf_counter()
+        n = 10
+        for _ in range(n):
+            tr.step(ids, y)
+        out[tag] = (time.perf_counter() - t0) / n * 1e3
+    s.stop()
+    print(f"{'heter step (pull/push)':36s} {out['pull/push']:9.3f}")
+    print(f"{'heter step (hot-row cache)':36s} {out['hot-cache']:9.3f}")
+    print(f"cache speedup: {out['pull/push'] / out['hot-cache']:.2f}x "
+          f"(host RPCs skipped on the hot set)")
+
+
 def main():
+    if "heter_cache" in sys.argv[1:]:
+        bench_hot_row_cache()
+        sys.argv.remove("heter_cache")
+        if not sys.argv[1:]:
+            return
     names = sys.argv[1:] or list(CASES)
     rng = np.random.RandomState(0)
     print(f"backend: {jax.default_backend()}")

@@ -1,0 +1,56 @@
+"""Convergence smoke tests for the BASELINE benchmark model families
+(BASELINE.md configs[1] ResNet-50 family, configs[2] BERT-base family):
+each must LEARN on a fixed batch — the CPU-mesh counterpart of the
+bench_sweep.py throughput rows (ref has no published numbers; learning +
+measured throughput is the evidence pair)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.jit import TrainStep
+
+
+def test_resnet_family_converges():
+    from paddle_tpu.vision.models import resnet18
+    import paddle_tpu.nn.functional as F
+
+    pt.seed(0)
+    model = resnet18(num_classes=4)
+    opt = pt.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return F.cross_entropy(logits, labels)
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    # 4 classes with distinct channel-mean signatures
+    labels = rng.randint(0, 4, (8,)).astype("int32")
+    imgs = rng.randn(8, 3, 32, 32).astype("f4") * 0.1
+    for i, l in enumerate(labels):
+        imgs[i, l % 3] += 1.0 + l
+    losses = [float(step(jnp.asarray(imgs), jnp.asarray(labels)).numpy())
+              for _ in range(15)]
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+def test_bert_family_converges():
+    from paddle_tpu.nlp.bert import (BertConfig, BertForPretraining,
+                                     bert_pretrain_loss)
+
+    pt.seed(0)
+    cfg = BertConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                     num_heads=2, intermediate_size=128, max_seq_len=32,
+                     dropout=0.0, attn_dropout=0.0)
+    model = BertForPretraining(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=3e-3,
+                             parameters=model.parameters())
+    step = TrainStep(model, bert_pretrain_loss, opt)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (4, 32)).astype("int32")
+    mlm = np.where(rng.rand(4, 32) < 0.3, ids, -100).astype("int64")
+    nsp = rng.randint(0, 2, (4,)).astype("int64")
+    losses = [float(step((ids,), (mlm, nsp)).numpy()) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]

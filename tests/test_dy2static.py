@@ -437,3 +437,24 @@ class TestBreakContinueReturn:
 
         out = convert_function(f)(pt.to_tensor(np.array(0.0, "f4")))
         assert float(np.asarray(out.numpy())) == 3.0
+
+    def test_loop_local_read_after_traced_loop_raises_with_name(self):
+        """a var first assigned inside a traced loop cannot escape the
+        lax carry; READING it afterwards must raise with its name."""
+        from paddle_tpu.jit.dy2static import convert_function
+
+        def f(x, n):
+            i = 0.0
+            while i < n:
+                y = x * 2.0
+                i = i + 1.0
+            return y  # noqa: F821  (deliberate: loop-local escape)
+
+        conv = convert_function(f)
+
+        def raw(x, n):
+            out = conv(pt.Tensor(x), pt.Tensor(n))
+            return out._data if hasattr(out, "_data") else out
+
+        with pytest.raises(ValueError, match="'y'.*does not escape"):
+            jax.jit(raw)(np.float32(1.0), np.float32(3.0))

@@ -81,3 +81,134 @@ def test_heter_padding_pushes_are_noop(server):
     tr.step(np.array([0, 1, 2, 3, 4]), jnp.zeros(()))
     after = client.pull_sparse(1, np.arange(5), 8)
     np.testing.assert_allclose(before, after, atol=1e-6)
+
+
+class _CountingClient:
+    """Wrap a PsClient, counting sparse RPCs (the hot-row cache's win is
+    measured in round-trips skipped)."""
+
+    def __init__(self, inner):
+        self._c = inner
+        self.pulls = 0
+        self.pushes = 0
+        self.sets = 0
+
+    def pull_sparse(self, *a, **k):
+        self.pulls += 1
+        return self._c.pull_sparse(*a, **k)
+
+    def push_sparse_grad(self, *a, **k):
+        self.pushes += 1
+        return self._c.push_sparse_grad(*a, **k)
+
+    def set_sparse(self, *a, **k):
+        self.sets += 1
+        return self._c.set_sparse(*a, **k)
+
+    def __getattr__(self, n):
+        return getattr(self._c, n)
+
+
+def test_set_sparse_roundtrip(server):
+    """New native SET_SPARSE command: absolute row overwrite."""
+    _, port = server
+    client = PsClient(port=port)
+    ids = np.array([3, 7], np.int64)
+    vals = np.arange(16, dtype=np.float32).reshape(2, 8)
+    client.set_sparse(1, ids, vals)
+    got = client.pull_sparse(1, ids, 8)
+    np.testing.assert_allclose(np.asarray(got), vals)
+
+
+def test_hot_row_cache_skips_host_pulls(server):
+    """ref heter_ps/hashtable.h rationale: repeated-key batches must not
+    pay host round-trips. Count RPCs: first step pulls once; subsequent
+    steps over the SAME working set issue ZERO sparse RPCs."""
+    _, port = server
+    client = _CountingClient(PsClient(port=port))
+    rng = np.random.RandomState(0)
+    emb_dim = 8
+
+    def loss_fn(p, urows, inv, y):
+        x = urows[inv].reshape(y.shape[0], 4 * emb_dim)
+        return jnp.mean(jnp.square(jnp.sum(x, -1) - y))
+
+    opt = pt.optimizer.AdamW(learning_rate=0.01, parameters=[])
+    tr = HeterPSTrainer(loss_fn, {"w": np.ones(2, "f4")}, opt, client,
+                        sparse_table=1, emb_dim=emb_dim,
+                        cache_capacity=256, sparse_lr=0.5)
+    ids = rng.randint(0, 30, (8, 4))
+    y = jnp.asarray(rng.randn(8).astype("f4"))
+    tr.step(ids, y)
+    assert client.pulls == 1 and client.pushes == 0
+    for _ in range(5):
+        tr.step(ids, y)
+    # hot working set: no further host traffic at all
+    assert client.pulls == 1 and client.pushes == 0 and client.sets == 0
+    st = tr.cache.stats()
+    assert st["pull_rpcs"] == 1 and st["hits"] > 0
+
+
+def test_hot_row_cache_matches_uncached_trajectory(server):
+    """The cache is write-back with the SAME SGD rule the server applies —
+    loss trajectories must match the uncached trainer exactly."""
+    _, port = server
+    rng_ids = np.random.RandomState(1).randint(0, 40, (10, 16, 4))
+    y_all = np.random.RandomState(2).randn(10, 16).astype("f4")
+    emb_dim = 8
+
+    def loss_fn(p, urows, inv, y):
+        x = urows[inv].reshape(y.shape[0], 4 * emb_dim)
+        return jnp.mean(jnp.square(jnp.sum(x, -1) - y))
+
+    def run(cache_capacity, table_id):
+        client = PsClient(port=port)
+        opt = pt.optimizer.AdamW(learning_rate=0.01, parameters=[])
+        tr = HeterPSTrainer(loss_fn, {"w": np.ones(2, "f4")}, opt, client,
+                            sparse_table=table_id, emb_dim=emb_dim,
+                            cache_capacity=cache_capacity, sparse_lr=0.5)
+        return [tr.step(rng_ids[i], jnp.asarray(y_all[i]))
+                for i in range(10)]
+
+    s, _ = server
+    s.add_sparse_table(2, dim=8, lr=0.5, init_scale=0.01)
+    s.add_sparse_table(3, dim=8, lr=0.5, init_scale=0.01)
+    base = run(0, 2)
+    cached = run(512, 3)
+    np.testing.assert_allclose(base, cached, rtol=1e-5, atol=1e-6)
+
+
+def test_hot_row_cache_eviction_writes_back(server):
+    """LRU eviction must write the device rows back (SET_SPARSE): a fresh
+    pull from the server sees the device-side updates."""
+    _, port = server
+    s, _ = server
+    s.add_sparse_table(4, dim=8, lr=0.5, init_scale=0.0)
+    client = _CountingClient(PsClient(port=port))
+    emb_dim = 8
+
+    def loss_fn(p, urows, inv, y):
+        x = urows[inv].reshape(y.shape[0], emb_dim)
+        return jnp.mean(jnp.square(jnp.sum(x, -1) - y))
+
+    opt = pt.optimizer.AdamW(learning_rate=0.01, parameters=[])
+    # capacity 64 == one bucket; a second disjoint working set must evict
+    tr = HeterPSTrainer(loss_fn, {"w": np.ones(2, "f4")}, opt, client,
+                        sparse_table=4, emb_dim=emb_dim,
+                        cache_capacity=64, sparse_lr=0.5)
+    ids_a = np.arange(0, 40).reshape(40, 1)
+    ids_b = np.arange(100, 140).reshape(40, 1)
+    y = jnp.asarray(np.ones(40, "f4"))
+    tr.step(ids_a, y)              # fills cache with set A, rows updated
+    tr.step(ids_b, y)              # disjoint set: evicts A -> SET_SPARSE
+    assert client.sets >= 1
+    assert tr.cache.stats()["evictions"] > 0
+    # server now holds A's device-side updates (init was zeros + update != 0)
+    fresh = np.asarray(PsClient(port=port).pull_sparse(
+        4, np.arange(0, 40, dtype=np.int64), emb_dim))
+    assert np.abs(fresh).max() > 0, "evicted rows not written back"
+    # flush writes the rest (set B)
+    tr.cache.flush()
+    fresh_b = np.asarray(PsClient(port=port).pull_sparse(
+        4, np.arange(100, 140, dtype=np.int64), emb_dim))
+    assert np.abs(fresh_b).max() > 0

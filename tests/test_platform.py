@@ -100,3 +100,61 @@ def test_monitor_stats():
     assert monitor.all_stats()["epoch"] == 7
     stats = monitor.device_memory_stats()
     assert "bytes_in_use" in stats
+
+
+class TestOpCallStack:
+    """ref framework/op_call_stack.cc + enforce.h Error Message Summary:
+    dispatch-time failures carry the operator name, input specs, and (for
+    desc replay) the python frames recorded at op-definition time — in
+    both eager and replayed-desc execution, with the original exception
+    TYPE preserved."""
+
+    def test_eager_failure_carries_op_context(self):
+        import paddle_tpu as pt
+        a = pt.to_tensor(np.ones((2, 3), "f4"))
+        with pytest.raises(TypeError) as ei:
+            pt.matmul(a, a)           # inner dims mismatch
+        msg = str(ei.value)
+        assert "[operator < matmul > error]" in msg
+        assert "float32[2,3], float32[2,3]" in msg
+        assert "'transpose_x': False" in msg
+
+    def test_eager_context_attached_once(self):
+        import paddle_tpu as pt
+        a = pt.to_tensor(np.ones((2, 3), "f4"))
+        with pytest.raises(TypeError) as ei:
+            pt.matmul(a, a)
+        assert str(ei.value).count("[operator <") == 1
+
+    def test_desc_replay_failure_carries_op_and_user_stack(self):
+        import paddle_tpu as pt
+        from paddle_tpu import static
+        from paddle_tpu.static import desc as D
+        import jax
+
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 3], "float32")
+            y = static.data("y", [3, 4], "float32")
+            out = pt.matmul(x, y)     # THIS line must appear in the stack
+        reloaded = D.ProgramDesc.from_json(prog.serialize_to_string())
+        # replay with an incompatible feed: the failure happens at RUN
+        # time, far from model code — the recorded stack must bridge it
+        env = {"x": np.ones((2, 3), "f4"), "y": np.ones((4, 5), "f4"),
+               D.RNG_VAR: jax.random.PRNGKey(0)}
+        with pytest.raises(TypeError) as ei:
+            D.run_desc(reloaded, env)
+        msg = str(ei.value)
+        assert "[operator < matmul > error]" in msg
+        assert "[python call stack (op creation)]" in msg
+        assert "test_platform.py" in msg        # points at MODEL code
+        assert "pt.matmul(x, y)" in msg
+
+    def test_typed_error_taxonomy_is_catchable_by_builtin(self):
+        from paddle_tpu.framework import errors
+        # taxonomy doubles as builtin types (ref error_codes.proto codes)
+        assert issubclass(errors.InvalidArgumentError, ValueError)
+        assert issubclass(errors.NotFoundError, KeyError)
+        assert issubclass(errors.OutOfRangeError, IndexError)
+        assert issubclass(errors.UnimplementedError, NotImplementedError)
+        assert errors.InvalidArgumentError.code == "INVALID_ARGUMENT"
