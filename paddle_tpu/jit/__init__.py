@@ -156,6 +156,8 @@ class TrainStep:
 
         _forward = tfm.wrap_forward(_forward, self.transforms)
 
+        ret_outs = return_outputs
+
         def _step(params, buffers, opt_state, acc, key, lr, step_i,
                   inputs, labels):
             (loss, (new_buf, outs)), grads = jax.value_and_grad(
@@ -163,6 +165,12 @@ class TrainStep:
                 has_aux=True)(params)
             new_params, new_opt, new_acc = update_fn(
                 params, grads, opt_state, acc, lr, step_i)
+            # outs leave the jitted program ONLY when asked for: a returned
+            # value can't be dead-code-eliminated, and fused-loss models
+            # (e.g. GPT chunked head+CE) rely on XLA dropping the unused
+            # wide logits entirely
+            if not ret_outs:
+                outs = ()
             return loss, new_params, new_buf, new_opt, new_acc, outs
 
         donate_args = (0, 1, 2, 3) if donate else ()

@@ -31,7 +31,8 @@ class GPTConfig:
                  num_heads=12, ffn_hidden_size=None, max_seq_len=1024,
                  dropout=0.1, attn_dropout=0.1, initializer_range=0.02,
                  use_recompute=False, sequence_parallel=False,
-                 moe_experts=0, moe_k=2, moe_capacity_factor=1.25):
+                 moe_experts=0, moe_k=2, moe_capacity_factor=1.25,
+                 fused_head_loss=True, attn_layout=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -52,6 +53,18 @@ class GPTConfig:
         self.moe_experts = int(moe_experts)
         self.moe_k = moe_k
         self.moe_capacity_factor = moe_capacity_factor
+        # vocab-chunked fused LM-head + CE (ops/chunked_ce.py): the [B,S,V]
+        # logits never hit HBM in training (XLA DCEs the unfused head
+        # matmul when only the loss is consumed)
+        self.fused_head_loss = bool(fused_head_loss)
+        # attention kernel layout: "bhsd" (default) or "bshd" (kernel reads
+        # [B,S,H,D] natively — kills the qkv transposes, but the size-1
+        # head-axis blocks are still unvalidated against real Mosaic
+        # tiling, so it is OPT-IN until measured on-chip; env
+        # PT_ATTN_LAYOUT lets the bench A/B it without code changes)
+        import os as _os
+        self.attn_layout = (attn_layout
+                            or _os.environ.get("PT_ATTN_LAYOUT", "bhsd"))
 
 
 def gpt2_small(**kw):
@@ -75,6 +88,7 @@ class GPTAttention(nn.Layer):
             initializer=I.Normal(0.0, cfg.initializer_range
                                  / math.sqrt(2 * cfg.num_layers))))
         self.attn_dropout_p = cfg.attn_dropout
+        self.attn_layout = getattr(cfg, "attn_layout", "bhsd")
         self.sequence_parallel = cfg.sequence_parallel
         if cfg.sequence_parallel and cfg.attn_dropout:
             import warnings
@@ -91,6 +105,22 @@ class GPTAttention(nn.Layer):
     def forward(self, x):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)                       # [B,S,3H]
+        if self.attn_layout == "bshd" and not self.sequence_parallel \
+                and not (self.attn_dropout_p and self.training):
+            # BSHD fast path: the kernel reads [B,S,H,D] natively, so the
+            # only layout op is the free reshape off the qkv matmul —
+            # kills the bf16 [B,H,S,D] transposes (PERF.md hotspot #1).
+            # q/k/v split indexes the UNSHARDED size-3 axis: the head axis
+            # carries the Megatron mp sharding and slicing across it would
+            # make GSPMD insert collectives inside per-stage control flow
+            qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+            q = qkv[:, :, 0]
+            k = qkv[:, :, 1]
+            v = qkv[:, :, 2]
+            from ..ops.pallas import flash_attention as _fa
+            out = _fa(q, k, v, causal=True, layout="bshd")
+            out = out.reshape([b, s, h])
+            return self.resid_dropout(self.out_proj(out))
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         qkv = qkv.transpose([2, 0, 3, 1, 4])          # [3,B,Hd,S,D]
         q, k, v = qkv[0], qkv[1], qkv[2]
@@ -290,6 +320,16 @@ class GPTForPretraining(nn.Layer):
             # ride the exact Tensor handed to the loss fn — per-call, no
             # global state, safe across interleaved models/forwards
             logits._moe_aux_loss = aux
+        if self.cfg.fused_head_loss:
+            # hand the loss fn the pre-head pieces: gpt_pretrain_loss uses
+            # the vocab-chunked fused CE and never touches `logits`, so
+            # under jit the dense head matmul above is dead code (users who
+            # consume logits directly still get them). The ARRAY snapshot
+            # of w matters: functional_call restores Parameter._data on
+            # exit, and the loss fn runs after — holding only the Tensor
+            # would silently swap the traced weight for a constant and
+            # drop the head's gradient into the tied embedding.
+            logits._fused_head = (hidden, w, w._data)
         return logits
 
     def loss(self, logits, labels):
@@ -310,14 +350,41 @@ def gpt_pretrain_loss(logits, labels):
     the logits: logits[:, :-1] yields a 1023-row tensor that breaks the
     TPU (8,128) tiling and costs a full relayout copy of the [B,S,V]
     logits (~512MB at the bench config, visible as reshape+fusion ops in
-    the device trace); the last position is masked via ignore_index."""
+    the device trace); the last position is masked via ignore_index.
+
+    When the model attached `_fused_head` (cfg.fused_head_loss), the loss
+    is computed by the vocab-chunked fused head+CE (ops/chunked_ce.py)
+    from the pre-head hidden states — the wide logits are never read, so
+    XLA removes the dense head matmul entirely."""
     b, s, v = logits.shape
     from ..ops.manipulation import concat
     from ..ops.creation import full
     ign = full([b, 1], -1, dtype="int64")
     shifted = concat([labels[:, 1:].astype("int64"), ign], axis=1)
-    loss = F.cross_entropy(logits.reshape([b * s, v]),
-                           shifted.reshape([b * s]), ignore_index=-1)
+    fused = getattr(logits, "_fused_head", None)
+    if fused is not None:
+        import jax as _jax
+        from ..ops.dispatch import apply
+        from ..ops.chunked_ce import chunked_lm_loss
+        hidden, w_t, w_arr = fused
+        # traced: use the array snapshot — the Tensor's _data was restored
+        # to the pre-trace constant when functional_call exited, and using
+        # it would silently drop the head's grad into the tied embedding.
+        # Eager: use the Tensor so the tape links w.grad.
+        w_in = w_arr if isinstance(w_arr, _jax.core.Tracer) else w_t
+        h2 = hidden.reshape([b * s, hidden.shape[-1]])
+        lab = shifted.reshape([b * s])
+        # small vocabs: chunk to the (128-aligned) vocab, not 4096 — padding
+        # a 512-wide vocab to 4096 would 8x the head FLOPs
+        chunk = min(4096, ((v + 127) // 128) * 128)
+
+        def f(h_, w_, l_):
+            return chunked_lm_loss(h_, w_, l_, -1, chunk)
+
+        loss = apply(f, (h2, w_in, lab), name="chunked_lm_loss")
+    else:
+        loss = F.cross_entropy(logits.reshape([b * s, v]),
+                               shifted.reshape([b * s]), ignore_index=-1)
     # MoE load-balance aux rides the logits Tensor (GPTForPretraining
     # attaches it); same-trace under TrainStep, concrete eagerly
     aux = getattr(logits, "_moe_aux_loss", None)

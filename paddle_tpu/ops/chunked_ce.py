@@ -1,0 +1,131 @@
+"""Vocab-chunked fused LM-head + softmax cross-entropy.
+
+The naive chain `logits = h @ W^T; ce(logits, labels)` materialises a
+[B*S, V] logits tensor in HBM twice (bf16 matmul output + f32 softmax
+chain) — ~512MB each way at the GPT-2s bench config, a pure-bandwidth
+cost (PERF.md hotspot #2 "LM-head + CE chain"). This op streams the vocab
+in chunks: per chunk one [N,H]x[H,C] MXU matmul feeds an ONLINE
+logsumexp + label-logit gather, so only [N, C] ever exists. The backward
+recomputes each chunk's logits from the saved (h, lse) — one extra matmul
+pass traded for never writing V-wide tensors (same recompute-over-HBM
+trade the flash attention kernels make; cf. the chunked/fused CE used by
+Megatron-style trainers).
+
+Ref surface: this replaces operators/softmax_with_cross_entropy_op.cc
+composed with the tied-embedding projection (ref GPTForPretraining
+matmul(hidden, emb_w, transpose_y=True) + cross_entropy).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _pad_vocab(w, chunk):
+    v = w.shape[0]
+    nc = (v + chunk - 1) // chunk
+    pad = nc * chunk - v
+    if pad:
+        w = jnp.pad(w, ((0, pad), (0, 0)))
+    return w, nc, v
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def chunked_lm_loss(h, w, labels, ignore_index=-1, chunk=4096):
+    """mean CE of softmax(h @ w^T) vs labels, streaming w in row chunks.
+
+    h: [N, H] hidden states; w: [V, H] (tied-embedding layout);
+    labels: [N] int (ignore_index rows excluded from the mean).
+    """
+    loss, _ = _fwd_impl(h, w, labels, ignore_index, chunk)
+    return loss
+
+
+def _fwd_impl(h, w, labels, ignore_index, chunk):
+    N, H = h.shape
+    wp, nc, v = _pad_vocab(w, chunk)
+    wch = wp.reshape(nc, chunk, H)
+    labels = labels.astype(jnp.int32)
+
+    m0 = jnp.full((N,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((N,), jnp.float32)
+    ll0 = jnp.zeros((N,), jnp.float32)
+
+    def body(carry, wc_i):
+        m, l, lab_logit = carry
+        wc, i = wc_i
+        logits = jax.lax.dot_general(
+            h, wc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [N, C]
+        if nc * chunk != v:
+            # padded vocab rows must not contribute to the partition fn
+            col = i * chunk + jnp.arange(chunk)[None, :]
+            logits = jnp.where(col < v, logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        loc = labels - i * chunk
+        in_c = (loc >= 0) & (loc < chunk)
+        got = jnp.take_along_axis(
+            logits, jnp.clip(loc, 0, chunk - 1)[:, None], axis=1)[:, 0]
+        lab_logit = jnp.where(in_c, got, lab_logit)
+        return (m_new, l, lab_logit), None
+
+    (m, l, lab_logit), _ = jax.lax.scan(
+        body, (m0, l0, ll0), (wch, jnp.arange(nc)))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    valid = labels != ignore_index
+    per = jnp.where(valid, lse - lab_logit, 0.0)
+    denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    loss = jnp.sum(per) / denom
+    return loss, (h, w, labels, lse, denom)
+
+
+def _fwd(h, w, labels, ignore_index, chunk):
+    loss, res = _fwd_impl(h, w, labels, ignore_index, chunk)
+    return loss, res
+
+
+def _bwd(ignore_index, chunk, res, g):
+    h, w, labels, lse, denom = res
+    N, H = h.shape
+    wp, nc, v = _pad_vocab(w, chunk)
+    wch = wp.reshape(nc, chunk, H)
+    labels = labels.astype(jnp.int32)
+    valid = labels != ignore_index
+    # d_logits = (softmax - onehot) * g / denom on valid rows
+    scale = (g / denom) * valid.astype(jnp.float32)      # [N]
+
+    dh0 = jnp.zeros((N, H), jnp.float32)
+
+    def body(dh, wc_i):
+        wc, i = wc_i
+        logits = jax.lax.dot_general(
+            h, wc, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if nc * chunk != v:
+            col = i * chunk + jnp.arange(chunk)[None, :]
+            logits = jnp.where(col < v, logits, -jnp.inf)
+        p = jnp.exp(logits - lse[:, None])               # softmax chunk
+        loc = labels - i * chunk
+        in_c = (loc >= 0) & (loc < chunk)
+        onehot_col = jnp.clip(loc, 0, chunk - 1)
+        sub = jnp.zeros_like(p).at[jnp.arange(N), onehot_col].set(
+            in_c.astype(jnp.float32))
+        dl = (p - sub) * scale[:, None]                  # [N, C]
+        dh = dh + jax.lax.dot_general(
+            dl, wc.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dwc = jax.lax.dot_general(
+            dl, h, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [C, H]
+        return dh, dwc
+
+    dh, dwcs = jax.lax.scan(body, dh0, (wch, jnp.arange(nc)))
+    dw = dwcs.reshape(nc * chunk, H)[:v]
+    zeros_lab = np.zeros(labels.shape, jax.dtypes.float0)
+    return dh.astype(h.dtype), dw.astype(w.dtype), zeros_lab
+
+
+chunked_lm_loss.defvjp(_fwd, _bwd)

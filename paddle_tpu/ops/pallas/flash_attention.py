@@ -74,14 +74,34 @@ def _sdpa_reference(q, k, v, mask, causal, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
+def _q2(ref, bshd):
+    """Whole-block 2-D view: refs are [1, BQ, D] (collapsed BHSD layout)
+    or [1, BQ, 1, D] (native BSHD layout, head dim blocked to 1)."""
+    return ref[0, :, 0, :] if bshd else ref[0]
+
+
+def _kslice(ref, start, size, bshd):
+    from jax.experimental import pallas as pl
+    if bshd:
+        return ref[0, pl.ds(start, size), 0, :]
+    return ref[0, pl.ds(start, size), :]
+
+
+def _w2(ref, val, bshd):
+    if bshd:
+        ref[0, :, 0, :] = val
+    else:
+        ref[0] = val
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                kv_len, q_len, bk):
+                kv_len, q_len, bk, bshd=False):
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
     Also writes the per-row log-sum-exp (softmax stats) so the flash
     backward kernel can recompute P tiles without re-reducing."""
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32) * scale        # [BQ, D]
+    q = _q2(q_ref, bshd).astype(jnp.float32) * scale  # [BQ, D]
     bq = q.shape[0]
     d = q.shape[1]
     nblocks = kv_len // bk
@@ -93,8 +113,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     def body(j, carry):
         m, l, acc = carry
-        kblk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        kblk = _kslice(k_ref, j * bk, bk, bshd).astype(jnp.float32)
+        vblk = _kslice(v_ref, j * bk, bk, bshd).astype(jnp.float32)
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [BQ,BK]
         if causal:
@@ -123,7 +143,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
     else:
         m, l, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    _w2(o_ref, (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype), bshd)
     # lse = m + log l (finite-m guard matches the shift guard above).
     # lse_ref holds the FULL [1, q_len] row (TPU block constraint: last two
     # dims must be 8/128-divisible or whole); each q-block program writes
@@ -132,12 +152,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0, 0, pl.ds(qblk * bq, bq)] = lse[:, 0]
 
 
-def _flash_fwd_pallas(q, k, v, causal, scale):
+def _flash_fwd_pallas(q, k, v, causal, scale, bshd=False):
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
+    if bshd:
+        # native [B, S, H, D] layout: no q/k/v transposes feed the kernel —
+        # the BlockSpec index maps stride over the head axis instead
+        # (kills the ~10ms/step of bf16 layout transposes the BHSD path
+        # pays at the bench config; PERF.md "qkv/attention transposes")
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
+    else:
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     # head_dim 64 runs unpadded (block dim == array dim satisfies the
     # Mosaic constraint); padding to 128 would double the HBM traffic of
@@ -148,45 +175,54 @@ def _flash_fwd_pallas(q, k, v, causal, scale):
         q = jnp.pad(q, pad)
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
-    qr = q.reshape(b * h, sq, d_pad)
-    kr = k.reshape(b * h, sk, d_pad)
-    vr = v.reshape(b * h, sk, d_pad)
+    if bshd:
+        qr, kr, vr = q, k, v
+        q_spec = pl.BlockSpec((1, bq_ := _blk(_BQ, sq), 1, d_pad),
+                              lambda bh, i: (bh // h, i, bh % h, 0))
+        kv_spec = pl.BlockSpec((1, sk, 1, d_pad),
+                               lambda bh, i: (bh // h, 0, bh % h, 0))
+        o_shape = _sds((b, sq, h, d_pad), q.dtype, q, k, v)
+    else:
+        qr = q.reshape(b * h, sq, d_pad)
+        kr = k.reshape(b * h, sk, d_pad)
+        vr = v.reshape(b * h, sk, d_pad)
+        bq_ = _blk(_BQ, sq)
+        q_spec = pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0))
+        kv_spec = pl.BlockSpec((1, sk, d_pad), lambda bh, i: (bh, 0, 0))
+        o_shape = _sds((b * h, sq, d_pad), q.dtype, qr, kr, vr)
 
     interpret = jax.default_backend() == "cpu"
-    bq_, bk_ = _blk(_BQ, sq), _blk(_BK, sk)
+    bk_ = _blk(_BK, sk)
     kernel = functools.partial(_fwd_kernel, scale=s, causal=causal,
-                               kv_len=sk, q_len=sq, bk=bk_)
+                               kv_len=sk, q_len=sq, bk=bk_, bshd=bshd)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sq // bq_),
-        in_specs=[
-            pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d_pad), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d_pad), lambda bh, i: (bh, 0, 0)),
-        ],
+        in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[
-            pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
+            q_spec,
             pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),
         ],
         out_shape=[
-            _sds((b * h, sq, d_pad), q.dtype, qr, kr, vr),
+            o_shape,
             _sds((b * h, 1, sq), jnp.float32, qr, kr, vr),
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    out = out.reshape(b, h, sq, d_pad)
+    if not bshd:
+        out = out.reshape(b, h, sq, d_pad)
     return (out[..., :d] if d != d_pad else out), lse
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
                     dk_ref, dv_ref, *, scale, causal, kv_len, q_len,
-                    bq, bk):
+                    bq, bk, bshd=False):
     """One (batch*head, k-block) program: accumulate dK/dV over q blocks.
     P tiles are recomputed from saved lse; dd is rowsum(dO * O)."""
     from jax.experimental import pallas as pl
 
-    kblk = k_ref[0].astype(jnp.float32)             # [BK, D]
-    vblk = v_ref[0].astype(jnp.float32)
+    kblk = _q2(k_ref, bshd).astype(jnp.float32)     # [BK, D]
+    vblk = _q2(v_ref, bshd).astype(jnp.float32)
     kb = pl.program_id(1)
     nqb = q_len // bq
     d = kblk.shape[1]
@@ -196,8 +232,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        q = _kslice(q_ref, i * bq, bq, bshd).astype(jnp.float32)
+        do = _kslice(do_ref, i * bq, bq, bshd).astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(i * bq, bq)].reshape(bq, 1)
         dd = dd_ref[0, 0, pl.ds(i * bq, bq)].reshape(bq, 1)
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
@@ -224,17 +260,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         dk, dv = jax.lax.fori_loop(start, nqb, body, (dk0, dv0))
     else:
         dk, dv = jax.lax.fori_loop(0, nqb, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    _w2(dk_ref, dk.astype(dk_ref.dtype), bshd)
+    _w2(dv_ref, dv.astype(dv_ref.dtype), bshd)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
-                   scale, causal, kv_len, q_len, bq, bk):
+                   scale, causal, kv_len, q_len, bq, bk, bshd=False):
     """One (batch*head, q-block) program: accumulate dQ over k blocks."""
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32)                # [BQ, D]
-    do = do_ref[0].astype(jnp.float32)
+    q = _q2(q_ref, bshd).astype(jnp.float32)        # [BQ, D]
+    do = _q2(do_ref, bshd).astype(jnp.float32)
     qblk = pl.program_id(1)
     lse = lse_ref[0, 0, pl.ds(qblk * bq, bq)].reshape(bq, 1)
     dd = dd_ref[0, 0, pl.ds(qblk * bq, bq)].reshape(bq, 1)
@@ -243,8 +279,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
     dq0 = jnp.zeros((bq, d), jnp.float32)
 
     def body(j, dq):
-        kblk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        kblk = _kslice(k_ref, j * bk, bk, bshd).astype(jnp.float32)
+        vblk = _kslice(v_ref, j * bk, bk, bshd).astype(jnp.float32)
         s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
@@ -266,82 +302,99 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
         dq = jax.lax.fori_loop(0, upper, body, dq0)
     else:
         dq = jax.lax.fori_loop(0, nkb, body, dq0)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    _w2(dq_ref, dq.astype(dq_ref.dtype), bshd)
 
 
-def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale):
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd=False):
     """Flash backward: dQ/dK/dV without materialising S x S in HBM."""
     from jax.experimental import pallas as pl
 
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
+    if bshd:
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
+    else:
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     d_pad = _pad_dim(d)
     if d != d_pad:
         pad = [(0, 0)] * 3 + [(0, d_pad - d)]
         q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
         out, g = jnp.pad(out, pad), jnp.pad(g, pad)
-    qr = q.reshape(b * h, sq, d_pad)
-    kr = k.reshape(b * h, sk, d_pad)
-    vr = v.reshape(b * h, sk, d_pad)
-    dor = g.reshape(b * h, sq, d_pad)
-    # dd = rowsum(dO * O): cheap elementwise reduce, XLA fuses it
-    dd = jnp.sum(dor.astype(jnp.float32)
-                 * out.reshape(b * h, sq, d_pad).astype(jnp.float32),
-                 axis=-1).reshape(b * h, 1, sq)
+    if bshd:
+        qr, kr, vr, dor = q, k, v, g
+        # dd = rowsum(dO * O) in [B*H, 1, S] layout (tiny f32 transpose)
+        dd = jnp.swapaxes(
+            jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1), 1, 2).reshape(b * h, 1, sq)
 
+        def qspec(blk):
+            return pl.BlockSpec((1, blk, 1, d_pad),
+                                lambda bh, i: (bh // h, i, bh % h, 0))
+
+        def fullspec(n):
+            return pl.BlockSpec((1, n, 1, d_pad),
+                                lambda bh, i: (bh // h, 0, bh % h, 0))
+
+        dkv_shape = [_sds((b, sk, h, d_pad), k.dtype, qr, kr, vr, dor),
+                     _sds((b, sk, h, d_pad), v.dtype, qr, kr, vr, dor)]
+        dq_shape = _sds((b, sq, h, d_pad), q.dtype, qr, kr, vr, dor)
+    else:
+        qr = q.reshape(b * h, sq, d_pad)
+        kr = k.reshape(b * h, sk, d_pad)
+        vr = v.reshape(b * h, sk, d_pad)
+        dor = g.reshape(b * h, sq, d_pad)
+        # dd = rowsum(dO * O): cheap elementwise reduce, XLA fuses it
+        dd = jnp.sum(dor.astype(jnp.float32)
+                     * out.reshape(b * h, sq, d_pad).astype(jnp.float32),
+                     axis=-1).reshape(b * h, 1, sq)
+
+        def qspec(blk):
+            return pl.BlockSpec((1, blk, d_pad), lambda bh, i: (bh, i, 0))
+
+        def fullspec(n):
+            return pl.BlockSpec((1, n, d_pad), lambda bh, i: (bh, 0, 0))
+
+        dkv_shape = [_sds((b * h, sk, d_pad), k.dtype, qr, kr, vr, dor),
+                     _sds((b * h, sk, d_pad), v.dtype, qr, kr, vr, dor)]
+        dq_shape = _sds((b * h, sq, d_pad), q.dtype, qr, kr, vr, dor)
+
+    lse_spec = pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0))
     interpret = jax.default_backend() == "cpu"
     bq_, bk_ = _blk(_BQ, sq), _blk(_BK, sk)
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=s, causal=causal,
-                          kv_len=sk, q_len=sq, bq=bq_, bk=bk_),
+                          kv_len=sk, q_len=sq, bq=bq_, bk=bk_, bshd=bshd),
         grid=(b * h, sk // bk_),
-        in_specs=[
-            pl.BlockSpec((1, sq, d_pad), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, bk_, d_pad), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, bk_, d_pad), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, sq, d_pad), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda bh, j: (bh, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bk_, d_pad), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, bk_, d_pad), lambda bh, j: (bh, j, 0)),
-        ],
-        out_shape=[
-            _sds((b * h, sk, d_pad), k.dtype, qr, kr, vr, dor),
-            _sds((b * h, sk, d_pad), v.dtype, qr, kr, vr, dor),
-        ],
+        in_specs=[fullspec(sq), qspec(bk_), qspec(bk_), fullspec(sq),
+                  lse_spec, lse_spec],
+        out_specs=[qspec(bk_), qspec(bk_)],
+        out_shape=dkv_shape,
         interpret=interpret,
     )(qr, kr, vr, dor, lse, dd)
     dk, dv = dkv
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=s, causal=causal,
-                          kv_len=sk, q_len=sq, bq=bq_, bk=bk_),
+                          kv_len=sk, q_len=sq, bq=bq_, bk=bk_, bshd=bshd),
         grid=(b * h, sq // bq_),
-        in_specs=[
-            pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d_pad), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d_pad), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0)),
-        out_shape=_sds((b * h, sq, d_pad), q.dtype, qr, kr, vr, dor),
+        in_specs=[qspec(bq_), fullspec(sk), fullspec(sk), qspec(bq_),
+                  lse_spec, lse_spec],
+        out_specs=qspec(bq_),
+        out_shape=dq_shape,
         interpret=interpret,
     )(qr, kr, vr, dor, lse, dd)
 
-    dq = dq.reshape(b, h, sq, d_pad)
-    dk = dk.reshape(b, h, sk, d_pad)
-    dv = dv.reshape(b, h, sk, d_pad)
+    if not bshd:
+        dq = dq.reshape(b, h, sq, d_pad)
+        dk = dk.reshape(b, h, sk, d_pad)
+        dv = dv.reshape(b, h, sk, d_pad)
     if d != d_pad:
         dq, dk, dv = dq[..., :d], dk[..., :d], dv[..., :d]
     return dq, dk, dv
 
 
-def _kernel_eligible(q, k, mask, dropout_p):
+def _kernel_eligible(q, k, mask, dropout_p, bshd=False):
     if mask is not None or dropout_p:
         return False
     if jax.default_backend() == "cpu":
@@ -355,35 +408,46 @@ def _kernel_eligible(q, k, mask, dropout_p):
             vma |= getattr(jax.typeof(a), "vma", frozenset()) or frozenset()
         if vma:
             return False
-    sq, sk = q.shape[2], k.shape[2]
+    seq_ax = 1 if bshd else 2
+    sq, sk = q.shape[seq_ax], k.shape[seq_ax]
     return (sq % 128 == 0 and sk % 128 == 0
             and sq >= 128 and sk >= 128)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_core(q, k, v, causal, scale):
-    out, _ = _flash_fwd_pallas(q, k, v, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_core(q, k, v, causal, scale, bshd=False):
+    out, _ = _flash_fwd_pallas(q, k, v, causal, scale, bshd)
     return out
 
 
-def _flash_core_fwd(q, k, v, causal, scale):
-    out, lse = _flash_fwd_pallas(q, k, v, causal, scale)
+def _flash_core_fwd(q, k, v, causal, scale, bshd=False):
+    out, lse = _flash_fwd_pallas(q, k, v, causal, scale, bshd)
     return out, (q, k, v, out, lse)
 
 
-def _flash_core_bwd(causal, scale, res, g):
+def _flash_core_bwd(causal, scale, bshd, res, g):
     q, k, v, out, lse = res
-    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale)
+    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def _flash_array(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
-                 rng_key=None):
-    """Array-level flash attention (pure; usable inside any jax transform)."""
-    if _kernel_eligible(q, k, mask, dropout_p):
-        return _flash_core(q, k, v, causal, scale)
+                 rng_key=None, layout="bhsd"):
+    """Array-level flash attention (pure; usable inside any jax transform).
+    layout="bshd" takes/returns [B, S, H, D] natively — no transposes feed
+    the kernel (the model keeps the matmul-natural layout end to end)."""
+    bshd = layout == "bshd"
+    if _kernel_eligible(q, k, mask, dropout_p, bshd):
+        return _flash_core(q, k, v, causal, scale, bshd)
+    if bshd:
+        # fallback reference path works in BHSD: transpose around it
+        # (ineligible shapes are the rare/small case)
+        o = _flash_array(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                         jnp.swapaxes(v, 1, 2), mask=mask, causal=causal,
+                         dropout_p=dropout_p, scale=scale, rng_key=rng_key)
+        return jnp.swapaxes(o, 1, 2)
     out = None
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -407,8 +471,10 @@ def _flash_array(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
 
 
 def flash_attention(q, k, v, attn_mask=None, causal=False, dropout_p=0.0,
-                    scale=None):
-    """Tensor-level op (dispatcher-integrated: eager tape or functional)."""
+                    scale=None, layout="bhsd"):
+    """Tensor-level op (dispatcher-integrated: eager tape or functional).
+    layout="bshd" takes [B, S, H, D] straight from the qkv projection —
+    no layout transposes between the matmul and the kernel."""
     from ..dispatch import apply
     from ...framework import state
 
@@ -417,7 +483,8 @@ def flash_attention(q, k, v, attn_mask=None, causal=False, dropout_p=0.0,
     def f(q_, k_, v_, *maybe_mask):
         m = maybe_mask[0] if maybe_mask else None
         return _flash_array(q_, k_, v_, mask=m, causal=causal,
-                            dropout_p=dropout_p, scale=scale, rng_key=rng_key)
+                            dropout_p=dropout_p, scale=scale,
+                            rng_key=rng_key, layout=layout)
 
     args = (q, k, v) if attn_mask is None else (q, k, v, attn_mask)
     return apply(f, args, name="flash_attention")
