@@ -242,8 +242,23 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
 
 
 def accuracy(input, label, k=1):
-    from ..metric import accuracy as _acc
-    return _acc(input, label, k=k)
+    # recorded op (the metric helper builds its Tensor outside the static
+    # recorder, so it cannot be a fetch target)
+    from ..ops.dispatch import apply
+    return apply(_accuracy_raw, (input, label), {"k": int(k)},
+                 differentiable=False, name="accuracy")
+
+
+def _accuracy_raw(a, l, k=1):
+    import jax
+    import jax.numpy as jnp
+    idx = jax.lax.top_k(a, k)[1]
+    hit = jnp.any(idx == l.reshape(-1, 1), axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+from ..ops.dispatch import register_op as _reg
+_reg("accuracy", _accuracy_raw)
 
 
 def cast(x, dtype):
@@ -281,3 +296,575 @@ def sequence_pool(input, pool_type="sum"):
     from ..ops import sequence as S
     lengths = Tensor(np.asarray([input.shape[1]] * input.shape[0], "i4"))
     return S.sequence_pool(input, lengths, pool_type=pool_type)
+
+
+# ------------------------------------------------------------------ tail
+# (round 3: the ~50 next-most-used 1.x builders — ref layers/nn.py,
+# layers/ops.py, layers/tensor.py, layers/loss.py — each delegating to the
+# modern impl; legacy spellings and argument names kept.)
+
+# elementwise / unary math (ref layers/ops.py auto-generated wrappers)
+def log(x, name=None):
+    return M.log(x)
+
+
+def exp(x, name=None):
+    return M.exp(x)
+
+
+def sqrt(x, name=None):
+    return M.sqrt(x)
+
+
+def square(x, name=None):
+    return M.square(x)
+
+
+def abs(x, name=None):
+    return M.abs(x)
+
+
+def ceil(x, name=None):
+    return M.ceil(x)
+
+
+def floor(x, name=None):
+    return M.floor(x)
+
+
+def cos(x, name=None):
+    return M.cos(x)
+
+
+def sin(x, name=None):
+    return M.sin(x)
+
+
+def round(x, name=None):
+    return M.round(x)
+
+
+def reciprocal(x, name=None):
+    return M.reciprocal(x)
+
+
+def pow(x, factor=1.0, name=None):
+    return M.pow(x, C.full([], factor) if not isinstance(factor, Tensor)
+                 else factor)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    return M.scale(x, scale=scale, bias=bias,
+                   bias_after_scale=bias_after_scale, act=act)
+
+
+def clip(x, min, max, name=None):
+    return M.clip(x, min=min, max=max)
+
+
+def _clip_by_norm_raw(a, max_norm=1.0):
+    import jax.numpy as jnp
+    nrm = jnp.sqrt(jnp.sum(jnp.square(a)))
+    return a * (max_norm / jnp.maximum(nrm, max_norm))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    from ..ops.dispatch import apply
+    return apply(_clip_by_norm_raw, (x,), {"max_norm": float(max_norm)},
+                 name="clip_by_norm")
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    out = M.maximum(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    out = M.minimum(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    out = M.pow(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    out = M.remainder(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return M.min(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return M.prod(input, axis=dim, keepdim=keep_dim)
+
+
+def sum(x):
+    out = x[0]
+    for t in x[1:]:
+        out = M.add(out, t)
+    return out
+
+
+def sums(input, out=None):
+    res = sum(input)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def cumsum(x, axis=None, exclusive=None, reverse=None, name=None):
+    ax = -1 if axis is None and (exclusive or reverse) else axis
+    t = MA.flip(x, ax) if reverse else x
+    out = M.cumsum(t, axis=ax)
+    if exclusive:
+        out = M.subtract(out, t)
+    return MA.flip(out, ax) if reverse else out
+
+
+def argmin(x, axis=0):
+    return M.argmin(x, axis=axis)
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    return (M.sort(input, axis=axis, descending=descending),
+            M.argsort(input, axis=axis, descending=descending))
+
+
+# activations (ref layers/nn.py + ops.py)
+def leaky_relu(x, alpha=0.02, name=None):
+    return F.leaky_relu(x, negative_slope=alpha)
+
+
+def relu6(x, threshold=6.0, name=None):
+    return F.relu6(x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return F.elu(x, alpha=alpha)
+
+
+def softplus(x, name=None):
+    return F.softplus(x)
+
+
+def softsign(x, name=None):
+    return F.softsign(x)
+
+
+def _hard_sigmoid_raw(a, slope=0.2, offset=0.5):
+    import jax.numpy as jnp
+    return jnp.clip(slope * a + offset, 0.0, 1.0)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    from ..ops.dispatch import apply
+    return apply(_hard_sigmoid_raw, (x,),
+                 {"slope": float(slope), "offset": float(offset)},
+                 name="hard_sigmoid")
+
+
+def swish(x, beta=1.0, name=None):
+    return F.silu(x)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    return F.hardswish(x)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return M.clip(x, min=t_min, max=t_max)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    name = name or _uname("prelu")
+    n = 1 if mode == "all" else x.shape[1]
+    w = _get_param(name + ".w_0", (n,), I.Constant(0.25), param_attr)
+    return F.prelu(x, w)
+
+
+def log_softmax(input, axis=-1):
+    return F.log_softmax(input, axis=axis)
+
+
+# shape / tensor manipulation (ref layers/nn.py + tensor.py)
+def squeeze(input, axes=None, name=None):
+    return MA.squeeze(input, axis=axes)
+
+
+def unsqueeze(input, axes, name=None):
+    return MA.unsqueeze(input, axis=axes)
+
+
+def stack(x, axis=0, name=None):
+    return MA.stack(x, axis=axis)
+
+
+def unstack(x, axis=0, num=None):
+    return MA.unstack(x, axis=axis, num=num)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    return MA.split(input, num_or_sections, axis=dim)
+
+
+def expand(x, expand_times, name=None):
+    return MA.tile(x, expand_times)
+
+
+def expand_as(x, target_tensor, name=None):
+    return MA.expand_as(x, target_tensor)
+
+
+def flatten(x, axis=1, name=None):
+    import numpy as _np
+    shp = x.shape
+    return MA.reshape(x, [-1, int(_np.prod(shp[axis:]))] if axis
+                      else [1, int(_np.prod(shp))])
+
+
+def slice(input, axes, starts, ends):
+    return MA.slice(input, axes, starts, ends)
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    return MA.strided_slice(input, axes, starts, ends, strides)
+
+
+def _shape_raw(a):
+    import jax.numpy as jnp
+    return jnp.asarray(a.shape, jnp.int32)
+
+
+def shape(input):
+    """Recorded against the input var: replayed programs see the RUN-time
+    shape, not the capture-time placeholder batch."""
+    from ..ops.dispatch import apply
+    return apply(_shape_raw, (input,), differentiable=False, name="shape")
+
+
+def gather(input, index, overwrite=True):
+    return MA.gather(input, index)
+
+
+def gather_nd(input, index, name=None):
+    return MA.gather_nd(input, index)
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    return MA.scatter(input, index, updates, overwrite=overwrite)
+
+
+def where(condition):
+    return MA.nonzero(condition)
+
+
+def zeros(shape, dtype="float32", force_cpu=False):
+    return C.zeros(shape, dtype=dtype)
+
+
+def ones(shape, dtype="float32", force_cpu=False):
+    return C.ones(shape, dtype=dtype)
+
+
+def zeros_like(x, out=None):
+    res = C.zeros_like(x)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def ones_like(x, out=None):
+    res = C.ones_like(x)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def _fcbsl_raw(a, shape=(), value=0.0, out_dtype="float32",
+               input_dim_idx=0, output_dim_idx=0):
+    import jax.numpy as jnp
+    from ..framework.dtype import convert_dtype
+    shp = list(shape)
+    shp[output_dim_idx] = a.shape[input_dim_idx]
+    return jnp.full(tuple(int(v) for v in shp), value,
+                    convert_dtype(out_dtype))
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    """Recorded against the INPUT var so the batch dim is read at run
+    time — baking input.shape at record time would freeze the
+    capture-time placeholder batch (1) into the program."""
+    from ..ops.dispatch import apply
+    return apply(_fcbsl_raw, (input,),
+                 {"shape": [int(v) for v in shape], "value": float(value),
+                  "out_dtype": str(dtype), "input_dim_idx": int(input_dim_idx),
+                  "output_dim_idx": int(output_dim_idx)},
+                 differentiable=False, name="fill_constant_batch_size_like")
+
+
+def range(start, end, step, dtype, name=None):
+    return C.arange(start, end, step, dtype=dtype)
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    return C.linspace(start, stop, num, dtype=dtype)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   name=None):
+    return C.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    out = C.randn(shape, dtype=dtype)
+    return M.add(M.scale(out, scale=std), C.full([], mean, dtype=dtype))
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    name = name or _uname("create_parameter")
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierNormal())
+    return _get_param(name, tuple(shape), init, attr)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    name = name or _uname("global_var")
+    return _get_param(name, tuple(shape), I.Constant(value), None)
+
+
+# nn builders (ref layers/nn.py)
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    name = name or _uname("conv2d_transpose")
+    st = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    pd = padding if isinstance(padding, (list, tuple)) \
+        else (padding, padding)
+    if filter_size is None:
+        # legacy form: filter size derived from the requested output size
+        # (ref layers/nn.py conv2d_transpose filter_size=None branch)
+        if output_size is None:
+            raise ValueError(
+                "conv2d_transpose: give filter_size or output_size")
+        osz = output_size if isinstance(output_size, (list, tuple)) \
+            else (output_size, output_size)
+        ks = tuple(int(osz[i] - (int(input.shape[2 + i]) - 1) * st[i]
+                       + 2 * pd[i]) for i in range(2))
+    else:
+        ks = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size, filter_size)
+    cin = input.shape[1]
+    w = _get_param(name + ".w_0", (cin, num_filters // groups) + tuple(ks),
+                   I.XavierNormal(), param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _get_param(name + ".b_0", (num_filters,), I.Constant(0.0),
+                       bias_attr)
+    out = F.conv2d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups)
+    return getattr(F, act)(out) if act else out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    name = name or _uname("layer_norm")
+    nshape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    w = _get_param(name + ".w_0", nshape, I.Constant(1.0), param_attr) \
+        if scale else None
+    b = _get_param(name + ".b_0", nshape, I.Constant(0.0), bias_attr) \
+        if shift else None
+    out = F.layer_norm(input, nshape, weight=w, bias=b, epsilon=epsilon)
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None,
+               bias_attr=None, act=None, name=None):
+    name = name or _uname("group_norm")
+    c = input.shape[1]
+    w = _get_param(name + ".w_0", (c,), I.Constant(1.0), param_attr)
+    b = _get_param(name + ".b_0", (c,), I.Constant(0.0), bias_attr)
+    out = F.group_norm(input, groups, epsilon=epsilon, weight=w, bias=b)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    name = name or _uname("instance_norm")
+    c = input.shape[1]
+    w = _get_param(name + ".w_0", (c,), I.Constant(1.0), param_attr)
+    b = _get_param(name + ".b_0", (c,), I.Constant(0.0), bias_attr)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return F.pad(x, paddings, value=pad_value)
+
+
+def pad2d(input, paddings, mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    # fluid 1.x order is [top, bottom, left, right]; F.pad's 4-element
+    # NCHW spec is [left, right, top, bottom]
+    t, b, l, r = [int(v) for v in paddings]
+    return F.pad(input, [l, r, t, b], mode=("replicate" if mode == "edge"
+                                            else mode), value=pad_value,
+                 data_format=data_format)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode="bilinear", align_corners=align_corners)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode="nearest")
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 name=None, align_corners=True):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode=resample.lower(),
+                         align_corners=align_corners)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return F.normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    return F.label_smooth(label, prior_dist=prior_dist, epsilon=epsilon)
+
+
+# losses (ref layers/loss.py)
+def mse_loss(input, label):
+    return F.mse_loss(input, label)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    return F.smooth_l1_loss(x, y, reduction="none")
+
+
+def huber_loss(input, label, delta):
+    return F.smooth_l1_loss(input, label, reduction="none", delta=delta)
+
+
+def _log_loss_raw(p, y, epsilon=1e-4):
+    import jax.numpy as jnp
+    return (-y * jnp.log(p + epsilon)
+            - (1.0 - y) * jnp.log(1.0 - p + epsilon))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    from ..ops.dispatch import apply
+    return apply(_log_loss_raw, (input, label),
+                 {"epsilon": float(epsilon)}, name="log_loss")
+
+
+def _sce_logits_raw(z, y, ignore_index=-100, normalize=False):
+    import jax.numpy as jnp
+    per = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    valid = y != ignore_index
+    per = jnp.where(valid, per, 0.0)
+    if normalize:
+        per = per / jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+    return per
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    from ..ops.dispatch import apply
+    return apply(_sce_logits_raw, (x, label),
+                 {"ignore_index": int(ignore_index),
+                  "normalize": bool(normalize)},
+                 name="sigmoid_cross_entropy_with_logits")
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return F.margin_ranking_loss(left, right, label, margin=margin,
+                                 reduction="none")
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return F.kl_div(x, target, reduction=reduction)
+
+
+def square_error_cost(input, label):
+    return F.square_error_cost(input, label)
+
+
+# comparisons / logic (ref layers/control_flow.py + logical ops)
+def equal(x, y, cond=None):
+    return L.equal(x, y)
+
+
+def not_equal(x, y, cond=None):
+    return L.not_equal(x, y)
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return L.less_than(x, y)
+
+
+def less_equal(x, y, cond=None):
+    return L.less_equal(x, y)
+
+
+def greater_than(x, y, cond=None):
+    return L.greater_than(x, y)
+
+
+def greater_equal(x, y, cond=None):
+    return L.greater_equal(x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return L.logical_and(x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return L.logical_or(x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return L.logical_not(x)
+
+
+def is_empty(x, cond=None):
+    return L.is_empty(x)
+
+
+def has_nan(x):
+    return L.any(M.isnan(x))
+
+
+def has_inf(x):
+    return L.any(M.isinf(x))
+
+
+def isfinite(x):
+    return L.all(M.isfinite(x))
+
+
+# (registered at module end: the raw impls above are defined throughout
+# the legacy tail)
+_reg("clip_by_norm", _clip_by_norm_raw)
+_reg("hard_sigmoid", _hard_sigmoid_raw)
+_reg("log_loss", _log_loss_raw)
+_reg("sigmoid_cross_entropy_with_logits", _sce_logits_raw)
+_reg("fill_constant_batch_size_like", _fcbsl_raw)
+_reg("shape", _shape_raw)

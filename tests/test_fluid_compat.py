@@ -108,3 +108,101 @@ def test_static_nn_namespace_builders():
                         fetch_list=[loss])
         first = first if first is not None else float(lv)
     assert float(lv) < first * 0.6
+
+
+def test_recognize_digits_conv_book_script():
+    """ref python/paddle/fluid/tests/book/test_recognize_digits.py (conv
+    variant): the 1.x LeNet-ish script — data -> conv2d -> pool2d ->
+    conv2d -> pool2d -> fc(softmax) -> cross_entropy -> mean -> Adam
+    minimize -> Executor loop — runs UNMODIFIED and learns."""
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv1 = fluid.layers.conv2d(img, num_filters=6, filter_size=5,
+                                    padding=2, act="relu")
+        pool1 = fluid.layers.pool2d(conv1, pool_size=2, pool_stride=2)
+        conv2 = fluid.layers.conv2d(pool1, num_filters=16, filter_size=5,
+                                    act="relu")
+        pool2 = fluid.layers.pool2d(conv2, pool_size=2, pool_stride=2)
+        logits = fluid.layers.fc(pool2, size=10)
+        loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+        avg_loss = fluid.layers.mean(loss)
+        acc = fluid.layers.accuracy(input=logits, label=label)
+        opt = fluid.optimizer.Adam(learning_rate=2e-3)
+        opt.minimize(avg_loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    # synthetic digits: class = which quadrant-ish blob is bright
+    y = rng.randint(0, 10, (64, 1)).astype("i8")
+    x = rng.randn(64, 1, 28, 28).astype("f4") * 0.1
+    for i, c in enumerate(y[:, 0]):
+        x[i, 0, (c // 5) * 14:(c // 5) * 14 + 14,
+          (c % 5) * 5:(c % 5) * 5 + 5] += 1.0
+    first = None
+    for _ in range(40):
+        lval, aval = exe.run(prog, feed={"img": x, "label": y},
+                             fetch_list=[avg_loss, acc])
+        if first is None:
+            first = float(lval)
+    assert float(lval) < first * 0.5, (first, float(lval))
+
+
+def test_fluid_layers_tail_surface_eager():
+    """Round-3 tail builders: spot-check the legacy spellings eagerly."""
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 6, 8, 8).astype("f4"))
+    L = fluid.layers
+    assert L.leaky_relu(x).shape == [2, 6, 8, 8]
+    assert L.hard_sigmoid(x).shape == [2, 6, 8, 8]
+    assert L.swish(x).shape == [2, 6, 8, 8]
+    assert L.group_norm(x, groups=2).shape == [2, 6, 8, 8]
+    assert L.instance_norm(x).shape == [2, 6, 8, 8]
+    assert L.layer_norm(x, begin_norm_axis=2).shape == [2, 6, 8, 8]
+    assert L.conv2d_transpose(x, num_filters=3, filter_size=2,
+                              stride=2).shape == [2, 3, 16, 16]
+    assert L.resize_nearest(x, scale=2.0).shape == [2, 6, 16, 16]
+    # fluid pad2d order is [top, bottom, left, right]
+    assert L.pad2d(x, [1, 1, 2, 2]).shape == [2, 6, 10, 12]
+    assert L.pad2d(x, [1, 0, 0, 0]).shape == [2, 6, 9, 8]
+    np.testing.assert_allclose(
+        L.cumsum(paddle.to_tensor(np.array([1., 2., 3.], "f4")),
+                 reverse=True).numpy(), [6., 5., 3.])
+    np.testing.assert_allclose(
+        L.cumsum(paddle.to_tensor(np.array([1., 2., 3.], "f4")),
+                 exclusive=True).numpy(), [0., 1., 3.])
+    zl = paddle.to_tensor(rng.randn(4, 3).astype("f4"))
+    sce = L.sigmoid_cross_entropy_with_logits(
+        zl, paddle.to_tensor(np.array([[1., 0., -1.]] * 4, "f4")),
+        ignore_index=-1)
+    assert np.all(np.asarray(sce.numpy())[:, 2] == 0.0)
+    assert L.squeeze(L.unsqueeze(x, [0]), [0]).shape == list(x.shape)
+    assert len(L.split(x, 2, dim=1)) == 2
+    assert L.stack([x, x]).shape == [2, 2, 6, 8, 8]
+    assert L.expand(paddle.to_tensor(np.ones((1, 3), "f4")),
+                    [4, 1]).shape == [4, 3]
+    assert L.reduce_prod(x, dim=1).shape == [2, 8, 8]
+    v, i = L.argsort(x)
+    assert v.shape == list(x.shape) and i.shape == list(x.shape)
+    a = paddle.to_tensor(rng.rand(4, 3).astype("f4"))
+    b = paddle.to_tensor(rng.rand(4, 3).astype("f4"))
+    assert L.elementwise_max(a, b).shape == [4, 3]
+    assert float(L.mse_loss(a, b).numpy()) >= 0
+    assert L.sigmoid_cross_entropy_with_logits(a, b).shape == [4, 3]
+    assert L.huber_loss(a, b, delta=1.0).shape == [4, 3]
+    assert bool(L.isfinite(a).numpy())
+    assert not bool(L.has_nan(a).numpy())
+    assert L.l2_normalize(a, axis=1).shape == [4, 3]
+    assert L.zeros_like(a).shape == [4, 3]
+    assert L.fill_constant_batch_size_like(a, [0, 7], "float32",
+                                           1.0).shape == [4, 7]
+    assert L.gather(a, paddle.to_tensor(np.array([0, 2]))).shape == [2, 3]
+    assert L.clip_by_norm(a, 0.1).shape == [4, 3]
+    assert bool(L.logical_and(L.less_than(a, b),
+                              L.greater_than(b, a)).numpy().any()) == bool(
+        (a.numpy() < b.numpy()).any())
+    p = L.create_parameter([3, 3], "float32")
+    assert p.shape == [3, 3]

@@ -201,6 +201,7 @@ import paddle_tpu.vision.ops           # noqa: F401  (detection ops)
 import paddle_tpu.quantization         # noqa: F401  (fake_quantize_dequantize)
 import paddle_tpu.nn.rnn               # noqa: F401  (lstm/gru/simple_rnn_seq)
 import paddle_tpu.ops.sequence         # noqa: F401  (sequence tail)
+import paddle_tpu.fluid.layers         # noqa: F401  (accuracy)
 from paddle_tpu.ops.dispatch import OP_REGISTRY, apply as _apply
 from paddle_tpu.static import desc as D
 
@@ -551,6 +552,18 @@ SPECS = {
     "sequence_topk_avg_pooling": S([F32((2, 4)), np.array([3, 4], "i4")],
                                    {"topks": [1, 2]}, grad=False),
     # --- decode / misc ---
+    "accuracy": S([F32((4, 5)), I32((4, 1), hi=5)], {"k": 2}, grad=False),
+    "clip_by_norm": S([F32()], {"max_norm": 0.5}),
+    "hard_sigmoid": S([F32()], {"slope": 0.2, "offset": 0.5}, grad=False),
+    "log_loss": S([POS((2, 3)) / 3.0, BOOL((2, 3)).astype("f4")],
+                  {"epsilon": 1e-4}),
+    "sigmoid_cross_entropy_with_logits": S(
+        [F32(seed=1), BOOL((2, 3)).astype("f4")],
+        {"ignore_index": -100, "normalize": False}),
+    "fill_constant_batch_size_like": S(
+        [F32((5, 2))], {"shape": [0, 3], "value": 1.0,
+                        "out_dtype": "float32"}, grad=False),
+    "shape": S([F32((2, 3))], grad=False),
     "gather_tree": S([I32((3, 2, 2), hi=4), I32((3, 2, 2), hi=2, seed=1)],
                      grad=False),
     "viterbi_decode": S([F32((2, 4, 3)), F32((3, 3), 1)], grad=False,
