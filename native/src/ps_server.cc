@@ -52,6 +52,12 @@ enum Op : uint8_t {
   COMPLETE = 13,         // aux = worker id; worker -> COMPLETED (clean exit)
   QUERY_ALIVE = 14,      // reply: u32 running, u32 completed, u32 dead
   SET_SPARSE = 15,       // overwrite sparse rows (heter cache write-back)
+  // graph service (ref distributed/service/graph_py_service.h +
+  // table/common_graph_table.h, re-done over the same length-prefixed TCP)
+  ADD_EDGES = 16,        // payload: count pairs of (src,dst) int64
+  SAMPLE_NEIGHBORS = 17, // payload: count ids; aux = k; reply count*k ids
+  GET_DEGREE = 18,       // payload: count ids; reply count int64 degrees
+  RANDOM_NODES = 19,     // aux = n; reply n int64 node ids (w/ replacement)
 };
 
 // worker lifecycle (ref operators/distributed/heart_beat_monitor.h:51
@@ -103,6 +109,32 @@ struct SparseTable {
                (2.0f * (st >> 11) * (1.0f / 9007199254740992.0f) - 1.0f);
     }
     return s.rows.emplace(id, std::move(row)).first->second;
+  }
+};
+
+// graph adjacency table (ref table/common_graph_table.h GraphTable:
+// sharded adjacency lists + uniform neighbor sampling; features live in a
+// regular sparse table — the TPU worker gathers them by sampled id)
+struct GraphShard {
+  std::unordered_map<int64_t, std::vector<int64_t>> adj;
+  std::mutex mu;
+};
+
+struct GraphTable {
+  static constexpr int kShards = 16;
+  GraphShard shards[kShards];
+  std::vector<int64_t> nodes;        // insertion-ordered unique sources
+  std::unordered_set<int64_t> node_set;
+  std::mutex nodes_mu;
+  std::atomic<uint64_t> rng{0x243f6a8885a308d3ull};
+
+  GraphShard& shard(int64_t id) {
+    return shards[mix64(static_cast<uint64_t>(id)) % kShards];
+  }
+
+  uint64_t NextRand() {
+    // racy fetch-add is fine: sampling only needs well-mixed bits
+    return mix64(rng.fetch_add(0x9e3779b97f4a7c15ull));
   }
 };
 
@@ -324,6 +356,67 @@ class PsServer {
         }
         uint8_t ok = 1;
         return Reply(fd, &ok, 1);
+      }
+      case ADD_EDGES: {
+        GraphTable* g = Graph(table);
+        std::vector<int64_t> pairs(count * 2);
+        if (!ReadN(fd, pairs.data(), count * 16)) return false;
+        for (uint64_t i = 0; i < count; ++i) {
+          int64_t src = pairs[2 * i], dst = pairs[2 * i + 1];
+          GraphShard& sh = g->shard(src);
+          {
+            std::lock_guard<std::mutex> lk(sh.mu);
+            sh.adj[src].push_back(dst);
+          }
+          std::lock_guard<std::mutex> lk(g->nodes_mu);
+          if (g->node_set.insert(src).second) g->nodes.push_back(src);
+        }
+        uint8_t ok = 1;
+        return Reply(fd, &ok, 1);
+      }
+      case SAMPLE_NEIGHBORS: {
+        // uniform with replacement, k per id (ref graph_py_service
+        // sample_neighboors); isolated nodes pad with -1 — static shapes
+        // for the TPU consumer
+        GraphTable* g = Graph(table);
+        uint32_t k = aux;
+        std::vector<int64_t> ids(count);
+        if (!ReadN(fd, ids.data(), count * 8)) return false;
+        std::vector<int64_t> out(count * k, -1);
+        for (uint64_t i = 0; i < count; ++i) {
+          GraphShard& sh = g->shard(ids[i]);
+          std::lock_guard<std::mutex> lk(sh.mu);
+          auto it = sh.adj.find(ids[i]);
+          if (it == sh.adj.end() || it->second.empty()) continue;
+          const std::vector<int64_t>& nb = it->second;
+          for (uint32_t j = 0; j < k; ++j)
+            out[i * k + j] = nb[g->NextRand() % nb.size()];
+        }
+        return Reply(fd, out.data(), out.size() * 8);
+      }
+      case GET_DEGREE: {
+        GraphTable* g = Graph(table);
+        std::vector<int64_t> ids(count);
+        if (!ReadN(fd, ids.data(), count * 8)) return false;
+        std::vector<int64_t> deg(count, 0);
+        for (uint64_t i = 0; i < count; ++i) {
+          GraphShard& sh = g->shard(ids[i]);
+          std::lock_guard<std::mutex> lk(sh.mu);
+          auto it = sh.adj.find(ids[i]);
+          deg[i] = it == sh.adj.end() ? 0
+                   : static_cast<int64_t>(it->second.size());
+        }
+        return Reply(fd, deg.data(), deg.size() * 8);
+      }
+      case RANDOM_NODES: {
+        GraphTable* g = Graph(table);
+        uint32_t n = aux;
+        std::vector<int64_t> out(n, -1);
+        std::lock_guard<std::mutex> lk(g->nodes_mu);
+        if (!g->nodes.empty())
+          for (uint32_t i = 0; i < n; ++i)
+            out[i] = g->nodes[g->NextRand() % g->nodes.size()];
+        return Reply(fd, out.data(), out.size() * 8);
       }
       case BARRIER: {  // aux = nominal world; table = worker_id+1 (0=anon)
         std::unique_lock<std::mutex> lk(barrier_mu_);
@@ -564,6 +657,14 @@ class PsServer {
     return it == sparse_.end() ? nullptr : it->second.get();
   }
 
+  GraphTable* Graph(uint32_t id) {
+    // lazily created: any graph op on a new table id opens it
+    std::lock_guard<std::mutex> lk(tables_mu_);
+    auto& slot = graph_[id];
+    if (!slot) slot = std::make_unique<GraphTable>();
+    return slot.get();
+  }
+
   int lfd_ = -1;
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
@@ -573,6 +674,7 @@ class PsServer {
   std::mutex tables_mu_;
   std::unordered_map<uint32_t, std::unique_ptr<DenseTable>> dense_;
   std::unordered_map<uint32_t, std::unique_ptr<SparseTable>> sparse_;
+  std::unordered_map<uint32_t, std::unique_ptr<GraphTable>> graph_;
   std::mutex barrier_mu_;
   std::condition_variable barrier_cv_;
   uint32_t barrier_count_ = 0;
@@ -604,6 +706,10 @@ class PsClient {
       case PULL_SPARSE:
       case SET_DENSE:
       case SET_SPARSE:   // absolute overwrite: retry-safe
+      case SAMPLE_NEIGHBORS:
+      case GET_DEGREE:
+      case RANDOM_NODES:
+      // ADD_EDGES is NOT idempotent (duplicate edges skew sampling)
       case QUERY_ALIVE:
       case REGISTER:
       case HEARTBEAT:
@@ -762,6 +868,44 @@ int pt_ps_push_sparse_grad(void* h, uint32_t table, const int64_t* ids,
                                                 payload.size(), &g_resp))
     return -1;
   return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+}
+
+int pt_ps_add_edges(void* h, uint32_t table, const int64_t* pairs,
+                    int64_t n) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::ADD_EDGES, table, n, 0,
+                                                pairs, n * 16, &g_resp))
+    return -1;
+  return g_resp.size() == 1 && g_resp[0] == 1 ? 0 : -1;
+}
+
+int pt_ps_sample_neighbors(void* h, uint32_t table, const int64_t* ids,
+                           int64_t n, uint32_t k, int64_t* out) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::SAMPLE_NEIGHBORS,
+                                                table, n, k, ids, n * 8,
+                                                &g_resp))
+    return -1;
+  if (g_resp.size() != static_cast<size_t>(n) * k * 8) return -1;
+  std::memcpy(out, g_resp.data(), g_resp.size());
+  return 0;
+}
+
+int pt_ps_get_degree(void* h, uint32_t table, const int64_t* ids, int64_t n,
+                     int64_t* out) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::GET_DEGREE, table, n,
+                                                0, ids, n * 8, &g_resp))
+    return -1;
+  if (g_resp.size() != static_cast<size_t>(n) * 8) return -1;
+  std::memcpy(out, g_resp.data(), g_resp.size());
+  return 0;
+}
+
+int pt_ps_random_nodes(void* h, uint32_t table, uint32_t n, int64_t* out) {
+  if (!static_cast<ptps::PsClient*>(h)->Request(ptps::RANDOM_NODES, table, 0,
+                                                n, nullptr, 0, &g_resp))
+    return -1;
+  if (g_resp.size() != static_cast<size_t>(n) * 8) return -1;
+  std::memcpy(out, g_resp.data(), g_resp.size());
+  return 0;
 }
 
 int pt_ps_set_sparse(void* h, uint32_t table, const int64_t* ids, int64_t n,

@@ -1,0 +1,88 @@
+"""Graph-learning PS service (ref paddle/fluid/distributed/service/
+graph_py_service.h + graph_brpc_server.h + table/common_graph_table.h).
+
+TPU-native redesign: the reference serves adjacency + sampling over brpc
+to GPU workers; here the graph lives in the same C++ parameter server
+(native/src/ps_server.cc GraphTable — sharded adjacency, uniform neighbor
+sampling with -1 padding) over the length-prefixed-TCP protocol, and the
+python side shapes every sample as a STATIC [n, k] block so the consuming
+GNN step compiles once per fanout signature. Node features live in an
+ordinary sparse table (pull_sparse by sampled id) — the same split the
+reference makes between the graph table and feature storage.
+"""
+import numpy as np
+
+
+class GraphService:
+    """Client-side facade: build + multi-hop sample (GraphSAGE-style)."""
+
+    def __init__(self, client, table_id=100, feature_table=None,
+                 symmetric=True):
+        self.client = client
+        self.table_id = table_id
+        self.feature_table = feature_table
+        self.symmetric = symmetric
+
+    # ------------------------------------------------------------- build
+    def add_edges(self, src, dst):
+        """Insert edges (both directions when symmetric — the reference
+        loads reverse edges as a separate edge type). One concatenated RPC
+        either way."""
+        src = np.asarray(src, np.int64).ravel()
+        dst = np.asarray(dst, np.int64).ravel()
+        if self.symmetric:
+            src, dst = (np.concatenate([src, dst]),
+                        np.concatenate([dst, src]))
+        self.client.add_edges(self.table_id, src, dst)
+
+    def load_edge_file(self, path, delimiter="\t"):
+        """ref graph_py_service load_edge_file: one 'src<TAB>dst' per line."""
+        src, dst = [], []
+        with open(path) as f:
+            for line in f:
+                parts = line.strip().split(delimiter)
+                if len(parts) >= 2:
+                    src.append(int(parts[0]))
+                    dst.append(int(parts[1]))
+        if src:
+            self.add_edges(np.asarray(src), np.asarray(dst))
+        return len(src)
+
+    # ------------------------------------------------------------ queries
+    def sample_neighbors(self, ids, k):
+        return self.client.sample_neighbors(self.table_id, ids, k)
+
+    def node_degree(self, ids):
+        return self.client.node_degree(self.table_id, ids)
+
+    def random_nodes(self, n):
+        return self.client.random_nodes(self.table_id, n)
+
+    def sample_subgraph(self, seed_ids, fanouts):
+        """Multi-hop GraphSAGE frontier expansion: returns one [n_i, k_i]
+        int64 block per hop (plus the seeds), each a static-shape gather
+        index into the feature table — the TPU-friendly flattening of the
+        reference's recursive sample_neighboors calls."""
+        seeds = np.asarray(seed_ids, np.int64).ravel()
+        hops = [seeds]
+        frontier = seeds
+        for k in fanouts:
+            nb = self.sample_neighbors(frontier, k)       # [n, k]
+            hops.append(nb)
+            frontier = nb.ravel()
+        return hops
+
+    def pull_features(self, ids, dim):
+        """Feature rows for (possibly -1-padded) ids; pads get zeros."""
+        if self.feature_table is None:
+            raise ValueError("GraphService built without a feature_table")
+        flat = np.asarray(ids, np.int64).ravel()
+        valid = flat >= 0
+        rows = np.zeros((flat.size, dim), np.float32)
+        if valid.any():
+            # pull only the real ids: PULL_SPARSE lazily materialises rows
+            # server-side, so pulling a pad-substitute id would create a
+            # phantom feature row
+            rows[valid] = np.asarray(self.client.pull_sparse(
+                self.feature_table, flat[valid], dim), np.float32)
+        return rows.reshape(tuple(np.asarray(ids).shape) + (dim,))

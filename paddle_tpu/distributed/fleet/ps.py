@@ -137,6 +137,38 @@ class PsClient:
             self._h, table_id, _iptr(ids), ids.size, _fptr(values),
             values.shape[1]), "set_sparse")
 
+    # ---- graph service (ref graph_py_service.h client surface)
+    def add_edges(self, table_id, src, dst):
+        pairs = np.ascontiguousarray(
+            np.stack([np.asarray(src, np.int64).ravel(),
+                      np.asarray(dst, np.int64).ravel()], axis=1))
+        self._check(self._lib.pt_ps_add_edges(
+            self._h, table_id, _iptr(pairs), pairs.shape[0]), "add_edges")
+
+    def sample_neighbors(self, table_id, ids, k):
+        """[n] ids -> [n, k] sampled neighbor ids (-1 pads isolated
+        nodes): static shapes for the TPU consumer."""
+        ids = np.ascontiguousarray(ids, np.int64)
+        out = np.empty((ids.size, int(k)), np.int64)
+        self._check(self._lib.pt_ps_sample_neighbors(
+            self._h, table_id, _iptr(ids), ids.size, int(k), _iptr(out)),
+            "sample_neighbors")
+        return out
+
+    def node_degree(self, table_id, ids):
+        ids = np.ascontiguousarray(ids, np.int64)
+        out = np.empty(ids.size, np.int64)
+        self._check(self._lib.pt_ps_get_degree(
+            self._h, table_id, _iptr(ids), ids.size, _iptr(out)),
+            "node_degree")
+        return out
+
+    def random_nodes(self, table_id, n):
+        out = np.empty(int(n), np.int64)
+        self._check(self._lib.pt_ps_random_nodes(
+            self._h, table_id, int(n), _iptr(out)), "random_nodes")
+        return out
+
     def barrier(self, world_size, worker_id=None):
         """True = clean release; False = released degraded (the server's
         heartbeat monitor evicted dead workers from the cohort instead of

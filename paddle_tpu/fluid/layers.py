@@ -34,7 +34,7 @@ def _uname(prefix):
     return f"{prefix}_{n}"
 
 
-def _get_param(name, shape, initializer, attr=None):
+def _get_param(name, shape, initializer, attr=None, dtype="float32"):
     if attr is not None and getattr(attr, "name", None):
         name = attr.name
     p = _PARAMS.get(name)
@@ -42,7 +42,7 @@ def _get_param(name, shape, initializer, attr=None):
         init = initializer
         if attr is not None and getattr(attr, "initializer", None) is not None:
             init = attr.initializer
-        p = Parameter(init(shape, "float32"), name=name)
+        p = Parameter(init(shape, dtype), name=name)
         if attr is not None and getattr(attr, "regularizer", None) is not None:
             p.regularizer = attr.regularizer
         _PARAMS[name] = p
@@ -418,7 +418,12 @@ def sums(input, out=None):
 
 
 def cumsum(x, axis=None, exclusive=None, reverse=None, name=None):
-    ax = -1 if axis is None and (exclusive or reverse) else axis
+    # fluid semantics: axis=None flattens (all variants)
+    if axis is None:
+        x = MA.reshape(x, [-1])
+        ax = 0
+    else:
+        ax = axis
     t = MA.flip(x, ax) if reverse else x
     out = M.cumsum(t, axis=ax)
     if exclusive:
@@ -441,7 +446,9 @@ def leaky_relu(x, alpha=0.02, name=None):
 
 
 def relu6(x, threshold=6.0, name=None):
-    return F.relu6(x)
+    if threshold == 6.0:
+        return F.relu6(x)
+    return M.clip(x, min=0.0, max=threshold)
 
 
 def elu(x, alpha=1.0, name=None):
@@ -468,12 +475,30 @@ def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
                  name="hard_sigmoid")
 
 
+def _swish_raw(a, beta=1.0):
+    import jax
+    return a * jax.nn.sigmoid(beta * a)
+
+
 def swish(x, beta=1.0, name=None):
-    return F.silu(x)
+    if beta == 1.0:
+        return F.silu(x)
+    from ..ops.dispatch import apply
+    return apply(_swish_raw, (x,), {"beta": float(beta)}, name="swish")
+
+
+def _hard_swish_raw(a, threshold=6.0, scale=6.0, offset=3.0):
+    import jax.numpy as jnp
+    return a * jnp.clip(a + offset, 0.0, threshold) / scale
 
 
 def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
-    return F.hardswish(x)
+    if (threshold, scale, offset) == (6.0, 6.0, 3.0):
+        return F.hardswish(x)
+    from ..ops.dispatch import apply
+    return apply(_hard_swish_raw, (x,),
+                 {"threshold": float(threshold), "scale": float(scale),
+                  "offset": float(offset)}, name="hard_swish")
 
 
 def brelu(x, t_min=0.0, t_max=24.0, name=None):
@@ -634,13 +659,14 @@ def create_parameter(shape, dtype, name=None, attr=None,
     name = name or _uname("create_parameter")
     init = default_initializer or (I.Constant(0.0) if is_bias
                                    else I.XavierNormal())
-    return _get_param(name, tuple(shape), init, attr)
+    return _get_param(name, tuple(shape), init, attr, dtype=dtype)
 
 
 def create_global_var(shape, value, dtype, persistable=False,
                       force_cpu=False, name=None):
     name = name or _uname("global_var")
-    return _get_param(name, tuple(shape), I.Constant(value), None)
+    return _get_param(name, tuple(shape), I.Constant(value), None,
+                      dtype=dtype)
 
 
 # nn builders (ref layers/nn.py)
@@ -756,7 +782,17 @@ def mse_loss(input, label):
 
 
 def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
-    return F.smooth_l1_loss(x, y, reduction="none")
+    # fluid: |d| < 1/sigma^2 -> 0.5 d^2 sigma^2, else |d| - 0.5/sigma^2 ==
+    # smooth_l1_loss with delta = 1/sigma^2; inside weights scale the diff,
+    # outside weights scale the loss
+    delta = 1.0 / (float(sigma) ** 2) if sigma else 1.0
+    if inside_weight is not None:
+        x = M.multiply(x, inside_weight)
+        y = M.multiply(y, inside_weight)
+    out = F.smooth_l1_loss(x, y, reduction="none", delta=delta)
+    if outside_weight is not None:
+        out = M.multiply(out, outside_weight)
+    return out
 
 
 def huber_loss(input, label, delta):

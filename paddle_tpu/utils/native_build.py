@@ -112,6 +112,17 @@ def _configure(lib):
     lib.pt_ps_set_sparse.argtypes = [c.c_void_p, c.c_uint32, i64p,
                                      c.c_int64, f32p, c.c_int]
     lib.pt_ps_set_sparse.restype = c.c_int
+    lib.pt_ps_add_edges.argtypes = [c.c_void_p, c.c_uint32, i64p, c.c_int64]
+    lib.pt_ps_add_edges.restype = c.c_int
+    lib.pt_ps_sample_neighbors.argtypes = [c.c_void_p, c.c_uint32, i64p,
+                                           c.c_int64, c.c_uint32, i64p]
+    lib.pt_ps_sample_neighbors.restype = c.c_int
+    lib.pt_ps_get_degree.argtypes = [c.c_void_p, c.c_uint32, i64p,
+                                     c.c_int64, i64p]
+    lib.pt_ps_get_degree.restype = c.c_int
+    lib.pt_ps_random_nodes.argtypes = [c.c_void_p, c.c_uint32, c.c_uint32,
+                                       i64p]
+    lib.pt_ps_random_nodes.restype = c.c_int
     lib.pt_ps_push_sparse_grad.argtypes = [c.c_void_p, c.c_uint32, i64p,
                                            c.c_int64, f32p, c.c_int]
     lib.pt_ps_push_sparse_grad.restype = c.c_int
