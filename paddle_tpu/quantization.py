@@ -20,15 +20,38 @@ from . import nn
 
 
 @def_op("fake_quantize_dequantize", n_tensor_args=1)
-def fake_quantize_dequantize(x, bits=8, symmetric=True):
+def fake_quantize_dequantize(x, bits=8, symmetric=True, scale=None):
     """Straight-through fake quant (ref fake_quantize_op.cc
     FakeQuantizeDequantizeAbsMax): quantize to `bits` then dequantize;
-    gradient passes through unchanged."""
-    qmax = 2.0 ** (bits - 1) - 1
-    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / qmax
+    gradient passes through unchanged. `scale=None` uses the dynamic
+    range (QAT); a float scale is the PTQ-calibrated fixed abs-max
+    (ref FakeQuantizeDequantizeMovingAverageAbsMax's frozen scale).
+    symmetric=False quantizes to the [min, max] range with a zero point.
+    All arithmetic stays in x.dtype (a frozen scale must not promote a
+    bf16 AMP program to f32)."""
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        s = (jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / qmax if scale is None
+             else jnp.asarray(scale, x.dtype) / qmax)
 
-    def qdq(v):
-        return jnp.round(v / scale) * scale
+        def qdq(v):
+            return (jnp.clip(jnp.round(v / s), -qmax, qmax) * s) \
+                .astype(x.dtype)
+    else:
+        # asymmetric: affine map of [lo, hi] onto [0, 2^bits - 1]
+        qmax = 2.0 ** bits - 1
+        if scale is None:
+            lo = jnp.min(x)
+            hi = jnp.max(x)
+        else:
+            lo = jnp.asarray(0.0, x.dtype)
+            hi = jnp.asarray(scale, x.dtype)
+        s = jnp.maximum(hi - lo, 1e-8) / qmax
+        zp = jnp.round(-lo / s)
+
+        def qdq(v):
+            q = jnp.clip(jnp.round(v / s) + zp, 0, qmax)
+            return ((q - zp) * s).astype(x.dtype)
 
     # straight-through estimator: forward quantized, backward identity
     return x + jax.lax.stop_gradient(qdq(x) - x)

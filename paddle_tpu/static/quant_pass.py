@@ -1,0 +1,186 @@
+"""Program-level quantization passes over the serializable desc IR.
+
+TPU-native analog of the reference slim program rewrites
+(ref python/paddle/fluid/contrib/slim/quantization/quantization_pass.py
+QuantizationTransformPass — walks the IrGraph inserting
+fake_quantize/dequantize around quantizable ops; AddQuantDequantPass;
+paddle/fluid/framework/ir/delete_quant_dequant_op_pass.cc for the
+inference strip). Here the "graph" is the flat ProgramDesc op list
+(static/desc.py), so a pass is a pure desc rewrite:
+
+  QuantizationTransformPass   QAT: insert fake_quantize_dequantize before
+                              quantizable ops' inputs (weight bits for
+                              persist/const vars, activation bits for the
+                              rest). Run BEFORE append_backward/minimize —
+                              the generic grad op then differentiates the
+                              STE impl like any other op.
+  collect_activation_scales   PTQ: replay the desc on calibration feeds
+                              recording per-quant-var abs-max.
+  apply_calibration           bake collected scales into the activation
+                              quant ops' `scale` attr (frozen range).
+  DeleteQuantDequantPass      inference convert: fold weight quant into
+                              the persist values (simulated-int8 weights)
+                              and strip the q/dq ops, rewiring consumers.
+
+All inserted ops are the registered `fake_quantize_dequantize` impl with
+JSON attrs, so quantized programs serialize/reload like any other desc.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import desc as D
+
+QUANTIZABLE_OP_TYPES = ("matmul", "linear", "conv1d", "conv2d", "conv3d",
+                        "bmm", "mm", "conv2d_transpose")
+_QOP = "fake_quantize_dequantize"
+
+
+def _quant_impl():
+    from ..ops.dispatch import OP_REGISTRY
+    return OP_REGISTRY[_QOP]
+
+
+def _assert_forward_only(desc, who):
+    """Both passes rebuild the op list; grad ops hold POSITIONAL
+    `fwd_index` references into it (static/backward.py), which a rebuild
+    would silently corrupt. The reference order is the same: slim's
+    transform runs on the forward program, then minimize."""
+    if any(op.type == "grad" for op in desc.ops):
+        raise ValueError(
+            f"{who} must run BEFORE append_backward/minimize: the program "
+            "already contains grad ops whose fwd_index references would "
+            "be invalidated by the rewrite")
+
+
+class QuantizationTransformPass:
+    """Insert fake-quant ops in front of quantizable ops' inputs."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_op_types=QUANTIZABLE_OP_TYPES):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.op_types = tuple(quantizable_op_types)
+
+    def apply(self, program):
+        desc = program.desc
+        _assert_forward_only(desc, "QuantizationTransformPass")
+        impl = _quant_impl()
+        quantized = {}            # var name -> quantized var name
+        new_ops = []
+        n_inserted = 0
+        for op in desc.ops:
+            if op.type in self.op_types:
+                new_inputs = []
+                for idx, vn in enumerate(op.inputs):
+                    var = desc.vars.get(vn)
+                    # only X and W (the first two inputs) are quantized —
+                    # the reference pass never touches bias (int8 bias is
+                    # an accuracy killer: small offset-critical ranges)
+                    if var is None or not vn or idx >= 2:
+                        new_inputs.append(vn)
+                        continue
+                    if vn not in quantized:
+                        is_weight = var.kind in (D.PERSIST, D.CONST)
+                        bits = (self.weight_bits if is_weight
+                                else self.activation_bits)
+                        qn = f"{vn}@quant"
+                        desc.add_var(D.VarDesc(qn, D.TMP, var.shape,
+                                               var.dtype))
+                        qop = D.OpDesc(
+                            _QOP, [vn], [qn],
+                            {"bits": int(bits), "symmetric": True,
+                             "scale": None,
+                             "__weight_quant__": bool(is_weight)},
+                            differentiable=True, _raw=impl)
+                        new_ops.append(qop)
+                        quantized[vn] = qn
+                        n_inserted += 1
+                    new_inputs.append(quantized[vn])
+                op.inputs = new_inputs
+            new_ops.append(op)
+        desc.ops = new_ops
+        desc.version += 1
+        return n_inserted
+
+
+def collect_activation_scales(program, feeds_list):
+    """PTQ calibration: replay the desc over the calibration feeds and
+    record abs-max for every ACTIVATION quant-op input (ref slim
+    post_training_quantization abs_max algo). Returns {var: scale}."""
+    desc = program.desc
+    act_vars = [op.inputs[0] for op in desc.ops
+                if op.type == _QOP and not op.attrs.get("__weight_quant__")]
+    scales = {v: 0.0 for v in act_vars}
+    persist = {n: t._data for n, t in program._persist.items()}
+    for feeds in feeds_list:
+        env = dict(persist)
+        env.update({k: jnp.asarray(v) for k, v in feeds.items()})
+        env[D.RNG_VAR] = jax.random.PRNGKey(0)
+        D.run_desc(desc, env)
+        for v in act_vars:
+            if v in env:
+                scales[v] = max(scales[v],
+                                float(jnp.max(jnp.abs(env[v]))))
+    return scales
+
+
+def apply_calibration(program, scales):
+    """Freeze collected abs-max ranges into the activation quant ops."""
+    n = 0
+    for op in program.desc.ops:
+        if op.type == _QOP and not op.attrs.get("__weight_quant__"):
+            v = op.inputs[0]
+            if v in scales and scales[v] > 0:
+                op.attrs["scale"] = float(scales[v])
+                op._fn = None      # drop any bound closure: attrs changed
+                n += 1
+    program.desc.version += 1
+    return n
+
+
+class DeleteQuantDequantPass:
+    """Inference convert (ref delete_quant_dequant_op_pass.cc +
+    save_quantized_model): weight quant ops are FOLDED — the persist
+    value is replaced by its quantize-dequantize image (simulated int8)
+    — and all q/dq ops are removed, consumers rewired to the original
+    vars."""
+
+    def __init__(self, keep_activation_quant=False):
+        self.keep_activation_quant = keep_activation_quant
+
+    def apply(self, program):
+        desc = program.desc
+        _assert_forward_only(desc, "DeleteQuantDequantPass")
+        rewire = {}
+        keep_ops = []
+        n_removed = 0
+        for op in desc.ops:
+            if op.type == _QOP:
+                src = op.inputs[0]
+                dst = op.outputs[0]
+                is_weight = op.attrs.get("__weight_quant__")
+                if is_weight or not self.keep_activation_quant:
+                    if is_weight:
+                        attrs = {k: v for k, v in op.attrs.items()
+                                 if not k.startswith("__")}
+                        if src in program._persist:
+                            t = program._persist[src]
+                            t._data = _quant_impl()(t._data, **attrs)
+                        elif desc.vars[src].kind == D.CONST:
+                            # const weights fold in the desc itself —
+                            # stripping without folding would silently
+                            # revert inference to full precision
+                            v = desc.vars[src]
+                            v.value = np.asarray(_quant_impl()(
+                                jnp.asarray(v.value), **attrs))
+                    rewire[dst] = src
+                    desc.vars.pop(dst, None)
+                    n_removed += 1
+                    continue
+            keep_ops.append(op)
+        for op in keep_ops:
+            op.inputs = [rewire.get(v, v) for v in op.inputs]
+        desc.ops = keep_ops
+        desc.version += 1
+        return n_removed

@@ -1,0 +1,149 @@
+"""Program-level quantization passes (ref slim/quantization
+quantization_pass.py + delete_quant_dequant_op_pass.cc): desc rewrite,
+QAT training THROUGH the quantized program, serialization, PTQ scale
+freezing, and the inference weight-fold/strip convert."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import static
+from paddle_tpu.static.quant_pass import (QuantizationTransformPass,
+                                          DeleteQuantDequantPass,
+                                          collect_activation_scales,
+                                          apply_calibration)
+from paddle_tpu import fluid
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    fluid.layers.reset_parameters()
+    yield
+
+
+def _build_prog():
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        x = static.data("x", [None, 8], "float32")
+        label = static.data("label", [None, 1], "float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        out = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(out, label))
+    return prog, loss, out
+
+
+def test_transform_inserts_and_serializes():
+    prog, loss, _ = _build_prog()
+    n = QuantizationTransformPass().apply(prog)
+    qops = [op for op in prog.desc.ops
+            if op.type == "fake_quantize_dequantize"]
+    assert n == len(qops) and n >= 4          # 2 matmuls x (act + weight)
+    kinds = {bool(op.attrs["__weight_quant__"]) for op in qops}
+    assert kinds == {True, False}
+    # the quantized program is still a fully serializable desc
+    reloaded = static.Program.parse_from_string(prog.serialize_to_string())
+    assert any(op.type == "fake_quantize_dequantize"
+               for op in reloaded.desc.ops)
+
+
+def test_qat_program_trains():
+    """QAT end-to-end: transform BEFORE minimize; the generic grad op
+    differentiates the STE impl and the program learns."""
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        x = static.data("x", [None, 8], "float32")
+        label = static.data("label", [None, 1], "float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        out = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(out, label))
+        QuantizationTransformPass().apply(prog)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    xv = rng.randn(32, 8).astype("f4")
+    yv = (xv.sum(-1, keepdims=True) > 0).astype("f4")
+    first = None
+    for _ in range(40):
+        (lv,) = exe.run(prog, feed={"x": xv, "label": yv},
+                        fetch_list=[loss])
+        first = first if first is not None else float(lv)
+    assert float(lv) < first * 0.5, (first, float(lv))
+
+
+def test_ptq_calibrate_freeze_and_convert():
+    prog, loss, out = _build_prog()
+    QuantizationTransformPass().apply(prog)
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.randn(16, 8).astype("f4"),
+              "label": np.zeros((16, 1), "f4")} for _ in range(4)]
+    scales = collect_activation_scales(prog, feeds)
+    assert scales and all(v > 0 for v in scales.values())
+    n = apply_calibration(prog, scales)
+    assert n == len(scales)
+    frozen = [op for op in prog.desc.ops
+              if op.type == "fake_quantize_dequantize"
+              and not op.attrs.get("__weight_quant__")]
+    assert all(op.attrs["scale"] is not None for op in frozen)
+
+    # quantized-program output before convert
+    exe = static.Executor()
+    xv = feeds[0]["x"]
+    (ref,) = exe.run(prog, feed=feeds[0],
+                     fetch_list=[prog.recorder.name_of(out)])
+
+    # convert: weights folded to their int8 image, q/dq ops stripped
+    w_name = next(op.inputs[0] for op in prog.desc.ops
+                  if op.type == "fake_quantize_dequantize"
+                  and op.attrs.get("__weight_quant__"))
+    n_rm = DeleteQuantDequantPass().apply(prog)
+    assert n_rm >= 4
+    assert not any(op.type == "fake_quantize_dequantize"
+                   for op in prog.desc.ops)
+    # folded weight sits on the int8 grid: few distinct values
+    w = np.asarray(prog._persist[w_name]._data)
+    assert len(np.unique(np.round(w / (np.abs(w).max() / 127), 4))) <= 256
+    (got,) = exe.run(prog, feed=feeds[0],
+                     fetch_list=[prog.recorder.name_of(out)])
+    # stripped activations: output close to the quantized-training forward
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=0.2, atol=0.2)
+
+
+def test_pass_refuses_program_with_grad_ops():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 8], "float32")
+        label = static.data("label", [None, 1], "float32")
+        out = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(out, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    with pytest.raises(ValueError, match="BEFORE append_backward"):
+        QuantizationTransformPass().apply(prog)
+
+
+def test_bias_not_quantized():
+    prog, _, _ = _build_prog()
+    QuantizationTransformPass().apply(prog)
+    for op in prog.desc.ops:
+        if op.type == "linear" and len(op.inputs) == 3:
+            assert not op.inputs[2].endswith("@quant"), "bias was quantized"
+            assert op.inputs[0].endswith("@quant")
+            assert op.inputs[1].endswith("@quant")
+
+
+def test_asymmetric_quant_roundtrip():
+    from paddle_tpu.quantization import fake_quantize_dequantize
+    import jax.numpy as jnp
+    x = pt.to_tensor(np.linspace(0.1, 2.0, 32).astype("f4"))
+    y = fake_quantize_dequantize(x, bits=8, symmetric=False)
+    np.testing.assert_allclose(np.asarray(y.numpy()),
+                               np.asarray(x.numpy()), atol=0.02)
+    # bf16 stays bf16 with a frozen scale (no silent f32 promotion)
+    xb = pt.Tensor(jnp.linspace(0, 1, 16, dtype=jnp.bfloat16))
+    yb = fake_quantize_dequantize(xb, bits=8, scale=1.0)
+    assert yb.dtype == xb.dtype
