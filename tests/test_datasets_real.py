@@ -1,0 +1,125 @@
+"""Real-format dataset parsing (ref python/paddle/dataset/mnist.py,
+cifar.py): genuine idx-ubyte and cifar-binary files are WRITTEN locally
+(zero-egress environment) and loaded through the standard cache-home
+discovery — the loaders must behave identically to the reference's
+post-download parse, including a convergence run on the parsed data."""
+import gzip
+import os
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _write_idx_images(path, imgs):
+    """imgs: [N, 28, 28] uint8 — the real idx3-ubyte format + gzip."""
+    payload = struct.pack(">IIII", 2051, imgs.shape[0], 28, 28) \
+        + imgs.tobytes()
+    with gzip.open(path, "wb") as f:
+        f.write(payload)
+
+
+def _write_idx_labels(path, labels):
+    payload = struct.pack(">II", 2049, labels.shape[0]) \
+        + labels.astype(np.uint8).tobytes()
+    with gzip.open(path, "wb") as f:
+        f.write(payload)
+
+
+@pytest.fixture
+def data_home(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    return tmp_path
+
+
+def _make_mnist(root, n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    # class-signal images so a model can actually learn from the files
+    imgs = (rng.rand(n, 28, 28) * 40).astype(np.uint8)
+    for i, l in enumerate(labels):
+        imgs[i, l * 2:l * 2 + 2, 4:24] += 180   # disjoint bands
+    os.makedirs(root, exist_ok=True)
+    _write_idx_images(os.path.join(root, "train-images-idx3-ubyte.gz"), imgs)
+    _write_idx_labels(os.path.join(root, "train-labels-idx1-ubyte.gz"),
+                      labels)
+    _write_idx_images(os.path.join(root, "t10k-images-idx3-ubyte.gz"),
+                      imgs[:64])
+    _write_idx_labels(os.path.join(root, "t10k-labels-idx1-ubyte.gz"),
+                      labels[:64])
+    return imgs, labels
+
+
+def test_mnist_loads_real_idx_files_from_data_home(data_home):
+    from paddle_tpu.vision.datasets import MNIST
+    imgs, labels = _make_mnist(data_home / "mnist")
+    ds = MNIST(mode="train")
+    assert len(ds) == 256
+    img0, lab0 = ds[0]
+    assert img0.shape == (1, 28, 28) and img0.dtype == np.float32
+    assert int(lab0) == int(labels[0])
+    np.testing.assert_allclose(img0[0], imgs[0].astype(np.float32) / 255.0)
+    test = MNIST(mode="test")
+    assert len(test) == 64
+
+
+def test_cifar10_loads_real_binary_batches(data_home):
+    from paddle_tpu.vision.datasets import Cifar10
+    rng = np.random.RandomState(0)
+    base = data_home / "cifar" / "cifar-10-batches-bin"
+    os.makedirs(base)
+    recs = []
+    labels = rng.randint(0, 10, 50).astype(np.uint8)
+    imgs = rng.randint(0, 255, (50, 3072)).astype(np.uint8)
+    for i in range(50):
+        recs.append(bytes([labels[i]]) + imgs[i].tobytes())
+    blob = b"".join(recs)
+    for i in range(1, 6):
+        (base / f"data_batch_{i}.bin").write_bytes(blob)
+    (base / "test_batch.bin").write_bytes(blob[:10 * 3073])
+    ds = Cifar10(mode="train")
+    assert len(ds) == 250                         # 5 batches x 50
+    img0, lab0 = ds[0]
+    assert img0.shape == (3, 32, 32) and int(lab0) == int(labels[0])
+    np.testing.assert_allclose(
+        img0.reshape(-1), imgs[0].astype(np.float32) / 255.0)
+    assert len(Cifar10(mode="test")) == 10
+
+
+def test_cifar10_loads_distribution_targz(data_home, tmp_path):
+    from paddle_tpu.vision.datasets import Cifar10
+    rng = np.random.RandomState(1)
+    labels = rng.randint(0, 10, 20).astype(np.uint8)
+    blob = b"".join(bytes([labels[i]])
+                    + rng.randint(0, 255, 3072).astype(np.uint8).tobytes()
+                    for i in range(20))
+    inner = tmp_path / "cifar-10-batches-bin"
+    os.makedirs(inner, exist_ok=True)
+    for i in range(1, 6):
+        (inner / f"data_batch_{i}.bin").write_bytes(blob)
+    tgz = tmp_path / "cifar-10-binary.tar.gz"
+    with tarfile.open(tgz, "w:gz") as tf:
+        tf.add(inner, arcname="cifar-10-batches-bin")
+    ds = Cifar10(data_file=str(tgz), mode="train")
+    assert len(ds) == 100
+    assert int(ds[0][1]) == int(labels[0])
+
+
+def test_training_on_real_format_files_converges(data_home):
+    """The reference's convergence claim runs on downloaded files; here a
+    LeNet learns from genuine idx files parsed by the same loader path."""
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.vision.models import LeNet
+    _make_mnist(data_home / "mnist", n=256)
+    pt.seed(0)
+    model = pt.Model(LeNet())
+    model.prepare(pt.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.network.parameters()),
+                  pt.nn.CrossEntropyLoss(), pt.metric.Accuracy())
+    model.fit(MNIST(mode="train"), batch_size=64, epochs=4, verbose=0)
+    res = model.evaluate(MNIST(mode="test"), batch_size=64, verbose=0)
+    acc = float(np.asarray(list(res.values())[-1]))
+    assert acc > 0.7, res
