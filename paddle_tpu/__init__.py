@@ -38,6 +38,11 @@ from .ops.math import (  # noqa: F401
     count_nonzero, matmul, mm, dot, bmm, inner, outer, addmm, kron, trace,
     diagonal, topk, sort, argsort, unique, kthvalue, scale, increment,
     multiplex, atan2, sigmoid, lgamma, digamma, erfinv,
+    lerp, heaviside, logit, logaddexp, xlogy, sinc, exp2, rad2deg, deg2rad,
+    copysign, nextafter, gcd, lcm, diff, trapezoid, cummax, cummin,
+    logcumsumexp, searchsorted, bucketize, renorm, quantile, nanquantile,
+    dist, angle, conj, real, imag, complex, polar, sgn, signbit, ldexp,
+    hypot, frac, nansum, nanmean,
 )
 from .ops.manipulation import (  # noqa: F401
     cast, reshape, reshape_, flatten, transpose, moveaxis, swapaxes, t, concat,
@@ -47,6 +52,7 @@ from .ops.manipulation import (  # noqa: F401
     scatter_nd_add, index_select, index_sample, where, nonzero, masked_select,
     masked_fill, take_along_axis, put_along_axis, shard_index, one_hot,
     tensordot, as_complex, as_real, crop,
+    take, index_add, index_put, masked_scatter, unflatten,
 )
 from .ops.logic import (  # noqa: F401
     equal, not_equal, greater_than, greater_equal, less_than, less_equal,

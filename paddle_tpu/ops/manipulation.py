@@ -648,3 +648,99 @@ def crop(x, shape=None, offsets=None, name=None):
                  {"shape": [int(s) for s in shape],
                   "offsets": None if offsets is None
                   else [int(o) for o in offsets]}, name="crop")
+
+
+# --------------------------------------------------------------- round-3 tail
+
+def _take_raw(a, idx, mode="raise"):
+    flat = a.reshape(-1)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:
+        # negative python-style indexing (desc replay cannot raise on
+        # device; out-of-range follows jnp's clamp semantics)
+        idx = jnp.where(idx < 0, idx + n, idx)
+    return jnp.take(flat, idx)
+
+
+def _index_add_raw(a, index, value, axis=0):
+    moved = jnp.moveaxis(a, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def _index_put_raw(a, index, value, accumulate=False):
+    comps = tuple(index[..., i] for i in range(index.shape[-1]))
+    return (a.at[comps].add(value) if accumulate
+            else a.at[comps].set(value))
+
+
+def _masked_scatter_raw(a, mask, value):
+    # value's first elements fill True positions in row-major order (ref
+    # masked_scatter_op): scatter value[cumsum(mask)-1] where mask
+    flatm = mask.reshape(-1)
+    src_idx = jnp.clip(jnp.cumsum(flatm) - 1, 0, value.size - 1)
+    vals = jnp.take(value.reshape(-1), src_idx)
+    return jnp.where(flatm, vals, a.reshape(-1)).reshape(a.shape)
+
+
+def _unflatten_raw(a, axis=0, shape=()):
+    ax = axis % a.ndim
+    new = a.shape[:ax] + tuple(shape) + a.shape[ax + 1:]
+    # a single -1 infers from the original dim
+    if -1 in shape:
+        known = 1
+        for s in shape:
+            if s != -1:
+                known *= s
+        new = tuple(a.shape[ax] // known if s == -1 else s
+                    for s in shape)
+        new = a.shape[:ax] + new + a.shape[ax + 1:]
+    return a.reshape(new)
+
+
+register_op("take", _take_raw)
+register_op("index_add", _index_add_raw)
+register_op("index_put", _index_put_raw)
+register_op("masked_scatter", _masked_scatter_raw)
+register_op("unflatten", _unflatten_raw)
+
+
+def take(x, index, mode="raise", name=None):
+    return apply(_take_raw, (x, index), {"mode": str(mode)}, name="take")
+
+
+def index_add(x, index, axis, value, name=None):
+    return apply(_index_add_raw, (x, index, value), {"axis": int(axis)},
+                 name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = indices
+    if isinstance(idx, (list, tuple)):
+        arrs = [as_array(i) if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in idx]
+        if any(a.dtype == jnp.bool_ for a in arrs):
+            raise NotImplementedError(
+                "index_put: boolean-mask indices are not supported "
+                "(dynamic shapes); use masked_fill/masked_scatter")
+        # paddle broadcasts the index tensors against each other
+        arrs = jnp.broadcast_arrays(*arrs)
+        idx = Tensor(jnp.stack(arrs, axis=-1))
+    return apply(_index_put_raw, (x, idx, value),
+                 {"accumulate": bool(accumulate)}, name="index_put")
+
+
+def masked_scatter(x, mask, value, name=None):
+    return apply(_masked_scatter_raw, (x, mask, value),
+                 name="masked_scatter")
+
+
+def unflatten(x, axis, shape, name=None):
+    return apply(_unflatten_raw, (x,),
+                 {"axis": int(axis), "shape": [int(s) for s in shape]},
+                 name="unflatten")

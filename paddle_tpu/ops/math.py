@@ -533,3 +533,399 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     topk_idx = jnp.argsort(a, axis=-1)[:, ::-1][:, :k]
     hit = jnp.any(topk_idx == l[:, None], axis=-1)
     return Tensor(jnp.mean(hit.astype(jnp.float32)))
+
+
+# --------------------------------------------------------------- round-3 tail
+# (next slice of the reference op surface — python/paddle/tensor/math.py
+# lerp/heaviside/diff/..., search.py searchsorted/bucketize, stat.py
+# quantile/corrcoef — every impl a registered raw with JSON attrs)
+
+def _lerp_raw(a, b, w):
+    return a + w * (b - a)
+
+
+def _heaviside_raw(a, b):
+    return jnp.where(a > 0, 1.0, jnp.where(a < 0, 0.0, b)).astype(a.dtype)
+
+
+def _logit_raw(a, eps=None):
+    x = a if eps is None else jnp.clip(a, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def _logaddexp_raw(a, b):
+    return jnp.logaddexp(a, b)
+
+
+def _xlogy_raw(a, b):
+    return jax.scipy.special.xlogy(a, b)
+
+
+def _sinc_raw(a):
+    return jnp.sinc(a)
+
+
+def _exp2_raw(a):
+    return jnp.exp2(a)
+
+
+def _rad2deg_raw(a):
+    return jnp.degrees(a)
+
+
+def _deg2rad_raw(a):
+    return jnp.radians(a)
+
+
+def _copysign_raw(a, b):
+    return jnp.copysign(a, b)
+
+
+def _nextafter_raw(a, b):
+    return jnp.nextafter(a, b)
+
+
+def _gcd_raw(a, b):
+    return jnp.gcd(a, b)
+
+
+def _lcm_raw(a, b):
+    return jnp.lcm(a, b)
+
+
+def _diff_raw(a, n=1, axis=-1):
+    return jnp.diff(a, n=n, axis=axis)
+
+
+def _trapezoid_raw(y, dx=1.0, axis=-1):
+    return jax.scipy.integrate.trapezoid(y, dx=dx, axis=axis)
+
+
+def _running_extreme(a, axis, better):
+    """(values, indices) of the running max/min along `axis`: one
+    associative scan over (value, index) pairs — ties keep the FIRST
+    occurrence (paddle/torch cummax semantics). axis=None flattens."""
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    ax = axis % a.ndim
+    idx0 = lax.broadcasted_iota(jnp.int64, a.shape, ax)
+
+    def comb(x, y):
+        va, ia = x
+        vb, ib = y
+        take_b = better(vb, va)
+        return jnp.where(take_b, vb, va), jnp.where(take_b, ib, ia)
+
+    vals, idx = lax.associative_scan(comb, (a, idx0), axis=ax)
+    return vals, idx.astype(convert_dtype("int64"))
+
+
+def _cummax_raw(a, axis=-1):
+    return _running_extreme(a, axis, lambda b, a_: b > a_)
+
+
+def _cummin_raw(a, axis=-1):
+    return _running_extreme(a, axis, lambda b, a_: b < a_)
+
+
+def _logcumsumexp_raw(a, axis=-1):
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+
+    def op(x, y):
+        return jnp.logaddexp(x, y)
+    return lax.associative_scan(op, a, axis=axis)
+
+
+def _searchsorted_raw(sorted_seq, values, right=False):
+    side = "right" if right else "left"
+    if sorted_seq.ndim == 1:
+        return jnp.searchsorted(sorted_seq, values, side=side).astype(
+            convert_dtype("int64"))
+    # N-D: leading dims of sorted_seq and values must match (paddle
+    # searchsorted); flatten them and vmap row-wise
+    lead = sorted_seq.shape[:-1]
+    ss2 = sorted_seq.reshape((-1, sorted_seq.shape[-1]))
+    vv2 = values.reshape((ss2.shape[0], -1))
+    out = jax.vmap(lambda s_, v_: jnp.searchsorted(s_, v_, side=side))(
+        ss2, vv2)
+    return out.reshape(values.shape).astype(convert_dtype("int64"))
+
+
+def _bucketize_raw(a, bins, right=False):
+    return jnp.searchsorted(bins, a,
+                            side="right" if right else "left").astype(
+        convert_dtype("int64"))
+
+
+def _renorm_raw(a, p=2.0, axis=0, max_norm=1.0):
+    moved = jnp.moveaxis(a, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p), axis=1),
+                      1.0 / p)
+    scale_f = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    out = flat * scale_f[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+def _quantile_raw(a, q=0.5, axis=None, keepdim=False, ignore_nan=False):
+    qs = jnp.asarray(q)
+    fn = jnp.nanquantile if ignore_nan else jnp.quantile
+    return fn(a, qs, axis=_axis_arg(axis), keepdims=keepdim)
+
+
+def _dist_raw(a, b, p=2.0):
+    d = (a - b).ravel()
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(a.dtype)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+def _angle_raw(a):
+    return jnp.angle(a)
+
+
+def _conj_raw(a):
+    return jnp.conj(a)
+
+
+def _real_raw(a):
+    return jnp.real(a)
+
+
+def _imag_raw(a):
+    return jnp.imag(a)
+
+
+def _complex_raw(a, b):
+    return lax.complex(a, b)
+
+
+def _polar_raw(r, theta):
+    return lax.complex(r * jnp.cos(theta), r * jnp.sin(theta))
+
+
+def _sgn_raw(a):
+    if jnp.issubdtype(a.dtype, jnp.complexfloating):
+        mag = jnp.abs(a)
+        return jnp.where(mag == 0, 0.0 + 0.0j, a / jnp.maximum(mag, 1e-30))
+    return jnp.sign(a)
+
+
+def _signbit_raw(a):
+    return jnp.signbit(a)
+
+
+def _ldexp_raw(a, b):
+    return a * jnp.exp2(b.astype(jnp.float32)).astype(a.dtype)
+
+
+register_op("lerp", _lerp_raw)
+register_op("heaviside", _heaviside_raw)
+register_op("logit", _logit_raw)
+register_op("logaddexp", _logaddexp_raw)
+register_op("xlogy", _xlogy_raw)
+register_op("sinc", _sinc_raw)
+register_op("exp2", _exp2_raw)
+register_op("rad2deg", _rad2deg_raw)
+register_op("deg2rad", _deg2rad_raw)
+register_op("copysign", _copysign_raw)
+register_op("nextafter", _nextafter_raw)
+register_op("gcd", _gcd_raw)
+register_op("lcm", _lcm_raw)
+register_op("diff", _diff_raw)
+register_op("trapezoid", _trapezoid_raw)
+register_op("cummax", _cummax_raw)
+register_op("cummin", _cummin_raw)
+register_op("logcumsumexp", _logcumsumexp_raw)
+register_op("searchsorted", _searchsorted_raw)
+register_op("bucketize", _bucketize_raw)
+register_op("renorm", _renorm_raw)
+register_op("quantile", _quantile_raw)
+register_op("dist", _dist_raw)
+register_op("angle", _angle_raw)
+register_op("conj", _conj_raw)
+register_op("real", _real_raw)
+register_op("imag", _imag_raw)
+register_op("complex", _complex_raw)
+register_op("polar", _polar_raw)
+register_op("sgn", _sgn_raw)
+register_op("signbit", _signbit_raw)
+register_op("ldexp", _ldexp_raw)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(_lerp_raw, (x, y, weight), name="lerp")
+    return apply(_lerp_raw, (x, y, Tensor(jnp.asarray(weight))),
+                 name="lerp")
+
+
+def heaviside(x, y, name=None):
+    return apply(_heaviside_raw, (x, y), differentiable=False,
+                 name="heaviside")
+
+
+def logit(x, eps=None, name=None):
+    return apply(_logit_raw, (x,),
+                 {"eps": None if eps is None else float(eps)}, name="logit")
+
+
+def logaddexp(x, y, name=None):
+    return apply(_logaddexp_raw, (x, y), name="logaddexp")
+
+
+def xlogy(x, y, name=None):
+    return apply(_xlogy_raw, (x, y), name="xlogy")
+
+
+def sinc(x, name=None):
+    return apply(_sinc_raw, (x,), name="sinc")
+
+
+def exp2(x, name=None):
+    return apply(_exp2_raw, (x,), name="exp2")
+
+
+def rad2deg(x, name=None):
+    return apply(_rad2deg_raw, (x,), name="rad2deg")
+
+
+def deg2rad(x, name=None):
+    return apply(_deg2rad_raw, (x,), name="deg2rad")
+
+
+def copysign(x, y, name=None):
+    return apply(_copysign_raw, (x, y), differentiable=False,
+                 name="copysign")
+
+
+def nextafter(x, y, name=None):
+    return apply(_nextafter_raw, (x, y), differentiable=False,
+                 name="nextafter")
+
+
+def gcd(x, y, name=None):
+    return apply(_gcd_raw, (x, y), differentiable=False, name="gcd")
+
+
+def lcm(x, y, name=None):
+    return apply(_lcm_raw, (x, y), differentiable=False, name="lcm")
+
+
+def diff(x, n=1, axis=-1, name=None):
+    return apply(_diff_raw, (x,), {"n": int(n), "axis": int(axis)},
+                 name="diff")
+
+
+def trapezoid(y, x=None, dx=1.0, axis=-1, name=None):
+    if x is not None:
+        raise NotImplementedError("trapezoid: sample-point x unsupported; "
+                                  "pass dx")
+    return apply(_trapezoid_raw, (y,),
+                 {"dx": float(dx), "axis": int(axis)}, name="trapezoid")
+
+
+def cummax(x, axis=None, name=None):
+    vals, idx = apply(_cummax_raw, (x,),
+                      {"axis": None if axis is None else int(axis)},
+                      name="cummax")
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def cummin(x, axis=None, name=None):
+    vals, idx = apply(_cummin_raw, (x,),
+                      {"axis": None if axis is None else int(axis)},
+                      name="cummin")
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def logcumsumexp(x, axis=None, name=None):
+    return apply(_logcumsumexp_raw, (x,),
+                 {"axis": None if axis is None else int(axis)},
+                 name="logcumsumexp")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    out = apply(_searchsorted_raw, (sorted_sequence, values),
+                {"right": bool(right)}, differentiable=False,
+                name="searchsorted")
+    from .manipulation import cast as _cast
+    return _cast(out, "int32") if out_int32 else out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    out = apply(_bucketize_raw, (x, sorted_sequence),
+                {"right": bool(right)}, differentiable=False,
+                name="bucketize")
+    from .manipulation import cast as _cast
+    return _cast(out, "int32") if out_int32 else out
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    return apply(_renorm_raw, (x,),
+                 {"p": float(p), "axis": int(axis),
+                  "max_norm": float(max_norm)}, name="renorm")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(_quantile_raw, (x,),
+                 {"q": q if isinstance(q, (int, float)) else list(q),
+                  "axis": _axis_attr(axis), "keepdim": bool(keepdim),
+                  "ignore_nan": False}, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(_quantile_raw, (x,),
+                 {"q": q if isinstance(q, (int, float)) else list(q),
+                  "axis": _axis_attr(axis), "keepdim": bool(keepdim),
+                  "ignore_nan": True}, name="quantile")
+
+
+def dist(x, y, p=2.0, name=None):
+    return apply(_dist_raw, (x, y), {"p": float(p)}, name="dist")
+
+
+def angle(x, name=None):
+    return apply(_angle_raw, (x,), differentiable=False, name="angle")
+
+
+def conj(x, name=None):
+    return apply(_conj_raw, (x,), name="conj")
+
+
+def real(x, name=None):
+    return apply(_real_raw, (x,), name="real")
+
+
+def imag(x, name=None):
+    return apply(_imag_raw, (x,), name="imag")
+
+
+def complex(real_t, imag_t, name=None):
+    return apply(_complex_raw, (real_t, imag_t), name="complex")
+
+
+def polar(abs_t, angle_t, name=None):
+    return apply(_polar_raw, (abs_t, angle_t), name="polar")
+
+
+def sgn(x, name=None):
+    return apply(_sgn_raw, (x,), differentiable=False, name="sgn")
+
+
+def signbit(x, name=None):
+    return apply(_signbit_raw, (x,), differentiable=False, name="signbit")
+
+
+def ldexp(x, y, name=None):
+    return apply(_ldexp_raw, (x, y), differentiable=False, name="ldexp")

@@ -551,6 +551,49 @@ SPECS = {
                             {"win_size": 2, "pad_value": 0}, grad=False),
     "sequence_topk_avg_pooling": S([F32((2, 4)), np.array([3, 4], "i4")],
                                    {"topks": [1, 2]}, grad=False),
+    # --- round-3 math tail ---
+    "lerp": S([F32(seed=1), F32(seed=2), POS((2, 3)) / 3.0]),
+    "heaviside": S([F32(seed=1), F32(seed=2)], grad=False),
+    "logit": S([POS((2, 3)) / 3.0], {"eps": 1e-4}),
+    "logaddexp": S([F32(seed=1), F32(seed=2)]),
+    "xlogy": S([POS(seed=1), POS(seed=2)]),
+    "sinc": S([F32()]),
+    "exp2": S([F32()]),
+    "rad2deg": S([F32()]),
+    "deg2rad": S([F32()]),
+    "copysign": S([F32(seed=1), F32(seed=2)], grad=False),
+    "nextafter": S([F32(seed=1), F32(seed=2)], grad=False),
+    "gcd": S([I32(seed=1, hi=20) + 1, I32(seed=2, hi=20) + 1], grad=False),
+    "lcm": S([I32(seed=1, hi=6) + 1, I32(seed=2, hi=6) + 1], grad=False),
+    "diff": S([F32((2, 6))], {"n": 1, "axis": -1}),
+    "trapezoid": S([F32((2, 6))], {"dx": 0.5, "axis": -1}),
+    "cummax": S([F32((2, 6))], {"axis": -1}, grad=False, out0=True),
+    "cummin": S([F32((2, 6))], {"axis": -1}, grad=False, out0=True),
+    "logcumsumexp": S([F32((2, 6))], {"axis": -1}),
+    "searchsorted": S([np.sort(F32((8,), 1)), F32((5,), 2)],
+                      {"right": False}, grad=False),
+    "bucketize": S([F32((2, 3)), np.sort(F32((6,), 1))],
+                   {"right": False}, grad=False),
+    "renorm": S([F32((3, 4))], {"p": 2.0, "axis": 0, "max_norm": 0.5}),
+    "quantile": S([F32((2, 8))], {"q": 0.25, "axis": 1, "keepdim": False,
+                                  "ignore_nan": False}, grad=False),
+    "dist": S([F32(seed=1), F32(seed=2)], {"p": 2.0}),
+    "angle": S([F32((2, 3)).astype("complex64")], grad=False),
+    "conj": S([F32((2, 3)).astype("complex64")], grad=False),
+    "real": S([F32((2, 3)).astype("complex64")], grad=False),
+    "imag": S([F32((2, 3)).astype("complex64")], grad=False),
+    "complex": S([F32(seed=1), F32(seed=2)], grad=False),
+    "polar": S([POS(seed=1), F32(seed=2)], grad=False),
+    "sgn": S([F32()], grad=False),
+    "signbit": S([F32()], grad=False),
+    "ldexp": S([F32(seed=1), I32(hi=3)], grad=False),
+    "take": S([F32((3, 4)), I32((5,), hi=12)], {"mode": "clip"}),
+    "index_add": S([F32((4, 3)), I32((2,), hi=4), F32((2, 3), 5)],
+                   {"axis": 0}),
+    "index_put": S([F32((4, 3)), I32((2, 2), hi=3), F32((2,), 5)],
+                   {"accumulate": True}),
+    "masked_scatter": S([F32((3, 4)), BOOL((3, 4)), F32((12,), 5)]),
+    "unflatten": S([F32((2, 12))], {"axis": 1, "shape": [3, 4]}),
     # --- decode / misc ---
     "accuracy": S([F32((4, 5)), I32((4, 1), hi=5)], {"k": 2}, grad=False),
     "clip_by_norm": S([F32()], {"max_norm": 0.5}),
@@ -676,3 +719,42 @@ def test_registry_op(name):
         want = np.asarray(outs[0])
         np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
                                    atol=1e-5, err_msg=f"{name}: desc replay")
+
+
+def test_cummax_indices_match_reference():
+    """Paddle cummax returns SAME-SHAPE per-position indices (first
+    occurrence on ties) — the reduced-shape regression the review
+    caught."""
+    import paddle_tpu as p
+    x = p.to_tensor(np.array([[1., 3., 3.], [5., 4., 6.]], "f4"))
+    v, i = p.cummax(x, axis=-1)
+    np.testing.assert_array_equal(np.asarray(v.numpy()),
+                                  [[1, 3, 3], [5, 5, 6]])
+    np.testing.assert_array_equal(np.asarray(i.numpy()),
+                                  [[0, 1, 1], [0, 0, 2]])
+    v, i = p.cummin(x, axis=0)
+    # row1: 5>1, 4>3, 6>3 -> running min unchanged, indices stay 0
+    np.testing.assert_array_equal(np.asarray(i.numpy()),
+                                  [[0, 0, 0], [0, 0, 0]])
+    v, i = p.cummin(p.to_tensor(np.array([[3., 1.]], "f4").T), axis=0)
+    np.testing.assert_array_equal(np.asarray(i.numpy()), [[0], [1]])
+    # default axis=None flattens (paddle semantics)
+    v, i = p.cummax(x)
+    assert v.shape == [6] and i.shape == [6]
+
+
+def test_index_put_broadcastable_and_searchsorted_nd():
+    import paddle_tpu as p
+    x = p.to_tensor(np.zeros((4, 3), "f4"))
+    out = p.index_put(x, (p.to_tensor(np.array([0, 1])),
+                          p.to_tensor(np.array([2]))),
+                      p.to_tensor(np.array([7.0, 8.0], "f4")))
+    got = np.asarray(out.numpy())
+    assert got[0, 2] == 7.0 and got[1, 2] == 8.0
+    ss = np.sort(np.random.RandomState(0).rand(2, 3, 4).astype("f4"))
+    vv = np.random.RandomState(1).rand(2, 3, 2).astype("f4")
+    out = p.searchsorted(p.to_tensor(ss), p.to_tensor(vv))
+    assert list(out.shape) == [2, 3, 2]
+    assert float(p.dist(p.to_tensor(np.array([1., 5.], "f4")),
+                        p.to_tensor(np.array([3., 5.], "f4")),
+                        p=float("-inf")).numpy()) == 0.0
