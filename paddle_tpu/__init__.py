@@ -42,7 +42,13 @@ from .ops.math import (  # noqa: F401
     copysign, nextafter, gcd, lcm, diff, trapezoid, cummax, cummin,
     logcumsumexp, searchsorted, bucketize, renorm, quantile, nanquantile,
     dist, angle, conj, real, imag, complex, polar, sgn, signbit, ldexp,
-    hypot, frac, nansum, nanmean,
+    hypot, frac, nansum, nanmean, add_n, mv, numel, broadcast_shape,
+)
+from .ops.linalg import (  # noqa: F401  (also under paddle.linalg)
+    cholesky, cross, inverse, norm, histogram, bincount,
+)
+from .static.control_flow import (  # noqa: F401  (legacy TensorArray API)
+    array_write, array_read, array_length, create_array,
 )
 from .ops.manipulation import (  # noqa: F401
     cast, reshape, reshape_, flatten, transpose, moveaxis, swapaxes, t, concat,
@@ -91,6 +97,60 @@ from . import rec  # noqa: E402
 from .framework.serialization import save, load  # noqa: E402
 from .hapi.model import Model, summary  # noqa: E402
 from .framework.state import get_flags, set_flags  # noqa: E402,F811
+
+# inplace tensor-method variants (ref tensor/manipulation.py *_ APIs);
+# one aliasing helper (nn.functional._inplace) owns the slot contract
+def scatter_(x, index, updates, overwrite=True, name=None):
+    from .nn.functional import _inplace
+    return _inplace(x, scatter(x, index, updates, overwrite=overwrite))
+
+
+def squeeze_(x, axis=None, name=None):
+    from .nn.functional import _inplace
+    return _inplace(x, squeeze(x, axis=axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    from .nn.functional import _inplace
+    return _inplace(x, unsqueeze(x, axis))
+
+
+def tanh_(x, name=None):
+    from .nn.functional import _inplace
+    return _inplace(x, tanh(x))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    """ref tensor/random.py gaussian."""
+    return normal(mean=mean, std=std, shape=shape)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """ref tensor/to_string.py set_printoptions: Tensor.__repr__ delegates
+    to numpy, so numpy's printoptions ARE the framework's print state."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def to_string(x, prefix="Tensor"):
+    import numpy as _np
+    a = x.numpy() if hasattr(x, "numpy") else _np.asarray(x)
+    return (f"{prefix}(shape={list(a.shape)}, dtype={a.dtype}, "
+            f"stop_gradient={getattr(x, 'stop_gradient', True)},\n"
+            f"       {_np.array2string(a, prefix='       ')})")
+
 
 # dygraph-mode queries (reference framework.py:182 in_dygraph_mode)
 def in_dynamic_mode():

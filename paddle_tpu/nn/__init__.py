@@ -11,22 +11,26 @@ from .layers_common import (PairwiseDistance, Unfold,
                             Pad3D, Upsample, UpsamplingBilinear2D,
                             UpsamplingNearest2D, PixelShuffle, Bilinear,
                             CosineSimilarity)
-from .conv import (Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose)
+from .conv import (Conv1D, Conv2D, Conv3D, Conv2DTranspose,
+                   Conv1DTranspose, Conv3DTranspose)
 from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                    SyncBatchNorm, LayerNorm, GroupNorm, InstanceNorm1D,
                    InstanceNorm2D, InstanceNorm3D, LocalResponseNorm,
                    SpectralNorm)
-from .pooling import (MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D,
-                      AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D)
+from .pooling import (MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D,
+                      AvgPool2D, AvgPool3D, AdaptiveAvgPool1D,
+                      AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+                      AdaptiveMaxPool1D, AdaptiveMaxPool2D,
+                      AdaptiveMaxPool3D)
 from .activation import (ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish,
                          Hardswish, Hardsigmoid, Softsign, Tanhshrink, GELU,
                          LeakyReLU, ELU, CELU, SELU, PReLU, Hardtanh,
                          Hardshrink, Softshrink, Softplus, Softmax, LogSoftmax,
-                         Maxout)
+                         Maxout, LogSigmoid, ThresholdedReLU)
 from .loss import (CTCLoss,
                    CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss,
                    BCELoss, BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss,
-                   HingeEmbeddingLoss)
+                   HingeEmbeddingLoss, HSigmoidLoss)
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
 from . import transformer
 from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
@@ -34,7 +38,7 @@ from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           TransformerDecoder, Transformer)
 from . import rnn
 from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
-                  SimpleRNN, LSTM, GRU)
+                  SimpleRNN, LSTM, GRU, RNNBase)
 from . import decode
 from .decode import (BeamSearchDecoder, dynamic_decode,
                      top_k_top_p_filtering, sampling_id, greedy_search)

@@ -509,52 +509,14 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def _conv2d_transpose_raw(a, w, *maybe_b, stride=1, padding=0,
                           output_padding=0, dilation=1, groups=1,
                           channels_last=False):
-    """weight layout: [in_c, out_c/groups, kh, kw] (ref conv_transpose_op.cc)."""
-    n = 2
-    strides = _norm_tuple(stride, n)
-    dilations = _norm_tuple(dilation, n)
-    out_pad = _norm_tuple(output_padding, n)
+    """weight layout: [in_c, out_c/groups, kh, kw] (ref conv_transpose_op.cc).
+    Thin layout shim over the shared N-d impl (_convnd_transpose_raw)."""
     if channels_last:
-        a_nchw = jnp.transpose(a, (0, 3, 1, 2))
-    else:
-        a_nchw = a
-    pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
-    if isinstance(pad, str):
-        pad_list = [(0, 0)] * n if pad == "VALID" else None
-        if pad_list is None:
-            raise ValueError("SAME padding unsupported for conv_transpose")
-        pad = pad_list
-    kh = [((w.shape[2 + i] - 1) * dilations[i] + 1) for i in range(n)]
-    trans_pad = [
-        (kh[i] - 1 - pad[i][0], kh[i] - 1 - pad[i][1] + out_pad[i])
-        for i in range(n)]
-    # grouped transpose conv: weight [in_c, out_c/g, kh, kw]
-    w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
-    if groups == 1:
-        w_t = jnp.transpose(w_flip, (1, 0, 2, 3))  # -> [out_c, in_c, kh, kw]
-        dn = lax.conv_dimension_numbers(a_nchw.shape, w_t.shape,
-                                        ("NCHW", "OIHW", "NCHW"))
-        out = lax.conv_general_dilated(
-            a_nchw, w_t, window_strides=(1, 1), padding=trans_pad,
-            lhs_dilation=strides, rhs_dilation=dilations,
-            dimension_numbers=dn)
-    else:
-        ic = a_nchw.shape[1]
-        icg = ic // groups
-        outs = []
-        for g in range(groups):
-            wg = w_flip[g * icg:(g + 1) * icg]
-            wg_t = jnp.transpose(wg, (1, 0, 2, 3))
-            dn = lax.conv_dimension_numbers(
-                (a_nchw.shape[0], icg) + a_nchw.shape[2:], wg_t.shape,
-                ("NCHW", "OIHW", "NCHW"))
-            outs.append(lax.conv_general_dilated(
-                a_nchw[:, g * icg:(g + 1) * icg], wg_t, window_strides=(1, 1),
-                padding=trans_pad, lhs_dilation=strides,
-                rhs_dilation=dilations, dimension_numbers=dn))
-        out = jnp.concatenate(outs, axis=1)
-    if maybe_b:
-        out = out + maybe_b[0].reshape(1, -1, 1, 1)
+        a = jnp.transpose(a, (0, 3, 1, 2))
+    out = _convnd_transpose_raw(a, w, *maybe_b, n=2, stride=stride,
+                                padding=padding,
+                                output_padding=output_padding,
+                                dilation=dilation, groups=groups)
     if channels_last:
         out = jnp.transpose(out, (0, 2, 3, 1))
     return out
@@ -576,9 +538,9 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                  name="conv2d_transpose")
 
 
-def _pool2d_raw(a, ksize=1, strides=None, padding=0, channels_last=False,
-                average=False, count_include_pad=True):
-    n = 2
+def _poolnd_raw(a, n=2, ksize=1, strides=None, padding=0,
+                channels_last=False, average=False, count_include_pad=True):
+    """Shared 1/2/3-d pooling over lax.reduce_window (NCX or NXC)."""
     ksize = _norm_tuple(ksize, n)
     strides = _norm_tuple(strides or ksize, n)
     if not channels_last:
@@ -587,7 +549,7 @@ def _pool2d_raw(a, ksize=1, strides=None, padding=0, channels_last=False,
     else:
         dims = (1,) + ksize + (1,)
         strd = (1,) + strides + (1,)
-    pad = _conv_padding(padding, n, strides, (1, 1), ksize)
+    pad = _conv_padding(padding, n, strides, (1,) * n, ksize)
     if isinstance(pad, str):
         pad_cfg = pad
     else:
@@ -612,8 +574,8 @@ def _pool2d_raw(a, ksize=1, strides=None, padding=0, channels_last=False,
     return out
 
 
-register_op("max_pool2d", functools.partial(_pool2d_raw, average=False))
-register_op("avg_pool2d", functools.partial(_pool2d_raw, average=True))
+register_op("max_pool2d", functools.partial(_poolnd_raw, n=2, average=False))
+register_op("avg_pool2d", functools.partial(_poolnd_raw, n=2, average=True))
 
 
 def _pool(x, ksize, strides, padding, data_format, name,
@@ -1568,3 +1530,261 @@ def gather_tree(ids, parents):
     per-(batch, beam) host loops."""
     return apply(_gather_tree_raw, (ids, parents), differentiable=False,
                  name="gather_tree")
+
+
+# --------------------------------------------------------------- round-3 tail
+# (last nn.functional gaps vs ref python/paddle/nn/functional: 1d/3d pools,
+# 1d/3d transposed convs, log_sigmoid/thresholded_relu, hsigmoid_loss,
+# inplace variants)
+
+def _log_sigmoid_raw(a):
+    return jax.nn.log_sigmoid(a)
+
+
+def _thresholded_relu_raw(a, threshold=1.0):
+    return jnp.where(a > threshold, a, 0.0)
+
+
+register_op("log_sigmoid", _log_sigmoid_raw)
+register_op("thresholded_relu", _thresholded_relu_raw)
+
+
+def log_sigmoid(x, name=None):
+    return apply(_log_sigmoid_raw, (x,), name="log_sigmoid")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(_thresholded_relu_raw, (x,),
+                 {"threshold": float(threshold)}, name="thresholded_relu")
+
+
+def _inplace(x, out):
+    x._data = out._data
+    x._node, x._slot = out._node, out._slot
+    return x
+
+
+def relu_(x, name=None):
+    return _inplace(x, relu(x))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return _inplace(x, elu(x, alpha=alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return _inplace(x, softmax(x, axis=axis, dtype=dtype))
+
+
+register_op("max_pool3d", functools.partial(_poolnd_raw, n=3, average=False))
+register_op("avg_pool3d", functools.partial(_poolnd_raw, n=3, average=True))
+
+
+def _reject_pool_extras(data_format, canonical, ceil_mode=False):
+    if data_format not in (None, canonical):
+        raise NotImplementedError(
+            f"pooling: only {canonical} layout supported, got {data_format}")
+    if ceil_mode:
+        raise NotImplementedError("pooling: ceil_mode=True unsupported")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    from ..ops.dispatch import OP_REGISTRY
+    _reject_pool_extras(data_format, "NCDHW", ceil_mode)
+    return apply(OP_REGISTRY["max_pool3d"], (x,),
+                 {"ksize": _stride_attr(kernel_size),
+                  "strides": None if stride is None else _stride_attr(stride),
+                  "padding": _pad_attr(padding)}, name="max_pool3d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               count_include_pad=True, divisor_override=None,
+               data_format="NCDHW", name=None):
+    from ..ops.dispatch import OP_REGISTRY
+    _reject_pool_extras(data_format, "NCDHW", ceil_mode)
+    if divisor_override is not None:
+        raise NotImplementedError("avg_pool3d: divisor_override unsupported")
+    return apply(OP_REGISTRY["avg_pool3d"], (x,),
+                 {"ksize": _stride_attr(kernel_size),
+                  "strides": None if stride is None else _stride_attr(stride),
+                  "padding": _pad_attr(padding),
+                  "count_include_pad": bool(count_include_pad)},
+                 name="avg_pool3d")
+
+
+def _adaptive_poolnd_raw(a, output_size=1, n=2, average=True):
+    """Divisible-size adaptive pool for any spatial rank (reshape-reduce)."""
+    out_sz = _norm_tuple(output_size, n)
+    lead = a.shape[:a.ndim - n]
+    spatial = a.shape[a.ndim - n:]
+    shape = list(lead)
+    red_axes = []
+    for i, (s, o) in enumerate(zip(spatial, out_sz)):
+        if s % o:
+            raise NotImplementedError(
+                "adaptive pooling with non-divisible sizes not supported")
+        shape += [o, s // o]
+        red_axes.append(len(lead) + 2 * i + 1)
+    r = a.reshape(shape)
+    return (r.mean(axis=tuple(red_axes)) if average
+            else r.max(axis=tuple(red_axes)))
+
+
+register_op("adaptive_avg_pool1d",
+            functools.partial(_adaptive_poolnd_raw, n=1, average=True))
+register_op("adaptive_max_pool1d",
+            functools.partial(_adaptive_poolnd_raw, n=1, average=False))
+register_op("adaptive_avg_pool3d",
+            functools.partial(_adaptive_poolnd_raw, n=3, average=True))
+register_op("adaptive_max_pool3d",
+            functools.partial(_adaptive_poolnd_raw, n=3, average=False))
+
+
+def _adaptive_pool_fn(opname):
+    from ..ops.dispatch import OP_REGISTRY
+
+    def fn(x, output_size, name=None, return_mask=False,
+           data_format=None):
+        if data_format not in (None, "NCL", "NCHW", "NCDHW"):
+            raise NotImplementedError(
+                f"{opname}: only channels-first layouts supported, "
+                f"got {data_format}")
+        return apply(OP_REGISTRY[opname], (x,),
+                     {"output_size": _stride_attr(output_size)},
+                     name=opname)
+    fn.__name__ = opname
+    return fn
+
+
+adaptive_avg_pool1d = _adaptive_pool_fn("adaptive_avg_pool1d")
+adaptive_max_pool1d = _adaptive_pool_fn("adaptive_max_pool1d")
+adaptive_avg_pool3d = _adaptive_pool_fn("adaptive_avg_pool3d")
+adaptive_max_pool3d = _adaptive_pool_fn("adaptive_max_pool3d")
+
+
+def _convnd_transpose_raw(a, w, *maybe_b, n=2, stride=1, padding=0,
+                          output_padding=0, dilation=1, groups=1):
+    """N-d transposed conv, NCX layout, weight [in_c, out_c/g, *k]
+    (generalizes the 2-d path; ref conv_transpose_op.cc)."""
+    strides = _norm_tuple(stride, n)
+    dilations = _norm_tuple(dilation, n)
+    out_pad = _norm_tuple(output_padding, n)
+    pad = _conv_padding(padding, n, strides, dilations, w.shape[2:])
+    if isinstance(pad, str):
+        if pad != "VALID":
+            raise ValueError("SAME padding unsupported for conv_transpose")
+        pad = [(0, 0)] * n
+    keff = [((w.shape[2 + i] - 1) * dilations[i] + 1) for i in range(n)]
+    trans_pad = [(keff[i] - 1 - pad[i][0],
+                  keff[i] - 1 - pad[i][1] + out_pad[i]) for i in range(n)]
+    w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+    spatial = "DHW"[3 - n:]
+    dn_str = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+    perm = (1, 0) + tuple(range(2, 2 + n))
+
+    def one(a_g, w_g):
+        w_t = jnp.transpose(w_g, perm)       # -> [out_c/g, in_c/g, *k]
+        dn = lax.conv_dimension_numbers(a_g.shape, w_t.shape, dn_str)
+        return lax.conv_general_dilated(
+            a_g, w_t, window_strides=(1,) * n, padding=trans_pad,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn)
+
+    if groups == 1:
+        out = one(a, w_flip)
+    else:
+        icg = a.shape[1] // groups
+        out = jnp.concatenate(
+            [one(a[:, g * icg:(g + 1) * icg],
+                 w_flip[g * icg:(g + 1) * icg]) for g in range(groups)],
+            axis=1)
+    if maybe_b:
+        out = out + maybe_b[0].reshape((1, -1) + (1,) * n)
+    return out
+
+
+register_op("conv1d_transpose",
+            functools.partial(_convnd_transpose_raw, n=1))
+register_op("conv3d_transpose",
+            functools.partial(_convnd_transpose_raw, n=3))
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    from ..ops.dispatch import OP_REGISTRY
+    if data_format != "NCL":
+        raise NotImplementedError(
+            f"conv1d_transpose: only NCL supported, got {data_format}")
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(OP_REGISTRY["conv1d_transpose"], args,
+                 {"stride": _stride_attr(stride), "padding": _pad_attr(padding),
+                  "output_padding": _stride_attr(output_padding),
+                  "dilation": _stride_attr(dilation), "groups": int(groups)},
+                 name="conv1d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    from ..ops.dispatch import OP_REGISTRY
+    if data_format != "NCDHW":
+        raise NotImplementedError(
+            f"conv3d_transpose: only NCDHW supported, got {data_format}")
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(OP_REGISTRY["conv3d_transpose"], args,
+                 {"stride": _stride_attr(stride), "padding": _pad_attr(padding),
+                  "output_padding": _stride_attr(output_padding),
+                  "dilation": _stride_attr(dilation), "groups": int(groups)},
+                 name="conv3d_transpose")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """ref nn/functional/common.py bilinear: out[b,o] = x1 W_o x2 + b."""
+    from ..nn.layers_common import _bilinear_raw
+    args = (x1, x2, weight) if bias is None else (x1, x2, weight, bias)
+    return apply(_bilinear_raw, args, name="bilinear")
+
+
+def _hsigmoid_loss_raw(x, lab, w, *maybe_b, num_classes=2):
+    """Hierarchical sigmoid over the default COMPLETE binary tree (ref
+    hierarchical_sigmoid_op.cc without custom paths): internal nodes are
+    1..C-1 heap-style; class c maps to leaf c + (C-1); the loss is the
+    sum of binary CE along the root->leaf path. Static shapes: every path
+    is padded to ceil(log2(C)) with zero-weight steps."""
+    C = num_classes
+    depth = max(int(np.ceil(np.log2(max(C, 2)))), 1)
+    leaf = lab.reshape(-1).astype(jnp.int32) + (C - 1)   # accepts [N] or [N,1]
+    losses = jnp.zeros(x.shape[0], jnp.float32)
+    node = leaf
+    for _ in range(depth):
+        parent = (node - 1) // 2
+        is_right = (node % 2 == 0) & (node > 0)
+        valid = node > 0
+        # internal-node weight row: parent index in [0, C-1)
+        row = jnp.clip(parent, 0, C - 2)
+        z = jnp.einsum("nd,nd->n", x, w[row])
+        if maybe_b:
+            z = z + maybe_b[0].reshape(-1)[row]
+        t = is_right.astype(jnp.float32)
+        bce = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        losses = losses + jnp.where(valid, bce, 0.0)
+        node = parent
+    return losses[:, None]
+
+
+register_op("hsigmoid_loss", _hsigmoid_loss_raw)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid_loss: custom path tables not supported (default "
+            "complete-binary-tree only)")
+    args = (input, label, weight) if bias is None \
+        else (input, label, weight, bias)
+    return apply(_hsigmoid_loss_raw, args, {"num_classes": int(num_classes)},
+                 name="hsigmoid_loss")

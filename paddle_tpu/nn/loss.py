@@ -129,3 +129,26 @@ class CTCLoss(Layer):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
                           blank=self.blank, reduction=self.reduction,
                           norm_by_times=norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """ref nn/layer/loss.py HSigmoidLoss: hierarchical sigmoid over the
+    default complete binary tree (custom path tables unsupported)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError("HSigmoidLoss: custom trees "
+                                      "unsupported")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = (self.create_parameter([num_classes - 1, 1],
+                                           attr=bias_attr, is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)

@@ -517,3 +517,7 @@ class GRU(_RNNBase):
                  **kwargs):
         super().__init__("GRU", input_size, hidden_size, num_layers,
                          direction, time_major, dropout, **kwargs)
+
+
+# public alias (ref nn/layer/rnn.py RNNBase)
+RNNBase = _RNNBase

@@ -929,3 +929,35 @@ def signbit(x, name=None):
 
 def ldexp(x, y, name=None):
     return apply(_ldexp_raw, (x, y), differentiable=False, name="ldexp")
+
+
+def add_n(inputs, name=None):
+    """ref sum_op: elementwise sum of a tensor list."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = add(out, t)
+    return out
+
+
+def _mv_raw(a, v):
+    return jnp.matmul(a, v)
+
+
+register_op("mv", _mv_raw)
+
+
+def mv(x, vec, name=None):
+    return apply(_mv_raw, (x, vec), name="mv")
+
+
+def numel(x, name=None):
+    from ..framework.tensor import Tensor as _T
+    # default int width (int64 under x64, int32 otherwise — avoids the
+    # jax truncation warning; paddle's int64 intent is preserved on x64)
+    return _T(jnp.asarray(int(np.prod(x.shape))))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

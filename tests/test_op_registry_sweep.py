@@ -594,6 +594,24 @@ SPECS = {
                    {"accumulate": True}),
     "masked_scatter": S([F32((3, 4)), BOOL((3, 4)), F32((12,), 5)]),
     "unflatten": S([F32((2, 12))], {"axis": 1, "shape": [3, 4]}),
+    # --- nn.functional 1d/3d tail ---
+    "max_pool3d": S([F32((1, 2, 4, 4, 4))], {"ksize": 2}, grad=False),
+    "avg_pool3d": S([F32((1, 2, 4, 4, 4))], {"ksize": 2}),
+    "adaptive_avg_pool1d": S([F32((1, 2, 8))], {"output_size": 4}),
+    "adaptive_max_pool1d": S([F32((1, 2, 8))], {"output_size": 4},
+                             grad=False),
+    "adaptive_avg_pool3d": S([F32((1, 2, 4, 4, 4))], {"output_size": 2}),
+    "adaptive_max_pool3d": S([F32((1, 2, 4, 4, 4))], {"output_size": 2},
+                             grad=False),
+    "conv1d_transpose": S([F32((1, 2, 6)), F32((2, 3, 3), 1)],
+                          {"stride": 2}),
+    "conv3d_transpose": S([F32((1, 2, 3, 3, 3)), F32((2, 3, 2, 2, 2), 1)],
+                          {"stride": 2}),
+    "log_sigmoid": S([F32()]),
+    "thresholded_relu": S([F32()], {"threshold": 0.5}, grad=False),
+    "hsigmoid_loss": S([F32((4, 8)), I32((4,), hi=6), F32((5, 8), 1)],
+                       {"num_classes": 6}),
+    "mv": S([F32((3, 4), 1), F32((4,), 2)]),
     # --- decode / misc ---
     "accuracy": S([F32((4, 5)), I32((4, 1), hi=5)], {"k": 2}, grad=False),
     "clip_by_norm": S([F32()], {"max_norm": 0.5}),
