@@ -15,3 +15,6 @@ from .parallel import DataParallel
 from .ring_attention import ring_attention, ring_flash_attention
 from . import fleet
 from .spawn import spawn
+from . import utils
+from .utils import (find_free_ports, get_host_name_ip, get_logger,
+                    get_cluster, add_arguments)

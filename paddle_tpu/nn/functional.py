@@ -1788,3 +1788,82 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
         else (input, label, weight, bias)
     return apply(_hsigmoid_loss_raw, args, {"num_classes": int(num_classes)},
                  name="hsigmoid_loss")
+
+
+def _deform_conv2d_raw(x, offset, w, *rest, stride=1, padding=0, dilation=1,
+                       has_mask=False, has_bias=False):
+    """Deformable conv v1/v2 (ref operators/deformable_conv_op.h;
+    static.nn.deform_conv2d). deformable_groups=1, groups=1.
+
+    x [N,C,H,W]; offset [N, 2*kh*kw, H',W'] as (dy,dx) pairs; w
+    [Co,C,kh,kw]; optional mask [N, kh*kw, H',W'] (v2 modulation) and
+    bias [Co]. TPU-native: the kernel-offset sampling grid is built
+    densely and gathered with ONE take_along_axis per corner — bilinear
+    interpolation as four fused gathers, no per-position loops."""
+    mask = rest[0] if has_mask else None
+    b = rest[-1] if has_bias else None
+    n_, c, h, w_in = x.shape
+    co, _, kh, kw = w.shape
+    sh, sw = _norm_tuple(stride, 2)
+    ph, pw = _norm_tuple(padding, 2)
+    dh, dw = _norm_tuple(dilation, 2)
+    ho = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    wo = (w_in + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    K = kh * kw
+
+    oi = jnp.arange(ho)[:, None]                  # output rows
+    oj = jnp.arange(wo)[None, :]
+    ku, kv = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+    base_y = (oi * sh - ph)[None, :, :] + (ku.reshape(-1) * dh)[:, None, None]
+    base_x = (oj * sw - pw)[None, :, :] + (kv.reshape(-1) * dw)[:, None, None]
+    off = offset.reshape(n_, K, 2, ho, wo)
+    ys = base_y[None] + off[:, :, 0]              # [N,K,H',W']
+    xs = base_x[None] + off[:, :, 1]
+
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+
+    def gather(yy, xx):
+        inb = ((yy >= 0) & (yy < h) & (xx >= 0) & (xx < w_in))
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w_in - 1).astype(jnp.int32)
+        flat = (yc * w_in + xc).reshape(n_, 1, -1)        # [N,1,K*H'*W']
+        got = jnp.take_along_axis(x.reshape(n_, c, h * w_in), flat, axis=2)
+        got = got.reshape(n_, c, K, ho, wo)
+        return jnp.where(inb[:, None], got, 0.0)
+
+    sampled = ((1 - wy) * (1 - wx))[:, None] * gather(y0, x0) \
+        + ((1 - wy) * wx)[:, None] * gather(y0, x0 + 1) \
+        + (wy * (1 - wx))[:, None] * gather(y0 + 1, x0) \
+        + (wy * wx)[:, None] * gather(y0 + 1, x0 + 1)     # [N,C,K,H',W']
+    if mask is not None:
+        sampled = sampled * mask[:, None]
+    out = jnp.einsum("nckij,ock->noij", sampled,
+                     w.reshape(co, c, K),
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+register_op("deform_conv2d", _deform_conv2d_raw)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    if deformable_groups != 1 or groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d: deformable_groups/groups > 1 unsupported")
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply(_deform_conv2d_raw, tuple(args),
+                 {"stride": _stride_attr(stride), "padding": _pad_attr(padding),
+                  "dilation": _stride_attr(dilation),
+                  "has_mask": mask is not None, "has_bias": bias is not None},
+                 name="deform_conv2d")

@@ -12,6 +12,11 @@ from .program import (Program, program_guard, default_main_program,
                       tpu_places, device_guard, CompiledProgram,
                       reset_default_programs)
 from .backward import append_backward, grad_var_name
+from .io import (save_inference_model, load_inference_model,
+                 serialize_program, deserialize_program,
+                 serialize_persistables, deserialize_persistables,
+                 normalize_program, save_to_file, load_from_file,
+                 is_persistable)
 from . import desc
 from . import control_flow
 from .control_flow import (cond, while_loop, case, switch_case, TensorArray,
@@ -40,6 +45,8 @@ def _populate_static_nn():
     for _name in ("fc", "embedding", "conv2d", "batch_norm",
                   "sequence_pool", "dropout", "one_hot", "topk"):
         setattr(nn, _name, staticmethod(getattr(_L, _name)))
+    from ..nn.functional import deform_conv2d as _dc
+    nn.deform_conv2d = staticmethod(_dc)
     nn.data = staticmethod(data)
 
 

@@ -1,0 +1,140 @@
+"""paddle.static inference-model IO (ref python/paddle/static/io.py:
+save/load_inference_model, serialize/deserialize_program+persistables,
+normalize_program, save_to_file/load_from_file).
+
+TPU-native: the serialized program is the desc JSON (static/desc.py) and
+persistables are an npz blob — same two artifacts Program.save writes,
+packaged with the feed/fetch interface the way the reference's
+.pdmodel/.pdiparams pair is. load_inference_model returns
+[program, feed_names, fetch_names] exactly like the reference so serving
+code ports unchanged.
+"""
+import io as _io
+import json
+
+import numpy as np
+import jax.numpy as jnp
+
+from .program import Program
+from . import desc as D
+
+
+def is_persistable(var):
+    """ref io.py is_persistable: feeds/fetches are not, parameters are."""
+    return bool(getattr(var, "persistable", False))
+
+
+def _var_names(program, vars_, fetch_first=False):
+    names = []
+    for v in vars_ or []:
+        if fetch_first:
+            n = program.recorder.name_of(v) or getattr(v, "name", None)
+        else:
+            n = getattr(v, "name", None) or program.recorder.name_of(v)
+        if n is None:
+            raise ValueError(
+                f"var {v!r} was not recorded in this program — build it "
+                "under program_guard(program)")
+        names.append(n)
+    return names
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """ref io.py normalize_program: prune the program to the FETCH
+    CLOSURE (a backward slice over the op list — loss/optimizer branches
+    and their feeds disappear, like the reference's prune_backward +
+    feed/fetch rewrite) and pin the interface on the clone."""
+    pruned = program.clone(for_test=True)
+    pruned._feed_names = _var_names(program, feed_vars)
+    pruned._fetch_names = _var_names(program, fetch_vars, fetch_first=True)
+    desc = pruned.desc
+    needed = set(pruned._fetch_names)
+    kept = []
+    for op in reversed(desc.ops):
+        if any(o and o in needed for o in op.outputs):
+            kept.append(op)
+            needed.update(n for n in op.inputs if n)
+    kept.reverse()
+    desc.ops = kept
+    desc.vars = {n: v for n, v in desc.vars.items()
+                 if n in needed or v.kind == D.PERSIST}
+    pruned._persist = {n: t for n, t in pruned._persist.items()
+                       if n in needed}
+    desc.version += 1
+    return pruned
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    from .program import default_main_program
+    program = program or default_main_program()
+    norm = normalize_program(program, feed_vars, fetch_vars)
+    return json.dumps({
+        "program": norm.serialize_to_string(),
+        "feeds": norm._feed_names,
+        "fetches": norm._fetch_names,
+    }).encode("utf-8")
+
+
+def deserialize_program(data):
+    d = json.loads(bytes(data).decode("utf-8"))
+    prog = Program.parse_from_string(d["program"])
+    prog._feed_names = d["feeds"]
+    prog._fetch_names = d["fetches"]
+    return prog
+
+
+def persist_blob(program):
+    """npz blob of the program's persistables — the ONE serialization
+    format (Program.save and serialize_persistables both use it)."""
+    buf = _io.BytesIO()
+    arrays = {n: np.asarray(t._data) for n, t in program._persist.items()}
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def load_persist_blob(program, data):
+    blob = np.load(_io.BytesIO(bytes(data)))
+    for n in blob.files:
+        if n in program._persist:
+            program._persist[n]._data = jnp.asarray(blob[n])
+    return program
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None, **kwargs):
+    from .program import default_main_program
+    return persist_blob(program or default_main_program())
+
+
+def deserialize_persistables(program, data, executor=None):
+    return load_persist_blob(program, data)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Writes {prefix}.pdmodel (program+interface) and {prefix}.pdiparams
+    (persistables) — the reference's two-artifact layout."""
+    save_to_file(path_prefix + ".pdmodel",
+                 serialize_program(feed_vars, fetch_vars, program=program))
+    save_to_file(path_prefix + ".pdiparams",
+                 serialize_persistables(feed_vars, fetch_vars,
+                                        program=program))
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns [program, feed_target_names, fetch_target_names] (ref
+    io.py load_inference_model contract)."""
+    prog = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    deserialize_persistables(prog,
+                             load_from_file(path_prefix + ".pdiparams"))
+    return [prog, prog._feed_names, prog._fetch_names]

@@ -1,0 +1,53 @@
+#!/bin/bash
+# One-shot on-chip artifact capture: run EVERYTHING that needs the real TPU
+# the moment the tunnel is back. Designed so a single tunnel-up window
+# produces every number the round needs (BENCH line, per-model sweeps, the
+# BSHD A/B, long-context rows). Never `timeout`-kills a compile in flight
+# (that wedges the tunnel — see docs/perf/PERF.md); each step has a
+# GENEROUS timeout instead and logs to docs/perf/capture_<step>.log.
+#
+#   PYTHONPATH=/root/repo:/root/.axon_site bash scripts/tunnel_up_capture.sh
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="/root/repo:/root/.axon_site"
+LOG=docs/perf
+mkdir -p "$LOG"
+
+step() {  # step <name> <timeout_s> <cmd...>
+  local name="$1" to="$2"; shift 2
+  echo "==== $name (timeout ${to}s) ===="
+  timeout "$to" "$@" 2>&1 | tee "$LOG/capture_${name}.log" | tail -5
+  echo "---- $name exit: ${PIPESTATUS[0]}"
+}
+
+# 0. probe (killable child; a wedged tunnel hangs rather than raising)
+python - <<'EOF' || { echo "TPU STILL DOWN — aborting capture"; exit 1; }
+import subprocess, sys
+code = "import jax; print(jax.devices())"
+try:
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=180)
+except subprocess.TimeoutExpired:
+    sys.exit(1)
+ok = p.returncode == 0 and ("Tpu" in p.stdout + p.stderr
+                            or "TPU" in p.stdout)
+sys.exit(0 if ok else (p.returncode or 1))
+EOF
+
+# 1. the driver metric (warm cache makes re-runs cheap)
+step bench 3600 python bench.py
+
+# 2. per-model sweeps (GPT-2s ladder point, medium, ResNet-50, BERT-base)
+step sweep_gpt    5400 python scripts/bench_sweep.py gpt 8
+step sweep_gpt2m  5400 python scripts/bench_sweep.py gpt2m 4
+step sweep_resnet 5400 python scripts/bench_sweep.py resnet 128
+step sweep_bert   5400 python scripts/bench_sweep.py bert 16
+
+# 3. BSHD kernel A/B (opt-in layout; compare against the bench gpt row)
+step bshd_ab 5400 env PT_ATTN_LAYOUT=bshd python scripts/bench_sweep.py gpt 8
+
+# 4. long-context rows (flash fwd+bwd at 4k/8k, recompute at 8k)
+step longctx 7200 python scripts/longctx_probe.py
+
+echo "==== capture complete; logs in $LOG/capture_*.log ===="
+echo "Update docs/perf/PERF.md + LONGCTX.md with the numbers above."

@@ -97,3 +97,58 @@ def test_exported_artifact_is_stablehlo(tmp_path):
     with open(path + ".pdmodel", "rb") as f:
         exp = jexport.deserialize(f.read())
     assert "stablehlo" in exp.mlir_module() or "module" in exp.mlir_module()
+
+
+def test_inference_model_prunes_to_fetch_closure(tmp_path):
+    """save_inference_model on a TRAINING program must slice away the
+    loss/optimizer branch: the served program runs without the label feed
+    (ref normalize_program pruning)."""
+    import numpy as np
+    from paddle_tpu import static, fluid
+    fluid.layers.reset_parameters()
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 4], "float32")
+        label = static.data("label", [None, 1], "float32")
+        out = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(out, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = static.Executor()
+    xv = np.random.RandomState(0).randn(4, 4).astype("f4")
+    exe.run(prog, feed={"x": xv, "label": np.zeros((4, 1), "f4")},
+            fetch_list=[loss])
+    static.save_inference_model(str(tmp_path / "m2"), [x], [out], exe,
+                                program=prog)
+    prog2, feeds, fetches = static.load_inference_model(
+        str(tmp_path / "m2"), exe)
+    assert feeds == ["x"]
+    (got,) = exe.run(prog2, feed={"x": xv}, fetch_list=fetches)
+    assert np.isfinite(np.asarray(got)).all()
+    assert not any(op.type in ("grad", "optimizer_update")
+                   for op in prog2.desc.ops)
+
+
+def test_static_inference_model_save_load_roundtrip(tmp_path):
+    """ref static/io.py save/load_inference_model contract:
+    [program, feed_names, fetch_names] + identical outputs after reload."""
+    import numpy as np
+    from paddle_tpu import static, fluid
+    fluid.layers.reset_parameters()
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 8], "float32")
+        out = fluid.layers.fc(x, size=4, act="relu")
+    exe = static.Executor()
+    xv = np.random.RandomState(0).randn(4, 8).astype("f4")
+    (ref,) = exe.run(prog, feed={"x": xv},
+                     fetch_list=[prog.recorder.name_of(out)])
+    static.save_inference_model(str(tmp_path / "m"), [x], [out], exe,
+                                program=prog)
+    prog2, feeds, fetches = static.load_inference_model(
+        str(tmp_path / "m"), exe)
+    assert feeds == ["x"] and len(fetches) == 1
+    (got,) = exe.run(prog2, feed={feeds[0]: xv}, fetch_list=fetches)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+    assert static.is_persistable(
+        next(iter(prog2._persist.values())))

@@ -612,6 +612,10 @@ SPECS = {
     "hsigmoid_loss": S([F32((4, 8)), I32((4,), hi=6), F32((5, 8), 1)],
                        {"num_classes": 6}),
     "mv": S([F32((3, 4), 1), F32((4,), 2)]),
+    "deform_conv2d": S([F32((1, 2, 6, 6)),
+                        F32((1, 18, 6, 6), 1, -0.3, 0.3),
+                        F32((3, 2, 3, 3), 2)],
+                       {"stride": 1, "padding": 1}),
     # --- decode / misc ---
     "accuracy": S([F32((4, 5)), I32((4, 1), hi=5)], {"k": 2}, grad=False),
     "clip_by_norm": S([F32()], {"max_norm": 0.5}),
