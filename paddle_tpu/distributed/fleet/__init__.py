@@ -7,7 +7,11 @@ from .base import (init, is_first_worker, worker_index, worker_num,
                    server_endpoints, is_server, barrier_worker,
                    distributed_optimizer, distributed_model,
                    DistributedStrategy, UserDefinedRoleMaker,
-                   PaddleCloudRoleMaker, UtilBase, fleet, build_train_step)
+                   PaddleCloudRoleMaker, UtilBase, fleet, build_train_step,
+                   init_server, run_server, init_worker, stop_worker,
+                   minimize, step, clear_grad, get_lr, set_lr, state_dict,
+                   set_state_dict, amp_init, shrink, save_persistables,
+                   save_inference_model)
 
 
 from .trainers import MultiTrainer, DistMultiTrainer

@@ -179,6 +179,11 @@ class UtilBase:
         parts = hc.all_gather(_json.dumps(input).encode())
         return [_json.loads(p) for p in parts]
 
+    def print_on_rank(self, message, rank_id=0):
+        """ref util_factory.py print_on_rank."""
+        if worker_index() == rank_id:
+            print(message, flush=True)
+
     def get_file_shard(self, files):
         idx = worker_index()
         n = worker_num()
@@ -202,6 +207,13 @@ def init(role_maker=None, is_collective=False, strategy=None):
         is_collective=is_collective)
     _fleet.strategy = strategy or DistributedStrategy()
     _fleet.initialized = True
+    # a re-init starts a fresh job: stale PS/optimizer handles from the
+    # previous one must not leak into it
+    _fleet.latest_opt = None
+    _fleet.runtime = None
+    _fleet.server = None
+    _fleet.server_port = None
+    _fleet.worker_trainer = None
     # build the mesh implied by hybrid_configs
     hc = _fleet.strategy.hybrid_configs
     import jax
@@ -284,7 +296,8 @@ def distributed_optimizer(optimizer, strategy=None):
     from .meta_optimizers import build_distributed_optimizer
     strat = strategy or _fleet.strategy or DistributedStrategy()
     _fleet.strategy = strat
-    return build_distributed_optimizer(optimizer, strat)
+    _fleet.latest_opt = build_distributed_optimizer(optimizer, strat)
+    return _fleet.latest_opt
 
 
 def build_train_step(model, loss_fn, optimizer, **kwargs):
@@ -359,3 +372,149 @@ class _FleetModule:
 
 
 fleet = _FleetModule()
+
+
+# --------------------------------------------------------------------------
+# PS lifecycle + optimizer delegation on the facade (ref fleet_base.py:
+# init_server/run_server/init_worker/stop_worker + the Fleet object's
+# minimize/step/clear_grad/get_lr/set_lr/state_dict passthroughs)
+# --------------------------------------------------------------------------
+
+def init_server(params=None, sparse_names=(), port=0, lr=0.1, **kwargs):
+    """Start the native PS with tables planned from `params` (ref
+    fleet_base.py init_server; table planning = the reference's
+    program-derived table config). Returns the bound port. Extra kwargs
+    (emb_dim, init_scale) forward to the runtime's table planner."""
+    from .runtime import TheOnePSRuntime
+    _fleet.runtime = TheOnePSRuntime(_fleet.strategy, role="server", lr=lr)
+    _fleet.server, bound = _fleet.runtime.init_server(
+        params or {}, sparse_names, port=port, **kwargs)
+    _fleet.server_port = bound
+    return bound
+
+
+def run_server(block=True, poll_s=0.5):
+    """ref fleet_base.py run_server: serve until stop_worker()/stop() —
+    the reference blocks the server process the same way."""
+    import time
+    if getattr(_fleet, "runtime", None) is None or _fleet.server is None:
+        raise RuntimeError("fleet.run_server: call fleet.init_server first")
+    while block and _fleet.runtime.server is not None:
+        time.sleep(poll_s)
+    return _fleet.server_port
+
+
+def init_worker(loss_fn=None, params=None, worker_id=None, host="127.0.0.1",
+                port=None, **kwargs):
+    """Connect this worker to the PS: liveness registration + heartbeat +
+    the trainer the strategy implies (async->Hogwild, geo->k-step deltas).
+    Returns the trainer (ref fleet_base.py init_worker)."""
+    from .runtime import TheOnePSRuntime
+    rt = TheOnePSRuntime(_fleet.strategy, role="worker")
+    wid = worker_id if worker_id is not None else worker_index()
+    trainer = rt.init_worker(loss_fn, params or {}, wid, host=host,
+                             port=port, **kwargs)
+    _fleet.worker_trainer = trainer
+    return trainer
+
+
+def stop_worker():
+    """ref fleet_base.py stop_worker: clean COMPLETE + heartbeat cancel on
+    a worker; server-side tears the server down."""
+    tr = getattr(_fleet, "worker_trainer", None)
+    if tr is not None:
+        tr.finish()
+        _fleet.worker_trainer = None
+    rt = getattr(_fleet, "runtime", None)
+    if rt is not None:
+        rt.stop()
+        _fleet.server = None
+        _fleet.server_port = None
+
+
+def shrink(threshold=None):
+    raise NotImplementedError(
+        "fleet.shrink: sparse-table eviction by staleness is not "
+        "implemented (the native SparseTable does not track per-row "
+        "access times); delete-and-reload via save/load instead")
+
+
+def _last_opt():
+    opt = getattr(_fleet, "latest_opt", None)
+    # (init() resets this to None on re-init — stale handles never leak)
+    if opt is None:
+        raise RuntimeError(
+            "no distributed optimizer yet — call "
+            "fleet.distributed_optimizer(opt) first")
+    return opt
+
+
+def minimize(loss, startup_program=None, parameter_list=None,
+             no_grad_set=None):
+    return _last_opt().minimize(loss, startup_program, parameter_list,
+                                no_grad_set)
+
+
+def step():
+    return _last_opt().inner_opt.step()
+
+
+def clear_grad():
+    return _last_opt().inner_opt.clear_grad()
+
+
+def get_lr():
+    return _last_opt().inner_opt.get_lr()
+
+
+def set_lr(value):
+    return _last_opt().inner_opt.set_lr(value)
+
+
+def state_dict():
+    return _last_opt().inner_opt.state_dict()
+
+
+def set_state_dict(state):
+    return _last_opt().inner_opt.set_state_dict(state)
+
+
+def amp_init(place=None, scope=None, test_program=None, use_fp16_test=False):
+    """ref fleet_base.py amp_init: pure-fp16 master-weight init. The XLA
+    path keeps master weights implicitly (params stay f32; casts are
+    inserted by the AMP transform), so this is a documented no-op."""
+    return None
+
+
+def save_persistables(executor, dirname, main_program=None, mode=0):
+    """ref fleet_base.py save_persistables -> static Program.save."""
+    import os
+    from ...static import default_main_program
+    prog = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    prog.save(os.path.join(dirname, "persistables"))
+
+
+def save_inference_model(executor, dirname, feeded_var_names, target_vars,
+                         main_program=None, export_for_deployment=True,
+                         mode=0):
+    """ref fleet_base.py save_inference_model -> static.io."""
+    import os
+    from ...static import default_main_program
+    from ...static.io import save_inference_model as _sim
+    prog = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    # the facade takes feed NAMES; resolve them to the program's feed vars
+    feeds = [prog.feeds[n] for n in feeded_var_names]
+    _sim(os.path.join(dirname, "model"), feeds, target_vars,
+         executor, program=prog)
+
+
+# the fleet OBJECT mirrors the reference singleton: every facade function
+# must be reachable as fleet.<name> too
+for _fn in (init_server, run_server, init_worker, stop_worker, shrink,
+            minimize, step, clear_grad, get_lr, set_lr, state_dict,
+            set_state_dict, amp_init, save_persistables,
+            save_inference_model):
+    setattr(_FleetModule, _fn.__name__, staticmethod(_fn))
+del _fn

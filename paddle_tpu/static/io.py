@@ -56,8 +56,7 @@ def normalize_program(program, feed_vars, fetch_vars):
             needed.update(n for n in op.inputs if n)
     kept.reverse()
     desc.ops = kept
-    desc.vars = {n: v for n, v in desc.vars.items()
-                 if n in needed or v.kind == D.PERSIST}
+    desc.vars = {n: v for n, v in desc.vars.items() if n in needed}
     pruned._persist = {n: t for n, t in pruned._persist.items()
                        if n in needed}
     desc.version += 1
@@ -84,8 +83,8 @@ def deserialize_program(data):
 
 
 def persist_blob(program):
-    """npz blob of the program's persistables — the ONE serialization
-    format (Program.save and serialize_persistables both use it)."""
+    """npz blob of the program's persistables — the single serialization
+    format; Program.save/load delegate here too."""
     buf = _io.BytesIO()
     arrays = {n: np.asarray(t._data) for n, t in program._persist.items()}
     np.savez(buf, **arrays)
@@ -123,12 +122,19 @@ def load_from_file(path):
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
     """Writes {prefix}.pdmodel (program+interface) and {prefix}.pdiparams
-    (persistables) — the reference's two-artifact layout."""
-    save_to_file(path_prefix + ".pdmodel",
-                 serialize_program(feed_vars, fetch_vars, program=program))
-    save_to_file(path_prefix + ".pdiparams",
-                 serialize_persistables(feed_vars, fetch_vars,
-                                        program=program))
+    (persistables) — the reference's two-artifact layout. BOTH artifacts
+    come from ONE normalized (fetch-closure-pruned) clone: a training
+    program's optimizer state and pruned-branch params never reach the
+    serving artifacts."""
+    from .program import default_main_program
+    program = program or default_main_program()
+    norm = normalize_program(program, feed_vars, fetch_vars)
+    save_to_file(path_prefix + ".pdmodel", json.dumps({
+        "program": norm.serialize_to_string(),
+        "feeds": norm._feed_names,
+        "fetches": norm._fetch_names,
+    }).encode("utf-8"))
+    save_to_file(path_prefix + ".pdiparams", persist_blob(norm))
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):

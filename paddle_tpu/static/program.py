@@ -260,19 +260,19 @@ class Program:
         """Desc JSON + persistable values (params/buffers/opt state) so a
         fresh process can resume (ref io.py save_persistables +
         framework.py Program.parse_from_string)."""
+        from .io import persist_blob
         with open(path + ".json", "w") as f:
             f.write(self.desc.to_json())
-        arrays = {n: np.asarray(t._data) for n, t in self._persist.items()}
-        np.savez(path + ".pdparams.npz", **arrays)
+        with open(path + ".pdparams.npz", "wb") as f:
+            f.write(persist_blob(self))
 
     @classmethod
     def load(cls, path):
+        from .io import load_persist_blob
         with open(path + ".json") as f:
             prog = cls.parse_from_string(f.read())
-        data = np.load(path + ".pdparams.npz")
-        for n in data.files:
-            if n in prog._persist:
-                prog._persist[n]._data = jnp.asarray(data[n])
+        with open(path + ".pdparams.npz", "rb") as f:
+            load_persist_blob(prog, f.read())
         return prog
 
     @classmethod

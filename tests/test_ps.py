@@ -400,3 +400,64 @@ def test_dist_multi_trainer_hogwild_ps():
         assert last < first * 0.6, (first, last)
     finally:
         rt.stop()
+
+
+def test_fleet_facade_ps_lifecycle():
+    """The reference PS recipe through the FACADE (ref fleet_base.py):
+    init -> init_server/run_server (server role) + init_worker/train/
+    stop_worker (worker role), with run_server unblocking on stop."""
+    import threading
+    import time
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import fleet
+
+    fleet.init(fleet.UserDefinedRoleMaker(role=0, worker_num=1,
+                                          server_num=1))
+    params = {"w": np.zeros((8, 1), "f4"),
+              "emb": np.zeros((100, 2), "f4")}
+    port = fleet.init_server(params, sparse_names=["emb"])
+    t = threading.Thread(target=fleet.run_server, daemon=True)
+    t.start()
+
+    def loss_fn(p, urows, inv, x, y):
+        emb = urows[inv].reshape(x.shape[0], -1)
+        feat = jnp.concatenate([x[:, :2], emb], axis=1)
+        return jnp.mean(jnp.square((feat @ p["w"])[:, 0] - y))
+
+    tr = fleet.init_worker(loss_fn, {"w": np.zeros((8, 1), "f4")},
+                           worker_id=0, port=port, emb_dim=2)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(40):
+        ids = rng.randint(0, 20, (8, 3)).astype("i8")
+        x = rng.randn(8, 8).astype("f4")
+        losses.append(tr.step(ids, jnp.asarray(x),
+                              jnp.asarray(x[:, 0].astype("f4"))))
+    assert losses[-1] < losses[0] * 0.6, losses[::10]
+    fleet.stop_worker()
+    deadline = time.time() + 5
+    while t.is_alive() and time.time() < deadline:
+        time.sleep(0.1)
+    assert not t.is_alive(), "run_server did not unblock after stop_worker"
+
+
+def test_fleet_facade_optimizer_passthroughs():
+    import paddle_tpu as pt2
+    from paddle_tpu.distributed import fleet
+
+    fleet.init(fleet.UserDefinedRoleMaker(role=0, worker_num=1,
+                                          server_num=0))
+    lin = pt2.nn.Linear(4, 1)
+    fleet.distributed_optimizer(
+        pt2.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters()))
+    out = lin(pt2.to_tensor(np.ones((2, 4), "f4")))
+    pt2.ops.math.mean(out).backward()
+    w_before = np.asarray(lin.weight.numpy()).copy()
+    fleet.step()
+    fleet.clear_grad()
+    assert not np.allclose(np.asarray(lin.weight.numpy()), w_before)
+    assert fleet.get_lr() == 0.1
+    fleet.set_lr(0.05)
+    assert fleet.get_lr() == 0.05
+    sd = fleet.state_dict()
+    fleet.set_state_dict(sd)
