@@ -169,7 +169,9 @@ class EarlyStopping(Callback):
 
     def on_epoch_end(self, epoch, logs=None):
         logs = logs or {}
-        cur = logs.get(self.monitor) or logs.get(f"eval_{self.monitor}")
+        cur = logs.get(self.monitor)
+        if cur is None:                     # explicit: 0.0 is a real value
+            cur = logs.get(f"eval_{self.monitor}")
         if cur is None:
             return
         if isinstance(cur, (list, tuple)):
@@ -184,6 +186,68 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+
+
+class ReduceLROnPlateau(Callback):
+    """ref hapi/callbacks.py ReduceLROnPlateau: scale the optimizer lr by
+    `factor` after `patience` epochs without `monitor` improvement."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = None
+        if mode == "auto":
+            mode = "min" if "loss" in monitor or "err" in monitor else "max"
+        self.mode = mode
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:                     # explicit: 0.0 is a real value
+            cur = logs.get(f"eval_{self.monitor}")
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self.cooldown_counter > 0:
+            # in cooldown: no patience counting at all (ref semantics)
+            self.cooldown_counter -= 1
+            self.wait = 0
+            return
+        better = (self.best is None
+                  or (self.mode == "min" and cur < self.best - self.min_delta)
+                  or (self.mode == "max" and cur > self.best + self.min_delta))
+        if better:
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = self.model._optimizer
+            try:
+                new_lr = max(float(opt.get_lr()) * self.factor, self.min_lr)
+                opt.set_lr(new_lr)
+            except (RuntimeError, TypeError) as e:
+                # an LRScheduler owns the lr: warn once and stand down
+                import warnings
+                warnings.warn(f"ReduceLROnPlateau: cannot adjust lr "
+                              f"({e}); disable the scheduler to use this "
+                              "callback")
+                self.patience = float("inf")
+                return
+            if self.verbose:
+                print(f"ReduceLROnPlateau: epoch {epoch}: lr -> {new_lr}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
 
 
 class VisualDL(Callback):

@@ -20,6 +20,7 @@ class Model:
         self._labels = labels
         self._loss = None
         self._optimizer = None
+        self.mode = "train"       # ref hapi Model.mode: train|eval|test
         self._metrics = []
         self._train_step = None
         self.stop_training = False
@@ -170,6 +171,10 @@ class Model:
             logs[self._name_of(m)] = m.accumulate()
         self.network.train()
         return logs
+
+    def summary(self, input_size=None, dtype=None):
+        """ref hapi Model.summary -> the module-level summary() printer."""
+        return summary(self.network, input_size=input_size)
 
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, verbose=1, callbacks=None):

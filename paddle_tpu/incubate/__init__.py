@@ -2,6 +2,9 @@
 from . import recompute  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import train_epoch_range, TrainEpochRange  # noqa: F401
+# ref python/paddle/incubate: optimizer wrappers surface here too
+from ..optimizer.wrappers import (ModelAverage,  # noqa: F401
+                                  LookaheadOptimizer as LookAhead)
 
 
 def __getattr__(name):

