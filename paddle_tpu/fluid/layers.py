@@ -796,7 +796,8 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
 
 
 def huber_loss(input, label, delta):
-    return F.smooth_l1_loss(input, label, reduction="none", delta=delta)
+    from ..ops.legacy import huber_loss as _hl
+    return _hl(input, label, delta=float(delta))
 
 
 def _log_loss_raw(p, y, epsilon=1e-4):

@@ -521,3 +521,94 @@ class GRU(_RNNBase):
 
 # public alias (ref nn/layer/rnn.py RNNBase)
 RNNBase = _RNNBase
+
+
+# --------------------------------------------------------------------------- #
+# fluid-era cell-step ops (ref operators/gru_unit_op.cc, lstm_unit_op.cc,    #
+# lstmp_op.cc) — single-step / projected variants registered as ops so      #
+# 1.x-style unrolled RNN programs serialize to the desc                      #
+# --------------------------------------------------------------------------- #
+
+@def_op("gru_unit", n_tensor_args=4)
+def gru_unit(x_gates, hidden_prev, weight, bias,
+             gate_activation="sigmoid", activation="tanh",
+             origin_mode=False):
+    """One GRU step, fluid layout (ref operators/gru_unit_op.cc):
+    x_gates: [B, 3D] (input already projected), hidden_prev: [B, D],
+    weight: [D, 3D] — first 2D columns are the update/reset recurrent
+    weights, last D the candidate's; bias: [1, 3D]. Returns
+    (gate [B,3D], reset_hidden_prev [B,D], hidden [B,D]) like the ref op."""
+    d = hidden_prev.shape[1]
+    g = x_gates + bias
+    w_rz, w_c = weight[:, :2 * d], weight[:, 2 * d:]
+    rz = g[:, :2 * d] + hidden_prev @ w_rz
+    act = jax.nn.sigmoid if gate_activation == "sigmoid" else jnp.tanh
+    u = act(rz[:, :d])
+    r = act(rz[:, d:])
+    rhp = r * hidden_prev
+    c_in = g[:, 2 * d:] + rhp @ w_c
+    cact = jnp.tanh if activation == "tanh" else jax.nn.sigmoid
+    c = cact(c_in)
+    if origin_mode:
+        h = u * hidden_prev + (1.0 - u) * c
+    else:
+        h = (1.0 - u) * hidden_prev + u * c
+    gate_out = jnp.concatenate([u, r, c], axis=1)
+    return gate_out, rhp, h
+
+
+@def_op("lstm_unit", n_tensor_args=2)
+def lstm_unit(x_gates, c_prev, forget_bias=0.0):
+    """One LSTM step on pre-projected gates (ref operators/lstm_unit_op.cc):
+    x_gates: [B, 4D] in (i, g, f, o) order like the reference kernel,
+    c_prev: [B, D]. Returns (c, h)."""
+    d = c_prev.shape[1]
+    i = jax.nn.sigmoid(x_gates[:, :d])
+    g = jnp.tanh(x_gates[:, d:2 * d])
+    f = jax.nn.sigmoid(x_gates[:, 2 * d:3 * d] + forget_bias)
+    o = jax.nn.sigmoid(x_gates[:, 3 * d:])
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    return c, h
+
+
+@def_op("lstmp_seq", n_tensor_args=9)
+def lstmp_seq(x, h0, c0, w_ih, w_hh, b_ih, b_hh, w_proj, lengths,
+              reverse=False):
+    """LSTM with recurrent projection (ref operators/lstmp_op.cc): the
+    recurrent state fed back is r_t = h_t @ w_proj, so w_hh is [4H, P].
+    x: [T, B, I]; returns (ys [T, B, P], r_T, c_T). Like the other seq
+    kernels here, padding steps freeze the carry (live mask per timestep),
+    so rT/cT are the states at each row's last valid step and reverse=True
+    consumes timesteps from each row's true region."""
+    T = x.shape[0]
+    xp = x @ w_ih.T + b_ih
+    ts = jnp.arange(T)
+    if reverse:
+        xp = jnp.flip(xp, axis=0)
+        ts = jnp.flip(ts, axis=0)
+
+    def step(carry, inp):
+        xt, t = inp
+        r, c = carry
+        gates = xt + r @ w_hh.T + b_hh
+        d = c.shape[1]
+        i = jax.nn.sigmoid(gates[:, :d])
+        f = jax.nn.sigmoid(gates[:, d:2 * d])
+        g = jnp.tanh(gates[:, 2 * d:3 * d])
+        o = jax.nn.sigmoid(gates[:, 3 * d:])
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        r2 = h2 @ w_proj
+        if lengths is not None:
+            valid = (t < lengths)[:, None]
+            r2 = jnp.where(valid, r2, r)
+            c2 = jnp.where(valid, c2, c)
+        out = r2 if lengths is None else jnp.where(
+            (t < lengths)[:, None], r2, jnp.zeros_like(r2))
+        return (r2, c2), out
+
+    (rT, cT), ys = jax.lax.scan(step, (h0, c0), (xp, ts))
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, rT, cT

@@ -4,7 +4,7 @@ The reference attaches generated `core.ops.*` fast-path methods to VarBase
 (ref pybind/op_function_generator.cc:488); here the analogous step is wiring the
 pure-python op functions onto Tensor as methods/dunders at import time.
 """
-from . import creation, math, manipulation, logic, sequence
+from . import creation, math, manipulation, logic, sequence, legacy
 from .dispatch import OP_REGISTRY, apply, def_op, as_array
 from ..framework.tensor import Tensor
 

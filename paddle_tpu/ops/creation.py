@@ -125,8 +125,11 @@ def triu(x, diagonal=0, name=None):
 
 
 def meshgrid(*args, **kwargs):
-    arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
-    return [Tensor(o) for o in jnp.meshgrid(*arrays, indexing="ij")]
+    from .dispatch import apply
+    from .legacy import _meshgrid_raw
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(apply(_meshgrid_raw, args, name="meshgrid"))
 
 
 def _assign_raw(v):

@@ -1,0 +1,357 @@
+"""Legacy operator tail: registered op types from the reference's fluid-era
+surface that have no paddle-2.x python wrapper but are real, distinct
+computations (ref paddle/fluid/operators/*.cc — per-op citations below).
+
+Everything here is a pure-jnp raw registered in OP_REGISTRY, so each op is
+eager-dispatchable, serializable to the static desc, and swept by the
+registry battery (eager + finite-diff grad + desc round-trip). Ops whose
+reference kernels exist only to work around CUDA limitations (fusion_*,
+xbyak jit) stay n/a — XLA fusion owns that layer (SURVEY §7).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .dispatch import apply, as_array, def_op, register_op
+
+
+# ------------------------------------------------------------------ losses
+
+@def_op("huber_loss", n_tensor_args=2)
+def huber_loss(x, y, delta=1.0):
+    """True Huber loss (ref operators/huber_loss_op.cc HuberLossForward):
+    0.5 z^2 for |z| <= delta else delta*(|z| - 0.5 delta). Distinct from
+    smooth_l1_loss, which scales the quadratic zone by 1/delta."""
+    z = jnp.abs(y - x)
+    return jnp.where(z <= delta, 0.5 * z * z, delta * (z - 0.5 * delta))
+
+
+@def_op("rank_loss", n_tensor_args=3)
+def rank_loss(label, left, right):
+    """Pairwise RankNet loss (ref operators/rank_loss_op.cc): given scores of
+    a left/right document pair and label in {0, 0.5, 1}, the sigmoid
+    cross-entropy on the score difference."""
+    d = left - right
+    # log(1 + exp(d)) - label*d, computed stably
+    return jnp.maximum(d, 0) - label * d + jnp.log1p(jnp.exp(-jnp.abs(d)))
+
+
+@def_op("bpr_loss", n_tensor_args=2)
+def bpr_loss(x, label):
+    """Bayesian Personalized Ranking loss (ref operators/bpr_loss_op.cc):
+    for each row, -mean_{j != label} log sigmoid(x[label] - x[j]).
+    x: [B, C] scores, label: [B] int. Returns [B, 1]."""
+    B, C = x.shape
+    lab = label.reshape(-1)
+    pos = jnp.take_along_axis(x, lab[:, None], axis=1)          # [B, 1]
+    d = pos - x                                                  # [B, C]
+    # -log sigmoid(d) = softplus(-d); exclude the label column
+    lose = jax.nn.softplus(-d)
+    mask = jnp.arange(C)[None, :] != lab[:, None]
+    s = jnp.sum(jnp.where(mask, lose, 0.0), axis=1, keepdims=True)
+    return s / jnp.maximum(C - 1, 1)
+
+
+@def_op("hinge_loss", n_tensor_args=2)
+def hinge_loss(logits, labels):
+    """ref operators/hinge_loss_op.cc: max(0, 1 - (2*label - 1) * pred)."""
+    return jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)
+
+
+@def_op("center_loss", n_tensor_args=3, differentiable=True)
+def center_loss(x, label, centers, alpha=0.1, need_update=True):
+    """Center loss (ref operators/center_loss_op.cc): per-sample squared
+    distance to its class center, plus the alpha-step center update the
+    reference folds into the same op. Returns (loss [B,1], centers_out).
+    Gradients flow through `loss` w.r.t. x; centers_out is the EMA-style
+    table update (class-count normalised, as the CUDA kernel does)."""
+    lab = label.reshape(-1)
+    cx = centers[lab]                                            # [B, D]
+    diff = x - cx
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if not need_update:
+        return loss, centers
+    # center update: c_j -= alpha * sum_{i: y_i=j}(c_j - x_i) / (1 + n_j)
+    n = centers.shape[0]
+    counts = jnp.zeros((n,), x.dtype).at[lab].add(1.0)
+    delta = jnp.zeros_like(centers).at[lab].add(diff)            # sum(x_i - c_j)
+    centers_out = centers + alpha * delta / (1.0 + counts)[:, None]
+    return loss, jax.lax.stop_gradient(centers_out)
+
+
+@def_op("cos_sim", n_tensor_args=2)
+def cos_sim(x, y, eps=1e-8):
+    """Row-wise cosine similarity with batch-1 broadcast on y
+    (ref operators/cos_sim_op.cc). x: [B, D], y: [B, D] or [1, D] ->
+    [B, 1]."""
+    xn = jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=1, keepdims=True))
+    num = jnp.sum(x * y, axis=1, keepdims=True)
+    return num / jnp.maximum(xn * yn, eps)
+
+
+@def_op("squared_l2_norm")
+def squared_l2_norm(x):
+    """ref operators/squared_l2_norm_op.cc — the grad-clip building block;
+    returns shape [1]."""
+    return jnp.sum(x * x).reshape(1)
+
+
+@def_op("l1_norm")
+def l1_norm(x):
+    """ref operators/l1_norm_op.cc; returns shape [1]."""
+    return jnp.sum(jnp.abs(x)).reshape(1)
+
+
+@def_op("frobenius_norm")
+def frobenius_norm(x, axis=None, keepdim=False):
+    """ref operators/reduce_ops/frobenius_norm_op.cc."""
+    ax = tuple(axis) if isinstance(axis, list) else axis
+    return jnp.sqrt(jnp.sum(x * x, axis=ax, keepdims=keepdim))
+
+
+@def_op("p_norm")
+def p_norm(x, porder=2.0, axis=-1, keepdim=False, epsilon=1e-12):
+    """ref operators/p_norm_op.cc: vector p-norm along one axis, with the
+    reference's epsilon floor inside the root for grad stability."""
+    if porder == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if porder == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    s = jnp.sum(jnp.abs(x) ** porder, axis=axis, keepdims=keepdim)
+    return (s + epsilon) ** (1.0 / porder)
+
+
+@def_op("nce_loss", n_tensor_args=5)
+def nce_loss(x, weight, bias, label, sample_ids):
+    """Noise-contrastive estimation with caller-supplied negative samples
+    (ref operators/nce_op.cc, CustomDist path — sampling itself happens at
+    the python edge so the op stays a pure function). x: [B, D],
+    weight: [V, D], bias: [V], label: [B], sample_ids: [K].
+    Returns [B, 1] per-sample loss."""
+    pos_w = weight[label.reshape(-1)]                            # [B, D]
+    pos_b = bias[label.reshape(-1)]                              # [B]
+    s_pos = jnp.sum(x * pos_w, axis=1) + pos_b                   # [B]
+    neg_w = weight[sample_ids]                                   # [K, D]
+    neg_b = bias[sample_ids]                                     # [K]
+    s_neg = x @ neg_w.T + neg_b[None, :]                         # [B, K]
+    loss = jax.nn.softplus(-s_pos) + jnp.sum(jax.nn.softplus(s_neg), axis=1)
+    return loss[:, None]
+
+
+@def_op("linear_chain_crf", n_tensor_args=4)
+def linear_chain_crf(emission, transition, label, lengths):
+    """Linear-chain CRF negative log-likelihood over padded batches
+    (ref operators/linear_chain_crf_op.cc, forward algorithm; the reference
+    walks LoD sequences — here one lax.scan over the padded time axis with
+    a length mask, which vectorises over the batch and shards along it).
+
+    emission: [B, T, N]; transition: [N+2, N] (row 0 start, row 1 stop,
+    rows 2.. pairwise w[from, to]); label: [B, T] int; lengths: [B].
+    Returns nll [B, 1] = log Z - score(gold path).
+    """
+    B, T, N = emission.shape
+    start, stop, w = transition[0], transition[1], transition[2:]
+
+    # --- log partition via forward recursion
+    alpha0 = start[None, :] + emission[:, 0]                     # [B, N]
+
+    def step(alpha, t):
+        # [B, N, 1] + [N, N] -> logsumexp over "from"
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + w[None, :, :], axis=1) + emission[:, t]
+        live = (t < lengths)[:, None]
+        return jnp.where(live, nxt, alpha), None
+
+    alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    # add stop transition at each sequence's true end
+    logZ = jax.scipy.special.logsumexp(alphaT + stop[None, :], axis=1)
+
+    # --- gold path score
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < lengths[:, None]                             # [B, T]
+    em = jnp.take_along_axis(emission, label[:, :, None], axis=2)[..., 0]
+    em_score = jnp.sum(jnp.where(valid, em, 0.0), axis=1)
+    prev, cur = label[:, :-1], label[:, 1:]
+    trans = w[prev, cur]                                         # [B, T-1]
+    pair_valid = (t_idx[:, 1:] < lengths[:, None])
+    tr_score = jnp.sum(jnp.where(pair_valid, trans, 0.0), axis=1)
+    last = jnp.take_along_axis(
+        label, jnp.maximum(lengths - 1, 0)[:, None], axis=1)[:, 0]
+    gold = start[label[:, 0]] + em_score + tr_score + stop[last]
+    return (logZ - gold)[:, None]
+
+
+# ------------------------------------------------------- legacy tensor ops
+
+@def_op("mul", n_tensor_args=2)
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    """The fluid-era `mul` op (ref operators/mul_op.cc): flatten x to
+    [prod(front dims), prod(back)], y likewise, matmul, then restore the
+    un-flattened front/back dims."""
+    xs, ys = x.shape, y.shape
+    xm = x.reshape((int(np.prod(xs[:x_num_col_dims])), -1))
+    ym = y.reshape((int(np.prod(ys[:y_num_col_dims])), -1))
+    out = xm @ ym
+    return out.reshape(tuple(xs[:x_num_col_dims]) + tuple(ys[y_num_col_dims:]))
+
+
+def _multiplex_raw(index, *candidates):
+    """ref operators/multiplex_op.cc: out[i] = candidates[index[i]][i]."""
+    stacked = jnp.stack(candidates, axis=0)                      # [K, B, ...]
+    idx = index.reshape(-1).astype(jnp.int32)                    # [B]
+    return jnp.take_along_axis(
+        stacked, idx[None, :].reshape((1, -1) + (1,) * (stacked.ndim - 2)),
+        axis=0)[0]
+
+
+register_op("multiplex", _multiplex_raw)
+
+
+def multiplex(inputs, index, name=None):
+    return apply(_multiplex_raw, (index, *inputs), name="multiplex")
+
+
+@def_op("segment_pool", n_tensor_args=2)
+def segment_pool(x, segment_ids, pool_type="SUM", num_segments=None):
+    """ref operators/segment_pool_op.cc (paddle.incubate.segment_*):
+    pool rows of x by monotonically non-decreasing segment_ids. On the
+    eager path num_segments defaults to ids[-1]+1; under tracing pass it
+    explicitly (static shapes)."""
+    if num_segments is None:
+        num_segments = int(np.asarray(segment_ids)[-1]) + 1
+    pt = pool_type.upper()
+    ids = segment_ids.astype(jnp.int32)
+    if pt == "SUM":
+        return jax.ops.segment_sum(x, ids, num_segments)
+    if pt == "MEAN":
+        s = jax.ops.segment_sum(x, ids, num_segments)
+        n = jax.ops.segment_sum(jnp.ones_like(x[..., :1]), ids, num_segments)
+        return s / jnp.maximum(n, 1.0)
+    if pt == "MAX":
+        return jax.ops.segment_max(x, ids, num_segments)
+    if pt == "MIN":
+        return jax.ops.segment_min(x, ids, num_segments)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@def_op("cvm", n_tensor_args=2)
+def cvm(x, cvm_in, use_cvm=True):
+    """Continuous-value-model feature op (ref operators/cvm_op.cc): input
+    embeds whose first two columns are (show, click) stats. use_cvm=True
+    replaces them with (log(show+1), log(click+1) - log(show+1)); False
+    strips them."""
+    show = jnp.log(cvm_in[:, 0:1] + 1.0)
+    click = jnp.log(cvm_in[:, 1:2] + 1.0) - show
+    if use_cvm:
+        return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+@def_op("data_norm", n_tensor_args=4)
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4):
+    """ref operators/data_norm_op.cc: normalize with externally accumulated
+    global stats — mean = sum/size, scale = sqrt(size/square_sum)."""
+    mean = batch_sum / batch_size
+    scale = jnp.sqrt(batch_size / (batch_square_sum + epsilon))
+    return (x - mean[None, :]) * scale[None, :]
+
+
+@def_op("shuffle_batch", n_tensor_args=1, differentiable=True)
+def shuffle_batch(x, seed=0):
+    """ref operators/shuffle_batch_op.cc: deterministic batch permutation
+    (seed attr — the reference threads a seed tensor)."""
+    perm = jax.random.permutation(jax.random.PRNGKey(seed), x.shape[0])
+    return jnp.take(x, perm, axis=0)
+
+
+@def_op("im2sequence", n_tensor_args=1)
+def im2sequence(x, kernels=(1, 1), strides=(1, 1), paddings=(0, 0)):
+    """ref operators/im2sequence_op.cc: slide a kernel over NCHW images and
+    emit one row per output position -> [B*OH*OW, C*kh*kw]."""
+    kh, kw = kernels
+    sh, sw = strides
+    ph, pw = paddings
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)))          # [B, C*kh*kw, OH, OW]
+    B, F, OH, OW = patches.shape
+    return patches.transpose(0, 2, 3, 1).reshape(B * OH * OW, F)
+
+
+@def_op("row_conv", n_tensor_args=2)
+def row_conv(x, wt):
+    """Lookahead row convolution (ref operators/row_conv_op.cc, DeepSpeech2):
+    y[b, t] = sum_{i=0..k-1} x[b, t+i] * wt[i], zero-padded at the tail.
+    x: [B, T, D], wt: [k, D]."""
+    k = wt.shape[0]
+    T = x.shape[1]
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for i in range(k):                        # k is small and static
+        out = out + xp[:, i:i + T] * wt[i][None, None, :]
+    return out
+
+
+@def_op("conv_shift", n_tensor_args=2)
+def conv_shift(x, y):
+    """Circular convolution/correlation (ref operators/conv_shift_op.cc):
+    out[b, i] = sum_j x[b, (i + j - M//2) mod N] * y[b, j].
+    x: [B, N], y: [B, M], M odd and <= N."""
+    N, M = x.shape[1], y.shape[1]
+    half = M // 2
+    idx = (jnp.arange(N)[:, None] + jnp.arange(M)[None, :] - half) % N
+    gathered = x[:, idx]                      # [B, N, M]
+    return jnp.sum(gathered * y[:, None, :], axis=2)
+
+
+@def_op("fsp", n_tensor_args=2)
+def fsp(x, y):
+    """FSP (flow of solution procedure) matrix for distillation
+    (ref operators/fsp_op.cc): [B,C1,H,W] x [B,C2,H,W] -> [B,C1,C2]
+    normalised by H*W."""
+    h, w = x.shape[2], x.shape[3]
+    return jnp.einsum("bchw,bdhw->bcd", x, y) / (h * w)
+
+
+def _increment_raw(x, step=1.0):
+    """ref operators/increment_op.cc (the loop-counter op). Attr is named
+    `step` to match the desc interpreter's builtin increment branch
+    (static/desc.py BUILTIN_OPS), so eager records and desc replays agree."""
+    return x + jnp.asarray(step, x.dtype)
+
+
+register_op("increment", _increment_raw)
+
+
+def increment(x, value=1.0):
+    return apply(_increment_raw, (x,), {"step": float(value)},
+                 name="increment")
+
+
+@def_op("expand_as_v2", n_tensor_args=2)
+def expand_as_v2(x, y):
+    """ref operators/expand_as_v2_op.cc: broadcast x to y's shape."""
+    return jnp.broadcast_to(x, y.shape)
+
+
+@def_op("reverse")
+def reverse(x, axis=0):
+    """ref operators/reverse_op.cc (multi-axis flip with list attr)."""
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.flip(x, axis=tuple(int(a) for a in axes))
+
+
+def _meshgrid_raw(*arrays):
+    return tuple(jnp.meshgrid(*arrays, indexing="ij"))
+
+
+register_op("meshgrid", _meshgrid_raw)
+
+
+def _unbind_raw(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+register_op("unbind", _unbind_raw)

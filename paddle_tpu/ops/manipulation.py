@@ -199,7 +199,8 @@ def chunk(x, chunks, axis=0, name=None):
 
 
 def unbind(x, axis=0, name=None):
-    return unstack(x, axis=axis)
+    from .legacy import _unbind_raw
+    return list(apply(_unbind_raw, (x,), {"axis": int(axis)}, name="unbind"))
 
 
 def _squeeze_raw(a, axis=None):

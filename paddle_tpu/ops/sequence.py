@@ -12,6 +12,7 @@ The `lod` concept survives only at the python edge: `sequence_pad/unpad`
 convert between python lists of variable-length arrays and the dense form.
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
@@ -255,3 +256,77 @@ def sequence_topk_avg_pooling(x, lengths, topks=(1,)):
                               srt, 0.0), axis=1)
         outs.append(s / jnp.maximum(kk, 1.0))
     return jnp.stack(outs, axis=1)
+
+
+@def_op("sequence_pad", n_tensor_args=3)
+def sequence_pad_op(x, lengths, pad_value, maxlen=None):
+    """ref sequence_ops/sequence_pad_op.cc: in the dense+lengths world the
+    data is already rectangular, so padding means forcing positions beyond
+    each row's length to pad_value (and optionally clipping/expanding T to
+    maxlen). Returns (padded, lengths) like the ref op's (Out, Length)."""
+    T = x.shape[1]
+    if maxlen is not None and maxlen != T:
+        if maxlen < T:
+            x = x[:, :maxlen]
+        else:
+            pad = [(0, 0), (0, maxlen - T)] + [(0, 0)] * (x.ndim - 2)
+            x = jnp.pad(x, pad)
+        T = maxlen
+    m = _mask(lengths, T, x.dtype).reshape(
+        (x.shape[0], T) + (1,) * (x.ndim - 2))
+    pv = jnp.asarray(pad_value, x.dtype)
+    return jnp.where(m > 0, x, pv), lengths
+
+
+@def_op("sequence_unpad", n_tensor_args=2)
+def sequence_unpad_op(x, lengths):
+    """ref sequence_ops/sequence_unpad_op.cc: the LoD output becomes the
+    dense canonical form — data zeroed past each length (so downstream
+    masked ops see exact zeros), lengths carried alongside. The python-edge
+    list converter keeps the public `sequence_unpad` name above."""
+    T = x.shape[1]
+    m = _mask(lengths, T, x.dtype).reshape(
+        (x.shape[0], T) + (1,) * (x.ndim - 2))
+    return x * m
+
+
+@def_op("sequence_reshape", n_tensor_args=2)
+def sequence_reshape(x, lengths, new_dim=1):
+    """ref sequence_ops/sequence_reshape_op.cc: refold each timestep row so
+    the trailing dim becomes new_dim; lengths scale by D/new_dim.
+    x: [B, T, D] -> ([B, T*D/new_dim, new_dim], scaled lengths)."""
+    B, T, D = x.shape
+    out = x.reshape(B, T * D // new_dim, new_dim)
+    return out, (lengths * D) // new_dim
+
+
+@def_op("sequence_scatter", n_tensor_args=4, differentiable=False)
+def sequence_scatter(x, index, updates, lengths):
+    """ref sequence_ops/sequence_scatter_op.cc: per row b, add
+    updates[b, j] into x[b, index[b, j]] for j < lengths[b]."""
+    m = (jnp.arange(index.shape[1])[None, :] < lengths[:, None])
+    upd = jnp.where(m.reshape(m.shape + (1,) * (updates.ndim - 2)),
+                    updates, 0)
+    bi = jnp.broadcast_to(jnp.arange(x.shape[0])[:, None], index.shape)
+    return x.at[bi, index].add(upd)
+
+
+@def_op("sequence_expand_as", n_tensor_args=2)
+def sequence_expand_as(x, lengths, maxlen=None):
+    """ref sequence_ops/sequence_expand_as_op.cc: repeat row b of x
+    lengths[b] times. Dense form: broadcast along a new T axis and mask —
+    [B, D] -> [B, Tmax, D] with rows beyond the length zeroed. Under
+    tracing the output T must be static: pass `maxlen` explicitly."""
+    if maxlen is not None:
+        T = int(maxlen)
+    elif isinstance(lengths, jax.core.Tracer):
+        raise ValueError(
+            "sequence_expand_as: lengths is traced and maxlen was not "
+            "given — the output time dim would be data-dependent. Pass "
+            "maxlen= (static) when calling under jit/desc tracing.")
+    else:
+        T = int(np.max(np.asarray(lengths)))
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    m = _mask(lengths, T, x.dtype).reshape(
+        (x.shape[0], T) + (1,) * (x.ndim - 1))
+    return out * m

@@ -217,10 +217,11 @@ def array_length(array):
 
 
 def increment(x, value=1.0):
-    """ref operators/increment_op.cc — loop counter helper."""
-    from ..ops.dispatch import apply
-    return apply(lambda a: a + jnp.asarray(value, a.dtype), (x,),
-                 name="increment")
+    """ref operators/increment_op.cc — loop counter helper. Routes through
+    the registered raw with the `step` attr so the desc replay (builtin
+    increment branch) sees the real step, not a closure-captured constant."""
+    from ..ops.legacy import increment as _inc
+    return _inc(x, value)
 
 
 def fori_loop(lower, upper, body_fn, init):

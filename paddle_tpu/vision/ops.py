@@ -315,3 +315,302 @@ def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
                   "spatial_scale": float(spatial_scale),
                   "sampling_ratio": int(sampling_ratio),
                   "aligned": bool(aligned)}, name="roi_align")
+
+
+# --------------------------------------------------------- roi pool family
+
+def _roi_pool_raw(x, boxes, output_size=(1, 1), spatial_scale=1.0):
+    """Quantized max-pool ROI pooling (ref operators/roi_pool_op.cc): bin
+    boundaries floor/ceil'd to integer pixels, max over each bin. Computed
+    as masked maxes over the full map per bin — static shapes for XLA; the
+    perf path for detection heads is roi_align. x: [1, C, H, W],
+    boxes: [R, 4] -> [R, C, ph, pw]."""
+    import jax
+    import jax.numpy as jnp
+    ph, pw = output_size
+    img = x[0]
+    c, h, w = img.shape
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def one_roi(box):
+        x1 = jnp.round(box[0] * spatial_scale)
+        y1 = jnp.round(box[1] * spatial_scale)
+        x2 = jnp.round(box[2] * spatial_scale)
+        y2 = jnp.round(box[3] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bh, bw = rh / ph, rw / pw
+
+        def one_bin(i, j):
+            hs = jnp.floor(y1 + i * bh)
+            he = jnp.ceil(y1 + (i + 1) * bh)
+            ws_ = jnp.floor(x1 + j * bw)
+            we = jnp.ceil(x1 + (j + 1) * bw)
+            m = ((ys[:, None] >= hs) & (ys[:, None] < he) &
+                 (xs[None, :] >= ws_) & (xs[None, :] < we))
+            neg = jnp.finfo(img.dtype).min
+            vals = jnp.where(m[None], img, neg)
+            mx = jnp.max(vals, axis=(1, 2))
+            any_m = jnp.any(m)
+            return jnp.where(any_m, mx, 0.0)
+
+        ii, jj = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw), indexing="ij")
+        bins = jax.vmap(jax.vmap(one_bin))(ii, jj)   # [ph, pw, C]
+        return bins.transpose(2, 0, 1)
+
+    return jax.vmap(one_roi)(boxes)
+
+
+register_op("roi_pool", _roi_pool_raw)
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+             name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    from ..ops.dispatch import as_array as _aa
+    if boxes_num is not None or _aa(x).shape[0] != 1:
+        raise NotImplementedError(
+            "roi_pool: pass one image per call (vmap over images for batches)")
+    return apply(_roi_pool_raw, (x, boxes),
+                 {"output_size": tuple(output_size),
+                  "spatial_scale": float(spatial_scale)}, name="roi_pool")
+
+
+def _psroi_pool_raw(x, boxes, output_size=(1, 1), spatial_scale=1.0,
+                    output_channels=1):
+    """Position-sensitive ROI average pooling (ref operators/psroi_pool_op.cc):
+    input channels C = output_channels*ph*pw; bin (i,j) of output channel k
+    averages input channel k*ph*pw + i*pw + j over the bin's pixels."""
+    import jax
+    import jax.numpy as jnp
+    ph, pw = output_size
+    img = x[0]
+    c, h, w = img.shape
+    ys = jnp.arange(h)
+    xs = jnp.arange(w)
+
+    def one_roi(box):
+        x1 = jnp.round(box[0]) * spatial_scale
+        y1 = jnp.round(box[1]) * spatial_scale
+        x2 = jnp.round(box[2] + 1.0) * spatial_scale
+        y2 = jnp.round(box[3] + 1.0) * spatial_scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+
+        def one_bin(i, j, k):
+            hs = jnp.floor(y1 + i * bh)
+            he = jnp.ceil(y1 + (i + 1) * bh)
+            ws_ = jnp.floor(x1 + j * bw)
+            we = jnp.ceil(x1 + (j + 1) * bw)
+            m = ((ys[:, None] >= hs) & (ys[:, None] < he) &
+                 (xs[None, :] >= ws_) & (xs[None, :] < we))
+            ch = (k * ph + i) * pw + j
+            plane = img[ch]
+            s = jnp.sum(jnp.where(m, plane, 0.0))
+            n = jnp.sum(m)
+            return jnp.where(n > 0, s / jnp.maximum(n, 1), 0.0)
+
+        kk, ii, jj = jnp.meshgrid(jnp.arange(output_channels),
+                                  jnp.arange(ph), jnp.arange(pw),
+                                  indexing="ij")
+        return jax.vmap(jax.vmap(jax.vmap(
+            lambda k, i, j: one_bin(i, j, k))))(kk, ii, jj)
+
+    return jax.vmap(one_roi)(boxes)
+
+
+register_op("psroi_pool", _psroi_pool_raw)
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+               name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    from ..ops.dispatch import as_array as _aa
+    xa = _aa(x)
+    if boxes_num is not None or xa.shape[0] != 1:
+        raise NotImplementedError(
+            "psroi_pool: pass one image per call")
+    ph, pw = output_size
+    if xa.shape[1] % (ph * pw) != 0:
+        raise ValueError(
+            f"psroi_pool: input channels ({xa.shape[1]}) must be divisible "
+            f"by output_size h*w ({ph}*{pw}) — ref psroi_pool_op enforces "
+            f"input_channels == output_channels * ph * pw")
+    oc = xa.shape[1] // (ph * pw)
+    return apply(_psroi_pool_raw, (x, boxes),
+                 {"output_size": tuple(output_size),
+                  "spatial_scale": float(spatial_scale),
+                  "output_channels": int(oc)}, name="psroi_pool")
+
+
+# ------------------------------------------------- channel/space reshapes
+
+def _affine_channel_raw(x, scale, bias, data_layout="NCHW"):
+    """ref operators/affine_channel_op.cc: y = x * scale[c] + bias[c]."""
+    import jax.numpy as jnp
+    if data_layout == "NCHW":
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+register_op("affine_channel", _affine_channel_raw)
+
+
+def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
+    return apply(_affine_channel_raw, (x, scale, bias),
+                 {"data_layout": data_layout}, name="affine_channel")
+
+
+def _channel_shuffle_raw(x, groups=1, data_format="NCHW"):
+    """ref operators/shuffle_channel_op.cc / paddle 2.x channel_shuffle."""
+    import jax.numpy as jnp
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        return (x.reshape(b, groups, c // groups, h, w)
+                 .transpose(0, 2, 1, 3, 4).reshape(b, c, h, w))
+    b, h, w, c = x.shape
+    return (x.reshape(b, h, w, groups, c // groups)
+             .transpose(0, 1, 2, 4, 3).reshape(b, h, w, c))
+
+
+register_op("channel_shuffle", _channel_shuffle_raw)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return apply(_channel_shuffle_raw, (x,),
+                 {"groups": int(groups), "data_format": data_format},
+                 name="channel_shuffle")
+
+
+def _pixel_unshuffle_raw(x, downscale_factor=1, data_format="NCHW"):
+    """Inverse of pixel_shuffle (ref operators/pixel_unshuffle_op.cc)."""
+    r = downscale_factor
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        return (x.reshape(b, c, h // r, r, w // r, r)
+                 .transpose(0, 1, 3, 5, 2, 4).reshape(b, c * r * r, h // r, w // r))
+    b, h, w, c = x.shape
+    return (x.reshape(b, h // r, r, w // r, r, c)
+             .transpose(0, 1, 3, 2, 4, 5).reshape(b, h // r, w // r, c * r * r))
+
+
+register_op("pixel_unshuffle", _pixel_unshuffle_raw)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return apply(_pixel_unshuffle_raw, (x,),
+                 {"downscale_factor": int(downscale_factor),
+                  "data_format": data_format}, name="pixel_unshuffle")
+
+
+def _space_to_depth_raw(x, blocksize=1):
+    """ref operators/space_to_depth_op.cc (NCHW; block-major channel order,
+    which matches the reference kernel's (c*bs + offset) layout rather than
+    pixel_unshuffle's channel-major)."""
+    bs = blocksize
+    b, c, h, w = x.shape
+    return (x.reshape(b, c, h // bs, bs, w // bs, bs)
+             .transpose(0, 3, 5, 1, 2, 4).reshape(b, c * bs * bs, h // bs, w // bs))
+
+
+register_op("space_to_depth", _space_to_depth_raw)
+
+
+def space_to_depth(x, blocksize, name=None):
+    return apply(_space_to_depth_raw, (x,), {"blocksize": int(blocksize)},
+                 name="space_to_depth")
+
+
+# ------------------------------------------------- pooling with indices
+
+def _max_pool2d_with_index_raw(x, kernel_size=(2, 2), stride=None,
+                               padding=(0, 0)):
+    """ref operators/max_pool2d_with_index_op (pool2d max + argmax over the
+    flattened H*W map). Returns (out, flat_indices) — the indices feed
+    max_unpool2d, exactly the reference pairing."""
+    import jax
+    import jax.numpy as jnp
+    kh, kw = kernel_size
+    sh, sw = (kh, kw) if stride is None else stride
+    ph, pw = padding
+    b, c, h, w = x.shape
+    xf = x.reshape(b * c, 1, h, w)
+    patches = jax.lax.conv_general_dilated_patches(
+        xf, filter_shape=(kh, kw), window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)))                 # [BC, kh*kw, OH, OW]
+    oh, ow = patches.shape[-2:]
+    # the patch layout is deterministic: entry (d, i, j) reads source pixel
+    # (i*sh - ph + d//kw, j*sw - pw + d%kw). Build the int32 index/validity
+    # grids arithmetically — no float round-trip (flat indices above 2^24
+    # would lose precision) and no extra convs.
+    d = jnp.arange(kh * kw)
+    rows = (jnp.arange(oh)[None, :, None] * sh - ph
+            + (d // kw)[:, None, None])               # [kh*kw, OH, 1]
+    cols = (jnp.arange(ow)[None, None, :] * sw - pw
+            + (d % kw)[:, None, None])                # [kh*kw, 1, OW]
+    valid = ((rows >= 0) & (rows < h) & (cols >= 0) & (cols < w))
+    flat = (rows * w + cols).astype(jnp.int32)        # [kh*kw, OH, OW]
+    neg = jnp.finfo(x.dtype).min
+    vals = jnp.where(valid[None], patches, neg)
+    arg = jnp.argmax(vals, axis=1)                    # [BC, OH, OW]
+    out = jnp.max(vals, axis=1)
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(flat[None], (b * c,) + flat.shape),
+        arg[:, None], axis=1)[:, 0]
+    return (out.reshape(b, c, oh, ow),
+            idx.reshape(b, c, oh, ow))
+
+
+register_op("max_pool2d_with_index", _max_pool2d_with_index_raw)
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0, name=None):
+    ks = (kernel_size,) * 2 if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = None if stride is None else (
+        (stride,) * 2 if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * 2 if isinstance(padding, int) else tuple(padding)
+    return apply(_max_pool2d_with_index_raw, (x,),
+                 {"kernel_size": ks, "stride": st, "padding": pd},
+                 name="max_pool2d_with_index")
+
+
+def _max_unpool2d_raw(x, indices, output_hw=(1, 1)):
+    """ref operators/unpool_op.cc: scatter pooled values back to the flat
+    positions recorded by max_pool2d_with_index."""
+    import jax.numpy as jnp
+    b, c, oh, ow = x.shape
+    H, W = output_hw
+    flat = jnp.zeros((b, c, H * W), x.dtype)
+    src = x.reshape(b, c, oh * ow)
+    idx = indices.reshape(b, c, oh * ow).astype(jnp.int32)
+    bi = jnp.arange(b)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    # indices from max_pool2d_with_index are unique per channel map, so a
+    # plain scatter-assign is exact (scatter-max would clobber negative
+    # values with the zero init)
+    flat = flat.at[bi, ci, idx].set(src)
+    return flat.reshape(b, c, H, W)
+
+
+register_op("max_unpool2d", _max_unpool2d_raw)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, name=None):
+    from ..ops.dispatch import as_array as _aa
+    xa = _aa(x)
+    ks = (kernel_size,) * 2 if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * 2 if isinstance(stride, int) else tuple(stride))
+    if output_size is None:
+        oh, ow = xa.shape[-2:]
+        output_size = ((oh - 1) * st[0] + ks[0], (ow - 1) * st[1] + ks[1])
+    return apply(_max_unpool2d_raw, (x, indices),
+                 {"output_hw": tuple(int(v) for v in output_size[-2:])},
+                 name="max_unpool2d")

@@ -650,6 +650,79 @@ SPECS = {
                           F32((1, 2, 8, 4), 2, -0.5, 0.5),
                           F32((1, 2, 8, 4), 3, -0.5, 0.5)],
                          grad=False, desc=False),
+    # --- legacy op tail (ops/legacy.py) ---
+    "huber_loss": S([F32(seed=1, lo=-2.0, hi=-1.0), F32(seed=2, lo=1.0, hi=2.0)],
+                    {"delta": 1.0}),          # |z|>delta: smooth linear zone
+    "rank_loss": S([F32((3, 1), 0, 0.0, 1.0), F32((3, 1), 1), F32((3, 1), 2)]),
+    "bpr_loss": S([F32((3, 4), 1), I32((3,), hi=4)]),
+    "hinge_loss": S([F32((2, 3), 1, 0.2, 0.8), BOOL((2, 3), 2)]),
+    "center_loss": S([F32((3, 4), 1), I32((3,), hi=5), F32((5, 4), 2)],
+                     {"alpha": 0.1}, out0=True),
+    "cos_sim": S([F32((3, 4), 1), F32((3, 4), 2)]),
+    "squared_l2_norm": S([F32()]),
+    "l1_norm": S([POS()]),
+    "frobenius_norm": S([F32()], {"axis": [1], "keepdim": False}),
+    "p_norm": S([POS((2, 3))], {"porder": 2.0, "axis": -1}),
+    "nce_loss": S([F32((2, 4), 1), F32((6, 4), 2), F32((6,), 3),
+                   I32((2,), hi=6, seed=4), I32((3,), hi=6, seed=5)]),
+    "linear_chain_crf": S([F32((2, 4, 3), 1), F32((5, 3), 2),
+                           I32((2, 4), hi=3), np.array([4, 2], "i4")]),
+    "mul": S([F32((2, 6), 1), F32((6, 3), 2)]),
+    "multiplex": S([I32((3,), hi=2), F32((3, 4), 1), F32((3, 4), 2)]),
+    "segment_pool": S([F32((5, 3), 1), np.array([0, 0, 1, 2, 2], "i4")],
+                      {"pool_type": "SUM", "num_segments": 3}),
+    "cvm": S([POS((3, 6)), POS((3, 2), 1)], {"use_cvm": True}),
+    "data_norm": S([F32((3, 4), 1), np.full((4,), 8.0, "f4"), F32((4,), 2),
+                    POS((4,), 3) * 10.0]),
+    "shuffle_batch": S([F32((4, 3))], {"seed": 3}),
+    "im2sequence": S([F32((1, 2, 4, 4))],
+                     {"kernels": (2, 2), "strides": (2, 2),
+                      "paddings": (0, 0)}),
+    "row_conv": S([F32((2, 5, 3), 1), F32((3, 3), 2)]),
+    "conv_shift": S([F32((2, 7), 1), F32((2, 3), 2)]),
+    "fsp": S([F32((2, 3, 4, 4), 1), F32((2, 5, 4, 4), 2)]),
+    "increment": S([F32((1,))], {"step": 2.0}),
+    "expand_as_v2": S([F32((1, 3)), F32((4, 3), 1)]),
+    "reverse": S([F32()], {"axis": [1]}),
+    "meshgrid": S([F32((3,)), F32((2,), 1)], out0=True),
+    "unbind": S([F32((2, 3))], {"axis": 0}, out0=True),
+    # --- vision tail (vision/ops.py) ---
+    "roi_pool": S([F32((1, 2, 6, 6)),
+                   np.array([[0, 0, 3, 3], [1, 1, 5, 5]], "f4")],
+                  {"output_size": (2, 2), "spatial_scale": 1.0}, grad=False),
+    "psroi_pool": S([F32((1, 4, 6, 6)),
+                     np.array([[0, 0, 3, 3], [1, 1, 5, 5]], "f4")],
+                    {"output_size": (2, 2), "spatial_scale": 1.0,
+                     "output_channels": 1}),
+    "affine_channel": S([F32((1, 3, 2, 2)), F32((3,), 1), F32((3,), 2)]),
+    "channel_shuffle": S([F32((1, 4, 2, 2))], {"groups": 2}),
+    "pixel_unshuffle": S([F32((1, 2, 4, 4))], {"downscale_factor": 2}),
+    "space_to_depth": S([F32((1, 2, 4, 4))], {"blocksize": 2}),
+    "max_pool2d_with_index": S([F32((1, 2, 4, 4))],
+                               {"kernel_size": (2, 2)}, out0=True),
+    "max_unpool2d": S([F32((1, 2, 2, 2)),
+                       np.array([[[[0, 3], [8, 11]], [[1, 2], [9, 10]]]],
+                                "i4")],
+                      {"output_hw": (4, 4)}),
+    # --- fluid-era rnn cell ops (nn/rnn.py) ---
+    "gru_unit": S([F32((2, 12), 1), F32((2, 4), 2), F32((4, 12), 3),
+                   F32((1, 12), 4)], out0=True),
+    "lstm_unit": S([F32((2, 16), 1), F32((2, 4), 2)],
+                   {"forget_bias": 1.0}, out0=True),
+    "lstmp_seq": S([F32((3, 2, 3)), F32((2, 2), 1), F32((2, 4), 2),
+                    F32((16, 3), 3), F32((16, 2), 4), F32((16,), 5),
+                    F32((16,), 6), F32((4, 2), 7),
+                    np.array([3, 2], "i4")], out0=True),
+    # --- sequence tail (ops/sequence.py) ---
+    "sequence_pad": S([F32((2, 4, 3)), np.array([3, 2], "i4"),
+                       np.array([0.5], "f4")], out0=True),
+    "sequence_unpad": S([F32((2, 4, 3)), np.array([3, 2], "i4")]),
+    "sequence_reshape": S([F32((2, 4, 6)), np.array([3, 2], "i4")],
+                          {"new_dim": 3}, out0=True),
+    "sequence_scatter": S([F32((2, 4, 3)), np.array([[0, 1], [1, 2]], "i4"),
+                           F32((2, 2, 3), 1), np.array([2, 1], "i4")],
+                          grad=False),
+    "sequence_expand_as": S([F32((2, 3)), np.array([3, 2], "i4")]),
 }
 SPECS.pop("rnn")
 
