@@ -11,7 +11,7 @@ Programming model (ref README.md dual model):
 __version__ = "0.1.0"
 
 from .framework import (  # noqa: F401
-    Tensor, Parameter, to_tensor,
+    Tensor, Parameter, to_tensor, create_parameter,
     float16, bfloat16, float32, float64, int8, int16, int32, int64, uint8,
     bool_, complex64, complex128,
     CPUPlace, TPUPlace, CUDAPlace, XPUPlace,

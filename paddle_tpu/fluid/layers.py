@@ -211,21 +211,30 @@ def transpose(x, perm, name=None):
     return MA.transpose(x, perm)
 
 
-def elementwise_add(x, y, axis=-1, act=None, name=None):
-    out = M.add(x, y)
+def _elementwise(opname, x, y, axis, act):
+    """1.x elementwise with the mid-dim `axis` broadcast attr honored
+    (registered raws in ops/legacy.py; ref elementwise_op_function.h)."""
+    from ..ops import legacy as _L
+    from ..ops.dispatch import apply as _apply
+    out = _apply(getattr(_L, opname), (x, y), {"axis": int(axis)},
+                 name=opname)
     return getattr(F, act)(out) if act else out
 
 
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_add", x, y, axis, act)
+
+
 def elementwise_sub(x, y, axis=-1, act=None, name=None):
-    return M.subtract(x, y)
+    return _elementwise("elementwise_sub", x, y, axis, act)
 
 
 def elementwise_mul(x, y, axis=-1, act=None, name=None):
-    return M.multiply(x, y)
+    return _elementwise("elementwise_mul", x, y, axis, act)
 
 
 def elementwise_div(x, y, axis=-1, act=None, name=None):
-    return M.divide(x, y)
+    return _elementwise("elementwise_div", x, y, axis, act)
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
@@ -375,23 +384,19 @@ def clip_by_norm(x, max_norm, name=None):
 
 
 def elementwise_max(x, y, axis=-1, act=None, name=None):
-    out = M.maximum(x, y)
-    return getattr(F, act)(out) if act else out
+    return _elementwise("elementwise_max", x, y, axis, act)
 
 
 def elementwise_min(x, y, axis=-1, act=None, name=None):
-    out = M.minimum(x, y)
-    return getattr(F, act)(out) if act else out
+    return _elementwise("elementwise_min", x, y, axis, act)
 
 
 def elementwise_pow(x, y, axis=-1, act=None, name=None):
-    out = M.pow(x, y)
-    return getattr(F, act)(out) if act else out
+    return _elementwise("elementwise_pow", x, y, axis, act)
 
 
 def elementwise_mod(x, y, axis=-1, act=None, name=None):
-    out = M.remainder(x, y)
-    return getattr(F, act)(out) if act else out
+    return _elementwise("elementwise_mod", x, y, axis, act)
 
 
 def reduce_min(input, dim=None, keep_dim=False, name=None):

@@ -10,3 +10,22 @@ from .tensor import Tensor, Parameter, to_tensor
 from . import tape
 from . import errors
 from .errors import enforce, enforce_eq, enforce_shape
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Top-level paddle.create_parameter (ref python/paddle/__init__.py:237
+    alias of fluid framework.create_parameter): a fresh trainable Parameter,
+    Xavier-normal by default, zeros when is_bias."""
+    from ..nn import initializer as I
+    init = default_initializer
+    if init is None and attr is not None and getattr(attr, "initializer",
+                                                    None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    p = Parameter(init(tuple(shape), dtype),
+                  name=name or (getattr(attr, "name", None) if attr else None))
+    if attr is not None and getattr(attr, "regularizer", None) is not None:
+        p.regularizer = attr.regularizer
+    return p

@@ -355,3 +355,44 @@ def _unbind_raw(x, axis=0):
 
 
 register_op("unbind", _unbind_raw)
+
+
+# -------------------------------------------- 1.x elementwise w/ axis attr
+
+def _axis_broadcast(x, y, axis):
+    """Paddle 1.x elementwise broadcast (ref operators/elementwise/
+    elementwise_op_function.h GetMidDims): y's dims align to x starting at
+    `axis` (default -1 = trailing alignment, numpy-style). Returns y
+    reshaped so jnp broadcasting reproduces the reference semantics."""
+    if axis == -1 or axis is None:
+        return y
+    # reference GetMidDims trims y's trailing size-1 dims before aligning
+    shape = tuple(y.shape)
+    while shape and shape[-1] == 1:
+        shape = shape[:-1]
+    trail = x.ndim - axis - len(shape)
+    if trail < 0:
+        raise ValueError(
+            f"elementwise axis={axis} invalid for x.ndim={x.ndim}, "
+            f"y.ndim={len(shape)} (after trailing-1 trim)")
+    return y.reshape((1,) * axis + shape + (1,) * trail)
+
+
+def _make_elementwise(opname, fn):
+    def raw(x, y, axis=-1):
+        return fn(x, _axis_broadcast(x, y, axis))
+    raw.__name__ = opname
+    raw.__doc__ = (f"ref operators/elementwise/{opname}_op.cc — binary op "
+                   "with the 1.x mid-dim `axis` broadcast attr.")
+    register_op(opname, raw)
+    return raw
+
+
+elementwise_add = _make_elementwise("elementwise_add", lambda a, b: a + b)
+elementwise_sub = _make_elementwise("elementwise_sub", lambda a, b: a - b)
+elementwise_mul = _make_elementwise("elementwise_mul", lambda a, b: a * b)
+elementwise_div = _make_elementwise("elementwise_div", lambda a, b: a / b)
+elementwise_max = _make_elementwise("elementwise_max", jnp.maximum)
+elementwise_min = _make_elementwise("elementwise_min", jnp.minimum)
+elementwise_pow = _make_elementwise("elementwise_pow", lambda a, b: a ** b)
+elementwise_mod = _make_elementwise("elementwise_mod", jnp.mod)

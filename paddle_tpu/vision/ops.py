@@ -614,3 +614,143 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
     return apply(_max_unpool2d_raw, (x, indices),
                  {"output_hw": tuple(int(v) for v in output_size[-2:])},
                  name="max_unpool2d")
+
+
+# ----------------------------------------------------------- yolov3 loss
+
+def _yolov3_loss_raw(x, gt_box, gt_label, gt_score=None, anchors=(),
+                     anchor_mask=(), class_num=1, ignore_thresh=0.7,
+                     downsample_ratio=32, use_label_smooth=True):
+    """YOLOv3 training loss (ref operators/detection/yolov3_loss_op.cc).
+
+    x: [B, A*(5+C), H, W] raw head output for this scale (A = len(anchor_mask)),
+    gt_box: [B, N, 4] normalised (cx, cy, w, h), gt_label: [B, N] int
+    (rows with w<=0 are padding), gt_score: [B, N] optional per-gt mixup
+    weight (scales that gt's loc/cls losses and is the objectness target,
+    as in the reference's CalcObjnessLossGrad). Follows the reference split:
+    sigmoid-CE on x/y, L1 on w/h (scaled by 2 - gw*gh), sigmoid-CE
+    objectness with ignore zone (pred IoU vs any gt > ignore_thresh), and
+    per-class sigmoid-CE at positive cells. The responsible anchor for a gt
+    is the best whole-anchor-set wh-IoU match, positive only when that
+    anchor belongs to this scale's mask — exactly the reference assignment.
+    Returns [B] loss.
+    """
+    import jax
+    import jax.numpy as jnp
+    B, _, H, W = x.shape
+    A = len(anchor_mask)
+    C = class_num
+    an = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)     # [An, 2] pixels
+    mask = jnp.asarray(anchor_mask, jnp.int32)
+    in_h, in_w = H * downsample_ratio, W * downsample_ratio
+
+    p = x.reshape(B, A, 5 + C, H, W)
+    tx, ty, tw, th = p[:, :, 0], p[:, :, 1], p[:, :, 2], p[:, :, 3]
+    tobj, tcls = p[:, :, 4], p[:, :, 5:]                      # [B,A,H,W], [B,A,C,H,W]
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+
+    # ---- decoded pred boxes (normalised) for the ignore-zone IoU test
+    gx = (jnp.arange(W)[None, None, None, :] + jax.nn.sigmoid(tx)) / W
+    gy = (jnp.arange(H)[None, None, :, None] + jax.nn.sigmoid(ty)) / H
+    aw = an[mask, 0][None, :, None, None]
+    ah = an[mask, 1][None, :, None, None]
+    pw = jnp.exp(tw) * aw / in_w
+    phh = jnp.exp(th) * ah / in_h
+
+    def iou_cwh(ax_, ay_, aw_, ah_, bx, by, bw, bh):
+        x1 = jnp.maximum(ax_ - aw_ / 2, bx - bw / 2)
+        x2 = jnp.minimum(ax_ + aw_ / 2, bx + bw / 2)
+        y1 = jnp.maximum(ay_ - ah_ / 2, by - bh / 2)
+        y2 = jnp.minimum(ay_ + ah_ / 2, by + bh / 2)
+        inter = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+        return inter / jnp.maximum(aw_ * ah_ + bw * bh - inter, 1e-10)
+
+    gb = gt_box.astype(jnp.float32)                           # [B, N, 4]
+    gvalid = gb[:, :, 2] > 0                                  # [B, N]
+    iou = iou_cwh(gx[..., None], gy[..., None], pw[..., None], phh[..., None],
+                  gb[:, None, None, None, :, 0], gb[:, None, None, None, :, 1],
+                  gb[:, None, None, None, :, 2], gb[:, None, None, None, :, 3])
+    iou = jnp.where(gvalid[:, None, None, None, :], iou, 0.0)
+    ignore = jnp.max(iou, axis=-1) > ignore_thresh            # [B,A,H,W]
+
+    # ---- responsible-anchor assignment per gt (wh IoU over ALL anchors)
+    gw_pix, gh_pix = gb[:, :, 2] * in_w, gb[:, :, 3] * in_h   # [B, N]
+    inter = (jnp.minimum(gw_pix[:, :, None], an[None, None, :, 0]) *
+             jnp.minimum(gh_pix[:, :, None], an[None, None, :, 1]))
+    union = (gw_pix * gh_pix)[:, :, None] + (an[:, 0] * an[:, 1])[None, None] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=2)  # [B, N]
+    # map best whole-set anchor -> slot in this scale's mask (or -1)
+    slot = jnp.argmax(best[:, :, None] == mask[None, None, :], axis=2)
+    in_mask = jnp.any(best[:, :, None] == mask[None, None, :], axis=2)
+    resp = gvalid & in_mask                                   # [B, N]
+
+    gi = jnp.clip((gb[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gb[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+    # per-gt targets
+    t_x = gb[:, :, 0] * W - gi
+    t_y = gb[:, :, 1] * H - gj
+    t_w = jnp.log(jnp.maximum(gw_pix / jnp.maximum(an[:, 0][best], 1e-10), 1e-10))
+    t_h = jnp.log(jnp.maximum(gh_pix / jnp.maximum(an[:, 1][best], 1e-10), 1e-10))
+    scale = 2.0 - gb[:, :, 2] * gb[:, :, 3]                   # [B, N]
+
+    bi = jnp.broadcast_to(jnp.arange(B)[:, None], resp.shape)
+    # gather predictions at each gt's assigned location
+    px_g = tx[bi, slot, gj, gi]
+    py_g = ty[bi, slot, gj, gi]
+    pw_g = tw[bi, slot, gj, gi]
+    ph_g = th[bi, slot, gj, gi]
+    score = (jnp.ones_like(gb[:, :, 0]) if gt_score is None
+             else gt_score.astype(jnp.float32))               # [B, N]
+    loc = (bce(px_g, t_x) + bce(py_g, t_y)
+           + jnp.abs(pw_g - t_w) + jnp.abs(ph_g - t_h)) * scale * score
+    loc_loss = jnp.sum(jnp.where(resp, loc, 0.0), axis=1)     # [B]
+
+    # ---- objectness: positives at responsible cells (target = gt_score),
+    # negatives elsewhere. Scatter with .max: a padding/non-responsible row
+    # writing 0 at a duplicate index must not clobber a positive.
+    posw = jnp.zeros((B, A, H, W)).at[bi, slot, gj, gi].max(
+        jnp.where(resp, score, 0.0), mode="drop")
+    pos = posw > 0
+    obj_pos = jnp.where(pos, bce(tobj, posw), 0.0)
+    obj_neg = jnp.where((~pos) & (~ignore),
+                        bce(tobj, jnp.zeros_like(tobj)), 0.0)
+    obj_loss = jnp.sum(obj_pos + obj_neg, axis=(1, 2, 3))
+
+    # ---- classification at positive cells (ref label_smooth: positives
+    # 1 - sw, negatives sw, sw = min(1/C, 1/40))
+    sw = min(1.0 / C, 1.0 / 40.0) if (use_label_smooth and C > 1) else 0.0
+    onehot = jax.nn.one_hot(gt_label, C)                      # [B, N, C]
+    tgt = onehot * (1.0 - sw) + (1.0 - onehot) * sw
+    pcls_g = jnp.transpose(tcls, (0, 1, 3, 4, 2))[bi, slot, gj, gi]  # [B,N,C]
+    cls = jnp.sum(bce(pcls_g, tgt), axis=2) * score
+    cls_loss = jnp.sum(jnp.where(resp, cls, 0.0), axis=1)
+
+    return loc_loss + obj_loss + cls_loss
+
+
+register_op("yolov3_loss", _yolov3_loss_raw)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    if gt_score is not None:
+        return apply(_yolov3_loss_raw, (x, gt_box, gt_label, gt_score),
+                     {"anchors": [int(a) for a in anchors],
+                      "anchor_mask": [int(a) for a in anchor_mask],
+                      "class_num": int(class_num),
+                      "ignore_thresh": float(ignore_thresh),
+                      "downsample_ratio": int(downsample_ratio),
+                      "use_label_smooth": bool(use_label_smooth)},
+                     name="yolov3_loss")
+    return apply(_yolov3_loss_raw, (x, gt_box, gt_label),
+                 {"anchors": [int(a) for a in anchors],
+                  "anchor_mask": [int(a) for a in anchor_mask],
+                  "class_num": int(class_num),
+                  "ignore_thresh": float(ignore_thresh),
+                  "downsample_ratio": int(downsample_ratio),
+                  "use_label_smooth": bool(use_label_smooth)},
+                 name="yolov3_loss")

@@ -686,6 +686,23 @@ SPECS = {
     "reverse": S([F32()], {"axis": [1]}),
     "meshgrid": S([F32((3,)), F32((2,), 1)], out0=True),
     "unbind": S([F32((2, 3))], {"axis": 0}, out0=True),
+    # --- 1.x elementwise with mid-dim axis broadcast ---
+    "elementwise_add": S([F32((2, 3, 4), 1), F32((3,), 2)], {"axis": 1}),
+    "elementwise_sub": S([F32((2, 3, 4), 1), F32((3,), 2)], {"axis": 1}),
+    "elementwise_mul": S([F32((2, 3, 4), 1), F32((3,), 2)], {"axis": 1}),
+    "elementwise_div": S([F32((2, 3, 4), 1), POS((3,), 2)], {"axis": 1}),
+    "elementwise_max": S([F32((2, 3), 1), F32((2, 3), 2)], grad=False),
+    "elementwise_min": S([F32((2, 3), 1), F32((2, 3), 2)], grad=False),
+    "elementwise_pow": S([POS((2, 3), 1), F32((2, 3), 2, 0.5, 2.0)]),
+    "elementwise_mod": S([POS((2, 3), 1), POS((2, 3), 2)], grad=False),
+    "yolov3_loss": S([F32((1, 18, 4, 4), 1, -0.5, 0.5),
+                      np.array([[[0.3, 0.4, 0.1, 0.2],
+                                 [0.0, 0.0, 0.0, 0.0]]], "f4"),
+                      np.array([[1, 0]], "i4")],
+                     {"anchors": [10, 13, 16, 30, 33, 23],
+                      "anchor_mask": [1, 2], "class_num": 4,
+                      "ignore_thresh": 0.7, "downsample_ratio": 32},
+                     grad=False),   # argmax assignment: FD at switch points
     # --- vision tail (vision/ops.py) ---
     "roi_pool": S([F32((1, 2, 6, 6)),
                    np.array([[0, 0, 3, 3], [1, 1, 5, 5]], "f4")],
