@@ -1187,27 +1187,117 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
                   "d": list(_norm_tuple(dilations, 2))}, name="unfold")
 
 
-def _interpolate_raw(a, size=None, scale_factor=None, mode="nearest",
-                     channels_last=False):
-    if not channels_last:
-        n, c, h, w = a.shape
-        spatial = (h, w)
+def _interp_axis_coords(out_n, in_n, align_corners):
+    """Source coordinates for each output index along one axis.
+    align_corners=True maps endpoints to endpoints (ref interpolate_op.h
+    align_corners branch; ratio 0 when out_n <= 1, selecting pixel 0);
+    False uses half-pixel centers."""
+    if align_corners:
+        ratio = (in_n - 1) / (out_n - 1) if out_n > 1 else 0.0
+        return jnp.arange(out_n) * ratio
+    scale = in_n / out_n
+    return jnp.maximum((jnp.arange(out_n) + 0.5) * scale - 0.5, 0.0)
+
+
+def _interp_linear_1axis(a, axis, out_n, align_corners):
+    """Linear resample of one axis by gather + lerp (any rank)."""
+    in_n = a.shape[axis]
+    c = _interp_axis_coords(out_n, in_n, align_corners)
+    lo = jnp.clip(jnp.floor(c).astype(jnp.int32), 0, in_n - 1)
+    hi = jnp.clip(lo + 1, 0, in_n - 1)
+    w = (c - lo).astype(a.dtype)
+    lo_v = jnp.take(a, lo, axis=axis)
+    hi_v = jnp.take(a, hi, axis=axis)
+    shape = [1] * a.ndim
+    shape[axis] = out_n
+    return lo_v * (1.0 - w.reshape(shape)) + hi_v * w.reshape(shape)
+
+
+def _interp_nearest_1axis(a, axis, out_n, align_corners):
+    """Reference nearest_interp index rule (ref interpolate_op.h
+    NearestNeighborInterpolate): floor(i*in/out) without align,
+    floor(i*ratio + 0.5) with align_corners."""
+    in_n = a.shape[axis]
+    i = jnp.arange(out_n)
+    if align_corners:
+        ratio = (in_n - 1) / (out_n - 1) if out_n > 1 else 0.0
+        idx = jnp.floor(i * ratio + 0.5)
     else:
-        n, h, w, c = a.shape
-        spatial = (h, w)
+        idx = jnp.floor(i * (in_n / out_n))
+    return jnp.take(a, jnp.clip(idx.astype(jnp.int32), 0, in_n - 1),
+                    axis=axis)
+
+
+def _interp_cubic_1axis(a, axis, out_n, align_corners):
+    """Cubic (Keys a=-0.75) resample of one axis with 4-tap gathers —
+    honors align_corners, unlike jax.image.resize (ref bicubic_interp's
+    cubic_interp1d)."""
+    in_n = a.shape[axis]
+    if align_corners:
+        ratio = (in_n - 1) / (out_n - 1) if out_n > 1 else 0.0
+        c = jnp.arange(out_n) * ratio
+    else:
+        # unclamped half-pixel coords: the reference (and torch) only clamp
+        # for the linear family; cubic keeps negative fractions at borders
+        c = (jnp.arange(out_n) + 0.5) * (in_n / out_n) - 0.5
+    base = jnp.floor(c).astype(jnp.int32)
+    t = (c - base).astype(a.dtype)
+    A = -0.75
+
+    def k1(x):      # |x| <= 1
+        return ((A + 2.0) * x - (A + 3.0)) * x * x + 1.0
+
+    def k2(x):      # 1 < |x| < 2
+        return ((A * x - 5.0 * A) * x + 8.0 * A) * x - 4.0 * A
+
+    ws = [k2(t + 1.0), k1(t), k1(1.0 - t), k2(2.0 - t)]
+    shape = [1] * a.ndim
+    shape[axis] = out_n
+    out = None
+    for tap, w in zip((-1, 0, 1, 2), ws):
+        v = jnp.take(a, jnp.clip(base + tap, 0, in_n - 1), axis=axis)
+        term = v * w.reshape(shape)
+        out = term if out is None else out + term
+    return out
+
+
+def _interpolate_raw(a, size=None, scale_factor=None, mode="nearest",
+                     channels_last=False, align_corners=False):
+    """All reference interp op families on one raw (ref operators/
+    interpolate_op.cc + interpolate_v2: linear [NCW], bilinear/nearest/
+    bicubic/area [NCHW], trilinear [NCDHW]); align_corners honored for the
+    nearest/linear family via explicit source-grid gathers."""
+    n_spatial = a.ndim - 2
+    sp_axes = tuple(range(1, 1 + n_spatial)) if channels_last \
+        else tuple(range(2, 2 + n_spatial))
+    spatial = tuple(a.shape[ax] for ax in sp_axes)
     if size is not None:
-        out_hw = tuple(int(v) for v in size)
+        out_sp = tuple(int(v) for v in (
+            size if isinstance(size, (list, tuple)) else [size] * n_spatial))
     else:
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
-            else (scale_factor, scale_factor)
-        out_hw = (int(spatial[0] * sf[0]), int(spatial[1] * sf[1]))
-    method = {"nearest": "nearest", "bilinear": "linear",
-              "bicubic": "cubic", "area": "linear"}[mode]
-    if not channels_last:
-        shape = (n, c) + out_hw
-    else:
-        shape = (n,) + out_hw + (c,)
-    return jax.image.resize(a, shape, method=method)
+            else (scale_factor,) * n_spatial
+        out_sp = tuple(int(s * f) for s, f in zip(spatial, sf))
+    if mode in ("linear", "bilinear", "trilinear"):
+        out = a
+        for ax, o in zip(sp_axes, out_sp):
+            out = _interp_linear_1axis(out, ax, o, align_corners)
+        return out
+    if mode == "nearest":
+        out = a
+        for ax, o in zip(sp_axes, out_sp):
+            out = _interp_nearest_1axis(out, ax, o, align_corners)
+        return out
+    if mode == "bicubic":
+        out = a
+        for ax, o in zip(sp_axes, out_sp):
+            out = _interp_cubic_1axis(out, ax, o, align_corners)
+        return out
+    # area: jax.image.resize antialiased linear (half-pixel semantics)
+    shape = list(a.shape)
+    for ax, o in zip(sp_axes, out_sp):
+        shape[ax] = o
+    return jax.image.resize(a, tuple(shape), method="linear")
 
 
 register_op("interpolate", _interpolate_raw)
@@ -1216,17 +1306,20 @@ register_op("interpolate", _interpolate_raw)
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
+    nd = as_array(x).ndim - 2
     if size is not None:
         size = [int(v) for v in
                 (size.tolist() if isinstance(size, Tensor) else size)] \
-            if not isinstance(size, numbers.Number) else [int(size)] * 2
+            if not isinstance(size, numbers.Number) else [int(size)] * nd
     if isinstance(scale_factor, (list, tuple)):
         scale_factor = [float(v) for v in scale_factor]
     elif scale_factor is not None:
         scale_factor = float(scale_factor)
     return apply(_interpolate_raw, (x,),
                  {"size": size, "scale_factor": scale_factor,
-                  "mode": str(mode), "channels_last": data_format != "NCHW"},
+                  "mode": str(mode),
+                  "channels_last": data_format in ("NHWC", "NWC", "NDHWC"),
+                  "align_corners": bool(align_corners)},
                  name="interpolate")
 
 

@@ -396,3 +396,264 @@ elementwise_max = _make_elementwise("elementwise_max", jnp.maximum)
 elementwise_min = _make_elementwise("elementwise_min", jnp.minimum)
 elementwise_pow = _make_elementwise("elementwise_pow", lambda a, b: a ** b)
 elementwise_mod = _make_elementwise("elementwise_mod", jnp.mod)
+
+
+# ------------------------------------------------------- search / decode
+
+@def_op("crf_decoding", n_tensor_args=3, differentiable=False)
+def crf_decoding(emission, transition, lengths):
+    """Viterbi decode paired with linear_chain_crf's transition layout
+    (ref operators/crf_decoding_op.h): transition rows 0/1 are start/stop,
+    2.. the pairwise matrix. emission: [B, T, N], lengths: [B].
+    Returns the argmax path [B, T] (positions past length are 0)."""
+    B, T, N = emission.shape
+    start, stop, w = transition[0], transition[1], transition[2:]
+    alpha0 = start[None, :] + emission[:, 0]
+
+    def fwd(alpha, t):
+        cand = alpha[:, :, None] + w[None, :, :]
+        best = jnp.max(cand, axis=1)
+        arg = jnp.argmax(cand, axis=1)
+        nxt = best + emission[:, t]
+        live = (t < lengths)[:, None]
+        return jnp.where(live, nxt, alpha), jnp.where(
+            live, arg, jnp.broadcast_to(jnp.arange(N)[None, :], arg.shape))
+
+    alphaT, back = jax.lax.scan(fwd, alpha0, jnp.arange(1, T))
+    # stop transition applies at each row's true last step; since frozen
+    # alphas carry the final scores, add stop once at the end
+    last = jnp.argmax(alphaT + stop[None, :], axis=1)            # [B]
+
+    def bwd(state, bp):
+        cur = state
+        prev = jnp.take_along_axis(bp, cur[:, None], axis=1)[:, 0]
+        return prev, cur
+
+    # scan(reverse=True) over back[0..T-2]: ys[k] = path[k+1], final carry
+    # = path[0]
+    first, path_rev = jax.lax.scan(bwd, last, back, reverse=True)
+    path = jnp.vstack([first[None, :], path_rev]).T              # [B, T]
+    t_idx = jnp.arange(T)[None, :]
+    return jnp.where(t_idx < lengths[:, None], path, 0).astype(jnp.int32)
+
+
+@def_op("beam_search", n_tensor_args=3, differentiable=False)
+def beam_search(pre_ids, pre_scores, probs, beam_size=4, end_id=0):
+    """One beam-search step on dense [B, W, V] score tensors
+    (ref operators/beam_search_op.h — the reference walks LoD lattices; the
+    dense analog selects top-`beam_size` continuations per batch row from
+    W*V candidates, exactly what gather_tree consumes downstream).
+
+    pre_ids: [B, W] int, pre_scores: [B, W], probs: [B, W, V] (already
+    normalised). Finished beams (pre_id == end_id) only continue with
+    end_id at unchanged score. Returns (selected_ids [B, W'],
+    selected_scores [B, W'], parent_idx [B, W'])."""
+    B, W, V = probs.shape
+    logp = jnp.log(jnp.maximum(probs, 1e-20))
+    total = pre_scores[:, :, None] + logp                        # [B, W, V]
+    finished = pre_ids == end_id                                 # [B, W]
+    neg = jnp.finfo(total.dtype).min
+    # finished beams: only the end_id column stays, at the old score
+    keep_end = jnp.zeros((B, W, V), bool).at[:, :, end_id].set(True)
+    total = jnp.where(finished[:, :, None],
+                      jnp.where(keep_end, pre_scores[:, :, None], neg),
+                      total)
+    flat = total.reshape(B, W * V)
+    top_scores, top_idx = jax.lax.top_k(flat, beam_size)
+    parent = (top_idx // V).astype(jnp.int32)
+    ids = (top_idx % V).astype(jnp.int32)   # default int width (x64 off)
+    return ids, top_scores, parent
+
+
+@def_op("sample_logits", n_tensor_args=3, differentiable=False)
+def sample_logits(logits, labels, samples, remove_accidental_hits=True):
+    """Gather true + sampled-negative logits (ref operators/
+    sample_logits_op.cc with caller-supplied samples, CustomDist path).
+    logits: [B, V], labels: [B, 1] int, samples: [S] int.
+    Returns sampled_logits [B, 1+S]; accidental hits (a sampled id equal to
+    the row's true label) are pushed to -1e20 like the reference."""
+    lab = labels.reshape(-1)
+    true_logit = jnp.take_along_axis(logits, lab[:, None], axis=1)
+    samp_logit = logits[:, samples]                              # [B, S]
+    if remove_accidental_hits:
+        hit = samples[None, :] == lab[:, None]
+        samp_logit = jnp.where(hit, -1e20, samp_logit)
+    return jnp.concatenate([true_logit, samp_logit], axis=1)
+
+
+# ------------------------------------------------------------- metric ops
+
+@def_op("auc", n_tensor_args=4, differentiable=False)
+def auc(predict, label, stat_pos, stat_neg, num_thresholds=4095):
+    """Streaming AUC op (ref operators/metrics/auc_op.cc): bucket the
+    positive-class probability, accumulate pos/neg histograms into the
+    running stats, output (auc, stat_pos_out, stat_neg_out)."""
+    p = predict[:, -1] if predict.ndim == 2 else predict.reshape(-1)
+    buck = jnp.clip((p * num_thresholds).astype(jnp.int32),
+                    0, num_thresholds)
+    y = label.reshape(-1).astype(jnp.int32)
+    pos = stat_pos + jnp.zeros_like(stat_pos).at[buck].add(
+        (y == 1).astype(stat_pos.dtype))
+    neg = stat_neg + jnp.zeros_like(stat_neg).at[buck].add(
+        (y == 0).astype(stat_neg.dtype))
+    # walk buckets low->high: area += neg_i * (pos_above_i + pos_i/2)
+    area = jnp.sum(neg * (jnp.sum(pos) - jnp.cumsum(pos) + 0.5 * pos))
+    denom = jnp.maximum(jnp.sum(pos) * jnp.sum(neg), 1.0)
+    return area / denom, pos, neg
+
+
+@def_op("chunk_eval", n_tensor_args=3, differentiable=False)
+def chunk_eval(inference, label, lengths, num_chunk_types=1,
+               chunk_scheme="IOB"):
+    """Chunking precision/recall/F1 (ref operators/metrics/chunk_eval_op.cc).
+    Tags follow the reference's encoding: scheme IOB -> tag = type*2 + {B:0,
+    I:1}; IOE -> {I:0, E:1}; IOBES -> type*4 + {B,I,E,S}; plain -> type.
+    A tag >= num_chunk_types*tag_arity is 'outside'. Host-side numpy (metric
+    op, eager only). Returns (precision, recall, f1, num_infer, num_label,
+    num_correct)."""
+    import numpy as _np
+    inf = _np.asarray(inference)
+    lab = _np.asarray(label)
+    lens = _np.asarray(lengths)
+
+    arity = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[chunk_scheme]
+
+    def chunks(row, L):
+        out = []
+        start, ctype = None, None
+        for t in range(int(L)):
+            tag = int(row[t])
+            if tag >= num_chunk_types * arity or tag < 0:
+                ty, kind = None, "O"
+            else:
+                ty = tag // arity
+                k = tag % arity
+                if arity == 1:
+                    kind = "S"
+                elif arity == 4:
+                    kind = "BIES"[k]
+                elif chunk_scheme == "IOE":
+                    kind = "I" if k == 0 else "E"
+                else:  # IOB
+                    kind = "B" if k == 0 else "I"
+            if kind == "O" or ty is None:
+                if start is not None:
+                    out.append((start, t - 1, ctype)); start = None
+                continue
+            if chunk_scheme == "plain":
+                if start is not None and ctype != ty:
+                    out.append((start, t - 1, ctype)); start = t
+                elif start is None:
+                    start = t
+                ctype = ty
+            elif chunk_scheme == "IOB":
+                if kind == "B" or (start is not None and ctype != ty) \
+                        or start is None:
+                    if start is not None:
+                        out.append((start, t - 1, ctype))
+                    start = t
+                ctype = ty
+            elif chunk_scheme == "IOE":
+                if start is None or ctype != ty:
+                    if start is not None:
+                        out.append((start, t - 1, ctype))
+                    start = t
+                ctype = ty
+                if kind == "E":
+                    out.append((start, t, ty)); start = None
+            else:  # IOBES
+                if kind in ("B", "S") or start is None or ctype != ty:
+                    if start is not None:
+                        out.append((start, t - 1, ctype))
+                    start = t
+                ctype = ty
+                if kind in ("E", "S"):
+                    out.append((start, t, ty)); start = None
+        if start is not None:
+            out.append((start, int(L) - 1, ctype))
+        return set(out)
+
+    n_inf = n_lab = n_cor = 0
+    for b in range(inf.shape[0]):
+        ci = chunks(inf[b], lens[b])
+        cl = chunks(lab[b], lens[b])
+        n_inf += len(ci); n_lab += len(cl); n_cor += len(ci & cl)
+    prec = n_cor / n_inf if n_inf else 0.0
+    rec = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    f = jnp.float32
+    return (f(prec), f(rec), f(f1), jnp.int32(n_inf), jnp.int32(n_lab),
+            jnp.int32(n_cor))
+
+
+@def_op("positive_negative_pair", n_tensor_args=3, differentiable=False)
+def positive_negative_pair(score, label, query_id):
+    """Ranking pair statistics per query (ref operators/
+    positive_negative_pair_op.cc): over same-query item pairs with
+    different labels, count concordant / discordant / tied score pairs.
+    Returns (positive, negative, neutral) float scalars."""
+    s = score.reshape(-1)
+    l = label.reshape(-1)
+    q = query_id.reshape(-1)
+    same_q = q[:, None] == q[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), k=1) > 0
+    valid = same_q & upper & (l[:, None] != l[None, :])
+    hi_label = l[:, None] > l[None, :]
+    s_diff = s[:, None] - s[None, :]
+    concord = jnp.where(hi_label, s_diff > 0, s_diff < 0)
+    tied = s_diff == 0
+    pos = jnp.sum(jnp.where(valid & ~tied & concord, 1.0, 0.0))
+    neg = jnp.sum(jnp.where(valid & ~tied & ~concord, 1.0, 0.0))
+    neu = jnp.sum(jnp.where(valid & tied, 1.0, 0.0))
+    return pos, neg, neu
+
+
+# ------------------------------------------------------------ misc tensor
+
+@def_op("partial_sum", n_tensor_args=None)
+def _partial_sum_impl(*inputs, start_index=0, length=-1):
+    """ref operators/partial_sum_op.cc: slice [:, start:start+length] of
+    each input and sum."""
+    L = inputs[0].shape[1] - start_index if length == -1 else length
+    acc = None
+    for t in inputs:
+        sl = t[:, start_index:start_index + L]
+        acc = sl if acc is None else acc + sl
+    return acc
+
+
+@def_op("partial_concat", n_tensor_args=None)
+def _partial_concat_impl(*inputs, start_index=0, length=-1):
+    """ref operators/partial_concat_op.cc."""
+    L = inputs[0].shape[1] - start_index if length == -1 else length
+    return jnp.concatenate([t[:, start_index:start_index + L]
+                            for t in inputs], axis=1)
+
+
+@def_op("batch_fc", n_tensor_args=3)
+def batch_fc(x, w, bias):
+    """Per-slot fully-connected (ref operators/batch_fc_op.cc):
+    x [S, B, I] @ w [S, I, O] + bias [S, 1, O]."""
+    return jnp.einsum("sbi,sio->sbo", x, w) + bias
+
+
+@def_op("spectral_norm_op", n_tensor_args=3)
+def spectral_norm_op(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    """Spectral weight normalisation as the reference op computes it
+    (ref operators/spectral_norm_op.h): fold `dim` to the front, run
+    power_iters u/v updates without gradient, divide by sigma."""
+    perm = (dim,) + tuple(i for i in range(weight.ndim) if i != dim)
+    wm = jnp.transpose(weight, perm).reshape(weight.shape[dim], -1)
+    uu, vv = u.reshape(-1), v.reshape(-1)
+    for _ in range(max(power_iters, 0)):
+        vv = wm.T @ uu
+        vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+        uu = wm @ vv
+        uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+    uu = jax.lax.stop_gradient(uu)
+    vv = jax.lax.stop_gradient(vv)
+    sigma = uu @ wm @ vv
+    out = wm / jnp.maximum(sigma, eps)
+    inv = tuple(np.argsort(perm))
+    return jnp.transpose(out.reshape(
+        tuple(weight.shape[d] for d in perm)), inv)

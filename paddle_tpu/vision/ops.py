@@ -754,3 +754,152 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                   "downsample_ratio": int(downsample_ratio),
                   "use_label_smooth": bool(use_label_smooth)},
                  name="yolov3_loss")
+
+
+# ------------------------------------------------------- precise roi pool
+
+def _prroi_pool_raw(x, boxes, output_size=(1, 1), spatial_scale=1.0):
+    """Precise ROI pooling (ref operators/prroi_pool_op.cc, PrRoIPool):
+    each output bin is the exact integral of the bilinearly-interpolated
+    feature surface over the bin, divided by bin area. The 1-D antiderivative
+    of the triangle kernel gives a closed form per pixel, so the whole op is
+    one [pixels x bins] weighted sum — fully differentiable w.r.t. both
+    features AND box coordinates (the op's reason to exist).
+    x: [1, C, H, W], boxes: [R, 4] -> [R, C, ph, pw]."""
+    import jax
+    import jax.numpy as jnp
+    ph, pw = output_size
+    img = x[0]
+    c, h, w = img.shape
+
+    def tri_int(t, p):
+        """∫_{-inf}^{t} max(0, 1-|s-p|) ds, elementwise."""
+        u = t - p
+        left = 0.5 * jnp.square(jnp.clip(u + 1.0, 0.0, 1.0))
+        right = 0.5 - 0.5 * jnp.square(jnp.clip(1.0 - u, 0.0, 1.0)) + 0.5
+        return jnp.where(u <= 0, left, right)
+
+    def seg_weight(a, b, p):
+        """∫_a^b triangle(s - p) ds for every pixel coordinate p."""
+        return tri_int(b, p) - tri_int(a, p)
+
+    px = jnp.arange(w, dtype=jnp.float32)
+    py = jnp.arange(h, dtype=jnp.float32)
+
+    def one_roi(box):
+        x1 = box[0] * spatial_scale
+        y1 = box[1] * spatial_scale
+        x2 = box[2] * spatial_scale
+        y2 = box[3] * spatial_scale
+        bw = jnp.maximum(x2 - x1, 1e-6) / pw
+        bh = jnp.maximum(y2 - y1, 1e-6) / ph
+
+        def one_bin(i, j):
+            ax, bx_ = x1 + j * bw, x1 + (j + 1) * bw
+            ay, by_ = y1 + i * bh, y1 + (i + 1) * bh
+            wx = seg_weight(ax, bx_, px)            # [W]
+            wy = seg_weight(ay, by_, py)            # [H]
+            area = jnp.maximum((bx_ - ax) * (by_ - ay), 1e-6)
+            return jnp.einsum("chw,h,w->c", img, wy, wx) / area
+
+        ii, jj = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw), indexing="ij")
+        bins = jax.vmap(jax.vmap(one_bin))(ii, jj)  # [ph, pw, C]
+        return bins.transpose(2, 0, 1)
+
+    return jax.vmap(one_roi)(boxes)
+
+
+register_op("prroi_pool", _prroi_pool_raw)
+
+
+def prroi_pool(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+               name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    from ..ops.dispatch import as_array as _aa
+    if boxes_num is not None or _aa(x).shape[0] != 1:
+        raise NotImplementedError("prroi_pool: pass one image per call")
+    return apply(_prroi_pool_raw, (x, boxes),
+                 {"output_size": tuple(output_size),
+                  "spatial_scale": float(spatial_scale)}, name="prroi_pool")
+
+
+# ----------------------------------------------------------- correlation
+
+def _correlation_raw(x1, x2, max_displacement=1, stride2=1, pad_size=None):
+    """Optical-flow correlation layer (ref operators/correlation_op.cc,
+    FlowNet; kernel_size=1, stride1=1 — the shapes FlowNetC uses):
+    out[b, k, i, j] = mean_c x1[b, c, i, j] * x2[b, c, i+dy, j+dx] over the
+    displacement window dy,dx in [-d, d] step stride2; k indexes (dy, dx)
+    row-major. Static unrolled shifts — XLA fuses them into one kernel."""
+    import jax.numpy as jnp
+    d = max_displacement
+    if pad_size is None:
+        pad_size = d
+    b, c, h, w = x1.shape
+    x2p = jnp.pad(x2, ((0, 0), (0, 0), (pad_size, pad_size),
+                       (pad_size, pad_size)))
+    outs = []
+    for dy in range(-d, d + 1, stride2):
+        for dx in range(-d, d + 1, stride2):
+            sh = x2p[:, :, pad_size + dy:pad_size + dy + h,
+                     pad_size + dx:pad_size + dx + w]
+            outs.append(jnp.mean(x1 * sh, axis=1))
+    return jnp.stack(outs, axis=1)
+
+
+register_op("correlation", _correlation_raw)
+
+
+def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1, name=None):
+    if kernel_size != 1 or stride1 != 1:
+        raise NotImplementedError(
+            "correlation: kernel_size=1, stride1=1 supported (FlowNetC "
+            "shapes); ref correlation_op.cc general case")
+    if pad_size < max_displacement:
+        raise ValueError(
+            f"correlation: pad_size ({pad_size}) must be >= "
+            f"max_displacement ({max_displacement}) or the displacement "
+            f"window reads out of bounds")
+    return apply(_correlation_raw, (x1, x2),
+                 {"max_displacement": int(max_displacement),
+                  "stride2": int(stride2), "pad_size": int(pad_size)},
+                 name="correlation")
+
+
+def _max_pool3d_with_index_raw(x, kernel_size=(2, 2, 2), stride=None,
+                               padding=(0, 0, 0)):
+    """ref operators/max_pool3d_with_index (NCDHW; flat D*H*W indices)."""
+    import jax
+    import jax.numpy as jnp
+    kd, kh, kw = kernel_size
+    sd, sh, sw = (kd, kh, kw) if stride is None else stride
+    pd, ph, pw = padding
+    b, c, D, h, w = x.shape
+    xf = x.reshape(b * c, 1, D, h, w)
+    patches = jax.lax.conv_general_dilated_patches(
+        xf, filter_shape=(kd, kh, kw), window_strides=(sd, sh, sw),
+        padding=((pd, pd), (ph, ph), (pw, pw)))   # [BC, kd*kh*kw, OD, OH, OW]
+    od, oh, ow = patches.shape[-3:]
+    dd = jnp.arange(kd * kh * kw)
+    zz = (jnp.arange(od)[None, :, None, None] * sd - pd
+          + (dd // (kh * kw))[:, None, None, None])
+    yy = (jnp.arange(oh)[None, None, :, None] * sh - ph
+          + ((dd // kw) % kh)[:, None, None, None])
+    xx = (jnp.arange(ow)[None, None, None, :] * sw - pw
+          + (dd % kw)[:, None, None, None])
+    valid = ((zz >= 0) & (zz < D) & (yy >= 0) & (yy < h)
+             & (xx >= 0) & (xx < w))
+    flat = ((zz * h + yy) * w + xx).astype(jnp.int32)
+    neg = jnp.finfo(x.dtype).min
+    vals = jnp.where(valid[None], patches, neg)
+    arg = jnp.argmax(vals, axis=1)
+    out = jnp.max(vals, axis=1)
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(flat[None], (b * c,) + flat.shape),
+        arg[:, None], axis=1)[:, 0]
+    return (out.reshape(b, c, od, oh, ow), idx.reshape(b, c, od, oh, ow))
+
+
+register_op("max_pool3d_with_index", _max_pool3d_with_index_raw)

@@ -1,0 +1,63 @@
+"""interpolate parity battery vs torch.nn.functional.interpolate — covering
+the reference's interp op family (ref operators/interpolate_op.cc +
+interpolate_v2_op.cc: linear/bilinear/trilinear/nearest/bicubic, the
+align_corners branch, up- and down-sampling). Torch implements the same
+coordinate rules as the reference kernels, so it serves as the numeric
+oracle here (torch-cpu is test-only, never a runtime dependency)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+CASES = [
+    ("linear", (2, 3, 8), "NCW"),
+    ("bilinear", (2, 3, 6, 8), "NCHW"),
+    ("trilinear", (1, 2, 4, 6, 8), "NCDHW"),
+    ("nearest", (2, 3, 6, 8), "NCHW"),
+    ("bicubic", (2, 3, 6, 8), "NCHW"),
+]
+
+
+@pytest.mark.parametrize("mode,shape,fmt", CASES,
+                         ids=[c[0] for c in CASES])
+@pytest.mark.parametrize("align", [False, True], ids=["half", "align"])
+def test_interp_parity(mode, shape, fmt, align):
+    if mode == "nearest" and align:
+        pytest.skip("torch nearest has no align_corners variant")
+    x = np.random.RandomState(0).randn(*shape).astype("f4")
+    t_ac = None if mode == "nearest" else align
+    # upsample x2
+    got = F.interpolate(pt.to_tensor(x), scale_factor=2, mode=mode,
+                        align_corners=align, data_format=fmt)
+    want = TF.interpolate(torch.tensor(x), scale_factor=2, mode=mode,
+                          align_corners=t_ac)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    # odd-factor downsample
+    size = [max(s // 2 + 1, 1) for s in shape[2:]]
+    got = F.interpolate(pt.to_tensor(x), size=size, mode=mode,
+                        align_corners=align, data_format=fmt)
+    want = TF.interpolate(torch.tensor(x), size=tuple(size), mode=mode,
+                          align_corners=t_ac)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_size1_align_corners_picks_first_pixel():
+    x = np.arange(4, dtype="f4").reshape(1, 1, 4)
+    out = F.interpolate(pt.to_tensor(x), size=[1], mode="linear",
+                        align_corners=True, data_format="NCW")
+    assert float(np.asarray(out.numpy()).ravel()[0]) == 0.0
+
+
+def test_nearest_reference_index_rule():
+    # ref NearestNeighborInterpolate: idx = floor(i * in / out)
+    x = np.arange(3, dtype="f4").reshape(1, 1, 3)
+    out = F.interpolate(pt.to_tensor(x), size=[5], mode="nearest",
+                        data_format="NCW")
+    np.testing.assert_array_equal(np.asarray(out.numpy()).ravel(),
+                                  [0, 0, 1, 1, 2])

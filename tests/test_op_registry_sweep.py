@@ -721,6 +721,42 @@ SPECS = {
                        np.array([[[[0, 3], [8, 11]], [[1, 2], [9, 10]]]],
                                 "i4")],
                       {"output_hw": (4, 4)}),
+    # --- search / decode / metric ops ---
+    "crf_decoding": S([F32((2, 4, 3), 1), F32((5, 3), 2),
+                       np.array([4, 2], "i4")], grad=False),
+    "beam_search": S([np.array([[3, 1]], "i8"), F32((1, 2), 1),
+                      POS((1, 2, 5), 2)],
+                     {"beam_size": 2, "end_id": 0}, grad=False, out0=True),
+    "sample_logits": S([F32((2, 6), 1), np.array([[2], [4]], "i4"),
+                        np.array([1, 5], "i4")], grad=False),
+    "auc": S([POS((4, 1)), np.array([0, 1, 0, 1], "i4"),
+              np.zeros(4096, "f4"), np.zeros(4096, "f4")],
+             grad=False, out0=True),
+    "chunk_eval": S([np.array([[0, 1, 4, 2]], "i4"),
+                     np.array([[0, 1, 4, 2]], "i4"), np.array([4], "i4")],
+                    {"num_chunk_types": 2}, grad=False, out0=True,
+                    desc=False),   # host-numpy metric op
+    "positive_negative_pair": S([F32((4, 1), 1), np.array([1, 0, 0, 1], "i4"),
+                                 np.array([0, 0, 0, 0], "i4")],
+                                grad=False, out0=True),
+    "partial_sum": S([F32((2, 6), 1), F32((2, 6), 2)],
+                     {"start_index": 1, "length": 3}),
+    "partial_concat": S([F32((2, 6), 1), F32((2, 6), 2)],
+                        {"start_index": 1, "length": 3}),
+    "batch_fc": S([F32((3, 2, 4), 1), F32((3, 4, 5), 2),
+                   F32((3, 1, 5), 3)]),
+    # grad=False: u/v power iterations are stop_gradient by design (ref
+    # treats them as buffers), so FD — which re-iterates — disagrees with
+    # the intended analytic grad
+    "spectral_norm_op": S([F32((4, 6), 1), F32((4,), 2), F32((6,), 3)],
+                          {"power_iters": 2}, grad=False),
+    "prroi_pool": S([F32((1, 2, 6, 6)),
+                     np.array([[1.2, 1.3, 4.7, 4.1]], "f4")],
+                    {"output_size": (2, 2), "spatial_scale": 1.0}),
+    "correlation": S([F32((1, 3, 5, 5), 1), F32((1, 3, 5, 5), 2)],
+                     {"max_displacement": 1}),
+    "max_pool3d_with_index": S([F32((1, 2, 4, 4, 4))],
+                               {"kernel_size": (2, 2, 2)}, out0=True),
     # --- fluid-era rnn cell ops (nn/rnn.py) ---
     "gru_unit": S([F32((2, 12), 1), F32((2, 4), 2), F32((4, 12), 3),
                    F32((1, 12), 4)], out0=True),
