@@ -657,3 +657,252 @@ def spectral_norm_op(weight, u, v, dim=0, power_iters=1, eps=1e-12):
     inv = tuple(np.argsort(perm))
     return jnp.transpose(out.reshape(
         tuple(weight.shape[d] for d in perm)), inv)
+
+
+# ----------------------------------------------- selected-rows / creation
+
+def _merge_selected_rows_impl(sr):
+    """ref operators/merge_selected_rows_op.cc: deduplicate a SelectedRows'
+    rows, summing duplicate slices (MergeAdd)."""
+    return sr.merge()
+
+
+def _get_tensor_from_selected_rows_impl(sr):
+    """ref operators/get_tensor_from_selected_rows_op.cc: densify."""
+    return sr.to_dense()
+
+
+def merge_selected_rows(x, name=None):
+    from ..framework.selected_rows import SelectedRows
+    if not isinstance(x, SelectedRows):
+        raise TypeError("merge_selected_rows expects a SelectedRows")
+    return _merge_selected_rows_impl(x)
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    from ..framework.selected_rows import SelectedRows
+    if not isinstance(x, SelectedRows):
+        raise TypeError("get_tensor_from_selected_rows expects SelectedRows")
+    return Tensor(_get_tensor_from_selected_rows_impl(x))
+
+
+@def_op("fill_zeros_like")
+def fill_zeros_like(x):
+    """ref operators/fill_zeros_like_op.cc (the backward-init op)."""
+    return jnp.zeros_like(x)
+
+
+@def_op("lod_reset", n_tensor_args=2, differentiable=False)
+def lod_reset(x, target_lengths):
+    """ref operators/lod_reset_op.cc: in the dense+lengths world, re-segment
+    means adopting new lengths for the same data — returns (x, lengths)
+    so downstream sequence ops mask by the new segmentation."""
+    return x, target_lengths
+
+
+def _gaussian_random_raw(key, shape=(1,), mean=0.0, std=1.0):
+    """ref operators/gaussian_random_op.cc as an rng-key op (the seed attr
+    becomes the desc's __rng__ salt, so static programs replay with fresh
+    randomness per run — initializer ops serialize)."""
+    return mean + std * jax.random.normal(key, tuple(shape))
+
+
+def _uniform_random_raw(key, shape=(1,), min=-1.0, max=1.0):
+    """ref operators/uniform_random_op.cc."""
+    return jax.random.uniform(key, tuple(shape), minval=min, maxval=max)
+
+
+def _truncated_gaussian_random_raw(key, shape=(1,), mean=0.0, std=1.0):
+    """ref operators/truncated_gaussian_random_op.cc: normal truncated to
+    two standard deviations."""
+    return mean + std * jax.random.truncated_normal(key, -2.0, 2.0,
+                                                    tuple(shape))
+
+
+register_op("gaussian_random", _gaussian_random_raw)
+register_op("uniform_random", _uniform_random_raw)
+register_op("truncated_gaussian_random", _truncated_gaussian_random_raw)
+
+
+def _rng_creation(raw, name, shape, kwargs):
+    from ..framework import state
+    key = state.next_rng_key()
+    return apply(raw, (key,), dict(kwargs, shape=[int(s) for s in shape],
+                                   __rng__=True), name=name)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, name=None):
+    return _rng_creation(_gaussian_random_raw, "gaussian_random", shape,
+                         {"mean": float(mean), "std": float(std)})
+
+
+def uniform_random(shape, min=-1.0, max=1.0, name=None):
+    return _rng_creation(_uniform_random_raw, "uniform_random", shape,
+                         {"min": float(min), "max": float(max)})
+
+
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, name=None):
+    return _rng_creation(_truncated_gaussian_random_raw,
+                         "truncated_gaussian_random", shape,
+                         {"mean": float(mean), "std": float(std)})
+
+
+@def_op("inplace_abn", n_tensor_args=5)
+def inplace_abn(x, mean, var, scale, bias, epsilon=1e-5,
+                activation="identity", alpha=0.01):
+    """Activated batch norm (ref operators/inplace_abn_op.cc): BN inference
+    transform + fused activation. The reference's in-place memory reuse is
+    an allocator trick XLA owns; the op semantics (identity/elu/leaky_relu
+    activation on normalized output) are preserved."""
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    y = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+    y = y * scale.reshape(shape) + bias.reshape(shape)
+    if activation == "leaky_relu":
+        return jnp.where(y >= 0, y, alpha * y)
+    if activation == "elu":
+        return jnp.where(y >= 0, y, alpha * (jnp.exp(y) - 1.0))
+    return y
+
+
+@def_op("hash_op", n_tensor_args=1, differentiable=False)
+def hash_op(x, num_hash=1, mod_by=100000):
+    """Feature hashing (ref operators/hash_op.cc contract: ids [B, 1] ->
+    [B, num_hash, 1] bucket ids, `num_hash` independent hashes mod
+    `mod_by`). The reference uses XXH64; here a splitmix64-style integer
+    mix in uint32 pairs — a DIFFERENT hash function with the same
+    determinism/distribution contract (documented divergence: bucket ids
+    differ from the reference for the same input)."""
+    v = x.reshape(x.shape[0], -1).astype(jnp.uint32)
+
+    def mix(h):
+        for shift, mult in ((15, 0x85EBCA6B), (13, 0xC2B2AE35)):
+            h = h ^ (h >> shift)
+            h = (h * jnp.uint32(mult)) & jnp.uint32(0xFFFFFFFF)
+        return h ^ (h >> 16)
+
+    outs = []
+    for k in range(num_hash):
+        h = jnp.full((v.shape[0],), (0x9E3779B9 * (k + 1)) & 0xFFFFFFFF,
+                     jnp.uint32)
+        for j in range(v.shape[1]):     # fold every column of the row in
+            h = mix(h ^ v[:, j])
+        outs.append(h % jnp.uint32(mod_by))
+    return jnp.stack(outs, axis=1).astype(jnp.int32)[:, :, None]
+
+
+# ----------------------------------------------- ASR / seg / misc metrics
+
+@def_op("edit_distance", n_tensor_args=4, differentiable=False)
+def edit_distance(hyp, ref, hyp_lens, ref_lens, normalized=True):
+    """Levenshtein distance over padded id batches (ref operators/
+    edit_distance_op.cc). hyp: [B, T1] int, ref: [B, T2] int + lengths.
+    One lax.scan over hypothesis positions with a [B, T2+1] DP row carry —
+    batch-vectorised, so it shards along B. Returns [B, 1] distances
+    (normalized by ref length when `normalized`)."""
+    B, T1 = hyp.shape
+    T2 = ref.shape[1]
+    j = jnp.arange(T2 + 1)
+    row0 = jnp.broadcast_to(j[None, :], (B, T2 + 1)).astype(jnp.float32)
+
+    def step(row, t):
+        sub = row[:, :-1] + (hyp[:, t][:, None]
+                             != ref).astype(jnp.float32)      # [B, T2]
+        dele = row[:, 1:] + 1.0
+        first = row[:, :1] + 1.0                              # new row[0]
+
+        def scan_min(carry, cols):
+            s, d = cols
+            v = jnp.minimum(jnp.minimum(s, d), carry + 1.0)
+            return v, v
+
+        _, rest = jax.lax.scan(scan_min, first[:, 0],
+                               (sub.T, dele.T))               # [T2, B]
+        new = jnp.concatenate([first, rest.T], axis=1)
+        live = (t < hyp_lens)[:, None]
+        return jnp.where(live, new, row), None
+
+    rowT, _ = jax.lax.scan(step, row0, jnp.arange(T1))
+    dist = jnp.take_along_axis(rowT, ref_lens[:, None], axis=1)
+    if normalized:
+        dist = dist / jnp.maximum(ref_lens[:, None], 1).astype(jnp.float32)
+    return dist
+
+
+@def_op("ctc_align", n_tensor_args=2, differentiable=False)
+def ctc_align(x, lengths, blank=0, merge_repeated=True):
+    """CTC greedy-decode alignment (ref operators/ctc_align_op.cc): merge
+    repeats, drop blanks. Host-side per row (output lengths are data
+    dependent); padded with 0 + new lengths returned."""
+    import numpy as _np
+    a = _np.asarray(x)
+    ls = _np.asarray(lengths)
+    B, T = a.shape
+    out = _np.zeros_like(a)
+    olens = _np.zeros((B,), _np.int32)
+    for b in range(B):
+        prev, k = None, 0
+        for t in range(int(ls[b])):
+            v = int(a[b, t])
+            if merge_repeated and prev is not None and v == prev:
+                continue
+            prev = v
+            if v != blank:
+                out[b, k] = v
+                k += 1
+        olens[b] = k
+    return jnp.asarray(out), jnp.asarray(olens)
+
+
+@def_op("mean_iou", n_tensor_args=2, differentiable=False)
+def mean_iou(pred, label, num_classes=2):
+    """Segmentation mean-IoU (ref operators/mean_iou_op.cc): confusion
+    accumulation + per-class intersection/union. Returns (mean_iou,
+    out_wrong [C], out_correct [C])."""
+    p = pred.reshape(-1).astype(jnp.int32)
+    l = label.reshape(-1).astype(jnp.int32)
+    correct = jnp.zeros((num_classes,), jnp.int32).at[l].add(
+        (p == l).astype(jnp.int32))
+    pred_cnt = jnp.zeros((num_classes,), jnp.int32).at[p].add(1)
+    lab_cnt = jnp.zeros((num_classes,), jnp.int32).at[l].add(1)
+    union = pred_cnt + lab_cnt - correct
+    present = union > 0
+    iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    wrong = lab_cnt - correct
+    return miou.astype(jnp.float32), wrong, correct
+
+
+@def_op("spp")
+def spp(x, pyramid_height=2, pool_type="max"):
+    """Spatial pyramid pooling (ref operators/spp_op.cc): adaptive pools at
+    1x1, 2x2, ... 2^(h-1) bins, flattened and concatenated -> [B, C*sum]."""
+    from ..nn.functional import _adaptive_max_pool2d_raw, \
+        _adaptive_avg_pool2d_raw
+    B, C = x.shape[:2]
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2 ** level
+        raw = _adaptive_max_pool2d_raw if pool_type == "max" \
+            else _adaptive_avg_pool2d_raw
+        pooled = raw(x, output_size=(bins, bins))
+        outs.append(pooled.reshape(B, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+@def_op("add_position_encoding")
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """Sinusoidal position encoding mix (ref operators/
+    add_position_encoding_op.h): out = alpha*x + beta*PE where, per the
+    reference kernel, PE[pos, i] = sin(pos / 10000^(i/(half-1))) for the
+    first half of channels and the matching cos for the second half.
+    x: [B, T, D]."""
+    B, T, D = x.shape
+    half = D // 2
+    i = jnp.arange(half, dtype=jnp.float32)
+    denom = jnp.power(10000.0, i / jnp.maximum(half - 1, 1))
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    ang = pos / denom[None, :]                                # [T, half]
+    pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)
+    if pe.shape[1] < D:                                       # odd D
+        pe = jnp.pad(pe, ((0, 0), (0, D - pe.shape[1])))
+    return alpha * x + beta * pe[None, :, :].astype(x.dtype)
