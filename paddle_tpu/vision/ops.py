@@ -903,3 +903,274 @@ def _max_pool3d_with_index_raw(x, kernel_size=(2, 2, 2), stride=None,
 
 
 register_op("max_pool3d_with_index", _max_pool3d_with_index_raw)
+
+
+# ------------------------------------------------ detection assembly tail
+
+def _box_clip_raw(boxes, im_shape):
+    """ref operators/detection/box_clip_op.cc: clamp corner boxes into
+    [0, H-1] x [0, W-1]. boxes: [..., 4], im_shape: [2] (H, W)."""
+    import jax.numpy as jnp
+    h, w = im_shape[0], im_shape[1]
+    x1 = jnp.clip(boxes[..., 0], 0, w - 1)
+    y1 = jnp.clip(boxes[..., 1], 0, h - 1)
+    x2 = jnp.clip(boxes[..., 2], 0, w - 1)
+    y2 = jnp.clip(boxes[..., 3], 0, h - 1)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+register_op("box_clip", _box_clip_raw)
+
+
+def box_clip(input, im_shape, name=None):
+    return apply(_box_clip_raw, (input, im_shape), name="box_clip")
+
+
+def _bipartite_match_raw(dist_mat, match_type="bipartite",
+                         overlap_threshold=0.5):
+    """Greedy bipartite matching (ref operators/detection/
+    bipartite_match_op.cc): repeatedly take the globally largest entry,
+    pairing its row (gt) to its column (prior); with
+    match_type='per_prediction', unmatched columns whose best row overlap
+    exceeds the threshold also match. Host numpy (sequential argmax over a
+    small [N, M] matrix). Returns (col_to_row [M] int32, col_dist [M])."""
+    import numpy as _np
+    d = _np.asarray(dist_mat).copy()
+    n, m = d.shape
+    match = _np.full((m,), -1, _np.int32)
+    mdist = _np.zeros((m,), _np.float32)
+    live = d.copy()
+    for _ in range(min(n, m)):
+        idx = _np.unravel_index(_np.argmax(live), live.shape)
+        if live[idx] <= 0:
+            break
+        r, c = idx
+        match[c] = r
+        mdist[c] = d[r, c]
+        live[r, :] = -1.0
+        live[:, c] = -1.0
+    if match_type == "per_prediction":
+        for c in range(m):
+            if match[c] == -1:
+                r = int(_np.argmax(d[:, c]))
+                if d[r, c] >= overlap_threshold:
+                    match[c] = r
+                    mdist[c] = d[r, c]
+    return jnp.asarray(match), jnp.asarray(mdist)
+
+
+register_op("bipartite_match", _bipartite_match_raw)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    return apply(_bipartite_match_raw, (dist_matrix,),
+                 {"match_type": match_type,
+                  "overlap_threshold": float(dist_threshold)},
+                 differentiable=False, name="bipartite_match")
+
+
+def _target_assign_raw(x, match_indices, fill_value=0.0):
+    """ref operators/detection/target_assign_op.cc: out[i, j] =
+    x[match[i, j]] rows gathered per batch, negatives filled.
+    x: [N, K, D] (entity table per image), match_indices: [N, M]."""
+    import jax.numpy as jnp
+    idx = jnp.maximum(match_indices, 0)
+    bi = jnp.arange(x.shape[0])[:, None]
+    out = x[bi, idx]                                          # [N, M, D]
+    neg = (match_indices < 0)[:, :, None]
+    out = jnp.where(neg, jnp.asarray(fill_value, x.dtype), out)
+    wt = jnp.where(match_indices < 0, 0.0, 1.0)[:, :, None]
+    return out, wt
+
+
+register_op("target_assign", _target_assign_raw)
+
+
+def target_assign(input, matched_indices, mismatch_value=0.0, name=None):
+    return apply(_target_assign_raw, (input, matched_indices),
+                 {"fill_value": float(mismatch_value)},
+                 differentiable=False, name="target_assign")
+
+
+def _greedy_nms_host(boxes, order, thresh, shift=0.0, max_keep=None):
+    """Vectorized host greedy NMS: one precomputed IoU matrix + O(len)
+    rounds of boolean suppression (no nested python IoU loops)."""
+    import numpy as _np
+    if order.size == 0:
+        return []
+    b = boxes[order]
+    area = (b[:, 2] - b[:, 0] + shift) * (b[:, 3] - b[:, 1] + shift)
+    x1 = _np.maximum(b[:, None, 0], b[None, :, 0])
+    y1 = _np.maximum(b[:, None, 1], b[None, :, 1])
+    x2 = _np.minimum(b[:, None, 2], b[None, :, 2])
+    y2 = _np.minimum(b[:, None, 3], b[None, :, 3])
+    inter = _np.maximum(x2 - x1 + shift, 0) * _np.maximum(y2 - y1 + shift, 0)
+    iou = inter / _np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+    live = _np.ones(order.size, bool)
+    kept = []
+    for i in range(order.size):
+        if not live[i]:
+            continue
+        kept.append(order[i])
+        if max_keep is not None and len(kept) >= max_keep:
+            break
+        live &= iou[i] <= thresh
+        live[i] = False
+    return kept
+
+
+def _multiclass_nms_raw(bboxes, scores, score_threshold=0.05, nms_top_k=64,
+                        keep_top_k=16, nms_threshold=0.3, background_label=0,
+                        normalized=True):
+    """Per-class NMS + cross-class top-k (ref operators/detection/
+    multiclass_nms_op.cc). bboxes: [M, 4], scores: [C, M]. The reference
+    emits a LoD list; the dense form is a fixed [keep_top_k, 6] tensor of
+    (label, score, x1, y1, x2, y2) rows padded with label=-1, plus the
+    valid count — the standard XLA detection-head contract."""
+    import numpy as _np
+    bx = _np.asarray(bboxes)
+    sc = _np.asarray(scores)
+    C, M = sc.shape
+    shift = 0.0 if normalized else 1.0
+    cand = []
+    for c in range(C):
+        if c == background_label:
+            continue
+        keep = _np.where(sc[c] > score_threshold)[0]
+        if keep.size == 0:
+            continue
+        order = keep[_np.argsort(-sc[c][keep])][:nms_top_k]
+        for k in _greedy_nms_host(bx, order, nms_threshold, shift):
+            cand.append((c, float(sc[c][k]), bx[k]))
+    cand.sort(key=lambda t: -t[1])
+    cand = cand[:keep_top_k]
+    out = _np.full((keep_top_k, 6), -1.0, _np.float32)
+    for i, (c, s, b) in enumerate(cand):
+        out[i] = [c, s, b[0], b[1], b[2], b[3]]
+    return jnp.asarray(out), jnp.int32(len(cand))
+
+
+register_op("multiclass_nms", _multiclass_nms_raw)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=64,
+                   keep_top_k=16, nms_threshold=0.3, normalized=True,
+                   background_label=0, name=None):
+    return apply(_multiclass_nms_raw, (bboxes, scores),
+                 {"score_threshold": float(score_threshold),
+                  "nms_top_k": int(nms_top_k),
+                  "keep_top_k": int(keep_top_k),
+                  "nms_threshold": float(nms_threshold),
+                  "background_label": int(background_label),
+                  "normalized": bool(normalized)},
+                 differentiable=False, name="multiclass_nms")
+
+
+def _generate_proposals_raw(scores, bbox_deltas, im_shape, anchors,
+                            variances, pre_nms_top_n=128, post_nms_top_n=32,
+                            nms_thresh=0.5, min_size=0.1):
+    """RPN proposal generation (ref operators/detection/
+    generate_proposals_op.cc): decode anchor deltas, clip to image, drop
+    tiny boxes, pre-NMS top-N by score, greedy NMS, post-NMS top-N.
+    Single image: scores [A], bbox_deltas [A, 4], anchors [A, 4],
+    variances [A, 4]. Dense output: ([post_nms_top_n, 4] padded rois,
+    count)."""
+    import numpy as _np
+    sc = _np.asarray(scores).reshape(-1)
+    dl = _np.asarray(bbox_deltas).reshape(-1, 4)
+    an = _np.asarray(anchors).reshape(-1, 4)
+    vr = _np.asarray(variances).reshape(-1, 4)
+    h, w = float(_np.asarray(im_shape)[0]), float(_np.asarray(im_shape)[1])
+    # decode (center-size, like box_coder decode)
+    aw = an[:, 2] - an[:, 0] + 1.0
+    ah = an[:, 3] - an[:, 1] + 1.0
+    ax = an[:, 0] + aw * 0.5
+    ay = an[:, 1] + ah * 0.5
+    cx = vr[:, 0] * dl[:, 0] * aw + ax
+    cy = vr[:, 1] * dl[:, 1] * ah + ay
+    bw = _np.exp(_np.minimum(vr[:, 2] * dl[:, 2], 10.0)) * aw
+    bh = _np.exp(_np.minimum(vr[:, 3] * dl[:, 3], 10.0)) * ah
+    boxes = _np.stack([cx - bw / 2, cy - bh / 2,
+                       cx + bw / 2 - 1, cy + bh / 2 - 1], axis=1)
+    boxes[:, 0::2] = _np.clip(boxes[:, 0::2], 0, w - 1)
+    boxes[:, 1::2] = _np.clip(boxes[:, 1::2], 0, h - 1)
+    ws = boxes[:, 2] - boxes[:, 0] + 1
+    hs = boxes[:, 3] - boxes[:, 1] + 1
+    keep = _np.where((ws >= min_size) & (hs >= min_size))[0]
+    order = keep[_np.argsort(-sc[keep])][:pre_nms_top_n]
+    kept = _greedy_nms_host(boxes, order, nms_thresh, shift=1.0,
+                            max_keep=post_nms_top_n)
+    out = _np.zeros((post_nms_top_n, 4), _np.float32)
+    for i, k in enumerate(kept):
+        out[i] = boxes[k]
+    return jnp.asarray(out), jnp.int32(len(kept))
+
+
+register_op("generate_proposals", _generate_proposals_raw)
+
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, name=None):
+    return apply(_generate_proposals_raw,
+                 (scores, bbox_deltas, im_shape, anchors, variances),
+                 {"pre_nms_top_n": int(pre_nms_top_n),
+                  "post_nms_top_n": int(post_nms_top_n),
+                  "nms_thresh": float(nms_thresh),
+                  "min_size": float(min_size)},
+                 differentiable=False, name="generate_proposals")
+
+
+def _distribute_fpn_proposals_raw(rois, min_level=2, max_level=5,
+                                  refer_level=4, refer_scale=224):
+    """ref operators/detection/distribute_fpn_proposals_op.cc: assign each
+    roi to level floor(refer_level + log2(sqrt(area)/refer_scale)),
+    clamped. Dense output: (level_ids [N] int32, restore_index [N]) — the
+    per-level splits are boolean masks over level_ids, static-shape
+    friendly."""
+    import jax.numpy as jnp
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-10))
+    lvl = jnp.floor(refer_level + jnp.log2(scale / refer_scale + 1e-10))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    order = jnp.argsort(lvl, stable=True)
+    restore = jnp.argsort(order, stable=True).astype(jnp.int32)
+    return lvl, restore
+
+
+register_op("distribute_fpn_proposals", _distribute_fpn_proposals_raw)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    return apply(_distribute_fpn_proposals_raw, (fpn_rois,),
+                 {"min_level": int(min_level), "max_level": int(max_level),
+                  "refer_level": int(refer_level),
+                  "refer_scale": int(refer_scale)},
+                 differentiable=False, name="distribute_fpn_proposals")
+
+
+def _polygon_box_transform_raw(x):
+    """ref operators/detection/polygon_box_transform_op.cc (EAST OCR):
+    input [B, 8, H, W] of per-pixel quad offsets; output absolute quad
+    coordinates: out[:, 2k] = 4*j - x[:, 2k], out[:, 2k+1] = 4*i - x."""
+    import jax.numpy as jnp
+    b, c, h, w = x.shape
+    jj = jnp.arange(w)[None, None, None, :] * 4.0
+    ii = jnp.arange(h)[None, None, :, None] * 4.0
+    even = jj - x[:, 0::2]
+    odd = ii - x[:, 1::2]
+    out = jnp.zeros_like(x)
+    out = out.at[:, 0::2].set(even)
+    out = out.at[:, 1::2].set(odd)
+    return out
+
+
+register_op("polygon_box_transform", _polygon_box_transform_raw)
+
+
+def polygon_box_transform(input, name=None):
+    return apply(_polygon_box_transform_raw, (input,),
+                 differentiable=False, name="polygon_box_transform")

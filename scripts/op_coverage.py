@@ -79,7 +79,6 @@ ALIAS = {
     "fake_dequantize_max_abs": "fake_quantize_dequantize",
     "moving_average_abs_max_scale": "fake_quantize_dequantize",
     "iou_similarity": "box_iou", "yolov3_loss": "yolov3_loss",
-    "masked_select": "masked_fill",   # dynamic-shape variant: host edge fn
     "unique": "unique", "unique_with_counts": "unique",
     "isinf_v2": "isinf", "isnan_v2": "isnan", "isfinite_v2": "isfinite",
     "isfinite": "isfinite",
@@ -111,13 +110,14 @@ ALIAS = {
     "partial_allgather": "partial_concat",
     "pool2d": "max_pool2d", "pool3d": "max_pool3d",
     "hierarchical_sigmoid": "hsigmoid_loss",
-    "edit_distance": "edit_distance", "ctc_align": "ctc_align",
+    "edit_distance": "edit_distance",
     "mean_iou": "mean_iou", "spp": "spp",
     "add_position_encoding": "add_position_encoding",
-    "diag": "diag_embed", "diag_v2": "diag_embed",
-    "multiclass_nms": "nms", "multiclass_nms2": "nms",
-    "multiclass_nms3": "nms", "matrix_nms": "nms",
+    "multiclass_nms": "multiclass_nms",
+    "multiclass_nms2": "multiclass_nms",
+    "multiclass_nms3": "multiclass_nms", "matrix_nms": "nms",
     "locality_aware_nms": "nms",
+    "generate_proposals_v2": "generate_proposals",
 }
 
 # python API / subsystem coverage (not a registered desc op, by design)
@@ -185,6 +185,9 @@ PYTHON_API = {
         "under jit; amp/__init__.py)",
     "update_loss_scaling": "amp.GradScaler dynamic loss-scale state machine",
     "bernoulli": "paddle.bernoulli (creation.py, explicit rng keys)",
+    "masked_select": "ops/manipulation.masked_select (dynamic shape -> "
+        "host edge fn, like nonzero)",
+    "diag": "paddle.diag (creation.py)", "diag_v2": "paddle.diag",
     "empty": "paddle.empty (creation.py)", "eye": "paddle.eye",
     "diag": "paddle.diag", "diag_v2": "paddle.diag",
     "set_value": "Tensor.__setitem__ (.at[] scatter)",
@@ -228,22 +231,15 @@ OPTIMIZER_OPS = {
 
 # honest documented gaps: reference capabilities not yet implemented
 GAPS = {
-    "bipartite_match": "detection assembly tail",
-    "target_assign": "detection assembly tail",
     "rpn_target_assign": "detection assembly tail",
     "retinanet_target_assign": "detection assembly tail",
     "retinanet_detection_output": "detection assembly tail",
-    "generate_proposals": "detection assembly tail",
-    "generate_proposals_v2": "detection assembly tail",
     "generate_proposal_labels": "detection assembly tail",
     "generate_mask_labels": "detection assembly tail",
-    "distribute_fpn_proposals": "detection assembly tail",
     "collect_fpn_proposals": "detection assembly tail",
     "mine_hard_examples": "detection assembly tail",
     "detection_map": "detection assembly tail",
-    "box_clip": "detection assembly tail",
     "box_decoder_and_assign": "detection assembly tail",
-    "polygon_box_transform": "OCR tail",
     "roi_perspective_transform": "OCR tail",
     "deformable_psroi_pooling": "deform tail (deform_conv2d + psroi_pool "
         "cover the components)",
@@ -293,6 +289,12 @@ NA_RULES = [
 ]
 
 
+# ALIAS targets that are deliberately python functions, not registry names
+ALIAS_PY_FN = {"add_n", "arange", "full", "full_like", "numel", "unique",
+               "multinomial", "randint", "randperm", "seed", "linspace",
+               "heter_embedding_cache", "nonzero"}
+
+
 def classify(name, registry):
     # ALIAS wins over a same-name registry hit: the reference name can
     # collide with a semantically different op of ours (ref `sum` is
@@ -303,7 +305,11 @@ def classify(name, registry):
             return ("registered", name)
         if tgt in registry:
             return ("alias", tgt)
-        return ("python-api", f"python fn `{tgt}`")
+        if tgt in ALIAS_PY_FN:
+            return ("python-api", f"python fn `{tgt}`")
+        # a typo'd / deleted registry target must fail the gate, not
+        # silently downgrade to a coverage claim
+        return ("UNCLASSIFIED", f"alias target `{tgt}` not registered")
     if name in registry:
         return ("registered", name)
     if name in GAPS:
@@ -341,7 +347,8 @@ def main():
                         r"REGISTER_OP_WITHOUT_GRADIENT\s*\(\s*"
                         r"([a-zA-Z0-9_]+)", src):
                     names.add(m.group(1))
-        json.dump(sorted(names), open(census_path, "w"))
+        if "--out" not in sys.argv:
+            json.dump(sorted(names), open(census_path, "w"))
     else:
         names = set(json.load(open(census_path)))
 
@@ -368,8 +375,11 @@ def main():
             unclassified.append(n)
         rows.append((n, status, how))
 
-    out = os.path.join(os.path.dirname(__file__), "..", "docs",
-                       "OP_COVERAGE.md")
+    if "--out" in sys.argv:
+        out = sys.argv[sys.argv.index("--out") + 1]
+    else:
+        out = os.path.join(os.path.dirname(__file__), "..", "docs",
+                           "OP_COVERAGE.md")
     with open(out, "w") as f:
         f.write("# Reference operator-type coverage map\n\n")
         f.write("Generated by `scripts/op_coverage.py` from the reference's "

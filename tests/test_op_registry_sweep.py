@@ -784,6 +784,34 @@ SPECS = {
                      {"max_displacement": 1}),
     "max_pool3d_with_index": S([F32((1, 2, 4, 4, 4))],
                                {"kernel_size": (2, 2, 2)}, out0=True),
+    # --- detection assembly tail (vision/ops.py) ---
+    "box_clip": S([np.array([[-5.0, -5.0, 20.0, 20.0]], "f4"),
+                   np.array([10.0, 12.0], "f4")], grad=False),
+    "bipartite_match": S([np.array([[0.9, 0.1, 0.3],
+                                    [0.2, 0.8, 0.4]], "f4")],
+                         grad=False, out0=True, desc=False),  # host greedy
+    "target_assign": S([F32((2, 3, 4), 1), np.array([[0, -1], [2, 1]],
+                                                    "i4")],
+                       grad=False, out0=True),
+    "multiclass_nms": S([np.array([[0, 0, 10, 10], [50, 50, 60, 60]],
+                                  "f4"),
+                         np.array([[0.0, 0.0], [0.9, 0.7]], "f4")],
+                        {"keep_top_k": 4}, grad=False, out0=True,
+                        desc=False),                          # host nms
+    "generate_proposals": S([POS((6,)), F32((6, 4), 1, -0.1, 0.1),
+                             np.array([32.0, 32.0], "f4"),
+                             np.array([[0, 0, 15, 15]] * 6, "f4") +
+                             np.arange(6, dtype="f4")[:, None],
+                             np.ones((6, 4), "f4")],
+                            {"pre_nms_top_n": 6, "post_nms_top_n": 3,
+                             "min_size": 1.0},
+                            grad=False, out0=True, desc=False),
+    "distribute_fpn_proposals": S([np.array([[0, 0, 20, 20],
+                                             [0, 0, 220, 220]], "f4")],
+                                  {"min_level": 2, "max_level": 5,
+                                   "refer_level": 4, "refer_scale": 224},
+                                  grad=False, out0=True),
+    "polygon_box_transform": S([F32((1, 8, 2, 2))], grad=False),
     # --- fluid-era rnn cell ops (nn/rnn.py) ---
     "gru_unit": S([F32((2, 12), 1), F32((2, 4), 2), F32((4, 12), 3),
                    F32((1, 12), 4)], out0=True),
@@ -944,9 +972,12 @@ def test_ref_op_coverage_map_complete():
     import sys
     import os
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    import tempfile
+    tmp = tempfile.NamedTemporaryFile(suffix=".md", delete=False)
     r = subprocess.run(
         [sys.executable, os.path.join(repo, "scripts", "op_coverage.py"),
-         "--ref", "/nonexistent-use-census"],
+         "--ref", "/nonexistent-use-census", "--out", tmp.name],
         capture_output=True, text=True, timeout=300)
+    os.unlink(tmp.name)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "UNCLASSIFIED" not in r.stderr
