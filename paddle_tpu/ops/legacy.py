@@ -906,3 +906,23 @@ def add_position_encoding(x, alpha=1.0, beta=1.0):
     if pe.shape[1] < D:                                       # odd D
         pe = jnp.pad(pe, ((0, 0), (0, D - pe.shape[1])))
     return alpha * x + beta * pe[None, :, :].astype(x.dtype)
+
+
+@def_op("dequantize_abs_max", n_tensor_args=2, differentiable=False)
+def dequantize_abs_max(x, scale, max_range=127.0):
+    """ref operators/dequantize_abs_max_op.cc: int8 row -> float via
+    per-tensor abs-max scale."""
+    return x.astype(jnp.float32) * (scale.reshape(-1)[0] / max_range)
+
+
+@def_op("dequantize_log", n_tensor_args=2, differentiable=False)
+def dequantize_log(x, dict_table):
+    """ref operators/dequantize_log_op.cc: 4-bit log-quantized weights
+    decoded through a 2^k lookup table; ids >= 128 carry a sign flip."""
+    ids = x.astype(jnp.int32)
+    # int8 codes: negative ids carry the sign (ref kernel: -dict[x + 128]
+    # for x < 0). uint8-style codes >= 128 mean the same thing.
+    neg = (ids < 0) | (ids >= 128)
+    vals = dict_table[jnp.where(ids < 0, ids + 128,
+                                jnp.where(ids >= 128, ids - 128, ids))]
+    return jnp.where(neg, -vals, vals)

@@ -1174,3 +1174,107 @@ register_op("polygon_box_transform", _polygon_box_transform_raw)
 def polygon_box_transform(input, name=None):
     return apply(_polygon_box_transform_raw, (input,),
                  differentiable=False, name="polygon_box_transform")
+
+
+def _collect_fpn_proposals_raw(*args, post_nms_top_n=16):
+    """ref operators/detection/collect_fpn_proposals_op.cc: concat
+    per-level (rois, scores) pairs and keep the global top-N by score.
+    args = L roi tensors [Ni, 4] then L score tensors [Ni]."""
+    import jax.numpy as jnp
+    L = len(args) // 2
+    rois = jnp.concatenate(args[:L], axis=0)
+    scores = jnp.concatenate(args[L:], axis=0)
+    k = min(post_nms_top_n, scores.shape[0])
+    top_s, idx = jax.lax.top_k(scores, k)
+    out = jnp.zeros((post_nms_top_n, 4), rois.dtype)
+    out = out.at[:k].set(rois[idx])
+    return out, jnp.int32(k)
+
+
+register_op("collect_fpn_proposals", _collect_fpn_proposals_raw)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    return apply(_collect_fpn_proposals_raw,
+                 tuple(multi_rois) + tuple(multi_scores),
+                 {"post_nms_top_n": int(post_nms_top_n)},
+                 differentiable=False, name="collect_fpn_proposals")
+
+
+def _box_decoder_and_assign_raw(prior_box, prior_box_var, target_box,
+                                box_score, box_clip=4.135):
+    """ref operators/detection/box_decoder_and_assign_op.cc: decode
+    per-class box deltas against priors, then assign each roi its
+    best-scoring non-background class's box.
+    prior_box [N,4], target_box [N, C*4], box_score [N, C]."""
+    import jax.numpy as jnp
+    N, C = box_score.shape
+    pw = prior_box[:, 2] - prior_box[:, 0] + 1.0
+    ph = prior_box[:, 3] - prior_box[:, 1] + 1.0
+    px = prior_box[:, 0] + pw * 0.5
+    py = prior_box[:, 1] + ph * 0.5
+    d = target_box.reshape(N, C, 4) * prior_box_var.reshape(
+        1, 1, 4) if prior_box_var.ndim == 1 else \
+        target_box.reshape(N, C, 4) * prior_box_var[:, None, :]
+    cx = d[:, :, 0] * pw[:, None] + px[:, None]
+    cy = d[:, :, 1] * ph[:, None] + py[:, None]
+    bw = jnp.exp(jnp.minimum(d[:, :, 2], box_clip)) * pw[:, None]
+    bh = jnp.exp(jnp.minimum(d[:, :, 3], box_clip)) * ph[:, None]
+    decoded = jnp.stack([cx - bw / 2, cy - bh / 2,
+                         cx + bw / 2 - 1, cy + bh / 2 - 1],
+                        axis=2)                     # [N, C, 4]
+    best = jnp.argmax(box_score[:, 1:], axis=1) + 1  # skip background 0
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, 2), axis=1)[:, 0]
+    return decoded.reshape(N, C * 4), assigned
+
+
+register_op("box_decoder_and_assign", _box_decoder_and_assign_raw)
+
+
+def _mine_hard_examples_raw(cls_loss, match_indices, neg_pos_ratio=3.0,
+                            mining_type="max_negative"):
+    """OHEM negative mining (ref operators/detection/
+    mine_hard_examples_op.cc, max_negative mode): per row, keep the
+    neg_pos_ratio * num_pos highest-loss negatives. Returns a [B, M]
+    int32 mask (1 = selected negative)."""
+    import jax.numpy as jnp
+    if mining_type != "max_negative":
+        raise NotImplementedError(
+            "mine_hard_examples: only max_negative mining implemented "
+            "(ref hard_example mode keeps a global sample_size)")
+    neg = match_indices < 0                              # [B, M]
+    n_pos = jnp.sum(~neg, axis=1)                        # [B]
+    n_keep = jnp.minimum((n_pos * neg_pos_ratio).astype(jnp.int32),
+                         jnp.sum(neg, axis=1))
+    loss_neg = jnp.where(neg, cls_loss, -jnp.inf)
+    order = jnp.argsort(-loss_neg, axis=1)
+    rank = jnp.argsort(order, axis=1)                    # rank of each col
+    return (rank < n_keep[:, None]).astype(jnp.int32)
+
+
+register_op("mine_hard_examples", _mine_hard_examples_raw)
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
+                       name=None):
+    return apply(_mine_hard_examples_raw, (cls_loss, match_indices),
+                 {"neg_pos_ratio": float(neg_pos_ratio)},
+                 differentiable=False, name="mine_hard_examples")
+
+
+def _tdm_child_raw(x, tree_info, child_nums=2):
+    """ref operators/tdm_child_op.cc: look up each node id's children in
+    the TDM tree table. tree_info: [total_nodes, 3 + child_nums] rows of
+    (item_id, layer_id, parent_id, child_ids...). Returns (child ids
+    [..., child_nums], leaf mask)."""
+    import jax.numpy as jnp
+    ids = x.astype(jnp.int32)
+    children = tree_info[ids][..., 3:3 + child_nums].astype(jnp.int32)
+    item = tree_info[children][..., 0]
+    leaf_mask = ((children != 0) & (item != 0)).astype(jnp.int32)
+    return children, leaf_mask
+
+
+register_op("tdm_child", _tdm_child_raw)

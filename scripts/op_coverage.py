@@ -236,18 +236,12 @@ GAPS = {
     "retinanet_detection_output": "detection assembly tail",
     "generate_proposal_labels": "detection assembly tail",
     "generate_mask_labels": "detection assembly tail",
-    "collect_fpn_proposals": "detection assembly tail",
-    "mine_hard_examples": "detection assembly tail",
     "detection_map": "detection assembly tail",
-    "box_decoder_and_assign": "detection assembly tail",
     "roi_perspective_transform": "OCR tail",
     "deformable_psroi_pooling": "deform tail (deform_conv2d + psroi_pool "
         "cover the components)",
-    "tdm_child": "tree-based recommendation (TDM)",
     "tdm_sampler": "tree-based recommendation (TDM)",
     "similarity_focus": "niche attention visualisation",
-    "dequantize_abs_max": "quant-infra variant",
-    "dequantize_log": "quant-infra variant",
 }
 
 # n/a categories: regex on name -> (category, replacement)

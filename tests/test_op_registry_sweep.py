@@ -812,6 +812,29 @@ SPECS = {
                                    "refer_level": 4, "refer_scale": 224},
                                   grad=False, out0=True),
     "polygon_box_transform": S([F32((1, 8, 2, 2))], grad=False),
+    "collect_fpn_proposals": S([F32((3, 4), 1, 0.0, 1.0),
+                                F32((2, 4), 2, 0.0, 1.0),
+                                POS((3,), 3), POS((2,), 4)],
+                               {"post_nms_top_n": 4}, grad=False,
+                               out0=True),
+    "box_decoder_and_assign": S([POS((3, 4)) * 10.0, np.ones(4, "f4"),
+                                 F32((3, 8), 1, -0.1, 0.1),
+                                 POS((3, 2), 2)], grad=False, out0=True),
+    "mine_hard_examples": S([POS((2, 6)),
+                             np.array([[0, -1, -1, -1, -1, -1],
+                                       [1, 2, -1, -1, -1, -1]], "i4")],
+                            grad=False),
+    "tdm_child": S([np.array([1, 2], "i4"),
+                    np.array([[0, 0, 0, 0, 0], [0, 0, 0, 3, 4],
+                              [0, 0, 0, 5, 6], [10, 2, 1, 0, 0],
+                              [11, 2, 1, 0, 0], [12, 2, 2, 0, 0],
+                              [13, 2, 2, 0, 0]], "i4")],
+                   grad=False, out0=True),
+    "dequantize_abs_max": S([np.array([[127, -64]], "i4"),
+                             np.array([0.5], "f4")], grad=False),
+    "dequantize_log": S([np.array([[0, 128, 5]], "i4"),
+                         np.linspace(0.1, 1.0, 128).astype("f4")],
+                        grad=False),
     # --- fluid-era rnn cell ops (nn/rnn.py) ---
     "gru_unit": S([F32((2, 12), 1), F32((2, 4), 2), F32((4, 12), 3),
                    F32((1, 12), 4)], out0=True),
