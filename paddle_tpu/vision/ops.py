@@ -1278,3 +1278,394 @@ def _tdm_child_raw(x, tree_info, child_nums=2):
 
 
 register_op("tdm_child", _tdm_child_raw)
+
+
+# ------------------------------------------------- training target assign
+
+def _iou_corner_np(a, b):
+    import numpy as _np
+    area_a = _np.maximum(a[:, 2] - a[:, 0], 0) * _np.maximum(
+        a[:, 3] - a[:, 1], 0)
+    area_b = _np.maximum(b[:, 2] - b[:, 0], 0) * _np.maximum(
+        b[:, 3] - b[:, 1], 0)
+    x1 = _np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = _np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = _np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = _np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = _np.maximum(x2 - x1, 0) * _np.maximum(y2 - y1, 0)
+    return inter / _np.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+def _encode_center_np(anchors, gts):
+    """box_coder encode_center_size, numpy (targets for matched pairs)."""
+    import numpy as _np
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + aw * 0.5
+    ay = anchors[:, 1] + ah * 0.5
+    gw = gts[:, 2] - gts[:, 0] + 1.0
+    gh = gts[:, 3] - gts[:, 1] + 1.0
+    gx = gts[:, 0] + gw * 0.5
+    gy = gts[:, 1] + gh * 0.5
+    return _np.stack([(gx - ax) / aw, (gy - ay) / ah,
+                      _np.log(_np.maximum(gw / aw, 1e-10)),
+                      _np.log(_np.maximum(gh / ah, 1e-10))], axis=1)
+
+
+def _rpn_target_assign_raw(anchors, gt_boxes, rpn_batch_size_per_im=256,
+                           rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                           rpn_negative_overlap=0.3, seed=0):
+    """RPN anchor sampling (ref operators/detection/rpn_target_assign_op.cc):
+    positives = best anchor per gt + anchors with IoU > positive_overlap;
+    negatives = IoU < negative_overlap; seeded random subsample to the
+    fg-fraction budget. Dense outputs: labels [A] int32 (1 pos / 0 neg /
+    -1 ignore) and bbox targets [A, 4] (zero rows for non-positives)."""
+    import numpy as _np
+    an = _np.asarray(anchors)
+    gt = _np.asarray(gt_boxes)
+    A = an.shape[0]
+    rng = _np.random.RandomState(seed)
+    labels = _np.full((A,), -1, _np.int32)
+    tgt = _np.zeros((A, 4), _np.float32)
+    if gt.shape[0]:
+        iou = _iou_corner_np(an, gt)                 # [A, G]
+        best_gt = iou.argmax(axis=1)
+        best_iou = iou.max(axis=1)
+        labels[best_iou < rpn_negative_overlap] = 0
+        labels[iou.argmax(axis=0)] = 1               # best anchor per gt
+        labels[best_iou >= rpn_positive_overlap] = 1
+        n_fg = int(rpn_batch_size_per_im * rpn_fg_fraction)
+        fg = _np.where(labels == 1)[0]
+        if fg.size > n_fg:
+            labels[rng.choice(fg, fg.size - n_fg, replace=False)] = -1
+        n_bg = rpn_batch_size_per_im - min(fg.size, n_fg)
+        bg = _np.where(labels == 0)[0]
+        if bg.size > n_bg:
+            labels[rng.choice(bg, bg.size - n_bg, replace=False)] = -1
+        pos = _np.where(labels == 1)[0]
+        tgt[pos] = _encode_center_np(an[pos], gt[best_gt[pos]])
+    else:
+        labels[:] = 0
+    return jnp.asarray(labels), jnp.asarray(tgt)
+
+
+register_op("rpn_target_assign", _rpn_target_assign_raw)
+
+
+def _retinanet_target_assign_raw(anchors, gt_boxes, positive_overlap=0.5,
+                                 negative_overlap=0.4):
+    """RetinaNet assignment (ref operators/detection/retinanet_target_
+    assign_op.cc): like RPN but NO subsampling (focal loss consumes all
+    anchors). Returns (labels [A] with gt class slot 1 for matched —
+    callers combine with gt labels —, bbox targets [A, 4])."""
+    import numpy as _np
+    an = _np.asarray(anchors)
+    gt = _np.asarray(gt_boxes)
+    A = an.shape[0]
+    labels = _np.full((A,), -1, _np.int32)
+    tgt = _np.zeros((A, 4), _np.float32)
+    if gt.shape[0]:
+        iou = _iou_corner_np(an, gt)
+        best_gt = iou.argmax(axis=1)
+        best_iou = iou.max(axis=1)
+        labels[best_iou < negative_overlap] = 0
+        labels[best_iou >= positive_overlap] = 1
+        labels[iou.argmax(axis=0)] = 1
+        pos = _np.where(labels == 1)[0]
+        tgt[pos] = _encode_center_np(an[pos], gt[best_gt[pos]])
+    else:
+        labels[:] = 0
+    return jnp.asarray(labels), jnp.asarray(tgt)
+
+
+register_op("retinanet_target_assign", _retinanet_target_assign_raw)
+
+
+def _generate_proposal_labels_raw(rois, gt_boxes, gt_classes,
+                                  batch_size_per_im=64, fg_fraction=0.25,
+                                  fg_thresh=0.5, bg_thresh_hi=0.5,
+                                  bg_thresh_lo=0.0, seed=0):
+    """Second-stage RoI sampling (ref operators/detection/
+    generate_proposal_labels_op.cc): label rois by IoU against gt, seeded
+    fg/bg subsample, regression targets for foregrounds. Dense outputs:
+    (sampled rois [S, 4], labels [S] int32 (-1 pad), bbox targets [S, 4])
+    with S = batch_size_per_im."""
+    import numpy as _np
+    r = _np.asarray(rois)
+    gt = _np.asarray(gt_boxes)
+    gc = _np.asarray(gt_classes).reshape(-1)
+    rng = _np.random.RandomState(seed)
+    S = batch_size_per_im
+    all_rois = _np.concatenate([r, gt], axis=0) if gt.size else r
+    iou = _iou_corner_np(all_rois, gt) if gt.size else _np.zeros(
+        (all_rois.shape[0], 0))
+    best = iou.max(axis=1) if gt.size else _np.zeros(all_rois.shape[0])
+    best_gt = iou.argmax(axis=1) if gt.size else _np.zeros(
+        all_rois.shape[0], _np.int64)
+    fg = _np.where(best >= fg_thresh)[0]
+    bg = _np.where((best < bg_thresh_hi) & (best >= bg_thresh_lo))[0]
+    n_fg = min(int(S * fg_fraction), fg.size)
+    n_bg = min(S - n_fg, bg.size)
+    fg = rng.choice(fg, n_fg, replace=False) if fg.size > n_fg else fg
+    bg = rng.choice(bg, n_bg, replace=False) if bg.size > n_bg else bg
+    keep = _np.concatenate([fg, bg]).astype(_np.int64)
+    out_rois = _np.zeros((S, 4), _np.float32)
+    out_lab = _np.full((S,), -1, _np.int32)
+    out_tgt = _np.zeros((S, 4), _np.float32)
+    k = keep.size
+    out_rois[:k] = all_rois[keep]
+    out_lab[:len(fg)] = gc[best_gt[fg]] if gt.size else 0
+    out_lab[len(fg):k] = 0
+    if gt.size and len(fg):
+        out_tgt[:len(fg)] = _encode_center_np(all_rois[fg], gt[best_gt[fg]])
+    return jnp.asarray(out_rois), jnp.asarray(out_lab), jnp.asarray(out_tgt)
+
+
+register_op("generate_proposal_labels", _generate_proposal_labels_raw)
+
+
+def _detection_map_raw(detections, det_count, gt_boxes, gt_labels,
+                       overlap_threshold=0.5, class_num=2,
+                       ap_type="integral"):
+    """VOC-style mAP (ref operators/detection/detection_map_op.cc) for one
+    image batch in the dense contract: detections [D, 6] rows of (label,
+    score, x1, y1, x2, y2) with det_count valid, gt_boxes [G, 4],
+    gt_labels [G] (-1 pads). Host numpy; returns scalar mAP."""
+    import numpy as _np
+    det = _np.asarray(detections)[:int(det_count)]
+    gtb = _np.asarray(gt_boxes)
+    gtl = _np.asarray(gt_labels).reshape(-1)
+    valid = gtl >= 0
+    gtb, gtl = gtb[valid], gtl[valid]
+    aps = []
+    for c in range(class_num):
+        gt_c = gtb[gtl == c]
+        det_c = det[det[:, 0] == c]
+        if gt_c.shape[0] == 0:
+            continue
+        order = _np.argsort(-det_c[:, 1])
+        det_c = det_c[order]
+        used = _np.zeros(gt_c.shape[0], bool)
+        tp = _np.zeros(det_c.shape[0])
+        fp = _np.zeros(det_c.shape[0])
+        iou_all = _iou_corner_np(det_c[:, 2:6], gt_c) if det_c.size else \
+            _np.zeros((0, gt_c.shape[0]))
+        for i in range(det_c.shape[0]):
+            j = iou_all[i].argmax() if gt_c.shape[0] else 0
+            if gt_c.shape[0] and iou_all[i, j] >= overlap_threshold \
+                    and not used[j]:
+                tp[i] = 1
+                used[j] = True
+            else:
+                fp[i] = 1
+        ctp = _np.cumsum(tp)
+        cfp = _np.cumsum(fp)
+        rec = ctp / gt_c.shape[0]
+        prec = ctp / _np.maximum(ctp + cfp, 1e-10)
+        if ap_type == "11point":
+            ap = _np.mean([prec[rec >= t].max() if (rec >= t).any() else 0.0
+                           for t in _np.linspace(0, 1, 11)])
+        else:  # integral
+            ap = 0.0
+            mrec = _np.concatenate([[0.0], rec, [1.0]])
+            mpre = _np.concatenate([[0.0], prec, [0.0]])
+            for i in range(mpre.size - 2, -1, -1):
+                mpre[i] = max(mpre[i], mpre[i + 1])
+            idx = _np.where(mrec[1:] != mrec[:-1])[0]
+            ap = _np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1])
+        aps.append(ap)
+    return jnp.float32(_np.mean(aps) if aps else 0.0)
+
+
+register_op("detection_map", _detection_map_raw)
+
+
+def detection_map(detect_res, det_count, gt_boxes, gt_labels,
+                  class_num, overlap_threshold=0.5, ap_type="integral",
+                  name=None):
+    return apply(_detection_map_raw,
+                 (detect_res, det_count, gt_boxes, gt_labels),
+                 {"overlap_threshold": float(overlap_threshold),
+                  "class_num": int(class_num), "ap_type": str(ap_type)},
+                 differentiable=False, name="detection_map")
+
+
+def _deformable_psroi_pooling_raw(x, boxes, trans, output_size=(3, 3),
+                                  spatial_scale=1.0, trans_std=0.1,
+                                  sample_per_part=2):
+    """Deformable position-sensitive RoI pooling (ref operators/
+    deformable_psroi_pooling_op.cc, Deformable R-FCN): each bin's sample
+    grid is shifted by a learned offset (trans [R, 2, ph, pw], scaled by
+    trans_std and roi size), values bilinearly sampled from the bin's
+    position-sensitive channel group and averaged.
+    x: [1, C, H, W] with C = oc*ph*pw, boxes: [R, 4] -> [R, oc, ph, pw].
+    Differentiable w.r.t. x, boxes AND trans (the point of the op)."""
+    import jax
+    import jax.numpy as jnp
+    ph, pw = output_size
+    img = x[0]
+    c, h, w = img.shape
+    oc = c // (ph * pw)
+    s = sample_per_part
+
+    def bilinear(plane, yy, xx):
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(yy - y0, 0.0, 1.0)
+        wx = jnp.clip(xx - x0, 0.0, 1.0)
+        y0i, x0i = y0.astype(int), x0.astype(int)
+        y1i, x1i = y1.astype(int), x1.astype(int)
+        return (plane[y0i, x0i] * (1 - wy) * (1 - wx)
+                + plane[y0i, x1i] * (1 - wy) * wx
+                + plane[y1i, x0i] * wy * (1 - wx)
+                + plane[y1i, x1i] * wy * wx)
+
+    def one_roi(box, tr):
+        x1 = box[0] * spatial_scale - 0.5
+        y1 = box[1] * spatial_scale - 0.5
+        x2 = (box[2] + 1.0) * spatial_scale - 0.5
+        y2 = (box[3] + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / pw, rh / ph
+
+        def one_bin(k, i, j):
+            dx = tr[0, i, j] * trans_std * rw
+            dy = tr[1, i, j] * trans_std * rh
+            gy = (y1 + i * bh + dy
+                  + (jnp.arange(s) + 0.5) / s * bh)[:, None]
+            gx = (x1 + j * bw + dx
+                  + (jnp.arange(s) + 0.5) / s * bw)[None, :]
+            ch = (k * ph + i) * pw + j
+            vals = bilinear(img[ch], jnp.broadcast_to(gy, (s, s)),
+                            jnp.broadcast_to(gx, (s, s)))
+            return jnp.mean(vals)
+
+        kk, ii, jj = jnp.meshgrid(jnp.arange(oc), jnp.arange(ph),
+                                  jnp.arange(pw), indexing="ij")
+        return jax.vmap(jax.vmap(jax.vmap(one_bin)))(kk, ii, jj)
+
+    return jax.vmap(one_roi)(boxes, trans)
+
+
+register_op("deformable_psroi_pooling", _deformable_psroi_pooling_raw)
+
+
+def _roi_perspective_transform_raw(x, rois, transformed_height=4,
+                                   transformed_width=4, spatial_scale=1.0):
+    """Perspective-warp RoI quads to a fixed rectangle (ref operators/
+    detection/roi_perspective_transform_op.cc, OCR text-line
+    rectification). rois: [R, 8] quad corners (x1 y1 ... x4 y4 in
+    clockwise order); each output pixel samples the input bilinearly
+    through the quad->rect homography. x: [1, C, H, W]."""
+    import jax
+    import jax.numpy as jnp
+    img = x[0]
+    c, h, w = img.shape
+    TH, TW = transformed_height, transformed_width
+
+    def one_roi(quad):
+        q = quad.reshape(4, 2) * spatial_scale
+        # homography rect(u,v in [0,W-1]x[0,H-1]) -> quad: solve 8x8
+        src = jnp.asarray([[0.0, 0.0], [TW - 1.0, 0.0],
+                           [TW - 1.0, TH - 1.0], [0.0, TH - 1.0]])
+        rows = []
+        rhs = []
+        for k in range(4):
+            u, v = src[k, 0], src[k, 1]
+            X, Y = q[k, 0], q[k, 1]
+            rows.append(jnp.stack(
+                [u, v, jnp.asarray(1.0), jnp.asarray(0.0),
+                 jnp.asarray(0.0), jnp.asarray(0.0), -u * X, -v * X]))
+            rhs.append(X)
+            rows.append(jnp.stack(
+                [jnp.asarray(0.0), jnp.asarray(0.0), jnp.asarray(0.0),
+                 u, v, jnp.asarray(1.0), -u * Y, -v * Y]))
+            rhs.append(Y)
+        A = jnp.stack(rows)
+        b = jnp.stack(rhs)
+        # degenerate quads (zero/collinear rows — e.g. the dense contract's
+        # zero-padded rois) make A singular; NaN from the solve would
+        # poison the whole vmapped batch's gradients, so regularise and
+        # zero the output instead
+        degenerate = jnp.abs(jnp.linalg.det(A)) < 1e-6
+        A = jnp.where(degenerate, A + jnp.eye(8), A)
+        hvec = jnp.linalg.solve(A, b)
+        H3 = jnp.concatenate([hvec, jnp.ones((1,))]).reshape(3, 3)
+        uu, vv = jnp.meshgrid(jnp.arange(TW, dtype=jnp.float32),
+                              jnp.arange(TH, dtype=jnp.float32))
+        ones = jnp.ones_like(uu)
+        pts = jnp.stack([uu, vv, ones], axis=0).reshape(3, -1)
+        mapped = H3 @ pts
+        xs = mapped[0] / jnp.maximum(jnp.abs(mapped[2]), 1e-8) * \
+            jnp.sign(mapped[2])
+        ys = mapped[1] / jnp.maximum(jnp.abs(mapped[2]), 1e-8) * \
+            jnp.sign(mapped[2])
+
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x1_ = jnp.clip(x0 + 1, 0, w - 1)
+        y1_ = jnp.clip(y0 + 1, 0, h - 1)
+        wx = jnp.clip(xs - x0, 0.0, 1.0)
+        wy = jnp.clip(ys - y0, 0.0, 1.0)
+        x0i, y0i = x0.astype(int), y0.astype(int)
+        x1i, y1i = x1_.astype(int), y1_.astype(int)
+        vals = (img[:, y0i, x0i] * (1 - wy) * (1 - wx)
+                + img[:, y0i, x1i] * (1 - wy) * wx
+                + img[:, y1i, x0i] * wy * (1 - wx)
+                + img[:, y1i, x1i] * wy * wx)
+        inside = ((xs >= -1) & (xs <= w) & (ys >= -1) & (ys <= h))
+        vals = jnp.where(inside[None, :] & ~degenerate, vals, 0.0)
+        return vals.reshape(c, TH, TW)
+
+    return jax.vmap(one_roi)(rois)
+
+
+register_op("roi_perspective_transform", _roi_perspective_transform_raw)
+
+
+def _tdm_sampler_raw(leaf_ids, travel_list, layer_list, neg_samples_list=(),
+                     seed=0, output_positive=True):
+    """TDM layer-wise sampling (ref operators/tdm_sampler_op.cc): for each
+    positive leaf, emit its ancestor per tree layer (travel_list row) plus
+    `neg_samples_list[l]` seeded negatives drawn from that layer's node
+    set (layer_list row, 0-padded). Host numpy. Returns (out ids
+    [B, sum(1+neg_l)], labels same shape)."""
+    import numpy as _np
+    ids = _np.asarray(leaf_ids).reshape(-1)
+    travel = _np.asarray(travel_list)          # [num_leaves, L]
+    layers = _np.asarray(layer_list)           # [L, max_layer_nodes]
+    L = travel.shape[1]
+    neg = list(neg_samples_list) or [1] * L
+    if len(neg) != L:
+        raise ValueError(
+            f"tdm_sampler: neg_samples_list has {len(neg)} entries but the "
+            f"travel table has {L} layers (ref requires equal length)")
+    rng = _np.random.RandomState(seed)
+    width = sum((1 if output_positive else 0) + n for n in neg)
+    out = _np.zeros((ids.size, width), _np.int32)
+    lab = _np.zeros((ids.size, width), _np.int32)
+    for b, leaf in enumerate(ids):
+        k = 0
+        for l in range(L):
+            pos = travel[leaf, l]
+            if pos == 0:        # 0-padded layer (unbalanced tree): skip,
+                k += (1 if output_positive else 0) + neg[l]   # keep label 0
+                continue
+            if output_positive:
+                out[b, k] = pos
+                lab[b, k] = 1
+                k += 1
+            nodes = layers[l][layers[l] > 0]
+            nodes = nodes[nodes != pos]
+            n = min(neg[l], nodes.size)
+            if n:
+                out[b, k:k + n] = rng.choice(nodes, n, replace=False)
+            k += neg[l]
+    return jnp.asarray(out), jnp.asarray(lab)
+
+
+register_op("tdm_sampler", _tdm_sampler_raw)

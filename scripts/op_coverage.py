@@ -118,6 +118,7 @@ ALIAS = {
     "multiclass_nms3": "multiclass_nms", "matrix_nms": "nms",
     "locality_aware_nms": "nms",
     "generate_proposals_v2": "generate_proposals",
+    "retinanet_detection_output": "multiclass_nms",
 }
 
 # python API / subsystem coverage (not a registered desc op, by design)
@@ -231,16 +232,7 @@ OPTIMIZER_OPS = {
 
 # honest documented gaps: reference capabilities not yet implemented
 GAPS = {
-    "rpn_target_assign": "detection assembly tail",
-    "retinanet_target_assign": "detection assembly tail",
-    "retinanet_detection_output": "detection assembly tail",
-    "generate_proposal_labels": "detection assembly tail",
     "generate_mask_labels": "detection assembly tail",
-    "detection_map": "detection assembly tail",
-    "roi_perspective_transform": "OCR tail",
-    "deformable_psroi_pooling": "deform tail (deform_conv2d + psroi_pool "
-        "cover the components)",
-    "tdm_sampler": "tree-based recommendation (TDM)",
     "similarity_focus": "niche attention visualisation",
 }
 

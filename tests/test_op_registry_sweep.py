@@ -835,6 +835,39 @@ SPECS = {
     "dequantize_log": S([np.array([[0, 128, 5]], "i4"),
                          np.linspace(0.1, 1.0, 128).astype("f4")],
                         grad=False),
+    "rpn_target_assign": S([np.array([[0, 0, 16, 16], [30, 30, 46, 46],
+                                      [5, 5, 21, 21]], "f4"),
+                            np.array([[4, 4, 20, 20]], "f4")],
+                           {"rpn_batch_size_per_im": 4, "seed": 0},
+                           grad=False, out0=True, desc=False),  # host rng
+    "retinanet_target_assign": S([np.array([[0, 0, 16, 16],
+                                            [30, 30, 46, 46]], "f4"),
+                                  np.array([[4, 4, 20, 20]], "f4")],
+                                 grad=False, out0=True),
+    "generate_proposal_labels": S([np.array([[0, 0, 16, 16],
+                                             [30, 30, 46, 46]], "f4"),
+                                   np.array([[4, 4, 20, 20]], "f4"),
+                                   np.array([1], "i4")],
+                                  {"batch_size_per_im": 4, "seed": 0},
+                                  grad=False, out0=True, desc=False),
+    "detection_map": S([np.array([[0, 0.9, 10, 10, 30, 30]], "f4"),
+                        np.int32(1), np.array([[10, 10, 30, 30]], "f4"),
+                        np.array([0], "i4")],
+                       {"class_num": 1}, grad=False, desc=False),
+    "deformable_psroi_pooling": S([F32((1, 18, 8, 8)),
+                                   np.array([[1, 1, 6, 6]], "f4"),
+                                   F32((1, 2, 3, 3), 1, -0.1, 0.1)],
+                                  {"output_size": (3, 3)}),
+    "roi_perspective_transform": S([F32((1, 2, 8, 8)),
+                                    np.array([[2, 2, 5, 2, 5, 5, 2, 5]],
+                                             "f4")],
+                                   {"transformed_height": 4,
+                                    "transformed_width": 4}),
+    "tdm_sampler": S([np.array([0, 2], "i4"),
+                      np.array([[1, 3], [1, 4], [2, 5], [2, 6]], "i4"),
+                      np.array([[1, 2, 0, 0], [3, 4, 5, 6]], "i4")],
+                     {"neg_samples_list": (1, 2), "seed": 0},
+                     grad=False, out0=True, desc=False),
     # --- fluid-era rnn cell ops (nn/rnn.py) ---
     "gru_unit": S([F32((2, 12), 1), F32((2, 4), 2), F32((4, 12), 3),
                    F32((1, 12), 4)], out0=True),
