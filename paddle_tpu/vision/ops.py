@@ -1669,3 +1669,124 @@ def _tdm_sampler_raw(leaf_ids, travel_list, layer_list, neg_samples_list=(),
 
 
 register_op("tdm_sampler", _tdm_sampler_raw)
+
+
+def _similarity_focus_raw(x, axis=1, indexes=(0,)):
+    """ref operators/similarity_focus_op.h: for each selected index along
+    `axis`, greedily pick cells of the remaining 2D plane in descending
+    value order with no repeated row/col (an assignment-style focus), and
+    set those positions to 1 across the whole `axis` dimension. Host numpy
+    (sorting-based mask synthesis, non-differentiable)."""
+    import numpy as _np
+    if axis not in (1, 2, 3):
+        raise ValueError(
+            f"similarity_focus: axis must be 1, 2 or 3 (got {axis}) — "
+            "ref similarity_focus_op.h enforces the same")
+    a = _np.asarray(x)
+    B, d1, d2, d3 = a.shape
+    out = _np.zeros_like(a)
+    for b in range(B):
+        for index in indexes:
+            if axis == 1:
+                plane = a[b, index]                     # [d2, d3]
+                n1, n2 = d2, d3
+            elif axis == 2:
+                plane = a[b, :, index]                  # [d1, d3]
+                n1, n2 = d1, d3
+            else:
+                plane = a[b, :, :, index]               # [d1, d2]
+                n1, n2 = d1, d2
+            order = _np.argsort(-plane.ravel())
+            tag1 = _np.zeros(n1, bool)
+            tag2 = _np.zeros(n2, bool)
+            picked = 0
+            for f in order:
+                i1, i2 = divmod(int(f), n2)
+                if tag1[i1] or tag2[i2]:
+                    continue
+                tag1[i1] = tag2[i2] = True
+                picked += 1
+                if axis == 1:
+                    out[b, :, i1, i2] = 1
+                elif axis == 2:
+                    out[b, i1, :, i2] = 1
+                else:
+                    out[b, i1, i2, :] = 1
+                if picked == min(n1, n2):
+                    break
+    return jnp.asarray(out)
+
+
+register_op("similarity_focus", _similarity_focus_raw)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return apply(_similarity_focus_raw, (input,),
+                 {"axis": int(axis), "indexes": [int(i) for i in indexes]},
+                 differentiable=False, name="similarity_focus")
+
+
+def _rasterize_polygon_np(poly, x0, y0, x1, y1, M):
+    """Point-in-polygon (crossing number) over an M x M grid spanning the
+    box [x0,x1] x [y0,y1] — numpy-vectorized over the grid."""
+    import numpy as _np
+    xs = x0 + (_np.arange(M) + 0.5) * max(x1 - x0, 1e-6) / M
+    ys = y0 + (_np.arange(M) + 0.5) * max(y1 - y0, 1e-6) / M
+    gx, gy = _np.meshgrid(xs, ys)                       # [M, M]
+    inside = _np.zeros((M, M), bool)
+    n = poly.shape[0]
+    for i in range(n):
+        xa, ya = poly[i]
+        xb, yb = poly[(i + 1) % n]
+        cond = ((ya > gy) != (yb > gy))
+        with _np.errstate(divide="ignore", invalid="ignore"):
+            xint = xa + (gy - ya) * (xb - xa) / (yb - ya + 1e-12)
+        inside ^= cond & (gx < xint)
+    return inside
+
+
+def _generate_mask_labels_raw(rois, roi_labels, gt_polys, poly_lens,
+                              gt_classes, resolution=14):
+    """Mask R-CNN mask targets (ref operators/detection/
+    generate_mask_labels_op.cc, which rasterises COCO polygons per fg
+    roi): each fg roi takes the gt polygon whose bounding box overlaps it
+    most and rasterises the polygon restricted to the roi into an
+    M x M binary grid. Dense contract: gt_polys [G, P, 2] zero-padded
+    with poly_lens [G]; outputs (mask_int32 [R, M, M], roi_has_mask [R]).
+    Background rois (label <= 0) produce zero masks."""
+    import numpy as _np
+    r = _np.asarray(rois)
+    lab = _np.asarray(roi_labels).reshape(-1)
+    polys = _np.asarray(gt_polys)
+    plens = _np.asarray(poly_lens).reshape(-1)
+    gcls = _np.asarray(gt_classes).reshape(-1)
+    R = r.shape[0]
+    M = resolution
+    masks = _np.zeros((R, M, M), _np.int32)
+    has = _np.zeros((R,), _np.int32)
+    if polys.shape[0]:
+        # gt bbox per polygon
+        boxes = _np.zeros((polys.shape[0], 4), _np.float32)
+        for g in range(polys.shape[0]):
+            p = polys[g, :max(int(plens[g]), 1)]
+            boxes[g] = [p[:, 0].min(), p[:, 1].min(),
+                        p[:, 0].max(), p[:, 1].max()]
+        iou = _iou_corner_np(r, boxes)
+        # a roi may only take a mask from a gt of ITS class (ref semantics:
+        # mask targets are class-specific) — other classes' IoU is zeroed
+        same_cls = gcls[None, :] == lab[:, None]
+        iou = _np.where(same_cls, iou, 0.0)
+        best = iou.argmax(axis=1)
+        for i in range(R):
+            if lab[i] <= 0 or iou[i, best[i]] <= 0:
+                continue
+            g = best[i]
+            poly = polys[g, :int(plens[g])]
+            m = _rasterize_polygon_np(poly, r[i, 0], r[i, 1],
+                                      r[i, 2], r[i, 3], M)
+            masks[i] = m.astype(_np.int32)
+            has[i] = 1
+    return jnp.asarray(masks), jnp.asarray(has)
+
+
+register_op("generate_mask_labels", _generate_mask_labels_raw)

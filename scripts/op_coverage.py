@@ -232,8 +232,6 @@ OPTIMIZER_OPS = {
 
 # honest documented gaps: reference capabilities not yet implemented
 GAPS = {
-    "generate_mask_labels": "detection assembly tail",
-    "similarity_focus": "niche attention visualisation",
 }
 
 # n/a categories: regex on name -> (category, replacement)

@@ -868,6 +868,15 @@ SPECS = {
                       np.array([[1, 2, 0, 0], [3, 4, 5, 6]], "i4")],
                      {"neg_samples_list": (1, 2), "seed": 0},
                      grad=False, out0=True, desc=False),
+    "similarity_focus": S([F32((1, 2, 3, 4))],
+                          {"axis": 1, "indexes": [0]}, grad=False),
+    "generate_mask_labels": S([np.array([[5, 5, 15, 15]], "f4"),
+                               np.array([1], "i4"),
+                               np.array([[[0, 0], [20, 0], [20, 20],
+                                          [0, 20]]], "f4"),
+                               np.array([4], "i4"), np.array([1], "i4")],
+                              {"resolution": 8}, grad=False, out0=True,
+                              desc=False),   # host rasterizer
     # --- fluid-era rnn cell ops (nn/rnn.py) ---
     "gru_unit": S([F32((2, 12), 1), F32((2, 4), 2), F32((4, 12), 3),
                    F32((1, 12), 4)], out0=True),
