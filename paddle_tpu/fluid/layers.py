@@ -910,3 +910,811 @@ _reg("log_loss", _log_loss_raw)
 _reg("sigmoid_cross_entropy_with_logits", _sce_logits_raw)
 _reg("fill_constant_batch_size_like", _fcbsl_raw)
 _reg("shape", _shape_raw)
+
+
+# ------------------------------------------------------------------------- #
+# 1.x builder tail: thin legacy-signature wrappers over the registered op   #
+# surface (ref python/paddle/fluid/layers/nn.py, tensor.py, loss.py,        #
+# sequence_lod.py). Weightless builders delegate directly; weight-carrying  #
+# ones use the module parameter cache like fc/conv2d above.                 #
+# ------------------------------------------------------------------------- #
+
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+    fn = F.adaptive_max_pool2d if pool_type == "max" \
+        else F.adaptive_avg_pool2d
+    return fn(input, pool_size)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", name=None):
+    fn = F.adaptive_max_pool3d if pool_type == "max" \
+        else F.adaptive_avg_pool3d
+    return fn(input, pool_size)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    name = name or _uname("conv3d")
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    cin = input.shape[1]
+    w = _get_param(name + ".w_0", (num_filters, cin // groups, *ks),
+                   I.XavierNormal(), param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _get_param(name + ".b_0", (num_filters,), I.Constant(0.0),
+                       bias_attr)
+    out = F.conv3d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    name = name or _uname("conv3d_transpose")
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    cin = input.shape[1]
+    w = _get_param(name + ".w_0", (cin, num_filters // groups, *ks),
+                   I.XavierNormal(), param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _get_param(name + ".b_0", (num_filters,), I.Constant(0.0),
+                       bias_attr)
+    out = F.conv3d_transpose(input, w, b, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups)
+    return getattr(F, act)(out) if act else out
+
+
+def pool3d(input, pool_size=2, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, name=None):
+    if global_pooling:
+        return F.adaptive_avg_pool3d(input, 1) if pool_type == "avg" \
+            else F.adaptive_max_pool3d(input, 1)
+    fn = F.avg_pool3d if pool_type == "avg" else F.max_pool3d
+    return fn(input, pool_size, stride=pool_stride, padding=pool_padding)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    name = name or _uname("bilinear_tensor_product")
+    w = _get_param(name + ".w_0", (size, x.shape[1], y.shape[1]),
+                   I.XavierNormal(), param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _get_param(name + ".b_0", (size,), I.Constant(0.0), bias_attr)
+    out = F.bilinear(x, y, w, b)
+    return getattr(F, act)(out) if act else out
+
+
+# --- losses / metrics ---
+
+def _legacy(name_):
+    from ..ops import legacy as _L
+    return getattr(_L, name_)
+
+
+def bpr_loss(input, label, name=None):
+    return _legacy("bpr_loss")(input, label)
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    name = _uname("center_loss")
+    centers = _get_param(name + ".centers", (num_classes, input.shape[1]),
+                         I.Constant(0.0), param_attr)
+    loss, new_centers = _legacy("center_loss")(
+        input, label, centers, alpha=float(alpha),
+        need_update=bool(update_center))
+    if update_center:
+        centers.set_value(new_centers)
+    return loss
+
+
+def cos_sim(X, Y, name=None):
+    return _legacy("cos_sim")(X, Y)
+
+
+def rank_loss(label, left, right, name=None):
+    return _legacy("rank_loss")(label, left, right)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return F.npair_loss(anchor, positive, labels, l2_reg)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=5, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    name = name or _uname("nce")
+    w = _get_param(name + ".w_0", (num_total_classes, input.shape[1]),
+                   I.XavierNormal(), param_attr)
+    if bias_attr is False:
+        b = Tensor(np.zeros((num_total_classes,), "f4"))
+    else:
+        b = _get_param(name + ".b_0", (num_total_classes,),
+                       I.Constant(0.0), bias_attr)
+    # fluid semantics: seed=0 means fresh randomness per call (negatives
+    # must be re-drawn every step)
+    rng = np.random.RandomState(seed or None)
+    samples = Tensor(rng.randint(0, num_total_classes,
+                                 (num_neg_samples,)).astype("i4"))
+    return _legacy("nce_loss")(input, w, b, label, samples)
+
+
+def linear_chain_crf(input, label, length, param_attr=None):
+    """1.x CRF builder: creates the [(N+2), N] transition table (rows 0/1
+    start/stop) and returns the per-sequence NLL."""
+    name = _uname("linear_chain_crf")
+    n = input.shape[-1]
+    trans = _get_param(name + ".transition", (n + 2, n),
+                       I.Uniform(-0.1, 0.1), param_attr)
+    return _legacy("linear_chain_crf")(input, trans, label, length)
+
+
+def crf_decoding(input, transition, length, name=None):
+    return _legacy("crf_decoding")(input, transition, length)
+
+
+def edit_distance(input, label, input_length, label_length,
+                  normalized=True, name=None):
+    return _legacy("edit_distance")(input, label, input_length,
+                                    label_length, normalized=normalized)
+
+
+def chunk_eval(input, label, seq_length, chunk_scheme="IOB",
+               num_chunk_types=1, excluded_chunk_types=None):
+    return _legacy("chunk_eval")(input, label, seq_length,
+                                 num_chunk_types=num_chunk_types,
+                                 chunk_scheme=chunk_scheme)
+
+
+def mean_iou(input, label, num_classes):
+    return _legacy("mean_iou")(input, label, num_classes=num_classes)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """ref layers/loss.py dice_loss: input [N, ..., C] probs, label
+    [N, ..., 1] int — scalar mean of 1 - 2|A∩B|/(|A|+|B|+eps), epsilon in
+    the denominator only, like the reference."""
+    import jax.numpy as jnp
+    a = _as(input)
+    lab = _as(label).squeeze(-1)
+    onehot = jnp.eye(a.shape[-1], dtype=a.dtype)[lab]
+    import builtins
+    red = tuple(builtins.range(1, a.ndim))   # `range` is the 1.x builder here
+    inter = jnp.sum(a * onehot, axis=red)
+    union = jnp.sum(a, axis=red) + jnp.sum(onehot, axis=red)
+    return Tensor(jnp.mean(1.0 - 2.0 * inter / (union + epsilon)))
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    z = M.clip(input, min=soft_max_lower_bound, max=soft_max_up_bound)
+    return F.binary_cross_entropy_with_logits(z, label, reduction="none")
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples, seed=0,
+                                       name=None):
+    rng = np.random.RandomState(seed or None)   # seed=0: fresh per call
+    V_ = logits.shape[-1]
+    samples = Tensor(rng.randint(0, V_, (num_samples,)).astype("i4"))
+    sampled = _legacy("sample_logits")(logits, label, samples)
+    zero = Tensor(np.zeros((sampled.shape[0],), "i4"))
+    return F.cross_entropy(sampled, zero, reduction="none")
+
+
+def warpctc(input, label, input_length=None, label_length=None,
+            blank=0, norm_by_times=False):
+    """1.x warpctc on batch-major [B, T, C] logits (F.ctc_loss is
+    time-major like the reference kernel)."""
+    tm = MA.transpose(input, [1, 0, 2])
+    return F.ctc_loss(tm, label, input_length, label_length,
+                      blank=blank, reduction="none")
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    ids = M.argmax(input, axis=-1)
+    if input_length is None:
+        input_length = Tensor(np.full((ids.shape[0],), ids.shape[1], "i4"))
+    return _legacy("ctc_align")(ids, input_length, blank=int(blank))
+
+
+def cross_entropy2(input, label, ignore_index=-100):
+    return F.cross_entropy(input, label, ignore_index=ignore_index,
+                           use_softmax=False, reduction="none")
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    name = name or _uname("hsigmoid")
+    w = _get_param(name + ".w_0", (num_classes - 1, input.shape[1]),
+                   I.XavierNormal(), param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _get_param(name + ".b_0", (num_classes - 1,), I.Constant(0.0),
+                       bias_attr)
+    return F.hsigmoid_loss(input, label, num_classes, w, b)
+
+
+# --- vision tail ---
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", act=None,
+                   name=None):
+    from ..vision import ops as _V
+    out = _V.affine_channel(x, scale, bias, data_layout)
+    return getattr(F, act)(out) if act else out
+
+
+def affine_grid(theta, out_shape, name=None):
+    return F.affine_grid(theta, out_shape)
+
+
+def grid_sampler(x, grid, name=None):
+    return F.grid_sample(x, grid)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    from ..vision import ops as _V
+    return _V.roi_pool(input, rois, output_size=(pooled_height,
+                                                 pooled_width),
+                       spatial_scale=spatial_scale)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    from ..vision import ops as _V
+    return _V.roi_align(input, rois, output_size=(pooled_height,
+                                                  pooled_width),
+                        spatial_scale=spatial_scale,
+                        sampling_ratio=sampling_ratio)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    from ..vision import ops as _V
+    return _V.psroi_pool(input, rois, output_size=(pooled_height,
+                                                   pooled_width),
+                         spatial_scale=spatial_scale)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    from ..vision import ops as _V
+    return _V.prroi_pool(input, rois, output_size=(pooled_height,
+                                                   pooled_width),
+                         spatial_scale=spatial_scale)
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    name = name or _uname("deformable_conv")
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    cin = input.shape[1]
+    w = _get_param(name + ".w_0", (num_filters, cin // groups, *ks),
+                   I.XavierNormal(), param_attr)
+    b = None
+    if bias_attr is not False:
+        b = _get_param(name + ".b_0", (num_filters,), I.Constant(0.0),
+                       bias_attr)
+    from ..vision.ops import deform_conv2d as _dc
+    return _dc(input, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask if modulated else None)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           name=None):
+    from ..vision import ops as _V
+    return Tensor(_V._deformable_psroi_pooling_raw(
+        _as(input), _as(rois), _as(trans),
+        output_size=(pooled_height, pooled_width),
+        spatial_scale=spatial_scale, trans_std=trans_std,
+        sample_per_part=sample_per_part))
+
+
+def shuffle_channel(x, group, name=None):
+    from ..vision import ops as _V
+    return _V.channel_shuffle(x, group)
+
+
+def space_to_depth(x, blocksize, name=None):
+    from ..vision import ops as _V
+    return _V.space_to_depth(x, blocksize)
+
+
+def pixel_shuffle(x, upscale_factor):
+    return F.pixel_shuffle(x, upscale_factor)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    from ..vision import ops as _V
+    return _V.similarity_focus(input, axis, indexes)
+
+
+def random_crop(x, shape, seed=None):
+    a = np.asarray(_as(x))
+    rng = np.random.RandomState(seed or None)   # None: random per call
+    h, w = shape[-2], shape[-1]
+    top = rng.randint(0, max(a.shape[-2] - h, 0) + 1)
+    left = rng.randint(0, max(a.shape[-1] - w, 0) + 1)
+    return Tensor(a[..., top:top + h, left:left + w])
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    h, w = input.shape[-2:]
+    scale = out_short_len / min(h, w)
+    return F.interpolate(input, size=[int(round(h * scale)),
+                                      int(round(w * scale))],
+                         mode=resample.lower())
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  align_corners=True, align_mode=1, data_format="NCW"):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode="linear", align_corners=align_corners,
+                         data_format=data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode="trilinear", align_corners=align_corners,
+                         data_format=data_format)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    return F.local_response_norm(input, n, alpha=alpha, beta=beta, k=k)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return F.unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return F.temporal_shift(x, seg_num, shift_ratio)
+
+
+def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+                param_attr=None, bias_attr=None, name=None,
+                act_alpha=1.0):
+    out = batch_norm(input, act=None, is_test=is_test, momentum=momentum,
+                     epsilon=epsilon, param_attr=param_attr,
+                     bias_attr=bias_attr, name=name)
+    if act == "leaky_relu":
+        return F.leaky_relu(out, act_alpha)
+    if act == "elu":
+        return F.elu(out, act_alpha)
+    return getattr(F, act)(out) if act else out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    name = name or _uname("spectral_norm")
+    h = weight.shape[dim]
+    w_ = int(np.prod(weight.shape)) // h
+    u = _get_param(name + ".u", (h,), I.Normal(0.0, 1.0))
+    v = _get_param(name + ".v", (w_,), I.Normal(0.0, 1.0))
+    return _legacy("spectral_norm_op")(weight, u, v, dim=dim,
+                                       power_iters=power_iters, eps=eps)
+
+
+# --- misc tensor / legacy infra ---
+
+def _as(t):
+    return t._data if isinstance(t, Tensor) else np.asarray(t)
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    return _legacy("add_position_encoding")(input, alpha=float(alpha),
+                                            beta=float(beta))
+
+
+def multiplex(inputs, index, name=None):
+    return _legacy("multiplex")(inputs, index)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None):
+    name = name or _uname("data_norm")
+    d = input.shape[-1]
+    bsz = _get_param(name + ".batch_size", (d,), I.Constant(1e4))
+    bsum = _get_param(name + ".batch_sum", (d,), I.Constant(0.0))
+    bsq = _get_param(name + ".batch_square_sum", (d,), I.Constant(1e4))
+    out = _legacy("data_norm")(input, bsz, bsum, bsq, epsilon=epsilon)
+    return getattr(F, act)(out) if act else out
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    return _legacy("cvm")(input, cvm, use_cvm=use_cvm)
+
+
+def fsp_matrix(x, y):
+    return _legacy("fsp")(x, y)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    st = stride if isinstance(stride, (list, tuple)) else (stride, stride)
+    pd = padding if isinstance(padding, (list, tuple)) else (padding,) * 4
+    if len(pd) == 2:
+        pd = (pd[0], pd[1], pd[0], pd[1])
+    return _legacy("im2sequence")(input, kernels=tuple(ks),
+                                  strides=tuple(st), paddings=tuple(pd))
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    name = _uname("row_conv")
+    w = _get_param(name + ".w_0", (future_context_size + 1,
+                                   input.shape[-1]),
+                   I.XavierNormal(), param_attr)
+    out = _legacy("row_conv")(input, w)
+    return getattr(F, act)(out) if act else out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _legacy("hash_op")(input, num_hash=num_hash, mod_by=hash_size)
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    from ..ops.legacy import get_tensor_from_selected_rows as _g
+    return _g(x)
+
+
+def merge_selected_rows(x, name=None):
+    from ..ops.legacy import merge_selected_rows as _m
+    return _m(x)
+
+
+def reverse(x, axis):
+    return _legacy("reverse")(x, axis=axis if isinstance(axis, int)
+                              else list(axis))
+
+
+def sign(x):
+    return M.sign(x)
+
+
+def rank(input):
+    return Tensor(np.asarray(len(input.shape), dtype="i4"))
+
+
+def size(input):
+    return Tensor(np.asarray(int(np.prod(input.shape)), dtype="i8"))
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32",
+        name=None):
+    out = C.eye(num_rows, num_columns, dtype=dtype)
+    if batch_shape:
+        a = _as(out)
+        for _ in batch_shape:
+            a = a[None]
+        import jax.numpy as jnp
+        a = jnp.broadcast_to(a, tuple(batch_shape) + a.shape[-2:])
+        return Tensor(a)
+    return out
+
+
+def diag(diagonal):
+    return C.diag(diagonal)
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    return Tensor(np.zeros((0,), dtype=np.dtype(dtype)))
+
+
+def _unique_1x(x):
+    """fluid 1.x unique: first-occurrence order + len(x) inverse map
+    (unlike 2.x paddle.unique, which sorts)."""
+    a = np.asarray(_as(x)).reshape(-1)
+    uniq_sorted, first_idx, inverse, counts = np.unique(
+        a, return_index=True, return_inverse=True, return_counts=True)
+    order = np.argsort(first_idx)               # first-occurrence order
+    uniq = uniq_sorted[order]
+    remap = np.empty_like(order)
+    remap[order] = np.arange(order.size)
+    return uniq, remap[inverse].astype("i4"), counts[order]
+
+
+def unique(x, dtype="int32"):
+    uniq, inverse, _ = _unique_1x(x)
+    return Tensor(uniq), Tensor(inverse)
+
+
+def unique_with_counts(x, dtype="int32"):
+    uniq, inverse, counts = _unique_1x(x)
+    return Tensor(uniq), Tensor(inverse), Tensor(counts.astype("i4"))
+
+
+def unbind(input, axis=0):
+    return MA.unbind(input, axis)
+
+
+def triu(input, diagonal=0, name=None):
+    return C.triu(input, diagonal)
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return MA.scatter_nd_add(ref, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return MA.scatter_nd(index, updates, shape)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return MA.shard_index(input, index_num, nshards, shard_id, ignore_value)
+
+
+def gather_tree(ids, parents):
+    return F.gather_tree(ids, parents)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return L.logical_xor(x, y)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return L.all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return L.any(input, axis=dim, keepdim=keep_dim)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    out = M.floor_divide(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    import jax.numpy as jnp
+    ya = _as(y)
+    pads = [(0, int(xs) - int(ys)) for xs, ys in zip(x.shape, ya.shape)]
+    return Tensor(jnp.pad(ya, pads, constant_values=pad_value))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return MA.crop(x, shape, offsets)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    return MA.crop(x, shape, offsets)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    probs = _as(x)
+    rng = np.random.RandomState(seed or None)
+    cum = np.cumsum(np.asarray(probs), axis=-1)
+    r = rng.rand(probs.shape[0], 1) * cum[:, -1:]
+    return Tensor((cum < r).sum(axis=1).astype("i4"))
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    dtype="float32"):
+    shp = list(shape)
+    shp[output_dim_idx] = input.shape[input_dim_idx]
+    return _legacy("gaussian_random")(shp, mean=mean, std=std)
+
+
+def uniform_random_batch_size_like(input, shape, min=-1.0, max=1.0,
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   dtype="float32"):
+    shp = list(shape)
+    shp[output_dim_idx] = input.shape[input_dim_idx]
+    return _legacy("uniform_random")(shp, min=min, max=max)
+
+
+# --- activations tail ---
+
+def mish(x, threshold=20.0, name=None):
+    return F.mish(x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return F.selu(x, scale, alpha)
+
+
+def maxout(x, groups, name=None, axis=1):
+    return F.maxout(x, groups, axis)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return M.multiply(Tensor(np.float32(scale_b)),
+                      M.tanh(M.multiply(Tensor(np.float32(scale_a)), x)))
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    clipped = M.clip(x, min=-threshold, max=threshold)
+    return M.log1p(M.exp(clipped))
+
+
+# --- sequence ops (dense + lengths world; see ops/sequence.py) ---
+
+def _seq(name_):
+    from ..ops import sequence as _S
+    return getattr(_S, name_)
+
+
+def sequence_conv(input, lengths=None, num_filters=1, filter_size=3,
+                  param_attr=None, bias_attr=None, act=None, name=None):
+    name = name or _uname("sequence_conv")
+    w = _get_param(name + ".w_0",
+                   (filter_size * input.shape[-1], num_filters),
+                   I.XavierNormal(), param_attr)
+    out = _seq("sequence_conv")(input, lengths, w,
+                                context_length=filter_size)
+    if bias_attr is not False:
+        b = _get_param(name + ".b_0", (num_filters,), I.Constant(0.0),
+                       bias_attr)
+        out = M.add(out, b)
+    return getattr(F, act)(out) if act else out
+
+
+def sequence_softmax(input, lengths=None, name=None):
+    return _seq("sequence_softmax")(input, lengths)
+
+
+def sequence_concat(input, lengths=None, name=None):
+    x1, l1, x2, l2 = input[0], input[1], input[2], input[3]
+    return _seq("sequence_concat")(x1, l1, x2, l2)
+
+
+def sequence_expand(x, y=None, ref_level=-1, repeats=None, name=None):
+    return _seq("sequence_expand")(x, repeats=repeats)
+
+
+def sequence_expand_as(x, y, name=None):
+    return _seq("sequence_expand_as")(x, y)
+
+
+def sequence_first_step(input):
+    return _seq("sequence_first_step")(input)
+
+
+def sequence_last_step(input, lengths=None):
+    return _seq("sequence_last_step")(input, lengths)
+
+
+def sequence_reverse(x, lengths=None, name=None):
+    return _seq("sequence_reverse")(x, lengths)
+
+
+def sequence_slice(input, offset, length, lengths=None, name=None):
+    return _seq("sequence_slice")(input, lengths, offset, length)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, lengths=None,
+                       name=None):
+    return _seq("sequence_enumerate")(input, lengths, win_size=win_size,
+                                      pad_value=pad_value)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    return F.sequence_mask(x, maxlen, dtype)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    from ..ops import sequence as _S
+    return _S.sequence_pad(x, pad_value=pad_value, maxlen=maxlen)
+
+
+def sequence_unpad(x, length, name=None):
+    from ..ops import sequence as _S
+    return _S.sequence_unpad(x, length)
+
+
+def sequence_reshape(input, new_dim, lengths=None):
+    return _seq("sequence_reshape")(input, lengths, new_dim=new_dim)
+
+
+def sequence_scatter(input, index, updates, lengths=None, name=None):
+    return _seq("sequence_scatter")(input, index, updates, lengths)
+
+
+# --- LoD-era infra: dense+lengths analogs / TensorArray bridge ---
+
+def array_length(array):
+    return _cf.array_length(array)
+
+
+def lod_append(x, level):
+    return x
+
+
+def lod_reset(x, y=None, target_lod=None):
+    tl = y if y is not None else Tensor(np.asarray(target_lod, "i4"))
+    return _legacy("lod_reset")(x, tl)[0]
+
+
+def lod_rank_table(x, level=0):
+    raise NotImplementedError(
+        "lod_rank_table: LoD rank tables do not exist in the dense+lengths "
+        "design — sort by lengths with argsort(lengths) instead")
+
+
+def array_to_lod_tensor(x, table):
+    raise NotImplementedError(
+        "array_to_lod_tensor: use TensorArray.stack() (static/control_flow)")
+
+
+def lod_tensor_to_array(x, table):
+    raise NotImplementedError(
+        "lod_tensor_to_array: use TensorArray.unstack()")
+
+
+def max_sequence_len(rank_table):
+    raise NotImplementedError(
+        "max_sequence_len: use lengths.max() on the dense pair")
+
+
+def merge_lod_tensor(in_true, in_false, x, mask, level=0):
+    import jax.numpy as jnp
+    m = _as(mask).astype(bool).reshape(-1, *([1] * (len(in_true.shape) - 1)))
+    return Tensor(jnp.where(m, _as(in_true), _as(in_false)))
+
+
+def split_lod_tensor(input, mask, level=0):
+    import jax.numpy as jnp
+    m = _as(mask).astype(bool).reshape(-1, *([1] * (len(input.shape) - 1)))
+    a = _as(input)
+    return (Tensor(jnp.where(m, a, 0)), Tensor(jnp.where(m, 0, a)))
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    raise NotImplementedError(
+        "reorder_lod_tensor_by_rank: gather rows by argsort(lengths)")
+
+
+def shrink_memory(x, i, table):
+    raise NotImplementedError(
+        "shrink_memory: dense RNN kernels mask by lengths instead")
+
+
+def select_input(inputs, mask):
+    """Eager branch select (ref select_input_op; under jit use
+    static.control_flow.cond for a traced branch)."""
+    return inputs[1] if bool(np.asarray(_as(mask)).item()) else inputs[0]
+
+
+def select_output(x, outputs, mask):
+    idx = int(np.asarray(_as(mask)).item())
+    outputs[idx] = x
+    return outputs
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*[_as(t) for t in xs])
+    return Tensor(np.asarray(res))
+
+
+def save(x, file_path, overwrite=True):
+    from ..framework.serialization import save as _save
+    _save({"x": x}, file_path)
+
+
+def save_combine(x, file_path, overwrite=True):
+    from ..framework.serialization import save as _save
+    # zero-padded keys: lexicographic order == numeric order on reload
+    _save({f"x{i:06d}": t for i, t in enumerate(x)}, file_path)
+
+
+def load_combine(out, file_path):
+    from ..framework.serialization import load as _load
+    d = _load(file_path)
+    return [d[k] for k in sorted(d)]

@@ -272,10 +272,14 @@ def im2sequence(x, kernels=(1, 1), strides=(1, 1), paddings=(0, 0)):
     emit one row per output position -> [B*OH*OW, C*kh*kw]."""
     kh, kw = kernels
     sh, sw = strides
-    ph, pw = paddings
+    if len(paddings) == 4:                     # (up, left, down, right)
+        pu, pl, pd_, pr = paddings
+    else:
+        pu, pl = paddings
+        pd_, pr = pu, pl
     patches = jax.lax.conv_general_dilated_patches(
         x, filter_shape=(kh, kw), window_strides=(sh, sw),
-        padding=((ph, ph), (pw, pw)))          # [B, C*kh*kw, OH, OW]
+        padding=((pu, pd_), (pl, pr)))         # [B, C*kh*kw, OH, OW]
     B, F, OH, OW = patches.shape
     return patches.transpose(0, 2, 3, 1).reshape(B * OH * OW, F)
 

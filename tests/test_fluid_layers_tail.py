@@ -1,0 +1,151 @@
+"""1.x fluid.layers builder-tail surface test: every legacy builder added
+for reference parity (ref python/paddle/fluid/layers/{nn,tensor,loss,
+sequence_lod}.py) runs eagerly on representative shapes and produces
+finite outputs of the right shape. Complements tests/test_fluid_compat.py
+(which checks numerics/convergence of the core builders)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid.layers as FL
+
+T = pt.to_tensor
+r = np.random.RandomState(0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_params():
+    FL.reset_parameters()
+    yield
+    FL.reset_parameters()
+
+
+def _finite(t):
+    arrs = t if isinstance(t, (list, tuple)) else [t]
+    for a in arrs:
+        v = np.asarray(a.numpy() if hasattr(a, "numpy") else a)
+        if v.dtype.kind == "f":
+            assert np.isfinite(v).all()
+    return t
+
+
+def test_conv3d_pool3d_family():
+    x = T(r.randn(1, 2, 6, 6, 6).astype("f4"))
+    assert FL.conv3d(x, 3, 3).shape[:2] == [1, 3]
+    assert FL.conv3d_transpose(x, 3, filter_size=3).shape[1] == 3
+    assert FL.pool3d(x, 2).shape == [1, 2, 5, 5, 5]
+    assert FL.adaptive_pool3d(x, 2).shape == [1, 2, 2, 2, 2]
+
+
+def test_loss_tail():
+    x = T(r.randn(4, 6).astype("f4"))
+    lab = T(np.array([0, 1, 2, 3], "i4"))
+    _finite(FL.bpr_loss(x, lab))
+    _finite(FL.center_loss(x, lab, 5, 0.1))
+    _finite(FL.cos_sim(x, x))
+    _finite(FL.nce(x, lab, 10))
+    _finite(FL.hsigmoid(x, lab, 8))
+    _finite(FL.dice_loss(T(r.rand(2, 4, 3).astype("f4")),
+                         T(np.zeros((2, 4, 1), "i4"))))
+    _finite(FL.teacher_student_sigmoid_loss(x, T(r.rand(4, 6).astype("f4"))))
+    _finite(FL.sampled_softmax_with_cross_entropy(
+        T(r.randn(3, 20).astype("f4")), T(np.array([[1], [2], [3]], "i4")),
+        5))
+    out = FL.warpctc(T(r.randn(2, 6, 5).astype("f4")),
+                     T(np.ones((2, 2), "i4")), T(np.array([6, 6], "i4")),
+                     T(np.array([2, 2], "i4")))
+    assert out.shape == [2]
+
+
+def test_crf_pipeline_builders():
+    em = T(r.randn(2, 4, 3).astype("f4"))
+    lab = T(np.zeros((2, 4), "i4"))
+    lens = T(np.array([4, 2], "i4"))
+    nll = FL.linear_chain_crf(em, lab, lens)
+    assert nll.shape == [2, 1]
+    trans = FL._PARAMS[[k for k in FL._PARAMS if "transition" in k][0]]
+    path = FL.crf_decoding(em, trans, lens)
+    assert path.shape == [2, 4]
+    d = FL.edit_distance(T(np.array([[1, 2]], "i4")),
+                         T(np.array([[1, 3]], "i4")),
+                         T(np.array([2], "i4")), T(np.array([2], "i4")),
+                         normalized=False)
+    assert float(d.numpy()[0, 0]) == 1.0
+
+
+def test_vision_tail():
+    x = T(r.randn(2, 4, 6, 6).astype("f4"))
+    _finite(FL.affine_channel(x, T(r.randn(4).astype("f4")),
+                              T(r.randn(4).astype("f4"))))
+    assert FL.shuffle_channel(x, 2).shape == x.shape
+    assert FL.space_to_depth(x, 2).shape == [2, 16, 3, 3]
+    assert FL.similarity_focus(x, 1, [0]).shape == x.shape
+    one = T(r.randn(1, 2, 8, 8).astype("f4"))
+    rois = T(np.array([[0, 0, 4, 4]], "f4"))
+    assert FL.roi_pool(one, rois, 2, 2).shape == [1, 2, 2, 2]
+    assert FL.prroi_pool(one, T(np.array([[1, 1, 5, 5]], "f4")),
+                         1.0, 2, 2).shape == [1, 2, 2, 2]
+    assert FL.image_resize_short(x, 12).shape[-1] == 12
+    assert FL.lrn(x).shape == x.shape
+    sn = FL.spectral_norm(T(r.randn(4, 6).astype("f4")), power_iters=12)
+    sv = np.linalg.svd(np.asarray(sn.numpy()), compute_uv=False)
+    assert abs(sv[0] - 1.0) < 0.05
+
+
+def test_misc_tensor_tail():
+    x = T(r.randn(4, 6).astype("f4"))
+    assert FL.multiplex([x, x], T(np.array([0, 1, 0, 1], "i4"))).shape \
+        == [4, 6]
+    _finite(FL.data_norm(x))
+    _finite(FL.continuous_value_model(T(r.rand(4, 6).astype("f4")),
+                                      T(r.rand(4, 2).astype("f4"))))
+    assert FL.fsp_matrix(T(r.randn(2, 3, 4, 4).astype("f4")),
+                         T(r.randn(2, 5, 4, 4).astype("f4"))).shape \
+        == [2, 3, 5]
+    assert FL.hash(T(np.array([[3], [7]], "i4")), 1000).shape == [2, 1, 1]
+    assert int(FL.rank(x).numpy()) == 2
+    assert int(FL.size(x).numpy()) == 24
+    assert FL.eye(3, batch_shape=[2]).shape == [2, 3, 3]
+    u, idx = FL.unique(T(np.array([3, 1, 3], "i4")))
+    assert sorted(np.asarray(u.numpy()).tolist()) == [1, 3]
+    assert FL.pad_constant_like(x, T(r.randn(2, 3).astype("f4"))).shape \
+        == [4, 6]
+    assert bool(FL.reduce_any(T(np.array([True, False]))).numpy())
+    # select_input is an eager branch pick
+    y = FL.select_input([x, T(np.zeros((1,), "f4"))], T(np.array(0, "i4")))
+    assert y.shape == [4, 6]
+
+
+def test_sequence_tail_builders():
+    x = T(r.randn(2, 4, 6).astype("f4"))
+    lens = T(np.array([3, 2], "i4"))
+    assert FL.sequence_softmax(T(r.randn(2, 5).astype("f4")),
+                               lens).shape == [2, 5]
+    out, newlens = FL.sequence_reshape(x, 3, lens)
+    assert out.shape == [2, 8, 3]
+    assert FL.sequence_mask(T(np.array([2, 3], "i4")), 5).shape == [2, 5]
+    conv = FL.sequence_conv(x, lens, num_filters=5, filter_size=3)
+    assert conv.shape == [2, 4, 5]
+    assert FL.row_conv(x, 2).shape == x.shape
+
+
+def test_rng_builders_deterministic():
+    x = T(r.randn(4, 6).astype("f4"))
+    g1 = FL.gaussian_random_batch_size_like(x, [0, 7])
+    assert g1.shape == [4, 7]
+    u1 = FL.uniform_random_batch_size_like(x, [0, 3], min=0.0, max=1.0)
+    assert float(u1.numpy().min()) >= 0.0
+    s = FL.sampling_id(T(r.rand(3, 5).astype("f4")), seed=7)
+    assert s.shape == [3]
+
+
+def test_legacy_lod_infra_errors_are_informative():
+    with pytest.raises(NotImplementedError, match="argsort"):
+        FL.lod_rank_table(T(np.zeros((2, 2), "f4")))
+    with pytest.raises(NotImplementedError, match="TensorArray"):
+        FL.array_to_lod_tensor(None, None)
+    # the dense analogs that DO exist
+    merged = FL.merge_lod_tensor(T(np.ones((4, 6), "f4")),
+                                 T(np.zeros((4, 6), "f4")), None,
+                                 T(np.array([1, 0, 1, 0], "i4")))
+    assert np.asarray(merged.numpy())[0].sum() == 6
