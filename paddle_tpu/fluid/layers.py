@@ -1718,3 +1718,36 @@ def load_combine(out, file_path):
     from ..framework.serialization import load as _load
     d = _load(file_path)
     return [d[k] for k in sorted(d)]
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    """ref layers/tensor.py tensor_array_to_tensor: fold a TensorArray (or
+    python list of Tensors) into one tensor + the per-element sizes along
+    `axis`."""
+    items = input.to_list() if hasattr(input, "to_list") else list(input)
+    if use_stack:
+        out = MA.stack(items, axis=axis)
+        sizes = np.ones((len(items),), "i4")
+    else:
+        out = MA.concat(items, axis=axis)
+        sizes = np.asarray([t.shape[axis] for t in items], "i4")
+    return out, Tensor(sizes)
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True, out_val_if_empty=0):
+    """ref operators/filter_by_instag_op.cc: keep rows whose tag set
+    intersects filter_tag. Dynamic output -> host edge op (like nonzero):
+    returns (filtered rows, loss_weight [kept, 1], index map [kept])."""
+    a = np.asarray(_as(ins))
+    tags = np.asarray(_as(ins_tag)).reshape(len(a), -1)
+    flt = set(np.asarray(_as(filter_tag)).reshape(-1).tolist())
+    import builtins
+    keep = [i for i in builtins.range(len(a))
+            if flt & set(tags[i].reshape(-1).tolist())]
+    if not keep:
+        empty = np.full((1,) + a.shape[1:], out_val_if_empty, a.dtype)
+        return (Tensor(empty), Tensor(np.zeros((1, 1), "f4")),
+                Tensor(np.zeros((1,), "i4")))
+    idx = np.asarray(keep, "i4")
+    return (Tensor(a[idx]), Tensor(np.ones((len(keep), 1), "f4")),
+            Tensor(idx))

@@ -930,3 +930,165 @@ def dequantize_log(x, dict_table):
     vals = dict_table[jnp.where(ids < 0, ids + 128,
                                 jnp.where(ids >= 128, ids - 128, ids))]
     return jnp.where(neg, -vals, vals)
+
+
+# ------------------------------------------------ niche text/vision tail
+
+@def_op("match_matrix_tensor", n_tensor_args=3)
+def match_matrix_tensor(x, y, w):
+    """Text-matching tensor product (ref operators/match_matrix_tensor_op.cc):
+    out[b, t, i, j] = x[b, i] . W[t] . y[b, j].
+    x: [B, Lx, D1], y: [B, Ly, D2], w: [D1, T, D2] -> [B, T, Lx, Ly]."""
+    return jnp.einsum("bid,dte,bje->btij", x, w, y)
+
+
+@def_op("tree_conv", n_tensor_args=3)
+def tree_conv(nodes_vector, edge_set, filter, max_depth=2):
+    """TBCNN tree convolution (ref operators/tree_conv_op.cc +
+    math/tree2col.cc/.h — formulas matched exactly): every node's patch
+    is itself (depth 0) plus descendants while depth+1 < max_depth; each
+    member contributes through the reference's continuous-binary-tree
+    weights eta_t = (fd - depth)/fd, eta_l = (1-eta_t)*((index-1)/
+    (pclen-1) | 0.5), eta_r = (1-eta_t)*(1-eta_l), stacked in the
+    filter's k order (l, r, t). The host builds the sparse [N, N, 3]
+    patch-weight tensor; the contraction is one einsum.
+    nodes_vector: [N, F] (node ids in edge_set are 1-based like the
+    reference), edge_set: [E, 2] (parent, child; 0-rows pad),
+    filter: [F, 3, out_size, num_filters] -> [N, out_size, num_filters]."""
+    import builtins
+    import numpy as _np
+    feats = nodes_vector
+    N = feats.shape[0]
+    edges = _np.asarray(edge_set).astype(int)
+    children = {}
+    for p, c in edges:
+        if p <= 0 or c <= 0:
+            continue                     # 0-rows pad (ids are 1-based)
+        children.setdefault(int(p), []).append(int(c))
+
+    fd = float(max_depth)
+    w = _np.zeros((N, N, 3), _np.float32)      # [root, member, (l, r, t)]
+    for root in builtins.range(1, N + 1):
+        patch = [(root, 1, 1, 0)]              # (node, index, pclen, depth)
+        stack = [(root, 1, 1, 0)]
+        seen = {root}
+        while stack:
+            node, idx, pclen, depth = stack.pop()
+            if depth + 1 >= max_depth:
+                continue
+            kids = children.get(node, [])
+            for i, v in enumerate(kids):
+                if v in seen or v > N:
+                    continue
+                seen.add(v)
+                patch.append((v, i + 1, len(kids), depth + 1))
+                stack.append((v, i + 1, len(kids), depth + 1))
+        for node, idx, pclen, depth in patch:
+            eta_t = (fd - depth) / fd
+            temp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * temp
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            w[root - 1, node - 1, 0] += eta_l
+            w[root - 1, node - 1, 1] += eta_r
+            w[root - 1, node - 1, 2] += eta_t
+    wj = jnp.asarray(w)
+    # out[n, o, m] = sum_{v, k, f} w[n, v, k] * x[v, f] * filter[f, k, o, m]
+    return jnp.einsum("nvk,vf,fkom->nom", wj, feats, filter)
+
+
+@def_op("var_conv_2d", n_tensor_args=4)
+def var_conv_2d(x, row_lengths, col_lengths, filter, output_channels=1,
+                input_channels=1, stride=(1, 1), kernel=(3, 3)):
+    """Variable-size 2D conv (ref operators/var_conv_2d_op.cc, search-net):
+    dense analog — same-padding conv over the padded batch, outputs
+    masked to each sample's true (rows, cols) region so padding never
+    leaks. x: [B, C, H, W], filter: [OC, C, kh, kw]."""
+    pads = ((kernel[0] // 2,) * 2, (kernel[1] // 2,) * 2)
+    out = jax.lax.conv_general_dilated(
+        x, filter, window_strides=stride, padding=pads,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    H, W = out.shape[2], out.shape[3]
+    # lengths are input-space; output-space bound is ceil(len / stride)
+    out_rows = (row_lengths + stride[0] - 1) // stride[0]
+    out_cols = (col_lengths + stride[1] - 1) // stride[1]
+    rmask = jnp.arange(H)[None, :] < out_rows[:, None]
+    cmask = jnp.arange(W)[None, :] < out_cols[:, None]
+    m = (rmask[:, None, :, None] & cmask[:, None, None, :])
+    return jnp.where(m, out, 0.0)
+
+
+@def_op("pyramid_hash", n_tensor_args=2, differentiable=True)
+def pyramid_hash(ids, emb_table, min_win=2, max_win=3, mod_by=None):
+    """Pyramid hashing embedding (ref operators/pyramid_hash_op.cc,
+    search ranking): every n-gram window of sizes [min_win, max_win] is
+    hashed into the embedding table and the looked-up vectors are summed
+    per position. Uses the same integer mix as hash_op (documented
+    divergence from the reference's xxhash). ids: [B, T] int,
+    emb_table: [space, D] -> [B, T, D]."""
+    space = emb_table.shape[0] if mod_by is None else mod_by
+    B, T = ids.shape
+    v = ids.astype(jnp.uint32)
+
+    def mix(h):
+        for shift, mult in ((15, 0x85EBCA6B), (13, 0xC2B2AE35)):
+            h = h ^ (h >> shift)
+            h = (h * jnp.uint32(mult)) & jnp.uint32(0xFFFFFFFF)
+        return h ^ (h >> 16)
+
+    out = jnp.zeros((B, T, emb_table.shape[1]), emb_table.dtype)
+    for win in range(min_win, max_win + 1):
+        if win > T:
+            break
+        h = jnp.full((B, T - win + 1), 0x9E3779B9 & 0xFFFFFFFF, jnp.uint32)
+        for j in range(win):
+            h = mix(h ^ v[:, j:T - win + 1 + j])
+        bucket = (h % jnp.uint32(space)).astype(jnp.int32)
+        emb = emb_table[bucket]                      # [B, T-win+1, D]
+        out = out.at[:, :T - win + 1].add(emb)
+    return out
+
+
+@def_op("bilateral_slice", n_tensor_args=3)
+def bilateral_slice(grid, guide, x, has_offset=False):
+    """HDRNet bilateral-grid slicing (ref operators/bilateral_slice_op.cc):
+    per-pixel trilinear lookup of affine coefficients from a low-res
+    bilateral grid at (x/W, y/H, guide(x, y)), then apply them to the
+    input. grid: [B, coeffs, gd, gh, gw], guide: [B, H, W],
+    x: [B, Cin, H, W]. coeffs = Cout*(Cin+1) (+offset variant)."""
+    B, C, gd, gh, gw = grid.shape
+    H, W = guide.shape[1], guide.shape[2]
+    cin = x.shape[1]
+    # ref bilateral_slice_op.cc: with offset, coeffs = cout*(cin+1)
+    # (affine + bias); without, coeffs = cout*cin (pure affine)
+    cout = C // (cin + 1) if has_offset else C // cin
+
+    gx = (jnp.arange(W) + 0.5) / W * gw - 0.5        # [W]
+    gy = (jnp.arange(H) + 0.5) / H * gh - 0.5        # [H]
+    gz = guide * gd - 0.5                            # [B, H, W]
+
+    def axis_idx(c, n):
+        lo = jnp.clip(jnp.floor(c).astype(jnp.int32), 0, n - 1)
+        hi = jnp.clip(lo + 1, 0, n - 1)
+        w_ = jnp.clip(c - lo, 0.0, 1.0)
+        return lo, hi, w_
+
+    x0, x1, wx = axis_idx(gx, gw)
+    y0, y1, wy = axis_idx(gy, gh)
+    z0, z1, wz = axis_idx(gz, gd)
+
+    bi = jnp.arange(B)[:, None, None]
+    coeff = 0.0
+    for zz, wz_ in ((z0, 1.0 - wz), (z1, wz)):
+        for yy, wy_ in ((y0, 1.0 - wy), (y1, wy)):
+            for xx, wx_ in ((x0, 1.0 - wx), (x1, wx)):
+                # grid[b, :, zz[b,h,w], yy[h], xx[w]] -> [B, H, W, C]
+                g = grid[bi, :, zz, yy[None, :, None], xx[None, None, :]]
+                weight = (wz_ * wy_[None, :, None] * wx_[None, None, :]
+                          )[..., None]
+                coeff = coeff + g * weight
+    coeff = jnp.moveaxis(coeff, -1, 1)               # [B, C, H, W]
+    A = coeff[:, :cout * cin].reshape(B, cout, cin, H, W)
+    out = jnp.einsum("boihw,bihw->bohw", A, x)
+    if has_offset:
+        out = out + coeff[:, cout * cin:cout * (cin + 1)]
+    return out

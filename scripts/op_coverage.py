@@ -186,6 +186,8 @@ PYTHON_API = {
         "under jit; amp/__init__.py)",
     "update_loss_scaling": "amp.GradScaler dynamic loss-scale state machine",
     "bernoulli": "paddle.bernoulli (creation.py, explicit rng keys)",
+    "filter_by_instag": "fluid.layers.filter_by_instag (dynamic-output "
+        "host edge fn)",
     "masked_select": "ops/manipulation.masked_select (dynamic shape -> "
         "host edge fn, like nonzero)",
     "diag": "paddle.diag (creation.py)", "diag_v2": "paddle.diag",
@@ -265,11 +267,11 @@ NA_RULES = [
      "creation API with explicit keys"),
     (r"^(memcpy|fill|alloc_float_status|clear_float_status|"
      r"get_float_status)", "runtime-infra", "XLA/PJRT runtime owns"),
-    (r"^(rank_attention|batch_fc|filter_by_instag|pyramid_hash|"
-     r"var_conv_2d|tree_conv|bilateral_slice|correlation|"
-     r"match_matrix_tensor|search_seq)", "niche-cv-rec",
-     "see registered subset (batch_fc/correlation registered; "
-     "remainder documented gaps)"),
+    (r"^(rank_attention)", "contrib-gpu-only",
+     "reference's own comment: 'exists in contrib ... not shown to the "
+     "public'; PS-rec rank attention is covered by the heter-PS + "
+     "batch_fc path"),
+    (r"^(search_seq)", "niche-cv-rec", "search-net internal ops"),
 ]
 
 

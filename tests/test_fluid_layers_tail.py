@@ -149,3 +149,45 @@ def test_legacy_lod_infra_errors_are_informative():
                                  T(np.zeros((4, 6), "f4")), None,
                                  T(np.array([1, 0, 1, 0], "i4")))
     assert np.asarray(merged.numpy())[0].sum() == 6
+
+
+def test_tensor_array_to_tensor_and_filter_by_instag():
+    a, b = T(np.ones((2, 3), "f4")), T(np.full((2, 2), 2.0, "f4"))
+    out, sizes = FL.tensor_array_to_tensor([a, b])
+    assert out.shape == [2, 5]
+    assert np.asarray(sizes.numpy()).tolist() == [3, 2]
+    st, sz = FL.tensor_array_to_tensor([a, a], axis=0, use_stack=True)
+    assert st.shape == [2, 2, 3]
+    ins = T(np.arange(12, dtype="f4").reshape(4, 3))
+    tags = T(np.array([[1], [2], [1], [3]], "i4"))
+    f, w, idx = FL.filter_by_instag(ins, tags, T(np.array([1], "i4")))
+    assert np.asarray(idx.numpy()).tolist() == [0, 2]
+    np.testing.assert_allclose(np.asarray(f.numpy()),
+                               np.asarray(ins.numpy())[[0, 2]])
+    # empty-match path: sentinel row + zero loss weight
+    fe, we, _ = FL.filter_by_instag(ins, tags, T(np.array([9], "i4")))
+    assert float(np.asarray(we.numpy()).sum()) == 0.0
+
+
+def test_var_conv_and_bilateral_semantics():
+    import jax.numpy as jnp
+    from paddle_tpu.ops import legacy as OL
+    r2 = np.random.RandomState(3)
+    # stride-2 var conv: output rows beyond ceil(4/2)=2 masked
+    vc = OL.var_conv_2d.raw(jnp.asarray(r2.randn(1, 1, 6, 6).astype("f4")),
+                            jnp.asarray(np.array([4], "i4")),
+                            jnp.asarray(np.array([6], "i4")),
+                            jnp.asarray(r2.randn(1, 1, 3, 3).astype("f4")),
+                            stride=(2, 2))
+    v = np.asarray(vc)
+    assert np.allclose(v[0, :, 2:], 0) and not np.allclose(v[0, :, :2], 0)
+    # bilateral has_offset=False: pure affine, cout = C // cin
+    grid = np.zeros((1, 6, 2, 4, 4), "f4")
+    A = r2.randn(3, 2).astype("f4")
+    grid[0] = A.reshape(-1)[:, None, None, None]
+    xin = r2.randn(1, 2, 8, 8).astype("f4")
+    out = OL.bilateral_slice.raw(jnp.asarray(grid),
+                                 jnp.asarray(np.full((1, 8, 8), 0.5, "f4")),
+                                 jnp.asarray(xin), has_offset=False)
+    want = np.einsum("oi,bihw->bohw", A, xin)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)

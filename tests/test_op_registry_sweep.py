@@ -877,6 +877,18 @@ SPECS = {
                                np.array([4], "i4"), np.array([1], "i4")],
                               {"resolution": 8}, grad=False, out0=True,
                               desc=False),   # host rasterizer
+    # --- niche text/vision tail ---
+    "match_matrix_tensor": S([F32((2, 3, 4), 1), F32((2, 5, 6), 2),
+                              F32((4, 2, 6), 3)]),
+    "tree_conv": S([F32((3, 4), 1), np.array([[1, 2], [1, 3]], "i4"),
+                    F32((4, 3, 5, 2), 2)],
+                   {"max_depth": 2}, desc=False),   # host patch build
+    "var_conv_2d": S([F32((2, 1, 6, 6), 1), np.array([4, 6], "i4"),
+                      np.array([3, 6], "i4"), F32((2, 1, 3, 3), 2)]),
+    "pyramid_hash": S([I32((2, 6), hi=100), F32((50, 8), 1)]),
+    "bilateral_slice": S([F32((1, 9, 2, 4, 4), 1),
+                          POS((1, 8, 8), 2) * 0.5,
+                          F32((1, 2, 8, 8), 3)]),
     # --- fluid-era rnn cell ops (nn/rnn.py) ---
     "gru_unit": S([F32((2, 12), 1), F32((2, 4), 2), F32((4, 12), 3),
                    F32((1, 12), 4)], out0=True),
