@@ -202,6 +202,7 @@ import paddle_tpu.quantization         # noqa: F401  (fake_quantize_dequantize)
 import paddle_tpu.nn.rnn               # noqa: F401  (lstm/gru/simple_rnn_seq)
 import paddle_tpu.ops.sequence         # noqa: F401  (sequence tail)
 import paddle_tpu.fluid.layers         # noqa: F401  (accuracy)
+import paddle_tpu.static.quant_pass    # noqa: F401  (quantized_matmul)
 from paddle_tpu.ops.dispatch import OP_REGISTRY, apply as _apply
 from paddle_tpu.static import desc as D
 
@@ -877,6 +878,16 @@ SPECS = {
                                np.array([4], "i4"), np.array([1], "i4")],
                               {"resolution": 8}, grad=False, out0=True,
                               desc=False),   # host rasterizer
+    # --- true-int8 inference ops (static/quant_pass.py) ---
+    "quantized_matmul": S([F32((2, 4), 1),
+                           (np.clip(np.round(np.random.RandomState(2)
+                            .randn(4, 3) * 40), -127, 127)).astype("i1")],
+                          {"x_scale": 2.0, "w_scale": 1.5}, grad=False),
+    "quantized_linear": S([F32((2, 4), 1),
+                           (np.clip(np.round(np.random.RandomState(2)
+                            .randn(4, 3) * 40), -127, 127)).astype("i1"),
+                           F32((3,), 3)],
+                          {"x_scale": 2.0, "w_scale": 1.5}, grad=False),
     # --- niche text/vision tail ---
     "match_matrix_tensor": S([F32((2, 3, 4), 1), F32((2, 5, 6), 2),
                               F32((4, 2, 6), 3)]),
