@@ -207,3 +207,42 @@ def test_convert_to_int8_true_execution():
                      {k: t._data for k, t in prog._persist.items()},
                      jax.random.PRNGKey(0))
     np.testing.assert_allclose(np.asarray(outs[0]), q8, rtol=1e-5)
+
+
+def test_int8_save_load_inference_model():
+    """The int8-converted program ships through the standard two-artifact
+    serving IO (save/load_inference_model) and replays identically —
+    the serving artifact carries only quantized ops + int8 consts."""
+    import tempfile
+    import os
+    import jax  # noqa: F401
+    from paddle_tpu.static.quant_pass import (
+        QuantizationTransformPass, collect_activation_scales,
+        apply_calibration, ConvertToInt8Pass)
+    from paddle_tpu.static.io import (save_inference_model,
+                                      load_inference_model)
+    import paddle_tpu.fluid.layers as FL
+    from paddle_tpu import static
+
+    r = np.random.RandomState(0)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 16], "float32")
+        FL.reset_parameters()
+        y = FL.fc(FL.fc(x, 32, act="relu", name="sv1"), 8, name="sv2")
+    yname = prog.recorder.name_of(y)
+    exe = static.Executor()
+    feeds = [{"x": r.randn(4, 16).astype("f4")} for _ in range(3)]
+    QuantizationTransformPass().apply(prog)
+    apply_calibration(prog, collect_activation_scales(prog, feeds))
+    ConvertToInt8Pass().apply(prog)
+    (q8,) = exe.run(prog, feed=feeds[0], fetch_list=[yname])
+
+    d = tempfile.mkdtemp()
+    save_inference_model(os.path.join(d, "int8_model"), [x], [y], exe, prog)
+    prog2, feed_names, fetch_names = load_inference_model(
+        os.path.join(d, "int8_model"), exe)
+    (q8b,) = exe.run(prog2, feed=feeds[0], fetch_list=fetch_names)
+    np.testing.assert_allclose(q8b, q8, rtol=1e-5)
+    assert sorted({op.type for op in prog2.desc.ops}) == [
+        "quantized_linear", "relu"]
