@@ -7,6 +7,22 @@ from ..optimizer.wrappers import (ModelAverage,  # noqa: F401
                                   LookaheadOptimizer as LookAhead)
 
 
+def _segment(pool_type):
+    def fn(data, segment_ids, name=None):
+        """ref python/paddle/incubate/tensor/math.py segment_{sum,mean,
+        max,min} over the registered segment_pool op (ops/legacy.py)."""
+        from ..ops.legacy import segment_pool
+        return segment_pool(data, segment_ids, pool_type=pool_type)
+    fn.__name__ = f"segment_{pool_type.lower()}"
+    return fn
+
+
+segment_sum = _segment("SUM")
+segment_mean = _segment("MEAN")
+segment_max = _segment("MAX")
+segment_min = _segment("MIN")
+
+
 def __getattr__(name):
     if name == "moe":
         import importlib
