@@ -8,11 +8,13 @@ from ..optimizer.wrappers import (ModelAverage,  # noqa: F401
 
 
 def _segment(pool_type):
-    def fn(data, segment_ids, name=None):
+    def fn(data, segment_ids, name=None, num_segments=None):
         """ref python/paddle/incubate/tensor/math.py segment_{sum,mean,
-        max,min} over the registered segment_pool op (ops/legacy.py)."""
+        max,min} over the registered segment_pool op (ops/legacy.py).
+        Pass num_segments explicitly under jit tracing (static shapes)."""
         from ..ops.legacy import segment_pool
-        return segment_pool(data, segment_ids, pool_type=pool_type)
+        return segment_pool(data, segment_ids, pool_type=pool_type,
+                            num_segments=num_segments)
     fn.__name__ = f"segment_{pool_type.lower()}"
     return fn
 

@@ -223,16 +223,19 @@ def segment_pool(x, segment_ids, pool_type="SUM", num_segments=None):
         num_segments = int(np.asarray(segment_ids)[-1]) + 1
     pt = pool_type.upper()
     ids = segment_ids.astype(jnp.int32)
+    counts = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
+                                 num_segments)
+    bshape = (num_segments,) + (1,) * (x.ndim - 1)
     if pt == "SUM":
         return jax.ops.segment_sum(x, ids, num_segments)
     if pt == "MEAN":
         s = jax.ops.segment_sum(x, ids, num_segments)
-        n = jax.ops.segment_sum(jnp.ones_like(x[..., :1]), ids, num_segments)
-        return s / jnp.maximum(n, 1.0)
-    if pt == "MAX":
-        return jax.ops.segment_max(x, ids, num_segments)
-    if pt == "MIN":
-        return jax.ops.segment_min(x, ids, num_segments)
+        return s / jnp.maximum(counts, 1.0).reshape(bshape)
+    if pt in ("MAX", "MIN"):
+        fn = jax.ops.segment_max if pt == "MAX" else jax.ops.segment_min
+        out = fn(x, ids, num_segments)
+        # reference segment_pool fills EMPTY segments with 0, not +-inf
+        return jnp.where((counts > 0).reshape(bshape), out, 0.0)
     raise ValueError(f"unknown pool_type {pool_type}")
 
 

@@ -118,3 +118,35 @@ def test_epoch_range_restores_weights(tmp_path):
     r3 = TrainEpochRange(2, root, model=m3, name="j2")
     assert r3.restored_from == 0
     np.testing.assert_allclose(m3.fc.weight.numpy(), 7.0)
+
+
+def test_incubate_segment_api():
+    """paddle.incubate.segment_* semantics: 1-D data, empty segments fill
+    0 (reference kernel behavior), num_segments escape hatch for jit."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+
+    x = pt.to_tensor(np.arange(10, dtype="f4").reshape(5, 2))
+    ids = pt.to_tensor(np.array([0, 0, 1, 1, 2], "i4"))
+    np.testing.assert_allclose(np.asarray(pt.incubate.segment_sum(x, ids)
+                                          .numpy()),
+                               [[2, 4], [10, 12], [8, 9]])
+    np.testing.assert_allclose(np.asarray(pt.incubate.segment_mean(x, ids)
+                                          .numpy())[0], [1, 2])
+    # 1-D data
+    m = pt.incubate.segment_mean(pt.to_tensor(np.array([1., 2., 3.], "f4")),
+                                 pt.to_tensor(np.array([0, 0, 1], "i4")))
+    np.testing.assert_allclose(np.asarray(m.numpy()), [1.5, 3.0])
+    # empty segment fills 0 for max/min
+    mx = pt.incubate.segment_max(pt.to_tensor(np.array([-1., -2., -3.],
+                                                       "f4")),
+                                 pt.to_tensor(np.array([0, 0, 2], "i4")))
+    np.testing.assert_allclose(np.asarray(mx.numpy()), [-1.0, 0.0, -3.0])
+    # traced path with explicit num_segments
+    from paddle_tpu.ops.legacy import segment_pool
+    out = jax.jit(lambda d, i: segment_pool.raw(
+        d, i, pool_type="SUM", num_segments=3))(
+        jnp.arange(6, dtype=jnp.float32), jnp.array([0, 0, 1, 1, 2, 2]))
+    np.testing.assert_allclose(np.asarray(out), [1, 5, 9])
