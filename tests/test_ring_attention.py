@@ -139,3 +139,39 @@ def test_ring_per_device_sequence_shard():
     assert f"{shard},16" in txt.replace(" ", ""), \
         "no seq/8-sized operand in partitioned HLO"
     assert "collective-permute" in txt, "ring ppermute missing"
+
+
+def test_ring_memory_advantage_xla_analysis():
+    """Per-device compiled memory (XLA memory_analysis, grad included) of
+    ring attention over sp=8 must beat the sequence-replicated dense
+    step — the reason sequence parallelism exists (docs/perf/LONGCTX.md
+    carries the full-scale table)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P, NamedSharding
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.ring_attention import ring_attention
+    from paddle_tpu.ops.pallas.flash_attention import _flash_array
+
+    m = Mesh(np.array(jax.devices()[:8]).reshape(8), ("sp",))
+    mesh_mod.set_mesh(m)
+    try:
+        q = jnp.zeros((1, 4, 2048, 32), jnp.float32)
+        shard = NamedSharding(m, P(None, None, "sp", None))
+        repl = NamedSharding(m, P())
+
+        def peak(fn, sh):
+            g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)),
+                        in_shardings=(sh, sh, sh))
+            ma = g.lower(q, q, q).compile().memory_analysis()
+            return (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                    + ma.output_size_in_bytes)
+
+        p_ring = peak(lambda a, b, c: ring_attention(
+            a, b, c, causal=True).sum(), shard)
+        p_dense = peak(lambda a, b, c: _flash_array(
+            a, b, c, causal=True).sum(), repl)
+        assert p_ring < p_dense * 0.6, (p_ring, p_dense)
+    finally:
+        mesh_mod.set_mesh(None)
