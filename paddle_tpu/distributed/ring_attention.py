@@ -11,9 +11,11 @@ pipeline). TPU-native design (PAPERS.md Ring Attention, arXiv:2310.01889):
     ever materialises more than an (S/n x S/n) score block.
   - The K/V rotation is expressed as a `lax.scan`, so XLA's latency-hiding
     scheduler overlaps each ppermute with the next block's compute.
-  - Backward is plain autodiff through the scan with `jax.checkpoint` around
-    the per-block kernel: score blocks are recomputed, keeping the backward
-    memory at the same (S/n)^2 footprint.
+  - Backward is a hand-rolled SECOND ring pass (custom_vjp): dk/dv
+    accumulators travel with their k/v shards around the ring and arrive
+    home after n hops; block probabilities are recomputed from the saved
+    global logsumexp, so residuals are strictly local O(S/n) — the scan's
+    per-step k/v carries are never saved.
 
 Communication rides the 'sp' ring only; composes freely with 'dp' (batch),
 'mp' (heads/hidden via GSPMD outside the shard_map), and 'pp'.
@@ -29,19 +31,27 @@ from jax.sharding import PartitionSpec as P
 from . import mesh as mesh_mod
 
 
+def _masked_scores(q, k, scale, causal, q_off, k_off):
+    """Scaled (+causally masked) scores — the ONE definition both the
+    forward ring and the hand-rolled backward recompute from, so the
+    gradient's probabilities can never drift from the forward's."""
+    sq, sk = q.shape[-2], k.shape[-2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = q_off + jnp.arange(sq)[:, None]
+        ki = k_off + jnp.arange(sk)[None, :]
+        s = jnp.where(ki <= qi, s, -jnp.inf)
+    return s
+
+
 def _block_attn(q, k, v, scale, causal, q_off, k_off):
     """One attention block. q:[B,H,Sq,D], k/v:[B,H,Sk,D] ->
     (normalised block output [B,H,Sq,D], logsumexp [B,H,Sq]).
 
     q_off/k_off are the global sequence offsets of the shards (k_off is
     traced — it depends on the ring step)."""
-    sq, sk = q.shape[-2], k.shape[-2]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
-    if causal:
-        qi = q_off + jnp.arange(sq)[:, None]
-        ki = k_off + jnp.arange(sk)[None, :]
-        s = jnp.where(ki <= qi, s, -jnp.inf)
+    s = _masked_scores(q, k, scale, causal, q_off, k_off)
     m = jnp.max(s, axis=-1, keepdims=True)
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)   # fully-masked rows
     p = jnp.exp(s - m_safe)
@@ -63,18 +73,18 @@ def _merge(o1, lse1, o2, lse2):
     return o1 * w1[..., None] + o2 * w2[..., None], lse
 
 
-def ring_attention_shard(q, k, v, *, axis_name, causal, scale):
-    """Per-shard body (call inside shard_map). q/k/v: local [B,H,S/n,D]."""
+def _ring_forward(q, k, v, axis_name, causal, scale):
+    """Forward ring pass. Returns (o [B,H,S/n,D] f32, lse [B,H,S/n])."""
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     s_loc = q.shape[-2]
-    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     q_off = me * s_loc
     qf = q.astype(jnp.float32) if q.dtype != jnp.float32 else q
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     block = jax.checkpoint(
-        functools.partial(_block_attn, scale=sc, causal=causal, q_off=q_off))
+        functools.partial(_block_attn, scale=scale, causal=causal,
+                          q_off=q_off))
 
     def body(carry, t):
         k_cur, v_cur, o, lse = carry
@@ -88,7 +98,95 @@ def ring_attention_shard(q, k, v, *, axis_name, causal, scale):
     o0 = jnp.zeros(q.shape, jnp.float32)
     lse0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
     (k, v, o, lse), _ = lax.scan(body, (k, v, o0, lse0), jnp.arange(n))
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_shard_cvjp(q, k, v, axis_name, causal, scale):
+    o, _ = _ring_forward(q, k, v, axis_name, causal, scale)
     return o.astype(q.dtype)
+
+
+def _ring_cvjp_fwd(q, k, v, axis_name, causal, scale):
+    o, lse = _ring_forward(q, k, v, axis_name, causal, scale)
+    # residuals are strictly LOCAL O(S/n) — the rotation is re-run in
+    # backward instead of saving every scan step's k/v carry (which would
+    # be the full sequence per device)
+    return o.astype(q.dtype), (q, k, v, o, lse)
+
+
+def _ring_cvjp_bwd(axis_name, causal, scale, res, do):
+    """Second ring pass (PAPERS.md Ring Attention backward): dk/dv
+    accumulators travel WITH their k/v shards around the ring, arriving
+    home after n hops; dq stays local. Block probabilities are recomputed
+    from the saved global logsumexp — the flash-attention backward
+    identity ds = p * (dp - rowsum(do*o))."""
+    q, k, v, o, lse = res
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    s_loc = q.shape[-2]
+    q_off = me * s_loc
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    Dvec = jnp.sum(dof * o, axis=-1)                      # [B,H,Sq]
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+    live = jnp.isfinite(lse)[..., None]                   # masked-out rows
+
+    def one_block(k_c, v_c, k_off):
+        s = _masked_scores(qf, k_c, scale, causal, q_off, k_off)
+        p = jnp.where(live, jnp.exp(s - lse_safe[..., None]), 0.0)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_c.astype(jnp.float32))
+        ds = p * (dp - Dvec[..., None])
+        dq_b = jnp.einsum("bhqk,bhkd->bhqd", ds,
+                          k_c.astype(jnp.float32)) * scale
+        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        return dq_b, dk_b, dv_b
+
+    def body(carry, t):
+        k_c, v_c, dk_c, dv_c, dq = carry
+        src = jnp.mod(me - t, n)
+        dq_b, dk_b, dv_b = one_block(k_c, v_c, src * s_loc)
+        dq = dq + dq_b
+        dk_c = dk_c + dk_b
+        dv_c = dv_c + dv_b
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        dk_c = lax.ppermute(dk_c, axis_name, perm)
+        dv_c = lax.ppermute(dv_c, axis_name, perm)
+        return (k_c, v_c, dk_c, dv_c, dq), None
+
+    zeros_kv = jnp.zeros(k.shape, jnp.float32)
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    (_, _, dk, dv, dq), _ = lax.scan(
+        body, (k, v, zeros_kv, zeros_kv, dq0), jnp.arange(n))
+    # n rotations of +1 bring each shard (and its grad) back home
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_shard_cvjp.defvjp(_ring_cvjp_fwd, _ring_cvjp_bwd)
+
+
+def ring_attention_shard(q, k, v, *, axis_name, causal, scale):
+    """Per-shard body (call inside shard_map). q/k/v: local [B,H,S/n,D]."""
+    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _ring_shard_cvjp(q, k, v, axis_name, causal, sc)
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_ring(mesh, axis_name, causal, scale, batch_axis, head_axis):
+    """One jitted shard_map per (mesh, config) — jax.jit caches on callable
+    identity, so rebuilding the closure per call would recompile every
+    attention layer every step."""
+    spec = P(batch_axis, head_axis, axis_name, None)
+    f = jax.shard_map(
+        functools.partial(ring_attention_shard, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    # jit: custom_vjp calls inside shard_map are not eagerly evaluable
+    return jax.jit(f)
 
 
 def ring_attention(q, k, v, causal=False, scale=None,
@@ -115,12 +213,11 @@ def ring_attention(q, k, v, causal=False, scale=None,
     head_axis = mesh_mod.MP_AXIS if (
         mesh_mod.MP_AXIS in mesh.axis_names
         and q.shape[1] % int(mesh.shape[mesh_mod.MP_AXIS]) == 0) else None
-    spec = P(batch_axis, head_axis, axis_name, None)
-    f = jax.shard_map(
-        functools.partial(ring_attention_shard, axis_name=axis_name,
-                          causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+    # scale is a nondiff static of the custom_vjp: it must be a python
+    # float (a traced scale would leak into the bwd rule)
+    scale_f = None if scale is None else float(scale)
+    f = _jitted_ring(mesh, axis_name, bool(causal), scale_f, batch_axis,
+                     head_axis)
     return f(q, k, v)
 
 

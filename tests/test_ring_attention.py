@@ -172,6 +172,8 @@ def test_ring_memory_advantage_xla_analysis():
             a, b, c, causal=True).sum(), shard)
         p_dense = peak(lambda a, b, c: _flash_array(
             a, b, c, causal=True).sum(), repl)
-        assert p_ring < p_dense * 0.6, (p_ring, p_dense)
+        # hand-rolled ring backward: strictly local residuals (the
+        # autodiff-through-scan baseline sat at ~0.35x dense here)
+        assert p_ring < p_dense * 0.25, (p_ring, p_dense)
     finally:
         mesh_mod.set_mesh(None)
