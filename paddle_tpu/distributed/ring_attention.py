@@ -73,6 +73,57 @@ def _merge(o1, lse1, o2, lse2):
     return o1 * w1[..., None] + o2 * w2[..., None], lse
 
 
+_KV_CHUNK = 512        # flash-style tile inside a ring block: working
+                       # set is chunk x S_loc instead of S_loc^2
+
+
+def _split_kv_chunks(k, v):
+    """Shared tiling split for forward AND backward: full _KV_CHUNK tiles
+    scan-major ([nch, ..., chunk, D]) plus an optional remainder tail, so
+    the linear-memory guarantee holds for EVERY shard length.
+    Returns (kc, vc, offs, k_tail, v_tail, tail_off) — kc is None when
+    the shard fits in one tile."""
+    sk = k.shape[-2]
+    nch, rem = divmod(sk, _KV_CHUNK)
+    if nch == 0 or (nch == 1 and rem == 0):
+        return None, None, None, k, v, 0
+    head = nch * _KV_CHUNK
+    kc = jnp.moveaxis(
+        k[..., :head, :].reshape(k.shape[:-2] + (nch, _KV_CHUNK,
+                                                 k.shape[-1])), -3, 0)
+    vc = jnp.moveaxis(
+        v[..., :head, :].reshape(v.shape[:-2] + (nch, _KV_CHUNK,
+                                                 v.shape[-1])), -3, 0)
+    offs = jnp.arange(nch) * _KV_CHUNK
+    k_tail = k[..., head:, :] if rem else None
+    v_tail = v[..., head:, :] if rem else None
+    return kc, vc, offs, k_tail, v_tail, head
+
+
+def _block_attn_tiled(q, k, v, scale, causal, q_off, k_off):
+    """_block_attn with the k/v axis tiled by _KV_CHUNK (online-softmax
+    merge per tile) so the score working set stays O(S_loc * chunk)."""
+    kc, vc, offs, k_tail, v_tail, tail_off = _split_kv_chunks(k, v)
+    if kc is None:
+        return _block_attn(q, k_tail, v_tail, scale, causal, q_off, k_off)
+
+    def body(carry, inp):
+        o, lse = carry
+        k_t, v_t, off = inp
+        o_b, lse_b = _block_attn(q, k_t, v_t, scale, causal, q_off,
+                                 k_off + off)
+        return _merge(o, lse, o_b, lse_b), None
+
+    o0 = jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32)
+    lse0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    (o, lse), _ = lax.scan(body, (o0, lse0), (kc, vc, offs))
+    if k_tail is not None:
+        o_b, lse_b = _block_attn(q, k_tail, v_tail, scale, causal, q_off,
+                                 k_off + tail_off)
+        o, lse = _merge(o, lse, o_b, lse_b)
+    return o, lse
+
+
 def _ring_forward(q, k, v, axis_name, causal, scale):
     """Forward ring pass. Returns (o [B,H,S/n,D] f32, lse [B,H,S/n])."""
     n = lax.axis_size(axis_name)
@@ -83,7 +134,7 @@ def _ring_forward(q, k, v, axis_name, causal, scale):
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     block = jax.checkpoint(
-        functools.partial(_block_attn, scale=scale, causal=causal,
+        functools.partial(_block_attn_tiled, scale=scale, causal=causal,
                           q_off=q_off))
 
     def body(carry, t):
@@ -133,7 +184,7 @@ def _ring_cvjp_bwd(axis_name, causal, scale, res, do):
     lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
     live = jnp.isfinite(lse)[..., None]                   # masked-out rows
 
-    def one_block(k_c, v_c, k_off):
+    def one_tile(k_c, v_c, k_off):
         s = _masked_scores(qf, k_c, scale, causal, q_off, k_off)
         p = jnp.where(live, jnp.exp(s - lse_safe[..., None]), 0.0)
         dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_c.astype(jnp.float32))
@@ -142,6 +193,32 @@ def _ring_cvjp_bwd(axis_name, causal, scale, res, do):
                           k_c.astype(jnp.float32)) * scale
         dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
         dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        return dq_b, dk_b, dv_b
+
+    def one_block(k_c, v_c, k_off):
+        """Tiled like the forward (same _split_kv_chunks): dk/dv come back
+        chunk-stacked and are re-folded, dq accumulates across tiles."""
+        kc, vc, offs, k_tail, v_tail, tail_off = _split_kv_chunks(k_c, v_c)
+        if kc is None:
+            return one_tile(k_tail, v_tail, k_off)
+
+        def body(dq_acc, inp):
+            k_t, v_t, off = inp
+            dq_b, dk_t, dv_t = one_tile(k_t, v_t, k_off + off)
+            return dq_acc + dq_b, (dk_t, dv_t)
+
+        dq_b, (dks, dvs) = lax.scan(
+            body, jnp.zeros(q.shape, jnp.float32), (kc, vc, offs))
+        head = offs.shape[0] * _KV_CHUNK
+        dk_b = jnp.moveaxis(dks, 0, -3).reshape(
+            k_c.shape[:-2] + (head, k_c.shape[-1]))
+        dv_b = jnp.moveaxis(dvs, 0, -3).reshape(
+            v_c.shape[:-2] + (head, v_c.shape[-1]))
+        if k_tail is not None:
+            dq_t, dk_t, dv_t = one_tile(k_tail, v_tail, k_off + tail_off)
+            dq_b = dq_b + dq_t
+            dk_b = jnp.concatenate([dk_b, dk_t], axis=-2)
+            dv_b = jnp.concatenate([dv_b, dv_t], axis=-2)
         return dq_b, dk_b, dv_b
 
     def body(carry, t):
