@@ -1,0 +1,190 @@
+"""Tier-a on-chip probe: Mosaic compile-check every Pallas kernel.
+
+Runs each kernel's AOT lowering+compile (jit(...).lower(avals).compile())
+at the REAL bench shapes in a separate killable subprocess, so a wedged
+tunnel or a Mosaic rejection on one kernel never blocks the rest. No
+input data is transferred (abstract avals only) — this is the cheapest
+possible way to bank a pass/fail for the round-3 kernel work
+(BSHD-layout flash fwd/bwd, chunked CE) during a short tunnel window.
+
+Writes one JSON line per kernel to stdout and the aggregate to
+docs/perf/mosaic_check.json. Exit 0 iff every kernel compiled.
+
+Usage:
+  python scripts/mosaic_check.py            # all kernels, subprocess each
+  python scripts/mosaic_check.py --one NAME # single kernel, in-process
+"""
+import functools
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# (name, builder) registry; builders return (fn, avals) for AOT lowering.
+# Shapes mirror bench.py's on-TPU gpt2s config (b=8 h=12 s=1024 d=64,
+# vocab 32768 hidden 768) plus the longctx 4k row.
+CHECKS = {}
+
+
+def check(name):
+    def deco(fn):
+        CHECKS[name] = fn
+        return fn
+    return deco
+
+
+def _sds(shape, dtype):
+    import jax
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _flash(layout, with_bwd, s=1024, b=8, h=12, d=64):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import _flash_array
+
+    shape = (b, s, h, d) if layout == "bshd" else (b, h, s, d)
+    avals = [_sds(shape, jnp.bfloat16)] * 3
+
+    def fwd(q, k, v):
+        return _flash_array(q, k, v, causal=True, layout=layout)
+
+    if not with_bwd:
+        return fwd, avals
+
+    def step(q, k, v):
+        return jax.grad(
+            lambda *a: fwd(*a).astype(jnp.float32).sum(), argnums=(0, 1, 2)
+        )(q, k, v)
+
+    return step, avals
+
+
+@check("flash_fwd_bhsd")
+def _c1():
+    return _flash("bhsd", False)
+
+
+@check("flash_fwd_bshd")
+def _c2():
+    return _flash("bshd", False)
+
+
+@check("flash_bwd_bhsd")
+def _c3():
+    return _flash("bhsd", True)
+
+
+@check("flash_bwd_bshd")
+def _c4():
+    return _flash("bshd", True)
+
+
+@check("flash_bwd_bshd_4k")
+def _c5():
+    return _flash("bshd", True, s=4096, b=1)
+
+
+@check("flash_bwd_bshd_8k")
+def _c6():
+    return _flash("bshd", True, s=8192, b=1)
+
+
+@check("chunked_ce")
+def _c7():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.chunked_ce import chunked_lm_loss
+
+    avals = [_sds((8192, 768), jnp.bfloat16),
+             _sds((32768, 768), jnp.bfloat16),
+             _sds((8192,), jnp.int32)]
+
+    def step(hid, w, lab):
+        loss, grads = jax.value_and_grad(
+            lambda h_, w_: chunked_lm_loss(h_, w_, lab), argnums=(0, 1)
+        )(hid, w)
+        return loss, grads
+
+    return step, avals
+
+
+def run_one(name):
+    import jax
+    cache = os.path.join(REPO, ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    backend = jax.default_backend()
+    fn, avals = CHECKS[name]()
+    t0 = time.time()
+    compiled = jax.jit(fn).lower(*avals).compile()
+    dt = time.time() - t0
+    flops = (compiled.cost_analysis() or {}).get("flops", 0)
+    # a CPU-backend "compile" is interpret-mode Pallas — NOT a Mosaic
+    # verdict (the tunnel can drop between the watchdog probe and this
+    # child); record it as such so it never banks a false pass
+    status = "ok" if backend != "cpu" else "cpu-fallback"
+    return {"kernel": name, "status": status, "backend": backend,
+            "compile_s": round(dt, 1), "flops": flops}
+
+
+def main():
+    if "--one" in sys.argv:
+        name = sys.argv[sys.argv.index("--one") + 1]
+        try:
+            rec = run_one(name)
+        except Exception as e:
+            rec = {"kernel": name, "status": "fail",
+                   "error": f"{type(e).__name__}: {str(e)[:2000]}"}
+        print(json.dumps(rec), flush=True)
+        sys.exit(0 if rec["status"] == "ok" else 1)
+
+    per_to = int(os.environ.get("MOSAIC_CHECK_TIMEOUT", 600))
+    results = []
+    for name in CHECKS:
+        t0 = time.time()
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one", name],
+                capture_output=True, text=True, timeout=per_to)
+            lines = p.stdout.strip().splitlines()
+            rec = None
+            if lines:
+                try:
+                    rec = json.loads(lines[-1])
+                except json.JSONDecodeError:
+                    rec = None
+            if not isinstance(rec, dict) or "status" not in rec:
+                # empty/garbled stdout (segfault, OOM-kill mid-compile)
+                rec = {"kernel": name, "status": "fail",
+                       "error": f"rc={p.returncode} "
+                                f"stderr={p.stderr[-1500:]}"}
+        except subprocess.TimeoutExpired:
+            rec = {"kernel": name, "status": "timeout",
+                   "elapsed_s": round(time.time() - t0, 1)}
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+
+    out = os.path.join(REPO, "docs", "perf", "mosaic_check.json")
+    ok = all(r["status"] == "ok" for r in results)
+    # bankable = every kernel reached a REAL Mosaic verdict (compiled on
+    # a non-cpu backend, pass or fail). Timeouts and cpu-fallbacks mean
+    # the tunnel dropped mid-battery: the watchdog must retry, not bank.
+    bankable = all(r["status"] in ("ok", "fail") for r in results)
+    with open(out, "w") as f:
+        json.dump({"ok": ok, "bankable": bankable, "results": results,
+                   "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())}, f, indent=1)
+    print(json.dumps({"summary": "mosaic_check",
+                      "ok": ok, "bankable": bankable,
+                      "passed": sum(r["status"] == "ok" for r in results),
+                      "total": len(results)}), flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -46,9 +46,27 @@ run_step() {  # run_step <name> <timeout_s> <cmd...>; rc 0 = step done
   return 1
 }
 
+run_mosaic() {  # tier-a: compile-only Mosaic check; done = verdict banked
+  [ -f ".probe/done_mosaic" ] && return 0
+  note "tier-a mosaic_check starting"
+  timeout 4500 python scripts/mosaic_check.py \
+    > docs/perf/capture_mosaic.log 2>&1
+  # a Mosaic REJECTION is still a banked verdict; retry when any kernel
+  # hit a timeout/cpu-fallback (tunnel drop mid-battery => not bankable)
+  if grep -q '"bankable": true' docs/perf/capture_mosaic.log; then
+    touch ".probe/done_mosaic"
+    note "tier-a DONE: $(grep '"summary"' docs/perf/capture_mosaic.log)"
+    return 0
+  fi
+  note "tier-a incomplete (tunnel drop?)"
+  return 1
+}
+
 while :; do
   if probe; then
     note "TUNNEL UP — running battery"
+    run_mosaic || { sleep 60; continue; }
+    probe || continue
     run_step bench       2400 python bench.py                         || { sleep 60; continue; }
     probe || continue
     run_step sweep_gpt   2400 python scripts/bench_sweep.py gpt 8     || { sleep 60; continue; }
