@@ -756,7 +756,8 @@ def pad2d(input, paddings, mode="constant", pad_value=0.0,
 def resize_bilinear(input, out_shape=None, scale=None, name=None,
                     align_corners=True, align_mode=1):
     return F.interpolate(input, size=out_shape, scale_factor=scale,
-                         mode="bilinear", align_corners=align_corners)
+                         mode="bilinear", align_corners=align_corners,
+                         align_mode=align_mode)
 
 
 def resize_nearest(input, out_shape=None, scale=None, name=None,
@@ -1262,7 +1263,7 @@ def resize_linear(input, out_shape=None, scale=None, name=None,
                   align_corners=True, align_mode=1, data_format="NCW"):
     return F.interpolate(input, size=out_shape, scale_factor=scale,
                          mode="linear", align_corners=align_corners,
-                         data_format=data_format)
+                         align_mode=align_mode, data_format=data_format)
 
 
 def resize_trilinear(input, out_shape=None, scale=None, name=None,
@@ -1270,7 +1271,7 @@ def resize_trilinear(input, out_shape=None, scale=None, name=None,
                      data_format="NCDHW"):
     return F.interpolate(input, size=out_shape, scale_factor=scale,
                          mode="trilinear", align_corners=align_corners,
-                         data_format=data_format)
+                         align_mode=align_mode, data_format=data_format)
 
 
 def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
@@ -1305,8 +1306,13 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     w_ = int(np.prod(weight.shape)) // h
     u = _get_param(name + ".u", (h,), I.Normal(0.0, 1.0))
     v = _get_param(name + ".v", (w_,), I.Normal(0.0, 1.0))
-    return _legacy("spectral_norm_op")(weight, u, v, dim=dim,
-                                       power_iters=power_iters, eps=eps)
+    out, u_new, v_new = _legacy("spectral_norm_op")(
+        weight, u, v, dim=dim, power_iters=power_iters, eps=eps)
+    # persist the advanced power-iteration state (the reference kernel
+    # updates U/V in place, so sigma converges across calls)
+    u.set_value(u_new)
+    v.set_value(v_new)
+    return out
 
 
 # --- misc tensor / legacy infra ---

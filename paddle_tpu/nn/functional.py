@@ -1187,22 +1187,27 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
                   "d": list(_norm_tuple(dilations, 2))}, name="unfold")
 
 
-def _interp_axis_coords(out_n, in_n, align_corners):
+def _interp_axis_coords(out_n, in_n, align_corners, align_mode=0):
     """Source coordinates for each output index along one axis.
     align_corners=True maps endpoints to endpoints (ref interpolate_op.h
     align_corners branch; ratio 0 when out_n <= 1, selecting pixel 0);
-    False uses half-pixel centers."""
+    False uses half-pixel centers when align_mode=0, or the fluid
+    asymmetric rule src = i * in/out when align_mode=1 (the reference's
+    `align_flag = align_mode == 0 && !align_corners` gate — the default
+    for the 1.x resize_bilinear/resize_trilinear builders)."""
     if align_corners:
         ratio = (in_n - 1) / (out_n - 1) if out_n > 1 else 0.0
         return jnp.arange(out_n) * ratio
     scale = in_n / out_n
+    if align_mode == 1:
+        return jnp.arange(out_n) * scale
     return jnp.maximum((jnp.arange(out_n) + 0.5) * scale - 0.5, 0.0)
 
 
-def _interp_linear_1axis(a, axis, out_n, align_corners):
+def _interp_linear_1axis(a, axis, out_n, align_corners, align_mode=0):
     """Linear resample of one axis by gather + lerp (any rank)."""
     in_n = a.shape[axis]
-    c = _interp_axis_coords(out_n, in_n, align_corners)
+    c = _interp_axis_coords(out_n, in_n, align_corners, align_mode)
     lo = jnp.clip(jnp.floor(c).astype(jnp.int32), 0, in_n - 1)
     hi = jnp.clip(lo + 1, 0, in_n - 1)
     w = (c - lo).astype(a.dtype)
@@ -1262,7 +1267,8 @@ def _interp_cubic_1axis(a, axis, out_n, align_corners):
 
 
 def _interpolate_raw(a, size=None, scale_factor=None, mode="nearest",
-                     channels_last=False, align_corners=False):
+                     channels_last=False, align_corners=False,
+                     align_mode=0):
     """All reference interp op families on one raw (ref operators/
     interpolate_op.cc + interpolate_v2: linear [NCW], bilinear/nearest/
     bicubic/area [NCHW], trilinear [NCDHW]); align_corners honored for the
@@ -1281,7 +1287,8 @@ def _interpolate_raw(a, size=None, scale_factor=None, mode="nearest",
     if mode in ("linear", "bilinear", "trilinear"):
         out = a
         for ax, o in zip(sp_axes, out_sp):
-            out = _interp_linear_1axis(out, ax, o, align_corners)
+            out = _interp_linear_1axis(out, ax, o, align_corners,
+                                       align_mode)
         return out
     if mode == "nearest":
         out = a
@@ -1319,7 +1326,8 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                  {"size": size, "scale_factor": scale_factor,
                   "mode": str(mode),
                   "channels_last": data_format in ("NHWC", "NWC", "NDHWC"),
-                  "align_corners": bool(align_corners)},
+                  "align_corners": bool(align_corners),
+                  "align_mode": int(align_mode)},
                  name="interpolate")
 
 

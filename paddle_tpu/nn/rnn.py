@@ -535,12 +535,17 @@ def gru_unit(x_gates, hidden_prev, weight, bias,
              origin_mode=False):
     """One GRU step, fluid layout (ref operators/gru_unit_op.cc):
     x_gates: [B, 3D] (input already projected), hidden_prev: [B, D],
-    weight: [D, 3D] — first 2D columns are the update/reset recurrent
-    weights, last D the candidate's; bias: [1, 3D]. Returns
+    weight: [D, 3D] stored flat — the reference kernel (gru_unit_op.h
+    GEMMs: ldb=2*frame_size over the first 2*D*D elements, then
+    ldb=frame_size from offset 2*D*D) reads it as a packed [D, 2D]
+    update/reset block followed by a [D, D] candidate block, NOT as
+    column slices of a [D, 3D] matrix; bias: [1, 3D]. Returns
     (gate [B,3D], reset_hidden_prev [B,D], hidden [B,D]) like the ref op."""
     d = hidden_prev.shape[1]
     g = x_gates + bias
-    w_rz, w_c = weight[:, :2 * d], weight[:, 2 * d:]
+    wf = weight.reshape(-1)
+    w_rz = wf[:2 * d * d].reshape(d, 2 * d)
+    w_c = wf[2 * d * d:].reshape(d, d)
     rz = g[:, :2 * d] + hidden_prev @ w_rz
     act = jax.nn.sigmoid if gate_activation == "sigmoid" else jnp.tanh
     u = act(rz[:, :d])

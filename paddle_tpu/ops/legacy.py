@@ -263,9 +263,16 @@ def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4):
 
 @def_op("shuffle_batch", n_tensor_args=1, differentiable=True)
 def shuffle_batch(x, seed=0):
-    """ref operators/shuffle_batch_op.cc: deterministic batch permutation
-    (seed attr — the reference threads a seed tensor)."""
-    perm = jax.random.permutation(jax.random.PRNGKey(seed), x.shape[0])
+    """ref operators/shuffle_batch_op.cc: batch permutation. seed=0 means
+    fresh randomness per execution in the reference (it draws from a
+    random device when the seed tensor is 0); nonzero seeds are
+    deterministic."""
+    if seed:
+        key = jax.random.PRNGKey(seed)
+    else:
+        from ..framework import state
+        key = state.next_rng_key()
+    perm = jax.random.permutation(key, x.shape[0])
     return jnp.take(x, perm, axis=0)
 
 
@@ -648,7 +655,10 @@ def batch_fc(x, w, bias):
 def spectral_norm_op(weight, u, v, dim=0, power_iters=1, eps=1e-12):
     """Spectral weight normalisation as the reference op computes it
     (ref operators/spectral_norm_op.h): fold `dim` to the front, run
-    power_iters u/v updates without gradient, divide by sigma."""
+    power_iters u/v updates without gradient, divide by sigma. The
+    reference kernel advances U/V in place; here they come back as extra
+    outputs (out, u_new, v_new) so callers persist the power-iteration
+    state across steps (center_loss wrapper pattern)."""
     perm = (dim,) + tuple(i for i in range(weight.ndim) if i != dim)
     wm = jnp.transpose(weight, perm).reshape(weight.shape[dim], -1)
     uu, vv = u.reshape(-1), v.reshape(-1)
@@ -662,8 +672,9 @@ def spectral_norm_op(weight, u, v, dim=0, power_iters=1, eps=1e-12):
     sigma = uu @ wm @ vv
     out = wm / jnp.maximum(sigma, eps)
     inv = tuple(np.argsort(perm))
-    return jnp.transpose(out.reshape(
+    out = jnp.transpose(out.reshape(
         tuple(weight.shape[d] for d in perm)), inv)
+    return out, uu.reshape(u.shape), vv.reshape(v.shape)
 
 
 # ----------------------------------------------- selected-rows / creation

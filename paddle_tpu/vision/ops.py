@@ -586,15 +586,22 @@ def _max_unpool2d_raw(x, indices, output_hw=(1, 1)):
     import jax.numpy as jnp
     b, c, oh, ow = x.shape
     H, W = output_hw
-    flat = jnp.zeros((b, c, H * W), x.dtype)
     src = x.reshape(b, c, oh * ow)
     idx = indices.reshape(b, c, oh * ow).astype(jnp.int32)
     bi = jnp.arange(b)[:, None, None]
     ci = jnp.arange(c)[None, :, None]
-    # indices from max_pool2d_with_index are unique per channel map, so a
-    # plain scatter-assign is exact (scatter-max would clobber negative
-    # values with the zero init)
-    flat = flat.at[bi, ci, idx].set(src)
+    # overlapping pool windows (stride < kernel) can record the SAME input
+    # position from two output cells, making scatter-assign order-dependent;
+    # scatter-max over a -inf init is deterministic. A scattered boolean
+    # mask identifies untouched positions for the reference's zero fill
+    # (comparing against the init value would misclassify legitimate
+    # -inf / INT_MIN inputs).
+    lo = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    flat = jnp.full((b, c, H * W), lo, x.dtype)
+    flat = flat.at[bi, ci, idx].max(src)
+    touched = jnp.zeros((b, c, H * W), jnp.bool_).at[bi, ci, idx].set(True)
+    flat = jnp.where(touched, flat, jnp.zeros((), x.dtype))
     return flat.reshape(b, c, H, W)
 
 
