@@ -137,10 +137,27 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
     save_to_file(path_prefix + ".pdiparams", persist_blob(norm))
 
 
-def load_inference_model(path_prefix, executor=None, **kwargs):
+def load_inference_model(path_prefix, executor=None, model_filename=None,
+                         params_filename=None, **kwargs):
     """Returns [program, feed_target_names, fetch_target_names] (ref
-    io.py load_inference_model contract)."""
-    prog = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    io.py load_inference_model contract). Accepts BOTH artifact
+    families: the native JSON desc pair this framework saves, and
+    reference-saved protobuf models (a 1.x `dirname/__model__` directory
+    or a 2.x prefix.pdmodel holding ProgramDesc wire bytes) — the latter
+    are translated through static/paddle_compat.py."""
+    import os
+    from . import paddle_pb
+
+    if os.path.isdir(path_prefix):
+        from .paddle_compat import load_paddle_format
+        return load_paddle_format(path_prefix, model_filename,
+                                  params_filename)
+    data = load_from_file(path_prefix + ".pdmodel")
+    if paddle_pb.looks_like_program(data):
+        from .paddle_compat import load_paddle_format
+        return load_paddle_format(path_prefix, model_filename,
+                                  params_filename, _model_bytes=data)
+    prog = deserialize_program(data)
     deserialize_persistables(prog,
                              load_from_file(path_prefix + ".pdiparams"))
     return [prog, prog._feed_names, prog._fetch_names]

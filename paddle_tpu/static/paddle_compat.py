@@ -1,0 +1,591 @@
+"""Translate parsed reference ProgramDescs into this framework's IR.
+
+paddle_pb.py parses the wire format; this module maps each reference
+OpDesc (named input/output slots + reference attr names, ref
+paddle/fluid/framework/framework.proto OpDesc) onto the op registry's
+positional-arg raw ops (static/desc.py OpDesc), producing a Program
+that the standard Executor jit-compiles. Covers the op set that appears
+in saved inference models (conv/bn/pool/fc/matmul/elementwise/act/
+shape-manipulation/embedding/norm/interp); unmapped op types raise with
+the full list so coverage gaps are explicit, not silent.
+
+Entry: load_paddle_format(path, model_filename, params_filename)
+-> [Program, feed_names, fetch_names].
+"""
+import os
+
+import numpy as np
+
+from . import desc as D
+from . import paddle_pb as pb
+
+
+class _Ctx:
+    def __init__(self, desc, var_info):
+        self.desc = desc
+        self.info = var_info          # name -> parsed VarDesc dict
+        self._nconst = 0
+
+    def emit(self, typ, inputs, outputs, attrs=None):
+        self.desc.add_op(D.OpDesc(typ, inputs, outputs, attrs or {}))
+        for o in outputs:
+            if o and o not in self.desc.vars:
+                self.desc.add_var(D.VarDesc(o, D.TMP))
+
+    def const(self, value, hint="c"):
+        self._nconst += 1
+        name = f"@pbconst_{self._nconst}_{hint}"
+        v = np.asarray(value)
+        self.desc.add_var(D.VarDesc(name, D.CONST, v.shape, str(v.dtype),
+                                    value=v))
+        return name
+
+    def dims(self, name):
+        v = self.info.get(name)
+        return None if v is None else v.get("dims")
+
+    def ndim(self, name):
+        d = self.dims(name)
+        return None if d is None else len(d)
+
+
+def _one(op, slot, required=True):
+    args = op["inputs"].get(slot) or []
+    if not args:
+        if required:
+            raise ValueError(f"op {op['type']}: missing input slot {slot}")
+        return None
+    return args[0]
+
+
+def _out(op, slot="Out"):
+    return op["outputs"][slot][0]
+
+
+TRANSLATORS = {}
+
+
+def translates(*ref_types):
+    def deco(fn):
+        for t in ref_types:
+            TRANSLATORS[t] = fn
+        return fn
+    return deco
+
+
+# ------------------------------------------------------------ conv / pool
+
+def _pad_pairs(paddings, algo=None):
+    """Reference conv/pool `paddings` attr -> our per-dim pad pairs."""
+    if algo in ("SAME", "VALID"):
+        return algo
+    p = list(paddings)
+    if len(p) == 2:                       # [ph, pw]
+        return [[p[0], p[0]], [p[1], p[1]]]
+    if len(p) == 4:                       # [top, bottom, left, right]
+        return [[p[0], p[1]], [p[2], p[3]]]
+    return p
+
+
+@translates("conv2d", "depthwise_conv2d", "conv2d_fusion")
+def _t_conv2d(op, ctx):
+    a = op["attrs"]
+    ins = [_one(op, "Input"), _one(op, "Filter")]
+    bias = _one(op, "Bias", required=False)
+    if bias:
+        ins.append(bias)
+    ctx.emit("conv2d", ins, [_out(op, "Output")], {
+        "stride": [int(s) for s in a.get("strides", [1, 1])],
+        "padding": _pad_pairs(a.get("paddings", [0, 0]),
+                              a.get("padding_algorithm")),
+        "dilation": [int(d) for d in a.get("dilations", [1, 1])],
+        "groups": int(a.get("groups", 1)),
+        "channels_last": a.get("data_format") == "NHWC"})
+
+
+@translates("pool2d")
+def _t_pool2d(op, ctx):
+    a = op["attrs"]
+    x = _one(op, "X")
+    ksize = [int(k) for k in a.get("ksize", [1, 1])]
+    nhwc = a.get("data_format") == "NHWC"
+    if a.get("adaptive") and any(k != 1 for k in ksize):
+        raise NotImplementedError(
+            "pool2d adaptive with output size != 1 is not translated yet")
+    if a.get("global_pooling") or a.get("adaptive"):
+        dims = ctx.dims(x)
+        if dims is None or len(dims) != 4:
+            raise ValueError(f"pool2d {x}: global pooling needs known dims")
+        ksize = [int(d) for d in (dims[1:3] if nhwc else dims[2:4])]
+        strides, padding = ksize, [[0, 0], [0, 0]]
+    else:
+        strides = [int(s) for s in a.get("strides", ksize)]
+        padding = _pad_pairs(a.get("paddings", [0, 0]),
+                             a.get("padding_algorithm"))
+    if a.get("ceil_mode"):
+        raise NotImplementedError("pool2d ceil_mode=True not translated")
+    our = "avg_pool2d" if a.get("pooling_type") == "avg" else "max_pool2d"
+    attrs = {"ksize": ksize, "strides": strides, "padding": padding,
+             "channels_last": nhwc}
+    if our == "avg_pool2d":
+        attrs["count_include_pad"] = not a.get("exclusive", True)
+    ctx.emit(our, [x], [_out(op)], attrs)
+
+
+# -------------------------------------------------------------- bn / norms
+
+@translates("batch_norm", "sync_batch_norm")
+def _t_batch_norm(op, ctx):
+    a = op["attrs"]
+    ch_axis = -1 if a.get("data_layout") == "NHWC" else 1
+    outs = [_out(op, "Y"),
+            op["outputs"].get("MeanOut", [None])[0] or "@pb_unused_mean",
+            op["outputs"].get("VarianceOut", [None])[0] or "@pb_unused_var"]
+    ctx.emit("batch_norm",
+             [_one(op, "X"), _one(op, "Mean"), _one(op, "Variance"),
+              _one(op, "Scale"), _one(op, "Bias")],
+             outs,
+             {"ch_axis": ch_axis,
+              "momentum": float(a.get("momentum", 0.9)),
+              "epsilon": float(a.get("epsilon", 1e-5)),
+              "training": not a.get("is_test", True)})
+
+
+@translates("layer_norm")
+def _t_layer_norm(op, ctx):
+    a = op["attrs"]
+    x = _one(op, "X")
+    nd_in = ctx.ndim(x)
+    if nd_in is None:
+        raise ValueError(f"layer_norm {x}: need var rank for begin_norm_axis")
+    ins = [x]
+    scale = _one(op, "Scale", required=False)
+    bias = _one(op, "Bias", required=False)
+    if scale:
+        ins.append(scale)
+        if bias:
+            ins.append(bias)
+    ctx.emit("layer_norm", ins, [_out(op, "Y")],
+             {"nd": nd_in - int(a.get("begin_norm_axis", 1)),
+              "epsilon": float(a.get("epsilon", 1e-5))})
+
+
+# ----------------------------------------------------------- matmul family
+
+@translates("mul")
+def _t_mul(op, ctx):
+    a = op["attrs"]
+    ctx.emit("mul", [_one(op, "X"), _one(op, "Y")], [_out(op)],
+             {"x_num_col_dims": int(a.get("x_num_col_dims", 1)),
+              "y_num_col_dims": int(a.get("y_num_col_dims", 1))})
+
+
+@translates("matmul", "matmul_v2")
+def _t_matmul(op, ctx):
+    a = op["attrs"]
+    tx = bool(a.get("trans_x", a.get("transpose_X", False)))
+    ty = bool(a.get("trans_y", a.get("transpose_Y", False)))
+    alpha = float(a.get("alpha", 1.0))
+    out = _out(op)
+    mm_out = out if alpha == 1.0 else out + "@mm"
+    ctx.emit("matmul", [_one(op, "X"), _one(op, "Y")], [mm_out],
+             {"transpose_x": tx, "transpose_y": ty})
+    if alpha != 1.0:
+        ctx.emit("scale", [mm_out, ctx.const(np.float32(alpha), "alpha"),
+                           ctx.const(np.float32(0.0), "zero")], [out])
+
+
+# ------------------------------------------------------------- elementwise
+
+@translates("elementwise_add", "elementwise_sub", "elementwise_mul",
+            "elementwise_div", "elementwise_min", "elementwise_max",
+            "elementwise_pow")
+def _t_elementwise(op, ctx):
+    ctx.emit(op["type"], [_one(op, "X"), _one(op, "Y")], [_out(op)],
+             {"axis": int(op["attrs"].get("axis", -1))})
+
+
+@translates("scale")
+def _t_scale(op, ctx):
+    a = op["attrs"]
+    ctx.emit("scale",
+             [_one(op, "X"), ctx.const(np.float32(a.get("scale", 1.0)), "s"),
+              ctx.const(np.float32(a.get("bias", 0.0)), "b")],
+             [_out(op)],
+             {"bias_after_scale": bool(a.get("bias_after_scale", True))})
+
+
+# ------------------------------------------------------------- activations
+
+_SAME_NAME_UNARY = [
+    "relu", "relu6", "sigmoid", "tanh", "sqrt", "rsqrt", "exp", "abs",
+    "floor", "ceil", "log", "log2", "log10", "log1p", "square", "round",
+    "sign", "erf", "softsign", "silu", "mish", "softshrink",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh",
+    "cosh", "reciprocal",
+]
+
+_RENAMED_UNARY = {"tanh_shrink": "tanhshrink", "hard_shrink": "hardshrink"}
+
+
+def _t_unary(op, ctx):
+    ctx.emit(_RENAMED_UNARY.get(op["type"], op["type"]),
+             [_one(op, "X")], [_out(op)])
+
+
+for _name in list(_SAME_NAME_UNARY) + list(_RENAMED_UNARY):
+    TRANSLATORS[_name] = _t_unary
+
+
+@translates("leaky_relu")
+def _t_leaky_relu(op, ctx):
+    ctx.emit("leaky_relu", [_one(op, "X")], [_out(op)],
+             {"negative_slope": float(op["attrs"].get("alpha", 0.02))})
+
+
+@translates("hard_sigmoid")
+def _t_hard_sigmoid(op, ctx):
+    a = op["attrs"]
+    ctx.emit("hard_sigmoid", [_one(op, "X")], [_out(op)],
+             {"slope": float(a.get("slope", 0.2)),
+              "offset": float(a.get("offset", 0.5))})
+
+
+@translates("gelu")
+def _t_gelu(op, ctx):
+    ctx.emit("gelu", [_one(op, "X")], [_out(op)],
+             {"approximate": bool(op["attrs"].get("approximate", False))})
+
+
+@translates("softmax")
+def _t_softmax(op, ctx):
+    ctx.emit("softmax", [_one(op, "X")], [_out(op)],
+             {"axis": int(op["attrs"].get("axis", -1))})
+
+
+@translates("clip")
+def _t_clip(op, ctx):
+    a = op["attrs"]
+    ctx.emit("clip", [_one(op, "X")], [_out(op)],
+             {"lo": float(a.get("min", 0.0)), "hi": float(a.get("max", 0.0))})
+
+
+@translates("swish")
+def _t_swish(op, ctx):
+    # swish(x, beta) = x * sigmoid(beta x); beta=1 is silu (the only case
+    # saved classifiers use)
+    if float(op["attrs"].get("beta", 1.0)) != 1.0:
+        raise NotImplementedError("swish beta != 1 not translated")
+    ctx.emit("silu", [_one(op, "X")], [_out(op)])
+
+
+@translates("hard_swish")
+def _t_hard_swish(op, ctx):
+    ctx.emit("hardswish", [_one(op, "X")], [_out(op)])
+
+
+# ------------------------------------------------------- shape manipulation
+
+def _static_reshape_shape(shape, in_dims):
+    """Resolve the reference reshape convention: 0 copies the input dim."""
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            if in_dims is None or i >= len(in_dims):
+                raise ValueError("reshape: 0-dim needs known input dims")
+            out.append(int(in_dims[i]))
+        else:
+            out.append(int(s))
+    return out
+
+
+@translates("reshape", "reshape2")
+def _t_reshape(op, ctx):
+    x = _one(op, "X")
+    shape = _static_reshape_shape(op["attrs"].get("shape", []), ctx.dims(x))
+    ctx.emit("reshape", [x], [_out(op)], {"shape": shape})
+
+
+@translates("transpose", "transpose2")
+def _t_transpose(op, ctx):
+    ctx.emit("transpose", [_one(op, "X")], [_out(op)],
+             {"perm": [int(v) for v in op["attrs"].get("axis", [])]})
+
+
+@translates("flatten_contiguous_range")
+def _t_flatten_range(op, ctx):
+    a = op["attrs"]
+    ctx.emit("flatten", [_one(op, "X")], [_out(op)],
+             {"start_axis": int(a.get("start_axis", 1)),
+              "stop_axis": int(a.get("stop_axis", -1))})
+
+
+@translates("flatten", "flatten2")
+def _t_flatten2(op, ctx):
+    """ref flatten2: [d0..dn] -> [prod(:axis), prod(axis:)]."""
+    x = _one(op, "X")
+    axis = int(op["attrs"].get("axis", 1))
+    dims = ctx.dims(x)
+    if dims is None:
+        raise ValueError(f"flatten {x}: needs known dims")
+    tail = int(np.prod([d for d in dims[axis:]]))
+    ctx.emit("reshape", [x], [_out(op)], {"shape": [-1, tail]})
+
+
+@translates("squeeze", "squeeze2")
+def _t_squeeze(op, ctx):
+    axes = [int(v) for v in op["attrs"].get("axes", [])]
+    ctx.emit("squeeze", [_one(op, "X")], [_out(op)],
+             {"axis": axes or None})
+
+
+@translates("unsqueeze", "unsqueeze2")
+def _t_unsqueeze(op, ctx):
+    axes = [int(v) for v in op["attrs"].get("axes", [])]
+    ctx.emit("unsqueeze", [_one(op, "X")], [_out(op)], {"axis": axes})
+
+
+@translates("concat")
+def _t_concat(op, ctx):
+    ctx.emit("concat", op["inputs"].get("X", []), [_out(op)],
+             {"axis": int(op["attrs"].get("axis", 0))})
+
+
+@translates("stack")
+def _t_stack(op, ctx):
+    ctx.emit("stack", op["inputs"].get("X", []), [_out(op, "Y")],
+             {"axis": int(op["attrs"].get("axis", 0))})
+
+
+@translates("split")
+def _t_split(op, ctx):
+    a = op["attrs"]
+    sections = [int(v) for v in a.get("sections", [])]
+    ctx.emit("split", [_one(op, "X")], op["outputs"]["Out"],
+             {"num_or_sections": sections or int(a.get("num", 1)),
+              "axis": int(a.get("axis", 0))})
+
+
+@translates("slice")
+def _t_slice(op, ctx):
+    a = op["attrs"]
+    out = _out(op)
+    dec = [int(v) for v in a.get("decrease_axis", [])]
+    mid = out + "@sl" if dec else out
+    ctx.emit("slice", [_one(op, "Input")], [mid],
+             {"axes": [int(v) for v in a.get("axes", [])],
+              "starts": [int(v) for v in a.get("starts", [])],
+              "ends": [int(v) for v in a.get("ends", [])]})
+    if dec:
+        ctx.emit("squeeze", [mid], [out], {"axis": dec})
+
+
+@translates("cast")
+def _t_cast(op, ctx):
+    ctx.emit("cast", [_one(op, "X")], [_out(op)],
+             {"to_dtype": pb.VARTYPE_DTYPE[int(op["attrs"]["out_dtype"])]})
+
+
+@translates("shape")
+def _t_shape(op, ctx):
+    ctx.emit("shape", [_one(op, "Input")], [_out(op)])
+
+
+@translates("fill_constant")
+def _t_fill_constant(op, ctx):
+    """Static-shape fill -> a const var, no runtime op."""
+    a = op["attrs"]
+    if op["inputs"].get("ShapeTensor") or op["inputs"].get("ShapeTensorList"):
+        raise NotImplementedError("fill_constant with runtime shape tensor")
+    dtype = pb.VARTYPE_DTYPE[int(a.get("dtype", 5))]
+    val = np.full([int(s) for s in a.get("shape", [])],
+                  float(a.get("value", 0.0)), dtype)
+    out = _out(op)
+    ctx.emit("assign", [ctx.const(val, "fill")], [out])
+
+
+# ------------------------------------------------------------- embeddings
+
+@translates("lookup_table_v2")
+def _t_lookup_v2(op, ctx):
+    pad = int(op["attrs"].get("padding_idx", -1))
+    ctx.emit("embedding", [_one(op, "Ids"), _one(op, "W")], [_out(op)],
+             {"padding_idx": None if pad == -1 else pad})
+
+
+@translates("lookup_table")
+def _t_lookup_v1(op, ctx):
+    """v1 ids carry a trailing [,1] dim that the output drops."""
+    ids, out = _one(op, "Ids"), _out(op)
+    pad = int(op["attrs"].get("padding_idx", -1))
+    ctx.emit("squeeze", [ids], [ids + "@sq"], {"axis": [-1]})
+    ctx.emit("embedding", [ids + "@sq", _one(op, "W")], [out],
+             {"padding_idx": None if pad == -1 else pad})
+
+
+# --------------------------------------------------------------- dropout
+
+@translates("dropout")
+def _t_dropout(op, ctx):
+    """Inference-mode dropout: upscale_in_train -> identity;
+    downgrade_in_infer -> x * (1-p)."""
+    a = op["attrs"]
+    x, out = _one(op, "X"), _out(op)
+    if a.get("dropout_implementation", "downgrade_in_infer") \
+            == "upscale_in_train":
+        ctx.emit("assign", [x], [out])
+    else:
+        keep = 1.0 - float(a.get("dropout_prob", 0.5))
+        ctx.emit("scale", [x, ctx.const(np.float32(keep), "keep"),
+                           ctx.const(np.float32(0.0), "zero")], [out])
+
+
+# ------------------------------------------------------------ reductions
+
+@translates("reduce_mean", "reduce_sum", "reduce_max", "reduce_min",
+            "reduce_prod")
+def _t_reduce(op, ctx):
+    a = op["attrs"]
+    ours = {"reduce_mean": "mean", "reduce_sum": "sum", "reduce_max": "max",
+            "reduce_min": "min", "reduce_prod": "prod"}[op["type"]]
+    axis = [int(v) for v in a.get("dim", [])]
+    ctx.emit(ours, [_one(op, "X")], [_out(op)],
+             {"axis": None if a.get("reduce_all") else (axis or None),
+              "keepdim": bool(a.get("keep_dim", False))})
+
+
+@translates("arg_max")
+def _t_argmax(op, ctx):
+    a = op["attrs"]
+    ctx.emit("argmax", [_one(op, "X")], [_out(op)],
+             {"axis": int(a.get("axis", -1)),
+              "keepdim": bool(a.get("keepdims", False))})
+
+
+# ----------------------------------------------------------- interpolation
+
+@translates("nearest_interp", "nearest_interp_v2", "bilinear_interp",
+            "bilinear_interp_v2", "bicubic_interp_v2", "linear_interp",
+            "trilinear_interp", "trilinear_interp_v2")
+def _t_interp(op, ctx):
+    a = op["attrs"]
+    mode = a.get("interp_method", op["type"].split("_")[0])
+    out_h, out_w = int(a.get("out_h", -1)), int(a.get("out_w", -1))
+    size = None
+    if out_h > 0 and out_w > 0:
+        size = [out_h, out_w]
+    scale = a.get("scale")
+    if isinstance(scale, (list, tuple)):
+        scale = [float(s) for s in scale] if scale else None
+    elif scale is not None and float(scale) > 0:
+        scale = float(scale)
+    else:
+        scale = None
+    if size is None and scale is None:
+        raise ValueError(f"{op['type']}: no static output size")
+    ctx.emit("interpolate", [_one(op, "X")], [_out(op)],
+             {"size": size, "scale_factor": scale, "mode": mode,
+              "channels_last": a.get("data_layout") == "NHWC",
+              "align_corners": bool(a.get("align_corners", True)),
+              "align_mode": int(a.get("align_mode", 1))})
+
+
+# -------------------------------------------------------------- assembly
+
+def from_parsed(parsed, name_hint="paddle_model"):
+    """Parsed ProgramDesc tree -> (Program, feed_names, fetch_names).
+
+    Only the global block translates (inference programs from
+    save_inference_model are single-block; control flow would need the
+    taken-branch trace the native IR uses)."""
+    from .program import Program
+
+    if len(parsed["blocks"]) != 1:
+        raise NotImplementedError(
+            f"{len(parsed['blocks'])}-block reference programs (control "
+            "flow) are not translated; export the inference block")
+    block = parsed["blocks"][0]
+    info = {v["name"]: v for v in block["vars"]}
+
+    desc = D.ProgramDesc()
+    ctx = _Ctx(desc, info)
+
+    # interface: feed/fetch ops carry (col -> var) in their attrs
+    feeds, fetches = {}, {}
+    body = []
+    for op in block["ops"]:
+        if op["type"] == "feed":
+            feeds[int(op["attrs"].get("col", 0))] = _out(op)
+        elif op["type"] == "fetch":
+            fetches[int(op["attrs"].get("col", 0))] = _one(op, "X")
+        else:
+            body.append(op)
+    feed_names = [feeds[i] for i in sorted(feeds)]
+    fetch_names = [fetches[i] for i in sorted(fetches)]
+
+    # vars: feeds + persistables first (translators may consult ctx.dims)
+    persist_names = []
+    for v in block["vars"]:
+        if v.get("type") in (pb.FEED_MINIBATCH, pb.FETCH_LIST):
+            continue
+        name = v["name"]
+        dtype = pb.VARTYPE_DTYPE.get(v.get("dtype"))
+        dims = v.get("dims")
+        if name in feed_names:
+            shape = [None if d == -1 else int(d) for d in (dims or [])]
+            desc.add_var(D.VarDesc(name, D.FEED, shape, dtype))
+        elif v.get("persistable"):
+            desc.add_var(D.VarDesc(name, D.PERSIST,
+                                   [int(d) for d in (dims or [])], dtype))
+            persist_names.append(name)
+
+    unmapped = sorted({op["type"] for op in body
+                       if op["type"] not in TRANSLATORS})
+    if unmapped:
+        raise NotImplementedError(
+            f"reference ops not yet translated: {unmapped} — add a "
+            "@translates handler in static/paddle_compat.py")
+    for op in body:
+        TRANSLATORS[op["type"]](op, ctx)
+
+    prog = Program.parse_from_string(desc.to_json())
+    prog._feed_names = feed_names
+    prog._fetch_names = fetch_names
+    return prog, feed_names, fetch_names
+
+
+def load_paddle_format(path, model_filename=None, params_filename=None,
+                       _model_bytes=None):
+    """Load a reference-saved inference model directory or file.
+
+    Layout (ref python/paddle/fluid/io.py:1199 save_inference_model):
+    `path/__model__` (or model_filename) = ProgramDesc bytes; params in
+    per-var files in `path`, or one combined params_filename. Also
+    accepts a 2.x `prefix.pdmodel` + `prefix.pdiparams` pair saved in
+    protobuf format."""
+    import jax.numpy as jnp
+
+    if os.path.isdir(path):
+        model_path = os.path.join(path, model_filename or "__model__")
+        model_dir = path
+    else:
+        model_path = path if os.path.exists(path) else path + ".pdmodel"
+        model_dir = os.path.dirname(model_path)
+        if params_filename is None:
+            cand = (path + ".pdiparams" if not path.endswith(".pdmodel")
+                    else path[:-len(".pdmodel")] + ".pdiparams")
+            if os.path.exists(cand):
+                params_filename = os.path.basename(cand)
+    if _model_bytes is not None:
+        data = _model_bytes
+    else:
+        with open(model_path, "rb") as f:
+            data = f.read()
+    prog, feed_names, fetch_names = from_parsed(pb.parse_program(data))
+    persist = list(prog._persist)
+    if persist:
+        arrays = pb.load_params(model_dir, persist,
+                                params_filename=params_filename)
+        for n, arr in arrays.items():
+            prog._persist[n]._data = jnp.asarray(arr)
+    return [prog, feed_names, fetch_names]

@@ -14,7 +14,6 @@ Usage:
   python scripts/mosaic_check.py            # all kernels, subprocess each
   python scripts/mosaic_check.py --one NAME # single kernel, in-process
 """
-import functools
 import json
 import os
 import subprocess
@@ -138,8 +137,15 @@ def main():
         try:
             rec = run_one(name)
         except Exception as e:
-            rec = {"kernel": name, "status": "fail",
-                   "error": f"{type(e).__name__}: {str(e)[:2000]}"}
+            msg = f"{type(e).__name__}: {str(e)[:2000]}"
+            # infra errors (tunnel drop mid-compile, RPC loss) are NOT a
+            # Mosaic verdict — mark them retryable, not 'fail'
+            infra = any(s in msg for s in (
+                "UNAVAILABLE", "DEADLINE", "DeadlineExceeded", "socket",
+                "connection", "Connection", "tunnel", "INTERNAL",
+                "failed to connect", "Broken pipe"))
+            rec = {"kernel": name, "status": "infra" if infra else "fail",
+                   "error": msg}
         print(json.dumps(rec), flush=True)
         sys.exit(0 if rec["status"] == "ok" else 1)
 
@@ -159,8 +165,9 @@ def main():
                 except json.JSONDecodeError:
                     rec = None
             if not isinstance(rec, dict) or "status" not in rec:
-                # empty/garbled stdout (segfault, OOM-kill mid-compile)
-                rec = {"kernel": name, "status": "fail",
+                # empty/garbled stdout (segfault, OOM-kill mid-compile):
+                # an infra outcome, not a Mosaic verdict — retryable
+                rec = {"kernel": name, "status": "infra",
                        "error": f"rc={p.returncode} "
                                 f"stderr={p.stderr[-1500:]}"}
         except subprocess.TimeoutExpired:
@@ -172,8 +179,9 @@ def main():
     out = os.path.join(REPO, "docs", "perf", "mosaic_check.json")
     ok = all(r["status"] == "ok" for r in results)
     # bankable = every kernel reached a REAL Mosaic verdict (compiled on
-    # a non-cpu backend, pass or fail). Timeouts and cpu-fallbacks mean
-    # the tunnel dropped mid-battery: the watchdog must retry, not bank.
+    # a non-cpu backend, pass or fail). Timeouts, cpu-fallbacks and
+    # infra errors mean the tunnel dropped mid-battery: the watchdog
+    # must retry, not bank.
     bankable = all(r["status"] in ("ok", "fail") for r in results)
     with open(out, "w") as f:
         json.dump({"ok": ok, "bankable": bankable, "results": results,
