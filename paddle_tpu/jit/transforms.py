@@ -30,6 +30,28 @@ def resolve(optimizer):
     return dict(getattr(optimizer, "transforms", None) or {})
 
 
+def reduced_dtype(value, default=jnp.float16):
+    """Normalize a user-facing dtype spec ('float16'/'bf16'/np.dtype/
+    jnp dtype object) to the jnp reduced-precision dtype."""
+    import numpy as np
+    if value is None:
+        return default
+    try:
+        dt = jnp.dtype(value)
+    except TypeError:
+        s = str(value)
+        if s.endswith(("bfloat16", "bf16")):
+            return jnp.bfloat16
+        if s.endswith(("float16", "fp16", "half")):
+            return jnp.float16
+        raise ValueError(f"unrecognized reduced dtype {value!r}")
+    if dt == jnp.dtype(jnp.bfloat16):
+        return jnp.bfloat16
+    if dt == np.dtype(np.float16):
+        return jnp.float16
+    raise ValueError(f"unsupported reduced dtype {value!r}")
+
+
 def wrap_forward(forward, transforms):
     """Apply amp/recompute to a functional forward
     ``forward(params, buffers, key, inputs, labels) -> (loss, aux)``.
@@ -38,8 +60,7 @@ def wrap_forward(forward, transforms):
     amp = transforms.get("amp")
     if amp:
         level = amp.get("level", "O1")
-        low = jnp.bfloat16 if str(amp.get("dtype", "bfloat16")).endswith(
-            ("bfloat16", "bf16")) else jnp.float16
+        low = reduced_dtype(amp.get("dtype"), default=jnp.bfloat16)
         inner = forward
 
         def amp_forward(p, buffers, key, inputs, labels):
