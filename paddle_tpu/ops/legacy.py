@@ -677,6 +677,21 @@ def spectral_norm_op(weight, u, v, dim=0, power_iters=1, eps=1e-12):
     return out, uu.reshape(u.shape), vv.reshape(v.shape)
 
 
+# v2: gained the (u_new, v_new) state outputs (op_version_registry analog
+# — old descs recorded one output)
+from ..static.desc import register_op_version, register_op_migration  # noqa: E402
+
+register_op_version("spectral_norm_op", 2)
+
+
+@register_op_migration("spectral_norm_op", 1)
+def _spectral_norm_v1_to_v2(od):
+    if len(od.get("outputs", [])) == 1:
+        base = od["outputs"][0]
+        od = dict(od, outputs=[base, base + "@u_new", base + "@v_new"])
+    return od
+
+
 # ----------------------------------------------- selected-rows / creation
 
 def _merge_selected_rows_impl(sr):

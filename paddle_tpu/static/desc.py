@@ -39,6 +39,84 @@ STEP_VAR = "@STEP@"
 
 _CONST_MAX_ELEMS = 10_000_000
 
+# ------------------------------------------------------------- versioning
+# op_version_registry analog (ref paddle/fluid/framework/
+# op_version_registry.h): the desc records a schema version plus the
+# version of every op type whose semantics have ever changed, and
+# from_json upgrades old descs through registered migration hooks so a
+# round-N artifact loads in round N+1.
+
+SCHEMA_VERSION = 2
+
+# op type -> current version (absent = 1, never changed)
+OP_VERSIONS = {}
+
+# (op type, from_version) -> fn(op_dict) -> op_dict upgrading ONE version
+_OP_MIGRATIONS = {}
+
+# schema-level: from_version -> fn(desc_dict) -> desc_dict
+_SCHEMA_MIGRATIONS = {}
+
+
+def register_op_version(op_type, version):
+    OP_VERSIONS[op_type] = int(version)
+
+
+def register_op_migration(op_type, from_version):
+    def deco(fn):
+        _OP_MIGRATIONS[(op_type, int(from_version))] = fn
+        return fn
+    return deco
+
+
+def register_schema_migration(from_version):
+    def deco(fn):
+        _SCHEMA_MIGRATIONS[int(from_version)] = fn
+        return fn
+    return deco
+
+
+@register_schema_migration(1)
+def _schema_1_to_2(d):
+    # v1 descs predate per-op versioning: every op is at version 1
+    d["op_versions"] = {}
+    return d
+
+
+def _migrate(d):
+    ver = int(d.get("version", 1))
+    if ver > SCHEMA_VERSION:
+        raise ValueError(
+            f"desc schema version {ver} is newer than this framework's "
+            f"{SCHEMA_VERSION}; upgrade the framework to load it")
+    while ver < SCHEMA_VERSION:
+        fn = _SCHEMA_MIGRATIONS.get(ver)
+        if fn is None:
+            raise ValueError(f"no migration from desc schema v{ver}")
+        d = fn(d)
+        ver += 1
+    d["version"] = SCHEMA_VERSION
+    saved_op_vers = d.get("op_versions", {})
+    ops = []
+    for od in d["ops"]:
+        have = int(saved_op_vers.get(od["type"], 1))
+        want = OP_VERSIONS.get(od["type"], 1)
+        if have > want:
+            raise ValueError(
+                f"op '{od['type']}' saved at version {have} is newer than "
+                f"this framework's {want}; upgrade the framework")
+        while have < want:
+            fn = _OP_MIGRATIONS.get((od["type"], have))
+            if fn is None:
+                raise ValueError(
+                    f"op '{od['type']}' saved at version {have} but the "
+                    f"registry is at {want} with no migration path")
+            od = fn(od)
+            have += 1
+        ops.append(od)
+    d["ops"] = ops
+    return d
+
 
 class VarDesc:
     __slots__ = ("name", "kind", "shape", "dtype", "stop_gradient", "value")
@@ -173,15 +251,18 @@ class ProgramDesc:
                 f"serialization: {kinds}. Register their impls with "
                 f"ops.dispatch.def_op (attrs must be JSON-able) to make the "
                 f"desc portable; in-process execution is unaffected.")
+        op_vers = {op.type: OP_VERSIONS[op.type] for op in self.ops
+                   if OP_VERSIONS.get(op.type, 1) > 1}
         return json.dumps({
-            "version": 1,
+            "version": SCHEMA_VERSION,
+            "op_versions": op_vers,
             "vars": [v.to_dict() for v in self.vars.values()],
             "ops": [op.to_dict() for op in self.ops],
         })
 
     @classmethod
     def from_json(cls, s):
-        d = json.loads(s)
+        d = _migrate(json.loads(s))
         desc = cls()
         for vd in d["vars"]:
             desc.add_var(VarDesc.from_dict(vd))
@@ -246,6 +327,10 @@ def _exec_grad(desc, op, env):
     multi = isinstance(outs, (tuple, list))
     outs_t = tuple(outs) if multi else (outs,)
     mask = a["has_out_grad"]
+    # op migrations can ADD forward outputs (e.g. spectral_norm_op v2's
+    # u/v state); grad ops recorded against the old arity carry a shorter
+    # mask — added outputs never have incoming grads
+    mask = list(mask) + [False] * (len(outs_t) - len(mask))
     cots, gi = [], 0
     for j, o in enumerate(outs_t):
         if mask[j]:
