@@ -1,80 +1,200 @@
 """paddle.inference — the deployment surface (ref paddle/fluid/inference
-AnalysisPredictor + api/paddle_inference_api.h; the TRT/Lite/capi engines
-are out of scope per SURVEY §7 — XLA is the engine).
+AnalysisPredictor + api/paddle_inference_api.h + api/analysis_config.cc;
+the TRT/Lite/capi engines are out of scope per SURVEY §7 — XLA is the
+engine).
 
-TPU-native slice: a predictor over the StableHLO export format
-(static/export.py jit.save artifacts). Config/create_predictor keep the
-reference call contract:
+Two artifact families serve through one Predictor:
+  * StableHLO bundles from paddle.jit.save (static/export.py)
+  * reference-saved protobuf models (dirname/__model__ or protobuf
+    .pdmodel + LoDTensor params) via static/paddle_compat.py
 
-    config = Config(model_dir)          # a paddle.jit.save'd dir/prefix
-    predictor = create_predictor(config)
-    out = predictor.run([np_input, ...])
+Config knobs are HONEST: each either takes real effect (memory_optim ->
+input-buffer donation in the compiled call; ir_optim=False -> the
+uncompiled per-call execution path; cpu_math_threads -> XLA:CPU thread
+cap when set before backend init) or warns loudly that XLA owns the
+concern (GPU/mkldnn/TensorRT switches).
 """
+import os
+import warnings
+
 import numpy as np
 
 
+def _inert(knob, why):
+    warnings.warn(
+        f"paddle.inference.Config.{knob} has no effect on the TPU build: "
+        f"{why}", stacklevel=3)
+
+
 class Config:
-    """ref paddle_infer.Config: carries the model path + knobs. GPU/TRT
-    switches are accepted and recorded (XLA owns device placement)."""
+    """ref paddle_infer.Config (api/analysis_config.cc)."""
 
     def __init__(self, model_dir=None, params_file=None):
         self.model_dir = model_dir
         self.params_file = params_file
-        self._use_gpu = False
-        self._device_id = 0
-        self._enable_mkldnn = False
-        self._cpu_math_threads = 1
         self._memory_optim = True
         self._ir_optim = True
+        self._cpu_math_threads = None
 
-    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        self._use_gpu = True
-        self._device_id = device_id
+    # ---- knobs with real effect
+    def enable_memory_optim(self, flag=True):
+        """memory_optim (ref analysis_config.cc EnableMemoryOptim):
+        donate input buffers to the compiled call so XLA reuses them for
+        activations/outputs."""
+        self._memory_optim = bool(flag)
 
-    def disable_gpu(self):
-        self._use_gpu = False
-
-    def enable_mkldnn(self):
-        self._enable_mkldnn = True
-
-    def set_cpu_math_library_num_threads(self, n):
-        self._cpu_math_threads = n
-
-    def enable_memory_optim(self):
-        self._memory_optim = True
+    def disable_memory_optim(self):
+        self._memory_optim = False
 
     def switch_ir_optim(self, flag=True):
-        self._ir_optim = flag
+        """ir_optim=False (ref analysis_config.cc SwitchIrOptim) runs the
+        UNOPTIMIZED path: per-call StableHLO replay with no cached
+        compiled executable — the analog of serving without the IR pass
+        pipeline."""
+        self._ir_optim = bool(flag)
+
+    def set_cpu_math_library_num_threads(self, n):
+        """Takes effect only before the first backend use (XLA:CPU reads
+        the flag at client init) — same constraint the reference has on
+        thread-pool construction."""
+        self._cpu_math_threads = int(n)
+        import jax
+        try:
+            backend_up = jax._src.xla_bridge._backends  # noqa: SLF001
+        except AttributeError:
+            backend_up = {}
+        if backend_up:
+            _inert("set_cpu_math_library_num_threads",
+                   "the XLA:CPU client is already initialized; set it "
+                   "before the first jax computation")
+        else:
+            flags = os.environ.get("XLA_FLAGS", "")
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_cpu_multi_thread_eigen="
+                f"{'true' if n > 1 else 'false'} "
+                f"intra_op_parallelism_threads={n}").strip()
+
+    def set_model(self, model_dir, params_file=None):
+        self.model_dir = model_dir
+        if params_file is not None:
+            self.params_file = params_file
+
+    # ---- knobs XLA owns: accepted for API compat, loudly inert
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        _inert("enable_use_gpu", "device placement is XLA's (the model "
+               "runs on the available TPU/CPU backend)")
+
+    def disable_gpu(self):
+        pass                      # already not-GPU; nothing to disable
+
+    def enable_mkldnn(self):
+        _inert("enable_mkldnn", "XLA:CPU replaces the mkldnn kernels")
+
+    def enable_tensorrt_engine(self, *args, **kwargs):
+        _inert("enable_tensorrt_engine", "XLA is the execution engine; "
+               "there is no TensorRT subgraph pass")
+
+    def enable_lite_engine(self, *args, **kwargs):
+        _inert("enable_lite_engine", "XLA is the execution engine")
 
     def model_path(self):
         return self.model_dir
 
+    def memory_optim_enabled(self):
+        return self._memory_optim
+
+    def ir_optim(self):
+        return self._ir_optim
+
 
 class Predictor:
-    """ref AnalysisPredictor: named input/output handles + run(). The
-    compiled executable comes from the StableHLO artifact; repeated run()
-    calls reuse XLA's compile cache."""
+    """ref AnalysisPredictor: named input/output handles + run().
+
+    StableHLO artifacts execute through ONE jitted call (params/buffers
+    captured, inputs donated when memory_optim); reference protobuf
+    models execute through the standard Executor."""
 
     def __init__(self, config):
+        self._config = config
+        path = config.model_path()
+        self._mode = None
+        if not path:
+            raise ValueError(
+                "inference Config has no model path — construct it as "
+                "Config(model_dir) or call config.set_model(path)")
+        if os.path.exists(path + ".meta.json"):
+            self._init_stablehlo(path, config)
+        else:
+            self._init_program(path, config)
+
+    # ---- StableHLO bundle (paddle.jit.save)
+    def _init_stablehlo(self, path, config):
+        import jax
         from ..static.export import load
-        self._layer = load(config.model_path())
-        self._inputs = None
+        self._mode = "stablehlo"
+        self._layer = load(path)
+        ex = self._layer._exported
+
+        def call(params, buffers, *xs):
+            return ex.call(params, buffers, *xs)
+
+        if config.ir_optim():
+            # donate the per-call input buffers; params/buffers persist
+            n_fixed = 2
+            spec = self._layer._meta.get("inputs", [])
+            donate = tuple(range(n_fixed, n_fixed + len(spec))) \
+                if config.memory_optim_enabled() else ()
+            self._run = jax.jit(call, donate_argnums=donate)
+        else:
+            self._run = call            # uncompiled per-call replay
+
+    # ---- reference protobuf / native JSON program
+    def _init_program(self, path, config):
+        from ..static import load_inference_model, Executor
+        self._mode = "program"
+        prog, feeds, fetches = load_inference_model(
+            path, params_filename=config.params_file)
+        self._prog, self._feeds, self._fetches = prog, feeds, fetches
+        self._exe = Executor()
+        if not config.ir_optim():
+            _inert("switch_ir_optim(False)",
+                   "program-path serving always executes the jit-compiled "
+                   "program (there is no unoptimized interpreter for it)")
 
     def get_input_names(self):
-        spec = getattr(self._layer, "_input_spec", None)
-        if spec:
-            return [getattr(s, "name", f"x{i}") or f"x{i}"
-                    for i, s in enumerate(spec)]
-        return ["x0"]
+        if self._mode == "program":
+            return list(self._feeds)
+        spec = self._layer._meta.get("inputs", [])
+        return [s.get("name") or f"x{i}" if isinstance(s, dict) else f"x{i}"
+                for i, s in enumerate(spec)] or ["x0"]
 
     def get_output_names(self):
-        return ["out0"]
+        if self._mode == "program":
+            return list(self._fetches)
+        return [f"out{i}"
+                for i in range(self._layer._meta.get("n_outputs", 1))]
 
     def run(self, inputs):
         """inputs: list of numpy arrays in input order. Returns a list of
         numpy outputs (ref predictor.run contract)."""
+        import jax.numpy as jnp
         from ..framework.tensor import Tensor
-        outs = self._layer(*[np.asarray(a) for a in inputs])
+        if self._mode == "program":
+            outs = self._exe.run(self._prog,
+                                 feed=dict(zip(self._feeds, inputs)),
+                                 fetch_list=self._fetches)
+            return [np.asarray(o) for o in outs]
+        donating = (self._config.memory_optim_enabled()
+                    and self._config.ir_optim())
+        arrays = []
+        for a in inputs:
+            if isinstance(a, Tensor):
+                # donation would invalidate the caller's live Tensor —
+                # hand the compiled call its own copy instead
+                arrays.append(jnp.copy(a._data) if donating else a._data)
+            else:
+                arrays.append(jnp.asarray(a))
+        outs = self._run(self._layer._params, self._layer._buffers, *arrays)
         outs = outs if isinstance(outs, (list, tuple)) else [outs]
         return [np.asarray(o.numpy() if isinstance(o, Tensor) else o)
                 for o in outs]

@@ -1,0 +1,131 @@
+"""Honest inference Config knobs (ref paddle/fluid/inference/api/
+analysis_config.cc): memory_optim really donates, ir_optim really
+switches the uncompiled path, XLA-owned switches warn loudly, and the
+Predictor serves both StableHLO and program-format artifacts."""
+import os
+import warnings
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def saved_model(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 2))
+    x = np.random.RandomState(0).randn(3, 4).astype("f4")
+    ref = net(paddle.to_tensor(x)).numpy()
+    path = os.path.join(str(tmp_path), "model")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([None, 4],
+                                                        "float32")])
+    return path, x, ref
+
+
+class TestHonestKnobs:
+    def _first_run(self, config, x):
+        """(outputs, donation_observed): donation is observed either as
+        an aliasing/donor marker in the first compile's lowering (TPU;
+        CPU when shapes alias) or as XLA:CPU's 'donated buffers were not
+        usable' warning (donation requested, backend dropped it)."""
+        p = paddle.inference.create_predictor(config)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            outs = p.run([x])
+            txt = ""
+            if hasattr(p._run, "lower"):
+                txt = p._run.lower(p._layer._params, p._layer._buffers,
+                                   jnp.asarray(x)).as_text()
+        dropped = any("donated buffers were not usable" in str(w.message)
+                      for w in rec)
+        donated = ("tf.aliasing_output" in txt or "jax.buffer_donor" in txt
+                   or dropped)
+        return outs, donated
+
+    def test_memory_optim_donates_inputs(self, saved_model):
+        path, x, ref = saved_model
+        config = paddle.inference.Config(path)
+        config.enable_memory_optim()
+        (out,), donated = self._first_run(config, x)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        assert donated, "memory_optim must request input-buffer donation"
+
+    def test_memory_optim_off_keeps_inputs(self, saved_model):
+        path, x, ref = saved_model
+        config = paddle.inference.Config(path)
+        config.disable_memory_optim()
+        (out,), donated = self._first_run(config, x)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+        assert not donated
+
+    def test_ir_optim_off_uncompiled_path(self, saved_model):
+        path, x, ref = saved_model
+        config = paddle.inference.Config(path)
+        config.switch_ir_optim(False)
+        p = paddle.inference.create_predictor(config)
+        import jax
+        assert not isinstance(p._run, jax.stages.Wrapped), \
+            "ir_optim=False must use the per-call replay, not cached jit"
+        (out,) = p.run([x])
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_inert_knobs_warn_loudly(self, saved_model):
+        path, _, _ = saved_model
+        config = paddle.inference.Config(path)
+        with pytest.warns(UserWarning, match="enable_use_gpu"):
+            config.enable_use_gpu()
+        with pytest.warns(UserWarning, match="mkldnn"):
+            config.enable_mkldnn()
+        with pytest.warns(UserWarning, match="tensorrt"):
+            config.enable_tensorrt_engine(workspace_size=1 << 20)
+        with pytest.warns(UserWarning, match="initialized"):
+            config.set_cpu_math_library_num_threads(4)
+
+    def test_repeated_runs_reuse_compile(self, saved_model):
+        path, x, ref = saved_model
+        config = paddle.inference.Config(path)
+        p = paddle.inference.create_predictor(config)
+        for _ in range(3):
+            (out,) = p.run([np.copy(x)])
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+class TestProgramPathServing:
+    def test_native_program_artifact(self, tmp_path):
+        """A static save_inference_model artifact (JSON program) serves
+        through the same Predictor."""
+        paddle.static.reset_default_programs()
+        with paddle.static.program_guard(paddle.static.Program()) as prog:
+            x = paddle.static.data("x", [None, 4])
+            w = paddle.create_parameter([4, 2], "float32")
+            y = paddle.matmul(x, w)
+        prefix = os.path.join(str(tmp_path), "m")
+        paddle.static.save_inference_model(prefix, [x], [y], program=prog)
+        config = paddle.inference.Config(prefix)
+        p = paddle.inference.create_predictor(config)
+        assert p.get_input_names() == ["x"]
+        xv = np.random.RandomState(1).randn(5, 4).astype("f4")
+        (out,) = p.run([xv])
+        assert out.shape == (5, 2)
+
+    def test_reference_protobuf_artifact(self, tmp_path):
+        """A reference-format __model__ dir serves via create_predictor
+        (ties the protobuf interop into the deployment surface)."""
+        from tests.test_paddle_pb import (compile_reference_proto,
+                                          _save_ref_style_mlp)
+        fw = compile_reference_proto()
+        if fw is None:
+            pytest.skip("protoc/reference proto unavailable")
+        forward = _save_ref_style_mlp(fw, str(tmp_path), combined=True)
+        config = paddle.inference.Config(str(tmp_path),
+                                         params_file="__params__")
+        p = paddle.inference.create_predictor(config)
+        assert p.get_input_names() == ["x"]
+        assert p.get_output_names() == ["out"]
+        xv = np.random.RandomState(2).randn(6, 8).astype("f4")
+        (out,) = p.run([xv])
+        np.testing.assert_allclose(out, forward(xv), rtol=1e-5, atol=1e-5)

@@ -25,27 +25,35 @@ REF_PROTO = "/root/reference/paddle/fluid/framework/framework.proto"
 
 # ----------------------------------------------------------- fixture gen
 
-@pytest.fixture(scope="module")
-def fw():
-    """Compiled framework_pb2 module from the reference schema."""
+def compile_reference_proto():
+    """Compiled framework_pb2 module from the reference schema, or None
+    (protoc / reference tree / protobuf runtime unavailable)."""
     if not os.path.exists(REF_PROTO):
-        pytest.skip("reference proto not available")
+        return None
     try:
         import google.protobuf  # noqa: F401
     except ImportError:
-        pytest.skip("protobuf runtime not available")
+        return None
     tmp = tempfile.mkdtemp()
     r = subprocess.run(["protoc", f"-I{os.path.dirname(REF_PROTO)}",
                         f"--python_out={tmp}", REF_PROTO],
                        capture_output=True, text=True)
     if r.returncode != 0:
-        pytest.skip(f"protoc failed: {r.stderr[:200]}")
+        return None
     sys.path.insert(0, tmp)
     try:
         import framework_pb2
     finally:
         sys.path.pop(0)
     return framework_pb2
+
+
+@pytest.fixture(scope="module")
+def fw():
+    mod = compile_reference_proto()
+    if mod is None:
+        pytest.skip("protoc/reference proto/protobuf runtime unavailable")
+    return mod
 
 
 def _add_var(block, name, dtype, dims, persistable=False, vtype=None):
