@@ -46,15 +46,81 @@ class _SyntheticTextDataset(Dataset):
 
 
 class Imdb(_SyntheticTextDataset):
-    """Sentiment classification (ref text/datasets/imdb.py API: mode,
-    cutoff; word_idx vocab)."""
+    """Sentiment classification (ref text/datasets/imdb.py). With a
+    `data_file`, parses the REAL aclImdb_v1.tar.gz format exactly as the
+    reference does (tar members aclImdb/{split}/{pos,neg}/*.txt,
+    punctuation-stripped lowercase tokenization, frequency-cutoff vocab
+    sorted by (-freq, word), '<unk>' appended; pos label 0, neg 1,
+    variable-length docs). Without one (zero-egress default), synthetic
+    sequences with the same API."""
 
     def __init__(self, data_file=None, mode="train", cutoff=150,
                  seq_len=128, vocab_size=5000, num_samples=2000):
+        assert mode.lower() in ("train", "test"), mode
+        self.mode = mode.lower()
+        if data_file is not None:
+            self.data_file = data_file
+            self.word_idx = self._build_word_dict(cutoff)
+            self._load_anno()
+            self.num_samples = len(self.docs)
+            return
         super().__init__(num_samples, seq_len, vocab_size, 2,
                          seed=0 if mode == "train" else 1)
         self.word_idx = {f"w{i}": i for i in range(vocab_size)}
-        self.mode = mode
+
+    # ---- real-format path (ref imdb.py:95-140)
+    def _tokenize(self, pattern):
+        import re
+        import string
+        import tarfile
+        table = bytes.maketrans(b"", b"")
+        strip = string.punctuation.encode()
+        docs = []
+        with tarfile.open(self.data_file) as tarf:
+            tf = tarf.next()
+            while tf is not None:
+                if pattern.match(tf.name):
+                    raw = tarf.extractfile(tf).read().rstrip(b"\n\r")
+                    docs.append(
+                        raw.translate(table, strip).lower().split())
+                tf = tarf.next()
+        return docs
+
+    def _build_word_dict(self, cutoff):
+        import collections
+        import re
+        freq = collections.defaultdict(int)
+        pat = re.compile(
+            r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(pat):
+            for w in doc:
+                freq[w] += 1
+        kept = [x for x in freq.items() if x[1] > cutoff]
+        kept.sort(key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx['<unk>'] = len(kept)   # str key like the reference
+        return word_idx
+
+    def _load_anno(self):
+        import re
+        unk = self.word_idx['<unk>']
+        self.docs, self.labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            pat = re.compile(
+                r"aclImdb/{}/{}/.*\.txt$".format(self.mode, sub))
+            for doc in self._tokenize(pat):
+                self.docs.append(
+                    [self.word_idx.get(w, unk) for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        if hasattr(self, "docs"):
+            return (np.array(self.docs[idx]),
+                    np.array([self.labels[idx]]))
+        return super().__getitem__(idx)
+
+    def __len__(self):
+        return self.num_samples
 
 
 class Imikolov(Dataset):
@@ -159,11 +225,82 @@ class _SyntheticTranslationDataset(Dataset):
 
 
 class WMT14(_SyntheticTranslationDataset):
-    """ref text/datasets/wmt14.py (dict_size)."""
+    """ref text/datasets/wmt14.py. With a `data_file`, parses the REAL
+    wmt14 tarball format exactly as the reference does: `*src.dict` /
+    `*trg.dict` members (one token per line, first dict_size lines),
+    `{mode}/{mode}` members of tab-separated src/trg sentence pairs,
+    <s>/<e> wrapping, UNK_IDX=2, >80-token pairs dropped. Without one,
+    synthetic permutation translation with the same API."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+    UNK_IDX = 2
 
     def __init__(self, data_file=None, mode="train", dict_size=1000,
                  seq_len=16, num_samples=2000):
+        assert mode.lower() in ("train", "test", "gen"), mode
+        if data_file is not None:
+            self.mode = mode.lower()
+            self.data_file = data_file
+            self.dict_size = int(dict_size)
+            assert self.dict_size > 0, "dict_size should be positive"
+            self._load_real()
+            self.num_samples = len(self.src_ids)
+            return
         super().__init__(mode, dict_size, dict_size, seq_len, num_samples)
+
+    # ---- real-format path (ref wmt14.py:106-165)
+    def _load_real(self):
+        import tarfile
+
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.decode().strip()] = i
+            return out
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file, mode="r") as f:
+            names = [m.name for m in f if m.name.endswith("src.dict")]
+            assert len(names) == 1, names
+            self.src_dict = to_dict(f.extractfile(names[0]), self.dict_size)
+            names = [m.name for m in f if m.name.endswith("trg.dict")]
+            assert len(names) == 1, names
+            self.trg_dict = to_dict(f.extractfile(names[0]), self.dict_size)
+            suffix = "{}/{}".format(self.mode, self.mode)
+            for name in [m.name for m in f if m.name.endswith(suffix)]:
+                for line in f.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, self.UNK_IDX)
+                           for w in ([self.START] + parts[0].split()
+                                     + [self.END])]
+                    trg = [self.trg_dict.get(w, self.UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.trg_ids_next.append(trg + [self.trg_dict[self.END]])
+                    self.trg_ids.append([self.trg_dict[self.START]] + trg)
+                    self.src_ids.append(src)
+
+    def get_dict(self, reverse=False):
+        src, trg = self.src_dict, self.trg_dict
+        if reverse:
+            src = {v: k for k, v in src.items()}
+            trg = {v: k for k, v in trg.items()}
+        return src, trg
+
+    def __getitem__(self, idx):
+        if hasattr(self, "src_ids"):
+            return (np.array(self.src_ids[idx]),
+                    np.array(self.trg_ids[idx]),
+                    np.array(self.trg_ids_next[idx]))
+        return super().__getitem__(idx)
+
+    def __len__(self):
+        return self.num_samples
 
 
 class WMT16(_SyntheticTranslationDataset):

@@ -230,3 +230,137 @@ def test_flowers_real_folder_split_and_transform(tmp_path, monkeypatch):
     assert not (tr_paths & te_paths)
     tr[0]
     assert calls, "transform was not applied on the real path"
+
+
+# ------------------------------------------------------------------ text
+
+def _make_aclimdb(path, docs):
+    """Write a REAL aclImdb_v1.tar.gz-format archive: members named
+    aclImdb/{split}/{pos,neg}/<i>.txt holding raw review text."""
+    import io
+    with tarfile.open(path, "w:gz") as tf:
+        for i, (split, sent, text) in enumerate(docs):
+            data = text.encode()
+            info = tarfile.TarInfo(f"aclImdb/{split}/{sent}/{i}.txt")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+class TestImdbRealFormat:
+    DOCS = [
+        ("train", "pos", "A truly great film, great acting!\n"),
+        ("train", "pos", "great great great. Loved it...\n"),
+        ("train", "neg", "terrible film; bad acting and a bad plot\n"),
+        ("train", "neg", "bad bad film\n"),
+        ("test", "pos", "great film\n"),
+        ("test", "neg", "bad film\n"),
+    ]
+
+    def test_parse_tokenization_and_vocab(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "aclImdb_v1.tar.gz")
+        _make_aclimdb(tar, self.DOCS)
+        ds = pt.text.Imdb(data_file=tar, mode="train", cutoff=2)
+        # vocab: words with freq > 2 over the WHOLE archive, sorted by
+        # (-freq, word): great(6) bad(5) film(5) -> plus <unk>
+        words = sorted(ds.word_idx, key=lambda w: ds.word_idx[w])
+        assert words[:3] == [b"great", b"bad", b"film"]
+        assert ds.word_idx["<unk>"] == 3
+        # train split: 2 pos (label 0) then 2 neg (label 1)
+        assert len(ds) == 4
+        doc0, lab0 = ds[0]
+        assert lab0[0] == 0
+        # 'a truly great film great acting' -> unk unk great film great unk
+        unk, great, film = 3, ds.word_idx[b"great"], ds.word_idx[b"film"]
+        assert doc0.tolist() == [unk, unk, great, film, great, unk]
+        _, lab3 = ds[3]
+        assert lab3[0] == 1
+
+    def test_punctuation_stripped_lowercase(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "a.tar.gz")
+        _make_aclimdb(tar, [("train", "pos", "GREAT!!! great, (great)\n"),
+                            ("train", "neg", "bad\n")])
+        ds = pt.text.Imdb(data_file=tar, mode="train", cutoff=0)
+        assert b"great" in ds.word_idx
+        assert not any(b"!" in w for w in ds.word_idx
+                       if isinstance(w, bytes))
+        doc, _ = ds[0]
+        g = ds.word_idx[b"great"]
+        assert doc.tolist() == [g, g, g]
+
+    def test_test_split_reuses_global_vocab(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "a.tar.gz")
+        _make_aclimdb(tar, self.DOCS)
+        tr = pt.text.Imdb(data_file=tar, mode="train", cutoff=2)
+        te = pt.text.Imdb(data_file=tar, mode="test", cutoff=2)
+        assert tr.word_idx == te.word_idx     # dict built on full corpus
+        assert len(te) == 2
+
+    def test_synthetic_default_unchanged(self):
+        ds = pt.text.Imdb(mode="train", num_samples=8)
+        toks, lab = ds[0]
+        assert toks.shape == (128,) and int(lab) in (0, 1)
+
+
+def _make_wmt14(path, pairs, src_vocab, trg_vocab):
+    """Write a REAL wmt14-format tgz: src.dict/trg.dict (one token per
+    line) + train/train, test/test tab-separated sentence pairs."""
+    import io
+    with tarfile.open(path, "w:gz") as tf:
+        def add(name, text):
+            data = text.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        add("wmt14/src.dict", "\n".join(src_vocab) + "\n")
+        add("wmt14/trg.dict", "\n".join(trg_vocab) + "\n")
+        for split in ("train", "test"):
+            lines = "".join(f"{s}\t{t}\n" for sp, s, t in pairs
+                            if sp == split)
+            add(f"{split}/{split}", lines)
+
+
+class TestWMT14RealFormat:
+    SRC = ["<s>", "<e>", "<unk>", "le", "chat", "noir"]
+    TRG = ["<s>", "<e>", "<unk>", "the", "cat", "black"]
+    PAIRS = [
+        ("train", "le chat", "the cat"),
+        ("train", "le chat noir", "the black cat"),
+        ("train", "zzz chat", "the cat"),       # zzz -> UNK
+        ("test", "le chat", "the cat"),
+    ]
+
+    def test_parse_dicts_and_pairs(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "wmt14.tgz")
+        _make_wmt14(tar, self.PAIRS, self.SRC, self.TRG)
+        ds = pt.text.WMT14(data_file=tar, mode="train", dict_size=6)
+        assert len(ds) == 3
+        src, trg, trg_next = ds[0]
+        # <s> le chat <e> / <s> the cat / the cat <e>
+        assert src.tolist() == [0, 3, 4, 1]
+        assert trg.tolist() == [0, 3, 4]
+        assert trg_next.tolist() == [3, 4, 1]
+        src2, _, _ = ds[2]
+        assert src2.tolist() == [0, 2, 4, 1]    # zzz -> UNK_IDX 2
+        sd, td = ds.get_dict()
+        assert sd["chat"] == 4 and td["black"] == 5
+        rd, _ = ds.get_dict(reverse=True)
+        assert rd[4] == "chat"
+
+    def test_dict_size_truncates(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "wmt14.tgz")
+        _make_wmt14(tar, self.PAIRS, self.SRC, self.TRG)
+        ds = pt.text.WMT14(data_file=tar, mode="train", dict_size=4)
+        # 'chat'(4) and 'noir'(5) fall out of the dict -> UNK
+        src, _, _ = ds[0]
+        assert src.tolist() == [0, 3, 2, 1]
+
+    def test_test_split(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "wmt14.tgz")
+        _make_wmt14(tar, self.PAIRS, self.SRC, self.TRG)
+        ds = pt.text.WMT14(data_file=tar, mode="test", dict_size=6)
+        assert len(ds) == 1
+
+    def test_synthetic_default_unchanged(self):
+        ds = pt.text.WMT14(mode="train", num_samples=4)
+        src, trg_in, trg = ds[0]
+        assert src.shape == trg.shape == (16,)
