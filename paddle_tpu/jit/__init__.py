@@ -41,6 +41,35 @@ def _wrap(x):
     return x
 
 
+def _split_static(args, kwargs):
+    """Partition call arguments: array leaves become jit inputs, python
+    scalars/strings stay COMPILE-TIME constants (the reference's
+    dy2static contract — a python bool arg selects code paths and must
+    not become a traced pred). Returns (dyn_leaves, hashable_meta)."""
+    import numpy as np
+    leaves, tree = jax.tree_util.tree_flatten((args, kwargs))
+    dyn, static = [], []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, (jax.Array, np.ndarray)):
+            dyn.append(leaf)
+        else:
+            static.append((i, leaf))
+    return tuple(dyn), (tree, len(leaves), tuple(static))
+
+
+_MISSING = object()
+
+
+def _merge_static(dyn, meta):
+    tree, n, static = meta
+    leaves = [_MISSING] * n
+    for i, v in static:
+        leaves[i] = v
+    it = iter(dyn)
+    leaves = [next(it) if v is _MISSING else v for v in leaves]
+    return jax.tree_util.tree_unflatten(tree, leaves)
+
+
 class StaticFunction:
     """Wraps a Layer (or plain function) into a jit-compiled callable keeping
     the dygraph Tensor interface."""
@@ -65,39 +94,41 @@ class StaticFunction:
                 if fwd is not type(layer).forward:
                     layer.__dict__["forward"] = _types.MethodType(fwd, layer)
 
-            def pure(params, buffers, key, args, kwargs):
+            def pure(params, buffers, key, dyn, meta):
+                args, kwargs = _merge_static(dyn, meta)
                 with state.functional_rng_ctx(key):
                     out, new_buf = layer.functional_call(
                         params, buffers, *_wrap(args), **_wrap(kwargs))
                 return _unwrap(out), new_buf
 
-            self._compiled = jax.jit(pure)
+            self._compiled = jax.jit(pure, static_argnums=(4,))
         else:
             fn = dy2static.convert_function(self._target) if convert \
                 else self._target
 
-            def pure(key, args, kwargs):
+            def pure(key, dyn, meta):
+                args, kwargs = _merge_static(dyn, meta)
                 with state.functional_mode_ctx():
                     with state.functional_rng_ctx(key):
                         out = fn(*_wrap(args), **_wrap(kwargs))
                 return _unwrap(out)
 
-            self._compiled = jax.jit(pure)
+            self._compiled = jax.jit(pure, static_argnums=(2,))
 
     def __call__(self, *args, **kwargs):
         if self._compiled is None:
             self._build()
         key = state.next_rng_key()
+        dyn, meta = _split_static(_unwrap(args), _unwrap(kwargs))
         if self._is_layer:
             params, buffers = self._target.functional_state()
-            out, new_buf = self._compiled(params, buffers, key,
-                                          _unwrap(args), _unwrap(kwargs))
+            out, new_buf = self._compiled(params, buffers, key, dyn, meta)
             # write back mutated buffers (BN running stats)
             named_b = dict(self._target.named_buffers())
             for n, arr in new_buf.items():
                 named_b[n]._data = arr
             return _wrap(out)
-        return _wrap(self._compiled(key, _unwrap(args), _unwrap(kwargs)))
+        return _wrap(self._compiled(key, dyn, meta))
 
     # paddle surface
     @property

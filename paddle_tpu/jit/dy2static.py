@@ -94,14 +94,34 @@ def _split_dynamic(vals):
     return dyn_idx
 
 
-def convert_ifelse(pred, true_fn, false_fn, get, reset):
+def _scalar_pred(p):
+    """lax.cond/while_loop need scalar bool preds; the fluid idiom is a
+    shape-[1] tensor condition (fill_constant(shape=[1]) counters) —
+    squeeze any size-1 pred to a scalar."""
+    if _is_traced(p) and getattr(p, "ndim", 0) \
+            and getattr(p, "size", None) == 1:
+        return p.reshape(())
+    return p
+
+
+def _is_internal_placeholder(name):
+    """Generated slots (return value/flag) whose not-assigned-branch value
+    is never observed — safe to coerce to the assigned branch's aval."""
+    return bool(name) and name.startswith("__pt_ret")
+
+
+def convert_ifelse(pred, true_fn, false_fn, get, reset, names=None):
     """Emitted for `if`: concrete pred runs one branch in place; traced pred
     lowers to lax.cond. Branch outputs are discovered during tracing: each
     branch closes over the enclosing frame (captured tracers become cond
     constants) and reports, per captured variable, whether it produced a
     dynamic value (carried through cond) or a static one (must agree across
-    branches — same constraint the reference's ifelse_transformer imposes)."""
-    p = _unwrap(pred)
+    branches — same constraint the reference's ifelse_transformer imposes).
+    Internal return-machinery slots get ONE reconciliation retry: the
+    placeholder side coerces to zeros of the assigned side's aval (the ref
+    RETURN_NO_VALUE contract — the value is only read when the flag says
+    the assignment fired)."""
+    p = _scalar_pred(_unwrap(pred))
     if not _is_traced(p):
         (true_fn if bool(p) else false_fn)()
         return get() if get is not None else ()
@@ -112,54 +132,99 @@ def convert_ifelse(pred, true_fn, false_fn, get, reset):
                      lambda _: (false_fn(), ())[1], None)
         return ()
     orig = get()
-    specs = {}  # branch name -> list of ('dyn',) | ('static', value)
+    # branch name -> list of ('dyn', aval, assigned) | ('static', v, assigned)
+    specs = {}
 
-    def run(fn, tag):
+    def run(fn, tag, coerce=None):
         def branch(_):
             reset(orig)
             fn()
             out = get()
             spec, leaves = [], []
-            for v in out:
+            for i, v in enumerate(out):
                 u = _unwrap(v)
-                if isinstance(u, (jax.Array, jax.core.Tracer)) or \
-                        isinstance(u, (int, float, bool)) and \
-                        not isinstance(v, _Undef):
-                    spec.append("dyn")
-                    leaves.append(jnp.asarray(u))
+                assigned = v is not orig[i]
+                dyn = isinstance(u, (jax.Array, jax.core.Tracer)) or \
+                    isinstance(u, (int, float, bool)) and \
+                    not isinstance(v, _Undef)
+                if coerce and i in coerce:
+                    want = coerce[i]
+                    leaf = jnp.asarray(u) if dyn else None
+                    if leaf is None or jnp.shape(leaf) != want.shape \
+                            or leaf.dtype != want.dtype:
+                        leaf = jnp.zeros(want.shape, want.dtype)
+                    spec.append(("dyn", jax.typeof(leaf), assigned))
+                    leaves.append(leaf)
+                elif dyn:
+                    leaf = jnp.asarray(u)
+                    spec.append(("dyn", jax.typeof(leaf), assigned))
+                    leaves.append(leaf)
                 else:
-                    spec.append(("static", v))
+                    spec.append(("static", v, assigned))
             specs[tag] = spec
             return tuple(leaves)
         return branch
 
+    def attempt(coerce=None):
+        return jax.lax.cond(p, run(true_fn, "true", coerce),
+                            run(false_fn, "false", coerce), None)
+
     try:
-        res = jax.lax.cond(p, run(true_fn, "true"), run(false_fn, "false"),
-                           None)
+        res = attempt()
     except (TypeError, ValueError) as e:
-        # Diagnose: if the branches disagree on which vars are tensors,
-        # lax.cond raises a generic pytree-structure error — both branch
-        # specs were already collected during its tracing, so we can
-        # replace it with an actionable message.
         both = specs.get("true"), specs.get("false")
-        if all(s is not None for s in both) and any(
-                (st == "dyn") != (sf == "dyn")
-                for st, sf in zip(*both)):
+        coerce = {}
+        mismatch = False
+        if all(s is not None for s in both):
+            for i, (st, sf) in enumerate(zip(*both)):
+                if st[0] == sf[0] == "dyn" and st[1] == sf[1]:
+                    continue
+                mismatch = True
+                nm = names[i] if names and i < len(names) else None
+                if not _is_internal_placeholder(nm):
+                    continue
+                # coerce ONLY a placeholder side (unassigned, or an
+                # assigned static None — `return None` is the reference's
+                # RETURN_NO_VALUE) to the dyn side's aval. Two branches
+                # that both ASSIGN dyn values of different shapes is a
+                # user error, not a placeholder artifact.
+                def real(s):
+                    return s[0] == "dyn" and s[2]
+                if real(st) and real(sf):
+                    raise ValueError(
+                        "dy2static: `return` values under a traced "
+                        "`if` have different shapes/dtypes across "
+                        "branches — XLA needs one output type; return "
+                        "consistently shaped values") from e
+                target = [s[1] for s in (st, sf) if real(s)]
+                if not target:
+                    # neither side is a real assignment (`return None` vs
+                    # the untouched placeholder): unify on any dyn aval
+                    target = [s[1] for s in (st, sf) if s[0] == "dyn"]
+                if target:
+                    coerce[i] = target[0]
+        if coerce:
+            res = attempt(coerce)
+        elif mismatch and any((st[0] == "dyn") != (sf[0] == "dyn")
+                              for st, sf in zip(*both)):
+            # branches disagree on which USER vars are tensors:
+            # lax.cond's generic pytree error, made actionable
             raise ValueError(
                 "dy2static: a variable is a tensor in one branch of a "
                 "traced `if` but not the other — assign it consistently "
                 "in both branches") from e
-        raise
+        else:
+            raise
     spec_t, spec_f = specs["true"], specs["false"]
     for st, sf in zip(spec_t, spec_f):
-        if (st == "dyn") != (sf == "dyn"):
+        if (st[0] == "dyn") != (sf[0] == "dyn"):
             raise ValueError(
                 "dy2static: a variable is a tensor in one branch of a "
                 "traced `if` but not the other — assign it consistently "
                 "in both branches")
     final, j = [], 0
     for i, s in enumerate(spec_t):
-        if s == "dyn":
+        if s[0] == "dyn":
             final.append(Tensor(res[j]) if isinstance(orig[i], Tensor)
                          or isinstance(orig[i], _Undef) else res[j])
             j += 1
@@ -192,6 +257,7 @@ def convert_while(cond_fn, body_fn, get, reset, names=None):
 
 def _lax_while(cond_fn, body_fn, get, reset, orig, names=None):
     dyn_idx = _split_dynamic(orig)
+    body_avals = {}        # var index -> aval the body actually produced
 
     def put(carry):
         full = list(orig)
@@ -202,7 +268,7 @@ def _lax_while(cond_fn, body_fn, get, reset, orig, names=None):
 
     def c(carry):
         put(carry)
-        return _unwrap(cond_fn())
+        return _scalar_pred(_unwrap(cond_fn()))
 
     def b(carry):
         put(carry)
@@ -226,12 +292,67 @@ def _lax_while(cond_fn, body_fn, get, reset, orig, names=None):
         new = []
         for j, i in enumerate(dyn_idx):
             u = jnp.asarray(_unwrap(out[i]))
+            body_avals[i] = jax.typeof(u)
             new.append(u.astype(carry[j].dtype)
                        if u.dtype != carry[j].dtype else u)
         return tuple(new)
 
     carry0 = tuple(jnp.asarray(_unwrap(orig[i])) for i in dyn_idx)
-    res = jax.lax.while_loop(c, b, carry0)
+    try:
+        res = jax.lax.while_loop(c, b, carry0)
+    except (TypeError, ValueError):
+        # return-machinery placeholders enter the loop as scalar 0.0 but
+        # the body assigns the real return value's shape/dtype — coerce
+        # the ENTRY carry to the body's aval (zeros; only read when the
+        # return flag fired) and retry once. Only the UNTOUCHED 0.0
+        # placeholder qualifies: a traced entry value means an earlier
+        # `return` already produced a real value of a different shape,
+        # which no fixed carry can represent.
+        carry0l, origl = list(carry0), list(orig)
+        coerced = False
+        for j, i in enumerate(dyn_idx):
+            nm = names[i] if names and i < len(names) else None
+            want = body_avals.get(i)
+            have = jax.typeof(carry0l[j])
+            if not (_is_internal_placeholder(nm) and want is not None
+                    and (want.shape, want.dtype)
+                    != (have.shape, have.dtype)):
+                continue
+            # provenance check on the RAW pre-asarray value: the untouched
+            # placeholder is the python float 0.0 the return transformer
+            # emitted, with the return FLAG still the python False it was
+            # initialized to. In NESTED lowered loops the outer carry
+            # turns the placeholder into a scalar tracer before the inner
+            # loop sees it, so a scalar-()-shaped slot widening to a
+            # shaped body value is also accepted as a placeholder.
+            # Known approximation: an earlier traced `return <scalar>`
+            # followed by a loop `return <shaped>` coerces the scalar
+            # away (zeros) instead of erroring — the runtime-dependent
+            # return STRUCTURE XLA cannot represent anyway.
+            raw = _unwrap(orig[i])
+            flag_raw = False
+            if names and "__pt_ret_flag" in names:
+                flag_raw = _unwrap(orig[names.index("__pt_ret_flag")])
+            is_placeholder = (
+                (isinstance(raw, float) and raw == 0.0
+                 and flag_raw is False)
+                or (_is_traced(raw) and jnp.shape(raw) == ()
+                    and want.ndim > 0))
+            if not is_placeholder:
+                raise ValueError(
+                    "dy2static: `return` values on different paths "
+                    "through a traced loop have different shapes/dtypes "
+                    "— XLA needs one output type; return consistently "
+                    "shaped values")
+            z = jnp.zeros(want.shape, want.dtype)
+            carry0l[j] = z
+            origl[i] = Tensor(z) if isinstance(orig[i], Tensor) else z
+            coerced = True
+        if not coerced:
+            raise
+        orig = tuple(origl)           # put()/b() close over this name
+        carry0 = tuple(carry0l)
+        res = jax.lax.while_loop(c, b, carry0)
     final = list(orig)
     for j, i in enumerate(dyn_idx):
         final[i] = Tensor(res[j]) if isinstance(orig[i], Tensor) else res[j]
@@ -731,6 +852,20 @@ class _ControlFlowTransformer(ast.NodeTransformer):
     def visit_Lambda(self, node):
         return node
 
+    @staticmethod
+    def _restore_locs(new_stmts, old_stmts):
+        """Copy source locations from original statements onto their
+        unparse->reparse equivalents (the runtime error source map:
+        lowered branch/loop bodies keep the user's line numbers).
+        Structures match by construction; best-effort on drift."""
+        for new, old in zip(new_stmts, old_stmts):
+            for a, b in zip(ast.walk(new), ast.walk(old)):
+                if type(a) is not type(b):
+                    break
+                if hasattr(b, "lineno") \
+                        and "lineno" in getattr(a, "_attributes", ()):
+                    ast.copy_location(a, b)
+
     def _emit_cluster(self, n, vars_, defs, call_expr):
         """Common tail: getter/resetter defs + result assignment."""
         stmts = list(defs)
@@ -774,15 +909,20 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 body_src, "    ")
             if not body:
                 src = f"def {name}():\n{nl}    pass"
-            return ast.parse(src).body[0]
+            fn_def = ast.parse(src).body[0]
+            if body:
+                off = 1 if vars_ else 0        # skip the nonlocal stmt
+                self._restore_locs(fn_def.body[off:], body)
+            return fn_def
 
         defs = self._guards(vars_) + [
             mk_branch(f"__pt_true_{n}", node.body),
             mk_branch(f"__pt_false_{n}", node.orelse)]
         get = f"__pt_get_{n}" if vars_ else "None"
         reset = f"__pt_reset_{n}" if vars_ else "None"
+        names_lit = "(" + "".join(f"{v!r}, " for v in vars_) + ")"
         call = (f"_jst.convert_ifelse(({test_src}), __pt_true_{n}, "
-                f"__pt_false_{n}, {get}, {reset})")
+                f"__pt_false_{n}, {get}, {reset}, names={names_lit})")
         return self._emit_cluster(n, vars_, defs, call)
 
     def visit_For(self, node):
@@ -852,8 +992,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         body_src = "\n".join(ast.unparse(s) for s in node.body) or "pass"
         body_def = f"def __pt_body_{n}():\n{nl}" + textwrap.indent(
             body_src, "    ")
+        body_node = ast.parse(body_def).body[0]
+        off = 1 if vars_ else 0                # skip the nonlocal stmt
+        self._restore_locs(body_node.body[off:], node.body)
         defs = self._guards(vars_) + [ast.parse(cond_src).body[0],
-                                      ast.parse(body_def).body[0]]
+                                      body_node]
         get = f"__pt_get_{n}" if vars_ else "None"
         reset = f"__pt_reset_{n}" if vars_ else "None"
         names_lit = ("(" + ", ".join(repr(v) for v in vars_) + ",)"
@@ -885,6 +1028,21 @@ def convert_function(fn):
     if not isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
     fn_node.decorator_list = []
+    # runtime error source map: shift the (dedented) tree back to the
+    # function's true location so the converted code object carries the
+    # ORIGINAL line numbers, and compile under the original filename —
+    # a traceback raised inside a lowered loop/branch body then points
+    # at the user's source line, not at rewritten synthetic code (ref
+    # dygraph_to_static/error.py's OriginInfo map; here the code object
+    # itself is the map)
+    first_line = getattr(getattr(fn, "__code__", None), "co_firstlineno", 1)
+    if first_line > 1:
+        ast.increment_lineno(tree, first_line - 1)
+    src_file = None
+    try:
+        src_file = inspect.getsourcefile(fn)
+    except TypeError:
+        pass
     def _range_for(nd):
         return (isinstance(nd, ast.For)
                 and isinstance(nd.iter, ast.Call)
@@ -914,6 +1072,26 @@ def convert_function(fn):
         new_body.extend(out if isinstance(out, list) else [out])
     fn_node.body = new_body
     ast.fix_missing_locations(tree)
+    if first_line > 1:
+        # synthetic nodes were mini-parsed with lines 1..k, which the
+        # shifted original lines can never be — restamp them with the
+        # nearest enclosing ORIGINAL line so every traceback frame in
+        # converted code lands on a real user source line
+        def stamp(node, cur):
+            ln = getattr(node, "lineno", None)
+            if ln is not None:
+                if ln >= first_line:
+                    cur = ln
+                else:
+                    node.lineno = cur
+                    node.col_offset = 0
+            if getattr(node, "end_lineno", None) is not None \
+                    and node.end_lineno < first_line:
+                node.end_lineno = cur
+                node.end_col_offset = 0
+            for child in ast.iter_child_nodes(node):
+                stamp(child, cur)
+        stamp(fn_node, first_line)
 
     glb = dict(fn.__globals__)
     glb["_jst"] = _JST
@@ -924,8 +1102,10 @@ def convert_function(fn):
             except ValueError:
                 pass
     try:
-        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
-                       mode="exec")
+        code = compile(
+            tree,
+            filename=src_file or f"<dy2static {fn.__qualname__}>",
+            mode="exec")
         exec(code, glb)
         new_fn = glb[fn_node.name]
     except SyntaxError as e:  # pragma: no cover - surface, keep original
