@@ -539,8 +539,13 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 
 def _poolnd_raw(a, n=2, ksize=1, strides=None, padding=0,
-                channels_last=False, average=False, count_include_pad=True):
-    """Shared 1/2/3-d pooling over lax.reduce_window (NCX or NXC)."""
+                channels_last=False, average=False, count_include_pad=True,
+                ceil_mode=False):
+    """Shared 1/2/3-d pooling over lax.reduce_window (NCX or NXC).
+    ceil_mode=True rounds the output size UP (ref pooling.cc
+    AdaptEndIndex/ceil branch) by extending the high-edge padding;
+    the extra cells never count toward an exclusive average (the ones
+    window sees them as padding)."""
     ksize = _norm_tuple(ksize, n)
     strides = _norm_tuple(strides or ksize, n)
     if not channels_last:
@@ -550,6 +555,15 @@ def _poolnd_raw(a, n=2, ksize=1, strides=None, padding=0,
         dims = (1,) + ksize + (1,)
         strd = (1,) + strides + (1,)
     pad = _conv_padding(padding, n, strides, (1,) * n, ksize)
+    if ceil_mode and not isinstance(pad, str):
+        spatial = a.shape[1:1 + n] if channels_last else a.shape[2:2 + n]
+        pad = [list(p) for p in pad]
+        for i in range(n):
+            total = spatial[i] + pad[i][0] + pad[i][1]
+            rem = (total - ksize[i]) % strides[i]
+            if rem:
+                pad[i][1] += strides[i] - rem   # one extra (partial) window
+        pad = [tuple(p) for p in pad]
     if isinstance(pad, str):
         pad_cfg = pad
     else:
@@ -585,6 +599,8 @@ def _pool(x, ksize, strides, padding, data_format, name,
              "strides": None if strides is None else _stride_attr(strides),
              "padding": _pad_attr(padding),
              "channels_last": data_format != "NCHW"}
+    if ceil_mode:
+        attrs["ceil_mode"] = True
     if average:
         attrs["count_include_pad"] = bool(count_include_pad)
     return apply(OP_REGISTRY[name], (x,), attrs, name=name)
@@ -604,6 +620,27 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  count_include_pad=count_include_pad, average=True)
 
 
+def _adaptive_bins(in_n, out_n):
+    """Reference adaptive bins (ref pooling.cc AdaptStartIndex/EndIndex):
+    bin i covers [floor(i*I/O), ceil((i+1)*I/O))."""
+    i = np.arange(out_n)
+    start = (i * in_n) // out_n
+    end = -((-(i + 1) * in_n) // out_n)      # ceil div
+    return start, end
+
+
+def _adaptive_avg_mat(in_n, out_n, dtype):
+    """[out_n, in_n] averaging matrix: the general adaptive mean becomes
+    a matmul over each spatial axis — static (trace-time) bin layout,
+    MXU-friendly, no data-dependent shapes."""
+    start, end = _adaptive_bins(in_n, out_n)
+    j = np.arange(in_n)
+    m = ((j[None, :] >= start[:, None])
+         & (j[None, :] < end[:, None])).astype(np.float32)
+    m /= m.sum(1, keepdims=True)
+    return jnp.asarray(m, dtype)
+
+
 def _adaptive_avg_pool2d_raw(a, output_size=1, channels_last=False):
     out_hw = _norm_tuple(output_size, 2)
     if not channels_last:
@@ -619,9 +656,17 @@ def _adaptive_avg_pool2d_raw(a, output_size=1, channels_last=False):
             return r.mean(axis=(3, 5))
         r = a.reshape(a.shape[0], oh, ih // oh, ow, iw // ow, a.shape[-1])
         return r.mean(axis=(2, 4))
-    # general: per-output-bin mean via cumsum trick is overkill; use resize
-    raise NotImplementedError(
-        "adaptive pooling with non-divisible sizes not supported")
+    # general (non-divisible) sizes: contract each spatial axis with its
+    # averaging matrix — two matmuls instead of gathers
+    acc = jnp.float32 if a.dtype != jnp.float64 else jnp.float64
+    wh = _adaptive_avg_mat(ih, oh, acc)
+    ww = _adaptive_avg_mat(iw, ow, acc)
+    af = a.astype(acc)
+    if not channels_last:
+        out = jnp.einsum("nchw,oh,pw->ncop", af, wh, ww)
+    else:
+        out = jnp.einsum("nhwc,oh,pw->nopc", af, wh, ww)
+    return out.astype(a.dtype)
 
 
 def _adaptive_max_pool2d_raw(a, output_size=1):
@@ -631,7 +676,14 @@ def _adaptive_max_pool2d_raw(a, output_size=1):
     if ih % oh == 0 and iw % ow == 0:
         r = a.reshape(a.shape[0], a.shape[1], oh, ih // oh, ow, iw // ow)
         return r.max(axis=(3, 5))
-    raise NotImplementedError
+    # general sizes: bins are static at trace time but ragged; reduce per
+    # output row/col with dynamic slices (O static, so the loop unrolls)
+    hs, he = _adaptive_bins(ih, oh)
+    ws, we = _adaptive_bins(iw, ow)
+    rows = jnp.stack([a[:, :, s:e, :].max(axis=2)
+                      for s, e in zip(hs, he)], axis=2)      # [N,C,oh,iw]
+    return jnp.stack([rows[:, :, :, s:e].max(axis=3)
+                      for s, e in zip(ws, we)], axis=3)
 
 
 register_op("adaptive_avg_pool2d", _adaptive_avg_pool2d_raw)
@@ -660,7 +712,7 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                       and stride else (stride[0] if stride else None), 1)
                      if stride else None,
                      padding=(padding if isinstance(padding, int) else padding[0],
-                              0))
+                              0), ceil_mode=ceil_mode)
     return out.squeeze(-1)
 
 
@@ -672,7 +724,8 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                      (stride if isinstance(stride, int) else None, 1)
                      if stride else None,
                      padding=(padding if isinstance(padding, int) else padding[0],
-                              0), count_include_pad=count_include_pad)
+                              0), ceil_mode=ceil_mode,
+                     count_include_pad=count_include_pad)
     return out.squeeze(-1)
 
 

@@ -110,8 +110,17 @@ def _t_pool2d(op, ctx):
     ksize = [int(k) for k in a.get("ksize", [1, 1])]
     nhwc = a.get("data_format") == "NHWC"
     if a.get("adaptive") and any(k != 1 for k in ksize):
-        raise NotImplementedError(
-            "pool2d adaptive with output size != 1 is not translated yet")
+        # adaptive pool2d: ksize IS the output size (ref pool_op.cc)
+        if a.get("pooling_type") == "avg":
+            ctx.emit("adaptive_avg_pool2d", [x], [_out(op)],
+                     {"output_size": ksize, "channels_last": nhwc})
+        else:
+            if nhwc:
+                raise NotImplementedError(
+                    "adaptive max pool2d NHWC not translated")
+            ctx.emit("adaptive_max_pool2d", [x], [_out(op)],
+                     {"output_size": ksize})
+        return
     if a.get("global_pooling") or a.get("adaptive"):
         dims = ctx.dims(x)
         if dims is None or len(dims) != 4:
@@ -122,11 +131,11 @@ def _t_pool2d(op, ctx):
         strides = [int(s) for s in a.get("strides", ksize)]
         padding = _pad_pairs(a.get("paddings", [0, 0]),
                              a.get("padding_algorithm"))
-    if a.get("ceil_mode"):
-        raise NotImplementedError("pool2d ceil_mode=True not translated")
     our = "avg_pool2d" if a.get("pooling_type") == "avg" else "max_pool2d"
     attrs = {"ksize": ksize, "strides": strides, "padding": padding,
              "channels_last": nhwc}
+    if a.get("ceil_mode"):
+        attrs["ceil_mode"] = True
     if our == "avg_pool2d":
         attrs["count_include_pad"] = not a.get("exclusive", True)
     ctx.emit(our, [x], [_out(op)], attrs)
@@ -402,6 +411,55 @@ def _t_fill_constant(op, ctx):
                   float(a.get("value", 0.0)), dtype)
     out = _out(op)
     ctx.emit("assign", [ctx.const(val, "fill")], [out])
+
+
+@translates("pad2d", "pad3d")
+def _t_pad2d(op, ctx):
+    a = op["attrs"]
+    p = [int(v) for v in a.get("paddings", [])]
+    want_len = 4 if op["type"] == "pad2d" else 6
+    if len(p) != want_len:
+        raise NotImplementedError(
+            f"{op['type']}: paddings supplied via input tensor (or "
+            f"malformed attr {p}) is not translated — only the "
+            f"{want_len}-element static attr form")
+    if op["type"] == "pad2d":       # ref order [t, b, l, r] -> ours [l,r,t,b]
+        p = [p[2], p[3], p[0], p[1]]
+    # pad3d: the reference attr order [l, r, t, b, front, back] already
+    # matches _pad_raw's innermost-first pairs — identity mapping
+    mode = a.get("mode", "constant")
+    ctx.emit("pad", [_one(op, "X")], [_out(op)],
+             {"pad": p, "mode": "replicate" if mode == "edge" else mode,
+              "value": float(a.get("pad_value", a.get("value", 0.0))),
+              "channels_first": a.get("data_format", "NCHW")
+              in ("NCHW", "NCDHW")})
+
+
+@translates("prelu")
+def _t_prelu(op, ctx):
+    ctx.emit("prelu", [_one(op, "X"), _one(op, "Alpha")], [_out(op)],
+             {"data_format": op["attrs"].get("data_format", "NCHW")})
+
+
+@translates("group_norm")
+def _t_group_norm(op, ctx):
+    a = op["attrs"]
+    if a.get("data_layout", "NCHW") == "NHWC":
+        raise NotImplementedError("group_norm NHWC not translated")
+    ins = [_one(op, "X")]
+    scale = _one(op, "Scale", required=False)
+    bias = _one(op, "Bias", required=False)
+    if bias and not scale:
+        # the raw op's (a, *wb) convention can't express bias-only
+        raise NotImplementedError(
+            "group_norm with Bias but no Scale not translated")
+    if scale:
+        ins.append(scale)
+        if bias:
+            ins.append(bias)
+    ctx.emit("group_norm", ins, [_out(op, "Y")],
+             {"num_groups": int(a.get("groups", 1)),
+              "epsilon": float(a.get("epsilon", 1e-5))})
 
 
 # ------------------------------------------------------------- embeddings

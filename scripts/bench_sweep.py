@@ -77,6 +77,11 @@ def sweep_gpt(batches, medium=False):
         model.to(dtype=jnp.bfloat16)
         opt = pt.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
+        if medium:
+            # BASELINE configs[3]: gpt2-medium runs recompute + bf16
+            from paddle_tpu.distributed.fleet.meta_optimizers import \
+                RecomputeOptimizer
+            opt = RecomputeOptimizer(opt)
         step = TrainStep(model, gpt_pretrain_loss, opt, donate=True)
         ids = np.random.RandomState(0).randint(
             0, cfg.vocab_size, (batch, seq)).astype("int32")

@@ -146,3 +146,36 @@ class TestSpectralNormStatePersists:
         est = _np(w)[0, 0] / _np(outs[-1])[0, 0]
         assert abs(est - sigma_true) / sigma_true < 1e-3, \
             (est, sigma_true)
+
+
+class TestPoolCeilMode:
+    """ceil_mode was silently dropped by the functional pool wrapper
+    (found wiring the protobuf pool2d translator)."""
+
+    def test_max_pool_ceil_shape_and_values(self):
+        import torch
+        import torch.nn.functional as TF
+        x = np.random.RandomState(0).randn(1, 2, 6, 6).astype("f4")
+        want = TF.max_pool2d(torch.from_numpy(x), 3, stride=2,
+                             ceil_mode=True).numpy()
+        got = _np(F.max_pool2d(paddle.to_tensor(x), 3, stride=2,
+                               ceil_mode=True))
+        assert got.shape == want.shape == (1, 2, 3, 3)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_avg_pool_ceil_exclusive(self):
+        import torch
+        import torch.nn.functional as TF
+        x = np.random.RandomState(1).randn(1, 1, 5, 5).astype("f4")
+        want = TF.avg_pool2d(torch.from_numpy(x), 2, stride=2,
+                             ceil_mode=True,
+                             count_include_pad=False).numpy()
+        got = _np(F.avg_pool2d(paddle.to_tensor(x), 2, stride=2,
+                               ceil_mode=True, count_include_pad=False))
+        assert got.shape == want.shape == (1, 1, 3, 3)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_ceil_mode_off_unchanged(self):
+        x = np.random.RandomState(2).randn(1, 1, 7, 7).astype("f4")
+        got = _np(F.max_pool2d(paddle.to_tensor(x), 3, stride=2))
+        assert got.shape == (1, 1, 3, 3)

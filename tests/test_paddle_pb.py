@@ -389,3 +389,55 @@ def test_unmapped_op_raises_clearly(fw, tmp_path):
     from paddle_tpu.static.paddle_compat import from_parsed
     with pytest.raises(NotImplementedError, match="some_exotic_op"):
         from_parsed(pb.parse_program(prog.SerializeToString()))
+
+
+def test_translate_pad_prelu_ceilpool(fw, tmp_path):
+    """Round-4 translator additions: pad2d ([t,b,l,r] reorder), prelu,
+    pool2d ceil_mode."""
+    rng = np.random.RandomState(7)
+    alpha = np.full((1,), 0.1, "f4")
+
+    prog = fw.ProgramDesc()
+    block = prog.blocks.add()
+    block.idx, block.parent_idx = 0, -1
+    _add_var(block, "feed", 5, [], vtype=fw.VarType.FEED_MINIBATCH)
+    _add_var(block, "fetch", 5, [], vtype=fw.VarType.FETCH_LIST)
+    _add_var(block, "x", 5, [-1, 1, 5, 5])
+    _add_var(block, "alpha", 5, [1], persistable=True)
+    for n, d in [("pd", [-1, 1, 7, 9]), ("pr", [-1, 1, 7, 9]),
+                 ("pl", [-1, 1, 4, 5])]:
+        _add_var(block, n, 5, d)
+    _add_op(block, "feed", {"X": ["feed"]}, {"Out": ["x"]},
+            {"col": (fw.INT, 0)}, fw)
+    _add_op(block, "pad2d", {"X": ["x"]}, {"Out": ["pd"]},
+            {"paddings": (fw.INTS, [1, 1, 2, 2]),     # [t, b, l, r]
+             "mode": (fw.STRING, "constant"),
+             "pad_value": (fw.FLOAT, 0.0)}, fw)
+    _add_op(block, "prelu", {"X": ["pd"], "Alpha": ["alpha"]},
+            {"Out": ["pr"]}, {"mode": (fw.STRING, "all")}, fw)
+    _add_op(block, "pool2d", {"X": ["pr"]}, {"Out": ["pl"]},
+            {"pooling_type": (fw.STRING, "max"), "ksize": (fw.INTS, [2, 2]),
+             "strides": (fw.INTS, [2, 2]), "paddings": (fw.INTS, [0, 0]),
+             "ceil_mode": (fw.BOOLEAN, True)}, fw)
+    _add_op(block, "fetch", {"X": ["pl"]}, {"Out": ["fetch"]},
+            {"col": (fw.INT, 0)}, fw)
+
+    with open(os.path.join(str(tmp_path), "__model__"), "wb") as f:
+        f.write(prog.SerializeToString())
+    with open(os.path.join(str(tmp_path), "alpha"), "wb") as f:
+        f.write(_lod_tensor_bytes(alpha))
+
+    prog_t, feeds, fetches = paddle.static.load_inference_model(
+        str(tmp_path))
+    exe = paddle.static.Executor()
+    x = rng.randn(2, 1, 5, 5).astype("f4")
+    (got,) = exe.run(prog_t, feed={"x": x}, fetch_list=fetches)
+
+    padded = np.pad(x, ((0, 0), (0, 0), (1, 1), (2, 2)))
+    pr = np.where(padded > 0, padded, 0.1 * padded)
+    # max pool k2 s2 ceil on 7x9 -> 4x5
+    pp = np.pad(pr, ((0, 0), (0, 0), (0, 1), (0, 1)),
+                constant_values=-np.inf)
+    want = pp.reshape(2, 1, 4, 2, 5, 2).max(axis=(3, 5))
+    assert got.shape == (2, 1, 4, 5)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
