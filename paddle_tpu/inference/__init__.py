@@ -118,6 +118,8 @@ class Predictor:
         self._config = config
         path = config.model_path()
         self._mode = None
+        self._pending = {}         # handle-fed inputs (ZeroCopyRun style)
+        self._last_outputs = None
         if not path:
             raise ValueError(
                 "inference Config has no model path — construct it as "
@@ -174,16 +176,44 @@ class Predictor:
         return [f"out{i}"
                 for i in range(self._layer._meta.get("n_outputs", 1))]
 
-    def run(self, inputs):
-        """inputs: list of numpy arrays in input order. Returns a list of
-        numpy outputs (ref predictor.run contract)."""
+    def get_input_handle(self, name):
+        """ref paddle_infer.Predictor.get_input_handle — the zero-copy
+        serving surface: handle.reshape/copy_from_cpu, run(),
+        output handle.copy_to_cpu()."""
+        if name not in self.get_input_names():
+            raise KeyError(f"no input named {name!r}; "
+                           f"inputs: {self.get_input_names()}")
+        return _TensorHandle(self, name, is_input=True)
+
+    def get_output_handle(self, name):
+        if name not in self.get_output_names():
+            raise KeyError(f"no output named {name!r}; "
+                           f"outputs: {self.get_output_names()}")
+        return _TensorHandle(self, name, is_input=False)
+
+    def run(self, inputs=None):
+        """inputs: list of numpy arrays in input order — or None for the
+        handle style (ref ZeroCopyRun: feed via get_input_handle, read
+        via get_output_handle). Returns a list of numpy outputs."""
         import jax.numpy as jnp
         from ..framework.tensor import Tensor
+        if inputs is None:
+            names = self.get_input_names()
+            missing = [n for n in names if n not in self._pending]
+            if missing:
+                raise RuntimeError(
+                    "inputs not fed via get_input_handle()."
+                    f"copy_from_cpu(): {missing}")
+            outs = self.run([self._pending[n] for n in names])
+            self._last_outputs = outs
+            return True
         if self._mode == "program":
             outs = self._exe.run(self._prog,
                                  feed=dict(zip(self._feeds, inputs)),
                                  fetch_list=self._fetches)
-            return [np.asarray(o) for o in outs]
+            outs = [np.asarray(o) for o in outs]
+            self._last_outputs = outs     # output handles track EVERY run
+            return outs
         donating = (self._config.memory_optim_enabled()
                     and self._config.ir_optim())
         arrays = []
@@ -196,8 +226,46 @@ class Predictor:
                 arrays.append(jnp.asarray(a))
         outs = self._run(self._layer._params, self._layer._buffers, *arrays)
         outs = outs if isinstance(outs, (list, tuple)) else [outs]
-        return [np.asarray(o.numpy() if isinstance(o, Tensor) else o)
+        outs = [np.asarray(o.numpy() if isinstance(o, Tensor) else o)
                 for o in outs]
+        self._last_outputs = outs         # output handles track EVERY run
+        return outs
+
+
+class _TensorHandle:
+    """ref paddle_api.h ZeroCopyTensor / paddle_infer.Tensor: the
+    handle-based serving surface (reshape + copy_from_cpu on inputs,
+    copy_to_cpu on outputs)."""
+
+    def __init__(self, predictor, name, is_input):
+        self._p = predictor
+        self.name = name
+        self._is_input = is_input
+        self._shape = None
+
+    def reshape(self, shape):
+        self._shape = tuple(int(s) for s in shape)
+
+    def copy_from_cpu(self, data):
+        if not self._is_input:
+            raise RuntimeError(f"'{self.name}' is an output handle")
+        arr = np.asarray(data)
+        if self._shape is not None:
+            arr = arr.reshape(self._shape)
+        self._p._pending[self.name] = arr
+
+    def copy_to_cpu(self):
+        if self._is_input:
+            raise RuntimeError(f"'{self.name}' is an input handle")
+        outs = self._p._last_outputs
+        if outs is None:
+            raise RuntimeError("run() has not been called yet")
+        return outs[self._p.get_output_names().index(self.name)]
+
+    def shape(self):
+        if self._is_input:
+            return list(self._shape or ())
+        return list(self.copy_to_cpu().shape)
 
 
 def create_predictor(config):

@@ -559,10 +559,17 @@ def _poolnd_raw(a, n=2, ksize=1, strides=None, padding=0,
         spatial = a.shape[1:1 + n] if channels_last else a.shape[2:2 + n]
         pad = [list(p) for p in pad]
         for i in range(n):
-            total = spatial[i] + pad[i][0] + pad[i][1]
-            rem = (total - ksize[i]) % strides[i]
-            if rem:
-                pad[i][1] += strides[i] - rem   # one extra (partial) window
+            H, (pl, ph) = spatial[i], pad[i]
+            total = H + pl + ph
+            out = -(-(total - ksize[i]) // strides[i]) + 1   # ceil count
+            # a window starting entirely in the high pad is not a window
+            # (torch/caffe clamp rule); without it stride > kernel emits
+            # all-padding cells (-inf / 0-count NaN)
+            if (out - 1) * strides[i] >= H + pl:
+                out -= 1
+            needed = (out - 1) * strides[i] + ksize[i]
+            if needed > total:
+                pad[i][1] += needed - total
         pad = [tuple(p) for p in pad]
     if isinstance(pad, str):
         pad_cfg = pad

@@ -21,9 +21,10 @@ from . import paddle_pb as pb
 
 
 class _Ctx:
-    def __init__(self, desc, var_info):
+    def __init__(self, desc, var_info, consumed=()):
         self.desc = desc
         self.info = var_info          # name -> parsed VarDesc dict
+        self.consumed = set(consumed)  # names read by ANY op in the block
         self._nconst = 0
 
     def emit(self, typ, inputs, outputs, attrs=None):
@@ -453,6 +454,11 @@ def _t_group_norm(op, ctx):
         # the raw op's (a, *wb) convention can't express bias-only
         raise NotImplementedError(
             "group_norm with Bias but no Scale not translated")
+    for slot in ("Mean", "Variance"):
+        extra = op["outputs"].get(slot)
+        if extra and extra[0] and extra[0] in ctx.consumed:
+            raise NotImplementedError(
+                f"group_norm: downstream use of {slot} not translated")
     if scale:
         ins.append(scale)
         if bias:
@@ -548,6 +554,62 @@ def _t_interp(op, ctx):
               "align_mode": int(a.get("align_mode", 1))})
 
 
+# --------------------------------------------------------------- detection
+
+@translates("yolo_box")
+def _t_yolo_box(op, ctx):
+    a = op["attrs"]
+    boxes = op["outputs"]["Boxes"][0]
+    scores = op["outputs"]["Scores"][0]
+    ctx.emit("yolo_box", [_one(op, "X"), _one(op, "ImgSize")],
+             [boxes, scores],
+             {"anchors": [int(v) for v in a.get("anchors", [])],
+              "class_num": int(a.get("class_num", 1)),
+              "conf_thresh": float(a.get("conf_thresh", 0.01)),
+              "downsample_ratio": int(a.get("downsample_ratio", 32)),
+              "clip_bbox": bool(a.get("clip_bbox", True)),
+              "scale_x_y": float(a.get("scale_x_y", 1.0))})
+
+
+@translates("multiclass_nms", "multiclass_nms2", "multiclass_nms3")
+def _t_multiclass_nms(op, ctx):
+    a = op["attrs"]
+    outs = [op["outputs"]["Out"][0]]
+    # nms2/3 expose extra outputs (Index / NmsRoisNum); our static-shape
+    # op returns the padded [keep_top_k, 6] result only — fine unless a
+    # downstream op actually READS the extras
+    for slot in ("Index", "NmsRoisNum"):
+        extra = op["outputs"].get(slot)
+        if extra and extra[0] and extra[0] in ctx.consumed:
+            raise NotImplementedError(
+                f"{op['type']}: downstream use of {slot} not translated")
+    outs.append(outs[0] + "@count")    # our op's valid-count output
+    ctx.emit("multiclass_nms", [_one(op, "BBoxes"), _one(op, "Scores")],
+             outs,
+             {"score_threshold": float(a.get("score_threshold", 0.05)),
+              "nms_top_k": int(a.get("nms_top_k", 64)),
+              "keep_top_k": int(a.get("keep_top_k", 16)),
+              "nms_threshold": float(a.get("nms_threshold", 0.3)),
+              "background_label": int(a.get("background_label", 0)),
+              "normalized": bool(a.get("normalized", True))})
+
+
+@translates("box_coder")
+def _t_box_coder(op, ctx):
+    a = op["attrs"]
+    pv = _one(op, "PriorBoxVar", required=False)
+    if pv is None:
+        raise NotImplementedError(
+            "box_coder without PriorBoxVar (variance attr form) not "
+            "translated")
+    ctx.emit("box_coder",
+             [_one(op, "PriorBox"), pv, _one(op, "TargetBox")],
+             [op["outputs"]["OutputBox"][0]],
+             {"code_type": a.get("code_type", "encode_center_size"),
+              "box_normalized": bool(a.get("box_normalized", True)),
+              "axis": int(a.get("axis", 0))})
+
+
 # -------------------------------------------------------------- assembly
 
 def from_parsed(parsed, name_hint="paddle_model"):
@@ -566,7 +628,11 @@ def from_parsed(parsed, name_hint="paddle_model"):
     info = {v["name"]: v for v in block["vars"]}
 
     desc = D.ProgramDesc()
-    ctx = _Ctx(desc, info)
+    consumed = set()
+    for op in block["ops"]:
+        for args in op["inputs"].values():
+            consumed.update(args)
+    ctx = _Ctx(desc, info, consumed)
 
     # interface: feed/fetch ops carry (col -> var) in their attrs
     feeds, fetches = {}, {}

@@ -153,12 +153,28 @@ class Imikolov(Dataset):
         return self.num_samples
 
 
+AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
 class Movielens(Dataset):
-    """Rating prediction (ref text/datasets/movielens.py: user/movie
-    features + 5-level rating)."""
+    """Rating prediction (ref text/datasets/movielens.py). With a
+    `data_file`, parses the REAL ml-1m.zip layout the way the reference
+    does (movies/users/ratings .dat with '::' separators, latin-1;
+    title words + categories dicts; the np.random test split with
+    rating*2-5 scaling; per-sample tuple = user.value() + movie.value()
+    + [[rating]]). Synthetic learnable default otherwise."""
 
     def __init__(self, data_file=None, mode="train", num_samples=4000,
-                 num_users=500, num_movies=800):
+                 num_users=500, num_movies=800, test_ratio=0.1,
+                 rand_seed=0):
+        if data_file is not None:
+            self.mode = mode.lower()
+            self.data_file = data_file
+            self.test_ratio = test_ratio
+            np.random.seed(rand_seed)
+            self._load_real()
+            self.num_samples = len(self._data)
+            return
         rng = np.random.RandomState(0 if mode == "train" else 1)
         self.num_users, self.num_movies = num_users, num_movies
         lat = np.random.RandomState(7)
@@ -172,7 +188,56 @@ class Movielens(Dataset):
             + 1, 1, 5)
         self.num_samples = num_samples
 
+    # ---- real-format path (ref movielens.py:157-212)
+    def _load_real(self):
+        import re
+        import zipfile
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        movie_info, user_info = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(self.data_file) as pkg:
+            with pkg.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode(
+                        "latin-1").strip().split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    title = pattern.match(title).group(1)
+                    movie_info[int(mid)] = (int(mid), cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            self.movie_title_dict = {w: i
+                                     for i, w in enumerate(title_words)}
+            self.categories_dict = {c: i
+                                    for i, c in enumerate(categories)}
+            with pkg.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = line.decode(
+                        "latin-1").strip().split("::")
+                    user_info[int(uid)] = (
+                        int(uid), 0 if gender == "M" else 1,
+                        AGE_TABLE.index(int(age)), int(job))
+            self._data = []
+            is_test = self.mode == "test"
+            with pkg.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = line.decode(
+                        "latin-1").strip().split("::")
+                    rating = float(rating) * 2 - 5.0
+                    u = user_info[int(uid)]
+                    midx, cats, title = movie_info[int(mid)]
+                    self._data.append(
+                        [[u[0]], [u[1]], [u[2]], [u[3]],
+                         [midx],
+                         [self.categories_dict[c] for c in cats],
+                         [self.movie_title_dict[w.lower()]
+                          for w in title.split()],
+                         [rating]])
+
     def __getitem__(self, idx):
+        if hasattr(self, "_data"):
+            return tuple(np.array(d) for d in self._data[idx])
         return (np.int64(self._users[idx]), np.int64(self._movies[idx]),
                 np.float32(self._ratings[idx]))
 
@@ -212,6 +277,13 @@ class _SyntheticTranslationDataset(Dataset):
         self._trg = perm[self._src % trg_vocab]
         self.src_vocab, self.trg_vocab = src_vocab, trg_vocab
         self.num_samples = num_samples
+
+    def _real_item(self, idx):
+        """Shared accessor for the real-format (src, trg, trg_next)
+        triples both WMT loaders build."""
+        return (np.array(self.src_ids[idx]),
+                np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
 
     def __getitem__(self, idx):
         src = self._src[idx].astype("int64")
@@ -294,9 +366,7 @@ class WMT14(_SyntheticTranslationDataset):
 
     def __getitem__(self, idx):
         if hasattr(self, "src_ids"):
-            return (np.array(self.src_ids[idx]),
-                    np.array(self.trg_ids[idx]),
-                    np.array(self.trg_ids_next[idx]))
+            return self._real_item(idx)
         return super().__getitem__(idx)
 
     def __len__(self):
@@ -304,13 +374,98 @@ class WMT14(_SyntheticTranslationDataset):
 
 
 class WMT16(_SyntheticTranslationDataset):
-    """ref text/datasets/wmt16.py (src_dict_size, trg_dict_size, lang)."""
+    """ref text/datasets/wmt16.py. With a `data_file`, parses the REAL
+    wmt16 tarball: member `wmt16/{mode}` of tab-separated en\\tde pairs;
+    vocabularies are BUILT from the train corpus by frequency with
+    <s>/<e>/<unk> reserved at 0/1/2 (the reference caches them as dict
+    files under DATA_HOME — here they're built in memory, same content);
+    `lang` selects the source column. Synthetic default otherwise."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
 
     def __init__(self, data_file=None, mode="train", src_dict_size=1000,
                  trg_dict_size=1000, lang="en", seq_len=16,
                  num_samples=2000):
+        assert mode.lower() in ("train", "test", "val"), mode
+        if data_file is not None:
+            self.mode = mode.lower()
+            self.data_file = data_file
+            self.lang = lang
+            en_dict, de_dict = self._build_dicts(
+                int(src_dict_size) if lang == "en" else int(trg_dict_size),
+                int(trg_dict_size) if lang == "en" else int(src_dict_size))
+            self.src_dict = en_dict if lang == "en" else de_dict
+            self.trg_dict = de_dict if lang == "en" else en_dict
+            self._load_real()
+            self.num_samples = len(self.src_ids)
+            return
         super().__init__(mode, src_dict_size, trg_dict_size, seq_len,
                          num_samples)
+
+    # ---- real-format path (ref wmt16.py:139-215)
+    def _build_dicts(self, en_size, de_size):
+        """BOTH language vocabularies in one pass over the train member
+        (the reference re-reads the tarball per dict; the content is
+        identical)."""
+        import collections
+        import tarfile
+        en_freq = collections.defaultdict(int)
+        de_freq = collections.defaultdict(int)
+        with tarfile.open(self.data_file, mode="r") as f:
+            for line in f.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[0].split():
+                    en_freq[w] += 1
+                for w in parts[1].split():
+                    de_freq[w] += 1
+
+        def mk(freq, size):
+            words = [self.START, self.END, self.UNK]
+            for w, _ in sorted(freq.items(), key=lambda x: x[1],
+                               reverse=True):
+                if len(words) == size:
+                    break
+                words.append(w)
+            return {w: i for i, w in enumerate(words)}
+
+        return mk(en_freq, en_size), mk(de_freq, de_size)
+
+    def _load_real(self):
+        import tarfile
+        start_id = self.src_dict[self.START]
+        end_id = self.src_dict[self.END]
+        unk_id = self.src_dict[self.UNK]
+        src_col = 0 if self.lang == "en" else 1
+        trg_col = 1 - src_col
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file, mode="r") as f:
+            for line in f.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [start_id] + [self.src_dict.get(w, unk_id)
+                                    for w in parts[src_col].split()] \
+                    + [end_id]
+                trg = [self.trg_dict.get(w, unk_id)
+                       for w in parts[trg_col].split()]
+                self.trg_ids_next.append(trg + [end_id])
+                self.trg_ids.append([start_id] + trg)
+                self.src_ids.append(src)
+
+    def get_dict(self, lang, reverse=False):
+        """ref wmt16 get_dict(lang): the built vocabulary for `lang`."""
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+    def __getitem__(self, idx):
+        if hasattr(self, "src_ids"):
+            return self._real_item(idx)
+        return super().__getitem__(idx)
+
+    def __len__(self):
+        return self.num_samples
 
 
 class Conll05st(Dataset):

@@ -1027,19 +1027,10 @@ def _greedy_nms_host(boxes, order, thresh, shift=0.0, max_keep=None):
     return kept
 
 
-def _multiclass_nms_raw(bboxes, scores, score_threshold=0.05, nms_top_k=64,
-                        keep_top_k=16, nms_threshold=0.3, background_label=0,
-                        normalized=True):
-    """Per-class NMS + cross-class top-k (ref operators/detection/
-    multiclass_nms_op.cc). bboxes: [M, 4], scores: [C, M]. The reference
-    emits a LoD list; the dense form is a fixed [keep_top_k, 6] tensor of
-    (label, score, x1, y1, x2, y2) rows padded with label=-1, plus the
-    valid count — the standard XLA detection-head contract."""
+def _nms_host_single(bx, sc, score_threshold, nms_top_k, keep_top_k,
+                     nms_threshold, background_label, shift):
     import numpy as _np
-    bx = _np.asarray(bboxes)
-    sc = _np.asarray(scores)
     C, M = sc.shape
-    shift = 0.0 if normalized else 1.0
     cand = []
     for c in range(C):
         if c == background_label:
@@ -1055,7 +1046,50 @@ def _multiclass_nms_raw(bboxes, scores, score_threshold=0.05, nms_top_k=64,
     out = _np.full((keep_top_k, 6), -1.0, _np.float32)
     for i, (c, s, b) in enumerate(cand):
         out[i] = [c, s, b[0], b[1], b[2], b[3]]
-    return jnp.asarray(out), jnp.int32(len(cand))
+    return out, _np.int32(len(cand))
+
+
+def _multiclass_nms_raw(bboxes, scores, score_threshold=0.05, nms_top_k=64,
+                        keep_top_k=16, nms_threshold=0.3, background_label=0,
+                        normalized=True):
+    """Per-class NMS + cross-class top-k (ref operators/detection/
+    multiclass_nms_op.cc). bboxes: [M, 4], scores: [C, M] — or the
+    batched reference layout [N, M, 4] / [N, C, M]. The reference emits
+    a LoD list; the dense form is a fixed [(N,) keep_top_k, 6] tensor of
+    (label, score, x1, y1, x2, y2) rows padded with label=-1, plus the
+    valid count(s). Inherently sequential greedy suppression runs on the
+    HOST; under tracing (the jitted Executor / translated reference
+    programs) it enters the program as a pure_callback with the static
+    [keep_top_k, 6] result shape."""
+    import numpy as _np
+    shift = 0.0 if normalized else 1.0
+    batched = getattr(bboxes, "ndim", 2) == 3
+
+    def host(bx, sc):
+        bx, sc = _np.asarray(bx), _np.asarray(sc)
+        if batched:
+            outs, counts = zip(*[
+                _nms_host_single(b, s, score_threshold, nms_top_k,
+                                 keep_top_k, nms_threshold,
+                                 background_label, shift)
+                for b, s in zip(bx, sc)])
+            return _np.stack(outs), _np.asarray(counts, _np.int32)
+        return _nms_host_single(bx, sc, score_threshold, nms_top_k,
+                                keep_top_k, nms_threshold,
+                                background_label, shift)
+
+    if isinstance(bboxes, jax.core.Tracer) \
+            or isinstance(scores, jax.core.Tracer):
+        if batched:
+            n = bboxes.shape[0]
+            shapes = (jax.ShapeDtypeStruct((n, keep_top_k, 6), jnp.float32),
+                      jax.ShapeDtypeStruct((n,), jnp.int32))
+        else:
+            shapes = (jax.ShapeDtypeStruct((keep_top_k, 6), jnp.float32),
+                      jax.ShapeDtypeStruct((), jnp.int32))
+        return jax.pure_callback(host, shapes, bboxes, scores)
+    out, count = host(bboxes, scores)
+    return jnp.asarray(out), jnp.asarray(count)
 
 
 register_op("multiclass_nms", _multiclass_nms_raw)

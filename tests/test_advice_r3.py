@@ -179,3 +179,22 @@ class TestPoolCeilMode:
         x = np.random.RandomState(2).randn(1, 1, 7, 7).astype("f4")
         got = _np(F.max_pool2d(paddle.to_tensor(x), 3, stride=2))
         assert got.shape == (1, 1, 3, 3)
+
+    def test_ceil_stride_gt_kernel_clamps(self):
+        """stride > kernel with ceil_mode: windows starting entirely in
+        the high pad are NOT windows (torch clamp rule) — no -inf cells,
+        no extra output row."""
+        import torch
+        import torch.nn.functional as TF
+        x = np.random.RandomState(4).randn(1, 1, 4, 4).astype("f4")
+        want = TF.max_pool2d(torch.from_numpy(x), 1, stride=2,
+                             ceil_mode=True).numpy()
+        got = _np(F.max_pool2d(paddle.to_tensor(x), 1, stride=2,
+                               ceil_mode=True))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert np.all(np.isfinite(got))
+        # avg exclusive must not produce 0/0 NaN either
+        got_a = _np(F.avg_pool2d(paddle.to_tensor(x), 1, stride=2,
+                                 ceil_mode=True, count_include_pad=False))
+        assert np.all(np.isfinite(got_a))

@@ -364,3 +364,110 @@ class TestWMT14RealFormat:
         ds = pt.text.WMT14(mode="train", num_samples=4)
         src, trg_in, trg = ds[0]
         assert src.shape == trg.shape == (16,)
+
+
+def _make_wmt16(path, pairs):
+    """REAL wmt16 layout: wmt16/{train,test,val} tab-separated en\tde."""
+    import io
+    with tarfile.open(path, "w:gz") as tf:
+        for split in ("train", "test", "val"):
+            text = "".join(f"{en}\t{de}\n" for sp, en, de in pairs
+                           if sp == split)
+            data = text.encode()
+            info = tarfile.TarInfo(f"wmt16/{split}")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+class TestWMT16RealFormat:
+    PAIRS = [
+        ("train", "the cat sat", "die katze sass"),
+        ("train", "the dog sat", "der hund sass"),
+        ("train", "the cat", "die katze"),
+        ("test", "the dog", "der hund"),
+        ("val", "the cat", "die katze"),
+    ]
+
+    def test_corpus_built_vocab_and_ids(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "wmt16.tar.gz")
+        _make_wmt16(tar, self.PAIRS)
+        ds = pt.text.WMT16(data_file=tar, mode="train",
+                           src_dict_size=20, trg_dict_size=20, lang="en")
+        # marks reserved at 0/1/2; 'the' is the most frequent en word
+        assert ds.src_dict["<s>"] == 0 and ds.src_dict["<e>"] == 1
+        assert ds.src_dict["<unk>"] == 2
+        assert ds.src_dict["the"] == 3
+        assert len(ds) == 3
+        src, trg, trg_next = ds[0]
+        the, cat, sat = (ds.src_dict[w] for w in ("the", "cat", "sat"))
+        assert src.tolist() == [0, the, cat, sat, 1]
+        die, katze, sass = (ds.trg_dict[w]
+                            for w in ("die", "katze", "sass"))
+        assert trg.tolist() == [0, die, katze, sass]
+        assert trg_next.tolist() == [die, katze, sass, 1]
+
+    def test_lang_de_swaps_columns(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "wmt16.tar.gz")
+        _make_wmt16(tar, self.PAIRS)
+        ds = pt.text.WMT16(data_file=tar, mode="train",
+                           src_dict_size=20, trg_dict_size=20, lang="de")
+        src, _, _ = ds[0]
+        die = ds.src_dict["die"]
+        assert src.tolist()[1] == die           # source is now german
+        d = ds.get_dict("de")
+        assert d is ds.src_dict
+
+    def test_dict_size_truncation_and_unk(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "wmt16.tar.gz")
+        _make_wmt16(tar, self.PAIRS)
+        ds = pt.text.WMT16(data_file=tar, mode="train",
+                           src_dict_size=4, trg_dict_size=4, lang="en")
+        # only <s>/<e>/<unk>/'the' fit; everything else -> UNK(2)
+        src, _, _ = ds[0]
+        assert src.tolist() == [0, 3, 2, 2, 1]
+
+
+def _make_ml1m(path, movies, users, ratings):
+    import zipfile
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "".join(f"{m}::{t}::{c}\n" for m, t, c in movies))
+        z.writestr("ml-1m/users.dat",
+                   "".join(f"{u}::{g}::{a}::{j}::00000\n"
+                           for u, g, a, j in users))
+        z.writestr("ml-1m/ratings.dat",
+                   "".join(f"{u}::{m}::{r}::978300760\n"
+                           for u, m, r in ratings))
+
+
+class TestMovielensRealFormat:
+    def test_parse_ml1m_layout(self, tmp_path):
+        zp = os.path.join(str(tmp_path), "ml-1m.zip")
+        _make_ml1m(
+            zp,
+            movies=[(1, "Toy Story (1995)", "Animation|Comedy"),
+                    (2, "Heat (1995)", "Action")],
+            users=[(1, "M", 25, 15), (2, "F", 45, 3)],
+            ratings=[(1, 1, 5), (1, 2, 3), (2, 1, 4), (2, 2, 2)] * 5)
+        ds = pt.text.Movielens(data_file=zp, mode="train",
+                               test_ratio=0.0, rand_seed=0)
+        assert len(ds) == 20                   # test_ratio 0 -> all train
+        usr_id, gender, age, job, mov_id, cats, title, rating = ds[0]
+        assert usr_id[0] in (1, 2) and gender[0] in (0, 1)
+        assert age[0] in (2, 4)                # AGE_TABLE indices of 25, 45
+        assert set(title.tolist()) <= set(
+            ds.movie_title_dict.values())
+        assert rating[0] in (-3.0, 1.0, 3.0, 5.0)   # r*2-5
+
+    def test_train_test_split_disjoint(self, tmp_path):
+        zp = os.path.join(str(tmp_path), "ml-1m.zip")
+        _make_ml1m(zp,
+                   movies=[(1, "Toy Story (1995)", "Comedy")],
+                   users=[(1, "M", 18, 0)],
+                   ratings=[(1, 1, r % 5 + 1) for r in range(50)])
+        tr = pt.text.Movielens(data_file=zp, mode="train",
+                               test_ratio=0.3, rand_seed=7)
+        te = pt.text.Movielens(data_file=zp, mode="test",
+                               test_ratio=0.3, rand_seed=7)
+        assert len(tr) + len(te) == 50
+        assert len(te) > 0

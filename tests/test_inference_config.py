@@ -129,3 +129,35 @@ class TestProgramPathServing:
         xv = np.random.RandomState(2).randn(6, 8).astype("f4")
         (out,) = p.run([xv])
         np.testing.assert_allclose(out, forward(xv), rtol=1e-5, atol=1e-5)
+
+
+class TestHandleServing:
+    """ref paddle_infer handle surface: get_input_handle/copy_from_cpu ->
+    run() -> get_output_handle/copy_to_cpu (the common serving loop)."""
+
+    def test_zero_copy_run_roundtrip(self, saved_model):
+        path, x, ref = saved_model
+        p = paddle.inference.create_predictor(
+            paddle.inference.Config(path))
+        in_name = p.get_input_names()[0]
+        h = p.get_input_handle(in_name)
+        h.reshape(x.shape)
+        h.copy_from_cpu(x.ravel())
+        assert p.run() is True
+        out_h = p.get_output_handle(p.get_output_names()[0])
+        np.testing.assert_allclose(out_h.copy_to_cpu(), ref, rtol=1e-5)
+        assert out_h.shape() == list(ref.shape)
+
+    def test_missing_feed_raises(self, saved_model):
+        path, _, _ = saved_model
+        p = paddle.inference.create_predictor(
+            paddle.inference.Config(path))
+        with pytest.raises(RuntimeError, match="copy_from_cpu"):
+            p.run()
+
+    def test_unknown_handle_name(self, saved_model):
+        path, _, _ = saved_model
+        p = paddle.inference.create_predictor(
+            paddle.inference.Config(path))
+        with pytest.raises(KeyError, match="no input named"):
+            p.get_input_handle("nope")

@@ -441,3 +441,64 @@ def test_translate_pad_prelu_ceilpool(fw, tmp_path):
     want = pp.reshape(2, 1, 4, 2, 5, 2).max(axis=(3, 5))
     assert got.shape == (2, 1, 4, 5)
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_translate_detection_head(fw, tmp_path):
+    """yolo_box -> transpose2 -> multiclass_nms: the standard exported
+    YOLOv3 tail serves through the jitted Executor (NMS enters the
+    program as a host pure_callback with static output shape)."""
+    rng = np.random.RandomState(0)
+    class_num, h, w = 3, 4, 4
+    anchors = [10, 13, 16, 30]
+    na = len(anchors) // 2
+    c = na * (5 + class_num)
+
+    prog = fw.ProgramDesc()
+    block = prog.blocks.add()
+    block.idx, block.parent_idx = 0, -1
+    _add_var(block, "feed", 5, [], vtype=fw.VarType.FEED_MINIBATCH)
+    _add_var(block, "fetch", 5, [], vtype=fw.VarType.FETCH_LIST)
+    _add_var(block, "x", 5, [-1, c, h, w])
+    _add_var(block, "im", 2, [-1, 2])
+    for n, d in [("boxes", [-1, h * w * na, 4]),
+                 ("scores", [-1, h * w * na, class_num]),
+                 ("scores_t", [-1, class_num, h * w * na]),
+                 ("nmsed", [-1, 16, 6])]:
+        _add_var(block, n, 5, d)
+    _add_op(block, "feed", {"X": ["feed"]}, {"Out": ["x"]},
+            {"col": (fw.INT, 0)}, fw)
+    _add_op(block, "feed", {"X": ["feed"]}, {"Out": ["im"]},
+            {"col": (fw.INT, 1)}, fw)
+    _add_op(block, "yolo_box", {"X": ["x"], "ImgSize": ["im"]},
+            {"Boxes": ["boxes"], "Scores": ["scores"]},
+            {"anchors": (fw.INTS, anchors), "class_num": (fw.INT, class_num),
+             "conf_thresh": (fw.FLOAT, 0.01),
+             "downsample_ratio": (fw.INT, 32)}, fw)
+    _add_op(block, "transpose2", {"X": ["scores"]}, {"Out": ["scores_t"]},
+            {"axis": (fw.INTS, [0, 2, 1])}, fw)
+    _add_op(block, "multiclass_nms", {"BBoxes": ["boxes"],
+                                      "Scores": ["scores_t"]},
+            {"Out": ["nmsed"]},
+            {"score_threshold": (fw.FLOAT, 0.01),
+             "nms_top_k": (fw.INT, 32), "keep_top_k": (fw.INT, 16),
+             "nms_threshold": (fw.FLOAT, 0.45),
+             "background_label": (fw.INT, -1)}, fw)
+    _add_op(block, "fetch", {"X": ["nmsed"]}, {"Out": ["fetch"]},
+            {"col": (fw.INT, 0)}, fw)
+
+    with open(os.path.join(str(tmp_path), "__model__"), "wb") as f:
+        f.write(prog.SerializeToString())
+
+    prog_t, feeds, fetches = paddle.static.load_inference_model(
+        str(tmp_path))
+    assert feeds == ["x", "im"]
+    exe = paddle.static.Executor()
+    xv = rng.randn(2, c, h, w).astype("f4")
+    imv = np.asarray([[128, 128], [128, 128]], "i4")
+    (got,) = exe.run(prog_t, feed={"x": xv, "im": imv},
+                     fetch_list=fetches)
+    assert got.shape == (2, 16, 6)
+    valid = got[got[..., 0] >= 0]
+    assert len(valid)                       # something survived NMS
+    assert np.all(valid[:, 0] < class_num)  # labels in range
+    assert np.all(valid[:, 1] > 0.0)        # positive scores
