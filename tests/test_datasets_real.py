@@ -471,3 +471,139 @@ class TestMovielensRealFormat:
                                test_ratio=0.3, rand_seed=7)
         assert len(tr) + len(te) == 50
         assert len(te) > 0
+
+
+def _make_ptb(path, train, valid, test):
+    import io
+    with tarfile.open(path, "w:gz") as tf:
+        for name, text in [("ptb.train.txt", train),
+                           ("ptb.valid.txt", valid),
+                           ("ptb.test.txt", test)]:
+            data = text.encode()
+            info = tarfile.TarInfo(f"./simple-examples/data/{name}")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+class TestImikolovRealFormat:
+    TRAIN = "the cat sat\nthe dog sat on the mat\nthe cat ran\n"
+    VALID = "the cat sat\n"
+    TEST = "the dog ran\n"
+
+    def test_ngram_windows(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "simple-examples.tgz")
+        _make_ptb(tar, self.TRAIN, self.VALID, self.TEST)
+        ds = pt.text.Imikolov(data_file=tar, data_type="NGRAM",
+                              window_size=3, mode="train",
+                              min_word_freq=1)
+        # vocab over train+valid, freq>1: the(6) cat(3) sat(3) + <s>(4)
+        # <e>(4) marks; <unk> appended last
+        assert b"the" in ds.word_idx and "<unk>" in ds.word_idx
+        assert ds.word_idx["<unk>"] == len(ds.word_idx) - 1
+        unk = ds.word_idx["<unk>"]
+        s, e = ds.word_idx["<s>"], ds.word_idx["<e>"]
+        the, cat, sat = (ds.word_idx[w] for w in (b"the", b"cat", b"sat"))
+        first = ds[0]
+        assert first == (s, the, cat)
+        # line 1 'the cat sat': windows (s,the,cat),(the,cat,sat),(cat,sat,e)
+        assert ds[1] == (the, cat, sat)
+        assert ds[2] == (cat, sat, e)
+
+    def test_seq_pairs(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "simple-examples.tgz")
+        _make_ptb(tar, self.TRAIN, self.VALID, self.TEST)
+        ds = pt.text.Imikolov(data_file=tar, data_type="SEQ",
+                              window_size=0, mode="test",
+                              min_word_freq=1)
+        src, trg = ds[0]
+        s, e = ds.word_idx["<s>"], ds.word_idx["<e>"]
+        assert src[0] == s and trg[-1] == e
+        assert list(src[1:]) == list(trg[:-1])
+
+    def test_low_freq_words_become_unk(self, tmp_path):
+        tar = os.path.join(str(tmp_path), "simple-examples.tgz")
+        _make_ptb(tar, self.TRAIN, self.VALID, self.TEST)
+        ds = pt.text.Imikolov(data_file=tar, data_type="NGRAM",
+                              window_size=3, mode="train",
+                              min_word_freq=2)
+        assert b"mat" not in ds.word_idx     # freq 1 -> cut
+        unk = ds.word_idx["<unk>"]
+        flat = {int(t) for tup in (ds[i] for i in range(len(ds)))
+                for t in tup}
+        assert unk in flat
+
+
+def _make_conll05(dirname):
+    import io
+    words = "The\ncat\nchased\nmice\n.\n\n"
+    props = "-\t(A0*\n-\t*)\nchase\t(V*)\n-\t(A1*)\n-\t*\n\n"
+
+    def gz_bytes(text):
+        buf = io.BytesIO()
+        with gzip.GzipFile(fileobj=buf, mode="wb") as g:
+            g.write(text.encode())
+        return buf.getvalue()
+
+    tar_path = os.path.join(dirname, "conll05st-tests.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, data in [
+                ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 gz_bytes(words)),
+                ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 gz_bytes(props))]:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    wd = os.path.join(dirname, "wordDict.txt")
+    open(wd, "w").write("<unk>\nThe\ncat\nchased\nmice\n.\nbos\neos\n")
+    vd = os.path.join(dirname, "verbDict.txt")
+    open(vd, "w").write("chase\nrun\n")
+    td = os.path.join(dirname, "targetDict.txt")
+    open(td, "w").write("B-A0\nI-A0\nB-A1\nI-A1\nB-V\nI-V\nO\n")
+    return tar_path, wd, vd, td
+
+
+class TestConll05stRealFormat:
+    def test_parse_props_to_bio_features(self, tmp_path):
+        tar, wd, vd, td = _make_conll05(str(tmp_path))
+        ds = pt.text.Conll05st(data_file=tar, word_dict_file=wd,
+                               verb_dict_file=vd, target_dict_file=td)
+        assert len(ds) == 1
+        (word_idx, c_n2, c_n1, c_0, c_p1, c_p2, pred, mark,
+         label) = ds[0]
+        # words: The cat chased mice .
+        assert word_idx.tolist() == [1, 2, 3, 4, 5]
+        # BIO: (A0* *) (V*) (A1*) *  ->  B-A0 I-A0 B-V B-A1 O
+        ld = ds.label_dict
+        assert label.tolist() == [ld["B-A0"], ld["I-A0"], ld["B-V"],
+                                  ld["B-A1"], ld["O"]]
+        # predicate 'chase' id broadcast over the sentence
+        assert pred.tolist() == [ds.predicate_dict["chase"]] * 5
+        # verb at position 2: ctx window marks positions 0..4
+        assert mark.tolist() == [1, 1, 1, 1, 1]
+        assert c_0.tolist() == [3] * 5          # 'chased'
+        assert c_n1.tolist() == [2] * 5         # 'cat'
+        assert c_p2.tolist() == [5] * 5         # '.'
+        wdict, pdict, ldict = ds.get_dict()
+        assert wdict["The"] == 1 and "chase" in pdict and "O" in ldict
+
+
+class TestUCIHousingRealFormat:
+    def test_parse_and_normalize(self, tmp_path):
+        rng = np.random.RandomState(0)
+        raw = np.abs(rng.randn(10, 14)) * 10
+        path = os.path.join(str(tmp_path), "housing.data")
+        with open(path, "w") as f:
+            for row in raw:
+                f.write(" ".join(f"{v:.4f}" for v in row) + "\n")
+        tr = pt.text.UCIHousing(data_file=path, mode="train")
+        te = pt.text.UCIHousing(data_file=path, mode="test")
+        assert len(tr) == 8 and len(te) == 2      # 80/20 front/back
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # features mean-centered/range-scaled; target untouched
+        data = np.loadtxt(path)
+        want = (data[0, 0] - data[:, 0].mean()) / (
+            data[:, 0].max() - data[:, 0].min())
+        np.testing.assert_allclose(x[0], want, rtol=1e-4)
+        np.testing.assert_allclose(y[0], data[0, -1], rtol=1e-4)
