@@ -121,7 +121,10 @@ class DistributedStrategy:
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 1}
         self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = {"init_k_steps": 1,
+                                          "begin_step": 1}
         self.fp16_allreduce = False
+        self.fp16_allreduce_configs = {"dtype": "float16"}
         self.find_unused_parameters = False
         # async PS
         self.a_sync = False
@@ -214,8 +217,15 @@ def init(role_maker=None, is_collective=False, strategy=None):
     _fleet.server = None
     _fleet.server_port = None
     _fleet.worker_trainer = None
-    # build the mesh implied by hybrid_configs
-    hc = _fleet.strategy.hybrid_configs
+    # build the mesh implied by hybrid_configs; strategy.tensor_parallel
+    # (ref distributed_strategy.py tensor_parallel + configs) is the
+    # non-hybrid spelling of an mp degree
+    hc = dict(_fleet.strategy.hybrid_configs)
+    if getattr(_fleet.strategy, "tensor_parallel", False):
+        tp = int(getattr(_fleet.strategy, "tensor_parallel_configs", {})
+                 .get("tensor_parallel_degree", 1) or 1)
+        if tp > 1 and int(hc.get("mp_degree", 1) or 1) <= 1:
+            hc["mp_degree"] = tp
     import jax
     ndev = len(jax.devices())
     axes = {}
@@ -340,7 +350,10 @@ def build_train_step(model, loss_fn, optimizer, **kwargs):
         cfg = tf["localsgd"]
         return LocalSGDTrainStep(
             model, loss_fn, optimizer,
-            k_steps=max(1, int(cfg.get("k_steps", 1) or 1)), **kwargs)
+            k_steps=max(1, int(cfg.get("k_steps", 1) or 1)),
+            adaptive=bool(cfg.get("adaptive", False)),
+            init_k_steps=int(cfg.get("init_k_steps", 1) or 1),
+            begin_step=int(cfg.get("begin_step", 1) or 1), **kwargs)
     if mesh is not None and ndev > 1:
         from ..sharded import ShardedTrainStep
         return ShardedTrainStep(model, loss_fn, optimizer,

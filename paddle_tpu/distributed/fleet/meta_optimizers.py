@@ -157,6 +157,21 @@ class LocalSGDOptimizer(MetaOptimizerBase):
         self.transforms["localsgd"] = {"k_steps": k_steps}
 
 
+class AdaptiveLocalSGDOptimizer(MetaOptimizerBase):
+    """ref localsgd_optimizer.py AdaptiveLocalSGDOptimizer: the averaging
+    interval follows the loss — next_k = clip(ceil(sqrt(lr_0 * loss /
+    (lr * loss_0) * init_k)), 1, 16) at every sync."""
+
+    def __init__(self, inner_opt, configs=None):
+        super().__init__(inner_opt)
+        cfg = dict(configs or {})
+        self.transforms["localsgd"] = {
+            "adaptive": True,
+            "init_k_steps": int(cfg.get("init_k_steps", 1)),
+            "begin_step": int(cfg.get("begin_step", 1)),
+        }
+
+
 class DGCOptimizer(MetaOptimizerBase):
     """ref meta_optimizers/dgc_optimizer.py DGCMomentumOptimizer: top-k
     sparsified grads with momentum correction + residual accumulation;
@@ -221,6 +236,17 @@ def build_distributed_optimizer(optimizer, strategy):
     matters — match the reference's valid chain AMP ∘ Recompute ∘ (Lamb|Lars)
     ∘ (Sharding|Pipeline|LocalSGD|GradientMerge) ∘ GraphExecution."""
     opt = optimizer
+    if getattr(strategy, "auto", False):
+        # ref strategy auto mode: meta-optimizers that report
+        # universally-applicable turn themselves on (_enable_strategy)
+        # when the user hand-set nothing. On TPU the always-win is bf16
+        # autocast; loss-scaling knobs are unnecessary for bf16.
+        explicit = any(getattr(strategy, f, False) for f in (
+            "amp", "recompute", "sharding", "pipeline", "localsgd",
+            "adaptive_localsgd", "dgc", "gradient_merge", "lamb", "lars",
+            "fp16_allreduce"))
+        if not explicit:
+            strategy.amp = True
     if strategy.lamb:
         opt = LambOptimizer(opt, strategy.lamb_configs)
     elif strategy.lars:
@@ -236,7 +262,10 @@ def build_distributed_optimizer(optimizer, strategy):
         opt = ShardingOptimizer(opt, strategy.sharding_configs)
     if strategy.pipeline:
         opt = PipelineOptimizer(opt, strategy.pipeline_configs)
-    if strategy.localsgd:
+    if getattr(strategy, "adaptive_localsgd", False):
+        opt = AdaptiveLocalSGDOptimizer(
+            opt, getattr(strategy, "adaptive_localsgd_configs", None))
+    elif strategy.localsgd:
         opt = LocalSGDOptimizer(opt, strategy.localsgd_configs.get("k_steps", 1))
     if strategy.dgc:
         opt = DGCOptimizer(opt, getattr(strategy, "dgc_configs", None))

@@ -37,7 +37,8 @@ class LocalSGDTrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, k_steps=1, mesh=None,
-                 dp_axis=None, donate=True):
+                 dp_axis=None, donate=True, adaptive=False,
+                 init_k_steps=1, begin_step=1):
         from ..jit import transforms as tfm
         self.model = model
         self.loss_fn = loss_fn
@@ -63,7 +64,6 @@ class LocalSGDTrainStep:
                                       optimizer.init_opt_state(params))
         self._step_i = optimizer._global_step
         apply_fn = optimizer.apply_gradients_fn()
-        k = self.k_steps
 
         # strategy transforms: amp/recompute apply per replica; k-step
         # accumulation is inherent to LocalSGD (its local steps), so a
@@ -90,8 +90,8 @@ class LocalSGDTrainStep:
             new_p, new_o = apply_fn(p, grads, o, lr, step_i)
             return loss, new_p, new_b, new_o
 
-        def _step(params, buffers, opt_state, keys, lr, step_i, inputs,
-                  labels):
+        def _step(params, buffers, opt_state, keys, lr, step_i, do_sync,
+                  inputs, labels):
             loss, new_p, new_b, new_o = jax.vmap(
                 _one_replica,
                 in_axes=(0, 0, 0, 0, None, None, 0, 0))(
@@ -104,7 +104,10 @@ class LocalSGDTrainStep:
                     lambda a: jnp.broadcast_to(
                         jnp.mean(a, axis=0, keepdims=True), a.shape), p)
 
-            new_p = jax.lax.cond(step_i % k == 0, sync, lambda p: p, new_p)
+            # the sync decision is a runtime input: fixed-k mode passes
+            # step_i % k == 0; adaptive mode (ref AdaptiveLocalSGD) lets
+            # the host controller grow/shrink the interval from the loss
+            new_p = jax.lax.cond(do_sync, sync, lambda p: p, new_p)
             return jnp.mean(loss), new_p, new_b, new_o
 
         sh = {"params": {n: rep for n in self.params},
@@ -113,11 +116,23 @@ class LocalSGDTrainStep:
         self._compiled = jax.jit(
             _step,
             in_shardings=(sh["params"], sh["buffers"], sh["opt"], rep,
-                          None, None, None, None),
+                          None, None, None, None, None),
             out_shardings=(NamedSharding(self.mesh, P()), sh["params"],
                            sh["buffers"], sh["opt"]),
             donate_argnums=(0, 1, 2) if donate else (),
         )
+
+        # adaptive interval controller state (ref AdaptiveLocalSGD:
+        # next_k = clip(ceil(sqrt(lr_0*loss / (lr*loss_0) * init_k)), 1, 16),
+        # recomputed at every sync from the replica-mean loss)
+        self.adaptive = bool(adaptive)
+        self.init_k_steps = max(1, int(init_k_steps))
+        self.begin_step = max(1, int(begin_step))
+        if self.adaptive:
+            self.k_steps = self.init_k_steps
+        self._last_sync = 0
+        self._loss0 = None
+        self._lr0 = None
 
     # ------------------------------------------------------------------ step
     def _split_batch(self, arrs):
@@ -139,15 +154,36 @@ class LocalSGDTrainStep:
         inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
         labels = labels if isinstance(labels, (list, tuple)) else (labels,)
         self._step_i += 1
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        lr = float(self.optimizer.get_lr())
+        if self.adaptive:
+            # ref AdaptiveLocalSGD warmup: sync EVERY step until
+            # begin_step (dense-DP lockstep), then loss-driven intervals
+            do_sync = (self._step_i < self.begin_step
+                       or self._step_i - self._last_sync >= self.k_steps)
+        else:
+            do_sync = self._step_i % self.k_steps == 0
         keys = jax.random.split(state.next_rng_key(), self.dp)
         with self.mesh:
             loss, self.params, self.buffers, self.opt_state = \
                 self._compiled(self.params, self.buffers, self.opt_state,
-                               keys, lr,
+                               keys, jnp.asarray(lr, jnp.float32),
                                jnp.asarray(self._step_i, jnp.int32),
+                               jnp.asarray(do_sync),
                                self._split_batch(inputs),
                                self._split_batch(labels))
+        if self.adaptive and (do_sync or self._loss0 is None):
+            # host round-trip for ONE scalar, and only on steps whose
+            # loss the controller actually consumes — non-sync steps stay
+            # fully async-dispatched
+            lv = float(np.asarray(jax.device_get(loss)))
+            if self._loss0 is None:
+                self._loss0, self._lr0 = max(lv, 1e-12), max(lr, 1e-12)
+            if do_sync:
+                self._last_sync = self._step_i
+                ratio = (self._lr0 * max(lv, 1e-12)) / (
+                    max(lr, 1e-12) * self._loss0)
+                self.k_steps = int(np.clip(
+                    np.ceil(np.sqrt(ratio * self.init_k_steps)), 1, 16))
         return Tensor(loss)
 
     def sync(self):

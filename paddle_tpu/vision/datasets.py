@@ -301,12 +301,29 @@ class ImageFolder(Dataset):
 
 
 class Flowers(Dataset):
-    """ref datasets/flowers.py (102-category). Real files when present in
-    the cache home; synthetic 3x64x64 fallback (zero-egress)."""
+    """ref datasets/flowers.py (102-category). Three real-data paths:
+    the REAL archive triplet (102flowers.tgz + imagelabels.mat +
+    setid.mat — parsed exactly like the reference, including its
+    train<->tstid flag swap), a class-per-dir tree in the cache home,
+    or the synthetic 3x64x64 fallback (zero-egress)."""
+
+    # ref flowers.py MODE_FLAG_MAP: "test data is more than train data"
+    MODE_FLAG_MAP = {"train": "tstid", "test": "trnid", "valid": "valid"}
 
     def __init__(self, data_file=None, label_file=None, setid_file=None,
                  mode="train", transform=None, download=True, backend=None):
         self.transform = transform
+        self.backend = backend
+        if data_file or label_file or setid_file:
+            if not (data_file and label_file and setid_file):
+                raise ValueError(
+                    "Flowers real-archive mode needs ALL of data_file "
+                    "(102flowers.tgz), label_file (imagelabels.mat) and "
+                    "setid_file (setid.mat) — the zero-egress build "
+                    "cannot download the missing pieces")
+            self._init_real_archives(data_file, label_file, setid_file,
+                                     mode)
+            return
         root = os.path.join(data_home(), "flowers")
         if os.path.isdir(root) and any(
                 os.path.isdir(os.path.join(root, d))
@@ -328,7 +345,37 @@ class Flowers(Dataset):
             self.labels = np.asarray([synth[i][1]
                                       for i in range(len(synth))])
 
+    # ---- real-archive path (ref flowers.py:122-160)
+    def _init_real_archives(self, data_file, label_file, setid_file, mode):
+        import tarfile
+        import scipy.io as scio
+        self._folder = None
+        self.images = self.labels = None
+        self._tar = tarfile.open(data_file)
+        self._name2mem = {m.name: m for m in self._tar.getmembers()}
+        self._mat_labels = scio.loadmat(label_file)["labels"][0]
+        self._indexes = scio.loadmat(setid_file)[
+            self.MODE_FLAG_MAP[mode.lower()]][0]
+
+    def _real_archive_item(self, idx):
+        import io as _io
+        from PIL import Image
+        index = int(self._indexes[idx])
+        label = np.array([self._mat_labels[index - 1]])
+        raw = self._tar.extractfile(
+            self._name2mem["jpg/image_%05d.jpg" % index]).read()
+        image = Image.open(_io.BytesIO(raw))
+        if self.backend != "pil":
+            image = np.array(image)
+        if self.transform is not None:
+            image = self.transform(image)
+        if self.backend == "pil":
+            return image, label.astype("int64")
+        return np.asarray(image, dtype="float32"), label.astype("int64")
+
     def __getitem__(self, idx):
+        if getattr(self, "_indexes", None) is not None:
+            return self._real_archive_item(idx)
         if self._folder is not None:
             return self._folder[idx]
         img, label = self.images[idx], self.labels[idx]
@@ -337,6 +384,8 @@ class Flowers(Dataset):
         return img, label
 
     def __len__(self):
+        if getattr(self, "_indexes", None) is not None:
+            return len(self._indexes)
         return (len(self._folder) if self._folder is not None
                 else len(self.images))
 

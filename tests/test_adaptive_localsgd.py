@@ -1,0 +1,140 @@
+"""AdaptiveLocalSGD (ref fleet/meta_optimizers/localsgd_optimizer.py
+AdaptiveLocalSGDOptimizer): the averaging interval follows the loss."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.localsgd import LocalSGDTrainStep
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    AdaptiveLocalSGDOptimizer, build_distributed_optimizer)
+from paddle_tpu.distributed.fleet.base import build_train_step
+
+
+def _setup(adaptive_cfg=None):
+    mesh_mod.make_mesh({"dp": 8})
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    if adaptive_cfg is not None:
+        opt = AdaptiveLocalSGDOptimizer(opt, adaptive_cfg)
+    return net, opt
+
+
+def _batch(n=32):
+    r = np.random.RandomState(0)
+    return (r.randn(n, 8).astype("f4"),
+            r.randint(0, 4, (n,)).astype("i8"))
+
+
+class TestAdaptiveLocalSGD:
+    def test_trains_and_k_adapts(self):
+        mesh_mod.make_mesh({"dp": 8})
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 64), nn.ReLU(), nn.Linear(64, 4))
+        opt = paddle.optimizer.Adam(learning_rate=0.02,
+                                    parameters=net.parameters())
+        step = LocalSGDTrainStep(net, paddle.nn.functional.cross_entropy,
+                                 opt, adaptive=True, init_k_steps=2,
+                                 donate=False)
+        assert step.adaptive and step.k_steps == 2
+        x, y = _batch(16)                  # small batch -> fast overfit
+        first = float(step(x, y).numpy())
+        ks = set()
+        for _ in range(80):
+            last = float(step(x, y).numpy())
+            ks.add(step.k_steps)
+        assert last < first * 0.2, (first, last)
+        # as the loss collapses, ratio -> 0 and the interval returns to 1
+        assert 1 in ks, (ks, first, last)
+        assert all(1 <= k <= 16 for k in ks)
+
+    def test_warmup_syncs_every_step_then_intervals(self):
+        """ref AdaptiveLocalSGD: dense-DP lockstep (sync EVERY step)
+        until begin_step, loss-driven intervals after."""
+        net, opt = _setup()
+        step = LocalSGDTrainStep(net, paddle.nn.functional.cross_entropy,
+                                 opt, adaptive=True, init_k_steps=4,
+                                 begin_step=4, donate=False)
+        x, y = _batch()
+        syncs = []
+        for i in range(1, 10):
+            before = step._last_sync
+            step(x, y)
+            if step._last_sync != before:
+                syncs.append(i)
+        assert syncs[:3] == [1, 2, 3]       # warmup: every step
+        # after begin_step, gaps of at least k appear
+        gaps = [b - a for a, b in zip(syncs[3:], syncs[4:])]
+        assert all(g >= 1 for g in gaps)
+
+    def test_strategy_chain_selects_adaptive(self):
+        import paddle_tpu.distributed.fleet as fleet
+        mesh_mod.make_mesh({"dp": 8})
+        paddle.seed(1)
+        net = nn.Linear(8, 4)
+        strat = fleet.DistributedStrategy()
+        strat.adaptive_localsgd = True
+        strat.adaptive_localsgd_configs = {"init_k_steps": 3,
+                                           "begin_step": 2}
+        opt = build_distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=net.parameters()), strat)
+        assert opt.transforms["localsgd"]["adaptive"]
+        step = build_train_step(net, paddle.nn.functional.cross_entropy,
+                                opt, donate=False)
+        assert isinstance(step, LocalSGDTrainStep)
+        assert step.adaptive and step.init_k_steps == 3
+        x, y = _batch()
+        assert np.isfinite(float(step(x, y).numpy()))
+
+    def test_fixed_mode_unchanged(self):
+        net, opt = _setup()
+        step = LocalSGDTrainStep(net, paddle.nn.functional.cross_entropy,
+                                 opt, k_steps=4, donate=False)
+        assert not step.adaptive
+        x, y = _batch()
+        for _ in range(8):
+            loss = step(x, y)
+        assert np.isfinite(float(loss.numpy()))
+
+
+class TestStrategyFlagsWired:
+    def test_auto_enables_amp(self):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.fleet.meta_optimizers import \
+            build_distributed_optimizer
+        paddle.seed(2)
+        net = nn.Linear(4, 2)
+        strat = fleet.DistributedStrategy()
+        strat.auto = True
+        opt = build_distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters()), strat)
+        assert "amp" in opt.transforms
+
+    def test_auto_respects_explicit_choices(self):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.fleet.meta_optimizers import \
+            build_distributed_optimizer
+        paddle.seed(2)
+        net = nn.Linear(4, 2)
+        strat = fleet.DistributedStrategy()
+        strat.auto = True
+        strat.recompute = True
+        opt = build_distributed_optimizer(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters()), strat)
+        assert "amp" not in opt.transforms
+        assert "recompute" in opt.transforms
+
+    def test_tensor_parallel_builds_mp_mesh(self):
+        import paddle_tpu.distributed.fleet as fleet
+        strat = fleet.DistributedStrategy()
+        strat.tensor_parallel = True
+        strat.tensor_parallel_configs = {"tensor_parallel_degree": 4}
+        fleet.init(is_collective=True, strategy=strat)
+        m = mesh_mod.get_mesh()
+        assert m is not None and m.shape.get("mp") == 4

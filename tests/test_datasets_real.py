@@ -607,3 +607,47 @@ class TestUCIHousingRealFormat:
             data[:, 0].max() - data[:, 0].min())
         np.testing.assert_allclose(x[0], want, rtol=1e-4)
         np.testing.assert_allclose(y[0], data[0, -1], rtol=1e-4)
+
+
+class TestFlowersRealArchives:
+    def test_tgz_plus_mat_triplet(self, tmp_path):
+        """The genuine flowers layout: 102flowers.tgz with
+        jpg/image_%05d.jpg + imagelabels.mat + setid.mat (including the
+        reference's train<->tstid flag swap)."""
+        import io
+        import scipy.io as scio
+        from PIL import Image
+
+        rng = np.random.RandomState(0)
+        tar_path = os.path.join(str(tmp_path), "102flowers.tgz")
+        n_imgs = 6
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for i in range(1, n_imgs + 1):
+                img = Image.fromarray(
+                    rng.randint(0, 255, (8, 8, 3), dtype=np.uint8))
+                buf = io.BytesIO()
+                img.save(buf, format="JPEG")
+                data = buf.getvalue()
+                info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        labels = np.arange(1, n_imgs + 1)[None, :]      # 1-based classes
+        lbl = os.path.join(str(tmp_path), "imagelabels.mat")
+        scio.savemat(lbl, {"labels": labels})
+        setid = os.path.join(str(tmp_path), "setid.mat")
+        scio.savemat(setid, {"tstid": np.array([[1, 2, 3, 4]]),
+                             "trnid": np.array([[5, 6]]),
+                             "valid": np.array([[5]])})
+
+        tr = pt.vision.datasets.Flowers(
+            data_file=tar_path, label_file=lbl, setid_file=setid,
+            mode="train")
+        te = pt.vision.datasets.Flowers(
+            data_file=tar_path, label_file=lbl, setid_file=setid,
+            mode="test")
+        assert len(tr) == 4 and len(te) == 2    # train reads tstid
+        img, label = tr[0]
+        assert img.shape == (8, 8, 3) and img.dtype == np.float32
+        assert label.tolist() == [1]            # image_00001 -> class 1
+        img2, label2 = te[0]
+        assert label2.tolist() == [5]           # trnid starts at index 5
