@@ -236,24 +236,24 @@ def build_distributed_optimizer(optimizer, strategy):
     matters — match the reference's valid chain AMP ∘ Recompute ∘ (Lamb|Lars)
     ∘ (Sharding|Pipeline|LocalSGD|GradientMerge) ∘ GraphExecution."""
     opt = optimizer
+    # ref strategy auto mode: meta-optimizers that report
+    # universally-applicable turn themselves on (_enable_strategy) when
+    # the user hand-set nothing. On TPU the always-win is bf16 autocast;
+    # the decision is LOCAL — the caller's strategy object is not mutated.
+    auto_amp = False
     if getattr(strategy, "auto", False):
-        # ref strategy auto mode: meta-optimizers that report
-        # universally-applicable turn themselves on (_enable_strategy)
-        # when the user hand-set nothing. On TPU the always-win is bf16
-        # autocast; loss-scaling knobs are unnecessary for bf16.
         explicit = any(getattr(strategy, f, False) for f in (
             "amp", "recompute", "sharding", "pipeline", "localsgd",
             "adaptive_localsgd", "dgc", "gradient_merge", "lamb", "lars",
             "fp16_allreduce"))
-        if not explicit:
-            strategy.amp = True
+        auto_amp = not explicit
     if strategy.lamb:
         opt = LambOptimizer(opt, strategy.lamb_configs)
     elif strategy.lars:
         opt = LarsOptimizer(opt, strategy.lars_configs)
     if strategy.recompute:
         opt = RecomputeOptimizer(opt, strategy.recompute_configs)
-    if strategy.amp:
+    if strategy.amp or auto_amp:
         opt = AMPOptimizer(opt, strategy.amp_configs)
     if getattr(strategy, "fp16_allreduce", False):
         opt = FP16AllReduceOptimizer(

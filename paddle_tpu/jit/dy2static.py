@@ -405,6 +405,45 @@ def check_step(step):
     return step
 
 
+def convert_print(*args, **kwargs):
+    """Emitted for `print(...)` (ref dygraph_to_static print_transformer:
+    print -> Print op so output happens at every EXECUTION, not once at
+    trace time). Traced arguments route through jax.debug.print honoring
+    sep/end (the debug printer always newline-terminates — a non-default
+    `end` is emitted before that newline); fully concrete calls stay
+    python print."""
+    if any(_is_traced(_unwrap(a)) for a in args):
+        sep = kwargs.get("sep", " ")
+        end = kwargs.get("end", "\n")
+        fmt = sep.join("{}" for _ in args)
+        if end != "\n":
+            fmt += end
+        jax.debug.print(fmt, *[_unwrap(a) for a in args],
+                        ordered=bool(kwargs.get("ordered", False)))
+        return
+    print(*args, **kwargs)
+
+
+def convert_assert(pred, msg=None):
+    """Emitted for `assert` (ref dygraph_to_static assert_transformer:
+    assert -> Assert op, which halts at runtime). Concrete preds stay
+    python asserts; traced preds install an ordered debug callback that
+    raises when the executed value is False — surfacing as a runtime
+    error on the step that violated the assertion."""
+    p = _scalar_pred(_unwrap(pred))
+    if not _is_traced(p):
+        assert bool(p), msg if msg is not None else "assert failed"
+        return
+
+    def cb(v):
+        if not bool(v):
+            raise AssertionError(
+                msg if msg is not None
+                else "dy2static: traced assert failed")
+
+    jax.debug.callback(cb, p, ordered=True)
+
+
 def convert_logical_and(lhs_fn, rhs_fn):
     """ref logical_transformer.py convert_logical_and — preserves python
     short-circuit when concrete."""
@@ -1049,11 +1088,44 @@ def convert_function(fn):
                 and isinstance(nd.iter.func, ast.Name)
                 and nd.iter.func.id == "range")
 
-    has_cf = any(isinstance(s, (ast.If, ast.While)) or _range_for(s)
+    def _is_print(nd):
+        return (isinstance(nd, ast.Call) and isinstance(nd.func, ast.Name)
+                and nd.func.id == "print")
+
+    has_cf = any(isinstance(s, (ast.If, ast.While, ast.Assert))
+                 or _range_for(s) or _is_print(s)
                  for s in ast.walk(fn_node))
     if not has_cf:
         _CACHE[key] = fn
         return fn
+    # print -> convert_print (ref print_transformer.py): output at every
+    # execution, via jax.debug.print when arguments are traced
+
+    class _PrintTransformer(ast.NodeTransformer):
+        def visit_Call(self, node):
+            self.generic_visit(node)
+            if isinstance(node.func, ast.Name) and node.func.id == "print":
+                node.func = ast.Attribute(
+                    value=ast.Name(id="_jst", ctx=ast.Load()),
+                    attr="convert_print", ctx=ast.Load())
+            return node
+
+        def visit_Assert(self, node):
+            # ref assert_transformer: assert -> runtime Assert
+            self.generic_visit(node)
+            args = [node.test]
+            if node.msg is not None:
+                args.append(node.msg)
+            call = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id="_jst", ctx=ast.Load()),
+                    attr="convert_assert", ctx=ast.Load()),
+                args=args, keywords=[])
+            return ast.copy_location(
+                ast.Expr(value=ast.copy_location(call, node)), node)
+
+    _PrintTransformer().visit(fn_node)
+
     # pre-passes: return -> flag/val, break/continue -> loop-carried booleans
     # (ref return_transformer.py / break_continue_transformer.py)
     _ReturnTransformer.apply(fn_node)
@@ -1128,6 +1200,8 @@ _JST = _JSTNamespace(
     convert_logical_and=convert_logical_and,
     convert_logical_or=convert_logical_or,
     convert_logical_not=convert_logical_not,
+    convert_print=convert_print,
+    convert_assert=convert_assert,
     finalize_return=finalize_return,
     UNDEF=UNDEF,
 )

@@ -149,7 +149,10 @@ def main():
         print(json.dumps(rec), flush=True)
         sys.exit(0 if rec["status"] == "ok" else 1)
 
-    per_to = int(os.environ.get("MOSAIC_CHECK_TIMEOUT", 600))
+    # generous: the first subprocess pays the tunnel backend init on top
+    # of its compile, and killing a remote compile mid-flight can wedge
+    # the tunnel (docs/perf/PERF.md)
+    per_to = int(os.environ.get("MOSAIC_CHECK_TIMEOUT", 900))
     results = []
     for name in CHECKS:
         t0 = time.time()

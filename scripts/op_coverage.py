@@ -383,6 +383,16 @@ def main():
                 "number by hand). The `registered` row below counts "
                 "reference types covered under the SAME name; aliases "
                 "cover the rest.\n\n" % len(OP_REGISTRY))
+        try:
+            from paddle_tpu.static.paddle_compat import TRANSLATORS
+            f.write("Reference-format model interop "
+                    "(static/paddle_compat.py) translates %d reference "
+                    "op types directly from protobuf ProgramDescs: %s."
+                    "\n\n" % (len(TRANSLATORS),
+                              ", ".join(f"`{t}`" for t in
+                                        sorted(TRANSLATORS))))
+        except ImportError:
+            pass
         f.write("| count | status |\n|---|---|\n")
         for k in sorted(counts):
             f.write(f"| {counts[k]} | {k} |\n")

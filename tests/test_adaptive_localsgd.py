@@ -66,9 +66,12 @@ class TestAdaptiveLocalSGD:
             if step._last_sync != before:
                 syncs.append(i)
         assert syncs[:3] == [1, 2, 3]       # warmup: every step
-        # after begin_step, gaps of at least k appear
-        gaps = [b - a for a, b in zip(syncs[3:], syncs[4:])]
-        assert all(g >= 1 for g in gaps)
+        # after begin_step the loss-driven interval takes over: with a
+        # barely-moving loss, next_k ~= ceil(sqrt(init_k)) = 2, so sync
+        # gaps of at least 2 must appear (a k-stuck-at-1 regression
+        # would sync every step)
+        gaps = [b - a for a, b in zip(syncs[2:], syncs[3:])]
+        assert any(g >= 2 for g in gaps), (syncs, step.k_steps)
 
     def test_strategy_chain_selects_adaptive(self):
         import paddle_tpu.distributed.fleet as fleet

@@ -368,3 +368,58 @@ class TestErrorSourceMap:
             f"no frame inside the original function lines "
             f"[{start}, {start + len(src_lines)}); got "
             f"{[(f.filename, f.lineno) for f in ours]}")
+
+
+def printing_fn(x):
+    for i in range(2):
+        x = x + 1
+        print("step", x.sum())
+    return x
+
+
+class TestPrintTransform:
+    def test_print_fires_per_execution(self, capfd):
+        """ref print_transformer: print must output at every EXECUTION
+        (via jax.debug.print for traced args), not once at trace time."""
+        import jax
+        st = to_static(printing_fn)
+        x = paddle.to_tensor(np.asarray([1.0], "f4"))
+        out1 = st(x)
+        jax.effects_barrier()
+        np.testing.assert_allclose(out1.numpy(), [3.0])
+        out2 = st(x)
+        jax.effects_barrier()
+        cap = capfd.readouterr()
+        # two calls x two loop prints each
+        assert cap.out.count("step") >= 4, cap.out
+
+    def test_concrete_print_stays_python(self, capsys):
+        from paddle_tpu.jit.dy2static import convert_print
+        convert_print("hello", 42)
+        assert "hello 42" in capsys.readouterr().out
+
+
+def asserting_fn(x):
+    assert x.sum() > 0, "sum must be positive"
+    return x * 2
+
+
+class TestAssertTransform:
+    def test_passing_assert(self):
+        st = to_static(asserting_fn)
+        out = st(paddle.to_tensor(np.asarray([1.0, 2.0], "f4")))
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+
+    def test_failing_assert_surfaces_at_runtime(self):
+        import jax
+        st = to_static(asserting_fn)
+        with pytest.raises(Exception, match="sum must be positive"):
+            out = st(paddle.to_tensor(np.asarray([-5.0], "f4")))
+            np.asarray(out.numpy())
+            jax.effects_barrier()
+
+    def test_concrete_assert_stays_python(self):
+        from paddle_tpu.jit.dy2static import convert_assert
+        convert_assert(True, "ok")
+        with pytest.raises(AssertionError, match="nope"):
+            convert_assert(False, "nope")
