@@ -444,6 +444,31 @@ def convert_assert(pred, msg=None):
     jax.debug.callback(cb, p, ordered=True)
 
 
+def _cast_dtype(kind):
+    # through the framework's dtype normalization (int64 -> int32 when
+    # x64 is off) so traces don't spew truncation warnings
+    from ..framework.dtype import convert_dtype
+    return convert_dtype({"int": "int64", "float": "float32",
+                          "bool": "bool"}[kind])
+
+
+def convert_cast(kind, x):
+    """Emitted for int(x)/float(x)/bool(x) (ref dygraph_to_static
+    cast_transformer: python casts -> the cast op). A traced tensor
+    becomes an astype (scalar tensors only, like the reference); python
+    values keep python semantics."""
+    u = _unwrap(x)
+    if _is_traced(u):
+        if getattr(u, "size", 1) != 1:
+            raise ValueError(
+                f"dy2static: {kind}() on a traced tensor of shape "
+                f"{jnp.shape(u)} — python casts apply to scalars; use "
+                f".astype() for arrays")
+        out = jnp.reshape(u, ()).astype(_cast_dtype(kind))
+        return Tensor(out) if isinstance(x, Tensor) else out
+    return {"int": int, "float": float, "bool": bool}[kind](x)
+
+
 def convert_logical_and(lhs_fn, rhs_fn):
     """ref logical_transformer.py convert_logical_and — preserves python
     short-circuit when concrete."""
@@ -1045,6 +1070,45 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return self._emit_cluster(n, vars_, defs, call)
 
 
+def _is_cast_call(nd):
+    return (isinstance(nd, ast.Call) and isinstance(nd.func, ast.Name)
+            and nd.func.id in ("int", "float", "bool")
+            and len(nd.args) == 1 and not nd.keywords)
+
+
+class _CallsiteTransformer(ast.NodeTransformer):
+    """print -> convert_print (output at every execution), assert ->
+    convert_assert (runtime halt), int/float/bool -> convert_cast (the
+    reference's print/assert/cast transformers)."""
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            node.func = ast.Attribute(
+                value=ast.Name(id="_jst", ctx=ast.Load()),
+                attr="convert_print", ctx=ast.Load())
+        elif _is_cast_call(node):
+            node.args = [ast.copy_location(
+                ast.Constant(value=node.func.id), node)] + node.args
+            node.func = ast.Attribute(
+                value=ast.Name(id="_jst", ctx=ast.Load()),
+                attr="convert_cast", ctx=ast.Load())
+        return node
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        args = [node.test]
+        if node.msg is not None:
+            args.append(node.msg)
+        call = ast.Call(
+            func=ast.Attribute(
+                value=ast.Name(id="_jst", ctx=ast.Load()),
+                attr="convert_assert", ctx=ast.Load()),
+            args=args, keywords=[])
+        return ast.copy_location(
+            ast.Expr(value=ast.copy_location(call, node)), node)
+
+
 _CACHE = {}
 
 
@@ -1093,38 +1157,14 @@ def convert_function(fn):
                 and nd.func.id == "print")
 
     has_cf = any(isinstance(s, (ast.If, ast.While, ast.Assert))
-                 or _range_for(s) or _is_print(s)
+                 or _range_for(s) or _is_print(s) or _is_cast_call(s)
                  for s in ast.walk(fn_node))
     if not has_cf:
         _CACHE[key] = fn
         return fn
-    # print -> convert_print (ref print_transformer.py): output at every
-    # execution, via jax.debug.print when arguments are traced
-
-    class _PrintTransformer(ast.NodeTransformer):
-        def visit_Call(self, node):
-            self.generic_visit(node)
-            if isinstance(node.func, ast.Name) and node.func.id == "print":
-                node.func = ast.Attribute(
-                    value=ast.Name(id="_jst", ctx=ast.Load()),
-                    attr="convert_print", ctx=ast.Load())
-            return node
-
-        def visit_Assert(self, node):
-            # ref assert_transformer: assert -> runtime Assert
-            self.generic_visit(node)
-            args = [node.test]
-            if node.msg is not None:
-                args.append(node.msg)
-            call = ast.Call(
-                func=ast.Attribute(
-                    value=ast.Name(id="_jst", ctx=ast.Load()),
-                    attr="convert_assert", ctx=ast.Load()),
-                args=args, keywords=[])
-            return ast.copy_location(
-                ast.Expr(value=ast.copy_location(call, node)), node)
-
-    _PrintTransformer().visit(fn_node)
+    # print/assert/cast -> per-execution runtime forms (ref
+    # print_transformer.py / assert_transformer.py / cast_transformer.py)
+    _CallsiteTransformer().visit(fn_node)
 
     # pre-passes: return -> flag/val, break/continue -> loop-carried booleans
     # (ref return_transformer.py / break_continue_transformer.py)
@@ -1202,6 +1242,7 @@ _JST = _JSTNamespace(
     convert_logical_not=convert_logical_not,
     convert_print=convert_print,
     convert_assert=convert_assert,
+    convert_cast=convert_cast,
     finalize_return=finalize_return,
     UNDEF=UNDEF,
 )

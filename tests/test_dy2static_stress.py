@@ -423,3 +423,61 @@ class TestAssertTransform:
         convert_assert(True, "ok")
         with pytest.raises(AssertionError, match="nope"):
             convert_assert(False, "nope")
+
+
+def casting_fn(x):
+    for i in range(2):
+        if x.sum() > 0:
+            x = x + float(x.sum())      # traced float() -> f32 cast
+    return x
+
+
+class TestCastTransform:
+    def test_traced_cast_in_control_flow(self):
+        _check(casting_fn, np.asarray([1.0], "f4"))
+        _check(casting_fn, np.asarray([-1.0], "f4"))
+
+    def test_concrete_cast_stays_python(self):
+        from paddle_tpu.jit.dy2static import convert_cast
+        assert convert_cast("int", 3.7) == 3
+        assert convert_cast("float", "2.5") == 2.5
+        assert convert_cast("bool", 0) is False
+
+    def test_traced_cast_nonscalar_errors(self):
+        def bad(x):
+            if x.sum() > 0:
+                return float(x)          # vector: must raise clearly
+            return x
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], "f4"))
+        with pytest.raises(Exception, match="scalars"):
+            to_static(bad)(x)
+
+
+class TestWholeModelConversion:
+    def test_gpt_forward_through_to_static(self):
+        """Whole-model conversion (ref dy2static test_bert/test_lstm
+        analog): the GPT decoder converts and matches eager."""
+        from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=32, dropout=0.0,
+                        attn_dropout=0.0)
+        model = GPTForPretraining(cfg)
+        model.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (2, 16), "i4"))
+        want = model(ids)
+        st = paddle.jit.to_static(model)
+        got = st(ids)
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.asarray(want.numpy()),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_standalone_cast_converts(self):
+        """A cast with NO other control flow must still convert (the
+        has_cf gate counts casts)."""
+        def f(x):
+            return x + float(x.sum())
+        x = paddle.to_tensor(np.asarray([1.0, 2.0], "f4"))
+        got = to_static(f)(x)
+        np.testing.assert_allclose(got.numpy(), [4.0, 5.0])
