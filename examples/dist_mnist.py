@@ -1,0 +1,84 @@
+"""Fleet-collective MNIST — the reference's dist_mnist.py benchmark
+model (python/paddle/fluid/tests/unittests/dist_mnist.py: conv-pool x2
++ fc softmax, Momentum) written against THIS framework's fleet API.
+
+BASELINE.md's methodology asks for the reference's own dist test models
+on matched global batch; this script is that model, runnable on any
+mesh (one chip, the 8-device virtual CPU mesh, or a pod slice):
+
+    python examples/dist_mnist.py [--steps 60] [--batch-size 64]
+
+The driver-facing numbers print as one JSON line at the end.
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def cnn_model(nn):
+    """The dist_mnist CNN: two conv-pool blocks + fc softmax head."""
+    return nn.Sequential(
+        nn.Conv2D(1, 20, 5), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Conv2D(20, 50, 5), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Flatten(), nn.Linear(50 * 4 * 4, 10),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet.base import build_train_step
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    strategy = fleet.DistributedStrategy()
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = mesh_mod.get_mesh()
+    ndev = 1 if mesh is None else int(np.prod(list(mesh.shape.values())))
+
+    paddle.seed(1)
+    model = cnn_model(paddle.nn)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.Momentum(learning_rate=args.lr, momentum=0.9,
+                                  parameters=model.parameters()),
+        strategy)
+    step = build_train_step(model, paddle.nn.functional.cross_entropy,
+                            opt, donate=False)
+
+    train = paddle.vision.datasets.MNIST(mode="train")
+    loader = paddle.io.DataLoader(train, batch_size=args.batch_size,
+                                  shuffle=True, drop_last=True)
+
+    losses, t0 = [], time.time()
+    it = iter(loader)
+    for i in range(args.steps):
+        try:
+            img, label = next(it)
+        except StopIteration:
+            it = iter(loader)
+            img, label = next(it)
+        loss = step(img, label.reshape([-1]))
+        losses.append(float(np.asarray(loss.numpy())))
+    dt = time.time() - t0
+
+    first = float(np.mean(losses[:5]))
+    last = float(np.mean(losses[-5:]))
+    print(json.dumps({
+        "example": "dist_mnist", "devices": ndev,
+        "global_batch": args.batch_size, "steps": args.steps,
+        "first_loss": round(first, 4), "last_loss": round(last, 4),
+        "converged": last < first * 0.5,
+        "steps_per_sec": round(args.steps / dt, 2),
+    }))
+    assert last < first * 0.5, f"no convergence: {first} -> {last}"
+
+
+if __name__ == "__main__":
+    main()

@@ -80,7 +80,12 @@ while :; do
     run_step sweep_bert  2400 python scripts/bench_sweep.py bert 16   || { sleep 60; continue; }
     probe || continue
     run_step longctx     3600 python scripts/longctx_probe.py         || { sleep 60; continue; }
-    note "BATTERY COMPLETE"
+    if python scripts/transcribe_capture.py \
+        >> docs/perf/capture_transcribe.log 2>&1; then
+      note "BATTERY COMPLETE (results transcribed into PERF.md/LONGCTX.md)"
+    else
+      note "BATTERY COMPLETE but transcription FAILED — see docs/perf/capture_transcribe.log"
+    fi
     break
   else
     note "tunnel down; sleeping ${PROBE_INTERVAL}s"

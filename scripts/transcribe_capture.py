@@ -1,0 +1,124 @@
+"""Transcribe watchdog capture logs into the perf docs.
+
+Run by tpu_watchdog.sh after the battery completes (or by hand):
+parses docs/perf/capture_*.log for the MFU/tok/s result lines that
+bench_sweep.py and longctx_probe.py print, appends a dated measured
+section to PERF.md, and fills LONGCTX.md §3's TBD rows in place. Safe
+to re-run: sections are keyed by a marker and replaced, not duplicated.
+"""
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "docs", "perf")
+
+MARK_END = "<!-- /transcribe_capture -->"
+
+RESULT_RE = re.compile(
+    r"\]\s+(?P<label>.+?):\s+(?P<ms>[\d.]+) ms/step\s+"
+    r"(?P<toks>[\d,]+) (?:tok|img|samples)/s\s+(?P<tf>[\d.]+) TF/s\s+"
+    r"MFU=(?P<mfu>[\d.]+)")
+SEQ_RE = re.compile(
+    r"\]\s+seq=(?P<seq>\d+):\s+(?P<ms>[\d.]+) ms/step\s+"
+    r"(?P<toks>[\d,]+) tok/s\s+(?P<tf>[\d.]+) TF/s\s+MFU=(?P<mfu>[\d.]+)")
+MARK = "<!-- transcribe_capture -->"
+
+
+def parse_logs():
+    rows, seq_rows, bench = [], [], None
+    for name in sorted(os.listdir(LOG)):
+        if not (name.startswith("capture_") and name.endswith(".log")):
+            continue
+        step = name[len("capture_"):-len(".log")]
+        text = open(os.path.join(LOG, name), errors="ignore").read()
+        if step == "bench":
+            for line in text.splitlines():
+                if line.startswith("{") and '"metric"' in line:
+                    try:
+                        d = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    det = d.get("detail", {})
+                    # only REAL on-chip results: error records carry no
+                    # backend key and value 0 — never transcribe those
+                    if (det.get("backend") not in (None, "cpu")
+                            and "error" not in det
+                            and d.get("value", 0) > 0):
+                        bench = d
+            continue
+        for m in SEQ_RE.finditer(text):
+            seq_rows.append((step,) + m.group("seq", "ms", "toks", "mfu"))
+        for m in RESULT_RE.finditer(text):
+            if not m.group("label").startswith("seq="):
+                rows.append((step,) + m.group("label", "ms", "toks",
+                                              "mfu"))
+    return rows, seq_rows, bench
+
+
+def main():
+    rows, seq_rows, bench = parse_logs()
+    if not (rows or seq_rows or bench):
+        print("no on-chip capture results found; nothing to transcribe")
+        return 1
+    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+
+    # ---- PERF.md: replace-or-append the measured section
+    lines = [MARK, f"\n## Measured on-chip (transcribed {stamp})\n"]
+    if bench:
+        d = bench.get("detail", {})
+        lines.append(
+            f"- bench.py: **{bench['value']:,} {bench['unit']}**, "
+            f"MFU **{bench['vs_baseline']}** "
+            f"(step {d.get('step_ms')} ms, backend {d.get('backend')})\n")
+    if rows:
+        lines.append("\n| config | ms/step | throughput | MFU |")
+        lines.append("|---|---|---|---|")
+        for step, label, ms, toks, mfu in rows:
+            lines.append(f"| {label} ({step}) | {ms} | {toks}/s | {mfu} |")
+        lines.append("")
+    lines.append(MARK_END)
+    perf = os.path.join(LOG, "PERF.md")
+    text = open(perf).read()
+    if MARK in text:
+        # replace ONLY the marked section; content added after it stays
+        head = text[:text.index(MARK)]
+        tail = ""
+        if MARK_END in text:
+            tail = text[text.index(MARK_END) + len(MARK_END):]
+        text = head.rstrip() + "\n\n" + "\n".join(lines) + tail
+    else:
+        text = text.rstrip() + "\n\n" + "\n".join(lines) + "\n"
+    with open(perf, "w") as f:
+        f.write(text)
+
+    # ---- LONGCTX.md: fill the TBD rows (report rows with no table slot)
+    filled, unmatched = 0, []
+    if seq_rows:
+        lc = os.path.join(LOG, "LONGCTX.md")
+        text = open(lc).read()
+        for step, seq, ms, toks, mfu in seq_rows:
+            batch = max(1, 8192 // int(seq))
+            text, n = re.subn(
+                rf"\| {seq} \| {batch} \| [^|]+\| [^|]+\| [^|]+\|[^|\n]*\|",
+                f"| {seq} | {batch} | {ms} | {toks} | {mfu} | "
+                f"measured {stamp} |",
+                text)
+            if n:
+                filled += n
+            else:
+                unmatched.append(seq)
+        with open(lc, "w") as f:
+            f.write(text)
+
+    print(f"transcribed: {len(rows)} sweep rows, {filled} longctx rows, "
+          f"bench={'yes' if bench else 'no'}"
+          + (f"; NO TABLE ROW for seq={unmatched} (add rows to "
+             f"LONGCTX.md by hand)" if unmatched else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
