@@ -12,6 +12,7 @@ from .program import (Program, program_guard, default_main_program,
                       tpu_places, device_guard, CompiledProgram,
                       reset_default_programs)
 from .backward import append_backward, grad_var_name
+from .paddle_pb import load_reference_checkpoint
 from .io import (save_inference_model, load_inference_model,
                  serialize_program, deserialize_program,
                  serialize_persistables, deserialize_persistables,

@@ -312,3 +312,57 @@ def load_params(model_dir, names, params_filename=None):
             with open(os.path.join(model_dir, n), "rb") as f:
                 out[n], _ = read_lod_tensor(f)
     return out
+
+
+def load_reference_checkpoint(path, names=None):
+    """Reference checkpoint -> {var name: np.ndarray}.
+
+    Reads what the reference's save_params/save_persistables wrote (ref
+    python/paddle/fluid/io.py save_vars): a DIRECTORY of per-variable
+    LoDTensor files, or a single combined file when `names` gives the
+    save_combine variable order. Use it to carry weights from a
+    reference-trained model into a Layer rebuilt here:
+
+        sd = load_reference_checkpoint("ckpt_dir")
+        model.set_state_dict({my_name(k): v for k, v in sd.items()})
+    """
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"checkpoint path {path} does not exist")
+    out = {}
+    if os.path.isdir(path):
+        if names is not None:
+            # explicit names: every requested variable must exist and
+            # parse — a typo'd/corrupt weight is an error, not a skip
+            for name in sorted(names):
+                fp = os.path.join(path, name)
+                if not os.path.isfile(fp):
+                    raise FileNotFoundError(
+                        f"requested parameter {name!r} not found under "
+                        f"{path}")
+                with open(fp, "rb") as f:
+                    out[name], _ = read_lod_tensor(f)
+            return out
+        # discovery scan: recursive ('/'-named vars land in subdirs),
+        # skipping only files that don't even LOOK like LoDTensor
+        # streams (e.g. __model__); a tensor-looking file that fails
+        # mid-parse is corrupt and must raise
+        for dirpath, _, files in sorted(os.walk(path)):
+            for fn in sorted(files):
+                fp = os.path.join(dirpath, fn)
+                name = os.path.relpath(fp, path)
+                with open(fp, "rb") as f:
+                    head = f.read(12)
+                    if len(head) < 12 or head[:4] != b"\x00\x00\x00\x00":
+                        continue          # not a LoDTensor stream
+                    f.seek(0)
+                    out[name], _ = read_lod_tensor(f)
+        if not out:
+            raise ValueError(
+                f"no LoDTensor parameter files found under {path}")
+        return out
+    if names is None:
+        raise ValueError(
+            "a combined parameter file needs `names` (the save_combine "
+            "variable order recorded by the program that saved it)")
+    return load_params(os.path.dirname(path) or ".", names,
+                       params_filename=os.path.basename(path))

@@ -502,3 +502,74 @@ def test_translate_detection_head(fw, tmp_path):
     assert len(valid)                       # something survived NMS
     assert np.all(valid[:, 0] < class_num)  # labels in range
     assert np.all(valid[:, 1] > 0.0)        # positive scores
+
+
+class TestReferenceCheckpoint:
+    def test_directory_of_param_files(self, tmp_path):
+        rng = np.random.RandomState(0)
+        arrs = {"fc_0.w_0": rng.randn(4, 8).astype("f4"),
+                "fc_0.b_0": rng.randn(8).astype("f4")}
+        for n, a in arrs.items():
+            with open(os.path.join(str(tmp_path), n), "wb") as f:
+                f.write(_lod_tensor_bytes(a))
+        # a non-tensor file in the dir (the reference leaves __model__
+        # beside params) must be skipped, not crash
+        open(os.path.join(str(tmp_path), "__model__"), "wb").write(
+            b"\x0a\x04junk")
+        sd = paddle.static.load_reference_checkpoint(str(tmp_path))
+        assert set(sd) == set(arrs)
+        for n in arrs:
+            np.testing.assert_array_equal(sd[n], arrs[n])
+
+    def test_state_dict_carries_into_layer(self, tmp_path):
+        rng = np.random.RandomState(1)
+        w = rng.randn(4, 8).astype("f4")
+        b = rng.randn(8).astype("f4")
+        with open(os.path.join(str(tmp_path), "linear.w"), "wb") as f:
+            f.write(_lod_tensor_bytes(w))
+        with open(os.path.join(str(tmp_path), "linear.b"), "wb") as f:
+            f.write(_lod_tensor_bytes(b))
+        sd = paddle.static.load_reference_checkpoint(str(tmp_path))
+        lin = paddle.nn.Linear(4, 8)
+        lin.set_state_dict({"weight": sd["linear.w"],
+                            "bias": sd["linear.b"]})
+        x = rng.randn(2, 4).astype("f4")
+        np.testing.assert_allclose(
+            lin(paddle.to_tensor(x)).numpy(), x @ w + b,
+            rtol=1e-4, atol=1e-6)
+
+    def test_combined_needs_names(self, tmp_path):
+        p = os.path.join(str(tmp_path), "params")
+        with open(p, "wb") as f:
+            f.write(_lod_tensor_bytes(np.zeros((2, 2), "f4")))
+        with pytest.raises(ValueError, match="names"):
+            paddle.static.load_reference_checkpoint(p)
+        sd = paddle.static.load_reference_checkpoint(p, names=["w"])
+        assert sd["w"].shape == (2, 2)
+
+    def test_explicit_missing_name_raises(self, tmp_path):
+        with open(os.path.join(str(tmp_path), "w"), "wb") as f:
+            f.write(_lod_tensor_bytes(np.zeros((2,), "f4")))
+        with pytest.raises(FileNotFoundError, match="typo"):
+            paddle.static.load_reference_checkpoint(
+                str(tmp_path), names=["w", "typo"])
+
+    def test_nonexistent_path_raises_clearly(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="does not exist"):
+            paddle.static.load_reference_checkpoint(
+                os.path.join(str(tmp_path), "nope"))
+
+    def test_corrupt_tensor_file_raises(self, tmp_path):
+        good = _lod_tensor_bytes(np.zeros((4, 4), "f4"))
+        with open(os.path.join(str(tmp_path), "w"), "wb") as f:
+            f.write(good[:len(good) // 2])     # truncated mid-stream
+        with pytest.raises(Exception):
+            paddle.static.load_reference_checkpoint(str(tmp_path))
+
+    def test_nested_var_names_found(self, tmp_path):
+        sub = os.path.join(str(tmp_path), "ernie")
+        os.makedirs(sub)
+        with open(os.path.join(sub, "fc.w"), "wb") as f:
+            f.write(_lod_tensor_bytes(np.ones((2, 2), "f4")))
+        sd = paddle.static.load_reference_checkpoint(str(tmp_path))
+        assert os.path.join("ernie", "fc.w") in sd
