@@ -1,0 +1,458 @@
+"""Export programs in the REFERENCE's serving format (the write path of
+the interop story; paddle_pb.py is the read path).
+
+save_reference_format() emits what the reference's save_inference_model
+writes (ref python/paddle/fluid/io.py:1199): `dirname/__model__` =
+protobuf ProgramDesc wire bytes (framework.proto schema, hand-encoded
+proto2) with prepended feed / appended fetch ops, plus per-variable
+LoDTensor parameter files — so a model trained HERE loads on the
+reference runtime (or Paddle ecosystem tools).
+
+Covers the inference op set this framework's own save_inference_model
+produces for MLP/vision/transformer graphs; an op without a reverse
+mapping raises listing the type.
+"""
+import os
+import struct
+
+import numpy as np
+
+from . import desc as D
+from . import paddle_pb as pb
+
+
+# ------------------------------------------------------------ proto2 emit
+
+def _varint(v):
+    out = bytearray()
+    if v < 0:
+        v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(fnum, wtype):
+    return _varint((fnum << 3) | wtype)
+
+
+def _f_varint(fnum, v):
+    return _key(fnum, 0) + _varint(v)
+
+
+def _f_bytes(fnum, data):
+    if isinstance(data, str):
+        data = data.encode()
+    return _key(fnum, 2) + _varint(len(data)) + data
+
+
+def _f_f32(fnum, v):
+    return _key(fnum, 5) + struct.pack("<f", v)
+
+
+# --------------------------------------------------- attr/var/op encoding
+
+def _attr_bytes(name, value):
+    """OpDesc.Attr message (framework.proto:44) from a python value."""
+    out = _f_bytes(1, name)
+    if isinstance(value, bool):
+        out += _f_varint(2, 6) + _f_varint(10, int(value))
+    elif isinstance(value, int):
+        out += _f_varint(2, 0) + _f_varint(3, value)
+    elif isinstance(value, float):
+        out += _f_varint(2, 1) + _f_f32(4, value)
+    elif isinstance(value, str):
+        out += _f_varint(2, 2) + _f_bytes(5, value)
+    elif isinstance(value, (list, tuple)):
+        if len(value) == 0:
+            # empty lists carry no element to infer from; INTS is what
+            # every empty-list attr in the covered op set is (axes,
+            # sections) — a BOOLEANS-typed empty would fail the
+            # reference runtime's GetAttr<vector<int>> type check
+            out += _f_varint(2, 3)
+        elif all(isinstance(v, bool) for v in value):
+            out += _f_varint(2, 7)
+            for v in value:
+                out += _f_varint(11, int(v))
+        elif all(isinstance(v, int) for v in value):
+            out += _f_varint(2, 3)
+            for v in value:
+                out += _f_varint(6, v)
+        elif all(isinstance(v, (int, float)) for v in value):
+            out += _f_varint(2, 4)
+            for v in value:
+                out += _f_f32(7, float(v))
+        elif all(isinstance(v, str) for v in value):
+            out += _f_varint(2, 5)
+            for v in value:
+                out += _f_bytes(8, v)
+        else:
+            raise ValueError(f"unencodable list attr {name}={value!r}")
+    else:
+        raise ValueError(f"unencodable attr {name}={value!r}")
+    return out
+
+
+def _op_var_bytes(slot, args):
+    out = _f_bytes(1, slot)
+    for a in args:
+        out += _f_bytes(2, a)
+    return out
+
+
+def _op_bytes(op_type, inputs, outputs, attrs):
+    """OpDesc message: inputs/outputs are {slot: [names]}."""
+    out = b""
+    for slot, args in inputs.items():
+        out += _f_bytes(1, _op_var_bytes(slot, args))
+    for slot, args in outputs.items():
+        out += _f_bytes(2, _op_var_bytes(slot, args))
+    out += _f_bytes(3, op_type)
+    for name, value in attrs.items():
+        out += _f_bytes(4, _attr_bytes(name, value))
+    return out
+
+
+# single source of truth: the inverse of the reader's enum->dtype table
+_NP2ENUM = {np.dtype(v): k for k, v in pb.VARTYPE_DTYPE.items()
+            if v != "bfloat16"}
+
+
+def _np_enum(dtype):
+    s = str(dtype)
+    if s == "bfloat16":
+        return 22
+    return _NP2ENUM[np.dtype(s)]
+
+
+def _tensor_desc_bytes(dtype, dims):
+    out = _f_varint(1, _np_enum(dtype))
+    for d in dims:
+        out += _f_varint(2, d if d is not None else -1)
+    return out
+
+
+def _var_bytes(name, dtype, dims, persistable, vtype=pb.LOD_TENSOR):
+    vt = _f_varint(1, vtype)
+    if vtype == pb.LOD_TENSOR:
+        vt += _f_bytes(3, _f_bytes(1, _tensor_desc_bytes(dtype, dims)))
+    out = _f_bytes(1, name) + _f_bytes(2, vt)
+    if persistable:
+        out += _f_varint(3, 1)
+    return out
+
+
+# ------------------------------------------------- reverse op translation
+
+class _UnmappedOp(Exception):
+    pass
+
+
+def _slots1(ins, outs, x_slot="X", out_slot="Out", attrs=None):
+    return {x_slot: [ins[0]]}, {out_slot: [outs[0]]}, dict(attrs or {})
+
+
+_UNARY = {"relu", "relu6", "sigmoid", "tanh", "sqrt", "rsqrt", "exp",
+          "abs", "floor", "ceil", "log", "log2", "log10", "log1p",
+          "square", "round", "sign", "erf", "softsign", "silu", "mish",
+          "softshrink", "sin", "cos", "tan", "asin", "acos", "atan",
+          "sinh", "cosh", "reciprocal", "gelu", "leaky_relu",
+          "hard_sigmoid", "hardswish", "softmax"}
+
+_UNARY_RENAME = {"hardswish": "hard_swish", "tanhshrink": "tanh_shrink",
+                 "hardshrink": "hard_shrink"}
+
+
+def _rev_pad_pairs(padding):
+    """Our per-dim pad pairs / int -> the reference 4-int paddings attr."""
+    if isinstance(padding, int):
+        return [padding, padding, padding, padding]
+    if (isinstance(padding, (list, tuple)) and len(padding) == 2
+            and all(isinstance(p, (list, tuple)) for p in padding)):
+        (t, b), (l, r) = padding
+        return [int(t), int(b), int(l), int(r)]
+    if isinstance(padding, (list, tuple)) and len(padding) == 2:
+        return [int(padding[0])] * 2 + [int(padding[1])] * 2
+    raise _UnmappedOp(f"padding form {padding!r}")
+
+
+def _reverse(op, var_dtype):
+    """Our OpDesc -> (ref_type, inputs{slot:[names]}, outputs, attrs)."""
+    t, ins, outs, a = op.type, op.inputs, op.outputs, dict(op.attrs)
+    a.pop("__callstack__", None)
+    a.pop("__rng__", None)
+    # None-valued attrs are unset knobs in our descs (e.g. softmax's
+    # to_dtype) — nothing to export
+    a = {k: v for k, v in a.items() if v is not None}
+    if t in _UNARY or t in _UNARY_RENAME:
+        ref = _UNARY_RENAME.get(t, t)
+        attrs = {}
+        if t == "softmax":
+            attrs["axis"] = int(a.pop("axis", -1))
+        elif t == "leaky_relu":
+            attrs["alpha"] = float(a.pop("negative_slope", 0.01))
+        elif t == "hard_sigmoid":
+            attrs = {"slope": float(a.pop("slope", 0.2)),
+                     "offset": float(a.pop("offset", 0.5))}
+        elif t == "gelu":
+            attrs = {"approximate": bool(a.pop("approximate", False))}
+        elif t == "softshrink":
+            attrs = {"lambda": float(a.pop("threshold", 0.5))}
+        elif t == "hardshrink":
+            attrs = {"threshold": float(a.pop("threshold", 0.5))}
+        elif t == "relu6":
+            attrs = {"threshold": 6.0}
+        if a:
+            # never DROP an attr silently — an unexported attr means the
+            # reference runtime would compute with its own default
+            raise _UnmappedOp(f"{t} with attrs {sorted(a)}")
+        i, o, at = _slots1(ins, outs, attrs=attrs)
+        return ref, i, o, at
+    if t == "conv2d":
+        inputs = {"Input": [ins[0]], "Filter": [ins[1]]}
+        if len(ins) > 2:
+            raise _UnmappedOp("conv2d with fused bias (reference conv2d "
+                              "has no Bias slot in 2.x)")
+        return "conv2d", inputs, {"Output": [outs[0]]}, {
+            "strides": [int(s) for s in _pair(a.get("stride", 1))],
+            "paddings": _rev_pad_pairs(a.get("padding", 0)),
+            "dilations": [int(d) for d in _pair(a.get("dilation", 1))],
+            "groups": int(a.get("groups", 1)),
+            "data_format": "NHWC" if a.get("channels_last") else "NCHW"}
+    if t == "batch_norm":
+        if len(ins) < 5:
+            raise _UnmappedOp(
+                "batch_norm without affine scale/bias (the reference op "
+                "requires the Scale/Bias slots)")
+        return "batch_norm", \
+            {"X": [ins[0]], "Mean": [ins[1]], "Variance": [ins[2]],
+             "Scale": [ins[3]], "Bias": [ins[4]]}, \
+            {"Y": [outs[0]], "MeanOut": [ins[1]], "VarianceOut": [ins[2]],
+             "SavedMean": [outs[0] + ".smean"],
+             "SavedVariance": [outs[0] + ".svar"]}, \
+            {"epsilon": float(a.get("epsilon", 1e-5)),
+             "momentum": float(a.get("momentum", 0.9)),
+             "is_test": not a.get("training", False),
+             "data_layout": "NHWC" if a.get("ch_axis", 1) in (-1, 3)
+             else "NCHW"}
+    if t in ("max_pool2d", "avg_pool2d"):
+        if a.get("ceil_mode"):
+            raise _UnmappedOp("pool2d ceil_mode export")
+        ks = [int(k) for k in _pair(a.get("ksize", 1))]
+        st = a.get("strides")
+        return "pool2d", {"X": [ins[0]]}, {"Out": [outs[0]]}, {
+            "pooling_type": "avg" if t == "avg_pool2d" else "max",
+            "ksize": ks,
+            "strides": [int(s) for s in _pair(st)] if st else ks,
+            "paddings": _rev_pad_pairs(a.get("padding", 0)),
+            "exclusive": not a.get("count_include_pad", True),
+            "data_format": "NHWC" if a.get("channels_last") else "NCHW"}
+    if t == "adaptive_avg_pool2d":
+        return "pool2d", {"X": [ins[0]]}, {"Out": [outs[0]]}, {
+            "pooling_type": "avg", "adaptive": True,
+            "ksize": [int(k) for k in _pair(a.get("output_size", 1))],
+            "strides": [1, 1], "paddings": [0, 0, 0, 0],
+            "data_format": "NHWC" if a.get("channels_last") else "NCHW"}
+    if t == "matmul":
+        return "matmul_v2", {"X": [ins[0]], "Y": [ins[1]]}, \
+            {"Out": [outs[0]]}, \
+            {"trans_x": bool(a.get("transpose_x", False)),
+             "trans_y": bool(a.get("transpose_y", False))}
+    if t == "mul":
+        return "mul", {"X": [ins[0]], "Y": [ins[1]]}, {"Out": [outs[0]]}, \
+            {"x_num_col_dims": int(a.get("x_num_col_dims", 1)),
+             "y_num_col_dims": int(a.get("y_num_col_dims", 1))}
+    if t in ("add", "elementwise_add"):
+        return "elementwise_add", {"X": [ins[0]], "Y": [ins[1]]}, \
+            {"Out": [outs[0]]}, {"axis": int(a.get("axis", -1))}
+    if t in ("subtract", "elementwise_sub"):
+        return "elementwise_sub", {"X": [ins[0]], "Y": [ins[1]]}, \
+            {"Out": [outs[0]]}, {"axis": int(a.get("axis", -1))}
+    if t in ("multiply", "elementwise_mul"):
+        return "elementwise_mul", {"X": [ins[0]], "Y": [ins[1]]}, \
+            {"Out": [outs[0]]}, {"axis": int(a.get("axis", -1))}
+    if t in ("divide", "elementwise_div"):
+        return "elementwise_div", {"X": [ins[0]], "Y": [ins[1]]}, \
+            {"Out": [outs[0]]}, {"axis": int(a.get("axis", -1))}
+    if t == "reshape":
+        return "reshape2", {"X": [ins[0]]}, \
+            {"Out": [outs[0]], "XShape": [outs[0] + ".xshape"]}, \
+            {"shape": [int(s) for s in a.get("shape", [])]}
+    if t == "transpose":
+        return "transpose2", {"X": [ins[0]]}, \
+            {"Out": [outs[0]], "XShape": [outs[0] + ".xshape"]}, \
+            {"axis": [int(v) for v in a.get("perm", [])]}
+    if t == "flatten":
+        return "flatten_contiguous_range", {"X": [ins[0]]}, \
+            {"Out": [outs[0]], "XShape": [outs[0] + ".xshape"]}, \
+            {"start_axis": int(a.get("start_axis", 0)),
+             "stop_axis": int(a.get("stop_axis", -1))}
+    if t == "squeeze":
+        ax = a.get("axis")
+        ax = [] if ax is None else (list(ax) if isinstance(
+            ax, (list, tuple)) else [int(ax)])
+        return "squeeze2", {"X": [ins[0]]}, \
+            {"Out": [outs[0]], "XShape": [outs[0] + ".xshape"]}, \
+            {"axes": [int(v) for v in ax]}
+    if t == "unsqueeze":
+        ax = a.get("axis", 0)
+        ax = list(ax) if isinstance(ax, (list, tuple)) else [int(ax)]
+        return "unsqueeze2", {"X": [ins[0]]}, \
+            {"Out": [outs[0]], "XShape": [outs[0] + ".xshape"]}, \
+            {"axes": [int(v) for v in ax]}
+    if t == "concat":
+        return "concat", {"X": list(ins)}, {"Out": [outs[0]]}, \
+            {"axis": int(a.get("axis", 0))}
+    if t == "embedding":
+        if a.get("padding_idx") is not None:
+            pad = int(a["padding_idx"])
+        else:
+            pad = -1
+        return "lookup_table_v2", {"Ids": [ins[0]], "W": [ins[1]]}, \
+            {"Out": [outs[0]]}, {"padding_idx": pad}
+    if t == "layer_norm":
+        inputs = {"X": [ins[0]]}
+        if len(ins) > 1:
+            inputs["Scale"] = [ins[1]]
+        if len(ins) > 2:
+            inputs["Bias"] = [ins[2]]
+        nd = int(a.get("nd", 1))
+        rank = len(var_dtype.get(ins[0], ((), None))[0] or ())
+        return "layer_norm", inputs, \
+            {"Y": [outs[0]], "Mean": [outs[0] + ".mean"],
+             "Variance": [outs[0] + ".var"]}, \
+            {"epsilon": float(a.get("epsilon", 1e-5)),
+             "begin_norm_axis": max(1, rank - nd) if rank else 1}
+    if t == "cast":
+        return "cast", {"X": [ins[0]]}, {"Out": [outs[0]]}, {
+            "in_dtype": _np_enum(var_dtype.get(
+                ins[0], (None, "float32"))[1] or "float32"),
+            "out_dtype": _np_enum(a.get("to_dtype", "float32"))}
+    raise _UnmappedOp(t)
+
+
+def _pair(v):
+    if v is None:
+        return (1, 1)
+    if isinstance(v, (int, float)):
+        return (int(v), int(v))
+    return tuple(int(x) for x in v)[:2]
+
+
+# ----------------------------------------------------------- entry point
+
+def save_reference_format(dirname, program, feed_names=None,
+                          fetch_names=None):
+    """Write `dirname/__model__` (reference ProgramDesc wire bytes) +
+    per-variable LoDTensor parameter files from a normalized (inference)
+    Program of THIS framework. Raises NotImplementedError listing any op
+    type without a reverse mapping."""
+    desc = program.desc
+    feed_names = list(feed_names or getattr(program, "_feed_names", []))
+    fetch_names = list(fetch_names
+                       or getattr(program, "_fetch_names", []))
+    if not feed_names or not fetch_names:
+        raise ValueError("save_reference_format needs feed/fetch names "
+                         "(normalize the program first)")
+
+    var_info = {}
+    for v in desc.vars.values():
+        var_info[v.name] = (v.shape, v.dtype)
+
+    ops, extra_vars, unmapped = [], {}, set()
+    for op in desc.ops:
+        if op.type in D.BUILTIN_OPS:
+            raise ValueError(
+                "program contains training ops; export the normalized "
+                "inference clone (normalize_program / "
+                "save_inference_model path)")
+        try:
+            ref_t, i, o, at = _reverse(op, var_info)
+        except _UnmappedOp as e:
+            unmapped.add(str(e))
+            continue
+        ops.append((ref_t, i, o, at))
+        for slot_args in o.values():
+            for n in slot_args:
+                if n not in var_info:
+                    extra_vars[n] = (None, "float32")
+    if unmapped:
+        raise NotImplementedError(
+            f"ops without a reference mapping: {sorted(unmapped)} — "
+            "extend static/paddle_export.py::_reverse")
+
+    # CONST vars (e.g. scale factors) become persistable params too
+    const_arrays = {}
+    for v in desc.vars.values():
+        if v.kind == D.CONST:
+            const_arrays[v.name] = np.asarray(v.value)
+
+    blk = b""
+    blk += _f_varint(1, 0) + _f_varint(2, -1)   # parent_idx
+    # vars: feed/fetch holders + every named var
+    blk += _f_bytes(3, _var_bytes("feed", "float32", [],
+                                  True, pb.FEED_MINIBATCH))
+    blk += _f_bytes(3, _var_bytes("fetch", "float32", [],
+                                  True, pb.FETCH_LIST))
+    persist = []
+    for v in desc.vars.values():
+        persistable = v.kind in (D.PERSIST, D.CONST)
+        if persistable:
+            persist.append(v.name)
+        dims = list(v.shape) if v.shape is not None else []
+        blk += _f_bytes(3, _var_bytes(v.name, v.dtype or "float32",
+                                      dims, persistable))
+    for n in extra_vars:
+        blk += _f_bytes(3, _var_bytes(n, "float32", [], False))
+
+    # ops: prepended feeds, body, appended fetches (ref io.py
+    # prepend_feed_ops/append_fetch_ops)
+    op_blobs = []
+    for i, n in enumerate(feed_names):
+        op_blobs.append(_op_bytes("feed", {"X": ["feed"]}, {"Out": [n]},
+                                  {"col": i}))
+    for ref_t, i_, o_, at in ops:
+        op_blobs.append(_op_bytes(ref_t, i_, o_, at))
+    for i, n in enumerate(fetch_names):
+        op_blobs.append(_op_bytes("fetch", {"X": [n]},
+                                  {"Out": ["fetch"]}, {"col": i}))
+    for blob in op_blobs:
+        blk += _f_bytes(4, blob)
+
+    prog = _f_bytes(1, blk)
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__model__"), "wb") as f:
+        f.write(prog)
+
+    # parameters: per-var LoDTensor streams (save_vars layout)
+    for name in persist:
+        if name in const_arrays:
+            arr = const_arrays[name]
+        else:
+            arr = np.asarray(program._persist[name]._data)
+        _write_lod_tensor(os.path.join(dirname, name), arr)
+    return os.path.join(dirname, "__model__")
+
+
+def _write_lod_tensor(path, arr):
+    """lod_tensor.cc SerializeToStream layout (lod-free)."""
+    desc = _f_varint(1, _np_enum(arr.dtype))
+    for d in arr.shape:
+        desc += _f_varint(2, int(d))
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<I", 0))           # LoDTensor version
+        f.write(struct.pack("<Q", 0))           # lod levels
+        f.write(struct.pack("<I", 0))           # Tensor version
+        f.write(struct.pack("<i", len(desc)))
+        f.write(desc)
+        if str(arr.dtype) == "bfloat16":
+            f.write(arr.view(np.uint16).tobytes())
+        else:
+            f.write(arr.tobytes())

@@ -567,10 +567,13 @@ class Executor:
                 cached = jax.jit(runner, donate_argnums=(1,))
             self._cache[key + (sig, mesh is not None)] = cached
 
-        # refresh scheduler-driven vars (lr) from their live sources
+        # refresh scheduler-driven vars (lr) from their live sources;
+        # a clone pruned to the fetch closure (normalize_program) drops
+        # optimizer vars but inherits the updater map — skip those
         for vname, getter in getattr(program, "_lr_updaters", {}).items():
-            program._persist[vname]._data = jnp.asarray(float(getter()),
-                                                        jnp.float32)
+            if vname in program._persist:
+                program._persist[vname]._data = jnp.asarray(
+                    float(getter()), jnp.float32)
         persist = {n: program._persist[n]._data for n in persist_names}
         self._run_count += 1
         rng = jax.random.fold_in(jax.random.PRNGKey(program.random_seed),

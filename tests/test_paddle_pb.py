@@ -573,3 +573,106 @@ class TestReferenceCheckpoint:
             f.write(_lod_tensor_bytes(np.ones((2, 2), "f4")))
         sd = paddle.static.load_reference_checkpoint(str(tmp_path))
         assert os.path.join("ernie", "fc.w") in sd
+
+
+class TestReferenceExport:
+    """The WRITE path: save_reference_format emits protobuf ProgramDesc
+    bytes the OFFICIAL protobuf runtime parses against the reference's
+    own schema, and the model round-trips through our loader."""
+
+    def _build_mlp_program(self):
+        paddle.static.reset_default_programs()
+        paddle.seed(0)
+        with paddle.static.program_guard(paddle.static.Program()) as prog:
+            x = paddle.static.data("x", [None, 8])
+            w1 = paddle.create_parameter([8, 16], "float32")
+            b1 = paddle.create_parameter([16], "float32")
+            h = paddle.nn.functional.relu(
+                paddle.add(paddle.matmul(x, w1), b1))
+            w2 = paddle.create_parameter([16, 4], "float32")
+            y = paddle.nn.functional.softmax(paddle.matmul(h, w2))
+        norm = paddle.static.normalize_program(prog, [x], [y])
+        return norm
+
+    def test_official_decoder_parses_export(self, fw, tmp_path):
+        norm = self._build_mlp_program()
+        out = os.path.join(str(tmp_path), "exported")
+        paddle.static.save_reference_format(out, norm)
+        prog = fw.ProgramDesc()
+        prog.ParseFromString(open(os.path.join(out, "__model__"),
+                                  "rb").read())
+        assert len(prog.blocks) == 1
+        blk = prog.blocks[0]
+        types = [op.type for op in blk.ops]
+        assert types[0] == "feed" and types[-1] == "fetch"
+        assert "matmul_v2" in types and "relu" in types \
+            and "softmax" in types
+        persist = {v.name for v in blk.vars if v.persistable}
+        assert len(persist & {op.inputs[1].arguments[0]
+                              for op in blk.ops
+                              if op.type == "matmul_v2"}) > 0
+
+    def test_round_trip_through_our_loader(self, fw, tmp_path):
+        norm = self._build_mlp_program()
+        # reference output on a probe batch BEFORE export
+        exe = paddle.static.Executor()
+        x = np.random.RandomState(3).randn(5, 8).astype("f4")
+        (want,) = exe.run(norm, feed={"x": x},
+                          fetch_list=norm._fetch_names)
+        out = os.path.join(str(tmp_path), "exported")
+        paddle.static.save_reference_format(out, norm)
+        prog2, feeds, fetches = paddle.static.load_inference_model(out)
+        (got,) = exe.run(prog2, feed={feeds[0]: x}, fetch_list=fetches)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_unmapped_op_raises(self, tmp_path):
+        paddle.static.reset_default_programs()
+        with paddle.static.program_guard(paddle.static.Program()) as prog:
+            x = paddle.static.data("x", [None, 4])
+            y = paddle.cumsum(x, axis=1)
+        norm = paddle.static.normalize_program(prog, [x], [y])
+        with pytest.raises(NotImplementedError, match="cumsum"):
+            paddle.static.save_reference_format(
+                os.path.join(str(tmp_path), "e"), norm)
+
+    def test_cnn_export_round_trip(self, fw, tmp_path):
+        """conv2d + batch_norm(eval) + pool + flatten export and
+        round-trip (exercises the layout-sensitive reverse mappings)."""
+        paddle.static.reset_default_programs()
+        paddle.seed(1)
+        with paddle.static.program_guard(paddle.static.Program()) as prog:
+            img = paddle.static.data("img", [None, 2, 8, 8])
+            w = paddle.create_parameter([4, 2, 3, 3], "float32")
+            c = paddle.nn.functional.conv2d(img, w, padding=1)
+            rm = paddle.create_parameter([4], "float32")
+            rv = paddle.create_parameter([4], "float32")
+            sc = paddle.create_parameter([4], "float32")
+            bi = paddle.create_parameter([4], "float32")
+            import paddle_tpu.nn.functional as F
+            bn = F.batch_norm(c, rm, rv, sc, bi, training=False)
+            p = F.max_pool2d(bn, 2, stride=2)
+            flat = paddle.flatten(p, start_axis=1)
+            y = paddle.nn.functional.relu(flat)
+        # bn running stats init to zeros var -> make them sane
+        r = np.random.RandomState(0)
+        for n, t in prog._persist.items():
+            arr = r.rand(*t._data.shape).astype("f4") + 0.5
+            t._data = paddle.to_tensor(arr)._data
+        norm = paddle.static.normalize_program(prog, [img], [y])
+
+        exe = paddle.static.Executor()
+        x = r.randn(2, 2, 8, 8).astype("f4")
+        (want,) = exe.run(norm, feed={"img": x},
+                          fetch_list=norm._fetch_names)
+        out = os.path.join(str(tmp_path), "cnn")
+        paddle.static.save_reference_format(out, norm)
+        # official decoder sees a pool2d + batch_norm with is_test
+        pd = fw.ProgramDesc()
+        pd.ParseFromString(open(os.path.join(out, "__model__"),
+                                "rb").read())
+        types = [op.type for op in pd.blocks[0].ops]
+        assert "conv2d" in types and "batch_norm" in types \
+            and "pool2d" in types
+        prog2, feeds, fetches = paddle.static.load_inference_model(out)
+        (got,) = exe.run(prog2, feed={feeds[0]: x}, fetch_list=fetches)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
