@@ -214,16 +214,33 @@ def _reverse(op, var_dtype):
         i, o, at = _slots1(ins, outs, attrs=attrs)
         return ref, i, o, at
     if t == "conv2d":
-        inputs = {"Input": [ins[0]], "Filter": [ins[1]]}
-        if len(ins) > 2:
-            raise _UnmappedOp("conv2d with fused bias (reference conv2d "
-                              "has no Bias slot in 2.x)")
-        return "conv2d", inputs, {"Output": [outs[0]]}, {
+        conv_attrs = {
             "strides": [int(s) for s in _pair(a.get("stride", 1))],
             "paddings": _rev_pad_pairs(a.get("padding", 0)),
             "dilations": [int(d) for d in _pair(a.get("dilation", 1))],
             "groups": int(a.get("groups", 1)),
             "data_format": "NHWC" if a.get("channels_last") else "NCHW"}
+        if len(ins) > 2:
+            # fused bias: the reference composition is conv2d +
+            # elementwise_add over the channel axis
+            mid = outs[0] + ".conv"
+            ch_axis = 3 if a.get("channels_last") else 1
+            return [("conv2d", {"Input": [ins[0]], "Filter": [ins[1]]},
+                     {"Output": [mid]}, conv_attrs),
+                    ("elementwise_add", {"X": [mid], "Y": [ins[2]]},
+                     {"Out": [outs[0]]}, {"axis": ch_axis})]
+        return "conv2d", {"Input": [ins[0]], "Filter": [ins[1]]}, \
+            {"Output": [outs[0]]}, conv_attrs
+    if t == "linear":
+        # our fused linear -> matmul_v2 (+ elementwise_add for bias)
+        if len(ins) > 2:
+            mid = outs[0] + ".mm"
+            return [("matmul_v2", {"X": [ins[0]], "Y": [ins[1]]},
+                     {"Out": [mid]}, {"trans_x": False, "trans_y": False}),
+                    ("elementwise_add", {"X": [mid], "Y": [ins[2]]},
+                     {"Out": [outs[0]]}, {"axis": -1})]
+        return "matmul_v2", {"X": [ins[0]], "Y": [ins[1]]}, \
+            {"Out": [outs[0]]}, {"trans_x": False, "trans_y": False}
     if t == "batch_norm":
         if len(ins) < 5:
             raise _UnmappedOp(
@@ -372,15 +389,21 @@ def save_reference_format(dirname, program, feed_names=None,
                 "inference clone (normalize_program / "
                 "save_inference_model path)")
         try:
-            ref_t, i, o, at = _reverse(op, var_info)
+            rev = _reverse(op, var_info)
         except _UnmappedOp as e:
             unmapped.add(str(e))
             continue
-        ops.append((ref_t, i, o, at))
-        for slot_args in o.values():
-            for n in slot_args:
-                if n not in var_info:
-                    extra_vars[n] = (None, "float32")
+        # intermediates introduced by multi-op expansions carry REAL data
+        # in the source op's dtype (an fp16 model must not declare fp32
+        # mids — Paddle IR passes trust VarDesc dtype)
+        op_dtype = (var_info.get(op.inputs[0], (None, None))[1]
+                    or "float32") if op.inputs else "float32"
+        for ref_t, i, o, at in (rev if isinstance(rev, list) else [rev]):
+            ops.append((ref_t, i, o, at))
+            for slot_args in o.values():
+                for n in slot_args:
+                    if n not in var_info:
+                        extra_vars[n] = (None, op_dtype)
     if unmapped:
         raise NotImplementedError(
             f"ops without a reference mapping: {sorted(unmapped)} — "
@@ -407,8 +430,8 @@ def save_reference_format(dirname, program, feed_names=None,
         dims = list(v.shape) if v.shape is not None else []
         blk += _f_bytes(3, _var_bytes(v.name, v.dtype or "float32",
                                       dims, persistable))
-    for n in extra_vars:
-        blk += _f_bytes(3, _var_bytes(n, "float32", [], False))
+    for n, (_, dt) in extra_vars.items():
+        blk += _f_bytes(3, _var_bytes(n, dt, [], False))
 
     # ops: prepended feeds, body, appended fetches (ref io.py
     # prepend_feed_ops/append_fetch_ops)

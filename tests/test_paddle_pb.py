@@ -676,3 +676,35 @@ class TestReferenceExport:
         prog2, feeds, fetches = paddle.static.load_inference_model(out)
         (got,) = exe.run(prog2, feed={feeds[0]: x}, fetch_list=fetches)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("model_name,shape", [
+        ("LeNet", (2, 1, 28, 28)),
+        ("resnet18", (1, 3, 32, 32)),
+    ])
+    def test_vision_model_export_round_trip(self, fw, tmp_path,
+                                            model_name, shape):
+        """Real zoo models (fused conv-bias, fused linear, residual adds,
+        bn, pools) export to the reference format and round-trip."""
+        import paddle_tpu.vision.models as M
+        paddle.static.reset_default_programs()
+        paddle.seed(0)
+        net = (M.LeNet() if model_name == "LeNet"
+               else M.resnet18())
+        net.eval()
+        with paddle.static.program_guard(paddle.static.Program()) as prog:
+            x = paddle.static.data("x", [None] + list(shape[1:]))
+            y = net(x)
+        norm = paddle.static.normalize_program(prog, [x], [y])
+        exe = paddle.static.Executor()
+        xp = np.random.RandomState(0).randn(*shape).astype("f4")
+        (want,) = exe.run(norm, feed={"x": xp},
+                          fetch_list=norm._fetch_names)
+        out = os.path.join(str(tmp_path), model_name)
+        paddle.static.save_reference_format(out, norm)
+        pd = fw.ProgramDesc()
+        pd.ParseFromString(open(os.path.join(out, "__model__"),
+                                "rb").read())
+        assert len(pd.blocks[0].ops) > 10
+        prog2, feeds, fetches = paddle.static.load_inference_model(out)
+        (got,) = exe.run(prog2, feed={feeds[0]: xp}, fetch_list=fetches)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
