@@ -393,12 +393,15 @@ def save_reference_format(dirname, program, feed_names=None,
         except _UnmappedOp as e:
             unmapped.add(str(e))
             continue
-        # intermediates introduced by multi-op expansions carry REAL data
+        # intermediates introduced by multi-op EXPANSIONS carry real data
         # in the source op's dtype (an fp16 model must not declare fp32
-        # mids — Paddle IR passes trust VarDesc dtype)
+        # mids — Paddle IR passes trust VarDesc dtype). Dummy outputs of
+        # single-op mappings (SavedMean/XShape and friends) stay fp32,
+        # which is what the reference kernels produce for saved stats.
+        expanded = isinstance(rev, list)
         op_dtype = (var_info.get(op.inputs[0], (None, None))[1]
-                    or "float32") if op.inputs else "float32"
-        for ref_t, i, o, at in (rev if isinstance(rev, list) else [rev]):
+                    or "float32") if (expanded and op.inputs) else "float32"
+        for ref_t, i, o, at in (rev if expanded else [rev]):
             ops.append((ref_t, i, o, at))
             for slot_args in o.values():
                 for n in slot_args:
@@ -479,3 +482,37 @@ def _write_lod_tensor(path, arr):
             f.write(arr.view(np.uint16).tobytes())
         else:
             f.write(arr.tobytes())
+
+
+def export_layer_reference_format(layer, dirname, input_spec):
+    """One-call Layer export to the reference serving format: capture the
+    forward under program_guard (eval mode), prune to the fetch closure,
+    and save_reference_format. `input_spec` is a list of InputSpec (or
+    (shape, dtype) tuples); returns the __model__ path.
+
+        paddle.static.export_layer_reference_format(
+            model, "served", [paddle.static.InputSpec([None, 3, 224, 224])])
+    """
+    from .program import Program, program_guard, data, InputSpec
+    from .io import normalize_program
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        with program_guard(Program()) as prog:
+            feeds = []
+            for i, spec in enumerate(input_spec):
+                if isinstance(spec, (tuple, list)) \
+                        and not isinstance(spec, InputSpec):
+                    spec = InputSpec(*spec)
+                name = getattr(spec, "name", None) or f"x{i}"
+                feeds.append(data(name, list(spec.shape),
+                                  str(getattr(spec, "dtype", "float32"))))
+            out = layer(*feeds)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        norm = normalize_program(prog, feeds, outs)
+        return save_reference_format(dirname, norm)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()

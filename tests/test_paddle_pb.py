@@ -708,3 +708,21 @@ class TestReferenceExport:
         prog2, feeds, fetches = paddle.static.load_inference_model(out)
         (got,) = exe.run(prog2, feed={feeds[0]: xp}, fetch_list=fetches)
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_layer_one_call_export(self, fw, tmp_path):
+        """export_layer_reference_format: Layer -> reference dir in one
+        call (capture + normalize + emit)."""
+        import paddle_tpu.vision.models as M
+        paddle.static.reset_default_programs()
+        paddle.seed(2)
+        net = M.LeNet()
+        out = os.path.join(str(tmp_path), "lenet")
+        paddle.static.export_layer_reference_format(
+            net, out, [paddle.static.InputSpec([None, 1, 28, 28])])
+        x = np.random.RandomState(1).randn(3, 1, 28, 28).astype("f4")
+        net.eval()
+        want = net(paddle.to_tensor(x)).numpy()
+        prog2, feeds, fetches = paddle.static.load_inference_model(out)
+        exe = paddle.static.Executor()
+        (got,) = exe.run(prog2, feed={feeds[0]: x}, fetch_list=fetches)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
