@@ -5,6 +5,9 @@ The reference attaches generated `core.ops.*` fast-path methods to VarBase
 pure-python op functions onto Tensor as methods/dunders at import time.
 """
 from . import creation, math, manipulation, logic, sequence, legacy
+# flash_attention's registered form must be importable from the BASE
+# package: serialized transformer descs resolve it in fresh processes
+from .pallas import flash_attention as _flash_attention_mod  # noqa: F401
 from .dispatch import OP_REGISTRY, apply, def_op, as_array
 from ..framework.tensor import Tensor
 

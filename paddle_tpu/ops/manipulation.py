@@ -333,7 +333,58 @@ def rot90(x, k=1, axes=(0, 1), name=None):
 
 # ----------------------------------------------------------------- index ops
 
+def _index_spec(idx):
+    """JSON-able encoding of a BASIC index (ints/slices/None/Ellipsis,
+    tuples thereof) or None when the index needs arrays (advanced
+    indexing stays a closure op)."""
+    def enc(i):
+        if isinstance(i, bool):
+            return None
+        if isinstance(i, (int, np.integer)):
+            return ["i", int(i)]
+        if isinstance(i, builtins.slice):
+            def v(x):
+                return None if x is None else int(x)
+            return ["s", v(i.start), v(i.stop), v(i.step)]
+        if i is None:
+            return ["n"]
+        if i is Ellipsis:
+            return ["e"]
+        return None
+
+    items = idx if isinstance(idx, tuple) else (idx,)
+    out = []
+    for i in items:
+        e = enc(i)
+        if e is None:
+            return None
+        out.append(e)
+    return out
+
+
+def _getitem_raw(a, spec=()):
+    idx = []
+    for e in spec:
+        if e[0] == "i":
+            idx.append(int(e[1]))
+        elif e[0] == "s":
+            idx.append(builtins.slice(e[1], e[2], e[3]))
+        elif e[0] == "n":
+            idx.append(None)
+        else:
+            idx.append(Ellipsis)
+    return a[tuple(idx)]
+
+
+register_op("getitem", _getitem_raw)
+
+
 def getitem(x, idx):
+    spec = _index_spec(idx)
+    if spec is not None:
+        # basic indexing: a registered, desc-serializable op
+        return apply(_getitem_raw, (x,), {"spec": spec}, name="getitem")
+
     def conv(i):
         if isinstance(i, Tensor):
             return i._data

@@ -470,6 +470,20 @@ def _flash_array(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
+def _flash_attention_raw(q, k, v, *maybe_mask, causal=False, scale=None,
+                         layout="bhsd"):
+    """Registered (desc-serializable) dropout-free form — captured
+    transformer programs stay portable across processes."""
+    m = maybe_mask[0] if maybe_mask else None
+    return _flash_array(q, k, v, mask=m, causal=causal, dropout_p=0.0,
+                        scale=scale, layout=layout)
+
+
+from ..dispatch import register_op as _register_op
+
+_register_op("flash_attention", _flash_attention_raw)
+
+
 def flash_attention(q, k, v, attn_mask=None, causal=False, dropout_p=0.0,
                     scale=None, layout="bhsd"):
     """Tensor-level op (dispatcher-integrated: eager tape or functional).
@@ -478,7 +492,17 @@ def flash_attention(q, k, v, attn_mask=None, causal=False, dropout_p=0.0,
     from ..dispatch import apply
     from ...framework import state
 
-    rng_key = state.next_rng_key() if dropout_p else None
+    args = (q, k, v) if attn_mask is None else (q, k, v, attn_mask)
+    if not dropout_p:
+        return apply(_flash_attention_raw, args,
+                     {"causal": bool(causal),
+                      "scale": None if scale is None else float(scale),
+                      "layout": str(layout)},
+                     name="flash_attention")
+
+    # attention dropout draws a key: stays an in-process closure op (a
+    # desc-portable rng form would thread the key input like dropout)
+    rng_key = state.next_rng_key()
 
     def f(q_, k_, v_, *maybe_mask):
         m = maybe_mask[0] if maybe_mask else None
@@ -486,7 +510,6 @@ def flash_attention(q, k, v, attn_mask=None, causal=False, dropout_p=0.0,
                             dropout_p=dropout_p, scale=scale,
                             rng_key=rng_key, layout=layout)
 
-    args = (q, k, v) if attn_mask is None else (q, k, v, attn_mask)
     return apply(f, args, name="flash_attention")
 
 

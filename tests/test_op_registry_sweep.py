@@ -382,6 +382,10 @@ SPECS = {
     "roll": S([F32((2, 3))], {"shifts": 1, "axis": 0}),
     "rot90": S([F32((2, 3))], {"k": 1, "axes": [0, 1]}),
     "slice": S([F32((4, 3))], {"axes": [0], "starts": [1], "ends": [3]}),
+    # basic-index getitem (registered so captured transformer programs
+    # serialize): x[1:3, None, ..., 0]
+    "getitem": S([F32((4, 3, 2))],
+                 {"spec": [["s", 1, 3, None], ["n"], ["e"], ["i", 0]]}),
     "strided_slice": S([F32((4, 3))],
                        {"axes": [0], "starts": [0], "ends": [4],
                         "strides": [2]}),
