@@ -181,7 +181,151 @@ def _rev_pad_pairs(padding):
     raise _UnmappedOp(f"padding form {padding!r}")
 
 
-def _reverse(op, var_dtype):
+class _ExportCtx:
+    """Carries var metadata + generated constants across _reverse calls
+    (decompositions like the causal mask need new persistable params)."""
+
+    def __init__(self, var_info):
+        self.var_info = var_info
+        self.gen_consts = {}
+        self._n = 0
+
+    def new_const(self, hint, arr):
+        arr = np.asarray(arr)
+        # content-dedup: N transformer layers share ONE causal mask
+        key = (hint, arr.shape, str(arr.dtype), arr.tobytes())
+        if not hasattr(self, "_const_keys"):
+            self._const_keys = {}
+        if key in self._const_keys:
+            return self._const_keys[key]
+        self._n += 1
+        name = f"@export_const_{self._n}_{hint}"
+        self.gen_consts[name] = arr
+        self._const_keys[key] = name
+        return name
+
+    def dims(self, name):
+        return self.var_info.get(name, (None, None))[0]
+
+
+def _reverse_getitem(op, ctx):
+    """Basic-index getitem -> slice (+ squeeze2 for int axes). Supports
+    int and step-1 slice items (what captured model code produces);
+    other forms raise."""
+    spec = op.attrs.get("spec", [])
+    axes, starts, ends, int_axes = [], [], [], []
+    for ax, e in enumerate(spec):
+        if e[0] == "i":
+            i = int(e[1])
+            if i < 0:
+                dims = ctx.dims(op.inputs[0])
+                if not dims or ax >= len(dims) or dims[ax] is None:
+                    raise _UnmappedOp("getitem negative index w/o dims")
+                i += int(dims[ax])
+            axes.append(ax)
+            starts.append(i)
+            ends.append(i + 1)
+            int_axes.append(ax)
+        elif e[0] == "s":
+            start, stop, step = e[1], e[2], e[3]
+            if step not in (None, 1):
+                raise _UnmappedOp("getitem strided slice export")
+            if start is None and stop is None:
+                continue                       # full slice: no-op axis
+            axes.append(ax)
+            starts.append(0 if start is None else int(start))
+            ends.append(2 ** 31 - 1 if stop is None else int(stop))
+        else:
+            raise _UnmappedOp(f"getitem {e[0]!r} item export")
+    out = op.outputs[0]
+    ops = []
+    mid = out + ".sl" if int_axes else out
+    if axes:
+        ops.append(("slice", {"Input": [op.inputs[0]]}, {"Out": [mid]},
+                    {"axes": axes, "starts": starts, "ends": ends}))
+    else:
+        mid = op.inputs[0]
+    if int_axes:
+        ops.append(("squeeze2",
+                    {"X": [mid]},
+                    {"Out": [out], "XShape": [out + ".xshape"]},
+                    {"axes": int_axes}))
+    if not ops:
+        # all-full-slice index (x[:] / x[:, :]): identity via scale 1
+        ops.append(("scale", {"X": [op.inputs[0]]}, {"Out": [out]},
+                    {"scale": 1.0, "bias": 0.0,
+                     "bias_after_scale": True}))
+    return ops
+
+
+def _reverse_flash(op, ctx):
+    """flash_attention -> the reference composition: transposes (BSHD),
+    scaled matmul_v2(QK^T) + causal-mask add + softmax + matmul_v2."""
+    import math as _math
+    a = op.attrs
+    if len(op.inputs) != 3:
+        raise _UnmappedOp("flash_attention with attn_mask export")
+    q, k, v = op.inputs
+    out = op.outputs[0]
+    layout = a.get("layout", "bhsd")
+    dims = ctx.dims(q)
+    if not dims or len(dims) != 4 or any(d is None for d in dims[1:]):
+        raise _UnmappedOp("flash_attention without static q dims")
+    if layout == "bshd":
+        b_, S, H, Dh = dims
+    else:
+        b_, H, S, Dh = dims
+    scale = a.get("scale")
+    scale = float(scale) if scale is not None else 1.0 / _math.sqrt(Dh)
+    ops = []
+    if layout == "bshd":
+        qt, kt, vt = (n + ".t" for n in (q, k, v))
+        for src, dst in ((q, qt), (k, kt), (v, vt)):
+            ops.append(("transpose2", {"X": [src]},
+                        {"Out": [dst], "XShape": [dst + ".xshape"]},
+                        {"axis": [0, 2, 1, 3]}))
+        q, k, v = qt, kt, vt
+    qk = out + ".qk"
+    ops.append(("matmul_v2", {"X": [q], "Y": [k]}, {"Out": [qk]},
+                {"trans_x": False, "trans_y": True}))
+    sc = out + ".scaled"
+    ops.append(("scale", {"X": [qk]}, {"Out": [sc]},
+                {"scale": scale, "bias": 0.0, "bias_after_scale": True}))
+    cur = sc
+    if a.get("causal", False):
+        # mask dtype follows q (mismatched X/Y dtypes fail the reference
+        # elementwise_add check); fp16 can't represent -1e9
+        qdt_s = str(ctx.var_info.get(op.inputs[0],
+                                     (None, None))[1] or "float32")
+        if qdt_s == "bfloat16":
+            import jax.numpy as jnp
+            mask = np.triu(np.full((S, S), -1e9, np.float32),
+                           k=1).astype(jnp.bfloat16)
+        else:
+            qdt = np.dtype(qdt_s)
+            fill = -6e4 if qdt == np.dtype("float16") else -1e9
+            mask = np.triu(np.full((S, S), fill, qdt), k=1)
+        mname = ctx.new_const("causal_mask", mask)
+        masked = out + ".masked"
+        ops.append(("elementwise_add", {"X": [cur], "Y": [mname]},
+                    {"Out": [masked]}, {"axis": -1}))
+        cur = masked
+    sm = out + ".sm"
+    ops.append(("softmax", {"X": [cur]}, {"Out": [sm]}, {"axis": -1}))
+    if layout == "bshd":
+        att = out + ".att"
+        ops.append(("matmul_v2", {"X": [sm], "Y": [v]}, {"Out": [att]},
+                    {"trans_x": False, "trans_y": False}))
+        ops.append(("transpose2", {"X": [att]},
+                    {"Out": [out], "XShape": [out + ".xshape"]},
+                    {"axis": [0, 2, 1, 3]}))
+    else:
+        ops.append(("matmul_v2", {"X": [sm], "Y": [v]}, {"Out": [out]},
+                    {"trans_x": False, "trans_y": False}))
+    return ops
+
+
+def _reverse(op, var_dtype, ctx=None):
     """Our OpDesc -> (ref_type, inputs{slot:[names]}, outputs, attrs)."""
     t, ins, outs, a = op.type, op.inputs, op.outputs, dict(op.attrs)
     a.pop("__callstack__", None)
@@ -189,6 +333,10 @@ def _reverse(op, var_dtype):
     # None-valued attrs are unset knobs in our descs (e.g. softmax's
     # to_dtype) — nothing to export
     a = {k: v for k, v in a.items() if v is not None}
+    if t == "getitem" and ctx is not None:
+        return _reverse_getitem(op, ctx)
+    if t == "flash_attention" and ctx is not None:
+        return _reverse_flash(op, ctx)
     if t in _UNARY or t in _UNARY_RENAME:
         ref = _UNARY_RENAME.get(t, t)
         attrs = {}
@@ -380,6 +528,7 @@ def save_reference_format(dirname, program, feed_names=None,
     var_info = {}
     for v in desc.vars.values():
         var_info[v.name] = (v.shape, v.dtype)
+    ctx = _ExportCtx(var_info)
 
     ops, extra_vars, unmapped = [], {}, set()
     for op in desc.ops:
@@ -389,7 +538,7 @@ def save_reference_format(dirname, program, feed_names=None,
                 "inference clone (normalize_program / "
                 "save_inference_model path)")
         try:
-            rev = _reverse(op, var_info)
+            rev = _reverse(op, var_info, ctx)
         except _UnmappedOp as e:
             unmapped.add(str(e))
             continue
@@ -417,6 +566,7 @@ def save_reference_format(dirname, program, feed_names=None,
     for v in desc.vars.values():
         if v.kind == D.CONST:
             const_arrays[v.name] = np.asarray(v.value)
+    const_arrays.update(ctx.gen_consts)   # decomposition constants
 
     blk = b""
     blk += _f_varint(1, 0) + _f_varint(2, -1)   # parent_idx
@@ -435,6 +585,10 @@ def save_reference_format(dirname, program, feed_names=None,
                                       dims, persistable))
     for n, (_, dt) in extra_vars.items():
         blk += _f_bytes(3, _var_bytes(n, dt, [], False))
+    for n, arr in ctx.gen_consts.items():     # decomposition constants
+        persist.append(n)
+        blk += _f_bytes(3, _var_bytes(n, str(arr.dtype),
+                                      list(arr.shape), True))
 
     # ops: prepended feeds, body, appended fetches (ref io.py
     # prepend_feed_ops/append_fetch_ops)

