@@ -263,9 +263,16 @@ def _reverse_flash(op, ctx):
     scaled matmul_v2(QK^T) + causal-mask add + softmax + matmul_v2."""
     import math as _math
     a = op.attrs
-    if len(op.inputs) != 3:
-        raise _UnmappedOp("flash_attention with attn_mask export")
-    q, k, v = op.inputs
+    if len(op.inputs) not in (3, 4):
+        raise _UnmappedOp("flash_attention input arity")
+    if "causal" not in a or "layout" not in a:
+        # closure-recorded variants (flash_attention_xla, the dropout
+        # path) keep causal/scale in python — decomposing them from
+        # defaults would silently drop the causal mask
+        raise _UnmappedOp(
+            "flash_attention recorded without attrs (closure form)")
+    q, k, v = op.inputs[:3]
+    attn_mask = op.inputs[3] if len(op.inputs) == 4 else None
     out = op.outputs[0]
     layout = a.get("layout", "bhsd")
     dims = ctx.dims(q)
@@ -310,6 +317,26 @@ def _reverse_flash(op, ctx):
         ops.append(("elementwise_add", {"X": [cur], "Y": [mname]},
                     {"Out": [masked]}, {"axis": -1}))
         cur = masked
+    if attn_mask is not None:
+        # additive attention mask input (BERT padding mask): a bool mask
+        # would need a select — only the additive float form exports
+        mdt = str(ctx.var_info.get(attn_mask, (None, None))[1] or "")
+        if mdt == "bool":
+            raise _UnmappedOp("flash_attention with boolean mask export")
+        qdt = str(ctx.var_info.get(op.inputs[0],
+                                   (None, None))[1] or "float32")
+        mask_in = attn_mask
+        if mdt and mdt != qdt:
+            # reference elementwise_add rejects mismatched X/Y dtypes
+            cast_name = out + ".amcast"
+            ops.append(("cast", {"X": [attn_mask]}, {"Out": [cast_name]},
+                        {"in_dtype": _np_enum(mdt),
+                         "out_dtype": _np_enum(qdt)}))
+            mask_in = cast_name
+        am = out + ".am"
+        ops.append(("elementwise_add", {"X": [cur], "Y": [mask_in]},
+                    {"Out": [am]}, {"axis": -1}))
+        cur = am
     sm = out + ".sm"
     ops.append(("softmax", {"X": [cur]}, {"Out": [sm]}, {"axis": -1}))
     if layout == "bshd":

@@ -783,3 +783,35 @@ class TestReferenceExport:
         p2, feeds, fetches = paddle.static.load_inference_model(out)
         (got,) = exe.run(p2, feed={feeds[0]: x}, fetch_list=fetches)
         np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-4)
+
+    def test_masked_bert_export_round_trip(self, tmp_path):
+        """BERT WITH an attention_mask feed: the padding-mask chain
+        (cast/unsqueeze/scale) and the in-attention additive mask all
+        export."""
+        from paddle_tpu.nlp import BertConfig, BertModel
+        paddle.static.reset_default_programs()
+        paddle.seed(0)
+        cfg = BertConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                         num_heads=2, max_seq_len=32,
+                         intermediate_size=64, dropout=0.0,
+                         attn_dropout=0.0)
+        net = BertModel(cfg)
+        net.eval()
+        with paddle.static.program_guard(paddle.static.Program()) as prog:
+            ids = paddle.static.data("ids", [1, 16], "int32")
+            am = paddle.static.data("attn_mask", [1, 16], "int32")
+            seq, pooled = net(ids, attention_mask=am)
+        norm = paddle.static.normalize_program(prog, [ids, am], [pooled])
+        exe = paddle.static.Executor()
+        r = np.random.RandomState(0)
+        x = r.randint(0, 128, (1, 16)).astype("i4")
+        m = np.ones((1, 16), "i4")
+        m[0, 10:] = 0
+        (want,) = exe.run(norm, feed={"ids": x, "attn_mask": m},
+                          fetch_list=norm._fetch_names)
+        out = os.path.join(str(tmp_path), "bert_mask")
+        paddle.static.save_reference_format(out, norm)
+        p2, feeds, fetches = paddle.static.load_inference_model(out)
+        (got,) = exe.run(p2, feed={feeds[0]: x, feeds[1]: m},
+                         fetch_list=fetches)
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-4)
