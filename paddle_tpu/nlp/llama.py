@@ -108,6 +108,50 @@ def apply_rope(x, cos, sin, pos_offset=0):
     return jnp.stack([y1, y2], axis=-1).reshape(b, h, s, d).astype(x.dtype)
 
 
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=8)
+def _rope_tensor_tables(seq_len, head_dim, theta):
+    """Tensor wrappers for the rope tables, cached so EVERY layer of a
+    captured model dedupes onto one shared const pair in the desc."""
+    from ..framework.tensor import Tensor
+    cos, sin = rope_tables(seq_len, head_dim, theta)
+    t_cos, t_sin = Tensor(cos), Tensor(sin)
+    t_cos.stop_gradient = True
+    t_sin.stop_gradient = True
+    return t_cos, t_sin
+
+
+def _llama_attention_raw(x, wqkv, cos, sin, num_heads=1, num_kv_heads=1,
+                         head_dim=1):
+    """Registered (desc-serializable) GQA attention: fused qkv matmul,
+    RoPE from the cos/sin table inputs, kv-head repeat, causal flash.
+    The rope tables ride as const inputs so captured LLaMA programs
+    replay in fresh processes."""
+    nh, nkv, hd = num_heads, num_kv_heads, head_dim
+    cos = jax.lax.stop_gradient(cos)
+    sin = jax.lax.stop_gradient(sin)
+    b, s, _ = x.shape
+    qkv = x @ wqkv                                   # [B,S,(nh+2kv)*hd]
+    q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+    q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if nkv != nh:                                    # GQA: repeat KV
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    from ..ops.pallas.flash_attention import _flash_array
+    o = _flash_array(q, k, v, causal=True)
+    return o.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+
+
+_register_op("llama_attention", _llama_attention_raw)
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -127,32 +171,22 @@ class LlamaAttention(nn.Layer):
                                         / math.sqrt(2 * cfg.num_layers))))
         self.qkv_proj.weight.sharding = P(None, mesh_mod.MP_AXIS)
         self.o_proj.weight.sharding = P(mesh_mod.MP_AXIS, None)
+        self._rope_args = (cfg.max_seq_len, self.head_dim,
+                           cfg.rope_theta)
         self._cos, self._sin = rope_tables(cfg.max_seq_len, self.head_dim,
                                            cfg.rope_theta)
 
     def forward(self, x):
         from ..ops.dispatch import apply
-        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
-
-        def f(x_, wqkv):
-            b, s, _ = x_.shape
-            qkv = x_ @ wqkv                              # [B,S,(nh+2kv)*hd]
-            q, k, v = jnp.split(
-                qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
-            q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
-            k = k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
-            v = v.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
-            q = apply_rope(q, self._cos, self._sin)
-            k = apply_rope(k, self._cos, self._sin)
-            if nkv != nh:                                 # GQA: repeat KV
-                rep = nh // nkv
-                k = jnp.repeat(k, rep, axis=1)
-                v = jnp.repeat(v, rep, axis=1)
-            from ..ops.pallas.flash_attention import _flash_array
-            o = _flash_array(q, k, v, causal=True)
-            return o.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
-
-        out = apply(f, (x, self.qkv_proj.weight), name="llama_attention")
+        t_cos, t_sin = _rope_tensor_tables(self._rope_args[0],
+                                           self._rope_args[1],
+                                           self._rope_args[2])
+        out = apply(_llama_attention_raw,
+                    (x, self.qkv_proj.weight, t_cos, t_sin),
+                    {"num_heads": self.num_heads,
+                     "num_kv_heads": self.num_kv_heads,
+                     "head_dim": self.head_dim},
+                    name="llama_attention")
         return self.o_proj(out)
 
     # -------------------------------------------------- incremental decode

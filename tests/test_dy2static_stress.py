@@ -516,3 +516,32 @@ class TestTransformerDescPortability:
         (b,) = exe.run(prog2, feed={"ids": x},
                        fetch_list=norm._fetch_names)
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_llama_program_serializes_and_replays(self):
+        """LLaMA (GQA + RoPE) captured programs serialize too: the
+        llama_attention op is registered with rope tables as const
+        inputs."""
+        import jax.numpy as jnp
+        from paddle_tpu.nlp.llama import LlamaConfig, LlamaForCausalLM
+        paddle.static.reset_default_programs()
+        paddle.seed(0)
+        cfg = LlamaConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=64, max_seq_len=32)
+        net = LlamaForCausalLM(cfg)
+        net.eval()
+        with paddle.static.program_guard(paddle.static.Program()) as prog:
+            ids = paddle.static.data("ids", [1, 16], "int32")
+            y = net(ids)
+        norm = paddle.static.normalize_program(prog, [ids], [y])
+        s = norm.serialize_to_string()
+        exe = paddle.static.Executor()
+        x = np.random.RandomState(0).randint(0, 128, (1, 16)).astype("i4")
+        (a,) = exe.run(norm, feed={"ids": x},
+                       fetch_list=norm._fetch_names)
+        prog2 = paddle.static.Program.parse_from_string(s)
+        for n, t in norm._persist.items():
+            prog2._persist[n]._data = jnp.copy(t._data)
+        (b,) = exe.run(prog2, feed={"ids": x},
+                       fetch_list=norm._fetch_names)
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)

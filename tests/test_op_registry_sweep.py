@@ -386,6 +386,13 @@ SPECS = {
     # serialize): x[1:3, None, ..., 0]
     "getitem": S([F32((4, 3, 2))],
                  {"spec": [["s", 1, 3, None], ["n"], ["e"], ["i", 0]]}),
+    # GQA attention with rope-table const inputs (nh=2, nkv=1, hd=8:
+    # qkv width (2+2*1)*8 = 32; cos/sin [S, hd/2])
+    "llama_attention": S([F32((1, 4, 16), 1, -0.5, 0.5),
+                          F32((16, 32), 2, -0.5, 0.5),
+                          F32((8, 4), 3), F32((8, 4), 4)],
+                         {"num_heads": 2, "num_kv_heads": 1,
+                          "head_dim": 8}, grad=False),
     "strided_slice": S([F32((4, 3))],
                        {"axes": [0], "starts": [0], "ends": [4],
                         "strides": [2]}),
