@@ -14,7 +14,8 @@ from .program import (Program, program_guard, default_main_program,
 from .backward import append_backward, grad_var_name
 from .paddle_pb import load_reference_checkpoint
 from .paddle_export import (save_reference_format,
-                            export_layer_reference_format)
+                            export_layer_reference_format,
+                            save_reference_checkpoint)
 from .io import (save_inference_model, load_inference_model,
                  serialize_program, deserialize_program,
                  serialize_persistables, deserialize_persistables,

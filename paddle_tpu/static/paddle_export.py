@@ -697,3 +697,16 @@ def export_layer_reference_format(layer, dirname, input_spec):
     finally:
         if was_training and hasattr(layer, "train"):
             layer.train()
+
+
+def save_reference_checkpoint(state_dict, dirname):
+    """Mirror of paddle_pb.load_reference_checkpoint: write a
+    {name: array/Tensor} state dict as the reference's save_params
+    layout (one LoDTensor stream file per variable; '/'-separated names
+    become subdirectories). A checkpoint written here loads with the
+    reference's load_vars — and with our own loader."""
+    os.makedirs(dirname, exist_ok=True)
+    for name, value in state_dict.items():
+        arr = np.asarray(getattr(value, "numpy", lambda: value)())
+        _write_lod_tensor(os.path.join(dirname, name), arr)
+    return dirname

@@ -815,3 +815,33 @@ class TestReferenceExport:
         (got,) = exe.run(p2, feed={feeds[0]: x, feeds[1]: m},
                          fetch_list=fetches)
         np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-4)
+
+
+class TestReferenceCheckpointSave:
+    def test_save_load_symmetry(self, tmp_path):
+        """save_reference_checkpoint -> load_reference_checkpoint is the
+        identity; nested names land in subdirs and come back."""
+        rng = np.random.RandomState(0)
+        sd = {"fc.w": rng.randn(4, 8).astype("f4"),
+              "block/ln.scale": rng.randn(8).astype("f4"),
+              "ids": rng.randint(0, 9, (5,)).astype("i8")}
+        d = os.path.join(str(tmp_path), "ckpt")
+        paddle.static.save_reference_checkpoint(sd, d)
+        back = paddle.static.load_reference_checkpoint(d)
+        assert set(back) == set(sd)
+        for k in sd:
+            np.testing.assert_array_equal(back[k], sd[k])
+            assert back[k].dtype == sd[k].dtype
+
+    def test_layer_state_dict_round_trip(self, tmp_path):
+        paddle.seed(3)
+        lin = paddle.nn.Linear(4, 8)
+        d = os.path.join(str(tmp_path), "ckpt")
+        paddle.static.save_reference_checkpoint(lin.state_dict(), d)
+        back = paddle.static.load_reference_checkpoint(d)
+        lin2 = paddle.nn.Linear(4, 8)
+        lin2.set_state_dict(back)
+        x = np.random.RandomState(1).randn(2, 4).astype("f4")
+        np.testing.assert_allclose(lin2(paddle.to_tensor(x)).numpy(),
+                                   lin(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-6)
