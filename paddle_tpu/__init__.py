@@ -36,7 +36,7 @@ from .ops.math import (  # noqa: F401
     sign, clip, isnan, isinf, isfinite, nan_to_num, sum, mean, prod, max, min,
     amax, amin, logsumexp, std, var, median, argmax, argmin, cumsum, cumprod,
     count_nonzero, matmul, mm, dot, bmm, inner, outer, addmm, kron, trace,
-    diagonal, topk, sort, argsort, unique, kthvalue, scale, increment,
+    diagonal, topk, sort, argsort, unique, kthvalue, mode, scale, increment,
     multiplex, atan2, sigmoid, lgamma, digamma, erfinv,
     lerp, heaviside, logit, logaddexp, xlogy, sinc, exp2, rad2deg, deg2rad,
     copysign, nextafter, gcd, lcm, diff, trapezoid, cummax, cummin,

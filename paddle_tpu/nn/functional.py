@@ -1741,37 +1741,29 @@ register_op("max_pool3d", functools.partial(_poolnd_raw, n=3, average=False))
 register_op("avg_pool3d", functools.partial(_poolnd_raw, n=3, average=True))
 
 
-def _reject_pool_extras(data_format, canonical, ceil_mode=False):
+def _reject_pool_extras(data_format, canonical):
     if data_format not in (None, canonical):
         raise NotImplementedError(
             f"pooling: only {canonical} layout supported, got {data_format}")
-    if ceil_mode:
-        raise NotImplementedError("pooling: ceil_mode=True unsupported")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
-    from ..ops.dispatch import OP_REGISTRY
-    _reject_pool_extras(data_format, "NCDHW", ceil_mode)
-    return apply(OP_REGISTRY["max_pool3d"], (x,),
-                 {"ksize": _stride_attr(kernel_size),
-                  "strides": None if stride is None else _stride_attr(stride),
-                  "padding": _pad_attr(padding)}, name="max_pool3d")
+    _reject_pool_extras(data_format, "NCDHW")
+    # NCDHW validated above = channels-first; _pool owns the attr build
+    return _pool(x, kernel_size, stride, padding, "NCHW", "max_pool3d",
+                 ceil_mode=ceil_mode)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                count_include_pad=True, divisor_override=None,
                data_format="NCDHW", name=None):
-    from ..ops.dispatch import OP_REGISTRY
-    _reject_pool_extras(data_format, "NCDHW", ceil_mode)
+    _reject_pool_extras(data_format, "NCDHW")
     if divisor_override is not None:
         raise NotImplementedError("avg_pool3d: divisor_override unsupported")
-    return apply(OP_REGISTRY["avg_pool3d"], (x,),
-                 {"ksize": _stride_attr(kernel_size),
-                  "strides": None if stride is None else _stride_attr(stride),
-                  "padding": _pad_attr(padding),
-                  "count_include_pad": bool(count_include_pad)},
-                 name="avg_pool3d")
+    return _pool(x, kernel_size, stride, padding, "NCHW", "avg_pool3d",
+                 ceil_mode=ceil_mode, count_include_pad=count_include_pad,
+                 average=True)
 
 
 def _adaptive_poolnd_raw(a, output_size=1, n=2, average=True):

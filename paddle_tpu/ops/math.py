@@ -511,10 +511,38 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
     return vals, idxs
 
 
+def _mode_raw(a, axis=-1, keepdim=False):
+    """ref operators/mode_op (torch-compatible tie rules: smallest modal
+    VALUE, LAST index of it along the axis). O(n^2) pairwise counting on
+    the mode axis — fine for the classification/postprocess sizes the
+    op serves; stays fully on-device."""
+    ax = axis % a.ndim
+    m = jnp.moveaxis(a, ax, -1)
+    eq = m[..., :, None] == m[..., None, :]
+    counts = eq.sum(-1)
+    modal = counts == counts.max(-1, keepdims=True)
+    big = jnp.max(m, axis=-1, keepdims=True)
+    mode_val = jnp.min(jnp.where(modal, m, big), axis=-1)
+    n = m.shape[-1]
+    pos = jnp.arange(n)
+    hit = m == mode_val[..., None]
+    idx = jnp.max(jnp.where(hit, pos, -1),
+                  axis=-1).astype(convert_dtype("int64"))
+    if keepdim:
+        mode_val = jnp.expand_dims(mode_val, ax)
+        idx = jnp.expand_dims(idx, ax)
+    return mode_val, idx
+
+
+register_op("mode", _mode_raw)
+
+
 def mode(x, axis=-1, keepdim=False, name=None):
-    a = np.asarray(as_array(x))
-    from scipy import stats  # pragma: no cover - optional
-    raise NotImplementedError("mode: not yet implemented")
+    vals, idxs = apply(_mode_raw, (x,),
+                       {"axis": int(axis), "keepdim": bool(keepdim)},
+                       name="mode")
+    idxs.stop_gradient = True
+    return vals, idxs
 
 
 def assign(x, output=None):

@@ -198,3 +198,37 @@ class TestPoolCeilMode:
         got_a = _np(F.avg_pool2d(paddle.to_tensor(x), 1, stride=2,
                                  ceil_mode=True, count_include_pad=False))
         assert np.all(np.isfinite(got_a))
+
+    def test_pool3d_ceil_mode(self):
+        import torch
+        import torch.nn.functional as TF
+        x = np.random.RandomState(5).randn(1, 1, 5, 5, 5).astype("f4")
+        want = TF.max_pool3d(torch.from_numpy(x), 2, stride=2,
+                             ceil_mode=True).numpy()
+        got = _np(F.max_pool3d(paddle.to_tensor(x), 2, stride=2,
+                               ceil_mode=True))
+        assert got.shape == want.shape == (1, 1, 3, 3, 3)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestModeOp:
+    def test_matches_torch(self):
+        import torch
+        x = np.array([[1, 2, 2, 3, 1, 1], [5, 5, 4, 4, 4, 6]], "i8")
+        tv, ti = torch.mode(torch.from_numpy(x), -1)
+        v, i = paddle.mode(paddle.to_tensor(x), axis=-1)
+        np.testing.assert_array_equal(_np(v), tv.numpy())
+        np.testing.assert_array_equal(_np(i), ti.numpy())
+
+    def test_float_and_axis(self):
+        import torch
+        x = np.random.RandomState(0).randint(0, 4, (3, 5, 4)).astype("f4")
+        tv, ti = torch.mode(torch.from_numpy(x), 1)
+        v, i = paddle.mode(paddle.to_tensor(x), axis=1)
+        np.testing.assert_array_equal(_np(v), tv.numpy())
+        np.testing.assert_array_equal(_np(i), ti.numpy())
+
+    def test_keepdim(self):
+        x = np.array([[1.0, 1.0, 2.0]], "f4")
+        v, i = paddle.mode(paddle.to_tensor(x), axis=-1, keepdim=True)
+        assert _np(v).shape == (1, 1) and _np(v)[0, 0] == 1.0

@@ -382,6 +382,8 @@ SPECS = {
     "roll": S([F32((2, 3))], {"shifts": 1, "axis": 0}),
     "rot90": S([F32((2, 3))], {"k": 1, "axes": [0, 1]}),
     "slice": S([F32((4, 3))], {"axes": [0], "starts": [1], "ends": [3]}),
+    "mode": S([np.array([[1.0, 2.0, 2.0, 3.0]], "f4")],
+              {"axis": -1}, grad=False),
     # basic-index getitem (registered so captured transformer programs
     # serialize): x[1:3, None, ..., 0]
     "getitem": S([F32((4, 3, 2))],
