@@ -80,9 +80,13 @@ while :; do
     run_step sweep_bert  2400 python scripts/bench_sweep.py bert 16   || { sleep 60; continue; }
     probe || continue
     run_step longctx     3600 python scripts/longctx_probe.py         || { sleep 60; continue; }
+    probe || continue
+    # on-chip OpTest sweep (ref op_test.py:1033 check_output_with_place);
+    # resumable via its own jsonl, so a timeout here still banks partials
+    run_step op_sweep    5400 python scripts/op_sweep_tpu.py          || { sleep 60; continue; }
     if python scripts/transcribe_capture.py \
         >> docs/perf/capture_transcribe.log 2>&1; then
-      note "BATTERY COMPLETE (results transcribed into PERF.md/LONGCTX.md)"
+      note "BATTERY COMPLETE ($(tail -1 docs/perf/capture_transcribe.log))"
     else
       note "BATTERY COMPLETE but transcription FAILED — see docs/perf/capture_transcribe.log"
     fi

@@ -58,11 +58,65 @@ def parse_logs():
     return rows, seq_rows, bench
 
 
+def transcribe_op_sweep():
+    """Render docs/perf/op_sweep_tpu.jsonl as the per-op pass/fail table
+    (docs/perf/OP_SWEEP_TPU.md) — the on-chip check_output_with_place
+    record. Returns number of ops transcribed."""
+    src = os.path.join(LOG, "op_sweep_tpu.jsonl")
+    if not os.path.exists(src):
+        return 0
+    recs = {}
+    with open(src) as f:
+        for line in f:
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if r.get("backend") not in (None, "cpu"):
+                recs[r["op"]] = r          # later lines win (retries)
+    if not recs:
+        return 0
+    stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+    counts = {}
+    for r in recs.values():
+        counts[r["verdict"]] = counts.get(r["verdict"], 0) + 1
+    out = [
+        "# On-chip op sweep (TPU place)",
+        "",
+        f"Transcribed {stamp} from op_sweep_tpu.jsonl — the registry",
+        "battery (eager finite-ness, AD-vs-FD grads, desc replay) run on",
+        "the real TPU backend; analog of ref op_test.py:1033",
+        "check_output_with_place on the device place.",
+        "",
+        "Summary: " + ", ".join(f"{v} {k}"
+                                for k, v in sorted(counts.items())),
+        "",
+        "| op | verdict | check | secs | detail |",
+        "|---|---|---|---|---|",
+    ]
+    def cell(v):
+        return str(v).replace("|", "\\|").replace("\n", " ")
+
+    for name in sorted(recs):
+        r = recs[name]
+        out.append(f"| {name} | {r['verdict']} | {r.get('check', '')} | "
+                   f"{r.get('secs', '')} | {cell(r.get('detail', ''))} |")
+    with open(os.path.join(LOG, "OP_SWEEP_TPU.md"), "w") as f:
+        f.write("\n".join(out) + "\n")
+    return len(recs)
+
+
 def main():
     rows, seq_rows, bench = parse_logs()
+    n_ops = transcribe_op_sweep()
+    if n_ops:
+        print(f"op sweep: {n_ops} per-op verdicts -> OP_SWEEP_TPU.md")
     if not (rows or seq_rows or bench):
-        print("no on-chip capture results found; nothing to transcribe")
-        return 1
+        # op-sweep-only is still a banked result, but say plainly that
+        # NO perf rows were written (the watchdog echoes this line)
+        print("op sweep only — NO sweep/bench rows for PERF.md/LONGCTX.md"
+              if n_ops else "no capture results; nothing transcribed")
+        return 0 if n_ops else 1
     stamp = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
 
     # ---- PERF.md: replace-or-append the measured section
