@@ -1,0 +1,50 @@
+"""The op-registry count has ONE source of truth: len(OP_REGISTRY) under
+a bare `import paddle_tpu`. Every op-registering module is imported by
+the base package (paddle_tpu/__init__.py tail), and the generated docs
+(OP_COVERAGE.md, README) must carry exactly that number — regenerate
+with scripts/op_coverage.py or this suite fails. Kills the 417/419/421
+drift the round-4 verdict flagged (different import sets used to yield
+different counts)."""
+import os
+import re
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.ops.dispatch import OP_REGISTRY
+
+# snapshot at collection time: tests may legitimately register CUSTOM ops
+# later (utils/cpp_extension), and those must not count against the docs
+BUILTIN_COUNT = len(OP_REGISTRY)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _doc_count(path):
+    text = open(path).read()
+    m = re.search(r"(\d+) registered serializable", text)
+    assert m, f"{path}: no 'NNN registered serializable' claim found"
+    return int(m.group(1))
+
+
+def test_base_import_registers_everything():
+    """Optional-module imports must add NOTHING to the registry."""
+    before = len(OP_REGISTRY)
+    import paddle_tpu.nlp.llama            # noqa: F401
+    import paddle_tpu.static.quant_pass    # noqa: F401
+    import paddle_tpu.vision.ops           # noqa: F401
+    import paddle_tpu.fluid.layers         # noqa: F401
+    import paddle_tpu.ops.legacy           # noqa: F401
+    import paddle_tpu.text                 # noqa: F401
+    import paddle_tpu.rec                  # noqa: F401
+    import paddle_tpu.nn.decode            # noqa: F401
+    import paddle_tpu.ops.sequence         # noqa: F401
+    assert len(OP_REGISTRY) == before, (
+        "op-registering module not imported by base paddle_tpu: "
+        f"{len(OP_REGISTRY) - before} ops appeared after optional imports")
+
+
+def test_docs_match_live_registry():
+    for doc in ("docs/OP_COVERAGE.md", "README.md"):
+        got = _doc_count(os.path.join(REPO, doc))
+        assert got == BUILTIN_COUNT, (
+            f"{doc} claims {got} ops, built-in registry has "
+            f"{BUILTIN_COUNT} — run scripts/op_coverage.py to regenerate")
