@@ -110,6 +110,27 @@ def _is_internal_placeholder(name):
     return bool(name) and name.startswith("__pt_ret")
 
 
+def _statics_equal(a, b):
+    """Branch-agreement check for static values (strings, numbers,
+    tuples, lists — possibly holding numpy arrays, whose elementwise ==
+    would make bool() ambiguous)."""
+    if a is b:
+        return True
+    import numpy as np
+    if isinstance(a, (list, tuple)):
+        return (type(a) is type(b) and len(a) == len(b)
+                and all(_statics_equal(x, y) for x, y in zip(a, b)))
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        try:
+            return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        except (ValueError, TypeError):
+            return False
+    try:
+        return bool(a == b)
+    except (ValueError, TypeError):
+        return False            # object compare failed: treat as unequal
+
+
 def convert_ifelse(pred, true_fn, false_fn, get, reset, names=None):
     """Emitted for `if`: concrete pred runs one branch in place; traced pred
     lowers to lax.cond. Branch outputs are discovered during tracing: each
@@ -144,6 +165,33 @@ def convert_ifelse(pred, true_fn, false_fn, get, reset, names=None):
             for i, v in enumerate(out):
                 u = _unwrap(v)
                 assigned = v is not orig[i]
+                # list vars (ref list_transformer.py): carried through
+                # cond element-wise ONLY for tensor content; scalar
+                # lists (int shape/perm lists, even when assigned) stay
+                # python statics — carrying them would tracer-ify values
+                # downstream static-shape consumers need concrete.
+                # Cross-branch disagreement of static lists is checked
+                # after the cond instead.
+                if _jaxable_list(u) and any(
+                        isinstance(_unwrap(e),
+                                   (jax.Array, jax.core.Tracer))
+                        for e in u):
+                    elems = [jnp.asarray(_unwrap(e)) for e in u]
+                    spec.append(("list",
+                                 tuple(jax.typeof(e) for e in elems),
+                                 assigned,
+                                 tuple(isinstance(e, Tensor)
+                                       for e in u)))
+                    leaves.extend(elems)
+                    continue
+                if isinstance(u, _TensorArrayCarry):
+                    # tensor-array carry rides cond as (buf, length);
+                    # the version count records this branch's appends
+                    spec.append(("ta", u.version, assigned,
+                                 (u.wrap, u.exact)))
+                    leaves.extend([u.buf,
+                                   jnp.asarray(u.length, jnp.int32)])
+                    continue
                 dyn = isinstance(u, (jax.Array, jax.core.Tracer)) or \
                     isinstance(u, (int, float, bool)) and \
                     not isinstance(v, _Undef)
@@ -179,6 +227,32 @@ def convert_ifelse(pred, true_fn, false_fn, get, reset, names=None):
             for i, (st, sf) in enumerate(zip(*both)):
                 if st[0] == sf[0] == "dyn" and st[1] == sf[1]:
                     continue
+                if st[0] == sf[0] == "ta":
+                    continue                # same structure by origin
+                if st[0] == "ta" or sf[0] == "ta":
+                    raise ValueError(
+                        "dy2static: a list that grew inside the "
+                        "enclosing loop is rebound inconsistently "
+                        "across branches of a traced `if`") from e
+                if st[0] == "list" or sf[0] == "list":
+                    if st[0] == sf[0] == "list":
+                        if st[1] == sf[1]:
+                            continue        # identical: not the cause
+                        nm = names[i] if names and i < len(names) \
+                            else "a list"
+                        raise ValueError(
+                            f"dy2static: list {nm!r} has "
+                            f"{len(st[1])} element(s) of "
+                            f"{[str(a) for a in st[1]]} in the true "
+                            f"branch but {len(sf[1])} of "
+                            f"{[str(a) for a in sf[1]]} in the false "
+                            "branch of a traced `if` — XLA needs one "
+                            "structure; append consistently in both "
+                            "branches") from e
+                    raise ValueError(
+                        "dy2static: a variable is a list in one branch "
+                        "of a traced `if` but not the other — assign it "
+                        "consistently in both branches") from e
                 mismatch = True
                 nm = names[i] if names and i < len(names) else None
                 if not _is_internal_placeholder(nm):
@@ -216,30 +290,74 @@ def convert_ifelse(pred, true_fn, false_fn, get, reset, names=None):
         else:
             raise
     spec_t, spec_f = specs["true"], specs["false"]
-    for st, sf in zip(spec_t, spec_f):
+    for i, (st, sf) in enumerate(zip(spec_t, spec_f)):
+        if st[0] != sf[0] and {"list", "ta"} & {st[0], sf[0]}:
+            raise ValueError(
+                "dy2static: a variable is a list in one branch of a "
+                "traced `if` but not the other — assign it consistently "
+                "in both branches")
         if (st[0] == "dyn") != (sf[0] == "dyn"):
             raise ValueError(
                 "dy2static: a variable is a tensor in one branch of a "
                 "traced `if` but not the other — assign it consistently "
                 "in both branches")
+        nm = names[i] if names and i < len(names) else None
+        if (st[0] == sf[0] == "static" and (st[2] or sf[2])
+                # only USER constants: the cluster machinery assigns its
+                # generated nested defs (__pt_*) branch-locally, and a
+                # one-sided assignment (other side undefined) keeps the
+                # longstanding closure semantics
+                and not (nm or "__pt_").startswith("__pt_")
+                and not (callable(st[1]) or callable(sf[1]))
+                and not isinstance(st[1], _Undef)
+                and not isinstance(sf[1], _Undef)
+                and not _statics_equal(st[1], sf[1])):
+            raise ValueError(
+                f"dy2static: {nm!r} is assigned different python "
+                f"values across branches of a traced `if` "
+                f"({st[1]!r} vs {sf[1]!r}) — a python constant cannot "
+                "be selected at runtime; use tensors, or assign the "
+                "same value in both branches")
     final, j = [], 0
     for i, s in enumerate(spec_t):
         if s[0] == "dyn":
             final.append(Tensor(res[j]) if isinstance(orig[i], Tensor)
                          or isinstance(orig[i], _Undef) else res[j])
             j += 1
+        elif s[0] == "list":
+            k = len(s[1])
+            # wrap a slot as Tensor if EITHER branch held a Tensor there
+            # (the structural check compares avals, not wrappers)
+            wf = spec_f[i][3] if spec_f[i][0] == "list" else s[3]
+            final.append([Tensor(leaf) if (w or w2) else leaf
+                          for leaf, w, w2 in zip(res[j:j + k], s[3], wf)])
+            j += k
+        elif s[0] == "ta":
+            sf = spec_f[i]
+            # uneven branch growth -> the traced length diverges from
+            # the append count: the final length is data-dependent, so
+            # exact finalization is off (honest-limit error on stack())
+            even = s[1] == sf[1]
+            wrap, exact = s[3]
+            final.append(_TensorArrayCarry(
+                res[j], res[j + 1], wrap,
+                exact and even and sf[3][1],
+                max(s[1], sf[1])))
+            j += 2
         else:
             final.append(s[1])
     reset(tuple(final))
     return tuple(final)
 
 
-def convert_while(cond_fn, body_fn, get, reset, names=None):
+def convert_while(cond_fn, body_fn, get, reset, names=None, bound=None):
     """Emitted for `while`: concrete → python loop; traced condition or
     loop vars → lax.while_loop over the dynamic subset of captured vars
     (static vars are loop-invariant closure constants). `names` is the
     captured-variable name tuple (diagnostics + the generated-local
-    exemption below).
+    exemption below). `bound` (for->while lowerings only) is a thunk
+    returning the CURRENT (i, stop, step) — the static trip bound that
+    caps tensor-array list carries.
 
     The python loop re-checks tracedness EVERY iteration and escapes to the
     lax path mid-loop from the current state: a loop can start fully
@@ -249,10 +367,180 @@ def convert_while(cond_fn, body_fn, get, reset, names=None):
         c = _unwrap(cond_fn())
         cur = get() if get is not None else ()
         if _is_traced(c) or _any_traced(cur):
-            return _lax_while(cond_fn, body_fn, get, reset, cur, names)
+            return _lax_while_lists(cond_fn, body_fn, get, reset, cur,
+                                    names, bound)
         if not bool(c):
             return cur
         body_fn()
+
+
+def _nm(names, i):
+    return names[i] if names and i < len(names) else f"var{i}"
+
+
+def _remaining_trips(bound):
+    """Static iteration cap of a lowered for-range loop, from the CURRENT
+    loop state; None when any of (i, stop, step) is traced."""
+    if bound is None:
+        return None
+    import math
+    cur, stop, step = (_unwrap(v) for v in bound())
+    if any(_is_traced(v) for v in (cur, stop, step)):
+        return None
+    return max(0, math.ceil((stop - cur) / step))
+
+
+def _lax_while_lists(cond_fn, body_fn, get, reset, orig, names, bound=None):
+    """List-carry adapter over _lax_while (ref list_transformer.py's
+    tensor-array writes): each jaxable list var expands to per-element
+    carry slots; a list that grows raises _ListGrew during the first
+    trace and retries with a fixed-capacity _TensorArrayCarry, capacity =
+    current length + the loop's remaining static trips."""
+    list_idx = [i for i, v in enumerate(orig)
+                if _jaxable_list(v) or isinstance(v, _TensorArrayCarry)]
+    if not list_idx:
+        return _lax_while(cond_fn, body_fn, get, reset, orig, names)
+
+    # var index -> ("elems", length, wrap_flags) | ("ta", wrap, exact)
+    mode = {}
+    for i in list_idx:
+        v = orig[i]
+        if isinstance(v, _TensorArrayCarry):      # nested lowered loop
+            mode[i] = ("ta", v.wrap, v.exact)
+        else:
+            mode[i] = ("elems", len(v), tuple(isinstance(e, Tensor)
+                                              for e in v))
+
+    def expand(vals):
+        out, nm = [], []
+        for i, v in enumerate(vals):
+            if i not in mode:
+                out.append(v)
+                nm.append(_nm(names, i))
+                continue
+            m = mode[i]
+            if m[0] == "elems":
+                if not isinstance(v, list):
+                    raise ValueError(
+                        f"dy2static: list {_nm(names, i)!r} was rebound "
+                        "to a non-list inside a traced loop")
+                if len(v) != m[1]:
+                    u = jnp.asarray(_unwrap(v[-1])) if v else None
+                    raise _ListGrew(
+                        i, len(v),
+                        tuple(u.shape) if u is not None else None,
+                        str(u.dtype) if u is not None else None,
+                        bool(v and isinstance(v[-1], Tensor)))
+                out.extend(v)
+                nm.extend(f"{_nm(names, i)}[{k}]" for k in range(m[1]))
+            else:
+                if not isinstance(v, _TensorArrayCarry):
+                    raise ValueError(
+                        f"dy2static: list {_nm(names, i)!r} was "
+                        "reassigned inside a traced loop after growing — "
+                        "build it in one place")
+                if not v.exact and m[2]:
+                    # a traced `if` appended unevenly: final length is
+                    # data-dependent; sticky for the rest of the loop
+                    mode[i] = m = ("ta", m[1], False)
+                out.extend([v.buf, jnp.asarray(v.length, jnp.int32)])
+                nm.extend([f"{_nm(names, i)}.buf",
+                           f"{_nm(names, i)}.len"])
+        return tuple(out), tuple(nm)
+
+    def collapse(vals):
+        out, j = [], 0
+        for i in range(len(orig)):
+            if i not in mode:
+                out.append(vals[j])
+                j += 1
+                continue
+            m = mode[i]
+            if m[0] == "elems":
+                elems = vals[j:j + m[1]]
+                j += m[1]
+                out.append([Tensor(_unwrap(e))
+                            if w and not isinstance(e, Tensor) else e
+                            for e, w in zip(elems, m[2])])
+            else:
+                buf, ln = vals[j], vals[j + 1]
+                j += 2
+                out.append(_TensorArrayCarry(jnp.asarray(_unwrap(buf)),
+                                             _unwrap(ln), m[1], m[2]))
+        return tuple(out)
+
+    def get2():
+        return expand(get())[0]
+
+    def reset2(vals):
+        reset(collapse(vals))
+
+    # early-exit/skip flags make the FINAL length a traced value; without
+    # them every remaining trip appends, so final length == capacity and
+    # the carry finalizes back to a plain python list
+    exact = not any(
+        n and n.startswith(("__pt_brk", "__pt_cont", "__pt_ret"))
+        for n in (names or ()))
+
+    # read the trip bound NOW: an abandoned trace leaves dead tracers in
+    # the loop-state temporaries the bound thunk reads
+    trips = _remaining_trips(bound)
+
+    while True:
+        orig2, names2 = expand(orig)
+        try:
+            res2 = _lax_while(cond_fn, body_fn, get2, reset2, orig2,
+                              names2)
+        except _ListGrew as g:
+            if trips is None:
+                raise ValueError(
+                    f"dy2static: list {_nm(names, g.idx)!r} grows inside "
+                    "a traced loop with no static trip bound — XLA needs "
+                    "a fixed capacity. Use `for i in range(...)` with "
+                    "concrete bounds, or preallocate with paddle.zeros "
+                    "and index-write (ref list_transformer.py lowers "
+                    "this to LoDTensorArray, which is host-dynamic; a "
+                    "TPU loop carry cannot be)") from None
+            entry = orig[g.idx]
+            # growth detected at body END: new_len - entry counts the
+            # appends of ONE iteration (k > 1 when the body appends
+            # several times; uneven cond-appends already error in the
+            # list-spec check), so capacity = entry + k per remaining trip
+            per_iter = max(1, g.new_len - len(entry))
+            cap = len(entry) + trips * per_iter
+            if g.elem_shape is None:
+                raise ValueError(
+                    f"dy2static: cannot infer element shape for list "
+                    f"{_nm(names, g.idx)!r} (grew from empty with no "
+                    "appended element visible)") from None
+            buf = jnp.zeros((cap,) + g.elem_shape, g.elem_dtype)
+            for k, e in enumerate(entry):
+                buf = buf.at[k].set(jnp.asarray(_unwrap(e))
+                                    .astype(buf.dtype))
+            ta = _TensorArrayCarry(buf, len(entry), g.wrap, exact)
+            mode[g.idx] = ("ta", g.wrap, exact)
+            lst = list(orig)
+            lst[g.idx] = ta
+            orig = tuple(lst)
+            reset(orig)
+            continue
+        break
+
+    # finalize: exact tensor-array carries become plain python lists of
+    # their capacity elements — downstream stack/concat/len/indexing all
+    # behave like the reference's tensor_array_to_tensor results
+    final = list(collapse(res2))
+    changed = False
+    for i, m in mode.items():
+        v = final[i]
+        if isinstance(v, _TensorArrayCarry) and v.exact:
+            final[i] = [Tensor(v.buf[k]) if v.wrap else v.buf[k]
+                        for k in range(v.capacity)]
+            changed = True
+    final = tuple(final)
+    if changed:
+        reset(final)
+    return final
 
 
 def _lax_while(cond_fn, body_fn, get, reset, orig, names=None):
@@ -403,6 +691,249 @@ def check_step(step):
     if not _is_traced(u) and int(u) == 0:
         raise ValueError("range() arg 3 must not be zero")
     return step
+
+
+# --------------------------------------------------------------------------- #
+# list lowering (ref dygraph_to_static/list_transformer.py +                  #
+# loop_transformer.py tensor-array paths, redesigned for XLA semantics):      #
+# `x.append(v)` is rewritten to `x = _jst.convert_list_append(x, v)` so list  #
+# mutation is a name-store the branch/loop capture machinery carries.         #
+# Fixed-length lists ride lax carries element-wise; a list that GROWS inside  #
+# a traced loop becomes a _TensorArrayCarry — a preallocated [capacity, ...]  #
+# HBM buffer + running length (XLA has no dynamic allocation; the capacity    #
+# comes from the loop's static trip bound). The reference's LoDTensorArray    #
+# is host-side dynamic, so its writes are unbounded; the static-capacity      #
+# contract is the honest TPU equivalent.                                      #
+# --------------------------------------------------------------------------- #
+
+
+def _jaxable_elem(e):
+    u = _unwrap(e)
+    return isinstance(u, (jax.Array, jax.core.Tracer,
+                          int, float, bool, complex))
+
+
+def _jaxable_list(v):
+    return isinstance(v, list) and all(_jaxable_elem(e) for e in v)
+
+
+class _ListGrew(Exception):
+    """A list var changed length inside a traced loop body: retry the
+    loop with a tensor-array carry (shape/dtype captured at raise time —
+    the element tracers die with the abandoned trace)."""
+
+    def __init__(self, idx, new_len, elem_shape, elem_dtype, wrap):
+        super().__init__(idx)
+        self.idx = idx
+        self.new_len = new_len
+        self.elem_shape = elem_shape
+        self.elem_dtype = elem_dtype
+        self.wrap = wrap
+
+
+class _TensorArrayCarry:
+    """A list growing inside a traced loop: [capacity, *elem] buffer +
+    running length, written via dynamic_update_slice. `exact` marks loops
+    with no early-exit/skip flags, where the final length provably equals
+    the capacity and the value finalizes back to a plain python list."""
+
+    def __init__(self, buf, length, wrap, exact, version=0):
+        self.buf = buf
+        self.length = length
+        self.wrap = wrap
+        self.exact = exact
+        # python-side append count since the last carry rebuild: lets
+        # convert_ifelse compare branch growth STATICALLY (the traced
+        # lengths are opaque) and demote `exact` on uneven appends
+        self.version = version
+
+    @property
+    def capacity(self):
+        return self.buf.shape[0]
+
+    def append(self, v):
+        u = jnp.asarray(_unwrap(v))
+        if tuple(u.shape) != tuple(self.buf.shape[1:]):
+            raise ValueError(
+                "dy2static: appended element shape "
+                f"{tuple(u.shape)} != earlier elements' "
+                f"{tuple(self.buf.shape[1:])} — a list lowered to a "
+                "tensor-array needs uniform elements")
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            self.buf, u.astype(self.buf.dtype)[None],
+            jnp.asarray(self.length, jnp.int32), axis=0)
+        return _TensorArrayCarry(buf, self.length + 1, self.wrap,
+                                 self.exact, self.version + 1)
+
+    def __getitem__(self, i):
+        ix = jnp.asarray(_unwrap(i), jnp.int32)
+        # negative indices count from the RUNNING length, not the
+        # preallocated capacity (x[-1] must be the last APPENDED value)
+        ix = jnp.where(ix < 0, ix + jnp.asarray(self.length, jnp.int32),
+                       ix)
+        v = self.buf[ix]
+        return Tensor(v) if self.wrap else v
+
+    def _no_static_len(self, *a, **k):
+        raise ValueError(
+            "dy2static: this list grew inside a traced loop with "
+            "break/continue/return, so its final length is a traced "
+            "value; index it with x[i] (traced index ok) or read "
+            "_jst.convert_len(x), but it cannot become a python list — "
+            "restructure without early exit, or preallocate with "
+            "paddle.zeros and index-write")
+
+    __len__ = __iter__ = _no_static_len
+
+
+def convert_list_append(xs, v):
+    """`x.append(v)` -> `x = convert_list_append(x, v)`. Returns a NEW
+    list (value semantics: branch purity and carry snapshots need the
+    pre-append value intact) or a tensor-array write inside traced
+    loops."""
+    if isinstance(xs, _TensorArrayCarry):
+        return xs.append(v)
+    if isinstance(xs, list):
+        return xs + [v]
+    xs.append(v)          # TensorArray static API / user object
+    return xs
+
+
+def convert_list_pop(xs, idx=-1):
+    """`v = x.pop(i)` -> `x, v = convert_list_pop(x, i)`."""
+    if isinstance(xs, _TensorArrayCarry):
+        raise ValueError(
+            "dy2static: pop() on a list that grew inside a traced loop "
+            "is not representable in XLA — restructure without pop")
+    i = _unwrap(idx)
+    if isinstance(xs, list):
+        if _is_traced(i):
+            raise ValueError(
+                "dy2static: list.pop(i) with a tensor index — use a "
+                "concrete index, or tensor indexing on a stacked tensor")
+        new = list(xs)
+        return new, new.pop(int(i))
+    return xs, xs.pop(i)
+
+
+def convert_list_pop_(xs, idx=-1):
+    """Statement-position pop: value discarded."""
+    return convert_list_pop(xs, idx)[0]
+
+
+def convert_list_setitem(xs, idx, v):
+    """`x[i] = v` -> `x = convert_list_setitem(x, i, v)`. A traced index
+    into a real list selects element-wise (the list stays a python list
+    of uniform tensors)."""
+    if not isinstance(xs, list):
+        xs[idx] = v       # Tensor / dict / user object: native setitem
+        return xs
+    i = _unwrap(idx)
+    if _is_traced(i):
+        if not xs or not all(_jaxable_elem(e) for e in xs):
+            raise ValueError(
+                "dy2static: tensor-index write needs a non-empty list "
+                "of tensors")
+        # python negative-index semantics (the matching load path's
+        # stack[i] gather already wraps; the equal() sweep must agree)
+        i = jnp.where(i < 0, i + len(xs), i)
+        u = jnp.asarray(_unwrap(v))
+        out = []
+        for k, e in enumerate(xs):
+            old = jnp.asarray(_unwrap(e))
+            new = jnp.where(jnp.equal(i, k), u.astype(old.dtype), old)
+            out.append(Tensor(new) if isinstance(e, Tensor) else new)
+        return out
+    new = list(xs)
+    new[int(i) if not isinstance(i, int) else i] = v
+    return new
+
+
+def convert_list_getitem(xs, idx):
+    """Load-position `x[i]` for known-list names: traced index gathers
+    from the stacked elements."""
+    if isinstance(xs, _TensorArrayCarry):
+        return xs[idx]
+    i = _unwrap(idx)
+    if isinstance(xs, list) and _is_traced(i):
+        if not xs or not all(_jaxable_elem(e) for e in xs):
+            raise ValueError(
+                "dy2static: tensor index into a non-tensor list")
+        stack = jnp.stack([jnp.asarray(_unwrap(e)) for e in xs])
+        v = stack[jnp.asarray(i, jnp.int32)]
+        return Tensor(v) if isinstance(xs[0], Tensor) else v
+    if isinstance(xs, list) and isinstance(i, jax.Array):
+        i = int(i)
+    return xs[i if isinstance(xs, list) else idx]
+
+
+def convert_list_insert(xs, idx, v):
+    """`x.insert(i, v)` -> `x = convert_list_insert(x, i, v)`."""
+    if isinstance(xs, _TensorArrayCarry):
+        raise ValueError(
+            "dy2static: insert() on a list that grew inside a traced "
+            "loop is not representable in XLA (it shifts the written "
+            "slots) — append in order instead")
+    i = _unwrap(idx)
+    if isinstance(xs, list):
+        if _is_traced(i):
+            raise ValueError(
+                "dy2static: list.insert with a tensor index — use a "
+                "concrete index")
+        new = list(xs)
+        new.insert(int(i), v)
+        return new
+    xs.insert(i, v)
+    return xs
+
+
+def convert_list_extend(xs, other):
+    """`x.extend(o)` -> `x = convert_list_extend(x, o)`."""
+    if isinstance(xs, _TensorArrayCarry):
+        out = xs
+        for e in list(other):
+            out = out.append(e)
+        return out
+    if isinstance(xs, list):
+        return xs + list(other)
+    xs.extend(other)
+    return xs
+
+
+def convert_list_clear(xs):
+    """`x.clear()` -> `x = convert_list_clear(x)`."""
+    if isinstance(xs, _TensorArrayCarry):
+        raise ValueError(
+            "dy2static: clear() on a list that grew inside a traced "
+            "loop — an XLA loop carry needs a fixed structure")
+    if isinstance(xs, list):
+        return []
+    xs.clear()
+    return xs
+
+
+def convert_len(x):
+    """len() in converted code (ref convert_call len -> array_length):
+    python len for containers, static leading dim for tensors, the
+    running (possibly traced) length for tensor-array carries."""
+    if isinstance(x, _TensorArrayCarry):
+        return Tensor(jnp.asarray(x.length)) if x.wrap else x.length
+    u = _unwrap(x)
+    if isinstance(u, (jax.Array, jax.core.Tracer)):
+        if u.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return u.shape[0]
+    return len(x)
+
+
+def is_tensor_seq(x):
+    u = _unwrap(x)
+    return isinstance(u, (jax.Array, jax.core.Tracer)) \
+        and getattr(u, "ndim", 0) >= 1
+
+
+def seq_len(x):
+    return int(_unwrap(x).shape[0])
 
 
 def convert_print(*args, **kwargs):
@@ -904,9 +1435,204 @@ def _empty_args():
                          defaults=[])
 
 
-class _ControlFlowTransformer(ast.NodeTransformer):
+class _ListCollector(ast.NodeVisitor):
+    """Names ever bound to a list display / comprehension / list() call
+    in this function body (ref list_transformer.py's created-list
+    tracking) — only these names get the method-call rewrites, so
+    `.append`/`.pop` on arbitrary objects keeps native semantics."""
+
     def __init__(self):
+        self.names = set()
+
+    @staticmethod
+    def _is_list_value(v):
+        return isinstance(v, (ast.List, ast.ListComp)) or (
+            isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+            and v.func.id == "list")
+
+    def visit_Assign(self, node):
+        if self._is_list_value(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.names.add(t.id)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        # `a: list = []` creates a list just like a plain assign
+        if node.value is not None and self._is_list_value(node.value) \
+                and isinstance(node.target, ast.Name):
+            self.names.add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass                     # nested defs own their names
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _reloc_stmt(src, node):
+    """Parse one synthetic statement and stamp it with `node`'s source
+    location (the runtime error map keeps pointing at user lines)."""
+    out = ast.parse(src).body[0]
+    for sub in ast.walk(out):
+        ast.copy_location(sub, node)
+    return out
+
+
+class _ListTransformer(ast.NodeTransformer):
+    """ref dygraph_to_static/list_transformer.py: list mutation becomes
+    name-stores (`x = _jst.convert_list_append(x, v)` …) so the
+    branch/loop capture machinery carries the list like any other
+    variable; loads `x[i]` route through convert_list_getitem so a
+    traced index gathers from the stacked elements."""
+
+    def __init__(self, names):
+        self.names = names
+
+    def _is_list_name(self, nd):
+        return isinstance(nd, ast.Name) and nd.id in self.names
+
+    _stmt = staticmethod(_reloc_stmt)
+
+    def visit_Expr(self, node):
+        self.generic_visit(node)
+        v = node.value
+        if not (isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and self._is_list_name(v.func.value) and not v.keywords):
+            return node
+        x = v.func.value.id
+        args = [ast.unparse(a) for a in v.args]
+        if v.func.attr == "append" and len(args) == 1:
+            return self._stmt(
+                f"{x} = _jst.convert_list_append({x}, {args[0]})", node)
+        if v.func.attr == "pop" and len(args) <= 1:
+            a = f", {args[0]}" if args else ""
+            return self._stmt(
+                f"{x} = _jst.convert_list_pop_({x}{a})", node)
+        if v.func.attr == "insert" and len(args) == 2:
+            return self._stmt(
+                f"{x} = _jst.convert_list_insert({x}, {args[0]}, "
+                f"{args[1]})", node)
+        if v.func.attr == "extend" and len(args) == 1:
+            return self._stmt(
+                f"{x} = _jst.convert_list_extend({x}, {args[0]})", node)
+        if v.func.attr == "clear" and not args:
+            return self._stmt(
+                f"{x} = _jst.convert_list_clear({x})", node)
+        return node
+
+    def visit_AugAssign(self, node):
+        # x[i] op= v  ->  x = setitem(x, i, getitem(x, i) op v)
+        self.generic_visit(node)
+        t = node.target
+        if not (isinstance(t, ast.Subscript) and self._is_list_name(t.value)
+                and not isinstance(t.slice, ast.Slice)):
+            return node
+        ops = {ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.Div: "/",
+               ast.FloorDiv: "//", ast.Mod: "%", ast.Pow: "**",
+               ast.MatMult: "@"}
+        op = ops.get(type(node.op))
+        if op is None:
+            return node
+        x, idx = t.value.id, ast.unparse(t.slice)
+        return self._stmt(
+            f"{x} = _jst.convert_list_setitem({x}, {idx}, "
+            f"_jst.convert_list_getitem({x}, {idx}) {op} "
+            f"({ast.unparse(node.value)}))", node)
+
+    def visit_Delete(self, node):
+        # del x[i] -> x = convert_list_pop_(x, i)
+        self.generic_visit(node)
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Subscript)
+                and self._is_list_name(node.targets[0].value)
+                and not isinstance(node.targets[0].slice, ast.Slice)):
+            t = node.targets[0]
+            return self._stmt(
+                f"{t.value.id} = _jst.convert_list_pop_({t.value.id}, "
+                f"{ast.unparse(t.slice)})", node)
+        return node
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        v = node.value
+        # v = x.pop(...)
+        if (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "pop"
+                and self._is_list_name(v.func.value) and not v.keywords
+                and len(v.args) <= 1 and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            x = v.func.value.id
+            a = f", {ast.unparse(v.args[0])}" if v.args else ""
+            return self._stmt(
+                f"({x}, {node.targets[0].id}) = "
+                f"_jst.convert_list_pop({x}{a})", node)
+        # x[i] = v
+        t = node.targets[0] if len(node.targets) == 1 else None
+        if (isinstance(t, ast.Subscript) and self._is_list_name(t.value)
+                and not isinstance(t.slice, ast.Slice)):
+            x = t.value.id
+            return self._stmt(
+                f"{x} = _jst.convert_list_setitem({x}, "
+                f"{ast.unparse(t.slice)}, {ast.unparse(v)})", node)
+        return node
+
+    def visit_Subscript(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.ctx, ast.Load)
+                and self._is_list_name(node.value)
+                and not isinstance(node.slice, ast.Slice)):
+            new = ast.parse(
+                f"_jst.convert_list_getitem({node.value.id}, "
+                f"{ast.unparse(node.slice)})", mode="eval").body
+            for sub in ast.walk(new):
+                ast.copy_location(sub, node)
+            return new
+        return node
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+
+_PY_ITER_CALLS = {"enumerate", "zip", "list", "tuple", "set", "sorted",
+                  "reversed", "dict", "map", "filter"}
+
+
+def _obviously_python_iter(nd, list_names=()):
+    """Iterables that can never be tensors: skip the tensor-for dispatch
+    (its body duplication and cluster overhead buy nothing there)."""
+    if isinstance(nd, (ast.List, ast.Tuple, ast.Set, ast.Dict,
+                       ast.ListComp, ast.GeneratorExp, ast.DictComp,
+                       ast.SetComp)):
+        return True
+    if isinstance(nd, ast.Constant):
+        return True
+    if isinstance(nd, ast.Name) and nd.id in list_names:
+        return True
+    if isinstance(nd, ast.Call):
+        f = nd.func
+        if isinstance(f, ast.Name) and f.id in _PY_ITER_CALLS:
+            return True
+        if isinstance(f, ast.Attribute) and f.attr in (
+                "items", "keys", "values", "split", "splitlines"):
+            return True
+    return False
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self, list_names=()):
         self.counter = 0
+        self.list_names = frozenset(list_names)
+        self._iter_dispatches = 0
 
     def visit_FunctionDef(self, node):
         return node  # don't transform nested defs
@@ -991,18 +1717,32 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_For(self, node):
         """`for i in range(...)` lowers to the while machinery (ref
-        dygraph_to_static loop_transformer's for->while rewrite); other
-        iterables (lists, enumerate, tensors) stay python — range is the
-        only form whose bound can be a traced Tensor."""
+        dygraph_to_static loop_transformer's for->while rewrite).
+        `for t in <expr>` over a TENSOR lowers to an index loop over the
+        static leading dim (ref loop_transformer's for-iter rewrite) via
+        a runtime dispatch — python iterables keep python semantics.
+        Loops carrying raw break/continue/return stay python."""
+        if getattr(node, "_pt_no_lower", False):
+            return node          # the python-fallback arm of a dispatch
+        before = self._iter_dispatches
         self.generic_visit(node)
         if (node.orelse or _scan(node.body)
-                or not isinstance(node.target, ast.Name)
-                or not (isinstance(node.iter, ast.Call)
-                        and isinstance(node.iter.func, ast.Name)
-                        and node.iter.func.id == "range"
-                        and not node.iter.keywords
-                        and 1 <= len(node.iter.args) <= 3)):
+                or not isinstance(node.target, ast.Name)):
             return node
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords
+                and 1 <= len(node.iter.args) <= 3):
+            if (_obviously_python_iter(node.iter, self.list_names)
+                    or self._iter_dispatches > before):
+                # python-only iterable, or a NESTED for-each already
+                # dispatched inside this body: duplicating it again
+                # would grow the converted function exponentially —
+                # innermost loops get the tensor dispatch, outer levels
+                # stay python (tensor rows still iterate eagerly there)
+                return node
+            return self._lower_iter_for(node)
         n = self.counter   # unique suffix for the loop-state temporaries
         tgt = node.target.id
         args = [ast.unparse(a) for a in node.iter.args]
@@ -1037,8 +1777,41 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             ast.parse(f"{tgt} = __pt_i_{n}").body
             + list(node.body)
             + ast.parse(f"__pt_i_{n} = __pt_i_{n} + __pt_step_{n}").body)
+        # static trip bound for tensor-array list carries: evaluated at
+        # lax-escape time from the CURRENT loop state
+        while_node._pt_bound_expr = (
+            f"lambda: (__pt_i_{n}, __pt_stop_{n}, __pt_step_{n})")
         out = self.visit_While(while_node)
         return setup + (out if isinstance(out, list) else [out])
+
+    def _lower_iter_for(self, node):
+        """`for t in seq:` -> runtime dispatch: a tensor seq becomes an
+        index loop over its static leading dim (then lowered through the
+        range machinery — traced-state bodies ride lax.while with a
+        dynamic row slice); anything else stays a python for."""
+        n = self.counter
+        self.counter += 1
+        self._iter_dispatches += 1
+        tgt = node.target.id
+        seq = f"__pt_seq_{n}"
+        setup = _reloc_stmt(f"{seq} = {ast.unparse(node.iter)}", node)
+        import copy
+        skel = (f"if _jst.is_tensor_seq({seq}):\n"
+                f"    for __pt_it_{n} in range(_jst.seq_len({seq})):\n"
+                f"        {tgt} = {seq}[__pt_it_{n}]\n"
+                f"        pass\n"
+                f"else:\n"
+                f"    for {tgt} in {seq}:\n"
+                f"        pass\n")
+        disp = ast.parse(skel).body[0]
+        for sub in ast.walk(disp):
+            ast.copy_location(sub, node)
+        tfor, pfor = disp.body[0], disp.orelse[0]
+        tfor.body = tfor.body[:1] + [copy.deepcopy(s) for s in node.body]
+        pfor.body = list(node.body)
+        pfor._pt_no_lower = True
+        out = self.visit_If(disp)
+        return [setup] + (out if isinstance(out, list) else [out])
 
     def visit_While(self, node):
         self.generic_visit(node)
@@ -1065,8 +1838,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         reset = f"__pt_reset_{n}" if vars_ else "None"
         names_lit = ("(" + ", ".join(repr(v) for v in vars_) + ",)"
                      if vars_ else "None")
+        bound = getattr(node, "_pt_bound_expr", "None")
         call = (f"_jst.convert_while(__pt_cond_{n}, __pt_body_{n}, "
-                f"{get}, {reset}, names={names_lit})")
+                f"{get}, {reset}, names={names_lit}, bound={bound})")
         return self._emit_cluster(n, vars_, defs, call)
 
 
@@ -1087,6 +1861,14 @@ class _CallsiteTransformer(ast.NodeTransformer):
             node.func = ast.Attribute(
                 value=ast.Name(id="_jst", ctx=ast.Load()),
                 attr="convert_print", ctx=ast.Load())
+        elif (isinstance(node.func, ast.Name) and node.func.id == "len"
+                and len(node.args) == 1 and not node.keywords):
+            # len -> convert_len (ref convert_call's len->array_length):
+            # python len for containers, static dim for tensors, running
+            # length for tensor-array carries
+            node.func = ast.Attribute(
+                value=ast.Name(id="_jst", ctx=ast.Load()),
+                attr="convert_len", ctx=ast.Load())
         elif _is_cast_call(node):
             node.args = [ast.copy_location(
                 ast.Constant(value=node.func.id), node)] + node.args
@@ -1146,22 +1928,35 @@ def convert_function(fn):
         src_file = inspect.getsourcefile(fn)
     except TypeError:
         pass
-    def _range_for(nd):
-        return (isinstance(nd, ast.For)
-                and isinstance(nd.iter, ast.Call)
-                and isinstance(nd.iter.func, ast.Name)
-                and nd.iter.func.id == "range")
-
     def _is_print(nd):
         return (isinstance(nd, ast.Call) and isinstance(nd.func, ast.Name)
                 and nd.func.id == "print")
 
-    has_cf = any(isinstance(s, (ast.If, ast.While, ast.Assert))
-                 or _range_for(s) or _is_print(s) or _is_cast_call(s)
+    lc = _ListCollector()
+    for s in fn_node.body:
+        lc.visit(s)
+    # list USE (indexing/mutation of a created-list name) also needs the
+    # runtime helpers — a tensor index into a list works only converted
+    has_list_use = lc.names and any(
+        (isinstance(s, ast.Subscript) and isinstance(s.value, ast.Name)
+         and s.value.id in lc.names)
+        or (isinstance(s, ast.Attribute) and isinstance(s.value, ast.Name)
+            and s.value.id in lc.names
+            and s.attr in ("append", "pop"))
+        for s in ast.walk(fn_node))
+    has_cf = any(isinstance(s, (ast.If, ast.While, ast.Assert, ast.For))
+                 or _is_print(s) or _is_cast_call(s)
                  for s in ast.walk(fn_node))
-    if not has_cf:
+    if not (has_cf or has_list_use):
         _CACHE[key] = fn
         return fn
+    # list mutation -> name-stores the capture machinery can carry (ref
+    # list_transformer.py); runs FIRST so appends/pops count as stored
+    # names for every later pass. Applied statement-wise: the passes'
+    # FunctionDef guards protect NESTED defs, not this top-level one.
+    if lc.names:
+        lt = _ListTransformer(lc.names)
+        fn_node.body = [lt.visit(s) for s in fn_node.body]
     # print/assert/cast -> per-execution runtime forms (ref
     # print_transformer.py / assert_transformer.py / cast_transformer.py)
     _CallsiteTransformer().visit(fn_node)
@@ -1175,7 +1970,7 @@ def convert_function(fn):
         out = bc.visit(s)
         bc_body.extend(out if isinstance(out, list) else [out])
     fn_node.body = bc_body
-    tr = _ControlFlowTransformer()
+    tr = _ControlFlowTransformer(list_names=lc.names)
     new_body = []
     for s in fn_node.body:
         out = tr.visit(s)
@@ -1244,5 +2039,16 @@ _JST = _JSTNamespace(
     convert_assert=convert_assert,
     convert_cast=convert_cast,
     finalize_return=finalize_return,
+    convert_list_append=convert_list_append,
+    convert_list_pop=convert_list_pop,
+    convert_list_pop_=convert_list_pop_,
+    convert_list_setitem=convert_list_setitem,
+    convert_list_getitem=convert_list_getitem,
+    convert_list_insert=convert_list_insert,
+    convert_list_extend=convert_list_extend,
+    convert_list_clear=convert_list_clear,
+    convert_len=convert_len,
+    is_tensor_seq=is_tensor_seq,
+    seq_len=seq_len,
     UNDEF=UNDEF,
 )

@@ -1,0 +1,373 @@
+"""dy2static list/tensor-array stress shapes mirroring the reference's
+dygraph_to_static/test_list.py (list created then append/pop inside
+if/for/while, stack/concat afterwards) and test_for_enumerate.py's
+tensor-iteration cases (`for t in tensor`), lowered the XLA way:
+fixed-length lists ride lax carries element-wise, growing lists become
+fixed-capacity tensor-array carries (capacity = the loop's static trip
+bound), and tensor iteration becomes an index loop over the static
+leading dim. Each converted result must match the eager run."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+
+
+def _check(fn, x=None, **kw):
+    x = np.asarray([1.0, 2.0], "f4") if x is None else x
+    want = fn(paddle.to_tensor(x), **kw)
+    got = to_static(fn)(paddle.to_tensor(x), **kw)
+    np.testing.assert_allclose(np.asarray(got.numpy()),
+                               np.asarray(want.numpy()), rtol=1e-6,
+                               atol=1e-6)
+
+
+# ---- ref test_list.py test_list_without_control_flow / in plain code
+
+def list_no_control_flow(x):
+    a = []
+    a.append(x)
+    a.append(x * 2)
+    return paddle.concat(a)
+
+
+def list_pop_no_control_flow(x):
+    a = []
+    a.append(x)
+    a.append(x * 2)
+    b = a.pop()
+    return a[0] + b
+
+
+def test_list_without_control_flow():
+    _check(list_no_control_flow)
+    _check(list_pop_no_control_flow)
+
+
+# ---- ref test_list.py test_list_in_if: append under a tensor cond
+
+def list_in_if(x):
+    a = []
+    if paddle.mean(x) > 0:
+        a.append(x)
+    else:
+        a.append(x * -1)
+    return a[0]
+
+
+def list_in_if_uneven(x):
+    a = []
+    if paddle.mean(x) > 0:
+        a.append(x)
+        a.append(x + 1)
+    else:
+        a.append(x * -1)
+    return a[0]
+
+
+def test_list_in_traced_if():
+    _check(list_in_if)
+    _check(list_in_if, x=np.asarray([-3.0, -1.0], "f4"))
+
+
+def test_list_uneven_branches_errors():
+    with pytest.raises(ValueError, match="append consistently"):
+        to_static(list_in_if_uneven)(
+            paddle.to_tensor(np.asarray([1.0, 2.0], "f4")))
+
+
+# ---- ref test_list.py test_list_in_for_loop (+ _with_concat/_stack):
+# the loop lowers to lax.while (traced carry), the list becomes a
+# tensor-array carry with capacity from the static range bound
+
+def list_in_for_loop_concat(x, iter_num=3):
+    a = []
+    for i in range(iter_num):
+        a.append(x + i)
+    return paddle.concat(a, axis=0)
+
+
+def list_in_for_loop_stack(x, iter_num=3):
+    a = []
+    for i in range(iter_num):
+        a.append(x * i)
+    return paddle.stack(a, axis=0)
+
+
+def list_in_for_with_traced_carry(x):
+    s = paddle.zeros([2])
+    a = []
+    for i in range(4):
+        s = s + x            # traced carry forces the lax path
+        a.append(s)
+    return paddle.stack(a).sum(axis=0) + s
+
+
+def test_list_in_for_loop():
+    _check(list_in_for_loop_concat)
+    _check(list_in_for_loop_stack)
+    _check(list_in_for_with_traced_carry)
+
+
+def test_list_growth_capacity_value():
+    """The tensor-array carry writes land in order: stack(a)[k] == the
+    k-th appended value (to_static jits, so the loop lowers on entry —
+    x rides as a traced jit input, not a constant)."""
+    x = np.asarray([1.0, 2.0], "f4")
+    got = to_static(list_in_for_loop_stack)(paddle.to_tensor(x))
+    want = np.stack([x * i for i in range(3)])
+    np.testing.assert_allclose(np.asarray(got.numpy()), want, rtol=1e-6)
+
+
+# ---- fixed-length list mutated (setitem) inside a lowered loop
+
+def list_setitem_in_loop(x):
+    a = [x, x * 0.0]
+    s = paddle.zeros([2])
+    for i in range(3):
+        s = s + x
+        a[1] = a[1] + s
+    return a[0] + a[1]
+
+
+def test_list_setitem_fixed_length():
+    _check(list_setitem_in_loop)
+
+
+# ---- traced-index read/write on a list of uniform tensors
+
+def list_traced_index_read(x):
+    a = [x, x * 2.0, x * 3.0]
+    i = paddle.argmax(x)                  # traced index
+    return a[i]
+
+
+def test_list_traced_index():
+    _check(list_traced_index_read)
+    _check(list_traced_index_read, x=np.asarray([5.0, 2.0], "f4"))
+
+
+# ---- len() conversion (ref convert_call len -> array_length)
+
+def len_of_list_and_tensor(x):
+    a = [x, x]
+    n = len(a) + len(x)                  # 2 + 2
+    return x * float(n)
+
+
+def test_convert_len():
+    _check(len_of_list_and_tensor)
+
+
+# ---- ref test_for_enumerate.py: `for t in tensor` iteration
+
+def iterate_tensor_rows(x):
+    s = paddle.zeros([3])
+    for row in x:
+        s = s + row * 2.0
+    return s
+
+
+def iterate_python_list(x):
+    s = x
+    for v in [1.0, 2.0]:                 # python iterable stays python
+        s = s + v
+    return s
+
+
+def test_for_over_tensor_rows():
+    x = np.arange(6, dtype="f4").reshape(2, 3)
+    _check(iterate_tensor_rows, x=x)
+    _check(iterate_python_list)
+
+
+def test_for_over_tensor_rows_under_jit():
+    import jax
+    x = np.arange(12, dtype="f4").reshape(4, 3)
+    conv = to_static(iterate_tensor_rows)
+
+    def fn(v):
+        out = conv(paddle.to_tensor(v))
+        return out._data
+
+    got = jax.jit(fn)(x)
+    np.testing.assert_allclose(np.asarray(got), x.sum(0) * 2.0,
+                               rtol=1e-6)
+
+
+# ---- list appended in a loop with break: growth capacity is a bound,
+# the final length is traced — honest error on python-list use, traced
+# indexing still works
+
+def list_append_with_break(x):
+    a = []
+    for i in range(5):
+        if paddle.mean(x) + i > 3:
+            break
+        a.append(x + i)
+    return paddle.stack(a)
+
+
+def test_list_append_break_is_actionable():
+    # eager: fine (python loop). converted under jit: traced break makes
+    # the final length dynamic -> clear guidance, not a tracer leak
+    import jax
+    conv = to_static(list_append_with_break)
+    with pytest.raises(ValueError,
+                       match="grew inside a traced loop|traced"):
+        jax.jit(lambda v: conv(paddle.to_tensor(v))._data)(
+            np.asarray([1.0, 2.0], "f4"))
+
+
+# ---- growth in a genuine traced while (no static bound): actionable
+
+def list_grow_traced_while(x):
+    a = []
+    i = paddle.zeros([1])
+    while paddle.mean(i) < 3:
+        a.append(x)
+        i = i + 1
+    return paddle.stack(a)
+
+
+def test_list_grow_traced_while_errors():
+    with pytest.raises(ValueError, match="static trip bound"):
+        to_static(list_grow_traced_while)(
+            paddle.to_tensor(np.asarray([1.0, 2.0], "f4")))
+
+
+# ---- nested: list append inside `if` inside lowered for
+
+def list_append_in_if_in_for(x):
+    a = []
+    s = paddle.zeros([2])
+    for i in range(4):
+        s = s + x
+        if paddle.mean(x) > 0:
+            a.append(s)
+        else:
+            a.append(s * 0.0)
+    return paddle.stack(a).sum(axis=0)
+
+
+def test_list_append_in_if_in_for():
+    _check(list_append_in_if_in_for)
+    _check(list_append_in_if_in_for, x=np.asarray([-1.0, -2.0], "f4"))
+
+
+# ---- review findings: capacity with >1 append per iteration, and
+# concrete lists that disagree across traced branches
+
+def list_two_appends_per_iter(x):
+    a = []
+    s = paddle.zeros([2])
+    for i in range(3):
+        s = s + x
+        a.append(s)
+        a.append(s * 2.0)
+    return paddle.stack(a).sum(axis=0)
+
+
+def test_two_appends_per_iteration():
+    """Capacity = trips x appends-per-iteration, not trips — an
+    undersized buffer would silently clobber the tail slots."""
+    _check(list_two_appends_per_iter)
+
+
+def concrete_list_disagreement(x):
+    if paddle.mean(x) > 0:
+        perm = [1.0, 2.0]
+    else:
+        perm = [3.0, 4.0]
+    return x + perm[0]
+
+
+def concrete_list_agreement(x):
+    if paddle.mean(x) > 0:
+        shape = [2]
+        y = x * 2.0
+    else:
+        shape = [2]
+        y = x * 3.0
+    return paddle.reshape(y, shape)     # shape list stays python ints
+
+
+def list_augassign_del_insert_extend(x):
+    a = [x, x * 2.0]
+    a[1] += x                  # AugAssign on a subscript
+    a.insert(0, x * 3.0)
+    a.extend([x * 4.0])
+    del a[0]
+    b = []
+    if paddle.mean(x) > 0:
+        b.append(a[0] + a[1] + a[2])
+    else:
+        b.append(a[0] - a[1] - a[2])
+    return b[0]
+
+
+def list_negative_index_in_loop(x):
+    ys = []
+    acc = paddle.zeros([2])
+    s = paddle.zeros([2])
+    for i in range(3):
+        s = s + x
+        ys.append(s)
+        acc = acc + ys[-1]      # must read the last APPENDED slot
+    return acc
+
+
+def list_negative_traced_setitem(x):
+    xs = [x, x * 2.0, x * 3.0]
+    t = paddle.argmax(x) - 3    # traced negative index
+    xs[t] = x * 9.0
+    return xs[2]
+
+
+def test_negative_indices_match_python():
+    _check(list_negative_index_in_loop)
+    _check(list_negative_traced_setitem)
+
+
+def test_augassign_del_insert_extend():
+    _check(list_augassign_del_insert_extend)
+    _check(list_augassign_del_insert_extend,
+           x=np.asarray([-1.0, -2.0], "f4"))
+
+
+def test_concrete_list_branches():
+    # same concrete list in both branches: stays static, usable as shape
+    _check(concrete_list_agreement)
+    _check(concrete_list_agreement, x=np.asarray([-1.0, -2.0], "f4"))
+    # differing concrete lists under a traced pred: actionable error,
+    # not a silent true-branch pick
+    with pytest.raises(ValueError, match="different python values"):
+        to_static(concrete_list_disagreement)(
+            paddle.to_tensor(np.asarray([1.0, 2.0], "f4")))
+    # strings disagreeing across traced branches get the same guard
+    # (review finding: 'mode' strings silently picked the true branch)
+
+    def mode_string(x):
+        if paddle.mean(x) > 0:
+            mode = "pos"
+        else:
+            mode = "neg"
+        return x * 2.0 if mode == "pos" else x * -3.0
+
+    with pytest.raises(ValueError, match="different python values"):
+        to_static(mode_string)(
+            paddle.to_tensor(np.asarray([1.0, 2.0], "f4")))
+    # annotated assignment creates a tracked list too (AnnAssign)
+
+    def ann_list(x):
+        a: list = []
+        if paddle.mean(x) > 0:
+            a.append(x)
+        else:
+            a.append(x * -1.0)
+        return a[0]
+
+    got = to_static(ann_list)(
+        paddle.to_tensor(np.asarray([-3.0, -1.0], "f4")))
+    np.testing.assert_allclose(np.asarray(got.numpy()), [3.0, 1.0],
+                               rtol=1e-6)
