@@ -14,6 +14,16 @@ import jax
 # baked into config at import time; this update must come before any backend use.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: XLA:CPU compiles dominate suite wall time
+# on the 1-core driver box; warm re-runs skip them (measured ~35% off the
+# heavy files). Same cache dir bench_sweep.py uses. Disable with
+# PT_NO_COMPILE_CACHE=1 when debugging compiler issues.
+if not os.environ.get("PT_NO_COMPILE_CACHE"):
+    _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_repo, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
 
 
