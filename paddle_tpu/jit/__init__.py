@@ -75,8 +75,19 @@ class StaticFunction:
     the dygraph Tensor interface."""
 
     def __init__(self, fn_or_layer, input_spec=None):
-        self._target = fn_or_layer
-        self._is_layer = isinstance(fn_or_layer, Layer)
+        import inspect
+        self._method = None
+        if inspect.ismethod(fn_or_layer) \
+                and isinstance(fn_or_layer.__self__, Layer):
+            # to_static(layer.forward): compile THROUGH the layer so its
+            # Parameters join the autograd graph (a plain-function wrap
+            # would see only the int input tensors and never train)
+            self._target = fn_or_layer.__self__
+            self._method = fn_or_layer.__func__
+            self._is_layer = True
+        else:
+            self._target = fn_or_layer
+            self._is_layer = isinstance(fn_or_layer, Layer)
         self._compiled = None
         self._input_spec = input_spec
 
@@ -84,23 +95,33 @@ class StaticFunction:
         from . import dy2static
         convert = ProgramTranslator.get_instance().enable_to_static
         if self._is_layer:
+            import types as _types
             layer = self._target
-            if convert and "forward" not in layer.__dict__:
-                # rewrite tensor-dependent `if`/`while` in forward so the
-                # trace lowers them to lax.cond/while (dy2static analog);
-                # patched on the instance so hooks/functional_call are kept
-                import types as _types
-                fwd = dy2static.convert_function(type(layer).forward)
-                if fwd is not type(layer).forward:
-                    layer.__dict__["forward"] = _types.MethodType(fwd, layer)
+            base_fwd = self._method or type(layer).forward
+            conv_fwd = dy2static.convert_function(base_fwd) if convert \
+                else base_fwd
+            conv_method = _types.MethodType(conv_fwd, layer)
 
             def pure(params, buffers, key, dyn, meta):
                 args, kwargs = _merge_static(dyn, meta)
-                with state.functional_rng_ctx(key):
-                    out, new_buf = layer.functional_call(
-                        params, buffers, *_wrap(args), **_wrap(kwargs))
+                # swap the converted forward in for the trace: the user
+                # may have assigned THIS StaticFunction to layer.forward
+                # (paddle idiom `model.forward = to_static(model.forward)`)
+                # and dispatching through it again would recurse
+                prev = layer.__dict__.get("forward", _MISSING)
+                layer.__dict__["forward"] = conv_method
+                try:
+                    with state.functional_rng_ctx(key):
+                        out, new_buf = layer.functional_call(
+                            params, buffers, *_wrap(args), **_wrap(kwargs))
+                finally:
+                    if prev is _MISSING:
+                        layer.__dict__.pop("forward", None)
+                    else:
+                        layer.__dict__["forward"] = prev
                 return _unwrap(out), new_buf
 
+            self._pure = pure
             self._compiled = jax.jit(pure, static_argnums=(4,))
         else:
             fn = dy2static.convert_function(self._target) if convert \
@@ -113,7 +134,30 @@ class StaticFunction:
                         out = fn(*_wrap(args), **_wrap(kwargs))
                 return _unwrap(out)
 
+            self._pure = pure
             self._compiled = jax.jit(pure, static_argnums=(2,))
+
+        # recompute-backward for eager training THROUGH the compiled
+        # forward (the reference's ProgramTranslator captures backward in
+        # the program, program_translator.py:233; here the whole jitted
+        # forward is ONE tape op whose vjp re-derives the backward inside
+        # jit — rematerialized, so nothing outlives the XLA program).
+        # float_idx (static) selects the differentiable output slots.
+        def bwd(p_leaves, dyn, buffers, key, cots, meta, names, float_idx):
+            def f(*prims):
+                p = dict(zip(names, prims[:len(names)]))
+                d = tuple(prims[len(names):])
+                if self._is_layer:
+                    out, _ = self._pure(p, buffers, key, d, meta)
+                else:
+                    out = self._pure(key, d, meta)
+                leaves = jax.tree_util.tree_flatten(out)[0]
+                return tuple(leaves[i] for i in float_idx)
+
+            _, vjp = jax.vjp(f, *(tuple(p_leaves) + tuple(dyn)))
+            return vjp(tuple(cots))
+
+        self._bwd = jax.jit(bwd, static_argnums=(5, 6, 7))
 
     def __call__(self, *args, **kwargs):
         if self._compiled is None:
@@ -127,8 +171,74 @@ class StaticFunction:
             named_b = dict(self._target.named_buffers())
             for n, arr in new_buf.items():
                 named_b[n]._data = arr
-            return _wrap(out)
-        return _wrap(self._compiled(key, dyn, meta))
+        else:
+            params, buffers = {}, {}
+            out = self._compiled(key, dyn, meta)
+        wrapped = _wrap(out)
+        if state.is_functional_mode() or not state.is_grad_enabled():
+            return wrapped
+        self._record_grad(wrapped, args, kwargs, params, buffers, key,
+                          dyn, meta)
+        return wrapped
+
+    def _record_grad(self, wrapped, args, kwargs, params, buffers, key,
+                     dyn, meta):
+        """Attach ONE GradNode covering the whole compiled forward, so
+        eager `loss.backward()` flows into the layer's Parameters and
+        any differentiable input Tensors. Double-grad (create_graph)
+        through a to_static function is not supported (fn=None)."""
+        from ..framework.tape import GradNode
+
+        # original Tensor objects aligned with the dyn leaves: _unwrap is
+        # structure-preserving, so wrapped and unwrapped trees flatten to
+        # the same leaf positions
+        w_leaves = jax.tree_util.tree_flatten((args, kwargs))[0]
+        u_leaves = jax.tree_util.tree_flatten(
+            (_unwrap(args), _unwrap(kwargs)))[0]
+        dyn_tensors = [w if isinstance(w, Tensor) else None
+                       for w, u in zip(w_leaves, u_leaves)
+                       if isinstance(u, (jax.Array, np.ndarray))]
+
+        names = tuple(params)
+        named_p = dict(self._target.named_parameters()) \
+            if self._is_layer else {}
+        p_tensors = [named_p.get(n) for n in names]
+        inputs = p_tensors + dyn_tensors
+        if not any(t is not None and not t.stop_gradient for t in inputs):
+            return
+
+        # ONE flatten defines the slot numbering: every leaf is a slot;
+        # only float Tensor slots are differentiable (float_idx), and the
+        # same indexing selects the cotangents the tape hands back
+        leaves_w = jax.tree_util.tree_flatten(
+            wrapped, is_leaf=lambda x: isinstance(x, Tensor))[0]
+        arrs = [w._data if isinstance(w, Tensor) else w for w in leaves_w]
+        float_idx = tuple(
+            i for i, (w, a) in enumerate(zip(leaves_w, arrs))
+            if isinstance(w, Tensor)
+            and jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating))
+        if not float_idx:
+            return
+        p_leaves = tuple(params[n] for n in names)
+        bwd = self._bwd
+
+        def vjp_fn(cots):
+            cots_t = cots if isinstance(cots, tuple) else (cots,)
+            return bwd(p_leaves, dyn, buffers, key,
+                       tuple(cots_t[i] for i in float_idx),
+                       meta, names, float_idx)
+
+        node = GradNode(
+            vjp=vjp_fn,
+            inputs=inputs,
+            n_outputs=len(leaves_w),
+            out_shapes=tuple(jnp.shape(a) for a in arrs),
+            out_dtypes=tuple(jnp.asarray(a).dtype for a in arrs),
+            name="to_static")
+        for i in float_idx:
+            leaves_w[i]._node = node
+            leaves_w[i]._slot = i
+            leaves_w[i].stop_gradient = False
 
     # paddle surface
     @property

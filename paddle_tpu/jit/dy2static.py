@@ -390,16 +390,79 @@ def _remaining_trips(bound):
     return max(0, math.ceil((stop - cur) / step))
 
 
+def _lax_scan(body_fn, get, reset, orig, names, trips):
+    """Fixed-trip lowering: a for-range loop with NO early-exit/skip
+    flags runs exactly `trips` iterations, so it lowers to lax.scan —
+    which, unlike lax.while_loop, supports reverse-mode AD. This is the
+    path that makes dy2static-converted training forwards (teacher-
+    forced decoders etc.) differentiable end to end."""
+    dyn_idx = _split_dynamic(orig)
+
+    def put(carry):
+        full = list(orig)
+        for j, i in enumerate(dyn_idx):
+            full[i] = Tensor(carry[j]) if isinstance(orig[i], Tensor) \
+                else carry[j]
+        reset(tuple(full))
+
+    def step(carry, _):
+        put(carry)
+        body_fn()
+        out = get()
+        for i, v in enumerate(out):
+            if i not in dyn_idx and _is_traced(_unwrap(v)) \
+                    and not _is_traced(_unwrap(orig[i])) \
+                    and not isinstance(orig[i], _Undef):
+                nm = names[i] if names and i < len(names) else None
+                what = f"variable {nm!r}" if nm else "a variable"
+                raise ValueError(
+                    f"dy2static: {what} becomes a tensor inside a traced "
+                    "loop — initialize it as a tensor before the loop "
+                    "(XLA loop carries need a fixed structure)")
+        new = []
+        for j, i in enumerate(dyn_idx):
+            u = jnp.asarray(_unwrap(out[i]))
+            new.append(u.astype(carry[j].dtype)
+                       if u.dtype != carry[j].dtype else u)
+        return tuple(new), None
+
+    carry0 = tuple(jnp.asarray(_unwrap(orig[i])) for i in dyn_idx)
+    res, _ = jax.lax.scan(step, carry0, None, length=trips)
+    final = list(orig)
+    for j, i in enumerate(dyn_idx):
+        final[i] = Tensor(res[j]) if isinstance(orig[i], Tensor) else res[j]
+    for i, v in enumerate(final):
+        if isinstance(v, _Undef):
+            final[i] = _LoopLocal(names[i] if names and i < len(names)
+                                  else None)
+    reset(tuple(final))
+    return tuple(final)
+
+
 def _lax_while_lists(cond_fn, body_fn, get, reset, orig, names, bound=None):
     """List-carry adapter over _lax_while (ref list_transformer.py's
     tensor-array writes): each jaxable list var expands to per-element
     carry slots; a list that grows raises _ListGrew during the first
     trace and retries with a fixed-capacity _TensorArrayCarry, capacity =
     current length + the loop's remaining static trips."""
+    # fixed-trip loops (static range bound, no break/continue/return
+    # flags) lower to lax.scan — the differentiable path; everything
+    # else keeps lax.while_loop semantics
+    exact = not any(
+        n and n.startswith(("__pt_brk", "__pt_cont", "__pt_ret"))
+        for n in (names or ()))
+    trips = _remaining_trips(bound)
+    if trips is not None and exact:
+        def run(bf, g, r, o, n):
+            return _lax_scan(bf, g, r, o, n, trips)
+    else:
+        def run(bf, g, r, o, n):
+            return _lax_while(cond_fn, bf, g, r, o, n)
+
     list_idx = [i for i, v in enumerate(orig)
                 if _jaxable_list(v) or isinstance(v, _TensorArrayCarry)]
     if not list_idx:
-        return _lax_while(cond_fn, body_fn, get, reset, orig, names)
+        return run(body_fn, get, reset, orig, names)
 
     # var index -> ("elems", length, wrap_flags) | ("ta", wrap, exact)
     mode = {}
@@ -475,22 +538,10 @@ def _lax_while_lists(cond_fn, body_fn, get, reset, orig, names, bound=None):
     def reset2(vals):
         reset(collapse(vals))
 
-    # early-exit/skip flags make the FINAL length a traced value; without
-    # them every remaining trip appends, so final length == capacity and
-    # the carry finalizes back to a plain python list
-    exact = not any(
-        n and n.startswith(("__pt_brk", "__pt_cont", "__pt_ret"))
-        for n in (names or ()))
-
-    # read the trip bound NOW: an abandoned trace leaves dead tracers in
-    # the loop-state temporaries the bound thunk reads
-    trips = _remaining_trips(bound)
-
     while True:
         orig2, names2 = expand(orig)
         try:
-            res2 = _lax_while(cond_fn, body_fn, get2, reset2, orig2,
-                              names2)
+            res2 = run(body_fn, get2, reset2, orig2, names2)
         except _ListGrew as g:
             if trips is None:
                 raise ValueError(
@@ -1898,12 +1949,34 @@ def convert_function(fn):
     """Rewrite `fn`'s tensor-dependent control flow; returns a new function
     closed over the same globals (ref program_translator.py:233
     ProgramTranslator + convert_to_static cache)."""
+    # bound methods: convert the underlying function, re-bind to the
+    # instance (paddle allows to_static(layer.forward) too)
+    if inspect.ismethod(fn):
+        conv = convert_function(fn.__func__)
+        return types.MethodType(conv, fn.__self__) \
+            if conv is not fn.__func__ else fn
     # closure cells are baked into the converted copy's globals, so the cache
-    # key must distinguish different closures over the same code object
+    # key must distinguish different closures over the same code object AND
+    # different CONTENTS of the same cell (a nonlocal rebind after first
+    # conversion must re-bake, not serve the stale copy). Cells are
+    # unhashable (they define __eq__ since 3.8): key by (cell id, content
+    # id); the cache value pins the cells so the ids stay valid.
     cells = tuple(fn.__closure__) if getattr(fn, "__closure__", None) else ()
-    key = (getattr(fn, "__code__", None), cells)
+
+    def _content_id(c):
+        try:
+            return id(c.cell_contents)
+        except ValueError:          # empty cell
+            return None
+
+    key = (getattr(fn, "__code__", None),
+           tuple((id(c), _content_id(c)) for c in cells))
+    # pin the CURRENT contents too: a freed old content's id could be
+    # reused by a new object, which would false-hit the stale entry
+    pins = (cells, tuple(c.cell_contents if _content_id(c) is not None
+                         else None for c in cells))
     if key in _CACHE:
-        return _CACHE[key]
+        return _CACHE[key][0]
     try:
         src = textwrap.dedent(inspect.getsource(fn))
     except (OSError, TypeError):
@@ -1948,7 +2021,7 @@ def convert_function(fn):
                  or _is_print(s) or _is_cast_call(s)
                  for s in ast.walk(fn_node))
     if not (has_cf or has_list_use):
-        _CACHE[key] = fn
+        _CACHE[key] = (fn, pins)
         return fn
     # list mutation -> name-stores the capture machinery can carry (ref
     # list_transformer.py); runs FIRST so appends/pops count as stored
@@ -2017,10 +2090,10 @@ def convert_function(fn):
         new_fn = glb[fn_node.name]
     except SyntaxError as e:  # pragma: no cover - surface, keep original
         warnings.warn(f"dy2static: could not convert {fn.__qualname__}: {e}")
-        _CACHE[key] = fn
+        _CACHE[key] = (fn, pins)
         return fn
     new_fn = functools.wraps(fn)(new_fn)
-    _CACHE[key] = new_fn
+    _CACHE[key] = (new_fn, pins)
     return new_fn
 
 
