@@ -21,6 +21,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <cmath>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -68,6 +69,12 @@ enum WorkerState : uint8_t { W_RUNNING = 1, W_COMPLETED = 2, W_DEAD = 3 };
 struct DenseTable {
   std::vector<float> values;
   float lr = 0.1f;
+  // adagrad rule (ref ps/table/sparse_sgd_rule.cc SparseAdaGradSGDRule's
+  // dense sibling): v -= lr * g / (sqrt(acc) + eps), acc += g*g.
+  // Accumulators are in-memory only (reset on save/load round-trip).
+  bool adagrad = false;
+  float eps = 1e-6f;
+  std::vector<float> accum;
   std::mutex mu;
 };
 
@@ -81,12 +88,15 @@ static inline uint64_t mix64(uint64_t x) {
 
 struct SparseShard {
   std::unordered_map<int64_t, std::vector<float>> rows;
+  std::unordered_map<int64_t, std::vector<float>> accums;  // adagrad state
   std::mutex mu;
 };
 
 struct SparseTable {
   int dim = 8;
   float lr = 0.1f;
+  bool adagrad = false;      // per-row adagrad (ref SparseAdaGradSGDRule)
+  float eps = 1e-6f;
   float init_scale = 0.01f;  // rows init uniform(-scale, scale), id-seeded
   static constexpr int kShards = 16;
   SparseShard shards[kShards];
@@ -195,6 +205,24 @@ class PsServer {
     sparse_[id] = std::move(t);
   }
 
+  // switch a table's update rule to adagrad (ref SparseAdaGradSGDRule);
+  // must be called before training starts
+  int SetAdagrad(uint32_t id, bool is_sparse, float eps) {
+    std::lock_guard<std::mutex> lk(tables_mu_);
+    if (is_sparse) {
+      auto it = sparse_.find(id);
+      if (it == sparse_.end()) return -1;
+      it->second->adagrad = true;
+      it->second->eps = eps;
+    } else {
+      auto it = dense_.find(id);
+      if (it == dense_.end()) return -1;
+      it->second->adagrad = true;
+      it->second->eps = eps;
+    }
+    return 0;
+  }
+
   void Stop() {
     if (!running_.exchange(false)) return;
     shutdown(lfd_, SHUT_RDWR);
@@ -300,13 +328,26 @@ class PsServer {
           return false;
         {
           std::lock_guard<std::mutex> lk(t->mu);
-          if (op == PUSH_DENSE_GRAD)
-            for (uint64_t i = 0; i < count; ++i)
-              t->values[i] -= t->lr * buf[i];
+          if (op == PUSH_DENSE_GRAD) {
+            if (t->adagrad) {
+              if (t->accum.size() != t->values.size())
+                t->accum.assign(t->values.size(), 0.0f);
+              for (uint64_t i = 0; i < count; ++i) {
+                t->accum[i] += buf[i] * buf[i];
+                t->values[i] -=
+                    t->lr * buf[i] / (std::sqrt(t->accum[i]) + t->eps);
+              }
+            } else {
+              for (uint64_t i = 0; i < count; ++i)
+                t->values[i] -= t->lr * buf[i];
+            }
+          }
           else if (op == PUSH_DENSE_DELTA)
             for (uint64_t i = 0; i < count; ++i) t->values[i] += buf[i];
-          else
+          else {                     // SET_DENSE: re-init, fresh opt state
             t->values = std::move(buf);
+            t->accum.clear();
+          }
         }
         uint8_t ok = 1;
         return Reply(fd, &ok, 1);
@@ -334,8 +375,18 @@ class PsServer {
           SparseShard& sh = t->shard(ids[i]);
           std::lock_guard<std::mutex> lk(sh.mu);
           std::vector<float>& row = t->Row(ids[i]);
-          for (int d = 0; d < t->dim; ++d)
-            row[d] -= t->lr * grads[i * t->dim + d];
+          if (t->adagrad) {
+            std::vector<float>& acc = sh.accums[ids[i]];
+            if ((int)acc.size() != t->dim) acc.assign(t->dim, 0.0f);
+            for (int d = 0; d < t->dim; ++d) {
+              float g = grads[i * t->dim + d];
+              acc[d] += g * g;
+              row[d] -= t->lr * g / (std::sqrt(acc[d]) + t->eps);
+            }
+          } else {
+            for (int d = 0; d < t->dim; ++d)
+              row[d] -= t->lr * grads[i * t->dim + d];
+          }
         }
         uint8_t ok = 1;
         return Reply(fd, &ok, 1);
@@ -547,6 +598,9 @@ class PsServer {
       if (!in.read(reinterpret_cast<char*>(staged.data()), n * 4))
         return false;
       t->values = std::move(staged);
+      // a restore rolls optimizer state back too: stale adagrad
+      // accumulators would shrink every post-restore update
+      t->accum.clear();
       return true;
     }
     if (SparseTable* t = Sparse(id)) {
@@ -575,6 +629,10 @@ class PsServer {
         SparseShard& sh = t->shard(kv.first);
         std::lock_guard<std::mutex> lk(sh.mu);
         sh.rows[kv.first] = std::move(kv.second);
+      }
+      for (auto& sh : t->shards) {   // restore == fresh optimizer state
+        std::lock_guard<std::mutex> lk(sh.mu);
+        sh.accums.clear();
       }
       return true;
     }
@@ -808,6 +866,10 @@ void pt_ps_add_dense_table(void* h, uint32_t id, int64_t size, float lr) {
 void pt_ps_add_sparse_table(void* h, uint32_t id, int dim, float lr,
                             float init_scale) {
   static_cast<ptps::PsServer*>(h)->AddSparseTable(id, dim, lr, init_scale);
+}
+
+int pt_ps_table_set_adagrad(void* h, uint32_t id, int is_sparse, float eps) {
+  return static_cast<ptps::PsServer*>(h)->SetAdagrad(id, is_sparse != 0, eps);
 }
 
 // returns bound port (use port=0 for ephemeral), or -1

@@ -42,13 +42,29 @@ class PsServer:
         self._h = self._lib.pt_ps_server_create()
         self.port = None
 
-    def add_dense_table(self, table_id, size, lr=0.1):
+    def add_dense_table(self, table_id, size, lr=0.1, optimizer="sgd"):
         self._lib.pt_ps_add_dense_table(self._h, table_id, int(size),
                                         float(lr))
+        self._set_optimizer(table_id, optimizer, is_sparse=False)
 
-    def add_sparse_table(self, table_id, dim, lr=0.1, init_scale=0.01):
+    def add_sparse_table(self, table_id, dim, lr=0.1, init_scale=0.01,
+                         optimizer="sgd"):
         self._lib.pt_ps_add_sparse_table(self._h, table_id, int(dim),
                                          float(lr), float(init_scale))
+        self._set_optimizer(table_id, optimizer, is_sparse=True)
+
+    def _set_optimizer(self, table_id, optimizer, is_sparse):
+        """Server-side update rule (ref ps/table/sparse_sgd_rule.cc:
+        SparseNaiveSGDRule / SparseAdaGradSGDRule)."""
+        if optimizer == "sgd":
+            return
+        if optimizer != "adagrad":
+            raise ValueError(f"unknown PS table optimizer {optimizer!r} "
+                             "(sgd | adagrad)")
+        rc = self._lib.pt_ps_table_set_adagrad(self._h, table_id,
+                                               int(is_sparse), 1e-6)
+        if rc != 0:
+            raise RuntimeError(f"no such table {table_id}")
 
     def start(self, port=0):
         p = self._lib.pt_ps_server_start(self._h, int(port))

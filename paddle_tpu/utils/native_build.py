@@ -92,6 +92,9 @@ def _configure(lib):
                                           c.c_float]
     lib.pt_ps_add_sparse_table.argtypes = [c.c_void_p, c.c_uint32, c.c_int,
                                            c.c_float, c.c_float]
+    lib.pt_ps_table_set_adagrad.argtypes = [c.c_void_p, c.c_uint32, c.c_int,
+                                            c.c_float]
+    lib.pt_ps_table_set_adagrad.restype = c.c_int
     lib.pt_ps_server_start.argtypes = [c.c_void_p, c.c_int]
     lib.pt_ps_server_start.restype = c.c_int
     lib.pt_ps_server_stop.argtypes = [c.c_void_p]

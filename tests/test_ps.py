@@ -461,3 +461,39 @@ def test_fleet_facade_optimizer_passthroughs():
     assert fleet.get_lr() == 0.05
     sd = fleet.state_dict()
     fleet.set_state_dict(sd)
+
+
+def test_adagrad_table_rule():
+    """Server-side adagrad (ref ps/table/sparse_sgd_rule.cc
+    SparseAdaGradSGDRule): v -= lr * g / (sqrt(acc) + eps)."""
+    s = PsServer()
+    s.add_dense_table(0, 4, lr=0.5, optimizer="adagrad")
+    s.add_sparse_table(1, dim=2, lr=0.5, init_scale=0.0,
+                       optimizer="adagrad")
+    port = s.start(0)
+    try:
+        c = PsClient(port=port)
+        g = np.array([2.0, 2.0, 0.5, 0.0], "f4")
+        c.push_dense_grad(0, g)
+        # acc = g^2 -> update = lr * g / (|g| + eps) = lr * sign(g)
+        np.testing.assert_allclose(c.pull_dense(0, 4),
+                                   [-0.5, -0.5, -0.5, 0.0], atol=1e-4)
+        c.push_dense_grad(0, g)
+        # acc = 2 g^2 -> update = lr / sqrt(2) for nonzero g
+        step2 = 0.5 / np.sqrt(2)
+        np.testing.assert_allclose(
+            c.pull_dense(0, 4),
+            [-0.5 - step2, -0.5 - step2, -0.5 - step2, 0.0], atol=1e-4)
+        # sparse: same rule per row
+        ids = np.array([7], "i8")
+        c.push_sparse_grad(1, ids, np.array([[3.0, 0.0]], "f4"))
+        row = c.pull_sparse(1, ids, 2)
+        np.testing.assert_allclose(row, [[-0.5, 0.0]], atol=1e-4)
+    finally:
+        s.stop()
+
+
+def test_unknown_optimizer_rejected():
+    s = PsServer()
+    with pytest.raises(ValueError, match=r"sgd \| adagrad"):
+        s.add_dense_table(0, 4, optimizer="adam")
