@@ -1,0 +1,91 @@
+"""N-gram word embedding model — the reference book suite's word2vec
+case (ref python/paddle/fluid/tests/book/test_word2vec_book.py: four
+context-word embeddings with a SHARED table -> concat -> fc sigmoid ->
+softmax over the vocab, SGD), on text.Imikolov (synthetic markov-chain
+corpus: learnable; same API as the real PTB loader).
+
+    python examples/word2vec.py [--steps 300]
+
+Prints one JSON line with convergence (perplexity must drop well below
+the uniform-vocab baseline).
+"""
+import argparse
+import json
+import math
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--emb", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=128)
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.text import Imikolov
+
+    paddle.seed(11)
+    train = Imikolov(data_type="NGRAM", window_size=5, mode="train",
+                     vocab_size=args.vocab, num_samples=20000)
+    V, E = args.vocab, args.emb
+
+    class NGram(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(V, E)       # ONE shared table
+            self.fc = nn.Linear(4 * E, 128)
+            self.out = nn.Linear(128, V)
+
+        def forward(self, ctx):                 # ctx [B,4]
+            e = self.emb(ctx).reshape([ctx.shape[0], 4 * E])
+            h = paddle.nn.functional.sigmoid(self.fc(e))
+            return self.out(h)
+
+    model = NGram()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+    ce = nn.CrossEntropyLoss()
+    loader = paddle.io.DataLoader(train, batch_size=args.batch_size,
+                                  shuffle=True, drop_last=True)
+
+    t0 = time.time()
+    first_loss = last_loss = None
+    step = 0
+    while step < args.steps:
+        for batch in loader:
+            *ctx_cols, label = batch
+            ctx = paddle.stack(ctx_cols, axis=1)
+            loss = ce(model(ctx), label.reshape([-1]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(loss.numpy())
+            if first_loss is None:
+                first_loss = v
+            last_loss = v
+            step += 1
+            if step >= args.steps:
+                break
+
+    uniform = math.log(V)
+    print(json.dumps({
+        "example": "word2vec",
+        "steps": args.steps,
+        "first_loss": round(first_loss, 4),
+        "last_loss": round(last_loss, 4),
+        "uniform_nats": round(uniform, 4),
+        "ppl": round(math.exp(last_loss), 2),
+        # the markov corpus is far more predictable than uniform: the
+        # model must beat the uniform baseline by a clear margin
+        "converged": last_loss < uniform * 0.6,
+        "secs": round(time.time() - t0, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,3 +39,38 @@ def test_pipeline_mnist_converges():
     assert r["converged"], r
     assert r["mesh"] == "dp4xpp2"
     assert r["last_loss"] < r["first_loss"] * 0.6
+
+
+# ---- the reference book suite (ref python/paddle/fluid/tests/book/)
+# as converging end-to-end examples — the integration surface that
+# catches cross-feature bugs (round-4 verdict, next-round #4)
+
+def test_machine_translation_converges():
+    """seq2seq + attention under @to_static (dy2static list lowering in
+    the decoder loop) + BeamSearchDecoder/dynamic_decode inference."""
+    r = _run_example("machine_translation.py", "--steps", "120")
+    assert r["converged"], r
+    # beam decode must actually reproduce the learned mapping
+    assert r["beam_token_acc"] > 0.7, r
+
+
+def test_word2vec_converges():
+    r = _run_example("word2vec.py", "--steps", "300")
+    assert r["converged"], r
+    assert r["last_loss"] < r["uniform_nats"] * 0.6, r
+
+
+def test_recommender_system_ps_converges():
+    """Embedding + PS path: native PsServer (adagrad tables) + async
+    Hogwild workers over TCP."""
+    r = _run_example("recommender_system.py", "--steps", "400")
+    assert r["converged"], r
+    assert r["last_mse"] < r["predict_mean_mse"] * 0.7, r
+    assert r["workers"] == 2
+
+
+def test_image_classification_converges():
+    r = _run_example("image_classification.py", "--steps", "40")
+    assert r["converged"], r
+    assert r["devices"] == 8
+    assert r["test_acc"] > 0.5, r
