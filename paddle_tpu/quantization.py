@@ -59,19 +59,22 @@ def fake_quantize_dequantize(x, bits=8, symmetric=True, scale=None):
 
 class FakeQuantWrapper(nn.Layer):
     """Wraps one layer; fake-quants its weight (and input activations when
-    activation_quantize=True) before the wrapped forward."""
+    activation_quantize=True) before the wrapped forward. act_scale=None
+    is the dynamic QAT range; a float is a PTQ-calibrated FROZEN range."""
 
     def __init__(self, layer, weight_bits=8, activation_bits=8,
-                 activation_quantize=True):
+                 activation_quantize=True, act_scale=None):
         super().__init__()
         self.wrapped = layer
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
         self.activation_quantize = activation_quantize
+        self.act_scale = act_scale
 
     def forward(self, x, *args, **kwargs):
         if self.activation_quantize:
-            x = fake_quantize_dequantize(x, bits=self.activation_bits)
+            x = fake_quantize_dequantize(x, bits=self.activation_bits,
+                                         scale=self.act_scale)
         w = self.wrapped.weight
         saved = w._data
         w._data = fake_quantize_dequantize(
@@ -111,29 +114,128 @@ class ImperativeQuantAware:
         return export.save(model, path, input_spec=input_spec)
 
 
-class PostTrainingQuantization:
-    """PTQ calibration (ref slim post_training_quantization.py): run
-    representative batches, record per-layer abs-max activation scales."""
+class ScaleObserver:
+    """Per-tensor activation-range observer (ref slim
+    post_training_quantization.py:121 PostTrainingQuantization's
+    sampling): abs_max / avg need one pass; hist / KL need the abs-max
+    pass FIRST (fixes the histogram domain), then a histogram pass."""
 
-    def __init__(self, model, algo="abs_max"):
+    BINS = 2048
+
+    def __init__(self, algo="abs_max", bits=8):
+        if algo not in ("abs_max", "avg", "hist", "KL"):
+            raise ValueError(
+                f"unknown PTQ algo {algo!r} (abs_max | avg | hist | KL)")
+        self.algo = algo
+        self.bits = bits
+        self.abs_max = 0.0
+        self._batch_maxes = []
+        self.hist = np.zeros(self.BINS, "f8") if algo in ("hist", "KL") \
+            else None
+
+    def update_max(self, x):
+        m = float(jnp.max(jnp.abs(x)))
+        self.abs_max = max(self.abs_max, m)
+        self._batch_maxes.append(m)
+
+    def update_hist(self, x):
+        if self.hist is None or self.abs_max <= 0:
+            return
+        a = np.abs(np.asarray(x)).ravel()
+        h, _ = np.histogram(a, bins=self.BINS, range=(0.0, self.abs_max))
+        self.hist += h
+
+    def scale(self):
+        """The frozen activation range for this tensor."""
+        if self.abs_max <= 0:
+            return 0.0
+        if self.algo == "abs_max":
+            return self.abs_max
+        if self.algo == "avg":                   # ref 'avg': mean of
+            return float(np.mean(self._batch_maxes))  # per-batch maxes
+        if self.algo == "hist":                  # ref hist_percent
+            c = np.cumsum(self.hist)
+            if c[-1] <= 0:
+                return self.abs_max
+            idx = int(np.searchsorted(c, 0.99999 * c[-1]))
+            return self.abs_max * (idx + 1) / self.BINS
+        return self._kl_scale()                  # "KL"
+
+    def _kl_scale(self):
+        """TensorRT-style KL threshold search (ref slim cal_kl_threshold):
+        pick the clip point whose 2^(bits-1)-level quantization of the
+        clipped distribution minimizes KL divergence."""
+        target = 2 ** (self.bits - 1)            # 128 for int8
+        h = self.hist
+        if h.sum() <= 0:
+            return self.abs_max
+        # search only thresholds that keep >= 99% of the mass: at
+        # t == target the `target`-level quantization is EXACT (KL = 0),
+        # so an unconstrained argmin always picks maximal clipping — the
+        # search's job is to trim the outlier TAIL, not the distribution
+        c = np.cumsum(h)
+        t99 = int(np.searchsorted(c, 0.99 * c[-1])) + 1
+        start = max(target, t99)
+        best_t, best_kl = self.BINS, np.inf
+        for t in range(start, self.BINS + 1, 16):
+            p = h[:t].astype("f8").copy()
+            p[-1] += h[t:].sum()                 # clip outliers into edge
+            if p.sum() <= 0:
+                continue
+            # quantize the t bins down to `target` levels and expand back
+            chunk = t / target
+            q = np.zeros(t, "f8")
+            for k in range(target):
+                lo, hi = int(np.floor(k * chunk)), int(np.ceil((k + 1)
+                                                              * chunk))
+                hi = min(hi, t)
+                seg = p[lo:hi]
+                nz = seg > 0
+                if nz.any():
+                    # spread the segment's mass over its nonzero bins
+                    q[lo:hi][nz] = seg.sum() / int(nz.sum())
+            pn = p / p.sum()
+            qs = q.sum()
+            if qs <= 0:
+                continue
+            qn = q / qs
+            mask = pn > 0
+            kl = float(np.sum(pn[mask] * np.log(
+                pn[mask] / np.maximum(qn[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_t = kl, t
+        return self.abs_max * best_t / self.BINS
+
+
+class PostTrainingQuantization:
+    """PTQ calibration (ref slim post_training_quantization.py:121): run
+    representative batches through the model, observe per-layer input
+    activation ranges (abs_max / avg / hist / KL), then convert() wraps
+    the quantizable sublayers with the FROZEN scales + fake-quant
+    weights — the deploy-path half of slim (QAT is the training half)."""
+
+    def __init__(self, model, algo="hist", weight_bits=8,
+                 activation_bits=8):
         self.model = model
         self.algo = algo
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.observers = {}
         self.scales = {}
 
-    def calibrate(self, data_iter, max_batches=16):
+    def _run(self, data_iter, max_batches, update):
         hooks = []
-        scales = self.scales
 
         def mk_hook(name):
             def hook(layer, inputs, outputs=None):
                 x = inputs[0]
-                m = float(jnp.max(jnp.abs(
-                    x._data if isinstance(x, Tensor) else x)))
-                scales[name] = max(scales.get(name, 0.0), m)
+                update(name, x._data if isinstance(x, Tensor) else x)
             return hook
 
         for name, sub in self.model.named_sublayers():
             if isinstance(sub, _QUANTIZABLE):
+                self.observers.setdefault(
+                    name, ScaleObserver(self.algo, self.activation_bits))
                 hooks.append(sub.register_forward_pre_hook(mk_hook(name)))
         try:
             for i, batch in enumerate(data_iter):
@@ -144,7 +246,40 @@ class PostTrainingQuantization:
         finally:
             for h in hooks:
                 h.remove()
+
+    def calibrate(self, data, max_batches=16):
+        """`data`: any iterable of batches (only the first max_batches
+        are drawn — an endless/streaming loader is fine); histogram
+        algos replay the drawn batches twice (pass 1 fixes the ranges,
+        pass 2 bins)."""
+        import itertools
+        if not isinstance(data, (list, tuple)):
+            data = list(itertools.islice(iter(data), max_batches))
+        self._run(iter(data), max_batches,
+                  lambda n, x: self.observers[n].update_max(x))
+        if self.algo in ("hist", "KL"):
+            self._run(iter(data), max_batches,
+                      lambda n, x: self.observers[n].update_hist(x))
+        self.scales = {n: ob.scale() for n, ob in self.observers.items()}
         return self.scales
+
+    def convert(self):
+        """Swap quantizable sublayers for wrappers with the calibrated
+        frozen activation scales (ref slim's save_quantized_model
+        output: q/dq at fixed ranges)."""
+        if not self.scales:
+            raise RuntimeError("call calibrate() before convert()")
+        for prefix, holder in self.model.named_sublayers(
+                include_self=True):
+            subs = getattr(holder, "_sub_layers", {})
+            for name, sub in list(subs.items()):
+                full = f"{prefix}.{name}" if prefix else name
+                if isinstance(sub, _QUANTIZABLE) and full in self.scales \
+                        and self.scales[full] > 0:
+                    subs[name] = FakeQuantWrapper(
+                        sub, self.weight_bits, self.activation_bits,
+                        act_scale=float(self.scales[full]))
+        return self.model
 
 
 # ---------------------------------------------------------------------------

@@ -104,24 +104,55 @@ class QuantizationTransformPass:
         return n_inserted
 
 
-def collect_activation_scales(program, feeds_list):
+def collect_activation_scales(program, feeds_list, algo="abs_max"):
     """PTQ calibration: replay the desc over the calibration feeds and
-    record abs-max for every ACTIVATION quant-op input (ref slim
-    post_training_quantization abs_max algo). Returns {var: scale}."""
+    observe every ACTIVATION quant-op input (ref slim
+    post_training_quantization.py:121; algo abs_max / avg / hist / KL —
+    histogram algos replay the feeds twice). Returns {var: scale}."""
+    from ..quantization import ScaleObserver
     desc = program.desc
     act_vars = [op.inputs[0] for op in desc.ops
                 if op.type == _QOP and not op.attrs.get("__weight_quant__")]
-    scales = {v: 0.0 for v in act_vars}
+    obs = {v: ScaleObserver(algo) for v in act_vars}
     persist = {n: t._data for n, t in program._persist.items()}
-    for feeds in feeds_list:
-        env = dict(persist)
-        env.update({k: jnp.asarray(v) for k, v in feeds.items()})
-        env[D.RNG_VAR] = jax.random.PRNGKey(0)
-        D.run_desc(desc, env)
-        for v in act_vars:
-            if v in env:
-                scales[v] = max(scales[v],
-                                float(jnp.max(jnp.abs(env[v]))))
+
+    def replay(update):
+        for feeds in feeds_list:
+            env = dict(persist)
+            env.update({k: jnp.asarray(v) for k, v in feeds.items()})
+            env[D.RNG_VAR] = jax.random.PRNGKey(0)
+            D.run_desc(desc, env)
+            for v in act_vars:
+                if v in env:
+                    update(obs[v], env[v])
+
+    replay(lambda ob, x: ob.update_max(x))
+    if algo in ("hist", "KL"):
+        replay(lambda ob, x: ob.update_hist(x))
+    return {v: ob.scale() for v, ob in obs.items()}
+
+
+def quantize_post_training(predictor, feeds_list, algo="hist"):
+    """One-call PTQ over a serving Predictor (ref slim
+    PostTrainingQuantization's create_predictor-driven flow): insert the
+    q/dq ops, run the calibration set THROUGH the predictor's program,
+    freeze the observed ranges. The predictor then serves the
+    quantization-simulated program in place. feeds_list: list of
+    {input_name: array}. Returns the frozen {var: scale} map."""
+    if getattr(predictor, "_mode", None) != "program":
+        raise ValueError(
+            "quantize_post_training needs a program-path Predictor "
+            "(save_inference_model artifacts); StableHLO bundles are "
+            "already-compiled executables — quantize the Layer with "
+            "quantization.PostTrainingQuantization before jit.save")
+    prog = predictor._prog
+    QuantizationTransformPass().apply(prog)
+    scales = collect_activation_scales(prog, feeds_list, algo=algo)
+    apply_calibration(prog, scales)
+    # drop any jit cache keyed on the old desc
+    if hasattr(predictor, "_exe"):
+        from . import Executor
+        predictor._exe = Executor()
     return scales
 
 
