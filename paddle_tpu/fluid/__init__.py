@@ -20,12 +20,16 @@ from .. import optimizer as _opt
 from . import layers
 from . import dygraph
 from . import io
+from .transpiler import (DistributeTranspiler,  # noqa: F401
+                         DistributeTranspilerConfig)
+from . import transpiler  # noqa: F401
 
 __all__ = ["layers", "dygraph", "io", "Program", "program_guard",
            "default_main_program", "default_startup_program", "Executor",
            "global_scope", "CPUPlace", "CUDAPlace", "TPUPlace",
            "ParamAttr", "optimizer", "initializer", "regularizer",
-           "core"]
+           "core", "transpiler", "DistributeTranspiler",
+           "DistributeTranspilerConfig"]
 
 from ..nn.param_attr import ParamAttr
 from ..nn import initializer

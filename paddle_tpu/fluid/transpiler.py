@@ -1,0 +1,217 @@
+"""fluid.DistributeTranspiler compat shim (ref
+transpiler/distribute_transpiler.py:256).
+
+The 1.x PS idiom:
+
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id, pservers="127.0.0.1:6170", trainers=2)
+    if role == "PSERVER":
+        exe.run(t.get_startup_program(ep))
+        exe.run(t.get_pserver_program(ep))          # serves, then returns
+    else:
+        prog = t.get_trainer_program()
+        for batch: exe.run(prog, feed=..., fetch_list=[loss])
+
+maps here onto the fleet/PS runtime (native TCP PsServer,
+native/src/ps_server.cc) WITHOUT desc surgery: the trainer runs the
+full local program (its optimizer ops included) against params pulled
+from the server and pushes the parameter DELTA back — exactly the
+transpiler's geo/a_sync semantics (ref geo_sgd_transpiler; with
+sync_mode a barrier closes every step, the ref's sync grad path).
+Dense persistables only — sparse/selected-rows PS training uses the
+fleet API (fleet/ps.py), the 2.x home the reference itself moved to.
+"""
+import atexit
+
+import numpy as np
+
+
+class DistributeTranspilerConfig:
+    """ref DistributeTranspilerConfig — accepted, recorded; splitting
+    knobs are meaningless for the single-dense-table shim."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.sync_mode = None
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
+        self.runtime_split_send_recv = False
+        self.wait_port = True
+
+
+class _InertProgram:
+    """get_startup_program result: running it is a no-op (tables are
+    created/initialised by the server/trainer-0 paths)."""
+
+    def _pt_transpiler_run(self, exe, feed, fetch_list):
+        return []
+
+
+class _PServerProgram:
+    """exe.run(pserver_program): start the native server on the
+    endpoint's port and serve until every expected trainer has either
+    completed or gone silent past the liveness timeout."""
+
+    def __init__(self, t, endpoint):
+        self._t = t
+        self._endpoint = endpoint
+
+    def _pt_transpiler_run(self, exe, feed, fetch_list):
+        import time
+        from ..distributed.fleet import ps as ps_mod
+
+        t = self._t
+        port = int(self._endpoint.rsplit(":", 1)[1])
+        srv = ps_mod.PsServer()
+        srv.add_dense_table(0, t._codec.total, lr=1.0)  # delta push
+        srv.start(port)
+        srv.set_heartbeat_timeout(t._heartbeat_timeout_s)
+        t._server = srv
+        try:
+            # serve until all trainers registered AND none still running;
+            # give up if nobody registers within a generous window (a
+            # crashed trainer fleet must not wedge the server forever)
+            seen_any = False
+            reg_deadline = time.time() + 120.0
+            while True:
+                time.sleep(0.2)
+                client = getattr(self, "_mon", None)
+                if client is None:
+                    client = self._mon = ps_mod.PsClient(port=port)
+                run, comp, dead = client.query_workers()
+                total = run + comp + dead
+                if total >= t._trainers:
+                    seen_any = True
+                if seen_any and run == 0:
+                    break
+                if not seen_any and time.time() > reg_deadline:
+                    raise TimeoutError(
+                        f"pserver: no trainers registered within 120s "
+                        f"(expected {t._trainers})")
+        finally:
+            srv.stop()
+            t._server = None
+        return []
+
+
+class _TrainerProgram:
+    """Wraps the user's main program: params live on the PS. Every
+    exe.run pulls the dense block, runs the FULL local program (the
+    optimizer ops the user's minimize() appended included), pushes the
+    resulting parameter delta, and (sync_mode) barriers the step."""
+
+    def __init__(self, t):
+        self._t = t
+        self._client = None
+
+    def __getattr__(self, name):                # delegate program surface
+        return getattr(self._t._program, name)
+
+    def _connect(self):
+        import time
+        from ..distributed.fleet import ps as ps_mod
+        t = self._t
+        host, port = t._pserver_eps[0].rsplit(":", 1)
+        # wait_port (ref transpile's wait_port=True): the pserver role
+        # may still be building its program — retry until it binds
+        deadline = time.time() + (60.0 if t.config.wait_port else 0.0)
+        while True:
+            try:
+                self._client = ps_mod.PsClient(host=host, port=int(port))
+                break
+            except ConnectionError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.2)
+        # start_heartbeat registers the worker itself
+        self._stop_beat = self._client.start_heartbeat(t._trainer_id)
+        if t._trainer_id == 0:
+            self._client.set_dense(0, t._codec.flatten(self._params()))
+        self._client.barrier(t._trainers, worker_id=t._trainer_id)
+
+        def _finish(client=self._client, tid=t._trainer_id,
+                    stop=self._stop_beat):
+            try:
+                stop()
+                client.complete_worker(tid)
+            except Exception:
+                pass
+        self._finish = _finish
+        atexit.register(_finish)
+
+    def _params(self):
+        prog = self._t._program
+        return {n: np.asarray(prog._persist[n]._data)
+                for n in self._t._codec.names}
+
+    def _pt_transpiler_run(self, exe, feed, fetch_list):
+        import jax.numpy as jnp
+        t = self._t
+        if self._client is None:
+            self._connect()
+        base = self._client.pull_dense(0, t._codec.total)
+        for n, arr in t._codec.unflatten(base).items():
+            t._program._persist[n]._data = jnp.asarray(arr)
+        outs = exe.run(t._program, feed=feed, fetch_list=fetch_list)
+        delta = t._codec.flatten(self._params()) - base
+        self._client.push_dense_delta(0, delta)
+        if t._sync_mode:
+            self._client.barrier(t._trainers, worker_id=t._trainer_id)
+        return outs
+
+    def complete(self):
+        """Optional explicit teardown (atexit covers script exit)."""
+        if self._client is not None:
+            self._finish()
+            atexit.unregister(self._finish)
+            self._client = None
+
+
+class DistributeTranspiler:
+    """ref transpiler/distribute_transpiler.py:256 — the 1.x entry
+    point, so fluid-era PS scripts port unmodified the way fluid
+    trainer scripts already do (test_fluid_compat.py)."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._server = None
+        self._heartbeat_timeout_s = 10.0
+
+    def transpile(self, trainer_id, program=None, pservers="",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint=None):
+        from ..static import default_main_program
+        from ..distributed.fleet.ps import _ParamCodec
+        self._trainer_id = int(trainer_id)
+        self._program = program or default_main_program()
+        self._pserver_eps = [e.strip() for e in pservers.split(",")
+                             if e.strip()]
+        if len(self._pserver_eps) != 1:
+            raise NotImplementedError(
+                "DistributeTranspiler shim serves ONE dense table from "
+                "one pserver endpoint; multi-server/sharded-table PS "
+                "training uses the fleet API (paddle.distributed.fleet)")
+        self._trainers = int(trainers)
+        self._sync_mode = bool(sync_mode)
+        params = {n: np.asarray(tsr._data)
+                  for n, tsr in self._program._persist.items()}
+        if not params:
+            raise ValueError(
+                "transpile(): program has no persistable parameters — "
+                "build the model (and call minimize) before transpiling")
+        self._codec = _ParamCodec(params)
+
+    def get_trainer_program(self, wait_port=True):
+        return _TrainerProgram(self)
+
+    def get_pserver_program(self, endpoint):
+        return _PServerProgram(self, endpoint)
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint), _InertProgram()
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        return _InertProgram()

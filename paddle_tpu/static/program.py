@@ -498,6 +498,11 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True):
         program_obj = program
+        if hasattr(program_obj, "_pt_transpiler_run"):
+            # DistributeTranspiler shim programs (fluid/transpiler.py):
+            # pserver serve-loops, trainer pulls/pushes around the real run
+            return program_obj._pt_transpiler_run(self, feed or {},
+                                                  fetch_list or [])
         if isinstance(program_obj, CompiledProgram):
             program = program_obj.program
         else:

@@ -1,0 +1,116 @@
+"""fluid.DistributeTranspiler compat shim (ref
+transpiler/distribute_transpiler.py:256): a 1.x-era PS script — build
+program + minimize, transpile, run pserver role and trainer roles
+through plain exe.run — ports unmodified and CONVERGES, params living
+on the native PS server."""
+import threading
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import fluid
+from paddle_tpu import static
+
+
+def _onex_style_ps_script(port, trainers=2, steps=30, sync_mode=True):
+    """The reference's dist fit-a-line shape: y = xW+b, sgd minimize,
+    DistributeTranspiler roles. Every role runs the SAME build code —
+    exactly how 1.x scripts are written."""
+    rng = np.random.RandomState(0)
+    true_w = rng.randn(8, 1).astype("f4")
+    xs = rng.randn(512, 8).astype("f4")
+    ys = xs @ true_w + 0.1
+
+    results = {}
+
+    def run_role(role, trainer_id=0):
+        prog = static.Program()
+        startup = static.Program()
+        with static.program_guard(prog, startup):
+            fluid.layers.reset_parameters()
+            x = static.data("x", [None, 8], "float32")
+            label = static.data("label", [None, 1], "float32")
+            pred = fluid.layers.fc(x, size=1, name="fit")
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, label))
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+        t = fluid.DistributeTranspiler()
+        t.transpile(trainer_id, program=prog,
+                    pservers=f"127.0.0.1:{port}", trainers=trainers,
+                    sync_mode=sync_mode)
+        exe = static.Executor()
+        if role == "PSERVER":
+            t._heartbeat_timeout_s = 3.0
+            ep = f"127.0.0.1:{port}"
+            exe.run(t.get_startup_program(ep))
+            exe.run(t.get_pserver_program(ep))     # serves, then returns
+            results["server_done"] = True
+            return
+        trainer_prog = t.get_trainer_program()
+        lname = prog.recorder.name_of(loss)
+        rw = np.random.RandomState(trainer_id)
+        losses = []
+        try:
+            for _ in range(steps):
+                idx = rw.randint(0, len(xs), 64)
+                (lv,) = exe.run(trainer_prog,
+                                feed={"x": xs[idx], "label": ys[idx]},
+                                fetch_list=[lname])
+                losses.append(float(lv))
+        finally:
+            # a crashed trainer must still COMPLETE, or the server keeps
+            # serving its live heartbeat until the liveness timeout
+            trainer_prog.complete()
+        results[f"trainer{trainer_id}"] = losses
+
+    # daemon threads: an assertion failure in any role must not block
+    # interpreter shutdown behind a still-serving thread
+    server = threading.Thread(target=run_role, args=("PSERVER",),
+                              daemon=True)
+    server.start()
+    import time
+    time.sleep(0.5)
+    workers = [threading.Thread(target=run_role, args=("TRAINER", i),
+                                daemon=True)
+               for i in range(trainers)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=120)
+    server.join(timeout=30)
+    return results
+
+
+def test_onex_ps_script_converges():
+    import os
+    port = 40600 + os.getpid() % 1000
+    r = _onex_style_ps_script(port)
+    assert r.get("server_done"), "pserver never finished serving"
+    for tid in (0, 1):
+        losses = r[f"trainer{tid}"]
+        assert losses[-1] < losses[0] * 0.2, (tid, losses[::8])
+
+
+def test_transpile_requires_params():
+    prog = static.Program()
+    with static.program_guard(prog):
+        static.data("x", [None, 4], "float32")
+    t = fluid.DistributeTranspiler()
+    import pytest
+    with pytest.raises(ValueError, match="persistable"):
+        t.transpile(0, program=prog, pservers="127.0.0.1:1", trainers=1)
+
+
+def test_multi_pserver_rejected_with_guidance():
+    prog = static.Program()
+    with static.program_guard(prog):
+        fluid.layers.reset_parameters()
+        x = static.data("x", [None, 4], "float32")
+        fluid.layers.fc(x, size=2)
+    t = fluid.DistributeTranspiler(
+        config=fluid.DistributeTranspilerConfig())
+    import pytest
+    with pytest.raises(NotImplementedError, match="fleet"):
+        t.transpile(0, program=prog,
+                    pservers="127.0.0.1:1,127.0.0.1:2", trainers=2)
