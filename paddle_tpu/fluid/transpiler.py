@@ -45,7 +45,7 @@ class _InertProgram:
     """get_startup_program result: running it is a no-op (tables are
     created/initialised by the server/trainer-0 paths)."""
 
-    def _pt_transpiler_run(self, exe, feed, fetch_list):
+    def _pt_transpiler_run(self, exe, feed, fetch_list, **run_kw):
         return []
 
 
@@ -58,7 +58,7 @@ class _PServerProgram:
         self._t = t
         self._endpoint = endpoint
 
-    def _pt_transpiler_run(self, exe, feed, fetch_list):
+    def _pt_transpiler_run(self, exe, feed, fetch_list, **run_kw):
         import time
         from ..distributed.fleet import ps as ps_mod
 
@@ -102,11 +102,16 @@ class _TrainerProgram:
     optimizer ops the user's minimize() appended included), pushes the
     resulting parameter delta, and (sync_mode) barriers the step."""
 
-    def __init__(self, t):
+    def __init__(self, t, wait_port=True):
         self._t = t
+        self._wait_port = wait_port
         self._client = None
 
     def __getattr__(self, name):                # delegate program surface
+        if name.startswith("_"):
+            # never delegate internals: an instance materialised without
+            # __init__ (copy/pickle) would otherwise recurse on self._t
+            raise AttributeError(name)
         return getattr(self._t._program, name)
 
     def _connect(self):
@@ -116,7 +121,8 @@ class _TrainerProgram:
         host, port = t._pserver_eps[0].rsplit(":", 1)
         # wait_port (ref transpile's wait_port=True): the pserver role
         # may still be building its program — retry until it binds
-        deadline = time.time() + (60.0 if t.config.wait_port else 0.0)
+        wait = self._wait_port and t.config.wait_port
+        deadline = time.time() + (60.0 if wait else 0.0)
         while True:
             try:
                 self._client = ps_mod.PsClient(host=host, port=int(port))
@@ -146,7 +152,7 @@ class _TrainerProgram:
         return {n: np.asarray(prog._persist[n]._data)
                 for n in self._t._codec.names}
 
-    def _pt_transpiler_run(self, exe, feed, fetch_list):
+    def _pt_transpiler_run(self, exe, feed, fetch_list, **run_kw):
         import jax.numpy as jnp
         t = self._t
         if self._client is None:
@@ -154,7 +160,8 @@ class _TrainerProgram:
         base = self._client.pull_dense(0, t._codec.total)
         for n, arr in t._codec.unflatten(base).items():
             t._program._persist[n]._data = jnp.asarray(arr)
-        outs = exe.run(t._program, feed=feed, fetch_list=fetch_list)
+        outs = exe.run(t._program, feed=feed, fetch_list=fetch_list,
+                       **run_kw)
         delta = t._codec.flatten(self._params()) - base
         self._client.push_dense_delta(0, delta)
         if t._sync_mode:
@@ -204,7 +211,7 @@ class DistributeTranspiler:
         self._codec = _ParamCodec(params)
 
     def get_trainer_program(self, wait_port=True):
-        return _TrainerProgram(self)
+        return _TrainerProgram(self, wait_port=wait_port)
 
     def get_pserver_program(self, endpoint):
         return _PServerProgram(self, endpoint)

@@ -108,11 +108,12 @@ def _bwd(ignore_index, chunk, res, g):
             col = i * chunk + jnp.arange(chunk)[None, :]
             logits = jnp.where(col < v, logits, -jnp.inf)
         p = jnp.exp(logits - lse[:, None])               # softmax chunk
+        # one-hot via broadcasted iota compare — elementwise, so XLA fuses
+        # it into the dl chain (a scatter here materialises a full [N, C]
+        # f32 zeros+update round-trip through HBM per chunk)
         loc = labels - i * chunk
-        in_c = (loc >= 0) & (loc < chunk)
-        onehot_col = jnp.clip(loc, 0, chunk - 1)
-        sub = jnp.zeros_like(p).at[jnp.arange(N), onehot_col].set(
-            in_c.astype(jnp.float32))
+        cols = jax.lax.broadcasted_iota(jnp.int32, (N, chunk), 1)
+        sub = (cols == loc[:, None]).astype(jnp.float32)
         dl = (p - sub) * scale[:, None]                  # [N, C]
         dh = dh + jax.lax.dot_general(
             dl, wc.astype(jnp.float32), (((1,), (0,)), ((), ())),

@@ -53,6 +53,15 @@ def _pad_dim(d):
     return d if d == 64 else max(128, ((d + 127) // 128) * 128)
 
 
+
+def _pack(d_pad, h):
+    """BSHD head-group packing rule (single source of truth for fwd, bwd
+    and eligibility): heads per program, group count, lane width. d=64
+    packs head PAIRS into the 128-lane tile; d_pad >= 128 maps 1:1."""
+    gsz = 2 if d_pad == 64 else 1
+    return gsz, h // gsz, gsz * d_pad
+
+
 def _sdpa_reference(q, k, v, mask, causal, scale):
     """Fused XLA path — also the recompute body for the backward pass.
     Softmax statistics in f32 regardless of input dtype."""
@@ -74,82 +83,80 @@ def _sdpa_reference(q, k, v, mask, causal, scale):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
-def _q2(ref, bshd):
-    """Whole-block 2-D view: refs are [1, BQ, D] (collapsed BHSD layout)
-    or [1, BQ, 1, D] (native BSHD layout, head dim blocked to 1)."""
-    return ref[0, :, 0, :] if bshd else ref[0]
+def _q2(ref, g, d):
+    """Whole-block 2-D view of head g: refs are [1, BQ, G*D] — the BSHD
+    path packs G heads into the lane dim (G*D is a 128 multiple, which is
+    what makes the block Mosaic-legal); the BHSD path is the G=1, full-
+    lane case of the same layout."""
+    return ref[0, :, g * d:(g + 1) * d]
 
 
-def _kslice(ref, start, size, bshd):
+def _kslice(ref, start, size, g, d):
     from jax.experimental import pallas as pl
-    if bshd:
-        return ref[0, pl.ds(start, size), 0, :]
-    return ref[0, pl.ds(start, size), :]
-
-
-def _w2(ref, val, bshd):
-    if bshd:
-        ref[0, :, 0, :] = val
-    else:
-        ref[0] = val
+    return ref[0, pl.ds(start, size), g * d:(g + 1) * d]
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                kv_len, q_len, bk, bshd=False):
-    """One (batch*head, q-block) program: stream K/V blocks, online softmax.
-    Also writes the per-row log-sum-exp (softmax stats) so the flash
-    backward kernel can recompute P tiles without re-reducing."""
+                kv_len, q_len, bk, dp, gsz=1):
+    """One (batch*head-group, q-block) program: stream K/V blocks, online
+    softmax. Also writes the per-row log-sum-exp (softmax stats) so the
+    flash backward kernel can recompute P tiles without re-reducing.
+    gsz heads live side-by-side in the lane dim (static unroll)."""
     from jax.experimental import pallas as pl
 
-    q = _q2(q_ref, bshd).astype(jnp.float32) * scale  # [BQ, D]
-    bq = q.shape[0]
-    d = q.shape[1]
+    bq = q_ref.shape[1]
     nblocks = kv_len // bk
     qblk = pl.program_id(1)
+    outs = []
+    for g in range(gsz):
+        q = _q2(q_ref, g, dp).astype(jnp.float32) * scale  # [BQ, D]
 
-    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
+        m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((bq, 1), jnp.float32)
+        acc0 = jnp.zeros((bq, dp), jnp.float32)
 
-    def body(j, carry):
-        m, l, acc = carry
-        kblk = _kslice(k_ref, j * bk, bk, bshd).astype(jnp.float32)
-        vblk = _kslice(v_ref, j * bk, bk, bshd).astype(jnp.float32)
-        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [BQ,BK]
+        def body(j, carry):
+            m, l, acc = carry
+            kblk = _kslice(k_ref, j * bk, bk, g, dp).astype(jnp.float32)
+            vblk = _kslice(v_ref, j * bk, bk, g, dp).astype(jnp.float32)
+            s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if causal:
+                # absolute query position includes the (klen - qlen) decode
+                # offset so semantics match _sdpa_reference for sq != sk
+                q_idx = ((kv_len - q_len) + qblk * bq
+                         + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+                k_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                          (bq, bk), 1)
+                s = jnp.where(k_idx <= q_idx, s, -jnp.inf)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            # guard fully-masked rows (m_new = -inf): shift by 0 there
+            shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.exp(s - shift)
+            alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - shift, -jnp.inf))
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + jax.lax.dot_general(
+                p, vblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
         if causal:
-            # absolute query position includes the (klen - qlen) decode offset
-            # so semantics match _sdpa_reference for sq != sk
-            q_idx = (kv_len - q_len) + qblk * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32,
-                                                      (bq, bk), 1)
-            s = jnp.where(k_idx <= q_idx, s, -jnp.inf)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # guard fully-masked rows (m_new = -inf): shift by 0 there
-        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - shift)
-        alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - shift, -jnp.inf))
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
-
-    if causal:
-        # only blocks up to (and including) the diagonal contribute
-        diag = kv_len - q_len + (qblk + 1) * bq
-        upper = jnp.minimum(nblocks, (diag + bk - 1) // bk)
-        m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    else:
-        m, l, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, acc0))
-    _w2(o_ref, (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype), bshd)
-    # lse = m + log l (finite-m guard matches the shift guard above).
-    # lse_ref holds the FULL [1, q_len] row (TPU block constraint: last two
-    # dims must be 8/128-divisible or whole); each q-block program writes
-    # its slice — grid iterations are sequential so this is race-free.
-    lse = jnp.where(jnp.isfinite(m), m, 0.0) + jnp.log(jnp.maximum(l, 1e-30))
-    lse_ref[0, 0, pl.ds(qblk * bq, bq)] = lse[:, 0]
+            # only blocks up to (and including) the diagonal contribute
+            diag = kv_len - q_len + (qblk + 1) * bq
+            upper = jnp.minimum(nblocks, (diag + bk - 1) // bk)
+            m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+        else:
+            m, l, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, acc0))
+        outs.append((acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype))
+        # lse = m + log l (finite-m guard matches the shift guard above).
+        # lse_ref holds FULL [1, gsz, q_len] rows (TPU block constraint:
+        # last two dims must be 8/128-divisible or whole); each q-block
+        # program writes its slice — grid iterations are sequential so
+        # this is race-free.
+        lse = (jnp.where(jnp.isfinite(m), m, 0.0)
+               + jnp.log(jnp.maximum(l, 1e-30)))
+        lse_ref[0, g, pl.ds(qblk * bq, bq)] = lse[:, 0]
+    o_ref[0] = outs[0] if gsz == 1 else jnp.concatenate(outs, axis=-1)
 
 
 def _flash_fwd_pallas(q, k, v, causal, scale, bshd=False):
@@ -157,9 +164,12 @@ def _flash_fwd_pallas(q, k, v, causal, scale, bshd=False):
 
     if bshd:
         # native [B, S, H, D] layout: no q/k/v transposes feed the kernel —
-        # the BlockSpec index maps stride over the head axis instead
-        # (kills the ~10ms/step of bf16 layout transposes the BHSD path
-        # pays at the bench config; PERF.md "qkv/attention transposes")
+        # the array is viewed as [B, S, H*D] (a FREE reshape: contiguous
+        # collapse) and heads are packed into 128-lane groups so the block
+        # shape stays Mosaic-legal (a size-1 head-axis block is not: the
+        # last two block dims must be 8/128-divisible or whole). Kills the
+        # ~10ms/step of bf16 layout transposes the BHSD path pays at the
+        # bench config; PERF.md "qkv/attention transposes".
         b, sq, h, d = q.shape
         sk = k.shape[1]
     else:
@@ -175,134 +185,152 @@ def _flash_fwd_pallas(q, k, v, causal, scale, bshd=False):
         q = jnp.pad(q, pad)
         k = jnp.pad(k, pad)
         v = jnp.pad(v, pad)
+    bq_ = _blk(_BQ, sq)
     if bshd:
-        qr, kr, vr = q, k, v
-        q_spec = pl.BlockSpec((1, bq_ := _blk(_BQ, sq), 1, d_pad),
-                              lambda bh, i: (bh // h, i, bh % h, 0))
-        kv_spec = pl.BlockSpec((1, sk, 1, d_pad),
-                               lambda bh, i: (bh // h, 0, bh % h, 0))
-        o_shape = _sds((b, sq, h, d_pad), q.dtype, q, k, v)
+        gsz, ngrp, lane = _pack(d_pad, h)
+        qr = q.reshape(b, sq, h * d_pad)
+        kr = k.reshape(b, sk, h * d_pad)
+        vr = v.reshape(b, sk, h * d_pad)
+        q_spec = pl.BlockSpec((1, bq_, lane),
+                              lambda bg, i: (bg // ngrp, i, bg % ngrp))
+        kv_spec = pl.BlockSpec((1, sk, lane),
+                               lambda bg, i: (bg // ngrp, 0, bg % ngrp))
+        o_shape = _sds((b, sq, h * d_pad), q.dtype, qr, kr, vr)
+        nprog = b * ngrp
     else:
+        gsz, ngrp = 1, h
         qr = q.reshape(b * h, sq, d_pad)
         kr = k.reshape(b * h, sk, d_pad)
         vr = v.reshape(b * h, sk, d_pad)
-        bq_ = _blk(_BQ, sq)
         q_spec = pl.BlockSpec((1, bq_, d_pad), lambda bh, i: (bh, i, 0))
         kv_spec = pl.BlockSpec((1, sk, d_pad), lambda bh, i: (bh, 0, 0))
         o_shape = _sds((b * h, sq, d_pad), q.dtype, qr, kr, vr)
+        nprog = b * h
 
     interpret = jax.default_backend() == "cpu"
     bk_ = _blk(_BK, sk)
     kernel = functools.partial(_fwd_kernel, scale=s, causal=causal,
-                               kv_len=sk, q_len=sq, bk=bk_, bshd=bshd)
+                               kv_len=sk, q_len=sq, bk=bk_, dp=d_pad,
+                               gsz=gsz)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // bq_),
+        grid=(nprog, sq // bq_),
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[
             q_spec,
-            pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, gsz, sq), lambda bh, i: (bh, 0, 0)),
         ],
         out_shape=[
             o_shape,
-            _sds((b * h, 1, sq), jnp.float32, qr, kr, vr),
+            _sds((nprog, gsz, sq), jnp.float32, qr, kr, vr),
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    if not bshd:
+    if bshd:
+        out = out.reshape(b, sq, h, d_pad)
+    else:
         out = out.reshape(b, h, sq, d_pad)
     return (out[..., :d] if d != d_pad else out), lse
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
                     dk_ref, dv_ref, *, scale, causal, kv_len, q_len,
-                    bq, bk, bshd=False):
-    """One (batch*head, k-block) program: accumulate dK/dV over q blocks.
-    P tiles are recomputed from saved lse; dd is rowsum(dO * O)."""
+                    bq, bk, dp, gsz=1):
+    """One (batch*head-group, k-block) program: accumulate dK/dV over q
+    blocks. P tiles are recomputed from saved lse; dd is rowsum(dO * O)."""
     from jax.experimental import pallas as pl
 
-    kblk = _q2(k_ref, bshd).astype(jnp.float32)     # [BK, D]
-    vblk = _q2(v_ref, bshd).astype(jnp.float32)
     kb = pl.program_id(1)
     nqb = q_len // bq
-    d = kblk.shape[1]
+    dks, dvs = [], []
+    for g in range(gsz):
+        kblk = _q2(k_ref, g, dp).astype(jnp.float32)     # [BK, D]
+        vblk = _q2(v_ref, g, dp).astype(jnp.float32)
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
+        dk0 = jnp.zeros((bk, dp), jnp.float32)
+        dv0 = jnp.zeros((bk, dp), jnp.float32)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = _kslice(q_ref, i * bq, bq, bshd).astype(jnp.float32)
-        do = _kslice(do_ref, i * bq, bq, bshd).astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * bq, bq)].reshape(bq, 1)
-        dd = dd_ref[0, 0, pl.ds(i * bq, bq)].reshape(bq, 1)
-        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lse)                        # [BQ, BK]
+        def body(i, carry):
+            dk, dv = carry
+            q = _kslice(q_ref, i * bq, bq, g, dp).astype(jnp.float32)
+            do = _kslice(do_ref, i * bq, bq, g, dp).astype(jnp.float32)
+            lse = lse_ref[0, g, pl.ds(i * bq, bq)].reshape(bq, 1)
+            dd = dd_ref[0, g, pl.ds(i * bq, bq)].reshape(bq, 1)
+            s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32
+                                    ) * scale
+            p = jnp.exp(s - lse)                        # [BQ, BK]
+            if causal:
+                q_idx = ((kv_len - q_len) + i * bq
+                         + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+                k_idx = kb * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                           (bq, bk), 1)
+                p = jnp.where(k_idx <= q_idx, p, 0.0)
+            dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+            dp_ = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            ds = p * (dp_ - dd) * scale                 # [BQ, BK]
+            dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+            return dk, dv
+
         if causal:
-            q_idx = (kv_len - q_len) + i * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_idx = kb * bk + jax.lax.broadcasted_iota(jnp.int32,
-                                                       (bq, bk), 1)
-            p = jnp.where(k_idx <= q_idx, p, 0.0)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - dd) * scale                  # [BQ, BK]
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
-
-    if causal:
-        # first q block whose last row reaches this k block's first row
-        start = jnp.maximum(0, (kb * bk - (kv_len - q_len)) // bq)
-        dk, dv = jax.lax.fori_loop(start, nqb, body, (dk0, dv0))
-    else:
-        dk, dv = jax.lax.fori_loop(0, nqb, body, (dk0, dv0))
-    _w2(dk_ref, dk.astype(dk_ref.dtype), bshd)
-    _w2(dv_ref, dv.astype(dv_ref.dtype), bshd)
+            # first q block whose last row reaches this k block's first row
+            start = jnp.maximum(0, (kb * bk - (kv_len - q_len)) // bq)
+            dk, dv = jax.lax.fori_loop(start, nqb, body, (dk0, dv0))
+        else:
+            dk, dv = jax.lax.fori_loop(0, nqb, body, (dk0, dv0))
+        dks.append(dk.astype(dk_ref.dtype))
+        dvs.append(dv.astype(dv_ref.dtype))
+    dk_ref[0] = dks[0] if gsz == 1 else jnp.concatenate(dks, axis=-1)
+    dv_ref[0] = dvs[0] if gsz == 1 else jnp.concatenate(dvs, axis=-1)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
-                   scale, causal, kv_len, q_len, bq, bk, bshd=False):
-    """One (batch*head, q-block) program: accumulate dQ over k blocks."""
+                   scale, causal, kv_len, q_len, bq, bk, dp, gsz=1):
+    """One (batch*head-group, q-block) program: accumulate dQ over k
+    blocks."""
     from jax.experimental import pallas as pl
 
-    q = _q2(q_ref, bshd).astype(jnp.float32)        # [BQ, D]
-    do = _q2(do_ref, bshd).astype(jnp.float32)
     qblk = pl.program_id(1)
-    lse = lse_ref[0, 0, pl.ds(qblk * bq, bq)].reshape(bq, 1)
-    dd = dd_ref[0, 0, pl.ds(qblk * bq, bq)].reshape(bq, 1)
     nkb = kv_len // bk
-    d = q.shape[1]
-    dq0 = jnp.zeros((bq, d), jnp.float32)
+    dqs = []
+    for g in range(gsz):
+        q = _q2(q_ref, g, dp).astype(jnp.float32)        # [BQ, D]
+        do = _q2(do_ref, g, dp).astype(jnp.float32)
+        lse = lse_ref[0, g, pl.ds(qblk * bq, bq)].reshape(bq, 1)
+        dd = dd_ref[0, g, pl.ds(qblk * bq, bq)].reshape(bq, 1)
+        dq0 = jnp.zeros((bq, dp), jnp.float32)
 
-    def body(j, dq):
-        kblk = _kslice(k_ref, j * bk, bk, bshd).astype(jnp.float32)
-        vblk = _kslice(v_ref, j * bk, bk, bshd).astype(jnp.float32)
-        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lse)
+        def body(j, dq):
+            kblk = _kslice(k_ref, j * bk, bk, g, dp).astype(jnp.float32)
+            vblk = _kslice(v_ref, j * bk, bk, g, dp).astype(jnp.float32)
+            s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32
+                                    ) * scale
+            p = jnp.exp(s - lse)
+            if causal:
+                q_idx = ((kv_len - q_len) + qblk * bq
+                         + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+                k_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32,
+                                                          (bq, bk), 1)
+                p = jnp.where(k_idx <= q_idx, p, 0.0)
+            dp_ = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            ds = p * (dp_ - dd) * scale
+            return dq + jax.lax.dot_general(
+                ds, kblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
         if causal:
-            q_idx = (kv_len - q_len) + qblk * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, bk), 0)
-            k_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32,
-                                                      (bq, bk), 1)
-            p = jnp.where(k_idx <= q_idx, p, 0.0)
-        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - dd) * scale
-        return dq + jax.lax.dot_general(ds, kblk, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
-
-    if causal:
-        diag = kv_len - q_len + (qblk + 1) * bq
-        upper = jnp.minimum(nkb, (diag + bk - 1) // bk)
-        dq = jax.lax.fori_loop(0, upper, body, dq0)
-    else:
-        dq = jax.lax.fori_loop(0, nkb, body, dq0)
-    _w2(dq_ref, dq.astype(dq_ref.dtype), bshd)
+            diag = kv_len - q_len + (qblk + 1) * bq
+            upper = jnp.minimum(nkb, (diag + bk - 1) // bk)
+            dq = jax.lax.fori_loop(0, upper, body, dq0)
+        else:
+            dq = jax.lax.fori_loop(0, nkb, body, dq0)
+        dqs.append(dq.astype(dq_ref.dtype))
+    dq_ref[0] = dqs[0] if gsz == 1 else jnp.concatenate(dqs, axis=-1)
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd=False):
@@ -322,24 +350,30 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd=False):
         q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
         out, g = jnp.pad(out, pad), jnp.pad(g, pad)
     if bshd:
-        qr, kr, vr, dor = q, k, v, g
-        # dd = rowsum(dO * O) in [B*H, 1, S] layout (tiny f32 transpose)
+        gsz, ngrp, lane = _pack(d_pad, h)
+        qr = q.reshape(b, sq, h * d_pad)
+        kr = k.reshape(b, sk, h * d_pad)
+        vr = v.reshape(b, sk, h * d_pad)
+        dor = g.reshape(b, sq, h * d_pad)
+        # dd = rowsum(dO * O) in [B*G, gsz, S] layout (tiny f32 transpose)
         dd = jnp.swapaxes(
             jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1), 1, 2).reshape(b * h, 1, sq)
+                    axis=-1), 1, 2).reshape(b * ngrp, gsz, sq)
 
         def qspec(blk):
-            return pl.BlockSpec((1, blk, 1, d_pad),
-                                lambda bh, i: (bh // h, i, bh % h, 0))
+            return pl.BlockSpec((1, blk, lane),
+                                lambda bg, i: (bg // ngrp, i, bg % ngrp))
 
         def fullspec(n):
-            return pl.BlockSpec((1, n, 1, d_pad),
-                                lambda bh, i: (bh // h, 0, bh % h, 0))
+            return pl.BlockSpec((1, n, lane),
+                                lambda bg, i: (bg // ngrp, 0, bg % ngrp))
 
-        dkv_shape = [_sds((b, sk, h, d_pad), k.dtype, qr, kr, vr, dor),
-                     _sds((b, sk, h, d_pad), v.dtype, qr, kr, vr, dor)]
-        dq_shape = _sds((b, sq, h, d_pad), q.dtype, qr, kr, vr, dor)
+        dkv_shape = [_sds((b, sk, h * d_pad), k.dtype, qr, kr, vr, dor),
+                     _sds((b, sk, h * d_pad), v.dtype, qr, kr, vr, dor)]
+        dq_shape = _sds((b, sq, h * d_pad), q.dtype, qr, kr, vr, dor)
+        nprog = b * ngrp
     else:
+        gsz, ngrp = 1, h
         qr = q.reshape(b * h, sq, d_pad)
         kr = k.reshape(b * h, sk, d_pad)
         vr = v.reshape(b * h, sk, d_pad)
@@ -358,14 +392,16 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd=False):
         dkv_shape = [_sds((b * h, sk, d_pad), k.dtype, qr, kr, vr, dor),
                      _sds((b * h, sk, d_pad), v.dtype, qr, kr, vr, dor)]
         dq_shape = _sds((b * h, sq, d_pad), q.dtype, qr, kr, vr, dor)
+        nprog = b * h
 
-    lse_spec = pl.BlockSpec((1, 1, sq), lambda bh, i: (bh, 0, 0))
+    lse_spec = pl.BlockSpec((1, gsz, sq), lambda bh, i: (bh, 0, 0))
     interpret = jax.default_backend() == "cpu"
     bq_, bk_ = _blk(_BQ, sq), _blk(_BK, sk)
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=s, causal=causal,
-                          kv_len=sk, q_len=sq, bq=bq_, bk=bk_, bshd=bshd),
-        grid=(b * h, sk // bk_),
+                          kv_len=sk, q_len=sq, bq=bq_, bk=bk_, dp=d_pad,
+                          gsz=gsz),
+        grid=(nprog, sk // bk_),
         in_specs=[fullspec(sq), qspec(bk_), qspec(bk_), fullspec(sq),
                   lse_spec, lse_spec],
         out_specs=[qspec(bk_), qspec(bk_)],
@@ -376,8 +412,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd=False):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=s, causal=causal,
-                          kv_len=sk, q_len=sq, bq=bq_, bk=bk_, bshd=bshd),
-        grid=(b * h, sq // bq_),
+                          kv_len=sk, q_len=sq, bq=bq_, bk=bk_, dp=d_pad,
+                          gsz=gsz),
+        grid=(nprog, sq // bq_),
         in_specs=[qspec(bq_), fullspec(sk), fullspec(sk), qspec(bq_),
                   lse_spec, lse_spec],
         out_specs=qspec(bq_),
@@ -385,7 +422,11 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd=False):
         interpret=interpret,
     )(qr, kr, vr, dor, lse, dd)
 
-    if not bshd:
+    if bshd:
+        dq = dq.reshape(b, sq, h, d_pad)
+        dk = dk.reshape(b, sk, h, d_pad)
+        dv = dv.reshape(b, sk, h, d_pad)
+    else:
         dq = dq.reshape(b, h, sq, d_pad)
         dk = dk.reshape(b, h, sk, d_pad)
         dv = dv.reshape(b, h, sk, d_pad)
@@ -409,6 +450,10 @@ def _kernel_eligible(q, k, mask, dropout_p, bshd=False):
         if vma:
             return False
     seq_ax = 1 if bshd else 2
+    if bshd and q.shape[2] % _pack(_pad_dim(q.shape[-1]), q.shape[2])[0]:
+        # head-pair lane packing needs an even head count; odd-H models
+        # take the transpose fallback (rare)
+        return False
     sq, sk = q.shape[seq_ax], k.shape[seq_ax]
     return (sq % 128 == 0 and sk % 128 == 0
             and sq >= 128 and sk >= 128)

@@ -501,8 +501,10 @@ class Executor:
         if hasattr(program_obj, "_pt_transpiler_run"):
             # DistributeTranspiler shim programs (fluid/transpiler.py):
             # pserver serve-loops, trainer pulls/pushes around the real run
-            return program_obj._pt_transpiler_run(self, feed or {},
-                                                  fetch_list or [])
+            return program_obj._pt_transpiler_run(
+                self, feed or {}, fetch_list or [], scope=scope,
+                return_numpy=return_numpy,
+                use_program_cache=use_program_cache)
         if isinstance(program_obj, CompiledProgram):
             program = program_obj.program
         else:
