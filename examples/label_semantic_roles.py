@@ -1,0 +1,131 @@
+"""Semantic role labeling with a linear-chain CRF — the reference book
+suite's sequence-labeling stress case (ref
+python/paddle/fluid/tests/book/test_label_semantic_roles.py: word +
+predicate + mark features into a stacked bidirectional recurrent
+encoder, linear_chain_crf training loss, crf_decoding inference),
+written against THIS framework:
+
+  - features embed and concatenate, a bidirectional GRU encodes the
+    padded batch (no LoD: dense [B, T] + lengths, the TPU-native
+    sequence layout used across the text stack);
+  - training minimises the CRF negative log-likelihood
+    (ops/legacy.py linear_chain_crf — one lax.scan forward recursion);
+  - inference is crf_decoding (Viterbi lax.scan) and tag accuracy is
+    measured against the gold tags;
+  - data is text.Conll05st (synthetic SRL: labels are a fixed function
+    of the word ids, so the task is learnable; same sample layout as
+    the real conll05st loader).
+
+    python examples/label_semantic_roles.py [--steps 160]
+
+Prints one JSON line: {"example": ..., "first_loss": ..., "last_loss":
+..., "tag_acc": ...}.
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=160)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--emb", type=int, default=32)
+    args = ap.parse_args()
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.ops.legacy import linear_chain_crf, crf_decoding
+    from paddle_tpu.text import Conll05st
+
+    paddle.seed(11)
+    T = 32
+    train = Conll05st(mode="train", vocab_size=512, seq_len=T,
+                      num_samples=4096)
+    test = Conll05st(mode="test", vocab_size=512, seq_len=T,
+                     num_samples=512)
+    V, N = train.vocab_size, Conll05st.NUM_LABELS
+    H, E = args.hidden, args.emb
+
+    class SRLTagger(nn.Layer):
+        """word + predicate features -> BiGRU -> CRF emissions.
+        transition is a learnable [N+2, N] parameter in the
+        linear_chain_crf layout (row 0 start, 1 stop, 2.. pairwise)."""
+
+        def __init__(self):
+            super().__init__()
+            self.word_emb = nn.Embedding(V, E)
+            self.pred_emb = nn.Embedding(V, E)
+            self.rnn = nn.GRU(2 * E, H, direction="bidirect")
+            self.emit = nn.Linear(2 * H, N)
+            self.transition = self.create_parameter(
+                [N + 2, N],
+                default_initializer=nn.initializer.Normal(std=0.1))
+
+        def forward(self, words, pred):
+            we = self.word_emb(words)                       # [B, T, E]
+            pe = self.pred_emb(pred)                        # [B, E]
+            pe = paddle.tile(pe.unsqueeze(1), [1, T, 1])    # broadcast
+            h, _ = self.rnn(paddle.concat([we, pe], axis=-1))
+            return self.emit(h)                             # [B, T, N]
+
+    model = SRLTagger()
+    opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                parameters=model.parameters())
+
+    lengths_full = np.full((args.batch_size,), T, dtype="int64")
+
+    def loss_fn(emission, labels):
+        lengths = paddle.to_tensor(lengths_full[:emission.shape[0]])
+        nll = linear_chain_crf(emission, model.transition, labels,
+                               lengths)
+        return paddle.mean(nll)
+
+    step = TrainStep(model, loss_fn, opt)
+    loader = DataLoader(train, batch_size=args.batch_size, shuffle=True,
+                        drop_last=True)
+
+    t0 = time.time()
+    first = last = None
+    it = 0
+    while it < args.steps:
+        for words, pred, labels in loader:
+            if it >= args.steps:
+                break
+            loss = step((words, pred), labels)
+            v = float(loss.numpy())
+            if first is None:
+                first = v
+            last = v
+            it += 1
+
+    step.sync()   # write the trained state back into the live Layer
+
+    # ---- crf_decoding tag accuracy on held-out data
+    correct = total = 0
+    eval_loader = DataLoader(test, batch_size=args.batch_size,
+                             drop_last=True)
+    for words, pred, labels in eval_loader:
+        emission = model(paddle.to_tensor(words), paddle.to_tensor(pred))
+        lengths = paddle.to_tensor(lengths_full[:emission.shape[0]])
+        path = crf_decoding(emission, model.transition, lengths)
+        path = np.asarray(path.numpy() if hasattr(path, "numpy")
+                          else path)
+        correct += int((path == np.asarray(labels)).sum())
+        total += path.size
+    acc = correct / max(total, 1)
+
+    print(json.dumps({
+        "example": "label_semantic_roles", "steps": it,
+        "first_loss": round(first, 4), "last_loss": round(last, 4),
+        "tag_acc": round(acc, 4), "secs": round(time.time() - t0, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

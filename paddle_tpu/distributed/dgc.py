@@ -97,9 +97,13 @@ class DGCTrainStep:
 
         def _forward(p, b, key, x, y):
             with state.functional_rng_ctx(key):
-                out, _ = model.functional_call(p, b, *_wrap(x))
-                outs = out if isinstance(out, tuple) else (out,)
-                loss_t = loss_fn(*outs, *_wrap(y))
+                # loss may read model params directly (CRF transitions,
+                # tied heads): keep the traced substitution alive through it
+                # (same fix as jit.TrainStep._forward)
+                with model._use_state(p, b):
+                    out, _ = model.functional_call(p, b, *_wrap(x))
+                    outs = out if isinstance(out, tuple) else (out,)
+                    loss_t = loss_fn(*outs, *_wrap(y))
             return _unwrap(loss_t)
 
         def _one_replica_grads(p, b, key, x, y):

@@ -77,9 +77,13 @@ class LocalSGDTrainStep:
 
         def _forward(p, b, key, x, y):
             with state.functional_rng_ctx(key):
-                out, new_b = model.functional_call(p, b, *_wrap(x))
-                outs = out if isinstance(out, tuple) else (out,)
-                loss_t = loss_fn(*outs, *_wrap(y))
+                # loss may read model params directly (CRF transitions,
+                # tied heads): keep the traced substitution alive through it
+                # (same fix as jit.TrainStep._forward)
+                with model._use_state(p, b):
+                    out, new_b = model.functional_call(p, b, *_wrap(x))
+                    outs = out if isinstance(out, tuple) else (out,)
+                    loss_t = loss_fn(*outs, *_wrap(y))
             return _unwrap(loss_t), new_b
 
         _forward = tfm.wrap_forward(_forward, self.transforms)

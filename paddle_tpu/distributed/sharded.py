@@ -157,10 +157,14 @@ class ShardedTrainStep:
 
         def _forward(p, buffers, key, inputs, labels):
             with state.functional_rng_ctx(key):
-                out, new_buf = model.functional_call(
-                    p, buffers, *_wrap(inputs))
-                outs = out if isinstance(out, tuple) else (out,)
-                loss_t = loss_fn(*outs, *_wrap(labels))
+                # loss may read model params directly (CRF transitions,
+                # tied heads): keep the traced substitution alive through it
+                # (same fix as jit.TrainStep._forward)
+                with model._use_state(p, buffers):
+                    out, new_buf = model.functional_call(
+                        p, buffers, *_wrap(inputs))
+                    outs = out if isinstance(out, tuple) else (out,)
+                    loss_t = loss_fn(*outs, *_wrap(labels))
             return _unwrap(loss_t), (new_buf, _unwrap(out))
 
         # amp autocast (recompute is handled by the remat flag below so a

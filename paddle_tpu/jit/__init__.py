@@ -289,10 +289,16 @@ class TrainStep:
 
         def _forward(p, bufs, key, inputs, labels):
             with state.functional_rng_ctx(key):
-                out, new_buf = model.functional_call(
-                    p, bufs, *_wrap(inputs))
-                outs = out if isinstance(out, tuple) else (out,)
-                loss_t = loss_fn(*outs, *_wrap(labels))
+                # keep the param substitution alive THROUGH the loss call:
+                # losses may read model parameters directly (CRF
+                # transitions, tied heads) and must see the traced arrays,
+                # not the pre-trace constants functional_call restores on
+                # exit — otherwise those params silently train to nothing
+                with model._use_state(p, bufs):
+                    out, new_buf = model.functional_call(
+                        p, bufs, *_wrap(inputs))
+                    outs = out if isinstance(out, tuple) else (out,)
+                    loss_t = loss_fn(*outs, *_wrap(labels))
             return _unwrap(loss_t), (new_buf, _unwrap(out))
 
         _forward = tfm.wrap_forward(_forward, self.transforms)

@@ -186,3 +186,44 @@ class TestTPLayers:
         (net(pt.ones([2, 4])).sum()).backward()
         opt.step(); opt.step()
         opt.clear_grad()
+
+
+class TestShardedLossParams:
+    def test_loss_only_parameter_trains_sharded(self):
+        """Same contract as TrainStep (test_training.py TestLossParams):
+        a parameter read ONLY by the loss fn must train under the
+        GSPMD-sharded step too (distributed/sharded.py keeps the param
+        substitution alive through the loss call)."""
+        pt.seed(0)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+                self.scale = self.create_parameter(
+                    [1],
+                    default_initializer=nn.initializer.Constant(2.0))
+
+            def forward(self, x):
+                return self.lin(x)
+
+        m = M()
+        s0 = float(np.asarray(m.scale.numpy())[0])
+
+        def loss_fn(out, y):
+            return pt.mean((out * m.scale - y) ** 2)
+
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters())
+        make_mesh({"dp": 8})
+        step = ShardedTrainStep(m, loss_fn, opt)
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 4).astype("f4")
+        y = rng.randn(16, 4).astype("f4")
+        l0 = float(step(x, y).numpy())
+        for _ in range(5):
+            l = float(step(x, y).numpy())
+        step.sync()
+        assert l < l0
+        s1 = float(np.asarray(m.scale.numpy())[0])
+        assert abs(s1 - s0) > 1e-4, "loss-only param did not train (sharded)"

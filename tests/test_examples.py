@@ -74,3 +74,13 @@ def test_image_classification_converges():
     assert r["converged"], r
     assert r["devices"] == 8
     assert r["test_acc"] > 0.5, r
+
+
+def test_label_semantic_roles_converges():
+    """Sequence labeling with a learnable linear-chain CRF: the
+    transition parameter lives ONLY in the loss (linear_chain_crf) and
+    inference is crf_decoding — exercises the TrainStep loss-param
+    threading end to end (ref book test_label_semantic_roles.py)."""
+    r = _run_example("label_semantic_roles.py", "--steps", "160")
+    assert r["last_loss"] < r["first_loss"] * 0.2, r
+    assert r["tag_acc"] > 0.9, r

@@ -968,6 +968,23 @@ class OpCheckFailure(AssertionError):
         self.detail = detail
 
 
+def _grad_loss(spec, raw, arrays):
+    """(fidx, loss) for the op's grad checks: scalar loss summing the
+    float outputs, differentiated w.r.t. the first float input. ONE
+    implementation shared by the FD battery (run_spec_checks) and the
+    cross-place parity battery (run_cross_place_checks) so both
+    differentiate the same thing."""
+    fidx = next(i for i, a in enumerate(arrays)
+                if jnp.issubdtype(a.dtype, jnp.floating))
+
+    def loss(v):
+        args = list(arrays)
+        args[fidx] = v
+        return _sum_float_outputs(raw(*args, **spec.attrs), spec.out0)
+
+    return fidx, loss
+
+
 def run_spec_checks(name, probes=12, grad_tol=5e-2, replay_tol=1e-5):
     """The three-check battery for one op: (a) eager finite outputs,
     (b) AD grad vs central finite differences on a bounded coordinate
@@ -991,16 +1008,9 @@ def run_spec_checks(name, probes=12, grad_tol=5e-2, replay_tol=1e-5):
     # (b) grad vs central finite differences (w.r.t. first float input).
     # The loss is jitted once and FD probes a bounded coordinate sample —
     # full-numel loops at eager dispatch cost blew the suite budget.
-    if spec.grad:
-        fidx = next(i for i, a in enumerate(arrays)
-                    if jnp.issubdtype(a.dtype, jnp.floating))
-
-        @jax.jit
-        def loss(v):
-            args = list(arrays)
-            args[fidx] = v
-            return _sum_float_outputs(raw(*args, **spec.attrs), spec.out0)
-
+    if spec.grad and probes:
+        fidx, loss_ = _grad_loss(spec, raw, arrays)
+        loss = jax.jit(loss_)
         g = np.asarray(jax.grad(loss)(arrays[fidx]))
         x0 = np.asarray(arrays[fidx]).astype("f8")
         eps = 1e-3
@@ -1109,3 +1119,67 @@ def test_ref_op_coverage_map_complete():
     os.unlink(tmp.name)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "UNCLASSIFIED" not in r.stderr
+
+
+def run_cross_place_checks(name, rtol=5e-2, atol=5e-3):
+    """Numeric parity of the op across places: fwd outputs and the AD
+    grad computed on the DEFAULT backend (the accelerator under the
+    on-chip sweep) must match the host-CPU backend on identical inputs
+    (ref op_test.py:1033 check_output_with_place — per-place numeric
+    validation). This replaces finite differences on the accelerator:
+    the MXU runs f32 contractions at bf16 tile precision, so an FD
+    perturbation below bf16 resolution silently vanishes (observed
+    on-chip: fd=0 for every matmul/conv-backed op).
+
+    jax's threefry PRNG is backend-invariant, so rng-consuming ops
+    compare equal too as long as the global seed is reset per place."""
+    import jax
+    import paddle_tpu as _pt
+    spec = SPECS[name]
+    raw = OP_REGISTRY[name]
+    cpu0 = jax.devices("cpu")[0]
+
+    def run_all(device):
+        _pt.seed(1234)   # rng-op keys must match across places
+        arrays = [jax.device_put(jnp.asarray(a), device)
+                  for a in spec.inputs]
+        with jax.default_device(device):
+            out = raw(*arrays, **spec.attrs)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            outs = [np.asarray(o) for o in outs]
+            g = None
+            if spec.grad:
+                fidx, loss = _grad_loss(spec, raw, arrays)
+                g = np.asarray(jax.grad(jax.jit(loss))(arrays[fidx]))
+        return outs, g
+
+    dev_outs, dev_g = run_all(jax.devices()[0])
+    cpu_outs, cpu_g = run_all(cpu0)
+
+    def compare(tag, a, b):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.shape != b.shape:
+            raise OpCheckFailure(tag, f"shape {a.shape} vs {b.shape}")
+        if a.dtype.kind in "fc" or b.dtype.kind in "fc":
+            # bf16 tile precision on the accelerator: compare in f32
+            # with MXU-tolerant bounds
+            a32, b32 = a.astype("f4"), b.astype("f4")
+            bad = ~np.isclose(a32, b32, rtol=rtol, atol=atol)
+            if bad.any():
+                i = int(np.argmax(np.abs(a32 - b32) * bad))
+                raise OpCheckFailure(
+                    tag, f"flat[{i}]: dev={a32.reshape(-1)[i]:.5g} "
+                         f"cpu={b32.reshape(-1)[i]:.5g} "
+                         f"({int(bad.sum())}/{a.size} mismatched)")
+        else:
+            if not np.array_equal(a, b):
+                bad = a != b
+                raise OpCheckFailure(
+                    tag, f"{int(bad.sum())}/{a.size} int mismatches")
+
+    if len(dev_outs) != len(cpu_outs):
+        raise OpCheckFailure("place_out", "output arity differs")
+    for j, (a, b) in enumerate(zip(dev_outs, cpu_outs)):
+        compare(f"place_out[{j}]", a, b)
+    if dev_g is not None:
+        compare("place_grad", dev_g, cpu_g)

@@ -255,3 +255,48 @@ class TestHapi:
     def test_summary(self):
         info = pt.summary(nn.Linear(4, 2))
         assert info["total_params"] == 10
+
+
+class TestLossParams:
+    def test_loss_only_parameter_trains(self):
+        """A parameter referenced ONLY inside the loss fn (CRF
+        transitions, learned temperatures) must receive gradients and
+        updates through TrainStep: the traced param substitution stays
+        alive through the loss call (jit/__init__.py _forward).
+        Regression: it used to trace as a pre-trace constant and
+        silently train to nothing."""
+        import numpy as np
+        from paddle_tpu.jit import TrainStep
+
+        pt.seed(0)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 4)
+                self.scale = self.create_parameter(
+                    [1], default_initializer=nn.initializer.Constant(2.0))
+
+            def forward(self, x):
+                return self.lin(x)
+
+        m = M()
+        s0 = float(np.asarray(m.scale.numpy())[0])
+
+        def loss_fn(out, y):
+            # scale participates ONLY in the loss
+            return pt.mean((out * m.scale - y) ** 2)
+
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters())
+        step = TrainStep(m, loss_fn, opt)
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 4).astype("float32")
+        y = rng.randn(8, 4).astype("float32")
+        l0 = float(step(x, y).numpy())
+        for _ in range(5):
+            l = float(step(x, y).numpy())
+        step.sync()
+        assert l < l0, (l0, l)
+        s1 = float(np.asarray(m.scale.numpy())[0])
+        assert abs(s1 - s0) > 1e-4, "loss-only parameter did not train"
