@@ -90,6 +90,8 @@ def main():
     loader = DataLoader(train, batch_size=args.batch_size, shuffle=True,
                         drop_last=True)
 
+    if len(loader) == 0:
+        raise SystemExit("batch size exceeds the dataset; nothing to train")
     t0 = time.time()
     first = last = None
     it = 0

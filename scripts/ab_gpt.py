@@ -74,6 +74,11 @@ def run_combo(fused, layout, batch=8, seq=1024, iters=20):
 
 
 def main():
+    bad = [a for a in sys.argv[1:] if "=" not in a]
+    if bad:
+        raise SystemExit(f"unknown args {bad}; use fused=0|1 layout=bhsd|bshd"
+                         " (gpt2m-no-recompute moved to"
+                         " scripts/bench_sweep.py gpt2m_norc)")
     want = dict(a.split("=") for a in sys.argv[1:] if "=" in a)
     fuseds = ([bool(int(want["fused"]))] if "fused" in want
               else [True, False])

@@ -57,14 +57,14 @@ def _measure(step, inputs, labels, tag, per_step_samples, flops_per_step,
         f"{tf:.1f} TF/s  MFU={tf/PEAK_TFLOPS:.3f}")
 
 
-def sweep_gpt(batches, medium=False):
+def sweep_gpt(batches, medium=False, recompute=True):
     from paddle_tpu.nlp import GPTConfig, GPTForPretraining
     from paddle_tpu.nlp.gpt import gpt_pretrain_loss
     if medium:
         cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
                         num_heads=16, max_seq_len=1024, dropout=0.0,
                         attn_dropout=0.0)
-        name = "gpt2-medium"
+        name = "gpt2-medium" if recompute else "gpt2m-norecompute"
     else:
         cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024, dropout=0.0,
@@ -77,7 +77,7 @@ def sweep_gpt(batches, medium=False):
         model.to(dtype=jnp.bfloat16)
         opt = pt.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
-        if medium:
+        if medium and recompute:
             # BASELINE configs[3]: gpt2-medium runs recompute + bf16
             from paddle_tpu.distributed.fleet.meta_optimizers import \
                 RecomputeOptimizer
@@ -148,6 +148,10 @@ def sweep_bert(batches, seq=512):
 FAMILIES = {
     "gpt": (sweep_gpt, [8, 16, 32]),
     "gpt2m": (lambda bs: sweep_gpt(bs, medium=True), [2, 4, 8]),
+    # does gpt2m fit HBM without recompute? BASELINE configs[3] keeps
+    # recompute for reference parity; this row measures what it costs
+    "gpt2m_norc": (lambda bs: sweep_gpt(bs, medium=True,
+                                        recompute=False), [4]),
     "resnet": (sweep_resnet, [64, 128]),
     "bert": (sweep_bert, [8, 16]),
 }

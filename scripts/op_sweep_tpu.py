@@ -35,6 +35,9 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 RESULTS = os.path.join(REPO, "docs", "perf", "op_sweep_tpu.jsonl")
 SUMMARY = os.path.join(REPO, "docs", "perf", "op_sweep_tpu.json")
 MAX_ATTEMPTS = 2       # error/timeout verdicts become final after this
+# bump when the check battery changes: pass/fail rows from an older
+# battery are re-run, not resume-skipped (v2 = cross-place parity)
+BATTERY_VERSION = 2
 
 
 class OpTimeout(Exception):
@@ -146,8 +149,11 @@ def main():
         DETERMINISTIC failure must not wedge the watchdog battery in a
         forever-retry loop — after that it banks as a final verdict and
         counts toward bankable)."""
-        v = done.get(n, {}).get("verdict")
-        return v in ("pass", "fail", "unsupported") or (
+        rec = done.get(n, {})
+        v = rec.get("verdict")
+        if v in ("pass", "fail"):
+            return rec.get("battery") == BATTERY_VERSION
+        return v == "unsupported" or (
             v in ("error", "timeout") and attempts.get(n, 0) >= MAX_ATTEMPTS)
 
     todo = [n for n in names if not settled(n)]
@@ -187,6 +193,7 @@ def main():
                 signal.alarm(0)
             rec["secs"] = round(time.time() - t0, 2)
             rec["backend"] = backend
+            rec["battery"] = BATTERY_VERSION
             outf.write(json.dumps(rec) + "\n")
             outf.flush()
             done[name] = rec

@@ -69,11 +69,18 @@ while :; do
     probe || continue
     run_step bench       2400 python bench.py                         || { sleep 60; continue; }
     probe || continue
-    run_step sweep_gpt   2400 python scripts/bench_sweep.py gpt 8     || { sleep 60; continue; }
+    run_step sweep_gpt   3000 python scripts/bench_sweep.py gpt 8 16  || { sleep 60; continue; }
     probe || continue
     run_step bshd_ab     2400 env PT_ATTN_LAYOUT=bshd python scripts/bench_sweep.py gpt 8 || { sleep 60; continue; }
     probe || continue
+    # chunked-CE on-chip datum (auto default resolves dense at all bench
+    # sizes, so the fused path needs an explicit measurement)
+    run_step fused_ab    2400 python scripts/ab_gpt.py fused=1 layout=bhsd || { sleep 60; continue; }
+    probe || continue
     run_step sweep_gpt2m 3000 python scripts/bench_sweep.py gpt2m 4   || { sleep 60; continue; }
+    probe || continue
+    # does gpt2m b=4 fit HBM without recompute? (banked verdict either way)
+    run_step gpt2m_norc  3000 python scripts/bench_sweep.py gpt2m_norc 4 || { sleep 60; continue; }
     probe || continue
     run_step sweep_resnet 2400 python scripts/bench_sweep.py resnet 128 || { sleep 60; continue; }
     probe || continue

@@ -77,7 +77,7 @@ def test_gpt_fused_head_dce_under_jit():
     pt.seed(0)
     cfg = GPTConfig(vocab_size=8192, hidden_size=64, num_layers=2,
                     num_heads=2, max_seq_len=64, dropout=0.0,
-                    attn_dropout=0.0)
+                    attn_dropout=0.0, fused_head_loss=True)
     model = GPTForPretraining(cfg)
     params, bufs = model.functional_state()
     ids = jnp.asarray(np.random.RandomState(0).randint(0, 8192, (4, 64)),
@@ -103,10 +103,34 @@ def test_gpt_fused_eager_tied_grad():
     pt.seed(0)
     cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
                     num_heads=2, max_seq_len=64, dropout=0.0,
-                    attn_dropout=0.0)
+                    attn_dropout=0.0, fused_head_loss=True)
     model = GPTForPretraining(cfg)
     ids = np.random.RandomState(0).randint(0, 512, (4, 64)).astype("int32")
     loss = gpt_pretrain_loss(model(pt.to_tensor(ids)), pt.to_tensor(ids))
     loss.backward()
     g = model.gpt.embeddings.word_embeddings.weight.grad
     assert g is not None and float(jnp.abs(g._data).max()) > 1e-4
+
+
+def test_fused_head_auto_threshold(monkeypatch):
+    """fused_head_loss=None resolves by dense-logits size: dense under
+    the threshold (chunking measured ~20ms/step SLOWER at the bench
+    config on-chip), chunked above it (logits too big for HBM)."""
+    import numpy as np
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+    from paddle_tpu.nlp import gpt as gpt_mod
+
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=1,
+                    num_heads=2, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)   # fused_head_loss defaults to None
+    assert cfg.fused_head_loss is None
+    m = GPTForPretraining(cfg)
+    ids = np.zeros((2, 32), dtype="int32")
+
+    monkeypatch.setattr(gpt_mod, "CHUNKED_CE_AUTO_BYTES", 1 << 60)
+    logits = m(pt.to_tensor(ids))
+    assert getattr(logits, "_fused_head", None) is None  # dense side
+
+    monkeypatch.setattr(gpt_mod, "CHUNKED_CE_AUTO_BYTES", 1)
+    logits = m(pt.to_tensor(ids))
+    assert getattr(logits, "_fused_head", None) is not None  # chunked side
