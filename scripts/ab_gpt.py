@@ -68,7 +68,7 @@ def run_combo(fused, layout, batch=8, seq=1024, iters=20):
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tf = 6 * n_params * batch * seq / dt / 1e12
     log(f"RESULT {tag}: {dt*1e3:.2f} ms/step  {batch*seq/dt:,.0f} tok/s  "
-        f"MFU={tf/PEAK_TFLOPS:.3f}")
+        f"{tf:.1f} TF/s  MFU={tf/PEAK_TFLOPS:.3f}")
     del step, model, opt
     return dt
 

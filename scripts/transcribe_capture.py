@@ -19,7 +19,7 @@ MARK_END = "<!-- /transcribe_capture -->"
 
 RESULT_RE = re.compile(
     r"\]\s+(?P<label>.+?):\s+(?P<ms>[\d.]+) ms/step\s+"
-    r"(?P<toks>[\d,]+) (?:tok|img|samples)/s\s+(?P<tf>[\d.]+) TF/s\s+"
+    r"(?P<toks>[\d,]+) (?:tok|imgs?|samples)/s\s+(?P<tf>[\d.]+) TF/s\s+"
     r"MFU=(?P<mfu>[\d.]+)")
 SEQ_RE = re.compile(
     r"\]\s+seq=(?P<seq>\d+):\s+(?P<ms>[\d.]+) ms/step\s+"
