@@ -1,0 +1,72 @@
+"""Autoregressive decode throughput on the real chip — the inference
+half of the perf record (training rows come from bench_sweep).
+
+GPT-2s bf16, prompt 128, KV-cache incremental decode
+(GPTModel.decode_step inside generate's single jitted fori_loop):
+
+    python scripts/bench_decode.py            # b=1 and b=8
+
+Prints one RESULT row per batch: decode tok/s (new tokens only) and
+per-token latency. The second call re-traces but hits the persistent
+XLA compile cache; 512 new tokens amortise the remaining dispatch
+overhead.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import paddle_tpu as pt
+
+t0 = time.time()
+
+
+def log(m):
+    print(f"[{time.time()-t0:7.1f}s] {m}", flush=True)
+
+
+def run(batch, prompt_len=128, new_tokens=512):
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+    from paddle_tpu.nlp.gpt import generate
+
+    cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=prompt_len + new_tokens,
+                    dropout=0.0, attn_dropout=0.0)
+    pt.seed(0)
+    model = GPTForPretraining(cfg)
+    model.to(dtype=jnp.bfloat16)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, prompt_len)).astype("int32")
+
+    t1 = time.time()
+    out = generate(model, ids, max_new_tokens=new_tokens, use_cache=True)
+    np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+    log(f"decode b={batch} warm (compile): {time.time()-t1:.1f}s")
+
+    t1 = time.time()
+    out = generate(model, ids, max_new_tokens=new_tokens, use_cache=True)
+    np.asarray(out.numpy() if hasattr(out, "numpy") else out)
+    dt = time.time() - t1
+    rate = batch * new_tokens / dt
+    log(f"RESULT decode b={batch} prompt={prompt_len} new={new_tokens}: "
+        f"{rate:,.0f} tok/s  {dt/new_tokens*1e3:.2f} ms/token")
+
+
+def main():
+    for b in (1, 8):
+        run(b)
+
+
+if __name__ == "__main__":
+    main()

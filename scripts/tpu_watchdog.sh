@@ -88,6 +88,9 @@ while :; do
     probe || continue
     run_step longctx     3600 python scripts/longctx_probe.py         || { sleep 60; continue; }
     probe || continue
+    # inference half of the record: KV-cache autoregressive decode tok/s
+    run_step decode      3000 python scripts/bench_decode.py          || { sleep 60; continue; }
+    probe || continue
     # on-chip OpTest sweep (ref op_test.py:1033 check_output_with_place);
     # resumable via its own jsonl, so a timeout here still banks partials
     run_step op_sweep    5400 python scripts/op_sweep_tpu.py          || { sleep 60; continue; }
