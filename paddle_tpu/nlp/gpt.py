@@ -410,6 +410,27 @@ def gpt_pretrain_loss(logits, labels):
     return loss
 
 
+_GEN_CACHE_MAX = 8     # distinct (shape, knob) programs kept per model
+
+
+def _gen_program_cache(model):
+    """Per-model cache of traced generate programs: generate() used to
+    build a fresh @jax.jit closure per call, so every call re-traced the
+    whole model (seconds on a 1-core host) even when the XLA executable
+    was disk-cached. The dict lives ON the model instance (the jitted
+    closures capture the model, so a global weak map would never
+    collect); model -> cache -> closure -> model is a plain cycle the
+    gc reclaims when the model is dropped. Insertion-ordered, bounded:
+    variable-shape serving loops evict oldest instead of accumulating
+    one executable per (B, L, prompt_len) forever."""
+    cache = getattr(model, "_pt_gen_programs", None)
+    if cache is None:
+        cache = {}
+        # bypass Layer.__setattr__ (it interns sublayers/params)
+        object.__setattr__(model, "_pt_gen_programs", cache)
+    return cache
+
+
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                  top_k=0, top_p=1.0, temperature=1.0, eos_token_id=None,
                  seed=None, use_cache=False):
@@ -481,18 +502,27 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     key0 = (jax.random.PRNGKey(seed) if seed is not None
             else _state.next_rng_key())
 
+    spec = (B, L, prompt_len, bool(use_cache), bool(do_sample),
+            int(top_k), float(top_p), float(temperature), eos)
+    programs = _gen_program_cache(model)
+
     if not use_cache:
-        @jax.jit
-        def run(p, b, buf, key):
-            # params enter as jit ARGUMENTS (not baked constants), so
-            # repeated generate() calls after training reuse the program
-            finished = jnp.zeros((B,), bool)
-            buf, _, _ = jax.lax.fori_loop(prompt_len, L, make_step(p, b),
-                                          (buf, finished, key))
-            return buf
+        if spec not in programs:
+            @jax.jit
+            def run(p, b, buf, key):
+                # params enter as jit ARGUMENTS (not baked constants), so
+                # repeated generate() calls after training reuse the program
+                finished = jnp.zeros((B,), bool)
+                buf, _, _ = jax.lax.fori_loop(prompt_len, L,
+                                              make_step(p, b),
+                                              (buf, finished, key))
+                return buf
+            programs[spec] = run
+            while len(programs) > _GEN_CACHE_MAX:
+                programs.pop(next(iter(programs)))
 
         try:
-            return _T(run(params, buffers, buf0, key0))
+            return _T(programs[spec](params, buffers, buf0, key0))
         finally:
             if was_training:
                 model.train()
@@ -532,16 +562,21 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         return (logits._data if isinstance(logits, _T) else logits,
                 new_caches)
 
-    @jax.jit
-    def run_cached(p, b, buf, key):
-        caches = model.init_cache(B, L)
-        finished = jnp.zeros((B,), bool)
-        buf, _, _, _ = jax.lax.fori_loop(
-            0, L - 1, make_cached_step(p, b), (buf, caches, finished, key))
-        return buf
+    if spec not in programs:
+        @jax.jit
+        def run_cached(p, b, buf, key):
+            caches = model.init_cache(B, L)
+            finished = jnp.zeros((B,), bool)
+            buf, _, _, _ = jax.lax.fori_loop(
+                0, L - 1, make_cached_step(p, b),
+                (buf, caches, finished, key))
+            return buf
+        programs[spec] = run_cached
+        while len(programs) > _GEN_CACHE_MAX:
+            programs.pop(next(iter(programs)))
 
     try:
-        return _T(run_cached(params, buffers, buf0, key0))
+        return _T(programs[spec](params, buffers, buf0, key0))
     finally:
         if was_training:
             model.train()

@@ -7,9 +7,9 @@ GPT-2s bf16, prompt 128, KV-cache incremental decode
     python scripts/bench_decode.py            # b=1 and b=8
 
 Prints one RESULT row per batch: decode tok/s (new tokens only) and
-per-token latency. The second call re-traces but hits the persistent
-XLA compile cache; 512 new tokens amortise the remaining dispatch
-overhead.
+per-token latency. The first call traces + compiles; the timed second
+call reuses the per-model generate program cache, so the RESULT row is
+pure execution.
 """
 import os
 import sys
