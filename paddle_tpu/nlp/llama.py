@@ -44,6 +44,10 @@ class LlamaConfig:
         self.rms_eps = rms_eps
         self.initializer_range = initializer_range
         self.use_recompute = use_recompute
+        # attention kernel layout (same opt-in knob as GPTConfig):
+        # "bshd" keeps [B,S,H,D] end to end — no layout transposes
+        import os as _os
+        self.attn_layout = _os.environ.get("PT_ATTN_LAYOUT", "bhsd")
         self.tie_embeddings = tie_embeddings
         if num_heads % self.num_kv_heads:
             raise ValueError(f"num_heads {num_heads} not divisible by "
@@ -88,6 +92,25 @@ def rope_tables(seq_len, head_dim, theta=10000.0):
             jnp.asarray(np.sin(freqs), jnp.float32))
 
 
+def apply_rope_bshd(x, cos, sin, pos_offset=0):
+    """x: [B, S, H, D] (transpose-free layout). Same rotation as
+    apply_rope with the broadcast moved to the S-major layout."""
+    b, s, h, d = x.shape
+    if isinstance(pos_offset, int) and pos_offset + s > cos.shape[0]:
+        raise ValueError(
+            f"RoPE positions [{pos_offset}, {pos_offset + s}) exceed the "
+            f"table length {cos.shape[0]} (raise max_seq_len)")
+    xf = x.astype(jnp.float32).reshape(b, s, h, d // 2, 2)
+    x1, x2 = xf[..., 0], xf[..., 1]
+    c = jax.lax.dynamic_slice_in_dim(cos, pos_offset, s, axis=0)
+    sn = jax.lax.dynamic_slice_in_dim(sin, pos_offset, s, axis=0)
+    c = c[None, :, None]                           # [1,S,1,D/2]
+    sn = sn[None, :, None]
+    y1 = x1 * c - x2 * sn
+    y2 = x1 * sn + x2 * c
+    return jnp.stack([y1, y2], axis=-1).reshape(b, s, h, d).astype(x.dtype)
+
+
 def apply_rope(x, cos, sin, pos_offset=0):
     """x: [B, H, S, D] array. Rotates pairs (x[2i], x[2i+1]) — f32 math,
     cast back to x.dtype. A static pos_offset is range-checked (a traced
@@ -124,27 +147,37 @@ def _rope_tensor_tables(seq_len, head_dim, theta):
 
 
 def _llama_attention_raw(x, wqkv, cos, sin, num_heads=1, num_kv_heads=1,
-                         head_dim=1):
+                         head_dim=1, attn_layout="bhsd"):
     """Registered (desc-serializable) GQA attention: fused qkv matmul,
     RoPE from the cos/sin table inputs, kv-head repeat, causal flash.
     The rope tables ride as const inputs so captured LLaMA programs
-    replay in fresh processes."""
+    replay in fresh processes. attn_layout="bshd" keeps [B,S,H,D]
+    end-to-end (RoPE + kv-repeat + packed-lane kernel) — zero layout
+    transposes in the whole attention block."""
     nh, nkv, hd = num_heads, num_kv_heads, head_dim
     cos = jax.lax.stop_gradient(cos)
     sin = jax.lax.stop_gradient(sin)
     b, s, _ = x.shape
     qkv = x @ wqkv                                   # [B,S,(nh+2kv)*hd]
     q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
-    q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
-    k = k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+    from ..ops.pallas.flash_attention import _flash_array
+    if attn_layout == "bshd":
+        q = apply_rope_bshd(q.reshape(b, s, nh, hd), cos, sin)
+        k = apply_rope_bshd(k.reshape(b, s, nkv, hd), cos, sin)
+        v = v.reshape(b, s, nkv, hd)
+        if nkv != nh:                                # GQA: repeat KV
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        o = _flash_array(q, k, v, causal=True, layout="bshd")
+        return o.reshape(b, s, nh * hd)
+    q = apply_rope(q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3), cos, sin)
+    k = apply_rope(k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3), cos, sin)
     v = v.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
     if nkv != nh:                                    # GQA: repeat KV
         rep = nh // nkv
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    from ..ops.pallas.flash_attention import _flash_array
     o = _flash_array(q, k, v, causal=True)
     return o.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
 
@@ -159,6 +192,7 @@ class LlamaAttention(nn.Layer):
         self.num_heads = cfg.num_heads
         self.num_kv_heads = cfg.num_kv_heads
         self.head_dim = h // cfg.num_heads
+        self.attn_layout = getattr(cfg, "attn_layout", "bhsd")
         init = I.Normal(0.0, cfg.initializer_range)
         qkv_out = (cfg.num_heads + 2 * cfg.num_kv_heads) * self.head_dim
         self.qkv_proj = nn.Linear(h, qkv_out, bias_attr=False,
@@ -185,7 +219,8 @@ class LlamaAttention(nn.Layer):
                     (x, self.qkv_proj.weight, t_cos, t_sin),
                     {"num_heads": self.num_heads,
                      "num_kv_heads": self.num_kv_heads,
-                     "head_dim": self.head_dim},
+                     "head_dim": self.head_dim,
+                     "attn_layout": self.attn_layout},
                     name="llama_attention")
         return self.o_proj(out)
 

@@ -37,8 +37,15 @@ class MultiHeadAttention(Layer):
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
-                 need_weights=False, weight_attr=None, bias_attr=None):
+                 need_weights=False, weight_attr=None, bias_attr=None,
+                 attn_layout=None):
         super().__init__()
+        import os as _os
+        # "bshd": the flash kernel reads [B,S,H,D] straight off the
+        # projections — no layout transposes (same opt-in knob as
+        # GPTConfig.attn_layout; PT_ATTN_LAYOUT lets benches A/B it)
+        self.attn_layout = (attn_layout
+                            or _os.environ.get("PT_ATTN_LAYOUT", "bhsd"))
         self.embed_dim = embed_dim
         self.kdim = kdim or embed_dim
         self.vdim = vdim or embed_dim
@@ -77,6 +84,22 @@ class MultiHeadAttention(Layer):
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         key = query if key is None else key
         value = key if value is None else value
+        if (self.attn_layout == "bshd" and cache is None
+                and not self.need_weights and attn_mask is None):
+            # transpose-free path: [B,S,E] -> [B,S,H,D] views feed the
+            # packed-lane flash kernel natively
+            from ..ops.pallas.flash_attention import flash_attention
+            b, s = query.shape[0], query.shape[1]
+            hd = (self.num_heads, self.head_dim)
+            q = self.q_proj(query).reshape([b, s, *hd])
+            k = self.k_proj(key).reshape([b, key.shape[1], *hd])
+            v = self.v_proj(value).reshape([b, value.shape[1], *hd])
+            out = flash_attention(
+                q, k, v, causal=False,
+                dropout_p=self.dropout if self.training else 0.0,
+                layout="bshd")
+            out = out.reshape([b, s, self.embed_dim])
+            return self.out_proj(out)
         q = self._reshape_heads(self.q_proj(query))
         if isinstance(cache, MultiHeadAttention.StaticCache):
             k, v = cache.k, cache.v

@@ -151,3 +151,22 @@ def test_llama_jit_save_load_roundtrip(tmp_path):
     out = pt.jit.load(path)(ids)
     arr = np.asarray(out.numpy() if hasattr(out, "numpy") else out)
     np.testing.assert_allclose(arr, ref, atol=1e-5)
+
+
+def test_llama_bshd_layout_matches_default():
+    """attn_layout='bshd' (transpose-free RoPE + packed-lane kernel,
+    GQA kv-repeat on the head axis of [B,S,H,D]) == the default
+    [B,H,S,D] path."""
+    ids = np.random.RandomState(0).randint(0, 256, (2, 128)) \
+        .astype("int32")
+    outs = {}
+    for layout in ("bhsd", "bshd"):
+        pt.seed(0)
+        cfg = LlamaConfig(vocab_size=256, hidden_size=128, num_layers=2,
+                          num_heads=4, num_kv_heads=2, max_seq_len=128)
+        cfg.attn_layout = layout
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        outs[layout] = np.asarray(model(pt.to_tensor(ids)).numpy())
+    np.testing.assert_allclose(outs["bshd"], outs["bhsd"],
+                               rtol=2e-4, atol=2e-4)

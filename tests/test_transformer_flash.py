@@ -249,3 +249,41 @@ class TestBSHDKernelPath:
             outs[layout] = np.asarray(model(pt.to_tensor(ids)).numpy())
         np.testing.assert_allclose(outs["bshd"], outs["bhsd"],
                                    rtol=2e-4, atol=2e-4)
+
+    def test_mha_bshd_layout_matches_default(self):
+        """nn.MultiHeadAttention attn_layout='bshd' (transpose-free
+        packed-lane kernel path) == the default [B,H,S,D] path; the
+        fallback conditions (mask/cache/need_weights) keep the default
+        path, so only the mask-free self-attention case must agree."""
+        from paddle_tpu import nn
+
+        x = np.random.RandomState(0).randn(2, 128, 128).astype("float32")
+        outs = {}
+        for layout in ("bhsd", "bshd"):
+            pt.seed(0)
+            mha = nn.MultiHeadAttention(128, 2, dropout=0.0,
+                                        attn_layout=layout)
+            mha.eval()
+            outs[layout] = np.asarray(mha(pt.to_tensor(x)).numpy())
+        np.testing.assert_allclose(outs["bshd"], outs["bhsd"],
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_mha_bshd_with_mask_falls_back(self):
+        """A mask forces the default path — same numerics either way."""
+        from paddle_tpu import nn
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 64, 64).astype("float32")
+        mask = np.ones((2, 1, 64, 64), dtype=bool)
+        mask[:, :, :, 48:] = False
+        outs = {}
+        for layout in ("bhsd", "bshd"):
+            pt.seed(0)
+            mha = nn.MultiHeadAttention(64, 2, dropout=0.0,
+                                        attn_layout=layout)
+            mha.eval()
+            outs[layout] = np.asarray(
+                mha(pt.to_tensor(x), attn_mask=pt.to_tensor(mask))
+                .numpy())
+        np.testing.assert_allclose(outs["bshd"], outs["bhsd"],
+                                   rtol=1e-5, atol=1e-5)
