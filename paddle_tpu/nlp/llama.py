@@ -31,7 +31,8 @@ class LlamaConfig:
                  intermediate_size=None, num_layers=12, num_heads=12,
                  num_kv_heads=None, max_seq_len=2048, rope_theta=10000.0,
                  rms_eps=1e-6, initializer_range=0.02,
-                 use_recompute=False, tie_embeddings=True):
+                 use_recompute=False, tie_embeddings=True,
+                 attn_layout=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         # LLaMA sizing: 2/3 * 4h rounded; callers may pass exact values
@@ -47,7 +48,8 @@ class LlamaConfig:
         # attention kernel layout (same opt-in knob as GPTConfig):
         # "bshd" keeps [B,S,H,D] end to end — no layout transposes
         import os as _os
-        self.attn_layout = _os.environ.get("PT_ATTN_LAYOUT", "bhsd")
+        self.attn_layout = (attn_layout
+                            or _os.environ.get("PT_ATTN_LAYOUT", "bhsd"))
         self.tie_embeddings = tie_embeddings
         if num_heads % self.num_kv_heads:
             raise ValueError(f"num_heads {num_heads} not divisible by "
@@ -92,49 +94,42 @@ def rope_tables(seq_len, head_dim, theta=10000.0):
             jnp.asarray(np.sin(freqs), jnp.float32))
 
 
-def apply_rope_bshd(x, cos, sin, pos_offset=0):
-    """x: [B, S, H, D] (transpose-free layout). Same rotation as
-    apply_rope with the broadcast moved to the S-major layout."""
-    b, s, h, d = x.shape
-    if isinstance(pos_offset, int) and pos_offset + s > cos.shape[0]:
+def _rope_rotate(x, cos, sin, pos_offset, head_axis):
+    """Shared RoPE core: rotates pairs (x[2i], x[2i+1]) in f32, cast back.
+    head_axis selects the layout — 1 for [B,H,S,D], 2 for [B,S,H,D]; the
+    sequence axis is the other one. A static pos_offset is range-checked
+    (a traced offset can't be; dynamic_slice would clamp silently)."""
+    d = x.shape[-1]
+    seq_axis = 3 - head_axis            # the non-head middle axis
+    s_len = x.shape[seq_axis]
+    if isinstance(pos_offset, int) and pos_offset + s_len > cos.shape[0]:
         raise ValueError(
-            f"RoPE positions [{pos_offset}, {pos_offset + s}) exceed the "
-            f"table length {cos.shape[0]} (raise max_seq_len)")
-    xf = x.astype(jnp.float32).reshape(b, s, h, d // 2, 2)
+            f"RoPE positions [{pos_offset}, {pos_offset + s_len}) exceed "
+            f"the table length {cos.shape[0]} (raise max_seq_len)")
+    xf = x.astype(jnp.float32).reshape(*x.shape[:-1], d // 2, 2)
     x1, x2 = xf[..., 0], xf[..., 1]
-    c = jax.lax.dynamic_slice_in_dim(cos, pos_offset, s, axis=0)
-    sn = jax.lax.dynamic_slice_in_dim(sin, pos_offset, s, axis=0)
-    c = c[None, :, None]                           # [1,S,1,D/2]
-    sn = sn[None, :, None]
+    c = jax.lax.dynamic_slice_in_dim(cos, pos_offset, s_len, axis=0)
+    sn = jax.lax.dynamic_slice_in_dim(sin, pos_offset, s_len, axis=0)
+    bshape = [1, 1, 1, d // 2]
+    bshape[seq_axis] = s_len
+    c = c.reshape(bshape)
+    sn = sn.reshape(bshape)
     y1 = x1 * c - x2 * sn
     y2 = x1 * sn + x2 * c
-    return jnp.stack([y1, y2], axis=-1).reshape(b, s, h, d).astype(x.dtype)
+    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+def apply_rope_bshd(x, cos, sin, pos_offset=0):
+    """x: [B, S, H, D] (transpose-free layout)."""
+    return _rope_rotate(x, cos, sin, pos_offset, head_axis=2)
 
 
 def apply_rope(x, cos, sin, pos_offset=0):
-    """x: [B, H, S, D] array. Rotates pairs (x[2i], x[2i+1]) — f32 math,
-    cast back to x.dtype. A static pos_offset is range-checked (a traced
-    offset can't be; dynamic_slice would clamp silently)."""
-    b, h, s, d = x.shape
-    if isinstance(pos_offset, int) and pos_offset + s > cos.shape[0]:
-        raise ValueError(
-            f"RoPE positions [{pos_offset}, {pos_offset + s}) exceed the "
-            f"table length {cos.shape[0]} (raise max_seq_len)")
-    xf = x.astype(jnp.float32).reshape(b, h, s, d // 2, 2)
-    x1, x2 = xf[..., 0], xf[..., 1]
-    c = jax.lax.dynamic_slice_in_dim(cos, pos_offset, s, axis=0)
-    sn = jax.lax.dynamic_slice_in_dim(sin, pos_offset, s, axis=0)
-    c = c[None, None]                              # [1,1,S,D/2]
-    sn = sn[None, None]
-    y1 = x1 * c - x2 * sn
-    y2 = x1 * sn + x2 * c
-    return jnp.stack([y1, y2], axis=-1).reshape(b, h, s, d).astype(x.dtype)
+    """x: [B, H, S, D] array (default layout)."""
+    return _rope_rotate(x, cos, sin, pos_offset, head_axis=1)
 
 
-import functools as _functools
-
-
-@_functools.lru_cache(maxsize=8)
+@functools.lru_cache(maxsize=8)
 def _rope_tensor_tables(seq_len, head_dim, theta):
     """Tensor wrappers for the rope tables, cached so EVERY layer of a
     captured model dedupes onto one shared const pair in the desc."""

@@ -6,8 +6,12 @@ rescaling. Backward: Pallas flash kernels too (_bwd_dkv_kernel /
 _bwd_dq_kernel below) — two passes that recompute the block's scores in
 VMEM from the saved logsumexp, so dQ/dK/dV never materialise S x S in HBM.
 
-Layout [B, H, S, D]; D is padded to the 128-lane boundary inside the kernel
-wrapper when needed.
+Two layouts share the kernels: the default [B, H, S, D] (one head per
+program) and the transpose-free [B, S, H, D] path, which views the array
+as [B, S, H*D] (free contiguous collapse) and packs heads into 128-lane
+groups — d=64 packs head PAIRS per program — so every block satisfies the
+Mosaic rule that a block's last two dims be 8/128-divisible or whole.
+D is padded to the 128-lane boundary inside the wrapper when needed.
 """
 import functools
 import math

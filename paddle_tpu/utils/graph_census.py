@@ -93,10 +93,13 @@ def trace_train_step(step, inputs, labels):
     from ..framework import state
 
     lr = jnp.asarray(step.optimizer.get_lr(), jnp.float32)
+    inputs = inputs if isinstance(inputs, tuple) else (inputs,)
+    labels = labels if isinstance(labels, tuple) else (labels,)
     traced = step._compiled.trace(
         step.params, step.buffers, step.opt_state, step.grad_acc,
         state.next_rng_key(), lr, jnp.asarray(1, jnp.int32),
-        (jnp.asarray(inputs),), (jnp.asarray(labels),))
+        tuple(jnp.asarray(x) for x in inputs),
+        tuple(jnp.asarray(y) for y in labels))
     closed = traced.jaxpr
     # XLA dead-code-eliminates values that never leave the program (the
     # fused-loss models return logits that TrainStep drops); census the

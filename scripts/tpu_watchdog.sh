@@ -91,6 +91,9 @@ while :; do
     # inference half of the record: KV-cache autoregressive decode tok/s
     run_step decode      3000 python scripts/bench_decode.py          || { sleep 60; continue; }
     probe || continue
+    # MultiHeadAttention bshd path on the BERT topology (vs sweep_bert)
+    run_step bert_bshd   2400 env PT_ATTN_LAYOUT=bshd python scripts/bench_sweep.py bert 16 || { sleep 60; continue; }
+    probe || continue
     # on-chip OpTest sweep (ref op_test.py:1033 check_output_with_place);
     # resumable via its own jsonl, so a timeout here still banks partials
     run_step op_sweep    5400 python scripts/op_sweep_tpu.py          || { sleep 60; continue; }

@@ -94,3 +94,42 @@ def test_gpt2m_recompute_bshd_fused_is_clean():
     assert c["attn_transposes"] == 0, c["attn_transpose_shapes"]
     assert c["vocab_intermediates"] == 0, c["vocab_shapes"]
     assert c["pallas_calls"] >= 48, c  # >= 2 per layer x 24 layers
+
+
+def test_bert_mha_bshd_no_attn_transposes():
+    """The MultiHeadAttention bshd path (BERT-base topology, bench_sweep
+    sweep_bert shapes) must leave zero attention-layout transposes in
+    the traced train step — same property the GPT census pins, now on
+    the shared nn.MultiHeadAttention used by BERT/Transformer."""
+    from paddle_tpu.nlp.bert import (BertForPretraining, bert_base,
+                                     bert_pretrain_loss)
+
+    pt.seed(0)
+    cfg = bert_base(max_seq_len=512, dropout=0.0, attn_dropout=0.0)
+    import os
+    counts = {}
+    for layout in ("bhsd", "bshd"):
+        os.environ["PT_ATTN_LAYOUT"] = layout
+        try:
+            pt.seed(0)
+            model = BertForPretraining(cfg)
+            model.to(dtype=jnp.bfloat16)
+            opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+            step = TrainStep(model, bert_pretrain_loss, opt, donate=False)
+            rng = np.random.RandomState(0)
+            ids = rng.randint(0, cfg.vocab_size, (2, 512)).astype("int32")
+            mlm = np.where(rng.rand(2, 512) < 0.15,
+                           rng.randint(0, cfg.vocab_size, (2, 512)),
+                           -100).astype("int64")
+            nsp = rng.randint(0, 2, (2,)).astype("int64")
+            c = census_jaxpr(
+                trace_train_step(step, (ids,), (mlm, nsp)),
+                seq_len=512, head_dim=64, vocab_size=cfg.vocab_size)
+            counts[layout] = c
+        finally:
+            os.environ.pop("PT_ATTN_LAYOUT", None)
+    assert counts["bshd"]["attn_transposes"] == 0, \
+        counts["bshd"]["attn_transpose_shapes"]
+    assert counts["bhsd"]["attn_transposes"] > 0, (
+        "census failed to detect the BHSD transposes — predicate broken")

@@ -163,8 +163,8 @@ def test_llama_bshd_layout_matches_default():
     for layout in ("bhsd", "bshd"):
         pt.seed(0)
         cfg = LlamaConfig(vocab_size=256, hidden_size=128, num_layers=2,
-                          num_heads=4, num_kv_heads=2, max_seq_len=128)
-        cfg.attn_layout = layout
+                          num_heads=4, num_kv_heads=2, max_seq_len=128,
+                          attn_layout=layout)
         model = LlamaForCausalLM(cfg)
         model.eval()
         outs[layout] = np.asarray(model(pt.to_tensor(ids)).numpy())
