@@ -94,6 +94,10 @@ while :; do
     # MultiHeadAttention bshd path on the BERT topology (vs sweep_bert)
     run_step bert_bshd   2400 env PT_ATTN_LAYOUT=bshd python scripts/bench_sweep.py bert 16 || { sleep 60; continue; }
     probe || continue
+    # device trace of the weakest row (resnet 0.145 MFU): hotspot evidence
+    # for the next tuning round
+    run_step trace_resnet 2400 python scripts/capture_trace.py resnet 128 || { sleep 60; continue; }
+    probe || continue
     # on-chip OpTest sweep (ref op_test.py:1033 check_output_with_place);
     # resumable via its own jsonl, so a timeout here still banks partials
     run_step op_sweep    5400 python scripts/op_sweep_tpu.py          || { sleep 60; continue; }
