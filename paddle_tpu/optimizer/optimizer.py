@@ -33,6 +33,7 @@ class Optimizer:
             self._weight_decay = weight_decay
         self._accumulators = {}    # id(param) -> dict(state_name -> jnp array)
         self._global_step = 0
+        self._multi_precision = False   # subclasses expose the kwarg
         self.helper = None
 
     # ------------------------------------------------------------------ lr
@@ -57,7 +58,24 @@ class Optimizer:
             self._accumulators[key] = self._init_state(p._data)
         return self._accumulators[key]
 
+    def _mp_param(self, arr):
+        """multi_precision applies to low-precision params: the optimizer
+        keeps an fp32 MASTER copy, updates it, and casts down — without
+        it, bf16 weights round away updates smaller than ~0.8%% of the
+        weight magnitude (ref multi_precision on Adam/Momentum/SGD:
+        master weights in the fp16/bf16 kernels)."""
+        return (self._multi_precision
+                and arr.dtype in (jnp.bfloat16, jnp.float16))
+
     def _init_state(self, arr):
+        if self._mp_param(arr):
+            # fp32 slots alongside the fp32 master: _update computes in
+            # f32, and param-dtype slots would flip the state pytree's
+            # dtypes after step 1 (a full recompile under jit)
+            st = {name: jnp.zeros(arr.shape, jnp.float32)
+                  for name in self._state_names}
+            st["master"] = arr.astype(jnp.float32)
+            return st
         return {name: jnp.zeros_like(arr) for name in self._state_names}
 
     def _hyper(self):
@@ -92,25 +110,39 @@ class Optimizer:
                 # (moments decay on untouched rows too — ref adam_op
                 # non-lazy SelectedRows branch densifies likewise)
                 g = Tensor(g.to_dense())
-            g_arr = g._data.astype(p._data.dtype)
+            state = self._ensure_state(p)
+            master = state.get("master")
+            base = master if master is not None else p._data
+            # decay/regularizer against the same values _update sees —
+            # for multi_precision that is the fp32 master (a bf16 g + wd*p
+            # would round the decay term away entirely)
+            g_arr = g._data.astype(base.dtype)
             if self._weight_decay is not None and \
                     getattr(p, "regularizer", None) is None:
-                g_arr = self._weight_decay._append(p._data, g_arr)
+                g_arr = self._weight_decay._append(base, g_arr)
             elif getattr(p, "regularizer", None) is not None:
-                g_arr = p.regularizer._append(p._data, g_arr)
-            state = self._ensure_state(p)
+                g_arr = p.regularizer._append(base, g_arr)
             plr = lr * getattr(p, "learning_rate", 1.0)
             new_p, new_state = update(
-                p._data, g_arr, jnp.asarray(plr, jnp.float32), hyper,
+                base, g_arr, jnp.asarray(plr, jnp.float32), hyper,
                 tuple(state[n] for n in self._state_names),
                 jnp.asarray(self._global_step, jnp.int32))
-            p._data = new_p
+            if master is not None:
+                state["master"] = new_p
+                p._data = new_p.astype(p._data.dtype)
+            else:
+                p._data = new_p
             for n, s in zip(self._state_names, new_state):
                 state[n] = s
 
     def _can_row_update(self):
         """Row-wise sparse update is exact for stateless rules (SGD) and is
-        the documented lazy_mode semantics for stateful ones."""
+        the documented lazy_mode semantics for stateful ones. Disabled
+        under multi_precision: the scatter would update p._data behind
+        the fp32 master's back, and the next dense step would revert it
+        — those grads densify instead (correct, just not lazy)."""
+        if self._multi_precision:
+            return False
         return not self._state_names or getattr(self, "_lazy_mode", False)
 
     def _sparse_step(self, p, g, lr, hyper):
@@ -163,11 +195,11 @@ class Optimizer:
 
     # ------------------------------------------------------- functional path
     def init_opt_state(self, params):
-        """params: dict name -> jnp array. Returns opt state pytree."""
-        return {
-            name: {sn: jnp.zeros_like(arr) for sn in self._state_names}
-            for name, arr in params.items()
-        }
+        """params: dict name -> jnp array. Returns opt state pytree.
+        Delegates to _init_state so subclass slot dtypes (Adam's f32
+        moments) and multi_precision master weights apply identically in
+        the eager and jitted paths."""
+        return {name: self._init_state(arr) for name, arr in params.items()}
 
     def apply_gradients_fn(self):
         """Returns a pure fn(params, grads, opt_state, lr, step) ->
@@ -190,13 +222,19 @@ class Optimizer:
                     new_params[n] = p
                     new_state[n] = opt_state[n]
                     continue
-                g = g.astype(p.dtype)
+                # multi_precision: update the fp32 master, cast down
+                master = opt_state[n].get("master")
+                base = master if master is not None else p
+                g = g.astype(base.dtype)
                 if wd is not None:
-                    g = wd._append(p, g)
+                    g = wd._append(base, g)
                 st = tuple(opt_state[n][sn] for sn in state_names)
-                np_, nst = update(p, g, lr, hyper, st, step)
-                new_params[n] = np_
+                np_, nst = update(base, g, lr, hyper, st, step)
                 new_state[n] = dict(zip(state_names, nst))
+                if master is not None:
+                    new_state[n]["master"] = np_
+                    np_ = np_.astype(p.dtype)
+                new_params[n] = np_
             return new_params, new_state
 
         return apply_fn
@@ -222,12 +260,17 @@ class Optimizer:
         for i, p in enumerate(self._parameters):
             key = p.name or f"param_{i}"
             st = self._ensure_state(p)
-            for n in self._state_names:
+            for n in (*self._state_names, "master"):
                 k = f"{key}.{n}"
                 if k in sd:
                     v = sd[k]
                     st[n] = jnp.asarray(v.numpy() if isinstance(v, Tensor)
                                         else v)
+            if "master" in st and f"{key}.master" not in sd:
+                # resuming from a checkpoint without a master slot: seed
+                # it from the just-loaded weights, else the next step
+                # would revert them to the stale pre-load master
+                st["master"] = p._data.astype(jnp.float32)
 
     set_dict = set_state_dict
 
@@ -255,10 +298,12 @@ class Momentum(Optimizer):
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False,
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._momentum = float(momentum)
         self._use_nesterov = bool(use_nesterov)
+        self._multi_precision = bool(multi_precision)
 
     def _hyper(self):
         return (self._momentum, 1.0 if self._use_nesterov else 0.0)
@@ -282,6 +327,7 @@ class Adam(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._lazy_mode = bool(lazy_mode)
+        self._multi_precision = bool(multi_precision)
 
     def _hyper(self):
         return (self._beta1, self._beta2, self._epsilon)
@@ -300,9 +346,13 @@ class Adam(Optimizer):
         return p - upd.astype(p.dtype), (m, v)
 
     def _init_state(self, arr):
-        # fp32 master moments even for bf16 params (multi-precision default)
-        return {n: jnp.zeros(arr.shape, jnp.float32)
-                for n in self._state_names}
+        # fp32 moments even for bf16 params (always; the "master" slot
+        # for the WEIGHTS is opt-in via multi_precision)
+        st = {n: jnp.zeros(arr.shape, jnp.float32)
+              for n in self._state_names}
+        if self._mp_param(arr):
+            st["master"] = arr.astype(jnp.float32)
+        return st
 
 
 class AdamW(Adam):
@@ -314,7 +364,8 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, lazy_mode=lazy_mode)
+                         None, grad_clip, lazy_mode=lazy_mode,
+                         multi_precision=multi_precision)
         self._coeff = float(weight_decay) if isinstance(weight_decay,
                                                         (int, float)) else 0.01
         self._apply_decay_param_fun = apply_decay_param_fun
