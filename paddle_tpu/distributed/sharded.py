@@ -172,7 +172,10 @@ class ShardedTrainStep:
         amp_only = {k: v for k, v in self.transforms.items() if k == "amp"}
         _forward = tfm.wrap_forward(_forward, amp_only)
         if remat:
-            _forward = jax.checkpoint(_forward, static_argnums=())
+            from ..jit.transforms import _remat_policy
+            _forward = jax.checkpoint(
+                _forward, static_argnums=(),
+                policy=_remat_policy(self.transforms.get("recompute")))
 
         # k-step gradient merge (strategy.gradient_merge): accumulator
         # sharded like the grads (= params)

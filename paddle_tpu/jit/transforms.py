@@ -74,9 +74,27 @@ def wrap_forward(forward, transforms):
                 return inner(p, buffers, key, inputs, labels)
 
         forward = amp_forward
-    if transforms.get("recompute") is not None:
-        forward = jax.checkpoint(forward)
+    rc = transforms.get("recompute")
+    if rc is not None:
+        forward = jax.checkpoint(forward, policy=_remat_policy(rc))
     return forward
+
+
+def _remat_policy(rc_config):
+    """Map the recompute strategy's `policy` knob to a jax.checkpoint
+    policy. Default (None) is full rematerialization — max memory
+    saving, forward runs ~twice. "dots" saves every matmul/contraction
+    output and replays only the cheap elementwise chains: ~half the
+    recompute FLOPs for most of the activation-memory win on
+    matmul-dominated models (ref recompute_configs has no analog knob —
+    the XLA policy machinery is the TPU-native upgrade)."""
+    pol = (rc_config or {}).get("policy")
+    if pol in (None, "", "full"):
+        return None
+    if pol == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown recompute policy {pol!r} "
+                     "(expected 'full' or 'dots')")
 
 
 def merge_config(transforms):

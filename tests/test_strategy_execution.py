@@ -247,3 +247,34 @@ def test_hapi_fit_picks_strategy_step():
     assert model._train_step.transforms.get("amp") is not None
     assert model._train_step.transforms.get("recompute") is not None
     assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_recompute_policy_dots_matches_full():
+    """recompute policy='dots' (save matmul outputs, replay elementwise)
+    must train identically to full rematerialization — only the
+    memory/recompute trade differs, not the math."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.distributed.fleet.meta_optimizers import \
+        RecomputeOptimizer
+
+    def run(configs):
+        pt.seed(3)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        opt = RecomputeOptimizer(
+            pt.optimizer.AdamW(learning_rate=1e-2,
+                               parameters=net.parameters()),
+            configs)
+        step = TrainStep(net, nn.functional.mse_loss, opt)
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 16).astype("f4")
+        y = rng.randn(8, 4).astype("f4")
+        return [float(step(x, y).numpy()) for _ in range(5)]
+
+    full = run({"policy": "full"})
+    dots = run({"policy": "dots"})
+    default = run(None)
+    np.testing.assert_allclose(full, dots, rtol=1e-5)
+    np.testing.assert_allclose(full, default, rtol=1e-5)
