@@ -64,7 +64,9 @@ def sweep_gpt(batches, medium=False, recompute=True):
         cfg = GPTConfig(vocab_size=32768, hidden_size=1024, num_layers=24,
                         num_heads=16, max_seq_len=1024, dropout=0.0,
                         attn_dropout=0.0)
-        name = "gpt2-medium" if recompute else "gpt2m-norecompute"
+        name = ("gpt2-medium" if recompute is True
+                else f"gpt2m-{recompute}" if recompute
+                else "gpt2m-norecompute")
     else:
         cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
                         num_heads=12, max_seq_len=1024, dropout=0.0,
@@ -78,10 +80,12 @@ def sweep_gpt(batches, medium=False, recompute=True):
         opt = pt.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
         if medium and recompute:
-            # BASELINE configs[3]: gpt2-medium runs recompute + bf16
+            # BASELINE configs[3]: gpt2-medium runs recompute + bf16;
+            # recompute='dots' uses the matmul-saving checkpoint policy
             from paddle_tpu.distributed.fleet.meta_optimizers import \
                 RecomputeOptimizer
-            opt = RecomputeOptimizer(opt)
+            cfgs = ({"policy": "dots"} if recompute == "dots" else None)
+            opt = RecomputeOptimizer(opt, cfgs)
         step = TrainStep(model, gpt_pretrain_loss, opt, donate=True)
         ids = np.random.RandomState(0).randint(
             0, cfg.vocab_size, (batch, seq)).astype("int32")
@@ -152,6 +156,9 @@ FAMILIES = {
     # recompute for reference parity; this row measures what it costs
     "gpt2m_norc": (lambda bs: sweep_gpt(bs, medium=True,
                                         recompute=False), [4]),
+    # matmul-saving checkpoint policy: between full remat and none
+    "gpt2m_dots": (lambda bs: sweep_gpt(bs, medium=True,
+                                        recompute="dots"), [4]),
     "resnet": (sweep_resnet, [64, 128]),
     "bert": (sweep_bert, [8, 16]),
 }

@@ -82,6 +82,8 @@ while :; do
     # does gpt2m b=4 fit HBM without recompute? (banked verdict either way)
     run_step gpt2m_norc  3000 python scripts/bench_sweep.py gpt2m_norc 4 || { sleep 60; continue; }
     probe || continue
+    run_step gpt2m_dots  3000 python scripts/bench_sweep.py gpt2m_dots 4 || { sleep 60; continue; }
+    probe || continue
     run_step sweep_resnet 2400 python scripts/bench_sweep.py resnet 128 || { sleep 60; continue; }
     probe || continue
     run_step sweep_bert  2400 python scripts/bench_sweep.py bert 16   || { sleep 60; continue; }
