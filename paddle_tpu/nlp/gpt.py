@@ -358,8 +358,14 @@ CHUNKED_CE_AUTO_BYTES = 2 << 30
 def _use_fused_head(cfg, logits_shape):
     if cfg.fused_head_loss is not None:
         return cfg.fused_head_loss
-    b, s, v = logits_shape
-    return int(b) * int(s) * int(v) * 4 > CHUNKED_CE_AUTO_BYTES
+    import numpy as _np
+    if not all(isinstance(d, (int, _np.integer)) for d in logits_shape):
+        # symbolic dims (shape-polymorphic jit.save export) have no
+        # concrete size: keep the dense head — exported forwards serve
+        # logits, they don't pair with the training-only fused loss
+        return False
+    b, s, v = (int(d) for d in logits_shape)
+    return b * s * v * 4 > CHUNKED_CE_AUTO_BYTES
 
 
 def gpt_pretrain_loss(logits, labels):

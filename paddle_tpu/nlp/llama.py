@@ -32,7 +32,7 @@ class LlamaConfig:
                  num_kv_heads=None, max_seq_len=2048, rope_theta=10000.0,
                  rms_eps=1e-6, initializer_range=0.02,
                  use_recompute=False, tie_embeddings=True,
-                 attn_layout=None):
+                 attn_layout=None, fused_head_loss=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         # LLaMA sizing: 2/3 * 4h rounded; callers may pass exact values
@@ -50,6 +50,10 @@ class LlamaConfig:
         import os as _os
         self.attn_layout = (attn_layout
                             or _os.environ.get("PT_ATTN_LAYOUT", "bhsd"))
+        # vocab-chunked fused LM-head+CE, same AUTO semantics as
+        # GPTConfig.fused_head_loss (None = by logits size)
+        self.fused_head_loss = (None if fused_head_loss is None
+                                else bool(fused_head_loss))
         self.tie_embeddings = tie_embeddings
         if num_heads % self.num_kv_heads:
             raise ValueError(f"num_heads {num_heads} not divisible by "
@@ -364,7 +368,19 @@ class LlamaForCausalLM(nn.Layer):
         return self.lm_head(hidden)
 
     def forward(self, input_ids):
-        return self._logits(self.model(input_ids))
+        hidden = self.model(input_ids)
+        logits = self._logits(hidden)
+        from .gpt import _use_fused_head
+        if (self.cfg.tie_embeddings
+                and _use_fused_head(self.cfg, logits.shape)):
+            # hand the loss the pre-head pieces so llama_pretrain_loss
+            # (-> gpt_pretrain_loss) takes the vocab-chunked fused CE and
+            # the dense head matmul above DCEs under jit. ARRAY snapshot
+            # of w for the same functional_call reason as GPT (gpt.py):
+            # the Tensor's _data is restored after tracing.
+            w = self.model.embed_tokens.weight
+            logits._fused_head = (hidden, w, w._data)
+        return logits
 
     def init_cache(self, batch, max_len, dtype=jnp.float32):
         return self.model.init_cache(batch, max_len, dtype)

@@ -204,3 +204,25 @@ def test_generate_with_tp_sharded_weights():
     base = run(False)
     shard = run(True)
     np.testing.assert_array_equal(base, shard)
+
+
+def test_llama_fused_head_matches_dense():
+    """LLaMA rides the same vocab-chunked fused head+CE as GPT when the
+    (auto or forced) decision says chunk: loss trajectories match the
+    dense path and the attach only happens for tied embeddings."""
+    from paddle_tpu.jit import TrainStep
+
+    ids = np.random.RandomState(0).randint(0, 256, (2, 64)).astype("int32")
+    traj = {}
+    for fused in (False, True):
+        pt.seed(0)
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=2, max_seq_len=64,
+                          fused_head_loss=fused)
+        model = LlamaForCausalLM(cfg)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        step = TrainStep(model, llama_pretrain_loss, opt)
+        traj[fused] = [float(step(ids, ids).numpy()) for _ in range(4)]
+    np.testing.assert_allclose(traj[False], traj[True], rtol=2e-4,
+                               atol=2e-4)
