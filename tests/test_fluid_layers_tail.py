@@ -191,3 +191,43 @@ def test_var_conv_and_bilateral_semantics():
                                  jnp.asarray(xin), has_offset=False)
     want = np.einsum("oi,bihw->bohw", A, xin)
     np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4, atol=1e-5)
+
+
+def test_crf_nll_and_viterbi_vs_bruteforce():
+    """linear_chain_crf / crf_decoding vs explicit enumeration over all
+    N^len paths (T=4, N=3, variable lengths): log Z, the gold-path
+    score, and the argmax path must match the brute force exactly."""
+    import itertools
+    import jax.numpy as jnp
+    from paddle_tpu.ops.legacy import linear_chain_crf, crf_decoding
+
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 4, 3
+    em = rng.randn(B, T, N).astype("f4")
+    trans = rng.randn(N + 2, N).astype("f4") * 0.5
+    labels = rng.randint(0, N, (B, T)).astype("i4")
+    lengths = np.array([4, 2, 3], dtype="i4")
+    start, stop, w = trans[0], trans[1], trans[2:]
+
+    def path_score(b, path):
+        s = start[path[0]] + em[b, 0, path[0]]
+        for t in range(1, len(path)):
+            s += w[path[t - 1], path[t]] + em[b, t, path[t]]
+        return s + stop[path[-1]]
+
+    nll = np.asarray(linear_chain_crf(
+        jnp.asarray(em), jnp.asarray(trans), jnp.asarray(labels),
+        jnp.asarray(lengths))).reshape(B)
+    dec = np.asarray(crf_decoding(
+        jnp.asarray(em), jnp.asarray(trans), jnp.asarray(lengths)))
+
+    for b in range(B):
+        L = int(lengths[b])
+        scores = {p: path_score(b, p)
+                  for p in itertools.product(range(N), repeat=L)}
+        logZ = np.logaddexp.reduce(np.array(list(scores.values())))
+        gold = path_score(b, tuple(labels[b, :L]))
+        np.testing.assert_allclose(nll[b], logZ - gold, rtol=2e-5,
+                                   atol=2e-5)
+        best = max(scores, key=scores.get)
+        np.testing.assert_array_equal(dec[b, :L], np.array(best))
