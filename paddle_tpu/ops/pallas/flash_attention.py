@@ -66,9 +66,19 @@ def _pack(d_pad, h):
     return gsz, h // gsz, gsz * d_pad
 
 
-def _sdpa_reference(q, k, v, mask, causal, scale):
+def _band_keep(q_idx, k_idx, window):
+    """Causal(+sliding-window) mask — ONE definition for the reference
+    path, both kernels' fwd/bwd tiles, and the XLA fallback."""
+    keep = k_idx <= q_idx
+    if window is not None:
+        keep = keep & (k_idx > q_idx - window)
+    return keep
+
+
+def _sdpa_reference(q, k, v, mask, causal, scale, window=None):
     """Fused XLA path — also the recompute body for the backward pass.
-    Softmax statistics in f32 regardless of input dtype."""
+    Softmax statistics in f32 regardless of input dtype. window=W keeps
+    only the last W keys per query (sliding-window/local attention)."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -77,7 +87,7 @@ def _sdpa_reference(q, k, v, mask, causal, scale):
         qlen, klen = logits.shape[-2], logits.shape[-1]
         qi = jnp.arange(qlen)[:, None] + (klen - qlen)
         ki = jnp.arange(klen)[None, :]
-        logits = jnp.where(ki <= qi, logits, -jnp.inf)
+        logits = jnp.where(_band_keep(qi, ki, window), logits, -jnp.inf)
     if mask is not None:
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, -jnp.inf)
@@ -101,7 +111,7 @@ def _kslice(ref, start, size, g, d):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                kv_len, q_len, bk, dp, gsz=1):
+                kv_len, q_len, bk, dp, gsz=1, window=None):
     """One (batch*head-group, q-block) program: stream K/V blocks, online
     softmax. Also writes the per-row log-sum-exp (softmax stats) so the
     flash backward kernel can recompute P tiles without re-reducing.
@@ -132,7 +142,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
                          + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
                 k_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32,
                                                           (bq, bk), 1)
-                s = jnp.where(k_idx <= q_idx, s, -jnp.inf)
+                s = jnp.where(_band_keep(q_idx, k_idx, window), s,
+                              -jnp.inf)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             # guard fully-masked rows (m_new = -inf): shift by 0 there
             shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
@@ -148,7 +159,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             # only blocks up to (and including) the diagonal contribute
             diag = kv_len - q_len + (qblk + 1) * bq
             upper = jnp.minimum(nblocks, (diag + bk - 1) // bk)
-            m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+            lower = 0
+            if window is not None:
+                # blocks entirely left of every row's window are skipped
+                first = kv_len - q_len + qblk * bq - window + 1
+                lower = jnp.maximum(0, first // bk)
+            m, l, acc = jax.lax.fori_loop(lower, upper, body,
+                                          (m0, l0, acc0))
         else:
             m, l, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, acc0))
         outs.append((acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype))
@@ -163,7 +180,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     o_ref[0] = outs[0] if gsz == 1 else jnp.concatenate(outs, axis=-1)
 
 
-def _flash_fwd_pallas(q, k, v, causal, scale, bshd=False):
+def _flash_fwd_pallas(q, k, v, causal, scale, bshd=False,
+                      window=None):
     from jax.experimental import pallas as pl
 
     if bshd:
@@ -215,7 +233,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, bshd=False):
     bk_ = _blk(_BK, sk)
     kernel = functools.partial(_fwd_kernel, scale=s, causal=causal,
                                kv_len=sk, q_len=sq, bk=bk_, dp=d_pad,
-                               gsz=gsz)
+                               gsz=gsz, window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(nprog, sq // bq_),
@@ -239,7 +257,7 @@ def _flash_fwd_pallas(q, k, v, causal, scale, bshd=False):
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
                     dk_ref, dv_ref, *, scale, causal, kv_len, q_len,
-                    bq, bk, dp, gsz=1):
+                    bq, bk, dp, gsz=1, window=None):
     """One (batch*head-group, k-block) program: accumulate dK/dV over q
     blocks. P tiles are recomputed from saved lse; dd is rowsum(dO * O)."""
     from jax.experimental import pallas as pl
@@ -269,7 +287,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
                          + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
                 k_idx = kb * bk + jax.lax.broadcasted_iota(jnp.int32,
                                                            (bq, bk), 1)
-                p = jnp.where(k_idx <= q_idx, p, 0.0)
+                p = jnp.where(_band_keep(q_idx, k_idx, window), p, 0.0)
             dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
             dp_ = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
@@ -282,7 +300,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
         if causal:
             # first q block whose last row reaches this k block's first row
             start = jnp.maximum(0, (kb * bk - (kv_len - q_len)) // bq)
-            dk, dv = jax.lax.fori_loop(start, nqb, body, (dk0, dv0))
+            end = nqb
+            if window is not None:
+                # past q_idx >= k_idx + window no query sees this k block
+                last = kb * bk + bk - 1 + window - 1 - (kv_len - q_len)
+                end = jnp.minimum(nqb, last // bq + 1)
+            dk, dv = jax.lax.fori_loop(start, end, body, (dk0, dv0))
         else:
             dk, dv = jax.lax.fori_loop(0, nqb, body, (dk0, dv0))
         dks.append(dk.astype(dk_ref.dtype))
@@ -292,7 +315,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
-                   scale, causal, kv_len, q_len, bq, bk, dp, gsz=1):
+                   scale, causal, kv_len, q_len, bq, bk, dp, gsz=1,
+                   window=None):
     """One (batch*head-group, q-block) program: accumulate dQ over k
     blocks."""
     from jax.experimental import pallas as pl
@@ -319,7 +343,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
                          + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
                 k_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32,
                                                           (bq, bk), 1)
-                p = jnp.where(k_idx <= q_idx, p, 0.0)
+                p = jnp.where(_band_keep(q_idx, k_idx, window), p, 0.0)
             dp_ = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
                                       preferred_element_type=jnp.float32)
             ds = p * (dp_ - dd) * scale
@@ -330,14 +354,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
         if causal:
             diag = kv_len - q_len + (qblk + 1) * bq
             upper = jnp.minimum(nkb, (diag + bk - 1) // bk)
-            dq = jax.lax.fori_loop(0, upper, body, dq0)
+            lower = 0
+            if window is not None:
+                first = kv_len - q_len + qblk * bq - window + 1
+                lower = jnp.maximum(0, first // bk)
+            dq = jax.lax.fori_loop(lower, upper, body, dq0)
         else:
             dq = jax.lax.fori_loop(0, nkb, body, dq0)
         dqs.append(dq.astype(dq_ref.dtype))
     dq_ref[0] = dqs[0] if gsz == 1 else jnp.concatenate(dqs, axis=-1)
 
 
-def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd=False):
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale,
+                      bshd=False, window=None):
     """Flash backward: dQ/dK/dV without materialising S x S in HBM."""
     from jax.experimental import pallas as pl
 
@@ -404,7 +433,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd=False):
     dkv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=s, causal=causal,
                           kv_len=sk, q_len=sq, bq=bq_, bk=bk_, dp=d_pad,
-                          gsz=gsz),
+                          gsz=gsz, window=window),
         grid=(nprog, sk // bk_),
         in_specs=[fullspec(sq), qspec(bk_), qspec(bk_), fullspec(sq),
                   lse_spec, lse_spec],
@@ -417,7 +446,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd=False):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=s, causal=causal,
                           kv_len=sk, q_len=sq, bq=bq_, bk=bk_, dp=d_pad,
-                          gsz=gsz),
+                          gsz=gsz, window=window),
         grid=(nprog, sq // bq_),
         in_specs=[qspec(bq_), fullspec(sk), fullspec(sk), qspec(bq_),
                   lse_spec, lse_spec],
@@ -463,39 +492,51 @@ def _kernel_eligible(q, k, mask, dropout_p, bshd=False):
             and sq >= 128 and sk >= 128)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_core(q, k, v, causal, scale, bshd=False):
-    out, _ = _flash_fwd_pallas(q, k, v, causal, scale, bshd)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, scale, bshd=False, window=None):
+    out, _ = _flash_fwd_pallas(q, k, v, causal, scale, bshd, window)
     return out
 
 
-def _flash_core_fwd(q, k, v, causal, scale, bshd=False):
-    out, lse = _flash_fwd_pallas(q, k, v, causal, scale, bshd)
+def _flash_core_fwd(q, k, v, causal, scale, bshd=False, window=None):
+    out, lse = _flash_fwd_pallas(q, k, v, causal, scale, bshd, window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_core_bwd(causal, scale, bshd, res, g):
+def _flash_core_bwd(causal, scale, bshd, window, res, g):
     q, k, v, out, lse = res
-    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd)
+    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale, bshd,
+                             window)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def _flash_array(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
-                 rng_key=None, layout="bhsd"):
+                 rng_key=None, layout="bhsd", window=None):
     """Array-level flash attention (pure; usable inside any jax transform).
     layout="bshd" takes/returns [B, S, H, D] natively — no transposes feed
-    the kernel (the model keeps the matmul-natural layout end to end)."""
+    the kernel (the model keeps the matmul-natural layout end to end).
+    window=W (requires causal) keeps only the last W keys per query —
+    sliding-window/local attention; the kernels skip KV blocks entirely
+    outside the band, so compute is O(S*W) instead of O(S^2/2)."""
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding-window "
+                             "attention is a causal mask refinement)")
+        window = int(window)
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
     bshd = layout == "bshd"
     if _kernel_eligible(q, k, mask, dropout_p, bshd):
-        return _flash_core(q, k, v, causal, scale, bshd)
+        return _flash_core(q, k, v, causal, scale, bshd, window)
     if bshd:
         # fallback reference path works in BHSD: transpose around it
         # (ineligible shapes are the rare/small case)
         o = _flash_array(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                          jnp.swapaxes(v, 1, 2), mask=mask, causal=causal,
-                         dropout_p=dropout_p, scale=scale, rng_key=rng_key)
+                         dropout_p=dropout_p, scale=scale, rng_key=rng_key,
+                         window=window)
         return jnp.swapaxes(o, 1, 2)
     out = None
     d = q.shape[-1]
@@ -506,7 +547,7 @@ def _flash_array(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
         qlen, klen = logits.shape[-2], logits.shape[-1]
         qi = jnp.arange(qlen)[:, None] + (klen - qlen)
         ki = jnp.arange(klen)[None, :]
-        logits = jnp.where(ki <= qi, logits, -jnp.inf)
+        logits = jnp.where(_band_keep(qi, ki, window), logits, -jnp.inf)
     if mask is not None:
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, -jnp.inf)
@@ -520,12 +561,12 @@ def _flash_array(q, k, v, mask=None, causal=False, dropout_p=0.0, scale=None,
 
 
 def _flash_attention_raw(q, k, v, *maybe_mask, causal=False, scale=None,
-                         layout="bhsd"):
+                         layout="bhsd", window=None):
     """Registered (desc-serializable) dropout-free form — captured
     transformer programs stay portable across processes."""
     m = maybe_mask[0] if maybe_mask else None
     return _flash_array(q, k, v, mask=m, causal=causal, dropout_p=0.0,
-                        scale=scale, layout=layout)
+                        scale=scale, layout=layout, window=window)
 
 
 from ..dispatch import register_op as _register_op
@@ -534,10 +575,11 @@ _register_op("flash_attention", _flash_attention_raw)
 
 
 def flash_attention(q, k, v, attn_mask=None, causal=False, dropout_p=0.0,
-                    scale=None, layout="bhsd"):
+                    scale=None, layout="bhsd", window=None):
     """Tensor-level op (dispatcher-integrated: eager tape or functional).
     layout="bshd" takes [B, S, H, D] straight from the qkv projection —
-    no layout transposes between the matmul and the kernel."""
+    no layout transposes between the matmul and the kernel. window=W is
+    causal sliding-window attention (last W keys per query)."""
     from ..dispatch import apply
     from ...framework import state
 
@@ -546,7 +588,8 @@ def flash_attention(q, k, v, attn_mask=None, causal=False, dropout_p=0.0,
         return apply(_flash_attention_raw, args,
                      {"causal": bool(causal),
                       "scale": None if scale is None else float(scale),
-                      "layout": str(layout)},
+                      "layout": str(layout),
+                      "window": None if window is None else int(window)},
                      name="flash_attention")
 
     # attention dropout draws a key: stays an in-process closure op (a
@@ -557,18 +600,20 @@ def flash_attention(q, k, v, attn_mask=None, causal=False, dropout_p=0.0,
         m = maybe_mask[0] if maybe_mask else None
         return _flash_array(q_, k_, v_, mask=m, causal=causal,
                             dropout_p=dropout_p, scale=scale,
-                            rng_key=rng_key, layout=layout)
+                            rng_key=rng_key, layout=layout, window=window)
 
     return apply(f, args, name="flash_attention")
 
 
-def flash_attention_xla(q, k, v, attn_mask=None, causal=False, scale=None):
-    """Force the XLA path (debug/fallback)."""
+def flash_attention_xla(q, k, v, attn_mask=None, causal=False, scale=None,
+                        window=None):
+    """Force the XLA path (debug/fallback) — same band semantics as the
+    kernel path so windowed models compare apples to apples."""
     from ..dispatch import apply
 
     def f(q_, k_, v_, *maybe_mask):
         m = maybe_mask[0] if maybe_mask else None
-        return _sdpa_reference(q_, k_, v_, m, causal, scale)
+        return _sdpa_reference(q_, k_, v_, m, causal, scale, window)
 
     args = (q, k, v) if attn_mask is None else (q, k, v, attn_mask)
     return apply(f, args, name="flash_attention")

@@ -41,7 +41,7 @@ def _sds(shape, dtype):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _flash(layout, with_bwd, s=1024, b=8, h=12, d=64):
+def _flash(layout, with_bwd, s=1024, b=8, h=12, d=64, window=None):
     import jax
     import jax.numpy as jnp
     from paddle_tpu.ops.pallas.flash_attention import _flash_array
@@ -50,7 +50,8 @@ def _flash(layout, with_bwd, s=1024, b=8, h=12, d=64):
     avals = [_sds(shape, jnp.bfloat16)] * 3
 
     def fwd(q, k, v):
-        return _flash_array(q, k, v, causal=True, layout=layout)
+        return _flash_array(q, k, v, causal=True, layout=layout,
+                            window=window)
 
     if not with_bwd:
         return fwd, avals
@@ -91,6 +92,12 @@ def _c5():
 @check("flash_bwd_bshd_8k")
 def _c6():
     return _flash("bshd", True, s=8192, b=1)
+
+
+@check("flash_bwd_window_8k")
+def _c_win():
+    # sliding-window 1024 over 8k context: the block-skipping band path
+    return _flash("bhsd", True, s=8192, b=1, window=1024)
 
 
 @check("chunked_ce")

@@ -287,3 +287,135 @@ class TestBSHDKernelPath:
                 .numpy())
         np.testing.assert_allclose(outs["bshd"], outs["bhsd"],
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestSlidingWindow:
+    """window=W (causal sliding-window / local attention): kernel vs the
+    dense band-masked softmax, fwd and all three grads, both layouts.
+    The kernels also SKIP kv blocks outside the band (O(S*W) compute) —
+    the bounds tightening must not change numerics."""
+
+    def _dense(self, q, k, v, window):
+        import math
+        d = q.shape[-1]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / math.sqrt(d)
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        qi = jnp.arange(qlen)[:, None] + (klen - qlen)
+        ki = jnp.arange(klen)[None, :]
+        keep = (ki <= qi) & (ki > qi - window)
+        logits = jnp.where(keep, logits, -jnp.inf)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+    @pytest.mark.parametrize("layout", ["bhsd", "bshd"])
+    @pytest.mark.parametrize("window", [128, 384, 1024])
+    def test_window_matches_dense_fwd_bwd(self, layout, window):
+        from paddle_tpu.ops.pallas.flash_attention import _flash_array
+
+        rng = np.random.RandomState(0)
+        b, h, s, d = 1, 2, 512, 64
+        q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+        want = self._dense(q, k, v, window)
+
+        if layout == "bshd":
+            qq, kk, vv = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+        else:
+            qq, kk, vv = q, k, v
+
+        got = _flash_array(qq, kk, vv, causal=True, layout=layout,
+                           window=window)
+        if layout == "bshd":
+            got = jnp.swapaxes(got, 1, 2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+        def loss_flash(q_, k_, v_):
+            o = _flash_array(q_, k_, v_, causal=True, layout=layout,
+                             window=window)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def loss_dense(q_, k_, v_):
+            return jnp.sum(self._dense(q_, k_, v_, window) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(qq, kk, vv)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gd):
+            if layout == "bshd":
+                a = jnp.swapaxes(a, 1, 2)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=3e-4, atol=3e-4)
+
+    def test_window_requires_causal(self):
+        from paddle_tpu.ops.pallas.flash_attention import _flash_array
+
+        q = jnp.zeros((1, 2, 128, 64), jnp.float32)
+        with pytest.raises(ValueError):
+            _flash_array(q, q, q, causal=False, window=64)
+
+    def test_window_decode_shapes(self):
+        """sq != sk (decode suffix): absolute positions honor the offset."""
+        from paddle_tpu.ops.pallas.flash_attention import _flash_array
+
+        rng = np.random.RandomState(1)
+        b, h, sk_, sq, d, w = 1, 2, 512, 128, 64, 192
+        q = jnp.asarray(rng.randn(b, h, sq, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, h, sk_, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, h, sk_, d), jnp.float32)
+        got = _flash_array(q, k, v, causal=True, window=w)
+        want = self._dense(q, k, v, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_window_multiblock_bounds(self, monkeypatch):
+        """Force 128-wide kernel blocks so the band's block-skipping
+        bounds (fwd lower, dq lower, dkv end) actually engage: 512/128 =
+        4 kv blocks, window 192 spans block boundaries. A wrong bound
+        formula shows up as wrong outputs/grads here."""
+        import importlib
+        fa = importlib.import_module(
+            "paddle_tpu.ops.pallas.flash_attention")
+
+        monkeypatch.setattr(fa, "_BQ", 128)
+        monkeypatch.setattr(fa, "_BK", 128)
+        rng = np.random.RandomState(2)
+        b, h, s, d, w = 1, 2, 512, 64, 192
+        q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+        got = fa._flash_array(q, k, v, causal=True, window=w)
+        want = self._dense(q, k, v, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+        def loss_flash(q_, k_, v_):
+            o = fa._flash_array(q_, k_, v_, causal=True, window=w)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def loss_dense(q_, k_, v_):
+            return jnp.sum(self._dense(q_, k_, v_, w) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=3e-4, atol=3e-4)
+
+    def test_window_xla_fallback_matches_kernel(self):
+        """flash_attention_xla(window=) computes the same band."""
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _flash_array, flash_attention_xla)
+
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(1, 2, 256, 64), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 2, 256, 64), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 2, 256, 64), jnp.float32)
+        a = np.asarray(_flash_array(q, k, v, causal=True, window=96))
+        bx = flash_attention_xla(pt.to_tensor(np.asarray(q)),
+                                 pt.to_tensor(np.asarray(k)),
+                                 pt.to_tensor(np.asarray(v)),
+                                 causal=True, window=96)
+        np.testing.assert_allclose(a, np.asarray(bx.numpy()),
+                                   rtol=2e-4, atol=2e-4)
