@@ -32,7 +32,8 @@ class GPTConfig:
                  dropout=0.1, attn_dropout=0.1, initializer_range=0.02,
                  use_recompute=False, sequence_parallel=False,
                  moe_experts=0, moe_k=2, moe_capacity_factor=1.25,
-                 fused_head_loss=None, attn_layout=None):
+                 fused_head_loss=None, attn_layout=None,
+                 attn_window=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -70,6 +71,10 @@ class GPTConfig:
         import os as _os
         self.attn_layout = (attn_layout
                             or _os.environ.get("PT_ATTN_LAYOUT", "bhsd"))
+        # causal sliding-window attention (last W keys per query); the
+        # flash kernels skip KV blocks outside the band — O(S*W) attention
+        # for long context. None = full causal.
+        self.attn_window = None if attn_window is None else int(attn_window)
 
 
 def gpt2_small(**kw):
@@ -94,6 +99,7 @@ class GPTAttention(nn.Layer):
                                  / math.sqrt(2 * cfg.num_layers))))
         self.attn_dropout_p = cfg.attn_dropout
         self.attn_layout = getattr(cfg, "attn_layout", "bhsd")
+        self.attn_window = getattr(cfg, "attn_window", None)
         self.sequence_parallel = cfg.sequence_parallel
         if cfg.sequence_parallel and cfg.attn_dropout:
             import warnings
@@ -123,7 +129,8 @@ class GPTAttention(nn.Layer):
             k = qkv[:, :, 1]
             v = qkv[:, :, 2]
             from ..ops.pallas import flash_attention as _fa
-            out = _fa(q, k, v, causal=True, layout="bshd")
+            out = _fa(q, k, v, causal=True, layout="bshd",
+                      window=self.attn_window)
             out = out.reshape([b, s, h])
             return self.resid_dropout(self.out_proj(out))
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
@@ -145,9 +152,15 @@ class GPTAttention(nn.Layer):
                     f"unknown sequence_parallel={self.sequence_parallel!r}; "
                     "expected False, True/'ring', or 'ulysses'")
         else:
-            out = scaled_dot_product_attention(
-                q, k, v, causal=True, dropout_p=self.attn_dropout_p,
-                training=self.training)
+            if self.attn_window is not None:
+                from ..ops.pallas import flash_attention as _fa
+                out = _fa(q, k, v, causal=True, window=self.attn_window,
+                          dropout_p=(self.attn_dropout_p
+                                     if self.training else 0.0))
+            else:
+                out = scaled_dot_product_attention(
+                    q, k, v, causal=True, dropout_p=self.attn_dropout_p,
+                    training=self.training)
         out = out.transpose([0, 2, 1, 3]).reshape([b, s, h])
         return self.resid_dropout(self.out_proj(out))
 
@@ -174,7 +187,8 @@ class GPTAttention(nn.Layer):
                                                  pos, axis=2)
         from ..nn.transformer import cached_decode_attention
         out = cached_decode_attention(q, ck, cv, pos,
-                                      1.0 / math.sqrt(self.head_dim))
+                                      1.0 / math.sqrt(self.head_dim),
+                                      window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, -1)
         out = self.out_proj(Tensor(out.astype(x_t._data.dtype)))
         return out, (ck, cv)

@@ -375,12 +375,14 @@ def _clone_layer(layer):
     return type(layer)(**layer._config)
 
 
-def cached_decode_attention(q, ck, cv, pos, scale):
+def cached_decode_attention(q, ck, cv, pos, scale, window=None):
     """Single-token cached attention core shared by the GPT and LLaMA
     decoders. q: [B, H, 1, D]; ck/cv: [B, Hkv, L, D] with H % Hkv == 0 —
     grouped (GQA) when H > Hkv, WITHOUT materialising the repeated cache:
     q is reshaped to [B, Hkv, rep, D] and contracted against the
-    un-repeated KV buffers. Returns [B, H, 1, D] in cv.dtype."""
+    un-repeated KV buffers. window=W restricts to the last W cache
+    positions (sliding-window decode matching the training band).
+    Returns [B, H, 1, D] in cv.dtype."""
     import jax
     import jax.numpy as jnp
 
@@ -390,7 +392,10 @@ def cached_decode_attention(q, ck, cv, pos, scale):
     qf = q.astype(jnp.float32).reshape(b, hkv, rep, d)
     scores = jnp.einsum("bkrd,bkld->bkrl", qf,
                         ck.astype(jnp.float32)) * scale
-    mask = jnp.arange(L)[None, None, None, :] <= pos
+    ks = jnp.arange(L)[None, None, None, :]
+    mask = ks <= pos
+    if window is not None:
+        mask = mask & (ks > pos - window)
     scores = jnp.where(mask, scores, -1e9)
     probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
     out = jnp.einsum("bkrl,bkld->bkrd", probs, cv)

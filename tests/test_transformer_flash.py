@@ -419,3 +419,31 @@ class TestSlidingWindow:
                                  causal=True, window=96)
         np.testing.assert_allclose(a, np.asarray(bx.numpy()),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_window_train_and_decode_consistent():
+    """attn_window on GPTConfig: the training forward uses the banded
+    kernel, and KV-cache decode applies the same band — frontier logits
+    from decode match the full forward at every position."""
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=2, max_seq_len=160, dropout=0.0,
+                    attn_dropout=0.0, attn_window=48)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    ids = np.random.RandomState(0).randint(0, 128, (1, 160)) \
+        .astype("int32")
+    full = np.asarray(m(pt.to_tensor(ids)).numpy())    # [1, S, V]
+
+    caches = m.init_cache(1, 160)
+    import jax.numpy as jnp
+    got = []
+    for t in range(160):
+        logits, caches = m.decode_step(
+            pt.to_tensor(ids[:, t:t + 1]), caches, jnp.int32(t))
+        arr = logits.numpy() if hasattr(logits, "numpy") else logits
+        got.append(np.asarray(arr)[:, 0])
+    got = np.stack(got, axis=1)
+    np.testing.assert_allclose(got, full, rtol=2e-3, atol=2e-3)
