@@ -32,12 +32,17 @@ def log(m):
     print(f"[{time.time()-t0:7.1f}s] {m}", flush=True)
 
 
-for seq in [int(a) for a in sys.argv[1:]] or [4096, 8192]:
+# rows: full causal at 4k/8k, plus sliding-window 1024 at 8k (the banded
+# kernel skips KV blocks outside the last-W band: O(S*W) attention)
+ROWS = ([(int(a), None) for a in sys.argv[1:]]
+        or [(4096, None), (8192, None), (8192, 1024)])
+for seq, window in ROWS:
     batch = max(1, 8192 // seq)
     pt.seed(0)
     cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
                     num_heads=12, max_seq_len=seq, dropout=0.0,
-                    attn_dropout=0.0, use_recompute=(seq >= 8192))
+                    attn_dropout=0.0, use_recompute=(seq >= 8192),
+                    attn_window=window)
     model = GPTForPretraining(cfg)
     model.to(dtype=jnp.bfloat16)
     opt = pt.optimizer.AdamW(learning_rate=1e-4,
@@ -49,7 +54,7 @@ for seq in [int(a) for a in sys.argv[1:]] or [4096, 8192]:
         t1 = time.time()
         loss = step(ids, ids)
         v = float(loss.numpy())
-        log(f"seq={seq} b={batch} warm {i}: {time.time()-t1:.1f}s "
+        log(f"seq={seq}{f'-w{window}' if window else ''} b={batch} warm {i}: {time.time()-t1:.1f}s "
             f"loss={v:.4f}")
     iters = 10
     t1 = time.time()
@@ -60,7 +65,7 @@ for seq in [int(a) for a in sys.argv[1:]] or [4096, 8192]:
     toks = batch * seq / dt
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tf = toks * 6 * n_params / 1e12
-    log(f"seq={seq}: {dt*1e3:.1f} ms/step  {toks:,.0f} tok/s  "
+    log(f"seq={seq}{f'-w{window}' if window else ''}: {dt*1e3:.1f} ms/step  {toks:,.0f} tok/s  "
         f"{tf:.1f} TF/s  MFU={tf/PEAK_TFLOPS:.3f} "
         f"(attn-flops excluded from MFU)")
     del step, model, opt

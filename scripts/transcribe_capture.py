@@ -22,7 +22,7 @@ RESULT_RE = re.compile(
     r"(?P<toks>[\d,]+) (?:tok|imgs?|samples)/s\s+(?P<tf>[\d.]+) TF/s\s+"
     r"MFU=(?P<mfu>[\d.]+)")
 SEQ_RE = re.compile(
-    r"\]\s+seq=(?P<seq>\d+):\s+(?P<ms>[\d.]+) ms/step\s+"
+    r"\]\s+seq=(?P<seq>\d+(?:-w\d+)?):\s+(?P<ms>[\d.]+) ms/step\s+"
     r"(?P<toks>[\d,]+) tok/s\s+(?P<tf>[\d.]+) TF/s\s+MFU=(?P<mfu>[\d.]+)")
 MARK = "<!-- transcribe_capture -->"
 
