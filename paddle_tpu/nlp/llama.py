@@ -32,7 +32,8 @@ class LlamaConfig:
                  num_kv_heads=None, max_seq_len=2048, rope_theta=10000.0,
                  rms_eps=1e-6, initializer_range=0.02,
                  use_recompute=False, tie_embeddings=True,
-                 attn_layout=None, fused_head_loss=None):
+                 attn_layout=None, fused_head_loss=None,
+                 attn_window=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         # LLaMA sizing: 2/3 * 4h rounded; callers may pass exact values
@@ -54,6 +55,11 @@ class LlamaConfig:
         # GPTConfig.fused_head_loss (None = by logits size)
         self.fused_head_loss = (None if fused_head_loss is None
                                 else bool(fused_head_loss))
+        # causal sliding-window attention (LLaMA + GQA + window = the
+        # Mistral recipe); the banded flash kernel skips out-of-band KV
+        # blocks in training and the decode band matches (see
+        # cached_decode_attention)
+        self.attn_window = None if attn_window is None else int(attn_window)
         self.tie_embeddings = tie_embeddings
         if num_heads % self.num_kv_heads:
             raise ValueError(f"num_heads {num_heads} not divisible by "
@@ -146,7 +152,7 @@ def _rope_tensor_tables(seq_len, head_dim, theta):
 
 
 def _llama_attention_raw(x, wqkv, cos, sin, num_heads=1, num_kv_heads=1,
-                         head_dim=1, attn_layout="bhsd"):
+                         head_dim=1, attn_layout="bhsd", window=None):
     """Registered (desc-serializable) GQA attention: fused qkv matmul,
     RoPE from the cos/sin table inputs, kv-head repeat, causal flash.
     The rope tables ride as const inputs so captured LLaMA programs
@@ -168,7 +174,8 @@ def _llama_attention_raw(x, wqkv, cos, sin, num_heads=1, num_kv_heads=1,
             rep = nh // nkv
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
-        o = _flash_array(q, k, v, causal=True, layout="bshd")
+        o = _flash_array(q, k, v, causal=True, layout="bshd",
+                         window=window)
         return o.reshape(b, s, nh * hd)
     q = apply_rope(q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3), cos, sin)
     k = apply_rope(k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3), cos, sin)
@@ -177,7 +184,7 @@ def _llama_attention_raw(x, wqkv, cos, sin, num_heads=1, num_kv_heads=1,
         rep = nh // nkv
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    o = _flash_array(q, k, v, causal=True)
+    o = _flash_array(q, k, v, causal=True, window=window)
     return o.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
 
 
@@ -192,6 +199,7 @@ class LlamaAttention(nn.Layer):
         self.num_kv_heads = cfg.num_kv_heads
         self.head_dim = h // cfg.num_heads
         self.attn_layout = getattr(cfg, "attn_layout", "bhsd")
+        self.attn_window = getattr(cfg, "attn_window", None)
         init = I.Normal(0.0, cfg.initializer_range)
         qkv_out = (cfg.num_heads + 2 * cfg.num_kv_heads) * self.head_dim
         self.qkv_proj = nn.Linear(h, qkv_out, bias_attr=False,
@@ -219,7 +227,9 @@ class LlamaAttention(nn.Layer):
                     {"num_heads": self.num_heads,
                      "num_kv_heads": self.num_kv_heads,
                      "head_dim": self.head_dim,
-                     "attn_layout": self.attn_layout},
+                     "attn_layout": self.attn_layout,
+                     "window": (None if self.attn_window is None
+                                else int(self.attn_window))},
                     name="llama_attention")
         return self.o_proj(out)
 
@@ -257,7 +267,8 @@ class LlamaAttention(nn.Layer):
         cv = jax.lax.dynamic_update_slice_in_dim(cv, v_t.astype(cv.dtype),
                                                  pos, axis=2)
         from ..nn.transformer import cached_decode_attention
-        out = cached_decode_attention(q, ck, cv, pos, 1.0 / math.sqrt(hd))
+        out = cached_decode_attention(q, ck, cv, pos, 1.0 / math.sqrt(hd),
+                                      window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, nh * hd)
         out = self.o_proj(Tensor(out.astype(x_t._data.dtype)))
         return out, (ck, cv)

@@ -226,3 +226,25 @@ def test_llama_fused_head_matches_dense():
         traj[fused] = [float(step(ids, ids).numpy()) for _ in range(4)]
     np.testing.assert_allclose(traj[False], traj[True], rtol=2e-4,
                                atol=2e-4)
+
+
+def test_llama_window_train_decode_consistent():
+    """attn_window on LlamaConfig (LLaMA + GQA + window = the Mistral
+    recipe): decode frontier logits match the banded training forward."""
+    pt.seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=96,
+                      attn_window=32)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    ids = np.random.RandomState(0).randint(0, 128, (1, 96)).astype("int32")
+    full = np.asarray(m(pt.to_tensor(ids)).numpy())
+    caches = m.init_cache(1, 96)
+    got = []
+    for t in range(96):
+        logits, caches = m.decode_step(
+            pt.to_tensor(ids[:, t:t + 1]), caches, jnp.int32(t))
+        arr = logits.numpy() if hasattr(logits, "numpy") else logits
+        got.append(np.asarray(arr)[:, 0])
+    got = np.stack(got, axis=1)
+    np.testing.assert_allclose(got, full, rtol=2e-3, atol=2e-3)
