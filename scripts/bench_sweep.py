@@ -39,6 +39,18 @@ def log(m):
 
 def _measure(step, inputs, labels, tag, per_step_samples, flops_per_step,
              unit):
+    """Measures one config; an OOM/compile failure banks a verdict line
+    instead of killing the sweep (the watchdog would otherwise retry the
+    whole step forever on a deterministically-too-big config)."""
+    try:
+        _measure_inner(step, inputs, labels, tag, per_step_samples,
+                       flops_per_step, unit)
+    except Exception as e:  # noqa: BLE001 — banked negative verdict
+        log(f"{tag}: FAILED {type(e).__name__}: {str(e)[:300]}")
+
+
+def _measure_inner(step, inputs, labels, tag, per_step_samples,
+                   flops_per_step, unit):
     warm = int(os.environ.get("BENCH_WARM", 3))
     for i in range(warm):
         t1 = time.time()
