@@ -101,6 +101,12 @@ class GPTAttention(nn.Layer):
         self.attn_layout = getattr(cfg, "attn_layout", "bhsd")
         self.attn_window = getattr(cfg, "attn_window", None)
         self.sequence_parallel = cfg.sequence_parallel
+        if self.attn_window is not None and cfg.sequence_parallel:
+            raise ValueError(
+                "attn_window with sequence_parallel is not implemented: "
+                "the ring/ulysses paths compute full causal attention "
+                "(a silent full-attention fallback would train a "
+                "different model than configured)")
         if cfg.sequence_parallel and cfg.attn_dropout:
             import warnings
             warnings.warn(
