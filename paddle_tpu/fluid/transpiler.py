@@ -157,13 +157,20 @@ class _TrainerProgram:
         t = self._t
         if self._client is None:
             self._connect()
-        base = self._client.pull_dense(0, t._codec.total)
-        for n, arr in t._codec.unflatten(base).items():
-            t._program._persist[n]._data = jnp.asarray(arr)
-        outs = exe.run(t._program, feed=feed, fetch_list=fetch_list,
-                       **run_kw)
-        delta = t._codec.flatten(self._params()) - base
-        self._client.push_dense_delta(0, delta)
+        # trainers sharing ONE transpiler in-process (threaded test
+        # harnesses) serialize the pull/run/push critical section: the
+        # Executor donates the program's param buffers, so interleaved
+        # runs on the same program race on deleted buffers. The sync
+        # barrier stays OUTSIDE the lock (a barrier inside would
+        # deadlock the waiting trainer against the lock holder).
+        with t._run_lock:
+            base = self._client.pull_dense(0, t._codec.total)
+            for n, arr in t._codec.unflatten(base).items():
+                t._program._persist[n]._data = jnp.asarray(arr)
+            outs = exe.run(t._program, feed=feed, fetch_list=fetch_list,
+                           **run_kw)
+            delta = t._codec.flatten(self._params()) - base
+            self._client.push_dense_delta(0, delta)
         if t._sync_mode:
             self._client.barrier(t._trainers, worker_id=t._trainer_id)
         return outs
@@ -182,9 +189,11 @@ class DistributeTranspiler:
     trainer scripts already do (test_fluid_compat.py)."""
 
     def __init__(self, config=None):
+        import threading
         self.config = config or DistributeTranspilerConfig()
         self._server = None
         self._heartbeat_timeout_s = 10.0
+        self._run_lock = threading.Lock()
 
     def transpile(self, trainer_id, program=None, pservers="",
                   trainers=1, sync_mode=True, startup_program=None,
