@@ -84,3 +84,12 @@ def test_label_semantic_roles_converges():
     r = _run_example("label_semantic_roles.py", "--steps", "160")
     assert r["last_loss"] < r["first_loss"] * 0.2, r
     assert r["tag_acc"] > 0.9, r
+
+
+def test_long_context_window_converges():
+    """Sliding-window GPT (attn_window=64, recompute) converges on a
+    pure local-dependency stream at seq 1024 — the banded kernel
+    integration check (round-5 capability)."""
+    r = _run_example("long_context_window.py", "--steps", "100",
+                     timeout=900)
+    assert r["last_loss"] < r["first_loss"] * 0.1, r
