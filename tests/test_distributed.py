@@ -227,3 +227,36 @@ class TestShardedLossParams:
         assert l < l0
         s1 = float(np.asarray(m.scale.numpy())[0])
         assert abs(s1 - s0) > 1e-4, "loss-only param did not train (sharded)"
+
+
+class TestWindowSharded:
+    def test_windowed_gpt_dp_mp_matches_single_device(self):
+        """attn_window under GSPMD (dp x mp): sharded loss trajectory ==
+        single-device — the banded attention partitions like the full
+        causal path."""
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+        from paddle_tpu.nlp.gpt import gpt_pretrain_loss
+
+        ids = np.random.RandomState(0).randint(0, 128, (4, 128)) \
+            .astype("int32")
+
+        def build():
+            pt.seed(7)
+            cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                            num_heads=2, max_seq_len=128, dropout=0.0,
+                            attn_dropout=0.0, attn_window=48)
+            m = GPTForPretraining(cfg)
+            opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+            return m, opt
+
+        m1, o1 = build()
+        s1 = TrainStep(m1, gpt_pretrain_loss, o1)
+        l1 = [float(s1(ids, ids).numpy()) for _ in range(3)]
+
+        m2, o2 = build()
+        make_mesh({"dp": 4, "mp": 2})
+        s2 = ShardedTrainStep(m2, gpt_pretrain_loss, o2)
+        l2 = [float(s2(ids, ids).numpy()) for _ in range(3)]
+        np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-4)
