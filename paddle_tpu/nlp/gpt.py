@@ -63,14 +63,15 @@ class GPTConfig:
         # gpt2s b=8 s=1024 v=32k runs ~20ms/step FASTER dense)
         self.fused_head_loss = (None if fused_head_loss is None
                                 else bool(fused_head_loss))
-        # attention kernel layout: "bhsd" (default) or "bshd" (kernel reads
-        # [B,S,H,D] natively — kills the qkv transposes, but the size-1
-        # head-axis blocks are still unvalidated against real Mosaic
-        # tiling, so it is OPT-IN until measured on-chip; env
-        # PT_ATTN_LAYOUT lets the bench A/B it without code changes)
+        # attention kernel layout: "bshd" (default — kernel reads the
+        # [B,S,H,D] qkv projection natively via packed 128-lane head
+        # groups, no layout transposes) or "bhsd". Measured on-chip
+        # (v5e, 2026-08-01): gpt2s b=8 64.2 vs 66.4 ms/step, BERT-base
+        # b=16 63.9 vs 67.7 — bshd wins both, so it is the default; env
+        # PT_ATTN_LAYOUT lets the bench A/B it without code changes.
         import os as _os
         self.attn_layout = (attn_layout
-                            or _os.environ.get("PT_ATTN_LAYOUT", "bhsd"))
+                            or _os.environ.get("PT_ATTN_LAYOUT", "bshd"))
         # causal sliding-window attention (last W keys per query); the
         # flash kernels skip KV blocks outside the band — O(S*W) attention
         # for long context. None = full causal.
@@ -98,7 +99,7 @@ class GPTAttention(nn.Layer):
             initializer=I.Normal(0.0, cfg.initializer_range
                                  / math.sqrt(2 * cfg.num_layers))))
         self.attn_dropout_p = cfg.attn_dropout
-        self.attn_layout = getattr(cfg, "attn_layout", "bhsd")
+        self.attn_layout = getattr(cfg, "attn_layout", "bshd")
         self.attn_window = getattr(cfg, "attn_window", None)
         self.sequence_parallel = cfg.sequence_parallel
         if self.attn_window is not None and cfg.sequence_parallel:

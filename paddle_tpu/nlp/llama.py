@@ -46,11 +46,11 @@ class LlamaConfig:
         self.rms_eps = rms_eps
         self.initializer_range = initializer_range
         self.use_recompute = use_recompute
-        # attention kernel layout (same opt-in knob as GPTConfig):
-        # "bshd" keeps [B,S,H,D] end to end — no layout transposes
+        # attention kernel layout (same knob as GPTConfig): "bshd"
+        # (default) keeps [B,S,H,D] end to end — no layout transposes
         import os as _os
         self.attn_layout = (attn_layout
-                            or _os.environ.get("PT_ATTN_LAYOUT", "bhsd"))
+                            or _os.environ.get("PT_ATTN_LAYOUT", "bshd"))
         # vocab-chunked fused LM-head+CE, same AUTO semantics as
         # GPTConfig.fused_head_loss (None = by logits size)
         self.fused_head_loss = (None if fused_head_loss is None
@@ -198,7 +198,7 @@ class LlamaAttention(nn.Layer):
         self.num_heads = cfg.num_heads
         self.num_kv_heads = cfg.num_kv_heads
         self.head_dim = h // cfg.num_heads
-        self.attn_layout = getattr(cfg, "attn_layout", "bhsd")
+        self.attn_layout = getattr(cfg, "attn_layout", "bshd")
         self.attn_window = getattr(cfg, "attn_window", None)
         init = I.Normal(0.0, cfg.initializer_range)
         qkv_out = (cfg.num_heads + 2 * cfg.num_kv_heads) * self.head_dim

@@ -41,11 +41,12 @@ class MultiHeadAttention(Layer):
                  attn_layout=None):
         super().__init__()
         import os as _os
-        # "bshd": the flash kernel reads [B,S,H,D] straight off the
-        # projections — no layout transposes (same opt-in knob as
-        # GPTConfig.attn_layout; PT_ATTN_LAYOUT lets benches A/B it)
+        # "bshd" (default): the flash kernel reads [B,S,H,D] straight
+        # off the projections — no layout transposes (same knob as
+        # GPTConfig.attn_layout, measured faster on-chip for both GPT
+        # and BERT topologies; PT_ATTN_LAYOUT lets benches A/B it)
         self.attn_layout = (attn_layout
-                            or _os.environ.get("PT_ATTN_LAYOUT", "bhsd"))
+                            or _os.environ.get("PT_ATTN_LAYOUT", "bshd"))
         self.embed_dim = embed_dim
         self.kdim = kdim or embed_dim
         self.vdim = vdim or embed_dim
