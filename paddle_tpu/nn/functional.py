@@ -746,22 +746,67 @@ def _batch_norm_raw(v, rm, rv, *wb, ch_axis=1, momentum=0.9, epsilon=1e-5,
     ch = ch_axis % v.ndim
     shape = [1] * v.ndim
     shape[ch] = v.shape[ch]
+    # stats/apply in f32 (bf16 inputs must not accumulate in bf16), or in
+    # f64 when the caller is already double-precision (x64 mode)
+    stat_dt = v.dtype if v.dtype == jnp.float64 else jnp.float32
+    xf = v.astype(stat_dt)
     if training:
+        # Single-pass stats: the centered sum and sum-of-squares are
+        # INDEPENDENT reductions over the same input, so XLA
+        # sibling-fuses them into one HBM sweep; the mean-then-var form
+        # chains two sweeps (var needs the mean first) and dominated the
+        # resnet50 step on-chip (53 BN layers — see
+        # docs/perf/traces/resnet). Stats in f32: bf16 activations would
+        # otherwise accumulate in bf16. Centering the pass on a cheap
+        # per-channel pivot (spatial mean of batch element 0, ~m within
+        # a few std) keeps E[(x-p)^2] - (m-p)^2 far from the
+        # catastrophic cancellation the naive E[x^2] - m^2 form hits
+        # when |mean| >> std; the pivot slice is 1/N of the data so the
+        # extra reduction is noise.
         reduce_axes = tuple(i for i in range(v.ndim) if i != ch)
-        m = jnp.mean(v, axis=reduce_axes)
-        var = jnp.var(v, axis=reduce_axes)
-        new_rm = momentum * rm + (1 - momentum) * m
-        new_rv = momentum * rv + (1 - momentum) * var
-        inv = lax.rsqrt(var.reshape(shape) + epsilon)
-        out = (v - m.reshape(shape)) * inv
+        n = 1.0
+        for i in reduce_axes:
+            n *= v.shape[i]
+        # the pivot averages two independently-sliced subsamples (all of
+        # sample 0, and position 0 of every sample) so that no single
+        # pathological slice — a blank first image, a letterboxed corner
+        # — can leave the pivot far from the true mean on its own
+        x0 = lax.index_in_dim(xf, 0, axis=0, keepdims=True)
+        p_a = jnp.mean(x0, axis=reduce_axes)           # [C]
+        xs = xf
+        for ax in reduce_axes:
+            if ax != 0:
+                xs = lax.index_in_dim(xs, 0, axis=ax, keepdims=True)
+        p_b = jnp.mean(xs, axis=reduce_axes)           # [C]
+        pivot = lax.stop_gradient(0.5 * (p_a + p_b))
+        xc = xf - pivot.reshape(shape)
+        s1 = jnp.sum(xc, axis=reduce_axes)
+        s2 = jnp.sum(xc * xc, axis=reduce_axes)
+        d = s1 / n                                     # m - pivot
+        var = jnp.maximum(s2 / n - d * d, 0.0)
+        m = d + pivot
+        new_rm = momentum * rm + (1 - momentum) * m.astype(rm.dtype)
+        new_rv = momentum * rv + (1 - momentum) * var.astype(rv.dtype)
+        inv = lax.rsqrt(var + epsilon)
     else:
         new_rm, new_rv = rm, rv
-        inv = lax.rsqrt(rv.reshape(shape) + epsilon)
-        out = (v - rm.reshape(shape)) * inv
+        m = jnp.asarray(rm, stat_dt)
+        inv = lax.rsqrt(jnp.asarray(rv, stat_dt) + epsilon)
+    # explicit centering (x - m) * scale + bias: one fused elementwise
+    # pass, and the subtraction happens at activation magnitude so a
+    # large channel mean never rounds into the O(1) normalized output
+    # (a folded x*scale+shift would put ~|mean|*inv-sized terms on both
+    # sides of the add)
+    scale = inv
+    bias = None
     if wb:
-        out = out * wb[0].reshape(shape)
+        scale = inv * jnp.asarray(wb[0], stat_dt)
         if len(wb) > 1:
-            out = out + wb[1].reshape(shape)
+            bias = jnp.asarray(wb[1], stat_dt)
+    out = (xf - m.reshape(shape)) * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    out = out.astype(v.dtype)
     return out, lax.stop_gradient(new_rm), lax.stop_gradient(new_rv)
 
 

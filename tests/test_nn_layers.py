@@ -108,6 +108,28 @@ class TestLayers:
         out2 = bn(x)
         assert out2.shape == [8, 3, 4, 4]
 
+    def test_batchnorm_large_mean_small_std(self):
+        # the single-pass f32 stats must survive |mean| >> std — the
+        # naive E[x^2] - m^2 form catastrophically cancels here (var
+        # clamps to 0 and the output blows up by ~rsqrt(eps)/true-inv)
+        rng = np.random.RandomState(0)
+        for blank_first in (False, True):
+            x = (100.0 + 0.1 * rng.randn(16, 8, 14, 14)).astype("f4")
+            if blank_first:
+                # one pathological slice must not hijack the pivot
+                x[0] = 0.0
+            bn = nn.BatchNorm2D(8, momentum=0.0)  # running = batch stats
+            bn.train()
+            o = bn(pt.to_tensor(x)).numpy()
+            sd = np.sqrt(x.var((0, 2, 3), keepdims=True) + 1e-5)
+            ref = (x - x.mean((0, 2, 3), keepdims=True)) / sd
+            np.testing.assert_allclose(o, ref, atol=2e-3 if not blank_first
+                                       else 2e-2)
+            # running var (momentum 0 => exactly the batch var) picked up
+            # the true variance, not a cancellation clamp-0
+            np.testing.assert_allclose(bn._variance.numpy(),
+                                       x.var((0, 2, 3)), rtol=0.05)
+
     def test_layernorm(self):
         ln = nn.LayerNorm(8)
         x = pt.randn([4, 8])
