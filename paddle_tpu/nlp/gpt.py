@@ -590,9 +590,30 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                 new_caches)
 
     if spec not in programs:
+        def _cache_dtype(p):
+            # KV caches in the model's compute dtype: a bf16 model
+            # decoding with f32 caches doubles the per-token HBM stream
+            # (the decode einsum upcasts scores to f32 either way);
+            # measured 2x decode tok/s on gpt2s b=8, which reads the
+            # full [B,H,L,D] cache pair every token. Decided from the
+            # TRACED params at trace time — jit retraces when param
+            # dtypes change, so model.to(...) after a cached generate
+            # cannot leave a stale dtype baked in — and by element-count
+            # majority, so a model with only a bf16 embedding table
+            # keeps f32 caches for its f32 attention compute.
+            counts = {}
+            for leaf in jax.tree_util.tree_leaves(p):
+                dt = leaf.dtype
+                if dt in (jnp.bfloat16, jnp.float16, jnp.float32):
+                    counts[dt] = counts.get(dt, 0) + int(np.prod(leaf.shape))
+            low = {d: c for d, c in counts.items() if d != jnp.float32}
+            if low and sum(low.values()) > counts.get(jnp.float32, 0):
+                return max(low, key=low.get)
+            return jnp.float32
+
         @jax.jit
         def run_cached(p, b, buf, key):
-            caches = model.init_cache(B, L)
+            caches = model.init_cache(B, L, dtype=_cache_dtype(p))
             finished = jnp.zeros((B,), bool)
             buf, _, _, _ = jax.lax.fori_loop(
                 0, L - 1, make_cached_step(p, b),

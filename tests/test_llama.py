@@ -125,6 +125,38 @@ def test_llama_generate_cache_matches_recompute():
     np.testing.assert_array_equal(out_full.numpy()[:, :4], prompt.numpy())
 
 
+def test_bf16_model_generate_uses_bf16_cache_and_matches():
+    """A bf16 model decodes over bf16 KV caches (halving the per-token
+    cache stream); greedy tokens must match the no-cache bf16 path."""
+    import jax.numpy as jnp
+    from paddle_tpu.nlp import generate
+    from paddle_tpu.nlp.gpt import GPTConfig, GPTForPretraining
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=4, max_seq_len=32, dropout=0.0,
+                    attn_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    m.to(dtype=jnp.bfloat16)
+    prompt = pt.to_tensor(np.random.RandomState(1).randint(0, 64, (2, 4)),
+                          dtype="int32")
+    seen_dtypes = []
+    orig_init = m.init_cache
+
+    def spy_init(b, l, dtype=None, **kw):
+        seen_dtypes.append(dtype)
+        return (orig_init(b, l, dtype=dtype, **kw) if dtype is not None
+                else orig_init(b, l, **kw))
+
+    m.init_cache = spy_init
+    out_full = generate(m, prompt, max_new_tokens=6, use_cache=False)
+    out_cache = generate(m, prompt, max_new_tokens=6, use_cache=True)
+    np.testing.assert_array_equal(out_full.numpy(), out_cache.numpy())
+    # the optimization itself: the traced program requested bf16 caches
+    assert seen_dtypes and all(
+        np.dtype(d) == np.dtype(jnp.bfloat16) for d in seen_dtypes), \
+        seen_dtypes
+
+
 def test_llama_generate_rejects_overlong_decode():
     from paddle_tpu.nlp import generate
     pt.seed(0)
