@@ -1,0 +1,69 @@
+#!/bin/bash
+# Round-5 follow-up battery: re-measure the rows affected by the
+# mid-window changes (bshd default, single-pass BN, bf16 decode caches)
+# once the op sweep releases the chip. Same capture-log/done-marker
+# discipline as tpu_watchdog.sh so transcribe_capture picks the rows up.
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="/root/repo:/root/.axon_site"
+mkdir -p .probe docs/perf
+
+note() { echo "[remeasure $(date -u +%H:%M:%S)] $*"; }
+
+probe() {
+  python - <<'EOF'
+import subprocess, sys
+try:
+    p = subprocess.run([sys.executable, "-c",
+        "import jax; assert jax.default_backend() != 'cpu'"],
+        capture_output=True, timeout=150)
+except subprocess.TimeoutExpired:
+    sys.exit(1)
+sys.exit(p.returncode)
+EOF
+}
+
+run_step() {
+  local name="$1" to="$2"; shift 2
+  [ -f ".probe/done_r5_${name}" ] && return 0
+  note "step ${name} starting (timeout ${to}s)"
+  timeout "$to" "$@" > "docs/perf/capture_${name}.log" 2>&1
+  local rc=$?
+  if [ $rc -eq 0 ] && ! grep -q '"error"' "docs/perf/capture_${name}.log"; then
+    touch ".probe/done_r5_${name}"
+    note "step ${name} DONE"
+    return 0
+  fi
+  note "step ${name} failed rc=$rc (tail: $(tail -c 200 docs/perf/capture_${name}.log | tr '\n' ' '))"
+  return 1
+}
+
+# wait for the watchdog's op sweep to finish before touching the chip
+while pgrep -f "op_sweep_tpu.py" > /dev/null 2>&1 || \
+      pgrep -f "tpu_watchdog.sh" > /dev/null 2>&1; do
+  note "watchdog battery still running; waiting"
+  sleep 120
+done
+
+while :; do
+  if probe; then
+    note "TUNNEL UP — running follow-up battery"
+    run_step bench       2400 python bench.py                         || { sleep 60; continue; }
+    probe || continue
+    run_step sweep_gpt   3000 python scripts/bench_sweep.py gpt 8 16  || { sleep 60; continue; }
+    probe || continue
+    run_step sweep_resnet 2400 python scripts/bench_sweep.py resnet 128 || { sleep 60; continue; }
+    probe || continue
+    run_step decode      3000 python scripts/bench_decode.py          || { sleep 60; continue; }
+    probe || continue
+    run_step sweep_bert  2400 python scripts/bench_sweep.py bert 16   || { sleep 60; continue; }
+    probe || continue
+    run_step trace_gpt   2400 python scripts/capture_trace.py gpt 8   || { sleep 60; continue; }
+    python scripts/transcribe_capture.py >> docs/perf/capture_transcribe.log 2>&1 \
+      && note "FOLLOW-UP COMPLETE" || note "transcription FAILED"
+    break
+  else
+    note "tunnel down; sleeping 480s"
+    sleep 480
+  fi
+done
