@@ -107,10 +107,10 @@ while :; do
     # resumable via its own jsonl, so a timeout here still banks partials
     run_step op_sweep    5400 python scripts/op_sweep_tpu.py          || { sleep 60; continue; }
     if python scripts/transcribe_capture.py \
-        >> docs/perf/capture_transcribe.log 2>&1; then
-      note "BATTERY COMPLETE ($(tail -1 docs/perf/capture_transcribe.log))"
+        >> .probe/transcribe.log 2>&1; then
+      note "BATTERY COMPLETE ($(tail -1 .probe/transcribe.log))"
     else
-      note "BATTERY COMPLETE but transcription FAILED — see docs/perf/capture_transcribe.log"
+      note "BATTERY COMPLETE but transcription FAILED — see .probe/transcribe.log"
     fi
     break
   else

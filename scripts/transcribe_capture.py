@@ -18,7 +18,7 @@ LOG = os.path.join(REPO, "docs", "perf")
 MARK_END = "<!-- /transcribe_capture -->"
 
 RESULT_RE = re.compile(
-    r"\]\s+(?P<label>.+?):\s+(?P<ms>[\d.]+) ms/step\s+"
+    r"\]\s+(?:RESULT\s+)?(?P<label>.+?):\s+(?P<ms>[\d.]+) ms/step\s+"
     r"(?P<toks>[\d,]+) (?:tok|imgs?|samples)/s\s+(?P<tf>[\d.]+) TF/s\s+"
     r"MFU=(?P<mfu>[\d.]+)")
 SEQ_RE = re.compile(
@@ -154,12 +154,28 @@ def main():
         lc = os.path.join(LOG, "LONGCTX.md")
         text = open(lc).read()
         for step, seq, ms, toks, mfu in seq_rows:
-            batch = max(1, 8192 // int(seq))
+            # "8192" or "8192-w1024" (sliding-window row)
+            ms_lbl = re.match(r"(\d+)(?:-w(\d+))?$", seq)
+            base, win = int(ms_lbl.group(1)), ms_lbl.group(2)
+            batch = max(1, 8192 // base)
+            label = f"{base} (window {win})" if win else seq
             text, n = re.subn(
-                rf"\| {seq} \| {batch} \| [^|]+\| [^|]+\| [^|]+\|[^|\n]*\|",
-                f"| {seq} | {batch} | {ms} | {toks} | {mfu} | "
+                rf"\| {re.escape(label)} \| {batch} \| "
+                rf"[^|]+\| [^|]+\| [^|]+\|[^|\n]*\|",
+                f"| {label} | {batch} | {ms} | {toks} | {mfu} | "
                 f"measured {stamp} |",
                 text)
+            if n:
+                filled += n
+                continue
+            # no slot yet (new config): append to the throughput table
+            row = (f"| {label} | {batch} | {ms} | {toks} | {mfu} | "
+                   f"measured {stamp} |")
+            text, n = re.subn(
+                r"(\| seq \| batch \| ms/step \| tok/s \| MFU \| status \|"
+                r"\n(?:\|[^\n]*\|\n)+)",
+                lambda mo: mo.group(1) + row + "\n",
+                text, count=1)
             if n:
                 filled += n
             else:

@@ -239,14 +239,25 @@ def SPD(n=3, seed=0):
 class S:
     """inputs: arrays; attrs: JSON-able kwargs; grad: finite-diff check;
     desc: static round-trip (False for rng-key inputs); out0: grad/desc use
-    only output[0] (multi-output ops with stop-gradient side outputs)."""
+    only output[0] (multi-output ops with stop-gradient side outputs);
+    place_cmp="abs": cross-place parity compares |out| — for
+    decompositions (svd/qr/eigh) whose factors are defined only up to a
+    sign gauge, so CPU and accelerator backends legitimately return
+    opposite-sign vectors (ref op_test.py handles decomposition ops
+    with reconstruction-based checks for the same reason)."""
 
-    def __init__(self, inputs, attrs=None, grad=True, desc=True, out0=False):
+    def __init__(self, inputs, attrs=None, grad=True, desc=True, out0=False,
+                 place_cmp=None, reconstruct=None):
         self.inputs = inputs
         self.attrs = attrs or {}
         self.grad = grad
         self.desc = desc
         self.out0 = out0
+        self.place_cmp = place_cmp
+        # rebuilds inputs[0] from the op outputs; run per place under
+        # place_cmp="abs" so a genuinely corrupted factor (not a mere
+        # gauge flip) still fails cross-place parity
+        self.reconstruct = reconstruct
 
 
 _A = F32()          # default activation input
@@ -348,9 +359,13 @@ SPECS = {
     "slogdet": S([SPD()], grad=False),
     "matrix_power": S([SPD()], {"n": 2}, grad=False),
     "matrix_rank": S([SPD()], grad=False),
-    "svd": S([F32((3, 3))], {"full_matrices": False}, grad=False, out0=True),
-    "qr": S([F32((3, 3))], {"mode": "reduced"}, grad=False, out0=True),
-    "eigh": S([SPD()], grad=False, out0=True),
+    "svd": S([F32((3, 3))], {"full_matrices": False}, grad=False, out0=True,
+         place_cmp="abs",
+         reconstruct=lambda o: o[0] @ np.diag(o[1]) @ o[2].T),
+    "qr": S([F32((3, 3))], {"mode": "reduced"}, grad=False, out0=True,
+        place_cmp="abs", reconstruct=lambda o: o[0] @ o[1]),
+    "eigh": S([SPD()], grad=False, out0=True, place_cmp="abs",
+          reconstruct=lambda o: o[1] @ np.diag(o[0]) @ o[1].T),
     "eigvalsh": S([SPD()], grad=False),
     "solve": S([SPD(), F32((3, 2))], grad=False),
     "triangular_solve": S([np.tril(SPD()).astype("f4"), F32((3, 2))],
@@ -1160,6 +1175,9 @@ def run_cross_place_checks(name, rtol=5e-2, atol=5e-3):
         a, b = np.asarray(a), np.asarray(b)
         if a.shape != b.shape:
             raise OpCheckFailure(tag, f"shape {a.shape} vs {b.shape}")
+        if spec.place_cmp == "abs" and a.dtype.kind in "fc":
+            # decomposition factors: gauge-fix the +-1 sign freedom
+            a, b = np.abs(a), np.abs(b)
         if a.dtype.kind in "fc" or b.dtype.kind in "fc":
             # bf16 tile precision on the accelerator: compare in f32
             # with MXU-tolerant bounds
@@ -1183,3 +1201,17 @@ def run_cross_place_checks(name, rtol=5e-2, atol=5e-3):
         compare(f"place_out[{j}]", a, b)
     if dev_g is not None:
         compare("place_grad", dev_g, cpu_g)
+    if spec.reconstruct is not None:
+        # per-place reconstruction: the factors must actually decompose
+        # the input on EACH backend — catches a corrupted element that
+        # the gauge-fixed |.| compare would wave through
+        x0 = np.asarray(spec.inputs[0], dtype="f4")
+        for place, outs in (("dev", dev_outs), ("cpu", cpu_outs)):
+            rec = np.asarray(spec.reconstruct(
+                [np.asarray(o, dtype="f4") for o in outs]))
+            if not np.allclose(rec, x0, rtol=rtol, atol=atol):
+                i = int(np.argmax(np.abs(rec - x0)))
+                raise OpCheckFailure(
+                    f"place_reconstruct[{place}]",
+                    f"flat[{i}]: rec={rec.reshape(-1)[i]:.5g} "
+                    f"x={x0.reshape(-1)[i]:.5g}")
