@@ -59,7 +59,7 @@ while :; do
     run_step sweep_bert  2400 python scripts/bench_sweep.py bert 16   || { sleep 60; continue; }
     probe || continue
     run_step trace_gpt   2400 python scripts/capture_trace.py gpt 8   || { sleep 60; continue; }
-    python scripts/transcribe_capture.py >> docs/perf/capture_transcribe.log 2>&1 \
+    python scripts/transcribe_capture.py >> .probe/transcribe.log 2>&1 \
       && note "FOLLOW-UP COMPLETE" || note "transcription FAILED"
     break
   else

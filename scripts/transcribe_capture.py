@@ -160,7 +160,7 @@ def main():
             batch = max(1, 8192 // base)
             label = f"{base} (window {win})" if win else seq
             text, n = re.subn(
-                rf"\| {re.escape(label)} \| {batch} \| "
+                rf"\| {re.escape(label)} \| \d+ \| "
                 rf"[^|]+\| [^|]+\| [^|]+\|[^|\n]*\|",
                 f"| {label} | {batch} | {ms} | {toks} | {mfu} | "
                 f"measured {stamp} |",
