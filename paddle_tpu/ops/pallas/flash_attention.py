@@ -38,6 +38,30 @@ def _blk(pref, n):
     return max(b, 128)
 
 
+def _causal_block_bounds(off, qblk, bq, bk, nblocks, window):
+    """KV-block loop bounds for one q block under causal(+window) masking:
+    returns (lower, lo_mid, mid, upper) with [lower, lo_mid) window-edge
+    blocks (masked), [lo_mid, mid) interior blocks (every (q, k) pair in
+    band — no mask chain needed), and [mid, upper) diagonal-edge blocks
+    (masked). The kernels are VPU-bound at small head_dim, so skipping
+    the 2-iota+compare+select chain on interior blocks matters. Shared
+    by _fwd_kernel and _bwd_dq_kernel; _bwd_dkv_kernel iterates the
+    transposed direction with its own bounds."""
+    qlo = off + qblk * bq                 # first absolute q row
+    diag = off + (qblk + 1) * bq
+    upper = jnp.minimum(nblocks, (diag + bk - 1) // bk)
+    # interior from the right: all k_idx <= min q_idx
+    mid = jnp.minimum(jnp.maximum(0, (qlo + 1) // bk), upper)
+    lower = 0
+    lo_mid = jnp.int32(0)
+    if window is not None:
+        lower = jnp.maximum(0, (qlo - window + 1) // bk)
+        # interior from the left: all k_idx > max q_idx - window
+        lo_mid = jnp.minimum(
+            jnp.maximum(lower, -(-(diag - window) // bk)), mid)
+    return lower, lo_mid, mid, upper
+
+
 def _sds(shape, dtype, *arrs):
     """ShapeDtypeStruct matching the varying-manual-axes (vma) of the
     inputs: under a vma-checked shard_map (partial-manual hybrid meshes),
@@ -123,51 +147,69 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     qblk = pl.program_id(1)
     outs = []
     for g in range(gsz):
-        q = _q2(q_ref, g, dp).astype(jnp.float32) * scale  # [BQ, D]
+        # dots take the INPUT dtype (bf16 on the bench path) with f32
+        # accumulation via preferred_element_type — an f32 upcast before
+        # the dot runs the MXU at its much slower f32 rate (measured:
+        # fwd kernel 0.59 -> ~0.2 ms/layer on gpt2s b=8). Softmax stats
+        # (m/l/lse) and the accumulator stay f32; scale applies post-dot.
+        q = _q2(q_ref, g, dp)                              # [BQ, D]
 
         m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((bq, 1), jnp.float32)
         acc0 = jnp.zeros((bq, dp), jnp.float32)
 
-        def body(j, carry):
-            m, l, acc = carry
-            kblk = _kslice(k_ref, j * bk, bk, g, dp).astype(jnp.float32)
-            vblk = _kslice(v_ref, j * bk, bk, g, dp).astype(jnp.float32)
-            s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-            if causal:
-                # absolute query position includes the (klen - qlen) decode
-                # offset so semantics match _sdpa_reference for sq != sk
-                q_idx = ((kv_len - q_len) + qblk * bq
-                         + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
-                k_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32,
-                                                          (bq, bk), 1)
-                s = jnp.where(_band_keep(q_idx, k_idx, window), s,
-                              -jnp.inf)
-            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-            # guard fully-masked rows (m_new = -inf): shift by 0 there
-            shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-            p = jnp.exp(s - shift)
-            alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - shift, -jnp.inf))
-            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            acc_new = acc * alpha + jax.lax.dot_general(
-                p, vblk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            return m_new, l_new, acc_new
+        def make_body(masked):
+            def body(j, carry):
+                m, l, acc = carry
+                kblk = _kslice(k_ref, j * bk, bk, g, dp)
+                vblk = _kslice(v_ref, j * bk, bk, g, dp)
+                s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32
+                                        ) * scale
+                if masked:
+                    # absolute query position includes the (klen - qlen)
+                    # decode offset so semantics match _sdpa_reference
+                    # for sq != sk
+                    q_idx = ((kv_len - q_len) + qblk * bq
+                             + jax.lax.broadcasted_iota(jnp.int32,
+                                                        (bq, bk), 0))
+                    k_idx = j * bk + jax.lax.broadcasted_iota(
+                        jnp.int32, (bq, bk), 1)
+                    s = jnp.where(_band_keep(q_idx, k_idx, window), s,
+                                  -jnp.inf)
+                m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+                # guard fully-masked rows (m_new = -inf): shift by 0 there
+                shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+                p = jnp.exp(s - shift)
+                alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - shift,
+                                          -jnp.inf))
+                l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                acc_new = acc * alpha + jax.lax.dot_general(
+                    p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return m_new, l_new, acc_new
+            return body
 
         if causal:
-            # only blocks up to (and including) the diagonal contribute
-            diag = kv_len - q_len + (qblk + 1) * bq
-            upper = jnp.minimum(nblocks, (diag + bk - 1) // bk)
-            lower = 0
+            # edge/interior split (_causal_block_bounds): only blocks up
+            # to the diagonal are visited; the mask chain runs on EDGE
+            # blocks only
+            lower, lo_mid, mid, upper = _causal_block_bounds(
+                kv_len - q_len, qblk, bq, bk, nblocks, window)
+            carry = (m0, l0, acc0)
             if window is not None:
-                # blocks entirely left of every row's window are skipped
-                first = kv_len - q_len + qblk * bq - window + 1
-                lower = jnp.maximum(0, first // bk)
-            m, l, acc = jax.lax.fori_loop(lower, upper, body,
-                                          (m0, l0, acc0))
+                carry = jax.lax.fori_loop(lower, lo_mid, make_body(True),
+                                          carry)
+                carry = jax.lax.fori_loop(lo_mid, mid, make_body(False),
+                                          carry)
+            else:
+                carry = jax.lax.fori_loop(lower, mid, make_body(False),
+                                          carry)
+            m, l, acc = jax.lax.fori_loop(mid, upper, make_body(True),
+                                          carry)
         else:
-            m, l, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, acc0))
+            m, l, acc = jax.lax.fori_loop(0, nblocks, make_body(False),
+                                          (m0, l0, acc0))
         outs.append((acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype))
         # lse = m + log l (finite-m guard matches the shift guard above).
         # lse_ref holds FULL [1, gsz, q_len] rows (TPU block constraint:
@@ -266,48 +308,74 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref,
     nqb = q_len // bq
     dks, dvs = [], []
     for g in range(gsz):
-        kblk = _q2(k_ref, g, dp).astype(jnp.float32)     # [BK, D]
-        vblk = _q2(v_ref, g, dp).astype(jnp.float32)
+        # same mixed-precision discipline as _fwd_kernel: dots in the
+        # input dtype with f32 accumulation; p/ds downcast for the
+        # second-stage dots (standard flash practice), stats stay f32
+        kblk = _q2(k_ref, g, dp)                         # [BK, D]
+        vblk = _q2(v_ref, g, dp)
 
         dk0 = jnp.zeros((bk, dp), jnp.float32)
         dv0 = jnp.zeros((bk, dp), jnp.float32)
 
-        def body(i, carry):
-            dk, dv = carry
-            q = _kslice(q_ref, i * bq, bq, g, dp).astype(jnp.float32)
-            do = _kslice(do_ref, i * bq, bq, g, dp).astype(jnp.float32)
-            lse = lse_ref[0, g, pl.ds(i * bq, bq)].reshape(bq, 1)
-            dd = dd_ref[0, g, pl.ds(i * bq, bq)].reshape(bq, 1)
-            s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32
-                                    ) * scale
-            p = jnp.exp(s - lse)                        # [BQ, BK]
-            if causal:
-                q_idx = ((kv_len - q_len) + i * bq
-                         + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
-                k_idx = kb * bk + jax.lax.broadcasted_iota(jnp.int32,
-                                                           (bq, bk), 1)
-                p = jnp.where(_band_keep(q_idx, k_idx, window), p, 0.0)
-            dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        def make_body(masked):
+            def body(i, carry):
+                dk, dv = carry
+                q = _kslice(q_ref, i * bq, bq, g, dp)
+                do = _kslice(do_ref, i * bq, bq, g, dp)
+                lse = lse_ref[0, g, pl.ds(i * bq, bq)].reshape(bq, 1)
+                dd = dd_ref[0, g, pl.ds(i * bq, bq)].reshape(bq, 1)
+                s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32
+                                        ) * scale
+                p = jnp.exp(s - lse)                    # [BQ, BK]
+                if masked:
+                    q_idx = ((kv_len - q_len) + i * bq
+                             + jax.lax.broadcasted_iota(jnp.int32,
+                                                        (bq, bk), 0))
+                    k_idx = kb * bk + jax.lax.broadcasted_iota(
+                        jnp.int32, (bq, bk), 1)
+                    p = jnp.where(_band_keep(q_idx, k_idx, window), p, 0.0)
+                dv = dv + jax.lax.dot_general(
+                    p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                dp_ = jax.lax.dot_general(do, vblk,
+                                          (((1,), (1,)), ((), ())),
                                           preferred_element_type=jnp.float32)
-            dp_ = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-            ds = p * (dp_ - dd) * scale                 # [BQ, BK]
-            dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
-            return dk, dv
+                ds = p * (dp_ - dd) * scale             # [BQ, BK]
+                dk = dk + jax.lax.dot_general(
+                    ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return dk, dv
+            return body
 
         if causal:
+            # edge/interior split (see _fwd_kernel): a q block is
+            # INTERIOR to this k block when every (q, k) pair is in the
+            # causal band — q >= k for all pairs, and within the window
+            # when one is set — so only edge q blocks pay the mask chain
+            off = kv_len - q_len
             # first q block whose last row reaches this k block's first row
-            start = jnp.maximum(0, (kb * bk - (kv_len - q_len)) // bq)
+            start = jnp.maximum(0, (kb * bk - off) // bq)
             end = nqb
+            # interior from below: all q_idx >= max k_idx of this k block
+            mid = jnp.minimum(jnp.maximum(
+                start, -(-(kb * bk + bk - 1 - off) // bq)), end)
             if window is not None:
                 # past q_idx >= k_idx + window no query sees this k block
-                last = kb * bk + bk - 1 + window - 1 - (kv_len - q_len)
+                last = kb * bk + bk - 1 + window - 1 - off
                 end = jnp.minimum(nqb, last // bq + 1)
-            dk, dv = jax.lax.fori_loop(start, end, body, (dk0, dv0))
+                # interior from above: all q_idx < min k_idx + window
+                hi_mid = jnp.minimum(end, (kb * bk + window - off) // bq)
+                mid = jnp.minimum(mid, hi_mid)
+            else:
+                hi_mid = end
+            carry = jax.lax.fori_loop(start, mid, make_body(True),
+                                      (dk0, dv0))
+            carry = jax.lax.fori_loop(mid, hi_mid, make_body(False), carry)
+            dk, dv = jax.lax.fori_loop(hi_mid, end, make_body(True), carry)
         else:
-            dk, dv = jax.lax.fori_loop(0, nqb, body, (dk0, dv0))
+            dk, dv = jax.lax.fori_loop(0, nqb, make_body(False),
+                                       (dk0, dv0))
         dks.append(dk.astype(dk_ref.dtype))
         dvs.append(dv.astype(dv_ref.dtype))
     dk_ref[0] = dks[0] if gsz == 1 else jnp.concatenate(dks, axis=-1)
@@ -325,42 +393,49 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
     nkb = kv_len // bk
     dqs = []
     for g in range(gsz):
-        q = _q2(q_ref, g, dp).astype(jnp.float32)        # [BQ, D]
-        do = _q2(do_ref, g, dp).astype(jnp.float32)
+        q = _q2(q_ref, g, dp)                            # [BQ, D]
+        do = _q2(do_ref, g, dp)
         lse = lse_ref[0, g, pl.ds(qblk * bq, bq)].reshape(bq, 1)
         dd = dd_ref[0, g, pl.ds(qblk * bq, bq)].reshape(bq, 1)
         dq0 = jnp.zeros((bq, dp), jnp.float32)
 
-        def body(j, dq):
-            kblk = _kslice(k_ref, j * bk, bk, g, dp).astype(jnp.float32)
-            vblk = _kslice(v_ref, j * bk, bk, g, dp).astype(jnp.float32)
-            s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32
-                                    ) * scale
-            p = jnp.exp(s - lse)
-            if causal:
-                q_idx = ((kv_len - q_len) + qblk * bq
-                         + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
-                k_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32,
-                                                          (bq, bk), 1)
-                p = jnp.where(_band_keep(q_idx, k_idx, window), p, 0.0)
-            dp_ = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-            ds = p * (dp_ - dd) * scale
-            return dq + jax.lax.dot_general(
-                ds, kblk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+        def make_body(masked):
+            def body(j, dq):
+                kblk = _kslice(k_ref, j * bk, bk, g, dp)
+                vblk = _kslice(v_ref, j * bk, bk, g, dp)
+                s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32
+                                        ) * scale
+                p = jnp.exp(s - lse)
+                if masked:
+                    q_idx = ((kv_len - q_len) + qblk * bq
+                             + jax.lax.broadcasted_iota(jnp.int32,
+                                                        (bq, bk), 0))
+                    k_idx = j * bk + jax.lax.broadcasted_iota(
+                        jnp.int32, (bq, bk), 1)
+                    p = jnp.where(_band_keep(q_idx, k_idx, window), p, 0.0)
+                dp_ = jax.lax.dot_general(do, vblk,
+                                          (((1,), (1,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+                ds = p * (dp_ - dd) * scale
+                return dq + jax.lax.dot_general(
+                    ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            return body
 
         if causal:
-            diag = kv_len - q_len + (qblk + 1) * bq
-            upper = jnp.minimum(nkb, (diag + bk - 1) // bk)
-            lower = 0
+            # edge/interior split over k blocks (shared bounds helper)
+            lower, lo_mid, mid, upper = _causal_block_bounds(
+                kv_len - q_len, qblk, bq, bk, nkb, window)
+            dq = dq0
             if window is not None:
-                first = kv_len - q_len + qblk * bq - window + 1
-                lower = jnp.maximum(0, first // bk)
-            dq = jax.lax.fori_loop(lower, upper, body, dq0)
+                dq = jax.lax.fori_loop(lower, lo_mid, make_body(True), dq)
+                dq = jax.lax.fori_loop(lo_mid, mid, make_body(False), dq)
+            else:
+                dq = jax.lax.fori_loop(lower, mid, make_body(False), dq)
+            dq = jax.lax.fori_loop(mid, upper, make_body(True), dq)
         else:
-            dq = jax.lax.fori_loop(0, nkb, body, dq0)
+            dq = jax.lax.fori_loop(0, nkb, make_body(False), dq0)
         dqs.append(dq.astype(dq_ref.dtype))
     dq_ref[0] = dqs[0] if gsz == 1 else jnp.concatenate(dqs, axis=-1)
 
