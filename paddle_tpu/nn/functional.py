@@ -9,6 +9,7 @@ both NCHW (paddle default) and NHWC are accepted.
 import functools
 import math
 import numbers
+import os
 
 import numpy as np
 import jax
@@ -852,9 +853,44 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
 def _layer_norm_raw(a, *wb, nd=1, epsilon=1e-5):
     axes = tuple(range(a.ndim - nd, a.ndim))
-    m = jnp.mean(a, axis=axes, keepdims=True)
-    v = jnp.var(a, axis=axes, keepdims=True)
-    out = (a - m) * lax.rsqrt(v + epsilon)
+    if os.environ.get("PT_LN_SINGLE_PASS", "").lower() in ("1", "true",
+                                                           "yes", "on"):
+        # Experimental single-pass stats (same construction as
+        # _batch_norm_raw: centered sum + sum-of-squares in one fused
+        # sweep, f32 accumulation, first-element pivot, input-dtype
+        # apply). OPT-IN until measured: the BN version won on-chip, but
+        # the LN A/B window closed with only tunnel-degraded samples
+        # (68-70 ms vs the 64-67 ms band), so the proven two-pass path
+        # stays the default — the round-3 lesson is that perf defaults
+        # need an on-chip number.
+        stat_dt = a.dtype if a.dtype == jnp.float64 else jnp.float32
+        af = a.astype(stat_dt)
+        n = 1.0
+        for ax in axes:
+            n *= a.shape[ax]
+        # pivot = mean of a leading lane-aligned stripe of each row (up
+        # to 128 elements per normalized axis), not a single element —
+        # one outlier (padding zero, BOS spike) must not leave the
+        # pivot |d| >> std and re-open the cancellation this construction
+        # avoids (same safeguard idea as _batch_norm_raw's two-subsample
+        # pivot)
+        idx = tuple(slice(None) if i not in axes
+                    else slice(0, min(128, a.shape[i]))
+                    for i in range(a.ndim))
+        pivot = lax.stop_gradient(
+            jnp.mean(af[idx], axis=axes, keepdims=True))
+        ac = af - pivot
+        s1 = jnp.sum(ac, axis=axes, keepdims=True)
+        s2 = jnp.sum(ac * ac, axis=axes, keepdims=True)
+        d = s1 / n
+        v = jnp.maximum(s2 / n - d * d, 0.0)
+        m = (d + pivot).astype(a.dtype)
+        rstd = lax.rsqrt(v + epsilon).astype(a.dtype)
+        out = (a - m) * rstd
+    else:
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * lax.rsqrt(v + epsilon)
     if wb:
         out = out * wb[0]
         if len(wb) > 1:
