@@ -1,0 +1,56 @@
+#!/bin/bash
+# Next-window battery: final-code headline re-bank + the LayerNorm
+# single-pass A/B the 12:00 UTC tunnel drop cut off. Same probe /
+# done-marker discipline as tpu_watchdog.sh.
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="/root/repo:/root/.axon_site"
+mkdir -p .probe docs/perf
+PROBE_INTERVAL=${PROBE_INTERVAL:-480}
+
+note() { echo "[ln_ab $(date -u +%H:%M:%S)] $*"; }
+
+probe() {
+  python - <<'EOF'
+import subprocess, sys
+try:
+    p = subprocess.run([sys.executable, "-c",
+        "import jax; assert jax.default_backend() != 'cpu'"],
+        capture_output=True, timeout=150)
+except subprocess.TimeoutExpired:
+    sys.exit(1)
+sys.exit(p.returncode)
+EOF
+}
+
+run_step() {
+  local name="$1" to="$2"; shift 2
+  [ -f ".probe/done_ab_${name}" ] && return 0
+  note "step ${name} starting (timeout ${to}s)"
+  timeout "$to" "$@" > "docs/perf/capture_${name}.log" 2>&1
+  local rc=$?
+  if [ $rc -eq 0 ] && ! grep -q '"error"' "docs/perf/capture_${name}.log"; then
+    touch ".probe/done_ab_${name}"
+    note "step ${name} DONE: $(grep -a 'ms/step\|vs_baseline' docs/perf/capture_${name}.log | tail -1 | cut -c1-120)"
+    return 0
+  fi
+  note "step ${name} failed rc=$rc"
+  return 1
+}
+
+while :; do
+  if probe; then
+    note "TUNNEL UP"
+    run_step bench     2400 python bench.py                        || { sleep 60; continue; }
+    probe || continue
+    run_step sweep_gpt 3000 python scripts/bench_sweep.py gpt 8 16 || { sleep 60; continue; }
+    probe || continue
+    run_step ln_ab     2400 env PT_LN_SINGLE_PASS=1 python scripts/bench_sweep.py gpt 8 || { sleep 60; continue; }
+    python scripts/transcribe_capture.py >> .probe/transcribe.log 2>&1 \
+      && note "AB BATTERY COMPLETE" || note "transcription FAILED"
+    break
+  else
+    note "tunnel down; sleeping ${PROBE_INTERVAL}s"
+    sleep "$PROBE_INTERVAL"
+  fi
+done
