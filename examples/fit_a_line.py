@@ -1,0 +1,74 @@
+"""Linear regression — the reference book suite's opening case (ref
+python/paddle/fluid/tests/book/test_fit_a_line.py: fluid.data ->
+layers.fc(size=1) -> square_error_cost -> SGD minimize -> Executor
+loop over UCI-housing batches). Written in the UNMODIFIED 1.x fluid
+style on purpose: this example doubles as fluid-compat evidence for
+the oldest script shape a switching user has.
+
+Synthetic housing-style data: 13 standardized features, linear ground
+truth + noise — the fitted MSE must approach the noise floor.
+
+    python examples/fit_a_line.py [--steps 200]
+
+Prints one JSON line with first/final MSE.
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    from paddle_tpu import fluid
+
+    rng = np.random.RandomState(7)
+    w_true = rng.randn(13, 1).astype("f4")
+    noise = 0.1
+
+    def housing_batch(n):
+        x = rng.randn(n, 13).astype("f4")
+        y = x @ w_true + 2.5 + noise * rng.randn(n, 1).astype("f4")
+        return x, y
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+        opt = fluid.optimizer.SGD(learning_rate=0.05)
+        opt.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    t0 = time.time()
+    first = last = None
+    for step in range(args.steps):
+        bx, by = housing_batch(args.batch_size)
+        (mse,) = exe.run(prog, feed={"x": bx, "y": by},
+                         fetch_list=[avg_cost])
+        v = float(mse)
+        if first is None:
+            first = v
+        last = v
+
+    print(json.dumps({
+        "example": "fit_a_line",
+        "steps": args.steps,
+        "first_mse": round(first, 4),
+        "final_mse": round(last, 4),
+        "noise_floor": round(noise * noise, 4),
+        "converged": bool(last < 0.1 * first and last < 5 * noise * noise),
+        "steps_per_sec": round(args.steps / (time.time() - t0), 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,23 @@ def test_machine_translation_converges():
     assert r["beam_token_acc"] > 0.7, r
 
 
+def test_fit_a_line_converges():
+    """The book suite's opening case in UNMODIFIED 1.x fluid style
+    (data -> fc -> square_error_cost -> SGD minimize -> Executor)."""
+    r = _run_example("fit_a_line.py", "--steps", "200")
+    assert r["converged"], r
+    # linear model on linear data: MSE must reach the noise floor
+    assert r["final_mse"] < 5 * r["noise_floor"], r
+
+
+def test_rnn_encoder_decoder_converges():
+    """GRU encoder->decoder with teacher forcing (book suite's
+    rnn_encoder_decoder shape) under the whole-step TrainStep jit."""
+    r = _run_example("rnn_encoder_decoder.py", "--steps", "450")
+    assert r["converged"], r
+    assert r["token_accuracy"] > 0.8, r
+
+
 def test_word2vec_converges():
     r = _run_example("word2vec.py", "--steps", "300")
     assert r["converged"], r
