@@ -110,6 +110,51 @@ def _check_nan_inf(name, outs):
                     f"(FLAGS_check_nan_inf is on)")
 
 
+# Ops with no TPU lowering (complex dtypes: the backend returns
+# UNIMPLEMENTED — measured by the on-chip registry sweep,
+# docs/perf/OP_SWEEP_TPU.md). In eager mode these fall back to the host
+# CPU, the analog of the reference's CPUPlace kernel fallback (ref
+# paddle/fluid/framework/operator.cc ChooseKernel: when no kernel exists
+# for the requested place, the op runs on CPUPlace). Complex outputs
+# stay on host (accelerators cannot hold complex buffers); real-dtyped
+# outputs transfer back to the default device so downstream device ops
+# continue unchanged. Inside jit (functional mode) there is no fallback
+# — a traced program is single-platform by construction.
+HOST_FALLBACK_OPS = {
+    # real -> complex producers (inputs are real, so the dtype check
+    # below cannot catch them); consumers of complex inputs (real, imag,
+    # conj, angle, abs, as_real, ...) are caught by iscomplexobj instead
+    # — on real-dtyped inputs those ops lower fine on the TPU and must
+    # NOT pay a host round-trip
+    "complex", "polar", "as_complex",
+}
+
+
+def _default_backend():
+    """Seam for tests: the live default jax backend name."""
+    return jax.default_backend()
+
+
+def _host_fallback(f):
+    """Wrap a raw op impl to execute on the host CPU device."""
+    @functools.wraps(f)
+    def run(*xs):
+        cpu = jax.devices("cpu")[0]
+        xs = tuple(jax.device_put(x, cpu) if hasattr(x, "dtype") else x
+                   for x in xs)
+        with jax.default_device(cpu):
+            out = f(*xs)
+
+        def back(o):
+            if hasattr(o, "dtype") and not jnp.iscomplexobj(o):
+                return jax.device_put(o, jax.devices()[0])
+            return o
+        if isinstance(out, (tuple, list)):
+            return type(out)(back(o) for o in out)
+        return back(out)
+    return run
+
+
 def apply(fn, tensors, attrs=None, name=None, differentiable=True):
     """Run op `fn(*arrays, **attrs)` on tensor inputs; record GradNode if needed."""
     attrs = attrs or {}
@@ -127,6 +172,18 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
         f = functools.partial(fn, **call_attrs) if call_attrs else fn
     else:
         f = fn
+
+    # f_rec is what recorders capture (static desc -> jit-compiled
+    # Executor programs): ALWAYS the unwrapped impl — the fallback's
+    # device_put/default_device must never be traced into a compiled
+    # program (a traced program is single-platform by construction)
+    f_rec = f
+    if (not state.is_functional_mode()
+            and _default_backend() != "cpu"
+            and (name in HOST_FALLBACK_OPS
+                 or any(jnp.iscomplexobj(a) for a in arrays
+                        if hasattr(a, "dtype")))):
+        f = _host_fallback(f)
 
     check = state.get_flag("FLAGS_check_nan_inf")
     rec = None if state.is_functional_mode() else state.get_static_recorder()
@@ -155,7 +212,7 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
               and any(_requires_grad(t) for t in tensors))
         wrapped = _wrap_outputs(tuple(outs) if multi else outs, multi, rg)
         if rec is not None:
-            rec.record_op(name, fn, f, tensors, attrs, wrapped, multi,
+            rec.record_op(name, fn, f_rec, tensors, attrs, wrapped, multi,
                           differentiable)
         return wrapped
 
@@ -167,7 +224,7 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
             _check_nan_inf(name, tuple(outs) if multi else (outs,))
         wrapped = _wrap_outputs(tuple(outs) if multi else outs, multi, False)
         if rec is not None:
-            rec.record_op(name, fn, f, tensors, attrs, wrapped, multi,
+            rec.record_op(name, fn, f_rec, tensors, attrs, wrapped, multi,
                           differentiable)
         return wrapped
 
@@ -196,7 +253,7 @@ def apply(fn, tensors, attrs=None, name=None, differentiable=True):
         w._node = node
         w._slot = i
     if rec is not None:
-        rec.record_op(name, fn, f, tensors, attrs, wrapped, multi,
+        rec.record_op(name, fn, f_rec, tensors, attrs, wrapped, multi,
                       differentiable)
     return wrapped
 

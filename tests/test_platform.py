@@ -158,3 +158,105 @@ class TestOpCallStack:
         assert issubclass(errors.OutOfRangeError, IndexError)
         assert issubclass(errors.UnimplementedError, NotImplementedError)
         assert errors.InvalidArgumentError.code == "INVALID_ARGUMENT"
+
+
+def test_complex_ops_host_fallback(monkeypatch):
+    """Reference semantics: ops with no device kernel fall back to
+    CPUPlace (ref framework/operator.cc ChooseKernel). Complex dtypes
+    have no TPU lowering (measured: docs/perf/OP_SWEEP_TPU.md, 8
+    UNIMPLEMENTED ops), so eager dispatch reroutes them to the host —
+    validated here with a patched backend name; on-chip validation is
+    the sweep's job."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.ops import dispatch
+
+    engaged = []
+    orig_fb = dispatch._host_fallback
+    monkeypatch.setattr(dispatch, "_default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        dispatch, "_host_fallback",
+        lambda f: engaged.append(f) or orig_fb(f))
+
+    x = pt.to_tensor([3.0, -4.0])
+    y = pt.to_tensor([4.0, 3.0])
+    c = pt.complex(x, y)                       # fallback by op name
+    assert engaged, "host fallback did not engage for complex()"
+    assert "complex64" in str(c.dtype)
+    np.testing.assert_allclose(pt.real(c).numpy(), [3.0, -4.0])
+    np.testing.assert_allclose(pt.imag(c).numpy(), [4.0, 3.0])
+    # complex INPUT routes any op through the fallback (dtype check)
+    n0 = len(engaged)
+    np.testing.assert_allclose(pt.abs(c).numpy(), [5.0, 5.0], rtol=1e-6)
+    assert len(engaged) > n0
+    np.testing.assert_allclose(
+        pt.angle(c).numpy(), np.angle([3 + 4j, -4 + 3j]), rtol=1e-6)
+    # autodiff through the host-fallback forward
+    xg = pt.to_tensor([1.0, 2.0])
+    xg.stop_gradient = False
+    loss = pt.sum(pt.real(pt.complex(xg, y)) * 3.0)
+    loss.backward()
+    np.testing.assert_allclose(xg.grad.numpy(), [3.0, 3.0])
+
+
+def test_complex_ops_no_fallback_on_cpu(monkeypatch):
+    """On the CPU backend the fallback must stay cold (no device_put
+    churn) — behavior identical to before."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.ops import dispatch
+    engaged = []
+    orig_fb = dispatch._host_fallback
+    monkeypatch.setattr(
+        dispatch, "_host_fallback",
+        lambda f: engaged.append(f) or orig_fb(f))
+    c = pt.complex(pt.to_tensor([1.0]), pt.to_tensor([2.0]))
+    np.testing.assert_allclose(pt.real(c).numpy(), [1.0])
+    assert not engaged, "fallback engaged on the CPU backend"
+
+
+def test_complex_consumer_ops_stay_on_device_for_real_inputs(monkeypatch):
+    """conj/angle on REAL inputs must not pay a host round-trip even on
+    an accelerator backend — only the real->complex producers and
+    complex-dtyped inputs reroute."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.ops import dispatch
+    engaged = []
+    orig_fb = dispatch._host_fallback
+    monkeypatch.setattr(dispatch, "_default_backend", lambda: "tpu")
+    monkeypatch.setattr(
+        dispatch, "_host_fallback",
+        lambda f: engaged.append(f) or orig_fb(f))
+    x = pt.to_tensor([1.0, -2.0])
+    np.testing.assert_allclose(pt.conj(x).numpy(), [1.0, -2.0])
+    np.testing.assert_allclose(pt.angle(x).numpy(), [0.0, np.pi],
+                               rtol=1e-6)
+    assert not engaged, "real-dtyped consumer op took the host fallback"
+
+
+def test_complex_fallback_not_recorded_into_static_programs(monkeypatch):
+    """The recorded desc impl must be the UNWRAPPED op: the fallback's
+    device_put/default_device must never be traced into a jit-compiled
+    Executor program."""
+    import paddle_tpu as pt
+    from paddle_tpu.ops import dispatch
+    from paddle_tpu.static.program import Program, program_guard
+    monkeypatch.setattr(dispatch, "_default_backend", lambda: "tpu")
+    prog = Program()
+    with program_guard(prog):
+        x = pt.to_tensor([1.0, 2.0])
+        y = pt.to_tensor([3.0, 4.0])
+        c = pt.complex(x, y)
+        _ = pt.real(c)
+    seen = 0
+    for op in prog.ops:
+        fn = getattr(op, "_fn", None)
+        if fn is None:
+            continue
+        seen += 1
+        # _host_fallback wraps via functools.wraps -> __wrapped__ is set;
+        # raw impls / functools.partial bindings never carry it
+        assert not hasattr(fn, "__wrapped__"), (
+            f"op {op} recorded a host-fallback-wrapped impl")
+    assert seen, "no ops recorded"
