@@ -70,6 +70,35 @@ def _init_backend(max_tries=2, delay=20.0):
     return jax, jax.default_backend() != "cpu"
 
 
+def _last_banked_tpu_result():
+    """Parse the newest real-TPU bench line out of the banked capture
+    log (docs/perf/capture_bench.log); None if absent/CPU-only."""
+    import os
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "docs", "perf", "capture_bench.log")
+    try:
+        best = None
+        with open(path, errors="ignore") as fh:
+            for line in fh:
+                if not line.startswith("{") or '"metric"' not in line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("detail", {}).get("backend") == "tpu":
+                    best = rec
+        if best is None:
+            return None
+        return {"value": best["value"], "unit": best["unit"],
+                "vs_baseline": best["vs_baseline"],
+                "step_ms": best["detail"].get("step_ms"),
+                "source": "docs/perf/capture_bench.log (banked on-chip "
+                          "run from the last tunnel-up window)"}
+    except OSError:
+        return None
+
+
 def _note(msg, _t0=[None]):
     """Progress to stderr (stdout is reserved for the one JSON line)."""
     if _t0[0] is None:
@@ -138,14 +167,26 @@ def run():
     peak = PEAK_TFLOPS if on_tpu else 1.0
     mfu = tflops / peak
 
+    detail = {"step_ms": round(dt * 1e3, 2), "loss": round(final, 3),
+              "model_tflops": round(tflops, 2), "params": n_params,
+              "backend": jax.default_backend(), "batch": batch}
+    if not on_tpu:
+        # tunnel down at bench time: this run is a CPU liveness smoke,
+        # NOT a perf datum. Attach the last BANKED on-chip measurement
+        # (docs/perf/capture_bench.log, written only by real-TPU runs)
+        # with provenance so the recorded bench still carries the
+        # measured number.
+        banked = _last_banked_tpu_result()
+        if banked is not None:
+            detail["cpu_smoke"] = True
+            detail["last_tpu_measurement"] = banked
+
     print(json.dumps({
         "metric": METRIC,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu, 4),
-        "detail": {"step_ms": round(dt * 1e3, 2), "loss": round(final, 3),
-                   "model_tflops": round(tflops, 2), "params": n_params,
-                   "backend": jax.default_backend(), "batch": batch},
+        "detail": detail,
     }))
 
 
