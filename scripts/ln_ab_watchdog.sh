@@ -46,6 +46,10 @@ while :; do
     run_step sweep_gpt 3000 python scripts/bench_sweep.py gpt 8 16 || { sleep 60; continue; }
     probe || continue
     run_step ln_ab     2400 env PT_LN_SINGLE_PASS=1 python scripts/bench_sweep.py gpt 8 || { sleep 60; continue; }
+    probe || continue
+    run_step sweep_resnet 2400 python scripts/bench_sweep.py resnet 128 || { sleep 60; continue; }
+    probe || continue
+    run_step decode    3000 python scripts/bench_decode.py             || { sleep 60; continue; }
     python scripts/transcribe_capture.py >> .probe/transcribe.log 2>&1 \
       && note "AB BATTERY COMPLETE" || note "transcription FAILED"
     break
