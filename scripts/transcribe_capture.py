@@ -24,11 +24,15 @@ RESULT_RE = re.compile(
 SEQ_RE = re.compile(
     r"\]\s+seq=(?P<seq>\d+(?:-w\d+)?):\s+(?P<ms>[\d.]+) ms/step\s+"
     r"(?P<toks>[\d,]+) tok/s\s+(?P<tf>[\d.]+) TF/s\s+MFU=(?P<mfu>[\d.]+)")
+DECODE_RE = re.compile(
+    r"\]\s+RESULT decode (?P<label>\w+ b=\d+) "
+    r"prompt=(?P<prompt>\d+) new=(?P<new>\d+):\s+"
+    r"(?P<rate>[\d,]+) tok/s\s+(?P<ms>[\d.]+) ms/token")
 MARK = "<!-- transcribe_capture -->"
 
 
 def parse_logs():
-    rows, seq_rows, bench = [], [], None
+    rows, seq_rows, decode_rows, bench = [], [], [], None
     for name in sorted(os.listdir(LOG)):
         if not (name.startswith("capture_") and name.endswith(".log")):
             continue
@@ -51,11 +55,17 @@ def parse_logs():
             continue
         for m in SEQ_RE.finditer(text):
             seq_rows.append((step,) + m.group("seq", "ms", "toks", "mfu"))
+        for m in DECODE_RE.finditer(text):
+            decode_rows.append(m.group("label", "prompt", "new", "rate",
+                                       "ms"))
         for m in RESULT_RE.finditer(text):
             if not m.group("label").startswith("seq="):
+                lbl = m.group("label")
+                if lbl.startswith("decode "):
+                    continue      # handled by DECODE_RE above
                 rows.append((step,) + m.group("label", "ms", "toks",
                                               "mfu"))
-    return rows, seq_rows, bench
+    return rows, seq_rows, decode_rows, bench
 
 
 def transcribe_op_sweep():
@@ -107,11 +117,11 @@ def transcribe_op_sweep():
 
 
 def main():
-    rows, seq_rows, bench = parse_logs()
+    rows, seq_rows, decode_rows, bench = parse_logs()
     n_ops = transcribe_op_sweep()
     if n_ops:
         print(f"op sweep: {n_ops} per-op verdicts -> OP_SWEEP_TPU.md")
-    if not (rows or seq_rows or bench):
+    if not (rows or seq_rows or decode_rows or bench):
         # op-sweep-only is still a banked result, but say plainly that
         # NO perf rows were written (the watchdog echoes this line)
         print("op sweep only — NO sweep/bench rows for PERF.md/LONGCTX.md"
@@ -132,6 +142,14 @@ def main():
         lines.append("|---|---|---|---|")
         for step, label, ms, toks, mfu in rows:
             lines.append(f"| {label} ({step}) | {ms} | {toks}/s | {mfu} |")
+        lines.append("")
+    if decode_rows:
+        lines.append("\nKV-cache autoregressive decode "
+                     "(scripts/bench_decode.py):\n")
+        lines.append("| model | prompt | new tokens | tok/s | ms/token |")
+        lines.append("|---|---|---|---|---|")
+        for label, prompt, new, rate, ms in decode_rows:
+            lines.append(f"| {label} | {prompt} | {new} | {rate} | {ms} |")
         lines.append("")
     lines.append(MARK_END)
     perf = os.path.join(LOG, "PERF.md")
@@ -184,6 +202,7 @@ def main():
             f.write(text)
 
     print(f"transcribed: {len(rows)} sweep rows, {filled} longctx rows, "
+          f"{len(decode_rows)} decode rows, "
           f"bench={'yes' if bench else 'no'}"
           + (f"; NO TABLE ROW for seq={unmatched} (add rows to "
              f"LONGCTX.md by hand)" if unmatched else ""))
