@@ -199,3 +199,31 @@ class TestLayers:
         np.testing.assert_allclose(out[..., 0],
                                    gray_mean + (255.0 - gray_mean) * 0.5)
         np.testing.assert_allclose(out[..., 1], gray_mean * 0.5)
+
+
+def test_batchnorm_1d_and_channels_last():
+    """The single-pass BN stats must be correct for every layout the op
+    serves: BatchNorm1D's [N,C,L] (ch axis 1) and the functional
+    data_format="NHWC" path (ch axis -1)."""
+    from paddle_tpu.nn import functional as F
+    rng = np.random.RandomState(3)
+    # [N, C, L], ch_axis=1
+    x = (5.0 + 2.0 * rng.randn(8, 6, 10)).astype("f4")
+    bn = nn.BatchNorm1D(6, momentum=0.0)
+    bn.train()
+    o = bn(pt.to_tensor(x)).numpy()
+    ref = (x - x.mean((0, 2), keepdims=True)) / np.sqrt(
+        x.var((0, 2), keepdims=True) + 1e-5)
+    np.testing.assert_allclose(o, ref, atol=2e-3)
+    np.testing.assert_allclose(bn._variance.numpy(), x.var((0, 2)),
+                               rtol=1e-3)
+    # NHWC via the functional API, ch axis -1
+    xl = (5.0 + 2.0 * rng.randn(4, 7, 7, 5)).astype("f4")
+    rm = pt.zeros([5])
+    rv = pt.ones([5])
+    y = F.batch_norm(pt.to_tensor(xl), rm, rv, training=True,
+                     momentum=0.0, data_format="NHWC").numpy()
+    refl = (xl - xl.mean((0, 1, 2), keepdims=True)) / np.sqrt(
+        xl.var((0, 1, 2), keepdims=True) + 1e-5)
+    np.testing.assert_allclose(y, refl, atol=2e-3)
+    np.testing.assert_allclose(rv.numpy(), xl.var((0, 1, 2)), rtol=1e-3)
