@@ -101,6 +101,12 @@ def transcribe_op_sweep():
         "Summary: " + ", ".join(f"{v} {k}"
                                 for k, v in sorted(counts.items())),
         "",
+        "`unsupported` is a KERNEL-level verdict (the tunneled backend",
+        "cannot lower complex dtypes); at the framework level these ops",
+        "run via the eager host-CPU fallback (ops/dispatch.py",
+        "HOST_FALLBACK_OPS — the reference's CPUPlace kernel-fallback",
+        "semantics), so user code still works on the TPU backend.",
+        "",
         "| op | verdict | check | secs | detail |",
         "|---|---|---|---|---|",
     ]
