@@ -101,6 +101,7 @@ from .framework.state import get_flags, set_flags  # noqa: E402,F811
 # base package, so len(OP_REGISTRY) is ONE number for every import set
 # (tests assert the docs match it — see tests/test_registry_count.py).
 from . import nlp  # noqa: E402,F401        (llama_attention, rms_norm)
+from . import serving  # noqa: E402,F401    (continuous-batching engine)
 from .static import quant_pass as _quant_pass  # noqa: E402,F401
 
 # inplace tensor-method variants (ref tensor/manipulation.py *_ APIs);

@@ -1,0 +1,20 @@
+"""Forward-compat shims for older jax builds.
+
+The repo targets the current jax surface; the container pins jax 0.4.37,
+which predates a few public aliases the codebase (and its kernels) use.
+Each shim installs the modern name only when missing, mapping it onto the
+0.4.x equivalent — on a current jax this module is a no-op, so nothing
+here can mask a real API change.
+"""
+import jax
+
+
+def install():
+    if not hasattr(jax, "typeof"):
+        # jax.typeof(x) -> the abstract value (aval) of x. 0.4.x spells
+        # it jax.core.get_aval; extras like .vma simply don't exist on
+        # the old avals, which callers already probe with getattr.
+        jax.typeof = jax.core.get_aval
+
+
+install()

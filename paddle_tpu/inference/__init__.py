@@ -35,6 +35,27 @@ class Config:
         self._memory_optim = True
         self._ir_optim = True
         self._cpu_math_threads = None
+        self._llm_opts = None
+
+    # ---- LLM serving engine (paddle_tpu.serving front door)
+    def enable_llm_engine(self, num_slots=4, max_len=256, prefill_len=None,
+                          eos_token_id=None, max_queue=None):
+        """Arm this Config for create_llm_predictor: slot-count / cache
+        horizon / prompt bucket for the continuous-batching engine
+        (docs/serving.md). switch_ir_optim(False) carries over as the
+        engine's uncompiled per-call path, the same meaning it has for
+        the classic Predictor."""
+        self._llm_opts = {
+            "num_slots": int(num_slots),
+            "max_len": int(max_len),
+            "prefill_len": None if prefill_len is None else int(prefill_len),
+            "eos_token_id": eos_token_id,
+            "max_queue": max_queue,
+        }
+        return self
+
+    def llm_engine_enabled(self):
+        return self._llm_opts is not None
 
     # ---- knobs with real effect
     def enable_memory_optim(self, flag=True):
@@ -270,3 +291,57 @@ class _TensorHandle:
 
 def create_predictor(config):
     return Predictor(config)
+
+
+class LLMPredictor:
+    """Serving-engine analog of Predictor: one Config-built Scheduler +
+    ServingEngine pair with a blocking generate() for the simple case and
+    the full submit()/run() surface for continuous batching."""
+
+    def __init__(self, config, model):
+        from ..serving import ServingEngine, Scheduler
+        opts = config._llm_opts or {}
+        self._eos_token_id = opts.get("eos_token_id")
+        self.engine = ServingEngine(
+            model,
+            num_slots=opts.get("num_slots", 4),
+            max_len=opts.get("max_len", 256),
+            prefill_len=opts.get("prefill_len"),
+            jit_compile=config.ir_optim())
+        self.scheduler = Scheduler(self.engine,
+                                   max_queue=opts.get("max_queue"))
+
+    def generate(self, prompt, **kw):
+        kw.setdefault("eos_token_id", self._eos_token_id)
+        return self.scheduler.generate(prompt, **kw)
+
+    def submit(self, **kw):
+        kw.setdefault("eos_token_id", self._eos_token_id)
+        return self.scheduler.submit(**kw)
+
+    def run(self, **kw):
+        return self.scheduler.run(**kw)
+
+    @property
+    def metrics(self):
+        return self.scheduler.metrics
+
+
+def create_llm_predictor(config, model=None):
+    """Front door from the inference Config to paddle_tpu.serving: the
+    Config carries the engine knobs (enable_llm_engine: slots, cache
+    horizon, prefill bucket, eos, queue bound; switch_ir_optim(False) ->
+    uncompiled engine; set_cpu_math_library_num_threads applies as for
+    any predictor) and `model` is a causal LM exposing
+    prefill/decode_step/init_cache (nlp.LlamaForCausalLM,
+    nlp.GPTForPretraining). LLM weights load through the model
+    constructors + paddle.load — there is no protobuf/StableHLO artifact
+    path for the decode-cache entry points."""
+    if model is None:
+        raise ValueError(
+            "create_llm_predictor needs `model` (a causal LM with "
+            "prefill/decode_step/init_cache); the classic artifact paths "
+            "(create_predictor) have no KV-cache decode entry points")
+    if not config.llm_engine_enabled():
+        config.enable_llm_engine()
+    return LLMPredictor(config, model)

@@ -180,7 +180,9 @@ class GPTAttention(nn.Layer):
 
     def decode(self, x_t, cache, pos):
         """One-token step: write K/V at `pos`, attend q over cache[:pos].
-        x_t: [B, 1, H] Tensor; pos: traced int. Returns (out, new_cache)."""
+        x_t: [B, 1, H] Tensor; pos: traced int — a scalar (lockstep
+        batch) or a [B] vector (slot-wise serving decode: per-row cache
+        scatter + per-row mask, same shapes, one program)."""
         b = x_t.shape[0]
         qkv = self.qkv_proj(x_t)
         a = qkv._data if isinstance(qkv, Tensor) else qkv
@@ -188,17 +190,42 @@ class GPTAttention(nn.Layer):
         a = jnp.transpose(a, (2, 0, 3, 1, 4))           # [3, B, nh, 1, D]
         q, k_t, v_t = a[0], a[1], a[2]
         ck, cv = cache
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, k_t.astype(ck.dtype),
-                                                 pos, axis=2)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, v_t.astype(cv.dtype),
-                                                 pos, axis=2)
-        from ..nn.transformer import cached_decode_attention
+        from ..nn.transformer import cached_decode_attention, scatter_kv_at
+        if jnp.ndim(pos):
+            ck = scatter_kv_at(ck, k_t, pos)
+            cv = scatter_kv_at(cv, v_t, pos)
+        else:
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                ck, k_t.astype(ck.dtype), pos, axis=2)
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cv, v_t.astype(cv.dtype), pos, axis=2)
         out = cached_decode_attention(q, ck, cv, pos,
                                       1.0 / math.sqrt(self.head_dim),
                                       window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, -1)
         out = self.out_proj(Tensor(out.astype(x_t._data.dtype)))
         return out, (ck, cv)
+
+    def prefill(self, x, cache):
+        """Prompt-phase step: the forward attention math over x [B, P, H]
+        that also writes the prompt's K/V into cache[:, :, :P] so decode
+        continues at pos=P (cells past the true prompt length are rewritten
+        by the decode frontier before the ks<=pos mask ever exposes them)."""
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        a = qkv._data if isinstance(qkv, Tensor) else qkv
+        a = a.reshape(b, s, 3, self.num_heads, self.head_dim)
+        a = jnp.transpose(a, (2, 0, 3, 1, 4))           # [3, B, nh, S, D]
+        q, k, v = a[0], a[1], a[2]
+        ck, cv = cache
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, 0, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, 0, 0, 0))
+        from ..ops.pallas.flash_attention import _flash_array
+        out = _flash_array(q, k, v, causal=True, window=self.attn_window)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h)
+        return self.out_proj(Tensor(out.astype(x._data.dtype))), (ck, cv)
 
 
 class GPTMLP(nn.Layer):
@@ -250,6 +277,14 @@ class GPTBlock(nn.Layer):
         x = x + a
         x = x + self.mlp(self.ln_2(x))
         return x, cache
+
+    def prefill(self, x, cache):
+        a, cache = self.attn.prefill(self.ln_1(x), cache)
+        x = x + a
+        m = self.mlp(self.ln_2(x))
+        if isinstance(m, tuple):         # MoE FFN: (out, aux_loss) — aux
+            m = m[0]                     # is a training-only signal
+        return x + m, cache
 
 
 class GPTEmbeddings(nn.Layer):
@@ -316,14 +351,31 @@ class GPTModel(nn.Layer):
                 for blk in self.blocks]
 
     def decode_step(self, tok, caches, pos):
-        """tok: [B, 1] ids; pos: traced position. Returns (h, caches)."""
+        """tok: [B, 1] ids; pos: traced position — a scalar, or a [B]
+        vector for slot-wise serving decode. Returns (h, caches)."""
         pos = pos._data if isinstance(pos, Tensor) else pos
-        pos_ids = jnp.full((tok.shape[0] if hasattr(tok, "shape") else 1, 1),
-                           0, jnp.int32) + pos
+        if jnp.ndim(pos):
+            pos_ids = jnp.asarray(pos, jnp.int32)[:, None]
+        else:
+            pos_ids = jnp.full(
+                (tok.shape[0] if hasattr(tok, "shape") else 1, 1),
+                0, jnp.int32) + pos
         x = self.embeddings(tok, Tensor(pos_ids))
         new_caches = []
         for blk, cache in zip(self.blocks, caches):
             x, cache = blk.decode(x, cache, pos)
+            new_caches.append(cache)
+        return self.ln_f(x), new_caches
+
+    def prefill(self, input_ids, max_len, dtype=jnp.float32):
+        """Prompt-phase forward over [B, P] ids that also populates fresh
+        [B, heads, max_len, head_dim] KV caches for positions [0, P).
+        Returns (hidden, caches) — decode continues at pos=P."""
+        x = self.embeddings(input_ids)
+        caches = self.init_cache(input_ids.shape[0], max_len, dtype)
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, cache = blk.prefill(x, cache)
             new_caches.append(cache)
         return self.ln_f(x), new_caches
 
@@ -366,6 +418,20 @@ class GPTForPretraining(nn.Layer):
 
     def decode_step(self, tok, caches, pos):
         h, caches = self.gpt.decode_step(tok, caches, pos)
+        w = self.gpt.embeddings.word_embeddings.weight
+        from ..ops.math import matmul
+        return matmul(h, w, transpose_y=True), caches
+
+    def prefill(self, input_ids, max_len, dtype=jnp.float32,
+                frontier=None):
+        """frontier (traced index): logits for that one prompt position
+        only — keeps the serving prefill's vocab matmul [1, V] instead
+        of [P, V] over the whole padded bucket."""
+        h, caches = self.gpt.prefill(input_ids, max_len, dtype)
+        if frontier is not None:
+            hr = h._data if isinstance(h, Tensor) else h
+            h = Tensor(jax.lax.dynamic_slice_in_dim(hr, frontier, 1,
+                                                    axis=1))
         w = self.gpt.embeddings.word_embeddings.weight
         from ..ops.math import matmul
         return matmul(h, w, transpose_y=True), caches

@@ -104,8 +104,20 @@ def rope_tables(seq_len, head_dim, theta=10000.0):
             jnp.asarray(np.sin(freqs), jnp.float32))
 
 
+def _rotate_pairs(x, c, sn):
+    """Rotate interleaved pairs (x[2i], x[2i+1]) of x's last dim by
+    cos/sin rows c/sn (broadcastable to [..., D/2]) in f32, cast back —
+    the one place the pair-layout convention lives."""
+    d = x.shape[-1]
+    xf = x.astype(jnp.float32).reshape(*x.shape[:-1], d // 2, 2)
+    x1, x2 = xf[..., 0], xf[..., 1]
+    y1 = x1 * c - x2 * sn
+    y2 = x1 * sn + x2 * c
+    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
 def _rope_rotate(x, cos, sin, pos_offset, head_axis):
-    """Shared RoPE core: rotates pairs (x[2i], x[2i+1]) in f32, cast back.
+    """Shared RoPE core over a contiguous position range.
     head_axis selects the layout — 1 for [B,H,S,D], 2 for [B,S,H,D]; the
     sequence axis is the other one. A static pos_offset is range-checked
     (a traced offset can't be; dynamic_slice would clamp silently)."""
@@ -116,17 +128,11 @@ def _rope_rotate(x, cos, sin, pos_offset, head_axis):
         raise ValueError(
             f"RoPE positions [{pos_offset}, {pos_offset + s_len}) exceed "
             f"the table length {cos.shape[0]} (raise max_seq_len)")
-    xf = x.astype(jnp.float32).reshape(*x.shape[:-1], d // 2, 2)
-    x1, x2 = xf[..., 0], xf[..., 1]
     c = jax.lax.dynamic_slice_in_dim(cos, pos_offset, s_len, axis=0)
     sn = jax.lax.dynamic_slice_in_dim(sin, pos_offset, s_len, axis=0)
     bshape = [1, 1, 1, d // 2]
     bshape[seq_axis] = s_len
-    c = c.reshape(bshape)
-    sn = sn.reshape(bshape)
-    y1 = x1 * c - x2 * sn
-    y2 = x1 * sn + x2 * c
-    return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
+    return _rotate_pairs(x, c.reshape(bshape), sn.reshape(bshape))
 
 
 def apply_rope_bshd(x, cos, sin, pos_offset=0):
@@ -139,6 +145,16 @@ def apply_rope(x, cos, sin, pos_offset=0):
     return _rope_rotate(x, cos, sin, pos_offset, head_axis=1)
 
 
+def apply_rope_at(x, cos, sin, pos):
+    """Single-token RoPE at a per-row position VECTOR. x: [B, H, 1, D];
+    pos: [B] int — each batch row rotated at its own position (slot-wise
+    serving decode, where slots sit at different depths). The table rows
+    come from one gather cos[pos] instead of a dynamic_slice, so the
+    whole batch stays one fused program."""
+    return _rotate_pairs(x, cos[pos][:, None, None, :],
+                         sin[pos][:, None, None, :])
+
+
 @functools.lru_cache(maxsize=8)
 def _rope_tensor_tables(seq_len, head_dim, theta):
     """Tensor wrappers for the rope tables, cached so EVERY layer of a
@@ -149,6 +165,29 @@ def _rope_tensor_tables(seq_len, head_dim, theta):
     t_cos.stop_gradient = True
     t_sin.stop_gradient = True
     return t_cos, t_sin
+
+
+def _split_rope_bshd(a, cos, sin, nh, nkv, hd):
+    """Split a fused qkv projection [B, S, (nh+2*nkv)*hd] and apply RoPE
+    to q/k in the transpose-free bshd layout (v reshape only). One home
+    for the split/rope convention — shared by the training forward
+    (_llama_attention_raw) and the serving prefill path."""
+    b, s = a.shape[0], a.shape[1]
+    q, k, v = jnp.split(a, [nh * hd, (nh + nkv) * hd], axis=-1)
+    q = apply_rope_bshd(q.reshape(b, s, nh, hd), cos, sin)
+    k = apply_rope_bshd(k.reshape(b, s, nkv, hd), cos, sin)
+    return q, k, v.reshape(b, s, nkv, hd)
+
+
+def _gqa_flash_bshd(q, k, v, nh, nkv, window):
+    """GQA kv-head repeat (free reshape-broadcast under XLA) + causal
+    flash attention, bshd layout."""
+    if nkv != nh:
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    from ..ops.pallas.flash_attention import _flash_array
+    return _flash_array(q, k, v, causal=True, layout="bshd", window=window)
 
 
 def _llama_attention_raw(x, wqkv, cos, sin, num_heads=1, num_kv_heads=1,
@@ -164,19 +203,12 @@ def _llama_attention_raw(x, wqkv, cos, sin, num_heads=1, num_kv_heads=1,
     sin = jax.lax.stop_gradient(sin)
     b, s, _ = x.shape
     qkv = x @ wqkv                                   # [B,S,(nh+2kv)*hd]
-    q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
     from ..ops.pallas.flash_attention import _flash_array
     if attn_layout == "bshd":
-        q = apply_rope_bshd(q.reshape(b, s, nh, hd), cos, sin)
-        k = apply_rope_bshd(k.reshape(b, s, nkv, hd), cos, sin)
-        v = v.reshape(b, s, nkv, hd)
-        if nkv != nh:                                # GQA: repeat KV
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        o = _flash_array(q, k, v, causal=True, layout="bshd",
-                         window=window)
+        q, k, v = _split_rope_bshd(qkv, cos, sin, nh, nkv, hd)
+        o = _gqa_flash_bshd(q, k, v, nh, nkv, window)
         return o.reshape(b, s, nh * hd)
+    q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
     q = apply_rope(q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3), cos, sin)
     k = apply_rope(k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3), cos, sin)
     v = v.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
@@ -249,7 +281,10 @@ class LlamaAttention(nn.Layer):
 
     def decode(self, x_t, cache, pos):
         """One-token step: RoPE at `pos` (traced), write K/V, attend over
-        cache[:pos]. x_t: [B, 1, H] Tensor."""
+        cache[:pos]. x_t: [B, 1, H] Tensor. `pos` is a scalar (lockstep
+        batch) or a [B] vector — slot-wise serving decode where each row
+        is at its own depth; the vector path scatters per-row cache
+        writes and masks per-row, same fixed shapes, one program."""
         from ..framework.tensor import Tensor
         nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
         b = x_t.shape[0]
@@ -259,18 +294,50 @@ class LlamaAttention(nn.Layer):
         q = q.reshape(b, 1, nh, hd).transpose(0, 2, 1, 3)
         k_t = k_t.reshape(b, 1, nkv, hd).transpose(0, 2, 1, 3)
         v_t = v_t.reshape(b, 1, nkv, hd).transpose(0, 2, 1, 3)
-        q = apply_rope(q, self._cos, self._sin, pos_offset=pos)
-        k_t = apply_rope(k_t, self._cos, self._sin, pos_offset=pos)
         ck, cv = cache
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, k_t.astype(ck.dtype),
-                                                 pos, axis=2)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, v_t.astype(cv.dtype),
-                                                 pos, axis=2)
-        from ..nn.transformer import cached_decode_attention
+        from ..nn.transformer import cached_decode_attention, scatter_kv_at
+        if jnp.ndim(pos):
+            q = apply_rope_at(q, self._cos, self._sin, pos)
+            k_t = apply_rope_at(k_t, self._cos, self._sin, pos)
+            ck = scatter_kv_at(ck, k_t, pos)
+            cv = scatter_kv_at(cv, v_t, pos)
+        else:
+            q = apply_rope(q, self._cos, self._sin, pos_offset=pos)
+            k_t = apply_rope(k_t, self._cos, self._sin, pos_offset=pos)
+            ck = jax.lax.dynamic_update_slice_in_dim(
+                ck, k_t.astype(ck.dtype), pos, axis=2)
+            cv = jax.lax.dynamic_update_slice_in_dim(
+                cv, v_t.astype(cv.dtype), pos, axis=2)
         out = cached_decode_attention(q, ck, cv, pos, 1.0 / math.sqrt(hd),
                                       window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, nh * hd)
         out = self.o_proj(Tensor(out.astype(x_t._data.dtype)))
+        return out, (ck, cv)
+
+    def prefill(self, x, cache):
+        """Prompt-phase step: the training forward's attention math over
+        x [B, P, H], additionally writing the prompt's K/V into
+        cache[:, :, :P] so decode can continue at pos=P. Positions past
+        the true prompt length hold garbage until the decode frontier
+        overwrites them — cached_decode_attention masks ks<=pos, so a
+        not-yet-rewritten cell is never attended. P is static (the engine
+        pads prompts to one bucket) => one compiled prefill program."""
+        from ..framework.tensor import Tensor
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        a = qkv._data if isinstance(qkv, Tensor) else qkv
+        q, k, v = _split_rope_bshd(a, self._cos, self._sin, nh, nkv, hd)
+        ck, cv = cache
+        ck = jax.lax.dynamic_update_slice(
+            ck, jnp.transpose(k, (0, 2, 1, 3)).astype(ck.dtype),
+            (0, 0, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cv, jnp.transpose(v, (0, 2, 1, 3)).astype(cv.dtype),
+            (0, 0, 0, 0))
+        o = _gqa_flash_bshd(q, k, v, nh, nkv, self.attn_window)
+        out = self.o_proj(Tensor(
+            o.reshape(b, s, nh * hd).astype(x._data.dtype)))
         return out, (ck, cv)
 
 
@@ -318,6 +385,12 @@ class LlamaBlock(nn.Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, cache
 
+    def prefill(self, x, cache):
+        a, cache = self.self_attn.prefill(self.input_layernorm(x), cache)
+        x = x + a
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, cache
+
 
 class LlamaModel(nn.Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -348,13 +421,26 @@ class LlamaModel(nn.Layer):
                 for blk in self.layers]
 
     def decode_step(self, tok, caches, pos):
-        """tok: [B, 1] ids; pos: traced position. Returns (h, caches)."""
+        """tok: [B, 1] ids; pos: traced position — a scalar, or a [B]
+        vector for slot-wise serving decode. Returns (h, caches)."""
         from ..framework.tensor import Tensor
         pos = pos._data if isinstance(pos, Tensor) else pos
         x = self.embed_tokens(tok)
         new_caches = []
         for blk, cache in zip(self.layers, caches):
             x, cache = blk.decode(x, cache, pos)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
+
+    def prefill(self, input_ids, max_len, dtype=jnp.float32):
+        """Prompt-phase forward over [B, P] ids that also populates fresh
+        [B, kv_heads, max_len, head_dim] KV caches for positions [0, P).
+        Returns (hidden, caches) — decode continues at pos=P."""
+        x = self.embed_tokens(input_ids)
+        caches = self.init_cache(input_ids.shape[0], max_len, dtype)
+        new_caches = []
+        for blk, cache in zip(self.layers, caches):
+            x, cache = blk.prefill(x, cache)
             new_caches.append(cache)
         return self.norm(x), new_caches
 
@@ -398,6 +484,20 @@ class LlamaForCausalLM(nn.Layer):
 
     def decode_step(self, tok, caches, pos):
         h, caches = self.model.decode_step(tok, caches, pos)
+        return self._logits(h), caches
+
+    def prefill(self, input_ids, max_len, dtype=jnp.float32,
+                frontier=None):
+        """frontier (traced index): return logits only for that prompt
+        position — the serving engine wants ONE next-token row, and
+        indexing before the LM head keeps the vocab matmul [1, V]
+        instead of [P, V] (P = padded bucket)."""
+        from ..framework.tensor import Tensor
+        h, caches = self.model.prefill(input_ids, max_len, dtype)
+        if frontier is not None:
+            hr = h._data if isinstance(h, Tensor) else h
+            h = Tensor(jax.lax.dynamic_slice_in_dim(hr, frontier, 1,
+                                                    axis=1))
         return self._logits(h), caches
 
 

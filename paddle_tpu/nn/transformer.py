@@ -383,7 +383,9 @@ def cached_decode_attention(q, ck, cv, pos, scale, window=None):
     q is reshaped to [B, Hkv, rep, D] and contracted against the
     un-repeated KV buffers. window=W restricts to the last W cache
     positions (sliding-window decode matching the training band).
-    Returns [B, H, 1, D] in cv.dtype."""
+    `pos` is a traced scalar (lockstep batch) or a [B] vector — the
+    slot-wise serving case where every row sits at its own depth; the
+    causal mask broadcasts per-row. Returns [B, H, 1, D] in cv.dtype."""
     import jax
     import jax.numpy as jnp
 
@@ -393,6 +395,8 @@ def cached_decode_attention(q, ck, cv, pos, scale, window=None):
     qf = q.astype(jnp.float32).reshape(b, hkv, rep, d)
     scores = jnp.einsum("bkrd,bkld->bkrl", qf,
                         ck.astype(jnp.float32)) * scale
+    if jnp.ndim(pos):
+        pos = jnp.reshape(pos, (b, 1, 1, 1))
     ks = jnp.arange(L)[None, None, None, :]
     mask = ks <= pos
     if window is not None:
@@ -401,3 +405,15 @@ def cached_decode_attention(q, ck, cv, pos, scale, window=None):
     probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
     out = jnp.einsum("bkrl,bkld->bkrd", probs, cv)
     return out.reshape(b, h, 1, d)
+
+
+def scatter_kv_at(cache, kv_t, pos):
+    """Write the step's K or V [B, Hkv, 1, D] into cache [B, Hkv, L, D]
+    at a per-row position vector pos [B] (slot-wise decode: each serving
+    slot is at its own depth). vmap over the batch axis lowers to one
+    scatter — no per-slot unrolling in the compiled program. The scalar
+    lockstep path keeps using dynamic_update_slice_in_dim directly."""
+    import jax
+    return jax.vmap(
+        lambda c, t, p: jax.lax.dynamic_update_slice_in_dim(
+            c, t, p, axis=1))(cache, kv_t.astype(cache.dtype), pos)

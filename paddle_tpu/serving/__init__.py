@@ -1,0 +1,24 @@
+"""paddle_tpu.serving — slot-based continuous-batching LLM serving.
+
+The reference ships a ~38K-LoC inference engine (AnalysisPredictor +
+config); its TPU-native replacement is a request SCHEDULER over the
+XLA-compiled decode step: a fixed [num_slots, max_len] batched KV cache,
+ONE compiled batched decode program reused across the whole request
+stream (per-slot position vector + active mask + where-based
+retirement), and mid-stream prefill into free slots. See
+docs/serving.md for the architecture.
+
+    from paddle_tpu import serving
+    engine = serving.ServingEngine(model, num_slots=4, max_len=256)
+    sched = serving.Scheduler(engine)
+    req = sched.submit(prompt=[1, 2, 3], max_tokens=32,
+                       on_token=lambda r, t: print(t))
+    sched.run()                    # drains queue + slots
+"""
+from .engine import ServingEngine
+from .scheduler import Scheduler
+from .request import Request, RequestState
+from .metrics import ServingMetrics
+
+__all__ = ["ServingEngine", "Scheduler", "Request", "RequestState",
+           "ServingMetrics"]

@@ -1,0 +1,256 @@
+"""ServingEngine: slot-based continuous batching over the causal-LM
+decode paths (nlp/gpt.py, nlp/llama.py).
+
+The engine owns `num_slots` decode slots backed by ONE batched KV cache
+[num_slots, kv_heads, max_len, head_dim] per layer and exactly TWO
+compiled programs, both with fully static shapes so XLA compiles each
+once for the life of the engine (compile-once discipline — the whole
+request stream reuses the same executable):
+
+  * decode wave — one token for every slot at once. Per-slot state rides
+    as vectors: position [S] (each slot at its own depth — decode_step's
+    position-vector path), active mask [S] (retired slots are frozen
+    with `where`, their lanes compute and are discarded; that is the
+    price of fixed shapes and it is the right trade in the
+    memory-bandwidth-bound decode regime, where the [S,...] cache stream
+    dominates and a masked lane adds nothing).
+  * prefill — one slot's prompt, padded to a fixed bucket, through the
+    model's prompt-phase forward (`prefill`), then the slot's cache
+    region is spliced into the batched cache with dynamic_update_slice
+    at a TRACED slot index (so one program serves every slot). The
+    frontier logits yield the request's first token: TTFT is paid at
+    admission, not at the next wave.
+
+Retire-and-refill happens BETWEEN waves by rewriting the per-slot
+vectors — in-flight decodes never stall and never recompile.
+
+Slot bookkeeping (positions, tokens, flags) is host-authoritative:
+five tiny [S] uploads per wave instead of device round-trips, and the
+next-token pull each wave is the one unavoidable sync (the tokens are
+the product being streamed).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+def _infer_cache_dtype(params):
+    """Majority element dtype of the params — a bf16 model gets bf16 KV
+    caches (halves the per-token HBM stream that bounds decode), an f32
+    model keeps f32 (same policy as nlp.gpt.generate's cached path)."""
+    # normalize to np.dtype keys: leaf.dtype is an np.dtype, and probing
+    # a dict of those with the jnp scalar TYPE hashes differently even
+    # though == compares true
+    f32 = np.dtype(jnp.float32)
+    floats = {np.dtype(jnp.bfloat16), np.dtype(jnp.float16), f32}
+    counts = {}
+    for leaf in jax.tree_util.tree_leaves(params):
+        dt = np.dtype(leaf.dtype)
+        if dt in floats:
+            counts[dt] = counts.get(dt, 0) + int(np.prod(leaf.shape))
+    low = {d: c for d, c in counts.items() if d != f32}
+    if low and sum(low.values()) > counts.get(f32, 0):
+        return max(low, key=low.get)
+    return jnp.float32
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+class ServingEngine:
+    """Fixed-shape batched decode executor. The Scheduler decides WHICH
+    request occupies which slot and when; the engine only knows slots.
+
+    model: a causal LM exposing prefill / decode_step / init_cache
+        (GPTForPretraining, LlamaForCausalLM).
+    num_slots: concurrent sequences per wave.
+    max_len: per-slot cache horizon (prompt + generated tokens).
+    prefill_len: prompt padding bucket (<= max_len; default max_len).
+        One bucket => one prefill compile for every prompt length.
+    jit_compile=False runs both programs uncompiled per call (the
+        inference Config's ir_optim=False analog) — for debugging;
+        decode_compiles stays 0 on that path.
+    """
+
+    def __init__(self, model, num_slots=4, max_len=256, prefill_len=None,
+                 cache_dtype=None, jit_compile=True, seed=0):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {max_len}")
+        self.model = model
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.prefill_len = int(prefill_len or max_len)
+        if self.prefill_len > self.max_len:
+            raise ValueError(
+                f"prefill_len {self.prefill_len} > max_len {self.max_len}")
+        model.eval()
+        self._params, self._buffers = model.functional_state()
+        self.cache_dtype = (cache_dtype if cache_dtype is not None
+                            else _infer_cache_dtype(self._params))
+        self._caches = model.init_cache(self.num_slots, self.max_len,
+                                        dtype=self.cache_dtype)
+        self._key = jax.random.PRNGKey(seed)
+
+        # host-authoritative per-slot state
+        S = self.num_slots
+        self.slot_active = [False] * S
+        self.slot_pos = [0] * S        # next cache write position
+        self.slot_tok = [0] * S        # token fed to the next wave
+        self.slot_sample = [False] * S
+        self.slot_temp = [1.0] * S
+
+        self._jit = bool(jit_compile)
+        self._build_programs()
+
+    # ---------------------------------------------------------- programs
+    def _build_programs(self):
+        model, L = self.model, self.max_len
+        cache_dtype = self.cache_dtype
+
+        def decode_wave(p, b, caches, tok, pos, active, sample, temps,
+                        key):
+            out, _ = model.functional_call(p, b, tok[:, None], caches,
+                                           pos, method="decode_step")
+            logits, new_caches = out
+            lo = _raw(logits)[:, 0, :].astype(jnp.float32)
+            greedy = jnp.argmax(lo, axis=-1).astype(jnp.int32)
+            scaled = lo / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.random.categorical(key, scaled,
+                                             axis=-1).astype(jnp.int32)
+            nxt = jnp.where(sample, sampled, greedy)
+            # retirement/freeze via where: inactive lanes keep their
+            # token and position — fixed shapes, no recompiles
+            nxt = jnp.where(active, nxt, tok)
+            new_pos = jnp.where(active, pos + 1, pos)
+            return nxt, new_pos, new_caches
+
+        def prefill(p, b, caches, prompt, prompt_len, slot, sample, temp,
+                    key):
+            # frontier=prompt_len-1: the model applies its LM head to
+            # that ONE position, not the whole padded bucket
+            out, _ = model.functional_call(p, b, prompt[None, :],
+                                           method="prefill", max_len=L,
+                                           dtype=cache_dtype,
+                                           frontier=prompt_len - 1)
+            logits, slot_caches = out
+            lo = _raw(logits)[0, 0].astype(jnp.float32)    # [V]
+            greedy = jnp.argmax(lo).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                key, lo / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
+            first = jnp.where(sample, sampled, greedy)
+            new_caches = []
+            for (ck, cv), (sck, scv) in zip(caches, slot_caches):
+                ck = jax.lax.dynamic_update_slice(
+                    ck, _raw(sck).astype(ck.dtype), (slot, 0, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, _raw(scv).astype(cv.dtype), (slot, 0, 0, 0))
+                new_caches.append((ck, cv))
+            return first, new_caches
+
+        if self._jit:
+            # donate the batched cache: the engine always replaces its
+            # cache reference with the program output, so XLA may update
+            # it in place — without this every wave would transiently
+            # hold 2x the [S, Hkv, L, D] pair in HBM
+            self._decode_wave = jax.jit(decode_wave, donate_argnums=(2,))
+            self._prefill = jax.jit(prefill, donate_argnums=(2,))
+        else:
+            self._decode_wave = decode_wave
+            self._prefill = prefill
+
+    @property
+    def decode_compiles(self):
+        """Number of compiled decode-wave programs (the compile-once
+        invariant: stays 1 across the whole request stream)."""
+        return self._decode_wave._cache_size() if self._jit else 0
+
+    @property
+    def prefill_compiles(self):
+        return self._prefill._cache_size() if self._jit else 0
+
+    # ------------------------------------------------------------- slots
+    def free_slots(self):
+        return [i for i, a in enumerate(self.slot_active) if not a]
+
+    def active_slots(self):
+        return [i for i, a in enumerate(self.slot_active) if a]
+
+    def validate_prompt(self, prompt):
+        """Admission check: the prompt must fit the prefill bucket and
+        leave room to decode at least one token under the cache horizon."""
+        n = len(prompt)
+        if n > self.prefill_len:
+            return (f"prompt length {n} exceeds the prefill bucket "
+                    f"{self.prefill_len} (engine prefill_len)")
+        if n + 1 > self.max_len:
+            return (f"prompt length {n} leaves no room to decode under "
+                    f"max_len {self.max_len}")
+        return None
+
+    def prefill_slot(self, slot, prompt, do_sample=False, temperature=1.0):
+        """Admit a prompt into a free slot: run the prefill program,
+        splice the slot's cache region, arm the slot for the next wave.
+        Returns the request's FIRST generated token (host int)."""
+        why = self.validate_prompt(prompt)
+        if why:
+            raise ValueError(why)
+        if self.slot_active[slot]:
+            raise RuntimeError(f"slot {slot} is busy")
+        n = len(prompt)
+        padded = np.zeros((self.prefill_len,), np.int32)
+        padded[:n] = np.asarray(prompt, np.int32)
+        self._key, sub = jax.random.split(self._key)
+        first, self._caches = self._prefill(
+            self._params, self._buffers, self._caches,
+            jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
+            jnp.asarray(bool(do_sample)), jnp.float32(temperature), sub)
+        first = int(np.asarray(first))
+        self.slot_active[slot] = True
+        self.slot_pos[slot] = n
+        self.slot_tok[slot] = first
+        self.slot_sample[slot] = bool(do_sample)
+        self.slot_temp[slot] = float(temperature)
+        return first
+
+    def decode_wave(self):
+        """One batched decode step over all slots. Returns {slot: token}
+        for the slots that were active this wave (the token generated at
+        each slot's frontier). Inactive lanes ride along frozen."""
+        active_now = list(self.slot_active)
+        if not any(active_now):
+            return {}
+        self._key, sub = jax.random.split(self._key)
+        tok, pos, self._caches = self._decode_wave(
+            self._params, self._buffers, self._caches,
+            jnp.asarray(self.slot_tok, jnp.int32),
+            jnp.asarray(self.slot_pos, jnp.int32),
+            jnp.asarray(active_now, bool),
+            jnp.asarray(self.slot_sample, bool),
+            jnp.asarray(self.slot_temp, jnp.float32), sub)
+        tok = np.asarray(tok)
+        out = {}
+        for s, was_active in enumerate(active_now):
+            if was_active:
+                self.slot_pos[s] += 1
+                self.slot_tok[s] = int(tok[s])
+                out[s] = int(tok[s])
+        return out
+
+    def slot_full(self, slot):
+        """True when the slot's next write would fall past the cache
+        horizon (max_len - 1 is the last legal write) — the scheduler
+        must retire it (finish_reason 'length') before the next wave."""
+        return self.slot_pos[slot] >= self.max_len
+
+    def retire_slot(self, slot):
+        """Free a slot between waves. The cache region is left as-is:
+        the next prefill overwrites [0, P) and the decode frontier
+        rewrites every position before the ks<=pos mask exposes it."""
+        self.slot_active[slot] = False
+        self.slot_sample[slot] = False
+        self.slot_temp[slot] = 1.0

@@ -1,0 +1,110 @@
+"""Serving metrics: TTFT distribution, token throughput, queue depth and
+slot occupancy — wired through the process-wide monitor stat registry
+(utils/monitor.py) so `paddle_tpu.utils.monitor.all_stats()` shows the
+serving counters next to everything else, and through
+utils/profiler.RecordEvent so prefill/decode waves land in the host
+profiler table and chrome traces.
+"""
+import threading
+
+from ..utils import monitor
+
+# stat-registry keys (monitor.stat_get / all_stats)
+REQUESTS_SUBMITTED = "serving_requests_submitted"
+REQUESTS_COMPLETED = "serving_requests_completed"
+REQUESTS_REJECTED = "serving_requests_rejected"
+TOKENS_GENERATED = "serving_tokens_generated"
+PREFILLS = "serving_prefills"
+DECODE_WAVES = "serving_decode_waves"
+QUEUE_DEPTH = "serving_queue_depth"
+SLOTS_ACTIVE = "serving_slots_active"
+QUEUE_DEPTH_PEAK = "serving_queue_depth_peak"
+
+
+class ServingMetrics:
+    """Per-engine aggregation on top of the global counters: keeps the
+    raw TTFT/latency samples (for p50/p99) and the occupancy integral
+    (active-slot-waves / total-slot-waves)."""
+
+    def __init__(self, num_slots):
+        self.num_slots = num_slots
+        self._lock = threading.Lock()
+        self._ttft = []
+        self._latency = []
+        self._active_slot_waves = 0
+        self._total_slot_waves = 0
+        self._tokens = 0
+        self._queue_peak = 0
+        self._first_token_time = None
+        self._last_token_time = None
+
+    # ---------------------------------------------------------- recording
+    def on_submit(self):
+        monitor.stat_add(REQUESTS_SUBMITTED)
+
+    def on_reject(self):
+        monitor.stat_add(REQUESTS_REJECTED)
+
+    def on_prefill(self):
+        monitor.stat_add(PREFILLS)
+
+    def on_wave(self, n_active):
+        monitor.stat_add(DECODE_WAVES)
+        monitor.stat_set(SLOTS_ACTIVE, int(n_active))
+        with self._lock:
+            self._active_slot_waves += int(n_active)
+            self._total_slot_waves += self.num_slots
+
+    def on_queue_depth(self, depth):
+        monitor.stat_set(QUEUE_DEPTH, int(depth))
+        monitor.stat_max(QUEUE_DEPTH_PEAK, int(depth))  # process-wide peak
+        with self._lock:
+            self._queue_peak = max(self._queue_peak, int(depth))
+
+    def on_token(self, t_now):
+        monitor.stat_add(TOKENS_GENERATED)
+        with self._lock:
+            self._tokens += 1
+            if self._first_token_time is None:
+                self._first_token_time = t_now
+            self._last_token_time = t_now
+
+    def on_complete(self, request):
+        monitor.stat_add(REQUESTS_COMPLETED)
+        with self._lock:
+            if request.ttft is not None:
+                self._ttft.append(request.ttft)
+            if request.latency is not None:
+                self._latency.append(request.latency)
+
+    # ---------------------------------------------------------- reporting
+    @staticmethod
+    def _pct(samples, q):
+        if not samples:
+            return None
+        s = sorted(samples)
+        idx = min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1))))
+        return s[idx]
+
+    def snapshot(self):
+        """Point-in-time summary dict (the bench script serializes this)."""
+        with self._lock:
+            ttft = list(self._ttft)
+            lat = list(self._latency)
+            active, total = self._active_slot_waves, self._total_slot_waves
+            tokens = self._tokens
+            span = (None if self._first_token_time is None
+                    or self._last_token_time is None
+                    else self._last_token_time - self._first_token_time)
+            queue_peak = self._queue_peak
+        return {
+            "requests_completed": len(lat),
+            "tokens_generated": tokens,
+            "tokens_per_s": (tokens / span if span else None),
+            "ttft_p50_s": self._pct(ttft, 50),
+            "ttft_p99_s": self._pct(ttft, 99),
+            "latency_p50_s": self._pct(lat, 50),
+            "latency_p99_s": self._pct(lat, 99),
+            "slot_occupancy": (active / total if total else 0.0),
+            "queue_depth_peak": queue_peak,   # this instance, not the
+        }                                     # process-wide monitor stat

@@ -1,0 +1,131 @@
+"""Request lifecycle for the serving engine.
+
+A request moves QUEUED -> PREFILL -> DECODE -> DONE (or REJECTED at
+admission). Tokens stream to the caller through an optional per-request
+callback fired as each wave's tokens land on host; timestamps are taken
+at every transition so TTFT/latency metrics need no extra bookkeeping.
+"""
+import threading
+import time
+
+
+class RequestState:
+    QUEUED = "QUEUED"
+    PREFILL = "PREFILL"
+    DECODE = "DECODE"
+    DONE = "DONE"
+    REJECTED = "REJECTED"
+
+
+class Request:
+    """One generation request.
+
+    prompt: list/array of int token ids (length >= 1)
+    max_tokens: generation budget (>= 1); the engine also stops at the
+        cache horizon (finish_reason "length") and at eos_token_id
+        (finish_reason "eos"). timeout (seconds, wall-clock from submit)
+        retires a stuck request with finish_reason "timeout".
+    on_token: optional fn(request, token_id) streaming callback —
+        exceptions are swallowed into `callback_error` so one client
+        cannot poison the shared decode loop.
+    """
+    _ids = iter(range(1, 1 << 62))
+    _ids_lock = threading.Lock()
+
+    def __init__(self, prompt, max_tokens=16, eos_token_id=None,
+                 timeout=None, on_token=None, do_sample=False,
+                 temperature=1.0):
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        with Request._ids_lock:
+            self.request_id = next(Request._ids)
+        self.prompt = prompt
+        self.max_tokens = int(max_tokens)
+        self.eos_token_id = None if eos_token_id is None else int(eos_token_id)
+        self.timeout = None if timeout is None else float(timeout)
+        self.on_token = on_token
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+
+        self.state = RequestState.QUEUED
+        self.slot = None                 # engine slot while PREFILL/DECODE
+        self.output_tokens = []
+        self.finish_reason = None        # eos | max_tokens | length | timeout
+        self.callback_error = None
+        self.submit_time = None          # set by the scheduler at admission
+        self.prefill_time = None
+        self.first_token_time = None
+        self.done_time = None
+        self._done_event = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def _mark_submitted(self):
+        self.submit_time = time.monotonic()
+
+    def _start_prefill(self, slot):
+        self.state = RequestState.PREFILL
+        self.slot = slot
+        self.prefill_time = time.monotonic()
+
+    def _emit(self, token_id):
+        """Record one generated token (first one comes from prefill)."""
+        token_id = int(token_id)
+        if self.first_token_time is None:
+            self.first_token_time = time.monotonic()
+            self.state = RequestState.DECODE
+        self.output_tokens.append(token_id)
+        if self.on_token is not None:
+            try:
+                self.on_token(self, token_id)
+            except Exception as e:    # noqa: BLE001 — client code
+                self.callback_error = e
+
+    def _finish(self, reason):
+        self.state = RequestState.DONE
+        self.finish_reason = reason
+        self.slot = None
+        self.done_time = time.monotonic()
+        self._done_event.set()
+
+    def _reject(self, why):
+        self.state = RequestState.REJECTED
+        self.finish_reason = "rejected"
+        self._done_event.set()
+        raise ValueError(why)
+
+    def _timed_out(self):
+        return (self.timeout is not None and self.submit_time is not None
+                and time.monotonic() - self.submit_time > self.timeout)
+
+    # ------------------------------------------------------------ client API
+    @property
+    def done(self):
+        return self.state in (RequestState.DONE, RequestState.REJECTED)
+
+    def wait(self, timeout=None):
+        """Block until DONE/REJECTED (for callers driving the scheduler
+        from another thread). Returns self."""
+        self._done_event.wait(timeout)
+        return self
+
+    @property
+    def ttft(self):
+        """Time-to-first-token in seconds (None until the first token)."""
+        if self.first_token_time is None or self.submit_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+    @property
+    def latency(self):
+        if self.done_time is None or self.submit_time is None:
+            return None
+        return self.done_time - self.submit_time
+
+    def __repr__(self):
+        return (f"Request(id={self.request_id}, state={self.state}, "
+                f"prompt_len={len(self.prompt)}, "
+                f"generated={len(self.output_tokens)}/{self.max_tokens}, "
+                f"finish={self.finish_reason})")

@@ -1,0 +1,164 @@
+"""Scheduler: admission queue + continuous-batching loop on top of
+ServingEngine.
+
+FCFS admission: whenever a slot is free and the queue is non-empty, the
+head request is prefilled into the slot MID-STREAM — the other slots'
+in-flight decodes are untouched (next wave simply sees one more active
+lane; same compiled program). Retirement (EOS / max_tokens / cache
+horizon / timeout) frees slots between waves and the freed slot is
+refilled in the same step() — a slot never idles while work is queued.
+
+Thread-model: submit() is safe from any producer thread (the bench
+script's Poisson arrival generator); the wave loop itself runs wherever
+run()/step() is called — the engine's compiled programs are driven from
+one thread at a time.
+"""
+import collections
+import threading
+import time
+
+from ..utils.profiler import RecordEvent
+from .metrics import ServingMetrics
+from .request import Request, RequestState
+
+
+class Scheduler:
+    def __init__(self, engine, max_queue=None, completed_log=1024):
+        self.engine = engine
+        self.max_queue = max_queue
+        self._queue = collections.deque()
+        self._lock = threading.Lock()
+        self._slot_req = [None] * engine.num_slots
+        self.metrics = ServingMetrics(engine.num_slots)
+        # bounded: callers hold their own Request handles (submit returns
+        # them); this ring is a debugging/inspection tail, and unbounded
+        # growth would leak every prompt ever served on a long-running
+        # server. completed_log=None keeps everything (tests/benches).
+        self.completed = collections.deque(maxlen=completed_log)
+
+    # ---------------------------------------------------------- admission
+    def submit(self, request=None, **kw):
+        """Enqueue a Request (or build one from kwargs: prompt,
+        max_tokens, eos_token_id, timeout, on_token, do_sample,
+        temperature). Oversized prompts are rejected CLEANLY here — the
+        request is marked REJECTED, a ValueError raises to the caller,
+        and the engine/queue state is untouched."""
+        if request is None:
+            request = Request(**kw)
+        why = self.engine.validate_prompt(request.prompt)
+        if why is not None:
+            self.metrics.on_reject()
+            request._reject(why)           # raises ValueError
+        with self._lock:
+            if self.max_queue is not None and len(self._queue) >= \
+                    self.max_queue:
+                self.metrics.on_reject()
+                request._reject(f"queue full (max_queue={self.max_queue})")
+            request._mark_submitted()
+            self._queue.append(request)
+            depth = len(self._queue)
+        self.metrics.on_submit()
+        self.metrics.on_queue_depth(depth)
+        return request
+
+    def queue_depth(self):
+        with self._lock:
+            return len(self._queue)
+
+    def _pop_next(self):
+        with self._lock:
+            req = self._queue.popleft() if self._queue else None
+            depth = len(self._queue)
+        self.metrics.on_queue_depth(depth)
+        return req
+
+    def _admit(self):
+        """Prefill queued requests into free slots. A request whose
+        timeout already expired in the queue is retired without spending
+        a prefill on it."""
+        while True:
+            free = self.engine.free_slots()
+            if not free:
+                return
+            req = self._pop_next()
+            if req is None:
+                return
+            if req._timed_out():
+                req._finish("timeout")
+                self._complete(req)
+                continue
+            slot = free[0]
+            req._start_prefill(slot)
+            self._slot_req[slot] = req
+            with RecordEvent("serving/prefill"):
+                first = self.engine.prefill_slot(
+                    slot, req.prompt, do_sample=req.do_sample,
+                    temperature=req.temperature)
+            self.metrics.on_prefill()
+            req._emit(first)
+            self.metrics.on_token(time.monotonic())
+            self._maybe_retire(slot, first)
+
+    # ---------------------------------------------------------- wave loop
+    def _maybe_retire(self, slot, last_token):
+        """Retire the slot if its request just finished: EOS (even on the
+        very first prefill-produced token), token budget, cache horizon,
+        or wall-clock timeout."""
+        req = self._slot_req[slot]
+        reason = None
+        if req.eos_token_id is not None and last_token == req.eos_token_id:
+            reason = "eos"
+        elif len(req.output_tokens) >= req.max_tokens:
+            reason = "max_tokens"
+        elif self.engine.slot_full(slot):
+            reason = "length"
+        elif req._timed_out():
+            reason = "timeout"
+        if reason is not None:
+            self.engine.retire_slot(slot)
+            self._slot_req[slot] = None
+            req._finish(reason)
+            self._complete(req)
+
+    def _complete(self, req):
+        self.completed.append(req)
+        self.metrics.on_complete(req)
+
+    def step(self):
+        """One scheduling round: refill free slots from the queue, run
+        one batched decode wave, stream the tokens, retire finished
+        slots. Returns the number of requests still in flight or queued."""
+        self._admit()
+        active = self.engine.active_slots()
+        if active:
+            with RecordEvent("serving/decode_wave"):
+                toks = self.engine.decode_wave()
+            self.metrics.on_wave(len(active))
+            now = time.monotonic()
+            for slot, tok in toks.items():
+                self._slot_req[slot]._emit(tok)
+                self.metrics.on_token(now)
+                self._maybe_retire(slot, tok)
+        return self.in_flight() + self.queue_depth()
+
+    def in_flight(self):
+        return sum(1 for r in self._slot_req if r is not None)
+
+    def run(self, drain=True, max_waves=None):
+        """Drive step() until the queue and all slots drain (or max_waves
+        hit). Producer threads may keep submit()ing while this runs."""
+        waves = 0
+        while self.step():
+            waves += 1
+            if max_waves is not None and waves >= max_waves:
+                break
+        return waves
+
+    # ---------------------------------------------------------- conveniences
+    def generate(self, prompt, **kw):
+        """Blocking single-request convenience (the create_llm_predictor
+        surface): submit, drain, return the generated token list."""
+        req = self.submit(prompt=prompt, **kw)
+        while not req.done:
+            self.step()
+        return req.output_tokens

@@ -23,6 +23,14 @@ def stat_set(name, value):
         _stats[name] = value
 
 
+def stat_max(name, value):
+    """Atomic running max — peak-style gauges (serving queue-depth peak)
+    from producer threads without a get-then-set race."""
+    with _lock:
+        _stats[name] = max(_stats.get(name, value), value)
+        return _stats[name]
+
+
 def stat_get(name, default=0):
     with _lock:
         return _stats.get(name, default)

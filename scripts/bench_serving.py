@@ -1,0 +1,169 @@
+"""Offered-load sweep for the continuous-batching serving engine.
+
+Poisson arrivals (exponential inter-arrival gaps) with mixed prompt and
+output lengths are submitted from a producer thread while the scheduler
+drives decode waves; per load point we report tokens/s, p50/p99 TTFT,
+and slot occupancy — one JSON line per point in the same
+{"metric", "value", "unit", "detail"} shape as bench.py, plus a
+BENCH_serving.json rollup next to the existing BENCH_*.json files.
+
+    python scripts/bench_serving.py                    # default sweep
+    python scripts/bench_serving.py --loads 2,8,32 --requests 24
+    python scripts/bench_serving.py --family llama --slots 8
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import paddle_tpu as pt
+from paddle_tpu.serving import ServingEngine, Scheduler
+
+t0 = time.time()
+
+
+def log(m):
+    print(f"[{time.time()-t0:7.1f}s] {m}", flush=True)
+
+
+def build_model(family, hidden, layers, heads, vocab, max_seq_len, bf16):
+    pt.seed(0)
+    if family == "llama":
+        from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                          num_layers=layers, num_heads=heads,
+                          num_kv_heads=max(1, heads // 4),
+                          max_seq_len=max_seq_len)
+        model = LlamaForCausalLM(cfg)
+    else:
+        from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+        cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                        num_layers=layers, num_heads=heads,
+                        max_seq_len=max_seq_len, dropout=0.0,
+                        attn_dropout=0.0)
+        model = GPTForPretraining(cfg)
+    if bf16:
+        model.to(dtype=jnp.bfloat16)
+    return model, cfg
+
+
+def run_load(sched, load_rps, n_requests, vocab, prompt_range,
+             output_range, seed):
+    """Submit n_requests at Poisson rate load_rps from a producer thread
+    while this thread drives the wave loop until everything drains."""
+    rng = np.random.RandomState(seed)
+    reqs, done_submitting = [], threading.Event()
+
+    def producer():
+        for _ in range(n_requests):
+            time.sleep(rng.exponential(1.0 / load_rps))
+            p = rng.randint(0, vocab, (rng.randint(*prompt_range),)).tolist()
+            reqs.append(sched.submit(
+                prompt=p, max_tokens=int(rng.randint(*output_range))))
+        done_submitting.set()
+
+    th = threading.Thread(target=producer, daemon=True)
+    t_start = time.time()
+    th.start()
+    # drive waves until the producer is done and the system drains;
+    # idle-spin politely while slots and queue are briefly empty
+    while True:
+        pending = sched.step()
+        if pending == 0:
+            # re-check the queue AFTER seeing the producer finished: a
+            # final submit can land between step() and is_set()
+            if done_submitting.is_set() and sched.queue_depth() == 0:
+                break
+            time.sleep(0.001)
+    th.join()
+    wall = time.time() - t_start
+    snap = sched.metrics.snapshot()
+    snap["wall_s"] = wall
+    snap["offered_load_rps"] = load_rps
+    snap["n_requests"] = len(reqs)
+    return snap
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--family", default="gpt", choices=["gpt", "llama"])
+    ap.add_argument("--loads", default="2,8,32",
+                    help="offered loads (requests/s), comma-separated")
+    ap.add_argument("--requests", type=int, default=16,
+                    help="requests per load point")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=256)
+    ap.add_argument("--prefill-len", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=256)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--out", default=os.path.join(_REPO,
+                                                  "BENCH_serving.json"))
+    args = ap.parse_args()
+
+    model, _cfg = build_model(args.family, args.hidden, args.layers,
+                              args.heads, args.vocab, args.max_len,
+                              args.bf16)
+    engine = ServingEngine(model, num_slots=args.slots,
+                           max_len=args.max_len,
+                           prefill_len=args.prefill_len)
+
+    # warm the two programs so every load point measures execution only
+    sched = Scheduler(engine)
+    sched.generate([1, 2, 3], max_tokens=4)
+    log(f"warmup done (decode compiles={engine.decode_compiles}, "
+        f"prefill compiles={engine.prefill_compiles})")
+
+    rows = []
+    for i, load in enumerate(float(x) for x in args.loads.split(",")):
+        sched = Scheduler(engine)        # fresh metrics per load point
+        out_hi = max(5, min(64, args.max_len - args.prefill_len))
+        snap = run_load(sched, load, args.requests, args.vocab,
+                        prompt_range=(4, args.prefill_len),
+                        output_range=(4, out_hi), seed=100 + i)
+        assert engine.decode_compiles <= 1, "decode step recompiled"
+        row = {
+            "metric": f"serving {args.family} tokens/s "
+                      f"@{load:g}req/s x{args.slots}slots",
+            "value": round(snap["tokens_per_s"] or 0.0, 1),
+            "unit": "tokens/s",
+            "detail": {
+                "ttft_p50_ms": round((snap["ttft_p50_s"] or 0) * 1e3, 2),
+                "ttft_p99_ms": round((snap["ttft_p99_s"] or 0) * 1e3, 2),
+                "slot_occupancy": round(snap["slot_occupancy"], 4),
+                "queue_depth_peak": snap["queue_depth_peak"],
+                "requests": snap["n_requests"],
+                "wall_s": round(snap["wall_s"], 2),
+                "offered_load_rps": load,
+                "backend": jax.default_backend(),
+                "num_slots": args.slots,
+                "max_len": args.max_len,
+                "prefill_len": args.prefill_len,
+            },
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump({"cmd": " ".join(sys.argv), "rows": rows}, f, indent=1)
+    log(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

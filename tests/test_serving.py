@@ -1,0 +1,214 @@
+"""paddle_tpu.serving — slot-based continuous-batching engine.
+
+Tier-1 tests share ONE tiny LLaMA engine (2 layers, hidden 64 — the
+870s budget is nearly full) via a module fixture, so the batched decode
+step and the prefill program each compile exactly once for the whole
+file; the compile-once invariant is asserted across a 3-wave stream.
+The heavier mixed-sampling stress run is @slow.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nlp.gpt import generate
+from paddle_tpu.serving import ServingEngine, Scheduler, RequestState
+
+VOCAB = 128
+PROMPT_LEN = 5
+MAX_NEW = 10
+
+
+@pytest.fixture(scope="module")
+def engine():
+    pt.seed(7)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    return ServingEngine(model, num_slots=4, max_len=64, prefill_len=16)
+
+
+def _prompt(seed, n=PROMPT_LEN):
+    return np.random.RandomState(seed).randint(0, VOCAB, (n,)).tolist()
+
+
+def _ref_greedy(model, prompt, max_new=MAX_NEW):
+    """Unbatched KV-cache greedy decode (the pre-serving path)."""
+    ids = np.asarray([prompt], np.int32)
+    out = generate(model, ids, max_new_tokens=max_new, use_cache=True)
+    return np.asarray(out.numpy())[0, len(prompt):].tolist()
+
+
+def test_single_request_matches_unbatched_greedy(engine):
+    """Parity guard for the position-vector decode_step refactor: the
+    batched engine must be token-identical to the unbatched greedy
+    path for a single request."""
+    sched = Scheduler(engine)
+    for seed in (0, 3):
+        prompt = _prompt(seed)
+        got = sched.generate(prompt, max_tokens=MAX_NEW)
+        assert got == _ref_greedy(engine.model, prompt)
+
+
+def test_three_wave_stream_compiles_once(engine):
+    """12 requests on 4 slots = 3 admission waves; every request
+    completes, slots retire/refill mid-stream, and the batched decode
+    step stays at exactly ONE compiled program."""
+    sched = Scheduler(engine)
+    rng = np.random.RandomState(1)
+    reqs = []
+    for i in range(12):
+        p = rng.randint(0, VOCAB, (int(rng.randint(2, 12)),)).tolist()
+        reqs.append(sched.submit(prompt=p,
+                                 max_tokens=int(rng.randint(2, 10))))
+    assert sched.queue_depth() == 12
+    sched.run()
+    assert all(r.state == RequestState.DONE for r in reqs)
+    assert all(1 <= len(r.output_tokens) <= r.max_tokens for r in reqs)
+    assert engine.decode_compiles == 1
+    assert engine.prefill_compiles == 1
+    snap = sched.metrics.snapshot()
+    assert snap["requests_completed"] == 12
+    assert snap["slot_occupancy"] > 0
+    assert snap["ttft_p50_s"] is not None
+
+
+def test_retire_refill_midstream_no_cross_talk(engine):
+    """Mixed token budgets retire and refill slots while neighbours keep
+    decoding; each request's tokens must equal the same request run
+    ALONE on the same engine (slot reuse may not leak stale cache)."""
+    sched = Scheduler(engine)
+    prompts = [_prompt(10 + i, n=3 + i % 5) for i in range(6)]
+    budgets = [3, 9, 2, 7, 4, 5]
+    reqs = [sched.submit(prompt=p, max_tokens=m)
+            for p, m in zip(prompts, budgets)]
+    sched.run()
+    assert all(r.done for r in reqs)
+    solo = Scheduler(engine)
+    for p, m, r in zip(prompts, budgets, reqs):
+        assert solo.generate(p, max_tokens=m) == r.output_tokens
+    assert engine.decode_compiles == 1
+
+
+def test_all_slots_busy_queues_fcfs(engine):
+    """More requests than slots: the overflow waits QUEUED, admission is
+    FCFS, and everyone completes."""
+    sched = Scheduler(engine)
+    reqs = [sched.submit(prompt=_prompt(20 + i), max_tokens=4)
+            for i in range(7)]
+    assert sched.queue_depth() == 7       # submit only enqueues
+    sched.step()                          # first round: 4 admitted, 3 wait
+    assert sum(r.state != RequestState.QUEUED for r in reqs) == 4
+    assert sched.queue_depth() == 3
+    sched.run()
+    assert all(r.done for r in reqs)
+    # FCFS: later submissions never finish before earlier ones started
+    starts = [r.prefill_time for r in reqs]
+    assert starts == sorted(starts)
+
+
+def test_prompt_longer_than_bucket_rejected_cleanly(engine):
+    """Oversized prompt: clean ValueError, REJECTED state, and the
+    engine keeps serving afterwards."""
+    sched = Scheduler(engine)
+    long_prompt = _prompt(0, n=engine.prefill_len + 1)
+    with pytest.raises(ValueError, match="prefill bucket"):
+        sched.submit(prompt=long_prompt, max_tokens=4)
+    assert not engine.active_slots()          # nothing leaked into a slot
+    prompt = _prompt(4)
+    assert sched.generate(prompt, max_tokens=4) == \
+        _ref_greedy(engine.model, prompt, max_new=4)
+
+
+def test_eos_on_first_decoded_token(engine):
+    """EOS equal to the prefill-produced FIRST token: the request is
+    done with exactly one token and zero decode waves spent on it."""
+    prompt = _prompt(5)
+    first = _ref_greedy(engine.model, prompt, max_new=1)[0]
+    sched = Scheduler(engine)
+    req = sched.submit(prompt=prompt, max_tokens=8, eos_token_id=first)
+    while not req.done:
+        sched.step()
+    assert req.output_tokens == [first]
+    assert req.finish_reason == "eos"
+    assert req.ttft is not None
+
+
+def test_request_hits_cache_horizon(engine):
+    """max_tokens beyond the cache horizon: the engine retires the slot
+    at max_len with finish_reason 'length' instead of clamp-corrupting
+    the cache tail."""
+    prompt = _prompt(6, n=engine.prefill_len)      # 16 of 64 positions
+    sched = Scheduler(engine)
+    req = sched.submit(prompt=prompt, max_tokens=10_000)
+    sched.run()
+    assert req.finish_reason == "length"
+    # prompt fills [0,16); decode writes [16, 64) = 48 tokens on top of
+    # the prefill-produced first token
+    assert len(req.output_tokens) == \
+        engine.max_len - engine.prefill_len + 1
+
+
+def test_streaming_callback_and_isolation(engine):
+    """Tokens stream in order through on_token; a raising callback is
+    contained (callback_error) and does not poison the wave loop."""
+    sched = Scheduler(engine)
+    seen = []
+
+    def cb(r, t):
+        seen.append(t)
+
+    def bad_cb(r, t):
+        raise RuntimeError("client bug")
+
+    good = sched.submit(prompt=_prompt(8), max_tokens=5, on_token=cb)
+    bad = sched.submit(prompt=_prompt(9), max_tokens=5, on_token=bad_cb)
+    sched.run()
+    assert seen == good.output_tokens and len(seen) == 5
+    assert isinstance(bad.callback_error, RuntimeError)
+    assert bad.state == RequestState.DONE and len(bad.output_tokens) == 5
+
+
+def test_create_llm_predictor_front_door(engine):
+    """inference.Config knobs reach serving via create_llm_predictor."""
+    from paddle_tpu import inference
+    cfg = inference.Config()
+    cfg.enable_llm_engine(num_slots=2, max_len=48, prefill_len=16,
+                          eos_token_id=None)
+    pred = inference.create_llm_predictor(cfg, model=engine.model)
+    assert pred.engine.num_slots == 2 and pred.engine.max_len == 48
+    prompt = _prompt(11)
+    assert pred.generate(prompt, max_tokens=4) == \
+        _ref_greedy(engine.model, prompt, max_new=4)
+    with pytest.raises(ValueError, match="needs `model`"):
+        inference.create_llm_predictor(inference.Config())
+
+
+@pytest.mark.slow
+def test_serving_stress_multi_wave_mixed_sampling():
+    """Stress: 30 mixed greedy/sampled requests with timeouts and EOS on
+    an 8-slot engine — compile-once must survive the full churn."""
+    pt.seed(11)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=128, num_layers=4,
+                      num_heads=8, num_kv_heads=4, max_seq_len=128)
+    model = LlamaForCausalLM(cfg)
+    engine = ServingEngine(model, num_slots=8, max_len=128,
+                           prefill_len=32)
+    sched = Scheduler(engine)
+    rng = np.random.RandomState(2)
+    reqs = []
+    for i in range(30):
+        p = rng.randint(0, 256, (int(rng.randint(2, 32)),)).tolist()
+        reqs.append(sched.submit(
+            prompt=p, max_tokens=int(rng.randint(2, 24)),
+            do_sample=bool(i % 3 == 0), temperature=0.8,
+            eos_token_id=(5 if i % 4 == 0 else None)))
+    sched.run()
+    assert all(r.done for r in reqs)
+    assert engine.decode_compiles == 1
+    assert engine.prefill_compiles == 1
+    snap = sched.metrics.snapshot()
+    assert snap["requests_completed"] == 30
+    assert snap["tokens_generated"] == sum(len(r.output_tokens)
+                                           for r in reqs)
