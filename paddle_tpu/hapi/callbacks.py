@@ -250,6 +250,67 @@ class ReduceLROnPlateau(Callback):
             self.wait = 0
 
 
+class TelemetryCallback(Callback):
+    """Unified-telemetry training callback (docs/observability.md): per
+    train step it feeds the `train_step_seconds` histogram and the
+    `train_loss` gauge, bumps `train_steps_total`, and every
+    `memory_freq` steps refreshes the PJRT device-memory gauges
+    (`device_bytes_in_use` / `device_peak_bytes_in_use` /
+    `device_bytes_limit`) — the training-side view on the same /metrics
+    endpoint the serving engine exports.
+
+        model.fit(data, callbacks=[callbacks.TelemetryCallback()])
+    """
+
+    def __init__(self, memory_freq=10, device=None):
+        super().__init__()
+        from ..utils import telemetry
+        self.memory_freq = max(0, int(memory_freq))
+        self.device = device
+        self._t0 = None
+        self._steps = telemetry.counter(
+            "train_steps_total", "Train steps completed")
+        self._step_h = telemetry.histogram(
+            "train_step_seconds", "Wall time per train step")
+        self._loss = telemetry.gauge(
+            "train_loss", "Loss of the latest train step")
+        self._mem_in_use = telemetry.gauge(
+            "device_bytes_in_use", "PJRT device memory in use")
+        self._mem_peak = telemetry.gauge(
+            "device_peak_bytes_in_use", "PJRT peak device memory")
+        self._mem_limit = telemetry.gauge(
+            "device_bytes_limit", "PJRT device memory limit")
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t0 is not None:
+            self._step_h.observe(time.perf_counter() - self._t0)
+            self._t0 = None
+        self._steps.inc()
+        loss = (logs or {}).get("loss")
+        if isinstance(loss, (list, tuple)) and loss:
+            loss = loss[0]
+        if isinstance(loss, numbers.Number):
+            self._loss.set(float(loss))
+        if self.memory_freq and step % self.memory_freq == 0:
+            self._poll_device_memory()
+
+    def on_train_end(self, logs=None):
+        self._poll_device_memory()
+
+    def _poll_device_memory(self):
+        from ..utils import monitor
+        try:
+            stats = monitor.device_memory_stats(self.device)
+        except Exception:      # no PJRT stats on this backend: keep zeros
+            return
+        self._mem_in_use.set(stats.get("bytes_in_use", 0))
+        self._mem_peak.set(stats.get("peak_bytes_in_use", 0))
+        self._mem_limit.set(stats.get("bytes_limit", 0))
+
+
 class VisualDL(Callback):
     """Stub writer: VisualDL isn't installed in this image; logs to a jsonl."""
 

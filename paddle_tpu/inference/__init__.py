@@ -36,6 +36,7 @@ class Config:
         self._ir_optim = True
         self._cpu_math_threads = None
         self._llm_opts = None
+        self._metrics_exporter = None
 
     # ---- LLM serving engine (paddle_tpu.serving front door)
     def enable_llm_engine(self, num_slots=4, max_len=256, prefill_len=None,
@@ -56,6 +57,18 @@ class Config:
 
     def llm_engine_enabled(self):
         return self._llm_opts is not None
+
+    def enable_metrics_exporter(self, port=0, host="127.0.0.1"):
+        """Arm the unified-telemetry /metrics exporter
+        (docs/observability.md): create_llm_predictor starts a
+        background stdlib-http.server thread serving /metrics
+        (Prometheus), /metrics.json and /healthz. port=0 picks a free
+        port — read it from predictor.metrics_server.port."""
+        self._metrics_exporter = {"port": int(port), "host": str(host)}
+        return self
+
+    def metrics_exporter_enabled(self):
+        return self._metrics_exporter is not None
 
     # ---- knobs with real effect
     def enable_memory_optim(self, flag=True):
@@ -310,6 +323,16 @@ class LLMPredictor:
             jit_compile=config.ir_optim())
         self.scheduler = Scheduler(self.engine,
                                    max_queue=opts.get("max_queue"))
+        self.metrics_server = None
+        if config.metrics_exporter_enabled():
+            self.metrics_server = self.engine.start_metrics_server(
+                **config._metrics_exporter)
+
+    def close(self):
+        """Stop the background metrics exporter (if any). The engine's
+        compiled programs need no teardown."""
+        self.engine.stop_metrics_server()
+        self.metrics_server = None
 
     def generate(self, prompt, **kw):
         kw.setdefault("eos_token_id", self._eos_token_id)

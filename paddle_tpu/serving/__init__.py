@@ -14,6 +14,12 @@ docs/serving.md for the architecture.
     req = sched.submit(prompt=[1, 2, 3], max_tokens=32,
                        on_token=lambda r, t: print(t))
     sched.run()                    # drains queue + slots
+
+Observability (docs/observability.md): requests carry trace ids and
+emit chrome-trace spans/flows through utils.telemetry; serving counters
+and TTFT/latency histograms live in the typed metric registry; and
+`engine.start_metrics_server()` (or
+inference.Config.enable_metrics_exporter) serves /metrics + /healthz.
 """
 from .engine import ServingEngine
 from .scheduler import Scheduler

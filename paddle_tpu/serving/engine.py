@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tensor import Tensor
+from ..utils import telemetry
 
 
 def _infer_cache_dtype(params):
@@ -105,6 +106,7 @@ class ServingEngine:
         self.slot_temp = [1.0] * S
 
         self._jit = bool(jit_compile)
+        self._metrics_server = None
         self._build_programs()
 
     # ---------------------------------------------------------- programs
@@ -156,9 +158,16 @@ class ServingEngine:
             # donate the batched cache: the engine always replaces its
             # cache reference with the program output, so XLA may update
             # it in place — without this every wave would transiently
-            # hold 2x the [S, Hkv, L, D] pair in HBM
-            self._decode_wave = jax.jit(decode_wave, donate_argnums=(2,))
-            self._prefill = jax.jit(prefill, donate_argnums=(2,))
+            # hold 2x the [S, Hkv, L, D] pair in HBM.
+            # instrument_jit attributes XLA compile events to these
+            # labels (xla_compiles_total{function=...}) — the
+            # compile-once invariant as a live metric, not just the
+            # _cache_size() test assertion.
+            self._decode_wave = telemetry.instrument_jit(
+                jax.jit(decode_wave, donate_argnums=(2,)),
+                "serving_decode_wave")
+            self._prefill = telemetry.instrument_jit(
+                jax.jit(prefill, donate_argnums=(2,)), "serving_prefill")
         else:
             self._decode_wave = decode_wave
             self._prefill = prefill
@@ -172,6 +181,39 @@ class ServingEngine:
     @property
     def prefill_compiles(self):
         return self._prefill._cache_size() if self._jit else 0
+
+    # --------------------------------------------------------- telemetry
+    def start_metrics_server(self, port=0, host="127.0.0.1"):
+        """Expose /metrics (Prometheus), /metrics.json and /healthz on a
+        stdlib-http.server background thread. port=0 picks a free port
+        (read it back from the returned server's .port). Idempotent for
+        matching args; asking for a DIFFERENT host/port while a server
+        is live raises instead of silently keeping the old address."""
+        if self._metrics_server is not None:
+            srv = self._metrics_server
+            if host != srv.host or port not in (0, srv.port):
+                raise RuntimeError(
+                    f"metrics server already running at {srv.url}; call "
+                    "stop_metrics_server() before rebinding to "
+                    f"{host}:{port}")
+            return srv
+        self._metrics_server = telemetry.MetricsServer(
+            host=host, port=port, health_fn=self._health).start()
+        return self._metrics_server
+
+    def stop_metrics_server(self):
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+
+    def _health(self):
+        return {
+            "num_slots": self.num_slots,
+            "slots_active": len(self.active_slots()),
+            "max_len": self.max_len,
+            "decode_compiles": self.decode_compiles,
+            "prefill_compiles": self.prefill_compiles,
+        }
 
     # ------------------------------------------------------------- slots
     def free_slots(self):

@@ -1,15 +1,25 @@
 """Serving metrics: TTFT distribution, token throughput, queue depth and
-slot occupancy — wired through the process-wide monitor stat registry
-(utils/monitor.py) so `paddle_tpu.utils.monitor.all_stats()` shows the
-serving counters next to everything else, and through
-utils/profiler.RecordEvent so prefill/decode waves land in the host
-profiler table and chrome traces.
+slot occupancy.
+
+Two sinks, one recording path:
+
+  * the typed telemetry registry (utils/telemetry.py) — labeled
+    counters/gauges plus BOUNDED exponential-bucket histograms for
+    TTFT/latency, rendered on /metrics and in JSON snapshots. The
+    histograms replaced the raw per-request sample lists, so a
+    long-running engine's memory no longer grows with request count;
+    p50/p99 come from bucket interpolation.
+  * the legacy flat `utils.monitor` stat registry (`serving_*` keys),
+    kept so `monitor.all_stats()` callers see the same counters.
+
+`ServingMetrics.snapshot()` keys are byte-compatible with the PR-1
+shape (`scripts/bench_serving.py` serializes it unchanged).
 """
 import threading
 
-from ..utils import monitor
+from ..utils import monitor, telemetry
 
-# stat-registry keys (monitor.stat_get / all_stats)
+# legacy stat-registry keys (monitor.stat_get / all_stats)
 REQUESTS_SUBMITTED = "serving_requests_submitted"
 REQUESTS_COMPLETED = "serving_requests_completed"
 REQUESTS_REJECTED = "serving_requests_rejected"
@@ -20,17 +30,44 @@ QUEUE_DEPTH = "serving_queue_depth"
 SLOTS_ACTIVE = "serving_slots_active"
 QUEUE_DEPTH_PEAK = "serving_queue_depth_peak"
 
+# typed registry metrics (docs/observability.md catalogs these)
+_REQUESTS = telemetry.counter(
+    "serving_requests_total", "Requests by lifecycle event",
+    labelnames=("state",))
+_TOKENS = telemetry.counter(
+    "serving_tokens_generated_total", "Generated tokens streamed to hosts")
+_PREFILLS = telemetry.counter(
+    "serving_prefills_total", "Prefill program invocations (admissions)")
+_WAVES = telemetry.counter(
+    "serving_decode_waves_total", "Batched decode waves executed")
+_QUEUE_DEPTH = telemetry.gauge(
+    "serving_queue_depth", "Requests waiting for a slot")
+_SLOTS_ACTIVE = telemetry.gauge(
+    "serving_slots_active", "Slots decoding in the latest wave")
+_TTFT = telemetry.histogram(
+    "serving_ttft_seconds", "Time from submit to first token",
+    buckets=telemetry.DEFAULT_LATENCY_BUCKETS)
+_LATENCY = telemetry.histogram(
+    "serving_request_latency_seconds", "Time from submit to completion",
+    buckets=telemetry.DEFAULT_LATENCY_BUCKETS)
+
 
 class ServingMetrics:
-    """Per-engine aggregation on top of the global counters: keeps the
-    raw TTFT/latency samples (for p50/p99) and the occupancy integral
-    (active-slot-waves / total-slot-waves)."""
+    """Per-engine aggregation on top of the process-wide sinks: bounded
+    TTFT/latency histograms (for this instance's p50/p99) and the
+    occupancy integral (active-slot-waves / total-slot-waves)."""
 
     def __init__(self, num_slots):
         self.num_slots = num_slots
         self._lock = threading.Lock()
-        self._ttft = []
-        self._latency = []
+        # instance-local (unregistered) histograms: a fresh Scheduler
+        # gets fresh percentiles while the registered process-wide
+        # histograms keep accumulating for /metrics
+        self._ttft = telemetry.Histogram(
+            "serving_ttft_seconds", buckets=telemetry.DEFAULT_LATENCY_BUCKETS)
+        self._latency = telemetry.Histogram(
+            "serving_request_latency_seconds",
+            buckets=telemetry.DEFAULT_LATENCY_BUCKETS)
         self._active_slot_waves = 0
         self._total_slot_waves = 0
         self._tokens = 0
@@ -41,16 +78,21 @@ class ServingMetrics:
     # ---------------------------------------------------------- recording
     def on_submit(self):
         monitor.stat_add(REQUESTS_SUBMITTED)
+        _REQUESTS.labels(state="submitted").inc()
 
     def on_reject(self):
         monitor.stat_add(REQUESTS_REJECTED)
+        _REQUESTS.labels(state="rejected").inc()
 
     def on_prefill(self):
         monitor.stat_add(PREFILLS)
+        _PREFILLS.inc()
 
     def on_wave(self, n_active):
         monitor.stat_add(DECODE_WAVES)
         monitor.stat_set(SLOTS_ACTIVE, int(n_active))
+        _WAVES.inc()
+        _SLOTS_ACTIVE.set(int(n_active))
         with self._lock:
             self._active_slot_waves += int(n_active)
             self._total_slot_waves += self.num_slots
@@ -58,11 +100,13 @@ class ServingMetrics:
     def on_queue_depth(self, depth):
         monitor.stat_set(QUEUE_DEPTH, int(depth))
         monitor.stat_max(QUEUE_DEPTH_PEAK, int(depth))  # process-wide peak
+        _QUEUE_DEPTH.set(int(depth))
         with self._lock:
             self._queue_peak = max(self._queue_peak, int(depth))
 
     def on_token(self, t_now):
         monitor.stat_add(TOKENS_GENERATED)
+        _TOKENS.inc()
         with self._lock:
             self._tokens += 1
             if self._first_token_time is None:
@@ -71,26 +115,20 @@ class ServingMetrics:
 
     def on_complete(self, request):
         monitor.stat_add(REQUESTS_COMPLETED)
-        with self._lock:
-            if request.ttft is not None:
-                self._ttft.append(request.ttft)
-            if request.latency is not None:
-                self._latency.append(request.latency)
+        _REQUESTS.labels(state="completed").inc()
+        if request.ttft is not None:
+            self._ttft.observe(request.ttft)
+            _TTFT.observe(request.ttft)
+        if request.latency is not None:
+            self._latency.observe(request.latency)
+            _LATENCY.observe(request.latency)
 
     # ---------------------------------------------------------- reporting
-    @staticmethod
-    def _pct(samples, q):
-        if not samples:
-            return None
-        s = sorted(samples)
-        idx = min(len(s) - 1, int(round(q / 100.0 * (len(s) - 1))))
-        return s[idx]
-
     def snapshot(self):
-        """Point-in-time summary dict (the bench script serializes this)."""
+        """Point-in-time summary dict (the bench script serializes this).
+        Keys are byte-compatible with the raw-sample-list era; the
+        percentiles are now bucket-interpolated estimates."""
         with self._lock:
-            ttft = list(self._ttft)
-            lat = list(self._latency)
             active, total = self._active_slot_waves, self._total_slot_waves
             tokens = self._tokens
             span = (None if self._first_token_time is None
@@ -98,13 +136,13 @@ class ServingMetrics:
                     else self._last_token_time - self._first_token_time)
             queue_peak = self._queue_peak
         return {
-            "requests_completed": len(lat),
+            "requests_completed": self._latency.count(),
             "tokens_generated": tokens,
             "tokens_per_s": (tokens / span if span else None),
-            "ttft_p50_s": self._pct(ttft, 50),
-            "ttft_p99_s": self._pct(ttft, 99),
-            "latency_p50_s": self._pct(lat, 50),
-            "latency_p99_s": self._pct(lat, 99),
+            "ttft_p50_s": self._ttft.percentile(50),
+            "ttft_p99_s": self._ttft.percentile(99),
+            "latency_p50_s": self._latency.percentile(50),
+            "latency_p99_s": self._latency.percentile(99),
             "slot_occupancy": (active / total if total else 0.0),
             "queue_depth_peak": queue_peak,   # this instance, not the
         }                                     # process-wide monitor stat

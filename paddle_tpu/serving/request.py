@@ -4,9 +4,17 @@ A request moves QUEUED -> PREFILL -> DECODE -> DONE (or REJECTED at
 admission). Tokens stream to the caller through an optional per-request
 callback fired as each wave's tokens land on host; timestamps are taken
 at every transition so TTFT/latency metrics need no extra bookkeeping.
+
+Every request carries a `trace_id`; each lifecycle transition emits a
+chrome-trace async span + flow event through utils.telemetry (no-op
+unless the host profiler is recording), so an exported trace shows the
+request's QUEUED/PREFILL/DECODE spans alongside the decode-wave slices
+(docs/observability.md).
 """
 import threading
 import time
+
+from ..utils import telemetry
 
 
 class RequestState:
@@ -42,6 +50,7 @@ class Request:
             raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
         with Request._ids_lock:
             self.request_id = next(Request._ids)
+        self.trace_id = self.request_id   # correlates trace events
         self.prompt = prompt
         self.max_tokens = int(max_tokens)
         self.eos_token_id = None if eos_token_id is None else int(eos_token_id)
@@ -64,11 +73,13 @@ class Request:
     # ------------------------------------------------------------ lifecycle
     def _mark_submitted(self):
         self.submit_time = time.monotonic()
+        telemetry.trace_request(self, RequestState.QUEUED)
 
     def _start_prefill(self, slot):
         self.state = RequestState.PREFILL
         self.slot = slot
         self.prefill_time = time.monotonic()
+        telemetry.trace_request(self, RequestState.PREFILL)
 
     def _emit(self, token_id):
         """Record one generated token (first one comes from prefill)."""
@@ -76,6 +87,7 @@ class Request:
         if self.first_token_time is None:
             self.first_token_time = time.monotonic()
             self.state = RequestState.DECODE
+            telemetry.trace_request(self, RequestState.DECODE)
         self.output_tokens.append(token_id)
         if self.on_token is not None:
             try:
@@ -88,11 +100,13 @@ class Request:
         self.finish_reason = reason
         self.slot = None
         self.done_time = time.monotonic()
+        telemetry.trace_request(self, RequestState.DONE, reason=reason)
         self._done_event.set()
 
     def _reject(self, why):
         self.state = RequestState.REJECTED
         self.finish_reason = "rejected"
+        telemetry.trace_request(self, RequestState.REJECTED)
         self._done_event.set()
         raise ValueError(why)
 

@@ -17,6 +17,7 @@ import collections
 import threading
 import time
 
+from ..utils import profiler
 from ..utils.profiler import RecordEvent
 from .metrics import ServingMetrics
 from .request import Request, RequestState
@@ -139,6 +140,13 @@ class Scheduler:
                 self._slot_req[slot]._emit(tok)
                 self.metrics.on_token(now)
                 self._maybe_retire(slot, tok)
+        # chrome-trace counter track: occupancy/queue depth over time,
+        # on the same timeline as the decode-wave slices
+        if profiler.trace_enabled():
+            profiler.emit_trace_event({
+                "ph": "C", "name": "serving/slots", "cat": "serving",
+                "args": {"active": self.in_flight(),
+                         "queued": self.queue_depth()}})
         return self.in_flight() + self.queue_depth()
 
     def in_flight(self):

@@ -1,6 +1,7 @@
 """paddle_tpu.utils (ref python/paddle/utils)."""
 from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401
+from . import telemetry  # noqa: F401  (after monitor/profiler: it uses both)
 
 
 def try_import(name):

@@ -5,7 +5,12 @@ paddle/fluid/platform/monitor.h:77 StatRegistry, STAT_ADD :130): named int
 counters for memory/throughput bookkeeping, queryable from python the way
 the reference exposes them via pybind/global_value_getter_setter.cc.
 Device memory stats come from PJRT (`jax.local_devices()[0].memory_stats()`)
-instead of a custom allocator (ref memory/allocation)."""
+instead of a custom allocator (ref memory/allocation).
+
+These flat int stats are subsumed by `utils.telemetry`: every snapshot /
+Prometheus exposition of the typed metric registry includes them, so
+legacy `stat_add` call sites show up on /metrics without migration. New
+code should prefer telemetry's typed Counter/Gauge/Histogram."""
 import threading
 
 _lock = threading.Lock()

@@ -17,7 +17,43 @@ import time
 _lock = threading.Lock()
 _enabled = False
 _events = []          # (name, start_s, dur_s, thread_id)
+_raw_events = []      # chrome-format dicts (async spans, flow, counters)
+_trace_gen = 0        # bumped when _raw_events is cleared (new trace)
 _active_trace_dir = None
+
+
+def trace_generation():
+    """Monotone id of the current trace buffer. Emitters holding
+    open-span/flow state across traces (telemetry.trace_request) compare
+    it so a request straddling a profiler restart doesn't emit
+    span-ends/flow-finishes whose partners died with the old buffer."""
+    return _trace_gen
+
+
+def now_us():
+    """Microsecond timestamp on the SAME clock the host events use —
+    raw trace events must share it or spans drift off the timeline."""
+    return time.perf_counter() * 1e6
+
+
+def trace_enabled():
+    return _enabled
+
+
+def emit_trace_event(event):
+    """Append one raw chrome-trace event (async 'b'/'n'/'e', flow
+    's'/'t'/'f', counter 'C', instant 'i', ...) to the host trace.
+    Fills ts/pid/tid defaults; dropped (returns False) when the profiler
+    is not recording — callers can emit unconditionally."""
+    if not _enabled:
+        return False
+    ev = dict(event)
+    ev.setdefault("ts", now_us())
+    ev.setdefault("pid", 0)
+    ev.setdefault("tid", threading.get_ident() % 10000)
+    with _lock:
+        _raw_events.append(ev)
+    return True
 
 
 class RecordEvent:
@@ -50,9 +86,11 @@ class RecordEvent:
 def start_profiler(state="All", tracer_option="Default", trace_dir=None):
     """ref EnableProfiler (profiler.h:210). When `trace_dir` is given, also
     start a jax.profiler device trace (XPlane -> TensorBoard)."""
-    global _enabled, _active_trace_dir
+    global _enabled, _active_trace_dir, _trace_gen
     with _lock:
         _events.clear()
+        _raw_events.clear()
+        _trace_gen += 1
     _enabled = True
     if trace_dir is not None:
         import jax
@@ -104,13 +142,18 @@ def summary(sorted_key="total"):
 
 
 def export_chrome_tracing(path):
-    """Write host events as chrome://tracing json (tools/timeline.py)."""
+    """Write host events as chrome://tracing json (tools/timeline.py).
+    RecordEvent slices ('X') merge with the raw events other layers emit
+    through emit_trace_event (serving request spans/flows, counters) so
+    one trace shows host events, decode waves, and request lifecycles."""
     with _lock:
         evs = list(_events)
-    trace = {"traceEvents": [
+        raw = [dict(e) for e in _raw_events]
+    events = [
         {"name": name, "ph": "X", "ts": t0 * 1e6, "dur": dur * 1e6,
          "pid": 0, "tid": tid % 10000, "cat": "host"}
-        for name, t0, dur, tid in evs]}
+        for name, t0, dur, tid in evs]
+    trace = {"traceEvents": events + raw}
     with open(path, "w") as f:
         json.dump(trace, f)
     return path
@@ -142,16 +185,17 @@ class ProfilerTarget:
 
 def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
     """ref profiler.make_scheduler: step-state machine. Returns
-    fn(step) -> 'closed'|'ready'|'record' (repeat=0 means cycle forever)."""
+    fn(step) -> 'closed'|'ready'|'record' (repeat=0 means cycle forever;
+    a zero-length cycle — closed=ready=record=0 — never records)."""
     cycle = closed + ready + record
 
     def schedule(step):
-        if step < skip_first:
+        if step < skip_first or cycle == 0:
             return "closed"
         s = step - skip_first
         if repeat and s >= cycle * repeat:
             return "closed"
-        pos = s % cycle if cycle else 0
+        pos = s % cycle
         if pos < closed:
             return "closed"
         if pos < closed + ready:
@@ -200,13 +244,17 @@ class Profiler:
         if want_record and not self._recording:
             _enabled = True
             self._recording = True
-            if self.trace_dir and not self.timer_only and \
-                    ProfilerTarget.TPU in self.targets or \
-                    ProfilerTarget.GPU in self.targets:
-                if self.trace_dir and not self._device_active:
-                    import jax
-                    jax.profiler.start_trace(self.trace_dir)
-                    self._device_active = True
+            # `a and b and c or d` bug fixed here: the un-parenthesized
+            # form started a DEVICE trace whenever GPU was in targets,
+            # even with timer_only=True or no trace_dir
+            want_device = (self.trace_dir is not None
+                           and not self.timer_only
+                           and (ProfilerTarget.TPU in self.targets
+                                or ProfilerTarget.GPU in self.targets))
+            if want_device and not self._device_active:
+                import jax
+                jax.profiler.start_trace(self.trace_dir)
+                self._device_active = True
         elif not want_record and self._recording:
             self._flush()
 

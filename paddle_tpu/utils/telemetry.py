@@ -1,0 +1,852 @@
+"""Unified telemetry: typed metric registry, Prometheus/JSON exporters,
+request-trace emission, and XLA compile-event tracking.
+
+This is the observability layer the reference stack spreads over
+platform/monitor.h (StatRegistry), platform/profiler.h (RecordEvent) and
+tools/timeline.py, rebuilt as one subsystem:
+
+  * a typed metric REGISTRY — Counter / Gauge / Histogram with label
+    sets and exponential latency buckets — that subsumes the flat
+    `utils.monitor` int stats (they ride along in every snapshot and
+    exposition) and renders both a JSON snapshot and the Prometheus
+    text format;
+  * an optional stdlib-`http.server` background thread (`MetricsServer`)
+    exposing `/metrics` (Prometheus), `/metrics.json` (snapshot) and
+    `/healthz`;
+  * XLA compile-event tracking: a `jax.monitoring` duration-listener
+    counts backend compilations (persistent-cache loads included — a new
+    executable entered this process either way) attributed to the
+    function label on the `track_compiles` thread-local stack, so the
+    serving engine's compile-once invariant is a live metric.  On jax
+    builds without `jax.monitoring`, `instrument_jit` falls back to
+    counting `_cache_size()` growth around each call (the wrap-jit
+    fallback for old containers);
+  * `trace_request`: chrome-trace async spans + flow events for the
+    serving Request lifecycle (QUEUED → PREFILL → DECODE → DONE) emitted
+    into `utils.profiler`'s event sink, so one exported trace shows host
+    RecordEvents, decode waves, and per-request lifecycles together.
+
+Metric names and label conventions are cataloged in
+docs/observability.md; scripts/check_metric_names.py lints call sites
+against that catalog.
+"""
+import bisect
+import contextlib
+import http.server
+import io
+import json
+import re
+import threading
+import time
+
+from . import monitor, profiler
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _check_name(name):
+    if not isinstance(name, str) or not _NAME_RE.match(name):
+        raise ValueError(
+            f"metric name must be snake_case ([a-z][a-z0-9_]*), got {name!r}")
+    return name
+
+
+def exponential_buckets(start=0.001, factor=2.0, count=16):
+    """Exponential bucket upper bounds: start, start*factor, ... — the
+    default (1ms..~32.8s) covers TTFT/step-time latencies without keeping
+    raw samples."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise ValueError("need start > 0, factor > 1, count >= 1")
+    return tuple(start * factor ** i for i in range(count))
+
+
+DEFAULT_LATENCY_BUCKETS = exponential_buckets()
+
+
+# ---------------------------------------------------------------------------
+# metric types
+# ---------------------------------------------------------------------------
+
+class _CounterChild:
+    __slots__ = ("_lock", "_v")
+
+    def __init__(self, lock):
+        self._lock = lock
+        self._v = 0.0
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError(f"counters only go up, got {amount}")
+        with self._lock:
+            self._v += amount
+
+    def value(self):
+        with self._lock:
+            return self._v
+
+    def _reset(self):
+        self._v = 0.0           # caller holds the lock
+
+
+class _GaugeChild:
+    __slots__ = ("_lock", "_v")
+
+    def __init__(self, lock):
+        self._lock = lock
+        self._v = 0.0
+
+    def set(self, value):
+        with self._lock:
+            self._v = float(value)
+
+    def inc(self, amount=1.0):
+        with self._lock:
+            self._v += amount
+
+    def dec(self, amount=1.0):
+        self.inc(-amount)
+
+    def set_max(self, value):
+        """Atomic running max — the peak-gauge idiom monitor.stat_max has."""
+        with self._lock:
+            self._v = max(self._v, float(value))
+
+    def value(self):
+        with self._lock:
+            return self._v
+
+    def _reset(self):
+        self._v = 0.0
+
+
+class _HistogramChild:
+    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count",
+                 "_min", "_max")
+
+    def __init__(self, lock, bounds):
+        self._lock = lock
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)     # +Inf overflow last
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+
+    def observe(self, value):
+        v = float(value)
+        if v != v or v in (float("inf"), float("-inf")):
+            return     # a non-finite sample would poison sum/min/max and
+                       # every percentile forever; drop it at the door
+        idx = bisect.bisect_left(self._bounds, v)  # le: v == bound stays in
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+            self._min = v if self._min is None else min(self._min, v)
+            self._max = v if self._max is None else max(self._max, v)
+
+    def count(self):
+        with self._lock:
+            return self._count
+
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    def bucket_counts(self):
+        """[(upper_bound, cumulative_count), ..., (None, total)] — the
+        Prometheus cumulative view; None stands for +Inf."""
+        with self._lock:
+            counts = list(self._counts)
+        out, cum = [], 0
+        for ub, c in zip(self._bounds, counts):
+            cum += c
+            out.append((ub, cum))
+        out.append((None, cum + counts[-1]))
+        return out
+
+    def percentile(self, q):
+        """Estimate the q-th percentile from the buckets (linear
+        interpolation within the bucket, clamped to the observed
+        [min, max]); None when empty. The whole point of the rebase from
+        raw sample lists: O(buckets) memory at any request count."""
+        with self._lock:
+            counts = list(self._counts)
+            total, mn, mx = self._count, self._min, self._max
+        if not total:
+            return None
+        target = (q / 100.0) * total
+        cum, lower = 0.0, None
+        for i, ub in enumerate(list(self._bounds) + [None]):
+            c = counts[i]
+            if c and cum + c >= target:
+                lo = mn if lower is None else max(lower, mn)
+                hi = mx if ub is None else min(ub, mx)
+                if hi < lo:
+                    hi = lo
+                frac = (target - cum) / c
+                return min(max(lo + frac * (hi - lo), mn), mx)
+            cum += c
+            lower = ub
+        return mx
+
+    def _reset(self):
+        self._counts = [0] * (len(self._bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+
+
+class _Metric:
+    kind = "untyped"
+    _child_args = ()
+
+    def __init__(self, name, help="", labelnames=()):
+        _check_name(name)
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(_check_name(n) for n in labelnames)
+        self._lock = threading.Lock()
+        self._children = {}
+
+    def _normalize(self, values, kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by "
+                                 "name, not both")
+            extra = set(kv) - set(self.labelnames)
+            if extra:
+                raise ValueError(f"{self.name}: unexpected labels {extra}")
+            values = tuple(str(kv[n]) for n in self.labelnames)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(f"{self.name} takes labels {self.labelnames}, "
+                             f"got {values!r}")
+        return values
+
+    def labels(self, *values, **kv):
+        """Bind label values -> child handle (created on first use)."""
+        values = self._normalize(values, kv)
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._new_child()
+                self._children[values] = child
+        return child
+
+    def peek(self, *values, **kv):
+        """Non-creating lookup: the child for these label values, or
+        None if that series has never been recorded. Read paths use this
+        so a dashboard probe cannot mint permanent zero-valued series."""
+        values = self._normalize(values, kv)
+        with self._lock:
+            return self._children.get(values)
+
+    def _new_child(self):
+        raise NotImplementedError
+
+    def _default(self):
+        if self.labelnames:
+            raise ValueError(f"{self.name} has labels {self.labelnames}; "
+                             "use .labels(...)")
+        return self.labels()
+
+    def _series(self):
+        with self._lock:
+            return sorted(self._children.items())
+
+    def _reset(self):
+        with self._lock:
+            for child in self._children.values():
+                child._reset()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild(self._lock)
+
+    def inc(self, amount=1.0):
+        self._default().inc(amount)
+
+    def value(self):
+        return self._default().value()
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild(self._lock)
+
+    def set(self, value):
+        self._default().set(value)
+
+    def inc(self, amount=1.0):
+        self._default().inc(amount)
+
+    def dec(self, amount=1.0):
+        self._default().dec(amount)
+
+    def set_max(self, value):
+        self._default().set_max(value)
+
+    def value(self):
+        return self._default().value()
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets=DEFAULT_LATENCY_BUCKETS):
+        super().__init__(name, help, labelnames)
+        bounds = tuple(float(b) for b in buckets)
+        if not bounds or list(bounds) != sorted(set(bounds)):
+            raise ValueError(f"buckets must be distinct and increasing, "
+                             f"got {buckets!r}")
+        self.buckets = bounds
+
+    def _new_child(self):
+        return _HistogramChild(self._lock, self.buckets)
+
+    def observe(self, value):
+        self._default().observe(value)
+
+    def count(self):
+        return self._default().count()
+
+    def sum(self):
+        return self._default().sum()
+
+    def percentile(self, q):
+        return self._default().percentile(q)
+
+    def bucket_counts(self):
+        return self._default().bucket_counts()
+
+
+# ---------------------------------------------------------------------------
+# registry + exporters
+# ---------------------------------------------------------------------------
+
+def _fmt(v):
+    # non-finite values are legal Prometheus samples (a diverged
+    # train_loss gauge is NaN) — render them instead of crashing /metrics
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.10g}"
+
+
+def _json_safe(v):
+    """JSON has no NaN/Inf literal (json.dumps would emit invalid JSON);
+    snapshot consumers get the string spelling instead."""
+    if v != v or v in (float("inf"), float("-inf")):
+        return _fmt(v)
+    return v
+
+
+def _esc_label(v):
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _sample_line(name, labelnames, values, value, suffix="", extra=()):
+    pairs = [f'{n}="{_esc_label(v)}"' for n, v in zip(labelnames, values)]
+    pairs += [f'{n}="{_esc_label(v)}"' for n, v in extra]
+    lbl = "{" + ",".join(pairs) + "}" if pairs else ""
+    return f"{name}{suffix}{lbl} {_fmt(value)}"
+
+
+class Registry:
+    """Named metric registry. `counter`/`gauge`/`histogram` get-or-create
+    (re-registration with the same kind+labels returns the existing
+    metric — modules can declare their metrics at import time without
+    ordering hazards); mismatched re-registration raises."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            cur = self._metrics.get(name)
+            if cur is not None:
+                if (type(cur) is not cls
+                        or cur.labelnames != tuple(labelnames)):
+                    raise ValueError(
+                        f"metric {name!r} already registered as {cur.kind} "
+                        f"with labels {cur.labelnames}")
+                want = kw.get("buckets")
+                if want is not None and \
+                        cur.buckets != tuple(float(b) for b in want):
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {cur.buckets}, requested {tuple(want)}")
+                return cur
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()):
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()):
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=DEFAULT_LATENCY_BUCKETS):
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def unregister(self, name):
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def reset(self):
+        """Zero every series IN PLACE — registrations and any child
+        handles modules cached stay live (tests isolate runs with this)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m._reset()
+
+    # ------------------------------------------------------------- exporters
+    def snapshot(self, include_monitor=True):
+        """JSON-able point-in-time dump of every metric (and, by default,
+        the flat utils.monitor stats alongside)."""
+        out = {"time_unix": time.time(), "metrics": {}}
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        for name, m in metrics:
+            series = []
+            for values, child in m._series():
+                entry = {"labels": dict(zip(m.labelnames, values))}
+                if m.kind == "histogram":
+                    entry.update(
+                        count=child.count(), sum=_json_safe(child.sum()),
+                        buckets=[[ub, c]
+                                 for ub, c in child.bucket_counts()])
+                    p50 = child.percentile(50)
+                    if p50 is not None:
+                        entry["p50"] = p50
+                        entry["p99"] = child.percentile(99)
+                else:
+                    entry["value"] = _json_safe(child.value())
+                series.append(entry)
+            out["metrics"][name] = {"kind": m.kind, "help": m.help,
+                                    "labelnames": list(m.labelnames),
+                                    "series": series}
+        if include_monitor:
+            out["monitor"] = monitor.all_stats()
+        return out
+
+    def render_prometheus(self, include_monitor=True):
+        """Prometheus text exposition (format 0.0.4). Histograms render
+        cumulative `_bucket{le=...}` + `_sum` + `_count`; the flat
+        monitor stats ride along as untyped samples (names sanitized,
+        typed metrics win collisions)."""
+        lines = []
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        for name, m in metrics:
+            if m.help:
+                lines.append(f"# HELP {name} "
+                             + m.help.replace("\\", "\\\\")
+                                     .replace("\n", "\\n"))
+            lines.append(f"# TYPE {name} {m.kind}")
+            for values, child in m._series():
+                if m.kind == "histogram":
+                    for ub, cum in child.bucket_counts():
+                        le = "+Inf" if ub is None else _fmt(ub)
+                        lines.append(_sample_line(
+                            name, m.labelnames, values, cum,
+                            suffix="_bucket", extra=(("le", le),)))
+                    lines.append(_sample_line(name, m.labelnames, values,
+                                              child.sum(), suffix="_sum"))
+                    lines.append(_sample_line(name, m.labelnames, values,
+                                              child.count(),
+                                              suffix="_count"))
+                else:
+                    lines.append(_sample_line(name, m.labelnames, values,
+                                              child.value()))
+        if include_monitor:
+            taken = {n for n, _ in metrics}
+            for key, v in sorted(monitor.all_stats().items()):
+                name = re.sub(r"[^a-z0-9_]", "_", str(key).lower())
+                if not _NAME_RE.match(name) or name in taken:
+                    continue
+                taken.add(name)
+                lines.append(f"# TYPE {name} untyped")
+                lines.append(f"{name} {_fmt(float(v))}")
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+
+def counter(name, help="", labelnames=()):
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=()):
+    return REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=DEFAULT_LATENCY_BUCKETS):
+    return REGISTRY.histogram(name, help, labelnames, buckets=buckets)
+
+
+def snapshot(include_monitor=True):
+    return REGISTRY.snapshot(include_monitor)
+
+
+def render_prometheus(include_monitor=True):
+    return REGISTRY.render_prometheus(include_monitor)
+
+
+def value(name, labels=None, default=None):
+    """Read one sample from the default registry: counter/gauge value, or
+    histogram observation count. `default` when the metric or the label
+    series is missing — reading never creates a series."""
+    m = REGISTRY.get(name)
+    if m is None:
+        return default
+    child = m.peek(**(labels or {}))
+    if child is None:
+        return default
+    return child.count() if m.kind == "histogram" else child.value()
+
+
+# ---------------------------------------------------------------------------
+# XLA compile-event tracking
+# ---------------------------------------------------------------------------
+
+XLA_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+XLA_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+_XLA_COMPILES = counter(
+    "xla_compiles_total",
+    "XLA backend compilations per attributed function (persistent-cache "
+    "loads count too: a new executable entered the process either way)",
+    labelnames=("function",))
+_XLA_COMPILE_SECONDS = histogram(
+    "xla_compile_seconds", "XLA backend compile/cache-load durations",
+    buckets=exponential_buckets(0.01, 2.0, 12))
+_XLA_CACHE_HITS = counter(
+    "xla_persistent_cache_hits_total",
+    "Compiled executables loaded from the persistent compilation cache")
+
+_tl = threading.local()
+_install_lock = threading.Lock()
+_install_state = {"installed": None}
+
+
+def _compile_label(metadata_name=None):
+    stack = getattr(_tl, "stack", None)
+    if stack:
+        return stack[-1]
+    return metadata_name or "unattributed"
+
+
+def _on_compile_duration(event, duration, **kw):
+    if event != XLA_BACKEND_COMPILE_EVENT:
+        return
+    label = _compile_label(kw.get("fun_name"))
+    _XLA_COMPILES.labels(label).inc()
+    _XLA_COMPILE_SECONDS.observe(duration)
+
+
+def _on_event(event, **kw):
+    if event == XLA_CACHE_HIT_EVENT:
+        _XLA_CACHE_HITS.inc()
+
+
+def install_compile_tracking():
+    """Register the jax.monitoring listeners (idempotent). Returns True
+    when live; False on jax builds without jax.monitoring — callers fall
+    back to _cache_size() deltas (instrument_jit does automatically)."""
+    with _install_lock:
+        if _install_state["installed"] is None:
+            try:
+                import jax.monitoring as jmon
+                jmon.register_event_duration_secs_listener(
+                    _on_compile_duration)
+                jmon.register_event_listener(_on_event)
+                _install_state["installed"] = True
+            except Exception:        # pragma: no cover - old jax fallback
+                _install_state["installed"] = False
+        return _install_state["installed"]
+
+
+@contextlib.contextmanager
+def track_compiles(label):
+    """Attribute every XLA compile event fired inside the block (from
+    this thread) to `label` in xla_compiles_total{function=label}."""
+    _check_name(label)
+    install_compile_tracking()
+    stack = getattr(_tl, "stack", None)
+    if stack is None:
+        stack = _tl.stack = []
+    stack.append(label)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+class _InstrumentedJit:
+    """Proxy over a jitted callable: calls run under
+    track_compiles(label); without jax.monitoring it counts
+    `_cache_size()` growth instead (the wrap-jit fallback). Attribute
+    access (lower, _cache_size, ...) passes through."""
+
+    def __init__(self, fn, label):
+        _check_name(label)
+        self._fn = fn
+        self.label = label
+        self._monitoring = install_compile_tracking()
+
+    def __call__(self, *args, **kw):
+        if self._monitoring:
+            with track_compiles(self.label):
+                return self._fn(*args, **kw)
+        before = self._safe_cache_size()
+        out = self._fn(*args, **kw)
+        grew = self._safe_cache_size() - before
+        if grew > 0:
+            _XLA_COMPILES.labels(self.label).inc(grew)
+        return out
+
+    def _safe_cache_size(self):
+        try:
+            return self._fn._cache_size()
+        except Exception:
+            return 0
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+    def __repr__(self):
+        return f"instrument_jit({self._fn!r}, label={self.label!r})"
+
+
+def instrument_jit(fn, label):
+    """Wrap a jax.jit callable so its compilations show up as
+    xla_compiles_total{function=label} (the serving engine labels its
+    decode wave / prefill programs this way)."""
+    return _InstrumentedJit(fn, label)
+
+
+def compile_count(function):
+    """Live compile count for an attributed function label."""
+    return int(value("xla_compiles_total", {"function": function}, 0) or 0)
+
+
+# ---------------------------------------------------------------------------
+# request-correlated tracing (chrome async spans + flow events)
+# ---------------------------------------------------------------------------
+
+_SPAN_STATES = ("QUEUED", "PREFILL", "DECODE")
+
+
+def trace_request(request, state, reason=None):
+    """Emit the chrome-trace events for one Request lifecycle transition:
+    close the previous async span, open the new one (QUEUED/PREFILL/
+    DECODE), and add a flow event (`s` at QUEUED, `t` in between, `f` at
+    DONE/REJECTED) binding the request's arrow across the timeline. All
+    events share id=trace_id and cat "serving.request"; no-op unless the
+    host profiler is recording."""
+    if not profiler.trace_enabled():
+        return
+    gen = profiler.trace_generation()
+    if getattr(request, "_trace_gen", None) != gen:
+        # first emission into a NEW trace buffer: any open span / flow
+        # start this request remembers died with the old buffer — reset
+        # so we never emit an 'e'/'t'/'f' whose partner is gone
+        request._trace_span = None
+        request._trace_started = False
+        request._trace_gen = gen
+    rid = int(getattr(request, "trace_id", 0)
+              or getattr(request, "request_id", 0))
+    base = {"cat": "serving.request", "id": rid, "pid": 0,
+            "tid": threading.get_ident() % 10000, "ts": profiler.now_us()}
+    open_span = getattr(request, "_trace_span", None)
+    if open_span is not None and open_span != state:
+        profiler.emit_trace_event({**base, "ph": "e", "name": open_span})
+    if state in _SPAN_STATES:
+        profiler.emit_trace_event({**base, "ph": "b", "name": state})
+        request._trace_span = state
+    else:
+        request._trace_span = None
+    ph = "s" if state == "QUEUED" else (
+        "f" if state in ("DONE", "REJECTED") else "t")
+    if ph != "s" and not getattr(request, "_trace_started", False):
+        return    # e.g. rejected before admission: no dangling flow-finish
+    request._trace_started = ph != "f"
+    flow = {**base, "ph": ph, "name": "request",
+            "args": {"state": state, "request_id": rid}}
+    if ph == "f":
+        flow["bp"] = "e"
+    if reason:
+        flow["args"]["finish_reason"] = reason
+    profiler.emit_trace_event(flow)
+
+
+# ---------------------------------------------------------------------------
+# /metrics exporter (stdlib http.server, background thread)
+# ---------------------------------------------------------------------------
+
+def make_metrics_handler(registry=None, health_fn=None):
+    reg = registry or REGISTRY
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        server_version = "paddle-tpu-telemetry/1.0"
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                body = reg.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                code = 200
+            elif path == "/metrics.json":
+                body = json.dumps(reg.snapshot()).encode()
+                ctype = "application/json"
+                code = 200
+            elif path == "/healthz":
+                payload = {"status": "ok", "time_unix": time.time()}
+                if health_fn is not None:
+                    try:
+                        payload.update(health_fn() or {})
+                    except Exception as e:   # noqa: BLE001 - report, not die
+                        payload["status"] = "degraded"
+                        payload["error"] = repr(e)
+                body = json.dumps(payload).encode()
+                ctype = "application/json"
+                code = 200
+            else:
+                body = b"not found; try /metrics /metrics.json /healthz\n"
+                ctype = "text/plain"
+                code = 404
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):      # keep the serving loop's stdout
+            pass
+
+    return Handler
+
+
+def http_get_inline(path="/metrics", registry=None, health_fn=None):
+    """Drive the metrics handler fully in-process (no socket): returns
+    (status_code, headers_dict, body_bytes). Tests exercise the exporter
+    exactly as an HTTP client would, without binding a port."""
+
+    class _FakeSocket:
+        """socketserver writes either via makefile('wb') or, for the
+        unbuffered default, via sendall() — capture both into one
+        buffer that survives close()."""
+
+        def __init__(self):
+            self._rd = io.BytesIO(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            self.out = bytearray()
+            outer = self
+
+            class _Wr(io.RawIOBase):
+                def writable(self):
+                    return True
+
+                def write(self, data):
+                    outer.out += bytes(data)
+                    return len(data)
+
+            self._wr = io.BufferedWriter(_Wr())
+
+        def makefile(self, mode, *a, **kw):
+            return self._rd if "r" in mode else self._wr
+
+        def sendall(self, data):
+            self.out += bytes(data)
+
+    sock = _FakeSocket()
+    make_metrics_handler(registry, health_fn)(sock, ("127.0.0.1", 0), None)
+    raw = bytes(sock.out)
+    head, _, body = raw.partition(b"\r\n\r\n")
+    head_lines = head.decode("latin-1").split("\r\n")
+    status = int(head_lines[0].split()[1])
+    headers = {}
+    for ln in head_lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers, body
+
+
+class MetricsServer:
+    """Background /metrics exporter over stdlib http.server.
+
+        srv = MetricsServer(port=9100).start()   # port=0 picks a free one
+        ... srv.url, srv.port ...
+        srv.stop()
+
+    health_fn (optional) returns extra key/values merged into the
+    /healthz payload (the serving engine reports slot state there)."""
+
+    def __init__(self, registry=None, host="127.0.0.1", port=0,
+                 health_fn=None):
+        self.registry = registry or REGISTRY
+        self.host = host
+        self.port = int(port)
+        self.health_fn = health_fn
+        self._httpd = None
+        self._thread = None
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        handler = make_metrics_handler(self.registry, self.health_fn)
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pt-metrics-exporter",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -32,6 +32,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import paddle_tpu as pt
 from paddle_tpu.serving import ServingEngine, Scheduler
+from paddle_tpu.utils import profiler, telemetry
 
 t0 = time.time()
 
@@ -115,6 +116,13 @@ def main():
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--out", default=os.path.join(_REPO,
                                                   "BENCH_serving.json"))
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics (Prometheus) + /healthz on this "
+                         "port during the sweep (0 picks a free port)")
+    ap.add_argument("--trace-out", default=None,
+                    help="record the sweep and write a chrome trace here "
+                         "(request lifecycle spans + decode waves; view "
+                         "in chrome://tracing / ui.perfetto.dev)")
     args = ap.parse_args()
 
     model, _cfg = build_model(args.family, args.hidden, args.layers,
@@ -124,11 +132,19 @@ def main():
                            max_len=args.max_len,
                            prefill_len=args.prefill_len)
 
+    if args.metrics_port is not None:
+        srv = engine.start_metrics_server(port=args.metrics_port)
+        log(f"metrics exporter live at {srv.url}/metrics "
+            f"(and /healthz, /metrics.json)")
+
     # warm the two programs so every load point measures execution only
     sched = Scheduler(engine)
     sched.generate([1, 2, 3], max_tokens=4)
     log(f"warmup done (decode compiles={engine.decode_compiles}, "
         f"prefill compiles={engine.prefill_compiles})")
+
+    if args.trace_out:
+        profiler.start_profiler()     # record AFTER warmup: steady state
 
     rows = []
     for i, load in enumerate(float(x) for x in args.loads.split(",")):
@@ -160,9 +176,15 @@ def main():
         rows.append(row)
         print(json.dumps(row), flush=True)
 
+    if args.trace_out:
+        profiler.stop_profiler(profile_path=args.trace_out)
+        log(f"wrote chrome trace {args.trace_out}")
+
     with open(args.out, "w") as f:
-        json.dump({"cmd": " ".join(sys.argv), "rows": rows}, f, indent=1)
+        json.dump({"cmd": " ".join(sys.argv), "rows": rows,
+                   "telemetry": telemetry.snapshot()}, f, indent=1)
     log(f"wrote {args.out}")
+    engine.stop_metrics_server()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,47 @@
+"""scripts/check_metric_names.py: the repo's metric-name lint (tier-1).
+
+The repo itself must lint clean — every literal metric name at a
+stat_add/stat_set/stat_max/counter/gauge/histogram call site is
+snake_case and cataloged in docs/observability.md — and the lint must
+actually catch the two violation classes.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_metric_names.py")
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True, cwd=REPO)
+
+
+def test_repo_lints_clean():
+    res = _run()
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_list_mode_reports_known_metrics():
+    res = _run("--list")
+    assert res.returncode == 0
+    assert "serving_requests_total" in res.stdout
+    assert "xla_compiles_total" in res.stdout
+
+
+def test_catches_non_snake_case_and_unregistered(tmp_path):
+    bad = tmp_path / "bad_metrics.py"
+    bad.write_text(
+        "from paddle_tpu.utils import monitor, telemetry\n"
+        'BAD_CONST = "Not-Snake"\n'
+        "monitor.stat_add(BAD_CONST)\n"             # via resolved constant
+        'telemetry.counter("totally_undocumented_metric_total")\n'
+        'telemetry.gauge("serving_queue_depth")\n'  # documented: clean
+    )
+    res = _run(str(bad))
+    assert res.returncode == 1
+    assert "Not-Snake" in res.stdout and "snake_case" in res.stdout
+    assert "totally_undocumented_metric_total" in res.stdout
+    assert "not registered" in res.stdout
+    assert res.stdout.count(str(bad.name)) == 2     # the clean line passes

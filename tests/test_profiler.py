@@ -45,6 +45,118 @@ def test_device_trace_writes_xplane(tmp_path):
     assert any(os.path.isfile(f) for f in dumped), dumped
 
 
+def test_timer_only_never_starts_device_trace(monkeypatch, tmp_path):
+    """Regression for the `a and b and c or d` precedence bug in
+    Profiler._apply_state: with GPU (or TPU) in targets the un-
+    parenthesized condition started a DEVICE trace even when
+    timer_only=True (and even with trace_dir=None)."""
+    import jax
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop", None)))
+
+    p = prof.Profiler(targets=[prof.ProfilerTarget.CPU,
+                               prof.ProfilerTarget.GPU],
+                      trace_dir=str(tmp_path / "t1"), timer_only=True)
+    p.start()
+    p.step()
+    p.stop()
+    assert calls == []                       # timer_only wins
+
+    p = prof.Profiler(targets=[prof.ProfilerTarget.GPU])  # no trace_dir
+    p.start()
+    p.stop()
+    assert calls == []
+
+    p = prof.Profiler(targets=[prof.ProfilerTarget.CPU],  # CPU-only
+                      trace_dir=str(tmp_path / "t2"))
+    p.start()
+    p.stop()
+    assert calls == []
+
+    d3 = str(tmp_path / "t3")                # the engaged case still works
+    p = prof.Profiler(targets=[prof.ProfilerTarget.TPU], trace_dir=d3)
+    p.start()
+    p.stop()
+    assert calls == [("start", d3), ("stop", None)]
+
+
+def test_make_scheduler_skip_first():
+    s = prof.make_scheduler(closed=1, ready=1, record=2, skip_first=3)
+    assert [s(i) for i in range(3)] == ["closed"] * 3
+    assert [s(i) for i in range(3, 7)] == \
+        ["closed", "ready", "record", "record"]
+    assert s(7) == "closed"                  # cycle restarts after skip
+
+
+def test_make_scheduler_repeat_expiry():
+    """repeat=N records N cycles then stays closed FOREVER (not cycling
+    back), counted from after skip_first."""
+    s = prof.make_scheduler(closed=0, ready=1, record=1, repeat=2,
+                            skip_first=1)
+    assert [s(i) for i in range(1, 5)] == ["ready", "record"] * 2
+    assert [s(i) for i in range(5, 12)] == ["closed"] * 7
+    assert s(0) == "closed"                  # skip_first region
+
+
+def test_make_scheduler_zero_length_cycle():
+    """closed=ready=record=0: a zero-length cycle never records (the
+    pre-fix code returned 'record' forever — a profiler you asked to do
+    nothing recorded everything)."""
+    s = prof.make_scheduler(closed=0, ready=0, record=0)
+    assert [s(i) for i in range(5)] == ["closed"] * 5
+    s = prof.make_scheduler(closed=0, ready=0, record=0, repeat=3,
+                            skip_first=2)
+    assert [s(i) for i in range(6)] == ["closed"] * 6
+
+
+def test_chrome_trace_schema_and_flow_ids(tmp_path):
+    """Exported chrome traces must be schema-clean: numeric ts (and
+    dur on 'X' slices), known phases, and every flow step/finish ('t'/
+    'f') referencing an id some flow start ('s') opened."""
+    prof.start_profiler()
+    with prof.RecordEvent("slice"):
+        pass
+    base = {"cat": "flowtest", "name": "request", "id": 9}
+    prof.emit_trace_event({**base, "ph": "s", "args": {"state": "QUEUED"}})
+    prof.emit_trace_event({**base, "ph": "t", "args": {"state": "DECODE"}})
+    prof.emit_trace_event({**base, "ph": "f", "bp": "e",
+                           "args": {"state": "DONE"}})
+    prof.emit_trace_event({"ph": "b", "cat": "flowtest", "name": "SPAN",
+                           "id": 9})
+    prof.emit_trace_event({"ph": "e", "cat": "flowtest", "name": "SPAN",
+                           "id": 9})
+    prof.emit_trace_event({"ph": "C", "cat": "flowtest", "name": "depth",
+                           "args": {"queued": 3}})
+    path = str(tmp_path / "trace.json")
+    prof.stop_profiler(profile_path=path)
+    trace = json.load(open(path))
+    events = trace["traceEvents"]
+    assert len(events) >= 7
+    flow_starts, flow_refs = set(), []
+    for e in events:
+        assert e["ph"] in set("XBEbneistfC"), e
+        assert isinstance(e["name"], str) and e["name"]
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        if e["ph"] in "bnestf":
+            assert "id" in e or e["ph"] in "ns", e
+        if e["ph"] == "s":
+            flow_starts.add((e["cat"], e["name"], e["id"]))
+        elif e["ph"] in "tf":
+            flow_refs.append((e["cat"], e["name"], e["id"]))
+    assert flow_refs and all(r in flow_starts for r in flow_refs)
+
+
+def test_emit_trace_event_dropped_when_disabled():
+    assert not prof.trace_enabled()
+    assert prof.emit_trace_event({"ph": "i", "name": "nope"}) is False
+
+
 def test_new_style_profiler_scheduler(tmp_path):
     sched = prof.make_scheduler(closed=1, ready=0, record=2, repeat=1)
     assert [sched(i) for i in range(4)] == \

@@ -1,0 +1,152 @@
+"""Serving x telemetry acceptance (ISSUE 3): a 12-request, 3-wave run
+exports ONE chrome trace with per-request flow events for all four
+lifecycle states; the compile-event metric reads exactly 1 for the
+batched decode function; and the Prometheus exposition (exercised
+in-process against the /metrics handler) shows the serving counters and
+a TTFT histogram whose buckets sum to the request count.
+
+Reuses the EXACT engine shape of tests/test_serving.py (2-layer /
+hidden-64 llama, 4 slots) so warm runs hit the persistent compile
+cache. The registry is reset (values only — registrations survive) at
+the start of the big test so counts are exact, not >=.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import Scheduler, ServingEngine
+from paddle_tpu.utils import profiler as prof
+from paddle_tpu.utils import telemetry
+
+VOCAB = 128
+LIFECYCLE = {"QUEUED", "PREFILL", "DECODE", "DONE"}
+
+
+@pytest.fixture(scope="module")
+def engine():
+    pt.seed(7)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=64)
+    model = LlamaForCausalLM(cfg)
+    return ServingEngine(model, num_slots=4, max_len=64, prefill_len=16)
+
+
+def test_three_wave_run_trace_compiles_and_prometheus(engine, tmp_path):
+    telemetry.REGISTRY.reset()
+    prof.start_profiler()
+    sched = Scheduler(engine)
+    rng = np.random.RandomState(3)
+    reqs = [sched.submit(
+        prompt=rng.randint(0, VOCAB, (int(rng.randint(2, 12)),)).tolist(),
+        max_tokens=int(rng.randint(2, 6))) for _ in range(12)]
+    sched.run()
+    assert all(r.done for r in reqs)
+
+    # ---- one chrome trace, per-request flows for all four states
+    path = str(tmp_path / "serving_trace.json")
+    prof.stop_profiler(profile_path=path)
+    events = json.load(open(path))["traceEvents"]
+    flows = [e for e in events if e.get("cat") == "serving.request"
+             and e["ph"] in "stf"]
+    states = {}
+    for e in flows:
+        assert e["id"] == e["args"]["request_id"]     # valid id binding
+        states.setdefault(e["args"]["request_id"], set()).add(
+            e["args"]["state"])
+    assert set(states) == {r.trace_id for r in reqs}
+    for rid, seen in states.items():
+        assert seen == LIFECYCLE, (rid, seen)
+    # every flow step/finish references an id a flow start opened
+    started = {e["id"] for e in flows if e["ph"] == "s"}
+    assert all(e["id"] in started for e in flows if e["ph"] in "tf")
+    # request spans and decode-wave slices share the timeline
+    assert any(e["ph"] == "b" and e["name"] == "DECODE" for e in events)
+    assert any(e.get("ph") == "X" and e["name"] == "serving/decode_wave"
+               for e in events)
+    assert any(e.get("ph") == "C" and e["name"] == "serving/slots"
+               for e in events)
+
+    # ---- compile-once as a live metric: exactly 1 for the decode wave
+    assert telemetry.compile_count("serving_decode_wave") == 1
+    assert telemetry.compile_count("serving_prefill") == 1
+    assert engine.decode_compiles == 1            # agrees with _cache_size
+
+    # ---- Prometheus exposition through the in-process /metrics handler
+    status, headers, body = telemetry.http_get_inline("/metrics")
+    assert status == 200
+    assert headers["content-type"].startswith("text/plain")
+    text = body.decode()
+    assert 'serving_requests_total{state="submitted"} 12' in text
+    assert 'serving_requests_total{state="completed"} 12' in text
+    assert "serving_prefills_total 12" in text
+    assert 'xla_compiles_total{function="serving_decode_wave"} 1' in text
+    # TTFT histogram: buckets (cumulative, so +Inf) sum to request count
+    assert 'serving_ttft_seconds_bucket{le="+Inf"} 12' in text
+    assert "serving_ttft_seconds_count 12" in text
+    tokens = sum(len(r.output_tokens) for r in reqs)
+    assert f"serving_tokens_generated_total {tokens}" in text
+
+
+def test_snapshot_keys_byte_compatible(engine):
+    """ServingMetrics.snapshot() keeps the PR-1 key set exactly (the
+    bench script serializes it) now that percentiles come from bounded
+    histograms instead of raw sample lists."""
+    sched = Scheduler(engine)
+    req = sched.submit(prompt=[1, 2, 3], max_tokens=3)
+    sched.run()
+    assert req.done
+    snap = sched.metrics.snapshot()
+    assert list(snap) == [
+        "requests_completed", "tokens_generated", "tokens_per_s",
+        "ttft_p50_s", "ttft_p99_s", "latency_p50_s", "latency_p99_s",
+        "slot_occupancy", "queue_depth_peak"]
+    assert snap["requests_completed"] == 1
+    assert snap["ttft_p50_s"] is not None
+    assert snap["ttft_p50_s"] <= snap["latency_p50_s"]
+    assert json.dumps(snap)                       # still serializable
+
+
+def test_engine_metrics_server_and_healthz(engine):
+    """ServingEngine exposes the exporter directly; /healthz reports
+    slot/compile state."""
+    srv = engine.start_metrics_server(port=0)
+    try:
+        assert engine.start_metrics_server() is srv       # idempotent
+        assert engine.start_metrics_server(port=srv.port) is srv
+        with pytest.raises(RuntimeError, match="already running"):
+            engine.start_metrics_server(port=srv.port + 1)   # no silent
+        with pytest.raises(RuntimeError, match="already running"):      #
+            engine.start_metrics_server(host="0.0.0.0")      # rebinding
+        status, _, body = telemetry.http_get_inline(
+            "/healthz", health_fn=engine._health)
+        payload = json.loads(body)
+        assert status == 200 and payload["status"] == "ok"
+        assert payload["num_slots"] == 4
+        assert payload["decode_compiles"] == 1
+        import urllib.request
+        data = urllib.request.urlopen(srv.url + "/healthz",
+                                      timeout=10).read()
+        assert json.loads(data)["num_slots"] == 4
+    finally:
+        engine.stop_metrics_server()
+    assert engine._metrics_server is None
+
+
+def test_config_front_door_starts_exporter(engine):
+    """inference.Config.enable_metrics_exporter reaches the engine via
+    create_llm_predictor; close() tears the server down."""
+    from paddle_tpu import inference
+    cfg = inference.Config()
+    cfg.enable_llm_engine(num_slots=2, max_len=32, prefill_len=8)
+    cfg.enable_metrics_exporter(port=0)
+    assert cfg.metrics_exporter_enabled()
+    pred = inference.create_llm_predictor(cfg, model=engine.model)
+    try:
+        assert pred.metrics_server is not None
+        assert pred.metrics_server.port > 0
+    finally:
+        pred.close()
+    assert pred.metrics_server is None
