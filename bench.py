@@ -140,6 +140,14 @@ def run():
                              parameters=model.parameters())
     step = TrainStep(model, gpt_pretrain_loss, opt, donate=True)
 
+    # flight recorder (memory-only): instruments warmup + a post-window
+    # verification step. The measured window runs UNinstrumented — the
+    # per-step block_until_ready the recorder adds must not perturb the
+    # tracked perf number.
+    from paddle_tpu.utils import flight_recorder as fr
+    recorder = fr.FlightRecorder(ring_size=256)
+    step.attach_flight_recorder(recorder)
+
     # warmup: step 1 compiles; step 2 recompiles once for the donated
     # on-device buffer layouts; step 3 confirms steady state
     _note("model built; warmup (compile)")
@@ -147,6 +155,7 @@ def run():
         loss = step(ids, ids)
         float(loss.numpy())
         _note(f"warm {i} done")
+    step.detach_flight_recorder()
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -154,6 +163,12 @@ def run():
     final = float(loss.numpy())           # one device sync at the end
     dt = (time.perf_counter() - t0) / iters
     assert np.isfinite(final), "non-finite loss in bench"
+
+    # one instrumented steady-state step -> journal MFU/sentinel rollup
+    step.attach_flight_recorder(recorder)
+    float(step(ids, ids).numpy())
+    step.detach_flight_recorder()
+    fr_rollup = fr.rollup(recorder.events())
 
     tokens_per_sec = batch * seq / dt
 
@@ -169,7 +184,8 @@ def run():
 
     detail = {"step_ms": round(dt * 1e3, 2), "loss": round(final, 3),
               "model_tflops": round(tflops, 2), "params": n_params,
-              "backend": jax.default_backend(), "batch": batch}
+              "backend": jax.default_backend(), "batch": batch,
+              "flight_recorder": fr_rollup}
     if not on_tpu:
         # tunnel down at bench time: this run is a CPU liveness smoke,
         # NOT a perf datum. Attach the last BANKED on-chip measurement

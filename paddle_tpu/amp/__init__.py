@@ -15,6 +15,13 @@ import jax.numpy as jnp
 from ..framework import state
 from ..framework.tensor import Tensor
 from ..ops.dispatch import AMP_WHITE_LIST, AMP_BLACK_LIST
+from ..utils import telemetry, flight_recorder as _flight_recorder
+
+_AMP_SKIPPED = telemetry.counter(
+    "amp_skipped_steps_total",
+    "Optimizer steps skipped because GradScaler saw inf/nan gradients")
+_AMP_SCALE = telemetry.gauge(
+    "amp_loss_scale", "Current GradScaler loss scale")
 
 
 @contextlib.contextmanager
@@ -79,6 +86,8 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        if enable:
+            _AMP_SCALE.set(self._scale)
 
     def scale(self, loss):
         if not self._enable:
@@ -120,9 +129,19 @@ class GradScaler:
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        else:
+            # skipped optimizer step: counted on /metrics and journaled
+            # through the current flight recorder (same path TrainStep's
+            # sentinel uses), so loss-scale churn is visible post-mortem
+            _AMP_SKIPPED.inc()
+            recorder = _flight_recorder.get_recorder()
+            if recorder is not None:
+                recorder.nonfinite(source="amp_grad_scaler",
+                                   loss_scale=float(self._scale))
 
     def update(self):
         if not self._dynamic:
+            _AMP_SCALE.set(self._scale)
             return
         if self._found_inf:
             self._bad_steps += 1
@@ -137,6 +156,7 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
         self._found_inf = False
+        _AMP_SCALE.set(self._scale)
 
     def is_enable(self):
         return self._enable

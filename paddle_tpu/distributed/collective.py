@@ -18,6 +18,8 @@ Two execution regimes:
     jax process-level primitives only where needed (barrier).
 These match the reference's dual dygraph/static collective paths.
 """
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -25,6 +27,7 @@ from jax import lax
 
 from ..framework.tensor import Tensor
 from ..ops.dispatch import as_array
+from ..utils import telemetry, profiler, flight_recorder as _flight_recorder
 from . import mesh as mesh_mod
 
 
@@ -44,6 +47,92 @@ def _axis(group):
     return mesh_mod.get_group(group).axis_name
 
 
+# --------------------------------------------------------------- telemetry
+# Byte/call accounting per op+group, RecordEvent spans (so communication
+# shows up next to compute in the chrome trace), and journal `collective`
+# events through the current flight recorder. Traced call sites (inside
+# shard_map/pjit) run ONCE PER TRACE, so there the counters measure the
+# communication the compiled program issues per executable, not per step
+# — docs/observability.md spells this out.
+
+_COLLECTIVE_CALLS = telemetry.counter(
+    "collective_calls_total",
+    "Collective op invocations (traced call sites count once per trace)",
+    labelnames=("op", "group"))
+_COLLECTIVE_BYTES = telemetry.counter(
+    "collective_bytes_total",
+    "Payload bytes entering collective ops, by op and group",
+    labelnames=("op", "group"))
+
+
+def _payload_bytes(x):
+    """Bytes of a tensor / array / list-of-tensors payload; works on
+    tracers too (shape/dtype are known at trace time)."""
+    try:
+        if isinstance(x, (list, tuple)):
+            return sum(_payload_bytes(v) for v in x)
+        a = x._data if isinstance(x, Tensor) else x
+        shape = jnp.shape(a)
+        return int(np.prod(shape, dtype=np.int64)) * jnp.dtype(a.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def _group_label(group):
+    """Best-effort closed-cardinality group label: the bound mesh axis
+    name when the group (handle or registered id) is resolvable, else
+    'default' (no mesh side effects — labels must not instantiate the
+    default mesh)."""
+    if group is None:
+        return "default"
+    if isinstance(group, mesh_mod._Group):
+        return str(group.axis_name)
+    try:
+        registered = mesh_mod._groups.get(int(group))
+    except (TypeError, ValueError):
+        registered = None
+    if registered is not None:
+        return str(registered.axis_name)
+    return str(group)
+
+
+def _payload_is_traced(x):
+    if isinstance(x, (list, tuple)):
+        return bool(x) and _payload_is_traced(x[0])
+    return _in_trace(x._data if isinstance(x, Tensor) else x)
+
+
+def _instrumented(payload_arg=0):
+    """Wrap a collective op: count calls/bytes, journal, span."""
+    def deco(fn):
+        import inspect
+        op = fn.__name__
+        params = list(inspect.signature(fn).parameters)
+        payload_name = params[payload_arg]
+        group_arg = params.index("group") if "group" in params else None
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            payload = args[payload_arg] if len(args) > payload_arg \
+                else kwargs.get(payload_name)
+            nbytes = _payload_bytes(payload)
+            grp = kwargs.get("group")
+            if grp is None and group_arg is not None \
+                    and len(args) > group_arg:
+                grp = args[group_arg]
+            group = _group_label(grp)
+            _COLLECTIVE_CALLS.labels(op, group).inc()
+            _COLLECTIVE_BYTES.labels(op, group).inc(nbytes)
+            recorder = _flight_recorder.get_recorder()
+            if recorder is not None:
+                recorder.collective(op=op, nbytes=nbytes, group=group,
+                                    traced=_payload_is_traced(payload))
+            with profiler.RecordEvent(f"collective/{op}"):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
 def _apply_inplace(x, arr):
     if isinstance(x, Tensor):
         x._data = arr
@@ -51,6 +140,7 @@ def _apply_inplace(x, arr):
     return Tensor(arr)
 
 
+@_instrumented(payload_arg=0)
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
                use_calc_stream=True):
     a = as_array(tensor)
@@ -71,6 +161,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
     return _apply_inplace(tensor, a)
 
 
+@_instrumented(payload_arg=1)
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     a = as_array(tensor)
     if _in_trace(a):
@@ -86,6 +177,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     return outs
 
 
+@_instrumented(payload_arg=1)
 def reduce_scatter(tensor, tensor_or_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     src = tensor_or_list
@@ -101,6 +193,7 @@ def reduce_scatter(tensor, tensor_or_list, op=ReduceOp.SUM, group=None,
     return _apply_inplace(tensor, out)
 
 
+@_instrumented(payload_arg=0)
 def broadcast(tensor, src=0, group=None, sync_op=True):
     a = as_array(tensor)
     if _in_trace(a):
@@ -116,6 +209,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op=op, group=group)
 
 
+@_instrumented(payload_arg=0)
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     a = as_array(tensor)
     if _in_trace(a) and tensor_list is not None:
@@ -128,6 +222,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_instrumented(payload_arg=0)
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     arrays = [as_array(t) for t in in_tensor_list]
     if _in_trace(arrays[0]):
@@ -144,6 +239,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     return outs
 
 
+@_instrumented(payload_arg=0)
 def send(tensor, dst=0, group=None, sync_op=True):
     """p2p over a ring edge -> ppermute in traced mode (ref send_v2_op.cc)."""
     a = as_array(tensor)
@@ -155,6 +251,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     return tensor
 
 
+@_instrumented(payload_arg=0)
 def recv(tensor, src=0, group=None, sync_op=True):
     return tensor
 

@@ -304,7 +304,11 @@ class TelemetryCallback(Callback):
         from ..utils import monitor
         try:
             stats = monitor.device_memory_stats(self.device)
-        except Exception:      # no PJRT stats on this backend: keep zeros
+        except Exception:      # device probe itself failed: skip
+            return
+        if not stats:
+            # CPU-only jax: memory_stats() is None — skip the gauges
+            # entirely rather than publishing misleading zeros
             return
         self._mem_in_use.set(stats.get("bytes_in_use", 0))
         self._mem_peak.set(stats.get("peak_bytes_in_use", 0))

@@ -13,6 +13,21 @@ from ..metric import Metric
 from . import callbacks as cbks
 
 
+def _timed_iter(loader):
+    """Yield (data_wait_seconds, batch): how long the input pipeline made
+    the train loop wait for each batch — the 'data' phase of the flight
+    recorder's step-time breakdown."""
+    import time
+    it = iter(loader)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        yield time.perf_counter() - t0, batch
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -23,6 +38,7 @@ class Model:
         self.mode = "train"       # ref hapi Model.mode: train|eval|test
         self._metrics = []
         self._train_step = None
+        self._flight_recorder = None
         self.stop_training = False
 
     # ------------------------------------------------------------- prepare
@@ -43,8 +59,9 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, flight_recorder=None):
         from ..io import DataLoader, Dataset
+        from ..utils import flight_recorder as fr
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,
@@ -57,42 +74,92 @@ class Model:
             steps = len(train_loader)
         except TypeError:
             steps = None
-        cb_list.on_begin("train", {"epochs": epochs, "steps": steps,
-                                   "verbose": verbose,
-                                   "metrics": self._metric_names()})
+        # flight recorder: a FlightRecorder, or a journal path (owned —
+        # opened here, closed in the finally). docs/observability.md
+        # documents the journal schema; on ANY exception the ring buffer
+        # is flushed so the last steps reach disk.
+        recorder, own_recorder = flight_recorder, False
+        if recorder is not None and not isinstance(recorder,
+                                                   fr.FlightRecorder):
+            recorder = fr.FlightRecorder(recorder)
+            own_recorder = True
+        self._flight_recorder = recorder
+        prev_recorder = fr.set_recorder(recorder) \
+            if recorder is not None else None
         history = {"loss": []}
         it_count = 0
         logs = {}
-        for epoch in range(epochs):
-            cb_list.on_epoch_begin(epoch)
-            self.network.train()
-            for step, batch in enumerate(train_loader):
-                cb_list.on_batch_begin("train", step, logs)
-                loss, metrics = self.train_batch_parts(batch)
-                logs = {"loss": loss, **metrics,
-                        "batch_size": batch_size}
-                history["loss"].append(loss)
-                cb_list.on_batch_end("train", step, logs)
-                it_count += 1
-                if num_iters is not None and it_count >= num_iters:
+        status, err = "ok", None
+        # run_start onward lives under the try: an unwritable journal
+        # path (or a callback raising in on_begin) must still restore
+        # the previous current recorder in the finally
+        try:
+            if recorder is not None:
+                recorder.run_start(mode="fit", epochs=int(epochs),
+                                   steps_per_epoch=steps,
+                                   batch_size=int(batch_size))
+            cb_list.on_begin("train", {"epochs": epochs, "steps": steps,
+                                       "verbose": verbose,
+                                       "metrics": self._metric_names()})
+            for epoch in range(epochs):
+                cb_list.on_epoch_begin(epoch)
+                self.network.train()
+                for step, (data_s, batch) in enumerate(
+                        _timed_iter(train_loader)):
+                    cb_list.on_batch_begin("train", step, logs)
+                    loss, metrics = self.train_batch_parts(
+                        batch, data_wait=data_s)
+                    logs = {"loss": loss, **metrics,
+                            "batch_size": batch_size}
+                    history["loss"].append(loss)
+                    cb_list.on_batch_end("train", step, logs)
+                    it_count += 1
+                    if num_iters is not None and it_count >= num_iters:
+                        break
+                for m in self._metrics:
+                    logs[self._name_of(m)] = m.accumulate()
+                    m.reset()
+                cb_list.on_epoch_end(epoch, logs)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_data,
+                                              batch_size=batch_size,
+                                              verbose=0)
+                    logs.update({f"eval_{k}": v
+                                 for k, v in eval_logs.items()})
+                if self.stop_training or (num_iters is not None
+                                          and it_count >= num_iters):
                     break
-            for m in self._metrics:
-                logs[self._name_of(m)] = m.accumulate()
-                m.reset()
-            cb_list.on_epoch_end(epoch, logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
-                                          verbose=0)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-            if self.stop_training or (num_iters is not None
-                                      and it_count >= num_iters):
-                break
-        cb_list.on_end("train", logs)
-        if self._train_step is not None:
-            self._train_step.sync()
+            cb_list.on_end("train", logs)
+            if self._train_step is not None:
+                self._train_step.sync()
+        except BaseException as e:
+            status, err = "crashed", f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            if recorder is not None:
+                try:
+                    recorder.run_end(status=status, error=err,
+                                     steps_run=it_count)
+                except Exception:
+                    # already crashing: a secondary journal-write failure
+                    # must not mask the original exception; on a clean
+                    # run it's a real error (the journal wasn't written)
+                    if status == "ok":
+                        raise
+                finally:
+                    fr.set_recorder(prev_recorder)
+                    if self._train_step is not None and hasattr(
+                            self._train_step, "detach_flight_recorder"):
+                        self._train_step.detach_flight_recorder()
+                    if own_recorder:
+                        try:
+                            recorder.close()
+                        except OSError:
+                            pass
+            self._flight_recorder = None
         return history
 
-    def train_batch_parts(self, batch):
+    def train_batch_parts(self, batch, data_wait=None):
         from ..optimizer.lr import LRScheduler
         inputs, labels = self._split_batch(batch)
         if self._train_step is None:
@@ -100,6 +167,23 @@ class Model:
             self._train_step = build_train_step(
                 self.network, self._loss_fn, self._optimizer,
                 return_outputs=bool(self._metrics))
+        recorder = getattr(self, "_flight_recorder", None)
+        if recorder is not None:
+            if hasattr(self._train_step, "attach_flight_recorder"):
+                if getattr(self._train_step, "_recorder", None) \
+                        is not recorder:
+                    self._train_step.attach_flight_recorder(recorder)
+            elif not getattr(self, "_fr_unsupported_warned", False):
+                import warnings
+                warnings.warn(
+                    f"{type(self._train_step).__name__} does not support "
+                    "flight-recorder instrumentation; the journal will "
+                    "carry run/checkpoint events but no step/compile/"
+                    "nonfinite events", stacklevel=2)
+                self._fr_unsupported_warned = True
+        if data_wait is not None and \
+                hasattr(self._train_step, "set_data_wait"):
+            self._train_step.set_data_wait(data_wait)
         result = self._train_step(inputs, labels)
         has_outs = getattr(self._train_step, "return_outputs", False)
         if self._metrics and not has_outs:
@@ -227,11 +311,17 @@ class Model:
     # ------------------------------------------------------------- save/load
     def save(self, path, training=True):
         from ..framework.serialization import save as _save
+        from ..utils import flight_recorder as fr
         if self._train_step is not None:
             self._train_step.sync()
         _save(dict(self.network.state_dict()), path + ".pdparams")
         if training and self._optimizer is not None:
             _save(self._optimizer.state_dict(), path + ".pdopt")
+        recorder = fr.get_recorder()
+        if recorder is not None:
+            recorder.checkpoint(
+                path=path,
+                step=getattr(self._train_step, "_step_i", None))
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from ..framework.serialization import load as _load

@@ -312,31 +312,191 @@ class TrainStep:
                 has_aux=True)(params)
             new_params, new_opt, new_acc = update_fn(
                 params, grads, opt_state, acc, lr, step_i)
+            # non-finite sentinel, folded into the compiled step: a tiny
+            # fp32 reduction over grads the scheduler fuses into the
+            # backward — no extra host sync (the flag is only ever READ
+            # by an instrumented caller that is about to block anyway)
+            gsq = sum((jnp.sum(jnp.square(g.astype(jnp.float32)))
+                       for g in jax.tree_util.tree_leaves(grads)),
+                      jnp.asarray(0.0, jnp.float32))
+            notfinite = jnp.logical_not(
+                jnp.all(jnp.isfinite(loss)) & jnp.isfinite(gsq))
             # outs leave the jitted program ONLY when asked for: a returned
             # value can't be dead-code-eliminated, and fused-loss models
             # (e.g. GPT chunked head+CE) rely on XLA dropping the unused
             # wide logits entirely
             if not ret_outs:
                 outs = ()
-            return loss, new_params, new_buf, new_opt, new_acc, outs
+            return (loss, new_params, new_buf, new_opt, new_acc, outs,
+                    jnp.sqrt(gsq), notfinite)
 
         donate_args = (0, 1, 2, 3) if donate else ()
         self._compiled = jax.jit(_step, donate_argnums=donate_args)
+        # flight-recorder instrumentation (attach_flight_recorder)
+        self._recorder = None
+        self._label = "train_step"
+        self._fail_fast = False
+        self._cost_cache = {}
+        self._pending_data_s = 0.0
+        self._last_grad_norm = None
+        self._last_nonfinite = None
 
     def __call__(self, inputs, labels):
         inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
         labels = labels if isinstance(labels, (list, tuple)) else (labels,)
         self._step_i += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        (loss, self.params, self.buffers, self.opt_state, self.grad_acc,
-         outs) = self._compiled(
-            self.params, self.buffers, self.opt_state, self.grad_acc,
-            state.next_rng_key(),
-            lr, jnp.asarray(self._step_i, jnp.int32),
-            _unwrap(tuple(inputs)), _unwrap(tuple(labels)))
+        args = (self.params, self.buffers, self.opt_state, self.grad_acc,
+                state.next_rng_key(),
+                lr, jnp.asarray(self._step_i, jnp.int32),
+                _unwrap(tuple(inputs)), _unwrap(tuple(labels)))
+        if self._recorder is not None:
+            loss, outs = self._instrumented_call(args)
+        else:
+            (loss, self.params, self.buffers, self.opt_state, self.grad_acc,
+             outs, self._last_grad_norm, self._last_nonfinite) = \
+                self._compiled(*args)
         if self.return_outputs:
             return Tensor(loss), _wrap(outs)
         return Tensor(loss)
+
+    # ------------------------------------------------------ flight recorder
+    def attach_flight_recorder(self, recorder, label="train_step",
+                               fail_fast=None):
+        """Instrument every subsequent step: journal `step` events with
+        the data/host/device timing split, per-executable `compile`
+        events with FLOPs/bytes from HLO cost analysis, MFU + non-finite
+        telemetry. Adds ONE host sync per step (block_until_ready on the
+        loss) — the same sync hapi's per-step float(loss) already pays.
+        `fail_fast=True` (or recorder.fail_fast) raises NonFiniteError
+        when loss/global-grad-norm go non-finite."""
+        from ..utils import telemetry, flight_recorder as fr
+        self._recorder = recorder
+        self._label = label
+        self._fail_fast = recorder.fail_fast if fail_fast is None \
+            else bool(fail_fast)
+        # False on jax builds without jax.monitoring: compile detection
+        # then falls back to _cache_size() deltas (same fallback
+        # telemetry._InstrumentedJit uses)
+        self._monitoring = telemetry.install_compile_tracking()
+        self._peak_flops = fr.device_peak_flops()   # constant per process
+        self._m_mfu = telemetry.gauge(
+            "train_mfu", "Model-FLOPs utilization of the latest step")
+        self._m_flops = telemetry.gauge(
+            "train_step_flops",
+            "FLOPs per compiled train step (HLO cost analysis)")
+        self._m_bytes = telemetry.gauge(
+            "train_step_bytes",
+            "Bytes accessed per compiled train step (HLO cost analysis)")
+        self._m_nonfinite = telemetry.counter(
+            "train_nonfinite_total",
+            "Train steps with non-finite loss or global grad norm")
+        self._m_data = telemetry.histogram(
+            "train_data_wait_seconds", "Input-pipeline wait per step")
+        self._m_host = telemetry.histogram(
+            "train_host_dispatch_seconds",
+            "Host time dispatching the compiled step")
+        self._m_dev = telemetry.histogram(
+            "train_device_step_seconds",
+            "Device execution time per step (block_until_ready)")
+        return self
+
+    def detach_flight_recorder(self):
+        self._recorder = None
+
+    def set_data_wait(self, seconds):
+        """Data-pipeline wait attributed to the NEXT step event
+        (Model.fit times the DataLoader and reports it here)."""
+        self._pending_data_s = float(seconds)
+
+    def last_nonfinite(self):
+        """Sentinel of the latest step (host sync on first read)."""
+        return None if self._last_nonfinite is None \
+            else bool(self._last_nonfinite)
+
+    def last_grad_norm(self):
+        return None if self._last_grad_norm is None \
+            else float(self._last_grad_norm)
+
+    def _safe_cache_size(self):
+        try:
+            return self._compiled._cache_size()
+        except Exception:
+            return 0
+
+    def _signature(self, args):
+        # dtype via attribute, NOT jnp.asarray: these are the raw batch
+        # leaves and asarray would device-transfer numpy batches once
+        # more per step just to read their dtype
+        leaves = jax.tree_util.tree_flatten((args[7], args[8]))[0]
+        return tuple(
+            (jnp.shape(a), str(getattr(a, "dtype", type(a).__name__)))
+            for a in leaves)
+
+    def _instrumented_call(self, args):
+        import time as _time
+        from ..utils import telemetry, flight_recorder as fr
+        rec = self._recorder
+        sig = self._signature(args)
+        if sig not in self._cost_cache:
+            # once per executable, BEFORE the call donates the buffers:
+            # lowering-level HLO cost analysis, no second backend compile
+            self._cost_cache[sig] = fr.cost_analysis(self._compiled, *args)
+        cost = self._cost_cache[sig] or {}
+        before = telemetry.compile_count(self._label) if self._monitoring \
+            else self._safe_cache_size()
+        t0 = _time.perf_counter()
+        with telemetry.track_compiles(self._label):
+            (loss, self.params, self.buffers, self.opt_state, self.grad_acc,
+             outs, self._last_grad_norm, self._last_nonfinite) = \
+                self._compiled(*args)
+        t1 = _time.perf_counter()
+        loss.block_until_ready()
+        t2 = _time.perf_counter()
+        host_s, device_s = t1 - t0, t2 - t1
+        if self._monitoring:
+            compiled = telemetry.compile_count(self._label) - before
+        else:
+            compiled = max(0, self._safe_cache_size() - before)
+            if compiled:
+                telemetry.counter(
+                    "xla_compiles_total", labelnames=("function",)
+                ).labels(self._label).inc(compiled)
+        flops = cost.get("flops")
+        if compiled:
+            rec.compile_event(self._label, count=compiled, compile_s=host_s,
+                              flops=flops,
+                              bytes_accessed=cost.get("bytes_accessed"))
+        # gauges track the CURRENT executable's cost, not just freshly
+        # compiled ones — a recorder attached after the compile (bench's
+        # verification step) must still publish them
+        if flops is not None:
+            self._m_flops.set(flops)
+        if cost.get("bytes_accessed") is not None:
+            self._m_bytes.set(cost["bytes_accessed"])
+        mfu = 0.0
+        if flops:
+            mfu = flops / (max(device_s, 1e-9) * self._peak_flops)
+            self._m_mfu.set(mfu)
+        data_s, self._pending_data_s = self._pending_data_s, 0.0
+        nonfinite = bool(self._last_nonfinite)
+        grad_norm = float(self._last_grad_norm)
+        rec.step(step=self._step_i, data_s=data_s, host_s=host_s,
+                 device_s=device_s, loss=float(loss), grad_norm=grad_norm,
+                 mfu=mfu, nonfinite=nonfinite)
+        self._m_data.observe(data_s)
+        self._m_host.observe(host_s)
+        self._m_dev.observe(device_s)
+        if nonfinite:
+            self._m_nonfinite.inc()
+            rec.nonfinite(step=self._step_i, loss=float(loss),
+                          grad_norm=grad_norm, source="train_step")
+            if self._fail_fast:
+                rec.flush()
+                raise fr.NonFiniteError(
+                    f"non-finite loss/grad at step {self._step_i}: "
+                    f"loss={float(loss)!r} grad_norm={grad_norm!r}")
+        return loss, outs
 
     def eval_fn(self, fn=None):
         """Compile an eval forward over the live functional state."""

@@ -2,6 +2,7 @@
 from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401
 from . import telemetry  # noqa: F401  (after monitor/profiler: it uses both)
+from . import flight_recorder  # noqa: F401
 
 
 def try_import(name):

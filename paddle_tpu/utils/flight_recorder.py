@@ -1,0 +1,366 @@
+"""Training flight recorder: append-only JSONL run journal.
+
+The serving path got full telemetry in the observability PR; this module
+is the training-side counterpart — a crash-surviving record of what a
+run actually did, step by step:
+
+  * `FlightRecorder` writes one JSON object per line (`run_start`,
+    `step`, `compile`, `nonfinite`, `collective`, `checkpoint`,
+    `run_end`). Events are ring-buffered (`ring_size`) between disk
+    flushes, so a pathological run keeps bounded memory/IO and the LAST
+    N events — the ones that explain the crash — always reach the
+    journal: the context manager flushes on exception and appends a
+    `run_end {status: "crashed"}` marker.
+  * `jit.TrainStep.attach_flight_recorder` threads it through training:
+    every step event carries the data-wait / host-dispatch / device-time
+    split, loss, global grad norm, the non-finite sentinel, and MFU from
+    the compiled executable's cost analysis (`cost_analysis` below —
+    computed once per executable, cached by input signature).
+  * `hapi.Model.fit(flight_recorder=...)` owns the run lifecycle
+    (run_start/run_end, flush-on-exception) and measures data wait.
+  * `amp.GradScaler`, `distributed.collective`, and `Model.save` emit
+    `nonfinite` / `collective` / `checkpoint` events through the
+    module-level *current recorder* (`set_recorder`/`get_recorder`) so
+    deep layers need no plumbing.
+
+`scripts/runlog_summary.py` renders a journal into a report;
+`rollup()` is the compact version bench entrypoints attach to their
+output. Journal schema is documented in docs/observability.md.
+"""
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+
+
+class NonFiniteError(RuntimeError):
+    """Raised by fail-fast training when loss/grad-norm go non-finite."""
+
+
+EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
+               "checkpoint", "run_end")
+
+
+def _json_safe(v):
+    """JSON has no NaN/Inf literal; a diverged loss is exactly when the
+    journal must stay parseable — spell non-finite floats as strings
+    (same convention as telemetry's JSON snapshot)."""
+    if isinstance(v, float) and (v != v or v in (float("inf"),
+                                                 float("-inf"))):
+        return "NaN" if v != v else ("+Inf" if v > 0 else "-Inf")
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    return v
+
+
+class FlightRecorder:
+    """Ring-buffered JSONL journal writer.
+
+        rec = FlightRecorder("runlog.jsonl")
+        with rec:                      # run_start ... run_end bracketing
+            rec.step(step=1, data_s=.001, host_s=.002, device_s=.03,
+                     loss=2.3, mfu=0.41)
+
+    `path=None` keeps events in memory only (bench rollups).
+    `flush_every` defers disk writes; between flushes at most `ring_size`
+    events are retained (oldest dropped, counted in `run_end`), so the
+    last steps before a crash always survive — the flight-recorder
+    contract. `fail_fast` is advisory state consumed by TrainStep: a
+    non-finite step raises `NonFiniteError` instead of training on.
+    """
+
+    def __init__(self, path=None, ring_size=512, flush_every=1,
+                 fail_fast=False, meta=None):
+        self.path = os.fspath(path) if path is not None else None
+        self.ring_size = max(1, int(ring_size))
+        self.flush_every = max(1, int(flush_every))
+        self.fail_fast = bool(fail_fast)
+        self.meta = dict(meta or {})
+        self._lock = threading.RLock()
+        self._pending = collections.deque(maxlen=self.ring_size)
+        self._recent = collections.deque(maxlen=self.ring_size)
+        self._counts = {}
+        self._dropped = 0
+        self._seq = 0
+        self._file = None
+        self._started = False
+        self._ended = False
+
+    # ---------------------------------------------------------------- core
+    def record(self, kind, **fields):
+        """Append one event; returns the dict written (ts/seq added)."""
+        with self._lock:
+            self._seq += 1
+            ev = {"ev": kind, "ts": round(time.time(), 6), "seq": self._seq}
+            ev.update(_json_safe(fields))
+            self._recent.append(ev)
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            if self.path is not None:
+                if len(self._pending) == self._pending.maxlen:
+                    self._dropped += 1    # ring full: oldest pending falls
+                self._pending.append(ev)
+                if len(self._pending) >= self.flush_every:
+                    self.flush()
+            return ev
+
+    def flush(self):
+        """Write buffered events to the journal file (no-op in-memory)."""
+        if self.path is None:
+            return
+        with self._lock:
+            if not self._pending:
+                return
+            if self._file is None:
+                self._file = open(self.path, "a")
+            while self._pending:
+                self._file.write(
+                    json.dumps(self._pending.popleft(), allow_nan=False)
+                    + "\n")
+            self._file.flush()
+
+    def close(self):
+        with self._lock:
+            self.flush()
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    def events(self):
+        """The last `ring_size` events, flushed or not (bench rollups)."""
+        with self._lock:
+            return list(self._recent)
+
+    def counts(self):
+        with self._lock:
+            return dict(self._counts)
+
+    @property
+    def dropped_events(self):
+        return self._dropped
+
+    # ------------------------------------------------------------- typed
+    def run_start(self, **meta):
+        """Open a run. Idempotent while a run is open (fit and `with`
+        both call it); after run_end it opens a NEW run segment in the
+        same journal, so reusing one recorder across two fits brackets
+        each run instead of silently recording neither."""
+        with self._lock:
+            if self._started and not self._ended:
+                return None
+            self._started, self._ended = True, False
+        info = dict(self.meta)
+        info.update(meta)
+        return self.record("run_start", **info)
+
+    def run_end(self, status="ok", error=None, **extra):
+        """Close the run (idempotent) and force a flush — crashed runs
+        keep their last `ring_size` events on disk."""
+        with self._lock:
+            if self._ended:
+                return None
+            self._ended = True
+        fields = {"status": status, "counts": self.counts(),
+                  "dropped_events": self._dropped}
+        if error:
+            fields["error"] = str(error)
+        fields.update(extra)
+        ev = self.record("run_end", **fields)
+        self.flush()
+        return ev
+
+    def step(self, step, data_s, host_s, device_s, loss=None, grad_norm=None,
+             mfu=None, nonfinite=False, **extra):
+        return self.record(
+            "step", step=int(step), data_s=round(float(data_s), 6),
+            host_s=round(float(host_s), 6),
+            device_s=round(float(device_s), 6),
+            loss=None if loss is None else float(loss),
+            grad_norm=None if grad_norm is None else float(grad_norm),
+            mfu=None if mfu is None else float(mfu),
+            nonfinite=bool(nonfinite), **extra)
+
+    def compile_event(self, label, count=1, compile_s=None, flops=None,
+                      bytes_accessed=None, **extra):
+        fields = {"label": str(label), "count": int(count)}
+        if compile_s is not None:
+            fields["compile_s"] = round(float(compile_s), 6)
+        if flops is not None:
+            fields["flops"] = float(flops)
+        if bytes_accessed is not None:
+            fields["bytes_accessed"] = float(bytes_accessed)
+        fields.update(extra)
+        return self.record("compile", **fields)
+
+    def nonfinite(self, step=None, loss=None, grad_norm=None,
+                  source="train_step", **extra):
+        fields = {"source": str(source)}
+        if step is not None:
+            fields["step"] = int(step)
+        if loss is not None:
+            fields["loss"] = float(loss)
+        if grad_norm is not None:
+            fields["grad_norm"] = float(grad_norm)
+        fields.update(extra)
+        return self.record("nonfinite", **fields)
+
+    def collective(self, op, nbytes, group="default", traced=False, **extra):
+        return self.record("collective", op=str(op), bytes=int(nbytes),
+                           group=str(group), traced=bool(traced), **extra)
+
+    def checkpoint(self, path=None, step=None, **extra):
+        fields = {}
+        if path is not None:
+            fields["path"] = str(path)
+        if step is not None:
+            fields["step"] = int(step)
+        fields.update(extra)
+        return self.record("checkpoint", **fields)
+
+    # --------------------------------------------------------- lifecycle
+    def __enter__(self):
+        self._prev = set_recorder(self)
+        self.run_start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.run_end(status="crashed",
+                         error=f"{exc_type.__name__}: {exc}")
+        else:
+            self.run_end(status="ok")
+        set_recorder(getattr(self, "_prev", None))
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# current recorder (so amp / collective / save need no plumbing)
+# ---------------------------------------------------------------------------
+
+_current_lock = threading.Lock()
+_current = None
+
+
+def set_recorder(recorder):
+    """Install `recorder` as the process-wide current recorder; returns
+    the previous one (restore it when done)."""
+    global _current
+    with _current_lock:
+        prev = _current
+        _current = recorder
+        return prev
+
+
+def get_recorder():
+    return _current
+
+
+@contextlib.contextmanager
+def recording(recorder):
+    prev = set_recorder(recorder)
+    try:
+        yield recorder
+    finally:
+        set_recorder(prev)
+
+
+# ---------------------------------------------------------------------------
+# journal readers / rollup
+# ---------------------------------------------------------------------------
+
+def read_journal(path):
+    """Parse a JSONL journal -> list of event dicts (strict: a malformed
+    line raises — the writer emits one valid object per line)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+def rollup(events):
+    """Compact summary for bench output: steps, mean MFU over steps that
+    have one, executable (re)compiles, and non-finite incidents."""
+    steps = [e for e in events if e.get("ev") == "step"]
+    mfus = [e["mfu"] for e in steps
+            if isinstance(e.get("mfu"), (int, float)) and e["mfu"] > 0]
+    return {
+        "steps": len(steps),
+        "mean_mfu": round(sum(mfus) / len(mfus), 4) if mfus else 0.0,
+        "recompiles": sum(int(e.get("count", 1)) for e in events
+                          if e.get("ev") == "compile"),
+        "nonfinite": sum(1 for e in events if e.get("ev") == "nonfinite"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# cost accounting (MFU)
+# ---------------------------------------------------------------------------
+
+# bf16 peak dense FLOP/s by TPU device kind substring (first match wins);
+# CPU/unknown fall back to a nominal 1 TF/s so MFU stays a defined,
+# comparable-across-runs number even off-chip (flagged by peak source).
+_PEAK_FLOPS_BY_KIND = (
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v6e", 918e12),
+    ("trillium", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+_DEFAULT_PEAK_FLOPS = 1e12
+
+
+def device_peak_flops(device=None):
+    """Peak FLOP/s of the accelerator MFU is measured against.
+    `PT_PEAK_FLOPS` (float, FLOP/s) overrides the table for parts not
+    listed here."""
+    env = os.environ.get("PT_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+        dev = device or jax.local_devices()[0]
+        kind = (getattr(dev, "device_kind", "") or "").lower()
+    except Exception:
+        return _DEFAULT_PEAK_FLOPS
+    for key, peak in _PEAK_FLOPS_BY_KIND:
+        if key in kind:
+            return peak
+    return _DEFAULT_PEAK_FLOPS
+
+
+def cost_analysis(jitted, *args, **kwargs):
+    """FLOPs/bytes of the executable `jitted(*args)` would run, via the
+    lowering's HLO cost analysis — no second backend compile, and safe
+    to call with the concrete (not-yet-donated) call arguments. Returns
+    {"flops": float, "bytes_accessed": float} (keys present when the
+    analysis provides them) or None when the jax build/backend can't
+    analyze."""
+    try:
+        lowered = jitted.lower(*args, **kwargs)
+        ca = lowered.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    if ca.get("flops") is not None:
+        out["flops"] = float(ca["flops"])
+    if ca.get("bytes accessed") is not None:
+        out["bytes_accessed"] = float(ca["bytes accessed"])
+    return out or None

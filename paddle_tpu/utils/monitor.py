@@ -56,10 +56,19 @@ def all_stats():
 
 def device_memory_stats(device=None):
     """PJRT memory stats for a device — replaces the reference's allocator
-    STAT_ADD("gpu_mem", ...) counters (memory/stats.h)."""
+    STAT_ADD("gpu_mem", ...) counters (memory/stats.h).
+
+    Returns None when the backend exposes no stats (CPU jax returns None
+    from `memory_stats()`): callers skip their gauges instead of
+    publishing fake zeros on /metrics."""
     import jax
     dev = device or jax.local_devices()[0]
-    stats = dev.memory_stats() or {}
+    try:
+        stats = dev.memory_stats()
+    except (AttributeError, RuntimeError):
+        return None
+    if not stats:
+        return None
     return {
         "bytes_in_use": stats.get("bytes_in_use", 0),
         "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),

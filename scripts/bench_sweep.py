@@ -51,12 +51,22 @@ def _measure(step, inputs, labels, tag, per_step_samples, flops_per_step,
 
 def _measure_inner(step, inputs, labels, tag, per_step_samples,
                    flops_per_step, unit):
+    # flight recorder over warmup + one trailing verification step (the
+    # measured window stays uninstrumented: no per-step device sync);
+    # the rollup adds utilization context to every sweep row
+    recorder = None
+    if hasattr(step, "attach_flight_recorder"):
+        from paddle_tpu.utils import flight_recorder as fr
+        recorder = fr.FlightRecorder(ring_size=256)
+        step.attach_flight_recorder(recorder)
     warm = int(os.environ.get("BENCH_WARM", 3))
     for i in range(warm):
         t1 = time.time()
         loss = step(inputs, labels)
         v = float(loss.numpy())
         log(f"{tag} warm {i}: {time.time()-t1:.3f}s loss={v:.4f}")
+    if recorder is not None:
+        step.detach_flight_recorder()
     iters = int(os.environ.get("BENCH_ITERS", 20))
     t1 = time.time()
     for _ in range(iters):
@@ -67,6 +77,15 @@ def _measure_inner(step, inputs, labels, tag, per_step_samples,
     tf = flops_per_step / dt / 1e12
     log(f"{tag}: {dt*1e3:.1f} ms/step  {rate:,.0f} {unit}  "
         f"{tf:.1f} TF/s  MFU={tf/PEAK_TFLOPS:.3f}")
+    if recorder is not None:
+        from paddle_tpu.utils import flight_recorder as fr
+        step.attach_flight_recorder(recorder)
+        float(step(inputs, labels).numpy())
+        step.detach_flight_recorder()
+        r = fr.rollup(recorder.events())
+        log(f"{tag} flight-recorder: steps={r['steps']} "
+            f"mean_mfu={r['mean_mfu']} recompiles={r['recompiles']} "
+            f"nonfinite={r['nonfinite']}")
 
 
 def sweep_gpt(batches, medium=False, recompute=True):

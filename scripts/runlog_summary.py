@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Render a flight-recorder JSONL journal into a run report.
+
+    python scripts/runlog_summary.py runlog.jsonl          # human report
+    python scripts/runlog_summary.py runlog.jsonl --json   # machine rollup
+
+The journal is written by `paddle_tpu.utils.flight_recorder`
+(`Model.fit(flight_recorder=...)` / `TrainStep.attach_flight_recorder`);
+schema in docs/observability.md. The report covers:
+
+  * step-time percentiles split by phase (data wait / host dispatch /
+    device execution / total),
+  * MFU and per-step FLOPs from the compiled executable's cost analysis,
+  * executable (re)compiles — a recompile mid-run is the invisible
+    latency cliff this tooling exists to surface,
+  * top collectives by payload bytes (op+group),
+  * non-finite incidents and checkpoints,
+  * run status (a `run_end {status: "crashed"}` means the tail of the
+    journal is the flight recorder doing its job).
+
+Stdlib-only on purpose: reading a journal must not require (or wait on)
+a jax import.
+"""
+import argparse
+import json
+import math
+import sys
+
+PHASES = (("data", "data_s"), ("host", "host_s"), ("device", "device_s"),
+          ("total", None))
+
+
+def load_events(path):
+    events = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError as e:
+                raise SystemExit(f"{path}:{lineno}: malformed journal "
+                                 f"line: {e}")
+    return events
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile (ceil(q/100 * n)-th value) over an
+    already-sorted list. ceil, not round: round() banker's-rounds x.5
+    to even and shifts exact-integer ranks one value high."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, min(len(sorted_vals) - 1,
+                     math.ceil(q / 100.0 * len(sorted_vals)) - 1))
+    return sorted_vals[idx]
+
+
+def _num(v):
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def _phase_values(steps, key):
+    if key is None:     # total = data + host + device
+        vals = [sum(_num(s.get(k)) or 0.0
+                    for k in ("data_s", "host_s", "device_s"))
+                for s in steps]
+    else:
+        vals = [_num(s.get(key)) for s in steps]
+    return sorted(v for v in vals if v is not None)
+
+
+def summarize(events):
+    steps = [e for e in events if e.get("ev") == "step"]
+    compiles = [e for e in events if e.get("ev") == "compile"]
+    nonfinite = [e for e in events if e.get("ev") == "nonfinite"]
+    colls = [e for e in events if e.get("ev") == "collective"]
+    run_start = next((e for e in events if e.get("ev") == "run_start"), {})
+    run_end = next((e for e in reversed(events)
+                    if e.get("ev") == "run_end"), {})
+
+    phases = {}
+    for name, key in PHASES:
+        vals = _phase_values(steps, key)
+        phases[name] = {
+            "count": len(vals),
+            "mean_ms": 1e3 * sum(vals) / len(vals) if vals else 0.0,
+            "p50_ms": 1e3 * percentile(vals, 50),
+            "p90_ms": 1e3 * percentile(vals, 90),
+            "p99_ms": 1e3 * percentile(vals, 99),
+            "max_ms": 1e3 * (vals[-1] if vals else 0.0),
+        }
+
+    mfus = sorted(m for m in (_num(s.get("mfu")) for s in steps)
+                  if m is not None and m > 0)
+    losses = [s.get("loss") for s in steps]
+    flops = next((_num(c.get("flops")) for c in reversed(compiles)
+                  if _num(c.get("flops")) is not None), None)
+
+    by_coll = {}
+    for c in colls:
+        key = (c.get("op", "?"), c.get("group", "default"))
+        agg = by_coll.setdefault(key, {"op": key[0], "group": key[1],
+                                       "calls": 0, "bytes": 0})
+        agg["calls"] += 1
+        agg["bytes"] += int(c.get("bytes", 0) or 0)
+    top_collectives = sorted(by_coll.values(), key=lambda a: -a["bytes"])
+
+    return {
+        "status": run_end.get("status", "unknown"),
+        "meta": {k: v for k, v in run_start.items()
+                 if k not in ("ev", "ts", "seq")},
+        "steps": len(steps),
+        "dropped_events": run_end.get("dropped_events", 0),
+        "phases": phases,
+        "mfu": {"mean": sum(mfus) / len(mfus) if mfus else 0.0,
+                "p50": percentile(mfus, 50),
+                "max": mfus[-1] if mfus else 0.0},
+        "step_flops": flops,
+        "compiles": sum(int(c.get("count", 1)) for c in compiles),
+        "compile_s": sum(_num(c.get("compile_s")) or 0.0 for c in compiles),
+        "nonfinite": {
+            "count": len(nonfinite),
+            "steps": [e["step"] for e in nonfinite if "step" in e][:10],
+            "sources": sorted({e.get("source", "?") for e in nonfinite}),
+        },
+        "collectives": top_collectives,
+        "checkpoints": sum(1 for e in events
+                           if e.get("ev") == "checkpoint"),
+        "last_loss": next((l for l in reversed(losses) if l is not None),
+                          None),
+    }
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{n:.0f} B"
+        n /= 1024.0
+    return f"{n:.1f} TB"
+
+
+def render(s):
+    lines = []
+    meta = " ".join(f"{k}={v}" for k, v in sorted(s["meta"].items()))
+    lines.append(f"run: status={s['status']} steps={s['steps']}"
+                 + (f" {meta}" if meta else ""))
+    if s["dropped_events"]:
+        lines.append(f"  ring overflow: {s['dropped_events']} events "
+                     "dropped before flush (raise ring_size)")
+    lines.append("")
+    lines.append("step time breakdown (ms):")
+    lines.append(f"  {'phase':<8}{'mean':>9}{'p50':>9}{'p90':>9}"
+                 f"{'p99':>9}{'max':>9}")
+    for name, _ in PHASES:
+        p = s["phases"][name]
+        lines.append(f"  {name:<8}{p['mean_ms']:>9.3f}{p['p50_ms']:>9.3f}"
+                     f"{p['p90_ms']:>9.3f}{p['p99_ms']:>9.3f}"
+                     f"{p['max_ms']:>9.3f}")
+    lines.append("")
+    m = s["mfu"]
+    lines.append(f"mfu: mean={m['mean']:.4f} p50={m['p50']:.4f} "
+                 f"max={m['max']:.4f}")
+    if s["step_flops"]:
+        lines.append(f"step flops: {s['step_flops']:.3e}")
+    lines.append(f"compiles: {s['compiles']} "
+                 f"(host time {s['compile_s']:.2f}s)"
+                 + ("  <-- recompiles mid-run!" if s["compiles"] > 1
+                    else ""))
+    nf = s["nonfinite"]
+    if nf["count"]:
+        at = ", ".join(str(x) for x in nf["steps"])
+        lines.append(f"non-finite incidents: {nf['count']} "
+                     f"(sources: {', '.join(nf['sources'])}"
+                     + (f"; steps {at}" if at else "") + ")")
+    else:
+        lines.append("non-finite incidents: 0")
+    if s["collectives"]:
+        lines.append("top collectives by bytes:")
+        for agg in s["collectives"][:8]:
+            lines.append(f"  {agg['op']}[{agg['group']}]: "
+                         f"{agg['calls']} calls, "
+                         f"{_fmt_bytes(agg['bytes'])}")
+    if s["checkpoints"]:
+        lines.append(f"checkpoints: {s['checkpoints']}")
+    if s["last_loss"] is not None:
+        lines.append(f"last loss: {s['last_loss']}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Summarize a flight-recorder JSONL journal")
+    ap.add_argument("journal", help="path to the runlog .jsonl")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable summary instead")
+    args = ap.parse_args(argv)
+    events = load_events(args.journal)
+    if not events:
+        print(f"{args.journal}: empty journal", file=sys.stderr)
+        return 2
+    summary = summarize(events)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,7 +99,9 @@ def test_monitor_stats():
     monitor.stat_set("epoch", 7)
     assert monitor.all_stats()["epoch"] == 7
     stats = monitor.device_memory_stats()
-    assert "bytes_in_use" in stats
+    # CPU jax exposes no PJRT memory stats -> None (callers skip gauges);
+    # on a real accelerator the dict carries the PJRT keys
+    assert stats is None or "bytes_in_use" in stats
 
 
 class TestOpCallStack:

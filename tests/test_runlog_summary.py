@@ -1,0 +1,92 @@
+"""scripts/runlog_summary.py smoke: the CLI renders a real generated
+journal (percentile table, MFU line, compiles, non-finite incidents) —
+tier-1 so the tooling can't silently rot."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.utils import flight_recorder as fr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "runlog_summary.py")
+
+
+def _generate_journal(path):
+    pt.seed(3)
+    net = nn.Linear(4, 3)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    step = TrainStep(net, lambda o, y: nn.functional.mse_loss(o, y), opt)
+    rec = fr.FlightRecorder(path)
+    step.attach_flight_recorder(rec)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype("f4")
+    y = rng.randn(8, 3).astype("f4")
+    xnan = x.copy()
+    xnan[0] = np.nan
+    with rec:
+        for _ in range(4):
+            step.set_data_wait(0.001)
+            step(x, y)
+        step(xnan, y)
+        rec.collective(op="all_reduce", nbytes=4096, group="dp")
+        rec.checkpoint(path="ckpt/5", step=5)
+    return path
+
+
+def test_cli_end_to_end(tmp_path):
+    journal = _generate_journal(str(tmp_path / "run.jsonl"))
+    out = subprocess.run(
+        [sys.executable, SCRIPT, journal],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    text = out.stdout
+    assert "status=ok" in text and "steps=5" in text
+    assert "p50" in text and "p99" in text          # percentile header
+    for phase in ("data", "host", "device", "total"):
+        assert phase in text
+    assert "mfu: mean=" in text                     # MFU line renders
+    assert "compiles: 1" in text
+    assert "non-finite incidents: 1" in text
+    assert "all_reduce[dp]" in text and "4.0 KB" in text
+    assert "checkpoints: 1" in text
+
+
+def test_cli_json_mode(tmp_path):
+    journal = _generate_journal(str(tmp_path / "run.jsonl"))
+    out = subprocess.run(
+        [sys.executable, SCRIPT, journal, "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout)
+    assert summary["steps"] == 5
+    assert summary["compiles"] == 1
+    assert summary["mfu"]["mean"] > 0
+    assert summary["nonfinite"]["count"] == 1
+    assert summary["phases"]["device"]["count"] == 5
+    assert summary["collectives"][0]["bytes"] == 4096
+
+
+def test_summarize_importable_without_jax_side_effects(tmp_path):
+    """The CLI module is stdlib-only: importable and usable on a bare
+    journal without pulling in paddle_tpu/jax."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import runlog_summary
+    finally:
+        sys.path.pop(0)
+    events = [{"ev": "run_start", "ts": 0, "seq": 1, "mode": "fit"},
+              {"ev": "step", "ts": 1, "seq": 2, "step": 1, "data_s": 0.01,
+               "host_s": 0.02, "device_s": 0.03, "loss": 1.0,
+               "mfu": 0.5, "nonfinite": False},
+              {"ev": "run_end", "ts": 2, "seq": 3, "status": "ok"}]
+    s = runlog_summary.summarize(events)
+    assert s["steps"] == 1 and s["status"] == "ok"
+    assert abs(s["phases"]["total"]["p50_ms"] - 60.0) < 1e-6
+    text = runlog_summary.render(s)
+    assert "mfu: mean=0.5000" in text

@@ -312,4 +312,7 @@ def test_telemetry_callback_records_step_loss_and_memory():
     assert telemetry.value("train_step_seconds") == n0 + 3
     assert telemetry.value("train_loss") == pytest.approx(0.125)
     cb.on_train_end()              # device-memory poll must not raise
-    assert telemetry.value("device_bytes_in_use") >= 0
+    # CPU jax has no PJRT memory stats: the gauge is SKIPPED (None), not
+    # published as a misleading zero; on accelerators it's >= 0
+    mem = telemetry.value("device_bytes_in_use")
+    assert mem is None or mem >= 0
