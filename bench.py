@@ -99,11 +99,15 @@ def _last_banked_tpu_result():
         return None
 
 
-def _note(msg, _t0=[None]):
+_note_t0 = None
+
+
+def _note(msg):
     """Progress to stderr (stdout is reserved for the one JSON line)."""
-    if _t0[0] is None:
-        _t0[0] = time.time()
-    print(f"[bench +{time.time()-_t0[0]:6.1f}s] {msg}",
+    global _note_t0
+    if _note_t0 is None:
+        _note_t0 = time.time()
+    print(f"[bench +{time.time()-_note_t0:6.1f}s] {msg}",
           file=sys.stderr, flush=True)
 
 

@@ -89,7 +89,9 @@ class PsServer:
                 self._lib.pt_ps_server_stop(self._h)
                 self._lib.pt_ps_server_destroy(self._h)
                 self._h = None
-        except Exception:
+        # interpreter teardown: ctypes globals may already be None'd, so
+        # ANY exception type here is shutdown noise, not a real failure
+        except Exception:   # ptlint: disable=swallowed-exception
             pass
 
 
@@ -266,7 +268,9 @@ class PsClient:
             if getattr(self, "_h", None):
                 self._lib.pt_ps_client_destroy(self._h)
                 self._h = None
-        except Exception:
+        # interpreter teardown: ctypes globals may already be None'd, so
+        # ANY exception type here is shutdown noise, not a real failure
+        except Exception:   # ptlint: disable=swallowed-exception
             pass
 
 

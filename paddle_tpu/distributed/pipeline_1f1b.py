@@ -45,6 +45,9 @@ from . import mesh as mesh_mod
 
 
 def simulate_1f1b(S, M):
+    # ptlint baseline: host-sync-in-trace findings here are
+    # grandfathered — S/M are python ints, this is trace-time static
+    # schedule precomputation (pure host numpy, no tracers enter it)
     """Host-side schedule simulation (the depth-first 1F1B rule: a stage runs
     a backward whenever one is ready, else a forward, with in-flight capped
     at S - r — ref section_worker.cc Run1F1B / Megatron's non-interleaved

@@ -139,10 +139,13 @@ class _TrainerProgram:
 
         def _finish(client=self._client, tid=t._trainer_id,
                     stop=self._stop_beat):
+            # best-effort deregistration at exit: the pserver may
+            # already be gone (OSError) or reject the late call
+            # (RuntimeError) — both are clean-shutdown noise
             try:
                 stop()
                 client.complete_worker(tid)
-            except Exception:
+            except (OSError, RuntimeError):
                 pass
         self._finish = _finish
         atexit.register(_finish)

@@ -95,7 +95,7 @@ def attach_op_context(exc, op_name, arrays=(), attrs=None, callstack=None):
     msg = str(exc.args[0]) if exc.args else ""
     try:
         exc.args = (f"{msg}\n{ctx}",) + tuple(exc.args[1:])
-    except Exception:
+    except (AttributeError, TypeError):
         pass        # exotic exception with immutable args: keep original
     return exc
 

@@ -40,7 +40,9 @@ class DatasetBase:
             if getattr(self, "_h", None):
                 self._lib.pt_feed_destroy(self._h)
                 self._h = None
-        except Exception:
+        # interpreter teardown: ctypes globals may already be None'd, so
+        # ANY exception type here is shutdown noise, not a real failure
+        except Exception:   # ptlint: disable=swallowed-exception
             pass
 
     # ------------------------------------------------------------ config

@@ -1,0 +1,20 @@
+"""ptlint — JAX-aware static analysis for paddle_tpu.
+
+    from paddle_tpu.tools import lint
+    findings = lint.lint_paths(["paddle_tpu"], repo_root=".")
+
+Rules (lint.RULES) cover tracer safety (host-sync-in-trace), compile
+stability (recompile-hazard), concurrency (lock-discipline), hygiene
+(mutable-default-arg, swallowed-exception) and the metric-name registry
+contract. `scripts/ptlint.py` is the CLI; docs/static_analysis.md is
+the rule catalog. Suppress per line with `# ptlint: disable=<rule>`;
+grandfather findings in scripts/ptlint_baseline.json (see
+lint.baseline).
+"""
+from .core import (Finding, Rule, RULES, register, lint_file, lint_paths,
+                   iter_py_files)
+from . import baseline
+from . import rules  # noqa: F401  (registers the built-in rules)
+
+__all__ = ["Finding", "Rule", "RULES", "register", "lint_file",
+           "lint_paths", "iter_py_files", "baseline"]

@@ -1,0 +1,122 @@
+"""Shared AST helpers for ptlint rules."""
+import ast
+
+
+def build_parents(tree):
+    """{child_node: parent_node} for ancestor walks (loop/with/def
+    containment). Built once per file via ctx.cached."""
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def parents_of(ctx):
+    return ctx.cached("parents", lambda: build_parents(ctx.tree))
+
+
+def ancestors(node, parents):
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)
+
+
+def last_name(node):
+    """Terminal identifier of a Name/Attribute chain ('jax.jit' -> 'jit',
+    'jit' -> 'jit'); None for anything else."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted(node):
+    """'a.b.c' for nested Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def param_names(fn):
+    a = fn.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def binds(target):
+    """Names a *binding* target introduces. `x = ...`, `x, y = ...` bind;
+    `d[k] = ...` and `o.a = ...` mutate an existing object and bind
+    NOTHING — treating them as bindings would hide exactly the writes
+    the lock/trace rules exist to catch."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            yield from binds(el)
+    elif isinstance(target, ast.Starred):
+        yield from binds(target.value)
+
+
+def assigned_names(fn):
+    """Plain-Name bindings inside a function def (its own subtree,
+    nested defs included — over-approximate shadow detection)."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                out.update(binds(t))
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            out.update(binds(node.target))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    out.update(binds(item.optional_vars))
+        elif isinstance(node, FUNC_DEFS):
+            if node is not fn:
+                out.add(node.name)
+            out.update(param_names(node))
+    return out
+
+
+def global_names(fn):
+    """Names declared `global` anywhere inside the function subtree."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                    ast.SetComp, ast.DictComp)
+MUTABLE_FACTORIES = {"list", "dict", "set", "defaultdict", "OrderedDict",
+                     "deque", "Counter", "bytearray"}
+
+
+def is_mutable_value(node):
+    """True for expressions that construct a mutable container."""
+    if isinstance(node, MUTABLE_LITERALS):
+        return True
+    if isinstance(node, ast.Call) and \
+            last_name(node.func) in MUTABLE_FACTORIES:
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        # [0] * n / n * [0]
+        return is_mutable_value(node.left) or is_mutable_value(node.right)
+    return False

@@ -1,0 +1,95 @@
+"""ptlint baseline: grandfathered findings, committed next to the CLI.
+
+The baseline is a JSON list of finding identities — (rule, path,
+message) plus an occurrence count and a REQUIRED one-line justification
+per entry. `diff` subtracts baselined occurrences from a run's
+findings; anything left over is new and fails the lint. Counts matter:
+a baselined fingerprint hides exactly `count` occurrences, so adding a
+second instance of a grandfathered pattern to the same file still
+fails.
+
+`update` rewrites the baseline from a run, preserving justifications of
+surviving entries and stamping new ones with a TODO marker the clean-run
+check rejects — a baseline entry cannot land undocumented.
+"""
+import collections
+import json
+
+TODO_JUSTIFICATION = "TODO: justify this grandfathered finding"
+
+
+def load(path):
+    """-> list of entry dicts (rule/path/message/count/justification).
+    Missing file -> empty baseline."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return []
+    if isinstance(data, dict):
+        data = data.get("findings", [])
+    out = []
+    for e in data:
+        out.append({
+            "rule": e["rule"], "path": e["path"], "message": e["message"],
+            "count": int(e.get("count", 1)),
+            "justification": e.get("justification",
+                                   TODO_JUSTIFICATION),
+        })
+    return out
+
+
+def _key(entry_or_finding):
+    e = entry_or_finding
+    if isinstance(e, dict):
+        return (e["rule"], e["path"], e["message"])
+    return (e.rule, e.path, e.message)
+
+
+def diff(findings, entries):
+    """(new_findings, suppressed_count): subtract up to `count`
+    occurrences of each baselined identity; later (higher-line)
+    occurrences survive as new."""
+    budget = collections.Counter()
+    for e in entries:
+        budget[_key(e)] += e["count"]
+    new, suppressed = [], 0
+    for fd in findings:         # lint_paths yields line-sorted findings
+        k = _key(fd)
+        if budget[k] > 0:
+            budget[k] -= 1
+            suppressed += 1
+        else:
+            new.append(fd)
+    return new, suppressed
+
+
+def undocumented(entries):
+    """Entries whose justification is missing/TODO — the clean-run
+    contract rejects these even when the diff is empty."""
+    return [e for e in entries
+            if not e.get("justification")
+            or e["justification"] == TODO_JUSTIFICATION]
+
+
+def update(findings, old_entries, path, keep=()):
+    """Write a fresh baseline covering exactly `findings`, carrying
+    justifications over from `old_entries` where the identity survives.
+    `keep` preserves entries a SCOPED run (--select / narrowed paths)
+    could not have reproduced — without it a partial run would silently
+    delete every out-of-scope grandfathered entry and its justification."""
+    just = {_key(e): e["justification"] for e in old_entries}
+    counts = collections.Counter(_key(fd) for fd in findings)
+    entries = [dict(e) for e in keep if _key(e) not in counts]
+    for (rule, rel, message), count in sorted(counts.items()):
+        entries.append({
+            "rule": rule, "path": rel, "message": message, "count": count,
+            "justification": just.get((rule, rel, message),
+                                      TODO_JUSTIFICATION),
+        })
+    entries.sort(key=_key)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": entries}, f, indent=2,
+                  sort_keys=True)
+        f.write("\n")
+    return entries

@@ -1,0 +1,188 @@
+"""ptlint core: rule registry, file context, suppression handling.
+
+A rule is a class with an `id`, a `check(ctx)` generator yielding
+`Finding`s, and a one-line `rationale`. Rules register themselves with
+`@register`; `lint_paths` parses each file ONCE and hands the shared
+`FileContext` to every (selected) rule, so a full-repo run stays
+AST-parse-bound (~hundreds of files, well under the 10 s budget).
+
+Suppressions: a `# ptlint: disable=rule-a,rule-b` trailing comment on
+the flagged line silences those rules there; bare `# ptlint: disable`
+silences every rule on that line. Messages carry no line numbers so a
+finding's identity (rule, path, message) survives unrelated edits —
+that identity is what the baseline (baseline.py) matches on.
+"""
+import ast
+import os
+import re
+
+
+class Finding:
+    """One lint hit. `message` must be stable across unrelated edits
+    (no line numbers / volatile state inside) — the baseline fingerprint
+    is (rule, path, message)."""
+
+    __slots__ = ("rule", "path", "line", "col", "message")
+
+    def __init__(self, rule, path, line, col, message):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+
+    @property
+    def fingerprint(self):
+        return f"{self.rule}::{self.path}::{self.message}"
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+    def render(self):
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] " \
+               f"{self.message}"
+
+    def __repr__(self):
+        return f"Finding({self.render()!r})"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ptlint:\s*disable(?:=([A-Za-z0-9_,\- ]+))?")
+
+
+def parse_suppressions(src):
+    """{lineno: frozenset(rule_ids) | None} — None means all rules."""
+    out = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[i] = None
+        else:
+            rules = frozenset(
+                r.strip() for r in m.group(1).split(",") if r.strip())
+            out[i] = rules or None
+    return out
+
+
+class FileContext:
+    """Everything rules need about one file, parsed once."""
+
+    def __init__(self, path, rel, src, tree, repo_root):
+        self.path = path            # absolute
+        self.rel = rel              # repo-relative, '/'-separated
+        self.src = src
+        self.tree = tree
+        self.repo_root = repo_root
+        self.suppressions = parse_suppressions(src)
+        # a suppression on a `def`/`class` line covers the whole body
+        # (one annotation instead of one per finding — trace-time
+        # precomputation helpers use this)
+        self.ranges = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) \
+                    and node.lineno in self.suppressions:
+                self.ranges.append((node.lineno, node.end_lineno,
+                                    self.suppressions[node.lineno]))
+        self._cache = {}            # rule modules share derived analyses
+
+    def cached(self, key, build):
+        if key not in self._cache:
+            self._cache[key] = build()
+        return self._cache[key]
+
+    def suppressed(self, line, rule_id):
+        rules = self.suppressions.get(line, False)
+        if rules is not False and (rules is None or rule_id in rules):
+            return True
+        for start, end, rules in self.ranges:
+            if start <= line <= end \
+                    and (rules is None or rule_id in rules):
+                return True
+        return False
+
+    def finding(self, rule_id, node, message):
+        return Finding(rule_id, self.rel, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+
+class Rule:
+    id = None
+    rationale = ""
+
+    def check(self, ctx):
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
+RULES = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the registry."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    RULES[inst.id] = inst
+    return cls
+
+
+def iter_py_files(paths):
+    for root in paths:
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".jax_cache"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_file(path, repo_root, select=None):
+    """Findings for one file (suppressions already applied).
+
+    A file that fails to parse (or read/decode) yields one
+    `parse-error` finding instead of aborting the run — the CLI still
+    exits 1 on it."""
+    rel = os.path.relpath(os.path.abspath(path),
+                          os.path.abspath(repo_root)).replace(os.sep, "/")
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding("parse-error", rel, 1, 0,
+                        f"cannot read: {type(e).__name__}")]
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("parse-error", rel, e.lineno or 1, 0,
+                        f"cannot parse: {e.msg}")]
+    ctx = FileContext(path, rel, src, tree, repo_root)
+    findings = []
+    for rule_id, rule in sorted(RULES.items()):
+        if select is not None and rule_id not in select:
+            continue
+        for fd in rule.check(ctx):
+            if not ctx.suppressed(fd.line, fd.rule):
+                findings.append(fd)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(paths, repo_root, select=None):
+    if select is not None:
+        unknown = set(select) - set(RULES)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+    findings = []
+    for path in iter_py_files(paths):
+        findings.extend(lint_file(path, repo_root, select))
+    return findings

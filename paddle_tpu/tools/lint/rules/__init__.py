@@ -1,0 +1,2 @@
+"""Importing this package registers every built-in ptlint rule."""
+from . import hygiene, locks, metric_names, tracer  # noqa: F401

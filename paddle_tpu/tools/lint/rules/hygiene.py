@@ -1,0 +1,105 @@
+"""General Python-hygiene rules: mutable-default-arg and
+swallowed-exception.
+
+Both patterns have bitten JAX codebases in characteristic ways: a
+mutable default shared across calls becomes cross-request state in a
+serving loop, and a silent broad `except` hides exactly the non-finite /
+device-error signals the flight recorder exists to journal.
+"""
+import ast
+
+from ..core import Rule, register
+from .. import astutil
+from ..astutil import FUNC_DEFS, last_name
+
+
+@register
+class MutableDefaultArg(Rule):
+    id = "mutable-default-arg"
+    rationale = ("A mutable default is created once at def time and "
+                 "shared by every call — state leaks across requests/"
+                 "steps. Default to None and construct inside.")
+
+    def check(self, ctx):
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, FUNC_DEFS + (ast.Lambda,)):
+                continue
+            a = fn.args
+            pos = list(a.posonlyargs) + list(a.args)
+            for param, default in zip(pos[len(pos) - len(a.defaults):],
+                                      a.defaults):
+                yield from self._check(ctx, fn, param, default)
+            for param, default in zip(a.kwonlyargs, a.kw_defaults):
+                if default is not None:
+                    yield from self._check(ctx, fn, param, default)
+
+    def _check(self, ctx, fn, param, default):
+        if astutil.is_mutable_value(default):
+            name = getattr(fn, "name", "<lambda>")
+            yield ctx.finding(
+                self.id, default,
+                f"mutable default for parameter '{param.arg}' of "
+                f"'{name}' is shared across calls; use None and build "
+                "it inside")
+
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler):
+    t = handler.type
+    if t is None:
+        return True                             # bare except:
+    if last_name(t) in BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(last_name(el) in BROAD for el in t.elts)
+    return False
+
+
+def _handles(body):
+    """True when the handler body does SOMETHING with the error: any
+    raise, call (log/journal/cleanup), return/yield, or assignment —
+    i.e. anything beyond pass/continue/constant-expression filler."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Return, ast.Yield,
+                                 ast.YieldFrom, ast.Call, ast.Assign,
+                                 ast.AugAssign, ast.AnnAssign,
+                                 ast.Break)):
+                return True
+    return False
+
+
+@register
+class SwallowedException(Rule):
+    id = "swallowed-exception"
+    rationale = ("`except: pass` over a broad type hides the failures "
+                 "observability exists to surface (non-finite steps, "
+                 "device errors) — narrow the type, journal, or re-raise.")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _handles(node.body):
+                # bare `except:` additionally eats KeyboardInterrupt/
+                # SystemExit — flag it even when handled, unless the
+                # handler re-raises
+                if node.type is None and not any(
+                        isinstance(n, ast.Raise)
+                        for s in node.body for n in ast.walk(s)):
+                    yield ctx.finding(
+                        self.id, node,
+                        "bare 'except:' also catches KeyboardInterrupt/"
+                        "SystemExit; catch Exception (or narrower)")
+                continue
+            what = "bare 'except:'" if node.type is None else \
+                f"broad 'except {last_name(node.type) or '...'}'"
+            yield ctx.finding(
+                self.id, node,
+                f"{what} swallows the error silently (no re-raise, log, "
+                "journal, or handling); narrow the exception or record "
+                "it")

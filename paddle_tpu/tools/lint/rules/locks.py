@@ -1,0 +1,157 @@
+"""lock-discipline: module-level shared state must be written under a
+lock, in modules that adopted locking.
+
+The telemetry/monitor/flight-recorder/profiler layer and the serving
+stack are driven from producer threads (metrics exporter, scheduler
+submit(), DataLoader workers); their module-level registries are the
+shared state. The contract this rule enforces: once a module declares a
+module-level threading.Lock/RLock, EVERY function-scope write to its
+module-level mutable containers — and every `global` rebind — happens
+inside a `with <lock>:` block. Modules without a module-level lock are
+out of scope (they opted out of cross-thread mutation entirely).
+
+Import-time writes (module top level) run single-threaded and are
+exempt. Attribute writes on module globals (e.g. `_tl.stack = []` on a
+threading.local) are exempt: thread-locals are the sanctioned lock-free
+idiom.
+"""
+import ast
+
+from ..core import Rule, register
+from .. import astutil
+from ..astutil import FUNC_DEFS, last_name
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore"}
+MUTATOR_METHODS = {"append", "appendleft", "extend", "insert", "add",
+                   "update", "pop", "popleft", "popitem", "remove",
+                   "discard", "clear", "setdefault"}
+
+
+def _module_bindings(tree):
+    """(mutables, globals_, locks) — module-level simple Name targets."""
+    mutables, globals_, locks = set(), set(), set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            globals_.add(name)
+            if astutil.is_mutable_value(node.value):
+                mutables.add(name)
+            if isinstance(node.value, ast.Call) \
+                    and last_name(node.value.func) in LOCK_FACTORIES:
+                locks.add(name)
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            globals_.add(node.target.id)
+            if node.value is not None:
+                if astutil.is_mutable_value(node.value):
+                    mutables.add(node.target.id)
+                if isinstance(node.value, ast.Call) \
+                        and last_name(node.value.func) in LOCK_FACTORIES:
+                    locks.add(node.target.id)
+    return mutables, globals_, locks
+
+
+def _looks_like_lock(expr, locks):
+    """`with <expr>:` guards shared state? Module lock names match
+    exactly; anything whose terminal identifier mentions 'lock' or
+    'mutex' (self._lock, _install_lock) counts too."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func    # with lock_factory() / lock.acquire_ctx()
+    name = last_name(expr)
+    if name is None:
+        return False
+    return name in locks or "lock" in name.lower() or "mutex" in name.lower()
+
+
+def _under_lock(node, parents, locks):
+    for anc in astutil.ancestors(node, parents):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            if any(_looks_like_lock(item.context_expr, locks)
+                   for item in anc.items):
+                return True
+        if isinstance(anc, FUNC_DEFS):
+            return False
+    return False
+
+
+@register
+class LockDiscipline(Rule):
+    id = "lock-discipline"
+    rationale = ("Unlocked writes to module-level shared state race "
+                 "against the metrics exporter / producer threads; lost "
+                 "updates corrupt counters silently.")
+
+    def check(self, ctx):
+        mutables, globals_, locks = _module_bindings(ctx.tree)
+        if not locks:
+            return
+        parents = astutil.parents_of(ctx)
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, FUNC_DEFS):
+                continue
+            # only report in the def that immediately owns the statement
+            # (nested defs are visited on their own)
+            yield from self._scan_fn(ctx, fn, parents, mutables,
+                                     globals_, locks)
+
+    def _scan_fn(self, ctx, fn, parents, mutables, globals_, locks):
+        fn_globals = astutil.global_names(fn)
+        shadowed = (set(astutil.param_names(fn))
+                    | astutil.assigned_names(fn)) - fn_globals
+
+        def owner(node):
+            for anc in astutil.ancestors(node, parents):
+                if isinstance(anc, FUNC_DEFS):
+                    return anc
+            return None
+
+        def is_module_mutable(name_node):
+            return (isinstance(name_node, ast.Name)
+                    and name_node.id in mutables
+                    and name_node.id not in shadowed)
+
+        for node in ast.walk(fn):
+            if owner(node) is not fn:
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    if isinstance(t, ast.Subscript) \
+                            and is_module_mutable(t.value) \
+                            and not _under_lock(node, parents, locks):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"write to module-level mutable "
+                            f"'{t.value.id}' outside a lock (module "
+                            "declares one; wrap in `with <lock>:`)")
+                    elif isinstance(t, ast.Name) and t.id in fn_globals \
+                            and t.id in globals_ and t.id not in locks \
+                            and not _under_lock(node, parents, locks):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"module global '{t.id}' rebound outside a "
+                            "lock (module declares one; wrap in `with "
+                            "<lock>:`)")
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and is_module_mutable(t.value) \
+                            and not _under_lock(node, parents, locks):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"del on module-level mutable "
+                            f"'{t.value.id}' outside a lock (module "
+                            "declares one; wrap in `with <lock>:`)")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in MUTATOR_METHODS \
+                    and is_module_mutable(node.func.value) \
+                    and not _under_lock(node, parents, locks):
+                yield ctx.finding(
+                    self.id, node,
+                    f".{node.func.attr}() on module-level mutable "
+                    f"'{node.func.value.id}' outside a lock (module "
+                    "declares one; wrap in `with <lock>:`)")

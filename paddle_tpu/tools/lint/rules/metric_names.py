@@ -1,0 +1,99 @@
+"""metric-name: every literal metric name at a monitor/telemetry call
+site is snake_case AND cataloged in docs/observability.md.
+
+Rebased from scripts/check_metric_names.py (which is now a thin shim
+over this rule): the doc IS the metric registry of record — adding a
+metric means documenting it, and /metrics cannot silently grow
+undocumented or Prometheus-hostile names. Simple module-level
+NAME = "literal" constants are resolved (serving/metrics.py declares
+its monitor keys that way); dynamic names are out of scope.
+"""
+import ast
+import os
+import re
+
+from ..core import Rule, register
+from ..astutil import last_name
+
+METRIC_FUNCS = {"stat_add", "stat_set", "stat_max", "stat_get",
+                "counter", "gauge", "histogram",
+                "Counter", "Gauge", "Histogram"}
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+BACKTICK_RE = re.compile(r"`([A-Za-z0-9_]+)`")
+
+_CATALOG_CACHE = {}      # path -> (mtime_ns, names)
+
+
+def catalog_path(repo_root):
+    return os.path.join(repo_root, "docs", "observability.md")
+
+
+def registered_names(repo_root):
+    """Allowlist: every backticked identifier in docs/observability.md.
+    None (not empty set) when the catalog is missing — rules and the
+    shim distinguish 'no registry here' from 'registry rejects this'.
+    Cached per (path, mtime), so a long-lived process that edits the
+    catalog between lint_paths() calls sees the fresh registry."""
+    path = os.path.abspath(catalog_path(repo_root))
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        _CATALOG_CACHE.pop(path, None)
+        return None
+    cached = _CATALOG_CACHE.get(path)
+    if cached is None or cached[0] != mtime:
+        try:
+            with open(path, encoding="utf-8") as f:
+                names = set(BACKTICK_RE.findall(f.read()))
+        except OSError:
+            return None
+        _CATALOG_CACHE[path] = cached = (mtime, names)
+    return cached[1]
+
+
+def module_consts(tree):
+    """Module-level NAME = "literal" string assignments."""
+    consts = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+    return consts
+
+
+def metric_call_sites(tree):
+    """Yield (node, metric_name) for every lintable call in the tree."""
+    consts = module_consts(tree)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and last_name(node.func) in METRIC_FUNCS and node.args):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            yield node, arg.value
+        elif isinstance(arg, ast.Name) and arg.id in consts:
+            yield node, consts[arg.id]
+
+
+@register
+class MetricName(Rule):
+    id = "metric-name"
+    rationale = ("docs/observability.md is the metric registry of "
+                 "record; undocumented or non-snake_case names corrupt "
+                 "the /metrics contract silently.")
+
+    def check(self, ctx):
+        allow = registered_names(ctx.repo_root)
+        for node, name in metric_call_sites(ctx.tree):
+            if not NAME_RE.match(name):
+                yield ctx.finding(
+                    self.id, node,
+                    f"metric name {name!r} is not snake_case "
+                    "([a-z][a-z0-9_]*)")
+            elif allow is not None and name not in allow:
+                yield ctx.finding(
+                    self.id, node,
+                    f"metric name {name!r} is not registered in "
+                    "docs/observability.md")

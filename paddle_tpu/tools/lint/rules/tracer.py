@@ -1,0 +1,465 @@
+"""Tracer-safety rules: host-sync-in-trace and recompile-hazard.
+
+Both rules share one per-file analysis: the set of TRACED function
+defs — functions that jax traces and compiles, so their Python bodies
+run once per compilation, not once per call, and any host interaction
+inside them is either a silent no-op, a per-step device->host stall, or
+a recompile trigger ("Operator Fusion in XLA" finds exactly these two
+pathologies dominating JAX performance regressions).
+
+Traced roots, module-locally:
+  * defs decorated with jit/pjit/pmap (directly, as a call, or through
+    functools.partial(jax.jit, ...));
+  * local function names passed to jit/pjit/pmap/grad/value_and_grad/
+    vmap/checkpoint/remat or to lax control flow (scan/cond/while_loop/
+    fori_loop/switch) — `self._compiled = jax.jit(_step, ...)` marks
+    `_step`;
+  * calls made inside a lambda handed to one of those wrappers.
+
+From the roots the analysis closes transitively over module-local
+callees by name (decode_wave -> helper -> ...). Cross-module reachability
+is out of scope — the hot subsystems keep their traced helpers local,
+which is also the layout this rule rewards.
+"""
+import ast
+
+from ..core import Rule, register
+from .. import astutil
+from ..astutil import FUNC_DEFS, last_name
+
+TRACE_WRAPPERS = {"jit", "pjit", "pmap"}
+TRACE_CONSUMERS = TRACE_WRAPPERS | {
+    "grad", "value_and_grad", "vmap", "checkpoint", "remat",
+    "scan", "cond", "while_loop", "fori_loop", "switch",
+    "custom_vjp", "custom_jvp",
+}
+
+# attribute calls that force a device->host transfer / sync
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# module.attr calls that materialize traced values on host
+HOST_MATERIALIZERS = {("np", "asarray"), ("np", "array"),
+                      ("numpy", "asarray"), ("numpy", "array"),
+                      ("onp", "asarray"), ("onp", "array")}
+# shape/metadata accesses that make a float()/int() cast trace-safe
+STATIC_ATTRS = {"shape", "ndim", "size", "itemsize", "dtype", "maxlen"}
+STATIC_FUNCS = {"len", "range", "ord", "min", "max", "round", "prod",
+                "id", "hash", "isinstance", "getattr"}
+
+
+def _is_trace_wrapper(node, names):
+    """`node` (a decorator or call func) denotes one of `names`?"""
+    if last_name(node) in names:
+        return True
+    if isinstance(node, ast.Call):
+        # @jax.jit(...) / @partial(jax.jit, static_argnums=...)
+        if last_name(node.func) in names:
+            return True
+        if last_name(node.func) == "partial" and node.args \
+                and last_name(node.args[0]) in names:
+            return True
+    return False
+
+
+def _local_defs(tree):
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, FUNC_DEFS):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _enclosing_fn(node, parents):
+    for anc in astutil.ancestors(node, parents):
+        if isinstance(anc, FUNC_DEFS):
+            return anc
+    return None
+
+
+def _resolve(name, ref_node, defs, parents):
+    """Defs a bare-Name reference plausibly binds to. A name defined in
+    a function enclosing the reference shadows same-named defs elsewhere
+    (ServingEngine's traced `decode_wave` closure vs. its host-side
+    `decode_wave` method) — prefer lexically-visible candidates."""
+    cands = defs.get(name, [])
+    if len(cands) < 2:
+        return cands
+    chain = set()
+    for anc in astutil.ancestors(ref_node, parents):
+        if isinstance(anc, FUNC_DEFS):
+            chain.add(anc)
+    scoped = [d for d in cands if _enclosing_fn(d, parents) in chain
+              and _enclosing_fn(d, parents) is not None]
+    return scoped or cands
+
+
+def traced_analysis(ctx):
+    """-> (traced_defs: set of def nodes, jit_calls: list of jit/pjit
+    Call nodes). Cached on the file context; both rules consume it.
+    Only bare-Name references resolve to local defs — `jnp.searchsorted`
+    must not mark a same-named module wrapper as traced."""
+    def build():
+        tree = ctx.tree
+        defs = _local_defs(tree)
+        parents = astutil.parents_of(ctx)
+        roots, jit_calls = [], []
+        for node in ast.walk(tree):
+            if isinstance(node, FUNC_DEFS):
+                if any(_is_trace_wrapper(d, TRACE_WRAPPERS)
+                       for d in node.decorator_list):
+                    roots.append(node)
+            elif isinstance(node, ast.Call) \
+                    and last_name(node.func) in TRACE_CONSUMERS:
+                if last_name(node.func) in TRACE_WRAPPERS:
+                    jit_calls.append(node)
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        roots.extend(_resolve(arg.id, node, defs, parents))
+                    elif isinstance(arg, ast.Lambda):
+                        for sub in ast.walk(arg.body):
+                            if isinstance(sub, ast.Call) \
+                                    and isinstance(sub.func, ast.Name):
+                                roots.extend(_resolve(sub.func.id, sub,
+                                                      defs, parents))
+        traced = set()
+        work = list(roots)
+        while work:
+            fn = work.pop()
+            if fn in traced:
+                continue
+            traced.add(fn)
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Name):
+                    for cand in _resolve(sub.func.id, sub, defs, parents):
+                        if cand not in traced:
+                            work.append(cand)
+        return traced, jit_calls
+
+    return ctx.cached("traced_analysis", build)
+
+
+def outermost_traced(ctx):
+    """Traced defs that are not nested inside another traced def —
+    walking only these visits every traced statement exactly once."""
+    traced, _ = traced_analysis(ctx)
+    parents = astutil.parents_of(ctx)
+    out = []
+    for fn in traced:
+        if not any(a in traced for a in astutil.ancestors(fn, parents)):
+            out.append(fn)
+    return sorted(out, key=lambda n: n.lineno)
+
+
+def _is_static_expr(node):
+    """Expression whose value is known at trace time (shapes, lengths,
+    python constants) — casting those to float/int/bool is fine."""
+    if isinstance(node, ast.Constant):
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in STATIC_ATTRS:
+            return True
+        if isinstance(sub, ast.Call) \
+                and last_name(sub.func) in STATIC_FUNCS:
+            return True
+    return False
+
+
+@register
+class HostSyncInTrace(Rule):
+    id = "host-sync-in-trace"
+    rationale = ("Device->host transfers (float()/int()/.item()/"
+                 "np.asarray) inside jit-traced code stall the device "
+                 "pipeline every step, and print() runs at trace time "
+                 "only — both break the compiled hot path silently.")
+
+    def check(self, ctx):
+        for fn in outermost_traced(ctx):
+            yield from self._scan(ctx, fn)
+
+    @staticmethod
+    def _is_config_flag(ctx, call, arg):
+        """float()/int()/bool() on a parameter whose default is a python
+        constant — a config flag, static at trace time, not a tracer."""
+        if not isinstance(arg, ast.Name):
+            return False
+        parents = astutil.parents_of(ctx)
+        owner = _enclosing_fn(call, parents)
+        while owner is not None:
+            a = owner.args
+            pos = list(a.posonlyargs) + list(a.args)
+            for param, default in zip(pos[len(pos) - len(a.defaults):],
+                                      a.defaults):
+                if param.arg == arg.id \
+                        and isinstance(default, ast.Constant):
+                    return True
+            for param, default in zip(a.kwonlyargs, a.kw_defaults):
+                if param.arg == arg.id \
+                        and isinstance(default, ast.Constant):
+                    return True
+            if arg.id in astutil.param_names(owner):
+                return False        # a non-defaulted param: assume traced
+            owner = _enclosing_fn(owner, parents)
+        return False
+
+    def _scan(self, ctx, fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = last_name(node.func)
+            if callee == "print":
+                yield ctx.finding(
+                    self.id, node,
+                    f"print() inside traced function '{fn.name}' runs at "
+                    "trace time only; use jax.debug.print or hoist it")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in SYNC_METHODS:
+                yield ctx.finding(
+                    self.id, node,
+                    f".{node.func.attr}() inside traced function "
+                    f"'{fn.name}' forces a device->host sync per step")
+            elif callee == "device_get":
+                yield ctx.finding(
+                    self.id, node,
+                    f"jax.device_get inside traced function '{fn.name}' "
+                    "forces a device->host transfer per step")
+            elif isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and (node.func.value.id, node.func.attr) \
+                    in HOST_MATERIALIZERS:
+                yield ctx.finding(
+                    self.id, node,
+                    f"{node.func.value.id}.{node.func.attr}() inside "
+                    f"traced function '{fn.name}' materializes a traced "
+                    "value on host (use jnp, or hoist the conversion)")
+            elif callee in ("float", "int", "bool") \
+                    and isinstance(node.func, ast.Name) \
+                    and len(node.args) == 1 and not node.keywords \
+                    and not _is_static_expr(node.args[0]) \
+                    and not self._is_config_flag(ctx, node, node.args[0]):
+                yield ctx.finding(
+                    self.id, node,
+                    f"{callee}() on a (possibly traced) value inside "
+                    f"traced function '{fn.name}' concretizes at trace "
+                    "time or syncs; keep it a jax array")
+
+
+def _loop_bound(loop):
+    """Names (re)bound inside a loop body (incl. the loop target)."""
+    out = set()
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+def _static_indices(call):
+    """(argnums: set[int], argnames: set[str]) from a jit call's
+    static_argnums/static_argnames keywords (literal forms only)."""
+    nums, names = set(), set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, int):
+                    nums.add(sub.value)
+        elif kw.arg == "static_argnames":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str):
+                    names.add(sub.value)
+    return nums, names
+
+
+def _target_key(parents, call):
+    """Where the jit-wrapped callable lands: Assign target Name ('f') or
+    attribute ('.attr' for self._f = jax.jit(...)); None otherwise."""
+    parent = parents.get(call)
+    # unwrap instrument_jit(jax.jit(...), label)-style wrappers
+    while isinstance(parent, ast.Call):
+        call = parent
+        parent = parents.get(parent)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        tgt = parent.targets[0]
+        if isinstance(tgt, ast.Name):
+            return ("name", tgt.id)
+        if isinstance(tgt, ast.Attribute):
+            return ("attr", tgt.attr)
+    return None
+
+
+@register
+class RecompileHazard(Rule):
+    id = "recompile-hazard"
+    rationale = ("A jit wrapper built per iteration, a traced function "
+                 "mutating (or formatting) Python state, or an unhashable "
+                 "value in a static argument each force XLA to retrace/"
+                 "recompile silently — the dominant JAX perf pathology.")
+
+    def check(self, ctx):
+        parents = astutil.parents_of(ctx)
+        traced, jit_calls = traced_analysis(ctx)
+        yield from self._jit_in_loop(ctx, parents, jit_calls)
+        yield from self._jit_on_method(ctx, parents)
+        yield from self._static_arg_literals(ctx, parents, jit_calls)
+        module_mutables = self._module_mutables(ctx)
+        for fn in outermost_traced(ctx):
+            yield from self._trace_side_effects(ctx, fn, module_mutables)
+
+    # --- jax.jit(...) evaluated inside a loop -> new wrapper, new cache
+    def _jit_in_loop(self, ctx, parents, jit_calls):
+        for call in jit_calls:
+            for anc in astutil.ancestors(call, parents):
+                if isinstance(anc, FUNC_DEFS + (ast.Lambda,)):
+                    break
+                if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                    # jitting a DIFFERENT function each iteration (a
+                    # bench sweep over CASES) is one compile per function
+                    # — only a loop-invariant target is the hazard
+                    if call.args and isinstance(call.args[0], ast.Name) \
+                            and call.args[0].id in _loop_bound(anc):
+                        break
+                    yield ctx.finding(
+                        self.id, call,
+                        "jit wrapper constructed inside a loop: every "
+                        "iteration builds a fresh callable with an empty "
+                        "compile cache; hoist the jit() out of the loop")
+                    break
+
+    # --- @jax.jit on an instance method retraces per instance
+    def _jit_on_method(self, ctx, parents):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, FUNC_DEFS):
+                continue
+            if not any(_is_trace_wrapper(d, TRACE_WRAPPERS)
+                       for d in node.decorator_list):
+                continue
+            args = node.args.posonlyargs + node.args.args
+            if args and args[0].arg in ("self", "cls") \
+                    and isinstance(parents.get(node), ast.ClassDef):
+                yield ctx.finding(
+                    self.id, node,
+                    f"@jit on method '{node.name}': self is a jit "
+                    "argument, so every instance (and mutation) "
+                    "retraces; jit a closure in __init__ instead")
+
+    # --- list/dict/set literals fed to static argument positions
+    def _static_arg_literals(self, ctx, parents, jit_calls):
+        targets = {}        # key -> (argnums, argnames)
+        for call in jit_calls:
+            nums, names = _static_indices(call)
+            if not nums and not names:
+                continue
+            key = _target_key(parents, call)
+            if key is not None:
+                targets[key] = (nums, names)
+            parent = parents.get(call)
+            if isinstance(parent, ast.Call) and parent.func is call:
+                # jax.jit(f, static_argnums=...)(args) called in place
+                yield from self._check_static_call(ctx, parent, nums,
+                                                   names)
+        if not targets:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name):
+                key = ("name", node.func.id)
+            elif isinstance(node.func, ast.Attribute):
+                key = ("attr", node.func.attr)
+            else:
+                continue
+            if key in targets:
+                nums, names = targets[key]
+                yield from self._check_static_call(ctx, node, nums, names)
+
+    def _check_static_call(self, ctx, call, nums, names):
+        for i, arg in enumerate(call.args):
+            if i in nums and astutil.is_mutable_value(arg):
+                yield ctx.finding(
+                    self.id, arg,
+                    f"unhashable container literal passed in static "
+                    f"argument position {i}: jit static args must be "
+                    "hashable and every new value recompiles")
+        for kw in call.keywords:
+            if kw.arg in names and astutil.is_mutable_value(kw.value):
+                yield ctx.finding(
+                    self.id, kw.value,
+                    f"unhashable container literal passed for static "
+                    f"argument '{kw.arg}': jit static args must be "
+                    "hashable and every new value recompiles")
+
+    def _module_mutables(self, ctx):
+        out = set()
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and astutil.is_mutable_value(node.value):
+                out.add(node.targets[0].id)
+        return out
+
+    # --- python side effects captured by the trace
+    def _trace_side_effects(self, ctx, fn, module_mutables):
+        parents = astutil.parents_of(ctx)
+        shadowed = astutil.assigned_names(fn)
+        params = set(astutil.param_names(fn))
+        for sub in ast.walk(fn):
+            if isinstance(sub, FUNC_DEFS):
+                params.update(astutil.param_names(sub))
+
+        def closed_over_mutable(name_node):
+            return (isinstance(name_node, ast.Name)
+                    and name_node.id in module_mutables
+                    and name_node.id not in shadowed)
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    if isinstance(t, ast.Subscript) \
+                            and closed_over_mutable(t.value):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"traced function '{fn.name}' writes into "
+                            f"closed-over module-level "
+                            f"'{t.value.id}': the mutation happens at "
+                            "trace time only and is silently skipped on "
+                            "compiled calls")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("append", "extend", "add",
+                                           "update", "insert",
+                                           "setdefault", "pop", "clear",
+                                           "remove") \
+                    and closed_over_mutable(node.func.value):
+                yield ctx.finding(
+                    self.id, node,
+                    f"traced function '{fn.name}' mutates closed-over "
+                    f"module-level '{node.func.value.id}' via "
+                    f".{node.func.attr}(): the mutation happens at trace "
+                    "time only and is silently skipped on compiled calls")
+            elif isinstance(node, ast.JoinedStr):
+                # f-strings under a raise are trace-time validation —
+                # formatting there is deliberate and runs once
+                if any(isinstance(a, ast.Raise)
+                       for a in astutil.ancestors(node, parents)):
+                    continue
+                for part in node.values:
+                    if isinstance(part, ast.FormattedValue) \
+                            and isinstance(part.value, ast.Name) \
+                            and part.value.id in params:
+                        yield ctx.finding(
+                            self.id, node,
+                            f"f-string in traced function '{fn.name}' "
+                            f"formats parameter '{part.value.id}': a "
+                            "traced value concretizes (or bakes) at "
+                            "trace time — feeding it onward (e.g. into "
+                            "static args) recompiles every call")
+                        break

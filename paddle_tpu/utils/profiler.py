@@ -91,22 +91,25 @@ def start_profiler(state="All", tracer_option="Default", trace_dir=None):
         _events.clear()
         _raw_events.clear()
         _trace_gen += 1
-    _enabled = True
+        _enabled = True
     if trace_dir is not None:
         import jax
         jax.profiler.start_trace(trace_dir)
-        _active_trace_dir = trace_dir
+        with _lock:
+            _active_trace_dir = trace_dir
 
 
 def stop_profiler(sorted_key="total", profile_path=None):
     """ref DisableProfiler. Prints the aggregated per-event table; writes a
     chrome trace json when profile_path is given (tools/timeline.py analog)."""
     global _enabled, _active_trace_dir
-    _enabled = False
+    with _lock:
+        _enabled = False
     if _active_trace_dir is not None:
         import jax
         jax.profiler.stop_trace()
-        _active_trace_dir = None
+        with _lock:
+            _active_trace_dir = None
     stats = summary(sorted_key)
     if profile_path:
         export_chrome_tracing(profile_path)
@@ -242,7 +245,8 @@ class Profiler:
         global _enabled
         want_record = st == "record"
         if want_record and not self._recording:
-            _enabled = True
+            with _lock:
+                _enabled = True
             self._recording = True
             # `a and b and c or d` bug fixed here: the un-parenthesized
             # form started a DEVICE trace whenever GPU was in targets,
@@ -260,7 +264,8 @@ class Profiler:
 
     def _flush(self):
         global _enabled
-        _enabled = False
+        with _lock:
+            _enabled = False
         self._recording = False
         if self._device_active:
             import jax

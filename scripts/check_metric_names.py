@@ -1,134 +1,65 @@
 #!/usr/bin/env python
-"""Metric-name lint: every literal metric name used at a
-stat_add/stat_set/stat_max/counter/gauge/histogram/Counter/Gauge/
-Histogram call site must be snake_case AND registered — i.e. appear
-(backticked) in the docs/observability.md catalog. Keeps /metrics
-from silently growing undocumented or Prometheus-hostile names.
+"""Metric-name lint — DEPRECATED entry point.
+
+This script predates the ptlint framework; the check now lives there as
+the `metric-name` rule (paddle_tpu/tools/lint/rules/metric_names.py) and
+runs as part of `python scripts/ptlint.py`. This shim keeps the old CLI
+contract for existing invocations and tests:
 
     python scripts/check_metric_names.py              # lint paddle_tpu/ scripts/
     python scripts/check_metric_names.py path.py ...  # lint specific files
     python scripts/check_metric_names.py --list       # dump found names
 
 Exit code 0 when clean, 1 with one violation per line otherwise.
-Simple module-level constants are resolved (stat_add(REQUESTS_SUBMITTED)
-is linted as its string value); dynamic names are out of scope.
+Prefer `python scripts/ptlint.py --select metric-name`.
 """
 import ast
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CATALOG = os.path.join(REPO, "docs", "observability.md")
+sys.path.insert(0, REPO)
+
 DEFAULT_ROOTS = [os.path.join(REPO, "paddle_tpu"),
                  os.path.join(REPO, "scripts")]
 
-METRIC_FUNCS = {"stat_add", "stat_set", "stat_max", "stat_get",
-                "counter", "gauge", "histogram",
-                "Counter", "Gauge", "Histogram"}
-NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-BACKTICK_RE = re.compile(r"`([A-Za-z0-9_]+)`")
-
-
-def registered_names(catalog_path=CATALOG):
-    """The allowlist: every backticked identifier in the observability
-    doc. The doc IS the metric registry of record — adding a metric
-    means documenting it."""
-    try:
-        with open(catalog_path) as f:
-            return set(BACKTICK_RE.findall(f.read()))
-    except OSError:
-        return set()
-
-
-def _call_name(node):
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return None
-
-
-def _module_consts(tree):
-    """Module-level NAME = "literal" assignments (metrics.py declares its
-    monitor keys this way)."""
-    consts = {}
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and isinstance(node.value, ast.Constant) \
-                and isinstance(node.value.value, str):
-            consts[node.targets[0].id] = node.value.value
-    return consts
-
-
-def metric_call_sites(path):
-    """Yield (lineno, metric_name) for every lintable call in the file."""
-    with open(path) as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        raise SystemExit(f"{path}: cannot parse: {e}")
-    consts = _module_consts(tree)
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and _call_name(node) in METRIC_FUNCS and node.args):
-            continue
-        arg = node.args[0]
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            yield node.lineno, arg.value
-        elif isinstance(arg, ast.Name) and arg.id in consts:
-            yield node.lineno, consts[arg.id]
-
-
-def iter_py_files(roots):
-    for root in roots:
-        if os.path.isfile(root):
-            yield root
-            continue
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = [d for d in dirnames
-                           if d not in ("__pycache__", ".git")]
-            for fn in sorted(filenames):
-                if fn.endswith(".py"):
-                    yield os.path.join(dirpath, fn)
-
 
 def main(argv):
+    from paddle_tpu.tools import lint
+    from paddle_tpu.tools.lint.rules import metric_names as mn
+
     args = [a for a in argv if a != "--list"]
     list_only = len(args) != len(argv)
     roots = args or DEFAULT_ROOTS
-    allow = registered_names()
-    if not allow and not list_only:
-        print(f"check_metric_names: catalog {CATALOG} missing or empty",
-              file=sys.stderr)
-        return 1
-    violations, found = [], {}
-    for path in iter_py_files(roots):
-        for lineno, name in metric_call_sites(path):
-            rel = os.path.relpath(path, REPO)
-            found.setdefault(name, f"{rel}:{lineno}")
-            if not NAME_RE.match(name):
-                violations.append(
-                    f"{rel}:{lineno}: metric name {name!r} is not "
-                    "snake_case ([a-z][a-z0-9_]*)")
-            elif name not in allow:
-                violations.append(
-                    f"{rel}:{lineno}: metric name {name!r} is not "
-                    "registered in docs/observability.md")
+
     if list_only:
+        found = {}
+        for path in lint.iter_py_files(roots):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), filename=path)
+            except (SyntaxError, OSError, UnicodeDecodeError) as e:
+                raise SystemExit(f"{path}: cannot parse: {e}")
+            rel = os.path.relpath(path, REPO)
+            for node, name in mn.metric_call_sites(tree):
+                found.setdefault(name, f"{rel}:{node.lineno}")
         for name in sorted(found):
             print(f"{name}  ({found[name]})")
         return 0
-    for v in violations:
-        print(v)
-    if violations:
-        print(f"check_metric_names: {len(violations)} violation(s); "
+
+    if mn.registered_names(REPO) is None:
+        print(f"check_metric_names: catalog {mn.catalog_path(REPO)} "
+              "missing or empty", file=sys.stderr)
+        return 1
+    findings = lint.lint_paths(roots, repo_root=REPO,
+                               select={"metric-name"})
+    for f in findings:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if findings:
+        print(f"check_metric_names: {len(findings)} violation(s); "
               "register names in docs/observability.md or fix the case",
               file=sys.stderr)
-    return 1 if violations else 0
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

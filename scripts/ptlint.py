@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""ptlint CLI — JAX-aware static analysis over the repo.
+
+    python scripts/ptlint.py                          # default roots
+    python scripts/ptlint.py paddle_tpu scripts bench.py
+    python scripts/ptlint.py --json                   # machine-readable
+    python scripts/ptlint.py --baseline-update        # regrandfather
+    python scripts/ptlint.py --select host-sync-in-trace,lock-discipline
+    python scripts/ptlint.py --list-rules
+
+Exit codes: 0 clean (no findings beyond the baseline, and every
+baseline entry justified), 1 findings, 2 internal error / bad usage.
+
+The baseline (default scripts/ptlint_baseline.json) grandfathers
+pre-existing findings by (rule, path, message) identity with per-entry
+counts and REQUIRED one-line justifications; `--baseline-update`
+rewrites it from the current run, preserving surviving justifications
+and stamping new entries with a TODO that itself fails the clean check
+(a grandfathered finding can't land undocumented). Per-line opt-out:
+`# ptlint: disable=<rule>[,<rule>]`. Rule catalog:
+docs/static_analysis.md.
+"""
+import argparse
+import json
+import os
+import sys
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_ROOTS = ["paddle_tpu", "scripts", "bench.py"]
+DEFAULT_BASELINE = os.path.join(REPO, "scripts", "ptlint_baseline.json")
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="ptlint", description="JAX-aware static analysis")
+    p.add_argument("paths", nargs="*",
+                   help=f"files/dirs to lint (default: {DEFAULT_ROOTS})")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output on stdout")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file (default scripts/ptlint_baseline"
+                        ".json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report every finding)")
+    p.add_argument("--baseline-update", action="store_true",
+                   help="rewrite the baseline from this run's findings")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def run(argv):
+    from paddle_tpu.tools import lint
+
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule_id in sorted(lint.RULES):
+            print(f"{rule_id}: {lint.RULES[rule_id].rationale}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+    if args.paths:
+        # user paths resolve like any CLI: against the caller's cwd
+        paths = [os.path.abspath(p) for p in args.paths]
+    else:
+        paths = [os.path.join(REPO, p) for p in DEFAULT_ROOTS]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"ptlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings = lint.lint_paths(paths, repo_root=REPO, select=select)
+
+    entries = [] if args.no_baseline \
+        else lint.baseline.load(args.baseline)
+    if args.baseline_update:
+        # a scoped run (--select / narrowed paths) cannot reproduce
+        # out-of-scope entries — keep them instead of silently deleting
+        # their justifications
+        def in_scope(e):
+            if select is not None and e["rule"] not in select:
+                return False
+            ep = os.path.normpath(os.path.join(REPO, e["path"]))
+            return any(ep == r or ep.startswith(r + os.sep)
+                       for r in (os.path.normpath(p) for p in paths))
+
+        kept = [e for e in entries if not in_scope(e)]
+        entries = lint.baseline.update(findings, entries, args.baseline,
+                                       keep=kept)
+        todo = lint.baseline.undocumented(entries)
+        print(f"ptlint: baseline rewritten with {len(entries)} "
+              f"entr{'y' if len(entries) == 1 else 'ies'} covering "
+              f"{len(findings)} finding(s) -> {args.baseline}")
+        if todo:
+            print(f"ptlint: {len(todo)} entr"
+                  f"{'y needs' if len(todo) == 1 else 'ies need'} a "
+                  "justification (edit the TODO markers before "
+                  "committing)", file=sys.stderr)
+        return 0
+
+    new, suppressed = lint.baseline.diff(findings, entries)
+    undocumented = lint.baseline.undocumented(entries)
+    clean = not new and not undocumented
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "status": "clean" if clean else "findings",
+            "counts": {
+                "findings": len(new),
+                "baseline_suppressed": suppressed,
+                "baseline_undocumented": len(undocumented),
+            },
+            "findings": [f.to_dict() for f in new],
+            "undocumented_baseline": undocumented,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for e in undocumented:
+            print(f"{e['path']}: [baseline] entry for {e['rule']} "
+                  "lacks a justification (edit "
+                  f"{os.path.relpath(args.baseline, REPO)})")
+        if not clean:
+            n = len(new) + len(undocumented)
+            print(f"ptlint: {n} finding(s) "
+                  f"({suppressed} baselined); see docs/static_analysis"
+                  ".md for suppression/baseline workflow",
+                  file=sys.stderr)
+    return 0 if clean else 1
+
+
+def main(argv=None):
+    try:
+        return run(sys.argv[1:] if argv is None else argv)
+    except SystemExit as e:          # argparse --help/usage errors
+        return e.code if isinstance(e.code, int) else 2
+    except Exception:
+        traceback.print_exc()
+        print("ptlint: internal error", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,483 @@
+"""ptlint: the repo's JAX-aware static-analysis framework (tier-1).
+
+Three contracts under test:
+  * each rule FIRES on its positive fixture and STAYS SILENT on the
+    negative one (false-positive drift in a lint is a broken build for
+    everyone, so the negatives matter as much as the positives);
+  * suppression comments and the committed baseline round-trip;
+  * the repo itself lints clean through the CLI (exit 0 against
+    scripts/ptlint_baseline.json), and deliberately re-introducing the
+    two flagship bug classes — a host sync in the serving decode wave,
+    an unlocked telemetry write — makes the CLI exit 1.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from paddle_tpu.tools import lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "ptlint.py")
+
+
+def _lint_src(tmp_path, src, name="mod.py", select=None, root=None):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return lint.lint_paths([str(p)], repo_root=str(root or tmp_path),
+                           select=select)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _cli(*args):
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True, cwd=REPO)
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-trace
+# ---------------------------------------------------------------------------
+
+def test_host_sync_fires_on_jitted_function(tmp_path):
+    findings = _lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            print("tracing")
+            v = float(x)
+            w = x.item()
+            z = np.asarray(x)
+            return x + v + w + z
+    """, select={"host-sync-in-trace"})
+    assert len(findings) == 4, findings
+    msgs = " | ".join(f.message for f in findings)
+    assert "print()" in msgs and "float()" in msgs
+    assert ".item()" in msgs and "np.asarray()" in msgs
+
+
+def test_host_sync_follows_module_local_call_chain(tmp_path):
+    findings = _lint_src(tmp_path, """
+        import jax
+
+        def helper(x):
+            return float(x)
+
+        def wave(x):
+            return helper(x) + 1
+
+        compiled = jax.jit(wave, donate_argnums=(0,))
+    """, select={"host-sync-in-trace"})
+    assert len(findings) == 1
+    assert "helper" in findings[0].message
+
+
+def test_host_sync_silent_on_static_and_host_code(tmp_path):
+    findings = _lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def ok(x, flag=False):
+            n = int(x.shape[0])             # shape: static at trace time
+            m = float(len(x.shape))
+            b = bool(flag)                  # python config flag
+            return x * n * m * b
+
+        def host_side(x):
+            return float(np.asarray(x))     # not traced: fine
+    """, select={"host-sync-in-trace"})
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+def test_recompile_hazard_jit_in_loop(tmp_path):
+    findings = _lint_src(tmp_path, """
+        import jax
+        for i in range(3):
+            f = jax.jit(lambda x: x + i)
+    """, select={"recompile-hazard"})
+    assert _rules(findings) == ["recompile-hazard"]
+    assert "inside a loop" in findings[0].message
+
+
+def test_recompile_hazard_allows_loop_variant_function(tmp_path):
+    # a bench sweep jitting a DIFFERENT case per iteration is one
+    # compile per case, not a hazard
+    findings = _lint_src(tmp_path, """
+        import jax
+        for name, fn in CASES.items():
+            jf = jax.jit(fn)
+            jf(1.0)
+    """, select={"recompile-hazard"})
+    assert findings == []
+
+
+def test_recompile_hazard_jit_on_method_and_static_literal(tmp_path):
+    findings = _lint_src(tmp_path, """
+        import jax
+
+        class Model:
+            @jax.jit
+            def forward(self, x):
+                return x
+
+        def g(x, cfg):
+            return x
+
+        f = jax.jit(g, static_argnums=(1,))
+        out = f(1.0, [64, 64])
+    """, select={"recompile-hazard"})
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 2, findings
+    assert "retraces" in msgs and "unhashable" in msgs
+
+
+def test_recompile_hazard_trace_time_mutation_and_fstring(tmp_path):
+    findings = _lint_src(tmp_path, """
+        import jax
+
+        CACHE = {}
+
+        @jax.jit
+        def f(x):
+            CACHE["last"] = x               # trace-time only: silent bug
+            s = f"{x}"                      # formats a traced parameter
+            return x
+
+        @jax.jit
+        def ok(x):
+            if x.ndim != 2:
+                raise ValueError(f"bad rank for {x}")   # validation: fine
+            return x
+    """, select={"recompile-hazard"})
+    msgs = " | ".join(f.message for f in findings)
+    assert len(findings) == 2, findings
+    assert "closed-over module-level 'CACHE'" in msgs
+    assert "f-string" in msgs
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+LOCKED_MODULE = """
+    import threading
+
+    _lock = threading.Lock()
+    _stats = {}
+    _enabled = False
+
+    def good_write(name):
+        with _lock:
+            _stats[name] = 1
+
+    def good_flip():
+        global _enabled
+        with _lock:
+            _enabled = True
+"""
+
+
+def test_lock_discipline_fires_on_unlocked_writes(tmp_path):
+    findings = _lint_src(tmp_path, LOCKED_MODULE + """
+    def bad_write(name):
+        _stats[name] = 1
+
+    def bad_flip():
+        global _enabled
+        _enabled = True
+
+    def bad_mutate():
+        _stats.clear()
+    """, name="telemetry.py", select={"lock-discipline"})
+    assert len(findings) == 3, findings
+    msgs = " | ".join(f.message for f in findings)
+    assert "'_stats'" in msgs and "'_enabled'" in msgs
+
+
+def test_lock_discipline_silent_when_locked_or_lockless(tmp_path):
+    assert _lint_src(tmp_path, LOCKED_MODULE, name="telemetry.py",
+                     select={"lock-discipline"}) == []
+    # module without a module-level lock opted out of locking entirely
+    assert _lint_src(tmp_path, """
+        _cache = {}
+        def remember(k, v):
+            _cache[k] = v
+    """, select={"lock-discipline"}) == []
+
+
+# ---------------------------------------------------------------------------
+# mutable-default-arg / swallowed-exception
+# ---------------------------------------------------------------------------
+
+def test_mutable_default_arg(tmp_path):
+    findings = _lint_src(tmp_path, """
+        def bad(a, b=[], *, c={}):
+            return a
+
+        def also_bad(xs=list()):
+            return xs
+
+        def fine(a=None, b=(), c="x", d=0):
+            return a
+    """, select={"mutable-default-arg"})
+    assert len(findings) == 3, findings
+    assert all("shared across calls" in f.message for f in findings)
+
+
+def test_swallowed_exception(tmp_path):
+    findings = _lint_src(tmp_path, """
+        def bad():
+            try:
+                work()
+            except Exception:
+                pass
+
+        def bad_bare():
+            try:
+                work()
+            except:
+                cleanup()
+
+        def fine_narrow():
+            try:
+                work()
+            except ValueError:
+                pass
+
+        def fine_handled():
+            try:
+                work()
+            except Exception as e:
+                log(e)
+
+        def fine_fallback():
+            try:
+                return work()
+            except Exception:
+                return None
+
+        def fine_reraise():
+            try:
+                work()
+            except:
+                cleanup()
+                raise
+    """, select={"swallowed-exception"})
+    assert len(findings) == 2, findings
+    msgs = " | ".join(f.message for f in findings)
+    assert "swallows the error silently" in msgs
+    assert "KeyboardInterrupt" in msgs          # the bare-except variant
+
+
+# ---------------------------------------------------------------------------
+# metric-name (rebased from scripts/check_metric_names.py)
+# ---------------------------------------------------------------------------
+
+def test_metric_name_rule_with_catalog(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text(
+        "catalog: `good_metric_total` and `const_metric` here\n")
+    findings = _lint_src(tmp_path, """
+        from paddle_tpu.utils import telemetry, monitor
+        C = "const_metric"
+        BAD = "rogue_metric"
+        telemetry.counter("good_metric_total")
+        telemetry.counter("Bad-Name")
+        telemetry.gauge("unregistered_thing")
+        monitor.stat_add(C)
+        monitor.stat_add(BAD)
+    """, select={"metric-name"})
+    assert len(findings) == 3, findings
+    msgs = " | ".join(f.message for f in findings)
+    assert "snake_case" in msgs and "not registered" in msgs
+    assert "rogue_metric" in msgs
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+def test_line_suppression(tmp_path):
+    findings = _lint_src(tmp_path, """
+        def a(b=[]):                # ptlint: disable=mutable-default-arg
+            return b
+
+        def c(d=[]):                # ptlint: disable
+            return d
+
+        def e(f=[]):                # ptlint: disable=some-other-rule
+            return f
+    """, select={"mutable-default-arg"})
+    assert len(findings) == 1 and findings[0].line == 8
+
+
+def test_def_scope_suppression(tmp_path):
+    findings = _lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def precompute(x):          # ptlint: disable=host-sync-in-trace
+            print("static schedule")
+            return float(x)
+
+        @jax.jit
+        def hot(x):
+            return float(x)
+    """, select={"host-sync-in-trace"})
+    assert len(findings) == 1 and "hot" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# baseline round trip + CLI exit-code contract
+# ---------------------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text("def f(xs=[]):\n    return xs\n")
+    bl = tmp_path / "baseline.json"
+
+    res = _cli(str(mod), "--baseline", str(bl))
+    assert res.returncode == 1 and "mutable-default-arg" in res.stdout
+
+    res = _cli(str(mod), "--baseline", str(bl), "--baseline-update")
+    assert res.returncode == 0 and bl.exists()
+
+    # grandfathered but UNDOCUMENTED: still fails the clean check
+    res = _cli(str(mod), "--baseline", str(bl))
+    assert res.returncode == 1 and "justification" in res.stdout
+
+    data = json.loads(bl.read_text())
+    for e in data["findings"]:
+        e["justification"] = "legacy fixture, tracked in tests"
+    bl.write_text(json.dumps(data))
+    res = _cli(str(mod), "--baseline", str(bl))
+    assert res.returncode == 0, res.stdout + res.stderr
+
+    # a NEW finding beyond the baselined count fails again
+    mod.write_text("def f(xs=[]):\n    return xs\n\n"
+                   "def g(ys=[]):\n    return ys\n")
+    res = _cli(str(mod), "--baseline", str(bl))
+    assert res.returncode == 1
+    out = json.loads(_cli(str(mod), "--baseline", str(bl),
+                          "--json").stdout)
+    assert out["status"] == "findings"
+    assert out["counts"] == {"findings": 1, "baseline_suppressed": 1,
+                             "baseline_undocumented": 0}
+
+
+def test_scoped_baseline_update_preserves_out_of_scope_entries(tmp_path):
+    # --baseline-update under --select (or narrowed paths) must not
+    # delete grandfathered entries the scoped run could not reproduce
+    mod = tmp_path / "mod.py"
+    mod.write_text("def f(xs=[]):\n    return xs\n")
+    bl = tmp_path / "baseline.json"
+    _cli(str(mod), "--baseline", str(bl), "--baseline-update")
+    data = json.loads(bl.read_text())
+    data["findings"][0]["justification"] = "keep me"
+    bl.write_text(json.dumps(data))
+
+    res = _cli(str(mod), "--baseline", str(bl), "--select",
+               "swallowed-exception", "--baseline-update")
+    assert res.returncode == 0
+    kept = json.loads(bl.read_text())["findings"]
+    assert len(kept) == 1 and kept[0]["justification"] == "keep me"
+    assert _cli(str(mod), "--baseline", str(bl)).returncode == 0
+
+
+def test_lock_discipline_sees_annotated_mutables(tmp_path):
+    findings = _lint_src(tmp_path, """
+        import threading
+        _lock = threading.Lock()
+        _registry: dict = {}
+
+        def bad(k, v):
+            _registry[k] = v
+    """, name="telemetry.py", select={"lock-discipline"})
+    assert len(findings) == 1 and "'_registry'" in findings[0].message
+
+
+def test_unreadable_file_degrades_to_parse_error(tmp_path):
+    bad = tmp_path / "latin.py"
+    bad.write_bytes(b"# caf\xe9\nx = 1\n")        # not valid utf-8
+    findings = lint.lint_paths([str(bad)], repo_root=str(tmp_path))
+    assert _rules(findings) == ["parse-error"]
+    assert "cannot read" in findings[0].message
+
+
+def test_cli_internal_error_exit_2(tmp_path):
+    assert _cli(str(tmp_path / "nope.py")).returncode == 2
+    assert _cli("--select", "no-such-rule").returncode == 2
+
+
+def test_cli_list_rules():
+    res = _cli("--list-rules")
+    assert res.returncode == 0
+    for rule_id in ("host-sync-in-trace", "recompile-hazard",
+                    "lock-discipline", "mutable-default-arg",
+                    "swallowed-exception", "metric-name"):
+        assert rule_id in res.stdout
+
+
+# ---------------------------------------------------------------------------
+# tier-1: the repo lints clean, and the flagship regressions fail fast
+# ---------------------------------------------------------------------------
+
+def test_repo_lints_clean_against_baseline():
+    res = _cli("paddle_tpu", "scripts", "bench.py", "--json")
+    assert res.returncode == 0, res.stdout + res.stderr
+    out = json.loads(res.stdout)
+    assert out["status"] == "clean"
+    assert out["counts"]["baseline_undocumented"] == 0
+
+
+def _inject(src_rel, anchor, addition):
+    with open(os.path.join(REPO, src_rel), encoding="utf-8") as f:
+        src = f.read()
+    assert anchor in src, f"anchor drifted in {src_rel}"
+    return src.replace(anchor, anchor + addition, 1)
+
+
+def test_float_in_decode_wave_fails_lint(tmp_path):
+    # the compile-once decode wave must stay sync-free: a float() on a
+    # traced value in it is exactly the regression ptlint exists to stop
+    hacked = _inject(
+        "paddle_tpu/serving/engine.py",
+        "            nxt = jnp.where(sample, sampled, greedy)",
+        "\n            nxt_host = float(nxt)")
+    bad = tmp_path / "engine.py"
+    bad.write_text(hacked)
+    res = _cli(str(bad))
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "host-sync-in-trace" in res.stdout
+    assert "decode_wave" in res.stdout
+
+
+def test_unlocked_telemetry_write_fails_lint(tmp_path):
+    hacked = _inject(
+        "paddle_tpu/utils/telemetry.py",
+        'XLA_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"',
+        '\n\n\ndef _poke_state():\n    _install_state["installed"] = None\n')
+    bad = tmp_path / "telemetry.py"
+    bad.write_text(hacked)
+    res = _cli(str(bad))
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "lock-discipline" in res.stdout
+
+
+def test_unmodified_hot_files_lint_clean(tmp_path):
+    # false-positive guard: the injection tests above prove the rules
+    # fire; this proves they fire because of the injection
+    res = _cli("paddle_tpu/serving/engine.py",
+               "paddle_tpu/utils/telemetry.py")
+    assert res.returncode == 0, res.stdout + res.stderr
