@@ -172,6 +172,20 @@ def run():
     step.attach_flight_recorder(recorder)
     float(step(ids, ids).numpy())
     step.detach_flight_recorder()
+
+    # compile-level state of the measured program (xprof audit): flops/
+    # bytes from the lowering, fusion/memory from the compiled HLO —
+    # the persistent cache makes the AOT compile a disk hit, and any
+    # failure degrades to an error note rather than losing the bench
+    _note("hlo audit (compile-level rollup)")
+    try:
+        from paddle_tpu.tools import xprof
+        audit_snap = xprof.snapshot_programs(
+            [xprof.train_step_spec(step, (ids,), (ids,))])
+        xprof.publish(audit_snap, recorder=recorder)
+        hlo_rollup = xprof.rollup(audit_snap)
+    except Exception as e:  # noqa: BLE001 - best-effort bench annotation
+        hlo_rollup = {"error": f"{type(e).__name__}: {e}"}
     fr_rollup = fr.rollup(recorder.events())
 
     tokens_per_sec = batch * seq / dt
@@ -189,7 +203,7 @@ def run():
     detail = {"step_ms": round(dt * 1e3, 2), "loss": round(final, 3),
               "model_tflops": round(tflops, 2), "params": n_params,
               "backend": jax.default_backend(), "batch": batch,
-              "flight_recorder": fr_rollup}
+              "flight_recorder": fr_rollup, "hlo_audit": hlo_rollup}
     if not on_tpu:
         # tunnel down at bench time: this run is a CPU liveness smoke,
         # NOT a perf datum. Attach the last BANKED on-chip measurement

@@ -154,6 +154,14 @@ class ServingEngine:
                 new_caches.append((ck, cv))
             return first, new_caches
 
+        # raw closures + jit spec, kept for the compile-level audit
+        # (tools/xprof lowers THE functions the engine serves — and can
+        # re-jit a deliberately degraded copy for its injection test —
+        # rather than a drifting reimplementation)
+        self._decode_wave_fn = decode_wave
+        self._prefill_fn = prefill
+        self._program_donate_argnums = (2,)
+
         if self._jit:
             # donate the batched cache: the engine always replaces its
             # cache reference with the program output, so XLA may update
@@ -164,10 +172,13 @@ class ServingEngine:
             # compile-once invariant as a live metric, not just the
             # _cache_size() test assertion.
             self._decode_wave = telemetry.instrument_jit(
-                jax.jit(decode_wave, donate_argnums=(2,)),
+                jax.jit(decode_wave,
+                        donate_argnums=self._program_donate_argnums),
                 "serving_decode_wave")
             self._prefill = telemetry.instrument_jit(
-                jax.jit(prefill, donate_argnums=(2,)), "serving_prefill")
+                jax.jit(prefill,
+                        donate_argnums=self._program_donate_argnums),
+                "serving_prefill")
         else:
             self._decode_wave = decode_wave
             self._prefill = prefill

@@ -1,1 +1,3 @@
-"""Developer tooling that ships with the library (static analysis)."""
+"""Developer tooling that ships with the library: static analysis
+(`tools.lint`, scripts/ptlint.py) and the XLA program observatory
+(`tools.xprof`, scripts/hlo_audit.py)."""

@@ -1,0 +1,16 @@
+"""xprof: the XLA program observatory.
+
+Compile-level observability for the repo's tracked hot paths — HLO
+cost analysis (flops / bytes-accessed), compiled memory analysis
+(argument / output / temp / peak bytes) and an optimized-HLO opcode
+histogram (fusions, collectives, instruction count) per program, with
+a committed baseline (`scripts/hlo_baseline.json`) and a regression
+gate (`scripts/hlo_audit.py --diff`, tier-1 via
+tests/test_hlo_audit.py). See docs/observability.md ("XLA program
+observatory").
+"""
+from . import audit, hlo, registry                        # noqa: F401
+from .audit import (audit_jitted, diff, publish, rollup,   # noqa: F401
+                    snapshot_programs)
+from .registry import (engine_program_specs,               # noqa: F401
+                       tracked_program_specs, train_step_spec)

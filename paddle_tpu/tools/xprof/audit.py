@@ -1,0 +1,408 @@
+"""Compile-level audit: snapshot tracked XLA programs, diff vs baseline.
+
+Three analyses per program, each independently degradable (a jax build
+or backend that can't answer one question must not cost us the others —
+the snapshot records `null` plus a reason string instead of crashing):
+
+  * cost      — `lower(...).cost_analysis()` flops / bytes-accessed via
+                `flight_recorder.normalize_cost_analysis` (HLO-level,
+                NO second backend compile; the same numbers TrainStep's
+                MFU accounting uses);
+  * memory    — `lower(...).compile().memory_analysis()` argument /
+                output / temp bytes and the derived peak (alias sizes
+                are deliberately NOT recorded — see _memory_entry);
+  * hlo       — opcode histogram over the optimized executable text
+                (`hlo.op_histogram`): fusion count+kinds, collectives,
+                instruction count.
+
+`diff()` compares a snapshot against the committed baseline
+(scripts/hlo_baseline.json) under per-metric tolerances — all audited
+metrics are lower-is-better, so only increases beyond tolerance are
+regressions; shrinkage is reported as a note suggesting a baseline
+update. `publish()` exports the same numbers as telemetry gauges
+(`xla_program_*{function=...}`) and journals them through the current
+flight recorder, so the live system and CI gate read one source.
+"""
+import json
+
+from ...utils.flight_recorder import normalize_cost_analysis
+from . import hlo as hlo_mod
+
+SCHEMA_VERSION = 1
+
+# metric -> (section, field); every one is lower-is-better
+METRICS = {
+    "flops": ("cost", "flops"),
+    "bytes_accessed": ("cost", "bytes_accessed"),
+    "peak_bytes": ("memory", "peak_bytes"),
+    "fusion_count": ("hlo", "fusion_count"),
+    "instruction_count": ("hlo", "instruction_count"),
+    "collective_count": ("hlo", "collective_count"),
+}
+
+# an increase is a regression when cur > base * (1 + rtol) + atol.
+# flops are near-exact per lowering; bytes/memory get slack for layout
+# and scheduling noise across XLA minor changes; the count metrics get
+# small absolute slack so a one-fusion wobble on a tiny program doesn't
+# cry wolf, while a de-optimized hot path (many new ops) still trips.
+DEFAULT_TOLERANCES = {
+    "flops": {"rtol": 0.02, "atol": 1024},
+    "bytes_accessed": {"rtol": 0.10, "atol": 4096},
+    "peak_bytes": {"rtol": 0.10, "atol": 4096},
+    "fusion_count": {"rtol": 0.25, "atol": 2},
+    "instruction_count": {"rtol": 0.25, "atol": 8},
+    "collective_count": {"rtol": 0.0, "atol": 0},
+}
+
+
+# ---------------------------------------------------------------------------
+# snapshotting
+# ---------------------------------------------------------------------------
+
+def _reason(exc):
+    return f"{type(exc).__name__}: {exc}"[:300]
+
+
+def _memory_entry(compiled):
+    ma = compiled.memory_analysis()
+    if ma is None:
+        raise RuntimeError("memory_analysis() returned None")
+    # NOT recorded: alias_size_in_bytes and generated_code_size_in_bytes
+    # do not survive persistent-cache serialization (a cache-hit load
+    # reports 0 where the fresh compile reported the donation aliasing),
+    # and a snapshot must be identical whether the executable was
+    # compiled or loaded — the determinism contract of --json/--diff.
+    fields = {
+        "argument_bytes": "argument_size_in_bytes",
+        "output_bytes": "output_size_in_bytes",
+        "temp_bytes": "temp_size_in_bytes",
+    }
+    out = {}
+    for key, attr in fields.items():
+        v = getattr(ma, attr, None)
+        out[key] = int(v) if isinstance(v, (int, float)) else None
+    missing = [k for k, v in out.items() if v is None]
+    if missing:
+        # ALL components or nothing: a peak computed from a partial
+        # field set would diff as a huge spurious "improvement" against
+        # a complete baseline — degrade to null + reason instead
+        raise RuntimeError(
+            f"memory stats missing {missing}: {ma!r}")
+    # args + outputs + temps: an UPPER BOUND on the executable's HBM
+    # high-water mark, not the exact peak — XLA reports a donated
+    # buffer's bytes on BOTH the argument and output side, and the
+    # aliasing size that would correct it does not survive
+    # persistent-cache loads (see the determinism note above), so it is
+    # deliberately not subtracted. Consistent run-to-run, which is all
+    # the regression gate needs.
+    out["peak_bytes"] = sum(out[k] for k in fields)
+    return out
+
+
+def audit_jitted(jitted, *args, **kwargs):
+    """Audit one jit-wrapped callable against example (or abstract
+    ShapeDtypeStruct) arguments. Returns the per-program entry dict:
+    `cost` / `memory` / `hlo` sections (null where the jax build can't
+    answer, with the reason under `unavailable`) plus the flat
+    `metrics` map the diff consumes."""
+    entry = {"cost": None, "memory": None, "hlo": None}
+    unavailable = {}
+    lowered = compiled = None
+    try:
+        lowered = jitted.lower(*args, **kwargs)
+    except Exception as e:
+        unavailable["cost"] = unavailable["memory"] = unavailable["hlo"] = \
+            f"lower() failed: {_reason(e)}"
+    if lowered is not None:
+        try:
+            cost = normalize_cost_analysis(lowered.cost_analysis())
+            if cost is None:
+                raise RuntimeError("cost_analysis() returned nothing "
+                                   "normalizable")
+            entry["cost"] = cost
+        except Exception as e:
+            unavailable["cost"] = _reason(e)
+        try:
+            compiled = lowered.compile()
+        except Exception as e:
+            unavailable["memory"] = unavailable["hlo"] = \
+                f"compile() failed: {_reason(e)}"
+    if compiled is not None:
+        try:
+            entry["memory"] = _memory_entry(compiled)
+        except Exception as e:
+            unavailable["memory"] = _reason(e)
+        try:
+            entry["hlo"] = hlo_mod.op_histogram(compiled.as_text())
+        except Exception as e:
+            unavailable["hlo"] = _reason(e)
+    if unavailable:
+        entry["unavailable"] = unavailable
+    entry["metrics"] = extract_metrics(entry)
+    return entry
+
+
+def extract_metrics(entry):
+    out = {}
+    for metric, (section, field) in METRICS.items():
+        sec = entry.get(section)
+        v = sec.get(field) if isinstance(sec, dict) else None
+        out[metric] = v if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+    return out
+
+
+def degrade(fn):
+    """Deliberately de-optimize a program — the audit's positive
+    control (`hlo_audit.py --inject`). Every float input leaf is
+    dragged through an extra transcendental reduction whose result
+    becomes an additional program output, so DCE cannot remove it and
+    an optimization barrier keeps it out of existing fusions: one more
+    full HBM pass over the weights and caches, extra instructions and
+    fusions — exactly the compile-level fingerprint of a broken hot
+    path, which the diff must flag."""
+    import jax
+    import jax.numpy as jnp
+
+    def degraded(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        junk = jnp.asarray(0.0, jnp.float32)
+        for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+            dt = getattr(leaf, "dtype", None)
+            if dt is not None and jnp.issubdtype(dt, jnp.floating):
+                junk = junk + jnp.sum(
+                    jnp.tanh(leaf.astype(jnp.float32) * 1.0001))
+        return out, jax.lax.optimization_barrier(junk)
+
+    return degraded
+
+
+def snapshot_spec(spec, inject=False):
+    """Audit one program spec (see registry.tracked_program_specs).
+    A spec carries either a prebuilt `jitted` callable or a raw `fn`
+    (+ optional `jit_kwargs`); injection needs the raw fn to wrap."""
+    import jax
+    if inject:
+        if spec.get("fn") is None:
+            raise ValueError(
+                f"program {spec['name']!r} exposes no raw fn to degrade")
+        jitted = jax.jit(degrade(spec["fn"]), **spec.get("jit_kwargs", {}))
+    elif spec.get("jitted") is not None:
+        jitted = spec["jitted"]
+    else:
+        jitted = jax.jit(spec["fn"], **spec.get("jit_kwargs", {}))
+    entry = audit_jitted(jitted, *spec["args"])
+    if spec.get("description"):
+        entry["description"] = spec["description"]
+    if inject:
+        entry["injected"] = True
+    return entry
+
+
+def snapshot_programs(specs, inject=()):
+    """Audit a list of specs -> snapshot dict. `inject` names programs
+    to deliberately de-optimize (test/debug only)."""
+    import jax
+    inject = set(inject or ())
+    unknown = inject - {s["name"] for s in specs}
+    if unknown:
+        raise ValueError(f"--inject names unknown programs: "
+                         f"{sorted(unknown)}")
+    programs = {}
+    for spec in specs:
+        programs[spec["name"]] = snapshot_spec(
+            spec, inject=spec["name"] in inject)
+    return {
+        "schema": SCHEMA_VERSION,
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "programs": programs,
+    }
+
+
+# ---------------------------------------------------------------------------
+# baseline + diff
+# ---------------------------------------------------------------------------
+
+def make_baseline(snapshot, previous=None, keep_missing=False):
+    """Compact a snapshot into the committed baseline shape: per program
+    the flat metric values (nulls preserved — unavailable analyses are
+    a recorded fact, and null-vs-null diffs clean) plus tolerances.
+    Per-program tolerance overrides hand-edited into a previous baseline
+    survive the update. `keep_missing=True` (a --programs SUBSET update)
+    carries previous-baseline programs absent from this snapshot over
+    unchanged, so re-banking one program never silently un-tracks the
+    others; a FULL update drops them (deliberate removal). A subset
+    merge across backends is refused — it would stamp cpu-banked
+    numbers with a tpu backend (or vice versa) and license exactly the
+    cross-backend comparison the backend stamp exists to prevent."""
+    if keep_missing and previous is not None \
+            and previous.get("backend") != snapshot["backend"]:
+        raise ValueError(
+            f"refusing a --programs subset baseline update across "
+            f"backends: baseline is {previous.get('backend')!r}, this "
+            f"snapshot is {snapshot['backend']!r} — re-bank ALL "
+            "programs on one backend instead")
+    prev_programs = (previous or {}).get("programs", {})
+    programs = {}
+    if keep_missing:
+        programs.update({k: v for k, v in prev_programs.items()
+                         if k not in snapshot["programs"]})
+    for name, entry in sorted(snapshot["programs"].items()):
+        row = {"metrics": dict(entry["metrics"])}
+        if entry.get("unavailable"):
+            row["unavailable"] = dict(entry["unavailable"])
+        old_tol = prev_programs.get(name, {}).get("tolerances")
+        if old_tol:
+            row["tolerances"] = old_tol
+        programs[name] = row
+    return {
+        "version": SCHEMA_VERSION,
+        "backend": snapshot["backend"],
+        "jax_version": snapshot["jax_version"],
+        "tolerances": (previous or {}).get("tolerances",
+                                           DEFAULT_TOLERANCES),
+        "programs": programs,
+    }
+
+
+def load_baseline(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_baseline(baseline, path):
+    with open(path, "w") as f:
+        json.dump(baseline, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def _limit(base, tol):
+    return base * (1.0 + tol.get("rtol", 0.0)) + tol.get("atol", 0.0)
+
+
+def diff(snapshot, baseline):
+    """Compare a snapshot against the baseline. Returns
+    (findings, notes): findings are tolerance-exceeding INCREASES of a
+    lower-is-better metric (the CI gate — exit 1); notes are
+    non-gating observations (backend mismatch, programs or analyses
+    appearing/disappearing, improvements worth a baseline update)."""
+    findings, notes = [], []
+    if snapshot.get("backend") != baseline.get("backend"):
+        notes.append(
+            f"backend mismatch: snapshot={snapshot.get('backend')!r} "
+            f"baseline={baseline.get('backend')!r} — compiled programs "
+            "are not comparable across backends; skipping the diff "
+            "(re-baseline on this backend to gate here)")
+        return findings, notes
+    base_tol = baseline.get("tolerances", DEFAULT_TOLERANCES)
+    base_programs = baseline.get("programs", {})
+    cur_programs = snapshot.get("programs", {})
+    for name in sorted(set(base_programs) - set(cur_programs)):
+        findings.append({
+            "program": name, "metric": "-", "base": None, "current": None,
+            "limit": None,
+            "why": "tracked program missing from the snapshot (renamed or "
+                   "dropped? update scripts/hlo_baseline.json "
+                   "deliberately via --update-baseline)"})
+    for name in sorted(set(cur_programs) - set(base_programs)):
+        notes.append(f"{name}: not in baseline — run --update-baseline "
+                     "to start tracking it")
+    for name in sorted(set(cur_programs) & set(base_programs)):
+        cur = cur_programs[name].get("metrics", {})
+        brow = base_programs[name]
+        base = brow.get("metrics", {})
+        tols = dict(base_tol)
+        tols.update(brow.get("tolerances", {}))
+        for metric in METRICS:
+            b, c = base.get(metric), cur.get(metric)
+            if b is None and c is None:
+                continue        # unavailable on both sides: clean
+            if c is None:
+                notes.append(
+                    f"{name}.{metric}: analysis unavailable here but "
+                    f"baselined at {b:g} — capability lost on this jax "
+                    "build (not gating)")
+                continue
+            if b is None:
+                notes.append(
+                    f"{name}.{metric}: now measurable ({c:g}) but null "
+                    "in baseline — run --update-baseline to gate it")
+                continue
+            tol = tols.get(metric, {})
+            limit = _limit(b, tol)
+            if c > limit:
+                findings.append({
+                    "program": name, "metric": metric, "base": b,
+                    "current": c, "limit": limit,
+                    "why": f"{metric} regressed {b:g} -> {c:g} "
+                           f"(tolerance ceiling {limit:g})"})
+            elif b - (c * (1.0 + tol.get("rtol", 0.0))
+                      + tol.get("atol", 0.0)) > 0:
+                notes.append(
+                    f"{name}.{metric}: improved {b:g} -> {c:g} — "
+                    "consider --update-baseline to lock in the win")
+    return findings, notes
+
+
+def render_findings(findings, notes):
+    lines = []
+    for f in findings:
+        lines.append(f"REGRESSION {f['program']}.{f['metric']}: {f['why']}")
+    for n in notes:
+        lines.append(f"note: {n}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# live export (telemetry gauges + flight-recorder journal)
+# ---------------------------------------------------------------------------
+
+def publish(snapshot, recorder=None):
+    """Export a snapshot's per-program numbers as telemetry gauges and
+    journal them through `recorder` (default: the current flight
+    recorder, if any) — the audit's measurements become part of the
+    same live surface the compile events already ride."""
+    from ...utils import telemetry, flight_recorder as fr
+    gauges = {
+        "flops": telemetry.gauge(
+            "xla_program_flops",
+            "FLOPs per tracked compiled program (HLO cost analysis)",
+            labelnames=("function",)),
+        "bytes_accessed": telemetry.gauge(
+            "xla_program_bytes",
+            "Bytes accessed per tracked compiled program",
+            labelnames=("function",)),
+        "peak_bytes": telemetry.gauge(
+            "xla_program_peak_memory_bytes",
+            "Peak executable memory (args+outputs+temps) per tracked "
+            "program", labelnames=("function",)),
+        "fusion_count": telemetry.gauge(
+            "xla_program_fusion_count",
+            "Fusion instructions in the optimized HLO per tracked "
+            "program", labelnames=("function",)),
+    }
+    rec = recorder if recorder is not None else fr.get_recorder()
+    for name, entry in sorted(snapshot.get("programs", {}).items()):
+        m = entry.get("metrics", {})
+        for metric, gauge in gauges.items():
+            if m.get(metric) is not None:
+                gauge.labels(name).set(m[metric])
+        if rec is not None:
+            rec.xla_program(
+                name, flops=m.get("flops"),
+                bytes_accessed=m.get("bytes_accessed"),
+                peak_memory_bytes=m.get("peak_bytes"),
+                fusion_count=m.get("fusion_count"))
+
+
+def rollup(snapshot):
+    """Compact per-program {flops, bytes_accessed, fusion_count,
+    peak_bytes} map for bench JSON embedding."""
+    out = {}
+    for name, entry in sorted(snapshot.get("programs", {}).items()):
+        m = entry.get("metrics", {})
+        out[name] = {k: m.get(k) for k in
+                     ("flops", "bytes_accessed", "fusion_count",
+                      "peak_bytes")}
+    return out

@@ -1,0 +1,81 @@
+"""Opcode histogram over optimized HLO text.
+
+The compiled executable's `as_text()` is post-optimization HLO — the
+program XLA actually runs, after fusion, layout assignment and
+scheduling. This module reduces that text to the aggregate numbers the
+audit baselines: how many instructions survived, how much of the
+program lives inside fusions (and of which kind), and how many
+communication ops the partitioner emitted. Those are exactly the
+quantities the operator-fusion literature (PAPERS.md: "Operator Fusion
+in XLA", "FusionStitching") identifies as the compile-level fingerprint
+of a memory-bound program — a PR that breaks fusion on the decode hot
+path moves `fusion_count`/`bytes_accessed` long before a wall-clock
+bench can see it.
+
+Text parsing (vs walking the jaxpr, graph_census.py's technique) is
+deliberate: fusion decisions only exist AFTER the backend pipeline, and
+the stable public surface for the optimized program in jax 0.4.37 is
+the HLO text dump.
+"""
+import re
+
+# one HLO instruction per line:  [ROOT] %name = type[shape]{layout} opcode(
+# the type may be a TUPLE `(f32[..]{..}, s32[..]{..})` — multi-output
+# fusions and tuple roots — whose spaces a bare \S+ cannot span
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?:\([^)]*\)|\S+)\s+"
+    r"([a-z][a-z0-9\-]*)")
+_FUSION_KIND_RE = re.compile(r"\bkind=k(\w+)")
+
+# computation-opening lines (`%fused_computation ... {`, `ENTRY %main`)
+# also contain " = " never — they match nothing; parameter declarations
+# inside computations DO parse as `parameter` instructions, matching
+# XLA's own instruction-count accounting.
+
+COLLECTIVE_OPCODES = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+)
+
+
+def op_histogram(hlo_text):
+    """Reduce optimized HLO text to the audit's aggregate counts.
+
+    Returns a plain JSON-able dict:
+      instruction_count  — instructions across every computation
+      fusion_count       — `fusion(...)` instructions
+      fusion_kinds       — {"Loop": n, "Output": n, ...} per kind=kXxx
+      collective_count   — communication ops (incl. -start variants)
+      collectives        — per-opcode counts for the comm ops present
+      custom_call_count  — custom-call instructions (host callbacks,
+                           library kernels — the un-fusable opaque ops)
+      ops                — full opcode -> count histogram
+    Deterministic for a given program + backend: names/ids are ignored,
+    only opcodes and fusion kinds are counted.
+    """
+    ops = {}
+    fusion_kinds = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        op = m.group(1)
+        ops[op] = ops.get(op, 0) + 1
+        if op == "fusion":
+            k = _FUSION_KIND_RE.search(line)
+            kind = k.group(1) if k else "Unknown"
+            fusion_kinds[kind] = fusion_kinds.get(kind, 0) + 1
+    collectives = {}
+    for op, n in ops.items():
+        base = op[:-6] if op.endswith("-start") else op
+        if base in COLLECTIVE_OPCODES:
+            collectives[op] = n
+    return {
+        "instruction_count": sum(ops.values()),
+        "fusion_count": ops.get("fusion", 0),
+        "fusion_kinds": dict(sorted(fusion_kinds.items())),
+        "collective_count": sum(collectives.values()),
+        "collectives": dict(sorted(collectives.items())),
+        "custom_call_count": ops.get("custom-call", 0),
+        "ops": dict(sorted(ops.items())),
+    }

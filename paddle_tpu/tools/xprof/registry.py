@@ -1,0 +1,174 @@
+"""Tracked-program registry: the compiled programs the repo gates on.
+
+Each entry is a deterministic abstract-shape lowering spec — a tiny
+canonical configuration (2 layers, hidden <= 128: HLO structure, not
+capacity, is what's audited, and tier-1 shares the 870s budget) of a
+REAL hot path:
+
+  * `serving_decode_wave` / `serving_prefill` — the ServingEngine's two
+    programs, lowered from the engine's own raw closures (the engine
+    stashes them precisely so this audit and the serving path cannot
+    drift apart);
+  * `train_step` — `jit.TrainStep` (forward + backward + AdamW, donated
+    state) on the canonical 2-layer GPT config — the same topology
+    bench.py's CPU smoke compiles, so the persistent compile cache is
+    shared;
+  * `cached_decode_attention` — the GQA single-token cached attention
+    core from nn/transformer.py with a per-slot position VECTOR (the
+    serving decode regime);
+  * `prefill_flash_attention` — the causal prompt-phase attention array
+    kernel the prefill paths route through.
+
+Specs are dicts: {name, fn | jitted, args, jit_kwargs, description}.
+Builders reset the global seed so repeated snapshots are
+bit-deterministic; parameter VALUES never reach the lowering anyway —
+only shapes/dtypes do.
+"""
+
+# serving canonical shape (mirrors tests/test_serving.py scale)
+SERVING = dict(vocab=128, hidden=64, layers=2, heads=4, max_len=64,
+               prefill_len=16, num_slots=4)
+# train canonical shape == bench.py CPU-smoke config
+TRAIN = dict(vocab=512, hidden=128, layers=2, heads=4, seq=128, batch=2)
+
+TRACKED_PROGRAMS = ("serving_decode_wave", "serving_prefill",
+                    "train_step", "cached_decode_attention",
+                    "prefill_flash_attention")
+
+
+def engine_program_specs(engine, prefix="serving"):
+    """Audit specs for a LIVE ServingEngine's two programs, with the
+    engine's actual shapes — used on the canonical engine below and by
+    bench_serving.py on the engine it just measured."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    S = engine.num_slots
+    key = jax.random.PRNGKey(0)
+    jit_kwargs = {"donate_argnums": engine._program_donate_argnums}
+    decode_args = (
+        engine._params, engine._buffers, engine._caches,
+        jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+        jnp.ones((S,), bool), jnp.zeros((S,), bool),
+        jnp.ones((S,), jnp.float32), key)
+    prefill_args = (
+        engine._params, engine._buffers, engine._caches,
+        jnp.asarray(np.zeros((engine.prefill_len,), np.int32)),
+        jnp.int32(1), jnp.int32(0), jnp.asarray(False),
+        jnp.float32(1.0), key)
+    return [
+        {"name": f"{prefix}_decode_wave", "fn": engine._decode_wave_fn,
+         "args": decode_args, "jit_kwargs": jit_kwargs,
+         "description": f"one batched decode token for every slot "
+                        f"(slots={S}, max_len={engine.max_len})"},
+        {"name": f"{prefix}_prefill", "fn": engine._prefill_fn,
+         "args": prefill_args, "jit_kwargs": jit_kwargs,
+         "description": f"one prompt bucket admission "
+                        f"(prefill_len={engine.prefill_len})"},
+    ]
+
+
+def _serving_specs():
+    import paddle_tpu as pt
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import ServingEngine
+
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=SERVING["vocab"],
+                    hidden_size=SERVING["hidden"],
+                    num_layers=SERVING["layers"],
+                    num_heads=SERVING["heads"],
+                    max_seq_len=SERVING["max_len"],
+                    dropout=0.0, attn_dropout=0.0)
+    engine = ServingEngine(GPTForPretraining(cfg),
+                           num_slots=SERVING["num_slots"],
+                           max_len=SERVING["max_len"],
+                           prefill_len=SERVING["prefill_len"])
+    return engine_program_specs(engine)
+
+
+def train_step_spec(step, inputs, labels):
+    """Audit spec for a LIVE TrainStep: lowers the step's own compiled
+    callable with its current state (injection needs a raw fn, which
+    TrainStep does not expose — gate regressions via the registry's
+    canonical instance instead)."""
+    import jax
+    import jax.numpy as jnp
+    args = (step.params, step.buffers, step.opt_state, step.grad_acc,
+            jax.random.PRNGKey(0), jnp.asarray(1e-4, jnp.float32),
+            jnp.asarray(1, jnp.int32), tuple(inputs), tuple(labels))
+    return {"name": "train_step", "jitted": step._compiled, "args": args,
+            "description": "forward+backward+optimizer, one donated "
+                           "executable (canonical 2-layer GPT)"}
+
+
+def _train_step_spec():
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+    from paddle_tpu.nlp.gpt import gpt_pretrain_loss
+
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=TRAIN["vocab"], hidden_size=TRAIN["hidden"],
+                    num_layers=TRAIN["layers"], num_heads=TRAIN["heads"],
+                    max_seq_len=TRAIN["seq"], dropout=0.0,
+                    attn_dropout=0.0)
+    model = GPTForPretraining(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters())
+    step = TrainStep(model, gpt_pretrain_loss, opt, donate=True)
+    ids = np.zeros((TRAIN["batch"], TRAIN["seq"]), np.int32)
+    return train_step_spec(step, (ids,), (ids,))
+
+
+def _attention_specs():
+    import jax.numpy as jnp
+    from paddle_tpu.nn.transformer import cached_decode_attention
+    from paddle_tpu.ops.pallas.flash_attention import _flash_array
+
+    b, h, hkv, L, d = 4, 4, 2, 64, 16
+
+    def decode_attn(q, ck, cv, pos):
+        return cached_decode_attention(q, ck, cv, pos,
+                                       scale=1.0 / (d ** 0.5))
+
+    decode_args = (jnp.zeros((b, h, 1, d), jnp.float32),
+                   jnp.zeros((b, hkv, L, d), jnp.float32),
+                   jnp.zeros((b, hkv, L, d), jnp.float32),
+                   jnp.zeros((b,), jnp.int32))
+
+    def prefill_attn(q, k, v):
+        return _flash_array(q, k, v, causal=True)
+
+    prefill_args = (jnp.zeros((2, h, L, d), jnp.float32),
+                    jnp.zeros((2, h, L, d), jnp.float32),
+                    jnp.zeros((2, h, L, d), jnp.float32))
+    return [
+        {"name": "cached_decode_attention", "fn": decode_attn,
+         "args": decode_args,
+         "description": "GQA cached decode attention core, per-slot "
+                        "position vector"},
+        {"name": "prefill_flash_attention", "fn": prefill_attn,
+         "args": prefill_args,
+         "description": "causal prompt-phase attention array kernel"},
+    ]
+
+
+def tracked_program_specs(names=None):
+    """Build the registry (or the named subset). Builders run lazily so
+    `--programs cached_decode_attention` never constructs an engine."""
+    want = set(names) if names else set(TRACKED_PROGRAMS)
+    unknown = want - set(TRACKED_PROGRAMS)
+    if unknown:
+        raise ValueError(f"unknown tracked programs {sorted(unknown)}; "
+                         f"registry has {list(TRACKED_PROGRAMS)}")
+    specs = []
+    if want & {"serving_decode_wave", "serving_prefill"}:
+        specs += [s for s in _serving_specs() if s["name"] in want]
+    if "train_step" in want:
+        specs.append(_train_step_spec())
+    if want & {"cached_decode_attention", "prefill_flash_attention"}:
+        specs += [s for s in _attention_specs() if s["name"] in want]
+    return specs

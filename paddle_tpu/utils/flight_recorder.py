@@ -40,7 +40,7 @@ class NonFiniteError(RuntimeError):
 
 
 EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
-               "checkpoint", "run_end")
+               "checkpoint", "xla_program", "run_end")
 
 
 def _json_safe(v):
@@ -211,6 +211,23 @@ class FlightRecorder:
         return self.record("collective", op=str(op), bytes=int(nbytes),
                            group=str(group), traced=bool(traced), **extra)
 
+    def xla_program(self, program, flops=None, bytes_accessed=None,
+                    peak_memory_bytes=None, fusion_count=None, **extra):
+        """Compile-level audit result for one tracked program (the
+        xprof observatory's journal hook — rides next to the `compile`
+        events so one journal shows both when a program compiled and
+        what the compiler made of it). None fields are journaled as
+        null: 'analysis unavailable' is itself a recorded fact."""
+        return self.record(
+            "xla_program", program=str(program),
+            flops=None if flops is None else float(flops),
+            bytes_accessed=(None if bytes_accessed is None
+                            else float(bytes_accessed)),
+            peak_memory_bytes=(None if peak_memory_bytes is None
+                               else float(peak_memory_bytes)),
+            fusion_count=(None if fusion_count is None
+                          else int(fusion_count)), **extra)
+
     def checkpoint(self, path=None, step=None, **extra):
         fields = {}
         if path is not None:
@@ -342,25 +359,42 @@ def device_peak_flops(device=None):
     return _DEFAULT_PEAK_FLOPS
 
 
-def cost_analysis(jitted, *args, **kwargs):
-    """FLOPs/bytes of the executable `jitted(*args)` would run, via the
-    lowering's HLO cost analysis — no second backend compile, and safe
-    to call with the concrete (not-yet-donated) call arguments. Returns
-    {"flops": float, "bytes_accessed": float} (keys present when the
-    analysis provides them) or None when the jax build/backend can't
-    analyze."""
-    try:
-        lowered = jitted.lower(*args, **kwargs)
-        ca = lowered.cost_analysis()
-    except Exception:
-        return None
+def normalize_cost_analysis(ca):
+    """Normalize a raw `cost_analysis()` result to one shape.
+
+    Across jax versions/backends the call returns a dict, a
+    list-of-dicts (one per device/partition — the first carries the
+    program totals), or something unusable; keys use XLA's spaced
+    spelling ("bytes accessed"). This is THE one place that shape
+    knowledge lives — jit.TrainStep, the xprof audit and
+    scripts/mosaic_check.py all consume this normalized form. Returns
+    {"flops": float, "bytes_accessed": float, "transcendentals": float}
+    (keys present when the analysis provides a numeric value, never
+    NaN), or None when nothing usable came back."""
     if isinstance(ca, (list, tuple)):
         ca = ca[0] if ca else {}
     if not isinstance(ca, dict):
         return None
     out = {}
-    if ca.get("flops") is not None:
-        out["flops"] = float(ca["flops"])
-    if ca.get("bytes accessed") is not None:
-        out["bytes_accessed"] = float(ca["bytes accessed"])
+    for key, spelled in (("flops", "flops"),
+                         ("bytes_accessed", "bytes accessed"),
+                         ("transcendentals", "transcendentals")):
+        v = ca.get(spelled, ca.get(key))
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and v == v:
+            out[key] = float(v)
     return out or None
+
+
+def cost_analysis(jitted, *args, **kwargs):
+    """FLOPs/bytes of the executable `jitted(*args)` would run, via the
+    lowering's HLO cost analysis — no second backend compile, and safe
+    to call with the concrete (not-yet-donated) call arguments. Returns
+    the `normalize_cost_analysis` dict or None when the jax
+    build/backend can't analyze."""
+    try:
+        lowered = jitted.lower(*args, **kwargs)
+        ca = lowered.cost_analysis()
+    except Exception:
+        return None
+    return normalize_cost_analysis(ca)

@@ -180,8 +180,25 @@ def main():
         profiler.stop_profiler(profile_path=args.trace_out)
         log(f"wrote chrome trace {args.trace_out}")
 
+    # compile-level state of THIS engine's two programs (xprof audit):
+    # the perf trajectory in BENCH_serving.json records what the
+    # compiler made of the decode wave/prefill, not just wall-clock —
+    # audited after the sweep so it cannot perturb a load point
+    try:
+        from paddle_tpu.tools import xprof
+        audit_snap = xprof.snapshot_programs(
+            xprof.engine_program_specs(engine))
+        xprof.publish(audit_snap)
+        hlo_rollup = xprof.rollup(audit_snap)
+        log(f"hlo audit: " + ", ".join(
+            f"{name} fusions={m['fusion_count']}"
+            for name, m in hlo_rollup.items()))
+    except Exception as e:  # noqa: BLE001 - best-effort bench annotation
+        hlo_rollup = {"error": f"{type(e).__name__}: {e}"}
+
     with open(args.out, "w") as f:
         json.dump({"cmd": " ".join(sys.argv), "rows": rows,
+                   "hlo_audit": hlo_rollup,
                    "telemetry": telemetry.snapshot()}, f, indent=1)
     log(f"wrote {args.out}")
     engine.stop_metrics_server()

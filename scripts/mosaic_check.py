@@ -129,7 +129,11 @@ def run_one(name):
     t0 = time.time()
     compiled = jax.jit(fn).lower(*avals).compile()
     dt = time.time() - t0
-    flops = (compiled.cost_analysis() or {}).get("flops", 0)
+    # shared shape normalization: compiled cost_analysis is a dict on
+    # this jax but a list-of-dicts on others
+    from paddle_tpu.utils.flight_recorder import normalize_cost_analysis
+    flops = (normalize_cost_analysis(compiled.cost_analysis())
+             or {}).get("flops", 0)
     # a CPU-backend "compile" is interpret-mode Pallas — NOT a Mosaic
     # verdict (the tunnel can drop between the watchdog probe and this
     # child); record it as such so it never banks a false pass

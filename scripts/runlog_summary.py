@@ -13,6 +13,10 @@ schema in docs/observability.md. The report covers:
   * MFU and per-step FLOPs from the compiled executable's cost analysis,
   * executable (re)compiles — a recompile mid-run is the invisible
     latency cliff this tooling exists to surface,
+  * compiled programs: the per-program compile + cost/memory events
+    (compiles, flops, bytes accessed, peak memory, fusion count) the
+    flight recorder and the xprof audit journal (`xla_program` events,
+    scripts/hlo_audit.py),
   * top collectives by payload bytes (op+group),
   * non-finite incidents and checkpoints,
   * run status (a `run_end {status: "crashed"}` means the tail of the
@@ -98,6 +102,35 @@ def summarize(events):
     flops = next((_num(c.get("flops")) for c in reversed(compiles)
                   if _num(c.get("flops")) is not None), None)
 
+    # per-program compile + compile-level audit rollup: `compile`
+    # events keyed by label, `xla_program` audit events keyed by
+    # program — one table shows when each executable entered the
+    # process and what the compiler made of it
+    programs = {}
+
+    def _prog(name):
+        return programs.setdefault(name, {
+            "compiles": 0, "compile_s": 0.0, "flops": None,
+            "bytes_accessed": None, "peak_memory_bytes": None,
+            "fusion_count": None})
+
+    for c in compiles:
+        agg = _prog(c.get("label", "?"))
+        agg["compiles"] += int(c.get("count", 1) or 0)
+        agg["compile_s"] += _num(c.get("compile_s")) or 0.0
+        for k in ("flops", "bytes_accessed"):
+            if _num(c.get(k)) is not None:
+                agg[k] = _num(c.get(k))
+    for e in events:
+        if e.get("ev") != "xla_program":
+            continue
+        agg = _prog(e.get("program", "?"))
+        for k in ("flops", "bytes_accessed", "peak_memory_bytes"):
+            if _num(e.get(k)) is not None:
+                agg[k] = _num(e.get(k))
+        if _num(e.get("fusion_count")) is not None:
+            agg["fusion_count"] = int(e["fusion_count"])
+
     by_coll = {}
     for c in colls:
         key = (c.get("op", "?"), c.get("group", "default"))
@@ -118,6 +151,7 @@ def summarize(events):
                 "p50": percentile(mfus, 50),
                 "max": mfus[-1] if mfus else 0.0},
         "step_flops": flops,
+        "programs": {k: programs[k] for k in sorted(programs)},
         "compiles": sum(int(c.get("count", 1)) for c in compiles),
         "compile_s": sum(_num(c.get("compile_s")) or 0.0 for c in compiles),
         "nonfinite": {
@@ -168,6 +202,21 @@ def render(s):
                  f"(host time {s['compile_s']:.2f}s)"
                  + ("  <-- recompiles mid-run!" if s["compiles"] > 1
                     else ""))
+    if s.get("programs"):
+        lines.append("compiled programs:")
+        lines.append(f"  {'program':<26}{'compiles':>9}{'flops':>12}"
+                     f"{'bytes':>12}{'peak mem':>10}{'fusions':>9}")
+        for name, p in s["programs"].items():
+            flops_c = (f"{p['flops']:.3e}" if p["flops"] is not None
+                       else "-")
+            bytes_c = (f"{p['bytes_accessed']:.3e}"
+                       if p["bytes_accessed"] is not None else "-")
+            peak_c = (_fmt_bytes(p["peak_memory_bytes"])
+                      if p["peak_memory_bytes"] is not None else "-")
+            fus_c = (str(p["fusion_count"])
+                     if p["fusion_count"] is not None else "-")
+            lines.append(f"  {name:<26}{p['compiles']:>9}{flops_c:>12}"
+                         f"{bytes_c:>12}{peak_c:>10}{fus_c:>9}")
     nf = s["nonfinite"]
     if nf["count"]:
         at = ", ".join(str(x) for x in nf["steps"])

@@ -1,0 +1,388 @@
+"""XLA program observatory (paddle_tpu/tools/xprof + scripts/hlo_audit.py).
+
+Contracts under test, mirroring the repo-lints-clean pattern:
+
+  * the repo audits CLEAN: `scripts/hlo_audit.py --diff` exits 0
+    against the committed scripts/hlo_baseline.json on this tree;
+  * the gate FIRES: a deliberately de-optimized tracked program
+    (`--inject serving_decode_wave` adds an un-fusable extra HBM pass
+    over the decode wave's float inputs) makes the CLI exit 1;
+  * snapshots are deterministic (two consecutive audits are equal);
+  * graceful degradation: on jax builds where cost/memory/HLO analysis
+    raises or is absent, the audit records null + a reason instead of
+    crashing, and the diff treats null-vs-null as clean;
+  * the shared `normalize_cost_analysis` flattens every return shape
+    jax has used (dict / list-of-dicts / junk) to one form;
+  * `publish()` exports xla_program_* gauges and journals xla_program
+    events through the flight recorder.
+
+The CLI subprocesses pin JAX_PLATFORMS=cpu so the diff runs against the
+cpu-backend baseline even when a TPU is reachable.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.tools import xprof
+from paddle_tpu.tools.xprof import audit
+from paddle_tpu.utils import telemetry
+from paddle_tpu.utils import flight_recorder as fr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "hlo_audit.py")
+BASELINE = os.path.join(REPO, "scripts", "hlo_baseline.json")
+
+ATTN_PROGRAMS = ["cached_decode_attention", "prefill_flash_attention"]
+
+
+def _cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=env, timeout=400)
+
+
+@pytest.fixture(scope="module")
+def attn_snapshot():
+    """One audited snapshot of the (cheap, engine-free) attention
+    programs, shared by the in-process tests."""
+    return xprof.snapshot_programs(xprof.tracked_program_specs(
+        ATTN_PROGRAMS))
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: CLI exit contract against the committed baseline
+# ---------------------------------------------------------------------------
+
+def test_cli_diff_clean_on_this_tree():
+    out = _cli("--diff")
+    assert out.returncode == 0, \
+        f"hlo_audit --diff regressed:\n{out.stdout}\n{out.stderr}"
+    assert "clean" in out.stderr
+
+
+def test_cli_injected_decode_wave_exits_1():
+    """Positive control: a de-optimized copy of the decode wave (extra
+    un-fused pass over its float inputs) must trip the gate."""
+    out = _cli("--diff", "--inject", "serving_decode_wave")
+    assert out.returncode == 1, \
+        f"degraded decode wave passed the gate:\n{out.stdout}\n{out.stderr}"
+    assert "serving_decode_wave" in out.stdout
+    assert "bytes_accessed" in out.stdout    # the headline decode metric
+
+
+def test_cli_refuses_injected_baseline_update():
+    out = _cli("--update-baseline", "--inject", "serving_decode_wave")
+    assert out.returncode == 2
+    assert "refusing" in out.stderr
+
+
+def test_cli_diff_programs_subset_clean():
+    """`--diff --programs SUBSET` gates only the selected programs —
+    the unselected ones must not read as 'tracked program missing'."""
+    out = _cli("--diff", "--programs", "cached_decode_attention")
+    assert out.returncode == 0, \
+        f"subset diff spuriously failed:\n{out.stdout}\n{out.stderr}"
+    assert "missing" not in out.stdout
+
+
+def test_cli_json_with_diff_keeps_stdout_parseable():
+    """--json reserves stdout for the one JSON document; diff findings
+    and notes must not corrupt it."""
+    out = _cli("--json", "--diff", "--programs", "cached_decode_attention")
+    assert out.returncode == 0, out.stderr
+    snap = json.loads(out.stdout)          # whole stream is valid JSON
+    assert "cached_decode_attention" in snap["programs"]
+
+
+def test_subset_baseline_update_keeps_other_programs():
+    """make_baseline(keep_missing=True) — the --programs SUBSET update
+    path — must not silently un-track the unselected programs."""
+    nulls = {m: None for m in audit.METRICS}
+    prev = {"version": 1, "backend": "cpu",
+            "tolerances": audit.DEFAULT_TOLERANCES,
+            "programs": {
+                "kept": {"metrics": dict(nulls, flops=7.0),
+                         "tolerances": {"flops": {"rtol": 0.5}}},
+                "rebanked": {"metrics": dict(nulls, flops=1.0)}}}
+    snap = {"schema": 1, "backend": "cpu", "jax_version": "test",
+            "programs": {"rebanked": {"metrics": dict(nulls, flops=2.0)}}}
+    merged = audit.make_baseline(snap, previous=prev, keep_missing=True)
+    assert set(merged["programs"]) == {"kept", "rebanked"}
+    assert merged["programs"]["kept"]["metrics"]["flops"] == 7.0
+    assert merged["programs"]["kept"]["tolerances"] == \
+        {"flops": {"rtol": 0.5}}
+    assert merged["programs"]["rebanked"]["metrics"]["flops"] == 2.0
+    # a FULL update still drops removed programs deliberately
+    full = audit.make_baseline(snap, previous=prev)
+    assert set(full["programs"]) == {"rebanked"}
+
+
+# ---------------------------------------------------------------------------
+# snapshot semantics (in-process, attention subset: no engine build)
+# ---------------------------------------------------------------------------
+
+def test_snapshot_deterministic(attn_snapshot):
+    again = xprof.snapshot_programs(xprof.tracked_program_specs(
+        ATTN_PROGRAMS))
+    assert again == attn_snapshot
+
+
+def test_snapshot_matches_committed_baseline(attn_snapshot):
+    """The attention rows of scripts/hlo_baseline.json describe THIS
+    tree (guards against a stale baseline commit)."""
+    with open(BASELINE) as f:
+        base = json.load(f)
+    if base["backend"] != attn_snapshot["backend"]:
+        pytest.skip("baseline banked on a different backend")
+    subset = dict(base, programs={k: v for k, v in base["programs"].items()
+                                  if k in ATTN_PROGRAMS})
+    findings, _ = xprof.diff(attn_snapshot, subset)
+    assert findings == [], findings
+
+
+def test_degraded_program_measurably_worse(attn_snapshot):
+    spec, = xprof.tracked_program_specs(["cached_decode_attention"])
+    bad = audit.snapshot_spec(spec, inject=True)
+    assert bad["injected"] is True
+    good = attn_snapshot["programs"]["cached_decode_attention"]
+    assert bad["metrics"]["bytes_accessed"] > \
+        good["metrics"]["bytes_accessed"]
+    assert bad["metrics"]["instruction_count"] > \
+        good["metrics"]["instruction_count"]
+
+
+def test_rollup_shape(attn_snapshot):
+    roll = xprof.rollup(attn_snapshot)
+    assert set(roll) == set(ATTN_PROGRAMS)
+    for row in roll.values():
+        assert set(row) == {"flops", "bytes_accessed", "fusion_count",
+                            "peak_bytes"}
+        assert row["bytes_accessed"] > 0
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation (the jax-0.4.37-quirk contract)
+# ---------------------------------------------------------------------------
+
+class _AnalysesRaise:
+    """Duck-typed jitted: lower() works, every analysis raises."""
+
+    def lower(self, *args, **kw):
+        return self
+
+    def cost_analysis(self):
+        raise RuntimeError("no cost analysis on this build")
+
+    def compile(self):
+        raise NotImplementedError("no AOT compile on this build")
+
+
+class _LowerRaises:
+    def lower(self, *args, **kw):
+        raise TypeError("cannot lower")
+
+
+class _MemoryRaises:
+    """cost + HLO work; memory_analysis is the 0.4.37-style gap."""
+
+    HLO = "\n".join([
+        "HloModule m",
+        "%fused (p: f32[4]) -> f32[4] {",
+        "  %p = f32[4]{0} parameter(0)",
+        "  ROOT %t = f32[4]{0} tanh(f32[4]{0} %p)",
+        "}",
+        "ENTRY %main (a: f32[4]) -> f32[4] {",
+        "  %a = f32[4]{0} parameter(0)",
+        "  ROOT %f = f32[4]{0} fusion(f32[4]{0} %a), kind=kLoop, "
+        "calls=%fused",
+        "}",
+    ])
+
+    def lower(self, *args, **kw):
+        return self
+
+    def cost_analysis(self):
+        return [{"flops": 8.0, "bytes accessed": 32.0}]   # list-of-dicts
+
+    def compile(self):
+        return self
+
+    def memory_analysis(self):
+        raise RuntimeError("memory stats unavailable")
+
+    def as_text(self):
+        return self.HLO
+
+
+def test_degradation_analyses_raise():
+    entry = audit.audit_jitted(_AnalysesRaise())
+    assert entry["cost"] is None and entry["memory"] is None \
+        and entry["hlo"] is None
+    assert "no cost analysis" in entry["unavailable"]["cost"]
+    assert "compile() failed" in entry["unavailable"]["memory"]
+    assert all(v is None for v in entry["metrics"].values())
+
+
+def test_degradation_lower_raises():
+    entry = audit.audit_jitted(_LowerRaises())
+    assert entry["cost"] is None
+    assert "lower() failed" in entry["unavailable"]["hlo"]
+
+
+class _PartialMemory(_MemoryRaises):
+    """memory_analysis exposes only SOME byte fields — a partial peak
+    (args+outputs, no temps) would diff as a spurious improvement, so
+    the section must degrade to null instead."""
+
+    def memory_analysis(self):
+        class Stats:
+            argument_size_in_bytes = 64
+            output_size_in_bytes = 32
+        return Stats()
+
+
+def test_degradation_partial_memory_stats_is_null():
+    entry = audit.audit_jitted(_PartialMemory())
+    assert entry["memory"] is None
+    assert "temp_bytes" in entry["unavailable"]["memory"]
+    assert entry["metrics"]["peak_bytes"] is None
+    assert entry["hlo"]["fusion_count"] == 1     # other analyses survive
+
+
+def test_subset_baseline_update_refuses_cross_backend():
+    nulls = {m: None for m in audit.METRICS}
+    prev = {"version": 1, "backend": "tpu",
+            "programs": {"p": {"metrics": dict(nulls)}}}
+    snap = {"schema": 1, "backend": "cpu", "jax_version": "test",
+            "programs": {"p": {"metrics": dict(nulls)}}}
+    with pytest.raises(ValueError, match="across\\s+backends"):
+        audit.make_baseline(snap, previous=prev, keep_missing=True)
+    # a FULL re-bank across backends is a deliberate replacement: allowed
+    assert audit.make_baseline(snap, previous=prev)["backend"] == "cpu"
+
+
+def test_degradation_memory_only():
+    entry = audit.audit_jitted(_MemoryRaises())
+    assert entry["cost"] == {"flops": 8.0, "bytes_accessed": 32.0}
+    assert entry["memory"] is None
+    assert "memory stats unavailable" in entry["unavailable"]["memory"]
+    assert entry["hlo"]["fusion_count"] == 1
+    assert entry["hlo"]["fusion_kinds"] == {"Loop": 1}
+    assert entry["hlo"]["instruction_count"] == 4
+    assert entry["metrics"]["peak_bytes"] is None
+    assert entry["metrics"]["bytes_accessed"] == 32.0
+
+
+def _prog_snapshot(metrics, backend="cpu"):
+    return {"schema": 1, "backend": backend, "jax_version": "test",
+            "programs": {"p": {"metrics": metrics}}}
+
+
+def _prog_baseline(metrics, backend="cpu"):
+    return {"version": 1, "backend": backend,
+            "tolerances": audit.DEFAULT_TOLERANCES,
+            "programs": {"p": {"metrics": metrics}}}
+
+
+def test_diff_null_vs_null_clean():
+    nulls = {m: None for m in audit.METRICS}
+    findings, notes = xprof.diff(_prog_snapshot(dict(nulls)),
+                                 _prog_baseline(dict(nulls)))
+    assert findings == [] and notes == []
+
+
+def test_diff_capability_loss_is_note_not_finding():
+    nulls = {m: None for m in audit.METRICS}
+    base = dict(nulls, flops=100.0)
+    findings, notes = xprof.diff(_prog_snapshot(dict(nulls)),
+                                 _prog_baseline(base))
+    assert findings == []
+    assert len(notes) == 1 and "capability lost" in notes[0]
+
+
+def test_diff_regression_and_improvement():
+    nulls = {m: None for m in audit.METRICS}
+    base = dict(nulls, bytes_accessed=1000.0, fusion_count=10)
+    cur = dict(nulls, bytes_accessed=100000.0, fusion_count=3)
+    findings, notes = xprof.diff(_prog_snapshot(cur),
+                                 _prog_baseline(base))
+    assert [f["metric"] for f in findings] == ["bytes_accessed"]
+    assert any("improved" in n for n in notes)          # fusion shrank
+
+
+def test_diff_backend_mismatch_skips():
+    nulls = {m: None for m in audit.METRICS}
+    cur = dict(nulls, bytes_accessed=1e12)
+    findings, notes = xprof.diff(_prog_snapshot(cur, backend="tpu"),
+                                 _prog_baseline(dict(nulls)))
+    assert findings == []
+    assert any("backend mismatch" in n for n in notes)
+
+
+def test_diff_missing_tracked_program_is_finding():
+    nulls = {m: None for m in audit.METRICS}
+    snap = _prog_snapshot(dict(nulls))
+    base = _prog_baseline(dict(nulls))
+    base["programs"]["gone"] = {"metrics": dict(nulls)}
+    findings, _ = xprof.diff(snap, base)
+    assert [f["program"] for f in findings] == ["gone"]
+
+
+def test_hlo_histogram_parses_tuple_typed_instructions():
+    """Multi-output fusions and tuple roots carry a parenthesized TUPLE
+    type whose spaces a naive token split misreads as the opcode —
+    kind=kOutput fusions must still land in fusion_count."""
+    from paddle_tpu.tools.xprof.hlo import op_histogram
+    text = "\n".join([
+        "ENTRY %main (a: f32[8]) -> (f32[8], s32[8]) {",
+        "  %a = f32[8]{0} parameter(0)",
+        "  %f = (f32[8]{0}, s32[8]{0}) fusion(f32[8]{0} %a), "
+        "kind=kOutput, calls=%fc",
+        "  ROOT %t = (f32[8]{0}, s32[8]{0}) tuple(f32[8]{0} %a, "
+        "s32[8]{0} %a)",
+        "}",
+    ])
+    h = op_histogram(text)
+    assert h["fusion_count"] == 1
+    assert h["fusion_kinds"] == {"Output": 1}
+    assert h["ops"]["tuple"] == 1
+    assert "s32" not in h["ops"]
+    assert h["instruction_count"] == 3
+
+
+def test_normalize_cost_analysis_shapes():
+    norm = fr.normalize_cost_analysis
+    assert norm({"flops": 5, "bytes accessed": 7}) == \
+        {"flops": 5.0, "bytes_accessed": 7.0}
+    assert norm([{"flops": 5}, {"flops": 99}]) == {"flops": 5.0}
+    assert norm([]) is None
+    assert norm(None) is None
+    assert norm("junk") is None
+    assert norm({"flops": float("nan")}) is None
+    assert norm({"flops": True}) is None        # bool is not a count
+
+
+# ---------------------------------------------------------------------------
+# live export: gauges + flight-recorder journal
+# ---------------------------------------------------------------------------
+
+def test_publish_gauges_and_journal(attn_snapshot):
+    rec = fr.FlightRecorder()          # memory-only
+    xprof.publish(attn_snapshot, recorder=rec)
+    for name in ATTN_PROGRAMS:
+        m = attn_snapshot["programs"][name]["metrics"]
+        assert telemetry.value("xla_program_fusion_count",
+                               {"function": name}) == m["fusion_count"]
+        assert telemetry.value("xla_program_bytes",
+                               {"function": name}) == m["bytes_accessed"]
+        assert telemetry.value("xla_program_peak_memory_bytes",
+                               {"function": name}) == m["peak_bytes"]
+        assert telemetry.value("xla_program_flops",
+                               {"function": name}) == m["flops"]
+    evs = [e for e in rec.events() if e["ev"] == "xla_program"]
+    assert {e["program"] for e in evs} == set(ATTN_PROGRAMS)
+    assert all(e["bytes_accessed"] > 0 for e in evs)

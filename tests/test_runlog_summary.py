@@ -36,6 +36,8 @@ def _generate_journal(path):
         step(xnan, y)
         rec.collective(op="all_reduce", nbytes=4096, group="dp")
         rec.checkpoint(path="ckpt/5", step=5)
+        rec.xla_program("train_step", flops=1.2e9, bytes_accessed=3.4e8,
+                        peak_memory_bytes=26743969, fusion_count=349)
     return path
 
 
@@ -55,6 +57,10 @@ def test_cli_end_to_end(tmp_path):
     assert "non-finite incidents: 1" in text
     assert "all_reduce[dp]" in text and "4.0 KB" in text
     assert "checkpoints: 1" in text
+    # compiled-programs table merges the compile event (TrainStep's
+    # label) with the journaled xla_program audit numbers
+    assert "compiled programs:" in text
+    assert "1.200e+09" in text and "25.5 MB" in text and "349" in text
 
 
 def test_cli_json_mode(tmp_path):
@@ -70,6 +76,12 @@ def test_cli_json_mode(tmp_path):
     assert summary["nonfinite"]["count"] == 1
     assert summary["phases"]["device"]["count"] == 5
     assert summary["collectives"][0]["bytes"] == 4096
+    prog = summary["programs"]["train_step"]
+    assert prog["compiles"] == 1
+    assert prog["fusion_count"] == 349
+    assert prog["peak_memory_bytes"] == 26743969
+    assert prog["flops"] == 1.2e9          # audit value wins over the
+    #                                        compile event's estimate
 
 
 def test_summarize_importable_without_jax_side_effects(tmp_path):
