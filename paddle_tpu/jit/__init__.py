@@ -331,6 +331,10 @@ class TrainStep:
                     jnp.sqrt(gsq), notfinite)
 
         donate_args = (0, 1, 2, 3) if donate else ()
+        # stashed for the program-level audit (tools/jxaudit): jax's
+        # PjitFunction exposes no public donate introspection, so the
+        # declaration of record rides on the TrainStep itself
+        self._donate_argnums = donate_args
         self._compiled = jax.jit(_step, donate_argnums=donate_args)
         # flight-recorder instrumentation (attach_flight_recorder)
         self._recorder = None

@@ -247,7 +247,12 @@ class Optimizer:
             st = self._accumulators.get(id(p))
             if st:
                 for n, arr in st.items():
-                    sd[f"{key}.{n}"] = Tensor(arr)
+                    # copy: step() donates the state buffers (see
+                    # UPDATE_DONATE_ARGNUMS), so a live reference would
+                    # be invalidated by the next step on donation-
+                    # honoring backends — checkpoint-then-continue must
+                    # keep working (same contract as TrainStep.sync)
+                    sd[f"{key}.{n}"] = Tensor(jnp.copy(arr))
         sd["global_step"] = self._global_step
         if isinstance(self._lr, LRScheduler):
             sd["LR_Scheduler"] = self._lr.state_dict()
@@ -264,8 +269,13 @@ class Optimizer:
                 k = f"{key}.{n}"
                 if k in sd:
                     v = sd[k]
-                    st[n] = jnp.asarray(v.numpy() if isinstance(v, Tensor)
-                                        else v)
+                    # copy, never alias: jnp.asarray is a no-op on a jax
+                    # array, and step() donates these slots (see
+                    # UPDATE_DONATE_ARGNUMS) — an aliased checkpoint
+                    # buffer would be deleted out from under the caller
+                    # on the next step
+                    st[n] = jnp.copy(v.numpy() if isinstance(v, Tensor)
+                                     else v)
             if "master" in st and f"{key}.master" not in sd:
                 # resuming from a checkpoint without a master slot: seed
                 # it from the just-loaded weights, else the next step
@@ -275,11 +285,21 @@ class Optimizer:
     set_dict = set_state_dict
 
 
+# param AND state are donated: step() discards both after every call
+# (state[n] is rebound to the returned tuple), so XLA may update the
+# moments in place instead of transiently holding 2x the optimizer
+# state per parameter — jxaudit's donation-missing rule gates this
+# (scripts/jxaudit.py, program `optimizer_update`), and its registry
+# reads THIS constant so the audited declaration cannot drift
+UPDATE_DONATE_ARGNUMS = (0, 4)
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_update(cls):
     """One compiled+donated executable per optimizer class; XLA caches per
     shape/dtype (the OpKernel cache analog)."""
-    return jax.jit(cls._update, donate_argnums=(0,), static_argnums=())
+    return jax.jit(cls._update, donate_argnums=UPDATE_DONATE_ARGNUMS,
+                   static_argnums=())
 
 
 # --------------------------------------------------------------------- rules

@@ -1,0 +1,43 @@
+"""jxaudit: program-level (jaxpr / compiled-HLO) semantic auditor.
+
+ptlint (tools/lint) polices the *source text* and the xprof observatory
+(tools/xprof) measures the *cost* of compiled programs; this package
+checks the *semantics* of what actually got traced and compiled — the
+defect classes that pass both neighbours today and only ever surface as
+a bench regression:
+
+  * a buffer declared in ``donate_argnums`` that XLA silently did not
+    alias (``donation-dropped``), or donatable state that is never
+    donated at all (``donation-missing``) — the dominant
+    silent-memory-waste class per "Automatic Cross-Replica Sharding of
+    Weight Update in Data-Parallel Training" (PAPERS.md);
+  * a large low-precision tensor upcast to f32/f64 on the device path
+    (``dtype-leak``) — dtype-conversion ops are what breaks
+    producer-consumer fusion ("Operator Fusion in XLA", PAPERS.md);
+  * a weight-sized array baked into the program as a closure constant
+    (``baked-constant``) — recompile-per-weight-set plus duplicated HBM;
+  * a host callback reachable from a hot program (``host-callback``).
+
+Audited programs are the xprof registry's tracked programs (the serving
+decode wave/prefill lowered from the engine's own closures, the
+compiled train step, the attention cores) plus the eager optimizer
+update and anything registered through the :func:`audited` decorator.
+Analyses degrade to null + reason on jax builds that can't answer,
+mirroring xprof. CLI: ``scripts/jxaudit.py`` (exit 0 clean / 1 findings
+/ 2 internal error) against the justified baseline
+``scripts/jxaudit_baseline.json``. Rule catalog:
+docs/static_analysis.md ("Program-level rules").
+"""
+from .core import (Finding, ProgramContext, RULES, register,
+                   audit_programs, summarize, publish_summary)
+from .registry import (audited, audited_program_specs, tracked_specs,
+                       tracked_program_names)
+from .inject import INJECTIONS, inject_spec
+from . import rules  # noqa: F401  (registers the built-in rules)
+
+__all__ = [
+    "Finding", "ProgramContext", "RULES", "register", "audit_programs",
+    "summarize", "publish_summary", "audited", "audited_program_specs",
+    "tracked_specs", "tracked_program_names", "INJECTIONS",
+    "inject_spec",
+]

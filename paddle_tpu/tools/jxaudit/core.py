@@ -1,0 +1,558 @@
+"""jxaudit core: program context, rule registry, audit driver.
+
+A *program spec* is the same dict shape the xprof registry builds
+(``{name, fn | jitted, args, jit_kwargs, description}``) extended with
+the donation metadata jxaudit's rules consume:
+
+  * ``donate_argnums`` — the argnums the program's jit wrapper declares
+    (for raw-``fn`` specs this defaults to ``jit_kwargs``'s value; for
+    prebuilt ``jitted`` specs the builder must pass it explicitly —
+    jax 0.4.37's PjitFunction exposes no public donate introspection);
+  * ``arg_names`` — positional parameter names, used by the
+    donatable-state heuristic (defaults to ``inspect.signature(fn)``).
+
+``ProgramContext`` wraps one spec and lazily computes the three views
+rules read, each independently degradable (a jax build that can't
+answer one question must not cost us the others — the failure is
+recorded as a reason string under ``unavailable`` instead of raised,
+the xprof contract):
+
+  * ``closed_jaxpr``  — ``jitted.trace(*args).jaxpr`` (consts + eqns;
+    no compile), falling back to ``jax.make_jaxpr`` on builds without
+    ``.trace``;
+  * ``hlo_text`` / ``aliased_param_indices`` — the compiled
+    executable's optimized-HLO text and the parsed
+    ``input_output_alias`` header. The header is the *actual* aliasing
+    XLA committed to, and — unlike ``memory_analysis()``'s
+    ``alias_size_in_bytes`` — it survives persistent-cache loads, so
+    the donation rule is deterministic warm or cold;
+  * flat-leaf accounting — ``donate_argnums`` is declared per pytree
+    *arg*, the HLO header speaks flat *parameter indices*; the context
+    maps between them (leaves flatten in argument order).
+"""
+import inspect
+
+import numpy as np
+
+
+def _reason(exc):
+    return f"{type(exc).__name__}: {exc}"[:300]
+
+
+def leaf_nbytes(leaf):
+    """HBM footprint of one pytree leaf (arrays or python scalars)."""
+    nb = getattr(leaf, "nbytes", None)
+    if isinstance(nb, (int, np.integer)):
+        return int(nb)
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return np.asarray(leaf).nbytes
+
+
+def np_dtype(dtype):
+    """np.dtype(dtype), or None for jax extended dtypes (PRNG keys,
+    float8 variants numpy can't interpret) — callers skip those.
+    None maps to None (np.dtype(None) would be float64!)."""
+    if dtype is None:
+        return None
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        return None
+
+
+def _dtype_name(dtype):
+    dt = np_dtype(dtype)
+    return dt.name if dt is not None else str(dtype)
+
+
+class Finding:
+    """One program-level audit hit.
+
+    ``message`` must be stable across unrelated edits (deterministic
+    shapes/dtypes are fine, volatile measurements are not) — the
+    baseline fingerprint is (rule, program, message), the same identity
+    contract as ptlint. Quantifications that may degrade (wasted bytes
+    from the compiled analysis) ride in ``details`` instead.
+    """
+
+    __slots__ = ("rule", "program", "severity", "message", "details")
+
+    def __init__(self, rule, program, message, severity="error",
+                 details=None):
+        self.rule = rule
+        self.program = program
+        self.message = message
+        self.severity = severity
+        self.details = dict(details or {})
+
+    @property
+    def fingerprint(self):
+        return f"{self.rule}::{self.program}::{self.message}"
+
+    @property
+    def path(self):
+        """Alias: the program name doubles as ptlint's `path` slot so
+        jxaudit reuses the lint baseline machinery (load/diff/update/
+        undocumented) unchanged — one justified-baseline contract
+        across both analyzers."""
+        return self.program
+
+    def to_dict(self):
+        return {"rule": self.rule, "program": self.program,
+                "severity": self.severity, "message": self.message,
+                "details": self.details}
+
+    def render(self):
+        return f"{self.program}: [{self.rule}/{self.severity}] " \
+               f"{self.message}"
+
+    def __repr__(self):
+        return f"Finding({self.render()!r})"
+
+
+class Rule:
+    id = None
+    severity = "error"
+    rationale = ""
+
+    def check(self, ctx):
+        raise NotImplementedError
+        yield  # pragma: no cover
+
+
+RULES = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the rule registry."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    RULES[inst.id] = inst
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# HLO input_output_alias header parsing
+# ---------------------------------------------------------------------------
+
+def parse_alias_header(hlo_text):
+    """Flat parameter indices the compiled module actually aliases to an
+    output, from the ``input_output_alias={ {out}: (param, {index},
+    may-alias), ... }`` entry on the HloModule header line. A module
+    with no donation committed has no header entry at all — that reads
+    as the empty set, which is exactly what a fully-dropped donation
+    looks like."""
+    header = hlo_text.split("\n", 1)[0]
+    key = "input_output_alias={"
+    start = header.find(key)
+    if start < 0:
+        return set()
+    depth, i = 1, start + len(key)
+    while i < len(header) and depth:
+        if header[i] == "{":
+            depth += 1
+        elif header[i] == "}":
+            depth -= 1
+        i += 1
+    body = header[start + len(key):i - 1]
+    import re
+    return {int(m.group(1))
+            for m in re.finditer(r"\(\s*(\d+)\s*,\s*\{[^}]*\}", body)}
+
+
+_HLO_DTYPE_ABBREV = {
+    "float32": "f32", "float64": "f64", "float16": "f16",
+    "bfloat16": "bf16", "int8": "s8", "int16": "s16", "int32": "s32",
+    "int64": "s64", "uint8": "u8", "uint16": "u16", "uint32": "u32",
+    "uint64": "u64", "bool": "pred", "complex64": "c64",
+    "complex128": "c128",
+}
+
+
+def aval_type_str(aval):
+    """HLO-style type string for an aval/array (``f32[64,64]``), or
+    None when the dtype has no HLO text spelling we can predict (jax
+    extended dtypes) — callers treat None as a wildcard."""
+    dt = np_dtype(getattr(aval, "dtype", None))
+    if dt is None:
+        return None
+    ab = _HLO_DTYPE_ABBREV.get(dt.name)
+    if ab is None:
+        return None
+    shape = getattr(aval, "shape", ())
+    return f"{ab}[{','.join(str(int(s)) for s in shape)}]"
+
+
+def parse_entry_param_types(hlo_text):
+    """Entry parameter type strings (layout braces stripped) from the
+    header's ``entry_computation_layout={(p0, p1, ...)->...}``, or None
+    when the header doesn't parse. jit's default ``keep_unused=False``
+    PRUNES unused args from the executable, so this list can be
+    SHORTER than the flat arg leaves — ``align_leaves_to_params``
+    reconciles the two numberings for the donation rule."""
+    import re
+    header = hlo_text.split("\n", 1)[0]
+    key = "entry_computation_layout={("
+    start = header.find(key)
+    if start < 0:
+        return None
+    i = start + len(key)
+    depth, buf, parts = 1, [], []
+    while i < len(header):
+        c = header[i]
+        if c in "({[":
+            depth += 1
+        elif c in ")}]":
+            depth -= 1
+            if depth == 0:
+                break
+        if c == "," and depth == 1:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(c)
+        i += 1
+    if depth != 0:
+        return None
+    if "".join(buf).strip():
+        parts.append("".join(buf))
+    # strip the /*index=N*/ comments XLA interleaves and the {layout}
+    return [re.sub(r"\{[^{}]*\}", "",
+                   re.sub(r"/\*.*?\*/", "", p)).strip() for p in parts]
+
+
+def align_leaves_to_params(leaf_types, param_types):
+    """Greedy order-preserving alignment of flat arg leaves onto the
+    compiled module's entry parameters -> ({leaf_index: param_index},
+    None) or (None, reason). Leaves the executable pruned are skipped;
+    a None leaf type is a wildcard (extended dtypes). The alignment
+    degrades instead of guessing when it could be wrong: a param no
+    leaf matches, or a pruned leaf whose type also occurs among the
+    KEPT parameters (a same-typed pruned/kept pair is indistinguishable
+    from text, and misattributing donation aliasing is worse than not
+    answering)."""
+    mapping, li, n = {}, 0, len(leaf_types)
+    for pi, pt in enumerate(param_types):
+        matched = False
+        while li < n:
+            lt = leaf_types[li]
+            if lt is None or lt == pt:
+                mapping[li] = pi
+                li += 1
+                matched = True
+                break
+            li += 1                       # this leaf was pruned
+        if not matched:
+            return None, (f"no arg leaf lines up with compiled entry "
+                          f"parameter {pi} ({pt})")
+    unmatched = [i for i in range(n) if i not in mapping]
+    params = set(param_types)
+    ambiguous = sorted({str(leaf_types[i]) for i in unmatched
+                        if leaf_types[i] is None
+                        or leaf_types[i] in params})
+    if ambiguous:
+        return None, ("pruned-arg alignment ambiguous: unused leaf "
+                      f"type(s) {ambiguous} also occur among kept "
+                      "parameters")
+    return mapping, None
+
+
+def iter_eqns(jaxpr):
+    """Every eqn in ``jaxpr`` and (recursively) in any sub-jaxpr carried
+    in eqn params — scan/cond/while bodies, pjit calls, custom-vjp
+    branches. Duck-typed (``.jaxpr`` unwraps a ClosedJaxpr, ``.eqns``
+    marks a Jaxpr) so it tracks no jax.core deprecation churn."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from iter_eqns(sub)
+
+
+def _sub_jaxprs(v):
+    inner = getattr(v, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        yield inner
+    elif hasattr(v, "eqns"):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            yield from _sub_jaxprs(x)
+
+
+# ---------------------------------------------------------------------------
+# per-program context
+# ---------------------------------------------------------------------------
+
+class ProgramContext:
+    """Everything rules need about one tracked program, computed lazily
+    and at most once. ``unavailable`` maps analysis/rule id -> reason
+    string for everything this jax build could not answer."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.name = spec["name"]
+        self.args = spec.get("args", ())
+        self.jit_kwargs = dict(spec.get("jit_kwargs") or {})
+        donate = spec.get("donate_argnums",
+                          self.jit_kwargs.get("donate_argnums", ()))
+        self.donate_argnums = tuple(sorted(donate or ()))
+        self.unavailable = {}
+        self._cache = {}
+
+    def _cached(self, key, build):
+        if key not in self._cache:
+            try:
+                self._cache[key] = build()
+            except Exception as e:
+                self.unavailable.setdefault(key, _reason(e))
+                self._cache[key] = None
+        return self._cache[key]
+
+    # ------------------------------------------------------------- jitted
+    @property
+    def jitted(self):
+        def build():
+            if self.spec.get("jitted") is not None:
+                return self.spec["jitted"]
+            import jax
+            return jax.jit(self.spec["fn"], **self.jit_kwargs)
+        return self._cached("jitted", build)
+
+    # ---------------------------------------------------------- arg names
+    @property
+    def arg_names(self):
+        """Positional parameter names, or None when unknowable (prebuilt
+        jitted spec without explicit ``arg_names``)."""
+        names = self.spec.get("arg_names")
+        if names:
+            return tuple(names)
+        fn = self.spec.get("fn")
+        if fn is None:
+            return None
+        try:
+            params = inspect.signature(fn).parameters.values()
+        except (TypeError, ValueError):
+            return None
+        return tuple(p.name for p in params
+                     if p.kind in (p.POSITIONAL_ONLY,
+                                   p.POSITIONAL_OR_KEYWORD))
+
+    # ------------------------------------------------------- flat leaves
+    @property
+    def arg_leaves(self):
+        """[(argnum, [leaf, ...]), ...] in flattening order."""
+        def build():
+            import jax
+            return [(i, jax.tree_util.tree_leaves(a))
+                    for i, a in enumerate(self.args)]
+        return self._cached("arg_leaves", build)
+
+    def leaf_index_ranges(self):
+        """{argnum: (first_flat_index, n_leaves)} — how the HLO module's
+        flat parameter numbering maps back onto pytree args."""
+        out, offset = {}, 0
+        for argnum, leaves in self.arg_leaves or []:
+            out[argnum] = (offset, len(leaves))
+            offset += len(leaves)
+        return out
+
+    # ------------------------------------------------------------- jaxpr
+    @property
+    def closed_jaxpr(self):
+        def build():
+            jitted = self.jitted
+            if jitted is not None and hasattr(jitted, "trace"):
+                return jitted.trace(*self.args).jaxpr
+            import jax
+            if self.spec.get("fn") is None:
+                raise RuntimeError(
+                    "no .trace() on this jax build and the spec carries "
+                    "no raw fn for make_jaxpr")
+            return jax.make_jaxpr(self.spec["fn"])(*self.args)
+        return self._cached("jaxpr", build)
+
+    # ----------------------------------------------------- compiled view
+    @property
+    def hlo_text(self):
+        def build():
+            jitted = self.jitted
+            if jitted is None:
+                raise RuntimeError("jit wrapper unavailable")
+            return jitted.lower(*self.args).compile().as_text()
+        return self._cached("hlo_text", build)
+
+    @property
+    def aliased_param_indices(self):
+        """Compiled-entry parameter indices XLA actually aliased, or
+        None (+reason) when the compiled text is unavailable."""
+        def build():
+            text = self.hlo_text
+            if text is None:
+                raise RuntimeError(
+                    "compiled HLO unavailable: "
+                    + self.unavailable.get("hlo_text", "unknown"))
+            return parse_alias_header(text)
+        return self._cached("aliased_params", build)
+
+    @property
+    def leaf_param_map(self):
+        """{flat_arg_leaf_index: compiled_entry_parameter_index}, or
+        None (+reason) when the two numberings can't be reconciled —
+        jit's keep_unused=False prunes unused args from the executable,
+        so the map comes from a type-based alignment rather than
+        assumed identity (see align_leaves_to_params)."""
+        def build():
+            text = self.hlo_text
+            if text is None:
+                raise RuntimeError(
+                    "compiled HLO unavailable: "
+                    + self.unavailable.get("hlo_text", "unknown"))
+            params = parse_entry_param_types(text)
+            if params is None:
+                raise RuntimeError(
+                    "entry_computation_layout header unparseable")
+            leaves = [l for _, ls in (self.arg_leaves or []) for l in ls]
+            cj = self.closed_jaxpr
+            if cj is not None \
+                    and len(cj.jaxpr.invars) == len(leaves):
+                # invars carry the CANONICALIZED avals (python floats
+                # become weak f32) — what the HLO params actually are
+                types = [aval_type_str(v.aval) for v in cj.jaxpr.invars]
+            else:
+                types = [aval_type_str(l) for l in leaves]
+            mapping, reason = align_leaves_to_params(types, params)
+            if mapping is None:
+                raise RuntimeError(reason)
+            return mapping
+        return self._cached("leaf_param_map", build)
+
+    # ------------------------------------------------------ float census
+    def float_census(self):
+        """Float bytes AND element counts by precision class over the
+        program's input leaves and closure consts. Elements, not bytes,
+        are the domination metric (a bf16 model's weights hold twice
+        the values per byte — bytes would undercount exactly the
+        tensors that make a program low-precision)."""
+        out = {"low_bytes": 0, "f32_bytes": 0, "f64_bytes": 0,
+               "low_elems": 0, "f32_elems": 0, "f64_elems": 0}
+        leaves = [l for _, ls in (self.arg_leaves or []) for l in ls]
+        cj = self.closed_jaxpr
+        if cj is not None:
+            leaves += list(getattr(cj, "consts", ()))
+        import jax.numpy as jnp
+        low = (np.dtype(jnp.bfloat16), np.dtype(np.float16))
+        for leaf in leaves:
+            dt = np_dtype(getattr(leaf, "dtype", None))
+            if dt is None:
+                continue
+            cls = ("low" if dt in low else
+                   "f32" if dt == np.dtype(np.float32) else
+                   "f64" if dt == np.dtype(np.float64) else None)
+            if cls is None:
+                continue
+            nb = leaf_nbytes(leaf)
+            out[f"{cls}_bytes"] += nb
+            out[f"{cls}_elems"] += nb // dt.itemsize
+        return out
+
+    # ----------------------------------------------------------- helpers
+    def finding(self, rule, message, severity="error", details=None):
+        return Finding(rule, self.name, message, severity=severity,
+                       details=details)
+
+    def degrade(self, rule_id, reason):
+        self.unavailable.setdefault(rule_id, str(reason)[:300])
+
+
+# ---------------------------------------------------------------------------
+# audit driver
+# ---------------------------------------------------------------------------
+
+SCHEMA_VERSION = 1
+
+
+def audit_programs(specs, select=None):
+    """Run every (selected) rule over every spec.
+
+    Returns ``(findings, report)``: findings is the flat
+    line-of-defense list (baseline-diffed by the CLI), report is the
+    JSON-able per-program document — description, per-rule finding
+    counts, and the ``unavailable`` reasons for every analysis this jax
+    build could not answer (null-style degradation, never a crash; an
+    unexpectedly *raising* rule is recorded there too)."""
+    import jax
+    if select is not None:
+        unknown = set(select) - set(RULES)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}; "
+                             f"registry has {sorted(RULES)}")
+    findings, programs = [], {}
+    for spec in specs:
+        ctx = ProgramContext(spec)
+        per_rule = {}
+        for rule_id, rule in sorted(RULES.items()):
+            if select is not None and rule_id not in select:
+                continue
+            try:
+                hits = list(rule.check(ctx))
+            except Exception as e:     # a rule must degrade, not abort
+                ctx.degrade(rule_id, _reason(e))
+                hits = []
+            if hits:
+                per_rule[rule_id] = len(hits)
+            findings.extend(hits)
+        row = {"findings": per_rule,
+               "donate_argnums": list(ctx.donate_argnums)}
+        if spec.get("description"):
+            row["description"] = spec["description"]
+        if spec.get("injected"):
+            row["injected"] = True
+        if ctx.unavailable:
+            row["unavailable"] = dict(ctx.unavailable)
+        programs[ctx.name] = row
+    findings.sort(key=lambda f: (f.program, f.rule, f.message))
+    report = {
+        "schema": SCHEMA_VERSION,
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "programs": programs,
+    }
+    return findings, report
+
+
+def summarize(findings, report):
+    """Compact counts-per-rule summary (the journal payload)."""
+    by_rule = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "findings": len(findings),
+        "by_rule": dict(sorted(by_rule.items())),
+        "programs": len(report.get("programs", {})),
+        "degraded": sum(1 for row in report.get("programs", {}).values()
+                        if row.get("unavailable")),
+    }
+
+
+def publish_summary(findings, report, recorder=None, **extra):
+    """Journal a ``jxaudit`` summary event (counts per rule) through
+    ``recorder`` or the current flight recorder, so a run journal shows
+    the audit verdict next to the compile / xla_program events it
+    contextualizes. Pass the POST-baseline findings (the CLI does) so
+    the journaled verdict matches the exit code; justified suppressions
+    ride along via ``suppressed=N``. No-op without a recorder."""
+    from ...utils import flight_recorder as fr
+    rec = recorder if recorder is not None else fr.get_recorder()
+    if rec is None:
+        return None
+    s = summarize(findings, report)
+    return rec.jxaudit(findings=s["findings"], by_rule=s["by_rule"],
+                       programs=s["programs"], degraded=s["degraded"],
+                       **extra)

@@ -1,0 +1,118 @@
+"""jxaudit positive controls: deliberately introduce each defect class.
+
+``inject_spec(spec, defect)`` returns a modified COPY of a raw-``fn``
+program spec (canonically the serving decode wave) carrying exactly one
+of the defect classes the rules exist to catch. The CLI's
+``--inject CLASS`` audits that copy and must exit 1 — tier-1 proves the
+gate fires (`tests/test_jxaudit.py`), the same contract as ptlint's
+decode-wave float() injection and hlo_audit's degrade(). Never usable
+with ``--baseline-update``.
+
+Each injection is surgical: it introduces its own defect without
+tripping the other rules, so a ``--select``-narrowed audit of the
+injected copy attributes the exit-1 to the intended rule.
+"""
+from .rules import BAKED_CONST_MIN_BYTES, DTYPE_LEAK_MIN_BYTES
+
+
+def _wrap_dropped_donation(spec):
+    """Cast the program's float32 outputs to bf16: the donated f32
+    input buffers (the batched KV cache) no longer dtype-match any
+    output, so XLA silently drops the donation — the exact failure a
+    refactor that changes an output dtype produces."""
+    import jax
+    import jax.numpy as jnp
+    fn = spec["fn"]
+
+    def injected(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if getattr(x, "dtype", None) == jnp.float32 else x, out)
+
+    return dict(spec, fn=injected, jitted=None)
+
+
+def _wrap_dtype_leak(spec):
+    """Feed the program bf16 weights and upcast them back to f32 at
+    entry: the program becomes low-precision-dominated with large
+    bf16 -> f32 convert_element_type ops on the device path — the
+    bf16-KV-cache-upcast-in-the-decode-wave hazard."""
+    import jax
+    import jax.numpy as jnp
+    fn = spec["fn"]
+
+    def down(x):
+        if getattr(x, "dtype", None) == jnp.float32 \
+                and x.nbytes >= DTYPE_LEAK_MIN_BYTES:
+            return x.astype(jnp.bfloat16)
+        return x
+
+    def up(x):
+        if getattr(x, "dtype", None) == jnp.bfloat16:
+            return x.astype(jnp.float32)
+        return x
+
+    # only the params arg (argnum 0) is downcast: the donated caches
+    # keep their dtype, so donation stays intact and the injected copy
+    # trips dtype-leak alone
+    args = list(spec["args"])
+    args[0] = jax.tree_util.tree_map(down, args[0])
+
+    def injected(params, *rest, **kwargs):
+        return fn(jax.tree_util.tree_map(up, params), *rest, **kwargs)
+
+    return dict(spec, fn=injected, args=tuple(args), jitted=None)
+
+
+def _wrap_baked_constant(spec):
+    """Close over a weight-sized array: it lands in the jaxpr's consts
+    — baked into the executable instead of threaded as an argument."""
+    import jax.numpy as jnp
+    fn = spec["fn"]
+    n = BAKED_CONST_MIN_BYTES // 4 * 4        # comfortably past threshold
+    baked = jnp.arange(n, dtype=jnp.float32).reshape(4, n // 4)
+
+    def injected(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        return out, jnp.sum(baked * 1e-9)
+
+    return dict(spec, fn=injected, jitted=None)
+
+
+def _wrap_host_callback(spec):
+    """Put a jax.debug.print on the hot path: a debug_callback
+    primitive (device->host round trip) reachable per call."""
+    import jax
+    fn = spec["fn"]
+
+    def injected(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        jax.debug.print("jxaudit-injected callback: {x}",
+                        x=leaf.reshape(-1)[0])
+        return out
+
+    return dict(spec, fn=injected, jitted=None)
+
+
+INJECTIONS = {
+    "donation-dropped": _wrap_dropped_donation,
+    "dtype-leak": _wrap_dtype_leak,
+    "baked-constant": _wrap_baked_constant,
+    "host-callback": _wrap_host_callback,
+}
+
+
+def inject_spec(spec, defect):
+    """Modified copy of ``spec`` carrying ``defect`` (an INJECTIONS
+    key). The spec must expose a raw ``fn`` to wrap."""
+    if defect not in INJECTIONS:
+        raise ValueError(f"unknown injection {defect!r}; have "
+                         f"{sorted(INJECTIONS)}")
+    if spec.get("fn") is None:
+        raise ValueError(f"program {spec['name']!r} exposes no raw fn "
+                         "to inject into")
+    out = INJECTIONS[defect](spec)
+    out["injected"] = defect
+    return out

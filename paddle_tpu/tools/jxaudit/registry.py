@@ -1,0 +1,133 @@
+"""jxaudit program registry: xprof's tracked programs + extras.
+
+The auditable surface is:
+
+  * every program the xprof observatory tracks (serving decode wave +
+    prefill lowered from the engine's own stashed closures, the
+    compiled train step, the attention cores) — one registry of record,
+    so the semantic audit and the cost audit can never diverge on WHAT
+    they audit;
+  * ``optimizer_update`` — the eager per-parameter optimizer executable
+    (`optimizer._jitted_update`), which the train-step program does NOT
+    cover (TrainStep folds the update into its own donated program;
+    eager `Model.fit` / `opt.step()` training runs this one);
+  * anything registered through the :func:`audited` decorator — the
+    hook for new subsystems to opt their hot programs into the audit
+    without touching this module.
+"""
+AUDITED = {}
+
+
+def audited(name=None, *, args=None, jit_kwargs=None, donate_argnums=None,
+            arg_names=None, description=None):
+    """Decorator: register a function as a jxaudit-tracked program.
+
+        @jxaudit.audited("paged_attention",
+                         args=lambda: (q, kv, tables),
+                         jit_kwargs={"donate_argnums": (1,)})
+        def paged_attention(q, kv, tables): ...
+
+    ``args`` is the example-argument tuple or a zero-arg callable
+    building one lazily (evaluated only when the audit runs — never at
+    import). The decorated function is returned unchanged."""
+    def deco(fn):
+        prog = name or fn.__name__
+        if prog in AUDITED or prog in _builtin_names():
+            raise ValueError(f"jxaudit program {prog!r} already "
+                             "registered")
+        AUDITED[prog] = {
+            "fn": fn, "args": args, "jit_kwargs": dict(jit_kwargs or {}),
+            "donate_argnums": donate_argnums, "arg_names": arg_names,
+            "description": description,
+        }
+        return fn
+    return deco
+
+
+def _builtin_names():
+    from ..xprof import registry as xprof_registry
+    return xprof_registry.TRACKED_PROGRAMS + ("optimizer_update",)
+
+
+def audited_program_specs(names=None):
+    """Build specs for decorator-registered programs (lazy args)."""
+    specs = []
+    for prog, row in sorted(AUDITED.items()):
+        if names is not None and prog not in names:
+            continue
+        args = row["args"]
+        if callable(args):
+            args = args()
+        spec = {"name": prog, "fn": row["fn"], "args": tuple(args or ()),
+                "jit_kwargs": row["jit_kwargs"]}
+        if row["donate_argnums"] is not None:
+            spec["donate_argnums"] = tuple(row["donate_argnums"])
+        if row["arg_names"]:
+            spec["arg_names"] = tuple(row["arg_names"])
+        if row["description"]:
+            spec["description"] = row["description"]
+        specs.append(spec)
+    return specs
+
+
+# canonical shape for the eager optimizer update: one mid-sized layer's
+# weight matrix (1 MiB param, 2 MiB Adam state) — structure is what the
+# rules inspect, capacity is irrelevant
+OPT_UPDATE_SHAPE = (512, 512)
+
+
+def _optimizer_update_spec():
+    import jax.numpy as jnp
+    from ...optimizer import optimizer as opt_mod
+
+    p = jnp.zeros(OPT_UPDATE_SHAPE, jnp.float32)
+    g = jnp.ones(OPT_UPDATE_SHAPE, jnp.float32)
+    state = (jnp.zeros_like(p), jnp.zeros_like(p))   # AdamW (m, v)
+    hyper = (0.9, 0.999, 1e-8, 0.01)
+    args = (p, g, jnp.asarray(1e-3, jnp.float32), hyper, state,
+            jnp.asarray(1, jnp.int32))
+    return {
+        "name": "optimizer_update",
+        "fn": opt_mod.AdamW._update,
+        "args": args,
+        # the wrapper optimizer.step() actually calls, with ITS donation
+        # declaration — read from the one constant _jitted_update uses,
+        # so this spec cannot drift from the eager training path
+        "jitted": opt_mod._jitted_update(opt_mod.AdamW),
+        "donate_argnums": opt_mod.UPDATE_DONATE_ARGNUMS,
+        "arg_names": ("p", "g", "lr", "hyper", "state", "step"),
+        "description": "eager per-parameter AdamW update (the "
+                       "opt.step() executable, one (512,512) leaf)",
+    }
+
+
+def tracked_specs(names=None):
+    """All audited program specs (or the named subset): the xprof
+    registry's five, ``optimizer_update``, and decorator registrations.
+    Builders run lazily — auditing one attention core never constructs
+    an engine."""
+    from ..xprof import registry as xprof_registry
+
+    # the decorator refuses collisions with built-in names, so `known`
+    # is duplicate-free by construction
+    known = _builtin_names() + tuple(sorted(AUDITED))
+    want = list(names) if names else list(known)
+    unknown = set(want) - set(known)
+    if unknown:
+        raise ValueError(f"unknown audited programs {sorted(unknown)}; "
+                         f"registry has {list(known)}")
+    specs = []
+    xprof_names = [n for n in want if n in xprof_registry.TRACKED_PROGRAMS]
+    if xprof_names:
+        specs += xprof_registry.tracked_program_specs(xprof_names)
+    if "optimizer_update" in want:
+        specs.append(_optimizer_update_spec())
+    specs += audited_program_specs([n for n in want if n in AUDITED])
+    order = {n: i for i, n in enumerate(want)}
+    specs.sort(key=lambda s: order.get(s["name"], len(order)))
+    return specs
+
+
+def tracked_program_names():
+    """Current full program-name tuple (decorators may add to it)."""
+    return _builtin_names() + tuple(sorted(AUDITED))

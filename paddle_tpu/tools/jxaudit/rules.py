@@ -1,0 +1,284 @@
+"""jxaudit built-in rules.
+
+Each rule reads the :class:`~.core.ProgramContext` views it needs and
+yields :class:`~.core.Finding`s with stable messages (the baseline
+identity). A rule that cannot answer on this jax build records a
+reason via ``ctx.degrade`` and yields nothing — degradation is a
+non-gating note, exactly the xprof contract.
+
+Thresholds are tuned for the registry's canonical audit shapes (tiny
+2-layer models — HLO *structure*, not capacity, is what tier-1 audits):
+at production shapes every threshold is conservative by orders of
+magnitude, and a program registered via ``@audited`` at real shapes
+gets the same absolute floors.
+"""
+import numpy as np
+
+from .core import (Rule, register, iter_eqns, leaf_nbytes, np_dtype,
+                   _dtype_name)
+
+# an un-donated state arg smaller than this is not worth a finding
+# (scalars, flags, RNG keys); the serving KV cache at the canonical
+# audit shape is ~128 KiB, real optimizer state is GBs
+DONATABLE_STATE_MIN_BYTES = 65536
+# smallest low-precision tensor whose f32 upcast we flag — at the
+# canonical shapes the weight matrices are 16-32 KiB
+DTYPE_LEAK_MIN_BYTES = 16384
+# smallest closure constant treated as "baked weights" rather than a
+# legitimate trace-time table (iota vectors, causal masks)
+BAKED_CONST_MIN_BYTES = 65536
+
+# positional parameter names that mark an arg as replace-each-call
+# state the caller could donate (the KV cache / optimizer-state naming
+# convention the engine, TrainStep, heter PS and the optimizers share)
+STATE_ARG_NAMES = frozenset({
+    "caches", "cache", "kv_cache", "kv_caches", "cache_rows",
+    "opt_state", "state", "grad_acc", "acc",
+})
+
+_LOW_FLOATS = ("bfloat16", "float16")
+CALLBACK_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback",
+})
+
+
+def _fmt(dtype, shape):
+    return f"{_dtype_name(dtype)}[{','.join(str(int(s)) for s in shape)}]"
+
+
+@register
+class DonationDropped(Rule):
+    id = "donation-dropped"
+    severity = "error"
+    rationale = ("An arg declared in donate_argnums that XLA did not "
+                 "actually alias to an output silently costs its full "
+                 "HBM footprint twice per call — the donation is "
+                 "dropped (dtype/shape mismatch with every output) "
+                 "with only a one-time warning nobody reads.")
+
+    def check(self, ctx):
+        if not ctx.donate_argnums:
+            return
+        aliased = ctx.aliased_param_indices
+        if aliased is None:
+            ctx.degrade(self.id, "compiled HLO unavailable: "
+                        + ctx.unavailable.get(
+                            "hlo_text",
+                            ctx.unavailable.get("aliased_params", "?")))
+            return
+        mapping = ctx.leaf_param_map
+        if mapping is None:
+            ctx.degrade(self.id,
+                        "cannot map arg leaves onto compiled entry "
+                        "parameters: "
+                        + ctx.unavailable.get("leaf_param_map", "?"))
+            return
+        ranges = ctx.leaf_index_ranges()
+        names = ctx.arg_names
+        for argnum in ctx.donate_argnums:
+            first, n = ranges.get(argnum, (0, 0))
+            if n == 0:
+                continue            # empty pytree: nothing to donate
+            # a donated leaf the executable PRUNED (not in the map) is
+            # dropped by definition — an unused arg cannot alias
+            dropped = [i for i in range(first, first + n)
+                       if mapping.get(i) not in aliased]
+            if not dropped:
+                continue
+            label = (f"'{names[argnum]}'" if names
+                     and argnum < len(names) else f"#{argnum}")
+            wasted, reason = self._wasted_bytes(ctx, argnum, first,
+                                                dropped)
+            details = {"argnum": argnum, "dropped_leaves": dropped,
+                       "declared_leaves": n, "wasted_bytes": wasted}
+            if reason:
+                details["wasted_bytes_reason"] = reason
+            yield ctx.finding(
+                self.id,
+                f"donated arg {label}: {len(dropped)}/{n} buffers were "
+                "not aliased by XLA — the donation was dropped "
+                "(an output dtype/shape no longer matches the donated "
+                "input)",
+                severity=self.severity, details=details)
+
+    @staticmethod
+    def _wasted_bytes(ctx, argnum, first, dropped):
+        """Transient duplicate HBM of the dropped leaves, from the
+        compiled program's own input buffers. (None, reason) when this
+        build can't answer — non-gating, the finding still stands."""
+        try:
+            leaves = dict(ctx.arg_leaves or [])[argnum]
+            return sum(leaf_nbytes(leaves[i - first])
+                       for i in dropped), None
+        except Exception as e:
+            return None, f"{type(e).__name__}: {e}"[:200]
+
+
+@register
+class DonationMissing(Rule):
+    id = "donation-missing"
+    severity = "warning"
+    rationale = ("A large replace-each-call state arg (KV cache, "
+                 "optimizer state) outside donate_argnums makes every "
+                 "call transiently hold two copies of it in HBM; "
+                 "donation lets XLA update it in place.")
+
+    def check(self, ctx):
+        names = ctx.arg_names
+        if names is None:
+            ctx.degrade(self.id, "positional arg names unavailable "
+                        "(prebuilt jitted spec without arg_names)")
+            return
+        donated = set(ctx.donate_argnums)
+        for argnum, leaves in ctx.arg_leaves or []:
+            if argnum in donated or argnum >= len(names):
+                continue
+            name = names[argnum]
+            if name not in STATE_ARG_NAMES or not leaves:
+                continue
+            nbytes = sum(leaf_nbytes(l) for l in leaves)
+            if nbytes < DONATABLE_STATE_MIN_BYTES:
+                continue
+            yield ctx.finding(
+                self.id,
+                f"state arg '{name}' (#{argnum}) is never donated: the "
+                "caller replaces it each call, so donate_argnums would "
+                "let XLA update it in place instead of holding two "
+                "copies",
+                severity=self.severity,
+                details={"argnum": argnum, "bytes": nbytes,
+                         "leaves": len(leaves)})
+
+
+@register
+class DtypeLeak(Rule):
+    id = "dtype-leak"
+    severity = "warning"
+    rationale = ("convert_element_type upcasts of large tensors to "
+                 "f32/f64 inside a low-precision program double the "
+                 "HBM stream on the exact paths bf16 exists to halve, "
+                 "and break producer-consumer fusion; f64 anywhere on "
+                 "a device path is an x64 leak.")
+
+    def check(self, ctx):
+        cj = ctx.closed_jaxpr
+        if cj is None:
+            ctx.degrade(self.id, "jaxpr unavailable: "
+                        + ctx.unavailable.get("jaxpr", "?"))
+            return
+        census = ctx.float_census()
+        low_dominated = census["low_elems"] > (census["f32_elems"]
+                                               + census["f64_elems"])
+        f64_seen = set()
+        for var in self._all_vars(cj):
+            aval = getattr(var, "aval", None)
+            dt = np_dtype(getattr(aval, "dtype", None))
+            if dt is not None and dt == np.dtype(np.float64):
+                key = _fmt(dt, getattr(aval, "shape", ()))
+                if key not in f64_seen:
+                    f64_seen.add(key)
+                    yield ctx.finding(
+                        self.id,
+                        f"float64 value {key} on the device path — an "
+                        "x64 leak (double the bytes of f32 and no TPU "
+                        "support)",
+                        severity="error",
+                        details={"dtype": "float64"})
+        if not low_dominated:
+            return
+        seen = {}
+        for eqn in iter_eqns(cj.jaxpr):
+            if getattr(eqn.primitive, "name",
+                       str(eqn.primitive)) != "convert_element_type":
+                continue
+            new_dt = np_dtype(eqn.params.get("new_dtype"))
+            aval = getattr(eqn.invars[0], "aval", None)
+            old_dt = np_dtype(getattr(aval, "dtype", None))
+            if new_dt is None or old_dt is None:
+                continue
+            if _dtype_name(old_dt) not in _LOW_FLOATS \
+                    or new_dt.name not in ("float32", "float64"):
+                continue
+            nbytes = int(np.prod(aval.shape, dtype=np.int64)) \
+                * old_dt.itemsize
+            if nbytes < DTYPE_LEAK_MIN_BYTES:
+                continue
+            key = (_fmt(old_dt, aval.shape), new_dt.name)
+            seen[key] = seen.get(key, 0) + 1
+        for (old, new), count in sorted(seen.items()):
+            for _ in range(count):
+                yield ctx.finding(
+                    self.id,
+                    f"{old} -> {new} upcast on the device path of a "
+                    "low-precision-dominated program (doubles the HBM "
+                    "stream and splits fusions at the conversion)",
+                    severity=self.severity,
+                    details={"from": old, "to": new})
+
+    @staticmethod
+    def _all_vars(cj):
+        yield from cj.jaxpr.constvars
+        yield from cj.jaxpr.invars
+        for eqn in iter_eqns(cj.jaxpr):
+            yield from eqn.outvars
+
+
+@register
+class BakedConstant(Rule):
+    id = "baked-constant"
+    severity = "error"
+    rationale = ("A weight-sized array captured by closure becomes a "
+                 "compile-time constant: it is duplicated into every "
+                 "compiled variant's HBM and changing its VALUE means "
+                 "a full recompile — thread it as an argument instead.")
+
+    def check(self, ctx):
+        cj = ctx.closed_jaxpr
+        if cj is None:
+            ctx.degrade(self.id, "jaxpr unavailable: "
+                        + ctx.unavailable.get("jaxpr", "?"))
+            return
+        for const in getattr(cj, "consts", ()):
+            shape = getattr(const, "shape", None)
+            dtype = getattr(const, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            nbytes = leaf_nbytes(const)
+            if nbytes < BAKED_CONST_MIN_BYTES:
+                continue
+            yield ctx.finding(
+                self.id,
+                f"closure-captured constant {_fmt(dtype, shape)} "
+                f"({nbytes} bytes) baked into the program — duplicated "
+                "HBM per compiled variant and a recompile per value; "
+                "pass it as an argument",
+                severity=self.severity,
+                details={"bytes": nbytes})
+
+
+@register
+class HostCallback(Rule):
+    id = "host-callback"
+    severity = "error"
+    rationale = ("pure_callback / io_callback / debug_callback (incl. "
+                 "jax.debug.print) in a hot program force a device-to-"
+                 "host round trip every call — the decode-wave latency "
+                 "cliff telemetry keeps finding after the fact.")
+
+    def check(self, ctx):
+        cj = ctx.closed_jaxpr
+        if cj is None:
+            ctx.degrade(self.id, "jaxpr unavailable: "
+                        + ctx.unavailable.get("jaxpr", "?"))
+            return
+        for eqn in iter_eqns(cj.jaxpr):
+            name = getattr(eqn.primitive, "name", str(eqn.primitive))
+            if name in CALLBACK_PRIMITIVES:
+                yield ctx.finding(
+                    self.id,
+                    f"host callback primitive '{name}' reachable in "
+                    "this program (device->host round trip per call); "
+                    "hoist it out of the hot path or gate it behind a "
+                    "debug build",
+                    severity=self.severity,
+                    details={"primitive": name})

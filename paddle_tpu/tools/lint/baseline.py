@@ -72,6 +72,15 @@ def undocumented(entries):
             or e["justification"] == TODO_JUSTIFICATION]
 
 
+def gate(findings, entries):
+    """The shared clean-run verdict both ptlint and jxaudit exit on:
+    (new_findings, suppressed_count, undocumented_entries, clean).
+    One implementation so the two CLIs' exit contracts cannot drift."""
+    new, suppressed = diff(findings, entries)
+    undoc = undocumented(entries)
+    return new, suppressed, undoc, (not new and not undoc)
+
+
 def update(findings, old_entries, path, keep=()):
     """Write a fresh baseline covering exactly `findings`, carrying
     justifications over from `old_entries` where the identity survives.

@@ -243,6 +243,51 @@ class HostSyncInTrace(Rule):
                     "time or syncs; keep it a jax array")
 
 
+# positional parameter names marking replace-each-call state a jit
+# wrapper could donate — THE shared vocabulary with the program-level
+# check (one definition, so the two rules cannot drift; jxaudit's
+# module bodies import nothing heavier than what the paddle_tpu
+# package import already paid for)
+from ...jxaudit.rules import STATE_ARG_NAMES as STATE_PARAM_NAMES
+
+
+@register
+class DonateHint(Rule):
+    id = "donate-hint"
+    rationale = ("A jit/pjit call site threading large state trees "
+                 "(KV caches, optimizer state, gradient accumulators) "
+                 "without any donate_argnums makes every call "
+                 "transiently hold two HBM copies of that state; "
+                 "jxaudit's donation rules (scripts/jxaudit.py) are "
+                 "the authoritative program-level check.")
+
+    def check(self, ctx):
+        tree = ctx.tree
+        defs = _local_defs(tree)
+        parents = astutil.parents_of(ctx)
+        _, jit_calls = traced_analysis(ctx)
+        for call in jit_calls:
+            if any(kw.arg is None or (kw.arg and "donate" in kw.arg)
+                   for kw in call.keywords):
+                # declares a donation — or splats **kwargs, which may
+                # carry one we can't see: unknown, don't cry wolf
+                continue
+            if not call.args or not isinstance(call.args[0], ast.Name):
+                continue
+            for cand in _resolve(call.args[0].id, call, defs, parents):
+                state = sorted(set(astutil.param_names(cand))
+                               & STATE_PARAM_NAMES)
+                if state:
+                    yield ctx.finding(
+                        self.id, call,
+                        f"jit({cand.name}) threads state arg(s) "
+                        f"{', '.join(state)} with no donate_argnums: "
+                        "each call transiently doubles that state in "
+                        "HBM; donate it (authoritative program-level "
+                        "check: scripts/jxaudit.py)")
+                    break
+
+
 def _loop_bound(loop):
     """Names (re)bound inside a loop body (incl. the loop target)."""
     out = set()

@@ -99,6 +99,12 @@ def train_step_spec(step, inputs, labels):
             jax.random.PRNGKey(0), jnp.asarray(1e-4, jnp.float32),
             jnp.asarray(1, jnp.int32), tuple(inputs), tuple(labels))
     return {"name": "train_step", "jitted": step._compiled, "args": args,
+            # donation metadata for the semantic audit (tools/jxaudit):
+            # a prebuilt jitted carries no introspectable donate info,
+            # so the spec passes the TrainStep's own declaration through
+            "donate_argnums": getattr(step, "_donate_argnums", ()),
+            "arg_names": ("params", "buffers", "opt_state", "acc", "key",
+                          "lr", "step_i", "inputs", "labels"),
             "description": "forward+backward+optimizer, one donated "
                            "executable (canonical 2-layer GPT)"}
 

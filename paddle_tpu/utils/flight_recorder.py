@@ -6,7 +6,8 @@ run actually did, step by step:
 
   * `FlightRecorder` writes one JSON object per line (`run_start`,
     `step`, `compile`, `nonfinite`, `collective`, `checkpoint`,
-    `run_end`). Events are ring-buffered (`ring_size`) between disk
+    `xla_program`, `jxaudit`, `run_end`). Events are ring-buffered
+    (`ring_size`) between disk
     flushes, so a pathological run keeps bounded memory/IO and the LAST
     N events — the ones that explain the crash — always reach the
     journal: the context manager flushes on exception and appends a
@@ -40,7 +41,7 @@ class NonFiniteError(RuntimeError):
 
 
 EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
-               "checkpoint", "xla_program", "run_end")
+               "checkpoint", "xla_program", "jxaudit", "run_end")
 
 
 def _json_safe(v):
@@ -227,6 +228,23 @@ class FlightRecorder:
                                else float(peak_memory_bytes)),
             fusion_count=(None if fusion_count is None
                           else int(fusion_count)), **extra)
+
+    def jxaudit(self, findings, by_rule=None, programs=None,
+                degraded=None, **extra):
+        """Semantic-audit verdict for the tracked programs (the jxaudit
+        journal hook — rides next to compile / xla_program events so
+        one journal shows what compiled, what it cost, and whether its
+        semantics audit clean). `by_rule` maps rule id -> finding
+        count; zero findings journals as a clean stamp, not silence."""
+        fields = {"findings": int(findings),
+                  "by_rule": {str(k): int(v)
+                              for k, v in sorted((by_rule or {}).items())}}
+        if programs is not None:
+            fields["programs"] = int(programs)
+        if degraded is not None:
+            fields["degraded"] = int(degraded)
+        fields.update(extra)
+        return self.record("jxaudit", **fields)
 
     def checkpoint(self, path=None, step=None, **extra):
         fields = {}

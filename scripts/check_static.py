@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""check_static — the repo's three static/compile-level gates in ONE
+process with a merged report and a single exit code:
+
+  * ptlint     — source-level JAX-aware lint (tools/lint);
+  * hlo_audit  — compile-level cost/fusion/memory regression diff
+                 (tools/xprof) against scripts/hlo_baseline.json;
+  * jxaudit    — program-level semantic audit (tools/jxaudit): donation,
+                 dtype leaks, baked constants, host callbacks against
+                 scripts/jxaudit_baseline.json.
+
+    python scripts/check_static.py            # all three, text report
+    python scripts/check_static.py --json     # one merged JSON document
+    python scripts/check_static.py --skip hlo_audit
+
+Exit codes: 0 every gate clean, 1 any gate has findings/regressions,
+2 any gate hit an internal error (2 wins over 1). Tier-1 invokes this
+once (tests/test_check_static.py) instead of three separate subprocess
+tests; the three standalone CLIs keep working unchanged — this runner
+imports and drives their own `run()` entry points, so there is exactly
+one implementation of each gate's semantics.
+
+Sharing one process matters on the 1-core CI box: jax imports once, the
+persistent compile cache is shared, and hlo_audit + jxaudit lower the
+same tracked programs back to back while everything is warm.
+"""
+import argparse
+import contextlib
+import importlib.util
+import io
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+GATES = ("ptlint", "hlo_audit", "jxaudit")
+GATE_ARGS = {"ptlint": [], "hlo_audit": ["--diff"], "jxaudit": []}
+
+
+def _load_cli(name):
+    """Import a sibling CLI script as a module (scripts/ is not a
+    package on purpose — they are entry points, not a library)."""
+    path = os.path.join(REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_check_static_{name}",
+                                                 path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_gate(name, as_json):
+    """-> (exit_code, parsed_json_or_None, captured_text). In JSON mode
+    the gate's stdout is one JSON document (their --json contract);
+    stderr passes through either way."""
+    mod = _load_cli(name)
+    argv = list(GATE_ARGS[name])
+    if as_json and "--json" not in argv:
+        argv.append("--json")
+    buf = io.StringIO()
+    try:
+        with contextlib.redirect_stdout(buf):
+            rc = mod.run(argv)
+    except SystemExit as e:          # argparse usage error inside a gate
+        rc = e.code if isinstance(e.code, int) else 2
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        rc = 2
+    text = buf.getvalue()
+    doc = None
+    if as_json and text.strip():
+        try:
+            doc = json.loads(text)
+        except ValueError:
+            doc = {"unparseable_output": text[-2000:]}
+    return rc, doc, text
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="check_static",
+        description="run ptlint + hlo_audit --diff + jxaudit as one "
+                    "gate")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="merged machine-readable report on stdout")
+    ap.add_argument("--skip", default=None,
+                    help="comma-separated gates to skip "
+                         f"(of {', '.join(GATES)})")
+    args = ap.parse_args(argv)
+
+    skip = {s.strip() for s in (args.skip or "").split(",") if s.strip()}
+    unknown = skip - set(GATES)
+    if unknown:
+        print(f"check_static: unknown gate(s) {sorted(unknown)}",
+              file=sys.stderr)
+        return 2
+    if skip >= set(GATES):
+        print("check_static: --skip covers every gate — a run that "
+              "checks nothing must not report clean", file=sys.stderr)
+        return 2
+
+    codes, docs = {}, {}
+    for name in GATES:
+        if name in skip:
+            continue
+        rc, doc, text = run_gate(name, args.as_json)
+        codes[name] = rc
+        docs[name] = doc
+        if not args.as_json:
+            verdict = {0: "clean", 1: "FINDINGS"}.get(rc, "ERROR")
+            print(f"== {name}: {verdict} (exit {rc}) ==")
+            if text.strip():
+                print(text.rstrip())
+
+    overall = 2 if any(c == 2 for c in codes.values()) \
+        else 1 if any(c for c in codes.values()) else 0
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "status": {0: "clean", 1: "findings"}.get(overall, "error"),
+            "exit_codes": codes,
+            "gates": docs,
+        }, indent=2))
+    else:
+        summary = " ".join(f"{k}={v}" for k, v in codes.items())
+        print(f"check_static: {'clean' if overall == 0 else 'NOT clean'} "
+              f"({summary})", file=sys.stderr)
+    return overall
+
+
+if __name__ == "__main__":
+    sys.exit(main())

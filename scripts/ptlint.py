@@ -105,9 +105,8 @@ def run(argv):
                   "committing)", file=sys.stderr)
         return 0
 
-    new, suppressed = lint.baseline.diff(findings, entries)
-    undocumented = lint.baseline.undocumented(entries)
-    clean = not new and not undocumented
+    new, suppressed, undocumented, clean = lint.baseline.gate(findings,
+                                                              entries)
 
     if args.as_json:
         print(json.dumps({

@@ -17,6 +17,8 @@ schema in docs/observability.md. The report covers:
     (compiles, flops, bytes accessed, peak memory, fusion count) the
     flight recorder and the xprof audit journal (`xla_program` events,
     scripts/hlo_audit.py),
+  * the latest semantic-audit verdict (`jxaudit` events,
+    scripts/jxaudit.py) — clean stamp or findings-per-rule,
   * top collectives by payload bytes (op+group),
   * non-finite incidents and checkpoints,
   * run status (a `run_end {status: "crashed"}` means the tail of the
@@ -131,6 +133,20 @@ def summarize(events):
         if _num(e.get("fusion_count")) is not None:
             agg["fusion_count"] = int(e["fusion_count"])
 
+    # semantic audit: the LAST jxaudit event is the verdict of record
+    # for this journal (re-audits supersede; runs are counted)
+    jxa = [e for e in events if e.get("ev") == "jxaudit"]
+    jxaudit = None
+    if jxa:
+        last = jxa[-1]
+        jxaudit = {
+            "runs": len(jxa),
+            "findings": int(last.get("findings", 0) or 0),
+            "by_rule": dict(last.get("by_rule") or {}),
+            "programs": last.get("programs"),
+            "degraded": last.get("degraded"),
+        }
+
     by_coll = {}
     for c in colls:
         key = (c.get("op", "?"), c.get("group", "default"))
@@ -154,6 +170,7 @@ def summarize(events):
         "programs": {k: programs[k] for k in sorted(programs)},
         "compiles": sum(int(c.get("count", 1)) for c in compiles),
         "compile_s": sum(_num(c.get("compile_s")) or 0.0 for c in compiles),
+        "jxaudit": jxaudit,
         "nonfinite": {
             "count": len(nonfinite),
             "steps": [e["step"] for e in nonfinite if "step" in e][:10],
@@ -217,6 +234,20 @@ def render(s):
                      if p["fusion_count"] is not None else "-")
             lines.append(f"  {name:<26}{p['compiles']:>9}{flops_c:>12}"
                          f"{bytes_c:>12}{peak_c:>10}{fus_c:>9}")
+    j = s.get("jxaudit")
+    if j:
+        progs = f" ({j['programs']} programs)" if j.get("programs") \
+            else ""
+        if j["findings"]:
+            rules = ", ".join(f"{k}={v}"
+                              for k, v in sorted(j["by_rule"].items()))
+            lines.append(f"semantic audit (jxaudit): {j['findings']} "
+                         f"finding(s){progs} — {rules}")
+        else:
+            lines.append(f"semantic audit (jxaudit): clean{progs}")
+        if j.get("degraded"):
+            lines.append(f"  ({j['degraded']} program(s) with "
+                         "unavailable analyses on this jax build)")
     nf = s["nonfinite"]
     if nf["count"]:
         at = ", ".join(str(x) for x in nf["steps"])

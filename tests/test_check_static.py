@@ -1,0 +1,54 @@
+"""check_static: the unified static/compile-level gate (tier-1).
+
+ONE subprocess runs all three analyzers — ptlint, hlo_audit --diff,
+jxaudit — in one process against their committed baselines; this is
+the repo-is-clean assertion that used to be three separate subprocess
+tests (tests/test_ptlint.py and tests/test_hlo_audit.py keep the
+per-tool fixtures and the gate-FIRES injection proofs; the standalone
+CLIs are unchanged). Sharing the process shares the jax import and the
+persistent compile cache between the two program-lowering gates.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_static.py")
+
+
+def _cli(*args, timeout=700):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=env, timeout=timeout)
+
+
+def test_repo_is_static_clean_single_gate():
+    """ptlint + hlo_audit + jxaudit all exit 0 on this tree, through
+    one process and one merged JSON document."""
+    out = _cli("--json")
+    assert out.returncode == 0, \
+        f"static gate not clean:\n{out.stdout[-4000:]}\n{out.stderr[-2000:]}"
+    doc = json.loads(out.stdout)
+    assert doc["status"] == "clean"
+    assert doc["exit_codes"] == {"ptlint": 0, "hlo_audit": 0,
+                                 "jxaudit": 0}
+    # each gate's own document made it into the merge
+    assert doc["gates"]["ptlint"]["status"] == "clean"
+    assert doc["gates"]["ptlint"]["counts"]["baseline_undocumented"] == 0
+    assert doc["gates"]["jxaudit"]["status"] == "clean"
+    assert "programs" in doc["gates"]["hlo_audit"]     # the snapshot
+
+
+def test_skip_narrows_the_gate():
+    out = _cli("--skip", "hlo_audit,jxaudit", "--json")
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert set(doc["exit_codes"]) == {"ptlint"}
+    bad = _cli("--skip", "nonsense")
+    assert bad.returncode == 2
+    # skipping EVERY gate must error, not report a vacuous clean
+    allskip = _cli("--skip", "ptlint,hlo_audit,jxaudit")
+    assert allskip.returncode == 2
+    assert "checks nothing" in allskip.stderr

@@ -57,12 +57,9 @@ def attn_snapshot():
 # the tier-1 gate: CLI exit contract against the committed baseline
 # ---------------------------------------------------------------------------
 
-def test_cli_diff_clean_on_this_tree():
-    out = _cli("--diff")
-    assert out.returncode == 0, \
-        f"hlo_audit --diff regressed:\n{out.stdout}\n{out.stderr}"
-    assert "clean" in out.stderr
-
+# (the full-registry --diff-is-clean assertion runs once through
+# tests/test_check_static.py — the unified ptlint + hlo_audit + jxaudit
+# gate; the subset diff below still proves the clean path in-tree)
 
 def test_cli_injected_decode_wave_exits_1():
     """Positive control: a de-optimized copy of the decode wave (extra

@@ -64,3 +64,62 @@ class TestMultiPrecision:
         np.testing.assert_array_equal(
             np.asarray(step.params[name], dtype="f4"),
             m1.astype(jnp.bfloat16).astype("f4"))
+
+
+class TestDonatedStateSafety:
+    def test_state_dict_snapshot_survives_later_donated_steps(self):
+        """step() donates the optimizer state buffers (jxaudit's
+        donation-missing fix: UPDATE_DONATE_ARGNUMS covers the moment
+        tuple), so state_dict() must hand out COPIES — a checkpoint
+        snapshot taken between steps has to stay readable after the
+        next step invalidates the donated originals (TrainStep.sync's
+        contract, now on the eager path too)."""
+        pt.seed(0)
+        lin = nn.Linear(8, 8)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=lin.parameters())
+        def one_step():
+            lin.weight.grad = pt.to_tensor(
+                np.full((8, 8), 1e-2, dtype="f4"))
+            opt.step()
+            opt.clear_grad()
+
+        one_step()
+        sd = opt.state_dict()
+        moments = {k: v for k, v in sd.items()
+                   if k.endswith(("moment1", "moment2"))}
+        assert moments, sd.keys()
+        # distinct buffers from the live accumulators (the next step
+        # donates those)
+        live = {id(st[n]) for st in opt._accumulators.values()
+                for n in ("moment1", "moment2") if n in st}
+        assert all(id(t._data) not in live for t in moments.values())
+        before = {k: np.asarray(t.numpy()).copy()
+                  for k, t in moments.items()}
+        one_step()
+        for k, t in moments.items():    # still readable, still the
+            np.testing.assert_array_equal(   # pre-step values
+                np.asarray(t.numpy()), before[k])
+
+    def test_set_state_dict_copies_loaded_arrays(self):
+        """The load side of the same contract: set_state_dict must not
+        alias the caller's arrays into the accumulators the next step
+        donates — the checkpoint the caller holds has to stay alive."""
+        import jax.numpy as jnp
+
+        pt.seed(0)
+        lin = nn.Linear(8, 8)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=lin.parameters())
+        lin.weight.grad = pt.to_tensor(np.full((8, 8), 1e-2, "f4"))
+        opt.step()
+        opt.clear_grad()
+        key = next(k for k in opt.state_dict() if k.endswith("moment1"))
+        mine = jnp.ones((8, 8), jnp.float32)       # raw jax array
+        opt.set_state_dict({key: mine})
+        live = next(st["moment1"] for st in opt._accumulators.values()
+                    if "moment1" in st)
+        assert live is not mine                    # copied, not aliased
+        lin.weight.grad = pt.to_tensor(np.full((8, 8), 1e-2, "f4"))
+        opt.step()                                 # donates the copy
+        np.testing.assert_array_equal(np.asarray(mine), 1.0)  # alive

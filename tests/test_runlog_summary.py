@@ -38,6 +38,8 @@ def _generate_journal(path):
         rec.checkpoint(path="ckpt/5", step=5)
         rec.xla_program("train_step", flops=1.2e9, bytes_accessed=3.4e8,
                         peak_memory_bytes=26743969, fusion_count=349)
+        rec.jxaudit(findings=2, by_rule={"donation-missing": 2},
+                    programs=6, degraded=0)
     return path
 
 
@@ -61,6 +63,9 @@ def test_cli_end_to_end(tmp_path):
     # label) with the journaled xla_program audit numbers
     assert "compiled programs:" in text
     assert "1.200e+09" in text and "25.5 MB" in text and "349" in text
+    # semantic-audit verdict renders next to the programs table
+    assert "semantic audit (jxaudit): 2 finding(s) (6 programs) — " \
+           "donation-missing=2" in text
 
 
 def test_cli_json_mode(tmp_path):
@@ -82,6 +87,9 @@ def test_cli_json_mode(tmp_path):
     assert prog["peak_memory_bytes"] == 26743969
     assert prog["flops"] == 1.2e9          # audit value wins over the
     #                                        compile event's estimate
+    assert summary["jxaudit"] == {
+        "runs": 1, "findings": 2, "by_rule": {"donation-missing": 2},
+        "programs": 6, "degraded": 0}
 
 
 def test_summarize_importable_without_jax_side_effects(tmp_path):
