@@ -1,15 +1,41 @@
 """paddle.save/load analog (ref python/paddle/framework/io.py:202,292 —
 pickled nested containers of tensors; tensors serialised as numpy).
 
+Writes are ATOMIC: the payload streams into a temp file in the
+destination directory, is fsync'd, and lands via `os.replace` — a
+crash (or an injected `chaos.CHECKPOINT_WRITE` fault) mid-write leaves
+the previous file intact and at most a stray `.tmp.<pid>` behind,
+never a truncated checkpoint. The `latest.json` manifest marks the
+newest COMPLETE checkpoint prefix in a directory (written only after
+every file of the checkpoint landed) and records each file's sha256
+(computed while the pickle streams out, no second pass), so
+`hapi.Model.load_latest` resumes from a consistent params+optimizer
+pair even when the crash hit between the two files.
+
+The digests close the REUSED-PREFIX hole: saving to the same prefix
+twice and crashing after the new `.pdparams` landed but before the
+`.pdopt` replace would leave the old manifest pointing at new params
++ old optimizer state. The old pair's bytes are gone (overwritten in
+place), so such a checkpoint cannot be repaired — but
+`latest_checkpoint(verify=True)` (the `load_latest` default) detects
+the mismatch and refuses to load the torn pair. Use unique per-step
+prefixes (e.g. `ckpt/step{n}`) when a resumable FALLBACK is required.
+
 Large checkpoints for distributed/sharded state go through orbax in
 incubate/checkpoint; this is the single-host object-file path.
 """
+import hashlib
+import json
 import os
 import pickle
+import time
 
 import numpy as np
 
+from ..utils import chaos
 from .tensor import Tensor, Parameter
+
+MANIFEST_NAME = "latest.json"
 
 
 class _TensorPayload:
@@ -56,15 +82,166 @@ def _unpack(obj, return_numpy=False):
     return obj
 
 
-def save(obj, path, protocol=4, **configs):
+class _CheckpointSink:
+    """File wrapper that accumulates the payload's sha256 while the
+    pickle streams through (recorded in the manifest so `load_latest`
+    can detect a checkpoint torn ACROSS files — see module docstring),
+    and hosts the checkpoint-write fault point after the first chunk
+    lands — a genuine torn write with real bytes on disk, without
+    materializing the whole payload just to split it."""
+
+    def __init__(self, f, path):
+        self._f = f
+        self._path = path
+        self._writes = 0
+        self._sha = hashlib.sha256()
+
+    def write(self, data):
+        n = self._f.write(data)
+        self._sha.update(data)
+        self._writes += 1
+        if self._writes == 1 and chaos.enabled():
+            chaos.fire(chaos.CHECKPOINT_WRITE, path=self._path)
+        return n
+
+    def hexdigest(self):
+        return self._sha.hexdigest()
+
+
+def _tmp_path(path):
+    return f"{path}.tmp.{os.getpid()}"
+
+
+def _makedirs_for(path):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def _atomic_write(target, write_fn):
+    """The one crash-atomic write path (checkpoints AND the manifest):
+    `write_fn(f)` streams the payload into a temp file in the target's
+    directory, then flush + fsync + `os.replace` — the target is either
+    its old bytes or the new ones, never a prefix, and a failure leaves
+    no `.tmp` litter. Returns write_fn's result."""
+    tmp = _tmp_path(target)
+    try:
+        with open(tmp, "wb") as f:
+            out = write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return out
+
+
+def save(obj, path, protocol=4, **configs):
+    """Atomic paddle.save: the pickle STREAMS into a temp file (no
+    second in-memory copy of the checkpoint), then fsync + os.replace —
+    the destination is either the old bytes or the new bytes, never a
+    prefix of the new ones. Returns the payload's sha256 hexdigest
+    (for the checkpoint manifest)."""
+    path = os.fspath(path)
+    _makedirs_for(path)
+
+    def _write(f):
+        sink = _CheckpointSink(f, path)
+        pickle.dump(_pack(obj), sink, protocol=protocol)
+        return sink.hexdigest()
+
+    return _atomic_write(path, _write)
 
 
 def load(path, return_numpy=False, **configs):
     with open(path, "rb") as f:
         obj = pickle.load(f)
     return _unpack(obj, return_numpy=return_numpy)
+
+
+# ---------------------------------------------------------------------------
+# latest-checkpoint manifest
+# ---------------------------------------------------------------------------
+
+def write_manifest(path, step=None, files=None):
+    """Atomically mark checkpoint prefix `path` as the newest COMPLETE
+    checkpoint of its directory (call only after every file of the
+    checkpoint landed). `files` maps basename -> sha256 hexdigest as
+    returned by `save` (a bare iterable of names is accepted, recorded
+    without digests — those files get an existence check only at
+    verify time). Returns the manifest dict written."""
+    path = os.fspath(path)
+    if files is None:
+        files = {}
+    elif not isinstance(files, dict):
+        files = {name: None for name in files}
+    doc = {"path": os.path.basename(path),
+           "step": None if step is None else int(step),
+           "time_unix": round(time.time(), 3),
+           "files": {name: files[name] for name in sorted(files)}}
+    d = os.path.dirname(os.path.abspath(path))
+    target = os.path.join(d, MANIFEST_NAME)
+    _atomic_write(target, lambda f: f.write(
+        (json.dumps(doc, indent=1) + "\n").encode()))
+    return doc
+
+
+def read_manifest(directory):
+    """The directory's manifest dict, or None (missing/unparseable —
+    an unparseable manifest means no complete checkpoint is KNOWN,
+    which is the safe answer after a torn legacy write)."""
+    try:
+        with open(os.path.join(directory, MANIFEST_NAME)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) and doc.get("path") else None
+
+
+def _file_sha256(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(chunk), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def verify_checkpoint(directory, doc):
+    """True when every file the manifest lists is present and (where a
+    digest was recorded) byte-identical to what the manifest's save
+    wrote — i.e. the params/optimizer pair on disk really is the pair
+    the manifest promised. False on any missing/mismatched file: the
+    classic cause is a crash while RE-saving to the same prefix (new
+    `.pdparams` already replaced in place, manifest + `.pdopt` still
+    the old save's)."""
+    files = doc.get("files") or {}
+    if not isinstance(files, dict):          # legacy list-form manifest
+        files = {name: None for name in files}
+    for name, digest in files.items():
+        p = os.path.join(directory, name)
+        try:
+            if digest is None:
+                if not os.path.exists(p):
+                    return False
+            elif _file_sha256(p) != digest:
+                return False
+        except OSError:
+            return False
+    return True
+
+
+def latest_checkpoint(directory, verify=True):
+    """Prefix (joined onto `directory`) of the newest complete
+    checkpoint, or None when the directory has no manifest — or when
+    `verify` (the default) finds the files on disk torn relative to
+    the manifest's recorded digests (see `verify_checkpoint`)."""
+    doc = read_manifest(directory)
+    if doc is None:
+        return None
+    if verify and not verify_checkpoint(directory, doc):
+        return None
+    return os.path.join(directory, doc["path"])

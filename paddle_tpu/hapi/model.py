@@ -310,18 +310,39 @@ class Model:
 
     # ------------------------------------------------------------- save/load
     def save(self, path, training=True):
-        from ..framework.serialization import save as _save
+        """Crash-safe checkpoint: each file is written atomically
+        (framework.serialization: temp + fsync + os.replace) and the
+        directory's `latest.json` manifest — which records each file's
+        sha256 — is updated only after EVERY file landed. A crash
+        mid-save over a FRESH prefix leaves the previous checkpoint
+        loadable via `load_latest`; a crash while re-saving over an
+        EXISTING prefix (old bytes already overwritten in place) is
+        detected by the digest check and `load_latest` refuses the torn
+        pair rather than silently mixing saves — use unique per-step
+        prefixes when a resumable fallback is required."""
+        import os
+        from ..framework import serialization
         from ..utils import flight_recorder as fr
         if self._train_step is not None:
             self._train_step.sync()
-        _save(dict(self.network.state_dict()), path + ".pdparams")
+        step = getattr(self._train_step, "_step_i", None)
+        base = os.path.basename(path)
+        files = {base + ".pdparams":
+                 serialization.save(dict(self.network.state_dict()),
+                                    path + ".pdparams")}
         if training and self._optimizer is not None:
-            _save(self._optimizer.state_dict(), path + ".pdopt")
+            files[base + ".pdopt"] = serialization.save(
+                self._optimizer.state_dict(), path + ".pdopt")
+        elif os.path.exists(path + ".pdopt"):
+            # params-only save over a prefix that previously had an
+            # optimizer file: the stale .pdopt belongs to DIFFERENT
+            # params now — remove it so load()/load_latest can never
+            # pair the new params with old optimizer moments
+            os.unlink(path + ".pdopt")
+        serialization.write_manifest(path, step=step, files=files)
         recorder = fr.get_recorder()
         if recorder is not None:
-            recorder.checkpoint(
-                path=path,
-                step=getattr(self._train_step, "_step_i", None))
+            recorder.checkpoint(path=path, step=step, complete=True)
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from ..framework.serialization import load as _load
@@ -332,6 +353,31 @@ class Model:
                 os.path.exists(path + ".pdopt"):
             self._optimizer.set_state_dict(_load(path + ".pdopt"))
         self._train_step = None  # recompile against restored state
+
+    def load_latest(self, directory, **kw):
+        """Resume from the newest COMPLETE checkpoint in `directory`
+        (the `latest.json` manifest save() maintains — a checkpoint
+        whose save crashed mid-write is never listed there, and the
+        manifest's sha256 digests are verified against the files on
+        disk before loading). Returns the checkpoint prefix loaded, or
+        None when the directory holds no manifest or the listed files
+        are torn relative to it (crash while re-saving a reused
+        prefix)."""
+        import os
+        from ..framework import serialization
+        prefix = serialization.latest_checkpoint(directory)
+        if prefix is None:
+            return None
+        doc = serialization.read_manifest(directory)
+        listed = (doc or {}).get("files") or {}
+        if os.path.basename(prefix) + ".pdopt" not in set(listed):
+            # an on-disk .pdopt the manifest does not list is a stray
+            # from some OTHER save (legacy writer, partial cleanup) —
+            # verification never covered it, so it must not be paired
+            # with these params
+            kw["reset_optimizer"] = True
+        self.load(prefix, **kw)
+        return prefix
 
     def parameters(self):
         return self.network.parameters()

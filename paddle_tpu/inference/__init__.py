@@ -328,10 +328,16 @@ class LLMPredictor:
             self.metrics_server = self.engine.start_metrics_server(
                 **config._metrics_exporter)
 
-    def close(self):
-        """Stop the background metrics exporter (if any). The engine's
-        compiled programs need no teardown."""
-        self.engine.stop_metrics_server()
+    def close(self, drain=True):
+        """Graceful shutdown: drain the scheduler (accepted requests
+        complete, new submits are shed with finish_reason "rejected")
+        and stop the background metrics exporter. drain=False skips the
+        wave loop for a hard stop. The engine's compiled programs need
+        no teardown."""
+        if drain:
+            self.scheduler.shutdown()
+        else:
+            self.engine.stop_metrics_server()
         self.metrics_server = None
 
     def generate(self, prompt, **kw):

@@ -20,6 +20,14 @@ emit chrome-trace spans/flows through utils.telemetry; serving counters
 and TTFT/latency histograms live in the typed metric registry; and
 `engine.start_metrics_server()` (or
 inference.Config.enable_metrics_exporter) serves /metrics + /healthz.
+
+Resilience (docs/serving.md "Resilience"): per-request fault isolation
+(a failed prefill or non-finite decode lane resolves only ITS request
+with finish_reason "error"), wave retry with bounded exponential
+backoff then graceful degradation, bounded-queue load shedding +
+`Scheduler.drain()`, and real /healthz state (ok/degraded/draining) —
+every path proven by deterministic injection (utils.chaos,
+scripts/chaos_serving.py).
 """
 from .engine import ServingEngine
 from .scheduler import Scheduler

@@ -34,7 +34,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tensor import Tensor
-from ..utils import telemetry
+from ..utils import chaos, telemetry
+
+HEALTH_STATES = ("ok", "degraded", "draining")
 
 
 def _infer_cache_dtype(params):
@@ -105,6 +107,9 @@ class ServingEngine:
         self.slot_sample = [False] * S
         self.slot_temp = [1.0] * S
 
+        self.last_nonfinite_slots = []
+        self.health_state = "ok"
+
         self._jit = bool(jit_compile)
         self._metrics_server = None
         self._build_programs()
@@ -115,21 +120,31 @@ class ServingEngine:
         cache_dtype = self.cache_dtype
 
         def decode_wave(p, b, caches, tok, pos, active, sample, temps,
-                        key):
+                        poison, key):
             out, _ = model.functional_call(p, b, tok[:, None], caches,
                                            pos, method="decode_step")
             logits, new_caches = out
             lo = _raw(logits)[:, 0, :].astype(jnp.float32)
+            # poison is all-False in production; the chaos harness sets
+            # a lane to inject NaN logits WITHOUT a second compiled
+            # program — the same executable serves both paths
+            lo = jnp.where(poison[:, None], jnp.float32(jnp.nan), lo)
+            # fused non-finite sentinel (the jit.TrainStep isfinite
+            # pattern): one [S] bool rides home with the tokens, no
+            # extra device sync — a poisoned lane is frozen in-program
+            # and retired by the scheduler with finish_reason "error"
+            finite = jnp.all(jnp.isfinite(lo), axis=-1)
             greedy = jnp.argmax(lo, axis=-1).astype(jnp.int32)
             scaled = lo / jnp.maximum(temps, 1e-6)[:, None]
             sampled = jax.random.categorical(key, scaled,
                                              axis=-1).astype(jnp.int32)
             nxt = jnp.where(sample, sampled, greedy)
-            # retirement/freeze via where: inactive lanes keep their
-            # token and position — fixed shapes, no recompiles
-            nxt = jnp.where(active, nxt, tok)
-            new_pos = jnp.where(active, pos + 1, pos)
-            return nxt, new_pos, new_caches
+            # retirement/freeze via where: inactive (or poisoned) lanes
+            # keep their token and position — fixed shapes, no recompiles
+            ok = active & finite
+            nxt = jnp.where(ok, nxt, tok)
+            new_pos = jnp.where(ok, pos + 1, pos)
+            return nxt, new_pos, finite, new_caches
 
         def prefill(p, b, caches, prompt, prompt_len, slot, sample, temp,
                     key):
@@ -217,8 +232,18 @@ class ServingEngine:
             self._metrics_server.stop()
             self._metrics_server = None
 
+    def set_health_state(self, state):
+        """ok | degraded | draining — the scheduler flips this so
+        /healthz reports REAL engine state (a degraded engine must not
+        answer "ok" to a load balancer)."""
+        if state not in HEALTH_STATES:
+            raise ValueError(f"health state must be one of "
+                             f"{HEALTH_STATES}, got {state!r}")
+        self.health_state = state
+
     def _health(self):
         return {
+            "status": self.health_state,
             "num_slots": self.num_slots,
             "slots_active": len(self.active_slots()),
             "max_len": self.max_len,
@@ -254,6 +279,12 @@ class ServingEngine:
             raise ValueError(why)
         if self.slot_active[slot]:
             raise RuntimeError(f"slot {slot} is busy")
+        if chaos.enabled():
+            # host-side, before any state mutates or the donated cache
+            # reaches the program — a fired fault leaves the engine
+            # exactly as it was, so the scheduler can fail JUST this
+            # request and keep serving
+            chaos.fire(chaos.PREFILL, slot=slot)
         n = len(prompt)
         padded = np.zeros((self.prefill_len,), np.int32)
         padded[:n] = np.asarray(prompt, np.int32)
@@ -272,26 +303,51 @@ class ServingEngine:
 
     def decode_wave(self):
         """One batched decode step over all slots. Returns {slot: token}
-        for the slots that were active this wave (the token generated at
-        each slot's frontier). Inactive lanes ride along frozen."""
+        for the slots that were active this wave AND produced finite
+        logits; slots whose logits went non-finite are excluded, frozen
+        in-program, and listed in `last_nonfinite_slots` for the
+        scheduler to retire (finish_reason "error"). Inactive lanes
+        ride along frozen.
+
+        Raise-type faults (chaos, or a real host-side error) fire
+        BEFORE the key splits or the donated cache reaches the program,
+        so a failed wave mutates nothing and a retry replays exactly.
+        An error from inside the compiled call itself may have consumed
+        the donated cache — the retry then fails too and the scheduler
+        degrades gracefully instead of looping."""
         active_now = list(self.slot_active)
         if not any(active_now):
+            self.last_nonfinite_slots = []
             return {}
+        poison = np.zeros((self.num_slots,), bool)
+        if chaos.enabled():
+            chaos.fire(chaos.DECODE_WAVE, active=sum(active_now))
+            hit = chaos.value(chaos.DECODE_WAVE_NAN)
+            if hit is not None:
+                for s in np.atleast_1d(hit):
+                    poison[int(s)] = True
         self._key, sub = jax.random.split(self._key)
-        tok, pos, self._caches = self._decode_wave(
+        tok, pos, finite, self._caches = self._decode_wave(
             self._params, self._buffers, self._caches,
             jnp.asarray(self.slot_tok, jnp.int32),
             jnp.asarray(self.slot_pos, jnp.int32),
             jnp.asarray(active_now, bool),
             jnp.asarray(self.slot_sample, bool),
-            jnp.asarray(self.slot_temp, jnp.float32), sub)
+            jnp.asarray(self.slot_temp, jnp.float32),
+            jnp.asarray(poison), sub)
         tok = np.asarray(tok)
-        out = {}
+        finite = np.asarray(finite)
+        out, bad = {}, []
         for s, was_active in enumerate(active_now):
-            if was_active:
-                self.slot_pos[s] += 1
-                self.slot_tok[s] = int(tok[s])
-                out[s] = int(tok[s])
+            if not was_active:
+                continue
+            if not bool(finite[s]):
+                bad.append(s)       # lane frozen in-program; caller
+                continue            # must retire it before the next wave
+            self.slot_pos[s] += 1
+            self.slot_tok[s] = int(tok[s])
+            out[s] = int(tok[s])
+        self.last_nonfinite_slots = bad
         return out
 
     def slot_full(self, slot):

@@ -17,7 +17,7 @@ shape (`scripts/bench_serving.py` serializes it unchanged).
 """
 import threading
 
-from ..utils import monitor, telemetry
+from ..utils import flight_recorder, monitor, telemetry
 
 # legacy stat-registry keys (monitor.stat_get / all_stats)
 REQUESTS_SUBMITTED = "serving_requests_submitted"
@@ -50,6 +50,35 @@ _TTFT = telemetry.histogram(
 _LATENCY = telemetry.histogram(
     "serving_request_latency_seconds", "Time from submit to completion",
     buckets=telemetry.DEFAULT_LATENCY_BUCKETS)
+# resilience counters (the chaos harness proves each one moves —
+# scripts/chaos_serving.py; kinds are a small closed set)
+_FAULTS = telemetry.counter(
+    "serving_faults_total",
+    "Faults handled by the resilience layer (isolated, retried, or "
+    "degraded — never a stack trace to the caller)",
+    labelnames=("kind",))
+_REJECTED = telemetry.counter(
+    "serving_rejected_total",
+    "Requests shed at admission: queue full, draining, degraded, or "
+    "invalid prompt")
+_WAVE_RETRIES = telemetry.counter(
+    "serving_wave_retries_total",
+    "Decode-wave retry attempts after a transient wave failure")
+_CALLBACK_ERRORS = telemetry.counter(
+    "serving_callback_errors_total",
+    "Exceptions raised by client on_token callbacks (contained "
+    "per-request, never poisoning the shared wave loop)")
+
+
+def record_callback_error(request, error):
+    """Count + journal a contained client-callback exception (called
+    from Request._emit — client bugs stay visible without breaking the
+    per-request isolation that swallows them)."""
+    _CALLBACK_ERRORS.inc()
+    rec = flight_recorder.get_recorder()
+    if rec is not None:
+        rec.fault(kind="callback_error", action="contained",
+                  request_id=request.request_id, error=repr(error))
 
 
 class ServingMetrics:
@@ -74,6 +103,9 @@ class ServingMetrics:
         self._queue_peak = 0
         self._first_token_time = None
         self._last_token_time = None
+        self._faults = {}
+        self._rejected = 0
+        self._wave_retries = 0
 
     # ---------------------------------------------------------- recording
     def on_submit(self):
@@ -83,6 +115,19 @@ class ServingMetrics:
     def on_reject(self):
         monitor.stat_add(REQUESTS_REJECTED)
         _REQUESTS.labels(state="rejected").inc()
+        _REJECTED.inc()
+        with self._lock:
+            self._rejected += 1
+
+    def on_fault(self, kind):
+        _FAULTS.labels(kind=kind).inc()
+        with self._lock:
+            self._faults[kind] = self._faults.get(kind, 0) + 1
+
+    def on_wave_retry(self):
+        _WAVE_RETRIES.inc()
+        with self._lock:
+            self._wave_retries += 1
 
     def on_prefill(self):
         monitor.stat_add(PREFILLS)
@@ -135,6 +180,8 @@ class ServingMetrics:
                     or self._last_token_time is None
                     else self._last_token_time - self._first_token_time)
             queue_peak = self._queue_peak
+            faults = dict(self._faults)
+            rejected, wave_retries = self._rejected, self._wave_retries
         return {
             "requests_completed": self._latency.count(),
             "tokens_generated": tokens,
@@ -145,4 +192,10 @@ class ServingMetrics:
             "latency_p99_s": self._latency.percentile(99),
             "slot_occupancy": (active / total if total else 0.0),
             "queue_depth_peak": queue_peak,   # this instance, not the
-        }                                     # process-wide monitor stat
+                                              # process-wide monitor stat
+            # resilience tallies (this instance): shedding onset vs
+            # offered load shows up in bench rows through these
+            "faults": faults,
+            "rejected": rejected,
+            "wave_retries": wave_retries,
+        }

@@ -14,7 +14,8 @@ request's QUEUED/PREFILL/DECODE spans alongside the decode-wave slices
 import threading
 import time
 
-from ..utils import telemetry
+from ..utils import chaos, telemetry
+from . import metrics as serving_metrics
 
 
 class RequestState:
@@ -34,7 +35,8 @@ class Request:
         (finish_reason "eos"). timeout (seconds, wall-clock from submit)
         retires a stuck request with finish_reason "timeout".
     on_token: optional fn(request, token_id) streaming callback —
-        exceptions are swallowed into `callback_error` so one client
+        exceptions are contained into `callback_error` (counted in
+        `serving_callback_errors_total` and journaled) so one client
         cannot poison the shared decode loop.
     """
     _ids = iter(range(1, 1 << 62))
@@ -62,7 +64,9 @@ class Request:
         self.state = RequestState.QUEUED
         self.slot = None                 # engine slot while PREFILL/DECODE
         self.output_tokens = []
-        self.finish_reason = None        # eos | max_tokens | length | timeout
+        # eos | max_tokens | length | timeout | error | rejected
+        self.finish_reason = None
+        self.error = None                # detail when error/rejected
         self.callback_error = None
         self.submit_time = None          # set by the scheduler at admission
         self.prefill_time = None
@@ -91,24 +95,41 @@ class Request:
         self.output_tokens.append(token_id)
         if self.on_token is not None:
             try:
+                if chaos.enabled():
+                    chaos.fire(chaos.CALLBACK, request_id=self.request_id)
                 self.on_token(self, token_id)
             except Exception as e:    # noqa: BLE001 — client code
                 self.callback_error = e
+                serving_metrics.record_callback_error(self, e)
 
-    def _finish(self, reason):
+    def _finish(self, reason, error=None):
         self.state = RequestState.DONE
         self.finish_reason = reason
+        if error is not None:
+            self.error = str(error)
         self.slot = None
         self.done_time = time.monotonic()
         telemetry.trace_request(self, RequestState.DONE, reason=reason)
         self._done_event.set()
 
-    def _reject(self, why):
+    def _fail(self, error):
+        """Resolve this request with finish_reason "error" (fault
+        isolation: the poisoned/failed request ends cleanly while the
+        rest of the batch keeps decoding)."""
+        self._finish("error", error=error)
+
+    def _reject(self, why, raise_error=True):
+        """Shed at admission (finish_reason "rejected"). Raises to the
+        submitting caller by default; the scheduler's degrade path
+        resolves already-queued requests with raise_error=False."""
         self.state = RequestState.REJECTED
         self.finish_reason = "rejected"
+        self.error = str(why)
+        self.done_time = time.monotonic()
         telemetry.trace_request(self, RequestState.REJECTED)
         self._done_event.set()
-        raise ValueError(why)
+        if raise_error:
+            raise ValueError(why)
 
     def _timed_out(self):
         return (self.timeout is not None and self.submit_time is not None
@@ -121,9 +142,10 @@ class Request:
 
     def wait(self, timeout=None):
         """Block until DONE/REJECTED (for callers driving the scheduler
-        from another thread). Returns self."""
-        self._done_event.wait(timeout)
-        return self
+        from another thread). Returns True when the request finished,
+        False when the wait timed out (threading.Event.wait semantics —
+        a None-returning wait hid the difference)."""
+        return self._done_event.wait(timeout)
 
     @property
     def ttft(self):

@@ -8,6 +8,26 @@ lane; same compiled program). Retirement (EOS / max_tokens / cache
 horizon / timeout) frees slots between waves and the freed slot is
 refilled in the same step() — a slot never idles while work is queued.
 
+Resilience (docs/serving.md "Resilience"; every path below is proven
+by injection in scripts/chaos_serving.py):
+
+  * a failed prefill or a non-finite decode lane resolves ONLY that
+    request (finish_reason "error") — the rest of the batch keeps
+    decoding the same compiled program; a streak of
+    `prefill_fail_limit` CONSECUTIVE prefill failures across distinct
+    requests escalates to graceful degradation, so a persistently
+    broken engine cannot hide behind per-request isolation with
+    /healthz still reporting "ok";
+  * a decode-wave exception is retried up to `wave_retries` times with
+    bounded exponential backoff (`retry_backoff_s`, doubling); an
+    exhausted budget degrades the engine gracefully — in-flight
+    requests resolve with "error", queued and new work is shed with
+    "rejected", /healthz reports "degraded" — instead of a stack trace
+    out of the wave loop;
+  * admission control: `max_queue` bounds the queue (overflow sheds
+    with finish_reason "rejected"), `drain()` stops admissions while
+    accepted work runs to completion (/healthz: "draining").
+
 Thread-model: submit() is safe from any producer thread (the bench
 script's Poisson arrival generator); the wave loop itself runs wherever
 run()/step() is called — the engine's compiled programs are driven from
@@ -17,19 +37,37 @@ import collections
 import threading
 import time
 
-from ..utils import profiler
+from ..utils import flight_recorder, profiler
 from ..utils.profiler import RecordEvent
 from .metrics import ServingMetrics
 from .request import Request, RequestState
 
 
 class Scheduler:
-    def __init__(self, engine, max_queue=None, completed_log=1024):
+    def __init__(self, engine, max_queue=None, completed_log=1024,
+                 wave_retries=3, retry_backoff_s=0.05,
+                 prefill_fail_limit=None):
         self.engine = engine
         self.max_queue = max_queue
+        self.wave_retries = max(0, int(wave_retries))
+        self.retry_backoff_s = float(retry_backoff_s)
+        # consecutive DISTINCT-request prefill failures tolerated before
+        # concluding the fault is the engine's, not the requests' (e.g. a
+        # raise from inside the compiled prefill after the donated cache
+        # was consumed fails every admission thereafter) — reaching it
+        # degrades instead of failing requests one-by-one forever while
+        # /healthz keeps saying "ok"
+        self.prefill_fail_limit = (engine.num_slots + self.wave_retries
+                                   if prefill_fail_limit is None
+                                   else max(1, int(prefill_fail_limit)))
+        self._prefill_fail_streak = 0
         self._queue = collections.deque()
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()        # queue + lifecycle flags
+        self._wave_lock = threading.Lock()   # one step() at a time
         self._slot_req = [None] * engine.num_slots
+        self._draining = False
+        self._degraded = False
+        self.last_error = None
         self.metrics = ServingMetrics(engine.num_slots)
         # bounded: callers hold their own Request handles (submit returns
         # them); this ring is a debugging/inspection tail, and unbounded
@@ -51,13 +89,21 @@ class Scheduler:
             self.metrics.on_reject()
             request._reject(why)           # raises ValueError
         with self._lock:
-            if self.max_queue is not None and len(self._queue) >= \
+            if self._degraded:
+                shed = f"engine degraded ({self.last_error})"
+            elif self._draining:
+                shed = "engine draining (graceful shutdown)"
+            elif self.max_queue is not None and len(self._queue) >= \
                     self.max_queue:
-                self.metrics.on_reject()
-                request._reject(f"queue full (max_queue={self.max_queue})")
-            request._mark_submitted()
-            self._queue.append(request)
-            depth = len(self._queue)
+                shed = f"queue full (max_queue={self.max_queue})"
+            else:
+                shed = None
+                request._mark_submitted()
+                self._queue.append(request)
+                depth = len(self._queue)
+        if shed is not None:
+            self.metrics.on_reject()
+            request._reject(shed)          # raises ValueError
         self.metrics.on_submit()
         self.metrics.on_queue_depth(depth)
         return request
@@ -91,10 +137,31 @@ class Scheduler:
             slot = free[0]
             req._start_prefill(slot)
             self._slot_req[slot] = req
-            with RecordEvent("serving/prefill"):
-                first = self.engine.prefill_slot(
-                    slot, req.prompt, do_sample=req.do_sample,
-                    temperature=req.temperature)
+            try:
+                with RecordEvent("serving/prefill"):
+                    first = self.engine.prefill_slot(
+                        slot, req.prompt, do_sample=req.do_sample,
+                        temperature=req.temperature)
+            except Exception as e:   # noqa: BLE001 — fault barrier:
+                # isolate the failing admission to ITS request; the
+                # engine mutates nothing before dispatch, so the slot
+                # is still free and every other lane is untouched
+                self._slot_req[slot] = None
+                self.last_error = e
+                self._prefill_fail_streak += 1
+                escalate = self._prefill_fail_streak >= \
+                    self.prefill_fail_limit
+                self._fault("prefill_error",
+                            action=("degrade" if escalate
+                                    else "request_failed"),
+                            request=req, slot=slot, error=e)
+                req._fail(e)
+                self._complete(req)
+                if escalate:
+                    self._degrade()
+                    return
+                continue
+            self._prefill_fail_streak = 0
             self.metrics.on_prefill()
             req._emit(first)
             self.metrics.on_token(time.monotonic())
@@ -125,16 +192,111 @@ class Scheduler:
         self.completed.append(req)
         self.metrics.on_complete(req)
 
+    def _fault(self, kind, action=None, request=None, slot=None,
+               error=None):
+        """One fault handled: count it (serving_faults_total{kind}) and
+        journal it through the current flight recorder."""
+        self.metrics.on_fault(kind)
+        rec = flight_recorder.get_recorder()
+        if rec is not None:
+            rec.fault(kind=kind, action=action,
+                      request_id=None if request is None
+                      else request.request_id,
+                      slot=slot,
+                      error=None if error is None else repr(error))
+
+    def _run_wave_with_retry(self):
+        """The decode wave behind a bounded-exponential-backoff retry.
+        Returns the wave's {slot: token} dict, or None after degrading
+        (budget exhausted). The engine raises BEFORE consuming its key
+        or the donated cache, so a retried wave replays exactly; an
+        error from inside the compiled call may have invalidated the
+        donated cache, in which case the retry fails too and the budget
+        runs out — degradation, not an infinite loop."""
+        delay = self.retry_backoff_s
+        for attempt in range(self.wave_retries + 1):
+            try:
+                with RecordEvent("serving/decode_wave"):
+                    return self.engine.decode_wave()
+            except Exception as e:   # noqa: BLE001 — fault barrier
+                self.last_error = e
+                self._fault("wave_error",
+                            action=("retry" if attempt < self.wave_retries
+                                    else "degrade"),
+                            error=e)
+                if attempt >= self.wave_retries:
+                    break
+                self.metrics.on_wave_retry()
+                time.sleep(delay)
+                delay *= 2
+        self._degrade()
+        return None
+
+    def _degrade(self):
+        """Graceful degradation: the wave loop cannot make progress, so
+        resolve everything cleanly — in-flight requests finish with
+        "error", queued requests shed with "rejected", new submits are
+        rejected, and /healthz reports "degraded" — instead of leaking
+        a stack trace through step()."""
+        with self._lock:
+            # flag + health transition under ONE lock: a concurrent
+            # drain() cannot interleave and overwrite "degraded" with
+            # "draining" on an engine that can no longer make progress
+            self._degraded = True
+            self.engine.set_health_state("degraded")
+        self._fault("degraded", action="drain_and_reject",
+                    error=self.last_error)
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            self.engine.retire_slot(slot)
+            self._slot_req[slot] = None
+            req._fail(f"engine degraded: {self.last_error!r}")
+            self._complete(req)
+        while True:
+            req = self._pop_next()
+            if req is None:
+                break
+            self.metrics.on_reject()
+            req._reject(f"engine degraded ({self.last_error!r})",
+                        raise_error=False)
+            # shed, not completed: on_complete would double-count the
+            # request and pollute the latency histogram with a
+            # queue-wait-only sample — the inspection ring still gets it
+            self.completed.append(req)
+
     def step(self):
         """One scheduling round: refill free slots from the queue, run
         one batched decode wave, stream the tokens, retire finished
-        slots. Returns the number of requests still in flight or queued."""
+        slots. Returns the number of requests still in flight or queued.
+
+        Serialized by `_wave_lock`, so concurrent drivers (a run() loop
+        in one thread, shutdown() in another) interleave whole rounds
+        instead of racing the engine's donated caches."""
+        with self._wave_lock:
+            return self._step_locked()
+
+    def _step_locked(self):
+        if self._degraded:
+            return 0
         self._admit()
         active = self.engine.active_slots()
         if active:
-            with RecordEvent("serving/decode_wave"):
-                toks = self.engine.decode_wave()
+            toks = self._run_wave_with_retry()
+            if toks is None:                 # degraded: everything is
+                return 0                     # resolved, nothing pending
             self.metrics.on_wave(len(active))
+            # fused-sentinel fallout: retire ONLY the poisoned lanes —
+            # their requests resolve with "error", healthy neighbours
+            # stream on token-identically (proven in chaos_serving)
+            for slot in self.engine.last_nonfinite_slots:
+                req = self._slot_req[slot]
+                self.engine.retire_slot(slot)
+                self._slot_req[slot] = None
+                self._fault("nonfinite", action="slot_retired",
+                            request=req, slot=slot)
+                req._fail("non-finite logits in decode wave")
+                self._complete(req)
             now = time.monotonic()
             for slot, tok in toks.items():
                 self._slot_req[slot]._emit(tok)
@@ -151,6 +313,38 @@ class Scheduler:
 
     def in_flight(self):
         return sum(1 for r in self._slot_req if r is not None)
+
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def degraded(self):
+        return self._degraded
+
+    # ------------------------------------------------------- graceful stop
+    def drain(self):
+        """Stop admitting new work: requests already accepted (queued or
+        in a slot) run to completion; new submit()s are shed with
+        finish_reason "rejected". /healthz reports "draining". Keep
+        driving step()/run() until it returns 0 to finish the accepted
+        work."""
+        with self._lock:
+            self._draining = True
+            if not self._degraded:     # degraded is sticky: see _degrade
+                self.engine.set_health_state("draining")
+
+    def shutdown(self, max_waves=None):
+        """Graceful shutdown: drain(), drive the wave loop until every
+        accepted request resolves, then stop the engine's metrics
+        exporter. Returns the number of waves run. Safe alongside a
+        concurrent run()/step() driver — rounds serialize on
+        `_wave_lock`, so the two loops cooperate on draining rather
+        than racing the engine."""
+        self.drain()
+        waves = self.run(max_waves=max_waves)
+        self.engine.stop_metrics_server()
+        return waves
 
     def run(self, drain=True, max_waves=None):
         """Drive step() until the queue and all slots drain (or max_waves

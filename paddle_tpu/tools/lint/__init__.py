@@ -5,8 +5,8 @@
 
 Rules (lint.RULES) cover tracer safety (host-sync-in-trace), compile
 stability (recompile-hazard), concurrency (lock-discipline), hygiene
-(mutable-default-arg, swallowed-exception) and the metric-name registry
-contract. `scripts/ptlint.py` is the CLI; docs/static_analysis.md is
+(mutable-default-arg, swallowed-exception), the metric-name registry
+contract, and fault-point gating (chaos-guard). `scripts/ptlint.py` is the CLI; docs/static_analysis.md is
 the rule catalog. Suppress per line with `# ptlint: disable=<rule>`;
 grandfather findings in scripts/ptlint_baseline.json (see
 lint.baseline).

@@ -1,2 +1,2 @@
 """Importing this package registers every built-in ptlint rule."""
-from . import hygiene, locks, metric_names, tracer  # noqa: F401
+from . import chaos_guard, hygiene, locks, metric_names, tracer  # noqa: F401

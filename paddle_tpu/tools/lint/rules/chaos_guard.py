@@ -1,0 +1,107 @@
+"""chaos-guard: fault points stay scoped and gated.
+
+The chaos harness (utils/chaos.py) promises its fault points are
+zero-cost when disabled. That only holds if every production call to
+`chaos.fire(...)` / `chaos.value(...)` sits behind the module's enable
+flag — and it is only auditable if the points are visibly the chaos
+module's (no `from ...chaos import fire` aliasing the injector into an
+innocent-looking local name). This rule enforces both:
+
+  * a `chaos.fire`/`chaos.value` call must be lexically inside an
+    `if chaos.enabled():` (or `... and chaos.enabled()` etc.) within
+    the same function — the guard and the point stay on one screen;
+  * importing the fault-point FUNCTIONS out of the module is flagged:
+    import the module, so the guard stays greppable at the call site.
+
+utils/chaos.py itself is exempt (it is the implementation)."""
+import ast
+
+from ..core import Rule, register
+from .. import astutil
+from ..astutil import FUNC_DEFS
+
+POINT_FUNCS = {"fire", "value"}
+EXEMPT = ("paddle_tpu/utils/chaos.py",)
+
+
+def _chaos_aliases(tree):
+    """Local names the chaos MODULE is bound to in this file
+    (`from ..utils import chaos`, `import paddle_tpu.utils.chaos as x`),
+    plus the fault-point functions imported directly (flagged)."""
+    modules, direct = set(), []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "chaos":
+                    modules.add(alias.asname or alias.name)
+                elif (node.module or "").endswith("chaos") \
+                        and alias.name in POINT_FUNCS | {"enabled"}:
+                    direct.append((node, alias.name))
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith(".chaos") or alias.name == "chaos":
+                    modules.add(alias.asname or alias.name.split(".")[0])
+    return modules, direct
+
+
+def _is_enabled_call(node, modules):
+    """`chaos.enabled()` (or an alias of the module)."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "enabled"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in modules)
+
+
+def _guarded(call, parents, modules):
+    """The call sits under an `if` whose test includes chaos.enabled(),
+    within the same function (a guard in a caller is invisible at the
+    point of use and rots silently)."""
+    for anc in astutil.ancestors(call, parents):
+        if isinstance(anc, ast.If):
+            for sub in ast.walk(anc.test):
+                if _is_enabled_call(sub, modules):
+                    return True
+        if isinstance(anc, FUNC_DEFS + (ast.Lambda,)):
+            return False
+    return False
+
+
+@register
+class ChaosGuard(Rule):
+    id = "chaos-guard"
+    rationale = ("Chaos fault points must be zero-cost when disabled "
+                 "and greppable: every chaos.fire()/chaos.value() call "
+                 "sits behind `if chaos.enabled():` in the same "
+                 "function, and the module is imported whole, never "
+                 "its point functions.")
+
+    def check(self, ctx):
+        if ctx.rel in EXEMPT:
+            return
+        modules, direct = _chaos_aliases(ctx.tree)
+        for node, name in direct:
+            yield ctx.finding(
+                self.id, node,
+                f"importing '{name}' out of the chaos module hides the "
+                "injector behind a bare name; import the module "
+                "(`from ..utils import chaos`) so the enable guard "
+                "stays visible at the call site")
+        if not modules:
+            return
+        parents = astutil.parents_of(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in POINT_FUNCS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in modules):
+                continue
+            if not _guarded(node, parents, modules):
+                yield ctx.finding(
+                    self.id, node,
+                    f"chaos.{node.func.attr}() fault point not guarded "
+                    "by `if chaos.enabled():` in the same function — "
+                    "the zero-cost-when-disabled contract "
+                    "(docs/serving.md Resilience) requires the guard "
+                    "at every production call site")

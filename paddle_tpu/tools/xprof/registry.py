@@ -51,7 +51,9 @@ def engine_program_specs(engine, prefix="serving"):
         engine._params, engine._buffers, engine._caches,
         jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
         jnp.ones((S,), bool), jnp.zeros((S,), bool),
-        jnp.ones((S,), jnp.float32), key)
+        jnp.ones((S,), jnp.float32),
+        jnp.zeros((S,), bool),          # poison (chaos NaN injection)
+        key)
     prefill_args = (
         engine._params, engine._buffers, engine._caches,
         jnp.asarray(np.zeros((engine.prefill_len,), np.int32)),

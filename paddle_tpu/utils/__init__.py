@@ -3,6 +3,7 @@ from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401
 from . import telemetry  # noqa: F401  (after monitor/profiler: it uses both)
 from . import flight_recorder  # noqa: F401
+from . import chaos  # noqa: F401  (after flight_recorder: firings journal)
 
 
 def try_import(name):

@@ -1,0 +1,234 @@
+"""Deterministic fault injection (chaos) harness.
+
+The resilience layer (serving fault isolation, wave retry, admission
+control, crash-safe checkpoints) is only trustworthy if every recovery
+path is *provoked* on demand — the same positive-control discipline the
+static gates use (`hlo_audit --inject`, `jxaudit --inject`). This module
+is the injector: named, seeded, scoped fault points that production
+code consults and test harnesses arm.
+
+    from paddle_tpu.utils import chaos
+
+    monkey = chaos.ChaosMonkey([
+        chaos.Fault(chaos.DECODE_WAVE, action="raise", times=(2,)),
+        chaos.Fault(chaos.DECODE_WAVE_NAN, action="payload",
+                    payload=1, times=(3,)),
+    ], seed=0)
+    with chaos.active(monkey):
+        ...drive the serving engine...
+
+Contract with production call sites (enforced by ptlint's `chaos-guard`
+rule, docs/static_analysis.md):
+
+  * every call to `chaos.fire(...)` / `chaos.value(...)` outside this
+    module is lexically guarded by `if chaos.enabled():` — with no
+    monkey installed the fault point costs one module-global read and
+    nothing else (zero-cost when disabled);
+  * call sites import the MODULE (`from ..utils import chaos`), never
+    the functions, so the guard stays visible at the point of use;
+  * fault points are the named constants below — scoped, greppable,
+    and stable for Fault(point=...) selectors.
+
+Selection is deterministic: each point keeps a per-monkey invocation
+counter and a fault fires on exact 1-based invocation indices
+(`times`), a modulus (`every`), or a seeded Bernoulli draw (`prob`,
+`random.Random(seed)` — reproducible across runs). Every firing is
+journaled as a `chaos` event through the current flight recorder, so a
+recovered run's journal shows the injection next to the `fault` events
+the resilience layer wrote while handling it.
+"""
+import contextlib
+import random
+import threading
+import time
+
+from . import flight_recorder
+
+# ---------------------------------------------------------------------------
+# fault point names (the scoped vocabulary — see docs/serving.md)
+# ---------------------------------------------------------------------------
+
+#: raise/delay before the batched decode wave dispatches (host-side, so
+#: no state mutated and no donated buffer consumed — retry is safe)
+DECODE_WAVE = "serving.decode_wave"
+#: payload: slot index (or list of indices) whose logits are poisoned
+#: to NaN THIS wave via the program's poison input — exercises the
+#: fused non-finite sentinel without a recompile
+DECODE_WAVE_NAN = "serving.decode_wave.nan"
+#: raise/delay before a prefill admission dispatches
+PREFILL = "serving.prefill"
+#: raise inside the per-request on_token callback guard
+CALLBACK = "serving.request.callback"
+#: raise mid-write inside the atomic checkpoint writer (partial temp
+#: file on disk, destination untouched — simulates a crash)
+CHECKPOINT_WRITE = "serialization.save"
+
+POINTS = (DECODE_WAVE, DECODE_WAVE_NAN, PREFILL, CALLBACK,
+          CHECKPOINT_WRITE)
+
+ACTIONS = ("raise", "delay", "payload")
+
+
+class ChaosError(RuntimeError):
+    """An injected fault (the 'transient device error' stand-in). The
+    resilience layer must treat it exactly like any other exception —
+    nothing may special-case this type."""
+
+
+class Fault:
+    """One armed fault: a point, an action, and a deterministic
+    selector.
+
+    point: one of the named fault points above (any string is accepted
+        — harnesses may define private points).
+    action: "raise" (ChaosError), "delay" (time.sleep(delay_s)), or
+        "payload" (fire() returns `payload` to the call site).
+    times: 1-based invocation indices of `point` at which to fire.
+    every: fire when the invocation index is a multiple of this.
+    prob: fire on a seeded Bernoulli draw per invocation.
+        With no selector at all, every invocation fires.
+    max_fires: cap on total firings (None = unbounded).
+    """
+
+    def __init__(self, point, action="raise", times=None, every=None,
+                 prob=None, payload=None, delay_s=0.0, max_fires=None,
+                 message=None):
+        if action not in ACTIONS:
+            raise ValueError(f"action must be one of {ACTIONS}, "
+                             f"got {action!r}")
+        if action == "delay" and delay_s <= 0:
+            raise ValueError("delay fault needs delay_s > 0")
+        self.point = str(point)
+        self.action = action
+        self.times = None if times is None else tuple(int(t) for t in times)
+        self.every = None if every is None else int(every)
+        if self.every is not None and self.every <= 0:
+            # fail at construction, not as a ZeroDivisionError out of
+            # the production fault point mid-wave
+            raise ValueError(f"every must be >= 1, got {every!r}")
+        self.prob = None if prob is None else float(prob)
+        self.payload = payload
+        self.delay_s = float(delay_s)
+        self.max_fires = max_fires
+        self.message = message or f"injected fault at {self.point}"
+        self.fires = 0
+
+    def should_fire(self, invocation, rng):
+        """Caller holds the monkey's lock."""
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if self.times is not None:
+            return invocation in self.times
+        if self.every is not None:
+            return invocation % self.every == 0
+        if self.prob is not None:
+            return rng.random() < self.prob
+        return True
+
+    def __repr__(self):
+        sel = (f"times={self.times}" if self.times is not None else
+               f"every={self.every}" if self.every is not None else
+               f"prob={self.prob}" if self.prob is not None else "always")
+        return f"Fault({self.point!r}, {self.action}, {sel})"
+
+
+class ChaosMonkey:
+    """A set of armed faults plus the deterministic firing state: one
+    invocation counter per point and one seeded RNG shared by every
+    `prob` selector. `fired` records (point, action, invocation) for
+    post-run assertions."""
+
+    def __init__(self, faults, seed=0):
+        self.faults = list(faults)
+        self.seed = int(seed)
+        self.rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self._invocations = {}
+        self.fired = []
+
+    def match(self, point):
+        """Count one invocation of `point`; return (fault, invocation)
+        with fault=None when nothing fires this time."""
+        with self._lock:
+            n = self._invocations.get(point, 0) + 1
+            self._invocations[point] = n
+            for fault in self.faults:
+                if fault.point == point and fault.should_fire(n, self.rng):
+                    fault.fires += 1
+                    self.fired.append((point, fault.action, n))
+                    return fault, n
+        return None, n
+
+    def invocations(self, point):
+        with self._lock:
+            return self._invocations.get(point, 0)
+
+
+# ---------------------------------------------------------------------------
+# module state: the installed monkey
+# ---------------------------------------------------------------------------
+
+_install_lock = threading.Lock()
+_monkey = None
+
+
+def install(monkey):
+    """Install `monkey` as the process-wide injector; returns the
+    previous one. Pass None to disarm."""
+    global _monkey
+    with _install_lock:
+        prev = _monkey
+        _monkey = monkey
+        return prev
+
+
+def uninstall():
+    return install(None)
+
+
+def enabled():
+    """True when a monkey is installed — THE guard every production
+    fault point checks before calling fire()/value()."""
+    return _monkey is not None
+
+
+def current():
+    return _monkey
+
+
+@contextlib.contextmanager
+def active(monkey):
+    """`with chaos.active(ChaosMonkey([...])):` — scoped arm/disarm."""
+    prev = install(monkey)
+    try:
+        yield monkey
+    finally:
+        install(prev)
+
+
+def fire(point, **ctx):
+    """Consult the installed monkey at a fault point. Returns None when
+    nothing fires; raises ChaosError / sleeps / returns the payload when
+    a fault matches. `ctx` kwargs are journaled with the firing."""
+    monkey = _monkey
+    if monkey is None:
+        return None
+    fault, n = monkey.match(point)
+    if fault is None:
+        return None
+    rec = flight_recorder.get_recorder()
+    if rec is not None:
+        rec.chaos(point=point, action=fault.action, invocation=n, **ctx)
+    if fault.action == "delay":
+        time.sleep(fault.delay_s)
+        return None
+    if fault.action == "payload":
+        return fault.payload
+    raise ChaosError(f"chaos[{point}#{n}]: {fault.message}")
+
+
+def value(point, default=None, **ctx):
+    """Payload-point sugar: the injected payload when a fault fires,
+    `default` otherwise (raise/delay faults behave as in fire())."""
+    out = fire(point, **ctx)
+    return default if out is None else out

@@ -41,7 +41,8 @@ class NonFiniteError(RuntimeError):
 
 
 EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
-               "checkpoint", "xla_program", "jxaudit", "run_end")
+               "checkpoint", "xla_program", "jxaudit", "chaos", "fault",
+               "run_end")
 
 
 def _json_safe(v):
@@ -92,14 +93,17 @@ class FlightRecorder:
         self._ended = False
 
     # ---------------------------------------------------------------- core
-    def record(self, kind, **fields):
-        """Append one event; returns the dict written (ts/seq added)."""
+    def record(self, event, **fields):
+        """Append one event of kind `event`; returns the dict written
+        (ts/seq added). The parameter is named `event`, not `kind`, so
+        typed events (`fault`) may carry their own `kind` field."""
         with self._lock:
             self._seq += 1
-            ev = {"ev": kind, "ts": round(time.time(), 6), "seq": self._seq}
+            ev = {"ev": event, "ts": round(time.time(), 6),
+                  "seq": self._seq}
             ev.update(_json_safe(fields))
             self._recent.append(ev)
-            self._counts[kind] = self._counts.get(kind, 0) + 1
+            self._counts[event] = self._counts.get(event, 0) + 1
             if self.path is not None:
                 if len(self._pending) == self._pending.maxlen:
                     self._dropped += 1    # ring full: oldest pending falls
@@ -245,6 +249,34 @@ class FlightRecorder:
             fields["degraded"] = int(degraded)
         fields.update(extra)
         return self.record("jxaudit", **fields)
+
+    def chaos(self, point, action, invocation=None, **extra):
+        """An injected fault fired (utils.chaos) — journaled so a
+        recovered run shows the injection next to the `fault` events
+        the resilience layer wrote while handling it."""
+        fields = {"point": str(point), "action": str(action)}
+        if invocation is not None:
+            fields["invocation"] = int(invocation)
+        fields.update(extra)
+        return self.record("chaos", **fields)
+
+    def fault(self, kind, action=None, request_id=None, slot=None,
+              error=None, **extra):
+        """The resilience layer handled a fault: `kind` names the fault
+        class (nonfinite / wave_error / prefill_error / callback_error /
+        degraded), `action` what was done about it (retired / retry /
+        degraded / shed)."""
+        fields = {"kind": str(kind)}
+        if action is not None:
+            fields["action"] = str(action)
+        if request_id is not None:
+            fields["request_id"] = int(request_id)
+        if slot is not None:
+            fields["slot"] = int(slot)
+        if error is not None:
+            fields["error"] = str(error)
+        fields.update(extra)
+        return self.record("fault", **fields)
 
     def checkpoint(self, path=None, step=None, **extra):
         fields = {}

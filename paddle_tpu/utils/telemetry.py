@@ -740,7 +740,11 @@ def make_metrics_handler(registry=None, health_fn=None):
                         payload["error"] = repr(e)
                 body = json.dumps(payload).encode()
                 ctype = "application/json"
-                code = 200
+                # status-code-probing load balancers (the k8s httpGet
+                # default) never parse the body — a degraded/draining
+                # engine must fail the probe, not answer 200 with a
+                # sad JSON inside
+                code = 200 if payload.get("status") == "ok" else 503
             else:
                 body = b"not found; try /metrics /metrics.json /healthz\n"
                 ctype = "text/plain"

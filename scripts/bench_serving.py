@@ -73,8 +73,11 @@ def run_load(sched, load_rps, n_requests, vocab, prompt_range,
         for _ in range(n_requests):
             time.sleep(rng.exponential(1.0 / load_rps))
             p = rng.randint(0, vocab, (rng.randint(*prompt_range),)).tolist()
-            reqs.append(sched.submit(
-                prompt=p, max_tokens=int(rng.randint(*output_range))))
+            try:
+                reqs.append(sched.submit(
+                    prompt=p, max_tokens=int(rng.randint(*output_range))))
+            except ValueError:
+                pass        # shed (max_queue) — counted by the scheduler
         done_submitting.set()
 
     th = threading.Thread(target=producer, daemon=True)
@@ -107,6 +110,11 @@ def main():
     ap.add_argument("--requests", type=int, default=16,
                     help="requests per load point")
     ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="bound the admission queue: overflow is shed "
+                         "with finish_reason 'rejected' (per-row "
+                         "'rejected' counts show shedding onset vs "
+                         "offered load)")
     ap.add_argument("--max-len", type=int, default=256)
     ap.add_argument("--prefill-len", type=int, default=64)
     ap.add_argument("--hidden", type=int, default=256)
@@ -148,7 +156,8 @@ def main():
 
     rows = []
     for i, load in enumerate(float(x) for x in args.loads.split(",")):
-        sched = Scheduler(engine)        # fresh metrics per load point
+        # fresh metrics per load point
+        sched = Scheduler(engine, max_queue=args.max_queue)
         out_hi = max(5, min(64, args.max_len - args.prefill_len))
         snap = run_load(sched, load, args.requests, args.vocab,
                         prompt_range=(4, args.prefill_len),
@@ -164,6 +173,11 @@ def main():
                 "ttft_p99_ms": round((snap["ttft_p99_s"] or 0) * 1e3, 2),
                 "slot_occupancy": round(snap["slot_occupancy"], 4),
                 "queue_depth_peak": snap["queue_depth_peak"],
+                # resilience tallies THIS load point: shedding onset vs
+                # offered load reads straight off the row sequence
+                "rejected": snap["rejected"],
+                "faults": snap["faults"],
+                "wave_retries": snap["wave_retries"],
                 "requests": snap["n_requests"],
                 "wall_s": round(snap["wall_s"], 2),
                 "offered_load_rps": load,
@@ -196,9 +210,23 @@ def main():
     except Exception as e:  # noqa: BLE001 - best-effort bench annotation
         hlo_rollup = {"error": f"{type(e).__name__}: {e}"}
 
+    # process-wide resilience totals for the whole sweep (per-point
+    # tallies ride each row's detail): future load benches show where
+    # shedding sets in and whether any fault path fired under load
+    resilience = {
+        "rejected_total": telemetry.value("serving_rejected_total",
+                                          default=0),
+        "wave_retries_total": telemetry.value("serving_wave_retries_total",
+                                              default=0),
+        "callback_errors_total": telemetry.value(
+            "serving_callback_errors_total", default=0),
+        "faults_total": sum(sum(r["detail"]["faults"].values())
+                            for r in rows),
+    }
     with open(args.out, "w") as f:
         json.dump({"cmd": " ".join(sys.argv), "rows": rows,
                    "hlo_audit": hlo_rollup,
+                   "resilience": resilience,
                    "telemetry": telemetry.snapshot()}, f, indent=1)
     log(f"wrote {args.out}")
     engine.stop_metrics_server()

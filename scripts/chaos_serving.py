@@ -1,0 +1,427 @@
+#!/usr/bin/env python
+"""chaos_serving — drive the serving/training resilience layer through
+every chaos fault class and assert the post-fault invariants.
+
+Same positive-control discipline as hlo_audit/jxaudit: each scenario
+arms a deterministic `utils.chaos` fault, runs a request stream, and
+checks the engine RECOVERED — poisoned slot isolated (healthy slots
+token-identical to a fault-free run), transient wave error retried
+within budget, failed prefill contained, callback exception counted,
+queue overflow shed, drain graceful, checkpoint crash survivable — all
+with the decode wave still compiled exactly once. `--inject` proves the
+runner itself: it disables one resilience property and must exit 1.
+
+    python scripts/chaos_serving.py                   # all scenarios
+    python scripts/chaos_serving.py --smoke           # tier-1 entry
+    python scripts/chaos_serving.py --scenarios nan_slot,wave_error
+    python scripts/chaos_serving.py --inject drop-isolation   # exit 1
+    python scripts/chaos_serving.py --inject no-retry         # exit 1
+    python scripts/chaos_serving.py --json --journal chaos.jsonl
+
+Exit codes: 0 every invariant holds, 1 violated invariant, 2 internal
+error. Tier-1 runs --smoke and both injections in-process
+(tests/test_chaos.py).
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.serving import Scheduler, ServingEngine
+from paddle_tpu.utils import chaos, flight_recorder, telemetry
+
+# canonical tiny scale == tests/test_serving.py fixture, so tier-1
+# shares one persistent-cache compile of the decode wave/prefill
+VOCAB, HIDDEN, LAYERS, HEADS, KV_HEADS = 128, 64, 2, 4, 2
+SLOTS, MAX_LEN, PREFILL_LEN = 4, 64, 16
+MAX_TOKENS = 6
+
+_CACHE = {}
+
+
+def get_engine():
+    """One engine per process (scenarios reset its health; compile-once
+    across ALL of them is itself the final invariant)."""
+    if "engine" not in _CACHE:
+        from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+        pt.seed(7)
+        cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
+                          num_layers=LAYERS, num_heads=HEADS,
+                          num_kv_heads=KV_HEADS, max_seq_len=MAX_LEN)
+        engine = ServingEngine(LlamaForCausalLM(cfg), num_slots=SLOTS,
+                               max_len=MAX_LEN, prefill_len=PREFILL_LEN)
+        Scheduler(engine).generate([1, 2, 3], max_tokens=2)   # warm
+        _CACHE["engine"] = engine
+        _CACHE["compiles_after_warm"] = telemetry.compile_count(
+            "serving_decode_wave")
+    return _CACHE["engine"]
+
+
+def _prompts(n=SLOTS):
+    return [np.random.RandomState(100 + i)
+            .randint(0, VOCAB, (4 + i % 3,)).tolist() for i in range(n)]
+
+
+def _run_stream(engine, prompts, **submit_kw):
+    sched = Scheduler(engine)
+    reqs = [sched.submit(prompt=p, max_tokens=MAX_TOKENS, **submit_kw)
+            for p in prompts]
+    sched.run()
+    return sched, reqs
+
+
+def _reference(engine, prompts):
+    """Fault-free greedy outputs for `prompts` (greedy decode ignores
+    the PRNG stream, so the reference is engine-state-independent)."""
+    key = ("ref", tuple(tuple(p) for p in prompts))
+    if key not in _CACHE:
+        _, reqs = _run_stream(engine, prompts)
+        _CACHE[key] = [r.output_tokens for r in reqs]
+    return _CACHE[key]
+
+
+def _check(violations, cond, msg):
+    if not cond:
+        violations.append(msg)
+
+
+# ---------------------------------------------------------------------------
+# scenarios — each returns a list of violated invariants (empty = pass)
+# ---------------------------------------------------------------------------
+
+def scenario_nan_slot(engine, inject):
+    """Poisoned slot: NaN logits in one lane retire ONLY that request
+    (finish_reason "error"); healthy lanes stream token-identically to
+    a fault-free run. --inject drop-isolation poisons EVERY lane while
+    the invariants still expect isolation — the checker must fail."""
+    v = []
+    prompts = _prompts()
+    ref = _reference(engine, prompts)
+    payload = list(range(SLOTS)) if inject == "drop-isolation" else 1
+    monkey = chaos.ChaosMonkey([chaos.Fault(
+        chaos.DECODE_WAVE_NAN, action="payload", payload=payload,
+        times=(2,))])
+    with chaos.active(monkey):
+        sched, reqs = _run_stream(engine, prompts)
+    _check(v, monkey.fired, "nan injection never fired")
+    _check(v, reqs[1].finish_reason == "error",
+           f"poisoned slot finished {reqs[1].finish_reason!r}, "
+           "expected 'error'")
+    for i in (0, 2, 3):
+        _check(v, reqs[i].output_tokens == ref[i],
+               f"healthy slot {i} output diverged from the fault-free "
+               "run — poison leaked across lanes")
+    _check(v, sched.metrics.snapshot()["faults"].get("nonfinite", 0) >= 1,
+           "serving_faults_total{kind=nonfinite} did not move")
+    return v
+
+
+def scenario_wave_error(engine, inject):
+    """Transient decode-wave exception: retried with backoff, stream
+    completes, outputs untouched. --inject no-retry zeroes the retry
+    budget so the engine degrades — the completion invariant fails."""
+    v = []
+    prompts = _prompts()
+    ref = _reference(engine, prompts)
+    retries = 0 if inject == "no-retry" else 3
+    monkey = chaos.ChaosMonkey([chaos.Fault(chaos.DECODE_WAVE,
+                                            times=(2,))])
+    with chaos.active(monkey):
+        sched = Scheduler(engine, wave_retries=retries,
+                          retry_backoff_s=0.001)
+        reqs = [sched.submit(prompt=p, max_tokens=MAX_TOKENS)
+                for p in prompts]
+        sched.run()
+    snap = sched.metrics.snapshot()
+    for i, r in enumerate(reqs):
+        _check(v, r.output_tokens == ref[i],
+               f"request {i} did not recover within the retry budget "
+               f"(finish={r.finish_reason!r})")
+    _check(v, snap["wave_retries"] >= 1,
+           "serving_wave_retries_total did not move")
+    _check(v, engine.health_state == "ok",
+           f"engine health {engine.health_state!r} after a transient "
+           "fault, expected 'ok'")
+    return v
+
+
+def scenario_slow_wave(engine, inject):
+    """Injected wave latency: slow is not broken — everything completes
+    with outputs untouched."""
+    v = []
+    prompts = _prompts()
+    ref = _reference(engine, prompts)
+    monkey = chaos.ChaosMonkey([chaos.Fault(
+        chaos.DECODE_WAVE, action="delay", delay_s=0.02, times=(2, 3))])
+    with chaos.active(monkey):
+        _, reqs = _run_stream(engine, prompts)
+    _check(v, len(monkey.fired) == 2, "slow-wave injection never fired")
+    for i, r in enumerate(reqs):
+        _check(v, r.output_tokens == ref[i],
+               f"request {i} output diverged under injected latency")
+    return v
+
+
+def scenario_prefill_error(engine, inject):
+    """Failing prefill: the admission fails ONLY its request; the slot
+    is not leaked and later admissions land in it."""
+    v = []
+    prompts = _prompts()
+    ref = _reference(engine, prompts)
+    monkey = chaos.ChaosMonkey([chaos.Fault(chaos.PREFILL, times=(2,))])
+    with chaos.active(monkey):
+        sched, reqs = _run_stream(engine, prompts)
+    _check(v, reqs[1].finish_reason == "error",
+           f"failed-prefill request finished {reqs[1].finish_reason!r}, "
+           "expected 'error'")
+    for i in (0, 2, 3):
+        _check(v, reqs[i].output_tokens == ref[i],
+               f"request {i} output diverged after a neighbour's "
+               "prefill failure")
+    _check(v, len(engine.free_slots()) == SLOTS,
+           "slot leaked by the failed prefill")
+    _check(v, sched.metrics.snapshot()["faults"].get("prefill_error", 0)
+           == 1, "serving_faults_total{kind=prefill_error} did not move")
+    return v
+
+
+def scenario_callback_error(engine, inject):
+    """Injected exception in a client on_token callback: contained to
+    `callback_error`, counted, and the request still completes."""
+    v = []
+    before = telemetry.value("serving_callback_errors_total", default=0)
+    monkey = chaos.ChaosMonkey([chaos.Fault(chaos.CALLBACK, times=(1,),
+                                            max_fires=1)])
+    seen = []
+    with chaos.active(monkey):
+        _, reqs = _run_stream(engine, _prompts(2),
+                              on_token=lambda r, t: seen.append((r, t)))
+    after = telemetry.value("serving_callback_errors_total", default=0)
+    _check(v, isinstance(reqs[0].callback_error, chaos.ChaosError),
+           "injected callback exception was not contained into "
+           "callback_error")
+    _check(v, len(reqs[0].output_tokens) == MAX_TOKENS,
+           "request with a failing callback did not complete")
+    _check(v, after - before == 1,
+           f"serving_callback_errors_total moved {after - before}, "
+           "expected 1")
+    _check(v, all(len(r.output_tokens) == MAX_TOKENS for r in reqs),
+           "a client callback fault leaked into the wave loop")
+    return v
+
+
+def scenario_overflow_shed(engine, inject):
+    """Bounded admission queue: overflow sheds with finish_reason
+    'rejected' (a clean ValueError), accepted work completes."""
+    from paddle_tpu.serving import Request
+    v = []
+    sched = Scheduler(engine, max_queue=2)
+    accepted, shed = [], []
+    for p in _prompts(6):
+        req = Request(prompt=p, max_tokens=MAX_TOKENS)
+        try:
+            sched.submit(request=req)
+            accepted.append(req)
+        except ValueError:
+            shed.append(req)
+    sched.run()
+    snap = sched.metrics.snapshot()
+    _check(v, len(accepted) == 2, f"accepted {len(accepted)}, expected "
+           "max_queue=2 to bound admission")
+    _check(v, len(shed) == 4 and all(r.finish_reason == "rejected"
+                                     for r in shed),
+           "shed requests did not resolve with finish_reason 'rejected'")
+    _check(v, snap["rejected"] == 4,
+           f"serving_rejected_total moved {snap['rejected']}, expected 4")
+    _check(v, all(r.done and r.finish_reason != "rejected"
+                  for r in accepted),
+           "an accepted request did not complete after shedding")
+    return v
+
+
+def scenario_drain(engine, inject):
+    """Graceful drain: accepted requests (queued or in-slot) complete,
+    new submits shed, /healthz says 'draining'."""
+    v = []
+    sched = Scheduler(engine)
+    reqs = [sched.submit(prompt=p, max_tokens=MAX_TOKENS)
+            for p in _prompts(6)]                 # 4 slots + 2 queued
+    sched.step()
+    sched.drain()
+    _check(v, engine.health_state == "draining",
+           f"health {engine.health_state!r} after drain(), expected "
+           "'draining'")
+    from paddle_tpu.serving import Request
+    late = Request(prompt=[1, 2], max_tokens=2)
+    try:
+        sched.submit(request=late)
+        _check(v, False, "submit() accepted work while draining")
+    except ValueError:
+        pass
+    _check(v, late.finish_reason == "rejected",
+           f"post-drain submit resolved {late.finish_reason!r}, "
+           "expected 'rejected'")
+    sched.run()
+    _check(v, all(r.done and r.finish_reason not in ("rejected", "error")
+                  for r in reqs),
+           "an accepted request did not complete through drain")
+    return v
+
+
+def scenario_ckpt_crash(engine, inject):
+    """Crash during checkpoint write: the previous checkpoint stays the
+    manifest's 'latest' and Model.load_latest resumes from it."""
+    from paddle_tpu import hapi
+    from paddle_tpu.framework import serialization
+    v = []
+    with tempfile.TemporaryDirectory(prefix="chaos_ckpt_") as d:
+        pt.seed(0)
+        net1 = pt.nn.Linear(4, 2)
+        hapi.Model(net1).save(os.path.join(d, "step1"), training=False)
+        want = {k: t.numpy().copy() for k, t in net1.state_dict().items()}
+        pt.seed(99)
+        crashed = False
+        monkey = chaos.ChaosMonkey([chaos.Fault(chaos.CHECKPOINT_WRITE,
+                                                times=(1,))])
+        try:
+            with chaos.active(monkey):
+                hapi.Model(pt.nn.Linear(4, 2)).save(
+                    os.path.join(d, "step2"), training=False)
+        except chaos.ChaosError:
+            crashed = True
+        _check(v, crashed, "checkpoint-write fault never fired")
+        _check(v, not os.path.exists(os.path.join(d, "step2.pdparams")),
+               "torn write reached the destination checkpoint file")
+        doc = serialization.read_manifest(d)
+        _check(v, doc is not None and doc["path"] == "step1",
+               f"manifest no longer points at the complete checkpoint: "
+               f"{doc!r}")
+        net3 = pt.nn.Linear(4, 2)
+        prefix = hapi.Model(net3).load_latest(d)
+        _check(v, prefix is not None and prefix.endswith("step1"),
+               f"load_latest resumed from {prefix!r}, expected step1")
+        if prefix is not None:
+            same = all(np.allclose(net3.state_dict()[k].numpy(), want[k])
+                       for k in want)
+            _check(v, same, "resumed weights differ from the last "
+                   "complete checkpoint")
+    return v
+
+
+SCENARIOS = {
+    "nan_slot": scenario_nan_slot,
+    "wave_error": scenario_wave_error,
+    "slow_wave": scenario_slow_wave,
+    "prefill_error": scenario_prefill_error,
+    "callback_error": scenario_callback_error,
+    "overflow_shed": scenario_overflow_shed,
+    "drain": scenario_drain,
+    "ckpt_crash": scenario_ckpt_crash,
+}
+
+# positive controls: each disables one resilience property inside its
+# scenario; the run MUST exit 1 (tests/test_chaos.py asserts it)
+INJECTIONS = {"drop-isolation": "nan_slot", "no-retry": "wave_error"}
+
+
+def run(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="chaos_serving",
+        description="chaos scenarios over the serving resilience layer")
+    ap.add_argument("--scenarios", default=None,
+                    help=f"comma-separated subset of "
+                         f"{','.join(SCENARIOS)}")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 entry point: the full scenario set at "
+                         "the canonical tiny scale (identical to the "
+                         "default run; the flag names the contract)")
+    ap.add_argument("--inject", default=None, choices=sorted(INJECTIONS),
+                    help="positive control: violate one invariant and "
+                         "prove this runner exits 1")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--journal", default=None,
+                    help="write the chaos/fault flight-recorder journal "
+                         "to this JSONL path")
+    args = ap.parse_args(argv)
+
+    if args.inject is not None:
+        names = [INJECTIONS[args.inject]]
+    elif args.scenarios:
+        names = [s.strip() for s in args.scenarios.split(",") if s.strip()]
+        unknown = set(names) - set(SCENARIOS)
+        if unknown:
+            print(f"chaos_serving: unknown scenario(s) {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+    else:
+        names = list(SCENARIOS)
+
+    engine = get_engine()
+    rec = flight_recorder.FlightRecorder(args.journal)
+    results = {}
+    with flight_recorder.recording(rec):
+        rec.run_start(mode="chaos_serving", scenarios=names,
+                      inject=args.inject)
+        for name in names:
+            # scenario isolation on the shared engine: a failed scenario
+            # must not leak active slots or health state into the next
+            for s in engine.active_slots():
+                engine.retire_slot(s)
+            engine.set_health_state("ok")
+            try:
+                violations = SCENARIOS[name](engine, args.inject)
+            except Exception as e:   # noqa: BLE001 — a fault ESCAPED
+                violations = [f"fault escaped the resilience layer: "
+                              f"{type(e).__name__}: {e}"]
+            results[name] = violations
+            if not args.as_json:
+                mark = "ok" if not violations else "FAIL"
+                print(f"== {name}: {mark} ==")
+                for msg in violations:
+                    print(f"   violated: {msg}")
+        # the global invariant every fault path shares: the decode wave
+        # is still ONE compiled program (and the live metric agrees)
+        compile_ok = (engine.decode_compiles == 1
+                      and telemetry.compile_count("serving_decode_wave")
+                      == _CACHE["compiles_after_warm"])
+        if not compile_ok:
+            results["compile_once"] = [
+                f"decode wave recompiled under fault load: "
+                f"cache={engine.decode_compiles}, metric="
+                f"{telemetry.compile_count('serving_decode_wave')}"]
+        rec.run_end(status="ok" if not any(results.values()) else
+                    "violations")
+    rec.close()
+
+    failed = {k: v for k, v in results.items() if v}
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "status": "ok" if not failed else "violations",
+            "inject": args.inject,
+            "scenarios": results,
+            "journal_counts": rec.counts(),
+        }, indent=2))
+    else:
+        print(f"chaos_serving: {len(results) - len(failed)}/"
+              f"{len(results)} scenarios clean"
+              + (f" (inject={args.inject}: expected to FAIL)"
+                 if args.inject else ""), file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -21,6 +21,9 @@ schema in docs/observability.md. The report covers:
     scripts/jxaudit.py) — clean stamp or findings-per-rule,
   * top collectives by payload bytes (op+group),
   * non-finite incidents and checkpoints,
+  * chaos injections (`chaos` events, utils.chaos) next to the `fault`
+    events the serving resilience layer wrote while recovering —
+    scripts/chaos_serving.py journals prove each recovery this way,
   * run status (a `run_end {status: "crashed"}` means the tail of the
     journal is the flight recorder doing its job).
 
@@ -147,6 +150,16 @@ def summarize(events):
             "degraded": last.get("degraded"),
         }
 
+    # resilience: injected faults vs handled faults, by point/kind
+    chaos_by_point, faults_by_kind = {}, {}
+    for e in events:
+        if e.get("ev") == "chaos":
+            key = e.get("point", "?")
+            chaos_by_point[key] = chaos_by_point.get(key, 0) + 1
+        elif e.get("ev") == "fault":
+            key = e.get("kind", "?")
+            faults_by_kind[key] = faults_by_kind.get(key, 0) + 1
+
     by_coll = {}
     for c in colls:
         key = (c.get("op", "?"), c.get("group", "default"))
@@ -177,6 +190,8 @@ def summarize(events):
             "sources": sorted({e.get("source", "?") for e in nonfinite}),
         },
         "collectives": top_collectives,
+        "chaos": chaos_by_point,
+        "faults": faults_by_kind,
         "checkpoints": sum(1 for e in events
                            if e.get("ev") == "checkpoint"),
         "last_loss": next((l for l in reversed(losses) if l is not None),
@@ -262,6 +277,12 @@ def render(s):
             lines.append(f"  {agg['op']}[{agg['group']}]: "
                          f"{agg['calls']} calls, "
                          f"{_fmt_bytes(agg['bytes'])}")
+    if s.get("chaos"):
+        inj = ", ".join(f"{k}={v}" for k, v in sorted(s["chaos"].items()))
+        lines.append(f"chaos injections: {inj}")
+    if s.get("faults"):
+        fl = ", ".join(f"{k}={v}" for k, v in sorted(s["faults"].items()))
+        lines.append(f"faults handled: {fl}")
     if s["checkpoints"]:
         lines.append(f"checkpoints: {s['checkpoints']}")
     if s["last_loss"] is not None:

@@ -1,0 +1,252 @@
+"""Chaos harness + serving/training resilience layer (tier-1).
+
+The heavy lifting lives in scripts/chaos_serving.py — one deterministic
+injection per fault class with post-fault invariants — driven here
+in-process (the engine is cached across run() calls, so the three
+invocations share ONE compiled decode wave from the persistent cache).
+The --inject runs are the positive controls: a runner that cannot fail
+proves nothing, so each must exit 1 (hlo_audit/jxaudit discipline).
+"""
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_cli(name):
+    path = os.path.join(REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_test_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def chaos_serving():
+    return _load_cli("chaos_serving")
+
+
+def test_smoke_every_fault_class_recovers(chaos_serving, capsys):
+    """The tier-1 contract: every chaos scenario's invariants hold —
+    poisoned slot isolated, transient wave retried, prefill contained,
+    callback counted, overflow shed, drain graceful, checkpoint crash
+    survivable — with the decode wave still compiled exactly once."""
+    assert chaos_serving.run(["--smoke"]) == 0
+    out = capsys.readouterr().out
+    assert "FAIL" not in out
+    engine = chaos_serving.get_engine()
+    assert engine.decode_compiles == 1
+    assert engine.prefill_compiles == 1
+
+
+def test_inject_drop_isolation_exits_1(chaos_serving, capsys):
+    """Positive control: poisoning EVERY lane while the checker expects
+    single-slot isolation must be caught (exit 1) — the token-identity
+    comparison is real, not vacuous."""
+    assert chaos_serving.run(["--inject", "drop-isolation"]) == 1
+    assert "diverged" in capsys.readouterr().out
+
+
+def test_inject_no_retry_exits_1(chaos_serving, capsys):
+    """Positive control: zeroing the retry budget degrades the engine,
+    and the recovers-within-budget invariant must catch it."""
+    assert chaos_serving.run(["--inject", "no-retry"]) == 1
+    assert "retry budget" in capsys.readouterr().out
+
+
+def test_journal_shows_injection_next_to_recovery(chaos_serving,
+                                                  tmp_path, capsys):
+    """One recovered run's journal carries BOTH sides: the `chaos`
+    event the injector wrote and the `fault` event the resilience
+    layer wrote while handling it."""
+    journal = tmp_path / "chaos.jsonl"
+    rc = chaos_serving.run(["--scenarios", "nan_slot", "--journal",
+                            str(journal), "--json"])
+    capsys.readouterr()
+    assert rc == 0
+    from paddle_tpu.utils import flight_recorder
+    events = flight_recorder.read_journal(str(journal))
+    kinds = {e["ev"] for e in events}
+    assert {"run_start", "chaos", "fault", "run_end"} <= kinds
+    chaos_ev = next(e for e in events if e["ev"] == "chaos")
+    assert chaos_ev["point"] == "serving.decode_wave.nan"
+    fault_ev = next(e for e in events if e["ev"] == "fault")
+    assert fault_ev["kind"] == "nonfinite"
+    assert fault_ev["slot"] == 1
+
+
+def test_monkey_prob_selector_is_seeded():
+    """Deterministic Bernoulli faults: same seed, same firing pattern."""
+    from paddle_tpu.utils import chaos
+
+    def pattern(seed):
+        m = chaos.ChaosMonkey(
+            [chaos.Fault("p", action="payload", payload=1, prob=0.3)],
+            seed=seed)
+        return [m.match("p")[0] is not None for _ in range(64)]
+
+    assert pattern(5) == pattern(5)
+    assert pattern(5) != pattern(6)
+    assert any(pattern(5)) and not all(pattern(5))
+
+
+def test_fault_selector_validated_at_construction():
+    """A broken selector fails fast at Fault() — never as a
+    ZeroDivisionError out of the production fault point mid-wave."""
+    from paddle_tpu.utils import chaos
+    with pytest.raises(ValueError, match="every"):
+        chaos.Fault("p", every=0)
+
+
+def test_fire_is_threadsafe_and_counts_per_point():
+    from paddle_tpu.utils import chaos
+    m = chaos.ChaosMonkey([chaos.Fault("x", action="payload", payload=9,
+                                       times=(50,))])
+    hits = []
+    with chaos.active(m):
+        def worker():
+            for _ in range(25):
+                out = chaos.fire("x")
+                if out is not None:
+                    hits.append(out)
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert m.invocations("x") == 100
+    assert hits == [9]
+    assert not chaos.enabled()
+
+
+def test_atomic_save_survives_midwrite_crash(tmp_path):
+    """Unit-level torn-write proof on framework.serialization directly:
+    the destination is either the old bytes or the new bytes, never a
+    prefix of the new ones, and no temp file is left behind."""
+    from paddle_tpu.framework import serialization
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.utils import chaos
+
+    path = str(tmp_path / "ckpt.pdparams")
+    old = {"w": Tensor(np.arange(6, dtype=np.float32))}
+    serialization.save(old, path)
+    monkey = chaos.ChaosMonkey([chaos.Fault(chaos.CHECKPOINT_WRITE,
+                                            times=(1,))])
+    with chaos.active(monkey):
+        with pytest.raises(chaos.ChaosError):
+            serialization.save(
+                {"w": Tensor(np.zeros(6, dtype=np.float32))}, path)
+    assert os.listdir(tmp_path) == ["ckpt.pdparams"]   # no .tmp litter
+    back = serialization.load(path)
+    np.testing.assert_array_equal(back["w"].numpy(),
+                                  np.arange(6, dtype=np.float32))
+
+
+def test_reused_prefix_torn_pair_is_detected(tmp_path):
+    """Re-saving over the SAME prefix and crashing between the two file
+    replaces leaves new params + old optimizer state on disk with the
+    old manifest still pointing at the prefix — the manifest's sha256
+    digests catch the mismatch and latest_checkpoint refuses the torn
+    pair instead of silently mixing saves."""
+    from paddle_tpu.framework import serialization
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.utils import chaos
+
+    d = str(tmp_path)
+    prefix = os.path.join(d, "ckpt")
+    digests = {
+        "ckpt.pdparams": serialization.save(
+            {"w": Tensor(np.ones(4, dtype=np.float32))},
+            prefix + ".pdparams"),
+        "ckpt.pdopt": serialization.save(
+            {"m": Tensor(np.ones(4, dtype=np.float32))},
+            prefix + ".pdopt"),
+    }
+    serialization.write_manifest(prefix, step=1, files=digests)
+    assert serialization.latest_checkpoint(d) == prefix    # digests ok
+
+    # second save to the same prefix: the new .pdparams REPLACES the
+    # old bytes in place, then the .pdopt write crashes (atomic: old
+    # .pdopt intact) — exactly the window the manifest alone can't see
+    serialization.save({"w": Tensor(np.zeros(4, dtype=np.float32))},
+                       prefix + ".pdparams")
+    monkey = chaos.ChaosMonkey([chaos.Fault(chaos.CHECKPOINT_WRITE,
+                                            times=(1,))])
+    with chaos.active(monkey):
+        with pytest.raises(chaos.ChaosError):
+            serialization.save(
+                {"m": Tensor(np.zeros(4, dtype=np.float32))},
+                prefix + ".pdopt")
+
+    assert serialization.latest_checkpoint(d) is None      # torn: refuse
+    assert serialization.latest_checkpoint(d, verify=False) == prefix
+    doc = serialization.read_manifest(d)
+    assert not serialization.verify_checkpoint(d, doc)
+
+
+def test_params_only_resave_drops_stale_optimizer_state(tmp_path):
+    """Re-saving a prefix WITHOUT optimizer state removes the previous
+    save's .pdopt and the manifest no longer lists it — new params can
+    never be silently paired with old optimizer moments."""
+    import paddle_tpu as pt
+    from paddle_tpu import hapi
+    from paddle_tpu.framework import serialization
+
+    pt.seed(1)
+    m = hapi.Model(pt.nn.Linear(4, 2))
+    m.prepare(pt.optimizer.Adam(learning_rate=1e-3,
+                                parameters=m.parameters()),
+              pt.nn.CrossEntropyLoss())
+    prefix = str(tmp_path / "ckpt")
+    m.save(prefix)                               # params + optimizer
+    assert os.path.exists(prefix + ".pdopt")
+
+    m.save(prefix, training=False)               # params-only re-save
+    assert not os.path.exists(prefix + ".pdopt")
+    doc = serialization.read_manifest(str(tmp_path))
+    assert set(doc["files"]) == {"ckpt.pdparams"}
+    assert serialization.latest_checkpoint(str(tmp_path)) == prefix
+    m2 = hapi.Model(pt.nn.Linear(4, 2))
+    assert m2.load_latest(str(tmp_path)) == prefix
+
+
+def test_chaos_guard_rule(tmp_path):
+    """The ptlint chaos-guard rule: unguarded fire() and point-function
+    imports are findings; the guarded idiom is clean."""
+    from paddle_tpu.tools.lint import lint_paths
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "from paddle_tpu.utils import chaos\n"
+        "from paddle_tpu.utils.chaos import fire\n"
+        "def f():\n"
+        "    chaos.fire('serving.decode_wave')\n")
+    findings = lint_paths([str(bad)], str(tmp_path),
+                          select=["chaos-guard"])
+    msgs = [f.message for f in findings]
+    assert len(findings) == 2
+    assert any("not guarded" in m for m in msgs)
+    assert any("import the module" in m for m in msgs)
+
+    good = tmp_path / "good.py"
+    good.write_text(
+        "from paddle_tpu.utils import chaos\n"
+        "def f():\n"
+        "    if chaos.enabled():\n"
+        "        chaos.fire('serving.decode_wave')\n")
+    assert lint_paths([str(good)], str(tmp_path),
+                      select=["chaos-guard"]) == []
+
+
+def test_json_report_shape(chaos_serving, capsys):
+    rc = chaos_serving.run(["--scenarios", "ckpt_crash", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["status"] == "ok"
+    assert doc["scenarios"]["ckpt_crash"] == []
+    assert doc["journal_counts"].get("chaos", 0) >= 1

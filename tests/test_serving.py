@@ -6,6 +6,8 @@ step and the prefill program each compile exactly once for the whole
 file; the compile-once invariant is asserted across a 3-wave stream.
 The heavier mixed-sampling stress run is @slow.
 """
+import threading
+
 import numpy as np
 import pytest
 import jax.numpy as jnp
@@ -13,7 +15,8 @@ import jax.numpy as jnp
 import paddle_tpu as pt
 from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.nlp.gpt import generate
-from paddle_tpu.serving import ServingEngine, Scheduler, RequestState
+from paddle_tpu.serving import (Request, RequestState, Scheduler,
+                                ServingEngine)
 
 VOCAB = 128
 PROMPT_LEN = 5
@@ -152,7 +155,9 @@ def test_request_hits_cache_horizon(engine):
 
 def test_streaming_callback_and_isolation(engine):
     """Tokens stream in order through on_token; a raising callback is
-    contained (callback_error) and does not poison the wave loop."""
+    contained (callback_error), counted in
+    serving_callback_errors_total, and does not poison the wave loop."""
+    from paddle_tpu.utils import telemetry
     sched = Scheduler(engine)
     seen = []
 
@@ -162,12 +167,95 @@ def test_streaming_callback_and_isolation(engine):
     def bad_cb(r, t):
         raise RuntimeError("client bug")
 
+    before = telemetry.value("serving_callback_errors_total", default=0)
     good = sched.submit(prompt=_prompt(8), max_tokens=5, on_token=cb)
     bad = sched.submit(prompt=_prompt(9), max_tokens=5, on_token=bad_cb)
     sched.run()
     assert seen == good.output_tokens and len(seen) == 5
     assert isinstance(bad.callback_error, RuntimeError)
     assert bad.state == RequestState.DONE and len(bad.output_tokens) == 5
+    # every emitted token's callback raised: 5 counted, none fatal
+    after = telemetry.value("serving_callback_errors_total", default=0)
+    assert after - before == 5
+
+
+def test_wait_reports_timeout_vs_done(engine):
+    """Request.wait returns True when the request finished and False
+    when the wait timed out (it used to return None either way)."""
+    sched = Scheduler(engine)
+    req = sched.submit(prompt=_prompt(30), max_tokens=3)
+    assert req.wait(timeout=0.01) is False      # nobody drives the loop
+    done = threading.Event()
+
+    def driver():
+        while not req.done:
+            sched.step()
+        done.set()
+
+    th = threading.Thread(target=driver, daemon=True)
+    th.start()
+    assert req.wait(timeout=30.0) is True
+    done.wait(30.0)
+    th.join()
+    assert req.finish_reason == "max_tokens"
+    assert req.wait() is True                   # already-done: immediate
+
+
+def test_drain_graceful_shutdown(engine):
+    """Satellite contract: submit mid-stream, drain() — in-flight AND
+    already-queued requests complete, new submits are shed with
+    finish_reason 'rejected', health reports 'draining', and the
+    compile-once contract survives the whole path."""
+    sched = Scheduler(engine)
+    try:
+        reqs = [sched.submit(prompt=_prompt(40 + i), max_tokens=4)
+                for i in range(6)]              # 4 slots + 2 queued
+        sched.step()                            # mid-stream
+        assert sched.in_flight() == 4 and sched.queue_depth() == 2
+        sched.drain()
+        assert engine.health_state == "draining"
+        assert sched.draining
+        late = Request(prompt=_prompt(50), max_tokens=2)
+        with pytest.raises(ValueError, match="draining"):
+            sched.submit(request=late)
+        assert late.finish_reason == "rejected"
+        assert late.state == RequestState.REJECTED
+        sched.run()
+        assert all(r.state == RequestState.DONE for r in reqs)
+        assert all(r.finish_reason == "max_tokens" for r in reqs)
+        assert engine.decode_compiles == 1      # fault paths compile-free
+    finally:
+        engine.set_health_state("ok")           # shared module engine
+
+
+def test_persistent_prefill_fault_escalates_to_degraded(engine,
+                                                        monkeypatch):
+    """A prefill failing for EVERY request is an engine fault, not a
+    request fault: after `prefill_fail_limit` consecutive failures the
+    scheduler degrades (queued work shed `rejected`, /healthz
+    'degraded') instead of failing requests one-by-one forever behind
+    an 'ok' health check."""
+    def boom(*a, **k):
+        raise RuntimeError("device wedged")
+    monkeypatch.setattr(engine, "prefill_slot", boom)
+    sched = Scheduler(engine, prefill_fail_limit=3)
+    try:
+        reqs = [sched.submit(prompt=_prompt(60 + i), max_tokens=2)
+                for i in range(5)]
+        sched.run()
+        assert sched.degraded
+        assert engine.health_state == "degraded"
+        assert [r.finish_reason for r in reqs[:3]] == ["error"] * 3
+        assert all(r.finish_reason == "rejected" for r in reqs[3:])
+        snap = sched.metrics.snapshot()
+        assert snap["faults"].get("prefill_error") == 3
+        assert snap["faults"].get("degraded") == 1
+        with pytest.raises(ValueError, match="degraded"):
+            sched.submit(prompt=_prompt(70), max_tokens=2)
+        assert engine.free_slots() == list(range(engine.num_slots))
+        assert engine.decode_compiles == 1      # no fault-path recompile
+    finally:
+        engine.set_health_state("ok")           # shared module engine
 
 
 def test_create_llm_predictor_front_door(engine):

@@ -91,9 +91,10 @@ def test_three_wave_run_trace_compiles_and_prometheus(engine, tmp_path):
 
 
 def test_snapshot_keys_byte_compatible(engine):
-    """ServingMetrics.snapshot() keeps the PR-1 key set exactly (the
-    bench script serializes it) now that percentiles come from bounded
-    histograms instead of raw sample lists."""
+    """ServingMetrics.snapshot() keeps the PR-1 key set (the bench
+    script serializes it) now that percentiles come from bounded
+    histograms instead of raw sample lists; the resilience PR appended
+    its fault/shed/retry tallies after them."""
     sched = Scheduler(engine)
     req = sched.submit(prompt=[1, 2, 3], max_tokens=3)
     sched.run()
@@ -102,10 +103,12 @@ def test_snapshot_keys_byte_compatible(engine):
     assert list(snap) == [
         "requests_completed", "tokens_generated", "tokens_per_s",
         "ttft_p50_s", "ttft_p99_s", "latency_p50_s", "latency_p99_s",
-        "slot_occupancy", "queue_depth_peak"]
+        "slot_occupancy", "queue_depth_peak",
+        "faults", "rejected", "wave_retries"]
     assert snap["requests_completed"] == 1
     assert snap["ttft_p50_s"] is not None
     assert snap["ttft_p50_s"] <= snap["latency_p50_s"]
+    assert snap["faults"] == {} and snap["rejected"] == 0
     assert json.dumps(snap)                       # still serializable
 
 

@@ -216,9 +216,21 @@ def test_healthz_degrades_on_broken_health_fn():
     status, _, body = telemetry.http_get_inline(
         "/healthz", registry=Registry(), health_fn=boom)
     payload = json.loads(body)
-    assert status == 200
+    assert status == 503           # status-code probes must fail too
     assert payload["status"] == "degraded"
     assert "engine wedged" in payload["error"]
+
+
+def test_healthz_non_ok_state_is_503():
+    """A health_fn reporting degraded/draining fails the probe at the
+    HTTP layer — load balancers that only check the status code stop
+    routing without parsing the body."""
+    for state in ("degraded", "draining"):
+        status, _, body = telemetry.http_get_inline(
+            "/healthz", registry=Registry(),
+            health_fn=lambda s=state: {"status": s})
+        assert status == 503
+        assert json.loads(body)["status"] == state
 
 
 def test_metrics_server_real_socket():
