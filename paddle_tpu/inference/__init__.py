@@ -40,18 +40,26 @@ class Config:
 
     # ---- LLM serving engine (paddle_tpu.serving front door)
     def enable_llm_engine(self, num_slots=4, max_len=256, prefill_len=None,
-                          eos_token_id=None, max_queue=None):
+                          eos_token_id=None, max_queue=None, paged=False,
+                          block_size=16, num_blocks=None):
         """Arm this Config for create_llm_predictor: slot-count / cache
         horizon / prompt bucket for the continuous-batching engine
         (docs/serving.md). switch_ir_optim(False) carries over as the
         engine's uncompiled per-call path, the same meaning it has for
-        the classic Predictor."""
+        the classic Predictor. paged=True serves from the block-table
+        paged KV cache (docs/serving.md "Paged KV cache"): HBM scales
+        with num_blocks (default: dense-equivalent capacity), prompts
+        chunk through `prefill_len`-sized prefill chunks, and identical
+        prompt prefixes share blocks."""
         self._llm_opts = {
             "num_slots": int(num_slots),
             "max_len": int(max_len),
             "prefill_len": None if prefill_len is None else int(prefill_len),
             "eos_token_id": eos_token_id,
             "max_queue": max_queue,
+            "paged": bool(paged),
+            "block_size": int(block_size),
+            "num_blocks": None if num_blocks is None else int(num_blocks),
         }
         return self
 
@@ -312,15 +320,25 @@ class LLMPredictor:
     the full submit()/run() surface for continuous batching."""
 
     def __init__(self, config, model):
-        from ..serving import ServingEngine, Scheduler
+        from ..serving import PagedServingEngine, ServingEngine, Scheduler
         opts = config._llm_opts or {}
         self._eos_token_id = opts.get("eos_token_id")
-        self.engine = ServingEngine(
-            model,
-            num_slots=opts.get("num_slots", 4),
-            max_len=opts.get("max_len", 256),
-            prefill_len=opts.get("prefill_len"),
-            jit_compile=config.ir_optim())
+        if opts.get("paged"):
+            self.engine = PagedServingEngine(
+                model,
+                num_slots=opts.get("num_slots", 4),
+                max_len=opts.get("max_len", 256),
+                block_size=opts.get("block_size", 16),
+                num_blocks=opts.get("num_blocks"),
+                prefill_chunk_len=opts.get("prefill_len"),
+                jit_compile=config.ir_optim())
+        else:
+            self.engine = ServingEngine(
+                model,
+                num_slots=opts.get("num_slots", 4),
+                max_len=opts.get("max_len", 256),
+                prefill_len=opts.get("prefill_len"),
+                jit_compile=config.ir_optim())
         self.scheduler = Scheduler(self.engine,
                                    max_queue=opts.get("max_queue"))
         self.metrics_server = None

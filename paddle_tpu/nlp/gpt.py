@@ -178,11 +178,21 @@ class GPTAttention(nn.Layer):
         shape = (batch, self.num_heads, max_len, self.head_dim)
         return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
-    def decode(self, x_t, cache, pos):
+    def init_paged_cache(self, num_blocks, block_size, dtype=jnp.float32):
+        """Block-pool KV cache [num_blocks, heads, block_size, head_dim]
+        x2 — requests claim BLOCKS (named by a host-managed table), not
+        dense rows; see serving/paged."""
+        shape = (num_blocks, self.num_heads, block_size, self.head_dim)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def decode(self, x_t, cache, pos, block_tables=None):
         """One-token step: write K/V at `pos`, attend q over cache[:pos].
         x_t: [B, 1, H] Tensor; pos: traced int — a scalar (lockstep
         batch) or a [B] vector (slot-wise serving decode: per-row cache
-        scatter + per-row mask, same shapes, one program)."""
+        scatter + per-row mask, same shapes, one program). With
+        block_tables [B, nblk], `cache` is the block POOL: K/V scatter
+        through the table and attention reads the gathered per-row
+        view — same fixed shapes, one program for every allocation."""
         b = x_t.shape[0]
         qkv = self.qkv_proj(x_t)
         a = qkv._data if isinstance(qkv, Tensor) else qkv
@@ -190,21 +200,58 @@ class GPTAttention(nn.Layer):
         a = jnp.transpose(a, (2, 0, 3, 1, 4))           # [3, B, nh, 1, D]
         q, k_t, v_t = a[0], a[1], a[2]
         ck, cv = cache
-        from ..nn.transformer import cached_decode_attention, scatter_kv_at
-        if jnp.ndim(pos):
+        from ..nn.transformer import (cached_decode_attention,
+                                      gather_block_kv, scatter_block_kv_at,
+                                      scatter_kv_at)
+        if block_tables is not None:
+            ck = scatter_block_kv_at(ck, k_t, block_tables, pos)
+            cv = scatter_block_kv_at(cv, v_t, block_tables, pos)
+            ak = gather_block_kv(ck, block_tables)
+            av = gather_block_kv(cv, block_tables)
+        elif jnp.ndim(pos):
             ck = scatter_kv_at(ck, k_t, pos)
             cv = scatter_kv_at(cv, v_t, pos)
+            ak, av = ck, cv
         else:
             ck = jax.lax.dynamic_update_slice_in_dim(
                 ck, k_t.astype(ck.dtype), pos, axis=2)
             cv = jax.lax.dynamic_update_slice_in_dim(
                 cv, v_t.astype(cv.dtype), pos, axis=2)
-        out = cached_decode_attention(q, ck, cv, pos,
+            ak, av = ck, cv
+        out = cached_decode_attention(q, ak, av, pos,
                                       1.0 / math.sqrt(self.head_dim),
                                       window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, -1)
         out = self.out_proj(Tensor(out.astype(x_t._data.dtype)))
         return out, (ck, cv)
+
+    def prefill_chunk(self, x, cache, block_tables, chunk_start, valid_len):
+        """One prompt CHUNK [1, C, H] against the block pool: scatter the
+        chunk's K/V through the table at absolute positions chunk_start +
+        arange(C) (the padded tail past valid_len goes to scratch), then
+        attend the C queries over the gathered view — previous chunks'
+        cached positions plus this chunk's own causal prefix
+        (chunk_attention masks ks <= chunk_start + i)."""
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        a = qkv._data if isinstance(qkv, Tensor) else qkv
+        a = a.reshape(b, s, 3, self.num_heads, self.head_dim)
+        a = jnp.transpose(a, (2, 0, 3, 1, 4))           # [3, B, nh, C, D]
+        q, k, v = a[0], a[1], a[2]
+        ck, cv = cache
+        from ..nn.transformer import (chunk_attention, gather_block_kv,
+                                      scatter_block_kv_chunk)
+        positions = chunk_start + jnp.arange(s)
+        ck = scatter_block_kv_chunk(ck, k, block_tables, positions,
+                                    valid_len)
+        cv = scatter_block_kv_chunk(cv, v, block_tables, positions,
+                                    valid_len)
+        out = chunk_attention(q, gather_block_kv(ck, block_tables),
+                              gather_block_kv(cv, block_tables),
+                              chunk_start, 1.0 / math.sqrt(self.head_dim),
+                              window=self.attn_window)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h)
+        return self.out_proj(Tensor(out.astype(x._data.dtype))), (ck, cv)
 
     def prefill(self, x, cache):
         """Prompt-phase step: the forward attention math over x [B, P, H]
@@ -272,8 +319,9 @@ class GPTBlock(nn.Layer):
             return x, m[1]
         return x + m
 
-    def decode(self, x, cache, pos):
-        a, cache = self.attn.decode(self.ln_1(x), cache, pos)
+    def decode(self, x, cache, pos, block_tables=None):
+        a, cache = self.attn.decode(self.ln_1(x), cache, pos,
+                                    block_tables=block_tables)
         x = x + a
         x = x + self.mlp(self.ln_2(x))
         return x, cache
@@ -284,6 +332,16 @@ class GPTBlock(nn.Layer):
         m = self.mlp(self.ln_2(x))
         if isinstance(m, tuple):         # MoE FFN: (out, aux_loss) — aux
             m = m[0]                     # is a training-only signal
+        return x + m, cache
+
+    def prefill_chunk(self, x, cache, block_tables, chunk_start, valid_len):
+        a, cache = self.attn.prefill_chunk(self.ln_1(x), cache,
+                                           block_tables, chunk_start,
+                                           valid_len)
+        x = x + a
+        m = self.mlp(self.ln_2(x))
+        if isinstance(m, tuple):         # MoE FFN: aux is training-only
+            m = m[0]
         return x + m, cache
 
 
@@ -350,9 +408,26 @@ class GPTModel(nn.Layer):
         return [blk.attn.init_cache(batch, max_len, dtype)
                 for blk in self.blocks]
 
-    def decode_step(self, tok, caches, pos):
+    def init_paged_cache(self, num_blocks, block_size, max_len,
+                         dtype=jnp.float32):
+        """Per-layer block pools [num_blocks, heads, block_size, hd] x2.
+        max_len is the per-request horizon (nblk * block_size) — checked
+        against the position-embedding table here because inside the
+        decode wave `pos` is traced and the gather would clamp
+        silently."""
+        if max_len > self.cfg.max_seq_len:
+            raise ValueError(
+                f"decode length {max_len} exceeds max_seq_len "
+                f"{self.cfg.max_seq_len}: the position-embedding gather "
+                "at a traced pos would clamp silently")
+        return [blk.attn.init_paged_cache(num_blocks, block_size, dtype)
+                for blk in self.blocks]
+
+    def decode_step(self, tok, caches, pos, block_tables=None):
         """tok: [B, 1] ids; pos: traced position — a scalar, or a [B]
-        vector for slot-wise serving decode. Returns (h, caches)."""
+        vector for slot-wise serving decode. With block_tables [B, nblk]
+        the caches are block POOLS (paged serving engine). Returns
+        (h, caches)."""
         pos = pos._data if isinstance(pos, Tensor) else pos
         if jnp.ndim(pos):
             pos_ids = jnp.asarray(pos, jnp.int32)[:, None]
@@ -363,7 +438,24 @@ class GPTModel(nn.Layer):
         x = self.embeddings(tok, Tensor(pos_ids))
         new_caches = []
         for blk, cache in zip(self.blocks, caches):
-            x, cache = blk.decode(x, cache, pos)
+            x, cache = blk.decode(x, cache, pos,
+                                  block_tables=block_tables)
+            new_caches.append(cache)
+        return self.ln_f(x), new_caches
+
+    def prefill_chunk(self, tok_chunk, caches, block_tables, chunk_start,
+                      valid_len):
+        """One prompt chunk [1, C] ids at absolute positions chunk_start
+        + arange(C) against the block pools (chunked prefill: long
+        prompts run C tokens at a time between decode waves, writing K/V
+        through the slot's block table). Returns (h, caches)."""
+        c = tok_chunk.shape[1]
+        pos_ids = (chunk_start + jnp.arange(c, dtype=jnp.int32))[None, :]
+        x = self.embeddings(tok_chunk, Tensor(pos_ids))
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, cache = blk.prefill_chunk(x, cache, block_tables,
+                                         chunk_start, valid_len)
             new_caches.append(cache)
         return self.ln_f(x), new_caches
 
@@ -416,8 +508,30 @@ class GPTForPretraining(nn.Layer):
     def init_cache(self, batch, max_len, dtype=jnp.float32):
         return self.gpt.init_cache(batch, max_len, dtype)
 
-    def decode_step(self, tok, caches, pos):
-        h, caches = self.gpt.decode_step(tok, caches, pos)
+    def init_paged_cache(self, num_blocks, block_size, max_len,
+                         dtype=jnp.float32):
+        return self.gpt.init_paged_cache(num_blocks, block_size, max_len,
+                                         dtype)
+
+    def decode_step(self, tok, caches, pos, block_tables=None):
+        h, caches = self.gpt.decode_step(tok, caches, pos,
+                                         block_tables=block_tables)
+        w = self.gpt.embeddings.word_embeddings.weight
+        from ..ops.math import matmul
+        return matmul(h, w, transpose_y=True), caches
+
+    def prefill_chunk(self, tok_chunk, caches, block_tables, chunk_start,
+                      valid_len, frontier=None):
+        """One prompt chunk against the block pools. frontier (traced
+        index WITHIN the chunk): logits for that one position only —
+        [1, V] instead of [C, V], same trick as prefill; only the final
+        chunk's frontier is consumed by the serving engine."""
+        h, caches = self.gpt.prefill_chunk(tok_chunk, caches, block_tables,
+                                           chunk_start, valid_len)
+        if frontier is not None:
+            hr = h._data if isinstance(h, Tensor) else h
+            h = Tensor(jax.lax.dynamic_slice_in_dim(hr, frontier, 1,
+                                                    axis=1))
         w = self.gpt.embeddings.word_embeddings.weight
         from ..ops.math import matmul
         return matmul(h, w, transpose_y=True), caches

@@ -145,6 +145,20 @@ def apply_rope(x, cos, sin, pos_offset=0):
     return _rope_rotate(x, cos, sin, pos_offset, head_axis=1)
 
 
+def apply_rope_positions(x, cos, sin, positions):
+    """x: [B, H, C, D] rotated at per-sequence-position vector
+    `positions` [C] (traced absolute positions — chunked prefill).
+    GATHERED per element, not dynamic-sliced: a final padded chunk can
+    run past the table end, where a dynamic_slice clamps its START and
+    silently shifts the rotation of VALID rows; the gather clamps only
+    the out-of-range pad rows themselves (whose K/V is redirected to
+    the scratch block and never read)."""
+    idx = jnp.minimum(positions, cos.shape[0] - 1)
+    c = cos[idx][None, None, :, :]                  # [1, 1, C, D/2]
+    sn = sin[idx][None, None, :, :]
+    return _rotate_pairs(x, c, sn)
+
+
 def apply_rope_at(x, cos, sin, pos):
     """Single-token RoPE at a per-row position VECTOR. x: [B, H, 1, D];
     pos: [B] int — each batch row rotated at its own position (slot-wise
@@ -279,12 +293,22 @@ class LlamaAttention(nn.Layer):
         shape = (batch, self.num_kv_heads, max_len, self.head_dim)
         return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
-    def decode(self, x_t, cache, pos):
+    def init_paged_cache(self, num_blocks, block_size, dtype=jnp.float32):
+        """Block-pool KV cache [num_blocks, kv_heads, block_size, hd] x2
+        — GQA pools cache only the kv heads, and requests claim blocks
+        through a host-managed table (serving/paged)."""
+        shape = (num_blocks, self.num_kv_heads, block_size, self.head_dim)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def decode(self, x_t, cache, pos, block_tables=None):
         """One-token step: RoPE at `pos` (traced), write K/V, attend over
         cache[:pos]. x_t: [B, 1, H] Tensor. `pos` is a scalar (lockstep
         batch) or a [B] vector — slot-wise serving decode where each row
         is at its own depth; the vector path scatters per-row cache
-        writes and masks per-row, same fixed shapes, one program."""
+        writes and masks per-row, same fixed shapes, one program. With
+        block_tables [B, nblk] the cache is the block POOL: K/V scatter
+        through the table and attention reads the gathered per-row
+        view."""
         from ..framework.tensor import Tensor
         nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
         b = x_t.shape[0]
@@ -295,12 +319,22 @@ class LlamaAttention(nn.Layer):
         k_t = k_t.reshape(b, 1, nkv, hd).transpose(0, 2, 1, 3)
         v_t = v_t.reshape(b, 1, nkv, hd).transpose(0, 2, 1, 3)
         ck, cv = cache
-        from ..nn.transformer import cached_decode_attention, scatter_kv_at
-        if jnp.ndim(pos):
+        from ..nn.transformer import (cached_decode_attention,
+                                      gather_block_kv, scatter_block_kv_at,
+                                      scatter_kv_at)
+        if block_tables is not None:
+            q = apply_rope_at(q, self._cos, self._sin, pos)
+            k_t = apply_rope_at(k_t, self._cos, self._sin, pos)
+            ck = scatter_block_kv_at(ck, k_t, block_tables, pos)
+            cv = scatter_block_kv_at(cv, v_t, block_tables, pos)
+            ak = gather_block_kv(ck, block_tables)
+            av = gather_block_kv(cv, block_tables)
+        elif jnp.ndim(pos):
             q = apply_rope_at(q, self._cos, self._sin, pos)
             k_t = apply_rope_at(k_t, self._cos, self._sin, pos)
             ck = scatter_kv_at(ck, k_t, pos)
             cv = scatter_kv_at(cv, v_t, pos)
+            ak, av = ck, cv
         else:
             q = apply_rope(q, self._cos, self._sin, pos_offset=pos)
             k_t = apply_rope(k_t, self._cos, self._sin, pos_offset=pos)
@@ -308,10 +342,45 @@ class LlamaAttention(nn.Layer):
                 ck, k_t.astype(ck.dtype), pos, axis=2)
             cv = jax.lax.dynamic_update_slice_in_dim(
                 cv, v_t.astype(cv.dtype), pos, axis=2)
-        out = cached_decode_attention(q, ck, cv, pos, 1.0 / math.sqrt(hd),
+            ak, av = ck, cv
+        out = cached_decode_attention(q, ak, av, pos, 1.0 / math.sqrt(hd),
                                       window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, nh * hd)
         out = self.o_proj(Tensor(out.astype(x_t._data.dtype)))
+        return out, (ck, cv)
+
+    def prefill_chunk(self, x, cache, block_tables, chunk_start,
+                      valid_len):
+        """One prompt chunk [1, C, H] against the block pool: RoPE at the
+        absolute positions chunk_start + arange(C) (gathered per
+        position — a final chunk may overrun the table with pad rows),
+        scatter the chunk's K/V through the table, attend the C queries
+        over the gathered view (previous chunks + own causal prefix)."""
+        from ..framework.tensor import Tensor
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        a = qkv._data if isinstance(qkv, Tensor) else qkv
+        q, k, v = jnp.split(a, [nh * hd, (nh + nkv) * hd], axis=-1)
+        q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+        positions = chunk_start + jnp.arange(s)
+        q = apply_rope_positions(q, self._cos, self._sin, positions)
+        k = apply_rope_positions(k, self._cos, self._sin, positions)
+        ck, cv = cache
+        from ..nn.transformer import (chunk_attention, gather_block_kv,
+                                      scatter_block_kv_chunk)
+        ck = scatter_block_kv_chunk(ck, k, block_tables, positions,
+                                    valid_len)
+        cv = scatter_block_kv_chunk(cv, v, block_tables, positions,
+                                    valid_len)
+        out = chunk_attention(q, gather_block_kv(ck, block_tables),
+                              gather_block_kv(cv, block_tables),
+                              chunk_start, 1.0 / math.sqrt(hd),
+                              window=self.attn_window)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, nh * hd)
+        out = self.o_proj(Tensor(out.astype(x._data.dtype)))
         return out, (ck, cv)
 
     def prefill(self, x, cache):
@@ -378,15 +447,24 @@ class LlamaBlock(nn.Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
-    def decode(self, x, cache, pos):
+    def decode(self, x, cache, pos, block_tables=None):
         a, cache = self.self_attn.decode(self.input_layernorm(x), cache,
-                                         pos)
+                                         pos, block_tables=block_tables)
         x = x + a
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, cache
 
     def prefill(self, x, cache):
         a, cache = self.self_attn.prefill(self.input_layernorm(x), cache)
+        x = x + a
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, cache
+
+    def prefill_chunk(self, x, cache, block_tables, chunk_start,
+                      valid_len):
+        a, cache = self.self_attn.prefill_chunk(
+            self.input_layernorm(x), cache, block_tables, chunk_start,
+            valid_len)
         x = x + a
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, cache
@@ -420,15 +498,46 @@ class LlamaModel(nn.Layer):
         return [blk.self_attn.init_cache(batch, max_len, dtype)
                 for blk in self.layers]
 
-    def decode_step(self, tok, caches, pos):
+    def init_paged_cache(self, num_blocks, block_size, max_len,
+                         dtype=jnp.float32):
+        """Per-layer block pools [num_blocks, kv_heads, block_size, hd]
+        x2. max_len (= nblk * block_size, the per-request horizon) is
+        validated against the RoPE table here because positions are
+        traced inside the programs (dynamic_slice would clamp
+        silently)."""
+        first = self.layers[0].self_attn
+        if max_len > first._cos.shape[0]:
+            raise ValueError(
+                f"decode length {max_len} exceeds the RoPE table "
+                f"({first._cos.shape[0]}); raise max_seq_len")
+        return [blk.self_attn.init_paged_cache(num_blocks, block_size,
+                                               dtype)
+                for blk in self.layers]
+
+    def decode_step(self, tok, caches, pos, block_tables=None):
         """tok: [B, 1] ids; pos: traced position — a scalar, or a [B]
-        vector for slot-wise serving decode. Returns (h, caches)."""
+        vector for slot-wise serving decode. With block_tables [B, nblk]
+        the caches are block POOLS (paged serving engine). Returns
+        (h, caches)."""
         from ..framework.tensor import Tensor
         pos = pos._data if isinstance(pos, Tensor) else pos
         x = self.embed_tokens(tok)
         new_caches = []
         for blk, cache in zip(self.layers, caches):
-            x, cache = blk.decode(x, cache, pos)
+            x, cache = blk.decode(x, cache, pos,
+                                  block_tables=block_tables)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
+
+    def prefill_chunk(self, tok_chunk, caches, block_tables, chunk_start,
+                      valid_len):
+        """One prompt chunk [1, C] ids at absolute positions chunk_start
+        + arange(C) against the block pools (chunked prefill)."""
+        x = self.embed_tokens(tok_chunk)
+        new_caches = []
+        for blk, cache in zip(self.layers, caches):
+            x, cache = blk.prefill_chunk(x, cache, block_tables,
+                                         chunk_start, valid_len)
             new_caches.append(cache)
         return self.norm(x), new_caches
 
@@ -482,8 +591,29 @@ class LlamaForCausalLM(nn.Layer):
     def init_cache(self, batch, max_len, dtype=jnp.float32):
         return self.model.init_cache(batch, max_len, dtype)
 
-    def decode_step(self, tok, caches, pos):
-        h, caches = self.model.decode_step(tok, caches, pos)
+    def init_paged_cache(self, num_blocks, block_size, max_len,
+                         dtype=jnp.float32):
+        return self.model.init_paged_cache(num_blocks, block_size,
+                                           max_len, dtype)
+
+    def decode_step(self, tok, caches, pos, block_tables=None):
+        h, caches = self.model.decode_step(tok, caches, pos,
+                                           block_tables=block_tables)
+        return self._logits(h), caches
+
+    def prefill_chunk(self, tok_chunk, caches, block_tables, chunk_start,
+                      valid_len, frontier=None):
+        """One prompt chunk against the block pools; frontier (traced
+        index within the chunk) keeps the vocab matmul [1, V] — only the
+        final chunk's frontier row is consumed by the serving engine."""
+        from ..framework.tensor import Tensor
+        h, caches = self.model.prefill_chunk(tok_chunk, caches,
+                                             block_tables, chunk_start,
+                                             valid_len)
+        if frontier is not None:
+            hr = h._data if isinstance(h, Tensor) else h
+            h = Tensor(jax.lax.dynamic_slice_in_dim(hr, frontier, 1,
+                                                    axis=1))
         return self._logits(h), caches
 
     def prefill(self, input_ids, max_len, dtype=jnp.float32,

@@ -417,3 +417,91 @@ def scatter_kv_at(cache, kv_t, pos):
     return jax.vmap(
         lambda c, t, p: jax.lax.dynamic_update_slice_in_dim(
             c, t, p, axis=1))(cache, kv_t.astype(cache.dtype), pos)
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache primitives (serving/paged: block-table memory manager)
+# ---------------------------------------------------------------------------
+# The pool is [num_blocks, Hkv, block_size, D]; a request's cache is the
+# ordered sequence of pool blocks named by its block TABLE (int32 block
+# ids, host-managed by serving.paged.BlockPool). All shapes below are
+# static — table entries are VALUES, not shapes — so one compiled
+# program serves every allocation pattern (compile-once). Block 0 is
+# the scratch block: inactive/invalid lanes are redirected there, its
+# contents are garbage by design and never read by a surviving lane
+# (the ks <= pos mask and the active-lane `where` discard them).
+
+
+def gather_block_kv(pool, tables):
+    """Materialise per-row KV views from the block pool. pool:
+    [NB, Hkv, BS, D]; tables: [B, nblk] int32 → [B, Hkv, nblk*BS, D],
+    position p of row b living at pool[tables[b, p // BS], :, p % BS].
+    One gather — the paged analog of reading the dense [B, Hkv, L, D]
+    cache (same bytes streamed when nblk*BS == L)."""
+    import jax.numpy as jnp
+    g = pool[tables]                           # [B, nblk, Hkv, BS, D]
+    b, nblk, hkv, bs, d = g.shape
+    return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(b, hkv, nblk * bs, d)
+
+
+def scatter_block_kv_at(pool, kv_t, tables, pos):
+    """Write one step's K or V [B, Hkv, 1, D] through block tables
+    [B, nblk] at per-row positions pos [B]: row b lands in
+    pool[tables[b, pos[b] // BS], :, pos[b] % BS]. One scatter. Rows
+    whose table entry is the scratch block (retired/starved lanes —
+    the host rewrites their table rows) collide there harmlessly."""
+    import jax.numpy as jnp
+    bs = pool.shape[2]
+    blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
+    return pool.at[blk, :, pos % bs, :].set(
+        kv_t[:, :, 0, :].astype(pool.dtype))
+
+
+def scatter_block_kv_chunk(pool, kv_c, table, positions, valid_len):
+    """Write a prefill chunk's K or V [1, Hkv, C, D] through one row's
+    block table [1, nblk] at absolute positions [C] (= chunk_start + i).
+    Positions at or past valid_len (the padded tail of the last chunk)
+    are redirected to the scratch block."""
+    import jax.numpy as jnp
+    nblk, bs = table.shape[1], pool.shape[2]
+    c = positions.shape[0]
+    # clamp BEFORE the table gather (a padded tail can index past the
+    # table); invalid lanes are then redirected to scratch regardless
+    blk = table[0, jnp.minimum(positions // bs, nblk - 1)]
+    blk = jnp.where(jnp.arange(c) < valid_len, blk, 0)
+    kv = jnp.transpose(kv_c[0], (1, 0, 2))     # [C, Hkv, D]
+    return pool.at[blk, :, positions % bs, :].set(kv.astype(pool.dtype))
+
+
+def chunk_attention(q, ck, cv, start, scale, window=None):
+    """Prefill-chunk attention core: C queries at absolute positions
+    start + i over an L-position KV view (the gathered paged cache,
+    which already contains this chunk's own K/V). q: [B, H, C, D];
+    ck/cv: [B, Hkv, L, D] with H % Hkv == 0 — grouped (GQA) without
+    materialising the repeated cache, exactly like
+    cached_decode_attention (C == 1 of this is that function). `start`
+    is a traced scalar or a [B] vector; each query row masks
+    ks <= start + i (banded to the last `window` keys when given), so a
+    chunk mid-prefill attends to every previous chunk's cached
+    positions plus its own causal prefix. Returns [B, H, C, D] in
+    cv.dtype."""
+    import jax
+    import jax.numpy as jnp
+
+    b, h, c, d = q.shape
+    hkv, L = ck.shape[1], ck.shape[2]
+    rep = h // hkv
+    qf = q.astype(jnp.float32).reshape(b, hkv, rep, c, d)
+    scores = jnp.einsum("bkrcd,bkld->bkrcl", qf,
+                        ck.astype(jnp.float32)) * scale
+    if jnp.ndim(start):
+        start = jnp.reshape(start, (b, 1, 1, 1, 1))
+    qpos = start + jnp.arange(c).reshape(1, 1, 1, c, 1)
+    ks = jnp.arange(L).reshape(1, 1, 1, 1, L)
+    mask = ks <= qpos
+    if window is not None:
+        mask = mask & (ks > qpos - window)
+    scores = jnp.where(mask, scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    out = jnp.einsum("bkrcl,bkld->bkrcd", probs, cv)
+    return out.reshape(b, h, c, d)

@@ -33,6 +33,8 @@ from .engine import ServingEngine
 from .scheduler import Scheduler
 from .request import Request, RequestState
 from .metrics import ServingMetrics
+from .paged import BlockPool, BlockPoolExhausted, PagedServingEngine
 
 __all__ = ["ServingEngine", "Scheduler", "Request", "RequestState",
-           "ServingMetrics"]
+           "ServingMetrics", "BlockPool", "BlockPoolExhausted",
+           "PagedServingEngine"]

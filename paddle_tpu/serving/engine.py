@@ -63,6 +63,42 @@ def _raw(x):
     return x._data if isinstance(x, Tensor) else x
 
 
+def _select_wave_tokens(lo, tok, pos, active, sample, temps, poison, key):
+    """The decode wave's token-selection tail, shared by the dense AND
+    paged programs — the paged/dense token-parity contract depends on
+    this math staying identical, so it lives exactly once.
+
+    poison is all-False in production; the chaos harness sets a lane to
+    inject NaN logits WITHOUT a second compiled program. The fused
+    non-finite sentinel (the jit.TrainStep isfinite pattern) rides home
+    as one [S] bool with the tokens — no extra device sync; a poisoned
+    lane is frozen in-program and retired by the scheduler with
+    finish_reason "error". Inactive (or poisoned) lanes keep their
+    token and position via where — fixed shapes, no recompiles."""
+    lo = jnp.where(poison[:, None], jnp.float32(jnp.nan), lo)
+    finite = jnp.all(jnp.isfinite(lo), axis=-1)
+    greedy = jnp.argmax(lo, axis=-1).astype(jnp.int32)
+    scaled = lo / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled,
+                                     axis=-1).astype(jnp.int32)
+    nxt = jnp.where(sample, sampled, greedy)
+    ok = active & finite
+    nxt = jnp.where(ok, nxt, tok)
+    new_pos = jnp.where(ok, pos + 1, pos)
+    return nxt, new_pos, finite
+
+
+def _select_first_token(lo, sample, temp, key):
+    """The prefill programs' first-token selection ([V] frontier logits
+    -> token), shared by the dense AND paged chunked programs — same
+    parity contract as _select_wave_tokens: this math lives exactly
+    once."""
+    greedy = jnp.argmax(lo).astype(jnp.int32)
+    sampled = jax.random.categorical(
+        key, lo / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
+    return jnp.where(sample, sampled, greedy)
+
+
 class ServingEngine:
     """Fixed-shape batched decode executor. The Scheduler decides WHICH
     request occupies which slot and when; the engine only knows slots.
@@ -95,8 +131,7 @@ class ServingEngine:
         self._params, self._buffers = model.functional_state()
         self.cache_dtype = (cache_dtype if cache_dtype is not None
                             else _infer_cache_dtype(self._params))
-        self._caches = model.init_cache(self.num_slots, self.max_len,
-                                        dtype=self.cache_dtype)
+        self._caches = self._make_caches()
         self._key = jax.random.PRNGKey(seed)
 
         # host-authoritative per-slot state
@@ -107,12 +142,26 @@ class ServingEngine:
         self.slot_sample = [False] * S
         self.slot_temp = [1.0] * S
 
+        # admissions mid-prefill (slot -> engine-specific state): the
+        # scheduler admits via begin_prefill and advances one
+        # prefill_step per scheduling round, so a long admission can be
+        # folded BETWEEN decode waves (the dense engine completes in one
+        # step; the paged engine runs one chunk per step)
+        self._pending_prefill = {}
         self.last_nonfinite_slots = []
+        # paged engines report lanes whose next cache write could not be
+        # backed by a block (pool exhausted) — the scheduler preempts
+        # them; dense engines never starve
+        self.last_starved_slots = []
         self.health_state = "ok"
 
         self._jit = bool(jit_compile)
         self._metrics_server = None
         self._build_programs()
+
+    def _make_caches(self):
+        return self.model.init_cache(self.num_slots, self.max_len,
+                                     dtype=self.cache_dtype)
 
     # ---------------------------------------------------------- programs
     def _build_programs(self):
@@ -125,25 +174,8 @@ class ServingEngine:
                                            pos, method="decode_step")
             logits, new_caches = out
             lo = _raw(logits)[:, 0, :].astype(jnp.float32)
-            # poison is all-False in production; the chaos harness sets
-            # a lane to inject NaN logits WITHOUT a second compiled
-            # program — the same executable serves both paths
-            lo = jnp.where(poison[:, None], jnp.float32(jnp.nan), lo)
-            # fused non-finite sentinel (the jit.TrainStep isfinite
-            # pattern): one [S] bool rides home with the tokens, no
-            # extra device sync — a poisoned lane is frozen in-program
-            # and retired by the scheduler with finish_reason "error"
-            finite = jnp.all(jnp.isfinite(lo), axis=-1)
-            greedy = jnp.argmax(lo, axis=-1).astype(jnp.int32)
-            scaled = lo / jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.random.categorical(key, scaled,
-                                             axis=-1).astype(jnp.int32)
-            nxt = jnp.where(sample, sampled, greedy)
-            # retirement/freeze via where: inactive (or poisoned) lanes
-            # keep their token and position — fixed shapes, no recompiles
-            ok = active & finite
-            nxt = jnp.where(ok, nxt, tok)
-            new_pos = jnp.where(ok, pos + 1, pos)
+            nxt, new_pos, finite = _select_wave_tokens(
+                lo, tok, pos, active, sample, temps, poison, key)
             return nxt, new_pos, finite, new_caches
 
         def prefill(p, b, caches, prompt, prompt_len, slot, sample, temp,
@@ -156,10 +188,7 @@ class ServingEngine:
                                            frontier=prompt_len - 1)
             logits, slot_caches = out
             lo = _raw(logits)[0, 0].astype(jnp.float32)    # [V]
-            greedy = jnp.argmax(lo).astype(jnp.int32)
-            sampled = jax.random.categorical(
-                key, lo / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
-            first = jnp.where(sample, sampled, greedy)
+            first = _select_first_token(lo, sample, temp, key)
             new_caches = []
             for (ck, cv), (sck, scv) in zip(caches, slot_caches):
                 ck = jax.lax.dynamic_update_slice(
@@ -253,10 +282,16 @@ class ServingEngine:
 
     # ------------------------------------------------------------- slots
     def free_slots(self):
-        return [i for i, a in enumerate(self.slot_active) if not a]
+        return [i for i, a in enumerate(self.slot_active)
+                if not a and i not in self._pending_prefill]
 
     def active_slots(self):
         return [i for i, a in enumerate(self.slot_active) if a]
+
+    def prefilling_slots(self):
+        """Slots admitted but still mid-prefill (paged chunked prefill;
+        at most one scheduling round for the dense engine)."""
+        return sorted(self._pending_prefill)
 
     def validate_prompt(self, prompt):
         """Admission check: the prompt must fit the prefill bucket and
@@ -269,6 +304,31 @@ class ServingEngine:
             return (f"prompt length {n} leaves no room to decode under "
                     f"max_len {self.max_len}")
         return None
+
+    def begin_prefill(self, slot, prompt, do_sample=False,
+                      temperature=1.0):
+        """Stage an admission: validate and park the prompt on the slot.
+        The work itself runs in prefill_step — the scheduler's advance
+        phase — so engines whose prefill spans several rounds (paged
+        chunked prefill) keep decode waves flowing while a long prompt
+        is mid-admission. The dense engine completes in ONE
+        prefill_step."""
+        why = self.validate_prompt(prompt)
+        if why:
+            raise ValueError(why)
+        if self.slot_active[slot] or slot in self._pending_prefill:
+            raise RuntimeError(f"slot {slot} is busy")
+        self._pending_prefill[slot] = (list(prompt), bool(do_sample),
+                                       float(temperature))
+
+    def prefill_step(self, slot):
+        """Advance the slot's admission one step. Returns the request's
+        FIRST generated token (host int) when the prefill completed,
+        None while more steps remain (the dense bucket prefill always
+        completes here)."""
+        prompt, do_sample, temperature = self._pending_prefill.pop(slot)
+        return self.prefill_slot(slot, prompt, do_sample=do_sample,
+                                 temperature=temperature)
 
     def prefill_slot(self, slot, prompt, do_sample=False, temperature=1.0):
         """Admit a prompt into a free slot: run the prefill program,
@@ -318,23 +378,27 @@ class ServingEngine:
         active_now = list(self.slot_active)
         if not any(active_now):
             self.last_nonfinite_slots = []
+            self.last_starved_slots = []
+            return {}
+        if chaos.enabled():
+            chaos.fire(chaos.DECODE_WAVE, active=sum(active_now))
+        # back each lane's next cache write (paged engines allocate
+        # blocks here; a starved lane is excluded from this wave and
+        # reported in last_starved_slots for the scheduler to preempt).
+        # Idempotent, so a retried wave replays exactly.
+        active_now = self._prepare_wave(active_now)
+        if not any(active_now):
+            self.last_nonfinite_slots = []
             return {}
         poison = np.zeros((self.num_slots,), bool)
         if chaos.enabled():
-            chaos.fire(chaos.DECODE_WAVE, active=sum(active_now))
             hit = chaos.value(chaos.DECODE_WAVE_NAN)
             if hit is not None:
                 for s in np.atleast_1d(hit):
                     poison[int(s)] = True
         self._key, sub = jax.random.split(self._key)
         tok, pos, finite, self._caches = self._decode_wave(
-            self._params, self._buffers, self._caches,
-            jnp.asarray(self.slot_tok, jnp.int32),
-            jnp.asarray(self.slot_pos, jnp.int32),
-            jnp.asarray(active_now, bool),
-            jnp.asarray(self.slot_sample, bool),
-            jnp.asarray(self.slot_temp, jnp.float32),
-            jnp.asarray(poison), sub)
+            *self._wave_args(active_now, poison, sub))
         tok = np.asarray(tok)
         finite = np.asarray(finite)
         out, bad = {}, []
@@ -350,6 +414,24 @@ class ServingEngine:
         self.last_nonfinite_slots = bad
         return out
 
+    def _prepare_wave(self, active_now):
+        """Hook: ensure each active lane's next cache write has backing
+        storage. Dense rows always do; the paged engine allocates blocks
+        on demand and drops starved lanes from the wave."""
+        self.last_starved_slots = []
+        return active_now
+
+    def _wave_args(self, active_now, poison, key):
+        """The decode-wave program's argument tuple (the paged engine
+        inserts its block tables after the donated caches)."""
+        return (self._params, self._buffers, self._caches,
+                jnp.asarray(self.slot_tok, jnp.int32),
+                jnp.asarray(self.slot_pos, jnp.int32),
+                jnp.asarray(active_now, bool),
+                jnp.asarray(self.slot_sample, bool),
+                jnp.asarray(self.slot_temp, jnp.float32),
+                jnp.asarray(poison), key)
+
     def slot_full(self, slot):
         """True when the slot's next write would fall past the cache
         horizon (max_len - 1 is the last legal write) — the scheduler
@@ -359,7 +441,9 @@ class ServingEngine:
     def retire_slot(self, slot):
         """Free a slot between waves. The cache region is left as-is:
         the next prefill overwrites [0, P) and the decode frontier
-        rewrites every position before the ks<=pos mask exposes it."""
+        rewrites every position before the ks<=pos mask exposes it.
+        Also aborts a mid-prefill admission parked on the slot."""
         self.slot_active[slot] = False
         self.slot_sample[slot] = False
         self.slot_temp[slot] = 1.0
+        self._pending_prefill.pop(slot, None)

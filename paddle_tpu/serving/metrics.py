@@ -68,6 +68,39 @@ _CALLBACK_ERRORS = telemetry.counter(
     "serving_callback_errors_total",
     "Exceptions raised by client on_token callbacks (contained "
     "per-request, never poisoning the shared wave loop)")
+# paged KV cache (serving/paged): pool pressure + prefix-cache efficacy
+_CACHE_BLOCKS_USED = telemetry.gauge(
+    "serving_cache_blocks_used",
+    "KV-cache blocks currently referenced by live requests (paged "
+    "engine block pool)")
+_CACHE_BLOCKS_TOTAL = telemetry.gauge(
+    "serving_cache_blocks_total",
+    "Usable KV-cache blocks in the paged engine's pool (scratch "
+    "excluded) — used/total is the utilization that replaces dense "
+    "slot occupancy")
+_PREFIX_HITS = telemetry.counter(
+    "serving_prefix_cache_hits_total",
+    "Full prompt blocks served from the hash-based prefix cache "
+    "(shared system prompts dedupe onto the same physical blocks)")
+_PREFIX_MISSES = telemetry.counter(
+    "serving_prefix_cache_misses_total",
+    "Full prompt blocks that had to be computed by prefill (no cached "
+    "block with a matching chain hash)")
+
+
+def record_block_usage(used, total):
+    """Export the paged pool's occupancy (called by BlockPool on every
+    alloc/release)."""
+    _CACHE_BLOCKS_USED.set(int(used))
+    _CACHE_BLOCKS_TOTAL.set(int(total))
+
+
+def record_prefix_lookup(hits, misses):
+    """Count one admission's prefix-cache outcome, block-granular."""
+    if hits:
+        _PREFIX_HITS.inc(int(hits))
+    if misses:
+        _PREFIX_MISSES.inc(int(misses))
 
 
 def record_callback_error(request, error):
@@ -106,6 +139,14 @@ class ServingMetrics:
         self._faults = {}
         self._rejected = 0
         self._wave_retries = 0
+        # paged-pool tracking (None until a paged engine reports):
+        # utilization is the block-wave integral — the paged analog of
+        # slot occupancy — and the prefix tallies are deltas of the
+        # pool's monotonic counters over THIS instance's lifetime
+        self._block_used_waves = 0
+        self._block_total_waves = 0
+        self._prefix_base = None
+        self._prefix_last = None
 
     # ---------------------------------------------------------- recording
     def on_submit(self):
@@ -149,6 +190,21 @@ class ServingMetrics:
         with self._lock:
             self._queue_peak = max(self._queue_peak, int(depth))
 
+    def on_blocks(self, used, total):
+        """One scheduling round's paged-pool occupancy sample."""
+        with self._lock:
+            self._block_used_waves += int(used)
+            self._block_total_waves += int(total)
+
+    def on_prefix_totals(self, hits, misses):
+        """Track the pool's monotonic prefix counters; snapshot reports
+        the delta across this metrics instance (per-load-point rates in
+        the bench, which builds a fresh Scheduler per point)."""
+        with self._lock:
+            if self._prefix_base is None:
+                self._prefix_base = (int(hits), int(misses))
+            self._prefix_last = (int(hits), int(misses))
+
     def on_token(self, t_now):
         monitor.stat_add(TOKENS_GENERATED)
         _TOKENS.inc()
@@ -182,6 +238,13 @@ class ServingMetrics:
             queue_peak = self._queue_peak
             faults = dict(self._faults)
             rejected, wave_retries = self._rejected, self._wave_retries
+            blk_used, blk_total = (self._block_used_waves,
+                                   self._block_total_waves)
+            if self._prefix_base is None:
+                p_hits = p_misses = 0
+            else:
+                p_hits = self._prefix_last[0] - self._prefix_base[0]
+                p_misses = self._prefix_last[1] - self._prefix_base[1]
         return {
             "requests_completed": self._latency.count(),
             "tokens_generated": tokens,
@@ -198,4 +261,13 @@ class ServingMetrics:
             "faults": faults,
             "rejected": rejected,
             "wave_retries": wave_retries,
+            # paged KV pool (None/0 on a dense engine): utilization is
+            # the block-wave integral — HBM held by ACTUAL tokens, the
+            # number that replaces dense slot occupancy
+            "block_utilization": (blk_used / blk_total if blk_total
+                                  else None),
+            "prefix_hits": p_hits,
+            "prefix_misses": p_misses,
+            "prefix_hit_rate": (p_hits / (p_hits + p_misses)
+                                if p_hits + p_misses else None),
         }

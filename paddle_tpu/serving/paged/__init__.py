@@ -1,0 +1,19 @@
+"""paddle_tpu.serving.paged — block-table KV-cache subsystem.
+
+vLLM-style paged attention for the serving engine: a fixed pool of KV
+blocks per layer (`BlockPool`: free-list allocator with refcounts,
+hash-based prefix sharing with copy-on-write, lazy eviction), per-slot
+block tables traced into the SAME two compiled programs the dense
+engine discipline established, and chunked prefill so long-prompt
+admission folds between decode waves instead of stalling them. See
+docs/serving.md ("Paged KV cache").
+
+    from paddle_tpu.serving import PagedServingEngine, Scheduler
+    engine = PagedServingEngine(model, num_slots=8, max_len=512,
+                                block_size=16, num_blocks=129)
+    sched = Scheduler(engine)          # same scheduler, same Requests
+"""
+from .block_pool import BlockPool, BlockPoolExhausted
+from .engine import PagedServingEngine
+
+__all__ = ["BlockPool", "BlockPoolExhausted", "PagedServingEngine"]

@@ -1,0 +1,226 @@
+"""BlockPool: host-side memory manager for the paged KV cache.
+
+The device side is a fixed pool of KV blocks per layer —
+`[num_blocks, kv_heads, block_size, head_dim]` x2, allocated once at
+engine construction (serving pays HBM for the blocks it CONFIGURES, not
+`num_slots * max_len`). This class owns the block ids: a free list with
+refcounts, per-request allocation, and a hash-based prefix cache so
+identical prompt prefixes (the shared-system-prompt pattern that
+dominates at millions-of-users scale) map to the SAME physical blocks.
+
+Invariants the engine relies on:
+
+  * block 0 is the scratch block — never allocated, never hashed; the
+    compiled programs redirect inactive/invalid lanes' writes there;
+  * only FULL, immutable prompt blocks are hashed (chain hash: a
+    block's identity covers its entire token prefix, which for a causal
+    LM determines its K/V content exactly), and a hash is registered
+    only AFTER the prefill chunk that wrote the block ran — a
+    concurrent admission can never share a block whose content is not
+    on the device yet;
+  * a freed block (refcount 0) keeps its hash and stays reusable from
+    the free list — the prefix cache survives request churn and is
+    evicted lazily, oldest-freed first, only when allocation needs the
+    block back;
+  * `cow()` is the copy-on-write guard: writing through a block with
+    refcount > 1 must first move the writer onto a private copy. With
+    full-block-only sharing the decode frontier always lands in a
+    private block, so this fires only as a safety net — but it is the
+    load-bearing guarantee that sharing can never corrupt a neighbour.
+
+Thread-model: driven single-threaded from the scheduler's wave loop
+(`Scheduler._wave_lock` serializes every engine call); producer threads
+touch only the queue, never the pool.
+"""
+import collections
+import hashlib
+
+from ...utils import chaos
+from .. import metrics as serving_metrics
+
+
+class BlockPoolExhausted(RuntimeError):
+    """Allocation failed: every usable block is referenced. The
+    scheduler treats this as CAPACITY, not as a request fault — the
+    request is queued behind the blocks it is waiting for (or preempted
+    to free some), never crashed."""
+
+
+class BlockPool:
+    SCRATCH = 0
+
+    def __init__(self, num_blocks, block_size):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks must be >= 2 (one scratch + "
+                             f"one usable), got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # free list in eviction order (oldest-freed first); block 0 is
+        # the scratch block and never enters it
+        self._free = collections.OrderedDict(
+            (b, None) for b in range(1, self.num_blocks))
+        self._ref = [0] * self.num_blocks
+        self._hash_to_block = {}
+        self._block_hash = {}
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self._publish()
+
+    # ------------------------------------------------------------- state
+    @property
+    def usable(self):
+        """Allocatable blocks (scratch excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def used(self):
+        """Blocks currently referenced by at least one request."""
+        return self.usable - len(self._free)
+
+    def refcount(self, block):
+        return self._ref[block]
+
+    def _publish(self):
+        serving_metrics.record_block_usage(self.used, self.usable)
+
+    # -------------------------------------------------------- allocation
+    def alloc(self, n):
+        """Take `n` fresh blocks (refcount 1 each). Prefers blocks with
+        no cached hash; evicts prefix-cache entries oldest-freed first
+        only when it must. Raises BlockPoolExhausted when fewer than `n`
+        blocks are free — atomically: either all `n` or none."""
+        n = int(n)
+        if chaos.enabled():
+            # payload (truthy) = simulated exhaustion; raise-action =
+            # simulated allocator crash (must surface as a fault, not
+            # be absorbed as capacity)
+            if chaos.value(chaos.CACHE_ALLOC, need=n,
+                           free=len(self._free)):
+                raise BlockPoolExhausted(
+                    f"injected exhaustion: need {n} block(s)")
+        if n > len(self._free):
+            raise BlockPoolExhausted(
+                f"need {n} block(s), {len(self._free)} free of "
+                f"{self.usable} usable")
+        out = []
+        for _ in range(n):
+            blk = next((b for b in self._free
+                        if b not in self._block_hash), None)
+            if blk is None:
+                blk = next(iter(self._free))       # evict oldest cached
+            del self._free[blk]
+            h = self._block_hash.pop(blk, None)
+            if h is not None and self._hash_to_block.get(h) == blk:
+                del self._hash_to_block[h]
+            self._ref[blk] = 1
+            out.append(blk)
+        self._publish()
+        return out
+
+    def acquire(self, block):
+        """Add one reference to an already-referenced block (sharing)."""
+        if self._ref[block] < 1:
+            raise ValueError(f"block {block} is not live")
+        self._ref[block] += 1
+
+    def release(self, blocks):
+        """Drop one reference per block; refcount 0 returns the block to
+        the free list (keeping its prefix-cache hash, if any — the
+        cached content stays matchable until evicted by alloc)."""
+        for blk in blocks:
+            if self._ref[blk] < 1:
+                raise ValueError(f"double free of block {blk}")
+            self._ref[blk] -= 1
+            if self._ref[blk] == 0:
+                self._free[blk] = None
+        self._publish()
+
+    def cow(self, block):
+        """Copy-on-write guard: `block` unchanged when exclusively owned;
+        otherwise allocate a fresh block, move one reference off the
+        shared one, and return the new id — the CALLER must copy the
+        device content before writing through it."""
+        if self._ref[block] <= 1:
+            return block
+        new, = self.alloc(1)
+        self._ref[block] -= 1
+        return new
+
+    # ------------------------------------------------------ prefix cache
+    @staticmethod
+    def chain_hash(prev, tokens):
+        """Digest of one full block's tokens chained onto its prefix —
+        equal chain hashes mean equal (prefix, block) token content,
+        which for a causal LM means equal K/V content at equal
+        positions. A chained sha256, NOT the builtin hash(): lookups
+        serve K/V content across requests on digest equality alone, so
+        a collision (adversarially constructible for hash(), which is
+        also salted per process) would leak one request's cache into
+        another's decode."""
+        h = hashlib.sha256(b"" if prev is None else prev)
+        h.update(repr(tuple(int(t) for t in tokens)).encode())
+        return h.digest()
+
+    def match_prefix(self, tokens):
+        """Longest run of cached full blocks covering `tokens`' prefix.
+        Returns (blocks, hashes): per matched block, one NEW reference
+        (caller must release on failure) and its chain hash. Does NOT
+        count hits/misses — the caller counts via count_prefix only on
+        a SUCCESSFUL admission, so a request retrying at the queue head
+        under pool pressure doesn't inflate the dedup-efficacy rate."""
+        bs = self.block_size
+        nfull = len(tokens) // bs
+        blocks, hashes, h = [], [], None
+        for i in range(nfull):
+            h = self.chain_hash(h, tokens[i * bs:(i + 1) * bs])
+            blk = self._hash_to_block.get(h)
+            if blk is None:
+                break
+            if self._ref[blk] == 0:            # revive off the free list
+                del self._free[blk]
+            self._ref[blk] += 1
+            blocks.append(blk)
+            hashes.append(h)
+        self._publish()
+        return blocks, hashes
+
+    def count_prefix(self, hits, misses):
+        """Count one admitted prompt's prefix-cache outcome (hits =
+        full blocks served from cache, misses = full blocks prefill
+        must compute)."""
+        self.prefix_hits += int(hits)
+        self.prefix_misses += int(misses)
+        serving_metrics.record_prefix_lookup(int(hits), int(misses))
+
+    def prompt_hashes(self, tokens):
+        """Chain hashes for every full block of `tokens` (registration
+        schedule for the prefill path)."""
+        bs = self.block_size
+        out, h = [], None
+        for i in range(len(tokens) // bs):
+            h = self.chain_hash(h, tokens[i * bs:(i + 1) * bs])
+            out.append(h)
+        return out
+
+    def register_hash(self, block, chain_hash):
+        """Enter a WRITTEN full prompt block into the prefix cache. A
+        hash already mapping to another live block keeps the existing
+        mapping (first writer wins; the duplicate content is simply not
+        shared)."""
+        if self._ref[block] < 1:
+            raise ValueError(f"block {block} is not live")
+        if chain_hash in self._hash_to_block:
+            return
+        self._hash_to_block[chain_hash] = block
+        self._block_hash[block] = chain_hash
+
+    def stats(self):
+        return {
+            "used": self.used, "usable": self.usable,
+            "block_size": self.block_size,
+            "cached_hashes": len(self._hash_to_block),
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+        }

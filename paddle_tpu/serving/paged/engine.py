@@ -1,0 +1,355 @@
+"""PagedServingEngine: block-table KV cache over the ServingEngine
+wave machinery.
+
+The dense engine pays `num_slots * max_len` HBM per layer whatever the
+traffic actually holds; BENCH_serving.json put real occupancy at
+0.26–0.45 — most of that stream is padding. Here the cache is a fixed
+POOL of `[num_blocks, kv_heads, block_size, head_dim]` KV blocks per
+layer and slots reference block TABLES (host-managed int32 id rows,
+`serving.paged.BlockPool`): HBM scales with the blocks you configure,
+utilisation scales with actual tokens, and identical prompt prefixes
+dedupe onto shared blocks.
+
+Still exactly TWO compiled programs, fully static shapes (the
+compile-once discipline — table entries are VALUES, not shapes):
+
+  * decode wave — the dense wave plus one traced `[S, nblk]` block
+    table: each lane's K/V scatters through its table row and attention
+    reads the gathered per-row view (`nn/transformer.py
+    gather_block_kv` / `scatter_block_kv_at`).
+  * prefill chunk — ONE fixed-size chunk of one slot's prompt at a
+    traced absolute offset. Long prompts run chunk-by-chunk BETWEEN
+    decode waves (the scheduler advances one chunk per round), so
+    admission never stalls decoding; prompts shorter than a chunk
+    complete in one step, and chunks fully covered by prefix-cache hits
+    are skipped outright.
+
+Block bookkeeping is host-authoritative like the rest of the slot
+state: the table upload is `S * nblk` int32 per wave. Allocation happens
+between waves; a wave whose lane cannot get a block (pool exhausted) is
+excluded from that wave and reported in `last_starved_slots` — the
+scheduler preempts it by recompute (requeue with prompt + generated
+tokens; the freed blocks' prefix hashes make the re-prefill mostly
+cache hits).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils import chaos, telemetry
+from ..engine import (ServingEngine, _raw, _select_first_token,
+                      _select_wave_tokens)
+from .block_pool import BlockPool, BlockPoolExhausted
+
+
+class PagedServingEngine(ServingEngine):
+    """Block-table batched decode executor.
+
+    model: a causal LM exposing init_paged_cache / decode_step(...,
+        block_tables=) / prefill_chunk (GPTForPretraining,
+        LlamaForCausalLM).
+    max_len: per-request horizon; must be a multiple of block_size
+        (table width = max_len // block_size).
+    num_blocks: pool size INCLUDING the scratch block (block 0).
+        Default num_slots * max_len // block_size + 1 — dense-equivalent
+        capacity; size it smaller to oversubscribe (utilisation follows
+        actual tokens, starved lanes preempt gracefully).
+    prefill_chunk_len: prompt chunk size (default min(64, max_len)).
+    prefix_sharing: hash full prompt blocks and dedupe identical
+        prefixes (copy-on-write guarded; see BlockPool).
+    """
+
+    def __init__(self, model, num_slots=4, max_len=256, block_size=16,
+                 num_blocks=None, prefill_chunk_len=None, cache_dtype=None,
+                 jit_compile=True, seed=0, prefix_sharing=True):
+        if max_len % block_size:
+            raise ValueError(f"max_len {max_len} must be a multiple of "
+                             f"block_size {block_size}")
+        self.block_size = int(block_size)
+        self.blocks_per_slot = int(max_len) // self.block_size
+        if num_blocks is None:
+            num_blocks = int(num_slots) * self.blocks_per_slot + 1
+        self.prefill_chunk_len = int(prefill_chunk_len
+                                     or min(64, int(max_len)))
+        if self.prefill_chunk_len > max_len:
+            raise ValueError(
+                f"prefill_chunk_len {self.prefill_chunk_len} > max_len "
+                f"{max_len}")
+        self.prefix_sharing = bool(prefix_sharing)
+        self.block_pool = BlockPool(num_blocks, self.block_size)
+        self._copy_fn = None
+        super().__init__(model, num_slots=num_slots, max_len=max_len,
+                         prefill_len=self.prefill_chunk_len,
+                         cache_dtype=cache_dtype, jit_compile=jit_compile,
+                         seed=seed)
+        self._slot_blocks = [[] for _ in range(self.num_slots)]
+        self._tables = np.zeros((self.num_slots, self.blocks_per_slot),
+                                np.int32)
+
+    def _make_caches(self):
+        return self.model.init_paged_cache(self.block_pool.num_blocks,
+                                           self.block_size, self.max_len,
+                                           dtype=self.cache_dtype)
+
+    # ---------------------------------------------------------- programs
+    def _build_programs(self):
+        model = self.model
+
+        def decode_wave(p, b, caches, tables, tok, pos, active, sample,
+                        temps, poison, key):
+            out, _ = model.functional_call(p, b, tok[:, None], caches,
+                                           pos, method="decode_step",
+                                           block_tables=tables)
+            logits, new_caches = out
+            lo = _raw(logits)[:, 0, :].astype(jnp.float32)
+            nxt, new_pos, finite = _select_wave_tokens(
+                lo, tok, pos, active, sample, temps, poison, key)
+            return nxt, new_pos, finite, new_caches
+
+        def prefill_chunk(p, b, caches, table, chunk, chunk_start,
+                          valid_len, frontier, sample, temp, key):
+            out, _ = model.functional_call(
+                p, b, chunk[None, :], caches, method="prefill_chunk",
+                block_tables=table[None, :], chunk_start=chunk_start,
+                valid_len=valid_len, frontier=frontier)
+            logits, new_caches = out
+            # frontier logits [1, 1, V]: only the FINAL chunk's value is
+            # consumed on host; earlier chunks compute a [V] row that is
+            # simply ignored (static shapes beat a conditional head)
+            lo = _raw(logits)[0, 0].astype(jnp.float32)
+            first = _select_first_token(lo, sample, temp, key)
+            return first, new_caches
+
+        self._decode_wave_fn = decode_wave
+        self._prefill_fn = prefill_chunk
+        self._program_donate_argnums = (2,)
+
+        if self._jit:
+            # the block pools are donated exactly like the dense cache:
+            # the engine always replaces its cache reference with the
+            # program output, so XLA updates the pool in place
+            self._decode_wave = telemetry.instrument_jit(
+                jax.jit(decode_wave,
+                        donate_argnums=self._program_donate_argnums),
+                "paged_decode_wave")
+            self._prefill = telemetry.instrument_jit(
+                jax.jit(prefill_chunk,
+                        donate_argnums=self._program_donate_argnums),
+                "paged_prefill_chunk")
+        else:
+            self._decode_wave = decode_wave
+            self._prefill = prefill_chunk
+
+    # --------------------------------------------------------- admission
+    def validate_prompt(self, prompt):
+        """Chunked prefill removes the dense bucket limit: any prompt
+        that fits the horizon (with one position to decode into) and the
+        pool's total capacity is admissible."""
+        n = len(prompt)
+        if n + 1 > self.max_len:
+            return (f"prompt length {n} leaves no room to decode under "
+                    f"max_len {self.max_len}")
+        need = (n + 1 + self.block_size - 1) // self.block_size
+        if need > self.block_pool.usable:
+            return (f"prompt needs {need} KV blocks, pool has only "
+                    f"{self.block_pool.usable} usable")
+        return None
+
+    def begin_prefill(self, slot, prompt, do_sample=False,
+                      temperature=1.0):
+        """Admit a prompt: match shared prefix blocks, allocate the rest
+        (BlockPoolExhausted = capacity, handled by the scheduler as
+        queueing pressure, never a request fault), and stage the chunk
+        schedule. Chunks fully covered by prefix-cache hits are
+        skipped — a fully-cached prompt still runs its LAST chunk, which
+        produces the frontier logits (the K/V are cached; the first
+        TOKEN never is)."""
+        why = self.validate_prompt(prompt)
+        if why:
+            raise ValueError(why)
+        if self.slot_active[slot] or slot in self._pending_prefill:
+            raise RuntimeError(f"slot {slot} is busy")
+        prompt = [int(t) for t in prompt]
+        n, bs = len(prompt), self.block_size
+        need = (n + 1 + bs - 1) // bs
+        shared, hashes = ([], [])
+        if self.prefix_sharing:
+            shared, hashes = self.block_pool.match_prefix(prompt)
+        try:
+            fresh = self.block_pool.alloc(need - len(shared))
+        except BaseException:
+            # exhaustion AND crash paths (e.g. an injected allocator
+            # raise): the matched prefix references must go back, or a
+            # failed admission permanently shrinks pool capacity
+            self.block_pool.release(shared)
+            raise
+        if self.prefix_sharing:
+            # counted only now, on successful admission — exhaustion
+            # retries at the queue head must not inflate the rate
+            self.block_pool.count_prefix(len(shared),
+                                         n // bs - len(shared))
+        blocks = shared + fresh
+        self._slot_blocks[slot] = blocks
+        self._tables[slot, :] = 0
+        self._tables[slot, :len(blocks)] = blocks
+        chunk = self.prefill_chunk_len
+        start = (len(shared) * bs // chunk) * chunk
+        start = min(start, ((n - 1) // chunk) * chunk)
+        self._pending_prefill[slot] = {
+            "prompt": prompt, "n": n, "next": start,
+            "sample": bool(do_sample), "temp": float(temperature),
+            "hashes": (self.block_pool.prompt_hashes(prompt)
+                       if self.prefix_sharing else []),
+            "next_hash": len(shared),
+        }
+
+    def prefill_step(self, slot):
+        """Run ONE chunk of the slot's staged prompt. Returns the
+        request's first generated token when the final chunk ran, None
+        while chunks remain (decode waves continue in between)."""
+        st = self._pending_prefill[slot]
+        if chaos.enabled():
+            # host-side, before the donated pool reaches the program — a
+            # fired fault leaves device state untouched; the scheduler
+            # fails just this request and frees its blocks
+            chaos.fire(chaos.PREFILL, slot=slot, chunk_start=st["next"])
+        c0, C, n, bs = st["next"], self.prefill_chunk_len, st["n"], \
+            self.block_size
+        valid = min(C, n - c0)
+        chunk = np.zeros((C,), np.int32)
+        chunk[:valid] = st["prompt"][c0:c0 + valid]
+        last = c0 + C >= n
+        frontier = (n - 1) - c0 if last else 0
+        self._key, sub = jax.random.split(self._key)
+        first, self._caches = self._prefill(
+            self._params, self._buffers, self._caches,
+            jnp.asarray(self._tables[slot]), jnp.asarray(chunk),
+            jnp.int32(c0), jnp.int32(valid), jnp.int32(frontier),
+            jnp.asarray(st["sample"]), jnp.float32(st["temp"]), sub)
+        # full prompt blocks written by this chunk enter the prefix
+        # cache — only now, so a concurrent admission can never share a
+        # block whose content is not on the device yet
+        if self.prefix_sharing:
+            end = c0 + valid
+            while (st["next_hash"] < len(st["hashes"])
+                   and (st["next_hash"] + 1) * bs <= end):
+                i = st["next_hash"]
+                self.block_pool.register_hash(self._slot_blocks[slot][i],
+                                              st["hashes"][i])
+                st["next_hash"] += 1
+        st["next"] = c0 + C
+        if not last:
+            return None
+        del self._pending_prefill[slot]
+        first = int(np.asarray(first))
+        self.slot_active[slot] = True
+        self.slot_pos[slot] = n
+        self.slot_tok[slot] = first
+        self.slot_sample[slot] = st["sample"]
+        self.slot_temp[slot] = st["temp"]
+        return first
+
+    def prefill_slot(self, slot, prompt, do_sample=False, temperature=1.0):
+        """Synchronous admission (runs every chunk back-to-back) — the
+        dense-engine surface, kept for direct engine users; the
+        scheduler uses begin_prefill/prefill_step to fold chunks between
+        waves."""
+        self.begin_prefill(slot, prompt, do_sample=do_sample,
+                           temperature=temperature)
+        while True:
+            first = self.prefill_step(slot)
+            if first is not None:
+                return first
+
+    # ------------------------------------------------------------- waves
+    def _prepare_wave(self, active_now):
+        """Back each active lane's next write position with a block.
+        Allocation failure excludes the lane from this wave (its table
+        row still maps unallocated entries to scratch, so the frozen
+        lane's in-program write is harmless) and reports it for
+        preemption. A shared write target (safety net — full-block
+        sharing keeps the frontier private by construction) is
+        copy-on-write'd first."""
+        starved = []
+        for s, live in enumerate(active_now):
+            if not live:
+                continue
+            bi = self.slot_pos[s] // self.block_size
+            blocks = self._slot_blocks[s]
+            try:
+                if bi >= len(blocks):
+                    blk, = self.block_pool.alloc(1)
+                    blocks.append(blk)
+                    self._tables[s, bi] = blk
+                elif self.block_pool.refcount(blocks[bi]) > 1:
+                    self._ensure_private(s, bi)
+            except BlockPoolExhausted:
+                starved.append(s)
+                active_now[s] = False
+        self.last_starved_slots = starved
+        return active_now
+
+    def _wave_args(self, active_now, poison, key):
+        # the program scatters EVERY lane's K/V unconditionally (fixed
+        # shapes); a lane not in THIS wave (free, mid-prefill, starved)
+        # would write its stale token through its table row into a live
+        # block — a mid-chunked-prefill slot's table is already
+        # populated, possibly with SHARED blocks. Upload scratch rows
+        # for those lanes so the write lands in block 0 by design.
+        tables = np.where(np.asarray(active_now, bool)[:, None],
+                          self._tables, np.int32(BlockPool.SCRATCH))
+        return (self._params, self._buffers, self._caches,
+                jnp.asarray(tables),
+                jnp.asarray(self.slot_tok, jnp.int32),
+                jnp.asarray(self.slot_pos, jnp.int32),
+                jnp.asarray(active_now, bool),
+                jnp.asarray(self.slot_sample, bool),
+                jnp.asarray(self.slot_temp, jnp.float32),
+                jnp.asarray(poison), key)
+
+    # ----------------------------------------------------- copy-on-write
+    def _ensure_private(self, slot, bi):
+        """Give the slot a private copy of table entry `bi` (the pool
+        moves the reference; the device content is copied by a tiny
+        jitted program, compiled lazily — the normal flow never diverges
+        into a shared block, so this almost never runs)."""
+        blocks = self._slot_blocks[slot]
+        blk = blocks[bi]
+        new = self.block_pool.cow(blk)
+        if new == blk:
+            return
+        self._caches = self._copy_block(self._caches, blk, new)
+        blocks[bi] = new
+        self._tables[slot, bi] = new
+
+    def _copy_block(self, caches, src, dst):
+        if self._copy_fn is None:
+            def copy_fn(caches, src, dst):
+                return [(ck.at[dst].set(ck[src]), cv.at[dst].set(cv[src]))
+                        for ck, cv in caches]
+            self._copy_fn = (telemetry.instrument_jit(
+                jax.jit(copy_fn, donate_argnums=(0,)), "paged_cow_copy")
+                if self._jit else copy_fn)
+        return self._copy_fn(caches, jnp.int32(src), jnp.int32(dst))
+
+    # ------------------------------------------------------------- slots
+    def retire_slot(self, slot):
+        """Free the slot AND its blocks. Freed blocks keep their prefix
+        hashes (lazy eviction), so a follow-up request with the same
+        prompt — or this request re-admitted after preemption — re-hits
+        the cache instead of recomputing."""
+        super().retire_slot(slot)
+        blocks = self._slot_blocks[slot]
+        if blocks:
+            self.block_pool.release(blocks)
+        self._slot_blocks[slot] = []
+        self._tables[slot, :] = 0
+
+    def _health(self):
+        h = super()._health()
+        h.update(block_size=self.block_size,
+                 blocks_used=self.block_pool.used,
+                 blocks_total=self.block_pool.usable,
+                 prefix_cache_hits=self.block_pool.prefix_hits,
+                 prefix_cache_misses=self.block_pool.prefix_misses)
+        return h

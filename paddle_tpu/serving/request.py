@@ -63,6 +63,15 @@ class Request:
 
         self.state = RequestState.QUEUED
         self.slot = None                 # engine slot while PREFILL/DECODE
+        # paged engine: times this request was preempted by recompute
+        # (KV blocks reclaimed under pool pressure, request requeued
+        # with prompt + generated tokens; bounded by the scheduler's
+        # max_preemptions)
+        self.preemptions = 0
+        # scheduler-private: True while this request waits at the queue
+        # head for KV blocks to free — the cache_exhausted/requeued
+        # fault is recorded once per wait EPISODE, not once per round
+        self._cache_waiting = False
         self.output_tokens = []
         # eos | max_tokens | length | timeout | error | rejected
         self.finish_reason = None
@@ -90,6 +99,10 @@ class Request:
         token_id = int(token_id)
         if self.first_token_time is None:
             self.first_token_time = time.monotonic()
+        if self.state != RequestState.DECODE:
+            # also re-entered after preemption-by-recompute: the resumed
+            # request passed through PREFILL again with first_token_time
+            # already stamped, and must still come back to DECODE
             self.state = RequestState.DECODE
             telemetry.trace_request(self, RequestState.DECODE)
         self.output_tokens.append(token_id)

@@ -2,11 +2,21 @@
 ServingEngine.
 
 FCFS admission: whenever a slot is free and the queue is non-empty, the
-head request is prefilled into the slot MID-STREAM — the other slots'
-in-flight decodes are untouched (next wave simply sees one more active
-lane; same compiled program). Retirement (EOS / max_tokens / cache
+head request is assigned to it MID-STREAM (engine.begin_prefill) and its
+prefill advances one engine step per scheduling round
+(engine.prefill_step) — the dense engine completes in one round, the
+paged engine runs one CHUNK per round, so a long prompt's admission is
+folded between decode waves and never stalls the other lanes (same
+compiled programs throughout). Retirement (EOS / max_tokens / cache
 horizon / timeout) frees slots between waves and the freed slot is
 refilled in the same step() — a slot never idles while work is queued.
+
+Paged-engine capacity (serving/paged) is handled here too: an exhausted
+block pool at admission queues the head request behind the blocks it is
+waiting for (or sheds it when nothing in flight could free them), and a
+lane starved mid-decode is PREEMPTED BY RECOMPUTE — blocks freed,
+request requeued with prompt + generated tokens (prefix-cache hits make
+the re-prefill cheap), bounded by `max_preemptions`.
 
 Resilience (docs/serving.md "Resilience"; every path below is proven
 by injection in scripts/chaos_serving.py):
@@ -40,17 +50,23 @@ import time
 from ..utils import flight_recorder, profiler
 from ..utils.profiler import RecordEvent
 from .metrics import ServingMetrics
+from .paged.block_pool import BlockPoolExhausted
 from .request import Request, RequestState
 
 
 class Scheduler:
     def __init__(self, engine, max_queue=None, completed_log=1024,
                  wave_retries=3, retry_backoff_s=0.05,
-                 prefill_fail_limit=None):
+                 prefill_fail_limit=None, max_preemptions=3):
         self.engine = engine
         self.max_queue = max_queue
         self.wave_retries = max(0, int(wave_retries))
         self.retry_backoff_s = float(retry_backoff_s)
+        # paged engines: a request may be preempted by recompute (its KV
+        # blocks reclaimed under pool pressure, the request requeued
+        # with prompt + generated tokens) at most this many times before
+        # it resolves "error" — preemption must converge, not livelock
+        self.max_preemptions = max(0, int(max_preemptions))
         # consecutive DISTINCT-request prefill failures tolerated before
         # concluding the fault is the engine's, not the requests' (e.g. a
         # raise from inside the compiled prefill after the donated cache
@@ -69,6 +85,13 @@ class Scheduler:
         self._degraded = False
         self.last_error = None
         self.metrics = ServingMetrics(engine.num_slots)
+        pool = getattr(engine, "block_pool", None)
+        if pool is not None:
+            # seed the prefix-delta baseline with the pool's totals
+            # BEFORE any round of ours — the snapshot then reports
+            # exactly this scheduler's lookups, first round included
+            self.metrics.on_prefix_totals(pool.prefix_hits,
+                                          pool.prefix_misses)
         # bounded: callers hold their own Request handles (submit returns
         # them); this ring is a debugging/inspection tail, and unbounded
         # growth would leak every prompt ever served on a long-running
@@ -119,10 +142,31 @@ class Scheduler:
         self.metrics.on_queue_depth(depth)
         return req
 
+    def _requeue_front(self, req):
+        """Put a request back at the queue HEAD (capacity pressure:
+        pool-exhausted admission, preemption) — it keeps its FCFS
+        standing."""
+        with self._lock:
+            self._queue.appendleft(req)
+            depth = len(self._queue)
+        self.metrics.on_queue_depth(depth)
+
+    def _continuation(self, req):
+        """The token prefix a (re-)admission must prefill: the prompt
+        plus anything already generated — a preempted request resumes by
+        recompute, and its next prefill's frontier logits produce the
+        NEXT token, not a repeat."""
+        return req.prompt + req.output_tokens
+
     def _admit(self):
-        """Prefill queued requests into free slots. A request whose
-        timeout already expired in the queue is retired without spending
-        a prefill on it."""
+        """Assign queued requests to free slots and stage their prefill
+        (engine.begin_prefill — block allocation on a paged engine); the
+        work itself runs in _advance_prefills, so a long chunked prefill
+        folds between decode waves. A request whose timeout already
+        expired in the queue is retired without spending a prefill on
+        it; an exhausted block pool is CAPACITY, not a request fault —
+        the head request waits for blocks to free (or is rejected when
+        nothing in flight could ever free them)."""
         while True:
             free = self.engine.free_slots()
             if not free:
@@ -135,37 +179,97 @@ class Scheduler:
                 self._complete(req)
                 continue
             slot = free[0]
-            req._start_prefill(slot)
-            self._slot_req[slot] = req
             try:
-                with RecordEvent("serving/prefill"):
-                    first = self.engine.prefill_slot(
-                        slot, req.prompt, do_sample=req.do_sample,
-                        temperature=req.temperature)
+                self.engine.begin_prefill(
+                    slot, self._continuation(req),
+                    do_sample=req.do_sample,
+                    temperature=req.temperature)
+            except BlockPoolExhausted as e:
+                if self.engine.active_slots() or \
+                        self.engine.prefilling_slots():
+                    # in-flight work will free blocks: wait at the head.
+                    # One fault per wait EPISODE — a long decode can
+                    # hold the head here for hundreds of rounds, and
+                    # per-round records would flood the counters/journal
+                    if not req._cache_waiting:
+                        req._cache_waiting = True
+                        self._fault("cache_exhausted", action="requeued",
+                                    request=req, error=e)
+                    self._requeue_front(req)
+                    return
+                # nothing in flight to free blocks — shed cleanly
+                self.metrics.on_reject()
+                self._fault("cache_exhausted", action="rejected",
+                            request=req, error=e)
+                req._reject(f"KV cache exhausted ({e})",
+                            raise_error=False)
+                self.completed.append(req)
+                continue
             except Exception as e:   # noqa: BLE001 — fault barrier:
-                # isolate the failing admission to ITS request; the
-                # engine mutates nothing before dispatch, so the slot
-                # is still free and every other lane is untouched
-                self._slot_req[slot] = None
+                # isolate the failing admission to ITS request; staging
+                # mutates no device state, so the slot stays free and
+                # every other lane is untouched
                 self.last_error = e
-                self._prefill_fail_streak += 1
-                escalate = self._prefill_fail_streak >= \
-                    self.prefill_fail_limit
-                self._fault("prefill_error",
-                            action=("degrade" if escalate
-                                    else "request_failed"),
-                            request=req, slot=slot, error=e)
-                req._fail(e)
-                self._complete(req)
-                if escalate:
-                    self._degrade()
+                if self._prefill_fault(req, slot):
                     return
                 continue
+            req._cache_waiting = False         # wait episode (if any) over
+            req._start_prefill(slot)
+            self._slot_req[slot] = req
+
+    def _prefill_fault(self, req, slot):
+        """Shared admission/chunk fault barrier: fail ONLY this request,
+        free the slot, and escalate to degradation after
+        `prefill_fail_limit` consecutive distinct-request failures.
+        Returns True when the engine degraded (stop the round)."""
+        self.engine.retire_slot(slot)      # frees pending state + blocks
+        self._slot_req[slot] = None
+        self._prefill_fail_streak += 1
+        escalate = self._prefill_fail_streak >= self.prefill_fail_limit
+        self._fault("prefill_error",
+                    action=("degrade" if escalate else "request_failed"),
+                    request=req, slot=slot, error=self.last_error)
+        req._fail(self.last_error)
+        self._complete(req)
+        if escalate:
+            self._degrade()
+            return True
+        return False
+
+    def _advance_prefills(self):
+        """Run one prefill step per mid-admission slot (ONE chunk on a
+        paged engine; the whole bucket on the dense engine). Slots whose
+        prefill completed get their first token and become active for
+        this round's decode wave. Returns True when a fault escalated to
+        degradation."""
+        for slot in self.engine.prefilling_slots():
+            req = self._slot_req[slot]
+            if req._timed_out():
+                # chunked prefill can span many rounds — don't keep
+                # burning chunk programs (and finally emit a token) on a
+                # request that already expired; same semantics as the
+                # queue-pop timeout check
+                self.engine.retire_slot(slot)
+                self._slot_req[slot] = None
+                req._finish("timeout")
+                self._complete(req)
+                continue
+            try:
+                with RecordEvent("serving/prefill"):
+                    first = self.engine.prefill_step(slot)
+            except Exception as e:   # noqa: BLE001 — fault barrier
+                self.last_error = e
+                if self._prefill_fault(req, slot):
+                    return True
+                continue
             self._prefill_fail_streak = 0
+            if first is None:
+                continue             # mid-prefill: decode waves go on
             self.metrics.on_prefill()
             req._emit(first)
             self.metrics.on_token(time.monotonic())
             self._maybe_retire(slot, first)
+        return False
 
     # ---------------------------------------------------------- wave loop
     def _maybe_retire(self, slot, last_token):
@@ -276,16 +380,49 @@ class Scheduler:
         with self._wave_lock:
             return self._step_locked()
 
+    def _preempt_starved(self):
+        """Pool-exhausted lanes (the wave excluded them): preempt by
+        recompute — free the slot's blocks, requeue the request with
+        prompt + generated tokens (the freed blocks' prefix hashes make
+        the re-prefill mostly cache hits). A request past its preemption
+        budget, or one whose continuation could never fit the pool,
+        resolves "error" instead of livelocking."""
+        for slot in self.engine.last_starved_slots:
+            req = self._slot_req[slot]
+            self.engine.retire_slot(slot)      # frees the blocks
+            self._slot_req[slot] = None
+            req.preemptions += 1
+            cont = self._continuation(req)
+            why = self.engine.validate_prompt(cont)
+            if req.preemptions > self.max_preemptions or why is not None:
+                self._fault("cache_exhausted", action="request_failed",
+                            request=req, slot=slot)
+                req._fail(why or "KV cache exhausted: preemption budget "
+                                 f"spent ({req.preemptions}x)")
+                self._complete(req)
+                continue
+            self._fault("cache_exhausted", action="preempted",
+                        request=req, slot=slot)
+            self._requeue_front(req)
+
     def _step_locked(self):
         if self._degraded:
             return 0
         self._admit()
+        # captured BEFORE the advance: a prefill that admits, emits its
+        # first token, and retires within one round still counts as a
+        # working round for the pool sample below
+        prefilled = bool(self.engine.prefilling_slots())
+        if self._advance_prefills():
+            return 0                         # degraded mid-advance
         active = self.engine.active_slots()
         if active:
             toks = self._run_wave_with_retry()
             if toks is None:                 # degraded: everything is
                 return 0                     # resolved, nothing pending
-            self.metrics.on_wave(len(active))
+            waved = len(active) - len(self.engine.last_starved_slots)
+            if waved > 0:     # all-starved rounds dispatch no program —
+                self.metrics.on_wave(waved)  # don't count phantom waves
             # fused-sentinel fallout: retire ONLY the poisoned lanes —
             # their requests resolve with "error", healthy neighbours
             # stream on token-identically (proven in chaos_serving)
@@ -297,11 +434,20 @@ class Scheduler:
                             request=req, slot=slot)
                 req._fail("non-finite logits in decode wave")
                 self._complete(req)
+            self._preempt_starved()
             now = time.monotonic()
             for slot, tok in toks.items():
                 self._slot_req[slot]._emit(tok)
                 self.metrics.on_token(now)
                 self._maybe_retire(slot, tok)
+        pool = getattr(self.engine, "block_pool", None)
+        if pool is not None and (active or prefilled):
+            # pool sample per WORKING round (idle spins don't dilute the
+            # integral — same cadence discipline as on_wave's slot
+            # occupancy): utilization + prefix tallies ride the snapshot
+            self.metrics.on_blocks(pool.used, pool.usable)
+            self.metrics.on_prefix_totals(pool.prefix_hits,
+                                          pool.prefix_misses)
         # chrome-trace counter track: occupancy/queue depth over time,
         # on the same timeline as the decode-wave slices
         if profiler.trace_enabled():

@@ -9,6 +9,13 @@ REAL hot path:
     programs, lowered from the engine's own raw closures (the engine
     stashes them precisely so this audit and the serving path cannot
     drift apart);
+  * `paged_decode_wave` / `paged_prefill_chunk` — the
+    PagedServingEngine's two programs (block-table KV cache, chunked
+    prefill; serving/paged), same stashed-closure discipline — jxaudit
+    verifies the block POOL leaves stay donation-aliased at engine
+    shapes;
+  * `paged_decode_attention` — the block-table decode core
+    (scatter/gather through traced tables + the GQA cached core);
   * `train_step` — `jit.TrainStep` (forward + backward + AdamW, donated
     state) on the canonical 2-layer GPT config — the same topology
     bench.py's CPU smoke compiles, so the persistent compile cache is
@@ -28,18 +35,31 @@ only shapes/dtypes do.
 # serving canonical shape (mirrors tests/test_serving.py scale)
 SERVING = dict(vocab=128, hidden=64, layers=2, heads=4, max_len=64,
                prefill_len=16, num_slots=4)
+# paged-serving canonical shape (mirrors tests/test_serving_paged.py):
+# same model topology, block-table cache
+PAGED = dict(vocab=128, hidden=64, layers=2, heads=4, max_len=64,
+             block_size=8, num_blocks=33, chunk_len=16, num_slots=4)
 # train canonical shape == bench.py CPU-smoke config
 TRAIN = dict(vocab=512, hidden=128, layers=2, heads=4, seq=128, batch=2)
 
 TRACKED_PROGRAMS = ("serving_decode_wave", "serving_prefill",
+                    "paged_decode_wave", "paged_prefill_chunk",
                     "train_step", "cached_decode_attention",
-                    "prefill_flash_attention")
+                    "paged_decode_attention", "prefill_flash_attention")
 
 
-def engine_program_specs(engine, prefix="serving"):
-    """Audit specs for a LIVE ServingEngine's two programs, with the
-    engine's actual shapes — used on the canonical engine below and by
-    bench_serving.py on the engine it just measured."""
+def engine_program_specs(engine, prefix=None):
+    """Audit specs for a LIVE engine's two programs, with the engine's
+    actual shapes — used on the canonical engines below and by
+    bench_serving.py on the engine it just measured. Dispatches on the
+    engine flavour: a paged engine (block_pool) audits its
+    decode-wave-with-tables and prefill-chunk programs."""
+    if hasattr(engine, "block_pool"):
+        return _paged_engine_specs(engine, prefix or "paged")
+    return _dense_engine_specs(engine, prefix or "serving")
+
+
+def _dense_engine_specs(engine, prefix):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -71,6 +91,43 @@ def engine_program_specs(engine, prefix="serving"):
     ]
 
 
+def _paged_engine_specs(engine, prefix):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    S, nblk = engine.num_slots, engine.blocks_per_slot
+    C = engine.prefill_chunk_len
+    key = jax.random.PRNGKey(0)
+    jit_kwargs = {"donate_argnums": engine._program_donate_argnums}
+    decode_args = (
+        engine._params, engine._buffers, engine._caches,
+        jnp.zeros((S, nblk), jnp.int32),     # block tables (traced!)
+        jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+        jnp.ones((S,), bool), jnp.zeros((S,), bool),
+        jnp.ones((S,), jnp.float32),
+        jnp.zeros((S,), bool),               # poison
+        key)
+    prefill_args = (
+        engine._params, engine._buffers, engine._caches,
+        jnp.zeros((nblk,), jnp.int32),       # one slot's table row
+        jnp.asarray(np.zeros((C,), np.int32)),
+        jnp.int32(0), jnp.int32(1), jnp.int32(0),
+        jnp.asarray(False), jnp.float32(1.0), key)
+    return [
+        {"name": f"{prefix}_decode_wave", "fn": engine._decode_wave_fn,
+         "args": decode_args, "jit_kwargs": jit_kwargs,
+         "description": f"one batched decode token for every slot "
+                        f"through block tables (slots={S}, "
+                        f"blocks={engine.block_pool.num_blocks}x"
+                        f"{engine.block_size})"},
+        {"name": f"{prefix}_prefill_chunk", "fn": engine._prefill_fn,
+         "args": prefill_args, "jit_kwargs": jit_kwargs,
+         "description": f"one prompt chunk admission through a block "
+                        f"table (chunk={C})"},
+    ]
+
+
 def _serving_specs():
     import paddle_tpu as pt
     from paddle_tpu.nlp import GPTConfig, GPTForPretraining
@@ -87,6 +144,27 @@ def _serving_specs():
                            num_slots=SERVING["num_slots"],
                            max_len=SERVING["max_len"],
                            prefill_len=SERVING["prefill_len"])
+    return engine_program_specs(engine)
+
+
+def _paged_serving_specs():
+    import paddle_tpu as pt
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import PagedServingEngine
+
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=PAGED["vocab"],
+                    hidden_size=PAGED["hidden"],
+                    num_layers=PAGED["layers"],
+                    num_heads=PAGED["heads"],
+                    max_seq_len=PAGED["max_len"],
+                    dropout=0.0, attn_dropout=0.0)
+    engine = PagedServingEngine(GPTForPretraining(cfg),
+                                num_slots=PAGED["num_slots"],
+                                max_len=PAGED["max_len"],
+                                block_size=PAGED["block_size"],
+                                num_blocks=PAGED["num_blocks"],
+                                prefill_chunk_len=PAGED["chunk_len"])
     return engine_program_specs(engine)
 
 
@@ -133,10 +211,13 @@ def _train_step_spec():
 
 def _attention_specs():
     import jax.numpy as jnp
-    from paddle_tpu.nn.transformer import cached_decode_attention
+    from paddle_tpu.nn.transformer import (cached_decode_attention,
+                                           gather_block_kv,
+                                           scatter_block_kv_at)
     from paddle_tpu.ops.pallas.flash_attention import _flash_array
 
     b, h, hkv, L, d = 4, 4, 2, 64, 16
+    bs, nblk, num_blocks = 8, 8, 17        # nblk * bs == L
 
     def decode_attn(q, ck, cv, pos):
         return cached_decode_attention(q, ck, cv, pos,
@@ -146,6 +227,24 @@ def _attention_specs():
                    jnp.zeros((b, hkv, L, d), jnp.float32),
                    jnp.zeros((b, hkv, L, d), jnp.float32),
                    jnp.zeros((b,), jnp.int32))
+
+    def paged_decode_attn(q, kv_t, pk, pv, tables, pos):
+        # the serving paged decode core: scatter the step's K/V through
+        # the tables, attend over the gathered per-row views; the
+        # updated pools ride out (donated in-place, like the engine's)
+        pk = scatter_block_kv_at(pk, kv_t, tables, pos)
+        pv = scatter_block_kv_at(pv, kv_t, tables, pos)
+        out = cached_decode_attention(
+            q, gather_block_kv(pk, tables), gather_block_kv(pv, tables),
+            pos, scale=1.0 / (d ** 0.5))
+        return out, pk, pv
+
+    paged_args = (jnp.zeros((b, h, 1, d), jnp.float32),
+                  jnp.zeros((b, hkv, 1, d), jnp.float32),
+                  jnp.zeros((num_blocks, hkv, bs, d), jnp.float32),
+                  jnp.zeros((num_blocks, hkv, bs, d), jnp.float32),
+                  jnp.zeros((b, nblk), jnp.int32),
+                  jnp.zeros((b,), jnp.int32))
 
     def prefill_attn(q, k, v):
         return _flash_array(q, k, v, causal=True)
@@ -158,6 +257,12 @@ def _attention_specs():
          "args": decode_args,
          "description": "GQA cached decode attention core, per-slot "
                         "position vector"},
+        {"name": "paged_decode_attention", "fn": paged_decode_attn,
+         "args": paged_args,
+         "jit_kwargs": {"donate_argnums": (2, 3)},
+         "description": "block-table decode attention core: KV "
+                        "scatter/gather through traced tables + the "
+                        "GQA cached core"},
         {"name": "prefill_flash_attention", "fn": prefill_attn,
          "args": prefill_args,
          "description": "causal prompt-phase attention array kernel"},
@@ -175,8 +280,11 @@ def tracked_program_specs(names=None):
     specs = []
     if want & {"serving_decode_wave", "serving_prefill"}:
         specs += [s for s in _serving_specs() if s["name"] in want]
+    if want & {"paged_decode_wave", "paged_prefill_chunk"}:
+        specs += [s for s in _paged_serving_specs() if s["name"] in want]
     if "train_step" in want:
         specs.append(_train_step_spec())
-    if want & {"cached_decode_attention", "prefill_flash_attention"}:
+    if want & {"cached_decode_attention", "paged_decode_attention",
+               "prefill_flash_attention"}:
         specs += [s for s in _attention_specs() if s["name"] in want]
     return specs

@@ -62,9 +62,15 @@ CALLBACK = "serving.request.callback"
 #: raise mid-write inside the atomic checkpoint writer (partial temp
 #: file on disk, destination untouched — simulates a crash)
 CHECKPOINT_WRITE = "serialization.save"
+#: payload (truthy): the paged KV BlockPool reports exhaustion for this
+#: alloc() call even though free blocks remain — exercises the
+#: shed/queue/preempt paths without needing a pool actually sized to
+#: starve (a raise-type fault here instead simulates the allocator
+#: CRASHING, which must surface as a request-isolated error)
+CACHE_ALLOC = "serving.cache_alloc"
 
 POINTS = (DECODE_WAVE, DECODE_WAVE_NAN, PREFILL, CALLBACK,
-          CHECKPOINT_WRITE)
+          CHECKPOINT_WRITE, CACHE_ALLOC)
 
 ACTIONS = ("raise", "delay", "payload")
 

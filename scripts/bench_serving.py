@@ -31,7 +31,8 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import paddle_tpu as pt
-from paddle_tpu.serving import ServingEngine, Scheduler
+from paddle_tpu.serving import (PagedServingEngine, Scheduler,
+                                ServingEngine)
 from paddle_tpu.utils import profiler, telemetry
 
 t0 = time.time()
@@ -63,16 +64,21 @@ def build_model(family, hidden, layers, heads, vocab, max_seq_len, bf16):
 
 
 def run_load(sched, load_rps, n_requests, vocab, prompt_range,
-             output_range, seed):
+             output_range, seed, shared_prefix=()):
     """Submit n_requests at Poisson rate load_rps from a producer thread
-    while this thread drives the wave loop until everything drains."""
+    while this thread drives the wave loop until everything drains.
+    shared_prefix tokens are prepended to EVERY prompt (the shared
+    system-prompt pattern — on a paged engine with prefix sharing these
+    blocks dedupe and the per-row prefix-hit rate shows it)."""
     rng = np.random.RandomState(seed)
+    shared_prefix = list(shared_prefix)
     reqs, done_submitting = [], threading.Event()
 
     def producer():
         for _ in range(n_requests):
             time.sleep(rng.exponential(1.0 / load_rps))
-            p = rng.randint(0, vocab, (rng.randint(*prompt_range),)).tolist()
+            p = shared_prefix + rng.randint(
+                0, vocab, (rng.randint(*prompt_range),)).tolist()
             try:
                 reqs.append(sched.submit(
                     prompt=p, max_tokens=int(rng.randint(*output_range))))
@@ -116,7 +122,30 @@ def main():
                          "'rejected' counts show shedding onset vs "
                          "offered load)")
     ap.add_argument("--max-len", type=int, default=256)
-    ap.add_argument("--prefill-len", type=int, default=64)
+    ap.add_argument("--prefill-len", type=int, default=64,
+                    help="dense engine: prompt padding bucket; paged "
+                         "engine: the prefill CHUNK length")
+    ap.add_argument("--paged", action="store_true",
+                    help="serve from the block-table paged KV cache "
+                         "(PagedServingEngine): HBM scales with "
+                         "--num-blocks, utilization with actual tokens")
+    ap.add_argument("--block-size", type=int, default=16,
+                    help="paged: tokens per KV block")
+    ap.add_argument("--num-blocks", type=int, default=None,
+                    help="paged: pool size incl. scratch (default "
+                         "slots*max_len/block_size + 1 = dense-"
+                         "equivalent capacity; smaller oversubscribes)")
+    ap.add_argument("--max-preemptions", type=int, default=16,
+                    help="paged: preemption-by-recompute budget per "
+                         "request before it resolves 'error' (an "
+                         "oversubscribed sweep preempts on purpose; "
+                         "each cycle nets tokens, so a higher budget "
+                         "just trades latency, never livelock)")
+    ap.add_argument("--shared-prefix", type=int, default=0,
+                    help="prepend this many fixed tokens to every "
+                         "prompt (shared system prompt) — with --paged "
+                         "the prefix-hit rate per row shows the blocks "
+                         "deduping")
     ap.add_argument("--hidden", type=int, default=256)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--heads", type=int, default=4)
@@ -136,9 +165,19 @@ def main():
     model, _cfg = build_model(args.family, args.hidden, args.layers,
                               args.heads, args.vocab, args.max_len,
                               args.bf16)
-    engine = ServingEngine(model, num_slots=args.slots,
-                           max_len=args.max_len,
-                           prefill_len=args.prefill_len)
+    if args.paged:
+        engine = PagedServingEngine(model, num_slots=args.slots,
+                                    max_len=args.max_len,
+                                    block_size=args.block_size,
+                                    num_blocks=args.num_blocks,
+                                    prefill_chunk_len=args.prefill_len)
+        log(f"paged pool: {engine.block_pool.usable} usable blocks x "
+            f"{engine.block_size} tokens (dense equivalent would be "
+            f"{args.slots * args.max_len // args.block_size})")
+    else:
+        engine = ServingEngine(model, num_slots=args.slots,
+                               max_len=args.max_len,
+                               prefill_len=args.prefill_len)
 
     if args.metrics_port is not None:
         srv = engine.start_metrics_server(port=args.metrics_port)
@@ -154,17 +193,25 @@ def main():
     if args.trace_out:
         profiler.start_profiler()     # record AFTER warmup: steady state
 
+    shared_prefix = []
+    if args.shared_prefix:
+        shared_prefix = np.random.RandomState(7).randint(
+            0, args.vocab, (args.shared_prefix,)).tolist()
+
     rows = []
+    kind = "paged" if args.paged else "dense"
     for i, load in enumerate(float(x) for x in args.loads.split(",")):
         # fresh metrics per load point
-        sched = Scheduler(engine, max_queue=args.max_queue)
+        sched = Scheduler(engine, max_queue=args.max_queue,
+                          max_preemptions=args.max_preemptions)
         out_hi = max(5, min(64, args.max_len - args.prefill_len))
         snap = run_load(sched, load, args.requests, args.vocab,
                         prompt_range=(4, args.prefill_len),
-                        output_range=(4, out_hi), seed=100 + i)
+                        output_range=(4, out_hi), seed=100 + i,
+                        shared_prefix=shared_prefix)
         assert engine.decode_compiles <= 1, "decode step recompiled"
         row = {
-            "metric": f"serving {args.family} tokens/s "
+            "metric": f"serving {args.family} {kind} tokens/s "
                       f"@{load:g}req/s x{args.slots}slots",
             "value": round(snap["tokens_per_s"] or 0.0, 1),
             "unit": "tokens/s",
@@ -187,6 +234,23 @@ def main():
                 "prefill_len": args.prefill_len,
             },
         }
+        if args.paged:
+            # paged cache economics per load point: utilization is HBM
+            # held by ACTUAL tokens (vs the dense layout's slot
+            # occupancy just above), hit rate is the shared-prefix dedup
+            row["detail"].update({
+                "block_size": engine.block_size,
+                "blocks_usable": engine.block_pool.usable,
+                "block_utilization": round(
+                    snap["block_utilization"] or 0.0, 4),
+                "prefix_hits": snap["prefix_hits"],
+                "prefix_misses": snap["prefix_misses"],
+                "prefix_hit_rate": (None if snap["prefix_hit_rate"]
+                                    is None
+                                    else round(snap["prefix_hit_rate"],
+                                               4)),
+                "shared_prefix_len": args.shared_prefix,
+            })
         rows.append(row)
         print(json.dumps(row), flush=True)
 

@@ -70,6 +70,25 @@ def get_engine():
     return _CACHE["engine"]
 
 
+def get_paged_engine():
+    """One PAGED engine per process (cache_exhaustion scenario) — same
+    canonical model scale as tests/test_serving_paged.py, so tier-1
+    shares one persistent-cache compile of the paged programs."""
+    if "paged_engine" not in _CACHE:
+        from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.serving import PagedServingEngine
+        pt.seed(7)
+        cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
+                          num_layers=LAYERS, num_heads=HEADS,
+                          num_kv_heads=KV_HEADS, max_seq_len=MAX_LEN)
+        engine = PagedServingEngine(
+            LlamaForCausalLM(cfg), num_slots=SLOTS, max_len=MAX_LEN,
+            block_size=8, num_blocks=33, prefill_chunk_len=PREFILL_LEN)
+        Scheduler(engine).generate([1, 2, 3], max_tokens=2)   # warm
+        _CACHE["paged_engine"] = engine
+    return _CACHE["paged_engine"]
+
+
 def _prompts(n=SLOTS):
     return [np.random.RandomState(100 + i)
             .randint(0, VOCAB, (4 + i % 3,)).tolist() for i in range(n)]
@@ -321,6 +340,53 @@ def scenario_ckpt_crash(engine, inject):
     return v
 
 
+def scenario_cache_exhaustion(engine, inject):
+    """Paged KV pool exhaustion at admission: the allocator reporting
+    'no free blocks' is CAPACITY — the request waits at the queue head
+    for in-flight work to free blocks (or sheds 'rejected' when nothing
+    could), and every request still completes with outputs untouched.
+    --inject alloc-crash swaps the payload fault for a RAISE out of the
+    allocator (a crashing allocator, not an exhausted one): that request
+    resolves 'error' and the completes-via-requeue invariant must catch
+    it."""
+    v = []
+    paged = get_paged_engine()
+    for s in paged.active_slots():
+        paged.retire_slot(s)
+    paged.set_health_state("ok")
+    prompts = _prompts()
+    key = ("paged_ref", tuple(tuple(p) for p in prompts))
+    if key not in _CACHE:
+        _, ref_reqs = _run_stream(paged, prompts)
+        _CACHE[key] = [r.output_tokens for r in ref_reqs]
+    ref = _CACHE[key]
+    action = "raise" if inject == "alloc-crash" else "payload"
+    # invocation 2: the FIRST admission holds blocks, so the second
+    # admission's exhaustion has in-flight work to wait behind
+    monkey = chaos.ChaosMonkey([chaos.Fault(
+        chaos.CACHE_ALLOC, action=action, payload=True, times=(2,))])
+    with chaos.active(monkey):
+        sched, reqs = _run_stream(paged, prompts)
+    snap = sched.metrics.snapshot()
+    _check(v, monkey.fired, "cache_alloc injection never fired")
+    for i, r in enumerate(reqs):
+        _check(v, r.finish_reason not in ("error", None),
+               f"request {i} resolved {r.finish_reason!r} — exhaustion "
+               "must shed/queue via requeue, never crash a request")
+        if r.finish_reason == "max_tokens":
+            _check(v, r.output_tokens == ref[i],
+                   f"request {i} output diverged after the allocator "
+                   "requeue")
+    _check(v, snap["faults"].get("cache_exhausted", 0) >= 1,
+           "serving_faults_total{kind=cache_exhausted} did not move")
+    _check(v, paged.health_state == "ok",
+           f"paged engine health {paged.health_state!r} after capacity "
+           "pressure, expected 'ok'")
+    _check(v, paged.decode_compiles == 1,
+           "paged decode wave recompiled under allocator faults")
+    return v
+
+
 SCENARIOS = {
     "nan_slot": scenario_nan_slot,
     "wave_error": scenario_wave_error,
@@ -329,12 +395,14 @@ SCENARIOS = {
     "callback_error": scenario_callback_error,
     "overflow_shed": scenario_overflow_shed,
     "drain": scenario_drain,
+    "cache_exhaustion": scenario_cache_exhaustion,
     "ckpt_crash": scenario_ckpt_crash,
 }
 
 # positive controls: each disables one resilience property inside its
 # scenario; the run MUST exit 1 (tests/test_chaos.py asserts it)
-INJECTIONS = {"drop-isolation": "nan_slot", "no-retry": "wave_error"}
+INJECTIONS = {"drop-isolation": "nan_slot", "no-retry": "wave_error",
+              "alloc-crash": "cache_exhaustion"}
 
 
 def run(argv=None):

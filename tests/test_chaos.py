@@ -59,6 +59,22 @@ def test_inject_no_retry_exits_1(chaos_serving, capsys):
     assert "retry budget" in capsys.readouterr().out
 
 
+def test_inject_alloc_crash_exits_1(chaos_serving, capsys):
+    """Positive control for the paged KV pool: a RAISE out of the block
+    allocator (crash, not capacity) fails its request with 'error', and
+    the exhaustion-sheds-or-queues-gracefully invariant must catch it."""
+    assert chaos_serving.run(["--inject", "alloc-crash"]) == 1
+    assert "requeue" in capsys.readouterr().out
+
+
+def test_cache_exhaustion_scenario_clean(chaos_serving, capsys):
+    """The real property: injected pool exhaustion at admission queues
+    the request behind in-flight work — every request completes with
+    outputs untouched, cache_exhausted counted, compile-once intact."""
+    assert chaos_serving.run(["--scenarios", "cache_exhaustion"]) == 0
+    assert "FAIL" not in capsys.readouterr().out
+
+
 def test_journal_shows_injection_next_to_recovery(chaos_serving,
                                                   tmp_path, capsys):
     """One recovered run's journal carries BOTH sides: the `chaos`

@@ -408,6 +408,38 @@ def test_decode_wave_full_audit_clean(decode_wave_ctx):
     assert findings == [], [f.render() for f in findings]
 
 
+def test_paged_decode_wave_pool_donation_actually_aliased():
+    """The paged engine's donated block POOLS must be aliased by XLA at
+    the engine's real shapes — every pool leaf, exactly like the dense
+    KV-cache regression above. The block-table arg rides as a traced
+    input (never donated, never a baked constant)."""
+    (spec,) = jxaudit.tracked_specs(["paged_decode_wave"])
+    ctx = ProgramContext(spec)
+    assert ctx.donate_argnums == (2,)          # the block pools
+    first, n = ctx.leaf_index_ranges()[2]
+    assert n == 4                              # 2 layers x (k, v) pools
+    aliased = ctx.aliased_param_indices
+    assert aliased is not None, ctx.unavailable
+    missing = [i for i in range(first, first + n) if i not in aliased]
+    assert missing == [], \
+        f"paged decode-wave pool leaves {missing} lost donation aliasing"
+    assert list(jxaudit.RULES["donation-dropped"].check(ctx)) == []
+
+
+def test_paged_prefill_chunk_pool_donation_actually_aliased():
+    (spec,) = jxaudit.tracked_specs(["paged_prefill_chunk"])
+    ctx = ProgramContext(spec)
+    assert ctx.donate_argnums == (2,)
+    first, n = ctx.leaf_index_ranges()[2]
+    assert n == 4
+    aliased = ctx.aliased_param_indices
+    assert aliased is not None, ctx.unavailable
+    missing = [i for i in range(first, first + n) if i not in aliased]
+    assert missing == [], \
+        f"paged prefill-chunk pool leaves {missing} lost donation " \
+        "aliasing"
+
+
 def test_optimizer_update_state_donated_and_aliased():
     """The eager opt.step() executable must donate param AND state (the
     first full jxaudit sweep caught state as donation-missing; this

@@ -485,8 +485,8 @@ def test_float_in_decode_wave_fails_lint(tmp_path):
     # traced value in it is exactly the regression ptlint exists to stop
     hacked = _inject(
         "paddle_tpu/serving/engine.py",
-        "            nxt = jnp.where(sample, sampled, greedy)",
-        "\n            nxt_host = float(nxt)")
+        "            lo = _raw(logits)[:, 0, :].astype(jnp.float32)",
+        "\n            lo_host = float(lo[0, 0])")
     bad = tmp_path / "engine.py"
     bad.write_text(hacked)
     res = _cli(str(bad))

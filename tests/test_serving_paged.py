@@ -1,0 +1,428 @@
+"""paddle_tpu.serving.paged — block-table KV cache, chunked prefill,
+prefix sharing.
+
+Tier-1 tests share ONE tiny LLaMA model between a paged and a dense
+engine (2 layers, hidden 64 — the scale every serving suite uses, so
+the persistent cache shares compiles with tests/test_serving.py and
+scripts/chaos_serving.py) and prove the acceptance contract:
+
+  * the paged engine is TOKEN-IDENTICAL to the dense baseline under a
+    fixed seed — single request and a multi-wave mixed-length stream —
+    while both compiled programs stay at exactly one executable;
+  * prefix sharing dedupes identical prompt prefixes onto the same
+    physical blocks WITHOUT changing a single output token (and the
+    BlockPool's refcount/COW machinery holds at the unit level);
+  * chunked prefill folds a long prompt between decode waves — decoding
+    lanes make progress while the long admission is mid-prefill;
+  * pool exhaustion never crashes: admission waits for blocks,
+    mid-decode starvation preempts by recompute and the resumed request
+    still produces the same tokens.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (BlockPool, BlockPoolExhausted,
+                                PagedServingEngine, Scheduler,
+                                ServingEngine)
+from paddle_tpu.utils import chaos
+
+VOCAB = 128
+MAX_LEN = 64
+BLOCK = 8
+CHUNK = 16
+MAX_NEW = 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(7)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=MAX_LEN)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def paged(model):
+    return PagedServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                              block_size=BLOCK, num_blocks=33,
+                              prefill_chunk_len=CHUNK)
+
+
+@pytest.fixture(scope="module")
+def dense(model):
+    return ServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                         prefill_len=CHUNK)
+
+
+def _prompt(seed, n=5):
+    return np.random.RandomState(seed).randint(0, VOCAB, (n,)).tolist()
+
+
+def _stream(engine, jobs):
+    sched = Scheduler(engine)
+    reqs = [sched.submit(prompt=p, max_tokens=m) for p, m in jobs]
+    sched.run()
+    return sched, reqs
+
+
+# ---------------------------------------------------------------------------
+# parity vs the dense baseline
+# ---------------------------------------------------------------------------
+
+def test_single_request_token_identical_to_dense(paged, dense):
+    for seed in (0, 3):
+        prompt = _prompt(seed)
+        assert Scheduler(paged).generate(prompt, max_tokens=MAX_NEW) == \
+            Scheduler(dense).generate(prompt, max_tokens=MAX_NEW)
+
+
+def test_mixed_length_multiwave_stream_token_identical(paged, dense):
+    """12 requests on 4 slots (3 admission waves), mixed prompt lengths
+    and budgets: every request's tokens equal the dense engine's, with
+    retire/refill churn on both sides and ONE compiled program each."""
+    rng = np.random.RandomState(1)
+    jobs = [(rng.randint(0, VOCAB, (int(rng.randint(2, 14)),)).tolist(),
+             int(rng.randint(2, 10))) for _ in range(12)]
+    _, pr = _stream(paged, jobs)
+    _, dr = _stream(dense, jobs)
+    assert [r.output_tokens for r in pr] == [r.output_tokens for r in dr]
+    assert [r.finish_reason for r in pr] == [r.finish_reason for r in dr]
+    assert paged.decode_compiles == 1
+    assert paged.prefill_compiles == 1
+
+
+def test_block_utilization_reported(paged):
+    """The scheduler samples the pool each round: a paged stream's
+    snapshot carries a real utilization and it reflects tokens, not
+    num_slots * max_len (4 short requests can't plausibly fill the
+    pool)."""
+    rng = np.random.RandomState(2)
+    jobs = [(rng.randint(0, VOCAB, (6,)).tolist(), 4) for _ in range(4)]
+    sched, _ = _stream(paged, jobs)
+    snap = sched.metrics.snapshot()
+    assert snap["block_utilization"] is not None
+    assert 0 < snap["block_utilization"] < 1
+    assert paged.block_pool.used == 0          # all blocks back home
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing
+# ---------------------------------------------------------------------------
+
+def test_prefix_sharing_hits_and_tokens_unchanged(model, paged):
+    """Two requests sharing a 3-full-block prefix: the second admission
+    hits the prefix cache (counted per block), shares physical blocks,
+    and BOTH produce exactly the tokens an engine with sharing DISABLED
+    produces."""
+    rng = np.random.RandomState(9)
+    prefix = rng.randint(0, VOCAB, (3 * BLOCK,)).tolist()
+    prompts = [prefix + [5, 6], prefix + [9, 11]]
+
+    noshare = PagedServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                                 block_size=BLOCK, num_blocks=33,
+                                 prefill_chunk_len=CHUNK,
+                                 prefix_sharing=False)
+    want = [Scheduler(noshare).generate(p, max_tokens=MAX_NEW)
+            for p in prompts]
+
+    h0, m0 = paged.block_pool.prefix_hits, paged.block_pool.prefix_misses
+    sched = Scheduler(paged)
+    r1 = sched.submit(prompt=prompts[0], max_tokens=MAX_NEW)
+    sched.run()                              # first writes + registers
+    r2 = sched.submit(prompt=prompts[1], max_tokens=MAX_NEW)
+    sched.run()                              # second re-hits the blocks
+    assert [r1.output_tokens, r2.output_tokens] == want
+    assert paged.block_pool.prefix_hits - h0 == 3
+    snap = sched.metrics.snapshot()
+    assert snap["prefix_hits"] == 3
+    assert snap["prefix_hit_rate"] > 0
+
+
+def test_shared_blocks_live_while_both_requests_decode(model):
+    """Concurrent sharing: two requests admitted back-to-back share the
+    prefix blocks (refcount 2) while BOTH decode, and still match the
+    unshared outputs — divergence lands in private blocks only."""
+    engine = PagedServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                                block_size=BLOCK, num_blocks=33,
+                                prefill_chunk_len=CHUNK)
+    rng = np.random.RandomState(12)
+    prefix = rng.randint(0, VOCAB, (2 * BLOCK,)).tolist()
+    prompts = [prefix + [3], prefix + [7]]
+    want = [Scheduler(engine).generate(p, max_tokens=MAX_NEW)
+            for p in prompts]                 # serial = no concurrency
+    sched = Scheduler(engine)
+    reqs = [sched.submit(prompt=p, max_tokens=MAX_NEW) for p in prompts]
+    sched.step()                              # both admitted
+    shared = set(engine._slot_blocks[reqs[0].slot][:2]) & \
+        set(engine._slot_blocks[reqs[1].slot][:2])
+    assert len(shared) == 2                   # physical dedup, live
+    assert all(engine.block_pool.refcount(b) == 2 for b in shared)
+    sched.run()
+    assert [r.output_tokens for r in reqs] == want
+
+
+def test_block_pool_refcount_and_cow_units():
+    """Host-level BlockPool semantics: alloc/release/refcounts, hash
+    retention on the free list with LRU eviction, revival of a cached
+    block, and the copy-on-write guard."""
+    pool = BlockPool(num_blocks=5, block_size=4)      # 4 usable
+    a = pool.alloc(2)
+    assert pool.used == 2 and pool.refcount(a[0]) == 1
+    with pytest.raises(BlockPoolExhausted):
+        pool.alloc(3)
+    # share + cow: a shared block is never handed back to the writer
+    pool.acquire(a[0])
+    assert pool.refcount(a[0]) == 2
+    new = pool.cow(a[0])
+    assert new != a[0] and pool.refcount(a[0]) == 1
+    assert pool.refcount(new) == 1
+    exclusive = pool.cow(new)
+    assert exclusive == new                    # refcount 1: no copy
+    # prefix cache: hash survives release, revives on match, evicts LRU
+    toks = list(range(4))
+    h, = pool.prompt_hashes(toks)
+    pool.register_hash(a[1], h)
+    pool.release([a[1]])
+    assert pool.refcount(a[1]) == 0
+    blocks, hashes = pool.match_prefix(toks + [9])   # revive off free
+    assert blocks == [a[1]] and pool.refcount(a[1]) == 1
+    assert pool.prefix_hits == 0               # counted at ADMISSION,
+    pool.count_prefix(len(blocks), 0)          # not per lookup (queue-
+    assert pool.prefix_hits == 1               # head retries don't
+                                               # inflate the rate)
+    pool.release([a[1]])
+    # allocation prefers uncached blocks and evicts the cached one LAST
+    got = pool.alloc(2)
+    assert got[0] != a[1]                      # uncached first
+    assert a[1] in got                         # then evicted (hash gone)
+    assert pool.match_prefix(toks)[0] == []    # the hash is gone
+    with pytest.raises(ValueError, match="double free"):
+        pool.release([a[0], a[0]])
+
+
+def test_cow_under_forced_sharing_keeps_tokens(model, paged):
+    """Safety net made real: force-share a slot's decode write target
+    mid-stream — the engine must copy-on-write (one lazily compiled
+    copy program) and finish with tokens identical to an unshared run."""
+    prompt = _prompt(21, n=BLOCK + 1)          # decode writes block 1
+    want = Scheduler(paged).generate(prompt, max_tokens=MAX_NEW)
+    sched = Scheduler(paged)
+    req = sched.submit(prompt=prompt, max_tokens=MAX_NEW)
+    sched.step()                               # admit + first wave
+    slot = req.slot
+    bi = paged.slot_pos[slot] // paged.block_size
+    blk = paged._slot_blocks[slot][bi]
+    paged.block_pool.acquire(blk)              # simulate another holder
+    try:
+        sched.run()
+    finally:
+        paged.block_pool.release([blk])
+    assert req.output_tokens == want
+    assert paged.decode_compiles == 1          # COW is a separate tiny
+                                               # program, not a recompile
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill
+# ---------------------------------------------------------------------------
+
+def test_long_prompt_chunks_fold_between_decode_waves(paged):
+    """A 3-chunk prompt admits while three short requests decode: the
+    decoding lanes gain tokens during rounds in which the long prompt
+    is still mid-prefill — admission never stalls the wave — and the
+    long request's output equals a solo run (chunked == monolithic)."""
+    rng = np.random.RandomState(4)
+    long_prompt = rng.randint(0, VOCAB, (2 * CHUNK + 5,)).tolist()
+    # NOTE: the solo reference runs AFTER the measured stream — running
+    # it first would register the prompt's block hashes and the measured
+    # admission would skip its first two chunks via the prefix cache
+    # (cached chunks fold to nothing, which is the point, but not THIS
+    # test's point)
+
+    sched = Scheduler(paged)
+    shorts = [sched.submit(prompt=_prompt(30 + i), max_tokens=12)
+              for i in range(3)]
+    sched.step()                               # shorts active + decoding
+    long_req = sched.submit(prompt=long_prompt, max_tokens=5)
+    progressed_mid_prefill = 0
+    while long_req.state == "QUEUED" or \
+            long_req.slot in paged.prefilling_slots():
+        before = sum(len(r.output_tokens) for r in shorts)
+        sched.step()
+        mid = (long_req.slot is not None
+               and long_req.slot in paged.prefilling_slots())
+        if mid and sum(len(r.output_tokens) for r in shorts) > before:
+            progressed_mid_prefill += 1
+    sched.run()
+    assert progressed_mid_prefill >= 1
+    want = Scheduler(paged).generate(long_prompt, max_tokens=5)
+    assert long_req.output_tokens == want      # chunked == solo (which
+                                               # itself re-hits the cache)
+    assert all(r.finish_reason == "max_tokens" for r in shorts)
+    assert paged.prefill_compiles == 1         # every chunk, one program
+
+
+def test_unaligned_final_chunk_rope_exact(model, paged):
+    """Regression: a final chunk overrunning the RoPE table (chunk 24,
+    50-token prompt -> last chunk covers [48, 72) over the 64-row
+    table) must gather rotations per position — a dynamic_slice clamps
+    the slice START and silently shifts RoPE for the chunk's VALID
+    tokens. Reference: the module engine's aligned 16-token chunks."""
+    engine = PagedServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                                block_size=BLOCK, num_blocks=33,
+                                prefill_chunk_len=24)
+    prompt = _prompt(80, n=50)
+    assert Scheduler(engine).generate(prompt, max_tokens=MAX_NEW) == \
+        Scheduler(paged).generate(prompt, max_tokens=MAX_NEW)
+
+
+def test_decode_wave_never_writes_through_midprefill_tables(model):
+    """Regression: the wave program scatters EVERY lane's K/V (fixed
+    shapes) — while a multi-chunk prompt is mid-prefill, a decode wave
+    driven by OTHER lanes must not write its stale token through the
+    pending slot's already-populated (possibly shared) block table. The
+    wave uploads scratch rows for non-wave lanes; the chunk's written
+    content must survive bit-exact."""
+    engine = PagedServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                                block_size=BLOCK, num_blocks=33,
+                                prefill_chunk_len=CHUNK)
+    engine.prefill_slot(0, _prompt(70))            # active decoder
+    engine.begin_prefill(1, _prompt(71, n=2 * CHUNK + 3))   # 3 chunks
+    assert engine.prefill_step(1) is None          # chunk 0 written
+    blk0 = engine._slot_blocks[1][0]
+    before = np.asarray(engine._caches[0][0])[blk0].copy()
+    engine.decode_wave()                           # slot 0 decodes
+    after = np.asarray(engine._caches[0][0])[blk0]
+    np.testing.assert_array_equal(before, after)
+
+
+def test_prompt_longer_than_chunk_but_full_horizon_rejected(paged):
+    """Chunked prefill removes the dense bucket limit — a prompt longer
+    than the chunk admits fine — but the horizon still binds."""
+    ok = _prompt(40, n=CHUNK + 3)              # > chunk: fine now
+    assert Scheduler(paged).generate(ok, max_tokens=2)
+    too_long = _prompt(41, n=MAX_LEN)          # no room to decode
+    with pytest.raises(ValueError, match="no room to decode"):
+        Scheduler(paged).submit(prompt=too_long, max_tokens=2)
+
+
+# ---------------------------------------------------------------------------
+# pool exhaustion: queueing + preemption by recompute
+# ---------------------------------------------------------------------------
+
+def test_injected_admission_exhaustion_requeues_and_completes(paged):
+    """Payload-injected allocator exhaustion on the second admission:
+    the request waits at the queue head behind in-flight work, then
+    admits — outputs identical to a fault-free run."""
+    jobs = [(_prompt(50 + i, n=4 + i), 6) for i in range(4)]
+    _, ref = _stream(paged, jobs)
+    monkey = chaos.ChaosMonkey([chaos.Fault(
+        chaos.CACHE_ALLOC, action="payload", payload=True, times=(2,))])
+    with chaos.active(monkey):
+        sched, reqs = _stream(paged, jobs)
+    assert monkey.fired
+    assert [r.output_tokens for r in reqs] == \
+        [r.output_tokens for r in ref]
+    assert sched.metrics.snapshot()["faults"]["cache_exhausted"] == 1
+
+
+def test_organic_starvation_preempts_by_recompute(model):
+    """A pool too small for four long-running requests: starved lanes
+    are preempted (blocks freed, request requeued with prompt +
+    generated tokens), everyone completes, and every output equals a
+    solo run — recompute + prefix re-hits are exact, not approximate."""
+    small = PagedServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                               block_size=BLOCK, num_blocks=9,
+                               prefill_chunk_len=CHUNK)   # 8 usable
+    rng = np.random.RandomState(6)
+    jobs = [(rng.randint(0, VOCAB, (14,)).tolist(), 12)
+            for _ in range(4)]
+    sched, reqs = _stream(small, jobs)
+    assert all(r.finish_reason == "max_tokens" for r in reqs)
+    assert sum(r.preemptions for r in reqs) >= 1
+    assert sched.metrics.snapshot()["faults"]["cache_exhausted"] >= 1
+    for (p, m), r in zip(jobs, reqs):
+        assert Scheduler(small).generate(p, max_tokens=m) == \
+            r.output_tokens
+    assert small.decode_compiles == 1
+    assert small.prefill_compiles == 1
+
+
+def test_never_fitting_prompt_rejected_cleanly(model):
+    """A prompt needing more blocks than the pool owns is shed at
+    submit with a clean ValueError, not an exhaustion loop."""
+    tiny = PagedServingEngine(model, num_slots=2, max_len=MAX_LEN,
+                              block_size=BLOCK, num_blocks=3,
+                              prefill_chunk_len=CHUNK)    # 2 usable
+    with pytest.raises(ValueError, match="KV blocks"):
+        Scheduler(tiny).submit(prompt=_prompt(60, n=3 * BLOCK),
+                               max_tokens=2)
+
+
+# ---------------------------------------------------------------------------
+# scheduler bookkeeping under the paged engine (review regressions)
+# ---------------------------------------------------------------------------
+
+def test_prefix_hits_sampled_on_immediate_retire(model):
+    """A request whose prefill emits the first token and retires in the
+    SAME round (max_tokens=1) still leaves its prefix hits and a pool
+    sample in the snapshot — the working-round sample must key off the
+    round's admissions, not just post-round active/prefilling sets."""
+    eng = PagedServingEngine(model, num_slots=2, max_len=MAX_LEN,
+                             block_size=BLOCK, num_blocks=33,
+                             prefill_chunk_len=CHUNK)
+    prompt = _prompt(75, n=2 * BLOCK)
+    Scheduler(eng).generate(prompt, max_tokens=2)   # warm the prefix cache
+    sched = Scheduler(eng)
+    req = sched.submit(prompt=prompt, max_tokens=1)
+    sched.run()
+    assert req.finish_reason == "max_tokens"
+    assert len(req.output_tokens) == 1
+    snap = sched.metrics.snapshot()
+    assert snap["prefix_hits"] >= 2                 # both full blocks re-hit
+    assert snap["block_utilization"] is not None
+
+
+def test_timeout_mid_chunked_prefill_retires_without_tokens(model):
+    """An expired request must not keep consuming prefill chunk
+    programs or emit a post-expiry first token: the round after its
+    deadline passes retires it with finish_reason "timeout"."""
+    eng = PagedServingEngine(model, num_slots=2, max_len=MAX_LEN,
+                             block_size=BLOCK, num_blocks=33,
+                             prefill_chunk_len=CHUNK)
+    sched = Scheduler(eng)
+    req = sched.submit(prompt=_prompt(76, n=3 * CHUNK), max_tokens=4,
+                       timeout=30.0)
+    sched.step()                        # admit + chunk 1 of 3
+    assert eng.prefilling_slots()
+    req.submit_time -= 60.0             # expire it between chunks
+    sched.step()
+    assert req.done
+    assert req.finish_reason == "timeout"
+    assert req.output_tokens == []
+    assert not eng.prefilling_slots()
+    assert eng.block_pool.used == 0     # mid-prefill blocks all freed
+
+
+def test_all_starved_wave_not_counted_in_occupancy(model):
+    """A wave where every active lane starves dispatches no program and
+    must not inflate the occupancy integral: every counted wave emits
+    exactly its counted number of tokens."""
+    eng = PagedServingEngine(model, num_slots=2, max_len=MAX_LEN,
+                             block_size=BLOCK, num_blocks=5,
+                             prefill_chunk_len=CHUNK)     # 4 usable
+    sched = Scheduler(eng)
+    waves = []
+    orig = sched.metrics.on_wave
+    sched.metrics.on_wave = lambda n: (waves.append(n), orig(n))[1]
+    reqs = [sched.submit(prompt=_prompt(70 + i, n=BLOCK), max_tokens=12)
+            for i in range(2)]
+    sched.run()
+    assert all(r.finish_reason == "max_tokens" for r in reqs)
+    admissions = len(reqs) + sum(r.preemptions for r in reqs)
+    assert admissions > len(reqs)       # starvation actually happened
+    decode_tokens = sum(len(r.output_tokens) for r in reqs) - admissions
+    assert sum(waves) == decode_tokens
+    assert all(n >= 1 for n in waves)

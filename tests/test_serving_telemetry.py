@@ -104,7 +104,12 @@ def test_snapshot_keys_byte_compatible(engine):
         "requests_completed", "tokens_generated", "tokens_per_s",
         "ttft_p50_s", "ttft_p99_s", "latency_p50_s", "latency_p99_s",
         "slot_occupancy", "queue_depth_peak",
-        "faults", "rejected", "wave_retries"]
+        "faults", "rejected", "wave_retries",
+        "block_utilization", "prefix_hits", "prefix_misses",
+        "prefix_hit_rate"]
+    # dense engine: the paged-pool keys are present but empty
+    assert snap["block_utilization"] is None
+    assert snap["prefix_hits"] == 0 and snap["prefix_hit_rate"] is None
     assert snap["requests_completed"] == 1
     assert snap["ttft_p50_s"] is not None
     assert snap["ttft_p50_s"] <= snap["latency_p50_s"]
