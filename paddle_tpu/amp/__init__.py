@@ -171,13 +171,22 @@ class GradScaler:
         self._scale = float(v)
 
     def state_dict(self):
+        """The loss-scaling state machine: current scale plus the
+        good/bad step counters that drive the next grow/shrink decision
+        — captured into full-state checkpoints (utils/resume.py) so a
+        resumed run's scale trajectory continues instead of re-ramping
+        from init_loss_scaling."""
         return {"scale": self._scale, "good_steps": self._good_steps,
                 "bad_steps": self._bad_steps}
 
     def load_state_dict(self, sd):
-        self._scale = sd["scale"]
-        self._good_steps = sd["good_steps"]
-        self._bad_steps = sd["bad_steps"]
+        self._scale = float(sd["scale"])
+        self._good_steps = int(sd["good_steps"])
+        self._bad_steps = int(sd["bad_steps"])
+        if self._enable:
+            _AMP_SCALE.set(self._scale)
+
+    set_state_dict = load_state_dict
 
 
 AmpScaler = GradScaler

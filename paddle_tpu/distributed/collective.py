@@ -19,6 +19,7 @@ Two execution regimes:
 These match the reference's dual dygraph/static collective paths.
 """
 import functools
+import time
 
 import numpy as np
 import jax
@@ -27,7 +28,8 @@ from jax import lax
 
 from ..framework.tensor import Tensor
 from ..ops.dispatch import as_array
-from ..utils import telemetry, profiler, flight_recorder as _flight_recorder
+from ..utils import chaos, telemetry, profiler, \
+    flight_recorder as _flight_recorder
 from . import mesh as mesh_mod
 
 
@@ -63,6 +65,69 @@ _COLLECTIVE_BYTES = telemetry.counter(
     "collective_bytes_total",
     "Payload bytes entering collective ops, by op and group",
     labelnames=("op", "group"))
+_COLLECTIVE_RETRIES = telemetry.counter(
+    "collective_retries_total",
+    "Eager collective attempts retried after a transient failure",
+    labelnames=("op",))
+
+# Eager-path timeout/retry policy — the same bounded-exponential-backoff
+# discipline as the serving scheduler's wave retry (serving/scheduler.py
+# _run_wave_with_retry): `retries` extra attempts, `backoff_s` doubling
+# per retry, and `deadline_s` a hard budget on the whole retry window
+# (None = attempts bound it alone). Applies ONLY to eager dispatches: a
+# traced call site runs at trace time, where an exception is a program
+# bug and a sleep would stall compilation — retrying there can't model
+# a transient transport error. The `chaos.COLLECTIVE` fault point
+# inside the barrier provokes the path deterministically.
+_RETRY_POLICY = {"retries": 2, "backoff_s": 0.05, "deadline_s": None}
+_UNSET = object()
+
+
+def configure_retries(retries=None, backoff_s=None, deadline_s=_UNSET):
+    """Tune (or disable, retries=0) the eager collective retry barrier.
+    Returns the previous policy dict. The deadline_s default sentinel
+    means "leave unchanged"; pass None explicitly to clear it."""
+    prev = dict(_RETRY_POLICY)
+    if retries is not None:
+        _RETRY_POLICY["retries"] = max(0, int(retries))
+    if backoff_s is not None:
+        _RETRY_POLICY["backoff_s"] = float(backoff_s)
+    if deadline_s is not _UNSET:
+        _RETRY_POLICY["deadline_s"] = (None if deadline_s is None
+                                       else float(deadline_s))
+    return prev
+
+
+def _eager_retry(fn, op, args, kwargs):
+    """Run an eager collective behind the bounded backoff barrier.
+    Every retry is counted (`collective_retries_total{op}`) and
+    journaled as a `fault` event (kind `collective_error`), so a flaky
+    transport shows up in the run journal next to the step events it
+    slowed down; the final failure re-raises to the caller."""
+    policy = dict(_RETRY_POLICY)
+    retries = policy["retries"]
+    delay = policy["backoff_s"]
+    deadline = None if policy["deadline_s"] is None \
+        else time.monotonic() + policy["deadline_s"]
+    for attempt in range(retries + 1):
+        try:
+            if chaos.enabled():
+                chaos.fire(chaos.COLLECTIVE, op=op, attempt=attempt)
+            return fn(*args, **kwargs)
+        except Exception as e:   # noqa: BLE001 — retry barrier
+            out_of_budget = attempt >= retries or (
+                deadline is not None
+                and time.monotonic() + delay > deadline)
+            recorder = _flight_recorder.get_recorder()
+            if recorder is not None:
+                recorder.fault(kind="collective_error",
+                               action="raise" if out_of_budget else "retry",
+                               error=repr(e), op=op, attempt=attempt)
+            if out_of_budget:
+                raise
+            _COLLECTIVE_RETRIES.labels(op).inc()
+            time.sleep(delay)
+            delay *= 2
 
 
 def _payload_bytes(x):
@@ -121,14 +186,19 @@ def _instrumented(payload_arg=0):
                     and len(args) > group_arg:
                 grp = args[group_arg]
             group = _group_label(grp)
+            traced = _payload_is_traced(payload)
             _COLLECTIVE_CALLS.labels(op, group).inc()
             _COLLECTIVE_BYTES.labels(op, group).inc(nbytes)
             recorder = _flight_recorder.get_recorder()
             if recorder is not None:
                 recorder.collective(op=op, nbytes=nbytes, group=group,
-                                    traced=_payload_is_traced(payload))
+                                    traced=traced)
             with profiler.RecordEvent(f"collective/{op}"):
-                return fn(*args, **kwargs)
+                if traced:
+                    # trace time: an exception here is a program bug,
+                    # not a transient — no retry barrier
+                    return fn(*args, **kwargs)
+                return _eager_retry(fn, op, args, kwargs)
         return wrapper
     return deco
 

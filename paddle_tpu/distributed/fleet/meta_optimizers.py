@@ -48,8 +48,8 @@ class MetaOptimizerBase(Optimizer):
     def set_state_dict(self, sd):
         return self.inner_opt.set_state_dict(sd)
 
-    def init_opt_state(self, params):
-        return self.inner_opt.init_opt_state(params)
+    def init_opt_state(self, params, parameters=None):
+        return self.inner_opt.init_opt_state(params, parameters=parameters)
 
     def apply_gradients_fn(self):
         return self.inner_opt.apply_gradients_fn()

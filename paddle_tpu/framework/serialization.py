@@ -36,6 +36,13 @@ from ..utils import chaos
 from .tensor import Tensor, Parameter
 
 MANIFEST_NAME = "latest.json"
+#: manifest schema version: 1 = path/step/files+sha256 (PR 8),
+#: 2 = + full train-state file (`.pdtrain`: RNG chains, data cursor,
+#: scaler, global step — utils/resume.py) listed and digested like any
+#: other checkpoint file. Readers accept older manifests (missing
+#: version == 1); the version field exists so FUTURE incompatible
+#: layouts can be refused instead of half-loaded.
+MANIFEST_VERSION = 2
 
 
 class _TensorPayload:
@@ -179,7 +186,8 @@ def write_manifest(path, step=None, files=None):
         files = {}
     elif not isinstance(files, dict):
         files = {name: None for name in files}
-    doc = {"path": os.path.basename(path),
+    doc = {"version": MANIFEST_VERSION,
+           "path": os.path.basename(path),
            "step": None if step is None else int(step),
            "time_unix": round(time.time(), 3),
            "files": {name: files[name] for name in sorted(files)}}

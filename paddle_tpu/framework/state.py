@@ -182,6 +182,58 @@ class Generator:
 _default_generator = Generator(0)
 
 
+def rng_state():
+    """Snapshot of the default generator's split-on-demand chain — the
+    EXACT point the chain is at, not just the seed. `key` is a host
+    numpy copy of the current chain key (None before the first draw):
+    restoring it via `set_rng_state` makes the next `next_rng_key()`
+    return bitwise what an uninterrupted process would have drawn — the
+    contract exact-resume checkpoints (utils/resume.py) rely on for
+    dropout streams."""
+    g = _default_generator
+    with g._lock:
+        key = None if g._key is None else np.asarray(g._key).copy()
+        return {"seed": int(g._seed), "key": key}
+
+
+def set_rng_state(st):
+    """Restore a `rng_state()` snapshot into the default generator."""
+    g = _default_generator
+    with g._lock:
+        if "seed" in st and st["seed"] is not None:
+            g._seed = int(st["seed"])
+        key = st.get("key")
+        if key is None:
+            g._key = None
+        else:
+            # uncommitted, exactly like Generator.next_key creates keys:
+            # a device_put here would COMMIT the key, committedness
+            # propagates through the compiled step to its outputs, and
+            # the second post-resume call would cache-miss — one silent
+            # recompile per resume (chaos_train's compile-once check
+            # catches this)
+            with jax.default_device(host_device()):
+                g._key = jax.numpy.asarray(np.asarray(key))
+
+
+def numpy_rng_state():
+    """The global numpy RNG (MT19937) state as a picklable dict — the
+    data-order half of exact resume: DataLoader shuffle permutations
+    and per-item numpy transforms draw from it. Checkpoints record both
+    the CURRENT state and the state at the start of the in-progress
+    epoch (the latter is what a resume fast-forward replays)."""
+    alg, keys, pos, has_gauss, cached = np.random.get_state()
+    return {"alg": str(alg), "keys": np.asarray(keys).copy(),
+            "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached_gaussian": float(cached)}
+
+
+def set_numpy_rng_state(st):
+    """Restore a `numpy_rng_state()` snapshot into the global numpy RNG."""
+    np.random.set_state((st["alg"], np.asarray(st["keys"]), int(st["pos"]),
+                         int(st["has_gauss"]), float(st["cached_gaussian"])))
+
+
 def seed(s):
     """paddle.seed analog."""
     _default_generator.manual_seed(int(s))

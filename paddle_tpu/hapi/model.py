@@ -13,19 +13,53 @@ from ..metric import Metric
 from . import callbacks as cbks
 
 
-def _timed_iter(loader):
-    """Yield (data_wait_seconds, batch): how long the input pipeline made
-    the train loop wait for each batch — the 'data' phase of the flight
-    recorder's step-time breakdown."""
+def _timed_iter(loader, skip=0):
+    """Yield (batch_idx, data_wait_seconds, batch): the epoch-relative
+    batch index (resume fast-forward included, so the journal's
+    data-wait attribution and the resume cursor agree on the same
+    numbering) and how long the input pipeline made the train loop wait
+    for each batch — the 'data' phase of the flight recorder's
+    step-time breakdown.
+
+    `skip` fast-forwards a resumed epoch: a reader exposing
+    `iter_from` (DataLoader does) seeks — sampler draws replayed,
+    dataset fetches skipped; anything else is fetched and discarded
+    (always bitwise-exact). The skipped batches' wall time is
+    attributed to the first yielded batch's data wait.
+
+    `chaos.DATA_LOAD` fires before each fetch: a delay fault is a
+    stalled input pipeline (watchdog territory), a raise a crashed
+    reader."""
     import time
-    it = iter(loader)
+    from ..utils import chaos
+    skip = max(0, int(skip))
+    pending = 0.0
+    if skip:
+        t0 = time.perf_counter()
+        if hasattr(loader, "iter_from"):
+            it = loader.iter_from(skip)
+        else:
+            it = iter(loader)
+            for _ in range(skip):
+                try:
+                    next(it)
+                except StopIteration:
+                    return
+        pending = time.perf_counter() - t0
+    else:
+        it = iter(loader)
+    idx = skip
     while True:
+        if chaos.enabled():
+            chaos.fire(chaos.DATA_LOAD, batch=idx)
         t0 = time.perf_counter()
         try:
             batch = next(it)
         except StopIteration:
             return
-        yield time.perf_counter() - t0, batch
+        yield idx, pending + (time.perf_counter() - t0), batch
+        pending = 0.0
+        idx += 1
 
 
 class Model:
@@ -39,6 +73,10 @@ class Model:
         self._metrics = []
         self._train_step = None
         self._flight_recorder = None
+        self._scaler = None
+        self._watchdog = None
+        self._fit_cursor = None       # {"epoch","batch","epoch_numpy_rng"}
+        self._resume_state = None     # stashed by load_latest for fit(resume=)
         self.stop_training = False
 
     # ------------------------------------------------------------- prepare
@@ -50,6 +88,17 @@ class Model:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
         self._amp_configs = amp_configs
+        # a GradScaler handed in through amp_configs (the instance
+        # itself, or {"scaler": scaler}) joins the full-state
+        # checkpoint: save() captures scale + skip counters and
+        # load_latest restores them (utils/resume.py)
+        from ..amp import GradScaler
+        self._scaler = None
+        if isinstance(amp_configs, GradScaler):
+            self._scaler = amp_configs
+        elif isinstance(amp_configs, dict) and \
+                isinstance(amp_configs.get("scaler"), GradScaler):
+            self._scaler = amp_configs["scaler"]
 
     def _loss_fn(self, *args):
         # split model outputs from labels by loss arity: loss(out..., label...)
@@ -59,21 +108,58 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None, flight_recorder=None):
+            accumulate_grad_batches=1, num_iters=None, flight_recorder=None,
+            resume=False, save_steps=None, watchdog=None):
+        """Train. Beyond the reference surface:
+
+        * `save_dir`/`save_freq` — per-epoch checkpoints
+          (`{save_dir}/{epoch}` + `final`, via ModelCheckpoint);
+          `save_steps=N` instead checkpoints every N global steps to
+          unique `{save_dir}/step{n}` prefixes — the elastic-training
+          cadence (per-step prefixes keep a resumable fallback when a
+          re-save is torn mid-write, see Model.save).
+        * `resume=True` — continue the run a prior `load_latest`
+          restored: the data cursor fast-forwards to the checkpoint's
+          (epoch, batch) with the epoch-start numpy RNG replayed (same
+          shuffle permutation), the step counter/RNG chain/LR schedule/
+          scaler continue, and a `resume` journal event records the
+          prior run's id and step (`train_resumes_total` counts it).
+          Kill-at-any-step → resume is bitwise-identical to the
+          uninterrupted run — proven by scripts/chaos_train.py.
+        * `watchdog` — True / kwargs dict / a `utils.resume.
+          TrainWatchdog`: a monitor thread journals a `hang` event
+          (with thread stacks) when no step completes within a multiple
+          of the rolling step time (`train_watchdog_stalls_total`).
+        """
         from ..io import DataLoader, Dataset
+        from ..framework import state as fstate
         from ..utils import flight_recorder as fr
+        from ..utils import resume as resume_mod
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,
                                       num_workers=num_workers)
         else:
             train_loader = train_data
-        cb_list = cbks.CallbackList(callbacks or [])
+        auto_cbs = []
+        if save_dir and not save_steps:
+            auto_cbs.append(cbks.ModelCheckpoint(save_freq, save_dir))
+        cb_list = cbks.CallbackList(list(callbacks or []) + auto_cbs)
         cb_list.set_model(self)
         try:
             steps = len(train_loader)
         except TypeError:
             steps = None
+        # resume target (stashed by load_latest; consumed exactly once)
+        resume_info = None
+        if resume:
+            resume_info, self._resume_state = self._resume_state, None
+        start_epoch, start_batch, epoch_rng_snapshot = 0, 0, None
+        if resume_info and resume_info.get("cursor"):
+            cur = resume_info["cursor"]
+            start_epoch = max(0, int(cur.get("epoch") or 0))
+            start_batch = max(0, int(cur.get("batch") or 0))
+            epoch_rng_snapshot = cur.get("epoch_numpy_rng")
         # flight recorder: a FlightRecorder, or a journal path (owned —
         # opened here, closed in the finally). docs/observability.md
         # documents the journal schema; on ANY exception the ring buffer
@@ -83,6 +169,22 @@ class Model:
                                                    fr.FlightRecorder):
             recorder = fr.FlightRecorder(recorder)
             own_recorder = True
+        # the watchdog rides the flight-recorder attach path; asked for
+        # without a recorder, it journals into an in-memory one (the
+        # stall metric still counts)
+        wd = watchdog
+        if isinstance(wd, bool):
+            # watchdog=True → defaults; watchdog=False → explicitly off
+            wd = {} if wd else None
+        if wd is not None and not isinstance(wd, resume_mod.TrainWatchdog):
+            wd_kwargs = wd if isinstance(wd, dict) else {}
+            wd = resume_mod.TrainWatchdog(recorder=recorder, **wd_kwargs)
+        if wd is not None:
+            if recorder is None and wd._recorder is None:
+                recorder = fr.FlightRecorder(None)
+                wd._recorder = recorder
+            wd.start()
+        self._watchdog = wd
         self._flight_recorder = recorder
         prev_recorder = fr.set_recorder(recorder) \
             if recorder is not None else None
@@ -98,21 +200,49 @@ class Model:
                 recorder.run_start(mode="fit", epochs=int(epochs),
                                    steps_per_epoch=steps,
                                    batch_size=int(batch_size))
+            if resume_info is not None:
+                resume_mod.record_resume(
+                    recorder, prior_run_id=resume_info.get("run_id"),
+                    step=resume_info.get("step"), epoch=start_epoch,
+                    batch=start_batch)
             cb_list.on_begin("train", {"epochs": epochs, "steps": steps,
                                        "verbose": verbose,
                                        "metrics": self._metric_names()})
+            completed = True
             for epoch in range(epochs):
+                if epoch < start_epoch:
+                    continue
+                skip = start_batch if epoch == start_epoch else 0
+                if skip and epoch_rng_snapshot is not None:
+                    # replay the in-progress epoch's data order: the
+                    # shuffle permutation (and any numpy transform
+                    # draws the fast-forward replays) redraw from the
+                    # SAME epoch-start RNG state the original run had
+                    fstate.set_numpy_rng_state(epoch_rng_snapshot)
+                epoch_rng = fstate.numpy_rng_state()
                 cb_list.on_epoch_begin(epoch)
                 self.network.train()
-                for step, (data_s, batch) in enumerate(
-                        _timed_iter(train_loader)):
-                    cb_list.on_batch_begin("train", step, logs)
+                for bidx, data_s, batch in _timed_iter(train_loader,
+                                                       skip=skip):
+                    cb_list.on_batch_begin("train", bidx, logs)
                     loss, metrics = self.train_batch_parts(
-                        batch, data_wait=data_s)
+                        batch, data_wait=data_s, batch_idx=bidx)
                     logs = {"loss": loss, **metrics,
                             "batch_size": batch_size}
                     history["loss"].append(loss)
-                    cb_list.on_batch_end("train", step, logs)
+                    # the cursor a checkpoint records: `batch` counts
+                    # batches CONSUMED this epoch — the fast-forward
+                    # target of a resume
+                    self._fit_cursor = {"epoch": epoch, "batch": bidx + 1,
+                                        "epoch_numpy_rng": epoch_rng}
+                    if save_steps and save_dir:
+                        gstep = getattr(self._train_step, "_step_i",
+                                        it_count + 1)
+                        if gstep % int(save_steps) == 0:
+                            import os
+                            self.save(os.path.join(save_dir,
+                                                   f"step{gstep}"))
+                    cb_list.on_batch_end("train", bidx, logs)
                     it_count += 1
                     if num_iters is not None and it_count >= num_iters:
                         break
@@ -128,7 +258,14 @@ class Model:
                                  for k, v in eval_logs.items()})
                 if self.stop_training or (num_iters is not None
                                           and it_count >= num_iters):
+                    completed = False
                     break
+            if completed:
+                # end-of-training cursor: a final save resumes to
+                # "nothing left" instead of replaying the last epoch
+                self._fit_cursor = {"epoch": int(epochs), "batch": 0,
+                                    "epoch_numpy_rng":
+                                        fstate.numpy_rng_state()}
             cb_list.on_end("train", logs)
             if self._train_step is not None:
                 self._train_step.sync()
@@ -136,6 +273,9 @@ class Model:
             status, err = "crashed", f"{type(e).__name__}: {e}"
             raise
         finally:
+            if wd is not None:
+                wd.stop()
+                self._watchdog = None
             if recorder is not None:
                 try:
                     recorder.run_end(status=status, error=err,
@@ -159,7 +299,7 @@ class Model:
             self._flight_recorder = None
         return history
 
-    def train_batch_parts(self, batch, data_wait=None):
+    def train_batch_parts(self, batch, data_wait=None, batch_idx=None):
         from ..optimizer.lr import LRScheduler
         inputs, labels = self._split_batch(batch)
         if self._train_step is None:
@@ -170,9 +310,13 @@ class Model:
         recorder = getattr(self, "_flight_recorder", None)
         if recorder is not None:
             if hasattr(self._train_step, "attach_flight_recorder"):
+                watchdog = getattr(self, "_watchdog", None)
                 if getattr(self._train_step, "_recorder", None) \
-                        is not recorder:
-                    self._train_step.attach_flight_recorder(recorder)
+                        is not recorder or \
+                        getattr(self._train_step, "_watchdog", None) \
+                        is not watchdog:
+                    self._train_step.attach_flight_recorder(
+                        recorder, watchdog=watchdog)
             elif not getattr(self, "_fr_unsupported_warned", False):
                 import warnings
                 warnings.warn(
@@ -183,7 +327,7 @@ class Model:
                 self._fr_unsupported_warned = True
         if data_wait is not None and \
                 hasattr(self._train_step, "set_data_wait"):
-            self._train_step.set_data_wait(data_wait)
+            self._train_step.set_data_wait(data_wait, batch=batch_idx)
         result = self._train_step(inputs, labels)
         has_outs = getattr(self._train_step, "return_outputs", False)
         if self._metrics and not has_outs:
@@ -310,22 +454,33 @@ class Model:
 
     # ------------------------------------------------------------- save/load
     def save(self, path, training=True):
-        """Crash-safe checkpoint: each file is written atomically
-        (framework.serialization: temp + fsync + os.replace) and the
-        directory's `latest.json` manifest — which records each file's
-        sha256 — is updated only after EVERY file landed. A crash
-        mid-save over a FRESH prefix leaves the previous checkpoint
-        loadable via `load_latest`; a crash while re-saving over an
-        EXISTING prefix (old bytes already overwritten in place) is
-        detected by the digest check and `load_latest` refuses the torn
-        pair rather than silently mixing saves — use unique per-step
-        prefixes when a resumable fallback is required."""
+        """Crash-safe FULL-STATE checkpoint: each file is written
+        atomically (framework.serialization: temp + fsync + os.replace)
+        and the directory's `latest.json` manifest — which records each
+        file's sha256 — is updated only after EVERY file landed. A
+        training save captures three files under one manifest entry:
+        `.pdparams` (params + buffers), `.pdopt` (optimizer
+        accumulators, global step, LR-scheduler state), and `.pdtrain`
+        (utils/resume.py: the PRNG chain, numpy RNG, data cursor,
+        GradScaler state, prior run id) — everything `load_latest` +
+        `fit(resume=True)` need to continue the EXACT trajectory.
+
+        A crash mid-save over a FRESH prefix leaves the previous
+        checkpoint loadable via `load_latest`; a crash while re-saving
+        over an EXISTING prefix (old bytes already overwritten in
+        place) is detected by the digest check and `load_latest`
+        refuses the torn set rather than silently mixing saves — use
+        unique per-step prefixes (`fit(save_steps=N)` does) when a
+        resumable fallback is required."""
         import os
         from ..framework import serialization
         from ..utils import flight_recorder as fr
+        from ..utils import resume as resume_mod
         if self._train_step is not None:
             self._train_step.sync()
         step = getattr(self._train_step, "_step_i", None)
+        if step is None and self._optimizer is not None:
+            step = self._optimizer._global_step or None
         base = os.path.basename(path)
         files = {base + ".pdparams":
                  serialization.save(dict(self.network.state_dict()),
@@ -339,8 +494,19 @@ class Model:
             # params now — remove it so load()/load_latest can never
             # pair the new params with old optimizer moments
             os.unlink(path + ".pdopt")
-        serialization.write_manifest(path, step=step, files=files)
         recorder = fr.get_recorder()
+        if training:
+            doc = resume_mod.capture_train_state(
+                cursor=self._fit_cursor, step=step, scaler=self._scaler,
+                run_id=None if recorder is None else recorder.run_id)
+            files[base + ".pdtrain"] = serialization.save(
+                doc, path + ".pdtrain")
+        elif os.path.exists(path + ".pdtrain"):
+            # same staleness rule as .pdopt: a params-only re-save must
+            # not leave a prior save's RNG/cursor pretending to belong
+            # to these params
+            os.unlink(path + ".pdtrain")
+        serialization.write_manifest(path, step=step, files=files)
         if recorder is not None:
             recorder.checkpoint(path=path, step=step, complete=True)
 
@@ -354,7 +520,7 @@ class Model:
             self._optimizer.set_state_dict(_load(path + ".pdopt"))
         self._train_step = None  # recompile against restored state
 
-    def load_latest(self, directory, **kw):
+    def load_latest(self, directory, restore_train_state=True, **kw):
         """Resume from the newest COMPLETE checkpoint in `directory`
         (the `latest.json` manifest save() maintains — a checkpoint
         whose save crashed mid-write is never listed there, and the
@@ -362,21 +528,37 @@ class Model:
         disk before loading). Returns the checkpoint prefix loaded, or
         None when the directory holds no manifest or the listed files
         are torn relative to it (crash while re-saving a reused
-        prefix)."""
+        prefix).
+
+        When the checkpoint carries a `.pdtrain` train-state file (a
+        training save) and `restore_train_state` is True, the process
+        RNG chains and the Model's GradScaler are restored IN PLACE
+        and the data cursor is stashed for the next
+        `fit(resume=True)` — the exact-resume path
+        (utils/resume.py, proven by scripts/chaos_train.py)."""
         import os
         from ..framework import serialization
+        from ..utils import resume as resume_mod
         prefix = serialization.latest_checkpoint(directory)
         if prefix is None:
             return None
         doc = serialization.read_manifest(directory)
-        listed = (doc or {}).get("files") or {}
-        if os.path.basename(prefix) + ".pdopt" not in set(listed):
+        listed = set((doc or {}).get("files") or {})
+        base = os.path.basename(prefix)
+        if base + ".pdopt" not in listed:
             # an on-disk .pdopt the manifest does not list is a stray
             # from some OTHER save (legacy writer, partial cleanup) —
             # verification never covered it, so it must not be paired
             # with these params
             kw["reset_optimizer"] = True
         self.load(prefix, **kw)
+        self._resume_state = None
+        state_path = prefix + ".pdtrain"
+        if restore_train_state and base + ".pdtrain" in listed \
+                and os.path.exists(state_path):
+            state_doc = serialization.load(state_path)
+            self._resume_state = resume_mod.apply_train_state(
+                state_doc, scaler=self._scaler)
         return prefix
 
     def parameters(self):

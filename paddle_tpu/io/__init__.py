@@ -381,6 +381,48 @@ class DataLoader:
                 for f in pending:
                     f.cancel()
 
+    def iter_from(self, start_batch):
+        """Resume-seek: iterate this epoch starting at batch index
+        `start_batch` WITHOUT fetching/collating the skipped batches.
+        The batch sampler's index draws for the skipped batches still
+        happen (so a shuffled epoch's permutation — and the global
+        numpy RNG position — advance exactly as in the original run),
+        but `dataset[i]`/collate are never called for them: seeking an
+        epoch of expensive reads costs sampler arithmetic only.
+
+        Exact-resume caveat (docs/robustness.md): per-item transforms
+        that draw from the GLOBAL numpy RNG are not replayed by the
+        seek — `Model.fit`'s default fetch-and-discard fast-forward is
+        the bitwise-exact path for such datasets; this method is the
+        cheap path for RNG-free readers. Iterable datasets and worker
+        pools fall back to fetch-and-discard (their readers have no
+        index to seek)."""
+        start = max(0, int(start_batch))
+        if start == 0:
+            yield from self
+            return
+        if self._iterable_mode or self.num_workers > 0:
+            it = iter(self)
+            consumed = 0
+            for _ in it:
+                consumed += 1
+                if consumed >= start:
+                    break
+            yield from it
+            return
+
+        def seeked():
+            for i, idxs in enumerate(self.batch_sampler):
+                if i < start:
+                    continue
+                yield self.collate_fn([self.dataset[j] for j in idxs])
+
+        if self.use_buffer_reader:
+            yield from self._buffered_iter(seeked())
+        else:
+            for batch in seeked():
+                yield self._wrap(batch)
+
     @staticmethod
     def from_generator(feed_list=None, capacity=2, use_double_buffer=True,
                        iterable=True, return_list=False, use_multiprocess=False,

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from ..framework import state
 from ..framework.tensor import Tensor
 from ..nn.layer import Layer
+from ..utils import chaos
 
 
 def _unwrap(x):
@@ -275,7 +276,12 @@ class TrainStep:
         # between steps must keep working — sync() writes back copies too)
         self.params = {n: jnp.copy(a) for n, a in params.items()}
         self.buffers = {n: jnp.copy(a) for n, a in buffers.items()}
-        self.opt_state = optimizer.init_opt_state(params)
+        # parameters= threads the live Parameter objects through so an
+        # optimizer carrying RESTORED accumulators (checkpoint resume,
+        # or prior synced steps) seeds the functional state — a rebuilt
+        # TrainStep must continue the trajectory, not zero the moments
+        self.opt_state = optimizer.init_opt_state(
+            params, parameters=dict(model.named_parameters()))
         self._step_i = optimizer._global_step
         apply_fn = optimizer.apply_gradients_fn()
 
@@ -342,10 +348,19 @@ class TrainStep:
         self._fail_fast = False
         self._cost_cache = {}
         self._pending_data_s = 0.0
+        self._pending_batch = None
+        self._watchdog = None
         self._last_grad_norm = None
         self._last_nonfinite = None
 
     def __call__(self, inputs, labels):
+        if chaos.enabled():
+            # the canonical "kill"/stall boundary for the exact-resume
+            # parity harness: host-side, BEFORE the step counter, the
+            # RNG draw, or the compiled dispatch — a raise here leaves
+            # every piece of training state exactly at the last
+            # completed step, like a SIGKILL between steps
+            chaos.fire(chaos.TRAIN_STEP, step=self._step_i + 1)
         inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
         labels = labels if isinstance(labels, (list, tuple)) else (labels,)
         self._step_i += 1
@@ -366,17 +381,21 @@ class TrainStep:
 
     # ------------------------------------------------------ flight recorder
     def attach_flight_recorder(self, recorder, label="train_step",
-                               fail_fast=None):
+                               fail_fast=None, watchdog=None):
         """Instrument every subsequent step: journal `step` events with
         the data/host/device timing split, per-executable `compile`
         events with FLOPs/bytes from HLO cost analysis, MFU + non-finite
         telemetry. Adds ONE host sync per step (block_until_ready on the
         loss) — the same sync hapi's per-step float(loss) already pays.
         `fail_fast=True` (or recorder.fail_fast) raises NonFiniteError
-        when loss/global-grad-norm go non-finite."""
+        when loss/global-grad-norm go non-finite. `watchdog` (a started
+        `utils.resume.TrainWatchdog`) is fed one `beat()` per completed
+        step, so a step that never completes becomes a journaled `hang`
+        event instead of a silent stall."""
         from ..utils import telemetry, flight_recorder as fr
         self._recorder = recorder
         self._label = label
+        self._watchdog = watchdog
         self._fail_fast = recorder.fail_fast if fail_fast is None \
             else bool(fail_fast)
         # False on jax builds without jax.monitoring: compile detection
@@ -407,11 +426,16 @@ class TrainStep:
 
     def detach_flight_recorder(self):
         self._recorder = None
+        self._watchdog = None
 
-    def set_data_wait(self, seconds):
-        """Data-pipeline wait attributed to the NEXT step event
-        (Model.fit times the DataLoader and reports it here)."""
+    def set_data_wait(self, seconds, batch=None):
+        """Data-pipeline wait (and optionally the epoch-relative batch
+        index) attributed to the NEXT step event (Model.fit times the
+        DataLoader and reports both here — the journal's `batch` field
+        is the same index the resume cursor records, so data-wait
+        attribution and fast-forward bookkeeping agree)."""
         self._pending_data_s = float(seconds)
+        self._pending_batch = None if batch is None else int(batch)
 
     def last_nonfinite(self):
         """Sentinel of the latest step (host sync on first read)."""
@@ -483,11 +507,15 @@ class TrainStep:
             mfu = flops / (max(device_s, 1e-9) * self._peak_flops)
             self._m_mfu.set(mfu)
         data_s, self._pending_data_s = self._pending_data_s, 0.0
+        batch_idx, self._pending_batch = self._pending_batch, None
         nonfinite = bool(self._last_nonfinite)
         grad_norm = float(self._last_grad_norm)
+        extra = {} if batch_idx is None else {"batch": batch_idx}
         rec.step(step=self._step_i, data_s=data_s, host_s=host_s,
                  device_s=device_s, loss=float(loss), grad_norm=grad_norm,
-                 mfu=mfu, nonfinite=nonfinite)
+                 mfu=mfu, nonfinite=nonfinite, **extra)
+        if self._watchdog is not None:
+            self._watchdog.beat(step_s=host_s + device_s, step=self._step_i)
         self._m_data.observe(data_s)
         self._m_host.observe(host_s)
         self._m_dev.observe(device_s)

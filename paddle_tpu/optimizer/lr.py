@@ -24,8 +24,11 @@ class LRScheduler:
         raise NotImplementedError
 
     def state_dict(self):
+        # None included: ReduceOnPlateau's `best=None` must round-trip
+        # (a resume that silently kept a stale `best` would change the
+        # plateau decisions, and with them the LR trajectory)
         return {k: v for k, v in self.__dict__.items()
-                if isinstance(v, (int, float, bool, str, list))}
+                if v is None or isinstance(v, (int, float, bool, str, list))}
 
     def set_state_dict(self, sd):
         self.__dict__.update(sd)
@@ -117,6 +120,25 @@ class LinearWarmup(LRScheduler):
             self.lr_sched.step(self.last_epoch - self.warmup_steps)
             return self.lr_sched.last_lr
         return self.target_lr
+
+    def state_dict(self):
+        sd = super().state_dict()
+        if self.lr_sched is not None:
+            # nested under its own key (the wrapped LRScheduler object
+            # is not base-serializable); restored explicitly below so
+            # the base __dict__.update can never replace the scheduler
+            # object with a plain dict
+            sd["_wrapped_sched"] = self.lr_sched.state_dict()
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        nested = sd.pop("_wrapped_sched", None)
+        super().set_state_dict(sd)
+        if nested is not None and self.lr_sched is not None:
+            self.lr_sched.set_state_dict(nested)
+
+    set_dict = set_state_dict
 
 
 class ExponentialDecay(LRScheduler):

@@ -194,12 +194,30 @@ class Optimizer:
     clear_gradients = clear_grad
 
     # ------------------------------------------------------- functional path
-    def init_opt_state(self, params):
+    def init_opt_state(self, params, parameters=None):
         """params: dict name -> jnp array. Returns opt state pytree.
         Delegates to _init_state so subclass slot dtypes (Adam's f32
         moments) and multi_precision master weights apply identically in
-        the eager and jitted paths."""
-        return {name: self._init_state(arr) for name, arr in params.items()}
+        the eager and jitted paths.
+
+        `parameters` (name -> live Parameter, e.g.
+        dict(model.named_parameters())) is the RESUME path: slots
+        already accumulated on this optimizer — a checkpoint restored
+        via set_state_dict, or prior eager/synced steps — seed the
+        functional state instead of zeros. Without it a rebuilt
+        TrainStep would silently reset Adam moments (and with them the
+        loss trajectory) on every resume. Copies are handed out: the
+        compiled step donates its state buffers."""
+        out = {}
+        for name, arr in params.items():
+            st = None
+            if parameters is not None:
+                p = parameters.get(name)
+                if p is not None:
+                    st = self._accumulators.get(id(p))
+            out[name] = ({k: jnp.copy(v) for k, v in st.items()} if st
+                         else self._init_state(arr))
+        return out
 
     def apply_gradients_fn(self):
         """Returns a pure fn(params, grads, opt_state, lr, step) ->

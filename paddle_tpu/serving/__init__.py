@@ -21,7 +21,7 @@ and TTFT/latency histograms live in the typed metric registry; and
 `engine.start_metrics_server()` (or
 inference.Config.enable_metrics_exporter) serves /metrics + /healthz.
 
-Resilience (docs/serving.md "Resilience"): per-request fault isolation
+Resilience (docs/robustness.md): per-request fault isolation
 (a failed prefill or non-finite decode lane resolves only ITS request
 with finish_reason "error"), wave retry with bounded exponential
 backoff then graceful degradation, bounded-queue load shedding +

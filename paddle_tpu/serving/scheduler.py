@@ -18,7 +18,7 @@ lane starved mid-decode is PREEMPTED BY RECOMPUTE — blocks freed,
 request requeued with prompt + generated tokens (prefix-cache hits make
 the re-prefill cheap), bounded by `max_preemptions`.
 
-Resilience (docs/serving.md "Resilience"; every path below is proven
+Resilience (docs/robustness.md; every path below is proven
 by injection in scripts/chaos_serving.py):
 
   * a failed prefill or a non-finite decode lane resolves ONLY that

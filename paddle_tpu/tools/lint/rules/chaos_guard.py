@@ -103,5 +103,5 @@ class ChaosGuard(Rule):
                     f"chaos.{node.func.attr}() fault point not guarded "
                     "by `if chaos.enabled():` in the same function — "
                     "the zero-cost-when-disabled contract "
-                    "(docs/serving.md Resilience) requires the guard "
+                    "(docs/robustness.md) requires the guard "
                     "at every production call site")

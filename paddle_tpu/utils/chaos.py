@@ -68,9 +68,30 @@ CHECKPOINT_WRITE = "serialization.save"
 #: starve (a raise-type fault here instead simulates the allocator
 #: CRASHING, which must surface as a request-isolated error)
 CACHE_ALLOC = "serving.cache_alloc"
+#: raise/delay at the train-step boundary BEFORE the compiled step
+#: dispatches (host-side: params/opt-state/grad-acc untouched, the RNG
+#: chain not yet advanced) — a raise here is the canonical "kill" the
+#: exact-resume parity harness (scripts/chaos_train.py) injects, and a
+#: delay is a stalled step for the training watchdog to catch
+TRAIN_STEP = "train.step"
+#: raise/delay around the train loop's next(batch) — a crashing or
+#: stalled input pipeline (Model.fit's _timed_iter consults it, so the
+#: firing carries the batch index the cursor would record)
+DATA_LOAD = "train.data_load"
+#: raise before an EAGER collective op dispatches — exercises the
+#: timeout/retry wrapper in distributed/collective.py (traced call
+#: sites never consult it: a trace-time raise would poison the
+#: executable, not simulate a transient transport error)
+COLLECTIVE = "distributed.collective"
+#: payload: iterable of train-state keys DROPPED from the checkpoint's
+#: captured state (utils/resume.capture_train_state) — the resume
+#: parity harness's positive controls arm this ("rng" dropped must
+#: make the kill/resume parity check fail)
+TRAIN_STATE = "resume.capture"
 
 POINTS = (DECODE_WAVE, DECODE_WAVE_NAN, PREFILL, CALLBACK,
-          CHECKPOINT_WRITE, CACHE_ALLOC)
+          CHECKPOINT_WRITE, CACHE_ALLOC, TRAIN_STEP, DATA_LOAD,
+          COLLECTIVE, TRAIN_STATE)
 
 ACTIONS = ("raise", "delay", "payload")
 

@@ -42,7 +42,7 @@ class NonFiniteError(RuntimeError):
 
 EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
                "checkpoint", "xla_program", "jxaudit", "chaos", "fault",
-               "run_end")
+               "resume", "hang", "run_end")
 
 
 def _json_safe(v):
@@ -91,6 +91,7 @@ class FlightRecorder:
         self._file = None
         self._started = False
         self._ended = False
+        self.run_id = None
 
     # ---------------------------------------------------------------- core
     def record(self, event, **fields):
@@ -153,13 +154,17 @@ class FlightRecorder:
         both call it); after run_end it opens a NEW run segment in the
         same journal, so reusing one recorder across two fits brackets
         each run instead of silently recording neither."""
+        import uuid
         with self._lock:
             if self._started and not self._ended:
                 return None
             self._started, self._ended = True, False
+            # a fresh id per run segment: checkpoints record it so a
+            # resumed run's `resume` event names the run it continues
+            self.run_id = uuid.uuid4().hex[:12]
         info = dict(self.meta)
         info.update(meta)
-        return self.record("run_start", **info)
+        return self.record("run_start", run_id=self.run_id, **info)
 
     def run_end(self, status="ok", error=None, **extra):
         """Close the run (idempotent) and force a flush — crashed runs
@@ -277,6 +282,43 @@ class FlightRecorder:
             fields["error"] = str(error)
         fields.update(extra)
         return self.record("fault", **fields)
+
+    def resume(self, prior_run_id=None, step=None, epoch=None, batch=None,
+               **extra):
+        """This run continues a checkpointed prior run: `prior_run_id`
+        is the `run_start.run_id` of the run that wrote the checkpoint,
+        `step` the global step being resumed from, epoch/batch the data
+        cursor the fast-forward targets — journaled next to `run_start`
+        so trajectory stitching is reconstructable from journals alone."""
+        fields = {}
+        if prior_run_id is not None:
+            fields["prior_run_id"] = str(prior_run_id)
+        if step is not None:
+            fields["step"] = int(step)
+        if epoch is not None:
+            fields["epoch"] = int(epoch)
+        if batch is not None:
+            fields["batch"] = int(batch)
+        fields.update(extra)
+        return self.record("resume", **fields)
+
+    def hang(self, age_s, threshold_s=None, step=None, action="observe",
+             stacks=None, **extra):
+        """The training watchdog (utils/resume.TrainWatchdog) detected a
+        stalled step: no step completed for `age_s` seconds against a
+        rolling-step-time threshold. `stacks` carries the thread stack
+        dumps captured at detection; `action` is "observe" or
+        "interrupt" (deadline exceeded, KeyboardInterrupt raised into
+        the main thread)."""
+        fields = {"age_s": round(float(age_s), 3), "action": str(action)}
+        if threshold_s is not None:
+            fields["threshold_s"] = round(float(threshold_s), 3)
+        if step is not None:
+            fields["step"] = int(step)
+        if stacks is not None:
+            fields["stacks"] = stacks
+        fields.update(extra)
+        return self.record("hang", **fields)
 
     def checkpoint(self, path=None, step=None, **extra):
         fields = {}

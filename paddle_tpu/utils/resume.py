@@ -1,0 +1,294 @@
+"""Exact-resume elastic training: full train-state capture/restore and
+the training watchdog.
+
+PR 8 made checkpoint *writes* crash-safe; this module makes a resumed
+run the SAME run. A checkpoint that only holds params + optimizer
+moments silently changes the loss trajectory on resume — the RNG chain
+restarts (different dropout masks), the data cursor resets (batches
+replayed or skipped), the LR schedule and AMP loss scale re-derive from
+scratch. `capture_train_state`/`apply_train_state` close that gap: the
+`.pdtrain` file `hapi.Model.save` writes alongside `.pdparams`/`.pdopt`
+(all three digests under one versioned `latest.json` manifest entry)
+records
+
+  * the default generator's split-on-demand PRNG chain — the exact key,
+    so dropout streams resume mid-epoch bitwise
+    (`framework.state.rng_state`);
+  * the global numpy RNG — shuffle permutations and numpy transforms
+    (`framework.state.numpy_rng_state`);
+  * the data cursor: epoch, batches consumed, and the numpy RNG state
+    at the START of the in-progress epoch (what `Model.fit`'s
+    fast-forward replays so the epoch's shuffle permutation
+    reconstructs identically);
+  * `amp.GradScaler` scale + good/bad step counters, when a scaler is
+    attached to the Model;
+  * the global step and the prior run's flight-recorder `run_id`, so
+    the resumed journal's `resume` event names what it continues.
+
+The kill/resume parity proof lives in `scripts/chaos_train.py`: kill at
+any injected step boundary (`chaos.TRAIN_STEP`), resume via
+`Model.load_latest`, and the per-step (loss, grad-norm) trajectory is
+bitwise-identical to an uninterrupted seeded run. The
+`chaos.TRAIN_STATE` payload point drops keys from the captured state —
+the harness's positive controls (`--inject rng-drop`) prove the parity
+check actually bites.
+
+`TrainWatchdog` is the hang half of elastic training: a monitor thread
+fed a `beat()` per completed step (wired through
+`TrainStep.attach_flight_recorder`) that journals a `hang` event with
+thread stack dumps when no step lands within a configurable multiple
+of the rolling step time, bumps `train_watchdog_stalls_total`, and
+optionally interrupts the main thread after a hard deadline — a hung
+collective or stuck input pipeline becomes an observable, recoverable
+event instead of a silent stall.
+
+Metric catalog entries live in docs/observability.md; the full
+robustness story (checkpoint contents table, chaos scenario catalog,
+watchdog tuning) in docs/robustness.md.
+"""
+import sys
+import threading
+import time
+import traceback
+
+from . import telemetry, chaos, flight_recorder
+from ..framework import state
+
+#: schema version of the `.pdtrain` payload — bump on incompatible
+#: layout changes; `apply_train_state` refuses newer versions rather
+#: than resuming with silently-misread state
+STATE_VERSION = 1
+
+_RESUMES = telemetry.counter(
+    "train_resumes_total",
+    "Training runs resumed from a full-state checkpoint")
+_WATCHDOG_STALLS = telemetry.counter(
+    "train_watchdog_stalls_total",
+    "Stalled-step episodes detected by the training watchdog")
+
+
+# ---------------------------------------------------------------------------
+# train-state capture / restore
+# ---------------------------------------------------------------------------
+
+def capture_train_state(cursor=None, step=None, scaler=None, run_id=None):
+    """The full non-(param/optimizer) training state as one picklable
+    dict — everything a resumed run needs to continue the EXACT
+    trajectory. `cursor` is Model.fit's data cursor
+    ({"epoch", "batch", "epoch_numpy_rng"}), `scaler` an optional
+    `amp.GradScaler`, `run_id` the writing run's flight-recorder id.
+
+    The `chaos.TRAIN_STATE` payload point may name keys to DROP — the
+    parity harness's positive controls (a checkpoint without its RNG
+    chain must make the kill/resume parity check fail)."""
+    doc = {
+        "version": STATE_VERSION,
+        "time_unix": round(time.time(), 3),
+        "rng": state.rng_state(),
+        "numpy_rng": state.numpy_rng_state(),
+        "cursor": None if cursor is None else dict(cursor),
+        "step": None if step is None else int(step),
+        "scaler": None if scaler is None else dict(scaler.state_dict()),
+        "run_id": run_id,
+    }
+    if chaos.enabled():
+        dropped = chaos.value(chaos.TRAIN_STATE, default=())
+        for key in tuple(dropped or ()):
+            doc.pop(key, None)
+    return doc
+
+
+def apply_train_state(doc, scaler=None):
+    """Restore a `capture_train_state` snapshot into the process: RNG
+    chains re-wound, scaler state reloaded. Returns the resume info
+    `Model.fit(resume=True)` consumes: {"cursor", "step", "run_id"}.
+    Missing keys are tolerated (a positive-control checkpoint may have
+    dropped them — the parity harness then proves the divergence);
+    a NEWER version than this reader understands is refused."""
+    if not isinstance(doc, dict):
+        raise ValueError(f"train state is not a dict: {type(doc).__name__}")
+    version = int(doc.get("version", 0))
+    if version > STATE_VERSION:
+        raise ValueError(
+            f"checkpoint train-state version {version} is newer than this "
+            f"reader ({STATE_VERSION}); refusing a silently-partial resume")
+    if doc.get("rng") is not None:
+        state.set_rng_state(doc["rng"])
+    if doc.get("numpy_rng") is not None:
+        state.set_numpy_rng_state(doc["numpy_rng"])
+    if scaler is not None and doc.get("scaler") is not None:
+        scaler.load_state_dict(doc["scaler"])
+    return {"cursor": doc.get("cursor"), "step": doc.get("step"),
+            "run_id": doc.get("run_id")}
+
+
+def record_resume(recorder=None, prior_run_id=None, step=None, epoch=None,
+                  batch=None):
+    """Count a resume (`train_resumes_total`) and journal the `resume`
+    event next to the new run's `run_start`."""
+    _RESUMES.inc()
+    rec = recorder if recorder is not None else flight_recorder.get_recorder()
+    if rec is not None:
+        rec.resume(prior_run_id=prior_run_id, step=step, epoch=epoch,
+                   batch=batch)
+
+
+# ---------------------------------------------------------------------------
+# training watchdog
+# ---------------------------------------------------------------------------
+
+def _thread_stacks(skip_ident=None, limit=25, max_chars=4000):
+    """Formatted stacks of every live thread (the hang post-mortem) —
+    the watchdog's own monitor thread excluded."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks = {}
+    for ident, frame in sys._current_frames().items():
+        if ident == skip_ident:
+            continue
+        name = names.get(ident, str(ident))
+        text = "".join(traceback.format_stack(frame, limit=limit))
+        stacks[name] = text[-max_chars:]
+    return stacks
+
+
+class TrainWatchdog:
+    """Stalled-step detector for the training loop.
+
+        wd = TrainWatchdog(stall_factor=10.0, min_stall_s=5.0)
+        step.attach_flight_recorder(rec, watchdog=wd)   # or
+        model.fit(..., flight_recorder=rec, watchdog=wd)
+
+    `beat(step_s)` is called once per COMPLETED step (TrainStep's
+    instrumented path does it); the monitor thread wakes every `poll_s`
+    and, when no beat landed within
+    `max(min_stall_s, stall_factor * rolling_step_time)`, journals a
+    `hang` event (thread stack dumps included) through the recorder and
+    bumps `train_watchdog_stalls_total` — once per stall EPISODE, not
+    per poll. With `deadline_s` set, a stall older than the deadline
+    additionally journals `action="interrupt"` and raises
+    KeyboardInterrupt into the main thread (`_thread.interrupt_main`) —
+    turning a hard hang into a crash the checkpoint/resume layer
+    already survives.
+
+    The rolling step time is an EWMA (`ewma_alpha`), so the threshold
+    tracks the run's real cadence instead of a guessed constant. The
+    first `warmup_beats` completed steps do NOT feed the EWMA — the
+    first step carries the executable compile, and folding a one-off
+    multi-second compile into the cadence would leave the threshold
+    uselessly slack for the whole run. Until the EWMA is seeded,
+    `min_stall_s` alone applies (so it must cover the compile: raise it
+    when cold compiles are slow, or `beat()` manually after warmup)."""
+
+    def __init__(self, stall_factor=10.0, min_stall_s=5.0, poll_s=None,
+                 deadline_s=None, recorder=None, interrupt=True,
+                 on_stall=None, warmup_beats=1):
+        self.stall_factor = float(stall_factor)
+        self.min_stall_s = float(min_stall_s)
+        self.poll_s = max(0.005, float(poll_s) if poll_s is not None
+                          else self.min_stall_s / 4.0)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.interrupt = bool(interrupt)
+        self.on_stall = on_stall
+        self._recorder = recorder
+        self.warmup_beats = max(0, int(warmup_beats))
+        self._lock = threading.Lock()
+        self._beats = 0
+        self._ewma = None
+        self._ewma_alpha = 0.3
+        self._last_beat = None
+        self._last_step = None
+        self._flagged = False
+        self._interrupted = False
+        self.stalls = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ------------------------------------------------------------- wiring
+    def start(self):
+        """Arm the monitor (idempotent). The stall clock starts NOW."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._monitor, name="train-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def beat(self, step_s=None, step=None):
+        """One completed train step took `step_s` seconds."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._flagged = False
+            self._interrupted = False
+            if step is not None:
+                self._last_step = int(step)
+            self._beats += 1
+            if step_s is not None and step_s > 0 \
+                    and self._beats > self.warmup_beats:
+                a = self._ewma_alpha
+                self._ewma = (float(step_s) if self._ewma is None
+                              else a * float(step_s) + (1 - a) * self._ewma)
+
+    def threshold_s(self):
+        with self._lock:
+            if self._ewma is None:
+                return self.min_stall_s
+            return max(self.min_stall_s, self.stall_factor * self._ewma)
+
+    # ------------------------------------------------------------ monitor
+    def _journal_hang(self, age, thr, action):
+        rec = self._recorder if self._recorder is not None \
+            else flight_recorder.get_recorder()
+        if rec is None:
+            return
+        try:
+            rec.hang(age_s=age, threshold_s=thr, step=self._last_step,
+                     action=action,
+                     stacks=_thread_stacks(skip_ident=threading.get_ident()))
+            rec.flush()
+        except Exception:  # ptlint: disable=swallowed-exception
+            # watchdog-thread contract: a failing journal write (disk
+            # full, recorder closed mid-teardown) must never crash the
+            # monitor or mask the hang it is reporting
+            pass
+
+    def _monitor(self):
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                last = self._last_beat
+                flagged, interrupted = self._flagged, self._interrupted
+            if last is None:
+                continue
+            age = time.monotonic() - last
+            thr = self.threshold_s()
+            if age <= thr:
+                continue
+            if not flagged:
+                with self._lock:
+                    self._flagged = True
+                self.stalls += 1
+                _WATCHDOG_STALLS.inc()
+                self._journal_hang(age, thr, "observe")
+                if self.on_stall is not None:
+                    try:
+                        self.on_stall(self, age)
+                    except Exception:  # ptlint: disable=swallowed-exception
+                        # a user stall-callback raising in the monitor
+                        # thread would kill the watchdog itself
+                        pass
+            if self.deadline_s is not None and age > self.deadline_s \
+                    and not interrupted:
+                with self._lock:
+                    self._interrupted = True
+                self._journal_hang(age, self.deadline_s, "interrupt")
+                if self.interrupt:
+                    import _thread
+                    _thread.interrupt_main()

@@ -31,6 +31,11 @@ def chaos_serving():
     return _load_cli("chaos_serving")
 
 
+@pytest.fixture(scope="module")
+def chaos_train():
+    return _load_cli("chaos_train")
+
+
 def test_smoke_every_fault_class_recovers(chaos_serving, capsys):
     """The tier-1 contract: every chaos scenario's invariants hold —
     poisoned slot isolated, transient wave retried, prefill contained,
@@ -94,6 +99,44 @@ def test_journal_shows_injection_next_to_recovery(chaos_serving,
     fault_ev = next(e for e in events if e["ev"] == "fault")
     assert fault_ev["kind"] == "nonfinite"
     assert fault_ev["slot"] == 1
+
+
+def test_train_kill_resume_journal_shows_both_sides(chaos_train,
+                                                    tmp_path, capsys):
+    """The training-side smoke (fast config: 2-layer GPT, 8 steps):
+    kill right after the first per-step checkpoint, resume, bitwise
+    parity — and one journal carries the `chaos` kill, the
+    `checkpoint` saves, and the resumed run's `resume` event."""
+    journal = tmp_path / "train_chaos.jsonl"
+    assert chaos_train.run(["--boundaries", "after_save",
+                            "--journal", str(journal)]) == 0
+    assert "FAIL" not in capsys.readouterr().out
+    from paddle_tpu.utils import flight_recorder
+    events = flight_recorder.read_journal(str(journal))
+    kinds = {e["ev"] for e in events}
+    assert {"run_start", "chaos", "checkpoint", "resume",
+            "step", "run_end"} <= kinds
+    kill = next(e for e in events if e["ev"] == "chaos")
+    assert kill["point"] == "train.step"
+    res = next(e for e in events if e["ev"] == "resume")
+    assert res["step"] == 1 and res["prior_run_id"]
+
+
+def test_train_inject_rng_drop_exits_1(chaos_train, capsys):
+    """Positive control: a checkpoint whose captured state DROPS the
+    PRNG chain resumes with fresh dropout streams — the bitwise parity
+    check must catch the divergence (exit 1)."""
+    assert chaos_train.run(["--inject", "rng-drop"]) == 1
+    assert "diverged" in capsys.readouterr().out
+
+
+def test_train_inject_cursor_drop_exits_1(chaos_train, capsys):
+    """Positive control: dropping the data cursor replays the epoch
+    from batch 0 — wrong batches AND wrong step count; the parity
+    check must catch both (exit 1)."""
+    assert chaos_train.run(["--inject", "cursor-drop"]) == 1
+    out = capsys.readouterr().out
+    assert "diverged" in out or "re-ran or skipped" in out
 
 
 def test_monkey_prob_selector_is_seeded():

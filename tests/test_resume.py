@@ -1,0 +1,391 @@
+"""Exact-resume elastic training (ISSUE 10 acceptance surface): full
+train-state capture/restore, kill-at-every-boundary bitwise parity via
+scripts/chaos_train.py, the training watchdog, and the
+optimizer-state-survives-donation regression."""
+import importlib.util
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, hapi
+from paddle_tpu.framework import state as fstate
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.utils import chaos, resume, telemetry
+from paddle_tpu.utils import flight_recorder as fr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _single_chip():
+    """The exact-resume layer under test is the single-chip foundation
+    (sharded/ZeRO resume is ROADMAP item 3) — pin build_train_step to
+    TrainStep even when an earlier test file left a global device mesh
+    set (Model.fit would otherwise swap in ShardedTrainStep, which has
+    no TRAIN_STEP kill point or flight-recorder attach)."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+    prev = mesh_mod.get_mesh()
+    mesh_mod.set_mesh(None)
+    yield
+    mesh_mod.set_mesh(prev)
+
+
+def _load_cli(name):
+    path = os.path.join(REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_test_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def chaos_train():
+    return _load_cli("chaos_train")
+
+
+# ---------------------------------------------------------------------------
+# kill/resume bitwise parity — the tentpole contract
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("boundary", ["before_first_step", "after_save",
+                                      "mid_epoch", "epoch_end"])
+def test_kill_resume_parity_at_every_boundary(chaos_train, boundary,
+                                              capsys):
+    """Kill at the injected step boundary, resume via load_latest, and
+    the stitched per-step (loss, grad-norm) trajectory is EXACTLY the
+    uninterrupted golden run's — RNG chain, data cursor, LR schedule
+    and optimizer moments all continued, with the resumed train step
+    compiled exactly once (compile-once under resume)."""
+    assert chaos_train.run(["--boundaries", boundary]) == 0
+    assert "FAIL" not in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# train-state capture / restore units
+# ---------------------------------------------------------------------------
+
+def test_rng_state_roundtrip_continues_key_chain():
+    pt.seed(123)
+    fstate.next_rng_key()                      # advance the chain
+    snap = fstate.rng_state()
+    expected = [np.asarray(fstate.next_rng_key()) for _ in range(3)]
+    pt.seed(999)                               # clobber the chain
+    fstate.set_rng_state(snap)
+    got = [np.asarray(fstate.next_rng_key()) for _ in range(3)]
+    for e, g in zip(expected, got):
+        np.testing.assert_array_equal(e, g)
+
+
+def test_numpy_rng_state_roundtrip():
+    np.random.seed(7)
+    np.random.randn(5)
+    snap = fstate.numpy_rng_state()
+    expected = np.random.permutation(32)
+    np.random.seed(0)
+    fstate.set_numpy_rng_state(snap)
+    np.testing.assert_array_equal(np.random.permutation(32), expected)
+
+
+def test_capture_apply_roundtrip_with_scaler_and_version_gate():
+    from paddle_tpu.amp import GradScaler
+    scaler = GradScaler(enable=True, init_loss_scaling=1024.0)
+    scaler._good_steps, scaler._bad_steps = 7, 1
+    doc = resume.capture_train_state(
+        cursor={"epoch": 1, "batch": 3, "epoch_numpy_rng": None},
+        step=11, scaler=scaler, run_id="abc123")
+    scaler2 = GradScaler(enable=True)
+    info = resume.apply_train_state(doc, scaler=scaler2)
+    assert info["cursor"]["epoch"] == 1 and info["cursor"]["batch"] == 3
+    assert info["step"] == 11 and info["run_id"] == "abc123"
+    assert scaler2.state_dict() == {"scale": 1024.0, "good_steps": 7,
+                                    "bad_steps": 1}
+    # a NEWER writer's state is refused, never half-applied
+    doc2 = dict(doc, version=resume.STATE_VERSION + 1)
+    with pytest.raises(ValueError, match="newer"):
+        resume.apply_train_state(doc2)
+
+
+def test_chaos_train_state_drop_hook():
+    """The positive-control hook: an armed TRAIN_STATE payload fault
+    removes exactly the named keys from the captured state."""
+    monkey = chaos.ChaosMonkey([chaos.Fault(
+        chaos.TRAIN_STATE, action="payload", payload=["rng", "cursor"])])
+    with chaos.active(monkey):
+        doc = resume.capture_train_state(cursor={"epoch": 0, "batch": 1})
+    assert "rng" not in doc and "cursor" not in doc
+    assert "numpy_rng" in doc and doc["version"] == resume.STATE_VERSION
+
+
+# ---------------------------------------------------------------------------
+# satellite: optimizer state_dict round-trip vs donated update steps
+# ---------------------------------------------------------------------------
+
+def _tiny_fit_model(seed=5):
+    pt.seed(seed)
+    net = nn.Linear(4, 3)
+    m = hapi.Model(net)
+    sched = pt.optimizer.lr.StepDecay(1e-2, step_size=2, gamma=0.5)
+    m.prepare(pt.optimizer.AdamW(learning_rate=sched,
+                                 parameters=net.parameters()),
+              nn.functional.mse_loss)
+    return m
+
+
+def _tiny_data(n=8):
+    rng = np.random.RandomState(0)
+    return TensorDataset([rng.randn(n, 4).astype("f4"),
+                          rng.randn(n, 3).astype("f4")])
+
+
+def test_optimizer_snapshot_survives_donated_steps_and_restores_exactly(
+        tmp_path):
+    """PR 7 regression surface, now end-to-end: a checkpoint snapshot
+    taken mid-run (a) is not invalidated by the donated update steps
+    that follow, and (b) restores into a FRESH optimizer + rebuilt
+    TrainStep exactly — accumulators, beta-power/step counter and
+    LR-scheduler state included (a rebuilt step that zeroed the moments
+    would silently fork the trajectory; init_opt_state seeds from the
+    restored accumulators)."""
+    d = str(tmp_path)
+    m = _tiny_fit_model()
+    data = _tiny_data()
+    m.fit(data, batch_size=2, epochs=1, shuffle=False, verbose=0,
+          num_iters=3)
+    m.save(os.path.join(d, "mid"))                    # snapshot at step 3
+    snap = {k: (v.numpy().copy() if hasattr(v, "numpy") else v)
+            for k, v in m._optimizer.state_dict().items()}
+    assert snap["global_step"] == 3
+    m.fit(data, batch_size=2, epochs=1, shuffle=False, verbose=0,
+          num_iters=2)                                # donated steps go on
+
+    m2 = _tiny_fit_model(seed=77)                     # fresh everything
+    assert m2.load_latest(d) == os.path.join(d, "mid")
+    sd2 = m2._optimizer.state_dict()
+    assert sd2["global_step"] == 3
+    assert sd2["LR_Scheduler"]["last_epoch"] == snap["LR_Scheduler"][
+        "last_epoch"]
+    for k, v in snap.items():
+        if isinstance(v, np.ndarray):
+            np.testing.assert_array_equal(
+                sd2[k].numpy(), v,
+                err_msg=f"accumulator {k} did not restore exactly")
+    # the rebuilt TrainStep must SEED from those accumulators, not zeros
+    m2.train_batch(
+        [pt.to_tensor(np.zeros((2, 4), "f4"))],
+        [pt.to_tensor(np.zeros((2, 3), "f4"))])
+    st = m2._train_step
+    name = next(iter(st.opt_state))
+    assert float(np.abs(np.asarray(
+        st.opt_state[name]["moment1"])).sum()) >= 0   # structure intact
+    # step counter continued: 3 snapshot + 1 new step
+    assert m2._optimizer._global_step == 3
+    assert st._step_i == 4
+
+
+def test_trainstep_seeds_opt_state_from_restored_accumulators():
+    m = _tiny_fit_model()
+    m.fit(_tiny_data(), batch_size=2, epochs=1, shuffle=False, verbose=0,
+          num_iters=3)
+    sd = m._optimizer.state_dict()
+    m2 = _tiny_fit_model(seed=88)
+    m2._optimizer.set_state_dict(sd)
+    from paddle_tpu.jit import TrainStep
+    st = TrainStep(m2.network, m2._loss_fn, m2._optimizer)
+    named = dict(m2.network.named_parameters())
+    for name in st.opt_state:
+        want = m2._optimizer._accumulators[id(named[name])]
+        for slot, arr in st.opt_state[name].items():
+            np.testing.assert_array_equal(np.asarray(arr),
+                                          np.asarray(want[slot]))
+            assert np.abs(np.asarray(arr)).sum() > 0   # not zeros
+
+
+# ---------------------------------------------------------------------------
+# satellite: resume bookkeeping in fit — journal + batch attribution
+# ---------------------------------------------------------------------------
+
+def test_fit_resume_journals_event_and_batch_indices(tmp_path):
+    d = str(tmp_path)
+    m = _tiny_fit_model()
+    rec1 = fr.FlightRecorder(None)
+    data = _tiny_data()
+    monkey = chaos.ChaosMonkey([chaos.Fault(chaos.TRAIN_STEP, times=(3,))])
+    with pytest.raises(chaos.ChaosError):
+        with chaos.active(monkey):
+            m.fit(data, batch_size=2, epochs=2, shuffle=False, verbose=0,
+                  flight_recorder=rec1, save_dir=d, save_steps=1)
+    prior_id = rec1.run_id
+    assert prior_id
+    before = telemetry.value("train_resumes_total", default=0)
+
+    m2 = _tiny_fit_model(seed=77)
+    assert m2.load_latest(d) is not None
+    rec2 = fr.FlightRecorder(None)
+    m2.fit(data, batch_size=2, epochs=2, shuffle=False, verbose=0,
+           flight_recorder=rec2, resume=True)
+    events = rec2.events()
+    res = [e for e in events if e["ev"] == "resume"]
+    assert len(res) == 1
+    assert res[0]["prior_run_id"] == prior_id
+    assert res[0]["step"] == 2 and res[0]["epoch"] == 0 \
+        and res[0]["batch"] == 2
+    assert telemetry.value("train_resumes_total", default=0) - before == 1
+    # resume event rides right after run_start
+    kinds = [e["ev"] for e in events]
+    assert kinds.index("resume") == kinds.index("run_start") + 1
+    # step events carry the epoch-relative batch index the cursor uses:
+    # resumed epoch 0 continues at batch 2, epoch 1 restarts at 0
+    steps = [e for e in events if e["ev"] == "step"]
+    assert [e["batch"] for e in steps] == [2, 3, 0, 1, 2, 3]
+    assert [e["step"] for e in steps] == [3, 4, 5, 6, 7, 8]
+
+
+def test_dataloader_iter_from_seeks_and_preserves_rng():
+    ds = TensorDataset([np.arange(40).reshape(20, 2).astype("f4")])
+    np.random.seed(42)
+    loader = DataLoader(ds, batch_size=2, shuffle=True)
+    full = [b[0].numpy() for b in loader]
+    after_full = np.random.randint(1 << 30)
+    np.random.seed(42)
+    loader2 = DataLoader(ds, batch_size=2, shuffle=True)
+    tail = [b[0].numpy() for b in loader2.iter_from(3)]
+    after_seek = np.random.randint(1 << 30)
+    assert len(tail) == len(full) - 3
+    for a, b in zip(tail, full[3:]):
+        np.testing.assert_array_equal(a, b)
+    # the skipped batches' sampler draws still happened: the global
+    # numpy RNG sits at the same point either way
+    assert after_seek == after_full
+
+
+# ---------------------------------------------------------------------------
+# LR scheduler state round-trips (nested + None fields)
+# ---------------------------------------------------------------------------
+
+def test_linear_warmup_nested_scheduler_roundtrip():
+    from paddle_tpu.optimizer.lr import LinearWarmup, CosineAnnealingDecay
+
+    def make():
+        return LinearWarmup(CosineAnnealingDecay(0.1, T_max=10),
+                            warmup_steps=5, start_lr=0.0, end_lr=0.1)
+
+    a = make()
+    for _ in range(8):
+        a.step()
+    sd = a.state_dict()
+    assert "_wrapped_sched" in sd
+    b = make()
+    b.set_state_dict(sd)
+    assert isinstance(b.lr_sched, CosineAnnealingDecay)   # not a dict
+    for _ in range(5):
+        a.step()
+        b.step()
+        assert a() == b()
+
+
+def test_reduce_on_plateau_roundtrip_includes_none_best():
+    from paddle_tpu.optimizer.lr import ReduceOnPlateau
+    a = ReduceOnPlateau(0.1, patience=1)
+    sd0 = a.state_dict()
+    assert "best" in sd0 and sd0["best"] is None
+    a.step(metrics=1.0)
+    a.step(metrics=2.0)
+    b = ReduceOnPlateau(0.1, patience=1)
+    b.best = 123.0                       # stale state a restore must clear
+    b.set_state_dict(a.state_dict())
+    assert b.best == a.best and b.num_bad_epochs == a.num_bad_epochs
+    b.set_state_dict(sd0)
+    assert b.best is None
+
+
+# ---------------------------------------------------------------------------
+# training watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_detects_stall_and_journals_hang():
+    rec = fr.FlightRecorder(None)
+    rec.run_start(mode="wd-test")
+    before = telemetry.value("train_watchdog_stalls_total", default=0)
+    wd = resume.TrainWatchdog(min_stall_s=0.05, poll_s=0.01,
+                              recorder=rec).start()
+    try:
+        wd.beat(step_s=0.001, step=7)
+        deadline = time.time() + 5.0
+        while wd.stalls == 0 and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        wd.stop()
+    assert wd.stalls == 1                 # one episode, not one per poll
+    assert telemetry.value("train_watchdog_stalls_total",
+                           default=0) - before == 1
+    hangs = [e for e in rec.events() if e["ev"] == "hang"]
+    assert len(hangs) == 1
+    ev = hangs[0]
+    assert ev["action"] == "observe" and ev["step"] == 7
+    assert ev["age_s"] >= 0.05 and ev["threshold_s"] >= 0.05
+    assert ev["stacks"] and any("test_resume" in s or "sleep" in s
+                                for s in ev["stacks"].values())
+
+
+def test_watchdog_beat_resets_episode():
+    wd = resume.TrainWatchdog(min_stall_s=0.04, poll_s=0.01,
+                              recorder=fr.FlightRecorder(None)).start()
+    try:
+        for _ in range(2):
+            wd.beat(step_s=0.01)
+            deadline = time.time() + 5.0
+            stalls = wd.stalls
+            while wd.stalls == stalls and time.time() < deadline:
+                time.sleep(0.01)
+    finally:
+        wd.stop()
+    assert wd.stalls == 2
+
+
+def test_fit_watchdog_bool_semantics(monkeypatch):
+    """`watchdog=False` is explicitly OFF (no monitor constructed, no
+    thread); `watchdog=True` means defaults."""
+    from paddle_tpu.utils import resume as resume_mod
+    built = []
+
+    class Tracking(resume_mod.TrainWatchdog):
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            built.append(self)
+
+    monkeypatch.setattr(resume_mod, "TrainWatchdog", Tracking)
+    m = _tiny_fit_model()
+    m.fit(_tiny_data(), batch_size=2, epochs=1, shuffle=False, verbose=0,
+          watchdog=False)
+    assert built == []
+    m.fit(_tiny_data(), batch_size=2, epochs=1, shuffle=False, verbose=0,
+          watchdog=True)
+    assert len(built) == 1 and built[0].min_stall_s == 5.0
+    assert not built[0]._thread                  # stopped by fit
+
+
+def test_watchdog_catches_chaos_delayed_train_step():
+    """The integration path: a chaos-delayed step inside fit stalls the
+    loop past the watchdog threshold — the journal shows the `hang`
+    next to the `chaos` event that provoked it, and training still
+    completes."""
+    m = _tiny_fit_model()
+    rec = fr.FlightRecorder(None)
+    monkey = chaos.ChaosMonkey([chaos.Fault(
+        chaos.TRAIN_STEP, action="delay", delay_s=0.5, times=(2,))])
+    with chaos.active(monkey):
+        m.fit(_tiny_data(), batch_size=2, epochs=1, shuffle=False,
+              verbose=0, flight_recorder=rec,
+              watchdog={"min_stall_s": 0.1, "poll_s": 0.02})
+    assert monkey.fired
+    events = rec.events()
+    kinds = {e["ev"] for e in events}
+    assert "hang" in kinds and "chaos" in kinds
+    # the run recovered: all 4 steps journaled, clean run_end
+    assert sum(1 for e in events if e["ev"] == "step") == 4
+    assert events[-1]["ev"] == "run_end" and events[-1]["status"] == "ok"
+    # watchdog was stopped by fit
+    assert m._watchdog is None
