@@ -34,7 +34,8 @@ from .scheduler import Scheduler
 from .request import Request, RequestState
 from .metrics import ServingMetrics
 from .paged import BlockPool, BlockPoolExhausted, PagedServingEngine
+from .fleet import FleetRequest, FleetRouter
 
 __all__ = ["ServingEngine", "Scheduler", "Request", "RequestState",
            "ServingMetrics", "BlockPool", "BlockPoolExhausted",
-           "PagedServingEngine"]
+           "PagedServingEngine", "FleetRouter", "FleetRequest"]

@@ -154,6 +154,10 @@ class ServingEngine:
         # them; dense engines never starve
         self.last_starved_slots = []
         self.health_state = "ok"
+        # the scheduler attaches its queue-depth probe here so /healthz
+        # carries real load state (a router or LB reads ONE endpoint
+        # instead of scraping /metrics); 0 until a scheduler attaches
+        self._queue_depth_fn = None
 
         self._jit = bool(jit_compile)
         self._metrics_server = None
@@ -261,6 +265,14 @@ class ServingEngine:
             self._metrics_server.stop()
             self._metrics_server = None
 
+    def attach_queue_probe(self, fn):
+        """Register a zero-arg queue-depth callable (the Scheduler's) —
+        folded into /healthz so load balancers and the fleet router get
+        queue state without a /metrics scrape. The newest scheduler
+        wins (benches build a fresh Scheduler per load point over one
+        engine)."""
+        self._queue_depth_fn = fn
+
     def set_health_state(self, state):
         """ok | degraded | draining — the scheduler flips this so
         /healthz reports REAL engine state (a degraded engine must not
@@ -271,10 +283,12 @@ class ServingEngine:
         self.health_state = state
 
     def _health(self):
+        qfn = self._queue_depth_fn
         return {
             "status": self.health_state,
             "num_slots": self.num_slots,
             "slots_active": len(self.active_slots()),
+            "queue_depth": int(qfn()) if qfn is not None else 0,
             "max_len": self.max_len,
             "decode_compiles": self.decode_compiles,
             "prefill_compiles": self.prefill_compiles,

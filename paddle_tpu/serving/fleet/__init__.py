@@ -1,0 +1,30 @@
+"""paddle_tpu.serving.fleet — multi-replica serving with
+prefix-affinity routing, token-exact failover, and elastic scale.
+
+One `PagedServingEngine` is chaos-proven but still a single point of
+failure; the fleet is the layer that makes `serving/` a SERVICE. A
+`FleetRouter` fronts N replicas:
+
+    from paddle_tpu.serving import fleet
+    router = fleet.FleetRouter(lambda: PagedServingEngine(model, ...),
+                               replicas=3)
+    req = router.submit(prompt=[1, 2, 3], max_tokens=32)
+    router.run()                     # drives every replica's wave loop
+    req.output_tokens
+
+Routing keys off the prefix cache the paged engine already maintains
+(the BlockPool's sha256 chain hashes over full prompt blocks), so a
+shared-system-prompt cohort lands where its K/V blocks already live;
+a killed or degraded replica's in-flight requests are resubmitted
+(prompt + tokens so far) and finish token-identically on a survivor
+(proven by `scripts/chaos_serving.py --scenarios replica_failover`);
+and the rotation grows/shrinks against live queue-depth telemetry with
+digest-verified warm starts. See docs/serving.md "Serving fleet".
+"""
+from .metrics import FleetMetrics
+from .migration import FleetRequest
+from .replica import Replica, ReplicaSupervisor, state_digest
+from .router import FleetRouter
+
+__all__ = ["FleetRouter", "FleetRequest", "FleetMetrics", "Replica",
+           "ReplicaSupervisor", "state_digest"]

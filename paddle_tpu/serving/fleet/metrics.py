@@ -1,0 +1,134 @@
+"""Fleet metrics: replica states, routing decisions, migrations and
+restarts.
+
+Same two-sink discipline as serving/metrics.py: the typed process-wide
+registry (docs/observability.md catalogs the names below) feeds
+/metrics, while a `FleetMetrics` instance aggregates per-router tallies
+for bench rows (`scripts/bench_serving.py --replicas` serializes
+`snapshot()` per load point).
+"""
+import threading
+
+from ...utils import flight_recorder, telemetry
+
+_REPLICAS = telemetry.gauge(
+    "fleet_replicas", "Replicas in the router's rotation by state",
+    labelnames=("state",))
+_MIGRATIONS = telemetry.counter(
+    "fleet_migrations_total",
+    "In-flight requests resubmitted (prompt + tokens generated so far) "
+    "from a dead or degraded replica to a healthy one — token-exact for "
+    "greedy requests (the preemption-by-recompute contract)")
+_ROUTED = telemetry.counter(
+    "fleet_routed_total",
+    "Requests routed by decision policy: affinity (prefix-cache blocks "
+    "matched on the chosen replica), least_loaded (no replica held the "
+    "prefix), or round_robin (A/B baseline policy)",
+    labelnames=("policy",))
+_RESTARTS = telemetry.counter(
+    "fleet_replica_restarts_total",
+    "Replacement replicas spawned after a kill/degradation (warm start: "
+    "weights digest-checked against the fleet's reference state)")
+_DISPATCH_RETRIES = telemetry.counter(
+    "fleet_dispatch_retries_total",
+    "Dispatch attempts rerouted to the next candidate replica after a "
+    "dispatch fault or a replica-side rejection — an accepted request "
+    "is never lost to a single bad hand-off")
+
+
+class FleetMetrics:
+    """Per-router aggregation (the process-wide counters keep
+    accumulating for /metrics; a fresh router — or a bench load point
+    via `FleetRouter.reset_metrics()` — gets fresh tallies)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._routed = {}            # policy -> count
+        self._migrations = 0
+        self._restarts = 0
+        self._dispatch_retries = 0
+        self._rejected = 0
+        self._kills = 0
+        self._scale_ups = 0
+        self._scale_downs = 0
+
+    # ---------------------------------------------------------- recording
+    def on_routed(self, policy):
+        _ROUTED.labels(policy=policy).inc()
+        with self._lock:
+            self._routed[policy] = self._routed.get(policy, 0) + 1
+
+    def on_migration(self, request_id=None, src=None, dst=None):
+        _MIGRATIONS.inc()
+        with self._lock:
+            self._migrations += 1
+        rec = flight_recorder.get_recorder()
+        if rec is not None:
+            rec.fault(kind="replica_migration", action="resubmitted",
+                      request_id=request_id,
+                      error=f"replica {src} -> {dst}")
+
+    def on_restart(self):
+        _RESTARTS.inc()
+        with self._lock:
+            self._restarts += 1
+
+    def on_dispatch_retry(self):
+        _DISPATCH_RETRIES.inc()
+        with self._lock:
+            self._dispatch_retries += 1
+
+    def on_rejected(self):
+        """One request refused fleet-wide. Counted HERE, once per
+        request — the per-replica serving counters tick once per
+        candidate walked, so summing them across the rotation would
+        inflate the shed count by up to the replica count."""
+        with self._lock:
+            self._rejected += 1
+
+    def on_kill(self):
+        with self._lock:
+            self._kills += 1
+
+    def on_scale(self, direction):
+        with self._lock:
+            if direction == "up":
+                self._scale_ups += 1
+            else:
+                self._scale_downs += 1
+
+    def publish_states(self, replicas, dead_total=0):
+        """Export the rotation's state census (called once per fleet
+        step). Every known state is set — including back to 0 — so a
+        replica leaving a state is visible, not sticky. Dead replicas
+        leave the rotation at retirement, so the `dead` series carries
+        the router's CUMULATIVE kill/degrade count instead (a census of
+        the rotation alone could never show a nonzero dead bucket)."""
+        counts = {"ok": 0, "degraded": 0, "draining": 0,
+                  "dead": dead_total}
+        for r in replicas:
+            counts[r.state] = counts.get(r.state, 0) + 1
+        for state, n in counts.items():
+            _REPLICAS.labels(state=state).set(n)
+
+    # ---------------------------------------------------------- reporting
+    def snapshot(self):
+        """Router-level tallies for bench rows: routing mix + affinity
+        hit rate, migrations, restarts, rebalance (scale) events."""
+        with self._lock:
+            routed = dict(self._routed)
+            total = sum(routed.values())
+            return {
+                "routed": routed,
+                "routed_total": total,
+                "affinity_hit_rate": (routed.get("affinity", 0) / total
+                                      if total else None),
+                "migrations": self._migrations,
+                "rejected": self._rejected,
+                "replica_kills": self._kills,
+                "replica_restarts": self._restarts,
+                "dispatch_retries": self._dispatch_retries,
+                "rebalances": self._scale_ups + self._scale_downs,
+                "scale_ups": self._scale_ups,
+                "scale_downs": self._scale_downs,
+            }

@@ -1,0 +1,164 @@
+"""Token-exact request migration.
+
+A `FleetRequest` is the client's handle for the life of one generation
+REQUEST, across however many replicas end up serving it. Each hop is an
+ordinary replica-local `serving.Request`; when a replica dies (killed
+or degraded) the fleet absorbs the tokens that hop already streamed and
+resubmits the CONTINUATION — original prompt + every token generated so
+far — to a healthy replica. That is exactly the preemption-by-recompute
+discipline the paged scheduler already proves token-exact: the
+continuation's re-prefill recomputes K/V for the full prefix (mostly
+prefix-cache hits when the blocks survived), and its frontier logits
+produce the NEXT token of the same greedy trajectory, because every
+replica serves digest-verified identical weights (replica.py).
+
+Greedy requests are therefore bitwise-identical to a no-fault run —
+the chaos harness's replica_failover scenario asserts it. Sampled
+requests (do_sample=True) migrate and complete too, but land on a
+different PRNG stream, so their tail is distribution-identical, not
+bit-identical; same caveat as preemption.
+"""
+import itertools
+import threading
+import time
+
+from ..request import RequestState
+
+#: migrations allowed per request before it resolves "error" — replicas
+#: dying faster than this is an outage, not a livelock worth chasing
+DEFAULT_MAX_MIGRATIONS = 3
+
+
+class FleetRequest:
+    """One generation request as the fleet sees it.
+
+    `output_tokens` is the stitched stream: tokens absorbed from dead
+    replicas followed by the live hop's tokens — the client never sees
+    the seam. `on_token` fires with (fleet_request, token) for every
+    token whichever replica produced it; exceptions stay contained
+    per-request by the underlying engine's callback guard.
+    """
+    _ids = itertools.count(1)
+
+    def __init__(self, prompt, max_tokens=16, eos_token_id=None,
+                 timeout=None, on_token=None, do_sample=False,
+                 temperature=1.0):
+        self.request_id = next(FleetRequest._ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_tokens = int(max_tokens)
+        self.eos_token_id = eos_token_id
+        self.timeout = None if timeout is None else float(timeout)
+        self.on_token = on_token
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+
+        self.submit_time = None      # stamped once, at fleet admission
+        self.migrations = 0
+        self.replica = None          # current Replica handle
+        self.current = None          # current replica-local Request
+        self._prior = []             # tokens from hops that died
+        self.finish_reason = None
+        self.error = None
+        self._done = threading.Event()
+        # orders _absorb's prior-extend/current-detach pair against a
+        # concurrent output_tokens read — without it a streaming client
+        # polling mid-migration sees the dead hop's tokens TWICE
+        self._tok_lock = threading.Lock()
+
+    # ------------------------------------------------------------- views
+    @property
+    def output_tokens(self):
+        with self._tok_lock:
+            cur = ([] if self.current is None
+                   else self.current.output_tokens)
+            return self._prior + cur
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    @property
+    def state(self):
+        if self.done:
+            return (RequestState.REJECTED
+                    if self.finish_reason == "rejected"
+                    else RequestState.DONE)
+        return (self.current.state if self.current is not None
+                else RequestState.QUEUED)
+
+    @property
+    def callback_error(self):
+        return (None if self.current is None
+                else self.current.callback_error)
+
+    def wait(self, timeout=None):
+        return self._done.wait(timeout)
+
+    @property
+    def latency(self):
+        if self.submit_time is None or not self.done:
+            return None
+        return self._finish_time - self.submit_time
+
+    # -------------------------------------------------- router internals
+    def _mark_submitted(self):
+        if self.submit_time is None:
+            self.submit_time = time.monotonic()
+
+    def _submit_kwargs(self):
+        """kwargs for the next hop's Scheduler.submit(): the
+        continuation prompt, the REMAINING token budget and wall-clock
+        budget, and the callback shimmed to this fleet handle."""
+        remaining_t = None
+        if self.timeout is not None:
+            elapsed = time.monotonic() - (self.submit_time or
+                                          time.monotonic())
+            remaining_t = max(1e-3, self.timeout - elapsed)
+        kw = {
+            "prompt": self.prompt + self._prior,
+            "max_tokens": self.max_tokens - len(self._prior),
+            "eos_token_id": self.eos_token_id,
+            "timeout": remaining_t,
+            "do_sample": self.do_sample,
+            "temperature": self.temperature,
+        }
+        if self.on_token is not None:
+            fleet_req = self
+
+            def shim(_req, token):
+                fleet_req.on_token(fleet_req, token)
+            kw["on_token"] = shim
+        return kw
+
+    def _absorb(self):
+        """A hop died: bank its clean tokens (every emitted token
+        precedes the fault — the non-finite sentinel freezes a lane
+        BEFORE a bad token reaches the host, and a kill harvests only
+        what was streamed) and detach from the dead Request."""
+        with self._tok_lock:
+            if self.current is not None:
+                self._prior.extend(self.current.output_tokens)
+            self.current = None
+            self.replica = None
+
+    def _attach(self, replica, request):
+        self.replica = replica
+        self.current = request
+
+    def _finalize(self, reason, error=None):
+        self.finish_reason = reason
+        if error is not None:
+            self.error = str(error)
+        self._finish_time = time.monotonic()
+        self._done.set()
+
+    def _finalize_from(self, request):
+        """Propagate a completed hop's resolution to the fleet handle
+        (the normal, no-fault path)."""
+        self._finalize(request.finish_reason, error=request.error)
+
+    def __repr__(self):
+        return (f"FleetRequest(id={self.request_id}, state={self.state}, "
+                f"generated={len(self.output_tokens)}/{self.max_tokens}, "
+                f"migrations={self.migrations}, "
+                f"finish={self.finish_reason})")

@@ -1,0 +1,158 @@
+"""Replica supervision: one serving engine + scheduler per replica,
+spawned from a factory and admitted into rotation only after a
+state-handoff digest check.
+
+The fleet's token-exact migration contract (a request killed mid-stream
+finishes elsewhere with bitwise-identical output) rests on every
+replica serving EXACTLY the same weights. The supervisor enforces it
+the way the exact-resume layer does for training checkpoints: a sha256
+digest over the engine's functional state, banked from the first
+replica and verified for every later spawn — a factory that drifted
+(different seed, stale checkpoint, half-updated weights) is refused at
+spawn, not discovered as token divergence in production.
+"""
+import hashlib
+
+import jax
+import numpy as np
+
+from ..scheduler import Scheduler
+
+
+def state_digest(engine):
+    """sha256 over the engine's functional state (params + buffers, in
+    pytree-leaf order — deterministic for one model structure). The
+    serving analog of the checkpoint manifest's per-file digests."""
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves((engine._params,
+                                           engine._buffers)):
+        arr = np.asarray(leaf)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+class Replica:
+    """One engine + scheduler in the fleet's rotation.
+
+    state: ok | degraded | draining | dead. `degraded` is adopted from
+    the scheduler (the engine's own resilience layer decides it); the
+    router reacts by replacing the replica. `dead` is terminal — a
+    killed replica's engine is never called again.
+    """
+
+    def __init__(self, replica_id, engine, scheduler_kwargs=None):
+        self.replica_id = int(replica_id)
+        self.engine = engine
+        self._scheduler_kwargs = dict(scheduler_kwargs or {})
+        self.scheduler = Scheduler(engine, **self._scheduler_kwargs)
+        self._killed = False
+
+    def renew_scheduler(self):
+        """Fresh Scheduler (fresh ServingMetrics) over the same warm
+        engine — the bench measures each load point separately. Only
+        valid idle: a replaced scheduler would strand accepted work."""
+        if self.scheduler.in_flight() or self.scheduler.queue_depth():
+            raise RuntimeError("renew_scheduler on a busy replica")
+        self.scheduler = Scheduler(self.engine, **self._scheduler_kwargs)
+
+    @property
+    def state(self):
+        if self._killed:
+            return "dead"
+        if self.scheduler.degraded:
+            return "degraded"
+        if self.scheduler.draining:
+            return "draining"
+        return "ok"
+
+    @property
+    def routable(self):
+        """May new work be routed here? Draining replicas finish what
+        they accepted but take nothing new."""
+        return self.state == "ok"
+
+    def load(self):
+        """Routing load score: requests in slots + waiting in queue."""
+        return self.scheduler.in_flight() + self.scheduler.queue_depth()
+
+    def health(self):
+        """The /healthz payload (status, queue_depth,
+        cache_blocks_used/total on a paged engine) — what the router
+        watches; an external LB reads the same dict over HTTP."""
+        h = self.engine._health()
+        h["replica_id"] = self.replica_id
+        if self._killed:
+            h["status"] = "dead"
+        return h
+
+    def affinity_hashes(self, hashes):
+        """Prefix-affinity score from precomputed chain hashes: cached
+        leading prompt blocks this replica could serve (0 on a dense
+        engine — no block pool). The router hashes a prompt ONCE per
+        admission and scores every replica by pool lookups (chain
+        hashes are content-only, so one prompt's walk is valid against
+        every pool)."""
+        pool = getattr(self.engine, "block_pool", None)
+        return 0 if pool is None else pool.peek_prefix_hashes(hashes)
+
+    def drain(self):
+        self.scheduler.drain()
+
+    def drained(self):
+        """True when a draining replica has resolved every accepted
+        request (safe to retire from rotation)."""
+        return (self.scheduler.in_flight() == 0
+                and self.scheduler.queue_depth() == 0)
+
+    def kill(self):
+        """Simulated crash: mark the replica dead, stop its exporter,
+        and return the accepted-but-unresolved requests it stranded
+        (informational — the router migrates from its own registry,
+        not from a dead replica's bookkeeping). Engine state is never
+        touched again — a real dead process has none."""
+        self._killed = True
+        harvested = self.scheduler.evacuate()
+        self.engine.stop_metrics_server()
+        return harvested
+
+    def __repr__(self):
+        return (f"Replica(id={self.replica_id}, state={self.state}, "
+                f"load={self.load() if not self._killed else '-'})")
+
+
+class ReplicaSupervisor:
+    """Owns replica lifecycle: spawn (with the digest handoff check),
+    replacement counting, and id allocation. The ROUTER decides *when*
+    to spawn/kill/drain; the supervisor guarantees *what* enters the
+    rotation is a faithful replica."""
+
+    def __init__(self, engine_factory, scheduler_kwargs=None,
+                 verify_state=True):
+        self.engine_factory = engine_factory
+        self.scheduler_kwargs = dict(scheduler_kwargs or {})
+        self.verify_state = bool(verify_state)
+        self.reference_digest = None
+        self._next_id = 0
+
+    def spawn(self):
+        """Build one replica. The first spawn banks the fleet's
+        reference state digest; every later spawn must match it (warm
+        replacement serves the SAME weights or it does not serve)."""
+        engine = self.engine_factory()
+        if self.verify_state:
+            digest = state_digest(engine)
+            if self.reference_digest is None:
+                self.reference_digest = digest
+            elif digest != self.reference_digest:
+                raise RuntimeError(
+                    "replica state-handoff mismatch: factory produced "
+                    f"weights with digest {digest[:12]}…, fleet "
+                    f"reference is {self.reference_digest[:12]}… — a "
+                    "replacement replica must serve identical state "
+                    "(token-exact migration depends on it)")
+        replica = Replica(self._next_id, engine,
+                          scheduler_kwargs=self.scheduler_kwargs)
+        self._next_id += 1
+        return replica

@@ -1,0 +1,490 @@
+"""FleetRouter: prefix-affinity routing, failover, and elastic scale
+over N serving-engine replicas.
+
+One engine is a single point of failure; the fleet turns `serving/`
+into a service. The router owns a rotation of `Replica`s (replica.py)
+and three behaviors:
+
+  * **Routing.** Every admission is scored against each replica's
+    prefix cache via the block pool's chain hashes (one
+    `BlockPool.prompt_hashes` walk scored with `peek_prefix_hashes`
+    per replica — the sha256 chain the paged engine already computes
+    over full prompt blocks IS the affinity key): a
+    shared-system-prompt cohort lands on the replica that already
+    holds its K/V blocks, so the fleet-wide prefix-hit rate approaches
+    the single-engine rate instead of dividing by N. No replica holds
+    the prefix → least-loaded; `policy="round_robin"` is the A/B
+    baseline the bench compares against.
+  * **Failover.** The router watches each replica's real health (the
+    same ok/degraded/draining states /healthz reports, plus queue
+    depth and `cache_blocks_used`) and treats a dead or degraded
+    replica as a REPLACEMENT event: its accepted requests are
+    evacuated with the tokens they already streamed and resubmitted
+    token-exactly elsewhere (migration.py), and a digest-verified
+    replacement is spawned into the rotation. Chaos points
+    `fleet.replica_kill` / `fleet.router_dispatch` make both paths
+    provable on demand (scripts/chaos_serving.py replica_failover).
+  * **Elastic scale.** Offered load is read off live telemetry (queue
+    depth per routable replica): sustained pressure spawns a replica
+    (warm start — the factory's weights must match the fleet's
+    reference digest), sustained idleness drains the newest one and
+    retires it once its accepted work finishes. Accepted work is never
+    dropped by scaling in either direction.
+
+Thread-model: `submit()` is safe from producer threads; `step()` —
+one round across every replica — runs wherever `run()` is driven, same
+as the single-engine Scheduler.
+"""
+import threading
+
+from ...utils import chaos, flight_recorder
+from .metrics import FleetMetrics
+from .migration import DEFAULT_MAX_MIGRATIONS, FleetRequest
+from .replica import ReplicaSupervisor
+
+POLICIES = ("affinity", "least_loaded", "round_robin")
+
+
+class FleetRouter:
+    """Router + supervisor loop over N replicas.
+
+    engine_factory: zero-arg callable building one serving engine
+        (replicas may share one model instance — each engine owns its
+        caches/pool; the supervisor digest-checks the weights).
+    replicas: initial rotation size (also the replacement target).
+    policy: "affinity" (default) | "least_loaded" | "round_robin".
+    migrate: False disables failover migration — a killed replica's
+        in-flight requests then resolve "error" (the chaos harness's
+        no-migration positive control).
+    min_replicas/max_replicas + scale_up_queue_depth: elastic range;
+        scale_up_queue_depth=None disables autoscaling.
+    """
+
+    def __init__(self, engine_factory, replicas=2, policy="affinity",
+                 scheduler_kwargs=None, migrate=True,
+                 max_migrations=DEFAULT_MAX_MIGRATIONS,
+                 min_replicas=None, max_replicas=None,
+                 scale_up_queue_depth=None, scale_down_idle_rounds=8,
+                 auto_replace=True, verify_state=True):
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, "
+                             f"got {policy!r}")
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.policy = policy
+        self.migrate = bool(migrate)
+        self.max_migrations = int(max_migrations)
+        self.auto_replace = bool(auto_replace)
+        self.min_replicas = int(min_replicas or 1)
+        self.max_replicas = int(max_replicas or max(replicas, 1))
+        self.scale_up_queue_depth = scale_up_queue_depth
+        self.scale_down_idle_rounds = int(scale_down_idle_rounds)
+        self.supervisor = ReplicaSupervisor(
+            engine_factory, scheduler_kwargs=scheduler_kwargs,
+            verify_state=verify_state)
+        self.metrics = FleetMetrics()
+        self._lock = threading.Lock()        # rotation + live-request set
+        # one fleet round at a time; REENTRANT so kill_replica — which
+        # step() itself drives on the REPLICA_KILL chaos point — can
+        # also serialize an operator/watch-loop thread's kill against
+        # the round in progress (finalization reads fr.current twice)
+        self._step_lock = threading.RLock()
+        self.replicas = [self.supervisor.spawn() for _ in range(replicas)]
+        self._live = []                      # unresolved FleetRequests
+        self._retired_metric_snaps = []      # final snapshots of the dead
+        self._dead_total = 0                 # replicas killed/degraded
+        self._target = int(replicas)         # replacement/scale target
+        self._rr = 0
+        self._idle_rounds = 0
+
+    # ---------------------------------------------------------- admission
+    def submit(self, request=None, **kw):
+        """Route one request (kwargs as serving.Request: prompt,
+        max_tokens, eos_token_id, timeout, on_token, do_sample,
+        temperature). Returns a FleetRequest; raises ValueError when no
+        replica accepts it (every replica shedding is the fleet-level
+        admission-control signal)."""
+        if request is None:
+            request = FleetRequest(**kw)
+        request._mark_submitted()
+        # live BEFORE dispatch: _retire_replica scans _live for a dead
+        # replica's work, and a request attached concurrently with the
+        # retirement must be visible to that scan or it is never
+        # migrated (producer threads submit while step() retires)
+        with self._lock:
+            self._live.append(request)
+        try:
+            self._dispatch(request)          # raises on total refusal
+        except ValueError:
+            with self._lock:
+                if request in self._live:
+                    self._live.remove(request)
+            raise
+        return request
+
+    def _route(self, prompt):
+        """Candidate replicas in preference order + the policy label
+        that placed the head choice. Affinity scores count the leading
+        full prompt blocks each replica's pool could serve from cache;
+        ties (and score 0) fall back to least-loaded."""
+        with self._lock:
+            live = [r for r in self.replicas if r.routable]
+            if self.policy == "round_robin" and live:
+                start = self._rr % len(live)   # read-modify-write under
+                self._rr += 1                  # the lock: submit() is
+        if not live:                           # producer-thread safe
+            raise RuntimeError("fleet has no routable replicas")
+        if self.policy == "round_robin":
+            return live[start:] + live[:start], "round_robin"
+        order = sorted(live, key=lambda r: (r.load(), r.replica_id))
+        policy = "least_loaded"
+        if self.policy == "affinity":
+            # hash the prompt ONCE: the chain hashes are content-only,
+            # so one prompt_hashes() walk scores every replica's pool
+            # by lookups instead of N sha256 chains per admission
+            pool = next((p for p in (getattr(r.engine, "block_pool",
+                                             None) for r in live)
+                         if p is not None), None)
+            if pool is not None:
+                hashes = pool.prompt_hashes(prompt)
+                score = {r.replica_id: r.affinity_hashes(hashes)
+                         for r in live}
+                if max(score.values()) > 0:
+                    order = sorted(live, key=lambda r: (
+                        -score[r.replica_id], r.load(), r.replica_id))
+                    policy = "affinity"
+        return order, policy
+
+    def _dispatch(self, fr, continuation=False):
+        """Hand `fr` to the best replica, walking the candidate order
+        on failure: a dispatch fault (the ROUTER_DISPATCH chaos point
+        stands in for a crashed/unreachable replica) or a replica-side
+        shed moves to the next candidate — an accepted request is never
+        lost to one bad hand-off. Total refusal resolves the request
+        ("rejected" fresh, "error" for a migrating continuation) and
+        raises ValueError for fresh submits."""
+        kw = fr._submit_kwargs()
+        try:
+            candidates, policy = self._route(kw["prompt"])
+        except RuntimeError as e:
+            fr._finalize("error" if continuation else "rejected", error=e)
+            if not continuation:
+                self.metrics.on_rejected()
+                raise ValueError(str(e))
+            return
+        last_err = None
+        for i, replica in enumerate(candidates):
+            if i:
+                self.metrics.on_dispatch_retry()
+            try:
+                if chaos.enabled():
+                    chaos.fire(chaos.ROUTER_DISPATCH,
+                               replica=replica.replica_id,
+                               request_id=fr.request_id)
+                req = replica.scheduler.submit(**kw)
+            except Exception as e:   # noqa: BLE001 — dispatch fault
+                last_err = e         # barrier: next candidate takes it
+                continue
+            with self._lock:
+                fr._attach(replica, req)
+                # the replica may have been retired between _route and
+                # submit — its kill() already harvested the scheduler,
+                # and _retire_replica's owned scan may have run before
+                # the attach, so this hop is ours to fail over
+                lost = replica not in self.replicas
+            self.metrics.on_routed(policy)
+            if lost:
+                self._migrate(fr, reason="retired mid-dispatch",
+                              src=replica)
+            return
+        why = f"no replica accepted the request ({last_err!r})"
+        fr._finalize("error" if continuation else "rejected", error=why)
+        if not continuation:
+            self.metrics.on_rejected()
+            raise ValueError(why)
+
+    # ---------------------------------------------------------- the loop
+    def step(self):
+        """One fleet round: honor any injected replica kill, drive one
+        scheduling round on every live replica, replace the dead and
+        degraded (migrating their work), finalize completions, and
+        autoscale. Returns the number of unresolved fleet requests."""
+        with self._step_lock:
+            if chaos.enabled():
+                hit = chaos.value(chaos.REPLICA_KILL)
+                if hit is not None:
+                    with self._lock:
+                        live = [r for r in self.replicas
+                                if r.state != "dead"]
+                    if live:
+                        self.kill_replica(live[int(hit) % len(live)])
+            for replica in self._rotation():
+                if replica.state == "dead":
+                    continue
+                replica.scheduler.step()
+                if replica.scheduler.degraded:
+                    self._retire_replica(replica, reason="degraded")
+            self._finalize_completed()
+            self._autoscale()
+            with self._lock:
+                self.metrics.publish_states(self.replicas,
+                                            dead_total=self._dead_total)
+        return self.outstanding()
+
+    def run(self, max_rounds=None):
+        """Drive step() until every accepted request resolves (or
+        max_rounds). Producer threads may keep submit()ing."""
+        rounds = 0
+        while self.step():
+            rounds += 1
+            if max_rounds is not None and rounds >= max_rounds:
+                break
+        return rounds
+
+    def generate(self, prompt, **kw):
+        """Blocking single-request convenience (mirrors
+        Scheduler.generate)."""
+        fr = self.submit(prompt=prompt, **kw)
+        while not fr.done:
+            self.step()
+        return fr.output_tokens
+
+    def _rotation(self):
+        with self._lock:
+            return list(self.replicas)
+
+    def outstanding(self):
+        with self._lock:
+            return len(self._live)
+
+    # ----------------------------------------------------------- failover
+    def kill_replica(self, replica, reason="killed"):
+        """Kill one replica (chaos, an operator, or the watch loop) and
+        fail its work over: replacement spawned first so migration has
+        a routable target even in a one-replica fleet. Safe from any
+        thread — serializes with the fleet round in progress."""
+        with self._step_lock:
+            if self._retire_replica(replica, reason=reason):
+                self.metrics.on_kill()   # count only kills that retired
+                                         # something (stale handles no-op)
+
+    def _retire_replica(self, replica, reason):
+        """Returns True when `replica` was actually retired here (False:
+        already gone — a second kill on a stale handle is a no-op)."""
+        with self._lock:
+            if replica not in self.replicas:
+                return False
+            self.replicas.remove(replica)
+            self._dead_total += 1
+        replica.kill()
+        with self._lock:
+            # its completed work must stay in fleet-wide rollups
+            # (bench rows would silently undercount otherwise)
+            self._retired_metric_snaps.append(
+                replica.scheduler.metrics.snapshot())
+        rec = flight_recorder.get_recorder()
+        if rec is not None:
+            rec.fault(kind="replica_" + reason, action="replace",
+                      error=f"replica {replica.replica_id}")
+        if self.auto_replace:
+            with self._lock:
+                short = sum(1 for r in self.replicas
+                            if r.routable) < self._target
+            if short:
+                try:
+                    self._spawn(restart=True)
+                except Exception as e:  # noqa: BLE001 — failover must
+                    # still migrate the dead replica's work even when
+                    # the replacement cannot be built (digest mismatch,
+                    # allocation failure): survivors take it, or total
+                    # refusal resolves it 'error' — never stranded
+                    if rec is not None:
+                        rec.fault(kind="replica_spawn_failed",
+                                  action="continue",
+                                  error=f"{type(e).__name__}: {e}")
+        with self._lock:
+            owned = [fr for fr in self._live if fr.replica is replica]
+        for fr in owned:
+            cur = fr.current
+            if cur is not None and cur.done and \
+                    cur.finish_reason not in ("error", "rejected"):
+                self._finalize_one(fr)   # finished before the fault
+            else:
+                self._migrate(fr, reason=reason, src=replica)
+        return True
+
+    def _migrate(self, fr, reason, src=None):
+        """Resubmit one evacuated request's continuation (prompt +
+        tokens generated so far) to a healthy replica — token-exact for
+        greedy requests (migration.py). Budget-bounded; a continuation
+        at the cache horizon finishes "length" exactly as it would have
+        on the original replica. `src` makes the call idempotent per
+        hop: the retire scan and a racing dispatch may both see the
+        same dead hop, and whoever detaches it first wins."""
+        with self._lock:
+            if src is not None and fr.replica is not src:
+                return               # this hop was already failed over
+            src_id = (None if fr.replica is None
+                      else fr.replica.replica_id)
+            cur = fr.current
+            fr._absorb()             # detach atomically with the check
+        if cur is not None and not cur.done:
+            cur._fail(f"replica {src_id} {reason}")
+        if not self.migrate:
+            self._finalize_one(fr, forced=(
+                "error", f"replica {src_id} {reason}; migration disabled"))
+            return
+        fr.migrations += 1
+        if fr.migrations > self.max_migrations:
+            self._finalize_one(fr, forced=(
+                "error", f"migration budget spent ({self.max_migrations}x)"))
+            return
+        if len(fr._prior) >= fr.max_tokens:
+            self._finalize_one(fr, forced=("max_tokens", None))
+            return
+        if self._continuation_refused(fr.prompt + fr._prior) is not None:
+            # the continuation cannot be re-admitted ANYWHERE in this
+            # fleet — the cache horizon, or on a dense fleet the prefill
+            # bucket (re-prefill cannot exceed it even though the dead
+            # replica was already past prefill): deliver the tokens
+            # generated so far, terminated "length", not "error"
+            self._finalize_one(fr, forced=("length", None))
+            return
+        self._dispatch(fr, continuation=True)
+        if fr.replica is not None:
+            self.metrics.on_migration(request_id=fr.request_id,
+                                      src=src_id,
+                                      dst=fr.replica.replica_id)
+        else:                        # total refusal: _dispatch resolved it
+            with self._lock:
+                if fr in self._live:
+                    self._live.remove(fr)
+
+    def _continuation_refused(self, cont_prompt):
+        """Engine-level admissibility of a migrated continuation — the
+        ENGINE owns its admission rules (dense prefill bucket, paged
+        horizon/pool capacity), so ask one live engine rather than
+        re-deriving them here; the fleet is homogeneous (one factory).
+        None = admissible (or nothing alive to ask — dispatch resolves
+        that case)."""
+        with self._lock:
+            for r in self.replicas:
+                if r.state != "dead":
+                    return r.engine.validate_prompt(cont_prompt)
+        return None
+
+    # -------------------------------------------------------- completions
+    def _finalize_one(self, fr, forced=None):
+        if forced is not None:
+            fr._finalize(forced[0], error=forced[1])
+        else:
+            fr._finalize_from(fr.current)
+        with self._lock:
+            if fr in self._live:
+                self._live.remove(fr)
+
+    def _finalize_completed(self):
+        with self._lock:
+            done = [fr for fr in self._live
+                    if fr.current is not None and fr.current.done]
+        for fr in done:
+            self._finalize_one(fr)
+
+    # ----------------------------------------------------------- scaling
+    def _spawn(self, restart=False):
+        replica = self.supervisor.spawn()
+        with self._lock:
+            self.replicas.append(replica)
+        if restart:
+            self.metrics.on_restart()
+        return replica
+
+    def _autoscale(self):
+        """Elastic scale on live telemetry. Scale-up: sustained queue
+        pressure per routable replica. Scale-down: a fully idle fleet
+        for `scale_down_idle_rounds` consecutive rounds drains the
+        newest replica (accepted work still completes) and retires it
+        once empty. Replicas draining for scale-down leave the rotation
+        here; replicas draining by operator drain() do too."""
+        with self._lock:
+            drained = [r for r in self.replicas
+                       if r.state == "draining" and r.drained()]
+            for r in drained:
+                self.replicas.remove(r)
+                self._retired_metric_snaps.append(
+                    r.scheduler.metrics.snapshot())
+        for r in drained:
+            r.engine.stop_metrics_server()
+        if self.scale_up_queue_depth is None:
+            return
+        with self._lock:
+            live = [r for r in self.replicas if r.routable]
+        if not live:
+            return
+        queued = sum(r.scheduler.queue_depth() for r in live)
+        busy = sum(r.load() for r in live)
+        if queued / len(live) > self.scale_up_queue_depth \
+                and len(live) < self.max_replicas:
+            self._target = len(live) + 1
+            self._spawn()
+            self.metrics.on_scale("up")
+            self._idle_rounds = 0
+        elif busy == 0 and len(live) > self.min_replicas:
+            self._idle_rounds += 1
+            if self._idle_rounds >= self.scale_down_idle_rounds:
+                victim = max(live, key=lambda r: r.replica_id)
+                victim.drain()
+                self._target = len(live) - 1
+                self.metrics.on_scale("down")
+                self._idle_rounds = 0
+        else:
+            self._idle_rounds = 0
+
+    # ------------------------------------------------------------- admin
+    def health(self):
+        """Fleet-level health view: per-replica /healthz payloads plus
+        the rotation summary (what an external dashboard polls)."""
+        with self._lock:
+            reps = list(self.replicas)
+        return {
+            "replicas": [r.health() for r in reps],
+            "routable": sum(1 for r in reps if r.routable),
+            "target_replicas": self._target,
+            "policy": self.policy,
+        }
+
+    def drain(self):
+        """Stop admitting fleet-wide; accepted work runs to completion
+        (drive run() until it returns 0)."""
+        for r in self._rotation():
+            if r.state in ("ok", "draining"):
+                r.drain()
+
+    def shutdown(self, max_rounds=None):
+        """drain() + drive to empty + stop every replica's exporter."""
+        self.drain()
+        rounds = self.run(max_rounds=max_rounds)
+        for r in self._rotation():
+            r.engine.stop_metrics_server()
+        return rounds
+
+    def reset_metrics(self):
+        """Fresh fleet + per-replica tallies (the bench builds one
+        fleet and measures each load point separately). Only valid on
+        an idle fleet — a new Scheduler per replica would strand
+        in-flight work."""
+        if self.outstanding():
+            raise RuntimeError("reset_metrics on a non-idle fleet")
+        self.metrics = FleetMetrics()
+        with self._lock:
+            self._retired_metric_snaps = []
+        for r in self._rotation():
+            r.renew_scheduler()
+
+    def retired_metric_snapshots(self):
+        """Final ServingMetrics snapshots of replicas retired (killed,
+        degraded-replaced, or drained away) since the last
+        reset_metrics() — a fleet-wide rollup must include the work
+        they completed before leaving the rotation."""
+        with self._lock:
+            return list(self._retired_metric_snaps)

@@ -232,9 +232,10 @@ class ServingMetrics:
         with self._lock:
             active, total = self._active_slot_waves, self._total_slot_waves
             tokens = self._tokens
-            span = (None if self._first_token_time is None
-                    or self._last_token_time is None
-                    else self._last_token_time - self._first_token_time)
+            first_t, last_t = (self._first_token_time,
+                               self._last_token_time)
+            span = (None if first_t is None or last_t is None
+                    else last_t - first_t)
             queue_peak = self._queue_peak
             faults = dict(self._faults)
             rejected, wave_retries = self._rejected, self._wave_retries
@@ -270,4 +271,10 @@ class ServingMetrics:
             "prefix_misses": p_misses,
             "prefix_hit_rate": (p_hits / (p_hits + p_misses)
                                 if p_hits + p_misses else None),
+            # fleet PR: raw span endpoints (monotonic clock), so a
+            # multi-replica rollup can compute the FLEET's first-to-
+            # last-token span (max(last) - min(first)) and keep its
+            # tokens/s denominator comparable with single-engine rows
+            "first_token_time": first_t,
+            "last_token_time": last_t,
         }

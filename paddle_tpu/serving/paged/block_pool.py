@@ -186,6 +186,20 @@ class BlockPool:
         self._publish()
         return blocks, hashes
 
+    def peek_prefix_hashes(self, hashes):
+        """READ-ONLY affinity probe over a precomputed chain-hash walk
+        (`prompt_hashes`): how many LEADING hashes this pool holds
+        right now. Takes no references, counts no hits, publishes
+        nothing — the fleet router scores every replica per admission
+        with this, and a probe that mutated refcounts or the hit rate
+        would corrupt both (`match_prefix` is the acquiring variant)."""
+        n = 0
+        for h in hashes:
+            if h not in self._hash_to_block:
+                break
+            n += 1
+        return n
+
     def count_prefix(self, hits, misses):
         """Count one admitted prompt's prefix-cache outcome (hits =
         full blocks served from cache, misses = full blocks prefill

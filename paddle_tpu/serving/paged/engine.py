@@ -346,10 +346,13 @@ class PagedServingEngine(ServingEngine):
         self._tables[slot, :] = 0
 
     def _health(self):
+        # cache_blocks_used/total mirror the gauges of the same name:
+        # the fleet router (and any LB) reads pool pressure from ONE
+        # /healthz fetch instead of scraping /metrics
         h = super()._health()
         h.update(block_size=self.block_size,
-                 blocks_used=self.block_pool.used,
-                 blocks_total=self.block_pool.usable,
+                 cache_blocks_used=self.block_pool.used,
+                 cache_blocks_total=self.block_pool.usable,
                  prefix_cache_hits=self.block_pool.prefix_hits,
                  prefix_cache_misses=self.block_pool.prefix_misses)
         return h

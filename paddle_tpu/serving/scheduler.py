@@ -85,6 +85,9 @@ class Scheduler:
         self._degraded = False
         self.last_error = None
         self.metrics = ServingMetrics(engine.num_slots)
+        # /healthz carries this scheduler's queue depth (fleet routers
+        # and LBs read load + pool pressure from one endpoint)
+        engine.attach_queue_probe(self.queue_depth)
         pool = getattr(engine, "block_pool", None)
         if pool is not None:
             # seed the prefix-delta baseline with the pool's totals
@@ -368,6 +371,28 @@ class Scheduler:
             # request and pollute the latency histogram with a
             # queue-wait-only sample — the inspection ring still gets it
             self.completed.append(req)
+
+    def evacuate(self):
+        """Pull every accepted-but-unresolved request out of this
+        scheduler WITHOUT resolving it, and stop accepting work. The
+        fleet failover path calls this on a replica presumed DEAD, so
+        no engine call is made here. The router migrates from its OWN
+        live-request registry (serving/fleet/router.py scans _live —
+        it must not trust a dead replica's bookkeeping); the returned
+        list (in-slot first, then queued) is informational: operators
+        and tests can see exactly what a kill stranded."""
+        with self._wave_lock:          # never mid-round: whole rounds
+            with self._lock:           # interleave with the evacuation
+                self._degraded = True  # step() idles; submit() sheds
+                if self.last_error is None:
+                    self.last_error = "replica evacuated"
+                queued = list(self._queue)
+                self._queue.clear()
+            out = [req for req in self._slot_req if req is not None]
+            self._slot_req = [None] * self.engine.num_slots
+            out.extend(queued)
+        self.metrics.on_queue_depth(0)
+        return out
 
     def step(self):
         """One scheduling round: refill free slots from the queue, run

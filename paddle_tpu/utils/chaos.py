@@ -88,10 +88,19 @@ COLLECTIVE = "distributed.collective"
 #: parity harness's positive controls arm this ("rng" dropped must
 #: make the kill/resume parity check fail)
 TRAIN_STATE = "resume.capture"
+#: payload: rotation index of a fleet replica to KILL before this fleet
+#: step (serving/fleet router loop) — the replica is marked dead, its
+#: accepted requests are evacuated and must finish token-identically on
+#: a surviving replica (scripts/chaos_serving.py replica_failover)
+REPLICA_KILL = "fleet.replica_kill"
+#: raise/delay before the router hands a request to its chosen
+#: replica's scheduler — a dispatch crash must reroute to the next
+#: candidate, never lose the accepted request
+ROUTER_DISPATCH = "fleet.router_dispatch"
 
 POINTS = (DECODE_WAVE, DECODE_WAVE_NAN, PREFILL, CALLBACK,
           CHECKPOINT_WRITE, CACHE_ALLOC, TRAIN_STEP, DATA_LOAD,
-          COLLECTIVE, TRAIN_STATE)
+          COLLECTIVE, TRAIN_STATE, REPLICA_KILL, ROUTER_DISPATCH)
 
 ACTIONS = ("raise", "delay", "payload")
 

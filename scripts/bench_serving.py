@@ -31,8 +31,8 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import paddle_tpu as pt
-from paddle_tpu.serving import (PagedServingEngine, Scheduler,
-                                ServingEngine)
+from paddle_tpu.serving import (FleetRouter, PagedServingEngine,
+                                Scheduler, ServingEngine)
 from paddle_tpu.utils import profiler, telemetry
 
 t0 = time.time()
@@ -108,6 +108,110 @@ def run_load(sched, load_rps, n_requests, vocab, prompt_range,
     return snap
 
 
+def _agg(snaps, key, how):
+    vals = [s[key] for s in snaps if s.get(key) is not None]
+    if not vals:
+        return None
+    return how(vals)
+
+
+def fleet_snapshot(router, reqs, wall):
+    """One load point's fleet-wide view: per-replica serving snapshots
+    summed where additive (tokens, prefix hits, faults), worst-case
+    where they are percentiles, plus the router's own tallies
+    (affinity hit rate, migrations, rebalances)."""
+    # retired replicas (killed, degraded-replaced, drained away) did
+    # real work this load point — the rollup must include it
+    snaps = ([r.scheduler.metrics.snapshot() for r in router.replicas]
+             + router.retired_metric_snapshots())
+    rs = router.metrics.snapshot()
+    faults = {}
+    for s in snaps:
+        for k, n in s["faults"].items():
+            faults[k] = faults.get(k, 0) + n
+    hits = _agg(snaps, "prefix_hits", sum) or 0
+    misses = _agg(snaps, "prefix_misses", sum) or 0
+    completed = _agg(snaps, "requests_completed", sum) or 0
+    tokens = _agg(snaps, "tokens_generated", sum) or 0
+    # same denominator as the single-engine rows: first-to-last-token
+    # span (fleet-wide: min(first) to max(last)), NOT wall time — wall
+    # includes Poisson inter-arrival idle, which would deflate fleet
+    # tokens/s vs the dense/paged rows it is compared against
+    first = _agg(snaps, "first_token_time", min)
+    last = _agg(snaps, "last_token_time", max)
+    span = (last - first) if first is not None and last is not None \
+        else None
+    out = {
+        "requests_completed": completed,
+        "tokens_generated": tokens,
+        "tokens_per_s": (tokens / span if span else None),
+        # worst replica's percentile: the fleet's service level is its
+        # slowest member's, not an average that hides a hot replica
+        "ttft_p50_s": _agg(snaps, "ttft_p50_s", max),
+        "ttft_p99_s": _agg(snaps, "ttft_p99_s", max),
+        "latency_p50_s": _agg(snaps, "latency_p50_s", max),
+        "latency_p99_s": _agg(snaps, "latency_p99_s", max),
+        "slot_occupancy": _agg(
+            snaps, "slot_occupancy", lambda v: sum(v) / len(v)),
+        "queue_depth_peak": _agg(snaps, "queue_depth_peak", max),
+        # router-level: one refusal per REQUEST (summing the replica
+        # counters would count every candidate the dispatch walked)
+        "rejected": rs["rejected"],
+        "faults": faults,
+        "wave_retries": _agg(snaps, "wave_retries", sum) or 0,
+        "block_utilization": _agg(
+            snaps, "block_utilization", lambda v: sum(v) / len(v)),
+        "prefix_hits": hits,
+        "prefix_misses": misses,
+        "prefix_hit_rate": (hits / (hits + misses)
+                            if hits + misses else None),
+        "prefix_hits_per_request": (hits / completed if completed
+                                    else None),
+        "wall_s": wall,
+        "n_requests": len(reqs),
+        "router": rs,
+        "replicas_final": len(router.replicas),
+    }
+    return out
+
+
+def run_load_fleet(router, load_rps, n_requests, vocab, prompt_range,
+                   output_range, seed, shared_prefix=()):
+    """Fleet analog of run_load: Poisson submits against the router
+    from a producer thread while this thread drives every replica's
+    wave loop through router.step()."""
+    rng = np.random.RandomState(seed)
+    shared_prefix = list(shared_prefix)
+    reqs, done_submitting = [], threading.Event()
+
+    def producer():
+        for _ in range(n_requests):
+            time.sleep(rng.exponential(1.0 / load_rps))
+            p = shared_prefix + rng.randint(
+                0, vocab, (rng.randint(*prompt_range),)).tolist()
+            try:
+                reqs.append(router.submit(
+                    prompt=p, max_tokens=int(rng.randint(*output_range))))
+            except ValueError:
+                pass        # shed fleet-wide — counted by the replicas
+        done_submitting.set()
+
+    th = threading.Thread(target=producer, daemon=True)
+    t_start = time.time()
+    th.start()
+    while True:
+        pending = router.step()
+        if pending == 0:
+            if done_submitting.is_set() and router.outstanding() == 0:
+                break
+            time.sleep(0.001)
+    th.join()
+    wall = time.time() - t_start
+    snap = fleet_snapshot(router, reqs, wall)
+    snap["offered_load_rps"] = load_rps
+    return snap
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--family", default="gpt", choices=["gpt", "llama"])
@@ -141,6 +245,24 @@ def main():
                          "oversubscribed sweep preempts on purpose; "
                          "each cycle nets tokens, so a higher budget "
                          "just trades latency, never livelock)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="serve through a FleetRouter over N replica "
+                         "engines (serving/fleet): per-row router stats "
+                         "— affinity hit rate, migrations, rebalances — "
+                         "roll up into the output JSON")
+    ap.add_argument("--router-policy", default="affinity",
+                    choices=["affinity", "least_loaded", "round_robin"],
+                    help="fleet routing policy (round_robin is the A/B "
+                         "baseline: with --shared-prefix, affinity "
+                         "should show strictly higher prefix hits per "
+                         "request)")
+    ap.add_argument("--max-replicas", type=int, default=None,
+                    help="fleet: autoscale ceiling (default --replicas "
+                         "= no scale-up)")
+    ap.add_argument("--scale-up-queue-depth", type=float, default=None,
+                    help="fleet: queued requests per routable replica "
+                         "that trigger a scale-up (default: autoscale "
+                         "disabled)")
     ap.add_argument("--shared-prefix", type=int, default=0,
                     help="prepend this many fixed tokens to every "
                          "prompt (shared system prompt) — with --paged "
@@ -165,28 +287,52 @@ def main():
     model, _cfg = build_model(args.family, args.hidden, args.layers,
                               args.heads, args.vocab, args.max_len,
                               args.bf16)
+
+    def make_engine():
+        if args.paged:
+            return PagedServingEngine(model, num_slots=args.slots,
+                                      max_len=args.max_len,
+                                      block_size=args.block_size,
+                                      num_blocks=args.num_blocks,
+                                      prefill_chunk_len=args.prefill_len)
+        return ServingEngine(model, num_slots=args.slots,
+                             max_len=args.max_len,
+                             prefill_len=args.prefill_len)
+
+    router = None
+    if args.replicas is not None:
+        router = FleetRouter(
+            make_engine, replicas=args.replicas,
+            policy=args.router_policy,
+            max_replicas=args.max_replicas or args.replicas,
+            scale_up_queue_depth=args.scale_up_queue_depth,
+            scheduler_kwargs={"max_queue": args.max_queue,
+                              "max_preemptions": args.max_preemptions})
+        engine = router.replicas[0].engine
+        log(f"fleet up: {args.replicas} replicas, "
+            f"policy={args.router_policy}"
+            + (f", autoscale to {args.max_replicas}"
+               if args.scale_up_queue_depth is not None else ""))
+    else:
+        engine = make_engine()
     if args.paged:
-        engine = PagedServingEngine(model, num_slots=args.slots,
-                                    max_len=args.max_len,
-                                    block_size=args.block_size,
-                                    num_blocks=args.num_blocks,
-                                    prefill_chunk_len=args.prefill_len)
         log(f"paged pool: {engine.block_pool.usable} usable blocks x "
             f"{engine.block_size} tokens (dense equivalent would be "
             f"{args.slots * args.max_len // args.block_size})")
-    else:
-        engine = ServingEngine(model, num_slots=args.slots,
-                               max_len=args.max_len,
-                               prefill_len=args.prefill_len)
 
     if args.metrics_port is not None:
         srv = engine.start_metrics_server(port=args.metrics_port)
         log(f"metrics exporter live at {srv.url}/metrics "
             f"(and /healthz, /metrics.json)")
 
-    # warm the two programs so every load point measures execution only
-    sched = Scheduler(engine)
-    sched.generate([1, 2, 3], max_tokens=4)
+    # warm the programs so every load point measures execution only
+    if router is not None:
+        for rep in router.replicas:
+            Scheduler(rep.engine).generate([1, 2, 3], max_tokens=4)
+        router.reset_metrics()        # warmup schedulers replaced too
+    else:
+        sched = Scheduler(engine)
+        sched.generate([1, 2, 3], max_tokens=4)
     log(f"warmup done (decode compiles={engine.decode_compiles}, "
         f"prefill compiles={engine.prefill_compiles})")
 
@@ -200,16 +346,37 @@ def main():
 
     rows = []
     kind = "paged" if args.paged else "dense"
+    if router is not None:
+        kind = (f"fleet[{args.replicas}x{kind}:"
+                f"{args.router_policy}]")
     for i, load in enumerate(float(x) for x in args.loads.split(",")):
-        # fresh metrics per load point
-        sched = Scheduler(engine, max_queue=args.max_queue,
-                          max_preemptions=args.max_preemptions)
         out_hi = max(5, min(64, args.max_len - args.prefill_len))
-        snap = run_load(sched, load, args.requests, args.vocab,
-                        prompt_range=(4, args.prefill_len),
-                        output_range=(4, out_hi), seed=100 + i,
-                        shared_prefix=shared_prefix)
-        assert engine.decode_compiles <= 1, "decode step recompiled"
+        if router is not None:
+            router.reset_metrics()           # fresh tallies per point
+            snap = run_load_fleet(router, load, args.requests,
+                                  args.vocab,
+                                  prompt_range=(4, args.prefill_len),
+                                  output_range=(4, out_hi), seed=100 + i,
+                                  shared_prefix=shared_prefix)
+        else:
+            # fresh metrics per load point
+            sched = Scheduler(engine, max_queue=args.max_queue,
+                              max_preemptions=args.max_preemptions)
+            snap = run_load(sched, load, args.requests, args.vocab,
+                            prompt_range=(4, args.prefill_len),
+                            output_range=(4, out_hi), seed=100 + i,
+                            shared_prefix=shared_prefix)
+        if router is not None:
+            # a degraded replica may have been replaced mid-sweep:
+            # compile-once must hold on every engine in the CURRENT
+            # rotation, and the paged detail row below must read a
+            # live pool, not the retired replica 0's
+            engines = [rep.engine for rep in router.replicas]
+            assert all(e.decode_compiles <= 1 for e in engines), \
+                "decode step recompiled"
+            engine = engines[0]
+        else:
+            assert engine.decode_compiles <= 1, "decode step recompiled"
         row = {
             "metric": f"serving {args.family} {kind} tokens/s "
                       f"@{load:g}req/s x{args.slots}slots",
@@ -251,6 +418,27 @@ def main():
                                                4)),
                 "shared_prefix_len": args.shared_prefix,
             })
+        if router is not None:
+            # router stats per load point: the affinity-vs-round_robin
+            # A/B reads straight off prefix_hits_per_request across
+            # two sweeps with different --router-policy
+            rs = snap["router"]
+            row["detail"].update({
+                "replicas": args.replicas,
+                "replicas_final": snap["replicas_final"],
+                "router_policy": args.router_policy,
+                "routed": rs["routed"],
+                "affinity_hit_rate": (
+                    None if rs["affinity_hit_rate"] is None
+                    else round(rs["affinity_hit_rate"], 4)),
+                "migrations": rs["migrations"],
+                "rebalances": rs["rebalances"],
+                "replica_restarts": rs["replica_restarts"],
+                "dispatch_retries": rs["dispatch_retries"],
+                "prefix_hits_per_request": (
+                    None if snap["prefix_hits_per_request"] is None
+                    else round(snap["prefix_hits_per_request"], 4)),
+            })
         rows.append(row)
         print(json.dumps(row), flush=True)
 
@@ -278,8 +466,14 @@ def main():
     # tallies ride each row's detail): future load benches show where
     # shedding sets in and whether any fault path fired under load
     resilience = {
-        "rejected_total": telemetry.value("serving_rejected_total",
-                                          default=0),
+        # fleet runs: per-row router-level counts (one per REQUEST) —
+        # the process-wide serving counter ticks once per candidate
+        # replica the dispatch walked, inflating by up to the replica
+        # count and contradicting the rows in the same file
+        "rejected_total": (sum(r["detail"]["rejected"] for r in rows)
+                           if router is not None else
+                           telemetry.value("serving_rejected_total",
+                                           default=0)),
         "wave_retries_total": telemetry.value("serving_wave_retries_total",
                                               default=0),
         "callback_errors_total": telemetry.value(
@@ -293,6 +487,8 @@ def main():
                    "resilience": resilience,
                    "telemetry": telemetry.snapshot()}, f, indent=1)
     log(f"wrote {args.out}")
+    if router is not None:
+        router.shutdown()
     engine.stop_metrics_server()
 
 

@@ -7,15 +7,18 @@ arms a deterministic `utils.chaos` fault, runs a request stream, and
 checks the engine RECOVERED — poisoned slot isolated (healthy slots
 token-identical to a fault-free run), transient wave error retried
 within budget, failed prefill contained, callback exception counted,
-queue overflow shed, drain graceful, checkpoint crash survivable — all
-with the decode wave still compiled exactly once. `--inject` proves the
-runner itself: it disables one resilience property and must exit 1.
+queue overflow shed, drain graceful, checkpoint crash survivable, a
+KILLED FLEET REPLICA's in-flight requests finished token-identically
+on a survivor (replica_failover), a router dispatch fault rerouted —
+all with the decode wave still compiled exactly once. `--inject`
+proves the runner itself: it disables one resilience property and must
+exit 1.
 
     python scripts/chaos_serving.py                   # all scenarios
     python scripts/chaos_serving.py --smoke           # tier-1 entry
-    python scripts/chaos_serving.py --scenarios nan_slot,wave_error
+    python scripts/chaos_serving.py --scenario replica_failover
     python scripts/chaos_serving.py --inject drop-isolation   # exit 1
-    python scripts/chaos_serving.py --inject no-retry         # exit 1
+    python scripts/chaos_serving.py --inject no-migration     # exit 1
     python scripts/chaos_serving.py --json --journal chaos.jsonl
 
 Exit codes: 0 every invariant holds, 1 violated invariant, 2 internal
@@ -52,16 +55,26 @@ MAX_TOKENS = 6
 _CACHE = {}
 
 
-def get_engine():
-    """One engine per process (scenarios reset its health; compile-once
-    across ALL of them is itself the final invariant)."""
-    if "engine" not in _CACHE:
+def get_model():
+    """One canonical tiny LLaMA per process — every engine (dense,
+    paged, and each fleet replica) serves the same weights, so the
+    persistent cache shares compiles and fleet migration's
+    identical-weights precondition holds by construction."""
+    if "model" not in _CACHE:
         from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
         pt.seed(7)
         cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
                           num_layers=LAYERS, num_heads=HEADS,
                           num_kv_heads=KV_HEADS, max_seq_len=MAX_LEN)
-        engine = ServingEngine(LlamaForCausalLM(cfg), num_slots=SLOTS,
+        _CACHE["model"] = LlamaForCausalLM(cfg)
+    return _CACHE["model"]
+
+
+def get_engine():
+    """One engine per process (scenarios reset its health; compile-once
+    across ALL of them is itself the final invariant)."""
+    if "engine" not in _CACHE:
+        engine = ServingEngine(get_model(), num_slots=SLOTS,
                                max_len=MAX_LEN, prefill_len=PREFILL_LEN)
         Scheduler(engine).generate([1, 2, 3], max_tokens=2)   # warm
         _CACHE["engine"] = engine
@@ -75,18 +88,19 @@ def get_paged_engine():
     canonical model scale as tests/test_serving_paged.py, so tier-1
     shares one persistent-cache compile of the paged programs."""
     if "paged_engine" not in _CACHE:
-        from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
-        from paddle_tpu.serving import PagedServingEngine
-        pt.seed(7)
-        cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
-                          num_layers=LAYERS, num_heads=HEADS,
-                          num_kv_heads=KV_HEADS, max_seq_len=MAX_LEN)
-        engine = PagedServingEngine(
-            LlamaForCausalLM(cfg), num_slots=SLOTS, max_len=MAX_LEN,
-            block_size=8, num_blocks=33, prefill_chunk_len=PREFILL_LEN)
+        engine = _paged_factory()
         Scheduler(engine).generate([1, 2, 3], max_tokens=2)   # warm
         _CACHE["paged_engine"] = engine
     return _CACHE["paged_engine"]
+
+
+def _paged_factory():
+    """Fleet replica factory: the canonical paged engine shape over the
+    shared model (each replica owns its caches/block pool)."""
+    from paddle_tpu.serving import PagedServingEngine
+    return PagedServingEngine(
+        get_model(), num_slots=SLOTS, max_len=MAX_LEN,
+        block_size=8, num_blocks=33, prefill_chunk_len=PREFILL_LEN)
 
 
 def _prompts(n=SLOTS):
@@ -350,16 +364,9 @@ def scenario_cache_exhaustion(engine, inject):
     resolves 'error' and the completes-via-requeue invariant must catch
     it."""
     v = []
-    paged = get_paged_engine()
-    for s in paged.active_slots():
-        paged.retire_slot(s)
-    paged.set_health_state("ok")
     prompts = _prompts()
-    key = ("paged_ref", tuple(tuple(p) for p in prompts))
-    if key not in _CACHE:
-        _, ref_reqs = _run_stream(paged, prompts)
-        _CACHE[key] = [r.output_tokens for r in ref_reqs]
-    ref = _CACHE[key]
+    ref = _paged_reference(prompts)
+    paged = get_paged_engine()
     action = "raise" if inject == "alloc-crash" else "payload"
     # invocation 2: the FIRST admission holds blocks, so the second
     # admission's exhaustion has in-flight work to wait behind
@@ -387,6 +394,96 @@ def scenario_cache_exhaustion(engine, inject):
     return v
 
 
+def _paged_reference(prompts):
+    """Fault-free greedy outputs from ONE paged engine — the fleet must
+    match these bitwise whatever the routing/failover did (identical
+    weights + greedy decode = engine-count-independent trajectory)."""
+    paged = get_paged_engine()
+    for s in paged.active_slots():
+        paged.retire_slot(s)
+    paged.set_health_state("ok")
+    key = ("paged_ref", tuple(tuple(p) for p in prompts))
+    if key not in _CACHE:
+        _, ref_reqs = _run_stream(paged, prompts)
+        _CACHE[key] = [r.output_tokens for r in ref_reqs]
+    return _CACHE[key]
+
+
+def scenario_replica_failover(engine, inject):
+    """THE fleet proof: a replica killed mid-stream has every accepted
+    request finish on a surviving replica with output bitwise-equal to
+    the no-fault run — in-flight work is resubmitted as prompt + tokens
+    generated so far (the preemption-by-recompute discipline, across
+    engines) — a digest-verified replacement joins the rotation, and
+    each surviving replica's decode wave stays compiled once.
+    --inject no-migration disables failover, so the killed replica's
+    in-flight requests resolve 'error' and the token-identity check
+    must fail."""
+    from paddle_tpu.serving import fleet
+    v = []
+    prompts = _prompts(6)
+    ref = _paged_reference(prompts)
+    router = fleet.FleetRouter(_paged_factory, replicas=2,
+                               migrate=(inject != "no-migration"))
+    reqs = [router.submit(prompt=p, max_tokens=MAX_TOKENS)
+            for p in prompts]
+    # fleet-step invocation 2: requests are dispatched and the first
+    # wave ran, so the victim holds live mid-stream work
+    monkey = chaos.ChaosMonkey([chaos.Fault(
+        chaos.REPLICA_KILL, action="payload", payload=0, times=(2,))])
+    with chaos.active(monkey):
+        router.run()
+    snap = router.metrics.snapshot()
+    _check(v, monkey.fired, "replica_kill injection never fired")
+    _check(v, snap["replica_kills"] == 1, "kill not recorded")
+    for i, r in enumerate(reqs):
+        _check(v, r.finish_reason == "max_tokens",
+               f"request {i} resolved {r.finish_reason!r} — a killed "
+               "replica's accepted work must complete via migration")
+        _check(v, r.output_tokens == ref[i],
+               f"request {i} output diverged from the no-fault run "
+               "after migration")
+    _check(v, snap["migrations"] >= 1,
+           "fleet_migrations_total did not move")
+    _check(v, snap["replica_restarts"] == 1,
+           f"expected 1 digest-verified replacement, got "
+           f"{snap['replica_restarts']}")
+    _check(v, router.health()["routable"] == 2,
+           "replacement replica did not rejoin the rotation")
+    for rep in router.replicas:
+        _check(v, rep.engine.decode_compiles <= 1,
+               f"replica {rep.replica_id} decode wave recompiled under "
+               "failover")
+    router.shutdown()
+    return v
+
+
+def scenario_router_dispatch(engine, inject):
+    """A dispatch fault (crashed/unreachable replica at hand-off time)
+    must reroute the request to the next candidate — accepted work is
+    never lost to one bad hand-off — with outputs untouched."""
+    from paddle_tpu.serving import fleet
+    v = []
+    prompts = _prompts(4)
+    ref = _paged_reference(prompts)
+    router = fleet.FleetRouter(_paged_factory, replicas=2)
+    monkey = chaos.ChaosMonkey([chaos.Fault(chaos.ROUTER_DISPATCH,
+                                            times=(1, 3))])
+    with chaos.active(monkey):
+        reqs = [router.submit(prompt=p, max_tokens=MAX_TOKENS)
+                for p in prompts]
+        router.run()
+    snap = router.metrics.snapshot()
+    _check(v, len(monkey.fired) == 2, "dispatch injection never fired")
+    _check(v, snap["dispatch_retries"] >= 2,
+           "fleet_dispatch_retries_total did not move")
+    for i, r in enumerate(reqs):
+        _check(v, r.output_tokens == ref[i],
+               f"request {i} lost or diverged after a dispatch fault")
+    router.shutdown()
+    return v
+
+
 SCENARIOS = {
     "nan_slot": scenario_nan_slot,
     "wave_error": scenario_wave_error,
@@ -396,20 +493,23 @@ SCENARIOS = {
     "overflow_shed": scenario_overflow_shed,
     "drain": scenario_drain,
     "cache_exhaustion": scenario_cache_exhaustion,
+    "replica_failover": scenario_replica_failover,
+    "router_dispatch": scenario_router_dispatch,
     "ckpt_crash": scenario_ckpt_crash,
 }
 
 # positive controls: each disables one resilience property inside its
 # scenario; the run MUST exit 1 (tests/test_chaos.py asserts it)
 INJECTIONS = {"drop-isolation": "nan_slot", "no-retry": "wave_error",
-              "alloc-crash": "cache_exhaustion"}
+              "alloc-crash": "cache_exhaustion",
+              "no-migration": "replica_failover"}
 
 
 def run(argv=None):
     ap = argparse.ArgumentParser(
         prog="chaos_serving",
         description="chaos scenarios over the serving resilience layer")
-    ap.add_argument("--scenarios", default=None,
+    ap.add_argument("--scenarios", "--scenario", default=None,
                     help=f"comma-separated subset of "
                          f"{','.join(SCENARIOS)}")
     ap.add_argument("--smoke", action="store_true",

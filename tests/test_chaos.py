@@ -72,6 +72,22 @@ def test_inject_alloc_crash_exits_1(chaos_serving, capsys):
     assert "requeue" in capsys.readouterr().out
 
 
+def test_inject_no_migration_exits_1(chaos_serving, capsys):
+    """Positive control for the fleet: disabling failover migration
+    strands the killed replica's in-flight requests as 'error' — the
+    completes-token-identically-elsewhere invariant must catch it."""
+    assert chaos_serving.run(["--inject", "no-migration"]) == 1
+    assert "migration" in capsys.readouterr().out
+
+
+def test_replica_failover_scenario_clean(chaos_serving, capsys):
+    """The fleet headline: a replica killed mid-stream has every
+    accepted request finish on a survivor with output bitwise-equal to
+    the no-fault run, a replacement joins, compile-once per replica."""
+    assert chaos_serving.run(["--scenario", "replica_failover"]) == 0
+    assert "FAIL" not in capsys.readouterr().out
+
+
 def test_cache_exhaustion_scenario_clean(chaos_serving, capsys):
     """The real property: injected pool exhaustion at admission queues
     the request behind in-flight work — every request completes with

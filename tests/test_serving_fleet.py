@@ -1,0 +1,308 @@
+"""serving/fleet — prefix-affinity router, token-exact failover,
+elastic scale (ISSUE 11 acceptance).
+
+Canonical tiny LLaMA scale (2 layers, hidden 64, the shape every
+serving suite compiles) so warm runs hit the persistent cache; all
+replicas share ONE model instance — each engine owns its caches and
+block pool, and the supervisor's digest check holds by construction.
+
+The contract under test:
+
+  * a fleet run is TOKEN-IDENTICAL to a single paged engine, routing
+    and all — and stays identical when a replica is killed mid-stream
+    and its in-flight requests migrate (prompt + tokens so far) to a
+    survivor;
+  * prefix-affinity routing lands a shared-system-prompt cohort on the
+    replica already holding its blocks: strictly more prefix-cache
+    hits than round-robin on the same workload;
+  * the rotation scales up under queue pressure and back down when
+    idle, never dropping accepted work; a replacement with different
+    weights is REFUSED at spawn (state-handoff digest).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import PagedServingEngine, Scheduler, fleet
+from paddle_tpu.utils import chaos
+
+VOCAB = 128
+MAX_LEN = 64
+BLOCK = 8
+CHUNK = 16
+MAX_NEW = 6
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(7)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=MAX_LEN)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def factory(model):
+    def make():
+        return PagedServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                                  block_size=BLOCK, num_blocks=33,
+                                  prefill_chunk_len=CHUNK)
+    return make
+
+
+@pytest.fixture(scope="module")
+def reference(factory):
+    """Fault-free greedy outputs from ONE engine — the fleet must match
+    bitwise whatever routing/failover does."""
+    engine = factory()
+
+    def ref(prompts, max_tokens=MAX_NEW):
+        return [Scheduler(engine).generate(p, max_tokens=max_tokens)
+                for p in prompts]
+    return ref
+
+
+def _prompts(n, seed=100):
+    return [np.random.RandomState(seed + i)
+            .randint(0, VOCAB, (4 + i % 3,)).tolist() for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# routing + no-fault parity
+# ---------------------------------------------------------------------------
+
+def test_fleet_stream_token_identical_to_single_engine(factory,
+                                                       reference):
+    prompts = _prompts(8)
+    want = reference(prompts)
+    router = fleet.FleetRouter(factory, replicas=2)
+    reqs = [router.submit(prompt=p, max_tokens=MAX_NEW) for p in prompts]
+    router.run()
+    assert [r.output_tokens for r in reqs] == want
+    assert all(r.finish_reason == "max_tokens" for r in reqs)
+    snap = router.metrics.snapshot()
+    assert snap["routed_total"] == 8
+    assert snap["migrations"] == 0
+    # requests actually spread over both replicas, compile-once each
+    for rep in router.replicas:
+        assert rep.scheduler.metrics.snapshot()["requests_completed"] > 0
+        assert rep.engine.decode_compiles == 1
+    router.shutdown()
+
+
+def test_affinity_routes_cohort_where_its_blocks_live(factory):
+    """A shared-prefix cohort: after the first request warms one
+    replica's prefix cache, every later cohort member routes to THAT
+    replica by chain-hash affinity and re-hits its blocks."""
+    rng = np.random.RandomState(9)
+    prefix = rng.randint(0, VOCAB, (2 * BLOCK,)).tolist()
+    router = fleet.FleetRouter(factory, replicas=2)
+    first = router.submit(prompt=prefix + [3], max_tokens=2)
+    router.run()
+    home = first.replica
+    cohort = [router.submit(prompt=prefix + [7 + i], max_tokens=2)
+              for i in range(4)]
+    router.run()
+    assert all(r.replica is home for r in cohort)
+    assert router.metrics.snapshot()["routed"]["affinity"] == 4
+    assert home.engine.block_pool.prefix_hits >= 4 * 2   # 2 blocks each
+    router.shutdown()
+
+
+def test_affinity_beats_round_robin_on_shared_prefix(factory):
+    """The acceptance A/B: same shared-prefix workload, affinity policy
+    must produce strictly more prefix-cache hits than round-robin (the
+    cohort's blocks live on ONE replica; round-robin recomputes them on
+    every other replica it sprays)."""
+    rng = np.random.RandomState(11)
+    prefix = rng.randint(0, VOCAB, (3 * BLOCK,)).tolist()
+    jobs = [prefix + rng.randint(0, VOCAB, (2,)).tolist()
+            for _ in range(6)]
+    hits = {}
+    for policy in ("affinity", "round_robin"):
+        router = fleet.FleetRouter(factory, replicas=2, policy=policy)
+        for p in jobs:
+            router.submit(prompt=p, max_tokens=2)
+            router.run()         # sequential: every admission sees the
+        #                          previous one's registered blocks
+        hits[policy] = sum(r.engine.block_pool.prefix_hits
+                           for r in router.replicas)
+        router.shutdown()
+    assert hits["affinity"] > hits["round_robin"]
+
+
+# ---------------------------------------------------------------------------
+# failover
+# ---------------------------------------------------------------------------
+
+def test_replica_kill_midstream_migrates_token_exact(factory,
+                                                     reference):
+    """Kill a replica with live mid-stream work: its requests finish on
+    the survivor with bitwise-identical output, a digest-verified
+    replacement joins, and nothing is double-served."""
+    prompts = _prompts(6, seed=200)
+    want = reference(prompts, max_tokens=MAX_NEW)
+    router = fleet.FleetRouter(factory, replicas=2)
+    reqs = [router.submit(prompt=p, max_tokens=MAX_NEW) for p in prompts]
+    router.step()                       # admissions + first wave
+    victim = reqs[0].replica
+    assert victim.scheduler.in_flight() > 0     # genuinely mid-stream
+    router.kill_replica(victim)
+    assert victim.state == "dead"
+    router.run()
+    assert [r.output_tokens for r in reqs] == want
+    assert all(r.finish_reason == "max_tokens" for r in reqs)
+    snap = router.metrics.snapshot()
+    assert snap["migrations"] >= 1
+    assert snap["replica_kills"] == 1
+    assert snap["replica_restarts"] == 1
+    assert router.health()["routable"] == 2
+    migrated = [r for r in reqs if r.migrations]
+    assert migrated and all(r.replica is not victim for r in migrated)
+    router.shutdown()
+
+
+def test_migration_disabled_fails_killed_work_only(factory):
+    """The no-migration control at unit level: the killed replica's
+    accepted requests resolve 'error'; the survivor's complete."""
+    router = fleet.FleetRouter(factory, replicas=2, migrate=False)
+    reqs = [router.submit(prompt=p, max_tokens=MAX_NEW)
+            for p in _prompts(6, seed=300)]
+    router.step()
+    victim = reqs[0].replica
+    victim_reqs = [r for r in reqs if r.replica is victim]
+    other_reqs = [r for r in reqs if r.replica is not victim]
+    assert victim_reqs and other_reqs
+    router.kill_replica(victim)
+    router.run()
+    assert all(r.finish_reason == "error" for r in victim_reqs)
+    assert all(r.finish_reason == "max_tokens" for r in other_reqs)
+    router.shutdown()
+
+
+def test_degraded_replica_replaced_and_work_migrates(factory,
+                                                     reference):
+    """A replica whose engine degrades (here: a wedged decode wave with
+    a zeroed retry budget) is treated as a replacement event — the
+    router migrates its work token-exactly, same as a kill."""
+    prompts = _prompts(4, seed=400)
+    want = reference(prompts, max_tokens=MAX_NEW)
+    router = fleet.FleetRouter(
+        factory, replicas=2,
+        scheduler_kwargs={"wave_retries": 0, "retry_backoff_s": 0.001})
+    reqs = [router.submit(prompt=p, max_tokens=MAX_NEW) for p in prompts]
+    router.step()
+    victim = reqs[0].replica
+    monkey = chaos.ChaosMonkey([chaos.Fault(chaos.DECODE_WAVE,
+                                            times=(1,))])
+    with chaos.active(monkey):
+        victim.scheduler.step()         # wave raises -> degrades
+    assert victim.scheduler.degraded
+    router.run()
+    assert [r.output_tokens for r in reqs] == want
+    snap = router.metrics.snapshot()
+    assert snap["replica_restarts"] == 1
+    assert victim not in router.replicas
+    router.shutdown()
+
+
+def test_dispatch_fault_reroutes_not_loses(factory, reference):
+    """ROUTER_DISPATCH raise at hand-off: the request lands on the next
+    candidate replica and completes token-identically."""
+    prompts = _prompts(2, seed=500)
+    want = reference(prompts, max_tokens=MAX_NEW)
+    router = fleet.FleetRouter(factory, replicas=2)
+    monkey = chaos.ChaosMonkey([chaos.Fault(chaos.ROUTER_DISPATCH,
+                                            times=(1,))])
+    with chaos.active(monkey):
+        reqs = [router.submit(prompt=p, max_tokens=MAX_NEW)
+                for p in prompts]
+        router.run()
+    assert monkey.fired
+    assert [r.output_tokens for r in reqs] == want
+    assert router.metrics.snapshot()["dispatch_retries"] >= 1
+    router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# elastic scale + supervision
+# ---------------------------------------------------------------------------
+
+def test_autoscale_up_under_load_down_when_idle(factory):
+    router = fleet.FleetRouter(factory, replicas=1, min_replicas=1,
+                               max_replicas=3, scale_up_queue_depth=2,
+                               scale_down_idle_rounds=3)
+    reqs = [router.submit(prompt=p, max_tokens=MAX_NEW)
+            for p in _prompts(12, seed=600)]
+    router.run()
+    snap = router.metrics.snapshot()
+    assert snap["scale_ups"] >= 1
+    assert router.health()["routable"] > 1
+    assert all(r.finish_reason == "max_tokens" for r in reqs)
+    for _ in range(8):                  # idle rounds -> drain back down
+        router.step()
+    assert router.health()["routable"] == 1
+    assert router.metrics.snapshot()["scale_downs"] >= 1
+    assert router.metrics.snapshot()["rebalances"] >= 2
+    router.shutdown()
+
+
+def test_scale_down_drains_without_dropping_accepted_work(factory):
+    """The drained replica finishes its accepted requests before
+    leaving the rotation — scale-down never drops work."""
+    router = fleet.FleetRouter(factory, replicas=2, min_replicas=1,
+                               max_replicas=2, scale_up_queue_depth=99,
+                               scale_down_idle_rounds=1)
+    # park work on BOTH replicas, then force the idle-detection path by
+    # draining the newest replica directly (the autoscale victim rule)
+    reqs = [router.submit(prompt=p, max_tokens=8)
+            for p in _prompts(4, seed=700)]
+    victim = max((r for r in router.replicas if r.routable),
+                 key=lambda r: r.replica_id)
+    victim_reqs = [r for r in reqs if r.replica is victim]
+    assert victim_reqs
+    victim.drain()
+    assert victim.state == "draining"
+    router.run()
+    assert all(r.finish_reason == "max_tokens" for r in reqs)
+    assert victim not in router.replicas        # retired once empty
+    router.shutdown()
+
+
+def test_spawn_refuses_weight_digest_mismatch(model):
+    """State-handoff discipline: a factory whose weights drifted from
+    the fleet's reference digest cannot enter the rotation."""
+    pt.seed(31)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=MAX_LEN)
+    other = LlamaForCausalLM(cfg)
+    models = iter([model, other])
+
+    def drifting_factory():
+        return PagedServingEngine(next(models), num_slots=4,
+                                  max_len=MAX_LEN, block_size=BLOCK,
+                                  num_blocks=33,
+                                  prefill_chunk_len=CHUNK)
+    sup = fleet.ReplicaSupervisor(drifting_factory)
+    sup.spawn()                                 # banks the reference
+    with pytest.raises(RuntimeError, match="state-handoff mismatch"):
+        sup.spawn()
+
+
+def test_fleet_health_reads_one_endpoint_per_replica(factory):
+    """The router's health view carries the /healthz satellite fields:
+    status, queue_depth, cache_blocks_used/total per replica."""
+    router = fleet.FleetRouter(factory, replicas=2)
+    router.submit(prompt=[1, 2, 3], max_tokens=2)
+    h = router.health()
+    assert h["routable"] == 2 and h["policy"] == "affinity"
+    for payload in h["replicas"]:
+        assert payload["status"] == "ok"
+        assert "queue_depth" in payload
+        assert payload["cache_blocks_total"] == 32
+        assert "cache_blocks_used" in payload
+    assert sum(p["queue_depth"] for p in h["replicas"]) \
+        + sum(r.scheduler.in_flight() for r in router.replicas) >= 1
+    router.run()
+    router.shutdown()

@@ -298,6 +298,63 @@ def test_decode_wave_never_writes_through_midprefill_tables(model):
     np.testing.assert_array_equal(before, after)
 
 
+def test_drain_mid_chunked_prefill_completes_request(model):
+    """drain() arriving while a chunked prefill is mid-fold (the gap
+    PR 9's staged admission left): the remaining chunks still run, the
+    request emits its full output, and only THEN does the engine report
+    drained — an accepted long prompt is never abandoned half-folded."""
+    eng = PagedServingEngine(model, num_slots=2, max_len=MAX_LEN,
+                             block_size=BLOCK, num_blocks=33,
+                             prefill_chunk_len=CHUNK)
+    prompt = _prompt(82, n=2 * CHUNK + 5)          # 3 chunks
+    # the solo reference runs AFTER the measured stream: running it
+    # first would register the prompt's block hashes and the measured
+    # admission would skip its chunks via the prefix cache — leaving
+    # nothing mid-fold for drain() to arrive during
+    sched = Scheduler(eng)
+    req = sched.submit(prompt=prompt, max_tokens=4)
+    sched.step()                        # admit + chunk 1 of 3
+    assert req.slot in eng.prefilling_slots()      # genuinely mid-fold
+    sched.drain()
+    assert eng.health_state == "draining"
+    assert req.slot in eng.prefilling_slots()      # drain didn't abort it
+    waves = sched.run()
+    assert waves >= 1
+    assert req.finish_reason == "max_tokens"
+    assert sched.in_flight() == 0 and sched.queue_depth() == 0
+    assert not eng.prefilling_slots()
+    assert eng.block_pool.used == 0
+    want = Scheduler(eng).generate(prompt, max_tokens=4)
+    assert req.output_tokens == want    # chunked-through-drain == solo
+
+
+def test_paged_healthz_reports_pool_and_queue(paged):
+    """/healthz satellite fields on the paged engine: queue_depth (from
+    the attached scheduler) and cache_blocks_used/cache_blocks_total
+    (mirroring the gauges) in one payload."""
+    import json as _json
+
+    from paddle_tpu.utils import telemetry
+    sched = Scheduler(paged)
+    reqs = [sched.submit(prompt=_prompt(90 + i), max_tokens=3)
+            for i in range(6)]                     # 4 slots + 2 queued
+    sched.step()
+    status, _, body = telemetry.http_get_inline(
+        "/healthz", health_fn=paged._health)
+    payload = _json.loads(body)
+    assert status == 200 and payload["status"] == "ok"
+    assert payload["queue_depth"] == sched.queue_depth() >= 1
+    assert payload["cache_blocks_total"] == paged.block_pool.usable
+    assert payload["cache_blocks_used"] == paged.block_pool.used > 0
+    sched.run()
+    assert all(r.done for r in reqs)
+    status, _, body = telemetry.http_get_inline(
+        "/healthz", health_fn=paged._health)
+    payload = _json.loads(body)
+    assert payload["queue_depth"] == 0
+    assert payload["cache_blocks_used"] == 0
+
+
 def test_prompt_longer_than_chunk_but_full_horizon_rejected(paged):
     """Chunked prefill removes the dense bucket limit — a prompt longer
     than the chunk admits fine — but the horizon still binds."""

@@ -106,7 +106,10 @@ def test_snapshot_keys_byte_compatible(engine):
         "slot_occupancy", "queue_depth_peak",
         "faults", "rejected", "wave_retries",
         "block_utilization", "prefix_hits", "prefix_misses",
-        "prefix_hit_rate"]
+        "prefix_hit_rate",
+        # fleet PR appended the raw span endpoints (rollups across
+        # replicas need min(first)/max(last), not per-engine spans)
+        "first_token_time", "last_token_time"]
     # dense engine: the paged-pool keys are present but empty
     assert snap["block_utilization"] is None
     assert snap["prefix_hits"] == 0 and snap["prefix_hit_rate"] is None
@@ -134,6 +137,19 @@ def test_engine_metrics_server_and_healthz(engine):
         assert status == 200 and payload["status"] == "ok"
         assert payload["num_slots"] == 4
         assert payload["decode_compiles"] == 1
+        # load state rides the SAME endpoint (fleet router / LB
+        # contract): queue depth from the last attached scheduler
+        assert payload["queue_depth"] == 0
+        sched = Scheduler(engine)
+        for i in range(6):              # 4 slots + 2 queued
+            sched.submit(prompt=[1 + i, 2, 3], max_tokens=2)
+        _, _, body = telemetry.http_get_inline(
+            "/healthz", health_fn=engine._health)
+        assert json.loads(body)["queue_depth"] == sched.queue_depth() >= 1
+        sched.run()
+        _, _, body = telemetry.http_get_inline(
+            "/healthz", health_fn=engine._health)
+        assert json.loads(body)["queue_depth"] == 0
         import urllib.request
         data = urllib.request.urlopen(srv.url + "/healthz",
                                       timeout=10).read()
