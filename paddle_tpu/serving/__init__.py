@@ -33,9 +33,11 @@ from .engine import ServingEngine
 from .scheduler import Scheduler
 from .request import Request, RequestState
 from .metrics import ServingMetrics
+from .slo import SLOEngine, SLOPolicy
 from .paged import BlockPool, BlockPoolExhausted, PagedServingEngine
 from .fleet import FleetRequest, FleetRouter
 
 __all__ = ["ServingEngine", "Scheduler", "Request", "RequestState",
-           "ServingMetrics", "BlockPool", "BlockPoolExhausted",
+           "ServingMetrics", "SLOEngine", "SLOPolicy",
+           "BlockPool", "BlockPoolExhausted",
            "PagedServingEngine", "FleetRouter", "FleetRequest"]

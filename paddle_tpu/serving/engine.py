@@ -38,6 +38,12 @@ from ..utils import chaos, telemetry
 
 HEALTH_STATES = ("ok", "degraded", "draining")
 
+# program-cost memo keyed by engine shape signature: every fleet
+# replica built from one factory shares a single lowering-level cost
+# analysis instead of paying one per engine (the fleet tests spawn
+# dozens of engines over one model)
+_PROGRAM_COST_CACHE = {}
+
 
 def _infer_cache_dtype(params):
     """Majority element dtype of the params — a bf16 model gets bf16 KV
@@ -158,6 +164,16 @@ class ServingEngine:
         # carries real load state (a router or LB reads ONE endpoint
         # instead of scraping /metrics); 0 until a scheduler attaches
         self._queue_depth_fn = None
+        # optional dict-returning probe merged into /healthz (the
+        # scheduler's SLO engine reports burn-rate state this way);
+        # newest wins, like the queue probe
+        self._health_probe_fn = None
+        # slot -> (trace_id, trace_pid): the scheduler parks the
+        # admitted request's trace context so engine-internal progress
+        # (the paged engine's per-chunk prefill) can emit
+        # request-correlated trace events
+        self._slot_trace = {}
+        self._program_costs_memo = None
 
         self._jit = bool(jit_compile)
         self._metrics_server = None
@@ -273,6 +289,56 @@ class ServingEngine:
         engine)."""
         self._queue_depth_fn = fn
 
+    def attach_health_probe(self, fn):
+        """Register a zero-arg dict-returning callable merged into the
+        /healthz payload — the scheduler's SLO engine serves its
+        burn-rate verdict through this. Newest wins, same contract as
+        the queue probe."""
+        self._health_probe_fn = fn
+
+    def set_slot_trace(self, slot, trace_id, trace_pid=0):
+        """Park the admitted request's trace context on its slot so
+        engine-internal progress events (chunked prefill) can correlate
+        to the request's chrome flow. Cleared at retirement."""
+        self._slot_trace[slot] = (int(trace_id), int(trace_pid))
+
+    def program_costs(self):
+        """FLOPs / bytes-accessed per invocation of this engine's two
+        compiled programs, from the xprof registry's specs at THIS
+        engine's real shapes (lowering-level HLO cost analysis — no
+        second backend compile; the same numbers
+        scripts/hlo_baseline.json banks for the canonical shapes).
+        Returns {"decode_wave": {...}|None, "prefill": {...}|None};
+        memoized per engine AND per shape signature process-wide, so a
+        fleet of identical replicas lowers once. {} when the audit
+        registry cannot analyze on this jax build."""
+        if self._program_costs_memo is not None:
+            return self._program_costs_memo
+        # caches are part of the key: they carry the pool/cache dims
+        # (block_size, num_blocks, cache dtype) that change the
+        # program's bytes-accessed even over identical weights
+        sig = (type(self).__name__, self.num_slots, self.max_len,
+               self.prefill_len,
+               tuple((tuple(leaf.shape), str(leaf.dtype))
+                     for leaf in jax.tree_util.tree_leaves(
+                         (self._params, self._buffers, self._caches))))
+        costs = _PROGRAM_COST_CACHE.get(sig)
+        if costs is None:
+            from ..tools.xprof.registry import (engine_program_specs,
+                                                program_cost)
+            costs = {}
+            try:
+                for spec in engine_program_specs(self):
+                    key = ("decode_wave" if "decode" in spec["name"]
+                           else "prefill")
+                    costs[key] = program_cost(spec)
+            except Exception:   # noqa: BLE001 — cost analysis is
+                costs = {}      # best-effort observability, never a
+                                # reason to fail serving
+            _PROGRAM_COST_CACHE[sig] = costs
+        self._program_costs_memo = costs
+        return costs
+
     def set_health_state(self, state):
         """ok | degraded | draining — the scheduler flips this so
         /healthz reports REAL engine state (a degraded engine must not
@@ -284,7 +350,7 @@ class ServingEngine:
 
     def _health(self):
         qfn = self._queue_depth_fn
-        return {
+        h = {
             "status": self.health_state,
             "num_slots": self.num_slots,
             "slots_active": len(self.active_slots()),
@@ -293,6 +359,11 @@ class ServingEngine:
             "decode_compiles": self.decode_compiles,
             "prefill_compiles": self.prefill_compiles,
         }
+        if self._health_probe_fn is not None:
+            # e.g. {"slo": {...burn-rate verdict...}} — the handler
+            # already degrades the payload if a probe raises
+            h.update(self._health_probe_fn() or {})
+        return h
 
     # ------------------------------------------------------------- slots
     def free_slots(self):
@@ -461,3 +532,4 @@ class ServingEngine:
         self.slot_sample[slot] = False
         self.slot_temp[slot] = 1.0
         self._pending_prefill.pop(slot, None)
+        self._slot_trace.pop(slot, None)

@@ -132,3 +132,81 @@ class FleetMetrics:
                 "scale_ups": self._scale_ups,
                 "scale_downs": self._scale_downs,
             }
+
+
+class FleetRegistry:
+    """Fleet-wide /metrics view: a duck-typed telemetry registry over a
+    FleetRouter, served by `FleetRouter.start_metrics_server()`.
+
+    The process-wide registry cannot distinguish replicas — every
+    engine's `serving_*` gauges overwrite one series. This facade
+    builds, per scrape, a fresh registry of per-replica gauges labeled
+    `replica` (queue depth, active slots, pool occupancy, health state)
+    plus fleet-summed counters that stay COHERENT across kill/replace
+    cycles (retired replicas' final metric snapshots are folded in, so
+    work done before a kill never vanishes from the totals), and
+    appends the process-wide exposition after it. Building per scrape
+    also means a replica leaving the rotation drops its series instead
+    of freezing at its last value.
+    """
+
+    def __init__(self, router):
+        self._router = router
+
+    def _build(self):
+        reg = telemetry.Registry()
+        depth = reg.gauge(
+            "fleet_replica_queue_depth",
+            "Requests queued on each replica", ("replica",))
+        slots = reg.gauge(
+            "fleet_replica_slots_active",
+            "Slots decoding on each replica", ("replica",))
+        used = reg.gauge(
+            "fleet_replica_cache_blocks_used",
+            "KV blocks referenced by live requests, per replica",
+            ("replica",))
+        total = reg.gauge(
+            "fleet_replica_cache_blocks_total",
+            "Usable KV blocks in each replica's pool", ("replica",))
+        state = reg.gauge(
+            "fleet_replica_state",
+            "1 for each replica's current health state (a replica "
+            "changing state moves the 1 between series)",
+            ("replica", "state"))
+        router = self._router
+        # one atomic capture: a replica mid-retirement lands in exactly
+        # one of the two lists, keeping the summed counters monotonic
+        reps, retired = router.metric_view()
+        for r in reps:
+            h = r.health()
+            lbl = str(r.replica_id)
+            depth.labels(replica=lbl).set(h.get("queue_depth", 0))
+            slots.labels(replica=lbl).set(h.get("slots_active", 0))
+            if "cache_blocks_used" in h:
+                used.labels(replica=lbl).set(h["cache_blocks_used"])
+                total.labels(replica=lbl).set(h["cache_blocks_total"])
+            state.labels(replica=lbl, state=h.get("status", "ok")).set(1)
+        snaps = [r.scheduler.metrics.snapshot() for r in reps] + retired
+        reg.counter(
+            "fleet_tokens_generated_total",
+            "Tokens generated across the whole fleet — live rotation "
+            "plus replicas retired since the last reset, so a "
+            "kill/replace cycle never loses counted work").inc(
+            sum(s["tokens_generated"] for s in snaps))
+        reg.counter(
+            "fleet_requests_completed_total",
+            "Requests completed across the whole fleet (same retired-"
+            "replica folding as the token counter)").inc(
+            sum(s["requests_completed"] for s in snaps))
+        return reg
+
+    # -- duck-typed registry surface (what make_metrics_handler calls) --
+    def render_prometheus(self, include_monitor=True):
+        return (self._build().render_prometheus(include_monitor=False)
+                + telemetry.REGISTRY.render_prometheus(include_monitor))
+
+    def snapshot(self, include_monitor=True):
+        out = telemetry.REGISTRY.snapshot(include_monitor)
+        out["metrics"].update(
+            self._build().snapshot(include_monitor=False)["metrics"])
+        return out

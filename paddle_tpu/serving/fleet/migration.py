@@ -39,11 +39,19 @@ class FleetRequest:
     per-request by the underlying engine's callback guard.
     """
     _ids = itertools.count(1)
+    #: fleet trace ids live above the replica-local Request id space so
+    #: a fleet request's chrome flow can never collide with a direct
+    #: (non-fleet) request recorded in the same trace
+    _TRACE_BASE = 1 << 20
 
     def __init__(self, prompt, max_tokens=16, eos_token_id=None,
                  timeout=None, on_token=None, do_sample=False,
                  temperature=1.0):
         self.request_id = next(FleetRequest._ids)
+        # ONE trace id for the life of the request: every hop's Request
+        # inherits it (_submit_kwargs), so the spans a migration leaves
+        # on two replicas link into a single chrome flow
+        self.trace_id = FleetRequest._TRACE_BASE + self.request_id
         self.prompt = [int(t) for t in prompt]
         self.max_tokens = int(max_tokens)
         self.eos_token_id = eos_token_id
@@ -57,6 +65,8 @@ class FleetRequest:
         self.replica = None          # current Replica handle
         self.current = None          # current replica-local Request
         self._prior = []             # tokens from hops that died
+        self._first_token_abs = None  # banked from a dead hop, so TTFT
+                                      # survives the hop that earned it
         self.finish_reason = None
         self.error = None
         self._done = threading.Event()
@@ -100,6 +110,37 @@ class FleetRequest:
             return None
         return self._finish_time - self.submit_time
 
+    @property
+    def first_token_time(self):
+        """When the FIRST token of the stitched stream landed — the
+        first hop's timestamp even after that hop's replica died."""
+        if self._first_token_abs is not None:
+            return self._first_token_abs
+        cur = self.current
+        return None if cur is None else cur.first_token_time
+
+    @property
+    def ttft(self):
+        """Fleet-level time-to-first-token (the client's view: from
+        fleet admission, whatever replica ended up serving it)."""
+        first = self.first_token_time
+        if first is None or self.submit_time is None:
+            return None
+        return first - self.submit_time
+
+    @property
+    def tpot(self):
+        """Mean inter-token latency of the stitched stream: first token
+        to completion over the gap count — migration stalls INCLUDE
+        themselves, because the client experienced them."""
+        first = self.first_token_time
+        if first is None or not self.done:
+            return None
+        n = len(self.output_tokens)
+        if n < 2:
+            return None
+        return (self._finish_time - first) / (n - 1)
+
     # -------------------------------------------------- router internals
     def _mark_submitted(self):
         if self.submit_time is None:
@@ -121,6 +162,10 @@ class FleetRequest:
             "timeout": remaining_t,
             "do_sample": self.do_sample,
             "temperature": self.temperature,
+            # trace continuity across migration: the resumed hop's
+            # spans carry the SAME fleet trace id, so the halves of a
+            # migrated request link instead of starting a fresh trace
+            "trace_id": self.trace_id,
         }
         if self.on_token is not None:
             fleet_req = self
@@ -138,6 +183,8 @@ class FleetRequest:
         with self._tok_lock:
             if self.current is not None:
                 self._prior.extend(self.current.output_tokens)
+                if self._first_token_abs is None:
+                    self._first_token_abs = self.current.first_token_time
             self.current = None
             self.replica = None
 

@@ -47,6 +47,10 @@ class Replica:
         self.engine = engine
         self._scheduler_kwargs = dict(scheduler_kwargs or {})
         self.scheduler = Scheduler(engine, **self._scheduler_kwargs)
+        # chrome-trace process row: the router's merged trace shows
+        # each replica's request spans + scheduler slices on its own
+        # pid row (0 stays the router/host row)
+        self.scheduler.trace_pid = self.replica_id + 1
         self._killed = False
 
     def renew_scheduler(self):
@@ -56,6 +60,7 @@ class Replica:
         if self.scheduler.in_flight() or self.scheduler.queue_depth():
             raise RuntimeError("renew_scheduler on a busy replica")
         self.scheduler = Scheduler(self.engine, **self._scheduler_kwargs)
+        self.scheduler.trace_pid = self.replica_id + 1
 
     @property
     def state(self):
@@ -135,6 +140,13 @@ class ReplicaSupervisor:
         self.verify_state = bool(verify_state)
         self.reference_digest = None
         self._next_id = 0
+
+    @property
+    def spawned(self):
+        """Replica ids handed out so far (dead ones included) — the
+        trace exporter names one chrome process row per id ever
+        spawned, so a killed replica's spans stay labeled."""
+        return self._next_id
 
     def spawn(self):
         """Build one replica. The first spawn banks the fleet's

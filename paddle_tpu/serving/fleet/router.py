@@ -37,8 +37,9 @@ as the single-engine Scheduler.
 """
 import threading
 
-from ...utils import chaos, flight_recorder
-from .metrics import FleetMetrics
+from ...utils import chaos, flight_recorder, profiler, telemetry
+from ..slo import as_engine as _slo_as_engine
+from .metrics import FleetMetrics, FleetRegistry
 from .migration import DEFAULT_MAX_MIGRATIONS, FleetRequest
 from .replica import ReplicaSupervisor
 
@@ -58,6 +59,13 @@ class FleetRouter:
         no-migration positive control).
     min_replicas/max_replicas + scale_up_queue_depth: elastic range;
         scale_up_queue_depth=None disables autoscaling.
+    slo: an SLOPolicy (or prebuilt SLOEngine, serving/slo.py). When
+        set, every finalized request feeds the sliding window and the
+        autoscaler consumes error-budget BURN RATE instead of queue
+        depth: scale up on fast burn (the latency promise is being
+        broken), drain the newest replica on sustained surplus. Burn
+        transitions journal through the flight recorder and the
+        verdict rides `health()` / the fleet exporter's /healthz.
     """
 
     def __init__(self, engine_factory, replicas=2, policy="affinity",
@@ -65,7 +73,7 @@ class FleetRouter:
                  max_migrations=DEFAULT_MAX_MIGRATIONS,
                  min_replicas=None, max_replicas=None,
                  scale_up_queue_depth=None, scale_down_idle_rounds=8,
-                 auto_replace=True, verify_state=True):
+                 auto_replace=True, verify_state=True, slo=None):
         if policy not in POLICIES:
             raise ValueError(f"policy must be one of {POLICIES}, "
                              f"got {policy!r}")
@@ -75,7 +83,13 @@ class FleetRouter:
         self.migrate = bool(migrate)
         self.max_migrations = int(max_migrations)
         self.auto_replace = bool(auto_replace)
-        self.min_replicas = int(min_replicas or 1)
+        # with an SLO configured, burn-surplus scale-DOWN is active —
+        # the configured size is then the default floor, so opting into
+        # SLO observability alone cannot silently shrink a fixed-size
+        # fleet; pass min_replicas explicitly to allow draining below it
+        if min_replicas is None:
+            min_replicas = replicas if slo is not None else 1
+        self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas or max(replicas, 1))
         self.scale_up_queue_depth = scale_up_queue_depth
         self.scale_down_idle_rounds = int(scale_down_idle_rounds)
@@ -96,6 +110,11 @@ class FleetRouter:
         self._target = int(replicas)         # replacement/scale target
         self._rr = 0
         self._idle_rounds = 0
+        # SLO-driven autoscale state (serving/slo.py)
+        self.slo_engine = _slo_as_engine(slo)
+        self._scale_cooldown = 0             # rounds until next burn
+        self._surplus_rounds = 0             # consecutive low-burn rounds
+        self._metrics_server = None
 
     # ---------------------------------------------------------- admission
     def submit(self, request=None, **kw):
@@ -168,6 +187,7 @@ class FleetRouter:
             candidates, policy = self._route(kw["prompt"])
         except RuntimeError as e:
             fr._finalize("error" if continuation else "rejected", error=e)
+            self._observe_slo(fr)
             if not continuation:
                 self.metrics.on_rejected()
                 raise ValueError(str(e))
@@ -192,6 +212,12 @@ class FleetRouter:
                 # and _retire_replica's owned scan may have run before
                 # the attach, so this hop is ours to fail over
                 lost = replica not in self.replicas
+            # the router's leg of the request's chrome flow: QUEUED(s)
+            # on the replica row, then this DISPATCH step naming which
+            # replica the policy picked (pid 0 = the router's row)
+            telemetry.trace_flow_step(
+                fr.trace_id, "DISPATCH", replica=replica.replica_id,
+                policy=policy, continuation=bool(continuation))
             self.metrics.on_routed(policy)
             if lost:
                 self._migrate(fr, reason="retired mid-dispatch",
@@ -199,6 +225,7 @@ class FleetRouter:
             return
         why = f"no replica accepted the request ({last_err!r})"
         fr._finalize("error" if continuation else "rejected", error=why)
+        self._observe_slo(fr)
         if not continuation:
             self.metrics.on_rejected()
             raise ValueError(why)
@@ -276,12 +303,16 @@ class FleetRouter:
                 return False
             self.replicas.remove(replica)
             self._dead_total += 1
-        replica.kill()
-        with self._lock:
-            # its completed work must stay in fleet-wide rollups
-            # (bench rows would silently undercount otherwise)
+            # its completed work must stay in fleet-wide rollups (bench
+            # rows would silently undercount otherwise) — snapshotted
+            # in the SAME lock acquisition as the removal, so a
+            # concurrent exporter scrape sees the replica in exactly
+            # one of {rotation, retired}: counters summed over both
+            # stay monotonic (kill() below only evacuates, it cannot
+            # change completed-work tallies)
             self._retired_metric_snaps.append(
                 replica.scheduler.metrics.snapshot())
+        replica.kill()
         rec = flight_recorder.get_recorder()
         if rec is not None:
             rec.fault(kind="replica_" + reason, action="replace",
@@ -350,6 +381,12 @@ class FleetRouter:
             # generated so far, terminated "length", not "error"
             self._finalize_one(fr, forced=("length", None))
             return
+        # the flow event that LINKS the halves of a migrated request:
+        # the dead hop's spans end here, the resumed hop's QUEUED span
+        # opens under the same trace id on the new replica's row
+        telemetry.trace_flow_step(
+            fr.trace_id, "MIGRATE", src=src_id, reason=str(reason),
+            migration=fr.migrations, tokens_so_far=len(fr._prior))
         self._dispatch(fr, continuation=True)
         if fr.replica is not None:
             self.metrics.on_migration(request_id=fr.request_id,
@@ -374,6 +411,16 @@ class FleetRouter:
         return None
 
     # -------------------------------------------------------- completions
+    def _observe_slo(self, fr):
+        """Feed one FINALIZED request to the SLO window. Every
+        finalization path must come through here (including _dispatch's
+        total-refusal resolutions) — a continuation failing dispatch-
+        side is exactly the client-visible error the error-rate target
+        exists to burn on. `rejected` stays excluded: that is admission
+        control doing its job, not a served request."""
+        if self.slo_engine is not None and fr.finish_reason != "rejected":
+            self.slo_engine.observe_request(fr)
+
     def _finalize_one(self, fr, forced=None):
         if forced is not None:
             fr._finalize(forced[0], error=forced[1])
@@ -382,6 +429,7 @@ class FleetRouter:
         with self._lock:
             if fr in self._live:
                 self._live.remove(fr)
+        self._observe_slo(fr)
 
     def _finalize_completed(self):
         with self._lock:
@@ -400,12 +448,11 @@ class FleetRouter:
         return replica
 
     def _autoscale(self):
-        """Elastic scale on live telemetry. Scale-up: sustained queue
-        pressure per routable replica. Scale-down: a fully idle fleet
-        for `scale_down_idle_rounds` consecutive rounds drains the
-        newest replica (accepted work still completes) and retires it
-        once empty. Replicas draining for scale-down leave the rotation
-        here; replicas draining by operator drain() do too."""
+        """Elastic scale on live telemetry. With an SLO configured the
+        signal is error-budget BURN RATE (`_autoscale_slo`); otherwise
+        the original queue-depth heuristic. Either way, replicas done
+        draining (scale-down or operator drain()) leave the rotation
+        here with their metrics folded into the retired rollup."""
         with self._lock:
             drained = [r for r in self.replicas
                        if r.state == "draining" and r.drained()]
@@ -415,11 +462,14 @@ class FleetRouter:
                     r.scheduler.metrics.snapshot())
         for r in drained:
             r.engine.stop_metrics_server()
-        if self.scale_up_queue_depth is None:
-            return
         with self._lock:
             live = [r for r in self.replicas if r.routable]
         if not live:
+            return
+        if self.slo_engine is not None:
+            self._autoscale_slo(live)
+            return
+        if self.scale_up_queue_depth is None:
             return
         queued = sum(r.scheduler.queue_depth() for r in live)
         busy = sum(r.load() for r in live)
@@ -440,18 +490,99 @@ class FleetRouter:
         else:
             self._idle_rounds = 0
 
+    def _autoscale_slo(self, live):
+        """Burn-rate autoscale: the SLO engine's verdict — computed
+        from what requests actually EXPERIENCED (TTFT/TPOT/errors) —
+        replaces queue depth. Fast burn (the latency promise is being
+        broken faster than the budget allows) spawns a replica, rate-
+        limited by the policy's cooldown so one long breach grows the
+        fleet stepwise; burn at/under the slow threshold for
+        `scale_down_idle_rounds` consecutive rounds is sustained
+        surplus — the newest replica drains (its accepted work still
+        completes) and retires. Scaling in either direction never drops
+        accepted work."""
+        pol = self.slo_engine.policy
+        verdict = self.slo_engine.evaluate()
+        burn = verdict["burn_rate"]
+        if self._scale_cooldown > 0:
+            self._scale_cooldown -= 1
+        if burn >= pol.fast_burn:
+            self._surplus_rounds = 0
+            if self._scale_cooldown == 0 and len(live) < self.max_replicas:
+                self._target = len(live) + 1
+                self._spawn()
+                self.metrics.on_scale("up")
+                self.slo_engine.journal_scale("up", verdict,
+                                              replicas=len(live) + 1)
+                self._scale_cooldown = pol.cooldown_rounds
+        elif burn <= pol.slow_burn:
+            self._surplus_rounds += 1
+            if self._surplus_rounds >= self.scale_down_idle_rounds \
+                    and len(live) > self.min_replicas:
+                victim = max(live, key=lambda r: r.replica_id)
+                victim.drain()
+                self._target = len(live) - 1
+                self.metrics.on_scale("down")
+                self.slo_engine.journal_scale("down", verdict,
+                                              replicas=len(live) - 1)
+                self._surplus_rounds = 0
+        else:
+            self._surplus_rounds = 0
+
     # ------------------------------------------------------------- admin
     def health(self):
         """Fleet-level health view: per-replica /healthz payloads plus
-        the rotation summary (what an external dashboard polls)."""
+        the rotation summary (what an external dashboard polls). Also
+        the fleet exporter's /healthz payload — `status` drives the
+        probe's HTTP code, and the SLO verdict (burn rate, attainment)
+        rides along when a policy is configured."""
         with self._lock:
             reps = list(self.replicas)
-        return {
+        routable = sum(1 for r in reps if r.routable)
+        out = {
+            "status": "ok" if routable else "degraded",
             "replicas": [r.health() for r in reps],
-            "routable": sum(1 for r in reps if r.routable),
+            "routable": routable,
             "target_replicas": self._target,
             "policy": self.policy,
         }
+        if self.slo_engine is not None:
+            out.update(self.slo_engine.health())
+        return out
+
+    def start_metrics_server(self, port=0, host="127.0.0.1"):
+        """Fleet-wide /metrics + /healthz exporter: ONE scrape carries
+        every replica's gauges labeled `replica` plus coherent fleet
+        sums (FleetRegistry) alongside the process-wide registry;
+        /healthz serves `health()` (503 once nothing is routable).
+        port=0 picks a free port."""
+        if self._metrics_server is not None:
+            return self._metrics_server
+        self._metrics_server = telemetry.MetricsServer(
+            registry=FleetRegistry(self), host=host, port=port,
+            health_fn=self.health).start()
+        return self._metrics_server
+
+    def stop_metrics_server(self):
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+
+    def export_trace(self, path):
+        """Export ONE merged chrome trace of everything the fleet did
+        while the host profiler was recording: each replica's request
+        lifecycle spans and scheduler slices sit on their own named
+        process row (pid = replica_id + 1 — dead replicas keep their
+        row, that is where a migrated request's first half lives), the
+        router's DISPATCH/MIGRATE flow steps on row 0, and one flow arrow
+        per request linking its spans across replicas."""
+        meta = [{"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+                 "ts": 0, "args": {"name": "fleet-router"}}]
+        for rid in range(self.supervisor.spawned):
+            meta.append({"ph": "M", "name": "process_name",
+                         "pid": rid + 1, "tid": 0, "ts": 0,
+                         "args": {"name": f"replica-{rid}"}})
+        return profiler.export_chrome_tracing(path, extra_events=meta)
 
     def drain(self):
         """Stop admitting fleet-wide; accepted work runs to completion
@@ -461,11 +592,13 @@ class FleetRouter:
                 r.drain()
 
     def shutdown(self, max_rounds=None):
-        """drain() + drive to empty + stop every replica's exporter."""
+        """drain() + drive to empty + stop every exporter (replicas'
+        and the fleet-wide one)."""
         self.drain()
         rounds = self.run(max_rounds=max_rounds)
         for r in self._rotation():
             r.engine.stop_metrics_server()
+        self.stop_metrics_server()
         return rounds
 
     def reset_metrics(self):
@@ -480,6 +613,8 @@ class FleetRouter:
             self._retired_metric_snaps = []
         for r in self._rotation():
             r.renew_scheduler()
+        if self.slo_engine is not None:
+            self.slo_engine.reset()
 
     def retired_metric_snapshots(self):
         """Final ServingMetrics snapshots of replicas retired (killed,
@@ -488,3 +623,13 @@ class FleetRouter:
         they completed before leaving the rotation."""
         with self._lock:
             return list(self._retired_metric_snaps)
+
+    def metric_view(self):
+        """(live non-dead replicas, retired snapshots) captured in ONE
+        lock acquisition — the fleet exporter sums counters over both,
+        and a replica retiring between two separate reads would be
+        counted twice (or dropped), turning a monotonic counter into a
+        sawtooth that rate() misreads as a reset."""
+        with self._lock:
+            return ([r for r in self.replicas if r.state != "dead"],
+                    list(self._retired_metric_snaps))

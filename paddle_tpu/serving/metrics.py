@@ -19,6 +19,14 @@ import threading
 
 from ..utils import flight_recorder, monitor, telemetry
 
+#: scheduler-round phases whose wall time is attributed per round —
+#: admission (queue pop + block alloc + staging), prefill_chunk (one
+#: prefill program per mid-admission slot), decode_wave (the batched
+#: wave INCLUDING its fused in-program sampling tail), host_dispatch
+#: (token emit + callbacks + retirement). Keys of snapshot()'s
+#: `phase_seconds`.
+PHASES = ("admission", "prefill_chunk", "decode_wave", "host_dispatch")
+
 # legacy stat-registry keys (monitor.stat_get / all_stats)
 REQUESTS_SUBMITTED = "serving_requests_submitted"
 REQUESTS_COMPLETED = "serving_requests_completed"
@@ -50,6 +58,41 @@ _TTFT = telemetry.histogram(
 _LATENCY = telemetry.histogram(
     "serving_request_latency_seconds", "Time from submit to completion",
     buckets=telemetry.DEFAULT_LATENCY_BUCKETS)
+# inter-token latency needs finer buckets than TTFT: a healthy decode
+# wave is sub-millisecond-to-tens-of-ms, right at the default latency
+# buckets' floor (these span 100us..~3.3s)
+TPOT_BUCKETS = telemetry.exponential_buckets(0.0001, 2.0, 16)
+_TPOT = telemetry.histogram(
+    "serving_tpot_seconds",
+    "Inter-token latency (gap between consecutive streamed tokens of "
+    "one request; the first token's latency is TTFT, not TPOT)",
+    buckets=TPOT_BUCKETS)
+# serving roofline: the decode wave is memory-bandwidth-bound, so BOTH
+# axes are exported — compute (MFU) and HBM-bandwidth utilization —
+# from the compiled program's own cost analysis (the same flops/bytes
+# scripts/hlo_baseline.json banks) over the measured wave time
+_MFU = telemetry.gauge(
+    "serving_mfu",
+    "Model-FLOPs utilization of the latest decode wave: program FLOPs "
+    "/ (wave seconds x device peak FLOP/s)")
+_HBM_UTIL = telemetry.gauge(
+    "serving_hbm_util",
+    "HBM-bandwidth utilization of the latest decode wave: program "
+    "bytes-accessed / (wave seconds x device peak HBM bandwidth) — the "
+    "roofline axis that actually binds decode")
+
+_DEVICE_PEAKS = []     # [(peak_flops, peak_hbm_bw)] resolved once
+
+
+def _device_peaks():
+    """The roofline denominators, resolved once per process — they are
+    device constants, and on_wave sits in the hottest serving loop
+    (sub-millisecond waves), where two env + JAX-client lookups per
+    wave are real overhead."""
+    if not _DEVICE_PEAKS:
+        _DEVICE_PEAKS.append((flight_recorder.device_peak_flops(),
+                              flight_recorder.device_peak_hbm_bw()))
+    return _DEVICE_PEAKS[0]
 # resilience counters (the chaos harness proves each one moves —
 # scripts/chaos_serving.py; kinds are a small closed set)
 _FAULTS = telemetry.counter(
@@ -130,6 +173,8 @@ class ServingMetrics:
         self._latency = telemetry.Histogram(
             "serving_request_latency_seconds",
             buckets=telemetry.DEFAULT_LATENCY_BUCKETS)
+        self._tpot = telemetry.Histogram(
+            "serving_tpot_seconds", buckets=TPOT_BUCKETS)
         self._active_slot_waves = 0
         self._total_slot_waves = 0
         self._tokens = 0
@@ -147,6 +192,13 @@ class ServingMetrics:
         self._block_total_waves = 0
         self._prefix_base = None
         self._prefix_last = None
+        # per-phase wall time (seconds, accumulated per scheduler
+        # round) and the wave-integral roofline numerators: program
+        # flops/bytes x waves over the summed wave seconds
+        self._phase_seconds = {}
+        self._wave_seconds = 0.0
+        self._wave_flops = 0.0
+        self._wave_bytes = 0.0
 
     # ---------------------------------------------------------- recording
     def on_submit(self):
@@ -174,7 +226,14 @@ class ServingMetrics:
         monitor.stat_add(PREFILLS)
         _PREFILLS.inc()
 
-    def on_wave(self, n_active):
+    def on_wave(self, n_active, wave_s=None, flops=None,
+                bytes_accessed=None):
+        """One dispatched decode wave. `wave_s` is the measured wave
+        wall time and flops/bytes_accessed the compiled program's cost
+        per invocation (engine.program_costs — the numbers the xprof
+        baseline banks); together they produce the serving roofline
+        gauges. Cost-less calls (analysis unavailable) still count the
+        wave."""
         monitor.stat_add(DECODE_WAVES)
         monitor.stat_set(SLOTS_ACTIVE, int(n_active))
         _WAVES.inc()
@@ -182,6 +241,25 @@ class ServingMetrics:
         with self._lock:
             self._active_slot_waves += int(n_active)
             self._total_slot_waves += self.num_slots
+            if wave_s is not None and wave_s > 0:
+                self._wave_seconds += float(wave_s)
+                self._wave_flops += float(flops or 0.0)
+                self._wave_bytes += float(bytes_accessed or 0.0)
+        if wave_s is not None and wave_s > 0:
+            peak_flops, peak_bw = _device_peaks()
+            if flops:
+                _MFU.set(float(flops) / (wave_s * peak_flops))
+            if bytes_accessed:
+                _HBM_UTIL.set(float(bytes_accessed) / (wave_s * peak_bw))
+
+    def on_phase(self, phase, seconds):
+        """Attribute one scheduler-round phase's wall time (keys in
+        `PHASES`; snapshot() reports the accumulated split)."""
+        if seconds is None:
+            return
+        with self._lock:
+            self._phase_seconds[phase] = (
+                self._phase_seconds.get(phase, 0.0) + float(seconds))
 
     def on_queue_depth(self, depth):
         monitor.stat_set(QUEUE_DEPTH, int(depth))
@@ -205,9 +283,17 @@ class ServingMetrics:
                 self._prefix_base = (int(hits), int(misses))
             self._prefix_last = (int(hits), int(misses))
 
-    def on_token(self, t_now):
+    def on_token(self, t_now, prev_t=None):
+        """One streamed token; `prev_t` is the SAME request's previous
+        token timestamp (None for its first token), so the gap is a
+        TPOT sample — per-request inter-token latency, not the
+        engine-wide token cadence."""
         monitor.stat_add(TOKENS_GENERATED)
         _TOKENS.inc()
+        if prev_t is not None:
+            gap = t_now - prev_t
+            self._tpot.observe(gap)
+            _TPOT.observe(gap)
         with self._lock:
             self._tokens += 1
             if self._first_token_time is None:
@@ -246,6 +332,9 @@ class ServingMetrics:
             else:
                 p_hits = self._prefix_last[0] - self._prefix_base[0]
                 p_misses = self._prefix_last[1] - self._prefix_base[1]
+            phase_seconds = dict(self._phase_seconds)
+            wave_s = self._wave_seconds
+            wave_flops, wave_bytes = self._wave_flops, self._wave_bytes
         return {
             "requests_completed": self._latency.count(),
             "tokens_generated": tokens,
@@ -277,4 +366,16 @@ class ServingMetrics:
             # tokens/s denominator comparable with single-engine rows
             "first_token_time": first_t,
             "last_token_time": last_t,
+            # observability PR: inter-token latency (the second half of
+            # the TTFT/TPOT request-latency decomposition), the per-
+            # round phase split, and the wave-integral roofline —
+            # flops/bytes per wave are the SAME numbers the xprof
+            # baseline banks, so these agree with hlo_baseline.json
+            "tpot_p50_s": self._tpot.percentile(50),
+            "tpot_p99_s": self._tpot.percentile(99),
+            "phase_seconds": phase_seconds,
+            "mfu": (wave_flops / (wave_s * _device_peaks()[0])
+                    if wave_s and wave_flops else None),
+            "hbm_util": (wave_bytes / (wave_s * _device_peaks()[1])
+                         if wave_s and wave_bytes else None),
         }

@@ -215,6 +215,14 @@ class PagedServingEngine(ServingEngine):
             chaos.fire(chaos.PREFILL, slot=slot, chunk_start=st["next"])
         c0, C, n, bs = st["next"], self.prefill_chunk_len, st["n"], \
             self.block_size
+        trace = self._slot_trace.get(slot)
+        if trace is not None:
+            # chunk-indexed progress marker inside the request's PREFILL
+            # span: a long chunked admission's folding between decode
+            # waves is visible per chunk in the exported trace
+            telemetry.trace_instant(
+                trace[0], f"PREFILL_CHUNK[{c0 // C}]", pid=trace[1],
+                slot=slot, chunk_start=c0, prompt_len=n)
         valid = min(C, n - c0)
         chunk = np.zeros((C,), np.int32)
         chunk[:valid] = st["prompt"][c0:c0 + valid]

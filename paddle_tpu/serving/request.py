@@ -44,7 +44,7 @@ class Request:
 
     def __init__(self, prompt, max_tokens=16, eos_token_id=None,
                  timeout=None, on_token=None, do_sample=False,
-                 temperature=1.0):
+                 temperature=1.0, trace_id=None):
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -52,7 +52,13 @@ class Request:
             raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
         with Request._ids_lock:
             self.request_id = next(Request._ids)
-        self.trace_id = self.request_id   # correlates trace events
+        # correlates trace events; a migrating FleetRequest passes ITS
+        # fleet-scoped id so every hop's spans/flows share one chrome
+        # flow across replicas (one linked trace, not one per hop)
+        self.trace_id = (self.request_id if trace_id is None
+                         else int(trace_id))
+        self.trace_pid = 0               # chrome process row (fleet:
+                                         # replica_id + 1, set at submit)
         self.prompt = prompt
         self.max_tokens = int(max_tokens)
         self.eos_token_id = None if eos_token_id is None else int(eos_token_id)
@@ -80,6 +86,9 @@ class Request:
         self.submit_time = None          # set by the scheduler at admission
         self.prefill_time = None
         self.first_token_time = None
+        self.last_token_time = None      # stamped per emitted token —
+                                         # TPOT (inter-token latency)
+                                         # derives from first/last
         self.done_time = None
         self._done_event = threading.Event()
 
@@ -97,8 +106,10 @@ class Request:
     def _emit(self, token_id):
         """Record one generated token (first one comes from prefill)."""
         token_id = int(token_id)
+        now = time.monotonic()
         if self.first_token_time is None:
-            self.first_token_time = time.monotonic()
+            self.first_token_time = now
+        self.last_token_time = now
         if self.state != RequestState.DECODE:
             # also re-entered after preemption-by-recompute: the resumed
             # request passed through PREFILL again with first_token_time
@@ -172,6 +183,17 @@ class Request:
         if self.done_time is None or self.submit_time is None:
             return None
         return self.done_time - self.submit_time
+
+    @property
+    def tpot(self):
+        """Mean time-per-output-token in seconds: the inter-token span
+        divided by the gap count. None until a second token exists (the
+        first token's latency is TTFT, not TPOT)."""
+        n = len(self.output_tokens)
+        if n < 2 or self.first_token_time is None \
+                or self.last_token_time is None:
+            return None
+        return (self.last_token_time - self.first_token_time) / (n - 1)
 
     def __repr__(self):
         return (f"Request(id={self.request_id}, state={self.state}, "

@@ -38,6 +38,15 @@ by injection in scripts/chaos_serving.py):
     with finish_reason "rejected"), `drain()` stops admissions while
     accepted work runs to completion (/healthz: "draining").
 
+Observability (docs/observability.md "SLO engine & fleet tracing"):
+every round is split into admission / prefill_chunk / decode_wave /
+host_dispatch phase spans (traced AND metered — the sampling tail is
+fused inside the wave program, so it deliberately has no host-side
+span), each wave's measured time is divided into the compiled
+program's own cost analysis for the `serving_mfu` /
+`serving_hbm_util` roofline gauges, and an optional `slo=SLOPolicy`
+feeds completions into a burn-rate window served on /healthz.
+
 Thread-model: submit() is safe from any producer thread (the bench
 script's Poisson arrival generator); the wave loop itself runs wherever
 run()/step() is called — the engine's compiled programs are driven from
@@ -52,14 +61,34 @@ from ..utils.profiler import RecordEvent
 from .metrics import ServingMetrics
 from .paged.block_pool import BlockPoolExhausted
 from .request import Request, RequestState
+from .slo import as_engine as _slo_as_engine
 
 
 class Scheduler:
     def __init__(self, engine, max_queue=None, completed_log=1024,
                  wave_retries=3, retry_backoff_s=0.05,
-                 prefill_fail_limit=None, max_preemptions=3):
+                 prefill_fail_limit=None, max_preemptions=3, slo=None):
         self.engine = engine
         self.max_queue = max_queue
+        # chrome-trace process row for this scheduler's spans/requests
+        # (0 = single-engine; a fleet Replica sets replica_id + 1 so
+        # the router's merged trace shows each replica on its own row)
+        self.trace_pid = 0
+        # optional SLO tracking (serving/slo.py): completions feed the
+        # sliding window, every round re-evaluates, and the burn-rate
+        # verdict rides /healthz next to queue depth
+        self.slo_engine = _slo_as_engine(slo)
+        if self.slo_engine is not None:
+            engine.attach_health_probe(self.slo_engine.health)
+        # program flops/bytes per wave for the roofline gauges —
+        # resolved NOW, at construction, not at the first wave: the
+        # lowering-level cost analysis can stall for seconds on a real
+        # model, and a stall between wave and token-emit would be
+        # stamped into every in-flight request's inter-token gap,
+        # spiking the very TPOT/SLO window it feeds. program_costs is
+        # memoized per shape signature, so a fleet pays one lowering.
+        self._wave_cost = engine.program_costs().get("decode_wave") or {}
+        self.last_wave_s = None
         self.wave_retries = max(0, int(wave_retries))
         self.retry_backoff_s = float(retry_backoff_s)
         # paged engines: a request may be preempted by recompute (its KV
@@ -124,6 +153,7 @@ class Scheduler:
                 shed = f"queue full (max_queue={self.max_queue})"
             else:
                 shed = None
+                request.trace_pid = self.trace_pid
                 request._mark_submitted()
                 self._queue.append(request)
                 depth = len(self._queue)
@@ -218,6 +248,10 @@ class Scheduler:
                 continue
             req._cache_waiting = False         # wait episode (if any) over
             req._start_prefill(slot)
+            # engine-internal progress (per-chunk prefill) correlates
+            # to the request's chrome flow through the slot
+            self.engine.set_slot_trace(slot, req.trace_id,
+                                       self.trace_pid)
             self._slot_req[slot] = req
 
     def _prefill_fault(self, req, slot):
@@ -258,19 +292,26 @@ class Scheduler:
                 self._complete(req)
                 continue
             try:
-                with RecordEvent("serving/prefill"):
+                with RecordEvent("serving/prefill",
+                                 pid=self.trace_pid) as ev:
                     first = self.engine.prefill_step(slot)
             except Exception as e:   # noqa: BLE001 — fault barrier
                 self.last_error = e
                 if self._prefill_fault(req, slot):
                     return True
                 continue
+            finally:
+                self.metrics.on_phase("prefill_chunk", ev.elapsed)
             self._prefill_fail_streak = 0
             if first is None:
                 continue             # mid-prefill: decode waves go on
             self.metrics.on_prefill()
+            # prev_t is non-None only for a preempted-then-resumed
+            # request: its re-prefill token IS an inter-token gap (the
+            # preemption stall is real TPOT the client observed)
+            prev_t = req.last_token_time
             req._emit(first)
-            self.metrics.on_token(time.monotonic())
+            self.metrics.on_token(time.monotonic(), prev_t=prev_t)
             self._maybe_retire(slot, first)
         return False
 
@@ -298,6 +339,8 @@ class Scheduler:
     def _complete(self, req):
         self.completed.append(req)
         self.metrics.on_complete(req)
+        if self.slo_engine is not None:
+            self.slo_engine.observe_request(req)
 
     def _fault(self, kind, action=None, request=None, slot=None,
                error=None):
@@ -323,8 +366,12 @@ class Scheduler:
         delay = self.retry_backoff_s
         for attempt in range(self.wave_retries + 1):
             try:
-                with RecordEvent("serving/decode_wave"):
-                    return self.engine.decode_wave()
+                with RecordEvent("serving/decode_wave",
+                                 pid=self.trace_pid) as ev:
+                    toks = self.engine.decode_wave()
+                self.last_wave_s = ev.elapsed
+                self.metrics.on_phase("decode_wave", ev.elapsed)
+                return toks
             except Exception as e:   # noqa: BLE001 — fault barrier
                 self.last_error = e
                 self._fault("wave_error",
@@ -433,7 +480,9 @@ class Scheduler:
     def _step_locked(self):
         if self._degraded:
             return 0
-        self._admit()
+        with RecordEvent("serving/admission", pid=self.trace_pid) as ev:
+            self._admit()
+        self.metrics.on_phase("admission", ev.elapsed)
         # captured BEFORE the advance: a prefill that admits, emits its
         # first token, and retires within one round still counts as a
         # working round for the pool sample below
@@ -447,7 +496,10 @@ class Scheduler:
                 return 0                     # resolved, nothing pending
             waved = len(active) - len(self.engine.last_starved_slots)
             if waved > 0:     # all-starved rounds dispatch no program —
-                self.metrics.on_wave(waved)  # don't count phantom waves
+                self.metrics.on_wave(  # don't count phantom waves
+                    waved, wave_s=self.last_wave_s,
+                    flops=self._wave_cost.get("flops"),
+                    bytes_accessed=self._wave_cost.get("bytes_accessed"))
             # fused-sentinel fallout: retire ONLY the poisoned lanes —
             # their requests resolve with "error", healthy neighbours
             # stream on token-identically (proven in chaos_serving)
@@ -461,10 +513,15 @@ class Scheduler:
                 self._complete(req)
             self._preempt_starved()
             now = time.monotonic()
-            for slot, tok in toks.items():
-                self._slot_req[slot]._emit(tok)
-                self.metrics.on_token(now)
-                self._maybe_retire(slot, tok)
+            with RecordEvent("serving/host_dispatch",
+                             pid=self.trace_pid) as ev:
+                for slot, tok in toks.items():
+                    req = self._slot_req[slot]
+                    prev_t = req.last_token_time
+                    req._emit(tok)
+                    self.metrics.on_token(now, prev_t=prev_t)
+                    self._maybe_retire(slot, tok)
+            self.metrics.on_phase("host_dispatch", ev.elapsed)
         pool = getattr(self.engine, "block_pool", None)
         if pool is not None and (active or prefilled):
             # pool sample per WORKING round (idle spins don't dilute the
@@ -473,11 +530,16 @@ class Scheduler:
             self.metrics.on_blocks(pool.used, pool.usable)
             self.metrics.on_prefix_totals(pool.prefix_hits,
                                           pool.prefix_misses)
+        if self.slo_engine is not None and (active or prefilled):
+            # re-evaluate once per WORKING round: gauges track live,
+            # transitions journal, /healthz serves the cached verdict
+            self.slo_engine.evaluate()
         # chrome-trace counter track: occupancy/queue depth over time,
         # on the same timeline as the decode-wave slices
         if profiler.trace_enabled():
             profiler.emit_trace_event({
                 "ph": "C", "name": "serving/slots", "cat": "serving",
+                "pid": self.trace_pid,
                 "args": {"active": self.in_flight(),
                          "queued": self.queue_depth()}})
         return self.in_flight() + self.queue_depth()

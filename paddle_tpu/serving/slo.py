@@ -1,0 +1,298 @@
+"""SLO engine: declarative latency/error targets, sliding-window
+attainment, and error-budget burn rate.
+
+Queue depth says how much work is WAITING; it says nothing about
+whether the fleet is meeting a latency promise. This module turns the
+serving stack's own measurements (TTFT, TPOT, finish_reason) into the
+SRE vocabulary an autoscaler can act on:
+
+  * `SLOPolicy` — the declarative contract: p99-style targets
+    (`ttft_p99_s`, `tpot_p99_s`), an error-rate budget, the objective
+    (what fraction of requests must meet each latency target), and the
+    burn thresholds the autoscaler reacts to.
+  * `SLOEngine` — a sliding window of completed requests evaluated
+    against the policy. For each target the **error budget** is
+    `1 - objective` (for the error target, the `error_rate` itself) and
+    the **burn rate** is `bad_fraction / budget`: 1.0 means exactly
+    spending budget, >1 burning it, `fast_burn` (default 2.0) is the
+    page-the-oncall threshold. The engine's verdict is the WORST
+    target's burn.
+
+Wiring (all optional, nothing changes when no policy is configured):
+
+  * `Scheduler(slo=policy)` observes every completion and re-evaluates
+    each round; the verdict rides the engine's `/healthz` payload.
+  * `FleetRouter(slo=policy)` observes finalized fleet requests and its
+    autoscaler consumes the burn rate — scale up on fast burn, drain
+    the newest replica on sustained surplus — instead of raw queue
+    depth (the no-SLO fleet keeps the queue-depth behavior).
+  * Burn-rate transitions (alert/clear, scale actions) are journaled
+    through the current flight recorder as `slo` events and exported as
+    the `slo_burn_rate` / `slo_attainment` gauges.
+
+The engine is pure host-side bookkeeping — it never touches compiled
+programs, so the compile-once discipline is untouched by SLO tracking.
+"""
+import collections
+import threading
+import time
+
+from ..utils import flight_recorder, telemetry
+
+_BURN = telemetry.gauge(
+    "slo_burn_rate",
+    "Error-budget burn rate per SLO target (bad-fraction / budget over "
+    "the sliding window; 1.0 = spending exactly the budget, the fleet "
+    "autoscaler scales up past the policy's fast_burn threshold)",
+    labelnames=("slo",))
+_ATTAINMENT = telemetry.gauge(
+    "slo_attainment",
+    "Fraction of windowed requests meeting each SLO target (1.0 = "
+    "every request within target)",
+    labelnames=("slo",))
+
+#: the closed label set for the gauges above — one series per target
+#: plus the overall (worst-target) verdict
+TARGETS = ("ttft_p99", "tpot_p99", "error_rate", "overall")
+
+
+class SLOPolicy:
+    """Declarative serving SLO.
+
+    ttft_p99_s / tpot_p99_s: latency targets in seconds — a request is
+        "good" for the target when its measured TTFT / mean TPOT is
+        within it. `objective` is the fraction of requests that must be
+        good (0.99 = a 1% error budget).
+    error_rate: budget for requests resolving finish_reason "error"
+        (0.01 = 1% may fail before the budget burns).
+    window_s: sliding evaluation window (seconds).
+    fast_burn: burn rate at/above which the SLO is BREACHED (alerting +
+        fleet scale-up). slow_burn: burn rate at/below which the fleet
+        has sustained surplus (scale-down candidate).
+    cooldown_rounds: fleet rounds between burn-driven scale-ups, so one
+        long breach adds replicas stepwise instead of all at once.
+    """
+
+    def __init__(self, ttft_p99_s=None, tpot_p99_s=None, error_rate=None,
+                 objective=0.99, window_s=60.0, fast_burn=2.0,
+                 slow_burn=0.5, cooldown_rounds=4):
+        if ttft_p99_s is None and tpot_p99_s is None and error_rate is None:
+            raise ValueError("an SLOPolicy needs at least one target "
+                             "(ttft_p99_s, tpot_p99_s, or error_rate)")
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0, 1), "
+                             f"got {objective}")
+        if error_rate is not None and not 0.0 < error_rate <= 1.0:
+            raise ValueError(f"error_rate must be in (0, 1], "
+                             f"got {error_rate}")
+        if fast_burn <= slow_burn:
+            raise ValueError(f"fast_burn ({fast_burn}) must exceed "
+                             f"slow_burn ({slow_burn})")
+        self.ttft_p99_s = None if ttft_p99_s is None else float(ttft_p99_s)
+        self.tpot_p99_s = None if tpot_p99_s is None else float(tpot_p99_s)
+        self.error_rate = None if error_rate is None else float(error_rate)
+        self.objective = float(objective)
+        self.window_s = float(window_s)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self.cooldown_rounds = max(0, int(cooldown_rounds))
+
+    def describe(self):
+        """The policy as a flat dict (health payloads, bench rows)."""
+        return {"ttft_p99_s": self.ttft_p99_s,
+                "tpot_p99_s": self.tpot_p99_s,
+                "error_rate": self.error_rate,
+                "objective": self.objective,
+                "window_s": self.window_s,
+                "fast_burn": self.fast_burn,
+                "slow_burn": self.slow_burn}
+
+
+class SLOEngine:
+    """Sliding-window SLO evaluation over completed requests.
+
+    Thread-model: `observe*` is called from whichever thread drives the
+    scheduler/router loop; `evaluate()`/`health()` may be called from
+    exporter threads — everything mutable sits under one lock.
+    """
+
+    def __init__(self, policy, clock=time.monotonic):
+        if not isinstance(policy, SLOPolicy):
+            raise TypeError(f"policy must be an SLOPolicy, "
+                            f"got {type(policy).__name__}")
+        self.policy = policy
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._window = collections.deque()   # (t, ttft, tpot, error)
+        self._last = None                    # latest verdict dict
+        self._breached = False
+        self.peak_burn_rate = 0.0
+
+    # ---------------------------------------------------------- recording
+    def observe_request(self, request):
+        """Fold one finished request in (duck-typed: `.ttft`, `.tpot`,
+        `.finish_reason` — both replica-local Requests and fleet-level
+        FleetRequests qualify)."""
+        self.observe(ttft=request.ttft, tpot=request.tpot,
+                     error=(request.finish_reason == "error"))
+
+    def observe(self, ttft=None, tpot=None, error=False, t=None):
+        t = self._clock() if t is None else float(t)
+        with self._lock:
+            self._window.append((
+                t,
+                None if ttft is None else float(ttft),
+                None if tpot is None else float(tpot),
+                bool(error)))
+
+    def reset(self):
+        """Fresh window + peak (the bench evaluates load points
+        independently). The policy and gauge registrations stay."""
+        with self._lock:
+            self._window.clear()
+            self._last = None
+            self._breached = False
+            self.peak_burn_rate = 0.0
+
+    # --------------------------------------------------------- evaluation
+    def _prune(self, now):
+        horizon = now - self.policy.window_s
+        while self._window and self._window[0][0] < horizon:
+            self._window.popleft()
+
+    @staticmethod
+    def _target_verdict(vals, target, budget):
+        """(burn, attainment, n) for one latency target: `vals` are the
+        requests that produced a measurement; an empty window spends no
+        budget (burn 0, attainment 1)."""
+        vals = [v for v in vals if v is not None]
+        if not vals:
+            return 0.0, 1.0, 0
+        bad = sum(1 for v in vals if v > target)
+        frac = bad / len(vals)
+        return frac / budget, 1.0 - frac, len(vals)
+
+    def evaluate(self, now=None, publish=True):
+        """One verdict over the current window:
+
+        {"burn_rate", "attainment", "breached", "worst", "window_requests",
+         "targets": {name: {"burn_rate", "attainment", "requests"}}}
+
+        `burn_rate` is the worst target's; `breached` latches against
+        the policy's fast_burn. With publish=True (the scheduler/router
+        loop) the gauges are updated and alert/clear TRANSITIONS are
+        journaled through the current flight recorder; health probes use
+        the cached verdict and never publish."""
+        pol = self.policy
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            self._prune(now)
+            samples = list(self._window)
+        budget = max(1e-9, 1.0 - pol.objective)
+        targets = {}
+        if pol.ttft_p99_s is not None:
+            b, a, n = self._target_verdict(
+                [s[1] for s in samples], pol.ttft_p99_s, budget)
+            targets["ttft_p99"] = {"burn_rate": b, "attainment": a,
+                                   "requests": n}
+        if pol.tpot_p99_s is not None:
+            b, a, n = self._target_verdict(
+                [s[2] for s in samples], pol.tpot_p99_s, budget)
+            targets["tpot_p99"] = {"burn_rate": b, "attainment": a,
+                                   "requests": n}
+        if pol.error_rate is not None:
+            n = len(samples)
+            bad = sum(1 for s in samples if s[3])
+            frac = bad / n if n else 0.0
+            targets["error_rate"] = {"burn_rate": frac / pol.error_rate,
+                                     "attainment": 1.0 - frac,
+                                     "requests": n}
+        worst = max(targets, key=lambda k: targets[k]["burn_rate"],
+                    default=None)
+        burn = targets[worst]["burn_rate"] if worst else 0.0
+        attainment = min((t["attainment"] for t in targets.values()),
+                         default=1.0)
+        verdict = {
+            "burn_rate": burn,
+            "attainment": attainment,
+            "breached": burn >= pol.fast_burn,
+            "worst": worst,
+            "window_requests": len(samples),
+            "targets": targets,
+        }
+        if publish:
+            self._publish(verdict)
+        with self._lock:
+            self._last = verdict
+            self.peak_burn_rate = max(self.peak_burn_rate, burn)
+        return verdict
+
+    def _publish(self, verdict):
+        for name, t in verdict["targets"].items():
+            _BURN.labels(slo=name).set(t["burn_rate"])
+            _ATTAINMENT.labels(slo=name).set(t["attainment"])
+        _BURN.labels(slo="overall").set(verdict["burn_rate"])
+        _ATTAINMENT.labels(slo="overall").set(verdict["attainment"])
+        breached = verdict["breached"]
+        if breached != self._breached:
+            self._breached = breached
+            self._journal("burn_alert" if breached else "burn_clear",
+                          verdict)
+
+    def _journal(self, action, verdict, **extra):
+        rec = flight_recorder.get_recorder()
+        if rec is not None:
+            rec.slo(burn_rate=verdict["burn_rate"], action=action,
+                    attainment=verdict["attainment"],
+                    slo=verdict["worst"],
+                    window_requests=verdict["window_requests"], **extra)
+
+    def journal_scale(self, direction, verdict, replicas):
+        """The fleet autoscaler acted on this engine's burn rate —
+        journal the action next to the alert that caused it."""
+        self._journal("scale_" + direction, verdict, replicas=replicas)
+
+    # ---------------------------------------------------------- reporting
+    @property
+    def last_verdict(self):
+        with self._lock:
+            return self._last
+
+    def health(self):
+        """The /healthz satellite payload: the policy's targets plus the
+        latest verdict (computed lazily, never published — a dashboard
+        poll must not mint journal entries or move gauges)."""
+        verdict = self.last_verdict
+        if verdict is None:
+            verdict = self.evaluate(publish=False)
+        return {"slo": {
+            "burn_rate": round(verdict["burn_rate"], 4),
+            "attainment": round(verdict["attainment"], 6),
+            "breached": verdict["breached"],
+            "worst": verdict["worst"],
+            "window_requests": verdict["window_requests"],
+            "targets": self.policy.describe(),
+        }}
+
+    def summary(self):
+        """Compact rollup for bench rows: latest verdict + the peak
+        burn over this engine's lifetime (reset() starts a new one)."""
+        verdict = self.last_verdict
+        if verdict is None:
+            verdict = self.evaluate(publish=False)
+        with self._lock:
+            peak = self.peak_burn_rate
+        return {"attainment": round(verdict["attainment"], 6),
+                "burn_rate": round(verdict["burn_rate"], 4),
+                "burn_rate_peak": round(peak, 4),
+                "window_requests": verdict["window_requests"]}
+
+
+def as_engine(slo):
+    """Normalize a Scheduler/FleetRouter `slo=` argument: None passes
+    through, an SLOPolicy is wrapped, an SLOEngine is used as-is (NOT
+    shared implicitly — pass one engine to several consumers only when
+    a merged window is what you mean)."""
+    if slo is None or isinstance(slo, SLOEngine):
+        return slo
+    return SLOEngine(slo)

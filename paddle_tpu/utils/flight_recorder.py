@@ -42,7 +42,7 @@ class NonFiniteError(RuntimeError):
 
 EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
                "checkpoint", "xla_program", "jxaudit", "chaos", "fault",
-               "resume", "hang", "run_end")
+               "resume", "hang", "slo", "run_end")
 
 
 def _json_safe(v):
@@ -320,6 +320,26 @@ class FlightRecorder:
         fields.update(extra)
         return self.record("hang", **fields)
 
+    def slo(self, burn_rate, action, attainment=None, slo=None,
+            window_requests=None, **extra):
+        """The SLO engine's burn-rate state changed (serving/slo.py):
+        `action` names the transition — "burn_alert" (burn rate crossed
+        the fast-burn threshold), "burn_clear" (it came back under
+        budget), "scale_up"/"scale_down" (the fleet autoscaler acted on
+        it). `slo` names the worst target driving the verdict. Journaled
+        on TRANSITIONS, not per evaluation, so a long breach is two
+        lines, not a flood."""
+        fields = {"burn_rate": round(float(burn_rate), 4),
+                  "action": str(action)}
+        if attainment is not None:
+            fields["attainment"] = round(float(attainment), 6)
+        if slo is not None:
+            fields["slo"] = str(slo)
+        if window_requests is not None:
+            fields["window_requests"] = int(window_requests)
+        fields.update(extra)
+        return self.record("slo", **fields)
+
     def checkpoint(self, path=None, step=None, **extra):
         fields = {}
         if path is not None:
@@ -449,6 +469,47 @@ def device_peak_flops(device=None):
         if key in kind:
             return peak
     return _DEFAULT_PEAK_FLOPS
+
+
+# peak HBM bandwidth (bytes/s) by TPU device kind substring — the
+# denominator of the serving roofline's bandwidth axis, the way
+# _PEAK_FLOPS_BY_KIND is the compute axis. CPU/unknown fall back to a
+# nominal 100 GB/s so serving_hbm_util stays a defined,
+# comparable-across-runs number off-chip (same policy as MFU).
+_PEAK_HBM_BW_BY_KIND = (
+    ("v5p", 2765e9),
+    ("v5e", 819e9),
+    ("v5 lite", 819e9),
+    ("v5litepod", 819e9),
+    ("v6e", 1640e9),
+    ("trillium", 1640e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+_DEFAULT_PEAK_HBM_BW = 100e9
+
+
+def device_peak_hbm_bw(device=None):
+    """Peak HBM bandwidth (bytes/s) of the accelerator the serving
+    bandwidth-utilization gauge is measured against. `PT_PEAK_HBM_BW`
+    (float, bytes/s) overrides the table for parts not listed."""
+    env = os.environ.get("PT_PEAK_HBM_BW")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+        dev = device or jax.local_devices()[0]
+        kind = (getattr(dev, "device_kind", "") or "").lower()
+    except Exception:
+        return _DEFAULT_PEAK_HBM_BW
+    for key, peak in _PEAK_HBM_BW_BY_KIND:
+        if key in kind:
+            return peak
+    return _DEFAULT_PEAK_HBM_BW
 
 
 def normalize_cost_analysis(ca):

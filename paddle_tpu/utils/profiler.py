@@ -16,7 +16,7 @@ import time
 
 _lock = threading.Lock()
 _enabled = False
-_events = []          # (name, start_s, dur_s, thread_id)
+_events = []          # (name, start_s, dur_s, thread_id, pid)
 _raw_events = []      # chrome-format dicts (async spans, flow, counters)
 _trace_gen = 0        # bumped when _raw_events is cleared (new trace)
 _active_trace_dir = None
@@ -58,10 +58,19 @@ def emit_trace_event(event):
 
 class RecordEvent:
     """RAII host event (ref platform/profiler.h:127). Usable as context
-    manager or decorator; nesting is recorded flat like the reference."""
+    manager or decorator; nesting is recorded flat like the reference.
 
-    def __init__(self, name):
+    `pid` places the slice on a chrome-trace process row (the fleet
+    router exports each replica's scheduler activity on its own row —
+    pid = replica_id + 1, pid 0 is the router/host). `elapsed` holds
+    the measured duration in seconds after exit whether or not the
+    profiler was recording, so callers can both trace AND meter one
+    timed region (the scheduler's per-phase attribution)."""
+
+    def __init__(self, name, pid=0):
         self.name = name
+        self.pid = int(pid)
+        self.elapsed = None
         self._t0 = None
 
     def __enter__(self):
@@ -69,11 +78,12 @@ class RecordEvent:
         return self
 
     def __exit__(self, *exc):
-        if _enabled and self._t0 is not None:
-            with _lock:
-                _events.append((self.name, self._t0,
-                                time.perf_counter() - self._t0,
-                                threading.get_ident()))
+        if self._t0 is not None:
+            self.elapsed = time.perf_counter() - self._t0
+            if _enabled:
+                with _lock:
+                    _events.append((self.name, self._t0, self.elapsed,
+                                    threading.get_ident(), self.pid))
         return False
 
     def __call__(self, fn):
@@ -122,7 +132,7 @@ def summary(sorted_key="total"):
     agg = {}
     with _lock:
         evs = list(_events)
-    for name, _t0, dur, _tid in evs:
+    for name, _t0, dur, _tid, _pid in evs:
         a = agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
         a[0] += 1
         a[1] += dur
@@ -144,19 +154,23 @@ def summary(sorted_key="total"):
     return rows
 
 
-def export_chrome_tracing(path):
+def export_chrome_tracing(path, extra_events=()):
     """Write host events as chrome://tracing json (tools/timeline.py).
     RecordEvent slices ('X') merge with the raw events other layers emit
     through emit_trace_event (serving request spans/flows, counters) so
-    one trace shows host events, decode waves, and request lifecycles."""
+    one trace shows host events, decode waves, and request lifecycles.
+    `extra_events` are appended verbatim — the fleet router passes 'M'
+    process_name metadata naming each replica's pid row when it merges
+    the per-replica sinks into one trace."""
     with _lock:
         evs = list(_events)
         raw = [dict(e) for e in _raw_events]
     events = [
         {"name": name, "ph": "X", "ts": t0 * 1e6, "dur": dur * 1e6,
-         "pid": 0, "tid": tid % 10000, "cat": "host"}
-        for name, t0, dur, tid in evs]
-    trace = {"traceEvents": events + raw}
+         "pid": pid, "tid": tid % 10000, "cat": "host"}
+        for name, t0, dur, tid, pid in evs]
+    trace = {"traceEvents": events + raw + [dict(e)
+                                            for e in extra_events]}
     with open(path, "w") as f:
         json.dump(trace, f)
     return path

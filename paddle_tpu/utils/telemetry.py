@@ -686,7 +686,11 @@ def trace_request(request, state, reason=None):
         request._trace_gen = gen
     rid = int(getattr(request, "trace_id", 0)
               or getattr(request, "request_id", 0))
-    base = {"cat": "serving.request", "id": rid, "pid": 0,
+    # pid 0 = single-engine/host; fleet replicas stamp their requests
+    # with trace_pid = replica_id + 1 so one merged trace shows each
+    # replica's lifecycle spans on its own process row
+    base = {"cat": "serving.request", "id": rid,
+            "pid": int(getattr(request, "trace_pid", 0)),
             "tid": threading.get_ident() % 10000, "ts": profiler.now_us()}
     open_span = getattr(request, "_trace_span", None)
     if open_span is not None and open_span != state:
@@ -708,6 +712,35 @@ def trace_request(request, state, reason=None):
     if reason:
         flow["args"]["finish_reason"] = reason
     profiler.emit_trace_event(flow)
+
+
+def trace_flow_step(trace_id, state, pid=0, **args):
+    """Mid-flow chrome step ('t') for a fleet-level transition the
+    replica-local Request lifecycle cannot see: DISPATCH (the router
+    handed the request to a replica) and MIGRATE (a dead replica's hop
+    was resubmitted elsewhere). Shares cat/id/name with trace_request's
+    flow events, so the request's arrow runs QUEUED → DISPATCH →
+    PREFILL → DECODE → (MIGRATE → next replica's spans) → DONE across
+    process rows in one merged trace. No-op unless recording."""
+    if not profiler.trace_enabled():
+        return
+    profiler.emit_trace_event({
+        "cat": "serving.request", "id": int(trace_id), "ph": "t",
+        "name": "request", "pid": int(pid),
+        "args": {"state": str(state), **args}})
+
+
+def trace_instant(trace_id, name, pid=0, **args):
+    """Request-correlated chrome instant event ('i', thread-scoped) —
+    the paged engine marks each PREFILL_CHUNK[i] it runs this way, so a
+    chunked admission's progress is visible inside the PREFILL span.
+    No-op unless recording."""
+    if not profiler.trace_enabled():
+        return
+    profiler.emit_trace_event({
+        "cat": "serving.request", "id": int(trace_id), "ph": "i",
+        "s": "t", "name": str(name), "pid": int(pid),
+        "args": dict(args) if args else {}})
 
 
 # ---------------------------------------------------------------------------

@@ -32,7 +32,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import paddle_tpu as pt
 from paddle_tpu.serving import (FleetRouter, PagedServingEngine,
-                                Scheduler, ServingEngine)
+                                Scheduler, ServingEngine, SLOPolicy)
 from paddle_tpu.utils import profiler, telemetry
 
 t0 = time.time()
@@ -149,8 +149,14 @@ def fleet_snapshot(router, reqs, wall):
         # slowest member's, not an average that hides a hot replica
         "ttft_p50_s": _agg(snaps, "ttft_p50_s", max),
         "ttft_p99_s": _agg(snaps, "ttft_p99_s", max),
+        "tpot_p50_s": _agg(snaps, "tpot_p50_s", max),
+        "tpot_p99_s": _agg(snaps, "tpot_p99_s", max),
         "latency_p50_s": _agg(snaps, "latency_p50_s", max),
         "latency_p99_s": _agg(snaps, "latency_p99_s", max),
+        # roofline utilization: mean across replicas (each replica's
+        # waves measure the same compiled program)
+        "mfu": _agg(snaps, "mfu", lambda v: sum(v) / len(v)),
+        "hbm_util": _agg(snaps, "hbm_util", lambda v: sum(v) / len(v)),
         "slot_occupancy": _agg(
             snaps, "slot_occupancy", lambda v: sum(v) / len(v)),
         "queue_depth_peak": _agg(snaps, "queue_depth_peak", max),
@@ -263,6 +269,18 @@ def main():
                     help="fleet: queued requests per routable replica "
                          "that trigger a scale-up (default: autoscale "
                          "disabled)")
+    ap.add_argument("--slo-ttft-p99", type=float, default=None,
+                    help="SLO target: p99 TTFT in seconds — per-row "
+                         "attainment + burn-rate peaks roll into "
+                         "BENCH_serving.json (comparable across paged/"
+                         "fleet configs); with --replicas the fleet "
+                         "autoscaler consumes the burn rate")
+    ap.add_argument("--slo-tpot-p99", type=float, default=None,
+                    help="SLO target: p99 inter-token latency (TPOT) "
+                         "in seconds")
+    ap.add_argument("--slo-objective", type=float, default=0.99,
+                    help="fraction of requests that must meet each SLO "
+                         "latency target (error budget = 1 - objective)")
     ap.add_argument("--shared-prefix", type=int, default=0,
                     help="prepend this many fixed tokens to every "
                          "prompt (shared system prompt) — with --paged "
@@ -299,13 +317,25 @@ def main():
                              max_len=args.max_len,
                              prefill_len=args.prefill_len)
 
+    def make_slo():
+        if args.slo_ttft_p99 is None and args.slo_tpot_p99 is None:
+            return None
+        return SLOPolicy(ttft_p99_s=args.slo_ttft_p99,
+                         tpot_p99_s=args.slo_tpot_p99,
+                         objective=args.slo_objective)
+
     router = None
     if args.replicas is not None:
         router = FleetRouter(
             make_engine, replicas=args.replicas,
             policy=args.router_policy,
+            # the configured count is the sweep's floor: burn-driven
+            # surplus drains (slo) must not shrink a row's fleet below
+            # what the row claims to measure
+            min_replicas=args.replicas,
             max_replicas=args.max_replicas or args.replicas,
             scale_up_queue_depth=args.scale_up_queue_depth,
+            slo=make_slo(),
             scheduler_kwargs={"max_queue": args.max_queue,
                               "max_preemptions": args.max_preemptions})
         engine = router.replicas[0].engine
@@ -359,9 +389,10 @@ def main():
                                   output_range=(4, out_hi), seed=100 + i,
                                   shared_prefix=shared_prefix)
         else:
-            # fresh metrics per load point
+            # fresh metrics (and a fresh SLO window) per load point
             sched = Scheduler(engine, max_queue=args.max_queue,
-                              max_preemptions=args.max_preemptions)
+                              max_preemptions=args.max_preemptions,
+                              slo=make_slo())
             snap = run_load(sched, load, args.requests, args.vocab,
                             prompt_range=(4, args.prefill_len),
                             output_range=(4, out_hi), seed=100 + i,
@@ -385,6 +416,14 @@ def main():
             "detail": {
                 "ttft_p50_ms": round((snap["ttft_p50_s"] or 0) * 1e3, 2),
                 "ttft_p99_ms": round((snap["ttft_p99_s"] or 0) * 1e3, 2),
+                "tpot_p50_ms": round((snap.get("tpot_p50_s") or 0) * 1e3,
+                                     3),
+                "tpot_p99_ms": round((snap.get("tpot_p99_s") or 0) * 1e3,
+                                     3),
+                "serving_mfu": (None if snap.get("mfu") is None
+                                else round(snap["mfu"], 6)),
+                "serving_hbm_util": (None if snap.get("hbm_util") is None
+                                     else round(snap["hbm_util"], 6)),
                 "slot_occupancy": round(snap["slot_occupancy"], 4),
                 "queue_depth_peak": snap["queue_depth_peak"],
                 # resilience tallies THIS load point: shedding onset vs
@@ -439,6 +478,17 @@ def main():
                     None if snap["prefix_hits_per_request"] is None
                     else round(snap["prefix_hits_per_request"], 4)),
             })
+        slo_eng = (router.slo_engine if router is not None
+                   else sched.slo_engine)
+        if slo_eng is not None:
+            # SLO attainment + burn-rate peak per load point: "at what
+            # offered load does the latency promise break" reads off
+            # the row sequence, comparable across paged/fleet configs
+            row["detail"]["slo"] = dict(
+                slo_eng.summary(),
+                ttft_p99_s=args.slo_ttft_p99,
+                tpot_p99_s=args.slo_tpot_p99,
+                objective=args.slo_objective)
         rows.append(row)
         print(json.dumps(row), flush=True)
 

@@ -20,6 +20,10 @@ schema in docs/observability.md. The report covers:
   * the latest semantic-audit verdict (`jxaudit` events,
     scripts/jxaudit.py) — clean stamp or findings-per-rule,
   * top collectives by payload bytes (op+group),
+  * fleet events: replica kills/degradations/migrations/spawn failures
+    (the router's `fault` events) and the SLO engine's burn-rate
+    journal (`slo` events: alerts, clears, burn-driven scale actions,
+    peak burn) in a "fleet" table next to the compiled-programs table,
   * non-finite incidents and checkpoints,
   * chaos injections (`chaos` events, utils.chaos) next to the `fault`
     events the serving resilience layer wrote while recovering —
@@ -160,6 +164,34 @@ def summarize(events):
             key = e.get("kind", "?")
             faults_by_kind[key] = faults_by_kind.get(key, 0) + 1
 
+    # fleet: the router's replica_* fault kinds + the SLO engine's
+    # burn-rate journal (serving/slo.py) — one table shows what the
+    # fleet did to replicas and why the autoscaler moved
+    slo_events = [e for e in events if e.get("ev") == "slo"]
+    replica_kinds = {k: v for k, v in faults_by_kind.items()
+                     if k.startswith("replica_")}
+    fleet = None
+    if replica_kinds or slo_events:
+        burns = [_num(e.get("burn_rate")) for e in slo_events]
+        burns = [b for b in burns if b is not None]
+        slo_actions = {}
+        for e in slo_events:
+            a = e.get("action", "?")
+            slo_actions[a] = slo_actions.get(a, 0) + 1
+        fleet = {
+            "migrations": replica_kinds.get("replica_migration", 0),
+            "kills": replica_kinds.get("replica_killed", 0),
+            "degraded": replica_kinds.get("replica_degraded", 0),
+            "spawn_failures": replica_kinds.get("replica_spawn_failed",
+                                                0),
+            "slo": None if not slo_events else {
+                "events": len(slo_events),
+                "actions": slo_actions,
+                "burn_rate_peak": max(burns) if burns else None,
+                "last_burn_rate": burns[-1] if burns else None,
+            },
+        }
+
     by_coll = {}
     for c in colls:
         key = (c.get("op", "?"), c.get("group", "default"))
@@ -192,6 +224,7 @@ def summarize(events):
         "collectives": top_collectives,
         "chaos": chaos_by_point,
         "faults": faults_by_kind,
+        "fleet": fleet,
         "checkpoints": sum(1 for e in events
                            if e.get("ev") == "checkpoint"),
         "last_loss": next((l for l in reversed(losses) if l is not None),
@@ -277,6 +310,20 @@ def render(s):
             lines.append(f"  {agg['op']}[{agg['group']}]: "
                          f"{agg['calls']} calls, "
                          f"{_fmt_bytes(agg['bytes'])}")
+    fl = s.get("fleet")
+    if fl:
+        lines.append("fleet:")
+        lines.append(f"  {'event':<16}{'count':>7}")
+        for key in ("kills", "degraded", "migrations",
+                    "spawn_failures"):
+            if fl[key]:
+                lines.append(f"  {key:<16}{fl[key]:>7}")
+        slo = fl.get("slo")
+        if slo and slo["burn_rate_peak"] is not None:
+            acts = ", ".join(f"{k}={v}"
+                             for k, v in sorted(slo["actions"].items()))
+            lines.append(f"  slo burn: peak={slo['burn_rate_peak']:.2f} "
+                         f"last={slo['last_burn_rate']:.2f} ({acts})")
     if s.get("chaos"):
         inj = ", ".join(f"{k}={v}" for k, v in sorted(s["chaos"].items()))
         lines.append(f"chaos injections: {inj}")

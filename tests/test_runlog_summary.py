@@ -40,6 +40,20 @@ def _generate_journal(path):
                         peak_memory_bytes=26743969, fusion_count=349)
         rec.jxaudit(findings=2, by_rule={"donation-missing": 2},
                     programs=6, degraded=0)
+        # fleet events: the router's replica_* fault kinds + the SLO
+        # engine's burn journal (serving/slo.py schema)
+        rec.fault(kind="replica_killed", action="replace",
+                  error="replica 0")
+        rec.fault(kind="replica_migration", action="resubmitted",
+                  request_id=3, error="replica 0 -> 1")
+        rec.fault(kind="replica_migration", action="resubmitted",
+                  request_id=4, error="replica 0 -> 1")
+        rec.slo(burn_rate=2.5, action="burn_alert", attainment=0.4,
+                slo="tpot_p99", window_requests=8)
+        rec.slo(burn_rate=2.5, action="scale_up", attainment=0.4,
+                slo="tpot_p99", window_requests=8, replicas=2)
+        rec.slo(burn_rate=0.8, action="burn_clear", attainment=0.96,
+                slo="tpot_p99", window_requests=8)
     return path
 
 
@@ -66,6 +80,11 @@ def test_cli_end_to_end(tmp_path):
     # semantic-audit verdict renders next to the programs table
     assert "semantic audit (jxaudit): 2 finding(s) (6 programs) — " \
            "donation-missing=2" in text
+    # fleet table: replica events + the SLO burn journal
+    assert "fleet:" in text
+    assert "kills" in text and "migrations" in text
+    assert "slo burn: peak=2.50 last=0.80" in text
+    assert "burn_alert=1" in text and "scale_up=1" in text
 
 
 def test_cli_json_mode(tmp_path):
@@ -90,6 +109,28 @@ def test_cli_json_mode(tmp_path):
     assert summary["jxaudit"] == {
         "runs": 1, "findings": 2, "by_rule": {"donation-missing": 2},
         "programs": 6, "degraded": 0}
+    assert summary["fleet"] == {
+        "migrations": 2, "kills": 1, "degraded": 0, "spawn_failures": 0,
+        "slo": {"events": 3,
+                "actions": {"burn_alert": 1, "scale_up": 1,
+                            "burn_clear": 1},
+                "burn_rate_peak": 2.5, "last_burn_rate": 0.8}}
+
+
+def test_fleet_section_absent_without_fleet_events(tmp_path):
+    """A single-engine training journal renders NO fleet table."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import runlog_summary
+    finally:
+        sys.path.pop(0)
+    events = [{"ev": "run_start", "ts": 0, "seq": 1},
+              {"ev": "fault", "ts": 1, "seq": 2, "kind": "wave_error",
+               "action": "retry"},
+              {"ev": "run_end", "ts": 2, "seq": 3, "status": "ok"}]
+    s = runlog_summary.summarize(events)
+    assert s["fleet"] is None
+    assert "fleet:" not in runlog_summary.render(s)
 
 
 def test_summarize_importable_without_jax_side_effects(tmp_path):

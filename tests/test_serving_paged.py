@@ -473,7 +473,8 @@ def test_all_starved_wave_not_counted_in_occupancy(model):
     sched = Scheduler(eng)
     waves = []
     orig = sched.metrics.on_wave
-    sched.metrics.on_wave = lambda n: (waves.append(n), orig(n))[1]
+    sched.metrics.on_wave = (
+        lambda n, **kw: (waves.append(n), orig(n, **kw))[1])
     reqs = [sched.submit(prompt=_prompt(70 + i, n=BLOCK), max_tokens=12)
             for i in range(2)]
     sched.run()

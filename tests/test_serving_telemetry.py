@@ -109,7 +109,17 @@ def test_snapshot_keys_byte_compatible(engine):
         "prefix_hit_rate",
         # fleet PR appended the raw span endpoints (rollups across
         # replicas need min(first)/max(last), not per-engine spans)
-        "first_token_time", "last_token_time"]
+        "first_token_time", "last_token_time",
+        # observability PR appended TPOT percentiles, the per-round
+        # phase split, and the wave-integral roofline
+        "tpot_p50_s", "tpot_p99_s", "phase_seconds", "mfu", "hbm_util"]
+    # a 3-token request has 2 inter-token gaps — TPOT is real, and the
+    # phase split saw every phase of a working round
+    assert snap["tpot_p50_s"] is not None
+    assert snap["phase_seconds"]["decode_wave"] > 0
+    assert set(snap["phase_seconds"]) >= {"admission", "prefill_chunk",
+                                          "decode_wave",
+                                          "host_dispatch"}
     # dense engine: the paged-pool keys are present but empty
     assert snap["block_utilization"] is None
     assert snap["prefix_hits"] == 0 and snap["prefix_hit_rate"] is None
