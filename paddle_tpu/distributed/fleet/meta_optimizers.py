@@ -22,9 +22,22 @@ class MetaOptimizerBase(Optimizer):
         self._grad_clip = inner_opt._grad_clip
         self._weight_decay = inner_opt._weight_decay
         self._accumulators = inner_opt._accumulators
-        self._global_step = inner_opt._global_step
         # transform flags consumed by TrainStep/hapi
         self.transforms = dict(getattr(inner_opt, "transforms", {}))
+
+    # the step counter lives on the INNER optimizer (checkpoints restore
+    # it there via the delegated set_state_dict, and state_dict reads it
+    # back from there) — a snapshot copy at wrap time would let the
+    # wrapper and inner counters drift, so a rebuilt train step seeded
+    # from the wrapper would restart its Adam bias correction and step
+    # numbering at 0 after a resume
+    @property
+    def _global_step(self):
+        return self.inner_opt._global_step
+
+    @_global_step.setter
+    def _global_step(self, value):
+        self.inner_opt._global_step = value
 
     # default passthroughs
     def get_lr(self):

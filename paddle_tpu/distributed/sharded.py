@@ -11,6 +11,40 @@ fleet meta-optimizer program rewrites): ONE jit over a Mesh.
   - XLA SPMD partitioner inserts + schedules all collectives over ICI
     (gradient AllReduce, TP AllReduces, AllGathers) — bucketing/overlap is
     the compiler's latency-hiding scheduler.
+  - exact-resume + elastic reshard (docs/robustness.md): the step fires
+    the same `chaos.TRAIN_STEP` kill point, fuses the same grad-norm /
+    non-finite sentinel, and carries the same flight-recorder
+    instrumentation as the single-chip TrainStep, so
+    `scripts/chaos_train.py --mesh dp=N --resume-mesh dp=M` can prove a
+    killed sharded run resumes bitwise-identically onto a DIFFERENT
+    replica count. `sync()` gathers the dp-sharded optimizer slots into
+    host copies (the PR-7 optimizer-copy contract, per shard), and
+    `sharding_state()` is what `Model.save` records in the `.pdtrain`
+    payload so a resume can re-derive placements on the new mesh.
+
+    The `exact_reshard` flag (opt-in: constructor kwarg or fleet
+    `sharding_configs={"stage": 1, "exact_reshard": True}`) selects
+    STORAGE-sharded, math-replicated execution: every dp-sharded state
+    leaf is gathered to its full logical shape before arithmetic
+    touches it (`with_sharding_constraint` to replicated), the whole
+    forward/backward/update computes at dp-invariant tile shapes, and
+    the out_shardings slice results back to their shards. The only
+    dp-dependent collectives are all-gather (concatenation) and
+    dynamic-slice — both bitwise-clean — so with a batch the mesh
+    cannot dp-shard (leading dim not divisible), the per-step
+    (loss, grad-norm, params, moments) are bit-identical across dp
+    counts: a dp=2 checkpoint resumes on dp=4 bitwise. Measured on
+    this XLA build, the default drifts by ~1 ulp per step across dp
+    counts: per-shard tile geometry changes the compiler's fma/fusion
+    choices even for the purely elementwise Adam update, and a
+    dp-sharded batch's gradient psum tree reorders with dp. The
+    default (False) keeps full ZeRO compute sharding —
+    reduce-scattered grads, shard-local update math and transients —
+    the right trade when throughput matters more than cross-mesh
+    exactness; kill/resume onto the SAME mesh is bitwise in both
+    modes, and storage stays sharded either way
+    (`opt_specs`/`param_specs`), so the persistent opt-state residency
+    win of arXiv:2004.13336 always holds.
 """
 import functools
 
@@ -21,7 +55,33 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..framework import state
 from ..framework.tensor import Tensor
+from ..jit import InstrumentedStepMixin, grad_norm_sentinel
+from ..utils import chaos, telemetry
 from . import mesh as mesh_mod
+
+#: per-device bytes of the dp-sharded optimizer state gathered at the
+#: last checkpoint sync — the live measurement of ZeRO's memory win
+#: (total state bytes / dp when sharding engaged; catalog:
+#: docs/observability.md)
+_SHARD_BYTES = telemetry.gauge(
+    "checkpoint_shard_bytes",
+    "Per-device bytes of dp-sharded optimizer state at the last "
+    "checkpoint sync")
+
+
+def _shard_nbytes(arr):
+    """Per-device bytes of one (possibly sharded) array."""
+    try:
+        shape = arr.sharding.shard_shape(arr.shape)
+    except Exception:
+        shape = arr.shape
+    return int(np.prod(shape, dtype=np.int64)) * arr.dtype.itemsize
+
+
+def _spec_doc(spec):
+    """PartitionSpec -> picklable list (axis name, None, or list of
+    names per dim) for the `.pdtrain` sharding record."""
+    return [list(e) if isinstance(e, (tuple, list)) else e for e in spec]
 
 
 def _unwrap(x):
@@ -72,7 +132,7 @@ def _zero_spec(shape, mesh, dp_axis, base_spec):
     return base_spec
 
 
-class ShardedTrainStep:
+class ShardedTrainStep(InstrumentedStepMixin):
     """Compiled SPMD train step over the current Mesh.
 
     Usage:
@@ -83,7 +143,7 @@ class ShardedTrainStep:
 
     def __init__(self, model, loss_fn, optimizer, mesh=None, dp_axis=None,
                  zero_stage=0, donate=True, remat=False, shard_seq=True,
-                 return_outputs=False):
+                 return_outputs=False, exact_reshard=False):
         from ..jit import transforms as tfm
         self.model = model
         self.loss_fn = loss_fn
@@ -100,6 +160,13 @@ class ShardedTrainStep:
         remat = remat or self.transforms.get("recompute") is not None
         self.zero_stage = zero_stage
         self.shard_seq = shard_seq
+        # deterministic-elastic mode rides the sharding strategy too
+        # (fleet sharding_configs={"stage": 1, "exact_reshard": True}),
+        # so fit-built steps can opt in without new plumbing
+        sh_cfg = self.transforms.get("sharding") or {}
+        if "exact_reshard" in sh_cfg:
+            exact_reshard = bool(sh_cfg["exact_reshard"])
+        self.exact_reshard = bool(exact_reshard)
 
         params, buffers = model.functional_state()
         named_params = dict(model.named_parameters())
@@ -126,7 +193,16 @@ class ShardedTrainStep:
         #      before the matmul and the backward reduce-scatters dL/dW
         #      straight back to the shard (test_zero3.py asserts both
         #      collectives exist and per-device bytes are size/dp).
-        opt_state = optimizer.init_opt_state(params)
+        # parameters= threads the live Parameter objects through so an
+        # optimizer carrying RESTORED accumulators (checkpoint resume —
+        # possibly written on a DIFFERENT mesh) seeds the functional
+        # state; device_put below then reshards the restored host
+        # copies onto THIS mesh's placements (the elastic-reshard load
+        # path). Without it a rebuilt sharded step would zero the
+        # moments on every resume, exactly the TrainStep bug PR 10
+        # fixed on the single-chip path.
+        opt_state = optimizer.init_opt_state(
+            params, parameters=named_params)
         self.opt_specs = {}
         for n, slots in opt_state.items():
             base = self.param_specs[n]
@@ -142,7 +218,16 @@ class ShardedTrainStep:
                                                  self.param_specs[n])
 
         def shard(x, spec):
-            return jax.device_put(x, NamedSharding(self.mesh, spec))
+            # jnp.copy BEFORE the placement: a restored/set_value'd leaf
+            # can be a ZERO-COPY view of host numpy memory (jax 0.4.37's
+            # CPU client aliases aligned numpy buffers), and the
+            # compiled step DONATES these — XLA freeing memory numpy
+            # owns corrupts the heap ("double free"/"corrupted
+            # double-linked list" on the first post-restore step). The
+            # copy materializes an XLA-owned buffer first, exactly what
+            # jit.TrainStep.__init__ does for the same reason;
+            # construction-time-only cost.
+            return jax.device_put(jnp.copy(x), NamedSharding(self.mesh, spec))
 
         self.params = {n: shard(a, self.param_specs[n])
                        for n, a in params.items()}
@@ -248,8 +333,31 @@ class ShardedTrainStep:
                 return P(dp_axis_name)
             return P()
 
+        exact = self.exact_reshard
+
         def _step(params, buffers, opt_state, acc, key, lr, step_i,
                   inputs, labels):
+            if exact:
+                # storage-sharded, math-replicated: gather every sharded
+                # state leaf to its full logical shape BEFORE any
+                # arithmetic touches it. Elementwise update math is then
+                # compiled at dp-invariant tile shapes (XLA's fma/fusion
+                # choices depend on the per-shard tile geometry — at
+                # dp=2 vs dp=4 the same Adam update rounds differently
+                # by 1 ulp otherwise), and the out_shardings slice the
+                # results back to their shards. The collectives this
+                # inserts (all-gather = concat in, dynamic-slice out)
+                # are bitwise-clean, which is the whole point.
+                rep = NamedSharding(mesh, P())
+
+                def _gather(t):
+                    return jax.tree.map(
+                        lambda a: jax.lax.with_sharding_constraint(a, rep),
+                        t)
+
+                params = _gather(params)
+                opt_state = _gather(opt_state)
+                acc = _gather(acc)
             if fp16_ar:
                 batch_spec = jax.tree.map(_batch_dp_spec, inputs)
                 label_spec = jax.tree.map(_batch_dp_spec, labels)
@@ -270,9 +378,31 @@ class ShardedTrainStep:
 
                 (loss, (new_buf, outs)), grads = jax.value_and_grad(
                     pure_loss, has_aux=True)(params)
+                if exact:
+                    # pin the backward's results REPLICATED before the
+                    # dp-sharded update reads them: sharding propagation
+                    # then computes the whole backward at full logical
+                    # shapes on every device (dp-count-invariant
+                    # reduction trees — the bitwise elastic-reshard
+                    # contract, see module docstring), instead of
+                    # materializing reduce-scattered grads whose
+                    # per-shard tile geometry varies with dp
+                    rep = NamedSharding(mesh, P())
+                    grads = jax.tree.map(
+                        lambda g: jax.lax.with_sharding_constraint(g, rep),
+                        grads)
             new_params, new_opt, new_acc = update_fn(
                 params, grads, opt_state, acc, lr, step_i)
-            return loss, new_params, new_buf, new_opt, new_acc, outs
+            # the SAME fused sentinel as jit.TrainStep (one shared
+            # implementation — the (loss, grad_norm) pair IS what the
+            # kill/resume parity gate compares across step flavours).
+            # Under exact_reshard the grads are pinned replicated (the
+            # fp16 path's psum out_specs already are), so the reduction
+            # runs at full logical shape on every device —
+            # dp-count-invariant.
+            grad_norm, notfinite = grad_norm_sentinel(loss, grads)
+            return (loss, new_params, new_buf, new_opt, new_acc, outs,
+                    grad_norm, notfinite)
 
         # output shardings mirror inputs so state stays put across steps
         ns = lambda spec: NamedSharding(mesh, spec)
@@ -281,14 +411,23 @@ class ShardedTrainStep:
         opt_sh = {n: {sn: ns(s) for sn, s in slots.items()}
                   for n, slots in self.opt_specs.items()}
         acc_sh = {n: param_sh[n] for n in self.grad_acc}
+        donate_args = (0, 1, 2, 3) if donate else ()
+        # the declaration of record for the program-level audit
+        # (tools/jxaudit, xprof sharded_train_step_spec) — PjitFunction
+        # exposes no public donate introspection
+        self._donate_argnums = donate_args
         self._compiled = jax.jit(
             _step,
             in_shardings=(param_sh, buffer_sh, opt_sh, acc_sh, None, None,
                           None, None, None),
             out_shardings=(ns(P()), param_sh, buffer_sh, opt_sh, acc_sh,
-                           None),
-            donate_argnums=(0, 1, 2, 3) if donate else (),
+                           None, ns(P()), ns(P())),
+            donate_argnums=donate_args,
         )
+        # flight-recorder instrumentation (attach_flight_recorder); the
+        # label keys xla_compiles_total{function=} and matches the
+        # xprof registry's tracked-program name
+        self._init_instrumentation(label="sharded_train_step")
 
     # ------------------------------------------------------------------ step
     def _shard_batch(self, arrs):
@@ -312,26 +451,90 @@ class ShardedTrainStep:
         return tuple(out)
 
     def __call__(self, inputs, labels):
+        if chaos.enabled():
+            # same kill/stall boundary as jit.TrainStep: host-side,
+            # BEFORE the step counter, the RNG draw, or the compiled
+            # dispatch — a raise here leaves every piece of (sharded)
+            # training state exactly at the last completed step
+            chaos.fire(chaos.TRAIN_STEP, step=self._step_i + 1)
         inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
         labels = labels if isinstance(labels, (list, tuple)) else (labels,)
         self._step_i += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        with self.mesh:
-            (loss, self.params, self.buffers, self.opt_state,
-             self.grad_acc, outs) = self._compiled(
-                self.params, self.buffers, self.opt_state, self.grad_acc,
+        args = (self.params, self.buffers, self.opt_state, self.grad_acc,
                 state.next_rng_key(), lr,
                 jnp.asarray(self._step_i, jnp.int32),
                 self._shard_batch(inputs), self._shard_batch(labels))
+        with self.mesh:
+            if self._recorder is not None:
+                loss, outs = self._instrumented_call(args)
+            else:
+                (loss, self.params, self.buffers, self.opt_state,
+                 self.grad_acc, outs, self._last_grad_norm,
+                 self._last_nonfinite) = self._compiled(*args)
         if self.return_outputs:
             return Tensor(loss), _wrap(outs)
         return Tensor(loss)
 
     def sync(self):
+        """Write functional state back into the Layer/Optimizer objects.
+        The dp-sharded optimizer slots are GATHERED into host copies
+        (device_get on a sharded array assembles the full logical
+        array), so the snapshot `optimizer.state_dict()` hands the
+        checkpoint survives later donated steps — the PR-7 optimizer-
+        copy contract, now per shard. `checkpoint_shard_bytes` records
+        the per-device footprint of what was gathered (the live ZeRO
+        memory-win measurement)."""
         named_p = dict(self.model.named_parameters())
         for n, arr in self.params.items():
             named_p[n]._data = jnp.copy(jax.device_get(arr))
         named_b = dict(self.model.named_buffers())
         for n, arr in self.buffers.items():
             named_b[n]._data = jnp.copy(jax.device_get(arr))
-        self.optimizer._global_step = self._step_i
+        opt = self.optimizer
+        opt._global_step = self._step_i
+        stale = None
+        if chaos.enabled():
+            # positive control for the reshard parity harness
+            # (--inject stale-shard): a gather that silently loses the
+            # dp shards' updates for one parameter's slots must make
+            # the kill/resume parity check fail
+            stale = chaos.value(chaos.SHARD_STATE, default=None)
+        shard_bytes = 0
+        stale_hit = False
+        for n, slots in self.opt_state.items():
+            host = {}
+            for sn, arr in slots.items():
+                shard_bytes += _shard_nbytes(arr)
+                full = jnp.asarray(jax.device_get(arr))
+                if stale is not None and not stale_hit and \
+                        (stale is True or str(stale) in n):
+                    full = jnp.zeros_like(full)
+                host[sn] = full
+            if stale is not None and not stale_hit and \
+                    (stale is True or str(stale) in n):
+                stale_hit = True
+            opt._accumulators[id(named_p[n])] = host
+        _SHARD_BYTES.set(shard_bytes)
+
+    def sharding_state(self):
+        """The placement record `Model.save` embeds in the `.pdtrain`
+        payload (utils/resume.capture_train_state): mesh shape, dp
+        axis, ZeRO stage, and the per-leaf PartitionSpecs — everything
+        a resume needs to KNOW how the checkpoint was laid out, and to
+        journal the `reshard` event when the current mesh differs. The
+        restore path re-derives placements for the CURRENT mesh (a
+        fresh ShardedTrainStep device_puts the restored host copies),
+        so these specs are provenance, not instructions."""
+        return {
+            "mesh": {name: int(self.mesh.shape[name])
+                     for name in self.mesh.axis_names},
+            "dp_axis": self.dp_axis,
+            "zero_stage": int(self.zero_stage),
+            "exact_reshard": bool(self.exact_reshard),
+            "param_specs": {n: _spec_doc(s)
+                            for n, s in self.param_specs.items()},
+            "opt_specs": {n: {sn: _spec_doc(s)
+                              for sn, s in slots.items()}
+                          for n, slots in self.opt_specs.items()},
+        }

@@ -39,10 +39,15 @@ MANIFEST_NAME = "latest.json"
 #: manifest schema version: 1 = path/step/files+sha256 (PR 8),
 #: 2 = + full train-state file (`.pdtrain`: RNG chains, data cursor,
 #: scaler, global step — utils/resume.py) listed and digested like any
-#: other checkpoint file. Readers accept older manifests (missing
-#: version == 1); the version field exists so FUTURE incompatible
-#: layouts can be refused instead of half-loaded.
-MANIFEST_VERSION = 2
+#: other checkpoint file, 3 = the `.pdtrain` payload additionally
+#: carries the sharded-training provenance record (mesh shape,
+#: dp_axis, zero_stage, per-leaf PartitionSpecs —
+#: `ShardedTrainStep.sharding_state`), which is what elastic reshard
+#: (`fit(resume=True)` onto a different replica count) journals
+#: against. Readers accept older manifests (missing version == 1); the
+#: version field exists so FUTURE incompatible layouts can be refused
+#: instead of half-loaded.
+MANIFEST_VERSION = 3
 
 
 class _TensorPayload:

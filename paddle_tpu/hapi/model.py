@@ -152,6 +152,9 @@ class Model:
             steps = None
         # resume target (stashed by load_latest; consumed exactly once)
         resume_info = None
+        # never inherit a prior (possibly crashed) resume's provenance
+        # stash — only THIS fit's resume block may arm the drift check
+        self._resume_sharding = None
         if resume:
             resume_info, self._resume_state = self._resume_state, None
         start_epoch, start_batch, epoch_rng_snapshot = 0, 0, None
@@ -205,6 +208,23 @@ class Model:
                     recorder, prior_run_id=resume_info.get("run_id"),
                     step=resume_info.get("step"), epoch=start_epoch,
                     batch=start_batch)
+                # elastic reshard: a checkpoint written on a different
+                # mesh journals the layout transition (the rebuilt
+                # sharded step re-derives placements for the CURRENT
+                # mesh on first use — utils/resume.maybe_record_reshard)
+                resume_mod.maybe_record_reshard(resume_info, recorder)
+                # stash the provenance so the first built step can be
+                # checked against it (train_batch_parts): a resume that
+                # silently loses the sharding strategy — no ZeRO, no
+                # exact_reshard — would otherwise drift off the
+                # checkpointed run with no sign in the journal
+                self._resume_sharding = resume_info.get("sharding")
+                if wd is not None:
+                    # the resumed first step carries a fresh compile (a
+                    # resharded step always recompiles); an EWMA warmed
+                    # on the pre-kill cadence would journal it as a
+                    # false hang episode
+                    wd.reset_warmup()
             cb_list.on_begin("train", {"epochs": epochs, "steps": steps,
                                        "verbose": verbose,
                                        "metrics": self._metric_names()})
@@ -325,6 +345,10 @@ class Model:
                     "carry run/checkpoint events but no step/compile/"
                     "nonfinite events", stacklevel=2)
                 self._fr_unsupported_warned = True
+        shard_doc = getattr(self, "_resume_sharding", None)
+        if shard_doc:
+            self._resume_sharding = None
+            self._warn_resume_sharding_drift(shard_doc, recorder)
         if data_wait is not None and \
                 hasattr(self._train_step, "set_data_wait"):
             self._train_step.set_data_wait(data_wait, batch=batch_idx)
@@ -353,6 +377,42 @@ class Model:
         if isinstance(self._optimizer._lr, LRScheduler):
             self._optimizer._lr.step()
         return loss, metric_logs
+
+    def _warn_resume_sharding_drift(self, shard_doc, recorder=None):
+        """The checkpoint's sharding provenance vs the step this resume
+        actually REBUILT. The record is provenance, not instructions —
+        nothing restores the fleet strategy for the caller — so a
+        resume that dropped it (no mesh, different zero_stage, lost
+        exact_reshard) still runs; but it silently forks the
+        checkpointed run's layout/bitwise contract, and that must be a
+        visible warning + journaled `fault`, not nothing."""
+        step = self._train_step
+        drift = {}
+        state_fn = getattr(step, "sharding_state", None)
+        if state_fn is None:
+            drift["step"] = (f"sharded ({shard_doc.get('mesh')})",
+                             type(step).__name__)
+        else:
+            now = state_fn()
+            for key in ("zero_stage", "exact_reshard"):
+                want = shard_doc.get(key)
+                if want is not None and now.get(key) != want:
+                    drift[key] = (want, now.get(key))
+        if not drift:
+            return
+        import warnings
+        desc = "; ".join(f"{k}: checkpoint={a!r} resumed={b!r}"
+                         for k, (a, b) in sorted(drift.items()))
+        warnings.warn(
+            f"resume dropped the checkpoint's sharding configuration "
+            f"({desc}) — the resumed run will not follow the "
+            "checkpointed run's layout/parity contract (re-apply the "
+            "fleet sharding strategy before fit(resume=True))",
+            stacklevel=3)
+        if recorder is not None:
+            recorder.fault(kind="reshard_config_drift",
+                           action="warned", **{k: list(v)
+                                               for k, v in drift.items()})
 
     def train_batch(self, inputs, labels=None):
         """Single train step (ref hapi/model.py train_batch)."""
@@ -496,9 +556,16 @@ class Model:
             os.unlink(path + ".pdopt")
         recorder = fr.get_recorder()
         if training:
+            # a sharded step records its placement provenance (mesh
+            # shape, dp_axis, zero_stage, per-leaf PartitionSpecs) in
+            # the .pdtrain payload — what an elastic reshard journals
+            # against; single-chip steps record None
+            sharding_fn = getattr(self._train_step, "sharding_state",
+                                  None)
             doc = resume_mod.capture_train_state(
                 cursor=self._fit_cursor, step=step, scaler=self._scaler,
-                run_id=None if recorder is None else recorder.run_id)
+                run_id=None if recorder is None else recorder.run_id,
+                sharding=None if sharding_fn is None else sharding_fn())
             files[base + ".pdtrain"] = serialization.save(
                 doc, path + ".pdtrain")
         elif os.path.exists(path + ".pdtrain"):

@@ -254,97 +254,36 @@ def to_static(layer_or_fn=None, input_spec=None, **kwargs):
     return StaticFunction(layer_or_fn, input_spec=input_spec)
 
 
-class TrainStep:
-    """Whole-train-step compiler: loss + grads + optimizer in one XLA program.
+def grad_norm_sentinel(loss, grads):
+    """(global_grad_norm, notfinite) fused into a compiled train step —
+    ONE implementation for TrainStep and ShardedTrainStep: the
+    (loss, grad_norm) pair is exactly what the kill/resume parity gate
+    (scripts/chaos_train.py) compares across the two step flavours, so
+    the reduction must never drift between them. A tiny fp32 reduction
+    over the grads that XLA fuses into the backward — no extra host
+    sync (the flag is only ever READ by an instrumented caller that is
+    about to block anyway)."""
+    gsq = sum((jnp.sum(jnp.square(g.astype(jnp.float32)))
+               for g in jax.tree_util.tree_leaves(grads)),
+              jnp.asarray(0.0, jnp.float32))
+    notfinite = jnp.logical_not(
+        jnp.all(jnp.isfinite(loss)) & jnp.isfinite(gsq))
+    return jnp.sqrt(gsq), notfinite
 
-    Usage:
-        step = TrainStep(model, loss_fn, opt)
-        loss = step(x, y)          # one device dispatch
-        step.sync()                # write state back into model/opt
-    """
 
-    def __init__(self, model, loss_fn, optimizer, donate=True,
-                 return_outputs=False):
-        from . import transforms as tfm
-        self.model = model
-        self.loss_fn = loss_fn
-        self.optimizer = optimizer
-        self.return_outputs = return_outputs
-        params, buffers = model.functional_state()
-        # copy: donated buffers are consumed by XLA, but the live Layer's
-        # Parameters still reference the originals (callbacks/eager access
-        # between steps must keep working — sync() writes back copies too)
-        self.params = {n: jnp.copy(a) for n, a in params.items()}
-        self.buffers = {n: jnp.copy(a) for n, a in buffers.items()}
-        # parameters= threads the live Parameter objects through so an
-        # optimizer carrying RESTORED accumulators (checkpoint resume,
-        # or prior synced steps) seeds the functional state — a rebuilt
-        # TrainStep must continue the trajectory, not zero the moments
-        self.opt_state = optimizer.init_opt_state(
-            params, parameters=dict(model.named_parameters()))
-        self._step_i = optimizer._global_step
-        apply_fn = optimizer.apply_gradients_fn()
+class InstrumentedStepMixin:
+    """Flight-recorder/watchdog instrumentation shared by the compiled
+    train steps (`TrainStep` here, `distributed.sharded.ShardedTrainStep`).
 
-        # strategy transforms recorded by the fleet meta-optimizer chain
-        # (amp autocast, recompute, k-step gradient merge) — see
-        # jit/transforms.py for the mapping
-        self.transforms = tfm.resolve(optimizer)
-        k_merge, merge_avg = tfm.merge_config(self.transforms)
-        self.grad_acc = tfm.init_grad_acc(self.params, k_merge)
-        update_fn = tfm.merged_update(apply_fn, k_merge, merge_avg)
+    Hosts expectations: the step object carries `_compiled` (a jitted
+    callable returning the canonical 8-tuple `(loss, params, buffers,
+    opt_state, grad_acc, outs, grad_norm, notfinite)`), the state dicts
+    those outputs rebind (`params`/`buffers`/`opt_state`/`grad_acc`),
+    and `_step_i`. `_init_instrumentation()` must run in `__init__`."""
 
-        def _forward(p, bufs, key, inputs, labels):
-            with state.functional_rng_ctx(key):
-                # keep the param substitution alive THROUGH the loss call:
-                # losses may read model parameters directly (CRF
-                # transitions, tied heads) and must see the traced arrays,
-                # not the pre-trace constants functional_call restores on
-                # exit — otherwise those params silently train to nothing
-                with model._use_state(p, bufs):
-                    out, new_buf = model.functional_call(
-                        p, bufs, *_wrap(inputs))
-                    outs = out if isinstance(out, tuple) else (out,)
-                    loss_t = loss_fn(*outs, *_wrap(labels))
-            return _unwrap(loss_t), (new_buf, _unwrap(out))
-
-        _forward = tfm.wrap_forward(_forward, self.transforms)
-
-        ret_outs = return_outputs
-
-        def _step(params, buffers, opt_state, acc, key, lr, step_i,
-                  inputs, labels):
-            (loss, (new_buf, outs)), grads = jax.value_and_grad(
-                lambda p: _forward(p, buffers, key, inputs, labels),
-                has_aux=True)(params)
-            new_params, new_opt, new_acc = update_fn(
-                params, grads, opt_state, acc, lr, step_i)
-            # non-finite sentinel, folded into the compiled step: a tiny
-            # fp32 reduction over grads the scheduler fuses into the
-            # backward — no extra host sync (the flag is only ever READ
-            # by an instrumented caller that is about to block anyway)
-            gsq = sum((jnp.sum(jnp.square(g.astype(jnp.float32)))
-                       for g in jax.tree_util.tree_leaves(grads)),
-                      jnp.asarray(0.0, jnp.float32))
-            notfinite = jnp.logical_not(
-                jnp.all(jnp.isfinite(loss)) & jnp.isfinite(gsq))
-            # outs leave the jitted program ONLY when asked for: a returned
-            # value can't be dead-code-eliminated, and fused-loss models
-            # (e.g. GPT chunked head+CE) rely on XLA dropping the unused
-            # wide logits entirely
-            if not ret_outs:
-                outs = ()
-            return (loss, new_params, new_buf, new_opt, new_acc, outs,
-                    jnp.sqrt(gsq), notfinite)
-
-        donate_args = (0, 1, 2, 3) if donate else ()
-        # stashed for the program-level audit (tools/jxaudit): jax's
-        # PjitFunction exposes no public donate introspection, so the
-        # declaration of record rides on the TrainStep itself
-        self._donate_argnums = donate_args
-        self._compiled = jax.jit(_step, donate_argnums=donate_args)
-        # flight-recorder instrumentation (attach_flight_recorder)
+    def _init_instrumentation(self, label="train_step"):
         self._recorder = None
-        self._label = "train_step"
+        self._label = label
         self._fail_fast = False
         self._cost_cache = {}
         self._pending_data_s = 0.0
@@ -353,34 +292,8 @@ class TrainStep:
         self._last_grad_norm = None
         self._last_nonfinite = None
 
-    def __call__(self, inputs, labels):
-        if chaos.enabled():
-            # the canonical "kill"/stall boundary for the exact-resume
-            # parity harness: host-side, BEFORE the step counter, the
-            # RNG draw, or the compiled dispatch — a raise here leaves
-            # every piece of training state exactly at the last
-            # completed step, like a SIGKILL between steps
-            chaos.fire(chaos.TRAIN_STEP, step=self._step_i + 1)
-        inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
-        labels = labels if isinstance(labels, (list, tuple)) else (labels,)
-        self._step_i += 1
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        args = (self.params, self.buffers, self.opt_state, self.grad_acc,
-                state.next_rng_key(),
-                lr, jnp.asarray(self._step_i, jnp.int32),
-                _unwrap(tuple(inputs)), _unwrap(tuple(labels)))
-        if self._recorder is not None:
-            loss, outs = self._instrumented_call(args)
-        else:
-            (loss, self.params, self.buffers, self.opt_state, self.grad_acc,
-             outs, self._last_grad_norm, self._last_nonfinite) = \
-                self._compiled(*args)
-        if self.return_outputs:
-            return Tensor(loss), _wrap(outs)
-        return Tensor(loss)
-
     # ------------------------------------------------------ flight recorder
-    def attach_flight_recorder(self, recorder, label="train_step",
+    def attach_flight_recorder(self, recorder, label=None,
                                fail_fast=None, watchdog=None):
         """Instrument every subsequent step: journal `step` events with
         the data/host/device timing split, per-executable `compile`
@@ -394,7 +307,8 @@ class TrainStep:
         event instead of a silent stall."""
         from ..utils import telemetry, flight_recorder as fr
         self._recorder = recorder
-        self._label = label
+        if label is not None:
+            self._label = label
         self._watchdog = watchdog
         self._fail_fast = recorder.fail_fast if fail_fast is None \
             else bool(fail_fast)
@@ -522,13 +436,123 @@ class TrainStep:
         if nonfinite:
             self._m_nonfinite.inc()
             rec.nonfinite(step=self._step_i, loss=float(loss),
-                          grad_norm=grad_norm, source="train_step")
+                          grad_norm=grad_norm, source=self._label)
             if self._fail_fast:
                 rec.flush()
                 raise fr.NonFiniteError(
                     f"non-finite loss/grad at step {self._step_i}: "
                     f"loss={float(loss)!r} grad_norm={grad_norm!r}")
         return loss, outs
+
+
+class TrainStep(InstrumentedStepMixin):
+    """Whole-train-step compiler: loss + grads + optimizer in one XLA program.
+
+    Usage:
+        step = TrainStep(model, loss_fn, opt)
+        loss = step(x, y)          # one device dispatch
+        step.sync()                # write state back into model/opt
+    """
+
+    def __init__(self, model, loss_fn, optimizer, donate=True,
+                 return_outputs=False):
+        from . import transforms as tfm
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.return_outputs = return_outputs
+        params, buffers = model.functional_state()
+        # copy: donated buffers are consumed by XLA, but the live Layer's
+        # Parameters still reference the originals (callbacks/eager access
+        # between steps must keep working — sync() writes back copies too)
+        self.params = {n: jnp.copy(a) for n, a in params.items()}
+        self.buffers = {n: jnp.copy(a) for n, a in buffers.items()}
+        # parameters= threads the live Parameter objects through so an
+        # optimizer carrying RESTORED accumulators (checkpoint resume,
+        # or prior synced steps) seeds the functional state — a rebuilt
+        # TrainStep must continue the trajectory, not zero the moments
+        self.opt_state = optimizer.init_opt_state(
+            params, parameters=dict(model.named_parameters()))
+        self._step_i = optimizer._global_step
+        apply_fn = optimizer.apply_gradients_fn()
+
+        # strategy transforms recorded by the fleet meta-optimizer chain
+        # (amp autocast, recompute, k-step gradient merge) — see
+        # jit/transforms.py for the mapping
+        self.transforms = tfm.resolve(optimizer)
+        k_merge, merge_avg = tfm.merge_config(self.transforms)
+        self.grad_acc = tfm.init_grad_acc(self.params, k_merge)
+        update_fn = tfm.merged_update(apply_fn, k_merge, merge_avg)
+
+        def _forward(p, bufs, key, inputs, labels):
+            with state.functional_rng_ctx(key):
+                # keep the param substitution alive THROUGH the loss call:
+                # losses may read model parameters directly (CRF
+                # transitions, tied heads) and must see the traced arrays,
+                # not the pre-trace constants functional_call restores on
+                # exit — otherwise those params silently train to nothing
+                with model._use_state(p, bufs):
+                    out, new_buf = model.functional_call(
+                        p, bufs, *_wrap(inputs))
+                    outs = out if isinstance(out, tuple) else (out,)
+                    loss_t = loss_fn(*outs, *_wrap(labels))
+            return _unwrap(loss_t), (new_buf, _unwrap(out))
+
+        _forward = tfm.wrap_forward(_forward, self.transforms)
+
+        ret_outs = return_outputs
+
+        def _step(params, buffers, opt_state, acc, key, lr, step_i,
+                  inputs, labels):
+            (loss, (new_buf, outs)), grads = jax.value_and_grad(
+                lambda p: _forward(p, buffers, key, inputs, labels),
+                has_aux=True)(params)
+            new_params, new_opt, new_acc = update_fn(
+                params, grads, opt_state, acc, lr, step_i)
+            grad_norm, notfinite = grad_norm_sentinel(loss, grads)
+            # outs leave the jitted program ONLY when asked for: a returned
+            # value can't be dead-code-eliminated, and fused-loss models
+            # (e.g. GPT chunked head+CE) rely on XLA dropping the unused
+            # wide logits entirely
+            if not ret_outs:
+                outs = ()
+            return (loss, new_params, new_buf, new_opt, new_acc, outs,
+                    grad_norm, notfinite)
+
+        donate_args = (0, 1, 2, 3) if donate else ()
+        # stashed for the program-level audit (tools/jxaudit): jax's
+        # PjitFunction exposes no public donate introspection, so the
+        # declaration of record rides on the TrainStep itself
+        self._donate_argnums = donate_args
+        self._compiled = jax.jit(_step, donate_argnums=donate_args)
+        # flight-recorder instrumentation (attach_flight_recorder)
+        self._init_instrumentation()
+
+    def __call__(self, inputs, labels):
+        if chaos.enabled():
+            # the canonical "kill"/stall boundary for the exact-resume
+            # parity harness: host-side, BEFORE the step counter, the
+            # RNG draw, or the compiled dispatch — a raise here leaves
+            # every piece of training state exactly at the last
+            # completed step, like a SIGKILL between steps
+            chaos.fire(chaos.TRAIN_STEP, step=self._step_i + 1)
+        inputs = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
+        labels = labels if isinstance(labels, (list, tuple)) else (labels,)
+        self._step_i += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        args = (self.params, self.buffers, self.opt_state, self.grad_acc,
+                state.next_rng_key(),
+                lr, jnp.asarray(self._step_i, jnp.int32),
+                _unwrap(tuple(inputs)), _unwrap(tuple(labels)))
+        if self._recorder is not None:
+            loss, outs = self._instrumented_call(args)
+        else:
+            (loss, self.params, self.buffers, self.opt_state, self.grad_acc,
+             outs, self._last_grad_norm, self._last_nonfinite) = \
+                self._compiled(*args)
+        if self.return_outputs:
+            return Tensor(loss), _wrap(outs)
+        return Tensor(loss)
 
     def eval_fn(self, fn=None):
         """Compile an eval forward over the live functional state."""

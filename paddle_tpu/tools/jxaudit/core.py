@@ -175,18 +175,37 @@ _HLO_DTYPE_ABBREV = {
 }
 
 
-def aval_type_str(aval):
+def aval_type_str(aval, shape=None):
     """HLO-style type string for an aval/array (``f32[64,64]``), or
     None when the dtype has no HLO text spelling we can predict (jax
-    extended dtypes) — callers treat None as a wildcard."""
+    extended dtypes) — callers treat None as a wildcard. ``shape``
+    overrides the aval's shape (the sharded-program case: the
+    partitioned module's entry parameters carry PER-SHARD shapes)."""
     dt = np_dtype(getattr(aval, "dtype", None))
     if dt is None:
         return None
     ab = _HLO_DTYPE_ABBREV.get(dt.name)
     if ab is None:
         return None
-    shape = getattr(aval, "shape", ())
+    if shape is None:
+        shape = getattr(aval, "shape", ())
     return f"{ab}[{','.join(str(int(s)) for s in shape)}]"
+
+
+def leaf_shard_shape(leaf):
+    """The per-device shape of one concrete arg leaf, or None when the
+    leaf carries no sharding to ask (plain numpy/scalars). For a
+    replicated or single-device jax.Array this equals the full shape;
+    for a dp-sharded leaf it is the slice each device holds — which is
+    exactly how the leaf appears in the partitioned module's
+    entry_computation_layout."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None:
+        return None
+    try:
+        return tuple(int(s) for s in sharding.shard_shape(leaf.shape))
+    except Exception:
+        return None
 
 
 def parse_entry_param_types(hlo_text):
@@ -423,10 +442,17 @@ class ProgramContext:
             if cj is not None \
                     and len(cj.jaxpr.invars) == len(leaves):
                 # invars carry the CANONICALIZED avals (python floats
-                # become weak f32) — what the HLO params actually are
-                types = [aval_type_str(v.aval) for v in cj.jaxpr.invars]
+                # become weak f32) — what the HLO params actually are.
+                # The SHAPE comes from the concrete leaf's per-device
+                # shard when it has one: a partitioned (SPMD) module's
+                # entry parameters are the per-shard slices, so a
+                # dp-sharded f32[128] opt-state leaf shows up as
+                # f32[16] on the dp=8 mesh.
+                types = [aval_type_str(v.aval, shape=leaf_shard_shape(l))
+                         for v, l in zip(cj.jaxpr.invars, leaves)]
             else:
-                types = [aval_type_str(l) for l in leaves]
+                types = [aval_type_str(l, shape=leaf_shard_shape(l))
+                         for l in leaves]
             mapping, reason = align_leaves_to_params(types, params)
             if mapping is None:
                 raise RuntimeError(reason)

@@ -20,6 +20,13 @@ REAL hot path:
     state) on the canonical 2-layer GPT config — the same topology
     bench.py's CPU smoke compiles, so the persistent compile cache is
     shared;
+  * `sharded_train_step` — `distributed.sharded.ShardedTrainStep`
+    (GSPMD, ZeRO-1 dp-sharded optimizer state) on the same 2-layer GPT
+    config over the tier-1 8-CPU-device dp mesh, active dropout so the
+    PRNG key stays a live entry parameter — jxaudit's donation rule
+    verifies the dp-SHARDED opt-state leaves are actually aliased in
+    the partitioned HLO (the PR-7 eager-optimizer donation bug, sharded
+    incarnation);
   * `cached_decode_attention` — the GQA single-token cached attention
     core from nn/transformer.py with a per-slot position VECTOR (the
     serving decode regime);
@@ -41,10 +48,16 @@ PAGED = dict(vocab=128, hidden=64, layers=2, heads=4, max_len=64,
              block_size=8, num_blocks=33, chunk_len=16, num_slots=4)
 # train canonical shape == bench.py CPU-smoke config
 TRAIN = dict(vocab=512, hidden=128, layers=2, heads=4, seq=128, batch=2)
+# sharded-train canonical mesh: the tier-1 8-CPU-device dp mesh
+# (conftest's --xla_force_host_platform_device_count=8), ZeRO-1. The
+# batch (2) is not divisible by dp, so it rides replicated — the
+# exact-reshard regime chaos_train proves bitwise.
+SHARDED_TRAIN = dict(TRAIN, dp=8, zero_stage=1, dropout=0.1)
 
 TRACKED_PROGRAMS = ("serving_decode_wave", "serving_prefill",
                     "paged_decode_wave", "paged_prefill_chunk",
-                    "train_step", "cached_decode_attention",
+                    "train_step", "sharded_train_step",
+                    "cached_decode_attention",
                     "paged_decode_attention", "prefill_flash_attention")
 
 
@@ -227,6 +240,60 @@ def _train_step_spec():
     return train_step_spec(step, (ids,), (ids,))
 
 
+def sharded_train_step_spec(step, inputs, labels):
+    """Audit spec for a LIVE ShardedTrainStep: lowers the step's own
+    compiled (pjit'd, in/out-sharded, donated) callable with its
+    current sharded state — the program a mesh training run actually
+    dispatches. `inputs`/`labels` are global batch arrays; they ride
+    through the step's own `_shard_batch` so the lowering sees the same
+    placements a real step does."""
+    import jax
+    import jax.numpy as jnp
+    args = (step.params, step.buffers, step.opt_state, step.grad_acc,
+            jax.random.PRNGKey(0), jnp.asarray(1e-4, jnp.float32),
+            jnp.asarray(1, jnp.int32), step._shard_batch(tuple(inputs)),
+            step._shard_batch(tuple(labels)))
+    return {"name": "sharded_train_step", "jitted": step._compiled,
+            "args": args,
+            "donate_argnums": getattr(step, "_donate_argnums", ()),
+            "arg_names": ("params", "buffers", "opt_state", "acc", "key",
+                          "lr", "step_i", "inputs", "labels"),
+            "description": "GSPMD forward+backward+AdamW with ZeRO "
+                           f"stage-{step.zero_stage} dp-sharded opt "
+                           "state, one donated executable "
+                           f"(mesh {dict(zip(step.mesh.axis_names, step.mesh.devices.shape))})"}
+
+
+def _sharded_train_step_spec():
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.sharded import ShardedTrainStep
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+    from paddle_tpu.nlp.gpt import gpt_pretrain_loss
+
+    C = SHARDED_TRAIN
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=C["vocab"], hidden_size=C["hidden"],
+                    num_layers=C["layers"], num_heads=C["heads"],
+                    max_seq_len=C["seq"], dropout=C["dropout"],
+                    attn_dropout=0.0)
+    model = GPTForPretraining(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters())
+    # an explicit mesh, NOT make_mesh: building an audit spec must not
+    # install (or leak) global mesh state into whatever runs next
+    devs = jax.devices()
+    dp = min(C["dp"], len(devs))
+    mesh = Mesh(np.asarray(devs[:dp]).reshape(dp), ("dp",))
+    step = ShardedTrainStep(model, gpt_pretrain_loss, opt, mesh=mesh,
+                            zero_stage=C["zero_stage"])
+    ids = np.zeros((C["batch"], C["seq"]), np.int32)
+    return sharded_train_step_spec(step, (ids,), (ids,))
+
+
 def _attention_specs():
     import jax.numpy as jnp
     from paddle_tpu.nn.transformer import (cached_decode_attention,
@@ -302,6 +369,8 @@ def tracked_program_specs(names=None):
         specs += [s for s in _paged_serving_specs() if s["name"] in want]
     if "train_step" in want:
         specs.append(_train_step_spec())
+    if "sharded_train_step" in want:
+        specs.append(_sharded_train_step_spec())
     if want & {"cached_decode_attention", "paged_decode_attention",
                "prefill_flash_attention"}:
         specs += [s for s in _attention_specs() if s["name"] in want]

@@ -88,6 +88,12 @@ COLLECTIVE = "distributed.collective"
 #: parity harness's positive controls arm this ("rng" dropped must
 #: make the kill/resume parity check fail)
 TRAIN_STATE = "resume.capture"
+#: payload: param-name fragment (or True = first param) whose gathered
+#: optimizer-state host copies are ZEROED during ShardedTrainStep.sync
+#: — simulates a shard gather that missed the dp shards' updates; the
+#: sharded kill/resume parity harness's `--inject stale-shard` positive
+#: control arms this (the resumed trajectory must diverge, exit 1)
+SHARD_STATE = "sharded.state_gather"
 #: payload: rotation index of a fleet replica to KILL before this fleet
 #: step (serving/fleet router loop) — the replica is marked dead, its
 #: accepted requests are evacuated and must finish token-identically on
@@ -100,7 +106,8 @@ ROUTER_DISPATCH = "fleet.router_dispatch"
 
 POINTS = (DECODE_WAVE, DECODE_WAVE_NAN, PREFILL, CALLBACK,
           CHECKPOINT_WRITE, CACHE_ALLOC, TRAIN_STEP, DATA_LOAD,
-          COLLECTIVE, TRAIN_STATE, REPLICA_KILL, ROUTER_DISPATCH)
+          COLLECTIVE, TRAIN_STATE, SHARD_STATE, REPLICA_KILL,
+          ROUTER_DISPATCH)
 
 ACTIONS = ("raise", "delay", "payload")
 

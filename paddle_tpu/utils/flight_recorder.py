@@ -42,7 +42,7 @@ class NonFiniteError(RuntimeError):
 
 EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
                "checkpoint", "xla_program", "jxaudit", "chaos", "fault",
-               "resume", "hang", "slo", "run_end")
+               "resume", "reshard", "hang", "slo", "run_end")
 
 
 def _json_safe(v):
@@ -301,6 +301,30 @@ class FlightRecorder:
             fields["batch"] = int(batch)
         fields.update(extra)
         return self.record("resume", **fields)
+
+    def reshard(self, from_mesh=None, to_mesh=None, from_dp=None,
+                to_dp=None, zero_stage=None, **extra):
+        """This resume relaid sharded training state onto a DIFFERENT
+        mesh than the checkpoint was written on (elastic reshard):
+        from/to mesh shape dicts, the dp sizes on the checkpoint's dp
+        axis, and the checkpoint's ZeRO stage — journaled right after
+        the `resume` event so a trajectory stitched across a reshard
+        names both layouts (utils/resume.maybe_record_reshard)."""
+        fields = {}
+        if from_mesh is not None:
+            fields["from_mesh"] = {str(k): int(v)
+                                   for k, v in dict(from_mesh).items()}
+        if to_mesh is not None:
+            fields["to_mesh"] = {str(k): int(v)
+                                 for k, v in dict(to_mesh).items()}
+        if from_dp is not None:
+            fields["from_dp"] = int(from_dp)
+        if to_dp is not None:
+            fields["to_dp"] = int(to_dp)
+        if zero_stage is not None:
+            fields["zero_stage"] = int(zero_stage)
+        fields.update(extra)
+        return self.record("reshard", **fields)
 
     def hang(self, age_s, threshold_s=None, step=None, action="observe",
              stacks=None, **extra):

@@ -56,12 +56,18 @@ from ..framework import state
 
 #: schema version of the `.pdtrain` payload — bump on incompatible
 #: layout changes; `apply_train_state` refuses newer versions rather
-#: than resuming with silently-misread state
-STATE_VERSION = 1
+#: than resuming with silently-misread state. 2 adds the `sharding`
+#: record (mesh shape / dp_axis / zero_stage / per-leaf PartitionSpecs
+#: from `ShardedTrainStep.sharding_state`) — readers tolerate its
+#: absence, so v1 checkpoints still resume (as unsharded provenance).
+STATE_VERSION = 2
 
 _RESUMES = telemetry.counter(
     "train_resumes_total",
     "Training runs resumed from a full-state checkpoint")
+_RESHARDS = telemetry.counter(
+    "train_reshards_total",
+    "Resumes that relaid sharded training state onto a different mesh")
 _WATCHDOG_STALLS = telemetry.counter(
     "train_watchdog_stalls_total",
     "Stalled-step episodes detected by the training watchdog")
@@ -71,16 +77,22 @@ _WATCHDOG_STALLS = telemetry.counter(
 # train-state capture / restore
 # ---------------------------------------------------------------------------
 
-def capture_train_state(cursor=None, step=None, scaler=None, run_id=None):
+def capture_train_state(cursor=None, step=None, scaler=None, run_id=None,
+                        sharding=None):
     """The full non-(param/optimizer) training state as one picklable
     dict — everything a resumed run needs to continue the EXACT
     trajectory. `cursor` is Model.fit's data cursor
     ({"epoch", "batch", "epoch_numpy_rng"}), `scaler` an optional
-    `amp.GradScaler`, `run_id` the writing run's flight-recorder id.
+    `amp.GradScaler`, `run_id` the writing run's flight-recorder id,
+    `sharding` a `ShardedTrainStep.sharding_state()` record (mesh
+    shape, dp_axis, zero_stage, per-leaf PartitionSpecs) when the
+    writing step was sharded — the provenance an elastic reshard
+    journals against (`record_reshard`).
 
     The `chaos.TRAIN_STATE` payload point may name keys to DROP — the
     parity harness's positive controls (a checkpoint without its RNG
-    chain must make the kill/resume parity check fail)."""
+    chain must make the kill/resume parity check fail; one without its
+    `sharding` record must fail the reshard-bookkeeping check)."""
     doc = {
         "version": STATE_VERSION,
         "time_unix": round(time.time(), 3),
@@ -90,6 +102,7 @@ def capture_train_state(cursor=None, step=None, scaler=None, run_id=None):
         "step": None if step is None else int(step),
         "scaler": None if scaler is None else dict(scaler.state_dict()),
         "run_id": run_id,
+        "sharding": None if sharding is None else dict(sharding),
     }
     if chaos.enabled():
         dropped = chaos.value(chaos.TRAIN_STATE, default=())
@@ -119,7 +132,7 @@ def apply_train_state(doc, scaler=None):
     if scaler is not None and doc.get("scaler") is not None:
         scaler.load_state_dict(doc["scaler"])
     return {"cursor": doc.get("cursor"), "step": doc.get("step"),
-            "run_id": doc.get("run_id")}
+            "run_id": doc.get("run_id"), "sharding": doc.get("sharding")}
 
 
 def record_resume(recorder=None, prior_run_id=None, step=None, epoch=None,
@@ -131,6 +144,47 @@ def record_resume(recorder=None, prior_run_id=None, step=None, epoch=None,
     if rec is not None:
         rec.resume(prior_run_id=prior_run_id, step=step, epoch=epoch,
                    batch=batch)
+
+
+def mesh_shape_dict(mesh=None):
+    """{axis: size} of `mesh` (default: the installed global mesh), or
+    None without one — the comparison key `maybe_record_reshard` uses."""
+    from ..distributed import mesh as mesh_mod
+    m = mesh_mod.get_mesh() if mesh is None else mesh
+    if m is None:
+        return None
+    return {name: int(m.shape[name]) for name in m.axis_names}
+
+
+def maybe_record_reshard(resume_info, recorder=None):
+    """Elastic-reshard bookkeeping, called by `fit(resume=True)` after
+    the `resume` event: when the checkpoint's `.pdtrain` carries a
+    `sharding` record and the CURRENT mesh shape differs from the one
+    the checkpoint was written on, count `train_reshards_total` and
+    journal a `reshard` event (from/to mesh shapes, dp sizes, the
+    checkpoint's zero_stage). The actual relayout needs no action here
+    — the rebuilt `ShardedTrainStep` re-derives `_zero_spec` placements
+    for the current mesh and `device_put`s the restored host state —
+    but the transition must be observable, and the sharded parity
+    harness's `--inject spec-drop` control (checkpoint stripped of its
+    sharding record) is caught exactly because this event then cannot
+    name the mesh it came from. Returns the journaled event (or None)."""
+    shard_doc = (resume_info or {}).get("sharding") or None
+    if not shard_doc or not isinstance(shard_doc, dict):
+        return None
+    from_mesh = shard_doc.get("mesh") or {}
+    to_mesh = mesh_shape_dict()
+    if to_mesh is None or dict(from_mesh) == to_mesh:
+        return None
+    _RESHARDS.inc()
+    dp_axis = shard_doc.get("dp_axis")
+    rec = recorder if recorder is not None else flight_recorder.get_recorder()
+    if rec is None:
+        return None
+    return rec.reshard(
+        from_mesh=dict(from_mesh), to_mesh=to_mesh,
+        from_dp=from_mesh.get(dp_axis), to_dp=to_mesh.get(dp_axis),
+        zero_stage=shard_doc.get("zero_stage"))
 
 
 # ---------------------------------------------------------------------------
@@ -221,6 +275,24 @@ class TrainWatchdog:
         if t is not None:
             t.join(timeout=2.0)
         self._thread = None
+
+    def reset_warmup(self):
+        """Re-enter the EWMA warmup (and restart the stall clock): the
+        next `warmup_beats` completed steps do NOT feed the rolling
+        step time, and until it re-seeds `min_stall_s` alone applies.
+        `fit(resume=True)` calls this on a watchdog that survived into
+        the resumed run — the resumed process's first step carries a
+        fresh compile (a resharded sharded step ALWAYS recompiles: new
+        mesh, new placements), and an EWMA warmed on the pre-kill
+        cadence would otherwise read that one-off compile as a stalled
+        step and journal a false `hang` episode."""
+        with self._lock:
+            self._beats = 0
+            self._ewma = None
+            self._last_beat = time.monotonic()
+            self._flagged = False
+            self._interrupted = False
+        return self
 
     def beat(self, step_s=None, step=None):
         """One completed train step took `step_s` seconds."""

@@ -32,3 +32,20 @@ def _seed():
     import paddle_tpu as pt
     pt.seed(1234)
     yield
+
+
+@pytest.fixture(scope="session")
+def chaos_train():
+    """scripts/chaos_train.py loaded ONCE per pytest session: the
+    kill/resume parity harness caches its per-(mesh, zero_stage) golden
+    trajectories inside the module, so test_resume / test_chaos /
+    test_sharded_resume share one set of golden runs instead of each
+    file recomputing them (the goldens are several full training fits —
+    real tier-1 wall time)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "chaos_train.py")
+    spec = importlib.util.spec_from_file_location("_t1_chaos_train", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -31,9 +31,8 @@ def chaos_serving():
     return _load_cli("chaos_serving")
 
 
-@pytest.fixture(scope="module")
-def chaos_train():
-    return _load_cli("chaos_train")
+# `chaos_train` comes from conftest.py (session-scoped): the golden
+# trajectories are shared with test_resume / test_sharded_resume.
 
 
 def test_smoke_every_fault_class_recovers(chaos_serving, capsys):
@@ -153,6 +152,49 @@ def test_train_inject_cursor_drop_exits_1(chaos_train, capsys):
     assert chaos_train.run(["--inject", "cursor-drop"]) == 1
     out = capsys.readouterr().out
     assert "diverged" in out or "re-ran or skipped" in out
+
+
+def test_train_reshard_kill_resume_journal(chaos_train, tmp_path,
+                                           capsys):
+    """The elastic-reshard headline: a ZeRO-sharded run killed on dp=2
+    resumes onto dp=4 with the stitched (loss, grad-norm) trajectory
+    bitwise-golden — and one journal carries the `chaos` kill, the
+    `checkpoint` saves, the `resume` event AND the `reshard` event
+    naming both mesh layouts. (The full zero-stage x dp matrix runs in
+    tests/test_sharded_resume.py.)"""
+    journal = tmp_path / "reshard_chaos.jsonl"
+    assert chaos_train.run(["--mesh", "dp=2", "--resume-mesh", "dp=4",
+                            "--boundaries", "after_save",
+                            "--journal", str(journal)]) == 0
+    assert "FAIL" not in capsys.readouterr().out
+    from paddle_tpu.utils import flight_recorder
+    events = flight_recorder.read_journal(str(journal))
+    kinds = {e["ev"] for e in events}
+    assert {"run_start", "chaos", "checkpoint", "resume", "reshard",
+            "step", "run_end"} <= kinds
+    res = next(e for e in events if e["ev"] == "reshard")
+    assert res["from_dp"] == 2 and res["to_dp"] == 4
+    assert res["zero_stage"] == 1
+    # the reshard event rides right after resume, never before it
+    seq = [e["ev"] for e in events if e["ev"] in ("resume", "reshard")]
+    assert seq == ["resume", "reshard"]
+
+
+def test_train_inject_spec_drop_exits_1(chaos_train, capsys):
+    """Positive control: a checkpoint stripped of its `sharding`
+    provenance record resumes onto the new mesh without being able to
+    journal the reshard it performed — the reshard-bookkeeping check
+    must catch it (exit 1)."""
+    assert chaos_train.run(["--inject", "spec-drop"]) == 1
+    assert "reshard" in capsys.readouterr().out
+
+
+def test_train_inject_stale_shard_exits_1(chaos_train, capsys):
+    """Positive control: zeroing one parameter's gathered opt-state
+    slots at checkpoint time (a shard gather that silently missed the
+    dp updates) must make the resumed trajectory diverge (exit 1)."""
+    assert chaos_train.run(["--inject", "stale-shard"]) == 1
+    assert "diverged" in capsys.readouterr().out
 
 
 def test_monkey_prob_selector_is_seeded():

@@ -457,6 +457,37 @@ def test_optimizer_update_state_donated_and_aliased():
     assert set(range(first, first + n)) <= aliased
 
 
+def test_sharded_train_step_opt_state_actually_aliased():
+    """The eager-optimizer donation bug from PR 7, in its SHARDED
+    incarnation: the ZeRO dp-sharded optimizer-state leaves of
+    `sharded_train_step` must be ACTUALLY aliased in the PARTITIONED
+    HLO — at their per-shard entry shapes, which is also the regression
+    gate on the shard-aware leaf->param alignment (a degrade here would
+    let a dropped sharded donation pass silently: the audit is only a
+    gate while the mapping resolves)."""
+    (spec,) = jxaudit.tracked_specs(["sharded_train_step"])
+    ctx = ProgramContext(spec)
+    assert ctx.donate_argnums == (0, 1, 2, 3)
+    mapping = ctx.leaf_param_map
+    assert mapping is not None, ctx.unavailable    # alignment resolved
+    aliased = ctx.aliased_param_indices
+    assert aliased is not None, ctx.unavailable
+    first, n = ctx.leaf_index_ranges()[2]          # opt_state
+    assert n > 0
+    opt_leaves = dict(ctx.arg_leaves)[2]
+    # the leaves ZeRO actually shards (per-device slice != full shape)
+    dp_sharded = [i for i, leaf in enumerate(opt_leaves)
+                  if jxaudit.core.leaf_shard_shape(leaf)
+                  not in (None, tuple(leaf.shape))]
+    assert dp_sharded, "no opt-state leaf is dp-sharded at audit shapes"
+    missing = [first + i for i in dp_sharded
+               if mapping.get(first + i) not in aliased]
+    assert missing == [], \
+        f"dp-sharded opt-state leaves {missing} lost donation aliasing " \
+        "in the partitioned HLO"
+    assert list(jxaudit.RULES["donation-dropped"].check(ctx)) == []
+
+
 # ---------------------------------------------------------------------------
 # CLI: exit contract + positive controls (tier-1's gate-fires proof)
 # ---------------------------------------------------------------------------

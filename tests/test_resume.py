@@ -2,7 +2,6 @@
 train-state capture/restore, kill-at-every-boundary bitwise parity via
 scripts/chaos_train.py, the training watchdog, and the
 optimizer-state-survives-donation regression."""
-import importlib.util
 import os
 import time
 
@@ -16,16 +15,14 @@ from paddle_tpu.io import DataLoader, TensorDataset
 from paddle_tpu.utils import chaos, resume, telemetry
 from paddle_tpu.utils import flight_recorder as fr
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
 @pytest.fixture(autouse=True)
 def _single_chip():
-    """The exact-resume layer under test is the single-chip foundation
-    (sharded/ZeRO resume is ROADMAP item 3) — pin build_train_step to
-    TrainStep even when an earlier test file left a global device mesh
-    set (Model.fit would otherwise swap in ShardedTrainStep, which has
-    no TRAIN_STEP kill point or flight-recorder attach)."""
+    """This file tests the SINGLE-CHIP exact-resume surface — pin
+    build_train_step to TrainStep even when an earlier test file left a
+    global device mesh set (Model.fit would otherwise swap in
+    ShardedTrainStep: fully resume-capable since the elastic-reshard
+    PR, but a different executable than these tests baseline against).
+    The sharded/reshard surface lives in tests/test_sharded_resume.py."""
     from paddle_tpu.distributed import mesh as mesh_mod
     prev = mesh_mod.get_mesh()
     mesh_mod.set_mesh(None)
@@ -33,17 +30,8 @@ def _single_chip():
     mesh_mod.set_mesh(prev)
 
 
-def _load_cli(name):
-    path = os.path.join(REPO, "scripts", f"{name}.py")
-    spec = importlib.util.spec_from_file_location(f"_test_{name}", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-@pytest.fixture(scope="module")
-def chaos_train():
-    return _load_cli("chaos_train")
+# `chaos_train` comes from conftest.py (session-scoped): the golden
+# trajectories are shared with test_chaos / test_sharded_resume.
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,316 @@
+"""Elastic-reshard exact resume for sharded/ZeRO training (ISSUE 13
+acceptance surface): the tier-1 reshard matrix (zero_stage x dp
+transitions) through scripts/chaos_train.py, the resume-under-mesh
+regression (the old single-chip pin must NOT silently downgrade a
+sharded resume), sharding-provenance capture/journal units, and the
+watchdog warmup reset after a resume-triggered recompile."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, hapi
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.utils import chaos, resume, telemetry
+from paddle_tpu.utils import flight_recorder as fr
+
+@pytest.fixture(autouse=True)
+def _restore_mesh():
+    """Every test here installs meshes on purpose; none may leak one
+    into the rest of the suite (the classic global-mesh hazard)."""
+    prev = mesh_mod.get_mesh()
+    yield
+    mesh_mod.set_mesh(prev)
+
+
+# `chaos_train` comes from conftest.py (session-scoped): the
+# per-(mesh, zero_stage) golden trajectories are cached inside the
+# module, so the 6-combo matrix below computes each golden once and
+# shares them with test_chaos / test_resume.
+
+
+# ---------------------------------------------------------------------------
+# the reshard matrix — the tentpole acceptance gate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("zero_stage", [1, 3])
+@pytest.mark.parametrize("dp_from,dp_to", [(2, 4), (4, 2), (2, 2)])
+def test_reshard_matrix_bitwise_parity(chaos_train, zero_stage, dp_from,
+                                       dp_to, capsys):
+    """Kill a ZeRO-sharded run at a step boundary on dp=N, resume onto
+    dp=M, and the stitched per-step (loss, grad-norm) trajectory is
+    EXACTLY the uninterrupted dp=N golden's — with the resumed step a
+    real ShardedTrainStep compiled exactly once on the new mesh, the
+    restored opt-state leaves actually dp-sharded (chaos_train's
+    sharded invariants assert the NamedSharding shard shapes — not
+    accidentally replicated, which would quietly undo ZeRO's memory
+    win), and a `reshard` event journaled iff the mesh changed."""
+    rc = chaos_train.run(["--mesh", f"dp={dp_from}",
+                          "--resume-mesh", f"dp={dp_to}",
+                          "--zero-stage", str(zero_stage),
+                          "--boundaries", "mid_epoch"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "FAIL" not in out
+
+
+# ---------------------------------------------------------------------------
+# satellite: resume under an active mesh must stay sharded (the old
+# single-chip pin would let a silent downgrade to TrainStep "pass")
+# ---------------------------------------------------------------------------
+
+def _tiny_sharded_model(seed):
+    pt.seed(seed)
+    net = nn.Linear(16, 8)
+    m = hapi.Model(net)
+    m.prepare(pt.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=net.parameters()),
+              nn.functional.mse_loss)
+    return m
+
+
+def _tiny_data(n=9):
+    from paddle_tpu.io import TensorDataset
+    rng = np.random.RandomState(0)
+    return TensorDataset([rng.randn(n, 16).astype("f4"),
+                          rng.randn(n, 8).astype("f4")])
+
+
+def test_fit_resume_under_active_mesh_builds_sharded_step(tmp_path):
+    from paddle_tpu.distributed.sharded import ShardedTrainStep
+    d = str(tmp_path)
+    mesh_mod.make_mesh({"dp": 2})
+    m = _tiny_sharded_model(5)
+    m.fit(_tiny_data(), batch_size=3, epochs=1, shuffle=False, verbose=0,
+          save_dir=d, save_steps=1)
+    assert isinstance(m._train_step, ShardedTrainStep)
+
+    m2 = _tiny_sharded_model(77)
+    assert m2.load_latest(d) is not None
+    rec = fr.FlightRecorder(None)
+    m2.fit(_tiny_data(), batch_size=3, epochs=2, shuffle=False, verbose=0,
+           flight_recorder=rec, resume=True)
+    # the regression: an active mesh + resume must construct the
+    # SHARDED step (the old pin downgraded to single-device TrainStep,
+    # which would still "pass" every loss assertion here)
+    assert isinstance(m2._train_step, ShardedTrainStep)
+    # and it journals real step events (the sharded step now carries
+    # the flight-recorder instrumentation, including grad_norm)
+    steps = [e for e in rec.events() if e["ev"] == "step"]
+    assert steps and all(e["grad_norm"] is not None for e in steps)
+    # step counter continued from the checkpoint, not from zero
+    assert steps[0]["step"] == 4
+
+
+def test_sharded_sync_writes_optimizer_accumulators():
+    """ShardedTrainStep.sync gathers the dp-sharded slots into host
+    copies the optimizer's state_dict can checkpoint — and they survive
+    the donated steps that follow (the PR-7 contract, per shard)."""
+    mesh_mod.make_mesh({"dp": 2})
+    m = _tiny_sharded_model(5)
+    m.fit(_tiny_data(), batch_size=3, epochs=1, shuffle=False, verbose=0)
+    sd = m._optimizer.state_dict()
+    moments = {k: v.numpy().copy() for k, v in sd.items()
+               if hasattr(v, "numpy")}
+    assert moments, "sync left no accumulators to checkpoint"
+    assert any(np.abs(v).sum() > 0 for v in moments.values()), \
+        "gathered accumulators are all zeros — sync never wrote them"
+    assert sd["global_step"] == 3
+    # shard-bytes gauge: per-device footprint of what was gathered
+    assert telemetry.value("checkpoint_shard_bytes", default=0) > 0
+    # the snapshot survives the donated steps that follow: the gathered
+    # host copies hand out fresh buffers, so continuing training cannot
+    # invalidate what state_dict returned
+    m.fit(_tiny_data(), batch_size=3, epochs=1, shuffle=False, verbose=0)
+    for k, v in moments.items():
+        got = np.asarray(sd[k].numpy())
+        np.testing.assert_array_equal(got, v,
+                                      err_msg=f"snapshot {k} was "
+                                      "invalidated by later steps")
+
+
+def test_resume_without_strategy_warns_on_sharding_drift(tmp_path):
+    """The provenance record is not instructions — nothing re-applies
+    the fleet strategy for the caller — but a resume that DROPPED it
+    (zero_stage/exact_reshard lost) forks the checkpointed run's
+    layout/bitwise contract and must say so: a UserWarning plus a
+    journaled `fault` (kind=reshard_config_drift)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.base import DistributedStrategy
+    d = str(tmp_path)
+    mesh_mod.make_mesh({"dp": 2})
+    pt.seed(5)
+    net = nn.Linear(16, 8)
+    m = hapi.Model(net)
+    opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=net.parameters())
+    strat = DistributedStrategy()
+    strat.sharding = True
+    strat.sharding_configs = {"stage": 1, "exact_reshard": True}
+    m.prepare(fleet.distributed_optimizer(opt, strat),
+              nn.functional.mse_loss)
+    m.fit(_tiny_data(), batch_size=3, epochs=1, shuffle=False, verbose=0,
+          save_dir=d, save_steps=1)
+
+    m2 = _tiny_sharded_model(77)          # NO strategy this time
+    assert m2.load_latest(d) is not None
+    rec = fr.FlightRecorder(None)
+    with pytest.warns(UserWarning, match="sharding configuration"):
+        m2.fit(_tiny_data(), batch_size=3, epochs=2, shuffle=False,
+               verbose=0, flight_recorder=rec, resume=True)
+    faults = [e for e in rec.events() if e["ev"] == "fault"]
+    assert faults and faults[0]["kind"] == "reshard_config_drift"
+    assert "zero_stage" in faults[0] or "exact_reshard" in faults[0]
+
+
+# ---------------------------------------------------------------------------
+# sharding-provenance capture / reshard journaling units (no compiles)
+# ---------------------------------------------------------------------------
+
+def test_capture_train_state_carries_sharding_record():
+    doc = resume.capture_train_state(
+        step=3, sharding={"mesh": {"dp": 2}, "dp_axis": "dp",
+                          "zero_stage": 1})
+    assert doc["version"] == resume.STATE_VERSION
+    assert doc["sharding"]["mesh"] == {"dp": 2}
+    info = resume.apply_train_state(doc)
+    assert info["sharding"]["zero_stage"] == 1
+    # v1 checkpoints (no sharding key) resume as unsharded provenance
+    legacy = {k: v for k, v in doc.items() if k != "sharding"}
+    legacy["version"] = 1
+    assert resume.apply_train_state(legacy)["sharding"] is None
+
+
+def test_maybe_record_reshard_only_on_mesh_change():
+    rec = fr.FlightRecorder(None)
+    rec.run_start(mode="reshard-unit")
+    info = {"sharding": {"mesh": {"dp": 2}, "dp_axis": "dp",
+                         "zero_stage": 3}}
+    before = telemetry.value("train_reshards_total", default=0)
+    # same mesh: no event, no count
+    mesh_mod.make_mesh({"dp": 2})
+    assert resume.maybe_record_reshard(info, rec) is None
+    # changed mesh: one event naming both layouts
+    mesh_mod.make_mesh({"dp": 4})
+    ev = resume.maybe_record_reshard(info, rec)
+    assert ev["from_mesh"] == {"dp": 2} and ev["to_mesh"] == {"dp": 4}
+    assert ev["from_dp"] == 2 and ev["to_dp"] == 4
+    assert ev["zero_stage"] == 3
+    assert telemetry.value("train_reshards_total",
+                           default=0) == before + 1
+    # no sharding record (spec-drop's world): nothing to journal
+    assert resume.maybe_record_reshard({"sharding": None}, rec) is None
+    assert [e["ev"] for e in rec.events()].count("reshard") == 1
+
+
+def test_shard_state_chaos_zeroes_gathered_slots():
+    """The stale-shard positive-control hook: an armed SHARD_STATE
+    payload zeroes exactly one parameter's gathered host slots."""
+    mesh_mod.make_mesh({"dp": 2})
+    m = _tiny_sharded_model(5)
+    m.fit(_tiny_data(), batch_size=3, epochs=1, shuffle=False, verbose=0)
+    step = m._train_step
+    monkey = chaos.ChaosMonkey([chaos.Fault(
+        chaos.SHARD_STATE, action="payload", payload=True)])
+    with chaos.active(monkey):
+        step.sync()
+    assert monkey.fired
+    sd = m._optimizer.state_dict()
+    sums = {k: float(np.abs(v.numpy()).sum()) for k, v in sd.items()
+            if hasattr(v, "numpy")}
+    zeroed = [k for k, s in sums.items() if s == 0.0]
+    live = [k for k, s in sums.items() if s > 0.0]
+    assert zeroed and live, sums
+
+
+# ---------------------------------------------------------------------------
+# satellite: watchdog warmup reset after a resume-triggered recompile
+# ---------------------------------------------------------------------------
+
+def test_watchdog_reset_warmup_reenters_warmup_and_clears_ewma():
+    wd = resume.TrainWatchdog(warmup_beats=1)
+    wd.beat(step_s=0.01)                     # warmup beat (excluded)
+    wd.beat(step_s=0.01)
+    wd.beat(step_s=0.01)
+    assert wd._ewma is not None
+    wd.reset_warmup()
+    assert wd._ewma is None and wd._beats == 0
+    # the synthetic slow first-beat-after-resume (the recompile): it is
+    # a warmup beat again, so it must NOT seed the EWMA...
+    wd.beat(step_s=5.0)
+    assert wd._ewma is None
+    # ...and the next real step seeds it from the true cadence
+    wd.beat(step_s=0.01)
+    assert wd._ewma == pytest.approx(0.01)
+
+
+def test_watchdog_reset_warmup_keeps_compile_beat_out_of_ewma():
+    """The failure mode the reset exists for, with a synthetic slow
+    first-beat-after-resume: a reused watchdog is past its warmup, so
+    the resumed step's one-off compile beat FEEDS the EWMA and inflates
+    the stall threshold by stall_factor * compile_time — genuine stalls
+    then go undetected for the rest of the run. reset_warmup re-enters
+    warmup so the compile beat is excluded, exactly like cold-start's
+    warmup_beats excluded the first compile."""
+    rec = fr.FlightRecorder(None)
+    rec.run_start(mode="wd-resume")
+
+    def stalls_after_compile_then_real_stall(reset):
+        wd = resume.TrainWatchdog(min_stall_s=0.05, poll_s=0.01,
+                                  stall_factor=5.0, recorder=rec).start()
+        try:
+            for _ in range(3):               # pre-kill cadence: fast
+                wd.beat(step_s=0.01)
+            if reset:
+                wd.reset_warmup()            # what fit(resume=True) does
+            wd.beat(step_s=1.0)              # the resumed compile step
+            thr = wd.threshold_s()
+            # a genuine 0.5s stall: ~50x the true cadence, but well
+            # under the EWMA-inflated threshold — only a watchdog whose
+            # EWMA excluded the compile beat can see it
+            time.sleep(0.5)
+            return wd.stalls, thr
+        finally:
+            wd.stop()
+
+    # control: the compile beat fed the EWMA — threshold balloons to
+    # ~stall_factor * compile_time and the real stall goes unseen
+    stalls, thr = stalls_after_compile_then_real_stall(reset=False)
+    assert thr > 1.0 and stalls == 0
+    # with the reset the compile beat is a warmup beat again: the
+    # min_stall_s floor governs and the stall is detected
+    stalls, thr = stalls_after_compile_then_real_stall(reset=True)
+    assert thr == pytest.approx(0.05) and stalls == 1
+
+
+def test_fit_resume_calls_reset_warmup(tmp_path, monkeypatch):
+    """fit(resume=True) resets a surviving watchdog's warmup before the
+    first (recompiling) step — the integration half of the unit above."""
+    mesh_mod.set_mesh(None)
+    d = str(tmp_path)
+    pt.seed(5)
+    net = nn.Linear(4, 3)
+    m = hapi.Model(net)
+    m.prepare(pt.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=net.parameters()),
+              nn.functional.mse_loss)
+    from paddle_tpu.io import TensorDataset
+    rng = np.random.RandomState(0)
+    data = TensorDataset([rng.randn(8, 4).astype("f4"),
+                          rng.randn(8, 3).astype("f4")])
+    m.fit(data, batch_size=2, epochs=1, shuffle=False, verbose=0,
+          save_dir=d, save_steps=1)
+
+    m2 = hapi.Model(nn.Linear(4, 3))
+    m2.prepare(pt.optimizer.AdamW(learning_rate=1e-2,
+                                  parameters=m2.network.parameters()),
+               nn.functional.mse_loss)
+    assert m2.load_latest(d) is not None
+    wd = resume.TrainWatchdog(min_stall_s=30.0)
+    calls = []
+    monkeypatch.setattr(wd, "reset_warmup",
+                        lambda: calls.append(True) or wd)
+    m2.fit(data, batch_size=2, epochs=1, shuffle=False, verbose=0,
+           resume=True, watchdog=wd)
+    assert calls == [True]
