@@ -41,7 +41,8 @@ class Config:
     # ---- LLM serving engine (paddle_tpu.serving front door)
     def enable_llm_engine(self, num_slots=4, max_len=256, prefill_len=None,
                           eos_token_id=None, max_queue=None, paged=False,
-                          block_size=16, num_blocks=None):
+                          block_size=16, num_blocks=None,
+                          speculative=False, draft_config=None, k=4):
         """Arm this Config for create_llm_predictor: slot-count / cache
         horizon / prompt bucket for the continuous-batching engine
         (docs/serving.md). switch_ir_optim(False) carries over as the
@@ -50,16 +51,31 @@ class Config:
         paged KV cache (docs/serving.md "Paged KV cache"): HBM scales
         with num_blocks (default: dense-equivalent capacity), prompts
         chunk through `prefill_len`-sized prefill chunks, and identical
-        prompt prefixes share blocks."""
+        prompt prefixes share blocks. speculative=True (implies paged)
+        adds draft-k/verify-once speculative decoding (docs/serving.md
+        "Speculative decoding"): a small draft model proposes `k`
+        tokens per slot per wave and the target verifies them in ONE
+        batched forward, output distribution-identical (bitwise under
+        greedy). The draft comes from create_llm_predictor's
+        `draft_model=` (pass a model with TRAINED weights loaded — the
+        engine snapshots its params at construction) or is built from
+        `draft_config` (a config of the target model's family, same
+        vocab) — note a draft_config-built draft is freshly
+        initialized: correctness holds regardless (the verify step
+        guarantees the target distribution), but acceptance — the whole
+        speedup — needs a draft that actually predicts the target."""
         self._llm_opts = {
             "num_slots": int(num_slots),
             "max_len": int(max_len),
             "prefill_len": None if prefill_len is None else int(prefill_len),
             "eos_token_id": eos_token_id,
             "max_queue": max_queue,
-            "paged": bool(paged),
+            "paged": bool(paged) or bool(speculative),
             "block_size": int(block_size),
             "num_blocks": None if num_blocks is None else int(num_blocks),
+            "speculative": bool(speculative),
+            "draft_config": draft_config,
+            "spec_k": int(k),
         }
         return self
 
@@ -319,11 +335,32 @@ class LLMPredictor:
     ServingEngine pair with a blocking generate() for the simple case and
     the full submit()/run() surface for continuous batching."""
 
-    def __init__(self, config, model):
-        from ..serving import PagedServingEngine, ServingEngine, Scheduler
+    def __init__(self, config, model, draft_model=None):
+        from ..serving import (PagedServingEngine, ServingEngine,
+                               Scheduler, SpeculativePagedEngine)
         opts = config._llm_opts or {}
         self._eos_token_id = opts.get("eos_token_id")
-        if opts.get("paged"):
+        if opts.get("speculative"):
+            if draft_model is None:
+                draft_cfg = opts.get("draft_config")
+                if draft_cfg is None:
+                    raise ValueError(
+                        "speculative serving needs a draft model: pass "
+                        "draft_model= to create_llm_predictor or "
+                        "draft_config= to enable_llm_engine")
+                # same family as the target: the configs carry the
+                # family, the model class carries the architecture
+                draft_model = type(model)(draft_cfg)
+            self.engine = SpeculativePagedEngine(
+                model, draft_model,
+                spec_k=opts.get("spec_k", 4),
+                num_slots=opts.get("num_slots", 4),
+                max_len=opts.get("max_len", 256),
+                block_size=opts.get("block_size", 16),
+                num_blocks=opts.get("num_blocks"),
+                prefill_chunk_len=opts.get("prefill_len"),
+                jit_compile=config.ir_optim())
+        elif opts.get("paged"):
             self.engine = PagedServingEngine(
                 model,
                 num_slots=opts.get("num_slots", 4),
@@ -374,16 +411,18 @@ class LLMPredictor:
         return self.scheduler.metrics
 
 
-def create_llm_predictor(config, model=None):
+def create_llm_predictor(config, model=None, draft_model=None):
     """Front door from the inference Config to paddle_tpu.serving: the
     Config carries the engine knobs (enable_llm_engine: slots, cache
-    horizon, prefill bucket, eos, queue bound; switch_ir_optim(False) ->
-    uncompiled engine; set_cpu_math_library_num_threads applies as for
-    any predictor) and `model` is a causal LM exposing
-    prefill/decode_step/init_cache (nlp.LlamaForCausalLM,
-    nlp.GPTForPretraining). LLM weights load through the model
-    constructors + paddle.load — there is no protobuf/StableHLO artifact
-    path for the decode-cache entry points."""
+    horizon, prefill bucket, eos, queue bound, speculative draft;
+    switch_ir_optim(False) -> uncompiled engine;
+    set_cpu_math_library_num_threads applies as for any predictor) and
+    `model` is a causal LM exposing prefill/decode_step/init_cache
+    (nlp.LlamaForCausalLM, nlp.GPTForPretraining). `draft_model` (same
+    family + vocab, typically far fewer layers) serves the speculative
+    configuration. LLM weights load through the model constructors +
+    paddle.load — there is no protobuf/StableHLO artifact path for the
+    decode-cache entry points."""
     if model is None:
         raise ValueError(
             "create_llm_predictor needs `model` (a causal LM with "
@@ -391,4 +430,4 @@ def create_llm_predictor(config, model=None):
             "(create_predictor) have no KV-cache decode entry points")
     if not config.llm_engine_enabled():
         config.enable_llm_engine()
-    return LLMPredictor(config, model)
+    return LLMPredictor(config, model, draft_model=draft_model)

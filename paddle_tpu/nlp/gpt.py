@@ -253,6 +253,33 @@ class GPTAttention(nn.Layer):
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h)
         return self.out_proj(Tensor(out.astype(x._data.dtype))), (ck, cv)
 
+    def decode_chunk(self, x, cache, block_tables, start, valid_len):
+        """Speculative verify step: C tokens for EVERY lane at once
+        (x: [S, C, H]; start/valid_len: [S]) — the batched, per-lane-
+        offset sibling of prefill_chunk. K/V scatter through every
+        lane's table in one op (writes at i >= valid_len[s] go to
+        scratch: horizon / spec_len clamp) and chunk_attention's
+        vector start gives each query row its own causal frontier."""
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        a = qkv._data if isinstance(qkv, Tensor) else qkv
+        a = a.reshape(b, s, 3, self.num_heads, self.head_dim)
+        a = jnp.transpose(a, (2, 0, 3, 1, 4))           # [3, S, nh, C, D]
+        q, k, v = a[0], a[1], a[2]
+        ck, cv = cache
+        from ..nn.transformer import (chunk_attention, gather_block_kv,
+                                      scatter_block_kv_chunk_batched)
+        ck = scatter_block_kv_chunk_batched(ck, k, block_tables, start,
+                                            valid_len)
+        cv = scatter_block_kv_chunk_batched(cv, v, block_tables, start,
+                                            valid_len)
+        out = chunk_attention(q, gather_block_kv(ck, block_tables),
+                              gather_block_kv(cv, block_tables),
+                              start, 1.0 / math.sqrt(self.head_dim),
+                              window=self.attn_window)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h)
+        return self.out_proj(Tensor(out.astype(x._data.dtype))), (ck, cv)
+
     def prefill(self, x, cache):
         """Prompt-phase step: the forward attention math over x [B, P, H]
         that also writes the prompt's K/V into cache[:, :, :P] so decode
@@ -338,6 +365,15 @@ class GPTBlock(nn.Layer):
         a, cache = self.attn.prefill_chunk(self.ln_1(x), cache,
                                            block_tables, chunk_start,
                                            valid_len)
+        x = x + a
+        m = self.mlp(self.ln_2(x))
+        if isinstance(m, tuple):         # MoE FFN: aux is training-only
+            m = m[0]
+        return x + m, cache
+
+    def decode_chunk(self, x, cache, block_tables, start, valid_len):
+        a, cache = self.attn.decode_chunk(self.ln_1(x), cache,
+                                          block_tables, start, valid_len)
         x = x + a
         m = self.mlp(self.ln_2(x))
         if isinstance(m, tuple):         # MoE FFN: aux is training-only
@@ -459,6 +495,31 @@ class GPTModel(nn.Layer):
             new_caches.append(cache)
         return self.ln_f(x), new_caches
 
+    def decode_chunk(self, tok_chunk, caches, block_tables, start,
+                     valid_len):
+        """Speculative verify: C tokens per lane ([S, C] ids) at
+        per-lane absolute positions start[s] + i against the block
+        pools. Position-embedding rows are gathered at the per-lane
+        position matrix (out-of-table pad positions clamp harmlessly —
+        their K/V is scratch-redirected and their logits masked)."""
+        block_tables = (block_tables._data
+                        if isinstance(block_tables, Tensor)
+                        else block_tables)
+        start = start._data if isinstance(start, Tensor) else start
+        valid_len = (valid_len._data if isinstance(valid_len, Tensor)
+                     else valid_len)
+        c = tok_chunk.shape[1]
+        pos_ids = jnp.minimum(
+            start[:, None] + jnp.arange(c, dtype=jnp.int32)[None, :],
+            self.cfg.max_seq_len - 1)
+        x = self.embeddings(tok_chunk, Tensor(pos_ids))
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, cache = blk.decode_chunk(x, cache, block_tables, start,
+                                        valid_len)
+            new_caches.append(cache)
+        return self.ln_f(x), new_caches
+
     def prefill(self, input_ids, max_len, dtype=jnp.float32):
         """Prompt-phase forward over [B, P] ids that also populates fresh
         [B, heads, max_len, head_dim] KV caches for positions [0, P).
@@ -516,6 +577,18 @@ class GPTForPretraining(nn.Layer):
     def decode_step(self, tok, caches, pos, block_tables=None):
         h, caches = self.gpt.decode_step(tok, caches, pos,
                                          block_tables=block_tables)
+        w = self.gpt.embeddings.word_embeddings.weight
+        from ..ops.math import matmul
+        return matmul(h, w, transpose_y=True), caches
+
+    def decode_chunk(self, tok_chunk, caches, block_tables, start,
+                     valid_len):
+        """Speculative verify: logits for ALL C positions of every lane
+        ([S, C, V] — the k+1-proportional head cost the verify program
+        pays on purpose: one batched forward scores the whole drafted
+        span)."""
+        h, caches = self.gpt.decode_chunk(tok_chunk, caches,
+                                          block_tables, start, valid_len)
         w = self.gpt.embeddings.word_embeddings.weight
         from ..ops.math import matmul
         return matmul(h, w, transpose_y=True), caches

@@ -146,16 +146,22 @@ def apply_rope(x, cos, sin, pos_offset=0):
 
 
 def apply_rope_positions(x, cos, sin, positions):
-    """x: [B, H, C, D] rotated at per-sequence-position vector
-    `positions` [C] (traced absolute positions — chunked prefill).
-    GATHERED per element, not dynamic-sliced: a final padded chunk can
-    run past the table end, where a dynamic_slice clamps its START and
-    silently shifts the rotation of VALID rows; the gather clamps only
-    the out-of-range pad rows themselves (whose K/V is redirected to
-    the scratch block and never read)."""
+    """x: [B, H, C, D] rotated at traced absolute positions — a [C]
+    vector (chunked prefill: one lane, every row at the same offsets)
+    or a [B, C] matrix (the speculative verify wave: every lane's
+    k+1-token span starts at its own depth). GATHERED per element, not
+    dynamic-sliced: a final padded chunk can run past the table end,
+    where a dynamic_slice clamps its START and silently shifts the
+    rotation of VALID rows; the gather clamps only the out-of-range pad
+    rows themselves (whose K/V is redirected to the scratch block and
+    never read)."""
     idx = jnp.minimum(positions, cos.shape[0] - 1)
-    c = cos[idx][None, None, :, :]                  # [1, 1, C, D/2]
-    sn = sin[idx][None, None, :, :]
+    if jnp.ndim(positions) == 2:                    # [B, C] per-lane
+        c = cos[idx][:, None, :, :]                 # [B, 1, C, D/2]
+        sn = sin[idx][:, None, :, :]
+    else:
+        c = cos[idx][None, None, :, :]              # [1, 1, C, D/2]
+        sn = sin[idx][None, None, :, :]
     return _rotate_pairs(x, c, sn)
 
 
@@ -383,6 +389,45 @@ class LlamaAttention(nn.Layer):
         out = self.o_proj(Tensor(out.astype(x._data.dtype)))
         return out, (ck, cv)
 
+    def decode_chunk(self, x, cache, block_tables, start, valid_len):
+        """Speculative verify step: C tokens for EVERY lane at once.
+        x: [S, C, H]; block_tables: [S, nblk]; start/valid_len: [S] —
+        lane s's tokens sit at absolute positions start[s] + i, with
+        writes at i >= valid_len[s] redirected to the scratch block
+        (horizon / per-request spec_len clamp). RoPE is gathered at the
+        per-lane position matrix, K/V scatter through every lane's
+        table in one op (scatter_block_kv_chunk_batched), and
+        chunk_attention's vector-start mask gives each query row its
+        own causal frontier — the C==1 case of this IS the decode wave,
+        which is why verify is a third compiled program, not a new
+        attention path."""
+        from ..framework.tensor import Tensor
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        a = qkv._data if isinstance(qkv, Tensor) else qkv
+        q, k, v = jnp.split(a, [nh * hd, (nh + nkv) * hd], axis=-1)
+        q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, nkv, hd).transpose(0, 2, 1, 3)
+        positions = start[:, None] + jnp.arange(s)[None, :]    # [S, C]
+        q = apply_rope_positions(q, self._cos, self._sin, positions)
+        k = apply_rope_positions(k, self._cos, self._sin, positions)
+        ck, cv = cache
+        from ..nn.transformer import (chunk_attention, gather_block_kv,
+                                      scatter_block_kv_chunk_batched)
+        ck = scatter_block_kv_chunk_batched(ck, k, block_tables, start,
+                                            valid_len)
+        cv = scatter_block_kv_chunk_batched(cv, v, block_tables, start,
+                                            valid_len)
+        out = chunk_attention(q, gather_block_kv(ck, block_tables),
+                              gather_block_kv(cv, block_tables),
+                              start, 1.0 / math.sqrt(hd),
+                              window=self.attn_window)
+        out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, nh * hd)
+        out = self.o_proj(Tensor(out.astype(x._data.dtype)))
+        return out, (ck, cv)
+
     def prefill(self, x, cache):
         """Prompt-phase step: the training forward's attention math over
         x [B, P, H], additionally writing the prompt's K/V into
@@ -469,6 +514,14 @@ class LlamaBlock(nn.Layer):
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x, cache
 
+    def decode_chunk(self, x, cache, block_tables, start, valid_len):
+        a, cache = self.self_attn.decode_chunk(
+            self.input_layernorm(x), cache, block_tables, start,
+            valid_len)
+        x = x + a
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, cache
+
 
 class LlamaModel(nn.Layer):
     def __init__(self, cfg: LlamaConfig):
@@ -541,6 +594,26 @@ class LlamaModel(nn.Layer):
             new_caches.append(cache)
         return self.norm(x), new_caches
 
+    def decode_chunk(self, tok_chunk, caches, block_tables, start,
+                     valid_len):
+        """Speculative verify: C tokens per lane ([S, C] ids) at
+        per-lane absolute positions start[s] + i against the block
+        pools. Returns (h [S, C, Hd], caches)."""
+        from ..framework.tensor import Tensor
+        block_tables = (block_tables._data
+                        if isinstance(block_tables, Tensor)
+                        else block_tables)
+        start = start._data if isinstance(start, Tensor) else start
+        valid_len = (valid_len._data if isinstance(valid_len, Tensor)
+                     else valid_len)
+        x = self.embed_tokens(tok_chunk)
+        new_caches = []
+        for blk, cache in zip(self.layers, caches):
+            x, cache = blk.decode_chunk(x, cache, block_tables, start,
+                                        valid_len)
+            new_caches.append(cache)
+        return self.norm(x), new_caches
+
     def prefill(self, input_ids, max_len, dtype=jnp.float32):
         """Prompt-phase forward over [B, P] ids that also populates fresh
         [B, kv_heads, max_len, head_dim] KV caches for positions [0, P).
@@ -599,6 +672,17 @@ class LlamaForCausalLM(nn.Layer):
     def decode_step(self, tok, caches, pos, block_tables=None):
         h, caches = self.model.decode_step(tok, caches, pos,
                                            block_tables=block_tables)
+        return self._logits(h), caches
+
+    def decode_chunk(self, tok_chunk, caches, block_tables, start,
+                     valid_len):
+        """Speculative verify: logits for ALL C positions of every lane
+        ([S, C, V] — the k+1-proportional head cost the verify program
+        pays on purpose: one batched forward scores the whole drafted
+        span)."""
+        h, caches = self.model.decode_chunk(tok_chunk, caches,
+                                            block_tables, start,
+                                            valid_len)
         return self._logits(h), caches
 
     def prefill_chunk(self, tok_chunk, caches, block_tables, chunk_start,

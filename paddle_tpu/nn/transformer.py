@@ -473,6 +473,32 @@ def scatter_block_kv_chunk(pool, kv_c, table, positions, valid_len):
     return pool.at[blk, :, positions % bs, :].set(kv.astype(pool.dtype))
 
 
+def scatter_block_kv_chunk_batched(pool, kv_c, tables, start, valid_len):
+    """Write a C-token chunk's K or V [S, Hkv, C, D] for EVERY lane
+    through its block table [S, nblk] at absolute positions start[s] + i
+    (start: [S] int). Per-lane positions at or past valid_len[s] ([S])
+    are redirected to the scratch block — the speculative verify wave
+    clamps its k+1-token span per slot this way (horizon, per-request
+    spec_len). The single-lane prefill variant above is the C-chunk/
+    one-slot case of this; here S lanes scatter in ONE op, which is the
+    verify program's write shape (serving/paged speculative decoding).
+    Distinct lanes write distinct blocks (frontier blocks are private by
+    the COW guard), so the only colliding writes are the scratch
+    redirects — garbage by design."""
+    import jax.numpy as jnp
+    nblk, bs = tables.shape[1], pool.shape[2]
+    s, c = kv_c.shape[0], kv_c.shape[2]
+    positions = start[:, None] + jnp.arange(c)[None, :]         # [S, C]
+    # clamp BEFORE the table gather (a clamped span can index past the
+    # table); invalid lanes/positions then redirect to scratch anyway
+    blk = jnp.take_along_axis(tables,
+                              jnp.minimum(positions // bs, nblk - 1),
+                              axis=1)                           # [S, C]
+    blk = jnp.where(jnp.arange(c)[None, :] < valid_len[:, None], blk, 0)
+    kv = jnp.transpose(kv_c, (0, 2, 1, 3))              # [S, C, Hkv, D]
+    return pool.at[blk, :, positions % bs, :].set(kv.astype(pool.dtype))
+
+
 def chunk_attention(q, ck, cv, start, scale, window=None):
     """Prefill-chunk attention core: C queries at absolute positions
     start + i over an L-position KV view (the gathered paged cache,

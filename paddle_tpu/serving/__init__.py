@@ -34,10 +34,12 @@ from .scheduler import Scheduler
 from .request import Request, RequestState
 from .metrics import ServingMetrics
 from .slo import SLOEngine, SLOPolicy
-from .paged import BlockPool, BlockPoolExhausted, PagedServingEngine
+from .paged import (BlockPool, BlockPoolExhausted, PagedServingEngine,
+                    SpeculativePagedEngine)
 from .fleet import FleetRequest, FleetRouter
 
 __all__ = ["ServingEngine", "Scheduler", "Request", "RequestState",
            "ServingMetrics", "SLOEngine", "SLOPolicy",
            "BlockPool", "BlockPoolExhausted",
-           "PagedServingEngine", "FleetRouter", "FleetRequest"]
+           "PagedServingEngine", "SpeculativePagedEngine",
+           "FleetRouter", "FleetRequest"]

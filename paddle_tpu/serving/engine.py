@@ -69,10 +69,51 @@ def _raw(x):
     return x._data if isinstance(x, Tensor) else x
 
 
-def _select_wave_tokens(lo, tok, pos, active, sample, temps, poison, key):
+def _filter_top_k_top_p(lo, top_k, top_p):
+    """Per-ROW top-k / nucleus filtering over already-temperature-scaled
+    logits [S, V] with traced per-slot knobs top_k [S] int32 (<=0 = off)
+    and top_p [S] f32 (>=1 = off). Same SEQUENTIAL semantics as
+    nn.decode.top_k_top_p_filtering — top-k first (kth-value threshold,
+    ties kept), then top-p over the RENORMALIZED top-k survivors (keep
+    the smallest prefix whose cumulative prob reaches p, best token
+    always kept) — vectorized so every slot carries its own knobs in
+    ONE compiled program, with one sort serving both stages. Disabled
+    rows pass through bitwise-identical (`where(True, lo, _)` is the
+    identity), which is what keeps the pre-existing fixed-seed sampling
+    streams unchanged."""
+    v = lo.shape[-1]
+    sort_idx = jnp.argsort(-lo, axis=-1)
+    sorted_lo = jnp.take_along_axis(lo, sort_idx, axis=-1)
+    kth = jnp.take_along_axis(
+        sorted_lo, (jnp.clip(top_k, 1, v) - 1)[:, None], axis=-1)
+    in_k = (sorted_lo >= kth) | (top_k <= 0)[:, None]   # sorted space
+    # nucleus over the top-k-FILTERED distribution (softmax of the
+    # masked row renormalizes it), exactly like applying the reference
+    # filters back to back
+    probs = jax.nn.softmax(
+        jnp.where(in_k, sorted_lo, jnp.float32(-1e9)), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (((cum - probs) < top_p[:, None])
+                   | (top_p >= 1.0)[:, None]).at[:, 0].set(True)
+    keep_sorted &= in_k
+    inv = jnp.argsort(sort_idx, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    return jnp.where(keep, lo, jnp.float32(-1e9))
+
+
+def _select_wave_tokens(lo, tok, pos, active, sample, temps, top_k,
+                        top_p, bias, poison, key):
     """The decode wave's token-selection tail, shared by the dense AND
     paged programs — the paged/dense token-parity contract depends on
-    this math staying identical, so it lives exactly once.
+    this math staying identical, so it lives exactly once. The
+    speculative verify tail reuses the same pieces position-by-position
+    (engine subclasses never reimplement the selection math).
+
+    Scenario surface: `bias` [S, V] is the per-request logit-bias /
+    token-mask hook (0 = untouched; -1e9 = forbidden — constrained/JSON
+    decoding uploads a fresh mask row per wave), `top_k`/`top_p` are
+    per-slot sampling knobs applied after temperature. Greedy lanes take
+    argmax over the BIASED logits (top-k/p cannot change an argmax).
 
     poison is all-False in production; the chaos harness sets a lane to
     inject NaN logits WITHOUT a second compiled program. The fused
@@ -81,12 +122,13 @@ def _select_wave_tokens(lo, tok, pos, active, sample, temps, poison, key):
     lane is frozen in-program and retired by the scheduler with
     finish_reason "error". Inactive (or poisoned) lanes keep their
     token and position via where — fixed shapes, no recompiles."""
-    lo = jnp.where(poison[:, None], jnp.float32(jnp.nan), lo)
+    lo = jnp.where(poison[:, None], jnp.float32(jnp.nan), lo + bias)
     finite = jnp.all(jnp.isfinite(lo), axis=-1)
     greedy = jnp.argmax(lo, axis=-1).astype(jnp.int32)
     scaled = lo / jnp.maximum(temps, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, scaled,
-                                     axis=-1).astype(jnp.int32)
+    sampled = jax.random.categorical(
+        key, _filter_top_k_top_p(scaled, top_k, top_p),
+        axis=-1).astype(jnp.int32)
     nxt = jnp.where(sample, sampled, greedy)
     ok = active & finite
     nxt = jnp.where(ok, nxt, tok)
@@ -94,14 +136,19 @@ def _select_wave_tokens(lo, tok, pos, active, sample, temps, poison, key):
     return nxt, new_pos, finite
 
 
-def _select_first_token(lo, sample, temp, key):
+def _select_first_token(lo, sample, temp, top_k, top_p, bias, key):
     """The prefill programs' first-token selection ([V] frontier logits
     -> token), shared by the dense AND paged chunked programs — same
     parity contract as _select_wave_tokens: this math lives exactly
-    once."""
+    once. Takes the admitted request's full sampling params (the first
+    token must obey the same temperature/top-k/top-p/bias as the decode
+    tail will)."""
+    lo = lo + bias
     greedy = jnp.argmax(lo).astype(jnp.int32)
+    scaled = (lo / jnp.maximum(temp, 1e-6))[None, :]
     sampled = jax.random.categorical(
-        key, lo / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
+        key, _filter_top_k_top_p(scaled, top_k[None], top_p[None])[0]
+    ).astype(jnp.int32)
     return jnp.where(sample, sampled, greedy)
 
 
@@ -142,11 +189,32 @@ class ServingEngine:
 
         # host-authoritative per-slot state
         S = self.num_slots
+        # vocab width: the logit-bias / token-mask rows are [V] uploads
+        self.vocab_size = int(model.cfg.vocab_size)
         self.slot_active = [False] * S
         self.slot_pos = [0] * S        # next cache write position
         self.slot_tok = [0] * S        # token fed to the next wave
         self.slot_sample = [False] * S
         self.slot_temp = [1.0] * S
+        # per-request scenario surface (all flow through the one shared
+        # sampling tail, _select_wave_tokens): top-k / nucleus knobs and
+        # a [S, V] additive logit-bias/token-mask matrix (0 = untouched,
+        # -1e9 = forbidden). A slot with a DYNAMIC mask (a token_mask
+        # callable refreshed per wave by the scheduler) is flagged so a
+        # speculative engine clamps its draft span to 0 for that lane —
+        # drafting ahead of a mask that depends on emitted tokens would
+        # break exactness.
+        self.slot_top_k = [0] * S
+        self.slot_top_p = [1.0] * S
+        self.slot_dynamic_mask = [False] * S
+        self._slot_bias = np.zeros((S, self.vocab_size), np.float32)
+        # device-resident copy of the bias matrix, re-uploaded only
+        # when a row actually changes: the [S, V] upload would
+        # otherwise ride EVERY wave of every engine (V can be 50k+),
+        # and the common case is all-zeros. The wave programs never
+        # donate it, so the same device array serves every wave.
+        self._slot_bias_dev = None
+        self._slot_bias_nonzero = [False] * S
 
         # admissions mid-prefill (slot -> engine-specific state): the
         # scheduler admits via begin_prefill and advances one
@@ -189,17 +257,18 @@ class ServingEngine:
         cache_dtype = self.cache_dtype
 
         def decode_wave(p, b, caches, tok, pos, active, sample, temps,
-                        poison, key):
+                        top_k, top_p, bias, poison, key):
             out, _ = model.functional_call(p, b, tok[:, None], caches,
                                            pos, method="decode_step")
             logits, new_caches = out
             lo = _raw(logits)[:, 0, :].astype(jnp.float32)
             nxt, new_pos, finite = _select_wave_tokens(
-                lo, tok, pos, active, sample, temps, poison, key)
+                lo, tok, pos, active, sample, temps, top_k, top_p, bias,
+                poison, key)
             return nxt, new_pos, finite, new_caches
 
         def prefill(p, b, caches, prompt, prompt_len, slot, sample, temp,
-                    key):
+                    top_k, top_p, bias, key):
             # frontier=prompt_len-1: the model applies its LM head to
             # that ONE position, not the whole padded bucket
             out, _ = model.functional_call(p, b, prompt[None, :],
@@ -208,7 +277,8 @@ class ServingEngine:
                                            frontier=prompt_len - 1)
             logits, slot_caches = out
             lo = _raw(logits)[0, 0].astype(jnp.float32)    # [V]
-            first = _select_first_token(lo, sample, temp, key)
+            first = _select_first_token(lo, sample, temp, top_k, top_p,
+                                        bias, key)
             new_caches = []
             for (ck, cv), (sck, scv) in zip(caches, slot_caches):
                 ck = jax.lax.dynamic_update_slice(
@@ -329,8 +399,11 @@ class ServingEngine:
             costs = {}
             try:
                 for spec in engine_program_specs(self):
-                    key = ("decode_wave" if "decode" in spec["name"]
-                           else "prefill")
+                    name = spec["name"]
+                    key = ("prefill" if "prefill" in name
+                           else "draft_wave" if "draft" in name
+                           else "verify" if "verify" in name
+                           else "decode_wave")
                     costs[key] = program_cost(spec)
             except Exception:   # noqa: BLE001 — cost analysis is
                 costs = {}      # best-effort observability, never a
@@ -390,8 +463,68 @@ class ServingEngine:
                     f"max_len {self.max_len}")
         return None
 
+    def _normalize_bias(self, logit_bias):
+        """One [V] float32 bias row from the request surface: None,
+        a {token_id: bias} dict, or a [V] array-like (a boolean array is
+        read as an ALLOWED mask: True = untouched, False = -1e9)."""
+        row = np.zeros((self.vocab_size,), np.float32)
+        if logit_bias is None:
+            return row
+        if isinstance(logit_bias, dict):
+            for t, v in logit_bias.items():
+                row[int(t)] = float(v)
+            return row
+        arr = np.asarray(logit_bias)
+        if arr.shape != (self.vocab_size,):
+            raise ValueError(
+                f"logit bias/mask must be [{self.vocab_size}] "
+                f"(vocab), got {arr.shape}")
+        if arr.dtype == bool:
+            return np.where(arr, 0.0, -1e9).astype(np.float32)
+        return arr.astype(np.float32)
+
+    def set_slot_bias(self, slot, bias, dynamic=True):
+        """Replace the slot's logit-bias/token-mask row mid-stream — the
+        scheduler's per-wave token_mask refresh (constrained decoding:
+        the allowed set changes as tokens land). `dynamic` keeps the
+        lane flagged so a speculative engine won't draft ahead of it."""
+        self._set_bias_row(slot, self._normalize_bias(bias))
+        self.slot_dynamic_mask[slot] = bool(dynamic)
+
+    def _set_bias_row(self, slot, row):
+        """Write one slot's bias row, invalidating the device copy only
+        when the row's content actually changes zero-ness — a stream of
+        bias-free requests uploads the [S, V] matrix exactly once."""
+        nonzero = bool(np.any(row))
+        if nonzero or self._slot_bias_nonzero[slot]:
+            self._slot_bias_dev = None
+        self._slot_bias[slot] = row
+        self._slot_bias_nonzero[slot] = nonzero
+
+    def _arm_slot(self, slot, first, n, sampling):
+        """Post-prefill slot arming shared by the dense and paged
+        admission paths: the request's whole sampling surface becomes
+        per-slot vectors for the next wave."""
+        self.slot_active[slot] = True
+        self.slot_pos[slot] = n
+        self.slot_tok[slot] = first
+        self.slot_sample[slot] = bool(sampling["sample"])
+        self.slot_temp[slot] = float(sampling["temp"])
+        self.slot_top_k[slot] = int(sampling["top_k"])
+        self.slot_top_p[slot] = float(sampling["top_p"])
+        self._set_bias_row(slot, sampling["bias"])
+        self.slot_dynamic_mask[slot] = bool(sampling["dynamic_mask"])
+
+    def _sampling_state(self, do_sample, temperature, top_k, top_p,
+                        logit_bias, dynamic_mask):
+        return {"sample": bool(do_sample), "temp": float(temperature),
+                "top_k": int(top_k), "top_p": float(top_p),
+                "bias": self._normalize_bias(logit_bias),
+                "dynamic_mask": bool(dynamic_mask)}
+
     def begin_prefill(self, slot, prompt, do_sample=False,
-                      temperature=1.0):
+                      temperature=1.0, top_k=0, top_p=1.0,
+                      logit_bias=None, dynamic_mask=False):
         """Stage an admission: validate and park the prompt on the slot.
         The work itself runs in prefill_step — the scheduler's advance
         phase — so engines whose prefill spans several rounds (paged
@@ -403,25 +536,39 @@ class ServingEngine:
             raise ValueError(why)
         if self.slot_active[slot] or slot in self._pending_prefill:
             raise RuntimeError(f"slot {slot} is busy")
-        self._pending_prefill[slot] = (list(prompt), bool(do_sample),
-                                       float(temperature))
+        self._pending_prefill[slot] = (
+            list(prompt),
+            self._sampling_state(do_sample, temperature, top_k, top_p,
+                                 logit_bias, dynamic_mask))
 
     def prefill_step(self, slot):
         """Advance the slot's admission one step. Returns the request's
         FIRST generated token (host int) when the prefill completed,
         None while more steps remain (the dense bucket prefill always
-        completes here)."""
-        prompt, do_sample, temperature = self._pending_prefill.pop(slot)
-        return self.prefill_slot(slot, prompt, do_sample=do_sample,
-                                 temperature=temperature)
+        completes here). Routed through prefill_slot so engine users
+        (and test seams) that override it see every admission."""
+        prompt, sampling = self._pending_prefill.pop(slot)
+        return self.prefill_slot(
+            slot, prompt, do_sample=sampling["sample"],
+            temperature=sampling["temp"], top_k=sampling["top_k"],
+            top_p=sampling["top_p"], logit_bias=sampling["bias"],
+            dynamic_mask=sampling["dynamic_mask"])
 
-    def prefill_slot(self, slot, prompt, do_sample=False, temperature=1.0):
+    def prefill_slot(self, slot, prompt, do_sample=False, temperature=1.0,
+                     top_k=0, top_p=1.0, logit_bias=None,
+                     dynamic_mask=False):
         """Admit a prompt into a free slot: run the prefill program,
         splice the slot's cache region, arm the slot for the next wave.
         Returns the request's FIRST generated token (host int)."""
         why = self.validate_prompt(prompt)
         if why:
             raise ValueError(why)
+        return self._prefill_slot_armed(
+            slot, list(prompt),
+            self._sampling_state(do_sample, temperature, top_k, top_p,
+                                 logit_bias, dynamic_mask))
+
+    def _prefill_slot_armed(self, slot, prompt, sampling):
         if self.slot_active[slot]:
             raise RuntimeError(f"slot {slot} is busy")
         if chaos.enabled():
@@ -437,13 +584,12 @@ class ServingEngine:
         first, self._caches = self._prefill(
             self._params, self._buffers, self._caches,
             jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
-            jnp.asarray(bool(do_sample)), jnp.float32(temperature), sub)
+            jnp.asarray(sampling["sample"]),
+            jnp.float32(sampling["temp"]),
+            jnp.int32(sampling["top_k"]), jnp.float32(sampling["top_p"]),
+            jnp.asarray(sampling["bias"]), sub)
         first = int(np.asarray(first))
-        self.slot_active[slot] = True
-        self.slot_pos[slot] = n
-        self.slot_tok[slot] = first
-        self.slot_sample[slot] = bool(do_sample)
-        self.slot_temp[slot] = float(temperature)
+        self._arm_slot(slot, first, n, sampling)
         return first
 
     def decode_wave(self):
@@ -506,6 +652,18 @@ class ServingEngine:
         self.last_starved_slots = []
         return active_now
 
+    def _sampling_args(self):
+        """The sampling-scenario vectors every wave uploads (per-slot
+        knobs + the [S, V] bias/mask matrix) — one place, so the dense,
+        paged and speculative wave argument tuples cannot drift."""
+        if self._slot_bias_dev is None:
+            self._slot_bias_dev = jnp.asarray(self._slot_bias)
+        return (jnp.asarray(self.slot_sample, bool),
+                jnp.asarray(self.slot_temp, jnp.float32),
+                jnp.asarray(self.slot_top_k, jnp.int32),
+                jnp.asarray(self.slot_top_p, jnp.float32),
+                self._slot_bias_dev)
+
     def _wave_args(self, active_now, poison, key):
         """The decode-wave program's argument tuple (the paged engine
         inserts its block tables after the donated caches)."""
@@ -513,8 +671,7 @@ class ServingEngine:
                 jnp.asarray(self.slot_tok, jnp.int32),
                 jnp.asarray(self.slot_pos, jnp.int32),
                 jnp.asarray(active_now, bool),
-                jnp.asarray(self.slot_sample, bool),
-                jnp.asarray(self.slot_temp, jnp.float32),
+                *self._sampling_args(),
                 jnp.asarray(poison), key)
 
     def slot_full(self, slot):
@@ -531,5 +688,9 @@ class ServingEngine:
         self.slot_active[slot] = False
         self.slot_sample[slot] = False
         self.slot_temp[slot] = 1.0
+        self.slot_top_k[slot] = 0
+        self.slot_top_p[slot] = 1.0
+        self.slot_dynamic_mask[slot] = False
+        self._set_bias_row(slot, np.zeros((self.vocab_size,), np.float32))
         self._pending_prefill.pop(slot, None)
         self._slot_trace.pop(slot, None)

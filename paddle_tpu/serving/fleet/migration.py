@@ -46,7 +46,8 @@ class FleetRequest:
 
     def __init__(self, prompt, max_tokens=16, eos_token_id=None,
                  timeout=None, on_token=None, do_sample=False,
-                 temperature=1.0):
+                 temperature=1.0, top_k=0, top_p=1.0,
+                 stop_sequences=None, logit_bias=None, token_mask=None):
         self.request_id = next(FleetRequest._ids)
         # ONE trace id for the life of the request: every hop's Request
         # inherits it (_submit_kwargs), so the spans a migration leaves
@@ -59,6 +60,14 @@ class FleetRequest:
         self.on_token = on_token
         self.do_sample = bool(do_sample)
         self.temperature = float(temperature)
+        # the scenario surface survives migration: the continuation hop
+        # must sample under the SAME knobs or the tail of a migrated
+        # request is a different request
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.stop_sequences = stop_sequences
+        self.logit_bias = logit_bias
+        self.token_mask = token_mask
 
         self.submit_time = None      # stamped once, at fleet admission
         self.migrations = 0
@@ -162,6 +171,16 @@ class FleetRequest:
             "timeout": remaining_t,
             "do_sample": self.do_sample,
             "temperature": self.temperature,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
+            "stop_sequences": self.stop_sequences,
+            "logit_bias": self.logit_bias,
+            # stop matching must see ACROSS the migration seam: the
+            # dead hop's tokens become prompt on the next hop, so the
+            # tail of the prior output stream rides along as context —
+            # a stop sequence whose first half was already streamed
+            # still fires on its second half
+            "stop_context": self._stop_tail(),
             # trace continuity across migration: the resumed hop's
             # spans carry the SAME fleet trace id, so the halves of a
             # migrated request link instead of starting a fresh trace
@@ -173,7 +192,26 @@ class FleetRequest:
             def shim(_req, token):
                 fleet_req.on_token(fleet_req, token)
             kw["on_token"] = shim
+        if self.token_mask is not None:
+            fleet_req = self
+
+            def mask_shim(_req):
+                # the mask sees the FLEET view: its stitched output
+                # stream, not the hop-local request whose prior tokens
+                # migrated into the prompt
+                return fleet_req.token_mask(fleet_req)
+            kw["token_mask"] = mask_shim
         return kw
+
+    def _stop_tail(self):
+        """The prior output stream's tail a continuation hop needs for
+        seam-spanning stop matching: the longest stop sequence minus
+        one tokens (None when no multi-token stop sequence exists)."""
+        longest = max((len(s) for s in (self.stop_sequences or [])),
+                      default=0)
+        if longest < 2 or not self._prior:
+            return None
+        return self._prior[-(longest - 1):]
 
     def _absorb(self):
         """A hop died: bank its clean tokens (every emitted token

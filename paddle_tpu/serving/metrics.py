@@ -129,6 +129,23 @@ _PREFIX_MISSES = telemetry.counter(
     "serving_prefix_cache_misses_total",
     "Full prompt blocks that had to be computed by prefill (no cached "
     "block with a matching chain hash)")
+# speculative decoding (serving/paged SpeculativePagedEngine): the
+# draft-k/verify-once wave's economics — acceptance rate IS the
+# speedup knob (mean accepted/wave > 0 means decode rounds per
+# generated token dropped below 1:1)
+_SPEC_PROPOSED = telemetry.counter(
+    "serving_spec_tokens_proposed_total",
+    "Draft tokens proposed to the verify wave (speculative decoding; "
+    "per-lane spec_len after horizon/token-mask clamps)")
+_SPEC_ACCEPTED = telemetry.counter(
+    "serving_spec_tokens_accepted_total",
+    "Draft tokens accepted by the exact acceptance-rejection tail "
+    "(the bonus/correction token per lane is not a draft's and is "
+    "never counted here)")
+_SPEC_RATE = telemetry.gauge(
+    "serving_spec_acceptance_rate",
+    "Cumulative accepted/proposed ratio of the speculative decode "
+    "path (draft-model quality at the currently served traffic)")
 
 
 def record_block_usage(used, total):
@@ -199,6 +216,10 @@ class ServingMetrics:
         self._wave_seconds = 0.0
         self._wave_flops = 0.0
         self._wave_bytes = 0.0
+        # speculative decoding tallies (0 on non-speculative engines)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_waves = 0
 
     # ---------------------------------------------------------- recording
     def on_submit(self):
@@ -251,6 +272,22 @@ class ServingMetrics:
                 _MFU.set(float(flops) / (wave_s * peak_flops))
             if bytes_accessed:
                 _HBM_UTIL.set(float(bytes_accessed) / (wave_s * peak_bw))
+
+    def on_spec(self, proposed, accepted):
+        """One speculative wave's draft economics (scheduler-reported:
+        proposed = sum of per-lane spec_len, accepted = draft tokens the
+        acceptance kept). Updates the process-wide counters and the
+        cumulative acceptance-rate gauge."""
+        if proposed:
+            _SPEC_PROPOSED.inc(int(proposed))
+        if accepted:
+            _SPEC_ACCEPTED.inc(int(accepted))
+        with self._lock:
+            self._spec_proposed += int(proposed)
+            self._spec_accepted += int(accepted)
+            self._spec_waves += 1
+            if self._spec_proposed:
+                _SPEC_RATE.set(self._spec_accepted / self._spec_proposed)
 
     def on_phase(self, phase, seconds):
         """Attribute one scheduler-round phase's wall time (keys in
@@ -335,6 +372,8 @@ class ServingMetrics:
             phase_seconds = dict(self._phase_seconds)
             wave_s = self._wave_seconds
             wave_flops, wave_bytes = self._wave_flops, self._wave_bytes
+            spec_p, spec_a = self._spec_proposed, self._spec_accepted
+            spec_w = self._spec_waves
         return {
             "requests_completed": self._latency.count(),
             "tokens_generated": tokens,
@@ -378,4 +417,13 @@ class ServingMetrics:
                     if wave_s and wave_flops else None),
             "hbm_util": (wave_bytes / (wave_s * _device_peaks()[1])
                          if wave_s and wave_bytes else None),
+            # speculative decoding (perf PR): 0/None on engines without
+            # a draft model. accepted_per_wave is the headline number —
+            # > 0 means each wave nets more than one token per lane
+            "spec_tokens_proposed": spec_p,
+            "spec_tokens_accepted": spec_a,
+            "spec_acceptance_rate": (spec_a / spec_p if spec_p
+                                     else None),
+            "spec_accepted_per_wave": (spec_a / spec_w if spec_w
+                                       else None),
         }

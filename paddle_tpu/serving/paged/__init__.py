@@ -14,6 +14,7 @@ docs/serving.md ("Paged KV cache").
     sched = Scheduler(engine)          # same scheduler, same Requests
 """
 from .block_pool import BlockPool, BlockPoolExhausted
-from .engine import PagedServingEngine
+from .engine import PagedServingEngine, SpeculativePagedEngine
 
-__all__ = ["BlockPool", "BlockPoolExhausted", "PagedServingEngine"]
+__all__ = ["BlockPool", "BlockPoolExhausted", "PagedServingEngine",
+           "SpeculativePagedEngine"]

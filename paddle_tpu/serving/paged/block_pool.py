@@ -28,6 +28,16 @@ Invariants the engine relies on:
     private block, so this fires only as a safety net — but it is the
     load-bearing guarantee that sharing can never corrupt a neighbour.
 
+Speculative decoding (serving/paged SpeculativePagedEngine) layers a
+DRAFT model's KV pools onto the SAME block ids: one table row names the
+same token span in the target pools and the draft pools, so allocation,
+refcounts, prefix sharing and copy-on-write govern both at once — there
+is no second allocator to leak from. Blocks allocated ahead for drafted
+tokens that verification REJECTS are released the same wave
+(`_rollback_spec_blocks`); `outstanding()` below is the audit surface
+the chaos harness uses to prove no speculative block outlives its
+tokens.
+
 Thread-model: driven single-threaded from the scheduler's wave loop
 (`Scheduler._wave_lock` serializes every engine call); producer threads
 touch only the queue, never the pool.
@@ -81,6 +91,14 @@ class BlockPool:
 
     def refcount(self, block):
         return self._ref[block]
+
+    def outstanding(self):
+        """{block_id: refcount} for every live (refcount > 0) block —
+        the refcount-audit surface: after a stream drains this must be
+        empty, and during one, every entry must be owned by some slot's
+        table (the speculative rollback audit names leaked blocks with
+        this instead of just counting them)."""
+        return {b: r for b, r in enumerate(self._ref) if r > 0}
 
     def _publish(self):
         serving_metrics.record_block_usage(self.used, self.usable)

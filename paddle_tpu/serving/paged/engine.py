@@ -37,8 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...utils import chaos, telemetry
-from ..engine import (ServingEngine, _raw, _select_first_token,
-                      _select_wave_tokens)
+from ..engine import (ServingEngine, _filter_top_k_top_p, _raw,
+                      _select_first_token, _select_wave_tokens)
 from .block_pool import BlockPool, BlockPoolExhausted
 
 
@@ -96,18 +96,20 @@ class PagedServingEngine(ServingEngine):
         model = self.model
 
         def decode_wave(p, b, caches, tables, tok, pos, active, sample,
-                        temps, poison, key):
+                        temps, top_k, top_p, bias, poison, key):
             out, _ = model.functional_call(p, b, tok[:, None], caches,
                                            pos, method="decode_step",
                                            block_tables=tables)
             logits, new_caches = out
             lo = _raw(logits)[:, 0, :].astype(jnp.float32)
             nxt, new_pos, finite = _select_wave_tokens(
-                lo, tok, pos, active, sample, temps, poison, key)
+                lo, tok, pos, active, sample, temps, top_k, top_p, bias,
+                poison, key)
             return nxt, new_pos, finite, new_caches
 
         def prefill_chunk(p, b, caches, table, chunk, chunk_start,
-                          valid_len, frontier, sample, temp, key):
+                          valid_len, frontier, sample, temp, top_k,
+                          top_p, bias, key):
             out, _ = model.functional_call(
                 p, b, chunk[None, :], caches, method="prefill_chunk",
                 block_tables=table[None, :], chunk_start=chunk_start,
@@ -117,7 +119,8 @@ class PagedServingEngine(ServingEngine):
             # consumed on host; earlier chunks compute a [V] row that is
             # simply ignored (static shapes beat a conditional head)
             lo = _raw(logits)[0, 0].astype(jnp.float32)
-            first = _select_first_token(lo, sample, temp, key)
+            first = _select_first_token(lo, sample, temp, top_k, top_p,
+                                        bias, key)
             return first, new_caches
 
         self._decode_wave_fn = decode_wave
@@ -156,7 +159,8 @@ class PagedServingEngine(ServingEngine):
         return None
 
     def begin_prefill(self, slot, prompt, do_sample=False,
-                      temperature=1.0):
+                      temperature=1.0, top_k=0, top_p=1.0,
+                      logit_bias=None, dynamic_mask=False):
         """Admit a prompt: match shared prefix blocks, allocate the rest
         (BlockPoolExhausted = capacity, handled by the scheduler as
         queueing pressure, never a request fault), and stage the chunk
@@ -197,7 +201,9 @@ class PagedServingEngine(ServingEngine):
         start = min(start, ((n - 1) // chunk) * chunk)
         self._pending_prefill[slot] = {
             "prompt": prompt, "n": n, "next": start,
-            "sample": bool(do_sample), "temp": float(temperature),
+            "sampling": self._sampling_state(do_sample, temperature,
+                                             top_k, top_p, logit_bias,
+                                             dynamic_mask),
             "hashes": (self.block_pool.prompt_hashes(prompt)
                        if self.prefix_sharing else []),
             "next_hash": len(shared),
@@ -229,11 +235,16 @@ class PagedServingEngine(ServingEngine):
         last = c0 + C >= n
         frontier = (n - 1) - c0 if last else 0
         self._key, sub = jax.random.split(self._key)
+        sampling = st["sampling"]
         first, self._caches = self._prefill(
-            self._params, self._buffers, self._caches,
+            *self._prefill_chunk_args(slot),
             jnp.asarray(self._tables[slot]), jnp.asarray(chunk),
             jnp.int32(c0), jnp.int32(valid), jnp.int32(frontier),
-            jnp.asarray(st["sample"]), jnp.float32(st["temp"]), sub)
+            jnp.asarray(sampling["sample"]),
+            jnp.float32(sampling["temp"]),
+            jnp.int32(sampling["top_k"]),
+            jnp.float32(sampling["top_p"]),
+            jnp.asarray(sampling["bias"]), sub)
         # full prompt blocks written by this chunk enter the prefix
         # cache — only now, so a concurrent admission can never share a
         # block whose content is not on the device yet
@@ -250,20 +261,22 @@ class PagedServingEngine(ServingEngine):
             return None
         del self._pending_prefill[slot]
         first = int(np.asarray(first))
-        self.slot_active[slot] = True
-        self.slot_pos[slot] = n
-        self.slot_tok[slot] = first
-        self.slot_sample[slot] = st["sample"]
-        self.slot_temp[slot] = st["temp"]
+        self._arm_slot(slot, first, n, sampling)
         return first
 
-    def prefill_slot(self, slot, prompt, do_sample=False, temperature=1.0):
+    def _prefill_chunk_args(self, slot):
+        """Leading argument tuple of the prefill-chunk program (the
+        speculative engine appends its draft params here so ONE chunk
+        program writes both models' K/V)."""
+        return (self._params, self._buffers, self._caches)
+
+    def prefill_slot(self, slot, prompt, **kw):
         """Synchronous admission (runs every chunk back-to-back) — the
         dense-engine surface, kept for direct engine users; the
         scheduler uses begin_prefill/prefill_step to fold chunks between
-        waves."""
-        self.begin_prefill(slot, prompt, do_sample=do_sample,
-                           temperature=temperature)
+        waves. Accepts the full per-request sampling surface
+        (do_sample, temperature, top_k, top_p, logit_bias)."""
+        self.begin_prefill(slot, prompt, **kw)
         while True:
             first = self.prefill_step(slot)
             if first is not None:
@@ -311,8 +324,7 @@ class PagedServingEngine(ServingEngine):
                 jnp.asarray(self.slot_tok, jnp.int32),
                 jnp.asarray(self.slot_pos, jnp.int32),
                 jnp.asarray(active_now, bool),
-                jnp.asarray(self.slot_sample, bool),
-                jnp.asarray(self.slot_temp, jnp.float32),
+                *self._sampling_args(),
                 jnp.asarray(poison), key)
 
     # ----------------------------------------------------- copy-on-write
@@ -363,4 +375,424 @@ class PagedServingEngine(ServingEngine):
                  cache_blocks_total=self.block_pool.usable,
                  prefix_cache_hits=self.block_pool.prefix_hits,
                  prefix_cache_misses=self.block_pool.prefix_misses)
+        return h
+
+
+def _spec_verify_tail(lo, tok, pos, active, sample, temps, top_k, top_p,
+                      bias, spec_len, draft_toks, draft_probs, poison,
+                      key):
+    """The speculative wave's acceptance–rejection tail: the
+    _select_wave_tokens math applied position-by-position over the
+    verify chunk's [S, C, V] target logits (C = k + 1), with EXACT
+    acceptance–rejection so the output distribution equals the target
+    model's own — and the greedy path is bitwise the target trajectory.
+
+    Greedy lanes accept the longest draft prefix agreeing with the
+    target argmax (over BIASED logits, like the non-speculative tail)
+    and emit the correcting argmax at the first mismatch. Sampled lanes
+    accept draft token d_i with probability min(1, p_t(d_i)/p_d(d_i))
+    and resample the first rejection from the normalized residual
+    max(p_t - p_d, 0); with all k accepted, the bonus token is the
+    a == k case of the same formula because p_d is zero-extended at
+    position k (residual = p_t). Both p_t and p_d are the PROCESSED
+    distributions (temperature, top-k/top-p, logit-bias applied), so
+    the scenario surface composes with speculation exactly.
+
+    Per-lane spec_len clamps acceptance (horizon, dynamic token-mask
+    lanes run at spec_len 0 == plain decode). Frozen lanes (inactive,
+    poisoned, non-finite) emit 0 tokens and keep their position — the
+    scheduler retires poisoned lanes exactly like the non-spec wave."""
+    s, c, v = lo.shape
+    k = c - 1
+    lo = jnp.where(poison[:, None, None], jnp.float32(jnp.nan),
+                   lo + bias[:, None, :])
+    finite = jnp.all(jnp.isfinite(lo), axis=(1, 2))
+    greedy = jnp.argmax(lo, axis=-1).astype(jnp.int32)          # [S, C]
+    scaled = lo / jnp.maximum(temps, 1e-6)[:, None, None]
+    filt = _filter_top_k_top_p(
+        scaled.reshape(s * c, v), jnp.repeat(top_k, c),
+        jnp.repeat(top_p, c)).reshape(s, c, v)
+    p_t = jax.nn.softmax(filt, axis=-1)                         # [S, C, V]
+    valid = jnp.arange(k)[None, :] < spec_len[:, None]          # [S, k]
+    ok_greedy = draft_toks == greedy[:, :k]
+    key_u, key_r, key_f = jax.random.split(key, 3)
+    u = jax.random.uniform(key_u, (s, k))
+    pt_d = jnp.take_along_axis(p_t[:, :k, :], draft_toks[..., None],
+                               axis=-1)[..., 0]                 # [S, k]
+    pd_d = jnp.take_along_axis(draft_probs, draft_toks[..., None],
+                               axis=-1)[..., 0]
+    ok_sample = u * pd_d < pt_d
+    ok = jnp.where(sample[:, None], ok_sample, ok_greedy) & valid
+    accepted = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+    a = jnp.sum(accepted, axis=1)                    # [S] in [0, k]
+    # the one non-draft token per lane: correction at the rejection,
+    # bonus past a fully-accepted span. p_d is zeroed at every position
+    # the lane did NOT draft (i >= its spec_len, the k-th position
+    # included) — there the formula must degenerate to sampling p_t
+    # itself: a horizon- or token-mask-clamped lane proposed nothing at
+    # its frontier, and subtracting a draft distribution it never
+    # offered would skew the output away from the target's (the
+    # "spec_len 0 == plain decode" exactness contract)
+    p_d_ext = jnp.concatenate(
+        [draft_probs, jnp.zeros((s, 1, v), draft_probs.dtype)], axis=1)
+    p_d_ext = jnp.where(
+        (jnp.arange(c)[None, :] < spec_len[:, None])[:, :, None],
+        p_d_ext, 0.0)
+    p_t_a = jnp.take_along_axis(p_t, a[:, None, None], axis=1)[:, 0]
+    p_d_a = jnp.take_along_axis(p_d_ext, a[:, None, None], axis=1)[:, 0]
+    residual = jnp.maximum(p_t_a - p_d_a, 0.0)
+    res_tok = jax.random.categorical(
+        key_r, jnp.log(jnp.maximum(residual, 1e-30)),
+        axis=-1).astype(jnp.int32)
+    # float round-off can zero a residual row that is positive in exact
+    # arithmetic — fall back to the target distribution itself (a
+    # measure-zero correction, never reached in exact math)
+    fallback = jax.random.categorical(
+        key_f, jnp.log(jnp.maximum(p_t_a, 1e-30)),
+        axis=-1).astype(jnp.int32)
+    res_tok = jnp.where(jnp.sum(residual, axis=-1) > 0, res_tok,
+                        fallback)
+    greedy_a = jnp.take_along_axis(greedy, a[:, None], axis=1)[:, 0]
+    extra = jnp.where(sample, res_tok, greedy_a).astype(jnp.int32)
+    draft_pad = jnp.concatenate(
+        [draft_toks, jnp.zeros((s, 1), jnp.int32)], axis=1)
+    out = jnp.where(jnp.arange(c)[None, :] < a[:, None], draft_pad,
+                    extra[:, None])
+    ok_lane = active & finite
+    n_emit = jnp.where(ok_lane, a + 1, 0)
+    new_pos = pos + n_emit
+    nxt = jnp.where(ok_lane, extra, tok)
+    return out, n_emit, nxt, new_pos, finite
+
+
+class SpeculativePagedEngine(PagedServingEngine):
+    """Draft-k / verify-once speculative decoding over the paged engine.
+
+    A small DRAFT model proposes up to k tokens per slot per wave; the
+    target model scores all k + 1 positions in ONE batched forward built
+    on `chunk_attention` over the SAME block tables (C == k + 1 — the
+    C == 1 case of the verify kernel IS the plain decode wave, so this
+    is a third compiled program, not a new attention path). Exact
+    acceptance–rejection (see `_spec_verify_tail`) keeps outputs
+    distribution-identical to the target model — bitwise-identical under
+    greedy — while a wave advances each lane by 1..k+1 tokens: decode
+    rounds per generated token drop by the acceptance rate.
+
+    Memory discipline: the draft model's paged KV pools share the block
+    TABLES (and therefore the allocator, refcounts, prefix sharing and
+    copy-on-write) with the target pools — one block id names the same
+    token span in both. The prefill-chunk program writes BOTH models'
+    K/V, so a prefix-cache hit serves the draft cache too, and
+    `retire_slot` frees both at once. Speculated-ahead blocks that the
+    acceptance did not commit are rolled back after every wave
+    (`_rollback_spec_blocks`) — the pool never holds blocks for tokens
+    that were rejected.
+
+    Compile-once holds as THREE programs with fully static shapes:
+    `paged_spec_draft_wave` (k+1 draft decode steps in one executable),
+    `paged_spec_verify` (the chunk-scored target forward + acceptance
+    tail), and `paged_spec_prefill_chunk` (target + draft chunk
+    prefill). Per-lane spec_len (horizon clamp, dynamic token-mask
+    lanes) is a traced VALUE, not a shape.
+    """
+
+    def __init__(self, model, draft_model, spec_k=4, **kw):
+        if draft_model is None:
+            raise ValueError("SpeculativePagedEngine needs a draft_model")
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        self.spec_k = int(spec_k)
+        draft_model.eval()
+        self.draft_model = draft_model
+        self._draft_params, self._draft_buffers = \
+            draft_model.functional_state()
+        if int(draft_model.cfg.vocab_size) != int(model.cfg.vocab_size):
+            raise ValueError(
+                f"draft vocab {draft_model.cfg.vocab_size} != target "
+                f"vocab {model.cfg.vocab_size}: acceptance-rejection "
+                "compares distributions over ONE vocabulary")
+        self._wave_spec_len = None
+        self.last_spec_proposed = 0
+        self.last_spec_accepted = 0
+        super().__init__(model, **kw)
+
+    # ---------------------------------------------------------- caches
+    def _make_caches(self):
+        # ONE bundle, donated through every program: the target pools
+        # and the draft pools ride together so each program updates its
+        # half in place and passes the other through aliased
+        tgt = super()._make_caches()
+        draft = self.draft_model.init_paged_cache(
+            self.block_pool.num_blocks, self.block_size, self.max_len,
+            dtype=self.cache_dtype)
+        return (tgt, draft)
+
+    # -------------------------------------------------------- programs
+    def _build_programs(self):
+        model, draft, k = self.model, self.draft_model, self.spec_k
+
+        def draft_wave(dp, db, caches, tables, tok, pos, sample,
+                       temps, top_k, top_p, bias, spec_len, key):
+            """k+1 draft decode steps in ONE executable: step j writes
+            the fed token's K/V at pos+j and proposes the next; the
+            final step is write-only (it commits d_k's K/V so a fully
+            accepted span leaves the draft cache synchronized). Writes
+            past a lane's spec_len land in the scratch block via a
+            scratch table row — per-step, per-lane, still one program."""
+            tgt_caches, dr_caches = caches
+            cur = tok
+            toks, probs = [], []
+            for j in range(k + 1):
+                tab_j = jnp.where((j <= spec_len)[:, None], tables,
+                                  jnp.int32(BlockPool.SCRATCH))
+                out, _ = draft.functional_call(
+                    dp, db, cur[:, None], dr_caches, pos + j,
+                    method="decode_step", block_tables=tab_j)
+                logits, dr_caches = out
+                if j == k:
+                    break               # write-only step: no proposal
+                lo = _raw(logits)[:, 0, :].astype(jnp.float32) + bias
+                greedy = jnp.argmax(lo, axis=-1).astype(jnp.int32)
+                scaled = lo / jnp.maximum(temps, 1e-6)[:, None]
+                filt = _filter_top_k_top_p(scaled, top_k, top_p)
+                key, sub = jax.random.split(key)
+                sampled = jax.random.categorical(
+                    sub, filt, axis=-1).astype(jnp.int32)
+                cur = jnp.where(sample, sampled, greedy)
+                toks.append(cur)
+                probs.append(jax.nn.softmax(filt, axis=-1))
+            return (jnp.stack(toks, axis=1), jnp.stack(probs, axis=1),
+                    (tgt_caches, dr_caches))
+
+        def spec_verify(p, b, caches, tables, tok, pos, active, sample,
+                        temps, top_k, top_p, bias, spec_len, draft_toks,
+                        draft_probs, poison, key):
+            """Verify-once: ONE target forward scores all k+1 positions
+            of every lane (decode_chunk == chunk_attention over the
+            block tables), then the exact acceptance-rejection tail."""
+            tgt_caches, dr_caches = caches
+            chunk = jnp.concatenate([tok[:, None], draft_toks], axis=1)
+            out, _ = model.functional_call(
+                p, b, chunk, tgt_caches, tables, pos, spec_len + 1,
+                method="decode_chunk")
+            logits, tgt_caches = out
+            lo = _raw(logits).astype(jnp.float32)       # [S, k+1, V]
+            out_toks, n_emit, nxt, new_pos, finite = _spec_verify_tail(
+                lo, tok, pos, active, sample, temps, top_k, top_p, bias,
+                spec_len, draft_toks, draft_probs, poison, key)
+            return (out_toks, n_emit, nxt, new_pos, finite,
+                    (tgt_caches, dr_caches))
+
+        def prefill_chunk(p, b, caches, dp, db, table, chunk,
+                          chunk_start, valid_len, frontier, sample, temp,
+                          top_k, top_p, bias, key):
+            """The spec configuration's ONE prefill program: the chunk
+            writes the TARGET pools (frontier logits select the first
+            token, exactly the non-spec chunk) AND the DRAFT pools — a
+            draft cache synchronized at admission is what lets the
+            first decode wave start drafting immediately, and a
+            prefix-cache hit skips the chunk for both models at once."""
+            tgt_caches, dr_caches = caches
+            out, _ = model.functional_call(
+                p, b, chunk[None, :], tgt_caches, method="prefill_chunk",
+                block_tables=table[None, :], chunk_start=chunk_start,
+                valid_len=valid_len, frontier=frontier)
+            logits, tgt_caches = out
+            dout, _ = draft.functional_call(
+                dp, db, chunk[None, :], dr_caches,
+                method="prefill_chunk", block_tables=table[None, :],
+                chunk_start=chunk_start, valid_len=valid_len,
+                frontier=frontier)
+            _, dr_caches = dout         # draft frontier logits unused
+            lo = _raw(logits)[0, 0].astype(jnp.float32)
+            first = _select_first_token(lo, sample, temp, top_k, top_p,
+                                        bias, key)
+            return first, (tgt_caches, dr_caches)
+
+        self._draft_wave_fn = draft_wave
+        self._decode_wave_fn = spec_verify
+        self._prefill_fn = prefill_chunk
+        self._program_donate_argnums = (2,)
+
+        if self._jit:
+            self._draft_wave = telemetry.instrument_jit(
+                jax.jit(draft_wave,
+                        donate_argnums=self._program_donate_argnums),
+                "paged_spec_draft_wave")
+            self._decode_wave = telemetry.instrument_jit(
+                jax.jit(spec_verify,
+                        donate_argnums=self._program_donate_argnums),
+                "paged_spec_verify")
+            self._prefill = telemetry.instrument_jit(
+                jax.jit(prefill_chunk,
+                        donate_argnums=self._program_donate_argnums),
+                "paged_spec_prefill_chunk")
+        else:
+            self._draft_wave = draft_wave
+            self._decode_wave = spec_verify
+            self._prefill = prefill_chunk
+
+    @property
+    def draft_compiles(self):
+        """Compiled draft-wave programs (compile-once: stays 1)."""
+        return self._draft_wave._cache_size() if self._jit else 0
+
+    def _copy_block(self, caches, src, dst):
+        """COW over the BUNDLE: a shared block's content must be copied
+        in the target AND draft pools — one block id names the same
+        token span in both, so a half-copied block would desynchronize
+        the draft cache from the tokens it claims to hold."""
+        if self._copy_fn is None:
+            def copy_fn(caches, src, dst):
+                tgt, dr = caches
+
+                def cp(pools):
+                    return [(ck.at[dst].set(ck[src]),
+                             cv.at[dst].set(cv[src])) for ck, cv in pools]
+                return (cp(tgt), cp(dr))
+            self._copy_fn = (telemetry.instrument_jit(
+                jax.jit(copy_fn, donate_argnums=(0,)), "paged_cow_copy")
+                if self._jit else copy_fn)
+        return self._copy_fn(caches, jnp.int32(src), jnp.int32(dst))
+
+    def _prefill_chunk_args(self, slot):
+        return (self._params, self._buffers, self._caches,
+                self._draft_params, self._draft_buffers)
+
+    # ----------------------------------------------------------- waves
+    def _prepare_wave(self, active_now):
+        """Back every position the wave may write — pos .. pos+spec_len
+        per lane (draft writes + the verify chunk's span) — with
+        allocated, exclusively-owned blocks. Allocation is atomic per
+        lane; a lane that cannot get its full span is starved out of
+        the wave and preempted by recompute, exactly like the
+        single-token engine."""
+        starved, bs = [], self.block_size
+        for s, live in enumerate(active_now):
+            if not live:
+                continue
+            last_bi = (self.slot_pos[s] + self._wave_spec_len[s]) // bs
+            blocks = self._slot_blocks[s]
+            try:
+                missing = last_bi + 1 - len(blocks)
+                if missing > 0:
+                    for blk in self.block_pool.alloc(missing):
+                        blocks.append(blk)
+                        self._tables[s, len(blocks) - 1] = blk
+                for bi in range(self.slot_pos[s] // bs, last_bi + 1):
+                    if self.block_pool.refcount(blocks[bi]) > 1:
+                        self._ensure_private(s, bi)
+            except BlockPoolExhausted:
+                starved.append(s)
+                active_now[s] = False
+        self.last_starved_slots = starved
+        return active_now
+
+    def _rollback_spec_blocks(self, wave_slots):
+        """Return speculated-ahead blocks the acceptance did not commit:
+        after the wave, a lane needs exactly the blocks covering its
+        committed positions [0, pos) — anything past that was allocated
+        for rejected draft tokens and goes straight back to the pool
+        (refcount-clean: fresh spec blocks are never hashed and never
+        shared). Skipping this (the chaos no-rollback control) leaves
+        the pool holding blocks for tokens that never existed."""
+        bs = self.block_size
+        for s in wave_slots:
+            blocks = self._slot_blocks[s]
+            needed = max(1, (self.slot_pos[s] + bs - 1) // bs)
+            if len(blocks) > needed:
+                extra = blocks[needed:]
+                del blocks[needed:]
+                self._tables[s, needed:] = 0
+                self.block_pool.release(extra)
+
+    def decode_wave(self):
+        """One speculative wave: draft k, verify once, accept exactly.
+        Returns {slot: [tokens]} — 1..k+1 tokens per healthy lane (the
+        scheduler streams them in order and retires mid-batch on
+        eos/budget/stop). Poisoned/non-finite lanes emit nothing, are
+        listed in `last_nonfinite_slots`, and their speculation is
+        rolled back with the rest."""
+        active_now = list(self.slot_active)
+        if not any(active_now):
+            self.last_nonfinite_slots = []
+            self.last_starved_slots = []
+            return {}
+        if chaos.enabled():
+            chaos.fire(chaos.DECODE_WAVE, active=sum(active_now))
+        # per-lane draft span: the horizon clamps it (writes stop at
+        # max_len - 1), a dynamic token-mask lane runs at 0 — the
+        # verify chunk then degenerates to the plain single-token wave
+        # for that lane, mask applied, same program
+        spec_len = [0] * self.num_slots
+        for s, live in enumerate(active_now):
+            if live:
+                limit = self.max_len - 1 - self.slot_pos[s]
+                want = 0 if self.slot_dynamic_mask[s] else self.spec_k
+                spec_len[s] = max(0, min(want, limit))
+        self._wave_spec_len = spec_len
+        active_now = self._prepare_wave(active_now)
+        if not any(active_now):
+            self.last_nonfinite_slots = []
+            return {}
+        poison = np.zeros((self.num_slots,), bool)
+        if chaos.enabled():
+            hit = chaos.value(chaos.DECODE_WAVE_NAN)
+            if hit is not None:
+                for s in np.atleast_1d(hit):
+                    poison[int(s)] = True
+        self._key, dkey = jax.random.split(self._key)
+        self._key, vkey = jax.random.split(self._key)
+        tables = jnp.asarray(
+            np.where(np.asarray(active_now, bool)[:, None], self._tables,
+                     np.int32(BlockPool.SCRATCH)))
+        tok = jnp.asarray(self.slot_tok, jnp.int32)
+        pos = jnp.asarray(self.slot_pos, jnp.int32)
+        act = jnp.asarray(active_now, bool)
+        sampling = self._sampling_args()
+        sl = jnp.asarray(spec_len, jnp.int32)
+        # the draft wave takes no active mask: inactive lanes ride
+        # scratch table rows and their proposals are discarded by
+        # the verify tail's active where — one argument fewer keeps
+        # every draft input live for the donation audit
+        draft_toks, draft_probs, self._caches = self._draft_wave(
+            self._draft_params, self._draft_buffers, self._caches,
+            tables, tok, pos, *sampling, sl, dkey)
+        out_toks, n_emit, nxt, new_pos, finite, self._caches = \
+            self._decode_wave(
+                self._params, self._buffers, self._caches, tables, tok,
+                pos, act, *sampling, sl, draft_toks, draft_probs,
+                jnp.asarray(poison), vkey)
+        out_toks = np.asarray(out_toks)
+        n_emit = np.asarray(n_emit)
+        nxt = np.asarray(nxt)
+        new_pos = np.asarray(new_pos)
+        finite = np.asarray(finite)
+        out, bad, waved = {}, [], []
+        proposed = accepted = 0
+        for s, was_active in enumerate(active_now):
+            if not was_active:
+                continue
+            waved.append(s)
+            if not bool(finite[s]):
+                bad.append(s)       # lane frozen in-program; caller
+                continue            # must retire it before the next wave
+            n = int(n_emit[s])
+            proposed += spec_len[s]
+            accepted += n - 1       # the extra token is never a draft's
+            self.slot_pos[s] = int(new_pos[s])
+            self.slot_tok[s] = int(nxt[s])
+            out[s] = [int(t) for t in out_toks[s, :n]]
+        self.last_nonfinite_slots = bad
+        self.last_spec_proposed = proposed
+        self.last_spec_accepted = accepted
+        # rejected-token blocks go back NOW, poisoned lanes included —
+        # the pool must never hold blocks for tokens that don't exist
+        self._rollback_spec_blocks(waved)
+        return out
+
+    def _health(self):
+        h = super()._health()
+        h.update(speculative=True, spec_k=self.spec_k,
+                 draft_compiles=self.draft_compiles)
         return h

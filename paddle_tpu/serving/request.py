@@ -38,13 +38,32 @@ class Request:
         exceptions are contained into `callback_error` (counted in
         `serving_callback_errors_total` and journaled) so one client
         cannot poison the shared decode loop.
+    top_k / top_p: per-request sampling truncation knobs (0 / 1.0 =
+        off), applied after temperature by the engines' ONE shared
+        sampling tail — dense, paged, and speculative waves all honor
+        them.
+    stop_sequences: list of token-id sequences; the request retires
+        with finish_reason "stop" as soon as its output ends with any
+        of them (the matched sequence is delivered, host-side check —
+        a speculative wave's multi-token batch truncates at the match).
+    logit_bias: {token_id: additive bias} dict, a [V] float array, or a
+        [V] bool ALLOWED mask — folded into the logits before
+        selection (use -1e9 / False to forbid tokens).
+    token_mask: callable(request) -> [V] bool allowed-mask or [V]
+        float bias, re-evaluated before EVERY wave (constrained/JSON
+        decoding: the legal set follows the tokens already emitted).
+        Lanes with a dynamic mask decode one token per wave even on a
+        speculative engine — drafting ahead of a mask that depends on
+        unemitted tokens would break exactness.
     """
     _ids = iter(range(1, 1 << 62))
     _ids_lock = threading.Lock()
 
     def __init__(self, prompt, max_tokens=16, eos_token_id=None,
                  timeout=None, on_token=None, do_sample=False,
-                 temperature=1.0, trace_id=None):
+                 temperature=1.0, top_k=0, top_p=1.0,
+                 stop_sequences=None, logit_bias=None, token_mask=None,
+                 stop_context=None, trace_id=None):
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -66,6 +85,19 @@ class Request:
         self.on_token = on_token
         self.do_sample = bool(do_sample)
         self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.stop_sequences = [
+            [int(t) for t in seq] for seq in (stop_sequences or [])
+            if len(seq)]
+        # tokens that PRECEDE this request's output stream for stop
+        # matching: a fleet migration folds the dead hop's tokens into
+        # the continuation PROMPT, so a stop sequence straddling the
+        # seam would be invisible to the hop-local output — the router
+        # passes the prior stream's tail here (_submit_kwargs)
+        self._stop_context = [int(t) for t in (stop_context or [])]
+        self.logit_bias = logit_bias
+        self.token_mask = token_mask
 
         self.state = RequestState.QUEUED
         self.slot = None                 # engine slot while PREFILL/DECODE
@@ -79,7 +111,7 @@ class Request:
         # fault is recorded once per wait EPISODE, not once per round
         self._cache_waiting = False
         self.output_tokens = []
-        # eos | max_tokens | length | timeout | error | rejected
+        # eos | stop | max_tokens | length | timeout | error | rejected
         self.finish_reason = None
         self.error = None                # detail when error/rejected
         self.callback_error = None
@@ -158,6 +190,20 @@ class Request:
     def _timed_out(self):
         return (self.timeout is not None and self.submit_time is not None
                 and time.monotonic() - self.submit_time > self.timeout)
+
+    def _hit_stop(self):
+        """True when the output stream ends with one of the request's
+        stop sequences (checked after every emitted token — host-side,
+        so every engine flavour gets stop sequences for free). The
+        stream is stop_context + output_tokens, so a sequence
+        straddling a migration seam still matches; a match that lies
+        entirely inside the context (already delivered by a prior hop)
+        never re-fires because this runs only after a NEW token."""
+        out = self._stop_context + self.output_tokens
+        for seq in self.stop_sequences:
+            if len(out) >= len(seq) and out[-len(seq):] == seq:
+                return True
+        return False
 
     # ------------------------------------------------------------ client API
     @property

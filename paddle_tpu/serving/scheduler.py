@@ -56,7 +56,7 @@ import collections
 import threading
 import time
 
-from ..utils import flight_recorder, profiler
+from ..utils import flight_recorder, profiler, telemetry
 from ..utils.profiler import RecordEvent
 from .metrics import ServingMetrics
 from .paged.block_pool import BlockPoolExhausted
@@ -87,7 +87,17 @@ class Scheduler:
         # stamped into every in-flight request's inter-token gap,
         # spiking the very TPOT/SLO window it feeds. program_costs is
         # memoized per shape signature, so a fleet pays one lowering.
-        self._wave_cost = engine.program_costs().get("decode_wave") or {}
+        # A speculative engine's wave is TWO programs (draft + verify):
+        # their costs sum into the per-wave roofline numerators.
+        costs = engine.program_costs()
+        self._wave_cost = costs.get("decode_wave") or {}
+        if "verify" in costs or "draft_wave" in costs:
+            merged = {}
+            for part in (costs.get("draft_wave"), costs.get("verify")):
+                for k, v in (part or {}).items():
+                    if isinstance(v, (int, float)):
+                        merged[k] = merged.get(k, 0.0) + v
+            self._wave_cost = merged
         self.last_wave_s = None
         self.wave_retries = max(0, int(wave_retries))
         self.retry_backoff_s = float(retry_backoff_s)
@@ -191,6 +201,43 @@ class Scheduler:
         NEXT token, not a repeat."""
         return req.prompt + req.output_tokens
 
+    def _combined_bias(self, req):
+        """The slot's effective [V] bias row: static logit_bias plus the
+        request's token_mask evaluated against what it has emitted so
+        far (bool masks normalize to 0/-1e9 in the engine)."""
+        bias = self.engine._normalize_bias(req.logit_bias)
+        if req.token_mask is not None:
+            bias = bias + self.engine._normalize_bias(req.token_mask(req))
+        return bias
+
+    def _admission_bias(self, req):
+        """Bias row handed to begin_prefill: the first token must obey
+        the mask too. A raising token_mask lands inside the admission
+        fault barrier — it fails ITS request, nothing else."""
+        return (req.logit_bias if req.token_mask is None
+                else self._combined_bias(req))
+
+    def _refresh_token_masks(self):
+        """Re-evaluate every dynamic token_mask against the tokens its
+        request has emitted (constrained decoding advances per token)
+        and upload the fresh bias rows before the wave. A raising mask
+        callable fails only its own request — same isolation contract
+        as on_token callbacks."""
+        for slot, req in enumerate(self._slot_req):
+            if req is None or req.token_mask is None or \
+                    not self.engine.slot_active[slot]:
+                continue
+            try:
+                self.engine.set_slot_bias(slot, self._combined_bias(req))
+            except Exception as e:   # noqa: BLE001 — client code
+                self.last_error = e
+                self.engine.retire_slot(slot)
+                self._slot_req[slot] = None
+                self._fault("token_mask_error", action="request_failed",
+                            request=req, slot=slot, error=e)
+                req._fail(e)
+                self._complete(req)
+
     def _admit(self):
         """Assign queued requests to free slots and stage their prefill
         (engine.begin_prefill — block allocation on a paged engine); the
@@ -216,7 +263,10 @@ class Scheduler:
                 self.engine.begin_prefill(
                     slot, self._continuation(req),
                     do_sample=req.do_sample,
-                    temperature=req.temperature)
+                    temperature=req.temperature,
+                    top_k=req.top_k, top_p=req.top_p,
+                    logit_bias=self._admission_bias(req),
+                    dynamic_mask=req.token_mask is not None)
             except BlockPoolExhausted as e:
                 if self.engine.active_slots() or \
                         self.engine.prefilling_slots():
@@ -316,17 +366,24 @@ class Scheduler:
         return False
 
     # ---------------------------------------------------------- wave loop
-    def _maybe_retire(self, slot, last_token):
+    def _maybe_retire(self, slot, last_token, check_length=True):
         """Retire the slot if its request just finished: EOS (even on the
-        very first prefill-produced token), token budget, cache horizon,
-        or wall-clock timeout."""
+        very first prefill-produced token), a stop sequence, token
+        budget, cache horizon, or wall-clock timeout. check_length=False
+        suppresses the horizon check for the NON-final tokens of a
+        speculative batch: slot_pos is already advanced for the whole
+        batch, and only its last token is the one written at the
+        horizon — retiring on an earlier one would drop tokens the
+        plain engine delivers."""
         req = self._slot_req[slot]
         reason = None
         if req.eos_token_id is not None and last_token == req.eos_token_id:
             reason = "eos"
+        elif req.stop_sequences and req._hit_stop():
+            reason = "stop"
         elif len(req.output_tokens) >= req.max_tokens:
             reason = "max_tokens"
-        elif self.engine.slot_full(slot):
+        elif check_length and self.engine.slot_full(slot):
             reason = "length"
         elif req._timed_out():
             reason = "timeout"
@@ -452,6 +509,27 @@ class Scheduler:
         with self._wave_lock:
             return self._step_locked()
 
+    def _record_spec_wave(self, waved):
+        """Speculative-wave accounting: proposed/accepted counters +
+        acceptance-rate gauge (serving_spec_* — docs/observability.md),
+        a `spec` journal event, and a per-wave trace instant carrying
+        the wave's spec_depth (accepted tokens per dispatched lane)."""
+        proposed = getattr(self.engine, "last_spec_proposed", None)
+        if proposed is None:
+            return                      # not a speculative engine
+        accepted = self.engine.last_spec_accepted
+        self.metrics.on_spec(proposed, accepted)
+        depth = accepted / waved if waved else 0.0
+        rec = flight_recorder.get_recorder()
+        if rec is not None:
+            rec.spec(proposed=proposed, accepted=accepted,
+                     lanes=waved, spec_depth=round(depth, 4))
+        if profiler.trace_enabled():
+            telemetry.trace_instant(
+                0, "SPEC_WAVE", pid=self.trace_pid,
+                spec_depth=round(depth, 4), proposed=proposed,
+                accepted=accepted)
+
     def _preempt_starved(self):
         """Pool-exhausted lanes (the wave excluded them): preempt by
         recompute — free the slot's blocks, requeue the request with
@@ -489,6 +567,7 @@ class Scheduler:
         prefilled = bool(self.engine.prefilling_slots())
         if self._advance_prefills():
             return 0                         # degraded mid-advance
+        self._refresh_token_masks()
         active = self.engine.active_slots()
         if active:
             toks = self._run_wave_with_retry()
@@ -500,6 +579,7 @@ class Scheduler:
                     waved, wave_s=self.last_wave_s,
                     flops=self._wave_cost.get("flops"),
                     bytes_accessed=self._wave_cost.get("bytes_accessed"))
+                self._record_spec_wave(waved)
             # fused-sentinel fallout: retire ONLY the poisoned lanes —
             # their requests resolve with "error", healthy neighbours
             # stream on token-identically (proven in chaos_serving)
@@ -515,12 +595,23 @@ class Scheduler:
             now = time.monotonic()
             with RecordEvent("serving/host_dispatch",
                              pid=self.trace_pid) as ev:
-                for slot, tok in toks.items():
+                for slot, emitted in toks.items():
                     req = self._slot_req[slot]
-                    prev_t = req.last_token_time
-                    req._emit(tok)
-                    self.metrics.on_token(now, prev_t=prev_t)
-                    self._maybe_retire(slot, tok)
+                    # a speculative wave emits a BATCH per lane; stream
+                    # it in order and stop at the first retirement
+                    # (eos/stop/budget/horizon) — the batch's rejected
+                    # tail past that point is dropped, exactly what the
+                    # non-speculative wave would never have generated
+                    if not isinstance(emitted, list):
+                        emitted = [emitted]
+                    for j, tok in enumerate(emitted):
+                        prev_t = req.last_token_time
+                        req._emit(tok)
+                        self.metrics.on_token(now, prev_t=prev_t)
+                        self._maybe_retire(
+                            slot, tok, check_length=j == len(emitted) - 1)
+                        if self._slot_req[slot] is None:
+                            break
             self.metrics.on_phase("host_dispatch", ev.elapsed)
         pool = getattr(self.engine, "block_pool", None)
         if pool is not None and (active or prefilled):

@@ -46,6 +46,10 @@ SERVING = dict(vocab=128, hidden=64, layers=2, heads=4, max_len=64,
 # same model topology, block-table cache
 PAGED = dict(vocab=128, hidden=64, layers=2, heads=4, max_len=64,
              block_size=8, num_blocks=33, chunk_len=16, num_slots=4)
+# speculative canonical shape (mirrors tests/test_serving_spec.py):
+# the PAGED target plus a 1-layer draft GPT and k=3
+SPEC = dict(PAGED, spec_k=3, draft_hidden=32, draft_layers=1,
+            draft_heads=2)
 # train canonical shape == bench.py CPU-smoke config
 TRAIN = dict(vocab=512, hidden=128, layers=2, heads=4, seq=128, batch=2)
 # sharded-train canonical mesh: the tier-1 8-CPU-device dp mesh
@@ -56,6 +60,7 @@ SHARDED_TRAIN = dict(TRAIN, dp=8, zero_stage=1, dropout=0.1)
 
 TRACKED_PROGRAMS = ("serving_decode_wave", "serving_prefill",
                     "paged_decode_wave", "paged_prefill_chunk",
+                    "paged_spec_draft_wave", "paged_spec_verify",
                     "train_step", "sharded_train_step",
                     "cached_decode_attention",
                     "paged_decode_attention", "prefill_flash_attention")
@@ -80,14 +85,37 @@ def program_cost(spec):
 
 
 def engine_program_specs(engine, prefix=None):
-    """Audit specs for a LIVE engine's two programs, with the engine's
-    actual shapes — used on the canonical engines below and by
+    """Audit specs for a LIVE engine's compiled programs, with the
+    engine's actual shapes — used on the canonical engines below and by
     bench_serving.py on the engine it just measured. Dispatches on the
     engine flavour: a paged engine (block_pool) audits its
-    decode-wave-with-tables and prefill-chunk programs."""
+    decode-wave-with-tables and prefill-chunk programs; a speculative
+    engine (draft_model) audits its draft/verify/prefill trio."""
+    if hasattr(engine, "draft_model"):
+        return _spec_engine_specs(engine, prefix or "paged_spec")
     if hasattr(engine, "block_pool"):
         return _paged_engine_specs(engine, prefix or "paged")
     return _dense_engine_specs(engine, prefix or "serving")
+
+
+def _sampling_vec_args(engine):
+    """The shared sampling-scenario vectors every wave program takes
+    (sample flag, temperature, top-k, top-p, [S, V] bias/mask) — the
+    audit specs mirror engine._sampling_args so signatures can't
+    drift."""
+    import jax.numpy as jnp
+    S = engine.num_slots
+    return (jnp.zeros((S,), bool), jnp.ones((S,), jnp.float32),
+            jnp.zeros((S,), jnp.int32), jnp.ones((S,), jnp.float32),
+            jnp.zeros((S, engine.vocab_size), jnp.float32))
+
+
+def _prefill_sampling_args(engine):
+    """The prefill programs' per-request sampling scalars + bias row."""
+    import jax.numpy as jnp
+    return (jnp.asarray(False), jnp.float32(1.0), jnp.int32(0),
+            jnp.float32(1.0),
+            jnp.zeros((engine.vocab_size,), jnp.float32))
 
 
 def _dense_engine_specs(engine, prefix):
@@ -101,15 +129,14 @@ def _dense_engine_specs(engine, prefix):
     decode_args = (
         engine._params, engine._buffers, engine._caches,
         jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
-        jnp.ones((S,), bool), jnp.zeros((S,), bool),
-        jnp.ones((S,), jnp.float32),
+        jnp.ones((S,), bool), *_sampling_vec_args(engine),
         jnp.zeros((S,), bool),          # poison (chaos NaN injection)
         key)
     prefill_args = (
         engine._params, engine._buffers, engine._caches,
         jnp.asarray(np.zeros((engine.prefill_len,), np.int32)),
-        jnp.int32(1), jnp.int32(0), jnp.asarray(False),
-        jnp.float32(1.0), key)
+        jnp.int32(1), jnp.int32(0), *_prefill_sampling_args(engine),
+        key)
     return [
         {"name": f"{prefix}_decode_wave", "fn": engine._decode_wave_fn,
          "args": decode_args, "jit_kwargs": jit_kwargs,
@@ -135,8 +162,7 @@ def _paged_engine_specs(engine, prefix):
         engine._params, engine._buffers, engine._caches,
         jnp.zeros((S, nblk), jnp.int32),     # block tables (traced!)
         jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
-        jnp.ones((S,), bool), jnp.zeros((S,), bool),
-        jnp.ones((S,), jnp.float32),
+        jnp.ones((S,), bool), *_sampling_vec_args(engine),
         jnp.zeros((S,), bool),               # poison
         key)
     prefill_args = (
@@ -144,7 +170,7 @@ def _paged_engine_specs(engine, prefix):
         jnp.zeros((nblk,), jnp.int32),       # one slot's table row
         jnp.asarray(np.zeros((C,), np.int32)),
         jnp.int32(0), jnp.int32(1), jnp.int32(0),
-        jnp.asarray(False), jnp.float32(1.0), key)
+        *_prefill_sampling_args(engine), key)
     return [
         {"name": f"{prefix}_decode_wave", "fn": engine._decode_wave_fn,
          "args": decode_args, "jit_kwargs": jit_kwargs,
@@ -156,6 +182,63 @@ def _paged_engine_specs(engine, prefix):
          "args": prefill_args, "jit_kwargs": jit_kwargs,
          "description": f"one prompt chunk admission through a block "
                         f"table (chunk={C})"},
+    ]
+
+
+def _spec_engine_specs(engine, prefix):
+    """Audit specs for a LIVE SpeculativePagedEngine's three programs:
+    the draft wave (k+1 draft decode steps in one executable), the
+    verify wave (chunk-scored target forward + exact acceptance-
+    rejection tail), and the dual-model prefill chunk. jxaudit's
+    donation rule runs over these to prove BOTH the target and draft
+    KV-pool leaves stay aliased; hlo_audit banks the verify program's
+    bytes-accessed so a k+1-disproportionate regression gates."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    S, nblk, k = engine.num_slots, engine.blocks_per_slot, engine.spec_k
+    C = engine.prefill_chunk_len
+    V = engine.vocab_size
+    key = jax.random.PRNGKey(0)
+    jit_kwargs = {"donate_argnums": engine._program_donate_argnums}
+    tables = jnp.zeros((S, nblk), jnp.int32)        # traced tables
+    tok = jnp.zeros((S,), jnp.int32)
+    pos = jnp.zeros((S,), jnp.int32)
+    spec_len = jnp.ones((S,), jnp.int32)
+    # the draft wave has no active mask (inactive lanes ride scratch
+    # table rows; the verify tail discards their proposals)
+    draft_args = (engine._draft_params, engine._draft_buffers,
+                  engine._caches, tables, tok, pos,
+                  *_sampling_vec_args(engine), spec_len, key)
+    verify_args = (
+        engine._params, engine._buffers, engine._caches, tables, tok,
+        pos, jnp.ones((S,), bool), *_sampling_vec_args(engine), spec_len,
+        jnp.zeros((S, k), jnp.int32),               # draft tokens
+        jnp.zeros((S, k, V), jnp.float32),          # draft probs
+        jnp.zeros((S,), bool),                      # poison
+        key)
+    prefill_args = (
+        engine._params, engine._buffers, engine._caches,
+        engine._draft_params, engine._draft_buffers,
+        jnp.zeros((nblk,), jnp.int32),
+        jnp.asarray(np.zeros((C,), np.int32)),
+        jnp.int32(0), jnp.int32(1), jnp.int32(0),
+        *_prefill_sampling_args(engine), key)
+    return [
+        {"name": f"{prefix}_draft_wave", "fn": engine._draft_wave_fn,
+         "args": draft_args, "jit_kwargs": jit_kwargs,
+         "description": f"k+1={engine.spec_k + 1} draft decode steps "
+                        f"in one executable (slots={S})"},
+        {"name": f"{prefix}_verify", "fn": engine._decode_wave_fn,
+         "args": verify_args, "jit_kwargs": jit_kwargs,
+         "description": f"verify-once: one chunk-scored target forward "
+                        f"over C=k+1={engine.spec_k + 1} positions + "
+                        "exact acceptance-rejection"},
+        {"name": f"{prefix}_prefill_chunk", "fn": engine._prefill_fn,
+         "args": prefill_args, "jit_kwargs": jit_kwargs,
+         "description": f"dual-model prompt chunk admission (target + "
+                        f"draft K/V, chunk={C})"},
     ]
 
 
@@ -196,6 +279,34 @@ def _paged_serving_specs():
                                 block_size=PAGED["block_size"],
                                 num_blocks=PAGED["num_blocks"],
                                 prefill_chunk_len=PAGED["chunk_len"])
+    return engine_program_specs(engine)
+
+
+def _spec_serving_specs():
+    import paddle_tpu as pt
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import SpeculativePagedEngine
+
+    C = SPEC
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=C["vocab"], hidden_size=C["hidden"],
+                    num_layers=C["layers"], num_heads=C["heads"],
+                    max_seq_len=C["max_len"], dropout=0.0,
+                    attn_dropout=0.0)
+    model = GPTForPretraining(cfg)
+    dcfg = GPTConfig(vocab_size=C["vocab"],
+                     hidden_size=C["draft_hidden"],
+                     num_layers=C["draft_layers"],
+                     num_heads=C["draft_heads"],
+                     max_seq_len=C["max_len"], dropout=0.0,
+                     attn_dropout=0.0)
+    engine = SpeculativePagedEngine(model, GPTForPretraining(dcfg),
+                                    spec_k=C["spec_k"],
+                                    num_slots=C["num_slots"],
+                                    max_len=C["max_len"],
+                                    block_size=C["block_size"],
+                                    num_blocks=C["num_blocks"],
+                                    prefill_chunk_len=C["chunk_len"])
     return engine_program_specs(engine)
 
 
@@ -367,6 +478,8 @@ def tracked_program_specs(names=None):
         specs += [s for s in _serving_specs() if s["name"] in want]
     if want & {"paged_decode_wave", "paged_prefill_chunk"}:
         specs += [s for s in _paged_serving_specs() if s["name"] in want]
+    if want & {"paged_spec_draft_wave", "paged_spec_verify"}:
+        specs += [s for s in _spec_serving_specs() if s["name"] in want]
     if "train_step" in want:
         specs.append(_train_step_spec())
     if "sharded_train_step" in want:

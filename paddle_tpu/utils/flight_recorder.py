@@ -42,7 +42,7 @@ class NonFiniteError(RuntimeError):
 
 EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
                "checkpoint", "xla_program", "jxaudit", "chaos", "fault",
-               "resume", "reshard", "hang", "slo", "run_end")
+               "resume", "reshard", "hang", "slo", "spec", "run_end")
 
 
 def _json_safe(v):
@@ -363,6 +363,22 @@ class FlightRecorder:
             fields["window_requests"] = int(window_requests)
         fields.update(extra)
         return self.record("slo", **fields)
+
+    def spec(self, proposed, accepted, lanes=None, spec_depth=None,
+             **extra):
+        """One speculative decode wave's draft economics (the serving
+        scheduler journals this next to its fault events): `proposed` =
+        draft tokens offered to the verify program, `accepted` = how
+        many the exact acceptance-rejection kept, `lanes` = slots the
+        wave dispatched, `spec_depth` = accepted per dispatched lane.
+        runlog_summary folds these into a per-run acceptance table."""
+        fields = {"proposed": int(proposed), "accepted": int(accepted)}
+        if lanes is not None:
+            fields["lanes"] = int(lanes)
+        if spec_depth is not None:
+            fields["spec_depth"] = float(spec_depth)
+        fields.update(extra)
+        return self.record("spec", **fields)
 
     def checkpoint(self, path=None, step=None, **extra):
         fields = {}

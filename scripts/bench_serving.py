@@ -70,6 +70,8 @@ def run_load(sched, load_rps, n_requests, vocab, prompt_range,
     shared_prefix tokens are prepended to EVERY prompt (the shared
     system-prompt pattern — on a paged engine with prefix sharing these
     blocks dedupe and the per-row prefix-hit rate shows it)."""
+    waves_before = telemetry.value("serving_decode_waves_total",
+                                   default=0)
     rng = np.random.RandomState(seed)
     shared_prefix = list(shared_prefix)
     reqs, done_submitting = [], threading.Event()
@@ -105,6 +107,16 @@ def run_load(sched, load_rps, n_requests, vocab, prompt_range,
     snap["wall_s"] = wall
     snap["offered_load_rps"] = load_rps
     snap["n_requests"] = len(reqs)
+    # decode economics for the speculative comparison: rounds per
+    # generated DECODE token (the first token of each request comes
+    # from prefill, not a wave) — 1/lanes-ish for the plain engine,
+    # measurably lower when speculation accepts drafts
+    waves = telemetry.value("serving_decode_waves_total",
+                            default=0) - waves_before
+    decode_tokens = snap["tokens_generated"] - snap["requests_completed"]
+    snap["decode_waves"] = waves
+    snap["decode_rounds_per_token"] = (waves / decode_tokens
+                                       if decode_tokens else None)
     return snap
 
 
@@ -245,6 +257,22 @@ def main():
                     help="paged: pool size incl. scratch (default "
                          "slots*max_len/block_size + 1 = dense-"
                          "equivalent capacity; smaller oversubscribes)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="draft-k/verify-once speculative decoding over "
+                         "the paged engine (implies --paged): each load "
+                         "point runs a matched NON-speculative baseline "
+                         "row first, and the speculative row reports "
+                         "acceptance rate, accepted tokens/wave, decode "
+                         "rounds/token and TPOT deltas against it")
+    ap.add_argument("--draft-layers", type=int, default=1,
+                    help="speculative: draft model depth (same family/"
+                         "vocab as the target)")
+    ap.add_argument("--draft-hidden", type=int, default=None,
+                    help="speculative: draft hidden size (default "
+                         "hidden // 2)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="speculative: draft tokens proposed per slot "
+                         "per wave (the verify chunk is k+1 wide)")
     ap.add_argument("--max-preemptions", type=int, default=16,
                     help="paged: preemption-by-recompute budget per "
                          "request before it resolves 'error' (an "
@@ -305,14 +333,30 @@ def main():
     model, _cfg = build_model(args.family, args.hidden, args.layers,
                               args.heads, args.vocab, args.max_len,
                               args.bf16)
+    if args.speculative:
+        args.paged = True
+        draft_model, _ = build_model(
+            args.family, args.draft_hidden or max(16, args.hidden // 2),
+            args.draft_layers, max(1, args.heads // 2), args.vocab,
+            args.max_len, args.bf16)
+
+    def make_paged():
+        return PagedServingEngine(model, num_slots=args.slots,
+                                  max_len=args.max_len,
+                                  block_size=args.block_size,
+                                  num_blocks=args.num_blocks,
+                                  prefill_chunk_len=args.prefill_len)
 
     def make_engine():
+        if args.speculative:
+            from paddle_tpu.serving import SpeculativePagedEngine
+            return SpeculativePagedEngine(
+                model, draft_model, spec_k=args.spec_k,
+                num_slots=args.slots, max_len=args.max_len,
+                block_size=args.block_size, num_blocks=args.num_blocks,
+                prefill_chunk_len=args.prefill_len)
         if args.paged:
-            return PagedServingEngine(model, num_slots=args.slots,
-                                      max_len=args.max_len,
-                                      block_size=args.block_size,
-                                      num_blocks=args.num_blocks,
-                                      prefill_chunk_len=args.prefill_len)
+            return make_paged()
         return ServingEngine(model, num_slots=args.slots,
                              max_len=args.max_len,
                              prefill_len=args.prefill_len)
@@ -323,6 +367,11 @@ def main():
         return SLOPolicy(ttft_p99_s=args.slo_ttft_p99,
                          tpot_p99_s=args.slo_tpot_p99,
                          objective=args.slo_objective)
+
+    if args.speculative and args.replicas is not None:
+        raise SystemExit("--speculative measures against a matched "
+                         "single-engine baseline; combine it with "
+                         "--replicas in separate sweeps")
 
     router = None
     if args.replicas is not None:
@@ -345,6 +394,14 @@ def main():
                if args.scale_up_queue_depth is not None else ""))
     else:
         engine = make_engine()
+    baseline_engine = None
+    if args.speculative:
+        # the matched non-speculative baseline: same target model, same
+        # pool/chunk geometry — each load point runs it first with the
+        # same arrival seed, so the speculative row's deltas compare
+        # like against like
+        baseline_engine = make_paged()
+        Scheduler(baseline_engine).generate([1, 2, 3], max_tokens=4)
     if args.paged:
         log(f"paged pool: {engine.block_pool.usable} usable blocks x "
             f"{engine.block_size} tokens (dense equivalent would be "
@@ -376,11 +433,23 @@ def main():
 
     rows = []
     kind = "paged" if args.paged else "dense"
+    if args.speculative:
+        kind = f"spec[k={args.spec_k},draft={args.draft_layers}L]"
     if router is not None:
         kind = (f"fleet[{args.replicas}x{kind}:"
                 f"{args.router_policy}]")
     for i, load in enumerate(float(x) for x in args.loads.split(",")):
         out_hi = max(5, min(64, args.max_len - args.prefill_len))
+        base_snap = None
+        if baseline_engine is not None:
+            base_sched = Scheduler(baseline_engine,
+                                   max_queue=args.max_queue,
+                                   max_preemptions=args.max_preemptions)
+            base_snap = run_load(base_sched, load, args.requests,
+                                 args.vocab,
+                                 prompt_range=(4, args.prefill_len),
+                                 output_range=(4, out_hi), seed=100 + i,
+                                 shared_prefix=shared_prefix)
         if router is not None:
             router.reset_metrics()           # fresh tallies per point
             snap = run_load_fleet(router, load, args.requests,
@@ -457,6 +526,56 @@ def main():
                                                4)),
                 "shared_prefix_len": args.shared_prefix,
             })
+        if args.speculative:
+            # the speculative economics vs the matched baseline row that
+            # ran first with the same arrival seed: acceptance rate IS
+            # the speedup knob, rounds/token is what it buys
+            def _delta_ms(key):
+                a, b = snap.get(key), base_snap.get(key)
+                return (None if a is None or b is None
+                        else round((a - b) * 1e3, 3))
+            row["detail"]["spec"] = {
+                "spec_k": args.spec_k,
+                "draft_layers": args.draft_layers,
+                "acceptance_rate": (
+                    None if snap["spec_acceptance_rate"] is None
+                    else round(snap["spec_acceptance_rate"], 4)),
+                "accepted_per_wave": (
+                    None if snap["spec_accepted_per_wave"] is None
+                    else round(snap["spec_accepted_per_wave"], 3)),
+                "decode_rounds_per_token": (
+                    None if snap["decode_rounds_per_token"] is None
+                    else round(snap["decode_rounds_per_token"], 4)),
+                "baseline_decode_rounds_per_token": (
+                    None if base_snap["decode_rounds_per_token"] is None
+                    else round(base_snap["decode_rounds_per_token"], 4)),
+                "tpot_p50_delta_ms": _delta_ms("tpot_p50_s"),
+                "tpot_p99_delta_ms": _delta_ms("tpot_p99_s"),
+            }
+            base_row = {
+                "metric": f"serving {args.family} paged-baseline "
+                          f"tokens/s @{load:g}req/s x{args.slots}slots",
+                "value": round(base_snap["tokens_per_s"] or 0.0, 1),
+                "unit": "tokens/s",
+                "detail": {
+                    "ttft_p50_ms": round(
+                        (base_snap["ttft_p50_s"] or 0) * 1e3, 2),
+                    "tpot_p50_ms": round(
+                        (base_snap.get("tpot_p50_s") or 0) * 1e3, 3),
+                    "tpot_p99_ms": round(
+                        (base_snap.get("tpot_p99_s") or 0) * 1e3, 3),
+                    "decode_rounds_per_token": (
+                        None
+                        if base_snap["decode_rounds_per_token"] is None
+                        else round(base_snap["decode_rounds_per_token"],
+                                   4)),
+                    "offered_load_rps": load,
+                    "requests": base_snap["n_requests"],
+                    "wall_s": round(base_snap["wall_s"], 2),
+                },
+            }
+            rows.append(base_row)
+            print(json.dumps(base_row), flush=True)
         if router is not None:
             # router stats per load point: the affinity-vs-round_robin
             # A/B reads straight off prefix_hits_per_request across
@@ -520,7 +639,8 @@ def main():
         # the process-wide serving counter ticks once per candidate
         # replica the dispatch walked, inflating by up to the replica
         # count and contradicting the rows in the same file
-        "rejected_total": (sum(r["detail"]["rejected"] for r in rows)
+        "rejected_total": (sum(r["detail"].get("rejected", 0)
+                               for r in rows)
                            if router is not None else
                            telemetry.value("serving_rejected_total",
                                            default=0)),
@@ -528,7 +648,7 @@ def main():
                                               default=0),
         "callback_errors_total": telemetry.value(
             "serving_callback_errors_total", default=0),
-        "faults_total": sum(sum(r["detail"]["faults"].values())
+        "faults_total": sum(sum(r["detail"].get("faults", {}).values())
                             for r in rows),
     }
     with open(args.out, "w") as f:

@@ -409,6 +409,107 @@ def _paged_reference(prompts):
     return _CACHE[key]
 
 
+def get_spec_engine():
+    """One SPECULATIVE paged engine per process (spec_rollback
+    scenario): the canonical paged scale plus a 1-layer draft, so
+    tier-1 shares compiles with tests/test_serving_spec.py."""
+    if "spec_engine" not in _CACHE:
+        from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.serving import SpeculativePagedEngine
+        pt.seed(23)
+        dcfg = LlamaConfig(vocab_size=VOCAB, hidden_size=32,
+                           num_layers=1, num_heads=2, num_kv_heads=1,
+                           max_seq_len=MAX_LEN)
+        draft = LlamaForCausalLM(dcfg)
+        # inflate one embedding row so the draft frequently DISAGREES
+        # with the target: rejections are what give the rollback audit
+        # (and the no-rollback control) something to catch — a draft
+        # that always agrees never over-allocates
+        w = draft.model.embed_tokens.weight.numpy().copy()
+        w[VOCAB - 1] += 5.0
+        draft.model.embed_tokens.weight.set_value(w)
+        engine = SpeculativePagedEngine(
+            get_model(), draft, spec_k=3,
+            num_slots=SLOTS, max_len=MAX_LEN, block_size=8,
+            num_blocks=33, prefill_chunk_len=PREFILL_LEN)
+        Scheduler(engine).generate([1, 2, 3], max_tokens=2)   # warm
+        _CACHE["spec_engine"] = engine
+    return _CACHE["spec_engine"]
+
+
+def scenario_spec_rollback(engine, inject):
+    """Speculative decoding under chaos: a DECODE_WAVE_NAN fault during
+    a speculative wave retires ONLY the poisoned lane — its whole
+    speculation (blocks allocated ahead for drafted tokens) rolled
+    back, healthy lanes token-identical to the fault-free run — and the
+    refcount audit holds after EVERY round: no lane ever retains blocks
+    past its committed positions, and the drained pool returns to 0
+    used (draft pools share the tables, so one audit covers both).
+    --inject no-rollback disables the engine's spec-block rollback; the
+    per-round block audit must catch the orphaned draft blocks."""
+    v = []
+    spec = get_spec_engine()
+    for s in spec.active_slots():
+        spec.retire_slot(s)
+    spec.set_health_state("ok")
+    prompts = _prompts()
+    ref = _spec_reference(prompts)
+    if inject == "no-rollback":
+        real = spec._rollback_spec_blocks
+        spec._rollback_spec_blocks = lambda wave_slots: None
+    try:
+        monkey = chaos.ChaosMonkey([chaos.Fault(
+            chaos.DECODE_WAVE_NAN, action="payload", payload=1,
+            times=(2,))])
+        over_held = 0
+        with chaos.active(monkey):
+            sched = Scheduler(spec)
+            reqs = [sched.submit(prompt=p, max_tokens=MAX_TOKENS)
+                    for p in prompts]
+            while sched.step():
+                for s in range(spec.num_slots):
+                    if spec.slot_active[s] and \
+                            len(spec._slot_blocks[s]) > \
+                            spec.slot_pos[s] // spec.block_size + 1:
+                        over_held += 1
+    finally:
+        if inject == "no-rollback":
+            spec._rollback_spec_blocks = real
+    _check(v, monkey.fired, "nan injection never fired")
+    _check(v, reqs[1].finish_reason == "error",
+           f"poisoned lane finished {reqs[1].finish_reason!r}, "
+           "expected 'error'")
+    for i in (0, 2, 3):
+        _check(v, reqs[i].output_tokens == ref[i],
+               f"healthy lane {i} diverged from the fault-free "
+               "speculative run")
+    _check(v, over_held == 0,
+           f"orphaned speculative blocks: {over_held} round(s) held "
+           "blocks past the committed positions (rollback missing)")
+    _check(v, spec.block_pool.used == 0,
+           f"blocks {spec.block_pool.outstanding()} still referenced "
+           "after the stream drained — speculative refcounts leaked")
+    _check(v, sched.metrics.snapshot()["faults"].get("nonfinite", 0) >= 1,
+           "serving_faults_total{kind=nonfinite} did not move")
+    _check(v, spec.decode_compiles == 1 and spec.draft_compiles == 1
+           and spec.prefill_compiles == 1,
+           "speculative configuration exceeded its three compiled "
+           "programs under fault load")
+    return v
+
+
+def _spec_reference(prompts):
+    """Fault-free greedy outputs from the speculative engine (greedy
+    speculative == greedy target trajectory, so this also equals the
+    paged reference — asserted once here, cheaply, as a bonus)."""
+    key = ("spec_ref", tuple(tuple(p) for p in prompts))
+    if key not in _CACHE:
+        spec = get_spec_engine()
+        _, reqs = _run_stream(spec, prompts)
+        _CACHE[key] = [r.output_tokens for r in reqs]
+    return _CACHE[key]
+
+
 def scenario_replica_failover(engine, inject):
     """THE fleet proof: a replica killed mid-stream has every accepted
     request finish on a surviving replica with output bitwise-equal to
@@ -493,6 +594,7 @@ SCENARIOS = {
     "overflow_shed": scenario_overflow_shed,
     "drain": scenario_drain,
     "cache_exhaustion": scenario_cache_exhaustion,
+    "spec_rollback": scenario_spec_rollback,
     "replica_failover": scenario_replica_failover,
     "router_dispatch": scenario_router_dispatch,
     "ckpt_crash": scenario_ckpt_crash,
@@ -502,7 +604,8 @@ SCENARIOS = {
 # scenario; the run MUST exit 1 (tests/test_chaos.py asserts it)
 INJECTIONS = {"drop-isolation": "nan_slot", "no-retry": "wave_error",
               "alloc-crash": "cache_exhaustion",
-              "no-migration": "replica_failover"}
+              "no-migration": "replica_failover",
+              "no-rollback": "spec_rollback"}
 
 
 def run(argv=None):

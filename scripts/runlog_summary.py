@@ -192,6 +192,22 @@ def summarize(events):
             },
         }
 
+    # speculative decoding: per-wave `spec` events (serving scheduler)
+    # fold into one acceptance line — the draft's live quality
+    spec_events = [e for e in events if e.get("ev") == "spec"]
+    spec = None
+    if spec_events:
+        proposed = sum(int(e.get("proposed", 0) or 0) for e in spec_events)
+        accepted = sum(int(e.get("accepted", 0) or 0) for e in spec_events)
+        spec = {
+            "waves": len(spec_events),
+            "proposed": proposed,
+            "accepted": accepted,
+            "acceptance_rate": (accepted / proposed if proposed
+                                else None),
+            "accepted_per_wave": accepted / len(spec_events),
+        }
+
     by_coll = {}
     for c in colls:
         key = (c.get("op", "?"), c.get("group", "default"))
@@ -222,6 +238,7 @@ def summarize(events):
             "sources": sorted({e.get("source", "?") for e in nonfinite}),
         },
         "collectives": top_collectives,
+        "spec": spec,
         "chaos": chaos_by_point,
         "faults": faults_by_kind,
         "fleet": fleet,
@@ -310,6 +327,13 @@ def render(s):
             lines.append(f"  {agg['op']}[{agg['group']}]: "
                          f"{agg['calls']} calls, "
                          f"{_fmt_bytes(agg['bytes'])}")
+    sp = s.get("spec")
+    if sp:
+        rate = ("-" if sp["acceptance_rate"] is None
+                else f"{sp['acceptance_rate']:.3f}")
+        lines.append(f"speculative decoding: {sp['waves']} waves, "
+                     f"{sp['accepted']}/{sp['proposed']} drafts accepted "
+                     f"(rate {rate}, {sp['accepted_per_wave']:.2f}/wave)")
     fl = s.get("fleet")
     if fl:
         lines.append("fleet:")

@@ -79,6 +79,23 @@ def test_inject_no_migration_exits_1(chaos_serving, capsys):
     assert "migration" in capsys.readouterr().out
 
 
+def test_inject_no_rollback_exits_1(chaos_serving, capsys):
+    """Positive control for speculative decoding: disabling the
+    spec-block rollback leaves lanes holding blocks allocated for
+    REJECTED draft tokens — the per-round refcount audit must catch
+    the orphaned blocks (exit 1)."""
+    assert chaos_serving.run(["--inject", "no-rollback"]) == 1
+    assert "orphaned speculative blocks" in capsys.readouterr().out
+
+
+def test_spec_rollback_scenario_clean(chaos_serving, capsys):
+    """Speculative chaos headline: a poisoned lane mid-speculation
+    retires alone with its speculation rolled back (no orphaned draft
+    blocks), healthy lanes token-identical, three compiled programs."""
+    assert chaos_serving.run(["--scenario", "spec_rollback"]) == 0
+    assert "FAIL" not in capsys.readouterr().out
+
+
 def test_replica_failover_scenario_clean(chaos_serving, capsys):
     """The fleet headline: a replica killed mid-stream has every
     accepted request finish on a survivor with output bitwise-equal to

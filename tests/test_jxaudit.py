@@ -440,6 +440,32 @@ def test_paged_prefill_chunk_pool_donation_actually_aliased():
         "aliasing"
 
 
+def test_spec_programs_target_and_draft_pools_actually_aliased():
+    """The speculative trio donates ONE bundle (target pools, draft
+    pools): every leaf of BOTH halves must be aliased by XLA at engine
+    shapes in the draft wave AND the verify wave — the draft wave
+    passes the target pools through untouched (and vice versa is never
+    true: verify updates only target), so a pass-through that lost its
+    alias would double the wave's HBM footprint silently."""
+    specs = jxaudit.tracked_specs(["paged_spec_draft_wave",
+                                   "paged_spec_verify"])
+    assert len(specs) == 2
+    for spec in specs:
+        ctx = ProgramContext(spec)
+        assert ctx.donate_argnums == (2,), spec["name"]
+        first, n = ctx.leaf_index_ranges()[2]
+        # 2 target layers x (k, v) + 1 draft layer x (k, v) pools
+        assert n == 6, spec["name"]
+        aliased = ctx.aliased_param_indices
+        assert aliased is not None, (spec["name"], ctx.unavailable)
+        missing = [i for i in range(first, first + n)
+                   if i not in aliased]
+        assert missing == [], \
+            f"{spec['name']}: pool leaves {missing} (target+draft " \
+            "bundle) lost donation aliasing"
+        assert list(jxaudit.RULES["donation-dropped"].check(ctx)) == []
+
+
 def test_optimizer_update_state_donated_and_aliased():
     """The eager opt.step() executable must donate param AND state (the
     first full jxaudit sweep caught state as donation-missing; this

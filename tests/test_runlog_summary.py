@@ -54,6 +54,9 @@ def _generate_journal(path):
                 slo="tpot_p99", window_requests=8, replicas=2)
         rec.slo(burn_rate=0.8, action="burn_clear", attainment=0.96,
                 slo="tpot_p99", window_requests=8)
+        # speculative decoding: the serving scheduler's per-wave events
+        rec.spec(proposed=12, accepted=9, lanes=4, spec_depth=2.25)
+        rec.spec(proposed=12, accepted=3, lanes=4, spec_depth=0.75)
     return path
 
 
@@ -85,6 +88,9 @@ def test_cli_end_to_end(tmp_path):
     assert "kills" in text and "migrations" in text
     assert "slo burn: peak=2.50 last=0.80" in text
     assert "burn_alert=1" in text and "scale_up=1" in text
+    # speculative acceptance line folds the per-wave spec events
+    assert "speculative decoding: 2 waves, 12/24 drafts accepted" in text
+    assert "rate 0.500" in text and "6.00/wave" in text
 
 
 def test_cli_json_mode(tmp_path):
@@ -109,6 +115,9 @@ def test_cli_json_mode(tmp_path):
     assert summary["jxaudit"] == {
         "runs": 1, "findings": 2, "by_rule": {"donation-missing": 2},
         "programs": 6, "degraded": 0}
+    assert summary["spec"] == {
+        "waves": 2, "proposed": 24, "accepted": 12,
+        "acceptance_rate": 0.5, "accepted_per_wave": 6.0}
     assert summary["fleet"] == {
         "migrations": 2, "kills": 1, "degraded": 0, "spawn_failures": 0,
         "slo": {"events": 3,

@@ -112,7 +112,11 @@ def test_snapshot_keys_byte_compatible(engine):
         "first_token_time", "last_token_time",
         # observability PR appended TPOT percentiles, the per-round
         # phase split, and the wave-integral roofline
-        "tpot_p50_s", "tpot_p99_s", "phase_seconds", "mfu", "hbm_util"]
+        "tpot_p50_s", "tpot_p99_s", "phase_seconds", "mfu", "hbm_util",
+        # speculative-decoding PR appended the draft economics (0/None
+        # on engines without a draft model)
+        "spec_tokens_proposed", "spec_tokens_accepted",
+        "spec_acceptance_rate", "spec_accepted_per_wave"]
     # a 3-token request has 2 inter-token gaps — TPOT is real, and the
     # phase split saw every phase of a working round
     assert snap["tpot_p50_s"] is not None
