@@ -42,7 +42,8 @@ class Config:
     def enable_llm_engine(self, num_slots=4, max_len=256, prefill_len=None,
                           eos_token_id=None, max_queue=None, paged=False,
                           block_size=16, num_blocks=None,
-                          speculative=False, draft_config=None, k=4):
+                          speculative=False, draft_config=None, k=4,
+                          paged_kernel=None):
         """Arm this Config for create_llm_predictor: slot-count / cache
         horizon / prompt bucket for the continuous-batching engine
         (docs/serving.md). switch_ir_optim(False) carries over as the
@@ -63,7 +64,12 @@ class Config:
         vocab) — note a draft_config-built draft is freshly
         initialized: correctness holds regardless (the verify step
         guarantees the target distribution), but acceptance — the whole
-        speedup — needs a draft that actually predicts the target."""
+        speedup — needs a draft that actually predicts the target.
+        paged_kernel selects the fused paged-attention implementation
+        the engine compiles with ("reference" | "lax" | "pallas" |
+        "auto"; default None defers to the PT_PAGED_KERNEL env var,
+        then backend auto-selection — nn/paged_attention.py). The
+        engine's /healthz reports the resolved kernel."""
         self._llm_opts = {
             "num_slots": int(num_slots),
             "max_len": int(max_len),
@@ -76,6 +82,7 @@ class Config:
             "speculative": bool(speculative),
             "draft_config": draft_config,
             "spec_k": int(k),
+            "paged_kernel": paged_kernel,
         }
         return self
 
@@ -359,6 +366,7 @@ class LLMPredictor:
                 block_size=opts.get("block_size", 16),
                 num_blocks=opts.get("num_blocks"),
                 prefill_chunk_len=opts.get("prefill_len"),
+                paged_kernel=opts.get("paged_kernel"),
                 jit_compile=config.ir_optim())
         elif opts.get("paged"):
             self.engine = PagedServingEngine(
@@ -368,6 +376,7 @@ class LLMPredictor:
                 block_size=opts.get("block_size", 16),
                 num_blocks=opts.get("num_blocks"),
                 prefill_chunk_len=opts.get("prefill_len"),
+                paged_kernel=opts.get("paged_kernel"),
                 jit_compile=config.ir_optim())
         else:
             self.engine = ServingEngine(

@@ -200,27 +200,30 @@ class GPTAttention(nn.Layer):
         a = jnp.transpose(a, (2, 0, 3, 1, 4))           # [3, B, nh, 1, D]
         q, k_t, v_t = a[0], a[1], a[2]
         ck, cv = cache
+        from ..nn.paged_attention import paged_decode_attention
         from ..nn.transformer import (cached_decode_attention,
-                                      gather_block_kv, scatter_block_kv_at,
-                                      scatter_kv_at)
+                                      scatter_block_kv_at, scatter_kv_at)
         if block_tables is not None:
+            # fused path: attention reads K/V straight out of the pool
+            # through the table (dispatch: reference | lax | pallas) —
+            # the [B, Hkv, nblk*BS, D] gathered view never exists
             ck = scatter_block_kv_at(ck, k_t, block_tables, pos)
             cv = scatter_block_kv_at(cv, v_t, block_tables, pos)
-            ak = gather_block_kv(ck, block_tables)
-            av = gather_block_kv(cv, block_tables)
-        elif jnp.ndim(pos):
-            ck = scatter_kv_at(ck, k_t, pos)
-            cv = scatter_kv_at(cv, v_t, pos)
-            ak, av = ck, cv
+            out = paged_decode_attention(q, ck, cv, block_tables, pos,
+                                         1.0 / math.sqrt(self.head_dim),
+                                         window=self.attn_window)
         else:
-            ck = jax.lax.dynamic_update_slice_in_dim(
-                ck, k_t.astype(ck.dtype), pos, axis=2)
-            cv = jax.lax.dynamic_update_slice_in_dim(
-                cv, v_t.astype(cv.dtype), pos, axis=2)
-            ak, av = ck, cv
-        out = cached_decode_attention(q, ak, av, pos,
-                                      1.0 / math.sqrt(self.head_dim),
-                                      window=self.attn_window)
+            if jnp.ndim(pos):
+                ck = scatter_kv_at(ck, k_t, pos)
+                cv = scatter_kv_at(cv, v_t, pos)
+            else:
+                ck = jax.lax.dynamic_update_slice_in_dim(
+                    ck, k_t.astype(ck.dtype), pos, axis=2)
+                cv = jax.lax.dynamic_update_slice_in_dim(
+                    cv, v_t.astype(cv.dtype), pos, axis=2)
+            out = cached_decode_attention(q, ck, cv, pos,
+                                          1.0 / math.sqrt(self.head_dim),
+                                          window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, -1)
         out = self.out_proj(Tensor(out.astype(x_t._data.dtype)))
         return out, (ck, cv)
@@ -239,17 +242,17 @@ class GPTAttention(nn.Layer):
         a = jnp.transpose(a, (2, 0, 3, 1, 4))           # [3, B, nh, C, D]
         q, k, v = a[0], a[1], a[2]
         ck, cv = cache
-        from ..nn.transformer import (chunk_attention, gather_block_kv,
-                                      scatter_block_kv_chunk)
+        from ..nn.paged_attention import paged_chunk_attention
+        from ..nn.transformer import scatter_block_kv_chunk
         positions = chunk_start + jnp.arange(s)
         ck = scatter_block_kv_chunk(ck, k, block_tables, positions,
                                     valid_len)
         cv = scatter_block_kv_chunk(cv, v, block_tables, positions,
                                     valid_len)
-        out = chunk_attention(q, gather_block_kv(ck, block_tables),
-                              gather_block_kv(cv, block_tables),
-                              chunk_start, 1.0 / math.sqrt(self.head_dim),
-                              window=self.attn_window)
+        out = paged_chunk_attention(q, ck, cv, block_tables,
+                                    chunk_start,
+                                    1.0 / math.sqrt(self.head_dim),
+                                    window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h)
         return self.out_proj(Tensor(out.astype(x._data.dtype))), (ck, cv)
 
@@ -267,16 +270,15 @@ class GPTAttention(nn.Layer):
         a = jnp.transpose(a, (2, 0, 3, 1, 4))           # [3, S, nh, C, D]
         q, k, v = a[0], a[1], a[2]
         ck, cv = cache
-        from ..nn.transformer import (chunk_attention, gather_block_kv,
-                                      scatter_block_kv_chunk_batched)
+        from ..nn.paged_attention import paged_chunk_attention
+        from ..nn.transformer import scatter_block_kv_chunk_batched
         ck = scatter_block_kv_chunk_batched(ck, k, block_tables, start,
                                             valid_len)
         cv = scatter_block_kv_chunk_batched(cv, v, block_tables, start,
                                             valid_len)
-        out = chunk_attention(q, gather_block_kv(ck, block_tables),
-                              gather_block_kv(cv, block_tables),
-                              start, 1.0 / math.sqrt(self.head_dim),
-                              window=self.attn_window)
+        out = paged_chunk_attention(q, ck, cv, block_tables, start,
+                                    1.0 / math.sqrt(self.head_dim),
+                                    window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h)
         return self.out_proj(Tensor(out.astype(x._data.dtype))), (ck, cv)
 

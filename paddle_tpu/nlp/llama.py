@@ -325,32 +325,37 @@ class LlamaAttention(nn.Layer):
         k_t = k_t.reshape(b, 1, nkv, hd).transpose(0, 2, 1, 3)
         v_t = v_t.reshape(b, 1, nkv, hd).transpose(0, 2, 1, 3)
         ck, cv = cache
+        from ..nn.paged_attention import paged_decode_attention
         from ..nn.transformer import (cached_decode_attention,
-                                      gather_block_kv, scatter_block_kv_at,
-                                      scatter_kv_at)
+                                      scatter_block_kv_at, scatter_kv_at)
         if block_tables is not None:
+            # fused path: attention reads K/V straight out of the pool
+            # through the table (dispatch: reference | lax | pallas) —
+            # the [B, Hkv, nblk*BS, D] gathered view never exists
             q = apply_rope_at(q, self._cos, self._sin, pos)
             k_t = apply_rope_at(k_t, self._cos, self._sin, pos)
             ck = scatter_block_kv_at(ck, k_t, block_tables, pos)
             cv = scatter_block_kv_at(cv, v_t, block_tables, pos)
-            ak = gather_block_kv(ck, block_tables)
-            av = gather_block_kv(cv, block_tables)
-        elif jnp.ndim(pos):
-            q = apply_rope_at(q, self._cos, self._sin, pos)
-            k_t = apply_rope_at(k_t, self._cos, self._sin, pos)
-            ck = scatter_kv_at(ck, k_t, pos)
-            cv = scatter_kv_at(cv, v_t, pos)
-            ak, av = ck, cv
+            out = paged_decode_attention(q, ck, cv, block_tables, pos,
+                                         1.0 / math.sqrt(hd),
+                                         window=self.attn_window)
         else:
-            q = apply_rope(q, self._cos, self._sin, pos_offset=pos)
-            k_t = apply_rope(k_t, self._cos, self._sin, pos_offset=pos)
-            ck = jax.lax.dynamic_update_slice_in_dim(
-                ck, k_t.astype(ck.dtype), pos, axis=2)
-            cv = jax.lax.dynamic_update_slice_in_dim(
-                cv, v_t.astype(cv.dtype), pos, axis=2)
-            ak, av = ck, cv
-        out = cached_decode_attention(q, ak, av, pos, 1.0 / math.sqrt(hd),
-                                      window=self.attn_window)
+            if jnp.ndim(pos):
+                q = apply_rope_at(q, self._cos, self._sin, pos)
+                k_t = apply_rope_at(k_t, self._cos, self._sin, pos)
+                ck = scatter_kv_at(ck, k_t, pos)
+                cv = scatter_kv_at(cv, v_t, pos)
+            else:
+                q = apply_rope(q, self._cos, self._sin, pos_offset=pos)
+                k_t = apply_rope(k_t, self._cos, self._sin,
+                                 pos_offset=pos)
+                ck = jax.lax.dynamic_update_slice_in_dim(
+                    ck, k_t.astype(ck.dtype), pos, axis=2)
+                cv = jax.lax.dynamic_update_slice_in_dim(
+                    cv, v_t.astype(cv.dtype), pos, axis=2)
+            out = cached_decode_attention(q, ck, cv, pos,
+                                          1.0 / math.sqrt(hd),
+                                          window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, 1, nh * hd)
         out = self.o_proj(Tensor(out.astype(x_t._data.dtype)))
         return out, (ck, cv)
@@ -375,16 +380,15 @@ class LlamaAttention(nn.Layer):
         q = apply_rope_positions(q, self._cos, self._sin, positions)
         k = apply_rope_positions(k, self._cos, self._sin, positions)
         ck, cv = cache
-        from ..nn.transformer import (chunk_attention, gather_block_kv,
-                                      scatter_block_kv_chunk)
+        from ..nn.paged_attention import paged_chunk_attention
+        from ..nn.transformer import scatter_block_kv_chunk
         ck = scatter_block_kv_chunk(ck, k, block_tables, positions,
                                     valid_len)
         cv = scatter_block_kv_chunk(cv, v, block_tables, positions,
                                     valid_len)
-        out = chunk_attention(q, gather_block_kv(ck, block_tables),
-                              gather_block_kv(cv, block_tables),
-                              chunk_start, 1.0 / math.sqrt(hd),
-                              window=self.attn_window)
+        out = paged_chunk_attention(q, ck, cv, block_tables, chunk_start,
+                                    1.0 / math.sqrt(hd),
+                                    window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, nh * hd)
         out = self.o_proj(Tensor(out.astype(x._data.dtype)))
         return out, (ck, cv)
@@ -414,16 +418,15 @@ class LlamaAttention(nn.Layer):
         q = apply_rope_positions(q, self._cos, self._sin, positions)
         k = apply_rope_positions(k, self._cos, self._sin, positions)
         ck, cv = cache
-        from ..nn.transformer import (chunk_attention, gather_block_kv,
-                                      scatter_block_kv_chunk_batched)
+        from ..nn.paged_attention import paged_chunk_attention
+        from ..nn.transformer import scatter_block_kv_chunk_batched
         ck = scatter_block_kv_chunk_batched(ck, k, block_tables, start,
                                             valid_len)
         cv = scatter_block_kv_chunk_batched(cv, v, block_tables, start,
                                             valid_len)
-        out = chunk_attention(q, gather_block_kv(ck, block_tables),
-                              gather_block_kv(cv, block_tables),
-                              start, 1.0 / math.sqrt(hd),
-                              window=self.attn_window)
+        out = paged_chunk_attention(q, ck, cv, block_tables, start,
+                                    1.0 / math.sqrt(hd),
+                                    window=self.attn_window)
         out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, nh * hd)
         out = self.o_proj(Tensor(out.astype(x._data.dtype)))
         return out, (ck, cv)

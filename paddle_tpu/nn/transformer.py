@@ -376,7 +376,8 @@ def _clone_layer(layer):
     return type(layer)(**layer._config)
 
 
-def cached_decode_attention(q, ck, cv, pos, scale, window=None):
+def cached_decode_attention(q, ck, cv, pos, scale, window=None,
+                            sanitize=False):
     """Single-token cached attention core shared by the GPT and LLaMA
     decoders. q: [B, H, 1, D]; ck/cv: [B, Hkv, L, D] with H % Hkv == 0 —
     grouped (GQA) when H > Hkv, WITHOUT materialising the repeated cache:
@@ -385,7 +386,11 @@ def cached_decode_attention(q, ck, cv, pos, scale, window=None):
     positions (sliding-window decode matching the training band).
     `pos` is a traced scalar (lockstep batch) or a [B] vector — the
     slot-wise serving case where every row sits at its own depth; the
-    causal mask broadcasts per-row. Returns [B, H, 1, D] in cv.dtype."""
+    causal mask broadcasts per-row. Returns [B, H, 1, D] in cv.dtype.
+    sanitize=True additionally zeroes V rows no query attends — needed
+    when the cache view contains scratch-block garbage that may be
+    non-finite (the paged reference path); the dense path skips the
+    extra elementwise pass over the cache."""
     import jax
     import jax.numpy as jnp
 
@@ -401,10 +406,49 @@ def cached_decode_attention(q, ck, cv, pos, scale, window=None):
     mask = ks <= pos
     if window is not None:
         mask = mask & (ks > pos - window)
-    scores = jnp.where(mask, scores, -1e9)
-    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    probs = _masked_softmax(scores, mask).astype(cv.dtype)
+    if sanitize:
+        cv = _sanitize_unattended(cv, mask[:, 0, 0, :, None])
     out = jnp.einsum("bkrl,bkld->bkrd", probs, cv)
     return out.reshape(b, h, 1, d)
+
+
+def _masked_softmax(scores, mask):
+    """Softmax with HARD exclusion of masked positions: -inf (not the
+    old -1e9 additive sentinel) before the max/exp, and fully-masked
+    rows (all-scratch lanes, padded chunk tails) renormalise to exactly
+    0 through the guarded `where` instead of averaging over a uniform
+    -1e9 row. Masked-position garbage — scratch blocks hold arbitrary
+    bytes, possibly non-finite — therefore can never reach the serving
+    engines' isfinite poison sentinel, while a non-finite value at an
+    ATTENDED position still propagates (exp(nan) is nan). For any row
+    with at least one unmasked position this is bitwise identical to
+    softmax over the -1e9-masked scores: exp(-1e9 - m) and
+    exp(-inf - m) both round to exactly 0.0 in f32 for finite m."""
+    import jax.numpy as jnp
+    scores = jnp.where(mask, scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.exp(scores - jnp.where(jnp.isfinite(m), m, 0.0))
+    denom = jnp.sum(e, axis=-1, keepdims=True)
+    # the guard must key on == 0 (fully-masked), NOT > 0: a non-finite
+    # denom from a genuine fault fails `> 0` and would silently zero
+    # the row; `== 0` lets nan fall through to the division instead
+    return jnp.where(denom == 0, 0.0, e / denom)
+
+
+def _sanitize_unattended(cv, attended):
+    """Zero the V rows NO query attends (attended: [B, L] broadcastable
+    against cv [B, Hkv, L, D], any-reduced over the query axes by the
+    caller). A 0-probability key with non-finite garbage would still
+    produce 0 * nan == nan in the probs @ V contraction — scratch-block
+    poison leaking past the mask. Keys attended by at least one query
+    keep their value, so a GENUINE non-finite at an attended position
+    propagates to that lane's logits (the poison sentinel) exactly as
+    before; for finite caches this is bitwise a no-op (0 * v == 0 * 0)."""
+    import jax.numpy as jnp
+    b = attended.shape[0]
+    return jnp.where(jnp.reshape(attended, (b, 1) + attended.shape[1:]),
+                     cv, jnp.zeros((), cv.dtype))
 
 
 def scatter_kv_at(cache, kv_t, pos):
@@ -499,7 +543,8 @@ def scatter_block_kv_chunk_batched(pool, kv_c, tables, start, valid_len):
     return pool.at[blk, :, positions % bs, :].set(kv.astype(pool.dtype))
 
 
-def chunk_attention(q, ck, cv, start, scale, window=None):
+def chunk_attention(q, ck, cv, start, scale, window=None,
+                    sanitize=False):
     """Prefill-chunk attention core: C queries at absolute positions
     start + i over an L-position KV view (the gathered paged cache,
     which already contains this chunk's own K/V). q: [B, H, C, D];
@@ -510,7 +555,8 @@ def chunk_attention(q, ck, cv, start, scale, window=None):
     ks <= start + i (banded to the last `window` keys when given), so a
     chunk mid-prefill attends to every previous chunk's cached
     positions plus its own causal prefix. Returns [B, H, C, D] in
-    cv.dtype."""
+    cv.dtype. sanitize as in cached_decode_attention (paged gathered
+    views only)."""
     import jax
     import jax.numpy as jnp
 
@@ -527,7 +573,9 @@ def chunk_attention(q, ck, cv, start, scale, window=None):
     mask = ks <= qpos
     if window is not None:
         mask = mask & (ks > qpos - window)
-    scores = jnp.where(mask, scores, -1e9)
-    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    probs = _masked_softmax(scores, mask).astype(cv.dtype)
+    if sanitize:
+        cv = _sanitize_unattended(
+            cv, jnp.any(mask, axis=3)[:, 0, 0, :, None])
     out = jnp.einsum("bkrcl,bkld->bkrcd", probs, cv)
     return out.reshape(b, h, c, d)

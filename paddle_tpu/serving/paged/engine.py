@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...nn import paged_attention
 from ...utils import chaos, telemetry
 from ..engine import (ServingEngine, _filter_top_k_top_p, _raw,
                       _select_first_token, _select_wave_tokens)
@@ -57,11 +58,18 @@ class PagedServingEngine(ServingEngine):
     prefill_chunk_len: prompt chunk size (default min(64, max_len)).
     prefix_sharing: hash full prompt blocks and dedupe identical
         prefixes (copy-on-write guarded; see BlockPool).
+    paged_kernel: which fused paged-attention implementation the
+        engine's programs trace ("reference" | "lax" | "pallas" |
+        "auto"; None defers to PT_PAGED_KERNEL / the process default —
+        see nn/paged_attention.py). Resolved at construction and pinned
+        for every program this engine compiles; reported in /healthz.
     """
 
     def __init__(self, model, num_slots=4, max_len=256, block_size=16,
                  num_blocks=None, prefill_chunk_len=None, cache_dtype=None,
-                 jit_compile=True, seed=0, prefix_sharing=True):
+                 jit_compile=True, seed=0, prefix_sharing=True,
+                 paged_kernel=None):
+        self.paged_kernel = paged_attention.resolve_kernel(paged_kernel)
         if max_len % block_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"block_size {block_size}")
@@ -93,13 +101,17 @@ class PagedServingEngine(ServingEngine):
 
     # ---------------------------------------------------------- programs
     def _build_programs(self):
-        model = self.model
+        model, kern = self.model, self.paged_kernel
 
         def decode_wave(p, b, caches, tables, tok, pos, active, sample,
                         temps, top_k, top_p, bias, poison, key):
-            out, _ = model.functional_call(p, b, tok[:, None], caches,
-                                           pos, method="decode_step",
-                                           block_tables=tables)
+            # the scope pins this engine's kernel at TRACE time — the
+            # compiled wave keeps whatever it resolved, regardless of
+            # the process default when later engines trace
+            with paged_attention.kernel_scope(kern):
+                out, _ = model.functional_call(p, b, tok[:, None], caches,
+                                               pos, method="decode_step",
+                                               block_tables=tables)
             logits, new_caches = out
             lo = _raw(logits)[:, 0, :].astype(jnp.float32)
             nxt, new_pos, finite = _select_wave_tokens(
@@ -110,10 +122,11 @@ class PagedServingEngine(ServingEngine):
         def prefill_chunk(p, b, caches, table, chunk, chunk_start,
                           valid_len, frontier, sample, temp, top_k,
                           top_p, bias, key):
-            out, _ = model.functional_call(
-                p, b, chunk[None, :], caches, method="prefill_chunk",
-                block_tables=table[None, :], chunk_start=chunk_start,
-                valid_len=valid_len, frontier=frontier)
+            with paged_attention.kernel_scope(kern):
+                out, _ = model.functional_call(
+                    p, b, chunk[None, :], caches, method="prefill_chunk",
+                    block_tables=table[None, :], chunk_start=chunk_start,
+                    valid_len=valid_len, frontier=frontier)
             logits, new_caches = out
             # frontier logits [1, 1, V]: only the FINAL chunk's value is
             # consumed on host; earlier chunks compute a [V] row that is
@@ -371,6 +384,7 @@ class PagedServingEngine(ServingEngine):
         # /healthz fetch instead of scraping /metrics
         h = super()._health()
         h.update(block_size=self.block_size,
+                 paged_kernel=self.paged_kernel,
                  cache_blocks_used=self.block_pool.used,
                  cache_blocks_total=self.block_pool.usable,
                  prefix_cache_hits=self.block_pool.prefix_hits,
@@ -530,6 +544,7 @@ class SpeculativePagedEngine(PagedServingEngine):
     # -------------------------------------------------------- programs
     def _build_programs(self):
         model, draft, k = self.model, self.draft_model, self.spec_k
+        kern = self.paged_kernel
 
         def draft_wave(dp, db, caches, tables, tok, pos, sample,
                        temps, top_k, top_p, bias, spec_len, key):
@@ -545,9 +560,10 @@ class SpeculativePagedEngine(PagedServingEngine):
             for j in range(k + 1):
                 tab_j = jnp.where((j <= spec_len)[:, None], tables,
                                   jnp.int32(BlockPool.SCRATCH))
-                out, _ = draft.functional_call(
-                    dp, db, cur[:, None], dr_caches, pos + j,
-                    method="decode_step", block_tables=tab_j)
+                with paged_attention.kernel_scope(kern):
+                    out, _ = draft.functional_call(
+                        dp, db, cur[:, None], dr_caches, pos + j,
+                        method="decode_step", block_tables=tab_j)
                 logits, dr_caches = out
                 if j == k:
                     break               # write-only step: no proposal
@@ -572,9 +588,10 @@ class SpeculativePagedEngine(PagedServingEngine):
             block tables), then the exact acceptance-rejection tail."""
             tgt_caches, dr_caches = caches
             chunk = jnp.concatenate([tok[:, None], draft_toks], axis=1)
-            out, _ = model.functional_call(
-                p, b, chunk, tgt_caches, tables, pos, spec_len + 1,
-                method="decode_chunk")
+            with paged_attention.kernel_scope(kern):
+                out, _ = model.functional_call(
+                    p, b, chunk, tgt_caches, tables, pos, spec_len + 1,
+                    method="decode_chunk")
             logits, tgt_caches = out
             lo = _raw(logits).astype(jnp.float32)       # [S, k+1, V]
             out_toks, n_emit, nxt, new_pos, finite = _spec_verify_tail(
@@ -593,16 +610,18 @@ class SpeculativePagedEngine(PagedServingEngine):
             first decode wave start drafting immediately, and a
             prefix-cache hit skips the chunk for both models at once."""
             tgt_caches, dr_caches = caches
-            out, _ = model.functional_call(
-                p, b, chunk[None, :], tgt_caches, method="prefill_chunk",
-                block_tables=table[None, :], chunk_start=chunk_start,
-                valid_len=valid_len, frontier=frontier)
-            logits, tgt_caches = out
-            dout, _ = draft.functional_call(
-                dp, db, chunk[None, :], dr_caches,
-                method="prefill_chunk", block_tables=table[None, :],
-                chunk_start=chunk_start, valid_len=valid_len,
-                frontier=frontier)
+            with paged_attention.kernel_scope(kern):
+                out, _ = model.functional_call(
+                    p, b, chunk[None, :], tgt_caches,
+                    method="prefill_chunk", block_tables=table[None, :],
+                    chunk_start=chunk_start, valid_len=valid_len,
+                    frontier=frontier)
+                logits, tgt_caches = out
+                dout, _ = draft.functional_call(
+                    dp, db, chunk[None, :], dr_caches,
+                    method="prefill_chunk", block_tables=table[None, :],
+                    chunk_start=chunk_start, valid_len=valid_len,
+                    frontier=frontier)
             _, dr_caches = dout         # draft frontier logits unused
             lo = _raw(logits)[0, 0].astype(jnp.float32)
             first = _select_first_token(lo, sample, temp, top_k, top_p,

@@ -26,6 +26,7 @@ _INSTR_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?:\([^)]*\)|\S+)\s+"
     r"([a-z][a-z0-9\-]*)")
 _FUSION_KIND_RE = re.compile(r"\bkind=k(\w+)")
+_CUSTOM_CALL_TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
 
 # computation-opening lines (`%fused_computation ... {`, `ENTRY %main`)
 # also contain " = " never — they match nothing; parameter declarations
@@ -49,12 +50,20 @@ def op_histogram(hlo_text):
       collectives        — per-opcode counts for the comm ops present
       custom_call_count  — custom-call instructions (host callbacks,
                            library kernels — the un-fusable opaque ops)
+      custom_calls       — {target: count} per custom_call_target — a
+                           Pallas kernel shows up here by name (e.g.
+                           "tpu_custom_call"), which keeps the
+                           fusion-count gate meaningful: work moving
+                           from XLA fusions INTO an opaque kernel is
+                           visible as a named count, not a silent
+                           fusion_count drop
       ops                — full opcode -> count histogram
     Deterministic for a given program + backend: names/ids are ignored,
-    only opcodes and fusion kinds are counted.
+    only opcodes, fusion kinds and custom-call targets are counted.
     """
     ops = {}
     fusion_kinds = {}
+    custom_calls = {}
     for line in hlo_text.splitlines():
         m = _INSTR_RE.match(line)
         if not m:
@@ -65,6 +74,10 @@ def op_histogram(hlo_text):
             k = _FUSION_KIND_RE.search(line)
             kind = k.group(1) if k else "Unknown"
             fusion_kinds[kind] = fusion_kinds.get(kind, 0) + 1
+        elif op == "custom-call":
+            t = _CUSTOM_CALL_TARGET_RE.search(line)
+            target = t.group(1) if t else "unknown"
+            custom_calls[target] = custom_calls.get(target, 0) + 1
     collectives = {}
     for op, n in ops.items():
         base = op[:-6] if op.endswith("-start") else op
@@ -77,5 +90,6 @@ def op_histogram(hlo_text):
         "collective_count": sum(collectives.values()),
         "collectives": dict(sorted(collectives.items())),
         "custom_call_count": ops.get("custom-call", 0),
+        "custom_calls": dict(sorted(custom_calls.items())),
         "ops": dict(sorted(ops.items())),
     }

@@ -15,7 +15,15 @@ REAL hot path:
     verifies the block POOL leaves stay donation-aliased at engine
     shapes;
   * `paged_decode_attention` — the block-table decode core
-    (scatter/gather through traced tables + the GQA cached core);
+    (scatter/gather through traced tables + the GQA cached core) — the
+    reference oracle the fused kernels are measured against;
+  * `paged_fused_decode_attention` / `paged_fused_chunk_attention` —
+    the fused paged-attention cores (nn/paged_attention.py): the same
+    scatter + attend, but reading K/V straight out of the pool through
+    the table with an online softmax — no gathered
+    [B, Hkv, nblk*BS, D] intermediate. Audited with the dispatch's
+    backend-auto kernel (lax on CPU — the implementation the banked
+    CPU baselines gate; pallas on TPU);
   * `train_step` — `jit.TrainStep` (forward + backward + AdamW, donated
     state) on the canonical 2-layer GPT config — the same topology
     bench.py's CPU smoke compiles, so the persistent compile cache is
@@ -63,7 +71,10 @@ TRACKED_PROGRAMS = ("serving_decode_wave", "serving_prefill",
                     "paged_spec_draft_wave", "paged_spec_verify",
                     "train_step", "sharded_train_step",
                     "cached_decode_attention",
-                    "paged_decode_attention", "prefill_flash_attention")
+                    "paged_decode_attention",
+                    "paged_fused_decode_attention",
+                    "paged_fused_chunk_attention",
+                    "prefill_flash_attention")
 
 
 def program_cost(spec):
@@ -407,13 +418,17 @@ def _sharded_train_step_spec():
 
 def _attention_specs():
     import jax.numpy as jnp
+    from paddle_tpu.nn.paged_attention import (paged_chunk_attention,
+                                               paged_decode_attention)
     from paddle_tpu.nn.transformer import (cached_decode_attention,
                                            gather_block_kv,
-                                           scatter_block_kv_at)
+                                           scatter_block_kv_at,
+                                           scatter_block_kv_chunk_batched)
     from paddle_tpu.ops.pallas.flash_attention import _flash_array
 
     b, h, hkv, L, d = 4, 4, 2, 64, 16
     bs, nblk, num_blocks = 8, 8, 17        # nblk * bs == L
+    C = SPEC["spec_k"] + 1                 # the verify chunk width
 
     def decode_attn(q, ck, cv, pos):
         return cached_decode_attention(q, ck, cv, pos,
@@ -442,6 +457,37 @@ def _attention_specs():
                   jnp.zeros((b, nblk), jnp.int32),
                   jnp.zeros((b,), jnp.int32))
 
+    def fused_decode_attn(q, kv_t, pk, pv, tables, pos):
+        # the fused sibling of paged_decode_attn: same scatter, but the
+        # attend reads the pool through the table (online softmax) —
+        # the [B, Hkv, nblk*BS, D] gathered view never materialises.
+        # kernel=None: the dispatch's backend auto-selection, i.e. the
+        # implementation the serving engines actually compile here
+        pk = scatter_block_kv_at(pk, kv_t, tables, pos)
+        pv = scatter_block_kv_at(pv, kv_t, tables, pos)
+        out = paged_decode_attention(q, pk, pv, tables, pos,
+                                     scale=1.0 / (d ** 0.5))
+        return out, pk, pv
+
+    def fused_chunk_attn(q, kv_c, pk, pv, tables, start, valid_len):
+        # the chunked form (spec verify / prefill chunk): C queries per
+        # lane at per-lane offsets, batched scatter + fused attend
+        pk = scatter_block_kv_chunk_batched(pk, kv_c, tables, start,
+                                            valid_len)
+        pv = scatter_block_kv_chunk_batched(pv, kv_c, tables, start,
+                                            valid_len)
+        out = paged_chunk_attention(q, pk, pv, tables, start,
+                                    scale=1.0 / (d ** 0.5))
+        return out, pk, pv
+
+    fused_chunk_args = (jnp.zeros((b, h, C, d), jnp.float32),
+                        jnp.zeros((b, hkv, C, d), jnp.float32),
+                        jnp.zeros((num_blocks, hkv, bs, d), jnp.float32),
+                        jnp.zeros((num_blocks, hkv, bs, d), jnp.float32),
+                        jnp.zeros((b, nblk), jnp.int32),
+                        jnp.zeros((b,), jnp.int32),
+                        jnp.full((b,), C, jnp.int32))
+
     def prefill_attn(q, k, v):
         return _flash_array(q, k, v, causal=True)
 
@@ -458,7 +504,21 @@ def _attention_specs():
          "jit_kwargs": {"donate_argnums": (2, 3)},
          "description": "block-table decode attention core: KV "
                         "scatter/gather through traced tables + the "
-                        "GQA cached core"},
+                        "GQA cached core (the fused kernels' reference "
+                        "oracle)"},
+        {"name": "paged_fused_decode_attention", "fn": fused_decode_attn,
+         "args": paged_args,
+         "jit_kwargs": {"donate_argnums": (2, 3)},
+         "description": "fused paged decode core: block-table gather + "
+                        "GQA online-softmax attend in one pass, no "
+                        "gathered KV intermediate (nn/paged_attention, "
+                        "backend-auto kernel)"},
+        {"name": "paged_fused_chunk_attention", "fn": fused_chunk_attn,
+         "args": fused_chunk_args,
+         "jit_kwargs": {"donate_argnums": (2, 3)},
+         "description": "fused paged chunk core (spec-verify width "
+                        "k+1): per-lane-offset queries, batched KV "
+                        "scatter + fused block-table attend"},
         {"name": "prefill_flash_attention", "fn": prefill_attn,
          "args": prefill_args,
          "description": "causal prompt-phase attention array kernel"},
@@ -485,6 +545,7 @@ def tracked_program_specs(names=None):
     if "sharded_train_step" in want:
         specs.append(_sharded_train_step_spec())
     if want & {"cached_decode_attention", "paged_decode_attention",
-               "prefill_flash_attention"}:
+               "paged_fused_decode_attention",
+               "paged_fused_chunk_attention", "prefill_flash_attention"}:
         specs += [s for s in _attention_specs() if s["name"] in want]
     return specs

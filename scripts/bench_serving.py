@@ -251,6 +251,17 @@ def main():
                     help="serve from the block-table paged KV cache "
                          "(PagedServingEngine): HBM scales with "
                          "--num-blocks, utilization with actual tokens")
+    ap.add_argument("--kernel", default=None,
+                    choices=["reference", "lax", "pallas"],
+                    help="paged: pin the paged-attention kernel "
+                         "(nn/paged_attention dispatch; default: the "
+                         "engine's auto choice). With a fused kernel "
+                         "(lax/pallas) on a plain --paged sweep, each "
+                         "load point first runs a matched "
+                         "kernel=reference baseline row with the same "
+                         "arrival seed, and the fused row reports "
+                         "tokens/s, TPOT, serving_hbm_util and "
+                         "program bytes_accessed deltas against it")
     ap.add_argument("--block-size", type=int, default=16,
                     help="paged: tokens per KV block")
     ap.add_argument("--num-blocks", type=int, default=None,
@@ -340,12 +351,14 @@ def main():
             args.draft_layers, max(1, args.heads // 2), args.vocab,
             args.max_len, args.bf16)
 
-    def make_paged():
+    def make_paged(paged_kernel=None):
         return PagedServingEngine(model, num_slots=args.slots,
                                   max_len=args.max_len,
                                   block_size=args.block_size,
                                   num_blocks=args.num_blocks,
-                                  prefill_chunk_len=args.prefill_len)
+                                  prefill_chunk_len=args.prefill_len,
+                                  paged_kernel=paged_kernel
+                                  or args.kernel)
 
     def make_engine():
         if args.speculative:
@@ -354,7 +367,8 @@ def main():
                 model, draft_model, spec_k=args.spec_k,
                 num_slots=args.slots, max_len=args.max_len,
                 block_size=args.block_size, num_blocks=args.num_blocks,
-                prefill_chunk_len=args.prefill_len)
+                prefill_chunk_len=args.prefill_len,
+                paged_kernel=args.kernel)
         if args.paged:
             return make_paged()
         return ServingEngine(model, num_slots=args.slots,
@@ -402,6 +416,16 @@ def main():
         # like against like
         baseline_engine = make_paged()
         Scheduler(baseline_engine).generate([1, 2, 3], max_tokens=4)
+    kernel_baseline_engine = None
+    if (args.kernel in ("lax", "pallas") and args.paged
+            and not args.speculative and router is None):
+        # the matched gather-then-attend baseline: same model and pool
+        # geometry, kernel pinned to the reference pair — each load
+        # point runs it first with the same arrival seed so the fused
+        # row's deltas compare like against like (the PR 15 pattern)
+        kernel_baseline_engine = make_paged(paged_kernel="reference")
+        Scheduler(kernel_baseline_engine).generate([1, 2, 3],
+                                                   max_tokens=4)
     if args.paged:
         log(f"paged pool: {engine.block_pool.usable} usable blocks x "
             f"{engine.block_size} tokens (dense equivalent would be "
@@ -431,10 +455,38 @@ def main():
         shared_prefix = np.random.RandomState(7).randint(
             0, args.vocab, (args.shared_prefix,)).tolist()
 
+    # static compile-level comparison for the kernel A/B: the fused
+    # programs' bytes_accessed vs the reference engine's — one number
+    # per program for the whole sweep (it is a property of the compiled
+    # program, not of a load point), attached to every fused row
+    kernel_bytes = None
+    if kernel_baseline_engine is not None:
+        from paddle_tpu.tools import xprof
+        fused_roll = xprof.rollup(xprof.snapshot_programs(
+            xprof.engine_program_specs(engine)))
+        ref_roll = xprof.rollup(xprof.snapshot_programs(
+            xprof.engine_program_specs(kernel_baseline_engine)))
+        kernel_bytes = {}
+        for name, m in fused_roll.items():
+            fb = m.get("bytes_accessed")
+            rb = ref_roll.get(name, {}).get("bytes_accessed")
+            kernel_bytes[name] = {
+                "fused": fb, "reference": rb,
+                "saved_frac": (None if not fb or not rb
+                               else round(1.0 - fb / rb, 4))}
+        log("kernel A/B bytes_accessed: " + ", ".join(
+            f"{n} {v['reference']}->{v['fused']}"
+            for n, v in kernel_bytes.items()))
+
     rows = []
     kind = "paged" if args.paged else "dense"
+    if args.paged and args.kernel:
+        kind = f"paged[{args.kernel}]"
     if args.speculative:
         kind = f"spec[k={args.spec_k},draft={args.draft_layers}L]"
+        if args.kernel:
+            kind = (f"spec[k={args.spec_k},"
+                    f"draft={args.draft_layers}L,{args.kernel}]")
     if router is not None:
         kind = (f"fleet[{args.replicas}x{kind}:"
                 f"{args.router_policy}]")
@@ -446,6 +498,16 @@ def main():
                                    max_queue=args.max_queue,
                                    max_preemptions=args.max_preemptions)
             base_snap = run_load(base_sched, load, args.requests,
+                                 args.vocab,
+                                 prompt_range=(4, args.prefill_len),
+                                 output_range=(4, out_hi), seed=100 + i,
+                                 shared_prefix=shared_prefix)
+        kern_snap = None
+        if kernel_baseline_engine is not None:
+            kb_sched = Scheduler(kernel_baseline_engine,
+                                 max_queue=args.max_queue,
+                                 max_preemptions=args.max_preemptions)
+            kern_snap = run_load(kb_sched, load, args.requests,
                                  args.vocab,
                                  prompt_range=(4, args.prefill_len),
                                  output_range=(4, out_hi), seed=100 + i,
@@ -576,6 +638,49 @@ def main():
             }
             rows.append(base_row)
             print(json.dumps(base_row), flush=True)
+        if args.kernel is not None and args.paged:
+            row["detail"]["kernel"] = {"paged_kernel": args.kernel}
+        if kern_snap is not None:
+            # the fused-vs-reference economics at THIS load point, vs
+            # the matched reference row that ran first with the same
+            # arrival seed: the compile-level bytes win (static, from
+            # kernel_bytes) should surface as a lower measured HBM
+            # residency per token at equal correctness
+            def _kdelta(key, scale=1.0, nd=4):
+                a, b = snap.get(key), kern_snap.get(key)
+                return (None if a is None or b is None
+                        else round((a - b) * scale, nd))
+            row["detail"]["kernel"].update({
+                "baseline_kernel": "reference",
+                "tokens_per_s_delta": _kdelta("tokens_per_s", nd=1),
+                "tpot_p50_delta_ms": _kdelta("tpot_p50_s", 1e3, 3),
+                "tpot_p99_delta_ms": _kdelta("tpot_p99_s", 1e3, 3),
+                "serving_hbm_util_delta": _kdelta("hbm_util", nd=6),
+                "bytes_accessed": kernel_bytes,
+            })
+            kern_row = {
+                "metric": f"serving {args.family} paged[reference] "
+                          f"tokens/s @{load:g}req/s x{args.slots}slots",
+                "value": round(kern_snap["tokens_per_s"] or 0.0, 1),
+                "unit": "tokens/s",
+                "detail": {
+                    "paged_kernel": "reference",
+                    "ttft_p50_ms": round(
+                        (kern_snap["ttft_p50_s"] or 0) * 1e3, 2),
+                    "tpot_p50_ms": round(
+                        (kern_snap.get("tpot_p50_s") or 0) * 1e3, 3),
+                    "tpot_p99_ms": round(
+                        (kern_snap.get("tpot_p99_s") or 0) * 1e3, 3),
+                    "serving_hbm_util": (
+                        None if kern_snap.get("hbm_util") is None
+                        else round(kern_snap["hbm_util"], 6)),
+                    "offered_load_rps": load,
+                    "requests": kern_snap["n_requests"],
+                    "wall_s": round(kern_snap["wall_s"], 2),
+                },
+            }
+            rows.append(kern_row)
+            print(json.dumps(kern_row), flush=True)
         if router is not None:
             # router stats per load point: the affinity-vs-round_robin
             # A/B reads straight off prefix_hits_per_request across

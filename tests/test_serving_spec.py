@@ -174,6 +174,7 @@ def test_chunked_prefill_interleave_token_identical(spec, paged):
     assert [r.output_tokens for r in sr] == [r.output_tokens for r in dr]
 
 
+@pytest.mark.slow
 def test_preemption_by_recompute_token_identical(model, draft):
     """A pool too small for four long requests: starved lanes preempt
     by recompute mid-speculation, everyone completes, and every output
@@ -292,6 +293,7 @@ def test_poisoned_lane_retired_with_speculation_rolled_back(model, draft,
     assert eng.decode_compiles == 1        # poison is a program INPUT
 
 
+@pytest.mark.slow
 def test_horizon_bounded_request_token_identical(model, draft):
     """A request running into the cache horizon: the speculative batch
     whose LAST token lands at max_len must stream every token before
