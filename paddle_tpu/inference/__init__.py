@@ -36,6 +36,7 @@ class Config:
         self._ir_optim = True
         self._cpu_math_threads = None
         self._llm_opts = None
+        self._fleet_opts = None
         self._metrics_exporter = None
 
     # ---- LLM serving engine (paddle_tpu.serving front door)
@@ -88,6 +89,43 @@ class Config:
 
     def llm_engine_enabled(self):
         return self._llm_opts is not None
+
+    def enable_llm_fleet(self, replicas=None, policy="affinity",
+                         prefill_replicas=None, decode_replicas=None,
+                         tenants=None):
+        """Serve through a replica fleet instead of one scheduler
+        (docs/serving.md "Serving fleet"): create_llm_predictor builds
+        `replicas` engines from the enable_llm_engine knobs behind a
+        FleetRouter (prefix-affinity routing, token-exact failover,
+        elastic scale). Setting prefill_replicas/decode_replicas
+        switches to the DISAGGREGATED topology (docs/serving.md
+        "Disaggregated prefill/decode"): that many role-pinned prefill
+        and decode replicas — a pure split fleet unless `replicas`
+        explicitly asks for unified ones alongside (the default is 0
+        unified in the split topology, 2 otherwise) — long prompts
+        prefill on the prefill side and hand their KV blocks to a
+        decode replica.
+        `tenants` (an iterable of serving.Tenant, or a prebuilt
+        QoSManager) arms multi-tenant QoS — per-tenant SLO windows,
+        weighted-fair admission under pool pressure, priority
+        preemption (docs/serving.md "Multi-tenant QoS"); submit() then
+        accepts tenant=/priority=."""
+        disagg = prefill_replicas is not None or decode_replicas is not None
+        if replicas is None:
+            replicas = 0 if disagg else 2
+        self._fleet_opts = {
+            "replicas": int(replicas),
+            "policy": str(policy),
+            "prefill_replicas": (None if prefill_replicas is None
+                                 else int(prefill_replicas)),
+            "decode_replicas": (None if decode_replicas is None
+                                else int(decode_replicas)),
+            "tenants": tenants,
+        }
+        return self
+
+    def llm_fleet_enabled(self):
+        return self._fleet_opts is not None
 
     def enable_metrics_exporter(self, port=0, host="127.0.0.1"):
         """Arm the unified-telemetry /metrics exporter
@@ -343,22 +381,113 @@ class LLMPredictor:
     the full submit()/run() surface for continuous batching."""
 
     def __init__(self, config, model, draft_model=None):
-        from ..serving import (PagedServingEngine, ServingEngine,
-                               Scheduler, SpeculativePagedEngine)
+        from ..serving import Scheduler
+        from ..serving.fleet import DisaggFleetRouter, FleetRouter
         opts = config._llm_opts or {}
         self._eos_token_id = opts.get("eos_token_id")
+        factory = _engine_factory(config, opts, model, draft_model)
+        self.router = None
+        fleet_opts = config._fleet_opts
+        if fleet_opts is None:
+            self.engine = factory()
+            self.scheduler = Scheduler(self.engine,
+                                       max_queue=opts.get("max_queue"))
+        else:
+            # fleet front door: replicas built from the SAME factory the
+            # single-engine path uses, so every enable_llm_engine knob
+            # (paged, speculative, kernel choice, ir_optim) carries over
+            sched_kw = ({} if opts.get("max_queue") is None
+                        else {"max_queue": opts["max_queue"]})
+            if (fleet_opts["prefill_replicas"] is not None
+                    or fleet_opts["decode_replicas"] is not None):
+                self.router = DisaggFleetRouter(
+                    factory,
+                    prefill_replicas=fleet_opts["prefill_replicas"] or 0,
+                    decode_replicas=fleet_opts["decode_replicas"] or 0,
+                    unified_replicas=fleet_opts["replicas"],
+                    qos=fleet_opts["tenants"],
+                    policy=fleet_opts["policy"],
+                    scheduler_kwargs=sched_kw)
+            else:
+                self.router = FleetRouter(
+                    factory, replicas=fleet_opts["replicas"],
+                    policy=fleet_opts["policy"],
+                    scheduler_kwargs=sched_kw)
+            self.engine = None
+            self.scheduler = None
+        self.metrics_server = None
+        if config.metrics_exporter_enabled():
+            target = self.engine if self.router is None else self.router
+            self.metrics_server = target.start_metrics_server(
+                **config._metrics_exporter)
+
+    def close(self, drain=True):
+        """Graceful shutdown: drain the scheduler (accepted requests
+        complete, new submits are shed with finish_reason "rejected")
+        and stop the background metrics exporter. drain=False skips the
+        wave loop for a hard stop. The engine's compiled programs need
+        no teardown."""
+        if self.router is not None:
+            if drain:
+                self.router.shutdown()
+            else:
+                self.router.stop_metrics_server()
+        elif drain:
+            self.scheduler.shutdown()
+        else:
+            self.engine.stop_metrics_server()
+        self.metrics_server = None
+
+    def generate(self, prompt, **kw):
+        kw.setdefault("eos_token_id", self._eos_token_id)
+        if self.router is not None:
+            return self.router.generate(prompt, **kw)
+        return self.scheduler.generate(prompt, **kw)
+
+    def submit(self, **kw):
+        kw.setdefault("eos_token_id", self._eos_token_id)
+        if self.router is not None:
+            return self.router.submit(**kw)
+        return self.scheduler.submit(**kw)
+
+    def run(self, **kw):
+        if self.router is not None:
+            return self.router.run(**kw)
+        return self.scheduler.run(**kw)
+
+    def health(self):
+        """Engine (or fleet) health payload — what /healthz serves."""
+        if self.router is not None:
+            return self.router.health()
+        return self.engine.health()
+
+    @property
+    def metrics(self):
+        if self.router is not None:
+            return self.router.metrics
+        return self.scheduler.metrics
+
+
+def _engine_factory(config, opts, model, draft_model):
+    """One closure building the Config-described engine — called once
+    for a single-engine predictor, once per replica for a fleet."""
+    from ..serving import (PagedServingEngine, ServingEngine,
+                           SpeculativePagedEngine)
+    if opts.get("speculative") and draft_model is None:
+        draft_cfg = opts.get("draft_config")
+        if draft_cfg is None:
+            raise ValueError(
+                "speculative serving needs a draft model: pass "
+                "draft_model= to create_llm_predictor or "
+                "draft_config= to enable_llm_engine")
+        # same family as the target: the configs carry the family, the
+        # model class carries the architecture. Built ONCE here so a
+        # fleet's replicas share one draft (digest-identical state).
+        draft_model = type(model)(draft_cfg)
+
+    def factory():
         if opts.get("speculative"):
-            if draft_model is None:
-                draft_cfg = opts.get("draft_config")
-                if draft_cfg is None:
-                    raise ValueError(
-                        "speculative serving needs a draft model: pass "
-                        "draft_model= to create_llm_predictor or "
-                        "draft_config= to enable_llm_engine")
-                # same family as the target: the configs carry the
-                # family, the model class carries the architecture
-                draft_model = type(model)(draft_cfg)
-            self.engine = SpeculativePagedEngine(
+            return SpeculativePagedEngine(
                 model, draft_model,
                 spec_k=opts.get("spec_k", 4),
                 num_slots=opts.get("num_slots", 4),
@@ -368,8 +497,8 @@ class LLMPredictor:
                 prefill_chunk_len=opts.get("prefill_len"),
                 paged_kernel=opts.get("paged_kernel"),
                 jit_compile=config.ir_optim())
-        elif opts.get("paged"):
-            self.engine = PagedServingEngine(
+        if opts.get("paged"):
+            return PagedServingEngine(
                 model,
                 num_slots=opts.get("num_slots", 4),
                 max_len=opts.get("max_len", 256),
@@ -378,46 +507,13 @@ class LLMPredictor:
                 prefill_chunk_len=opts.get("prefill_len"),
                 paged_kernel=opts.get("paged_kernel"),
                 jit_compile=config.ir_optim())
-        else:
-            self.engine = ServingEngine(
-                model,
-                num_slots=opts.get("num_slots", 4),
-                max_len=opts.get("max_len", 256),
-                prefill_len=opts.get("prefill_len"),
-                jit_compile=config.ir_optim())
-        self.scheduler = Scheduler(self.engine,
-                                   max_queue=opts.get("max_queue"))
-        self.metrics_server = None
-        if config.metrics_exporter_enabled():
-            self.metrics_server = self.engine.start_metrics_server(
-                **config._metrics_exporter)
-
-    def close(self, drain=True):
-        """Graceful shutdown: drain the scheduler (accepted requests
-        complete, new submits are shed with finish_reason "rejected")
-        and stop the background metrics exporter. drain=False skips the
-        wave loop for a hard stop. The engine's compiled programs need
-        no teardown."""
-        if drain:
-            self.scheduler.shutdown()
-        else:
-            self.engine.stop_metrics_server()
-        self.metrics_server = None
-
-    def generate(self, prompt, **kw):
-        kw.setdefault("eos_token_id", self._eos_token_id)
-        return self.scheduler.generate(prompt, **kw)
-
-    def submit(self, **kw):
-        kw.setdefault("eos_token_id", self._eos_token_id)
-        return self.scheduler.submit(**kw)
-
-    def run(self, **kw):
-        return self.scheduler.run(**kw)
-
-    @property
-    def metrics(self):
-        return self.scheduler.metrics
+        return ServingEngine(
+            model,
+            num_slots=opts.get("num_slots", 4),
+            max_len=opts.get("max_len", 256),
+            prefill_len=opts.get("prefill_len"),
+            jit_compile=config.ir_optim())
+    return factory
 
 
 def create_llm_predictor(config, model=None, draft_model=None):

@@ -34,12 +34,14 @@ from .scheduler import Scheduler
 from .request import Request, RequestState
 from .metrics import ServingMetrics
 from .slo import SLOEngine, SLOPolicy
-from .paged import (BlockPool, BlockPoolExhausted, PagedServingEngine,
-                    SpeculativePagedEngine)
-from .fleet import FleetRequest, FleetRouter
+from .paged import (BlockPool, BlockPoolExhausted, HandoffRefused,
+                    PagedServingEngine, SpeculativePagedEngine)
+from .fleet import (DisaggFleetRouter, FleetRequest, FleetRouter,
+                    QoSManager, Tenant)
 
 __all__ = ["ServingEngine", "Scheduler", "Request", "RequestState",
            "ServingMetrics", "SLOEngine", "SLOPolicy",
-           "BlockPool", "BlockPoolExhausted",
+           "BlockPool", "BlockPoolExhausted", "HandoffRefused",
            "PagedServingEngine", "SpeculativePagedEngine",
-           "FleetRouter", "FleetRequest"]
+           "FleetRouter", "FleetRequest", "DisaggFleetRouter",
+           "QoSManager", "Tenant"]

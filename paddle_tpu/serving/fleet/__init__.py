@@ -21,11 +21,13 @@ a killed or degraded replica's in-flight requests are resubmitted
 and the rotation grows/shrinks against live queue-depth telemetry with
 digest-verified warm starts. See docs/serving.md "Serving fleet".
 """
+from .disagg import DisaggFleetRouter
 from .metrics import FleetMetrics, FleetRegistry
 from .migration import FleetRequest
+from .qos import QoSManager, Tenant
 from .replica import Replica, ReplicaSupervisor, state_digest
 from .router import FleetRouter
 
-__all__ = ["FleetRouter", "FleetRequest", "FleetMetrics",
-           "FleetRegistry", "Replica", "ReplicaSupervisor",
-           "state_digest"]
+__all__ = ["FleetRouter", "DisaggFleetRouter", "FleetRequest",
+           "FleetMetrics", "FleetRegistry", "QoSManager", "Tenant",
+           "Replica", "ReplicaSupervisor", "state_digest"]

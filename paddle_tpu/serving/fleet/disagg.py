@@ -1,0 +1,186 @@
+"""Disaggregated prefill/decode fleet: role-specialized replicas with
+block-level KV handoff.
+
+A unified replica interleaves chunked prefill with decode waves, so a
+long prompt's admission steals rounds from every decoding lane on that
+replica. Disaggregation splits the fleet by ROLE: prefill replicas run
+ONLY the chunked-prefill program (their decode program is never even
+compiled — jit is lazy and a pure-prefill replica never dispatches a
+wave), decode replicas run ONLY decode waves, and the seam between them
+is a **block-level KV transfer**, not recompute: the prefill replica
+exports its populated per-layer KV blocks (digest-sealed —
+`PagedServingEngine.export_slot_kv`), the router hands the payload to a
+decode replica, and that replica's admission imports the blocks and
+arms the slot directly (`import_handoff`). A handoff therefore costs
+bytes proportional to the prompt's K/V, never a second prefill — the
+FusionStitching principle (memory movement, not compute, is the cost to
+engineer) applied at fleet scale, and the decode-side compile/program
+count proves it: a handed-off request runs ZERO prefill-chunk programs
+on the decode replica.
+
+The handoff rides the token-exact migration machinery (migration.py):
+the prefill hop's first token is absorbed into the fleet request's
+stitched stream, the continuation (prompt + first token) dispatches
+with the payload attached, and the decode replica's slot arms at
+exactly the position/token a single-replica run would hold — greedy
+output is bitwise-identical. A payload that fails its digest check is
+REFUSED (the request fails, request-isolated: decoding over corrupt
+K/V would silently produce wrong tokens), and a failed export falls
+back to plain migration-by-recompute, budget-bounded.
+
+Multi-tenant QoS (qos.py) layers on top: the shared QoSManager rides
+every replica's scheduler (weighted-fair admission under pool
+pressure, priority-chosen preemption victims), tenant priorities
+resolve at fleet admission, and per-tenant SLO windows are fed from
+fleet-level finalizations.
+"""
+from ...utils import telemetry
+from ..scheduler import ROLES  # noqa: F401  (re-exported convenience)
+from .migration import FleetRequest
+from .qos import as_manager
+from .router import FleetRouter
+
+
+class DisaggFleetRouter(FleetRouter):
+    """FleetRouter over a role-specialized rotation.
+
+    engine_factory: as FleetRouter — every replica (either role) is
+        built from the same factory and digest-verified; role is a
+        SCHEDULING specialization, not a different binary.
+    prefill_replicas / decode_replicas / unified_replicas: the initial
+        role mix. At least one prefill-capable (prefill or unified) and
+        one decode-capable replica are required, or work could be
+        accepted that no replica can ever finish.
+    qos: a QoSManager, or an iterable of Tenants (qos.py). Shared by
+        every replica's scheduler; None = single-tenant behavior.
+    Remaining kwargs as FleetRouter (policy, migrate, slo, ...).
+    """
+
+    def __init__(self, engine_factory, prefill_replicas=1,
+                 decode_replicas=1, unified_replicas=0, qos=None,
+                 scheduler_kwargs=None, **kw):
+        roles = (["prefill"] * int(prefill_replicas)
+                 + ["decode"] * int(decode_replicas)
+                 + ["unified"] * int(unified_replicas))
+        if not any(r in ("prefill", "unified") for r in roles):
+            raise ValueError("fleet needs at least one prefill-capable "
+                             "replica (prefill or unified)")
+        if not any(r in ("decode", "unified") for r in roles):
+            raise ValueError("fleet needs at least one decode-capable "
+                             "replica (decode or unified)")
+        self.qos = as_manager(qos)
+        scheduler_kwargs = dict(scheduler_kwargs or {})
+        if self.qos is not None:
+            # ONE manager across the rotation: weights and SLO windows
+            # are fleet-global even though each scheduler computes its
+            # own in-flight census
+            scheduler_kwargs.setdefault("qos", self.qos)
+        super().__init__(engine_factory, replicas=len(roles),
+                         roles=roles, scheduler_kwargs=scheduler_kwargs,
+                         **kw)
+
+    # ---------------------------------------------------------- admission
+    def submit(self, request=None, **kw):
+        fr = request if request is not None else FleetRequest(**kw)
+        if fr.priority is None and self.qos is not None:
+            # tenant rank resolves ONCE, at fleet admission, and then
+            # rides _submit_kwargs through every hop
+            fr.priority = self.qos.priority(fr.tenant)
+        return super().submit(request=fr)
+
+    # ---------------------------------------------------------- the loop
+    def step(self):
+        """One fleet round, plus the disaggregation seam: pick up every
+        prefill replica's completed handoffs and dispatch them to
+        decode replicas, then refresh the per-tenant SLO windows."""
+        super().step()
+        self._pickup_handoffs()
+        if self.qos is not None:
+            self.qos.evaluate()
+        return self.outstanding()
+
+    def _pickup_handoffs(self):
+        with self._step_lock:
+            for replica in self._rotation():
+                if replica.state == "dead":
+                    continue
+                take = getattr(replica.scheduler, "take_handoffs", None)
+                if take is None:
+                    continue
+                for req, payload in take():
+                    fr = self._owner_of(req)
+                    if fr is None:
+                        continue     # finalized concurrently (timeout)
+                    if payload is None:
+                        # export failed: fall back to recompute — the
+                        # classic migration path, budget-bounded
+                        self._migrate(fr, reason="handoff export failed",
+                                      src=replica)
+                    else:
+                        self._handoff(fr, payload, src=replica)
+
+    def _owner_of(self, req):
+        with self._lock:
+            for fr in self._live:
+                if fr.current is req:
+                    return fr
+        return None
+
+    def _handoff(self, fr, payload, src):
+        """Move one prefilled request to a decode replica carrying its
+        KV payload. Mirrors _migrate's absorb-and-redispatch shape but
+        does NOT spend the migration budget — a handoff is the planned
+        fast path, not a fault recovery — and the continuation imports
+        blocks instead of re-prefilling."""
+        with self._lock:
+            if fr.replica is not src:
+                return               # hop already failed over elsewhere
+            src_id = src.replica_id
+            fr._absorb()             # bank the prefill's first token
+        if len(fr._prior) >= fr.max_tokens:
+            self._finalize_one(fr, forced=("max_tokens", None))
+            return
+        if self._continuation_refused(fr.prompt + fr._prior) is not None:
+            self._finalize_one(fr, forced=("length", None))
+            return
+        telemetry.trace_flow_step(
+            fr.trace_id, "HANDOFF", src=src_id,
+            blocks=len(payload["manifest"]), nbytes=payload["nbytes"],
+            tokens_so_far=len(fr._prior))
+        fr._handoff_payload = payload
+        try:
+            self._dispatch(fr, continuation=True)
+        finally:
+            # one-shot: whatever happened, a LATER redispatch (e.g. a
+            # migration after the decode replica dies) must replay by
+            # recompute — the payload's blocks belong to the hop that
+            # imported them (or to nobody, if dispatch failed)
+            fr._handoff_payload = None
+        if fr.replica is not None:
+            self.metrics.on_handoff(
+                request_id=fr.request_id, src=src_id,
+                dst=fr.replica.replica_id,
+                blocks=len(payload["manifest"]),
+                nbytes=payload["nbytes"])
+        else:                        # total refusal: _dispatch resolved it
+            with self._lock:
+                if fr in self._live:
+                    self._live.remove(fr)
+
+    # -------------------------------------------------------- completions
+    def _observe_slo(self, fr):
+        super()._observe_slo(fr)
+        if self.qos is not None:
+            self.qos.observe(fr)
+
+    # ------------------------------------------------------------- admin
+    def health(self):
+        out = super().health()
+        roles = {"prefill": 0, "decode": 0, "unified": 0}
+        for r in out["replicas"]:
+            roles[r.get("role", "unified")] = \
+                roles.get(r.get("role", "unified"), 0) + 1
+        out["roles"] = roles
+        if self.qos is not None:
+            out["tenants"] = self.qos.summary()
+        return out

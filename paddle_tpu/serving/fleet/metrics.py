@@ -34,6 +34,16 @@ _DISPATCH_RETRIES = telemetry.counter(
     "Dispatch attempts rerouted to the next candidate replica after a "
     "dispatch fault or a replica-side rejection — an accepted request "
     "is never lost to a single bad hand-off")
+_ROLES = telemetry.gauge(
+    "fleet_replica_role", "Replicas in the rotation by disaggregation "
+    "role (prefill / decode / unified)", labelnames=("role",))
+_HANDOFF_BLOCKS = telemetry.counter(
+    "fleet_handoff_blocks_total",
+    "KV blocks shipped prefill->decode via the block-level handoff "
+    "path (digest-verified; the bytes-not-recompute transfer)")
+_HANDOFF_BYTES = telemetry.counter(
+    "fleet_handoff_bytes_total",
+    "Device bytes shipped in block-level KV handoff payloads")
 
 
 class FleetMetrics:
@@ -51,6 +61,9 @@ class FleetMetrics:
         self._kills = 0
         self._scale_ups = 0
         self._scale_downs = 0
+        self._handoffs = 0
+        self._handoff_blocks = 0
+        self._handoff_bytes = 0
 
     # ---------------------------------------------------------- recording
     def on_routed(self, policy):
@@ -67,6 +80,26 @@ class FleetMetrics:
             rec.fault(kind="replica_migration", action="resubmitted",
                       request_id=request_id,
                       error=f"replica {src} -> {dst}")
+
+    def on_handoff(self, request_id=None, src=None, dst=None, blocks=0,
+                   nbytes=0):
+        """One block-level prefill->decode KV handoff dispatched. The
+        journal event's kind is distinct from replica_migration so the
+        runlog's fleet table can count bytes-moved handoffs separately
+        from recompute migrations."""
+        _HANDOFF_BLOCKS.inc(blocks)
+        _HANDOFF_BYTES.inc(nbytes)
+        with self._lock:
+            self._handoffs += 1
+            self._handoff_blocks += blocks
+            self._handoff_bytes += nbytes
+        rec = flight_recorder.get_recorder()
+        if rec is not None:
+            rec.fault(kind="replica_handoff", action="resubmitted",
+                      request_id=request_id,
+                      error=f"replica {src} -> {dst} "
+                            f"({blocks} blocks, {nbytes} bytes)",
+                      blocks=int(blocks), nbytes=int(nbytes))
 
     def on_restart(self):
         _RESTARTS.inc()
@@ -106,10 +139,15 @@ class FleetMetrics:
         the rotation alone could never show a nonzero dead bucket)."""
         counts = {"ok": 0, "degraded": 0, "draining": 0,
                   "dead": dead_total}
+        roles = {"prefill": 0, "decode": 0, "unified": 0}
         for r in replicas:
             counts[r.state] = counts.get(r.state, 0) + 1
+            role = getattr(r, "role", "unified")
+            roles[role] = roles.get(role, 0) + 1
         for state, n in counts.items():
             _REPLICAS.labels(state=state).set(n)
+        for role, n in roles.items():
+            _ROLES.labels(role=role).set(n)
 
     # ---------------------------------------------------------- reporting
     def snapshot(self):
@@ -124,6 +162,9 @@ class FleetMetrics:
                 "affinity_hit_rate": (routed.get("affinity", 0) / total
                                       if total else None),
                 "migrations": self._migrations,
+                "handoffs": self._handoffs,
+                "handoff_blocks": self._handoff_blocks,
+                "handoff_bytes": self._handoff_bytes,
                 "rejected": self._rejected,
                 "replica_kills": self._kills,
                 "replica_restarts": self._restarts,

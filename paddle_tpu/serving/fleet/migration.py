@@ -47,7 +47,8 @@ class FleetRequest:
     def __init__(self, prompt, max_tokens=16, eos_token_id=None,
                  timeout=None, on_token=None, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0,
-                 stop_sequences=None, logit_bias=None, token_mask=None):
+                 stop_sequences=None, logit_bias=None, token_mask=None,
+                 tenant="default", priority=None):
         self.request_id = next(FleetRequest._ids)
         # ONE trace id for the life of the request: every hop's Request
         # inherits it (_submit_kwargs), so the spans a migration leaves
@@ -68,6 +69,17 @@ class FleetRequest:
         self.stop_sequences = stop_sequences
         self.logit_bias = logit_bias
         self.token_mask = token_mask
+        # QoS identity, carried for the LIFE of the request: every hop's
+        # _submit_kwargs forwards both, so a migration or handoff can
+        # never silently demote a premium request to the default cohort
+        # (the PR 15 sampling-params discipline). priority=None defers
+        # to the tenant's configured rank at fleet admission (qos.py).
+        self.tenant = str(tenant)
+        self.priority = priority
+        # a block-level KV payload staged by a prefill-role replica:
+        # consumed by the NEXT dispatch (the decode hop's admission
+        # imports it instead of re-running prefill), then cleared
+        self._handoff_payload = None
 
         self.submit_time = None      # stamped once, at fleet admission
         self.migrations = 0
@@ -185,7 +197,14 @@ class FleetRequest:
             # spans carry the SAME fleet trace id, so the halves of a
             # migrated request link instead of starting a fresh trace
             "trace_id": self.trace_id,
+            # QoS identity rides EVERY hop (tenant attainment and
+            # priority preemption would silently break across a
+            # migration or handoff otherwise)
+            "tenant": self.tenant,
+            "priority": 0 if self.priority is None else int(self.priority),
         }
+        if self._handoff_payload is not None:
+            kw["handoff"] = self._handoff_payload
         if self.on_token is not None:
             fleet_req = self
 

@@ -1,0 +1,231 @@
+"""Multi-tenant QoS: per-tenant SLO cohorts, weighted-fair admission,
+and preemption priorities for the serving fleet.
+
+One shared fleet serves many tenants, and pre-QoS everything was FCFS:
+a tenant saturating the queue under block-pool pressure starves every
+other tenant's admissions, and preemption-by-recompute evicts whichever
+lane happened to starve — a premium request pays for a bulk tenant's
+appetite. This module gives each tenant three levers:
+
+  * **weight** — weighted-fair admission under pool pressure: the
+    scheduler picks the queued request whose tenant has the least
+    weighted in-flight cost (`in_flight / weight`), FCFS within a
+    tenant. Off pressure, admission stays strict FCFS (weights change
+    who waits when blocks are scarce, not the happy path).
+  * **priority** — preemption rank: a starved lane evicts the lowest-
+    priority active lane STRICTLY below it (scheduler
+    `_preemption_victim`) instead of always evicting itself, so bulk
+    work absorbs the recompute cost of pressure it created.
+  * **slo** — an `SLOPolicy` per latency tier: each tenant with a
+    policy gets its own burn-rate window (serving/slo.py), published as
+    `serving_tenant_*` gauges labeled by tenant, so "the premium tier
+    is in SLO" is a first-class, per-cohort verdict instead of a
+    fleet-wide average that a noisy neighbour can hide inside.
+
+The manager is duck-typed into the Scheduler (under_pressure /
+pick_admission) and driven by the disaggregated router (observe /
+evaluate) — a fleet without one behaves exactly as before (tenant
+"default", priority 0, FCFS).
+"""
+import threading
+
+from ...utils import flight_recorder, telemetry
+from ..slo import SLOEngine, SLOPolicy
+
+_TENANT_ATTAINMENT = telemetry.gauge(
+    "serving_tenant_attainment",
+    "Per-tenant SLO attainment over the sliding window (1.0 = every "
+    "request of this tenant met its cohort's targets)",
+    labelnames=("tenant",))
+_TENANT_BURN = telemetry.gauge(
+    "serving_tenant_burn_rate",
+    "Per-tenant error-budget burn rate (1.0 = burning exactly the "
+    "cohort's budget; see serving/slo.py)",
+    labelnames=("tenant",))
+_TENANT_REQUESTS = telemetry.counter(
+    "serving_tenant_requests_total",
+    "Requests finalized per tenant cohort (every finish reason)",
+    labelnames=("tenant",))
+
+#: the implicit cohort every request without a tenant bills against
+DEFAULT_TENANT = "default"
+
+
+class Tenant:
+    """One tenant cohort: a name, a fair-share weight (> 0), a
+    preemption priority (higher survives longer under pool pressure),
+    and optionally its own SLOPolicy (latency tier)."""
+
+    def __init__(self, name, weight=1.0, priority=0, slo=None):
+        self.name = str(name)
+        self.weight = float(weight)
+        if self.weight <= 0:
+            raise ValueError(f"tenant {name!r}: weight must be > 0, "
+                             f"got {weight}")
+        self.priority = int(priority)
+        if slo is not None and not isinstance(slo, SLOPolicy):
+            raise TypeError(f"tenant {name!r}: slo must be an SLOPolicy")
+        self.slo = slo
+
+    def describe(self):
+        d = {"name": self.name, "weight": self.weight,
+             "priority": self.priority}
+        if self.slo is not None:
+            d["slo"] = self.slo.describe()
+        return d
+
+    def __repr__(self):
+        return (f"Tenant({self.name!r}, weight={self.weight}, "
+                f"priority={self.priority}, "
+                f"slo={'yes' if self.slo else 'no'})")
+
+
+class QoSManager:
+    """The fleet's tenant registry + per-tenant SLO windows.
+
+    tenants: iterable of Tenant. A "default" tenant is implied (weight
+        1, priority 0, no SLO) unless configured explicitly — unknown
+        tenant names bill against it rather than erroring, so a
+        misconfigured client degrades to best-effort instead of 500s.
+    pressure_threshold: pool occupancy (used / usable) at which
+        weighted-fair admission replaces FCFS.
+
+    ONE manager is shared by every replica's scheduler in a fleet
+    (disagg.py passes it through scheduler_kwargs): in-flight counts
+    are per-replica (each scheduler computes its own), but tenant
+    identity, weights and the SLO windows are fleet-global.
+    """
+
+    def __init__(self, tenants=(), pressure_threshold=0.85):
+        self._lock = threading.Lock()
+        self.pressure_threshold = float(pressure_threshold)
+        self.tenants = {}
+        for t in tenants:
+            if t.name in self.tenants:
+                raise ValueError(f"duplicate tenant {t.name!r}")
+            self.tenants[t.name] = t
+        self.tenants.setdefault(DEFAULT_TENANT, Tenant(DEFAULT_TENANT))
+        # a burn window per tenant that declared a latency tier
+        self._slo = {name: SLOEngine(t.slo)
+                     for name, t in self.tenants.items()
+                     if t.slo is not None}
+        self._breached = {name: False for name in self._slo}
+        self._requests = {name: 0 for name in self.tenants}
+
+    # ------------------------------------------------------------ lookups
+    def tenant(self, name):
+        """The cohort for `name` (unknown names fall back to the
+        default tenant — best-effort, never an error)."""
+        return self.tenants.get(str(name),
+                                self.tenants[DEFAULT_TENANT])
+
+    def priority(self, name):
+        return self.tenant(name).priority
+
+    def weight(self, name):
+        return self.tenant(name).weight
+
+    # -------------------------------------------------- admission fairness
+    def under_pressure(self, pool):
+        """True when the block pool is scarce enough that admission
+        order becomes a fairness decision (no pool = dense engine =
+        never)."""
+        if pool is None or pool.usable == 0:
+            return False
+        return pool.used / pool.usable >= self.pressure_threshold
+
+    def pick_admission(self, queued, in_flight_by_tenant):
+        """Index into `queued` of the next request to admit under
+        pressure: the FIRST queued request of the tenant with the least
+        weighted in-flight cost (in_flight / weight) — FCFS within a
+        tenant, weighted-fair across tenants. A tenant with nothing in
+        flight costs 0, so starvation is impossible: every tenant's
+        head request eventually has the cheapest cost."""
+        best_i, best_cost = 0, None
+        seen = set()
+        for i, req in enumerate(queued):
+            name = self.tenant(getattr(req, "tenant",
+                                       DEFAULT_TENANT)).name
+            if name in seen:
+                continue             # FCFS within the tenant
+            seen.add(name)
+            cost = (in_flight_by_tenant.get(name, 0)
+                    / self.tenant(name).weight)
+            if best_cost is None or cost < best_cost:
+                best_i, best_cost = i, cost
+        return best_i
+
+    # ------------------------------------------------------------- windows
+    def observe(self, request):
+        """Feed one FINALIZED request into its tenant's window (duck-
+        typed on .ttft/.tpot/.finish_reason like SLOEngine). Rejected
+        requests count toward the request tally but not the SLO window
+        — admission control shedding is not a served request."""
+        name = self.tenant(getattr(request, "tenant",
+                                   DEFAULT_TENANT)).name
+        _TENANT_REQUESTS.labels(tenant=name).inc()
+        with self._lock:
+            self._requests[name] = self._requests.get(name, 0) + 1
+        eng = self._slo.get(name)
+        if eng is not None and request.finish_reason != "rejected":
+            eng.observe_request(request)
+
+    def evaluate(self, publish=True):
+        """Per-tenant burn verdicts: {tenant: evaluate() dict}. With
+        publish, the tenant-labeled gauges update and breach
+        TRANSITIONS journal (`slo` events tagged with the tenant, the
+        runlog's per-tenant rows)."""
+        out = {}
+        for name, eng in self._slo.items():
+            verdict = eng.evaluate(publish=False)
+            out[name] = verdict
+            if not publish:
+                continue
+            _TENANT_BURN.labels(tenant=name).set(
+                round(verdict["burn_rate"], 4))
+            _TENANT_ATTAINMENT.labels(tenant=name).set(
+                round(verdict["attainment"], 4))
+            breached = bool(verdict["breached"])
+            with self._lock:
+                transition = breached != self._breached[name]
+                self._breached[name] = breached
+            if transition:
+                rec = flight_recorder.get_recorder()
+                if rec is not None:
+                    rec.slo(burn_rate=round(verdict["burn_rate"], 4),
+                            action=("burn_alert" if breached
+                                    else "burn_clear"),
+                            attainment=round(verdict["attainment"], 4),
+                            slo=verdict["worst"], tenant=name)
+        return out
+
+    # ------------------------------------------------------------ reporting
+    def summary(self):
+        """Per-tenant rollup for bench rows and health payloads:
+        config + request tally + the current window verdict (None for
+        tenants without an SLO tier)."""
+        verdicts = self.evaluate(publish=False)
+        with self._lock:
+            requests = dict(self._requests)
+        out = {}
+        for name, t in self.tenants.items():
+            v = verdicts.get(name)
+            out[name] = {
+                "weight": t.weight,
+                "priority": t.priority,
+                "requests": requests.get(name, 0),
+                "attainment": (None if v is None
+                               else round(v["attainment"], 4)),
+                "burn_rate": (None if v is None
+                              else round(v["burn_rate"], 4)),
+                "breached": None if v is None else bool(v["breached"]),
+            }
+        return out
+
+
+def as_manager(qos):
+    """Normalize the `qos=` surface: None / a prebuilt QoSManager pass
+    through; an iterable of Tenants builds one."""
+    if qos is None or isinstance(qos, QoSManager):
+        return qos
+    return QoSManager(tenants=qos)

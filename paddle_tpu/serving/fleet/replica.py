@@ -42,11 +42,17 @@ class Replica:
     killed replica's engine is never called again.
     """
 
-    def __init__(self, replica_id, engine, scheduler_kwargs=None):
+    def __init__(self, replica_id, engine, scheduler_kwargs=None,
+                 role="unified"):
         self.replica_id = int(replica_id)
         self.engine = engine
+        # disaggregated fleets (fleet/disagg.py) specialize replicas:
+        # "prefill" runs only chunked prefill and exports KV handoffs,
+        # "decode" imports them and only decodes, "unified" does both
+        self.role = str(role)
         self._scheduler_kwargs = dict(scheduler_kwargs or {})
-        self.scheduler = Scheduler(engine, **self._scheduler_kwargs)
+        self.scheduler = Scheduler(engine, role=self.role,
+                                   **self._scheduler_kwargs)
         # chrome-trace process row: the router's merged trace shows
         # each replica's request spans + scheduler slices on its own
         # pid row (0 stays the router/host row)
@@ -59,8 +65,17 @@ class Replica:
         valid idle: a replaced scheduler would strand accepted work."""
         if self.scheduler.in_flight() or self.scheduler.queue_depth():
             raise RuntimeError("renew_scheduler on a busy replica")
-        self.scheduler = Scheduler(self.engine, **self._scheduler_kwargs)
+        self.scheduler = Scheduler(self.engine, role=self.role,
+                                   **self._scheduler_kwargs)
         self.scheduler.trace_pid = self.replica_id + 1
+
+    def accepts(self, needs_prefill):
+        """Role gate for routing: a unified replica takes anything; a
+        prefill replica takes only fresh (prefill-needing) work; a
+        decode replica takes only block-level handoff continuations."""
+        if self.role == "unified":
+            return True
+        return self.role == ("prefill" if needs_prefill else "decode")
 
     @property
     def state(self):
@@ -88,6 +103,7 @@ class Replica:
         watches; an external LB reads the same dict over HTTP."""
         h = self.engine._health()
         h["replica_id"] = self.replica_id
+        h["role"] = self.role
         if self._killed:
             h["status"] = "dead"
         return h
@@ -148,10 +164,13 @@ class ReplicaSupervisor:
         spawned, so a killed replica's spans stay labeled."""
         return self._next_id
 
-    def spawn(self):
-        """Build one replica. The first spawn banks the fleet's
-        reference state digest; every later spawn must match it (warm
-        replacement serves the SAME weights or it does not serve)."""
+    def spawn(self, role="unified"):
+        """Build one replica (optionally role-specialized — the router
+        preserves a dead replica's role on replacement, so a killed
+        prefill replica respawns as prefill). The first spawn banks the
+        fleet's reference state digest; every later spawn must match it
+        (warm replacement serves the SAME weights or it does not
+        serve)."""
         engine = self.engine_factory()
         if self.verify_state:
             digest = state_digest(engine)
@@ -165,6 +184,7 @@ class ReplicaSupervisor:
                     "replacement replica must serve identical state "
                     "(token-exact migration depends on it)")
         replica = Replica(self._next_id, engine,
-                          scheduler_kwargs=self.scheduler_kwargs)
+                          scheduler_kwargs=self.scheduler_kwargs,
+                          role=role)
         self._next_id += 1
         return replica

@@ -73,12 +73,16 @@ class FleetRouter:
                  max_migrations=DEFAULT_MAX_MIGRATIONS,
                  min_replicas=None, max_replicas=None,
                  scale_up_queue_depth=None, scale_down_idle_rounds=8,
-                 auto_replace=True, verify_state=True, slo=None):
+                 auto_replace=True, verify_state=True, slo=None,
+                 roles=None):
         if policy not in POLICIES:
             raise ValueError(f"policy must be one of {POLICIES}, "
                              f"got {policy!r}")
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if roles is not None and len(roles) != replicas:
+            raise ValueError(f"roles ({len(roles)}) must name every "
+                             f"initial replica ({replicas})")
         self.policy = policy
         self.migrate = bool(migrate)
         self.max_migrations = int(max_migrations)
@@ -103,7 +107,9 @@ class FleetRouter:
         # also serialize an operator/watch-loop thread's kill against
         # the round in progress (finalization reads fr.current twice)
         self._step_lock = threading.RLock()
-        self.replicas = [self.supervisor.spawn() for _ in range(replicas)]
+        roles = roles or ["unified"] * replicas
+        self.replicas = [self.supervisor.spawn(role=role)
+                         for role in roles]
         self._live = []                      # unresolved FleetRequests
         self._retired_metric_snaps = []      # final snapshots of the dead
         self._dead_total = 0                 # replicas killed/degraded
@@ -141,13 +147,17 @@ class FleetRouter:
             raise
         return request
 
-    def _route(self, prompt):
+    def _route(self, prompt, needs_prefill=True):
         """Candidate replicas in preference order + the policy label
-        that placed the head choice. Affinity scores count the leading
-        full prompt blocks each replica's pool could serve from cache;
-        ties (and score 0) fall back to least-loaded."""
+        that placed the head choice. Role-specialized replicas
+        (disagg.py) filter first: fresh work routes only to prefill-
+        capable replicas, handoff continuations only to decode-capable
+        ones. Affinity scores count the leading full prompt blocks each
+        replica's pool could serve from cache; ties (and score 0) fall
+        back to least-loaded."""
         with self._lock:
-            live = [r for r in self.replicas if r.routable]
+            live = [r for r in self.replicas
+                    if r.routable and r.accepts(needs_prefill)]
             if self.policy == "round_robin" and live:
                 start = self._rr % len(live)   # read-modify-write under
                 self._rr += 1                  # the lock: submit() is
@@ -184,7 +194,8 @@ class FleetRouter:
         raises ValueError for fresh submits."""
         kw = fr._submit_kwargs()
         try:
-            candidates, policy = self._route(kw["prompt"])
+            candidates, policy = self._route(
+                kw["prompt"], needs_prefill=kw.get("handoff") is None)
         except RuntimeError as e:
             fr._finalize("error" if continuation else "rejected", error=e)
             self._observe_slo(fr)
@@ -316,14 +327,18 @@ class FleetRouter:
         rec = flight_recorder.get_recorder()
         if rec is not None:
             rec.fault(kind="replica_" + reason, action="replace",
-                      error=f"replica {replica.replica_id}")
+                      error=f"replica {replica.replica_id}",
+                      role=getattr(replica, "role", "unified"))
         if self.auto_replace:
             with self._lock:
                 short = sum(1 for r in self.replicas
                             if r.routable) < self._target
             if short:
                 try:
-                    self._spawn(restart=True)
+                    # role-preserving replacement: a dead prefill
+                    # replica respawns as prefill — a disaggregated
+                    # fleet's role mix survives failover
+                    self._spawn(restart=True, role=replica.role)
                 except Exception as e:  # noqa: BLE001 — failover must
                     # still migrate the dead replica's work even when
                     # the replacement cannot be built (digest mismatch,
@@ -439,8 +454,8 @@ class FleetRouter:
             self._finalize_one(fr)
 
     # ----------------------------------------------------------- scaling
-    def _spawn(self, restart=False):
-        replica = self.supervisor.spawn()
+    def _spawn(self, restart=False, role="unified"):
+        replica = self.supervisor.spawn(role=role)
         with self._lock:
             self.replicas.append(replica)
         if restart:

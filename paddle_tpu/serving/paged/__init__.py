@@ -14,7 +14,8 @@ docs/serving.md ("Paged KV cache").
     sched = Scheduler(engine)          # same scheduler, same Requests
 """
 from .block_pool import BlockPool, BlockPoolExhausted
-from .engine import PagedServingEngine, SpeculativePagedEngine
+from .engine import (HandoffRefused, PagedServingEngine,
+                     SpeculativePagedEngine)
 
-__all__ = ["BlockPool", "BlockPoolExhausted", "PagedServingEngine",
-           "SpeculativePagedEngine"]
+__all__ = ["BlockPool", "BlockPoolExhausted", "HandoffRefused",
+           "PagedServingEngine", "SpeculativePagedEngine"]

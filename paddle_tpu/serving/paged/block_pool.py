@@ -236,6 +236,32 @@ class BlockPool:
             out.append(h)
         return out
 
+    # --------------------------------------------------- block-level handoff
+    def export_blocks(self, blocks):
+        """Manifest for a block-level handoff: one entry per live block,
+        carrying its prefix-cache chain hash (None for unhashed blocks —
+        the partially-filled tail, or hashes another block won). The
+        DEVICE content rides separately (the engine's export_slot_kv);
+        this is the allocator-side half of the transfer: the importing
+        pool re-allocates from the manifest and re-registers the hashes
+        only after the content lands, preserving the never-share-an-
+        unwritten-block invariant across pools."""
+        for blk in blocks:
+            if blk == self.SCRATCH:
+                raise ValueError("scratch block cannot be exported")
+            if self._ref[blk] < 1:
+                raise ValueError(f"block {blk} is not live")
+        return [{"hash": self._block_hash.get(blk)} for blk in blocks]
+
+    def import_blocks(self, manifest):
+        """Allocate fresh local blocks to receive an exported manifest —
+        atomically (all or none; BlockPoolExhausted is CAPACITY, handled
+        upstream exactly like an admission under pool pressure). Returns
+        the new block ids in manifest order. Hashes are NOT registered
+        here: the caller registers them via register_hash only after the
+        device content is actually written into the new blocks."""
+        return self.alloc(len(manifest))
+
     def register_hash(self, block, chain_hash):
         """Enter a WRITTEN full prompt block into the prefix cache. A
         hash already mapping to another live block keeps the existing

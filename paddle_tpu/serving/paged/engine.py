@@ -36,11 +36,41 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import hashlib
+
 from ...nn import paged_attention
 from ...utils import chaos, telemetry
 from ..engine import (ServingEngine, _filter_top_k_top_p, _raw,
                       _select_first_token, _select_wave_tokens)
 from .block_pool import BlockPool, BlockPoolExhausted
+
+#: block-level KV handoff payload schema version (export_slot_kv /
+#: import_handoff) — bumped when the payload layout changes so a
+#: mixed-version fleet refuses the transfer instead of mis-scattering
+HANDOFF_VERSION = 1
+
+
+class HandoffRefused(RuntimeError):
+    """A block-level KV handoff payload failed verification (digest
+    mismatch, incompatible pool geometry, or a version skew). This is a
+    REQUEST fault, never capacity: the importing scheduler fails only
+    the handed-off request — decoding over corrupt or misaligned K/V
+    would silently produce wrong tokens, which is strictly worse than
+    an error (the PR 10/11 digest-verified-state discipline)."""
+
+
+def _handoff_digest(layers, n_tokens, block_size):
+    """sha256 over the payload's device content + the geometry that
+    gives it meaning — the serving analog of the checkpoint manifest's
+    per-file digests (and of the replica supervisor's weight digest):
+    the importing engine verifies bytes, not trust."""
+    h = hashlib.sha256()
+    h.update(f"v{HANDOFF_VERSION}:{n_tokens}:{block_size}".encode())
+    for arr in layers:
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 class PagedServingEngine(ServingEngine):
@@ -86,6 +116,8 @@ class PagedServingEngine(ServingEngine):
         self.prefix_sharing = bool(prefix_sharing)
         self.block_pool = BlockPool(num_blocks, self.block_size)
         self._copy_fn = None
+        self._handoff_gather_fn = None
+        self._handoff_scatter_fn = None
         super().__init__(model, num_slots=num_slots, max_len=max_len,
                          prefill_len=self.prefill_chunk_len,
                          cache_dtype=cache_dtype, jit_compile=jit_compile,
@@ -294,6 +326,148 @@ class PagedServingEngine(ServingEngine):
             first = self.prefill_step(slot)
             if first is not None:
                 return first
+
+    # -------------------------------------------------- block-level handoff
+    def export_slot_kv(self, slot):
+        """Package a prefilled slot's populated KV blocks for a
+        block-level handoff to another replica: the allocator manifest
+        (BlockPool.export_blocks) plus the per-layer device content
+        gathered at the slot's block ids, digest-sealed. The gather is
+        its own tiny program (compiled lazily, like the COW copy) —
+        tree-generic over the cache bundle, so the speculative engine's
+        (target, draft) pools ride the same path with no override.
+
+        The slot itself is left untouched: the caller retires it (which
+        frees the blocks but keeps their prefix hashes) only once the
+        payload is safely in hand."""
+        if not self.slot_active[slot]:
+            raise RuntimeError(f"slot {slot} is not active "
+                               "(handoff export needs a completed prefill)")
+        if slot in self._pending_prefill:
+            raise RuntimeError(f"slot {slot} is mid-prefill")
+        blocks = list(self._slot_blocks[slot])
+        manifest = self.block_pool.export_blocks(blocks)
+        if self._handoff_gather_fn is None:
+            def gather_fn(caches, idx):
+                return [leaf[idx]
+                        for leaf in jax.tree_util.tree_leaves(caches)]
+            self._handoff_gather_fn = (telemetry.instrument_jit(
+                jax.jit(gather_fn), "paged_handoff_gather")
+                if self._jit else gather_fn)
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        layers = [np.asarray(x)
+                  for x in self._handoff_gather_fn(self._caches, idx)]
+        n = int(self.slot_pos[slot])
+        return {
+            "version": HANDOFF_VERSION,
+            "n_tokens": n,
+            "next_token": int(self.slot_tok[slot]),
+            "block_size": self.block_size,
+            "blocks": len(blocks),
+            "manifest": manifest,
+            "layers": layers,
+            "nbytes": sum(a.nbytes for a in layers),
+            "digest": _handoff_digest(layers, n, self.block_size),
+        }
+
+    def import_handoff(self, slot, prompt, payload, do_sample=False,
+                       temperature=1.0, top_k=0, top_p=1.0,
+                       logit_bias=None, dynamic_mask=False):
+        """Admit a request from an exported KV payload: verify the
+        digest and geometry (HandoffRefused = request fault — decoding
+        over corrupt or misaligned K/V would silently emit wrong
+        tokens), allocate local blocks (BlockPoolExhausted = capacity,
+        exactly an admission under pool pressure), scatter the content
+        in, and arm the slot as if the final prefill chunk had just run
+        here. `prompt` is the handed-off request's continuation
+        (original prompt + the first token the prefill side produced):
+        the slot arms at position len(prompt) - 1 holding prompt[-1],
+        and the next decode wave writes that token's K/V — bit-for-bit
+        the single-replica schedule. No prefill-chunk program runs (the
+        scatter is a separate lazy jit), which is the whole point:
+        a handoff costs bytes on the wire, not recompute."""
+        why = self.validate_prompt(prompt)
+        if why:
+            raise ValueError(why)
+        if self.slot_active[slot] or slot in self._pending_prefill:
+            raise RuntimeError(f"slot {slot} is busy")
+        prompt = [int(t) for t in prompt]
+        layers = list(payload.get("layers", ()))
+        if chaos.enabled():
+            # injected wire corruption: flip payload content out from
+            # under its digest (host-side copies; the exporter's arrays
+            # are untouched) — the digest check below MUST refuse it
+            if chaos.value(chaos.HANDOFF_IMPORT, slot=slot,
+                           blocks=payload.get("blocks")):
+                corrupt = np.array(layers[0])
+                corrupt.flat[0] += np.asarray(1, corrupt.dtype)
+                layers[0] = corrupt
+        if payload.get("version") != HANDOFF_VERSION:
+            raise HandoffRefused(
+                f"handoff version {payload.get('version')!r} != "
+                f"{HANDOFF_VERSION} (mixed-version fleet)")
+        if int(payload["block_size"]) != self.block_size:
+            raise HandoffRefused(
+                f"payload block_size {payload['block_size']} != pool "
+                f"block_size {self.block_size}")
+        n = int(payload["n_tokens"])
+        if n != len(prompt) - 1 or int(payload["next_token"]) != prompt[-1]:
+            raise HandoffRefused(
+                "payload token state does not match the continuation "
+                f"(payload n={n}, next={payload['next_token']}; "
+                f"continuation len={len(prompt)})")
+        nblk = len(payload["manifest"])
+        if nblk * self.block_size < n + 1 or nblk != payload.get("blocks"):
+            raise HandoffRefused(
+                f"{nblk} exported block(s) cannot back {n} tokens "
+                "plus the decode frontier")
+        leaves = jax.tree_util.tree_leaves(self._caches)
+        if len(layers) != len(leaves) or any(
+                a.shape != (nblk,) + l.shape[1:] or a.dtype != l.dtype
+                for a, l in zip(layers, leaves)):
+            raise HandoffRefused(
+                "payload layer layout does not match this engine's "
+                "cache bundle (engine-flavor or geometry mismatch)")
+        if _handoff_digest(layers, n, self.block_size) != payload["digest"]:
+            raise HandoffRefused(
+                "handoff digest mismatch: payload content is corrupt")
+        fresh = self.block_pool.import_blocks(payload["manifest"])
+        try:
+            if self._handoff_scatter_fn is None:
+                def scatter_fn(caches, idx, data):
+                    flat, treedef = jax.tree_util.tree_flatten(caches)
+                    return jax.tree_util.tree_unflatten(
+                        treedef,
+                        [leaf.at[idx].set(arr)
+                         for leaf, arr in zip(flat, data)])
+                self._handoff_scatter_fn = (telemetry.instrument_jit(
+                    jax.jit(scatter_fn, donate_argnums=(0,)),
+                    "paged_handoff_scatter")
+                    if self._jit else scatter_fn)
+            idx = jnp.asarray(np.asarray(fresh, np.int32))
+            self._caches = self._handoff_scatter_fn(self._caches, idx,
+                                                    layers)
+            self._slot_blocks[slot] = fresh
+            self._tables[slot, :] = 0
+            self._tables[slot, :len(fresh)] = fresh
+            if self.prefix_sharing:
+                # content is on the device NOW — full prompt blocks may
+                # enter the prefix cache (first writer wins), so the
+                # decode replica's follow-up admissions share them
+                for i, h in enumerate(self.block_pool.prompt_hashes(
+                        prompt[:n])[:len(fresh)]):
+                    self.block_pool.register_hash(fresh[i], h)
+        except BaseException:
+            self.block_pool.release(fresh)
+            self._slot_blocks[slot] = []
+            self._tables[slot, :] = 0
+            raise
+        first = prompt[-1]
+        self._arm_slot(slot, first, n,
+                       self._sampling_state(do_sample, temperature, top_k,
+                                            top_p, logit_bias,
+                                            dynamic_mask))
+        return first
 
     # ------------------------------------------------------------- waves
     def _prepare_wave(self, active_now):

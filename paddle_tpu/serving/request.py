@@ -55,6 +55,13 @@ class Request:
         Lanes with a dynamic mask decode one token per wave even on a
         speculative engine — drafting ahead of a mask that depends on
         unemitted tokens would break exactness.
+    tenant / priority: multi-tenant QoS cohort and preemption rank
+        (serving/fleet/qos.py); defaults bill the implicit "default"
+        tenant at priority 0, which reproduces pre-QoS behavior
+        exactly.
+    handoff: block-level KV payload from a prefill-role replica
+        (PagedServingEngine.export_slot_kv) — admission imports the
+        blocks instead of re-running prefill chunks.
     """
     _ids = iter(range(1, 1 << 62))
     _ids_lock = threading.Lock()
@@ -63,7 +70,8 @@ class Request:
                  timeout=None, on_token=None, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0,
                  stop_sequences=None, logit_bias=None, token_mask=None,
-                 stop_context=None, trace_id=None):
+                 stop_context=None, trace_id=None, tenant="default",
+                 priority=0, handoff=None):
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -98,6 +106,19 @@ class Request:
         self._stop_context = [int(t) for t in (stop_context or [])]
         self.logit_bias = logit_bias
         self.token_mask = token_mask
+        # multi-tenant QoS surface: the cohort this request bills
+        # against (weighted-fair admission under pool pressure, per-
+        # tenant SLO attainment) and its preemption priority — under
+        # block starvation the scheduler evicts the lowest-priority
+        # lane STRICTLY below the starved one, never a peer or better
+        self.tenant = str(tenant)
+        self.priority = int(priority)
+        # a block-level KV handoff payload (engine.export_slot_kv):
+        # admission imports the populated blocks instead of running
+        # prefill chunks; consumed one-shot at the first admission so
+        # any LATER re-admission (preemption, migration) replays
+        # normally from the prefix cache
+        self.handoff = handoff
 
         self.state = RequestState.QUEUED
         self.slot = None                 # engine slot while PREFILL/DECODE

@@ -64,10 +64,38 @@ from .request import Request, RequestState
 from .slo import as_engine as _slo_as_engine
 
 
+#: replica roles for the disaggregated fleet (serving/fleet/disagg.py):
+#: a "prefill" scheduler runs ONLY chunked-prefill programs — each
+#: completed prefill is exported as a block-level KV payload and parked
+#: for the router (take_handoffs) instead of decoding; a "decode"
+#: scheduler accepts ONLY handoff continuations (admission imports the
+#: blocks, zero prefill-chunk programs run); "unified" is the classic
+#: do-both replica.
+ROLES = ("prefill", "decode", "unified")
+
+
 class Scheduler:
     def __init__(self, engine, max_queue=None, completed_log=1024,
                  wave_retries=3, retry_backoff_s=0.05,
-                 prefill_fail_limit=None, max_preemptions=3, slo=None):
+                 prefill_fail_limit=None, max_preemptions=3, slo=None,
+                 role="unified", qos=None):
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        if role != "unified" and not hasattr(engine, "export_slot_kv"):
+            raise ValueError(
+                f"role {role!r} needs an engine with the block-level "
+                "handoff surface (export_slot_kv / import_handoff — "
+                "serving/paged)")
+        self.role = role
+        # optional multi-tenant QoS manager (serving/fleet/qos.py),
+        # duck-typed: under_pressure(pool) gates weighted-fair admission
+        # (pick_admission over the queue) — with qos=None the queue is
+        # strict FCFS, pre-QoS behavior exactly
+        self.qos = qos
+        # prefill-role staging area: (request, payload) pairs whose
+        # prefill completed this round, waiting for the router to hand
+        # them to a decode replica (payload None = export failed)
+        self._handoff_ready = []
         self.engine = engine
         self.max_queue = max_queue
         # chrome-trace process row for this scheduler's spans/requests
@@ -149,6 +177,17 @@ class Scheduler:
         and the engine/queue state is untouched."""
         if request is None:
             request = Request(**kw)
+        # role defense-in-depth: the fleet router filters candidates by
+        # role before dispatch, so these raise only on a direct misuse —
+        # without finalizing the request (the caller may route it to a
+        # capable replica instead)
+        if self.role == "decode" and request.handoff is None:
+            raise ValueError(
+                "decode-role replica accepts only block-level handoff "
+                "continuations (this request still needs prefill)")
+        if self.role == "prefill" and request.handoff is not None:
+            raise ValueError(
+                "prefill-role replica cannot import a handoff payload")
         why = self.engine.validate_prompt(request.prompt)
         if why is not None:
             self.metrics.on_reject()
@@ -179,8 +218,25 @@ class Scheduler:
             return len(self._queue)
 
     def _pop_next(self):
+        """Next request to admit: strict FCFS — except under block-pool
+        pressure with a QoS manager attached, where the pick is
+        weighted-fair across tenants (least weighted in-flight cost
+        first, FCFS within a tenant) so one saturating tenant cannot
+        monopolize every freed block while others queue behind it."""
         with self._lock:
-            req = self._queue.popleft() if self._queue else None
+            req, i = None, 0
+            if self._queue:
+                if self.qos is not None and len(self._queue) > 1 and \
+                        self.qos.under_pressure(
+                            getattr(self.engine, "block_pool", None)):
+                    counts = {}
+                    for r in self._slot_req:
+                        if r is not None:
+                            t = getattr(r, "tenant", "default")
+                            counts[t] = counts.get(t, 0) + 1
+                    i = self.qos.pick_admission(self._queue, counts)
+                req = self._queue[i]
+                del self._queue[i]
             depth = len(self._queue)
         self.metrics.on_queue_depth(depth)
         return req
@@ -259,14 +315,28 @@ class Scheduler:
                 self._complete(req)
                 continue
             slot = free[0]
+            handoff = getattr(req, "handoff", None)
             try:
-                self.engine.begin_prefill(
-                    slot, self._continuation(req),
-                    do_sample=req.do_sample,
-                    temperature=req.temperature,
-                    top_k=req.top_k, top_p=req.top_p,
-                    logit_bias=self._admission_bias(req),
-                    dynamic_mask=req.token_mask is not None)
+                if handoff is not None:
+                    # block-level handoff: import the prefill replica's
+                    # populated KV blocks and arm the slot directly —
+                    # ZERO prefill-chunk programs run here (the whole
+                    # point: a handoff costs bytes, not recompute)
+                    self.engine.import_handoff(
+                        slot, self._continuation(req), handoff,
+                        do_sample=req.do_sample,
+                        temperature=req.temperature,
+                        top_k=req.top_k, top_p=req.top_p,
+                        logit_bias=self._admission_bias(req),
+                        dynamic_mask=req.token_mask is not None)
+                else:
+                    self.engine.begin_prefill(
+                        slot, self._continuation(req),
+                        do_sample=req.do_sample,
+                        temperature=req.temperature,
+                        top_k=req.top_k, top_p=req.top_p,
+                        logit_bias=self._admission_bias(req),
+                        dynamic_mask=req.token_mask is not None)
             except BlockPoolExhausted as e:
                 if self.engine.active_slots() or \
                         self.engine.prefilling_slots():
@@ -293,9 +363,25 @@ class Scheduler:
                 # mutates no device state, so the slot stays free and
                 # every other lane is untouched
                 self.last_error = e
+                if handoff is not None:
+                    # a refused handoff (digest/geometry mismatch) is a
+                    # REQUEST fault — the payload is unusable, so fail
+                    # only this request; it never feeds the engine's
+                    # prefill-fail streak (the engine is healthy)
+                    self._fault("handoff_refused",
+                                action="request_failed", request=req,
+                                slot=slot, error=e)
+                    req.handoff = None
+                    req._fail(e)
+                    self._complete(req)
+                    continue
                 if self._prefill_fault(req, slot):
                     return
                 continue
+            # handoff consumed one-shot: a LATER re-admission of this
+            # request (preemption, migration) replays from the prefix
+            # cache like any other continuation
+            req.handoff = None
             req._cache_waiting = False         # wait episode (if any) over
             req._start_prefill(slot)
             # engine-internal progress (per-chunk prefill) correlates
@@ -363,7 +449,41 @@ class Scheduler:
             req._emit(first)
             self.metrics.on_token(time.monotonic(), prev_t=prev_t)
             self._maybe_retire(slot, first)
+            if self.role == "prefill" and self._slot_req[slot] is not None:
+                # prefill-role epilogue: this replica never decodes —
+                # package the populated KV blocks for a decode replica
+                self._export_handoff(slot)
         return False
+
+    def _export_handoff(self, slot):
+        """Export the slot's populated KV blocks (the prefill just
+        completed and emitted its first token) and park (request,
+        payload) for the router to hand to a decode replica; the slot
+        retires either way — freed blocks keep their prefix hashes, so
+        a failed export's fallback (migration-by-recompute, payload
+        None) still re-prefills mostly from cache."""
+        req = self._slot_req[slot]
+        payload = None
+        try:
+            payload = self.engine.export_slot_kv(slot)
+        except Exception as e:   # noqa: BLE001 — fault barrier: the
+            # router falls back to recompute, bounded by its budget
+            self.last_error = e
+            self._fault("handoff_error", action="export_failed",
+                        request=req, slot=slot, error=e)
+        self.engine.retire_slot(slot)
+        self._slot_req[slot] = None
+        with self._lock:
+            self._handoff_ready.append((req, payload))
+
+    def take_handoffs(self):
+        """Drain the prefill-role staging area: [(request, payload)]
+        pairs whose prefill completed (payload None = export failed;
+        the caller migrates by recompute instead)."""
+        with self._lock:
+            out = self._handoff_ready
+            self._handoff_ready = []
+        return out
 
     # ---------------------------------------------------------- wave loop
     def _maybe_retire(self, slot, last_token, check_length=True):
@@ -464,6 +584,12 @@ class Scheduler:
             self._slot_req[slot] = None
             req._fail(f"engine degraded: {self.last_error!r}")
             self._complete(req)
+        with self._lock:
+            parked = [req for req, _ in self._handoff_ready]
+            self._handoff_ready = []
+        for req in parked:
+            req._fail(f"engine degraded: {self.last_error!r}")
+            self._complete(req)
         while True:
             req = self._pop_next()
             if req is None:
@@ -492,8 +618,13 @@ class Scheduler:
                     self.last_error = "replica evacuated"
                 queued = list(self._queue)
                 self._queue.clear()
+                # handoffs parked but never picked up (the payload dies
+                # with the replica; the request migrates by recompute)
+                parked = [req for req, _ in self._handoff_ready]
+                self._handoff_ready = []
             out = [req for req in self._slot_req if req is not None]
             self._slot_req = [None] * self.engine.num_slots
+            out.extend(parked)
             out.extend(queued)
         self.metrics.on_queue_depth(0)
         return out
@@ -530,30 +661,70 @@ class Scheduler:
                 spec_depth=round(depth, 4), proposed=proposed,
                 accepted=accepted)
 
-    def _preempt_starved(self):
-        """Pool-exhausted lanes (the wave excluded them): preempt by
-        recompute — free the slot's blocks, requeue the request with
-        prompt + generated tokens (the freed blocks' prefix hashes make
-        the re-prefill mostly cache hits). A request past its preemption
-        budget, or one whose continuation could never fit the pool,
-        resolves "error" instead of livelocking."""
-        for slot in self.engine.last_starved_slots:
-            req = self._slot_req[slot]
-            self.engine.retire_slot(slot)      # frees the blocks
-            self._slot_req[slot] = None
-            req.preemptions += 1
-            cont = self._continuation(req)
-            why = self.engine.validate_prompt(cont)
-            if req.preemptions > self.max_preemptions or why is not None:
-                self._fault("cache_exhausted", action="request_failed",
-                            request=req, slot=slot)
-                req._fail(why or "KV cache exhausted: preemption budget "
-                                 f"spent ({req.preemptions}x)")
-                self._complete(req)
+    def _preemption_victim(self, starved_slot):
+        """Priority preemption: choose which lane recompute evicts to
+        unblock a starved one. Among the OTHER active lanes, pick the
+        lowest-priority one STRICTLY below the starved request's
+        priority (ties: latest-submitted goes, preserving FCFS within a
+        class). None when no lane ranks below — then the starved lane
+        itself is evicted, which at uniform priority (the default 0
+        everywhere) reproduces pre-QoS behavior exactly."""
+        starved_pri = getattr(self._slot_req[starved_slot], "priority", 0)
+        victim = None
+        for slot, req in enumerate(self._slot_req):
+            if req is None or slot == starved_slot or \
+                    not self.engine.slot_active[slot]:
                 continue
-            self._fault("cache_exhausted", action="preempted",
+            pri = getattr(req, "priority", 0)
+            if pri >= starved_pri:
+                continue
+            if victim is None:
+                victim = slot
+                continue
+            vreq = self._slot_req[victim]
+            vpri = getattr(vreq, "priority", 0)
+            if pri < vpri or (pri == vpri and (req.submit_time or 0) >
+                              (vreq.submit_time or 0)):
+                victim = slot
+        return victim
+
+    def _evict_for_recompute(self, slot):
+        """Preemption-by-recompute of one lane: free the slot's blocks,
+        requeue the request with prompt + generated tokens (the freed
+        blocks' prefix hashes make the re-prefill mostly cache hits). A
+        request past its preemption budget, or one whose continuation
+        could never fit the pool, resolves "error" instead of
+        livelocking."""
+        req = self._slot_req[slot]
+        self.engine.retire_slot(slot)          # frees the blocks
+        self._slot_req[slot] = None
+        req.preemptions += 1
+        cont = self._continuation(req)
+        why = self.engine.validate_prompt(cont)
+        if req.preemptions > self.max_preemptions or why is not None:
+            self._fault("cache_exhausted", action="request_failed",
                         request=req, slot=slot)
-            self._requeue_front(req)
+            req._fail(why or "KV cache exhausted: preemption budget "
+                             f"spent ({req.preemptions}x)")
+            self._complete(req)
+            return
+        self._fault("cache_exhausted", action="preempted",
+                    request=req, slot=slot)
+        self._requeue_front(req)
+
+    def _preempt_starved(self):
+        """Pool-exhausted lanes (the wave excluded them): evict a lane
+        by recompute so blocks free up. Which lane is a QoS decision —
+        a lower-priority lane below the starved request goes first
+        (_preemption_victim); otherwise the starved lane evicts itself
+        (and the victim path leaves it armed to retry allocation at the
+        next wave against the freed blocks)."""
+        for slot in self.engine.last_starved_slots:
+            if self._slot_req[slot] is None:
+                continue     # already evicted as another lane's victim
+                             # (or finished during this round's dispatch)
+            victim = self._preemption_victim(slot)
+            self._evict_for_recompute(slot if victim is None else victim)
 
     def _step_locked(self):
         if self._degraded:
@@ -591,7 +762,6 @@ class Scheduler:
                             request=req, slot=slot)
                 req._fail("non-finite logits in decode wave")
                 self._complete(req)
-            self._preempt_starved()
             now = time.monotonic()
             with RecordEvent("serving/host_dispatch",
                              pid=self.trace_pid) as ev:
@@ -613,6 +783,11 @@ class Scheduler:
                         if self._slot_req[slot] is None:
                             break
             self.metrics.on_phase("host_dispatch", ev.elapsed)
+            # AFTER the dispatch loop: a priority victim was in this
+            # wave — evicting it first would drop the token it just
+            # produced (starved lanes were never in `toks`, so they
+            # don't care about the ordering)
+            self._preempt_starved()
         pool = getattr(self.engine, "block_pool", None)
         if pool is not None and (active or prefilled):
             # pool sample per WORKING round (idle spins don't dilute the

@@ -103,11 +103,17 @@ REPLICA_KILL = "fleet.replica_kill"
 #: replica's scheduler — a dispatch crash must reroute to the next
 #: candidate, never lose the accepted request
 ROUTER_DISPATCH = "fleet.router_dispatch"
+#: payload (truthy): the block-level KV handoff import sees a CORRUPT
+#: payload — its digest check must refuse the transfer (the request
+#: fails request-isolated, never silently decodes over corrupt K/V);
+#: scripts/chaos_serving.py prefill_handoff_kill's `--inject
+#: corrupt-handoff` positive control arms this
+HANDOFF_IMPORT = "fleet.handoff_import"
 
 POINTS = (DECODE_WAVE, DECODE_WAVE_NAN, PREFILL, CALLBACK,
           CHECKPOINT_WRITE, CACHE_ALLOC, TRAIN_STEP, DATA_LOAD,
           COLLECTIVE, TRAIN_STATE, SHARD_STATE, REPLICA_KILL,
-          ROUTER_DISPATCH)
+          ROUTER_DISPATCH, HANDOFF_IMPORT)
 
 ACTIONS = ("raise", "delay", "payload")
 

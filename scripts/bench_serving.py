@@ -31,8 +31,9 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import paddle_tpu as pt
-from paddle_tpu.serving import (FleetRouter, PagedServingEngine,
-                                Scheduler, ServingEngine, SLOPolicy)
+from paddle_tpu.serving import (DisaggFleetRouter, FleetRouter,
+                                PagedServingEngine, Scheduler,
+                                ServingEngine, SLOPolicy, Tenant)
 from paddle_tpu.utils import profiler, telemetry
 
 t0 = time.time()
@@ -194,10 +195,14 @@ def fleet_snapshot(router, reqs, wall):
 
 
 def run_load_fleet(router, load_rps, n_requests, vocab, prompt_range,
-                   output_range, seed, shared_prefix=()):
+                   output_range, seed, shared_prefix=(),
+                   tenant_names=None):
     """Fleet analog of run_load: Poisson submits against the router
     from a producer thread while this thread drives every replica's
-    wave loop through router.step()."""
+    wave loop through router.step(). With tenant_names, each submit is
+    billed to a seed-deterministic tenant and the snapshot grows a
+    per-tenant latency table (the same arrival seed on a matched
+    baseline fleet bills the same prompts to the same tenants)."""
     rng = np.random.RandomState(seed)
     shared_prefix = list(shared_prefix)
     reqs, done_submitting = [], threading.Event()
@@ -207,9 +212,14 @@ def run_load_fleet(router, load_rps, n_requests, vocab, prompt_range,
             time.sleep(rng.exponential(1.0 / load_rps))
             p = shared_prefix + rng.randint(
                 0, vocab, (rng.randint(*prompt_range),)).tolist()
+            kw = {}
+            if tenant_names:
+                kw["tenant"] = tenant_names[rng.randint(
+                    len(tenant_names))]
             try:
                 reqs.append(router.submit(
-                    prompt=p, max_tokens=int(rng.randint(*output_range))))
+                    prompt=p, max_tokens=int(rng.randint(*output_range)),
+                    **kw))
             except ValueError:
                 pass        # shed fleet-wide — counted by the replicas
         done_submitting.set()
@@ -227,6 +237,22 @@ def run_load_fleet(router, load_rps, n_requests, vocab, prompt_range,
     wall = time.time() - t_start
     snap = fleet_snapshot(router, reqs, wall)
     snap["offered_load_rps"] = load_rps
+    if tenant_names:
+        per = {}
+        for name in tenant_names:
+            cohort = [r for r in reqs if r.tenant == name]
+            ttfts = [r.ttft for r in cohort if r.ttft is not None]
+            per[name] = {
+                "requests": len(cohort),
+                "completed": sum(1 for r in cohort
+                                 if r.finish_reason
+                                 not in ("error", "rejected")),
+                "ttft_p50_ms": (None if not ttfts else round(
+                    float(np.percentile(ttfts, 50)) * 1e3, 2)),
+                "ttft_p99_ms": (None if not ttfts else round(
+                    float(np.percentile(ttfts, 99)) * 1e3, 2)),
+            }
+        snap["tenants"] = per
     return snap
 
 
@@ -301,6 +327,27 @@ def main():
                          "baseline: with --shared-prefix, affinity "
                          "should show strictly higher prefix hits per "
                          "request)")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="serve through a disaggregated prefill/decode "
+                         "fleet (serving/fleet/disagg): role-pinned "
+                         "replicas with block-level KV handoff (implies "
+                         "--paged). Each load point first runs a matched "
+                         "UNIFIED fleet of the same total size with the "
+                         "same arrival seed; the disagg row reports "
+                         "handoff blocks/bytes and TTFT/tokens-per-s "
+                         "deltas against it")
+    ap.add_argument("--prefill-replicas", type=int, default=1,
+                    help="disaggregate: prefill-role replica count")
+    ap.add_argument("--decode-replicas", type=int, default=1,
+                    help="disaggregate: decode-role replica count")
+    ap.add_argument("--tenants", default=None,
+                    help="multi-tenant QoS spec 'name:weight:priority"
+                         "[,name:weight:priority...]' (e.g. "
+                         "'premium:4:10,bulk:1:0'): submits are billed "
+                         "to seed-deterministic tenants, every tenant "
+                         "gets the sweep's --slo-* targets as its SLO "
+                         "tier, and per-tenant attainment/TTFT tables "
+                         "ride each row")
     ap.add_argument("--max-replicas", type=int, default=None,
                     help="fleet: autoscale ceiling (default --replicas "
                          "= no scale-up)")
@@ -386,9 +433,63 @@ def main():
         raise SystemExit("--speculative measures against a matched "
                          "single-engine baseline; combine it with "
                          "--replicas in separate sweeps")
+    if args.disaggregate and args.replicas is not None:
+        raise SystemExit("--disaggregate sizes its fleet from "
+                         "--prefill-replicas/--decode-replicas; drop "
+                         "--replicas")
 
+    def make_tenants():
+        """One FRESH Tenant list per router (each router builds its own
+        QoSManager; weights/priorities parsed from --tenants, the
+        sweep's --slo-* targets applied as every tenant's tier)."""
+        if args.tenants is None:
+            return None
+        out = []
+        for entry in args.tenants.split(","):
+            parts = entry.strip().split(":")
+            if not parts[0]:
+                raise SystemExit(f"--tenants: bad entry {entry!r}")
+            out.append(Tenant(
+                parts[0],
+                weight=float(parts[1]) if len(parts) > 1 else 1.0,
+                priority=int(parts[2]) if len(parts) > 2 else 0,
+                slo=make_slo()))
+        return out
+
+    tenant_names = ([t.name for t in make_tenants() or []]
+                    or None)
     router = None
-    if args.replicas is not None:
+    unified_router = None
+    if args.disaggregate:
+        args.paged = True             # handoff ships KV *blocks*
+        n_total = args.prefill_replicas + args.decode_replicas
+        router = DisaggFleetRouter(
+            make_engine,
+            prefill_replicas=args.prefill_replicas,
+            decode_replicas=args.decode_replicas,
+            qos=make_tenants(),
+            policy=args.router_policy,
+            min_replicas=n_total, max_replicas=n_total,
+            scheduler_kwargs={"max_queue": args.max_queue,
+                              "max_preemptions": args.max_preemptions})
+        # the matched baseline: same total replica count, same tenancy,
+        # same arrival seed per load point — only the topology differs,
+        # so the disagg row's deltas isolate what disaggregation buys
+        unified_router = DisaggFleetRouter(
+            make_engine, prefill_replicas=0, decode_replicas=0,
+            unified_replicas=n_total,
+            qos=make_tenants(),
+            policy=args.router_policy,
+            min_replicas=n_total, max_replicas=n_total,
+            scheduler_kwargs={"max_queue": args.max_queue,
+                              "max_preemptions": args.max_preemptions})
+        engine = router.replicas[0].engine
+        log(f"disagg fleet up: {args.prefill_replicas} prefill + "
+            f"{args.decode_replicas} decode replicas, "
+            f"policy={args.router_policy}"
+            + (f", tenants={','.join(tenant_names)}"
+               if tenant_names else ""))
+    elif args.replicas is not None:
         router = FleetRouter(
             make_engine, replicas=args.replicas,
             policy=args.router_policy,
@@ -440,7 +541,16 @@ def main():
     if router is not None:
         for rep in router.replicas:
             Scheduler(rep.engine).generate([1, 2, 3], max_tokens=4)
+        if args.disaggregate:
+            # one request through the router itself so the handoff
+            # gather/scatter programs compile during warmup, not inside
+            # the first measured load point
+            router.generate(list(range(1, 5)), max_tokens=4)
         router.reset_metrics()        # warmup schedulers replaced too
+        if unified_router is not None:
+            for rep in unified_router.replicas:
+                Scheduler(rep.engine).generate([1, 2, 3], max_tokens=4)
+            unified_router.reset_metrics()
     else:
         sched = Scheduler(engine)
         sched.generate([1, 2, 3], max_tokens=4)
@@ -487,7 +597,11 @@ def main():
         if args.kernel:
             kind = (f"spec[k={args.spec_k},"
                     f"draft={args.draft_layers}L,{args.kernel}]")
-    if router is not None:
+    if args.disaggregate:
+        kind = (f"disagg[{args.prefill_replicas}p+"
+                f"{args.decode_replicas}d x{kind}:"
+                f"{args.router_policy}]")
+    elif router is not None:
         kind = (f"fleet[{args.replicas}x{kind}:"
                 f"{args.router_policy}]")
     for i, load in enumerate(float(x) for x in args.loads.split(",")):
@@ -512,13 +626,22 @@ def main():
                                  prompt_range=(4, args.prefill_len),
                                  output_range=(4, out_hi), seed=100 + i,
                                  shared_prefix=shared_prefix)
+        uni_snap = None
+        if unified_router is not None:
+            unified_router.reset_metrics()
+            uni_snap = run_load_fleet(
+                unified_router, load, args.requests, args.vocab,
+                prompt_range=(4, args.prefill_len),
+                output_range=(4, out_hi), seed=100 + i,
+                shared_prefix=shared_prefix, tenant_names=tenant_names)
         if router is not None:
             router.reset_metrics()           # fresh tallies per point
             snap = run_load_fleet(router, load, args.requests,
                                   args.vocab,
                                   prompt_range=(4, args.prefill_len),
                                   output_range=(4, out_hi), seed=100 + i,
-                                  shared_prefix=shared_prefix)
+                                  shared_prefix=shared_prefix,
+                                  tenant_names=tenant_names)
         else:
             # fresh metrics (and a fresh SLO window) per load point
             sched = Scheduler(engine, max_queue=args.max_queue,
@@ -687,7 +810,9 @@ def main():
             # two sweeps with different --router-policy
             rs = snap["router"]
             row["detail"].update({
-                "replicas": args.replicas,
+                "replicas": (args.prefill_replicas
+                             + args.decode_replicas
+                             if args.disaggregate else args.replicas),
                 "replicas_final": snap["replicas_final"],
                 "router_policy": args.router_policy,
                 "routed": rs["routed"],
@@ -702,6 +827,67 @@ def main():
                     None if snap["prefix_hits_per_request"] is None
                     else round(snap["prefix_hits_per_request"], 4)),
             })
+        if tenant_names and "tenants" in snap:
+            # per-tenant service level THIS load point: arrival-side
+            # TTFT percentiles from the request stream, window-side
+            # attainment/burn from the QoS manager (None without one)
+            tenants_detail = {name: dict(stats)
+                              for name, stats in snap["tenants"].items()}
+            qos = getattr(router, "qos", None)
+            if qos is not None:
+                for name, srow in qos.summary().items():
+                    if name in tenants_detail:
+                        tenants_detail[name].update(
+                            attainment=srow["attainment"],
+                            burn_rate=srow["burn_rate"],
+                            weight=srow["weight"],
+                            priority=srow["priority"])
+            row["detail"]["tenants"] = tenants_detail
+        if args.disaggregate:
+            # the disaggregation economics vs the matched unified fleet
+            # that ran first with the same arrival seed: handoffs moved
+            # BYTES (blocks gathered once, scattered once) instead of
+            # burning decode rounds on chunked re-prefill
+            def _ddelta(key, scale=1.0, nd=3):
+                a, b = snap.get(key), uni_snap.get(key)
+                return (None if a is None or b is None
+                        else round((a - b) * scale, nd))
+            row["detail"]["disagg"] = {
+                "prefill_replicas": args.prefill_replicas,
+                "decode_replicas": args.decode_replicas,
+                "handoffs": rs["handoffs"],
+                "handoff_blocks": rs["handoff_blocks"],
+                "handoff_bytes": rs["handoff_bytes"],
+                "tokens_per_s_delta": _ddelta("tokens_per_s", nd=1),
+                "ttft_p50_delta_ms": _ddelta("ttft_p50_s", 1e3, 2),
+                "ttft_p99_delta_ms": _ddelta("ttft_p99_s", 1e3, 2),
+                "tpot_p50_delta_ms": _ddelta("tpot_p50_s", 1e3, 3),
+            }
+            n_total = args.prefill_replicas + args.decode_replicas
+            uni_row = {
+                "metric": f"serving {args.family} fleet-unified "
+                          f"baseline tokens/s @{load:g}req/s "
+                          f"x{args.slots}slots",
+                "value": round(uni_snap["tokens_per_s"] or 0.0, 1),
+                "unit": "tokens/s",
+                "detail": {
+                    "replicas": n_total,
+                    "router_policy": args.router_policy,
+                    "ttft_p50_ms": round(
+                        (uni_snap["ttft_p50_s"] or 0) * 1e3, 2),
+                    "ttft_p99_ms": round(
+                        (uni_snap["ttft_p99_s"] or 0) * 1e3, 2),
+                    "tpot_p50_ms": round(
+                        (uni_snap.get("tpot_p50_s") or 0) * 1e3, 3),
+                    "offered_load_rps": load,
+                    "requests": uni_snap["n_requests"],
+                    "wall_s": round(uni_snap["wall_s"], 2),
+                },
+            }
+            if "tenants" in uni_snap:
+                uni_row["detail"]["tenants"] = uni_snap["tenants"]
+            rows.append(uni_row)
+            print(json.dumps(uni_row), flush=True)
         slo_eng = (router.slo_engine if router is not None
                    else sched.slo_engine)
         if slo_eng is not None:
@@ -764,6 +950,8 @@ def main():
     log(f"wrote {args.out}")
     if router is not None:
         router.shutdown()
+    if unified_router is not None:
+        unified_router.shutdown()
     engine.stop_metrics_server()
 
 

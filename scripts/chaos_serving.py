@@ -585,6 +585,148 @@ def scenario_router_dispatch(engine, inject):
     return v
 
 
+def scenario_prefill_handoff_kill(engine, inject):
+    """Disaggregated fleet under fire: the PREFILL replica is killed
+    mid-chunk. Requests still mid-prefill migrate to the role-preserving
+    replacement and every request finishes on the DECODE side via the
+    block-level KV handoff, token-identical to the single-engine run —
+    and the decode replica proves the bytes-not-recompute contract by
+    never compiling a prefill-chunk program at all (prefill_compiles ==
+    0), while the prefill side never compiles a decode wave.
+    --inject corrupt-handoff flips one element of the first handoff
+    payload's KV in flight: the digest check must REFUSE it, the
+    request resolves 'error', and the token-identity invariant fails."""
+    from paddle_tpu.serving import fleet
+    v = []
+    # two one-chunk prompts (hand off before the kill) + two two-chunk
+    # prompts (mid-prefill when the kill lands)
+    prompts = [np.random.RandomState(200 + i)
+               .randint(0, VOCAB, (n,)).tolist()
+               for i, n in enumerate((10, 12, PREFILL_LEN + 2,
+                                      PREFILL_LEN + 4))]
+    ref = _paged_reference(prompts)
+    router = fleet.DisaggFleetRouter(_paged_factory, prefill_replicas=1,
+                                     decode_replicas=1)
+    faults = [chaos.Fault(chaos.HANDOFF_IMPORT, action="payload",
+                          payload=True, times=(1,))] \
+        if inject == "corrupt-handoff" else \
+        [chaos.Fault(chaos.REPLICA_KILL, action="payload", payload=0,
+                     times=(2,))]
+    monkey = chaos.ChaosMonkey(faults)
+    with chaos.active(monkey):
+        reqs = [router.submit(prompt=p, max_tokens=MAX_TOKENS)
+                for p in prompts]
+        router.run()
+    snap = router.metrics.snapshot()
+    _check(v, monkey.fired, "injection never fired")
+    for i, r in enumerate(reqs):
+        _check(v, r.finish_reason == "max_tokens",
+               f"request {i} resolved {r.finish_reason!r} — a killed "
+               "prefill replica's work must finish via handoff")
+        _check(v, r.output_tokens == ref[i],
+               f"request {i} output diverged from the single-engine run "
+               "across the prefill->decode handoff")
+    _check(v, snap["handoffs"] >= len(prompts),
+           f"expected >= {len(prompts)} block-level handoffs, got "
+           f"{snap['handoffs']}")
+    _check(v, snap["handoff_blocks"] > 0 and snap["handoff_bytes"] > 0,
+           "fleet_handoff_{blocks,bytes}_total did not move")
+    _check(v, snap["replica_restarts"] == 1,
+           f"expected 1 role-preserving replacement, got "
+           f"{snap['replica_restarts']}")
+    roles = router.health()["roles"]
+    _check(v, roles.get("prefill") == 1 and roles.get("decode") == 1,
+           f"role mix not preserved across the kill: {roles}")
+    for rep in router.replicas:
+        if rep.role == "decode":
+            _check(v, rep.engine.prefill_compiles == 0,
+                   f"decode replica {rep.replica_id} compiled a prefill "
+                   "program — handoff replayed by recompute")
+            _check(v, rep.engine.decode_compiles <= 1,
+                   f"decode replica {rep.replica_id} decode wave "
+                   "recompiled under handoff load")
+        if rep.role == "prefill":
+            _check(v, rep.engine.decode_compiles == 0,
+                   f"prefill replica {rep.replica_id} compiled a decode "
+                   "wave — role specialization leaked")
+    router.shutdown()
+    return v
+
+
+def scenario_noisy_tenant(engine, inject):
+    """Multi-tenant QoS: a tenant saturating the fleet cannot push a
+    premium tenant out of SLO attainment. Six bulk requests flood a
+    2-slot replica before two premium requests arrive; weighted-fair
+    admission under pool pressure admits the premium cohort as soon as
+    slots free instead of behind the whole bulk backlog, premium output
+    stays token-identical, and the premium SLO window reads attainment
+    1.0. --inject no-qos runs the same load with the QoS manager
+    removed: strict FCFS finishes premium dead last and the
+    admitted-ahead invariant must fail."""
+    from paddle_tpu.serving import PagedServingEngine, SLOPolicy, fleet
+    from paddle_tpu.serving.fleet import QoSManager, Tenant
+    v = []
+
+    def tiny_factory():
+        # 2 slots + a 4-block pool; prompt(4) + 3 new tokens fit ONE
+        # block, so admission — not mid-decode growth — is the only
+        # pressure point and the run is deterministic
+        return PagedServingEngine(get_model(), num_slots=2,
+                                  max_len=MAX_LEN, block_size=8,
+                                  num_blocks=5,
+                                  prefill_chunk_len=PREFILL_LEN)
+
+    bulk_p = [np.random.RandomState(300 + i)
+              .randint(0, VOCAB, (4,)).tolist() for i in range(6)]
+    prem_p = [np.random.RandomState(400 + i)
+              .randint(0, VOCAB, (4,)).tolist() for i in range(2)]
+    ref = {tuple(p): Scheduler(tiny_factory()).generate(p, max_tokens=3)
+           for p in bulk_p + prem_p}
+    qos = None if inject == "no-qos" else QoSManager(
+        tenants=[Tenant("premium", weight=8.0, priority=10,
+                        slo=SLOPolicy(error_rate=0.01)),
+                 Tenant("bulk", weight=1.0, priority=0)],
+        # one staged 1-block lane out of 4 usable blocks already counts
+        # as pressure at this tiny scale, so the weighted-fair pick is
+        # exercised on every admission after the first
+        pressure_threshold=0.25)
+    router = fleet.DisaggFleetRouter(tiny_factory, prefill_replicas=0,
+                                     decode_replicas=0,
+                                     unified_replicas=1, qos=qos)
+    reqs = [(tenant, router.submit(prompt=p, max_tokens=3, tenant=tenant))
+            for tenant, p in ([("bulk", p) for p in bulk_p]
+                              + [("premium", p) for p in prem_p])]
+    order = []                   # tenant names in completion order
+    pending = list(reqs)
+    while router.step():
+        done = [(t, r) for t, r in pending if r.done]
+        pending = [(t, r) for t, r in pending if not r.done]
+        order.extend(t for t, _ in done)
+    order.extend(t for t, r in pending if r.done)
+    for tenant, r in reqs:
+        _check(v, r.finish_reason == "max_tokens",
+               f"{tenant} request resolved {r.finish_reason!r} — QoS "
+               "must starve nobody, premium or bulk")
+        _check(v, r.output_tokens == ref[tuple(r.prompt)],
+               f"{tenant} output diverged under tenant contention")
+    last_prem = max(i for i, t in enumerate(order) if t == "premium") \
+        if "premium" in order else len(order)
+    bulk_after = sum(1 for t in order[last_prem + 1:] if t == "bulk")
+    _check(v, bulk_after >= 2,
+           f"premium admitted behind the bulk backlog (only {bulk_after} "
+           "bulk completions after the last premium; weighted-fair "
+           "admission should have moved premium ahead)")
+    if qos is not None:
+        prem = qos.summary()["premium"]
+        _check(v, prem["requests"] == 2,
+               f"premium window saw {prem['requests']} requests, "
+               "expected 2")
+        _check(v, prem["attainment"] == 1.0 and not prem["breached"],
+               f"premium pushed out of SLO attainment: {prem}")
+    router.shutdown()
+    return v
+
+
 SCENARIOS = {
     "nan_slot": scenario_nan_slot,
     "wave_error": scenario_wave_error,
@@ -597,6 +739,8 @@ SCENARIOS = {
     "spec_rollback": scenario_spec_rollback,
     "replica_failover": scenario_replica_failover,
     "router_dispatch": scenario_router_dispatch,
+    "prefill_handoff_kill": scenario_prefill_handoff_kill,
+    "noisy_tenant": scenario_noisy_tenant,
     "ckpt_crash": scenario_ckpt_crash,
 }
 
@@ -605,7 +749,9 @@ SCENARIOS = {
 INJECTIONS = {"drop-isolation": "nan_slot", "no-retry": "wave_error",
               "alloc-crash": "cache_exhaustion",
               "no-migration": "replica_failover",
-              "no-rollback": "spec_rollback"}
+              "no-rollback": "spec_rollback",
+              "corrupt-handoff": "prefill_handoff_kill",
+              "no-qos": "noisy_tenant"}
 
 
 def run(argv=None):

@@ -166,18 +166,52 @@ def summarize(events):
 
     # fleet: the router's replica_* fault kinds + the SLO engine's
     # burn-rate journal (serving/slo.py) — one table shows what the
-    # fleet did to replicas and why the autoscaler moved
+    # fleet did to replicas and why the autoscaler moved. Kill/degrade
+    # events carry the victim's disaggregation role; replica_handoff
+    # events carry structured block/byte counts; tenant-tagged slo
+    # events (fleet/qos.py) fold into per-tenant rows
     slo_events = [e for e in events if e.get("ev") == "slo"]
     replica_kinds = {k: v for k, v in faults_by_kind.items()
                      if k.startswith("replica_")}
     fleet = None
     if replica_kinds or slo_events:
-        burns = [_num(e.get("burn_rate")) for e in slo_events]
+        burns = [_num(e.get("burn_rate")) for e in slo_events
+                 if "tenant" not in e]
         burns = [b for b in burns if b is not None]
         slo_actions = {}
         for e in slo_events:
+            if "tenant" in e:
+                continue
             a = e.get("action", "?")
             slo_actions[a] = slo_actions.get(a, 0) + 1
+        roles_hit, handoffs = {}, {"count": 0, "blocks": 0, "bytes": 0}
+        for e in events:
+            if e.get("ev") != "fault":
+                continue
+            kind = e.get("kind", "")
+            if kind in ("replica_killed", "replica_degraded") \
+                    and "role" in e:
+                roles_hit[e["role"]] = roles_hit.get(e["role"], 0) + 1
+            elif kind == "replica_handoff":
+                handoffs["count"] += 1
+                handoffs["blocks"] += int(e.get("blocks", 0) or 0)
+                handoffs["bytes"] += int(e.get("nbytes", 0) or 0)
+        tenants = {}
+        for e in slo_events:
+            t = e.get("tenant")
+            if t is None:
+                continue
+            agg = tenants.setdefault(t, {"alerts": 0, "clears": 0,
+                                         "last_burn_rate": None,
+                                         "last_attainment": None,
+                                         "worst": None})
+            if e.get("action") == "burn_alert":
+                agg["alerts"] += 1
+            elif e.get("action") == "burn_clear":
+                agg["clears"] += 1
+            agg["last_burn_rate"] = _num(e.get("burn_rate"))
+            agg["last_attainment"] = _num(e.get("attainment"))
+            agg["worst"] = e.get("slo")
         fleet = {
             "migrations": replica_kinds.get("replica_migration", 0),
             "kills": replica_kinds.get("replica_killed", 0),
@@ -191,6 +225,14 @@ def summarize(events):
                 "last_burn_rate": burns[-1] if burns else None,
             },
         }
+        # disaggregation-era keys only when the journal has the events:
+        # pre-disagg journals keep the pre-disagg summary shape
+        if roles_hit:
+            fleet["roles_hit"] = roles_hit
+        if handoffs["count"]:
+            fleet["handoffs"] = handoffs
+        if tenants:
+            fleet["tenants"] = tenants
 
     # speculative decoding: per-wave `spec` events (serving scheduler)
     # fold into one acceptance line — the draft's live quality
@@ -337,17 +379,39 @@ def render(s):
     fl = s.get("fleet")
     if fl:
         lines.append("fleet:")
-        lines.append(f"  {'event':<16}{'count':>7}")
+        lines.append(f"  {'event':<16}{'count':>7}  {'role':<14}")
+        roles = ", ".join(f"{k}={v}"
+                          for k, v in sorted(fl.get("roles_hit",
+                                                    {}).items()))
         for key in ("kills", "degraded", "migrations",
                     "spawn_failures"):
             if fl[key]:
-                lines.append(f"  {key:<16}{fl[key]:>7}")
+                role_c = roles if key in ("kills", "degraded") else ""
+                lines.append(f"  {key:<16}{fl[key]:>7}  {role_c:<14}")
+        ho = fl.get("handoffs")
+        if ho:
+            lines.append(f"  {'handoffs':<16}{ho['count']:>7}  "
+                         f"{'prefill->decode':<14} "
+                         f"({ho['blocks']} blocks, "
+                         f"{_fmt_bytes(ho['bytes'])})")
         slo = fl.get("slo")
         if slo and slo["burn_rate_peak"] is not None:
             acts = ", ".join(f"{k}={v}"
                              for k, v in sorted(slo["actions"].items()))
             lines.append(f"  slo burn: peak={slo['burn_rate_peak']:.2f} "
                          f"last={slo['last_burn_rate']:.2f} ({acts})")
+        if fl.get("tenants"):
+            lines.append(f"  {'tenant':<12}{'alerts':>7}{'clears':>7}"
+                         f"{'burn':>8}{'attain':>8}  worst")
+            for name in sorted(fl["tenants"]):
+                t = fl["tenants"][name]
+                burn_c = ("-" if t["last_burn_rate"] is None
+                          else f"{t['last_burn_rate']:.2f}")
+                att_c = ("-" if t["last_attainment"] is None
+                         else f"{t['last_attainment']:.3f}")
+                lines.append(f"  {name:<12}{t['alerts']:>7}"
+                             f"{t['clears']:>7}{burn_c:>8}{att_c:>8}  "
+                             f"{t['worst'] or '-'}")
     if s.get("chaos"):
         inj = ", ".join(f"{k}={v}" for k, v in sorted(s["chaos"].items()))
         lines.append(f"chaos injections: {inj}")

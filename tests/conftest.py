@@ -27,6 +27,13 @@ if not os.environ.get("PT_NO_COMPILE_CACHE"):
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy non-parity permutations excluded from the tier-1 "
+        "budgeted run (selected with -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as pt

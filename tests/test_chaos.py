@@ -104,6 +104,41 @@ def test_replica_failover_scenario_clean(chaos_serving, capsys):
     assert "FAIL" not in capsys.readouterr().out
 
 
+def test_prefill_handoff_kill_scenario_clean(chaos_serving, capsys):
+    """The disaggregation headline: the prefill replica killed
+    mid-chunk, every request finishes on the decode side via the
+    block-level KV handoff token-identically — and the decode replica
+    never compiles a prefill program (bytes, not recompute)."""
+    assert chaos_serving.run(["--scenario", "prefill_handoff_kill"]) == 0
+    assert "FAIL" not in capsys.readouterr().out
+
+
+def test_inject_corrupt_handoff_exits_1(chaos_serving, capsys):
+    """Positive control: flipping one KV element of a handoff payload
+    in flight must be REFUSED by the digest check — the request fails
+    instead of decoding over corrupt K/V, and the token-identity
+    invariant catches it (exit 1)."""
+    assert chaos_serving.run(["--inject", "corrupt-handoff"]) == 1
+    assert "handoff" in capsys.readouterr().out
+
+
+def test_noisy_tenant_scenario_clean(chaos_serving, capsys):
+    """The QoS headline: a bulk tenant flooding a tiny replica cannot
+    push the premium tenant out of SLO attainment — weighted-fair
+    admission moves premium ahead of the backlog, outputs stay
+    token-identical, nobody starves."""
+    assert chaos_serving.run(["--scenario", "noisy_tenant"]) == 0
+    assert "FAIL" not in capsys.readouterr().out
+
+
+def test_inject_no_qos_exits_1(chaos_serving, capsys):
+    """Positive control: the same contended load without the QoS
+    manager finishes premium dead last (strict FCFS) — the
+    admitted-ahead invariant must catch it (exit 1)."""
+    assert chaos_serving.run(["--inject", "no-qos"]) == 1
+    assert "bulk backlog" in capsys.readouterr().out
+
+
 def test_cache_exhaustion_scenario_clean(chaos_serving, capsys):
     """The real property: injected pool exhaustion at admission queues
     the request behind in-flight work — every request completes with

@@ -1,0 +1,318 @@
+"""serving/fleet disaggregation + QoS — role-split replicas with
+block-level KV handoff (ISSUE 17 acceptance).
+
+The contract under test:
+
+  * a 1-prefill + 1-decode fleet streams TOKEN-IDENTICAL to a single
+    unified engine, and the decode replica provably runs ZERO
+    prefill-chunk programs (jit is lazy, so `prefill_compiles == 0` is
+    an assertable property, not a deployment hope) while the prefill
+    replica never compiles a decode wave;
+  * the handoff payload is digest-sealed: a corrupted payload is
+    REFUSED (request fault) with the importing pool rolled back;
+  * tenant identity and priority survive every hop through ONE
+    `_submit_kwargs` path (the satellite-6 regression);
+  * weighted-fair admission and priority preemption are unit-pinned.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import (HandoffRefused, PagedServingEngine,
+                                Scheduler, fleet)
+from paddle_tpu.serving.fleet import DisaggFleetRouter, QoSManager, Tenant
+from paddle_tpu.serving.fleet.migration import FleetRequest
+from paddle_tpu.utils import chaos
+
+VOCAB = 128
+MAX_LEN = 64
+BLOCK = 8
+CHUNK = 16
+MAX_NEW = 6
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(7)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=MAX_LEN)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def factory(model):
+    def make():
+        return PagedServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                                  block_size=BLOCK, num_blocks=33,
+                                  prefill_chunk_len=CHUNK)
+    return make
+
+
+@pytest.fixture(scope="module")
+def reference(factory):
+    engine = factory()
+
+    def ref(prompts, max_tokens=MAX_NEW):
+        return [Scheduler(engine).generate(p, max_tokens=max_tokens)
+                for p in prompts]
+    return ref
+
+
+def _prompts(n, seed=500):
+    """Mixed lengths, including prompts spanning >1 prefill chunk so the
+    handoff carries multi-chunk KV."""
+    lens = [4, 6, CHUNK + 2, 5, CHUNK + 4, 7]
+    return [np.random.RandomState(seed + i)
+            .randint(0, VOCAB, (lens[i % len(lens)],)).tolist()
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: bitwise parity + zero prefill programs on decode
+# ---------------------------------------------------------------------------
+
+def test_disagg_stream_token_identical_and_role_pure(factory, reference):
+    prompts = _prompts(6)
+    want = reference(prompts)
+    router = DisaggFleetRouter(factory, prefill_replicas=1,
+                               decode_replicas=1)
+    reqs = [router.submit(prompt=p, max_tokens=MAX_NEW) for p in prompts]
+    router.run()
+    assert [r.output_tokens for r in reqs] == want
+    assert all(r.finish_reason == "max_tokens" for r in reqs)
+    snap = router.metrics.snapshot()
+    # every request moved by handoff, none by recompute migration
+    assert snap["handoffs"] == len(prompts)
+    assert snap["handoff_blocks"] > 0
+    assert snap["handoff_bytes"] > 0
+    assert snap["migrations"] == 0
+    # role purity is a COMPILE count: the decode replica never traced a
+    # prefill chunk, the prefill replica never traced a decode wave
+    for rep in router.replicas:
+        if rep.role == "decode":
+            assert rep.engine.prefill_compiles == 0
+            assert rep.engine.decode_compiles == 1
+        elif rep.role == "prefill":
+            assert rep.engine.decode_compiles == 0
+            assert rep.engine.prefill_compiles >= 1
+    router.shutdown()
+
+
+def test_disagg_roles_validated(factory):
+    with pytest.raises(ValueError):
+        DisaggFleetRouter(factory, prefill_replicas=2, decode_replicas=0,
+                          unified_replicas=0)
+    with pytest.raises(ValueError):
+        DisaggFleetRouter(factory, prefill_replicas=0, decode_replicas=1,
+                          unified_replicas=0)
+
+
+def test_decode_role_rejects_fresh_prompts(factory):
+    sched = Scheduler(factory(), role="decode")
+    with pytest.raises(ValueError):
+        sched.submit(prompt=[1, 2, 3], max_tokens=2)
+    with pytest.raises(ValueError):
+        Scheduler(factory(), role="bogus")
+
+
+# ---------------------------------------------------------------------------
+# the handoff payload: export semantics + digest refusal
+# ---------------------------------------------------------------------------
+
+def _export_one(factory, prompt):
+    """Run one prompt through a prefill-role scheduler and drain its
+    staged (request, payload) pair."""
+    sched = Scheduler(factory(), role="prefill")
+    req = sched.submit(prompt=prompt, max_tokens=MAX_NEW)
+    for _ in range(16):
+        sched.step()
+        ready = sched.take_handoffs()
+        if ready:
+            return req, ready[0][1]
+    raise AssertionError("prefill never staged a handoff")
+
+
+def test_corrupt_payload_refused_and_pool_rolled_back(factory):
+    _, payload = _export_one(factory, list(range(1, CHUNK + 3)))
+    assert payload is not None and payload["nbytes"] > 0
+    corrupt = dict(payload)
+    layers = [np.array(a) for a in payload["layers"]]
+    layers[0].flat[0] += 1
+    corrupt["layers"] = layers
+    dst = factory()
+    used_before = dst.block_pool.used
+    cont = list(range(1, CHUNK + 3)) + [int(payload["next_token"])]
+    with pytest.raises(HandoffRefused):
+        dst.import_handoff(0, cont, corrupt)
+    # atomic refusal: no block of the destination pool stays allocated
+    assert dst.block_pool.used == used_before
+    # the pristine payload still imports fine into the same pool
+    dst.import_handoff(0, cont, payload)
+    assert dst.slot_active[0]
+
+
+def test_block_pool_export_manifest_semantics(factory):
+    engine = factory()
+    pool = engine.block_pool
+    with pytest.raises(ValueError):
+        pool.export_blocks([pool.SCRATCH])
+    free = pool.alloc(1)[0]
+    pool.release([free])
+    with pytest.raises(ValueError):
+        pool.export_blocks([free])          # not live anymore
+    live = pool.alloc(2)
+    manifest = pool.export_blocks(live)
+    assert len(manifest) == 2
+    got = pool.import_blocks(manifest)
+    assert len(got) == 2 and all(b != pool.SCRATCH for b in got)
+
+
+# ---------------------------------------------------------------------------
+# QoS: weighted-fair admission + priority preemption + hop survival
+# ---------------------------------------------------------------------------
+
+class _Q:
+    def __init__(self, tenant):
+        self.tenant = tenant
+
+
+def test_weighted_fair_pick_admission_unit():
+    qos = QoSManager([Tenant("premium", weight=8.0, priority=10),
+                      Tenant("bulk", weight=1.0)])
+    queued = [_Q("bulk"), _Q("bulk"), _Q("premium"), _Q("premium")]
+    # bulk cost 4/1=4 vs premium 1/8=0.125 -> first premium admits
+    assert qos.pick_admission(queued, {"bulk": 4, "premium": 1}) == 2
+    # nothing in flight: pure FCFS (head of queue)
+    assert qos.pick_admission(queued, {}) == 0
+    # unknown tenants bill to default and never crash the picker
+    assert qos.pick_admission([_Q("mystery")], {"mystery": 3}) == 0
+
+
+def test_priority_preemption_victim(factory):
+    sched = Scheduler(factory())
+    low = sched.submit(prompt=[1, 2, 3], max_tokens=MAX_NEW, priority=0)
+    mid = sched.submit(prompt=[4, 5, 6], max_tokens=MAX_NEW, priority=3)
+    high = sched.submit(prompt=[7, 8, 9], max_tokens=MAX_NEW, priority=9)
+    for _ in range(4):                       # admit + arm all three
+        sched.step()
+    slot_of = {id(r): s for s, r in enumerate(sched._slot_req)
+               if r is not None}
+    # the high-priority lane starves -> the priority-0 lane goes
+    assert sched._preemption_victim(slot_of[id(high)]) == slot_of[id(low)]
+    # the mid lane starving also evicts low, never high
+    assert sched._preemption_victim(slot_of[id(mid)]) == slot_of[id(low)]
+    # nothing ranks strictly below the low lane -> no victim
+    assert sched._preemption_victim(slot_of[id(low)]) is None
+    sched.shutdown()
+
+
+def test_tenant_priority_ride_submit_kwargs():
+    fr = FleetRequest(prompt=[1, 2], max_tokens=4, tenant="premium",
+                      priority=7)
+    kw = fr._submit_kwargs()
+    assert kw["tenant"] == "premium"
+    assert kw["priority"] == 7
+    # unresolved priority (no QoS manager) degrades to 0, never None
+    assert FleetRequest(prompt=[1], max_tokens=1)._submit_kwargs()[
+        "priority"] == 0
+
+
+def test_tenant_identity_survives_migration(factory, reference):
+    """Kill a unified replica mid-stream: the migrated hop's underlying
+    Request still carries the fleet request's tenant and its
+    QoS-resolved priority."""
+    prompts = _prompts(4)
+    want = reference(prompts)
+    qos = QoSManager([Tenant("premium", weight=4.0, priority=7)])
+    monkey = chaos.ChaosMonkey([
+        chaos.Fault(chaos.REPLICA_KILL, action="payload", payload=0,
+                    times=(2,))], seed=0)
+    with chaos.active(monkey):
+        router = DisaggFleetRouter(factory, prefill_replicas=0,
+                                   decode_replicas=0, unified_replicas=2,
+                                   qos=qos)
+        reqs = [router.submit(prompt=p, max_tokens=MAX_NEW,
+                              tenant="premium") for p in prompts]
+        router.run()
+    assert [r.output_tokens for r in reqs] == want
+    for fr in reqs:
+        assert fr.priority == 7              # resolved at fleet admission
+        assert fr.current.tenant == "premium"
+        assert getattr(fr.current, "priority", None) == 7
+    assert router.metrics.snapshot()["migrations"] > 0
+    summary = qos.summary()
+    assert summary["premium"]["requests"] == len(prompts)
+    router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# health + front door
+# ---------------------------------------------------------------------------
+
+def test_health_surfaces_roles_and_tenants(factory):
+    qos = QoSManager([Tenant("premium", weight=2.0, priority=5)])
+    router = DisaggFleetRouter(factory, prefill_replicas=1,
+                               decode_replicas=1, qos=qos)
+    health = router.health()
+    assert health["roles"] == {"prefill": 1, "decode": 1, "unified": 0}
+    assert {r["role"] for r in health["replicas"]} == {"prefill",
+                                                       "decode"}
+    assert "premium" in health["tenants"]
+    router.shutdown()
+
+
+def test_front_door_disagg_fleet(model, reference):
+    from paddle_tpu import inference
+    prompts = _prompts(3)
+    want = reference(prompts)
+    cfg = inference.Config()
+    cfg.enable_llm_engine(num_slots=4, max_len=MAX_LEN, paged=True,
+                          block_size=BLOCK, num_blocks=33,
+                          prefill_len=CHUNK)
+    cfg.enable_llm_fleet(prefill_replicas=1, decode_replicas=1,
+                         tenants=[Tenant("premium", weight=2.0,
+                                         priority=5)])
+    pred = inference.create_llm_predictor(cfg, model=model)
+    try:
+        assert cfg.llm_fleet_enabled()
+        got = [pred.generate(p, max_tokens=MAX_NEW) for p in prompts]
+        assert got == want
+        health = pred.health()
+        # a split request builds a PURE split fleet: the unified-fleet
+        # replicas default must not leak extra unified replicas in
+        assert health["roles"] == {"prefill": 1, "decode": 1,
+                                   "unified": 0}
+    finally:
+        pred.close()
+
+
+@pytest.mark.slow
+def test_spec_engine_handoff_token_identical(model):
+    """The speculative engine's (target, draft) cache bundle rides the
+    SAME tree-generic export/import path — disagg parity holds with
+    speculation on both sides of the seam."""
+    from paddle_tpu.serving import SpeculativePagedEngine
+    pt.seed(11)
+    draft = LlamaForCausalLM(
+        LlamaConfig(vocab_size=VOCAB, hidden_size=64, num_layers=1,
+                    num_heads=4, num_kv_heads=2, max_seq_len=MAX_LEN))
+
+    def make():
+        return SpeculativePagedEngine(model, draft, spec_k=2,
+                                      num_slots=4, max_len=MAX_LEN,
+                                      block_size=BLOCK, num_blocks=33,
+                                      prefill_chunk_len=CHUNK)
+    prompts = _prompts(3)
+    want = [Scheduler(make()).generate(p, max_tokens=MAX_NEW)
+            for p in prompts]
+    router = DisaggFleetRouter(make, prefill_replicas=1,
+                               decode_replicas=1)
+    reqs = [router.submit(prompt=p, max_tokens=MAX_NEW) for p in prompts]
+    router.run()
+    assert [r.output_tokens for r in reqs] == want
+    assert router.metrics.snapshot()["handoffs"] == len(prompts)
+    for rep in router.replicas:
+        if rep.role == "decode":
+            assert rep.engine.prefill_compiles == 0
+    router.shutdown()

@@ -110,6 +110,7 @@ def test_affinity_routes_cohort_where_its_blocks_live(factory):
     router.shutdown()
 
 
+@pytest.mark.slow
 def test_affinity_beats_round_robin_on_shared_prefix(factory):
     """The acceptance A/B: same shared-prefix workload, affinity policy
     must produce strictly more prefix-cache hits than round-robin (the

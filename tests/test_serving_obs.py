@@ -287,6 +287,7 @@ def test_slo_burn_scales_fleet_up_without_dropping_work(factory):
     router.shutdown()
 
 
+@pytest.mark.slow
 def test_no_slo_control_keeps_queue_depth_behavior(factory):
     """The control: same injected latency, no SLO policy — the
     autoscaler stays on the queue-depth heuristic (which sees no

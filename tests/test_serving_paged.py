@@ -463,6 +463,7 @@ def test_timeout_mid_chunked_prefill_retires_without_tokens(model):
     assert eng.block_pool.used == 0     # mid-prefill blocks all freed
 
 
+@pytest.mark.slow
 def test_all_starved_wave_not_counted_in_occupancy(model):
     """A wave where every active lane starves dispatches no program and
     must not inflate the occupancy integral: every counted wave emits

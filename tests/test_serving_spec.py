@@ -316,6 +316,7 @@ def test_horizon_bounded_request_token_identical(model, draft):
         assert s_req.output_tokens == p_req.output_tokens
 
 
+@pytest.mark.slow
 def test_truncated_lane_resamples_from_target_distribution():
     """Exactness at spec_len < k (token-mask/horizon-clamped lanes):
     the emitted token must come from p_t itself, NOT the residual
@@ -555,6 +556,7 @@ def test_raising_token_mask_fails_only_its_request(paged):
                                                   0) == 1
 
 
+@pytest.mark.slow
 def test_spec_front_door_via_inference_config(model, draft):
     """inference.Config.enable_llm_engine(speculative=...) builds the
     speculative engine through create_llm_predictor."""
