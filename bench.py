@@ -161,6 +161,15 @@ def run():
         _note(f"warm {i} done")
     step.detach_flight_recorder()
 
+    # anomaly plane armed at steady state (utils/anomaly): the warmup
+    # recompile is already banked as baseline, so a healthy bench must
+    # report ZERO fired alerts — the rollup rides the BENCH JSON
+    from paddle_tpu.utils import anomaly, timeseries
+    sampler = timeseries.MetricsSampler(interval_s=0.0)
+    alert_mgr = anomaly.AlertManager(rules=anomaly.default_train_rules())
+    alert_mgr.evaluate()    # seed detector baselines pre-window
+    sampler.sample()
+
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = step(ids, ids)
@@ -172,6 +181,8 @@ def run():
     step.attach_flight_recorder(recorder)
     float(step(ids, ids).numpy())
     step.detach_flight_recorder()
+    sampler.sample()
+    alert_mgr.evaluate()    # a recompile inside the window fires here
 
     # compile-level state of the measured program (xprof audit): flops/
     # bytes from the lowering, fusion/memory from the compiled HLO —
@@ -203,7 +214,8 @@ def run():
     detail = {"step_ms": round(dt * 1e3, 2), "loss": round(final, 3),
               "model_tflops": round(tflops, 2), "params": n_params,
               "backend": jax.default_backend(), "batch": batch,
-              "flight_recorder": fr_rollup, "hlo_audit": hlo_rollup}
+              "flight_recorder": fr_rollup, "hlo_audit": hlo_rollup,
+              "alerts": alert_mgr.summary()}
     if not on_tpu:
         # tunnel down at bench time: this run is a CPU liveness smoke,
         # NOT a perf datum. Attach the last BANKED on-chip measurement

@@ -260,13 +260,23 @@ class TelemetryCallback(Callback):
     endpoint the serving engine exports.
 
         model.fit(data, callbacks=[callbacks.TelemetryCallback()])
+
+    With `sampler`/`alerts` attached (utils/timeseries MetricsSampler,
+    utils/anomaly AlertManager — or the process-wide installed sampler
+    by default), every train step also banks a metrics-history sample
+    and runs the anomaly detector set, so a step-time regression or a
+    mid-run recompile fires an `alert` journal event while the run is
+    still going, not in the post-mortem.
     """
 
-    def __init__(self, memory_freq=10, device=None):
+    def __init__(self, memory_freq=10, device=None, sampler=None,
+                 alerts=None):
         super().__init__()
         from ..utils import telemetry
         self.memory_freq = max(0, int(memory_freq))
         self.device = device
+        self.sampler = sampler
+        self.alerts = alerts
         self._t0 = None
         self._steps = telemetry.counter(
             "train_steps_total", "Train steps completed")
@@ -296,6 +306,12 @@ class TelemetryCallback(Callback):
             self._loss.set(float(loss))
         if self.memory_freq and step % self.memory_freq == 0:
             self._poll_device_memory()
+        from ..utils import timeseries
+        sampler = self.sampler or timeseries.get_sampler()
+        if sampler is not None:
+            sampler.maybe_sample()
+        if self.alerts is not None:
+            self.alerts.evaluate()
 
     def on_train_end(self, logs=None):
         self._poll_device_memory()

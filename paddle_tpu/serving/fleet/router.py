@@ -37,7 +37,8 @@ as the single-engine Scheduler.
 """
 import threading
 
-from ...utils import chaos, flight_recorder, profiler, telemetry
+from ...utils import (chaos, flight_recorder, profiler, telemetry,
+                      timeseries)
 from ..slo import as_engine as _slo_as_engine
 from .metrics import FleetMetrics, FleetRegistry
 from .migration import DEFAULT_MAX_MIGRATIONS, FleetRequest
@@ -121,6 +122,24 @@ class FleetRouter:
         self._scale_cooldown = 0             # rounds until next burn
         self._surplus_rounds = 0             # consecutive low-burn rounds
         self._metrics_server = None
+        # observability plane (attach_timeseries): sampled + evaluated
+        # once per fleet round, with per-replica queue depths as extra
+        # series / detector context (only the router sees all replicas)
+        self._sampler = None
+        self._alerts = None
+
+    def attach_timeseries(self, sampler=None, alerts=None):
+        """Attach the metrics-history sampler and/or an AlertManager to
+        the fleet round: each step() samples every registered metric
+        plus per-replica queue-depth series, and feeds the depths to the
+        queue-skew detector (anomaly.default_fleet_rules).  A retired
+        replica's series simply stops — its ladder freezes without
+        touching any other series.  Alert state rides health()."""
+        if sampler is not None:
+            self._sampler = sampler
+        if alerts is not None:
+            self._alerts = alerts
+        return self
 
     # ---------------------------------------------------------- admission
     def submit(self, request=None, **kw):
@@ -267,6 +286,23 @@ class FleetRouter:
             with self._lock:
                 self.metrics.publish_states(self.replicas,
                                             dead_total=self._dead_total)
+                reps = list(self.replicas)
+            if self._sampler is not None or self._alerts is not None:
+                # one observability pass per fleet round: per-replica
+                # queue depths ride along as extra history series (a
+                # retired replica drops out — its ladder freezes) and
+                # as the queue-skew detector's context
+                depths = {str(r.replica_id):
+                          float(r.scheduler.queue_depth())
+                          for r in reps if r.state != "dead"}
+                if self._sampler is not None:
+                    self._sampler.maybe_sample(extra={
+                        timeseries.series_key("fleet_replica_queue_depth",
+                                              {"replica": rid}): d
+                        for rid, d in depths.items()})
+                if self._alerts is not None:
+                    self._alerts.evaluate(
+                        {"replica_queue_depths": depths})
         return self.outstanding()
 
     def run(self, max_rounds=None):
@@ -563,6 +599,8 @@ class FleetRouter:
         }
         if self.slo_engine is not None:
             out.update(self.slo_engine.health())
+        if self._alerts is not None:
+            out.update(self._alerts.health())
         return out
 
     def start_metrics_server(self, port=0, host="127.0.0.1"):

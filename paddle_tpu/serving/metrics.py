@@ -34,9 +34,11 @@ REQUESTS_REJECTED = "serving_requests_rejected"
 TOKENS_GENERATED = "serving_tokens_generated"
 PREFILLS = "serving_prefills"
 DECODE_WAVES = "serving_decode_waves"
-QUEUE_DEPTH = "serving_queue_depth"
-SLOTS_ACTIVE = "serving_slots_active"
 QUEUE_DEPTH_PEAK = "serving_queue_depth_peak"
+# NOTE: `serving_queue_depth` / `serving_slots_active` are TYPED gauges
+# only — the monitor keys of the same name used to ride along in every
+# exposition just to be shadowed by the typed series (the documented
+# legacy-monitor wart); ServingMetrics.snapshot() keys are unchanged.
 
 # typed registry metrics (docs/observability.md catalogs these)
 _REQUESTS = telemetry.counter(
@@ -256,7 +258,6 @@ class ServingMetrics:
         gauges. Cost-less calls (analysis unavailable) still count the
         wave."""
         monitor.stat_add(DECODE_WAVES)
-        monitor.stat_set(SLOTS_ACTIVE, int(n_active))
         _WAVES.inc()
         _SLOTS_ACTIVE.set(int(n_active))
         with self._lock:
@@ -299,7 +300,6 @@ class ServingMetrics:
                 self._phase_seconds.get(phase, 0.0) + float(seconds))
 
     def on_queue_depth(self, depth):
-        monitor.stat_set(QUEUE_DEPTH, int(depth))
         monitor.stat_max(QUEUE_DEPTH_PEAK, int(depth))  # process-wide peak
         _QUEUE_DEPTH.set(int(depth))
         with self._lock:

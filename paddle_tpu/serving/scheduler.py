@@ -106,8 +106,15 @@ class Scheduler:
         # sliding window, every round re-evaluates, and the burn-rate
         # verdict rides /healthz next to queue depth
         self.slo_engine = _slo_as_engine(slo)
+        # optional observability plane (utils/timeseries + utils/anomaly,
+        # attach_timeseries): the sampler banks every metric once per
+        # working round, the alert manager runs its detector set.  The
+        # engine's health-probe slot is NEWEST-WINS, so the SLO verdict
+        # and the alert state must share ONE merged probe.
+        self._sampler = None
+        self._alerts = None
         if self.slo_engine is not None:
-            engine.attach_health_probe(self.slo_engine.health)
+            engine.attach_health_probe(self._health_extras)
         # program flops/bytes per wave for the roofline gauges —
         # resolved NOW, at construction, not at the first wave: the
         # lowering-level cost analysis can stall for seconds on a real
@@ -167,6 +174,30 @@ class Scheduler:
         # growth would leak every prompt ever served on a long-running
         # server. completed_log=None keeps everything (tests/benches).
         self.completed = collections.deque(maxlen=completed_log)
+
+    # ------------------------------------------------------ observability
+    def attach_timeseries(self, sampler=None, alerts=None):
+        """Attach the metrics-history sampler and/or an AlertManager
+        (utils/timeseries, utils/anomaly): both run once per WORKING
+        round at wave end, and the alert state rides /healthz next to
+        the SLO verdict (one merged health probe — the engine's probe
+        slot is newest-wins, so separate attaches would shadow each
+        other).  Returns self for chaining."""
+        if sampler is not None:
+            self._sampler = sampler
+        if alerts is not None:
+            self._alerts = alerts
+        self.engine.attach_health_probe(self._health_extras)
+        return self
+
+    def _health_extras(self):
+        """The merged /healthz fragment: SLO verdict + alert state."""
+        out = {}
+        if self.slo_engine is not None:
+            out.update(self.slo_engine.health() or {})
+        if self._alerts is not None:
+            out.update(self._alerts.health() or {})
+        return out
 
     # ---------------------------------------------------------- admission
     def submit(self, request=None, **kw):
@@ -800,6 +831,15 @@ class Scheduler:
             # re-evaluate once per WORKING round: gauges track live,
             # transitions journal, /healthz serves the cached verdict
             self.slo_engine.evaluate()
+        if active or prefilled:
+            # the history sampler and the anomaly detectors run on the
+            # same working-round cadence (idle spins sample nothing:
+            # they would flood the ladders with flat lines and dilute
+            # every EWMA baseline toward the idle value)
+            if self._sampler is not None:
+                self._sampler.maybe_sample()
+            if self._alerts is not None:
+                self._alerts.evaluate()
         # chrome-trace counter track: occupancy/queue depth over time,
         # on the same timeline as the decode-wave slices
         if profiler.trace_enabled():

@@ -1,2 +1,3 @@
 """Importing this package registers every built-in ptlint rule."""
-from . import chaos_guard, hygiene, locks, metric_names, tracer  # noqa: F401
+from . import (alert_rules, chaos_guard, hygiene, locks,  # noqa: F401
+               metric_names, tracer)

@@ -1,0 +1,60 @@
+"""alert-rule-documented: every `AlertRule` id constructed in code is
+cataloged in docs/observability.md.
+
+Same contract as metric-name, for the anomaly plane (utils/anomaly.py):
+the alert table in the observability doc is the rule registry of
+record — an operator paging on `recompile_after_warmup` must be able to
+look it up.  Ids are read from the first positional argument (or the
+`rule_id=` keyword) of `AlertRule(...)` call sites, with module-level
+string constants resolved; dynamically-built ids are out of scope, the
+same escape hatch the metric-name rule leaves.
+"""
+import ast
+import re
+
+from ..core import Rule, register
+from ..astutil import last_name
+from .metric_names import module_consts, registered_names
+
+ID_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def alert_rule_sites(tree):
+    """Yield (node, rule_id) for every resolvable AlertRule(...) call."""
+    consts = module_consts(tree)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and last_name(node.func) == "AlertRule"):
+            continue
+        arg = node.args[0] if node.args else None
+        if arg is None:
+            for kw in node.keywords:
+                if kw.arg == "rule_id":
+                    arg = kw.value
+                    break
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            yield node, arg.value
+        elif isinstance(arg, ast.Name) and arg.id in consts:
+            yield node, consts[arg.id]
+
+
+@register
+class AlertRuleDocumented(Rule):
+    id = "alert-rule-documented"
+    rationale = ("the docs/observability.md alert table is the alert "
+                 "registry of record; an undocumented rule id pages "
+                 "operators with no runbook to look up.")
+
+    def check(self, ctx):
+        allow = registered_names(ctx.repo_root)
+        for node, rule_id in alert_rule_sites(ctx.tree):
+            if not ID_RE.match(rule_id):
+                yield ctx.finding(
+                    self.id, node,
+                    f"alert rule id {rule_id!r} is not snake_case "
+                    "([a-z][a-z0-9_]*)")
+            elif allow is not None and rule_id not in allow:
+                yield ctx.finding(
+                    self.id, node,
+                    f"alert rule id {rule_id!r} is not documented in "
+                    "docs/observability.md")

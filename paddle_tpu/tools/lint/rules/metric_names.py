@@ -1,12 +1,12 @@
 """metric-name: every literal metric name at a monitor/telemetry call
 site is snake_case AND cataloged in docs/observability.md.
 
-Rebased from scripts/check_metric_names.py (which is now a thin shim
-over this rule): the doc IS the metric registry of record — adding a
-metric means documenting it, and /metrics cannot silently grow
-undocumented or Prometheus-hostile names. Simple module-level
-NAME = "literal" constants are resolved (serving/metrics.py declares
-its monitor keys that way); dynamic names are out of scope.
+The doc IS the metric registry of record — adding a metric means
+documenting it, and /metrics cannot silently grow undocumented or
+Prometheus-hostile names. Simple module-level NAME = "literal"
+constants are resolved (serving/metrics.py declares its monitor keys
+that way); dynamic names are out of scope.  (This rule subsumed the
+retired scripts/check_metric_names.py standalone linter.)
 """
 import ast
 import os

@@ -42,7 +42,8 @@ class NonFiniteError(RuntimeError):
 
 EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
                "checkpoint", "xla_program", "jxaudit", "chaos", "fault",
-               "resume", "reshard", "hang", "slo", "spec", "run_end")
+               "resume", "reshard", "hang", "slo", "alert", "spec",
+               "run_end")
 
 
 def _json_safe(v):
@@ -363,6 +364,20 @@ class FlightRecorder:
             fields["window_requests"] = int(window_requests)
         fields.update(extra)
         return self.record("slo", **fields)
+
+    def alert(self, rule, action, severity=None, **detail):
+        """An AlertManager rule transitioned (utils/anomaly.py):
+        `action` is "firing" (the detector tripped) or "cleared" (it
+        recovered).  Journaled on TRANSITIONS only — the same
+        discipline as the SLO engine's burn alerts, so a sustained
+        anomaly is two lines, not a per-round flood.  `detail` carries
+        the detector's evidence (value, z-score, the function that
+        recompiled, the skew ratio, ...)."""
+        fields = {"rule": str(rule), "action": str(action)}
+        if severity is not None:
+            fields["severity"] = str(severity)
+        fields.update(detail)
+        return self.record("alert", **fields)
 
     def spec(self, proposed, accepted, lanes=None, spec_depth=None,
              **extra):

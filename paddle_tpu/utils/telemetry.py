@@ -11,8 +11,10 @@ tools/timeline.py, rebuilt as one subsystem:
     exposition) and renders both a JSON snapshot and the Prometheus
     text format;
   * an optional stdlib-`http.server` background thread (`MetricsServer`)
-    exposing `/metrics` (Prometheus), `/metrics.json` (snapshot) and
-    `/healthz`;
+    exposing `/metrics` (Prometheus), `/metrics.json` (snapshot),
+    `/healthz`, `/metrics/history` (the utils/timeseries ring-buffer
+    history, `snapshot_history()`) and `/dashboard` (self-contained
+    sparkline page);
   * XLA compile-event tracking: a `jax.monitoring` duration-listener
     counts backend compilations (persistent-cache loads included — a new
     executable entered this process either way) attributed to the
@@ -27,8 +29,8 @@ tools/timeline.py, rebuilt as one subsystem:
     RecordEvents, decode waves, and per-request lifecycles together.
 
 Metric names and label conventions are cataloged in
-docs/observability.md; scripts/check_metric_names.py lints call sites
-against that catalog.
+docs/observability.md; the `metric-name` rule of scripts/ptlint.py
+lints call sites against that catalog.
 """
 import bisect
 import contextlib
@@ -522,6 +524,15 @@ def render_prometheus(include_monitor=True):
     return REGISTRY.render_prometheus(include_monitor)
 
 
+def snapshot_history():
+    """The utils/timeseries history payload of the process-wide sampler
+    (what /metrics/history serves); an empty payload before any sampler
+    is installed."""
+    from . import timeseries
+    s = timeseries.get_sampler()
+    return s.history() if s is not None else timeseries.empty_history()
+
+
 def value(name, labels=None, default=None):
     """Read one sample from the default registry: counter/gauge value, or
     histogram observation count. `default` when the metric or the label
@@ -747,8 +758,16 @@ def trace_instant(trace_id, name, pid=0, **args):
 # /metrics exporter (stdlib http.server, background thread)
 # ---------------------------------------------------------------------------
 
-def make_metrics_handler(registry=None, health_fn=None):
+def make_metrics_handler(registry=None, health_fn=None, sampler=None):
     reg = registry or REGISTRY
+
+    def _history():
+        # the handler-bound sampler wins; otherwise the process-wide
+        # install (utils/timeseries) is resolved per request, so a
+        # server started before the sampler still serves its history
+        from . import timeseries
+        s = sampler or timeseries.get_sampler()
+        return s.history() if s is not None else timeseries.empty_history()
 
     class Handler(http.server.BaseHTTPRequestHandler):
         server_version = "paddle-tpu-telemetry/1.0"
@@ -762,6 +781,18 @@ def make_metrics_handler(registry=None, health_fn=None):
             elif path == "/metrics.json":
                 body = json.dumps(reg.snapshot()).encode()
                 ctype = "application/json"
+                code = 200
+            elif path == "/metrics/history":
+                # sorted keys + no timestamps anywhere in the payload:
+                # identical sampled values serve identical BYTES
+                # (tests pin this determinism)
+                body = json.dumps(_history(), sort_keys=True).encode()
+                ctype = "application/json"
+                code = 200
+            elif path == "/dashboard":
+                from . import timeseries
+                body = timeseries.render_dashboard(_history()).encode()
+                ctype = "text/html; charset=utf-8"
                 code = 200
             elif path == "/healthz":
                 payload = {"status": "ok", "time_unix": time.time()}
@@ -779,7 +810,8 @@ def make_metrics_handler(registry=None, health_fn=None):
                 # sad JSON inside
                 code = 200 if payload.get("status") == "ok" else 503
             else:
-                body = b"not found; try /metrics /metrics.json /healthz\n"
+                body = (b"not found; try /metrics /metrics.json "
+                        b"/metrics/history /dashboard /healthz\n")
                 ctype = "text/plain"
                 code = 404
             self.send_response(code)
@@ -794,7 +826,8 @@ def make_metrics_handler(registry=None, health_fn=None):
     return Handler
 
 
-def http_get_inline(path="/metrics", registry=None, health_fn=None):
+def http_get_inline(path="/metrics", registry=None, health_fn=None,
+                    sampler=None):
     """Drive the metrics handler fully in-process (no socket): returns
     (status_code, headers_dict, body_bytes). Tests exercise the exporter
     exactly as an HTTP client would, without binding a port."""
@@ -826,7 +859,8 @@ def http_get_inline(path="/metrics", registry=None, health_fn=None):
             self.out += bytes(data)
 
     sock = _FakeSocket()
-    make_metrics_handler(registry, health_fn)(sock, ("127.0.0.1", 0), None)
+    make_metrics_handler(registry, health_fn,
+                         sampler=sampler)(sock, ("127.0.0.1", 0), None)
     raw = bytes(sock.out)
     head, _, body = raw.partition(b"\r\n\r\n")
     head_lines = head.decode("latin-1").split("\r\n")
@@ -849,18 +883,20 @@ class MetricsServer:
     /healthz payload (the serving engine reports slot state there)."""
 
     def __init__(self, registry=None, host="127.0.0.1", port=0,
-                 health_fn=None):
+                 health_fn=None, sampler=None):
         self.registry = registry or REGISTRY
         self.host = host
         self.port = int(port)
         self.health_fn = health_fn
+        self.sampler = sampler
         self._httpd = None
         self._thread = None
 
     def start(self):
         if self._httpd is not None:
             return self
-        handler = make_metrics_handler(self.registry, self.health_fn)
+        handler = make_metrics_handler(self.registry, self.health_fn,
+                                       sampler=self.sampler)
         self._httpd = http.server.ThreadingHTTPServer(
             (self.host, self.port), handler)
         self.port = self._httpd.server_address[1]

@@ -1,0 +1,362 @@
+"""In-process metrics time-series plane — fixed-memory history for every
+registered metric.
+
+The telemetry registry (utils/telemetry.py) answers "what is the value
+now"; this module answers "what did it just do".  A `MetricsSampler`
+walks the registry periodically (and on key events — decode-wave end,
+fleet step, train step) and pushes every counter/gauge value, plus each
+histogram's derived p50/p99, into a per-series `SeriesLadder`:
+
+* tier 0 — the most recent `window` raw samples, full resolution;
+* tier 1 — older samples folded `agg_factor` at a time into
+  (min, mean, max) buckets, the last `window` buckets kept.
+
+That is the classic RRD two-tier downsampling shape: O(window) memory
+per series forever, recent detail intact, older history still showing
+envelopes (a spike survives aggregation as a `max` excursion).  **No
+banked artifact carries a timestamp** — series are keyed by sample
+index, so the history payload is byte-identical across runs that push
+identical values (tests pin this), and the wall clock is consulted only
+to rate-limit `maybe_sample()`.
+
+The plane is served three ways, all from one payload:
+
+* `telemetry.snapshot_history()` — the JSON-able dict;
+* `GET /metrics/history` on any MetricsServer — the same dict, dumped
+  with sorted keys (deterministic bytes);
+* `GET /dashboard` — one self-contained HTML page of inline-SVG
+  sparklines built per request from the same payload (no JS, no
+  external assets — curl it from an air-gapped box).
+
+`utils/anomaly.py` consumes the same sampled values for online
+anomaly detection; the sampler itself stays judgment-free.
+"""
+
+import collections
+import html
+import math
+import threading
+
+from . import telemetry
+
+#: default tier-0 capacity (raw samples) and tier-1 capacity (buckets)
+DEFAULT_WINDOW = 120
+#: raw samples folded per tier-1 bucket
+DEFAULT_AGG_FACTOR = 8
+
+_SAMPLES_TOTAL = telemetry.counter(
+    "timeseries_samples_total",
+    "Sampling passes the metrics history sampler has taken")
+
+
+def _finite(v):
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if math.isfinite(f) else None
+
+
+class SeriesLadder:
+    """Two-tier fixed-memory history of one metric series.
+
+    Raw samples land in `recent` (ring of `window`).  A sample evicted
+    from `recent` joins a pending fold; every `agg_factor` evictions
+    close one (min, mean, max) bucket appended to `agg` (ring of
+    `window` buckets — the oldest buckets fall off the end, which is
+    the fixed-memory guarantee).  Total state is bounded by
+    `window + 3 * window + agg_factor` floats regardless of how many
+    samples were ever pushed."""
+
+    __slots__ = ("window", "agg_factor", "recent", "agg", "_pending",
+                 "count", "last_index")
+
+    def __init__(self, window=DEFAULT_WINDOW, agg_factor=DEFAULT_AGG_FACTOR):
+        self.window = max(1, int(window))
+        self.agg_factor = max(1, int(agg_factor))
+        self.recent = collections.deque()
+        self.agg = collections.deque(maxlen=self.window)
+        self._pending = []
+        self.count = 0          # samples ever pushed into THIS series
+        self.last_index = -1    # sampler pass index of the latest push
+
+    def push(self, value, index):
+        if len(self.recent) >= self.window:
+            self._pending.append(self.recent.popleft())
+            if len(self._pending) >= self.agg_factor:
+                p = self._pending
+                self.agg.append((min(p), sum(p) / len(p), max(p)))
+                self._pending = []
+        self.recent.append(float(value))
+        self.count += 1
+        self.last_index = int(index)
+
+    def point_capacity(self):
+        """Float slots this ladder can ever hold (the memory bound the
+        tests pin at 10x window)."""
+        return self.window + 3 * self.window + self.agg_factor
+
+    def payload(self):
+        return {
+            "count": self.count,
+            "last_index": self.last_index,
+            "recent": [telemetry._json_safe(v) for v in self.recent],
+            "agg": [[telemetry._json_safe(lo), telemetry._json_safe(mean),
+                     telemetry._json_safe(hi)]
+                    for lo, mean, hi in self.agg],
+        }
+
+
+def series_key(name, labels=None):
+    """Prometheus-flavored series key: `name` or `name{k="v",...}` with
+    labels sorted — one canonical spelling per series."""
+    if not labels:
+        return str(name)
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class MetricsSampler:
+    """Samples every registered metric into per-series ladders.
+
+    `sample(extra=...)` takes one pass unconditionally; `maybe_sample()`
+    rate-limits against `interval_s` on the injected `clock` (event
+    hooks — wave end, fleet step, train step — call maybe_sample so an
+    idle-spinning loop cannot flood the ladders).  `extra` merges
+    caller-provided series (the fleet router passes per-replica queue
+    depths there; a retired replica simply stops appearing and its
+    ladder freezes without touching any other series' aggregates)."""
+
+    def __init__(self, registry=None, window=DEFAULT_WINDOW,
+                 agg_factor=DEFAULT_AGG_FACTOR, interval_s=0.25,
+                 clock=None):
+        self.registry = registry or telemetry.REGISTRY
+        self.window = max(1, int(window))
+        self.agg_factor = max(1, int(agg_factor))
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._series = {}
+        self._samples = 0
+        self._last_t = None
+
+    # ------------------------------------------------------------ sampling
+    def maybe_sample(self, extra=None):
+        """One pass, unless the last one was under `interval_s` ago.
+        Returns True when a pass ran.  With no clock configured and
+        interval_s <= 0, every call samples."""
+        if self.interval_s > 0:
+            clock = self._clock
+            if clock is None:
+                import time
+                clock = time.monotonic
+            now = clock()
+            if self._last_t is not None and now - self._last_t < \
+                    self.interval_s:
+                return False
+            self._last_t = now
+        self.sample(extra=extra)
+        return True
+
+    def sample(self, extra=None):
+        """One sampling pass: every counter/gauge value, every
+        histogram's p50/p99 (skipped until it has observations), plus
+        `extra` {series_key: value}.  Non-finite and non-numeric values
+        are dropped for that pass — a NaN gauge must not poison a
+        bucket's min/mean/max."""
+        values = {}
+        reg = self.registry
+        for name in reg.names():
+            m = reg.get(name)
+            if m is None:
+                continue
+            for label_values, child in m._series():
+                labels = dict(zip(m.labelnames, label_values))
+                if m.kind == "histogram":
+                    p50 = child.percentile(50)
+                    if p50 is None:
+                        continue
+                    values[series_key(name + "_p50", labels)] = p50
+                    values[series_key(name + "_p99", labels)] = \
+                        child.percentile(99)
+                else:
+                    values[series_key(name, labels)] = child.value()
+        for key, v in (extra or {}).items():
+            values[str(key)] = v
+        with self._lock:
+            index = self._samples
+            self._samples += 1
+            for key in sorted(values):
+                v = _finite(values[key])
+                if v is None:
+                    continue
+                ladder = self._series.get(key)
+                if ladder is None:
+                    ladder = self._series[key] = SeriesLadder(
+                        self.window, self.agg_factor)
+                ladder.push(v, index)
+        _SAMPLES_TOTAL.inc()
+        return index
+
+    # ------------------------------------------------------------- readers
+    @property
+    def samples(self):
+        with self._lock:
+            return self._samples
+
+    def latest(self, key, default=None):
+        with self._lock:
+            ladder = self._series.get(key)
+            if ladder is None or not ladder.recent:
+                return default
+            return ladder.recent[-1]
+
+    def history(self):
+        """The JSON-able history payload.  Deterministic by
+        construction: sorted series keys, sample-index based, no
+        timestamps anywhere."""
+        with self._lock:
+            out = {
+                "version": 1,
+                "window": self.window,
+                "agg_factor": self.agg_factor,
+                "samples": self._samples,
+                "series": {k: self._series[k].payload()
+                           for k in sorted(self._series)},
+            }
+        return out
+
+    def point_budget(self):
+        """Total float slots across every ladder — the live number the
+        memory-bound test compares against 10x window per series."""
+        with self._lock:
+            return sum(l.point_capacity() for l in self._series.values())
+
+
+def empty_history(window=DEFAULT_WINDOW, agg_factor=DEFAULT_AGG_FACTOR):
+    """What /metrics/history serves before any sampler is installed."""
+    return {"version": 1, "window": int(window),
+            "agg_factor": int(agg_factor), "samples": 0, "series": {}}
+
+
+# ---------------------------------------------------------------------------
+# process-wide sampler slot (telemetry.snapshot_history / the exporter
+# endpoints resolve it at call time — newest install wins, mirroring the
+# engine's health-probe discipline)
+# ---------------------------------------------------------------------------
+
+_global_lock = threading.Lock()
+_global_sampler = None
+
+
+def install_sampler(sampler):
+    """Make `sampler` the process-wide history source (served by every
+    MetricsServer's /metrics/history + /dashboard and by
+    telemetry.snapshot_history).  Returns the sampler."""
+    global _global_sampler
+    with _global_lock:
+        _global_sampler = sampler
+    return sampler
+
+
+def get_sampler():
+    with _global_lock:
+        return _global_sampler
+
+
+def uninstall_sampler(sampler=None):
+    """Remove the installed sampler (or only `sampler`, if it is still
+    the installed one — a test tearing down must not evict a newer
+    install)."""
+    global _global_sampler
+    with _global_lock:
+        if sampler is None or _global_sampler is sampler:
+            _global_sampler = None
+
+
+# ---------------------------------------------------------------------------
+# /dashboard — one self-contained page of sparklines
+# ---------------------------------------------------------------------------
+
+_SPARK_W, _SPARK_H = 240, 36
+
+
+def _spark_svg(points, band=None):
+    """Inline-SVG sparkline: `points` polyline, optional (lo, hi) band
+    behind it (the aggregated tier's min/max envelope)."""
+    if not points:
+        return "<svg width='%d' height='%d'></svg>" % (_SPARK_W, _SPARK_H)
+    everything = list(points)
+    if band:
+        everything += [v for lo, hi in band for v in (lo, hi)]
+    vmin, vmax = min(everything), max(everything)
+    span = (vmax - vmin) or 1.0
+    n = max(len(points) + (len(band or ())), 2)
+
+    def x(i):
+        return round(i * (_SPARK_W - 2) / (n - 1) + 1, 2)
+
+    def y(v):
+        return round(_SPARK_H - 2 - (v - vmin) * (_SPARK_H - 4) / span, 2)
+
+    parts = [f"<svg width='{_SPARK_W}' height='{_SPARK_H}' "
+             f"viewBox='0 0 {_SPARK_W} {_SPARK_H}'>"]
+    if band:
+        top = " ".join(f"{x(i)},{y(hi)}" for i, (_, hi) in enumerate(band))
+        bot = " ".join(f"{x(i)},{y(lo)}"
+                       for i, (lo, _) in reversed(list(enumerate(band))))
+        parts.append(f"<polygon points='{top} {bot}' fill='#cfe3f7' "
+                     "stroke='none'/>")
+    offset = len(band or ())
+    line = " ".join(f"{x(offset + i)},{y(v)}"
+                    for i, v in enumerate(points))
+    parts.append(f"<polyline points='{line}' fill='none' "
+                 "stroke='#1f6fb2' stroke-width='1.5'/>")
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def render_dashboard(history, title="paddle_tpu metrics"):
+    """One self-contained HTML page (no JS, no external assets): a row
+    per series — aggregated min/max envelope + mean, then the raw
+    recent tail, latest value on the right.  Built per request from the
+    history payload, so it is exactly as fresh as the last sample."""
+    rows = []
+    for key in sorted(history.get("series", {})):
+        s = history["series"][key]
+        band = [(lo, hi) for lo, _, hi in s.get("agg", ())
+                if _finite(lo) is not None and _finite(hi) is not None]
+        means = [m for _, m, _ in s.get("agg", ())
+                 if _finite(m) is not None]
+        recent = [v for v in s.get("recent", ())
+                  if _finite(v) is not None]
+        latest = recent[-1] if recent else (means[-1] if means else None)
+        latest_s = "—" if latest is None else f"{latest:.6g}"
+        rows.append(
+            "<tr><td class='k'>%s</td><td>%s</td>"
+            "<td class='v'>%s</td><td class='n'>%d</td></tr>"
+            % (html.escape(key), _spark_svg(means + recent, band=band),
+               latest_s, s.get("count", 0)))
+    body = "\n".join(rows) or \
+        "<tr><td colspan='4'>no samples yet</td></tr>"
+    return f"""<!doctype html>
+<html><head><meta charset="utf-8"><title>{html.escape(title)}</title>
+<style>
+ body {{ font: 13px/1.4 system-ui, sans-serif; margin: 1.5em; }}
+ table {{ border-collapse: collapse; }}
+ td {{ padding: 2px 10px; border-bottom: 1px solid #eee;
+      vertical-align: middle; }}
+ td.k {{ font-family: ui-monospace, monospace; }}
+ td.v {{ text-align: right; font-variant-numeric: tabular-nums; }}
+ td.n {{ color: #888; text-align: right; }}
+</style></head><body>
+<h1>{html.escape(title)}</h1>
+<p>{history.get("samples", 0)} sampling passes ·
+window {history.get("window")} raw + {history.get("window")}
+aggregated buckets × {history.get("agg_factor")} samples
+(band = aggregated min/max envelope, line = mean then raw tail)</p>
+<table><tr><th>series</th><th>history</th><th>latest</th>
+<th>samples</th></tr>
+{body}
+</table></body></html>
+"""

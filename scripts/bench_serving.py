@@ -34,7 +34,7 @@ import paddle_tpu as pt
 from paddle_tpu.serving import (DisaggFleetRouter, FleetRouter,
                                 PagedServingEngine, Scheduler,
                                 ServingEngine, SLOPolicy, Tenant)
-from paddle_tpu.utils import profiler, telemetry
+from paddle_tpu.utils import anomaly, profiler, telemetry, timeseries
 
 t0 = time.time()
 
@@ -557,6 +557,19 @@ def main():
     log(f"warmup done (decode compiles={engine.decode_compiles}, "
         f"prefill compiles={engine.prefill_compiles})")
 
+    # anomaly plane (utils/anomaly): the sampler rides every wave for
+    # /metrics/history, but alert rules evaluate only at load-point
+    # BOUNDARIES — a bench sweeps offered load on purpose, so per-wave
+    # scoring would flag the idle->load ramp itself as a step change.
+    # Warmup compiles are already banked as baseline; a clean matched
+    # baseline sweep must roll up ZERO fired alerts in BENCH JSON.
+    sampler = timeseries.MetricsSampler()
+    alert_mgr = anomaly.AlertManager(rules=anomaly.default_serving_rules())
+    alert_mgr.evaluate()              # seed detector baselines pre-sweep
+    sampler.sample()
+    if router is not None:
+        router.attach_timeseries(sampler)
+
     if args.trace_out:
         profiler.start_profiler()     # record AFTER warmup: steady state
 
@@ -647,6 +660,7 @@ def main():
             sched = Scheduler(engine, max_queue=args.max_queue,
                               max_preemptions=args.max_preemptions,
                               slo=make_slo())
+            sched.attach_timeseries(sampler)
             snap = run_load(sched, load, args.requests, args.vocab,
                             prompt_range=(4, args.prefill_len),
                             output_range=(4, out_hi), seed=100 + i,
@@ -662,6 +676,8 @@ def main():
             engine = engines[0]
         else:
             assert engine.decode_compiles <= 1, "decode step recompiled"
+        sampler.sample()
+        alert_mgr.evaluate()          # quiesced boundary: rule check only
         row = {
             "metric": f"serving {args.family} {kind} tokens/s "
                       f"@{load:g}req/s x{args.slots}slots",
@@ -946,6 +962,7 @@ def main():
         json.dump({"cmd": " ".join(sys.argv), "rows": rows,
                    "hlo_audit": hlo_rollup,
                    "resilience": resilience,
+                   "alerts": alert_mgr.summary(),
                    "telemetry": telemetry.snapshot()}, f, indent=1)
     log(f"wrote {args.out}")
     if router is not None:

@@ -44,7 +44,8 @@ import numpy as np
 
 import paddle_tpu as pt
 from paddle_tpu.serving import Scheduler, ServingEngine
-from paddle_tpu.utils import chaos, flight_recorder, telemetry
+from paddle_tpu.utils import (anomaly, chaos, flight_recorder,
+                              telemetry, timeseries)
 
 # canonical tiny scale == tests/test_serving.py fixture, so tier-1
 # shares one persistent-cache compile of the decode wave/prefill
@@ -727,6 +728,83 @@ def scenario_noisy_tenant(engine, inject):
     return v
 
 
+def scenario_latency_spike(engine, inject):
+    """Anomaly-plane positive control: an injected decode-wave delay
+    must fire the TTFT/TPOT anomaly alert (utils/anomaly.py) and then
+    CLEAR once the detector's baseline absorbs the new level — slow is
+    detected, and a one-time spike is a firing/cleared pair, not a
+    latch.  Outputs stay token-exact (slow is not broken), and the
+    sampled history serves in-process.  --inject no_alerts evaluates
+    with an EMPTY rule set while the invariants still expect the alert
+    — the checker must fail."""
+    v = []
+    spike_rules = ("ttft_p99_anomaly", "tpot_p99_anomaly")
+    prompts = _prompts()
+    ref = _reference(engine, prompts)
+    # fresh latency window: the preceding scenarios (slow_wave above
+    # all) already banked big observations in the CUMULATIVE latency
+    # histograms, which would bury the spike's p99 shift. Only these
+    # two series reset — a registry-wide reset would zero the compile
+    # counters the final compile-once invariant audits.
+    for name in ("serving_ttft_seconds", "serving_tpot_seconds"):
+        m = telemetry.REGISTRY.get(name)
+        if m is not None:
+            m._reset()
+    sampler = timeseries.MetricsSampler(interval_s=0.0)
+    rules = [] if inject == "no_alerts" else \
+        anomaly.default_serving_rules(
+            detector_kw={"warmup": 3, "z_fire": 3.0, "z_clear": 1.5,
+                         "alpha": 0.3})
+    am = anomaly.AlertManager(rules=rules)
+    sched = Scheduler(engine)
+    sched.attach_timeseries(sampler, am)
+    # fault-free stream first: seeds every detector's EWMA baseline
+    for p in prompts:
+        sched.submit(prompt=p, max_tokens=MAX_TOKENS)
+    sched.run()
+    monkey = chaos.ChaosMonkey([chaos.Fault(
+        chaos.DECODE_WAVE, action="delay", delay_s=0.25,
+        times=(1, 2, 3))])
+    with chaos.active(monkey):
+        reqs = [sched.submit(prompt=p, max_tokens=MAX_TOKENS)
+                for p in prompts]
+        sched.run()
+    _check(v, len(monkey.fired) == 3, "latency injection never fired")
+    for i, r in enumerate(reqs):
+        _check(v, r.output_tokens == ref[i],
+               f"request {i} output diverged under injected latency")
+    fired = {r for r in spike_rules
+             if am.summary()["rules"].get(r, {}).get("fired", 0) >= 1}
+    _check(v, fired,
+           "no TTFT/TPOT anomaly alert fired under an injected "
+           "0.25s decode-wave latency spike")
+    # recovery: fault-free rounds until the EWMA absorbs the level
+    for _ in range(8):
+        if not set(am.active()) & set(spike_rules):
+            break
+        for p in prompts:
+            sched.submit(prompt=p, max_tokens=MAX_TOKENS)
+        sched.run()
+    _check(v, not set(am.active()) & set(spike_rules),
+           "latency alert latched forever — never cleared after the "
+           "spike ended")
+    _check(v, all(am.summary()["rules"][r]["cleared"] >= 1
+                  for r in fired),
+           "fired alert has no cleared transition")
+    # the sampled plane serves in-process: history JSON + dashboard
+    st, _, body = telemetry.http_get_inline("/metrics/history",
+                                            sampler=sampler)
+    hist = json.loads(body)
+    _check(v, st == 200 and hist["samples"] > 0
+           and "serving_tpot_seconds_p99" in hist["series"],
+           "/metrics/history did not serve the sampled series")
+    st, _, body = telemetry.http_get_inline("/dashboard",
+                                            sampler=sampler)
+    _check(v, st == 200 and b"serving_tpot_seconds_p99" in body,
+           "/dashboard did not render the sampled series")
+    return v
+
+
 SCENARIOS = {
     "nan_slot": scenario_nan_slot,
     "wave_error": scenario_wave_error,
@@ -742,6 +820,7 @@ SCENARIOS = {
     "prefill_handoff_kill": scenario_prefill_handoff_kill,
     "noisy_tenant": scenario_noisy_tenant,
     "ckpt_crash": scenario_ckpt_crash,
+    "latency_spike": scenario_latency_spike,
 }
 
 # positive controls: each disables one resilience property inside its
@@ -751,7 +830,8 @@ INJECTIONS = {"drop-isolation": "nan_slot", "no-retry": "wave_error",
               "no-migration": "replica_failover",
               "no-rollback": "spec_rollback",
               "corrupt-handoff": "prefill_handoff_kill",
-              "no-qos": "noisy_tenant"}
+              "no-qos": "noisy_tenant",
+              "no_alerts": "latency_spike"}
 
 
 def run(argv=None):

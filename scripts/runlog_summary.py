@@ -250,6 +250,30 @@ def summarize(events):
             "accepted_per_wave": accepted / len(spec_events),
         }
 
+    # anomaly alerts (`alert` events, utils/anomaly.py): fired/cleared
+    # per rule — transitions only, so counts are episodes, not rounds
+    alerts = None
+    alert_events = [e for e in events if e.get("ev") == "alert"]
+    if alert_events:
+        rules = {}
+        for e in alert_events:
+            r = rules.setdefault(str(e.get("rule", "?")),
+                                 {"fired": 0, "cleared": 0,
+                                  "severity": None})
+            action = e.get("action")
+            if action == "firing":
+                r["fired"] += 1
+            elif action == "cleared":
+                r["cleared"] += 1
+            if e.get("severity"):
+                r["severity"] = e["severity"]
+        alerts = {
+            "rules": {k: rules[k] for k in sorted(rules)},
+            "fired_total": sum(r["fired"] for r in rules.values()),
+            "active": sorted(k for k, r in rules.items()
+                             if r["fired"] > r["cleared"]),
+        }
+
     by_coll = {}
     for c in colls:
         key = (c.get("op", "?"), c.get("group", "default"))
@@ -281,6 +305,7 @@ def summarize(events):
         },
         "collectives": top_collectives,
         "spec": spec,
+        "alerts": alerts,
         "chaos": chaos_by_point,
         "faults": faults_by_kind,
         "fleet": fleet,
@@ -412,6 +437,16 @@ def render(s):
                 lines.append(f"  {name:<12}{t['alerts']:>7}"
                              f"{t['clears']:>7}{burn_c:>8}{att_c:>8}  "
                              f"{t['worst'] or '-'}")
+    al = s.get("alerts")
+    if al:
+        lines.append("alerts:")
+        lines.append(f"  {'rule':<28}{'fired':>7}{'cleared':>9}"
+                     f"{'active':>8}  severity")
+        for rule in sorted(al["rules"]):
+            r = al["rules"][rule]
+            active = "yes" if rule in al["active"] else ""
+            lines.append(f"  {rule:<28}{r['fired']:>7}{r['cleared']:>9}"
+                         f"{active:>8}  {r['severity'] or '-'}")
     if s.get("chaos"):
         inj = ", ".join(f"{k}={v}" for k, v in sorted(s["chaos"].items()))
         lines.append(f"chaos injections: {inj}")

@@ -318,7 +318,7 @@ def test_swallowed_exception(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# metric-name (rebased from scripts/check_metric_names.py)
+# metric-name (subsumed the retired scripts/check_metric_names.py)
 # ---------------------------------------------------------------------------
 
 def test_metric_name_rule_with_catalog(tmp_path):
@@ -339,6 +339,53 @@ def test_metric_name_rule_with_catalog(tmp_path):
     msgs = " | ".join(f.message for f in findings)
     assert "snake_case" in msgs and "not registered" in msgs
     assert "rogue_metric" in msgs
+
+
+def test_metric_name_catalog_names_registered():
+    # the shim's old --list contract: the registry of record resolves
+    # from docs/observability.md and carries the core serving/compile
+    # names plus the time-series plane's own instruments
+    from paddle_tpu.tools.lint.rules.metric_names import registered_names
+    names = registered_names(REPO)
+    assert names is not None
+    for name in ("serving_requests_total", "xla_compiles_total",
+                 "timeseries_samples_total", "alerts_fired_total",
+                 "alerts_active"):
+        assert name in names, name
+
+
+# ---------------------------------------------------------------------------
+# alert-rule-documented
+# ---------------------------------------------------------------------------
+
+def test_alert_rule_documented_with_catalog(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "observability.md").write_text(
+        "alert rules: `good_rule` and `const_rule` here\n")
+    findings = _lint_src(tmp_path, """
+        from paddle_tpu.utils import anomaly
+        RULE = "const_rule"
+        ROGUE = "rogue_rule"
+        anomaly.AlertRule("good_rule", check=lambda ctx: None)
+        anomaly.AlertRule(RULE, check=lambda ctx: None)
+        anomaly.AlertRule("Not-Snake", check=lambda ctx: None)
+        anomaly.AlertRule(rule_id="undocumented_rule",
+                          check=lambda ctx: None)
+        anomaly.AlertRule(ROGUE, check=lambda ctx: None)
+    """, select={"alert-rule-documented"})
+    assert len(findings) == 3, findings
+    msgs = " | ".join(f.message for f in findings)
+    assert "snake_case" in msgs and "not documented" in msgs
+    assert "rogue_rule" in msgs and "undocumented_rule" in msgs
+
+
+def test_alert_rule_builtin_catalog_lints_clean():
+    # every AlertRule constructed by the shipped detectors must be in
+    # the docs/observability.md alert table
+    findings = lint.lint_paths(
+        [os.path.join(REPO, "paddle_tpu", "utils", "anomaly.py")],
+        repo_root=REPO, select={"alert-rule-documented"})
+    assert findings == [], findings
 
 
 # ---------------------------------------------------------------------------
@@ -462,7 +509,8 @@ def test_cli_list_rules():
     assert res.returncode == 0
     for rule_id in ("host-sync-in-trace", "recompile-hazard",
                     "lock-discipline", "mutable-default-arg",
-                    "swallowed-exception", "metric-name", "donate-hint"):
+                    "swallowed-exception", "metric-name", "donate-hint",
+                    "alert-rule-documented"):
         assert rule_id in res.stdout
 
 

@@ -1,0 +1,357 @@
+"""Metrics time-series plane + online anomaly detection (ISSUE 18).
+
+The contract under test:
+
+  * **Ladder determinism** — the sampler's two-tier ring ladder banks
+    NO timestamps: two identical runs against identical registries
+    produce byte-identical `/metrics/history` payloads, and the ladder
+    holds at most ~10x the window regardless of stream length.
+  * **Fleet retirement** — a retired replica's series simply stops
+    (frozen `last_index`, no poisoned aggregates) while live series
+    keep advancing.
+  * **Detector math** — the robust-EWMA detector fires on an injected
+    step change and then CLEARS as its baseline absorbs the new level;
+    the AlertManager latches each transition exactly once and journals
+    exactly one `alert` event per transition.
+  * **End-to-end (acceptance)** — the paged engine under load with the
+    sampler attached: an injected decode-wave latency spike AND a
+    provoked recompile each fire exactly once with a cleared
+    transition, the `alert` events land after the provoking `chaos`
+    event in the same journal, `/metrics/history` + `/dashboard` serve
+    via `http_get_inline`, and a no-anomaly run fires ZERO alerts.
+
+Canonical tiny LLaMA scale (2 layers, hidden 64) so warm runs hit the
+persistent compilation cache.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import PagedServingEngine, Scheduler
+from paddle_tpu.utils import anomaly, chaos, flight_recorder, telemetry
+from paddle_tpu.utils import timeseries as ts
+
+VOCAB = 128
+MAX_LEN = 64
+BLOCK = 8
+CHUNK = 16
+MAX_NEW = 5
+
+
+# ---------------------------------------------------------------------------
+# ladder / sampler unit contracts (no engine)
+# ---------------------------------------------------------------------------
+
+def test_ladder_folds_evictions_into_min_mean_max():
+    lad = ts.SeriesLadder(window=4, agg_factor=2)
+    for i in range(10):
+        lad.push(float(i), index=i)
+    p = lad.payload()
+    assert p["count"] == 10 and p["last_index"] == 9
+    assert p["recent"] == [6.0, 7.0, 8.0, 9.0]
+    # evicted 0..5 folded pairwise: (0,1) (2,3) (4,5)
+    assert p["agg"] == [[0.0, 0.5, 1.0], [2.0, 2.5, 3.0], [4.0, 4.5, 5.0]]
+
+
+def test_ladder_memory_bounded_at_10x_window():
+    for window, agg in ((16, 4), (32, 8), (120, 8)):
+        lad = ts.SeriesLadder(window=window, agg_factor=agg)
+        for i in range(50 * window):
+            lad.push(float(i % 7), index=i)
+        held = len(lad.recent) + 3 * len(lad.agg) + len(lad._pending)
+        assert held <= lad.point_capacity() <= 10 * window, \
+            (window, agg, held)
+
+
+def _mk_registry(seed_vals):
+    reg = telemetry.Registry()
+    g = reg.gauge("t_gauge", "test gauge")
+    c = reg.counter("t_total", "test counter")
+    h = reg.histogram("t_lat_seconds", "test latency")
+    for v in seed_vals:
+        g.set(v)
+        c.inc(v)
+        h.observe(v / 10.0)
+    return reg
+
+
+def test_history_payload_byte_identical_across_runs():
+    """No timestamps in the banked plane: two identical runs serve
+    byte-identical /metrics/history bodies (acceptance criterion)."""
+    bodies = []
+    for _ in range(2):
+        fake_t = [100.0]
+        reg = _mk_registry([1.0, 2.0, 3.0])
+        sam = ts.MetricsSampler(registry=reg, window=8, agg_factor=2,
+                                interval_s=0.5,
+                                clock=lambda: fake_t[0])
+        for k in range(20):
+            reg.get("t_gauge").set(float(k))
+            fake_t[0] += 0.5          # fake clock: every tick samples
+            sam.maybe_sample()
+        st, _, body = telemetry.http_get_inline("/metrics/history",
+                                                registry=reg, sampler=sam)
+        assert st == 200
+        bodies.append(body)
+    assert bodies[0] == bodies[1]
+    hist = json.loads(bodies[0])
+    assert hist["samples"] == 20
+    assert "t_gauge" in hist["series"]
+    assert "t_lat_seconds_p99" in hist["series"]
+
+
+def test_fake_clock_rate_limits_sampling():
+    fake_t = [0.0]
+    reg = _mk_registry([1.0])
+    sam = ts.MetricsSampler(registry=reg, interval_s=1.0,
+                            clock=lambda: fake_t[0])
+    for _ in range(10):
+        sam.maybe_sample()            # clock frozen: only the first lands
+    assert sam.samples == 1
+    fake_t[0] = 5.0
+    sam.maybe_sample()
+    assert sam.samples == 2
+
+
+def test_retired_replica_series_freezes_cleanly():
+    """A fleet replica that retires mid-run just stops contributing:
+    its series keeps its banked shape (frozen last_index), live series
+    advance, and the payload stays well-formed."""
+    reg = _mk_registry([1.0])
+    sam = ts.MetricsSampler(registry=reg, window=8, agg_factor=2,
+                            interval_s=0.0)
+    k0 = ts.series_key("fleet_replica_queue_depth", {"replica": "0"})
+    k1 = ts.series_key("fleet_replica_queue_depth", {"replica": "1"})
+    for i in range(6):
+        sam.sample(extra={k0: float(i), k1: float(10 + i)})
+    for i in range(6, 12):            # replica 1 retired: extra shrinks
+        sam.sample(extra={k0: float(i)})
+    hist = sam.history()
+    live, dead = hist["series"][k0], hist["series"][k1]
+    assert live["count"] == 12 and live["last_index"] == 11
+    assert dead["count"] == 6 and dead["last_index"] == 5
+    assert max(dead["recent"]) <= 15.0     # no post-retirement points
+    # the frozen series is gap-free up to retirement, not padded after
+    assert live["recent"][-1] == 11.0
+    json.dumps(hist, sort_keys=True)       # payload stays serializable
+
+
+# ---------------------------------------------------------------------------
+# detector / alert-manager unit contracts
+# ---------------------------------------------------------------------------
+
+def test_robust_ewma_fires_on_step_then_absorbs():
+    det = anomaly.RobustEWMA(warmup=4, z_fire=3.0, z_clear=1.0)
+    fired = []
+    for x in [1.0, 1.1, 0.9, 1.0, 1.05, 0.95, 8.0, 8.0, 8.0, 8.0,
+              8.0, 8.0, 8.0, 8.0]:
+        fired.append(det.update(x))
+    assert fired[6]                        # the step is caught
+    assert not any(fired[:6])              # warmup/steady never fires
+    assert not fired[-1]                   # baseline absorbed the level
+
+
+def test_alert_manager_latches_exactly_once_and_journals():
+    flag = {"on": False}
+    rule = anomaly.AlertRule(
+        "t_unit_rule", check=lambda ctx: {"firing": flag["on"]},
+        severity="critical")
+    rec = flight_recorder.FlightRecorder(ring_size=64)
+    am = anomaly.AlertManager(rules=[rule], recorder=rec)
+    am.evaluate()
+    flag["on"] = True
+    assert am.evaluate() == [("t_unit_rule", "firing")]
+    for _ in range(3):
+        assert am.evaluate() == []         # steady breach: no re-fire
+    flag["on"] = False
+    assert am.evaluate() == [("t_unit_rule", "cleared")]
+    s = am.summary()["rules"]["t_unit_rule"]
+    assert (s["fired"], s["cleared"], s["active"]) == (1, 1, False)
+    alerts = [e for e in rec.events() if e["ev"] == "alert"]
+    assert [a["action"] for a in alerts] == ["firing", "cleared"]
+    assert alerts[0]["severity"] == "critical"
+
+
+def test_alert_manager_contains_detector_crashes():
+    def boom(ctx):
+        raise RuntimeError("detector bug")
+    am = anomaly.AlertManager(rules=[
+        anomaly.AlertRule("t_boom_rule", check=boom)])
+    assert am.evaluate() == []             # contained, not raised
+    assert am.summary()["check_errors"] == 1
+
+
+def test_queue_skew_detector_needs_consecutive_breaches():
+    rule = anomaly.AlertRule(
+        "t_skew_rule",
+        check=anomaly.queue_skew_check(skew_fire=1.5, skew_clear=1.0,
+                                       min_mean_depth=1.0, consecutive=2))
+    am = anomaly.AlertManager(rules=[rule])
+    even = {"replica_queue_depths": {"0": 4.0, "1": 4.0}}
+    skew = {"replica_queue_depths": {"0": 12.0, "1": 1.0}}
+    am.evaluate(even)
+    assert am.evaluate(skew) == []         # one breach: streak only
+    assert am.evaluate(skew) == [("t_skew_rule", "firing")]
+    assert am.evaluate(even) == [("t_skew_rule", "cleared")]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance: paged engine under load, spike + recompile
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(7)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=64, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=MAX_LEN)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(scope="module")
+def paged(model):
+    eng = PagedServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                             block_size=BLOCK, num_blocks=33,
+                             prefill_chunk_len=CHUNK)
+    Scheduler(eng).generate([1, 2, 3], max_tokens=2)   # warm pre-arming
+    return eng
+
+
+def _prompts(n=6, seed=300):
+    return [np.random.RandomState(seed + i)
+            .randint(0, VOCAB, (4 + i % 5,)).tolist() for i in range(n)]
+
+
+def _mgr(recorder=None, **overrides):
+    # warmup=16 spans two full 8-evaluate streams so the EWMA learns
+    # the steady-load regime before scoring begins; rel_floor=0.5
+    # ignores sub-1.5x wall-clock jitter (tiny-scale hbm/queue values
+    # swing tens of percent on a busy CI box) while the injected
+    # latency spike still lands 10x+ above baseline
+    kw = {"warmup": 16, "z_fire": 3.0, "z_clear": 1.5,
+          "alpha": 0.3, "rel_floor": 0.5}
+    kw.update(overrides)
+    return anomaly.AlertManager(
+        rules=anomaly.default_serving_rules(detector_kw=kw),
+        recorder=recorder)
+
+
+def _run_stream(sched, prompts):
+    for p in prompts:
+        sched.submit(prompt=p, max_tokens=MAX_NEW)
+    sched.run()
+
+
+def test_e2e_clean_run_fires_zero_alerts(paged):
+    """No-anomaly control: steady load with the full serving rule set
+    armed fires NOTHING (acceptance criterion). This control proves the
+    PLANE adds no false positives of its own, so it is desensitized to
+    genuine scheduler stalls a loaded CI box can inject (a real 200ms
+    stall IS an anomaly — the spike test covers detection)."""
+    telemetry.REGISTRY.reset()
+    sampler = ts.MetricsSampler(interval_s=0.0)
+    am = _mgr(rel_floor=2.0, min_delta=0.2)
+    sched = Scheduler(paged)
+    sched.attach_timeseries(sampler, am)
+    for r in range(4):
+        _run_stream(sched, _prompts(seed=400 + 10 * r))
+    s = am.summary()
+    assert s["fired_total"] == 0 and s["active"] == [], s
+    assert s["check_errors"] == 0
+    assert sampler.samples > 0
+
+
+def test_e2e_spike_and_recompile_fire_once_and_clear(paged, model):
+    """The flagship acceptance path: injected decode-wave latency AND a
+    provoked recompile each produce exactly one firing (then cleared)
+    while the journal interleaves `alert` next to the provoking
+    `chaos` event and the history endpoints serve in-process."""
+    telemetry.REGISTRY.reset()
+    rec = flight_recorder.FlightRecorder(ring_size=512)
+    sampler = ts.MetricsSampler(interval_s=0.0)
+    am = _mgr(recorder=rec)
+    sched = Scheduler(paged)
+    sched.attach_timeseries(sampler, am)
+    with flight_recorder.recording(rec):
+        for r in range(2):                 # seed every EWMA baseline
+            _run_stream(sched, _prompts(seed=500 + 10 * r))
+        assert am.summary()["fired_total"] == 0
+
+        monkey = chaos.ChaosMonkey([chaos.Fault(
+            chaos.DECODE_WAVE, action="delay", delay_s=0.25,
+            times=(1, 2, 3))])
+        with chaos.active(monkey):
+            _run_stream(sched, _prompts(seed=520))
+        assert len(monkey.fired) == 3, "latency injection never fired"
+
+        # recovery: with traffic stopped the cumulative percentiles are
+        # FROZEN, so driving evaluate() directly absorbs the spike
+        # level deterministically — no live waves whose wall-clock
+        # jitter on a loaded CI box could re-fire a latency rule
+        sched.attach_timeseries(sampler)      # detach alert evaluation
+        for _ in range(16):
+            am.evaluate()
+            if not am.active():
+                break
+        assert not am.active(), am.active()
+
+        # provoke a genuine recompile after warmup: fresh engines
+        # compile the instrumented paged programs at NEW shapes under
+        # the same labels the detector watches. The registry was reset
+        # above, so the first fresh compile re-seeds the per-label
+        # baseline (first-compile-is-warmup semantics) and the second
+        # is the recompile-after-warmup the rule must catch. Their
+        # warmup generates bank compile-inflated TTFT/TPOT observations,
+        # so the latency histograms are quieted before each evaluation —
+        # only the compile-count delta may reach the manager here, or
+        # the latency rules would (correctly!) fire on the compile
+        # stall and break the exactly-once accounting under test.
+        def _quiet_latency():
+            for name in ("serving_ttft_seconds", "serving_tpot_seconds"):
+                m = telemetry.REGISTRY.get(name)
+                if m is not None:
+                    m._reset()
+
+        for slots, blocks in ((2, 17), (3, 25)):
+            eng2 = PagedServingEngine(model, num_slots=slots,
+                                      max_len=MAX_LEN, block_size=BLOCK,
+                                      num_blocks=blocks,
+                                      prefill_chunk_len=CHUNK)
+            Scheduler(eng2).generate([1, 2, 3], max_tokens=2)
+            _quiet_latency()
+            am.evaluate()                  # sees the compile-count bump
+        am.evaluate()                      # steady again -> cleared
+
+    spike = {r: am.summary()["rules"][r]
+             for r in ("ttft_p99_anomaly", "tpot_p99_anomaly")}
+    fired = {r: s for r, s in spike.items() if s["fired"]}
+    assert fired, f"no latency alert fired under injected delay: {spike}"
+    for r, s in fired.items():
+        assert s["fired"] == 1, (r, s)     # exactly once, not a flap
+        assert s["cleared"] == 1 and not s["active"], (r, s)
+    rc = am.summary()["rules"]["recompile_after_warmup"]
+    assert (rc["fired"], rc["cleared"], rc["active"]) == (1, 1, False), rc
+
+    # journal: the firing alert lands AFTER its provoking chaos event,
+    # in the same journal (adjacent plane, one timeline)
+    evs = rec.events()
+    kinds = [e["ev"] for e in evs]
+    first_chaos = kinds.index("chaos")
+    alert_evs = [(i, e) for i, e in enumerate(evs) if e["ev"] == "alert"]
+    spike_firing = [i for i, e in alert_evs
+                    if e["rule"] in fired and e["action"] == "firing"]
+    assert spike_firing and min(spike_firing) > first_chaos
+    recompile_acts = [e["action"] for _, e in alert_evs
+                      if e["rule"] == "recompile_after_warmup"]
+    assert recompile_acts == ["firing", "cleared"]
+
+    # the sampled plane serves in-process on the metrics handler
+    st, _, body = telemetry.http_get_inline("/metrics/history",
+                                            sampler=sampler)
+    hist = json.loads(body)
+    assert st == 200 and hist["samples"] > 0
+    assert "serving_tpot_seconds_p99" in hist["series"]
+    st, _, body = telemetry.http_get_inline("/dashboard", sampler=sampler)
+    assert st == 200 and b"serving_tpot_seconds_p99" in body
