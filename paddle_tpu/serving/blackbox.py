@@ -1,0 +1,601 @@
+"""Serving black-box recorder: a bounded journal of every
+replay-relevant serving decision, with deterministic incident replay.
+
+The serving-side counterpart of `utils/flight_recorder.py` (same
+ring-buffered JSONL journal, crash-flush context manager, and
+module-level `set_recorder`/`get_recorder` no-plumbing pattern), but
+where the flight recorder journals *faults*, the black box journals
+*decisions*: request submission (prompt tokens + digest, sampling
+params, resolved seed, tenant/priority), QoS admission verdicts, wave
+membership, preemption/eviction, fleet hops (dispatch / migrate /
+handoff / kv export-import / replica spawn-retire), and completion
+(output-token digest plus per-phase wall timings).
+
+The repo's serving stack is token-exact reproducible end to end
+(failover, migration, disagg handoff, and spec decoding are all proven
+bitwise), so capturing the externally-sourced decision inputs makes a
+run *replayable*: `scripts/replay_incident.py` rebuilds a fresh
+engine/fleet from the journal's `run_start` harness metadata, re-submits
+the window in order, re-forces the recorded replica kills, and verifies
+outputs token-exact against the recorded digests.
+
+Determinism contract: wall-clock state lives only in the stamped `ts`
+field and the explicit `wall` sidecar of `complete` events, and the
+only per-run randomness is `run_id`. `replay_view(events)` strips those
+and normalizes process-lifetime request/trace ids (the global `Request`
+counter keeps counting across runs in one process) to journal ordinals,
+so two runs that made identical decisions produce **byte-identical**
+views: `json.dumps(replay_view(evs), sort_keys=True)` is a fitness
+hash for the whole serving stack.
+
+Zero-overhead discipline: every emission site in the serving stack is
+gated on `blackbox.get_recorder() is not None` — recording detached
+costs one module-global read per site, nothing else.
+"""
+import collections
+import contextlib
+import hashlib
+import json
+import os
+import threading
+import time
+import uuid
+
+from ..utils import telemetry
+from ..utils.flight_recorder import _json_safe
+
+#: journal event taxonomy (the `ev` field). ptlint's
+#: `event-kind-documented` rule checks emission call sites against the
+#: kind tuples in this module and docs/observability.md.
+EVENT_KINDS = (
+    "run_start",   # run bracketing: run id, recorder meta, harness config
+    "submit",      # request accepted: prompt tokens+digest, sampling, seed
+    "admission",   # QoS/scheduler verdict: picked/admitted/deferred/shed/rejected
+    "wave",        # decode wave membership: slots, tokens, spec counts
+    "preempt",     # eviction for recompute: victim, reason, budget
+    "hop",         # fleet-plane movement, see HOP_KINDS
+    "complete",    # request finished: output digest, wall sidecar
+    "incident",    # incident bundle snapshotted (alert latched firing)
+    "run_end",     # run bracketing: status + drop counters
+)
+
+#: `hop` event sub-kinds (the `kind` field of `ev == "hop"` events).
+HOP_KINDS = (
+    "dispatch",        # request placed on a replica
+    "migrate",         # live migration off a dead/dying replica
+    "handoff",         # prefill->decode KV handoff (disagg fleet)
+    "kv_export",       # engine exported a slot's KV blocks (digested)
+    "kv_import",       # engine imported a KV payload (digest verified)
+    "replica_spawn",   # replica (re)joined the rotation
+    "replica_retire",  # replica left the rotation (killed/degraded)
+)
+
+#: stamped / sidecar fields excluded from the replay-relevant payload
+REPLAY_EXCLUDED = ("ts", "wall", "run_id")
+
+# Fields holding process-lifetime identifiers, normalized to journal
+# ordinals by replay_view (two identical runs in one process draw
+# different ids from the global Request/FleetRequest counters).
+_REQ_ID_FIELDS = ("request_id", "local_request_id", "victim_for")
+_TRACE_ID_FIELDS = ("trace_id",)
+
+
+def token_digest(tokens):
+    """Content digest of a token stream (sha256 prefix, 16 hex chars).
+
+    The journal records digests; replay verifies regenerated streams
+    against them. Canonical form is the comma-joined decimal ints, so
+    the digest is independent of container/int dtype.
+    """
+    raw = ",".join(str(int(t)) for t in tokens)
+    return hashlib.sha256(raw.encode("utf-8")).hexdigest()[:16]
+
+
+class BlackBoxRecorder:
+    """Ring-buffered JSONL journal of serving decisions.
+
+    Mirrors `utils.flight_recorder.FlightRecorder`: events are held in
+    bounded deques (`ring_size`), flushed to `path` in batches of
+    `flush_every`, and crash-flushed by ``__exit__``. Two additions:
+
+    - `clock`: injectable time source (tests pin it to a constant so
+      two runs' journals are byte-comparable even before
+      `replay_view` stripping).
+    - `bundle_dir`: when set, `incident_bundle()` snapshots the ring
+      tail + `telemetry.snapshot_history()` + a manifest into a
+      self-contained per-incident directory (`AlertManager` calls it
+      when an alert latches firing).
+    """
+
+    def __init__(self, path=None, ring_size=512, flush_every=1,
+                 meta=None, clock=time.time, bundle_dir=None):
+        self.path = path
+        self.ring_size = int(ring_size)
+        self.flush_every = max(1, int(flush_every))
+        self.meta = dict(meta or {})
+        self.bundle_dir = bundle_dir
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._pending = collections.deque(maxlen=self.ring_size)
+        self._recent = collections.deque(maxlen=self.ring_size)
+        self._dropped = 0
+        self._seq = 0
+        self._bundle_seq = 0
+        self._counts = collections.Counter()
+        self._file = None
+        self._run_id = None
+        self._run_start_fields = None
+        self._prev = _MISSING
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # core record/flush (flight-recorder pattern)
+    # ------------------------------------------------------------------
+
+    def record(self, event, **fields):
+        """Append one event. `event` names the kind (the `ev` field);
+        extra fields are JSON-sanitised. Returns the stamped dict."""
+        ev = {"ev": event, "ts": round(float(self._clock()), 6)}
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            for k, v in fields.items():
+                ev[k] = _json_safe(v)
+            self._counts[event] += 1
+            if len(self._pending) == self._pending.maxlen:
+                self._dropped += 1
+            self._pending.append(ev)
+            self._recent.append(ev)
+            should_flush = (self.path is not None
+                            and len(self._pending) >= self.flush_every)
+        if should_flush:
+            self.flush()
+        return ev
+
+    def flush(self):
+        """Write pending events to the journal file (append mode)."""
+        if self.path is None:
+            with self._lock:
+                self._pending.clear()
+            return
+        with self._lock:
+            batch = list(self._pending)
+            self._pending.clear()
+            if not batch:
+                return
+            if self._file is None:
+                self._file = open(self.path, "a", encoding="utf-8")
+            for ev in batch:
+                self._file.write(json.dumps(ev, allow_nan=False) + "\n")
+            self._file.flush()
+
+    def close(self):
+        self.flush()
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            self._closed = True
+
+    def events(self):
+        """Most recent events (ring tail), oldest first."""
+        with self._lock:
+            return list(self._recent)
+
+    def counts(self):
+        with self._lock:
+            return dict(self._counts)
+
+    @property
+    def dropped_events(self):
+        with self._lock:
+            return self._dropped
+
+    # ------------------------------------------------------------------
+    # run bracketing + crash flush
+    # ------------------------------------------------------------------
+
+    def run_start(self, harness=None, **fields):
+        """Open the run. `harness` carries everything replay needs to
+        rebuild the serving stack (model/engine/fleet config) and is
+        also copied into incident-bundle manifests. Idempotent."""
+        if self._run_id is not None:
+            return self._run_id
+        self._run_id = uuid.uuid4().hex[:12]
+        self._run_start_fields = _json_safe(harness) if harness else None
+        self.record("run_start", run_id=self._run_id, meta=self.meta,
+                    harness=self._run_start_fields, **fields)
+        return self._run_id
+
+    def run_end(self, status="ok", **fields):
+        self.record("run_end", status=status, counts=dict(self._counts),
+                    dropped_events=self._dropped, **fields)
+        self.flush()
+
+    def __enter__(self):
+        self._prev = get_recorder()
+        set_recorder(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            if exc_type is not None:
+                self.run_end(status="crashed",
+                             error=f"{exc_type.__name__}: {exc}")
+            elif self._counts.get("run_end", 0) == 0:
+                self.run_end(status="ok")
+        finally:
+            set_recorder(self._prev if self._prev is not _MISSING else None)
+            self._prev = _MISSING
+            self.close()
+        return False
+
+    # ------------------------------------------------------------------
+    # typed events
+    # ------------------------------------------------------------------
+
+    def submit(self, request, origin="scheduler", round=None,
+               replica=None):
+        """Request accepted for serving. Records the prompt verbatim
+        (replay re-submits it) plus its digest, the full sampling
+        config, and the resolved seed."""
+        prompt = [int(t) for t in request.prompt]
+        self.record(
+            "submit", origin=origin,
+            request_id=request.request_id,
+            trace_id=getattr(request, "trace_id", None),
+            tenant=getattr(request, "tenant", "default"),
+            priority=getattr(request, "priority", None),
+            seed=getattr(request, "seed", None),
+            prompt=prompt,
+            prompt_sha=token_digest(prompt),
+            prompt_len=len(prompt),
+            max_tokens=request.max_tokens,
+            eos_token_id=getattr(request, "eos_token_id", None),
+            sampling={
+                "do_sample": bool(getattr(request, "do_sample", False)),
+                "temperature": float(getattr(request, "temperature", 1.0)),
+                "top_k": int(getattr(request, "top_k", 0) or 0),
+                "top_p": float(getattr(request, "top_p", 1.0)),
+            },
+            stop_sequences=getattr(request, "stop_sequences", None),
+            has_logit_bias=getattr(request, "logit_bias", None) is not None,
+            has_token_mask=getattr(request, "token_mask", None) is not None,
+            handoff=getattr(request, "handoff", None) is not None,
+            round=round, replica=replica)
+
+    def admission(self, request_id, verdict, reason=None, slot=None,
+                  tenant=None, basis=None, trace_id=None, round=None,
+                  replica=None, **extra):
+        """QoS/scheduler admission verdict: `picked` (QoS weighted-fair
+        selection), `admitted` (slot staged), `deferred` (waiting at
+        head), `shed`/`rejected` (refused)."""
+        self.record("admission", request_id=request_id, verdict=verdict,
+                    reason=reason, slot=slot, tenant=tenant, basis=basis,
+                    trace_id=trace_id, round=round, replica=replica,
+                    **extra)
+
+    def wave(self, wave_id, members, starved=None, nonfinite=None,
+             spec_proposed=None, spec_accepted=None, round=None,
+             replica=None):
+        """One decode wave: which requests rode it in which slots, how
+        many tokens each emitted, and the speculative accept counts."""
+        self.record("wave", wave_id=wave_id, members=members,
+                    starved=starved, nonfinite=nonfinite,
+                    spec_proposed=spec_proposed,
+                    spec_accepted=spec_accepted,
+                    round=round, replica=replica)
+
+    def preempt(self, request_id, slot, reason, victim_for=None,
+                preemptions=None, round=None, replica=None):
+        """A request was evicted from its slot for later recompute
+        (`pool_pressure`) or failed out (`budget_spent`)."""
+        self.record("preempt", request_id=request_id, slot=slot,
+                    reason=reason, victim_for=victim_for,
+                    preemptions=preemptions, round=round, replica=replica)
+
+    def hop(self, kind, request_id=None, trace_id=None,
+            local_request_id=None, src=None, dst=None, round=None,
+            **extra):
+        """Fleet-plane movement (see HOP_KINDS). `src`/`dst` are
+        replica ids; `local_request_id` is the hop-local scheduler
+        request id (correlates with that replica's scheduler events)."""
+        self.record("hop", kind=kind, request_id=request_id,
+                    trace_id=trace_id, local_request_id=local_request_id,
+                    src=src, dst=dst, round=round, **extra)
+
+    def complete(self, request, origin="scheduler", round=None,
+                 replica=None, migrations=None):
+        """Request finished (any finish reason). The output digest is
+        what replay verifies against; wall timings live in the `wall`
+        sidecar so the replay-relevant payload stays run-deterministic."""
+        toks = list(request.output_tokens)
+        wall = {}
+        for name in ("ttft", "latency", "tpot"):
+            v = getattr(request, name, None)
+            if v is not None:
+                wall[name + "_s"] = round_s(v)
+        self.record(
+            "complete", origin=origin,
+            request_id=request.request_id,
+            trace_id=getattr(request, "trace_id", None),
+            tenant=getattr(request, "tenant", "default"),
+            finish_reason=request.finish_reason,
+            error=None if request.error is None else str(request.error),
+            n_tokens=len(toks),
+            output_sha=token_digest(toks),
+            seed=getattr(request, "seed", None),
+            migrations=migrations,
+            round=round, replica=replica,
+            wall=wall or None)
+
+    def incident(self, rule, bundle, severity=None, **detail):
+        """An alert latched firing and an incident bundle was written."""
+        self.record("incident", rule=rule, severity=severity,
+                    bundle=bundle, **detail)
+
+    # ------------------------------------------------------------------
+    # incident bundles
+    # ------------------------------------------------------------------
+
+    def incident_bundle(self, rule, severity=None, detail=None):
+        """Snapshot a self-contained incident bundle directory:
+
+        - ``journal.jsonl``  — the ring tail (last-N journal events)
+        - ``history.json``   — `telemetry.snapshot_history()` (the
+          sampler's metric time-series, when a sampler is installed)
+        - ``manifest.json``  — rule/severity/detail, run id, recorder
+          meta + harness config, event counts
+
+        Returns the bundle path, or None when `bundle_dir` is unset.
+        """
+        if self.bundle_dir is None:
+            return None
+        with self._lock:
+            self._bundle_seq += 1
+            n = self._bundle_seq
+            tail = list(self._recent)
+        dirname = os.path.join(self.bundle_dir, f"incident-{n:03d}-{rule}")
+        os.makedirs(dirname, exist_ok=True)
+        with open(os.path.join(dirname, "journal.jsonl"), "w",
+                  encoding="utf-8") as f:
+            for ev in tail:
+                f.write(json.dumps(ev, allow_nan=False) + "\n")
+        try:
+            history = telemetry.snapshot_history()
+        except Exception:
+            history = None
+        with open(os.path.join(dirname, "history.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(_json_safe(history), f, sort_keys=True)
+        manifest = {
+            "version": 1,
+            "rule": rule,
+            "severity": severity,
+            "detail": _json_safe(detail) if detail else None,
+            "run_id": self._run_id,
+            "meta": self.meta,
+            "harness": self._run_start_fields,
+            "counts": dict(self._counts),
+            "events": len(tail),
+        }
+        with open(os.path.join(dirname, "manifest.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(manifest, f, sort_keys=True, indent=2)
+        self.incident(rule=rule, bundle=dirname, severity=severity)
+        return dirname
+
+
+class _Missing:
+    def __repr__(self):
+        return "<missing>"
+
+
+_MISSING = _Missing()
+
+_current = None
+_current_lock = threading.Lock()
+
+
+def set_recorder(recorder):
+    """Install `recorder` as the process-wide black box (None detaches).
+    Returns the previous recorder."""
+    global _current
+    with _current_lock:
+        prev = _current
+        _current = recorder
+    return prev
+
+
+def get_recorder():
+    """The active recorder, or None. Every serving emission site gates
+    on this — detached recording is a single global read."""
+    return _current
+
+
+@contextlib.contextmanager
+def recording(recorder):
+    """Scope `recorder` as the active black box (crash-flush on exit)."""
+    with recorder:
+        yield recorder
+
+
+def read_journal(path):
+    """Parse a JSONL journal strictly (raises on malformed lines)."""
+    events = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(
+                    f"{path}:{lineno}: malformed journal line: {e}") \
+                    from None
+    return events
+
+
+def round_s(v, ndigits=6):
+    try:
+        return round(float(v), ndigits)
+    except (TypeError, ValueError):
+        return None
+
+
+# ----------------------------------------------------------------------
+# replay-relevant view + per-request traces
+# ----------------------------------------------------------------------
+
+def replay_view(events):
+    """The replay-relevant payload of a journal: events minus the
+    wall-clock fields (`ts`, the `wall` sidecar) and the per-run random
+    `run_id`, with process-lifetime request/trace ids normalized to
+    first-appearance ordinals. Two runs that made identical decisions
+    yield views whose `json.dumps(..., sort_keys=True)` are
+    byte-identical — the determinism tests and replay divergence diffs
+    both compare exactly that."""
+    req_map, trace_map = {}, {}
+
+    def norm(table, v):
+        if v is None:
+            return None
+        if v not in table:
+            table[v] = len(table) + 1
+        return table[v]
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            out = {}
+            for k, v in obj.items():
+                if k in REPLAY_EXCLUDED:
+                    continue
+                if k in _REQ_ID_FIELDS:
+                    out[k] = norm(req_map, v)
+                elif k in _TRACE_ID_FIELDS:
+                    out[k] = norm(trace_map, v)
+                else:
+                    out[k] = walk(v)
+            return out
+        if isinstance(obj, list):
+            return [walk(x) for x in obj]
+        return obj
+
+    return [walk(ev) for ev in events]
+
+
+def request_traces(events, limit=None):
+    """Group journal events into per-request decision timelines.
+
+    Fleet requests (their hops share a `trace_id`) fold into a single
+    trace; hop-local scheduler events are folded in via the dispatch
+    hop's `local_request_id`. Returns traces in first-submission order;
+    `limit` keeps only the most recent N (what `/debug/requests`
+    serves)."""
+    traces = {}
+    order = []
+    rid_to_key = {}      # request_id (incl. hop-local) -> trace key
+
+    def key_for(ev):
+        if ev.get("trace_id") is not None:
+            return ("t", ev["trace_id"])
+        if ev.get("request_id") is not None:
+            return ("r", ev["request_id"])
+        return None
+
+    def get_trace(key, ev):
+        tr = traces.get(key)
+        if tr is None:
+            tr = traces[key] = {
+                "request_id": ev.get("request_id"),
+                "trace_id": ev.get("trace_id"),
+                "tenant": ev.get("tenant"),
+                "seed": ev.get("seed"),
+                "events": [],
+            }
+            order.append(key)
+        return tr
+
+    def compact(ev):
+        out = {}
+        for k, v in ev.items():
+            if k in ("ts", "run_id", "prompt", "members"):
+                continue
+            if v is None:
+                continue
+            out[k] = v
+        return out
+
+    for ev in events:
+        name = ev.get("ev")
+        if name == "wave":
+            # fan wave membership out to each member's trace
+            for m in ev.get("members") or ():
+                key = rid_to_key.get(m.get("request_id"))
+                if key is None or key not in traces:
+                    continue
+                traces[key]["events"].append({
+                    "seq": ev.get("seq"), "ev": "wave",
+                    "wave_id": ev.get("wave_id"), "slot": m.get("slot"),
+                    "tokens": m.get("tokens"), "round": ev.get("round"),
+                    "replica": ev.get("replica"),
+                    "spec_proposed": ev.get("spec_proposed"),
+                    "spec_accepted": ev.get("spec_accepted"),
+                })
+            continue
+        if name not in ("submit", "admission", "preempt", "hop",
+                        "complete"):
+            continue
+        rid = ev.get("request_id")
+        lrid = ev.get("local_request_id")
+        if name == "submit":
+            key = rid_to_key.get(rid) or key_for(ev)
+            if rid is not None:
+                rid_to_key[rid] = key
+        else:
+            key = rid_to_key.get(rid) or key_for(ev)
+        if key is None:
+            continue
+        tr = get_trace(key, ev)
+        if lrid is not None:
+            rid_to_key[lrid] = key
+        if name == "submit":
+            for field in ("tenant", "seed"):
+                if tr.get(field) is None and ev.get(field) is not None:
+                    tr[field] = ev[field]
+            # first submit wins: migration/handoff continuation
+            # re-submits must not masquerade as the client's prompt
+            if tr.get("prompt_len") is None:
+                tr["prompt_len"] = ev.get("prompt_len")
+                tr["prompt_sha"] = ev.get("prompt_sha")
+                tr["sampling"] = ev.get("sampling")
+        elif name == "complete":
+            tr["finish_reason"] = ev.get("finish_reason")
+            tr["n_tokens"] = ev.get("n_tokens")
+            tr["output_sha"] = ev.get("output_sha")
+            if ev.get("migrations") is not None:
+                tr["migrations"] = ev["migrations"]
+            if ev.get("wall") is not None:
+                tr["wall"] = ev["wall"]
+        tr["events"].append(compact(ev))
+
+    out = [traces[k] for k in order]
+    if limit is not None and len(out) > limit:
+        out = out[-limit:]
+    return out
+
+
+def _debug_requests_payload():
+    """`/debug/requests` provider: recent per-request decision traces
+    from the active recorder's ring (empty when detached)."""
+    rec = get_recorder()
+    if rec is None:
+        return {"recording": False, "requests": []}
+    return {"recording": True,
+            "requests": request_traces(rec.events(), limit=32)}
+
+
+# utils must not import serving; the debug endpoint reaches the black
+# box through this provider hook instead.
+telemetry.set_debug_requests_provider(_debug_requests_payload)

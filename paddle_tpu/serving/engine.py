@@ -185,6 +185,11 @@ class ServingEngine:
         self.cache_dtype = (cache_dtype if cache_dtype is not None
                             else _infer_cache_dtype(self._params))
         self._caches = self._make_caches()
+        # the one PRNG chain every sampled request on this engine draws
+        # from — recorded (blackbox `run_start` harness / per-request
+        # seed provenance) so a fresh engine built with the same seed
+        # replays sampled streams token-exact
+        self.seed = int(seed)
         self._key = jax.random.PRNGKey(seed)
 
         # host-authoritative per-slot state
@@ -450,6 +455,17 @@ class ServingEngine:
         """Slots admitted but still mid-prefill (paged chunked prefill;
         at most one scheduling round for the dense engine)."""
         return sorted(self._pending_prefill)
+
+    def describe(self):
+        """Replay-relevant construction config. The black-box journal
+        records this in `run_start` harness metadata so
+        scripts/replay_incident.py can rebuild an identical engine
+        (same seed => same PRNG chain => sampled streams replay
+        token-exact)."""
+        return {"engine": "dense", "num_slots": self.num_slots,
+                "max_len": self.max_len, "prefill_len": self.prefill_len,
+                "seed": self.seed,
+                "cache_dtype": np.dtype(self.cache_dtype).name}
 
     def validate_prompt(self, prompt):
         """Admission check: the prompt must fit the prefill bucket and

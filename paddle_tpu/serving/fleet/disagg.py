@@ -35,6 +35,7 @@ resolve at fleet admission, and per-tenant SLO windows are fed from
 fleet-level finalizations.
 """
 from ...utils import telemetry
+from .. import blackbox
 from ..scheduler import ROLES  # noqa: F401  (re-exported convenience)
 from .migration import FleetRequest
 from .qos import as_manager
@@ -147,6 +148,14 @@ class DisaggFleetRouter(FleetRouter):
             fr.trace_id, "HANDOFF", src=src_id,
             blocks=len(payload["manifest"]), nbytes=payload["nbytes"],
             tokens_so_far=len(fr._prior))
+        bb = blackbox.get_recorder()
+        if bb is not None:
+            bb.hop(kind="handoff", request_id=fr.request_id,
+                   trace_id=fr.trace_id, src=src_id,
+                   digest=payload["digest"],
+                   blocks=len(payload["manifest"]),
+                   nbytes=payload["nbytes"],
+                   tokens_so_far=len(fr._prior), round=self._round)
         fr._handoff_payload = payload
         try:
             self._dispatch(fr, continuation=True)

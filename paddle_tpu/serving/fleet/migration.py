@@ -80,6 +80,11 @@ class FleetRequest:
         # consumed by the NEXT dispatch (the decode hop's admission
         # imports it instead of re-running prefill), then cleared
         self._handoff_payload = None
+        # seed provenance (serving/blackbox.py): the first hop's
+        # scheduler stamps its engine's PRNG-chain seed on the hop-local
+        # Request; _attach copies it here so the fleet handle names the
+        # seed its sampled stream started from
+        self.seed = None
 
         self.submit_time = None      # stamped once, at fleet admission
         self.migrations = 0
@@ -248,6 +253,8 @@ class FleetRequest:
     def _attach(self, replica, request):
         self.replica = replica
         self.current = request
+        if self.seed is None:       # first hop wins: later hops replay
+            self.seed = getattr(request, "seed", None)
 
     def _finalize(self, reason, error=None):
         self.finish_reason = reason
@@ -263,6 +270,7 @@ class FleetRequest:
 
     def __repr__(self):
         return (f"FleetRequest(id={self.request_id}, state={self.state}, "
+                f"tenant={self.tenant!r}, seed={self.seed}, "
                 f"generated={len(self.output_tokens)}/{self.max_tokens}, "
                 f"migrations={self.migrations}, "
                 f"finish={self.finish_reason})")

@@ -30,6 +30,7 @@ evaluate) — a fleet without one behaves exactly as before (tenant
 import threading
 
 from ...utils import flight_recorder, telemetry
+from .. import blackbox
 from ..slo import SLOEngine, SLOPolicy
 
 _TENANT_ATTAINMENT = telemetry.gauge(
@@ -153,6 +154,18 @@ class QoSManager:
                     / self.tenant(name).weight)
             if best_cost is None or cost < best_cost:
                 best_i, best_cost = i, cost
+        bb = blackbox.get_recorder()
+        if bb is not None and len(queued) > 1:
+            # journal only non-trivial picks: a 1-deep queue is FCFS
+            # whatever the weights say
+            req = queued[best_i]
+            bb.admission(getattr(req, "request_id", None),
+                         verdict="picked", basis="weighted_fair",
+                         tenant=getattr(req, "tenant", DEFAULT_TENANT),
+                         trace_id=getattr(req, "trace_id", None),
+                         queue_index=best_i,
+                         cost=None if best_cost is None
+                         else round(best_cost, 4))
         return best_i
 
     # ------------------------------------------------------------- windows

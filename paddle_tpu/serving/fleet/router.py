@@ -39,6 +39,7 @@ import threading
 
 from ...utils import (chaos, flight_recorder, profiler, telemetry,
                       timeseries)
+from .. import blackbox
 from ..slo import as_engine as _slo_as_engine
 from .metrics import FleetMetrics, FleetRegistry
 from .migration import DEFAULT_MAX_MIGRATIONS, FleetRequest
@@ -117,6 +118,11 @@ class FleetRouter:
         self._target = int(replicas)         # replacement/scale target
         self._rr = 0
         self._idle_rounds = 0
+        # fleet-round counter stamping every journaled routing decision
+        # (serving/blackbox.py): replay re-forces recorded kills at the
+        # same round boundary, so the counter ticks at the TOP of
+        # step(), before the chaos kill check
+        self._round = 0
         # SLO-driven autoscale state (serving/slo.py)
         self.slo_engine = _slo_as_engine(slo)
         self._scale_cooldown = 0             # rounds until next burn
@@ -151,6 +157,12 @@ class FleetRouter:
         if request is None:
             request = FleetRequest(**kw)
         request._mark_submitted()
+        bb = blackbox.get_recorder()
+        if bb is not None:
+            # the fleet-origin submit is what window replay re-submits
+            # (hop-local scheduler submits carry origin="scheduler" and
+            # correlate through the shared trace_id)
+            bb.submit(request, origin="fleet", round=self._round)
         # live BEFORE dispatch: _retire_replica scans _live for a dead
         # replica's work, and a request attached concurrently with the
         # retirement must be visible to that scan or it is never
@@ -248,6 +260,14 @@ class FleetRouter:
             telemetry.trace_flow_step(
                 fr.trace_id, "DISPATCH", replica=replica.replica_id,
                 policy=policy, continuation=bool(continuation))
+            bb = blackbox.get_recorder()
+            if bb is not None:
+                bb.hop(kind="dispatch", request_id=fr.request_id,
+                       trace_id=fr.trace_id,
+                       local_request_id=req.request_id,
+                       dst=replica.replica_id, policy=policy,
+                       continuation=bool(continuation),
+                       round=self._round)
             self.metrics.on_routed(policy)
             if lost:
                 self._migrate(fr, reason="retired mid-dispatch",
@@ -267,6 +287,7 @@ class FleetRouter:
         degraded (migrating their work), finalize completions, and
         autoscale. Returns the number of unresolved fleet requests."""
         with self._step_lock:
+            self._round += 1
             if chaos.enabled():
                 hit = chaos.value(chaos.REPLICA_KILL)
                 if hit is not None:
@@ -365,6 +386,15 @@ class FleetRouter:
             rec.fault(kind="replica_" + reason, action="replace",
                       error=f"replica {replica.replica_id}",
                       role=getattr(replica, "role", "unified"))
+        bb = blackbox.get_recorder()
+        if bb is not None:
+            # replay re-forces kill-reason retirements at this round
+            # boundary (degraded retirements re-derive from the replayed
+            # engine's own faults)
+            bb.hop(kind="replica_retire", src=replica.replica_id,
+                   reason=str(reason),
+                   role=getattr(replica, "role", "unified"),
+                   round=self._round)
         if self.auto_replace:
             with self._lock:
                 short = sum(1 for r in self.replicas
@@ -438,6 +468,12 @@ class FleetRouter:
         telemetry.trace_flow_step(
             fr.trace_id, "MIGRATE", src=src_id, reason=str(reason),
             migration=fr.migrations, tokens_so_far=len(fr._prior))
+        bb = blackbox.get_recorder()
+        if bb is not None:
+            bb.hop(kind="migrate", request_id=fr.request_id,
+                   trace_id=fr.trace_id, src=src_id,
+                   reason=str(reason), migration=fr.migrations,
+                   tokens_so_far=len(fr._prior), round=self._round)
         self._dispatch(fr, continuation=True)
         if fr.replica is not None:
             self.metrics.on_migration(request_id=fr.request_id,
@@ -480,6 +516,12 @@ class FleetRouter:
         with self._lock:
             if fr in self._live:
                 self._live.remove(fr)
+        bb = blackbox.get_recorder()
+        if bb is not None:
+            # fleet-origin completion: the STITCHED output stream across
+            # every hop — the digest window replay verifies against
+            bb.complete(fr, origin="fleet", migrations=fr.migrations,
+                        round=self._round)
         self._observe_slo(fr)
 
     def _finalize_completed(self):
@@ -494,6 +536,10 @@ class FleetRouter:
         replica = self.supervisor.spawn(role=role)
         with self._lock:
             self.replicas.append(replica)
+        bb = blackbox.get_recorder()
+        if bb is not None:
+            bb.hop(kind="replica_spawn", dst=replica.replica_id,
+                   role=role, restart=bool(restart), round=self._round)
         if restart:
             self.metrics.on_restart()
         return replica

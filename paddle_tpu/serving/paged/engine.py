@@ -40,6 +40,7 @@ import hashlib
 
 from ...nn import paged_attention
 from ...utils import chaos, telemetry
+from .. import blackbox
 from ..engine import (ServingEngine, _filter_top_k_top_p, _raw,
                       _select_first_token, _select_wave_tokens)
 from .block_pool import BlockPool, BlockPoolExhausted
@@ -187,6 +188,17 @@ class PagedServingEngine(ServingEngine):
         else:
             self._decode_wave = decode_wave
             self._prefill = prefill_chunk
+
+    def describe(self):
+        """Replay-relevant construction config (see ServingEngine
+        .describe): the paged extras on top of the dense fields."""
+        d = super().describe()
+        d.update({"engine": "paged", "block_size": self.block_size,
+                  "num_blocks": self.block_pool.num_blocks,
+                  "prefill_chunk_len": self.prefill_chunk_len,
+                  "prefix_sharing": self.prefix_sharing,
+                  "paged_kernel": self.paged_kernel})
+        return d
 
     # --------------------------------------------------------- admission
     def validate_prompt(self, prompt):
@@ -358,7 +370,7 @@ class PagedServingEngine(ServingEngine):
         layers = [np.asarray(x)
                   for x in self._handoff_gather_fn(self._caches, idx)]
         n = int(self.slot_pos[slot])
-        return {
+        payload = {
             "version": HANDOFF_VERSION,
             "n_tokens": n,
             "next_token": int(self.slot_tok[slot]),
@@ -369,6 +381,12 @@ class PagedServingEngine(ServingEngine):
             "nbytes": sum(a.nbytes for a in layers),
             "digest": _handoff_digest(layers, n, self.block_size),
         }
+        bb = blackbox.get_recorder()
+        if bb is not None:
+            bb.hop(kind="kv_export", slot=slot, digest=payload["digest"],
+                   blocks=payload["blocks"], nbytes=payload["nbytes"],
+                   n_tokens=n)
+        return payload
 
     def import_handoff(self, slot, prompt, payload, do_sample=False,
                        temperature=1.0, top_k=0, top_p=1.0,
@@ -467,6 +485,10 @@ class PagedServingEngine(ServingEngine):
                        self._sampling_state(do_sample, temperature, top_k,
                                             top_p, logit_bias,
                                             dynamic_mask))
+        bb = blackbox.get_recorder()
+        if bb is not None:
+            bb.hop(kind="kv_import", slot=slot, digest=payload["digest"],
+                   blocks=nblk, nbytes=payload.get("nbytes"), n_tokens=n)
         return first
 
     # ------------------------------------------------------------- waves
@@ -703,6 +725,11 @@ class SpeculativePagedEngine(PagedServingEngine):
         self.last_spec_proposed = 0
         self.last_spec_accepted = 0
         super().__init__(model, **kw)
+
+    def describe(self):
+        d = super().describe()
+        d.update({"engine": "spec_paged", "spec_k": self.spec_k})
+        return d
 
     # ---------------------------------------------------------- caches
     def _make_caches(self):

@@ -119,6 +119,11 @@ class Request:
         # any LATER re-admission (preemption, migration) replays
         # normally from the prefix cache
         self.handoff = handoff
+        # resolved sampling-seed provenance: the scheduler stamps the
+        # engine's PRNG-chain seed here at submission (greedy requests
+        # too — the chain is shared), so a journaled sampled request
+        # names the seed that replays it (serving/blackbox.py)
+        self.seed = None
 
         self.state = RequestState.QUEUED
         self.slot = None                 # engine slot while PREFILL/DECODE
@@ -264,6 +269,7 @@ class Request:
 
     def __repr__(self):
         return (f"Request(id={self.request_id}, state={self.state}, "
+                f"tenant={self.tenant!r}, seed={self.seed}, "
                 f"prompt_len={len(self.prompt)}, "
                 f"generated={len(self.output_tokens)}/{self.max_tokens}, "
                 f"finish={self.finish_reason})")

@@ -58,6 +58,7 @@ import time
 
 from ..utils import flight_recorder, profiler, telemetry
 from ..utils.profiler import RecordEvent
+from . import blackbox
 from .metrics import ServingMetrics
 from .paged.block_pool import BlockPoolExhausted
 from .request import Request, RequestState
@@ -174,6 +175,17 @@ class Scheduler:
         # growth would leak every prompt ever served on a long-running
         # server. completed_log=None keeps everything (tests/benches).
         self.completed = collections.deque(maxlen=completed_log)
+        # black-box journal coordinates (serving/blackbox.py): the
+        # scheduling-round counter stamps every journaled decision so
+        # replay can re-submit and re-fault in the same round order;
+        # the wave counter names waves in `wave` events
+        self._round = 0
+        self._wave_seq = 0
+
+    def _replica_ord(self):
+        """This scheduler's fleet replica id for journal events (the
+        chrome-trace pid is replica_id + 1; None = single-engine)."""
+        return self.trace_pid - 1 if self.trace_pid else None
 
     # ------------------------------------------------------ observability
     def attach_timeseries(self, sampler=None, alerts=None):
@@ -219,9 +231,23 @@ class Scheduler:
         if self.role == "prefill" and request.handoff is not None:
             raise ValueError(
                 "prefill-role replica cannot import a handoff payload")
+        # seed provenance: stamp the engine's PRNG-chain seed on the
+        # request (greedy too — the chain is shared) so the journal
+        # names the seed that replays it; an already-stamped seed (a
+        # fleet hop's continuation) wins
+        if request.seed is None:
+            request.seed = getattr(self.engine, "seed", None)
+        bb = blackbox.get_recorder()
         why = self.engine.validate_prompt(request.prompt)
         if why is not None:
             self.metrics.on_reject()
+            if bb is not None:
+                bb.admission(request.request_id, verdict="rejected",
+                             reason="invalid_prompt",
+                             tenant=request.tenant,
+                             trace_id=request.trace_id,
+                             round=self._round,
+                             replica=self._replica_ord())
             request._reject(why)           # raises ValueError
         with self._lock:
             if self._degraded:
@@ -239,7 +265,16 @@ class Scheduler:
                 depth = len(self._queue)
         if shed is not None:
             self.metrics.on_reject()
+            if bb is not None:
+                bb.admission(request.request_id, verdict="shed",
+                             reason=shed, tenant=request.tenant,
+                             trace_id=request.trace_id,
+                             round=self._round,
+                             replica=self._replica_ord())
             request._reject(shed)          # raises ValueError
+        if bb is not None:
+            bb.submit(request, round=self._round,
+                      replica=self._replica_ord())
         self.metrics.on_submit()
         self.metrics.on_queue_depth(depth)
         return request
@@ -334,6 +369,8 @@ class Scheduler:
         it; an exhausted block pool is CAPACITY, not a request fault —
         the head request waits for blocks to free (or is rejected when
         nothing in flight could ever free them)."""
+        bb = blackbox.get_recorder()
+        rep = self._replica_ord()
         while True:
             free = self.engine.free_slots()
             if not free:
@@ -379,12 +416,25 @@ class Scheduler:
                         req._cache_waiting = True
                         self._fault("cache_exhausted", action="requeued",
                                     request=req, error=e)
+                        if bb is not None:
+                            bb.admission(req.request_id,
+                                         verdict="deferred",
+                                         reason="cache_exhausted",
+                                         tenant=req.tenant,
+                                         trace_id=req.trace_id,
+                                         round=self._round, replica=rep)
                     self._requeue_front(req)
                     return
                 # nothing in flight to free blocks — shed cleanly
                 self.metrics.on_reject()
                 self._fault("cache_exhausted", action="rejected",
                             request=req, error=e)
+                if bb is not None:
+                    bb.admission(req.request_id, verdict="rejected",
+                                 reason="cache_exhausted",
+                                 tenant=req.tenant,
+                                 trace_id=req.trace_id,
+                                 round=self._round, replica=rep)
                 req._reject(f"KV cache exhausted ({e})",
                             raise_error=False)
                 self.completed.append(req)
@@ -409,6 +459,13 @@ class Scheduler:
                 if self._prefill_fault(req, slot):
                     return
                 continue
+            if bb is not None:
+                bb.admission(req.request_id, verdict="admitted",
+                             slot=slot, tenant=req.tenant,
+                             basis=("handoff" if handoff is not None
+                                    else "prefill"),
+                             trace_id=req.trace_id,
+                             round=self._round, replica=rep)
             # handoff consumed one-shot: a LATER re-admission of this
             # request (preemption, migration) replays from the prefix
             # cache like any other continuation
@@ -546,6 +603,10 @@ class Scheduler:
 
     def _complete(self, req):
         self.completed.append(req)
+        bb = blackbox.get_recorder()
+        if bb is not None:
+            bb.complete(req, round=self._round,
+                        replica=self._replica_ord())
         self.metrics.on_complete(req)
         if self.slo_engine is not None:
             self.slo_engine.observe_request(req)
@@ -719,14 +780,16 @@ class Scheduler:
                 victim = slot
         return victim
 
-    def _evict_for_recompute(self, slot):
+    def _evict_for_recompute(self, slot, victim_for=None):
         """Preemption-by-recompute of one lane: free the slot's blocks,
         requeue the request with prompt + generated tokens (the freed
         blocks' prefix hashes make the re-prefill mostly cache hits). A
         request past its preemption budget, or one whose continuation
         could never fit the pool, resolves "error" instead of
-        livelocking."""
+        livelocking. `victim_for` names the starved request this
+        eviction unblocks (priority preemption) for the journal."""
         req = self._slot_req[slot]
+        bb = blackbox.get_recorder()
         self.engine.retire_slot(slot)          # frees the blocks
         self._slot_req[slot] = None
         req.preemptions += 1
@@ -735,12 +798,23 @@ class Scheduler:
         if req.preemptions > self.max_preemptions or why is not None:
             self._fault("cache_exhausted", action="request_failed",
                         request=req, slot=slot)
+            if bb is not None:
+                bb.preempt(req.request_id, slot=slot,
+                           reason="budget_spent", victim_for=victim_for,
+                           preemptions=req.preemptions,
+                           round=self._round,
+                           replica=self._replica_ord())
             req._fail(why or "KV cache exhausted: preemption budget "
                              f"spent ({req.preemptions}x)")
             self._complete(req)
             return
         self._fault("cache_exhausted", action="preempted",
                     request=req, slot=slot)
+        if bb is not None:
+            bb.preempt(req.request_id, slot=slot, reason="pool_pressure",
+                       victim_for=victim_for,
+                       preemptions=req.preemptions, round=self._round,
+                       replica=self._replica_ord())
         self._requeue_front(req)
 
     def _preempt_starved(self):
@@ -755,11 +829,20 @@ class Scheduler:
                 continue     # already evicted as another lane's victim
                              # (or finished during this round's dispatch)
             victim = self._preemption_victim(slot)
-            self._evict_for_recompute(slot if victim is None else victim)
+            if victim is None:
+                self._evict_for_recompute(slot)
+            else:
+                self._evict_for_recompute(
+                    victim,
+                    victim_for=self._slot_req[slot].request_id)
 
     def _step_locked(self):
         if self._degraded:
             return 0
+        # round stamp for every decision journaled below: replay
+        # re-submits and re-faults in the same round order, so the
+        # counter must tick before ANY of this round's decisions
+        self._round += 1
         with RecordEvent("serving/admission", pid=self.trace_pid) as ev:
             self._admit()
         self.metrics.on_phase("admission", ev.elapsed)
@@ -782,6 +865,29 @@ class Scheduler:
                     flops=self._wave_cost.get("flops"),
                     bytes_accessed=self._wave_cost.get("bytes_accessed"))
                 self._record_spec_wave(waved)
+            bb = blackbox.get_recorder()
+            if bb is not None and toks:
+                # membership captured from `toks` BEFORE the dispatch
+                # loop below retires finished slots (after it, the
+                # slot->request map may already be cleared)
+                self._wave_seq += 1
+                bb.wave(
+                    self._wave_seq,
+                    members=[{"slot": s,
+                              "request_id": self._slot_req[s].request_id,
+                              "tokens": (len(t) if isinstance(t, list)
+                                         else 1)}
+                             for s, t in sorted(toks.items())
+                             if self._slot_req[s] is not None],
+                    starved=sorted(self.engine.last_starved_slots)
+                    or None,
+                    nonfinite=sorted(self.engine.last_nonfinite_slots)
+                    or None,
+                    spec_proposed=getattr(self.engine,
+                                          "last_spec_proposed", None),
+                    spec_accepted=getattr(self.engine,
+                                          "last_spec_accepted", None),
+                    round=self._round, replica=self._replica_ord())
             # fused-sentinel fallout: retire ONLY the poisoned lanes —
             # their requests resolve with "error", healthy neighbours
             # stream on token-identically (proven in chaos_serving)

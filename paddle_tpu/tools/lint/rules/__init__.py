@@ -1,3 +1,3 @@
 """Importing this package registers every built-in ptlint rule."""
-from . import (alert_rules, chaos_guard, hygiene, locks,  # noqa: F401
-               metric_names, tracer)
+from . import (alert_rules, chaos_guard, event_kinds,  # noqa: F401
+               hygiene, locks, metric_names, tracer)

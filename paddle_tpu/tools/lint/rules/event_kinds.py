@@ -1,0 +1,123 @@
+"""event-kind-documented: every literal `kind=` at a flight-recorder
+fault site or black-box hop site is declared in the owning module's
+kind tuple AND cataloged in docs/observability.md.
+
+Same contract as metric-name and alert-rule-documented, for the
+journal planes: `utils/flight_recorder.py` declares `FAULT_KINDS` (the
+closed vocabulary of `fault` events) and `serving/blackbox.py` declares
+`HOP_KINDS` (the fleet-hop vocabulary of the black-box journal).  A
+kind invented at a call site but absent from the tuples is invisible to
+the runlog summarizer's rollups and to replay; a kind absent from the
+doc leaves an operator grepping a journal with no schema to look up.
+Kinds are read from the first positional argument or the `kind=`
+keyword of `.fault(...)` / `._fault(...)` / `.hop(...)` calls, with
+module-level string constants resolved; dynamically-built kinds (the
+router's "replica_" + reason family, the scheduler's taxonomy fan-in)
+are out of scope, the same escape hatch the sibling rules leave.
+"""
+import ast
+import os
+import re
+
+from ..core import Rule, register
+from ..astutil import last_name
+from .metric_names import module_consts, registered_names
+
+KIND_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+#: method name -> (source module, tuple names) the kind must appear in
+KIND_METHODS = {
+    "fault": ("paddle_tpu/utils/flight_recorder.py", ("FAULT_KINDS",)),
+    "_fault": ("paddle_tpu/utils/flight_recorder.py", ("FAULT_KINDS",)),
+    "hop": ("paddle_tpu/serving/blackbox.py", ("HOP_KINDS",)),
+}
+
+_KINDS_CACHE = {}        # path -> (mtime_ns, {tuple_name: frozenset})
+
+
+def _declared_in(repo_root, rel_path, tuple_names):
+    """The union of the named module-level string tuples in rel_path,
+    or None when the module is missing/unparseable — rules distinguish
+    'no vocabulary here' from 'vocabulary rejects this'.  Cached per
+    (path, mtime) like the docs catalog."""
+    path = os.path.abspath(os.path.join(repo_root, rel_path))
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        _KINDS_CACHE.pop(path, None)
+        return None
+    cached = _KINDS_CACHE.get(path)
+    if cached is None or cached[0] != mtime:
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return None
+        tuples = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Tuple):
+                vals = [e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+                tuples[node.targets[0].id] = frozenset(vals)
+        _KINDS_CACHE[path] = cached = (mtime, tuples)
+    out = set()
+    found = False
+    for name in tuple_names:
+        vals = cached[1].get(name)
+        if vals is not None:
+            found = True
+            out.update(vals)
+    return out if found else None
+
+
+def kind_sites(tree):
+    """Yield (node, method, kind) for every resolvable fault/hop call."""
+    consts = module_consts(tree)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in KIND_METHODS):
+            continue
+        arg = node.args[0] if node.args else None
+        if arg is None:
+            for kw in node.keywords:
+                if kw.arg == "kind":
+                    arg = kw.value
+                    break
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            yield node, node.func.attr, arg.value
+        elif isinstance(arg, ast.Name) and arg.id in consts:
+            yield node, node.func.attr, consts[arg.id]
+
+
+@register
+class EventKindDocumented(Rule):
+    id = "event-kind-documented"
+    rationale = ("the recorder kind tuples and docs/observability.md "
+                 "are the journal schema of record; an undeclared kind "
+                 "is invisible to the runlog rollups and to incident "
+                 "replay.")
+
+    def check(self, ctx):
+        allow = registered_names(ctx.repo_root)
+        for node, method, kind in kind_sites(ctx.tree):
+            rel_path, tuple_names = KIND_METHODS[method]
+            declared = _declared_in(ctx.repo_root, rel_path, tuple_names)
+            if not KIND_RE.match(kind):
+                yield ctx.finding(
+                    self.id, node,
+                    f"event kind {kind!r} is not snake_case "
+                    "([a-z][a-z0-9_]*)")
+            elif declared is not None and kind not in declared:
+                yield ctx.finding(
+                    self.id, node,
+                    f"event kind {kind!r} is not declared in "
+                    f"{'/'.join(tuple_names)} of {rel_path}")
+            elif allow is not None and kind not in allow:
+                yield ctx.finding(
+                    self.id, node,
+                    f"event kind {kind!r} is not documented in "
+                    "docs/observability.md")

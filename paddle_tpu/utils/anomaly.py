@@ -29,6 +29,7 @@ ptlint rule enforces it, same contract as metric names.
 """
 
 import math
+import sys
 import threading
 
 from . import flight_recorder, telemetry
@@ -348,6 +349,10 @@ class AlertManager:
         self._lock = threading.Lock()
         self._state = {}
         self.check_errors = 0
+        #: path of the most recent incident bundle snapped by a firing
+        #: transition (None until a black-box recorder with a bundle_dir
+        #: is attached and a rule latches)
+        self.last_bundle = None
         for rule in self.rules:
             self._state[rule.id] = {"active": False, "fired": 0,
                                     "cleared": 0, "last": None}
@@ -379,12 +384,37 @@ class AlertManager:
                     _FIRED.labels(rule=rule.id).inc()
                 _ACTIVE.labels(rule=rule.id).set(1.0 if firing else 0.0)
                 detail = {k: v for k, v in res.items() if k != "firing"}
+                if firing:
+                    bundle = self._snapshot_incident(rule, detail)
+                    if bundle is not None:
+                        detail["bundle"] = bundle
+                        self.last_bundle = bundle
                 rec = self._recorder or flight_recorder.get_recorder()
                 if rec is not None:
                     rec.alert(rule=rule.id, action=action,
                               severity=rule.severity, **detail)
                 transitions.append((rule.id, action))
         return transitions
+
+    def _snapshot_incident(self, rule, detail):
+        """Freeze a self-contained incident bundle through the serving
+        black-box recorder, if one is attached with a bundle_dir.
+        Resolved through sys.modules, not an import: utils must not
+        depend on serving, and a recorder can only exist if the blackbox
+        module was already imported by whoever installed it."""
+        bb_mod = sys.modules.get("paddle_tpu.serving.blackbox")
+        if bb_mod is None:
+            return None
+        try:
+            bb = bb_mod.get_recorder()
+            if bb is None or bb.bundle_dir is None:
+                return None
+            return bb.incident_bundle(rule=rule.id,
+                                      severity=rule.severity,
+                                      detail=dict(detail))
+        except Exception:   # noqa: BLE001 — observer, not actor
+            self.check_errors += 1
+            return None
 
     # ------------------------------------------------------------- readers
     def active(self):

@@ -45,6 +45,21 @@ EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
                "resume", "reshard", "hang", "slo", "alert", "spec",
                "run_end")
 
+#: every `kind=` a `fault` event may carry.  The closed vocabulary is
+#: what makes journals greppable and the runlog summarizer's fault
+#: rollup stable; a NEW kind must be added here AND documented in
+#: docs/observability.md — the `event-kind-documented` ptlint rule
+#: enforces both at every literal call site.  The `replica_killed` /
+#: `replica_degraded` pair is emitted dynamically by the fleet router
+#: ("replica_" + retire reason), so the members are declared here even
+#: though no literal call site spells them out.
+FAULT_KINDS = ("nonfinite", "wave_error", "prefill_error",
+               "callback_error", "token_mask_error", "cache_exhausted",
+               "handoff_refused", "handoff_error", "degraded",
+               "collective_error", "reshard_config_drift",
+               "replica_killed", "replica_degraded", "replica_migration",
+               "replica_handoff", "replica_spawn_failed")
+
 
 def _json_safe(v):
     """JSON has no NaN/Inf literal; a diverged loss is exactly when the

@@ -758,6 +758,31 @@ def trace_instant(trace_id, name, pid=0, **args):
 # /metrics exporter (stdlib http.server, background thread)
 # ---------------------------------------------------------------------------
 
+_debug_requests_provider = None
+
+
+def set_debug_requests_provider(fn):
+    """Install the `/debug/requests` payload provider. The serving
+    black-box recorder (serving/blackbox.py) registers itself at import
+    time — utils must not import serving, so the endpoint reaches the
+    journal through this hook. `fn` takes no arguments and returns a
+    JSON-safe dict; None detaches (the endpoint then serves an empty
+    trace list)."""
+    global _debug_requests_provider
+    with _install_lock:
+        _debug_requests_provider = fn
+
+
+def _debug_requests_body():
+    fn = _debug_requests_provider
+    if fn is None:
+        return {"recording": False, "requests": []}
+    try:
+        return fn()
+    except Exception as e:   # noqa: BLE001 - report, not die
+        return {"recording": False, "requests": [], "error": repr(e)}
+
+
 def make_metrics_handler(registry=None, health_fn=None, sampler=None):
     reg = registry or REGISTRY
 
@@ -794,6 +819,13 @@ def make_metrics_handler(registry=None, health_fn=None, sampler=None):
                 body = timeseries.render_dashboard(_history()).encode()
                 ctype = "text/html; charset=utf-8"
                 code = 200
+            elif path == "/debug/requests":
+                # sorted keys, timestamp-free payload — same bytes
+                # discipline as /metrics/history
+                body = json.dumps(_debug_requests_body(),
+                                  sort_keys=True).encode()
+                ctype = "application/json"
+                code = 200
             elif path == "/healthz":
                 payload = {"status": "ok", "time_unix": time.time()}
                 if health_fn is not None:
@@ -811,7 +843,8 @@ def make_metrics_handler(registry=None, health_fn=None, sampler=None):
                 code = 200 if payload.get("status") == "ok" else 503
             else:
                 body = (b"not found; try /metrics /metrics.json "
-                        b"/metrics/history /dashboard /healthz\n")
+                        b"/metrics/history /dashboard /debug/requests "
+                        b"/healthz\n")
                 ctype = "text/plain"
                 code = 404
             self.send_response(code)

@@ -728,15 +728,101 @@ def scenario_noisy_tenant(engine, inject):
     return v
 
 
+def _model_meta():
+    """Replayable model-construction metadata for black-box `run_start`
+    harnesses (scripts/replay_incident.py rebuilds get_model() from
+    exactly this)."""
+    return {"arch": "llama", "vocab_size": VOCAB, "hidden_size": HIDDEN,
+            "num_layers": LAYERS, "num_heads": HEADS,
+            "num_kv_heads": KV_HEADS, "max_seq_len": MAX_LEN,
+            "init_seed": 7}
+
+
+def scenario_blackbox_replay(engine, inject):
+    """The black-box recorder's end-to-end proof: a 2-replica fleet
+    serving mixed greedy + seeded-sampling requests has a replica
+    KILLED mid-stream while the black box journals every decision; the
+    journal then replays on a freshly built fleet
+    (scripts/replay_incident.py) — re-forcing the recorded kill at the
+    same round boundary — and every request's regenerated output
+    digest must equal the recorded one, sampled requests included
+    (identical engine seeds -> identical PRNG chains).  --inject
+    no_journal runs the same stream with the recorder detached: the
+    journal never exists, replay must refuse, and the checker exits 1."""
+    from paddle_tpu.serving import blackbox, fleet
+    from scripts import replay_incident
+    v = []
+    tmp = tempfile.mkdtemp(prefix="chaos_blackbox_")
+    journal = os.path.join(tmp, "blackbox.jsonl")
+    prompts = _prompts(6)
+    router = fleet.FleetRouter(_paged_factory, replicas=2)
+    harness = {"model": _model_meta(),
+               "engine": router.replicas[0].engine.describe(),
+               "fleet": {"kind": "fleet", "replicas": 2}}
+    monkey = chaos.ChaosMonkey([chaos.Fault(
+        chaos.REPLICA_KILL, action="payload", payload=0, times=(2,))])
+
+    def drive():
+        reqs = []
+        for i, p in enumerate(prompts):
+            kw = {"prompt": p, "max_tokens": MAX_TOKENS}
+            if i % 2:
+                kw.update(do_sample=True, temperature=0.9, top_k=8)
+            reqs.append(router.submit(**kw))
+        # fleet-step invocation 2: the victim holds mid-stream work
+        with chaos.active(monkey):
+            router.run()
+        return reqs
+
+    if inject == "no_journal":
+        reqs = drive()               # recorder detached: no journal
+    else:
+        with blackbox.BlackBoxRecorder(path=journal) as bb:
+            bb.run_start(harness=harness)
+            reqs = drive()
+    _check(v, monkey.fired, "replica_kill injection never fired")
+    for i, r in enumerate(reqs):
+        _check(v, r.finish_reason == "max_tokens",
+               f"request {i} resolved {r.finish_reason!r} under the "
+               "recorded kill")
+    snap = router.metrics.snapshot()
+    _check(v, snap["migrations"] >= 1,
+           "the kill forced no migration — nothing worth replaying")
+    router.shutdown()
+    try:
+        rep = replay_incident.replay(journal, model=get_model())
+    except (replay_incident.UsageError, OSError) as e:
+        _check(v, False, f"black-box journal not replayable: {e}")
+        return v
+    _check(v, rep["verified"] == len(reqs),
+           f"replay verified {rep['verified']}/{len(reqs)} requests "
+           "(journal lost completions)")
+    _check(v, rep["ok"],
+           "replayed outputs diverged from the recorded digests: "
+           + "; ".join(f"request {r['request_id']} expect "
+                       f"{r.get('expect_sha')} got {r['got_sha']}"
+                       for r in rep["rows"] if r["ok"] is False))
+    _check(v, any(r["sampled"] and r["ok"] for r in rep["rows"]),
+           "no seeded-sampling request replayed token-exact")
+    _check(v, any(r["ok"] and not r["sampled"] for r in rep["rows"]),
+           "no greedy request replayed token-exact")
+    return v
+
+
 def scenario_latency_spike(engine, inject):
     """Anomaly-plane positive control: an injected decode-wave delay
     must fire the TTFT/TPOT anomaly alert (utils/anomaly.py) and then
     CLEAR once the detector's baseline absorbs the new level — slow is
     detected, and a one-time spike is a firing/cleared pair, not a
     latch.  Outputs stay token-exact (slow is not broken), and the
-    sampled history serves in-process.  --inject no_alerts evaluates
-    with an EMPTY rule set while the invariants still expect the alert
-    — the checker must fail."""
+    sampled history serves in-process.  The black box rides along:
+    the firing alert must snapshot an incident bundle whose journal
+    round-trips through scripts/replay_incident.py token-exact on the
+    same warmed engine.  --inject no_alerts evaluates with an EMPTY
+    rule set while the invariants still expect the alert — the checker
+    must fail."""
+    from paddle_tpu.serving import blackbox
+    from scripts import replay_incident
     v = []
     spike_rules = ("ttft_p99_anomaly", "tpot_p99_anomaly")
     prompts = _prompts()
@@ -756,41 +842,72 @@ def scenario_latency_spike(engine, inject):
             detector_kw={"warmup": 3, "z_fire": 3.0, "z_clear": 1.5,
                          "alpha": 0.3})
     am = anomaly.AlertManager(rules=rules)
-    sched = Scheduler(engine)
-    sched.attach_timeseries(sampler, am)
-    # fault-free stream first: seeds every detector's EWMA baseline
-    for p in prompts:
-        sched.submit(prompt=p, max_tokens=MAX_TOKENS)
-    sched.run()
-    monkey = chaos.ChaosMonkey([chaos.Fault(
-        chaos.DECODE_WAVE, action="delay", delay_s=0.25,
-        times=(1, 2, 3))])
-    with chaos.active(monkey):
-        reqs = [sched.submit(prompt=p, max_tokens=MAX_TOKENS)
-                for p in prompts]
-        sched.run()
-    _check(v, len(monkey.fired) == 3, "latency injection never fired")
-    for i, r in enumerate(reqs):
-        _check(v, r.output_tokens == ref[i],
-               f"request {i} output diverged under injected latency")
-    fired = {r for r in spike_rules
-             if am.summary()["rules"].get(r, {}).get("fired", 0) >= 1}
-    _check(v, fired,
-           "no TTFT/TPOT anomaly alert fired under an injected "
-           "0.25s decode-wave latency spike")
-    # recovery: fault-free rounds until the EWMA absorbs the level
-    for _ in range(8):
-        if not set(am.active()) & set(spike_rules):
-            break
+    tmp = tempfile.mkdtemp(prefix="chaos_spike_bb_")
+    bb = blackbox.BlackBoxRecorder(
+        path=os.path.join(tmp, "blackbox.jsonl"),
+        bundle_dir=os.path.join(tmp, "bundles"))
+    with bb:
+        bb.run_start(harness={"model": _model_meta(),
+                              "engine": engine.describe()})
+        sched = Scheduler(engine)
+        sched.attach_timeseries(sampler, am)
+        # fault-free stream first: seeds every detector's EWMA baseline
         for p in prompts:
             sched.submit(prompt=p, max_tokens=MAX_TOKENS)
         sched.run()
-    _check(v, not set(am.active()) & set(spike_rules),
-           "latency alert latched forever — never cleared after the "
-           "spike ended")
-    _check(v, all(am.summary()["rules"][r]["cleared"] >= 1
-                  for r in fired),
-           "fired alert has no cleared transition")
+        monkey = chaos.ChaosMonkey([chaos.Fault(
+            chaos.DECODE_WAVE, action="delay", delay_s=0.25,
+            times=(1, 2, 3))])
+        with chaos.active(monkey):
+            reqs = [sched.submit(prompt=p, max_tokens=MAX_TOKENS)
+                    for p in prompts]
+            sched.run()
+        _check(v, len(monkey.fired) == 3,
+               "latency injection never fired")
+        for i, r in enumerate(reqs):
+            _check(v, r.output_tokens == ref[i],
+                   f"request {i} output diverged under injected "
+                   "latency")
+        fired = {r for r in spike_rules
+                 if am.summary()["rules"].get(r, {}).get("fired", 0)
+                 >= 1}
+        _check(v, fired,
+               "no TTFT/TPOT anomaly alert fired under an injected "
+               "0.25s decode-wave latency spike")
+        # recovery: fault-free rounds until the EWMA absorbs the level
+        for _ in range(8):
+            if not set(am.active()) & set(spike_rules):
+                break
+            for p in prompts:
+                sched.submit(prompt=p, max_tokens=MAX_TOKENS)
+            sched.run()
+        _check(v, not set(am.active()) & set(spike_rules),
+               "latency alert latched forever — never cleared after "
+               "the spike ended")
+        _check(v, all(am.summary()["rules"][r]["cleared"] >= 1
+                      for r in fired),
+               "fired alert has no cleared transition")
+    # the firing alert must have snapshotted a self-contained incident
+    # bundle that round-trips through the replayer (on the SAME warmed
+    # engine: a rebuilt one would violate the compile-once invariant)
+    bundle = am.last_bundle
+    _check(v, bundle is not None and os.path.isdir(bundle),
+           "firing alert snapshotted no incident bundle")
+    if bundle is not None and os.path.isdir(bundle):
+        for fname in ("journal.jsonl", "history.json",
+                      "manifest.json"):
+            _check(v, os.path.isfile(os.path.join(bundle, fname)),
+                   f"incident bundle missing {fname}")
+        with open(os.path.join(bundle, "manifest.json"),
+                  encoding="utf-8") as f:
+            manifest = json.load(f)
+        _check(v, manifest.get("rule") in spike_rules,
+               f"bundle manifest names rule {manifest.get('rule')!r}, "
+               "not the latency alert")
+        rep = replay_incident.replay(bundle, engine=engine)
+        _check(v, rep["verified"] >= 1 and rep["ok"],
+               "incident bundle did not replay token-exact "
+               f"({rep['diverged']}/{rep['verified']} diverged)")
     # the sampled plane serves in-process: history JSON + dashboard
     st, _, body = telemetry.http_get_inline("/metrics/history",
                                             sampler=sampler)
@@ -821,6 +938,7 @@ SCENARIOS = {
     "noisy_tenant": scenario_noisy_tenant,
     "ckpt_crash": scenario_ckpt_crash,
     "latency_spike": scenario_latency_spike,
+    "blackbox_replay": scenario_blackbox_replay,
 }
 
 # positive controls: each disables one resilience property inside its
@@ -831,7 +949,8 @@ INJECTIONS = {"drop-isolation": "nan_slot", "no-retry": "wave_error",
               "no-rollback": "spec_rollback",
               "corrupt-handoff": "prefill_handoff_kill",
               "no-qos": "noisy_tenant",
-              "no_alerts": "latency_spike"}
+              "no_alerts": "latency_spike",
+              "no_journal": "blackbox_replay"}
 
 
 def run(argv=None):

@@ -1,0 +1,478 @@
+#!/usr/bin/env python
+"""replay_incident — deterministic replay of a serving black-box
+journal (or incident bundle) against a freshly built engine/fleet,
+verifying outputs token-exact against the recorded digests.
+
+The black box (paddle_tpu/serving/blackbox.py) journals every
+replay-relevant serving decision: the `run_start` harness metadata
+names the model/engine/fleet construction, `submit` events carry the
+prompt + sampling config + resolved PRNG seed, and `hop` events record
+the replica kills that forced migrations. Because the serving stack is
+token-exact reproducible end to end, re-building that harness,
+re-submitting the window in recorded order, and re-forcing the recorded
+kills at the same round boundaries regenerates the SAME token streams —
+greedy and seeded-sampling alike — so every replayed request's output
+digest must equal the recorded `complete.output_sha`. A divergence
+(tampered journal, drifted weights, a nondeterminism bug) is reported
+with a unified diff of the two runs' decision traces.
+
+    python scripts/replay_incident.py chaos.bb.jsonl            # window
+    python scripts/replay_incident.py chaos.bb.jsonl --request 3
+    python scripts/replay_incident.py bundles/incident-001-ttft_p99_anomaly
+    python scripts/replay_incident.py chaos.bb.jsonl --json
+
+Exit codes: 0 every verified request token-exact, 1 divergence /
+tampered digests / nothing replayable, 2 usage or internal error.
+"""
+import argparse
+import difflib
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+#: rounds the drivers will drive past the last journaled round before
+#: declaring the replay hung (covers drain waves the journal never saw)
+ROUND_SLACK = 256
+
+
+class UsageError(ValueError):
+    """Bad invocation / unreplayable journal shape (exit code 2)."""
+
+
+# ----------------------------------------------------------------------
+# journal loading
+# ----------------------------------------------------------------------
+
+def load_journal(path):
+    """Load a journal file or an incident-bundle directory. Returns
+    (events, manifest) — manifest is None for bare journals."""
+    from paddle_tpu.serving import blackbox
+
+    if os.path.isdir(path):
+        journal = os.path.join(path, "journal.jsonl")
+        manifest_path = os.path.join(path, "manifest.json")
+        if not os.path.exists(journal):
+            raise UsageError(f"{path}: not an incident bundle "
+                             "(no journal.jsonl)")
+        manifest = None
+        if os.path.exists(manifest_path):
+            with open(manifest_path, encoding="utf-8") as f:
+                manifest = json.load(f)
+        return blackbox.read_journal(journal), manifest
+    if not os.path.exists(path):
+        raise UsageError(f"{path}: no such journal")
+    return blackbox.read_journal(path), None
+
+
+def find_harness(events, manifest):
+    """The harness config replay rebuilds from: the journal's
+    `run_start`, falling back to the bundle manifest (a ring tail may
+    have dropped `run_start`; the manifest always carries a copy)."""
+    for ev in events:
+        if ev.get("ev") == "run_start" and ev.get("harness"):
+            return ev["harness"]
+    if manifest is not None and manifest.get("harness"):
+        return manifest["harness"]
+    return None
+
+
+# ----------------------------------------------------------------------
+# harness reconstruction
+# ----------------------------------------------------------------------
+
+def build_model(model_meta):
+    """Rebuild the served model from recorded construction metadata.
+    Weight determinism comes from re-seeding the global PRNG with the
+    recorded init seed before construction — the same discipline the
+    fleet's state-digest check enforces across replicas."""
+    import paddle_tpu as pt
+
+    arch = model_meta.get("arch", "llama")
+    if arch != "llama":
+        raise UsageError(f"cannot rebuild model arch {arch!r} "
+                         "(only 'llama' harnesses are replayable)")
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+    pt.seed(int(model_meta.get("init_seed", 0)))
+    cfg = LlamaConfig(
+        vocab_size=int(model_meta["vocab_size"]),
+        hidden_size=int(model_meta["hidden_size"]),
+        num_layers=int(model_meta["num_layers"]),
+        num_heads=int(model_meta["num_heads"]),
+        num_kv_heads=int(model_meta.get("num_kv_heads")
+                         or model_meta["num_heads"]),
+        max_seq_len=int(model_meta["max_seq_len"]))
+    return LlamaForCausalLM(cfg)
+
+
+def build_engine(engine_meta, model):
+    """Rebuild an engine from its recorded `describe()` dict."""
+    kind = engine_meta.get("engine", "dense")
+    if kind == "dense":
+        from paddle_tpu.serving import ServingEngine
+        return ServingEngine(
+            model, num_slots=int(engine_meta["num_slots"]),
+            max_len=int(engine_meta["max_len"]),
+            prefill_len=int(engine_meta["prefill_len"]),
+            seed=int(engine_meta.get("seed", 0)))
+    if kind == "paged":
+        from paddle_tpu.serving import PagedServingEngine
+        return PagedServingEngine(
+            model, num_slots=int(engine_meta["num_slots"]),
+            max_len=int(engine_meta["max_len"]),
+            block_size=int(engine_meta["block_size"]),
+            num_blocks=int(engine_meta["num_blocks"]),
+            prefill_chunk_len=int(engine_meta["prefill_chunk_len"]),
+            seed=int(engine_meta.get("seed", 0)),
+            prefix_sharing=bool(engine_meta.get("prefix_sharing", True)),
+            paged_kernel=engine_meta.get("paged_kernel"))
+    raise UsageError(f"cannot rebuild engine kind {kind!r} "
+                     "(spec_paged harnesses need a draft model the "
+                     "journal cannot carry)")
+
+
+def submit_kwargs_from(ev):
+    """Scheduler/FleetRouter submit kwargs from a recorded `submit`."""
+    sampling = ev.get("sampling") or {}
+    kw = {
+        "prompt": list(ev["prompt"]),
+        "max_tokens": int(ev["max_tokens"]),
+        "eos_token_id": ev.get("eos_token_id"),
+        "do_sample": bool(sampling.get("do_sample", False)),
+        "temperature": float(sampling.get("temperature", 1.0)),
+        "top_k": int(sampling.get("top_k", 0)),
+        "top_p": float(sampling.get("top_p", 1.0)),
+        "stop_sequences": ev.get("stop_sequences"),
+    }
+    if ev.get("tenant") not in (None, "default"):
+        kw["tenant"] = ev["tenant"]
+    return kw
+
+
+# ----------------------------------------------------------------------
+# verification
+# ----------------------------------------------------------------------
+
+def _completes_by_request(events, origin):
+    out = {}
+    for ev in events:
+        if ev.get("ev") == "complete" and ev.get("origin") == origin:
+            out.setdefault(ev.get("request_id"), ev)
+    return out
+
+
+def _verify_rows(pairs, completes):
+    """pairs: (recorded submit event, replayed request handle). Matched
+    BY POSITION — replay re-submits in recorded order, so the k-th
+    replayed request corresponds to the k-th recorded submit even
+    though the process-global id counters differ between runs."""
+    from paddle_tpu.serving.blackbox import token_digest
+
+    rows = []
+    for sub, req in pairs:
+        rid = sub.get("request_id")
+        rec = completes.get(rid)
+        toks = list(req.output_tokens)
+        row = {
+            "request_id": rid,
+            "tenant": sub.get("tenant"),
+            "prompt_sha": sub.get("prompt_sha"),
+            "sampled": bool((sub.get("sampling") or {})
+                            .get("do_sample", False)),
+            "replayable": not (sub.get("has_logit_bias")
+                               or sub.get("has_token_mask")),
+            "got_sha": token_digest(toks),
+            "got_n": len(toks),
+            "got_finish": req.finish_reason,
+        }
+        if rec is None:
+            row["ok"] = None         # recorded run never completed it
+        else:
+            row["expect_sha"] = rec.get("output_sha")
+            row["expect_n"] = rec.get("n_tokens")
+            row["expect_finish"] = rec.get("finish_reason")
+            row["ok"] = (row["replayable"]
+                         and row["got_sha"] == row["expect_sha"]
+                         and row["got_n"] == row["expect_n"])
+        rows.append(row)
+    return rows
+
+
+def _trace_diff(orig_events, replay_events):
+    """Unified diff of the two runs' normalized decision views — the
+    divergence report (WHICH decision differed, not just that digests
+    did)."""
+    from paddle_tpu.serving.blackbox import replay_view
+
+    def lines(evs):
+        return [json.dumps(ev, sort_keys=True)
+                for ev in replay_view(evs)]
+
+    return "\n".join(difflib.unified_diff(
+        lines(orig_events), lines(replay_events),
+        fromfile="recorded", tofile="replayed", lineterm="", n=2))
+
+
+# ----------------------------------------------------------------------
+# drivers
+# ----------------------------------------------------------------------
+
+def _max_round(events):
+    return max((ev.get("round") or 0 for ev in events), default=0)
+
+
+def _select_submits(submits, request):
+    """Submits to re-play for a `--request` filter. A greedy request
+    replays in true isolation; a SAMPLED request's PRNG draw depends on
+    the wave composition around it (which slot it landed in, which
+    other lanes sampled the same wave), so isolating it would change
+    its stream — the whole window replays and only the requested row is
+    verified."""
+    if request is None:
+        return submits
+    target = [ev for ev in submits if ev.get("request_id") == request]
+    if not target:
+        return []
+    if any((ev.get("sampling") or {}).get("do_sample")
+           for ev in target):
+        return submits
+    return target
+
+
+def _replay_single(events, harness, model=None, engine=None,
+                   request=None, max_rounds=None):
+    """Replay a single-engine journal: fresh Scheduler over a rebuilt
+    (or caller-provided) engine, submits re-played at their recorded
+    round boundaries."""
+    from paddle_tpu.serving import Scheduler
+    from paddle_tpu.serving import blackbox
+
+    if engine is None:
+        if harness is None or "engine" not in harness:
+            raise UsageError("journal has no run_start harness metadata "
+                             "(and no engine= override was given)")
+        model = model if model is not None \
+            else build_model(harness["model"])
+        engine = build_engine(harness["engine"], model)
+    sched = Scheduler(engine, **dict((harness or {}).get("scheduler")
+                                     or {}))
+    submits = _select_submits(
+        [ev for ev in events if ev.get("ev") == "submit"], request)
+    if not submits:
+        return {"mode": "single", "rows": [], "ok": False,
+                "error": "no replayable submit events"}
+    if max_rounds is None:
+        max_rounds = _max_round(events) + ROUND_SLACK
+
+    recorder = blackbox.BlackBoxRecorder(path=None, ring_size=1 << 16)
+    pairs = []
+    with recorder:
+        pending = list(submits)
+        rounds = 0
+        while pending or sched.in_flight() or sched.queue_depth():
+            while pending and (pending[0].get("round") or 0) <= rounds:
+                ev = pending.pop(0)
+                pairs.append((ev, sched.submit(
+                    **submit_kwargs_from(ev))))
+            sched.step()
+            rounds += 1
+            if rounds > max_rounds:
+                break
+        replay_events = recorder.events()
+
+    rows = _verify_rows(pairs, _completes_by_request(events,
+                                                     "scheduler"))
+    if request is not None:
+        rows = [r for r in rows if r["request_id"] == request]
+    return _report("single", rows, events, replay_events)
+
+
+def _replay_fleet(events, harness, model=None, request=None,
+                  max_rounds=None):
+    """Replay a fleet journal: rebuild the fleet from the harness,
+    re-submit fleet-origin submits at their recorded rounds, and force
+    the recorded kill-reason replica retirements at the same round
+    boundaries (degraded retirements re-derive from the replayed
+    engines' own faults)."""
+    from paddle_tpu.serving import blackbox
+    from paddle_tpu.serving.fleet import DisaggFleetRouter, FleetRouter
+
+    if harness is None or "engine" not in harness:
+        raise UsageError("fleet journal has no run_start harness "
+                         "metadata — cannot rebuild the fleet")
+    model = model if model is not None else build_model(harness["model"])
+    engine_meta = harness["engine"]
+
+    def factory():
+        return build_engine(engine_meta, model)
+
+    fleet_meta = dict(harness.get("fleet") or {})
+    kind = fleet_meta.pop("kind", "fleet")
+    if kind == "disagg":
+        router = DisaggFleetRouter(factory, **fleet_meta)
+    else:
+        router = FleetRouter(factory, **fleet_meta)
+
+    submits = _select_submits(
+        [ev for ev in events if ev.get("ev") == "submit"
+         and ev.get("origin") == "fleet"], request)
+    if not submits:
+        return {"mode": "fleet", "rows": [], "ok": False,
+                "error": "no replayable fleet submit events"}
+    kills = [(int(ev.get("round") or 0), ev.get("src"))
+             for ev in events
+             if ev.get("ev") == "hop"
+             and ev.get("kind") == "replica_retire"
+             and ev.get("reason") == "killed"]
+    if max_rounds is None:
+        max_rounds = _max_round(events) + ROUND_SLACK
+
+    recorder = blackbox.BlackBoxRecorder(path=None, ring_size=1 << 16)
+    pairs = []
+    with recorder:
+        pending = list(submits)
+        rounds = 0                   # == router._round between steps
+        while pending or router.outstanding():
+            while pending and (pending[0].get("round") or 0) <= rounds:
+                ev = pending.pop(0)
+                pairs.append((ev, router.submit(
+                    **submit_kwargs_from(ev))))
+            # the recorded kill happened INSIDE round r+1 (the chaos
+            # check is step()'s first action after the round ticks);
+            # kill_replica here serializes on the same step lock, so
+            # forcing it just before the step is the same schedule
+            for kr, src in kills:
+                if kr == rounds + 1:
+                    for rep in list(router.replicas):
+                        if rep.replica_id == src and rep.state != "dead":
+                            router.kill_replica(rep)
+            router.step()
+            rounds += 1
+            if rounds > max_rounds:
+                break
+        replay_events = recorder.events()
+
+    rows = _verify_rows(pairs, _completes_by_request(events, "fleet"))
+    if request is not None:
+        rows = [r for r in rows if r["request_id"] == request]
+    return _report("fleet", rows, events, replay_events)
+
+
+def _report(mode, rows, events, replay_events):
+    verified = [r for r in rows if r["ok"] is not None]
+    diverged = [r for r in verified if not r["ok"]]
+    report = {
+        "mode": mode,
+        "rows": rows,
+        "replayed": len(rows),
+        "verified": len(verified),
+        "diverged": len(diverged),
+        "unverified": len(rows) - len(verified),
+        "ok": bool(verified) and not diverged,
+    }
+    if not verified:
+        report["error"] = ("no replayed request could be verified "
+                           "(journal records no completions)")
+    if diverged:
+        report["divergence"] = _trace_diff(events, replay_events)
+    return report
+
+
+# ----------------------------------------------------------------------
+# public entry
+# ----------------------------------------------------------------------
+
+def replay(path, request=None, model=None, engine=None, max_rounds=None):
+    """Replay a journal file / bundle dir. `engine=` pins single-engine
+    replay onto a caller-provided (already warmed) engine — fleet
+    journals refuse it, they own their replica engines."""
+    events, manifest = load_journal(path)
+    harness = find_harness(events, manifest)
+    fleet = any(ev.get("ev") == "submit" and ev.get("origin") == "fleet"
+                for ev in events)
+    if fleet:
+        if engine is not None:
+            raise UsageError("engine= override only applies to "
+                             "single-engine journals")
+        return _replay_fleet(events, harness, model=model,
+                             request=request, max_rounds=max_rounds)
+    return _replay_single(events, harness, model=model, engine=engine,
+                          request=request, max_rounds=max_rounds)
+
+
+def _render(report):
+    out = [f"replay mode: {report['mode']}  "
+           f"replayed={report['replayed']} "
+           f"verified={report['verified']} "
+           f"diverged={report['diverged']} "
+           f"unverified={report['unverified']}"]
+    for r in report["rows"]:
+        if r["ok"] is None:
+            verdict = "UNVERIFIED (no recorded completion)"
+        elif r["ok"]:
+            verdict = "ok"
+        elif not r["replayable"]:
+            verdict = "UNSUPPORTED (logit_bias/token_mask)"
+        else:
+            verdict = (f"DIVERGED (expect {r.get('expect_sha')}"
+                       f"/{r.get('expect_n')}t, got {r['got_sha']}"
+                       f"/{r['got_n']}t)")
+        mode = "sampled" if r["sampled"] else "greedy"
+        out.append(f"  request {r['request_id']} [{mode}] {verdict}")
+    if report.get("error"):
+        out.append(f"error: {report['error']}")
+    if report.get("divergence"):
+        out.append("decision-trace diff:")
+        out.append(report["divergence"])
+    return "\n".join(out)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="replay_incident",
+        description="deterministically replay a serving black-box "
+                    "journal or incident bundle and verify token-exact")
+    p.add_argument("journal",
+                   help="journal .jsonl or incident bundle directory")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("--request", type=int, default=None,
+                   help="replay only this recorded request id")
+    g.add_argument("--window", action="store_true",
+                   help="replay the whole window (default)")
+    p.add_argument("--max-rounds", type=int, default=None,
+                   help="abort a hung replay after this many rounds")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable report on stdout")
+    return p
+
+
+def run(argv):
+    args = build_parser().parse_args(argv)
+    try:
+        report = replay(args.journal, request=args.request,
+                        max_rounds=args.max_rounds)
+    except UsageError as e:
+        print(f"replay_incident: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(_render(report))
+    return 0 if report["ok"] else 1
+
+
+def main():
+    try:
+        sys.exit(run(sys.argv[1:]))
+    except SystemExit:
+        raise
+    except Exception:                # noqa: BLE001 — CLI boundary
+        import traceback
+        traceback.print_exc()
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

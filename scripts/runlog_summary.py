@@ -29,7 +29,14 @@ schema in docs/observability.md. The report covers:
     events the serving resilience layer wrote while recovering —
     scripts/chaos_serving.py journals prove each recovery this way,
   * run status (a `run_end {status: "crashed"}` means the tail of the
-    journal is the flight recorder doing its job).
+    journal is the flight recorder doing its job),
+  * black-box journals (`paddle_tpu.serving.blackbox`): per-request
+    decision timelines (submit -> admission -> waves -> hops ->
+    complete), the fleet-hop rollup (dispatch/migrate/handoff/kv
+    export-import/replica spawn-retire edges), and the incident
+    bundles the alert manager snapshotted (`incident` events — their
+    paths ride the `--json` rollup, ready for
+    scripts/replay_incident.py).
 
 Stdlib-only on purpose: reading a journal must not require (or wait on)
 a jax import.
@@ -274,6 +281,8 @@ def summarize(events):
                              if r["fired"] > r["cleared"]),
         }
 
+    blackbox = summarize_blackbox(events)
+
     by_coll = {}
     for c in colls:
         key = (c.get("op", "?"), c.get("group", "default"))
@@ -309,10 +318,132 @@ def summarize(events):
         "chaos": chaos_by_point,
         "faults": faults_by_kind,
         "fleet": fleet,
+        "blackbox": blackbox,
         "checkpoints": sum(1 for e in events
                            if e.get("ev") == "checkpoint"),
         "last_loss": next((l for l in reversed(losses) if l is not None),
                           None),
+    }
+
+
+#: black-box journal event kinds (serving/blackbox.py) — presence of
+#: any decision event marks a journal as (also) a black-box journal
+_BB_KINDS = ("submit", "admission", "wave", "preempt", "hop",
+             "complete", "incident")
+
+
+def summarize_blackbox(events):
+    """Rollup of the serving black-box decision events (None when the
+    journal has none). Re-groups per request locally — stdlib-only, the
+    same fold `blackbox.request_traces` does — keyed by `trace_id`
+    (fleet requests: every hop shares it) falling back to
+    `request_id`."""
+    if not any(e.get("ev") in _BB_KINDS for e in events):
+        return None
+
+    requests = {}
+    order = []
+    rid_to_key = {}
+
+    def trace(key, ev):
+        tr = requests.get(key)
+        if tr is None:
+            tr = requests[key] = {
+                "request_id": ev.get("request_id"),
+                "tenant": ev.get("tenant"),
+                "seed": ev.get("seed"),
+                "sampled": None, "prompt_len": None,
+                "waves": 0, "preempts": 0, "hops": [],
+                "admissions": [], "finish_reason": None,
+                "n_tokens": None, "output_sha": None,
+                "migrations": None,
+            }
+            order.append(key)
+        return tr
+
+    hops_by_kind, hop_edges, replicas = {}, {}, set()
+    incidents = []
+    for ev in events:
+        name = ev.get("ev")
+        if name == "hop":
+            kind = ev.get("kind", "?")
+            hops_by_kind[kind] = hops_by_kind.get(kind, 0) + 1
+            src, dst = ev.get("src"), ev.get("dst")
+            for r in (src, dst):
+                if r is not None:
+                    replicas.add(r)
+            if src is not None or dst is not None:
+                edge = (f"{'-' if src is None else src}->"
+                        f"{'-' if dst is None else dst}")
+                key = (kind, edge)
+                hop_edges[key] = hop_edges.get(key, 0) + 1
+        elif name == "incident":
+            incidents.append({"rule": ev.get("rule"),
+                              "severity": ev.get("severity"),
+                              "bundle": ev.get("bundle")})
+        if name not in _BB_KINDS or name == "incident":
+            continue
+        if name == "wave":
+            for m in ev.get("members") or ():
+                key = rid_to_key.get(m.get("request_id"))
+                if key is not None:
+                    requests[key]["waves"] += 1
+            continue
+        rid = ev.get("request_id")
+        key = rid_to_key.get(rid)
+        if key is None:
+            key = (("t", ev["trace_id"])
+                   if ev.get("trace_id") is not None
+                   else ("r", rid) if rid is not None else None)
+        if key is None:
+            continue
+        if rid is not None:
+            rid_to_key[rid] = key
+        if ev.get("local_request_id") is not None:
+            rid_to_key[ev["local_request_id"]] = key
+        tr = trace(key, ev)
+        if name == "submit":
+            # first submit wins: a migration/handoff hop re-submits the
+            # continuation (prompt + generated-so-far) on the next
+            # replica, which must not masquerade as the client's prompt
+            if tr["prompt_len"] is None:
+                tr["prompt_len"] = ev.get("prompt_len")
+                tr["sampled"] = bool((ev.get("sampling") or {})
+                                     .get("do_sample", False))
+            for f in ("tenant", "seed"):
+                if tr[f] is None and ev.get(f) is not None:
+                    tr[f] = ev[f]
+        elif name == "admission":
+            v = ev.get("verdict", "?")
+            if ev.get("slot") is not None:
+                v += f"@slot{ev['slot']}"
+            tr["admissions"].append(v)
+        elif name == "preempt":
+            tr["preempts"] += 1
+        elif name == "hop":
+            src, dst = ev.get("src"), ev.get("dst")
+            tr["hops"].append(
+                ev.get("kind", "?")
+                + (f"({'-' if src is None else src}->"
+                   f"{'-' if dst is None else dst})"
+                   if (src is not None or dst is not None) else ""))
+        elif name == "complete":
+            # the fleet-origin completion wins (the stitched stream is
+            # what replay verifies); hop-local completions fill in only
+            # when no fleet view exists
+            if tr["finish_reason"] is None or ev.get("origin") == "fleet":
+                tr["finish_reason"] = ev.get("finish_reason")
+                tr["n_tokens"] = ev.get("n_tokens")
+                tr["output_sha"] = ev.get("output_sha")
+                tr["migrations"] = ev.get("migrations")
+
+    return {
+        "requests": [requests[k] for k in order],
+        "hops": {k: hops_by_kind[k] for k in sorted(hops_by_kind)},
+        "hop_edges": {f"{kind} {edge}": n
+                      for (kind, edge), n in sorted(hop_edges.items())},
+        "replicas": sorted(replicas),
+        "incident_bundles": incidents,
     }
 
 
@@ -447,6 +578,43 @@ def render(s):
             active = "yes" if rule in al["active"] else ""
             lines.append(f"  {rule:<28}{r['fired']:>7}{r['cleared']:>9}"
                          f"{active:>8}  {r['severity'] or '-'}")
+    bb = s.get("blackbox")
+    if bb:
+        hop_c = ", ".join(f"{k}={v}" for k, v in bb["hops"].items())
+        lines.append(f"black box: {len(bb['requests'])} request(s)"
+                     + (f", hops: {hop_c}" if hop_c else "")
+                     + (f", replicas: "
+                        f"{', '.join(str(r) for r in bb['replicas'])}"
+                        if bb["replicas"] else ""))
+        for tr in bb["requests"][:16]:
+            mode = ("sampled" if tr["sampled"]
+                    else "greedy" if tr["sampled"] is not None else "?")
+            steps = []
+            if tr["prompt_len"] is not None:
+                steps.append(f"submit({tr['prompt_len']}t)")
+            steps.extend(tr["admissions"])
+            if tr["waves"]:
+                steps.append(f"wave x{tr['waves']}")
+            if tr["preempts"]:
+                steps.append(f"preempt x{tr['preempts']}")
+            steps.extend(tr["hops"])
+            if tr["finish_reason"] is not None:
+                done = f"complete({tr['finish_reason']}"
+                if tr["n_tokens"] is not None:
+                    done += f", {tr['n_tokens']}t"
+                if tr["output_sha"]:
+                    done += f", sha {tr['output_sha']}"
+                steps.append(done + ")")
+            seed_c = "" if tr["seed"] is None else f", seed {tr['seed']}"
+            lines.append(f"  request {tr['request_id']} [{mode}, "
+                         f"tenant {tr['tenant'] or 'default'}{seed_c}]: "
+                         + " -> ".join(steps))
+        if len(bb["requests"]) > 16:
+            lines.append(f"  ... and {len(bb['requests']) - 16} more")
+        for inc in bb["incident_bundles"]:
+            lines.append(f"  incident bundle [{inc['rule']}]: "
+                         f"{inc['bundle']}  (replay with "
+                         "scripts/replay_incident.py)")
     if s.get("chaos"):
         inj = ", ".join(f"{k}={v}" for k, v in sorted(s["chaos"].items()))
         lines.append(f"chaos injections: {inj}")

@@ -1,0 +1,321 @@
+"""Serving black box + deterministic incident replay (tier-1).
+
+The contract under test (docs/observability.md "Serving black box"):
+
+  * **Byte-identical journals** — two runs of the same workload on
+    fresh engines, under a pinned clock, produce identical
+    replay-relevant payloads (`blackbox.replay_view` strips the
+    stamped fields and normalizes process-lifetime ids).
+  * **Ring bound** — an unflushed recorder holds at most `ring_size`
+    events and accounts every overwrite in `dropped_events`.
+  * **Replay exactness** — `scripts/replay_incident.py` rebuilds the
+    stack from the journal's harness and regenerates every request
+    token-exact (greedy isolated; sampled via full-window replay), and
+    a tampered digest makes the CLI exit 1 with a decision-trace diff.
+  * **Incident bundles** — an alert latching firing snapshots a
+    self-contained bundle (journal + history + manifest) that
+    round-trips through the replayer.
+  * **Zero overhead detached** — no recorder, no journaling work, and
+    `/debug/requests` stays safe to curl either way.
+
+Canonical tiny LLaMA scale (2 layers, hidden 64) so warm runs hit the
+persistent compile cache.
+"""
+import json
+import os
+
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.serving import Scheduler, ServingEngine, blackbox
+from paddle_tpu.utils import anomaly, telemetry
+
+from scripts import replay_incident
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VOCAB = 128
+HIDDEN = 64
+MAX_LEN = 64
+PREFILL = 16
+MAX_NEW = 4
+
+PROMPTS = ([3, 5, 7], [11, 13, 17, 19], [23, 29], [31, 37, 41])
+
+
+@pytest.fixture(scope="module")
+def model():
+    pt.seed(7)
+    cfg = LlamaConfig(vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=2,
+                      num_heads=4, num_kv_heads=2, max_seq_len=MAX_LEN)
+    return LlamaForCausalLM(cfg)
+
+
+def _model_meta():
+    return {"arch": "llama", "vocab_size": VOCAB, "hidden_size": HIDDEN,
+            "num_layers": 2, "num_heads": 4, "num_kv_heads": 2,
+            "max_seq_len": MAX_LEN, "init_seed": 7}
+
+
+def _engine(model):
+    return ServingEngine(model, num_slots=4, max_len=MAX_LEN,
+                         prefill_len=PREFILL)
+
+
+def _submit_mixed(sched):
+    """The canonical workload: greedy and seeded-sampling interleaved."""
+    reqs = []
+    for i, p in enumerate(PROMPTS):
+        kw = {"prompt": list(p), "max_tokens": MAX_NEW}
+        if i % 2:
+            kw.update(do_sample=True, temperature=0.8, top_k=8)
+        reqs.append(sched.submit(**kw))
+    return reqs
+
+
+def _serve(model, path=None, clock=None, bundle_dir=None, harness=True):
+    """One recorded serving run on a fresh engine; returns
+    (requests, events, recorder)."""
+    engine = _engine(model)
+    kw = {"path": path, "bundle_dir": bundle_dir}
+    if clock is not None:
+        kw["clock"] = clock
+    bb = blackbox.BlackBoxRecorder(**kw)
+    with bb:
+        if harness:
+            bb.run_start(harness={"model": _model_meta(),
+                                  "engine": engine.describe()})
+        sched = Scheduler(engine)
+        reqs = _submit_mixed(sched)
+        sched.run()
+    return reqs, bb.events(), bb
+
+
+# ---------------------------------------------------------------------------
+# journal determinism
+# ---------------------------------------------------------------------------
+
+def test_replay_payload_byte_identical_across_runs(model):
+    """Two fresh-engine runs under a pinned clock journal byte-identical
+    replay-relevant payloads — even though the global request/trace id
+    counters advanced between them (replay_view normalizes both)."""
+    _, ev1, _ = _serve(model, clock=lambda: 1234.5)
+    _, ev2, _ = _serve(model, clock=lambda: 1234.5)
+    v1 = json.dumps(blackbox.replay_view(ev1), sort_keys=True)
+    v2 = json.dumps(blackbox.replay_view(ev2), sort_keys=True)
+    assert v1 == v2
+    # the normalization is doing real work: raw ids differ run to run
+    raw1 = [e["request_id"] for e in ev1 if e["ev"] == "submit"]
+    raw2 = [e["request_id"] for e in ev2 if e["ev"] == "submit"]
+    assert raw1 != raw2
+
+
+def test_event_kinds_closed_vocabulary(model):
+    _, events, bb = _serve(model)
+    assert events, "recorder captured nothing"
+    assert {e["ev"] for e in events} <= set(blackbox.EVENT_KINDS)
+    for e in events:
+        if e["ev"] == "hop":
+            assert e["kind"] in blackbox.HOP_KINDS
+    counts = bb.counts()
+    assert counts["submit"] == len(PROMPTS)
+    assert counts["complete"] == len(PROMPTS)
+    assert counts["wave"] >= 1 and counts["admission"] >= 1
+
+
+def test_ring_bound_and_drop_accounting():
+    bb = blackbox.BlackBoxRecorder(path=None, ring_size=8)
+    for i in range(50):
+        bb.admission(i, verdict="deferred")
+    assert len(bb.events()) == 8
+    assert bb.dropped_events == 42
+    assert bb.counts()["admission"] == 50
+    # the tail is the MOST RECENT events, oldest first
+    assert [e["request_id"] for e in bb.events()] == list(range(42, 50))
+
+
+def test_detached_recorder_is_inert(model):
+    """No recorder installed -> the serving path journals nothing and
+    requests carry no recorder state; outputs match a recorded run."""
+    assert blackbox.get_recorder() is None
+    engine = _engine(model)
+    sched = Scheduler(engine)
+    reqs = _submit_mixed(sched)
+    sched.run()
+    recorded, _, _ = _serve(model)
+    for a, b in zip(reqs, recorded):
+        assert a.output_tokens == b.output_tokens
+
+
+# ---------------------------------------------------------------------------
+# seed provenance
+# ---------------------------------------------------------------------------
+
+def test_request_seed_provenance_and_repr(model):
+    engine = _engine(model)
+    sched = Scheduler(engine)
+    r = sched.submit(prompt=[3, 5, 7], max_tokens=2, do_sample=True)
+    assert isinstance(r.seed, int)
+    assert f"seed={r.seed}" in repr(r)
+    sched.run()
+    # the journaled submit carries the same resolved seed
+    _, events, _ = _serve(model)
+    subs = [e for e in events if e["ev"] == "submit"]
+    assert all(isinstance(e["seed"], int) for e in subs)
+
+
+# ---------------------------------------------------------------------------
+# deterministic replay
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def journal(model, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("bb") / "journal.jsonl")
+    reqs, events, _ = _serve(model, path=path)
+    return {"path": path, "reqs": reqs, "events": events}
+
+
+def test_replay_window_token_exact(model, journal):
+    rep = replay_incident.replay(journal["path"], model=model)
+    assert rep["ok"] is True
+    assert rep["verified"] == len(PROMPTS) and rep["diverged"] == 0
+    assert any(r["sampled"] for r in rep["rows"])
+    assert any(not r["sampled"] for r in rep["rows"])
+    for row in rep["rows"]:
+        assert row["got_sha"] == row["expect_sha"]
+
+
+def test_replay_single_request_greedy_and_sampled(model, journal):
+    subs = [e for e in journal["events"] if e["ev"] == "submit"]
+    greedy = next(e for e in subs if not e["sampling"]["do_sample"])
+    sampled = next(e for e in subs if e["sampling"]["do_sample"])
+    rep = replay_incident.replay(journal["path"], model=model,
+                                 request=greedy["request_id"])
+    assert rep["ok"] is True and len(rep["rows"]) == 1
+    # a sampled request's PRNG draw depends on wave composition: the
+    # replayer falls back to full-window replay, verifying just this row
+    rep = replay_incident.replay(journal["path"], model=model,
+                                 request=sampled["request_id"])
+    assert rep["ok"] is True and len(rep["rows"]) == 1
+    assert rep["rows"][0]["sampled"] is True
+
+
+def test_replay_cli_exit_codes(model, journal, tmp_path, capsys):
+    assert replay_incident.run([journal["path"]]) == 0
+    capsys.readouterr()
+    # tamper with one recorded output digest -> divergence, exit 1,
+    # and a decision-trace diff in the report
+    tampered = str(tmp_path / "tampered.jsonl")
+    with open(journal["path"]) as f, open(tampered, "w") as out:
+        for line in f:
+            ev = json.loads(line)
+            if ev.get("ev") == "complete":
+                ev["output_sha"] = "0" * 16
+            out.write(json.dumps(ev) + "\n")
+    assert replay_incident.run([tampered]) == 1
+    assert "DIVERGED" in capsys.readouterr().out
+    # an unusable journal (no harness, no events) is a usage error
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert replay_incident.run([empty]) == 2
+
+
+# ---------------------------------------------------------------------------
+# incident bundles
+# ---------------------------------------------------------------------------
+
+def test_incident_bundle_roundtrip(model, tmp_path):
+    tmp = str(tmp_path)
+    engine = _engine(model)
+    bb = blackbox.BlackBoxRecorder(
+        path=os.path.join(tmp, "journal.jsonl"),
+        bundle_dir=os.path.join(tmp, "bundles"))
+    am = anomaly.AlertManager(rules=[anomaly.AlertRule(
+        "ttft_p99_anomaly", lambda ctx: {"firing": True, "value": 9.9})])
+    with bb:
+        bb.run_start(harness={"model": _model_meta(),
+                              "engine": engine.describe()})
+        sched = Scheduler(engine)
+        _submit_mixed(sched)
+        sched.run()
+        transitions = am.evaluate()
+    assert transitions == [("ttft_p99_anomaly", "firing")]
+    bundle = am.last_bundle
+    assert bundle is not None and os.path.isdir(bundle)
+    for fname in ("journal.jsonl", "history.json", "manifest.json"):
+        assert os.path.isfile(os.path.join(bundle, fname)), fname
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["rule"] == "ttft_p99_anomaly"
+    assert manifest["harness"]["model"] == _model_meta()
+    assert manifest["detail"]["value"] == 9.9
+    assert manifest["severity"] == "warning"
+    # the journal itself records the incident
+    incidents = [e for e in bb.events() if e["ev"] == "incident"]
+    assert incidents and incidents[0]["bundle"] == bundle
+    # the bundle is self-contained: it replays token-exact on its own
+    rep = replay_incident.replay(bundle, model=model)
+    assert rep["ok"] is True and rep["verified"] == len(PROMPTS)
+
+
+def test_no_bundle_dir_means_no_bundle(model, tmp_path):
+    bb = blackbox.BlackBoxRecorder(path=str(tmp_path / "j.jsonl"))
+    am = anomaly.AlertManager(rules=[anomaly.AlertRule(
+        "ttft_p99_anomaly", lambda ctx: {"firing": True})])
+    with bb:
+        assert am.evaluate() == [("ttft_p99_anomaly", "firing")]
+    assert am.last_bundle is None
+    assert am.check_errors == 0
+
+
+# ---------------------------------------------------------------------------
+# /debug/requests
+# ---------------------------------------------------------------------------
+
+def test_debug_requests_endpoint(model):
+    st, _, body = telemetry.http_get_inline("/debug/requests")
+    assert st == 200
+    payload = json.loads(body)
+    assert payload == {"recording": False, "requests": []}
+    engine = _engine(model)
+    with blackbox.BlackBoxRecorder() as bb:
+        sched = Scheduler(engine)
+        _submit_mixed(sched)
+        sched.run()
+        st, _, body = telemetry.http_get_inline("/debug/requests")
+        assert st == 200
+        payload = json.loads(body)
+    assert payload["recording"] is True
+    rows = payload["requests"]
+    assert len(rows) == len(PROMPTS)
+    for row in rows:
+        assert row["finish_reason"] == "max_tokens"
+        assert isinstance(row["seed"], int)
+        assert row["output_sha"] and row["prompt_sha"]
+        assert any(e["ev"] == "wave" for e in row["events"])
+    # detaching restores the empty-but-200 payload
+    st, _, body = telemetry.http_get_inline("/debug/requests")
+    assert json.loads(body) == {"recording": False, "requests": []}
+
+
+# ---------------------------------------------------------------------------
+# runlog summary rendering
+# ---------------------------------------------------------------------------
+
+def test_runlog_summary_renders_blackbox(journal, tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_test_runlog", os.path.join(REPO, "scripts",
+                                     "runlog_summary.py"))
+    runlog = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(runlog)
+    s = runlog.summarize(runlog.load_events(journal["path"]))
+    bbs = s["blackbox"]
+    assert bbs is not None and len(bbs["requests"]) == len(PROMPTS)
+    for row in bbs["requests"]:
+        assert row["finish_reason"] == "max_tokens"
+        assert row["n_tokens"] == MAX_NEW
+    text = runlog.render(s)
+    assert "black box:" in text
+    # training-only journals keep rendering without a blackbox section
+    assert runlog.summarize([])["blackbox"] is None

@@ -139,6 +139,17 @@ def test_inject_no_qos_exits_1(chaos_serving, capsys):
     assert "bulk backlog" in capsys.readouterr().out
 
 
+def test_inject_no_journal_exits_1(chaos_serving, capsys):
+    """Positive control for the black-box plane: the same fleet
+    kill/replay stream with the recorder DETACHED leaves no journal, so
+    the replay-exactness invariant of `--scenario blackbox_replay`
+    (covered by the smoke run) must catch the missing evidence
+    (exit 1) — a replayer that passes without a journal proves
+    nothing."""
+    assert chaos_serving.run(["--inject", "no_journal"]) == 1
+    assert "not replayable" in capsys.readouterr().out
+
+
 def test_cache_exhaustion_scenario_clean(chaos_serving, capsys):
     """The real property: injected pool exhaustion at admission queues
     the request behind in-flight work — every request completes with

@@ -561,3 +561,78 @@ def test_unmodified_hot_files_lint_clean(tmp_path):
     res = _cli("paddle_tpu/serving/engine.py",
                "paddle_tpu/utils/telemetry.py")
     assert res.returncode == 0, res.stdout + res.stderr
+
+
+# ---------------------------------------------------------------------------
+# event-kind-documented
+# ---------------------------------------------------------------------------
+
+def test_event_kind_undeclared_fault_fires(tmp_path):
+    findings = _lint_src(tmp_path, """
+        from paddle_tpu.utils import flight_recorder
+
+        def handle(rec):
+            rec.fault("made_up_kind", action="ignore")
+    """, select={"event-kind-documented"}, root=REPO)
+    assert _rules(findings) == ["event-kind-documented"]
+    assert "FAULT_KINDS" in findings[0].message
+
+
+def test_event_kind_undeclared_hop_fires(tmp_path):
+    findings = _lint_src(tmp_path, """
+        def route(bb):
+            bb.hop("teleport", src=0, dst=1)
+    """, select={"event-kind-documented"}, root=REPO)
+    assert _rules(findings) == ["event-kind-documented"]
+    assert "HOP_KINDS" in findings[0].message
+
+
+def test_event_kind_declared_and_documented_clean(tmp_path):
+    findings = _lint_src(tmp_path, """
+        KIND = "wave_error"
+
+        def handle(rec, bb, reason):
+            rec.fault("wave_error", action="retry")
+            rec.fault(KIND, action="retry")       # module-const resolves
+            bb.hop("migrate", src=0, dst=1)
+            bb.hop(kind="kv_export", src=0)
+            rec.fault("replica_" + reason)        # dynamic: out of scope
+    """, select={"event-kind-documented"}, root=REPO)
+    assert findings == []
+
+
+def test_event_kind_not_snake_case_fires_without_repo_vocab(tmp_path):
+    # shape check needs no vocabulary: fires even in a bare repo root
+    findings = _lint_src(tmp_path, """
+        def handle(rec):
+            rec.fault("BadKind")
+    """, select={"event-kind-documented"})
+    assert _rules(findings) == ["event-kind-documented"]
+    assert "snake_case" in findings[0].message
+
+
+def test_event_kind_declared_but_undocumented_fires(tmp_path):
+    # a tmp repo whose vocabulary accepts the kind but whose docs
+    # catalog does not mention it: the doc leg must fire on its own
+    root = tmp_path / "repo"
+    fr = root / "paddle_tpu" / "utils" / "flight_recorder.py"
+    fr.parent.mkdir(parents=True)
+    fr.write_text('FAULT_KINDS = ("ghost_kind",)\n')
+    docs = root / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text("only `other_name` here\n")
+    findings = _lint_src(tmp_path, """
+        def handle(rec):
+            rec.fault("ghost_kind")
+    """, name="repo/mod.py", select={"event-kind-documented"},
+        root=root)
+    assert _rules(findings) == ["event-kind-documented"]
+    assert "not documented" in findings[0].message
+
+
+def test_repo_event_kind_sites_lint_clean():
+    # the live emission sites: every literal fault/hop kind in the
+    # serving+utils planes is declared AND cataloged
+    res = _cli("paddle_tpu/serving", "paddle_tpu/utils",
+               "--select", "event-kind-documented")
+    assert res.returncode == 0, res.stdout + res.stderr
