@@ -538,3 +538,39 @@ class ShardedTrainStep(InstrumentedStepMixin):
                               for sn, s in slots.items()}
                           for n, slots in self.opt_specs.items()},
         }
+
+    def audit_sharding_decl(self):
+        """Declared-sharding record for the mesh-aware program audit
+        (tools/jxaudit/mesh_rules.py, threaded through the xprof
+        registry's sharded_train_step spec). Hands out the LIVE
+        PartitionSpec trees the compiled step was built with — the audit
+        compares these against what XLA committed to in the optimized
+        HLO, and because they are the same objects `jax.jit` received,
+        the declarations cannot drift from the code.
+
+        `in_specs` is keyed by positional argnum of `_step`
+        (params, buffers, opt_state, acc); batch/scalar args are
+        unconstrained at jit time and carry no declaration.
+        `expected_collectives` whitelists collective opcodes the
+        reshard-in-body rule must NOT flag: the flash-attention kernel's
+        shifted-window slice/pad partitions into halo-exchange
+        collective-permutes under GSPMD whenever the batch dim doesn't
+        divide dp — data movement the kernel's math asked for, not an
+        implicit reshard (their exact counts are still gated by the
+        collective-budget rows)."""
+        return {
+            "mesh_axes": {name: int(self.mesh.shape[name])
+                          for name in self.mesh.axis_names},
+            "in_specs": {
+                0: dict(self.param_specs),
+                1: dict(self.buffer_specs),
+                2: {n: dict(slots)
+                    for n, slots in self.opt_specs.items()},
+                3: {n: self.param_specs[n] for n in self.grad_acc},
+            },
+            # exact_reshard pins state/grads replicated via explicit
+            # with_sharding_constraint sites; sharding-dropped checks the
+            # traced program still carries them
+            "constraint_specs": [repr(P())] if self.exact_reshard else [],
+            "expected_collectives": ("collective-permute",),
+        }

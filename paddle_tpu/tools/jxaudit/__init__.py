@@ -27,17 +27,32 @@ mirroring xprof. CLI: ``scripts/jxaudit.py`` (exit 0 clean / 1 findings
 / 2 internal error) against the justified baseline
 ``scripts/jxaudit_baseline.json``. Rule catalog:
 docs/static_analysis.md ("Program-level rules").
+
+The MESH-AWARE rule family (mesh_rules.py: sharding-dropped,
+accidental-replication, donation-through-pjit, collective-budget,
+reshard-in-body) audits the pjit'd sharded programs over their declared
+PartitionSpecs, the compiled module's committed ``sharding=``
+annotations, and the banked per-opcode collective budgets. It lives in
+its own registry (``MESH_RULES``) behind its own CLI
+(``scripts/shaudit.py``, baseline ``scripts/shaudit_baseline.json``) —
+disjoint rule ids, one shared driver. Catalog: docs/static_analysis.md
+("Mesh-aware rules").
 """
 from .core import (Finding, ProgramContext, RULES, register,
                    audit_programs, summarize, publish_summary)
 from .registry import (audited, audited_program_specs, tracked_specs,
-                       tracked_program_names)
+                       tracked_program_names, mesh_specs, MESH_PROGRAMS)
 from .inject import INJECTIONS, inject_spec
 from . import rules  # noqa: F401  (registers the built-in rules)
+from .mesh_rules import (MESH_RULES, summarize_mesh,
+                         publish_mesh_summary)
+from .mesh_inject import MESH_INJECTIONS, build_injected_spec
 
 __all__ = [
     "Finding", "ProgramContext", "RULES", "register", "audit_programs",
     "summarize", "publish_summary", "audited", "audited_program_specs",
     "tracked_specs", "tracked_program_names", "INJECTIONS",
-    "inject_spec",
+    "inject_spec", "mesh_specs", "MESH_PROGRAMS", "MESH_RULES",
+    "summarize_mesh", "publish_mesh_summary", "MESH_INJECTIONS",
+    "build_injected_spec",
 ]

@@ -246,6 +246,44 @@ def parse_entry_param_types(hlo_text):
                    re.sub(r"/\*.*?\*/", "", p)).strip() for p in parts]
 
 
+def parse_entry_param_shardings(hlo_text):
+    """{entry_param_index: sharding_string} from the ``parameter(N),
+    sharding={...}`` instruction lines of partitioned optimized HLO —
+    the sharding XLA COMMITTED each entry parameter to (``{replicated}``,
+    ``{devices=[8,1]<=[8]}``, ...), which is what the mesh-aware rules
+    compare declarations against. Returns ``{}`` when no parameter
+    carries an annotation (an unpartitioned module, or a build that
+    strips them) and None when the same index appears with two different
+    sharding strings (nested computations colliding with the entry —
+    misattributing a sharding is worse than not answering)."""
+    import re
+    out = {}
+    pat = re.compile(r"=\s*(?:\([^)]*\)|\S+)\s+parameter\((\d+)\)"
+                     r"\s*,\s*sharding=(\{)")
+    for line in hlo_text.splitlines():
+        m = pat.search(line)
+        if not m:
+            continue
+        idx = int(m.group(1))
+        i = m.start(2)
+        depth, j = 0, i
+        while j < len(line):
+            if line[j] == "{":
+                depth += 1
+            elif line[j] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        if depth != 0:
+            continue
+        sharding = line[i:j + 1]
+        if idx in out and out[idx] != sharding:
+            return None
+        out[idx] = sharding
+    return out
+
+
 def align_leaves_to_params(leaf_types, param_types):
     """Greedy order-preserving alignment of flat arg leaves onto the
     compiled module's entry parameters -> ({leaf_index: param_index},
@@ -421,6 +459,35 @@ class ProgramContext:
         return self._cached("aliased_params", build)
 
     @property
+    def entry_param_shardings(self):
+        """{entry_param_index: committed sharding string} parsed from
+        the partitioned module's ``parameter(N), sharding={...}`` lines,
+        or None (+reason) when the compiled text is unavailable, carries
+        no annotations at all, or annotates one index two ways. The
+        all-or-nothing posture is deliberate: a module without
+        annotations (single-device build, or a jax that stops printing
+        them) must degrade every mesh rule, not read as 'everything
+        replicated'."""
+        def build():
+            text = self.hlo_text
+            if text is None:
+                raise RuntimeError(
+                    "compiled HLO unavailable: "
+                    + self.unavailable.get("hlo_text", "unknown"))
+            ann = parse_entry_param_shardings(text)
+            if ann is None:
+                raise RuntimeError(
+                    "conflicting parameter sharding annotations in the "
+                    "compiled text")
+            if not ann:
+                raise RuntimeError(
+                    "compiled text carries no parameter sharding "
+                    "annotations (unpartitioned module, or a jax build "
+                    "that strips them)")
+            return ann
+        return self._cached("entry_param_shardings", build)
+
+    @property
     def leaf_param_map(self):
         """{flat_arg_leaf_index: compiled_entry_parameter_index}, or
         None (+reason) when the two numberings can't be reconciled —
@@ -504,8 +571,13 @@ class ProgramContext:
 SCHEMA_VERSION = 1
 
 
-def audit_programs(specs, select=None):
+def audit_programs(specs, select=None, rules=None):
     """Run every (selected) rule over every spec.
+
+    ``rules`` is the registry to drive (default: the module-global
+    ``RULES``); the mesh-aware family passes its own registry
+    (mesh_rules.MESH_RULES) so the two rule sets stay disjoint CLIs
+    over one driver.
 
     Returns ``(findings, report)``: findings is the flat
     line-of-defense list (baseline-diffed by the CLI), report is the
@@ -514,16 +586,18 @@ def audit_programs(specs, select=None):
     build could not answer (null-style degradation, never a crash; an
     unexpectedly *raising* rule is recorded there too)."""
     import jax
+    if rules is None:
+        rules = RULES
     if select is not None:
-        unknown = set(select) - set(RULES)
+        unknown = set(select) - set(rules)
         if unknown:
             raise ValueError(f"unknown rule(s): {sorted(unknown)}; "
-                             f"registry has {sorted(RULES)}")
+                             f"registry has {sorted(rules)}")
     findings, programs = [], {}
     for spec in specs:
         ctx = ProgramContext(spec)
         per_rule = {}
-        for rule_id, rule in sorted(RULES.items()):
+        for rule_id, rule in sorted(rules.items()):
             if select is not None and rule_id not in select:
                 continue
             try:

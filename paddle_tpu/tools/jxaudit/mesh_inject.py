@@ -1,0 +1,209 @@
+"""shaudit positive controls: one deliberately mis-sharded probe
+program per mesh rule class.
+
+The sharded tracked programs carry prebuilt pjit callables (no raw fn
+to wrap, and a ShardedTrainStep compile is seconds even warm), so —
+unlike jxaudit's inject.py, which wraps the decode wave — each control
+here BUILDS a tiny self-contained pjit program over the tier-1
+8-device dp mesh carrying exactly one defect:
+
+  sharding-dropped       the declaration says params are dp-sharded,
+                         the live jit call compiles them replicated —
+                         declaration drift, the rule's reason to exist
+  accidental-replication a 512 KiB ZeRO-style optimizer accumulator
+                         deliberately placed (and declared) fully
+                         replicated along dp=8
+  collective-budget      a correctly sharded program shipped with an
+                         EMPTY banked budget, so its inherent
+                         all-gather reads as unbudgeted
+  donation-through-pjit  a donated dp-sharded accumulator whose
+                         updated value is returned as bf16 — the alias
+                         drops at per-shard shapes
+  reshard-in-body        a forced with_sharding_constraint flips the
+                         accumulator from P('dp', None) to
+                         P(None, 'dp') mid-body: the partitioner must
+                         emit all-to-all resharding collectives
+
+``build_injected_spec(defect)`` returns the full program spec
+(``injected`` set), audit-ready; the probes compile in ~1-2 s each on
+the CPU mesh. On a 1-device build the probes still build (dp=1) but
+the defects cannot manifest — tier-1 runs under the 8-device
+XLA_FLAGS env, where each control must exit 1.
+"""
+
+PROBE_NAME = "sharded_probe"
+
+_W, _K = 512, 256      # the m accumulator: 512*256*4 = 512 KiB f32
+
+
+def _mesh():
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    dp = min(8, len(devs))
+    return Mesh(np.asarray(devs[:dp]).reshape(dp), ("dp",))
+
+
+def _base_fn():
+    """A minimal train-ish step: matmul forward, gradient-shaped
+    reduction, EMA accumulator update — enough structure for sharding
+    propagation and donation to behave like the real step."""
+    import jax.numpy as jnp
+
+    def probe(params, opt_state, x):
+        w = params["w"]
+        g = x.T @ jnp.tanh(x @ w)
+        m = opt_state["m"] * 0.9 + g * 0.1
+        return {"w": w - 0.01 * m}, {"m": m}
+
+    return probe
+
+
+def _assemble(mesh, fn, param_spec, opt_spec, out_param_spec=None,
+              out_opt_spec=None, meta_in_specs=None, meta_extra=None,
+              description=""):
+    """Shared probe-spec assembly: device_put the example args onto
+    their LIVE placements, build matching in/out_shardings (opt_state
+    donated), and attach the declared-sharding metadata."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    ns = lambda spec: NamedSharding(mesh, spec)
+    params = {"w": jnp.ones((_W, _K), jnp.float32)}
+    opt = {"m": jnp.zeros((_W, _K), jnp.float32)}
+    x = jnp.ones((8, _W), jnp.float32)
+    in_sh = (ns(param_spec), ns(opt_spec), ns(P()))
+    args = tuple(jax.device_put(a, sh)
+                 for a, sh in zip((params, opt, x), in_sh))
+    out_sh = (ns(out_param_spec if out_param_spec is not None
+                 else param_spec),
+              ns(out_opt_spec if out_opt_spec is not None
+                 else opt_spec))
+    meta = {
+        "mesh_axes": {a: int(mesh.shape[a]) for a in mesh.axis_names},
+        "in_specs": dict(meta_in_specs if meta_in_specs is not None
+                         else {0: param_spec, 1: opt_spec}),
+        "constraint_specs": [],
+        "expected_collectives": (),
+    }
+    meta.update(meta_extra or {})
+    return {
+        "name": PROBE_NAME, "fn": fn, "args": args,
+        "jit_kwargs": {"in_shardings": in_sh, "out_shardings": out_sh,
+                       "donate_argnums": (1,)},
+        "donate_argnums": (1,),
+        "arg_names": ("params", "opt_state", "x"),
+        "sharding": meta,
+        "description": description,
+    }
+
+
+def _inject_sharding_dropped():
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh()
+    dp = P("dp", None) if mesh.shape["dp"] > 1 else P()
+    # declaration drift: metadata claims params are dp-sharded, the
+    # live in_shardings compile them replicated. opt stays honestly
+    # sharded so the other rules see nothing.
+    return _assemble(
+        mesh, _base_fn(), param_spec=P(), opt_spec=dp,
+        meta_in_specs={0: {"w": P("dp", None)}, 1: {"m": dp}},
+        description="declared dp-sharded params compiled replicated "
+                    "(declaration drift)")
+
+
+def _inject_accidental_replication():
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh()
+    # the deliberately replicated ZeRO accumulator: m is 512 KiB of
+    # per-device state with a dp-divisible dim, placed (and declared)
+    # fully replicated — every device holds all of it
+    return _assemble(
+        mesh, _base_fn(), param_spec=P(), opt_spec=P(),
+        description="512 KiB optimizer accumulator deliberately "
+                    "replicated along dp")
+
+
+def _inject_collective_budget():
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh()
+    dp = P("dp", None) if mesh.shape["dp"] > 1 else P()
+    # correctly sharded — but shipped with an empty banked budget, so
+    # the all-gather the replicated-param update inherently needs
+    # reads as an unbudgeted collective on the hot path
+    return _assemble(
+        mesh, _base_fn(), param_spec=P(), opt_spec=dp,
+        meta_extra={"collective_baseline": {
+            "collectives": {},
+            "tolerances": {"collective_count": {"rtol": 0.0, "atol": 0},
+                           "collective_bytes": {"rtol": 0.0,
+                                                "atol": 0}}}},
+        description="sharded probe gated against an empty collective "
+                    "budget")
+
+
+def _inject_donation_through_pjit():
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh()
+    dp = P("dp", None) if mesh.shape["dp"] > 1 else P()
+    base = _base_fn()
+
+    def probe(params, opt_state, x):
+        new_p, new_o = base(params, opt_state, x)
+        # the donated f32 shards no longer dtype-match the bf16
+        # output shards: the alias drops on every device at once
+        return new_p, {"m": new_o["m"].astype(jnp.bfloat16)}
+
+    return _assemble(
+        mesh, probe, param_spec=P(), opt_spec=dp,
+        description="donated dp-sharded accumulator returned as bf16 "
+                    "(alias dropped at shard shapes)")
+
+
+def _inject_reshard_in_body():
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    mesh = _mesh()
+    dp = P("dp", None) if mesh.shape["dp"] > 1 else P()
+    flipped = P(None, "dp") if mesh.shape["dp"] > 1 else P()
+    base = _base_fn()
+
+    def probe(params, opt_state, x):
+        new_p, new_o = base(params, opt_state, x)
+        # the forced resharding constraint: flip the accumulator's
+        # sharded axis mid-body; with out_shardings pinning it back to
+        # P('dp', None) the partitioner must emit all-to-all both ways
+        m = jax.lax.with_sharding_constraint(
+            new_o["m"], NamedSharding(mesh, flipped))
+        return new_p, {"m": m}
+
+    return _assemble(
+        mesh, probe, param_spec=P(), opt_spec=dp,
+        description="forced resharding constraint flips the "
+                    "accumulator axis mid-body (implicit all-to-all)")
+
+
+MESH_INJECTIONS = {
+    "sharding-dropped": _inject_sharding_dropped,
+    "accidental-replication": _inject_accidental_replication,
+    "collective-budget": _inject_collective_budget,
+    "donation-through-pjit": _inject_donation_through_pjit,
+    "reshard-in-body": _inject_reshard_in_body,
+}
+
+
+def build_injected_spec(defect):
+    """The probe spec for ``defect`` (a MESH_INJECTIONS key), with
+    ``injected`` stamped — the shaudit CLI's --inject positive
+    control."""
+    if defect not in MESH_INJECTIONS:
+        raise ValueError(f"unknown injection {defect!r}; have "
+                         f"{sorted(MESH_INJECTIONS)}")
+    spec = MESH_INJECTIONS[defect]()
+    spec["injected"] = defect
+    return spec

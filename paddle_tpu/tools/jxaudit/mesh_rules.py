@@ -1,0 +1,458 @@
+"""jxaudit mesh-aware rules: sharding & collective semantics of pjit
+programs (the `shaudit` CLI's rule family).
+
+These rules audit the registry's SHARDED tracked programs — pjit'd
+modules compiled over an explicit device mesh — by comparing three
+layers that are supposed to agree but are maintained by different
+hands:
+
+  1. what the code DECLARED — the live PartitionSpec trees and
+     constraint sites threaded through ``spec["sharding"]`` (e.g.
+     ``ShardedTrainStep.audit_sharding_decl``, so declarations cannot
+     drift from the jit call they describe);
+  2. what XLA COMMITTED — the ``sharding={...}`` annotations on the
+     optimized module's entry parameters
+     (``core.parse_entry_param_shardings``) and the collective
+     instructions the partitioner emitted (``xprof.hlo.op_histogram``);
+  3. what was BANKED — the per-opcode collective {count, bytes} rows in
+     scripts/hlo_baseline.json.
+
+Rules live in their OWN registry (``MESH_RULES``) so the jxaudit and
+shaudit CLIs stay disjoint rule sets over one driver
+(``core.audit_programs(..., rules=MESH_RULES)``); every rule degrades
+to null+reason exactly like the built-ins — a single-device build, a
+module whose text carries no annotations, or a failed ``lower()`` must
+never misattribute.
+
+The spec's ``sharding`` dict:
+
+  mesh_axes             {axis_name: size} of the declared mesh
+  in_specs              {argnum: PartitionSpec | pytree of specs} — a
+                        bare spec is a PREFIX (covers every leaf of
+                        that arg), mirroring jit's in_shardings
+  constraint_specs      [repr(PartitionSpec), ...] with_sharding_
+                        constraint sites the traced program must carry
+  expected_collectives  collective opcodes reshard-in-body must NOT
+                        flag (declared, justified data movement — e.g.
+                        flash-attention halo exchanges)
+  collective_baseline   attached by the CLI from hlo_baseline.json:
+                        {"collectives": {op: {count, bytes}},
+                         "tolerances": {...}}
+"""
+from . import core as _core
+from .core import Rule, iter_eqns, leaf_nbytes, aval_type_str
+from .rules import (DONATABLE_STATE_MIN_BYTES, STATE_ARG_NAMES,
+                    DonationDropped)
+
+MESH_RULES = {}
+
+# implicit-reshard collective opcodes: all-to-all IS the partitioner's
+# spelling of a layout transpose (sharded axis moves), and a
+# collective-permute outside the declared expected set means data is
+# being rotated between devices no constraint asked for. all-reduce /
+# all-gather / reduce-scatter are NOT here — they are how legitimate
+# sharded math (grad sync, gather-on-use) is spelled, and their counts
+# are gated exactly by collective-budget instead.
+RESHARD_OPCODES = ("all-to-all", "collective-permute")
+
+
+def register_mesh(cls):
+    """Class decorator: instantiate into the MESH registry, refusing
+    any id collision with the built-in jxaudit rules — the three CLIs'
+    --list-rules are documented (and tested) disjoint."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in MESH_RULES or inst.id in _core.RULES:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    MESH_RULES[inst.id] = inst
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# declaration plumbing
+# ---------------------------------------------------------------------------
+
+def _spec_axes(spec):
+    """Flat mesh-axis names a PartitionSpec actually uses (entries can
+    be None, a name, or a tuple of names)."""
+    axes = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(entry)
+        else:
+            axes.append(entry)
+    return axes
+
+
+def _is_replicated(committed):
+    """True when a committed HLO sharding string means fully
+    replicated. Exact-match on the canonical spelling — `{devices=...
+    last_tile_dim_replicate}` is PARTIAL replication and must not
+    match."""
+    return committed.replace(" ", "") == "{replicated}"
+
+
+def leaf_rows(ctx):
+    """Flatten the declared per-arg specs against the actual args ->
+    ([(flat_leaf_index, argnum, label, leaf, spec_or_None), ...], None)
+    or (None, reason). Labels are ``argname + keypath`` (stable across
+    runs — dict flattening is key-sorted). A bare PartitionSpec
+    declaration is a prefix covering every leaf of its arg."""
+    import jax
+    from jax.sharding import PartitionSpec
+    meta = ctx.spec.get("sharding") or {}
+    in_specs = meta.get("in_specs") or {}
+    names = ctx.arg_names
+    rows, flat = [], 0
+    for argnum, arg in enumerate(ctx.args):
+        paths = jax.tree_util.tree_flatten_with_path(arg)[0]
+        decl = in_specs.get(argnum)
+        if isinstance(decl, PartitionSpec):
+            specs = [decl] * len(paths)
+        elif decl is not None:
+            specs = jax.tree_util.tree_leaves(
+                decl, is_leaf=lambda x: isinstance(x, PartitionSpec))
+            if len(specs) != len(paths):
+                return None, (
+                    f"declared in_specs for arg #{argnum} flatten to "
+                    f"{len(specs)} spec leaves but the arg has "
+                    f"{len(paths)} — the declaration drifted from the "
+                    "argument structure")
+        else:
+            specs = [None] * len(paths)
+        base = (names[argnum] if names and argnum < len(names)
+                else f"#{argnum}")
+        for i, (path, leaf) in enumerate(paths):
+            rows.append((flat + i, argnum,
+                         base + jax.tree_util.keystr(path), leaf,
+                         specs[i]))
+        flat += len(paths)
+    return rows, None
+
+
+def _committed_views(ctx, rule):
+    """(entry_param_shardings, leaf_param_map) or (None, None) after
+    degrading `rule` with the blocking reason."""
+    ann = ctx.entry_param_shardings
+    if ann is None:
+        ctx.degrade(rule.id, "entry sharding annotations unavailable: "
+                    + ctx.unavailable.get("entry_param_shardings", "?"))
+        return None, None
+    mapping = ctx.leaf_param_map
+    if mapping is None:
+        ctx.degrade(rule.id, "cannot map arg leaves onto compiled "
+                    "entry parameters: "
+                    + ctx.unavailable.get("leaf_param_map", "?"))
+        return None, None
+    return ann, mapping
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+@register_mesh
+class ShardingDropped(Rule):
+    id = "sharding-dropped"
+    severity = "error"
+    rationale = ("A declared in-sharding XLA silently committed as "
+                 "fully replicated (or a with_sharding_constraint site "
+                 "a refactor traced away) undoes the memory/compute "
+                 "partitioning the code asked for — the program still "
+                 "runs, just dp-times bigger, and only a compile-level "
+                 "diff can see it.")
+
+    def check(self, ctx):
+        meta = ctx.spec.get("sharding")
+        if not meta:
+            ctx.degrade(self.id, "spec carries no declared sharding "
+                        "metadata (not a mesh program?)")
+            return
+        rows, reason = leaf_rows(ctx)
+        if rows is None:
+            ctx.degrade(self.id, reason)
+            return
+        declared = [r for r in rows
+                    if r[4] is not None and _spec_axes(r[4])]
+        if declared:
+            ann, mapping = _committed_views(ctx, self)
+            if ann is None:
+                return
+            for flat, argnum, label, leaf, spec in declared:
+                pi = mapping.get(flat)
+                if pi is None:
+                    continue    # pruned arg: nothing was committed
+                committed = ann.get(pi)
+                if committed is None:
+                    ctx.degrade(self.id, f"entry parameter {pi} "
+                                f"({label}) carries no sharding "
+                                "annotation")
+                    continue
+                if _is_replicated(committed):
+                    yield ctx.finding(
+                        self.id,
+                        f"declared sharding {spec} for {label} was "
+                        "dropped — XLA committed this entry parameter "
+                        "fully replicated",
+                        severity=self.severity,
+                        details={"leaf": label, "declared": repr(spec),
+                                 "committed": committed,
+                                 "entry_param": pi})
+        wanted = list(meta.get("constraint_specs") or ())
+        if not wanted:
+            return
+        cj = ctx.closed_jaxpr
+        if cj is None:
+            ctx.degrade(self.id, "jaxpr unavailable: "
+                        + ctx.unavailable.get("jaxpr", "?"))
+            return
+        present = set()
+        for eqn in iter_eqns(cj.jaxpr):
+            if getattr(eqn.primitive, "name",
+                       str(eqn.primitive)) == "sharding_constraint":
+                sh = eqn.params.get("sharding")
+                present.add(repr(getattr(sh, "spec", sh)))
+        for want in wanted:
+            if want not in present:
+                yield ctx.finding(
+                    self.id,
+                    f"declared with_sharding_constraint {want} has no "
+                    "site left in the traced program — the constraint "
+                    "was dropped",
+                    severity=self.severity,
+                    details={"declared": want,
+                             "present": sorted(present)})
+
+
+@register_mesh
+class AccidentalReplication(Rule):
+    id = "accidental-replication"
+    severity = "error"
+    rationale = ("A large state tensor (optimizer moments, KV cache) "
+                 "compiled fully replicated along a >1-size mesh axis "
+                 "pays (devices-1)x its bytes in HBM for nothing — the "
+                 "silent memory tax arXiv:2004.13336 measures; ZeRO "
+                 "exists precisely to shard these.")
+
+    def check(self, ctx):
+        meta = ctx.spec.get("sharding")
+        if not meta:
+            ctx.degrade(self.id, "spec carries no declared sharding "
+                        "metadata (not a mesh program?)")
+            return
+        axes = {a: int(s) for a, s in (meta.get("mesh_axes") or
+                                       {}).items() if int(s) > 1}
+        if not axes:
+            return      # 1-device mesh: replication is free
+        names = ctx.arg_names
+        if names is None:
+            ctx.degrade(self.id, "positional arg names unavailable "
+                        "(prebuilt jitted spec without arg_names)")
+            return
+        rows, reason = leaf_rows(ctx)
+        if rows is None:
+            ctx.degrade(self.id, reason)
+            return
+        ann, mapping = _committed_views(ctx, self)
+        if ann is None:
+            return
+        ndev = 1
+        for s in (meta.get("mesh_axes") or {}).values():
+            ndev *= int(s)
+        for flat, argnum, label, leaf, spec in rows:
+            if argnum >= len(names) \
+                    or names[argnum] not in STATE_ARG_NAMES:
+                continue
+            nbytes = leaf_nbytes(leaf)
+            if nbytes < DONATABLE_STATE_MIN_BYTES:
+                continue
+            pi = mapping.get(flat)
+            if pi is None:
+                continue
+            committed = ann.get(pi)
+            if committed is None or not _is_replicated(committed):
+                continue
+            shape = getattr(leaf, "shape", ())
+            if not any(d and d % size == 0
+                       for d in shape for size in axes.values()):
+                continue    # no mesh axis divides any dim: unshardable
+            yield ctx.finding(
+                self.id,
+                f"state leaf {label} ({aval_type_str(leaf)}) is "
+                f"compiled fully replicated across the {ndev}-device "
+                "mesh despite a shardable dim — every device holds a "
+                "full copy",
+                severity=self.severity,
+                details={"leaf": label, "bytes": nbytes,
+                         "wasted_bytes": nbytes * (ndev - 1),
+                         "mesh_axes": dict(meta.get("mesh_axes") or {}),
+                         "entry_param": pi})
+
+
+@register_mesh
+class DonationThroughPjit(DonationDropped):
+    # DonationDropped's check already works at per-shard shapes — the
+    # leaf/param alignment types each concrete leaf by its
+    # sharding.shard_shape (core.leaf_shard_shape), which is how a
+    # partitioned module's entry parameters are spelled. Re-registered
+    # under its own id so the MESH registry gates it on the sharded
+    # programs (and the built-in registry's findings stay attributed to
+    # 'donation-dropped' for the single-device ones).
+    id = "donation-through-pjit"
+    severity = "error"
+    rationale = ("Donation is declared per logical arg but committed "
+                 "per SHARD: an output whose dtype/per-shard shape no "
+                 "longer matches the donated input drops the alias on "
+                 "every device at once — dp copies of the double-"
+                 "buffering HBM cost donation-dropped flags on one.")
+
+
+@register_mesh
+class CollectiveBudget(Rule):
+    id = "collective-budget"
+    severity = "error"
+    rationale = ("Collectives are the scaling-cost primitives (EQuARX: "
+                 "count AND operand bytes are the gate metric); an "
+                 "accidental all-gather on a hot path is invisible to "
+                 "unit tests and shows up in benches as an unexplained "
+                 "regression — gate the per-opcode histogram against "
+                 "the banked budget instead.")
+
+    def check(self, ctx):
+        text = ctx.hlo_text
+        if text is None:
+            ctx.degrade(self.id, "compiled HLO unavailable: "
+                        + ctx.unavailable.get("hlo_text", "?"))
+            return
+        meta = ctx.spec.get("sharding") or {}
+        base = meta.get("collective_baseline")
+        if base is None:
+            ctx.degrade(self.id, meta.get(
+                "collective_baseline_reason",
+                "no banked collective rows for this program — bank "
+                "them via scripts/hlo_audit.py --update-baseline"))
+            return
+        from ..xprof import hlo as hlo_mod
+        hist = hlo_mod.op_histogram(text)
+        rows = base.get("collectives") or {}
+        tols = base.get("tolerances") or {}
+        count_tol = tols.get("collective_count") or {}
+        bytes_tol = tols.get("collective_bytes") or {}
+        cur_counts = hist.get("collectives") or {}
+        cur_bytes = hist.get("collective_bytes") or {}
+        for op in sorted(cur_counts):
+            row = rows.get(op)
+            if row is None:
+                yield ctx.finding(
+                    self.id,
+                    f"unbudgeted collective '{op}' appeared in this "
+                    "program (zero banked budget) — an accidental "
+                    "communication op on the hot path",
+                    severity=self.severity,
+                    details={"op": op, "count": cur_counts[op],
+                             "bytes": cur_bytes.get(op)})
+                continue
+            b = row.get("count")
+            if b is not None and cur_counts[op] > _limit(b, count_tol):
+                yield ctx.finding(
+                    self.id,
+                    f"collective '{op}' count exceeded its banked "
+                    "budget",
+                    severity=self.severity,
+                    details={"op": op, "base": b,
+                             "current": cur_counts[op],
+                             "limit": _limit(b, count_tol)})
+            bb, cb = row.get("bytes"), cur_bytes.get(op)
+            if bb is not None and cb is not None \
+                    and cb > _limit(bb, bytes_tol):
+                yield ctx.finding(
+                    self.id,
+                    f"collective '{op}' operand bytes exceeded the "
+                    "banked budget",
+                    severity=self.severity,
+                    details={"op": op, "base_bytes": bb,
+                             "current_bytes": cb,
+                             "limit": _limit(bb, bytes_tol)})
+
+
+def _limit(base, tol):
+    return base * (1.0 + tol.get("rtol", 0.0)) + tol.get("atol", 0.0)
+
+
+@register_mesh
+class ReshardInBody(Rule):
+    id = "reshard-in-body"
+    severity = "error"
+    rationale = ("A producer/consumer sharding mismatch inside the "
+                 "module makes the partitioner insert an implicit "
+                 "reshard collective (all-to-all / collective-permute) "
+                 "no declared constraint asked for — per-step data "
+                 "motion the source never spelled, usually a "
+                 "PartitionSpec typo or a propagation surprise.")
+
+    def check(self, ctx):
+        meta = ctx.spec.get("sharding")
+        if not meta:
+            ctx.degrade(self.id, "spec carries no declared sharding "
+                        "metadata (not a mesh program?)")
+            return
+        text = ctx.hlo_text
+        if text is None:
+            ctx.degrade(self.id, "compiled HLO unavailable: "
+                        + ctx.unavailable.get("hlo_text", "?"))
+            return
+        from ..xprof import hlo as hlo_mod
+        hist = hlo_mod.op_histogram(text)
+        expected = set(meta.get("expected_collectives") or ())
+        cur_bytes = hist.get("collective_bytes") or {}
+        for op, n in sorted((hist.get("collectives") or {}).items()):
+            base = op[:-6] if op.endswith("-start") else op
+            if base not in RESHARD_OPCODES or base in expected \
+                    or op in expected:
+                continue
+            yield ctx.finding(
+                self.id,
+                f"implicit reshard: collective '{base}' in the "
+                "compiled body with no declared constraint or "
+                "expected-collective asking for it",
+                severity=self.severity,
+                details={"op": op, "count": n,
+                         "bytes": cur_bytes.get(op),
+                         "expected_collectives": sorted(expected)})
+
+
+# ---------------------------------------------------------------------------
+# summary / journal
+# ---------------------------------------------------------------------------
+
+def summarize_mesh(findings, report):
+    """core.summarize + the mesh-specific aggregates the journal and
+    runlog summary render: total wasted replicated HBM and the number
+    of collective-budget breaches."""
+    s = _core.summarize(findings, report)
+    s["wasted_replicated_bytes"] = int(sum(
+        f.details.get("wasted_bytes") or 0 for f in findings
+        if f.rule == "accidental-replication"))
+    s["collective_breaches"] = sum(
+        1 for f in findings if f.rule == "collective-budget")
+    return s
+
+
+def publish_mesh_summary(findings, report, recorder=None, **extra):
+    """Journal a ``shaudit`` summary event through ``recorder`` or the
+    current flight recorder — same contract as core.publish_summary
+    (pass POST-baseline findings so the journaled verdict matches the
+    exit code). No-op without a recorder."""
+    from ...utils import flight_recorder as fr
+    rec = recorder if recorder is not None else fr.get_recorder()
+    if rec is None:
+        return None
+    s = summarize_mesh(findings, report)
+    return rec.shaudit(
+        findings=s["findings"], by_rule=s["by_rule"],
+        programs=s["programs"], degraded=s["degraded"],
+        wasted_replicated_bytes=s["wasted_replicated_bytes"],
+        collective_breaches=s["collective_breaches"], **extra)

@@ -131,3 +131,20 @@ def tracked_specs(names=None):
 def tracked_program_names():
     """Current full program-name tuple (decorators may add to it)."""
     return _builtin_names() + tuple(sorted(AUDITED))
+
+
+# the pjit-over-a-mesh subset the mesh-aware rule family (shaudit)
+# audits: programs whose specs carry a "sharding" declaration
+MESH_PROGRAMS = ("sharded_train_step", "sharded_train_step_z3",
+                 "sharded_decode_wave")
+
+
+def mesh_specs(names=None):
+    """Specs for the sharded tracked programs (or the named subset) —
+    the shaudit CLI's default audit surface."""
+    want = list(names) if names else list(MESH_PROGRAMS)
+    unknown = set(want) - set(MESH_PROGRAMS)
+    if unknown:
+        raise ValueError(f"unknown mesh programs {sorted(unknown)}; "
+                         f"registry has {list(MESH_PROGRAMS)}")
+    return tracked_specs(want)

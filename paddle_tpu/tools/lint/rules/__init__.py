@@ -1,3 +1,3 @@
 """Importing this package registers every built-in ptlint rule."""
 from . import (alert_rules, chaos_guard, event_kinds,  # noqa: F401
-               hygiene, locks, metric_names, tracer)
+               hygiene, locks, mesh_axes, metric_names, tracer)

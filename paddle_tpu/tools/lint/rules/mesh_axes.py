@@ -1,0 +1,137 @@
+"""mesh-axis-name: every string-literal mesh axis is a declared axis.
+
+A PartitionSpec / collective naming an axis the mesh does not have is
+the classic silent-replication typo: GSPMD treats the unknown name as
+"don't partition", the program compiles, and the only symptom is N
+copies of the tensor (shaudit's accidental-replication rule catches it
+at compile level — this rule catches the typo at the source).
+
+Allowed axis names per file are the union of:
+
+  * the canonical ``*_AXIS`` constants declared in
+    ``paddle_tpu/distributed/mesh.py`` (dp/mp/pp/sp/ep — read from that
+    file's AST so this rule cannot drift from the registry of record);
+  * axes the FILE ITSELF declares: string literals inside ``Mesh(...)``
+    / ``make_mesh(...)`` call arguments (jax's positional axis-name
+    tuples and this repo's ``make_mesh({'dp': 8})`` dict keys both
+    resolve), plus the file's own module-level ``*_AXIS = "..."``
+    constants.
+
+Checked sites: string literals in ``PartitionSpec(...)`` / ``P(...)``
+positional args (nested tuples included) and in ``axis_name=`` /
+``axis_names=`` keywords of any call. Dynamically-built names are out
+of scope — the same escape hatch the metric-name rule leaves.
+"""
+import ast
+import os
+
+from ..core import Rule, register
+from ..astutil import last_name
+
+#: fallback when distributed/mesh.py can't be read — the canonical five
+#: as of when this rule was written
+FALLBACK_AXES = frozenset({"dp", "mp", "pp", "sp", "ep"})
+
+MESH_CTORS = ("Mesh", "make_mesh")
+SPEC_CTORS = ("PartitionSpec", "P")
+
+_canonical_cache = {}
+
+
+def canonical_axes(repo_root):
+    """The ``*_AXIS`` string constants of distributed/mesh.py."""
+    if repo_root in _canonical_cache:
+        return _canonical_cache[repo_root]
+    path = os.path.join(repo_root, "paddle_tpu", "distributed", "mesh.py")
+    axes = set()
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.endswith("_AXIS") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                axes.add(node.value.value)
+    except (OSError, SyntaxError):
+        pass
+    out = frozenset(axes) or FALLBACK_AXES
+    _canonical_cache[repo_root] = out
+    return out
+
+
+def _strings_in(node):
+    """String constants anywhere under an expression node."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub
+
+
+def declared_axes(tree):
+    """Axes this file declares: Mesh/make_mesh call literals + its own
+    module-level *_AXIS constants."""
+    axes = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.endswith("_AXIS") \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            axes.add(node.value.value)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and last_name(node.func) in MESH_CTORS):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Dict):        # make_mesh({'dp': 8})
+                for k in arg.keys:
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str):
+                        axes.add(k.value)
+            elif isinstance(arg, (ast.Tuple, ast.List, ast.Set,
+                                  ast.Constant)):
+                for s in _strings_in(arg):
+                    axes.add(s.value)
+    return axes
+
+
+def axis_literal_sites(tree):
+    """Yield (node, axis_string) for every checked literal site."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if last_name(node.func) in SPEC_CTORS:
+            for arg in node.args:
+                for s in _strings_in(arg):
+                    yield s, s.value
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    yield kw.value, kw.value.value
+            elif kw.arg == "axis_names":
+                for s in _strings_in(kw.value):
+                    yield s, s.value
+
+
+@register
+class MeshAxisName(Rule):
+    id = "mesh-axis-name"
+    rationale = ("a PartitionSpec/collective naming an axis the mesh "
+                 "does not declare compiles to silent full replication "
+                 "instead of an error; axis literals must come from "
+                 "distributed/mesh.py's *_AXIS registry or a mesh the "
+                 "file itself constructs.")
+
+    def check(self, ctx):
+        allowed = canonical_axes(ctx.repo_root) \
+            | ctx.cached("declared_axes",
+                         lambda: declared_axes(ctx.tree))
+        for node, axis in axis_literal_sites(ctx.tree):
+            if axis not in allowed:
+                yield ctx.finding(
+                    self.id, node,
+                    f"axis name {axis!r} is not a declared mesh axis "
+                    f"(known: {', '.join(sorted(allowed))}); typo'd "
+                    "axes shard nothing and replicate silently")

@@ -38,6 +38,7 @@ METRICS = {
     "fusion_count": ("hlo", "fusion_count"),
     "instruction_count": ("hlo", "instruction_count"),
     "collective_count": ("hlo", "collective_count"),
+    "collective_bytes": ("hlo", "collective_bytes_total"),
 }
 
 # an increase is a regression when cur > base * (1 + rtol) + atol.
@@ -52,6 +53,10 @@ DEFAULT_TOLERANCES = {
     "fusion_count": {"rtol": 0.25, "atol": 2},
     "instruction_count": {"rtol": 0.25, "atol": 8},
     "collective_count": {"rtol": 0.0, "atol": 0},
+    # any extra communicated byte on a banked program is a regression —
+    # this is the EQuARX-style budget the quantized-collective follow-on
+    # gates against, so it gets no slack by default
+    "collective_bytes": {"rtol": 0.0, "atol": 0},
 }
 
 
@@ -250,6 +255,17 @@ def make_baseline(snapshot, previous=None, keep_missing=False):
                          if k not in snapshot["programs"]})
     for name, entry in sorted(snapshot["programs"].items()):
         row = {"metrics": dict(entry["metrics"])}
+        hlo_sec = entry.get("hlo")
+        if isinstance(hlo_sec, dict) and "collectives" in hlo_sec:
+            # per-opcode {count, bytes} rows — the collective-budget rule
+            # (tools/jxaudit/mesh_rules.py) gates sharded programs against
+            # these, so an accidental all-gather is named, not just a +1
+            # in collective_count. An empty dict is meaningful: it banks
+            # a ZERO budget for every collective opcode.
+            cb = hlo_sec.get("collective_bytes") or {}
+            row["collectives"] = {
+                op: {"count": n, "bytes": cb.get(op)}
+                for op, n in sorted(hlo_sec["collectives"].items())}
         if entry.get("unavailable"):
             row["unavailable"] = dict(entry["unavailable"])
         old_tol = prev_programs.get(name, {}).get("tolerances")

@@ -38,6 +38,56 @@ COLLECTIVE_OPCODES = (
     "collective-permute", "collective-broadcast",
 )
 
+# HLO type-string element sizes for operand-byte accounting; a dtype
+# outside this table makes the bytes for that op None (count still
+# recorded) rather than silently wrong
+_HLO_TYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+_OPERAND_TYPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([\d,]*)\]")
+
+
+def _operand_bytes(line, op):
+    """Total operand bytes of one collective instruction line, parsed
+    from the typed operand list (`all-gather(f32[32,128]{1,0} %x, ...)`
+    — optimized HLO text spells every operand with its type), or None
+    when a type doesn't parse. Communication volume is what the operands
+    carry INTO the op: for all-gather the result is dp x bigger and for
+    reduce-scatter dp x smaller, so result bytes would mis-rank exactly
+    the ops the budget exists to compare."""
+    start = line.find(op + "(")
+    if start < 0:
+        return None
+    i = start + len(op) + 1
+    depth, buf = 1, []
+    while i < len(line) and depth:
+        c = line[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if not depth:
+                break
+        buf.append(c)
+        i += 1
+    total = 0
+    matched = False
+    for m in _OPERAND_TYPE_RE.finditer("".join(buf)):
+        size = _HLO_TYPE_BYTES.get(m.group(1))
+        if size is None:
+            return None
+        n = 1
+        for d in m.group(2).split(","):
+            if d:
+                n *= int(d)
+        total += n * size
+        matched = True
+    return total if matched else None
+
 
 def op_histogram(hlo_text):
     """Reduce optimized HLO text to the audit's aggregate counts.
@@ -48,6 +98,11 @@ def op_histogram(hlo_text):
       fusion_kinds       — {"Loop": n, "Output": n, ...} per kind=kXxx
       collective_count   — communication ops (incl. -start variants)
       collectives        — per-opcode counts for the comm ops present
+      collective_bytes   — per-opcode total OPERAND bytes for those ops
+                           (None for an opcode whose operand types did
+                           not parse); communication volume, the number
+                           EQuARX-style collective work is gated on
+      collective_bytes_total — sum of the parseable per-op bytes
       custom_call_count  — custom-call instructions (host callbacks,
                            library kernels — the un-fusable opaque ops)
       custom_calls       — {target: count} per custom_call_target — a
@@ -64,6 +119,7 @@ def op_histogram(hlo_text):
     ops = {}
     fusion_kinds = {}
     custom_calls = {}
+    coll_bytes = {}
     for line in hlo_text.splitlines():
         m = _INSTR_RE.match(line)
         if not m:
@@ -78,6 +134,16 @@ def op_histogram(hlo_text):
             t = _CUSTOM_CALL_TARGET_RE.search(line)
             target = t.group(1) if t else "unknown"
             custom_calls[target] = custom_calls.get(target, 0) + 1
+        else:
+            base = op[:-6] if op.endswith("-start") else op
+            if base in COLLECTIVE_OPCODES:
+                nbytes = _operand_bytes(line, op)
+                if op in coll_bytes and (nbytes is None
+                                         or coll_bytes[op] is None):
+                    coll_bytes[op] = None
+                else:
+                    coll_bytes[op] = coll_bytes.get(op, 0) + nbytes \
+                        if nbytes is not None else None
     collectives = {}
     for op, n in ops.items():
         base = op[:-6] if op.endswith("-start") else op
@@ -89,6 +155,9 @@ def op_histogram(hlo_text):
         "fusion_kinds": dict(sorted(fusion_kinds.items())),
         "collective_count": sum(collectives.values()),
         "collectives": dict(sorted(collectives.items())),
+        "collective_bytes": dict(sorted(coll_bytes.items())),
+        "collective_bytes_total": sum(
+            v for v in coll_bytes.values() if v is not None),
         "custom_call_count": ops.get("custom-call", 0),
         "custom_calls": dict(sorted(custom_calls.items())),
         "ops": dict(sorted(ops.items())),

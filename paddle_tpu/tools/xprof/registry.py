@@ -34,7 +34,12 @@ REAL hot path:
     PRNG key stays a live entry parameter — jxaudit's donation rule
     verifies the dp-SHARDED opt-state leaves are actually aliased in
     the partitioned HLO (the PR-7 eager-optimizer donation bug, sharded
-    incarnation);
+    incarnation); `sharded_train_step_z3` is the same step at ZeRO-3
+    (params dp-sharded too, gather-on-use);
+  * `sharded_decode_wave` — the dense engine's decode wave pjit'd with
+    head-sharded K/V caches over the 8-device `mp` mesh (the ROADMAP
+    item-1 tensor-parallel serving scaffold, gated by the mesh-aware
+    audit before the real TP engine lands);
   * `cached_decode_attention` — the GQA single-token cached attention
     core from nn/transformer.py with a per-slot position VECTOR (the
     serving decode regime);
@@ -63,13 +68,22 @@ TRAIN = dict(vocab=512, hidden=128, layers=2, heads=4, seq=128, batch=2)
 # sharded-train canonical mesh: the tier-1 8-CPU-device dp mesh
 # (conftest's --xla_force_host_platform_device_count=8), ZeRO-1. The
 # batch (2) is not divisible by dp, so it rides replicated — the
-# exact-reshard regime chaos_train proves bitwise.
+# exact-reshard regime chaos_train proves bitwise. The z3 sibling
+# (`sharded_train_step_z3`) dp-shards the PARAMETERS too — gather-on-use
+# in the partitioned HLO, the regime test_zero3.py proves — so the
+# mesh-aware audit gates both ZeRO points the repo ships.
 SHARDED_TRAIN = dict(TRAIN, dp=8, zero_stage=1, dropout=0.1)
+# tensor-parallel decode canonical shape: the dense serving wave with
+# heads == mp so the per-slot K/V caches shard cleanly head-wise over
+# the tier-1 8-device mesh — the ROADMAP item-1 (TP sharded serving)
+# scaffold the mesh-aware audit gates before the real engine lands
+SHARDED_SERVING = dict(SERVING, heads=8, mp=8)
 
 TRACKED_PROGRAMS = ("serving_decode_wave", "serving_prefill",
                     "paged_decode_wave", "paged_prefill_chunk",
                     "paged_spec_draft_wave", "paged_spec_verify",
                     "train_step", "sharded_train_step",
+                    "sharded_train_step_z3", "sharded_decode_wave",
                     "cached_decode_attention",
                     "paged_decode_attention",
                     "paged_fused_decode_attention",
@@ -362,31 +376,37 @@ def _train_step_spec():
     return train_step_spec(step, (ids,), (ids,))
 
 
-def sharded_train_step_spec(step, inputs, labels):
+def sharded_train_step_spec(step, inputs, labels,
+                            name="sharded_train_step"):
     """Audit spec for a LIVE ShardedTrainStep: lowers the step's own
     compiled (pjit'd, in/out-sharded, donated) callable with its
     current sharded state — the program a mesh training run actually
     dispatches. `inputs`/`labels` are global batch arrays; they ride
     through the step's own `_shard_batch` so the lowering sees the same
-    placements a real step does."""
+    placements a real step does. The `sharding` entry is the step's own
+    declaration of record (`audit_sharding_decl`) — what the mesh-aware
+    rules (tools/jxaudit/mesh_rules.py) compare against the compiled
+    module's committed annotations."""
     import jax
     import jax.numpy as jnp
     args = (step.params, step.buffers, step.opt_state, step.grad_acc,
             jax.random.PRNGKey(0), jnp.asarray(1e-4, jnp.float32),
             jnp.asarray(1, jnp.int32), step._shard_batch(tuple(inputs)),
             step._shard_batch(tuple(labels)))
-    return {"name": "sharded_train_step", "jitted": step._compiled,
+    return {"name": name, "jitted": step._compiled,
             "args": args,
             "donate_argnums": getattr(step, "_donate_argnums", ()),
             "arg_names": ("params", "buffers", "opt_state", "acc", "key",
                           "lr", "step_i", "inputs", "labels"),
+            "sharding": step.audit_sharding_decl(),
             "description": "GSPMD forward+backward+AdamW with ZeRO "
                            f"stage-{step.zero_stage} dp-sharded opt "
                            "state, one donated executable "
                            f"(mesh {dict(zip(step.mesh.axis_names, step.mesh.devices.shape))})"}
 
 
-def _sharded_train_step_spec():
+def _sharded_train_step_spec(zero_stage=None,
+                             name="sharded_train_step"):
     import numpy as np
     import jax
     from jax.sharding import Mesh
@@ -397,6 +417,8 @@ def _sharded_train_step_spec():
     from paddle_tpu.nlp.gpt import gpt_pretrain_loss
 
     C = SHARDED_TRAIN
+    if zero_stage is None:
+        zero_stage = C["zero_stage"]
     pt.seed(0)
     cfg = GPTConfig(vocab_size=C["vocab"], hidden_size=C["hidden"],
                     num_layers=C["layers"], num_heads=C["heads"],
@@ -411,9 +433,65 @@ def _sharded_train_step_spec():
     dp = min(C["dp"], len(devs))
     mesh = Mesh(np.asarray(devs[:dp]).reshape(dp), ("dp",))
     step = ShardedTrainStep(model, gpt_pretrain_loss, opt, mesh=mesh,
-                            zero_stage=C["zero_stage"])
+                            zero_stage=zero_stage)
     ids = np.zeros((C["batch"], C["seq"]), np.int32)
-    return sharded_train_step_spec(step, (ids,), (ids,))
+    return sharded_train_step_spec(step, (ids,), (ids,), name=name)
+
+
+def _sharded_decode_wave_spec():
+    """pjit'd tensor-parallel decode wave on the 8-device CPU mesh: the
+    dense engine's OWN decode-wave closure, re-jitted with the per-slot
+    K/V caches sharded head-wise (`P(None, 'mp', None, None)`) and
+    params/buffers replicated — a faithful scaffold of ROADMAP item 1's
+    TP serving regime, with the caches still donated so the mesh-aware
+    donation rule proves aliasing survives pjit at shard shapes."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as pt
+    from paddle_tpu.nlp import GPTConfig, GPTForPretraining
+    from paddle_tpu.serving import ServingEngine
+
+    C = SHARDED_SERVING
+    pt.seed(0)
+    cfg = GPTConfig(vocab_size=C["vocab"], hidden_size=C["hidden"],
+                    num_layers=C["layers"], num_heads=C["heads"],
+                    max_seq_len=C["max_len"], dropout=0.0,
+                    attn_dropout=0.0)
+    engine = ServingEngine(GPTForPretraining(cfg),
+                           num_slots=C["num_slots"],
+                           max_len=C["max_len"],
+                           prefill_len=C["prefill_len"])
+    devs = jax.devices()
+    mp = min(C["mp"], len(devs))
+    mesh = Mesh(np.asarray(devs[:mp]).reshape(mp), ("mp",))
+    ns = lambda spec: NamedSharding(mesh, spec)
+    cache_spec = P(None, "mp", None, None)    # [slots, HEADS, len, d]
+    base = _dense_engine_specs(engine, "sharded")[0]
+    # caches are argnum 2 (the donated state); everything else —
+    # params, buffers, per-slot control vectors, key — is replicated.
+    # in_shardings rides pytree PREFIXES: one NamedSharding per argnum
+    # covers every leaf of that arg.
+    in_sh = tuple(ns(cache_spec) if i == 2 else ns(P())
+                  for i in range(len(base["args"])))
+    args = tuple(jax.device_put(a, sh)
+                 for a, sh in zip(base["args"], in_sh))
+    return {
+        "name": "sharded_decode_wave", "fn": base["fn"], "args": args,
+        "jit_kwargs": dict(base["jit_kwargs"], in_shardings=in_sh),
+        "donate_argnums": base["jit_kwargs"]["donate_argnums"],
+        "sharding": {
+            "mesh_axes": {a: int(mesh.shape[a])
+                          for a in mesh.axis_names},
+            "in_specs": {2: cache_spec},
+            "constraint_specs": [],
+            "expected_collectives": (),
+        },
+        "description": "tensor-parallel batched decode token: head-"
+                       f"sharded K/V caches over mp={mp} "
+                       f"(slots={C['num_slots']}, heads={C['heads']})"}
 
 
 def _attention_specs():
@@ -544,6 +622,11 @@ def tracked_program_specs(names=None):
         specs.append(_train_step_spec())
     if "sharded_train_step" in want:
         specs.append(_sharded_train_step_spec())
+    if "sharded_train_step_z3" in want:
+        specs.append(_sharded_train_step_spec(
+            zero_stage=3, name="sharded_train_step_z3"))
+    if "sharded_decode_wave" in want:
+        specs.append(_sharded_decode_wave_spec())
     if want & {"cached_decode_attention", "paged_decode_attention",
                "paged_fused_decode_attention",
                "paged_fused_chunk_attention", "prefill_flash_attention"}:

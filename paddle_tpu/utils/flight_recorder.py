@@ -41,9 +41,9 @@ class NonFiniteError(RuntimeError):
 
 
 EVENT_KINDS = ("run_start", "step", "compile", "nonfinite", "collective",
-               "checkpoint", "xla_program", "jxaudit", "chaos", "fault",
-               "resume", "reshard", "hang", "slo", "alert", "spec",
-               "run_end")
+               "checkpoint", "xla_program", "jxaudit", "shaudit", "chaos",
+               "fault", "resume", "reshard", "hang", "slo", "alert",
+               "spec", "run_end")
 
 #: every `kind=` a `fault` event may carry.  The closed vocabulary is
 #: what makes journals greppable and the runlog summarizer's fault
@@ -270,6 +270,29 @@ class FlightRecorder:
             fields["degraded"] = int(degraded)
         fields.update(extra)
         return self.record("jxaudit", **fields)
+
+    def shaudit(self, findings, by_rule=None, programs=None,
+                degraded=None, wasted_replicated_bytes=None,
+                collective_breaches=None, **extra):
+        """Mesh-aware sharding-audit verdict for the pjit'd sharded
+        programs (the shaudit journal hook). Beyond the jxaudit fields,
+        `wasted_replicated_bytes` totals the accidental-replication
+        waste across findings and `collective_breaches` counts
+        collective-budget violations — zero findings journals as a
+        clean stamp, not silence."""
+        fields = {"findings": int(findings),
+                  "by_rule": {str(k): int(v)
+                              for k, v in sorted((by_rule or {}).items())}}
+        if programs is not None:
+            fields["programs"] = int(programs)
+        if degraded is not None:
+            fields["degraded"] = int(degraded)
+        if wasted_replicated_bytes is not None:
+            fields["wasted_replicated_bytes"] = int(wasted_replicated_bytes)
+        if collective_breaches is not None:
+            fields["collective_breaches"] = int(collective_breaches)
+        fields.update(extra)
+        return self.record("shaudit", **fields)
 
     def chaos(self, point, action, invocation=None, **extra):
         """An injected fault fired (utils.chaos) — journaled so a

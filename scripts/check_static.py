@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""check_static — the repo's three static/compile-level gates in ONE
+"""check_static — the repo's four static/compile-level gates in ONE
 process with a merged report and a single exit code:
 
   * ptlint     — source-level JAX-aware lint (tools/lint);
@@ -7,22 +7,26 @@ process with a merged report and a single exit code:
                  (tools/xprof) against scripts/hlo_baseline.json;
   * jxaudit    — program-level semantic audit (tools/jxaudit): donation,
                  dtype leaks, baked constants, host callbacks against
-                 scripts/jxaudit_baseline.json.
+                 scripts/jxaudit_baseline.json;
+  * shaudit    — mesh-aware sharding & collective semantic audit of the
+                 pjit'd sharded programs (tools/jxaudit/mesh_rules)
+                 against scripts/shaudit_baseline.json and the
+                 collective rows banked in scripts/hlo_baseline.json.
 
-    python scripts/check_static.py            # all three, text report
+    python scripts/check_static.py            # all four, text report
     python scripts/check_static.py --json     # one merged JSON document
     python scripts/check_static.py --skip hlo_audit
 
 Exit codes: 0 every gate clean, 1 any gate has findings/regressions,
 2 any gate hit an internal error (2 wins over 1). Tier-1 invokes this
-once (tests/test_check_static.py) instead of three separate subprocess
-tests; the three standalone CLIs keep working unchanged — this runner
+once (tests/test_check_static.py) instead of four separate subprocess
+tests; the four standalone CLIs keep working unchanged — this runner
 imports and drives their own `run()` entry points, so there is exactly
 one implementation of each gate's semantics.
 
 Sharing one process matters on the 1-core CI box: jax imports once, the
-persistent compile cache is shared, and hlo_audit + jxaudit lower the
-same tracked programs back to back while everything is warm.
+persistent compile cache is shared, and hlo_audit + jxaudit + shaudit
+lower the same tracked programs back to back while everything is warm.
 """
 import argparse
 import contextlib
@@ -35,8 +39,9 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-GATES = ("ptlint", "hlo_audit", "jxaudit")
-GATE_ARGS = {"ptlint": [], "hlo_audit": ["--diff"], "jxaudit": []}
+GATES = ("ptlint", "hlo_audit", "jxaudit", "shaudit")
+GATE_ARGS = {"ptlint": [], "hlo_audit": ["--diff"], "jxaudit": [],
+             "shaudit": []}
 
 
 def _load_cli(name):
@@ -81,8 +86,8 @@ def run_gate(name, as_json):
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="check_static",
-        description="run ptlint + hlo_audit --diff + jxaudit as one "
-                    "gate")
+        description="run ptlint + hlo_audit --diff + jxaudit + shaudit "
+                    "as one gate")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="merged machine-readable report on stdout")
     ap.add_argument("--skip", default=None,

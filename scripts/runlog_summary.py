@@ -19,6 +19,9 @@ schema in docs/observability.md. The report covers:
     scripts/hlo_audit.py),
   * the latest semantic-audit verdict (`jxaudit` events,
     scripts/jxaudit.py) — clean stamp or findings-per-rule,
+  * the latest sharding-audit verdict (`shaudit` events,
+    scripts/shaudit.py) — findings-per-rule plus wasted replicated
+    bytes and collective-budget breaches,
   * top collectives by payload bytes (op+group),
   * fleet events: replica kills/degradations/migrations/spawn failures
     (the router's `fault` events) and the SLO engine's burn-rate
@@ -159,6 +162,25 @@ def summarize(events):
             "by_rule": dict(last.get("by_rule") or {}),
             "programs": last.get("programs"),
             "degraded": last.get("degraded"),
+        }
+
+    # sharding audit: same verdict-of-record contract as jxaudit, plus
+    # the mesh-specific severities (wasted replicated bytes, budget
+    # breaches) the shaudit hook journals
+    sha = [e for e in events if e.get("ev") == "shaudit"]
+    shaudit = None
+    if sha:
+        last = sha[-1]
+        shaudit = {
+            "runs": len(sha),
+            "findings": int(last.get("findings", 0) or 0),
+            "by_rule": dict(last.get("by_rule") or {}),
+            "programs": last.get("programs"),
+            "degraded": last.get("degraded"),
+            "wasted_replicated_bytes": int(
+                last.get("wasted_replicated_bytes", 0) or 0),
+            "collective_breaches": int(
+                last.get("collective_breaches", 0) or 0),
         }
 
     # resilience: injected faults vs handled faults, by point/kind
@@ -307,6 +329,7 @@ def summarize(events):
         "compiles": sum(int(c.get("count", 1)) for c in compiles),
         "compile_s": sum(_num(c.get("compile_s")) or 0.0 for c in compiles),
         "jxaudit": jxaudit,
+        "shaudit": shaudit,
         "nonfinite": {
             "count": len(nonfinite),
             "steps": [e["step"] for e in nonfinite if "step" in e][:10],
@@ -510,6 +533,26 @@ def render(s):
             lines.append(f"semantic audit (jxaudit): clean{progs}")
         if j.get("degraded"):
             lines.append(f"  ({j['degraded']} program(s) with "
+                         "unavailable analyses on this jax build)")
+    sh = s.get("shaudit")
+    if sh:
+        progs = f" ({sh['programs']} programs)" if sh.get("programs") \
+            else ""
+        if sh["findings"]:
+            rules = ", ".join(f"{k}={v}"
+                              for k, v in sorted(sh["by_rule"].items()))
+            lines.append(f"sharding audit (shaudit): {sh['findings']} "
+                         f"finding(s){progs} — {rules}")
+        else:
+            lines.append(f"sharding audit (shaudit): clean{progs}")
+        if sh.get("wasted_replicated_bytes"):
+            lines.append("  wasted replicated bytes: "
+                         f"{_fmt_bytes(sh['wasted_replicated_bytes'])}")
+        if sh.get("collective_breaches"):
+            lines.append(f"  collective-budget breaches: "
+                         f"{sh['collective_breaches']}")
+        if sh.get("degraded"):
+            lines.append(f"  ({sh['degraded']} program(s) with "
                          "unavailable analyses on this jax build)")
     nf = s["nonfinite"]
     if nf["count"]:

@@ -1,12 +1,12 @@
 """check_static: the unified static/compile-level gate (tier-1).
 
-ONE subprocess runs all three analyzers — ptlint, hlo_audit --diff,
-jxaudit — in one process against their committed baselines; this is
-the repo-is-clean assertion that used to be three separate subprocess
+ONE subprocess runs all four analyzers — ptlint, hlo_audit --diff,
+jxaudit, shaudit — in one process against their committed baselines;
+this is the repo-is-clean assertion that used to be separate subprocess
 tests (tests/test_ptlint.py and tests/test_hlo_audit.py keep the
 per-tool fixtures and the gate-FIRES injection proofs; the standalone
 CLIs are unchanged). Sharing the process shares the jax import and the
-persistent compile cache between the two program-lowering gates.
+persistent compile cache between the program-lowering gates.
 """
 import json
 import os
@@ -25,30 +25,39 @@ def _cli(*args, timeout=700):
 
 
 def test_repo_is_static_clean_single_gate():
-    """ptlint + hlo_audit + jxaudit all exit 0 on this tree, through
-    one process and one merged JSON document."""
+    """ptlint + hlo_audit + jxaudit + shaudit all exit 0 on this tree,
+    through one process and one merged JSON document."""
     out = _cli("--json")
     assert out.returncode == 0, \
         f"static gate not clean:\n{out.stdout[-4000:]}\n{out.stderr[-2000:]}"
     doc = json.loads(out.stdout)
     assert doc["status"] == "clean"
     assert doc["exit_codes"] == {"ptlint": 0, "hlo_audit": 0,
-                                 "jxaudit": 0}
+                                 "jxaudit": 0, "shaudit": 0}
     # each gate's own document made it into the merge
     assert doc["gates"]["ptlint"]["status"] == "clean"
     assert doc["gates"]["ptlint"]["counts"]["baseline_undocumented"] == 0
     assert doc["gates"]["jxaudit"]["status"] == "clean"
     assert "programs" in doc["gates"]["hlo_audit"]     # the snapshot
+    sha = doc["gates"]["shaudit"]
+    assert sha["status"] == "clean"
+    # the sharded programs were actually audited, not degraded away:
+    # donation-through-pjit must PROVE the z1 step's dp-sharded opt
+    # leaves alias at shard shapes (acceptance), and every program in
+    # the mesh registry is present in the report
+    assert set(sha["report"]["programs"]) == {
+        "sharded_train_step", "sharded_train_step_z3",
+        "sharded_decode_wave"}
 
 
 def test_skip_narrows_the_gate():
-    out = _cli("--skip", "hlo_audit,jxaudit", "--json")
+    out = _cli("--skip", "hlo_audit,jxaudit,shaudit", "--json")
     assert out.returncode == 0, out.stdout + out.stderr
     doc = json.loads(out.stdout)
     assert set(doc["exit_codes"]) == {"ptlint"}
     bad = _cli("--skip", "nonsense")
     assert bad.returncode == 2
     # skipping EVERY gate must error, not report a vacuous clean
-    allskip = _cli("--skip", "ptlint,hlo_audit,jxaudit")
+    allskip = _cli("--skip", "ptlint,hlo_audit,jxaudit,shaudit")
     assert allskip.returncode == 2
     assert "checks nothing" in allskip.stderr

@@ -636,3 +636,62 @@ def test_repo_event_kind_sites_lint_clean():
     res = _cli("paddle_tpu/serving", "paddle_tpu/utils",
                "--select", "event-kind-documented")
     assert res.returncode == 0, res.stdout + res.stderr
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis-name
+# ---------------------------------------------------------------------------
+
+def test_mesh_axis_name_fires_on_typod_axis(tmp_path):
+    findings = _lint_src(tmp_path, """
+        from jax.sharding import PartitionSpec as P
+
+        good = P("dp", None)
+        typo = P("md", None)
+        nested = P(("dp", "nope"), None)
+        kw = dict(axis_name="dpp")
+    """, select={"mesh-axis-name"})
+    assert _rules(findings) == ["mesh-axis-name"]
+    axes = sorted(f.message.split("'")[1] for f in findings)
+    assert axes == ["dpp", "md", "nope"]
+    assert all("replicate silently" in f.message for f in findings)
+
+
+def test_mesh_axis_name_accepts_file_declared_axes(tmp_path):
+    """Axes a file's own Mesh/make_mesh literals or *_AXIS constants
+    declare are allowed — custom meshes don't need suppressions."""
+    findings = _lint_src(tmp_path, """
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        RING_AXIS = "ring"
+        m = Mesh(np.arange(4).reshape(2, 2), ("dp", "tp2d"))
+        make_mesh({"fsdp": 8})
+        a = P("tp2d", "dp")
+        b = P("fsdp")
+        c = psum(x, axis_name="ring")
+        d = shard_map(f, axis_names={"tp2d"})
+    """, select={"mesh-axis-name"})
+    assert findings == []
+
+
+def test_mesh_axis_name_reads_canonical_axes_from_mesh_module(tmp_path):
+    """With a repo-root mesh.py the *_AXIS constants there are the
+    registry of record — a canonical-name typo is caught against THAT
+    file, not a hardcoded set."""
+    root = tmp_path / "repo"
+    mesh_py = root / "paddle_tpu" / "distributed" / "mesh.py"
+    mesh_py.parent.mkdir(parents=True)
+    mesh_py.write_text('DP_AXIS = "dp"\nXP_AXIS = "xp"\n')
+    findings = _lint_src(tmp_path, """
+        from jax.sharding import PartitionSpec as P
+        ok = P("xp")
+        bad = P("mp")       # canonical elsewhere, absent from THIS repo
+    """, name="repo/mod.py", select={"mesh-axis-name"}, root=root)
+    assert _rules(findings) == ["mesh-axis-name"]
+    assert "'mp'" in findings[0].message
+
+
+def test_repo_mesh_axis_literals_lint_clean():
+    res = _cli("--select", "mesh-axis-name")
+    assert res.returncode == 0, res.stdout + res.stderr

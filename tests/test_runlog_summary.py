@@ -40,6 +40,10 @@ def _generate_journal(path):
                         peak_memory_bytes=26743969, fusion_count=349)
         rec.jxaudit(findings=2, by_rule={"donation-missing": 2},
                     programs=6, degraded=0)
+        rec.shaudit(findings=1, by_rule={"accidental-replication": 1},
+                    programs=3, degraded=0,
+                    wasted_replicated_bytes=3670016,
+                    collective_breaches=0)
         # fleet events: the router's replica_* fault kinds + the SLO
         # engine's burn journal (serving/slo.py schema)
         rec.fault(kind="replica_killed", action="replace",
@@ -83,6 +87,10 @@ def test_cli_end_to_end(tmp_path):
     # semantic-audit verdict renders next to the programs table
     assert "semantic audit (jxaudit): 2 finding(s) (6 programs) — " \
            "donation-missing=2" in text
+    # sharding-audit verdict with the mesh-specific severities
+    assert "sharding audit (shaudit): 1 finding(s) (3 programs) — " \
+           "accidental-replication=1" in text
+    assert "wasted replicated bytes: 3.5 MB" in text
     # fleet table: replica events + the SLO burn journal
     assert "fleet:" in text
     assert "kills" in text and "migrations" in text
@@ -115,6 +123,11 @@ def test_cli_json_mode(tmp_path):
     assert summary["jxaudit"] == {
         "runs": 1, "findings": 2, "by_rule": {"donation-missing": 2},
         "programs": 6, "degraded": 0}
+    assert summary["shaudit"] == {
+        "runs": 1, "findings": 1,
+        "by_rule": {"accidental-replication": 1}, "programs": 3,
+        "degraded": 0, "wasted_replicated_bytes": 3670016,
+        "collective_breaches": 0}
     assert summary["spec"] == {
         "waves": 2, "proposed": 24, "accepted": 12,
         "acceptance_rate": 0.5, "accepted_per_wave": 6.0}

@@ -1,0 +1,531 @@
+"""shaudit: mesh-aware sharding & collective audit (tools/jxaudit/
+mesh_rules + scripts/shaudit.py).
+
+Contracts under test:
+
+  * each mesh rule FIRES on a purpose-built mis-sharded probe over the
+    8-device dp mesh and STAYS SILENT on the honest twin;
+  * the acceptance regressions on the REAL sharded programs: the z1
+    step's dp-sharded optimizer leaves alias at shard shapes
+    (donation-through-pjit affirmatively clean, NOT degraded), and the
+    declared expected-collectives escape is load-bearing (stripping it
+    makes the flash-attention halo permutes fire reshard-in-body);
+  * degradation triads: no sharding metadata / no entry annotations /
+    lower() failure -> null + per-rule reason, never a finding;
+  * rule-id disjointness across all three analyzers (ptlint, jxaudit,
+    shaudit) — a rule id in any report names exactly one tool;
+  * the HLO collective operand-bytes parser on synthetic lines;
+  * the CLI exit contract: every --inject class exits 1 (positive
+    controls), --baseline-update with --inject refused, --select that
+    excludes the injected class refused, foreign-backend banked rows
+    degrade instead of comparing;
+  * the audit journals a `shaudit` summary event with the mesh-specific
+    severities.
+
+The repo-audits-clean gate itself runs once through
+tests/test_check_static.py (ptlint + hlo_audit + jxaudit + shaudit in
+one process).
+"""
+import contextlib
+import importlib.util
+import io
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.tools import jxaudit
+from paddle_tpu.tools.jxaudit import mesh_inject, mesh_rules
+from paddle_tpu.tools.jxaudit.core import (ProgramContext,
+                                           parse_entry_param_shardings)
+from paddle_tpu.tools.xprof import hlo as hlo_mod
+from paddle_tpu.utils import flight_recorder as fr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "shaudit.py")
+
+needs_mesh = pytest.mark.skipif(
+    jax.device_count() < 2, reason="needs the multi-device CPU mesh")
+
+
+def _cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=env, timeout=500)
+
+
+def _load_shaudit_mod():
+    spec = importlib.util.spec_from_file_location("_test_shaudit_cli",
+                                                  SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mesh_audit(spec, select):
+    return jxaudit.audit_programs([spec], select=select,
+                                  rules=jxaudit.MESH_RULES)
+
+
+# ---------------------------------------------------------------------------
+# parsing units: committed shardings + collective operand bytes
+# ---------------------------------------------------------------------------
+
+def test_parse_entry_param_shardings():
+    text = """
+HloModule m
+ENTRY %main {
+  %p0 = f32[64,256]{1,0} parameter(0), sharding={devices=[8,1]<=[8]}
+  %p1 = f32[512,256]{1,0} parameter(1), sharding={replicated}
+  ROOT %r = f32[64,256]{1,0} add(%p0, %p0)
+}
+"""
+    ann = parse_entry_param_shardings(text)
+    assert ann == {0: "{devices=[8,1]<=[8]}", 1: "{replicated}"}
+    assert mesh_rules._is_replicated(ann[1])
+    assert not mesh_rules._is_replicated(ann[0])
+    # partial replication must NOT read as replicated
+    assert not mesh_rules._is_replicated(
+        "{devices=[4,1,2]<=[8] last_tile_dim_replicate}")
+    # no annotations at all -> {} (degrade upstream, never "all
+    # replicated")
+    assert parse_entry_param_shardings(
+        "%p0 = f32[4]{0} parameter(0)\n") == {}
+    # same index with two different strings -> None (misattribution is
+    # worse than not answering)
+    conflict = ("%a = f32[4]{0} parameter(0), sharding={replicated}\n"
+                "%b = f32[4]{0} parameter(0), "
+                "sharding={devices=[8]<=[8]}\n")
+    assert parse_entry_param_shardings(conflict) is None
+
+
+def test_collective_operand_bytes_from_hlo_text():
+    text = """
+HloModule m
+ENTRY %main {
+  %p0 = f32[2,256]{1,0} parameter(0)
+  %ag = f32[16,256]{1,0} all-gather(f32[2,256]{1,0} %p0), dimensions={0}
+  %ar = f32[16,256]{1,0} all-reduce(f32[16,256]{1,0} %ag), to_apply=%add
+  %cp = f32[2,256]{1,0} collective-permute-start(f32[2,256]{1,0} %p0)
+  ROOT %r = f32[16,256]{1,0} add(%ar, %ar)
+}
+"""
+    h = hlo_mod.op_histogram(text)
+    # operand bytes = volume INTO the op: the all-gather carries its
+    # 2x256 f32 shard (2 KiB), not its 16x256 result
+    assert h["collectives"] == {"all-gather": 1, "all-reduce": 1,
+                                "collective-permute-start": 1}
+    assert h["collective_bytes"]["all-gather"] == 2 * 256 * 4
+    assert h["collective_bytes"]["all-reduce"] == 16 * 256 * 4
+    assert h["collective_bytes"]["collective-permute-start"] == 2 * 256 * 4
+    assert h["collective_bytes_total"] == (2 + 16 + 2) * 256 * 4
+    # an unknown dtype poisons that op's bytes to None, count survives
+    odd = "%x = q4[8]{0} all-reduce(q4[8]{0} %p0), to_apply=%add\n"
+    h2 = hlo_mod.op_histogram(odd)
+    assert h2["collectives"] == {"all-reduce": 1}
+    assert h2["collective_bytes"]["all-reduce"] is None
+    assert h2["collective_bytes_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# rules on the injection probes (fires) and honest twins (silent)
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_sharding_dropped_fires_on_declaration_drift():
+    spec = jxaudit.build_injected_spec("sharding-dropped")
+    findings, report = _mesh_audit(spec, {"sharding-dropped"})
+    assert [f.rule for f in findings] == ["sharding-dropped"]
+    (fd,) = findings
+    assert fd.details["committed"] == "{replicated}"
+    assert "params" in fd.details["leaf"]
+    assert "unavailable" not in report["programs"][mesh_inject.PROBE_NAME]
+
+
+@needs_mesh
+def test_sharding_dropped_silent_on_honest_probe():
+    mesh = mesh_inject._mesh()
+    dp = P("dp", None)
+    spec = mesh_inject._assemble(mesh, mesh_inject._base_fn(),
+                                 param_spec=dp, opt_spec=dp)
+    findings, report = _mesh_audit(spec, {"sharding-dropped"})
+    assert findings == []
+    assert "unavailable" not in report["programs"][mesh_inject.PROBE_NAME]
+
+
+@needs_mesh
+def test_accidental_replication_quantifies_wasted_bytes():
+    """The acceptance probe: a deliberately replicated 512 KiB
+    optimizer accumulator with a dp-divisible dim must be caught with
+    wasted = bytes x (devices - 1)."""
+    spec = jxaudit.build_injected_spec("accidental-replication")
+    findings, report = _mesh_audit(spec, {"accidental-replication"})
+    assert [f.rule for f in findings] == ["accidental-replication"]
+    (fd,) = findings
+    ndev = jax.device_count() if jax.device_count() < 8 else 8
+    m_bytes = mesh_inject._W * mesh_inject._K * 4
+    assert fd.details["bytes"] == m_bytes
+    assert fd.details["wasted_bytes"] == m_bytes * (ndev - 1)
+    assert "opt_state" in fd.details["leaf"]
+    s = jxaudit.summarize_mesh(findings, report)
+    assert s["wasted_replicated_bytes"] == m_bytes * (ndev - 1)
+    # the dp-sharded twin is silent
+    twin = mesh_inject._assemble(mesh_inject._mesh(),
+                                 mesh_inject._base_fn(),
+                                 param_spec=P(), opt_spec=P("dp", None))
+    findings2, _ = _mesh_audit(twin, {"accidental-replication"})
+    assert findings2 == []
+
+
+@needs_mesh
+def test_donation_through_pjit_fires_at_shard_shapes():
+    spec = jxaudit.build_injected_spec("donation-through-pjit")
+    findings, report = _mesh_audit(spec, {"donation-through-pjit"})
+    assert [f.rule for f in findings] == ["donation-through-pjit"]
+    assert "'opt_state'" in findings[0].message
+    assert "unavailable" not in report["programs"][mesh_inject.PROBE_NAME]
+
+
+@needs_mesh
+def test_collective_budget_empty_budget_flags_any_collective():
+    spec = jxaudit.build_injected_spec("collective-budget")
+    findings, _ = _mesh_audit(spec, {"collective-budget"})
+    assert findings and all(f.rule == "collective-budget"
+                            for f in findings)
+    assert any("unbudgeted" in f.message for f in findings)
+
+
+@needs_mesh
+def test_collective_budget_degrades_without_banked_rows():
+    """No attached baseline -> reason, never a spurious finding (and
+    never a spurious clean: the degrade is reported)."""
+    mesh = mesh_inject._mesh()
+    spec = mesh_inject._assemble(mesh, mesh_inject._base_fn(),
+                                 param_spec=P(), opt_spec=P("dp", None))
+    findings, report = _mesh_audit(spec, {"collective-budget"})
+    assert findings == []
+    reason = report["programs"][mesh_inject.PROBE_NAME][
+        "unavailable"]["collective-budget"]
+    assert "hlo_audit.py --update-baseline" in reason
+
+
+@needs_mesh
+def test_reshard_in_body_fires_on_forced_flip():
+    spec = jxaudit.build_injected_spec("reshard-in-body")
+    findings, _ = _mesh_audit(spec, {"reshard-in-body"})
+    assert findings and all(f.rule == "reshard-in-body"
+                            for f in findings)
+    assert any(f.details["op"].startswith("all-to-all")
+               for f in findings), [f.details for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# the real sharded programs (acceptance regressions)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def z1_spec():
+    (spec,) = jxaudit.mesh_specs(["sharded_train_step"])
+    return spec
+
+
+@needs_mesh
+def test_sharded_train_step_mesh_audit_clean_not_degraded(z1_spec):
+    """The z1 step audits CLEAN on the sharding rules with every rule
+    actually answering — donation-through-pjit must PROVE the
+    dp-sharded opt leaves alias at shard shapes, not degrade its way to
+    silence (the audit is only a gate while the analyses resolve)."""
+    select = {"sharding-dropped", "accidental-replication",
+              "donation-through-pjit", "reshard-in-body"}
+    findings, report = _mesh_audit(z1_spec, select)
+    assert findings == [], [f.render() for f in findings]
+    row = report["programs"]["sharded_train_step"]
+    degraded = set(row.get("unavailable") or {})
+    assert not (select & degraded), row.get("unavailable")
+
+
+@needs_mesh
+def test_expected_collectives_escape_is_load_bearing(z1_spec):
+    """Stripping the declared expected-collectives set makes the
+    flash-attention halo permutes fire reshard-in-body — the escape is
+    doing real work, not masking the rule."""
+    stripped = dict(z1_spec,
+                    sharding=dict(z1_spec["sharding"],
+                                  expected_collectives=()))
+    findings, _ = _mesh_audit(stripped, {"reshard-in-body"})
+    assert findings, "halo collective-permutes should fire without the " \
+                     "declared expected set"
+    assert all(f.details["op"].startswith("collective-permute")
+               for f in findings), [f.details for f in findings]
+    # and with the declaration in place they are expected, not findings
+    findings2, _ = _mesh_audit(z1_spec, {"reshard-in-body"})
+    assert findings2 == []
+
+
+@needs_mesh
+def test_collective_budget_gates_real_program_against_banked_rows(z1_spec):
+    """The banked hlo_baseline rows budget the z1 step exactly: clean
+    as banked, findings when the budget is tightened below reality."""
+    sh = _load_shaudit_mod()
+    sh.attach_collective_budgets([z1_spec],
+                                 os.path.join(REPO, "scripts",
+                                              "hlo_baseline.json"))
+    base = z1_spec["sharding"].get("collective_baseline")
+    assert base is not None, z1_spec["sharding"].get(
+        "collective_baseline_reason")
+    assert "all-reduce" in base["collectives"]
+    findings, _ = _mesh_audit(z1_spec, {"collective-budget"})
+    assert findings == [], [f.render() for f in findings]
+    # halve one opcode's banked count: the gate must fire
+    tight = json.loads(json.dumps(base))
+    op = sorted(tight["collectives"])[0]
+    tight["collectives"][op]["count"] //= 2
+    tightened = dict(z1_spec,
+                     sharding=dict(z1_spec["sharding"],
+                                   collective_baseline=tight))
+    findings2, _ = _mesh_audit(tightened, {"collective-budget"})
+    assert any(f.details.get("op") == op and "count" in f.message
+               for f in findings2), [f.render() for f in findings2]
+
+
+def test_attach_collective_budgets_degrades_on_backend_mismatch(tmp_path):
+    sh = _load_shaudit_mod()
+    foreign = tmp_path / "hlo_baseline.json"
+    foreign.write_text(json.dumps({
+        "backend": "tpu", "programs": {"p": {"collectives": {}}}}))
+    spec = {"name": "p", "sharding": {}}
+    sh.attach_collective_budgets([spec], str(foreign))
+    assert "collective_baseline" not in spec["sharding"]
+    assert "not comparable" in spec["sharding"][
+        "collective_baseline_reason"]
+    # unreadable file: same degrade path
+    spec2 = {"name": "p", "sharding": {}}
+    sh.attach_collective_budgets([spec2], str(tmp_path / "missing.json"))
+    assert "unreadable" in spec2["sharding"]["collective_baseline_reason"]
+
+
+# ---------------------------------------------------------------------------
+# degradation triad: null + reason, never misattribution
+# ---------------------------------------------------------------------------
+
+MESH_RULE_IDS = ("sharding-dropped", "accidental-replication",
+                 "donation-through-pjit", "collective-budget",
+                 "reshard-in-body")
+
+
+def test_degrades_without_sharding_metadata():
+    """A spec with no `sharding` declaration is not a mesh program:
+    the declaration-driven rules must say so per rule, and none may
+    invent a finding."""
+    def f(x):
+        return x * 2
+
+    spec = {"name": "toy", "fn": f, "args": (jnp.zeros((8, 8)),)}
+    findings, report = jxaudit.audit_programs(
+        [spec], rules=jxaudit.MESH_RULES)
+    assert findings == []
+    reasons = report["programs"]["toy"]["unavailable"]
+    for rule_id in ("sharding-dropped", "accidental-replication",
+                    "reshard-in-body"):
+        assert "no declared sharding metadata" in reasons[rule_id]
+    assert "collective-budget" in reasons
+
+
+def test_degrades_when_lower_fails():
+    class _LowerRaises:
+        def trace(self, *a, **kw):
+            raise RuntimeError("no trace on this build")
+
+        def lower(self, *a, **kw):
+            raise RuntimeError("no lower on this build")
+
+    spec = {"name": "toy", "jitted": _LowerRaises(),
+            "args": ({"w": jnp.zeros((8, 8))},
+                     {"m": jnp.zeros((8, 8))}),
+            "donate_argnums": (1,),
+            "arg_names": ("params", "opt_state"),
+            "sharding": {"mesh_axes": {"dp": 8},
+                         "in_specs": {0: P("dp", None)},
+                         "constraint_specs": [],
+                         "expected_collectives": ()}}
+    findings, report = jxaudit.audit_programs(
+        [spec], rules=jxaudit.MESH_RULES)
+    assert findings == []
+    reasons = report["programs"]["toy"]["unavailable"]
+    for rule_id in MESH_RULE_IDS:
+        assert rule_id in reasons, (rule_id, reasons)
+    s = jxaudit.summarize_mesh(findings, report)
+    assert s["degraded"] == 1 and s["findings"] == 0
+
+
+def test_degrades_when_module_has_no_sharding_annotations():
+    """A single-device jit compile commits no `sharding=` annotations:
+    the committed-view rules must degrade with the parse reason — an
+    empty annotation set must NEVER be read as 'everything
+    replicated'."""
+    def f(params, opt_state):
+        return ({"w": params["w"] * 2},
+                {"m": opt_state["m"] + 1})
+
+    spec = {"name": "toy", "fn": f,
+            "args": ({"w": jnp.zeros((64, 64))},
+                     {"m": jnp.zeros((256, 256))}),   # 256 KiB state
+            "arg_names": ("params", "opt_state"),
+            "sharding": {"mesh_axes": {"dp": 8},
+                         "in_specs": {0: P("dp", None)},
+                         "constraint_specs": [],
+                         "expected_collectives": ()}}
+    findings, report = jxaudit.audit_programs(
+        [spec], select={"sharding-dropped", "accidental-replication"},
+        rules=jxaudit.MESH_RULES)
+    assert findings == []
+    reasons = report["programs"]["toy"]["unavailable"]
+    assert "entry sharding annotations" in reasons["sharding-dropped"]
+    assert "entry sharding annotations" in \
+        reasons["accidental-replication"]
+
+
+def test_leaf_rows_degrades_on_declaration_drift():
+    """A declared spec tree that no longer matches the argument
+    structure is reported as drift, not guessed around."""
+    def f(params):
+        return params
+
+    spec = {"name": "toy", "fn": f,
+            "args": ({"a": jnp.zeros(4), "b": jnp.zeros(4)},),
+            "sharding": {"mesh_axes": {"dp": 8},
+                         "in_specs": {0: {"a": P("dp")}},  # one of two
+                         "constraint_specs": [],
+                         "expected_collectives": ()}}
+    findings, report = jxaudit.audit_programs(
+        [spec], select={"sharding-dropped"}, rules=jxaudit.MESH_RULES)
+    assert findings == []
+    reason = report["programs"]["toy"]["unavailable"]["sharding-dropped"]
+    assert "drifted" in reason
+
+
+# ---------------------------------------------------------------------------
+# registries: disjoint rule ids across the three analyzers
+# ---------------------------------------------------------------------------
+
+def test_rule_ids_disjoint_across_analyzers():
+    from paddle_tpu.tools import lint as ptlint_pkg
+    lint_ids = set(ptlint_pkg.RULES)
+    jx_ids = set(jxaudit.RULES)
+    mesh_ids = set(jxaudit.MESH_RULES)
+    assert mesh_ids == set(MESH_RULE_IDS)
+    assert not (lint_ids & jx_ids)
+    assert not (lint_ids & mesh_ids)
+    assert not (jx_ids & mesh_ids)
+    # registration itself refuses a collision with the built-ins
+    with pytest.raises(ValueError, match="duplicate rule id"):
+        @mesh_rules.register_mesh
+        class Clash(mesh_rules.Rule):
+            id = "donation-dropped"
+    assert "donation-dropped" not in jxaudit.MESH_RULES
+
+
+def test_cli_list_rules_disjoint_and_complete():
+    """The three CLIs' --list-rules surfaces are the registries —
+    driven in-process (check_static's loader pattern) so this stays
+    cheap."""
+    def _list(script):
+        path = os.path.join(REPO, "scripts", script)
+        spec = importlib.util.spec_from_file_location(
+            f"_lr_{script.replace('.', '_')}", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = mod.run(["--list-rules"])
+        assert rc == 0
+        return {line.split(":", 1)[0] for line in
+                buf.getvalue().splitlines() if ":" in line}
+
+    pt_ids = _list("ptlint.py")
+    jx_ids = _list("jxaudit.py")
+    sh_ids = _list("shaudit.py")
+    assert sh_ids == set(MESH_RULE_IDS)
+    assert "mesh-axis-name" in pt_ids
+    assert not (pt_ids & jx_ids) and not (pt_ids & sh_ids) \
+        and not (jx_ids & sh_ids)
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+def test_publish_mesh_summary_journals_shaudit_event():
+    spec = jxaudit.build_injected_spec("accidental-replication")
+    findings, report = _mesh_audit(spec, {"accidental-replication"})
+    rec = fr.FlightRecorder()           # memory-only
+    ev = jxaudit.publish_mesh_summary(findings, report, recorder=rec)
+    assert ev["ev"] == "shaudit"
+    assert ev["findings"] == 1
+    assert ev["by_rule"] == {"accidental-replication": 1}
+    assert ev["programs"] == 1
+    assert ev["wasted_replicated_bytes"] == \
+        findings[0].details["wasted_bytes"]
+    assert ev["collective_breaches"] == 0
+    assert "shaudit" in fr.EVENT_KINDS
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit contract + positive controls (tier-1's gate-fires proof)
+# ---------------------------------------------------------------------------
+
+@needs_mesh
+@pytest.mark.parametrize("defect", sorted(mesh_inject.MESH_INJECTIONS))
+def test_cli_injected_defect_exits_1(defect):
+    out = _cli("--inject", defect)
+    assert out.returncode == 1, \
+        f"injected {defect} passed the audit:\n{out.stdout}\n{out.stderr}"
+    assert defect in out.stdout                # the matching rule fired
+
+
+def test_cli_refusals_exit_2():
+    out = _cli("--inject", "reshard-in-body", "--baseline-update")
+    assert out.returncode == 2
+    assert "refusing" in out.stderr
+    out2 = _cli("--inject", "no-such-class")
+    assert out2.returncode == 2
+    # --select that excludes the injected class would let the positive
+    # control vacuously pass — refused
+    out3 = _cli("--inject", "reshard-in-body", "--select",
+                "collective-budget")
+    assert out3.returncode == 2
+    assert "vacuously" in out3.stderr
+    out4 = _cli("--programs", "no_such_program")
+    assert out4.returncode == 2
+
+
+def test_cli_inject_refused_on_single_device():
+    """Outside the tier-1 8-device env every probe axis has size 1, so
+    an injected defect can't manifest — the CLI must refuse (exit 2),
+    never report a vacuous clean exit 0."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "--inject", "accidental-replication"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=500)
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "vacuously" in out.stderr
+
+
+def test_cli_undocumented_baseline_entry_fails(tmp_path):
+    """A baseline entry without a justification is rejected even when
+    the audited subset is clean — ptlint's contract, same machinery."""
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"version": 1, "findings": [{
+        "rule": "reshard-in-body", "path": "sharded_decode_wave",
+        "message": "grandfathered without explanation", "count": 1}]}))
+    out = _cli("--programs", "sharded_decode_wave",
+               "--baseline", str(base))
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "lacks a justification" in out.stdout
